@@ -1,0 +1,11 @@
+"""areal-tpu: a TPU-native asynchronous RL training framework.
+
+A from-scratch JAX/XLA/pjit/Pallas re-design of the capabilities of AReaL
+(the reference's layer map is documented in SURVEY.md): staleness-controlled
+asynchronous rollout, decoupled-PPO/GRPO training over packed variable-length
+sequences, GSPMD mesh parallelism (DP/FSDP/TP/SP/CP/EP), a continuous-batching
+JAX inference engine with interruptible generation and in-place weight updates,
+and launcher/recovery/observability infrastructure.
+"""
+
+__version__ = "0.1.0"

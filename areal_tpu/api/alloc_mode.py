@@ -1,0 +1,277 @@
+"""Allocation-mode language: device-count/parallel-layout expressions.
+
+Capability parity with the reference's ``areal/api/alloc_mode.py`` (Lark
+grammar at alloc_mode.py:316-358, ``ParallelStrategy`` 5-D dataclass, and
+``AllocationMode.from_str``): expressions such as
+
+- ``d4t2``                         — train-only layout (4-way DP × 2-way TP)
+- ``gspmd:d4t2c2``                 — explicit train backend
+- ``jaxgen:d4t2+gspmd:d2t4``       — disaggregated: inference chips + train chips
+- ``jaxgen:d2t2|gspmd:d2t2``       — colocated: same chips serve both roles
+- ``jaxgen:d4+eval``               — inference + evaluation-only client
+- ``gspmd:(attn:d2c2t2|ffn:d2e2t2)`` — MoE hybrid attn/ffn layouts
+
+Dim letters: d=data, t=tensor, p=pipeline, c=context(sequence), e=expert.
+Reference backend names (sglang, vllm, fsdp, megatron) are accepted as aliases
+so reference YAML configs parse unchanged, mapping onto the two TPU backends:
+``jaxgen`` (continuous-batching JAX inference engine) and ``gspmd`` (mesh
+train engine).
+
+TPU mapping: a ParallelStrategy is realized as a ``jax.sharding.Mesh`` with
+axes ("dp", "pp", "cp", "ep", "tp") — see areal_tpu/parallel/mesh.py. The
+parser here is a hand-written tokenizer/recursive-descent (no grammar files).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+
+GEN_BACKEND_ALIASES = {"sglang": "jaxgen", "vllm": "jaxgen", "jaxgen": "jaxgen"}
+TRAIN_BACKEND_ALIASES = {
+    "fsdp": "gspmd",
+    "megatron": "gspmd",
+    "gspmd": "gspmd",
+}
+
+DIM_NAMES = {"d": "dp", "t": "tp", "p": "pp", "c": "cp", "e": "ep"}
+
+
+class AllocationType(enum.Enum):
+    DECOUPLED = "decoupled"  # gen chips + train chips
+    COLOCATED = "colocated"  # same chips, both roles
+    TRAIN_ONLY = "train_only"
+    GEN_ONLY = "gen_only"
+    DECOUPLED_EVAL = "decoupled_eval"  # gen + eval client (no trainer)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelStrategy:
+    """5-D parallel layout (reference: alloc_mode.py:35-203).
+
+    ``ep``/``etp``/``edp`` describe the expert (FFN) sub-layout for MoE; for
+    dense models they stay 1. The invariant, matching the reference's MoE
+    folding, is dp*cp*tp == edp*ep*etp when a hybrid layout is given (the
+    attention and FFN layouts must cover the same chips).
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    cp: int = 1
+    ep: int = 1
+    etp: int = 1
+    edp: int = 1
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"{f.name} must be a positive int, got {v}")
+        if self.ep > 1 or self.etp > 1 or self.edp > 1:
+            attn_world = self.dp * self.cp * self.tp
+            ffn_world = self.edp * self.ep * self.etp
+            if attn_world != ffn_world:
+                raise ValueError(
+                    f"attn layout covers {attn_world} chips/stage but ffn layout "
+                    f"covers {ffn_world}; they must match"
+                )
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.tp * self.pp * self.cp
+
+    def __str__(self) -> str:
+        def dims_str(pairs):
+            s = "".join(f"{l}{v}" for l, v in pairs if v != 1)
+            return s or "d1"
+
+        if self.ep > 1 or self.etp > 1 or self.edp > 1:
+            default_etp = self.tp
+            default_edp = self.dp * self.cp // self.ep if self.ep > 1 else 1
+            if self.etp != default_etp or self.edp != default_edp:
+                # non-default expert folding only survives hybrid syntax
+                attn = dims_str(
+                    [("d", self.dp), ("c", self.cp), ("t", self.tp), ("p", self.pp)]
+                )
+                ffn = dims_str(
+                    [("d", self.edp), ("e", self.ep), ("t", self.etp), ("p", self.pp)]
+                )
+                return f"(attn:{attn}|ffn:{ffn})"
+        return dims_str(
+            [
+                ("d", self.dp),
+                ("t", self.tp),
+                ("p", self.pp),
+                ("c", self.cp),
+                ("e", self.ep),
+            ]
+        )
+
+
+@dataclasses.dataclass
+class AllocationMode:
+    type_: AllocationType
+    gen_backend: str | None = None
+    gen: ParallelStrategy | None = None
+    train_backend: str | None = None
+    train: ParallelStrategy | None = None
+
+    @property
+    def gen_world_size(self) -> int:
+        return self.gen.world_size if self.gen else 0
+
+    @property
+    def train_world_size(self) -> int:
+        return self.train.world_size if self.train else 0
+
+    @property
+    def total_world_size(self) -> int:
+        if self.type_ == AllocationType.COLOCATED:
+            return max(self.gen_world_size, self.train_world_size)
+        return self.gen_world_size + self.train_world_size
+
+    # ------------------------- parsing -------------------------
+    @classmethod
+    def from_str(cls, s: str) -> "AllocationMode":
+        s = s.strip().replace(" ", "")
+        if not s:
+            raise ValueError("Empty allocation mode")
+        # decoupled: '+' at top level
+        plus_parts = _split_top(s, "+")
+        if len(plus_parts) == 2:
+            left, right = plus_parts
+            if right == "eval":
+                backend, strat = _parse_role(left, gen=True)
+                return cls(AllocationType.DECOUPLED_EVAL, backend, strat)
+            gb, gs = _parse_role(left, gen=True)
+            tb, ts = _parse_role(right, gen=False)
+            return cls(AllocationType.DECOUPLED, gb, gs, tb, ts)
+        if len(plus_parts) > 2:
+            raise ValueError(f"At most one '+' allowed: {s}")
+        bar_parts = _split_top(s, "|")
+        if len(bar_parts) == 2:
+            gb, gs = _parse_role(bar_parts[0], gen=True)
+            tb, ts = _parse_role(bar_parts[1], gen=False)
+            if gs.world_size != ts.world_size:
+                raise ValueError(
+                    f"Colocated roles must cover the same chips: "
+                    f"{gs.world_size} vs {ts.world_size}"
+                )
+            return cls(AllocationType.COLOCATED, gb, gs, tb, ts)
+        if len(bar_parts) > 2:
+            raise ValueError(f"At most one top-level '|' allowed: {s}")
+        # single role
+        if ":" in s:
+            backend = s.split(":", 1)[0]
+            if backend in GEN_BACKEND_ALIASES:
+                gb, gs = _parse_role(s, gen=True)
+                return cls(AllocationType.GEN_ONLY, gb, gs)
+        tb, ts = _parse_role(s, gen=False)
+        return cls(AllocationType.TRAIN_ONLY, train_backend=tb, train=ts)
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    """Split on ``sep`` outside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth < 0:
+                raise ValueError(f"Unbalanced ')' in {s!r}")
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if depth != 0:
+        raise ValueError(f"Unbalanced '(' in {s!r}")
+    parts.append("".join(cur))
+    return parts
+
+
+_DIM_RE = re.compile(r"([dtpce])(\d+)")
+
+
+def _parse_dims(s: str) -> dict[str, int]:
+    pos = 0
+    dims: dict[str, int] = {}
+    while pos < len(s):
+        m = _DIM_RE.match(s, pos)
+        if not m:
+            raise ValueError(f"Bad parallel spec at {s[pos:]!r} in {s!r}")
+        letter, value = m.group(1), int(m.group(2))
+        name = DIM_NAMES[letter]
+        if name in dims:
+            raise ValueError(f"Duplicate dim {letter!r} in {s!r}")
+        dims[name] = value
+        pos = m.end()
+    if not dims:
+        raise ValueError(f"Empty parallel spec: {s!r}")
+    return dims
+
+
+def _parse_parallel(s: str) -> ParallelStrategy:
+    """Parse either plain dims or a MoE hybrid '(attn:...|ffn:...)'."""
+    if s.startswith("("):
+        if not s.endswith(")"):
+            raise ValueError(f"Unbalanced hybrid spec: {s!r}")
+        inner = s[1:-1]
+        halves = _split_top(inner, "|")
+        if len(halves) != 2:
+            raise ValueError(f"Hybrid spec needs 'attn:...|ffn:...': {s!r}")
+        spec: dict[str, dict[str, int]] = {}
+        for half in halves:
+            if ":" not in half:
+                raise ValueError(f"Hybrid half missing role: {half!r}")
+            role, dims_s = half.split(":", 1)
+            if role not in ("attn", "ffn"):
+                raise ValueError(f"Hybrid role must be attn|ffn: {role!r}")
+            spec[role] = _parse_dims(dims_s)
+        if "attn" not in spec or "ffn" not in spec:
+            raise ValueError(f"Hybrid spec needs both attn and ffn: {s!r}")
+        attn, ffn = spec["attn"], spec["ffn"]
+        if "ep" in attn:
+            raise ValueError("attn layout cannot have an expert dim")
+        if attn.get("pp", 1) != ffn.get("pp", 1):
+            raise ValueError("attn and ffn pp must match")
+        return ParallelStrategy(
+            dp=attn.get("dp", 1),
+            tp=attn.get("tp", 1),
+            pp=attn.get("pp", 1),
+            cp=attn.get("cp", 1),
+            ep=ffn.get("ep", 1),
+            etp=ffn.get("tp", 1),
+            edp=ffn.get("dp", 1),
+        )
+    dims = _parse_dims(s)
+    return ParallelStrategy(
+        dp=dims.get("dp", 1),
+        tp=dims.get("tp", 1),
+        pp=dims.get("pp", 1),
+        cp=dims.get("cp", 1),
+        ep=dims.get("ep", 1),
+        etp=dims.get("tp", 1) if dims.get("ep", 1) > 1 else 1,
+        edp=(
+            dims.get("dp", 1) * dims.get("cp", 1) // dims.get("ep", 1)
+            if dims.get("ep", 1) > 1
+            else 1
+        ),
+    )
+
+
+def _parse_role(s: str, gen: bool) -> tuple[str, ParallelStrategy]:
+    aliases = GEN_BACKEND_ALIASES if gen else TRAIN_BACKEND_ALIASES
+    default = "jaxgen" if gen else "gspmd"
+    if ":" in s and not s.startswith("("):
+        backend, rest = s.split(":", 1)
+        if backend not in aliases:
+            raise ValueError(
+                f"Unknown {'gen' if gen else 'train'} backend {backend!r} "
+                f"(known: {sorted(aliases)})"
+            )
+        return aliases[backend], _parse_parallel(rest)
+    return default, _parse_parallel(s)

@@ -1,0 +1,501 @@
+"""Config system: dataclasses + YAML file + dotted CLI overrides.
+
+Capability parity with the reference's ``areal/api/cli_args.py`` (SURVEY §2.4):
+the same config surface (GenerationHyperparameters, OptimizerConfig,
+TrainEngineConfig, PPOActorConfig, InferenceEngineConfig, saver/eval/recover
+timers, DatasetConfig, launcher configs, BaseExperimentConfig and the
+SFT/GRPO/PPO experiment types) and the same loading convention
+(``--config file.yaml key=value ...``). The reference leans on OmegaConf;
+here structured merge/coercion is implemented directly (no omegaconf in the
+TPU image).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import types
+import typing
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from areal_tpu.utils.name_resolve import NameResolveConfig
+
+# --------------------------------------------------------------------------
+# Structured merge machinery (OmegaConf replacement)
+# --------------------------------------------------------------------------
+
+
+def _is_dataclass_type(tp) -> bool:
+    return dataclasses.is_dataclass(tp) and isinstance(tp, type)
+
+
+def _coerce(value: Any, tp: Any) -> Any:
+    origin = typing.get_origin(tp)
+    if tp is Any or tp is None:
+        return value
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if value is None:
+            return None
+        for a in args:
+            try:
+                return _coerce(value, a)
+            except (TypeError, ValueError):
+                continue
+        raise TypeError(f"Cannot coerce {value!r} to {tp}")
+    if _is_dataclass_type(tp):
+        if isinstance(value, tp):
+            return value
+        if isinstance(value, dict):
+            return from_dict(tp, value)
+        raise TypeError(f"Cannot coerce {value!r} to {tp}")
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        elem = args[0] if args else Any
+        if isinstance(value, str):
+            value = [v for v in value.split(",") if v]
+        seq = [_coerce(v, elem) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        return dict(value)
+    if tp is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            if value.lower() in ("true", "1", "yes"):
+                return True
+            if value.lower() in ("false", "0", "no"):
+                return False
+            raise ValueError(f"Cannot parse bool: {value!r}")
+        return bool(value)
+    if tp is int:
+        if isinstance(value, bool):
+            raise TypeError("bool is not int")
+        return int(value)
+    if tp is float:
+        return float(value)
+    if tp is str:
+        return str(value)
+    return value
+
+
+def from_dict(cls, data: dict[str, Any]):
+    """Build dataclass ``cls`` from a nested dict with type coercion; unknown
+    keys raise (catching config typos, like OmegaConf structured mode)."""
+    if data is None:
+        data = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(f"Unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    for name, f in fields.items():
+        if name in data:
+            kwargs[name] = _coerce(data[name], hints.get(name, Any))
+    return cls(**kwargs)
+
+
+def to_dict(cfg) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def _set_dotted(d: dict, dotted_key: str, value: Any):
+    parts = dotted_key.split(".")
+    cur = d
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+        if not isinstance(cur, dict):
+            raise ValueError(f"Cannot override non-dict path {dotted_key}")
+    cur[parts[-1]] = value
+
+
+def _parse_override_value(s: str) -> Any:
+    try:
+        return yaml.safe_load(s)
+    except yaml.YAMLError:
+        return s
+
+
+def parse_cli_args(argv: list[str] | None = None):
+    """``--config file.yaml key=value ...`` -> (merged dict, config path).
+
+    Reference behavior: areal/api/cli_args.py:1247.
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--config", type=str, default=None)
+    args, overrides = parser.parse_known_args(argv)
+    data: dict[str, Any] = {}
+    if args.config:
+        with open(args.config) as f:
+            data = yaml.safe_load(f) or {}
+    for ov in overrides:
+        if ov.startswith("--"):
+            raise ValueError(
+                f"Unknown flag {ov!r}: overrides use plain 'key=value' syntax "
+                "(no leading dashes)"
+            )
+        if "=" not in ov:
+            raise ValueError(f"Override must be key=value, got {ov!r}")
+        k, v = ov.split("=", 1)
+        _set_dotted(data, k, _parse_override_value(v))
+    return data, args.config
+
+
+def load_expr_config(argv: list[str] | None, cls):
+    """Load an experiment config of dataclass type ``cls``
+    (reference: areal/api/cli_args.py:1280)."""
+    data, config_path = parse_cli_args(argv)
+    cfg = from_dict(cls, data)
+    return cfg, config_path
+
+
+# --------------------------------------------------------------------------
+# Leaf configs
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NormConfig:
+    """Advantage/value normalization spec (reference cli_args.py:24)."""
+
+    mean_level: str = "batch"  # batch | group | none
+    std_level: str = "batch"  # batch | group | none
+    group_size: int = 1
+    eps: float = 1e-5
+
+
+@dataclass
+class MicroBatchSpec:
+    """Microbatch splitting spec (reference cli_args.py:63)."""
+
+    n_mbs: int = 1
+    max_tokens_per_mb: int = 1 << 30  # effectively unbounded by default
+    granularity: int = 1
+
+
+@dataclass
+class GenerationHyperparameters:
+    """Sampling params for rollout (reference cli_args.py:98)."""
+
+    n_samples: int = 1
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    greedy: bool = False
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    stop_token_ids: list[int] = field(default_factory=list)
+    stop: list[str] = field(default_factory=list)
+    frequency_penalty: float = 0.0
+
+    def new(self, **kwargs) -> "GenerationHyperparameters":
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclass
+class LRSchedulerConfig:
+    type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    min_lr_ratio: float = 0.0
+
+
+@dataclass
+class OptimizerConfig:
+    """Optax-backed optimizer config (reference cli_args.py:161)."""
+
+    type: str = "adamw"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    gradient_clipping: float = 1.0
+    lr_scheduler: LRSchedulerConfig = field(default_factory=LRSchedulerConfig)
+    offload_optimizer_state: bool = False
+
+
+@dataclass
+class EngineBackendConfig:
+    """GSPMD train-backend knobs (replaces the reference's FSDPEngineConfig /
+    MegatronEngineConfig pair, cli_args.py:242,274 — one JAX backend)."""
+
+    remat: bool = True  # jax.checkpoint each block (activation remat)
+    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    param_dtype: str = "bfloat16"
+    compute_dtype: str = "bfloat16"
+    optimizer_dtype: str = "float32"
+    fsdp: bool = True  # shard params/optimizer over the dp axis (ZeRO-3-like)
+    donate_params: bool = True
+    pad_mb_to_multiple: int = 128  # static-shape bucketing for XLA
+
+
+@dataclass
+class TrainEngineConfig:
+    """Reference cli_args.py:317."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    path: str = ""  # HF model path or name
+    init_from_scratch: bool = False
+    attn_impl: str = "auto"  # auto | pallas | xla
+    mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)
+    optimizer: OptimizerConfig | None = field(default_factory=OptimizerConfig)
+    backend: EngineBackendConfig = field(default_factory=EngineBackendConfig)
+    lora: "LoRAConfig | None" = None
+
+
+@dataclass
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    dropout: float = 0.0
+    target_modules: list[str] = field(
+        default_factory=lambda: ["q_proj", "k_proj", "v_proj", "o_proj"]
+    )
+
+
+@dataclass
+class PPOActorConfig(TrainEngineConfig):
+    """PPO/GRPO actor knobs (reference cli_args.py:392)."""
+
+    group_size: int = 1
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    eps_clip_higher: float | None = None  # DAPO clip-higher
+    c_clip: float | None = None  # dual clip
+    temperature: float = 1.0
+    # reward shaping
+    group_reward_norm: bool = False
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    reward_clip: float = 20.0
+    overlong_reward_penalty: bool = False
+    overlong_tokens: int = 0
+    overlong_penalty_factor: float = 0.0
+    mask_no_eos_with_zero: bool = False
+    # KL
+    kl_ctl: float = 0.0
+    kl_estimator: str = "k1"
+    # GAE
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: NormConfig | None = field(default_factory=NormConfig)
+    # decoupled PPO / staleness
+    recompute_logprob: bool = True
+    use_decoupled_loss: bool = True
+    behav_imp_weight_cap: float | None = None
+    # sampling filters
+    dynamic_sampling: bool = False
+    # entropy
+    entropy_coeff: float = 0.0
+    entropy_clamp: float | None = None  # AEnt-style clamped entropy
+    log_agg_mode: str = "token-mean"  # token-mean | seq-mean-token-sum | seq-mean-token-mean
+
+
+@dataclass
+class PPOCriticConfig(TrainEngineConfig):
+    """Reference cli_args.py:515."""
+
+    value_eps_clip: float = 0.2
+    value_loss_type: str = "mse"  # mse | huber
+    huber_delta: float = 10.0
+    ppo_n_minibatches: int = 4
+    mask_no_eos_with_zero: bool = False
+
+
+@dataclass
+class JaxGenConfig:
+    """Inference-server engine knobs (replaces SGLangConfig/vLLMConfig,
+    reference cli_args.py:533,620 — ours is the in-repo JAX server)."""
+
+    model_path: str = ""
+    dtype: str = "bfloat16"
+    max_batch_size: int = 64
+    prefill_chunk: int = 512  # tokens per prefill chunk (static bucket)
+    max_seq_len: int = 4096
+    page_size: int = 128  # KV cache page granularity
+    hbm_utilization: float = 0.85
+    decode_steps_per_call: int = 8  # multi-step decode inside one jit call
+    host: str = "0.0.0.0"
+    port: int = 0  # 0 = pick free port
+    tp_size: int = 1
+    random_seed: int = 1
+    skip_tokenizer_init: bool = False
+
+
+@dataclass
+class InferenceEngineConfig:
+    """Client/rollout control (reference cli_args.py:786)."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    max_concurrent_rollouts: int | None = None
+    queue_size: int | None = None
+    consumer_batch_size: int = 1
+    max_head_offpolicyness: int = 0
+    enable_rollout_tracing: bool = False
+    check_trajectory_format: bool = False
+    schedule_policy: str = "round_robin"
+    setup_timeout: float = 120.0
+    request_timeout: float = 3600.0
+    request_retries: int = 3
+    pause_grace_period: float = 0.0
+
+
+@dataclass
+class _TimerConfig:
+    freq_epochs: int | None = None
+    freq_steps: int | None = None
+    freq_secs: int | None = None
+
+
+@dataclass
+class SaverConfig(_TimerConfig):
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu/experiments"
+
+
+@dataclass
+class EvaluatorConfig(_TimerConfig):
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu/experiments"
+
+
+@dataclass
+class RecoverConfig:
+    mode: str = "disabled"  # disabled | auto | fault | resume
+    freq_epochs: int | None = None
+    freq_steps: int | None = None
+    freq_secs: int | None = None
+    retries: int = 3
+
+
+@dataclass
+class WandBConfig:
+    mode: str = "disabled"
+    project: str | None = None
+    entity: str | None = None
+    name: str | None = None
+
+
+@dataclass
+class TensorBoardConfig:
+    path: str | None = None
+
+
+@dataclass
+class StatsLoggerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    wandb: WandBConfig = field(default_factory=WandBConfig)
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+
+
+@dataclass
+class ClusterSpecConfig:
+    name_resolve: NameResolveConfig = field(default_factory=NameResolveConfig)
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    n_chips_per_host: int = 4
+
+
+@dataclass
+class DatasetConfig:
+    path: str = ""  # HF dataset name or local path
+    type: str = "rl"  # rl | sft | rw
+    batch_size: int = 8
+    shuffle: bool = True
+    pin_memory: bool = False
+    num_workers: int = 0
+    drop_last: bool = True
+    max_length: int | None = None
+
+
+@dataclass
+class LauncherConfig:
+    inference_server_cpus_per_chip: int = 4
+    inference_server_mem_per_chip: int = 32768
+    trainer_cpus_per_chip: int = 4
+    trainer_mem_per_chip: int = 32768
+    inference_server_env_vars: dict[str, str] = field(default_factory=dict)
+    trainer_env_vars: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class BaseExperimentConfig:
+    """Reference cli_args.py:1145."""
+
+    experiment_name: str = "experiment"
+    trial_name: str = "trial"
+    cluster: ClusterSpecConfig = field(default_factory=ClusterSpecConfig)
+    allocation_mode: str = "d1"
+    seed: int = 1
+    total_train_epochs: int = 1
+    total_train_steps: int | None = None
+    total_train_n_seqs: int | None = None
+    tokenizer_path: str = ""
+    train_dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    valid_dataset: DatasetConfig | None = None
+    saver: SaverConfig = field(default_factory=SaverConfig)
+    checkpointer: SaverConfig = field(default_factory=SaverConfig)
+    evaluator: EvaluatorConfig = field(default_factory=EvaluatorConfig)
+    recover: RecoverConfig = field(default_factory=RecoverConfig)
+    stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
+    launcher: LauncherConfig = field(default_factory=LauncherConfig)
+
+    def __post_init__(self):
+        # propagate experiment/trial names into sub-configs left at defaults
+        for sub in (
+            "saver",
+            "checkpointer",
+            "evaluator",
+            "stats_logger",
+        ):
+            c = getattr(self, sub, None)
+            if c is not None and not c.experiment_name:
+                c.experiment_name = self.experiment_name
+            if c is not None and not c.trial_name:
+                c.trial_name = self.trial_name
+
+
+@dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class RWConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class GRPOConfig(BaseExperimentConfig):
+    async_training: bool = True
+    gconfig: GenerationHyperparameters = field(
+        default_factory=GenerationHyperparameters
+    )
+    rollout: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
+    server: JaxGenConfig = field(default_factory=JaxGenConfig)
+    actor: PPOActorConfig = field(default_factory=PPOActorConfig)
+    ref: TrainEngineConfig | None = None
+
+
+@dataclass
+class PPOConfig(GRPOConfig):
+    critic: PPOCriticConfig = field(default_factory=PPOCriticConfig)
+
+
+def get_save_path(cfg) -> str:
+    return os.path.join(
+        cfg.fileroot, cfg.experiment_name, cfg.trial_name
+    )

@@ -1,0 +1,166 @@
+"""Engine ABCs: TrainEngine and InferenceEngine.
+
+Capability parity with the reference's ``areal/api/engine_api.py`` (TrainEngine
+at engine_api.py:40, InferenceEngine at :347). The method surface is kept so
+algorithm code written against the reference maps 1:1; semantics are
+TPU-native (params are jax pytrees on a mesh, not torch modules).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, TYPE_CHECKING
+
+from areal_tpu.api.io_struct import (
+    FinetuneSpec,
+    ModelRequest,
+    ModelResponse,
+    SaveLoadMeta,
+    WeightUpdateMeta,
+)
+
+if TYPE_CHECKING:
+    from areal_tpu.api.workflow_api import RolloutWorkflow
+
+TensorDict = dict[str, Any]
+
+
+class TrainEngine(abc.ABC):
+    """A sharded trainable model + optimizer on a device mesh."""
+
+    def initialize(self, addr: str | None, ft_spec: FinetuneSpec | None, **kwargs):
+        raise NotImplementedError()
+
+    def destroy(self):
+        pass
+
+    @property
+    def data_parallel_size(self) -> int:
+        raise NotImplementedError()
+
+    def current_data_parallel_head(self) -> int:
+        return 0
+
+    def is_data_parallel_head(self) -> bool:
+        """Single-controller JAX: the controller process is always the head."""
+        return True
+
+    def train(self, mode: bool = True):
+        return self
+
+    def get_version(self) -> int:
+        raise NotImplementedError()
+
+    def set_version(self, version: int):
+        raise NotImplementedError()
+
+    def train_batch(
+        self,
+        input_: TensorDict,
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> dict[str, float]:
+        """Forward+backward+step over microbatches of one batch.
+
+        ``loss_fn(logits, mb) -> scalar loss`` (sum-reduced over tokens);
+        ``loss_weight_fn(mb) -> float`` gives each microbatch's weight (e.g.
+        token count); the global normalizer is the sum over all microbatches,
+        matching the reference's loss scaling (fsdp_engine.py:499-606).
+        """
+        raise NotImplementedError()
+
+    def eval_batch(
+        self,
+        input_: TensorDict,
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> float | None:
+        raise NotImplementedError()
+
+    def forward(
+        self,
+        input_: TensorDict,
+        output_seqlens: list[int] | None = None,
+        post_hook: Callable | None = None,
+        aggregate_fn: Callable = None,
+    ) -> Any:
+        """Microbatched inference forward; ``post_hook(logits, mb) -> out``
+        runs on-device per microbatch; results re-ordered to input order."""
+        raise NotImplementedError()
+
+    def step_lr_scheduler(self):
+        raise NotImplementedError()
+
+    def save(self, meta: SaveLoadMeta):
+        raise NotImplementedError()
+
+    def load(self, meta: SaveLoadMeta):
+        raise NotImplementedError()
+
+    def upload_weights(self, meta: WeightUpdateMeta):
+        """Push current weights toward inference engines (disk or device)."""
+        raise NotImplementedError()
+
+    def connect_engine(self, engine: "InferenceEngine", meta: WeightUpdateMeta):
+        """Pair with a rollout engine for weight updates + data redistribution
+        (reference: fsdp_engine.py:437-455)."""
+        raise NotImplementedError()
+
+
+class InferenceEngine(abc.ABC):
+    """Client to (possibly remote) generation service(s)."""
+
+    def initialize(self, addr: str | None = None, **kwargs):
+        raise NotImplementedError()
+
+    def destroy(self):
+        pass
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        raise NotImplementedError()
+
+    def generate(self, req: ModelRequest) -> ModelResponse:
+        raise NotImplementedError()
+
+    def update_weights(self, meta: WeightUpdateMeta):
+        raise NotImplementedError()
+
+    def get_version(self) -> int:
+        raise NotImplementedError()
+
+    def set_version(self, version: int):
+        raise NotImplementedError()
+
+    def submit(
+        self,
+        data: TensorDict,
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+    ) -> None:
+        raise NotImplementedError()
+
+    def wait(self, count: int, timeout: float | None = None) -> TensorDict:
+        raise NotImplementedError()
+
+    def rollout_batch(
+        self,
+        data: list[TensorDict],
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+    ) -> TensorDict:
+        raise NotImplementedError()
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+    ) -> TensorDict:
+        raise NotImplementedError()
+
+    def pause(self):
+        """Pause accepting/issuing generation (during weight update)."""
+        raise NotImplementedError()
+
+    def resume(self):
+        raise NotImplementedError()

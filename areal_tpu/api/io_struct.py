@@ -1,0 +1,162 @@
+"""IO structs shared between rollout, inference, and training.
+
+Capability parity with the reference's ``areal/api/io_struct.py``:
+``ModelRequest``/``ModelResponse`` (with **per-token output_versions** — the
+load-bearing piece of staleness-aware decoupled PPO), ``FinetuneSpec``,
+``WeightUpdateMeta``, ``SaveLoadMeta``, ``RolloutStat``, ``StepInfo``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+
+
+@dataclass
+class ModelRequest:
+    """One generation request (reference io_struct.py:21)."""
+
+    rid: str = field(default_factory=lambda: str(uuid.uuid4()))
+    input_ids: list[int] = field(default_factory=list)
+    gconfig: GenerationHyperparameters = field(
+        default_factory=GenerationHyperparameters
+    )
+    text: str | None = None
+    metadata: dict = field(default_factory=dict)
+    tokenizer: object | None = None
+    image_data: list | None = None
+
+
+@dataclass
+class ModelResponse:
+    """Generation result (reference io_struct.py:48). ``output_versions[i]`` is
+    the weight version that produced output token i — interrupted requests
+    resumed after a weight update carry mixed versions."""
+
+    input_tokens: list[int] = field(default_factory=list)
+    output_tokens: list[int] = field(default_factory=list)
+    output_logprobs: list[float] = field(default_factory=list)
+    output_versions: list[int] = field(default_factory=list)
+    stop_reason: str = "length"  # "stop" | "length" | "abort"
+    latency: float = 0.0
+    ttft: float = 0.0  # time to first token
+    itl: list[float] = field(default_factory=list)  # inter-token latencies
+    tokenizer: object | None = None
+
+    @property
+    def input_len(self) -> int:
+        return len(self.input_tokens)
+
+    @property
+    def output_len(self) -> int:
+        return len(self.output_tokens)
+
+
+@dataclass
+class FinetuneSpec:
+    """Dataset-size-derived schedule spec (reference io_struct.py:77)."""
+
+    total_train_epochs: int
+    dataset_size: int
+    train_batch_size: int
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return (self.dataset_size + self.train_batch_size - 1) // self.train_batch_size
+
+    @property
+    def total_train_steps(self) -> int:
+        return self.total_train_epochs * self.steps_per_epoch
+
+    def is_epoch_last_step(self, step: int) -> bool:
+        return (step + 1) % self.steps_per_epoch == 0
+
+
+@dataclass
+class ParamSpec:
+    """Per-parameter metadata for weight transfer (reference io_struct.py:93)."""
+
+    name: str
+    shape: tuple
+    dtype: str
+
+
+@dataclass
+class WeightUpdateMeta:
+    """How trainer weights reach inference servers (reference io_struct.py:105).
+
+    type="disk": trainer writes safetensors to ``path``; servers mmap+load.
+    type="device": trainer transfers live jax arrays (colocated engines or
+    cross-slice transfer); ``chunked_mem_mb`` bounds staging-buffer size.
+    """
+
+    type: str = "disk"  # "disk" | "device"
+    path: str | None = None
+    chunked_mem_mb: int = 1024
+
+    @classmethod
+    def from_disk(
+        cls, experiment_name: str, trial_name: str, fileroot: str, name: str = "default"
+    ) -> "WeightUpdateMeta":
+        path = f"{fileroot}/{experiment_name}/{trial_name}/weight_update/{name}"
+        return cls(type="disk", path=path)
+
+    @classmethod
+    def from_device(cls, chunked_mem_mb: int = 1024) -> "WeightUpdateMeta":
+        return cls(type="device", chunked_mem_mb=chunked_mem_mb)
+
+
+@dataclass
+class SaveLoadMeta:
+    """Checkpoint save/load request (reference io_struct.py:197)."""
+
+    path: str
+    weight_format: str = "hf"  # "hf" (safetensors) | "orbax"
+    with_optim: bool = False
+    tokenizer: object | None = None
+    base_model_path: str | None = None
+
+
+@dataclass
+class RolloutStat:
+    """Counters for the rollout runtime (reference io_struct.py:208)."""
+
+    submitted: int = 0
+    accepted: int = 0
+    running: int = 0
+    rejected: int = 0
+
+
+@dataclass
+class StepInfo:
+    """Training progress marker (reference io_struct.py:215)."""
+
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+    steps_per_epoch: int = 0
+
+    def next(self) -> "StepInfo":
+        ep_last = (self.epoch_step + 1) >= self.steps_per_epoch
+        return StepInfo(
+            epoch=self.epoch + 1 if ep_last else self.epoch,
+            epoch_step=0 if ep_last else self.epoch_step + 1,
+            global_step=self.global_step + 1,
+            steps_per_epoch=self.steps_per_epoch,
+        )
+
+
+@dataclass
+class TimedResult:
+    """A rollout trajectory stamped with its creation time."""
+
+    t: float
+    data: dict
+
+    @classmethod
+    def now(cls, data: dict) -> "TimedResult":
+        return cls(t=time.monotonic_ns(), data=data)

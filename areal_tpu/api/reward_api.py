@@ -1,0 +1,74 @@
+"""Async reward execution.
+
+Capability parity with the reference's ``areal/api/reward_api.py:37-120``
+(``AsyncRewardWrapper``): run synchronous, potentially slow/crashy reward
+functions in a shared process pool with timeout and broken-pool recovery, so
+reward computation never blocks the rollout event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import functools
+import os
+from typing import Callable
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("reward")
+
+_EXECUTOR: concurrent.futures.ProcessPoolExecutor | None = None
+_MAX_WORKERS = int(os.environ.get("AREAL_TPU_REWARD_WORKERS", "4"))
+
+
+def _get_executor() -> concurrent.futures.ProcessPoolExecutor:
+    global _EXECUTOR
+    if _EXECUTOR is None:
+        _EXECUTOR = concurrent.futures.ProcessPoolExecutor(max_workers=_MAX_WORKERS)
+    return _EXECUTOR
+
+
+def _reset_executor():
+    global _EXECUTOR
+    if _EXECUTOR is not None:
+        _EXECUTOR.shutdown(wait=False, cancel_futures=True)
+    _EXECUTOR = None
+
+
+class AsyncRewardWrapper:
+    """Wrap a sync ``reward_fn(prompt, completion, prompt_ids, completion_ids,
+    **data) -> float`` for await-able use from workflows."""
+
+    def __init__(
+        self,
+        reward_fn: Callable,
+        timeout: float = 60.0,
+        in_process: bool = False,
+    ):
+        self.reward_fn = reward_fn
+        self.timeout = timeout
+        # in_process avoids pool overhead for trivially-fast rewards and is
+        # required for closures that can't pickle.
+        self.in_process = in_process
+
+    async def __call__(self, *args, **kwargs) -> float:
+        if self.in_process:
+            return float(self.reward_fn(*args, **kwargs))
+        loop = asyncio.get_running_loop()
+        try:
+            fut = loop.run_in_executor(
+                _get_executor(),
+                functools.partial(self.reward_fn, *args, **kwargs),
+            )
+            return float(await asyncio.wait_for(fut, timeout=self.timeout))
+        except asyncio.TimeoutError:
+            # The worker process is still running the hung reward_fn; restart
+            # the pool so timed-out workers don't permanently starve it.
+            logger.warning("Reward computation timed out; restarting pool, returning 0.")
+            _reset_executor()
+            return 0.0
+        except concurrent.futures.process.BrokenProcessPool:
+            logger.warning("Reward process pool broke; restarting pool.")
+            _reset_executor()
+            return 0.0

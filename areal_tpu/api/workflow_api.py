@@ -1,0 +1,19 @@
+"""RolloutWorkflow ABC (reference: areal/api/workflow_api.py:11)."""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from areal_tpu.api.engine_api import InferenceEngine
+
+
+class RolloutWorkflow(abc.ABC):
+    @abc.abstractmethod
+    async def arun_episode(
+        self, engine: "InferenceEngine", data: dict[str, Any]
+    ) -> dict[str, Any] | None:
+        """Run one episode (possibly many model calls); return a padded
+        tensor-dict trajectory batch, or None to drop the episode."""
+        ...

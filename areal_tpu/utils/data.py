@@ -1,0 +1,348 @@
+"""Host-side batch containers: padded <-> packed conversion, micro-batching.
+
+Capability parity with the reference's ``areal/utils/data.py`` (SURVEY §2.4):
+``pad_sequences_to_tensors``, ``concat_padded_tensors``, ``pack_tensor_dict``,
+``unpack_sequence``, ``split_padded_tensor_dict_into_mb_list``, ``pad_mb_list``,
+``Normalization`` (group/batch mean-std) and ``KLEstimator`` (k1/k2/k3).
+
+Design: trajectories travel between the rollout runtime and the train engine as
+plain ``dict[str, np.ndarray]`` on host. Padded batches are ``[bs, seqlen]``
+with an ``attention_mask``; the engine packs them into flat ``[total_tokens]``
+arrays with ``cu_seqlens`` + per-token segment ids before anything is shipped
+to the TPU (packing avoids MXU cycles on pad tokens, and static-shape padding
+of each microbatch to a bucket size keeps XLA recompilation bounded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.utils import datapack
+
+TensorDict = dict[str, Any]
+
+
+def _is_per_token(key: str, arr: np.ndarray, batch_size: int) -> bool:
+    return isinstance(arr, np.ndarray) and arr.ndim >= 2 and arr.shape[0] == batch_size
+
+
+def pad_sequences_to_tensors(
+    sequences: list[TensorDict], pad_value: float = 0.0
+) -> TensorDict:
+    """Stack a list of per-sequence dicts of 1D arrays into padded [bs, maxlen]
+    arrays plus an ``attention_mask``. Scalar entries stack to [bs].
+
+    Reference behavior: areal/utils/data.py:82.
+    """
+    if not sequences:
+        return {}
+    keys = sequences[0].keys()
+    seq_keys = [
+        k
+        for k in keys
+        if isinstance(sequences[0][k], np.ndarray) and sequences[0][k].ndim >= 1
+    ]
+    if not seq_keys:
+        raise ValueError(
+            "pad_sequences_to_tensors needs at least one ndarray (per-token) key "
+            f"to derive sequence lengths; got keys {sorted(keys)}"
+        )
+    max_len = max(int(np.shape(s[seq_keys[0]])[0]) for s in sequences)
+    out: TensorDict = {}
+    for k in keys:
+        v0 = sequences[0][k]
+        if k in seq_keys:
+            padded = []
+            for s in sequences:
+                arr = np.asarray(s[k])
+                pad_width = [(0, max_len - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                padded.append(np.pad(arr, pad_width, constant_values=pad_value))
+            out[k] = np.stack(padded)
+        else:
+            out[k] = np.asarray([s[k] for s in sequences])
+    lens = np.asarray([int(np.shape(s[seq_keys[0]])[0]) for s in sequences])
+    out["attention_mask"] = (np.arange(max_len)[None, :] < lens[:, None]).astype(
+        np.bool_
+    )
+    return out
+
+
+def concat_padded_tensors(
+    tensor_dicts: list[TensorDict], pad_value: float = 0.0
+) -> TensorDict:
+    """Concatenate padded batches along batch dim, re-padding to the common max
+    length (reference: areal/utils/data.py:152)."""
+    tensor_dicts = [d for d in tensor_dicts if d]
+    if not tensor_dicts:
+        return {}
+    assert all("attention_mask" in d for d in tensor_dicts)
+    max_len = max(d["attention_mask"].shape[1] for d in tensor_dicts)
+    out: TensorDict = {}
+    keys = tensor_dicts[0].keys()
+    for k in keys:
+        parts = []
+        for d in tensor_dicts:
+            arr = np.asarray(d[k])
+            bs = d["attention_mask"].shape[0]
+            if _is_per_token(k, arr, bs) and arr.shape[1] == d["attention_mask"].shape[1]:
+                pad_len = max_len - arr.shape[1]
+                if pad_len:
+                    value = False if arr.dtype == np.bool_ else pad_value
+                    pad_width = [(0, 0), (0, pad_len)] + [(0, 0)] * (arr.ndim - 2)
+                    arr = np.pad(arr, pad_width, constant_values=value)
+            parts.append(arr)
+        out[k] = np.concatenate(parts, axis=0)
+    return out
+
+
+def shuffle_within_batch(data: TensorDict, seed: int | None = None) -> TensorDict:
+    bs = data["attention_mask"].shape[0]
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(bs)
+    return index_select(data, perm)
+
+
+def index_select(data: TensorDict, indices) -> TensorDict:
+    indices = np.asarray(indices)
+    bs = data["attention_mask"].shape[0]
+    out = {}
+    for k, v in data.items():
+        arr = np.asarray(v)
+        if arr.ndim >= 1 and arr.shape[0] == bs:
+            out[k] = arr[indices]
+        else:
+            out[k] = arr
+    return out
+
+
+def batch_size_of(data: TensorDict) -> int:
+    return int(data["attention_mask"].shape[0])
+
+
+def seqlens_of(data: TensorDict) -> np.ndarray:
+    return np.asarray(data["attention_mask"]).sum(axis=1).astype(np.int64)
+
+
+def pack_tensor_dict(data: TensorDict) -> TensorDict:
+    """Padded [bs, T] -> packed flat arrays.
+
+    Returns a dict with every per-token key flattened to [total_tokens, ...],
+    plus ``cu_seqlens`` [bs+1] and ``max_seqlen`` (host ints). Reference:
+    areal/utils/data.py:266.
+    """
+    mask = np.asarray(data["attention_mask"]).astype(bool)
+    bs, t = mask.shape
+    lens = mask.sum(axis=1).astype(np.int32)
+    cu = np.zeros(bs + 1, dtype=np.int32)
+    np.cumsum(lens, out=cu[1:])
+    flat_idx = np.nonzero(mask.reshape(-1))[0]
+    out: TensorDict = {}
+    for k, v in data.items():
+        if k == "attention_mask":
+            continue
+        arr = np.asarray(v)
+        if _is_per_token(k, arr, bs) and arr.shape[1] == t:
+            out[k] = arr.reshape((bs * t,) + arr.shape[2:])[flat_idx]
+        else:
+            out[k] = arr
+    out["cu_seqlens"] = cu
+    out["max_seqlen"] = int(lens.max()) if bs else 0
+    return out
+
+
+def unpack_sequence(packed: np.ndarray, cu_seqlens: np.ndarray) -> list[np.ndarray]:
+    """Split a packed flat array back into per-sequence arrays
+    (reference: areal/utils/data.py:224)."""
+    return [
+        packed[int(cu_seqlens[i]) : int(cu_seqlens[i + 1])]
+        for i in range(len(cu_seqlens) - 1)
+    ]
+
+
+def unpack_to_padded(
+    packed: np.ndarray, cu_seqlens: np.ndarray, pad_value: float = 0.0
+) -> np.ndarray:
+    seqs = unpack_sequence(packed, cu_seqlens)
+    max_len = max((len(s) for s in seqs), default=0)
+    out = np.full((len(seqs), max_len) + packed.shape[1:], pad_value, packed.dtype)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = s
+    return out
+
+
+def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int | None = None):
+    """Per-token segment ids (0-based) for packed attention; pad tokens get -1
+    when ``total`` exceeds cu_seqlens[-1]."""
+    n = int(cu_seqlens[-1])
+    total = total if total is not None else n
+    seg = np.full(total, -1, dtype=np.int32)
+    for i in range(len(cu_seqlens) - 1):
+        seg[int(cu_seqlens[i]) : int(cu_seqlens[i + 1])] = i
+    return seg
+
+
+def positions_from_cu_seqlens(cu_seqlens: np.ndarray, total: int | None = None):
+    n = int(cu_seqlens[-1])
+    total = total if total is not None else n
+    pos = np.zeros(total, dtype=np.int32)
+    for i in range(len(cu_seqlens) - 1):
+        s, e = int(cu_seqlens[i]), int(cu_seqlens[i + 1])
+        pos[s:e] = np.arange(e - s)
+    return pos
+
+
+@dataclasses.dataclass
+class MicroBatchList:
+    """A split of one padded batch into token-budgeted microbatches."""
+
+    mbs: list[TensorDict]
+    group_lens: list[int]  # total real tokens per microbatch
+    forward_indices: list[list[int]]  # original row idx per mb
+    padded_to: list[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def n_mbs(self) -> int:
+        return len(self.mbs)
+
+    def reorder_back(self, per_row_outputs: list[Any]) -> list[Any]:
+        """Given outputs per mb-row (concatenated in mb order), restore the
+        original batch row order."""
+        flat_idx = datapack.flat2d(self.forward_indices)
+        out = [None] * len(flat_idx)
+        for pos, orig in enumerate(flat_idx):
+            out[orig] = per_row_outputs[pos]
+        return out
+
+
+def split_padded_tensor_dict_into_mb_list(
+    data: TensorDict,
+    max_tokens_per_mb: int,
+    min_n_mbs: int = 1,
+) -> MicroBatchList:
+    """FFD-split a padded batch into microbatches under a token budget
+    (reference: areal/utils/data.py:404)."""
+    lens = seqlens_of(data)
+    bins = datapack.ffd_allocate(lens, max_tokens_per_mb, min_groups=min_n_mbs)
+    if min_n_mbs <= 1:
+        # drop empty bins when the caller doesn't need a fixed mb count
+        bins = [b for b in bins if b] or [[]]
+    mbs = []
+    group_lens = []
+    for b in bins:
+        mbs.append(index_select(data, np.asarray(b, dtype=np.int64)))
+        group_lens.append(int(lens[b].sum()))
+    return MicroBatchList(mbs=mbs, group_lens=group_lens, forward_indices=bins)
+
+
+def pad_packed_to_multiple(packed: TensorDict, multiple: int, pad_token: int = 0):
+    """Pad a packed batch's flat arrays up to a multiple of ``multiple`` tokens
+    by appending a dummy sequence; keeps XLA shapes bucketed (reference's
+    pad_mb_list pads for TP/CP alignment, areal/utils/data.py:685)."""
+    cu = packed["cu_seqlens"]
+    n = int(cu[-1])
+    target = ((n + multiple - 1) // multiple) * multiple
+    pad = target - n
+    if pad == 0:
+        return packed, n
+    out = dict(packed)
+    for k, v in packed.items():
+        if k in ("cu_seqlens", "max_seqlen"):
+            continue
+        arr = np.asarray(v)
+        if arr.ndim >= 1 and arr.shape[0] == n:
+            value = pad_token if k == "input_ids" else 0
+            pad_width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+            out[k] = np.pad(arr, pad_width, constant_values=value)
+    out["cu_seqlens"] = np.concatenate([cu, [target]]).astype(np.int32)
+    out["max_seqlen"] = max(int(packed["max_seqlen"]), pad)
+    return out, n
+
+
+def cycle_dataloader(loader):
+    """Infinite epoch-cycling iterator (reference: areal/utils/data.py:1063)."""
+    while True:
+        yield from loader
+
+
+@dataclasses.dataclass
+class Normalization:
+    """Advantage normalization: none / batch / group mean-std
+    (reference: areal/utils/data.py:1073).
+
+    group_size partitions the batch rows into consecutive groups (GRPO's
+    n-samples-per-prompt groups).
+    """
+
+    mean_level: str = "batch"  # "batch" | "group" | "none"
+    std_level: str = "batch"  # "batch" | "group" | "none"
+    group_size: int = 1
+    eps: float = 1e-5
+
+    def __call__(
+        self, x: np.ndarray, mask: np.ndarray | None = None
+    ) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        if mask is None:
+            mask = np.ones_like(x, dtype=bool)
+        mask = np.asarray(mask, dtype=bool)
+
+        def masked_moments(values, m, axis=None, keepdims=False):
+            cnt = m.sum(axis=axis, keepdims=keepdims)
+            cnt = np.maximum(cnt, 1)
+            mean = (values * m).sum(axis=axis, keepdims=keepdims) / cnt
+            var = (((values - mean) * m) ** 2).sum(axis=axis, keepdims=keepdims) / cnt
+            return mean, var
+
+        if self.mean_level == "group" or self.std_level == "group":
+            bs = x.shape[0]
+            assert bs % self.group_size == 0, (bs, self.group_size)
+            g = x.reshape((bs // self.group_size, self.group_size) + x.shape[1:])
+            gm = mask.reshape(g.shape)
+            axes = tuple(range(1, g.ndim))
+            gmean, gvar = masked_moments(g, gm, axis=axes, keepdims=True)
+            gmean = np.broadcast_to(gmean, g.shape).reshape(x.shape)
+            gstd = np.sqrt(np.broadcast_to(gvar, g.shape).reshape(x.shape))
+        if self.mean_level == "batch" or self.std_level == "batch":
+            bmean, bvar = masked_moments(x, mask)
+            bstd = np.sqrt(bvar)
+
+        if self.mean_level == "group":
+            x = x - gmean
+        elif self.mean_level == "batch":
+            x = x - bmean
+        if self.std_level == "group":
+            x = x / (gstd + self.eps)
+        elif self.std_level == "batch":
+            x = x / (bstd + self.eps)
+        return (x * mask).astype(np.float32)
+
+
+@dataclasses.dataclass
+class KLEstimator:
+    """k1/k2/k3 KL estimators (http://joschu.net/blog/kl-approx.html);
+    reference: areal/utils/data.py:1306."""
+
+    kind: str = "k1"
+
+    def __call__(self, logp: np.ndarray, ref_logp: np.ndarray) -> np.ndarray:
+        logr = ref_logp - logp
+        if self.kind == "k1":
+            return -logr
+        if self.kind == "k2":
+            return 0.5 * logr**2
+        if self.kind == "k3":
+            return np.expm1(logr) - logr
+        raise ValueError(f"Unknown KL estimator: {self.kind}")
+
+
+def to_device_tree(data: TensorDict):
+    """Convert numpy leaves to jax arrays (lazy import)."""
+    import jax.numpy as jnp
+
+    return {
+        k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+        for k, v in data.items()
+    }

@@ -1,0 +1,112 @@
+"""Sequence bin-packing / balanced partition.
+
+Capability parity with the reference's ``areal/utils/datapack.py``:
+``ffd_allocate`` (first-fit-decreasing under a token budget with a min-group
+constraint, datapack.py:187) and ``partition_balanced`` (DP-balanced
+partitioning, datapack.py:14). Implementations are our own.
+
+These run on host (they shape microbatches before anything touches the TPU);
+a C++ fast path is provided via ``areal_tpu.utils.native`` when built.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ffd_allocate(
+    sizes: list[int] | np.ndarray,
+    capacity: int,
+    min_groups: int = 1,
+) -> list[list[int]]:
+    """First-fit-decreasing: pack items (token counts) into the fewest bins of
+    ``capacity`` tokens, then split further if fewer than ``min_groups`` bins.
+
+    Returns a list of bins, each a list of original item indices. Every item
+    must individually fit in ``capacity``.
+    """
+    sizes = np.asarray(sizes, dtype=np.int64)
+    if len(sizes) == 0:
+        return [[] for _ in range(min_groups)]
+    if sizes.max() > capacity:
+        raise ValueError(
+            f"Item of size {int(sizes.max())} exceeds bin capacity {capacity}"
+        )
+    order = np.argsort(-sizes, kind="stable")
+    bins: list[list[int]] = []
+    loads: list[int] = []
+    for idx in order:
+        size = int(sizes[idx])
+        placed = False
+        for b in range(len(bins)):
+            if loads[b] + size <= capacity:
+                bins[b].append(int(idx))
+                loads[b] += size
+                placed = True
+                break
+        if not placed:
+            bins.append([int(idx)])
+            loads.append(size)
+    while len(bins) < min_groups:
+        # split the heaviest multi-item bin
+        cand = sorted(
+            (b for b in range(len(bins)) if len(bins[b]) > 1),
+            key=lambda b: -loads[b],
+        )
+        if not cand:
+            bins.append([])
+            loads.append(0)
+            continue
+        b = cand[0]
+        items = sorted(bins[b], key=lambda i: -int(sizes[i]))
+        half_a, half_b, la, lb = [], [], 0, 0
+        for i in items:
+            if la <= lb:
+                half_a.append(i)
+                la += int(sizes[i])
+            else:
+                half_b.append(i)
+                lb += int(sizes[i])
+        bins[b] = half_a
+        loads[b] = la
+        bins.append(half_b)
+        loads.append(lb)
+    # keep deterministic order: sort each bin & sort bins by first item
+    bins = [sorted(b) for b in bins]
+    bins.sort(key=lambda b: (b[0] if b else 1 << 62))
+    return bins
+
+
+def partition_balanced(sizes: list[int] | np.ndarray, k: int) -> list[list[int]]:
+    """Partition ``len(sizes)`` contiguously-indexed items into exactly ``k``
+    groups minimizing the max group load (greedy LPT, then index-sorted).
+
+    Unlike ``ffd_allocate`` this always returns exactly k groups and has no
+    capacity limit — used for DP-rank balancing (reference datapack.py:14).
+    """
+    sizes = np.asarray(sizes, dtype=np.int64)
+    n = len(sizes)
+    if k <= 0:
+        raise ValueError("k must be positive")
+    groups: list[list[int]] = [[] for _ in range(k)]
+    loads = np.zeros(k, dtype=np.int64)
+    order = np.argsort(-sizes, kind="stable")
+    for idx in order:
+        b = int(np.argmin(loads))
+        groups[b].append(int(idx))
+        loads[b] += int(sizes[idx])
+    for g in groups:
+        g.sort()
+    if n >= k and any(len(g) == 0 for g in groups):
+        # steal from the largest group to guarantee non-empty groups
+        for b in range(k):
+            if not groups[b]:
+                donor = max(range(k), key=lambda j: len(groups[j]))
+                groups[b].append(groups[donor].pop())
+        for g in groups:
+            g.sort()
+    return groups
+
+
+def flat2d(list_of_lists):
+    return [x for sub in list_of_lists for x in sub]

@@ -1,0 +1,49 @@
+"""Per-component colored loggers.
+
+Capability parity with the reference's ``areal/utils/logging.py`` (colored
+per-component loggers); implementation is our own minimal stdlib setup.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            if color:
+                return f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured: set[str] = set()
+
+
+def getLogger(name: str = "areal_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if name not in _configured:
+        _configured.add(name)
+        logger.setLevel(os.environ.get("AREAL_TPU_LOG_LEVEL", "INFO").upper())
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(_ColorFormatter(_FORMAT, _DATE_FORMAT))
+            logger.addHandler(handler)
+        logger.propagate = False
+    return logger

@@ -1,0 +1,276 @@
+"""Pluggable KV service-discovery ("name resolve").
+
+Capability parity with the reference's ``areal/utils/name_resolve.py`` (memory /
+NFS / etcd / ray repositories, add/get/wait/delete/subtree watch). The TPU
+build keeps the same abstraction; backends here are:
+
+- ``MemoryNameRecordRepository`` — in-process dict (unit tests, single proc).
+- ``NfsNameRecordRepository`` — files on a shared filesystem (multi-host without
+  extra services; works on any POSIX shared mount, e.g. GCS-fuse on TPU pods).
+
+Keys are slash-separated paths; values are strings. ``add(..., delete_on_exit)``
+records keys for atexit cleanup, matching the reference semantics.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import os
+import shutil
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+class NameRecordRepository(ABC):
+    @abstractmethod
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        replace: bool = False,
+    ) -> None: ...
+
+    @abstractmethod
+    def get(self, name: str) -> str: ...
+
+    @abstractmethod
+    def get_subtree(self, name_root: str) -> list[str]: ...
+
+    @abstractmethod
+    def find_subtree(self, name_root: str) -> list[str]:
+        """Return the key names (not values) under the subtree, sorted."""
+
+    @abstractmethod
+    def delete(self, name: str) -> None: ...
+
+    @abstractmethod
+    def clear_subtree(self, name_root: str) -> None: ...
+
+    def add_subentry(self, name_root: str, value: str, **kwargs) -> str:
+        sub = str(uuid.uuid4())[:8]
+        name = f"{name_root}/{sub}"
+        self.add(name, value, **kwargs)
+        return name
+
+    def wait(
+        self, name: str, timeout: float | None = None, poll_frequency: float = 0.1
+    ) -> str:
+        start = time.monotonic()
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if timeout is not None and time.monotonic() - start > timeout:
+                    raise TimeoutError_(f"Timeout waiting for key: {name}")
+                time.sleep(poll_frequency)
+
+    def reset(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    """Process-local dict-backed repository (thread-safe)."""
+
+    def __init__(self):
+        self._store: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name, value, delete_on_exit=True, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def get_subtree(self, name_root):
+        with self._lock:
+            prefix = name_root.rstrip("/") + "/"
+            return [
+                v
+                for k, v in sorted(self._store.items())
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            ]
+
+    def find_subtree(self, name_root):
+        with self._lock:
+            prefix = name_root.rstrip("/") + "/"
+            return sorted(
+                k
+                for k in self._store
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            )
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+
+    def clear_subtree(self, name_root):
+        with self._lock:
+            prefix = name_root.rstrip("/") + "/"
+            for k in [
+                k
+                for k in self._store
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            ]:
+                del self._store[k]
+
+    def reset(self):
+        with self._lock:
+            self._store.clear()
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """Shared-filesystem repository: one file per key under ``record_root``.
+
+    Works across hosts given any shared POSIX mount. Values are written
+    atomically via rename.
+    """
+
+    def __init__(self, record_root: str = "/tmp/areal_tpu/name_resolve"):
+        self.record_root = record_root
+        self._to_delete: set[str] = set()
+        os.makedirs(record_root, exist_ok=True)
+        atexit.register(self._cleanup)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.record_root, name.strip("/"), "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, replace=False):
+        path = self._path(name)
+        if os.path.exists(path) and not replace:
+            raise NameEntryExistsError(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)
+        if delete_on_exit:
+            self._to_delete.add(name)
+
+    def get(self, name):
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise NameEntryNotFoundError(name)
+        with open(path) as f:
+            return f.read()
+
+    def _iter_subtree(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        if not os.path.isdir(root):
+            return
+        for dirpath, _, filenames in sorted(os.walk(root)):
+            if "ENTRY" in filenames:
+                rel = os.path.relpath(dirpath, self.record_root)
+                yield rel.replace(os.sep, "/")
+
+    def get_subtree(self, name_root):
+        return [self.get(k) for k in self.find_subtree(name_root)]
+
+    def find_subtree(self, name_root):
+        return sorted(self._iter_subtree(name_root))
+
+    def delete(self, name):
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise NameEntryNotFoundError(name)
+        os.remove(path)
+        self._to_delete.discard(name)
+
+    def clear_subtree(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        if os.path.isdir(root):
+            shutil.rmtree(root, ignore_errors=True)
+
+    def _cleanup(self):
+        for name in list(self._to_delete):
+            try:
+                self.delete(name)
+            except Exception:
+                pass
+
+
+@dataclasses.dataclass
+class NameResolveConfig:
+    """Mirrors the reference's NameResolveConfig (areal/api/cli_args.py:964)."""
+
+    type: str = "nfs"  # "memory" | "nfs"
+    nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+
+
+DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
+
+
+def reconfigure(config: NameResolveConfig) -> NameRecordRepository:
+    global DEFAULT_REPOSITORY
+    if config.type == "memory":
+        DEFAULT_REPOSITORY = MemoryNameRecordRepository()
+    elif config.type == "nfs":
+        DEFAULT_REPOSITORY = NfsNameRecordRepository(config.nfs_record_root)
+    else:
+        raise ValueError(f"Unknown name_resolve type: {config.type}")
+    return DEFAULT_REPOSITORY
+
+
+def add(name, value, **kwargs):
+    return DEFAULT_REPOSITORY.add(name, value, **kwargs)
+
+
+def add_subentry(name_root, value, **kwargs):
+    return DEFAULT_REPOSITORY.add_subentry(name_root, value, **kwargs)
+
+
+def get(name):
+    return DEFAULT_REPOSITORY.get(name)
+
+
+def get_subtree(name_root):
+    return DEFAULT_REPOSITORY.get_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return DEFAULT_REPOSITORY.find_subtree(name_root)
+
+
+def wait(name, timeout=None, poll_frequency=0.1):
+    return DEFAULT_REPOSITORY.wait(name, timeout, poll_frequency)
+
+
+def delete(name):
+    return DEFAULT_REPOSITORY.delete(name)
+
+
+def clear_subtree(name_root):
+    return DEFAULT_REPOSITORY.clear_subtree(name_root)

@@ -1,0 +1,46 @@
+"""Canonical name-resolve key layout.
+
+Parity: reference ``areal/utils/names.py`` — every distributed component
+registers/watches keys under a trial-scoped prefix.
+"""
+
+from __future__ import annotations
+
+ROOT = "areal_tpu"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return f"{ROOT}/{experiment_name}/{trial_name}"
+
+
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which inference servers register their addresses."""
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
+
+
+def update_weights_from_disk(
+    experiment_name: str, trial_name: str, model_version: int
+) -> str:
+    """Timestamp key used to measure disk weight-update latency
+    (reference: areal/core/remote_inf_engine.py:762-810)."""
+    return f"{trial_root(experiment_name, trial_name)}/update_weights_from_disk/{model_version}"
+
+
+def weight_version(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/weight_version"
+
+
+def trainer_port(experiment_name: str, trial_name: str, role: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/trainer_ports/{role}"
+
+
+def distributed_lock(experiment_name: str, trial_name: str, lock_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/locks/{lock_name}"
+
+
+def worker_status(experiment_name: str, trial_name: str, worker: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker_status/{worker}"

@@ -1,0 +1,46 @@
+"""Host networking helpers (free ports, host IP).
+
+Parity: reference ``areal/utils/network.py`` (find_free_ports / gethostip).
+"""
+
+from __future__ import annotations
+
+import socket
+from contextlib import closing
+
+
+def find_free_ports(count: int = 1, low: int = 10000, high: int = 60000) -> list[int]:
+    ports: list[int] = []
+    socks = []
+    try:
+        while len(ports) < count:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            if low <= port <= high and port not in ports:
+                ports.append(port)
+                socks.append(s)  # hold open so the next bind can't collide
+            else:
+                s.close()
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def find_free_port(**kwargs) -> int:
+    return find_free_ports(1, **kwargs)[0]
+
+
+def gethostip() -> str:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
+
+def gethostname() -> str:
+    return socket.gethostname()

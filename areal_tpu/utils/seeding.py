@@ -1,0 +1,40 @@
+"""Deterministic seeding across python/numpy/jax.
+
+Parity: reference ``areal/utils/seeding.py`` (seeds torch/np/random per rank).
+TPU-native version derives a `jax.random.PRNGKey` tree instead of torch seeds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_BASE_SEED: int | None = None
+
+
+def _mix(seed: int, key: str) -> int:
+    digest = hashlib.sha256(f"{seed}-{key}".encode()).digest()
+    return int.from_bytes(digest[:8], "little") % (2**31 - 1)
+
+
+def set_random_seed(seed: int, key: str = "") -> None:
+    """Seed python & numpy RNGs with a (seed, key)-derived value."""
+    global _BASE_SEED
+    _BASE_SEED = seed
+    mixed = _mix(seed, key)
+    random.seed(mixed)
+    np.random.seed(mixed % (2**32 - 1))
+
+
+def base_seed() -> int:
+    return _BASE_SEED if _BASE_SEED is not None else 0
+
+
+def prng_key(key: str = "", seed: int | None = None):
+    """Derive a named jax PRNGKey; import jax lazily to keep utils CPU-cheap."""
+    import jax
+
+    s = seed if seed is not None else base_seed()
+    return jax.random.PRNGKey(_mix(s, key))

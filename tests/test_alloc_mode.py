@@ -1,0 +1,120 @@
+"""Allocation-grammar tests (modeled on the reference's
+areal/tests/test_allocation_mode.py coverage: every production + errors)."""
+
+import pytest
+
+from areal_tpu.api.alloc_mode import (
+    AllocationMode,
+    AllocationType,
+    ParallelStrategy,
+)
+
+
+def test_train_only_plain_dims():
+    m = AllocationMode.from_str("d4t2")
+    assert m.type_ == AllocationType.TRAIN_ONLY
+    assert m.train == ParallelStrategy(dp=4, tp=2)
+    assert m.train_world_size == 8
+    assert m.gen is None
+
+
+def test_train_only_with_backend():
+    m = AllocationMode.from_str("gspmd:d2t2p2c2")
+    assert m.train_backend == "gspmd"
+    assert m.train.world_size == 16
+    assert m.train.pp == 2 and m.train.cp == 2
+
+
+def test_reference_backend_aliases():
+    m = AllocationMode.from_str("sglang:d4t2+fsdp:d8")
+    assert m.type_ == AllocationType.DECOUPLED
+    assert m.gen_backend == "jaxgen"
+    assert m.train_backend == "gspmd"
+    assert m.gen.world_size == 8
+    assert m.train.world_size == 8
+    m2 = AllocationMode.from_str("vllm:d2t4+megatron:d2t4p2")
+    assert m2.gen.tp == 4 and m2.train.pp == 2
+
+
+def test_colocated():
+    m = AllocationMode.from_str("jaxgen:d2t2|gspmd:d1t4")
+    assert m.type_ == AllocationType.COLOCATED
+    assert m.total_world_size == 4
+
+
+def test_colocated_world_size_mismatch():
+    with pytest.raises(ValueError):
+        AllocationMode.from_str("jaxgen:d2|gspmd:d4")
+
+
+def test_gen_plus_eval():
+    m = AllocationMode.from_str("sglang:d4t2+eval")
+    assert m.type_ == AllocationType.DECOUPLED_EVAL
+    assert m.gen.world_size == 8
+    assert m.train is None
+
+
+def test_gen_only():
+    m = AllocationMode.from_str("jaxgen:d4")
+    assert m.type_ == AllocationType.GEN_ONLY
+
+
+def test_moe_hybrid():
+    m = AllocationMode.from_str("gspmd:(attn:d2c2t2|ffn:d2e2t2)")
+    assert m.train.dp == 2 and m.train.cp == 2 and m.train.tp == 2
+    assert m.train.ep == 2 and m.train.etp == 2 and m.train.edp == 2
+    assert m.train.world_size == 8
+
+
+def test_moe_hybrid_mismatched_world():
+    with pytest.raises(ValueError):
+        AllocationMode.from_str("gspmd:(attn:d2t2|ffn:d2e4t2)")
+
+
+def test_moe_plain_ep_folding():
+    # e2 inside a plain spec folds dp*cp over ep
+    m = AllocationMode.from_str("d4t2e2")
+    assert m.train.ep == 2
+    assert m.train.edp == 2
+    assert m.train.etp == 2
+
+
+def test_decoupled_moe():
+    m = AllocationMode.from_str("sglang:d4t2+gspmd:(attn:d2c2|ffn:e4)")
+    assert m.type_ == AllocationType.DECOUPLED
+    assert m.train.ep == 4
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "x4",
+        "d4t2+d2+d2",
+        "d0",
+        "dd4",
+        "d4td",
+        "unknown:d4",
+        "sglang:d4+unknown:d2",
+        "gspmd:(attn:d2|attn:d2)",
+        "gspmd:(attn:d2e2|ffn:e2)",
+        "d4t2|d2t2|d2t2",
+    ],
+)
+def test_errors(bad):
+    with pytest.raises(ValueError):
+        AllocationMode.from_str(bad)
+
+
+def test_parallel_strategy_str_roundtrip():
+    p = ParallelStrategy(dp=4, tp=2, cp=2)
+    assert AllocationMode.from_str(str(p)).train == p
+
+
+def test_moe_strategy_str_roundtrip():
+    # non-default expert folding must round-trip via hybrid syntax
+    p = ParallelStrategy(dp=2, tp=2, cp=2, ep=2, etp=1, edp=4)
+    assert AllocationMode.from_str(str(p)).train == p
+    # default folding round-trips via plain syntax
+    q = ParallelStrategy(dp=4, tp=2, ep=2, etp=2, edp=2)
+    assert AllocationMode.from_str(str(q)).train == q

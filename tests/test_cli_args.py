@@ -1,0 +1,71 @@
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GRPOConfig,
+    SFTConfig,
+    from_dict,
+    load_expr_config,
+    parse_cli_args,
+)
+
+
+def test_from_dict_nested_coercion():
+    cfg = from_dict(
+        GRPOConfig,
+        {
+            "experiment_name": "e",
+            "trial_name": "t",
+            "actor": {
+                "optimizer": {"lr": "1e-4"},
+                "eps_clip": "0.3",
+                "ppo_n_minibatches": "2",
+            },
+            "gconfig": {"max_new_tokens": 128, "temperature": 1},
+        },
+    )
+    assert cfg.actor.eps_clip == 0.3
+    assert cfg.actor.ppo_n_minibatches == 2
+    assert cfg.actor.optimizer.lr == 1e-4
+    assert cfg.gconfig.temperature == 1.0
+    assert isinstance(cfg.gconfig.temperature, float)
+
+
+def test_from_dict_unknown_key_raises():
+    with pytest.raises(ValueError, match="Unknown config keys"):
+        from_dict(SFTConfig, {"not_a_key": 1})
+
+
+def test_yaml_plus_overrides(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(
+        "experiment_name: exp\ntrial_name: t0\nactor:\n  eps_clip: 0.1\n"
+    )
+    data, _ = parse_cli_args(
+        ["--config", str(p), "actor.eps_clip=0.25", "seed=7", "async_training=false"]
+    )
+    cfg = from_dict(GRPOConfig, data)
+    assert cfg.actor.eps_clip == 0.25
+    assert cfg.seed == 7
+    assert cfg.async_training is False
+
+
+def test_load_expr_config(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("experiment_name: exp\ntrial_name: t0\n")
+    cfg, path = load_expr_config(["--config", str(p)], SFTConfig)
+    assert cfg.experiment_name == "exp"
+    assert path == str(p)
+    # experiment/trial names propagate into sub-configs
+    assert cfg.saver.experiment_name == "exp"
+    assert cfg.stats_logger.trial_name == "t0"
+
+
+def test_override_without_config_file():
+    data, _ = parse_cli_args(["total_train_epochs=3"])
+    cfg = from_dict(SFTConfig, data)
+    assert cfg.total_train_epochs == 3
+
+
+def test_bad_override():
+    with pytest.raises(ValueError):
+        parse_cli_args(["keynovalue"])

@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.data import (
+    KLEstimator,
+    Normalization,
+    concat_padded_tensors,
+    pack_tensor_dict,
+    pad_sequences_to_tensors,
+    pad_packed_to_multiple,
+    positions_from_cu_seqlens,
+    segment_ids_from_cu_seqlens,
+    seqlens_of,
+    split_padded_tensor_dict_into_mb_list,
+    unpack_sequence,
+    unpack_to_padded,
+)
+
+
+def _make_batch(lens, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs = [
+        {
+            "input_ids": rng.integers(0, 100, size=l),
+            "loss_mask": rng.integers(0, 2, size=l).astype(np.bool_),
+            "reward": float(rng.normal()),
+        }
+        for l in lens
+    ]
+    return pad_sequences_to_tensors(seqs), seqs
+
+
+def test_pad_sequences():
+    batch, seqs = _make_batch([3, 5, 2])
+    assert batch["input_ids"].shape == (3, 5)
+    assert batch["attention_mask"].shape == (3, 5)
+    assert (seqlens_of(batch) == [3, 5, 2]).all()
+    assert batch["reward"].shape == (3,)
+    np.testing.assert_array_equal(batch["input_ids"][1], seqs[1]["input_ids"])
+
+
+def test_pack_unpack_roundtrip():
+    batch, seqs = _make_batch([3, 5, 2])
+    packed = pack_tensor_dict(batch)
+    assert packed["input_ids"].shape == (10,)
+    assert (packed["cu_seqlens"] == [0, 3, 8, 10]).all()
+    assert packed["max_seqlen"] == 5
+    parts = unpack_sequence(packed["input_ids"], packed["cu_seqlens"])
+    for p, s in zip(parts, seqs):
+        np.testing.assert_array_equal(p, s["input_ids"])
+    padded = unpack_to_padded(packed["input_ids"], packed["cu_seqlens"])
+    np.testing.assert_array_equal(padded, batch["input_ids"])
+
+
+def test_segment_ids_positions():
+    cu = np.array([0, 3, 8, 10])
+    seg = segment_ids_from_cu_seqlens(cu, total=12)
+    assert list(seg) == [0, 0, 0, 1, 1, 1, 1, 1, 2, 2, -1, -1]
+    pos = positions_from_cu_seqlens(cu)
+    assert list(pos) == [0, 1, 2, 0, 1, 2, 3, 4, 0, 1]
+
+
+def test_concat_padded():
+    b1, _ = _make_batch([3, 5])
+    b2, _ = _make_batch([7], seed=1)
+    cat = concat_padded_tensors([b1, b2])
+    assert cat["input_ids"].shape == (3, 7)
+    assert (seqlens_of(cat) == [3, 5, 7]).all()
+
+
+def test_mb_split_and_reorder():
+    batch, _ = _make_batch([10, 90, 20, 80, 30, 70])
+    mblist = split_padded_tensor_dict_into_mb_list(batch, max_tokens_per_mb=100)
+    assert sum(mblist.group_lens) == 300
+    assert all(g <= 100 for g in mblist.group_lens)
+    # reorder_back restores original row order
+    rows = []
+    for mb in mblist.mbs:
+        rows.extend(seqlens_of(mb).tolist())
+    restored = mblist.reorder_back(rows)
+    assert restored == [10, 90, 20, 80, 30, 70]
+
+
+def test_pad_packed_to_multiple():
+    batch, _ = _make_batch([3, 5, 2])
+    packed = pack_tensor_dict(batch)
+    padded, n = pad_packed_to_multiple(packed, 16)
+    assert n == 10
+    assert padded["input_ids"].shape == (16,)
+    assert padded["cu_seqlens"][-1] == 16
+
+
+def test_normalization_batch():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    mask = np.ones_like(x, dtype=bool)
+    norm = Normalization(mean_level="batch", std_level="batch")
+    y = norm(x, mask)
+    assert abs(y.mean()) < 1e-6
+    assert abs(y.std() - 1.0) < 1e-2
+
+
+def test_normalization_group():
+    # two groups of 2 rows; group means removed independently
+    x = np.array([[1.0], [3.0], [100.0], [102.0]])
+    mask = np.ones_like(x, dtype=bool)
+    norm = Normalization(mean_level="group", std_level="none", group_size=2)
+    y = norm(x, mask)
+    np.testing.assert_allclose(y.ravel(), [-1, 1, -1, 1], atol=1e-6)
+
+
+def test_normalization_masked():
+    x = np.array([[1.0, 99.0], [3.0, 99.0]])
+    mask = np.array([[True, False], [True, False]])
+    norm = Normalization(mean_level="batch", std_level="none")
+    y = norm(x, mask)
+    np.testing.assert_allclose(y[:, 0], [-1, 1], atol=1e-6)
+    np.testing.assert_allclose(y[:, 1], [0, 0], atol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["k1", "k2", "k3"])
+def test_kl_estimators(kind):
+    logp = np.log(np.array([0.5, 0.25]))
+    ref = np.log(np.array([0.25, 0.5]))
+    kl = KLEstimator(kind)(logp, ref)
+    assert kl.shape == (2,)
+    if kind == "k2":
+        assert (kl >= 0).all()
+    if kind == "k3":
+        assert (kl >= 0).all()
+
+
+def test_kl_identical_is_zero():
+    logp = np.log(np.array([0.5, 0.25]))
+    for kind in ["k1", "k2", "k3"]:
+        np.testing.assert_allclose(KLEstimator(kind)(logp, logp), 0.0, atol=1e-12)

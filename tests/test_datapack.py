@@ -1,0 +1,48 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.datapack import ffd_allocate, flat2d, partition_balanced
+
+
+def test_ffd_basic():
+    sizes = [5, 5, 5, 5]
+    bins = ffd_allocate(sizes, capacity=10)
+    assert sorted(flat2d(bins)) == [0, 1, 2, 3]
+    assert all(sum(sizes[i] for i in b) <= 10 for b in bins)
+    assert len(bins) == 2
+
+
+def test_ffd_capacity_violation():
+    with pytest.raises(ValueError):
+        ffd_allocate([11], capacity=10)
+
+
+def test_ffd_min_groups():
+    bins = ffd_allocate([1, 1, 1, 1], capacity=100, min_groups=3)
+    assert len(bins) >= 3
+    assert sorted(flat2d(bins)) == [0, 1, 2, 3]
+
+
+def test_ffd_random_invariants():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        n = int(rng.integers(1, 60))
+        sizes = rng.integers(1, 512, size=n)
+        cap = int(sizes.max() * rng.integers(1, 4))
+        bins = ffd_allocate(sizes, cap)
+        assert sorted(flat2d(bins)) == list(range(n))
+        for b in bins:
+            assert sum(int(sizes[i]) for i in b) <= cap
+
+
+def test_partition_balanced_exact_k():
+    groups = partition_balanced([10, 9, 8, 1, 1, 1], k=3)
+    assert len(groups) == 3
+    assert sorted(flat2d(groups)) == list(range(6))
+    loads = [sum([10, 9, 8, 1, 1, 1][i] for i in g) for g in groups]
+    assert max(loads) <= 12
+
+
+def test_partition_balanced_nonempty_when_enough_items():
+    groups = partition_balanced([100, 1, 1, 1], k=4)
+    assert all(len(g) >= 1 for g in groups)

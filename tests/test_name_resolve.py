@@ -1,0 +1,69 @@
+"""Name-resolve backend tests (modeled on the reference's parametrized
+realhf/tests/distributed/test_name_resolve.py)."""
+
+import threading
+import time
+
+import pytest
+
+from areal_tpu.utils.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+    TimeoutError_,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        return MemoryNameRecordRepository()
+    return NfsNameRecordRepository(str(tmp_path / "nr"))
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.delete("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "v1")
+    repo.add("root/x/2", "v2")
+    repo.add("root/y/3", "v3")
+    assert repo.get_subtree("root/x") == ["v1", "v2"]
+    assert repo.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    repo.clear_subtree("root")
+    assert repo.get_subtree("root") == []
+
+
+def test_add_subentry(repo):
+    n1 = repo.add_subentry("servers", "addr1")
+    n2 = repo.add_subentry("servers", "addr2")
+    assert n1 != n2
+    assert sorted(repo.get_subtree("servers")) == ["addr1", "addr2"]
+
+
+def test_wait_timeout(repo):
+    with pytest.raises(TimeoutError_):
+        repo.wait("nope", timeout=0.2, poll_frequency=0.05)
+
+
+def test_wait_concurrent(repo):
+    def writer():
+        time.sleep(0.2)
+        repo.add("late/key", "yes")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    assert repo.wait("late/key", timeout=5) == "yes"
+    t.join()

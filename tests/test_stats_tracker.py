@@ -1,0 +1,77 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.stats_tracker import ReduceType, StatsTracker
+
+
+def test_masked_avg():
+    t = StatsTracker()
+    mask = np.array([1, 1, 0, 0], dtype=bool)
+    t.denominator(tokens=mask)
+    t.stat("tokens", values=np.array([1.0, 3.0, 100.0, 100.0]))
+    out = t.export()
+    assert out["values/avg"] == pytest.approx(2.0)
+    assert out["values/min"] == pytest.approx(1.0)
+    assert out["values/max"] == pytest.approx(3.0)
+    assert out["tokens"] == 2.0
+
+
+def test_scoped_keys():
+    t = StatsTracker()
+    with t.scope("actor"):
+        t.scalar(loss=1.0)
+        with t.scope("inner"):
+            t.scalar(x=2.0)
+    out = t.export()
+    assert out["actor/loss"] == 1.0
+    assert out["actor/inner/x"] == 2.0
+
+
+def test_reduce_types():
+    t = StatsTracker()
+    m = np.ones(3, dtype=bool)
+    t.denominator(n=m)
+    t.stat("n", reduce_type=ReduceType.SUM, s=np.array([1.0, 2.0, 3.0]))
+    t.denominator(n=m)
+    t.stat("n", reduce_type=ReduceType.MAX, mx=np.array([1.0, 5.0, 3.0]))
+    out = t.export()
+    assert out["s"] == 6.0
+    assert out["mx"] == 5.0
+
+
+def test_export_resets():
+    t = StatsTracker()
+    t.scalar(a=1.0)
+    assert t.export() == {"a": 1.0}
+    assert t.export() == {}
+
+
+def test_export_key_filter():
+    t = StatsTracker()
+    t.scalar(**{"x/a": 1.0, "y/b": 2.0})
+    out = t.export(key="x")
+    assert out == {"x/a": 1.0}
+    out2 = t.export()
+    assert out2 == {"y/b": 2.0}
+
+
+def test_record_timing():
+    t = StatsTracker()
+    with t.record_timing("phase"):
+        pass
+    out = t.export()
+    assert "time_perf/phase" in out
+    assert out["time_perf/phase"] >= 0
+
+
+def test_shape_mismatch_raises():
+    t = StatsTracker()
+    t.denominator(m=np.ones(3, dtype=bool))
+    with pytest.raises(ValueError):
+        t.stat("m", v=np.ones(4))
+
+
+def test_missing_denominator_raises():
+    t = StatsTracker()
+    with pytest.raises(ValueError):
+        t.stat("nope", v=np.ones(2))
