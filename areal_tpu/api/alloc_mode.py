@@ -138,11 +138,18 @@ class AllocationMode:
         s = s.strip().replace(" ", "")
         if not s:
             raise ValueError("Empty allocation mode")
+        # legacy dot form: 'sglang.d4t2' (reference grammar's legacy_inf_para)
+        all_backends = set(GEN_BACKEND_ALIASES) | set(TRAIN_BACKEND_ALIASES)
+        s = re.sub(
+            rf"(^|[+|(])({'|'.join(sorted(all_backends))})\.",
+            lambda m: m.group(1) + m.group(2) + ":",
+            s,
+        )
         # decoupled: '+' at top level
         plus_parts = _split_top(s, "+")
         if len(plus_parts) == 2:
             left, right = plus_parts
-            if right == "eval":
+            if right in ("eval", "cpu"):  # 'cpu' = reference's eval alias
                 backend, strat = _parse_role(left, gen=True)
                 return cls(AllocationType.DECOUPLED_EVAL, backend, strat)
             gb, gs = _parse_role(left, gen=True)

@@ -42,11 +42,17 @@ def _coerce(value: Any, tp: Any) -> Any:
         args = [a for a in typing.get_args(tp) if a is not type(None)]
         if value is None:
             return None
+        dc_error: Exception | None = None
         for a in args:
             try:
                 return _coerce(value, a)
-            except (TypeError, ValueError):
+            except (TypeError, ValueError) as e:
+                # keep the precise unknown-key error from a dataclass arm
+                if _is_dataclass_type(a) and isinstance(value, dict):
+                    dc_error = dc_error or e
                 continue
+        if dc_error is not None:
+            raise dc_error
         raise TypeError(f"Cannot coerce {value!r} to {tp}")
     if _is_dataclass_type(tp):
         if isinstance(value, tp):
@@ -149,10 +155,15 @@ def parse_cli_args(argv: list[str] | None = None):
 
 
 def load_expr_config(argv: list[str] | None, cls):
-    """Load an experiment config of dataclass type ``cls``
-    (reference: areal/api/cli_args.py:1280)."""
+    """Load an experiment config of dataclass type ``cls`` and apply its
+    name-resolve configuration (reference: areal/api/cli_args.py:1280-1286)."""
+    from areal_tpu.utils import name_resolve as _nr
+
     data, config_path = parse_cli_args(argv)
     cfg = from_dict(cls, data)
+    cluster = getattr(cfg, "cluster", None)
+    if cluster is not None and getattr(cluster, "name_resolve", None) is not None:
+        _nr.reconfigure(cluster.name_resolve)
     return cfg, config_path
 
 

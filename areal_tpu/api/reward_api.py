@@ -56,12 +56,20 @@ class AsyncRewardWrapper:
         if self.in_process:
             return float(self.reward_fn(*args, **kwargs))
         loop = asyncio.get_running_loop()
+        fut = None
         try:
             fut = loop.run_in_executor(
                 _get_executor(),
                 functools.partial(self.reward_fn, *args, **kwargs),
             )
             return float(await asyncio.wait_for(fut, timeout=self.timeout))
+        except asyncio.CancelledError:
+            # distinguish "our pool future was cancelled by a pool restart"
+            # (degrade to 0.0) from "the caller cancelled us" (propagate)
+            if fut is not None and fut.cancelled():
+                logger.warning("Reward future cancelled by pool restart; returning 0.")
+                return 0.0
+            raise
         except asyncio.TimeoutError:
             # The worker process is still running the hung reward_fn; restart
             # the pool so timed-out workers don't permanently starve it.

@@ -1,0 +1,158 @@
+"""Transformer model config + HF config ingestion.
+
+Replaces the reference's ``ReaLModelConfig`` (realhf/api/core/model_api.py:340)
+and the per-arch HF mappings (realhf/api/from_hf/*.py) with one config that
+covers the llama/qwen2/qwen3 family (dense) + MoE variants (qwen3-moe /
+mixtral-style).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_hidden_layers: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # qwen2: True for qkv
+    qk_norm: bool = False  # qwen3
+    max_position_embeddings: int = 32768
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    # output head
+    is_critic: bool = False  # scalar value head instead of LM head
+    arch: str = "qwen2"
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_attention_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_key_value_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+_HF_ARCH_MAP = {
+    "Qwen2ForCausalLM": "qwen2",
+    "Qwen3ForCausalLM": "qwen3",
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",
+    "Qwen3MoeForCausalLM": "qwen3_moe",
+    "MixtralForCausalLM": "mixtral",
+}
+
+
+def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
+    """Build a TransformerConfig from an HF ``config.json`` (path, model dir,
+    or already-loaded dict)."""
+    if isinstance(path_or_dict, dict):
+        hf = path_or_dict
+    else:
+        p = path_or_dict
+        if os.path.isdir(p):
+            p = os.path.join(p, "config.json")
+        with open(p) as f:
+            hf = json.load(f)
+    archs = hf.get("architectures") or ["Qwen2ForCausalLM"]
+    arch = _HF_ARCH_MAP.get(archs[0])
+    if arch is None:
+        raise ValueError(f"Unsupported HF architecture: {archs[0]}")
+    n_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
+    num_experts = hf.get("num_experts") or hf.get("num_local_experts") or 0
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=n_heads,
+        num_key_value_heads=hf.get("num_key_value_heads", n_heads),
+        head_dim=head_dim,
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=arch == "qwen2" or hf.get("attention_bias", False),
+        qk_norm=arch in ("qwen3", "qwen3_moe"),
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        num_experts=num_experts,
+        num_experts_per_tok=hf.get("num_experts_per_tok", 0),
+        moe_intermediate_size=hf.get("moe_intermediate_size")
+        or (hf["intermediate_size"] if num_experts else 0),
+        norm_topk_prob=hf.get("norm_topk_prob", True),
+        is_critic=is_critic,
+        arch=arch,
+    )
+
+
+def to_hf_config(cfg: TransformerConfig) -> dict:
+    """Inverse of ``from_hf_config`` for checkpoint export."""
+    arch = {
+        "qwen2": "Qwen2ForCausalLM",
+        "qwen3": "Qwen3ForCausalLM",
+        "llama": "LlamaForCausalLM",
+        "qwen3_moe": "Qwen3MoeForCausalLM",
+        "mixtral": "MixtralForCausalLM",
+    }[cfg.arch]
+    out = {
+        "architectures": [arch],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": cfg.num_attention_heads,
+        "num_key_value_heads": cfg.num_key_value_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "torch_dtype": "bfloat16",
+        "model_type": cfg.arch.replace("_moe", "_moe"),
+    }
+    if cfg.is_moe:
+        out.update(
+            num_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            moe_intermediate_size=cfg.moe_intermediate_size,
+            norm_topk_prob=cfg.norm_topk_prob,
+        )
+    return out
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """Small-config model for tests (mirrors the reference's vocab-128/hidden-16
+    test configs, realhf/base/testing.py:37-43)."""
+    base = dict(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=True,
+        arch="qwen2",
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)

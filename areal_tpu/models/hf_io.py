@@ -1,0 +1,250 @@
+"""HF safetensors checkpoint load/save for the functional decoder.
+
+Capability parity with the reference's distributed HF load/save
+(areal/models/mcore/hf_load.py:215, hf_save.py; legacy conversion registry
+realhf/impl/model/conversion/hf_registry.py): reads an HF model directory
+(sharded or single safetensors) into the stacked-leaf param pytree of
+areal_tpu.models.lm, and writes one back out so any HF-compatible server or
+`transformers` itself can consume checkpoints.
+
+Name mapping is computed (not table-per-arch): the llama/qwen2/qwen3 families
+share the `model.layers.{i}.*` scheme; MoE experts live at
+`mlp.experts.{e}.*` plus a router at `mlp.gate`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig, from_hf_config, to_hf_config
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("hf_io")
+
+_SAFETENSORS_INDEX = "model.safetensors.index.json"
+
+
+def _open_shards(model_dir: str):
+    """Yield (name, numpy array) for every tensor in the checkpoint."""
+    from safetensors.numpy import load_file
+
+    index_path = os.path.join(model_dir, _SAFETENSORS_INDEX)
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        shard_files = sorted(set(index["weight_map"].values()))
+    else:
+        shard_files = [
+            f for f in sorted(os.listdir(model_dir)) if f.endswith(".safetensors")
+        ]
+    if not shard_files:
+        raise FileNotFoundError(f"No safetensors found under {model_dir}")
+    for shard in shard_files:
+        tensors = load_file(os.path.join(model_dir, shard))
+        yield from tensors.items()
+
+
+def _bf16_view(arr: np.ndarray):
+    """safetensors.numpy returns bfloat16 via ml_dtypes; pass through."""
+    return arr
+
+
+def load_hf_params(
+    model_dir: str,
+    cfg: TransformerConfig | None = None,
+    dtype=None,
+    to_device: Callable | None = None,
+) -> tuple[TransformerConfig, dict]:
+    """Read an HF checkpoint dir into (config, stacked param pytree).
+
+    ``to_device``: optional fn(path_tuple, np_array) -> jax array, letting the
+    engine place each stacked leaf directly onto its NamedSharding without a
+    host-side full copy per device.
+    """
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    if cfg is None:
+        cfg = from_hf_config(model_dir)
+    l = cfg.num_hidden_layers
+    np_dtype = ml_dtypes.bfloat16 if dtype in (None, "bfloat16") else np.dtype(dtype)
+
+    # collect per-layer tensors first, then stack
+    layer_parts: dict[str, list] = {}
+    top: dict[str, np.ndarray] = {}
+
+    def put_layer(key: str, layer: int, value: np.ndarray):
+        lst = layer_parts.setdefault(key, [None] * l)
+        lst[layer] = value
+
+    for name, tensor in _open_shards(model_dir):
+        tensor = _bf16_view(tensor)
+        if name == "model.embed_tokens.weight":
+            top["embed"] = tensor
+        elif name == "lm_head.weight":
+            top["lm_head"] = tensor.T
+        elif name == "model.norm.weight":
+            top["final_norm"] = tensor
+        elif name == "score.weight" or name == "value_head.weight":
+            top["value_head"] = tensor.T
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            i_str, sub = rest.split(".", 1)
+            i = int(i_str)
+            if sub == "input_layernorm.weight":
+                put_layer("ln1", i, tensor)
+            elif sub == "post_attention_layernorm.weight":
+                put_layer("ln2", i, tensor)
+            elif sub == "self_attn.q_proj.weight":
+                put_layer("wq", i, tensor.T)
+            elif sub == "self_attn.k_proj.weight":
+                put_layer("wk", i, tensor.T)
+            elif sub == "self_attn.v_proj.weight":
+                put_layer("wv", i, tensor.T)
+            elif sub == "self_attn.o_proj.weight":
+                put_layer("wo", i, tensor.T)
+            elif sub == "self_attn.q_proj.bias":
+                put_layer("bq", i, tensor)
+            elif sub == "self_attn.k_proj.bias":
+                put_layer("bk", i, tensor)
+            elif sub == "self_attn.v_proj.bias":
+                put_layer("bv", i, tensor)
+            elif sub == "self_attn.q_norm.weight":
+                put_layer("q_norm", i, tensor)
+            elif sub == "self_attn.k_norm.weight":
+                put_layer("k_norm", i, tensor)
+            elif sub == "mlp.gate_proj.weight":
+                put_layer("wg", i, tensor.T)
+            elif sub == "mlp.up_proj.weight":
+                put_layer("wu", i, tensor.T)
+            elif sub == "mlp.down_proj.weight":
+                put_layer("wd", i, tensor.T)
+            elif sub in ("mlp.gate.weight", "block_sparse_moe.gate.weight"):
+                put_layer("router", i, tensor.T)
+            elif ".experts." in sub:
+                # mlp.experts.{e}.gate_proj.weight etc.
+                parts = sub.split(".")
+                e = int(parts[2])
+                proj = parts[3]
+                key = {"gate_proj": "wg", "up_proj": "wu", "down_proj": "wd"}[proj]
+                lst = layer_parts.setdefault(
+                    key, [[None] * cfg.num_experts for _ in range(l)]
+                )
+                lst[i][e] = tensor.T
+            else:
+                logger.warning(f"Skipping unmapped tensor: {name}")
+        else:
+            logger.warning(f"Skipping unmapped tensor: {name}")
+
+    def stack(key: str, lst) -> np.ndarray:
+        if any(x is None for x in lst):
+            missing = [i for i, x in enumerate(lst) if x is None]
+            raise ValueError(f"Checkpoint missing layer tensors {key}: {missing}")
+        if isinstance(lst[0], list):  # MoE: [layer][expert]
+            return np.stack([np.stack(per_l) for per_l in lst])
+        return np.stack(lst)
+
+    layers = {}
+    for key, lst in layer_parts.items():
+        layers[key] = stack(key, lst)
+
+    params_np = {
+        "embed": top["embed"],
+        "layers": layers,
+        "final_norm": top["final_norm"],
+    }
+    if cfg.is_critic:
+        if "value_head" in top:
+            params_np["value_head"] = top["value_head"]
+        else:
+            # critic bootstrapped from an LM checkpoint: fresh value head
+            rng = np.random.default_rng(0)
+            params_np["value_head"] = rng.normal(
+                0, 0.02, (cfg.hidden_size, 1)
+            ).astype(np.float32)
+    elif not cfg.tie_word_embeddings:
+        params_np["lm_head"] = top["lm_head"]
+
+    import jax
+
+    def leafify(path, arr):
+        arr = np.asarray(arr, dtype=np_dtype)
+        if to_device is not None:
+            return to_device(path, arr)
+        return jnp.asarray(arr)
+
+    params = jax.tree_util.tree_map_with_path(leafify, params_np)
+    return cfg, params
+
+
+def save_hf_params(
+    params: dict,
+    cfg: TransformerConfig,
+    out_dir: str,
+) -> None:
+    """Write the param pytree as an HF-layout safetensors checkpoint
+    (+config.json). Arrays are gathered to host as bfloat16."""
+    import jax
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    def host(x) -> np.ndarray:
+        return np.asarray(jax.device_get(x))
+
+    def contig(x: np.ndarray) -> np.ndarray:
+        # safetensors silently serializes the BASE buffer of transposed
+        # views, corrupting data — force C-contiguity at the boundary
+        return np.ascontiguousarray(x)
+
+    tensors: dict[str, np.ndarray] = {}
+    tensors["model.embed_tokens.weight"] = contig(host(params["embed"]))
+    tensors["model.norm.weight"] = contig(host(params["final_norm"]))
+    if "lm_head" in params:
+        tensors["lm_head.weight"] = contig(host(params["lm_head"]).T)
+    if "value_head" in params:
+        tensors["score.weight"] = contig(host(params["value_head"]).T)
+    lay = params["layers"]
+    l = cfg.num_hidden_layers
+    sub_map = {
+        "ln1": ("input_layernorm.weight", False),
+        "ln2": ("post_attention_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "bq": ("self_attn.q_proj.bias", False),
+        "bk": ("self_attn.k_proj.bias", False),
+        "bv": ("self_attn.v_proj.bias", False),
+        "q_norm": ("self_attn.q_norm.weight", False),
+        "k_norm": ("self_attn.k_norm.weight", False),
+    }
+    for key, arr in lay.items():
+        arr = host(arr)
+        for i in range(l):
+            if key in sub_map:
+                hf_sub, transpose = sub_map[key]
+                t = arr[i].T if transpose else arr[i]
+                tensors[f"model.layers.{i}.{hf_sub}"] = contig(t)
+            elif key == "router":
+                tensors[f"model.layers.{i}.mlp.gate.weight"] = contig(arr[i].T)
+            elif key in ("wg", "wu", "wd"):
+                proj = {"wg": "gate_proj", "wu": "up_proj", "wd": "down_proj"}[key]
+                if cfg.is_moe:
+                    for e in range(cfg.num_experts):
+                        tensors[
+                            f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"
+                        ] = contig(arr[i, e].T)
+                else:
+                    tensors[f"model.layers.{i}.mlp.{proj}.weight"] = contig(arr[i].T)
+            else:
+                raise ValueError(f"Unmapped param key: layers/{key}")
+
+    # single-shard save (sharding by size if ever needed)
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(to_hf_config(cfg), f, indent=2)

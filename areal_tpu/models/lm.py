@@ -1,0 +1,268 @@
+"""Functional decoder-only transformer (llama/qwen2/qwen3 family, dense + MoE).
+
+This is the TPU-native replacement for the reference's from-scratch ReaLModel
+(realhf/impl/model/nn/real_llm_api.py:100, real_llm_base.py) and for its HF
+model usage in the lite stack (areal/engine/base_hf_engine.py:180-212):
+
+- Parameters are a plain pytree with **stacked per-layer leaves** ([L, ...])
+  so the whole decoder is one ``lax.scan`` over layers — one layer compiles
+  once regardless of depth, and GSPMD shards every layer identically.
+- Forward consumes **packed 1D token streams** (positions + segment ids), the
+  no-padding representation the whole framework standardizes on (reference
+  packs via cu_seqlens, SURVEY §5 long-context notes).
+- Decode runs batched against a preallocated KV cache with per-slot lengths —
+  the continuous-batching inference engine's inner step.
+- Everything is pure: (params, inputs) -> outputs. No modules, no state.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.ops.attention import decode_attention_xla, packed_attention_xla
+from areal_tpu.ops.rotary import apply_rope
+
+Params = dict[str, Any]
+
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: TransformerConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random init (scaled normal), stacked [L, ...] leaves."""
+    l, h, i = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+    qd, kvd, d = cfg.q_dim, cfg.kv_dim, cfg.head_dim
+    keys = iter(jax.random.split(key, 32))
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    s = 0.02
+    layers: Params = {
+        "ln1": jnp.ones((l, h), dtype),
+        "wq": normal(next(keys), (l, h, qd), s),
+        "wk": normal(next(keys), (l, h, kvd), s),
+        "wv": normal(next(keys), (l, h, kvd), s),
+        "wo": normal(next(keys), (l, qd, h), s / (2 * l) ** 0.5),
+        "ln2": jnp.ones((l, h), dtype),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((l, qd), dtype)
+        layers["bk"] = jnp.zeros((l, kvd), dtype)
+        layers["bv"] = jnp.zeros((l, kvd), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((l, d), dtype)
+        layers["k_norm"] = jnp.ones((l, d), dtype)
+    if cfg.is_moe:
+        e, mi = cfg.num_experts, cfg.moe_intermediate_size
+        layers["router"] = normal(next(keys), (l, h, e), s)
+        layers["wg"] = normal(next(keys), (l, e, h, mi), s)
+        layers["wu"] = normal(next(keys), (l, e, h, mi), s)
+        layers["wd"] = normal(next(keys), (l, e, mi, h), s / (2 * l) ** 0.5)
+    else:
+        layers["wg"] = normal(next(keys), (l, h, i), s)
+        layers["wu"] = normal(next(keys), (l, h, i), s)
+        layers["wd"] = normal(next(keys), (l, i, h), s / (2 * l) ** 0.5)
+
+    params: Params = {
+        "embed": normal(next(keys), (cfg.vocab_size, h), s),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), dtype),
+    }
+    if cfg.is_critic:
+        params["value_head"] = normal(next(keys), (h, 1), s)
+    elif not cfg.tie_word_embeddings:
+        params["lm_head"] = normal(next(keys), (h, cfg.vocab_size), s)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Layer body (shared between packed forward and decode)
+# ---------------------------------------------------------------------------
+
+
+def _qkv(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
+    """x [..., H] -> q [..., NH, D], k/v [..., KH, D] with bias + qk-norm."""
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(*x.shape[:-1], cfg.num_attention_heads, cfg.head_dim)
+    k = k.reshape(*x.shape[:-1], cfg.num_key_value_heads, cfg.head_dim)
+    v = v.reshape(*x.shape[:-1], cfg.num_key_value_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
+
+
+def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.is_moe:
+        return _moe_mlp(cfg, lp, x)
+    return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+
+
+def _moe_mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Top-k token-choice MoE.
+
+    TPU-friendly dense formulation: every expert runs over every token and
+    results mix by routing weight (zero for non-selected experts). This keeps
+    shapes static for XLA; the EP-sharded ragged_dot path lives in
+    areal_tpu/ops/moe.py and replaces this when the expert axis is sharded.
+    Reference behavior: realhf/impl/model/modules/moe/ (router + experts).
+    """
+    t, h = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [T, k]
+    if cfg.norm_topk_prob:
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    # scatter top-k weights back to a dense [T, E] mixing matrix
+    weights = jnp.zeros((t, e), jnp.float32).at[
+        jnp.arange(t)[:, None], topk_idx
+    ].set(topk_probs)
+    # all-expert forward: [E, T, I] activations
+    g = jax.nn.silu(jnp.einsum("th,ehi->eti", x, lp["wg"]))
+    u = jnp.einsum("th,ehi->eti", x, lp["wu"])
+    y = jnp.einsum("eti,eih->eth", g * u, lp["wd"])  # [E, T, H]
+    return jnp.einsum("eth,te->th", y, weights.astype(y.dtype))
+
+
+def _block(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+) -> jnp.ndarray:
+    """One decoder block over a packed stream. x [T, H]."""
+    h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, lp, h)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn = packed_attention_xla(q, k, v, segment_ids)
+    x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
+    h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+    x = x + _mlp(cfg, lp, h)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Packed forward (training / scoring)
+# ---------------------------------------------------------------------------
+
+
+def forward_packed(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [T] int32
+    positions: jnp.ndarray,  # [T] int32
+    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
+    x = params["embed"][input_ids]
+
+    def body(carry, lp):
+        return _block(cfg, lp, carry, positions, segment_ids), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.is_critic:
+        return (x @ params["value_head"]).astype(jnp.float32)[:, 0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Batched decode with KV cache (inference engine inner step)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16
+) -> Params:
+    shape = (
+        cfg.num_hidden_layers,
+        batch_size,
+        max_seq_len,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def decode_step(
+    params: Params,
+    cfg: TransformerConfig,
+    cache: Params,
+    input_ids: jnp.ndarray,  # [B, Tq]
+    cache_len: jnp.ndarray,  # [B] valid tokens per slot BEFORE this call
+) -> tuple[jnp.ndarray, Params]:
+    """Run Tq tokens per slot against the cache.
+
+    Positions of the new tokens are cache_len + [0..Tq). Returns
+    (logits [B, Tq, V] fp32, updated cache). Slots with fewer than Tq real new
+    tokens should mask results host-side; the cache write is dense per slot.
+    """
+    b, tq = input_ids.shape
+    x = params["embed"][input_ids]  # [B, Tq, H]
+    positions = cache_len[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
+
+    def body(carry, layer_in):
+        h_in, = carry
+        lp, k_cache, v_cache = layer_in
+        h = rms_norm(h_in, lp["ln1"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        # write new k/v into the cache at [cache_len, cache_len+Tq)
+        def write(cache_l, new):
+            def per_slot(c, n, start):
+                return jax.lax.dynamic_update_slice(c, n, (start, 0, 0))
+
+            return jax.vmap(per_slot)(cache_l, new, cache_len)
+
+        k_cache = write(k_cache, k.astype(k_cache.dtype))
+        v_cache = write(v_cache, v.astype(v_cache.dtype))
+        attn = decode_attention_xla(q, k_cache, v_cache, cache_len + tq)
+        h_out = h_in + attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
+        h2 = rms_norm(h_out, lp["ln2"], cfg.rms_norm_eps)
+        mlp_in_shape = h2.shape
+        mlp_out = _mlp(cfg, lp, h2.reshape(-1, cfg.hidden_size)).reshape(mlp_in_shape)
+        h_out = h_out + mlp_out
+        return (h_out,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

@@ -1,0 +1,86 @@
+"""Attention over packed variable-length sequences (segment-id masked).
+
+This is the TPU answer to the reference's flash_attn_varlen_func usage
+(realhf/impl/model/modules/attn.py, SURVEY §2.1): instead of cu_seqlens-indexed
+CUDA varlen attention, packed sequences carry per-token **segment ids** and the
+causal×same-segment mask is applied inside attention. The XLA path below is a
+single fused einsum chain; the Pallas flash path (areal_tpu/ops/pallas/) is
+selected automatically on TPU for long sequences.
+
+Shapes (packed training): q [T, NH, D], k/v [T, KH, D], segment_ids [T].
+Shapes (batched decode):  q [B, 1, NH, D] against cache k/v [B, S, KH, D].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -2.0**30
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[..., KH, D] -> [..., KH*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=-2)
+
+
+def packed_attention_xla(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    softmax_scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal self-attention over one packed token stream.
+
+    q [T, NH, D], k/v [T, KH, D], segment_ids [T] (pad tokens = -1).
+    Returns [T, NH, D]. fp32 softmax, bf16-friendly elsewhere.
+    """
+    t, nh, d = q.shape
+    kh = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    k = repeat_kv(k, nh // kh)
+    v = repeat_kv(v, nh // kh)
+    logits = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    idx = jnp.arange(t)
+    causal = idx[:, None] >= idx[None, :]
+    same_seg = (segment_ids[:, None] == segment_ids[None, :]) & (
+        segment_ids[:, None] >= 0
+    )
+    mask = causal & same_seg
+    logits = jnp.where(mask[None, :, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
+    return out
+
+
+def decode_attention_xla(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    softmax_scale: float | None = None,
+) -> jnp.ndarray:
+    """Batched decode attention against a KV cache.
+
+    q [B, Tq, NH, D] (Tq=1 for pure decode, >1 for chunked prefill tail),
+    k_cache/v_cache [B, S, KH, D], cache_len [B] = number of valid cache
+    entries per slot INCLUDING the Tq new tokens already written at positions
+    cache_len - Tq + i. Returns [B, Tq, NH, D].
+    """
+    b, tq, nh, d = q.shape
+    s, kh = k_cache.shape[1], k_cache.shape[2]
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    k = repeat_kv(k_cache, nh // kh)
+    v = repeat_kv(v_cache, nh // kh)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    kpos = jnp.arange(s)[None, None, :]  # [1,1,S]
+    qpos = (cache_len[:, None] - tq + jnp.arange(tq)[None, :])[:, :, None]  # [B,Tq,1]
+    mask = kpos <= qpos  # causal within cache
+    logits = jnp.where(mask[:, None, :, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

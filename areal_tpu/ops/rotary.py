@@ -1,0 +1,40 @@
+"""Rotary position embeddings (functional, position-indexed).
+
+Capability parity with the reference's rotary module
+(realhf/impl/model/modules/rotary.py) — standard RoPE with configurable theta;
+written position-first so the same function serves packed training (arbitrary
+per-token positions) and KV-cache decode (scalar positions per slot).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """inv_freq [head_dim//2] (float32)."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponents)
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float
+) -> jnp.ndarray:
+    """Rotate ``x[..., T, H, D]`` by per-token ``positions[..., T]``.
+
+    Uses the HF "half-split" convention (rotate_half): the first D/2 dims pair
+    with the last D/2, matching transformers' llama/qwen2 implementation so HF
+    checkpoints produce identical activations.
+    """
+    d = x.shape[-1]
+    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2 :]
+    xf1 = x1.astype(jnp.float32)
+    xf2 = x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

@@ -1,0 +1,50 @@
+"""Device mesh construction from a ParallelStrategy.
+
+The TPU-native replacement for the reference's process-group zoo (FSDP
+DeviceMesh at areal/utils/fsdp/parallel.py:155-179, Megatron parallel_state,
+legacy ProcessTopology at realhf/base/topology.py): ONE ``jax.sharding.Mesh``
+per job with named axes, and GSPMD inserts all collectives.
+
+Axis order is ("pp", "dp", "cp", "tp") — fastest-varying last so TP groups
+map onto adjacent devices (ICI neighbors on a TPU slice), CP next (ring over
+ICI), then DP, then PP across the slowest links. The expert axis for MoE is
+the folded ("dp","cp") pair reinterpreted as ("edp","ep") — same devices,
+different logical view, matching the reference's MoE parallel folding
+(SURVEY §2.2 EP row).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from areal_tpu.api.alloc_mode import ParallelStrategy
+
+AXIS_PP = "pp"
+AXIS_DP = "dp"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_TP)
+
+
+def make_mesh(
+    parallel: ParallelStrategy, devices: list | None = None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = parallel.world_size
+    if len(devices) < need:
+        raise ValueError(
+            f"ParallelStrategy {parallel} needs {need} devices, "
+            f"only {len(devices)} available"
+        )
+    devices = devices[:need]
+    arr = np.asarray(devices).reshape(
+        parallel.pp, parallel.dp, parallel.cp, parallel.tp
+    )
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    device = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), MESH_AXES)

@@ -1,0 +1,136 @@
+"""Parameter / activation sharding rules (GSPMD PartitionSpecs).
+
+The TPU-native replacement for the reference's three TP implementations
+(DTensor plans, Megatron-core TP, hand-written Column/RowParallelLinear —
+SURVEY §2.2): weights get ``NamedSharding`` annotations and XLA inserts the
+all-reduces/all-gathers that Megatron hand-codes.
+
+Rules for the stacked-leaf decoder pytree (leaf shapes include the leading
+layer dim L, which is scanned over and never sharded):
+
+- wq/wk/wv [L,H,heads*D]  -> tp shards the head (output) dim; fsdp shards H
+- wo       [L,heads*D,H]  -> tp shards the head (input) dim  (row-parallel)
+- wg/wu    [L,H,I]        -> tp shards I
+- wd       [L,I,H]        -> tp shards I (row-parallel)
+- embed    [V,H]          -> tp shards V (vocab-parallel embedding + logits)
+- lm_head  [H,V]          -> tp shards V
+- biases/norms            -> replicated (fsdp-sharded if large)
+- MoE router [L,H,E]      -> replicated over tp
+- MoE wg/wu [L,E,H,I]     -> ep shards E (expert parallel: the folded
+  (dp,cp) axes), tp shards I
+- value_head [H,1]        -> replicated
+
+FSDP (ZeRO-3-style) additionally shards each weight's largest non-tp dim over
+the ("dp","cp") axes; under jit XLA all-gathers just-in-time per layer of the
+scan, which is exactly FSDP's prefetch behavior, and the optimizer state
+inherits the sharding so it is ZeRO-sharded too.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+
+FSDP_AXES = (AXIS_DP, AXIS_CP)  # combined data axes used for param sharding
+EP_AXES = (AXIS_DP, AXIS_CP)  # expert axis = folded data axes (MoE folding)
+
+
+def param_spec(path: tuple, leaf: Any, fsdp: bool) -> P:
+    """PartitionSpec for one stacked-leaf param, keyed by its pytree path."""
+    keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+    name = keys[-1]
+    in_layers = "layers" in keys
+
+    def fs(axis_spec):
+        """Optionally add fsdp sharding on the first None dim."""
+        if not fsdp:
+            return axis_spec
+        spec = list(axis_spec)
+        for i, s in enumerate(spec):
+            if s is None and i > 0:  # never shard the scanned layer dim
+                spec[i] = FSDP_AXES
+                return tuple(spec)
+        return tuple(spec)
+
+    if not in_layers:
+        if name == "embed":
+            return fs((AXIS_TP, None))
+        if name == "lm_head":
+            return fs((None, AXIS_TP))
+        if name == "value_head":
+            return P(None, None)
+        if name == "final_norm":
+            return P(None)
+        return P()
+
+    # layer-stacked leaves: dim 0 is L
+    if name in ("wq", "wk", "wv"):
+        return fs((None, None, AXIS_TP))
+    if name == "wo":
+        return fs((None, AXIS_TP, None))
+    if name == "router":
+        return fs((None, None, None))
+    if name in ("wg", "wu"):
+        if leaf is not None and getattr(leaf, "ndim", 3) == 4:  # MoE [L,E,H,I]
+            return (None, EP_AXES, None, AXIS_TP)
+        return fs((None, None, AXIS_TP))
+    if name == "wd":
+        if leaf is not None and getattr(leaf, "ndim", 3) == 4:  # MoE [L,E,I,H]
+            return (None, EP_AXES, AXIS_TP, None)
+        return fs((None, AXIS_TP, None))
+    if name in ("bq", "bk", "bv"):
+        return P(None, AXIS_TP)
+    # norms and other small per-layer vectors
+    return P(None, None) if getattr(leaf, "ndim", 1) >= 2 else P(None)
+
+
+def param_shardings(mesh: Mesh, params_shape_tree: Any, fsdp: bool = True):
+    """Pytree of NamedShardings matching ``params_shape_tree``.
+
+    Dims that don't divide evenly by their assigned axes fall back to
+    replication on that dim (GSPMD requires even sharding for inputs placed
+    via device_put; XLA can still re-shard internally)."""
+
+    def build(path, leaf):
+        spec = param_spec(path, leaf, fsdp)
+        spec = _evenly_divisible(mesh, spec, getattr(leaf, "shape", ()))
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map_with_path(build, params_shape_tree)
+
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        out = 1
+        for a in axis:
+            out *= mesh.shape[a]
+        return out
+    return mesh.shape[axis]
+
+
+def _evenly_divisible(mesh: Mesh, spec, shape) -> tuple:
+    out = []
+    for i, axis in enumerate(spec):
+        if axis is None or i >= len(shape):
+            out.append(axis)
+            continue
+        if shape[i] % _axis_size(mesh, axis) != 0:
+            out.append(None)
+        else:
+            out.append(axis)
+    return tuple(out)
+
+
+def logits_spec() -> P:
+    """Activations: packed token dim sharded over (dp,cp); vocab over tp."""
+    return P(FSDP_AXES, AXIS_TP)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

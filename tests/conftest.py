@@ -1,18 +1,26 @@
-"""Test harness: force an 8-virtual-device CPU platform before jax imports.
+"""Test harness: force an 8-virtual-device CPU platform before jax is used.
 
 Mirrors the reference's strategy of testing distributed logic with N-process
 gloo-on-CPU (realhf/base/testing.py:112-119); the JAX analogue is a host
 platform with 8 virtual devices so mesh/sharding code runs anywhere.
+
+Note: the TPU image's sitecustomize force-registers the 'axon' TPU backend and
+overrides JAX_PLATFORMS from the environment, so we must ALSO set the platform
+via jax.config after import — env vars alone are ignored.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -23,3 +31,10 @@ def _fresh_name_resolve():
 
     name_resolve.DEFAULT_REPOSITORY = name_resolve.MemoryNameRecordRepository()
     yield
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"Expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
