@@ -64,12 +64,15 @@ class AsyncRewardWrapper:
             )
             return float(await asyncio.wait_for(fut, timeout=self.timeout))
         except asyncio.CancelledError:
-            # distinguish "our pool future was cancelled by a pool restart"
-            # (degrade to 0.0) from "the caller cancelled us" (propagate)
-            if fut is not None and fut.cancelled():
-                logger.warning("Reward future cancelled by pool restart; returning 0.")
-                return 0.0
-            raise
+            # wait_for cancels the inner future on outer cancellation too, so
+            # fut.cancelled() can't distinguish the cases; a pending task
+            # cancellation on *us* (caller cancel) must propagate, while a
+            # cancel that originated from a pool restart degrades to 0.0.
+            task = asyncio.current_task()
+            if task is not None and task.cancelling() > 0:
+                raise
+            logger.warning("Reward future cancelled by pool restart; returning 0.")
+            return 0.0
         except asyncio.TimeoutError:
             # The worker process is still running the hung reward_fn; restart
             # the pool so timed-out workers don't permanently starve it.

@@ -126,11 +126,15 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
         "tie_word_embeddings": cfg.tie_word_embeddings,
         "max_position_embeddings": cfg.max_position_embeddings,
         "torch_dtype": "bfloat16",
-        "model_type": cfg.arch.replace("_moe", "_moe"),
+        "model_type": cfg.arch,
+        "attention_bias": cfg.attention_bias,
     }
     if cfg.is_moe:
         out.update(
             num_experts=cfg.num_experts,
+            # transformers' MixtralConfig reads num_local_experts and
+            # ignores num_experts — write both so the export round-trips
+            num_local_experts=cfg.num_experts,
             num_experts_per_tok=cfg.num_experts_per_tok,
             moe_intermediate_size=cfg.moe_intermediate_size,
             norm_topk_prob=cfg.norm_topk_prob,

@@ -126,11 +126,15 @@ def load_hf_params(
             elif sub in ("mlp.gate.weight", "block_sparse_moe.gate.weight"):
                 put_layer("router", i, tensor.T)
             elif ".experts." in sub:
-                # mlp.experts.{e}.gate_proj.weight etc.
+                # qwen3-moe: mlp.experts.{e}.gate_proj.weight
+                # mixtral: block_sparse_moe.experts.{e}.w1/w2/w3.weight
                 parts = sub.split(".")
                 e = int(parts[2])
                 proj = parts[3]
-                key = {"gate_proj": "wg", "up_proj": "wu", "down_proj": "wd"}[proj]
+                key = {
+                    "gate_proj": "wg", "up_proj": "wu", "down_proj": "wd",
+                    "w1": "wg", "w3": "wu", "w2": "wd",
+                }[proj]
                 lst = layer_parts.setdefault(
                     key, [[None] * cfg.num_experts for _ in range(l)]
                 )
@@ -141,11 +145,22 @@ def load_hf_params(
             logger.warning(f"Skipping unmapped tensor: {name}")
 
     def stack(key: str, lst) -> np.ndarray:
+        if isinstance(lst[0], list):  # MoE: [layer][expert]
+            missing = [
+                (i, e)
+                for i, per_l in enumerate(lst)
+                for e, x in enumerate(per_l)
+                if x is None
+            ]
+            if missing:
+                raise ValueError(
+                    f"Checkpoint missing expert tensors {key} (layer, expert): "
+                    f"{missing}"
+                )
+            return np.stack([np.stack(per_l) for per_l in lst])
         if any(x is None for x in lst):
             missing = [i for i, x in enumerate(lst) if x is None]
             raise ValueError(f"Checkpoint missing layer tensors {key}: {missing}")
-        if isinstance(lst[0], list):  # MoE: [layer][expert]
-            return np.stack([np.stack(per_l) for per_l in lst])
         return np.stack(lst)
 
     layers = {}
@@ -231,15 +246,24 @@ def save_hf_params(
                 t = arr[i].T if transpose else arr[i]
                 tensors[f"model.layers.{i}.{hf_sub}"] = contig(t)
             elif key == "router":
-                tensors[f"model.layers.{i}.mlp.gate.weight"] = contig(arr[i].T)
+                moe_mod = "block_sparse_moe" if cfg.arch == "mixtral" else "mlp"
+                tensors[f"model.layers.{i}.{moe_mod}.gate.weight"] = contig(arr[i].T)
             elif key in ("wg", "wu", "wd"):
-                proj = {"wg": "gate_proj", "wu": "up_proj", "wd": "down_proj"}[key]
                 if cfg.is_moe:
+                    if cfg.arch == "mixtral":
+                        moe_mod = "block_sparse_moe"
+                        proj = {"wg": "w1", "wu": "w3", "wd": "w2"}[key]
+                    else:
+                        moe_mod = "mlp"
+                        proj = {
+                            "wg": "gate_proj", "wu": "up_proj", "wd": "down_proj"
+                        }[key]
                     for e in range(cfg.num_experts):
                         tensors[
-                            f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"
+                            f"model.layers.{i}.{moe_mod}.experts.{e}.{proj}.weight"
                         ] = contig(arr[i, e].T)
                 else:
+                    proj = {"wg": "gate_proj", "wu": "up_proj", "wd": "down_proj"}[key]
                     tensors[f"model.layers.{i}.mlp.{proj}.weight"] = contig(arr[i].T)
             else:
                 raise ValueError(f"Unmapped param key: layers/{key}")

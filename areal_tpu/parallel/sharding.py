@@ -46,12 +46,16 @@ def param_spec(path: tuple, leaf: Any, fsdp: bool) -> P:
     in_layers = "layers" in keys
 
     def fs(axis_spec):
-        """Optionally add fsdp sharding on the first None dim."""
+        """Optionally add fsdp sharding on the first shardable None dim.
+
+        Layer-stacked leaves never shard dim 0 (the scanned L dim); top-level
+        leaves (embed/lm_head) may shard any dim."""
         if not fsdp:
             return axis_spec
         spec = list(axis_spec)
+        first = 1 if in_layers else 0
         for i, s in enumerate(spec):
-            if s is None and i > 0:  # never shard the scanned layer dim
+            if s is None and i >= first:
                 spec[i] = FSDP_AXES
                 return tuple(spec)
         return tuple(spec)

@@ -1,0 +1,328 @@
+"""Loss / logprob / advantage math (JAX).
+
+Capability parity with the reference's ``areal/utils/functional.py``
+(gather_logprobs:43, gather_logprobs_entropy:84, masked_normalization:131,
+ppo_actor_loss_fn:171 — the decoupled-PPO objective, ppo_critic_loss_fn:247,
+dynamic_sampling:314, reward_overlong_penalty:376) and its cuGAE CUDA kernels
+(csrc/cugae/gae.cu). TPU-native design notes:
+
+- log-softmax gathers are plain fused XLA ops over the full [T, V] logits —
+  no manual chunking needed; XLA tiles the reduction onto the VPU/MXU.
+- GAE is a time-reversed ``jax.lax.scan`` over the padded [B, T] batch —
+  the sequential dependence is inherent (it's a linear recurrence), and a
+  scan over T with B lanes vectorized is the TPU-shaped formulation of the
+  reference's one-CUDA-thread-per-sequence kernel.
+- Everything is pure and jittable; host-side helpers (dynamic_sampling)
+  operate on numpy and stay out of jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TensorDict = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Logprob gathering
+# ---------------------------------------------------------------------------
+
+
+def gather_logprobs(
+    logits: jnp.ndarray,  # [T, V] fp32
+    labels: jnp.ndarray,  # [T] int32
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """Log-probability of ``labels`` under ``logits`` (reference :43)."""
+    if temperature != 1.0:
+        logits = logits / temperature
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return picked - logz
+
+
+def gather_logprobs_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    temperature: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(logprobs, entropy) in one pass (reference :84)."""
+    if temperature != 1.0:
+        logits = logits / temperature
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    logp_full = logits - logz[:, None]
+    entropy = -jnp.sum(jnp.exp(logp_full) * logp_full, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return picked - logz, entropy
+
+
+# ---------------------------------------------------------------------------
+# Masked normalization
+# ---------------------------------------------------------------------------
+
+
+def masked_normalization(
+    x: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    dim=None,
+    unbiased: bool = False,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Whiten ``x`` over ``dim`` counting only masked entries (reference :131).
+
+    The reference all-reduces sums across DP ranks; under the JAX
+    single-controller model the arrays are global, so plain reductions give
+    the identical result.
+    """
+    xf = x.astype(jnp.float32)
+    if dim is None:
+        dim = tuple(range(x.ndim))
+    if mask is None:
+        factor = np.prod([x.shape[d] for d in dim]).astype(np.float32)
+    else:
+        m = mask.astype(jnp.float32)
+        xf = xf * m
+        factor = jnp.sum(m, axis=dim, keepdims=True)
+    x_sum = jnp.sum(xf, axis=dim, keepdims=True)
+    x_sq = jnp.sum(jnp.square(xf), axis=dim, keepdims=True)
+    mean = x_sum / factor
+    var = x_sq / factor - jnp.square(mean)
+    if unbiased:
+        var = var * factor / (factor - 1)
+    return (xf - mean) / (jnp.sqrt(var) + eps)
+
+
+# ---------------------------------------------------------------------------
+# PPO losses
+# ---------------------------------------------------------------------------
+
+
+def ppo_actor_loss_fn(
+    logprobs: jnp.ndarray,
+    proximal_logprobs: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    eps_clip: float,
+    loss_mask: jnp.ndarray,
+    eps_clip_higher: float | None = None,
+    c_clip: float | None = None,
+    behav_imp_weight_cap: float | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Decoupled-PPO policy loss (reference functional.py:171-235).
+
+    ratio = exp(logp - proximal_logp) is clipped per PPO; the whole objective
+    is reweighted by the behavior importance weight exp(proximal - behavioral),
+    which corrects for rollout staleness (the AReaL decoupled objective).
+    Returns (scalar mean-over-mask loss, stats dict of per-token arrays).
+    """
+    mask = loss_mask.astype(bool)
+    count = jnp.maximum(jnp.sum(mask), 1)
+    ratio = jnp.where(mask, jnp.exp(logprobs - proximal_logprobs), 0.0)
+    hi = eps_clip if eps_clip_higher is None else eps_clip_higher
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + hi)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * clipped_ratio
+    clip_mask = pg1 < pg2
+    pg = jnp.maximum(pg1, pg2)
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg3 = jnp.sign(advantages) * c_clip * advantages
+        dual_clip_mask = pg3 < pg
+        pg = jnp.minimum(pg, pg3)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+    behav_kl = proximal_logprobs - old_logprobs
+    behav_imp_weight = jnp.exp(behav_kl)
+    if behav_imp_weight_cap is not None:
+        behav_mask = (behav_imp_weight <= behav_imp_weight_cap) & mask
+    else:
+        behav_mask = mask
+    behav_kl = jnp.where(behav_mask, behav_kl, 0.0)
+    behav_imp_weight = jnp.where(behav_mask, behav_imp_weight, 0.0)
+    pg = pg * behav_imp_weight
+    logging_loss = pg
+    loss = jnp.sum(jnp.where(mask, pg, 0.0)) / count
+    stats = dict(
+        loss=logging_loss,
+        importance_weight=ratio,
+        approx_kl=jax.lax.stop_gradient(logprobs - proximal_logprobs),
+        clip_mask=clip_mask & mask,
+        dual_clip_mask=dual_clip_mask & mask,
+        behave_imp_weight=behav_imp_weight,
+        behave_approx_kl=behav_kl,
+        behave_mask=behav_mask,
+    )
+    return loss, stats
+
+
+def ppo_critic_loss_fn(
+    value: jnp.ndarray,
+    old_value: jnp.ndarray,
+    target_value: jnp.ndarray,
+    value_eps_clip: float,
+    loss_mask: jnp.ndarray | None = None,
+    loss_fn_type: str = "mse",
+    huber_delta: float = 10.0,
+) -> tuple[jnp.ndarray, dict]:
+    """Clipped value loss (reference functional.py:247-312)."""
+
+    def base(x, y):
+        if loss_fn_type == "huber":
+            diff = jnp.abs(x - y)
+            return jnp.where(
+                diff < huber_delta,
+                0.5 * diff**2,
+                huber_delta * (diff - 0.5 * huber_delta),
+            )
+        return 0.5 * (x - y) ** 2
+
+    value_clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    l_orig = base(value, target_value)
+    l_clip = base(value_clipped, target_value)
+    clip_mask = l_clip > l_orig
+    value_loss = jnp.maximum(l_orig, l_clip)
+    if loss_mask is not None:
+        m = loss_mask.astype(bool)
+        loss = jnp.sum(jnp.where(m, value_loss, 0.0)) / jnp.maximum(jnp.sum(m), 1)
+        clip_mask = clip_mask & m
+    else:
+        loss = jnp.mean(value_loss)
+    return loss, dict(loss=value_loss, clip_mask=clip_mask)
+
+
+# ---------------------------------------------------------------------------
+# GAE — the cuGAE equivalent as a lax.scan linear recurrence
+# ---------------------------------------------------------------------------
+
+
+def gae_padded(
+    rewards: jnp.ndarray,  # [B, T] fp32
+    values: jnp.ndarray,  # [B, T] fp32
+    loss_mask: jnp.ndarray,  # [B, T] (already shifted like the reference)
+    seq_no_eos_mask: jnp.ndarray,  # [B] bool — sequence hit max length
+    discount: float,
+    gae_lambda: float,
+) -> jnp.ndarray:
+    """Masked GAE over a padded batch, exactly mirroring the reference's
+    backward loop (areal/engine/ppo/actor.py:136-151): tokens with mask 0
+    pass ``nextvalues``/``lastgaelam`` through unchanged; the bootstrap value
+    at T-1 is ``values[:, T-1]`` only when the sequence never emitted EOS.
+
+    Formulated as a reverse-time ``lax.scan`` with B vectorized lanes — the
+    TPU analogue of cuGAE's one-thread-per-sequence kernel
+    (csrc/cugae/gae.cu:10-28).
+    """
+    b, t = rewards.shape
+    mask = loss_mask.astype(jnp.float32)
+    init = (
+        values[:, t - 1] * seq_no_eos_mask.astype(jnp.float32),  # nextvalues
+        jnp.zeros((b,), jnp.float32),  # lastgaelam
+    )
+
+    def step(carry, xs):
+        nextvalues, lastgaelam = carry
+        r_t, v_t, m_t = xs
+        delta = r_t + discount * nextvalues - v_t
+        newgaelam = delta + discount * gae_lambda * lastgaelam
+        nextvalues = nextvalues * (1 - m_t) + v_t * m_t
+        lastgaelam = lastgaelam * (1 - m_t) + newgaelam * m_t
+        return (nextvalues, lastgaelam), lastgaelam
+
+    xs = (rewards[:, : t - 1].T, values[:, : t - 1].T, mask[:, : t - 1].T)
+    _, adv_rev = jax.lax.scan(step, init, xs, reverse=True)
+    # adv_rev[t] is lastgaelam produced at time t (already in forward order
+    # thanks to reverse=True); the reference appends a zero column at T-1.
+    advantages = jnp.concatenate(
+        [adv_rev.T, jnp.zeros((b, 1), jnp.float32)], axis=1
+    )
+    return advantages
+
+
+def gae_packed(
+    rewards: jnp.ndarray,  # [total] fp32, packed
+    values: jnp.ndarray,  # [total] fp32
+    segment_ids: jnp.ndarray,  # [total] int32, pad = -1
+    bootstrap: jnp.ndarray,  # [total] fp32 — nextvalue at each seq's last token
+    discount: float,
+    gae_lambda: float,
+) -> jnp.ndarray:
+    """GAE over a packed 1D stream (cuGAE gae_1d_nolp_misalign equivalent,
+    csrc/cugae/gae.cu:10-28). A single reverse scan; the recurrence resets at
+    segment boundaries detected from ``segment_ids``."""
+    # last-token flag: next token belongs to a different segment
+    next_seg = jnp.concatenate([segment_ids[1:], jnp.full((1,), -2, jnp.int32)])
+    is_last = segment_ids != next_seg
+
+    def step(carry, xs):
+        r, v, boot, last = xs
+        # carry holds (A_{t+1}, V_{t+1}); at a segment's last token the
+        # recurrence restarts from (0, bootstrap).
+        gaelam_in = jnp.where(last, 0.0, carry[0])
+        nextv_in = jnp.where(last, boot, carry[1])
+        delta = r + discount * nextv_in - v
+        gaelam = delta + discount * gae_lambda * gaelam_in
+        return (gaelam, v), gaelam
+
+    (_, _), adv = jax.lax.scan(
+        step,
+        (jnp.float32(0.0), jnp.float32(0.0)),
+        (rewards, values, bootstrap, is_last),
+        reverse=True,
+    )
+    return jnp.where(segment_ids >= 0, adv, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch filters (numpy; out of jit by design)
+# ---------------------------------------------------------------------------
+
+
+def dynamic_sampling(
+    data: TensorDict, group_size: int
+) -> tuple[TensorDict, dict[str, int]]:
+    """Drop whole groups whose rewards are all equal — DAPO-style filtering
+    (reference functional.py:314-374). Assumes group members are adjacent."""
+    rewards = np.asarray(data["rewards"])
+    bs = rewards.shape[0]
+    if group_size <= 0:
+        return data, dict(n_group_kept=0, n_group_filtered=0)
+    if bs % group_size != 0:
+        return data, dict(n_group_kept=bs // max(group_size, 1), n_group_filtered=0)
+    n_groups = bs // group_size
+    grouped = rewards.reshape(n_groups, group_size)
+    valid = ~np.all(grouped == grouped[:, :1], axis=1)
+    mask = np.repeat(valid, group_size)
+    if not mask.any():
+        return data, dict(n_group_kept=0, n_group_filtered=n_groups)
+    kept = int(valid.sum())
+    out: TensorDict = {}
+    for k, v in data.items():
+        arr = np.asarray(v) if not np.isscalar(v) else v
+        if hasattr(arr, "shape") and arr.ndim >= 1 and arr.shape[0] == bs:
+            out[k] = arr[mask]
+        else:
+            out[k] = v
+    return out, dict(n_group_kept=kept, n_group_filtered=n_groups - kept)
+
+
+def reward_overlong_penalty(
+    data: TensorDict,
+    overlong_tokens: int,
+    overlong_penalty_factor: float,
+    max_response_length: int,
+) -> TensorDict:
+    """Linear penalty once the response exceeds max_len - overlong_tokens
+    (reference functional.py:376-398, DAPO)."""
+    rewards = np.asarray(data["rewards"], dtype=np.float32).copy()
+    response_lengths = np.asarray(data["loss_mask"]).sum(axis=-1).astype(np.int64)
+    expected = max_response_length - overlong_tokens
+    exceed = response_lengths - expected
+    penalty = np.minimum(-exceed / overlong_tokens * overlong_penalty_factor, 0.0)
+    data["rewards"] = rewards + penalty.astype(np.float32)
+    return data

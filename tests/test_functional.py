@@ -1,0 +1,175 @@
+"""Loss/GAE math vs straightforward numpy references.
+
+Mirrors the reference's kernel-test pattern: cuGAE is tested against a
+pure-PyTorch loop (realhf/tests/cpp_extensions/test_cugae.py); here the
+lax.scan GAE is tested against a pure-numpy loop.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.utils import functional as F
+
+
+def _np_gae_padded(rewards, values, loss_mask, no_eos, discount, lam):
+    b, t = rewards.shape
+    adv_rev = [np.zeros(b, np.float32)]
+    lastgaelam = np.zeros(b, np.float32)
+    nextvalues = values[:, t - 1] * no_eos
+    for i in reversed(range(t - 1)):
+        delta = rewards[:, i] + discount * nextvalues - values[:, i]
+        new = delta + discount * lam * lastgaelam
+        m = loss_mask[:, i]
+        nextvalues = nextvalues * (1 - m) + values[:, i] * m
+        lastgaelam = lastgaelam * (1 - m) + new * m
+        adv_rev.append(lastgaelam.copy())
+    return np.stack(adv_rev[::-1], axis=1)
+
+
+def test_gae_padded_matches_numpy_loop():
+    rng = np.random.default_rng(0)
+    b, t = 4, 17
+    rewards = rng.normal(size=(b, t)).astype(np.float32)
+    values = rng.normal(size=(b, t)).astype(np.float32)
+    lens = rng.integers(3, t, size=b)
+    loss_mask = (np.arange(t)[None, :] < lens[:, None]).astype(np.float32)
+    no_eos = (lens == t).astype(np.float32)
+    got = F.gae_padded(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(loss_mask),
+        jnp.asarray(no_eos), 0.97, 0.95,
+    )
+    want = _np_gae_padded(rewards, values, loss_mask, no_eos, 0.97, 0.95)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_packed_matches_per_sequence():
+    rng = np.random.default_rng(1)
+    lens = [5, 9, 3]
+    discount, lam = 0.99, 0.9
+    total = sum(lens)
+    rewards = rng.normal(size=total).astype(np.float32)
+    values = rng.normal(size=total).astype(np.float32)
+    seg = np.concatenate([np.full(n, i, np.int32) for i, n in enumerate(lens)])
+    boots = rng.normal(size=total).astype(np.float32)
+
+    got = F.gae_packed(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(seg),
+        jnp.asarray(boots), discount, lam,
+    )
+    # per-sequence reference loop
+    want = np.zeros(total, np.float32)
+    off = 0
+    for n in lens:
+        last = off + n - 1
+        a_next, v_next = 0.0, boots[last]
+        for i in reversed(range(off, off + n)):
+            delta = rewards[i] + discount * v_next - values[i]
+            a = delta + discount * lam * a_next
+            want[i] = a
+            a_next, v_next = a, values[i]
+        off += n
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_gather_logprobs_and_entropy():
+    rng = np.random.default_rng(2)
+    logits = rng.normal(size=(11, 37)).astype(np.float32)
+    labels = rng.integers(0, 37, size=11).astype(np.int32)
+    lp = np.asarray(F.gather_logprobs(jnp.asarray(logits), jnp.asarray(labels)))
+    ref = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    np.testing.assert_allclose(lp, ref[np.arange(11), labels], rtol=1e-5, atol=1e-5)
+    lp2, ent = F.gather_logprobs_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    np.testing.assert_allclose(np.asarray(lp2), lp, rtol=1e-6)
+    want_ent = -(np.exp(ref) * ref).sum(-1)
+    np.testing.assert_allclose(np.asarray(ent), want_ent, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_normalization_zero_mean_unit_std():
+    rng = np.random.default_rng(3)
+    x = rng.normal(5.0, 3.0, size=(6, 20)).astype(np.float32)
+    mask = (rng.random((6, 20)) > 0.3).astype(np.float32)
+    out = np.asarray(F.masked_normalization(jnp.asarray(x), jnp.asarray(mask)))
+    sel = out[mask.astype(bool)]
+    assert abs(sel.mean()) < 1e-3
+    assert abs(sel.std() - 1.0) < 1e-2
+
+
+def test_ppo_actor_loss_clip_and_decoupled():
+    # identical logp == proximal == old -> ratio 1, loss = -mean(adv on mask)
+    t = 8
+    adv = jnp.asarray(np.arange(t, dtype=np.float32))
+    lp = jnp.zeros(t)
+    mask = jnp.ones(t)
+    loss, stats = F.ppo_actor_loss_fn(lp, lp, lp, adv, 0.2, mask)
+    np.testing.assert_allclose(float(loss), -np.arange(t).mean(), rtol=1e-6)
+    assert not bool(stats["clip_mask"].any())
+
+    # stale behavior policy: behav weight = exp(prox - old) scales the loss
+    old = lp - 0.5
+    loss2, _ = F.ppo_actor_loss_fn(lp, lp, old, adv, 0.2, mask)
+    np.testing.assert_allclose(float(loss2), float(loss) * np.exp(0.5), rtol=1e-5)
+
+    # cap excludes tokens with too-large behav weight
+    loss3, stats3 = F.ppo_actor_loss_fn(
+        lp, lp, old, adv, 0.2, mask, behav_imp_weight_cap=1.0
+    )
+    assert float(loss3) == 0.0
+    assert not bool(stats3["behave_mask"].any())
+
+
+def test_ppo_actor_dual_clip():
+    lp = jnp.zeros(4)
+    prox = jnp.asarray([-2.0, -2.0, 0.0, 0.0])  # ratio = e^2 for first two
+    adv = jnp.asarray([-1.0, 1.0, -1.0, 1.0])
+    mask = jnp.ones(4)
+    _, stats = F.ppo_actor_loss_fn(lp, prox, prox, adv, 0.2, mask, c_clip=3.0)
+    # dual clip binds only for negative advantages with huge ratio
+    assert bool(stats["dual_clip_mask"][0])
+    assert not bool(stats["dual_clip_mask"][1])
+
+
+def test_ppo_critic_loss_clip():
+    v = jnp.asarray([1.0, 5.0])
+    old = jnp.asarray([0.0, 0.0])
+    tgt = jnp.asarray([0.0, 0.0])
+    loss, stats = F.ppo_critic_loss_fn(v, old, tgt, 0.5)
+    # second element clipped to 0.5 -> loss uses max(orig, clipped)
+    want = 0.5 * np.array([1.0, 25.0]).mean()
+    np.testing.assert_allclose(float(loss), want, rtol=1e-6)
+    assert not bool(stats["clip_mask"][1])  # orig loss already larger
+
+
+def test_dynamic_sampling_filters_uniform_groups():
+    data = {
+        "rewards": np.array([1.0, 1.0, 0.0, 1.0], np.float32),
+        "input_ids": np.arange(8).reshape(4, 2),
+        "meta": "keep",
+    }
+    out, stats = F.dynamic_sampling(data, group_size=2)
+    assert stats == dict(n_group_kept=1, n_group_filtered=1)
+    np.testing.assert_array_equal(out["rewards"], [0.0, 1.0])
+    np.testing.assert_array_equal(out["input_ids"], [[4, 5], [6, 7]])
+    assert out["meta"] == "keep"
+
+    # all groups uniform -> return original
+    data2 = {"rewards": np.ones(4, np.float32)}
+    out2, stats2 = F.dynamic_sampling(data2, group_size=2)
+    assert stats2["n_group_filtered"] == 2
+    assert out2["rewards"].shape[0] == 4
+
+
+def test_reward_overlong_penalty():
+    data = {
+        "rewards": np.array([1.0, 1.0], np.float32),
+        "input_ids": np.zeros((2, 10)),
+        "loss_mask": np.stack([
+            np.r_[np.ones(4), np.zeros(6)],  # short: no penalty
+            np.ones(10),  # too long: penalized
+        ]),
+    }
+    out = F.reward_overlong_penalty(
+        data, overlong_tokens=4, overlong_penalty_factor=1.0, max_response_length=10
+    )
+    np.testing.assert_allclose(out["rewards"][0], 1.0)
+    np.testing.assert_allclose(out["rewards"][1], 1.0 - 4 / 4 * 1.0)
