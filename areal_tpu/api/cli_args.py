@@ -290,6 +290,7 @@ class PPOActorConfig(TrainEngineConfig):
     overlong_reward_penalty: bool = False
     overlong_tokens: int = 0
     overlong_penalty_factor: float = 0.0
+    max_new_tokens: int = 1024  # response-length cap used by the penalty
     mask_no_eos_with_zero: bool = False
     # KL
     kl_ctl: float = 0.0

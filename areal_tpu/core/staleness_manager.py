@@ -1,0 +1,67 @@
+"""Staleness-aware rollout capacity control.
+
+Behavior parity with the reference's ``areal/core/staleness_manager.py:12``:
+capacity is the min of a concurrency budget and a staleness budget,
+
+    capacity = min(max_concurrent - running,
+                   (max_staleness + version + 1) * consumer_bs
+                       - (accepted + running))
+
+so that no trajectory consumed at training version v was generated more than
+``max_staleness`` versions earlier.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from areal_tpu.api.io_struct import RolloutStat
+
+
+class StalenessManager:
+    def __init__(
+        self,
+        max_concurrent_rollouts: int,
+        consumer_batch_size: int,
+        max_staleness: int,
+    ):
+        self.max_concurrent_rollouts = max_concurrent_rollouts
+        self.consumer_batch_size = consumer_batch_size
+        self.max_staleness = max_staleness
+        self._lock = threading.Lock()
+        self._stat = RolloutStat()
+
+    def get_capacity(self, current_version: int) -> int:
+        """Available rollout slots at ``current_version`` (may be negative)."""
+        with self._lock:
+            concurrency = (
+                max(1, self.max_concurrent_rollouts) - self._stat.running
+            )
+            sample_cnt = self._stat.accepted + self._stat.running
+            staleness = (
+                self.max_staleness + current_version + 1
+            ) * max(1, self.consumer_batch_size) - sample_cnt
+            return min(concurrency, staleness)
+
+    def on_rollout_submitted(self) -> None:
+        with self._lock:
+            self._stat.submitted += 1
+            self._stat.running += 1
+
+    def on_rollout_accepted(self) -> None:
+        with self._lock:
+            self._stat.accepted += 1
+            self._stat.running -= 1
+
+    def on_rollout_rejected(self) -> None:
+        with self._lock:
+            self._stat.running -= 1
+
+    def get_stats(self) -> RolloutStat:
+        with self._lock:
+            return RolloutStat(
+                submitted=self._stat.submitted,
+                accepted=self._stat.accepted,
+                running=self._stat.running,
+                rejected=self._stat.rejected,
+            )

@@ -1,0 +1,314 @@
+"""Async rollout executor: a background asyncio thread that turns dataset
+items into trajectories through RolloutWorkflows, under staleness control.
+
+Behavior parity with the reference's ``areal/core/workflow_executor.py:225``:
+
+- ``submit`` enqueues (data, workflow, should_accept) inputs.
+- the rollout thread spawns one asyncio task per episode while
+  ``StalenessManager.get_capacity(version) > 0`` and not paused.
+- completed trajectories are format-checked, filtered by ``should_accept``,
+  and enqueued with their creation time.
+- ``wait(count)`` drains results, sorts by creation time (oldest rollouts
+  consumed first -> bounded staleness), shuffles, and concatenates into one
+  padded batch.
+- ``prepare_batch`` keeps >= 2 batches in flight for maximum overlap of
+  generation and training.
+- exceptions in the thread propagate to the caller on the next API call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import random
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.io_struct import TimedResult
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.staleness_manager import StalenessManager
+from areal_tpu.utils import logging
+from areal_tpu.utils.data import concat_padded_tensors, cycle_dataloader
+
+logger = logging.getLogger("WorkflowExecutor")
+
+POLL_WAIT_TIME = 0.05
+POLL_SLEEP_TIME = 0.02
+
+
+def check_trajectory_format(
+    traj: dict[str, Any], expected_keys: set | None = None
+) -> bool:
+    """Validate a trajectory tensor-dict (reference
+    workflow_executor.py:32-202): 2D padded arrays with consistent batch size,
+    required keys present, attention_mask of 0/1."""
+    if not isinstance(traj, dict):
+        raise ValueError(f"trajectory must be a dict, got {type(traj)}")
+    required = {"input_ids", "attention_mask"}
+    missing = required - set(traj.keys())
+    if missing:
+        raise ValueError(f"trajectory missing required keys: {missing}")
+    if expected_keys is not None and set(traj.keys()) != expected_keys:
+        raise ValueError(
+            f"trajectory keys {set(traj.keys())} != expected {expected_keys}"
+        )
+    bs = None
+    for k, v in traj.items():
+        arr = np.asarray(v)
+        if arr.ndim == 0:
+            continue
+        if bs is None:
+            bs = arr.shape[0]
+        elif arr.shape[0] != bs:
+            raise ValueError(
+                f"trajectory key {k} batch dim {arr.shape[0]} != {bs}"
+            )
+    attn = np.asarray(traj["attention_mask"])
+    if not np.isin(attn, (0, 1)).all():
+        raise ValueError("attention_mask must be 0/1")
+    if attn.shape != np.asarray(traj["input_ids"]).shape:
+        raise ValueError("attention_mask shape != input_ids shape")
+    return True
+
+
+class _TaskInput:
+    __slots__ = ("data", "workflow", "should_accept")
+
+    def __init__(self, data, workflow, should_accept):
+        self.data = data
+        self.workflow = workflow
+        self.should_accept = should_accept
+
+
+class WorkflowExecutor:
+    def __init__(
+        self,
+        config: InferenceEngineConfig,
+        inference_engine,
+        staleness_manager: StalenessManager | None = None,
+    ):
+        self.config = config
+        self.inference_engine = inference_engine
+        self.max_concurrent_rollouts = (
+            config.max_concurrent_rollouts or config.consumer_batch_size
+        )
+        self.consumer_batch_size = config.consumer_batch_size
+        self.staleness_manager = staleness_manager
+
+        qsize = config.queue_size or self.max_concurrent_rollouts * 16
+        self.input_queue: queue.Queue = queue.Queue(maxsize=qsize)
+        self.output_queue: queue.Queue = queue.Queue(maxsize=qsize)
+        self.result_cache: list[TimedResult] = []
+        self._expected_keys: set | None = None
+
+        self.exiting = threading.Event()
+        self.paused = threading.Event()
+        self._exc_lock = threading.Lock()
+        self._thread_exc: BaseException | None = None
+        self.rollout_thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def initialize(self, train_data_parallel_size: int | None = None):
+        if self.staleness_manager is None:
+            dp = train_data_parallel_size or 1
+            self.staleness_manager = StalenessManager(
+                max_concurrent_rollouts=max(1, self.max_concurrent_rollouts // dp),
+                consumer_batch_size=max(1, self.consumer_batch_size // dp),
+                max_staleness=self.config.max_head_offpolicyness,
+            )
+        self.rollout_thread = threading.Thread(target=self._thread_main, daemon=True)
+        self.rollout_thread.start()
+
+    def destroy(self):
+        self.exiting.set()
+        if self.rollout_thread is not None:
+            self.rollout_thread.join(timeout=10)
+
+    def _check_health(self):
+        with self._exc_lock:
+            if self._thread_exc is not None:
+                raise RuntimeError(
+                    "Rollout thread died; no further rollouts possible."
+                ) from self._thread_exc
+
+    def get_capacity(self) -> int:
+        version = self.inference_engine.get_version()
+        return self.staleness_manager.get_capacity(version)
+
+    # -------------------------------------------------------- rollout thread
+
+    def _thread_main(self):
+        try:
+            asyncio.run(self._run_async())
+        except BaseException as e:  # noqa: BLE001 — propagate to callers
+            with self._exc_lock:
+                self._thread_exc = e
+            logger.error(f"rollout thread failed: {e}", exc_info=True)
+            self.exiting.set()
+
+    async def _run_async(self):
+        live: dict[int, tuple[int, asyncio.Task, _TaskInput]] = {}
+        next_rid = 0
+        try:
+            while not self.exiting.is_set():
+                capacity = self.get_capacity()
+                while (
+                    capacity > 0
+                    and not self.paused.is_set()
+                    and self.input_queue.qsize() > 0
+                ):
+                    x: _TaskInput = self.input_queue.get_nowait()
+                    task = asyncio.create_task(
+                        x.workflow.arun_episode(self.inference_engine, x.data),
+                        name=str(next_rid),
+                    )
+                    live[next_rid] = (time.monotonic_ns(), task, x)
+                    self.staleness_manager.on_rollout_submitted()
+                    if self.config.enable_rollout_tracing:
+                        s = self.staleness_manager.get_stats()
+                        logger.info(
+                            f"submit rollout {next_rid}: submitted={s.submitted} "
+                            f"running={s.running} accepted={s.accepted}"
+                        )
+                    capacity -= 1
+                    next_rid += 1
+
+                tasks = [t for (_, t, _) in live.values()]
+                done: set = set()
+                if tasks:
+                    done, _ = await asyncio.wait(
+                        tasks, timeout=POLL_WAIT_TIME,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                for task in done:
+                    rid = int(task.get_name())
+                    create_time, _, x = live.pop(rid)
+                    traj = await task  # re-raises workflow exceptions
+                    if traj is not None and self.config.check_trajectory_format:
+                        check_trajectory_format(traj, self._expected_keys)
+                        if self._expected_keys is None and "input_ids" in traj:
+                            self._expected_keys = set(traj.keys())
+                    accept = traj is not None and (
+                        x.should_accept is None or x.should_accept(traj)
+                    )
+                    if accept:
+                        self.staleness_manager.on_rollout_accepted()
+                        try:
+                            self.output_queue.put_nowait(
+                                TimedResult(t=create_time, data=traj)
+                            )
+                        except queue.Full:
+                            raise RuntimeError(
+                                "output queue full; increase queue_size"
+                            ) from None
+                    else:
+                        self.staleness_manager.on_rollout_rejected()
+                    if self.config.enable_rollout_tracing:
+                        s = self.staleness_manager.get_stats()
+                        verdict = "accept" if accept else "reject"
+                        logger.info(
+                            f"{verdict} rollout {rid}: submitted={s.submitted} "
+                            f"running={s.running} accepted={s.accepted}"
+                        )
+                await asyncio.sleep(POLL_SLEEP_TIME)
+        finally:
+            pending = [t for (_, t, _) in live.values() if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+    # --------------------------------------------------------------- client
+
+    def submit(
+        self,
+        data: dict[str, Any],
+        workflow: RolloutWorkflow | None = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> None:
+        self._check_health()
+        if workflow is None:
+            workflow = workflow_builder()
+        try:
+            self.input_queue.put_nowait(_TaskInput(data, workflow, should_accept))
+        except queue.Full:
+            raise RuntimeError("input queue full; increase queue_size") from None
+
+    def wait(self, count: int, timeout: float | None = None) -> dict[str, Any]:
+        start = time.perf_counter()
+        timeout = timeout or float(7 * 24 * 3600)
+        while not self.exiting.is_set() and time.perf_counter() - start < timeout:
+            self._check_health()
+            while True:
+                try:
+                    self.result_cache.append(self.output_queue.get_nowait())
+                except queue.Empty:
+                    break
+            if len(self.result_cache) >= count:
+                break
+            time.sleep(POLL_WAIT_TIME)
+        if self.exiting.is_set():
+            self._check_health()
+            raise RuntimeError("rollout executor is exiting")
+        if len(self.result_cache) < count:
+            raise TimeoutError(
+                f"timed out waiting for {count} rollouts "
+                f"(have {len(self.result_cache)})"
+            )
+        # oldest first => staleness bound holds; then shuffle for SGD
+        self.result_cache.sort(key=lambda r: r.t)
+        results, self.result_cache = (
+            self.result_cache[:count],
+            self.result_cache[count:],
+        )
+        random.shuffle(results)
+        return concat_padded_tensors([r.data for r in results])
+
+    def rollout_batch(
+        self,
+        data: list[dict[str, Any]],
+        workflow: RolloutWorkflow | None = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> dict[str, Any]:
+        for item in data:
+            self.submit(item, workflow, workflow_builder, should_accept)
+        return self.wait(count=len(data))
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow: RolloutWorkflow | None = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> dict[str, Any]:
+        if not hasattr(self, "_data_generator"):
+            self._data_generator = cycle_dataloader(dataloader)
+        batch_size = dataloader.batch_size
+        assert batch_size is not None
+        while True:
+            # keep >= 2 batches in flight to overlap generation with training
+            if (
+                self.get_capacity() + batch_size > 0
+                and self.input_queue.qsize() + batch_size
+                < self.input_queue.maxsize
+            ):
+                items = next(self._data_generator)
+                for item in items:
+                    self.submit(item, workflow, workflow_builder, should_accept)
+            try:
+                return self.wait(batch_size, timeout=1)
+            except TimeoutError:
+                pass
+
+    def pause(self):
+        self.paused.set()
+
+    def resume(self):
+        self.paused.clear()

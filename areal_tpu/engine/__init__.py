@@ -1,0 +1,3 @@
+from areal_tpu.engine.train_engine import TPUTrainEngine
+
+__all__ = ["TPUTrainEngine"]

@@ -1,0 +1,4 @@
+from areal_tpu.engine.ppo.actor import PPOActor, TPUPPOActor
+from areal_tpu.engine.ppo.critic import PPOCritic, TPUPPOCritic
+
+__all__ = ["PPOActor", "TPUPPOActor", "PPOCritic", "TPUPPOCritic"]

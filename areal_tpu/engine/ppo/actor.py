@@ -1,0 +1,301 @@
+"""PPO/GRPO actor: advantage pipeline + policy update orchestration.
+
+Behavior parity with the reference's ``areal/engine/ppo/actor.py``
+(PPOActor:25, FSDPPPOActor:278): the advantage math (reward shaping, KL
+regularization, masked GAE, normalization) follows compute_advantages
+(actor.py:72-164) token for token; the update path follows ppo_update
+(actor.py:166-275) including dynamic sampling and minibatch splitting.
+
+TPU-native differences: GAE runs as a reverse ``lax.scan`` on-device
+(the cuGAE equivalent, csrc/cugae/gae.cu:10-28); per-token training stats
+are computed host-side around the jitted loss rather than inside it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import MicroBatchSpec, NormConfig, PPOActorConfig
+from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.utils import stats_tracker
+from areal_tpu.utils.data import (
+    KLEstimator,
+    Normalization,
+    TensorDict,
+    split_padded_tensor_dict_into_mb_list,
+)
+from areal_tpu.utils.functional import (
+    dynamic_sampling,
+    gae_padded,
+    gather_logprobs,
+    gather_logprobs_entropy,
+    ppo_actor_loss_fn,
+    reward_overlong_penalty,
+)
+
+
+class PPOActor:
+    """Algorithm wrapper over a TrainEngine (reference actor.py:25)."""
+
+    def __init__(self, config: PPOActorConfig, engine: TPUTrainEngine):
+        self.config = config
+        self.engine = engine
+        self.temperature = config.temperature
+        self.reward_bias = config.reward_bias
+        self.reward_scaling = config.reward_scaling
+        self.reward_clip = config.reward_clip
+        self.kl_ctl = config.kl_ctl
+        self.kl_estimator = KLEstimator(config.kl_estimator)
+        self.discount = config.discount
+        self.gae_lambda = config.gae_lambda
+        self.mask_no_eos_with_zero = config.mask_no_eos_with_zero
+        self.dynamic_sampling = config.dynamic_sampling
+        self.group_size = config.group_size
+
+        self.reward_norm = (
+            Normalization(
+                mean_level="group",
+                std_level="group",
+                group_size=config.group_size,
+            )
+            if config.group_reward_norm
+            else None
+        )
+        self.adv_norm = (
+            Normalization(
+                mean_level=config.adv_norm.mean_level,
+                std_level=config.adv_norm.std_level,
+                group_size=config.adv_norm.group_size,
+                eps=config.adv_norm.eps,
+            )
+            if config.adv_norm is not None
+            else None
+        )
+        # stable hook identity => jit cache hit in engine.forward
+        self._logp_hook = functools.partial(
+            _calc_logprobs, temperature=self.temperature
+        )
+        self._loss_fn = functools.partial(
+            grpo_loss_fn,
+            temperature=self.temperature,
+            eps_clip=config.eps_clip,
+            eps_clip_higher=config.eps_clip_higher,
+            c_clip=config.c_clip,
+            behav_imp_weight_cap=config.behav_imp_weight_cap,
+            entropy_coeff=config.entropy_coeff,
+            entropy_clamp=config.entropy_clamp,
+        )
+
+    def compute_logp(self, data: TensorDict) -> np.ndarray:
+        """Teacher-forced logprobs of the batch under current weights,
+        next-token convention (index t scores token t+1). Padded [B, S]."""
+        self.engine.train(False)
+        return self.engine.forward(input_=data, post_hook=self._logp_hook)
+
+    def compute_advantages(self, data: TensorDict) -> None:
+        """In-place advantage pipeline (reference actor.py:72-164)."""
+        cfg = self.config
+        input_ids = np.asarray(data["input_ids"])
+        bs, max_seqlen = input_ids.shape
+        batch_idx = np.arange(bs)
+
+        if cfg.overlong_reward_penalty:
+            data = reward_overlong_penalty(
+                data,
+                overlong_tokens=cfg.overlong_tokens,
+                overlong_penalty_factor=cfg.overlong_penalty_factor,
+                max_response_length=cfg.max_new_tokens,
+            )
+
+        reward_score = np.asarray(data["rewards"], dtype=np.float32)
+        reward_score = (reward_score + self.reward_bias) * self.reward_scaling
+        reward_score = np.clip(reward_score, -self.reward_clip, self.reward_clip)
+        if self.reward_norm is not None:
+            reward_score = self.reward_norm(reward_score)
+
+        loss_mask = np.asarray(data["loss_mask"], dtype=np.float32)
+        loss_mask = np.roll(loss_mask, shift=-1, axis=-1)
+
+        if not cfg.use_decoupled_loss and cfg.recompute_logprob:
+            # overwrite the inference engine's logprobs with the recomputed
+            # ones (already next-token aligned from compute_logp)
+            old_logp = data["logprobs"] = np.asarray(data["prox_logp"])
+        else:
+            old_logp = np.roll(np.asarray(data["logprobs"]), shift=-1, axis=-1)
+            if not cfg.use_decoupled_loss:
+                data["prox_logp"] = old_logp
+        ref_logp = np.asarray(
+            data.get("ref_logp", np.zeros_like(old_logp)), dtype=np.float32
+        )
+        ref_logp = ref_logp * loss_mask
+        old_logp = old_logp * loss_mask
+
+        attn_mask = np.asarray(data["attention_mask"])
+        seqlens = attn_mask.sum(-1).astype(np.int64)
+        seq_no_eos_mask = seqlens == attn_mask.shape[1]
+        rewards = -self.kl_ctl * self.kl_estimator(old_logp, ref_logp)
+        kl_rewards = rewards.copy()
+        # no KL reward at/after the final token; task reward lands on the
+        # second-to-last position (the one predicting EOS)
+        rewards[batch_idx, seqlens - 1] = 0
+        indices = np.clip(seqlens - 2, 0, None)
+        if self.mask_no_eos_with_zero:
+            rewards[batch_idx, indices] += np.where(seq_no_eos_mask, 0, reward_score)
+        else:
+            rewards[batch_idx, indices] += reward_score
+
+        values = np.asarray(
+            data.get("values", np.zeros_like(rewards)), dtype=np.float32
+        )
+        advantages = np.asarray(
+            gae_padded(
+                jnp.asarray(rewards, jnp.float32),
+                jnp.asarray(values, jnp.float32),
+                jnp.asarray(loss_mask, jnp.float32),
+                jnp.asarray(seq_no_eos_mask),
+                self.discount,
+                self.gae_lambda,
+            )
+        )
+        data["returns"] = advantages + values
+        if self.adv_norm is not None:
+            advantages = self.adv_norm(advantages, loss_mask)
+
+        data["advantages"] = advantages.astype(np.float32)
+        data["kl_rewards"] = kl_rewards.astype(np.float32)
+        data["tot_rewards"] = rewards.astype(np.float32)
+        data["loss_mask"] = loss_mask
+        data["logprobs"] = old_logp
+
+    def ppo_update(self, data: TensorDict) -> list[dict[str, float]]:
+        """Minibatched policy update (reference actor.py:166-275)."""
+        cfg = self.config
+        if self.dynamic_sampling and len(data["rewards"]) % self.group_size == 0:
+            data, _sampling_stat = dynamic_sampling(data, self.group_size)
+
+        attn_mask = np.asarray(data["attention_mask"])
+        loss_mask = np.asarray(data["loss_mask"])
+        reward_score = np.asarray(data["rewards"], dtype=np.float32)
+        seqlens = attn_mask.sum(-1)
+
+        tracker = stats_tracker.DEFAULT_TRACKER
+        tracker.denominator(
+            n_seqs=np.ones_like(reward_score, dtype=bool),
+            n_tokens=np.ones_like(loss_mask, dtype=bool),
+            n_valid_tokens=loss_mask.astype(bool),
+            correct_n_seqs=reward_score > 0,
+            incorrect_n_seqs=reward_score <= 0,
+        )
+        tracker.stat(
+            correct_seq_len=seqlens.astype(np.float32), denominator="correct_n_seqs"
+        )
+        tracker.stat(
+            incorrect_seq_len=seqlens.astype(np.float32),
+            denominator="incorrect_n_seqs",
+        )
+        tracker.stat(
+            advantages=np.asarray(data["advantages"]),
+            kl_rewards=np.asarray(data["kl_rewards"]),
+            final_reward=np.asarray(data["tot_rewards"]),
+            denominator="n_valid_tokens",
+        )
+        prompt_lens = attn_mask.sum(-1) - loss_mask.sum(-1)
+        tracker.stat(
+            no_eos_ratios=(seqlens == attn_mask.shape[-1]).astype(np.float32),
+            task_reward=reward_score,
+            prompt_len=prompt_lens.astype(np.float32),
+            seq_len=seqlens.astype(np.float32),
+            denominator="n_seqs",
+        )
+        global_stats = tracker.export()
+
+        data = dict(data)
+        for key in ["rewards", "tot_rewards", "kl_rewards", "versions"]:
+            data.pop(key, None)
+
+        self.engine.train()
+        mb_inputs = split_padded_tensor_dict_into_mb_list(
+            data,
+            max_tokens_per_mb=1 << 30,
+            min_n_mbs=cfg.ppo_n_minibatches,
+        )
+        all_stats = []
+        for mb in mb_inputs.mbs:
+            train_stat = self.engine.train_batch(
+                mb,
+                loss_fn=self._loss_fn,
+                loss_weight_fn=lambda x: np.asarray(x["loss_mask"]).sum(),
+            )
+            tracker.scalar(**train_stat)
+            all_stats.append(tracker.export())
+        all_stats[0].update(global_stats)
+        return all_stats
+
+
+# TPU engine-fused variant, mirroring the reference's FSDPPPOActor
+# (actor.py:278): the engine IS the actor.
+class TPUPPOActor(TPUTrainEngine):
+    def __init__(self, config: PPOActorConfig):
+        super().__init__(config)
+        self.actor = PPOActor(config, self)
+
+    def compute_logp(self, *args, **kwargs):
+        return self.actor.compute_logp(*args, **kwargs)
+
+    def compute_advantages(self, *args, **kwargs):
+        return self.actor.compute_advantages(*args, **kwargs)
+
+    def ppo_update(self, *args, **kwargs):
+        return self.actor.ppo_update(*args, **kwargs)
+
+
+def _calc_logprobs(logits, input_data, temperature: float = 1.0):
+    labels = jnp.roll(input_data["input_ids"], shift=-1)
+    return gather_logprobs(logits, labels, temperature)
+
+
+def grpo_loss_fn(
+    logits: jnp.ndarray,
+    input_data: dict[str, Any],
+    temperature: float,
+    eps_clip: float,
+    eps_clip_higher: float | None,
+    c_clip: float | None,
+    behav_imp_weight_cap: float | None,
+    entropy_coeff: float = 0.0,
+    entropy_clamp: float | None = None,
+):
+    """Packed decoupled-PPO loss, SUM-reduced over valid tokens (the engine
+    divides by the global token count). Reference: actor.py:313-391; the
+    entropy bonus is the AEnt recipe extension (recipe/AEnt/functional.py)."""
+    labels = jnp.roll(input_data["input_ids"], shift=-1)
+    old_logp = input_data["logprobs"]
+    advantages = input_data["advantages"]
+    loss_mask = input_data["loss_mask"]
+    prox_logp = input_data["prox_logp"]
+
+    logprobs, entropy = gather_logprobs_entropy(logits, labels, temperature)
+    loss, _stat = ppo_actor_loss_fn(
+        logprobs=logprobs,
+        proximal_logprobs=prox_logp,
+        old_logprobs=old_logp,
+        advantages=advantages,
+        eps_clip=eps_clip,
+        loss_mask=loss_mask,
+        eps_clip_higher=eps_clip_higher,
+        c_clip=c_clip,
+        behav_imp_weight_cap=behav_imp_weight_cap,
+    )
+    mask = loss_mask.astype(bool)
+    count = jnp.maximum(jnp.sum(mask), 1)
+    if entropy_coeff != 0.0:
+        ent = entropy
+        if entropy_clamp is not None:
+            ent = jnp.minimum(ent, entropy_clamp)
+        ent_bonus = jnp.sum(jnp.where(mask, ent, 0.0)) / count
+        loss = loss - entropy_coeff * ent_bonus
+    return loss * count

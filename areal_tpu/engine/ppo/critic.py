@@ -1,0 +1,95 @@
+"""PPO critic: value scoring + clipped value-loss update.
+
+Behavior parity with the reference's ``areal/engine/ppo/critic.py``
+(PPOCritic/FSDPPPOCritic). The critic model is the same decoder with a
+scalar value head (TransformerConfig.is_critic=True -> forward_packed
+returns values [T]).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import PPOCriticConfig
+from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.utils.data import TensorDict, split_padded_tensor_dict_into_mb_list
+from areal_tpu.utils.functional import ppo_critic_loss_fn
+
+
+class PPOCritic:
+    def __init__(self, config: PPOCriticConfig, engine: TPUTrainEngine):
+        self.config = config
+        self.engine = engine
+        self._loss_fn = functools.partial(
+            critic_loss_fn,
+            value_eps_clip=config.value_eps_clip,
+            loss_fn_type=config.value_loss_type,
+            huber_delta=config.huber_delta,
+        )
+
+    def compute_values(self, data: TensorDict) -> np.ndarray:
+        """Value of every token position, padded [B, S]."""
+        self.engine.train(False)
+        return self.engine.forward(input_=data, post_hook=_take_values)
+
+    def ppo_update(self, data: TensorDict) -> list[dict[str, float]]:
+        data = dict(data)
+        for key in ["rewards", "tot_rewards", "kl_rewards", "versions"]:
+            data.pop(key, None)
+        self.engine.train()
+        mb_inputs = split_padded_tensor_dict_into_mb_list(
+            data,
+            max_tokens_per_mb=1 << 30,
+            min_n_mbs=self.config.ppo_n_minibatches,
+        )
+        all_stats = []
+        for mb in mb_inputs.mbs:
+            stat = self.engine.train_batch(
+                mb,
+                loss_fn=self._loss_fn,
+                loss_weight_fn=lambda x: np.asarray(x["loss_mask"]).sum(),
+            )
+            all_stats.append(stat)
+        return all_stats
+
+
+class TPUPPOCritic(TPUTrainEngine):
+    """Engine-fused critic (reference FSDPPPOCritic pattern)."""
+
+    def __init__(self, config: PPOCriticConfig):
+        super().__init__(config)
+        self.critic = PPOCritic(config, self)
+
+    def compute_values(self, *args, **kwargs):
+        return self.critic.compute_values(*args, **kwargs)
+
+    def ppo_update(self, *args, **kwargs):
+        return self.critic.ppo_update(*args, **kwargs)
+
+
+def _take_values(values, input_data):
+    return values
+
+
+def critic_loss_fn(
+    values: jnp.ndarray,
+    input_data,
+    value_eps_clip: float,
+    loss_fn_type: str,
+    huber_delta: float,
+):
+    """SUM-reduced clipped value loss over valid tokens."""
+    loss, _ = ppo_critic_loss_fn(
+        value=values,
+        old_value=input_data["values"],
+        target_value=input_data["returns"],
+        value_eps_clip=value_eps_clip,
+        loss_mask=input_data["loss_mask"],
+        loss_fn_type=loss_fn_type,
+        huber_delta=huber_delta,
+    )
+    count = jnp.maximum(jnp.sum(input_data["loss_mask"].astype(bool)), 1)
+    return loss * count

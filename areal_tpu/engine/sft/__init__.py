@@ -1,0 +1,3 @@
+from areal_tpu.engine.sft.lm_engine import LMEngine, TPULMEngine
+
+__all__ = ["LMEngine", "TPULMEngine"]

@@ -1,0 +1,66 @@
+"""SFT language-model engine: packed next-token cross-entropy.
+
+Behavior parity with the reference's ``areal/engine/sft/lm_engine.py``
+(FSDPLMEngine.train_lm/evaluate_lm): loss is the mean NLL over loss-masked
+tokens, globally normalized across microbatches by the engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import TrainEngineConfig
+from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.utils.data import TensorDict
+from areal_tpu.utils.functional import gather_logprobs
+
+
+def sft_loss_fn(logits: jnp.ndarray, input_data) -> jnp.ndarray:
+    """SUM-reduced masked NLL (engine divides by global mask count).
+
+    ``loss_mask[t] == 1`` marks token t as a TARGET; both labels and mask
+    roll by -1 to the next-token convention, so position t scores token t+1
+    and sequence-boundary positions in the packed stream drop out (their
+    rolled mask is the next sequence's first-token mask, always 0)."""
+    labels = jnp.roll(input_data["input_ids"], shift=-1)
+    logp = gather_logprobs(logits, labels)
+    mask = jnp.roll(input_data["loss_mask"], shift=-1).astype(bool)
+    return -jnp.sum(jnp.where(mask, logp, 0.0))
+
+
+def _loss_weight(mb) -> float:
+    return float(np.asarray(mb["loss_mask"]).sum())
+
+
+class LMEngine:
+    """Algorithm wrapper (reference lm_engine.py pattern)."""
+
+    def __init__(self, engine: TPUTrainEngine):
+        self.engine = engine
+
+    def train_lm(self, data: TensorDict) -> dict[str, float]:
+        self.engine.train()
+        return self.engine.train_batch(
+            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight
+        )
+
+    def evaluate_lm(self, data: TensorDict) -> float | None:
+        self.engine.train(False)
+        return self.engine.eval_batch(
+            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight
+        )
+
+
+class TPULMEngine(TPUTrainEngine):
+    """Engine-fused variant (reference FSDPLMEngine pattern)."""
+
+    def __init__(self, config: TrainEngineConfig):
+        super().__init__(config)
+        self.lm = LMEngine(self)
+
+    def train_lm(self, data: TensorDict) -> dict[str, float]:
+        return self.lm.train_lm(data)
+
+    def evaluate_lm(self, data: TensorDict) -> float | None:
+        return self.lm.evaluate_lm(data)

@@ -226,9 +226,10 @@ def split_padded_tensor_dict_into_mb_list(
     (reference: areal/utils/data.py:404)."""
     lens = seqlens_of(data)
     bins = datapack.ffd_allocate(lens, max_tokens_per_mb, min_groups=min_n_mbs)
-    if min_n_mbs <= 1:
-        # drop empty bins when the caller doesn't need a fixed mb count
-        bins = [b for b in bins if b] or [[]]
+    # drop empty bins: an empty microbatch has zero loss weight and would
+    # poison the global normalizer (min_n_mbs is a target, not a guarantee —
+    # a batch smaller than min_n_mbs yields fewer microbatches)
+    bins = [b for b in bins if b] or [[]]
     mbs = []
     group_lens = []
     for b in bins:

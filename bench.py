@@ -1,0 +1,108 @@
+"""Benchmark: SFT training throughput (tokens/sec/chip) on a Qwen2-1.5B-shaped
+dense decoder — the reference quickstart model family (examples/math GSM8K
+configs). Prints ONE JSON line.
+
+vs_baseline derivation: the reference trains on H800 GPUs; a well-tuned dense
+1.5B Megatron/FSDP trainer reaches ~40% MFU of H800's ~495 TFLOP/s dense bf16
+=> 0.4*495e12 / (6*1.5e9) ~= 22,000 tokens/s/GPU. vs_baseline is measured
+tokens/s/chip divided by that hardware-normalized reference estimate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 22000.0
+
+
+def make_cfg(layers: int):
+    from areal_tpu.models.config import TransformerConfig
+
+    return TransformerConfig(
+        arch="qwen2",
+        vocab_size=151936,
+        hidden_size=1536,
+        intermediate_size=8960,
+        num_hidden_layers=layers,
+        num_attention_heads=12,
+        num_key_value_heads=2,
+        head_dim=128,
+        rope_theta=1e6,
+        attention_bias=True,
+        tie_word_embeddings=True,
+    )
+
+
+def run(layers: int, seqlen: int = 2048, n_seqs: int = 4):
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+
+    cfg = TrainEngineConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-4),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=n_seqs * seqlen),
+    )
+    cfg.backend.remat = True
+    cfg.backend.pad_mb_to_multiple = 512
+    engine = TPULMEngine(cfg)
+    engine.initialize(None, None, model_config=make_cfg(layers))
+
+    rng = np.random.default_rng(0)
+    data = dict(
+        input_ids=rng.integers(1, 150000, size=(n_seqs, seqlen)).astype(np.int32),
+        attention_mask=np.ones((n_seqs, seqlen), np.int32),
+        loss_mask=np.ones((n_seqs, seqlen), np.int32),
+    )
+    data["loss_mask"][:, 0] = 0
+
+    for _ in range(2):  # warmup + compile
+        engine.train_lm(data)
+    n_steps = 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        stats = engine.train_lm(data)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(stats["loss"])
+    return n_seqs * seqlen * n_steps / dt
+
+
+def main():
+    tps, layers_used = None, None
+    for layers in (28, 14, 8):
+        try:
+            tps = run(layers)
+            layers_used = layers
+            break
+        except Exception as e:  # OOM on small chips -> shrink depth
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg.lower():
+                raise
+    if tps is None:
+        raise RuntimeError("benchmark failed at all model sizes")
+    # normalize to the full 28-layer model's per-token cost if we had to shrink
+    scale = layers_used / 28.0
+    eff_tps = tps * scale
+    print(
+        json.dumps(
+            {
+                "metric": "sft_train_tokens_per_sec_per_chip_qwen2_1.5b",
+                "value": round(eff_tps, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(eff_tps / BASELINE_TOKENS_PER_SEC, 3),
+                "layers_used": layers_used,
+                "raw_tokens_per_sec": round(tps, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""WorkflowExecutor + StalenessManager: capacity math, submit/wait ordering,
+staleness gating, pause/resume, error propagation (modeled on the reference's
+test_staleness_manager.py and workflow executor behavior)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.staleness_manager import StalenessManager
+from areal_tpu.core.workflow_executor import WorkflowExecutor, check_trajectory_format
+
+
+class FakeInferenceEngine:
+    def __init__(self):
+        self.version = 0
+
+    def get_version(self):
+        return self.version
+
+
+class EchoWorkflow(RolloutWorkflow):
+    """Returns a 1-row trajectory tagged with the submitted value."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    async def arun_episode(self, engine, data):
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        v = int(data["x"])
+        return dict(
+            input_ids=np.full((1, 4), v, dtype=np.int32),
+            attention_mask=np.ones((1, 4), dtype=np.int32),
+        )
+
+
+class NoneWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        return None
+
+
+class BoomWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        raise ValueError("boom")
+
+
+def _executor(max_concurrent=4, batch_size=2, staleness=10):
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=max_concurrent,
+        consumer_batch_size=batch_size,
+        max_head_offpolicyness=staleness,
+    )
+    ex = WorkflowExecutor(cfg, FakeInferenceEngine())
+    ex.initialize()
+    return ex
+
+
+def test_staleness_capacity_formula():
+    m = StalenessManager(
+        max_concurrent_rollouts=8, consumer_batch_size=4, max_staleness=1
+    )
+    # version 0: (1+0+1)*4 = 8 samples allowed; nothing running
+    assert m.get_capacity(0) == 8
+    for _ in range(8):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    for _ in range(8):
+        m.on_rollout_accepted()
+    # accepted=8 -> staleness cap exhausted at v0, replenished at v1
+    assert m.get_capacity(0) == 0
+    assert m.get_capacity(1) == 4
+    # rejected rollouts free capacity entirely
+    m.on_rollout_submitted()
+    m.on_rollout_rejected()
+    assert m.get_capacity(1) == 4
+
+
+def test_rollout_batch_roundtrip():
+    ex = _executor()
+    try:
+        out = ex.rollout_batch([{"x": i} for i in range(4)], workflow=EchoWorkflow())
+        assert out["input_ids"].shape == (4, 4)
+        # every submitted value came back exactly once (order may shuffle)
+        vals = sorted(out["input_ids"][:, 0].tolist())
+        assert vals == [0, 1, 2, 3]
+    finally:
+        ex.destroy()
+
+
+def test_should_accept_filter_and_none_drop():
+    ex = _executor(max_concurrent=8, batch_size=8)
+    try:
+        # None trajectories are rejected and never reach the output queue
+        for i in range(2):
+            ex.submit({"x": i}, workflow=NoneWorkflow())
+        ex.submit({"x": 7}, workflow=EchoWorkflow())
+        out = ex.wait(1, timeout=10)
+        assert out["input_ids"][0, 0] == 7
+        # should_accept filtering
+        ex.submit({"x": 1}, workflow=EchoWorkflow(),
+                  should_accept=lambda t: False)
+        ex.submit({"x": 2}, workflow=EchoWorkflow(),
+                  should_accept=lambda t: True)
+        out = ex.wait(1, timeout=10)
+        assert out["input_ids"][0, 0] == 2
+    finally:
+        ex.destroy()
+
+
+def test_staleness_blocks_submission_until_version_bump():
+    eng = FakeInferenceEngine()
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=16,
+        consumer_batch_size=2,
+        max_head_offpolicyness=0,
+    )
+    ex = WorkflowExecutor(cfg, eng)
+    ex.initialize()
+    try:
+        # staleness=0, version=0 -> only 1*2 = 2 episodes may start
+        for i in range(4):
+            ex.submit({"x": i}, workflow=EchoWorkflow())
+        out = ex.wait(2, timeout=10)
+        assert out["input_ids"].shape[0] == 2
+        time.sleep(0.3)
+        assert ex.output_queue.qsize() == 0  # episodes 3/4 still gated
+        eng.version = 1  # weight update unlocks the next batch worth
+        out = ex.wait(2, timeout=10)
+        assert out["input_ids"].shape[0] == 2
+    finally:
+        ex.destroy()
+
+
+def test_workflow_error_propagates():
+    ex = _executor()
+    try:
+        ex.submit({"x": 0}, workflow=BoomWorkflow())
+        with pytest.raises(RuntimeError, match="Rollout thread died"):
+            ex.wait(1, timeout=10)
+    finally:
+        ex.destroy()
+
+
+def test_pause_resume():
+    ex = _executor(max_concurrent=8, batch_size=8)
+    try:
+        ex.pause()
+        ex.submit({"x": 5}, workflow=EchoWorkflow())
+        time.sleep(0.3)
+        assert ex.output_queue.qsize() == 0
+        ex.resume()
+        out = ex.wait(1, timeout=10)
+        assert out["input_ids"][0, 0] == 5
+    finally:
+        ex.destroy()
+
+
+def test_check_trajectory_format():
+    good = dict(
+        input_ids=np.zeros((2, 3), np.int32),
+        attention_mask=np.ones((2, 3), np.int32),
+    )
+    assert check_trajectory_format(good)
+    with pytest.raises(ValueError, match="missing required"):
+        check_trajectory_format({"input_ids": np.zeros((1, 2))})
+    bad = dict(
+        input_ids=np.zeros((2, 3), np.int32),
+        attention_mask=np.full((2, 3), 2, np.int32),
+    )
+    with pytest.raises(ValueError, match="0/1"):
+        check_trajectory_format(bad)
+    mismatched = dict(
+        input_ids=np.zeros((2, 3), np.int32),
+        attention_mask=np.ones((3, 3), np.int32),
+    )
+    with pytest.raises(ValueError):
+        check_trajectory_format(mismatched)
