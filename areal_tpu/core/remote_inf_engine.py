@@ -1,0 +1,320 @@
+"""HTTP client to one or more generation servers, with interruptible
+generation and weight-update fan-out.
+
+Behavior parity with the reference's backend-agnostic remote engine
+(areal/core/remote_inf_engine.py:39,189):
+
+- server discovery via ``AREAL_LLM_SERVER_ADDRS`` env or name_resolve
+  (``initialize``), with a setup-timeout wait loop;
+- round-robin server choice with an rid→server affinity cache so resumed
+  requests land on the server holding their KV (remote_inf_engine.py:334-408);
+- the **interrupt loop** (remote_inf_engine.py:424-474): when a server aborts
+  a request mid-generation (weight update), the client waits out the pause,
+  then re-issues the request with the accumulated tokens as the new prompt —
+  output tokens carry per-token weight versions across the splice;
+- weight-update fan-out to every server (pause → update → continue), with the
+  disk path stamping a name_resolve key to measure update latency
+  (remote_inf_engine.py:762-810);
+- rollout-runtime delegation: submit/wait/rollout_batch/prepare_batch run on
+  the embedded :class:`WorkflowExecutor`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Callable
+
+import aiohttp
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse, WeightUpdateMeta
+from areal_tpu.core.workflow_executor import WorkflowExecutor
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry
+
+logger = logging.getLogger("RemoteInfEngine")
+
+RID_CACHE_SIZE = 128
+
+
+class RemoteInfEngine(InferenceEngine):
+    """Client to the TPU generation servers (the reference's
+    RemoteSGLangEngine/RemotevLLMEngine equivalent — one class, since our
+    server protocol is in-repo)."""
+
+    def __init__(self, config: InferenceEngineConfig):
+        self.config = config
+        self.addresses: list[str] = []
+        self._server_idx = 0
+        self._rid_to_address: dict[str, str] = {}
+        self._rid_queue: list[str] = []
+        self._version = 0
+        self._paused = threading.Event()
+        self.executor = WorkflowExecutor(config, self)
+        # one ClientSession per event loop (the rollout thread's loop is the
+        # long-lived one; keepalive pooling matters there)
+        self._sessions: dict[int, tuple[asyncio.AbstractEventLoop, aiohttp.ClientSession]] = {}
+
+    # ------------------------------------------------------------------
+    # lifecycle / discovery
+    # ------------------------------------------------------------------
+
+    def initialize(self, addr: str | list[str] | None = None, train_data_parallel_size: int | None = None):
+        if addr:
+            self.addresses = [addr] if isinstance(addr, str) else list(addr)
+        elif os.environ.get("AREAL_LLM_SERVER_ADDRS"):
+            self.addresses = os.environ["AREAL_LLM_SERVER_ADDRS"].split(",")
+        else:
+            self.addresses = self._discover_servers()
+        if not self.addresses:
+            raise RuntimeError("no generation servers found")
+        logger.info("RemoteInfEngine using servers: %s", self.addresses)
+        self.executor.initialize(train_data_parallel_size)
+
+    def _discover_servers(self) -> list[str]:
+        key = names.gen_servers(self.config.experiment_name, self.config.trial_name)
+        deadline = time.monotonic() + self.config.setup_timeout
+        while time.monotonic() < deadline:
+            addrs = name_resolve.get_subtree(key)
+            if addrs:
+                return sorted(addrs)
+            time.sleep(1.0)
+        raise TimeoutError(
+            f"no generation servers registered under {key} within "
+            f"{self.config.setup_timeout}s"
+        )
+
+    def destroy(self):
+        for loop, session in list(self._sessions.values()):
+            if loop.is_running():
+                try:
+                    asyncio.run_coroutine_threadsafe(session.close(), loop).result(5)
+                except Exception:
+                    pass
+        self._sessions.clear()
+        self.executor.destroy()
+
+    # ------------------------------------------------------------------
+    # server selection
+    # ------------------------------------------------------------------
+
+    def choose_server(self, rid: str | None = None) -> str:
+        if self.config.schedule_policy != "round_robin":
+            raise NotImplementedError(self.config.schedule_policy)
+        if rid is not None and rid in self._rid_to_address:
+            return self._rid_to_address[rid]
+        addr = self.addresses[self._server_idx % len(self.addresses)]
+        self._server_idx += 1
+        if rid is not None:
+            if len(self._rid_queue) >= RID_CACHE_SIZE:
+                old = self._rid_queue.pop(0)
+                self._rid_to_address.pop(old, None)
+            self._rid_to_address[rid] = addr
+            self._rid_queue.append(rid)
+        return addr
+
+    # ------------------------------------------------------------------
+    # generation (interrupt loop)
+    # ------------------------------------------------------------------
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        """Generate with abort-resume splicing across weight updates."""
+        addr = self.choose_server(req.rid)
+        gconfig = req.gconfig
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "RemoteInfEngine.agenerate expects n_samples=1; "
+                "fan out in the workflow (reference rlvr.py does the same)"
+            )
+        prompt = list(req.input_ids)
+        accumulated: list[int] = []
+        logprobs: list[float] = []
+        versions: list[int] = []
+        stop_reason = "abort"
+        t_start = time.monotonic()
+        ttft = 0.0
+        itl: list[float] = []
+        session = await self._get_session()
+        max_new = gconfig.max_new_tokens
+        while stop_reason == "abort" and len(accumulated) < max_new:
+            while self._paused.is_set():
+                await asyncio.sleep(0.05)
+            payload = {
+                "rid": req.rid,
+                "input_ids": prompt + accumulated,
+                "sampling_params": {
+                    "max_new_tokens": max_new - len(accumulated),
+                    "min_new_tokens": max(
+                        0, gconfig.min_new_tokens - len(accumulated)
+                    ),
+                    "greedy": gconfig.greedy,
+                    "temperature": gconfig.temperature,
+                    "top_p": gconfig.top_p,
+                    "top_k": gconfig.top_k,
+                    "stop_token_ids": gconfig.stop_token_ids,
+                },
+            }
+            result = await arequest_with_retry(
+                session,
+                f"http://{addr}/generate",
+                payload=payload,
+                max_retries=self.config.request_retries,
+                timeout=self.config.request_timeout,
+            )
+            if not accumulated:
+                ttft = time.monotonic() - t_start
+            accumulated += result["output_tokens"]
+            logprobs += result["output_logprobs"]
+            versions += result["output_versions"]
+            itl += result.get("itl", [])
+            stop_reason = result["stop_reason"]
+        return ModelResponse(
+            input_tokens=prompt,
+            output_tokens=accumulated,
+            output_logprobs=logprobs,
+            output_versions=versions,
+            stop_reason=stop_reason,
+            latency=time.monotonic() - t_start,
+            ttft=ttft,
+            itl=itl,
+            tokenizer=req.tokenizer,
+        )
+
+    def generate(self, req: ModelRequest) -> ModelResponse:
+        async def _go():
+            try:
+                return await self.agenerate(req)
+            finally:
+                await self._close_session_for_current_loop()
+
+        return asyncio.run(_go())
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        loop = asyncio.get_running_loop()
+        entry = self._sessions.get(id(loop))
+        if entry is None or entry[1].closed:
+            entry = (loop, aiohttp.ClientSession())
+            self._sessions[id(loop)] = entry
+        return entry[1]
+
+    async def _close_session_for_current_loop(self):
+        loop = asyncio.get_running_loop()
+        entry = self._sessions.pop(id(loop), None)
+        if entry is not None:
+            await entry[1].close()
+
+    # ------------------------------------------------------------------
+    # weight updates
+    # ------------------------------------------------------------------
+
+    def update_weights(self, meta: WeightUpdateMeta):
+        """Fan the update out to every server. Caller (train engine) has
+        already written the checkpoint for the disk path."""
+        if meta.type != "disk":
+            raise NotImplementedError(
+                f"weight update type {meta.type!r}; device path is driven by "
+                "the train engine (colocated) — see TPUTrainEngine.update_weights"
+            )
+        next_version = self._version + 1
+        save_ts = time.time_ns()
+
+        async def _update():
+            session = aiohttp.ClientSession()
+            try:
+                await asyncio.gather(
+                    *[
+                        arequest_with_retry(
+                            session,
+                            f"http://{a}/update_weights_from_disk",
+                            payload={
+                                "model_path": meta.path,
+                                "version": next_version,
+                            },
+                            max_retries=self.config.request_retries,
+                            timeout=self.config.request_timeout,
+                        )
+                        for a in self.addresses
+                    ]
+                )
+            finally:
+                await session.close()
+
+        asyncio.run(_update())
+        load_ts = time.time_ns()
+        try:
+            name_resolve.add(
+                names.update_weights_from_disk(
+                    self.config.experiment_name,
+                    self.config.trial_name,
+                    next_version,
+                ),
+                str(save_ts),
+                replace=True,
+            )
+        except Exception:
+            logger.debug("name_resolve unavailable for update latency key")
+        logger.info(
+            "weight update v%d fanned out to %d servers in %.2fs",
+            next_version,
+            len(self.addresses),
+            (load_ts - save_ts) / 1e9,
+        )
+        self.set_version(next_version)
+
+    def pause(self):
+        """Pause servers + the local rollout runtime (weight-update fence)."""
+        self._paused.set()
+        self._fanout("pause_generation")
+        self.executor.pause()
+
+    def resume(self):
+        self._fanout("continue_generation")
+        self._paused.clear()
+        self.executor.resume()
+
+    def _fanout(self, endpoint: str):
+        async def _go():
+            session = aiohttp.ClientSession()
+            try:
+                await asyncio.gather(
+                    *[
+                        arequest_with_retry(
+                            session,
+                            f"http://{a}/{endpoint}",
+                            payload={},
+                            max_retries=self.config.request_retries,
+                            timeout=60.0,
+                        )
+                        for a in self.addresses
+                    ]
+                )
+            finally:
+                await session.close()
+
+        asyncio.run(_go())
+
+    # ------------------------------------------------------------------
+    # version + rollout-runtime delegation
+    # ------------------------------------------------------------------
+
+    def get_version(self) -> int:
+        return self._version
+
+    def set_version(self, version: int):
+        self._version = version
+
+    def submit(self, data, workflow=None, workflow_builder: Callable | None = None):
+        self.executor.submit(data, workflow, workflow_builder)
+
+    def wait(self, count: int, timeout: float | None = None):
+        return self.executor.wait(count, timeout=timeout)
+
+    def rollout_batch(self, data: list[Any], workflow=None, workflow_builder=None):
+        return self.executor.rollout_batch(data, workflow, workflow_builder)
+
+    def prepare_batch(self, dataloader, workflow=None, workflow_builder=None):
+        return self.executor.prepare_batch(dataloader, workflow, workflow_builder)

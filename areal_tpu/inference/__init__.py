@@ -1,0 +1,10 @@
+"""TPU-native continuous-batching generation service.
+
+Replaces the reference's SGLang/vLLM servers + the 538-line SGLang patch
+(patch/sglang/v0.5.2.patch, SURVEY §2.1): a JetStream-style JAX inference
+engine with slot-based continuous batching, interruptible generation
+(abort + client re-issue), per-token weight-version tagging, and in-place
+weight refresh from disk.
+"""
+
+from areal_tpu.inference.engine import GenerationEngine  # noqa: F401

@@ -1,0 +1,599 @@
+"""Slot-based continuous-batching generation engine.
+
+This is the TPU-native replacement for the SGLang/vLLM server internals the
+reference leans on (patch/sglang/v0.5.2.patch + areal/launcher/sglang_server.py,
+SURVEY §2.1, §7 step 4). Capabilities:
+
+- **Continuous batching**: a fixed pool of ``max_batch_size`` KV-cache slots;
+  finished requests free their slot and queued requests are admitted without
+  draining the batch. All jitted shapes are static (TPU/XLA requirement);
+  prompt lengths round up to buckets, decode runs ``decode_steps_per_call``
+  tokens per dispatch for all slots at once.
+- **Interruptible generation** (reference remote_inf_engine.py:424-474 server
+  side): ``pause()`` aborts every in-flight request, returning partial output
+  with ``stop_reason="abort"``; the client re-issues with accumulated tokens.
+- **In-place weight refresh**: ``update_weights_from_disk`` loads a safetensors
+  checkpoint into the live sharded params between decode dispatches and bumps
+  the engine version; every generated token is tagged with the version that
+  produced it (ModelResponse.output_versions).
+- **TP sharding**: params/caches laid out on a ("pp","dp","cp","tp") mesh with
+  ``tp_size`` devices on the tp axis; GSPMD inserts the collectives.
+
+Host-side state (slot table, per-request accumulators) is plain numpy; device
+state is (params, kv_cache) only — both donated through the jitted steps so
+HBM holds exactly one copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.models import hf_io
+from areal_tpu.models.config import TransformerConfig, from_hf_config
+from areal_tpu.models.lm import decode_step, init_kv_cache, init_params, prefill
+from areal_tpu.inference.sampling import sample_tokens
+from areal_tpu.parallel.mesh import MESH_AXES, AXIS_TP
+from areal_tpu.parallel.sharding import param_shardings
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("GenerationEngine")
+
+_PAD = 0
+
+
+@dataclasses.dataclass
+class _Seq:
+    """One in-flight request bound to a cache slot."""
+
+    rid: str
+    prompt: list[int]
+    gconfig: GenerationHyperparameters
+    on_done: Callable[[ModelResponse], None]
+    slot: int = -1
+    out_tokens: list[int] = dataclasses.field(default_factory=list)
+    out_logprobs: list[float] = dataclasses.field(default_factory=list)
+    out_versions: list[int] = dataclasses.field(default_factory=list)
+    t_submit: float = dataclasses.field(default_factory=time.monotonic)
+    t_first_token: float | None = None
+    t_last_token: float | None = None
+    itl: list[float] = dataclasses.field(default_factory=list)
+    aborted: bool = False
+
+    @property
+    def max_total(self) -> int:
+        return len(self.prompt) + self.gconfig.max_new_tokens
+
+    def stop_ids(self, eos_token_id: int | None) -> set[int]:
+        s = set(self.gconfig.stop_token_ids)
+        if eos_token_id is not None:
+            s.add(eos_token_id)
+        return s
+
+
+class GenerationEngine:
+    """In-process generation engine; the HTTP server and colocated rollout
+    engines both drive this object."""
+
+    def __init__(
+        self,
+        config: JaxGenConfig,
+        model_config: TransformerConfig | None = None,
+        params: Any | None = None,
+        tokenizer: Any | None = None,
+        devices: list | None = None,
+    ):
+        self.config = config
+        self.tokenizer = tokenizer
+        devices = devices if devices is not None else jax.devices()
+        tp = config.tp_size
+        if len(devices) < tp:
+            raise ValueError(f"tp_size={tp} but only {len(devices)} devices")
+        self.mesh = jax.sharding.Mesh(
+            np.asarray(devices[:tp]).reshape(1, 1, 1, tp), MESH_AXES
+        )
+
+        if model_config is None:
+            if not config.model_path:
+                raise ValueError("need model_config or config.model_path")
+            model_config = from_hf_config(config.model_path)
+        self.model_config = model_config
+        self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+        shape_tree = jax.eval_shape(
+            lambda: init_params(model_config, jax.random.PRNGKey(0), self.dtype)
+        )
+        self._shardings = param_shardings(self.mesh, shape_tree, fsdp=False)
+        if params is not None:
+            self.params = jax.device_put(params, self._shardings)
+        elif config.model_path:
+            self.params = self._load_params_from(config.model_path)
+        else:
+            with jax.default_device(devices[0]):
+                raw = init_params(
+                    model_config, jax.random.PRNGKey(config.random_seed), self.dtype
+                )
+            self.params = jax.device_put(raw, self._shardings)
+
+        b, s = config.max_batch_size, config.max_seq_len
+        cache = init_kv_cache(model_config, b, s, self.dtype)
+        kh_div = model_config.num_key_value_heads % tp == 0
+        cache_spec = jax.sharding.PartitionSpec(
+            None, None, None, AXIS_TP if kh_div else None, None
+        )
+        self._cache_sharding = jax.sharding.NamedSharding(self.mesh, cache_spec)
+        self.cache = jax.device_put(
+            cache, {"k": self._cache_sharding, "v": self._cache_sharding}
+        )
+
+        self._rng_base = jax.random.PRNGKey(config.random_seed)
+        self._rng_counter = 0
+
+        # host slot table
+        self.cache_len = np.zeros(b, np.int32)
+        self.slots: list[_Seq | None] = [None] * b
+        self.last_token = np.zeros(b, np.int32)
+        self.version = 0
+
+        # control plane
+        self._input_queue: queue.Queue[_Seq] = queue.Queue()
+        self._cmd_queue: queue.Queue = queue.Queue()
+        self._paused = threading.Event()
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._abort_rids: set[str] = set()
+        self._lock = threading.Lock()
+
+        self._jit_prefill = jax.jit(
+            functools.partial(self._prefill_impl),
+            donate_argnums=(1,),
+            static_argnames=("use_top_k", "use_top_p"),
+        )
+        self._jit_decode = jax.jit(
+            functools.partial(self._decode_impl),
+            donate_argnums=(1,),
+            static_argnames=("steps", "use_top_k", "use_top_p"),
+        )
+
+    # ------------------------------------------------------------------
+    # Device steps
+    # ------------------------------------------------------------------
+
+    def _prefill_impl(
+        self,
+        params,
+        cache,
+        ids,  # [Tp]
+        length,  # scalar
+        slot,  # scalar
+        rng,
+        temp,
+        top_k,
+        top_p,
+        greedy,
+        use_top_k: bool,
+        use_top_p: bool,
+    ):
+        logits, ks, vs = prefill(params, self.model_config, ids, length)
+        tok, logp = sample_tokens(
+            logits[None],
+            rng,
+            temp[None],
+            top_k[None],
+            top_p[None],
+            greedy[None],
+            use_top_k=use_top_k,
+            use_top_p=use_top_p,
+        )
+        # write [L, Tp, KH, D] into cache [L, B, S, KH, D] at (0, slot, 0, 0, 0)
+        k_new = ks[:, None]  # [L, 1, Tp, KH, D]
+        v_new = vs[:, None]
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.astype(cache["k"].dtype), (0, slot, 0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.astype(cache["v"].dtype), (0, slot, 0, 0, 0)
+        )
+        return tok[0], logp[0], {"k": k_cache, "v": v_cache}
+
+    def _decode_impl(
+        self,
+        params,
+        cache,
+        last_tokens,  # [B]
+        cache_len,  # [B]
+        active,  # [B] bool
+        rng,
+        temp,
+        top_k,
+        top_p,
+        greedy,
+        steps: int,
+        use_top_k: bool,
+        use_top_p: bool,
+    ):
+        def step(carry, step_rng):
+            tokens, cache, clen = carry
+            logits, cache = decode_step(
+                params, self.model_config, cache, tokens[:, None], clen
+            )
+            nxt, logp = sample_tokens(
+                logits[:, 0],
+                step_rng,
+                temp,
+                top_k,
+                top_p,
+                greedy,
+                use_top_k=use_top_k,
+                use_top_p=use_top_p,
+            )
+            nxt = jnp.where(active, nxt, tokens)
+            clen = clen + active.astype(jnp.int32)
+            return (nxt, cache, clen), (nxt, logp)
+
+        rngs = jax.random.split(rng, steps)
+        (_, cache, _), (toks, logps) = jax.lax.scan(
+            step, (last_tokens, cache, cache_len), rngs
+        )
+        return toks, logps, cache  # [steps, B], [steps, B]
+
+    # ------------------------------------------------------------------
+    # Host-side helpers
+    # ------------------------------------------------------------------
+
+    def _load_params_from(self, path: str):
+        def putter(p, arr):
+            shard = self._leaf_sharding(p)
+            return jax.device_put(jnp.asarray(arr), shard)
+
+        _, params = hf_io.load_hf_params(
+            path, self.model_config, dtype=self.config.dtype, to_device=putter
+        )
+        return jax.device_put(params, self._shardings)
+
+    def _leaf_sharding(self, path):
+        node = self._shardings
+        for k in path:
+            node = node[getattr(k, "key", k)]
+        return node
+
+    def _next_rng(self):
+        self._rng_counter += 1
+        return jax.random.fold_in(self._rng_base, self._rng_counter)
+
+    def _bucket(self, n: int) -> int:
+        """Static prompt-length bucket: powers of two up to prefill_chunk,
+        then multiples of prefill_chunk (bounds compile count)."""
+        chunk = self.config.prefill_chunk
+        b = 64
+        while b < min(n, chunk):
+            b *= 2
+        if n <= b:
+            return min(b, self._max_bucket())
+        return min(-(-n // chunk) * chunk, self._max_bucket())
+
+    def _max_bucket(self) -> int:
+        return self.config.max_seq_len
+
+    @property
+    def eos_token_id(self) -> int | None:
+        if self.tokenizer is not None:
+            return getattr(self.tokenizer, "eos_token_id", None)
+        return None
+
+    # ------------------------------------------------------------------
+    # Public API (thread-safe)
+    # ------------------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="generation-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._shutdown.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def submit(
+        self,
+        rid: str,
+        input_ids: list[int],
+        gconfig: GenerationHyperparameters,
+        on_done: Callable[[ModelResponse], None],
+    ):
+        """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
+        thread when it finishes (stop/length/abort)."""
+        if len(input_ids) >= self.config.max_seq_len:
+            resp = ModelResponse(
+                input_tokens=list(input_ids), stop_reason="length"
+            )
+            on_done(resp)
+            return
+        seq = _Seq(
+            rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done
+        )
+        self._input_queue.put(seq)
+        self._wake.set()
+
+    def abort(self, rid: str):
+        with self._lock:
+            self._abort_rids.add(rid)
+        self._wake.set()
+
+    def pause(self):
+        """Abort all in-flight requests and stop admitting new ones (weight
+        update fence). Returns once the engine thread acknowledges."""
+        done = threading.Event()
+        self._paused.set()
+        self._cmd_queue.put(("pause_ack", done))
+        self._wake.set()
+        done.wait(timeout=60.0)
+
+    def resume(self):
+        self._paused.clear()
+        self._wake.set()
+
+    def update_weights_from_disk(self, path: str, version: int | None = None):
+        """Swap params in place; must run on the engine thread between
+        dispatches. Blocks until done."""
+        done: queue.Queue = queue.Queue()
+        self._cmd_queue.put(("update_weights", path, version, done))
+        self._wake.set()
+        err = done.get(timeout=600.0)
+        if err is not None:
+            raise err
+
+    def get_version(self) -> int:
+        return self.version
+
+    def set_version(self, v: int):
+        self.version = v
+
+    @property
+    def n_running(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    # ------------------------------------------------------------------
+    # Engine loop
+    # ------------------------------------------------------------------
+
+    def _loop(self):
+        try:
+            while not self._shutdown.is_set():
+                self._drain_commands()
+                if self._paused.is_set():
+                    self._abort_all("abort")
+                    self._wake.wait(timeout=0.1)
+                    self._wake.clear()
+                    continue
+                self._handle_aborts()
+                self._admit()
+                if self.n_running == 0:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                self._decode_chunk()
+        except Exception:
+            logger.exception("generation engine loop died")
+            self._abort_all("abort")
+            raise
+
+    def _drain_commands(self):
+        while True:
+            try:
+                cmd = self._cmd_queue.get_nowait()
+            except queue.Empty:
+                return
+            if cmd[0] == "pause_ack":
+                self._abort_all("abort")
+                cmd[1].set()
+            elif cmd[0] == "update_weights":
+                _, path, version, done = cmd
+                try:
+                    t0 = time.monotonic()
+                    self.params = self._load_params_from(path)
+                    jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
+                    self.version = version if version is not None else self.version + 1
+                    logger.info(
+                        "weights updated from %s -> v%d in %.2fs",
+                        path,
+                        self.version,
+                        time.monotonic() - t0,
+                    )
+                    done.put(None)
+                except Exception as e:  # surface to caller
+                    logger.exception("weight update failed")
+                    done.put(e)
+
+    def _abort_all(self, reason: str):
+        for i, seq in enumerate(self.slots):
+            if seq is not None:
+                self._finish(i, reason)
+        # flush queued-but-not-admitted requests too: client re-issues them
+        while True:
+            try:
+                seq = self._input_queue.get_nowait()
+            except queue.Empty:
+                break
+            seq.on_done(self._response(seq, reason))
+
+    def _handle_aborts(self):
+        with self._lock:
+            rids, self._abort_rids = self._abort_rids, set()
+        if not rids:
+            return
+        for i, seq in enumerate(self.slots):
+            if seq is not None and seq.rid in rids:
+                self._finish(i, "abort")
+
+    def _admit(self):
+        """Fill free slots from the input queue (prefill each)."""
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        while free and not self._input_queue.empty():
+            try:
+                seq = self._input_queue.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop()
+            self._prefill_seq(seq, slot)
+
+    def _prefill_seq(self, seq: _Seq, slot: int):
+        n = len(seq.prompt)
+        tp = self._bucket(n)
+        ids = np.zeros(tp, np.int32)
+        ids[:n] = seq.prompt
+        g = seq.gconfig
+        tok, logp, self.cache = self._jit_prefill(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.int32(n),
+            jnp.int32(slot),
+            self._next_rng(),
+            jnp.float32(g.temperature),
+            jnp.int32(g.top_k),
+            jnp.float32(g.top_p),
+            jnp.asarray(g.greedy),
+            use_top_k=g.top_k > 0,
+            use_top_p=g.top_p < 1.0,
+        )
+        now = time.monotonic()
+        seq.slot = slot
+        seq.t_first_token = now
+        seq.t_last_token = now
+        tok_i = int(tok)
+        seq.out_tokens.append(tok_i)
+        seq.out_logprobs.append(float(logp))
+        seq.out_versions.append(self.version)
+        self.slots[slot] = seq
+        # cache holds exactly the n prompt tokens; the sampled token's K/V is
+        # written by the next decode step (which feeds it at position n)
+        self.cache_len[slot] = n
+        self.last_token[slot] = tok_i
+        if self._seq_finished(seq, tok_i):
+            self._finish(slot, self._finish_reason(seq, tok_i))
+
+    def _seq_finished(self, seq: _Seq, last_tok: int) -> bool:
+        n_out = len(seq.out_tokens)
+        if n_out >= seq.gconfig.max_new_tokens:
+            return True
+        if len(seq.prompt) + n_out >= self.config.max_seq_len:
+            return True
+        if n_out < seq.gconfig.min_new_tokens:
+            return False
+        if last_tok in seq.stop_ids(self.eos_token_id):
+            return True
+        return self._hit_stop_string(seq)
+
+    def _hit_stop_string(self, seq: _Seq) -> bool:
+        """Stop-string matching over the decoded tail (needs a tokenizer).
+        Tokens are not trimmed back past the match; workflows that need exact
+        truncation should use stop_token_ids."""
+        if not seq.gconfig.stop or self.tokenizer is None:
+            return False
+        tail = self.tokenizer.decode(seq.out_tokens[-32:])
+        return any(s in tail for s in seq.gconfig.stop)
+
+    def _finish_reason(self, seq: _Seq, last_tok: int) -> str:
+        if len(seq.out_tokens) >= seq.gconfig.min_new_tokens:
+            if last_tok in seq.stop_ids(self.eos_token_id):
+                return "stop"
+            if self._hit_stop_string(seq):
+                return "stop"
+        return "length"
+
+    def _decode_chunk(self):
+        b = self.config.max_batch_size
+        active = np.array([s is not None for s in self.slots])
+        # never decode past any active slot's cache capacity
+        steps = self.config.decode_steps_per_call
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                steps = min(steps, self.config.max_seq_len - int(self.cache_len[i]))
+        steps = max(steps, 1)
+        temp = np.ones(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+        greedy = np.zeros(b, bool)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                g = s.gconfig
+                temp[i], top_k[i], top_p[i], greedy[i] = (
+                    g.temperature,
+                    g.top_k,
+                    g.top_p,
+                    g.greedy,
+                )
+        toks, logps, self.cache = self._jit_decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self.last_token),
+            jnp.asarray(self.cache_len),
+            jnp.asarray(active),
+            self._next_rng(),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(greedy),
+            steps=steps,
+            use_top_k=bool(top_k.any()),
+            use_top_p=bool((top_p < 1.0).any()),
+        )
+        toks = np.asarray(toks)  # [steps, B]
+        logps = np.asarray(logps)
+        now = time.monotonic()
+        for i, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            for t in range(toks.shape[0]):
+                tok = int(toks[t, i])
+                seq.out_tokens.append(tok)
+                seq.out_logprobs.append(float(logps[t, i]))
+                seq.out_versions.append(self.version)
+                if seq.t_last_token is not None:
+                    seq.itl.append(now - seq.t_last_token)
+                seq.t_last_token = now
+                self.cache_len[i] += 1
+                self.last_token[i] = tok
+                if self._seq_finished(seq, tok):
+                    self._finish(i, self._finish_reason(seq, tok))
+                    break
+
+    def _finish(self, slot: int, reason: str):
+        seq = self.slots[slot]
+        if seq is None:
+            return
+        self.slots[slot] = None
+        self.cache_len[slot] = 0
+        seq.on_done(self._response(seq, reason))
+
+    def _response(self, seq: _Seq, reason: str) -> ModelResponse:
+        now = time.monotonic()
+        return ModelResponse(
+            input_tokens=list(seq.prompt),
+            output_tokens=list(seq.out_tokens),
+            output_logprobs=list(seq.out_logprobs),
+            output_versions=list(seq.out_versions),
+            stop_reason=reason,
+            latency=now - seq.t_submit,
+            ttft=(seq.t_first_token or now) - seq.t_submit,
+            itl=list(seq.itl),
+            tokenizer=self.tokenizer,
+        )

@@ -1,0 +1,67 @@
+"""On-device token sampling for the generation engine.
+
+The reference delegates sampling to SGLang's CUDA sampler; here it is a pure
+jittable function fused into the prefill/decode calls so logits never leave
+the device. Logprobs are computed under the *modified* (temperature / top-k /
+top-p) distribution — the true behavior-policy logprob that decoupled PPO
+consumes (reference: SGLang `output_token_logprobs`,
+areal/engine/sglang_remote.py:22-170).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _apply_top_k(scaled: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits outside the per-row top-k. top_k [B] int32, 0 = disabled."""
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
+    thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B,1]
+    return jnp.where(scaled >= thresh, scaled, _NEG_INF)
+
+
+def _apply_top_p(scaled: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering. top_p [B] float32, 1.0 = disabled.
+
+    Keeps the smallest prefix of probability-sorted tokens whose cumulative
+    mass reaches top_p (the highest-probability token always survives).
+    """
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept if the cumulative mass *before* it is < top_p
+    keep_sorted = (cum - probs) < top_p[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(scaled.shape[0])[:, None], sort_idx
+    ].set(keep_sorted)
+    return jnp.where(keep, scaled, _NEG_INF)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] fp32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B] fp32
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B] fp32 (1.0 = off)
+    greedy: jnp.ndarray,  # [B] bool
+    use_top_k: bool = True,  # static: compile out the sort when unused
+    use_top_p: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens [B] int32, logprobs [B] fp32)."""
+    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
+    if use_top_k:
+        scaled = _apply_top_k(scaled, top_k)
+    if use_top_p:
+        scaled = _apply_top_p(scaled, top_p)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    argmax = jnp.argmax(scaled, axis=-1)
+    tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
+    logp_dist = jax.nn.log_softmax(scaled, axis=-1)
+    logprobs = jnp.take_along_axis(logp_dist, tokens[:, None], axis=-1)[:, 0]
+    return tokens, logprobs

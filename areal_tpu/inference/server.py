@@ -1,0 +1,164 @@
+"""HTTP generation server over :class:`GenerationEngine`.
+
+Endpoint parity with the reference's patched SGLang server protocol
+(areal/engine/sglang_remote.py:22-170, patch/sglang/v0.5.2.patch):
+
+- ``POST /generate`` — {rid, input_ids, sampling_params} -> tokens, logprobs,
+  per-token weight versions, stop reason ("abort" when interrupted).
+- ``POST /pause_generation`` / ``POST /continue_generation`` — weight-update
+  fence; pause aborts all in-flight requests.
+- ``POST /update_weights_from_disk`` — {model_path, version?} -> in-place
+  safetensors refresh of the live params.
+- ``POST /abort_request`` — {rid}.
+- ``GET /health`` / ``GET /model_info`` — liveness + version/running counters.
+
+The engine loop runs on its own thread; handlers bridge with asyncio futures
+via ``loop.call_soon_threadsafe`` so one aiohttp event loop serves many
+concurrent generation requests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from aiohttp import web
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("GenerationServer")
+
+
+def _gconfig_from_dict(d: dict[str, Any]) -> GenerationHyperparameters:
+    fields = {
+        k: d[k]
+        for k in (
+            "n_samples",
+            "max_new_tokens",
+            "min_new_tokens",
+            "greedy",
+            "temperature",
+            "top_p",
+            "top_k",
+            "stop_token_ids",
+            "stop",
+            "frequency_penalty",
+        )
+        if k in d
+    }
+    return GenerationHyperparameters(**fields)
+
+
+def _response_payload(r: ModelResponse) -> dict:
+    return {
+        "input_tokens": r.input_tokens,
+        "output_tokens": r.output_tokens,
+        "output_logprobs": r.output_logprobs,
+        "output_versions": r.output_versions,
+        "stop_reason": r.stop_reason,
+        "latency": r.latency,
+        "ttft": r.ttft,
+        "itl": r.itl,
+    }
+
+
+class GenerationServer:
+    def __init__(self, engine: GenerationEngine):
+        self.engine = engine
+        self.app = web.Application(client_max_size=256 * 1024**2)
+        self.app.add_routes(
+            [
+                web.get("/health", self.health),
+                web.get("/model_info", self.model_info),
+                web.post("/generate", self.generate),
+                web.post("/abort_request", self.abort_request),
+                web.post("/pause_generation", self.pause),
+                web.post("/continue_generation", self.resume),
+                web.post("/update_weights_from_disk", self.update_weights_from_disk),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+
+    # -- handlers -------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def model_info(self, request: web.Request) -> web.Response:
+        e = self.engine
+        return web.json_response(
+            {
+                "weight_version": e.get_version(),
+                "n_running": e.n_running,
+                "max_batch_size": e.config.max_batch_size,
+                "max_seq_len": e.config.max_seq_len,
+            }
+        )
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        rid = body.get("rid") or ""
+        input_ids = body["input_ids"]
+        gconfig = _gconfig_from_dict(body.get("sampling_params", {}))
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_done(resp: ModelResponse):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(resp) if not fut.done() else None
+            )
+
+        self.engine.submit(rid, input_ids, gconfig, on_done)
+        resp = await fut
+        return web.json_response(_response_payload(resp))
+
+    async def abort_request(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.engine.abort(body.get("rid", ""))
+        return web.json_response({"success": True})
+
+    async def pause(self, request: web.Request) -> web.Response:
+        await asyncio.get_running_loop().run_in_executor(None, self.engine.pause)
+        return web.json_response({"success": True})
+
+    async def resume(self, request: web.Request) -> web.Response:
+        self.engine.resume()
+        return web.json_response({"success": True})
+
+    async def update_weights_from_disk(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        path = body["model_path"]
+        version = body.get("version")
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.update_weights_from_disk, path, version
+            )
+        except Exception as e:
+            logger.exception("update_weights_from_disk failed")
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.engine.get_version()}
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self, host: str, port: int) -> int:
+        self.engine.start()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        logger.info("generation server listening on %s:%d", host, actual_port)
+        return actual_port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        self.engine.stop()

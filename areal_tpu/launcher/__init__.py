@@ -1,0 +1,1 @@
+"""Process launchers (reference: areal/launcher/ — local, ray, slurm)."""

@@ -1,0 +1,87 @@
+"""Standalone generation-server process.
+
+Parity with the reference's ``areal/launcher/sglang_server.py:272``: boot the
+in-repo JAX generation server from a config, register its address under the
+trial's name_resolve subtree, then serve until the trial's shutdown key
+appears (or the process is signalled).
+
+Usage::
+
+    python -m areal_tpu.launcher.tpu_server --config cfg.yaml \
+        server.model_path=/path/to/hf_ckpt server.port=30000
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import uuid
+from dataclasses import dataclass, field
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+from areal_tpu.api.cli_args import JaxGenConfig, NameResolveConfig, parse_cli_args, from_dict
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.utils import logging, name_resolve, names, network
+
+logger = logging.getLogger("tpu_server")
+
+
+@dataclass
+class GenServerConfig:
+    experiment_name: str = "local"
+    trial_name: str = "trial"
+    server: JaxGenConfig = field(default_factory=JaxGenConfig)
+    name_resolve: NameResolveConfig = field(default_factory=NameResolveConfig)
+
+
+def _load_tokenizer(path: str):
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(path)
+    except Exception:
+        logger.warning("no tokenizer at %s; stop-string matching disabled", path)
+        return None
+
+
+async def amain(cfg: GenServerConfig):
+    name_resolve.reconfigure(cfg.name_resolve)
+    tokenizer = _load_tokenizer(cfg.server.model_path) if cfg.server.model_path else None
+    engine = GenerationEngine(cfg.server, tokenizer=tokenizer)
+    server = GenerationServer(engine)
+    port = cfg.server.port or network.find_free_ports(1)[0]
+    port = await server.start(cfg.server.host, port)
+
+    addr = f"{network.gethostip()}:{port}"
+    server_id = os.environ.get("AREAL_SERVER_ID") or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+    key = names.gen_server(cfg.experiment_name, cfg.trial_name, server_id)
+    name_resolve.add(key, addr, replace=True)
+    logger.info("registered %s -> %s", key, addr)
+
+    stop_key = f"{names.trial_root(cfg.experiment_name, cfg.trial_name)}/shutdown"
+    try:
+        while True:
+            try:
+                name_resolve.get(stop_key)
+                logger.info("shutdown key found; exiting")
+                break
+            except Exception:
+                pass
+            await asyncio.sleep(2.0)
+    finally:
+        await server.stop()
+
+
+def main(argv: list[str] | None = None):
+    cfg_dict, _ = parse_cli_args(argv)
+    cfg = from_dict(GenServerConfig, cfg_dict)
+    asyncio.run(amain(cfg))
+
+
+if __name__ == "__main__":
+    main()

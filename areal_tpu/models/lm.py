@@ -216,6 +216,47 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def prefill(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [Tp] int32, padded to a static bucket
+    length: jnp.ndarray,  # scalar int32, true prompt length
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prompt pass for one cache slot.
+
+    Runs causal attention over the first ``length`` tokens (the padded tail is
+    masked out via segment ids) and returns the pieces the generation engine
+    needs: the last real token's logits and the per-layer K/V to write into
+    the slot's cache region.
+
+    Returns (last_logits [V] fp32, k [L, Tp, KH, D], v [L, Tp, KH, D]).
+    """
+    tp = input_ids.shape[0]
+    positions = jnp.arange(tp, dtype=jnp.int32)
+    segment_ids = jnp.where(positions < length, 0, -1)
+    x = params["embed"][input_ids]
+
+    def body(carry, lp):
+        h = rms_norm(carry, lp["ln1"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = packed_attention_xla(q, k, v, segment_ids)
+        out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
+        h2 = rms_norm(out, lp["ln2"], cfg.rms_norm_eps)
+        out = out + _mlp(cfg, lp, h2)
+        return out, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    h_last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=0, keepdims=False)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    return logits, ks, vs
+
+
 def decode_step(
     params: Params,
     cfg: TransformerConfig,

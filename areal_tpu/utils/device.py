@@ -1,0 +1,48 @@
+"""Device/platform helpers.
+
+The TPU images ship a sitecustomize that force-registers the TPU plugin and
+ignores ``JAX_PLATFORMS`` from the environment, so subprocesses (tests, CPU
+verification drives, CI) need an explicit override: set ``AREAL_PLATFORM=cpu``
+and call :func:`apply_platform_env` before any jax computation. Entry points
+(launchers, example scripts) all call it first thing.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env():
+    """Honor AREAL_PLATFORM / AREAL_HOST_DEVICE_COUNT before jax is used."""
+    plat = os.environ.get("AREAL_PLATFORM")
+    n = os.environ.get("AREAL_HOST_DEVICE_COUNT")
+    if n:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    if plat:
+        os.environ["JAX_PLATFORMS"] = plat
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def log_device_stats(tag: str = ""):
+    """HBM usage snapshot (reference: areal/utils/device.py log_gpu_stats)."""
+    import jax
+
+    from areal_tpu.utils import logging
+
+    logger = logging.getLogger("device")
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        used = stats.get("bytes_in_use", 0) / 1e9
+        limit = stats.get("bytes_limit", 0) / 1e9
+        logger.info("%s %s: %.2f/%.2f GB in use", tag, d, used, limit)

@@ -1,0 +1,51 @@
+"""Async HTTP helpers (reference: areal/utils/http.py)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import aiohttp
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("http")
+
+
+class HTTPRequestError(RuntimeError):
+    pass
+
+
+async def arequest_with_retry(
+    session: aiohttp.ClientSession,
+    url: str,
+    method: str = "POST",
+    payload: dict | None = None,
+    max_retries: int = 3,
+    timeout: float = 3600.0,
+    retry_delay: float = 1.0,
+) -> dict[str, Any]:
+    """POST/GET with exponential-backoff retries; raises HTTPRequestError
+    after exhausting retries."""
+    last_exc: Exception | None = None
+    for attempt in range(max_retries):
+        try:
+            async with session.request(
+                method,
+                url,
+                json=payload,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                if resp.status == 200:
+                    return await resp.json()
+                body = await resp.text()
+                last_exc = HTTPRequestError(
+                    f"{method} {url} -> {resp.status}: {body[:500]}"
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            last_exc = e
+        if attempt + 1 < max_retries:
+            await asyncio.sleep(retry_delay * 2**attempt)
+    raise HTTPRequestError(f"{method} {url} failed after {max_retries} tries") from last_exc

@@ -1,0 +1,4 @@
+"""Rollout workflows (reference: areal/workflow/)."""
+
+from areal_tpu.workflow.rlvr import RLVRWorkflow  # noqa: F401
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow  # noqa: F401

@@ -1,0 +1,134 @@
+"""Multi-turn self-correction workflow.
+
+Parity with the reference MultiTurnWorkflow (areal/workflow/multi_turn.py:22-172):
+generate, score; on zero reward append a canned retry prompt and try again up
+to ``max_turns``; later-turn successes earn a discounted reward. The emitted
+loss_mask covers only model-generated tokens across all turns.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils.data import concat_padded_tensors
+
+
+class MultiTurnWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable,
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        max_turns: int = 3,
+        turn_discount: float = 0.9,
+        retry_prompt: str = (
+            "Your answer is either wrong or not parsable to the reward function. "
+            "You may misunderstand the original question. Please carefully read "
+            "the original question, check the preivous errors, and try to answer it again."
+        ),
+        reward_timeout: float = 60.0,
+        in_process_reward: bool = False,
+    ):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, timeout=reward_timeout, in_process=in_process_reward
+        )
+        self.gconfig = gconfig.new(n_samples=1)
+        self.tokenizer = tokenizer
+        self.max_turns = max_turns
+        self.turn_discount = turn_discount
+        self.retry_prompt = retry_prompt
+
+    def _continuation_ids(self, messages, completion_str: str) -> list[int]:
+        """Token ids for the chat-format glue between a raw assistant
+        completion and the next user (retry) turn.
+
+        The recorded token stream is ground truth — the assistant's raw
+        sampled ids are never re-tokenized (tokenize(decode(x)) need not equal
+        x). Only the *string delta* the chat template appends after the
+        assistant content (turn terminator + retry user turn + generation
+        prompt) is tokenized and spliced on.
+        """
+        with_assistant = messages + [
+            {"role": "assistant", "content": completion_str}
+        ]
+        with_retry = with_assistant + [
+            {"role": "user", "content": self.retry_prompt}
+        ]
+        s1 = self.tokenizer.apply_chat_template(with_assistant, tokenize=False)
+        s2 = self.tokenizer.apply_chat_template(
+            with_retry, tokenize=False, add_generation_prompt=True
+        )
+        delta = s2[len(s1) :] if s2.startswith(s1) else s2
+        return self.tokenizer.encode(delta, add_special_tokens=False)
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        messages = list(data["messages"])
+        seq: list[int] = list(
+            self.tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True
+            )
+        )
+        loss_mask: list[int] = [0] * len(seq)
+        logprobs: list[float] = [0.0] * len(seq)
+        versions: list[int] = [-1] * len(seq)
+        reward = 0.0
+        discount = 1.0
+        rid = str(uuid.uuid4())
+        for turn in range(self.max_turns):
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=rid,
+                    input_ids=list(seq),
+                    gconfig=self.gconfig,
+                    tokenizer=self.tokenizer,
+                )
+            )
+            seq += resp.output_tokens
+            loss_mask += [1] * resp.output_len
+            logprobs += resp.output_logprobs
+            versions += resp.output_versions
+
+            completion_str = self.tokenizer.decode(resp.output_tokens)
+            r = await self.reward_fn(
+                None,
+                completion_str,
+                resp.input_tokens,
+                resp.output_tokens,
+                **{k: v for k, v in data.items() if k != "messages"},
+            )
+            if r > 0:
+                reward = r * discount
+                break
+            if turn + 1 >= self.max_turns:
+                break
+            glue = self._continuation_ids(messages, completion_str)
+            seq += glue
+            loss_mask += [0] * len(glue)
+            logprobs += [0.0] * len(glue)
+            versions += [-1] * len(glue)
+            messages = messages + [
+                {"role": "assistant", "content": completion_str},
+                {"role": "user", "content": self.retry_prompt},
+            ]
+            discount *= self.turn_discount
+
+        n = len(seq)
+        return concat_padded_tensors(
+            [
+                dict(
+                    input_ids=np.asarray(seq, np.int64)[None],
+                    loss_mask=np.asarray(loss_mask, np.int64)[None],
+                    logprobs=np.asarray(logprobs, np.float32)[None],
+                    versions=np.asarray(versions, np.int64)[None],
+                    attention_mask=np.ones((1, n), np.int64),
+                    rewards=np.asarray([reward], np.float32),
+                )
+            ]
+        )

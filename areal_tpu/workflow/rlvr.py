@@ -1,0 +1,129 @@
+"""RL-with-verifiable-rewards workflow.
+
+Parity with the reference RLVRWorkflow (areal/workflow/rlvr.py:37-144):
+tokenize the prompt through the chat template, fire ``n_samples`` parallel
+generations, score each with the (async-wrapped) reward function, and emit a
+padded trajectory batch with per-token behavior logprobs + weight versions —
+the tensors decoupled PPO consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import logging
+from areal_tpu.utils.data import concat_padded_tensors
+
+logger = logging.getLogger("RLVRWorkflow")
+
+
+class RLVRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable,
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        enable_thinking: bool = False,
+        rollout_stat_scope: str = "rollout",
+        dump_dir: str | None = None,
+        reward_timeout: float = 60.0,
+        in_process_reward: bool = False,
+    ):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, timeout=reward_timeout, in_process=in_process_reward
+        )
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.enable_thinking = enable_thinking
+        self.dump_dir = dump_dir
+        if dump_dir is not None:
+            os.makedirs(dump_dir, exist_ok=True)
+
+    def _tokenize_prompt(self, data: dict[str, Any]) -> list[int]:
+        if "input_ids" in data:
+            return list(data["input_ids"])
+        messages = data["messages"]
+        return self.tokenizer.apply_chat_template(
+            messages,
+            tokenize=True,
+            add_generation_prompt=True,
+            enable_thinking=self.enable_thinking,
+        )
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        input_ids = self._tokenize_prompt(data)
+        n = self.gconfig.n_samples
+        gconfig = self.gconfig.new(n_samples=1)
+        resps = await asyncio.gather(
+            *[
+                engine.agenerate(
+                    ModelRequest(
+                        rid=str(uuid.uuid4()),
+                        input_ids=list(input_ids),
+                        gconfig=gconfig,
+                        tokenizer=self.tokenizer,
+                    )
+                )
+                for _ in range(n)
+            ]
+        )
+        prompt_str = self.tokenizer.decode(input_ids) if self.tokenizer else None
+        extra = {
+            k: v for k, v in data.items() if k not in ("messages", "input_ids")
+        }
+        completions = [
+            self.tokenizer.decode(r.output_tokens) if self.tokenizer else None
+            for r in resps
+        ]
+        rewards = await asyncio.gather(
+            *[
+                self.reward_fn(
+                    prompt_str, comp, r.input_tokens, r.output_tokens, **extra
+                )
+                for r, comp in zip(resps, completions)
+            ]
+        )
+        samples = []
+        for resp, completion_str, reward in zip(resps, completions, rewards):
+            seqlen = resp.input_len + resp.output_len
+            seq = resp.input_tokens + resp.output_tokens
+            logprobs = [0.0] * resp.input_len + resp.output_logprobs
+            loss_mask = [0] * resp.input_len + [1] * resp.output_len
+            versions = [-1] * resp.input_len + resp.output_versions
+            samples.append(
+                dict(
+                    input_ids=np.asarray(seq, np.int64)[None],
+                    loss_mask=np.asarray(loss_mask, np.int64)[None],
+                    logprobs=np.asarray(logprobs, np.float32)[None],
+                    versions=np.asarray(versions, np.int64)[None],
+                    attention_mask=np.ones((1, seqlen), np.int64),
+                    rewards=np.asarray([reward], np.float32),
+                )
+            )
+            self._maybe_dump(engine, data, resp, completion_str, reward)
+        return concat_padded_tensors(samples)
+
+    def _maybe_dump(self, engine, data, resp, completion_str, reward):
+        if self.dump_dir is None:
+            return
+        version = engine.get_version()
+        path = os.path.join(self.dump_dir, f"v{version}.jsonl")
+        rec = {
+            "prompt_len": resp.input_len,
+            "output_len": resp.output_len,
+            "reward": float(reward),
+            "stop_reason": resp.stop_reason,
+            "completion": completion_str,
+        }
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, ensure_ascii=False) + "\n")
