@@ -156,6 +156,7 @@ class RemoteInfEngine(InferenceEngine):
                     "top_p": gconfig.top_p,
                     "top_k": gconfig.top_k,
                     "stop_token_ids": gconfig.stop_token_ids,
+                    "stop": gconfig.stop,
                 },
             }
             result = await arequest_with_retry(

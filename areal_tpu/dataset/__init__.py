@@ -1,0 +1,142 @@
+"""Dataset builders (reference: areal/dataset/ — gsm8k et al.).
+
+``get_custom_dataset`` dispatches on dataset name/path. Zero-egress friendly:
+every builder accepts a local directory / jsonl file; the HF hub path is only
+attempted when the name is not a local path (and will use the local cache).
+Rows are plain dicts; RL-type rows carry ``messages`` (chat format) + gold
+fields for the reward fn; SFT-type rows carry pre-tokenized
+``input_ids``/``loss_mask``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("dataset")
+
+
+def load_jsonl(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def _gsm8k_gold(solution: str) -> str:
+    if "####" in solution:
+        return solution.split("####")[-1].strip().replace(",", "")
+    return solution.strip()
+
+
+def process_gsm8k_rl_dataset(rows: list[dict]) -> list[dict]:
+    """gsm8k RL rows -> {messages, answer} (reference areal/dataset gsm8k)."""
+    out = []
+    for r in rows:
+        q = r.get("question") or r.get("prompt") or r.get("problem")
+        a = r.get("answer") or r.get("solution") or ""
+        if q is None:
+            continue
+        out.append(
+            {
+                "messages": [{"role": "user", "content": q}],
+                "answer": _gsm8k_gold(str(a)),
+            }
+        )
+    return out
+
+
+def process_gsm8k_sft_dataset(
+    rows: list[dict], tokenizer, max_length: int | None = None
+) -> list[dict]:
+    """gsm8k SFT rows -> {input_ids, loss_mask}: prompt masked out, full
+    solution supervised."""
+    out = []
+    for r in rows:
+        q = r.get("question") or r.get("prompt") or r.get("problem")
+        a = r.get("answer") or r.get("solution") or ""
+        if q is None:
+            continue
+        msgs = [{"role": "user", "content": q}]
+        prompt_ids = tokenizer.apply_chat_template(
+            msgs, tokenize=True, add_generation_prompt=True
+        )
+        ans_ids = tokenizer.encode(str(a), add_special_tokens=False)
+        eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
+        ids = list(prompt_ids) + list(ans_ids) + eos
+        mask = [0] * len(prompt_ids) + [1] * (len(ans_ids) + len(eos))
+        if max_length is not None and len(ids) > max_length:
+            ids, mask = ids[:max_length], mask[:max_length]
+        out.append(
+            {
+                "input_ids": np.asarray(ids, np.int64),
+                "loss_mask": np.asarray(mask, np.int64),
+            }
+        )
+    return out
+
+
+_PROCESSORS: dict[tuple[str, str], Callable] = {}
+
+
+def register_dataset(name: str, type_: str):
+    def deco(fn):
+        _PROCESSORS[(name, type_)] = fn
+        return fn
+
+    return deco
+
+
+def get_custom_dataset(
+    path: str,
+    split: str = "train",
+    type: str = "rl",
+    tokenizer=None,
+    max_length: int | None = None,
+    rank: int = 0,
+    world_size: int = 1,
+    **kwargs,
+) -> list[dict]:
+    """Load + process a dataset, optionally sharded across DP ranks.
+
+    ``path`` may be: a local .jsonl file, a local dir containing
+    ``{split}.jsonl``, or an HF hub name (e.g. "openai/gsm8k") resolved from
+    the local HF cache.
+    """
+    name = os.path.basename(path.rstrip("/")).lower()
+    if os.path.isfile(path):
+        rows = load_jsonl(path)
+    elif os.path.isdir(path):
+        f = os.path.join(path, f"{split}.jsonl")
+        if not os.path.isfile(f):
+            raise FileNotFoundError(f)
+        rows = load_jsonl(f)
+    else:
+        import datasets as hf_datasets
+
+        ds = hf_datasets.load_dataset(path, "main" if "gsm8k" in name else None, split=split)
+        rows = [dict(r) for r in ds]
+
+    custom = _PROCESSORS.get((name, type))
+    if custom is not None:
+        rows = custom(rows, tokenizer=tokenizer, max_length=max_length, **kwargs)
+    elif type == "rl":
+        rows = process_gsm8k_rl_dataset(rows)
+    elif type == "sft":
+        if tokenizer is None:
+            raise ValueError("sft datasets need a tokenizer")
+        rows = process_gsm8k_sft_dataset(rows, tokenizer, max_length)
+    else:
+        raise ValueError(f"unknown dataset type {type!r}")
+
+    if world_size > 1:
+        rows = rows[rank::world_size]
+    return rows

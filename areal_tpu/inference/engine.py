@@ -154,6 +154,7 @@ class GenerationEngine:
         self._thread: threading.Thread | None = None
         self._abort_rids: set[str] = set()
         self._lock = threading.Lock()
+        self._dead: Exception | None = None
 
         self._jit_prefill = jax.jit(
             functools.partial(self._prefill_impl),
@@ -260,7 +261,7 @@ class GenerationEngine:
         _, params = hf_io.load_hf_params(
             path, self.model_config, dtype=self.config.dtype, to_device=putter
         )
-        return jax.device_put(params, self._shardings)
+        return params  # every leaf already placed on its NamedSharding
 
     def _leaf_sharding(self, path):
         node = self._shardings
@@ -320,6 +321,8 @@ class GenerationEngine:
     ):
         """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
         thread when it finishes (stop/length/abort)."""
+        if self._dead is not None:
+            raise RuntimeError("generation engine loop died") from self._dead
         if len(input_ids) >= self.config.max_seq_len:
             resp = ModelResponse(
                 input_tokens=list(input_ids), stop_reason="length"
@@ -337,14 +340,24 @@ class GenerationEngine:
             self._abort_rids.add(rid)
         self._wake.set()
 
-    def pause(self):
+    @property
+    def healthy(self) -> bool:
+        return self._dead is None
+
+    def pause(self, timeout: float = 60.0):
         """Abort all in-flight requests and stop admitting new ones (weight
-        update fence). Returns once the engine thread acknowledges."""
+        update fence). Raises if the engine thread doesn't acknowledge —
+        proceeding with a weight update while requests run would violate the
+        fence."""
         done = threading.Event()
         self._paused.set()
         self._cmd_queue.put(("pause_ack", done))
         self._wake.set()
-        done.wait(timeout=60.0)
+        if not done.wait(timeout=timeout) and self._dead is None:
+            raise TimeoutError(
+                f"engine thread did not acknowledge pause within {timeout}s "
+                "(long compile in progress?)"
+            )
 
     def resume(self):
         self._paused.clear()
@@ -390,8 +403,9 @@ class GenerationEngine:
                     self._wake.clear()
                     continue
                 self._decode_chunk()
-        except Exception:
+        except Exception as e:
             logger.exception("generation engine loop died")
+            self._dead = e
             self._abort_all("abort")
             raise
 
@@ -442,6 +456,23 @@ class GenerationEngine:
         for i, seq in enumerate(self.slots):
             if seq is not None and seq.rid in rids:
                 self._finish(i, "abort")
+                rids.discard(seq.rid)
+        if rids:
+            # the rid may still be waiting in the input queue — filter it out
+            # there too (otherwise the abort is silently lost and the request
+            # is admitted later)
+            kept: list[_Seq] = []
+            while True:
+                try:
+                    seq = self._input_queue.get_nowait()
+                except queue.Empty:
+                    break
+                if seq.rid in rids:
+                    seq.on_done(self._response(seq, "abort"))
+                else:
+                    kept.append(seq)
+            for seq in kept:
+                self._input_queue.put(seq)
 
     def _admit(self):
         """Fill free slots from the input queue (prefill each)."""

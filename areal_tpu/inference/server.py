@@ -85,6 +85,8 @@ class GenerationServer:
     # -- handlers -------------------------------------------------------
 
     async def health(self, request: web.Request) -> web.Response:
+        if not self.engine.healthy:
+            return web.json_response({"status": "dead"}, status=500)
         return web.json_response({"status": "ok"})
 
     async def model_info(self, request: web.Request) -> web.Response:
@@ -111,8 +113,17 @@ class GenerationServer:
                 lambda: fut.set_result(resp) if not fut.done() else None
             )
 
-        self.engine.submit(rid, input_ids, gconfig, on_done)
-        resp = await fut
+        try:
+            self.engine.submit(rid, input_ids, gconfig, on_done)
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        try:
+            resp = await fut
+        except asyncio.CancelledError:
+            # client disconnected / timed out: free the slot so a retry of
+            # the same rid doesn't run two copies concurrently
+            self.engine.abort(rid)
+            raise
         return web.json_response(_response_payload(resp))
 
     async def abort_request(self, request: web.Request) -> web.Response:

@@ -1,0 +1,178 @@
+"""Local launcher: generation servers + trainer on one host.
+
+Parity with the reference's LocalLauncher (areal/launcher/local.py:258-401):
+
+1. parse the experiment config + allocation mode;
+2. spawn one ``areal_tpu.launcher.tpu_server`` process per inference DP
+   replica (TPU chips assigned via the platform's visible-device env);
+3. wait until all servers register in name_resolve, export
+   ``AREAL_LLM_SERVER_ADDRS`` to the trainer;
+4. spawn the trainer entry script;
+5. monitor both; on any child failure kill the trial and relaunch with
+   ``run_id+1`` (recovery run env set) up to ``recover.retries``.
+
+Usage::
+
+    python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v ...]
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from areal_tpu.api.alloc_mode import AllocationMode
+from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.name_resolve import NameResolveConfig
+from areal_tpu.utils.recover import RECOVER_ENV
+
+logger = logging.getLogger("launcher.local")
+
+SERVER_WAIT_TIMEOUT = 600.0
+
+
+def _ensure_cross_process_name_resolve(cfg) -> NameResolveConfig:
+    nr = cfg.cluster.name_resolve
+    if nr.type == "memory":
+        # memory repo can't cross the process boundary; fall back to NFS files
+        nr = NameResolveConfig(
+            type="nfs",
+            nfs_record_root=os.path.join(cfg.cluster.fileroot, "name_resolve"),
+        )
+        cfg.cluster.name_resolve = nr
+    return nr
+
+
+def _flatten(prefix: str, d: dict) -> list[str]:
+    out = []
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out += _flatten(key, v)
+        elif isinstance(v, (list, tuple)):
+            out.append(f"{key}=[{','.join(map(str, v))}]")
+        elif v is not None:
+            out.append(f"{key}={v}")
+    return out
+
+
+def _spawn_servers(cfg, alloc: AllocationMode) -> list:
+    """The server process gets ONLY its own config section (GenServerConfig
+    is strict about unknown keys), flattened to key=value overrides."""
+    from areal_tpu.api.cli_args import to_dict
+
+    procs = []
+    n_servers = alloc.gen.dp if alloc.gen else 0
+    chips_per_server = (
+        alloc.gen.world_size // max(alloc.gen.dp, 1) if alloc.gen else 0
+    )
+    for i in range(n_servers):
+        env = dict(os.environ)
+        env["AREAL_SERVER_ID"] = f"server{i}"
+        env.update(cfg.launcher.inference_server_env_vars)
+        argv = [
+            sys.executable,
+            "-m",
+            "areal_tpu.launcher.tpu_server",
+            *_flatten("server", to_dict(cfg.server)),
+            f"experiment_name={cfg.experiment_name}",
+            f"trial_name={cfg.trial_name}",
+            f"server.tp_size={max(chips_per_server, 1)}",
+            f"name_resolve.type={cfg.cluster.name_resolve.type}",
+            f"name_resolve.nfs_record_root={cfg.cluster.name_resolve.nfs_record_root}",
+        ]
+        logger.info("spawning server %d: %s", i, " ".join(argv[3:]))
+        procs.append(subprocess.Popen(argv, env=env))
+    return procs
+
+
+def _wait_server_addrs(cfg, n_servers: int) -> list[str]:
+    key = names.gen_servers(cfg.experiment_name, cfg.trial_name)
+    deadline = time.monotonic() + SERVER_WAIT_TIMEOUT
+    while time.monotonic() < deadline:
+        addrs = name_resolve.get_subtree(key)
+        if len(addrs) >= n_servers:
+            return sorted(addrs)
+        time.sleep(1.0)
+    raise TimeoutError(f"only {len(name_resolve.get_subtree(key))}/{n_servers} servers registered")
+
+
+def _spawn_trainer(cfg, entry: str, config_argv: list[str], addrs: list[str], run_id: int):
+    env = dict(os.environ)
+    env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
+    env[RECOVER_ENV] = "1" if run_id > 0 else "0"
+    env.update(cfg.launcher.trainer_env_vars)
+    argv = [sys.executable, entry, *config_argv]
+    logger.info("spawning trainer: %s", " ".join(argv))
+    return subprocess.Popen(argv, env=env)
+
+
+def _kill(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    t0 = time.monotonic()
+    for p in procs:
+        while p.poll() is None and time.monotonic() - t0 < 10:
+            time.sleep(0.2)
+        if p.poll() is None:
+            p.kill()
+
+
+def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
+    cfg, _ = load_expr_config(config_argv, GRPOConfig)
+    nr = _ensure_cross_process_name_resolve(cfg)
+    name_resolve.reconfigure(nr)
+    # clear any stale subtree from a previous run of this trial
+    try:
+        name_resolve.clear_subtree(names.trial_root(cfg.experiment_name, cfg.trial_name))
+    except Exception:
+        pass
+
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    servers = _spawn_servers(cfg, alloc)
+    procs = list(servers)
+    try:
+        addrs = _wait_server_addrs(cfg, len(servers))
+        logger.info("servers up: %s", addrs)
+        trainer = _spawn_trainer(cfg, entry, config_argv, addrs, run_id)
+        procs.append(trainer)
+        while True:
+            rc = trainer.poll()
+            if rc is not None:
+                return rc
+            for s in servers:
+                if s.poll() is not None:
+                    logger.error("server died with rc=%s; failing trial", s.poll())
+                    return s.poll() or 1
+            time.sleep(1.0)
+    finally:
+        _kill(procs)
+
+
+def main(argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        raise SystemExit("usage: python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v ...]")
+    entry, config_argv = argv[0], argv[1:]
+    cfg, _ = load_expr_config(config_argv, GRPOConfig)
+    retries = max(cfg.recover.retries, 0) if cfg.recover.mode in ("auto", "fault") else 0
+    run_id = 0
+    while True:
+        rc = run_trial(entry, config_argv, run_id)
+        if rc == 0:
+            logger.info("trial finished successfully")
+            return 0
+        if run_id >= retries:
+            logger.error("trial failed with rc=%s; no retries left", rc)
+            return rc or 1
+        run_id += 1
+        logger.warning("trial failed (rc=%s); relaunching as run %d", rc, run_id)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Reward functions (reference: areal/reward/)."""
+
+from areal_tpu.reward.math_parser import (  # noqa: F401
+    extract_answer,
+    math_equal,
+    math_verify_reward,
+    process_results,
+)

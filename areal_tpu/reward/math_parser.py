@@ -1,0 +1,175 @@
+"""Math answer extraction + equivalence checking.
+
+Capability parity with the reference's sympy/latex verifier
+(areal/reward/math_parser.py:867 — ``process_results`` and friends), built
+fresh and compact: extract the model's final answer from \\boxed{..},
+``####``-style markers, or the last number/expression, then decide
+equivalence by (1) string normalization, (2) numeric evaluation, (3) sympy
+symbolic simplification. Designed to run inside the AsyncRewardWrapper
+process pool with a timeout, so sympy hangs can't stall rollout.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+_BOXED_RE = re.compile(r"\\boxed\s*\{")
+_HASH_RE = re.compile(r"####\s*(.+?)\s*(?:$|\n)")
+_ANSWER_IS_RE = re.compile(
+    r"(?:final answer|answer)\s*(?:is|:|=)\s*\$?([^\n\.\$]+)", re.IGNORECASE
+)
+_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:/\d+)?")
+
+
+def _extract_boxed(text: str) -> str | None:
+    """Last \\boxed{...} with balanced-brace scanning (nested braces legal)."""
+    out = None
+    for m in _BOXED_RE.finditer(text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        if depth == 0:
+            out = text[m.end() : i - 1]
+    return out
+
+
+def extract_answer(text: str) -> str | None:
+    """Model-output answer extraction, most-specific marker first."""
+    if not text:
+        return None
+    boxed = _extract_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    m = _HASH_RE.findall(text)
+    if m:
+        return m[-1].strip()
+    m = _ANSWER_IS_RE.findall(text)
+    if m:
+        return m[-1].strip()
+    nums = _NUMBER_RE.findall(text)
+    if nums:
+        return nums[-1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Normalization + equivalence
+# ---------------------------------------------------------------------------
+
+_LATEX_SUBS = [
+    (re.compile(r"\\left|\\right|\\!|\\,|\\;|\\:"), ""),
+    (re.compile(r"\\text\s*\{[^}]*\}"), ""),
+    (re.compile(r"\\mathrm\s*\{[^}]*\}"), ""),
+    (re.compile(r"\\(?:d)?frac\s*\{([^{}]+)\}\s*\{([^{}]+)\}"), r"(\1)/(\2)"),
+    (re.compile(r"\\sqrt\s*\{([^{}]+)\}"), r"sqrt(\1)"),
+    (re.compile(r"\\sqrt\s*(\w)"), r"sqrt(\1)"),
+    (re.compile(r"\\cdot|\\times"), "*"),
+    (re.compile(r"\\pi"), "pi"),
+    (re.compile(r"\\infty"), "oo"),
+    (re.compile(r"\\pm"), "+-"),
+    (re.compile(r"\\%|%"), ""),
+    (re.compile(r"\\\$|\$"), ""),
+    (re.compile(r"\\ "), " "),
+    (re.compile(r"\^\s*\{([^{}]+)\}"), r"^(\1)"),
+    (re.compile(r"\{|\}"), ""),
+    (re.compile(r"\s+"), ""),
+]
+
+_UNIT_TAIL = re.compile(
+    r"(?:degrees?|deg|cm|mm|km|m|inches|inch|in|feet|ft|hours?|hrs?|minutes?"
+    r"|mins?|seconds?|secs?|dollars?|cents?|percent|units?|square|cubic)$",
+    re.IGNORECASE,
+)
+
+
+def normalize_answer(ans: str) -> str:
+    ans = ans.strip().strip(".").strip()
+    for pat, repl in _LATEX_SUBS:
+        ans = pat.sub(repl, ans)
+    ans = ans.replace(",", "")  # thousands separators AND tuple commas differ; numeric path handles tuples poorly anyway
+    ans = _UNIT_TAIL.sub("", ans)
+    return ans.strip().lower()
+
+
+def _to_number(s: str) -> float | None:
+    try:
+        if "/" in s:
+            num, den = s.split("/", 1)
+            return float(num.strip("() ")) / float(den.strip("() "))
+        return float(s)
+    except (ValueError, ZeroDivisionError):
+        return None
+
+
+def _sympy_equal(a: str, b: str, timeout_ok: bool = True) -> bool:
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import (
+            implicit_multiplication_application,
+            parse_expr,
+            standard_transformations,
+        )
+
+        tf = standard_transformations + (implicit_multiplication_application,)
+        ea = parse_expr(a.replace("^", "**"), transformations=tf)
+        eb = parse_expr(b.replace("^", "**"), transformations=tf)
+        return bool(sympy.simplify(ea - eb) == 0)
+    except Exception:
+        return False
+
+
+def math_equal(pred: str | None, gold: str | None) -> bool:
+    if pred is None or gold is None:
+        return False
+    p, g = normalize_answer(pred), normalize_answer(gold)
+    if not p or not g:
+        return False
+    if p == g:
+        return True
+    pn, gn = _to_number(p), _to_number(g)
+    if pn is not None and gn is not None:
+        return abs(pn - gn) <= 1e-6 * max(1.0, abs(gn))
+    if pn is not None or gn is not None:
+        # one side numeric, other symbolic: try sympy numeric evaluation
+        pass
+    return _sympy_equal(p, g)
+
+
+# ---------------------------------------------------------------------------
+# Reward entry points
+# ---------------------------------------------------------------------------
+
+
+def process_results(completion: str, gold: str) -> int:
+    """1 if the completion's extracted answer matches gold (reference
+    math_parser.process_results semantics)."""
+    pred = extract_answer(completion)
+    gold_ans = extract_answer(gold) or gold
+    return int(math_equal(pred, gold_ans))
+
+
+def math_verify_reward(
+    prompt: str | None,
+    completion: str | None,
+    prompt_ids: Any = None,
+    completion_ids: Any = None,
+    answer: str | None = None,
+    solution: str | None = None,
+    **kwargs,
+) -> float:
+    """RLVR reward fn signature used by workflows: gold comes from the
+    dataset row's ``answer`` (gsm8k-style) or ``solution`` field."""
+    gold = answer if answer is not None else solution
+    if completion is None or gold is None:
+        return 0.0
+    return float(process_results(completion, str(gold)))

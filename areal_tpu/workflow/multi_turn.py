@@ -17,7 +17,10 @@ from areal_tpu.api.cli_args import GenerationHyperparameters
 from areal_tpu.api.io_struct import ModelRequest
 from areal_tpu.api.reward_api import AsyncRewardWrapper
 from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import logging
 from areal_tpu.utils.data import concat_padded_tensors
+
+logger = logging.getLogger("MultiTurnWorkflow")
 
 
 class MultiTurnWorkflow(RolloutWorkflow):
@@ -45,7 +48,7 @@ class MultiTurnWorkflow(RolloutWorkflow):
         self.turn_discount = turn_discount
         self.retry_prompt = retry_prompt
 
-    def _continuation_ids(self, messages, completion_str: str) -> list[int]:
+    def _continuation_ids(self, messages, completion_str: str) -> list[int] | None:
         """Token ids for the chat-format glue between a raw assistant
         completion and the next user (retry) turn.
 
@@ -65,8 +68,11 @@ class MultiTurnWorkflow(RolloutWorkflow):
         s2 = self.tokenizer.apply_chat_template(
             with_retry, tokenize=False, add_generation_prompt=True
         )
-        delta = s2[len(s1) :] if s2.startswith(s1) else s2
-        return self.tokenizer.encode(delta, add_special_tokens=False)
+        if not s2.startswith(s1):
+            # template re-render is not append-only (e.g. injects a per-render
+            # header): splicing anything would corrupt the token stream
+            return None
+        return self.tokenizer.encode(s2[len(s1) :], add_special_tokens=False)
 
     async def arun_episode(self, engine, data: dict[str, Any]):
         messages = list(data["messages"])
@@ -103,12 +109,18 @@ class MultiTurnWorkflow(RolloutWorkflow):
                 resp.output_tokens,
                 **{k: v for k, v in data.items() if k != "messages"},
             )
+            reward = r * discount
             if r > 0:
-                reward = r * discount
                 break
             if turn + 1 >= self.max_turns:
                 break
             glue = self._continuation_ids(messages, completion_str)
+            if glue is None:
+                logger.warning(
+                    "chat template is not append-only; ending episode at turn %d",
+                    turn,
+                )
+                break
             seq += glue
             loss_mask += [0] * len(glue)
             logprobs += [0.0] * len(glue)

@@ -1,0 +1,155 @@
+"""GSM8K SFT entry point — the minimum end-to-end workload (reference:
+examples/math/gsm8k_sft.py + SURVEY §3.5): packed cross-entropy on the GSPMD
+mesh, saver/evaluator/recover wiring, no inference engine.
+
+    python examples/gsm8k_sft.py --config examples/configs/gsm8k_sft.yaml
+"""
+
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np  # noqa: E402
+
+from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402
+from areal_tpu.api.cli_args import SFTConfig, load_expr_config  # noqa: E402
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo  # noqa: E402
+from areal_tpu.dataset import get_custom_dataset  # noqa: E402
+from areal_tpu.engine.sft.lm_engine import TPULMEngine  # noqa: E402
+from areal_tpu.utils import logging, stats_tracker  # noqa: E402
+from areal_tpu.utils.data import pad_sequences_to_tensors  # noqa: E402
+from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
+from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
+from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+
+logger = logging.getLogger("gsm8k_sft")
+
+
+def main(argv=None):
+    cfg, _ = load_expr_config(argv, SFTConfig)
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+
+    rows = get_custom_dataset(
+        cfg.train_dataset.path,
+        split="train",
+        type="sft",
+        tokenizer=tokenizer,
+        max_length=cfg.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        rows,
+        cfg.train_dataset.batch_size,
+        shuffle=cfg.train_dataset.shuffle,
+        seed=cfg.seed,
+        drop_last=cfg.train_dataset.drop_last,
+        collate_fn=pad_sequences_to_tensors,
+    )
+    valid_loader = None
+    if cfg.valid_dataset is not None and cfg.valid_dataset.path:
+        valid_rows = get_custom_dataset(
+            cfg.valid_dataset.path,
+            split="test",
+            type="sft",
+            tokenizer=tokenizer,
+            max_length=cfg.valid_dataset.max_length,
+        )
+        valid_loader = StatefulDataLoader(
+            valid_rows,
+            cfg.valid_dataset.batch_size,
+            shuffle=False,
+            drop_last=False,
+            collate_fn=pad_sequences_to_tensors,
+        )
+
+    ft_spec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        dataset_size=len(rows),
+        train_batch_size=cfg.train_dataset.batch_size,
+    )
+    total_steps = cfg.total_train_steps or ft_spec.total_train_steps
+
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    engine = TPULMEngine(cfg.model)
+    engine.create_process_group(alloc.train)
+    engine.initialize(None, ft_spec)
+
+    saver = Saver(cfg.saver, ft_spec)
+    evaluator = Evaluator(cfg.evaluator, ft_spec)
+    recover_handler = RecoverHandler(cfg.recover, ft_spec)
+    slogger = StatsLogger(cfg.stats_logger, ft_spec)
+
+    start_step = 0
+    if check_if_recover(cfg.recover):
+        info = recover_handler.load(
+            engine,
+            saver,
+            evaluator,
+            dataloader,
+            fileroot=cfg.cluster.fileroot,
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+            config=cfg,
+        )
+        if info is not None:
+            start_step = info.last_step_info.global_step + 1
+
+    data_iter = iter(dataloader)
+    losses = []
+    for global_step in range(start_step, total_steps):
+        step_info = StepInfo(
+            epoch=global_step // ft_spec.steps_per_epoch,
+            epoch_step=global_step % ft_spec.steps_per_epoch,
+            global_step=global_step,
+            steps_per_epoch=ft_spec.steps_per_epoch,
+        )
+        try:
+            batch = next(data_iter)
+        except StopIteration:
+            data_iter = iter(dataloader)
+            batch = next(data_iter)
+
+        with stats_tracker.record_timing("train_step"):
+            stats = engine.train_lm(batch)
+            engine.step_lr_scheduler()
+        losses.append(stats["loss"])
+
+        def eval_fn():
+            if valid_loader is None:
+                return
+            vl = [engine.evaluate_lm(vb) for vb in valid_loader]
+            vl = [x for x in vl if x is not None]
+            if vl:
+                stats_tracker.scalar(eval_loss=float(np.mean(vl)))
+
+        saver.save(engine, step_info, tokenizer=tokenizer)
+        evaluator.evaluate(eval_fn, step_info)
+        recover_handler.dump(
+            engine,
+            step_info,
+            saver,
+            evaluator,
+            dataloader,
+            slogger,
+            fileroot=cfg.cluster.fileroot,
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+            tokenizer=tokenizer,
+            config=cfg,
+        )
+        stats.update(stats_tracker.export())
+        slogger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
+
+    logger.info("final loss %.4f (start %.4f)", losses[-1], losses[0])
+    slogger.close()
+    engine.destroy()
+    return losses
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,60 @@
+"""Math reward parser (reference: realhf/tests/reward/test_math_reward.py)."""
+
+import pytest
+
+from areal_tpu.reward.math_parser import (
+    extract_answer,
+    math_equal,
+    math_verify_reward,
+    process_results,
+)
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("The answer is \\boxed{42}", "42"),
+        ("so \\boxed{\\frac{1}{2}} is final", "\\frac{1}{2}"),
+        ("nested \\boxed{a_{1} + b}", "a_{1} + b"),
+        ("earlier \\boxed{1} then \\boxed{2}", "2"),
+        ("blah blah #### 18", "18"),
+        ("#### 1,234", "1,234"),
+        ("The final answer is 7.", "7"),
+        ("we get 3 then 12 then 99", "99"),
+        ("", None),
+    ],
+)
+def test_extract_answer(text, expected):
+    assert extract_answer(text) == expected
+
+
+@pytest.mark.parametrize(
+    "pred,gold,eq",
+    [
+        ("42", "42", True),
+        ("42", "43", False),
+        ("1,234", "1234", True),
+        ("0.5", "\\frac{1}{2}", True),
+        ("1/2", "0.5", True),
+        ("\\frac{2}{4}", "1/2", True),
+        ("2*x+1", "1+2x", True),
+        ("x^2", "x*x", True),
+        ("sqrt(4)", "2", True),
+        ("3.14159", "3.1416", False),
+        ("7 dollars", "7", True),
+        ("50%", "50", True),
+        ("$12", "12", True),
+        (None, "1", False),
+    ],
+)
+def test_math_equal(pred, gold, eq):
+    assert math_equal(pred, gold) is eq
+
+
+def test_process_results_and_reward():
+    assert process_results("long reasoning ... #### 18", "#### 18") == 1
+    assert process_results("\\boxed{9}", "9") == 1
+    assert process_results("#### 8", "#### 18") == 0
+    assert math_verify_reward(None, "ans #### 12", answer="12") == 1.0
+    assert math_verify_reward(None, "ans #### 12", solution="#### 12") == 1.0
+    assert math_verify_reward(None, None, answer="12") == 0.0

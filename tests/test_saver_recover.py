@@ -1,0 +1,176 @@
+"""Saver/Evaluator frequency control + full recover dump/load roundtrip."""
+
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    EvaluatorConfig,
+    OptimizerConfig,
+    RecoverConfig,
+    SaverConfig,
+    TrainEngineConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo
+from areal_tpu.engine.sft.lm_engine import TPULMEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Evaluator, FreqTimer, Saver
+
+
+def make_engine():
+    cfg = TrainEngineConfig(
+        path="", init_from_scratch=True, optimizer=OptimizerConfig(lr=1e-3)
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 32
+    eng = TPULMEngine(cfg)
+    eng.initialize(
+        None,
+        None,
+        model_config=tiny_config(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+        ),
+    )
+    return eng
+
+
+def step(i, spe=4):
+    return StepInfo(epoch=i // spe, epoch_step=i % spe, global_step=i, steps_per_epoch=spe)
+
+
+def test_freq_timer_steps():
+    t = FreqTimer(freq_steps=3)
+    fired = [t.should_fire(step(i), False) for i in range(6)]
+    assert fired == [False, False, True, False, False, True]
+
+
+def test_freq_timer_epochs():
+    t = FreqTimer(freq_epochs=1)
+    assert not t.should_fire(step(1), False)
+    assert t.should_fire(step(3), True)
+
+
+def test_saver_fires_on_freq(tmp_path):
+    eng = make_engine()
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    saver = Saver(
+        SaverConfig(
+            freq_steps=2,
+            experiment_name="s",
+            trial_name="t",
+            fileroot=str(tmp_path),
+        ),
+        ft,
+    )
+    assert saver.save(eng, step(0)) is None
+    path = saver.save(eng, step(1))
+    assert path is not None and os.path.isfile(os.path.join(path, "model.safetensors"))
+    eng.destroy()
+
+
+def test_check_if_recover_env(monkeypatch):
+    assert not check_if_recover(RecoverConfig(mode="disabled"))
+    assert check_if_recover(RecoverConfig(mode="resume"))
+    monkeypatch.setenv("AREAL_RECOVER_RUN", "1")
+    assert check_if_recover(RecoverConfig(mode="fault"))
+    monkeypatch.delenv("AREAL_RECOVER_RUN")
+    assert not check_if_recover(RecoverConfig(mode="fault"), run_id=0)
+    assert check_if_recover(RecoverConfig(mode="fault"), run_id=1)
+
+
+def test_recover_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    data = dict(
+        input_ids=rng.integers(1, 128, size=(4, 16)).astype(np.int32),
+        attention_mask=np.ones((4, 16), np.int32),
+        loss_mask=np.ones((4, 16), np.int32),
+    )
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+
+    eng = make_engine()
+    eng.train_lm(data)  # one step so optimizer state is non-trivial
+    eng.set_version(5)
+    dl = StatefulDataLoader(list(range(16)), batch_size=4, seed=3)
+    it = iter(dl)
+    next(it)
+    saver = Saver(SaverConfig(freq_steps=1), ft)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    root = handler.dump(
+        eng,
+        step(2),
+        saver,
+        None,
+        dl,
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+        config=None,
+        force=True,
+    )
+    assert root is not None
+    ref_params = eng.params
+
+    eng2 = make_engine()
+    dl2 = StatefulDataLoader(list(range(16)), batch_size=4, seed=3)
+    handler2 = RecoverHandler(RecoverConfig(mode="fault"), ft)
+    info = handler2.load(
+        eng2,
+        None,
+        None,
+        dl2,
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+    )
+    assert info is not None
+    assert info.last_step_info.global_step == 2
+    assert dl2.state_dict() == dl.state_dict()
+    # weights restored exactly
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_params), jax.tree_util.tree_leaves(eng2.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # training continues from restored state without error
+    stats = eng2.train_lm(data)
+    assert np.isfinite(stats["loss"])
+    eng.destroy()
+    eng2.destroy()
+
+
+def test_config_hash_mismatch_refuses(tmp_path):
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    eng = make_engine()
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    cfg_a = SaverConfig(freq_steps=1)
+    cfg_b = SaverConfig(freq_steps=2)
+    handler.dump(
+        eng,
+        step(0),
+        None,
+        None,
+        None,
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+        config=cfg_a,
+        force=True,
+    )
+    with pytest.raises(RuntimeError, match="config hash"):
+        handler.load(
+            eng,
+            fileroot=str(tmp_path),
+            experiment_name="e",
+            trial_name="t",
+            config=cfg_b,
+        )
+    eng.destroy()

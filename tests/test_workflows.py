@@ -1,0 +1,118 @@
+"""RLVRWorkflow / MultiTurnWorkflow against a stub inference engine."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+from areal_tpu.utils.testing import make_toy_tokenizer
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    return make_toy_tokenizer(str(tmp_path_factory.mktemp("tok")))
+
+
+class StubEngine:
+    """Echoes a scripted completion per call; tags versions."""
+
+    def __init__(self, tokenizer, completions):
+        self.tokenizer = tokenizer
+        self.completions = list(completions)
+        self.calls = []
+        self.version = 0
+
+    def get_version(self):
+        return self.version
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        self.calls.append(req)
+        text = self.completions[min(len(self.calls) - 1, len(self.completions) - 1)]
+        out = self.tokenizer.encode(text, add_special_tokens=False)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.5] * len(out),
+            output_versions=[self.version] * len(out),
+            stop_reason="stop",
+        )
+
+
+def reward_fn(prompt, completion, prompt_ids, completion_ids, answer=None, **kw):
+    return 1.0 if answer is not None and f"#### {answer}" in (completion or "") else 0.0
+
+
+def test_rlvr_episode_shapes_and_rewards(tokenizer):
+    eng = StubEngine(tokenizer, ["thinking... #### 7", "wrong #### 9"])
+    wf = RLVRWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=2, max_new_tokens=32),
+        tokenizer,
+        in_process_reward=True,
+    )
+    data = {"messages": [{"role": "user", "content": "What is 3 + 4?"}], "answer": "7"}
+    traj = asyncio.run(wf.arun_episode(eng, data))
+    assert traj["input_ids"].shape[0] == 2
+    rewards = np.asarray(traj["rewards"])
+    assert sorted(rewards.tolist()) == [0.0, 1.0]
+    # loss mask covers exactly the generated tokens
+    lm = np.asarray(traj["loss_mask"])
+    am = np.asarray(traj["attention_mask"])
+    assert (lm <= am).all()
+    assert lm.sum() > 0
+    # behavior logprobs recorded on generated positions
+    lp = np.asarray(traj["logprobs"])
+    assert np.allclose(lp[lm.astype(bool)], -0.5)
+    assert (np.asarray(traj["versions"])[lm.astype(bool)] == 0).all()
+
+
+def test_multi_turn_retries_then_succeeds(tokenizer):
+    eng = StubEngine(tokenizer, ["bad answer", "still bad", "now #### 7"])
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(max_new_tokens=32),
+        tokenizer,
+        max_turns=3,
+        turn_discount=0.5,
+        in_process_reward=True,
+    )
+    data = {"messages": [{"role": "user", "content": "What is 3 + 4?"}], "answer": "7"}
+    traj = asyncio.run(wf.arun_episode(eng, data))
+    assert len(eng.calls) == 3
+    # success on turn 3 => discount 0.5^2
+    assert float(np.asarray(traj["rewards"])[0]) == pytest.approx(0.25)
+    # the next turn's prompt must extend the previous token stream exactly
+    ids = np.asarray(traj["input_ids"])[0]
+    lm = np.asarray(traj["loss_mask"])[0]
+    n = int(np.asarray(traj["attention_mask"])[0].sum())
+    assert lm[: len(eng.calls[0].input_ids)].sum() == 0  # initial prompt masked
+    # turn-2 request prompt == recorded stream prefix (splice correctness)
+    second_req = eng.calls[1]
+    assert list(ids[: len(second_req.input_ids)]) == list(second_req.input_ids)
+    # total stream = turn-3 prompt + turn-3 completion
+    assert n == len(eng.calls[2].input_ids) + len(
+        tokenizer.encode("now #### 7", add_special_tokens=False)
+    )
+
+
+def test_multi_turn_final_negative_reward_kept(tokenizer):
+    def neg_reward(prompt, completion, p_ids, c_ids, **kw):
+        return -1.0
+
+    eng = StubEngine(tokenizer, ["bad"])
+    wf = MultiTurnWorkflow(
+        neg_reward,
+        GenerationHyperparameters(max_new_tokens=8),
+        tokenizer,
+        max_turns=2,
+        turn_discount=0.5,
+        in_process_reward=True,
+    )
+    data = {"messages": [{"role": "user", "content": "Q"}]}
+    traj = asyncio.run(wf.arun_episode(eng, data))
+    # final-turn failure reward is recorded (with its discount), not clamped to 0
+    assert float(np.asarray(traj["rewards"])[0]) == pytest.approx(-0.5)
