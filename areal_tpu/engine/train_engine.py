@@ -169,6 +169,29 @@ class TPUTrainEngine(TrainEngine):
         if self.mesh is None:
             self.create_process_group(None)
         cfg = self.config
+        from areal_tpu.ops.attention import set_attention_impl, set_ring_context
+
+        n_tok_shards = 1
+        if self.mesh is not None:
+            n_tok_shards = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("cp", 1)
+        if cfg.attn_impl != "auto":
+            set_attention_impl(cfg.attn_impl)
+        elif n_tok_shards == 1 and self.mesh is not None and self.mesh.shape.get("tp", 1) > 1:
+            # tp-only sharding: the raw Pallas call has no GSPMD partitioning
+            # rule (it would replicate head compute); the einsum path
+            # partitions over heads natively
+            set_attention_impl("xla")
+        else:
+            set_attention_impl("auto")
+        if n_tok_shards > 1:
+            # tokens are sharded over (dp, cp): ring attention over the
+            # flattened axes is exactly equal to global packed attention
+            # (memory O((T/n)^2) per step) and is the only dispatch that
+            # partitions instead of replicating — a bare pallas_call under
+            # GSPMD would all-gather the full stream on every device
+            set_ring_context(self.mesh, ("dp", "cp"))
+        else:
+            set_ring_context(None)  # don't inherit a stale mesh
         if model_config is not None:
             self.model_config = model_config
         else:
@@ -201,6 +224,9 @@ class TPUTrainEngine(TrainEngine):
         return self
 
     def destroy(self):
+        from areal_tpu.ops.attention import set_ring_context
+
+        set_ring_context(None)  # drop the mesh reference + stale dispatch
         self.params = None
         self.opt_state = None
         self._jit_cache.clear()

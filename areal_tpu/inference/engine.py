@@ -103,6 +103,13 @@ class GenerationEngine:
             np.asarray(devices[:tp]).reshape(1, 1, 1, tp), MESH_AXES
         )
 
+        if tp > 1:
+            # heads are tp-sharded under GSPMD; the einsum attention path
+            # partitions over heads, the bare Pallas call would not
+            from areal_tpu.ops.attention import set_attention_impl
+
+            set_attention_impl("xla")
+
         if model_config is None:
             if not config.model_path:
                 raise ValueError("need model_config or config.model_path")

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from areal_tpu.models.config import TransformerConfig
-from areal_tpu.ops.attention import decode_attention_xla, packed_attention_xla
+from areal_tpu.ops.attention import decode_attention_xla, packed_attention
 from areal_tpu.ops.rotary import apply_rope
 
 Params = dict[str, Any]
@@ -160,7 +160,7 @@ def _block(
     q, k, v = _qkv(cfg, lp, h)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    attn = packed_attention_xla(q, k, v, segment_ids)
+    attn = packed_attention(q, k, v, segment_ids)
     x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
     x = x + _mlp(cfg, lp, h)
@@ -241,7 +241,7 @@ def prefill(
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        attn = packed_attention_xla(q, k, v, segment_ids)
+        attn = packed_attention(q, k, v, segment_ids)
         out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(out, lp["ln2"], cfg.rms_norm_eps)
         out = out + _mlp(cfg, lp, h2)

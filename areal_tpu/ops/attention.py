@@ -18,6 +18,93 @@ import jax.numpy as jnp
 
 _NEG_INF = -2.0**30
 
+# module-level attention implementation selector, set by the engines from
+# TrainEngineConfig.attn_impl
+# ("auto" | "pallas" | "xla" | "pallas_interpret" | "ring")
+_ATTN_IMPL = "auto"
+_FLASH_BLOCK = 128
+# (mesh, token_axes, ring_axis) installed by the train engine when the mesh
+# has a context-parallel axis; "auto"/"ring" dispatch to ring attention then
+_RING_CTX = None
+
+
+def set_attention_impl(impl: str):
+    global _ATTN_IMPL
+    assert impl in ("auto", "pallas", "xla", "pallas_interpret", "ring"), impl
+    _ATTN_IMPL = impl
+
+
+def get_attention_impl() -> str:
+    return _ATTN_IMPL
+
+
+def set_ring_context(mesh, token_axes=("dp", "cp"), ring_axis=None):
+    """Install (or clear, with mesh=None) the context-parallel ring setup.
+    ring_axis=None rings over all token axes flattened (always-correct
+    default — see ops/ring_attention.py)."""
+    global _RING_CTX
+    if mesh is None:
+        _RING_CTX = None
+    else:
+        _RING_CTX = (mesh, tuple(token_axes), ring_axis or tuple(token_axes))
+
+
+def _ring_enabled() -> bool:
+    if _RING_CTX is None:
+        return False
+    if _ATTN_IMPL == "ring":
+        return True
+    mesh, _, ring_axis = _RING_CTX
+    axes = (ring_axis,) if isinstance(ring_axis, str) else ring_axis
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return _ATTN_IMPL == "auto" and size > 1
+
+
+def _use_pallas(t: int, backend: str | None = None) -> bool:
+    if _ATTN_IMPL == "xla":
+        return False
+    if t % _FLASH_BLOCK != 0:
+        return False
+    if _ATTN_IMPL in ("pallas", "pallas_interpret"):
+        return True
+    return (backend or jax.default_backend()) == "tpu"
+
+
+def packed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    softmax_scale: float | None = None,
+) -> jnp.ndarray:
+    """Dispatch: ring attention when a cp ring context is installed, Pallas
+    flash kernel on TPU (T divisible by the block), fused-einsum XLA path
+    otherwise. Same [T, ...] packed layout in all cases."""
+    if _ring_enabled():
+        from areal_tpu.ops.ring_attention import ring_attention_sharded
+
+        mesh, token_axes, ring_axis = _RING_CTX
+        return ring_attention_sharded(
+            mesh, q, k, v, segment_ids,
+            token_axes=token_axes, ring_axis=ring_axis,
+            softmax_scale=softmax_scale,
+        )
+    if _use_pallas(q.shape[0]):
+        from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
+
+        return flash_attention_packed(
+            q,
+            k,
+            v,
+            segment_ids,
+            softmax_scale,
+            _FLASH_BLOCK,
+            _ATTN_IMPL == "pallas_interpret",
+        )
+    return packed_attention_xla(q, k, v, segment_ids, softmax_scale)
+
 
 def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """[..., KH, D] -> [..., KH*n_rep, D] (GQA head expansion)."""

@@ -1,0 +1,368 @@
+"""Packed (segment-id) causal flash attention — Pallas TPU kernel.
+
+The TPU replacement for the reference's `flash_attn_varlen_func` usage
+(realhf/impl/model/modules/attn.py, SURVEY §2.1 flash-attn row), built for
+this framework's native data layout: one packed 1D token stream
+``q [T, NH, D], k/v [T, KH, D], segment_ids [T]`` (pad = -1) — no batch dim,
+no cu_seqlens; the segment ids carry the variable-length structure.
+
+Design (tpu-first):
+- classic flash accumulation (running max / denominator / accumulator in VMEM
+  scratch) over a ``(heads, q_blocks, kv_blocks)`` grid with the kv dimension
+  innermost-sequential;
+- **block skipping via scalar prefetch**: per-block segment-id ranges live in
+  SMEM; a (q_block, kv_block) pair runs only if causally reachable AND the
+  segment ranges overlap. Packed batches of many short sequences therefore
+  cost O(sum_i L_i^2) like varlen flash-attn, not O(T^2);
+- GQA folded into the index maps (kv head = q head // group) — no
+  ``repeat_kv`` materialization;
+- custom VJP with recomputation: dq kernel over (heads, q_blocks, kv_blocks),
+  dk/dv kernel over (heads, kv_blocks, q_blocks) at full q-head resolution,
+  group-summed outside the kernel.
+
+T must be a multiple of the block size (the engine pads packed microbatches
+to ``pad_mb_to_multiple`` — cli_args.EngineBackendConfig); padding tokens use
+segment_id=-1 and produce zero output rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK = 128
+
+
+def _seg_ranges(segment_ids: jnp.ndarray, block: int):
+    """Per-block [min, max] over valid (>=0) segment ids; [-2,-2] if the
+    whole block is padding (-2 never matches a real segment or -1)."""
+    s = segment_ids.reshape(-1, block)
+    valid = s >= 0
+    big = jnp.int32(1 << 30)
+    mn = jnp.min(jnp.where(valid, s, big), axis=1)
+    mx = jnp.max(jnp.where(valid, s, -big), axis=1)
+    any_valid = valid.any(axis=1)
+    mn = jnp.where(any_valid, mn, -2).astype(jnp.int32)
+    mx = jnp.where(any_valid, mx, -2).astype(jnp.int32)
+    return mn, mx
+
+
+def _block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk):
+    causal = (ki * bk) <= (qi * bq + bq - 1)
+    overlap = (kmax[ki] >= qmin[qi]) & (kmin[ki] <= qmax[qi])
+    valid = (qmax[qi] >= 0) & (kmax[ki] >= 0)
+    return causal & overlap & valid
+
+
+def _mask(segq, segk, qi, ki, bq, bk):
+    qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return (kpos <= qpos) & (segq == segk.T) & (segq >= 0)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    qmin, qmax, kmin, kmax,  # scalar-prefetch SMEM refs [nq]/[nk]
+    q_ref, k_ref, v_ref, segq_ref, segk_ref,
+    o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale: float, bq: int, bk: int, nk: int,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    def _compute():
+        q = q_ref[:, 0, :]
+        k = k_ref[:, 0, :]
+        v = v_ref[:, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_scr[:, :] = alpha * l_scr[:, :] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[:, :] = m_cur
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :]
+        m = m_scr[:, :]
+        # rows with no valid key (padding, or empty causal window) still have
+        # m == NEG_INF; their p = exp(NEG_INF - NEG_INF) = 1 polluted acc/l,
+        # so zero them explicitly
+        valid = m > NEG_INF / 2
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o = jnp.where(valid, acc_scr[:, :] / safe_l, 0.0)
+        o_ref[:, 0, :] = o.astype(o_ref.dtype)
+        lse = jnp.where(valid & (l > 0.0), m + jnp.log(safe_l), NEG_INF)
+        lse_ref[0, :] = lse[:, 0]
+
+
+def _fwd(q, k, v, segment_ids, scale, block: int, interpret: bool):
+    t, nh, d = q.shape
+    kh = k.shape[1]
+    group = nh // kh
+    bq = bk = min(block, t)
+    assert t % bq == 0, (t, bq)
+    nq, nk = t // bq, t // bk
+    seg2d = segment_ids.reshape(t, 1).astype(jnp.int32)
+    qmn, qmx = _seg_ranges(segment_ids, bq)
+    kmn, kmx = _seg_ranges(segment_ids, bk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+            pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+            pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+            pl.BlockSpec((bq, 1), lambda h, qi, ki, *_: (qi, 0)),
+            pl.BlockSpec((bk, 1), lambda h, qi, ki, *_: (ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+            pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    out_shapes = [
+        jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+        jax.ShapeDtypeStruct((nh, t), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    qmin, qmax, kmin, kmax,
+    q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_scr,
+    *, scale: float, bq: int, bk: int, nk: int,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    def _compute():
+        q = q_ref[:, 0, :]
+        k = k_ref[:, 0, :]
+        v = v_ref[:, 0, :]
+        do = do_ref[:, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, :][:, None]  # [bq, 1]
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        delta = delta_ref[0, :][:, None]
+        ds = p * (dp - delta) * scale
+        dq_scr[:, :] += jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[:, 0, :] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    qmin, qmax, kmin, kmax,
+    q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, bq: int, bk: int, nq: int,
+):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    def _compute():
+        q = q_ref[:, 0, :]
+        k = k_ref[:, 0, :]
+        v = v_ref[:, 0, :]
+        do = do_ref[:, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, :][:, None]
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_scr[:, :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        delta = delta_ref[0, :][:, None]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+        dk_scr[:, :] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[:, 0, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[:, 0, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def _bwd(block, interpret, scale, res, dout):
+    q, k, v, segment_ids, o, lse = res
+    t, nh, d = q.shape
+    kh = k.shape[1]
+    group = nh // kh
+    bq = bk = min(block, t)
+    nq, nk = t // bq, t // bk
+    seg2d = segment_ids.reshape(t, 1).astype(jnp.int32)
+    qmn, qmx = _seg_ranges(segment_ids, bq)
+    kmn, kmx = _seg_ranges(segment_ids, bk)
+    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1).T  # [NH, T]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(nh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+                pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+                pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+                pl.BlockSpec((bq, 1), lambda h, qi, ki, *_: (qi, 0)),
+                pl.BlockSpec((bk, 1), lambda h, qi, ki, *_: (ki, 0)),
+                pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+                pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
+                pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
+            ],
+            out_specs=pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d, dout, lse, delta)
+
+    # dk/dv at full q-head resolution, summed over the GQA group afterwards
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(nh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((bq, 1, d), lambda h, ki, qi, *_: (qi, h, 0)),
+                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h // group, 0)),
+                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h // group, 0)),
+                pl.BlockSpec((bq, 1), lambda h, ki, qi, *_: (qi, 0)),
+                pl.BlockSpec((bk, 1), lambda h, ki, qi, *_: (ki, 0)),
+                pl.BlockSpec((bq, 1, d), lambda h, ki, qi, *_: (qi, h, 0)),
+                pl.BlockSpec((1, bq), lambda h, ki, qi, *_: (h, qi)),
+                pl.BlockSpec((1, bq), lambda h, ki, qi, *_: (h, qi)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h, 0)),
+                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+            jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d, dout, lse, delta)
+
+    dk = dk_full.reshape(t, kh, group, d).sum(axis=2).astype(k.dtype)
+    dv = dv_full.reshape(t, kh, group, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_packed(
+    q: jnp.ndarray,  # [T, NH, D]
+    k: jnp.ndarray,  # [T, KH, D]
+    v: jnp.ndarray,  # [T, KH, D]
+    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
+    softmax_scale: float | None = None,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    o, _ = _fwd(q, k, v, segment_ids, scale, block, interpret)
+    return o
+
+
+def _vjp_fwd(q, k, v, segment_ids, softmax_scale, block, interpret):
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    o, lse = _fwd(q, k, v, segment_ids, scale, block, interpret)
+    return o, (q, k, v, segment_ids, o, lse)
+
+
+def _vjp_bwd(softmax_scale, block, interpret, res, dout):
+    q = res[0]
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    return _bwd(block, interpret, scale, res, dout)
+
+
+flash_attention_packed.defvjp(_vjp_fwd, _vjp_bwd)

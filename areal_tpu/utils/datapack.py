@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from areal_tpu.utils import native
+
 
 def ffd_allocate(
     sizes: list[int] | np.ndarray,
@@ -32,21 +34,30 @@ def ffd_allocate(
         raise ValueError(
             f"Item of size {int(sizes.max())} exceeds bin capacity {capacity}"
         )
-    order = np.argsort(-sizes, kind="stable")
-    bins: list[list[int]] = []
-    loads: list[int] = []
-    for idx in order:
-        size = int(sizes[idx])
-        placed = False
-        for b in range(len(bins)):
-            if loads[b] + size <= capacity:
-                bins[b].append(int(idx))
-                loads[b] += size
-                placed = True
-                break
-        if not placed:
-            bins.append([int(idx)])
-            loads.append(size)
+    native_result = native.ffd_group_ids(sizes, capacity)
+    if native_result is not None:
+        n_bins, gids = native_result
+        bins = [[] for _ in range(n_bins)]
+        loads = [0] * n_bins
+        for i, g in enumerate(gids.tolist()):
+            bins[g].append(i)
+            loads[g] += int(sizes[i])
+    else:
+        order = np.argsort(-sizes, kind="stable")
+        bins = []
+        loads = []
+        for idx in order:
+            size = int(sizes[idx])
+            placed = False
+            for b in range(len(bins)):
+                if loads[b] + size <= capacity:
+                    bins[b].append(int(idx))
+                    loads[b] += size
+                    placed = True
+                    break
+            if not placed:
+                bins.append([int(idx)])
+                loads.append(size)
     while len(bins) < min_groups:
         # split the heaviest multi-item bin
         cand = sorted(
@@ -88,13 +99,18 @@ def partition_balanced(sizes: list[int] | np.ndarray, k: int) -> list[list[int]]
     n = len(sizes)
     if k <= 0:
         raise ValueError("k must be positive")
+    gids = native.partition_group_ids(sizes, k)
     groups: list[list[int]] = [[] for _ in range(k)]
-    loads = np.zeros(k, dtype=np.int64)
-    order = np.argsort(-sizes, kind="stable")
-    for idx in order:
-        b = int(np.argmin(loads))
-        groups[b].append(int(idx))
-        loads[b] += int(sizes[idx])
+    if gids is not None:
+        for i, g in enumerate(gids.tolist()):
+            groups[g].append(i)
+    else:
+        loads = np.zeros(k, dtype=np.int64)
+        order = np.argsort(-sizes, kind="stable")
+        for idx in order:
+            b = int(np.argmin(loads))
+            groups[b].append(int(idx))
+            loads[b] += int(sizes[idx])
     for g in groups:
         g.sort()
     if n >= k and any(len(g) == 0 for g in groups):

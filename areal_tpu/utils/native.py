@@ -1,0 +1,209 @@
+"""ctypes bindings to the native host runtime (csrc/areal_host.cpp).
+
+Compiled on demand with g++ into ``<repo>/build/libareal_host.so`` (one-time,
+cached, guarded by an mtime check against the source). Every entry point has a
+pure-Python fallback — ``available()`` is False when no toolchain exists and
+callers in utils/datapack transparently degrade.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("native")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "areal_host.cpp")
+_OUT_DIR = os.path.join(_REPO, "build")
+_SO = os.path.join(_OUT_DIR, "libareal_host.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _build() -> str | None:
+    if os.path.isfile(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    # per-pid temp: launcher-spawned processes may build concurrently, and
+    # os.replace makes the final install atomic either way
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        logger.info("built native host library at %s", _SO)
+        return _SO
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        logger.warning("native build failed (%s); using Python fallbacks", e)
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(so))
+        except OSError as e:
+            logger.warning("native library load failed (%s); Python fallbacks", e)
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.areal_ffd_allocate.restype = ctypes.c_int64
+    lib.areal_ffd_allocate.argtypes = [_I64P, ctypes.c_int64, ctypes.c_int64, _I64P]
+    lib.areal_partition_balanced.restype = ctypes.c_int64
+    lib.areal_partition_balanced.argtypes = [_I64P, ctypes.c_int64, ctypes.c_int64, _I64P]
+    lib.areal_merge_intervals.restype = ctypes.c_int64
+    lib.areal_merge_intervals.argtypes = [_I64P, _I64P, ctypes.c_int64]
+    lib.areal_slice_intervals_f32.restype = None
+    lib.areal_slice_intervals_f32.argtypes = [_F32P, _I64P, _I64P, ctypes.c_int64, _F32P]
+    lib.areal_set_intervals_f32.restype = None
+    lib.areal_set_intervals_f32.argtypes = [_F32P, _I64P, _I64P, ctypes.c_int64, _F32P]
+    lib.areal_gae_1d_packed_f32.restype = None
+    lib.areal_gae_1d_packed_f32.argtypes = [
+        _F32P, _F32P, _I64P, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, _F32P,
+    ]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def ffd_group_ids(sizes: np.ndarray, capacity: int) -> tuple[int, np.ndarray] | None:
+    """Native FFD core: (n_bins, group_ids) or None if unavailable.
+    Raises ValueError when an item exceeds capacity (parity with Python)."""
+    lib = _load()
+    if lib is None:
+        return None
+    sizes = np.ascontiguousarray(sizes, np.int64)
+    out = np.empty(len(sizes), np.int64)
+    nb = lib.areal_ffd_allocate(sizes, len(sizes), capacity, out)
+    if nb < 0:
+        raise ValueError(
+            f"Item of size {int(sizes.max())} exceeds bin capacity {capacity}"
+        )
+    return int(nb), out
+
+
+def partition_group_ids(sizes: np.ndarray, k: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    sizes = np.ascontiguousarray(sizes, np.int64)
+    out = np.empty(len(sizes), np.int64)
+    rc = lib.areal_partition_balanced(sizes, len(sizes), k, out)
+    if rc < 0:
+        raise ValueError("k must be positive")
+    return out
+
+
+def merge_intervals(starts: np.ndarray, ends: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Merged [start, end) intervals (sorted). Python fallback included."""
+    lib = _load()
+    starts = np.ascontiguousarray(starts, np.int64).copy()
+    ends = np.ascontiguousarray(ends, np.int64).copy()
+    if lib is not None:
+        m = lib.areal_merge_intervals(starts, ends, len(starts))
+        return starts[:m], ends[:m]
+    iv = sorted(zip(starts.tolist(), ends.tolist()))
+    ms, me = [], []
+    for s, e in iv:
+        if ms and s <= me[-1]:
+            me[-1] = max(me[-1], e)
+        else:
+            ms.append(s)
+            me.append(e)
+    return np.asarray(ms, np.int64), np.asarray(me, np.int64)
+
+
+def slice_intervals(src: np.ndarray, starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Gather [start, end) slices of a flat fp32 buffer, packed back-to-back
+    (reference csrc/interval_op slice_intervals — used for flattened-param
+    staging in weight transfer)."""
+    src = np.ascontiguousarray(src, np.float32)
+    starts = np.ascontiguousarray(starts, np.int64)
+    ends = np.ascontiguousarray(ends, np.int64)
+    total = int((ends - starts).sum())
+    out = np.empty(total, np.float32)
+    lib = _load()
+    if lib is not None:
+        lib.areal_slice_intervals_f32(src, starts, ends, len(starts), out)
+        return out
+    off = 0
+    for s, e in zip(starts, ends):
+        out[off : off + (e - s)] = src[s:e]
+        off += e - s
+    return out
+
+
+def set_intervals(dst: np.ndarray, starts: np.ndarray, ends: np.ndarray, src: np.ndarray):
+    """Scatter packed fp32 values into [start, end) slices of dst, in place."""
+    assert dst.dtype == np.float32 and dst.flags["C_CONTIGUOUS"]
+    starts = np.ascontiguousarray(starts, np.int64)
+    ends = np.ascontiguousarray(ends, np.int64)
+    src = np.ascontiguousarray(src, np.float32)
+    lib = _load()
+    if lib is not None:
+        lib.areal_set_intervals_f32(dst, starts, ends, len(starts), src)
+        return
+    off = 0
+    for s, e in zip(starts, ends):
+        dst[s:e] = src[off : off + (e - s)]
+        off += e - s
+
+
+def gae_1d_packed(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    cu_seqlens: np.ndarray,
+    gamma: float,
+    lam: float,
+) -> np.ndarray:
+    """Host GAE over packed sequences (cuGAE gae_1d_nolp_misalign semantics:
+    values carries one bootstrap entry extra per sequence)."""
+    rewards = np.ascontiguousarray(rewards, np.float32)
+    values = np.ascontiguousarray(values, np.float32)
+    cu = np.ascontiguousarray(cu_seqlens, np.int64)
+    n_seqs = len(cu) - 1
+    out = np.empty(len(rewards), np.float32)
+    lib = _load()
+    if lib is not None:
+        lib.areal_gae_1d_packed_f32(rewards, values, cu, n_seqs, gamma, lam, out)
+        return out
+    for s in range(n_seqs):
+        r0, r1 = int(cu[s]), int(cu[s + 1])
+        val = values[r0 + s : r1 + s + 1]
+        carry = 0.0
+        for t in range(r1 - r0 - 1, -1, -1):
+            delta = rewards[r0 + t] + gamma * val[t + 1] - val[t]
+            carry = delta + gamma * lam * carry
+            out[r0 + t] = carry
+    return out

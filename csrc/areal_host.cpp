@@ -1,0 +1,147 @@
+// Native host-side runtime ops for areal_tpu.
+//
+// TPU-native counterpart of the reference's csrc/ extensions (SURVEY §2.1):
+// the reference puts GAE and interval scatter/gather on CUDA
+// (csrc/cugae/gae.cu, csrc/interval_op/). On TPU the device-side equivalents
+// are lax.scan / Pallas under jit; what actually runs hot on the HOST here is
+// the microbatch shaping path (FFD bin packing + balanced partition, called
+// for every train_batch) and checkpoint/weight-transfer interval bookkeeping.
+// Those are implemented natively below and bound via ctypes
+// (areal_tpu/utils/native.py), with pure-Python fallbacks kept in sync.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 areal_host.cpp -o libareal_host.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// First-fit-decreasing bin packing under a token budget.
+// sizes[n] -> group_ids[n] (bin index per item). Returns the number of bins,
+// or -1 if any item exceeds capacity. Matches the Python implementation:
+// stable descending order, first bin that fits.
+int64_t areal_ffd_allocate(const int64_t* sizes, int64_t n, int64_t capacity,
+                           int64_t* group_ids) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return sizes[a] > sizes[b];
+  });
+  std::vector<int64_t> loads;
+  loads.reserve(16);
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t idx = order[oi];
+    const int64_t size = sizes[idx];
+    if (size > capacity) return -1;
+    bool placed = false;
+    for (size_t b = 0; b < loads.size(); ++b) {
+      if (loads[b] + size <= capacity) {
+        group_ids[idx] = static_cast<int64_t>(b);
+        loads[b] += size;
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
+      group_ids[idx] = static_cast<int64_t>(loads.size());
+      loads.push_back(size);
+    }
+  }
+  return static_cast<int64_t>(loads.size());
+}
+
+// Greedy LPT k-way partition: stable descending sizes, each item to the
+// least-loaded group (first group on ties, matching numpy argmin).
+int64_t areal_partition_balanced(const int64_t* sizes, int64_t n, int64_t k,
+                                 int64_t* group_ids) {
+  if (k <= 0) return -1;
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return sizes[a] > sizes[b];
+  });
+  std::vector<int64_t> loads(k, 0);
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t idx = order[oi];
+    int64_t best = 0;
+    for (int64_t b = 1; b < k; ++b) {
+      if (loads[b] < loads[best]) best = b;
+    }
+    group_ids[idx] = best;
+    loads[best] += sizes[idx];
+  }
+  return k;
+}
+
+// Merge overlapping/adjacent [start, end) intervals. Arrays are modified in
+// place; returns the merged count. Intervals need not be sorted.
+// (reference: csrc/interval_op/interval_op.cpp merge_intervals)
+int64_t areal_merge_intervals(int64_t* starts, int64_t* ends, int64_t n) {
+  if (n <= 0) return 0;
+  std::vector<std::pair<int64_t, int64_t>> iv(n);
+  for (int64_t i = 0; i < n; ++i) iv[i] = {starts[i], ends[i]};
+  std::sort(iv.begin(), iv.end());
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (m > 0 && iv[i].first <= ends[m - 1]) {
+      ends[m - 1] = std::max(ends[m - 1], iv[i].second);
+    } else {
+      starts[m] = iv[i].first;
+      ends[m] = iv[i].second;
+      ++m;
+    }
+  }
+  return m;
+}
+
+// Gather many [start, end) slices of a flat fp32 buffer into dst (packed
+// back-to-back). dst must hold sum(end - start) elements.
+// (reference: csrc/interval_op slice_intervals_*)
+void areal_slice_intervals_f32(const float* src, const int64_t* starts,
+                               const int64_t* ends, int64_t n, float* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t len = ends[i] - starts[i];
+    std::memcpy(dst + off, src + starts[i], sizeof(float) * len);
+    off += len;
+  }
+}
+
+// Scatter packed src back into many [start, end) slices of dst.
+// (reference: csrc/interval_op set_intervals_*)
+void areal_set_intervals_f32(float* dst, const int64_t* starts,
+                             const int64_t* ends, int64_t n, const float* src) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t len = ends[i] - starts[i];
+    std::memcpy(dst + starts[i], src + off, sizeof(float) * len);
+    off += len;
+  }
+}
+
+// Packed-1D GAE over variable-length sequences (host reference for the
+// device-side lax.scan in utils/functional.py; mirrors cuGAE's
+// gae_1d_nolp_misalign semantics — csrc/cugae/gae.cu:10-28 — one backward
+// lambda-return scan per sequence). rewards/values are packed [total_tokens]
+// with cu_seqlens[n_seqs+1] offsets; values has one extra bootstrap entry per
+// sequence (cu_seqlens indexes rewards; values offset i + seq index).
+void areal_gae_1d_packed_f32(const float* rewards, const float* values,
+                             const int64_t* cu_seqlens, int64_t n_seqs,
+                             float gamma, float lam, float* adv_out) {
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    const int64_t r0 = cu_seqlens[s];
+    const int64_t r1 = cu_seqlens[s + 1];
+    const float* val = values + r0 + s;  // one-longer per sequence
+    float carry = 0.0f;
+    for (int64_t t = r1 - r0 - 1; t >= 0; --t) {
+      const float delta = rewards[r0 + t] + gamma * val[t + 1] - val[t];
+      carry = delta + gamma * lam * carry;
+      adv_out[r0 + t] = carry;
+    }
+  }
+}
+
+}  // extern "C"
