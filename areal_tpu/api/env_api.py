@@ -1,0 +1,38 @@
+"""Async tool-execution environment API (reference: areal/api/env_api.py:5-28).
+
+Agentic workflows (tool-integrated reasoning, search agents) hold an
+``Environment`` per episode: initialize, list tools, execute calls with a
+timeout, close. Concrete example: examples/tir's python-executor environment.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class Environment(abc.ABC):
+    async def ainitialize(self) -> None:
+        """Acquire resources (sandboxes, browsers, connections)."""
+
+    async def aclose(self) -> None:
+        """Release resources."""
+
+    @abc.abstractmethod
+    async def alist_tools(self) -> list[dict[str, Any]]:
+        """Tool schemas (OpenAI function-call format)."""
+        ...
+
+    @abc.abstractmethod
+    async def aexecute(
+        self, tool_name: str, arguments: dict[str, Any], timeout: float = 30.0
+    ) -> tuple[str, bool]:
+        """Run one tool call. Returns (observation_text, success)."""
+        ...
+
+    async def __aenter__(self) -> "Environment":
+        await self.ainitialize()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()

@@ -84,6 +84,34 @@ def process_gsm8k_sft_dataset(
     return out
 
 
+def process_pairs_rw_dataset(
+    rows: list[dict], tokenizer, max_length: int | None = None
+) -> list[dict]:
+    """Preference pairs -> alternating rows (even=chosen, odd=rejected), the
+    layout RWEngine.train_rm consumes (reference: hhrlhf paired RM data,
+    areal/dataset/ hhrlhf builder). Accepts either {prompt, chosen, rejected}
+    text fields or hh-rlhf style {chosen, rejected} full transcripts."""
+    out = []
+    for r in rows:
+        prompt = r.get("prompt") or r.get("question") or ""
+        chosen, rejected = r.get("chosen"), r.get("rejected")
+        if chosen is None or rejected is None:
+            continue
+        for text in (str(prompt) + str(chosen), str(prompt) + str(rejected)):
+            ids = tokenizer.encode(text, add_special_tokens=False)
+            if max_length is not None:
+                ids = ids[:max_length]
+            if not ids:
+                ids = [tokenizer.eos_token_id or 0]
+            out.append(
+                {
+                    "input_ids": np.asarray(ids, np.int64),
+                    "loss_mask": np.ones(len(ids), np.int64),
+                }
+            )
+    return out
+
+
 _PROCESSORS: dict[tuple[str, str], Callable] = {}
 
 
@@ -134,9 +162,19 @@ def get_custom_dataset(
         if tokenizer is None:
             raise ValueError("sft datasets need a tokenizer")
         rows = process_gsm8k_sft_dataset(rows, tokenizer, max_length)
+    elif type == "rw":
+        if tokenizer is None:
+            raise ValueError("rw datasets need a tokenizer")
+        rows = process_pairs_rw_dataset(rows, tokenizer, max_length)
     else:
         raise ValueError(f"unknown dataset type {type!r}")
 
     if world_size > 1:
-        rows = rows[rank::world_size]
+        if type == "rw":
+            # shard at PAIR granularity — rows interleave chosen/rejected and
+            # a row-level stride would hand one rank all-chosen rows
+            pairs = [rows[i : i + 2] for i in range(0, len(rows) - 1, 2)]
+            rows = [x for p in pairs[rank::world_size] for x in p]
+        else:
+            rows = rows[rank::world_size]
     return rows

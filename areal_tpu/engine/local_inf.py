@@ -1,0 +1,135 @@
+"""Colocated in-process inference engine.
+
+The reference's colocated mode runs SGLang inside the trainer process
+(areal/experimental/sglang_engine.py:40, allocation ``jaxgen:..|gspmd:..``);
+here the :class:`GenerationEngine` shares the chip with the train engine and
+weight updates are direct HBM-local array re-placements
+(``update_weights_from_arrays``) — no HTTP, no disk, no NCCL group.
+
+Implements the same ``InferenceEngine`` surface as the remote client, so
+workflows and training scripts are identical across colocated/disaggregated
+allocation modes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+from areal_tpu.api.cli_args import InferenceEngineConfig, JaxGenConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse, WeightUpdateMeta
+from areal_tpu.core.workflow_executor import WorkflowExecutor
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("LocalInfEngine")
+
+
+class LocalInfEngine(InferenceEngine):
+    def __init__(
+        self,
+        config: InferenceEngineConfig,
+        gen_config: JaxGenConfig,
+        model_config=None,
+        params=None,
+        tokenizer=None,
+    ):
+        self.config = config
+        self.engine = GenerationEngine(
+            gen_config, model_config=model_config, params=params, tokenizer=tokenizer
+        )
+        self.executor = WorkflowExecutor(config, self)
+
+    def initialize(self, addr: str | None = None, train_data_parallel_size: int | None = None):
+        self.engine.start()
+        self.executor.initialize(train_data_parallel_size)
+
+    def destroy(self):
+        self.executor.destroy()
+        self.engine.stop()
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_done(resp: ModelResponse):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(resp) if not fut.done() else None
+            )
+
+        self.engine.submit(req.rid, list(req.input_ids), req.gconfig, on_done)
+        resp = await fut
+        # colocated pause aborts like the remote path; splice by re-issuing
+        if resp.stop_reason == "abort" and len(resp.output_tokens) < req.gconfig.max_new_tokens:
+            while self.engine._paused.is_set():
+                await asyncio.sleep(0.05)
+            rest = await self.agenerate(
+                ModelRequest(
+                    rid=req.rid,
+                    input_ids=list(req.input_ids) + resp.output_tokens,
+                    gconfig=req.gconfig.new(
+                        max_new_tokens=req.gconfig.max_new_tokens
+                        - len(resp.output_tokens)
+                    ),
+                    tokenizer=req.tokenizer,
+                )
+            )
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=resp.output_tokens + rest.output_tokens,
+                output_logprobs=resp.output_logprobs + rest.output_logprobs,
+                output_versions=resp.output_versions + rest.output_versions,
+                stop_reason=rest.stop_reason,
+                latency=resp.latency + rest.latency,
+                ttft=resp.ttft,
+                itl=resp.itl + rest.itl,
+                tokenizer=req.tokenizer,
+            )
+        return resp
+
+    def generate(self, req: ModelRequest) -> ModelResponse:
+        return asyncio.run(self.agenerate(req))
+
+    # -- weight updates -------------------------------------------------
+
+    def update_weights(self, meta: WeightUpdateMeta):
+        if meta.type == "disk":
+            assert meta.path is not None
+            self.engine.update_weights_from_disk(meta.path)
+        else:
+            raise ValueError(
+                "device updates go through update_weights_from_arrays "
+                "(driven by TPUTrainEngine.update_weights)"
+            )
+
+    def update_weights_from_arrays(self, params, version: int | None = None):
+        self.engine.update_weights_from_arrays(params, version)
+
+    def get_version(self) -> int:
+        return self.engine.get_version()
+
+    def set_version(self, version: int):
+        self.engine.set_version(version)
+
+    # -- rollout runtime ------------------------------------------------
+
+    def submit(self, data, workflow=None, workflow_builder: Callable | None = None):
+        self.executor.submit(data, workflow, workflow_builder)
+
+    def wait(self, count: int, timeout: float | None = None):
+        return self.executor.wait(count, timeout=timeout)
+
+    def rollout_batch(self, data: list[Any], workflow=None, workflow_builder=None):
+        return self.executor.rollout_batch(data, workflow, workflow_builder)
+
+    def prepare_batch(self, dataloader, workflow=None, workflow_builder=None):
+        return self.executor.prepare_batch(dataloader, workflow, workflow_builder)
+
+    def pause(self):
+        self.engine.pause()
+        self.executor.pause()
+
+    def resume(self):
+        self.engine.resume()
+        self.executor.resume()

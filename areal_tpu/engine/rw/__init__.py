@@ -1,0 +1,1 @@
+from areal_tpu.engine.rw.rw_engine import RWEngine, TPURWEngine  # noqa: F401

@@ -1,0 +1,101 @@
+"""Reward-model training engine: pairwise Bradley-Terry loss.
+
+Behavior parity with the reference's RW engine (areal/engine/rw/rw_engine.py,
+exercised by examples/alignment/hhrlhf_rw.py): the model is the decoder with
+a scalar value head (is_critic=True); each training pair is two adjacent rows
+(even index = chosen, odd = rejected); the score of a sequence is the value
+at its final token; loss = -log sigmoid(score_chosen - score_rejected).
+
+The packed formulation stays jit-static: per-sequence scores come from a
+``segment_sum`` of last-token-masked values with ``num_segments=T`` (an upper
+bound), so no dynamic gathers appear in the graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import TrainEngineConfig
+from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.utils.data import TensorDict
+
+
+def _identity_hook(values, mb):
+    """Module-level (stable identity => engine.forward jit-cache hit)."""
+    return values
+
+
+def rw_pairwise_loss_fn(values: jnp.ndarray, input_data) -> jnp.ndarray:
+    """SUM-reduced pairwise loss over the microbatch's (chosen, rejected)
+    pairs. ``values`` [T] from the critic-headed forward."""
+    seg = input_data["segment_ids"]  # [T], alignment-pad carries its own id
+    t = seg.shape[0]
+    nxt = jnp.concatenate([seg[1:], jnp.full((1,), -3, seg.dtype)])
+    is_last = (seg != nxt) & (seg >= 0)
+    scores = jax.ops.segment_sum(
+        jnp.where(is_last, values, 0.0), jnp.clip(seg, 0, t - 1), num_segments=t
+    )  # [T]; entry s = last-token value of sequence s (packed order)
+    n_seqs = input_data["pair_mask"].shape[0]
+    s = scores[:n_seqs].reshape(-1, 2)  # [n_pairs, 2] chosen|rejected
+    pair_ok = input_data["pair_mask"].reshape(-1, 2)[:, 0].astype(bool)
+    margin = s[:, 0] - s[:, 1]
+    loss = -jax.nn.log_sigmoid(margin)
+    return jnp.sum(jnp.where(pair_ok, loss, 0.0))
+
+
+class RWEngine:
+    """Algorithm wrapper (reference RWEngine pattern)."""
+
+    def __init__(self, engine: TPUTrainEngine):
+        self.engine = engine
+
+    def train_rm(self, data: TensorDict) -> dict[str, float]:
+        """``data``: padded batch where rows 2i / 2i+1 are the chosen /
+        rejected completions of pair i (input_ids + attention_mask [+
+        loss_mask])."""
+        bs = np.asarray(data["attention_mask"]).shape[0]
+        assert bs % 2 == 0, "reward-model batches are (chosen, rejected) pairs"
+        lens = np.asarray(data["attention_mask"]).sum(-1)
+        pair_lens = lens.reshape(-1, 2).sum(-1)
+        budget = self.engine.config.mb_spec.max_tokens_per_mb
+        if pair_lens.max() > budget:
+            raise ValueError(
+                f"a (chosen, rejected) pair spans {int(pair_lens.max())} tokens "
+                f"> max_tokens_per_mb={budget}; pairs cannot split across "
+                "microbatches — raise mb_spec.max_tokens_per_mb or lower the "
+                "dataset max_length (<= budget // 2)"
+            )
+        data = dict(data)
+        data["pair_mask"] = np.ones(bs, np.int64)
+        self.engine.train()
+        return self.engine.train_batch(
+            data,
+            loss_fn=rw_pairwise_loss_fn,
+            loss_weight_fn=lambda x: len(np.asarray(x["pair_mask"])) // 2,
+            group_size=2,  # a pair never splits across microbatches
+        )
+
+    def score(self, data: TensorDict) -> np.ndarray:
+        """Per-sequence scalar scores (value at each sequence's last token),
+        shape [B]."""
+        self.engine.train(False)
+        vals = self.engine.forward(input_=data, post_hook=_identity_hook)
+        vals = np.asarray(vals)  # padded [B, S]
+        lens = np.asarray(data["attention_mask"]).sum(-1).astype(int)
+        return vals[np.arange(len(lens)), lens - 1]
+
+
+class TPURWEngine(TPUTrainEngine):
+    """Engine-fused variant (reference FSDPRWEngine pattern)."""
+
+    def __init__(self, config: TrainEngineConfig):
+        super().__init__(config)
+        self.rw = RWEngine(self)
+
+    def train_rm(self, data: TensorDict) -> dict[str, float]:
+        return self.rw.train_rm(data)
+
+    def score(self, data: TensorDict) -> np.ndarray:
+        return self.rw.score(data)

@@ -296,16 +296,20 @@ class TPUTrainEngine(TrainEngine):
                 )
         return out
 
-    def _prepare_mbs(self, input_: TensorDict) -> tuple[Any, list[TensorDict], list[int]]:
+    def _prepare_mbs(
+        self, input_: TensorDict, group_size: int = 1
+    ) -> tuple[Any, list[TensorDict], list[int]]:
         """Padded batch -> packed, bucketed microbatches (host side).
 
         Reference: base_hf_engine.prepare_mb_list (base_hf_engine.py:257-376).
         Returns (MicroBatchList, packed mbs with positions/segment_ids, real
-        token counts)."""
+        token counts). ``group_size`` keeps row groups (e.g. RM pairs) in one
+        microbatch."""
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_,
             max_tokens_per_mb=self.config.mb_spec.max_tokens_per_mb,
             min_n_mbs=self.config.mb_spec.n_mbs,
+            group_size=group_size,
         )
         multiple = self.config.backend.pad_mb_to_multiple
         packed_mbs, real_ns = [], []
@@ -393,6 +397,7 @@ class TPUTrainEngine(TrainEngine):
         input_: TensorDict,
         loss_fn: Callable,
         loss_weight_fn: Callable,
+        group_size: int = 1,
     ) -> dict[str, float]:
         """Grad-accumulated optimizer step over one padded batch.
 
@@ -401,7 +406,7 @@ class TPUTrainEngine(TrainEngine):
         ``sum(loss_weight_fn(mb))`` (reference: fsdp_engine.py:536-560)."""
         assert self.initialized and self._tx is not None
         t0 = time.perf_counter()
-        mb_list, packed_mbs, _ = self._prepare_mbs(input_)
+        mb_list, packed_mbs, _ = self._prepare_mbs(input_, group_size=group_size)
         weights = [float(loss_weight_fn(mb)) for mb in packed_mbs]
         total_weight = sum(weights)
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
@@ -627,12 +632,24 @@ class TPUTrainEngine(TrainEngine):
 
     def update_weights(self, meta: WeightUpdateMeta | None = None):
         """Push current weights to the paired rollout engine and bump
-        versions on both sides (reference train loop: gsm8k_grpo.py:196-255)."""
+        versions on both sides (reference train loop: gsm8k_grpo.py:196-255).
+
+        type="device" + a colocated engine => direct HBM array re-placement
+        (the reference's NCCL-broadcast fast path, SURVEY §3.3, without the
+        process-group machinery); type="disk" => safetensors + fan-out."""
         meta = meta or self._weight_update_meta
         assert meta is not None, "call connect_engine first or pass meta"
-        self.upload_weights(meta)
+        next_version = self.get_version() + 1
+        if meta.type == "device":
+            target = self._rollout_engine
+            assert target is not None and hasattr(
+                target, "update_weights_from_arrays"
+            ), "device weight updates need a colocated engine (LocalInfEngine)"
+            target.update_weights_from_arrays(self.params, next_version)
+        else:
+            self.upload_weights(meta)
+            if self._rollout_engine is not None:
+                self._rollout_engine.update_weights(meta)
+        self.set_version(next_version)
         if self._rollout_engine is not None:
-            self._rollout_engine.update_weights(meta)
-        self.set_version(self.get_version() + 1)
-        if self._rollout_engine is not None:
-            self._rollout_engine.set_version(self.get_version())
+            self._rollout_engine.set_version(next_version)

@@ -1,0 +1,3 @@
+"""Offline evaluation harness (reference: evaluation/ tree)."""
+
+from areal_tpu.eval.offline import evaluate_checkpoint, pass_at_k  # noqa: F401

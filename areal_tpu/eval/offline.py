@@ -1,0 +1,126 @@
+"""Offline checkpoint evaluation: greedy/sampled generation over a test set,
+scored by a reward fn, aggregated as accuracy / pass@k.
+
+Capability parity with the reference's evaluation harness
+(evaluation/eval_and_aggregate.py, math_eval.py — SURVEY §2.7) rebuilt on the
+in-repo generation engine: no external server, one function call.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("eval")
+
+
+def pass_at_k(n: int, c: int, k: int) -> float:
+    """Unbiased pass@k estimator (Codex paper): 1 - C(n-c, k)/C(n, k)."""
+    if n - c < k:
+        return 1.0
+    return 1.0 - math.comb(n - c, k) / math.comb(n, k)
+
+
+def evaluate_checkpoint(
+    model_path: str,
+    rows: list[dict[str, Any]],
+    reward_fn: Callable,
+    tokenizer=None,
+    gconfig: GenerationHyperparameters | None = None,
+    gen_config: JaxGenConfig | None = None,
+    n_samples: int = 1,
+    ks: tuple[int, ...] = (1,),
+    output_path: str | None = None,
+    engine=None,
+) -> dict[str, float]:
+    """Generate ``n_samples`` completions per row, score each with
+    ``reward_fn(prompt, completion, prompt_ids, completion_ids, **row)``,
+    return {"accuracy", "pass@k"...}.
+
+    ``engine`` may be a pre-built GenerationEngine (tests); otherwise one is
+    built from ``model_path``.
+    """
+    import threading
+
+    from areal_tpu.inference.engine import GenerationEngine
+
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_path)
+    gconfig = gconfig or GenerationHyperparameters(max_new_tokens=512, greedy=n_samples == 1)
+    own_engine = engine is None
+    if own_engine:
+        gc = gen_config or JaxGenConfig()
+        gc.model_path = model_path
+        engine = GenerationEngine(gc, tokenizer=tokenizer)
+        engine.start()
+
+    results = []
+    try:
+        # fan all requests into the continuous batcher at once
+        done = threading.Event()
+        out: dict[int, list] = {i: [] for i in range(len(rows))}
+        remaining = [len(rows) * n_samples]
+        lock = threading.Lock()
+
+        def cb_for(i):
+            def cb(resp):
+                with lock:
+                    out[i].append(resp)
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+
+            return cb
+
+        for i, row in enumerate(rows):
+            ids = tokenizer.apply_chat_template(
+                row["messages"], tokenize=True, add_generation_prompt=True
+            )
+            for s in range(n_samples):
+                engine.submit(f"eval-{i}-{s}", list(ids), gconfig, cb_for(i))
+        done.wait()
+
+        for i, row in enumerate(rows):
+            extra = {k: v for k, v in row.items() if k != "messages"}
+            scores = []
+            for resp in out[i]:
+                completion = tokenizer.decode(resp.output_tokens)
+                scores.append(
+                    float(
+                        reward_fn(
+                            None, completion, resp.input_tokens,
+                            resp.output_tokens, **extra,
+                        )
+                    )
+                )
+            results.append(scores)
+    finally:
+        if own_engine:
+            engine.stop()
+
+    n = n_samples
+    metrics = {
+        "accuracy": float(np.mean([np.mean(s) for s in results])),
+        "n_rows": float(len(rows)),
+        "n_samples": float(n),
+    }
+    for k in ks:
+        if k <= n:
+            metrics[f"pass@{k}"] = float(
+                np.mean([pass_at_k(n, int(sum(x > 0 for x in s)), k) for s in results])
+            )
+    if output_path:
+        os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+        with open(output_path, "w") as f:
+            json.dump({"metrics": metrics, "scores": results}, f)
+    logger.info("eval %s: %s", model_path, metrics)
+    return metrics

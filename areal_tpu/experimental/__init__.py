@@ -1,0 +1,1 @@
+"""Experimental features (reference: areal/experimental/)."""

@@ -1,0 +1,235 @@
+"""OpenAI-compatible chat client over an InferenceEngine.
+
+Capability parity with the reference's ``ArealOpenAI``
+(areal/experimental/openai/client.py:216): agent code written against the
+``client.chat.completions.create(...)`` shape runs unmodified against the
+framework's inference engines, every completion is cached with its token ids
+/ behavior logprobs / weight versions, rewards attach per completion
+(``set_reward``) and back-propagate along the conversation parent chain with
+a turn discount (``apply_reward_discount``), and ``export_completions`` emits
+padded trajectory batches ready for the PPO actor.
+
+The OpenAI python SDK is not a dependency — the response objects are small
+dataclasses with the same field names agents actually touch
+(``choices[0].message.content``, ``id``, ``usage``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+from areal_tpu.utils.data import concat_padded_tensors
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+
+@dataclass
+class Choice:
+    index: int
+    message: ChatMessage
+    finish_reason: str = "stop"
+
+
+@dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+@dataclass
+class ChatCompletion:
+    id: str
+    choices: list[Choice]
+    usage: Usage
+    model: str = "areal-tpu"
+
+
+@dataclass
+class CompletionWithTokenLogpReward:
+    """Cache record: everything PPO needs about one model call (reference
+    client.py CompletionWithTokenLogpReward)."""
+
+    completion: ChatCompletion
+    response: ModelResponse
+    messages: list[dict]
+    parent_id: str | None = None
+    reward: float | None = None
+
+
+class _Completions:
+    def __init__(self, client: "ArealOpenAI"):
+        self._client = client
+
+    async def create(self, *, messages: list[dict], **kwargs) -> ChatCompletion:
+        return await self._client._create_chat(messages, **kwargs)
+
+
+class _Chat:
+    def __init__(self, client: "ArealOpenAI"):
+        self.completions = _Completions(client)
+
+
+class ArealOpenAI:
+    """``client.chat.completions.create`` -> InferenceEngine.agenerate."""
+
+    def __init__(
+        self,
+        engine,
+        tokenizer,
+        gconfig: GenerationHyperparameters | None = None,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.gconfig = gconfig or GenerationHyperparameters()
+        self.chat = _Chat(self)
+        self._cache: dict[str, CompletionWithTokenLogpReward] = {}
+        # most recent completion whose message list is a prefix of a new
+        # call's messages becomes its parent (turn chain)
+        self._last_id: str | None = None
+
+    async def _create_chat(
+        self,
+        messages: list[dict],
+        max_tokens: int | None = None,
+        max_completion_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        stop: list[str] | None = None,
+        **_: Any,
+    ) -> ChatCompletion:
+        g = self.gconfig.new(n_samples=1)
+        if max_tokens or max_completion_tokens:
+            g = g.new(max_new_tokens=max_tokens or max_completion_tokens)
+        if temperature is not None:
+            g = g.new(temperature=temperature)
+        if top_p is not None:
+            g = g.new(top_p=top_p)
+        if stop:
+            g = g.new(stop=list(stop))
+        input_ids = self.tokenizer.apply_chat_template(
+            messages, tokenize=True, add_generation_prompt=True
+        )
+        rid = f"chatcmpl-{uuid.uuid4().hex}"
+        resp = await self.engine.agenerate(
+            ModelRequest(
+                rid=rid, input_ids=list(input_ids), gconfig=g, tokenizer=self.tokenizer
+            )
+        )
+        text = self.tokenizer.decode(resp.output_tokens)
+        completion = ChatCompletion(
+            id=rid,
+            choices=[
+                Choice(
+                    index=0,
+                    message=ChatMessage(role="assistant", content=text),
+                    finish_reason=resp.stop_reason,
+                )
+            ],
+            usage=Usage(
+                prompt_tokens=resp.input_len, completion_tokens=resp.output_len
+            ),
+        )
+        parent = self._find_parent(messages)
+        self._cache[rid] = CompletionWithTokenLogpReward(
+            completion=completion,
+            response=resp,
+            messages=[dict(m) for m in messages],
+            parent_id=parent,
+        )
+        self._last_id = rid
+        return completion
+
+    def _find_parent(self, messages: list[dict]) -> str | None:
+        """Heuristic turn-chaining (reference behavior): the previous call is
+        the parent if its messages are a strict prefix of this call's."""
+        if self._last_id is None:
+            return None
+        prev = self._cache[self._last_id]
+        pm = prev.messages
+        if len(messages) > len(pm) and messages[: len(pm)] == pm:
+            return self._last_id
+        return None
+
+    # ------------------------------------------------------------------
+    # rewards
+    # ------------------------------------------------------------------
+
+    def get_completions(self, cid: str) -> CompletionWithTokenLogpReward | None:
+        return self._cache.get(cid)
+
+    def set_reward(self, cid: str, reward: float):
+        if cid not in self._cache:
+            raise KeyError(f"unknown completion id {cid}")
+        self._cache[cid].reward = float(reward)
+
+    def apply_reward_discount(self, turn_discount: float = 1.0):
+        """Back-propagate rewards along parent chains: a completion with no
+        explicit reward inherits child_reward * turn_discount (reference
+        client.py:262)."""
+        children: dict[str, list[str]] = {}
+        for cid, rec in self._cache.items():
+            if rec.parent_id is not None:
+                children.setdefault(rec.parent_id, []).append(cid)
+
+        def resolve(cid: str) -> float:
+            rec = self._cache[cid]
+            if rec.reward is not None:
+                return rec.reward
+            kid_rewards = [resolve(k) for k in children.get(cid, [])]
+            rec.reward = (
+                max(kid_rewards) * turn_discount if kid_rewards else 0.0
+            )
+            return rec.reward
+
+        for cid in self._cache:
+            resolve(cid)
+
+    # ------------------------------------------------------------------
+    # export
+    # ------------------------------------------------------------------
+
+    def export_completions(self, style: str = "individual") -> dict[str, np.ndarray]:
+        """Padded trajectory batch for the PPO actor. style="individual":
+        one row per completion (prompt masked, completion supervised)."""
+        if style != "individual":
+            raise NotImplementedError(style)
+        rows = []
+        for rec in self._cache.values():
+            r = rec.response
+            n = r.input_len + r.output_len
+            rows.append(
+                dict(
+                    input_ids=np.asarray(
+                        r.input_tokens + r.output_tokens, np.int64
+                    )[None],
+                    loss_mask=np.asarray(
+                        [0] * r.input_len + [1] * r.output_len, np.int64
+                    )[None],
+                    logprobs=np.asarray(
+                        [0.0] * r.input_len + r.output_logprobs, np.float32
+                    )[None],
+                    versions=np.asarray(
+                        [-1] * r.input_len + r.output_versions, np.int64
+                    )[None],
+                    attention_mask=np.ones((1, n), np.int64),
+                    rewards=np.asarray([rec.reward or 0.0], np.float32),
+                )
+            )
+        if not rows:
+            return {}
+        return concat_padded_tensors(rows)

@@ -380,6 +380,19 @@ class GenerationEngine:
         if err is not None:
             raise err
 
+    def update_weights_from_arrays(self, params, version: int | None = None):
+        """Colocated device-to-device weight refresh: re-place live jax
+        arrays (e.g. the train engine's params) onto this engine's shardings
+        — on a shared chip/slice this is an HBM-local copy, no disk or host
+        roundtrip (the fast path the reference needs NCCL broadcast for,
+        SURVEY §3.3)."""
+        done: queue.Queue = queue.Queue()
+        self._cmd_queue.put(("update_weights_arrays", params, version, done))
+        self._wake.set()
+        err = done.get(timeout=600.0)
+        if err is not None:
+            raise err
+
     def get_version(self) -> int:
         return self.version
 
@@ -425,16 +438,32 @@ class GenerationEngine:
             if cmd[0] == "pause_ack":
                 self._abort_all("abort")
                 cmd[1].set()
-            elif cmd[0] == "update_weights":
-                _, path, version, done = cmd
+            elif cmd[0] in ("update_weights", "update_weights_arrays"):
+                _, src, version, done = cmd
                 try:
                     t0 = time.monotonic()
-                    self.params = self._load_params_from(path)
+                    if cmd[0] == "update_weights":
+                        self.params = self._load_params_from(src)
+                    else:
+                        # force a copy: astype/device_put are no-ops for
+                        # matching dtype+sharding, and aliasing the train
+                        # engine's buffers is fatal once its next step
+                        # donates them
+                        new = jax.device_put(
+                            jax.tree.map(
+                                lambda x: jnp.array(
+                                    x, dtype=self.dtype, copy=True
+                                ),
+                                src,
+                            ),
+                            self._shardings,
+                        )
+                        self.params = new
                     jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
                     self.version = version if version is not None else self.version + 1
                     logger.info(
-                        "weights updated from %s -> v%d in %.2fs",
-                        path,
+                        "weights updated (%s) -> v%d in %.2fs",
+                        "disk" if cmd[0] == "update_weights" else "device",
                         self.version,
                         time.monotonic() - t0,
                     )

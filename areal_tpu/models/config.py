@@ -34,6 +34,7 @@ class TransformerConfig:
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
+    moe_impl: str = "ragged"  # "ragged" (grouped GEMM) | "dense" (all-expert)
     # output head
     is_critic: bool = False  # scalar value head instead of LM head
     arch: str = "qwen2"

@@ -122,14 +122,25 @@ def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _moe_mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    """Top-k token-choice MoE.
+    """Top-k token-choice MoE (reference: realhf/impl/model/modules/moe/).
 
-    TPU-friendly dense formulation: every expert runs over every token and
-    results mix by routing weight (zero for non-selected experts). This keeps
-    shapes static for XLA; the EP-sharded ragged_dot path lives in
-    areal_tpu/ops/moe.py and replaces this when the expert axis is sharded.
-    Reference behavior: realhf/impl/model/modules/moe/ (router + experts).
+    Default "ragged" = grouped-GEMM over expert-sorted tokens
+    (areal_tpu/ops/moe.py, O(k·T) expert FLOPs); "dense" = every expert over
+    every token mixed by routing weight (O(E·T), kept for tiny tests and as
+    a numerics cross-check).
     """
+    if cfg.moe_impl == "ragged":
+        from areal_tpu.ops.moe import moe_mlp_ragged
+
+        return moe_mlp_ragged(
+            x,
+            lp["router"],
+            lp["wg"],
+            lp["wu"],
+            lp["wd"],
+            cfg.num_experts_per_tok,
+            cfg.norm_topk_prob,
+        )
     t, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [T, E]

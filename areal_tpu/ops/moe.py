@@ -1,0 +1,59 @@
+"""MoE expert compute: ragged (grouped-GEMM) formulation.
+
+TPU replacement for the reference's grouped_gemm CUDA dependency
+(realhf/impl/model/modules/moe/experts.py:21-123, SURVEY §2.1): tokens are
+sorted by routed expert and the three expert projections run as
+``jax.lax.ragged_dot`` grouped GEMMs — one MXU pass over all experts, no
+per-expert Python loop, dropless (every token keeps all its top-k experts).
+
+Two implementations, selected by ``TransformerConfig`` via models/lm.py:
+- dense (lm._moe_mlp): every expert over every token, mixed by routing weight
+  — O(E·T·H·I) FLOPs but trivially GSPMD-shardable; right for tiny E or tests.
+- ragged (here): O(k·T·H·I) FLOPs — the production path.
+
+EP sharding note: under GSPMD the expert-stacked weights [E, ...] shard over
+the ep axis and ragged_dot's group dimension follows; explicit all-to-all
+token dispatch (Megatron-style) is a later optimization once multi-host
+meshes are in play.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp_ragged(
+    x: jnp.ndarray,  # [T, H]
+    router_w: jnp.ndarray,  # [H, E]
+    wg: jnp.ndarray,  # [E, H, I]
+    wu: jnp.ndarray,  # [E, H, I]
+    wd: jnp.ndarray,  # [E, I, H]
+    num_experts_per_tok: int,
+    norm_topk_prob: bool = True,
+) -> jnp.ndarray:
+    t, h = x.shape
+    e = router_w.shape[-1]
+    k = num_experts_per_tok
+
+    router_logits = (x @ router_w).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [T, k]
+    if norm_topk_prob:
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    # sort the T*k (token, expert) assignments by expert id -> contiguous
+    # groups for the grouped GEMM
+    flat_expert = topk_idx.reshape(-1)  # [T*k]
+    order = jnp.argsort(flat_expert, stable=True)
+    tok_idx = order // k  # source token of each sorted slot
+    xs = x[tok_idx]  # [T*k, H] gathered activations
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    g = jax.nn.silu(jax.lax.ragged_dot(xs, wg, group_sizes))
+    u = jax.lax.ragged_dot(xs, wu, group_sizes)
+    y = jax.lax.ragged_dot(g * u, wd, group_sizes)  # [T*k, H]
+
+    w = topk_probs.reshape(-1)[order].astype(y.dtype)  # routing weights, sorted
+    out = jnp.zeros((t, h), y.dtype).at[tok_idx].add(y * w[:, None])
+    return out.astype(x.dtype)

@@ -221,11 +221,27 @@ def split_padded_tensor_dict_into_mb_list(
     data: TensorDict,
     max_tokens_per_mb: int,
     min_n_mbs: int = 1,
+    group_size: int = 1,
 ) -> MicroBatchList:
     """FFD-split a padded batch into microbatches under a token budget
-    (reference: areal/utils/data.py:404)."""
+    (reference: areal/utils/data.py:404).
+
+    ``group_size > 1`` keeps each block of ``group_size`` consecutive rows in
+    the same microbatch, in order — pairwise losses (reward models) and
+    group-relative advantages rely on adjacency."""
     lens = seqlens_of(data)
-    bins = datapack.ffd_allocate(lens, max_tokens_per_mb, min_groups=min_n_mbs)
+    if group_size > 1:
+        assert len(lens) % group_size == 0, (len(lens), group_size)
+        unit_lens = lens.reshape(-1, group_size).sum(axis=1)
+        unit_bins = datapack.ffd_allocate(
+            unit_lens, max_tokens_per_mb, min_groups=min_n_mbs
+        )
+        bins = [
+            [u * group_size + j for u in b for j in range(group_size)]
+            for b in unit_bins
+        ]
+    else:
+        bins = datapack.ffd_allocate(lens, max_tokens_per_mb, min_groups=min_n_mbs)
     # drop empty bins: an empty microbatch has zero loss weight and would
     # poison the global normalizer (min_n_mbs is a target, not a guarantee —
     # a batch smaller than min_n_mbs yields fewer microbatches)

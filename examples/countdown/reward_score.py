@@ -1,0 +1,57 @@
+"""Countdown-task reward (reference: examples/countdown/reward_score.py
+capability): the model must combine the given numbers with + - * / to reach
+the target; the reward checks the proposed equation actually evaluates to the
+target and uses each number exactly once."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+
+def _extract_equation(text: str) -> str | None:
+    m = re.findall(r"<answer>(.*?)</answer>", text, re.DOTALL)
+    if m:
+        return m[-1].strip()
+    m = re.findall(r"([\d\s\+\-\*/\(\)\.]+)=", text)
+    return m[-1].strip() if m else None
+
+
+def _numbers_used(expr: str) -> list[int]:
+    return [int(x) for x in re.findall(r"\d+", expr)]
+
+
+def _safe_eval(expr: str) -> float | None:
+    """Arithmetic-only evaluation (no names/calls)."""
+    try:
+        node = ast.parse(expr, mode="eval")
+    except SyntaxError:
+        return None
+    allowed = (
+        ast.Expression, ast.BinOp, ast.UnaryOp, ast.Constant,
+        ast.Add, ast.Sub, ast.Mult, ast.Div, ast.USub, ast.UAdd,
+    )
+    for sub in ast.walk(node):
+        if not isinstance(sub, allowed):
+            return None
+    try:
+        return float(eval(compile(node, "<eq>", "eval"), {"__builtins__": {}}))
+    except (ZeroDivisionError, OverflowError, ValueError):
+        return None
+
+
+def countdown_reward(
+    prompt, completion, prompt_ids, completion_ids,
+    target: int | None = None, nums: list[int] | None = None, **kwargs,
+) -> float:
+    if completion is None or target is None or nums is None:
+        return 0.0
+    eq = _extract_equation(completion)
+    if eq is None:
+        return 0.0
+    if sorted(_numbers_used(eq)) != sorted(int(n) for n in nums):
+        return 0.0
+    val = _safe_eval(eq)
+    if val is None:
+        return 0.0
+    return 1.0 if abs(val - float(target)) < 1e-6 else 0.0

@@ -1,0 +1,24 @@
+"""Countdown GRPO (reference: examples/countdown/train.py): custom dataset
+rows {target, nums} + the equation-verifier reward, same training loop as
+gsm8k_grpo.
+
+    python -m areal_tpu.launcher.local examples/countdown/train.py --config <cfg>
+"""
+
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    import examples.gsm8k_grpo as base
+    from examples.countdown.reward_score import countdown_reward
+
+    base.math_verify_reward = countdown_reward
+    base.main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,102 @@
+"""Reward-model training on preference pairs (reference:
+examples/alignment/hhrlhf_rw.py): pairwise Bradley-Terry loss on a
+critic-headed decoder via TPURWEngine.
+
+    python examples/hhrlhf_rw.py --config examples/configs/hhrlhf_rw.yaml
+"""
+
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np  # noqa: E402
+
+from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402
+from areal_tpu.api.cli_args import RWConfig, load_expr_config  # noqa: E402
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo  # noqa: E402
+from areal_tpu.dataset import get_custom_dataset  # noqa: E402
+from areal_tpu.engine.rw import TPURWEngine  # noqa: E402
+from areal_tpu.models.config import from_hf_config  # noqa: E402
+from areal_tpu.utils import logging  # noqa: E402
+from areal_tpu.utils.data import pad_sequences_to_tensors  # noqa: E402
+from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.saver import Saver  # noqa: E402
+from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+
+logger = logging.getLogger("hhrlhf_rw")
+
+
+class _PairLoader(StatefulDataLoader):
+    """Batches must hold whole pairs: rows are (chosen, rejected) alternating,
+    so shuffle at PAIR granularity."""
+
+    def _order(self, epoch):
+        import random
+
+        n_pairs = len(self.dataset) // 2
+        pairs = list(range(n_pairs))
+        if self.shuffle:
+            random.Random((self.seed, epoch).__hash__()).shuffle(pairs)
+        return [2 * p + j for p in pairs for j in (0, 1)]
+
+
+def main(argv=None):
+    cfg, _ = load_expr_config(argv, RWConfig)
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    rows = get_custom_dataset(
+        cfg.train_dataset.path,
+        split="train",
+        type="rw",
+        tokenizer=tokenizer,
+        max_length=cfg.train_dataset.max_length,
+    )
+    # batch_size counts PAIRS; loader rows are 2x
+    loader = _PairLoader(
+        rows,
+        cfg.train_dataset.batch_size * 2,
+        shuffle=cfg.train_dataset.shuffle,
+        seed=cfg.seed,
+        collate_fn=pad_sequences_to_tensors,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        dataset_size=len(rows) // 2,
+        train_batch_size=cfg.train_dataset.batch_size,
+    )
+    total_steps = cfg.total_train_steps or ft_spec.total_train_steps
+
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    engine = TPURWEngine(cfg.model)
+    engine.create_process_group(alloc.train)
+    engine.initialize(
+        None, ft_spec, model_config=from_hf_config(cfg.model.path, is_critic=True)
+    )
+
+    saver = Saver(cfg.saver, ft_spec)
+    slogger = StatsLogger(cfg.stats_logger, ft_spec)
+    it = iter(loader)
+    for global_step in range(total_steps):
+        step_info = StepInfo(
+            epoch=global_step // ft_spec.steps_per_epoch,
+            epoch_step=global_step % ft_spec.steps_per_epoch,
+            global_step=global_step,
+            steps_per_epoch=ft_spec.steps_per_epoch,
+        )
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(loader)
+            batch = next(it)
+        stats = engine.train_rm(batch)
+        saver.save(engine, step_info, tokenizer=tokenizer)
+        slogger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
+    slogger.close()
+    engine.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,36 @@
+"""Multi-turn self-correction GRPO (reference: examples/multi-turn-math/train.py):
+identical to gsm8k_grpo except the rollout workflow retries wrong answers
+with a canned prompt and discounts later-turn rewards.
+
+    python -m areal_tpu.launcher.local examples/multi_turn_math.py --config <cfg>
+"""
+
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    import examples.gsm8k_grpo as base
+    from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+    # swap the workflow the base entry constructs; every other step of the
+    # loop (logp, advantages, updates, weight push) is unchanged
+    def build_workflow(reward_fn, gconfig, tokenizer, **kw):
+        return MultiTurnWorkflow(
+            reward_fn,
+            gconfig,
+            tokenizer,
+            max_turns=3,
+            turn_discount=0.9,
+            in_process_reward=kw.get("in_process_reward", True),
+        )
+
+    base.RLVRWorkflow = build_workflow
+    base.main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

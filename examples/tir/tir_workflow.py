@@ -1,0 +1,95 @@
+"""Tool-integrated-reasoning workflow (reference: examples/tir/tir_workflow.py):
+the model interleaves reasoning with ```python ...``` blocks; each block runs
+in the PythonToolEnv and its output is spliced back as an observation turn,
+up to ``max_tool_calls``; the final answer is scored by the math verifier.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils.data import concat_padded_tensors
+from examples.tir.tool_env import PythonToolEnv
+
+_CODE_RE = re.compile(r"```python\s*(.*?)```", re.DOTALL)
+
+
+class TIRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn,
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        max_tool_calls: int = 3,
+        tool_timeout: float = 10.0,
+        in_process_reward: bool = False,
+    ):
+        self.reward_fn = AsyncRewardWrapper(reward_fn, in_process=in_process_reward)
+        # stop at the end of a code block so the tool can run before the
+        # model continues
+        self.gconfig = gconfig.new(n_samples=1, stop=list(gconfig.stop) + ["```\n"])
+        self.tokenizer = tokenizer
+        self.max_tool_calls = max_tool_calls
+        self.env = PythonToolEnv(timeout=tool_timeout)
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        seq = list(
+            self.tokenizer.apply_chat_template(
+                data["messages"], tokenize=True, add_generation_prompt=True
+            )
+        )
+        loss_mask = [0] * len(seq)
+        logprobs = [0.0] * len(seq)
+        versions = [-1] * len(seq)
+        rid = str(uuid.uuid4())
+        full_text = ""
+        for _ in range(self.max_tool_calls + 1):
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=rid, input_ids=list(seq), gconfig=self.gconfig,
+                    tokenizer=self.tokenizer,
+                )
+            )
+            seq += resp.output_tokens
+            loss_mask += [1] * resp.output_len
+            logprobs += resp.output_logprobs
+            versions += resp.output_versions
+            chunk = self.tokenizer.decode(resp.output_tokens)
+            full_text += chunk
+            codes = _CODE_RE.findall(chunk)
+            if not codes or resp.stop_reason != "stop":
+                break
+            obs, _ok = await self.env.aexecute("python", {"code": codes[-1]})
+            obs_text = f"\n<output>\n{obs}\n</output>\n"
+            obs_ids = self.tokenizer.encode(obs_text, add_special_tokens=False)
+            seq += obs_ids
+            loss_mask += [0] * len(obs_ids)  # tool output is not model policy
+            logprobs += [0.0] * len(obs_ids)
+            versions += [-1] * len(obs_ids)
+            full_text += obs_text
+
+        reward = await self.reward_fn(
+            None, full_text, None, None,
+            **{k: v for k, v in data.items() if k != "messages"},
+        )
+        n = len(seq)
+        return concat_padded_tensors(
+            [
+                dict(
+                    input_ids=np.asarray(seq, np.int64)[None],
+                    loss_mask=np.asarray(loss_mask, np.int64)[None],
+                    logprobs=np.asarray(logprobs, np.float32)[None],
+                    versions=np.asarray(versions, np.int64)[None],
+                    attention_mask=np.ones((1, n), np.int64),
+                    rewards=np.asarray([reward], np.float32),
+                )
+            ]
+        )

@@ -1,0 +1,56 @@
+"""Python-executor tool environment (reference: examples/tir/tool_manager.py
+capability): runs model-emitted python snippets in a subprocess with a
+timeout and returns stdout as the observation."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from typing import Any
+
+from areal_tpu.api.env_api import Environment
+
+
+class PythonToolEnv(Environment):
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+
+    async def alist_tools(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "type": "function",
+                "function": {
+                    "name": "python",
+                    "description": "Execute python code; stdout is returned.",
+                    "parameters": {
+                        "type": "object",
+                        "properties": {"code": {"type": "string"}},
+                        "required": ["code"],
+                    },
+                },
+            }
+        ]
+
+    async def aexecute(
+        self, tool_name: str, arguments: dict[str, Any], timeout: float | None = None
+    ) -> tuple[str, bool]:
+        if tool_name != "python":
+            return f"unknown tool {tool_name}", False
+        code = arguments.get("code", "")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-I",  # isolated mode: no site, no user paths
+            "-c",
+            code,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        try:
+            out, _ = await asyncio.wait_for(
+                proc.communicate(), timeout or self.timeout
+            )
+        except asyncio.TimeoutError:
+            proc.kill()
+            return "execution timed out", False
+        text = out.decode(errors="replace")[-2000:]
+        return text, proc.returncode == 0

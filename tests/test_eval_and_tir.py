@@ -1,0 +1,123 @@
+"""Offline eval harness + TIR tool workflow."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.eval import evaluate_checkpoint, pass_at_k
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils.testing import make_toy_tokenizer
+
+
+def test_pass_at_k_estimator():
+    assert pass_at_k(10, 10, 1) == 1.0
+    assert pass_at_k(10, 0, 5) == 0.0
+    assert 0 < pass_at_k(10, 3, 1) < pass_at_k(10, 3, 5) <= 1.0
+    assert pass_at_k(4, 2, 3) == 1.0  # n - c < k
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    return make_toy_tokenizer(str(tmp_path_factory.mktemp("tok")))
+
+
+def test_evaluate_checkpoint_with_engine(tokenizer, tmp_path):
+    cfg = tiny_config(
+        vocab_size=512,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    engine = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=4, max_seq_len=256, prefill_chunk=64, dtype="float32"
+        ),
+        model_config=cfg,
+        params=params,
+        tokenizer=tokenizer,
+    )
+    engine.start()
+    rows = [
+        {"messages": [{"role": "user", "content": f"What is {i} + 1?"}], "gold": i}
+        for i in range(4)
+    ]
+
+    # scripted reward: row index even -> correct
+    def reward(prompt, completion, p_ids, c_ids, gold=None, **kw):
+        return 1.0 if gold % 2 == 0 else 0.0
+
+    metrics = evaluate_checkpoint(
+        "unused",
+        rows,
+        reward,
+        tokenizer=tokenizer,
+        gconfig=GenerationHyperparameters(max_new_tokens=8, temperature=1.0),
+        n_samples=2,
+        ks=(1, 2),
+        output_path=str(tmp_path / "eval.json"),
+        engine=engine,
+    )
+    engine.stop()
+    assert metrics["accuracy"] == 0.5
+    assert metrics["pass@1"] == 0.5
+    assert (tmp_path / "eval.json").exists()
+
+
+def test_tir_workflow_executes_tools(tokenizer):
+    from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+    from examples.tir.tir_workflow import TIRWorkflow
+
+    scripted = [
+        "Let me compute this.\n```python\nprint(3 + 4)\n```\n",
+        "So the answer is #### 7",
+    ]
+
+    class Eng:
+        def __init__(self):
+            self.n = 0
+            self.prompts = []
+
+        async def agenerate(self, req: ModelRequest):
+            text = scripted[min(self.n, len(scripted) - 1)]
+            self.n += 1
+            self.prompts.append(list(req.input_ids))
+            out = tokenizer.encode(text, add_special_tokens=False)
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+            )
+
+    def reward(prompt, completion, p_ids, c_ids, answer=None, **kw):
+        return 1.0 if f"#### {answer}" in (completion or "") else 0.0
+
+    eng = Eng()
+    wf = TIRWorkflow(
+        reward,
+        GenerationHyperparameters(max_new_tokens=64),
+        tokenizer,
+        in_process_reward=True,
+    )
+    data = {"messages": [{"role": "user", "content": "What is 3 + 4?"}], "answer": "7"}
+    traj = asyncio.run(wf.arun_episode(eng, data))
+    assert eng.n == 2  # second call happened after tool execution
+    # the tool output was spliced into the second prompt
+    second_prompt_text = tokenizer.decode(eng.prompts[1])
+    assert "<output>" in second_prompt_text and "7" in second_prompt_text
+    assert float(np.asarray(traj["rewards"])[0]) == 1.0
+    # tool-output tokens carry zero loss mask
+    lm = np.asarray(traj["loss_mask"])[0]
+    ids = np.asarray(traj["input_ids"])[0]
+    n_valid = int(np.asarray(traj["attention_mask"])[0].sum())
+    assert 0 < lm.sum() < n_valid
