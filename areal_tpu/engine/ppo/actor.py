@@ -292,10 +292,22 @@ def grpo_loss_fn(
     )
     mask = loss_mask.astype(bool)
     count = jnp.maximum(jnp.sum(mask), 1)
+    if "loss_agg_w" in input_data:
+        # seq-mean aggregation modes (Dr.GRPO / LitePPO knob,
+        # cli_args.log_agg_mode): per-token weights turn the engine's
+        # global sum/normalize into mean-over-sequences of token-sum
+        # (w=1, normalizer=n_seqs) or of token-mean (w=1/len(seq))
+        scale = jnp.sum(jnp.where(mask, input_data["loss_agg_w"], 0.0))
+        loss = jnp.sum(
+            jnp.where(mask, _stat["loss"] * input_data["loss_agg_w"], 0.0)
+        )
+    else:
+        scale = count
+        loss = loss * count
     if entropy_coeff != 0.0:
         ent = entropy
         if entropy_clamp is not None:
             ent = jnp.minimum(ent, entropy_clamp)
         ent_bonus = jnp.sum(jnp.where(mask, ent, 0.0)) / count
-        loss = loss - entropy_coeff * ent_bonus
-    return loss * count
+        loss = loss - entropy_coeff * ent_bonus * scale
+    return loss

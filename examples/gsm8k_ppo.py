@@ -1,0 +1,134 @@
+"""GSM8K PPO with a learned critic (reference: examples/math/gsm8k_ppo.yaml
+path through the same gsm8k_grpo.py loop + PPOCritic): GAE uses the critic's
+values instead of group baselines; both networks update every step.
+
+    python -m areal_tpu.launcher.local examples/gsm8k_ppo.py --config <cfg>
+"""
+
+import json
+import os
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np  # noqa: E402
+
+from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402
+from areal_tpu.api.cli_args import PPOConfig, load_expr_config  # noqa: E402
+from areal_tpu.api.io_struct import (  # noqa: E402
+    FinetuneSpec,
+    StepInfo,
+    WeightUpdateMeta,
+)
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine  # noqa: E402
+from areal_tpu.dataset import get_custom_dataset  # noqa: E402
+from areal_tpu.engine.ppo.actor import TPUPPOActor  # noqa: E402
+from areal_tpu.engine.ppo.critic import TPUPPOCritic  # noqa: E402
+from areal_tpu.models.config import from_hf_config  # noqa: E402
+from areal_tpu.reward import math_verify_reward  # noqa: E402
+from areal_tpu.utils import logging, stats_tracker  # noqa: E402
+from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.saver import Saver  # noqa: E402
+from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+from areal_tpu.workflow.rlvr import RLVRWorkflow  # noqa: E402
+
+logger = logging.getLogger("gsm8k_ppo")
+
+
+def main(argv=None):
+    cfg, _ = load_expr_config(argv, PPOConfig)
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    rows = get_custom_dataset(
+        cfg.train_dataset.path, split="train", type="rl", tokenizer=tokenizer
+    )
+    dataloader = StatefulDataLoader(
+        rows, cfg.train_dataset.batch_size, shuffle=True, seed=cfg.seed
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        dataset_size=len(rows),
+        train_batch_size=cfg.train_dataset.batch_size,
+    )
+    total_steps = cfg.total_train_steps or ft_spec.total_train_steps
+
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    rollout = RemoteInfEngine(cfg.rollout)
+    rollout.initialize(None, train_data_parallel_size=alloc.train.dp if alloc.train else 1)
+
+    actor = TPUPPOActor(cfg.actor)
+    actor.create_process_group(alloc.train)
+    actor.initialize(None, ft_spec)
+
+    critic = TPUPPOCritic(cfg.critic)
+    critic.create_process_group(alloc.train)
+    critic.initialize(
+        None, ft_spec, model_config=from_hf_config(cfg.critic.path or cfg.actor.path, is_critic=True)
+    )
+
+    weight_meta = WeightUpdateMeta.from_disk(
+        cfg.experiment_name, cfg.trial_name, cfg.cluster.fileroot
+    )
+    actor.connect_engine(rollout, weight_meta)
+
+    workflow = RLVRWorkflow(
+        math_verify_reward, cfg.gconfig, tokenizer, in_process_reward=True
+    )
+    saver = Saver(cfg.saver, ft_spec)
+    stats_logger = StatsLogger(cfg.stats_logger, ft_spec)
+
+    all_rewards = []
+    for global_step in range(total_steps):
+        step_info = StepInfo(
+            epoch=global_step // ft_spec.steps_per_epoch,
+            epoch_step=global_step % ft_spec.steps_per_epoch,
+            global_step=global_step,
+            steps_per_epoch=ft_spec.steps_per_epoch,
+        )
+        with stats_tracker.record_timing("rollout"):
+            if cfg.async_training:
+                batch = rollout.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = rollout.rollout_batch(next(iter(dataloader)), workflow=workflow)
+
+        with stats_tracker.record_timing("compute_values"):
+            batch["values"] = critic.compute_values(batch)
+        if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
+            with stats_tracker.record_timing("recompute_logp"):
+                batch["prox_logp"] = actor.actor.compute_logp(batch)
+        with stats_tracker.record_timing("compute_advantage"):
+            actor.actor.compute_advantages(batch)
+        with stats_tracker.record_timing("train_step"):
+            stats = actor.actor.ppo_update(batch)
+            actor.step_lr_scheduler()
+            critic_stats = critic.ppo_update(batch)
+            critic.step_lr_scheduler()
+        with stats_tracker.record_timing("update_weights"):
+            rollout.pause()
+            actor.update_weights(weight_meta)
+            rollout.resume()
+
+        saver.save(actor, step_info, tokenizer=tokenizer)
+        mean_reward = float(np.mean(np.asarray(batch["rewards"])))
+        all_rewards.append(mean_reward)
+        stats[0].update(stats_tracker.export(key="time_perf"))
+        stats[0]["ppo/mean_task_reward"] = mean_reward
+        stats[0]["ppo/critic_loss"] = float(
+            np.mean([s.get("loss", 0.0) for s in critic_stats])
+        )
+        stats_logger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
+
+    out = os.path.join(stats_logger.log_dir(), "rewards.json")
+    with open(out, "w") as f:
+        json.dump(all_rewards, f)
+    stats_logger.close()
+    rollout.destroy()
+    actor.destroy()
+    critic.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
