@@ -168,11 +168,17 @@ class RemoteInfEngine(InferenceEngine):
             )
             if not accumulated:
                 ttft = time.monotonic() - t_start
+            n_new = len(result["output_tokens"])
             accumulated += result["output_tokens"]
             logprobs += result["output_logprobs"]
             versions += result["output_versions"]
             itl += result.get("itl", [])
             stop_reason = result["stop_reason"]
+            if stop_reason == "abort" and n_new == 0:
+                # the server is paused by someone other than this client
+                # (launcher-driven update, another process): back off instead
+                # of busy-spinning issue->abort->issue HTTP loops
+                await asyncio.sleep(0.2)
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=accumulated,

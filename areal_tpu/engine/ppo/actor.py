@@ -217,6 +217,24 @@ class PPOActor:
         for key in ["rewards", "tot_rewards", "kl_rewards", "versions"]:
             data.pop(key, None)
 
+        # Loss aggregation mode (Dr.GRPO / LitePPO knob, cli_args.log_agg_mode).
+        # token-mean leaves the engine's global sum/n_valid_tokens normalizer;
+        # seq-mean modes attach per-token weights and normalize by n_seqs.
+        mode = cfg.log_agg_mode
+        if mode == "token-mean":
+            loss_weight_fn = lambda x: np.asarray(x["loss_mask"]).sum()  # noqa: E731
+        elif mode in ("seq-mean-token-sum", "seq-mean-token-mean"):
+            lm = np.asarray(data["loss_mask"], dtype=np.float32)
+            lens = np.maximum(lm.sum(-1, keepdims=True), 1.0)
+            data["loss_agg_w"] = (
+                np.ones_like(lm)
+                if mode == "seq-mean-token-sum"
+                else np.broadcast_to(1.0 / lens, lm.shape).astype(np.float32).copy()
+            )
+            loss_weight_fn = _count_seqs_with_loss
+        else:
+            raise ValueError(f"unknown log_agg_mode: {mode!r}")
+
         self.engine.train()
         mb_inputs = split_padded_tensor_dict_into_mb_list(
             data,
@@ -228,7 +246,7 @@ class PPOActor:
             train_stat = self.engine.train_batch(
                 mb,
                 loss_fn=self._loss_fn,
-                loss_weight_fn=lambda x: np.asarray(x["loss_mask"]).sum(),
+                loss_weight_fn=loss_weight_fn,
             )
             tracker.scalar(**train_stat)
             all_stats.append(tracker.export())
@@ -251,6 +269,18 @@ class TPUPPOActor(TPUTrainEngine):
 
     def ppo_update(self, *args, **kwargs):
         return self.actor.ppo_update(*args, **kwargs)
+
+
+def _count_seqs_with_loss(x) -> float:
+    """Number of sequences with >=1 valid loss token, for packed ([T] +
+    cu_seqlens) or padded [B, S] microbatches."""
+    lm = np.asarray(x["loss_mask"], dtype=np.float32)
+    if lm.ndim == 1 and "cu_seqlens" in x:
+        cu = np.asarray(x["cu_seqlens"])
+        per_seq = np.add.reduceat(lm, cu[:-1]) if len(cu) > 1 else np.zeros(0)
+    else:
+        per_seq = lm.sum(-1)
+    return float(np.count_nonzero(per_seq > 0))
 
 
 def _calc_logprobs(logits, input_data, temperature: float = 1.0):

@@ -129,6 +129,7 @@ class TPUTrainEngine(TrainEngine):
         self._lr_schedule = None
         self._opt_steps = 0
         self._jit_cache: dict[Any, Callable] = {}
+        self.attn_spec = None
         self._rollout_engine = None
         self._weight_update_meta: WeightUpdateMeta | None = None
         self.initialized = False
@@ -169,33 +170,11 @@ class TPUTrainEngine(TrainEngine):
         if self.mesh is None:
             self.create_process_group(None)
         cfg = self.config
-        from areal_tpu.ops.attention import set_attention_impl, set_ring_context
-
-        n_tok_shards = 1
-        if self.mesh is not None:
-            n_tok_shards = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("cp", 1)
-        if cfg.attn_impl != "auto":
-            set_attention_impl(cfg.attn_impl)
-        elif n_tok_shards == 1 and self.mesh is not None and self.mesh.shape.get("tp", 1) > 1:
-            # tp-only sharding: the raw Pallas call has no GSPMD partitioning
-            # rule (it would replicate head compute); the einsum path
-            # partitions over heads natively
-            set_attention_impl("xla")
-        else:
-            set_attention_impl("auto")
-        if n_tok_shards > 1:
-            # tokens are sharded over (dp, cp): ring attention over the
-            # flattened axes is exactly equal to global packed attention
-            # (memory O((T/n)^2) per step) and is the only dispatch that
-            # partitions instead of replicating — a bare pallas_call under
-            # GSPMD would all-gather the full stream on every device
-            set_ring_context(self.mesh, ("dp", "cp"))
-        else:
-            set_ring_context(None)  # don't inherit a stale mesh
         if model_config is not None:
             self.model_config = model_config
         else:
             self.model_config = from_hf_config(cfg.path)
+        self.attn_spec = self._build_attn_spec()
 
         param_dtype = _DTYPES[cfg.backend.param_dtype]
         shardings = self.param_shardings()
@@ -223,10 +202,20 @@ class TPUTrainEngine(TrainEngine):
         self.initialized = True
         return self
 
-    def destroy(self):
-        from areal_tpu.ops.attention import set_ring_context
+    def _build_attn_spec(self):
+        """Per-engine attention dispatch (no process-global state): tokens
+        ring over (dp, cp) when sharded — exactly equal to global packed
+        attention, O((T/n)^2) memory — and heads shard over tp when the
+        head counts divide, keeping the Pallas flash kernel live under TP
+        instead of falling back to O(T^2) einsum attention."""
+        from areal_tpu.ops.attention import AttnSpec
 
-        set_ring_context(None)  # drop the mesh reference + stale dispatch
+        return AttnSpec.for_mesh(
+            self.mesh, self.model_config, impl=self.config.attn_impl
+        )
+
+    def destroy(self):
+        self.attn_spec = None  # drop the mesh reference
         self.params = None
         self.opt_state = None
         self._jit_cache.clear()
@@ -342,6 +331,7 @@ class TPUTrainEngine(TrainEngine):
                     mb["positions"],
                     mb["segment_ids"],
                     remat=backend.remat,
+                    attn_spec=self.attn_spec,
                 )
                 return loss_fn(logits, mb)
 
@@ -457,6 +447,7 @@ class TPUTrainEngine(TrainEngine):
                 logits = forward_packed(
                     params, cfg, mb["input_ids"], mb["positions"],
                     mb["segment_ids"], remat=False,
+                    attn_spec=self.attn_spec,
                 )
                 return loss_fn(logits, mb)
 
@@ -494,6 +485,7 @@ class TPUTrainEngine(TrainEngine):
                 logits = forward_packed(
                     params, cfg, mb["input_ids"], mb["positions"],
                     mb["segment_ids"], remat=False,
+                    attn_spec=self.attn_spec,
                 )
                 return post_hook(logits, mb) if post_hook is not None else logits
 

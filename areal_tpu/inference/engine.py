@@ -103,18 +103,20 @@ class GenerationEngine:
             np.asarray(devices[:tp]).reshape(1, 1, 1, tp), MESH_AXES
         )
 
-        if tp > 1:
-            # heads are tp-sharded under GSPMD; the einsum attention path
-            # partitions over heads, the bare Pallas call would not
-            from areal_tpu.ops.attention import set_attention_impl
-
-            set_attention_impl("xla")
-
         if model_config is None:
             if not config.model_path:
                 raise ValueError("need model_config or config.model_path")
             model_config = from_hf_config(config.model_path)
         self.model_config = model_config
+
+        # per-engine attention dispatch (no process-global state): under TP,
+        # prefill keeps the Pallas flash kernel with heads sharded over the
+        # tp axis via shard_map; decode stays on the GSPMD einsum path
+        from areal_tpu.ops.attention import AttnSpec
+
+        self.attn_spec = AttnSpec.for_mesh(
+            self.mesh, model_config, token_axes=(), head_axis=AXIS_TP
+        )
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
         shape_tree = jax.eval_shape(
@@ -193,7 +195,9 @@ class GenerationEngine:
         use_top_k: bool,
         use_top_p: bool,
     ):
-        logits, ks, vs = prefill(params, self.model_config, ids, length)
+        logits, ks, vs = prefill(
+            params, self.model_config, ids, length, attn_spec=self.attn_spec
+        )
         tok, logp = sample_tokens(
             logits[None],
             rng,

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from areal_tpu.models.config import TransformerConfig
-from areal_tpu.ops.attention import decode_attention_xla, packed_attention
+from areal_tpu.ops.attention import AttnSpec, decode_attention_xla, packed_attention
 from areal_tpu.ops.rotary import apply_rope
 
 Params = dict[str, Any]
@@ -165,13 +165,14 @@ def _block(
     x: jnp.ndarray,
     positions: jnp.ndarray,
     segment_ids: jnp.ndarray,
+    attn_spec: AttnSpec | None = None,
 ) -> jnp.ndarray:
     """One decoder block over a packed stream. x [T, H]."""
     h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
     q, k, v = _qkv(cfg, lp, h)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    attn = packed_attention(q, k, v, segment_ids)
+    attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
     x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
     x = x + _mlp(cfg, lp, h)
@@ -190,12 +191,13 @@ def forward_packed(
     positions: jnp.ndarray,  # [T] int32
     segment_ids: jnp.ndarray,  # [T] int32, pad = -1
     remat: bool = False,
+    attn_spec: AttnSpec | None = None,
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
     x = params["embed"][input_ids]
 
     def body(carry, lp):
-        return _block(cfg, lp, carry, positions, segment_ids), None
+        return _block(cfg, lp, carry, positions, segment_ids, attn_spec), None
 
     if remat:
         body = jax.checkpoint(body)
@@ -232,6 +234,7 @@ def prefill(
     cfg: TransformerConfig,
     input_ids: jnp.ndarray,  # [Tp] int32, padded to a static bucket
     length: jnp.ndarray,  # scalar int32, true prompt length
+    attn_spec: AttnSpec | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for one cache slot.
 
@@ -252,7 +255,7 @@ def prefill(
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        attn = packed_attention(q, k, v, segment_ids)
+        attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
         out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(out, lp["ln2"], cfg.rms_norm_eps)
         out = out + _mlp(cfg, lp, h2)

@@ -5,7 +5,18 @@ This is the TPU answer to the reference's flash_attn_varlen_func usage
 CUDA varlen attention, packed sequences carry per-token **segment ids** and the
 causal×same-segment mask is applied inside attention. The XLA path below is a
 single fused einsum chain; the Pallas flash path (areal_tpu/ops/pallas/) is
-selected automatically on TPU for long sequences.
+selected automatically on TPU.
+
+Dispatch is configured by an explicit, immutable ``AttnSpec`` threaded through
+the model call (models/lm.forward_packed(attn_spec=...)) — NOT module globals —
+so a train engine and a colocated generation engine in one process each carry
+their own mesh/impl without clobbering each other:
+
+- ``spec.mesh`` set → ``shard_map`` ring attention with tokens sharded over
+  ``spec.token_axes`` and heads over ``spec.head_axis`` (TP); the per-chunk
+  compute is the Pallas flash kernel on TPU (ops/ring_attention.py).
+- no mesh → local dispatch: Pallas flash kernel on TPU when T divides the
+  block, fused-einsum XLA otherwise.
 
 Shapes (packed training): q [T, NH, D], k/v [T, KH, D], segment_ids [T].
 Shapes (batched decode):  q [B, 1, NH, D] against cache k/v [B, S, KH, D].
@@ -13,63 +24,110 @@ Shapes (batched decode):  q [B, 1, NH, D] against cache k/v [B, S, KH, D].
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -2.0**30
 
-# module-level attention implementation selector, set by the engines from
-# TrainEngineConfig.attn_impl
-# ("auto" | "pallas" | "xla" | "pallas_interpret" | "ring")
-_ATTN_IMPL = "auto"
-_FLASH_BLOCK = 128
-# (mesh, token_axes, ring_axis) installed by the train engine when the mesh
-# has a context-parallel axis; "auto"/"ring" dispatch to ring attention then
-_RING_CTX = None
+DEFAULT_BLOCK = 128
 
 
-def set_attention_impl(impl: str):
-    global _ATTN_IMPL
-    assert impl in ("auto", "pallas", "xla", "pallas_interpret", "ring"), impl
-    _ATTN_IMPL = impl
+@dataclasses.dataclass(frozen=True)
+class AttnSpec:
+    """Immutable attention-dispatch configuration.
+
+    impl: "auto" | "pallas" | "xla" | "pallas_interpret"
+    mesh: jax Mesh for the sharded (ring / TP) path; None = local compute.
+    token_axes: mesh axes the packed token stream is sharded over (ring axes).
+    head_axis: mesh axis heads are sharded over (tensor parallelism), or None.
+    block: flash-attention block size (T on each shard must divide it for the
+      Pallas path; otherwise the XLA chunk path is used automatically).
+    """
+
+    impl: str = "auto"
+    mesh: Any = None
+    token_axes: tuple[str, ...] = ()
+    head_axis: str | None = None
+    block: int = DEFAULT_BLOCK
+
+    def __post_init__(self):
+        assert self.impl in ("auto", "pallas", "xla", "pallas_interpret"), self.impl
+
+    @property
+    def n_token_shards(self) -> int:
+        n = 1
+        for a in self.token_axes:
+            n *= self.mesh.shape[a] if self.mesh is not None else 1
+        return n
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.mesh is not None and (
+            self.n_token_shards > 1 or self.head_axis is not None
+        )
+
+    @classmethod
+    def for_mesh(
+        cls,
+        mesh,
+        model_config,
+        impl: str = "auto",
+        token_axes: tuple[str, ...] = ("dp", "cp"),
+        head_axis: str = "tp",
+        block: int = DEFAULT_BLOCK,
+    ) -> "AttnSpec":
+        """The one home for the engine dispatch rule (train + inference):
+
+        - tokens ring over ``token_axes`` when their mesh extent > 1;
+        - heads shard over ``head_axis`` when BOTH head counts divide it
+          (a GQA group must stay whole per shard);
+        - tp>1 with non-dividing heads forces the einsum path — a raw
+          pallas_call under GSPMD has no partitioning rule and would
+          replicate full-head attention on every tp device.
+        """
+        if mesh is None:
+            return cls(impl=impl, block=block)
+        n_tok = 1
+        for a in token_axes:
+            n_tok *= mesh.shape.get(a, 1)
+        tp = mesh.shape.get(head_axis, 1)
+        heads_divide = (
+            tp > 1
+            and model_config.num_attention_heads % tp == 0
+            and model_config.num_key_value_heads % tp == 0
+        )
+        if tp > 1 and not heads_divide:
+            impl = "xla"
+        tok = tuple(token_axes) if n_tok > 1 else ()
+        if not tok and not heads_divide:
+            return cls(impl=impl, block=block)
+        return cls(
+            impl=impl,
+            mesh=mesh,
+            token_axes=tok,
+            head_axis=head_axis if heads_divide else None,
+            block=block,
+        )
+
+    def resolve_impl(self, t_local: int) -> str:
+        """Concrete kernel choice for a (local-shard) stream length."""
+        if self.impl in ("xla", "pallas_interpret"):
+            return self.impl
+        if t_local % self.block != 0:
+            if self.impl == "pallas":
+                raise ValueError(
+                    f"impl=pallas requires T % {self.block} == 0, got {t_local}"
+                )
+            return "xla"
+        if self.impl == "pallas":
+            return "pallas"
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
-def get_attention_impl() -> str:
-    return _ATTN_IMPL
-
-
-def set_ring_context(mesh, token_axes=("dp", "cp"), ring_axis=None):
-    """Install (or clear, with mesh=None) the context-parallel ring setup.
-    ring_axis=None rings over all token axes flattened (always-correct
-    default — see ops/ring_attention.py)."""
-    global _RING_CTX
-    if mesh is None:
-        _RING_CTX = None
-    else:
-        _RING_CTX = (mesh, tuple(token_axes), ring_axis or tuple(token_axes))
-
-
-def _ring_enabled() -> bool:
-    if _RING_CTX is None:
-        return False
-    if _ATTN_IMPL == "ring":
-        return True
-    mesh, _, ring_axis = _RING_CTX
-    axes = (ring_axis,) if isinstance(ring_axis, str) else ring_axis
-    size = 1
-    for a in axes:
-        size *= mesh.shape[a]
-    return _ATTN_IMPL == "auto" and size > 1
-
-
-def _use_pallas(t: int, backend: str | None = None) -> bool:
-    if _ATTN_IMPL == "xla":
-        return False
-    if t % _FLASH_BLOCK != 0:
-        return False
-    if _ATTN_IMPL in ("pallas", "pallas_interpret"):
-        return True
-    return (backend or jax.default_backend()) == "tpu"
+_DEFAULT_SPEC = AttnSpec()
 
 
 def packed_attention(
@@ -78,30 +136,30 @@ def packed_attention(
     v: jnp.ndarray,
     segment_ids: jnp.ndarray,
     softmax_scale: float | None = None,
+    spec: AttnSpec | None = None,
 ) -> jnp.ndarray:
-    """Dispatch: ring attention when a cp ring context is installed, Pallas
-    flash kernel on TPU (T divisible by the block), fused-einsum XLA path
-    otherwise. Same [T, ...] packed layout in all cases."""
-    if _ring_enabled():
+    """Dispatch per ``spec`` (see module docstring). Same [T, ...] packed
+    layout in all cases."""
+    spec = spec if spec is not None else _DEFAULT_SPEC
+    if spec.is_sharded:
         from areal_tpu.ops.ring_attention import ring_attention_sharded
 
-        mesh, token_axes, ring_axis = _RING_CTX
+        t_local = q.shape[0] // max(spec.n_token_shards, 1)
         return ring_attention_sharded(
-            mesh, q, k, v, segment_ids,
-            token_axes=token_axes, ring_axis=ring_axis,
+            spec.mesh, q, k, v, segment_ids,
+            token_axes=spec.token_axes,
             softmax_scale=softmax_scale,
+            chunk_impl=spec.resolve_impl(t_local),
+            head_axis=spec.head_axis,
+            block=spec.block,
         )
-    if _use_pallas(q.shape[0]):
+    impl = spec.resolve_impl(q.shape[0])
+    if impl in ("pallas", "pallas_interpret"):
         from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
 
         return flash_attention_packed(
-            q,
-            k,
-            v,
-            segment_ids,
-            softmax_scale,
-            _FLASH_BLOCK,
-            _ATTN_IMPL == "pallas_interpret",
+            q, k, v, segment_ids, softmax_scale, spec.block,
+            impl == "pallas_interpret",
         )
     return packed_attention_xla(q, k, v, segment_ids, softmax_scale)
 

@@ -20,6 +20,16 @@ Design (tpu-first):
   dk/dv kernel over (heads, kv_blocks, q_blocks) at full q-head resolution,
   group-summed outside the kernel.
 
+Two entry points:
+- ``flash_attention_packed`` — self-attention over one stream (q == kv).
+- ``flash_attention_chunk`` — cross-chunk attention between a local query
+  shard and a (possibly remote) KV chunk with **global position offsets**
+  ``q_start``/``k_start`` and separate segment-id streams; returns
+  ``(o, lse)`` so ring context parallelism (ops/ring_attention.py) can merge
+  chunks with a streaming softmax. The lse cotangent folds into the existing
+  delta term (d s from dlse is ``p * dlse`` = replacing delta by
+  ``delta - dlse``), so the backward kernels are shared.
+
 T must be a multiple of the block size (the engine pads packed microbatches
 to ``pad_mb_to_multiple`` — cli_args.EngineBackendConfig); padding tokens use
 segment_id=-1 and produce zero output rows.
@@ -52,16 +62,17 @@ def _seg_ranges(segment_ids: jnp.ndarray, block: int):
     return mn, mx
 
 
-def _block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk):
-    causal = (ki * bk) <= (qi * bq + bq - 1)
+def _block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk):
+    q0, k0 = starts[0], starts[1]
+    causal = (k0 + ki * bk) <= (q0 + qi * bq + bq - 1)
     overlap = (kmax[ki] >= qmin[qi]) & (kmin[ki] <= qmax[qi])
     valid = (qmax[qi] >= 0) & (kmax[ki] >= 0)
     return causal & overlap & valid
 
 
-def _mask(segq, segk, qi, ki, bq, bk):
-    qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+def _mask(segq, segk, starts, qi, ki, bq, bk):
+    qpos = starts[0] + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = starts[1] + ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return (kpos <= qpos) & (segq == segk.T) & (segq >= 0)
 
 
@@ -71,7 +82,7 @@ def _mask(segq, segk, qi, ki, bq, bk):
 
 
 def _fwd_kernel(
-    qmin, qmax, kmin, kmax,  # scalar-prefetch SMEM refs [nq]/[nk]
+    qmin, qmax, kmin, kmax, starts,  # scalar-prefetch SMEM refs [nq]/[nk]/[2]
     q_ref, k_ref, v_ref, segq_ref, segk_ref,
     o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
@@ -85,15 +96,15 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
     def _compute():
-        q = q_ref[:, 0, :]
-        k = k_ref[:, 0, :]
-        v = v_ref[:, 0, :]
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -115,38 +126,48 @@ def _fwd_kernel(
         valid = m > NEG_INF / 2
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o = jnp.where(valid, acc_scr[:, :] / safe_l, 0.0)
-        o_ref[:, 0, :] = o.astype(o_ref.dtype)
+        o_ref[:, :] = o.astype(o_ref.dtype)
         lse = jnp.where(valid & (l > 0.0), m + jnp.log(safe_l), NEG_INF)
-        lse_ref[0, :] = lse[:, 0]
+        # lse is per-row scalar data, but TPU block tiling wants a minor dim
+        # of 8/128 — store it broadcast across 8 lanes, slice lane 0 outside
+        lse_ref[:, :] = jnp.broadcast_to(lse, (lse.shape[0], 8))
 
 
-def _fwd(q, k, v, segment_ids, scale, block: int, interpret: bool):
-    t, nh, d = q.shape
-    kh = k.shape[1]
+def _fwd(q, k, v, segq, segk, starts, scale, block: int, interpret: bool):
+    tq, nh, d = q.shape
+    tk, kh = k.shape[0], k.shape[1]
     group = nh // kh
-    bq = bk = min(block, t)
-    assert t % bq == 0, (t, bq)
-    nq, nk = t // bq, t // bk
-    seg2d = segment_ids.reshape(t, 1).astype(jnp.int32)
-    qmn, qmx = _seg_ranges(segment_ids, bq)
-    kmn, kmx = _seg_ranges(segment_ids, bk)
+    bq = min(block, tq)
+    bk = min(block, tk)
+    assert tq % bq == 0 and tk % bk == 0, (tq, bq, tk, bk)
+    nq, nk = tq // bq, tk // bk
+    segq2d = segq.reshape(tq, 1).astype(jnp.int32)
+    segk2d = segk.reshape(tk, 1).astype(jnp.int32)
+    qmn, qmx = _seg_ranges(segq, bq)
+    kmn, kmx = _seg_ranges(segk, bk)
 
+    # head-major [NH, T, D] layout with the head dim squeezed out of every
+    # block (None) — TPU block tiling requires the trailing two block dims be
+    # (mult of 8, mult of 128) or full, which (bq, 1, d) blocks violate
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh_ = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(nh, nq, nk),
         in_specs=[
-            pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
-            pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
-            pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+            pl.BlockSpec((None, bq, d), lambda h, qi, ki, *_: (h, qi, 0)),
+            pl.BlockSpec((None, bk, d), lambda h, qi, ki, *_: (h // group, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda h, qi, ki, *_: (h // group, ki, 0)),
             pl.BlockSpec((bq, 1), lambda h, qi, ki, *_: (qi, 0)),
             pl.BlockSpec((bk, 1), lambda h, qi, ki, *_: (ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
-            pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
+            pl.BlockSpec((None, bq, d), lambda h, qi, ki, *_: (h, qi, 0)),
+            pl.BlockSpec((None, bq, 8), lambda h, qi, ki, *_: (h, qi, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -155,8 +176,8 @@ def _fwd(q, k, v, segment_ids, scale, block: int, interpret: bool):
         ],
     )
     out_shapes = [
-        jax.ShapeDtypeStruct((t, nh, d), q.dtype),
-        jax.ShapeDtypeStruct((nh, t), jnp.float32),
+        jax.ShapeDtypeStruct((nh, tq, d), q.dtype),
+        jax.ShapeDtypeStruct((nh, tq, 8), jnp.float32),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -166,8 +187,8 @@ def _fwd(q, k, v, segment_ids, scale, block: int, interpret: bool):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d)
-    return o, lse
+    )(qmn, qmx, kmn, kmx, starts, qh, kh_, vh, segq2d, segk2d)
+    return jnp.transpose(o, (1, 0, 2)), lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -176,7 +197,7 @@ def _fwd(q, k, v, segment_ids, scale, block: int, interpret: bool):
 
 
 def _dq_kernel(
-    qmin, qmax, kmin, kmax,
+    qmin, qmax, kmin, kmax, starts,
     q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
     dq_ref,
     dq_scr,
@@ -188,23 +209,23 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
     def _compute():
-        q = q_ref[:, 0, :]
-        k = k_ref[:, 0, :]
-        v = v_ref[:, 0, :]
-        do = do_ref[:, 0, :]
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        do = do_ref[:, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
         s = jnp.where(mask, s, NEG_INF)
-        lse = lse_ref[0, :][:, None]  # [bq, 1]
+        lse = lse_ref[:, 0:1]  # [bq, 1]
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        delta = delta_ref[0, :][:, None]
+        delta = delta_ref[:, 0:1]
         ds = p * (dp - delta) * scale
         dq_scr[:, :] += jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
@@ -212,11 +233,11 @@ def _dq_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[:, 0, :] = dq_scr[:, :].astype(dq_ref.dtype)
+        dq_ref[:, :] = dq_scr[:, :].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    qmin, qmax, kmin, kmax,
+    qmin, qmax, kmin, kmax, starts,
     q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref,
     dk_scr, dv_scr,
@@ -229,18 +250,18 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
     def _compute():
-        q = q_ref[:, 0, :]
-        k = k_ref[:, 0, :]
-        v = v_ref[:, 0, :]
-        do = do_ref[:, 0, :]
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        do = do_ref[:, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
         s = jnp.where(mask, s, NEG_INF)
-        lse = lse_ref[0, :][:, None]
+        lse = lse_ref[:, 0:1]
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_scr[:, :] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -249,7 +270,7 @@ def _dkv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        delta = delta_ref[0, :][:, None]
+        delta = delta_ref[:, 0:1]
         ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
         dk_scr[:, :] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -257,66 +278,81 @@ def _dkv_kernel(
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[:, 0, :] = dk_scr[:, :].astype(dk_ref.dtype)
-        dv_ref[:, 0, :] = dv_scr[:, :].astype(dv_ref.dtype)
+        dk_ref[:, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[:, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _bwd(block, interpret, scale, res, dout):
-    q, k, v, segment_ids, o, lse = res
-    t, nh, d = q.shape
-    kh = k.shape[1]
+def _bwd(block, interpret, scale, res, dout, dlse=None):
+    q, k, v, segq, segk, starts, o, lse = res
+    tq, nh, d = q.shape
+    tk, kh = k.shape[0], k.shape[1]
     group = nh // kh
-    bq = bk = min(block, t)
-    nq, nk = t // bq, t // bk
-    seg2d = segment_ids.reshape(t, 1).astype(jnp.int32)
-    qmn, qmx = _seg_ranges(segment_ids, bq)
-    kmn, kmx = _seg_ranges(segment_ids, bk)
-    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1).T  # [NH, T]
+    bq = min(block, tq)
+    bk = min(block, tk)
+    nq, nk = tq // bq, tk // bk
+    segq2d = segq.reshape(tq, 1).astype(jnp.int32)
+    segk2d = segk.reshape(tk, 1).astype(jnp.int32)
+    qmn, qmx = _seg_ranges(segq, bq)
+    kmn, kmx = _seg_ranges(segk, bk)
+    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1).T  # [NH, Tq]
+    if dlse is not None:
+        # d s_ij from the lse output is p_ij * dlse_i, identical in form to
+        # the -delta term — fold it in instead of touching the kernels
+        delta = delta - dlse.astype(jnp.float32)
+
+    # head-major layout + squeezed head blocks (see _fwd); lse/delta carry a
+    # broadcast 8-lane minor dim for block tiling
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh2 = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    doh = jnp.transpose(dout, (1, 0, 2))
+    lse8 = jnp.broadcast_to(lse[:, :, None], (nh, tq, 8))
+    delta8 = jnp.broadcast_to(delta[:, :, None], (nh, tq, 8))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=5,
             grid=(nh, nq, nk),
             in_specs=[
-                pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
-                pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
-                pl.BlockSpec((bk, 1, d), lambda h, qi, ki, *_: (ki, h // group, 0)),
+                pl.BlockSpec((None, bq, d), lambda h, qi, ki, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, qi, ki, *_: (h // group, ki, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, qi, ki, *_: (h // group, ki, 0)),
                 pl.BlockSpec((bq, 1), lambda h, qi, ki, *_: (qi, 0)),
                 pl.BlockSpec((bk, 1), lambda h, qi, ki, *_: (ki, 0)),
-                pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
-                pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
-                pl.BlockSpec((1, bq), lambda h, qi, ki, *_: (h, qi)),
+                pl.BlockSpec((None, bq, d), lambda h, qi, ki, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bq, 8), lambda h, qi, ki, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bq, 8), lambda h, qi, ki, *_: (h, qi, 0)),
             ],
-            out_specs=pl.BlockSpec((bq, 1, d), lambda h, qi, ki, *_: (qi, h, 0)),
+            out_specs=pl.BlockSpec((None, bq, d), lambda h, qi, ki, *_: (h, qi, 0)),
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((nh, tq, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d, dout, lse, delta)
+    )(qmn, qmx, kmn, kmx, starts, qh, kh2, vh, segq2d, segk2d, doh, lse8, delta8)
 
     # dk/dv at full q-head resolution, summed over the GQA group afterwards
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=5,
             grid=(nh, nk, nq),
             in_specs=[
-                pl.BlockSpec((bq, 1, d), lambda h, ki, qi, *_: (qi, h, 0)),
-                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h // group, 0)),
-                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h // group, 0)),
+                pl.BlockSpec((None, bq, d), lambda h, ki, qi, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, ki, qi, *_: (h // group, ki, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, ki, qi, *_: (h // group, ki, 0)),
                 pl.BlockSpec((bq, 1), lambda h, ki, qi, *_: (qi, 0)),
                 pl.BlockSpec((bk, 1), lambda h, ki, qi, *_: (ki, 0)),
-                pl.BlockSpec((bq, 1, d), lambda h, ki, qi, *_: (qi, h, 0)),
-                pl.BlockSpec((1, bq), lambda h, ki, qi, *_: (h, qi)),
-                pl.BlockSpec((1, bq), lambda h, ki, qi, *_: (h, qi)),
+                pl.BlockSpec((None, bq, d), lambda h, ki, qi, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bq, 8), lambda h, ki, qi, *_: (h, qi, 0)),
+                pl.BlockSpec((None, bq, 8), lambda h, ki, qi, *_: (h, qi, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h, 0)),
-                pl.BlockSpec((bk, 1, d), lambda h, ki, qi, *_: (ki, h, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, ki, qi, *_: (h, ki, 0)),
+                pl.BlockSpec((None, bk, d), lambda h, ki, qi, *_: (h, ki, 0)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bk, d), jnp.float32),
@@ -324,21 +360,71 @@ def _bwd(block, interpret, scale, res, dout):
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((t, nh, d), q.dtype),
-            jax.ShapeDtypeStruct((t, nh, d), q.dtype),
+            jax.ShapeDtypeStruct((nh, tk, d), q.dtype),
+            jax.ShapeDtypeStruct((nh, tk, d), q.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qmn, qmx, kmn, kmx, q, k, v, seg2d, seg2d, dout, lse, delta)
+    )(qmn, qmx, kmn, kmx, starts, qh, kh2, vh, segq2d, segk2d, doh, lse8, delta8)
 
-    dk = dk_full.reshape(t, kh, group, d).sum(axis=2).astype(k.dtype)
-    dv = dv_full.reshape(t, kh, group, d).sum(axis=2).astype(v.dtype)
-    return dq, dk, dv, None
+    dq = jnp.transpose(dq, (1, 0, 2))
+    dk = (
+        dk_full.reshape(kh, group, tk, d).sum(axis=1).transpose(1, 0, 2).astype(k.dtype)
+    )
+    dv = (
+        dv_full.reshape(kh, group, tk, d).sum(axis=1).transpose(1, 0, 2).astype(v.dtype)
+    )
+    return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def flash_attention_chunk(
+    q: jnp.ndarray,  # [Tq, NH, D] — local query shard
+    k: jnp.ndarray,  # [Tk, KH, D] — one (possibly remote) KV chunk
+    v: jnp.ndarray,  # [Tk, KH, D]
+    segq: jnp.ndarray,  # [Tq] int32 global segment ids (pad = -1)
+    segk: jnp.ndarray,  # [Tk]
+    q_start: jnp.ndarray,  # scalar int32, global position of q[0]
+    k_start: jnp.ndarray,  # scalar int32, global position of k[0]
+    softmax_scale: float | None = None,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One ring-attention step: (o [Tq, NH, D], lse [NH, Tq])."""
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    starts = jnp.stack(
+        [jnp.asarray(q_start, jnp.int32), jnp.asarray(k_start, jnp.int32)]
+    )
+    return _fwd(q, k, v, segq, segk, starts, scale, block, interpret)
+
+
+def _chunk_vjp_fwd(q, k, v, segq, segk, q_start, k_start, softmax_scale, block, interpret):
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    starts = jnp.stack(
+        [jnp.asarray(q_start, jnp.int32), jnp.asarray(k_start, jnp.int32)]
+    )
+    o, lse = _fwd(q, k, v, segq, segk, starts, scale, block, interpret)
+    return (o, lse), (q, k, v, segq, segk, starts, o, lse)
+
+
+def _chunk_vjp_bwd(softmax_scale, block, interpret, res, cotangents):
+    dout, dlse = cotangents
+    q = res[0]
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _bwd(block, interpret, scale, res, dout, dlse)
+    return dq, dk, dv, None, None, None, None
+
+
+flash_attention_chunk.defvjp(_chunk_vjp_fwd, _chunk_vjp_bwd)
+
+
 def flash_attention_packed(
     q: jnp.ndarray,  # [T, NH, D]
     k: jnp.ndarray,  # [T, KH, D]
@@ -348,21 +434,10 @@ def flash_attention_packed(
     block: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    o, _ = _fwd(q, k, v, segment_ids, scale, block, interpret)
+    """Self-attention over one packed stream (q == kv stream)."""
+    zero = jnp.int32(0)
+    o, _ = flash_attention_chunk(
+        q, k, v, segment_ids, segment_ids, zero, zero,
+        softmax_scale, block, interpret,
+    )
     return o
-
-
-def _vjp_fwd(q, k, v, segment_ids, softmax_scale, block, interpret):
-    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    o, lse = _fwd(q, k, v, segment_ids, scale, block, interpret)
-    return o, (q, k, v, segment_ids, o, lse)
-
-
-def _vjp_bwd(softmax_scale, block, interpret, res, dout):
-    q = res[0]
-    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    return _bwd(block, interpret, scale, res, dout)
-
-
-flash_attention_packed.defvjp(_vjp_fwd, _vjp_bwd)

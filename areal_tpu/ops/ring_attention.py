@@ -1,24 +1,31 @@
-"""Ring attention over the context-parallel mesh axis.
+"""Ring attention over the context-parallel mesh axes.
 
 The TPU counterpart of the reference's Megatron/TransformerEngine context
 parallelism (areal/utils/mcore/packed_context_parallel.py, SURVEY §2.2 CP
-row): the packed token stream is sharded contiguously over the ``cp`` axis;
+row): the packed token stream is sharded contiguously over the token axes;
 K/V chunks rotate around the ring via ``lax.ppermute`` while each rank
-accumulates its queries' attention with a streaming-softmax merge, so peak
-memory is O((T/cp)^2) per step and the K/V transfer overlaps compute on ICI.
+merges its queries' per-chunk attention with a streaming-softmax (log-sum-exp)
+combine, so peak memory is O((T/n)^2) per step and the K/V transfer overlaps
+compute on ICI.
 
-Causality uses GLOBAL token indices, so one uniform mask covers the diagonal
-chunk (causal), below-diagonal chunks (full), and above-diagonal chunks
-(empty) — no per-chunk case analysis, and the reference's 2-chunk causal
-load-balancing trick becomes unnecessary because every rank walks the whole
-ring anyway (compute is imbalanced per step but balanced over the ring).
+Causality uses GLOBAL token indices (chunk position offsets), so one uniform
+mask covers the diagonal chunk (causal), below-diagonal chunks (full), and
+above-diagonal chunks (empty) — no per-chunk case analysis, and the
+reference's 2-chunk causal load-balancing trick becomes unnecessary because
+every rank walks the whole ring anyway (compute is imbalanced per step but
+balanced over the ring).
 
-Pure jnp + ppermute => jax autodiff differentiates it (ppermute transposes to
-the reverse rotation); no custom VJP needed. The inner per-chunk-pair compute
-is XLA-fused; swapping it for the Pallas flash kernel is a drop-in follow-up.
+Per-chunk compute is selectable: the Pallas flash kernel
+(ops/pallas/flash_attention.flash_attention_chunk — block-skipping, GQA in
+the index maps) on TPU, or a fused-einsum XLA chunk elsewhere. Both return
+(o, lse) and both are differentiable (the kernel via its custom VJP, the
+merge and ppermute via plain autodiff), so the ring needs no hand-written
+global VJP.
 
-Intended use: inside ``shard_map`` (see ``ring_attention_sharded``) with
-q/k/v/segment_ids/global positions all sharded along tokens over ("dp","cp").
+``ring_attention_sharded`` is the jit-safe wrapper: a ``shard_map`` over the
+mesh with tokens sharded along ``token_axes`` and (optionally) heads sharded
+along ``head_axis`` (tensor parallelism) — this is how the flash kernel runs
+under TP instead of falling back to O(T^2) einsum attention.
 """
 
 from __future__ import annotations
@@ -34,37 +41,53 @@ from areal_tpu.ops.attention import repeat_kv
 _NEG_INF = -1e30
 
 
-def _ring_body(q, segq, posq, scale, axis_name, n):
-    """Returns the scan step fn for one ring rotation (n = ring size,
-    static)."""
-    perm = [(i, (i + 1) % n) for i in range(n)]
+def _chunk_xla(q, k, v, segq, segk, q_start, k_start, scale):
+    """Einsum chunk attention returning (o [Tq,NH,D] f32, lse [NH,Tq])."""
+    tq, nh, d = q.shape
+    tk, kh = k.shape[0], k.shape[1]
+    kf = repeat_kv(k, nh // kh)
+    vf = repeat_kv(v, nh // kh)
+    s = jnp.einsum(
+        "qhd,khd->hqk", q, kf, preferred_element_type=jnp.float32
+    ) * scale
+    qpos = q_start + jnp.arange(tq, dtype=jnp.int32)
+    kpos = k_start + jnp.arange(tk, dtype=jnp.int32)
+    mask = (
+        (segq[:, None] == segk[None, :])
+        & (segq[:, None] >= 0)
+        & (qpos[:, None] >= kpos[None, :])
+    )
+    s = jnp.where(mask[None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [H, Tq]
+    valid = m > _NEG_INF / 2
+    p = jnp.exp(s - jnp.where(valid, m, 0.0)[..., None])
+    p = jnp.where(mask[None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    safe_l = jnp.where(l > 0, l, 1.0)
+    acc = jnp.einsum(
+        "hqk,khd->hqd", p.astype(vf.dtype), vf,
+        preferred_element_type=jnp.float32,
+    )
+    o = jnp.where(valid[..., None], acc / safe_l[..., None], 0.0)
+    lse = jnp.where(valid & (l > 0), m + jnp.log(safe_l), _NEG_INF)
+    return jnp.transpose(o, (1, 0, 2)), lse  # [Tq, NH, D] f32, [NH, Tq]
 
-    def step(carry, _):
-        m, l, acc, k_cur, v_cur, segk, posk = carry
-        s = jnp.einsum(
-            "qhd,khd->hqk", q, k_cur, preferred_element_type=jnp.float32
-        ) * scale
-        mask = (
-            (segq[:, None] == segk[None, :])
-            & (segq[:, None] >= 0)
-            & (posq[:, None] >= posk[None, :])
-        )
-        s = jnp.where(mask[None], s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # [H, Tq]
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum(
-            "hqk,khd->hqd", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32,
-        )
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        segk_nxt = jax.lax.ppermute(segk, axis_name, perm)
-        posk_nxt = jax.lax.ppermute(posk, axis_name, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt, segk_nxt, posk_nxt), None
 
-    return step
+def _merge(o_acc, lse_acc, o_c, lse_c):
+    """Streaming-softmax combine of two normalized chunk results."""
+    m = jnp.maximum(lse_acc, lse_c)
+    valid = m > _NEG_INF / 2
+    m_safe = jnp.where(valid, m, 0.0)
+    w1 = jnp.where(lse_acc > _NEG_INF / 2, jnp.exp(lse_acc - m_safe), 0.0)
+    w2 = jnp.where(lse_c > _NEG_INF / 2, jnp.exp(lse_c - m_safe), 0.0)
+    l = w1 + w2
+    safe_l = jnp.where(l > 0, l, 1.0)
+    # weights are [NH, Tq]; o is [Tq, NH, D]
+    w1t = jnp.transpose(w1 / safe_l)[..., None]
+    w2t = jnp.transpose(w2 / safe_l)[..., None]
+    o = o_acc * w1t + o_c.astype(jnp.float32) * w2t
+    lse = jnp.where(valid & (l > 0), m_safe + jnp.log(safe_l), _NEG_INF)
+    return o, lse
 
 
 def ring_attention_local(
@@ -72,30 +95,52 @@ def ring_attention_local(
     k: jnp.ndarray,  # [Tl, KH, D]
     v: jnp.ndarray,  # [Tl, KH, D]
     segment_ids: jnp.ndarray,  # [Tl] global segment ids (pad -1)
-    global_pos: jnp.ndarray,  # [Tl] global token indices in the packed stream
-    axis_name: str = "cp",
+    q_start: jnp.ndarray,  # scalar int32: global position of this shard's q[0]
+    axis_name=("cp",),
     ring_size: int = 1,
     softmax_scale: float | None = None,
+    chunk_impl: str = "xla",  # xla | pallas | pallas_interpret
+    block: int = 128,
 ) -> jnp.ndarray:
     """The per-rank function; call under shard_map over ``axis_name``."""
     tl, nh, d = q.shape
-    kh = k.shape[1]
     scale = softmax_scale if softmax_scale is not None else d**-0.5
-    kf = repeat_kv(k, nh // kh)
-    vf = repeat_kv(v, nh // kh)
 
-    m0 = jnp.full((nh, tl), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((nh, tl), jnp.float32)
-    acc0 = jnp.zeros((nh, tl, d), jnp.float32)
-    step = _ring_body(q, segment_ids, global_pos, scale, axis_name, ring_size)
-    (m, l, acc, _, _, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, kf, vf, segment_ids, global_pos), None,
-        length=ring_size,
+    if chunk_impl in ("pallas", "pallas_interpret"):
+        from areal_tpu.ops.pallas.flash_attention import flash_attention_chunk
+
+        chunk = functools.partial(
+            flash_attention_chunk,
+            softmax_scale=scale,
+            block=block,
+            interpret=chunk_impl == "pallas_interpret",
+        )
+    else:
+        chunk = functools.partial(_chunk_xla, scale=scale)
+
+    if ring_size == 1:
+        o, _ = chunk(q, k, v, segment_ids, segment_ids, q_start, q_start)
+        return o.astype(q.dtype)
+
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(carry, _):
+        o_acc, lse_acc, k_cur, v_cur, segk, k_start = carry
+        o_c, lse_c = chunk(q, k_cur, v_cur, segment_ids, segk, q_start, k_start)
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_c, lse_c)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        segk_nxt = jax.lax.ppermute(segk, axis_name, perm)
+        kst_nxt = jax.lax.ppermute(k_start, axis_name, perm)
+        return (o_acc, lse_acc, k_nxt, v_nxt, segk_nxt, kst_nxt), None
+
+    o0 = jnp.zeros((tl, nh, d), jnp.float32)
+    lse0 = jnp.full((nh, tl), _NEG_INF, jnp.float32)
+    (o, _, _, _, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v, segment_ids, jnp.asarray(q_start, jnp.int32)),
+        None, length=ring_size,
     )
-    valid = m > _NEG_INF / 2
-    safe_l = jnp.where(l > 0, l, 1.0)
-    out = jnp.where(valid[..., None], acc / safe_l[..., None], 0.0)
-    return jnp.transpose(out, (1, 0, 2)).astype(q.dtype)  # [Tl, NH, D]
+    return o.astype(q.dtype)
 
 
 def ring_attention_sharded(
@@ -107,10 +152,13 @@ def ring_attention_sharded(
     token_axes: tuple[str, ...] = ("dp", "cp"),
     ring_axis: str | tuple[str, ...] | None = None,
     softmax_scale: float | None = None,
+    chunk_impl: str = "xla",
+    head_axis: str | None = None,
+    block: int = 128,
 ) -> jnp.ndarray:
-    """shard_map wrapper: tokens sharded over ``token_axes``; K/V ring over
-    ``ring_axis`` (default: ALL token axes, flattened). Callable inside jit
-    on the same mesh.
+    """shard_map wrapper: tokens sharded over ``token_axes``, heads over
+    ``head_axis`` (TP), K/V ring over ``ring_axis`` (default: ALL token
+    axes, flattened). Callable inside jit on the same mesh.
 
     Ringing over the full flattened token-sharding axis group makes the
     result exactly equal to global packed attention regardless of where
@@ -119,29 +167,42 @@ def ring_attention_sharded(
     narrower ring (e.g. just "cp") is valid only when the packing guarantees
     no sequence straddles the excluded axes.
     """
+    token_axes = tuple(token_axes)
     if ring_axis is None:
         ring_axis = token_axes
-    t = q.shape[0]
-    global_pos = jnp.arange(t, dtype=jnp.int32)
-    spec_tok3 = P(token_axes, None, None)
-    spec_tok1 = P(token_axes)
+    axes = (ring_axis,) if isinstance(ring_axis, str) else tuple(ring_axis)
+    ring_size = 1
+    for a in axes:
+        ring_size *= mesh.shape[a]
 
-    if isinstance(ring_axis, str):
-        ring_size = mesh.shape[ring_axis]
-    else:
-        ring_size = 1
-        for a in ring_axis:
-            ring_size *= mesh.shape[a]
-    fn = functools.partial(
-        ring_attention_local,
-        axis_name=ring_axis,
-        ring_size=ring_size,
-        softmax_scale=softmax_scale,
-    )
+    n_tok = 1
+    for a in token_axes:
+        n_tok *= mesh.shape[a]
+    tl = q.shape[0] // max(n_tok, 1)
+
+    tok = token_axes if token_axes else None
+
+    def fn(q_l, k_l, v_l, seg_l):
+        if token_axes:
+            idx = jax.lax.axis_index(token_axes)
+        else:
+            idx = jnp.int32(0)
+        q_start = (idx * tl).astype(jnp.int32)
+        return ring_attention_local(
+            q_l, k_l, v_l, seg_l, q_start,
+            axis_name=axes if len(axes) != 1 else axes[0],
+            ring_size=ring_size,
+            softmax_scale=softmax_scale,
+            chunk_impl=chunk_impl,
+            block=block,
+        )
+
+    spec3 = P(tok, head_axis, None)
+    spec1 = P(tok)
     return jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec_tok3, spec_tok3, spec_tok3, spec_tok1, spec_tok1),
-        out_specs=spec_tok3,
+        in_specs=(spec3, spec3, spec3, spec1),
+        out_specs=spec3,
         check_vma=False,
-    )(q, k, v, segment_ids, global_pos)
+    )(q, k, v, segment_ids)

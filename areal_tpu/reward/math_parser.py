@@ -85,9 +85,13 @@ _LATEX_SUBS = [
     (re.compile(r"\s+"), ""),
 ]
 
+# only strip a unit suffix when it follows a digit (optionally with a space):
+# "2m" -> "2", "3 cm" -> "3", but symbolic answers like "x+m" or bare "min"
+# keep their letters
 _UNIT_TAIL = re.compile(
-    r"(?:degrees?|deg|cm|mm|km|m|inches|inch|in|feet|ft|hours?|hrs?|minutes?"
-    r"|mins?|seconds?|secs?|dollars?|cents?|percent|units?|square|cubic)$",
+    r"(?<=\d)\s*(?:degrees?|deg|cm|mm|km|m|inches|inch|in|feet|ft|hours?|hrs?"
+    r"|minutes?|mins?|seconds?|secs?|dollars?|cents?|percent|units?|square"
+    r"|cubic)$",
     re.IGNORECASE,
 )
 

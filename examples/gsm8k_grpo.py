@@ -28,7 +28,7 @@ from areal_tpu.api.io_struct import (  # noqa: E402
 )
 from areal_tpu.core.remote_inf_engine import RemoteInfEngine  # noqa: E402
 from areal_tpu.dataset import get_custom_dataset  # noqa: E402
-from areal_tpu.engine.ppo.actor import TPUPPOActor  # noqa: E402
+from areal_tpu.engine.ppo.actor import PPOActor, TPUPPOActor  # noqa: E402
 from areal_tpu.engine.train_engine import TPUTrainEngine  # noqa: E402
 from areal_tpu.reward import math_verify_reward  # noqa: E402
 from areal_tpu.utils import logging, stats_tracker  # noqa: E402
@@ -84,11 +84,14 @@ def main(argv=None):
     )
     actor.connect_engine(rollout, weight_meta)
 
-    ref: TPUTrainEngine | None = None
+    ref: PPOActor | None = None
     if cfg.ref is not None and cfg.actor.kl_ctl != 0.0:
-        ref = TPUTrainEngine(cfg.ref)
-        ref.create_process_group(alloc.train)
-        ref.initialize(None, ft_spec)
+        ref_engine = TPUTrainEngine(cfg.ref)
+        ref_engine.create_process_group(alloc.train)
+        ref_engine.initialize(None, ft_spec)
+        # Wrap so the frozen reference policy can compute logprobs; the KL
+        # penalty must compare actor vs ref weights, not actor vs itself.
+        ref = PPOActor(cfg.actor, ref_engine)
 
     log_dir = os.path.join(
         cfg.stats_logger.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
@@ -145,7 +148,7 @@ def main(argv=None):
 
         if ref is not None:
             with stats_tracker.record_timing("ref_logp"):
-                batch["ref_logp"] = actor.actor.compute_logp(batch)
+                batch["ref_logp"] = ref.compute_logp(batch)
 
         with stats_tracker.record_timing("compute_advantage"):
             actor.actor.compute_advantages(batch)

@@ -9,9 +9,9 @@ import numpy as np
 import pytest
 
 from areal_tpu.ops.attention import (
+    AttnSpec,
     packed_attention,
     packed_attention_xla,
-    set_attention_impl,
 )
 from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
 
@@ -71,13 +71,10 @@ def test_grads_match_xla():
 def test_dispatch_selects_impl():
     rng = np.random.default_rng(2)
     q, k, v, seg = make_inputs(rng, 128, 2, 2, 64, [100])
-    try:
-        set_attention_impl("pallas_interpret")
-        out_pallas = np.asarray(packed_attention(q, k, v, seg))
-        set_attention_impl("xla")
-        out_xla = np.asarray(packed_attention(q, k, v, seg))
-    finally:
-        set_attention_impl("auto")
+    out_pallas = np.asarray(
+        packed_attention(q, k, v, seg, spec=AttnSpec(impl="pallas_interpret"))
+    )
+    out_xla = np.asarray(packed_attention(q, k, v, seg, spec=AttnSpec(impl="xla")))
     valid = (np.asarray(seg) >= 0)[:, None, None]
     np.testing.assert_allclose(
         np.where(valid, out_pallas, 0.0),
@@ -90,11 +87,11 @@ def test_dispatch_selects_impl():
 def test_non_multiple_t_falls_back():
     rng = np.random.default_rng(3)
     q, k, v, seg = make_inputs(rng, 100, 2, 2, 64, [60])
-    try:
-        set_attention_impl("pallas")  # forced, but T=100 not divisible
-        out = np.asarray(packed_attention(q, k, v, seg))
-    finally:
-        set_attention_impl("auto")
+    # auto with T=100 not divisible by the block -> xla fallback
+    out = np.asarray(packed_attention(q, k, v, seg, spec=AttnSpec(impl="auto")))
+    # forced pallas with non-divisible T is a loud error, not silence
+    with pytest.raises(ValueError):
+        packed_attention(q, k, v, seg, spec=AttnSpec(impl="pallas"))
     ref = np.asarray(packed_attention_xla(q, k, v, seg))
     np.testing.assert_allclose(out, ref, rtol=1e-6)
 
@@ -117,13 +114,10 @@ def test_model_forward_with_pallas_interpret():
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, t), jnp.int32)
     seg = jnp.asarray(([0] * 70 + [1] * 50 + [-1] * 8), jnp.int32)
     pos = jnp.concatenate([jnp.arange(70), jnp.arange(50), jnp.zeros(8, jnp.int32)])
-    try:
-        set_attention_impl("xla")
-        ref = forward_packed(params, cfg, ids, pos, seg)
-        set_attention_impl("pallas_interpret")
-        out = forward_packed(params, cfg, ids, pos, seg)
-    finally:
-        set_attention_impl("auto")
+    ref = forward_packed(params, cfg, ids, pos, seg, attn_spec=AttnSpec(impl="xla"))
+    out = forward_packed(
+        params, cfg, ids, pos, seg, attn_spec=AttnSpec(impl="pallas_interpret")
+    )
     valid = np.asarray(seg) >= 0
     np.testing.assert_allclose(
         np.asarray(out)[valid], np.asarray(ref)[valid], rtol=3e-4, atol=3e-4

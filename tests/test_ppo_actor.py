@@ -143,3 +143,32 @@ def test_group_adv_norm():
         rows = slice(2 * g, 2 * g + 2)
         vals = adv[rows][mask[rows]]
         assert abs(vals.mean()) < 1e-3
+
+
+@pytest.mark.parametrize(
+    "mode", ["seq-mean-token-sum", "seq-mean-token-mean"]
+)
+def test_log_agg_mode_seq_mean(mode):
+    """Dr.GRPO-style aggregation must actually change the update (the knob
+    was previously dead — ADVICE r1)."""
+    a = TPUPPOActor(_actor_cfg(log_agg_mode=mode))
+    a.initialize(None, None, model_config=tiny_config(), seed=4)
+    data = _rollout_batch(seed=5)
+    data["prox_logp"] = a.compute_logp(data)
+    a.compute_advantages(data)
+    stats = a.ppo_update(dict(data))
+    assert np.isfinite(stats[0]["loss"])
+    # per-mb normalizer is now the sequence count, not token count
+    # (stats[0]["n_tokens"] is overwritten by the tracker's global token
+    # denominator, so check the second minibatch's raw train stats)
+    assert stats[1]["n_tokens"] <= data["input_ids"].shape[0]
+
+
+def test_log_agg_mode_unknown_raises():
+    a = TPUPPOActor(_actor_cfg(log_agg_mode="bogus"))
+    a.initialize(None, None, model_config=tiny_config(), seed=4)
+    data = _rollout_batch(seed=5)
+    data["prox_logp"] = a.compute_logp(data)
+    a.compute_advantages(data)
+    with pytest.raises(ValueError):
+        a.ppo_update(dict(data))

@@ -72,3 +72,32 @@ def test_sharded_forward_matches_single_device(cpu_devices):
     with jax.set_mesh(mesh):
         out = np.asarray(fwd(sharded_params, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_attn_spec_for_mesh_rules():
+    """Shared dispatch rule (train + inference engines both call this)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.ops.attention import AttnSpec
+
+    cfg = tiny_config(num_attention_heads=4, num_key_value_heads=2)
+    devs = np.asarray(jax.devices()[:8])
+
+    # tp=2 divides both head counts -> head-sharded, token ring over dp,cp
+    mesh = Mesh(devs.reshape(1, 2, 2, 2), ("pp", "dp", "cp", "tp"))
+    s = AttnSpec.for_mesh(mesh, cfg)
+    assert s.head_axis == "tp" and s.token_axes == ("dp", "cp")
+
+    # tp=4 does not divide kv heads -> forced einsum, heads replicated
+    mesh = Mesh(devs.reshape(1, 2, 1, 4), ("pp", "dp", "cp", "tp"))
+    s = AttnSpec.for_mesh(mesh, cfg)
+    assert s.head_axis is None and s.impl == "xla"
+    assert s.token_axes == ("dp", "cp")  # ring still on
+
+    # single-extent mesh -> plain local spec, no mesh reference
+    mesh = Mesh(devs[:1].reshape(1, 1, 1, 1), ("pp", "dp", "cp", "tp"))
+    s = AttnSpec.for_mesh(mesh, cfg)
+    assert s.mesh is None
