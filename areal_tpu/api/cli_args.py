@@ -241,7 +241,8 @@ class EngineBackendConfig:
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
     param_dtype: str = "bfloat16"
     compute_dtype: str = "bfloat16"
-    optimizer_dtype: str = "float32"
+    optimizer_dtype: str = "float32"  # adam mu AND nu storage dtype
+    grad_acc_dtype: str = "float32"  # microbatch gradient accumulator dtype
     fsdp: bool = True  # shard params/optimizer over the dp axis (ZeRO-3-like)
     donate_params: bool = True
     pad_mb_to_multiple: int = 128  # static-shape bucketing for XLA

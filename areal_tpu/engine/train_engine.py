@@ -89,8 +89,53 @@ def make_lr_schedule(cfg: OptimizerConfig, total_steps: int):
     )
 
 
-def make_optimizer(cfg: OptimizerConfig, total_steps: int) -> optax.GradientTransformation:
-    assert cfg.type in ("adamw", "sgd"), cfg.type
+def _scale_by_adam(b1: float, b2: float, eps: float, moment_dtype) -> optax.GradientTransformation:
+    """scale_by_adam with BOTH moments stored in ``moment_dtype`` (optax's
+    only exposes mu_dtype; nu silently inherits the param dtype). Moment
+    math runs in fp32; storage is cast."""
+
+    def init(params):
+        z = lambda p: jnp.zeros(p.shape, moment_dtype)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(z, params),
+            nu=jax.tree.map(z, params),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        mu = jax.tree.map(
+            lambda m, g: b1 * m.astype(jnp.float32)
+            + (1 - b1) * g.astype(jnp.float32),
+            state.mu,
+            updates,
+        )
+        nu = jax.tree.map(
+            lambda v, g: b2 * v.astype(jnp.float32)
+            + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+            state.nu,
+            updates,
+        )
+        bc1 = 1.0 - jnp.asarray(b1, jnp.float32) ** count
+        bc2 = 1.0 - jnp.asarray(b2, jnp.float32) ** count
+        out = jax.tree.map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu, nu
+        )
+        cast = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: x.astype(moment_dtype), t
+        )
+        return out, optax.ScaleByAdamState(
+            count=count, mu=cast(mu), nu=cast(nu)
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(
+    cfg: OptimizerConfig, total_steps: int, moment_dtype: str = "float32"
+) -> optax.GradientTransformation:
+    assert cfg.type in ("adamw", "sgd", "adafactor"), cfg.type
     schedule = make_lr_schedule(cfg, total_steps)
 
     def decay_mask(params):
@@ -103,10 +148,32 @@ def make_optimizer(cfg: OptimizerConfig, total_steps: int) -> optax.GradientTran
             optax.clip_by_global_norm(cfg.gradient_clipping),
             optax.sgd(schedule),
         )
+    if cfg.type == "adafactor":
+        # factored second moments: O(rows+cols) optimizer state instead of
+        # O(params) — the memory-lean choice for big models on small chips.
+        # No weight decay here: optax.adafactor applies weight_decay_rate
+        # AFTER lr scaling (a per-step shrink factor, not adamw-style
+        # lr-scaled decoupled decay), so cfg.weight_decay would be orders of
+        # magnitude too strong.
+        if cfg.weight_decay:
+            logger.warning(
+                "adafactor ignores weight_decay=%s (unsupported semantics)",
+                cfg.weight_decay,
+            )
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.gradient_clipping),
+            optax.adafactor(
+                learning_rate=schedule,
+                multiply_by_parameter_scale=False,
+                clipping_threshold=None,
+                weight_decay_rate=None,
+            ),
+        )
     return optax.chain(
         optax.clip_by_global_norm(cfg.gradient_clipping),
-        optax.scale_by_adam(
-            b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps, mu_dtype=jnp.float32
+        _scale_by_adam(
+            b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+            moment_dtype=_DTYPES[moment_dtype],
         ),
         optax.add_decayed_weights(cfg.weight_decay, mask=decay_mask),
         optax.scale_by_learning_rate(schedule),
@@ -195,7 +262,9 @@ class TPUTrainEngine(TrainEngine):
 
         if cfg.optimizer is not None:
             total = ft_spec.total_train_steps if ft_spec is not None else 1 << 20
-            self._tx = make_optimizer(cfg.optimizer, total)
+            self._tx = make_optimizer(
+                cfg.optimizer, total, moment_dtype=cfg.backend.optimizer_dtype
+            )
             self._lr_schedule = make_lr_schedule(cfg.optimizer, total)
             init_opt = jax.jit(self._tx.init)
             self.opt_state = init_opt(self.params)
@@ -251,6 +320,29 @@ class TPUTrainEngine(TrainEngine):
     def step_lr_scheduler(self):
         """No-op: the optax schedule advances with the optimizer step count
         (kept for API parity with the reference's explicit scheduler)."""
+
+    def _perf_stats(
+        self, input_: TensorDict, real_tokens: int, step_time: float
+    ) -> dict[str, float]:
+        """Analytic throughput/MFU per step (reference:
+        realhf/base/monitor.py:288-403 FLOPs counters)."""
+        from areal_tpu.utils import perf
+
+        if step_time <= 0 or real_tokens <= 0:
+            return {}
+        n_seqs = max(int(np.asarray(input_["attention_mask"]).shape[0]), 1)
+        avg_seqlen = real_tokens / n_seqs
+        fpt = perf.train_flops_per_token(self.model_config, avg_seqlen)
+        tps = real_tokens / step_time
+        n_chips = self.mesh.size if self.mesh is not None else 1
+        out = {
+            "tokens_per_sec": tps,
+            "tflops_per_chip": tps * fpt / n_chips / 1e12,
+        }
+        m = perf.mfu(tps, fpt, n_chips=n_chips)
+        if m is not None:
+            out["mfu"] = m
+        return out
 
     def current_lr(self) -> float:
         if self._lr_schedule is None:
@@ -335,10 +427,12 @@ class TPUTrainEngine(TrainEngine):
                 )
                 return loss_fn(logits, mb)
 
+            acc_dtype = _DTYPES[backend.grad_acc_dtype]
+
             def step(params, acc, mb):
                 loss, grads = jax.value_and_grad(compute)(params, mb)
                 acc = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads
+                    lambda a, g: a + g.astype(acc_dtype), acc, grads
                 )
                 return loss, acc
 
@@ -374,9 +468,10 @@ class TPUTrainEngine(TrainEngine):
         key = "zeros"
         if key not in self._jit_cache:
             shardings = self.param_shardings()
+            acc_dtype = _DTYPES[self.config.backend.grad_acc_dtype]
             self._jit_cache[key] = jax.jit(
                 lambda p: jax.tree.map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), p
+                    lambda x: jnp.zeros(x.shape, acc_dtype), p
                 ),
                 out_shardings=shardings,
             )
@@ -396,7 +491,8 @@ class TPUTrainEngine(TrainEngine):
         ``sum(loss_weight_fn(mb))`` (reference: fsdp_engine.py:536-560)."""
         assert self.initialized and self._tx is not None
         t0 = time.perf_counter()
-        mb_list, packed_mbs, _ = self._prepare_mbs(input_, group_size=group_size)
+        mb_list, packed_mbs, real_ns = self._prepare_mbs(input_, group_size=group_size)
+        real_tokens = int(sum(real_ns))
         weights = [float(loss_weight_fn(mb)) for mb in packed_mbs]
         total_weight = sum(weights)
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
@@ -416,6 +512,7 @@ class TPUTrainEngine(TrainEngine):
         if bool(ok):
             self._opt_steps += 1
         loss_sum = float(jnp.sum(jnp.stack([jnp.asarray(l) for l in losses])))
+        step_time = time.perf_counter() - t0
         stats = {
             "loss": loss_sum / total_weight,
             "grad_norm": float(gnorm),
@@ -423,8 +520,9 @@ class TPUTrainEngine(TrainEngine):
             "lr": self.current_lr(),
             "n_mbs": float(mb_list.n_mbs),
             "n_tokens": float(total_weight),
-            "step_time": time.perf_counter() - t0,
+            "step_time": step_time,
         }
+        stats.update(self._perf_stats(input_, real_tokens, step_time))
         if not bool(ok):
             logger.warning(
                 f"non-finite grad norm {float(gnorm)}; skipped optimizer step"
