@@ -442,6 +442,10 @@ class LauncherConfig:
     trainer_mem_per_chip: int = 32768
     inference_server_env_vars: dict[str, str] = field(default_factory=dict)
     trainer_env_vars: dict[str, str] = field(default_factory=dict)
+    # multi-host training (the torchrun replacement): spawn this many trainer
+    # processes wired together via jax.distributed (parallel/distributed.py);
+    # each process drives its local chips and the GSPMD mesh spans all of them
+    trainer_processes: int = 1
 
 
 @dataclass

@@ -64,6 +64,17 @@ class RemoteInfEngine(InferenceEngine):
     # ------------------------------------------------------------------
 
     def initialize(self, addr: str | list[str] | None = None, train_data_parallel_size: int | None = None):
+        from areal_tpu.parallel import distributed
+
+        if distributed.process_count() > 1:
+            # async rollout coordination across hosts (the DP-head
+            # redistribution role) is not wired yet; N hosts each running a
+            # rollout client would double-submit every prompt. Guarded HERE
+            # so every rollout entry point fails loudly, not just grpo.
+            raise NotImplementedError(
+                "multi-host rollout needs the cross-host coordinator; "
+                "run the rollout client on one process (or use the SFT path)"
+            )
         if addr:
             self.addresses = [addr] if isinstance(addr, str) else list(addr)
         elif os.environ.get("AREAL_LLM_SERVER_ADDRS"):

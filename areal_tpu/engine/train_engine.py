@@ -43,6 +43,7 @@ from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import TransformerConfig, from_hf_config
 from areal_tpu.models.lm import forward_packed, init_params
+from areal_tpu.parallel import distributed
 from areal_tpu.parallel.mesh import make_mesh, single_device_mesh
 from areal_tpu.parallel.sharding import FSDP_AXES, param_shardings
 from areal_tpu.utils import logging, stats_tracker
@@ -330,7 +331,10 @@ class TPUTrainEngine(TrainEngine):
 
         if step_time <= 0 or real_tokens <= 0:
             return {}
-        n_seqs = max(int(np.asarray(input_["attention_mask"]).shape[0]), 1)
+        real_tokens = distributed.sync_sum(real_tokens)
+        n_seqs = distributed.sync_sum(
+            max(int(np.asarray(input_["attention_mask"]).shape[0]), 1)
+        )
         avg_seqlen = real_tokens / n_seqs
         fpt = perf.train_flops_per_token(self.model_config, avg_seqlen)
         tps = real_tokens / step_time
@@ -353,9 +357,13 @@ class TPUTrainEngine(TrainEngine):
 
     def _mb_to_device(self, packed: TensorDict) -> dict[str, jnp.ndarray]:
         """Move one packed microbatch to the mesh. Token-dim arrays shard over
-        (dp, cp); everything else replicates. cu_seqlens stays host-side."""
+        (dp, cp); everything else replicates. cu_seqlens stays host-side.
+
+        Multi-host: this process's ``packed`` holds only its LOCAL token
+        stream; the global sharded array is assembled host-locally (each
+        host feeds its own device shards — no cross-host data movement,
+        the DistRolloutCoordinator redistribution made structural)."""
         n = int(packed["cu_seqlens"][-1])
-        seq_sharding = NamedSharding(self.mesh, P(FSDP_AXES))
         rep = NamedSharding(self.mesh, P())
         out = {}
         for k, v in packed.items():
@@ -367,11 +375,11 @@ class TPUTrainEngine(TrainEngine):
                     arr = arr.astype(np.float32)
                 if arr.dtype == np.int64:
                     arr = arr.astype(np.int32)
-                spec = [FSDP_AXES] + [None] * (arr.ndim - 1)
-                out[k] = jax.device_put(
-                    arr, NamedSharding(self.mesh, P(*spec))
-                )
+                spec = P(*([FSDP_AXES] + [None] * (arr.ndim - 1)))
+                out[k] = distributed.host_local_to_global(self.mesh, spec, arr)
             else:
+                # non-token arrays replicate; in multi-host mode every
+                # process must pass identical values here
                 out[k] = jax.device_put(
                     arr.astype(np.float32) if arr.dtype == np.float64 else arr, rep
                 )
@@ -406,7 +414,54 @@ class TPUTrainEngine(TrainEngine):
             packed["segment_ids"] = seg
             packed_mbs.append(packed)
             real_ns.append(real_n)
+        if distributed.process_count() > 1:
+            packed_mbs, real_ns = self._sync_mbs_across_hosts(packed_mbs, real_ns)
         return mb_list, packed_mbs, real_ns
+
+    def _sync_mbs_across_hosts(
+        self, packed_mbs: list[TensorDict], real_ns: list[int]
+    ):
+        """Multi-host agreement on microbatch count and bucket lengths.
+
+        Each host packed only its LOCAL sequences; jit shapes must line up
+        globally (the reference's allocate_balanced_mbs_synced role,
+        areal/utils/data.py:249). Hosts that run short fabricate a zero-loss
+        clone of their last microbatch (real_n = 0). Two collectives total:
+        one for the count, one vectorized over all bucket lengths."""
+        n_mbs = int(distributed.sync_max(len(packed_mbs)))
+        real_ns = list(real_ns)
+        while len(packed_mbs) < n_mbs:
+            dummy = dict(packed_mbs[-1])
+            dummy["loss_mask"] = np.zeros_like(np.asarray(dummy["loss_mask"]))
+            packed_mbs.append(dummy)
+            real_ns.append(0)
+        local_ts = [int(np.asarray(p["cu_seqlens"])[-1]) for p in packed_mbs]
+        targets = distributed.sync_max_vector(local_ts, n_mbs)
+        out = []
+        for packed, local_t, target in zip(packed_mbs, local_ts, targets):
+            target = int(target)
+            if local_t < target:
+                packed = dict(packed)
+                # re-pad to the agreed bucket, then rebuild positions/segments
+                for k in ("positions", "segment_ids"):
+                    packed.pop(k, None)
+                packed, _ = pad_packed_to_multiple(packed, target)
+                cu = packed["cu_seqlens"]
+                total = int(cu[-1])
+                packed["positions"] = positions_from_cu_seqlens(cu, total)
+                packed["segment_ids"] = segment_ids_from_cu_seqlens(cu, total)
+            # per-host segment-id namespace: host-local ids all start at 0,
+            # and the global packed stream concatenates hosts — without an
+            # offset, host B's sequence 0 would attend into host A's
+            # sequence 0 (they'd share a segment id)
+            seg = np.asarray(packed["segment_ids"])
+            offset = distributed.process_index() << 20
+            packed = dict(packed)
+            packed["segment_ids"] = np.where(seg >= 0, seg + offset, seg).astype(
+                np.int32
+            )
+            out.append(packed)
+        return out, real_ns
 
     # ------------------------------------------------------------ train step
 
@@ -494,7 +549,10 @@ class TPUTrainEngine(TrainEngine):
         mb_list, packed_mbs, real_ns = self._prepare_mbs(input_, group_size=group_size)
         real_tokens = int(sum(real_ns))
         weights = [float(loss_weight_fn(mb)) for mb in packed_mbs]
-        total_weight = sum(weights)
+        # multi-host: the normalizer is the GLOBAL loss weight (each host
+        # only sees its local sequences; reference fsdp_engine.py:536-560
+        # scales by dp_size for the same reason)
+        total_weight = distributed.sync_sum(sum(weights))
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
 
         grad_step = self._grad_fn(loss_fn)
@@ -627,11 +685,26 @@ class TPUTrainEngine(TrainEngine):
 
     def save(self, meta: SaveLoadMeta):
         if meta.weight_format == "hf":
-            hf_io.save_hf_params(self.params, self.model_config, meta.path)
+            multi = distributed.process_count() > 1
+            params = self.params
+            opt_leaves = None
+            if multi:
+                # every host participates in the gathers (collectives!);
+                # only host 0 writes files afterwards
+                params = distributed.gather_host_values(params)
+                if meta.with_optim:
+                    opt_leaves = distributed.gather_host_values(
+                        self._flat_opt_leaves()[0]
+                    )
+                if not distributed.is_main():
+                    return
+            hf_io.save_hf_params(params, self.model_config, meta.path)
             if meta.tokenizer is not None:
                 meta.tokenizer.save_pretrained(meta.path)
             if meta.with_optim:
-                self._save_optimizer(os.path.join(meta.path, "optim"))
+                self._save_optimizer(
+                    os.path.join(meta.path, "optim"), leaves=opt_leaves
+                )
         elif meta.weight_format == "orbax":
             self._save_orbax(meta.path, with_optim=meta.with_optim)
         else:
@@ -657,9 +730,10 @@ class TPUTrainEngine(TrainEngine):
         leaves, treedef = jax.tree.flatten(self.opt_state)
         return leaves, treedef
 
-    def _save_optimizer(self, path: str):
+    def _save_optimizer(self, path: str, leaves=None):
         os.makedirs(path, exist_ok=True)
-        leaves, _ = self._flat_opt_leaves()
+        if leaves is None:
+            leaves, _ = self._flat_opt_leaves()
         arrs = {
             f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)
         }

@@ -102,13 +102,30 @@ def _wait_server_addrs(cfg, n_servers: int) -> list[str]:
 
 
 def _spawn_trainer(cfg, entry: str, config_argv: list[str], addrs: list[str], run_id: int):
-    env = dict(os.environ)
-    env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
-    env[RECOVER_ENV] = "1" if run_id > 0 else "0"
-    env.update(cfg.launcher.trainer_env_vars)
+    """One trainer process — or N jax.distributed-wired processes when
+    launcher.trainer_processes > 1 (the torchrun replacement; each process
+    calls parallel/distributed.initialize from these env vars)."""
+    base_env = dict(os.environ)
+    base_env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
+    base_env[RECOVER_ENV] = "1" if run_id > 0 else "0"
+    base_env.update(cfg.launcher.trainer_env_vars)
     argv = [sys.executable, entry, *config_argv]
-    logger.info("spawning trainer: %s", " ".join(argv))
-    return subprocess.Popen(argv, env=env)
+    n = max(cfg.launcher.trainer_processes, 1)
+    if n == 1:
+        logger.info("spawning trainer: %s", " ".join(argv))
+        return [subprocess.Popen(argv, env=base_env)]
+    from areal_tpu.utils.network import find_free_ports
+
+    coordinator = f"127.0.0.1:{find_free_ports(1)[0]}"
+    procs = []
+    for pid in range(n):
+        env = dict(base_env)
+        env["AREAL_COORDINATOR_ADDR"] = coordinator
+        env["AREAL_NUM_PROCESSES"] = str(n)
+        env["AREAL_PROCESS_ID"] = str(pid)
+        logger.info("spawning trainer %d/%d: %s", pid, n, " ".join(argv))
+        procs.append(subprocess.Popen(argv, env=env))
+    return procs
 
 
 def _kill(procs):
@@ -139,12 +156,15 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
     try:
         addrs = _wait_server_addrs(cfg, len(servers))
         logger.info("servers up: %s", addrs)
-        trainer = _spawn_trainer(cfg, entry, config_argv, addrs, run_id)
-        procs.append(trainer)
+        trainers = _spawn_trainer(cfg, entry, config_argv, addrs, run_id)
+        procs.extend(trainers)
         while True:
-            rc = trainer.poll()
-            if rc is not None:
-                return rc
+            rcs = [t.poll() for t in trainers]
+            if all(rc is not None for rc in rcs):
+                return next((rc for rc in rcs if rc), 0)
+            if any(rc is not None and rc != 0 for rc in rcs):
+                logger.error("a trainer died with rc=%s; failing trial", rcs)
+                return next(rc for rc in rcs if rc)
             for s in servers:
                 if s.poll() is not None:
                     logger.error("server died with rc=%s; failing trial", s.poll())

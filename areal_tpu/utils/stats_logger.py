@@ -18,9 +18,16 @@ logger = logging.getLogger("StatsLogger")
 
 
 class StatsLogger:
-    def __init__(self, config: StatsLoggerConfig, ft_spec=None, rank: int = 0):
+    def __init__(
+        self, config: StatsLoggerConfig, ft_spec=None, rank: int | None = None
+    ):
         self.config = config
         self.ft_spec = ft_spec
+        if rank is None:
+            # multi-host: only the jax.distributed main process logs
+            from areal_tpu.parallel import distributed
+
+            rank = distributed.process_index()
         self.rank = rank
         self._jsonl = None
         self._tb = None

@@ -17,6 +17,12 @@ from areal_tpu.utils.device import apply_platform_env
 
 apply_platform_env()
 
+from areal_tpu.parallel import distributed  # noqa: E402
+
+# no-op single-process; multi-host rollout is rejected loudly inside
+# RemoteInfEngine.initialize until the cross-host coordinator lands
+distributed.initialize()
+
 import numpy as np  # noqa: E402
 
 from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402

@@ -11,6 +11,12 @@ from areal_tpu.utils.device import apply_platform_env
 
 apply_platform_env()
 
+from areal_tpu.parallel import distributed  # noqa: E402
+
+# no-op single-process; connects the jax.distributed mesh when the launcher
+# set AREAL_COORDINATOR_ADDR/AREAL_NUM_PROCESSES/AREAL_PROCESS_ID
+distributed.initialize()
+
 import numpy as np  # noqa: E402
 
 from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402
@@ -42,6 +48,7 @@ def main(argv=None):
         tokenizer=tokenizer,
         max_length=cfg.train_dataset.max_length,
     )
+    rows = distributed.shard_rows(rows)  # per-host DP shard (multi-host)
     dataloader = StatefulDataLoader(
         rows,
         cfg.train_dataset.batch_size,

@@ -1,0 +1,98 @@
+"""Per-process driver for the 2-process jax.distributed train test.
+
+Launched by tests/test_multihost.py as N separate processes, each with ONE
+virtual CPU device; together they form the global dp=N mesh. This is the
+JAX analogue of the reference's gloo-on-CPU multi-process tests
+(realhf/base/testing.py:48-137, tests/torchrun/).
+
+Usage: python multihost_driver.py <coordinator> <nprocs> <pid> <outdir>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    coordinator, nprocs, pid, outdir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coordinator, num_processes=nprocs, process_id=pid
+    )
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == nprocs
+
+    import numpy as np
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import OptimizerConfig, TrainEngineConfig
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+    from areal_tpu.models.config import tiny_config
+
+    cfg = TrainEngineConfig(
+        path="", init_from_scratch=True, optimizer=OptimizerConfig(lr=1e-3)
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 32
+    eng = TPULMEngine(cfg)
+    eng.create_process_group(ParallelStrategy(dp=nprocs))
+    eng.initialize(None, None, model_config=tiny_config(), seed=7)
+
+    # global batch: 4 sequences; this host takes rows [pid::nprocs]
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 128, size=(4, 16)).astype(np.int32)
+    attn = np.ones((4, 16), np.int32)
+    loss_mask = np.ones((4, 16), np.int32)
+    loss_mask[:, 0] = 0
+    rows = distributed.shard_rows(list(range(4)))
+    data = dict(
+        input_ids=input_ids[rows],
+        attention_mask=attn[rows],
+        loss_mask=loss_mask[rows],
+    )
+
+    losses = [eng.train_lm(data)["loss"] for _ in range(3)]
+
+    # multi-host checkpoint: all hosts join the gather, host 0 writes
+    from areal_tpu.api.io_struct import SaveLoadMeta
+
+    eng.save(
+        SaveLoadMeta(
+            path=os.path.join(outdir, "ckpt"), weight_format="hf", with_optim=True
+        )
+    )
+
+    if distributed.is_main():
+        from jax.experimental import multihost_utils
+
+        embed = multihost_utils.process_allgather(
+            eng.params["embed"], tiled=True
+        )
+        np.save(os.path.join(outdir, "embed.npy"), np.asarray(embed))
+        with open(os.path.join(outdir, "result.json"), "w") as f:
+            json.dump({"losses": [float(x) for x in losses]}, f)
+    else:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.process_allgather(eng.params["embed"], tiled=True)
+    print(f"proc {pid} done losses={losses}")
+
+
+if __name__ == "__main__":
+    main()
