@@ -498,6 +498,9 @@ class RWConfig(BaseExperimentConfig):
 @dataclass
 class GRPOConfig(BaseExperimentConfig):
     async_training: bool = True
+    # trainer -> inference weight transfer: "disk" (safetensors + mmap load),
+    # "http" (no-disk streamed tensors, io_struct.WeightUpdateMeta.from_http)
+    weight_update: str = "disk"
     gconfig: GenerationHyperparameters = field(
         default_factory=GenerationHyperparameters
     )

@@ -92,9 +92,13 @@ class WeightUpdateMeta:
     type="disk": trainer writes safetensors to ``path``; servers mmap+load.
     type="device": trainer transfers live jax arrays (colocated engines or
     cross-slice transfer); ``chunked_mem_mb`` bounds staging-buffer size.
+    type="http": trainer streams safetensors-serialized chunks straight to
+    each server's /update_weights_from_tensor endpoint — the disaggregated
+    no-disk path (reference NCCL broadcast, fsdp_engine.py:359-401, without
+    the cross-job process group); ``chunked_mem_mb`` bounds chunk size.
     """
 
-    type: str = "disk"  # "disk" | "device"
+    type: str = "disk"  # "disk" | "device" | "http"
     path: str | None = None
     chunked_mem_mb: int = 1024
 
@@ -108,6 +112,10 @@ class WeightUpdateMeta:
     @classmethod
     def from_device(cls, chunked_mem_mb: int = 1024) -> "WeightUpdateMeta":
         return cls(type="device", chunked_mem_mb=chunked_mem_mb)
+
+    @classmethod
+    def from_http(cls, chunked_mem_mb: int = 512) -> "WeightUpdateMeta":
+        return cls(type="http", chunked_mem_mb=chunked_mem_mb)
 
 
 @dataclass

@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable
 
 import aiohttp
+import numpy as np
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
@@ -282,6 +283,73 @@ class RemoteInfEngine(InferenceEngine):
             (load_ts - save_ts) / 1e9,
         )
         self.set_version(next_version)
+
+    def update_weights_from_tensors(self, chunks, next_version: int) -> float:
+        """Disaggregated no-disk weight transfer: stream safetensors-encoded
+        chunks to every server's /update_weights_from_tensor endpoint
+        (reference NCCL broadcast path, fsdp_engine.py:359-401, replaced by
+        HTTP into host RAM + device_put on the server side).
+
+        ``chunks``: iterable of dict[param_path -> np.ndarray] in the
+        engines' native (stacked-layer) pytree naming. Chunks are sent in
+        order; the last one carries final=1 so servers bump their version
+        atomically after the whole set landed. Returns the wall latency and
+        records it under stats_tracker timeperf/update_weights_http."""
+        from safetensors.numpy import save as st_save
+
+        from areal_tpu.utils import stats_tracker
+
+        t0 = time.monotonic()
+        n_chunks = 0
+
+        async def _push_all():
+            nonlocal n_chunks
+            session = aiohttp.ClientSession()
+            try:
+                it = iter(chunks)
+                try:
+                    cur = next(it)
+                except StopIteration:
+                    raise AssertionError("no weight chunks to send") from None
+                # one-chunk lookahead keeps the staging RAM bound the
+                # chunked_mem_mb contract promises while still knowing
+                # which chunk is final
+                while cur is not None:
+                    nxt = next(it, None)
+                    final = nxt is None
+                    blob = st_save(
+                        {k: np.ascontiguousarray(v) for k, v in cur.items()}
+                    )
+                    n_chunks += 1
+                    await asyncio.gather(
+                        *[
+                            arequest_with_retry(
+                                session,
+                                f"http://{a}/update_weights_from_tensor"
+                                f"?version={next_version}&final={int(final)}",
+                                data=blob,
+                                max_retries=self.config.request_retries,
+                                timeout=self.config.request_timeout,
+                            )
+                            for a in self.addresses
+                        ]
+                    )
+                    cur = nxt
+            finally:
+                await session.close()
+
+        asyncio.run(_push_all())
+        latency = time.monotonic() - t0
+        stats_tracker.DEFAULT_TRACKER.scalar(update_weights_http_latency=latency)
+        logger.info(
+            "tensor weight update v%d (%d chunks) -> %d servers in %.2fs",
+            next_version,
+            n_chunks,
+            len(self.addresses),
+            latency,
+        )
+        self.set_version(next_version)
+        return latency
 
     def pause(self):
         """Pause servers + the local rollout runtime (weight-update fence)."""

@@ -789,10 +789,38 @@ class TPUTrainEngine(TrainEngine):
         if meta.type == "disk":
             assert meta.path is not None
             hf_io.save_hf_params(self.params, self.model_config, meta.path)
-        elif meta.type == "device":
-            pass  # live handle: colocated engines read self.params directly
+        elif meta.type in ("device", "http"):
+            pass  # live handle / streamed by update_weights
         else:
             raise ValueError(f"unknown weight update type {meta.type}")
+
+    def _weight_chunks(self, chunk_mb: int):
+        """Yield dotted-path-named host-array chunks of <= chunk_mb MB each
+        (oversized single leaves go alone). The staging buffer holds one
+        chunk at a time, bounding host RAM like the reference's
+        weight_chunked_mem_mb bucketing (fsdp_engine.py:359-401)."""
+        budget = chunk_mb * 1_000_000
+        cur: dict[str, np.ndarray] = {}
+        size = 0
+
+        def walk(node, prefix):
+            for k in sorted(node.keys()):
+                v = node[k]
+                path = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, dict):
+                    yield from walk(v, path)
+                else:
+                    yield path, v
+
+        for path, leaf in walk(self.params, ""):
+            arr = np.asarray(jax.device_get(leaf))
+            if cur and size + arr.nbytes > budget:
+                yield cur
+                cur, size = {}, 0
+            cur[path] = arr
+            size += arr.nbytes
+        if cur:
+            yield cur
 
     def update_weights(self, meta: WeightUpdateMeta | None = None):
         """Push current weights to the paired rollout engine and bump
@@ -810,6 +838,14 @@ class TPUTrainEngine(TrainEngine):
                 target, "update_weights_from_arrays"
             ), "device weight updates need a colocated engine (LocalInfEngine)"
             target.update_weights_from_arrays(self.params, next_version)
+        elif meta.type == "http":
+            target = self._rollout_engine
+            assert target is not None and hasattr(
+                target, "update_weights_from_tensors"
+            ), "http weight updates need a RemoteInfEngine"
+            target.update_weights_from_tensors(
+                self._weight_chunks(meta.chunked_mem_mb), next_version
+            )
         else:
             self.upload_weights(meta)
             if self._rollout_engine is not None:

@@ -162,6 +162,7 @@ class GenerationEngine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._abort_rids: set[str] = set()
+        self._staging_params = None  # in-flight chunked tensor update
         self._lock = threading.Lock()
         self._dead: Exception | None = None
 
@@ -384,6 +385,19 @@ class GenerationEngine:
         if err is not None:
             raise err
 
+    def update_weights_from_named_arrays(
+        self, named: dict, version: int | None = None
+    ):
+        """Apply one chunk of dotted-path-named host arrays (the
+        /update_weights_from_tensor payload) into the live sharded params.
+        ``version=None`` = partial chunk (more coming, don't bump)."""
+        done: queue.Queue = queue.Queue()
+        self._cmd_queue.put(("update_named", named, version, done))
+        self._wake.set()
+        err = done.get(timeout=600.0)
+        if err is not None:
+            raise err
+
     def update_weights_from_arrays(self, params, version: int | None = None):
         """Colocated device-to-device weight refresh: re-place live jax
         arrays (e.g. the train engine's params) onto this engine's shardings
@@ -442,6 +456,50 @@ class GenerationEngine:
             if cmd[0] == "pause_ack":
                 self._abort_all("abort")
                 cmd[1].set()
+            elif cmd[0] == "update_named":
+                _, named, version, done = cmd
+                try:
+                    t0 = time.monotonic()
+                    # stage into a deep-copied TREE (leaves are shared jax
+                    # arrays until replaced) and swap atomically on the final
+                    # chunk — decode between chunks must never see layer i at
+                    # v(n+1) while layer j is still v(n), and a mid-chunk
+                    # error must leave the live params untouched
+                    if self._staging_params is None:
+                        self._staging_params = jax.tree.map(
+                            lambda x: x, self.params
+                        )
+                    for name, arr in named.items():
+                        node = self._staging_params
+                        parts = name.split(".")
+                        for p in parts[:-1]:
+                            node = node[p]
+                        leaf = node[parts[-1]]
+                        if arr.shape != leaf.shape:
+                            raise ValueError(
+                                f"shape mismatch for {name}: "
+                                f"{arr.shape} vs {leaf.shape}"
+                            )
+                        node[parts[-1]] = jax.device_put(
+                            arr.astype(leaf.dtype), leaf.sharding
+                        )
+                    if version is not None:
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(self._staging_params)[0]
+                        )
+                        self.params = self._staging_params
+                        self._staging_params = None
+                        self.version = version
+                        logger.info(
+                            "weights updated (tensor) -> v%d (+%.2fs final chunk)",
+                            self.version,
+                            time.monotonic() - t0,
+                        )
+                    done.put(None)
+                except Exception as e:
+                    logger.exception("named weight update failed")
+                    self._staging_params = None  # abandon the partial set
+                    done.put(e)
             elif cmd[0] in ("update_weights", "update_weights_arrays"):
                 _, src, version, done = cmd
                 try:

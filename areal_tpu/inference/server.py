@@ -78,6 +78,7 @@ class GenerationServer:
                 web.post("/pause_generation", self.pause),
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
+                web.post("/update_weights_from_tensor", self.update_weights_from_tensor),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -138,6 +139,31 @@ class GenerationServer:
     async def resume(self, request: web.Request) -> web.Response:
         self.engine.resume()
         return web.json_response({"success": True})
+
+    async def update_weights_from_tensor(self, request: web.Request) -> web.Response:
+        """No-disk weight update: body is one safetensors-encoded chunk of
+        native-pytree-named arrays; final=1 commits the new version."""
+        from safetensors.numpy import load as st_load
+
+        body = await request.read()
+        version = request.query.get("version")
+        final = request.query.get("final", "1") == "1"
+        try:
+            arrs = st_load(body)
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                self.engine.update_weights_from_named_arrays,
+                arrs,
+                int(version) if (final and version is not None) else None,
+            )
+        except Exception as e:
+            logger.exception("update_weights_from_tensor failed")
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.engine.get_version()}
+        )
 
     async def update_weights_from_disk(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -21,6 +21,7 @@ async def arequest_with_retry(
     url: str,
     method: str = "POST",
     payload: dict | None = None,
+    data: bytes | None = None,
     max_retries: int = 3,
     timeout: float = 3600.0,
     retry_delay: float = 1.0,
@@ -34,6 +35,7 @@ async def arequest_with_retry(
                 method,
                 url,
                 json=payload,
+                data=data,
                 timeout=aiohttp.ClientTimeout(total=timeout),
             ) as resp:
                 if resp.status == 200:

@@ -85,9 +85,16 @@ def main(argv=None):
     actor.create_process_group(alloc.train)
     actor.initialize(None, ft_spec)
 
-    weight_meta = WeightUpdateMeta.from_disk(
-        cfg.experiment_name, cfg.trial_name, cfg.cluster.fileroot
-    )
+    if cfg.weight_update == "http":
+        weight_meta = WeightUpdateMeta.from_http()
+    elif cfg.weight_update == "disk":
+        weight_meta = WeightUpdateMeta.from_disk(
+            cfg.experiment_name, cfg.trial_name, cfg.cluster.fileroot
+        )
+    else:
+        raise ValueError(
+            f"weight_update must be 'disk' or 'http', got {cfg.weight_update!r}"
+        )
     actor.connect_engine(rollout, weight_meta)
 
     ref: PPOActor | None = None

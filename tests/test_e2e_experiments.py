@@ -91,6 +91,7 @@ actor:
     param_dtype: float32
     pad_mb_to_multiple: 64
 async_training: true
+weight_update: http
 saver:
   freq_epochs: null
 stats_logger:
