@@ -239,7 +239,8 @@ class GenerationEngine:
         def step(carry, step_rng):
             tokens, cache, clen = carry
             logits, cache = decode_step(
-                params, self.model_config, cache, tokens[:, None], clen
+                params, self.model_config, cache, tokens[:, None], clen,
+                attn_spec=self.attn_spec,
             )
             nxt, logp = sample_tokens(
                 logits[:, 0],

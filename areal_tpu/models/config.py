@@ -34,7 +34,9 @@ class TransformerConfig:
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
-    moe_impl: str = "ragged"  # "ragged" (grouped GEMM) | "dense" (all-expert)
+    moe_impl: str = "ragged"  # "ragged" (grouped GEMM, dropless) | "dense"
+    # | "gshard_ep" (expert-parallel token dispatch, ops/moe.moe_mlp_gshard)
+    moe_capacity_factor: float = 2.0  # gshard_ep per-expert buffer headroom
     # output head
     is_critic: bool = False  # scalar value head instead of LM head
     arch: str = "qwen2"

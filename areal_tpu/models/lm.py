@@ -115,13 +115,23 @@ def _qkv(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jnp.ndarray,
+    attn_spec: AttnSpec | None = None,
+) -> jnp.ndarray:
     if cfg.is_moe:
-        return _moe_mlp(cfg, lp, x)
+        return _moe_mlp(cfg, lp, x, attn_spec)
     return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
 
 
-def _moe_mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_mlp(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jnp.ndarray,
+    attn_spec: AttnSpec | None = None,
+) -> jnp.ndarray:
     """Top-k token-choice MoE (reference: realhf/impl/model/modules/moe/).
 
     Default "ragged" = grouped-GEMM over expert-sorted tokens
@@ -140,6 +150,29 @@ def _moe_mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
             lp["wd"],
             cfg.num_experts_per_tok,
             cfg.norm_topk_prob,
+        )
+    if cfg.moe_impl == "gshard_ep":
+        from areal_tpu.ops.moe import moe_mlp_gshard
+
+        mesh = attn_spec.mesh if attn_spec is not None else None
+        token_axes = (
+            attn_spec.token_axes if attn_spec is not None else ("dp", "cp")
+        )
+        return moe_mlp_gshard(
+            x,
+            lp["router"],
+            lp["wg"],
+            lp["wu"],
+            lp["wd"],
+            cfg.num_experts_per_tok,
+            cfg.norm_topk_prob,
+            capacity_factor=cfg.moe_capacity_factor,
+            mesh=mesh,
+            ep_axes=token_axes or ("dp", "cp"),
+        )
+    if cfg.moe_impl != "dense":
+        raise ValueError(
+            f"unknown moe_impl {cfg.moe_impl!r}; use ragged | gshard_ep | dense"
         )
     t, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
@@ -175,7 +208,7 @@ def _block(
     attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
     x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
-    x = x + _mlp(cfg, lp, h)
+    x = x + _mlp(cfg, lp, h, attn_spec)
     return x
 
 
@@ -258,7 +291,7 @@ def prefill(
         attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
         out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(out, lp["ln2"], cfg.rms_norm_eps)
-        out = out + _mlp(cfg, lp, h2)
+        out = out + _mlp(cfg, lp, h2, attn_spec)
         return out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
@@ -277,6 +310,7 @@ def decode_step(
     cache: Params,
     input_ids: jnp.ndarray,  # [B, Tq]
     cache_len: jnp.ndarray,  # [B] valid tokens per slot BEFORE this call
+    attn_spec: AttnSpec | None = None,
 ) -> tuple[jnp.ndarray, Params]:
     """Run Tq tokens per slot against the cache.
 
@@ -308,7 +342,9 @@ def decode_step(
         h_out = h_in + attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(h_out, lp["ln2"], cfg.rms_norm_eps)
         mlp_in_shape = h2.shape
-        mlp_out = _mlp(cfg, lp, h2.reshape(-1, cfg.hidden_size)).reshape(mlp_in_shape)
+        mlp_out = _mlp(
+            cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
+        ).reshape(mlp_in_shape)
         h_out = h_out + mlp_out
         return (h_out,), (k_cache, v_cache)
 

@@ -57,3 +57,92 @@ def moe_mlp_ragged(
     w = topk_probs.reshape(-1)[order].astype(y.dtype)  # routing weights, sorted
     out = jnp.zeros((t, h), y.dtype).at[tok_idx].add(y * w[:, None])
     return out.astype(x.dtype)
+
+
+def moe_mlp_gshard(
+    x: jnp.ndarray,  # [T, H]
+    router_w: jnp.ndarray,  # [H, E]
+    wg: jnp.ndarray,  # [E, H, I]
+    wu: jnp.ndarray,  # [E, H, I]
+    wd: jnp.ndarray,  # [E, I, H]
+    num_experts_per_tok: int,
+    norm_topk_prob: bool = True,
+    capacity_factor: float = 2.0,
+    mesh=None,
+    ep_axes: tuple[str, ...] = ("dp", "cp"),
+) -> jnp.ndarray:
+    """Expert-parallel MoE with explicit token dispatch (GShard formulation).
+
+    The reference implements EP as Megatron token all-to-all over an ep
+    process group (areal/utils/fsdp/parallel.py:158-169 folds dp into ep;
+    megatron_engine.py:451-535). The TPU-native equivalent is the classic
+    Mesh-TensorFlow/GShard dispatch: tokens are grouped along the
+    token-sharded axes, routed into a fixed-capacity per-expert buffer
+    [G, E, C, H] via a one-hot dispatch einsum, and a
+    ``with_sharding_constraint`` flips the buffer from token-sharded (G) to
+    expert-sharded (E over the folded (dp, cp) axes) — XLA emits exactly the
+    all-to-all Megatron hand-codes. Expert FFNs then run where the expert
+    weights live, and the combine einsum rides the reverse all-to-all.
+
+    Capacity-based: each expert accepts at most C = capacity_factor*S*k/E
+    tokens per group (static shapes for the MXU); overflow assignments are
+    dropped, standard GShard/Switch semantics. Use the dropless "ragged"
+    impl when EP is off.
+    """
+    t, h = x.shape
+    e = router_w.shape[-1]
+    k = num_experts_per_tok
+
+    g = 1
+    if mesh is not None:
+        for a in ep_axes:
+            g *= mesh.shape.get(a, 1)
+    assert t % g == 0, (t, g)
+    s = t // g
+    cap = int(capacity_factor * s * k / e) + 1
+    cap = max(8, -(-cap // 8) * 8)  # multiple of 8 for TPU tiling
+    cap = min(cap, s * k)
+
+    xg = x.reshape(g, s, h)
+    router_logits = (xg @ router_w).astype(jnp.float32)  # [G, S, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, S, k]
+    if norm_topk_prob:
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    # capacity positions in SLOT-MAJOR order: every token's first choice
+    # claims capacity before any token's spill (k-th) choice does
+    oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [G, S, k, E]
+    ohm = oh.transpose(0, 2, 1, 3).reshape(g, k * s, e)  # slot-major flat
+    pos_m = (jnp.cumsum(ohm, axis=1) - 1) * ohm  # [G, k*S, E]
+    pos = (
+        jnp.sum(pos_m, axis=-1).reshape(g, k, s).transpose(0, 2, 1)
+    )  # [G, S, k] position within the routed expert
+    keep = pos < cap
+    gates = jnp.where(keep, topk_probs, 0.0).astype(x.dtype)  # [G, S, k]
+
+    # k experts of one token are distinct, so contracting k in the einsum is
+    # lossless and keeps the dispatch mask at the canonical [G, S, E, C]
+    ohc = jax.nn.one_hot(jnp.where(keep, pos, cap), cap, dtype=x.dtype)
+    disp = jnp.einsum("gske,gskc->gsec", oh.astype(x.dtype), ohc)
+    comb = jnp.einsum(
+        "gske,gskc,gsk->gsec", oh.astype(x.dtype), ohc, gates
+    )
+
+    buf = jnp.einsum("gsec,gsh->gech", disp, xg)  # [G, E, C, H]
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # token-sharded -> expert-sharded: THE all-to-all
+        buf = jax.lax.with_sharding_constraint(
+            buf, NamedSharding(mesh, P(None, ep_axes, None, None))
+        )
+    hg = jax.nn.silu(jnp.einsum("gech,ehi->geci", buf, wg))
+    hu = jnp.einsum("gech,ehi->geci", buf, wu)
+    y = jnp.einsum("geci,eih->gech", hg * hu, wd)  # [G, E, C, H]
+    if mesh is not None:
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, ep_axes, None, None))
+        )
+    out = jnp.einsum("gsec,gech->gsh", comb, y)
+    return out.reshape(t, h).astype(x.dtype)
