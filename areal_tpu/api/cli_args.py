@@ -341,6 +341,13 @@ class JaxGenConfig:
     tp_size: int = 1
     random_seed: int = 1
     skip_tokenizer_init: bool = False
+    # keep aborted requests' KV in their slots, keyed by rid; the client's
+    # abort-resume loop then continues decoding with ZERO re-prefill. The
+    # retained attention state may predate a weight update (accepted
+    # staleness: per-token versions still record the sampling policy and
+    # decoupled PPO recomputes logprobs on the trainer); set False for
+    # strict re-prefill-under-new-weights semantics.
+    retain_kv_on_abort: bool = True
 
 
 @dataclass

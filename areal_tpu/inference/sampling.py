@@ -16,29 +16,29 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _apply_top_k(scaled: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
-    """Mask logits outside the per-row top-k. top_k [B] int32, 0 = disabled."""
-    v = scaled.shape[-1]
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
-    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
-    thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B,1]
-    return jnp.where(scaled >= thresh, scaled, _NEG_INF)
+def _apply_top_k_top_p(
+    scaled: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    """Fused top-k + nucleus filtering sharing ONE descending sort.
 
-
-def _apply_top_p(scaled: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
-    """Nucleus filtering. top_p [B] float32, 1.0 = disabled.
-
-    Keeps the smallest prefix of probability-sorted tokens whose cumulative
-    mass reaches top_p (the highest-probability token always survives).
-    """
+    Matches sequential top-k-then-top-p semantics (the SGLang convention the
+    reference relies on): the nucleus mass is computed on the top-k-filtered,
+    RENORMALIZED distribution. The highest-probability token always survives
+    (exclusive-cumulative test)."""
+    b, v = scaled.shape
     sort_idx = jnp.argsort(-scaled, axis=-1)
     sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    pos = jnp.arange(v)[None, :]
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
+    keep_k = pos < k[:, None]
+    probs = jax.nn.softmax(
+        jnp.where(keep_k, sorted_logits, _NEG_INF), axis=-1
+    )  # renormalized over the surviving top-k
     cum = jnp.cumsum(probs, axis=-1)
-    # token i is kept if the cumulative mass *before* it is < top_p
-    keep_sorted = (cum - probs) < top_p[:, None]
+    keep_p = (cum - probs) < top_p[:, None]
+    keep_sorted = keep_k & keep_p
     keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(scaled.shape[0])[:, None], sort_idx
+        jnp.arange(b)[:, None], sort_idx
     ].set(keep_sorted)
     return jnp.where(keep, scaled, _NEG_INF)
 
@@ -50,15 +50,21 @@ def sample_tokens(
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B] fp32 (1.0 = off)
     greedy: jnp.ndarray,  # [B] bool
-    use_top_k: bool = True,  # static: compile out the sort when unused
-    use_top_p: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (tokens [B] int32, logprobs [B] fp32)."""
+    """Returns (tokens [B] int32, logprobs [B] fp32).
+
+    The filter knobs are fully DYNAMIC: one compiled program regardless of
+    the batch's top-k/top-p mixture (the round-1 engine flipped static args
+    per batch, recompiling on mixture changes). A runtime ``lax.cond`` skips
+    the vocab sort entirely when every row has both filters disabled."""
     scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
-    if use_top_k:
-        scaled = _apply_top_k(scaled, top_k)
-    if use_top_p:
-        scaled = _apply_top_p(scaled, top_p)
+    need = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
+    scaled = jax.lax.cond(
+        need,
+        lambda s: _apply_top_k_top_p(s, top_k, top_p),
+        lambda s: s,
+        scaled,
+    )
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     argmax = jnp.argmax(scaled, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)

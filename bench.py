@@ -160,6 +160,19 @@ def decode_bench(layers: int = 28, n_requests: int = 32, prompt_len: int = 128,
         gconfig = GenerationHyperparameters(
             max_new_tokens=new_tokens, min_new_tokens=new_tokens, temperature=1.0
         )
+
+        # warmup: compile prefill buckets + decode before the timed window
+        warm = threading.Event()
+        eng.submit(
+            "warm",
+            rng.integers(1, 150000, size=prompt_len).tolist(),
+            GenerationHyperparameters(
+                max_new_tokens=16, min_new_tokens=16, temperature=1.0
+            ),
+            lambda r: warm.set(),
+        )
+        assert warm.wait(600), "decode warmup timed out"
+
         t0 = time.perf_counter()
         for i in range(n_requests):
             prompt = rng.integers(1, 150000, size=prompt_len).tolist()
