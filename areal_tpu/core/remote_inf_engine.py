@@ -39,6 +39,14 @@ from areal_tpu.utils.http import arequest_with_retry
 
 logger = logging.getLogger("RemoteInfEngine")
 
+
+def _encode_images_for_transport(images):
+    if not images:
+        return None
+    from areal_tpu.utils.image import encode_image
+
+    return [x if isinstance(x, str) else encode_image(x) for x in images]
+
 RID_CACHE_SIZE = 128
 
 
@@ -158,6 +166,7 @@ class RemoteInfEngine(InferenceEngine):
             payload = {
                 "rid": req.rid,
                 "input_ids": prompt + accumulated,
+                "image_data": _encode_images_for_transport(req.image_data),
                 "sampling_params": {
                     "max_new_tokens": max_new - len(accumulated),
                     "min_new_tokens": max(

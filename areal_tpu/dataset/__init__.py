@@ -112,6 +112,25 @@ def process_pairs_rw_dataset(
     return out
 
 
+def process_clevr_count_dataset(rows: list[dict], **_kw) -> list[dict]:
+    """clevr_count_70k-style VLM rows (reference areal/dataset clevr entry):
+    {"images": [b64...], "question": str, "answer": int} -> RL rows carrying
+    the base64 images for VisionRLVRWorkflow."""
+    out = []
+    for r in rows:
+        q = r.get("question") or r.get("prompt")
+        if q is None or not r.get("images"):
+            continue
+        out.append(
+            {
+                "messages": [{"role": "user", "content": q}],
+                "images": list(r["images"]),
+                "answer": str(r.get("answer", "")),
+            }
+        )
+    return out
+
+
 _PROCESSORS: dict[tuple[str, str], Callable] = {}
 
 
@@ -166,6 +185,8 @@ def get_custom_dataset(
         if tokenizer is None:
             raise ValueError("rw datasets need a tokenizer")
         rows = process_pairs_rw_dataset(rows, tokenizer, max_length)
+    elif type == "vlm_rl":
+        rows = process_clevr_count_dataset(rows)
     else:
         raise ValueError(f"unknown dataset type {type!r}")
 

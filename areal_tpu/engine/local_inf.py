@@ -58,7 +58,10 @@ class LocalInfEngine(InferenceEngine):
                 lambda: fut.set_result(resp) if not fut.done() else None
             )
 
-        self.engine.submit(req.rid, list(req.input_ids), req.gconfig, on_done)
+        self.engine.submit(
+            req.rid, list(req.input_ids), req.gconfig, on_done,
+            image_data=req.image_data,
+        )
         resp = await fut
         # colocated pause aborts like the remote path; splice by re-issuing
         if resp.stop_reason == "abort" and len(resp.output_tokens) < req.gconfig.max_new_tokens:
@@ -73,6 +76,7 @@ class LocalInfEngine(InferenceEngine):
                         - len(resp.output_tokens)
                     ),
                     tokenizer=req.tokenizer,
+                    image_data=req.image_data,
                 )
             )
             return ModelResponse(

@@ -59,6 +59,15 @@ from areal_tpu.utils.data import (
 
 logger = logging.getLogger("TPUTrainEngine")
 
+
+def _flat_pixels(mb):
+    """[rows, N_img, S, S, 3] -> [rows*N_img, S, S, 3] in stream order (rows
+    are packed in order, so images line up with their placeholders)."""
+    pv = mb.get("pixel_values")
+    if pv is None:
+        return None
+    return pv.reshape((-1,) + tuple(pv.shape[-3:]))
+
 _DTYPES = {
     "bfloat16": jnp.bfloat16,
     "float32": jnp.float32,
@@ -479,6 +488,7 @@ class TPUTrainEngine(TrainEngine):
                     mb["segment_ids"],
                     remat=backend.remat,
                     attn_spec=self.attn_spec,
+                    pixel_values=_flat_pixels(mb),
                 )
                 return loss_fn(logits, mb)
 
@@ -604,6 +614,7 @@ class TPUTrainEngine(TrainEngine):
                     params, cfg, mb["input_ids"], mb["positions"],
                     mb["segment_ids"], remat=False,
                     attn_spec=self.attn_spec,
+                    pixel_values=_flat_pixels(mb),
                 )
                 return loss_fn(logits, mb)
 
@@ -642,6 +653,7 @@ class TPUTrainEngine(TrainEngine):
                     params, cfg, mb["input_ids"], mb["positions"],
                     mb["segment_ids"], remat=False,
                     attn_spec=self.attn_spec,
+                    pixel_values=_flat_pixels(mb),
                 )
                 return post_hook(logits, mb) if post_hook is not None else logits
 

@@ -69,6 +69,7 @@ class _Seq:
     t_last_token: float | None = None
     itl: list[float] = dataclasses.field(default_factory=list)
     aborted: bool = False
+    images: list | None = None  # decoded [S, S, 3] float arrays
 
     @property
     def max_total(self) -> int:
@@ -177,6 +178,8 @@ class GenerationEngine:
         self._lock = threading.Lock()
         self._dead: Exception | None = None
 
+        # one body; pixels=None (text) vs array (VLM) retraces by pytree
+        # structure, so both paths share the cache-write/sampling code
         self._jit_prefill = jax.jit(
             functools.partial(self._prefill_impl),
             donate_argnums=(1,),
@@ -203,9 +206,11 @@ class GenerationEngine:
         top_k,
         top_p,
         greedy,
+        pixels=None,  # [N, S, S, 3] for VLM prompts
     ):
         logits, ks, vs = prefill(
-            params, self.model_config, ids, length, attn_spec=self.attn_spec
+            params, self.model_config, ids, length, attn_spec=self.attn_spec,
+            pixel_values=pixels,
         )
         tok, logp = sample_tokens(
             logits[None], rng, temp[None], top_k[None], top_p[None], greedy[None]
@@ -323,6 +328,7 @@ class GenerationEngine:
         input_ids: list[int],
         gconfig: GenerationHyperparameters,
         on_done: Callable[[ModelResponse], None],
+        image_data: list | None = None,
     ):
         """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
         thread when it finishes (stop/length/abort)."""
@@ -334,8 +340,29 @@ class GenerationEngine:
             )
             on_done(resp)
             return
+        images = None
+        if image_data:
+            from areal_tpu.utils.image import decode_image
+
+            images = [
+                decode_image(x) if isinstance(x, str) else np.asarray(x)
+                for x in image_data
+            ]
+            expected = len(images) * self.model_config.vision_patches
+            got = sum(
+                1 for t in input_ids if t == self.model_config.image_token_id
+            )
+            if not self.model_config.is_vlm:
+                raise ValueError("model has no vision encoder but got images")
+            if got != expected:
+                raise ValueError(
+                    f"prompt carries {got} image placeholder tokens but "
+                    f"{len(images)} images x {self.model_config.vision_patches} "
+                    f"patches = {expected} are required"
+                )
         seq = _Seq(
-            rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done
+            rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done,
+            images=images,
         )
         self._input_queue.put(seq)
         self._wake.set()
@@ -629,7 +656,7 @@ class GenerationEngine:
         ids = np.zeros(tp, np.int32)
         ids[:n] = seq.prompt
         g = seq.gconfig
-        tok, logp, self.cache = self._jit_prefill(
+        args = (
             self.params,
             self.cache,
             jnp.asarray(ids),
@@ -641,6 +668,11 @@ class GenerationEngine:
             jnp.float32(g.top_p),
             jnp.asarray(g.greedy),
         )
+        if seq.images:
+            pixels = jnp.asarray(np.stack(seq.images), jnp.float32)
+            tok, logp, self.cache = self._jit_prefill(*args, pixels)
+        else:
+            tok, logp, self.cache = self._jit_prefill(*args)
         now = time.monotonic()
         seq.slot = slot
         seq.t_first_token = now

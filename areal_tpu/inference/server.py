@@ -115,7 +115,10 @@ class GenerationServer:
             )
 
         try:
-            self.engine.submit(rid, input_ids, gconfig, on_done)
+            self.engine.submit(
+                rid, input_ids, gconfig, on_done,
+                image_data=body.get("image_data"),
+            )
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=500)
         try:

@@ -40,6 +40,15 @@ class TransformerConfig:
     # output head
     is_critic: bool = False  # scalar value head instead of LM head
     arch: str = "qwen2"
+    # Vision (0 = text-only). A compact ViT encoder (models/vlm.py) turns
+    # each image into exactly vision_patches embedding rows, spliced into
+    # the packed stream at image_token_id placeholders — fixed tokens per
+    # image keeps every packing/padding shape static (TPU requirement).
+    vision_patch_size: int = 0
+    vision_image_size: int = 0  # square input images, pixels
+    vision_hidden_size: int = 0
+    vision_layers: int = 0
+    image_token_id: int = 0
 
     @property
     def q_dim(self) -> int:
@@ -52,6 +61,16 @@ class TransformerConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_vlm(self) -> bool:
+        return self.vision_patch_size > 0
+
+    @property
+    def vision_patches(self) -> int:
+        """Embedding rows per image (placeholder token count)."""
+        side = self.vision_image_size // self.vision_patch_size
+        return side * side
 
 
 _HF_ARCH_MAP = {

@@ -91,6 +91,14 @@ def load_hf_params(
             top["final_norm"] = tensor
         elif name == "score.weight" or name == "value_head.weight":
             top["value_head"] = tensor.T
+        elif name.startswith("vision."):
+            # our own mini-ViT subtree (models/vlm.py) — no HF counterpart,
+            # round-tripped under dotted native names
+            node = top.setdefault("vision", {})
+            parts = name[len("vision.") :].split(".")
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = tensor
         elif name.startswith("model.layers."):
             rest = name[len("model.layers.") :]
             i_str, sub = rest.split(".", 1)
@@ -172,6 +180,18 @@ def load_hf_params(
         "layers": layers,
         "final_norm": top["final_norm"],
     }
+    if cfg.is_vlm:
+        if "vision" in top:
+            params_np["vision"] = top["vision"]
+        else:
+            # VLM bootstrapped from a text-only LM checkpoint: fresh encoder
+            from areal_tpu.models.vlm import init_vision_params
+            import jax as _jax
+
+            params_np["vision"] = _jax.tree.map(
+                lambda x: np.asarray(x, np.float32),
+                init_vision_params(cfg, _jax.random.PRNGKey(0)),
+            )
     if cfg.is_critic:
         if "value_head" in top:
             params_np["value_head"] = top["value_head"]
@@ -217,6 +237,17 @@ def save_hf_params(
         return np.ascontiguousarray(x)
 
     tensors: dict[str, np.ndarray] = {}
+    if "vision" in params:
+        def _walk(node, prefix):
+            for k in sorted(node.keys()):
+                v = node[k]
+                name = f"{prefix}.{k}"
+                if isinstance(v, dict):
+                    _walk(v, name)
+                else:
+                    tensors[name] = contig(host(v))
+
+        _walk(params["vision"], "vision")
     tensors["model.embed_tokens.weight"] = contig(host(params["embed"]))
     tensors["model.norm.weight"] = contig(host(params["final_norm"]))
     if "lm_head" in params:

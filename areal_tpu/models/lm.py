@@ -85,6 +85,10 @@ def init_params(
         "layers": layers,
         "final_norm": jnp.ones((h,), dtype),
     }
+    if cfg.is_vlm:
+        from areal_tpu.models.vlm import init_vision_params
+
+        params["vision"] = init_vision_params(cfg, next(keys), dtype)
     if cfg.is_critic:
         params["value_head"] = normal(next(keys), (h, 1), s)
     elif not cfg.tie_word_embeddings:
@@ -225,9 +229,15 @@ def forward_packed(
     segment_ids: jnp.ndarray,  # [T] int32, pad = -1
     remat: bool = False,
     attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
     x = params["embed"][input_ids]
+    if pixel_values is not None:
+        from areal_tpu.models.vlm import encode_images, splice_image_embeds
+
+        embeds = encode_images(params["vision"], cfg, pixel_values)
+        x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
         return _block(cfg, lp, carry, positions, segment_ids, attn_spec), None
@@ -268,6 +278,7 @@ def prefill(
     input_ids: jnp.ndarray,  # [Tp] int32, padded to a static bucket
     length: jnp.ndarray,  # scalar int32, true prompt length
     attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3]
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for one cache slot.
 
@@ -282,6 +293,11 @@ def prefill(
     positions = jnp.arange(tp, dtype=jnp.int32)
     segment_ids = jnp.where(positions < length, 0, -1)
     x = params["embed"][input_ids]
+    if pixel_values is not None:
+        from areal_tpu.models.vlm import encode_images, splice_image_embeds
+
+        embeds = encode_images(params["vision"], cfg, pixel_values)
+        x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
         h = rms_norm(carry, lp["ln1"], cfg.rms_norm_eps)

@@ -1,0 +1,120 @@
+"""Compact vision encoder + image-embedding splice for VLM workloads.
+
+The reference serves VLM RL through HF Qwen2.5-VL + SGLang multimodal
+(areal/workflow/vision_rlvr.py, areal/models/transformers/qwen2_vl.py). The
+TPU-native slice here is deliberately minimal but REAL end to end: a small
+ViT (patch embed + pre-norm attention/MLP blocks, stacked-leaf scan like the
+decoder) encodes each image into exactly ``cfg.vision_patches`` rows, which
+``splice_image_embeds`` swaps into the packed token stream wherever the
+prompt carries ``cfg.image_token_id`` placeholders.
+
+Fixed patches-per-image keeps every shape static, so the packing / FFD
+microbatching / bucketing machinery is untouched: ``pixel_values`` ride
+along as a per-sequence array and images line up with their placeholders by
+order of appearance in the stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import TransformerConfig
+
+Params = dict[str, Any]
+
+
+def init_vision_params(
+    cfg: TransformerConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    hv, lv = cfg.vision_hidden_size, cfg.vision_layers
+    pd = cfg.vision_patch_size * cfg.vision_patch_size * 3
+    p = cfg.vision_patches
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    blocks = {
+        "ln1": jnp.ones((lv, hv), dtype),
+        "wqkv": normal(next(keys), (lv, hv, 3 * hv)),
+        "wo": normal(next(keys), (lv, hv, hv)),
+        "ln2": jnp.ones((lv, hv), dtype),
+        "w1": normal(next(keys), (lv, hv, 4 * hv)),
+        "w2": normal(next(keys), (lv, 4 * hv, hv)),
+    }
+    return {
+        "patch_proj": normal(next(keys), (pd, hv)),
+        "pos_emb": normal(next(keys), (p, hv)),
+        "blocks": blocks,
+        "out_proj": normal(next(keys), (hv, cfg.hidden_size)),
+        "out_norm": jnp.ones((hv,), dtype),
+    }
+
+
+def _patchify(cfg: TransformerConfig, pixels: jnp.ndarray) -> jnp.ndarray:
+    """[N, S, S, 3] -> [N, P, patch_dim]."""
+    n = pixels.shape[0]
+    s, ps = cfg.vision_image_size, cfg.vision_patch_size
+    side = s // ps
+    x = pixels.reshape(n, side, ps, side, ps, 3)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, side * side, ps * ps * 3)
+
+
+def _ln(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def encode_images(
+    vparams: Params, cfg: TransformerConfig, pixels: jnp.ndarray
+) -> jnp.ndarray:
+    """[N, S, S, 3] float images -> [N, P, hidden_size] embedding rows."""
+    hv = cfg.vision_hidden_size
+    x = _patchify(cfg, pixels.astype(jnp.float32))
+    x = (x @ vparams["patch_proj"].astype(jnp.float32)).astype(
+        vparams["patch_proj"].dtype
+    )
+    x = x + vparams["pos_emb"][None]
+
+    def block(carry, bp):
+        h = _ln(carry, bp["ln1"])
+        qkv = h @ bp["wqkv"]  # [N, P, 3hv]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = jnp.einsum("npd,nqd->npq", q, k) * (hv**-0.5)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(v.dtype)
+        h = jnp.einsum("npq,nqd->npd", att, v) @ bp["wo"]
+        carry = carry + h
+        h = _ln(carry, bp["ln2"])
+        carry = carry + jax.nn.gelu(h @ bp["w1"]) @ bp["w2"]
+        return carry, None
+
+    x, _ = jax.lax.scan(block, x, vparams["blocks"])
+    x = _ln(x, vparams["out_norm"])
+    return x @ vparams["out_proj"]
+
+
+def splice_image_embeds(
+    cfg: TransformerConfig,
+    x: jnp.ndarray,  # [T, H] token embeddings (packed stream)
+    input_ids: jnp.ndarray,  # [T]
+    image_embeds: jnp.ndarray,  # [N, P, H] in order of appearance
+) -> jnp.ndarray:
+    """Replace rows at image placeholder positions with image embeddings.
+
+    The i-th placeholder token (stream order) takes the i-th row of the
+    flattened image embeddings; prompts must carry exactly P placeholders
+    per image. Static shapes: a cumulative-rank gather, no dynamic slicing.
+    """
+    flat = image_embeds.reshape(-1, image_embeds.shape[-1]).astype(x.dtype)
+    mask = input_ids == cfg.image_token_id
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1  # [T]
+    safe = jnp.clip(rank, 0, flat.shape[0] - 1)
+    return jnp.where(mask[:, None], flat[safe], x)

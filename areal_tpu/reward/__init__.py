@@ -1,8 +1,10 @@
 """Reward functions (reference: areal/reward/)."""
 
+from areal_tpu.reward.count_reward import count_reward  # noqa: F401
 from areal_tpu.reward.math_parser import (  # noqa: F401
     extract_answer,
     math_equal,
     math_verify_reward,
+
     process_results,
 )

@@ -1,0 +1,28 @@
+"""Counting reward for clevr_count-style VLM tasks (reference:
+areal/reward clevr verifier): extract the first integer from the completion
+and compare with the gold count."""
+
+from __future__ import annotations
+
+import re
+
+_NUM = re.compile(r"-?\d+")
+
+
+def count_reward(
+    prompt: str | None,
+    completion: str | None,
+    prompt_ids=None,
+    completion_ids=None,
+    answer: str = "",
+    **_kw,
+) -> float:
+    if not completion:
+        return 0.0
+    m = _NUM.search(completion)
+    if m is None:
+        return 0.0
+    try:
+        return 1.0 if int(m.group()) == int(str(answer).strip()) else 0.0
+    except ValueError:
+        return 0.0

@@ -144,6 +144,9 @@ def pack_tensor_dict(data: TensorDict) -> TensorDict:
         if k == "attention_mask":
             continue
         arr = np.asarray(v)
+        if k == "pixel_values":
+            out[k] = arr  # per-ROW image tensors ride alongside unpacked
+            continue
         if _is_per_token(k, arr, bs) and arr.shape[1] == t:
             out[k] = arr.reshape((bs * t,) + arr.shape[2:])[flat_idx]
         else:

@@ -1,0 +1,27 @@
+"""Image transport helpers (reference: areal/utils/image.py base64 transport).
+
+Images travel client -> server as base64-encoded raw float arrays (shape
+header + bytes) — no PIL/JPEG dependency in the TPU image, and the encoder
+consumes float pixel grids anyway. The trainer keeps the decoded arrays in
+the batch as ``pixel_values``.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+
+
+def encode_image(arr: np.ndarray) -> str:
+    """float32 [H, W, 3] (values in [0, 1]) -> base64 string."""
+    arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_image(s: str) -> np.ndarray:
+    raw = base64.b64decode(s.encode("ascii"))
+    return np.load(io.BytesIO(raw), allow_pickle=False)

@@ -106,3 +106,32 @@ def make_math_jsonl(path: str, n: int = 64, seed: int = 0):
                 + "\n"
             )
     return path
+
+
+def make_clevr_jsonl(
+    path: str, n: int = 16, image_size: int = 16, max_objects: int = 4, seed: int = 0
+):
+    """Synthetic clevr_count-style VLM rows: k bright squares on a dark
+    field; question asks how many; answer = k. Images travel as base64
+    (utils/image.py)."""
+    import json
+
+    import numpy as np
+
+    from areal_tpu.utils.image import encode_image
+
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            k = int(rng.integers(1, max_objects + 1))
+            img = np.zeros((image_size, image_size, 3), np.float32)
+            for _j in range(k):
+                x = int(rng.integers(0, image_size - 3))
+                y = int(rng.integers(0, image_size - 3))
+                img[y : y + 3, x : x + 3] = rng.uniform(0.5, 1.0, 3)
+            row = {
+                "question": "How many objects are in the picture?",
+                "images": [encode_image(img)],
+                "answer": k,
+            }
+            f.write(json.dumps(row) + "\n")
