@@ -160,13 +160,14 @@ class RemoteInfEngine(InferenceEngine):
         itl: list[float] = []
         session = await self._get_session()
         max_new = gconfig.max_new_tokens
+        encoded_images = _encode_images_for_transport(req.image_data)
         while stop_reason == "abort" and len(accumulated) < max_new:
             while self._paused.is_set():
                 await asyncio.sleep(0.05)
             payload = {
                 "rid": req.rid,
                 "input_ids": prompt + accumulated,
-                "image_data": _encode_images_for_transport(req.image_data),
+                "image_data": encoded_images,
                 "sampling_params": {
                     "max_new_tokens": max_new - len(accumulated),
                     "min_new_tokens": max(

@@ -206,6 +206,8 @@ class TPUTrainEngine(TrainEngine):
         self._lr_schedule = None
         self._opt_steps = 0
         self._jit_cache: dict[Any, Callable] = {}
+        self.lora_params = None
+        self._merged_cache = None
         self.attn_spec = None
         self._rollout_engine = None
         self._weight_update_meta: WeightUpdateMeta | None = None
@@ -270,6 +272,21 @@ class TPUTrainEngine(TrainEngine):
                 to_device=self._sharded_putter(shardings),
             )
 
+        if cfg.lora is not None:
+            # adapters are the ONLY trainable tree; the base stays frozen and
+            # the effective weights are merged on the fly (models/lora.py)
+            from areal_tpu.models.lora import init_lora_params
+
+            rep = NamedSharding(self.mesh, P())
+            self.lora_params = jax.device_put(
+                init_lora_params(
+                    self.model_config, cfg.lora, jax.random.PRNGKey(seed + 1)
+                ),
+                rep,
+            )
+        else:
+            self.lora_params = None
+
         if cfg.optimizer is not None:
             total = ft_spec.total_train_steps if ft_spec is not None else 1 << 20
             self._tx = make_optimizer(
@@ -277,9 +294,40 @@ class TPUTrainEngine(TrainEngine):
             )
             self._lr_schedule = make_lr_schedule(cfg.optimizer, total)
             init_opt = jax.jit(self._tx.init)
-            self.opt_state = init_opt(self.params)
+            self.opt_state = init_opt(self._trainable())
         self.initialized = True
         return self
+
+    def _trainable(self):
+        """The pytree the optimizer updates: LoRA adapters when configured,
+        else the full params."""
+        return self.lora_params if self.config.lora is not None else self.params
+
+    def _set_trainable(self, tree):
+        if self.config.lora is not None:
+            self.lora_params = tree
+            self._merged_cache = None  # effective weights changed
+        else:
+            self.params = tree
+
+    def effective_params(self):
+        """Merged (base + adapter) weights for scoring / export / serving;
+        identity without LoRA. Cached until the next optimizer step."""
+        if self.config.lora is None:
+            return self.params
+        if self._merged_cache is None:
+            from areal_tpu.models.lora import merge_lora
+
+            key = "lora_merge"
+            if key not in self._jit_cache:
+                cfg = self.config.lora
+                self._jit_cache[key] = jax.jit(
+                    lambda b, lo: merge_lora(b, lo, cfg)
+                )
+            self._merged_cache = self._jit_cache[key](
+                self.params, self.lora_params
+            )
+        return self._merged_cache
 
     def _build_attn_spec(self):
         """Per-engine attention dispatch (no process-global state): tokens
@@ -373,6 +421,12 @@ class TPUTrainEngine(TrainEngine):
         host feeds its own device shards — no cross-host data movement,
         the DistRolloutCoordinator redistribution made structural)."""
         n = int(packed["cu_seqlens"][-1])
+        if "pixel_values" in packed and distributed.process_count() > 1:
+            # per-host image tables vs global placeholder ranks don't line
+            # up yet — fail loudly instead of training on the wrong images
+            raise NotImplementedError(
+                "multi-host VLM training is not supported yet"
+            )
         rep = NamedSharding(self.mesh, P())
         out = {}
         for k, v in packed.items():
@@ -493,15 +547,35 @@ class TPUTrainEngine(TrainEngine):
                 return loss_fn(logits, mb)
 
             acc_dtype = _DTYPES[backend.grad_acc_dtype]
+            lora_cfg = self.config.lora
 
-            def step(params, acc, mb):
-                loss, grads = jax.value_and_grad(compute)(params, mb)
-                acc = jax.tree.map(
-                    lambda a, g: a + g.astype(acc_dtype), acc, grads
+            if lora_cfg is None:
+
+                def step(params, acc, mb):
+                    loss, grads = jax.value_and_grad(compute)(params, mb)
+                    acc = jax.tree.map(
+                        lambda a, g: a + g.astype(acc_dtype), acc, grads
+                    )
+                    return loss, acc
+
+                self._jit_cache[key] = jax.jit(step, donate_argnums=(1,))
+            else:
+                from areal_tpu.models.lora import merge_lora
+
+                def step(lora, base, acc, mb):
+                    def f(lo):
+                        return compute(merge_lora(base, lo, lora_cfg), mb)
+
+                    loss, grads = jax.value_and_grad(f)(lora)
+                    acc = jax.tree.map(
+                        lambda a, g: a + g.astype(acc_dtype), acc, grads
+                    )
+                    return loss, acc
+
+                jitted = jax.jit(step, donate_argnums=(2,))
+                self._jit_cache[key] = (
+                    lambda tr, acc, mb: jitted(tr, self.params, acc, mb)
                 )
-                return loss, acc
-
-            self._jit_cache[key] = jax.jit(step, donate_argnums=(1,))
         return self._jit_cache[key]
 
     def _apply_fn(self) -> Callable:
@@ -532,15 +606,17 @@ class TPUTrainEngine(TrainEngine):
     def _zeros_like_grads(self):
         key = "zeros"
         if key not in self._jit_cache:
-            shardings = self.param_shardings()
             acc_dtype = _DTYPES[self.config.backend.grad_acc_dtype]
+            kwargs = {}
+            if self.config.lora is None:
+                kwargs["out_shardings"] = self.param_shardings()
             self._jit_cache[key] = jax.jit(
                 lambda p: jax.tree.map(
                     lambda x: jnp.zeros(x.shape, acc_dtype), p
                 ),
-                out_shardings=shardings,
+                **kwargs,
             )
-        return self._jit_cache[key](self.params)
+        return self._jit_cache[key](self._trainable())
 
     def train_batch(
         self,
@@ -570,13 +646,14 @@ class TPUTrainEngine(TrainEngine):
         losses = []
         for packed in packed_mbs:
             mb_dev = self._mb_to_device(packed)
-            loss, acc = grad_step(self.params, acc, mb_dev)
+            loss, acc = grad_step(self._trainable(), acc, mb_dev)
             losses.append(loss)
 
         apply = self._apply_fn()
-        self.params, self.opt_state, gnorm, ok = apply(
-            self.params, self.opt_state, acc, jnp.float32(total_weight)
+        new_trainable, self.opt_state, gnorm, ok = apply(
+            self._trainable(), self.opt_state, acc, jnp.float32(total_weight)
         )
+        self._set_trainable(new_trainable)
         if bool(ok):
             self._opt_steps += 1
         loss_sum = float(jnp.sum(jnp.stack([jnp.asarray(l) for l in losses])))
@@ -623,7 +700,7 @@ class TPUTrainEngine(TrainEngine):
         total, denom = 0.0, 0.0
         for packed in packed_mbs:
             mb_dev = self._mb_to_device(packed)
-            total += float(ev(self.params, mb_dev))
+            total += float(ev(self.effective_params(), mb_dev))
             denom += float(loss_weight_fn(packed))
         return total / max(denom, 1.0)
 
@@ -663,7 +740,7 @@ class TPUTrainEngine(TrainEngine):
         per_row: list[np.ndarray] = []
         for mb_idx, (packed, real_n) in enumerate(zip(packed_mbs, real_ns)):
             mb_dev = self._mb_to_device(packed)
-            out = np.asarray(jax.device_get(fwd(self.params, mb_dev)))[:real_n]
+            out = np.asarray(jax.device_get(fwd(self.effective_params(), mb_dev)))[:real_n]
             if output_seqlens is not None:
                 # per-sequence output lengths differ from input lengths
                 # (reference base_hf_engine.py:516-544)
@@ -695,6 +772,44 @@ class TPUTrainEngine(TrainEngine):
 
     # ------------------------------------------------------------ checkpoint
 
+    def _lora_adapter_path(self, path: str) -> str:
+        return os.path.join(path, "lora_adapter.safetensors")
+
+    def _save_lora_adapter(self, path: str):
+        from safetensors.numpy import save_file
+
+        flat = {}
+
+        def walk(node, prefix):
+            for k in sorted(node.keys()):
+                v = node[k]
+                name = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, dict):
+                    walk(v, name)
+                else:
+                    flat[name] = np.ascontiguousarray(
+                        np.asarray(jax.device_get(v))
+                    )
+
+        walk(self.lora_params, "")
+        os.makedirs(path, exist_ok=True)
+        save_file(flat, self._lora_adapter_path(path))
+
+    def _load_lora_adapter(self, path: str):
+        from safetensors.numpy import load_file
+
+        flat = load_file(self._lora_adapter_path(path))
+        tree: dict = {}
+        for name, arr in flat.items():
+            node = tree
+            parts = name.split(".")
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = arr
+        rep = NamedSharding(self.mesh, P())
+        self.lora_params = jax.device_put(tree, rep)
+        self._merged_cache = None
+
     def save(self, meta: SaveLoadMeta):
         if meta.weight_format == "hf":
             multi = distributed.process_count() > 1
@@ -711,6 +826,10 @@ class TPUTrainEngine(TrainEngine):
                 if not distributed.is_main():
                     return
             hf_io.save_hf_params(params, self.model_config, meta.path)
+            if self.config.lora is not None:
+                # PEFT convention: frozen base + separate adapter file so a
+                # resume restores the exact (base, adapter, optimizer) state
+                self._save_lora_adapter(meta.path)
             if meta.tokenizer is not None:
                 meta.tokenizer.save_pretrained(meta.path)
             if meta.with_optim:
@@ -730,6 +849,11 @@ class TPUTrainEngine(TrainEngine):
                 dtype=self.config.backend.param_dtype,
                 to_device=self._sharded_putter(self.param_shardings()),
             )
+            self._merged_cache = None  # base changed; stale merge invalid
+            if self.config.lora is not None and os.path.isfile(
+                self._lora_adapter_path(meta.path)
+            ):
+                self._load_lora_adapter(meta.path)
             optim_dir = os.path.join(meta.path, "optim")
             if meta.with_optim and os.path.isdir(optim_dir):
                 self._load_optimizer(optim_dir)
@@ -770,6 +894,8 @@ class TPUTrainEngine(TrainEngine):
         import orbax.checkpoint as ocp
 
         ckpt = {"params": self.params}
+        if self.lora_params is not None:
+            ckpt["lora_params"] = self.lora_params
         if with_optim:
             ckpt["opt_state"] = self.opt_state
             ckpt["opt_steps"] = self._opt_steps
@@ -780,12 +906,17 @@ class TPUTrainEngine(TrainEngine):
         import orbax.checkpoint as ocp
 
         target = {"params": self.params}
+        if self.lora_params is not None:
+            target["lora_params"] = self.lora_params
         if with_optim:
             target["opt_state"] = self.opt_state
             target["opt_steps"] = self._opt_steps
         with ocp.StandardCheckpointer() as cp:
             restored = cp.restore(os.path.abspath(path), target)
         self.params = restored["params"]
+        if self.lora_params is not None:
+            self.lora_params = restored["lora_params"]
+        self._merged_cache = None
         if with_optim:
             self.opt_state = restored["opt_state"]
             self._opt_steps = int(restored["opt_steps"])
@@ -800,7 +931,9 @@ class TPUTrainEngine(TrainEngine):
     def upload_weights(self, meta: WeightUpdateMeta):
         if meta.type == "disk":
             assert meta.path is not None
-            hf_io.save_hf_params(self.params, self.model_config, meta.path)
+            hf_io.save_hf_params(
+                self.effective_params(), self.model_config, meta.path
+            )
         elif meta.type in ("device", "http"):
             pass  # live handle / streamed by update_weights
         else:
@@ -824,7 +957,7 @@ class TPUTrainEngine(TrainEngine):
                 else:
                     yield path, v
 
-        for path, leaf in walk(self.params, ""):
+        for path, leaf in walk(self.effective_params(), ""):
             arr = np.asarray(jax.device_get(leaf))
             if cur and size + arr.nbytes > budget:
                 yield cur
@@ -849,7 +982,9 @@ class TPUTrainEngine(TrainEngine):
             assert target is not None and hasattr(
                 target, "update_weights_from_arrays"
             ), "device weight updates need a colocated engine (LocalInfEngine)"
-            target.update_weights_from_arrays(self.params, next_version)
+            target.update_weights_from_arrays(
+                self.effective_params(), next_version
+            )
         elif meta.type == "http":
             target = self._rollout_engine
             assert target is not None and hasattr(

@@ -344,16 +344,24 @@ class GenerationEngine:
         if image_data:
             from areal_tpu.utils.image import decode_image
 
+            if not self.model_config.is_vlm:
+                raise ValueError("model has no vision encoder but got images")
             images = [
                 decode_image(x) if isinstance(x, str) else np.asarray(x)
                 for x in image_data
             ]
+            size = self.model_config.vision_image_size
+            for img in images:
+                if tuple(img.shape) != (size, size, 3):
+                    # validate HERE (caller thread): a malformed image must
+                    # not detonate inside the shared engine loop
+                    raise ValueError(
+                        f"image shape {tuple(img.shape)} != ({size}, {size}, 3)"
+                    )
             expected = len(images) * self.model_config.vision_patches
             got = sum(
                 1 for t in input_ids if t == self.model_config.image_token_id
             )
-            if not self.model_config.is_vlm:
-                raise ValueError("model has no vision encoder but got images")
             if got != expected:
                 raise ValueError(
                     f"prompt carries {got} image placeholder tokens but "

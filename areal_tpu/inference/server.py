@@ -119,6 +119,8 @@ class GenerationServer:
                 rid, input_ids, gconfig, on_done,
                 image_data=body.get("image_data"),
             )
+        except ValueError as e:  # invalid request: no point retrying
+            return web.json_response({"error": str(e)}, status=400)
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=500)
         try:

@@ -49,6 +49,16 @@ class RLVRWorkflow(RolloutWorkflow):
         if dump_dir is not None:
             os.makedirs(dump_dir, exist_ok=True)
 
+    # hook points for subclasses (VisionRLVRWorkflow): what to send, and
+    # what extra per-sample arrays ride the trajectory batch
+    _extra_exclude: tuple[str, ...] = ("messages", "input_ids")
+
+    def _prepare_inputs(
+        self, data: dict[str, Any]
+    ) -> tuple[list[int], dict, dict]:
+        """-> (input_ids, extra ModelRequest kwargs, extra sample arrays)."""
+        return self._tokenize_prompt(data), {}, {}
+
     def _tokenize_prompt(self, data: dict[str, Any]) -> list[int]:
         if "input_ids" in data:
             return list(data["input_ids"])
@@ -61,7 +71,7 @@ class RLVRWorkflow(RolloutWorkflow):
         )
 
     async def arun_episode(self, engine, data: dict[str, Any]):
-        input_ids = self._tokenize_prompt(data)
+        input_ids, req_kwargs, sample_extras = self._prepare_inputs(data)
         n = self.gconfig.n_samples
         gconfig = self.gconfig.new(n_samples=1)
         resps = await asyncio.gather(
@@ -72,6 +82,7 @@ class RLVRWorkflow(RolloutWorkflow):
                         input_ids=list(input_ids),
                         gconfig=gconfig,
                         tokenizer=self.tokenizer,
+                        **req_kwargs,
                     )
                 )
                 for _ in range(n)
@@ -79,7 +90,7 @@ class RLVRWorkflow(RolloutWorkflow):
         )
         prompt_str = self.tokenizer.decode(input_ids) if self.tokenizer else None
         extra = {
-            k: v for k, v in data.items() if k not in ("messages", "input_ids")
+            k: v for k, v in data.items() if k not in self._extra_exclude
         }
         completions = [
             self.tokenizer.decode(r.output_tokens) if self.tokenizer else None
@@ -108,6 +119,7 @@ class RLVRWorkflow(RolloutWorkflow):
                     versions=np.asarray(versions, np.int64)[None],
                     attention_mask=np.ones((1, seqlen), np.int64),
                     rewards=np.asarray([reward], np.float32),
+                    **sample_extras,
                 )
             )
             self._maybe_dump(engine, data, resp, completion_str, reward)

@@ -1,29 +1,28 @@
 """Vision RLVR workflow (reference: areal/workflow/vision_rlvr.py).
 
-Same contract as RLVRWorkflow plus image handling: each sample's images are
-base64-strings in ``data["images"]``; the prompt is prefixed with exactly
-``cfg.vision_patches`` placeholder tokens per image (``image_token_id``), the
-images ride the generation request (ModelRequest.image_data), and the output
-batch carries decoded ``pixel_values`` so the trainer can recompute logprobs
-through the vision encoder.
+Subclasses RLVRWorkflow through its hook points: the prompt is prefixed with
+exactly ``patches_per_image`` placeholder tokens per image
+(``image_token_id``), the decoded images ride the generation request
+(ModelRequest.image_data — the remote client re-encodes for HTTP transport),
+and the trajectory batch carries ``pixel_values`` so the trainer recomputes
+logprobs through the vision encoder. The episode loop itself lives in
+RLVRWorkflow — one implementation for text and vision.
 """
 
 from __future__ import annotations
 
-import asyncio
-import uuid
 from typing import Any, Callable
 
 import numpy as np
 
 from areal_tpu.api.cli_args import GenerationHyperparameters
-from areal_tpu.api.io_struct import ModelRequest
-from areal_tpu.utils.data import concat_padded_tensors
 from areal_tpu.utils.image import decode_image
 from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
 class VisionRLVRWorkflow(RLVRWorkflow):
+    _extra_exclude = ("messages", "input_ids", "images")
+
     def __init__(
         self,
         reward_fn: Callable,
@@ -37,7 +36,7 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         self.image_token_id = image_token_id
         self.patches_per_image = patches_per_image
 
-    async def arun_episode(self, engine, data: dict[str, Any]):
+    def _prepare_inputs(self, data: dict[str, Any]):
         images = list(data.get("images", []))
         if not images:
             raise ValueError(
@@ -45,69 +44,18 @@ class VisionRLVRWorkflow(RLVRWorkflow):
                 "image counts would break batch concatenation); use "
                 "RLVRWorkflow for text-only rows"
             )
-        # decode ONCE per episode (n_samples requests share the arrays);
-        # the remote client re-encodes for HTTP transport
+        # decode ONCE per episode (n_samples requests share the arrays)
         pixels = np.stack(
-            [decode_image(s) if isinstance(s, str) else np.asarray(s) for s in images]
+            [
+                decode_image(s) if isinstance(s, str) else np.asarray(s)
+                for s in images
+            ]
         )
-        images = [pixels[i] for i in range(pixels.shape[0])]
         text_ids = self._tokenize_prompt(data)
         placeholder = [self.image_token_id] * (
-            self.patches_per_image * len(images)
+            self.patches_per_image * pixels.shape[0]
         )
         input_ids = placeholder + list(text_ids)
-
-        n = self.gconfig.n_samples
-        gconfig = self.gconfig.new(n_samples=1)
-        resps = await asyncio.gather(
-            *[
-                engine.agenerate(
-                    ModelRequest(
-                        rid=str(uuid.uuid4()),
-                        input_ids=list(input_ids),
-                        gconfig=gconfig,
-                        tokenizer=self.tokenizer,
-                        image_data=list(images),
-                    )
-                )
-                for _ in range(n)
-            ]
-        )
-        prompt_str = self.tokenizer.decode(text_ids) if self.tokenizer else None
-        extra = {
-            k: v
-            for k, v in data.items()
-            if k not in ("messages", "input_ids", "images")
-        }
-        completions = [
-            self.tokenizer.decode(r.output_tokens) if self.tokenizer else None
-            for r in resps
-        ]
-        rewards = await asyncio.gather(
-            *[
-                self.reward_fn(
-                    prompt_str, comp, r.input_tokens, r.output_tokens, **extra
-                )
-                for r, comp in zip(resps, completions)
-            ]
-        )
-        samples = []
-        for resp, completion_str, reward in zip(resps, completions, rewards):
-            seqlen = resp.input_len + resp.output_len
-            seq = resp.input_tokens + resp.output_tokens
-            logprobs = [0.0] * resp.input_len + resp.output_logprobs
-            loss_mask = [0] * resp.input_len + [1] * resp.output_len
-            versions = [-1] * resp.input_len + resp.output_versions
-            samples.append(
-                dict(
-                    input_ids=np.asarray(seq, np.int64)[None],
-                    loss_mask=np.asarray(loss_mask, np.int64)[None],
-                    logprobs=np.asarray(logprobs, np.float32)[None],
-                    versions=np.asarray(versions, np.int64)[None],
-                    attention_mask=np.ones((1, seqlen), np.int64),
-                    rewards=np.asarray([reward], np.float32),
-                    pixel_values=pixels[None],  # [1, N_img, S, S, 3]
-                )
-            )
-            self._maybe_dump(engine, data, resp, completion_str, reward)
-        return concat_padded_tensors(samples)
+        req_kwargs = {"image_data": [pixels[i] for i in range(pixels.shape[0])]}
+        sample_extras = {"pixel_values": pixels[None]}  # [1, N_img, S, S, 3]
+        return input_ids, req_kwargs, sample_extras

@@ -1,0 +1,139 @@
+"""LoRA: adapter-only training with frozen base, merged serving/export,
+adapter checkpoint round trip (VERDICT r1 missing #7; reference:
+examples/lora/gsm8k_grpo_lora.py + sglang_remote.py:82-106 hot-swap)."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import LoRAConfig, OptimizerConfig, TrainEngineConfig
+from areal_tpu.api.io_struct import SaveLoadMeta
+from areal_tpu.engine.sft.lm_engine import TPULMEngine
+from areal_tpu.models.config import tiny_config
+
+
+def _cfg(**over):
+    cfg = TrainEngineConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=5e-3),
+        lora=LoRAConfig(rank=4, alpha=8.0),
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 32
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    data = dict(
+        input_ids=rng.integers(1, 128, size=(4, 16)).astype(np.int32),
+        attention_mask=np.ones((4, 16), np.int32),
+        loss_mask=np.ones((4, 16), np.int32),
+    )
+    data["loss_mask"][:, 0] = 0
+    return data
+
+
+def test_lora_trains_adapters_only():
+    eng = TPULMEngine(_cfg())
+    eng.initialize(None, None, model_config=tiny_config(), seed=0)
+    base_before = jax.device_get(eng.params["layers"]["wq"])
+    lora_b_before = jax.device_get(eng.lora_params["layers"]["wq_b"])
+    assert np.all(np.asarray(lora_b_before) == 0)  # identity adapter at init
+
+    data = _data()
+    losses = [eng.train_lm(data)["loss"] for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+    base_after = jax.device_get(eng.params["layers"]["wq"])
+    lora_b_after = jax.device_get(eng.lora_params["layers"]["wq_b"])
+    np.testing.assert_array_equal(
+        np.asarray(base_before), np.asarray(base_after)
+    )  # base frozen
+    assert not np.allclose(np.asarray(lora_b_after), 0)  # adapters moved
+    eng.destroy()
+
+
+def test_lora_effective_params_used_for_scoring_and_export(tmp_path):
+    eng = TPULMEngine(_cfg())
+    eng.initialize(None, None, model_config=tiny_config(), seed=1)
+    data = _data(1)
+    for _ in range(4):
+        eng.train_lm(data)
+
+    eff = eng.effective_params()
+    base = eng.params
+    assert not np.allclose(
+        np.asarray(jax.device_get(eff["layers"]["wq"])),
+        np.asarray(jax.device_get(base["layers"]["wq"])),
+    )
+
+    # merged weights flow through the weight-update chunk walk
+    names = set()
+    for chunk in eng._weight_chunks(1):
+        names.update(chunk)
+        for k, v in chunk.items():
+            if k == "layers.wq":
+                np.testing.assert_allclose(
+                    v,
+                    np.asarray(jax.device_get(eff["layers"]["wq"])),
+                    rtol=1e-6,
+                )
+    assert "layers.wq" in names
+    eng.destroy()
+
+
+def test_lora_checkpoint_roundtrip_resumes_exactly(tmp_path):
+    eng = TPULMEngine(_cfg())
+    eng.initialize(None, None, model_config=tiny_config(), seed=2)
+    data = _data(2)
+    for _ in range(3):
+        eng.train_lm(data)
+    eng.save(SaveLoadMeta(path=str(tmp_path), weight_format="hf", with_optim=True))
+    lora_ref = jax.device_get(eng.lora_params["layers"]["wq_a"])
+    eng.destroy()
+
+    eng2 = TPULMEngine(_cfg(path=str(tmp_path), init_from_scratch=False))
+    eng2.initialize(None, None, model_config=tiny_config(), seed=9)
+    eng2.load(SaveLoadMeta(path=str(tmp_path), weight_format="hf", with_optim=True))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(eng2.lora_params["layers"]["wq_a"])),
+        np.asarray(lora_ref),
+        rtol=1e-6,
+    )
+    # training continues without error after resume
+    stats = eng2.train_lm(data)
+    assert np.isfinite(stats["loss"])
+    eng2.destroy()
+
+
+def test_lora_unknown_target_raises():
+    from areal_tpu.models.lora import init_lora_params
+
+    with pytest.raises(ValueError, match="unknown LoRA target"):
+        init_lora_params(
+            tiny_config(),
+            LoRAConfig(target_modules=["bogus_proj"]),
+            jax.random.PRNGKey(0),
+        )
+
+
+def test_lora_orbax_roundtrip(tmp_path):
+    eng = TPULMEngine(_cfg())
+    eng.initialize(None, None, model_config=tiny_config(), seed=3)
+    data = _data(3)
+    eng.train_lm(data)
+    eng.save(SaveLoadMeta(path=str(tmp_path / "ck"), weight_format="orbax", with_optim=True))
+    ref = np.asarray(jax.device_get(eng.lora_params["layers"]["wq_b"]))
+    eng.destroy()
+
+    eng2 = TPULMEngine(_cfg())
+    eng2.initialize(None, None, model_config=tiny_config(), seed=8)
+    eng2.load(SaveLoadMeta(path=str(tmp_path / "ck"), weight_format="orbax", with_optim=True))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(eng2.lora_params["layers"]["wq_b"])), ref, rtol=1e-6
+    )
+    eng2.destroy()
