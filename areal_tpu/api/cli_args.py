@@ -442,6 +442,17 @@ class DatasetConfig:
 
 
 @dataclass
+class ProfilerConfig:
+    """Windowed jax.profiler capture (utils/profiling.py; the reference's
+    torch-profiler attribution role, realhf/base/monitor.py:404-610)."""
+
+    enabled: bool = False
+    dir: str = "/tmp/areal_tpu/profiles"
+    start_step: int = 2  # skip compile steps
+    num_steps: int = 2
+
+
+@dataclass
 class LauncherConfig:
     inference_server_cpus_per_chip: int = 4
     inference_server_mem_per_chip: int = 32768
@@ -476,6 +487,7 @@ class BaseExperimentConfig:
     recover: RecoverConfig = field(default_factory=RecoverConfig)
     stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
     launcher: LauncherConfig = field(default_factory=LauncherConfig)
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
 
     def __post_init__(self):
         # propagate experiment/trial names into sub-configs left at defaults

@@ -1,0 +1,1 @@
+from areal_tpu.controller.batch import DistributedBatchMemory  # noqa: F401

@@ -1,0 +1,101 @@
+"""Controller-side batch container: chunk / union / concat over row dicts.
+
+Parity with the reference's ``DistributedBatchMemory``
+(areal/controller/batch.py:16-366): a padded tensor-dict batch that the
+single-controller mode shards across engine workers — even row chunks,
+FFD-balanced token chunks (utils/datapack.ffd_allocate), union-by-key, and
+concatenation. Arrays are numpy on the controller; engines shard on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.utils.data import TensorDict, concat_padded_tensors
+from areal_tpu.utils.datapack import partition_balanced
+
+
+def _batch_size(data: TensorDict) -> int:
+    for v in data.values():
+        arr = np.asarray(v)
+        if arr.ndim >= 1:
+            return arr.shape[0]
+    return 0
+
+
+class DistributedBatchMemory:
+    def __init__(self, data: TensorDict):
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+
+    @classmethod
+    def from_dict(cls, data: TensorDict) -> "DistributedBatchMemory":
+        return cls(data)
+
+    def __len__(self) -> int:
+        return _batch_size(self.data)
+
+    def __getitem__(self, key: str):
+        return self.data[key]
+
+    def keys(self):
+        return self.data.keys()
+
+    def _select(self, rows: list[int]) -> "DistributedBatchMemory":
+        idx = np.asarray(rows, np.int64)
+        bs = len(self)
+        out = {}
+        for k, v in self.data.items():
+            out[k] = v[idx] if v.ndim >= 1 and v.shape[0] == bs else v
+        return DistributedBatchMemory(out)
+
+    def chunk(self, n: int) -> list["DistributedBatchMemory"]:
+        """Even row split (last chunks one shorter when not divisible)."""
+        bs = len(self)
+        if n <= 0 or bs < n:
+            raise ValueError(f"cannot chunk batch of {bs} rows into {n}")
+        splits = np.array_split(np.arange(bs), n)
+        return [self._select(list(s)) for s in splits]
+
+    def chunk_by_ffd(self, group_size: int, n: int) -> list["DistributedBatchMemory"]:
+        """Token-balanced split keeping ``group_size`` row groups intact
+        (GRPO groups must stay on one worker — reference batch.py:55+)."""
+        bs = len(self)
+        assert bs % group_size == 0, (bs, group_size)
+        if "attention_mask" in self.data:
+            lens = np.asarray(self.data["attention_mask"]).sum(-1)
+        else:
+            lens = np.ones(bs, np.int64)
+        group_costs = lens.reshape(-1, group_size).sum(-1)
+        bins = partition_balanced(group_costs, n)
+        out = []
+        for b in bins:
+            rows = [
+                g * group_size + i for g in sorted(b) for i in range(group_size)
+            ]
+            out.append(self._select(rows))
+        return out
+
+    def union(self, other: "DistributedBatchMemory") -> "DistributedBatchMemory":
+        """Merge per-key: other's keys join this batch (same row count)."""
+        if len(other) not in (0, len(self)):
+            raise ValueError(f"union row mismatch: {len(self)} vs {len(other)}")
+        merged = dict(self.data)
+        merged.update(other.data)
+        return DistributedBatchMemory(merged)
+
+    @classmethod
+    def concat(
+        cls, batches: list["DistributedBatchMemory"]
+    ) -> "DistributedBatchMemory":
+        return cls(concat_padded_tensors([b.data for b in batches]))
+
+    def to_dict(self) -> TensorDict:
+        return dict(self.data)
+
+    def __repr__(self) -> str:
+        return (
+            f"DistributedBatchMemory(rows={len(self)}, "
+            f"keys={sorted(self.data)})"
+        )

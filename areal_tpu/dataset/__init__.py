@@ -131,7 +131,57 @@ def process_clevr_count_dataset(rows: list[dict], **_kw) -> list[dict]:
     return out
 
 
-_PROCESSORS: dict[tuple[str, str], Callable] = {}
+def _first_present(r: dict, keys: tuple[str, ...]):
+    """First key present with a non-None value — `or`-chaining would drop
+    falsy-but-valid golds like the integer 0."""
+    for k in keys:
+        if r.get(k) is not None:
+            return r[k]
+    return None
+
+
+def process_torl_dataset(rows: list[dict], **_kw) -> list[dict]:
+    """ToRL math rows (reference areal/dataset torl entry): tool-integrated
+    reasoning prompts; gold answers flow to the math/TIR reward."""
+    out = []
+    for r in rows:
+        q = _first_present(r, ("question", "prompt", "problem"))
+        a = _first_present(r, ("answer", "gt", "solution"))
+        a = "" if a is None else a
+        if q is None:
+            continue
+        out.append(
+            {
+                "messages": [{"role": "user", "content": str(q)}],
+                "answer": str(a),
+            }
+        )
+    return out
+
+
+def process_geometry3k_dataset(rows: list[dict], **_kw) -> list[dict]:
+    """geometry3k VLM rows (reference areal/dataset geometry3k entry): same
+    contract as clevr — images + question + gold answer for
+    VisionRLVRWorkflow."""
+    out = []
+    for r in rows:
+        q = _first_present(r, ("question", "problem"))
+        if q is None or not r.get("images"):
+            continue
+        out.append(
+            {
+                "messages": [{"role": "user", "content": str(q)}],
+                "images": list(r["images"]),
+                "answer": str(r.get("answer", "")),
+            }
+        )
+    return out
+
+
+_PROCESSORS: dict[tuple[str, str], Callable] = {
+    ("torl", "rl"): process_torl_dataset,
+    ("geometry3k", "vlm_rl"): process_geometry3k_dataset,
+}
 
 
 def register_dataset(name: str, type_: str):

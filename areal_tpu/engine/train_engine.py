@@ -642,6 +642,10 @@ class TPUTrainEngine(TrainEngine):
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
 
         grad_step = self._grad_fn(loss_fn)
+        # free any merged-weights copy BEFORE forward+backward: holding a
+        # full effective-params clone through the grad step would forfeit
+        # LoRA's memory savings
+        self._merged_cache = None
         acc = self._zeros_like_grads()
         losses = []
         for packed in packed_mbs:

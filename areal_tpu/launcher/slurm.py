@@ -1,0 +1,156 @@
+"""Slurm launcher: sbatch script synthesis + submission.
+
+The reference synthesizes sbatch scripts with gres/container mounts and
+polls job state (realhf/scheduler/slurm/utils.py:816, client.py;
+areal/launcher/slurm.py:657). The TPU translation: one job array of
+generation-server tasks + one trainer job of ``launcher.trainer_processes``
+jax.distributed-wired tasks, glued by NFS name-resolve (servers register
+their addresses; trainers discover them — same flow as the local launcher,
+scaled out). Script synthesis is pure (unit-testable anywhere); submission
+shells out to ``sbatch`` when present.
+
+    python -m areal_tpu.launcher.slurm examples/gsm8k_grpo.py \
+        --config cfg.yaml [k=v ...]
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+
+from areal_tpu.api.alloc_mode import AllocationMode
+from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("launcher.slurm")
+
+
+def _sbatch_header(
+    job_name: str,
+    n_tasks: int,
+    cfg,
+    log_path: str,
+    extra: list[str] | None = None,
+) -> list[str]:
+    lines = [
+        "#!/bin/bash",
+        f"#SBATCH --job-name={job_name}",
+        f"#SBATCH --ntasks={n_tasks}",
+        "#SBATCH --ntasks-per-node=1",
+        f"#SBATCH --cpus-per-task={cfg.launcher.trainer_cpus_per_chip * cfg.cluster.n_chips_per_host}",
+        f"#SBATCH --mem={cfg.launcher.trainer_mem_per_chip * cfg.cluster.n_chips_per_host}M",
+        f"#SBATCH --output={log_path}",
+        "#SBATCH --open-mode=append",
+    ]
+    lines.extend(extra or [])
+    return lines
+
+
+def render_server_script(cfg, config_path: str, overrides: list[str]) -> str:
+    """One srun task per inference server replica; each registers its
+    address in name_resolve (launcher/tpu_server.py does that natively)."""
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    n_servers = alloc.gen.dp if alloc.gen else 1
+    log_dir = os.path.join(
+        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
+    )
+    args = " ".join(shlex.quote(o) for o in overrides)
+    lines = _sbatch_header(
+        f"{cfg.experiment_name}-{cfg.trial_name}-gen",
+        n_servers,
+        cfg,
+        os.path.join(log_dir, "gen-%t.log"),
+    )
+    lines += [
+        "",
+        "srun --kill-on-bad-exit=1 bash -c '",
+        f"  exec {sys.executable} -m areal_tpu.launcher.tpu_server "
+        f"--config {shlex.quote(config_path)} {args}",
+        "'",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def render_trainer_script(
+    cfg, entry: str, config_path: str, overrides: list[str]
+) -> str:
+    """N trainer tasks wired into one jax.distributed mesh: task 0's host is
+    the coordinator; SLURM_PROCID maps to AREAL_PROCESS_ID."""
+    n = max(cfg.launcher.trainer_processes, 1)
+    log_dir = os.path.join(
+        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
+    )
+    args = " ".join(shlex.quote(o) for o in overrides)
+    lines = _sbatch_header(
+        f"{cfg.experiment_name}-{cfg.trial_name}-trainer",
+        n,
+        cfg,
+        os.path.join(log_dir, "trainer-%t.log"),
+    )
+    lines += [
+        "",
+        # first node in the allocation hosts the jax.distributed service
+        'COORD_HOST=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)',
+        "export AREAL_COORDINATOR_ADDR=${COORD_HOST}:47801",
+        f"export AREAL_NUM_PROCESSES={n}",
+        "srun --kill-on-bad-exit=1 bash -c '",
+        "  export AREAL_PROCESS_ID=$SLURM_PROCID",
+        f"  exec {sys.executable} {shlex.quote(entry)} "
+        f"--config {shlex.quote(config_path)} {args}",
+        "'",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def write_scripts(cfg, entry: str, config_path: str, overrides: list[str]) -> tuple[str, str]:
+    out_dir = os.path.join(
+        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "slurm"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    os.makedirs(
+        os.path.join(
+            cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
+        ),
+        exist_ok=True,
+    )
+    gen = os.path.join(out_dir, "gen.sbatch")
+    trainer = os.path.join(out_dir, "trainer.sbatch")
+    with open(gen, "w") as f:
+        f.write(render_server_script(cfg, config_path, overrides))
+    with open(trainer, "w") as f:
+        f.write(render_trainer_script(cfg, entry, config_path, overrides))
+    return gen, trainer
+
+
+def sbatch(script: str, dependency: str | None = None) -> str:
+    """Submit; returns the job id. Requires sbatch on PATH."""
+    cmd = ["sbatch", "--parsable"]
+    if dependency:
+        cmd.append(f"--dependency={dependency}")
+    cmd.append(script)
+    out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    return out.stdout.strip().split(";")[0]
+
+
+def main(argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        raise SystemExit(
+            "usage: python -m areal_tpu.launcher.slurm entry.py "
+            "--config cfg.yaml [k=v ...]"
+        )
+    entry, config_argv = argv[0], argv[1:]
+    cfg, config_path = load_expr_config(config_argv, GRPOConfig)
+    overrides = [a for a in config_argv if "=" in a and not a.startswith("--")]
+    gen, trainer = write_scripts(cfg, entry, config_path, overrides)
+    gen_id = sbatch(gen)
+    logger.info("submitted generation servers: job %s", gen_id)
+    trainer_id = sbatch(trainer)  # discovery blocks on name_resolve, not slurm
+    logger.info("submitted trainer: job %s", trainer_id)
+    print(gen_id, trainer_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,10 @@ def init_lora_params(
     dtype=jnp.float32,
 ) -> Params:
     """A per target: scaled normal; B: zeros (adapter starts as identity)."""
+    if lora_cfg.dropout:
+        raise NotImplementedError(
+            "LoRA dropout is not implemented; set lora.dropout=0"
+        )
     if model_cfg.is_moe and any(
         t in ("gate_proj", "up_proj", "down_proj")
         for t in lora_cfg.target_modules

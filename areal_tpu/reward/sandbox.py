@@ -1,0 +1,101 @@
+"""Sandboxed execution of untrusted model-emitted code.
+
+The reference offloads code verification to a remote FaaS sandbox
+(functioncall/base/call.py + code/verify.py, with code/local_verify.py as
+the in-repo fallback). TPU pods run zero-egress, so the local sandbox IS the
+production path here: each snippet executes in a fresh ``python -I``
+subprocess with hard resource limits (CPU seconds, address space, file
+size, descriptors), an empty environment, and a throwaway working
+directory. This is os-level isolation, not a jail — pair with container
+sandboxing for adversarial workloads.
+
+``code_verify_reward`` mirrors functioncall/code/verify.py's testcase
+semantics: extract the completion's final code block, run it against each
+(stdin -> expected stdout) case, reward = fraction passed (1.0 = all).
+"""
+
+from __future__ import annotations
+
+import re
+import resource
+import subprocess
+import sys
+import tempfile
+
+_CODE_BLOCK = re.compile(r"```(?:python|py)?\s*\n(.*?)```", re.S)
+
+
+def _limits(memory_mb: int, cpu_seconds: int):
+    def apply():
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu_seconds, cpu_seconds + 1))
+        mem = memory_mb * 1024 * 1024
+        resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
+        resource.setrlimit(resource.RLIMIT_FSIZE, (1 << 20, 1 << 20))
+        resource.setrlimit(resource.RLIMIT_NOFILE, (32, 32))
+        resource.setrlimit(resource.RLIMIT_NPROC, (16, 16))
+
+    return apply
+
+
+def run_sandboxed(
+    code: str,
+    stdin: str | None = None,
+    timeout: float = 10.0,
+    memory_mb: int = 512,
+    cpu_seconds: int | None = None,
+) -> tuple[str, bool]:
+    """Execute ``code`` in an isolated python subprocess.
+
+    Returns (stdout+stderr tail, succeeded). Wall timeout kills the process;
+    rlimits bound CPU/memory/files inside it.
+    """
+    cpu_seconds = cpu_seconds or max(int(timeout), 1)
+    with tempfile.TemporaryDirectory() as cwd:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-I", "-c", code],
+                input=(stdin or "").encode(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                timeout=timeout,
+                cwd=cwd,
+                env={"PATH": ""},
+                preexec_fn=_limits(memory_mb, cpu_seconds),
+            )
+        except subprocess.TimeoutExpired:
+            return "execution timed out", False
+        except Exception as e:  # spawn failure
+            return f"sandbox error: {e}", False
+    text = proc.stdout.decode(errors="replace")[-4000:]
+    return text, proc.returncode == 0
+
+
+def extract_code(completion: str) -> str | None:
+    """Last fenced code block in the completion (reference convention)."""
+    blocks = _CODE_BLOCK.findall(completion or "")
+    return blocks[-1] if blocks else None
+
+
+def code_verify_reward(
+    prompt: str | None,
+    completion: str | None,
+    prompt_ids=None,
+    completion_ids=None,
+    testcases: list[dict] | None = None,
+    timeout: float = 10.0,
+    **_kw,
+) -> float:
+    """Reward = fraction of (stdin -> expected stdout) testcases passed by
+    the completion's final code block (functioncall/code/verify.py role;
+    run it through AsyncRewardWrapper like every reward fn)."""
+    code = extract_code(completion or "")
+    if code is None or not testcases:
+        return 0.0
+    passed = 0
+    for case in testcases:
+        out, ok = run_sandboxed(
+            code, stdin=case.get("stdin", ""), timeout=timeout
+        )
+        if ok and out.strip() == str(case.get("expected_stdout", "")).strip():
+            passed += 1
+    return passed / len(testcases)

@@ -1,0 +1,171 @@
+"""Engine-over-HTTP RPC: the single-controller ("controller mode") transport.
+
+Parity with the reference's WIP RPC scheduler
+(areal/scheduler/rpc/rpc_server.py:149, rpc_client.py:137): a worker
+process hosts a train engine and exposes its methods by name over HTTP; the
+controller drives many such workers, sharding batches with
+``DistributedBatchMemory``. Tensor arguments travel as an npz payload
+(dense, lossless, stdlib-serializable); scalar/string kwargs as JSON
+headers. Methods are whitelisted — this is a trusted-cluster control plane,
+not a public API.
+
+    server: EngineRPCServer(engine).start(host, port)   # aiohttp, own loop
+    client: EngineRPCClient(addr).call("train_lm", batch) -> stats dict
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import threading
+from typing import Any
+
+import numpy as np
+from aiohttp import web
+
+from areal_tpu.utils import logging
+from areal_tpu.utils.http import arequest_with_retry
+
+logger = logging.getLogger("EngineRPC")
+
+_ALLOWED = (
+    "train_lm",
+    "evaluate_lm",
+    "train_batch_named",
+    "get_version",
+    "set_version",
+    "save",
+    "load",
+    "update_weights",
+    "step_lr_scheduler",
+)
+
+
+def _pack(data: dict[str, Any]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in data.items()})
+    return buf.getvalue()
+
+
+def _unpack(raw: bytes) -> dict[str, np.ndarray]:
+    if not raw:
+        return {}
+    with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+class EngineRPCServer:
+    def __init__(self, engine):
+        self.engine = engine
+        self.app = web.Application(client_max_size=1024 * 1024**2)
+        self.app.add_routes(
+            [
+                web.get("/health", self._health),
+                web.post("/call/{method}", self._call),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _call(self, request: web.Request) -> web.Response:
+        method = request.match_info["method"]
+        if method not in _ALLOWED:
+            return web.json_response(
+                {"error": f"method {method!r} not allowed"}, status=400
+            )
+        kwargs = json.loads(request.headers.get("X-RPC-Kwargs", "{}"))
+        tensors = _unpack(await request.read())
+        fn = getattr(self.engine, method, None)
+        if fn is None:
+            return web.json_response(
+                {"error": f"engine has no method {method}"}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            if tensors:
+                result = await loop.run_in_executor(
+                    None, lambda: fn(tensors, **kwargs)
+                )
+            else:
+                result = await loop.run_in_executor(None, lambda: fn(**kwargs))
+        except Exception as e:
+            logger.exception("rpc %s failed", method)
+            return web.json_response({"error": str(e)}, status=500)
+        if isinstance(result, dict) and any(
+            isinstance(v, np.ndarray) for v in result.values()
+        ):
+            return web.Response(
+                body=_pack(result),
+                content_type="application/octet-stream",
+            )
+        return web.json_response({"result": result})
+
+    def start_threaded(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Run the server on its own event-loop thread; returns the port."""
+        self._loop = asyncio.new_event_loop()
+        t = threading.Thread(target=self._loop.run_forever, daemon=True)
+        t.start()
+
+        async def _start():
+            self._runner = web.AppRunner(self.app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, host, port)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+
+        return asyncio.run_coroutine_threadsafe(_start(), self._loop).result(30)
+
+    def stop(self):
+        if self._runner is not None and self._loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._runner.cleanup(), self._loop
+            ).result(15)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class EngineRPCClient:
+    def __init__(self, addr: str, timeout: float = 3600.0, retries: int = 2):
+        self.addr = addr
+        self.timeout = timeout
+        self.retries = retries
+
+    def call(self, method: str, tensors: dict | None = None, **kwargs):
+        import aiohttp
+
+        async def _go():
+            session = aiohttp.ClientSession()
+            try:
+                headers = {"X-RPC-Kwargs": json.dumps(kwargs)} if kwargs else {}
+                async with session.post(
+                    f"http://{self.addr}/call/{method}",
+                    data=_pack(tensors) if tensors else b"",
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=self.timeout),
+                ) as resp:
+                    body = await resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"rpc {method} -> {resp.status}: {body[:500]!r}"
+                        )
+                    if resp.content_type == "application/octet-stream":
+                        return _unpack(body)
+                    return json.loads(body).get("result")
+            finally:
+                await session.close()
+
+        return asyncio.run(_go())
+
+    def health(self) -> bool:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://{self.addr}/health", timeout=5
+            ) as r:
+                return r.status == 200
+        except Exception:
+            return False

@@ -1,0 +1,151 @@
+"""Checkpoint-watching automatic evaluator.
+
+The reference's AutomaticEvaluator (realhf/scheduler/evaluator.py, 348 LoC)
+watches the checkpoint directory, launches one offline-eval job per saved
+step, and pushes results to wandb. Same design here: poll the saver's output
+root for new ``globalstepN`` checkpoints, run a configurable eval command
+per checkpoint ({ckpt}/{step} substituted — by default the in-repo offline
+eval harness, eval/offline.py), and append results to ``eval_results.jsonl``
+under the trial log dir. Runs standalone:
+
+    python -m areal_tpu.utils.auto_evaluator --watch <saves_dir> \
+        --cmd "python -m areal_tpu.eval.offline --ckpt {ckpt} ..." --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import time
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("AutoEvaluator")
+
+_STEP = re.compile(r"globalstep(\d+)$")
+
+
+class AutomaticEvaluator:
+    def __init__(
+        self,
+        watch_dir: str,
+        cmd_template: str,
+        output_path: str | None = None,
+        poll_interval: float = 10.0,
+        timeout: float = 3600.0,
+    ):
+        self.watch_dir = watch_dir
+        self.cmd_template = cmd_template
+        self.output_path = output_path or os.path.join(
+            watch_dir, "eval_results.jsonl"
+        )
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+        self._done: set[str] = set()
+        self._load_done()
+
+    def _load_done(self):
+        """Resume: don't re-evaluate checkpoints already in the results."""
+        if not os.path.isfile(self.output_path):
+            return
+        with open(self.output_path) as f:
+            for line in f:
+                try:
+                    self._done.add(json.loads(line)["ckpt"])
+                except Exception:
+                    continue
+
+    def pending_checkpoints(self) -> list[tuple[int, str]]:
+        if not os.path.isdir(self.watch_dir):
+            return []
+        out = []
+        for name in os.listdir(self.watch_dir):
+            path = os.path.join(self.watch_dir, name)
+            m = _STEP.search(name)
+            if m is None or not os.path.isdir(path) or path in self._done:
+                continue
+            # only evaluate checkpoints whose write completed
+            if not any(
+                os.path.isfile(os.path.join(path, f))
+                for f in ("model.safetensors", "config.json")
+            ):
+                continue
+            out.append((int(m.group(1)), path))
+        return sorted(out)
+
+    def evaluate_one(self, step: int, ckpt: str) -> dict:
+        # literal replacement, not str.format: eval commands legitimately
+        # contain braces (inline JSON, jq, shell expansions)
+        cmd = self.cmd_template.replace("{ckpt}", ckpt).replace(
+            "{step}", str(step)
+        )
+        logger.info("evaluating step %d: %s", step, cmd)
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                cmd, shell=True, capture_output=True, text=True,
+                timeout=self.timeout,
+            )
+            ok = proc.returncode == 0
+            # convention: the eval command prints ONE json line last
+            result = None
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    result = json.loads(line)
+                    break
+                except Exception:
+                    continue
+        except subprocess.TimeoutExpired:
+            ok, result = False, None
+        rec = {
+            "ckpt": ckpt,
+            "global_step": step,
+            "ok": ok,
+            "result": result,
+            "eval_secs": round(time.monotonic() - t0, 2),
+        }
+        os.makedirs(os.path.dirname(self.output_path), exist_ok=True)
+        with open(self.output_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._done.add(ckpt)
+        return rec
+
+    def step(self) -> int:
+        """Evaluate everything currently pending; returns count evaluated."""
+        n = 0
+        for step, ckpt in self.pending_checkpoints():
+            self.evaluate_one(step, ckpt)
+            n += 1
+        return n
+
+    def run_forever(self, stop_after: float | None = None):
+        t0 = time.monotonic()
+        while True:
+            self.step()
+            if stop_after is not None and time.monotonic() - t0 > stop_after:
+                return
+            time.sleep(self.poll_interval)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watch", required=True)
+    ap.add_argument("--cmd", required=True)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--interval", type=float, default=10.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args(argv)
+    ev = AutomaticEvaluator(
+        args.watch, args.cmd, output_path=args.out, poll_interval=args.interval
+    )
+    if args.once:
+        ev.step()
+    else:
+        ev.run_forever()
+
+
+if __name__ == "__main__":
+    main()

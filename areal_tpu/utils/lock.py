@@ -1,0 +1,91 @@
+"""Distributed lock over the name-resolve KV.
+
+The reference builds DistributedLock on the torch c10d TCPStore with an
+atomic add-counter + owner-token validation + exponential backoff
+(areal/utils/lock.py:9-60, exercised by tests/torchrun/run_lock.py). Here
+the same contract rides the name-resolve repository's atomic
+exclusive-create ``add(replace=False)`` (dict setdefault for memory, O_EXCL
+file create for NFS): whoever creates the key owns the lock; release
+validates the owner token before deleting; a TTL lets a crashed owner's
+lock be broken.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from areal_tpu.utils import logging, name_resolve
+
+logger = logging.getLogger("DistributedLock")
+
+
+class DistributedLock:
+    def __init__(
+        self,
+        name: str,
+        ttl: float = 120.0,
+        poll_interval: float = 0.05,
+        max_poll_interval: float = 1.0,
+    ):
+        self.key = f"locks/{name.strip('/')}"
+        self.ttl = ttl
+        self.poll_interval = poll_interval
+        self.max_poll_interval = max_poll_interval
+        self.token = uuid.uuid4().hex
+        self._held = False
+
+    def _try_acquire(self) -> bool:
+        try:
+            name_resolve.add(
+                self.key, f"{self.token}:{time.time()}", replace=False
+            )
+            return True
+        except name_resolve.NameEntryExistsError:
+            return False
+
+    def _break_if_expired(self):
+        try:
+            value = name_resolve.get(self.key)
+            _tok, ts = value.rsplit(":", 1)
+            if time.time() - float(ts) > self.ttl:
+                logger.warning("breaking expired lock %s", self.key)
+                name_resolve.delete(self.key)
+        except Exception:
+            pass  # raced with the owner's release — fine
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        interval = self.poll_interval
+        while True:
+            if self._try_acquire():
+                self._held = True
+                return True
+            self._break_if_expired()
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(interval)
+            interval = min(interval * 2, self.max_poll_interval)  # backoff
+
+    def release(self):
+        if not self._held:
+            return
+        try:
+            value = name_resolve.get(self.key)
+            if value.rsplit(":", 1)[0] == self.token:  # owner validation
+                name_resolve.delete(self.key)
+            else:
+                logger.warning(
+                    "lock %s no longer owned by this holder", self.key
+                )
+        except Exception:
+            pass
+        self._held = False
+
+    def __enter__(self):
+        if not self.acquire():
+            raise TimeoutError(f"could not acquire {self.key}")
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

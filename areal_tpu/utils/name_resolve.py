@@ -7,6 +7,9 @@ build keeps the same abstraction; backends here are:
 - ``MemoryNameRecordRepository`` — in-process dict (unit tests, single proc).
 - ``NfsNameRecordRepository`` — files on a shared filesystem (multi-host without
   extra services; works on any POSIX shared mount, e.g. GCS-fuse on TPU pods).
+- ``EtcdNameRecordRepository`` — etcd v3 over its HTTP/JSON gateway (stdlib
+  urllib only; the reference's Etcd3NameRecordRepository role for clusters
+  with a real coordination service).
 
 Keys are slash-separated paths; values are strings. ``add(..., delete_on_exit)``
 records keys for atexit cleanup, matching the reference semantics.
@@ -170,13 +173,22 @@ class NfsNameRecordRepository(NameRecordRepository):
 
     def add(self, name, value, delete_on_exit=True, replace=False):
         path = self._path(name)
-        if os.path.exists(path) and not replace:
-            raise NameEntryExistsError(name)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
-        with open(tmp, "w") as f:
-            f.write(str(value))
-        os.replace(tmp, path)
+        if replace:
+            tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+            with open(tmp, "w") as f:
+                f.write(str(value))
+            os.replace(tmp, path)
+        else:
+            # atomic exclusive create: the existence check + write must be
+            # one op or two processes can both think they won (the
+            # DistributedLock acquire path rides this)
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                raise NameEntryExistsError(name) from None
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
         if delete_on_exit:
             self._to_delete.add(name)
 
@@ -222,12 +234,140 @@ class NfsNameRecordRepository(NameRecordRepository):
                 pass
 
 
+class EtcdNameRecordRepository(NameRecordRepository):
+    """etcd v3 via the HTTP/JSON grpc-gateway (/v3/kv/*): no client library
+    needed in the image. Values and keys are base64 per the gateway wire
+    format. Exclusive create uses an etcd txn on create_revision=0 — atomic
+    cluster-wide, so DistributedLock works across hosts."""
+
+    def __init__(self, endpoint: str):
+        import base64 as _b64  # noqa: F401
+
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.startswith("http"):
+            self.endpoint = "http://" + self.endpoint
+        self._to_delete: set[str] = set()
+        atexit.register(self._cleanup)
+
+    @staticmethod
+    def _b64(s: str) -> str:
+        import base64
+
+        return base64.b64encode(s.encode()).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> str:
+        import base64
+
+        return base64.b64decode(s.encode()).decode()
+
+    def _call(self, path: str, payload: dict) -> dict:
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.endpoint}/v3/kv/{path}",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def add(self, name, value, delete_on_exit=True, replace=False):
+        name = name.rstrip("/")
+        if replace:
+            self._call("put", {"key": self._b64(name), "value": self._b64(str(value))})
+        else:
+            # txn: put only if the key was never created (atomic)
+            out = self._call(
+                "txn",
+                {
+                    "compare": [
+                        {
+                            "key": self._b64(name),
+                            "target": "CREATE",
+                            "create_revision": "0",
+                        }
+                    ],
+                    "success": [
+                        {
+                            "request_put": {
+                                "key": self._b64(name),
+                                "value": self._b64(str(value)),
+                            }
+                        }
+                    ],
+                },
+            )
+            if not out.get("succeeded", False):
+                raise NameEntryExistsError(name)
+        if delete_on_exit:
+            self._to_delete.add(name)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        out = self._call("range", {"key": self._b64(name)})
+        kvs = out.get("kvs") or []
+        if not kvs:
+            raise NameEntryNotFoundError(name)
+        return self._unb64(kvs[0]["value"])
+
+    def _range_prefix(self, prefix: str) -> list[tuple[str, str]]:
+        start = prefix.rstrip("/") + "/"
+        end = start[:-1] + chr(ord("/") + 1)
+        out = self._call(
+            "range", {"key": self._b64(start), "range_end": self._b64(end)}
+        )
+        return [
+            (self._unb64(kv["key"]), self._unb64(kv["value"]))
+            for kv in out.get("kvs") or []
+        ]
+
+    def get_subtree(self, name_root):
+        vals = [v for _k, v in self._range_prefix(name_root)]
+        try:
+            vals.insert(0, self.get(name_root))
+        except NameEntryNotFoundError:
+            pass
+        return vals
+
+    def find_subtree(self, name_root):
+        keys = [k for k, _v in self._range_prefix(name_root)]
+        try:
+            self.get(name_root)
+            keys.insert(0, name_root.rstrip("/"))
+        except NameEntryNotFoundError:
+            pass
+        return sorted(keys)
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        self._call("deleterange", {"key": self._b64(name)})
+        self._to_delete.discard(name)
+
+    def clear_subtree(self, name_root):
+        start = name_root.rstrip("/")
+        end = start + chr(ord("/") + 1)
+        self._call(
+            "deleterange",
+            {"key": self._b64(start), "range_end": self._b64(end)},
+        )
+
+    def _cleanup(self):
+        for name in list(self._to_delete):
+            try:
+                self.delete(name)
+            except Exception:
+                pass
+
+
 @dataclasses.dataclass
 class NameResolveConfig:
     """Mirrors the reference's NameResolveConfig (areal/api/cli_args.py:964)."""
 
-    type: str = "nfs"  # "memory" | "nfs"
+    type: str = "nfs"  # "memory" | "nfs" | "etcd"
     nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+    etcd_endpoint: str = "127.0.0.1:2379"
 
 
 DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
@@ -239,6 +379,8 @@ def reconfigure(config: NameResolveConfig) -> NameRecordRepository:
         DEFAULT_REPOSITORY = MemoryNameRecordRepository()
     elif config.type == "nfs":
         DEFAULT_REPOSITORY = NfsNameRecordRepository(config.nfs_record_root)
+    elif config.type == "etcd":
+        DEFAULT_REPOSITORY = EtcdNameRecordRepository(config.etcd_endpoint)
     else:
         raise ValueError(f"Unknown name_resolve type: {config.type}")
     return DEFAULT_REPOSITORY

@@ -1,0 +1,69 @@
+"""jax.profiler capture around training phases.
+
+The TPU counterpart of the reference's torch-profiler kernel-time
+attribution (realhf/base/monitor.py:404-610): instead of parsing CUDA
+kernel categories out of chrome traces, capture a windowed
+``jax.profiler.trace`` (viewable in TensorBoard / Perfetto, with XLA op
+and fusion attribution built in) for a configured span of steps.
+
+Analytic FLOPs/MFU counters live in utils/perf.py (monitor.py:288-403
+equivalent) and are always on; trace capture is opt-in via ProfilerConfig.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("profiling")
+
+
+class StepProfiler:
+    """Capture a jax.profiler trace for steps [start_step, start_step+num_steps).
+
+    Usage (train loop):
+        profiler = StepProfiler(cfg.profiler)
+        for step in ...:
+            with profiler.step(step):
+                ...train...
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._active = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.config is not None and getattr(self.config, "enabled", False)
+
+    @contextlib.contextmanager
+    def step(self, global_step: int):
+        if not self.enabled:
+            yield
+            return
+        import jax
+
+        cfg = self.config
+        start = cfg.start_step
+        stop = cfg.start_step + cfg.num_steps
+        if global_step == start and not self._active:
+            os.makedirs(cfg.dir, exist_ok=True)
+            jax.profiler.start_trace(cfg.dir)
+            self._active = True
+            logger.info("profiler trace started -> %s", cfg.dir)
+        try:
+            yield
+        finally:
+            if self._active and global_step + 1 >= stop:
+                jax.profiler.stop_trace()
+                self._active = False
+                logger.info("profiler trace stopped (step %d)", global_step)
+
+    def close(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

@@ -59,6 +59,12 @@ class RLVRWorkflow(RolloutWorkflow):
         """-> (input_ids, extra ModelRequest kwargs, extra sample arrays)."""
         return self._tokenize_prompt(data), {}, {}
 
+    def _reward_prompt_ids(self, data: dict[str, Any], input_ids: list[int]):
+        """Tokens decoded into the reward/dump prompt string (subclasses
+        with non-text prompt tokens override — placeholders would decode
+        to garbage)."""
+        return input_ids
+
     def _tokenize_prompt(self, data: dict[str, Any]) -> list[int]:
         if "input_ids" in data:
             return list(data["input_ids"])
@@ -88,7 +94,11 @@ class RLVRWorkflow(RolloutWorkflow):
                 for _ in range(n)
             ]
         )
-        prompt_str = self.tokenizer.decode(input_ids) if self.tokenizer else None
+        prompt_str = (
+            self.tokenizer.decode(self._reward_prompt_ids(data, input_ids))
+            if self.tokenizer
+            else None
+        )
         extra = {
             k: v for k, v in data.items() if k not in self._extra_exclude
         }

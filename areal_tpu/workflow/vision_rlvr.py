@@ -59,3 +59,7 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         req_kwargs = {"image_data": [pixels[i] for i in range(pixels.shape[0])]}
         sample_extras = {"pixel_values": pixels[None]}  # [1, N_img, S, S, 3]
         return input_ids, req_kwargs, sample_extras
+
+    def _reward_prompt_ids(self, data, input_ids):
+        # decode only the text prompt; image placeholders aren't language
+        return self._tokenize_prompt(data)

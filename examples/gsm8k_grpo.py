@@ -39,6 +39,7 @@ from areal_tpu.engine.train_engine import TPUTrainEngine  # noqa: E402
 from areal_tpu.reward import math_verify_reward  # noqa: E402
 from areal_tpu.utils import logging, stats_tracker  # noqa: E402
 from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.profiling import StepProfiler  # noqa: E402
 from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
 from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
@@ -138,66 +139,76 @@ def main(argv=None):
             start_step = info.last_step_info.global_step + 1
             actor.update_weights(weight_meta)  # re-push recovered weights
 
+    profiler = StepProfiler(cfg.profiler)
     all_rewards = []
-    for global_step in range(start_step, total_steps):
-        step_info = StepInfo(
-            epoch=global_step // ft_spec.steps_per_epoch,
-            epoch_step=global_step % ft_spec.steps_per_epoch,
-            global_step=global_step,
-            steps_per_epoch=ft_spec.steps_per_epoch,
-        )
-
-        with stats_tracker.record_timing("rollout"):
-            if cfg.async_training:
-                batch = rollout.prepare_batch(dataloader, workflow=workflow)
-            else:
-                batch = rollout.rollout_batch(
-                    next(iter(dataloader)), workflow=workflow
-                )
-
-        if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
-            with stats_tracker.record_timing("recompute_logp"):
-                batch["prox_logp"] = actor.actor.compute_logp(batch)
-
-        if ref is not None:
-            with stats_tracker.record_timing("ref_logp"):
-                batch["ref_logp"] = ref.compute_logp(batch)
-
-        with stats_tracker.record_timing("compute_advantage"):
-            actor.actor.compute_advantages(batch)
-
-        with stats_tracker.record_timing("train_step"):
-            stats = actor.actor.ppo_update(batch)
-            actor.step_lr_scheduler()
-
-        with stats_tracker.record_timing("update_weights"):
-            rollout.pause()
-            actor.update_weights(weight_meta)
-            rollout.resume()
-
-        with stats_tracker.record_timing("save"):
-            saver.save(actor, step_info, tokenizer=tokenizer)
-            recover_handler.dump(
-                actor,
-                step_info,
-                saver,
-                evaluator,
-                dataloader,
-                stats_logger,
-                fileroot=cfg.cluster.fileroot,
-                experiment_name=cfg.experiment_name,
-                trial_name=cfg.trial_name,
-                tokenizer=tokenizer,
-                config=cfg,
+    try:
+        for global_step in range(start_step, total_steps):
+            step_info = StepInfo(
+                epoch=global_step // ft_spec.steps_per_epoch,
+                epoch_step=global_step % ft_spec.steps_per_epoch,
+                global_step=global_step,
+                steps_per_epoch=ft_spec.steps_per_epoch,
             )
 
-        mean_reward = float(np.mean(np.asarray(batch["rewards"])))
-        all_rewards.append(mean_reward)
-        stats[0].update(stats_tracker.export(key="time_perf"))
-        stats[0]["grpo/mean_task_reward"] = mean_reward
-        stats_logger.commit(
-            step_info.epoch, step_info.epoch_step, global_step, stats
-        )
+            profiler_cm = profiler.step(global_step)
+            profiler_cm.__enter__()
+            # profiler.close() in the finally below finalizes the trace if any
+            # step raises mid-window
+            with stats_tracker.record_timing("rollout"):
+                if cfg.async_training:
+                    batch = rollout.prepare_batch(dataloader, workflow=workflow)
+                else:
+                    batch = rollout.rollout_batch(
+                        next(iter(dataloader)), workflow=workflow
+                    )
+
+            if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
+                with stats_tracker.record_timing("recompute_logp"):
+                    batch["prox_logp"] = actor.actor.compute_logp(batch)
+
+            if ref is not None:
+                with stats_tracker.record_timing("ref_logp"):
+                    batch["ref_logp"] = ref.compute_logp(batch)
+
+            with stats_tracker.record_timing("compute_advantage"):
+                actor.actor.compute_advantages(batch)
+
+            with stats_tracker.record_timing("train_step"):
+                stats = actor.actor.ppo_update(batch)
+                actor.step_lr_scheduler()
+
+            with stats_tracker.record_timing("update_weights"):
+                rollout.pause()
+                actor.update_weights(weight_meta)
+                rollout.resume()
+
+            with stats_tracker.record_timing("save"):
+                saver.save(actor, step_info, tokenizer=tokenizer)
+                recover_handler.dump(
+                    actor,
+                    step_info,
+                    saver,
+                    evaluator,
+                    dataloader,
+                    stats_logger,
+                    fileroot=cfg.cluster.fileroot,
+                    experiment_name=cfg.experiment_name,
+                    trial_name=cfg.trial_name,
+                    tokenizer=tokenizer,
+                    config=cfg,
+                )
+
+            profiler_cm.__exit__(None, None, None)
+            mean_reward = float(np.mean(np.asarray(batch["rewards"])))
+            all_rewards.append(mean_reward)
+            stats[0].update(stats_tracker.export(key="time_perf"))
+            stats[0]["grpo/mean_task_reward"] = mean_reward
+            stats_logger.commit(
+                step_info.epoch, step_info.epoch_step, global_step, stats
+            )
+    finally:
+        # finalize any in-flight profiler trace even when a step dies
+        profiler.close()
 
     # artifact the e2e test asserts on (reference tests/grpo pattern)
     out = os.path.join(stats_logger.log_dir(), "rewards.json")

@@ -27,6 +27,7 @@ from areal_tpu.engine.sft.lm_engine import TPULMEngine  # noqa: E402
 from areal_tpu.utils import logging, stats_tracker  # noqa: E402
 from areal_tpu.utils.data import pad_sequences_to_tensors  # noqa: E402
 from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.profiling import StepProfiler  # noqa: E402
 from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
 from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
@@ -108,6 +109,7 @@ def main(argv=None):
 
     data_iter = iter(dataloader)
     losses = []
+    profiler = StepProfiler(cfg.profiler)
     for global_step in range(start_step, total_steps):
         step_info = StepInfo(
             epoch=global_step // ft_spec.steps_per_epoch,
@@ -121,7 +123,9 @@ def main(argv=None):
             data_iter = iter(dataloader)
             batch = next(data_iter)
 
-        with stats_tracker.record_timing("train_step"):
+        with profiler.step(global_step), stats_tracker.record_timing(
+            "train_step"
+        ):
             stats = engine.train_lm(batch)
             engine.step_lr_scheduler()
         losses.append(stats["loss"])

@@ -1,14 +1,15 @@
 """Python-executor tool environment (reference: examples/tir/tool_manager.py
-capability): runs model-emitted python snippets in a subprocess with a
-timeout and returns stdout as the observation."""
+capability): runs model-emitted python snippets through the sandboxed
+executor (areal_tpu/reward/sandbox.py — rlimits on CPU/memory/files, empty
+env, throwaway cwd) and returns stdout as the observation."""
 
 from __future__ import annotations
 
 import asyncio
-import sys
 from typing import Any
 
 from areal_tpu.api.env_api import Environment
+from areal_tpu.reward.sandbox import run_sandboxed
 
 
 class PythonToolEnv(Environment):
@@ -37,20 +38,8 @@ class PythonToolEnv(Environment):
         if tool_name != "python":
             return f"unknown tool {tool_name}", False
         code = arguments.get("code", "")
-        proc = await asyncio.create_subprocess_exec(
-            sys.executable,
-            "-I",  # isolated mode: no site, no user paths
-            "-c",
-            code,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.STDOUT,
+        loop = asyncio.get_running_loop()
+        out, ok = await loop.run_in_executor(
+            None, lambda: run_sandboxed(code, timeout=timeout or self.timeout)
         )
-        try:
-            out, _ = await asyncio.wait_for(
-                proc.communicate(), timeout or self.timeout
-            )
-        except asyncio.TimeoutError:
-            proc.kill()
-            return "execution timed out", False
-        text = out.decode(errors="replace")[-2000:]
-        return text, proc.returncode == 0
+        return out[-2000:], ok

@@ -24,6 +24,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Suite budget (reference test strategy, SURVEY §4): the default selection
+# should stay fast enough that people actually run it. Long-running tests
+# (multi-process, e2e launchers, heavy numerics) carry @pytest.mark.slow —
+# run the quick set with:  pytest -m "not slow" -q
+
 
 @pytest.fixture(autouse=True)
 def _fresh_name_resolve():

@@ -1,0 +1,110 @@
+"""Aux subsystems: sandboxed reward execution, checkpoint-watching auto
+evaluator, slurm script synthesis (reference: functioncall/,
+realhf/scheduler/evaluator.py, launcher/slurm.py)."""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.reward.sandbox import (
+    code_verify_reward,
+    extract_code,
+    run_sandboxed,
+)
+
+
+def test_sandbox_runs_and_captures_stdout():
+    out, ok = run_sandboxed("print(6 * 7)")
+    assert ok and out.strip() == "42"
+
+
+def test_sandbox_stdin():
+    out, ok = run_sandboxed("import sys; print(sys.stdin.read().upper())", stdin="abc")
+    assert ok and out.strip() == "ABC"
+
+
+def test_sandbox_wall_timeout():
+    out, ok = run_sandboxed("while True: pass", timeout=1.5, cpu_seconds=60)
+    assert not ok and "timed out" in out
+
+
+def test_sandbox_memory_limit():
+    out, ok = run_sandboxed("x = bytearray(10**9); print(len(x))", memory_mb=128)
+    assert not ok
+
+
+def test_sandbox_isolated_env():
+    out, ok = run_sandboxed("import os; print(os.environ.get('HOME'))")
+    assert ok and out.strip() == "None"
+
+
+def test_extract_code_last_block():
+    s = "text\n```python\nprint(1)\n```\nmore\n```py\nprint(2)\n```"
+    assert extract_code(s).strip() == "print(2)"
+
+
+def test_code_verify_reward():
+    completion = "Here:\n```python\nimport sys\nn=int(sys.stdin.read())\nprint(n*2)\n```"
+    cases = [
+        {"stdin": "3", "expected_stdout": "6"},
+        {"stdin": "5", "expected_stdout": "10"},
+        {"stdin": "5", "expected_stdout": "11"},  # wrong on purpose
+    ]
+    r = code_verify_reward(None, completion, testcases=cases)
+    assert abs(r - 2 / 3) < 1e-9
+    assert code_verify_reward(None, "no code here", testcases=cases) == 0.0
+
+
+def test_auto_evaluator_watches_and_records(tmp_path):
+    from areal_tpu.utils.auto_evaluator import AutomaticEvaluator
+
+    saves = tmp_path / "saves"
+    for step in (2, 5):
+        d = saves / f"epoch0epochstep{step}globalstep{step}"
+        d.mkdir(parents=True)
+        (d / "config.json").write_text("{}")
+    (saves / "not_a_ckpt").mkdir()
+
+    out = str(tmp_path / "eval_results.jsonl")
+    ev = AutomaticEvaluator(
+        str(saves),
+        cmd_template='echo \'{"score": {step}}\'',
+        output_path=out,
+        timeout=30,
+    )
+    assert ev.step() == 2
+    recs = [json.loads(x) for x in open(out)]
+    assert [r["global_step"] for r in recs] == [2, 5]
+    assert recs[0]["ok"] and recs[0]["result"] == {"score": 2}
+    # resume: nothing new
+    ev2 = AutomaticEvaluator(
+        str(saves), cmd_template="echo x", output_path=out
+    )
+    assert ev2.step() == 0
+
+
+def test_slurm_script_synthesis(tmp_path):
+    from areal_tpu.api.cli_args import GRPOConfig, from_dict
+    from areal_tpu.launcher.slurm import write_scripts
+
+    cfg = from_dict(
+        GRPOConfig,
+        {
+            "experiment_name": "e",
+            "trial_name": "t",
+            "allocation_mode": "jaxgen:d2+gspmd:d4",
+            "cluster": {"fileroot": str(tmp_path)},
+            "launcher": {"trainer_processes": 4},
+        },
+    )
+    gen, trainer = write_scripts(cfg, "examples/gsm8k_grpo.py", "cfg.yaml", ["a.b=1"])
+    g = open(gen).read()
+    t = open(trainer).read()
+    assert "#SBATCH --ntasks=2" in g  # one per generation server replica
+    assert "areal_tpu.launcher.tpu_server" in g
+    assert "#SBATCH --ntasks=4" in t
+    assert "AREAL_NUM_PROCESSES=4" in t
+    assert "AREAL_PROCESS_ID=$SLURM_PROCID" in t
+    assert "AREAL_COORDINATOR_ADDR" in t
+    assert "a.b=1" in t

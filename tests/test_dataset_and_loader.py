@@ -65,3 +65,26 @@ def test_loader_state_roundtrip(jsonl):
     rest1 = list(it)
     assert [b[0]["messages"] for b in rest2] == [b[0]["messages"] for b in rest1]
     assert len(first) + len(rest1) == 5
+
+
+def test_torl_and_geometry3k_processors(tmp_path):
+    import json
+
+    from areal_tpu.dataset import get_custom_dataset
+
+    torl = tmp_path / "torl"
+    torl.mkdir()
+    (torl / "train.jsonl").write_text(
+        json.dumps({"problem": "1+1?", "gt": "2"}) + "\n"
+    )
+    rows = get_custom_dataset(str(torl), type="rl")
+    assert rows[0]["answer"] == "2"
+
+    g3k = tmp_path / "geometry3k"
+    g3k.mkdir()
+    (g3k / "train.jsonl").write_text(
+        json.dumps({"question": "angle?", "images": ["AAA="], "answer": "90"})
+        + "\n"
+    )
+    rows = get_custom_dataset(str(g3k), type="vlm_rl")
+    assert rows[0]["images"] == ["AAA="] and rows[0]["answer"] == "90"

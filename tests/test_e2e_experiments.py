@@ -37,6 +37,7 @@ def _run(cmd, env_extra, timeout=900):
     )
 
 
+@pytest.mark.slow
 def test_grpo_end_to_end_via_launcher(assets):
     """Launcher spawns the generation server + trainer; two GRPO steps run;
     rewards.json is written; weight updates reach the server each step."""
@@ -123,6 +124,7 @@ recover:
     assert any("time_perf/update_weights" in x for x in lines)
 
 
+@pytest.mark.slow
 def test_sft_end_to_end_loss_decreases(assets):
     root = assets
     fileroot = str(root / "sft_exp")

@@ -63,6 +63,7 @@ def run_request(eng, rid, prompt, gconfig, timeout=120.0):
     return out["r"]
 
 
+@pytest.mark.slow
 def test_greedy_matches_naive_forward(model):
     cfg, params = model
     eng = make_engine(model)
@@ -220,6 +221,7 @@ def test_prompt_too_long_rejected(model):
         eng.stop()
 
 
+@pytest.mark.slow
 def test_sample_tokens_distribution_and_masks():
     rng = jax.random.PRNGKey(0)
     logits = jnp.asarray(np.log([[0.5, 0.3, 0.15, 0.05]]), jnp.float32)

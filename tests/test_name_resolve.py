@@ -67,3 +67,83 @@ def test_wait_concurrent(repo):
     t.start()
     assert repo.wait("late/key", timeout=5) == "yes"
     t.join()
+
+
+def test_nfs_exclusive_create_atomic(tmp_path):
+    """replace=False must be a single atomic op (DistributedLock acquire)."""
+    from areal_tpu.utils.name_resolve import (
+        NameEntryExistsError,
+        NfsNameRecordRepository,
+    )
+
+    repo = NfsNameRecordRepository(str(tmp_path))
+    repo.add("lk", "a", replace=False)
+    with pytest.raises(NameEntryExistsError):
+        repo.add("lk", "b", replace=False)
+    assert repo.get("lk") == "a"
+
+
+def test_distributed_lock_mutual_exclusion(tmp_path):
+    import threading
+
+    from areal_tpu.utils import name_resolve
+    from areal_tpu.utils.lock import DistributedLock
+    from areal_tpu.utils.name_resolve import NameResolveConfig
+
+    name_resolve.reconfigure(
+        NameResolveConfig(type="nfs", nfs_record_root=str(tmp_path))
+    )
+    counter = {"v": 0, "max_in": 0, "in": 0}
+    lk_lock = threading.Lock()
+
+    def work(i):
+        lock = DistributedLock("crit", ttl=30)
+        with lock:
+            with lk_lock:
+                counter["in"] += 1
+                counter["max_in"] = max(counter["max_in"], counter["in"])
+            counter["v"] += 1
+            with lk_lock:
+                counter["in"] -= 1
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert counter["v"] == 6
+    assert counter["max_in"] == 1  # never two holders at once
+
+
+def test_distributed_lock_breaks_expired(tmp_path):
+    from areal_tpu.utils import name_resolve
+    from areal_tpu.utils.lock import DistributedLock
+    from areal_tpu.utils.name_resolve import NameResolveConfig
+
+    name_resolve.reconfigure(
+        NameResolveConfig(type="nfs", nfs_record_root=str(tmp_path))
+    )
+    dead = DistributedLock("stale", ttl=0.1)
+    assert dead.acquire(timeout=1)
+    # owner "crashes" (no release); a new holder breaks the expired lock
+    import time as _t
+
+    _t.sleep(0.2)
+    fresh = DistributedLock("stale", ttl=0.1)
+    assert fresh.acquire(timeout=5)
+    fresh.release()
+
+
+def test_etcd_backend_gated():
+    """Real etcd only: skip unless one is reachable."""
+    import urllib.request
+
+    from areal_tpu.utils.name_resolve import EtcdNameRecordRepository
+
+    repo = EtcdNameRecordRepository("127.0.0.1:2379")
+    try:
+        repo.add("areal-test/x", "1", replace=True)
+    except Exception:
+        pytest.skip("no etcd at 127.0.0.1:2379")
+    assert repo.get("areal-test/x") == "1"
+    repo.clear_subtree("areal-test")

@@ -148,6 +148,7 @@ def test_group_adv_norm():
 @pytest.mark.parametrize(
     "mode", ["seq-mean-token-sum", "seq-mean-token-mean"]
 )
+@pytest.mark.slow
 def test_log_agg_mode_seq_mean(mode):
     """Dr.GRPO-style aggregation must actually change the update (the knob
     was previously dead — ADVICE r1)."""

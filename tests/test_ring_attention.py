@@ -63,6 +63,7 @@ def test_ring_grads_match_global():
         )
 
 
+@pytest.mark.slow
 def test_train_engine_cp_ring_matches_single_device():
     """dp2×cp2 (ring attention auto-enabled) training step == single-device
     step — the same invariance the reference checks for its CP backend."""

@@ -70,6 +70,7 @@ def test_eval_batch(engine):
     assert np.isfinite(loss) and loss > 0
 
 
+@pytest.mark.slow
 def test_microbatch_invariance():
     """Splitting into microbatches must not change loss or updates
     (the reference's global loss-weight normalization contract)."""
@@ -117,6 +118,7 @@ def test_save_load_hf_roundtrip(engine, tmp_path):
     np.testing.assert_allclose(before, after, rtol=1e-2, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_multi_device_mesh_matches_single():
     """dp4×tp2 sharded training step == single-device step (GSPMD
     correctness; analogue of the reference's torchrun consistency tests)."""

@@ -90,6 +90,7 @@ def test_forward_is_image_conditioned():
     assert not np.allclose(np.asarray(la), np.asarray(lb))
 
 
+@pytest.mark.slow
 def test_train_with_images_decreases_loss():
     from areal_tpu.api.cli_args import OptimizerConfig, TrainEngineConfig
     from areal_tpu.engine.sft.lm_engine import TPULMEngine
