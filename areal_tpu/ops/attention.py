@@ -39,8 +39,10 @@ DEFAULT_BLOCK = 128
 class AttnSpec:
     """Immutable attention-dispatch configuration.
 
-    impl: "auto" | "pallas" | "xla" | "pallas_interpret"
-    mesh: jax Mesh for the sharded (ring / TP) path; None = local compute.
+    impl: "auto" | "pallas" | "xla" | "pallas_interpret" | "ulysses"
+      (ulysses = all-to-all head/sequence resharding, ops/ulysses.py; the
+      others ring KV chunks, ops/ring_attention.py)
+    mesh: jax Mesh for the sharded (ring / ulysses / TP) path; None = local.
     token_axes: mesh axes the packed token stream is sharded over (ring axes).
     head_axis: mesh axis heads are sharded over (tensor parallelism), or None.
     block: flash-attention block size (T on each shard must divide it for the
@@ -54,7 +56,9 @@ class AttnSpec:
     block: int = DEFAULT_BLOCK
 
     def __post_init__(self):
-        assert self.impl in ("auto", "pallas", "xla", "pallas_interpret"), self.impl
+        assert self.impl in (
+            "auto", "pallas", "xla", "pallas_interpret", "ulysses"
+        ), self.impl
 
     @property
     def n_token_shards(self) -> int:
@@ -114,6 +118,8 @@ class AttnSpec:
 
     def resolve_impl(self, t_local: int) -> str:
         """Concrete kernel choice for a (local-shard) stream length."""
+        if self.impl == "ulysses":  # per-chunk compute inside the all-to-all
+            return AttnSpec(impl="auto", block=self.block).resolve_impl(t_local)
         if self.impl in ("xla", "pallas_interpret"):
             return self.impl
         if t_local % self.block != 0:
@@ -142,6 +148,17 @@ def packed_attention(
     layout in all cases."""
     spec = spec if spec is not None else _DEFAULT_SPEC
     if spec.is_sharded:
+        if spec.impl == "ulysses":
+            from areal_tpu.ops.ulysses import ulysses_attention_sharded
+
+            # local attention runs over the FULL gathered sequence
+            return ulysses_attention_sharded(
+                spec.mesh, q, k, v, segment_ids,
+                token_axes=spec.token_axes,
+                softmax_scale=softmax_scale,
+                chunk_impl=spec.resolve_impl(q.shape[0]),
+                block=spec.block,
+            )
         from areal_tpu.ops.ring_attention import ring_attention_sharded
 
         t_local = q.shape[0] // max(spec.n_token_shards, 1)
@@ -215,17 +232,25 @@ def decode_attention_xla(
     k_cache/v_cache [B, S, KH, D], cache_len [B] = number of valid cache
     entries per slot INCLUDING the Tq new tokens already written at positions
     cache_len - Tq + i. Returns [B, Tq, NH, D].
+
+    GQA stays folded in the einsums (query heads grouped per KV head) — no
+    repeat_kv materialization, so the cache is read once, not group-times.
     """
     b, tq, nh, d = q.shape
     s, kh = k_cache.shape[1], k_cache.shape[2]
+    g = nh // kh
     scale = softmax_scale if softmax_scale is not None else d**-0.5
-    k = repeat_kv(k_cache, nh // kh)
-    v = repeat_kv(v_cache, nh // kh)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    qg = q.reshape(b, tq, kh, g, d)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_cache, preferred_element_type=jnp.float32
+    )
     logits = logits * scale
     kpos = jnp.arange(s)[None, None, :]  # [1,1,S]
     qpos = (cache_len[:, None] - tq + jnp.arange(tq)[None, :])[:, :, None]  # [B,Tq,1]
-    mask = kpos <= qpos  # causal within cache
-    logits = jnp.where(mask[:, None, :, :], logits, _NEG_INF)
+    mask = (kpos <= qpos)[:, None, None, :, :]  # causal within cache
+    logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(v_cache.dtype), v_cache
+    )
+    return out.reshape(b, tq, nh, d)

@@ -1,0 +1,99 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+The reference's second long-context mechanism (areal/utils/ulysses.py +
+models/transformers/ulyssess_patch.py, SURVEY §2.2): tokens are sharded over
+the sequence-parallel group everywhere EXCEPT inside attention, where an
+all-to-all reshards to head-sharded/full-sequence so each device runs plain
+full-context attention over its head slice, and a reverse all-to-all
+restores token sharding.
+
+TPU formulation: ``shard_map`` over the token axes with two
+``jax.lax.all_to_all`` collectives around the local attention compute —
+exactly the SeqAllToAll autograd function (ulysses.py:149-183) with XLA
+differentiating through the collectives. Complements ring attention
+(ops/ring_attention.py): Ulysses moves activations twice but runs one
+full-length attention (better for many heads / moderate context); the ring
+keeps memory at O((T/n)^2) per step (better for extreme context). Selected
+via ``AttnSpec(impl="ulysses")``.
+
+Constraint: num heads (q AND kv) must divide the group size; falls back to
+ring otherwise at the dispatch level.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _local_attention(q, k, v, seg, impl: str, block: int):
+    from areal_tpu.ops.attention import packed_attention_xla
+
+    if impl in ("pallas", "pallas_interpret"):
+        from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
+
+        return flash_attention_packed(
+            q, k, v, seg, None, block, impl == "pallas_interpret"
+        )
+    return packed_attention_xla(q, k, v, seg)
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,  # [T, NH, D] global
+    k: jnp.ndarray,  # [T, KH, D]
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,  # [T]
+    token_axes: tuple[str, ...] = ("dp", "cp"),
+    softmax_scale: float | None = None,
+    chunk_impl: str = "xla",
+    block: int = 128,
+) -> jnp.ndarray:
+    """Tokens sharded over ``token_axes`` outside; heads sharded inside.
+
+    all_to_all #1: [T/n, H, D] -> [T, H/n, D] (scatter heads, gather seq)
+    all_to_all #2: the reverse. Segment ids all-gather (tiny).
+    """
+    token_axes = tuple(token_axes)
+    n = 1
+    for a in token_axes:
+        n *= mesh.shape[a]
+    if n == 1:
+        return _local_attention(q, k, v, segment_ids, chunk_impl, block)
+    assert q.shape[1] % n == 0 and k.shape[1] % n == 0, (
+        f"ulysses needs heads divisible by the sp group: "
+        f"q heads {q.shape[1]}, kv heads {k.shape[1]}, group {n}"
+    )
+
+    axis = token_axes if len(token_axes) > 1 else token_axes[0]
+
+    def fn(q_l, k_l, v_l, seg_l):
+        # [Tl, H, D] -> heads split across the group, sequence gathered:
+        # all_to_all(split heads, concat tokens) -> [Tl*n, H/n, D]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=0, tiled=True
+            )
+
+        def gather_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=0, concat_axis=1, tiled=True
+            )
+
+        qf = scatter_heads(q_l)
+        kf = scatter_heads(k_l)
+        vf = scatter_heads(v_l)
+        seg_f = jax.lax.all_gather(seg_l, axis, tiled=True)  # [T]
+        of = _local_attention(qf, kf, vf, seg_f, chunk_impl, block)
+        return gather_heads(of)  # back to [Tl, H, D]
+
+    spec3 = P(token_axes, None, None)
+    spec1 = P(token_axes)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec3, spec3, spec3, spec1),
+        out_specs=spec3,
+        check_vma=False,
+    )(q, k, v, segment_ids)

@@ -139,7 +139,10 @@ def decode_bench(layers: int = 28, n_requests: int = 32, prompt_len: int = 128,
             max_batch_size=16,
             max_seq_len=512,
             prefill_chunk=128,
-            decode_steps_per_call=8,
+            # long decode chains amortize per-dispatch latency (the bench
+            # tunnel adds ~70ms RTT per host sync; real hosts ~none) at the
+            # cost of post-EOS overshoot — fine for fixed-length decode
+            decode_steps_per_call=16,
             dtype="bfloat16",
         ),
         model_config=model_cfg,
