@@ -238,7 +238,9 @@ class EngineBackendConfig:
     MegatronEngineConfig pair, cli_args.py:242,274 — one JAX backend)."""
 
     remat: bool = True  # jax.checkpoint each block (activation remat)
-    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    # one of models/lm.py _REMAT_POLICIES: nothing_saveable | dots_saveable
+    # | dots_with_no_batch_dims_saveable
+    remat_policy: str = "nothing_saveable"
     param_dtype: str = "bfloat16"
     compute_dtype: str = "bfloat16"
     optimizer_dtype: str = "float32"  # adam mu AND nu storage dtype

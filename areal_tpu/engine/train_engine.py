@@ -541,6 +541,7 @@ class TPUTrainEngine(TrainEngine):
                     mb["positions"],
                     mb["segment_ids"],
                     remat=backend.remat,
+                    remat_policy=backend.remat_policy,
                     attn_spec=self.attn_spec,
                     pixel_values=_flat_pixels(mb),
                 )
@@ -603,6 +604,27 @@ class TPUTrainEngine(TrainEngine):
             self._jit_cache[key] = jax.jit(apply, donate_argnums=(0, 1, 2))
         return self._jit_cache[key]
 
+    def _finalize_fn(self) -> Callable:
+        key = "finalize"
+        if key not in self._jit_cache:
+
+            def fin(gnorm, ok, losses, lr):
+                return jnp.stack(
+                    [
+                        jnp.asarray(gnorm, jnp.float32),
+                        jnp.asarray(ok, jnp.float32),
+                        jnp.sum(
+                            jnp.stack(
+                                [jnp.asarray(l, jnp.float32) for l in losses]
+                            )
+                        ),
+                        jnp.asarray(lr, jnp.float32),
+                    ]
+                )
+
+            self._jit_cache[key] = jax.jit(fin)
+        return self._jit_cache[key]
+
     def _zeros_like_grads(self):
         key = "zeros"
         if key not in self._jit_cache:
@@ -658,23 +680,36 @@ class TPUTrainEngine(TrainEngine):
             self._trainable(), self.opt_state, acc, jnp.float32(total_weight)
         )
         self._set_trainable(new_trainable)
-        if bool(ok):
+        # All per-step scalars (grad norm, skip flag, summed loss, lr) ride
+        # ONE packed vector fetched in a single device->host read: on a
+        # tunneled/remote backend every scalar read is a full RTT (~50ms),
+        # and four separate float()/bool() calls were costing ~20% of the
+        # whole 1.5B-model step.
+        lr_val = (
+            self._lr_schedule(self._opt_steps)
+            if self._lr_schedule is not None
+            else 0.0
+        )
+        host = np.asarray(self._finalize_fn()(gnorm, ok, losses, lr_val))
+        gnorm_f = float(host[0])
+        ok_b = bool(host[1])
+        loss_sum = float(host[2])
+        if ok_b:
             self._opt_steps += 1
-        loss_sum = float(jnp.sum(jnp.stack([jnp.asarray(l) for l in losses])))
         step_time = time.perf_counter() - t0
         stats = {
             "loss": loss_sum / total_weight,
-            "grad_norm": float(gnorm),
-            "update_successful": float(ok),
-            "lr": self.current_lr(),
+            "grad_norm": gnorm_f,
+            "update_successful": float(ok_b),
+            "lr": float(host[3]),
             "n_mbs": float(mb_list.n_mbs),
             "n_tokens": float(total_weight),
             "step_time": step_time,
         }
         stats.update(self._perf_stats(input_, real_tokens, step_time))
-        if not bool(ok):
+        if not ok_b:
             logger.warning(
-                f"non-finite grad norm {float(gnorm)}; skipped optimizer step"
+                f"non-finite grad norm {gnorm_f}; skipped optimizer step"
             )
         return stats
 

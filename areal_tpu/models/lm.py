@@ -220,6 +220,20 @@ def _block(
 # Packed forward (training / scoring)
 # ---------------------------------------------------------------------------
 
+# Activation-remat policies for the per-layer jax.checkpoint inside the scan
+# (cli_args.EngineBackendConfig.remat_policy). "nothing_saveable" recomputes
+# the whole block in backward (min memory); "dots_with_no_batch_dims_saveable"
+# keeps matmul outputs (qkv/o/gate/up/down) stacked across layers so backward
+# recomputes only elementwise ops — ~1 forward of FLOPs saved per step when
+# the activations fit in HBM.
+_REMAT_POLICIES = {
+    "nothing_saveable": None,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable": (
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    ),
+}
+
 
 def forward_packed(
     params: Params,
@@ -230,6 +244,7 @@ def forward_packed(
     remat: bool = False,
     attn_spec: AttnSpec | None = None,
     pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
+    remat_policy: str = "nothing_saveable",
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
     x = params["embed"][input_ids]
@@ -243,7 +258,12 @@ def forward_packed(
         return _block(cfg, lp, carry, positions, segment_ids, attn_spec), None
 
     if remat:
-        body = jax.checkpoint(body)
+        if remat_policy not in _REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {remat_policy!r}; choose from "
+                f"{sorted(_REMAT_POLICIES)}"
+            )
+        body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.is_critic:

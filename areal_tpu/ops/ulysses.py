@@ -16,8 +16,10 @@ full-length attention (better for many heads / moderate context); the ring
 keeps memory at O((T/n)^2) per step (better for extreme context). Selected
 via ``AttnSpec(impl="ulysses")``.
 
-Constraint: num heads (q AND kv) must divide the group size; falls back to
-ring otherwise at the dispatch level.
+Constraint: the sp group size must divide num heads (q AND kv); a
+non-divisible combination raises at trace time — pick ring CP
+(``impl="auto"`` on a cp mesh) for models with fewer KV heads than the
+group.
 """
 
 from __future__ import annotations
@@ -27,16 +29,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _local_attention(q, k, v, seg, impl: str, block: int):
+def _local_attention(q, k, v, seg, impl: str, block: int, softmax_scale):
     from areal_tpu.ops.attention import packed_attention_xla
 
     if impl in ("pallas", "pallas_interpret"):
         from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
 
         return flash_attention_packed(
-            q, k, v, seg, None, block, impl == "pallas_interpret"
+            q, k, v, seg, softmax_scale, block, impl == "pallas_interpret"
         )
-    return packed_attention_xla(q, k, v, seg)
+    return packed_attention_xla(q, k, v, seg, softmax_scale)
 
 
 def ulysses_attention_sharded(
@@ -60,7 +62,9 @@ def ulysses_attention_sharded(
     for a in token_axes:
         n *= mesh.shape[a]
     if n == 1:
-        return _local_attention(q, k, v, segment_ids, chunk_impl, block)
+        return _local_attention(
+            q, k, v, segment_ids, chunk_impl, block, softmax_scale
+        )
     assert q.shape[1] % n == 0 and k.shape[1] % n == 0, (
         f"ulysses needs heads divisible by the sp group: "
         f"q heads {q.shape[1]}, kv heads {k.shape[1]}, group {n}"
@@ -85,7 +89,7 @@ def ulysses_attention_sharded(
         kf = scatter_heads(k_l)
         vf = scatter_heads(v_l)
         seg_f = jax.lax.all_gather(seg_l, axis, tiled=True)  # [T]
-        of = _local_attention(qf, kf, vf, seg_f, chunk_impl, block)
+        of = _local_attention(qf, kf, vf, seg_f, chunk_impl, block, softmax_scale)
         return gather_heads(of)  # back to [Tl, H, D]
 
     spec3 = P(token_axes, None, None)

@@ -74,7 +74,14 @@ def _is_oom(msg: str) -> bool:
     return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
 
 
-def sft_bench(layers: int, opt_type: str, seqlen: int, n_seqs: int):
+def sft_bench(
+    layers: int,
+    opt_type: str,
+    seqlen: int,
+    n_seqs: int,
+    remat_policy: str = "nothing_saveable",
+    mb_tokens: int | None = None,
+):
     """One SFT throughput measurement; returns (tokens/s, mfu or None)."""
     from areal_tpu.api.cli_args import (
         MicroBatchSpec,
@@ -88,9 +95,10 @@ def sft_bench(layers: int, opt_type: str, seqlen: int, n_seqs: int):
         path="",
         init_from_scratch=True,
         optimizer=OptimizerConfig(lr=1e-4, type=opt_type),
-        mb_spec=MicroBatchSpec(max_tokens_per_mb=n_seqs * seqlen),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=mb_tokens or n_seqs * seqlen),
     )
     cfg.backend.remat = True
+    cfg.backend.remat_policy = remat_policy
     cfg.backend.pad_mb_to_multiple = 512
     # single 16GB chip hosting a 1.5B model: bf16 adam moments + bf16 grad
     # accumulator (multi-chip deployments shard optimizer state over dp
@@ -215,6 +223,11 @@ def main():
     # ladder: full model first (adam OOMs a 16GB chip at 1.5B even with bf16
     # moments -> adafactor); depth reduction is the last resort
     attempts = [
+        # 4096-token microbatches hit the chip's matmul sweet spot; grad
+        # accumulation over 2 of them amortizes the fixed per-step cost
+        # (measured: 4.5k tok/s vs 4.3k single-mb, vs 3.7k one 8192 mb)
+        dict(layers=28, opt_type="adafactor", seqlen=4096, n_seqs=2,
+             mb_tokens=4096),
         dict(layers=28, opt_type="adafactor", seqlen=4096, n_seqs=1),
         dict(layers=28, opt_type="adafactor", seqlen=2048, n_seqs=2),
         dict(layers=14, opt_type="adamw", seqlen=2048, n_seqs=2),

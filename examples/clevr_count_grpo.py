@@ -1,0 +1,207 @@
+"""CLEVR-count GRPO — the VLM RL entry point, mirroring the reference's
+examples/vlm/clevr_count_70k_grpo.py call stack: VisionRLVRWorkflow rollouts
+(images ride the generation request), count reward on the gold object count,
+decoupled-PPO updates through the vision encoder on the GSPMD train mesh.
+
+Run under the local launcher (which starts the generation servers first):
+
+    python -m areal_tpu.launcher.local examples/clevr_count_grpo.py \
+        --config examples/configs/clevr_count_grpo.yaml
+"""
+
+import json
+import os
+import sys
+
+from areal_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+from areal_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize()
+
+import numpy as np  # noqa: E402
+
+from areal_tpu.api.alloc_mode import AllocationMode  # noqa: E402
+from areal_tpu.api.cli_args import GRPOConfig, load_expr_config  # noqa: E402
+from areal_tpu.api.io_struct import (  # noqa: E402
+    FinetuneSpec,
+    StepInfo,
+    WeightUpdateMeta,
+)
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine  # noqa: E402
+from areal_tpu.dataset import get_custom_dataset  # noqa: E402
+from areal_tpu.engine.ppo.actor import TPUPPOActor  # noqa: E402
+from areal_tpu.models.config import from_hf_config  # noqa: E402
+from areal_tpu.reward.count_reward import count_reward  # noqa: E402
+from areal_tpu.utils import logging, stats_tracker  # noqa: E402
+from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
+from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
+from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow  # noqa: E402
+
+logger = logging.getLogger("clevr_count_grpo")
+
+
+def main(argv=None):
+    cfg, _ = load_expr_config(argv, GRPOConfig)
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+
+    # the vision splice geometry comes from the model config: each image
+    # becomes exactly `vision_patches` embedding rows at `image_token_id`
+    model_cfg = from_hf_config(cfg.actor.path)
+    if not model_cfg.is_vlm:
+        raise ValueError(
+            f"{cfg.actor.path} has no vision tower; clevr_count requires a "
+            "VLM checkpoint (vision_patch_size > 0)"
+        )
+
+    train_rows = get_custom_dataset(
+        cfg.train_dataset.path,
+        split="train",
+        type=cfg.train_dataset.type,
+        tokenizer=tokenizer,
+        max_length=cfg.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_rows,
+        cfg.train_dataset.batch_size,
+        shuffle=cfg.train_dataset.shuffle,
+        seed=cfg.seed,
+        drop_last=cfg.train_dataset.drop_last,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        dataset_size=len(train_rows),
+        train_batch_size=cfg.train_dataset.batch_size,
+    )
+    total_steps = cfg.total_train_steps or ft_spec.total_train_steps
+
+    rollout = RemoteInfEngine(cfg.rollout)
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    rollout.initialize(
+        None, train_data_parallel_size=alloc.train.dp if alloc.train else 1
+    )
+
+    actor = TPUPPOActor(cfg.actor)
+    actor.create_process_group(alloc.train)
+    actor.initialize(None, ft_spec)
+
+    if cfg.weight_update == "http":
+        weight_meta = WeightUpdateMeta.from_http()
+    elif cfg.weight_update == "disk":
+        weight_meta = WeightUpdateMeta.from_disk(
+            cfg.experiment_name, cfg.trial_name, cfg.cluster.fileroot
+        )
+    else:
+        raise ValueError(
+            f"weight_update must be 'disk' or 'http', got {cfg.weight_update!r}"
+        )
+    actor.connect_engine(rollout, weight_meta)
+
+    log_dir = os.path.join(
+        cfg.stats_logger.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
+    )
+    workflow = VisionRLVRWorkflow(
+        count_reward,
+        cfg.gconfig,
+        tokenizer,
+        image_token_id=model_cfg.image_token_id,
+        patches_per_image=model_cfg.vision_patches,
+        dump_dir=os.path.join(log_dir, "generated"),
+        in_process_reward=True,
+    )
+
+    saver = Saver(cfg.saver, ft_spec)
+    evaluator = Evaluator(cfg.evaluator, ft_spec)
+    recover_handler = RecoverHandler(cfg.recover, ft_spec)
+    stats_logger = StatsLogger(cfg.stats_logger, ft_spec)
+
+    start_step = 0
+    if check_if_recover(cfg.recover):
+        info = recover_handler.load(
+            actor,
+            saver,
+            evaluator,
+            dataloader,
+            fileroot=cfg.cluster.fileroot,
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+            config=cfg,
+        )
+        if info is not None:
+            start_step = info.last_step_info.global_step + 1
+            actor.update_weights(weight_meta)
+
+    all_rewards = []
+    for global_step in range(start_step, total_steps):
+        step_info = StepInfo(
+            epoch=global_step // ft_spec.steps_per_epoch,
+            epoch_step=global_step % ft_spec.steps_per_epoch,
+            global_step=global_step,
+            steps_per_epoch=ft_spec.steps_per_epoch,
+        )
+
+        with stats_tracker.record_timing("rollout"):
+            if cfg.async_training:
+                batch = rollout.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = rollout.rollout_batch(
+                    next(iter(dataloader)), workflow=workflow
+                )
+
+        if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
+            with stats_tracker.record_timing("recompute_logp"):
+                batch["prox_logp"] = actor.actor.compute_logp(batch)
+
+        with stats_tracker.record_timing("compute_advantage"):
+            actor.actor.compute_advantages(batch)
+
+        with stats_tracker.record_timing("train_step"):
+            stats = actor.actor.ppo_update(batch)
+            actor.step_lr_scheduler()
+
+        with stats_tracker.record_timing("update_weights"):
+            rollout.pause()
+            actor.update_weights(weight_meta)
+            rollout.resume()
+
+        with stats_tracker.record_timing("save"):
+            saver.save(actor, step_info, tokenizer=tokenizer)
+            recover_handler.dump(
+                actor,
+                step_info,
+                saver,
+                evaluator,
+                dataloader,
+                stats_logger,
+                fileroot=cfg.cluster.fileroot,
+                experiment_name=cfg.experiment_name,
+                trial_name=cfg.trial_name,
+                tokenizer=tokenizer,
+                config=cfg,
+            )
+
+        mean_reward = float(np.mean(np.asarray(batch["rewards"])))
+        all_rewards.append(mean_reward)
+        stats[0].update(stats_tracker.export(key="time_perf"))
+        stats[0]["grpo/mean_task_reward"] = mean_reward
+        stats_logger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
+
+    out = os.path.join(stats_logger.log_dir(), "rewards.json")
+    with open(out, "w") as f:
+        json.dump(all_rewards, f)
+    logger.info("wrote %s", out)
+
+    stats_logger.close()
+    rollout.destroy()
+    actor.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
