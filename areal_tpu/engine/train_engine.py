@@ -45,6 +45,11 @@ from areal_tpu.models.config import TransformerConfig, from_hf_config
 from areal_tpu.models.lm import forward_packed, init_params
 from areal_tpu.parallel import distributed
 from areal_tpu.parallel.mesh import make_mesh, single_device_mesh
+from areal_tpu.parallel.pipeline import (
+    check_pp_compatible,
+    forward_packed_pipelined,
+    pp_size,
+)
 from areal_tpu.parallel.sharding import FSDP_AXES, param_shardings
 from areal_tpu.utils import logging, stats_tracker
 from areal_tpu.utils.data import (
@@ -253,6 +258,17 @@ class TPUTrainEngine(TrainEngine):
             self.model_config = model_config
         else:
             self.model_config = from_hf_config(cfg.path)
+        check_pp_compatible(self.model_config, self.mesh)
+        if pp_size(self.mesh) > 1 and distributed.process_count() > 1:
+            # pp peers would need identical per-host batches (the stacked
+            # [M, T] array is pp-replicated); the host-local dataloader
+            # sharding feeds DIFFERENT streams per host, which would build
+            # inconsistent global arrays and double-count the loss
+            # normalizer — fail loudly until pp-aware host data placement
+            # lands
+            raise NotImplementedError(
+                "pp>1 with multi-host jax.distributed is not supported yet"
+            )
         self.attn_spec = self._build_attn_spec()
 
         param_dtype = _DTYPES[cfg.backend.param_dtype]
@@ -448,6 +464,54 @@ class TPUTrainEngine(TrainEngine):
                 )
         return out
 
+    def _stacked_to_device(self, packed_mbs: list[TensorDict]) -> dict:
+        """Stack equal-bucket packed microbatches into one [M, T, ...] batch
+        on the mesh (the pipelined grad step consumes all mbs in one call).
+        Token dims shard over (dp, cp); the leading M dim stays unsharded —
+        it is the pipeline's time axis, not a data axis."""
+        assert packed_mbs, "no microbatches"
+        n = int(packed_mbs[0]["cu_seqlens"][-1])
+        if any(int(p["cu_seqlens"][-1]) != n for p in packed_mbs):
+            raise ValueError("stacked microbatches must share one bucket")
+        if any("pixel_values" in p for p in packed_mbs):
+            raise NotImplementedError("pp>1 with pixel_values is unsupported")
+        rep = NamedSharding(self.mesh, P())
+        out = {}
+        for k in packed_mbs[0]:
+            if k in ("cu_seqlens", "max_seqlen"):
+                continue
+            arrs = [np.asarray(p[k]) for p in packed_mbs]
+            arr = np.stack(arrs)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            if arr.dtype == np.int64:
+                arr = arr.astype(np.int32)
+            if arr.ndim >= 2 and arr.shape[1] == n:
+                spec = P(*([None, FSDP_AXES] + [None] * (arr.ndim - 2)))
+                out[k] = distributed.host_local_to_global(
+                    self.mesh, spec, arr
+                )
+            else:
+                out[k] = jax.device_put(arr, rep)
+        return out
+
+    @staticmethod
+    def _repad_packed(packed: TensorDict, target: int) -> TensorDict:
+        """Re-pad one packed microbatch to exactly ``target`` tokens and
+        rebuild positions/segment_ids (the pad tokens form an isolated
+        zero-loss segment)."""
+        if int(packed["cu_seqlens"][-1]) >= target:
+            return packed
+        packed = dict(packed)
+        for k in ("positions", "segment_ids"):
+            packed.pop(k, None)
+        packed, _ = pad_packed_to_multiple(packed, target)
+        cu = packed["cu_seqlens"]
+        total = int(cu[-1])
+        packed["positions"] = positions_from_cu_seqlens(cu, total)
+        packed["segment_ids"] = segment_ids_from_cu_seqlens(cu, total)
+        return packed
+
     def _prepare_mbs(
         self, input_: TensorDict, group_size: int = 1
     ) -> tuple[Any, list[TensorDict], list[int]]:
@@ -477,6 +541,13 @@ class TPUTrainEngine(TrainEngine):
             packed["segment_ids"] = seg
             packed_mbs.append(packed)
             real_ns.append(real_n)
+        if pp_size(self.mesh) > 1:
+            # the pipelined grad step stacks microbatches into one [M, T]
+            # batch, so every mb must share ONE token bucket
+            t = max(int(p["cu_seqlens"][-1]) for p in packed_mbs)
+            if distributed.process_count() > 1:
+                t = int(distributed.sync_max(t))
+            packed_mbs = [self._repad_packed(p, t) for p in packed_mbs]
         if distributed.process_count() > 1:
             packed_mbs, real_ns = self._sync_mbs_across_hosts(packed_mbs, real_ns)
         return mb_list, packed_mbs, real_ns
@@ -502,17 +573,7 @@ class TPUTrainEngine(TrainEngine):
         targets = distributed.sync_max_vector(local_ts, n_mbs)
         out = []
         for packed, local_t, target in zip(packed_mbs, local_ts, targets):
-            target = int(target)
-            if local_t < target:
-                packed = dict(packed)
-                # re-pad to the agreed bucket, then rebuild positions/segments
-                for k in ("positions", "segment_ids"):
-                    packed.pop(k, None)
-                packed, _ = pad_packed_to_multiple(packed, target)
-                cu = packed["cu_seqlens"]
-                total = int(cu[-1])
-                packed["positions"] = positions_from_cu_seqlens(cu, total)
-                packed["segment_ids"] = segment_ids_from_cu_seqlens(cu, total)
+            packed = self._repad_packed(packed, int(target))
             # per-host segment-id namespace: host-local ids all start at 0,
             # and the global packed stream concatenates hosts — without an
             # offset, host B's sequence 0 would attend into host A's
@@ -527,6 +588,63 @@ class TPUTrainEngine(TrainEngine):
         return out, real_ns
 
     # ------------------------------------------------------------ train step
+
+    def _grad_fn_pp(self, loss_fn: Callable) -> Callable:
+        """Pipelined grad step: ALL microbatches ride one jit call as a
+        stacked [M, T] batch; the GPipe schedule inside
+        forward_packed_pipelined overlaps their stage compute, and grad
+        accumulation over M falls out of summing the vmapped per-mb losses
+        (no explicit accumulator buffer)."""
+        key = ("grad_pp", loss_fn)
+        if key not in self._jit_cache:
+            cfg, backend = self.model_config, self.config.backend
+            mesh, attn_spec = self.mesh, self.attn_spec
+            acc_dtype = _DTYPES[backend.grad_acc_dtype]
+            lora_cfg = self.config.lora
+
+            def compute(params, mbs):
+                logits = forward_packed_pipelined(
+                    params,
+                    cfg,
+                    mbs["input_ids"],
+                    mbs["positions"],
+                    mbs["segment_ids"],
+                    mesh,
+                    attn_spec=attn_spec,
+                    remat=backend.remat,
+                    remat_policy=backend.remat_policy,
+                )
+                losses = jax.vmap(loss_fn)(logits, mbs)  # [M]
+                return jnp.sum(losses), losses
+
+            if lora_cfg is None:
+
+                def step(params, mbs):
+                    (_, losses), grads = jax.value_and_grad(
+                        compute, has_aux=True
+                    )(params, mbs)
+                    grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
+                    return losses, grads
+
+                self._jit_cache[key] = jax.jit(step)
+            else:
+                from areal_tpu.models.lora import merge_lora
+
+                def step(lora, base, mbs):
+                    def f(lo):
+                        return compute(merge_lora(base, lo, lora_cfg), mbs)
+
+                    (_, losses), grads = jax.value_and_grad(f, has_aux=True)(
+                        lora
+                    )
+                    grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
+                    return losses, grads
+
+                jitted = jax.jit(step)
+                self._jit_cache[key] = (
+                    lambda tr, mbs: jitted(tr, self.params, mbs)
+                )
+        return self._jit_cache[key]
 
     def _grad_fn(self, loss_fn: Callable) -> Callable:
         key = ("grad", loss_fn)
@@ -663,17 +781,24 @@ class TPUTrainEngine(TrainEngine):
         total_weight = distributed.sync_sum(sum(weights))
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
 
-        grad_step = self._grad_fn(loss_fn)
         # free any merged-weights copy BEFORE forward+backward: holding a
         # full effective-params clone through the grad step would forfeit
         # LoRA's memory savings
         self._merged_cache = None
-        acc = self._zeros_like_grads()
-        losses = []
-        for packed in packed_mbs:
-            mb_dev = self._mb_to_device(packed)
-            loss, acc = grad_step(self._trainable(), acc, mb_dev)
-            losses.append(loss)
+        if pp_size(self.mesh) > 1:
+            mbs_dev = self._stacked_to_device(packed_mbs)
+            losses_vec, acc = self._grad_fn_pp(loss_fn)(
+                self._trainable(), mbs_dev
+            )
+            losses = [jnp.sum(losses_vec)]
+        else:
+            grad_step = self._grad_fn(loss_fn)
+            acc = self._zeros_like_grads()
+            losses = []
+            for packed in packed_mbs:
+                mb_dev = self._mb_to_device(packed)
+                loss, acc = grad_step(self._trainable(), acc, mb_dev)
+                losses.append(loss)
 
         apply = self._apply_fn()
         new_trainable, self.opt_state, gnorm, ok = apply(
@@ -721,9 +846,28 @@ class TPUTrainEngine(TrainEngine):
     ) -> float | None:
         assert self.initialized
         _, packed_mbs, _ = self._prepare_mbs(input_)
+        denom = sum(float(loss_weight_fn(p)) for p in packed_mbs)
+        if pp_size(self.mesh) > 1:
+            pkey = ("eval_pp", loss_fn)
+            if pkey not in self._jit_cache:
+                cfg = self.model_config
+                mesh, attn_spec = self.mesh, self.attn_spec
+
+                def ev_pp(params, mbs):
+                    logits = forward_packed_pipelined(
+                        params, cfg, mbs["input_ids"], mbs["positions"],
+                        mbs["segment_ids"], mesh, attn_spec=attn_spec,
+                        remat=False,
+                    )
+                    return jnp.sum(jax.vmap(loss_fn)(logits, mbs))
+
+                self._jit_cache[pkey] = jax.jit(ev_pp)
+            mbs_dev = self._stacked_to_device(packed_mbs)
+            total = float(self._jit_cache[pkey](self.effective_params(), mbs_dev))
+            return total / max(denom, 1.0)
         key = ("eval", loss_fn)
         if key not in self._jit_cache:
-            cfg, backend = self.model_config, self.config.backend
+            cfg = self.model_config
 
             def ev(params, mb):
                 logits = forward_packed(
@@ -736,11 +880,10 @@ class TPUTrainEngine(TrainEngine):
 
             self._jit_cache[key] = jax.jit(ev)
         ev = self._jit_cache[key]
-        total, denom = 0.0, 0.0
+        total = 0.0
         for packed in packed_mbs:
             mb_dev = self._mb_to_device(packed)
             total += float(ev(self.effective_params(), mb_dev))
-            denom += float(loss_weight_fn(packed))
         return total / max(denom, 1.0)
 
     # --------------------------------------------------------------- forward
@@ -760,26 +903,59 @@ class TPUTrainEngine(TrainEngine):
         re-padded to the input's [B, S] layout (pad = 0)."""
         assert self.initialized
         mb_list, packed_mbs, real_ns = self._prepare_mbs(input_)
-        key = ("fwd", post_hook)
-        if key not in self._jit_cache:
-            cfg = self.model_config
+        if pp_size(self.mesh) > 1:
+            key = ("fwd_pp", post_hook)
+            if key not in self._jit_cache:
+                cfg = self.model_config
+                mesh, attn_spec = self.mesh, self.attn_spec
 
-            def fwd(params, mb):
-                logits = forward_packed(
-                    params, cfg, mb["input_ids"], mb["positions"],
-                    mb["segment_ids"], remat=False,
-                    attn_spec=self.attn_spec,
-                    pixel_values=_flat_pixels(mb),
+                def fwd_pp(params, mbs):
+                    logits = forward_packed_pipelined(
+                        params, cfg, mbs["input_ids"], mbs["positions"],
+                        mbs["segment_ids"], mesh, attn_spec=attn_spec,
+                        remat=False,
+                    )
+                    if post_hook is not None:
+                        return jax.vmap(post_hook)(logits, mbs)
+                    return logits
+
+                self._jit_cache[key] = jax.jit(fwd_pp)
+            mbs_dev = self._stacked_to_device(packed_mbs)
+            stacked_out = np.asarray(
+                jax.device_get(
+                    self._jit_cache[key](self.effective_params(), mbs_dev)
                 )
-                return post_hook(logits, mb) if post_hook is not None else logits
+            )
+            mb_outs = list(stacked_out)
+        else:
+            key = ("fwd", post_hook)
+            if key not in self._jit_cache:
+                cfg = self.model_config
 
-            self._jit_cache[key] = jax.jit(fwd)
-        fwd = self._jit_cache[key]
+                def fwd(params, mb):
+                    logits = forward_packed(
+                        params, cfg, mb["input_ids"], mb["positions"],
+                        mb["segment_ids"], remat=False,
+                        attn_spec=self.attn_spec,
+                        pixel_values=_flat_pixels(mb),
+                    )
+                    return (
+                        post_hook(logits, mb) if post_hook is not None else logits
+                    )
+
+                self._jit_cache[key] = jax.jit(fwd)
+            fwd = self._jit_cache[key]
+            mb_outs = None
 
         per_row: list[np.ndarray] = []
         for mb_idx, (packed, real_n) in enumerate(zip(packed_mbs, real_ns)):
-            mb_dev = self._mb_to_device(packed)
-            out = np.asarray(jax.device_get(fwd(self.effective_params(), mb_dev)))[:real_n]
+            if mb_outs is not None:
+                out = mb_outs[mb_idx][:real_n]
+            else:
+                mb_dev = self._mb_to_device(packed)
+                out = np.asarray(
+                    jax.device_get(fwd(self.effective_params(), mb_dev))
+                )[:real_n]
             if output_seqlens is not None:
                 # per-sequence output lengths differ from input lengths
                 # (reference base_hf_engine.py:516-544)

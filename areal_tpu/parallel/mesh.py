@@ -33,14 +33,6 @@ def make_mesh(
 ) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     need = parallel.world_size
-    if parallel.pp > 1:
-        # reject loudly instead of a cosmetic axis (VERDICT r1 weak #7):
-        # GSPMD favors dp×cp×tp(+folded ep); pipeline staging is not built
-        raise NotImplementedError(
-            f"pipeline parallelism (pp={parallel.pp}) is not implemented; "
-            "use dp/cp/tp (and MoE expert parallelism via the folded "
-            "(dp, cp) axes — TransformerConfig.moe_impl='gshard_ep')"
-        )
     if len(devices) < need:
         raise ValueError(
             f"ParallelStrategy {parallel} needs {need} devices, "

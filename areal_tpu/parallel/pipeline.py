@@ -1,0 +1,194 @@
+"""Pipeline parallelism: GPipe microbatch schedule as a GSPMD program.
+
+The TPU-native counterpart of the reference's pipeline engines
+(realhf/impl/model/backend/pipe_runner.py:274-778 instruction schedules and
+megatron PP, areal/engine/megatron_engine.py:846-925). Those hand-drive
+send/recv pairs between stage processes; here the whole fill-drain schedule
+is ONE jitted program:
+
+- the stacked layer dim L is sharded over the ``pp`` mesh axis (each stage
+  owns L/S contiguous layers — the pytree stays a single scan-friendly
+  stack, no per-stage module lists);
+- a ``jax.shard_map`` manual only over ``pp`` (dp/cp/tp stay auto, so the
+  usual GSPMD tensor/data sharding applies *inside* each stage) runs the
+  classic GPipe loop: ``M + S - 1`` steps of ``lax.scan``, each step
+  computing this stage's layers on its current microbatch and
+  ``ppermute``-ing activations to the next stage;
+- embedding and the vocab head run OUTSIDE the pipeline region with the
+  token dim sharded over ``(pp, dp, cp)`` — the pp axis acts as extra data
+  parallelism there, so no stage redundantly computes the (large) head;
+- backward is jax.grad through the scan + ppermute: AD reverses the
+  schedule into the symmetric drain-fill backward pipeline automatically.
+
+Bubble fraction is (S-1)/(M+S-1), the GPipe figure; feed M >= 2S
+microbatches to keep it small. Per-stage activation memory is O(M) saved
+stage inputs (with remat inside each stage step), the GPipe tradeoff.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.ops.attention import AttnSpec
+from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_PP
+
+
+def pp_size(mesh: Mesh | None) -> int:
+    return int(mesh.shape.get(AXIS_PP, 1)) if mesh is not None else 1
+
+
+def check_pp_compatible(cfg: TransformerConfig, mesh: Mesh) -> None:
+    s = pp_size(mesh)
+    if s <= 1:
+        return
+    if cfg.num_hidden_layers % s != 0:
+        raise ValueError(
+            f"pipeline parallelism needs num_hidden_layers "
+            f"({cfg.num_hidden_layers}) divisible by pp ({s})"
+        )
+    if cfg.is_vlm:
+        raise NotImplementedError(
+            "pp>1 with a vision tower is not supported yet (the image "
+            "splice runs outside the pipeline; wiring pixel batches through "
+            "the stacked-microbatch path is future work)"
+        )
+
+
+def stage_attn_spec(spec: AttnSpec | None) -> AttnSpec | None:
+    """Attention dispatch used INSIDE a pipeline stage.
+
+    The stage body runs under a shard_map that is manual over pp and auto
+    over dp/cp/tp, so the ring/ulysses wrappers (their own shard_maps over
+    the token axes) cannot be re-entered here; attention runs locally and
+    GSPMD shards the einsum over tp heads / dp tokens like any other op.
+    The Pallas kernel has no GSPMD partitioning rule, so it is only safe
+    when nothing would need partitioning inside the stage.
+    """
+    if spec is None:
+        return None
+    impl = spec.impl
+    if spec.is_sharded or impl in ("auto", "ulysses"):
+        impl = "xla"
+    return AttnSpec(impl=impl, mesh=None, block=spec.block)
+
+
+def pipeline_hidden(
+    params: dict,
+    cfg: TransformerConfig,
+    embeds: jnp.ndarray,  # [M, T, H] post-embedding microbatch stack
+    positions: jnp.ndarray,  # [M, T]
+    segment_ids: jnp.ndarray,  # [M, T]
+    mesh: Mesh,
+    attn_spec: AttnSpec | None = None,
+    remat: bool = True,
+    remat_policy: str = "nothing_saveable",
+) -> jnp.ndarray:
+    """Run the decoder stack as an S-stage GPipe pipeline.
+
+    Returns pre-final-norm hidden states [M, T, H], replicated over pp.
+    """
+    from areal_tpu.models.lm import _REMAT_POLICIES, _block
+
+    s = pp_size(mesh)
+    m = embeds.shape[0]
+    inner_spec = stage_attn_spec(attn_spec)
+
+    def run_stage(layers_local, x, pos, seg):
+        def body(carry, lp):
+            return _block(cfg, lp, carry, pos, seg, inner_spec), None
+
+        if remat:
+            body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
+        y, _ = jax.lax.scan(body, x, layers_local)
+        return y
+
+    def stage_fn(layers_local, emb, pos_all, seg_all):
+        stage = jax.lax.axis_index(AXIS_PP)
+        steps = m + s - 1
+        buf = jnp.zeros_like(emb[0])
+
+        def body(carry, t):
+            # at step t this stage works on microbatch (t - stage); the
+            # clip keeps indices in range during fill/drain (those
+            # iterations compute garbage that is never collected)
+            midx = jnp.clip(t - stage, 0, m - 1)
+            x0 = jax.lax.dynamic_index_in_dim(emb, midx, 0, keepdims=False)
+            x_in = jnp.where(stage == 0, x0, carry)
+            pos = jax.lax.dynamic_index_in_dim(
+                pos_all, midx, 0, keepdims=False
+            )
+            seg = jax.lax.dynamic_index_in_dim(
+                seg_all, midx, 0, keepdims=False
+            )
+            y = run_stage(layers_local, x_in, pos, seg)
+            nxt = jax.lax.ppermute(
+                y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
+            )
+            return nxt, y
+
+        _, ys = jax.lax.scan(body, buf, jnp.arange(steps))
+        # microbatch mb exits the last stage at step mb + s - 1
+        out = ys[s - 1 :]
+        out = jnp.where(stage == s - 1, out, 0.0)
+        return jax.lax.psum(out, AXIS_PP)
+
+    return jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(), P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(params["layers"], embeds, positions, segment_ids)
+
+
+def forward_packed_pipelined(
+    params: dict,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [M, T] int32 microbatch stack
+    positions: jnp.ndarray,  # [M, T]
+    segment_ids: jnp.ndarray,  # [M, T]
+    mesh: Mesh,
+    attn_spec: AttnSpec | None = None,
+    remat: bool = False,
+    remat_policy: str = "nothing_saveable",
+) -> jnp.ndarray:
+    """Pipelined counterpart of models/lm.forward_packed over M stacked
+    microbatches: logits [M, T, V] fp32 (values [M, T] for critics).
+
+    Embedding and head are computed outside the pipeline with the token dim
+    sharded over (pp, dp, cp) — every device works on head FLOPs, none
+    duplicates them.
+    """
+    from areal_tpu.models.lm import rms_norm
+
+    x = params["embed"][input_ids]  # [M, T, H]
+    x = pipeline_hidden(
+        params,
+        cfg,
+        x,
+        positions,
+        segment_ids,
+        mesh,
+        attn_spec=attn_spec,
+        remat=remat,
+        remat_policy=remat_policy,
+    )
+    # spread head/loss work across ALL devices: pp joins dp/cp as token
+    # parallelism for the out-of-pipeline ops
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, (AXIS_PP, AXIS_DP, AXIS_CP), None))
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.is_critic:
+        return (x @ params["value_head"]).astype(jnp.float32)[..., 0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)

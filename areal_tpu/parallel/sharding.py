@@ -33,17 +33,26 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_PP, AXIS_TP
 
 FSDP_AXES = (AXIS_DP, AXIS_CP)  # combined data axes used for param sharding
 EP_AXES = (AXIS_DP, AXIS_CP)  # expert axis = folded data axes (MoE folding)
 
 
-def param_spec(path: tuple, leaf: Any, fsdp: bool) -> P:
-    """PartitionSpec for one stacked-leaf param, keyed by its pytree path."""
+def param_spec(path: tuple, leaf: Any, fsdp: bool, pp: bool = False) -> P:
+    """PartitionSpec for one stacked-leaf param, keyed by its pytree path.
+
+    ``pp=True`` (mesh has a real pipeline axis) shards the stacked layer
+    dim L of every in-layers leaf over ``pp`` — each pipeline stage owns
+    its contiguous L/pp layer slice at rest, matching the shard_map
+    in_specs of parallel/pipeline.py so entering the pipeline moves no
+    weights."""
     keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
     name = keys[-1]
     in_layers = "layers" in keys
+    if pp and in_layers:
+        base = tuple(param_spec(path, leaf, fsdp, pp=False))
+        return (AXIS_PP,) + base[1:]
 
     def fs(axis_spec):
         """Optionally add fsdp sharding on the first shardable None dim.
@@ -99,8 +108,10 @@ def param_shardings(mesh: Mesh, params_shape_tree: Any, fsdp: bool = True):
     replication on that dim (GSPMD requires even sharding for inputs placed
     via device_put; XLA can still re-shard internally)."""
 
+    pp = mesh.shape.get(AXIS_PP, 1) > 1
+
     def build(path, leaf):
-        spec = param_spec(path, leaf, fsdp)
+        spec = param_spec(path, leaf, fsdp, pp=pp)
         spec = _evenly_divisible(mesh, spec, getattr(leaf, "shape", ()))
         return NamedSharding(mesh, P(*spec))
 

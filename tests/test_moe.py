@@ -109,9 +109,12 @@ def test_gshard_ep_sharded_matches_single():
     )
 
 
-def test_pp_rejected_loudly():
+def test_pp_mesh_constructs():
+    # pp is a real axis now (parallel/pipeline.py); the old loud rejection
+    # is gone. Incompatible LAYER counts still fail fast in the engine
+    # (pipeline.check_pp_compatible, covered in tests/test_pipeline.py).
     from areal_tpu.api.alloc_mode import ParallelStrategy
     from areal_tpu.parallel.mesh import make_mesh
 
-    with pytest.raises(NotImplementedError, match="pipeline"):
-        make_mesh(ParallelStrategy(pp=2, dp=2, tp=2))
+    mesh = make_mesh(ParallelStrategy(pp=2, dp=2, tp=2))
+    assert mesh.shape["pp"] == 2 and mesh.shape["dp"] == 2
