@@ -350,6 +350,12 @@ class JaxGenConfig:
     # decoupled PPO recomputes logprobs on the trainer); set False for
     # strict re-prefill-under-new-weights semantics.
     retain_kv_on_abort: bool = True
+    # reuse another slot's KV rows when a new request's prompt prefix is
+    # already cached there (the GRPO n-samples case: one prefill per prompt
+    # GROUP; clones join the batched decode directly via a device-side row
+    # copy). Cleared on weight updates so fresh requests always prefill
+    # under current weights.
+    enable_prefix_reuse: bool = True
 
 
 @dataclass

@@ -481,6 +481,13 @@ class TPUTrainEngine(TrainEngine):
             if k in ("cu_seqlens", "max_seqlen"):
                 continue
             arrs = [np.asarray(p[k]) for p in packed_mbs]
+            if any(a.shape != arrs[0].shape for a in arrs[1:]):
+                # per-sequence keys (RM pair_mask etc.) differ per mb even
+                # after token-bucket equalization
+                raise NotImplementedError(
+                    f"pp>1 cannot stack microbatch key {k!r}: per-mb shapes "
+                    f"{[a.shape for a in arrs]} differ"
+                )
             arr = np.stack(arrs)
             if arr.dtype == np.float64:
                 arr = arr.astype(np.float32)

@@ -174,7 +174,22 @@ class GenerationEngine:
         # JaxGenConfig.retain_kv_on_abort).
         self._retained: dict[str, tuple[int, tuple, int, float]] = {}
         self._retained_slots: dict[int, str] = {}
+        # Prompt-prefix KV reuse (the SGLang radix-cache role for the
+        # dominant RL pattern): _slot_covered[i] = the token sequence (a
+        # list, appended per decoded token) whose K/V rows live in cache
+        # positions [0, len) of slot i. Rows stay
+        # valid after a sequence finishes (until the slot is re-prefilled),
+        # so a group's later samples clone the first sample's prompt rows
+        # with one device-side copy and join batched decode directly —
+        # n_samples-per-prompt rollouts prefill ONCE per group.
+        self._slot_covered: list[list] = [[] for _ in range(b)]
+        # weight version the slot's cached rows were computed under: clone
+        # sources must match the CURRENT version (fresh requests always see
+        # current-weight prefixes; in-flight/retained sequences keep their
+        # accepted staleness but stop being clone sources after an update)
+        self._slot_kv_version = np.zeros(b, np.int64)
         self.prefill_count = 0  # observability + zero-re-prefill tests
+        self.prefix_clone_count = 0
         self._lock = threading.Lock()
         self._dead: Exception | None = None
 
@@ -189,6 +204,22 @@ class GenerationEngine:
             donate_argnums=(1,),
             static_argnames=("steps",),
         )
+        self._jit_copy_kv = jax.jit(self._copy_kv_impl, donate_argnums=(0,))
+
+    @staticmethod
+    def _copy_kv_impl(cache, src, dst, n):
+        """Copy the first ``n`` cache rows of slot ``src`` into ``dst``
+        (cache leaves are [L, B, S, KH, D]; one fused masked select per
+        leaf — no host roundtrip of KV data)."""
+
+        def cp(x):
+            rows = jax.lax.dynamic_index_in_dim(x, src, 1, keepdims=False)
+            dst_rows = jax.lax.dynamic_index_in_dim(x, dst, 1, keepdims=False)
+            mask = (jnp.arange(x.shape[2]) < n)[None, :, None, None]
+            new = jnp.where(mask, rows, dst_rows)
+            return jax.lax.dynamic_update_index_in_dim(x, new, dst, 1)
+
+        return {"k": cp(cache["k"]), "v": cp(cache["v"])}
 
     # ------------------------------------------------------------------
     # Device steps
@@ -635,6 +666,8 @@ class GenerationEngine:
             if not free:
                 self._input_queue.put(seq)  # no capacity; retry next loop
                 return
+            if self._try_clone(seq, free[0]):
+                continue  # one KV row copy, no prefill compute
             self._prefill_seq(seq, free[0])
             token_budget -= self._bucket(len(seq.prompt))
 
@@ -654,7 +687,46 @@ class GenerationEngine:
         seq.slot = slot
         self.slots[slot] = seq
         self.last_token[slot] = feed_tok
+        self._slot_covered[slot] = list(covered)
         # cache_len already holds len(covered); decode feeds feed_tok next
+        return True
+
+    def _try_clone(self, seq: _Seq, dst: int) -> bool:
+        """Prompt-prefix KV reuse: if some slot already caches this exact
+        prompt minus its final token, copy those rows into ``dst`` and skip
+        prefill — the request enters decode feeding the final prompt token,
+        which produces the first-output-token logits exactly as a fresh
+        prefill would. The group-sampling fast path (n_samples identical
+        prompts -> one prefill + n-1 row copies)."""
+        if not self.config.enable_prefix_reuse or seq.images:
+            return False
+        n = len(seq.prompt)
+        if n < 2:
+            return False
+        prefix = list(seq.prompt[: n - 1])
+        src = None
+        for i, cov in enumerate(self._slot_covered):
+            if len(cov) < n - 1:
+                continue
+            if self._slot_kv_version[i] != self.version:
+                continue  # rows predate the current weights (or hold pixels)
+            if cov[: n - 1] == prefix:
+                src = i
+                if i == dst:  # in-place reuse of dst's own rows: no copy
+                    break
+        if src is None:
+            return False
+        self.prefix_clone_count += 1
+        if src != dst:
+            self.cache = self._jit_copy_kv(
+                self.cache, jnp.int32(src), jnp.int32(dst), jnp.int32(n - 1)
+            )
+        seq.slot = dst
+        self.slots[dst] = seq
+        self.cache_len[dst] = n - 1
+        self.last_token[dst] = seq.prompt[-1]
+        self._slot_covered[dst] = list(prefix)
+        self._slot_kv_version[dst] = self._slot_kv_version[src]
         return True
 
     def _prefill_seq(self, seq: _Seq, slot: int):
@@ -694,6 +766,10 @@ class GenerationEngine:
         # written by the next decode step (which feeds it at position n)
         self.cache_len[slot] = n
         self.last_token[slot] = tok_i
+        self._slot_covered[slot] = list(seq.prompt)
+        # image-conditioned rows encode pixels the token ids don't show;
+        # stamp -1 so they can never be cloned into a text request
+        self._slot_kv_version[slot] = -1 if seq.images else self.version
         if self._seq_finished(seq, tok_i):
             self._finish(slot, self._finish_reason(seq, tok_i))
 
@@ -777,6 +853,8 @@ class GenerationEngine:
                 if seq.t_last_token is not None:
                     seq.itl.append(now - seq.t_last_token)
                 seq.t_last_token = now
+                # the fed token's K/V row was just written at cache_len
+                self._slot_covered[i].append(int(self.last_token[i]))
                 self.cache_len[i] += 1
                 self.last_token[i] = tok
                 if self._seq_finished(seq, tok):
@@ -800,8 +878,14 @@ class GenerationEngine:
                 time.monotonic(),
             )
             self._retained_slots[slot] = seq.rid
-        else:
+        elif self.cache_len[slot] >= self.config.max_seq_len:
+            # a full slot leaves no row for the idle decode write (the
+            # dense per-slot write would clamp INTO the covered rows)
             self.cache_len[slot] = 0
+            self._slot_covered[slot] = []
+        # else: keep cache_len and covered — the rows stay valid as
+        # prefix-clone sources, and decode's idle write for this inactive
+        # slot lands at cache_len, one past the covered rows (harmless)
         seq.on_done(self._response(seq, reason))
 
     def _evict_retained(self, rid: str):
@@ -809,7 +893,10 @@ class GenerationEngine:
         if ent is not None:
             slot = ent[0]
             self._retained_slots.pop(slot, None)
-            self.cache_len[slot] = 0
+            if self.cache_len[slot] >= self.config.max_seq_len:
+                self.cache_len[slot] = 0
+                self._slot_covered[slot] = []
+            # rows stay valid (see _finish): still a prefix-clone source
 
     def _evict_lru_retained(self):
         if not self._retained:

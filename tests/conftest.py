@@ -22,6 +22,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: test time is dominated by CPU compiles of
+# the same tiny-model jits; caching them across runs cuts repeat-suite wall
+# time several-fold (first run pays once). Key includes backend + jax
+# version, so stale hits are not a concern.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("AREAL_TPU_TEST_CACHE", "/tmp/areal_tpu_test_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 # Suite budget (reference test strategy, SURVEY §4): the default selection

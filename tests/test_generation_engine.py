@@ -329,3 +329,82 @@ def test_mixed_sampling_batch_single_compile(model):
         assert all(len(r.output_tokens) == 6 for r in results)
     finally:
         eng.stop()
+
+
+def test_prefix_clone_one_prefill_per_group(model):
+    """The GRPO group-sampling fast path: n identical prompts cost ONE
+    prefill; later samples clone the cached prompt rows and join decode."""
+    eng = make_engine(model)
+    try:
+        prompt = list(range(5, 25))
+        g = GenerationHyperparameters(
+            max_new_tokens=8, min_new_tokens=8, greedy=True
+        )
+        rs = []
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def cb(r):
+            with lock:
+                rs.append(r)
+                if len(rs) == 3:
+                    done.set()
+
+        for i in range(3):
+            eng.submit(f"g-{i}", prompt, g, cb)
+        assert done.wait(120)
+        assert eng.prefill_count == 1, eng.prefill_count
+        assert eng.prefix_clone_count == 2, eng.prefix_clone_count
+        outs = [tuple(r.output_tokens) for r in rs]
+        # greedy: the clone path must reproduce the prefill path exactly
+        assert outs[0] == outs[1] == outs[2], outs
+    finally:
+        eng.stop()
+
+
+def test_prefix_clone_matches_no_reuse_outputs(model):
+    prompt = list(range(30, 50))
+    g = GenerationHyperparameters(max_new_tokens=6, min_new_tokens=6, greedy=True)
+    eng0 = make_engine(model, enable_prefix_reuse=False)
+    try:
+        want = run_request(eng0, "a", prompt, g).output_tokens
+        assert eng0.prefix_clone_count == 0
+    finally:
+        eng0.stop()
+    eng1 = make_engine(model)
+    try:
+        r1 = run_request(eng1, "b", prompt, g)
+        # second request clones the FINISHED first slot's rows (rows stay
+        # valid after finish until the slot is re-prefilled)
+        r2 = run_request(eng1, "c", prompt, g)
+        assert r1.output_tokens == want
+        assert r2.output_tokens == want
+        assert eng1.prefill_count == 1 and eng1.prefix_clone_count == 1
+    finally:
+        eng1.stop()
+
+
+def test_prefix_clone_invalidated_by_weight_update(model):
+    cfg, params = model
+    prompt = list(range(60, 80))
+    g = GenerationHyperparameters(max_new_tokens=4, min_new_tokens=4, greedy=True)
+    eng = make_engine(model)
+    try:
+        run_request(eng, "a", prompt, g)
+        eng.update_weights_from_arrays(params, version=1)
+        run_request(eng, "b", prompt, g)
+        # the old rows predate v1 -> full prefill, no clone
+        assert eng.prefill_count == 2 and eng.prefix_clone_count == 0
+    finally:
+        eng.stop()
+
+
+def test_different_prompts_do_not_clone(model):
+    g = GenerationHyperparameters(max_new_tokens=4, min_new_tokens=4, greedy=True)
+    eng = make_engine(model)
+    try:
+        run_request(eng, "a", list(range(5, 25)), g)
+        run_request(eng, "b", list(range(6, 26)), g)
+        assert eng.prefill_count == 2 and eng.prefix_clone_count == 0
+    finally:
+        eng.stop()

@@ -26,6 +26,7 @@ def moe_cfg(impl):
     )
 
 
+@pytest.mark.slow
 def test_ragged_matches_dense_forward_and_grad():
     cfg_r, cfg_d = moe_cfg("ragged"), moe_cfg("dense")
     params = init_params(cfg_r, jax.random.PRNGKey(0), jnp.float32)

@@ -72,6 +72,7 @@ def test_rw_training_separates_pairs():
     eng.destroy()
 
 
+@pytest.mark.slow
 def test_rw_pairs_never_split_across_microbatches():
     rng = np.random.default_rng(2)
     eng = make_rw_engine(max_tokens_per_mb=40)  # forces many microbatches

@@ -86,6 +86,7 @@ def test_check_if_recover_env(monkeypatch):
     assert check_if_recover(RecoverConfig(mode="fault"), run_id=1)
 
 
+@pytest.mark.slow
 def test_recover_roundtrip(tmp_path):
     rng = np.random.default_rng(0)
     data = dict(

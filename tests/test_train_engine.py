@@ -143,6 +143,7 @@ def test_multi_device_mesh_matches_single():
     np.testing.assert_allclose(p_s, p_m, rtol=2e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_skip_on_nonfinite_grads():
     eng = TPULMEngine(_cfg())
     eng.initialize(None, None, model_config=tiny_config(), seed=3)
@@ -179,6 +180,7 @@ def test_adam_moment_dtype_honored():
     assert state.nu["w"].dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_adafactor_smoke():
     from areal_tpu.api.cli_args import OptimizerConfig, TrainEngineConfig
     from areal_tpu.engine.sft.lm_engine import TPULMEngine
