@@ -2,7 +2,7 @@
 
 Replaces the reference's ``ReaLModelConfig`` (realhf/api/core/model_api.py:340)
 and the per-arch HF mappings (realhf/api/from_hf/*.py) with one config that
-covers the llama/qwen2/qwen3 family (dense) + MoE variants (qwen3-moe /
+covers the llama/mistral/qwen2/qwen3/gemma family (dense) + MoE variants (qwen3-moe /
 mixtral-style).
 """
 
@@ -28,6 +28,9 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: True for qkv
     qk_norm: bool = False  # qwen3
+    hidden_act: str = "silu"  # silu | gelu_tanh (gemma GeGLU)
+    rms_norm_offset: bool = False  # gemma: scale by (1 + weight)
+    scale_embeddings: bool = False  # gemma: embeddings * sqrt(hidden)
     max_position_embeddings: int = 32768
     # MoE (0 experts = dense)
     num_experts: int = 0
@@ -78,6 +81,7 @@ _HF_ARCH_MAP = {
     "Qwen3ForCausalLM": "qwen3",
     "LlamaForCausalLM": "llama",
     "MistralForCausalLM": "llama",
+    "GemmaForCausalLM": "gemma",
     "Qwen3MoeForCausalLM": "qwen3_moe",
     "MixtralForCausalLM": "mixtral",
 }
@@ -98,6 +102,20 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     arch = _HF_ARCH_MAP.get(archs[0])
     if arch is None:
         raise ValueError(f"Unsupported HF architecture: {archs[0]}")
+    window = hf.get("sliding_window")
+    window_active = window is not None and window < hf.get(
+        "max_position_embeddings", 1 << 30
+    )
+    if "use_sliding_window" in hf:  # qwen2-style gate (defaults off)
+        window_active = window_active and hf["use_sliding_window"]
+    if window_active:
+        # mistral-v0.1-style local attention is not implemented; attending
+        # over the full context would silently diverge from the checkpoint's
+        # semantics past the window
+        raise ValueError(
+            f"sliding_window={window} attention is not supported; use a "
+            "full-attention checkpoint (mistral>=v0.2 sets sliding_window=null)"
+        )
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
     num_experts = hf.get("num_experts") or hf.get("num_local_experts") or 0
@@ -111,9 +129,14 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
         head_dim=head_dim,
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
-        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        # gemma ties by default and its config.json may omit the field
+        tie_word_embeddings=hf.get("tie_word_embeddings", arch == "gemma"),
         attention_bias=arch == "qwen2" or hf.get("attention_bias", False),
         qk_norm=arch in ("qwen3", "qwen3_moe"),
+        # gemma: zero-centered norm weights, GeGLU, sqrt(H)-scaled embeddings
+        hidden_act="gelu_tanh" if arch == "gemma" else "silu",
+        rms_norm_offset=arch == "gemma",
+        scale_embeddings=arch == "gemma",
         max_position_embeddings=hf.get("max_position_embeddings", 32768),
         num_experts=num_experts,
         num_experts_per_tok=hf.get("num_experts_per_tok", 0),
@@ -131,6 +154,7 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
         "qwen2": "Qwen2ForCausalLM",
         "qwen3": "Qwen3ForCausalLM",
         "llama": "LlamaForCausalLM",
+        "gemma": "GemmaForCausalLM",
         "qwen3_moe": "Qwen3MoeForCausalLM",
         "mixtral": "MixtralForCausalLM",
     }[cfg.arch]

@@ -1,4 +1,4 @@
-"""Functional decoder-only transformer (llama/qwen2/qwen3 family, dense + MoE).
+"""Functional decoder-only transformer (llama/mistral/qwen2/qwen3/gemma family, dense + MoE).
 
 This is the TPU-native replacement for the reference's from-scratch ReaLModel
 (realhf/impl/model/nn/real_llm_api.py:100, real_llm_base.py) and for its HF
@@ -30,11 +30,33 @@ from areal_tpu.ops.rotary import apply_rope
 Params = dict[str, Any]
 
 
-def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, w: jnp.ndarray, eps: float, offset: bool = False
+) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     out = xf * jax.lax.rsqrt(var + eps)
-    return (out * w.astype(jnp.float32)).astype(x.dtype)
+    wf = w.astype(jnp.float32)
+    if offset:  # gemma stores zero-centered norm weights
+        wf = wf + 1.0
+    return (out * wf).astype(x.dtype)
+
+
+def _norm(cfg: TransformerConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    return rms_norm(x, w, cfg.rms_norm_eps, cfg.rms_norm_offset)
+
+
+def _embed(params: Params, cfg: TransformerConfig, input_ids: jnp.ndarray):
+    x = params["embed"][input_ids]
+    if cfg.scale_embeddings:  # gemma normalizer
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    return x
+
+
+def _act(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 # ---------------------------------------------------------------------------
@@ -54,21 +76,23 @@ def init_params(
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
     s = 0.02
+    # offset norms (gemma) store zero-centered weights: identity init = 0
+    norm_init = jnp.zeros if cfg.rms_norm_offset else jnp.ones
     layers: Params = {
-        "ln1": jnp.ones((l, h), dtype),
+        "ln1": norm_init((l, h), dtype),
         "wq": normal(next(keys), (l, h, qd), s),
         "wk": normal(next(keys), (l, h, kvd), s),
         "wv": normal(next(keys), (l, h, kvd), s),
         "wo": normal(next(keys), (l, qd, h), s / (2 * l) ** 0.5),
-        "ln2": jnp.ones((l, h), dtype),
+        "ln2": norm_init((l, h), dtype),
     }
     if cfg.attention_bias:
         layers["bq"] = jnp.zeros((l, qd), dtype)
         layers["bk"] = jnp.zeros((l, kvd), dtype)
         layers["bv"] = jnp.zeros((l, kvd), dtype)
     if cfg.qk_norm:
-        layers["q_norm"] = jnp.ones((l, d), dtype)
-        layers["k_norm"] = jnp.ones((l, d), dtype)
+        layers["q_norm"] = norm_init((l, d), dtype)
+        layers["k_norm"] = norm_init((l, d), dtype)
     if cfg.is_moe:
         e, mi = cfg.num_experts, cfg.moe_intermediate_size
         layers["router"] = normal(next(keys), (l, h, e), s)
@@ -83,7 +107,7 @@ def init_params(
     params: Params = {
         "embed": normal(next(keys), (cfg.vocab_size, h), s),
         "layers": layers,
-        "final_norm": jnp.ones((h,), dtype),
+        "final_norm": norm_init((h,), dtype),
     }
     if cfg.is_vlm:
         from areal_tpu.models.vlm import init_vision_params
@@ -114,8 +138,8 @@ def _qkv(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
     k = k.reshape(*x.shape[:-1], cfg.num_key_value_heads, cfg.head_dim)
     v = v.reshape(*x.shape[:-1], cfg.num_key_value_heads, cfg.head_dim)
     if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = _norm(cfg, q, lp["q_norm"])
+        k = _norm(cfg, k, lp["k_norm"])
     return q, k, v
 
 
@@ -127,7 +151,7 @@ def _mlp(
 ) -> jnp.ndarray:
     if cfg.is_moe:
         return _moe_mlp(cfg, lp, x, attn_spec)
-    return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+    return (_act(cfg, x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
 
 
 def _moe_mlp(
@@ -205,13 +229,13 @@ def _block(
     attn_spec: AttnSpec | None = None,
 ) -> jnp.ndarray:
     """One decoder block over a packed stream. x [T, H]."""
-    h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+    h = _norm(cfg, x, lp["ln1"])
     q, k, v = _qkv(cfg, lp, h)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
     x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
-    h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+    h = _norm(cfg, x, lp["ln2"])
     x = x + _mlp(cfg, lp, h, attn_spec)
     return x
 
@@ -247,7 +271,7 @@ def forward_packed(
     remat_policy: str = "nothing_saveable",
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
-    x = params["embed"][input_ids]
+    x = _embed(params, cfg, input_ids)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
 
@@ -265,7 +289,7 @@ def forward_packed(
             )
         body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[:, 0]
     head = params.get("lm_head")
@@ -312,7 +336,7 @@ def prefill(
     tp = input_ids.shape[0]
     positions = jnp.arange(tp, dtype=jnp.int32)
     segment_ids = jnp.where(positions < length, 0, -1)
-    x = params["embed"][input_ids]
+    x = _embed(params, cfg, input_ids)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
 
@@ -320,18 +344,18 @@ def prefill(
         x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
-        h = rms_norm(carry, lp["ln1"], cfg.rms_norm_eps)
+        h = _norm(cfg, carry, lp["ln1"])
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
         attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
         out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
-        h2 = rms_norm(out, lp["ln2"], cfg.rms_norm_eps)
+        h2 = _norm(cfg, out, lp["ln2"])
         out = out + _mlp(cfg, lp, h2, attn_spec)
         return out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     h_last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=0, keepdims=False)
     head = params.get("lm_head")
     if head is None:
@@ -355,13 +379,13 @@ def decode_step(
     tokens should mask results host-side; the cache write is dense per slot.
     """
     b, tq = input_ids.shape
-    x = params["embed"][input_ids]  # [B, Tq, H]
+    x = _embed(params, cfg, input_ids)  # [B, Tq, H]
     positions = cache_len[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
 
     def body(carry, layer_in):
         h_in, = carry
         lp, k_cache, v_cache = layer_in
-        h = rms_norm(h_in, lp["ln1"], cfg.rms_norm_eps)
+        h = _norm(cfg, h_in, lp["ln1"])
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
@@ -376,7 +400,7 @@ def decode_step(
         v_cache = write(v_cache, v.astype(v_cache.dtype))
         attn = decode_attention_xla(q, k_cache, v_cache, cache_len + tq)
         h_out = h_in + attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
-        h2 = rms_norm(h_out, lp["ln2"], cfg.rms_norm_eps)
+        h2 = _norm(cfg, h_out, lp["ln2"])
         mlp_in_shape = h2.shape
         mlp_out = _mlp(
             cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
@@ -387,7 +411,7 @@ def decode_step(
     (x,), (new_k, new_v) = jax.lax.scan(
         body, (x,), (params["layers"], cache["k"], cache["v"])
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T

@@ -166,9 +166,9 @@ def forward_packed_pipelined(
     sharded over (pp, dp, cp) — every device works on head FLOPs, none
     duplicates them.
     """
-    from areal_tpu.models.lm import rms_norm
+    from areal_tpu.models.lm import _embed, _norm
 
-    x = params["embed"][input_ids]  # [M, T, H]
+    x = _embed(params, cfg, input_ids)  # [M, T, H]
     x = pipeline_hidden(
         params,
         cfg,
@@ -185,7 +185,7 @@ def forward_packed_pipelined(
     x = jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(None, (AXIS_PP, AXIS_DP, AXIS_CP), None))
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[..., 0]
     head = params.get("lm_head")

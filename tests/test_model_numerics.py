@@ -171,3 +171,52 @@ def test_critic_head():
         params, cfg, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)
     )
     assert values.shape == (4,)
+
+
+def test_forward_matches_hf_gemma(tmp_path):
+    """Gemma family: (1+w) RMSNorm, GeGLU, sqrt(H)-scaled embeddings, tied
+    head (reference parity: realhf/api/from_hf gemma mapping)."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=256,
+        hidden_act="gelu_pytorch_tanh",
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = GemmaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "hf_gemma"
+    model.save_pretrained(d, safe_serialization=True)
+
+    cfg = from_hf_config(str(d))
+    assert cfg.arch == "gemma"
+    assert cfg.rms_norm_offset and cfg.scale_embeddings
+    assert cfg.hidden_act == "gelu_tanh" and cfg.tie_word_embeddings
+    cfg2, params = hf_io.load_hf_params(str(d), cfg, dtype="float32")
+
+    lens = [7, 5]
+    ids, flat, pos, seg = _packed_inputs(lens)
+    ours = np.asarray(
+        lm.forward_packed(
+            params, cfg, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)
+        )
+    )
+    with torch.no_grad():
+        off = 0
+        for seq in ids:
+            hf_logits = model(torch.tensor(seq[None].astype(np.int64))).logits[0]
+            np.testing.assert_allclose(
+                ours[off : off + len(seq)],
+                hf_logits.float().numpy(),
+                rtol=3e-4,
+                atol=3e-4,
+            )
+            off += len(seq)
