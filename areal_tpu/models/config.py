@@ -29,6 +29,7 @@ class TransformerConfig:
     attention_bias: bool = False  # qwen2: True for qkv
     qk_norm: bool = False  # qwen3
     hidden_act: str = "silu"  # silu | gelu_tanh (gemma GeGLU)
+    sliding_window: int = 0  # >0 = mistral-style local attention window
     rms_norm_offset: bool = False  # gemma: scale by (1 + weight)
     scale_embeddings: bool = False  # gemma: embeddings * sqrt(hidden)
     max_position_embeddings: int = 32768
@@ -109,13 +110,20 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     if "use_sliding_window" in hf:  # qwen2-style gate (defaults off)
         window_active = window_active and hf["use_sliding_window"]
     if window_active:
-        # mistral-v0.1-style local attention is not implemented; attending
-        # over the full context would silently diverge from the checkpoint's
-        # semantics past the window
-        raise ValueError(
-            f"sliding_window={window} attention is not supported; use a "
-            "full-attention checkpoint (mistral>=v0.2 sets sliding_window=null)"
-        )
+        # qwen2-style per-layer gating: HF applies the window only to
+        # layers >= max_window_layers; we model a UNIFORM window, so a
+        # mixed split would silently diverge — reject it, and treat a
+        # split at/past the depth as fully windowed off
+        mwl = hf.get("max_window_layers")
+        if mwl is not None:
+            if mwl >= hf["num_hidden_layers"]:
+                window_active = False
+            elif mwl > 0:
+                raise ValueError(
+                    f"per-layer sliding-window split (max_window_layers="
+                    f"{mwl} of {hf['num_hidden_layers']}) is not supported;"
+                    " only uniform windows (max_window_layers=0) are"
+                )
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
     num_experts = hf.get("num_experts") or hf.get("num_local_experts") or 0
@@ -135,6 +143,7 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
         qk_norm=arch in ("qwen3", "qwen3_moe"),
         # gemma: zero-centered norm weights, GeGLU, sqrt(H)-scaled embeddings
         hidden_act="gelu_tanh" if arch == "gemma" else "silu",
+        sliding_window=int(window) if window_active else 0,
         rms_norm_offset=arch == "gemma",
         scale_embeddings=arch == "gemma",
         max_position_embeddings=hf.get("max_position_embeddings", 32768),
@@ -175,6 +184,17 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
         "model_type": cfg.arch,
         "attention_bias": cfg.attention_bias,
     }
+    if cfg.sliding_window > 0:
+        out["sliding_window"] = cfg.sliding_window
+        if cfg.arch == "llama":
+            # a sliding-window llama IS a mistral: export under the arch
+            # whose HF modeling code actually applies the window
+            out["architectures"] = ["MistralForCausalLM"]
+            out["model_type"] = "mistral"
+        else:
+            # qwen2-style gate: window on every layer
+            out["use_sliding_window"] = True
+            out["max_window_layers"] = 0
     if cfg.is_moe:
         out.update(
             num_experts=cfg.num_experts,

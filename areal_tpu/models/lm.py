@@ -233,7 +233,9 @@ def _block(
     q, k, v = _qkv(cfg, lp, h)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
+    attn = packed_attention(
+        q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
+    )
     x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
     h = _norm(cfg, x, lp["ln2"])
     x = x + _mlp(cfg, lp, h, attn_spec)
@@ -348,7 +350,9 @@ def prefill(
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        attn = packed_attention(q, k, v, segment_ids, spec=attn_spec)
+        attn = packed_attention(
+            q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
+        )
         out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
         h2 = _norm(cfg, out, lp["ln2"])
         out = out + _mlp(cfg, lp, h2, attn_spec)
@@ -398,7 +402,9 @@ def decode_step(
 
         k_cache = write(k_cache, k.astype(k_cache.dtype))
         v_cache = write(v_cache, v.astype(v_cache.dtype))
-        attn = decode_attention_xla(q, k_cache, v_cache, cache_len + tq)
+        attn = decode_attention_xla(
+            q, k_cache, v_cache, cache_len + tq, window=cfg.sliding_window
+        )
         h_out = h_in + attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
         h2 = _norm(cfg, h_out, lp["ln2"])
         mlp_in_shape = h2.shape

@@ -143,10 +143,30 @@ def packed_attention(
     segment_ids: jnp.ndarray,
     softmax_scale: float | None = None,
     spec: AttnSpec | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Dispatch per ``spec`` (see module docstring). Same [T, ...] packed
     layout in all cases."""
     spec = spec if spec is not None else _DEFAULT_SPEC
+    if window > 0:
+        # sliding window exists only on the local einsum path for now; the
+        # ring/ulysses/pallas variants would silently attend outside the
+        # window. O(T^2) mask memory — windowed flash blocks are the
+        # planned upgrade for long-context SWA.
+        if spec.is_sharded:
+            raise NotImplementedError(
+                "sliding-window attention is not implemented for "
+                "ring/ulysses/TP-sharded dispatch; run sliding-window "
+                "models on a dp=cp=tp=1 mesh"
+            )
+        if spec.impl in ("pallas", "pallas_interpret"):
+            raise NotImplementedError(
+                "sliding-window attention has no Pallas kernel yet; use "
+                "impl='auto' or 'xla'"
+            )
+        return packed_attention_xla(
+            q, k, v, segment_ids, softmax_scale, window
+        )
     if spec.is_sharded:
         if spec.impl == "ulysses":
             from areal_tpu.ops.ulysses import ulysses_attention_sharded
@@ -194,11 +214,15 @@ def packed_attention_xla(
     v: jnp.ndarray,
     segment_ids: jnp.ndarray,
     softmax_scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Causal self-attention over one packed token stream.
 
     q [T, NH, D], k/v [T, KH, D], segment_ids [T] (pad tokens = -1).
     Returns [T, NH, D]. fp32 softmax, bf16-friendly elsewhere.
+    ``window > 0`` = mistral-style sliding window: each token sees at most
+    the ``window`` most recent keys of its own segment (stream distance ==
+    position distance inside a packed segment).
     """
     t, nh, d = q.shape
     kh = k.shape[1]
@@ -213,6 +237,8 @@ def packed_attention_xla(
         segment_ids[:, None] >= 0
     )
     mask = causal & same_seg
+    if window > 0:
+        mask = mask & (idx[:, None] - idx[None, :] < window)
     logits = jnp.where(mask[None, :, :], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
@@ -225,6 +251,7 @@ def decode_attention_xla(
     v_cache: jnp.ndarray,
     cache_len: jnp.ndarray,
     softmax_scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Batched decode attention against a KV cache.
 
@@ -247,7 +274,10 @@ def decode_attention_xla(
     logits = logits * scale
     kpos = jnp.arange(s)[None, None, :]  # [1,1,S]
     qpos = (cache_len[:, None] - tq + jnp.arange(tq)[None, :])[:, :, None]  # [B,Tq,1]
-    mask = (kpos <= qpos)[:, None, None, :, :]  # causal within cache
+    mask = kpos <= qpos  # causal within cache
+    if window > 0:
+        mask = mask & (qpos - kpos < window)
+    mask = mask[:, None, None, :, :]
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(

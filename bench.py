@@ -133,9 +133,13 @@ def sft_bench(
         engine.destroy()
 
 
-def decode_bench(layers: int = 28, n_requests: int = 32, prompt_len: int = 128,
-                 new_tokens: int = 128):
-    """Continuous-batching decode throughput on the GenerationEngine."""
+def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
+                 new_tokens: int = 128, batch: int = 48, steps_per_call: int = 32):
+    """Continuous-batching decode throughput on the GenerationEngine.
+
+    Decode is HBM-bound (every step re-reads the 3GB bf16 params), so
+    aggregate tokens/s scales with concurrent slots until compute-bound;
+    the batch value is picked to fit KV + params + logits in 16GB."""
     import threading
 
     from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
@@ -144,13 +148,13 @@ def decode_bench(layers: int = 28, n_requests: int = 32, prompt_len: int = 128,
     model_cfg = qwen2_1p5b_cfg(layers)
     eng = GenerationEngine(
         JaxGenConfig(
-            max_batch_size=16,
+            max_batch_size=batch,
             max_seq_len=512,
             prefill_chunk=128,
             # long decode chains amortize per-dispatch latency (the bench
             # tunnel adds ~70ms RTT per host sync; real hosts ~none) at the
             # cost of post-EOS overshoot — fine for fixed-length decode
-            decode_steps_per_call=16,
+            decode_steps_per_call=steps_per_call,
             dtype="bfloat16",
         ),
         model_config=model_cfg,

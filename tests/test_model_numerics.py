@@ -220,3 +220,81 @@ def test_forward_matches_hf_gemma(tmp_path):
                 atol=3e-4,
             )
             off += len(seq)
+
+
+def test_forward_matches_hf_mistral_sliding_window(tmp_path):
+    """Active sliding-window (mistral v0.1 semantics): logits must match HF
+    past the window, where local attention diverges from full-causal."""
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=256,
+        sliding_window=6,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = MistralForCausalLM(hf_cfg).eval()
+    # eager attention applies the sliding-window mask in HF
+    model.config._attn_implementation = "eager"
+    d = tmp_path / "hf_mistral"
+    model.save_pretrained(d, safe_serialization=True)
+
+    cfg = from_hf_config(str(d))
+    assert cfg.sliding_window == 6 and cfg.arch == "llama"
+    cfg2, params = hf_io.load_hf_params(str(d), cfg, dtype="float32")
+
+    lens = [16, 9]  # longer than the window
+    ids, flat, pos, seg = _packed_inputs(lens)
+    ours = np.asarray(
+        lm.forward_packed(
+            params, cfg, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)
+        )
+    )
+    with torch.no_grad():
+        off = 0
+        for seq in ids:
+            hf_logits = model(torch.tensor(seq[None].astype(np.int64))).logits[0]
+            np.testing.assert_allclose(
+                ours[off : off + len(seq)],
+                hf_logits.float().numpy(),
+                rtol=3e-4,
+                atol=3e-4,
+            )
+            off += len(seq)
+
+
+def test_decode_matches_forward_with_window():
+    """Sliding-window decode against the cache == packed forward."""
+    cfg = tiny_config(sliding_window=5, attention_bias=False)
+    params = lm.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    n = 12
+    rng = np.random.default_rng(3)
+    seq = rng.integers(1, 128, size=n).astype(np.int32)
+    pos = np.arange(n, dtype=np.int32)
+    seg = np.zeros(n, np.int32)
+    want = np.asarray(
+        lm.forward_packed(
+            params, cfg, jnp.asarray(seq), jnp.asarray(pos), jnp.asarray(seg)
+        )
+    )
+
+    from areal_tpu.models.lm import decode_step, init_kv_cache
+
+    cache = init_kv_cache(cfg, 1, 32, jnp.float32)
+    got = []
+    clen = jnp.zeros(1, jnp.int32)
+    for t in range(n):
+        logits, cache = decode_step(
+            params, cfg, cache, jnp.asarray([[seq[t]]]), clen
+        )
+        got.append(np.asarray(logits)[0, 0])
+        clen = clen + 1
+    np.testing.assert_allclose(np.stack(got), want, rtol=2e-4, atol=2e-4)
