@@ -63,6 +63,7 @@ class RemoteInfEngine(InferenceEngine):
         self._rid_queue: list[str] = []
         self._version = 0
         self._paused = threading.Event()
+        self._spectator = False  # set by initialize() under multi-host
         self.executor = WorkflowExecutor(config, self)
         # one ClientSession per event loop (the rollout thread's loop is the
         # long-lived one; keepalive pooling matters there)
@@ -75,15 +76,16 @@ class RemoteInfEngine(InferenceEngine):
     def initialize(self, addr: str | list[str] | None = None, train_data_parallel_size: int | None = None):
         from areal_tpu.parallel import distributed
 
-        if distributed.process_count() > 1:
-            # async rollout coordination across hosts (the DP-head
-            # redistribution role) is not wired yet; N hosts each running a
-            # rollout client would double-submit every prompt. Guarded HERE
-            # so every rollout entry point fails loudly, not just grpo.
-            raise NotImplementedError(
-                "multi-host rollout needs the cross-host coordinator; "
-                "run the rollout client on one process (or use the SFT path)"
-            )
+        # Multi-host: host 0 is the rollout head (the reference's DP-head
+        # coordinator role, areal/core/dist_rollout.py:43-93) — it alone
+        # talks to the generation servers and runs the workflow executor;
+        # the other hosts are spectators that only join the per-step
+        # broadcast+shard scatter in rollout_batch/prepare_batch.
+        self._spectator = (
+            distributed.process_count() > 1 and not distributed.is_main()
+        )
+        if self._spectator:
+            return
         if addr:
             self.addresses = [addr] if isinstance(addr, str) else list(addr)
         elif os.environ.get("AREAL_LLM_SERVER_ADDRS"):
@@ -93,6 +95,11 @@ class RemoteInfEngine(InferenceEngine):
         if not self.addresses:
             raise RuntimeError("no generation servers found")
         logger.info("RemoteInfEngine using servers: %s", self.addresses)
+        if distributed.process_count() > 1:
+            # head-only executor: this process produces the GLOBAL batch for
+            # all hosts, so the per-DP-rank budget split (which assumed one
+            # executor per rank) must not shrink its staleness capacity
+            train_data_parallel_size = 1
         self.executor.initialize(train_data_parallel_size)
 
     def _discover_servers(self) -> list[str]:
@@ -243,6 +250,9 @@ class RemoteInfEngine(InferenceEngine):
     def update_weights(self, meta: WeightUpdateMeta):
         """Fan the update out to every server. Caller (train engine) has
         already written the checkpoint for the disk path."""
+        if self._spectator:
+            self._version += 1  # stay in step with the head's version
+            return
         if meta.type != "disk":
             raise NotImplementedError(
                 f"weight update type {meta.type!r}; device path is driven by "
@@ -363,11 +373,15 @@ class RemoteInfEngine(InferenceEngine):
 
     def pause(self):
         """Pause servers + the local rollout runtime (weight-update fence)."""
+        if self._spectator:
+            return
         self._paused.set()
         self._fanout("pause_generation")
         self.executor.pause()
 
     def resume(self):
+        if self._spectator:
+            return
         self._fanout("continue_generation")
         self._paused.clear()
         self.executor.resume()
@@ -404,13 +418,57 @@ class RemoteInfEngine(InferenceEngine):
         self._version = version
 
     def submit(self, data, workflow=None, workflow_builder: Callable | None = None):
+        if getattr(self, "_spectator", False):
+            raise RuntimeError(
+                "submit/wait run on the rollout head (host 0) only; "
+                "spectator hosts use rollout_batch/prepare_batch, which "
+                "scatter the head's results"
+            )
         self.executor.submit(data, workflow, workflow_builder)
 
     def wait(self, count: int, timeout: float | None = None):
+        if getattr(self, "_spectator", False):
+            raise RuntimeError("wait() is head-only; see submit()")
         return self.executor.wait(count, timeout=timeout)
 
+    def _scatter_batch(self, batch):
+        """Broadcast host 0's full rollout batch, return this host's row
+        shard: CONTIGUOUS equal blocks in process order. Contiguity keeps
+        each prompt's n_samples group whole on one host (group-level
+        reward/advantage norm and dynamic sampling reshape contiguous
+        groups), and matches the train engine's host-local-to-global
+        assembly order. The row count must divide evenly — silently
+        dropping completed trajectories or handing a host an empty batch
+        would be worse than failing."""
+        from areal_tpu.parallel import distributed
+
+        nprocs = distributed.process_count()
+        if nprocs == 1:
+            return batch
+        if batch is not None:
+            batch = {k: np.asarray(v) for k, v in batch.items()}
+        batch = distributed.broadcast_obj(batch)
+        n = len(next(iter(batch.values())))
+        if n % nprocs != 0:
+            raise ValueError(
+                f"rollout batch of {n} rows does not divide over {nprocs} "
+                "hosts; make batch_size (prompts per step) a multiple of "
+                "the host count"
+            )
+        per = n // nprocs
+        lo = distributed.process_index() * per
+        return {k: v[lo : lo + per] for k, v in batch.items()}
+
     def rollout_batch(self, data: list[Any], workflow=None, workflow_builder=None):
-        return self.executor.rollout_batch(data, workflow, workflow_builder)
+        if getattr(self, "_spectator", False):
+            return self._scatter_batch(None)
+        return self._scatter_batch(
+            self.executor.rollout_batch(data, workflow, workflow_builder)
+        )
 
     def prepare_batch(self, dataloader, workflow=None, workflow_builder=None):
-        return self.executor.prepare_batch(dataloader, workflow, workflow_builder)
+        if getattr(self, "_spectator", False):
+            return self._scatter_batch(None)
+        return self._scatter_batch(
+            self.executor.prepare_batch(dataloader, workflow, workflow_builder)
+        )

@@ -1153,9 +1153,14 @@ class TPUTrainEngine(TrainEngine):
     def upload_weights(self, meta: WeightUpdateMeta):
         if meta.type == "disk":
             assert meta.path is not None
-            hf_io.save_hf_params(
-                self.effective_params(), self.model_config, meta.path
-            )
+            params = self.effective_params()
+            if distributed.process_count() > 1:
+                # leaf-streamed: non-main hosts join each gather collective
+                # but never hold more than one leaf in host RAM
+                params = distributed.gather_tree_for_main(params)
+                if not distributed.is_main():
+                    return
+            hf_io.save_hf_params(params, self.model_config, meta.path)
         elif meta.type in ("device", "http"):
             pass  # live handle / streamed by update_weights
         else:
@@ -1179,8 +1184,14 @@ class TPUTrainEngine(TrainEngine):
                 else:
                     yield path, v
 
+        multi = distributed.process_count() > 1
         for path, leaf in walk(self.effective_params(), ""):
-            arr = np.asarray(jax.device_get(leaf))
+            if multi:
+                # cross-host sharded leaf: every host joins the gather (a
+                # collective) even though only host 0 pushes the chunks
+                arr = distributed.gather_host_values(leaf)
+            else:
+                arr = np.asarray(jax.device_get(leaf))
             if cur and size + arr.nbytes > budget:
                 yield cur
                 cur, size = {}, 0
@@ -1212,9 +1223,12 @@ class TPUTrainEngine(TrainEngine):
             assert target is not None and hasattr(
                 target, "update_weights_from_tensors"
             ), "http weight updates need a RemoteInfEngine"
-            target.update_weights_from_tensors(
-                self._weight_chunks(meta.chunked_mem_mb), next_version
-            )
+            chunks = self._weight_chunks(meta.chunked_mem_mb)
+            if distributed.process_count() > 1 and not distributed.is_main():
+                for _ in chunks:  # join the per-leaf gather collectives
+                    pass
+            else:
+                target.update_weights_from_tensors(chunks, next_version)
         else:
             self.upload_weights(meta)
             if self._rollout_engine is not None:

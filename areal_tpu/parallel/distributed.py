@@ -150,6 +150,48 @@ def sync_max_vector(values, length: int) -> np.ndarray:
     return np.max(multihost_utils.process_allgather(padded), axis=0)
 
 
+def broadcast_obj(obj):
+    """Broadcast an arbitrary picklable object from process 0 to all.
+
+    The cross-host rollout distribution primitive (the reference moves
+    rollout batches between DP ranks with torch broadcast,
+    areal/utils/data.py:838-1006; here: pickle -> two fixed-shape
+    broadcast_one_to_all collectives, length then payload). Every process
+    must call this in the same order; non-source processes pass obj=None.
+    """
+    if jax.process_count() == 1:
+        return obj
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == 0:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        ln = np.array([payload.size], np.int64)
+    else:
+        payload = None
+        ln = np.zeros(1, np.int64)
+    ln = int(multihost_utils.broadcast_one_to_all(ln)[0])
+    if payload is None:
+        payload = np.zeros(ln, np.uint8)
+    payload = multihost_utils.broadcast_one_to_all(payload)
+    return pickle.loads(bytes(payload.tobytes()))
+
+
+def gather_tree_for_main(tree):
+    """Gather a cross-host-sharded pytree to host RAM on process 0 ONLY,
+    leaf by leaf: every host joins each per-leaf collective, but non-main
+    hosts discard the result immediately, so their peak extra host memory
+    is one leaf instead of the whole model."""
+    main = is_main()
+
+    def g(leaf):
+        arr = gather_host_values(leaf)
+        return arr if main else None
+
+    return jax.tree.map(g, tree)
+
+
 def gather_host_values(tree):
     """Fully-replicated host copy of a (possibly cross-host sharded) pytree;
     every process must call this (it is a collective)."""

@@ -19,8 +19,9 @@ apply_platform_env()
 
 from areal_tpu.parallel import distributed  # noqa: E402
 
-# no-op single-process; multi-host rollout is rejected loudly inside
-# RemoteInfEngine.initialize until the cross-host coordinator lands
+# no-op single-process; under multi-host (jax.distributed), host 0 becomes
+# the rollout head and the other hosts receive their row shards through
+# RemoteInfEngine's per-step broadcast+shard scatter
 distributed.initialize()
 
 import numpy as np  # noqa: E402

@@ -93,6 +93,35 @@ def main():
         multihost_utils.process_allgather(eng.params["embed"], tiled=True)
     print(f"proc {pid} done losses={losses}")
 
+    # ---- cross-host rollout scatter (the DP-head coordinator role,
+    # reference areal/core/dist_rollout.py:43-93): host 0 holds the full
+    # rollout batch; every host gets its row shard via broadcast_obj ----
+    from areal_tpu.api.cli_args import InferenceEngineConfig
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+
+    rollout = RemoteInfEngine(InferenceEngineConfig())
+    rollout._spectator = not distributed.is_main()
+    full = dict(
+        input_ids=np.arange(4 * 6, dtype=np.int32).reshape(4, 6),
+        rewards=np.asarray([0.0, 1.0, 2.0, 3.0], np.float32),
+    )
+    shard = rollout._scatter_batch(full if distributed.is_main() else None)
+    # contiguous blocks in process order (keeps n_samples groups whole)
+    per = 4 // nprocs
+    expect_rows = list(range(pid * per, (pid + 1) * per))
+    assert shard["input_ids"].shape == (len(expect_rows), 6)
+    np.testing.assert_array_equal(
+        shard["rewards"], full["rewards"][expect_rows]
+    )
+    np.testing.assert_array_equal(
+        shard["input_ids"], full["input_ids"][expect_rows]
+    )
+    # spectator control-plane calls are safe no-ops
+    if rollout._spectator:
+        rollout.pause()
+        rollout.resume()
+    print(f"proc {pid} scatter ok rows={expect_rows}")
+
 
 if __name__ == "__main__":
     main()
