@@ -431,13 +431,13 @@ class RemoteInfEngine(InferenceEngine):
             raise RuntimeError("wait() is head-only; see submit()")
         return self.executor.wait(count, timeout=timeout)
 
-    def _scatter_batch(self, batch):
+    def _scatter_batch(self, batch, n_groups: int | None = None):
         """Broadcast host 0's full rollout batch, return this host's row
-        shard: CONTIGUOUS equal blocks in process order. Contiguity keeps
-        each prompt's n_samples group whole on one host (group-level
-        reward/advantage norm and dynamic sampling reshape contiguous
-        groups), and matches the train engine's host-local-to-global
-        assembly order. The row count must divide evenly — silently
+        shard: CONTIGUOUS equal blocks in process order. Contiguity plus
+        the PROMPT-count divisibility check keep each prompt's n_samples
+        group whole on one host (group-level reward/advantage norm and
+        dynamic sampling reshape contiguous groups), and the block order
+        matches the train engine's host-local-to-global assembly. Silently
         dropping completed trajectories or handing a host an empty batch
         would be worse than failing."""
         from areal_tpu.parallel import distributed
@@ -447,13 +447,20 @@ class RemoteInfEngine(InferenceEngine):
             return batch
         if batch is not None:
             batch = {k: np.asarray(v) for k, v in batch.items()}
-        batch = distributed.broadcast_obj(batch)
+        batch, n_groups = distributed.broadcast_obj(
+            (batch, n_groups) if batch is not None else None
+        )
         n = len(next(iter(batch.values())))
+        if n_groups is not None and n_groups % nprocs != 0:
+            raise ValueError(
+                f"rollout batch of {n_groups} prompt groups does not divide "
+                f"over {nprocs} hosts; make batch_size (prompts per step) a "
+                "multiple of the host count"
+            )
         if n % nprocs != 0:
             raise ValueError(
                 f"rollout batch of {n} rows does not divide over {nprocs} "
-                "hosts; make batch_size (prompts per step) a multiple of "
-                "the host count"
+                "hosts (uneven sample groups?)"
             )
         per = n // nprocs
         lo = distributed.process_index() * per
@@ -463,12 +470,14 @@ class RemoteInfEngine(InferenceEngine):
         if getattr(self, "_spectator", False):
             return self._scatter_batch(None)
         return self._scatter_batch(
-            self.executor.rollout_batch(data, workflow, workflow_builder)
+            self.executor.rollout_batch(data, workflow, workflow_builder),
+            n_groups=len(data),
         )
 
     def prepare_batch(self, dataloader, workflow=None, workflow_builder=None):
         if getattr(self, "_spectator", False):
             return self._scatter_batch(None)
         return self._scatter_batch(
-            self.executor.prepare_batch(dataloader, workflow, workflow_builder)
+            self.executor.prepare_batch(dataloader, workflow, workflow_builder),
+            n_groups=self.config.consumer_batch_size,
         )

@@ -148,24 +148,14 @@ def packed_attention(
     """Dispatch per ``spec`` (see module docstring). Same [T, ...] packed
     layout in all cases."""
     spec = spec if spec is not None else _DEFAULT_SPEC
-    if window > 0:
-        # sliding window exists only on the local einsum path for now; the
-        # ring/ulysses/pallas variants would silently attend outside the
-        # window. O(T^2) mask memory — windowed flash blocks are the
-        # planned upgrade for long-context SWA.
-        if spec.is_sharded:
-            raise NotImplementedError(
-                "sliding-window attention is not implemented for "
-                "ring/ulysses/TP-sharded dispatch; run sliding-window "
-                "models on a dp=cp=tp=1 mesh"
-            )
-        if spec.impl in ("pallas", "pallas_interpret"):
-            raise NotImplementedError(
-                "sliding-window attention has no Pallas kernel yet; use "
-                "impl='auto' or 'xla'"
-            )
-        return packed_attention_xla(
-            q, k, v, segment_ids, softmax_scale, window
+    if window > 0 and spec.is_sharded:
+        # sliding window runs on the LOCAL paths only (flash kernel with
+        # window block-skipping, or the einsum fallback); the ring/ulysses
+        # wrappers would silently attend outside the window
+        raise NotImplementedError(
+            "sliding-window attention is not implemented for "
+            "ring/ulysses/TP-sharded dispatch; run sliding-window "
+            "models on a dp=cp=tp=1 mesh"
         )
     if spec.is_sharded:
         if spec.impl == "ulysses":
@@ -196,9 +186,9 @@ def packed_attention(
 
         return flash_attention_packed(
             q, k, v, segment_ids, softmax_scale, spec.block,
-            impl == "pallas_interpret",
+            impl == "pallas_interpret", window,
         )
-    return packed_attention_xla(q, k, v, segment_ids, softmax_scale)
+    return packed_attention_xla(q, k, v, segment_ids, softmax_scale, window)
 
 
 def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:

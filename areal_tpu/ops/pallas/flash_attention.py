@@ -62,18 +62,26 @@ def _seg_ranges(segment_ids: jnp.ndarray, block: int):
     return mn, mx
 
 
-def _block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk):
+def _block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk, window=0):
     q0, k0 = starts[0], starts[1]
     causal = (k0 + ki * bk) <= (q0 + qi * bq + bq - 1)
     overlap = (kmax[ki] >= qmin[qi]) & (kmin[ki] <= qmax[qi])
     valid = (qmax[qi] >= 0) & (kmax[ki] >= 0)
-    return causal & overlap & valid
+    live = causal & overlap & valid
+    if window > 0:
+        # sliding window: a block pair is dead when even the NEWEST key of
+        # the k block is >= window behind the OLDEST query of the q block
+        live = live & ((q0 + qi * bq) - (k0 + ki * bk + bk - 1) < window)
+    return live
 
 
-def _mask(segq, segk, starts, qi, ki, bq, bk):
+def _mask(segq, segk, starts, qi, ki, bq, bk, window=0):
     qpos = starts[0] + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = starts[1] + ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return (kpos <= qpos) & (segq == segk.T) & (segq >= 0)
+    m = (kpos <= qpos) & (segq == segk.T) & (segq >= 0)
+    if window > 0:
+        m = m & (qpos - kpos < window)
+    return m
 
 
 # ---------------------------------------------------------------------------
@@ -86,7 +94,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, segq_ref, segk_ref,
     o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
-    *, scale: float, bq: int, bk: int, nk: int,
+    *, scale: float, bq: int, bk: int, nk: int, window: int,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -96,7 +104,7 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk, window))
     def _compute():
         q = q_ref[:, :]
         k = k_ref[:, :]
@@ -104,7 +112,7 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk, window)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -133,7 +141,7 @@ def _fwd_kernel(
         lse_ref[:, :] = jnp.broadcast_to(lse, (lse.shape[0], 8))
 
 
-def _fwd(q, k, v, segq, segk, starts, scale, block: int, interpret: bool):
+def _fwd(q, k, v, segq, segk, starts, scale, block: int, interpret: bool, window: int = 0):
     tq, nh, d = q.shape
     tk, kh = k.shape[0], k.shape[1]
     group = nh // kh
@@ -153,7 +161,7 @@ def _fwd(q, k, v, segq, segk, starts, scale, block: int, interpret: bool):
     kh_ = jnp.transpose(k, (1, 0, 2))
     vh = jnp.transpose(v, (1, 0, 2))
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk, window=window
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
@@ -201,7 +209,7 @@ def _dq_kernel(
     q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
     dq_ref,
     dq_scr,
-    *, scale: float, bq: int, bk: int, nk: int,
+    *, scale: float, bq: int, bk: int, nk: int, window: int,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -209,7 +217,7 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk, window))
     def _compute():
         q = q_ref[:, :]
         k = k_ref[:, :]
@@ -218,7 +226,7 @@ def _dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk, window)
         s = jnp.where(mask, s, NEG_INF)
         lse = lse_ref[:, 0:1]  # [bq, 1]
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
@@ -241,7 +249,7 @@ def _dkv_kernel(
     q_ref, k_ref, v_ref, segq_ref, segk_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale: float, bq: int, bk: int, nq: int,
+    *, scale: float, bq: int, bk: int, nq: int, window: int,
 ):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
@@ -250,7 +258,7 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk))
+    @pl.when(_block_live(qmin, qmax, kmin, kmax, starts, qi, ki, bq, bk, window))
     def _compute():
         q = q_ref[:, :]
         k = k_ref[:, :]
@@ -259,7 +267,7 @@ def _dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk)
+        mask = _mask(segq_ref[:, :], segk_ref[:, :], starts, qi, ki, bq, bk, window)
         s = jnp.where(mask, s, NEG_INF)
         lse = lse_ref[:, 0:1]
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
@@ -282,7 +290,7 @@ def _dkv_kernel(
         dv_ref[:, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _bwd(block, interpret, scale, res, dout, dlse=None):
+def _bwd(block, interpret, scale, res, dout, dlse=None, window: int = 0):
     q, k, v, segq, segk, starts, o, lse = res
     tq, nh, d = q.shape
     tk, kh = k.shape[0], k.shape[1]
@@ -310,7 +318,7 @@ def _bwd(block, interpret, scale, res, dout, dlse=None):
     delta8 = jnp.broadcast_to(delta[:, :, None], (nh, tq, 8))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk),
+        functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=5,
             grid=(nh, nq, nk),
@@ -336,7 +344,7 @@ def _bwd(block, interpret, scale, res, dout, dlse=None):
 
     # dk/dv at full q-head resolution, summed over the GQA group afterwards
     dk_full, dv_full = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq),
+        functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=5,
             grid=(nh, nk, nq),
@@ -384,7 +392,7 @@ def _bwd(block, interpret, scale, res, dout, dlse=None):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
 def flash_attention_chunk(
     q: jnp.ndarray,  # [Tq, NH, D] — local query shard
     k: jnp.ndarray,  # [Tk, KH, D] — one (possibly remote) KV chunk
@@ -396,29 +404,30 @@ def flash_attention_chunk(
     softmax_scale: float | None = None,
     block: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    window: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One ring-attention step: (o [Tq, NH, D], lse [NH, Tq])."""
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     starts = jnp.stack(
         [jnp.asarray(q_start, jnp.int32), jnp.asarray(k_start, jnp.int32)]
     )
-    return _fwd(q, k, v, segq, segk, starts, scale, block, interpret)
+    return _fwd(q, k, v, segq, segk, starts, scale, block, interpret, window)
 
 
-def _chunk_vjp_fwd(q, k, v, segq, segk, q_start, k_start, softmax_scale, block, interpret):
+def _chunk_vjp_fwd(q, k, v, segq, segk, q_start, k_start, softmax_scale, block, interpret, window=0):
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     starts = jnp.stack(
         [jnp.asarray(q_start, jnp.int32), jnp.asarray(k_start, jnp.int32)]
     )
-    o, lse = _fwd(q, k, v, segq, segk, starts, scale, block, interpret)
+    o, lse = _fwd(q, k, v, segq, segk, starts, scale, block, interpret, window)
     return (o, lse), (q, k, v, segq, segk, starts, o, lse)
 
 
-def _chunk_vjp_bwd(softmax_scale, block, interpret, res, cotangents):
+def _chunk_vjp_bwd(softmax_scale, block, interpret, window, res, cotangents):
     dout, dlse = cotangents
     q = res[0]
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    dq, dk, dv = _bwd(block, interpret, scale, res, dout, dlse)
+    dq, dk, dv = _bwd(block, interpret, scale, res, dout, dlse, window)
     return dq, dk, dv, None, None, None, None
 
 
@@ -433,11 +442,15 @@ def flash_attention_packed(
     softmax_scale: float | None = None,
     block: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
-    """Self-attention over one packed stream (q == kv stream)."""
+    """Self-attention over one packed stream (q == kv stream); ``window>0``
+    adds mistral-style sliding-window masking WITH block skipping — blocks
+    wholly outside the window never run, so long-window-limited contexts
+    cost O(T * window), not O(T^2)."""
     zero = jnp.int32(0)
     o, _ = flash_attention_chunk(
         q, k, v, segment_ids, segment_ids, zero, zero,
-        softmax_scale, block, interpret,
+        softmax_scale, block, interpret, window,
     )
     return o

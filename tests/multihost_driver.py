@@ -120,6 +120,15 @@ def main():
     if rollout._spectator:
         rollout.pause()
         rollout.resume()
+    # a prompt-group count that does not divide over hosts must fail loudly
+    # on EVERY host (the guard rides the broadcast)
+    try:
+        rollout._scatter_batch(
+            full if distributed.is_main() else None, n_groups=3
+        )
+        raise AssertionError("expected group-divisibility rejection")
+    except ValueError:
+        pass
     print(f"proc {pid} scatter ok rows={expect_rows}")
 
 
