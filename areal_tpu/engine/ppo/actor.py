@@ -257,9 +257,13 @@ class PPOActor:
 # TPU engine-fused variant, mirroring the reference's FSDPPPOActor
 # (actor.py:278): the engine IS the actor.
 class TPUPPOActor(TPUTrainEngine):
-    def __init__(self, config: PPOActorConfig):
+    # recipes override this to swap algorithm behavior while keeping the
+    # engine wiring (the reference's recipe/AEnt extension pattern)
+    actor_cls = PPOActor
+
+    def __init__(self, config: PPOActorConfig, **actor_kwargs):
         super().__init__(config)
-        self.actor = PPOActor(config, self)
+        self.actor = self.actor_cls(config, self, **actor_kwargs)
 
     def compute_logp(self, *args, **kwargs):
         return self.actor.compute_logp(*args, **kwargs)

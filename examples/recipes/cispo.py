@@ -1,0 +1,95 @@
+"""Recipe: CISPO-style clipped-importance-sampling policy loss.
+
+This mirrors the reference's recipe extension pattern (recipe/AEnt/actor.py:
+subclass the actor, swap the loss fn, keep everything else — rollout,
+advantages, microbatching, optimizer — untouched). AEnt's clamped-entropy
+bonus is already a built-in knob here (cli_args entropy_coeff/entropy_clamp),
+so this recipe demonstrates the pattern with a different variant:
+
+    L = - E[ stop_grad(min(ratio, 1 + eps_max)) * logp * advantage ]
+
+i.e. a REINFORCE-style surrogate whose importance weight is clipped and
+detached (the CISPO formulation) instead of PPO's clipped-ratio objective.
+
+Run it exactly like GRPO — same launcher, same config — with this module's
+actor:
+
+    python -m areal_tpu.launcher.local examples/recipes/cispo.py \
+        --config examples/configs/gsm8k_grpo.yaml
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import PPOActorConfig
+from areal_tpu.engine.ppo.actor import PPOActor, TPUPPOActor
+from areal_tpu.utils.functional import gather_logprobs_entropy
+
+
+def cispo_loss_fn(
+    logits: jnp.ndarray,
+    input_data: dict[str, Any],
+    temperature: float,
+    eps_max: float,
+    entropy_coeff: float = 0.0,
+    entropy_clamp: float | None = None,
+):
+    """SUM-reduced (the engine divides by the global valid-token count)."""
+    labels = jnp.roll(input_data["input_ids"], shift=-1)
+    logprobs, entropy = gather_logprobs_entropy(logits, labels, temperature)
+    behav = input_data["logprobs"]  # behavior-policy logprobs from rollout
+    adv = input_data["advantages"]
+    mask = input_data["loss_mask"].astype(bool)
+
+    ratio = jnp.exp(logprobs - behav)
+    w = jax.lax.stop_gradient(jnp.minimum(ratio, 1.0 + eps_max))
+    loss_tok = -w * logprobs * adv
+    loss = jnp.sum(jnp.where(mask, loss_tok, 0.0))
+    if entropy_coeff != 0.0:
+        # honor the built-in AEnt knobs here too: a replaced loss must not
+        # silently kill config switches
+        ent = entropy
+        if entropy_clamp is not None:
+            ent = jnp.minimum(ent, entropy_clamp)
+        loss = loss - entropy_coeff * jnp.sum(jnp.where(mask, ent, 0.0))
+    return loss
+
+
+class CISPOActor(PPOActor):
+    """PPOActor with the loss swapped — nothing else changes."""
+
+    def __init__(self, config: PPOActorConfig, engine, eps_max: float = 0.28):
+        super().__init__(config, engine)
+        self._loss_fn = functools.partial(
+            cispo_loss_fn,
+            temperature=self.temperature,
+            eps_max=eps_max,
+            entropy_coeff=config.entropy_coeff,
+            entropy_clamp=config.entropy_clamp,
+        )
+
+
+class TPUCISPOActor(TPUPPOActor):
+    actor_cls = CISPOActor
+
+
+def main(argv=None):
+    # the GRPO entry point drives everything; only the actor class differs
+    import examples.gsm8k_grpo as grpo
+
+    orig = grpo.TPUPPOActor
+    grpo.TPUPPOActor = TPUCISPOActor
+    try:
+        grpo.main(argv)
+    finally:
+        grpo.TPUPPOActor = orig
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

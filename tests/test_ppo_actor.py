@@ -173,3 +173,19 @@ def test_log_agg_mode_unknown_raises():
     a.compute_advantages(data)
     with pytest.raises(ValueError):
         a.ppo_update(dict(data))
+
+
+def test_recipe_cispo_actor_trains():
+    """The recipe extension pattern (reference recipe/AEnt/actor.py): swap
+    the loss fn via actor subclass, everything else untouched."""
+    from examples.recipes.cispo import TPUCISPOActor
+
+    a = TPUCISPOActor(_actor_cfg())
+    a.initialize(None, None, model_config=tiny_config(), seed=9)
+    data = _rollout_batch(seed=9)
+    data["prox_logp"] = a.compute_logp(data)
+    a.compute_advantages(data)
+    stats = a.ppo_update(data)
+    assert np.isfinite(stats[0]["loss"])
+    assert stats[0]["update_successful"] == 1.0
+    a.destroy()
