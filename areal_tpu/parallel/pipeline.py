@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import AttnSpec
-from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_PP
+from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_PP, AXIS_TP
 
 
 def pp_size(mesh: Mesh | None) -> int:
@@ -60,19 +60,26 @@ def check_pp_compatible(cfg: TransformerConfig, mesh: Mesh) -> None:
         )
 
 
-def stage_attn_spec(spec: AttnSpec | None) -> AttnSpec | None:
+def stage_attn_spec(spec: AttnSpec | None, mesh: Mesh | None = None) -> AttnSpec | None:
     """Attention dispatch used INSIDE a pipeline stage.
 
     The stage body runs under a shard_map that is manual over pp and auto
     over dp/cp/tp, so the ring/ulysses wrappers (their own shard_maps over
     the token axes) cannot be re-entered here; attention runs locally and
     GSPMD shards the einsum over tp heads / dp tokens like any other op.
-    The Pallas kernel has no GSPMD partitioning rule, so it is only safe
-    when nothing would need partitioning inside the stage.
+    The Pallas kernel has no GSPMD partitioning rule, so it only survives
+    when nothing inside the stage needs partitioning — i.e. the non-pp mesh
+    extent is 1 (pure pipeline parallelism).
     """
     if spec is None:
         return None
+    inner = 1
+    if mesh is not None:
+        for a in (AXIS_DP, AXIS_CP, AXIS_TP):
+            inner *= int(mesh.shape.get(a, 1))
     impl = spec.impl
+    if inner == 1 and impl in ("auto", "pallas", "pallas_interpret"):
+        return AttnSpec(impl=impl, mesh=None, block=spec.block)
     if spec.is_sharded or impl in ("auto", "ulysses"):
         impl = "xla"
     return AttnSpec(impl=impl, mesh=None, block=spec.block)
@@ -97,7 +104,7 @@ def pipeline_hidden(
 
     s = pp_size(mesh)
     m = embeds.shape[0]
-    inner_spec = stage_attn_spec(attn_spec)
+    inner_spec = stage_attn_spec(attn_spec, mesh)
 
     def run_stage(layers_local, x, pos, seg):
         def body(carry, lp):
@@ -136,13 +143,24 @@ def pipeline_hidden(
         # microbatch mb exits the last stage at step mb + s - 1
         out = ys[s - 1 :]
         out = jnp.where(stage == s - 1, out, 0.0)
+        if shard_out:
+            # reduce-scatter hands each stage its own token slice in one
+            # collective (half the wire traffic of psum + slice, no
+            # transient full-size buffer), and the pp-sharded out_specs
+            # spare XLA an "involuntary full rematerialization" reshard at
+            # the head boundary
+            return jax.lax.psum_scatter(
+                out, AXIS_PP, scatter_dimension=1, tiled=True
+            )
         return jax.lax.psum(out, AXIS_PP)
 
+    t = embeds.shape[1]
+    shard_out = t % s == 0
     return jax.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), P(), P(), P()),
-        out_specs=P(),
+        out_specs=P(None, AXIS_PP) if shard_out else P(),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
     )(params["layers"], embeds, positions, segment_ids)

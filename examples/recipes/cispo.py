@@ -50,6 +50,9 @@ def cispo_loss_fn(
     ratio = jnp.exp(logprobs - behav)
     w = jax.lax.stop_gradient(jnp.minimum(ratio, 1.0 + eps_max))
     loss_tok = -w * logprobs * adv
+    if "loss_agg_w" in input_data:
+        # honor seq-mean aggregation modes (log_agg_mode) like grpo_loss_fn
+        loss_tok = loss_tok * input_data["loss_agg_w"]
     loss = jnp.sum(jnp.where(mask, loss_tok, 0.0))
     if entropy_coeff != 0.0:
         # honor the built-in AEnt knobs here too: a replaced loss must not
@@ -62,10 +65,19 @@ def cispo_loss_fn(
 
 
 class CISPOActor(PPOActor):
-    """PPOActor with the loss swapped — nothing else changes."""
+    """PPOActor with the loss swapped — nothing else changes.
 
-    def __init__(self, config: PPOActorConfig, engine, eps_max: float = 0.28):
+    ``eps_max`` defaults to the config's clip-higher knob
+    (``actor.eps_clip_higher``) so the threshold stays tunable through the
+    normal YAML/CLI path when running via ``main()``.
+    """
+
+    def __init__(
+        self, config: PPOActorConfig, engine, eps_max: float | None = None
+    ):
         super().__init__(config, engine)
+        if eps_max is None:
+            eps_max = config.eps_clip_higher or 0.28
         self._loss_fn = functools.partial(
             cispo_loss_fn,
             temperature=self.temperature,
