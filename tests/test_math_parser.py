@@ -1,5 +1,7 @@
 """Math reward parser (reference: realhf/tests/reward/test_math_reward.py)."""
 
+import os
+
 import pytest
 
 from areal_tpu.reward.math_parser import (
@@ -58,3 +60,28 @@ def test_process_results_and_reward():
     assert math_verify_reward(None, "ans #### 12", answer="12") == 1.0
     assert math_verify_reward(None, "ans #### 12", solution="#### 12") == 1.0
     assert math_verify_reward(None, None, answer="12") == 0.0
+
+
+REF_CASES = (
+    "/root/reference/realhf/tests/reward/math_answers_sample_cases.jsonl"
+)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CASES), reason="reference not mounted")
+def test_agrees_with_reference_verifier_sample_cases():
+    """Behavior parity with the reference's verify_math_solution on its OWN
+    sample cases (realhf/tests/reward/test_math_reward.py labels: reward
+    r = (label - 0.5) * 10)."""
+    import json
+
+    from areal_tpu.reward.math_parser import process_results
+
+    rows = [json.loads(l) for l in open(REF_CASES)]
+    assert rows, "empty sample file"
+    for row in rows:
+        for gen, rew in zip(row["generateds"], row["rewards"]):
+            want = 1 if rew > 0 else 0
+            got = 0
+            for sol in row["solutions"]:
+                got = got or process_results(gen, sol)
+            assert got == want, (row["solutions"], rew)
