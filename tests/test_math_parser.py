@@ -1,5 +1,6 @@
 """Math reward parser (reference: realhf/tests/reward/test_math_reward.py)."""
 
+import json
 import os
 
 import pytest
@@ -72,14 +73,10 @@ def test_agrees_with_reference_verifier_sample_cases():
     """Behavior parity with the reference's verify_math_solution on its OWN
     sample cases (realhf/tests/reward/test_math_reward.py labels: reward
     r = (label - 0.5) * 10)."""
-    import json
-
-    from areal_tpu.reward.math_parser import process_results
-
     rows = [json.loads(l) for l in open(REF_CASES)]
     assert rows, "empty sample file"
     for row in rows:
-        for gen, rew in zip(row["generateds"], row["rewards"]):
+        for gen, rew in zip(row["generateds"], row["rewards"], strict=True):
             want = 1 if rew > 0 else 0
             got = 0
             for sol in row["solutions"]:
