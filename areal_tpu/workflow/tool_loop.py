@@ -1,0 +1,80 @@
+"""Shared agentic tool loop: generate -> parse action -> execute -> splice
+observation (zero loss mask) -> continue, used by the TIR and search-agent
+workflows (reference shape: examples/tir/tir_workflow.py and
+examples/search-agent/tongyi_deepresearch/react_agent.py). One home for the
+subtle loss_mask/logprobs/versions splice and the padded-tensor packing so
+masking fixes cannot silently miss a copy."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Awaitable, Callable
+
+import numpy as np
+
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.utils.data import concat_padded_tensors
+
+
+async def run_tool_episode(
+    engine,
+    tokenizer,
+    gconfig,
+    prompt_ids: list[int],
+    parse_action: Callable[[str], Any | None],
+    execute: Callable[[Any], Awaitable[str]],
+    format_obs: Callable[[str], str],
+    max_tool_calls: int,
+) -> tuple[list[int], list[int], list[float], list[int], str]:
+    """Returns (seq, loss_mask, logprobs, versions, full_text).
+
+    ``parse_action(chunk)`` returns None to stop the loop; observation
+    tokens carry loss_mask 0 / logprob 0 / version -1 (not model policy).
+    """
+    seq = list(prompt_ids)
+    loss_mask = [0] * len(seq)
+    logprobs = [0.0] * len(seq)
+    versions = [-1] * len(seq)
+    rid = str(uuid.uuid4())
+    full_text = ""
+    for _ in range(max_tool_calls + 1):
+        resp = await engine.agenerate(
+            ModelRequest(
+                rid=rid, input_ids=list(seq), gconfig=gconfig,
+                tokenizer=tokenizer,
+            )
+        )
+        seq += resp.output_tokens
+        loss_mask += [1] * resp.output_len
+        logprobs += resp.output_logprobs
+        versions += resp.output_versions
+        chunk = tokenizer.decode(resp.output_tokens)
+        full_text += chunk
+        action = parse_action(chunk)
+        if action is None or resp.stop_reason != "stop":
+            break
+        obs_text = format_obs(await execute(action))
+        obs_ids = tokenizer.encode(obs_text, add_special_tokens=False)
+        seq += obs_ids
+        loss_mask += [0] * len(obs_ids)
+        logprobs += [0.0] * len(obs_ids)
+        versions += [-1] * len(obs_ids)
+        full_text += obs_text
+    return seq, loss_mask, logprobs, versions, full_text
+
+
+def pack_episode(seq, loss_mask, logprobs, versions, reward) -> dict:
+    """One trajectory -> the padded tensor layout every RLVR workflow emits."""
+    n = len(seq)
+    return concat_padded_tensors(
+        [
+            dict(
+                input_ids=np.asarray(seq, np.int64)[None],
+                loss_mask=np.asarray(loss_mask, np.int64)[None],
+                logprobs=np.asarray(logprobs, np.float32)[None],
+                versions=np.asarray(versions, np.int64)[None],
+                attention_mask=np.ones((1, n), np.int64),
+                rewards=np.asarray([reward], np.float32),
+            )
+        ]
+    )

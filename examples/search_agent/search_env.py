@@ -1,0 +1,87 @@
+"""Local-corpus search tools (the reference search-agent capability,
+examples/search-agent/tongyi_deepresearch/tool_search.py + tool_visit.py,
+re-hosted without network dependencies): ``search`` ranks corpus documents
+by token overlap with the query and returns titles + snippets; ``visit``
+returns a document's full text. The corpus is a list of {title, text} dicts
+(or a .jsonl path) — swap in a real retrieval service by subclassing
+``Environment`` the same way."""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+from areal_tpu.api.env_api import Environment
+
+_TOKEN = re.compile(r"[a-z0-9]+")
+
+
+def _tokens(s: str) -> set[str]:
+    return set(_TOKEN.findall(s.lower()))
+
+
+class LocalSearchEnv(Environment):
+    def __init__(self, corpus: list[dict] | str, top_k: int = 3,
+                 snippet_chars: int = 200):
+        if isinstance(corpus, str):
+            with open(corpus) as f:
+                corpus = [json.loads(l) for l in f if l.strip()]
+        self.docs = list(corpus)
+        self.by_title = {d["title"]: d for d in self.docs}
+        self.top_k = top_k
+        self.snippet_chars = snippet_chars
+
+    async def alist_tools(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "type": "function",
+                "function": {
+                    "name": "search",
+                    "description": "Search the corpus; returns top titles + snippets.",
+                    "parameters": {
+                        "type": "object",
+                        "properties": {"query": {"type": "string"}},
+                        "required": ["query"],
+                    },
+                },
+            },
+            {
+                "type": "function",
+                "function": {
+                    "name": "visit",
+                    "description": "Fetch a document's full text by its title.",
+                    "parameters": {
+                        "type": "object",
+                        "properties": {"title": {"type": "string"}},
+                        "required": ["title"],
+                    },
+                },
+            },
+        ]
+
+    async def aexecute(
+        self, tool_name: str, arguments: dict[str, Any], timeout: float | None = None
+    ) -> tuple[str, bool]:
+        if tool_name == "search":
+            q = _tokens(arguments.get("query", ""))
+            if not q:
+                return "empty query", False
+            hits = [
+                (len(q & _tokens(d["title"] + " " + d["text"])), d)
+                for d in self.docs
+            ]
+            hits = sorted(
+                (h for h in hits if h[0] > 0), key=lambda h: -h[0]
+            )[: self.top_k]
+            lines = [
+                f"[{d['title']}] {d['text'][: self.snippet_chars]}"
+                for _, d in hits
+            ]
+            return "\n".join(lines) if lines else "no results", True
+        if tool_name == "visit":
+            d = self.by_title.get(arguments.get("title", ""))
+            if d is None:
+                return f"no document titled {arguments.get('title')!r}", False
+            return d["text"], True
+        return f"unknown tool {tool_name}", False

@@ -1,0 +1,108 @@
+"""ReAct-style search-agent workflow (reference:
+examples/search-agent/tongyi_deepresearch/react_agent.py + train.py call
+shape): the model interleaves reasoning with ``<search>query</search>`` and
+``<visit>title</visit>`` actions; each action's observation is spliced back
+as a zero-loss-mask turn (areal_tpu/workflow/tool_loop.py), up to
+``max_tool_calls``; the episode's final ``<answer>...</answer>`` is scored
+against the gold answer. One trajectory per episode, trained exactly like
+any other RLVR rollout.
+
+To train: build this workflow with your corpus and hand it to
+``rollout.prepare_batch`` in a GRPO entry point — the full loop is
+``examples/gsm8k_grpo.py``; only the workflow construction differs
+(see examples/search_agent/README.md).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.workflow.tool_loop import pack_episode, run_tool_episode
+
+_ACTION_RE = re.compile(r"<(search|visit)>\s*(.*?)\s*</\1>", re.DOTALL)
+
+SYSTEM_PROMPT = (
+    "You are a research agent. You may use tools by emitting "
+    "<search>query</search> to find documents or <visit>title</visit> to "
+    "read one. Observations appear inside <observation></observation>. "
+    "When confident, answer inside <answer></answer>."
+)
+
+
+class SearchAgentWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn,
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        env,
+        max_tool_calls: int = 4,
+        in_process_reward: bool = False,
+    ):
+        self.reward_fn = AsyncRewardWrapper(reward_fn, in_process=in_process_reward)
+        # stop after an action tag so the tool can answer before the model
+        # continues reasoning
+        self.gconfig = gconfig.new(
+            n_samples=1,
+            stop=list(gconfig.stop) + ["</search>", "</visit>"],
+        )
+        self.tokenizer = tokenizer
+        self.env = env
+        self.max_tool_calls = max_tool_calls
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        messages = [{"role": "system", "content": SYSTEM_PROMPT}] + list(
+            data["messages"]
+        )
+        prompt_ids = list(
+            self.tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True
+            )
+        )
+
+        def parse(chunk: str):
+            acts = _ACTION_RE.findall(chunk)
+            return acts[-1] if acts else None
+
+        async def execute(action):
+            tool, arg = action
+            key = "query" if tool == "search" else "title"
+            obs, _ok = await self.env.aexecute(tool, {key: arg})
+            return obs
+
+        seq, loss_mask, logprobs, versions, full_text = await run_tool_episode(
+            engine,
+            self.tokenizer,
+            self.gconfig,
+            prompt_ids,
+            parse,
+            execute,
+            lambda obs: f"\n<observation>\n{obs}\n</observation>\n",
+            self.max_tool_calls,
+        )
+        reward = await self.reward_fn(
+            None, full_text, None, None,
+            **{k: v for k, v in data.items() if k != "messages"},
+        )
+        return pack_episode(seq, loss_mask, logprobs, versions, reward)
+
+
+_ANSWER_RE = re.compile(r"<answer>\s*(.*?)\s*</answer>", re.DOTALL)
+
+
+def search_answer_reward(
+    prompt, completion, prompt_ids, completion_ids, answer: str = "", **_kw
+) -> float:
+    """Exact-match (normalized) on the final <answer> tag."""
+    if not completion:
+        return 0.0
+    m = _ANSWER_RE.findall(completion)
+    if not m:
+        return 0.0
+    got = " ".join(m[-1].split()).lower()
+    want = " ".join(str(answer).split()).lower()
+    return 1.0 if got == want else 0.0

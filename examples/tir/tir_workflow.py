@@ -7,16 +7,12 @@ up to ``max_tool_calls``; the final answer is scored by the math verifier.
 from __future__ import annotations
 
 import re
-import uuid
 from typing import Any
 
-import numpy as np
-
 from areal_tpu.api.cli_args import GenerationHyperparameters
-from areal_tpu.api.io_struct import ModelRequest
 from areal_tpu.api.reward_api import AsyncRewardWrapper
 from areal_tpu.api.workflow_api import RolloutWorkflow
-from areal_tpu.utils.data import concat_padded_tensors
+from areal_tpu.workflow.tool_loop import pack_episode, run_tool_episode
 from examples.tir.tool_env import PythonToolEnv
 
 _CODE_RE = re.compile(r"```python\s*(.*?)```", re.DOTALL)
@@ -41,55 +37,32 @@ class TIRWorkflow(RolloutWorkflow):
         self.env = PythonToolEnv(timeout=tool_timeout)
 
     async def arun_episode(self, engine, data: dict[str, Any]):
-        seq = list(
+        prompt_ids = list(
             self.tokenizer.apply_chat_template(
                 data["messages"], tokenize=True, add_generation_prompt=True
             )
         )
-        loss_mask = [0] * len(seq)
-        logprobs = [0.0] * len(seq)
-        versions = [-1] * len(seq)
-        rid = str(uuid.uuid4())
-        full_text = ""
-        for _ in range(self.max_tool_calls + 1):
-            resp = await engine.agenerate(
-                ModelRequest(
-                    rid=rid, input_ids=list(seq), gconfig=self.gconfig,
-                    tokenizer=self.tokenizer,
-                )
-            )
-            seq += resp.output_tokens
-            loss_mask += [1] * resp.output_len
-            logprobs += resp.output_logprobs
-            versions += resp.output_versions
-            chunk = self.tokenizer.decode(resp.output_tokens)
-            full_text += chunk
-            codes = _CODE_RE.findall(chunk)
-            if not codes or resp.stop_reason != "stop":
-                break
-            obs, _ok = await self.env.aexecute("python", {"code": codes[-1]})
-            obs_text = f"\n<output>\n{obs}\n</output>\n"
-            obs_ids = self.tokenizer.encode(obs_text, add_special_tokens=False)
-            seq += obs_ids
-            loss_mask += [0] * len(obs_ids)  # tool output is not model policy
-            logprobs += [0.0] * len(obs_ids)
-            versions += [-1] * len(obs_ids)
-            full_text += obs_text
 
+        def parse(chunk: str):
+            codes = _CODE_RE.findall(chunk)
+            return codes[-1] if codes else None
+
+        async def execute(code):
+            obs, _ok = await self.env.aexecute("python", {"code": code})
+            return obs
+
+        seq, loss_mask, logprobs, versions, full_text = await run_tool_episode(
+            engine,
+            self.tokenizer,
+            self.gconfig,
+            prompt_ids,
+            parse,
+            execute,
+            lambda obs: f"\n<output>\n{obs}\n</output>\n",
+            self.max_tool_calls,
+        )
         reward = await self.reward_fn(
             None, full_text, None, None,
             **{k: v for k, v in data.items() if k != "messages"},
         )
-        n = len(seq)
-        return concat_padded_tensors(
-            [
-                dict(
-                    input_ids=np.asarray(seq, np.int64)[None],
-                    loss_mask=np.asarray(loss_mask, np.int64)[None],
-                    logprobs=np.asarray(logprobs, np.float32)[None],
-                    versions=np.asarray(versions, np.int64)[None],
-                    attention_mask=np.ones((1, n), np.int64),
-                    rewards=np.asarray([reward], np.float32),
-                )
-            ]
-        )
+        return pack_episode(seq, loss_mask, logprobs, versions, reward)

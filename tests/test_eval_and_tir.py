@@ -121,3 +121,63 @@ def test_tir_workflow_executes_tools(tokenizer):
     ids = np.asarray(traj["input_ids"])[0]
     n_valid = int(np.asarray(traj["attention_mask"])[0].sum())
     assert 0 < lm.sum() < n_valid
+
+
+def test_search_agent_workflow_uses_tools(tokenizer):
+    from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+    from examples.search_agent.search_env import LocalSearchEnv
+    from examples.search_agent.search_workflow import (
+        SearchAgentWorkflow,
+        search_answer_reward,
+    )
+
+    corpus = [
+        {"title": "TPU", "text": "The TPU v5e has 16GB of HBM per chip."},
+        {"title": "GPU", "text": "A GPU is a different accelerator."},
+    ]
+    scripted = [
+        "I should look this up. <search>TPU HBM</search>",
+        "Let me read it. <visit>TPU</visit>",
+        "<answer>16GB</answer>",
+    ]
+
+    class Eng:
+        def __init__(self):
+            self.n = 0
+            self.prompts = []
+
+        async def agenerate(self, req: ModelRequest):
+            text = scripted[min(self.n, len(scripted) - 1)]
+            self.n += 1
+            self.prompts.append(list(req.input_ids))
+            out = tokenizer.encode(text, add_special_tokens=False)
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+            )
+
+    eng = Eng()
+    wf = SearchAgentWorkflow(
+        search_answer_reward,
+        GenerationHyperparameters(max_new_tokens=64),
+        tokenizer,
+        env=LocalSearchEnv(corpus),
+        in_process_reward=True,
+    )
+    data = {
+        "messages": [{"role": "user", "content": "How much HBM does a TPU v5e have?"}],
+        "answer": "16GB",
+    }
+    traj = asyncio.run(wf.arun_episode(eng, data))
+    assert eng.n == 3  # search -> visit -> answer
+    p2 = tokenizer.decode(eng.prompts[1])
+    assert "<observation>" in p2 and "TPU" in p2  # search results spliced
+    p3 = tokenizer.decode(eng.prompts[2])
+    assert "16GB" in p3  # visit returned the full text
+    assert float(np.asarray(traj["rewards"])[0]) == 1.0
+    lm = np.asarray(traj["loss_mask"])[0]
+    n_valid = int(np.asarray(traj["attention_mask"])[0].sum())
+    assert 0 < lm.sum() < n_valid  # observations carry no policy gradient
