@@ -334,6 +334,9 @@ class JaxGenConfig:
     dtype: str = "bfloat16"
     max_batch_size: int = 64
     prefill_chunk: int = 512  # tokens per prefill chunk (static bucket)
+    # max queued prompts packed into ONE prefill dispatch (same segment-id
+    # stream; block-skipping keeps cost at sum of per-prompt quadratics)
+    prefill_batch: int = 4
     max_seq_len: int = 4096
     page_size: int = 128  # KV cache page granularity
     hbm_utilization: float = 0.85

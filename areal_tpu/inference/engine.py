@@ -41,7 +41,12 @@ from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
 from areal_tpu.api.io_struct import ModelResponse
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import TransformerConfig, from_hf_config
-from areal_tpu.models.lm import decode_step, init_kv_cache, init_params, prefill
+from areal_tpu.models.lm import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill_many,
+)
 from areal_tpu.inference.sampling import sample_tokens
 from areal_tpu.parallel.mesh import MESH_AXES, AXIS_TP
 from areal_tpu.parallel.sharding import param_shardings
@@ -188,7 +193,8 @@ class GenerationEngine:
         # current-weight prefixes; in-flight/retained sequences keep their
         # accepted staleness but stop being clone sources after an update)
         self._slot_kv_version = np.zeros(b, np.int64)
-        self.prefill_count = 0  # observability + zero-re-prefill tests
+        self.prefill_count = 0  # prompts prefilled (zero-re-prefill tests)
+        self.prefill_dispatch_count = 0  # device dispatches (batching tests)
         self.prefix_clone_count = 0
         self._lock = threading.Lock()
         self._dead: Exception | None = None
@@ -229,33 +235,44 @@ class GenerationEngine:
         self,
         params,
         cache,
-        ids,  # [Tp]
-        length,  # scalar
-        slot,  # scalar
+        ids,  # [N, Tp] — N prompts in one packed dispatch
+        lengths,  # [N]
+        slots,  # [N]
         rng,
-        temp,
+        temp,  # [N]
         top_k,
         top_p,
         greedy,
-        pixels=None,  # [N, S, S, 3] for VLM prompts
+        pixels=None,  # [Nimg, S, S, 3] for VLM prompts (N == 1 only)
     ):
-        logits, ks, vs = prefill(
-            params, self.model_config, ids, length, attn_spec=self.attn_spec,
+        logits, ks, vs = prefill_many(
+            params, self.model_config, ids, lengths, attn_spec=self.attn_spec,
             pixel_values=pixels,
         )
-        tok, logp = sample_tokens(
-            logits[None], rng, temp[None], top_k[None], top_p[None], greedy[None]
-        )
-        # write [L, Tp, KH, D] into cache [L, B, S, KH, D] at (0, slot, 0, 0, 0)
-        k_new = ks[:, None]  # [L, 1, Tp, KH, D]
-        v_new = vs[:, None]
-        k_cache = jax.lax.dynamic_update_slice(
-            cache["k"], k_new.astype(cache["k"].dtype), (0, slot, 0, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            cache["v"], v_new.astype(cache["v"].dtype), (0, slot, 0, 0, 0)
-        )
-        return tok[0], logp[0], {"k": k_cache, "v": v_cache}
+        toks, logps = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
+        # write each prompt's [L, Tp, KH, D] rows into its slot's cache
+        # region; N is static, so this unrolls into N updates. Zero-length
+        # rows are batch padding: their write is masked to a no-op (the
+        # read-modify keeps the target slot's rows intact).
+        k_cache, v_cache = cache["k"], cache["v"]
+        tp = ids.shape[1]
+
+        def write(cache_arr, new_rows, i):
+            new = new_rows[:, i][:, None].astype(cache_arr.dtype)
+            if ids.shape[0] > 1:
+                sz = (cache_arr.shape[0], 1, tp) + cache_arr.shape[3:]
+                cur = jax.lax.dynamic_slice(
+                    cache_arr, (0, slots[i], 0, 0, 0), sz
+                )
+                new = jnp.where(lengths[i] > 0, new, cur)
+            return jax.lax.dynamic_update_slice(
+                cache_arr, new, (0, slots[i], 0, 0, 0)
+            )
+
+        for i in range(ids.shape[0]):
+            k_cache = write(k_cache, ks, i)
+            v_cache = write(v_cache, vs, i)
+        return toks, logps, {"k": k_cache, "v": v_cache}
 
     def _decode_impl(
         self,
@@ -644,6 +661,16 @@ class GenerationEngine:
             if self.n_running == 0
             else max(self.config.prefill_chunk * 4, 512)
         )
+        pending: list[_Seq] = []  # text prompts awaiting a batched prefill
+        pending_slots: list[int] = []
+        pending_bucket = [0]
+
+        def flush():
+            if pending:
+                self._prefill_seqs(list(pending), list(pending_slots))
+                pending.clear()
+                pending_slots.clear()
+
         while token_budget > 0 and not self._input_queue.empty():
             try:
                 seq = self._input_queue.get_nowait()
@@ -654,22 +681,58 @@ class GenerationEngine:
             free = [
                 i
                 for i, s in enumerate(self.slots)
-                if s is None and i not in self._retained_slots
+                if s is None
+                and i not in self._retained_slots
+                and i not in pending_slots
             ]
             if not free and self._retained:
                 self._evict_lru_retained()
                 free = [
                     i
                     for i, s in enumerate(self.slots)
-                    if s is None and i not in self._retained_slots
+                    if s is None
+                    and i not in self._retained_slots
+                    and i not in pending_slots
                 ]
             if not free:
                 self._input_queue.put(seq)  # no capacity; retry next loop
+                flush()
                 return
+            if (
+                pending
+                and self.config.enable_prefix_reuse
+                and len(seq.prompt) >= 2
+            ):
+                # a same-prompt twin sitting in the pending batch can serve
+                # as a clone source once its KV lands — flush first so a
+                # sampling group costs ONE prefill + n-1 row copies, not n
+                # packed prefills
+                prefix = tuple(seq.prompt[:-1])
+                if any(
+                    len(p.prompt) >= len(prefix)
+                    and tuple(p.prompt[: len(prefix)]) == prefix
+                    for p in pending
+                ):
+                    flush()
             if self._try_clone(seq, free[0]):
                 continue  # one KV row copy, no prefill compute
-            self._prefill_seq(seq, free[0])
+            if seq.images:
+                # image prompts dispatch alone (per-dispatch pixel table)
+                self._prefill_seq(seq, free[0])
+            else:
+                b = self._bucket(len(seq.prompt))
+                if pending and b != pending_bucket[0]:
+                    # one bucket per packed dispatch: mixed lengths would
+                    # make every row pay the longest row's non-attention
+                    # compute and break the token-budget accounting
+                    flush()
+                pending.append(seq)
+                pending_slots.append(free[0])
+                pending_bucket[0] = b
+                if len(pending) >= self.config.prefill_batch:
+                    flush()
             token_budget -= self._bucket(len(seq.prompt))
+        flush()
 
     def _try_resume(self, seq: _Seq) -> bool:
         """Abort-resume fast path: the re-issued prompt must be exactly the
@@ -730,48 +793,76 @@ class GenerationEngine:
         return True
 
     def _prefill_seq(self, seq: _Seq, slot: int):
-        self.prefill_count += 1
-        n = len(seq.prompt)
-        tp = self._bucket(n)
-        ids = np.zeros(tp, np.int32)
-        ids[:n] = seq.prompt
-        g = seq.gconfig
+        self._prefill_seqs([seq], [slot])
+
+    def _prefill_seqs(self, seqs: list[_Seq], slots: list[int]):
+        """One packed prefill dispatch for up to ``prefill_batch`` prompts
+        (image-carrying requests always go alone — the pixel table is per
+        dispatch)."""
+        self.prefill_count += len(seqs)
+        self.prefill_dispatch_count += 1
+        # two compiled shapes per bucket, not prefill_batch: singles keep
+        # the [1, Tp] program (no overhead for the common lone admission);
+        # groups pad to a FIXED [prefill_batch, Tp] with zero-length dummy
+        # rows (pad segments, masked cache writes)
+        n_rows = 1 if len(seqs) == 1 else self.config.prefill_batch
+        bucket = self._bucket(max(len(s.prompt) for s in seqs))
+        ids = np.zeros((n_rows, bucket), np.int32)
+        lengths = np.zeros(n_rows, np.int32)
+        temp = np.ones(n_rows, np.float32)
+        top_k = np.zeros(n_rows, np.int32)
+        top_p = np.ones(n_rows, np.float32)
+        greedy = np.zeros(n_rows, bool)
+        row_slots = np.zeros(n_rows, np.int32)
+        for i, s in enumerate(seqs):
+            n = len(s.prompt)
+            ids[i, :n] = s.prompt
+            lengths[i] = n
+            row_slots[i] = slots[i]
+            g = s.gconfig
+            temp[i], top_k[i], top_p[i], greedy[i] = (
+                g.temperature, g.top_k, g.top_p, g.greedy,
+            )
         args = (
             self.params,
             self.cache,
             jnp.asarray(ids),
-            jnp.int32(n),
-            jnp.int32(slot),
+            jnp.asarray(lengths),
+            jnp.asarray(row_slots),
             self._next_rng(),
-            jnp.float32(g.temperature),
-            jnp.int32(g.top_k),
-            jnp.float32(g.top_p),
-            jnp.asarray(g.greedy),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(greedy),
         )
-        if seq.images:
-            pixels = jnp.asarray(np.stack(seq.images), jnp.float32)
-            tok, logp, self.cache = self._jit_prefill(*args, pixels)
+        if any(s.images for s in seqs):
+            assert len(seqs) == 1, "image prompts prefill alone"
+            pixels = jnp.asarray(np.stack(seqs[0].images), jnp.float32)
+            toks, logps, self.cache = self._jit_prefill(*args, pixels)
         else:
-            tok, logp, self.cache = self._jit_prefill(*args)
+            toks, logps, self.cache = self._jit_prefill(*args)
         now = time.monotonic()
-        seq.slot = slot
-        seq.t_first_token = now
-        seq.t_last_token = now
-        tok_i = int(tok)
-        seq.out_tokens.append(tok_i)
-        seq.out_logprobs.append(float(logp))
-        seq.out_versions.append(self.version)
-        self.slots[slot] = seq
-        # cache holds exactly the n prompt tokens; the sampled token's K/V is
-        # written by the next decode step (which feeds it at position n)
-        self.cache_len[slot] = n
-        self.last_token[slot] = tok_i
-        self._slot_covered[slot] = list(seq.prompt)
-        # image-conditioned rows encode pixels the token ids don't show;
-        # stamp -1 so they can never be cloned into a text request
-        self._slot_kv_version[slot] = -1 if seq.images else self.version
-        if self._seq_finished(seq, tok_i):
-            self._finish(slot, self._finish_reason(seq, tok_i))
+        toks = np.asarray(toks)
+        logps = np.asarray(logps)
+        for i, (seq, slot) in enumerate(zip(seqs, slots)):
+            seq.slot = slot
+            seq.t_first_token = now
+            seq.t_last_token = now
+            tok_i = int(toks[i])
+            seq.out_tokens.append(tok_i)
+            seq.out_logprobs.append(float(logps[i]))
+            seq.out_versions.append(self.version)
+            self.slots[slot] = seq
+            # cache holds exactly the prompt tokens; the sampled token's
+            # K/V is written by the next decode step
+            self.cache_len[slot] = len(seq.prompt)
+            self.last_token[slot] = tok_i
+            self._slot_covered[slot] = list(seq.prompt)
+            # image-conditioned rows encode pixels the token ids don't
+            # show; stamp -1 so they can never be cloned into a text request
+            self._slot_kv_version[slot] = -1 if seq.images else self.version
+            if self._seq_finished(seq, tok_i):
+                self._finish(slot, self._finish_reason(seq, tok_i))
 
     def _seq_finished(self, seq: _Seq, last_tok: int) -> bool:
         n_out = len(seq.out_tokens)

@@ -335,15 +335,48 @@ def prefill(
 
     Returns (last_logits [V] fp32, k [L, Tp, KH, D], v [L, Tp, KH, D]).
     """
-    tp = input_ids.shape[0]
-    positions = jnp.arange(tp, dtype=jnp.int32)
-    segment_ids = jnp.where(positions < length, 0, -1)
-    x = _embed(params, cfg, input_ids)
+    logits, ks, vs = prefill_many(
+        params,
+        cfg,
+        input_ids[None],
+        jnp.asarray(length, jnp.int32)[None],
+        attn_spec=attn_spec,
+        pixel_values=pixel_values,
+    )
+    return logits[0], ks[:, 0], vs[:, 0]
+
+
+def prefill_many(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [N, Tp] int32, each row padded to the bucket
+    lengths: jnp.ndarray,  # [N] int32, true prompt lengths
+    attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched prompt pass: N prompts pack into ONE [N*Tp] segment-id stream
+    (the framework's native representation — attention block-skipping keeps
+    the cost at O(sum_i L_i^2), not O((N*Tp)^2)), so a burst of admissions
+    costs one device dispatch instead of N.
+
+    Returns (last_logits [N, V] fp32, k [L, N, Tp, KH, D], v likewise).
+    """
+    n, tp = input_ids.shape
+    pos2d = jnp.broadcast_to(jnp.arange(tp, dtype=jnp.int32), (n, tp))
+    seg2d = jnp.where(
+        pos2d < lengths[:, None],
+        jnp.arange(n, dtype=jnp.int32)[:, None],
+        -1,
+    )
+    positions = pos2d.reshape(-1)
+    segment_ids = seg2d.reshape(-1)
+    flat = input_ids.reshape(-1)
+    x = _embed(params, cfg, flat)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
 
         embeds = encode_images(params["vision"], cfg, pixel_values)
-        x = splice_image_embeds(cfg, x, input_ids, embeds)
+        x = splice_image_embeds(cfg, x, flat, embeds)
 
     def body(carry, lp):
         h = _norm(cfg, carry, lp["ln1"])
@@ -353,18 +386,22 @@ def prefill(
         attn = packed_attention(
             q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
         )
-        out = carry + attn.reshape(tp, cfg.q_dim) @ lp["wo"]
+        out = carry + attn.reshape(n * tp, cfg.q_dim) @ lp["wo"]
         h2 = _norm(cfg, out, lp["ln2"])
         out = out + _mlp(cfg, lp, h2, attn_spec)
         return out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = _norm(cfg, x, params["final_norm"])
-    h_last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=0, keepdims=False)
+    idx = jnp.arange(n, dtype=jnp.int32) * tp + lengths - 1
+    h_last = x[idx]  # [N, H]
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = (h_last @ head).astype(jnp.float32)
+    l = ks.shape[0]
+    ks = ks.reshape(l, n, tp, *ks.shape[2:])
+    vs = vs.reshape(l, n, tp, *vs.shape[2:])
     return logits, ks, vs
 
 

@@ -408,3 +408,43 @@ def test_different_prompts_do_not_clone(model):
         assert eng.prefill_count == 2 and eng.prefix_clone_count == 0
     finally:
         eng.stop()
+
+
+def test_batched_prefill_one_dispatch_for_distinct_prompts(model):
+    """A burst of DISTINCT prompts packs into one prefill dispatch (segment
+    ids keep them independent); greedy outputs match solo runs."""
+    g = GenerationHyperparameters(max_new_tokens=6, min_new_tokens=6, greedy=True)
+    prompts = [list(range(5, 20)), list(range(40, 58)), list(range(70, 82))]
+
+    # solo references (prefix reuse off so each runs standalone)
+    solo = []
+    eng0 = make_engine(model, enable_prefix_reuse=False, prefill_batch=1)
+    try:
+        for i, p in enumerate(prompts):
+            solo.append(run_request(eng0, f"s-{i}", p, g).output_tokens)
+    finally:
+        eng0.stop()
+
+    eng = make_engine(model, enable_prefix_reuse=False)
+    try:
+        rs = {}
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def cb_for(i):
+            def cb(r):
+                with lock:
+                    rs[i] = r.output_tokens
+                    if len(rs) == len(prompts):
+                        done.set()
+            return cb
+
+        for i, p in enumerate(prompts):
+            eng.submit(f"b-{i}", p, g, cb_for(i))
+        assert done.wait(120)
+        assert eng.prefill_count == 3
+        assert eng.prefill_dispatch_count < 3, eng.prefill_dispatch_count
+        for i in range(len(prompts)):
+            assert rs[i] == solo[i], i
+    finally:
+        eng.stop()
