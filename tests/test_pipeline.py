@@ -224,3 +224,23 @@ def test_engine_train_batch_pp_with_lora():
         np.testing.assert_array_equal(a, b)
     assert eng.lora_params is not None
     eng.destroy()
+
+
+def test_pipeline_critic_values_match_plain():
+    """Critic (scalar value head) through the pipeline == plain forward."""
+    cfg = tiny_config(num_hidden_layers=4, is_critic=True)
+    mesh = _pp_mesh(pp=4, dp=2)
+    params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    params = jax.device_put(params, param_shardings(mesh, params, fsdp=False))
+    ids, pos, seg = _mb_stack(m=2)
+    got = jax.jit(
+        lambda p: forward_packed_pipelined(p, cfg, ids, pos, seg, mesh)
+    )(params)
+    want = np.stack(
+        [
+            np.asarray(forward_packed(params, cfg, ids[m], pos[m], seg[m]))
+            for m in range(2)
+        ]
+    )
+    assert got.shape == want.shape == (2, ids.shape[1])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
