@@ -9,7 +9,9 @@ grammar at alloc_mode.py:316-358, ``ParallelStrategy`` 5-D dataclass, and
 - ``jaxgen:d4t2+gspmd:d2t4``       — disaggregated: inference chips + train chips
 - ``jaxgen:d2t2|gspmd:d2t2``       — colocated: same chips serve both roles
 - ``jaxgen:d4+eval``               — inference + evaluation-only client
-- ``gspmd:(attn:d2c2t2|ffn:d2e2t2)`` — MoE hybrid attn/ffn layouts
+- ``gspmd:(attn:d2c2t2|ffn:e4t2)`` — MoE hybrid attn/ffn layouts (the
+  realizable expert fold is the FULL (dp, cp) extent with etp == tp;
+  parallel/mesh.py rejects partial folds loudly)
 
 Dim letters: d=data, t=tensor, p=pipeline, c=context(sequence), e=expert.
 Reference backend names (sglang, vllm, fsdp, megatron) are accepted as aliases

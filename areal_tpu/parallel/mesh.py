@@ -33,6 +33,23 @@ def make_mesh(
 ) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     need = parallel.world_size
+    full_fold = parallel.dp * parallel.cp
+    if parallel.ep > 1 and parallel.ep != full_fold:
+        # expert weights shard over the WHOLE folded (dp, cp) extent
+        # (parallel/sharding.py EP_AXES); a partial expert group would need
+        # a factored mesh axis that is not built — reject instead of
+        # silently sharding over a different group size than requested
+        raise NotImplementedError(
+            f"expert parallelism folds over ALL of (dp, cp) = {full_fold}; "
+            f"partial ep={parallel.ep} is not implemented (write e{full_fold} "
+            "or omit the e dim)"
+        )
+    if parallel.ep > 1 and parallel.etp != parallel.tp:
+        raise NotImplementedError(
+            f"expert weights always shard their I dim over tp={parallel.tp}; "
+            f"etp={parallel.etp} is not implemented (write the ffn layout "
+            f"with t{parallel.tp} or drop tensor parallelism)"
+        )
     if len(devices) < need:
         raise ValueError(
             f"ParallelStrategy {parallel} needs {need} devices, "

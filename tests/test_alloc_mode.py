@@ -118,3 +118,18 @@ def test_moe_strategy_str_roundtrip():
     # default folding round-trips via plain syntax
     q = ParallelStrategy(dp=4, tp=2, ep=2, etp=2, edp=2)
     assert AllocationMode.from_str(str(q)).train == q
+
+
+def test_partial_expert_fold_rejected():
+    """ep is only realizable as the FULL folded (dp, cp) extent; partial
+    folds must fail loudly, not silently shard over a different group."""
+    import pytest
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(NotImplementedError, match="partial ep"):
+        make_mesh(ParallelStrategy(dp=4, ep=2, edp=2))
+    # the full fold is exactly what the sharding rules implement
+    mesh = make_mesh(ParallelStrategy(dp=2, cp=2, ep=4))
+    assert mesh.shape["dp"] * mesh.shape["cp"] == 4
