@@ -196,6 +196,14 @@ class GenerationEngine:
         self.prefill_count = 0  # prompts prefilled (zero-re-prefill tests)
         self.prefill_dispatch_count = 0  # device dispatches (batching tests)
         self.prefix_clone_count = 0
+        # served-token counters (the reference gserver_manager's per-server
+        # token-usage tracking role, realhf/system/gserver_manager.py):
+        # prompt_tokens_total counts every ADMITTED request's prompt
+        # (prefill, prefix-clone, and abort-resume paths alike — it
+        # measures demand, not prefill compute); generated counts sampled
+        # tokens including each sequence's prefill-sampled first token
+        self.prompt_tokens_total = 0
+        self.generated_tokens_total = 0
         self._lock = threading.Lock()
         self._dead: Exception | None = None
 
@@ -747,6 +755,7 @@ class GenerationEngine:
             return False
         self._retained.pop(seq.rid, None)
         self._retained_slots.pop(slot, None)
+        self.prompt_tokens_total += len(seq.prompt)
         seq.slot = slot
         self.slots[slot] = seq
         self.last_token[slot] = feed_tok
@@ -780,6 +789,7 @@ class GenerationEngine:
         if src is None:
             return False
         self.prefix_clone_count += 1
+        self.prompt_tokens_total += len(seq.prompt)
         if src != dst:
             self.cache = self._jit_copy_kv(
                 self.cache, jnp.int32(src), jnp.int32(dst), jnp.int32(n - 1)
@@ -801,6 +811,7 @@ class GenerationEngine:
         dispatch)."""
         self.prefill_count += len(seqs)
         self.prefill_dispatch_count += 1
+        self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
         # two compiled shapes per bucket, not prefill_batch: singles keep
         # the [1, Tp] program (no overhead for the common lone admission);
         # groups pad to a FIXED [prefill_batch, Tp] with zero-length dummy
@@ -852,6 +863,7 @@ class GenerationEngine:
             seq.out_tokens.append(tok_i)
             seq.out_logprobs.append(float(logps[i]))
             seq.out_versions.append(self.version)
+            self.generated_tokens_total += 1
             self.slots[slot] = seq
             # cache holds exactly the prompt tokens; the sampled token's
             # K/V is written by the next decode step
@@ -944,6 +956,7 @@ class GenerationEngine:
                 if seq.t_last_token is not None:
                     seq.itl.append(now - seq.t_last_token)
                 seq.t_last_token = now
+                self.generated_tokens_total += 1
                 # the fed token's K/V row was just written at cache_len
                 self._slot_covered[i].append(int(self.last_token[i]))
                 self.cache_len[i] += 1

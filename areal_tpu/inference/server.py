@@ -98,6 +98,12 @@ class GenerationServer:
                 "n_running": e.n_running,
                 "max_batch_size": e.config.max_batch_size,
                 "max_seq_len": e.config.max_seq_len,
+                # serving counters (gserver token-usage tracking role)
+                "prompt_tokens_total": e.prompt_tokens_total,
+                "generated_tokens_total": e.generated_tokens_total,
+                "prefill_count": e.prefill_count,
+                "prefill_dispatch_count": e.prefill_dispatch_count,
+                "prefix_clone_count": e.prefix_clone_count,
             }
         )
 
