@@ -960,9 +960,18 @@ class TPUTrainEngine(TrainEngine):
                 out = mb_outs[mb_idx][:real_n]
             else:
                 mb_dev = self._mb_to_device(packed)
-                out = np.asarray(
-                    jax.device_get(fwd(self.effective_params(), mb_dev))
-                )[:real_n]
+                out_dev = fwd(self.effective_params(), mb_dev)
+                if distributed.process_count() > 1:
+                    # the output token dim spans all hosts (process-order
+                    # concat, like the input assembly); allgather and keep
+                    # this host's segment — device_get alone cannot fetch
+                    # non-addressable shards
+                    t_local = int(packed["cu_seqlens"][-1])
+                    full = distributed.gather_host_values(out_dev)
+                    lo = distributed.process_index() * t_local
+                    out = np.asarray(full)[lo : lo + t_local][:real_n]
+                else:
+                    out = np.asarray(jax.device_get(out_dev))[:real_n]
             if output_seqlens is not None:
                 # per-sequence output lengths differ from input lengths
                 # (reference base_hf_engine.py:516-544)

@@ -55,7 +55,33 @@ def make_mesh(
             f"ParallelStrategy {parallel} needs {need} devices, "
             f"only {len(devices)} available"
         )
-    devices = devices[:need]
+    n_procs = len({d.process_index for d in devices})
+    if n_procs > 1 and need < len(devices):
+        # jax.devices() is process-major: a plain [:need] slice can select
+        # devices from a strict subset of processes, leaving other hosts
+        # with no addressable shard (make_array_from_process_local_data
+        # then dies with StopIteration). Take an equal share from every
+        # process instead.
+        if need % n_procs != 0:
+            raise ValueError(
+                f"{need} mesh devices cannot be split evenly over "
+                f"{n_procs} processes"
+            )
+        per = need // n_procs
+        by_proc: dict[int, list] = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        short = {p: len(ds) for p, ds in by_proc.items() if len(ds) < per}
+        if short:
+            raise ValueError(
+                f"need {per} mesh devices from every process but "
+                f"{short} have fewer"
+            )
+        devices = [
+            d for p in sorted(by_proc) for d in by_proc[p][:per]
+        ]
+    else:
+        devices = devices[:need]
     arr = np.asarray(devices).reshape(
         parallel.pp, parallel.dp, parallel.cp, parallel.tp
     )

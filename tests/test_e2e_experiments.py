@@ -31,6 +31,9 @@ def _run(cmd, env_extra, timeout=900):
     env = dict(os.environ)
     env["AREAL_PLATFORM"] = "cpu"
     env["PYTHONPATH"] = REPO
+    # don't leak the conftest's 8-virtual-device XLA_FLAGS into spawned
+    # processes: multi-trainer runs want ONE device per process
+    env.pop("XLA_FLAGS", None)
     env.update(env_extra)
     return subprocess.run(
         cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout
@@ -166,3 +169,90 @@ recover:
     lines = [json.loads(x) for x in open(stats_path)]
     assert len(lines) == 8
     assert lines[-1]["loss"] < lines[0]["loss"]
+
+
+@pytest.mark.slow
+def test_grpo_multihost_two_trainers_end_to_end(assets):
+    """The multi-host rollout-head path, end to end: the launcher wires TWO
+    jax.distributed trainer processes into one dp=2 mesh; host 0 drives the
+    generation server and scatters rollout batches; weight pushes gather
+    leaf-by-leaf across hosts."""
+    root = assets
+    fileroot = str(root / "mh_exp")
+    cfg = f"""
+experiment_name: e2e-grpo-mh
+trial_name: t0
+allocation_mode: "jaxgen:d1+gspmd:d2"
+seed: 1
+total_train_epochs: 1
+total_train_steps: 2
+tokenizer_path: {root}/model
+cluster:
+  fileroot: {fileroot}
+  name_resolve:
+    type: nfs
+    nfs_record_root: {fileroot}/nr
+train_dataset:
+  path: {root}/train.jsonl
+  type: rl
+  batch_size: 4
+gconfig:
+  n_samples: 2
+  max_new_tokens: 16
+  temperature: 1.0
+rollout:
+  experiment_name: e2e-grpo-mh
+  trial_name: t0
+  max_concurrent_rollouts: 8
+  consumer_batch_size: 4
+server:
+  model_path: {root}/model
+  dtype: float32
+  max_batch_size: 8
+  max_seq_len: 256
+  prefill_chunk: 64
+  decode_steps_per_call: 4
+actor:
+  path: {root}/model
+  init_from_scratch: false
+  group_size: 2
+  ppo_n_minibatches: 1
+  use_decoupled_loss: true
+  adv_norm:
+    mean_level: group
+    std_level: group
+    group_size: 2
+  optimizer:
+    lr: 1.0e-4
+  backend:
+    param_dtype: float32
+    pad_mb_to_multiple: 64
+launcher:
+  trainer_processes: 2
+async_training: true
+weight_update: http
+saver:
+  freq_epochs: null
+stats_logger:
+  fileroot: {fileroot}
+recover:
+  mode: disabled
+"""
+    cfg_path = root / "grpo_mh.yaml"
+    cfg_path.write_text(cfg)
+    r = _run(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.launcher.local",
+            "examples/gsm8k_grpo.py",
+            "--config",
+            str(cfg_path),
+        ],
+        env_extra={},
+        timeout=1200,
+    )
+    assert r.returncode == 0, f"STDOUT:\n{r.stdout[-4000:]}\nSTDERR:\n{r.stderr[-6000:]}"
+    rewards_path = os.path.join(fileroot, "e2e-grpo-mh", "t0", "logs", "rewards.json")
+    assert os.path.isfile(rewards_path), r.stderr[-3000:]
+    assert len(json.load(open(rewards_path))) == 2
