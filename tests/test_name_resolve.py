@@ -65,7 +65,7 @@ def test_wait_concurrent(repo):
 
     t = threading.Thread(target=writer)
     t.start()
-    assert repo.wait("late/key", timeout=5) == "yes"
+    assert repo.wait("late/key", timeout=30) == "yes"
     t.join()
 
 
