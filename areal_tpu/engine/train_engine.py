@@ -541,6 +541,15 @@ class TPUTrainEngine(TrainEngine):
             packed, real_n = pad_packed_to_multiple(packed, multiple)
             cu = packed["cu_seqlens"]
             total = int(cu[-1])
+            if self.model_config.pos_embed_type == "learned":
+                longest = int(np.diff(np.asarray(cu)).max())
+                if longest > self.model_config.max_position_embeddings:
+                    # the wpe gather clamps out-of-range rows silently
+                    raise ValueError(
+                        f"sequence of {longest} tokens exceeds the learned "
+                        f"position table "
+                        f"({self.model_config.max_position_embeddings})"
+                    )
             packed["positions"] = positions_from_cu_seqlens(cu, total)
             seg = segment_ids_from_cu_seqlens(cu, total)
             # tokens beyond real_n belong to the alignment-pad sequence; give

@@ -114,6 +114,15 @@ class GenerationEngine:
                 raise ValueError("need model_config or config.model_path")
             model_config = from_hf_config(config.model_path)
         self.model_config = model_config
+        if (
+            model_config.pos_embed_type == "learned"
+            and config.max_seq_len > model_config.max_position_embeddings
+        ):
+            # gather clamps out-of-range rows silently; fail loudly instead
+            raise ValueError(
+                f"max_seq_len={config.max_seq_len} exceeds the learned "
+                f"position table ({model_config.max_position_embeddings})"
+            )
 
         # per-engine attention dispatch (no process-global state): under TP,
         # prefill keeps the Pallas flash kernel with heads sharded over the

@@ -32,6 +32,10 @@ class TransformerConfig:
     sliding_window: int = 0  # >0 = mistral-style local attention window
     rms_norm_offset: bool = False  # gemma: scale by (1 + weight)
     scale_embeddings: bool = False  # gemma: embeddings * sqrt(hidden)
+    norm_type: str = "rms"  # "rms" | "layer" (gpt2: mean-centered + bias)
+    pos_embed_type: str = "rope"  # "rope" | "learned" (gpt2 wpe table)
+    mlp_gated: bool = True  # False = gpt2 fc->act->proj (no up gate)
+    proj_bias: bool = False  # gpt2: bias on attn-out + both MLP matmuls
     max_position_embeddings: int = 32768
     # MoE (0 experts = dense)
     num_experts: int = 0
@@ -85,7 +89,46 @@ _HF_ARCH_MAP = {
     "GemmaForCausalLM": "gemma",
     "Qwen3MoeForCausalLM": "qwen3_moe",
     "MixtralForCausalLM": "mixtral",
+    "GPT2LMHeadModel": "gpt2",
 }
+
+
+def _gpt2_config(hf: dict, is_critic: bool) -> TransformerConfig:
+    """GPT-2 config.json uses its own key scheme (n_embd/n_head/n_layer...).
+
+    Reference parity: realhf/api/from_hf/gpt2.py (legacy conversion
+    registry entry for gpt2)."""
+    h = hf["n_embd"]
+    n_heads = hf["n_head"]
+    act_map = {
+        "gelu_new": "gelu_tanh",
+        "gelu_pytorch_tanh": "gelu_tanh",
+        "gelu": "gelu",
+        "relu": "relu",
+    }
+    hf_act = hf.get("activation_function", "gelu_new")
+    if hf_act not in act_map:
+        raise ValueError(f"unsupported gpt2 activation_function: {hf_act!r}")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=h,
+        intermediate_size=hf.get("n_inner") or 4 * h,
+        num_hidden_layers=hf["n_layer"],
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_heads,  # MHA
+        head_dim=h // n_heads,
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        tie_word_embeddings=True,  # GPT2LMHeadModel always ties
+        attention_bias=True,
+        hidden_act=act_map[hf_act],
+        norm_type="layer",
+        pos_embed_type="learned",
+        mlp_gated=False,
+        proj_bias=True,
+        max_position_embeddings=hf.get("n_positions", 1024),
+        is_critic=is_critic,
+        arch="gpt2",
+    )
 
 
 def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
@@ -103,6 +146,8 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     arch = _HF_ARCH_MAP.get(archs[0])
     if arch is None:
         raise ValueError(f"Unsupported HF architecture: {archs[0]}")
+    if arch == "gpt2":
+        return _gpt2_config(hf, is_critic)
     window = hf.get("sliding_window")
     window_active = window is not None and window < hf.get(
         "max_position_embeddings", 1 << 30
@@ -159,6 +204,24 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
 
 def to_hf_config(cfg: TransformerConfig) -> dict:
     """Inverse of ``from_hf_config`` for checkpoint export."""
+    if cfg.arch == "gpt2":
+        return {
+            "architectures": ["GPT2LMHeadModel"],
+            "model_type": "gpt2",
+            "vocab_size": cfg.vocab_size,
+            "n_embd": cfg.hidden_size,
+            "n_head": cfg.num_attention_heads,
+            "n_layer": cfg.num_hidden_layers,
+            "n_inner": cfg.intermediate_size,
+            "n_positions": cfg.max_position_embeddings,
+            "n_ctx": cfg.max_position_embeddings,
+            "layer_norm_epsilon": cfg.rms_norm_eps,
+            "activation_function": {
+                "gelu_tanh": "gelu_new", "gelu": "gelu", "relu": "relu"
+            }[cfg.hidden_act],
+            "tie_word_embeddings": True,
+            "torch_dtype": "bfloat16",
+        }
     arch = {
         "qwen2": "Qwen2ForCausalLM",
         "qwen3": "Qwen3ForCausalLM",

@@ -53,6 +53,65 @@ def _bf16_view(arr: np.ndarray):
     return arr
 
 
+def _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer):
+    """GPT-2 checkpoint scheme (transformer.h.{i}.*; Conv1D weights are
+    stored [in, out] — already our x@W orientation, so no transpose).
+
+    Reference parity: realhf/api/from_hf/gpt2.py name mapping."""
+    if name.startswith("transformer."):
+        name = name[len("transformer.") :]
+    if name == "wte.weight":
+        top["embed"] = tensor
+    elif name == "wpe.weight":
+        top["pos_embed"] = tensor
+    elif name == "ln_f.weight":
+        top["final_norm"] = tensor
+    elif name == "ln_f.bias":
+        top["final_norm_b"] = tensor
+    elif name == "lm_head.weight":
+        pass  # always tied to wte
+    elif name in ("score.weight", "value_head.weight"):
+        top["value_head"] = tensor.T
+    elif name.startswith("h."):
+        i_str, sub = name[2:].split(".", 1)
+        i = int(i_str)
+        h = cfg.hidden_size
+        if sub == "attn.c_attn.weight":  # [H, 3H] fused qkv
+            put_layer("wq", i, tensor[:, :h])
+            put_layer("wk", i, tensor[:, h : 2 * h])
+            put_layer("wv", i, tensor[:, 2 * h :])
+        elif sub == "attn.c_attn.bias":
+            put_layer("bq", i, tensor[:h])
+            put_layer("bk", i, tensor[h : 2 * h])
+            put_layer("bv", i, tensor[2 * h :])
+        elif sub == "attn.c_proj.weight":
+            put_layer("wo", i, tensor)
+        elif sub == "attn.c_proj.bias":
+            put_layer("bo", i, tensor)
+        elif sub == "ln_1.weight":
+            put_layer("ln1", i, tensor)
+        elif sub == "ln_1.bias":
+            put_layer("ln1_b", i, tensor)
+        elif sub == "ln_2.weight":
+            put_layer("ln2", i, tensor)
+        elif sub == "ln_2.bias":
+            put_layer("ln2_b", i, tensor)
+        elif sub == "mlp.c_fc.weight":
+            put_layer("wg", i, tensor)
+        elif sub == "mlp.c_fc.bias":
+            put_layer("b_fc", i, tensor)
+        elif sub == "mlp.c_proj.weight":
+            put_layer("wd", i, tensor)
+        elif sub == "mlp.c_proj.bias":
+            put_layer("b_proj", i, tensor)
+        elif sub.endswith(("attn.bias", "attn.masked_bias")):
+            pass  # causal-mask buffers, not weights
+        else:
+            logger.warning(f"Skipping unmapped gpt2 tensor: {name}")
+    else:
+        logger.warning(f"Skipping unmapped gpt2 tensor: {name}")
+
+
 def load_hf_params(
     model_dir: str,
     cfg: TransformerConfig | None = None,
@@ -83,6 +142,9 @@ def load_hf_params(
 
     for name, tensor in _open_shards(model_dir):
         tensor = _bf16_view(tensor)
+        if cfg.arch == "gpt2":
+            _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer)
+            continue
         if name == "model.embed_tokens.weight":
             top["embed"] = tensor
         elif name == "lm_head.weight":
@@ -180,6 +242,9 @@ def load_hf_params(
         "layers": layers,
         "final_norm": top["final_norm"],
     }
+    for opt in ("pos_embed", "final_norm_b"):
+        if opt in top:
+            params_np[opt] = top[opt]
     if cfg.is_vlm:
         if "vision" in top:
             params_np["vision"] = top["vision"]
@@ -237,6 +302,40 @@ def save_hf_params(
         return np.ascontiguousarray(x)
 
     tensors: dict[str, np.ndarray] = {}
+    if cfg.arch == "gpt2":
+        tensors["transformer.wte.weight"] = contig(host(params["embed"]))
+        tensors["transformer.wpe.weight"] = contig(host(params["pos_embed"]))
+        tensors["transformer.ln_f.weight"] = contig(host(params["final_norm"]))
+        tensors["transformer.ln_f.bias"] = contig(host(params["final_norm_b"]))
+        if "value_head" in params:
+            tensors["score.weight"] = contig(host(params["value_head"]).T)
+        lay = params["layers"]
+        gpt2_map = {  # ours -> hf sub-name (Conv1D orientation == ours)
+            "ln1": "ln_1.weight", "ln1_b": "ln_1.bias",
+            "ln2": "ln_2.weight", "ln2_b": "ln_2.bias",
+            "wo": "attn.c_proj.weight", "bo": "attn.c_proj.bias",
+            "wg": "mlp.c_fc.weight", "b_fc": "mlp.c_fc.bias",
+            "wd": "mlp.c_proj.weight", "b_proj": "mlp.c_proj.bias",
+        }
+        hosted = {k: host(v) for k, v in lay.items()}
+        for i in range(cfg.num_hidden_layers):
+            pre = f"transformer.h.{i}."
+            for key, sub in gpt2_map.items():
+                tensors[pre + sub] = contig(hosted[key][i])
+            tensors[pre + "attn.c_attn.weight"] = contig(
+                np.concatenate(
+                    [hosted["wq"][i], hosted["wk"][i], hosted["wv"][i]], axis=1
+                )
+            )
+            tensors[pre + "attn.c_attn.bias"] = contig(
+                np.concatenate(
+                    [hosted["bq"][i], hosted["bk"][i], hosted["bv"][i]]
+                )
+            )
+        save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+        with open(os.path.join(out_dir, "config.json"), "w") as f:
+            json.dump(to_hf_config(cfg), f, indent=2)
+        return
     if "vision" in params:
         def _walk(node, prefix):
             for k in sorted(node.keys()):

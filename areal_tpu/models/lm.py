@@ -22,6 +22,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import AttnSpec, decode_attention_xla, packed_attention
@@ -42,20 +43,49 @@ def rms_norm(
     return (out * wf).astype(x.dtype)
 
 
-def _norm(cfg: TransformerConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+def layer_norm(
+    x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, eps: float
+) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = out * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _norm(
+    cfg: TransformerConfig,
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    b: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    if cfg.norm_type == "layer":
+        return layer_norm(x, w, b, cfg.rms_norm_eps)
     return rms_norm(x, w, cfg.rms_norm_eps, cfg.rms_norm_offset)
 
 
-def _embed(params: Params, cfg: TransformerConfig, input_ids: jnp.ndarray):
+def _embed(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+):
     x = params["embed"][input_ids]
     if cfg.scale_embeddings:  # gemma normalizer
         x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    if cfg.pos_embed_type == "learned":  # gpt2 wpe table
+        x = x + params["pos_embed"][positions]
     return x
 
 
 def _act(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.hidden_act == "gelu_tanh":
         return jax.nn.gelu(x, approximate=True)
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if cfg.hidden_act == "relu":
+        return jax.nn.relu(x)
     return jax.nn.silu(x)
 
 
@@ -90,6 +120,11 @@ def init_params(
         layers["bq"] = jnp.zeros((l, qd), dtype)
         layers["bk"] = jnp.zeros((l, kvd), dtype)
         layers["bv"] = jnp.zeros((l, kvd), dtype)
+    if cfg.norm_type == "layer":
+        layers["ln1_b"] = jnp.zeros((l, h), dtype)
+        layers["ln2_b"] = jnp.zeros((l, h), dtype)
+    if cfg.proj_bias:
+        layers["bo"] = jnp.zeros((l, h), dtype)
     if cfg.qk_norm:
         layers["q_norm"] = norm_init((l, d), dtype)
         layers["k_norm"] = norm_init((l, d), dtype)
@@ -99,16 +134,30 @@ def init_params(
         layers["wg"] = normal(next(keys), (l, e, h, mi), s)
         layers["wu"] = normal(next(keys), (l, e, h, mi), s)
         layers["wd"] = normal(next(keys), (l, e, mi, h), s / (2 * l) ** 0.5)
-    else:
+    elif cfg.mlp_gated:
         layers["wg"] = normal(next(keys), (l, h, i), s)
         layers["wu"] = normal(next(keys), (l, h, i), s)
         layers["wd"] = normal(next(keys), (l, i, h), s / (2 * l) ** 0.5)
+    else:  # gpt2 fc -> act -> proj
+        layers["wg"] = normal(next(keys), (l, h, i), s)
+        layers["wd"] = normal(next(keys), (l, i, h), s / (2 * l) ** 0.5)
+    if cfg.proj_bias and not cfg.is_moe:
+        layers["b_fc"] = jnp.zeros((l, i), dtype)
+        if cfg.mlp_gated:
+            layers["b_up"] = jnp.zeros((l, i), dtype)
+        layers["b_proj"] = jnp.zeros((l, h), dtype)
 
     params: Params = {
         "embed": normal(next(keys), (cfg.vocab_size, h), s),
         "layers": layers,
         "final_norm": norm_init((h,), dtype),
     }
+    if cfg.norm_type == "layer":
+        params["final_norm_b"] = jnp.zeros((h,), dtype)
+    if cfg.pos_embed_type == "learned":
+        params["pos_embed"] = normal(
+            next(keys), (cfg.max_position_embeddings, h), s
+        )
     if cfg.is_vlm:
         from areal_tpu.models.vlm import init_vision_params
 
@@ -151,7 +200,23 @@ def _mlp(
 ) -> jnp.ndarray:
     if cfg.is_moe:
         return _moe_mlp(cfg, lp, x, attn_spec)
-    return (_act(cfg, x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+    # named for the "mlp_saveable" remat policy: these [T, I] tensors are
+    # ~60% of per-layer forward FLOPs but only 2*T*I bf16 bytes per layer
+    if not cfg.mlp_gated:  # gpt2 fc -> act -> proj
+        h = x @ lp["wg"]
+        if cfg.proj_bias:
+            h = h + lp["b_fc"]
+        out = _act(cfg, checkpoint_name(h, "mlp_gate")) @ lp["wd"]
+        return out + lp["b_proj"] if cfg.proj_bias else out
+    g = x @ lp["wg"]
+    u = x @ lp["wu"]
+    if cfg.proj_bias:
+        g = g + lp["b_fc"]
+        u = u + lp["b_up"]
+    g = checkpoint_name(g, "mlp_gate")
+    u = checkpoint_name(u, "mlp_up")
+    out = (_act(cfg, g) * u) @ lp["wd"]
+    return out + lp["b_proj"] if cfg.proj_bias else out
 
 
 def _moe_mlp(
@@ -229,15 +294,19 @@ def _block(
     attn_spec: AttnSpec | None = None,
 ) -> jnp.ndarray:
     """One decoder block over a packed stream. x [T, H]."""
-    h = _norm(cfg, x, lp["ln1"])
+    h = _norm(cfg, x, lp["ln1"], lp.get("ln1_b"))
     q, k, v = _qkv(cfg, lp, h)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    if cfg.pos_embed_type == "rope":
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
     attn = packed_attention(
         q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
     )
-    x = x + attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
-    h = _norm(cfg, x, lp["ln2"])
+    attn_out = attn.reshape(x.shape[0], cfg.q_dim) @ lp["wo"]
+    if cfg.proj_bias:
+        attn_out = attn_out + lp["bo"]
+    x = x + attn_out
+    h = _norm(cfg, x, lp["ln2"], lp.get("ln2_b"))
     x = x + _mlp(cfg, lp, h, attn_spec)
     return x
 
@@ -258,6 +327,11 @@ _REMAT_POLICIES = {
     "dots_with_no_batch_dims_saveable": (
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     ),
+    # middle ground: keep only the gate/up projections (the FLOPs-dominant
+    # dots) at 2*T*I bf16 bytes/layer — attention + down-proj recompute
+    "mlp_saveable": jax.checkpoint_policies.save_only_these_names(
+        "mlp_gate", "mlp_up"
+    ),
 }
 
 
@@ -273,7 +347,7 @@ def forward_packed(
     remat_policy: str = "nothing_saveable",
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
-    x = _embed(params, cfg, input_ids)
+    x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
 
@@ -291,7 +365,7 @@ def forward_packed(
             )
         body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[:, 0]
     head = params.get("lm_head")
@@ -371,7 +445,7 @@ def prefill_many(
     positions = pos2d.reshape(-1)
     segment_ids = seg2d.reshape(-1)
     flat = input_ids.reshape(-1)
-    x = _embed(params, cfg, flat)
+    x = _embed(params, cfg, flat, positions)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
 
@@ -379,20 +453,24 @@ def prefill_many(
         x = splice_image_embeds(cfg, x, flat, embeds)
 
     def body(carry, lp):
-        h = _norm(cfg, carry, lp["ln1"])
+        h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
         q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        if cfg.pos_embed_type == "rope":
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         attn = packed_attention(
             q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
         )
-        out = carry + attn.reshape(n * tp, cfg.q_dim) @ lp["wo"]
-        h2 = _norm(cfg, out, lp["ln2"])
+        attn_out = attn.reshape(n * tp, cfg.q_dim) @ lp["wo"]
+        if cfg.proj_bias:
+            attn_out = attn_out + lp["bo"]
+        out = carry + attn_out
+        h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
         out = out + _mlp(cfg, lp, h2, attn_spec)
         return out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     idx = jnp.arange(n, dtype=jnp.int32) * tp + lengths - 1
     h_last = x[idx]  # [N, H]
     head = params.get("lm_head")
@@ -420,16 +498,17 @@ def decode_step(
     tokens should mask results host-side; the cache write is dense per slot.
     """
     b, tq = input_ids.shape
-    x = _embed(params, cfg, input_ids)  # [B, Tq, H]
     positions = cache_len[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
+    x = _embed(params, cfg, input_ids, positions)  # [B, Tq, H]
 
     def body(carry, layer_in):
         h_in, = carry
         lp, k_cache, v_cache = layer_in
-        h = _norm(cfg, h_in, lp["ln1"])
+        h = _norm(cfg, h_in, lp["ln1"], lp.get("ln1_b"))
         q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        if cfg.pos_embed_type == "rope":
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         # write new k/v into the cache at [cache_len, cache_len+Tq)
         def write(cache_l, new):
             def per_slot(c, n, start):
@@ -442,8 +521,11 @@ def decode_step(
         attn = decode_attention_xla(
             q, k_cache, v_cache, cache_len + tq, window=cfg.sliding_window
         )
-        h_out = h_in + attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
-        h2 = _norm(cfg, h_out, lp["ln2"])
+        attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
+        if cfg.proj_bias:
+            attn_out = attn_out + lp["bo"]
+        h_out = h_in + attn_out
+        h2 = _norm(cfg, h_out, lp["ln2"], lp.get("ln2_b"))
         mlp_in_shape = h2.shape
         mlp_out = _mlp(
             cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
@@ -454,7 +536,7 @@ def decode_step(
     (x,), (new_k, new_v) = jax.lax.scan(
         body, (x,), (params["layers"], cache["k"], cache["v"])
     )
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T

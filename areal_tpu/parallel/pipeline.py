@@ -186,7 +186,7 @@ def forward_packed_pipelined(
     """
     from areal_tpu.models.lm import _embed, _norm
 
-    x = _embed(params, cfg, input_ids)  # [M, T, H]
+    x = _embed(params, cfg, input_ids, positions)  # [M, T, H]
     x = pipeline_hidden(
         params,
         cfg,
@@ -203,7 +203,7 @@ def forward_packed_pipelined(
     x = jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(None, (AXIS_PP, AXIS_DP, AXIS_CP), None))
     )
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[..., 0]
     head = params.get("lm_head")

@@ -57,7 +57,8 @@ def matmul_params(cfg) -> int:
         per_layer += h * cfg.num_experts  # router
         per_layer += 3 * h * cfg.moe_intermediate_size * cfg.num_experts_per_tok
     else:
-        per_layer += 3 * h * cfg.intermediate_size
+        n_mlp_mats = 3 if getattr(cfg, "mlp_gated", True) else 2  # gpt2: fc+proj
+        per_layer += n_mlp_mats * h * cfg.intermediate_size
     total = cfg.num_hidden_layers * per_layer
     # lm_head (or the tied-embedding matmul — the FLOPs are real either way);
     # critics project to 1, negligible

@@ -298,3 +298,146 @@ def test_decode_matches_forward_with_window():
         got.append(np.asarray(logits)[0, 0])
         clen = clen + 1
     np.testing.assert_allclose(np.stack(got), want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "policy",
+    ["nothing_saveable", "dots_with_no_batch_dims_saveable", "mlp_saveable"],
+)
+def test_remat_policies_match_no_remat(policy):
+    """Loss + grads under every remat policy == the no-remat program."""
+    cfg = tiny_config()
+    params = lm.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    _, flat, pos, seg = _packed_inputs([9, 6])
+    flat, pos, seg = jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)
+
+    def loss(p, remat, policy="nothing_saveable"):
+        logits = lm.forward_packed(
+            p, cfg, flat, pos, seg, remat=remat, remat_policy=policy
+        )
+        return jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(15), flat])
+
+    base, gbase = jax.value_and_grad(loss)(params, False)
+    got, ggot = jax.value_and_grad(loss)(params, True, policy)
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(gbase),
+        jax.tree_util.tree_leaves_with_path(ggot),
+        strict=True,
+    ):
+        assert ka == kb
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6, err_msg=str(ka))
+
+
+def _hf_tiny_gpt2(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(
+        vocab_size=128,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        n_positions=64,
+        n_inner=96,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    model = GPT2LMHeadModel(cfg).eval()
+    d = tmp_path / "hf_gpt2"
+    model.save_pretrained(d, safe_serialization=True)
+    return model, str(d)
+
+
+def test_forward_matches_hf_gpt2(tmp_path):
+    """GPT-2: LayerNorm + learned positions + fused-qkv Conv1D + non-gated
+    MLP (reference conversion-registry entry realhf/api/from_hf/gpt2.py)."""
+    torch = pytest.importorskip("torch")
+    model, d = _hf_tiny_gpt2(tmp_path)
+    cfg = from_hf_config(d)
+    assert cfg.arch == "gpt2" and cfg.norm_type == "layer"
+    assert cfg.pos_embed_type == "learned" and not cfg.mlp_gated
+    assert cfg.intermediate_size == 96 and cfg.tie_word_embeddings
+    cfg2, params = hf_io.load_hf_params(d, cfg, dtype="float32")
+
+    lens = [7, 5, 3]
+    ids, flat, pos, seg = _packed_inputs(lens)
+    ours = np.asarray(
+        lm.forward_packed(
+            params, cfg, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)
+        )
+    )
+    with torch.no_grad():
+        off = 0
+        for seq in ids:
+            hf_logits = model(torch.tensor(seq[None].astype(np.int64))).logits[0]
+            np.testing.assert_allclose(
+                ours[off : off + len(seq)],
+                hf_logits.float().numpy(),
+                rtol=2e-4,
+                atol=2e-4,
+            )
+            off += len(seq)
+
+
+def test_gpt2_decode_and_roundtrip(tmp_path):
+    """Decode-with-cache == packed forward; save_hf_params output reloads
+    through transformers with identical logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2LMHeadModel
+
+    model, d = _hf_tiny_gpt2(tmp_path)
+    cfg = from_hf_config(d)
+    _, params = hf_io.load_hf_params(d, cfg, dtype="float32")
+
+    n = 10
+    seq = np.random.default_rng(5).integers(1, 128, size=n).astype(np.int32)
+    want = np.asarray(
+        lm.forward_packed(
+            params,
+            cfg,
+            jnp.asarray(seq),
+            jnp.arange(n, dtype=jnp.int32),
+            jnp.zeros(n, np.int32),
+        )
+    )
+    cache = lm.init_kv_cache(cfg, 1, 32, jnp.float32)
+    clen = jnp.zeros(1, jnp.int32)
+    got = []
+    for t in range(n):
+        logits, cache = lm.decode_step(
+            params, cfg, cache, jnp.asarray([[seq[t]]]), clen
+        )
+        got.append(np.asarray(logits)[0, 0])
+        clen = clen + 1
+    np.testing.assert_allclose(np.stack(got), want, rtol=2e-4, atol=2e-4)
+
+    out = tmp_path / "export"
+    hf_io.save_hf_params(params, cfg, str(out))
+    reloaded = GPT2LMHeadModel.from_pretrained(out).eval()
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(seq[None].astype(np.int64))).logits[0]
+    np.testing.assert_allclose(want, hf_logits.float().numpy(), rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_critic_value_head_roundtrip(tmp_path):
+    """GPT-2 critic: value head must survive save/load (not re-randomized)."""
+    cfg = tiny_config(
+        arch="gpt2", norm_type="layer", pos_embed_type="learned",
+        mlp_gated=False, proj_bias=True, hidden_act="gelu_tanh",
+        tie_word_embeddings=True, is_critic=True, max_position_embeddings=64,
+    )
+    params = lm.init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    params["value_head"] = params["value_head"] + 0.5  # distinctive values
+    out = tmp_path / "critic"
+    hf_io.save_hf_params(params, cfg, str(out))
+    import json as _json
+
+    hf = _json.load(open(out / "config.json"))
+    cfg2 = from_hf_config(hf, is_critic=True)
+    _, params2 = hf_io.load_hf_params(str(out), cfg2, dtype="float32")
+    np.testing.assert_allclose(
+        np.asarray(params["value_head"]), np.asarray(params2["value_head"])
+    )
