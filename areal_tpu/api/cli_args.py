@@ -248,6 +248,12 @@ class EngineBackendConfig:
     fsdp: bool = True  # shard params/optimizer over the dp axis (ZeRO-3-like)
     donate_params: bool = True
     pad_mb_to_multiple: int = 128  # static-shape bucketing for XLA
+    # > 0 fuses LM head + log-softmax into token chunks of this size
+    # (models/lm.forward_fused_logp): full [T, V] logits are never
+    # materialized, which long-context training needs (32k x 152k fp32
+    # logits = 19.5GB). 0 = classic full-logits loss. LM/PPO-actor losses
+    # only; ignored for critics/RM and under pipeline parallelism.
+    loss_chunk_size: int = 0
 
 
 @dataclass

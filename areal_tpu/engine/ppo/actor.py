@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.api.cli_args import MicroBatchSpec, NormConfig, PPOActorConfig
-from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.engine.train_engine import TokenLossFn, TPUTrainEngine
 from areal_tpu.utils import stats_tracker
 from areal_tpu.utils.data import (
     KLEstimator,
@@ -89,12 +89,30 @@ class PPOActor:
             entropy_coeff=config.entropy_coeff,
             entropy_clamp=config.entropy_clamp,
         )
+        # fused chunked-LM-head twin (used when backend.loss_chunk_size > 0)
+        self._token_loss_fn = TokenLossFn(
+            fn=functools.partial(
+                grpo_loss_from_logp,
+                eps_clip=config.eps_clip,
+                eps_clip_higher=config.eps_clip_higher,
+                c_clip=config.c_clip,
+                behav_imp_weight_cap=config.behav_imp_weight_cap,
+                entropy_coeff=config.entropy_coeff,
+                entropy_clamp=config.entropy_clamp,
+            ),
+            temperature=self.temperature,
+            needs_entropy=config.entropy_coeff != 0.0,
+        )
 
     def compute_logp(self, data: TensorDict) -> np.ndarray:
         """Teacher-forced logprobs of the batch under current weights,
         next-token convention (index t scores token t+1). Padded [B, S]."""
         self.engine.train(False)
-        return self.engine.forward(input_=data, post_hook=self._logp_hook)
+        return self.engine.forward(
+            input_=data,
+            post_hook=self._logp_hook,
+            logp_fused_temperature=self.temperature,
+        )
 
     def compute_advantages(self, data: TensorDict) -> None:
         """In-place advantage pipeline (reference actor.py:72-164)."""
@@ -247,6 +265,7 @@ class PPOActor:
                 mb,
                 loss_fn=self._loss_fn,
                 loss_weight_fn=loss_weight_fn,
+                token_loss_fn=self._token_loss_fn,
             )
             tracker.scalar(**train_stat)
             all_stats.append(tracker.export())
@@ -307,12 +326,38 @@ def grpo_loss_fn(
     divides by the global token count). Reference: actor.py:313-391; the
     entropy bonus is the AEnt recipe extension (recipe/AEnt/functional.py)."""
     labels = jnp.roll(input_data["input_ids"], shift=-1)
+    logprobs, entropy = gather_logprobs_entropy(logits, labels, temperature)
+    return grpo_loss_from_logp(
+        logprobs,
+        entropy,
+        input_data,
+        eps_clip=eps_clip,
+        eps_clip_higher=eps_clip_higher,
+        c_clip=c_clip,
+        behav_imp_weight_cap=behav_imp_weight_cap,
+        entropy_coeff=entropy_coeff,
+        entropy_clamp=entropy_clamp,
+    )
+
+
+def grpo_loss_from_logp(
+    logprobs: jnp.ndarray,
+    entropy: jnp.ndarray,
+    input_data: dict[str, Any],
+    eps_clip: float,
+    eps_clip_higher: float | None,
+    c_clip: float | None,
+    behav_imp_weight_cap: float | None,
+    entropy_coeff: float = 0.0,
+    entropy_clamp: float | None = None,
+):
+    """The loss math downstream of (logp, entropy) — shared by the classic
+    logits path and the chunked fused-LM-head path (TokenLossFn)."""
     old_logp = input_data["logprobs"]
     advantages = input_data["advantages"]
     loss_mask = input_data["loss_mask"]
     prox_logp = input_data["prox_logp"]
 
-    logprobs, entropy = gather_logprobs_entropy(logits, labels, temperature)
     loss, _stat = ppo_actor_loss_fn(
         logprobs=logprobs,
         proximal_logprobs=prox_logp,

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.api.cli_args import TrainEngineConfig
-from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.engine.train_engine import TokenLossFn, TPUTrainEngine
 from areal_tpu.utils.data import TensorDict
 from areal_tpu.utils.functional import gather_logprobs
 
@@ -29,6 +29,15 @@ def sft_loss_fn(logits: jnp.ndarray, input_data) -> jnp.ndarray:
     return -jnp.sum(jnp.where(mask, logp, 0.0))
 
 
+def _sft_token_loss_fn(logp, entropy, input_data) -> jnp.ndarray:
+    """sft_loss_fn downstream of the chunked fused LM head."""
+    mask = jnp.roll(input_data["loss_mask"], shift=-1).astype(bool)
+    return -jnp.sum(jnp.where(mask, logp, 0.0))
+
+
+SFT_TOKEN_LOSS = TokenLossFn(fn=_sft_token_loss_fn)
+
+
 def _loss_weight(mb) -> float:
     return float(np.asarray(mb["loss_mask"]).sum())
 
@@ -42,13 +51,15 @@ class LMEngine:
     def train_lm(self, data: TensorDict) -> dict[str, float]:
         self.engine.train()
         return self.engine.train_batch(
-            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight
+            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight,
+            token_loss_fn=SFT_TOKEN_LOSS,
         )
 
     def evaluate_lm(self, data: TensorDict) -> float | None:
         self.engine.train(False)
         return self.engine.eval_batch(
-            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight
+            input_=data, loss_fn=sft_loss_fn, loss_weight_fn=_loss_weight,
+            token_loss_fn=SFT_TOKEN_LOSS,
         )
 
 

@@ -26,6 +26,7 @@ recompiles only per bucket; the packed token dim is sharded over the
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Any, Callable
@@ -42,7 +43,7 @@ from areal_tpu.api.engine_api import TrainEngine
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import TransformerConfig, from_hf_config
-from areal_tpu.models.lm import forward_packed, init_params
+from areal_tpu.models.lm import forward_fused_logp, forward_packed, init_params
 from areal_tpu.parallel import distributed
 from areal_tpu.parallel.mesh import make_mesh, single_device_mesh
 from areal_tpu.parallel.pipeline import (
@@ -78,6 +79,22 @@ _DTYPES = {
     "float32": jnp.float32,
     "float16": jnp.float16,
 }
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenLossFn:
+    """A loss that needs logits only through next-token (logp, entropy).
+
+    When ``backend.loss_chunk_size > 0``, train/eval_batch compute these
+    via the chunked fused LM head (models/lm.forward_fused_logp) instead of
+    materializing [T, V] logits for the companion logits-space ``loss_fn``.
+    ``fn(logp [T], entropy [T], mb) -> scalar`` must be SUM-reduced exactly
+    like its logits-space twin. Frozen/hashable => stable jit-cache key.
+    """
+
+    fn: Callable
+    temperature: float = 1.0
+    needs_entropy: bool = False
 
 
 def make_lr_schedule(cfg: OptimizerConfig, total_steps: int):
@@ -681,37 +698,74 @@ class TPUTrainEngine(TrainEngine):
                 )
                 return loss_fn(logits, mb)
 
-            acc_dtype = _DTYPES[backend.grad_acc_dtype]
-            lora_cfg = self.config.lora
-
-            if lora_cfg is None:
-
-                def step(params, acc, mb):
-                    loss, grads = jax.value_and_grad(compute)(params, mb)
-                    acc = jax.tree.map(
-                        lambda a, g: a + g.astype(acc_dtype), acc, grads
-                    )
-                    return loss, acc
-
-                self._jit_cache[key] = jax.jit(step, donate_argnums=(1,))
-            else:
-                from areal_tpu.models.lora import merge_lora
-
-                def step(lora, base, acc, mb):
-                    def f(lo):
-                        return compute(merge_lora(base, lo, lora_cfg), mb)
-
-                    loss, grads = jax.value_and_grad(f)(lora)
-                    acc = jax.tree.map(
-                        lambda a, g: a + g.astype(acc_dtype), acc, grads
-                    )
-                    return loss, acc
-
-                jitted = jax.jit(step, donate_argnums=(2,))
-                self._jit_cache[key] = (
-                    lambda tr, acc, mb: jitted(tr, self.params, acc, mb)
-                )
+            self._jit_cache[key] = self._build_grad_step(compute)
         return self._jit_cache[key]
+
+    def _grad_fn_fused(self, token_loss_fn: "TokenLossFn") -> Callable:
+        """Like _grad_fn but with the chunked LM-head loss
+        (models/lm.forward_fused_logp): [T, V] logits never materialize."""
+        key = ("grad_fused", token_loss_fn)
+        if key not in self._jit_cache:
+            cfg, backend = self.model_config, self.config.backend
+
+            def compute(params, mb):
+                logp, ent = forward_fused_logp(
+                    params,
+                    cfg,
+                    mb["input_ids"],
+                    mb["positions"],
+                    mb["segment_ids"],
+                    labels=jnp.roll(mb["input_ids"], shift=-1),
+                    temperature=token_loss_fn.temperature,
+                    need_entropy=token_loss_fn.needs_entropy,
+                    chunk=backend.loss_chunk_size,
+                    remat=backend.remat,
+                    remat_policy=backend.remat_policy,
+                    attn_spec=self.attn_spec,
+                    pixel_values=_flat_pixels(mb),
+                )
+                return token_loss_fn.fn(logp, ent, mb)
+
+            self._jit_cache[key] = self._build_grad_step(compute)
+        return self._jit_cache[key]
+
+    def _use_fused_loss(self, token_loss_fn) -> bool:
+        return (
+            token_loss_fn is not None
+            and self.config.backend.loss_chunk_size > 0
+            and pp_size(self.mesh) == 1
+            and not self.model_config.is_critic
+        )
+
+    def _build_grad_step(self, compute: Callable) -> Callable:
+        backend = self.config.backend
+        acc_dtype = _DTYPES[backend.grad_acc_dtype]
+        lora_cfg = self.config.lora
+
+        if lora_cfg is None:
+
+            def step(params, acc, mb):
+                loss, grads = jax.value_and_grad(compute)(params, mb)
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(acc_dtype), acc, grads
+                )
+                return loss, acc
+
+            return jax.jit(step, donate_argnums=(1,))
+        from areal_tpu.models.lora import merge_lora
+
+        def step(lora, base, acc, mb):
+            def f(lo):
+                return compute(merge_lora(base, lo, lora_cfg), mb)
+
+            loss, grads = jax.value_and_grad(f)(lora)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(acc_dtype), acc, grads
+            )
+            return loss, acc
+
+        jitted = jax.jit(step, donate_argnums=(2,))
+        return lambda tr, acc, mb: jitted(tr, self.params, acc, mb)
 
     def _apply_fn(self) -> Callable:
         key = "apply"
@@ -780,6 +834,7 @@ class TPUTrainEngine(TrainEngine):
         loss_fn: Callable,
         loss_weight_fn: Callable,
         group_size: int = 1,
+        token_loss_fn: "TokenLossFn | None" = None,
     ) -> dict[str, float]:
         """Grad-accumulated optimizer step over one padded batch.
 
@@ -808,7 +863,10 @@ class TPUTrainEngine(TrainEngine):
             )
             losses = [jnp.sum(losses_vec)]
         else:
-            grad_step = self._grad_fn(loss_fn)
+            if self._use_fused_loss(token_loss_fn):
+                grad_step = self._grad_fn_fused(token_loss_fn)
+            else:
+                grad_step = self._grad_fn(loss_fn)
             acc = self._zeros_like_grads()
             losses = []
             for packed in packed_mbs:
@@ -859,10 +917,35 @@ class TPUTrainEngine(TrainEngine):
         input_: TensorDict,
         loss_fn: Callable,
         loss_weight_fn: Callable,
+        token_loss_fn: "TokenLossFn | None" = None,
     ) -> float | None:
         assert self.initialized
         _, packed_mbs, _ = self._prepare_mbs(input_)
         denom = sum(float(loss_weight_fn(p)) for p in packed_mbs)
+        if self._use_fused_loss(token_loss_fn):
+            key = ("eval_fused", token_loss_fn)
+            if key not in self._jit_cache:
+                cfg, backend = self.model_config, self.config.backend
+
+                def ev_fused(params, mb):
+                    logp, ent = forward_fused_logp(
+                        params, cfg, mb["input_ids"], mb["positions"],
+                        mb["segment_ids"],
+                        labels=jnp.roll(mb["input_ids"], shift=-1),
+                        temperature=token_loss_fn.temperature,
+                        need_entropy=token_loss_fn.needs_entropy,
+                        chunk=backend.loss_chunk_size,
+                        attn_spec=self.attn_spec,
+                        pixel_values=_flat_pixels(mb),
+                    )
+                    return token_loss_fn.fn(logp, ent, mb)
+
+                self._jit_cache[key] = jax.jit(ev_fused)
+            evf = self._jit_cache[key]
+            total = 0.0
+            for packed in packed_mbs:
+                total += float(evf(self.effective_params(), self._mb_to_device(packed)))
+            return total / max(denom, 1.0)
         if pp_size(self.mesh) > 1:
             pkey = ("eval_pp", loss_fn)
             if pkey not in self._jit_cache:
@@ -910,6 +993,7 @@ class TPUTrainEngine(TrainEngine):
         output_seqlens: list[int] | None = None,
         post_hook: Callable | None = None,
         aggregate_fn: Callable | None = None,
+        logp_fused_temperature: float | None = None,
     ) -> Any:
         """Microbatched scoring forward (reference: base_hf_engine.py:513).
 
@@ -943,6 +1027,34 @@ class TPUTrainEngine(TrainEngine):
                 )
             )
             mb_outs = list(stacked_out)
+        elif (
+            logp_fused_temperature is not None
+            and self.config.backend.loss_chunk_size > 0
+            and not self.model_config.is_critic
+        ):
+            # chunked-fused scoring: next-token logp without [T, V] logits
+            # (the compute_logp / recompute_logprob path must survive long
+            # context just like the train step)
+            key = ("fwd_fused", logp_fused_temperature)
+            if key not in self._jit_cache:
+                cfg, backend = self.model_config, self.config.backend
+                temp = logp_fused_temperature
+
+                def fwd(params, mb):
+                    logp, _ = forward_fused_logp(
+                        params, cfg, mb["input_ids"], mb["positions"],
+                        mb["segment_ids"],
+                        labels=jnp.roll(mb["input_ids"], shift=-1),
+                        temperature=temp,
+                        chunk=backend.loss_chunk_size,
+                        attn_spec=self.attn_spec,
+                        pixel_values=_flat_pixels(mb),
+                    )
+                    return logp
+
+                self._jit_cache[key] = jax.jit(fwd)
+            fwd = self._jit_cache[key]
+            mb_outs = None
         else:
             key = ("fwd", post_hook)
             if key not in self._jit_cache:

@@ -335,7 +335,7 @@ _REMAT_POLICIES = {
 }
 
 
-def forward_packed(
+def _trunk(
     params: Params,
     cfg: TransformerConfig,
     input_ids: jnp.ndarray,  # [T] int32
@@ -346,7 +346,7 @@ def forward_packed(
     pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
     remat_policy: str = "nothing_saveable",
 ) -> jnp.ndarray:
-    """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
+    """Embed -> layer scan -> final norm: hidden states [T, H]."""
     x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
         from areal_tpu.models.vlm import encode_images, splice_image_embeds
@@ -365,13 +365,90 @@ def forward_packed(
             )
         body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    return _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+
+
+def forward_packed(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [T] int32
+    positions: jnp.ndarray,  # [T] int32
+    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
+    remat: bool = False,
+    attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
+    remat_policy: str = "nothing_saveable",
+) -> jnp.ndarray:
+    """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
+    x = _trunk(
+        params, cfg, input_ids, positions, segment_ids,
+        remat=remat, attn_spec=attn_spec, pixel_values=pixel_values,
+        remat_policy=remat_policy,
+    )
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[:, 0]
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     return (x @ head).astype(jnp.float32)
+
+
+def forward_fused_logp(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [T] int32
+    positions: jnp.ndarray,  # [T] int32
+    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
+    labels: jnp.ndarray,  # [T] int32
+    temperature: float = 1.0,
+    need_entropy: bool = False,
+    chunk: int = 1024,
+    remat: bool = False,
+    attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,
+    remat_policy: str = "nothing_saveable",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(logp[T], entropy[T]) of ``labels`` WITHOUT materializing [T, V].
+
+    The LM head + log-softmax run chunk-by-chunk over the token dim under
+    ``jax.checkpoint``, so live memory is one [chunk, V] logits block and
+    the backward recomputes each block from the stored [T, H] hidden
+    states. This is what makes full-vocab training possible at long
+    context on HBM-limited chips: at 32k tokens x 152k vocab, fp32 logits
+    alone are ~19.5GB — more than a v5e's entire HBM. Per-row math is
+    identical to utils/functional.gather_logprobs_entropy.
+    """
+    x = _trunk(
+        params, cfg, input_ids, positions, segment_ids,
+        remat=remat, attn_spec=attn_spec, pixel_values=pixel_values,
+        remat_policy=remat_policy,
+    )
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    t = x.shape[0]
+    n_chunks = -(-t // chunk)
+    pad = n_chunks * chunk - t
+    xc = jnp.pad(x, ((0, pad), (0, 0))).reshape(n_chunks, chunk, -1)
+    yc = jnp.pad(labels, (0, pad)).reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def chunk_body(args):
+        h_c, y_c = args
+        logits = (h_c @ head).astype(jnp.float32)
+        if temperature != 1.0:
+            logits = logits / temperature
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, y_c[:, None], axis=-1)[:, 0]
+        if need_entropy:
+            logp_full = logits - logz[:, None]
+            ent = -jnp.sum(jnp.exp(logp_full) * logp_full, axis=-1)
+        else:
+            ent = jnp.zeros_like(logz)
+        return picked - logz, ent
+
+    logp, ent = jax.lax.map(chunk_body, (xc, yc))
+    return logp.reshape(-1)[:t], ent.reshape(-1)[:t]
 
 
 # ---------------------------------------------------------------------------

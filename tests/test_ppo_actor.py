@@ -189,3 +189,33 @@ def test_recipe_cispo_actor_trains():
     assert np.isfinite(stats[0]["loss"])
     assert stats[0]["update_successful"] == 1.0
     a.destroy()
+
+
+def test_ppo_update_fused_chunked_loss_matches_full():
+    """ppo_update with backend.loss_chunk_size > 0 (chunked fused LM head)
+    matches the classic full-logits loss: same stats, same updated params."""
+    import jax
+
+    results = {}
+    for chunk in (0, 8):
+        cfg = _actor_cfg(entropy_coeff=0.01)
+        cfg.backend.loss_chunk_size = chunk
+        a = TPUPPOActor(cfg)
+        a.initialize(None, None, model_config=tiny_config(), seed=0)
+        data = _rollout_batch(seed=3)
+        data["prox_logp"] = a.compute_logp(data)
+        a.compute_advantages(data)
+        stats = a.ppo_update(data)
+        results[chunk] = (stats, jax.device_get(a.params))
+        a.destroy()
+
+    (s0, p0), (s1, p1) = results[0], results[8]
+    for a_, b_ in zip(s0, s1, strict=True):
+        np.testing.assert_allclose(a_["loss"], b_["loss"], rtol=1e-5)
+        np.testing.assert_allclose(a_["grad_norm"], b_["grad_norm"], rtol=1e-4)
+    for (ka, x), (kb, y) in zip(
+        jax.tree_util.tree_leaves_with_path(p0),
+        jax.tree_util.tree_leaves_with_path(p1),
+        strict=True,
+    ):
+        np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-6, err_msg=str(ka))

@@ -204,3 +204,39 @@ def test_adafactor_smoke():
     losses = [eng.train_lm(data)["loss"] for _ in range(6)]
     assert losses[-1] < losses[0], losses
     eng.destroy()
+
+
+def test_fused_chunked_loss_matches_full():
+    """backend.loss_chunk_size > 0 must produce the same train stats and
+    final params as the classic full-logits loss (the chunked fused LM head
+    never materializes [T, V] — models/lm.forward_fused_logp)."""
+    import jax.numpy as jnp
+
+    results = {}
+    for chunk in (0, 8):
+        cfg = _cfg()
+        cfg.backend.loss_chunk_size = chunk
+        eng = TPULMEngine(cfg)
+        eng.initialize(
+            None,
+            FinetuneSpec(
+                total_train_epochs=1, dataset_size=64, train_batch_size=4
+            ),
+            model_config=tiny_config(),
+        )
+        stats = [eng.train_lm(_batch(seed=5)) for _ in range(3)]
+        ev = eng.lm.evaluate_lm(_batch(seed=6))
+        results[chunk] = (stats, ev, jax.device_get(eng.params))
+        eng.destroy()
+
+    (s0, e0, p0), (s1, e1, p1) = results[0], results[8]
+    for a, b in zip(s0, s1, strict=True):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+        np.testing.assert_allclose(a["grad_norm"], b["grad_norm"], rtol=1e-4)
+    np.testing.assert_allclose(e0, e1, rtol=1e-5)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(p0),
+        jax.tree_util.tree_leaves_with_path(p1),
+        strict=True,
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6, err_msg=str(ka))
