@@ -148,17 +148,15 @@ def packed_attention(
     """Dispatch per ``spec`` (see module docstring). Same [T, ...] packed
     layout in all cases."""
     spec = spec if spec is not None else _DEFAULT_SPEC
-    if window > 0 and spec.is_sharded:
-        # sliding window runs on the LOCAL paths only (flash kernel with
-        # window block-skipping, or the einsum fallback); the ring/ulysses
-        # wrappers would silently attend outside the window
-        raise NotImplementedError(
-            "sliding-window attention is not implemented for "
-            "ring/ulysses/TP-sharded dispatch; run sliding-window "
-            "models on a dp=cp=tp=1 mesh"
-        )
     if spec.is_sharded:
         if spec.impl == "ulysses":
+            if window > 0:
+                # the ulysses all-to-all path has no windowed chunk compute;
+                # ring CP (the default) handles windows on global positions
+                raise NotImplementedError(
+                    "sliding-window attention is not implemented for the "
+                    "ulysses dispatch; use ring CP (the default) instead"
+                )
             from areal_tpu.ops.ulysses import ulysses_attention_sharded
 
             # local attention runs over the FULL gathered sequence
@@ -171,6 +169,8 @@ def packed_attention(
             )
         from areal_tpu.ops.ring_attention import ring_attention_sharded
 
+        # window > 0 is exact here: both chunk computes mask on GLOBAL
+        # positions, so ring steps outside the window contribute nothing
         t_local = q.shape[0] // max(spec.n_token_shards, 1)
         return ring_attention_sharded(
             spec.mesh, q, k, v, segment_ids,
@@ -179,6 +179,7 @@ def packed_attention(
             chunk_impl=spec.resolve_impl(t_local),
             head_axis=spec.head_axis,
             block=spec.block,
+            window=window,
         )
     impl = spec.resolve_impl(q.shape[0])
     if impl in ("pallas", "pallas_interpret"):

@@ -41,7 +41,7 @@ from areal_tpu.ops.attention import repeat_kv
 _NEG_INF = -1e30
 
 
-def _chunk_xla(q, k, v, segq, segk, q_start, k_start, scale):
+def _chunk_xla(q, k, v, segq, segk, q_start, k_start, scale, window=0):
     """Einsum chunk attention returning (o [Tq,NH,D] f32, lse [NH,Tq])."""
     tq, nh, d = q.shape
     tk, kh = k.shape[0], k.shape[1]
@@ -57,6 +57,10 @@ def _chunk_xla(q, k, v, segq, segk, q_start, k_start, scale):
         & (segq[:, None] >= 0)
         & (qpos[:, None] >= kpos[None, :])
     )
+    if window > 0:
+        # sliding window on GLOBAL positions, so it is exact across ring
+        # chunk boundaries too
+        mask = mask & (qpos[:, None] - kpos[None, :] < window)
     s = jnp.where(mask[None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [H, Tq]
     valid = m > _NEG_INF / 2
@@ -101,6 +105,7 @@ def ring_attention_local(
     softmax_scale: float | None = None,
     chunk_impl: str = "xla",  # xla | pallas | pallas_interpret
     block: int = 128,
+    window: int = 0,
 ) -> jnp.ndarray:
     """The per-rank function; call under shard_map over ``axis_name``."""
     tl, nh, d = q.shape
@@ -114,9 +119,10 @@ def ring_attention_local(
             softmax_scale=scale,
             block=block,
             interpret=chunk_impl == "pallas_interpret",
+            window=window,
         )
     else:
-        chunk = functools.partial(_chunk_xla, scale=scale)
+        chunk = functools.partial(_chunk_xla, scale=scale, window=window)
 
     if ring_size == 1:
         o, _ = chunk(q, k, v, segment_ids, segment_ids, q_start, q_start)
@@ -155,6 +161,7 @@ def ring_attention_sharded(
     chunk_impl: str = "xla",
     head_axis: str | None = None,
     block: int = 128,
+    window: int = 0,
 ) -> jnp.ndarray:
     """shard_map wrapper: tokens sharded over ``token_axes``, heads over
     ``head_axis`` (TP), K/V ring over ``ring_axis`` (default: ALL token
@@ -195,6 +202,7 @@ def ring_attention_sharded(
             softmax_scale=softmax_scale,
             chunk_impl=chunk_impl,
             block=block,
+            window=window,
         )
 
     spec3 = P(tok, head_axis, None)

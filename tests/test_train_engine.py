@@ -240,3 +240,31 @@ def test_fused_chunked_loss_matches_full():
         strict=True,
     ):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6, err_msg=str(ka))
+
+
+def test_multi_device_mesh_fused_loss_matches_single():
+    """The chunked fused LM head composes with GSPMD meshes: a dp2cp2tp2
+    sharded step with loss_chunk_size > 0 == the single-device full-logits
+    step."""
+    data = _batch(bs=8, seed=7)
+    out = {}
+    for name, par, chunk in [
+        ("single_full", None, 0),
+        ("mesh_fused", ParallelStrategy(dp=2, cp=2, tp=2), 8),
+    ]:
+        cfg = _cfg()
+        cfg.backend.loss_chunk_size = chunk
+        eng = TPULMEngine(cfg)
+        eng.create_process_group(par)
+        eng.initialize(None, None, model_config=tiny_config(), seed=11)
+        stats = eng.train_lm(data)
+        assert np.isfinite(stats["loss"])
+        out[name] = (
+            stats["loss"],
+            np.asarray(jax.device_get(eng.params["embed"])),
+        )
+        eng.destroy()
+    l_s, p_s = out["single_full"]
+    l_m, p_m = out["mesh_fused"]
+    assert np.isclose(l_s, l_m, rtol=1e-4), (l_s, l_m)
+    np.testing.assert_allclose(p_s, p_m, rtol=2e-3, atol=1e-4)
