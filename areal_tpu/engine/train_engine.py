@@ -455,18 +455,28 @@ class TPUTrainEngine(TrainEngine):
         the DistRolloutCoordinator redistribution made structural)."""
         n = int(packed["cu_seqlens"][-1])
         if "pixel_values" in packed and distributed.process_count() > 1:
-            # per-host image tables vs global placeholder ranks don't line
-            # up yet — fail loudly instead of training on the wrong images
-            raise NotImplementedError(
-                "multi-host VLM training is not supported yet"
-            )
+            # assemble the GLOBAL image table in process order — the same
+            # order host token streams concatenate into the global stream —
+            # so splice_image_embeds' global placeholder ranks line up.
+            # The table replicates to every host (each device encodes all
+            # images); fine at rollout-batch scale, revisit if image counts
+            # explode. Every host must carry a pixel_values key (VLM
+            # datasets always do) or the collective would desync.
+            flat = np.asarray(_flat_pixels(packed), np.float32)
+            packed = dict(packed)
+            packed["pixel_values"] = distributed.allgather_rows(flat)
         rep = NamedSharding(self.mesh, P())
         out = {}
         for k, v in packed.items():
             if k in ("cu_seqlens", "max_seqlen"):
                 continue
             arr = np.asarray(v)
-            if arr.ndim >= 1 and arr.shape[0] == n:
+            if k == "pixel_values":
+                # the (possibly allgathered) image table is ALWAYS
+                # replicated — never token-sharded, even if its row count
+                # coincides with this host's token count n
+                out[k] = jax.device_put(arr.astype(np.float32), rep)
+            elif arr.ndim >= 1 and arr.shape[0] == n:
                 if arr.dtype == np.float64:
                     arr = arr.astype(np.float32)
                 if arr.dtype == np.int64:

@@ -120,6 +120,32 @@ def host_local_to_global(mesh, spec, arr: np.ndarray):
     return jax.make_array_from_process_local_data(sharding, arr)
 
 
+def allgather_rows(arr: np.ndarray) -> np.ndarray:
+    """Concatenate per-process row tables in PROCESS ORDER (uneven row
+    counts allowed). The multi-host image-table primitive: the global packed
+    stream is the process-order concat of host streams
+    (host_local_to_global), so a process-order image table keeps
+    placeholder ranks aligned (models/vlm.splice_image_embeds)."""
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    counts = multihost_utils.process_allgather(
+        np.asarray([arr.shape[0]], np.int64), tiled=True
+    )  # [P]
+    m = int(counts.max())
+    if m == 0:
+        return arr
+    if arr.shape[0] < m:  # pad to the common max so shapes agree
+        pad = np.zeros((m - arr.shape[0],) + arr.shape[1:], arr.dtype)
+        arr = np.concatenate([arr, pad], axis=0)
+    full = multihost_utils.process_allgather(arr, tiled=True)  # [P*m, ...]
+    segs = [
+        full[i * m : i * m + int(c)] for i, c in enumerate(counts)
+    ]
+    return np.concatenate(segs, axis=0)
+
+
 def sync_max(value: float) -> float:
     """Max of a host-local scalar across processes (bucket-size agreement)."""
     if jax.process_count() == 1:

@@ -131,6 +131,50 @@ def main():
         pass
     print(f"proc {pid} scatter ok rows={expect_rows}")
 
+    # ---- multi-host VLM: the image table allgathers in process order so
+    # global placeholder ranks line up (train_engine._mb_to_device +
+    # distributed.allgather_rows); must match single-process numerics ----
+    vcfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vision_patch_size=8,
+        vision_image_size=16,
+        vision_hidden_size=16,
+        vision_layers=2,
+        image_token_id=100,
+    )
+    veng = TPULMEngine(cfg)
+    veng.create_process_group(ParallelStrategy(dp=nprocs))
+    veng.initialize(None, None, model_config=vcfg, seed=13)
+    vrng = np.random.default_rng(3)
+    ids = vrng.integers(1, 100, size=(4, 16)).astype(np.int32)
+    ids[:, :4] = 100  # 4 placeholders = 1 image (2x2 patches... 4 rows)
+    pix = vrng.uniform(0, 1, (4, 1, 16, 16, 3)).astype(np.float32)
+    lm_mask = np.concatenate(
+        [np.zeros((4, 4), np.int32), np.ones((4, 12), np.int32)], 1
+    )
+    # deliberately UNEVEN rows per host (3 vs 1) so allgather_rows'
+    # pad-to-max + reslice branch is exercised, not just equal counts
+    if nprocs == 2:
+        vrows = list(range(3)) if pid == 0 else list(range(3, 4))
+    else:
+        vrows = distributed.shard_rows(list(range(4)))
+    vdata = dict(
+        input_ids=ids[vrows],
+        attention_mask=np.ones((len(vrows), 16), np.int32),
+        loss_mask=lm_mask[vrows],
+        pixel_values=pix[vrows],
+    )
+    vlosses = [veng.train_lm(vdata)["loss"] for _ in range(2)]
+    if distributed.is_main():
+        with open(os.path.join(outdir, "vlm_result.json"), "w") as f:
+            json.dump({"losses": [float(x) for x in vlosses]}, f)
+    print(f"proc {pid} vlm ok losses={vlosses}")
+
 
 if __name__ == "__main__":
     main()

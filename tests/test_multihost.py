@@ -81,3 +81,32 @@ def test_two_process_dp_matches_single(tmp_path):
     ckpt = os.path.join(outdir, "ckpt")
     assert os.path.isfile(os.path.join(ckpt, "model.safetensors"))
     assert os.path.isfile(os.path.join(ckpt, "optim", "opt_state.npz"))
+
+    # multi-host VLM (process-order image-table allgather) vs single-process
+    vmulti = json.load(open(os.path.join(outdir, "vlm_result.json")))
+    vcfg_over = dict(
+        vision_patch_size=8,
+        vision_image_size=16,
+        vision_hidden_size=16,
+        vision_layers=2,
+        image_token_id=100,
+    )
+    veng = TPULMEngine(cfg)
+    veng.initialize(
+        None, None, model_config=tiny_config(**vcfg_over), seed=13
+    )
+    vrng = np.random.default_rng(3)
+    ids = vrng.integers(1, 100, size=(4, 16)).astype(np.int32)
+    ids[:, :4] = 100
+    pix = vrng.uniform(0, 1, (4, 1, 16, 16, 3)).astype(np.float32)
+    vdata = dict(
+        input_ids=ids,
+        attention_mask=np.ones((4, 16), np.int32),
+        loss_mask=np.concatenate(
+            [np.zeros((4, 4), np.int32), np.ones((4, 12), np.int32)], 1
+        ),
+        pixel_values=pix,
+    )
+    vlosses = [veng.train_lm(vdata)["loss"] for _ in range(2)]
+    veng.destroy()
+    np.testing.assert_allclose(vmulti["losses"], vlosses, rtol=1e-4)
