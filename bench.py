@@ -36,21 +36,49 @@ def log(msg: str):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def init_backend(retries: int = 5, sleep_s: float = 20.0):
-    """jax.devices() with retry + diagnostics (backend tunnel can flap)."""
+def init_backend(retries: int = 5, sleep_s: float = 20.0, attempt_s: float = 120.0):
+    """jax.devices() with retry + diagnostics (backend tunnel can flap).
+
+    Each attempt runs in a daemon thread with a deadline: a wedged tunnel
+    BLOCKS inside backend init instead of erroring (observed failure mode),
+    and an indefinite hang here would surface as a driver-side timeout with
+    no parseable record at all."""
+    import threading
+
     import jax
 
-    last = None
+    last: list = [None]
+    attempts_run = 0
     for i in range(retries):
-        try:
-            devices = jax.devices()
-            log(f"backend={jax.default_backend()} devices={devices}")
-            return devices
-        except Exception as e:  # backend UNAVAILABLE etc.
-            last = e
-            log(f"backend init attempt {i + 1}/{retries} failed: {e}")
+        attempts_run = i + 1
+        box: list = []
+
+        def attempt():
+            try:
+                box.append(jax.devices())
+            except Exception as e:  # backend UNAVAILABLE etc.
+                last[0] = e
+
+        th = threading.Thread(target=attempt, daemon=True)
+        th.start()
+        th.join(attempt_s)
+        if box:
+            log(f"backend={jax.default_backend()} devices={box[0]}")
+            return box[0]
+        if th.is_alive():
+            last[0] = TimeoutError(
+                f"backend init still blocked after {attempt_s}s "
+                "(tunnel wedged — claim never resolves)"
+            )
+            # the stuck thread holds jax's init lock; further in-process
+            # retries would just queue behind it
+            break
+        log(f"backend init attempt {i + 1}/{retries} failed: {last[0]}")
+        if i + 1 < retries:
             time.sleep(sleep_s)
-    raise RuntimeError(f"TPU backend unavailable after {retries} attempts: {last}")
+    raise RuntimeError(
+        f"TPU backend unavailable after {attempts_run} attempt(s): {last[0]}"
+    )
 
 
 def qwen2_1p5b_cfg(layers: int = 28):
