@@ -150,22 +150,17 @@ def packed_attention(
     spec = spec if spec is not None else _DEFAULT_SPEC
     if spec.is_sharded:
         if spec.impl == "ulysses":
-            if window > 0:
-                # the ulysses all-to-all path has no windowed chunk compute;
-                # ring CP (the default) handles windows on global positions
-                raise NotImplementedError(
-                    "sliding-window attention is not implemented for the "
-                    "ulysses dispatch; use ring CP (the default) instead"
-                )
             from areal_tpu.ops.ulysses import ulysses_attention_sharded
 
-            # local attention runs over the FULL gathered sequence
+            # local attention runs over the FULL gathered sequence, so the
+            # sliding window applies exactly as in the unsharded path
             return ulysses_attention_sharded(
                 spec.mesh, q, k, v, segment_ids,
                 token_axes=spec.token_axes,
                 softmax_scale=softmax_scale,
                 chunk_impl=spec.resolve_impl(q.shape[0]),
                 block=spec.block,
+                window=window,
             )
         from areal_tpu.ops.ring_attention import ring_attention_sharded
 

@@ -29,16 +29,18 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _local_attention(q, k, v, seg, impl: str, block: int, softmax_scale):
+def _local_attention(q, k, v, seg, impl: str, block: int, softmax_scale,
+                     window: int = 0):
     from areal_tpu.ops.attention import packed_attention_xla
 
     if impl in ("pallas", "pallas_interpret"):
         from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
 
         return flash_attention_packed(
-            q, k, v, seg, softmax_scale, block, impl == "pallas_interpret"
+            q, k, v, seg, softmax_scale, block, impl == "pallas_interpret",
+            window,
         )
-    return packed_attention_xla(q, k, v, seg, softmax_scale)
+    return packed_attention_xla(q, k, v, seg, softmax_scale, window)
 
 
 def ulysses_attention_sharded(
@@ -51,11 +53,14 @@ def ulysses_attention_sharded(
     softmax_scale: float | None = None,
     chunk_impl: str = "xla",
     block: int = 128,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Tokens sharded over ``token_axes`` outside; heads sharded inside.
 
     all_to_all #1: [T/n, H, D] -> [T, H/n, D] (scatter heads, gather seq)
     all_to_all #2: the reverse. Segment ids all-gather (tiny).
+    ``window`` is exact here: the local compute sees the FULL gathered
+    sequence, so windowing is the same as the unsharded path.
     """
     token_axes = tuple(token_axes)
     n = 1
@@ -63,7 +68,7 @@ def ulysses_attention_sharded(
         n *= mesh.shape[a]
     if n == 1:
         return _local_attention(
-            q, k, v, segment_ids, chunk_impl, block, softmax_scale
+            q, k, v, segment_ids, chunk_impl, block, softmax_scale, window
         )
     assert q.shape[1] % n == 0 and k.shape[1] % n == 0, (
         f"ulysses needs heads divisible by the sp group: "
@@ -89,7 +94,9 @@ def ulysses_attention_sharded(
         kf = scatter_heads(k_l)
         vf = scatter_heads(v_l)
         seg_f = jax.lax.all_gather(seg_l, axis, tiled=True)  # [T]
-        of = _local_attention(qf, kf, vf, seg_f, chunk_impl, block, softmax_scale)
+        of = _local_attention(
+            qf, kf, vf, seg_f, chunk_impl, block, softmax_scale, window
+        )
         return gather_heads(of)  # back to [Tl, H, D]
 
     spec3 = P(token_axes, None, None)

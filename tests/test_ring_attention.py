@@ -326,3 +326,24 @@ def test_ring_sliding_window_pallas_chunks_matches_global():
         ref = np.asarray(packed_attention_xla(q, k, v, seg, window=w))
         ref = np.where((np.asarray(seg) >= 0)[:, None, None], ref, 0.0)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_sliding_window_matches_global():
+    """Windowed ulysses == windowed global attention (the local compute
+    sees the full gathered sequence, so the window applies exactly)."""
+    from areal_tpu.ops.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(2, 2)
+    q, k, v, seg = make_inputs(t=256, nh=8, kh=4, d=32, seed=6)
+    for w, impl, block in ((41, "xla", 128), (64, "pallas_interpret", 32)):
+        out = jax.jit(
+            lambda *a, w=w, impl=impl, block=block: ulysses_attention_sharded(
+                mesh, *a, window=w, chunk_impl=impl, block=block
+            )
+        )(q, k, v, seg)
+        ref = np.asarray(packed_attention_xla(q, k, v, seg, window=w))
+        ref = np.where((np.asarray(seg) >= 0)[:, None, None], ref, 0.0)
+        out = np.where(
+            (np.asarray(seg) >= 0)[:, None, None], np.asarray(out), 0.0
+        )
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
