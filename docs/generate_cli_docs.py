@@ -1,0 +1,73 @@
+"""Generate the CLI/config reference from the cli_args dataclasses.
+
+Parity with the reference's auto-generated CLI docs
+(docs/generate_cli_docs.py there): every config dataclass in
+areal_tpu.api.cli_args becomes a markdown table of field / type / default,
+with the class docstring as the section intro. Inline field comments are
+not extracted (they live next to the code on purpose); the table is the
+override map for ``--config file.yaml key=value`` users.
+
+Usage:  python docs/generate_cli_docs.py > docs/cli_reference.md
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _default_repr(f: dataclasses.Field) -> str:
+    if f.default is not dataclasses.MISSING:
+        return repr(f.default)
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        try:
+            return repr(f.default_factory())  # type: ignore[misc]
+        except Exception:
+            return f.default_factory.__name__  # type: ignore[union-attr]
+    return "(required)"
+
+
+def _type_repr(tp) -> str:
+    s = tp if isinstance(tp, str) else getattr(tp, "__name__", str(tp))
+    return s.replace("areal_tpu.api.cli_args.", "")
+
+
+def main(out=sys.stdout):
+    from areal_tpu.api import cli_args
+
+    classes = [
+        obj
+        for name, obj in vars(cli_args).items()
+        if dataclasses.is_dataclass(obj)
+        and isinstance(obj, type)
+        and not name.startswith("_")
+    ]
+    print("# Config / CLI reference", file=out)
+    print(
+        "\nAuto-generated from `areal_tpu/api/cli_args.py` by"
+        " `docs/generate_cli_docs.py` — do not edit by hand."
+        "\nOverride any field with `--config file.yaml dotted.key=value`"
+        " (`load_expr_config`).\n",
+        file=out,
+    )
+    for cls in classes:
+        print(f"## {cls.__name__}", file=out)
+        doc = (cls.__doc__ or "").strip()
+        if doc and not doc.startswith(cls.__name__ + "("):
+            print(f"\n{doc}\n", file=out)
+        else:
+            print("", file=out)
+        print("| field | type | default |", file=out)
+        print("|---|---|---|", file=out)
+        for f in dataclasses.fields(cls):
+            t = _type_repr(f.type).replace("|", "\\|")
+            d = _default_repr(f).replace("|", "\\|")
+            print(f"| `{f.name}` | `{t}` | `{d}` |", file=out)
+        print("", file=out)
+
+
+if __name__ == "__main__":
+    main()

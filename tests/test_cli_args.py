@@ -69,3 +69,37 @@ def test_override_without_config_file():
 def test_bad_override():
     with pytest.raises(ValueError):
         parse_cli_args(["keynovalue"])
+
+
+def test_cli_docs_generator_covers_all_configs():
+    """docs/generate_cli_docs.py emits a section per config dataclass."""
+    import dataclasses
+    import io
+    import importlib.util
+    import os
+
+    from areal_tpu.api import cli_args
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "gen_cli_docs", os.path.join(repo, "docs", "generate_cli_docs.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    mod.main(out=buf)
+    text = buf.getvalue()
+    # the committed reference must match the generator (no hand edits /
+    # no stale docs after a cli_args change)
+    with open(os.path.join(repo, "docs", "cli_reference.md")) as f:
+        assert f.read() == text, (
+            "docs/cli_reference.md is stale — regenerate with "
+            "`python docs/generate_cli_docs.py > docs/cli_reference.md`"
+        )
+    for name, obj in vars(cli_args).items():
+        if (
+            dataclasses.is_dataclass(obj)
+            and isinstance(obj, type)
+            and not name.startswith("_")
+        ):
+            assert f"## {name}" in text, name
