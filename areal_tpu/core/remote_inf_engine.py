@@ -59,6 +59,9 @@ class RemoteInfEngine(InferenceEngine):
         self.config = config
         self.addresses: list[str] = []
         self._server_idx = 0
+        self._inflight: dict[str, int] = {}  # addr -> my in-flight requests
+        self._inflight_lock = threading.Lock()  # agenerate runs on the
+        # rollout thread's loop while generate() may run on a caller thread
         self._rid_to_address: dict[str, str] = {}
         self._rid_queue: list[str] = []
         self._version = 0
@@ -130,11 +133,26 @@ class RemoteInfEngine(InferenceEngine):
     # ------------------------------------------------------------------
 
     def choose_server(self, rid: str | None = None) -> str:
-        if self.config.schedule_policy != "round_robin":
-            raise NotImplementedError(self.config.schedule_policy)
+        policy = self.config.schedule_policy
+        if policy not in ("round_robin", "least_loaded"):
+            raise NotImplementedError(policy)
         if rid is not None and rid in self._rid_to_address:
+            # KV-prefix affinity beats load balance (reference gserver
+            # routes resumed qids back to their server for cache reuse)
             return self._rid_to_address[rid]
-        addr = self.addresses[self._server_idx % len(self.addresses)]
+        if policy == "least_loaded":
+            # the gserver_manager schedule_request role
+            # (realhf/system/gserver_manager.py allocate/schedule): route to
+            # the server with the fewest in-flight requests from this
+            # client; ties rotate round-robin so equal-load servers
+            # interleave instead of pinning to the first
+            n = len(self.addresses)
+            start = self._server_idx % n
+            order = [self.addresses[(start + i) % n] for i in range(n)]
+            with self._inflight_lock:
+                addr = min(order, key=lambda a: self._inflight.get(a, 0))
+        else:
+            addr = self.addresses[self._server_idx % len(self.addresses)]
         self._server_idx += 1
         if rid is not None:
             if len(self._rid_queue) >= RID_CACHE_SIZE:
@@ -168,46 +186,53 @@ class RemoteInfEngine(InferenceEngine):
         session = await self._get_session()
         max_new = gconfig.max_new_tokens
         encoded_images = _encode_images_for_transport(req.image_data)
-        while stop_reason == "abort" and len(accumulated) < max_new:
-            while self._paused.is_set():
-                await asyncio.sleep(0.05)
-            payload = {
-                "rid": req.rid,
-                "input_ids": prompt + accumulated,
-                "image_data": encoded_images,
-                "sampling_params": {
-                    "max_new_tokens": max_new - len(accumulated),
-                    "min_new_tokens": max(
-                        0, gconfig.min_new_tokens - len(accumulated)
-                    ),
-                    "greedy": gconfig.greedy,
-                    "temperature": gconfig.temperature,
-                    "top_p": gconfig.top_p,
-                    "top_k": gconfig.top_k,
-                    "stop_token_ids": gconfig.stop_token_ids,
-                    "stop": gconfig.stop,
-                },
-            }
-            result = await arequest_with_retry(
-                session,
-                f"http://{addr}/generate",
-                payload=payload,
-                max_retries=self.config.request_retries,
-                timeout=self.config.request_timeout,
-            )
-            if not accumulated:
-                ttft = time.monotonic() - t_start
-            n_new = len(result["output_tokens"])
-            accumulated += result["output_tokens"]
-            logprobs += result["output_logprobs"]
-            versions += result["output_versions"]
-            itl += result.get("itl", [])
-            stop_reason = result["stop_reason"]
-            if stop_reason == "abort" and n_new == 0:
-                # the server is paused by someone other than this client
-                # (launcher-driven update, another process): back off instead
-                # of busy-spinning issue->abort->issue HTTP loops
-                await asyncio.sleep(0.2)
+        with self._inflight_lock:
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+        try:
+            while stop_reason == "abort" and len(accumulated) < max_new:
+                while self._paused.is_set():
+                    await asyncio.sleep(0.05)
+                payload = {
+                    "rid": req.rid,
+                    "input_ids": prompt + accumulated,
+                    "image_data": encoded_images,
+                    "sampling_params": {
+                        "max_new_tokens": max_new - len(accumulated),
+                        "min_new_tokens": max(
+                            0, gconfig.min_new_tokens - len(accumulated)
+                        ),
+                        "greedy": gconfig.greedy,
+                        "temperature": gconfig.temperature,
+                        "top_p": gconfig.top_p,
+                        "top_k": gconfig.top_k,
+                        "stop_token_ids": gconfig.stop_token_ids,
+                        "stop": gconfig.stop,
+                    },
+                }
+                result = await arequest_with_retry(
+                    session,
+                    f"http://{addr}/generate",
+                    payload=payload,
+                    max_retries=self.config.request_retries,
+                    timeout=self.config.request_timeout,
+                )
+                if not accumulated:
+                    ttft = time.monotonic() - t_start
+                n_new = len(result["output_tokens"])
+                accumulated += result["output_tokens"]
+                logprobs += result["output_logprobs"]
+                versions += result["output_versions"]
+                itl += result.get("itl", [])
+                stop_reason = result["stop_reason"]
+                if stop_reason == "abort" and n_new == 0:
+                    # the server is paused by someone other than this
+                    # client (launcher-driven update, another process):
+                    # back off instead of busy-spinning
+                    # issue->abort->issue HTTP loops
+                    await asyncio.sleep(0.2)
+        finally:
+            with self._inflight_lock:
+                self._inflight[addr] -= 1
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=accumulated,

@@ -226,3 +226,30 @@ def test_tensor_weight_update_no_disk(served, monkeypatch):
         ids.append(nxt)
     assert resp.output_tokens == expect
     trainer.destroy()
+
+
+def test_least_loaded_routing():
+    """schedule_policy=least_loaded routes new rids to the server with the
+    fewest in-flight requests (the gserver_manager schedule_request role);
+    rid affinity still wins for resumed requests."""
+    from areal_tpu.api.cli_args import InferenceEngineConfig
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+
+    client = RemoteInfEngine(
+        InferenceEngineConfig(schedule_policy="least_loaded")
+    )
+    try:
+        client.addresses = ["s0:1", "s1:1", "s2:1"]
+        # ties rotate round-robin
+        first = {client.choose_server() for _ in range(3)}
+        assert first == {"s0:1", "s1:1", "s2:1"}
+        # load one server; new requests avoid it
+        client._inflight = {"s0:1": 3, "s1:1": 0, "s2:1": 1}
+        assert client.choose_server() == "s1:1"
+        client._inflight["s1:1"] = 5
+        assert client.choose_server() == "s2:1"
+        # affinity beats load
+        client._rid_to_address["rid-x"] = "s0:1"
+        assert client.choose_server("rid-x") == "s0:1"
+    finally:
+        client.executor.destroy()
