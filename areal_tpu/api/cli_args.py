@@ -365,6 +365,13 @@ class JaxGenConfig:
     # copy). Cleared on weight updates so fresh requests always prefill
     # under current weights.
     enable_prefix_reuse: bool = True
+    # cross-request PARTIAL prefix sharing (the general radix-tree-reuse
+    # case the reference inherits from SGLang): when a new prompt shares at
+    # least this many leading tokens with some slot's cached rows, admit it
+    # by copying the shared rows and running a suffix-extension dispatch
+    # instead of a full prefill. Minimum is a cost cutoff — below it a
+    # fresh (batched) prefill is cheaper than copy + lone extend dispatch.
+    prefix_extend_min: int = 128
 
 
 @dataclass

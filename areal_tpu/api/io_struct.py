@@ -96,9 +96,14 @@ class WeightUpdateMeta:
     each server's /update_weights_from_tensor endpoint — the disaggregated
     no-disk path (reference NCCL broadcast, fsdp_engine.py:359-401, without
     the cross-job process group); ``chunked_mem_mb`` bounds chunk size.
+    type="lora": adapter-only push — just the rank-r LoRA factors go to
+    /update_lora_weights (or the colocated equivalent) and the serving side
+    merges against its retained base; a sync ships megabytes, not the full
+    parameter set (reference SGLang adapter hot-swap,
+    areal/engine/sglang_remote.py:82-106).
     """
 
-    type: str = "disk"  # "disk" | "device" | "http"
+    type: str = "disk"  # "disk" | "device" | "http" | "lora"
     path: str | None = None
     chunked_mem_mb: int = 1024
 
@@ -116,6 +121,10 @@ class WeightUpdateMeta:
     @classmethod
     def from_http(cls, chunked_mem_mb: int = 512) -> "WeightUpdateMeta":
         return cls(type="http", chunked_mem_mb=chunked_mem_mb)
+
+    @classmethod
+    def from_lora(cls) -> "WeightUpdateMeta":
+        return cls(type="lora")
 
 
 @dataclass

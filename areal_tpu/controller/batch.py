@@ -77,6 +77,16 @@ class DistributedBatchMemory:
             out.append(self._select(rows))
         return out
 
+    def split_sizes(self, sizes: list[int]) -> list["DistributedBatchMemory"]:
+        """Contiguous row split by explicit sizes (the controller re-splits
+        a concat of ffd shards back into the same per-worker pieces)."""
+        assert sum(sizes) == len(self), (sizes, len(self))
+        out, start = [], 0
+        for n in sizes:
+            out.append(self._select(list(range(start, start + n))))
+            start += n
+        return out
+
     def union(self, other: "DistributedBatchMemory") -> "DistributedBatchMemory":
         """Merge per-key: other's keys join this batch (same row count)."""
         if len(other) not in (0, len(self)):

@@ -1,0 +1,204 @@
+"""Single-controller ("controller mode") orchestration.
+
+Parity with the reference's TrainController/RolloutController
+(areal/api/controller_api.py:21-455): one controller process owns the
+training loop and drives N RPC-hosted engine workers
+(scheduler/rpc.EngineRPCServer around a TPUPPOActor / TPULMEngine), sharding
+batches with :class:`DistributedBatchMemory`.
+
+TPU-native worker model: the workers are the HOSTS of one
+``jax.distributed`` mesh (each runs the same GSPMD program over its device
+shard; gradient sync is the mesh's psum, not an RPC concern — the reference
+needs a torch process group for the same reason,
+areal/controller/train_controller.py). Every model-touching RPC therefore
+fans out to ALL workers CONCURRENTLY — each worker enters the same
+collective program with its own batch shard. Controller-local work
+(advantage pipeline) runs here once, so advantage normalization sees the
+GLOBAL batch, matching single-process numerics.
+
+Step anatomy (``train_ppo_step``):
+1. version fence — all workers must agree on the weight version;
+2. ``chunk_by_ffd`` token-balanced scatter (GRPO groups kept whole);
+3. ``compute_logp`` fan-out -> gather ``prox_logp``;
+4. controller-local ``compute_advantages`` over the global batch;
+5. re-split by the SAME shard sizes -> ``ppo_update`` fan-out;
+6. ``step_lr_scheduler`` + version bump fan-out.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.cli_args import PPOActorConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, WeightUpdateMeta
+from areal_tpu.controller.batch import DistributedBatchMemory
+from areal_tpu.scheduler.rpc import EngineRPCClient
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("TrainController")
+
+
+def _meta_kwargs(meta) -> dict:
+    import dataclasses
+
+    d = dataclasses.asdict(meta)
+    # only JSON-representable fields survive the RPC header; a tokenizer
+    # object can't ride the wire (workers load their own from the model
+    # path when they need one)
+    d = {
+        k: v for k, v in d.items()
+        if isinstance(v, (str, int, float, bool, type(None)))
+    }
+    return {"meta": d}
+
+
+class TrainController:
+    """Drives N RPC engine workers through training steps.
+
+    ``clients`` — one :class:`EngineRPCClient` per worker (host) of the
+    shared jax.distributed mesh, in process order.
+    """
+
+    def __init__(
+        self,
+        clients: list[EngineRPCClient],
+        config: PPOActorConfig | None = None,
+    ):
+        assert clients, "need at least one worker"
+        self.clients = clients
+        self.config = config
+        self._pool = ThreadPoolExecutor(max_workers=len(clients))
+        # controller-local advantage pipeline: PPOActor.compute_advantages
+        # never touches the engine, so a detached actor works here and the
+        # adv/reward normalization sees the GLOBAL batch (single-process
+        # semantics, reference actor.py:72-164)
+        self._local_actor = None
+        if config is not None:
+            from areal_tpu.engine.ppo.actor import PPOActor
+
+            self._local_actor = PPOActor(config, engine=None)
+
+    # -- fan-out plumbing ----------------------------------------------
+
+    def _all(self, method: str, tensors_list=None, **kwargs) -> list[Any]:
+        """Call ``method`` on every worker CONCURRENTLY (collective entry:
+        a sequential loop would deadlock the mesh)."""
+        futs = [
+            self._pool.submit(
+                c.call,
+                method,
+                tensors_list[i] if tensors_list is not None else None,
+                **kwargs,
+            )
+            for i, c in enumerate(self.clients)
+        ]
+        return [f.result() for f in futs]
+
+    # -- engine surface (controller_api.py:207-455 parity) -------------
+
+    def get_version(self) -> int:
+        return int(self.clients[0].call("get_version"))
+
+    def set_version(self, version: int):
+        self._all("set_version", version=version)
+
+    def step_lr_scheduler(self):
+        self._all("step_lr_scheduler")
+
+    def save(self, meta: SaveLoadMeta):
+        self._all("save", **_meta_kwargs(meta))
+
+    def load(self, meta: SaveLoadMeta):
+        self._all("load", **_meta_kwargs(meta))
+
+    def upload_weights(self, meta: WeightUpdateMeta):
+        """All workers join the gather collectives; worker 0 writes."""
+        self._all("upload_weights", **_meta_kwargs(meta))
+
+    def version_fence(self) -> int:
+        versions = set(self._all("get_version"))
+        if len(versions) != 1:
+            raise RuntimeError(
+                f"workers disagree on weight version: {sorted(versions)}"
+            )
+        return int(next(iter(versions)))
+
+    # -- training steps -------------------------------------------------
+
+    def train_lm(self, batch: DistributedBatchMemory) -> dict:
+        """SFT step: even scatter -> concurrent train_lm -> mean stats."""
+        shards = batch.chunk(len(self.clients))
+        stats = self._all("train_lm", tensors_list=[s.to_dict() for s in shards])
+        return {
+            k: float(np.mean([s[k] for s in stats])) for k in stats[0]
+        }
+
+    def train_ppo_step(
+        self, batch: DistributedBatchMemory
+    ) -> list[dict[str, float]]:
+        """One full GRPO/PPO update across the worker fleet."""
+        assert self._local_actor is not None, (
+            "construct TrainController with the PPOActorConfig to run PPO"
+        )
+        cfg = self.config
+        n = len(self.clients)
+        self.version_fence()
+
+        shards = batch.chunk_by_ffd(cfg.group_size, n)
+        sizes = [len(s) for s in shards]
+        logger.info("scatter: %s rows per worker", sizes)
+
+        if cfg.recompute_logprob or cfg.use_decoupled_loss:
+            outs = self._all(
+                "compute_logp_named",
+                tensors_list=[s.to_dict() for s in shards],
+            )
+            for s, o in zip(shards, outs):
+                s.data["prox_logp"] = np.asarray(o["logp"])
+
+        # global advantage pipeline on the controller (adv_norm/group norm
+        # operate on the whole batch, as in single-process mode)
+        full = DistributedBatchMemory.concat(shards)
+        data = full.to_dict()
+        self._local_actor.compute_advantages(data)
+        full = DistributedBatchMemory.from_dict(data)
+
+        update_shards = full.split_sizes(sizes)
+        all_stats = self._all(
+            "ppo_update", tensors_list=[s.to_dict() for s in update_shards]
+        )
+        self.step_lr_scheduler()
+        # merge the per-worker stats lists pointwise (mean over workers)
+        merged: list[dict[str, float]] = []
+        for i in range(max(len(s) for s in all_stats)):
+            per = [s[i] for s in all_stats if i < len(s)]
+            merged.append(
+                {
+                    k: float(np.mean([p[k] for p in per if k in p]))
+                    for k in per[0]
+                }
+            )
+        return merged
+
+    def update_weights(self, meta: WeightUpdateMeta, rollout=None):
+        """Weight push + version bump fan-out (disk path: workers gather,
+        worker 0 writes, rollout servers reload)."""
+        next_version = self.get_version() + 1
+        if meta.type == "disk":
+            self.upload_weights(meta)
+            if rollout is not None:
+                rollout.update_weights(meta)
+        else:
+            raise NotImplementedError(
+                "controller-mode weight updates are disk-based; colocated "
+                "device pushes belong to the launcher mode engines"
+            )
+        self.set_version(next_version)
+        if rollout is not None:
+            rollout.set_version(next_version)
+
+    def destroy(self):
+        self._pool.shutdown(wait=False)

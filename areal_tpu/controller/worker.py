@@ -1,0 +1,81 @@
+"""RPC engine worker (controller mode).
+
+One process per mesh host: builds the PPO actor engine on this host's
+devices, joins ``jax.distributed`` when the fleet spans processes, and
+exposes the engine over :class:`EngineRPCServer` for a
+:class:`TrainController` to drive (reference: areal/scheduler/rpc launch
+path + controller_api.py worker side).
+
+    python -m areal_tpu.controller.worker --config cfg.yaml \
+        [--port 0] [--coordinator HOST:PORT --nprocs N --pid I] \
+        [--port-file /path]
+
+The chosen port is printed on stdout (and written to --port-file) so the
+controller can discover workers started with port 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+
+def serve(engine, host: str = "0.0.0.0", port: int = 0,
+          port_file: str | None = None) -> int:
+    from areal_tpu.scheduler.rpc import EngineRPCServer
+
+    server = EngineRPCServer(engine)
+    actual = server.start_threaded(host, port)
+    print(f"AREAL_WORKER_PORT={actual}", flush=True)
+    if port_file:
+        with open(port_file, "w") as f:
+            f.write(str(actual))
+    return actual
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--nprocs", type=int, default=1)
+    p.add_argument("--pid", type=int, default=0)
+    args, overrides = p.parse_known_args(argv)
+
+    from areal_tpu.parallel import distributed
+
+    if args.coordinator:
+        distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.nprocs,
+            process_id=args.pid,
+        )
+    else:
+        distributed.initialize()
+
+    from areal_tpu.api.alloc_mode import AllocationMode
+    from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+
+    cfg, _ = load_expr_config(["--config", args.config, *overrides], GRPOConfig)
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    actor = TPUPPOActor(cfg.actor)
+    actor.create_process_group(alloc.train)
+    actor.initialize(
+        None,
+        FinetuneSpec(
+            total_train_epochs=cfg.total_train_epochs,
+            dataset_size=cfg.train_dataset.batch_size,  # controller feeds data
+            train_batch_size=cfg.train_dataset.batch_size,
+        ),
+    )
+    serve(actor, args.host, args.port, args.port_file)
+    threading.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

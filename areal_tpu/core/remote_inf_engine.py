@@ -396,6 +396,50 @@ class RemoteInfEngine(InferenceEngine):
         self.set_version(next_version)
         return latency
 
+    def update_lora_weights(
+        self, named: dict, scale: float, next_version: int
+    ) -> float:
+        """Adapter-only weight sync: one safetensors payload of LoRA leaves
+        to every server's /update_lora_weights (reference adapter hot-swap,
+        areal/engine/sglang_remote.py:82-106). Ships rank-r factors —
+        megabytes — instead of the gigabyte full-parameter stream, which is
+        the operational point of LoRA in async RL."""
+        from safetensors.numpy import save as st_save
+
+        from areal_tpu.utils import stats_tracker
+
+        t0 = time.monotonic()
+        blob = st_save({k: np.ascontiguousarray(v) for k, v in named.items()})
+
+        async def _push_all():
+            session = aiohttp.ClientSession()
+            try:
+                await asyncio.gather(
+                    *[
+                        arequest_with_retry(
+                            session,
+                            f"http://{a}/update_lora_weights"
+                            f"?version={next_version}&scale={scale}",
+                            data=blob,
+                            max_retries=self.config.request_retries,
+                            timeout=self.config.request_timeout,
+                        )
+                        for a in self.addresses
+                    ]
+                )
+            finally:
+                await session.close()
+
+        asyncio.run(_push_all())
+        latency = time.monotonic() - t0
+        stats_tracker.DEFAULT_TRACKER.scalar(update_lora_http_latency=latency)
+        logger.info(
+            "lora adapter update v%d (%.1f MB) -> %d servers in %.2fs",
+            next_version, len(blob) / 1e6, len(self.addresses), latency,
+        )
+        self.set_version(next_version)
+        return latency
+
     def pause(self):
         """Pause servers + the local rollout runtime (weight-update fence)."""
         if self._spectator:

@@ -110,6 +110,12 @@ class LocalInfEngine(InferenceEngine):
     def update_weights_from_arrays(self, params, version: int | None = None):
         self.engine.update_weights_from_arrays(params, version)
 
+    def update_lora_weights(
+        self, named: dict, scale: float, next_version: int
+    ):
+        """Colocated adapter-only sync (same surface as RemoteInfEngine)."""
+        self.engine.update_lora_from_named_arrays(named, scale, next_version)
+
     def get_version(self) -> int:
         return self.engine.get_version()
 

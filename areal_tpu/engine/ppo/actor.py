@@ -293,6 +293,11 @@ class TPUPPOActor(TPUTrainEngine):
     def ppo_update(self, *args, **kwargs):
         return self.actor.ppo_update(*args, **kwargs)
 
+    # RPC-friendly variant (controller mode, scheduler/rpc.py whitelists
+    # this — a raw ndarray return doesn't survive the wire)
+    def compute_logp_named(self, data) -> dict:
+        return {"logp": np.asarray(self.compute_logp(data))}
+
 
 def _count_seqs_with_loss(x) -> float:
     """Number of sequences with >=1 valid loss token, for packed ([T] +

@@ -1369,6 +1369,32 @@ class TPUTrainEngine(TrainEngine):
                     pass
             else:
                 target.update_weights_from_tensors(chunks, next_version)
+        elif meta.type == "lora":
+            # adapter-native sync: ship ONLY the rank-r factors (megabytes)
+            # and let the serving side merge against its retained base —
+            # the reference's SGLang adapter hot-swap
+            # (areal/engine/sglang_remote.py:82-106)
+            lora_cfg = self.config.lora
+            assert lora_cfg is not None, (
+                "weight_update type 'lora' needs a LoRA-configured engine"
+            )
+            target = self._rollout_engine
+            assert target is not None and hasattr(
+                target, "update_lora_weights"
+            ), "lora weight updates need an engine with update_lora_weights"
+            named: dict[str, np.ndarray] = {}
+            for k in sorted(self.lora_params["layers"].keys()):
+                leaf = self.lora_params["layers"][k]
+                if distributed.process_count() > 1:
+                    named[f"layers.{k}"] = distributed.gather_host_values(leaf)
+                else:
+                    named[f"layers.{k}"] = np.asarray(jax.device_get(leaf))
+            if distributed.process_count() > 1 and not distributed.is_main():
+                pass  # joined the gathers above; host 0 pushes
+            else:
+                target.update_lora_weights(
+                    named, lora_cfg.alpha / lora_cfg.rank, next_version
+                )
         else:
             self.upload_weights(meta)
             if self._rollout_engine is not None:

@@ -178,6 +178,9 @@ class GenerationEngine:
         self._thread: threading.Thread | None = None
         self._abort_rids: set[str] = set()
         self._staging_params = None  # in-flight chunked tensor update
+        # adapter-native serving: pristine base params retained across
+        # adapter-only updates (None until the first /update_lora_weights)
+        self._lora_base = None
         # KV retention across abort-resume (VERDICT r1 weak #4): rid ->
         # (slot, tokens covered by the slot's cache, next feed token, ts).
         # The client's interrupt loop re-issues prompt+accumulated; a match
@@ -205,6 +208,12 @@ class GenerationEngine:
         self.prefill_count = 0  # prompts prefilled (zero-re-prefill tests)
         self.prefill_dispatch_count = 0  # device dispatches (batching tests)
         self.prefix_clone_count = 0
+        # cross-request partial prefix sharing (the general radix-reuse
+        # case: different requests with a common system/few-shot prefix):
+        # number of admissions served by copy-shared-rows + suffix-extend,
+        # and how many prompt tokens skipped prefill that way
+        self.prefix_extend_count = 0
+        self.prefix_extend_saved_tokens = 0
         # served-token counters (the reference gserver_manager's per-server
         # token-usage tracking role, realhf/system/gserver_manager.py):
         # prompt_tokens_total counts every ADMITTED request's prompt
@@ -228,6 +237,7 @@ class GenerationEngine:
             static_argnames=("steps",),
         )
         self._jit_copy_kv = jax.jit(self._copy_kv_impl, donate_argnums=(0,))
+        self._jit_extend = jax.jit(self._extend_impl, donate_argnums=(1,))
 
     @staticmethod
     def _copy_kv_impl(cache, src, dst, n):
@@ -290,6 +300,41 @@ class GenerationEngine:
             k_cache = write(k_cache, ks, i)
             v_cache = write(v_cache, vs, i)
         return toks, logps, {"k": k_cache, "v": v_cache}
+
+    def _extend_impl(self, params, cache, ids, start_len, slot):
+        """Suffix prefill for ONE slot: run ``ids`` [1, Tq] through the
+        model against the slot's existing ``start_len`` cache rows (the
+        shared prefix) and write their K/V at positions
+        [start_len, start_len+Tq). Logits are discarded — the caller leaves
+        the final prompt token for the decode feed, same as the clone path.
+
+        Tq is a padded bucket; pad tokens write garbage rows beyond the true
+        suffix, which is safe: each such position is overwritten by its real
+        token (one decode write per position) strictly before any query can
+        attend it (decode masks kpos <= qpos and positions fill in order).
+
+        The slot's rows are sliced out so the dispatch costs O(Tq · model),
+        not O(B · Tq · model), and other slots' caches are untouched."""
+
+        def getslot(x):
+            return jax.lax.dynamic_slice(
+                x, (0, slot, 0, 0, 0), (x.shape[0], 1) + x.shape[2:]
+            )
+
+        sub = {"k": getslot(cache["k"]), "v": getslot(cache["v"])}
+        _, sub = decode_step(
+            params, self.model_config, sub, ids,
+            jnp.reshape(start_len, (1,)).astype(jnp.int32),
+            attn_spec=self.attn_spec,
+            compute_logits=False,
+        )
+
+        def put(x, s):
+            return jax.lax.dynamic_update_slice(
+                x, s.astype(x.dtype), (0, slot, 0, 0, 0)
+            )
+
+        return {"k": put(cache["k"], sub["k"]), "v": put(cache["v"], sub["v"])}
 
     def _decode_impl(
         self,
@@ -491,6 +536,24 @@ class GenerationEngine:
         if err is not None:
             raise err
 
+    def update_lora_from_named_arrays(
+        self, named: dict, scale: float, version: int | None = None
+    ):
+        """Adapter-only weight update (reference: SGLang adapter hot-swap,
+        areal/engine/sglang_remote.py:82-106). ``named`` holds dotted-path
+        adapter leaves (``layers.wq_a`` [L, in, r] / ``layers.wq_b``
+        [L, r, out] pairs — models/lora.py layout); the engine retains the
+        pristine base params on first use and serves ``W + scale * A@B`` on
+        every adapted leaf. A LoRA sync therefore ships megabytes (rank-r
+        factors) instead of the full parameter set, which is the main
+        operational reason to train LoRA in async RL."""
+        done: queue.Queue = queue.Queue()
+        self._cmd_queue.put(("update_lora", named, scale, version, done))
+        self._wake.set()
+        err = done.get(timeout=600.0)
+        if err is not None:
+            raise err
+
     def update_weights_from_arrays(self, params, version: int | None = None):
         """Colocated device-to-device weight refresh: re-place live jax
         arrays (e.g. the train engine's params) onto this engine's shardings
@@ -582,6 +645,7 @@ class GenerationEngine:
                         )
                         self.params = self._staging_params
                         self._staging_params = None
+                        self._lora_base = None  # base changed; re-snapshot
                         self.version = version
                         logger.info(
                             "weights updated (tensor) -> v%d (+%.2fs final chunk)",
@@ -593,10 +657,63 @@ class GenerationEngine:
                     logger.exception("named weight update failed")
                     self._staging_params = None  # abandon the partial set
                     done.put(e)
+            elif cmd[0] == "update_lora":
+                _, named, scale, version, done = cmd
+                try:
+                    t0 = time.monotonic()
+                    if self._lora_base is None:
+                        # first adapter update: current params become the
+                        # retained base (leaves shared, not copied — merges
+                        # REPLACE leaves, never mutate them)
+                        self._lora_base = jax.tree.map(lambda x: x, self.params)
+                    base_layers = self._lora_base["layers"]
+                    new_layers = dict(base_layers)
+                    leaves = sorted(
+                        n.split(".")[1][:-2]
+                        for n in named
+                        if n.startswith("layers.") and n.endswith("_a")
+                    )
+                    if not leaves:
+                        raise ValueError(
+                            f"no adapter leaf pairs in payload: {sorted(named)}"
+                        )
+                    for leaf in leaves:
+                        a = jnp.asarray(named[f"layers.{leaf}_a"], jnp.float32)
+                        b = jnp.asarray(named[f"layers.{leaf}_b"], jnp.float32)
+                        w = base_layers[leaf]
+                        if a.shape[1] != w.shape[1] or b.shape[2] != w.shape[2]:
+                            raise ValueError(
+                                f"adapter/base shape mismatch on {leaf}: "
+                                f"{a.shape}x{b.shape} vs {w.shape}"
+                            )
+                        delta = jnp.einsum("lir,lro->lio", a, b) * scale
+                        merged = (w.astype(jnp.float32) + delta).astype(w.dtype)
+                        new_layers[leaf] = jax.device_put(merged, w.sharding)
+                    new_params = dict(self._lora_base)
+                    new_params["layers"] = new_layers
+                    jax.block_until_ready(
+                        [new_layers[leaf] for leaf in leaves]
+                    )
+                    self.params = new_params
+                    if version is not None:
+                        self.version = version
+                    else:
+                        self.version += 1
+                    logger.info(
+                        "weights updated (lora adapters %s) -> v%d in %.2fs",
+                        ",".join(leaves), self.version, time.monotonic() - t0,
+                    )
+                    done.put(None)
+                except Exception as e:
+                    logger.exception("lora weight update failed")
+                    done.put(e)
             elif cmd[0] in ("update_weights", "update_weights_arrays"):
                 _, src, version, done = cmd
                 try:
                     t0 = time.monotonic()
+                    # a full-weight refresh changes the base: a later
+                    # adapter-only update must re-snapshot
+                    self._lora_base = None
                     if cmd[0] == "update_weights":
                         self.params = self._load_params_from(src)
                     else:
@@ -773,42 +890,84 @@ class GenerationEngine:
         return True
 
     def _try_clone(self, seq: _Seq, dst: int) -> bool:
-        """Prompt-prefix KV reuse: if some slot already caches this exact
-        prompt minus its final token, copy those rows into ``dst`` and skip
-        prefill — the request enters decode feeding the final prompt token,
-        which produces the first-output-token logits exactly as a fresh
-        prefill would. The group-sampling fast path (n_samples identical
-        prompts -> one prefill + n-1 row copies)."""
+        """Prompt-prefix KV reuse, full and partial.
+
+        Full: some slot already caches this exact prompt minus its final
+        token — copy those rows into ``dst`` and skip prefill entirely; the
+        request enters decode feeding the final prompt token, which produces
+        the first-output-token logits exactly as a fresh prefill would. The
+        group-sampling fast path (n_samples identical prompts -> one
+        prefill + n-1 row copies).
+
+        Partial (cross-request sharing, the SGLang-radix role the reference
+        relies on): a different request whose prompt shares >=
+        ``prefix_extend_min`` leading tokens (identical system/few-shot
+        prefix) copies the shared rows and runs ONE suffix-extension
+        dispatch (``_extend_impl``) over only the unshared tail — the
+        shared 1k-token prefix prefills once for the whole batch."""
         if not self.config.enable_prefix_reuse or seq.images:
             return False
         n = len(seq.prompt)
         if n < 2:
             return False
         prefix = list(seq.prompt[: n - 1])
-        src = None
+        prompt_arr = np.asarray(prefix)  # one conversion, sliced per slot
+        src, best = None, 0
         for i, cov in enumerate(self._slot_covered):
-            if len(cov) < n - 1:
-                continue
             if self._slot_kv_version[i] != self.version:
                 continue  # rows predate the current weights (or hold pixels)
-            if cov[: n - 1] == prefix:
-                src = i
+            if cov[: n - 1] == prefix:  # full match
+                src, best = i, n - 1
                 if i == dst:  # in-place reuse of dst's own rows: no copy
                     break
-        if src is None:
+            elif src is None or best < n - 1:
+                # longest common prefix with this slot's covered tokens
+                # (vectorized — a per-token Python loop over every slot
+                # would stall the engine loop on long prompts)
+                m = min(len(cov), n - 1)
+                if m > best:
+                    diff = np.flatnonzero(np.asarray(cov[:m]) != prompt_arr[:m])
+                    sh = int(diff[0]) if diff.size else m
+                    if sh > best:
+                        src, best = i, sh
+        if src is None or best == 0:
             return False
-        self.prefix_clone_count += 1
+        if best < n - 1:
+            if best < self.config.prefix_extend_min:
+                return False  # too little sharing to beat a batched prefill
+            # the padded suffix write must fit the cache: dynamic_update_slice
+            # CLAMPS an out-of-bounds start, which would shift the write back
+            # over the shared-prefix rows and corrupt them
+            if best + self._bucket(n - 1 - best) > self.config.max_seq_len:
+                return False
         self.prompt_tokens_total += len(seq.prompt)
         if src != dst:
             self.cache = self._jit_copy_kv(
-                self.cache, jnp.int32(src), jnp.int32(dst), jnp.int32(n - 1)
+                self.cache, jnp.int32(src), jnp.int32(dst), jnp.int32(best)
             )
+        if best == n - 1:
+            self.prefix_clone_count += 1
+            self._slot_kv_version[dst] = self._slot_kv_version[src]
+        else:
+            # suffix extension over prompt[best : n-1] (bucket-padded; pad
+            # rows are overwritten before they're ever attended — see
+            # _extend_impl)
+            suffix = seq.prompt[best : n - 1]
+            bucket = self._bucket(len(suffix))
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, : len(suffix)] = suffix
+            self.cache = self._jit_extend(
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.int32(best), jnp.int32(dst),
+            )
+            self.prefix_extend_count += 1
+            self.prefix_extend_saved_tokens += best
+            self._slot_kv_version[dst] = self.version
         seq.slot = dst
         self.slots[dst] = seq
         self.cache_len[dst] = n - 1
         self.last_token[dst] = seq.prompt[-1]
         self._slot_covered[dst] = list(prefix)
-        self._slot_kv_version[dst] = self._slot_kv_version[src]
         return True
 
     def _prefill_seq(self, seq: _Seq, slot: int):

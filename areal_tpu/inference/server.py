@@ -79,6 +79,7 @@ class GenerationServer:
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
                 web.post("/update_weights_from_tensor", self.update_weights_from_tensor),
+                web.post("/update_lora_weights", self.update_lora_weights),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -104,6 +105,8 @@ class GenerationServer:
                 "prefill_count": e.prefill_count,
                 "prefill_dispatch_count": e.prefill_dispatch_count,
                 "prefix_clone_count": e.prefix_clone_count,
+                "prefix_extend_count": e.prefix_extend_count,
+                "prefix_extend_saved_tokens": e.prefix_extend_saved_tokens,
             }
         )
 
@@ -169,6 +172,35 @@ class GenerationServer:
             )
         except Exception as e:
             logger.exception("update_weights_from_tensor failed")
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.engine.get_version()}
+        )
+
+    async def update_lora_weights(self, request: web.Request) -> web.Response:
+        """Adapter-only update (reference: live SGLang adapter load,
+        areal/engine/sglang_remote.py:82-106): body is one safetensors chunk
+        of adapter leaves (``layers.wq_a``/``layers.wq_b`` ...); query
+        ``scale`` = alpha/rank, ``version`` bumps the served version. Ships
+        megabytes instead of the full parameter set."""
+        from safetensors.numpy import load as st_load
+
+        body = await request.read()
+        scale = float(request.query.get("scale", "1.0"))
+        version = request.query.get("version")
+        try:
+            arrs = st_load(body)
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                self.engine.update_lora_from_named_arrays,
+                arrs,
+                scale,
+                int(version) if version is not None else None,
+            )
+        except Exception as e:
+            logger.exception("update_lora_weights failed")
             return web.json_response(
                 {"success": False, "message": str(e)}, status=500
             )

@@ -247,9 +247,16 @@ def _moe_mlp(
     if cfg.moe_impl == "gshard_ep":
         from areal_tpu.ops.moe import moe_mlp_gshard
 
-        mesh = attn_spec.mesh if attn_spec is not None else None
+        # inside a pipeline stage (attn_spec.nested_manual) the GShard
+        # with_sharding_constraint dispatch cannot run — fall back to the
+        # local capacity formulation (g=1), same as before nested attention
+        # support landed
+        nested = attn_spec is not None and attn_spec.nested_manual
+        mesh = attn_spec.mesh if attn_spec is not None and not nested else None
         token_axes = (
-            attn_spec.token_axes if attn_spec is not None else ("dp", "cp")
+            attn_spec.token_axes
+            if attn_spec is not None and not nested
+            else ("dp", "cp")
         )
         return moe_mlp_gshard(
             x,
@@ -567,7 +574,8 @@ def decode_step(
     input_ids: jnp.ndarray,  # [B, Tq]
     cache_len: jnp.ndarray,  # [B] valid tokens per slot BEFORE this call
     attn_spec: AttnSpec | None = None,
-) -> tuple[jnp.ndarray, Params]:
+    compute_logits: bool = True,
+) -> tuple[jnp.ndarray | None, Params]:
     """Run Tq tokens per slot against the cache.
 
     Positions of the new tokens are cache_len + [0..Tq). Returns
@@ -613,6 +621,10 @@ def decode_step(
     (x,), (new_k, new_v) = jax.lax.scan(
         body, (x,), (params["layers"], cache["k"], cache["v"])
     )
+    if not compute_logits:
+        # cache-building pass (prefix-extension): the [B, Tq, V] fp32 head
+        # matmul is the dominant cost and its output would be discarded
+        return None, {"k": new_k, "v": new_v}
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     head = params.get("lm_head")
     if head is None:

@@ -54,6 +54,12 @@ class AttnSpec:
     token_axes: tuple[str, ...] = ()
     head_axis: str | None = None
     block: int = DEFAULT_BLOCK
+    # mesh axes ALREADY manualized by an enclosing shard_map (the pp axis
+    # inside a pipeline stage — parallel/pipeline.py). The ring/ulysses
+    # shard_maps then nest: they manualize only their own axes and use the
+    # context abstract mesh, keeping the Pallas kernel live under pp x tp
+    # instead of degrading to O(T^2) einsum attention.
+    nested_manual: frozenset = frozenset()
 
     def __post_init__(self):
         assert self.impl in (
@@ -161,6 +167,7 @@ def packed_attention(
                 chunk_impl=spec.resolve_impl(q.shape[0]),
                 block=spec.block,
                 window=window,
+                nested_manual=spec.nested_manual,
             )
         from areal_tpu.ops.ring_attention import ring_attention_sharded
 
@@ -175,6 +182,7 @@ def packed_attention(
             head_axis=spec.head_axis,
             block=spec.block,
             window=window,
+            nested_manual=spec.nested_manual,
         )
     impl = spec.resolve_impl(q.shape[0])
     if impl in ("pallas", "pallas_interpret"):

@@ -162,6 +162,7 @@ def ring_attention_sharded(
     head_axis: str | None = None,
     block: int = 128,
     window: int = 0,
+    nested_manual: frozenset = frozenset(),
 ) -> jnp.ndarray:
     """shard_map wrapper: tokens sharded over ``token_axes``, heads over
     ``head_axis`` (TP), K/V ring over ``ring_axis`` (default: ALL token
@@ -173,6 +174,14 @@ def ring_attention_sharded(
     is the only thing isolating sequences, same as the unsharded path. A
     narrower ring (e.g. just "cp") is valid only when the packing guarantees
     no sequence straddles the excluded axes.
+
+    ``nested_manual``: axes already manualized by an enclosing shard_map
+    (pp, inside a pipeline stage — parallel/pipeline.py). The wrapper then
+    manualizes only its own axes on the context abstract mesh — legal
+    shard_map nesting — so the Pallas chunk kernel stays live under pp x tp
+    / pp x cp layouts. Each shard's global q offset rides a sharded iota
+    input rather than ``axis_index`` (whose lowering binds every manual
+    axis, which Shardy rejects inside a nested manual computation).
     """
     token_axes = tuple(token_axes)
     if ring_axis is None:
@@ -188,15 +197,13 @@ def ring_attention_sharded(
     tl = q.shape[0] // max(n_tok, 1)
 
     tok = token_axes if token_axes else None
+    # per-shard global q offset as data: shard i of this [n_tok] iota sees
+    # its own scalar (works both top-level and nested, unlike axis_index)
+    starts = jnp.arange(max(n_tok, 1), dtype=jnp.int32) * tl
 
-    def fn(q_l, k_l, v_l, seg_l):
-        if token_axes:
-            idx = jax.lax.axis_index(token_axes)
-        else:
-            idx = jnp.int32(0)
-        q_start = (idx * tl).astype(jnp.int32)
+    def fn(q_l, k_l, v_l, seg_l, st_l):
         return ring_attention_local(
-            q_l, k_l, v_l, seg_l, q_start,
+            q_l, k_l, v_l, seg_l, st_l[0],
             axis_name=axes if len(axes) != 1 else axes[0],
             ring_size=ring_size,
             softmax_scale=softmax_scale,
@@ -207,10 +214,19 @@ def ring_attention_sharded(
 
     spec3 = P(tok, head_axis, None)
     spec1 = P(tok)
+    extra = {}
+    use_mesh = mesh
+    if nested_manual:
+        own = set(token_axes) | set(axes)
+        if head_axis is not None:
+            own.add(head_axis)
+        extra["axis_names"] = frozenset(own)
+        use_mesh = jax.sharding.get_abstract_mesh()
     return jax.shard_map(
         fn,
-        mesh=mesh,
-        in_specs=(spec3, spec3, spec3, spec1),
+        mesh=use_mesh,
+        in_specs=(spec3, spec3, spec3, spec1, spec1),
         out_specs=spec3,
         check_vma=False,
-    )(q, k, v, segment_ids)
+        **extra,
+    )(q, k, v, segment_ids, starts)

@@ -54,6 +54,7 @@ def ulysses_attention_sharded(
     chunk_impl: str = "xla",
     block: int = 128,
     window: int = 0,
+    nested_manual: frozenset = frozenset(),
 ) -> jnp.ndarray:
     """Tokens sharded over ``token_axes`` outside; heads sharded inside.
 
@@ -61,6 +62,9 @@ def ulysses_attention_sharded(
     all_to_all #2: the reverse. Segment ids all-gather (tiny).
     ``window`` is exact here: the local compute sees the FULL gathered
     sequence, so windowing is the same as the unsharded path.
+    ``nested_manual``: axes an enclosing shard_map already manualizes (pp in
+    a pipeline stage); the wrapper then nests, manualizing only its own
+    token axes on the context abstract mesh.
     """
     token_axes = tuple(token_axes)
     n = 1
@@ -101,10 +105,16 @@ def ulysses_attention_sharded(
 
     spec3 = P(token_axes, None, None)
     spec1 = P(token_axes)
+    extra = {}
+    use_mesh = mesh
+    if nested_manual:
+        extra["axis_names"] = frozenset(token_axes)
+        use_mesh = jax.sharding.get_abstract_mesh()
     return jax.shard_map(
         fn,
-        mesh=mesh,
+        mesh=use_mesh,
         in_specs=(spec3, spec3, spec3, spec1),
         out_specs=spec3,
         check_vma=False,
+        **extra,
     )(q, k, v, segment_ids)

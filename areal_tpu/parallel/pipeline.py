@@ -64,13 +64,19 @@ def stage_attn_spec(spec: AttnSpec | None, mesh: Mesh | None = None) -> AttnSpec
     """Attention dispatch used INSIDE a pipeline stage.
 
     The stage body runs under a shard_map that is manual over pp and auto
-    over dp/cp/tp, so the ring/ulysses wrappers (their own shard_maps over
-    the token axes) cannot be re-entered here; attention runs locally and
-    GSPMD shards the einsum over tp heads / dp tokens like any other op.
-    The Pallas kernel has no GSPMD partitioning rule, so it only survives
-    when nothing inside the stage needs partitioning — i.e. the non-pp mesh
-    extent is 1 (pure pipeline parallelism).
+    over dp/cp/tp. When dp/cp/tp have extent > 1, the engine-level sharded
+    dispatch (ring over token axes, heads over tp) is kept and marked
+    ``nested_manual={pp}``: the ring/ulysses wrappers then NEST their
+    shard_map (manualizing only their own axes on the context abstract
+    mesh), so the Pallas flash kernel stays live inside pipeline stages
+    under pp x tp / pp x dp / pp x cp layouts instead of degrading to
+    O(T^2) einsum attention.
+
+    Only a spec that was already ``impl="xla"`` (e.g. non-dividing heads
+    under tp — AttnSpec.for_mesh) stays on the einsum path, loudly.
     """
+    import dataclasses
+
     if spec is None:
         return None
     inner = 1
@@ -79,10 +85,20 @@ def stage_attn_spec(spec: AttnSpec | None, mesh: Mesh | None = None) -> AttnSpec
             inner *= int(mesh.shape.get(a, 1))
     impl = spec.impl
     if inner == 1 and impl in ("auto", "pallas", "pallas_interpret"):
+        # pure pipeline parallelism: plain local dispatch inside the stage
         return AttnSpec(impl=impl, mesh=None, block=spec.block)
-    if spec.is_sharded or impl in ("auto", "ulysses"):
-        impl = "xla"
-    return AttnSpec(impl=impl, mesh=None, block=spec.block)
+    if inner > 1 and spec.is_sharded and impl != "xla":
+        return dataclasses.replace(spec, nested_manual=frozenset({AXIS_PP}))
+    if impl != "xla" and inner > 1:
+        from areal_tpu.utils import logging
+
+        logging.getLogger("pipeline").warning(
+            "attention inside pipeline stages falls back to O(T^2) einsum "
+            "(impl=%s, spec not sharded over dp/cp/tp: %s) — check "
+            "AttnSpec.for_mesh head divisibility",
+            impl, spec,
+        )
+    return AttnSpec(impl="xla" if inner > 1 else impl, mesh=None, block=spec.block)
 
 
 def pipeline_hidden(

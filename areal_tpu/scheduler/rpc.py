@@ -38,8 +38,35 @@ _ALLOWED = (
     "save",
     "load",
     "update_weights",
+    "upload_weights",
     "step_lr_scheduler",
+    # PPO-actor surface (controller mode, controller/train_controller.py;
+    # the advantage pipeline runs controller-locally — global adv norm)
+    "compute_logp_named",
+    "ppo_update",
 )
+
+# methods whose single argument is a dataclass meta, reconstructed from the
+# JSON kwargs dict under "meta" (dataclasses don't survive JSON headers)
+_META_TYPES = {
+    "save": "SaveLoadMeta",
+    "load": "SaveLoadMeta",
+    "update_weights": "WeightUpdateMeta",
+    "upload_weights": "WeightUpdateMeta",
+}
+
+
+def _sanitize(obj):
+    """np scalars/arrays -> JSON-safe python values (stats dicts)."""
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return obj
 
 
 def _pack(data: dict[str, Any]) -> bytes:
@@ -84,6 +111,11 @@ class EngineRPCServer:
             return web.json_response(
                 {"error": f"engine has no method {method}"}, status=400
             )
+        if method in _META_TYPES and "meta" in kwargs:
+            from areal_tpu.api import io_struct
+
+            meta_cls = getattr(io_struct, _META_TYPES[method])
+            kwargs["meta"] = meta_cls(**kwargs["meta"])
         loop = asyncio.get_running_loop()
         try:
             if tensors:
@@ -102,7 +134,7 @@ class EngineRPCServer:
                 body=_pack(result),
                 content_type="application/octet-stream",
             )
-        return web.json_response({"result": result})
+        return web.json_response({"result": _sanitize(result)})
 
     def start_threaded(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Run the server on its own event-loop thread; returns the port."""

@@ -1,0 +1,195 @@
+"""Async-RL end-to-end step benchmark (bench.py --grpo-child).
+
+Measures the reference's actual headline quantity — wall time of one full
+GRPO iteration (rollout + recompute-logp + advantages + PPO update + weight
+push), not SFT throughput (reference `time_perf/e2e`, SURVEY §6 async-RL
+speedup table benchmark/verl_v0_3_0_post1_76084d3/README.md).
+
+Single-chip colocated layout: the GenerationEngine shares the chip with the
+train engine (LocalInfEngine), weight push is an HBM-local array
+re-placement. Two phases:
+
+1. one SYNC step (rollout_batch -> train) with per-phase timers — the
+   un-overlapped cost;
+2. ``steps`` ASYNC steps (prepare_batch keeps >=2 batches in flight while
+   the trainer runs — core/workflow_executor.py) — the steady-state step
+   time. overlap_fraction = 1 - async_step/sync_step.
+
+The model is the Qwen2-1.5B shape at reduced depth (two full param copies +
+optimizer state + KV cache must share one 16GB chip; the depth used is
+recorded in the output record).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _reward(prompt, completion, prompt_ids, completion_ids, **kwargs) -> float:
+    # deterministic, tokenizer-free stand-in for math_verify_reward: the
+    # bench measures the loop, not verifier quality
+    return float(sum(completion_ids) % 2)
+
+
+def grpo_step_bench(
+    layers: int = 14,
+    n_prompts: int = 8,
+    group_size: int = 4,
+    prompt_len: int = 128,
+    new_tokens: int = 128,
+    steps: int = 2,
+    smoke: bool = False,
+):
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec, WeightUpdateMeta
+    from areal_tpu.engine.local_inf import LocalInfEngine
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+    from areal_tpu.utils.dataloader import StatefulDataLoader
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+    from bench import qwen2_1p5b_cfg
+
+    if smoke:  # CPU-sized config for the unit test of this bench harness
+        from areal_tpu.models.config import tiny_config
+
+        model_cfg = tiny_config(
+            vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        )
+    else:
+        model_cfg = qwen2_1p5b_cfg(layers)
+
+    acfg = PPOActorConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-5, type="adafactor"),
+        group_size=group_size,
+        ppo_n_minibatches=1,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+    )
+    if smoke:
+        acfg.backend.param_dtype = "float32"
+        acfg.backend.pad_mb_to_multiple = 32
+    else:
+        acfg.backend.remat = True
+        acfg.backend.pad_mb_to_multiple = 512
+        acfg.backend.loss_chunk_size = 1024
+        acfg.backend.optimizer_dtype = "bfloat16"
+        acfg.backend.grad_acc_dtype = "bfloat16"
+
+    ft_spec = FinetuneSpec(
+        total_train_epochs=1,
+        dataset_size=n_prompts * (steps + 2),
+        train_batch_size=n_prompts,
+    )
+    actor = TPUPPOActor(acfg)
+    actor.initialize(None, ft_spec, model_config=model_cfg, seed=0)
+
+    inf = LocalInfEngine(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=n_prompts * 2,
+            consumer_batch_size=n_prompts,
+        ),
+        JaxGenConfig(
+            max_batch_size=max(n_prompts * group_size, 8),
+            max_seq_len=prompt_len + new_tokens + 64,
+            prefill_chunk=64 if smoke else 128,
+            decode_steps_per_call=4 if smoke else 32,
+            dtype="float32" if smoke else "bfloat16",
+        ),
+        model_config=model_cfg,
+    )
+    inf.initialize(None, train_data_parallel_size=1)
+    actor.connect_engine(inf, WeightUpdateMeta.from_device())
+
+    gconfig = GenerationHyperparameters(
+        n_samples=group_size,
+        max_new_tokens=new_tokens,
+        min_new_tokens=new_tokens,
+        temperature=1.0,
+    )
+    workflow = RLVRWorkflow(_reward, gconfig, tokenizer=None,
+                            in_process_reward=True)
+
+    rng = np.random.default_rng(0)
+    hi = model_cfg.vocab_size - 1
+    rows = [
+        {"input_ids": rng.integers(1, hi, size=prompt_len).tolist()}
+        for _ in range(n_prompts * (steps + 2))
+    ]
+    dataloader = StatefulDataLoader(rows, n_prompts, shuffle=False)
+
+    try:
+        # initial weight push: serve trainer weights from step 0 (also
+        # compiles the push path outside the timed window)
+        inf.pause()
+        actor.update_weights()
+        inf.resume()
+
+        def train_half(batch, timings):
+            t = time.perf_counter()
+            batch["prox_logp"] = actor.compute_logp(batch)
+            timings["logp_s"] = time.perf_counter() - t
+            t = time.perf_counter()
+            actor.compute_advantages(batch)
+            timings["adv_s"] = time.perf_counter() - t
+            t = time.perf_counter()
+            stats = actor.ppo_update(batch)
+            timings["train_s"] = time.perf_counter() - t
+            t = time.perf_counter()
+            inf.pause()
+            actor.update_weights()
+            inf.resume()
+            timings["push_s"] = time.perf_counter() - t
+            assert stats, "ppo_update returned no stats"
+
+        # ---- sync step (compile + un-overlapped reference point) ----
+        sync: dict = {}
+        t0 = time.perf_counter()
+        t = time.perf_counter()
+        batch = inf.rollout_batch(next(iter(dataloader)), workflow=workflow)
+        sync["rollout_s"] = time.perf_counter() - t
+        train_half(batch, sync)
+        # first step pays compilation; run a second sync step for the
+        # honest un-overlapped number
+        sync_warm: dict = {}
+        t0 = time.perf_counter()
+        t = time.perf_counter()
+        batch = inf.rollout_batch(next(iter(dataloader)), workflow=workflow)
+        sync_warm["rollout_s"] = time.perf_counter() - t
+        train_half(batch, sync_warm)
+        sync_step = time.perf_counter() - t0
+
+        # ---- async steps (prepare_batch keeps rollouts in flight) ----
+        async_times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            batch = inf.prepare_batch(dataloader, workflow=workflow)
+            timings: dict = {}
+            train_half(batch, timings)
+            async_times.append(time.perf_counter() - t0)
+        async_step = float(np.mean(async_times))
+
+        tokens_per_step = n_prompts * group_size * (prompt_len + new_tokens)
+        return {
+            "step_sec": round(async_step, 2),
+            "sync_step_sec": round(sync_step, 2),
+            "overlap_fraction": round(max(0.0, 1.0 - async_step / sync_step), 3),
+            "layers": layers,
+            "n_prompts": n_prompts,
+            "group_size": group_size,
+            "new_tokens": new_tokens,
+            "tokens_per_step": tokens_per_step,
+            "phase_breakdown": {k: round(v, 2) for k, v in sync_warm.items()},
+        }
+    finally:
+        inf.destroy()
+        actor.destroy()

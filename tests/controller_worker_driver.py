@@ -1,0 +1,77 @@
+"""Per-process worker for the controller-mode e2e test.
+
+Each process: one virtual CPU device, joins the jax.distributed dp=N mesh,
+hosts a tiny TPUPPOActor behind EngineRPCServer, writes its port to
+<outdir>/port<pid>, serves until the controller writes <outdir>/stop.
+
+Usage: python controller_worker_driver.py <coordinator> <nprocs> <pid> <outdir>
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    coordinator, nprocs, pid, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coordinator, num_processes=nprocs, process_id=pid
+    )
+
+    import numpy as np
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import OptimizerConfig, PPOActorConfig
+    from areal_tpu.controller.worker import serve
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+    from areal_tpu.models.config import tiny_config
+
+    cfg = PPOActorConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-3),
+        group_size=2,
+        ppo_n_minibatches=1,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 32
+    actor = TPUPPOActor(cfg)
+    actor.create_process_group(ParallelStrategy(dp=nprocs))
+    actor.initialize(None, None, model_config=tiny_config(), seed=7)
+
+    serve(actor, "127.0.0.1", 0, os.path.join(outdir, f"port{pid}"))
+
+    stop = os.path.join(outdir, "stop")
+    deadline = time.time() + 570
+    while not os.path.exists(stop) and time.time() < deadline:
+        time.sleep(0.2)
+
+    # post-run evidence for the test: params must be IDENTICAL across
+    # workers (the mesh's grad psum, not RPC, keeps them in sync)
+    np.save(
+        os.path.join(outdir, f"embed{pid}.npy"),
+        np.asarray(jax.device_get(actor.params["embed"])),
+    )
+    with open(os.path.join(outdir, f"done{pid}.json"), "w") as f:
+        json.dump({"version": actor.get_version()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""The bench harness itself must work: a broken bench.py costs an entire
+round's only TPU window (rounds 1 and 2 both lost their bench to harness +
+tunnel failures).
+
+Covers: the GRPO step bench end to end in smoke (tiny-model CPU) mode, and
+bench.py's subprocess probe plumbing (parse, timeout handling, partial
+records) without touching any real backend.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def test_grpo_step_bench_smoke():
+    from bench_grpo import grpo_step_bench
+
+    res = grpo_step_bench(
+        n_prompts=2, group_size=2, prompt_len=8, new_tokens=4, steps=1,
+        smoke=True,
+    )
+    assert res["step_sec"] > 0
+    assert res["sync_step_sec"] > 0
+    assert 0.0 <= res["overlap_fraction"] <= 1.0
+    assert set(res["phase_breakdown"]) == {
+        "rollout_s", "logp_s", "adv_s", "train_s", "push_s",
+    }
+
+
+def test_bench_probe_child_parses_on_cpu(tmp_path):
+    """--probe-child emits one parseable JSON line (CPU backend here)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--probe-child", "{}"],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo",
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["n"] >= 1
+    assert rec["t_init"] >= 0
+
+
+def test_bench_emit_writes_partial(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "PARTIAL_PATH", str(tmp_path / "p.jsonl"))
+    bench.emit({"metric": "x", "value": 1})
+    bench.emit({"metric": "y", "value": 2})
+    lines = (tmp_path / "p.jsonl").read_text().strip().splitlines()
+    assert [json.loads(ln)["metric"] for ln in lines] == ["x", "y"]
+
+
+def test_probe_backend_gives_up_within_budget(monkeypatch):
+    """A permanently wedged tunnel must exhaust the wall budget and raise
+    (driver then records the error line) — not hang."""
+    import bench
+
+    calls = []
+
+    def fake_run_child(kind, att, timeout):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    deadline = bench.time.time() + 0.05  # nearly-spent budget
+    with pytest.raises(RuntimeError, match="unavailable"):
+        bench.probe_backend(deadline)
+    assert len(calls) == 0  # budget below the 90s floor -> no attempt
+
+    # with budget, attempts run until the deadline passes
+    t = [0.0]
+    monkeypatch.setattr(bench.time, "time", lambda: t[0])
+    monkeypatch.setattr(bench, "_T0", 0.0)
+
+    def advancing_child(kind, att, timeout):
+        calls.append(timeout)
+        t[0] += 200.0
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(bench, "_run_child", advancing_child)
+    with pytest.raises(RuntimeError, match="wedged"):
+        bench.probe_backend(1000.0)
+    assert len(calls) >= 4

@@ -1,0 +1,126 @@
+"""Controller mode e2e (round-2 verdict item 5): a TrainController drives
+RPC-hosted engine workers through a full GRPO step — chunk_by_ffd scatter,
+concurrent collective entry, controller-local global advantage pipeline,
+version fencing (reference areal/api/controller_api.py:21-455 +
+controller/train_controller.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.utils.network import find_free_ports
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_rollout_batch(n_groups=4, group_size=2, seqlen=16, vocab=128, seed=0):
+    """What RLVRWorkflow would emit: padded trajectories with behavior
+    logprobs, versions, rewards."""
+    rng = np.random.default_rng(seed)
+    bs = n_groups * group_size
+    input_ids = rng.integers(1, vocab, size=(bs, seqlen)).astype(np.int64)
+    loss_mask = np.ones((bs, seqlen), np.int64)
+    loss_mask[:, :4] = 0  # 4-token "prompt"
+    return dict(
+        input_ids=input_ids,
+        attention_mask=np.ones((bs, seqlen), np.int64),
+        loss_mask=loss_mask,
+        logprobs=rng.normal(-1.0, 0.3, size=(bs, seqlen)).astype(np.float32),
+        versions=np.zeros((bs, seqlen), np.int64),
+        rewards=rng.choice([0.0, 1.0], size=bs).astype(np.float32),
+    )
+
+
+@pytest.mark.slow
+def test_controller_drives_grpo_step_over_two_workers(tmp_path):
+    nprocs = 2
+    coordinator = f"127.0.0.1:{find_free_ports(1)[0]}"
+    outdir = str(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "controller_worker_driver.py"),
+                coordinator, str(nprocs), str(pid), outdir,
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(nprocs)
+    ]
+    try:
+        # discover worker ports
+        ports = []
+        deadline = time.time() + 300
+        for pid in range(nprocs):
+            pf = os.path.join(outdir, f"port{pid}")
+            while not os.path.exists(pf):
+                for p in procs:
+                    assert p.poll() is None, p.communicate()[0][-4000:]
+                assert time.time() < deadline, "workers never came up"
+                time.sleep(0.2)
+            time.sleep(0.1)
+            ports.append(int(open(pf).read()))
+
+        from areal_tpu.api.cli_args import OptimizerConfig, PPOActorConfig
+        from areal_tpu.controller.batch import DistributedBatchMemory
+        from areal_tpu.controller.train_controller import TrainController
+        from areal_tpu.scheduler.rpc import EngineRPCClient
+
+        cfg = PPOActorConfig(
+            path="",
+            init_from_scratch=True,
+            optimizer=OptimizerConfig(lr=1e-3),
+            group_size=2,
+            ppo_n_minibatches=1,
+            recompute_logprob=True,
+            use_decoupled_loss=True,
+        )
+        ctrl = TrainController(
+            [EngineRPCClient(f"127.0.0.1:{p}", timeout=300) for p in ports],
+            config=cfg,
+        )
+        try:
+            assert ctrl.version_fence() == 0
+
+            batch = DistributedBatchMemory.from_dict(_fake_rollout_batch())
+            stats = ctrl.train_ppo_step(batch)
+            assert stats and all(
+                np.isfinite(v)
+                for v in stats[0].values()
+                if isinstance(v, float)
+            ), stats
+
+            ctrl.set_version(1)
+            assert ctrl.version_fence() == 1
+        finally:
+            ctrl.destroy()
+    finally:
+        open(os.path.join(outdir, "stop"), "w").write("1")
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-4000:]
+
+    # the GSPMD mesh (not RPC) synced gradients: post-update params must be
+    # bit-identical across the worker fleet, and versions fenced at 1
+    e0 = np.load(os.path.join(outdir, "embed0.npy"))
+    e1 = np.load(os.path.join(outdir, "embed1.npy"))
+    np.testing.assert_array_equal(e0, e1)
+    for pid in range(nprocs):
+        done = json.load(open(os.path.join(outdir, f"done{pid}.json")))
+        assert done["version"] == 1

@@ -137,3 +137,228 @@ def test_lora_orbax_roundtrip(tmp_path):
         np.asarray(jax.device_get(eng2.lora_params["layers"]["wq_b"])), ref, rtol=1e-6
     )
     eng2.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Adapter-native serving (round-2 verdict item 3): an adapter-only push must
+# produce logits identical to pushing the fully merged weights, ship far
+# fewer bytes, and merge against the retained BASE on every update.
+# ---------------------------------------------------------------------------
+
+
+def _gen_engine(cfg, params):
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+
+    eng = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=4,
+            max_seq_len=256,
+            prefill_chunk=64,
+            decode_steps_per_call=4,
+            dtype="float32",
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    eng.start()
+    return eng
+
+
+def _greedy(eng, prompt, n=6, rid="r"):
+    import threading
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(
+        rid, prompt,
+        GenerationHyperparameters(max_new_tokens=n, greedy=True), cb,
+    )
+    assert done.wait(120), "generation timed out"
+    return out["r"]
+
+
+def _named_adapters(lora_params):
+    return {
+        f"layers.{k}": np.asarray(jax.device_get(v))
+        for k, v in lora_params["layers"].items()
+    }
+
+
+def test_adapter_update_matches_merged_weights():
+    from areal_tpu.models.lm import init_params
+    from areal_tpu.models.lora import init_lora_params, merge_lora
+
+    cfg = tiny_config()
+    lcfg = LoRAConfig(rank=4, alpha=8.0)
+    base = init_params(cfg, jax.random.PRNGKey(0), np.float32)
+    # a non-trivial adapter: B must be nonzero for the update to matter
+    lora = init_lora_params(cfg, lcfg, jax.random.PRNGKey(1), np.float32)
+    lora["layers"] = {
+        k: (
+            jax.random.normal(jax.random.PRNGKey(i), v.shape) * 0.05
+            if k.endswith("_b") else v
+        )
+        for i, (k, v) in enumerate(sorted(lora["layers"].items()))
+    }
+    merged = merge_lora(base, lora, lcfg)
+    scale = lcfg.alpha / lcfg.rank
+    prompt = [5, 9, 3, 7, 2]
+
+    eng_merged = _gen_engine(cfg, merged)
+    try:
+        want = _greedy(eng_merged, prompt)
+    finally:
+        eng_merged.stop()
+
+    eng = _gen_engine(cfg, base)
+    try:
+        before = _greedy(eng, prompt, rid="r0")
+        eng.update_lora_from_named_arrays(_named_adapters(lora), scale, 3)
+        got = _greedy(eng, prompt, rid="r1")
+        assert eng.get_version() == 3
+        assert got.output_tokens == want.output_tokens
+        np.testing.assert_allclose(
+            got.output_logprobs, want.output_logprobs, rtol=1e-5, atol=1e-6
+        )
+        # the adapter actually changed the outputs
+        assert (
+            before.output_tokens != got.output_tokens
+            or before.output_logprobs != got.output_logprobs
+        )
+
+        # second adapter must merge against the retained BASE, not the
+        # previously merged params
+        lora2 = init_lora_params(cfg, lcfg, jax.random.PRNGKey(7), np.float32)
+        lora2["layers"] = {
+            k: (
+                jax.random.normal(jax.random.PRNGKey(100 + i), v.shape) * 0.05
+                if k.endswith("_b") else v
+            )
+            for i, (k, v) in enumerate(sorted(lora2["layers"].items()))
+        }
+        eng.update_lora_from_named_arrays(_named_adapters(lora2), scale, 4)
+        merged2 = merge_lora(base, lora2, lcfg)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(eng.params["layers"]["wq"])),
+            np.asarray(jax.device_get(merged2["layers"]["wq"])),
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        eng.stop()
+
+
+def test_adapter_http_endpoint_and_payload_size():
+    import asyncio
+    import threading
+
+    import aiohttp
+    from safetensors.numpy import save as st_save
+
+    from areal_tpu.inference.server import GenerationServer
+    from areal_tpu.models.lm import init_params
+    from areal_tpu.models.lora import init_lora_params, merge_lora
+    from areal_tpu.utils.http import arequest_with_retry
+
+    cfg = tiny_config()
+    lcfg = LoRAConfig(rank=4, alpha=8.0)
+    base = init_params(cfg, jax.random.PRNGKey(0), np.float32)
+    lora = init_lora_params(cfg, lcfg, jax.random.PRNGKey(1), np.float32)
+    lora["layers"] = {
+        k: (np.full(v.shape, 0.02, np.float32) if k.endswith("_b") else v)
+        for k, v in lora["layers"].items()
+    }
+
+    eng = _gen_engine(cfg, base)
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=60)
+
+        adapter_blob = st_save(
+            {k: np.ascontiguousarray(v) for k, v in _named_adapters(lora).items()}
+        )
+        full_blob = st_save(
+            {
+                f"layers.{k}": np.ascontiguousarray(jax.device_get(v))
+                for k, v in merge_lora(base, lora, lcfg)["layers"].items()
+            }
+        )
+        # the point of adapter-native serving: the sync payload is tiny
+        assert len(adapter_blob) * 5 < len(full_blob), (
+            len(adapter_blob), len(full_blob),
+        )
+
+        scale = lcfg.alpha / lcfg.rank
+
+        async def _push():
+            async with aiohttp.ClientSession() as session:
+                return await arequest_with_retry(
+                    session,
+                    f"http://127.0.0.1:{port}/update_lora_weights"
+                    f"?version=2&scale={scale}",
+                    data=adapter_blob,
+                )
+
+        res = asyncio.run(_push())
+        assert res["success"], res
+        assert res["weight_version"] == 2
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(eng.params["layers"]["wq"])),
+            np.asarray(
+                jax.device_get(merge_lora(base, lora, lcfg)["layers"]["wq"])
+            ),
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_lora_meta_drives_adapter_push_colocated():
+    """Full chain: LoRA trainer -> WeightUpdateMeta.from_lora ->
+    LocalInfEngine -> GenerationEngine serves base + trained adapters."""
+    from areal_tpu.api.cli_args import InferenceEngineConfig, JaxGenConfig
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+    from areal_tpu.engine.local_inf import LocalInfEngine
+
+    model_cfg = tiny_config()
+    eng = TPULMEngine(_cfg())
+    eng.initialize(None, None, model_config=model_cfg, seed=0)
+    for _ in range(3):
+        eng.train_lm(_data())
+
+    inf = LocalInfEngine(
+        InferenceEngineConfig(max_concurrent_rollouts=2, consumer_batch_size=2),
+        JaxGenConfig(
+            max_batch_size=2, max_seq_len=128, prefill_chunk=32,
+            decode_steps_per_call=2, dtype="float32",
+        ),
+        model_config=model_cfg,
+        params=eng.params,  # serving starts from the BASE weights
+    )
+    inf.initialize(None, train_data_parallel_size=1)
+    try:
+        eng.connect_engine(inf, WeightUpdateMeta.from_lora())
+        eng.update_weights()
+        assert inf.get_version() == 1
+        eff = eng.effective_params()
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(inf.engine.params["layers"]["wq"])),
+            np.asarray(jax.device_get(eff["layers"]["wq"])),
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        inf.destroy()
+        eng.destroy()

@@ -137,6 +137,55 @@ def test_pipeline_grads_match_plain():
         )
 
 
+@pytest.mark.parametrize("strategy", [
+    ParallelStrategy(pp=2, tp=2),        # pp x tp: heads shard over tp
+    ParallelStrategy(pp=2, dp=2),        # pp x dp: tokens ring over dp
+    ParallelStrategy(pp=2, dp=2, tp=2),  # all three (8 devices)
+])
+def test_pipeline_keeps_flash_kernel_under_inner_sharding(
+    strategy, monkeypatch
+):
+    """Round-2 verdict item 2: the Pallas flash kernel must stay live inside
+    pipeline stages when dp/cp/tp > 1 (previously silently degraded to
+    O(T^2) einsum attention). Asserts the kernel path is actually traced AND
+    numerics match the plain unsharded forward."""
+    import areal_tpu.ops.pallas.flash_attention as fa
+    from areal_tpu.ops.attention import AttnSpec
+
+    cfg = tiny_config(num_hidden_layers=4)
+    mesh = make_mesh(strategy)
+    spec = AttnSpec.for_mesh(mesh, cfg, impl="pallas_interpret", block=8)
+    assert spec.is_sharded, spec
+
+    calls = []
+    real_chunk = fa.flash_attention_chunk
+
+    def counting_chunk(*args, **kwargs):
+        calls.append(1)
+        return real_chunk(*args, **kwargs)
+
+    monkeypatch.setattr(fa, "flash_attention_chunk", counting_chunk)
+
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    ids, pos, seg = _mb_stack(m=3, t=16)
+    got = jax.jit(
+        lambda p: forward_packed_pipelined(
+            p, cfg, ids, pos, seg, mesh, attn_spec=spec
+        )
+    )(params_pp)
+    assert calls, "flash kernel was never traced inside the pipeline"
+    want = np.stack(
+        [
+            np.asarray(forward_packed(params, cfg, ids[m], pos[m], seg[m]))
+            for m in range(ids.shape[0])
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
 def _batch(bs=6, seqlen=12, vocab=128, seed=0):
     rng = np.random.default_rng(seed)
     lens = rng.integers(5, seqlen + 1, size=bs)
