@@ -90,11 +90,174 @@ def _coerce(value: Any, tp: Any) -> Any:
     return value
 
 
+# --------------------------------------------------------------------------
+# Reference-YAML compatibility (cli audit, PARITY.md "cli_args audit" table):
+# per-dataclass key ALIASES (reference key -> our dotted key) and
+# ACCEPTED-BUT-IGNORED keys (warned once, value dropped — knobs whose role
+# doesn't exist in the TPU design). Anything not listed and not a field
+# still raises, preserving typo-catching.
+# --------------------------------------------------------------------------
+
+# looked up over cls.__mro__, so PPOActorConfig/PPOCriticConfig inherit the
+# TrainEngineConfig entries (subclass tables add to — and override — them)
+_KEY_ALIASES: dict[str, dict[str, str]] = {
+    "TrainEngineConfig": {
+        "dtype": "backend.param_dtype",
+        "grad_reduce_dtype": "backend.grad_acc_dtype",
+        "gradient_checkpointing": "backend.remat",
+        "lora_rank": "lora.rank",
+        "lora_alpha": "lora.alpha",
+        "target_modules": "lora.target_modules",
+    },
+    "OptimizerConfig": {
+        "lr_scheduler_type": "lr_scheduler.type",
+        "warmup_steps_proportion": "lr_scheduler.warmup_steps_proportion",
+        "min_lr_ratio": "lr_scheduler.min_lr_ratio",
+        "offload": "offload_optimizer_state",
+    },
+    "ClusterSpecConfig": {"n_gpus_per_node": "n_chips_per_host"},
+}
+
+_IGNORED_KEYS: dict[str, dict[str, str]] = {
+    # class -> {key: why it has no TPU counterpart}; merged over __mro__
+    "TrainEngineConfig": {
+        "pad_to_maximum": "backend.pad_mb_to_multiple buckets instead",
+        "disable_dropout": "the TPU models carry no dropout at all",
+        "weight_update_mode": "WeightUpdateMeta chooses disk/device/http/lora",
+        "fsdp": "one GSPMD backend replaces the FSDP engine config",
+        "megatron": "one GSPMD backend replaces the Megatron engine config",
+        "peft_type": "lora is the only PEFT type (matching the reference)",
+        "use_lora": "presence of the lora section enables adapters",
+        "is_critic": "criticness rides PPOCriticConfig / model config",
+    },
+    "PPOActorConfig": {
+        "log_agent_stats": "agent stats ride the stats_tracker scopes",
+        "log_agent_stats_keys": "agent stats ride the stats_tracker scopes",
+    },
+    "OptimizerConfig": {
+        "initial_loss_scale": "bf16 training needs no fp16 loss scaling",
+        "min_loss_scale": "bf16 training needs no fp16 loss scaling",
+        "loss_scale_window": "bf16 training needs no fp16 loss scaling",
+        "hysteresis": "bf16 training needs no fp16 loss scaling",
+    },
+    "GenerationHyperparameters": {
+        "max_tokens": "per-request totals derive from max_new_tokens + "
+        "prompt length; the server enforces max_seq_len",
+    },
+    "StatsLoggerConfig": {
+        "swanlab": "no swanlab in the TPU image (wandb/tensorboard do)",
+    },
+    "BaseExperimentConfig": {
+        "scheduler": "launcher/slurm sections cover worker scheduling",
+    },
+    "SFTConfig": {"scheduler": "launcher/slurm sections cover scheduling"},
+    "GRPOConfig": {"scheduler": "launcher/slurm sections cover scheduling"},
+    "PPOConfig": {"scheduler": "launcher/slurm sections cover scheduling"},
+    "RWConfig": {"scheduler": "launcher/slurm sections cover scheduling"},
+}
+
+# reference sglang/vllm server sections -> JaxGenConfig ("server") fields;
+# unmapped engine-tuning keys are dropped with one summary warning
+_SERVER_SECTION_MAP = {
+    "model_path": "model_path",
+    "dtype": "dtype",
+    "random_seed": "random_seed",
+    "skip_tokenizer_init": "skip_tokenizer_init",
+    "context_length": "max_seq_len",
+    "max_running_requests": "max_batch_size",
+    "mem_fraction_static": "hbm_utilization",
+    "gpu_memory_utilization": "hbm_utilization",
+    "page_size": "page_size",
+}
+
+_warned_keys: set = set()
+
+
+def _warn_once(msg: str):
+    if msg not in _warned_keys:
+        _warned_keys.add(msg)
+        import warnings
+
+        warnings.warn(msg, stacklevel=3)
+
+
+def _set_dotted_default(d: dict, dotted_key: str, value: Any, src: str):
+    """Like _set_dotted but an explicitly-set canonical key WINS over the
+    reference alias (warned), matching the sglang-section setdefault
+    precedence."""
+    parts = dotted_key.split(".")
+    cur = d
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+        if not isinstance(cur, dict):
+            raise ValueError(f"Cannot override non-dict path {dotted_key}")
+    if parts[-1] in cur:
+        _warn_once(
+            f"both reference key {src!r} and canonical {dotted_key!r} are "
+            f"set; the canonical value wins"
+        )
+        return
+    cur[parts[-1]] = value
+
+
+def _apply_compat(cls, data: dict) -> dict:
+    """Reference-YAML key compatibility: aliases move values to our fields,
+    ignored keys drop with a one-time warning, sglang/vllm server sections
+    map onto the in-repo JAX server config. Tables merge over ``__mro__``
+    (base-class entries apply to subclasses)."""
+    name = cls.__name__
+    aliases: dict = {}
+    ignored: dict = {}
+    for klass in reversed(getattr(cls, "__mro__", [cls])):
+        aliases.update(_KEY_ALIASES.get(klass.__name__, {}))
+        ignored.update(_IGNORED_KEYS.get(klass.__name__, {}))
+    if not aliases and not ignored and name not in (
+        "GRPOConfig", "PPOConfig", "SFTConfig", "RWConfig",
+        "BaseExperimentConfig",
+    ):
+        return data
+    data = dict(data)
+    use_lora = data.get("use_lora")
+    for key in list(data):
+        if key in aliases:
+            _set_dotted_default(data, aliases[key], data.pop(key), key)
+        elif key in ignored:
+            _warn_once(
+                f"{name}.{key} is accepted but ignored on TPU: {ignored[key]}"
+            )
+            data.pop(key)
+        elif key in ("sglang", "vllm") and "server" in {
+            f.name for f in dataclasses.fields(cls)
+        }:
+            section = data.pop(key) or {}
+            dropped = []
+            for k, v in section.items():
+                if k in _SERVER_SECTION_MAP:
+                    data.setdefault("server", {})
+                    if isinstance(data["server"], dict):
+                        data["server"].setdefault(_SERVER_SECTION_MAP[k], v)
+                else:
+                    dropped.append(k)
+            if dropped:
+                _warn_once(
+                    f"{name}.{key}: {len(dropped)} engine-tuning keys have "
+                    f"no JAX-server counterpart and were ignored: "
+                    f"{sorted(dropped)}"
+                )
+    if use_lora is False:
+        # reference YAML disabled LoRA: the lora_* aliases must not enable it
+        data.pop("lora", None)
+    return data
+
+
 def from_dict(cls, data: dict[str, Any]):
     """Build dataclass ``cls`` from a nested dict with type coercion; unknown
-    keys raise (catching config typos, like OmegaConf structured mode)."""
+    keys raise (catching config typos, like OmegaConf structured mode).
+    Reference-YAML keys that have a mapped or intentionally-dropped role are
+    translated first (``_apply_compat``)."""
     if data is None:
         data = {}
+    data = _apply_compat(cls, data)
     fields = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(data) - set(fields)
     if unknown:
@@ -180,6 +343,10 @@ class NormConfig:
     std_level: str = "batch"  # batch | group | none
     group_size: int = 1
     eps: float = 1e-5
+    # RLOO-style leave-one-out mean: each sample's baseline excludes itself
+    mean_leave1out: bool = False
+    # Bessel-corrected std (n-1 denominator)
+    std_unbiased: bool = False
 
 
 @dataclass
@@ -254,6 +421,12 @@ class EngineBackendConfig:
     # logits = 19.5GB). 0 = classic full-logits loss. LM/PPO-actor losses
     # only; ignored for critics/RM and under pipeline parallelism.
     loss_chunk_size: int = 0
+    # pipeline schedule (pp > 1): "gpipe" = one forward pipeline + AD
+    # (stores O(M) stage activations); "1f1b" = hand-rolled interleaved
+    # one-forward-one-backward (parallel/pipeline.pipeline_train_step_1f1b),
+    # O(pp) live activations — feed more microbatches per step for the same
+    # memory, shrinking the bubble. LoRA engines fall back to gpipe.
+    pp_schedule: str = "gpipe"
 
 
 @dataclass
@@ -292,6 +465,9 @@ class PPOActorConfig(TrainEngineConfig):
     c_clip: float | None = None  # dual clip
     temperature: float = 1.0
     # reward shaping
+    # full reward-normalization spec (reference PPOActorConfig.reward_norm);
+    # group_reward_norm is the boolean shorthand for group/group
+    reward_norm: NormConfig | None = None
     group_reward_norm: bool = False
     reward_scaling: float = 1.0
     reward_bias: float = 0.0
@@ -429,6 +605,15 @@ class WandBConfig:
     project: str | None = None
     entity: str | None = None
     name: str | None = None
+    # passthrough wandb.init knobs (reference cli_args WandBConfig parity)
+    wandb_base_url: str | None = None
+    wandb_api_key: str | None = None
+    job_type: str | None = None
+    group: str | None = None
+    notes: str | None = None
+    tags: list | None = None
+    config: dict | None = None
+    id_suffix: str | None = None
 
 
 @dataclass
@@ -451,6 +636,7 @@ class ClusterSpecConfig:
     cluster_name: str = "local"
     fileroot: str = "/tmp/areal_tpu/experiments"
     n_chips_per_host: int = 4
+    n_nodes: int = 1
 
 
 @dataclass

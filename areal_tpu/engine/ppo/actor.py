@@ -56,21 +56,39 @@ class PPOActor:
         self.dynamic_sampling = config.dynamic_sampling
         self.group_size = config.group_size
 
-        self.reward_norm = (
-            Normalization(
+        if config.reward_norm is not None:
+            # full spec (reference PPOActorConfig.reward_norm); a
+            # group-level norm without an explicit group_size (NormConfig
+            # default 1 = every sample its own group -> all-zero rewards)
+            # means the actor's GRPO group
+            rn = config.reward_norm
+            rn_group = rn.group_size
+            if rn_group <= 1 and "group" in (rn.mean_level, rn.std_level):
+                rn_group = config.group_size
+            self.reward_norm = Normalization(
+                mean_level=rn.mean_level,
+                std_level=rn.std_level,
+                group_size=rn_group,
+                eps=rn.eps,
+                mean_leave1out=rn.mean_leave1out,
+                std_unbiased=rn.std_unbiased,
+            )
+        elif config.group_reward_norm:  # boolean shorthand for group/group
+            self.reward_norm = Normalization(
                 mean_level="group",
                 std_level="group",
                 group_size=config.group_size,
             )
-            if config.group_reward_norm
-            else None
-        )
+        else:
+            self.reward_norm = None
         self.adv_norm = (
             Normalization(
                 mean_level=config.adv_norm.mean_level,
                 std_level=config.adv_norm.std_level,
                 group_size=config.adv_norm.group_size,
                 eps=config.adv_norm.eps,
+                mean_leave1out=config.adv_norm.mean_leave1out,
+                std_unbiased=config.adv_norm.std_unbiased,
             )
             if config.adv_norm is not None
             else None

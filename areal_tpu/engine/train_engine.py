@@ -276,16 +276,26 @@ class TPUTrainEngine(TrainEngine):
         else:
             self.model_config = from_hf_config(cfg.path)
         check_pp_compatible(self.model_config, self.mesh)
+        self._pp_replicated_data = False
         if pp_size(self.mesh) > 1 and distributed.process_count() > 1:
-            # pp peers would need identical per-host batches (the stacked
-            # [M, T] array is pp-replicated); the host-local dataloader
-            # sharding feeds DIFFERENT streams per host, which would build
-            # inconsistent global arrays and double-count the loss
-            # normalizer — fail loudly until pp-aware host data placement
-            # lands
-            raise NotImplementedError(
-                "pp>1 with multi-host jax.distributed is not supported yet"
+            dp_cp = int(self.mesh.shape.get("dp", 1)) * int(
+                self.mesh.shape.get("cp", 1)
             )
+            if dp_cp > 1:
+                # mixed dp x pp across hosts would need pp-aware host data
+                # placement (which host feeds which dp shard of a
+                # pp-replicated stack) — fail loudly
+                raise NotImplementedError(
+                    "pp>1 with multi-host jax.distributed supports only the "
+                    "synchronized-batch case (dp=cp=1): every host must feed "
+                    "the IDENTICAL batch; got dp*cp="
+                    f"{dp_cp}"
+                )
+            # synchronized-batch multi-host pp: the stacked [M, T] batch is
+            # replicated over the pp hosts (each host feeds the same data —
+            # verified by checksum each step), so the loss normalizer must
+            # NOT be summed across processes
+            self._pp_replicated_data = True
         self.attn_spec = self._build_attn_spec()
 
         param_dtype = _DTYPES[cfg.backend.param_dtype]
@@ -591,7 +601,43 @@ class TPUTrainEngine(TrainEngine):
             if distributed.process_count() > 1:
                 t = int(distributed.sync_max(t))
             packed_mbs = [self._repad_packed(p, t) for p in packed_mbs]
-        if distributed.process_count() > 1:
+        if self._pp_replicated_data:
+            # synchronized-batch multi-host pp: every host MUST be feeding
+            # the identical batch — a silent divergence would build
+            # inconsistent pp-replicated global arrays. One vectorized
+            # collective checks (count, tokens, input_ids checksum).
+            # ORDER-SENSITIVE signature (a permutation of the same
+            # microbatches must fail too): position- and token-weighted
+            # rolling hashes of ids + loss_mask, kept exactly float64-
+            # representable via mod 2^40
+            mod = np.int64(1) << 40
+
+            def h(arr_key):
+                acc = np.int64(0)
+                for i, p in enumerate(packed_mbs):
+                    a = np.asarray(p[arr_key], np.int64).ravel()
+                    w = np.arange(1, a.size + 1, dtype=np.int64) % mod
+                    acc = (acc + np.int64(i + 1) * np.sum(a * w % mod)) % mod
+                return float(acc)
+
+            sig = np.asarray(
+                [
+                    len(packed_mbs),
+                    h("input_ids"),
+                    h("loss_mask"),
+                    sum(int(p["cu_seqlens"][-1]) for p in packed_mbs),
+                ],
+                np.float64,
+            )
+            mx = distributed.sync_max_vector(sig, 4)
+            mn = -distributed.sync_max_vector(-sig, 4)
+            if not np.array_equal(mx, mn):
+                raise ValueError(
+                    "multi-host pp requires every host to feed the IDENTICAL "
+                    f"batch (synchronized-batch mode); local signature {sig} "
+                    f"vs fleet max {mx} / min {mn}"
+                )
+        elif distributed.process_count() > 1:
             packed_mbs, real_ns = self._sync_mbs_across_hosts(packed_mbs, real_ns)
         return mb_list, packed_mbs, real_ns
 
@@ -632,18 +678,53 @@ class TPUTrainEngine(TrainEngine):
 
     # ------------------------------------------------------------ train step
 
-    def _grad_fn_pp(self, loss_fn: Callable) -> Callable:
+    def _grad_fn_pp(
+        self, loss_fn: Callable, token_loss_fn: "TokenLossFn | None" = None
+    ) -> Callable:
         """Pipelined grad step: ALL microbatches ride one jit call as a
         stacked [M, T] batch; the GPipe schedule inside
         forward_packed_pipelined overlaps their stage compute, and grad
         accumulation over M falls out of summing the vmapped per-mb losses
         (no explicit accumulator buffer)."""
-        key = ("grad_pp", loss_fn)
+        key = ("grad_pp", loss_fn, token_loss_fn)
         if key not in self._jit_cache:
             cfg, backend = self.model_config, self.config.backend
             mesh, attn_spec = self.mesh, self.attn_spec
             acc_dtype = _DTYPES[backend.grad_acc_dtype]
             lora_cfg = self.config.lora
+
+            if (
+                backend.pp_schedule == "1f1b"
+                and lora_cfg is None
+                and token_loss_fn is not None
+                and not cfg.is_critic
+            ):
+                from areal_tpu.parallel.pipeline import (
+                    pipeline_train_step_1f1b,
+                )
+
+                def step_1f1b(params, mbs):
+                    return pipeline_train_step_1f1b(
+                        params, cfg, mbs, mesh, token_loss_fn,
+                        attn_spec=attn_spec,
+                        remat=backend.remat,
+                        remat_policy=backend.remat_policy,
+                        acc_dtype=acc_dtype,
+                    )
+
+                self._jit_cache[key] = jax.jit(step_1f1b)
+                return self._jit_cache[key]
+            if backend.pp_schedule == "1f1b":
+                logger.warning(
+                    "pp_schedule=1f1b needs the fused-loss contract "
+                    "(TokenLossFn) and supports neither LoRA nor critics; "
+                    "falling back to gpipe"
+                )
+            elif backend.pp_schedule != "gpipe":
+                raise ValueError(
+                    f"unknown pp_schedule {backend.pp_schedule!r}; "
+                    "use gpipe | 1f1b"
+                )
 
             def compute(params, mbs):
                 logits = forward_packed_pipelined(
@@ -858,8 +939,13 @@ class TPUTrainEngine(TrainEngine):
         weights = [float(loss_weight_fn(mb)) for mb in packed_mbs]
         # multi-host: the normalizer is the GLOBAL loss weight (each host
         # only sees its local sequences; reference fsdp_engine.py:536-560
-        # scales by dp_size for the same reason)
-        total_weight = distributed.sync_sum(sum(weights))
+        # scales by dp_size for the same reason). Synchronized-batch pp is
+        # the exception: hosts feed REPLICAS, so summing across processes
+        # would double-count the denominator.
+        if self._pp_replicated_data:
+            total_weight = float(sum(weights))
+        else:
+            total_weight = distributed.sync_sum(sum(weights))
         assert total_weight > 0, "loss_weight_fn summed to 0 over the batch"
 
         # free any merged-weights copy BEFORE forward+backward: holding a
@@ -868,7 +954,7 @@ class TPUTrainEngine(TrainEngine):
         self._merged_cache = None
         if pp_size(self.mesh) > 1:
             mbs_dev = self._stacked_to_device(packed_mbs)
-            losses_vec, acc = self._grad_fn_pp(loss_fn)(
+            losses_vec, acc = self._grad_fn_pp(loss_fn, token_loss_fn)(
                 self._trainable(), mbs_dev
             )
             losses = [jnp.sum(losses_vec)]

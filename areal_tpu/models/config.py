@@ -57,6 +57,21 @@ class TransformerConfig:
     vision_hidden_size: int = 0
     vision_layers: int = 0
     image_token_id: int = 0
+    # Qwen2-VL family (models/vlm_qwen2.py): HF-processor patch-stream
+    # inputs (pixel_values [num_patches, C*tps*ps*ps] + image_grid_thw) and
+    # M-RoPE (3-axis rotary) in the decoder. vision_arch selects between the
+    # compact in-repo ViT ("mini", models/vlm.py) and the HF-parity tower.
+    vision_arch: str = "mini"  # "mini" | "qwen2_vl"
+    vision_embed_dim: int = 0
+    vision_depth: int = 0
+    vision_num_heads: int = 0
+    vision_mlp_ratio: float = 4.0
+    vision_spatial_merge: int = 2
+    vision_temporal_patch: int = 2
+    vision_in_channels: int = 3
+    vision_hidden_act: str = "quick_gelu"
+    mrope_section: tuple | None = None  # (t, h, w) freq-channel split
+    vision_start_token_id: int = 0
 
     @property
     def q_dim(self) -> int:
@@ -82,6 +97,7 @@ class TransformerConfig:
 
 
 _HF_ARCH_MAP = {
+    "Qwen2VLForConditionalGeneration": "qwen2_vl",
     "Qwen2ForCausalLM": "qwen2",
     "Qwen3ForCausalLM": "qwen3",
     "LlamaForCausalLM": "llama",
@@ -131,6 +147,46 @@ def _gpt2_config(hf: dict, is_critic: bool) -> TransformerConfig:
     )
 
 
+def _qwen2_vl_config(hf: dict, is_critic: bool) -> TransformerConfig:
+    """Qwen2-VL: text fields live top-level (and mirrored in text_config),
+    the vision tower under vision_config, M-RoPE split under rope_scaling
+    (reference: areal/models/transformers/qwen2_vl.py HF passthrough)."""
+    text = {**hf, **hf.get("text_config", {})}
+    vis = hf["vision_config"]
+    n_heads = text["num_attention_heads"]
+    rope_scaling = text.get("rope_scaling") or {}
+    mrope = rope_scaling.get("mrope_section")
+    return TransformerConfig(
+        vocab_size=text["vocab_size"],
+        hidden_size=text["hidden_size"],
+        intermediate_size=text["intermediate_size"],
+        num_hidden_layers=text["num_hidden_layers"],
+        num_attention_heads=n_heads,
+        num_key_value_heads=text.get("num_key_value_heads", n_heads),
+        head_dim=text.get("head_dim") or text["hidden_size"] // n_heads,
+        rope_theta=text.get("rope_theta", 10000.0),
+        rms_norm_eps=text.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=True,  # qwen2-family qkv bias
+        max_position_embeddings=text.get("max_position_embeddings", 32768),
+        is_critic=is_critic,
+        arch="qwen2_vl",
+        vision_arch="qwen2_vl",
+        vision_patch_size=vis["patch_size"],
+        vision_embed_dim=vis["embed_dim"],
+        vision_depth=vis["depth"],
+        vision_num_heads=vis["num_heads"],
+        vision_mlp_ratio=vis.get("mlp_ratio", 4.0),
+        vision_spatial_merge=vis.get("spatial_merge_size", 2),
+        vision_temporal_patch=vis.get("temporal_patch_size", 2),
+        vision_in_channels=vis.get("in_channels", 3),
+        vision_hidden_act=vis.get("hidden_act", "quick_gelu"),
+        mrope_section=tuple(mrope) if mrope else None,
+        image_token_id=hf.get("image_token_id", 151655),
+        vision_start_token_id=hf.get("vision_start_token_id", 151652),
+    )
+
+
 def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     """Build a TransformerConfig from an HF ``config.json`` (path, model dir,
     or already-loaded dict)."""
@@ -142,12 +198,18 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
             p = os.path.join(p, "config.json")
         with open(p) as f:
             hf = json.load(f)
+    if hf.get("model_type") == "qwen2_vl":
+        # saved Qwen2VLConfig may omit top-level architectures (they live in
+        # text_config, naming the composite class)
+        return _qwen2_vl_config(hf, is_critic)
     archs = hf.get("architectures") or ["Qwen2ForCausalLM"]
     arch = _HF_ARCH_MAP.get(archs[0])
     if arch is None:
         raise ValueError(f"Unsupported HF architecture: {archs[0]}")
     if arch == "gpt2":
         return _gpt2_config(hf, is_critic)
+    if arch == "qwen2_vl":
+        return _qwen2_vl_config(hf, is_critic)
     window = hf.get("sliding_window")
     window_active = window is not None and window < hf.get(
         "max_position_embeddings", 1 << 30
@@ -220,6 +282,41 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
                 "gelu_tanh": "gelu_new", "gelu": "gelu", "relu": "relu"
             }[cfg.hidden_act],
             "tie_word_embeddings": True,
+            "torch_dtype": "bfloat16",
+        }
+    if cfg.arch == "qwen2_vl":
+        return {
+            "architectures": ["Qwen2VLForConditionalGeneration"],
+            "model_type": "qwen2_vl",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "num_hidden_layers": cfg.num_hidden_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "num_key_value_heads": cfg.num_key_value_heads,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.rms_norm_eps,
+            "tie_word_embeddings": cfg.tie_word_embeddings,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "rope_scaling": {
+                "type": "mrope",
+                "mrope_section": list(cfg.mrope_section or ()),
+            },
+            "image_token_id": cfg.image_token_id,
+            "vision_start_token_id": cfg.vision_start_token_id,
+            "vision_config": {
+                "model_type": "qwen2_vl",
+                "depth": cfg.vision_depth,
+                "embed_dim": cfg.vision_embed_dim,
+                "num_heads": cfg.vision_num_heads,
+                "hidden_size": cfg.hidden_size,
+                "mlp_ratio": cfg.vision_mlp_ratio,
+                "patch_size": cfg.vision_patch_size,
+                "spatial_merge_size": cfg.vision_spatial_merge,
+                "temporal_patch_size": cfg.vision_temporal_patch,
+                "in_channels": cfg.vision_in_channels,
+                "hidden_act": cfg.vision_hidden_act,
+            },
             "torch_dtype": "bfloat16",
         }
     arch = {

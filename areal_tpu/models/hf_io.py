@@ -112,6 +112,55 @@ def _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer):
         logger.warning(f"Skipping unmapped gpt2 tensor: {name}")
 
 
+def _ingest_qwen2vl_vision(sub: str, tensor: np.ndarray, vtop, put_vblock):
+    """Map one HF ``visual.*`` tensor into the vlm_qwen2 param layout
+    (weights transposed to x @ W orientation; Conv3d with stride == kernel
+    flattened to a linear over the (C, tps, ps, ps) patch)."""
+    if sub == "patch_embed.proj.weight":
+        vtop["patch_proj"] = tensor.reshape(tensor.shape[0], -1).T
+        return
+    if sub.startswith("merger."):
+        key = {
+            "merger.ln_q.weight": ("merger_ln", False),
+            "merger.ln_q.bias": ("merger_ln_b", False),
+            "merger.mlp.0.weight": ("merger_fc1", True),
+            "merger.mlp.0.bias": ("merger_b1", False),
+            "merger.mlp.2.weight": ("merger_fc2", True),
+            "merger.mlp.2.bias": ("merger_b2", False),
+        }.get(sub)
+        if key is None:
+            logger.warning(f"Skipping unmapped vision tensor: visual.{sub}")
+            return
+        name, transpose = key
+        vtop[name] = tensor.T if transpose else tensor
+        return
+    if sub.startswith("blocks."):
+        rest = sub[len("blocks.") :]
+        d_str, bsub = rest.split(".", 1)
+        d = int(d_str)
+        key = {
+            "norm1.weight": ("ln1", False),
+            "norm1.bias": ("ln1_b", False),
+            "norm2.weight": ("ln2", False),
+            "norm2.bias": ("ln2_b", False),
+            "attn.qkv.weight": ("wqkv", True),
+            "attn.qkv.bias": ("bqkv", False),
+            "attn.proj.weight": ("wo", True),
+            "attn.proj.bias": ("bo", False),
+            "mlp.fc1.weight": ("fc1", True),
+            "mlp.fc1.bias": ("b1", False),
+            "mlp.fc2.weight": ("fc2", True),
+            "mlp.fc2.bias": ("b2", False),
+        }.get(bsub)
+        if key is None:
+            logger.warning(f"Skipping unmapped vision tensor: visual.{sub}")
+            return
+        name, transpose = key
+        put_vblock(name, d, tensor.T if transpose else tensor)
+        return
+    logger.warning(f"Skipping unmapped vision tensor: visual.{sub}")
+
+
 def load_hf_params(
     model_dir: str,
     cfg: TransformerConfig | None = None,
@@ -140,11 +189,28 @@ def load_hf_params(
         lst = layer_parts.setdefault(key, [None] * l)
         lst[layer] = value
 
+    # qwen2_vl vision tower: per-depth block parts stacked like the decoder
+    vblock_parts: dict[str, list] = {}
+    vtop: dict[str, np.ndarray] = {}
+
+    def put_vblock(key: str, depth: int, value: np.ndarray):
+        lst = vblock_parts.setdefault(key, [None] * cfg.vision_depth)
+        lst[depth] = value
+
     for name, tensor in _open_shards(model_dir):
         tensor = _bf16_view(tensor)
         if cfg.arch == "gpt2":
             _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer)
             continue
+        if cfg.arch == "qwen2_vl":
+            # transformers >=4.52 nests the text model under language_model
+            if name.startswith("model.language_model."):
+                name = "model." + name[len("model.language_model.") :]
+            if name.startswith(("model.visual.", "visual.")):
+                _ingest_qwen2vl_vision(
+                    name.split("visual.", 1)[1], tensor, vtop, put_vblock
+                )
+                continue
         if name == "model.embed_tokens.weight":
             top["embed"] = tensor
         elif name == "lm_head.weight":
@@ -245,7 +311,19 @@ def load_hf_params(
     for opt in ("pos_embed", "final_norm_b"):
         if opt in top:
             params_np[opt] = top[opt]
-    if cfg.is_vlm:
+    if cfg.arch == "qwen2_vl":
+        if not vtop and not vblock_parts:
+            raise ValueError(
+                f"qwen2_vl checkpoint at {model_dir} carries no visual.* "
+                "tensors"
+            )
+        vision: dict = dict(vtop)
+        vision["blocks"] = {
+            key: stack(f"visual.{key}", lst)
+            for key, lst in vblock_parts.items()
+        }
+        params_np["vision"] = vision
+    elif cfg.is_vlm:
         if "vision" in top:
             params_np["vision"] = top["vision"]
         else:
@@ -336,7 +414,43 @@ def save_hf_params(
         with open(os.path.join(out_dir, "config.json"), "w") as f:
             json.dump(to_hf_config(cfg), f, indent=2)
         return
-    if "vision" in params:
+    if "vision" in params and cfg.arch == "qwen2_vl":
+        # proper HF visual.* names so transformers can load our checkpoints
+        vis = params["vision"]
+        tensors["model.visual.patch_embed.proj.weight"] = contig(
+            host(vis["patch_proj"]).T.reshape(
+                cfg.vision_embed_dim,
+                cfg.vision_in_channels,
+                cfg.vision_temporal_patch,
+                cfg.vision_patch_size,
+                cfg.vision_patch_size,
+            )
+        )
+        for ours, hf_name, transpose in (
+            ("merger_ln", "merger.ln_q.weight", False),
+            ("merger_ln_b", "merger.ln_q.bias", False),
+            ("merger_fc1", "merger.mlp.0.weight", True),
+            ("merger_b1", "merger.mlp.0.bias", False),
+            ("merger_fc2", "merger.mlp.2.weight", True),
+            ("merger_b2", "merger.mlp.2.bias", False),
+        ):
+            t = host(vis[ours])
+            tensors[f"model.visual.{hf_name}"] = contig(t.T if transpose else t)
+        vb_map = {
+            "ln1": ("norm1.weight", False), "ln1_b": ("norm1.bias", False),
+            "ln2": ("norm2.weight", False), "ln2_b": ("norm2.bias", False),
+            "wqkv": ("attn.qkv.weight", True), "bqkv": ("attn.qkv.bias", False),
+            "wo": ("attn.proj.weight", True), "bo": ("attn.proj.bias", False),
+            "fc1": ("mlp.fc1.weight", True), "b1": ("mlp.fc1.bias", False),
+            "fc2": ("mlp.fc2.weight", True), "b2": ("mlp.fc2.bias", False),
+        }
+        for key, arr in vis["blocks"].items():
+            hf_sub, transpose = vb_map[key]
+            a = host(arr)
+            for d in range(cfg.vision_depth):
+                t = a[d].T if transpose else a[d]
+                tensors[f"model.visual.blocks.{d}.{hf_sub}"] = contig(t)
+    elif "vision" in params:
         def _walk(node, prefix):
             for k in sorted(node.keys()):
                 v = node[k]
@@ -347,8 +461,9 @@ def save_hf_params(
                     tensors[name] = contig(host(v))
 
         _walk(params["vision"], "vision")
-    tensors["model.embed_tokens.weight"] = contig(host(params["embed"]))
-    tensors["model.norm.weight"] = contig(host(params["final_norm"]))
+    text_pre = "model.language_model." if cfg.arch == "qwen2_vl" else "model."
+    tensors[text_pre + "embed_tokens.weight"] = contig(host(params["embed"]))
+    tensors[text_pre + "norm.weight"] = contig(host(params["final_norm"]))
     if "lm_head" in params:
         tensors["lm_head.weight"] = contig(host(params["lm_head"]).T)
     if "value_head" in params:
@@ -374,10 +489,10 @@ def save_hf_params(
             if key in sub_map:
                 hf_sub, transpose = sub_map[key]
                 t = arr[i].T if transpose else arr[i]
-                tensors[f"model.layers.{i}.{hf_sub}"] = contig(t)
+                tensors[f"{text_pre}layers.{i}.{hf_sub}"] = contig(t)
             elif key == "router":
                 moe_mod = "block_sparse_moe" if cfg.arch == "mixtral" else "mlp"
-                tensors[f"model.layers.{i}.{moe_mod}.gate.weight"] = contig(arr[i].T)
+                tensors[f"{text_pre}layers.{i}.{moe_mod}.gate.weight"] = contig(arr[i].T)
             elif key in ("wg", "wu", "wd"):
                 if cfg.is_moe:
                     if cfg.arch == "mixtral":
@@ -390,11 +505,11 @@ def save_hf_params(
                         }[key]
                     for e in range(cfg.num_experts):
                         tensors[
-                            f"model.layers.{i}.{moe_mod}.experts.{e}.{proj}.weight"
+                            f"{text_pre}layers.{i}.{moe_mod}.experts.{e}.{proj}.weight"
                         ] = contig(arr[i, e].T)
                 else:
                     proj = {"wg": "gate_proj", "wu": "up_proj", "wd": "down_proj"}[key]
-                    tensors[f"model.layers.{i}.mlp.{proj}.weight"] = contig(arr[i].T)
+                    tensors[f"{text_pre}layers.{i}.mlp.{proj}.weight"] = contig(arr[i].T)
             else:
                 raise ValueError(f"Unmapped param key: layers/{key}")
 

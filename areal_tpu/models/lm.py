@@ -159,9 +159,16 @@ def init_params(
             next(keys), (cfg.max_position_embeddings, h), s
         )
     if cfg.is_vlm:
-        from areal_tpu.models.vlm import init_vision_params
+        if cfg.vision_arch == "qwen2_vl":
+            from areal_tpu.models.vlm_qwen2 import init_qwen2vl_vision_params
 
-        params["vision"] = init_vision_params(cfg, next(keys), dtype)
+            params["vision"] = init_qwen2vl_vision_params(
+                cfg, next(keys), dtype
+            )
+        else:
+            from areal_tpu.models.vlm import init_vision_params
+
+            params["vision"] = init_vision_params(cfg, next(keys), dtype)
     if cfg.is_critic:
         params["value_head"] = normal(next(keys), (h, 1), s)
     elif not cfg.tie_word_embeddings:
@@ -292,6 +299,17 @@ def _moe_mlp(
     return jnp.einsum("eth,te->th", y, weights.astype(y.dtype))
 
 
+def _rope(cfg: TransformerConfig, v: jnp.ndarray, positions: jnp.ndarray):
+    """1D RoPE, or Qwen2-VL M-RoPE when positions carry (t, h, w) streams
+    ([3, T]); 1D positions under an mrope config are the text-only case and
+    remain exact (all three streams equal)."""
+    if cfg.mrope_section is not None and positions.ndim == v.ndim - 1:
+        from areal_tpu.ops.rotary import apply_mrope
+
+        return apply_mrope(v, positions, cfg.rope_theta, cfg.mrope_section)
+    return apply_rope(v, positions, cfg.rope_theta)
+
+
 def _block(
     cfg: TransformerConfig,
     lp: Params,
@@ -304,8 +322,8 @@ def _block(
     h = _norm(cfg, x, lp["ln1"], lp.get("ln1_b"))
     q, k, v = _qkv(cfg, lp, h)
     if cfg.pos_embed_type == "rope":
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = _rope(cfg, q, positions)
+        k = _rope(cfg, k, positions)
     attn = packed_attention(
         q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
     )
@@ -352,13 +370,28 @@ def _trunk(
     attn_spec: AttnSpec | None = None,
     pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
     remat_policy: str = "nothing_saveable",
+    image_grid_thw: tuple | None = None,  # qwen2_vl: static (t,h,w) per image
 ) -> jnp.ndarray:
     """Embed -> layer scan -> final norm: hidden states [T, H]."""
     x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
-        from areal_tpu.models.vlm import encode_images, splice_image_embeds
+        from areal_tpu.models.vlm import splice_image_embeds
 
-        embeds = encode_images(params["vision"], cfg, pixel_values)
+        if cfg.vision_arch == "qwen2_vl":
+            # HF-parity tower: pixel_values is the processor's flattened
+            # patch stream [P, C*tps*ps*ps] + static grid (vlm_qwen2.py)
+            from areal_tpu.models.vlm_qwen2 import encode_images_qwen2vl
+
+            assert image_grid_thw is not None, (
+                "qwen2_vl pixel_values need image_grid_thw"
+            )
+            embeds = encode_images_qwen2vl(
+                params["vision"], cfg, pixel_values, image_grid_thw
+            )[None]  # [1, P/m^2, H] — splice consumes flattened rows
+        else:
+            from areal_tpu.models.vlm import encode_images
+
+            embeds = encode_images(params["vision"], cfg, pixel_values)
         x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
@@ -385,12 +418,13 @@ def forward_packed(
     attn_spec: AttnSpec | None = None,
     pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
     remat_policy: str = "nothing_saveable",
+    image_grid_thw: tuple | None = None,
 ) -> jnp.ndarray:
     """Returns logits [T, V] (fp32) — or values [T] (fp32) for critics."""
     x = _trunk(
         params, cfg, input_ids, positions, segment_ids,
         remat=remat, attn_spec=attn_spec, pixel_values=pixel_values,
-        remat_policy=remat_policy,
+        remat_policy=remat_policy, image_grid_thw=image_grid_thw,
     )
     if cfg.is_critic:
         return (x @ params["value_head"]).astype(jnp.float32)[:, 0]

@@ -1,0 +1,238 @@
+"""Qwen2-VL vision tower + M-RoPE position machinery (HF-parity).
+
+Round-2 verdict item 4: the mini-ViT (models/vlm.py) proves the VLM
+plumbing but cannot load a real checkpoint. This module is the actual
+HF Qwen2-VL vision transformer re-expressed functionally for TPU
+(reference serving path: areal/models/transformers/qwen2_vl.py wrapping
+transformers' Qwen2VisionTransformerPretrainedModel):
+
+- patch embed: the HF Conv3d with stride == kernel is a pure linear over
+  the flattened (C, tps, ps, ps) patch — one [P, pd] @ [pd, E] matmul;
+- 2D rotary: per-patch (h, w) ids in the processor's merge-window order,
+  each getting half the head_dim/2 frequency channels, rotate_half
+  convention;
+- full (non-causal) attention within each image (block-diagonal segment
+  mask over the packed patch stream), fp32 softmax;
+- PatchMerger: LayerNorm then groups of merge^2 consecutive patches
+  through a 2-layer GELU MLP into LLM hidden size.
+
+Static shapes: ``grid_thw`` is a python tuple, so patch counts and the
+merge grouping are compile-time constants (TPU requirement); variable
+image sizes retrace per grid signature, same as prefill buckets.
+
+Decoder-side M-RoPE positions (``mrope_positions``) replicate HF
+``get_rope_index`` for the images-only case: text tokens advance all
+three axes together; image spans pin t and sweep the (h, w) grid; the
+next text token resumes at max position + 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+
+Params = dict[str, Any]
+
+
+def vision_head_dim(cfg: TransformerConfig) -> int:
+    return cfg.vision_embed_dim // cfg.vision_num_heads
+
+
+def patch_dim(cfg: TransformerConfig) -> int:
+    return (
+        cfg.vision_in_channels
+        * cfg.vision_temporal_patch
+        * cfg.vision_patch_size
+        * cfg.vision_patch_size
+    )
+
+
+def init_qwen2vl_vision_params(
+    cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    e, d = cfg.vision_embed_dim, cfg.vision_depth
+    i = int(e * cfg.vision_mlp_ratio)
+    m2 = cfg.vision_spatial_merge**2
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "patch_proj": normal(next(keys), (patch_dim(cfg), e)),
+        "blocks": {
+            "ln1": jnp.ones((d, e), dtype),
+            "ln1_b": jnp.zeros((d, e), dtype),
+            "ln2": jnp.ones((d, e), dtype),
+            "ln2_b": jnp.zeros((d, e), dtype),
+            "wqkv": normal(next(keys), (d, e, 3 * e)),
+            "bqkv": jnp.zeros((d, 3 * e), dtype),
+            "wo": normal(next(keys), (d, e, e)),
+            "bo": jnp.zeros((d, e), dtype),
+            "fc1": normal(next(keys), (d, e, i)),
+            "b1": jnp.zeros((d, i), dtype),
+            "fc2": normal(next(keys), (d, i, e)),
+            "b2": jnp.zeros((d, e), dtype),
+        },
+        "merger_ln": jnp.ones((e,), dtype),
+        "merger_ln_b": jnp.zeros((e,), dtype),
+        "merger_fc1": normal(next(keys), (e * m2, e * m2)),
+        "merger_b1": jnp.zeros((e * m2,), dtype),
+        "merger_fc2": normal(next(keys), (e * m2, cfg.hidden_size)),
+        "merger_b2": jnp.zeros((cfg.hidden_size,), dtype),
+    }
+
+
+def _grid_hw_ids(cfg: TransformerConfig, grid_thw) -> np.ndarray:
+    """Per-patch (h, w) ids in the processor's merge-window patch order
+    (HF rot_pos_emb, modeling_qwen2_vl.py)."""
+    merge = cfg.vision_spatial_merge
+    out = []
+    for t, h, w in grid_thw:
+        hp = np.arange(h)[:, None].repeat(w, 1)
+        hp = hp.reshape(h // merge, merge, w // merge, merge)
+        hp = hp.transpose(0, 2, 1, 3).reshape(-1)
+        wp = np.arange(w)[None, :].repeat(h, 0)
+        wp = wp.reshape(h // merge, merge, w // merge, merge)
+        wp = wp.transpose(0, 2, 1, 3).reshape(-1)
+        out.append(np.tile(np.stack([hp, wp], -1), (t, 1)))
+    return np.concatenate(out, 0)  # [P, 2]
+
+
+def _layer_norm(x, w, b, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _act(name: str, x):
+    if name == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x, approximate=name != "gelu")
+    raise ValueError(f"unsupported vision activation {name!r}")
+
+
+def encode_images_qwen2vl(
+    vparams: Params,
+    cfg: TransformerConfig,
+    pixel_values: jnp.ndarray,  # [P, C*tps*ps*ps] HF-processor patch stream
+    grid_thw: Sequence[tuple[int, int, int]],  # static, one (t,h,w) per image
+) -> jnp.ndarray:
+    """-> [P / merge^2, hidden_size] rows for the placeholder positions."""
+    e = cfg.vision_embed_dim
+    nh = cfg.vision_num_heads
+    hd = vision_head_dim(cfg)
+    p = pixel_values.shape[0]
+    assert p == sum(t * h * w for t, h, w in grid_thw), (p, grid_thw)
+
+    x = pixel_values.astype(vparams["patch_proj"].dtype) @ vparams["patch_proj"]
+
+    # 2D rotary angles: (h, w) each over head_dim//4 freq channels
+    ids = _grid_hw_ids(cfg, grid_thw)  # [P, 2] static numpy
+    inv_freq = 1.0 / (
+        10000.0 ** (np.arange(0, hd // 2, 2, dtype=np.float32) / (hd // 2))
+    )
+    freqs = np.concatenate(
+        [ids[:, 0:1] * inv_freq[None], ids[:, 1:2] * inv_freq[None]], -1
+    )  # [P, hd/2]
+    cos = jnp.asarray(np.cos(freqs), jnp.float32)  # applied to duplicated halves
+    sin = jnp.asarray(np.sin(freqs), jnp.float32)
+
+    # block-diagonal full-attention mask per image (static)
+    seg = np.repeat(
+        np.arange(len(grid_thw)), [t * h * w for t, h, w in grid_thw]
+    )
+    mask = jnp.asarray(seg[:, None] == seg[None, :])
+
+    def rot(v):  # [P, NH, hd] rotate_half with per-patch 2D angles
+        v1, v2 = v[..., : hd // 2], v[..., hd // 2 :]
+        vf1, vf2 = v1.astype(jnp.float32), v2.astype(jnp.float32)
+        c = cos[:, None, :]
+        s = sin[:, None, :]
+        return jnp.concatenate(
+            [vf1 * c - vf2 * s, vf2 * c + vf1 * s], -1
+        ).astype(v.dtype)
+
+    def block(carry, bp):
+        h_in = carry
+        h = _layer_norm(h_in, bp["ln1"], bp["ln1_b"])
+        qkv = h @ bp["wqkv"] + bp["bqkv"]  # [P, 3E]
+        q, k, v = jnp.split(qkv, 3, -1)
+        q = rot(q.reshape(p, nh, hd))
+        k = rot(k.reshape(p, nh, hd))
+        v = v.reshape(p, nh, hd)
+        logits = jnp.einsum(
+            "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        logits = jnp.where(mask[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(v.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(p, e)
+        h_in = h_in + attn @ bp["wo"] + bp["bo"]
+        h = _layer_norm(h_in, bp["ln2"], bp["ln2_b"])
+        h_in = h_in + _act(cfg.vision_hidden_act, h @ bp["fc1"] + bp["b1"]) @ bp["fc2"] + bp["b2"]
+        return h_in, None
+
+    x, _ = jax.lax.scan(block, x, vparams["blocks"])
+
+    # PatchMerger: LN, then merge^2 consecutive patches -> MLP -> LLM hidden
+    m2 = cfg.vision_spatial_merge**2
+    x = _layer_norm(x, vparams["merger_ln"], vparams["merger_ln_b"])
+    x = x.reshape(p // m2, m2 * e)
+    x = jax.nn.gelu(x @ vparams["merger_fc1"] + vparams["merger_b1"],
+                    approximate=False)
+    return x @ vparams["merger_fc2"] + vparams["merger_b2"]
+
+
+def mrope_positions(
+    cfg: TransformerConfig,
+    input_ids: np.ndarray,  # [T] one unpadded sequence
+    grid_thw: Sequence[tuple[int, int, int]],
+) -> np.ndarray:
+    """[3, T] (t, h, w) decoder positions — HF get_rope_index, images-only.
+
+    Text tokens advance all three axes together; each image span (the
+    merged-placeholder run) pins t at the running index and sweeps the
+    (h/merge, w/merge) grid in raster order; the following text resumes at
+    max(position) + 1.
+    """
+    merge = cfg.vision_spatial_merge
+    ids = np.asarray(input_ids)
+    t_len = len(ids)
+    pos = np.zeros((3, t_len), np.int64)
+    img_starts = np.flatnonzero(ids == cfg.image_token_id)
+    # group consecutive placeholder runs into spans
+    spans: list[tuple[int, int]] = []
+    for i in img_starts:
+        if spans and i == spans[-1][1]:
+            spans[-1] = (spans[-1][0], i + 1)
+        else:
+            spans.append((i, i + 1))
+    cur = 0  # next position value
+    prev_end = 0
+    for (st, ed), (t, h, w) in zip(spans, grid_thw):
+        lh, lw = h // merge, w // merge
+        assert ed - st == t * lh * lw, (
+            f"placeholder run [{st},{ed}) != grid {t}x{lh}x{lw}"
+        )
+        n_text = st - prev_end
+        pos[:, prev_end:st] = cur + np.arange(n_text)
+        cur += n_text
+        tpos = np.repeat(np.arange(t), lh * lw)
+        hpos = np.tile(np.repeat(np.arange(lh), lw), t)
+        wpos = np.tile(np.tile(np.arange(lw), lh), t)
+        pos[0, st:ed] = cur + tpos
+        pos[1, st:ed] = cur + hpos
+        pos[2, st:ed] = cur + wpos
+        cur += int(max(t, lh, lw))
+        prev_end = ed
+    n_text = t_len - prev_end
+    pos[:, prev_end:] = cur + np.arange(n_text)
+    return pos

@@ -17,6 +17,35 @@ def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta**exponents)
 
 
+def apply_mrope(
+    x: jnp.ndarray,  # [T, H, D]
+    positions: jnp.ndarray,  # [3, T] (t, h, w) position streams
+    theta: float,
+    sections: tuple,  # (st, sh, sw), sum == D//2
+) -> jnp.ndarray:
+    """Qwen2-VL multimodal RoPE: the D/2 frequency channels are split into
+    (t, h, w) sections, each rotated by its own position stream (HF
+    apply_multimodal_rotary_pos_emb; for text-only positions the three
+    streams are equal and this reduces exactly to apply_rope)."""
+    d = x.shape[-1]
+    assert sum(sections) == d // 2, (sections, d)
+    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [3, T, D/2]
+    import numpy as _np
+
+    plane = _np.repeat(_np.arange(3), _np.asarray(sections))  # [D/2]
+    chan = _np.arange(d // 2)
+    sel = angles[plane, :, chan]  # [D/2, T]
+    angles_sel = jnp.transpose(sel)  # [T, D/2]
+    cos = jnp.cos(angles_sel)[..., None, :]
+    sin = jnp.sin(angles_sel)[..., None, :]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2 :].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1
+    ).astype(x.dtype)
+
+
 def apply_rope(
     x: jnp.ndarray, positions: jnp.ndarray, theta: float
 ) -> jnp.ndarray:

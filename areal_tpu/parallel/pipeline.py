@@ -182,6 +182,300 @@ def pipeline_hidden(
     )(params["layers"], embeds, positions, segment_ids)
 
 
+def pipeline_train_step_1f1b(
+    params: dict,
+    cfg: TransformerConfig,
+    mbs: dict,  # stacked [M, T, ...] microbatch dict (input_ids, positions,
+    #             segment_ids, loss_mask, ... — everything loss_fn reads)
+    mesh: Mesh,
+    token_loss_fn,  # TokenLossFn: .fn(logp [T], ent [T], mb_row) -> SUM loss,
+    #                 .temperature, .needs_entropy (engine fused-loss twin)
+    attn_spec: AttnSpec | None = None,
+    remat: bool = True,
+    remat_policy: str = "nothing_saveable",
+    acc_dtype=jnp.float32,
+) -> tuple[jnp.ndarray, dict]:
+    """One-forward-one-backward pipeline schedule: (losses [M], grads).
+
+    The TPU-native 1F1B (reference: realhf static_schedule.py:1F1B +
+    pipe_runner.py instruction schedules). Unlike ``forward_packed_pipelined``
+    (GPipe + AD, which stores O(M) stage activations through the reverse
+    scan), this HAND-ROLLS forward and backward into ONE ``lax.scan`` of
+    ``M + 2S - 1`` ticks where every tick runs one stage-forward AND one
+    stage-backward (steady state), so live activation memory is the O(S)
+    ring buffer of stage inputs — the whole point of 1F1B. Backward
+    recomputes the stage forward from its stored input (full remat inside
+    ``jax.vjp``).
+
+    Schedule (stage s, microbatch m): forward at tick ``m + s``, backward at
+    ``m + 2S - 1 - s``; messages ride one fwd ppermute and one bwd ppermute
+    per tick. The LM head + loss are NOT a serial last-stage epilogue: every
+    tick, the last stage's block output is psum-broadcast and each stage
+    runs the head for its own 1/S token slice down to per-token
+    (logp, entropy) — the [T, V] logits never leave a stage — then the tiny
+    [T, 2] vectors psum together and the token loss runs over the FULL
+    stream (so losses that roll labels/masks internally stay exact; this is
+    the chunked fused-LM-head-loss pattern with chunk == stage slice). Head
+    FLOPs stay distributed over the pp group, like the GPipe path's
+    out-of-pipeline token-parallel head. The embedding lookup folds into
+    stage 0 (its weight gradient accumulates via scatter-add on the carry),
+    so no O(M) cotangent stack exists anywhere.
+
+    Requires the fused-loss contract (``TokenLossFn``); critics and LoRA
+    engines use the GPipe path. T must divide S.
+    """
+    from areal_tpu.models.lm import (
+        _REMAT_POLICIES,
+        _block,
+        _norm,
+    )
+    from areal_tpu.utils.functional import (
+        gather_logprobs,
+        gather_logprobs_entropy,
+    )
+
+    s = pp_size(mesh)
+    m, t = mbs["input_ids"].shape
+    assert t % s == 0, (
+        f"1f1b shards the head over pp: tokens {t} must divide pp {s}"
+    )
+    tl = t // s
+    k = 2 * s  # stage-input ring slots (live range is 2S-1-2s ticks)
+    steps = m + 2 * s - 1
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+
+    if cfg.is_critic:
+        raise NotImplementedError("1f1b critics: use pp_schedule=gpipe")
+    tied = "lm_head" not in params
+    head_w = params["embed"].T if tied else params["lm_head"]
+    norm_b = params.get("final_norm_b")
+    if cfg.pos_embed_type == "learned":
+        raise NotImplementedError("1f1b with learned position embeddings")
+
+    def run_stage(layers_local, x, pos, seg):
+        def body(carry, lp):
+            return _block(cfg, lp, carry, pos, seg, inner_spec), None
+
+        if remat:
+            body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
+        y, _ = jax.lax.scan(body, x, layers_local)
+        return y
+
+    def stage_fn(layers_local, ids_all, pos_all, seg_all, mbs_rep, head_w_l,
+                 norm_w, norm_b_l, embed_w):
+        stage = jax.lax.axis_index(AXIS_PP)
+        is_first = stage == 0
+        is_last = stage == s - 1
+        lo = stage * tl  # this stage's head token slice
+        h = cfg.hidden_size
+        has_nb = norm_b_l is not None
+
+        def embed_rows(ids):
+            x = embed_w[ids]
+            if cfg.scale_embeddings:
+                x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+            return x
+
+        def tick(carry, tt):
+            (fwd_msg, bwd_msg, xbuf, dybuf, loss_vec, g_lay, g_emb, g_nw,
+             g_nb, g_hw) = carry
+
+            # ---- forward ----
+            mf = tt - stage
+            f_valid = (mf >= 0) & (mf < m)
+            mfc = jnp.clip(mf, 0, m - 1)
+            ids_f = jax.lax.dynamic_index_in_dim(ids_all, mfc, 0, False)
+            pos_f = jax.lax.dynamic_index_in_dim(pos_all, mfc, 0, False)
+            seg_f = jax.lax.dynamic_index_in_dim(seg_all, mfc, 0, False)
+            x_in = jnp.where(is_first, embed_rows(ids_f), fwd_msg)
+            # invalid ticks park their write in the scratch slot K
+            slot = jnp.where(f_valid, mfc % k, k)
+            xbuf = jax.lax.dynamic_update_index_in_dim(
+                xbuf, x_in, slot, 0
+            )
+            y = run_stage(layers_local, x_in, pos_f, seg_f)
+
+            # ---- head + loss for the LAST stage's current microbatch,
+            #      token-sliced across ALL stages ----
+            ml = tt - (s - 1)
+            l_valid = (ml >= 0) & (ml < m)
+            mlc = jnp.clip(ml, 0, m - 1)
+            y_last = jax.lax.psum(jnp.where(is_last, y, 0.0), AXIS_PP)
+            y_sl = jax.lax.dynamic_slice_in_dim(y_last, lo, tl, 0)
+
+            mb_row = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, mlc, 0, False),
+                mbs_rep,
+            )
+            labels_full = jnp.roll(
+                jax.lax.dynamic_index_in_dim(ids_all, mlc, 0, False), shift=-1
+            )
+            labels_sl = jax.lax.dynamic_slice_in_dim(labels_full, lo, tl, 0)
+
+            # head for THIS stage's token slice -> per-token (logp, entropy)
+            # only (no [T, V] logits ever cross stages); the token loss then
+            # runs over the psum-assembled FULL [T] vectors with the FULL
+            # microbatch row, so losses that roll labels/masks internally
+            # stay exact (the chunked fused-LM-head-loss pattern,
+            # models/lm.forward_fused_logp, with chunk == stage slice)
+            def head_q(y_, nw, nb, hw):
+                xn = _norm(cfg, y_, nw, nb)
+                logits = (xn @ hw).astype(jnp.float32)
+                if token_loss_fn.needs_entropy:
+                    logp, ent = gather_logprobs_entropy(
+                        logits, labels_sl, token_loss_fn.temperature
+                    )
+                else:
+                    logp = gather_logprobs(
+                        logits, labels_sl, token_loss_fn.temperature
+                    )
+                    ent = jnp.zeros_like(logp)
+                return jnp.stack([logp, ent], -1)  # [tl, 2]
+
+            if has_nb:
+                q_sl, pullq = jax.vjp(
+                    head_q, y_sl, norm_w, norm_b_l, head_w_l
+                )
+            else:
+                q_sl, pullq = jax.vjp(
+                    lambda y_, nw, hw: head_q(y_, nw, None, hw),
+                    y_sl, norm_w, head_w_l,
+                )
+            q_full = jax.lax.psum(
+                jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros((t, 2), jnp.float32), q_sl, lo, 0
+                ),
+                AXIS_PP,
+            )
+
+            def tok_loss(qf):
+                return token_loss_fn.fn(qf[:, 0], qf[:, 1], mb_row)
+
+            loss_part, pull_l = jax.vjp(tok_loss, q_full)
+            dq_full = pull_l(jnp.float32(1.0))[0]
+            dq_sl = jax.lax.dynamic_slice(dq_full, (lo, 0), (tl, 2))
+            if has_nb:
+                dy_sl, dnw, dnb, dhw = pullq(dq_sl)
+            else:
+                dy_sl, dnw, dhw = pullq(dq_sl)
+                dnb = None
+            zeros_t = jnp.zeros((t, h), jnp.float32)
+            dy_full = jax.lax.psum(
+                jax.lax.dynamic_update_slice_in_dim(
+                    zeros_t, dy_sl.astype(jnp.float32), lo, 0
+                ),
+                AXIS_PP,
+            )
+            # every stage computed the (cheap) full token loss redundantly;
+            # count it once — the end-of-scan psum over pp restores the total
+            loss_vec = loss_vec.at[mlc].add(
+                jnp.where(l_valid & is_first, loss_part, 0.0)
+            )
+            g_nw = g_nw + jnp.where(l_valid, dnw.astype(acc_dtype), 0.0)
+            if has_nb:
+                g_nb = g_nb + jnp.where(l_valid, dnb.astype(acc_dtype), 0.0)
+            g_hw = g_hw + jnp.where(l_valid, dhw.astype(acc_dtype), 0.0)
+            dyslot = jnp.where(l_valid, mlc % 2, 2)
+            dybuf = jax.lax.dynamic_update_index_in_dim(
+                dybuf, dy_full.astype(y.dtype), dyslot, 0
+            )
+
+            # ---- backward ----
+            mb_ = tt - (2 * s - 1 - stage)
+            b_valid = (mb_ >= 0) & (mb_ < m)
+            mbc = jnp.clip(mb_, 0, m - 1)
+            ids_b = jax.lax.dynamic_index_in_dim(ids_all, mbc, 0, False)
+            pos_b = jax.lax.dynamic_index_in_dim(pos_all, mbc, 0, False)
+            seg_b = jax.lax.dynamic_index_in_dim(seg_all, mbc, 0, False)
+            dy_in = jnp.where(
+                is_last,
+                jax.lax.dynamic_index_in_dim(dybuf, mbc % 2, 0, False),
+                bwd_msg,
+            )
+            x_st = jax.lax.dynamic_index_in_dim(xbuf, mbc % k, 0, False)
+            _, pull2 = jax.vjp(
+                lambda L, x: run_stage(L, x, pos_b, seg_b), layers_local, x_st
+            )
+            dlay, dx = pull2(dy_in)
+            g_lay = jax.tree.map(
+                lambda a, d: a + jnp.where(b_valid, d.astype(acc_dtype), 0.0),
+                g_lay, dlay,
+            )
+            demb_rows = jnp.where(
+                b_valid & is_first, dx.astype(acc_dtype), 0.0
+            )
+            if cfg.scale_embeddings:
+                demb_rows = demb_rows * (cfg.hidden_size**0.5)
+            g_emb = g_emb.at[ids_b].add(demb_rows)
+
+            # ---- messages for the next tick ----
+            fwd_nxt = jax.lax.ppermute(
+                y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
+            )
+            bwd_nxt = jax.lax.ppermute(
+                dx, AXIS_PP, [(i + 1, i) for i in range(s - 1)]
+            )
+            return (
+                fwd_nxt, bwd_nxt, xbuf, dybuf, loss_vec, g_lay, g_emb,
+                g_nw, g_nb, g_hw,
+            ), None
+
+        xdtype = embed_w.dtype
+        carry0 = (
+            jnp.zeros((t, h), xdtype),
+            jnp.zeros((t, h), xdtype),
+            jnp.zeros((k + 1, t, h), xdtype),
+            jnp.zeros((3, t, h), xdtype),
+            jnp.zeros((m,), jnp.float32),
+            jax.tree.map(
+                lambda a: jnp.zeros(a.shape, acc_dtype), layers_local
+            ),
+            jnp.zeros(embed_w.shape, acc_dtype),
+            jnp.zeros(norm_w.shape, acc_dtype),
+            jnp.zeros(norm_w.shape, acc_dtype),
+            jnp.zeros(head_w_l.shape, acc_dtype),
+        )
+        (
+            _, _, _, _, loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw
+        ) = jax.lax.scan(tick, carry0, jnp.arange(steps))[0]
+        # token-sliced / stage-local accumulators -> global sums (g_lay stays
+        # per-stage: it matches the pp-sharded layer stack)
+        loss_vec = jax.lax.psum(loss_vec, AXIS_PP)
+        g_emb = jax.lax.psum(g_emb, AXIS_PP)
+        g_nw = jax.lax.psum(g_nw, AXIS_PP)
+        g_nb = jax.lax.psum(g_nb, AXIS_PP)
+        g_hw = jax.lax.psum(g_hw, AXIS_PP)
+        return loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw
+
+    loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(
+            P(AXIS_PP), P(), P(), P(), P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), P(AXIS_PP), P(), P(), P(), P()),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(
+        params["layers"], mbs["input_ids"], mbs["positions"],
+        mbs["segment_ids"], mbs, head_w, params["final_norm"], norm_b,
+        params["embed"],
+    )
+
+    grads = {
+        "embed": g_emb,
+        "layers": g_lay,
+        "final_norm": g_nw,
+    }
+    if norm_b is not None:
+        grads["final_norm_b"] = g_nb
+    if tied:
+        grads["embed"] = grads["embed"] + g_hw.T
+    else:
+        grads["lm_head"] = g_hw
+    return loss_vec, grads
+
+
 def forward_packed_pipelined(
     params: dict,
     cfg: TransformerConfig,

@@ -300,6 +300,10 @@ class Normalization:
     std_level: str = "batch"  # "batch" | "group" | "none"
     group_size: int = 1
     eps: float = 1e-5
+    # RLOO-style leave-one-out mean: sample i's baseline is the mean over
+    # the OTHER members of its normalization scope (reference NormConfig)
+    mean_leave1out: bool = False
+    std_unbiased: bool = False  # Bessel (n-1) std
 
     def __call__(
         self, x: np.ndarray, mask: np.ndarray | None = None
@@ -313,8 +317,20 @@ class Normalization:
             cnt = m.sum(axis=axis, keepdims=keepdims)
             cnt = np.maximum(cnt, 1)
             mean = (values * m).sum(axis=axis, keepdims=keepdims) / cnt
-            var = (((values - mean) * m) ** 2).sum(axis=axis, keepdims=keepdims) / cnt
+            denom = np.maximum(cnt - 1, 1) if self.std_unbiased else cnt
+            var = (
+                (((values - mean) * m) ** 2).sum(axis=axis, keepdims=keepdims)
+                / denom
+            )
             return mean, var
+
+        def loo_mean(values, m, axis, keepdims):
+            """Per-element leave-one-out mean over ``axis``: the scope mean
+            with the element's own contribution removed."""
+            cnt = m.sum(axis=axis, keepdims=True)
+            tot = (values * m).sum(axis=axis, keepdims=True)
+            loo_cnt = np.maximum(cnt - m, 1)
+            return (tot - values * m) / loo_cnt
 
         if self.mean_level == "group" or self.std_level == "group":
             bs = x.shape[0]
@@ -323,10 +339,17 @@ class Normalization:
             gm = mask.reshape(g.shape)
             axes = tuple(range(1, g.ndim))
             gmean, gvar = masked_moments(g, gm, axis=axes, keepdims=True)
-            gmean = np.broadcast_to(gmean, g.shape).reshape(x.shape)
+            if self.mean_leave1out:
+                gmean = loo_mean(g, gm, axes, True).reshape(x.shape)
+            else:
+                gmean = np.broadcast_to(gmean, g.shape).reshape(x.shape)
             gstd = np.sqrt(np.broadcast_to(gvar, g.shape).reshape(x.shape))
         if self.mean_level == "batch" or self.std_level == "batch":
             bmean, bvar = masked_moments(x, mask)
+            if self.mean_leave1out:
+                bmean = loo_mean(
+                    x, mask, tuple(range(x.ndim)), True
+                ).reshape(x.shape)
             bstd = np.sqrt(bvar)
 
         if self.mean_level == "group":

@@ -57,12 +57,22 @@ class StatsLogger:
             try:
                 import wandb
 
+                wcfg = self.config.wandb
+                if wcfg.wandb_api_key:
+                    os.environ.setdefault("WANDB_API_KEY", wcfg.wandb_api_key)
+                if wcfg.wandb_base_url:
+                    os.environ.setdefault("WANDB_BASE_URL", wcfg.wandb_base_url)
+                name = wcfg.name or self.config.trial_name
                 wandb.init(
-                    mode=self.config.wandb.mode,
-                    project=self.config.wandb.project
-                    or self.config.experiment_name,
-                    entity=self.config.wandb.entity,
-                    name=self.config.wandb.name or self.config.trial_name,
+                    mode=wcfg.mode,
+                    project=wcfg.project or self.config.experiment_name,
+                    entity=wcfg.entity,
+                    name=name + (wcfg.id_suffix or ""),
+                    job_type=wcfg.job_type,
+                    group=wcfg.group,
+                    notes=wcfg.notes,
+                    tags=list(wcfg.tags) if wcfg.tags else None,
+                    config=wcfg.config,
                 )
                 self._wandb = wandb
             except Exception:

@@ -1,0 +1,101 @@
+"""Per-process driver for the 2-process PIPELINE-parallel train test
+(synchronized-batch multi-host pp: each host owns one pipeline stage and
+feeds the IDENTICAL batch).
+
+Usage: python pp_multihost_driver.py <coordinator> <nprocs> <pid> <outdir>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    coordinator, nprocs, pid, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coordinator, num_processes=nprocs, process_id=pid
+    )
+
+    import numpy as np
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+    from areal_tpu.models.config import tiny_config
+
+    cfg = TrainEngineConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-3),
+        # small cap -> several microbatches feed the pipeline
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=32),
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 16
+    eng = TPULMEngine(cfg)
+    eng.create_process_group(ParallelStrategy(pp=nprocs))
+    eng.initialize(None, None, model_config=tiny_config(num_hidden_layers=4), seed=7)
+    assert eng._pp_replicated_data
+
+    # IDENTICAL batch on every host (synchronized-batch contract)
+    rng = np.random.default_rng(0)
+    data = dict(
+        input_ids=rng.integers(1, 128, size=(6, 16)).astype(np.int32),
+        attention_mask=np.ones((6, 16), np.int32),
+        loss_mask=np.ones((6, 16), np.int32),
+    )
+    data["loss_mask"][:, 0] = 0
+    losses = [eng.train_lm(data)["loss"] for _ in range(3)]
+
+    # divergent batches must be rejected loudly
+    bad = dict(data)
+    if pid == 1:
+        bad = dict(data)
+        bad["input_ids"] = data["input_ids"] + 1
+    rejected = False
+    try:
+        eng.train_lm(bad)
+    except ValueError as e:
+        rejected = "IDENTICAL" in str(e)
+    if pid == 0:
+        with open(os.path.join(outdir, "pp_result.json"), "w") as f:
+            json.dump({"losses": losses, "rejected_divergent": rejected}, f)
+        np.save(
+            os.path.join(outdir, "pp_embed.npy"),
+            np.asarray(jax.device_get(
+                jax.experimental.multihost_utils.process_allgather(
+                    eng.params["embed"], tiled=True
+                )
+            ))[: 128],
+        )
+    else:
+        # all hosts join the allgather collective
+        import jax.experimental.multihost_utils as mh
+
+        mh.process_allgather(eng.params["embed"], tiled=True)
+        assert rejected
+    eng.destroy()
+
+
+if __name__ == "__main__":
+    import jax.experimental.multihost_utils  # noqa: F401
+
+    main()

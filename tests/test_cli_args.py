@@ -103,3 +103,84 @@ def test_cli_docs_generator_covers_all_configs():
             and not name.startswith("_")
         ):
             assert f"## {name}" in text, name
+
+
+# ---------------------------------------------------------------------------
+# Reference-YAML compatibility (round-2 verdict item 9: field-by-field audit
+# vs areal/api/cli_args.py — aliases map, dropped knobs warn, typos raise).
+# ---------------------------------------------------------------------------
+
+
+def test_reference_train_engine_keys_alias_and_ignore():
+    import warnings
+
+    from areal_tpu.api.cli_args import TrainEngineConfig, from_dict
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cfg = from_dict(TrainEngineConfig, {
+            "path": "/m",
+            # reference spellings:
+            "dtype": "float32",
+            "grad_reduce_dtype": "float32",
+            "gradient_checkpointing": False,
+            "use_lora": True,
+            "lora_rank": 16,
+            "lora_alpha": 32,
+            "target_modules": ["q_proj", "v_proj"],
+            "peft_type": "lora",
+            "disable_dropout": True,
+            "weight_update_mode": "disk",
+        })
+    assert cfg.backend.param_dtype == "float32"
+    assert cfg.backend.grad_acc_dtype == "float32"
+    assert cfg.backend.remat is False
+    assert cfg.lora is not None
+    assert cfg.lora.rank == 16 and cfg.lora.alpha == 32
+    assert tuple(cfg.lora.target_modules) == ("q_proj", "v_proj")
+    assert any("ignored on TPU" in str(x.message) for x in w)
+
+
+def test_reference_use_lora_false_disables_adapters():
+    from areal_tpu.api.cli_args import TrainEngineConfig, from_dict
+
+    cfg = from_dict(
+        TrainEngineConfig,
+        {"path": "/m", "use_lora": False, "lora_rank": 16, "lora_alpha": 32},
+    )
+    assert cfg.lora is None
+
+
+def test_reference_optimizer_and_sglang_sections():
+    from areal_tpu.api.cli_args import GRPOConfig, from_dict
+
+    cfg = from_dict(GRPOConfig, {
+        "experiment_name": "x", "trial_name": "t",
+        "actor": {"path": "/m", "optimizer": {
+            "lr": 1e-4,
+            "lr_scheduler_type": "cosine",
+            "offload": False,
+            "initial_loss_scale": 65536.0,  # fp16-only: ignored
+        }},
+        # the reference server section feeds our JAX server config
+        "sglang": {
+            "model_path": "/m",
+            "dtype": "float32",
+            "context_length": 2048,
+            "max_running_requests": 32,
+            "mem_fraction_static": 0.8,
+            "attention_backend": "fa3",  # no JAX counterpart: ignored
+        },
+    })
+    assert cfg.actor.optimizer.lr_scheduler.type == "cosine"
+    assert cfg.server.max_seq_len == 2048
+    assert cfg.server.max_batch_size == 32
+    assert cfg.server.hbm_utilization == 0.8
+    assert cfg.server.dtype == "float32"
+
+
+def test_unknown_keys_still_raise():
+    from areal_tpu.api.cli_args import TrainEngineConfig, from_dict
+
+    with pytest.raises(ValueError, match="Unknown config keys"):
+        from_dict(TrainEngineConfig, {"path": "/m", "not_a_real_knob": 1})

@@ -133,3 +133,23 @@ def test_kl_identical_is_zero():
     logp = np.log(np.array([0.5, 0.25]))
     for kind in ["k1", "k2", "k3"]:
         np.testing.assert_allclose(KLEstimator(kind)(logp, logp), 0.0, atol=1e-12)
+
+
+def test_normalization_leave_one_out_and_unbiased():
+    """RLOO leave-one-out baseline + Bessel std (reference NormConfig
+    mean_leave1out / std_unbiased)."""
+    from areal_tpu.utils.data import Normalization
+
+    x = np.asarray([1.0, 3.0, 2.0, 6.0], np.float32)
+    # group leave-one-out: each element's baseline is its group partner
+    n = Normalization(mean_level="group", std_level="none", group_size=2,
+                      mean_leave1out=True)
+    out = n(x)
+    np.testing.assert_allclose(out, [1 - 3, 3 - 1, 2 - 6, 6 - 2], rtol=1e-6)
+
+    # batch unbiased std: divide by n-1
+    n2 = Normalization(mean_level="batch", std_level="batch",
+                       std_unbiased=True, eps=0.0)
+    out2 = n2(x)
+    want = (x - x.mean()) / x.std(ddof=1)
+    np.testing.assert_allclose(out2, want, rtol=1e-6)

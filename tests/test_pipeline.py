@@ -293,3 +293,109 @@ def test_pipeline_critic_values_match_plain():
     )
     assert got.shape == want.shape == (2, ids.shape[1])
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (round-2 verdict item 7): the hand-rolled interleaved
+# fwd/bwd pipeline must reproduce the plain path's losses AND grads exactly.
+# ---------------------------------------------------------------------------
+
+
+def _tok_ce(logp, ent, mb):
+    # token-loss contract (fused-LM-head twin): mask rolls INTERNALLY on the
+    # full stream — exactly the convention that broke naive token slicing
+    lm = jnp.roll(mb["loss_mask"], shift=-1).astype(jnp.float32)
+    return -jnp.sum(logp * lm)
+
+
+@pytest.mark.parametrize("strategy,m", [
+    (ParallelStrategy(pp=4), 8),   # the verdict's d1t1p4 / M=8 case
+    (ParallelStrategy(pp=2), 3),   # M < 2S exercises fill/drain masking
+])
+def test_1f1b_matches_plain_losses_and_grads(strategy, m):
+    from areal_tpu.engine.train_engine import TokenLossFn
+    from areal_tpu.parallel.pipeline import pipeline_train_step_1f1b
+    from areal_tpu.utils.functional import gather_logprobs
+
+    tok = TokenLossFn(fn=_tok_ce)
+    cfg = tiny_config(num_hidden_layers=4)
+    mesh = make_mesh(strategy)
+    params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    ids, pos, seg = _mb_stack(m=m, t=16)
+    rng = np.random.default_rng(4)
+    lm_mask = jnp.asarray(
+        (rng.uniform(size=(m, 16)) > 0.25).astype(np.float32)
+    )
+    mbs = dict(input_ids=ids, positions=pos, segment_ids=seg,
+               loss_mask=lm_mask)
+
+    losses, grads = jax.jit(
+        lambda p, mb: pipeline_train_step_1f1b(
+            p, cfg, mb, mesh, tok, remat=True
+        )
+    )(params_pp, mbs)
+
+    # plain reference: per-mb losses + summed grads
+    def plain_loss(p):
+        tot = 0.0
+        per = []
+        for i in range(m):
+            lg = forward_packed(p, cfg, ids[i], pos[i], seg[i])
+            mb = {k: v[i] for k, v in mbs.items()}
+            logp = gather_logprobs(lg, jnp.roll(ids[i], shift=-1))
+            li = _tok_ce(logp, None, mb)
+            per.append(li)
+            tot = tot + li
+        return tot, jnp.stack(per)
+
+    (_, want_losses), want_grads = jax.jit(
+        jax.value_and_grad(plain_loss, has_aux=True)
+    )(params)
+
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(want_losses), rtol=2e-4, atol=2e-5
+    )
+    flat = dict(jax.tree_util.tree_leaves_with_path(want_grads))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat[path]),
+            rtol=2e-3, atol=2e-4, err_msg=str(path),
+        )
+
+
+@pytest.mark.slow
+def test_engine_train_batch_1f1b_matches_pp1():
+    eng_pp = None
+    eng_1 = None
+    try:
+        eng_1 = _make_engine(ParallelStrategy(dp=1), seed=11)
+        cfgo = _cfg()
+        cfgo.backend.pp_schedule = "1f1b"
+        eng_pp = TPULMEngine(cfgo)
+        eng_pp.create_process_group(ParallelStrategy(pp=4))
+        eng_pp.initialize(
+            None,
+            FinetuneSpec(
+                total_train_epochs=1, dataset_size=64, train_batch_size=6
+            ),
+            model_config=tiny_config(num_hidden_layers=4),
+            seed=11,
+        )
+        data = _batch()
+        for _ in range(2):
+            s1 = eng_1.train_lm(data)
+            sp = eng_pp.train_lm(data)
+        np.testing.assert_allclose(sp["loss"], s1["loss"], rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(eng_pp.params["embed"]),
+            np.asarray(eng_1.params["embed"]),
+            rtol=2e-3, atol=1e-5,
+        )
+    finally:
+        if eng_1 is not None:
+            eng_1.destroy()
+        if eng_pp is not None:
+            eng_pp.destroy()

@@ -211,3 +211,142 @@ def test_vlm_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-2, atol=1e-2
         )
+
+
+# ---------------------------------------------------------------------------
+# Real Qwen2-VL ingest (round-2 verdict item 4): load an actual HF Qwen2-VL
+# checkpoint (vision tower + merger + M-RoPE decoder) and match transformers'
+# logits exactly — like the text-family parity tests in test_model_numerics.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_qwen2vl(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    out = str(tmp_path_factory.mktemp("qwen2vl"))
+    vc = dict(
+        depth=2, embed_dim=16, num_heads=2, hidden_size=32, mlp_ratio=2.0,
+        patch_size=4, spatial_merge_size=2, temporal_patch_size=2,
+        in_channels=3,
+    )
+    cfg = Qwen2VLConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, vision_config=vc,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 1, 1]},
+        image_token_id=120, video_token_id=121,
+        vision_start_token_id=118, vision_end_token_id=119,
+        tie_word_embeddings=False, max_position_embeddings=512,
+    )
+    torch.manual_seed(0)
+    model = Qwen2VLForConditionalGeneration(cfg).eval().float()
+    model.save_pretrained(out)
+    return out, model
+
+
+def _vlm_inputs(seed=0):
+    """One prompt with a 16x16 image -> grid (1,4,4) -> 4 merged tokens."""
+    rng = np.random.default_rng(seed)
+    ids = [5, 9, 118] + [120] * 4 + [119, 7, 3, 11, 2]
+    # HF-processor patch stream: 16 patches x (3*2*4*4) flattened values
+    pixels = rng.normal(0, 1, size=(16, 96)).astype(np.float32)
+    grid = (1, 4, 4)
+    return np.asarray(ids, np.int32), pixels, grid
+
+
+def test_qwen2vl_logit_parity_with_hf(tiny_hf_qwen2vl):
+    torch = pytest.importorskip("torch")
+
+    model_dir, hf_model = tiny_hf_qwen2vl
+    ids, pixels, grid = _vlm_inputs()
+
+    with torch.no_grad():
+        hf_out = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long)[None],
+            pixel_values=torch.tensor(pixels),
+            image_grid_thw=torch.tensor([list(grid)]),
+        )
+    want = hf_out.logits[0].numpy()
+
+    from areal_tpu.models import hf_io
+    from areal_tpu.models.vlm_qwen2 import mrope_positions
+
+    cfg, params = hf_io.load_hf_params(model_dir, dtype="float32")
+    assert cfg.arch == "qwen2_vl" and cfg.mrope_section == (2, 1, 1)
+    positions = mrope_positions(cfg, ids, [grid])
+
+    # our positions must equal HF get_rope_index
+    hf_pos, _ = hf_model.model.get_rope_index(
+        input_ids=torch.tensor(ids, dtype=torch.long)[None],
+        image_grid_thw=torch.tensor([list(grid)]),
+    )
+    np.testing.assert_array_equal(positions, hf_pos[:, 0].numpy())
+
+    got = np.asarray(
+        forward_packed(
+            params,
+            cfg,
+            jnp.asarray(ids),
+            jnp.asarray(positions),
+            jnp.zeros(len(ids), jnp.int32),
+            pixel_values=jnp.asarray(pixels),
+            image_grid_thw=(grid,),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2vl_text_only_matches_hf(tiny_hf_qwen2vl):
+    """No image: M-RoPE must reduce to plain RoPE (1D positions path)."""
+    torch = pytest.importorskip("torch")
+
+    model_dir, hf_model = tiny_hf_qwen2vl
+    ids = np.asarray([5, 9, 7, 3, 11, 2, 14, 90], np.int32)
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long)[None]
+        ).logits[0].numpy()
+
+    from areal_tpu.models import hf_io
+
+    cfg, params = hf_io.load_hf_params(model_dir, dtype="float32")
+    got = np.asarray(
+        forward_packed(
+            params, cfg, jnp.asarray(ids),
+            jnp.arange(len(ids), dtype=jnp.int32),
+            jnp.zeros(len(ids), jnp.int32),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2vl_checkpoint_roundtrip(tiny_hf_qwen2vl, tmp_path):
+    """Our save -> transformers load -> identical logits (export parity)."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2VLForConditionalGeneration
+
+    model_dir, hf_model = tiny_hf_qwen2vl
+    from areal_tpu.models import hf_io
+
+    cfg, params = hf_io.load_hf_params(model_dir, dtype="float32")
+    out = str(tmp_path / "export")
+    hf_io.save_hf_params(params, cfg, out)
+
+    reloaded = Qwen2VLForConditionalGeneration.from_pretrained(
+        out, torch_dtype=torch.float32
+    ).eval()
+    ids, pixels, grid = _vlm_inputs(seed=3)
+    with torch.no_grad():
+        a = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long)[None],
+            pixel_values=torch.tensor(pixels),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits.numpy()
+        b = reloaded(
+            input_ids=torch.tensor(ids, dtype=torch.long)[None],
+            pixel_values=torch.tensor(pixels),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits.numpy()
+    np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-5)
