@@ -1,0 +1,282 @@
+"""Multi-benchmark offline evaluation harness.
+
+The breadth layer over :func:`areal_tpu.eval.offline.evaluate_checkpoint`
+— capability parity with the reference's evaluation suite
+(evaluation/eval_and_aggregate.py, math_eval.py, code_eval.py,
+data_loader.py): named benchmarks load from local jsonl (TPU pods run
+zero-egress, so file-backed datasets are the production path; the
+reference's HF-hub fallbacks have no role here), math tasks score through
+the in-repo math verifier and code tasks through the rlimit sandbox, and
+one aggregation pass emits accuracy / pass@k / maj@k per benchmark plus
+the cross-benchmark average the reference headlines.
+
+    python -m areal_tpu.eval.benchmarks --model-path CKPT \
+        --data-names math_500,aime24 --data-dir ./data \
+        --n-sampling 8 --output-path out/
+
+Benchmark jsonl rows:
+  math: {"question" | "problem" | "messages", "answer" | "solution"}
+  code: {"question" | "messages", "testcases": [{"input","output"}, ...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("eval.benchmarks")
+
+_PROMPT_TEMPLATES = {
+    # reference prompt_type flavors (math_eval.py PROMPT_TEMPLATES role)
+    "plain": "{question}",
+    "qwen-boxed": (
+        "{question}\n\nPlease reason step by step, and put your final "
+        "answer within \\boxed{{}}."
+    ),
+    "r1-distilled-qwen": (
+        "{question}\n\nPlease reason step by step, and put your final "
+        "answer within \\boxed{{}}."
+    ),
+    "code": (
+        "{question}\n\nWrite a Python program that reads from stdin and "
+        "writes the answer to stdout. Put it in one ```python code block."
+    ),
+}
+
+
+def load_benchmark(name: str, data_dir: str, split: str = "test") -> list[dict]:
+    """Rows of ``{data_dir}/{name}/{split}.jsonl`` (reference data_loader
+    layout)."""
+    path = os.path.join(data_dir, name, f"{split}.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"benchmark {name!r}: no {path} (zero-egress evaluation reads "
+            "local jsonl; fetch datasets onto the pod first)"
+        )
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def _to_messages(row: dict, template: str) -> list[dict]:
+    if "messages" in row:
+        return row["messages"]
+    q = row.get("question") or row.get("problem") or row.get("prompt")
+    assert q, f"benchmark row has no question/problem/prompt: {sorted(row)}"
+    return [{"role": "user", "content": template.format(question=q)}]
+
+
+def _task_of(row: dict) -> str:
+    return "code" if "testcases" in row else "math"
+
+
+def _math_reward(prompt, completion, prompt_ids, completion_ids, **row):
+    from areal_tpu.reward import math_verify_reward
+
+    answer = row.get("answer") or row.get("solution") or ""
+    return math_verify_reward(
+        prompt, completion, prompt_ids, completion_ids, answer=str(answer)
+    )
+
+
+def _code_reward(prompt, completion, prompt_ids, completion_ids, **row):
+    from areal_tpu.reward.sandbox import code_verify_reward
+
+    return float(
+        code_verify_reward(
+            prompt, completion, prompt_ids, completion_ids,
+            testcases=row["testcases"],
+        )
+        >= 1.0
+    )
+
+
+def maj_at_k(answers: list[str], scores: list[float], k: int) -> float:
+    """Majority-vote accuracy over the first k samples (reference
+    rm_maj_eval.group_pred role): the most common extracted answer wins;
+    correct iff any sample with that answer scored positive."""
+    votes = collections.Counter(a for a in answers[:k] if a)
+    if not votes:
+        return 0.0
+    top = votes.most_common(1)[0][0]
+    return float(
+        any(s > 0 for a, s in zip(answers[:k], scores[:k]) if a == top)
+    )
+
+
+def evaluate_benchmark(
+    model_path: str,
+    name: str,
+    rows: list[dict],
+    tokenizer=None,
+    prompt_type: str = "qwen-boxed",
+    n_sampling: int = 1,
+    gconfig: GenerationHyperparameters | None = None,
+    gen_config: JaxGenConfig | None = None,
+    engine=None,
+    output_path: str | None = None,
+) -> dict[str, float]:
+    """One benchmark end to end; returns its metric dict."""
+    from areal_tpu.eval.offline import evaluate_checkpoint
+    from areal_tpu.reward.math_parser import extract_answer
+
+    tasks = {_task_of(r) for r in rows}
+    if len(tasks) != 1:
+        raise ValueError(
+            f"benchmark {name!r} mixes tasks {sorted(tasks)}; split it into "
+            "homogeneous files (scoring and templates are per-benchmark)"
+        )
+    task = tasks.pop()
+    template = _PROMPT_TEMPLATES["code" if task == "code" else prompt_type]
+    msg_rows = []
+    for row in rows:
+        r = dict(row)
+        r["messages"] = _to_messages(row, template)
+        msg_rows.append(r)
+    reward_fn = _code_reward if task == "code" else _math_reward
+
+    # reuse the per-checkpoint engine + collect raw scores via output file
+    scores_path = (
+        os.path.join(output_path, f"{name}.json") if output_path else None
+    )
+    metrics = evaluate_checkpoint(
+        model_path,
+        msg_rows,
+        reward_fn,
+        tokenizer=tokenizer,
+        gconfig=gconfig,
+        gen_config=gen_config,
+        n_samples=n_sampling,
+        ks=tuple(
+            k for k in (1, 4, 8, 16, 32) if k <= n_sampling
+        ) or (1,),
+        output_path=scores_path,
+        engine=engine,
+        return_completions=True,
+    )
+    completions = metrics.pop("_completions", None)
+    scores = metrics.pop("_scores", None)
+    if task == "math" and completions is not None and n_sampling > 1:
+        for k in (4, 8, 16, 32):
+            if k <= n_sampling:
+                metrics[f"maj@{k}"] = float(
+                    np.mean(
+                        [
+                            maj_at_k(
+                                [extract_answer(c) or "" for c in comps],
+                                scs,
+                                k,
+                            )
+                            for comps, scs in zip(completions, scores)
+                        ]
+                    )
+                )
+    metrics["benchmark"] = name
+    metrics["task"] = task  # type: ignore[assignment]
+    return metrics
+
+
+def eval_and_aggregate(
+    model_path: str,
+    data_names: list[str],
+    data_dir: str,
+    prompt_type: str = "qwen-boxed",
+    n_sampling: int = 1,
+    max_gen_tokens: int = 1024,
+    temperature: float = 0.6,
+    top_p: float = 0.95,
+    output_path: str | None = None,
+    gen_config: JaxGenConfig | None = None,
+    tokenizer=None,
+    engine=None,
+    split: str = "test",
+) -> dict[str, Any]:
+    """Reference eval_and_aggregate.py role: run every named benchmark on
+    one checkpoint through ONE generation engine, aggregate, write
+    result.json."""
+    from areal_tpu.inference.engine import GenerationEngine
+
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_path)
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=max_gen_tokens,
+        temperature=temperature,
+        top_p=top_p,
+        greedy=n_sampling == 1,
+    )
+    own_engine = engine is None
+    if own_engine:
+        gc = gen_config or JaxGenConfig()
+        gc.model_path = model_path
+        engine = GenerationEngine(gc, tokenizer=tokenizer)
+        engine.start()
+    per_bench = {}
+    try:
+        for name in data_names:
+            rows = load_benchmark(name, data_dir, split=split)
+            per_bench[name] = evaluate_benchmark(
+                model_path, name, rows,
+                tokenizer=tokenizer,
+                prompt_type=prompt_type,
+                n_sampling=n_sampling,
+                gconfig=gconfig,
+                engine=engine,
+                output_path=output_path,
+            )
+    finally:
+        if own_engine:
+            engine.stop()
+    result = {
+        "model_path": model_path,
+        "benchmarks": per_bench,
+        "average_accuracy": float(
+            np.mean([m["accuracy"] for m in per_bench.values()])
+        ),
+    }
+    if output_path:
+        os.makedirs(output_path, exist_ok=True)
+        with open(os.path.join(output_path, "result.json"), "w") as f:
+            json.dump(result, f, indent=2)
+    logger.info("aggregate over %s: %s", data_names, result["average_accuracy"])
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--data-names", required=True,
+                   type=lambda x: [s for s in x.split(",") if s])
+    p.add_argument("--data-dir", default="./data")
+    p.add_argument("--split", default="test")
+    p.add_argument("--prompt-type", default="qwen-boxed")
+    p.add_argument("--n-sampling", type=int, default=1)
+    p.add_argument("--max-gen-tokens", type=int, default=1024)
+    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--top-p", type=float, default=0.95)
+    p.add_argument("--output-path", default=None)
+    args = p.parse_args(argv)
+    res = eval_and_aggregate(
+        args.model_path, args.data_names, args.data_dir,
+        prompt_type=args.prompt_type, n_sampling=args.n_sampling,
+        max_gen_tokens=args.max_gen_tokens, temperature=args.temperature,
+        top_p=args.top_p, output_path=args.output_path, split=args.split,
+    )
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,13 +39,16 @@ def evaluate_checkpoint(
     ks: tuple[int, ...] = (1,),
     output_path: str | None = None,
     engine=None,
+    return_completions: bool = False,
 ) -> dict[str, float]:
     """Generate ``n_samples`` completions per row, score each with
     ``reward_fn(prompt, completion, prompt_ids, completion_ids, **row)``,
     return {"accuracy", "pass@k"...}.
 
     ``engine`` may be a pre-built GenerationEngine (tests); otherwise one is
-    built from ``model_path``.
+    built from ``model_path``. ``return_completions`` adds the raw decoded
+    completions + per-sample scores under "_completions"/"_scores" (the
+    benchmark harness computes maj@k from them — eval/benchmarks.py).
     """
     import threading
 
@@ -89,11 +92,14 @@ def evaluate_checkpoint(
                 engine.submit(f"eval-{i}-{s}", list(ids), gconfig, cb_for(i))
         done.wait()
 
+        completions: list[list[str]] = []
         for i, row in enumerate(rows):
             extra = {k: v for k, v in row.items() if k != "messages"}
             scores = []
+            comps = []
             for resp in out[i]:
                 completion = tokenizer.decode(resp.output_tokens)
+                comps.append(completion)
                 scores.append(
                     float(
                         reward_fn(
@@ -103,6 +109,7 @@ def evaluate_checkpoint(
                     )
                 )
             results.append(scores)
+            completions.append(comps)
     finally:
         if own_engine:
             engine.stop()
@@ -123,4 +130,7 @@ def evaluate_checkpoint(
         with open(output_path, "w") as f:
             json.dump({"metrics": metrics, "scores": results}, f)
     logger.info("eval %s: %s", model_path, metrics)
+    if return_completions:
+        metrics["_completions"] = completions  # type: ignore[assignment]
+        metrics["_scores"] = results  # type: ignore[assignment]
     return metrics

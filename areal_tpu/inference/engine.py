@@ -74,7 +74,9 @@ class _Seq:
     t_last_token: float | None = None
     itl: list[float] = dataclasses.field(default_factory=list)
     aborted: bool = False
-    images: list | None = None  # decoded [S, S, 3] float arrays
+    images: list | None = None  # decoded [S, S, 3] float arrays, or for
+    # qwen2_vl: HF-processor patch arrays [P_i, C*tps*ps*ps]
+    grids: list | None = None  # qwen2_vl (t, h, w) per image
 
     @property
     def max_total(self) -> int:
@@ -167,6 +169,10 @@ class GenerationEngine:
         self.cache_len = np.zeros(b, np.int32)
         self.slots: list[_Seq | None] = [None] * b
         self.last_token = np.zeros(b, np.int32)
+        # qwen2_vl M-RoPE decode delta per slot: rope position = cache_len +
+        # delta (image placeholder runs occupy fewer rope positions than
+        # cache rows; 0 for text / non-mrope models)
+        self.pos_delta = np.zeros(b, np.int32)
         self.version = 0
 
         # control plane
@@ -238,6 +244,9 @@ class GenerationEngine:
         )
         self._jit_copy_kv = jax.jit(self._copy_kv_impl, donate_argnums=(0,))
         self._jit_extend = jax.jit(self._extend_impl, donate_argnums=(1,))
+        # qwen2_vl prefill retraces per (grid signature, bucket) — the image
+        # grid is a static shape input like prefill buckets
+        self._jit_cache_vlm: dict = {}
 
     @staticmethod
     def _copy_kv_impl(cache, src, dst, n):
@@ -270,11 +279,14 @@ class GenerationEngine:
         top_k,
         top_p,
         greedy,
-        pixels=None,  # [Nimg, S, S, 3] for VLM prompts (N == 1 only)
+        pixels=None,  # [Nimg, S, S, 3] (mini) / [P, pd] (qwen2_vl), N == 1
+        positions3=None,  # [3, N*Tp] qwen2_vl M-RoPE positions
+        image_grid_thw=None,  # static (jit-partial-bound) qwen2_vl grids
     ):
         logits, ks, vs = prefill_many(
             params, self.model_config, ids, lengths, attn_spec=self.attn_spec,
-            pixel_values=pixels,
+            pixel_values=pixels, positions3=positions3,
+            image_grid_thw=image_grid_thw,
         )
         toks, logps = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
         # write each prompt's [L, Tp, KH, D] rows into its slot's cache
@@ -348,13 +360,14 @@ class GenerationEngine:
         top_k,
         top_p,
         greedy,
+        pos_delta,  # [B] qwen2_vl M-RoPE decode offsets (zeros otherwise)
         steps: int,
     ):
         def step(carry, step_rng):
             tokens, cache, clen = carry
             logits, cache = decode_step(
                 params, self.model_config, cache, tokens[:, None], clen,
-                attn_spec=self.attn_spec,
+                attn_spec=self.attn_spec, pos_offset=pos_delta,
             )
             nxt, logp = sample_tokens(
                 logits[:, 0], step_rng, temp, top_k, top_p, greedy
@@ -451,36 +464,64 @@ class GenerationEngine:
             on_done(resp)
             return
         images = None
+        grids = None
         if image_data:
-            from areal_tpu.utils.image import decode_image
-
             if not self.model_config.is_vlm:
                 raise ValueError("model has no vision encoder but got images")
-            images = [
-                decode_image(x) if isinstance(x, str) else np.asarray(x)
-                for x in image_data
-            ]
-            size = self.model_config.vision_image_size
-            for img in images:
-                if tuple(img.shape) != (size, size, 3):
-                    # validate HERE (caller thread): a malformed image must
-                    # not detonate inside the shared engine loop
-                    raise ValueError(
-                        f"image shape {tuple(img.shape)} != ({size}, {size}, 3)"
-                    )
-            expected = len(images) * self.model_config.vision_patches
             got = sum(
                 1 for t in input_ids if t == self.model_config.image_token_id
             )
+            if self.model_config.vision_arch == "qwen2_vl":
+                # HF-processor payloads: {"pixel_values": [P_i, pd],
+                # "grid_thw": [t, h, w]} per image
+                images, grids = [], []
+                pd = None
+                for item in image_data:
+                    if not isinstance(item, dict) or "grid_thw" not in item:
+                        raise ValueError(
+                            "qwen2_vl images need {'pixel_values', "
+                            "'grid_thw'} payloads"
+                        )
+                    arr = np.asarray(item["pixel_values"], np.float32)
+                    grid = tuple(int(v) for v in item["grid_thw"])
+                    from areal_tpu.models.vlm_qwen2 import patch_dim
+
+                    pd = patch_dim(self.model_config)
+                    t, h, w = grid
+                    if arr.ndim != 2 or arr.shape != (t * h * w, pd):
+                        raise ValueError(
+                            f"pixel_values shape {arr.shape} != "
+                            f"({t * h * w}, {pd}) for grid {grid}"
+                        )
+                    images.append(arr)
+                    grids.append(grid)
+                merge2 = self.model_config.vision_spatial_merge**2
+                expected = sum(t * h * w // merge2 for t, h, w in grids)
+            else:
+                from areal_tpu.utils.image import decode_image
+
+                images = [
+                    decode_image(x) if isinstance(x, str) else np.asarray(x)
+                    for x in image_data
+                ]
+                size = self.model_config.vision_image_size
+                for img in images:
+                    if tuple(img.shape) != (size, size, 3):
+                        # validate HERE (caller thread): a malformed image
+                        # must not detonate inside the shared engine loop
+                        raise ValueError(
+                            f"image shape {tuple(img.shape)} != "
+                            f"({size}, {size}, 3)"
+                        )
+                expected = len(images) * self.model_config.vision_patches
             if got != expected:
                 raise ValueError(
                     f"prompt carries {got} image placeholder tokens but "
-                    f"{len(images)} images x {self.model_config.vision_patches} "
-                    f"patches = {expected} are required"
+                    f"the supplied images need {expected}"
                 )
         seq = _Seq(
             rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done,
-            images=images,
+            images=images, grids=grids,
         )
         self._input_queue.put(seq)
         self._wake.set()
@@ -967,6 +1008,7 @@ class GenerationEngine:
         self.slots[dst] = seq
         self.cache_len[dst] = n - 1
         self.last_token[dst] = seq.prompt[-1]
+        self.pos_delta[dst] = 0  # clone/extension sources are text-only
         self._slot_covered[dst] = list(prefix)
         return True
 
@@ -1016,9 +1058,50 @@ class GenerationEngine:
         )
         if any(s.images for s in seqs):
             assert len(seqs) == 1, "image prompts prefill alone"
-            pixels = jnp.asarray(np.stack(seqs[0].images), jnp.float32)
-            toks, logps, self.cache = self._jit_prefill(*args, pixels)
+            seq0 = seqs[0]
+            if self.model_config.vision_arch == "qwen2_vl":
+                from areal_tpu.models.vlm_qwen2 import mrope_positions
+
+                pixels = jnp.asarray(
+                    np.concatenate(seq0.images, 0), jnp.float32
+                )
+                grids = tuple(seq0.grids)
+                pos3 = mrope_positions(
+                    self.model_config, np.asarray(seq0.prompt), grids
+                )
+                # bucket padding continues the text positions
+                pad = bucket - pos3.shape[1]
+                if pad > 0:
+                    tail = pos3[:, -1:] + np.arange(1, pad + 1)
+                    pos3 = np.concatenate([pos3, tail], 1)
+                self.pos_delta[slots[0]] = int(
+                    pos3[:, : len(seq0.prompt)].max() + 1 - len(seq0.prompt)
+                )
+                key = ("prefill_vlm", grids, bucket)
+                if key not in self._jit_cache_vlm:
+                    # grids are unbounded user input (native-resolution
+                    # images): bound the per-signature executable cache so
+                    # a long-lived server can't grow memory monotonically
+                    if len(self._jit_cache_vlm) >= 16:
+                        oldest = next(iter(self._jit_cache_vlm))
+                        self._jit_cache_vlm.pop(oldest)
+                    self._jit_cache_vlm[key] = jax.jit(
+                        functools.partial(
+                            self._prefill_impl, image_grid_thw=grids
+                        ),
+                        donate_argnums=(1,),
+                    )
+                else:
+                    self._jit_cache_vlm[key] = self._jit_cache_vlm.pop(key)
+                toks, logps, self.cache = self._jit_cache_vlm[key](
+                    *args, pixels, jnp.asarray(pos3.astype(np.int32)),
+                )
+            else:
+                pixels = jnp.asarray(np.stack(seq0.images), jnp.float32)
+                toks, logps, self.cache = self._jit_prefill(*args, pixels)
         else:
+            for slot in slots:
+                self.pos_delta[slot] = 0
             toks, logps, self.cache = self._jit_prefill(*args)
         now = time.monotonic()
         toks = np.asarray(toks)
@@ -1106,6 +1189,7 @@ class GenerationEngine:
             jnp.asarray(top_k),
             jnp.asarray(top_p),
             jnp.asarray(greedy),
+            jnp.asarray(self.pos_delta),
             steps=steps,
         )
         toks = np.asarray(toks)  # [steps, B]

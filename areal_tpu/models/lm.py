@@ -545,6 +545,8 @@ def prefill_many(
     lengths: jnp.ndarray,  # [N] int32, true prompt lengths
     attn_spec: AttnSpec | None = None,
     pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3]
+    positions3: jnp.ndarray | None = None,  # [3, N*Tp] qwen2_vl M-RoPE
+    image_grid_thw: tuple | None = None,  # qwen2_vl static grids
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched prompt pass: N prompts pack into ONE [N*Tp] segment-id stream
     (the framework's native representation — attention block-skipping keeps
@@ -552,6 +554,8 @@ def prefill_many(
     costs one device dispatch instead of N.
 
     Returns (last_logits [N, V] fp32, k [L, N, Tp, KH, D], v likewise).
+    ``positions3`` carries per-token (t, h, w) M-RoPE streams for qwen2_vl
+    prompts (vlm_qwen2.mrope_positions per row, offset-free per slot).
     """
     n, tp = input_ids.shape
     pos2d = jnp.broadcast_to(jnp.arange(tp, dtype=jnp.int32), (n, tp))
@@ -562,20 +566,31 @@ def prefill_many(
     )
     positions = pos2d.reshape(-1)
     segment_ids = seg2d.reshape(-1)
+    rope_pos = positions3 if positions3 is not None else positions
     flat = input_ids.reshape(-1)
     x = _embed(params, cfg, flat, positions)
     if pixel_values is not None:
-        from areal_tpu.models.vlm import encode_images, splice_image_embeds
+        from areal_tpu.models.vlm import splice_image_embeds
 
-        embeds = encode_images(params["vision"], cfg, pixel_values)
+        if cfg.vision_arch == "qwen2_vl":
+            from areal_tpu.models.vlm_qwen2 import encode_images_qwen2vl
+
+            assert image_grid_thw is not None
+            embeds = encode_images_qwen2vl(
+                params["vision"], cfg, pixel_values, image_grid_thw
+            )[None]
+        else:
+            from areal_tpu.models.vlm import encode_images
+
+            embeds = encode_images(params["vision"], cfg, pixel_values)
         x = splice_image_embeds(cfg, x, flat, embeds)
 
     def body(carry, lp):
         h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
         q, k, v = _qkv(cfg, lp, h)
         if cfg.pos_embed_type == "rope":
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            q = _rope(cfg, q, rope_pos)
+            k = _rope(cfg, k, rope_pos)
         attn = packed_attention(
             q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
         )
@@ -609,15 +624,22 @@ def decode_step(
     cache_len: jnp.ndarray,  # [B] valid tokens per slot BEFORE this call
     attn_spec: AttnSpec | None = None,
     compute_logits: bool = True,
+    pos_offset: jnp.ndarray | None = None,  # [B] rope-position shift
 ) -> tuple[jnp.ndarray | None, Params]:
     """Run Tq tokens per slot against the cache.
 
-    Positions of the new tokens are cache_len + [0..Tq). Returns
+    Positions of the new tokens are cache_len + pos_offset + [0..Tq)
+    (``pos_offset`` is the qwen2_vl M-RoPE delta: image placeholder runs
+    occupy fewer rope positions than cache rows, and text continuation
+    advances all three axes together — so decode is plain 1D rope at the
+    shifted position; HF mrope_position_deltas). Returns
     (logits [B, Tq, V] fp32, updated cache). Slots with fewer than Tq real new
     tokens should mask results host-side; the cache write is dense per slot.
     """
     b, tq = input_ids.shape
     positions = cache_len[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
+    if pos_offset is not None:
+        positions = positions + pos_offset[:, None]
     x = _embed(params, cfg, input_ids, positions)  # [B, Tq, H]
 
     def body(carry, layer_in):

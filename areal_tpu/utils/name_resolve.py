@@ -180,15 +180,41 @@ class NfsNameRecordRepository(NameRecordRepository):
                 f.write(str(value))
             os.replace(tmp, path)
         else:
-            # atomic exclusive create: the existence check + write must be
-            # one op or two processes can both think they won (the
-            # DistributedLock acquire path rides this)
-            try:
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
-                raise NameEntryExistsError(name) from None
-            with os.fdopen(fd, "w") as f:
+            # atomic exclusive create WITH atomic content visibility: write
+            # the value to a private tmp file first, then hardlink it into
+            # place (the classic NFS-safe technique). O_CREAT|O_EXCL + write
+            # would expose an EMPTY entry between the two ops — a concurrent
+            # wait()/get() read "" instead of the value (observed flake:
+            # test_wait_concurrent[nfs]). link() both fails on an existing
+            # entry (the DistributedLock acquire contract) and publishes the
+            # fully-written file in one op.
+            tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+            with open(tmp, "w") as f:
                 f.write(str(value))
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                # NFS retransmit caveat: the LINK RPC may succeed but its
+                # reply get lost; the kernel retry then sees EEXIST for OUR
+                # OWN entry. st_nlink == 2 on tmp proves the link landed.
+                if os.stat(tmp).st_nlink == 2:
+                    os.unlink(tmp)
+                else:
+                    os.unlink(tmp)
+                    raise NameEntryExistsError(name) from None
+            except OSError:
+                # filesystem without hardlinks (gcsfuse/FUSE): fall back to
+                # exclusive create + write — atomic existence, weaker
+                # content visibility (a concurrent get may briefly see "")
+                os.unlink(tmp)
+                try:
+                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    raise NameEntryExistsError(name) from None
+                with os.fdopen(fd, "w") as f:
+                    f.write(str(value))
+            else:
+                os.unlink(tmp)
         if delete_on_exit:
             self._to_delete.add(name)
 

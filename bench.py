@@ -564,6 +564,13 @@ def main():
 
 
 def _child_main():
+    # honor AREAL_PLATFORM (tests drive the children on CPU; the default
+    # env-var-only JAX_PLATFORMS is NOT enough on this image — the TPU
+    # plugin is force-registered by sitecustomize and backend init would
+    # fight the tunnel for minutes)
+    from areal_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
     kind = sys.argv[1]
     att = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
     if kind == "--probe-child":

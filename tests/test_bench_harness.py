@@ -34,7 +34,10 @@ def test_bench_probe_child_parses_on_cpu(tmp_path):
     import os
 
     env = dict(os.environ)
+    # AREAL_PLATFORM drives jax.config.update in the child — env-var-only
+    # JAX_PLATFORMS doesn't defeat the force-registered TPU plugin
     env["JAX_PLATFORMS"] = "cpu"
+    env["AREAL_PLATFORM"] = "cpu"
     r = subprocess.run(
         [sys.executable, "bench.py", "--probe-child", "{}"],
         capture_output=True, text=True, timeout=300, cwd="/root/repo",
