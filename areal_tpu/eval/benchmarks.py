@@ -85,7 +85,10 @@ def _task_of(row: dict) -> str:
 def _math_reward(prompt, completion, prompt_ids, completion_ids, **row):
     from areal_tpu.reward import math_verify_reward
 
-    answer = row.get("answer") or row.get("solution") or ""
+    # explicit None checks: a numeric answer 0 is falsy but valid (AIME-style)
+    answer = row.get("answer")
+    if answer is None:
+        answer = row.get("solution", "")
     return math_verify_reward(
         prompt, completion, prompt_ids, completion_ids, answer=str(answer)
     )
@@ -169,17 +172,16 @@ def evaluate_benchmark(
     completions = metrics.pop("_completions", None)
     scores = metrics.pop("_scores", None)
     if task == "math" and completions is not None and n_sampling > 1:
+        extracted = [
+            [extract_answer(c) or "" for c in comps] for comps in completions
+        ]
         for k in (4, 8, 16, 32):
             if k <= n_sampling:
                 metrics[f"maj@{k}"] = float(
                     np.mean(
                         [
-                            maj_at_k(
-                                [extract_answer(c) or "" for c in comps],
-                                scs,
-                                k,
-                            )
-                            for comps, scs in zip(completions, scores)
+                            maj_at_k(ans, scs, k)
+                            for ans, scs in zip(extracted, scores)
                         ]
                     )
                 )

@@ -125,6 +125,21 @@ class GenerationEngine:
                 f"max_seq_len={config.max_seq_len} exceeds the learned "
                 f"position table ({model_config.max_position_embeddings})"
             )
+        if (
+            model_config.rope_scaling_type == "dynamic"
+            and config.max_seq_len > model_config.max_position_embeddings
+        ):
+            # dynamic NTK matches HF exactly only INSIDE the trained window
+            # (beyond it HF re-stretches the base per sequence length, which
+            # a static compiled schedule cannot) — serving past the window
+            # would silently diverge
+            raise ValueError(
+                f"max_seq_len={config.max_seq_len} exceeds "
+                f"max_position_embeddings "
+                f"({model_config.max_position_embeddings}) on a dynamic-NTK "
+                "rope model; extension beyond the trained window is not "
+                "supported"
+            )
 
         # per-engine attention dispatch (no process-global state): under TP,
         # prefill keeps the Pallas flash kernel with heads sharded over the

@@ -24,6 +24,15 @@ class TransformerConfig:
     num_key_value_heads: int
     head_dim: int
     rope_theta: float = 10000.0
+    # HF rope_scaling support (long-context checkpoints; llama-3.x ships
+    # "llama3" by default). "" = plain RoPE. "dynamic" NTK is computed at
+    # the max_position_embeddings bound — exactly HF's value for any
+    # sequence within the trained window (HF clamps seq_len up to it).
+    rope_scaling_type: str = ""  # "" | "linear" | "dynamic" | "llama3"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 0
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: True for qkv
@@ -234,6 +243,16 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
     num_experts = hf.get("num_experts") or hf.get("num_local_experts") or 0
+    rs = hf.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type") or rs.get("type") or ""
+    if rs_type in ("default", ""):
+        rs_type = ""
+    elif rs_type not in ("linear", "dynamic", "llama3"):
+        # loading with silently-wrong rope would corrupt every activation
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r} "
+            "(supported: linear, dynamic, llama3)"
+        )
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -243,6 +262,13 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
         num_key_value_heads=hf.get("num_key_value_heads", n_heads),
         head_dim=head_dim,
         rope_theta=hf.get("rope_theta", 10000.0),
+        rope_scaling_type=rs_type,
+        rope_scaling_factor=float(rs.get("factor", 1.0)),
+        rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        rope_original_max_position=int(
+            rs.get("original_max_position_embeddings", 0)
+        ),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
         # gemma ties by default and its config.json may omit the field
         tie_word_embeddings=hf.get("tie_word_embeddings", arch == "gemma"),
@@ -344,6 +370,18 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
         "model_type": cfg.arch,
         "attention_bias": cfg.attention_bias,
     }
+    if cfg.rope_scaling_type:
+        rs: dict = {
+            "rope_type": cfg.rope_scaling_type,
+            "factor": cfg.rope_scaling_factor,
+        }
+        if cfg.rope_scaling_type == "llama3":
+            rs.update(
+                low_freq_factor=cfg.rope_low_freq_factor,
+                high_freq_factor=cfg.rope_high_freq_factor,
+                original_max_position_embeddings=cfg.rope_original_max_position,
+            )
+        out["rope_scaling"] = rs
     if cfg.sliding_window > 0:
         out["sliding_window"] = cfg.sliding_window
         if cfg.arch == "llama":

@@ -299,15 +299,37 @@ def _moe_mlp(
     return jnp.einsum("eth,te->th", y, weights.astype(y.dtype))
 
 
+@functools.lru_cache(maxsize=32)
+def _rope_inv_freq(cfg: TransformerConfig):
+    """Per-config inv_freq, honoring HF rope_scaling (None = plain rope)."""
+    if not cfg.rope_scaling_type:
+        return None
+    from areal_tpu.ops.rotary import scaled_rope_frequencies
+
+    return scaled_rope_frequencies(
+        cfg.head_dim,
+        cfg.rope_theta,
+        cfg.rope_scaling_type,
+        factor=cfg.rope_scaling_factor,
+        low_freq_factor=cfg.rope_low_freq_factor,
+        high_freq_factor=cfg.rope_high_freq_factor,
+        original_max_position=cfg.rope_original_max_position,
+        max_position=cfg.max_position_embeddings,
+    )
+
+
 def _rope(cfg: TransformerConfig, v: jnp.ndarray, positions: jnp.ndarray):
-    """1D RoPE, or Qwen2-VL M-RoPE when positions carry (t, h, w) streams
-    ([3, T]); 1D positions under an mrope config are the text-only case and
-    remain exact (all three streams equal)."""
+    """1D RoPE (with any HF rope scaling), or Qwen2-VL M-RoPE when positions
+    carry (t, h, w) streams ([3, T]); 1D positions under an mrope config are
+    the text-only case and remain exact (all three streams equal)."""
+    inv_freq = _rope_inv_freq(cfg)
     if cfg.mrope_section is not None and positions.ndim == v.ndim - 1:
         from areal_tpu.ops.rotary import apply_mrope
 
-        return apply_mrope(v, positions, cfg.rope_theta, cfg.mrope_section)
-    return apply_rope(v, positions, cfg.rope_theta)
+        return apply_mrope(
+            v, positions, cfg.rope_theta, cfg.mrope_section, inv_freq=inv_freq
+        )
+    return apply_rope(v, positions, cfg.rope_theta, inv_freq=inv_freq)
 
 
 def _block(
@@ -648,8 +670,8 @@ def decode_step(
         h = _norm(cfg, h_in, lp["ln1"], lp.get("ln1_b"))
         q, k, v = _qkv(cfg, lp, h)
         if cfg.pos_embed_type == "rope":
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            q = _rope(cfg, q, positions)
+            k = _rope(cfg, k, positions)
         # write new k/v into the cache at [cache_len, cache_len+Tq)
         def write(cache_l, new):
             def per_slot(c, n, start):

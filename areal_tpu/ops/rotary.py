@@ -17,11 +17,64 @@ def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta**exponents)
 
 
+def scaled_rope_frequencies(
+    head_dim: int,
+    theta: float,
+    scaling_type: str,
+    factor: float = 1.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 0,
+    max_position: int = 0,
+) -> jnp.ndarray:
+    """HF rope_scaling-compatible inv_freq (modeling_rope_utils parity).
+
+    - "linear": position interpolation — inv_freq / factor.
+    - "dynamic": NTK base stretch evaluated at the ``max_position`` bound
+      (HF clamps seq_len up to max_position_embeddings, so this is exactly
+      its value for any sequence inside the trained window).
+    - "llama3": per-channel — high-frequency channels untouched, low
+      frequencies / factor, smooth interpolation between the wavelength
+      cutoffs (llama-3.x checkpoints).
+    """
+    import numpy as np
+
+    if scaling_type == "dynamic":
+        assert max_position > 0
+        theta = theta * (
+            (factor * max_position / max_position) - (factor - 1)
+        ) ** (head_dim / (head_dim - 2))
+        # (at the clamp bound seq_len == max_position; written out so the
+        # formula is recognizably HF's)
+    # pure numpy END TO END: this is lru-cached across jit traces
+    # (models/lm._rope_inv_freq), so the result must be a host constant —
+    # a jnp array materialized inside one trace would leak into the next
+    # (observed: prefill trace -> decode trace UnexpectedTracerError)
+    exponents = np.arange(0, head_dim, 2, dtype=np.float64) / head_dim
+    inv_freq = 1.0 / (theta**exponents)
+    if scaling_type == "linear":
+        inv_freq = inv_freq / factor
+    elif scaling_type == "llama3":
+        assert original_max_position > 0
+        low_wav = original_max_position / low_freq_factor
+        high_wav = original_max_position / high_freq_factor
+        wavelen = 2.0 * np.pi / inv_freq
+        scaled = np.where(wavelen > low_wav, inv_freq / factor, inv_freq)
+        smooth = (original_max_position / wavelen - low_freq_factor) / (
+            high_freq_factor - low_freq_factor
+        )
+        smoothed = (1 - smooth) * scaled / factor + smooth * scaled
+        medium = (wavelen >= high_wav) & (wavelen <= low_wav)
+        inv_freq = np.where(medium, smoothed, scaled)
+    return np.asarray(inv_freq, np.float32)
+
+
 def apply_mrope(
     x: jnp.ndarray,  # [T, H, D]
     positions: jnp.ndarray,  # [3, T] (t, h, w) position streams
     theta: float,
     sections: tuple,  # (st, sh, sw), sum == D//2
+    inv_freq: jnp.ndarray | None = None,  # rope-scaling override
 ) -> jnp.ndarray:
     """Qwen2-VL multimodal RoPE: the D/2 frequency channels are split into
     (t, h, w) sections, each rotated by its own position stream (HF
@@ -29,7 +82,8 @@ def apply_mrope(
     streams are equal and this reduces exactly to apply_rope)."""
     d = x.shape[-1]
     assert sum(sections) == d // 2, (sections, d)
-    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    if inv_freq is None:
+        inv_freq = rope_frequencies(d, theta)  # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [3, T, D/2]
     import numpy as _np
 
@@ -47,16 +101,19 @@ def apply_mrope(
 
 
 def apply_rope(
-    x: jnp.ndarray, positions: jnp.ndarray, theta: float
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float,
+    inv_freq: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Rotate ``x[..., T, H, D]`` by per-token ``positions[..., T]``.
 
     Uses the HF "half-split" convention (rotate_half): the first D/2 dims pair
     with the last D/2, matching transformers' llama/qwen2 implementation so HF
-    checkpoints produce identical activations.
+    checkpoints produce identical activations. ``inv_freq`` overrides the
+    plain schedule (rope scaling — scaled_rope_frequencies).
     """
     d = x.shape[-1]
-    inv_freq = rope_frequencies(d, theta)  # [D/2]
+    if inv_freq is None:
+        inv_freq = rope_frequencies(d, theta)  # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
     sin = jnp.sin(angles)[..., None, :]

@@ -202,7 +202,17 @@ class NfsNameRecordRepository(NameRecordRepository):
                 else:
                     os.unlink(tmp)
                     raise NameEntryExistsError(name) from None
-            except OSError:
+            except OSError as e:
+                import errno
+
+                if e.errno not in (
+                    errno.EPERM, errno.ENOTSUP, errno.EOPNOTSUPP, errno.EXDEV
+                ):
+                    # transient I/O (ESTALE/EIO/...) must propagate — the
+                    # no-hardlink fallback would reintroduce the
+                    # empty-entry race this path exists to fix
+                    os.unlink(tmp)
+                    raise
                 # filesystem without hardlinks (gcsfuse/FUSE): fall back to
                 # exclusive create + write — atomic existence, weaker
                 # content visibility (a concurrent get may briefly see "")

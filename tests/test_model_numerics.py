@@ -441,3 +441,114 @@ def test_gpt2_critic_value_head_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(params["value_head"]), np.asarray(params2["value_head"])
     )
+
+
+# ---------------------------------------------------------------------------
+# HF rope_scaling parity: llama-3.x ("llama3") and linear position
+# interpolation — silently-wrong rope would corrupt every activation, so
+# these load real scaled-rope checkpoints and match HF logits exactly.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
+    {"rope_type": "linear", "factor": 4.0},
+    {"rope_type": "dynamic", "factor": 2.0},
+])
+def test_forward_matches_hf_llama_rope_scaling(tmp_path, scaling):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rope_scaling=dict(scaling), attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "hf_llama_scaled"
+    model.save_pretrained(d, safe_serialization=True)
+
+    cfg, params = hf_io.load_hf_params(str(d), dtype="float32")
+    assert cfg.rope_scaling_type == scaling["rope_type"]
+    ids = np.random.default_rng(1).integers(1, 128, size=48).astype(np.int32)
+    with torch.no_grad():
+        want = model(
+            input_ids=torch.tensor(ids, dtype=torch.long)[None]
+        ).logits[0].numpy()
+    got = np.asarray(
+        lm.forward_packed(
+            params, cfg, jnp.asarray(ids),
+            jnp.arange(len(ids), dtype=jnp.int32),
+            jnp.zeros(len(ids), jnp.int32),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_rope_scaling_rejected():
+    with pytest.raises(ValueError, match="rope_scaling"):
+        from_hf_config({
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+        })
+
+
+def test_rope_scaling_generation_matches_hf_generate(tmp_path):
+    """Scaled-rope inv_freq is lru-cached ACROSS jit traces (prefill then
+    decode) — it must be a host constant, not a trace-born array (regression:
+    UnexpectedTracerError killed the engine loop on the 2nd dispatch)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "scaled"
+    model.save_pretrained(d, safe_serialization=True)
+    with torch.no_grad():
+        want = model.generate(
+            input_ids=torch.tensor([[5, 9, 3, 7, 2]]), max_new_tokens=6,
+            do_sample=False,
+        )[0, 5:].tolist()
+
+    cfg, params = hf_io.load_hf_params(str(d), dtype="float32")
+    eng = GenerationEngine(
+        JaxGenConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=32,
+                     decode_steps_per_call=2, dtype="float32"),
+        model_config=cfg, params=params,
+    )
+    eng.start()
+    try:
+        import threading
+
+        done = threading.Event()
+        res = {}
+        eng.submit(
+            "rs", [5, 9, 3, 7, 2],
+            GenerationHyperparameters(
+                max_new_tokens=6, min_new_tokens=6, greedy=True
+            ),
+            lambda r: (res.update(r=r), done.set()),
+        )
+        assert done.wait(120)
+        assert res["r"].stop_reason != "abort"
+        assert res["r"].output_tokens == want
+    finally:
+        eng.stop()
