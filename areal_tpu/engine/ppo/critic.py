@@ -32,8 +32,17 @@ class PPOCritic:
 
     def compute_values(self, data: TensorDict) -> np.ndarray:
         """Value of every token position, padded [B, S]."""
+        from areal_tpu.engine.ppo.actor import PPOActor
+
         self.engine.train(False)
-        return self.engine.forward(input_=data, post_hook=_take_values)
+        # forward consumes only the model inputs; per-host-different extras
+        # (rewards etc.) must not hit the replicated device_put branch
+        return self.engine.forward(
+            input_={
+                k: v for k, v in data.items() if k in PPOActor._FORWARD_KEYS
+            },
+            post_hook=_take_values,
+        )
 
     def ppo_update(self, data: TensorDict) -> list[dict[str, float]]:
         data = dict(data)

@@ -578,14 +578,23 @@ class TPUTrainEngine(TrainEngine):
             packed, real_n = pad_packed_to_multiple(packed, multiple)
             cu = packed["cu_seqlens"]
             total = int(cu[-1])
-            if self.model_config.pos_embed_type == "learned":
+            mc = self.model_config
+            if (
+                mc.pos_embed_type == "learned"
+                or mc.rope_scaling_type == "dynamic"
+            ):
                 longest = int(np.diff(np.asarray(cu)).max())
-                if longest > self.model_config.max_position_embeddings:
-                    # the wpe gather clamps out-of-range rows silently
+                if longest > mc.max_position_embeddings:
+                    # learned: the wpe gather clamps out-of-range rows
+                    # silently; dynamic NTK: beyond the window HF
+                    # re-stretches the base per seq_len, which the static
+                    # compiled schedule cannot — logprobs would silently
+                    # diverge from HF/inference
                     raise ValueError(
-                        f"sequence of {longest} tokens exceeds the learned "
-                        f"position table "
-                        f"({self.model_config.max_position_embeddings})"
+                        f"sequence of {longest} tokens exceeds "
+                        f"max_position_embeddings "
+                        f"({mc.max_position_embeddings}) for "
+                        f"{'learned positions' if mc.pos_embed_type == 'learned' else 'dynamic-NTK rope'}"
                     )
             packed["positions"] = positions_from_cu_seqlens(cu, total)
             seg = segment_ids_from_cu_seqlens(cu, total)

@@ -85,9 +85,10 @@ def _task_of(row: dict) -> str:
 def _math_reward(prompt, completion, prompt_ids, completion_ids, **row):
     from areal_tpu.reward import math_verify_reward
 
-    # explicit None checks: a numeric answer 0 is falsy but valid (AIME-style)
+    # a numeric answer 0 is falsy but valid (AIME-style); only a missing or
+    # EMPTY answer falls back to the solution field
     answer = row.get("answer")
-    if answer is None:
+    if answer is None or answer == "":
         answer = row.get("solution", "")
     return math_verify_reward(
         prompt, completion, prompt_ids, completion_ids, answer=str(answer)

@@ -28,11 +28,15 @@ class TransformerConfig:
     # "llama3" by default). "" = plain RoPE. "dynamic" NTK is computed at
     # the max_position_embeddings bound — exactly HF's value for any
     # sequence within the trained window (HF clamps seq_len up to it).
-    rope_scaling_type: str = ""  # "" | "linear" | "dynamic" | "llama3"
+    rope_scaling_type: str = ""  # "" | "linear" | "dynamic" | "llama3" | "yarn"
     rope_scaling_factor: float = 1.0
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 0
+    # yarn-specific knobs as sorted (key, value) pairs (hashable — the
+    # frozen config is an lru_cache key): attention_factor, beta_fast,
+    # beta_slow, mscale, mscale_all_dim, truncate
+    rope_yarn: tuple | None = None
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2: True for qkv
@@ -247,12 +251,21 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
     rs_type = rs.get("rope_type") or rs.get("type") or ""
     if rs_type in ("default", ""):
         rs_type = ""
-    elif rs_type not in ("linear", "dynamic", "llama3"):
+    elif rs_type not in ("linear", "dynamic", "llama3", "yarn"):
         # loading with silently-wrong rope would corrupt every activation
         raise ValueError(
             f"unsupported rope_scaling type {rs_type!r} "
-            "(supported: linear, dynamic, llama3)"
+            "(supported: linear, dynamic, llama3, yarn)"
         )
+    yarn_keys = (
+        "attention_factor", "beta_fast", "beta_slow", "mscale",
+        "mscale_all_dim", "truncate",
+    )
+    rope_yarn = (
+        tuple(sorted((k, rs[k]) for k in yarn_keys if k in rs))
+        if rs_type == "yarn"
+        else None
+    )
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -269,6 +282,7 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
         rope_original_max_position=int(
             rs.get("original_max_position_embeddings", 0)
         ),
+        rope_yarn=rope_yarn,
         rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
         # gemma ties by default and its config.json may omit the field
         tie_word_embeddings=hf.get("tie_word_embeddings", arch == "gemma"),
@@ -381,6 +395,12 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
                 high_freq_factor=cfg.rope_high_freq_factor,
                 original_max_position_embeddings=cfg.rope_original_max_position,
             )
+        elif cfg.rope_scaling_type == "yarn":
+            rs.update(dict(cfg.rope_yarn or ()))
+            if cfg.rope_original_max_position:
+                rs["original_max_position_embeddings"] = (
+                    cfg.rope_original_max_position
+                )
         out["rope_scaling"] = rs
     if cfg.sliding_window > 0:
         out["sliding_window"] = cfg.sliding_window

@@ -301,9 +301,11 @@ def _moe_mlp(
 
 @functools.lru_cache(maxsize=32)
 def _rope_inv_freq(cfg: TransformerConfig):
-    """Per-config inv_freq, honoring HF rope_scaling (None = plain rope)."""
+    """Per-config (inv_freq, attention_factor), honoring HF rope_scaling
+    ((None, 1.0) = plain rope). Host numpy constants — safe to reuse across
+    jit traces."""
     if not cfg.rope_scaling_type:
-        return None
+        return None, 1.0
     from areal_tpu.ops.rotary import scaled_rope_frequencies
 
     return scaled_rope_frequencies(
@@ -315,6 +317,7 @@ def _rope_inv_freq(cfg: TransformerConfig):
         high_freq_factor=cfg.rope_high_freq_factor,
         original_max_position=cfg.rope_original_max_position,
         max_position=cfg.max_position_embeddings,
+        yarn=dict(cfg.rope_yarn) if cfg.rope_yarn else None,
     )
 
 
@@ -322,14 +325,17 @@ def _rope(cfg: TransformerConfig, v: jnp.ndarray, positions: jnp.ndarray):
     """1D RoPE (with any HF rope scaling), or Qwen2-VL M-RoPE when positions
     carry (t, h, w) streams ([3, T]); 1D positions under an mrope config are
     the text-only case and remain exact (all three streams equal)."""
-    inv_freq = _rope_inv_freq(cfg)
+    inv_freq, cs_scale = _rope_inv_freq(cfg)
     if cfg.mrope_section is not None and positions.ndim == v.ndim - 1:
         from areal_tpu.ops.rotary import apply_mrope
 
         return apply_mrope(
-            v, positions, cfg.rope_theta, cfg.mrope_section, inv_freq=inv_freq
+            v, positions, cfg.rope_theta, cfg.mrope_section,
+            inv_freq=inv_freq, cs_scale=cs_scale,
         )
-    return apply_rope(v, positions, cfg.rope_theta, inv_freq=inv_freq)
+    return apply_rope(
+        v, positions, cfg.rope_theta, inv_freq=inv_freq, cs_scale=cs_scale
+    )
 
 
 def _block(

@@ -26,8 +26,10 @@ def scaled_rope_frequencies(
     high_freq_factor: float = 4.0,
     original_max_position: int = 0,
     max_position: int = 0,
-) -> jnp.ndarray:
-    """HF rope_scaling-compatible inv_freq (modeling_rope_utils parity).
+    yarn: dict | None = None,
+):
+    """HF rope_scaling-compatible ``(inv_freq, attention_factor)``
+    (modeling_rope_utils parity).
 
     - "linear": position interpolation — inv_freq / factor.
     - "dynamic": NTK base stretch evaluated at the ``max_position`` bound
@@ -36,8 +38,15 @@ def scaled_rope_frequencies(
     - "llama3": per-channel — high-frequency channels untouched, low
       frequencies / factor, smooth interpolation between the wavelength
       cutoffs (llama-3.x checkpoints).
+    - "yarn": interpolation/extrapolation ramp between the
+      beta_fast/beta_slow correction dims + the paper's attention
+      temperature, returned as attention_factor (multiplies cos AND sin).
     """
+    import math
+
     import numpy as np
+
+    attention_factor = 1.0
 
     if scaling_type == "dynamic":
         assert max_position > 0
@@ -66,7 +75,45 @@ def scaled_rope_frequencies(
         smoothed = (1 - smooth) * scaled / factor + smooth * scaled
         medium = (wavelen >= high_wav) & (wavelen <= low_wav)
         inv_freq = np.where(medium, smoothed, scaled)
-    return np.asarray(inv_freq, np.float32)
+    elif scaling_type == "yarn":
+        y = dict(yarn or {})
+        orig = original_max_position or max_position
+        assert orig > 0
+        beta_fast = y.get("beta_fast") or 32
+        beta_slow = y.get("beta_slow") or 1
+        mscale = y.get("mscale")
+        mscale_all_dim = y.get("mscale_all_dim")
+
+        def get_mscale(scale, ms=1.0):
+            return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
+
+        attention_factor = y.get("attention_factor")
+        if attention_factor is None:
+            if mscale and mscale_all_dim:
+                attention_factor = get_mscale(factor, mscale) / get_mscale(
+                    factor, mscale_all_dim
+                )
+            else:
+                attention_factor = get_mscale(factor)
+
+        def corr_dim(n_rot):
+            return (
+                head_dim * math.log(orig / (n_rot * 2 * math.pi))
+            ) / (2 * math.log(theta))
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if y.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, head_dim - 1)
+        if low == high:
+            high = high + 0.001
+        ramp = np.clip(
+            (np.arange(head_dim // 2, dtype=np.float64) - low) / (high - low),
+            0.0, 1.0,
+        )
+        extrap = 1.0 - ramp
+        inv_freq = (inv_freq / factor) * (1 - extrap) + inv_freq * extrap
+    return np.asarray(inv_freq, np.float32), float(attention_factor)
 
 
 def apply_mrope(
@@ -75,6 +122,7 @@ def apply_mrope(
     theta: float,
     sections: tuple,  # (st, sh, sw), sum == D//2
     inv_freq: jnp.ndarray | None = None,  # rope-scaling override
+    cs_scale: float = 1.0,  # yarn attention temperature on cos/sin
 ) -> jnp.ndarray:
     """Qwen2-VL multimodal RoPE: the D/2 frequency channels are split into
     (t, h, w) sections, each rotated by its own position stream (HF
@@ -91,8 +139,8 @@ def apply_mrope(
     chan = _np.arange(d // 2)
     sel = angles[plane, :, chan]  # [D/2, T]
     angles_sel = jnp.transpose(sel)  # [T, D/2]
-    cos = jnp.cos(angles_sel)[..., None, :]
-    sin = jnp.sin(angles_sel)[..., None, :]
+    cos = jnp.cos(angles_sel)[..., None, :] * cs_scale
+    sin = jnp.sin(angles_sel)[..., None, :] * cs_scale
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2 :].astype(jnp.float32)
     return jnp.concatenate(
@@ -103,6 +151,7 @@ def apply_mrope(
 def apply_rope(
     x: jnp.ndarray, positions: jnp.ndarray, theta: float,
     inv_freq: jnp.ndarray | None = None,
+    cs_scale: float = 1.0,  # yarn attention temperature on cos/sin
 ) -> jnp.ndarray:
     """Rotate ``x[..., T, H, D]`` by per-token ``positions[..., T]``.
 
@@ -115,8 +164,8 @@ def apply_rope(
     if inv_freq is None:
         inv_freq = rope_frequencies(d, theta)  # [D/2]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
-    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
-    sin = jnp.sin(angles)[..., None, :]
+    cos = jnp.cos(angles)[..., None, :] * cs_scale  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :] * cs_scale
     x1 = x[..., : d // 2]
     x2 = x[..., d // 2 :]
     xf1 = x1.astype(jnp.float32)

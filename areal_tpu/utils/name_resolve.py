@@ -206,7 +206,8 @@ class NfsNameRecordRepository(NameRecordRepository):
                 import errno
 
                 if e.errno not in (
-                    errno.EPERM, errno.ENOTSUP, errno.EOPNOTSUPP, errno.EXDEV
+                    errno.EPERM, errno.ENOTSUP, errno.EOPNOTSUPP,
+                    errno.EXDEV, errno.ENOSYS,  # FUSE mounts return ENOSYS
                 ):
                     # transient I/O (ESTALE/EIO/...) must propagate — the
                     # no-hardlink fallback would reintroduce the

@@ -64,10 +64,14 @@ def main():
         time.sleep(0.2)
 
     # post-run evidence for the test: params must be IDENTICAL across
-    # workers (the mesh's grad psum, not RPC, keeps them in sync)
+    # workers (the mesh's grad psum, not RPC, keeps them in sync). The
+    # embed may be fsdp-sharded across processes — allgather to host
+    # (collective: every worker joins).
+    from areal_tpu.parallel.distributed import gather_host_values
+
     np.save(
         os.path.join(outdir, f"embed{pid}.npy"),
-        np.asarray(jax.device_get(actor.params["embed"])),
+        np.asarray(gather_host_values(actor.params["embed"])),
     )
     with open(os.path.join(outdir, f"done{pid}.json"), "w") as f:
         json.dump({"version": actor.get_version()}, f)

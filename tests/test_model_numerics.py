@@ -455,6 +455,10 @@ def test_gpt2_critic_value_head_roundtrip(tmp_path):
      "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
     {"rope_type": "linear", "factor": 4.0},
     {"rope_type": "dynamic", "factor": 2.0},
+    {"rope_type": "yarn", "factor": 4.0,
+     "original_max_position_embeddings": 64},
+    {"rope_type": "yarn", "factor": 4.0, "beta_fast": 16, "beta_slow": 2,
+     "attention_factor": 1.1, "original_max_position_embeddings": 64},
 ])
 def test_forward_matches_hf_llama_rope_scaling(tmp_path, scaling):
     torch = pytest.importorskip("torch")
@@ -495,7 +499,7 @@ def test_unsupported_rope_scaling_rejected():
             "architectures": ["LlamaForCausalLM"],
             "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
             "num_hidden_layers": 2, "num_attention_heads": 4,
-            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+            "rope_scaling": {"rope_type": "longrope", "factor": 4.0},
         })
 
 
