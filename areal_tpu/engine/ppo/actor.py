@@ -122,22 +122,16 @@ class PPOActor:
             needs_entropy=config.entropy_coeff != 0.0,
         )
 
-    # keys the forward pass actually consumes; everything else (rewards,
-    # behavior logprobs, versions ...) stays host-side — under multi-host
-    # those per-host-different arrays would otherwise land in the
-    # replicated device_put branch, which REQUIRES identical values on
-    # every process (observed: controller-mode compute_logp rejected the
-    # per-worker reward shards)
-    _FORWARD_KEYS = (
-        "input_ids", "attention_mask", "pixel_values", "image_grid_thw",
-    )
-
     def compute_logp(self, data: TensorDict) -> np.ndarray:
         """Teacher-forced logprobs of the batch under current weights,
-        next-token convention (index t scores token t+1). Padded [B, S]."""
+        next-token convention (index t scores token t+1). Padded [B, S].
+        Only the model-input keys go through (FORWARD_INPUT_KEYS): per-host
+        -different extras must not hit the replicated device_put branch."""
+        from areal_tpu.engine.train_engine import FORWARD_INPUT_KEYS
+
         self.engine.train(False)
         return self.engine.forward(
-            input_={k: v for k, v in data.items() if k in self._FORWARD_KEYS},
+            input_={k: v for k, v in data.items() if k in FORWARD_INPUT_KEYS},
             post_hook=self._logp_hook,
             logp_fused_temperature=self.temperature,
         )

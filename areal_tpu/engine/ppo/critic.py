@@ -32,14 +32,14 @@ class PPOCritic:
 
     def compute_values(self, data: TensorDict) -> np.ndarray:
         """Value of every token position, padded [B, S]."""
-        from areal_tpu.engine.ppo.actor import PPOActor
+        from areal_tpu.engine.train_engine import FORWARD_INPUT_KEYS
 
         self.engine.train(False)
         # forward consumes only the model inputs; per-host-different extras
         # (rewards etc.) must not hit the replicated device_put branch
         return self.engine.forward(
             input_={
-                k: v for k, v in data.items() if k in PPOActor._FORWARD_KEYS
+                k: v for k, v in data.items() if k in FORWARD_INPUT_KEYS
             },
             post_hook=_take_values,
         )

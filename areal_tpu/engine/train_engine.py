@@ -67,11 +67,16 @@ logger = logging.getLogger("TPUTrainEngine")
 
 
 def _flat_pixels(mb):
-    """[rows, N_img, S, S, 3] -> [rows*N_img, S, S, 3] in stream order (rows
-    are packed in order, so images line up with their placeholders)."""
+    """Flatten the per-row image tensors into the stream-order table the
+    vision encoder consumes (rows are packed in order, so images line up
+    with their placeholders):
+    - mini ViT:  [rows, N_img, S, S, 3] -> [rows*N_img, S, S, 3]
+    - qwen2_vl:  [rows, P, pd] patch streams -> [rows*P, pd]"""
     pv = mb.get("pixel_values")
     if pv is None:
         return None
+    if pv.ndim == 3:  # qwen2_vl HF-processor patch stream
+        return pv.reshape((-1, pv.shape[-1]))
     return pv.reshape((-1,) + tuple(pv.shape[-3:]))
 
 _DTYPES = {
@@ -79,6 +84,13 @@ _DTYPES = {
     "float32": jnp.float32,
     "float16": jnp.float16,
 }
+
+# the batch keys engine.forward consumes; algorithm wrappers (PPO actor /
+# critic) filter to these so per-host-different extras (rewards, behavior
+# logprobs, ...) never hit the replicated device_put branch under multi-host
+FORWARD_INPUT_KEYS = (
+    "input_ids", "attention_mask", "pixel_values", "image_grid_thw",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,6 +240,10 @@ class TPUTrainEngine(TrainEngine):
         self._lr_schedule = None
         self._opt_steps = 0
         self._jit_cache: dict[Any, Callable] = {}
+        # qwen2_vl training: the static image grid signature of the current
+        # batch (one image per row, uniform grid — TPU static shapes);
+        # captured by _prepare_mbs, part of every forward jit-cache key
+        self._vlm_grids: tuple | None = None
         self.lora_params = None
         self._merged_cache = None
         self.attn_spec = None
@@ -478,7 +494,7 @@ class TPUTrainEngine(TrainEngine):
         rep = NamedSharding(self.mesh, P())
         out = {}
         for k, v in packed.items():
-            if k in ("cu_seqlens", "max_seqlen"):
+            if k in ("cu_seqlens", "max_seqlen", "image_grid_thw"):
                 continue
             arr = np.asarray(v)
             if k == "pixel_values":
@@ -515,7 +531,7 @@ class TPUTrainEngine(TrainEngine):
         rep = NamedSharding(self.mesh, P())
         out = {}
         for k in packed_mbs[0]:
-            if k in ("cu_seqlens", "max_seqlen"):
+            if k in ("cu_seqlens", "max_seqlen", "image_grid_thw"):
                 continue
             arrs = [np.asarray(p[k]) for p in packed_mbs]
             if any(a.shape != arrs[0].shape for a in arrs[1:]):
@@ -565,6 +581,16 @@ class TPUTrainEngine(TrainEngine):
         Returns (MicroBatchList, packed mbs with positions/segment_ids, real
         token counts). ``group_size`` keeps row groups (e.g. RM pairs) in one
         microbatch."""
+        if self.model_config.vision_arch == "qwen2_vl":
+            if "image_grid_thw" in input_:
+                # batch-wide static grid signature, captured BEFORE the mb
+                # split: all microbatches share one jitted forward, so one
+                # grid must cover them all
+                self._capture_vlm_grids(input_)
+            else:
+                # text-only batch: a stale grid would needlessly key (and
+                # recompile) the text-only jit functions
+                self._vlm_grids = None
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_,
             max_tokens_per_mb=self.config.mb_spec.max_tokens_per_mb,
@@ -601,6 +627,11 @@ class TPUTrainEngine(TrainEngine):
             # tokens beyond real_n belong to the alignment-pad sequence; give
             # them a real segment id (isolated) but they carry zero loss_mask
             packed["segment_ids"] = seg
+            if (
+                self.model_config.vision_arch == "qwen2_vl"
+                and "pixel_values" in packed
+            ):
+                packed["positions"] = self._mrope_positions_packed(packed)
             packed_mbs.append(packed)
             real_ns.append(real_n)
         if pp_size(self.mesh) > 1:
@@ -649,6 +680,62 @@ class TPUTrainEngine(TrainEngine):
         elif distributed.process_count() > 1:
             packed_mbs, real_ns = self._sync_mbs_across_hosts(packed_mbs, real_ns)
         return mb_list, packed_mbs, real_ns
+
+    def _capture_vlm_grids(self, packed: TensorDict) -> None:
+        """Static grid signature for the qwen2_vl forward jit (one image per
+        row, uniform grid across the microbatch — the TPU static-shape
+        contract, like the mini ViT's fixed vision_patches)."""
+        if distributed.process_count() > 1:
+            raise NotImplementedError(
+                "qwen2_vl training under multi-host jax.distributed is not "
+                "supported yet (per-host grid/image-table alignment)"
+            )
+        grids = {
+            tuple(int(v) for v in row)
+            for row in np.asarray(packed["image_grid_thw"]).reshape(-1, 3)
+        }
+        if len(grids) != 1:
+            raise NotImplementedError(
+                f"qwen2_vl training needs one uniform image grid per batch "
+                f"(static shapes); got {sorted(grids)}"
+            )
+        # a single (t, h, w) — per-microbatch image COUNTS derive from the
+        # pixel-array shape inside the trace, so one jit covers every mb
+        self._vlm_grids = grids.pop()
+
+    def _mrope_positions_packed(self, packed: TensorDict) -> np.ndarray:
+        """[3, T] M-RoPE positions for a packed qwen2_vl stream: per-sequence
+        vlm_qwen2.mrope_positions (offset-free per segment), pad sequences
+        get plain arange (isolated zero-loss segments)."""
+        from areal_tpu.models.vlm_qwen2 import mrope_positions
+
+        cu = np.asarray(packed["cu_seqlens"])
+        ids = np.asarray(packed["input_ids"])
+        tok = self.model_config.image_token_id
+        parts = []
+        for i in range(len(cu) - 1):
+            row = ids[cu[i]: cu[i + 1]]
+            is_ph = row == tok
+            # one grid per placeholder RUN (multi-image rows supported as
+            # long as every image shares the batch grid)
+            n_runs = int(
+                np.count_nonzero(
+                    is_ph & np.concatenate([[True], ~is_ph[:-1]])
+                )
+            )
+            if n_runs:
+                parts.append(
+                    mrope_positions(
+                        self.model_config, row, [self._vlm_grids] * n_runs
+                    )
+                )
+            else:  # text-only or alignment-pad sequence
+                parts.append(
+                    np.broadcast_to(
+                        np.arange(len(row), dtype=np.int64), (3, len(row))
+                    )
+                )
+        return np.concatenate(parts, axis=1).astype(np.int32)
 
     def _sync_mbs_across_hosts(
         self, packed_mbs: list[TensorDict], real_ns: list[int]
@@ -780,7 +867,7 @@ class TPUTrainEngine(TrainEngine):
         return self._jit_cache[key]
 
     def _grad_fn(self, loss_fn: Callable) -> Callable:
-        key = ("grad", loss_fn)
+        key = ("grad", loss_fn, self._vlm_grids)
         if key not in self._jit_cache:
             cfg, backend = self.model_config, self.config.backend
 
@@ -795,6 +882,7 @@ class TPUTrainEngine(TrainEngine):
                     remat_policy=backend.remat_policy,
                     attn_spec=self.attn_spec,
                     pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                 )
                 return loss_fn(logits, mb)
 
@@ -804,7 +892,7 @@ class TPUTrainEngine(TrainEngine):
     def _grad_fn_fused(self, token_loss_fn: "TokenLossFn") -> Callable:
         """Like _grad_fn but with the chunked LM-head loss
         (models/lm.forward_fused_logp): [T, V] logits never materialize."""
-        key = ("grad_fused", token_loss_fn)
+        key = ("grad_fused", token_loss_fn, self._vlm_grids)
         if key not in self._jit_cache:
             cfg, backend = self.model_config, self.config.backend
 
@@ -823,6 +911,7 @@ class TPUTrainEngine(TrainEngine):
                     remat_policy=backend.remat_policy,
                     attn_spec=self.attn_spec,
                     pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                 )
                 return token_loss_fn.fn(logp, ent, mb)
 
@@ -1028,7 +1117,7 @@ class TPUTrainEngine(TrainEngine):
         _, packed_mbs, _ = self._prepare_mbs(input_)
         denom = sum(float(loss_weight_fn(p)) for p in packed_mbs)
         if self._use_fused_loss(token_loss_fn):
-            key = ("eval_fused", token_loss_fn)
+            key = ("eval_fused", token_loss_fn, self._vlm_grids)
             if key not in self._jit_cache:
                 cfg, backend = self.model_config, self.config.backend
 
@@ -1042,6 +1131,7 @@ class TPUTrainEngine(TrainEngine):
                         chunk=backend.loss_chunk_size,
                         attn_spec=self.attn_spec,
                         pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                     )
                     return token_loss_fn.fn(logp, ent, mb)
 
@@ -1069,7 +1159,7 @@ class TPUTrainEngine(TrainEngine):
             mbs_dev = self._stacked_to_device(packed_mbs)
             total = float(self._jit_cache[pkey](self.effective_params(), mbs_dev))
             return total / max(denom, 1.0)
-        key = ("eval", loss_fn)
+        key = ("eval", loss_fn, self._vlm_grids)
         if key not in self._jit_cache:
             cfg = self.model_config
 
@@ -1079,6 +1169,7 @@ class TPUTrainEngine(TrainEngine):
                     mb["segment_ids"], remat=False,
                     attn_spec=self.attn_spec,
                     pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                 )
                 return loss_fn(logits, mb)
 
@@ -1140,7 +1231,7 @@ class TPUTrainEngine(TrainEngine):
             # chunked-fused scoring: next-token logp without [T, V] logits
             # (the compute_logp / recompute_logprob path must survive long
             # context just like the train step)
-            key = ("fwd_fused", logp_fused_temperature)
+            key = ("fwd_fused", logp_fused_temperature, self._vlm_grids)
             if key not in self._jit_cache:
                 cfg, backend = self.model_config, self.config.backend
                 temp = logp_fused_temperature
@@ -1154,6 +1245,7 @@ class TPUTrainEngine(TrainEngine):
                         chunk=backend.loss_chunk_size,
                         attn_spec=self.attn_spec,
                         pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                     )
                     return logp
 
@@ -1161,7 +1253,7 @@ class TPUTrainEngine(TrainEngine):
             fwd = self._jit_cache[key]
             mb_outs = None
         else:
-            key = ("fwd", post_hook)
+            key = ("fwd", post_hook, self._vlm_grids)
             if key not in self._jit_cache:
                 cfg = self.model_config
 
@@ -1171,6 +1263,7 @@ class TPUTrainEngine(TrainEngine):
                         mb["segment_ids"], remat=False,
                         attn_spec=self.attn_spec,
                         pixel_values=_flat_pixels(mb),
+                        image_grid_thw=self._vlm_grids,
                     )
                     return (
                         post_hook(logits, mb) if post_hook is not None else logits

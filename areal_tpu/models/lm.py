@@ -21,6 +21,7 @@ import functools
 from typing import Any
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
@@ -321,6 +322,18 @@ def _rope_inv_freq(cfg: TransformerConfig):
     )
 
 
+def _expand_grids(image_grid_thw: tuple, pixel_values) -> tuple:
+    """A single uniform ``(t, h, w)`` expands to one grid per image, the
+    image count derived statically from the patch-stream length (the train
+    engine's per-microbatch contract); an explicit tuple-of-grids (serving)
+    passes through."""
+    if image_grid_thw and isinstance(image_grid_thw[0], (int, np.integer)):
+        t, h, w = (int(v) for v in image_grid_thw)
+        n = pixel_values.shape[0] // (t * h * w)
+        return ((t, h, w),) * n
+    return tuple(image_grid_thw)
+
+
 def _rope(cfg: TransformerConfig, v: jnp.ndarray, positions: jnp.ndarray):
     """1D RoPE (with any HF rope scaling), or Qwen2-VL M-RoPE when positions
     carry (t, h, w) streams ([3, T]); 1D positions under an mrope config are
@@ -414,7 +427,8 @@ def _trunk(
                 "qwen2_vl pixel_values need image_grid_thw"
             )
             embeds = encode_images_qwen2vl(
-                params["vision"], cfg, pixel_values, image_grid_thw
+                params["vision"], cfg, pixel_values,
+                _expand_grids(image_grid_thw, pixel_values),
             )[None]  # [1, P/m^2, H] — splice consumes flattened rows
         else:
             from areal_tpu.models.vlm import encode_images
@@ -476,6 +490,7 @@ def forward_fused_logp(
     attn_spec: AttnSpec | None = None,
     pixel_values: jnp.ndarray | None = None,
     remat_policy: str = "nothing_saveable",
+    image_grid_thw: tuple | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(logp[T], entropy[T]) of ``labels`` WITHOUT materializing [T, V].
 
@@ -490,7 +505,7 @@ def forward_fused_logp(
     x = _trunk(
         params, cfg, input_ids, positions, segment_ids,
         remat=remat, attn_spec=attn_spec, pixel_values=pixel_values,
-        remat_policy=remat_policy,
+        remat_policy=remat_policy, image_grid_thw=image_grid_thw,
     )
     head = params.get("lm_head")
     if head is None:
@@ -605,7 +620,8 @@ def prefill_many(
 
             assert image_grid_thw is not None
             embeds = encode_images_qwen2vl(
-                params["vision"], cfg, pixel_values, image_grid_thw
+                params["vision"], cfg, pixel_values,
+                _expand_grids(image_grid_thw, pixel_values),
             )[None]
         else:
             from areal_tpu.models.vlm import encode_images

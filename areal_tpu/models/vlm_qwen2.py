@@ -215,6 +215,10 @@ def mrope_positions(
             spans[-1] = (spans[-1][0], i + 1)
         else:
             spans.append((i, i + 1))
+    assert len(spans) == len(grid_thw), (
+        f"{len(spans)} placeholder runs but {len(grid_thw)} grids — a "
+        "silently dropped span would mis-position every later token"
+    )
     cur = 0  # next position value
     prev_end = 0
     for (st, ed), (t, h, w) in zip(spans, grid_thw):

@@ -459,6 +459,10 @@ def test_gpt2_critic_value_head_roundtrip(tmp_path):
      "original_max_position_embeddings": 64},
     {"rope_type": "yarn", "factor": 4.0, "beta_fast": 16, "beta_slow": 2,
      "attention_factor": 1.1, "original_max_position_embeddings": 64},
+    # original_max deliberately NOT equal to max_position/factor: proves the
+    # interpolation divisor is the config factor, not a recomputed ratio
+    {"rope_type": "yarn", "factor": 4.0,
+     "original_max_position_embeddings": 32},
 ])
 def test_forward_matches_hf_llama_rope_scaling(tmp_path, scaling):
     torch = pytest.importorskip("torch")
