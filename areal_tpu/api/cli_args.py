@@ -521,11 +521,23 @@ class JaxGenConfig:
     prefill_batch: int = 4
     max_seq_len: int = 4096
     page_size: int = 128  # KV cache page granularity
+    # total tokens the paged KV pool holds (HBM budget for attention state);
+    # None = max_batch_size * max_seq_len (capacity parity with a dense
+    # per-slot cache). Because slots draw blocks on demand, a pool far
+    # smaller than B*S admits the same traffic whenever sequences are
+    # shorter than max_seq_len — the paged-attention memory win.
+    kv_pool_tokens: int | None = None
     hbm_utilization: float = 0.85
     decode_steps_per_call: int = 8  # multi-step decode inside one jit call
     host: str = "0.0.0.0"
     port: int = 0  # 0 = pick free port
     tp_size: int = 1
+    # pipeline-parallel serving: the layer stack (params + paged KV pool)
+    # shards over pp_size stages, so models pp x larger than one chip's
+    # TP reach can serve (the realhf pipe_runner.py:375-648 pipelined-
+    # generation role). Decode latency grows by the stage count; combine
+    # with tp_size for pp x tp meshes.
+    pp_size: int = 1
     random_seed: int = 1
     skip_tokenizer_init: bool = False
     # keep aborted requests' KV in their slots, keyed by rid; the client's

@@ -1,0 +1,96 @@
+"""Host-side KV block pool: refcounted fixed-size block allocation.
+
+This is the TPU-native analogue of the paged-KV machinery the reference
+rides via SGLang's radix/token allocator (patch/sglang/v0.5.2.patch — the
+patched server keeps SGLang's paged pool; here the pool is ours). Device
+memory holds ONE flat pool `[L, num_blocks, block_size, KH, D]`; each
+sequence owns a row of block ids (its block table), so HBM scales with
+tokens actually cached rather than `max_batch_size * max_seq_len`.
+
+Sharing: full blocks of a common prompt prefix are shared by bumping a
+refcount (the vLLM/SGLang copy-on-write discipline); a block is writable
+only while its refcount is 1, so partially-filled tail blocks are copied
+before a new sequence appends into them.
+
+Block 0 is reserved as the TRASH block: device-side writes for padding
+rows and inactive batch lanes are routed there, keeping every jitted
+scatter total (no masks on the write path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRASH_BLOCK = 0
+
+
+class OutOfBlocks(Exception):
+    """Raised when an allocation cannot be satisfied even after eviction."""
+
+
+class BlockPool:
+    """Refcounted allocator over `num_blocks` fixed-size KV blocks.
+
+    Pure host bookkeeping — the device pool itself lives in the engine.
+    Not thread-safe; the generation-engine loop is the single owner.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the trash block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.ref = np.zeros(num_blocks, np.int32)
+        self.ref[TRASH_BLOCK] = 1  # permanently allocated
+        # LIFO free list: recently freed blocks are re-used first (their
+        # pool rows are more likely to still be in cache-friendly state)
+        self._free: list[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+
+    # ------------------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` fresh blocks (refcount 1 each). Raises OutOfBlocks if
+        the free list is short — caller evicts and retries."""
+        if n > len(self._free):
+            raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        self.ref[out] = 1
+        return out
+
+    def incref(self, ids) -> None:
+        for b in ids:
+            b = int(b)
+            if b == TRASH_BLOCK:
+                continue
+            assert self.ref[b] > 0, f"incref on free block {b}"
+            self.ref[b] += 1
+
+    def decref(self, ids) -> None:
+        """Drop one reference per id; blocks reaching zero return to the
+        free list."""
+        for b in ids:
+            b = int(b)
+            if b == TRASH_BLOCK or b < 0:
+                continue
+            assert self.ref[b] > 0, f"decref on free block {b}"
+            self.ref[b] -= 1
+            if self.ref[b] == 0:
+                self._free.append(b)
+
+    def writable(self, block_id: int) -> bool:
+        """A block may be appended to only while exactly one table points
+        at it (copy-on-write discipline)."""
+        return int(self.ref[block_id]) == 1 and block_id != TRASH_BLOCK

@@ -42,13 +42,19 @@ from areal_tpu.api.io_struct import ModelResponse
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import TransformerConfig, from_hf_config
 from areal_tpu.models.lm import (
-    decode_step,
-    init_kv_cache,
+    decode_step_paged,
+    init_paged_kv_cache,
     init_params,
-    prefill_many,
+    prefill_stream,
+    write_prefill_blocks,
+)
+from areal_tpu.inference.block_pool import (
+    TRASH_BLOCK,
+    BlockPool,
+    OutOfBlocks,
 )
 from areal_tpu.inference.sampling import sample_tokens
-from areal_tpu.parallel.mesh import MESH_AXES, AXIS_TP
+from areal_tpu.parallel.mesh import MESH_AXES, AXIS_PP, AXIS_TP
 from areal_tpu.parallel.sharding import param_shardings
 from areal_tpu.utils import logging
 
@@ -104,11 +110,14 @@ class GenerationEngine:
         self.config = config
         self.tokenizer = tokenizer
         devices = devices if devices is not None else jax.devices()
-        tp = config.tp_size
-        if len(devices) < tp:
-            raise ValueError(f"tp_size={tp} but only {len(devices)} devices")
+        tp, pp = config.tp_size, config.pp_size
+        if len(devices) < tp * pp:
+            raise ValueError(
+                f"tp_size={tp} x pp_size={pp} but only {len(devices)} devices"
+            )
+        self._pp = pp
         self.mesh = jax.sharding.Mesh(
-            np.asarray(devices[:tp]).reshape(1, 1, 1, tp), MESH_AXES
+            np.asarray(devices[: tp * pp]).reshape(pp, 1, 1, tp), MESH_AXES
         )
 
         if model_config is None:
@@ -116,6 +125,17 @@ class GenerationEngine:
                 raise ValueError("need model_config or config.model_path")
             model_config = from_hf_config(config.model_path)
         self.model_config = model_config
+        if pp > 1:
+            if model_config.num_hidden_layers % pp:
+                raise ValueError(
+                    f"pp_size={pp} must divide num_hidden_layers="
+                    f"{model_config.num_hidden_layers}"
+                )
+            if model_config.is_vlm:
+                raise NotImplementedError(
+                    "pp serving with a vision tower is not supported "
+                    "(matches the training-side pp/VLM exclusion)"
+                )
         if (
             model_config.pos_embed_type == "learned"
             and config.max_seq_len > model_config.max_position_embeddings
@@ -167,15 +187,43 @@ class GenerationEngine:
             self.params = jax.device_put(raw, self._shardings)
 
         b, s = config.max_batch_size, config.max_seq_len
-        cache = init_kv_cache(model_config, b, s, self.dtype)
+        # Paged KV pool (the SGLang paged-allocator role,
+        # patch/sglang/v0.5.2.patch): HBM holds `kv_pool_tokens` worth of
+        # fixed-size blocks shared by all slots via per-slot block tables,
+        # instead of a dense [B, max_seq] reservation per slot.
+        self.block_size = min(config.page_size, s)
+        if s % self.block_size:
+            raise ValueError(
+                f"max_seq_len={s} must be a multiple of the KV block size "
+                f"({self.block_size}; knob: page_size)"
+            )
+        pool_tokens = config.kv_pool_tokens or b * s
+        self.max_blocks_per_seq = s // self.block_size
+        num_blocks = -(-pool_tokens // self.block_size) + 1  # +1 trash block
+        if num_blocks - 1 < self.max_blocks_per_seq:
+            raise ValueError(
+                f"kv_pool_tokens={pool_tokens} cannot hold even one "
+                f"max_seq_len={s} sequence"
+            )
+        self.pool = BlockPool(num_blocks, self.block_size)
+        cache = init_paged_kv_cache(
+            model_config, num_blocks, self.block_size, self.dtype
+        )
         kh_div = model_config.num_key_value_heads % tp == 0
         cache_spec = jax.sharding.PartitionSpec(
-            None, None, None, AXIS_TP if kh_div else None, None
+            AXIS_PP if pp > 1 else None,  # pool's L dim lives per stage
+            None, None,
+            AXIS_TP if kh_div else None,
+            None,
         )
         self._cache_sharding = jax.sharding.NamedSharding(self.mesh, cache_spec)
         self.cache = jax.device_put(
             cache, {"k": self._cache_sharding, "v": self._cache_sharding}
         )
+        # per-slot block tables (-1 = unmapped) + valid-entry counts
+        self.block_table = np.full((b, self.max_blocks_per_seq), -1, np.int32)
+        self._slot_nblocks = np.zeros(b, np.int64)
+        self._slot_last_use = np.zeros(b, np.float64)
 
         self._rng_base = jax.random.PRNGKey(config.random_seed)
         self._rng_counter = 0
@@ -257,24 +305,22 @@ class GenerationEngine:
             donate_argnums=(1,),
             static_argnames=("steps",),
         )
-        self._jit_copy_kv = jax.jit(self._copy_kv_impl, donate_argnums=(0,))
+        self._jit_copy_block = jax.jit(
+            self._copy_block_impl, donate_argnums=(0,)
+        )
         self._jit_extend = jax.jit(self._extend_impl, donate_argnums=(1,))
         # qwen2_vl prefill retraces per (grid signature, bucket) — the image
         # grid is a static shape input like prefill buckets
         self._jit_cache_vlm: dict = {}
 
     @staticmethod
-    def _copy_kv_impl(cache, src, dst, n):
-        """Copy the first ``n`` cache rows of slot ``src`` into ``dst``
-        (cache leaves are [L, B, S, KH, D]; one fused masked select per
-        leaf — no host roundtrip of KV data)."""
+    def _copy_block_impl(cache, src_blk, dst_blk):
+        """Copy ONE physical block (copy-on-write for a shared partial tail
+        block): [L, BS, KH, D] moved pool-internally, no host roundtrip."""
 
         def cp(x):
-            rows = jax.lax.dynamic_index_in_dim(x, src, 1, keepdims=False)
-            dst_rows = jax.lax.dynamic_index_in_dim(x, dst, 1, keepdims=False)
-            mask = (jnp.arange(x.shape[2]) < n)[None, :, None, None]
-            new = jnp.where(mask, rows, dst_rows)
-            return jax.lax.dynamic_update_index_in_dim(x, new, dst, 1)
+            row = jax.lax.dynamic_index_in_dim(x, src_blk, 1, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(x, row, dst_blk, 1)
 
         return {"k": cp(cache["k"]), "v": cp(cache["v"])}
 
@@ -286,82 +332,83 @@ class GenerationEngine:
         self,
         params,
         cache,
-        ids,  # [N, Tp] — N prompts in one packed dispatch
-        lengths,  # [N]
-        slots,  # [N]
+        ids,  # [Tb] ragged packed stream — ANY mix of prompt lengths
+        positions,  # [Tb] within-prompt positions
+        segment_ids,  # [Tb] prompt index, pad = -1
+        last_idx,  # [N] stream index of each prompt's final token
+        token_blocks,  # [Tb] physical block per token (trash for pads)
+        token_offsets,  # [Tb] row within each block
         rng,
         temp,  # [N]
         top_k,
         top_p,
         greedy,
-        pixels=None,  # [Nimg, S, S, 3] (mini) / [P, pd] (qwen2_vl), N == 1
-        positions3=None,  # [3, N*Tp] qwen2_vl M-RoPE positions
+        pixels=None,  # [Nimg, S, S, 3] (mini) / [P, pd] (qwen2_vl)
+        positions3=None,  # [3, Tb] qwen2_vl M-RoPE positions
         image_grid_thw=None,  # static (jit-partial-bound) qwen2_vl grids
     ):
-        logits, ks, vs = prefill_many(
-            params, self.model_config, ids, lengths, attn_spec=self.attn_spec,
-            pixel_values=pixels, positions3=positions3,
-            image_grid_thw=image_grid_thw,
-        )
-        toks, logps = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
-        # write each prompt's [L, Tp, KH, D] rows into its slot's cache
-        # region; N is static, so this unrolls into N updates. Zero-length
-        # rows are batch padding: their write is masked to a no-op (the
-        # read-modify keeps the target slot's rows intact).
-        k_cache, v_cache = cache["k"], cache["v"]
-        tp = ids.shape[1]
+        if self._pp > 1:
+            from areal_tpu.parallel.pipeline import prefill_stream_pp
 
-        def write(cache_arr, new_rows, i):
-            new = new_rows[:, i][:, None].astype(cache_arr.dtype)
-            if ids.shape[0] > 1:
-                sz = (cache_arr.shape[0], 1, tp) + cache_arr.shape[3:]
-                cur = jax.lax.dynamic_slice(
-                    cache_arr, (0, slots[i], 0, 0, 0), sz
-                )
-                new = jnp.where(lengths[i] > 0, new, cur)
-            return jax.lax.dynamic_update_slice(
-                cache_arr, new, (0, slots[i], 0, 0, 0)
+            logits, cache = prefill_stream_pp(
+                params, self.model_config, cache, ids, positions,
+                segment_ids, last_idx, token_blocks, token_offsets,
+                self.mesh, attn_spec=self.attn_spec, positions3=positions3,
             )
+        else:
+            logits, ks, vs = prefill_stream(
+                params, self.model_config, ids, positions, segment_ids,
+                last_idx, attn_spec=self.attn_spec, pixel_values=pixels,
+                positions3=positions3, image_grid_thw=image_grid_thw,
+            )
+            # scatter the stream's K/V rows into the prompts' allocated
+            # blocks; pad rows (stream tail, dummy rows) carry trash ids
+            cache = write_prefill_blocks(
+                cache, ks, vs, token_blocks, token_offsets
+            )
+        toks, logps = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
+        return toks, logps, cache
 
-        for i in range(ids.shape[0]):
-            k_cache = write(k_cache, ks, i)
-            v_cache = write(v_cache, vs, i)
-        return toks, logps, {"k": k_cache, "v": v_cache}
-
-    def _extend_impl(self, params, cache, ids, start_len, slot):
-        """Suffix prefill for ONE slot: run ``ids`` [1, Tq] through the
-        model against the slot's existing ``start_len`` cache rows (the
-        shared prefix) and write their K/V at positions
-        [start_len, start_len+Tq). Logits are discarded — the caller leaves
-        the final prompt token for the decode feed, same as the clone path.
+    def _extend_impl(self, params, cache, ids, start_len, table):
+        """Suffix prefill for ONE sequence: run ``ids`` [1, Tq] against the
+        ``start_len`` prefix rows reachable through ``table`` [1, NBT] and
+        write the suffix K/V at positions [start_len, start_len+Tq).
+        Logits are discarded — the caller leaves the final prompt token for
+        the decode feed, same as the clone path.
 
         Tq is a padded bucket; pad tokens write garbage rows beyond the true
         suffix, which is safe: each such position is overwritten by its real
         token (one decode write per position) strictly before any query can
         attend it (decode masks kpos <= qpos and positions fill in order).
-
-        The slot's rows are sliced out so the dispatch costs O(Tq · model),
-        not O(B · Tq · model), and other slots' caches are untouched."""
-
-        def getslot(x):
-            return jax.lax.dynamic_slice(
-                x, (0, slot, 0, 0, 0), (x.shape[0], 1) + x.shape[2:]
-            )
-
-        sub = {"k": getslot(cache["k"]), "v": getslot(cache["v"])}
-        _, sub = decode_step(
-            params, self.model_config, sub, ids,
+        The dispatch costs O(Tq · model), not O(B · Tq · model)."""
+        _, cache = self._paged_decode(
+            params, cache, ids,
             jnp.reshape(start_len, (1,)).astype(jnp.int32),
-            attn_spec=self.attn_spec,
+            table,
+            jnp.ones((1,), bool),
             compute_logits=False,
         )
+        return cache
 
-        def put(x, s):
-            return jax.lax.dynamic_update_slice(
-                x, s.astype(x.dtype), (0, slot, 0, 0, 0)
+    def _paged_decode(
+        self, params, cache, ids, clen, table, active,
+        compute_logits=True, pos_offset=None,
+    ):
+        """Single dispatch of paged decode, routed through the pipeline
+        conveyor when the engine serves with pp > 1."""
+        if self._pp > 1:
+            from areal_tpu.parallel.pipeline import decode_step_paged_pp
+
+            return decode_step_paged_pp(
+                params, self.model_config, cache, ids, clen, table, active,
+                self.mesh, attn_spec=self.attn_spec,
+                compute_logits=compute_logits, pos_offset=pos_offset,
             )
-
-        return {"k": put(cache["k"], sub["k"]), "v": put(cache["v"], sub["v"])}
+        return decode_step_paged(
+            params, self.model_config, cache, ids, clen, table, active,
+            attn_spec=self.attn_spec, compute_logits=compute_logits,
+            pos_offset=pos_offset,
+        )
 
     def _decode_impl(
         self,
@@ -369,6 +416,7 @@ class GenerationEngine:
         cache,
         last_tokens,  # [B]
         cache_len,  # [B]
+        block_table,  # [B, NBT] bucketed to the longest live sequence
         active,  # [B] bool
         rng,
         temp,
@@ -380,9 +428,9 @@ class GenerationEngine:
     ):
         def step(carry, step_rng):
             tokens, cache, clen = carry
-            logits, cache = decode_step(
-                params, self.model_config, cache, tokens[:, None], clen,
-                attn_spec=self.attn_spec, pos_offset=pos_delta,
+            logits, cache = self._paged_decode(
+                params, cache, tokens[:, None], clen,
+                block_table, active, pos_offset=pos_delta,
             )
             nxt, logp = sample_tokens(
                 logits[:, 0], step_rng, temp, top_k, top_p, greedy
@@ -435,6 +483,68 @@ class GenerationEngine:
     def _max_bucket(self) -> int:
         return self.config.max_seq_len
 
+    def _stream_bucket(self, n: int) -> int:
+        """Static bucket for the ragged prefill stream's TOTAL length —
+        same ladder as _bucket but uncapped (a stream packs many prompts,
+        so it may exceed max_seq_len)."""
+        chunk = self.config.prefill_chunk
+        b = 64
+        while b < min(n, chunk):
+            b *= 2
+        if n <= b:
+            return b
+        return -(-n // chunk) * chunk
+
+    # ------------------------------------------------------------------
+    # KV block management (host side)
+    # ------------------------------------------------------------------
+
+    def _free_slot_blocks(self, i: int):
+        """Release slot ``i``'s block references and clear its cached-prefix
+        state. Never call on an active slot."""
+        n = int(self._slot_nblocks[i])
+        if n:
+            self.pool.decref(self.block_table[i, :n])
+        self.block_table[i, :] = -1
+        self._slot_nblocks[i] = 0
+        self._slot_covered[i] = []
+        self.cache_len[i] = 0
+        self._slot_kv_version[i] = 0
+
+    def _reclaim_blocks(self) -> bool:
+        """Free one inactive slot's cached blocks (LRU). Plain
+        finished-slot prefix caches go first; retained abort-resume state
+        is evicted only when nothing else is left (its loss forces a full
+        re-prefill on resume)."""
+        cands = [
+            i
+            for i, s in enumerate(self.slots)
+            if s is None
+            and i not in self._retained_slots
+            and self._slot_nblocks[i] > 0
+        ]
+        if cands:
+            self._free_slot_blocks(
+                min(cands, key=lambda j: self._slot_last_use[j])
+            )
+            return True
+        if self._retained:
+            self._evict_lru_retained()  # demotes its slot to plain-cached
+            return self._reclaim_blocks()
+        return False
+
+    def _alloc_blocks(self, n: int) -> list[int]:
+        """Allocate ``n`` blocks, evicting cached prefixes as needed.
+        Raises OutOfBlocks when live sequences hold everything."""
+        if n <= 0:
+            return []
+        while True:
+            try:
+                return self.pool.alloc(n)
+            except OutOfBlocks:
+                if not self._reclaim_blocks():
+                    raise
+
     @property
     def eos_token_id(self) -> int | None:
         if self.tokenizer is not None:
@@ -486,7 +596,7 @@ class GenerationEngine:
             got = sum(
                 1 for t in input_ids if t == self.model_config.image_token_id
             )
-            if self.model_config.vision_arch == "qwen2_vl":
+            if self.model_config.is_qwen_vl:
                 # HF-processor payloads: {"pixel_values": [P_i, pd],
                 # "grid_thw": [t, h, w]} per image
                 images, grids = [], []
@@ -851,15 +961,20 @@ class GenerationEngine:
             if self.n_running == 0
             else max(self.config.prefill_chunk * 4, 512)
         )
-        pending: list[_Seq] = []  # text prompts awaiting a batched prefill
+        pending: list[_Seq] = []  # prompts awaiting one packed prefill
         pending_slots: list[int] = []
-        pending_bucket = [0]
+        pending_blocks: list[list[int]] = []
+        pending_tokens = [0]
 
         def flush():
             if pending:
-                self._prefill_seqs(list(pending), list(pending_slots))
+                self._prefill_seqs(
+                    list(pending), list(pending_slots), list(pending_blocks)
+                )
                 pending.clear()
                 pending_slots.clear()
+                pending_blocks.clear()
+                pending_tokens[0] = 0
 
         while token_budget > 0 and not self._input_queue.empty():
             try:
@@ -893,35 +1008,55 @@ class GenerationEngine:
                 and self.config.enable_prefix_reuse
                 and len(seq.prompt) >= 2
             ):
-                # a same-prompt twin sitting in the pending batch can serve
-                # as a clone source once its KV lands — flush first so a
-                # sampling group costs ONE prefill + n-1 row copies, not n
-                # packed prefills
-                prefix = tuple(seq.prompt[:-1])
-                if any(
-                    len(p.prompt) >= len(prefix)
-                    and tuple(p.prompt[: len(prefix)]) == prefix
-                    for p in pending
-                ):
+                # a prompt sharing a reusable prefix with a PENDING request
+                # flushes the batch first, so its KV lands and this request
+                # admits by block-sharing instead of re-prefilling: a full
+                # twin (sampling group) costs ONE prefill + n-1 clones, and
+                # a long shared system/few-shot prefix costs one prefill +
+                # cheap suffix extensions
+                prefix = np.asarray(seq.prompt[:-1])
+                best = 0
+                for p in pending:
+                    m = min(len(p.prompt), prefix.size)
+                    if m <= best:
+                        continue
+                    d = np.flatnonzero(
+                        np.asarray(p.prompt[:m]) != prefix[:m]
+                    )
+                    best = max(best, int(d[0]) if d.size else m)
+                if best >= min(
+                    prefix.size, self.config.prefix_extend_min
+                ) and best > 0:
                     flush()
             if self._try_clone(seq, free[0]):
-                continue  # one KV row copy, no prefill compute
-            if seq.images:
-                # image prompts dispatch alone (per-dispatch pixel table)
-                self._prefill_seq(seq, free[0])
-            else:
-                b = self._bucket(len(seq.prompt))
-                if pending and b != pending_bucket[0]:
-                    # one bucket per packed dispatch: mixed lengths would
-                    # make every row pay the longest row's non-attention
-                    # compute and break the token-budget accounting
-                    flush()
-                pending.append(seq)
-                pending_slots.append(free[0])
-                pending_bucket[0] = b
-                if len(pending) >= self.config.prefill_batch:
-                    flush()
-            token_budget -= self._bucket(len(seq.prompt))
+                continue  # block sharing + at most one block copy
+            # a fresh prefill owns its blocks exclusively: release the
+            # slot's old cached prefix, then draw blocks for the prompt
+            self._free_slot_blocks(free[0])
+            try:
+                blocks = self._alloc_blocks(
+                    self.pool.blocks_for_tokens(len(seq.prompt))
+                )
+            except OutOfBlocks:
+                self._input_queue.put(seq)  # pool full of live sequences
+                flush()
+                return
+            # ragged packed prefill: mixed lengths and image prompts all
+            # join the same stream; flush first when this prompt would
+            # push the dispatch past the stream cap
+            cap = max(
+                self.config.prefill_chunk * self.config.prefill_batch,
+                self._stream_bucket(len(seq.prompt)),
+            )
+            if pending and pending_tokens[0] + len(seq.prompt) > cap:
+                flush()
+            pending.append(seq)
+            pending_slots.append(free[0])
+            pending_blocks.append(blocks)
+            pending_tokens[0] += len(seq.prompt)
+            if len(pending) >= self.config.prefill_batch:
+                flush()
+            token_budget -= len(seq.prompt)
         flush()
 
     def _try_resume(self, seq: _Seq) -> bool:
@@ -991,19 +1126,69 @@ class GenerationEngine:
         if best < n - 1:
             if best < self.config.prefix_extend_min:
                 return False  # too little sharing to beat a batched prefill
-            # the padded suffix write must fit the cache: dynamic_update_slice
-            # CLAMPS an out-of-bounds start, which would shift the write back
-            # over the shared-prefix rows and corrupt them
+            # the padded suffix write must stay inside the per-sequence
+            # block-table range
             if best + self._bucket(n - 1 - best) > self.config.max_seq_len:
                 return False
-        self.prompt_tokens_total += len(seq.prompt)
-        if src != dst:
-            self.cache = self._jit_copy_kv(
-                self.cache, jnp.int32(src), jnp.int32(dst), jnp.int32(best)
+        # Block-level sharing (vLLM/SGLang copy-on-write discipline): full
+        # blocks of the shared prefix are REFERENCED, not copied; only the
+        # partially-filled tail block — which this sequence will append
+        # into — is copied. Pin every source block first so pool eviction
+        # during allocation cannot free rows we are about to use.
+        bs = self.block_size
+        nfull = best // bs
+        partial = best % bs
+        src_ids = self.block_table[src, : nfull + (1 if partial else 0)].copy()
+        # snapshot BEFORE any table mutation: the in-place branch (and a
+        # reclaim triggered by _alloc_blocks) can zero src's version while
+        # its rows are pinned and still perfectly current
+        src_kv_version = self._slot_kv_version[src]
+        self.pool.incref(src_ids)
+        if dst != src:
+            self._free_slot_blocks(dst)
+        else:
+            # in-place reuse: drop the old table (its full-prefix blocks are
+            # the very src_ids we just pinned; surplus tail blocks free).
+            # Clear the covered-tokens state too — a failed allocation below
+            # must not leave covered tokens pointing at a dropped table.
+            old_n = int(self._slot_nblocks[dst])
+            self.pool.decref(self.block_table[dst, :old_n])
+            self.block_table[dst, :] = -1
+            self._slot_nblocks[dst] = 0
+            self._slot_covered[dst] = []
+            self.cache_len[dst] = 0
+            self._slot_kv_version[dst] = 0
+        if best == n - 1:
+            extra = 0  # decode allocates growth blocks on demand
+        else:
+            bucket = self._bucket(n - 1 - best)
+            extra = (
+                self.pool.blocks_for_tokens(best + bucket)
+                - nfull
+                - (1 if partial else 0)
             )
+        try:
+            fresh = self._alloc_blocks((1 if partial else 0) + max(extra, 0))
+        except OutOfBlocks:
+            self.pool.decref(src_ids)
+            return False
+        new_table = list(src_ids[:nfull])
+        if partial:
+            # copy-on-write of the shared tail block
+            tail = fresh.pop(0)
+            self.cache = self._jit_copy_block(
+                self.cache, jnp.int32(src_ids[nfull]), jnp.int32(tail)
+            )
+            self.pool.decref([src_ids[nfull]])  # pin released; we keep a copy
+            new_table.append(tail)
+        new_table.extend(fresh)
+        self.block_table[dst, : len(new_table)] = new_table
+        self.block_table[dst, len(new_table):] = -1
+        self._slot_nblocks[dst] = len(new_table)
+        self.prompt_tokens_total += len(seq.prompt)
         if best == n - 1:
             self.prefix_clone_count += 1
-            self._slot_kv_version[dst] = self._slot_kv_version[src]
+            self._slot_kv_version[dst] = src_kv_version
         else:
             # suffix extension over prompt[best : n-1] (bucket-padded; pad
             # rows are overwritten before they're ever attended — see
@@ -1012,9 +1197,18 @@ class GenerationEngine:
             bucket = self._bucket(len(suffix))
             ids = np.zeros((1, bucket), np.int32)
             ids[0, : len(suffix)] = suffix
+            # pad the table width to a power of two (like _decode_chunk):
+            # arbitrary widths would recompile the model-sized extend
+            # program per distinct prefix length; surplus -1 entries gather
+            # the trash block and are masked by position
+            nbt = 1
+            while nbt < len(new_table):
+                nbt *= 2
+            nbt = min(nbt, self.max_blocks_per_seq)
             self.cache = self._jit_extend(
                 self.params, self.cache, jnp.asarray(ids),
-                jnp.int32(best), jnp.int32(dst),
+                jnp.int32(best),
+                jnp.asarray(self.block_table[dst, :nbt][None]),
             )
             self.prefix_extend_count += 1
             self.prefix_extend_saved_tokens += best
@@ -1025,74 +1219,98 @@ class GenerationEngine:
         self.last_token[dst] = seq.prompt[-1]
         self.pos_delta[dst] = 0  # clone/extension sources are text-only
         self._slot_covered[dst] = list(prefix)
+        self._slot_last_use[dst] = time.monotonic()
         return True
 
-    def _prefill_seq(self, seq: _Seq, slot: int):
-        self._prefill_seqs([seq], [slot])
-
-    def _prefill_seqs(self, seqs: list[_Seq], slots: list[int]):
-        """One packed prefill dispatch for up to ``prefill_batch`` prompts
-        (image-carrying requests always go alone — the pixel table is per
-        dispatch)."""
+    def _prefill_seqs(
+        self, seqs: list[_Seq], slots: list[int], blocks: list[list[int]]
+    ):
+        """One ragged packed prefill dispatch: ANY mix of prompt lengths —
+        and image prompts — share a single [Tb] segment-id stream
+        (attention block-skipping keeps cost at the sum of per-prompt
+        quadratics). ``blocks[i]`` are slot i's freshly allocated KV blocks
+        (covering its prompt); stream-tail and dummy-row writes are routed
+        to the trash block."""
         self.prefill_count += len(seqs)
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
-        # two compiled shapes per bucket, not prefill_batch: singles keep
-        # the [1, Tp] program (no overhead for the common lone admission);
-        # groups pad to a FIXED [prefill_batch, Tp] with zero-length dummy
-        # rows (pad segments, masked cache writes)
+        # compiled-shape control: the stream length buckets like prompt
+        # lengths did; the segment count pads to prefill_batch (singles
+        # keep a lone-row program for the common case)
         n_rows = 1 if len(seqs) == 1 else self.config.prefill_batch
-        bucket = self._bucket(max(len(s.prompt) for s in seqs))
-        ids = np.zeros((n_rows, bucket), np.int32)
-        lengths = np.zeros(n_rows, np.int32)
+        total = sum(len(s.prompt) for s in seqs)
+        tb = self._stream_bucket(total)
+        bs = self.block_size
+        ids = np.zeros(tb, np.int32)
+        positions = np.zeros(tb, np.int32)
+        segment_ids = np.full(tb, -1, np.int32)
+        last_idx = np.full(n_rows, tb - 1, np.int32)  # dummy rows -> pad tail
         temp = np.ones(n_rows, np.float32)
         top_k = np.zeros(n_rows, np.int32)
         top_p = np.ones(n_rows, np.float32)
         greedy = np.zeros(n_rows, bool)
-        row_slots = np.zeros(n_rows, np.int32)
+        token_blocks = np.full(tb, TRASH_BLOCK, np.int32)
+        token_offsets = np.zeros(tb, np.int32)
+        has_images = any(s.images for s in seqs)
+        mrope = has_images and self.model_config.is_qwen_vl
+        pos3 = np.zeros((3, tb), np.int64) if mrope else None
+        cursor = 0
         for i, s in enumerate(seqs):
             n = len(s.prompt)
-            ids[i, :n] = s.prompt
-            lengths[i] = n
-            row_slots[i] = slots[i]
+            sl = slice(cursor, cursor + n)
+            ids[sl] = s.prompt
+            positions[sl] = np.arange(n)
+            segment_ids[sl] = i
+            last_idx[i] = cursor + n - 1
+            blk_row = np.asarray(blocks[i], np.int32)
+            token_blocks[sl] = blk_row[np.arange(n) // bs]
+            token_offsets[sl] = np.arange(n) % bs
+            if mrope:
+                if s.grids:
+                    from areal_tpu.models.vlm_qwen2 import mrope_positions
+
+                    p3 = mrope_positions(
+                        self.model_config, np.asarray(s.prompt), tuple(s.grids)
+                    )
+                    self.pos_delta[slots[i]] = int(p3.max() + 1 - n)
+                else:
+                    p3 = np.broadcast_to(np.arange(n), (3, n))
+                    self.pos_delta[slots[i]] = 0
+                pos3[:, sl] = p3
+            else:
+                self.pos_delta[slots[i]] = 0
             g = s.gconfig
             temp[i], top_k[i], top_p[i], greedy[i] = (
                 g.temperature, g.top_k, g.top_p, g.greedy,
             )
+            cursor += n
         args = (
             self.params,
             self.cache,
             jnp.asarray(ids),
-            jnp.asarray(lengths),
-            jnp.asarray(row_slots),
+            jnp.asarray(positions),
+            jnp.asarray(segment_ids),
+            jnp.asarray(last_idx),
+            jnp.asarray(token_blocks),
+            jnp.asarray(token_offsets),
             self._next_rng(),
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
             jnp.asarray(greedy),
         )
-        if any(s.images for s in seqs):
-            assert len(seqs) == 1, "image prompts prefill alone"
-            seq0 = seqs[0]
-            if self.model_config.vision_arch == "qwen2_vl":
-                from areal_tpu.models.vlm_qwen2 import mrope_positions
-
+        if has_images:
+            if mrope:
+                # pixel table + grids concatenate in stream order across
+                # every image-carrying prompt in the dispatch
                 pixels = jnp.asarray(
-                    np.concatenate(seq0.images, 0), jnp.float32
+                    np.concatenate(
+                        [a for s in seqs if s.images for a in s.images], 0
+                    ),
+                    jnp.float32,
                 )
-                grids = tuple(seq0.grids)
-                pos3 = mrope_positions(
-                    self.model_config, np.asarray(seq0.prompt), grids
-                )
-                # bucket padding continues the text positions
-                pad = bucket - pos3.shape[1]
-                if pad > 0:
-                    tail = pos3[:, -1:] + np.arange(1, pad + 1)
-                    pos3 = np.concatenate([pos3, tail], 1)
-                self.pos_delta[slots[0]] = int(
-                    pos3[:, : len(seq0.prompt)].max() + 1 - len(seq0.prompt)
-                )
-                key = ("prefill_vlm", grids, bucket)
+                grids = tuple(g for s in seqs if s.grids for g in s.grids)
+                key = ("prefill_vlm", grids, tb, n_rows)
                 if key not in self._jit_cache_vlm:
                     # grids are unbounded user input (native-resolution
                     # images): bound the per-signature executable cache so
@@ -1112,11 +1330,14 @@ class GenerationEngine:
                     *args, pixels, jnp.asarray(pos3.astype(np.int32)),
                 )
             else:
-                pixels = jnp.asarray(np.stack(seq0.images), jnp.float32)
+                pixels = jnp.asarray(
+                    np.stack(
+                        [a for s in seqs if s.images for a in s.images]
+                    ),
+                    jnp.float32,
+                )
                 toks, logps, self.cache = self._jit_prefill(*args, pixels)
         else:
-            for slot in slots:
-                self.pos_delta[slot] = 0
             toks, logps, self.cache = self._jit_prefill(*args)
         now = time.monotonic()
         toks = np.asarray(toks)
@@ -1136,6 +1357,10 @@ class GenerationEngine:
             self.cache_len[slot] = len(seq.prompt)
             self.last_token[slot] = tok_i
             self._slot_covered[slot] = list(seq.prompt)
+            self.block_table[slot, : len(blocks[i])] = blocks[i]
+            self.block_table[slot, len(blocks[i]):] = -1
+            self._slot_nblocks[slot] = len(blocks[i])
+            self._slot_last_use[slot] = now
             # image-conditioned rows encode pixels the token ids don't
             # show; stamp -1 so they can never be cloned into a text request
             self._slot_kv_version[slot] = -1 if seq.images else self.version
@@ -1171,15 +1396,66 @@ class GenerationEngine:
                 return "stop"
         return "length"
 
+    def _grow_tables(self, steps: int) -> int:
+        """Ensure every active slot's block table covers cache_len + steps
+        tokens; under pool pressure, evict cached prefixes, then preempt the
+        youngest other active sequence (abort — the client's interrupt loop
+        re-issues it). Returns the table width (blocks) this chunk needs."""
+        nbt = 1
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            need = min(
+                self.pool.blocks_for_tokens(int(self.cache_len[i]) + steps),
+                self.max_blocks_per_seq,
+            )
+            nbt = max(nbt, need)
+            have = int(self._slot_nblocks[i])
+            while need > have:
+                try:
+                    new = self._alloc_blocks(need - have)
+                except OutOfBlocks:
+                    victims = [
+                        j
+                        for j, q in enumerate(self.slots)
+                        if q is not None and j != i
+                    ]
+                    if not victims:
+                        # init guarantees one max-length sequence fits once
+                        # caches and other actives are gone
+                        raise
+                    v = max(victims, key=lambda j: self.slots[j].t_submit)
+                    logger.warning(
+                        "KV pool exhausted: preempting rid=%s (slot %d)",
+                        self.slots[v].rid, v,
+                    )
+                    self._finish(v, "abort", retain=False)
+                    self._free_slot_blocks(v)
+                    continue
+                self.block_table[i, have : have + len(new)] = new
+                self._slot_nblocks[i] = have + len(new)
+                have += len(new)
+        return nbt
+
     def _decode_chunk(self):
         b = self.config.max_batch_size
-        active = np.array([s is not None for s in self.slots])
         # never decode past any active slot's cache capacity
         steps = self.config.decode_steps_per_call
         for i, s in enumerate(self.slots):
             if s is not None:
                 steps = min(steps, self.config.max_seq_len - int(self.cache_len[i]))
         steps = max(steps, 1)
+        nbt = self._grow_tables(steps)
+        if self.n_running == 0:
+            return  # everything was preempted while growing tables
+        active = np.array([s is not None for s in self.slots])
+        # bucket the table width to powers of two: the gather view scales
+        # with the LONGEST live sequence, not max_seq_len, and the compile
+        # count stays logarithmic
+        w = 1
+        while w < nbt:
+            w *= 2
+        nbt = min(w, self.max_blocks_per_seq)
         temp = np.ones(b, np.float32)
         top_k = np.zeros(b, np.int32)
         top_p = np.ones(b, np.float32)
@@ -1198,6 +1474,7 @@ class GenerationEngine:
             self.cache,
             jnp.asarray(self.last_token),
             jnp.asarray(self.cache_len),
+            jnp.asarray(self.block_table[:, :nbt]),
             jnp.asarray(active),
             self._next_rng(),
             jnp.asarray(temp),
@@ -1227,6 +1504,7 @@ class GenerationEngine:
                 # the fed token's K/V row was just written at cache_len
                 self._slot_covered[i].append(int(self.last_token[i]))
                 self.cache_len[i] += 1
+                self._slot_last_use[i] = now
                 self.last_token[i] = tok
                 if self._seq_finished(seq, tok):
                     self._finish(i, self._finish_reason(seq, tok))
@@ -1249,24 +1527,17 @@ class GenerationEngine:
                 time.monotonic(),
             )
             self._retained_slots[slot] = seq.rid
-        elif self.cache_len[slot] >= self.config.max_seq_len:
-            # a full slot leaves no row for the idle decode write (the
-            # dense per-slot write would clamp INTO the covered rows)
-            self.cache_len[slot] = 0
-            self._slot_covered[slot] = []
-        # else: keep cache_len and covered — the rows stay valid as
-        # prefix-clone sources, and decode's idle write for this inactive
-        # slot lands at cache_len, one past the covered rows (harmless)
+        # keep cache_len, covered tokens, and the block table — the rows
+        # stay valid as prefix-clone sources until the pool reclaims them
+        # (inactive lanes write to the trash block, so a full table poses
+        # no idle-write hazard)
+        self._slot_last_use[slot] = time.monotonic()
         seq.on_done(self._response(seq, reason))
 
     def _evict_retained(self, rid: str):
         ent = self._retained.pop(rid, None)
         if ent is not None:
-            slot = ent[0]
-            self._retained_slots.pop(slot, None)
-            if self.cache_len[slot] >= self.config.max_seq_len:
-                self.cache_len[slot] = 0
-                self._slot_covered[slot] = []
+            self._retained_slots.pop(ent[0], None)
             # rows stay valid (see _finish): still a prefix-clone source
 
     def _evict_lru_retained(self):

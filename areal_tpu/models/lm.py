@@ -160,7 +160,7 @@ def init_params(
             next(keys), (cfg.max_position_embeddings, h), s
         )
     if cfg.is_vlm:
-        if cfg.vision_arch == "qwen2_vl":
+        if cfg.is_qwen_vl:
             from areal_tpu.models.vlm_qwen2 import init_qwen2vl_vision_params
 
             params["vision"] = init_qwen2vl_vision_params(
@@ -418,7 +418,7 @@ def _trunk(
     if pixel_values is not None:
         from areal_tpu.models.vlm import splice_image_embeds
 
-        if cfg.vision_arch == "qwen2_vl":
+        if cfg.is_qwen_vl:
             # HF-parity tower: pixel_values is the processor's flattened
             # patch stream [P, C*tps*ps*ps] + static grid (vlm_qwen2.py)
             from areal_tpu.models.vlm_qwen2 import encode_images_qwen2vl
@@ -553,6 +553,137 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def init_paged_kv_cache(
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> Params:
+    """Flat paged KV pool: ``[L, num_blocks, block_size, KH, D]``.
+
+    Sequences own *block tables* (rows of physical block ids) instead of a
+    dense ``[B, max_seq]`` slab, so HBM scales with tokens actually cached
+    (the role SGLang's paged allocator plays for the reference,
+    patch/sglang/v0.5.2.patch). Block 0 is the trash block — padding and
+    inactive-lane writes are routed there (block_pool.TRASH_BLOCK).
+    """
+    shape = (
+        cfg.num_hidden_layers,
+        num_blocks,
+        block_size,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def write_prefill_blocks(
+    cache: Params,
+    ks: jnp.ndarray,  # [L, N, Tp, KH, D] from prefill_many
+    vs: jnp.ndarray,
+    token_blocks: jnp.ndarray,  # [...] physical block per token (trash=0)
+    token_offsets: jnp.ndarray,  # [...] row within the block
+) -> Params:
+    """Scatter freshly-prefilled K/V rows into their sequences' blocks.
+
+    Token-granular: K/V row j lands at ``(token_blocks[j],
+    token_offsets[j])`` (any leading shape — [T] streams and [N, Tp]
+    buckets alike), so prefill layouts need no block alignment; pad rows
+    (bucket tails, zero-length batch fillers) carry the trash block id.
+    ``ks``/``vs`` are [L, *token_shape, KH, D].
+    """
+    l = ks.shape[0]
+    ids = token_blocks.reshape(-1)
+    off = token_offsets.reshape(-1)
+
+    def scatter(pool, new):
+        rows = new.reshape(l, ids.shape[0], *new.shape[-2:]).astype(pool.dtype)
+        return pool.at[:, ids, off].set(rows, mode="drop")
+
+    return {"k": scatter(cache["k"], ks), "v": scatter(cache["v"], vs)}
+
+
+def decode_step_paged(
+    params: Params,
+    cfg: TransformerConfig,
+    cache: Params,  # paged pool {k, v: [L, NB, BS, KH, D]}
+    input_ids: jnp.ndarray,  # [B, Tq]
+    cache_len: jnp.ndarray,  # [B] valid tokens per sequence BEFORE this call
+    block_table: jnp.ndarray,  # [B, NBT] physical block ids (-1 = unmapped)
+    active: jnp.ndarray,  # [B] bool — inactive lanes write to the trash block
+    attn_spec: AttnSpec | None = None,
+    compute_logits: bool = True,
+    pos_offset: jnp.ndarray | None = None,  # [B] rope-position shift (M-RoPE)
+) -> tuple[jnp.ndarray | None, Params]:
+    """Paged-KV decode: ``decode_step`` against a block pool.
+
+    New tokens' K/V scatter into ``block_table[b, p // BS]`` at offset
+    ``p % BS`` (p = cache_len + t); attention gathers the table's blocks
+    into a ``[B, NBT*BS]`` view and masks by position, so the per-dispatch
+    transient scales with the table width the caller passes (bucketed to
+    the longest live sequence), while the *persistent* pool scales with
+    tokens actually cached. Returns (logits [B, Tq, V] | None, pool).
+    """
+    b, tq = input_ids.shape
+    nbt = block_table.shape[1]
+    bs = cache["k"].shape[2]
+    write_pos = cache_len[:, None] + jnp.arange(tq)[None, :]  # [B, Tq]
+    rope_pos = write_pos
+    if pos_offset is not None:
+        rope_pos = rope_pos + pos_offset[:, None]
+    x = _embed(params, cfg, input_ids, rope_pos)  # [B, Tq, H]
+
+    # physical write targets, computed once (loop-invariant across layers)
+    li = jnp.clip(write_pos // bs, 0, nbt - 1)  # [B, Tq] logical block idx
+    phys = jnp.take_along_axis(block_table, li, axis=1)  # [B, Tq]
+    phys = jnp.where(active[:, None], jnp.maximum(phys, 0), 0)
+    off = write_pos % bs
+    flat_phys = phys.reshape(-1)
+    flat_off = off.reshape(-1)
+    # gather view of the table (trash for unmapped entries; masked anyway)
+    gather_ids = jnp.maximum(block_table, 0)  # [B, NBT]
+
+    def body(carry, layer_in):
+        (h_in,) = carry
+        lp, k_pool, v_pool = layer_in
+        h = _norm(cfg, h_in, lp["ln1"], lp.get("ln1_b"))
+        q, k, v = _qkv(cfg, lp, h)
+        if cfg.pos_embed_type == "rope":
+            q = _rope(cfg, q, rope_pos)
+            k = _rope(cfg, k, rope_pos)
+
+        def write(pool, new):
+            rows = new.reshape(b * tq, *new.shape[2:]).astype(pool.dtype)
+            return pool.at[flat_phys, flat_off].set(rows, mode="drop")
+
+        k_pool = write(k_pool, k)
+        v_pool = write(v_pool, v)
+        k_view = k_pool[gather_ids].reshape(b, nbt * bs, *k_pool.shape[2:])
+        v_view = v_pool[gather_ids].reshape(b, nbt * bs, *v_pool.shape[2:])
+        attn = decode_attention_xla(
+            q, k_view, v_view, cache_len + tq, window=cfg.sliding_window
+        )
+        attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
+        if cfg.proj_bias:
+            attn_out = attn_out + lp["bo"]
+        h_out = h_in + attn_out
+        h2 = _norm(cfg, h_out, lp["ln2"], lp.get("ln2_b"))
+        mlp_out = _mlp(
+            cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
+        ).reshape(h2.shape)
+        h_out = h_out + mlp_out
+        return (h_out,), (k_pool, v_pool)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], cache["k"], cache["v"])
+    )
+    if not compute_logits:
+        return None, {"k": new_k, "v": new_v}
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def prefill(
     params: Params,
     cfg: TransformerConfig,
@@ -581,41 +712,35 @@ def prefill(
     return logits[0], ks[:, 0], vs[:, 0]
 
 
-def prefill_many(
+def prefill_stream(
     params: Params,
     cfg: TransformerConfig,
-    input_ids: jnp.ndarray,  # [N, Tp] int32, each row padded to the bucket
-    lengths: jnp.ndarray,  # [N] int32, true prompt lengths
+    input_ids: jnp.ndarray,  # [T] int32 packed stream (pad tail = anything)
+    positions: jnp.ndarray,  # [T] int32 within-prompt positions
+    segment_ids: jnp.ndarray,  # [T] int32 prompt index, pad = -1
+    last_idx: jnp.ndarray,  # [N] stream index of each prompt's final token
     attn_spec: AttnSpec | None = None,
-    pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3]
-    positions3: jnp.ndarray | None = None,  # [3, N*Tp] qwen2_vl M-RoPE
+    pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3] / [P, pd]
+    positions3: jnp.ndarray | None = None,  # [3, T] qwen2_vl M-RoPE
     image_grid_thw: tuple | None = None,  # qwen2_vl static grids
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Batched prompt pass: N prompts pack into ONE [N*Tp] segment-id stream
-    (the framework's native representation — attention block-skipping keeps
-    the cost at O(sum_i L_i^2), not O((N*Tp)^2)), so a burst of admissions
-    costs one device dispatch instead of N.
+    """Ragged batched prompt pass: ANY mix of prompt lengths packs into ONE
+    [T] segment-id stream (the framework's native representation —
+    attention block-skipping keeps the cost at O(sum_i L_i^2), not O(T^2)),
+    so a mixed 64/512/4k admission burst costs one device dispatch.
 
-    Returns (last_logits [N, V] fp32, k [L, N, Tp, KH, D], v likewise).
+    Returns (last_logits [N, V] fp32, k [L, T, KH, D], v likewise) — the
+    caller scatters K/V rows to its paged cache via (block, offset) maps.
     ``positions3`` carries per-token (t, h, w) M-RoPE streams for qwen2_vl
-    prompts (vlm_qwen2.mrope_positions per row, offset-free per slot).
+    prompts (vlm_qwen2.mrope_positions per prompt, offset-free).
     """
-    n, tp = input_ids.shape
-    pos2d = jnp.broadcast_to(jnp.arange(tp, dtype=jnp.int32), (n, tp))
-    seg2d = jnp.where(
-        pos2d < lengths[:, None],
-        jnp.arange(n, dtype=jnp.int32)[:, None],
-        -1,
-    )
-    positions = pos2d.reshape(-1)
-    segment_ids = seg2d.reshape(-1)
+    t = input_ids.shape[0]
     rope_pos = positions3 if positions3 is not None else positions
-    flat = input_ids.reshape(-1)
-    x = _embed(params, cfg, flat, positions)
+    x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
         from areal_tpu.models.vlm import splice_image_embeds
 
-        if cfg.vision_arch == "qwen2_vl":
+        if cfg.is_qwen_vl:
             from areal_tpu.models.vlm_qwen2 import encode_images_qwen2vl
 
             assert image_grid_thw is not None
@@ -627,7 +752,7 @@ def prefill_many(
             from areal_tpu.models.vlm import encode_images
 
             embeds = encode_images(params["vision"], cfg, pixel_values)
-        x = splice_image_embeds(cfg, x, flat, embeds)
+        x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
         h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
@@ -638,7 +763,7 @@ def prefill_many(
         attn = packed_attention(
             q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
         )
-        attn_out = attn.reshape(n * tp, cfg.q_dim) @ lp["wo"]
+        attn_out = attn.reshape(t, cfg.q_dim) @ lp["wo"]
         if cfg.proj_bias:
             attn_out = attn_out + lp["bo"]
         out = carry + attn_out
@@ -648,12 +773,49 @@ def prefill_many(
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
-    idx = jnp.arange(n, dtype=jnp.int32) * tp + lengths - 1
-    h_last = x[idx]  # [N, H]
+    h_last = x[last_idx]  # [N, H]
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = (h_last @ head).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def prefill_many(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [N, Tp] int32, each row padded to the bucket
+    lengths: jnp.ndarray,  # [N] int32, true prompt lengths
+    attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3]
+    positions3: jnp.ndarray | None = None,  # [3, N*Tp] qwen2_vl M-RoPE
+    image_grid_thw: tuple | None = None,  # qwen2_vl static grids
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Uniform-bucket wrapper over :func:`prefill_stream`: N prompts, each
+    padded to the same Tp, as one packed stream.
+
+    Returns (last_logits [N, V] fp32, k [L, N, Tp, KH, D], v likewise).
+    """
+    n, tp = input_ids.shape
+    pos2d = jnp.broadcast_to(jnp.arange(tp, dtype=jnp.int32), (n, tp))
+    seg2d = jnp.where(
+        pos2d < lengths[:, None],
+        jnp.arange(n, dtype=jnp.int32)[:, None],
+        -1,
+    )
+    idx = jnp.arange(n, dtype=jnp.int32) * tp + lengths - 1
+    logits, ks, vs = prefill_stream(
+        params,
+        cfg,
+        input_ids.reshape(-1),
+        pos2d.reshape(-1),
+        seg2d.reshape(-1),
+        idx,
+        attn_spec=attn_spec,
+        pixel_values=pixel_values,
+        positions3=positions3,
+        image_grid_thw=image_grid_thw,
+    )
     l = ks.shape[0]
     ks = ks.reshape(l, n, tp, *ks.shape[2:])
     vs = vs.reshape(l, n, tp, *vs.shape[2:])

@@ -24,11 +24,39 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compile cache: test time is dominated by CPU compiles of
 # the same tiny-model jits; caching them across runs cuts repeat-suite wall
-# time several-fold (first run pays once). Key includes backend + jax
-# version, so stale hits are not a concern.
+# time several-fold (first run pays once).
+#
+# The cache key does NOT cover host CPU features: XLA:CPU AOT-compiles
+# executables for the build host's ISA extensions, and loading an entry
+# produced on a machine with different features aborts the interpreter
+# (SIGABRT after "could lead to execution errors such as SIGILL"). Guard by
+# keying the cache *directory* with a fingerprint of this host's CPU feature
+# flags — a different host simply gets a fresh directory.
+
+
+def _host_cpu_fingerprint() -> str:
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = line
+                    break
+    except OSError:
+        pass
+    raw = f"{platform.machine()}|{jax.__version__}|{feats}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:10]
+
+
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.environ.get("AREAL_TPU_TEST_CACHE", "/tmp/areal_tpu_test_jax_cache"),
+    os.environ.get(
+        "AREAL_TPU_TEST_CACHE",
+        f"/tmp/areal_tpu_test_jax_cache-{_host_cpu_fingerprint()}",
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
