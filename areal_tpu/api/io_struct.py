@@ -96,6 +96,11 @@ class WeightUpdateMeta:
     each server's /update_weights_from_tensor endpoint — the disaggregated
     no-disk path (reference NCCL broadcast, fsdp_engine.py:359-401, without
     the cross-job process group); ``chunked_mem_mb`` bounds chunk size.
+    type="shm": same-host disaggregated fast path — trainer writes chunks
+    into /dev/shm (RAM-backed tmpfs, no TCP payload, no disk) and servers
+    mmap them straight into device_put; only a tiny JSON notification rides
+    HTTP. The closest analogue of the reference's NCCL same-node broadcast
+    for separate-process engines sharing a host.
     type="lora": adapter-only push — just the rank-r LoRA factors go to
     /update_lora_weights (or the colocated equivalent) and the serving side
     merges against its retained base; a sync ships megabytes, not the full
@@ -103,7 +108,7 @@ class WeightUpdateMeta:
     areal/engine/sglang_remote.py:82-106).
     """
 
-    type: str = "disk"  # "disk" | "device" | "http" | "lora"
+    type: str = "disk"  # "disk" | "device" | "http" | "shm" | "lora"
     path: str | None = None
     chunked_mem_mb: int = 1024
 
@@ -117,6 +122,10 @@ class WeightUpdateMeta:
     @classmethod
     def from_device(cls, chunked_mem_mb: int = 1024) -> "WeightUpdateMeta":
         return cls(type="device", chunked_mem_mb=chunked_mem_mb)
+
+    @classmethod
+    def from_shm(cls, chunked_mem_mb: int = 1024) -> "WeightUpdateMeta":
+        return cls(type="shm", chunked_mem_mb=chunked_mem_mb)
 
     @classmethod
     def from_http(cls, chunked_mem_mb: int = 512) -> "WeightUpdateMeta":

@@ -396,6 +396,79 @@ class RemoteInfEngine(InferenceEngine):
         self.set_version(next_version)
         return latency
 
+    def update_weights_from_shm(self, chunks, next_version: int) -> float:
+        """Same-host no-copy weight transfer: each chunk is written once to
+        /dev/shm (RAM-backed tmpfs) as a safetensors file and every server
+        mmaps it directly — the HTTP requests carry only a JSON pointer, so
+        no tensor bytes ride the socket and N same-host servers share ONE
+        staging copy. The nearest analogue of the reference's same-node
+        NCCL broadcast (fsdp_engine.py:359-401) for separate processes.
+        Falls on its face across hosts by design — use type="http" there.
+        """
+        import uuid
+
+        from safetensors.numpy import save_file as st_save_file
+
+        from areal_tpu.utils import stats_tracker
+
+        t0 = time.monotonic()
+        n_chunks = 0
+
+        async def _push_all():
+            nonlocal n_chunks
+            session = aiohttp.ClientSession()
+            try:
+                it = iter(chunks)
+                try:
+                    cur = next(it)
+                except StopIteration:
+                    raise AssertionError("no weight chunks to send") from None
+                run_id = uuid.uuid4().hex[:12]
+                while cur is not None:
+                    nxt = next(it, None)
+                    final = nxt is None
+                    path = f"/dev/shm/areal_wu_{run_id}_{n_chunks}.st"
+                    st_save_file(
+                        {k: np.ascontiguousarray(v) for k, v in cur.items()},
+                        path,
+                    )
+                    n_chunks += 1
+                    try:
+                        await asyncio.gather(
+                            *[
+                                arequest_with_retry(
+                                    session,
+                                    f"http://{a}/update_weights_from_shm",
+                                    payload={
+                                        "path": path,
+                                        "version": next_version,
+                                        "final": final,
+                                    },
+                                    max_retries=self.config.request_retries,
+                                    timeout=self.config.request_timeout,
+                                )
+                                for a in self.addresses
+                            ]
+                        )
+                    finally:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                    cur = nxt
+            finally:
+                await session.close()
+
+        asyncio.run(_push_all())
+        latency = time.monotonic() - t0
+        stats_tracker.DEFAULT_TRACKER.scalar(update_weights_shm_latency=latency)
+        logger.info(
+            "shm weight update v%d (%d chunks) -> %d servers in %.2fs",
+            next_version, n_chunks, len(self.addresses), latency,
+        )
+        self.set_version(next_version)
+        return latency
+
     def update_lora_weights(
         self, named: dict, scale: float, next_version: int
     ) -> float:

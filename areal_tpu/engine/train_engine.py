@@ -294,24 +294,50 @@ class TPUTrainEngine(TrainEngine):
         check_pp_compatible(self.model_config, self.mesh)
         self._pp_replicated_data = False
         if pp_size(self.mesh) > 1 and distributed.process_count() > 1:
-            dp_cp = int(self.mesh.shape.get("dp", 1)) * int(
-                self.mesh.shape.get("cp", 1)
-            )
-            if dp_cp > 1:
-                # mixed dp x pp across hosts would need pp-aware host data
-                # placement (which host feeds which dp shard of a
-                # pp-replicated stack) — fail loudly
-                raise NotImplementedError(
-                    "pp>1 with multi-host jax.distributed supports only the "
-                    "synchronized-batch case (dp=cp=1): every host must feed "
-                    "the IDENTICAL batch; got dp*cp="
-                    f"{dp_cp}"
-                )
-            # synchronized-batch multi-host pp: the stacked [M, T] batch is
-            # replicated over the pp hosts (each host feeds the same data —
-            # verified by checksum each step), so the loss normalizer must
-            # NOT be summed across processes
-            self._pp_replicated_data = True
+            # Two supported multi-host pp data placements, decided by the
+            # mesh's device->process layout (parallel/mesh.py):
+            # (a) pp spans hosts, each host's devices cover EVERY (dp,cp)
+            #     shard -> synchronized-batch mode: all hosts feed the
+            #     IDENTICAL batch (verified by checksum each step) and the
+            #     loss normalizer must NOT be summed across processes.
+            # (b) dp-outer layout: each host's devices cover a distinct
+            #     (dp,cp) slice across all stages -> every host feeds its
+            #     OWN data shard (the reference's Megatron dp x pp layout);
+            #     the normal multi-host sync path applies.
+            devs = self.mesh.devices  # [pp, dp, cp, tp]
+            me = jax.process_index()
+            n_dp, n_cp = devs.shape[1], devs.shape[2]
+            local = {
+                (i, j)
+                for i in range(n_dp)
+                for j in range(n_cp)
+                if any(d.process_index == me for d in devs[:, i, j, :].flat)
+            }
+            if len(local) == n_dp * n_cp:
+                self._pp_replicated_data = True
+            else:
+                owners = []
+                for i in range(n_dp):
+                    for j in range(n_cp):
+                        procs = {
+                            d.process_index for d in devs[:, i, j, :].flat
+                        }
+                        if len(procs) != 1:
+                            raise NotImplementedError(
+                                "pp>1 multi-host needs each (dp,cp) data "
+                                "shard either fully local to one process "
+                                "(dp-outer layout) or covered by every "
+                                f"process (sync-batch); shard ({i},{j}) "
+                                f"spans processes {sorted(procs)}"
+                            )
+                        owners.append(procs.pop())
+                if owners != sorted(owners):
+                    # host token streams concatenate in process order; a
+                    # permuted shard->process map would interleave them
+                    raise NotImplementedError(
+                        "pp>1 multi-host dp shards must follow process "
+                        f"order along (dp, cp); got owners {owners}"
+                    )
         self.attn_spec = self._build_attn_spec()
 
         param_dtype = _DTYPES[cfg.backend.param_dtype]
@@ -581,7 +607,7 @@ class TPUTrainEngine(TrainEngine):
         Returns (MicroBatchList, packed mbs with positions/segment_ids, real
         token counts). ``group_size`` keeps row groups (e.g. RM pairs) in one
         microbatch."""
-        if self.model_config.vision_arch == "qwen2_vl":
+        if self.model_config.is_qwen_vl:
             if "image_grid_thw" in input_:
                 # batch-wide static grid signature, captured BEFORE the mb
                 # split: all microbatches share one jitted forward, so one
@@ -628,7 +654,7 @@ class TPUTrainEngine(TrainEngine):
             # them a real segment id (isolated) but they carry zero loss_mask
             packed["segment_ids"] = seg
             if (
-                self.model_config.vision_arch == "qwen2_vl"
+                self.model_config.is_qwen_vl
                 and "pixel_values" in packed
             ):
                 packed["positions"] = self._mrope_positions_packed(packed)
@@ -1546,17 +1572,22 @@ class TPUTrainEngine(TrainEngine):
             target.update_weights_from_arrays(
                 self.effective_params(), next_version
             )
-        elif meta.type == "http":
+        elif meta.type in ("http", "shm"):
             target = self._rollout_engine
-            assert target is not None and hasattr(
-                target, "update_weights_from_tensors"
-            ), "http weight updates need a RemoteInfEngine"
+            method = (
+                "update_weights_from_tensors"
+                if meta.type == "http"
+                else "update_weights_from_shm"
+            )
+            assert target is not None and hasattr(target, method), (
+                f"{meta.type} weight updates need a RemoteInfEngine"
+            )
             chunks = self._weight_chunks(meta.chunked_mem_mb)
             if distributed.process_count() > 1 and not distributed.is_main():
                 for _ in chunks:  # join the per-leaf gather collectives
                     pass
             else:
-                target.update_weights_from_tensors(chunks, next_version)
+                getattr(target, method)(chunks, next_version)
         elif meta.type == "lora":
             # adapter-native sync: ship ONLY the rank-r factors (megabytes)
             # and let the serving side merge against its retained base —

@@ -20,6 +20,7 @@ concurrent generation requests.
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Any
 
 from aiohttp import web
@@ -79,6 +80,7 @@ class GenerationServer:
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
                 web.post("/update_weights_from_tensor", self.update_weights_from_tensor),
+                web.post("/update_weights_from_shm", self.update_weights_from_shm),
                 web.post("/update_lora_weights", self.update_lora_weights),
             ]
         )
@@ -172,6 +174,50 @@ class GenerationServer:
             )
         except Exception as e:
             logger.exception("update_weights_from_tensor failed")
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.engine.get_version()}
+        )
+
+    async def update_weights_from_shm(self, request: web.Request) -> web.Response:
+        """Same-host no-copy weight update: the request carries only a JSON
+        pointer to a safetensors file the trainer placed in /dev/shm
+        (RAM-backed); tensors mmap from page cache straight into the
+        engine's device_put. The sender owns the file's lifetime (it
+        unlinks after every server acknowledged the chunk)."""
+        payload = await request.json()
+        path = payload.get("path", "")
+        version = payload.get("version")
+        final = bool(payload.get("final", True))
+        # resolve symlinks/..-segments BEFORE the containment check — a
+        # startswith test alone is traversable ("/dev/shm/../etc/...")
+        real = os.path.realpath(path)
+        if os.path.dirname(real) != "/dev/shm":
+            return web.json_response(
+                {"success": False, "message": "path must live in /dev/shm"},
+                status=400,
+            )
+        path = real
+        try:
+            from safetensors import safe_open
+
+            def load_and_apply():
+                arrs = {}
+                with safe_open(path, framework="numpy") as f:
+                    for name in f.keys():
+                        arrs[name] = f.get_tensor(name)
+                self.engine.update_weights_from_named_arrays(
+                    arrs,
+                    int(version) if (final and version is not None) else None,
+                )
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, load_and_apply
+            )
+        except Exception as e:
+            logger.exception("update_weights_from_shm failed")
             return web.json_response(
                 {"success": False, "message": str(e)}, status=500
             )

@@ -82,6 +82,23 @@ def make_mesh(
         ]
     else:
         devices = devices[:need]
+    n_procs_used = len({d.process_index for d in devices})
+    if (
+        n_procs_used > 1
+        and parallel.pp > 1
+        and parallel.dp % n_procs_used == 0
+    ):
+        # dp-OUTER layout: the process boundary lands on the dp axis, so
+        # each host's devices cover a distinct dp slice across ALL pipeline
+        # stages — every host feeds only its own data shard (the
+        # reference's normal Megatron dp x pp placement,
+        # areal/api/alloc_mode.py:216-241) instead of replicating the
+        # global batch. pp here spans in-host devices; tp stays
+        # fastest-varying (ICI neighbors).
+        arr = np.asarray(devices).reshape(
+            parallel.dp, parallel.pp, parallel.cp, parallel.tp
+        )
+        return Mesh(arr.transpose(1, 0, 2, 3), MESH_AXES)
     arr = np.asarray(devices).reshape(
         parallel.pp, parallel.dp, parallel.cp, parallel.tp
     )

@@ -178,3 +178,71 @@ def test_two_process_pipeline_parallel_synchronized_batch(tmp_path):
 
     np.testing.assert_allclose(multi["losses"], losses, rtol=1e-4)
     np.testing.assert_allclose(embed_multi, embed_single, rtol=2e-3, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_dp_pp_per_host_shards(tmp_path):
+    """Multi-host dp x pp (round-3 verdict item 5, dp-OUTER layout): two
+    processes with two devices each form a d2p2 mesh where each host owns
+    one dp shard across both pipeline stages and feeds ONLY its half of
+    the global batch — and must match single-process full-batch numerics."""
+    nprocs = 2
+    coordinator = f"127.0.0.1:{find_free_ports(1)[0]}"
+    outdir = str(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "dp_pp_multihost_driver.py"),
+                coordinator, str(nprocs), str(pid), outdir,
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-4000:]
+
+    multi = json.load(open(os.path.join(outdir, "dp_pp_result.json")))
+    embed_multi = np.load(os.path.join(outdir, "dp_pp_embed.npy"))
+
+    # single-process reference: the identical GLOBAL 6-row batch
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+    from areal_tpu.models.config import tiny_config
+
+    cfg = TrainEngineConfig(
+        path="", init_from_scratch=True, optimizer=OptimizerConfig(lr=1e-3),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=32),
+    )
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.pad_mb_to_multiple = 16
+    eng = TPULMEngine(cfg)
+    eng.initialize(
+        None, None, model_config=tiny_config(num_hidden_layers=4), seed=7
+    )
+    rng = np.random.default_rng(0)
+    data = dict(
+        input_ids=rng.integers(1, 128, size=(6, 16)).astype(np.int32),
+        attention_mask=np.ones((6, 16), np.int32),
+        loss_mask=np.ones((6, 16), np.int32),
+    )
+    data["loss_mask"][:, 0] = 0
+    losses = [eng.train_lm(data)["loss"] for _ in range(3)]
+    embed_single = np.asarray(eng.params["embed"])
+    eng.destroy()
+
+    np.testing.assert_allclose(multi["losses"], losses, rtol=1e-4)
+    np.testing.assert_allclose(embed_multi, embed_single, rtol=2e-3, atol=1e-5)
