@@ -83,6 +83,13 @@ class TransformerConfig:
     vision_temporal_patch: int = 2
     vision_in_channels: int = 3
     vision_hidden_act: str = "quick_gelu"
+    # qwen2_5_vl delta: RMS-normed SwiGLU vision blocks with WINDOWED
+    # attention — most blocks attend within window_size-pixel windows,
+    # fullatt_blocks attend across the whole (per-frame) grid
+    vision_intermediate_size: int = 0  # explicit MLP width (2.5)
+    vision_window_size: int = 0  # attention window, pixels (2.5)
+    vision_fullatt_blocks: tuple = ()  # full-attention block indexes (2.5)
+    vision_out_hidden_size: int = 0  # merger output dim; 0 = hidden_size
     mrope_section: tuple | None = None  # (t, h, w) freq-channel split
     vision_start_token_id: int = 0
 
@@ -103,6 +110,12 @@ class TransformerConfig:
         return self.vision_patch_size > 0
 
     @property
+    def is_qwen_vl(self) -> bool:
+        """Qwen2-VL-family tower (HF-processor patch streams + M-RoPE),
+        either generation."""
+        return self.vision_arch in ("qwen2_vl", "qwen2_5_vl")
+
+    @property
     def vision_patches(self) -> int:
         """Embedding rows per image (placeholder token count)."""
         side = self.vision_image_size // self.vision_patch_size
@@ -111,6 +124,7 @@ class TransformerConfig:
 
 _HF_ARCH_MAP = {
     "Qwen2VLForConditionalGeneration": "qwen2_vl",
+    "Qwen2_5_VLForConditionalGeneration": "qwen2_5_vl",
     "Qwen2ForCausalLM": "qwen2",
     "Qwen3ForCausalLM": "qwen3",
     "LlamaForCausalLM": "llama",
@@ -160,15 +174,23 @@ def _gpt2_config(hf: dict, is_critic: bool) -> TransformerConfig:
     )
 
 
-def _qwen2_vl_config(hf: dict, is_critic: bool) -> TransformerConfig:
-    """Qwen2-VL: text fields live top-level (and mirrored in text_config),
-    the vision tower under vision_config, M-RoPE split under rope_scaling
-    (reference: areal/models/transformers/qwen2_vl.py HF passthrough)."""
+def _qwen2_vl_config(
+    hf: dict, is_critic: bool, flavor: str = "qwen2_vl"
+) -> TransformerConfig:
+    """Qwen2-VL / Qwen2.5-VL: text fields live top-level (and mirrored in
+    text_config), the vision tower under vision_config, M-RoPE split under
+    rope_scaling (reference: areal/models/transformers/qwen2_vl.py +
+    ulyssess_patch.py:131-140 for the 2.5 coverage).
+
+    The 2.5 vision_config renames embed_dim -> hidden_size and adds
+    intermediate_size / window_size / fullatt_block_indexes /
+    out_hidden_size (windowed RMS-SwiGLU tower)."""
     text = {**hf, **hf.get("text_config", {})}
     vis = hf["vision_config"]
     n_heads = text["num_attention_heads"]
     rope_scaling = text.get("rope_scaling") or {}
     mrope = rope_scaling.get("mrope_section")
+    is_25 = flavor == "qwen2_5_vl"
     return TransformerConfig(
         vocab_size=text["vocab_size"],
         hidden_size=text["hidden_size"],
@@ -183,17 +205,31 @@ def _qwen2_vl_config(hf: dict, is_critic: bool) -> TransformerConfig:
         attention_bias=True,  # qwen2-family qkv bias
         max_position_embeddings=text.get("max_position_embeddings", 32768),
         is_critic=is_critic,
-        arch="qwen2_vl",
-        vision_arch="qwen2_vl",
+        arch=flavor,
+        vision_arch=flavor,
         vision_patch_size=vis["patch_size"],
-        vision_embed_dim=vis["embed_dim"],
+        vision_embed_dim=(
+            vis["hidden_size"] if is_25 else vis["embed_dim"]
+        ),
         vision_depth=vis["depth"],
         vision_num_heads=vis["num_heads"],
         vision_mlp_ratio=vis.get("mlp_ratio", 4.0),
         vision_spatial_merge=vis.get("spatial_merge_size", 2),
         vision_temporal_patch=vis.get("temporal_patch_size", 2),
         vision_in_channels=vis.get("in_channels", 3),
-        vision_hidden_act=vis.get("hidden_act", "quick_gelu"),
+        vision_hidden_act=vis.get(
+            "hidden_act", "silu" if is_25 else "quick_gelu"
+        ),
+        vision_intermediate_size=(
+            vis.get("intermediate_size", 0) if is_25 else 0
+        ),
+        vision_window_size=vis.get("window_size", 0) if is_25 else 0,
+        vision_fullatt_blocks=(
+            tuple(vis.get("fullatt_block_indexes", ())) if is_25 else ()
+        ),
+        vision_out_hidden_size=(
+            vis.get("out_hidden_size", 0) if is_25 else 0
+        ),
         mrope_section=tuple(mrope) if mrope else None,
         image_token_id=hf.get("image_token_id", 151655),
         vision_start_token_id=hf.get("vision_start_token_id", 151652),
@@ -211,18 +247,18 @@ def from_hf_config(path_or_dict, is_critic: bool = False) -> TransformerConfig:
             p = os.path.join(p, "config.json")
         with open(p) as f:
             hf = json.load(f)
-    if hf.get("model_type") == "qwen2_vl":
+    if hf.get("model_type") in ("qwen2_vl", "qwen2_5_vl"):
         # saved Qwen2VLConfig may omit top-level architectures (they live in
         # text_config, naming the composite class)
-        return _qwen2_vl_config(hf, is_critic)
+        return _qwen2_vl_config(hf, is_critic, flavor=hf["model_type"])
     archs = hf.get("architectures") or ["Qwen2ForCausalLM"]
     arch = _HF_ARCH_MAP.get(archs[0])
     if arch is None:
         raise ValueError(f"Unsupported HF architecture: {archs[0]}")
     if arch == "gpt2":
         return _gpt2_config(hf, is_critic)
-    if arch == "qwen2_vl":
-        return _qwen2_vl_config(hf, is_critic)
+    if arch in ("qwen2_vl", "qwen2_5_vl"):
+        return _qwen2_vl_config(hf, is_critic, flavor=arch)
     window = hf.get("sliding_window")
     window_active = window is not None and window < hf.get(
         "max_position_embeddings", 1 << 30
@@ -324,10 +360,41 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
             "tie_word_embeddings": True,
             "torch_dtype": "bfloat16",
         }
-    if cfg.arch == "qwen2_vl":
+    if cfg.arch in ("qwen2_vl", "qwen2_5_vl"):
+        is_25 = cfg.arch == "qwen2_5_vl"
+        vis_cfg = {
+            "model_type": cfg.arch,
+            "depth": cfg.vision_depth,
+            "num_heads": cfg.vision_num_heads,
+            "patch_size": cfg.vision_patch_size,
+            "spatial_merge_size": cfg.vision_spatial_merge,
+            "temporal_patch_size": cfg.vision_temporal_patch,
+            "in_channels": cfg.vision_in_channels,
+            "hidden_act": cfg.vision_hidden_act,
+        }
+        if is_25:
+            vis_cfg.update(
+                hidden_size=cfg.vision_embed_dim,
+                intermediate_size=cfg.vision_intermediate_size,
+                window_size=cfg.vision_window_size,
+                fullatt_block_indexes=list(cfg.vision_fullatt_blocks),
+                out_hidden_size=(
+                    cfg.vision_out_hidden_size or cfg.hidden_size
+                ),
+            )
+        else:
+            vis_cfg.update(
+                embed_dim=cfg.vision_embed_dim,
+                hidden_size=cfg.hidden_size,
+                mlp_ratio=cfg.vision_mlp_ratio,
+            )
         return {
-            "architectures": ["Qwen2VLForConditionalGeneration"],
-            "model_type": "qwen2_vl",
+            "architectures": [
+                "Qwen2_5_VLForConditionalGeneration"
+                if is_25
+                else "Qwen2VLForConditionalGeneration"
+            ],
+            "model_type": cfg.arch,
             "vocab_size": cfg.vocab_size,
             "hidden_size": cfg.hidden_size,
             "intermediate_size": cfg.intermediate_size,
@@ -344,19 +411,7 @@ def to_hf_config(cfg: TransformerConfig) -> dict:
             },
             "image_token_id": cfg.image_token_id,
             "vision_start_token_id": cfg.vision_start_token_id,
-            "vision_config": {
-                "model_type": "qwen2_vl",
-                "depth": cfg.vision_depth,
-                "embed_dim": cfg.vision_embed_dim,
-                "num_heads": cfg.vision_num_heads,
-                "hidden_size": cfg.hidden_size,
-                "mlp_ratio": cfg.vision_mlp_ratio,
-                "patch_size": cfg.vision_patch_size,
-                "spatial_merge_size": cfg.vision_spatial_merge,
-                "temporal_patch_size": cfg.vision_temporal_patch,
-                "in_channels": cfg.vision_in_channels,
-                "hidden_act": cfg.vision_hidden_act,
-            },
+            "vision_config": vis_cfg,
             "torch_dtype": "bfloat16",
         }
     arch = {

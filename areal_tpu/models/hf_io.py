@@ -112,7 +112,61 @@ def _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer):
         logger.warning(f"Skipping unmapped gpt2 tensor: {name}")
 
 
-def _ingest_qwen2vl_vision(sub: str, tensor: np.ndarray, vtop, put_vblock):
+_VISION_MERGER_MAP = {
+    "qwen2_vl": {
+        "merger.ln_q.weight": ("merger_ln", False),
+        "merger.ln_q.bias": ("merger_ln_b", False),
+        "merger.mlp.0.weight": ("merger_fc1", True),
+        "merger.mlp.0.bias": ("merger_b1", False),
+        "merger.mlp.2.weight": ("merger_fc2", True),
+        "merger.mlp.2.bias": ("merger_b2", False),
+    },
+    # 2.5: RMS ln_q (no bias), same MLP shapes
+    "qwen2_5_vl": {
+        "merger.ln_q.weight": ("merger_ln", False),
+        "merger.mlp.0.weight": ("merger_fc1", True),
+        "merger.mlp.0.bias": ("merger_b1", False),
+        "merger.mlp.2.weight": ("merger_fc2", True),
+        "merger.mlp.2.bias": ("merger_b2", False),
+    },
+}
+
+_VISION_BLOCK_MAP = {
+    "qwen2_vl": {
+        "norm1.weight": ("ln1", False),
+        "norm1.bias": ("ln1_b", False),
+        "norm2.weight": ("ln2", False),
+        "norm2.bias": ("ln2_b", False),
+        "attn.qkv.weight": ("wqkv", True),
+        "attn.qkv.bias": ("bqkv", False),
+        "attn.proj.weight": ("wo", True),
+        "attn.proj.bias": ("bo", False),
+        "mlp.fc1.weight": ("fc1", True),
+        "mlp.fc1.bias": ("b1", False),
+        "mlp.fc2.weight": ("fc2", True),
+        "mlp.fc2.bias": ("b2", False),
+    },
+    # 2.5: RMS norms (no bias) + SwiGLU gate/up/down
+    "qwen2_5_vl": {
+        "norm1.weight": ("ln1", False),
+        "norm2.weight": ("ln2", False),
+        "attn.qkv.weight": ("wqkv", True),
+        "attn.qkv.bias": ("bqkv", False),
+        "attn.proj.weight": ("wo", True),
+        "attn.proj.bias": ("bo", False),
+        "mlp.gate_proj.weight": ("wg", True),
+        "mlp.gate_proj.bias": ("bg", False),
+        "mlp.up_proj.weight": ("wu", True),
+        "mlp.up_proj.bias": ("bu", False),
+        "mlp.down_proj.weight": ("wd", True),
+        "mlp.down_proj.bias": ("bd", False),
+    },
+}
+
+
+def _ingest_qwen2vl_vision(
+    sub: str, tensor: np.ndarray, vtop, put_vblock, arch: str = "qwen2_vl"
+):
     """Map one HF ``visual.*`` tensor into the vlm_qwen2 param layout
     (weights transposed to x @ W orientation; Conv3d with stride == kernel
     flattened to a linear over the (C, tps, ps, ps) patch)."""
@@ -120,14 +174,7 @@ def _ingest_qwen2vl_vision(sub: str, tensor: np.ndarray, vtop, put_vblock):
         vtop["patch_proj"] = tensor.reshape(tensor.shape[0], -1).T
         return
     if sub.startswith("merger."):
-        key = {
-            "merger.ln_q.weight": ("merger_ln", False),
-            "merger.ln_q.bias": ("merger_ln_b", False),
-            "merger.mlp.0.weight": ("merger_fc1", True),
-            "merger.mlp.0.bias": ("merger_b1", False),
-            "merger.mlp.2.weight": ("merger_fc2", True),
-            "merger.mlp.2.bias": ("merger_b2", False),
-        }.get(sub)
+        key = _VISION_MERGER_MAP[arch].get(sub)
         if key is None:
             logger.warning(f"Skipping unmapped vision tensor: visual.{sub}")
             return
@@ -138,20 +185,7 @@ def _ingest_qwen2vl_vision(sub: str, tensor: np.ndarray, vtop, put_vblock):
         rest = sub[len("blocks.") :]
         d_str, bsub = rest.split(".", 1)
         d = int(d_str)
-        key = {
-            "norm1.weight": ("ln1", False),
-            "norm1.bias": ("ln1_b", False),
-            "norm2.weight": ("ln2", False),
-            "norm2.bias": ("ln2_b", False),
-            "attn.qkv.weight": ("wqkv", True),
-            "attn.qkv.bias": ("bqkv", False),
-            "attn.proj.weight": ("wo", True),
-            "attn.proj.bias": ("bo", False),
-            "mlp.fc1.weight": ("fc1", True),
-            "mlp.fc1.bias": ("b1", False),
-            "mlp.fc2.weight": ("fc2", True),
-            "mlp.fc2.bias": ("b2", False),
-        }.get(bsub)
+        key = _VISION_BLOCK_MAP[arch].get(bsub)
         if key is None:
             logger.warning(f"Skipping unmapped vision tensor: visual.{sub}")
             return
@@ -202,13 +236,14 @@ def load_hf_params(
         if cfg.arch == "gpt2":
             _ingest_gpt2_tensor(name, tensor, cfg, top, put_layer)
             continue
-        if cfg.arch == "qwen2_vl":
+        if cfg.arch in ("qwen2_vl", "qwen2_5_vl"):
             # transformers >=4.52 nests the text model under language_model
             if name.startswith("model.language_model."):
                 name = "model." + name[len("model.language_model.") :]
             if name.startswith(("model.visual.", "visual.")):
                 _ingest_qwen2vl_vision(
-                    name.split("visual.", 1)[1], tensor, vtop, put_vblock
+                    name.split("visual.", 1)[1], tensor, vtop, put_vblock,
+                    arch=cfg.arch,
                 )
                 continue
         if name == "model.embed_tokens.weight":
@@ -311,10 +346,10 @@ def load_hf_params(
     for opt in ("pos_embed", "final_norm_b"):
         if opt in top:
             params_np[opt] = top[opt]
-    if cfg.arch == "qwen2_vl":
+    if cfg.arch in ("qwen2_vl", "qwen2_5_vl"):
         if not vtop and not vblock_parts:
             raise ValueError(
-                f"qwen2_vl checkpoint at {model_dir} carries no visual.* "
+                f"{cfg.arch} checkpoint at {model_dir} carries no visual.* "
                 "tensors"
             )
         vision: dict = dict(vtop)
@@ -414,7 +449,7 @@ def save_hf_params(
         with open(os.path.join(out_dir, "config.json"), "w") as f:
             json.dump(to_hf_config(cfg), f, indent=2)
         return
-    if "vision" in params and cfg.arch == "qwen2_vl":
+    if "vision" in params and cfg.arch in ("qwen2_vl", "qwen2_5_vl"):
         # proper HF visual.* names so transformers can load our checkpoints
         vis = params["vision"]
         tensors["model.visual.patch_embed.proj.weight"] = contig(
@@ -426,23 +461,13 @@ def save_hf_params(
                 cfg.vision_patch_size,
             )
         )
-        for ours, hf_name, transpose in (
-            ("merger_ln", "merger.ln_q.weight", False),
-            ("merger_ln_b", "merger.ln_q.bias", False),
-            ("merger_fc1", "merger.mlp.0.weight", True),
-            ("merger_b1", "merger.mlp.0.bias", False),
-            ("merger_fc2", "merger.mlp.2.weight", True),
-            ("merger_b2", "merger.mlp.2.bias", False),
-        ):
+        # save maps are the ingest maps inverted (one source of truth)
+        for hf_name, (ours, transpose) in _VISION_MERGER_MAP[cfg.arch].items():
             t = host(vis[ours])
             tensors[f"model.visual.{hf_name}"] = contig(t.T if transpose else t)
         vb_map = {
-            "ln1": ("norm1.weight", False), "ln1_b": ("norm1.bias", False),
-            "ln2": ("norm2.weight", False), "ln2_b": ("norm2.bias", False),
-            "wqkv": ("attn.qkv.weight", True), "bqkv": ("attn.qkv.bias", False),
-            "wo": ("attn.proj.weight", True), "bo": ("attn.proj.bias", False),
-            "fc1": ("mlp.fc1.weight", True), "b1": ("mlp.fc1.bias", False),
-            "fc2": ("mlp.fc2.weight", True), "b2": ("mlp.fc2.bias", False),
+            ours: (hf_sub, transpose)
+            for hf_sub, (ours, transpose) in _VISION_BLOCK_MAP[cfg.arch].items()
         }
         for key, arr in vis["blocks"].items():
             hf_sub, transpose = vb_map[key]
@@ -461,7 +486,11 @@ def save_hf_params(
                     tensors[name] = contig(host(v))
 
         _walk(params["vision"], "vision")
-    text_pre = "model.language_model." if cfg.arch == "qwen2_vl" else "model."
+    text_pre = (
+        "model.language_model."
+        if cfg.arch in ("qwen2_vl", "qwen2_5_vl")
+        else "model."
+    )
     tensors[text_pre + "embed_tokens.weight"] = contig(host(params["embed"]))
     tensors[text_pre + "norm.weight"] = contig(host(params["final_norm"]))
     if "lm_head" in params:

@@ -55,6 +55,8 @@ def patch_dim(cfg: TransformerConfig) -> int:
 def init_qwen2vl_vision_params(
     cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32
 ) -> Params:
+    if cfg.vision_arch == "qwen2_5_vl":
+        return _init_qwen25_vision_params(cfg, key, dtype)
     e, d = cfg.vision_embed_dim, cfg.vision_depth
     i = int(e * cfg.vision_mlp_ratio)
     m2 = cfg.vision_spatial_merge**2
@@ -88,6 +90,45 @@ def init_qwen2vl_vision_params(
     }
 
 
+def _init_qwen25_vision_params(
+    cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    """Qwen2.5-VL tower params: RMS-normed SwiGLU blocks + RMS merger
+    (reference coverage: areal/models/transformers/ulyssess_patch.py:131-140
+    trains Qwen2.5-VL through the same HF tower)."""
+    e, d = cfg.vision_embed_dim, cfg.vision_depth
+    i = cfg.vision_intermediate_size or int(e * cfg.vision_mlp_ratio)
+    m2 = cfg.vision_spatial_merge**2
+    out = cfg.vision_out_hidden_size or cfg.hidden_size
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "patch_proj": normal(next(keys), (patch_dim(cfg), e)),
+        "blocks": {
+            "ln1": jnp.ones((d, e), dtype),
+            "ln2": jnp.ones((d, e), dtype),
+            "wqkv": normal(next(keys), (d, e, 3 * e)),
+            "bqkv": jnp.zeros((d, 3 * e), dtype),
+            "wo": normal(next(keys), (d, e, e)),
+            "bo": jnp.zeros((d, e), dtype),
+            "wg": normal(next(keys), (d, e, i)),
+            "bg": jnp.zeros((d, i), dtype),
+            "wu": normal(next(keys), (d, e, i)),
+            "bu": jnp.zeros((d, i), dtype),
+            "wd": normal(next(keys), (d, i, e)),
+            "bd": jnp.zeros((d, e), dtype),
+        },
+        "merger_ln": jnp.ones((e,), dtype),
+        "merger_fc1": normal(next(keys), (e * m2, e * m2)),
+        "merger_b1": jnp.zeros((e * m2,), dtype),
+        "merger_fc2": normal(next(keys), (e * m2, out)),
+        "merger_b2": jnp.zeros((out,), dtype),
+    }
+
+
 def _grid_hw_ids(cfg: TransformerConfig, grid_thw) -> np.ndarray:
     """Per-patch (h, w) ids in the processor's merge-window patch order
     (HF rot_pos_emb, modeling_qwen2_vl.py)."""
@@ -117,7 +158,146 @@ def _act(name: str, x):
         return x * jax.nn.sigmoid(1.702 * x)
     if name in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
         return jax.nn.gelu(x, approximate=name != "gelu")
+    if name == "silu":
+        return jax.nn.silu(x)
     raise ValueError(f"unsupported vision activation {name!r}")
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, -1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def _window_perm(cfg: TransformerConfig, grid_thw):
+    """Static window machinery (HF get_window_index): permutation of
+    MERGED units into window-major order (never crossing temporal frames),
+    plus per-unit window ids and per-unit frame ids in permuted order."""
+    m = cfg.vision_spatial_merge
+    w_units = cfg.vision_window_size // m // cfg.vision_patch_size
+    perm: list[int] = []
+    win_ids: list[int] = []
+    frame_ids: list[int] = []
+    base = 0
+    win = 0
+    frame_base = 0
+    for t, h, w in grid_thw:
+        lh, lw = h // m, w // m
+        idx = np.arange(t * lh * lw).reshape(t, lh, lw)
+        ph, pw = (-lh) % w_units, (-lw) % w_units
+        padded = np.pad(
+            idx, ((0, 0), (0, ph), (0, pw)), constant_values=-1
+        )
+        nh, nw = (lh + ph) // w_units, (lw + pw) // w_units
+        padded = (
+            padded.reshape(t, nh, w_units, nw, w_units)
+            .transpose(0, 1, 3, 2, 4)
+            .reshape(t, nh * nw, w_units * w_units)
+        )
+        for ti in range(t):
+            for wi in range(nh * nw):
+                vals = padded[ti, wi]
+                vals = vals[vals >= 0]
+                if vals.size == 0:
+                    continue
+                perm.extend((vals + base).tolist())
+                win_ids.extend([win] * vals.size)
+                frame_ids.extend([frame_base + ti] * vals.size)
+                win += 1
+        base += t * lh * lw
+        frame_base += t
+    return (
+        np.asarray(perm, np.int64),
+        np.asarray(win_ids, np.int64),
+        np.asarray(frame_ids, np.int64),
+    )
+
+
+def _encode_qwen25(
+    vparams: Params,
+    cfg: TransformerConfig,
+    pixel_values: jnp.ndarray,  # [P, C*tps*ps*ps]
+    grid_thw: Sequence[tuple[int, int, int]],
+) -> jnp.ndarray:
+    """Qwen2.5-VL tower: windowed attention (full attention only in
+    ``vision_fullatt_blocks``), RMS norms, SwiGLU MLP, RMS merger. The
+    whole stream is permuted into window-major unit order up front (HF
+    window_index), processed, merged, and un-permuted at the end."""
+    e = cfg.vision_embed_dim
+    nh = cfg.vision_num_heads
+    hd = vision_head_dim(cfg)
+    m2 = cfg.vision_spatial_merge**2
+    p = pixel_values.shape[0]
+    assert p == sum(t * h * w for t, h, w in grid_thw), (p, grid_thw)
+
+    x = pixel_values.astype(vparams["patch_proj"].dtype) @ vparams["patch_proj"]
+
+    perm, win_u, frame_u = _window_perm(cfg, grid_thw)
+    row_perm = (perm[:, None] * m2 + np.arange(m2)[None, :]).reshape(-1)
+    x = x[row_perm]
+
+    ids = _grid_hw_ids(cfg, grid_thw)[row_perm]  # [P, 2] permuted
+    inv_freq = 1.0 / (
+        10000.0 ** (np.arange(0, hd // 2, 2, dtype=np.float32) / (hd // 2))
+    )
+    freqs = np.concatenate(
+        [ids[:, 0:1] * inv_freq[None], ids[:, 1:2] * inv_freq[None]], -1
+    )
+    cos = jnp.asarray(np.cos(freqs), jnp.float32)
+    sin = jnp.asarray(np.sin(freqs), jnp.float32)
+
+    seg_win = np.repeat(win_u, m2)
+    seg_full = np.repeat(frame_u, m2)
+    mask_win = jnp.asarray(seg_win[:, None] == seg_win[None, :])
+    mask_full = jnp.asarray(seg_full[:, None] == seg_full[None, :])
+    full_flags = np.zeros(cfg.vision_depth, bool)
+    if cfg.vision_fullatt_blocks:
+        full_flags[list(cfg.vision_fullatt_blocks)] = True
+
+    def rot(v):
+        v1, v2 = v[..., : hd // 2], v[..., hd // 2 :]
+        vf1, vf2 = v1.astype(jnp.float32), v2.astype(jnp.float32)
+        c, s = cos[:, None, :], sin[:, None, :]
+        return jnp.concatenate(
+            [vf1 * c - vf2 * s, vf2 * c + vf1 * s], -1
+        ).astype(v.dtype)
+
+    def block(carry, inp):
+        bp, is_full = inp
+        h_in = carry
+        h = _rms(h_in, bp["ln1"])
+        qkv = h @ bp["wqkv"] + bp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, -1)
+        q = rot(q.reshape(p, nh, hd))
+        k = rot(k.reshape(p, nh, hd))
+        v = v.reshape(p, nh, hd)
+        logits = jnp.einsum(
+            "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        mask = jnp.where(is_full, mask_full, mask_win)
+        logits = jnp.where(mask[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(v.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(p, e)
+        h_in = h_in + attn @ bp["wo"] + bp["bo"]
+        h = _rms(h_in, bp["ln2"])
+        g = _act(cfg.vision_hidden_act, h @ bp["wg"] + bp["bg"])
+        u = h @ bp["wu"] + bp["bu"]
+        h_in = h_in + (g * u) @ bp["wd"] + bp["bd"]
+        return h_in, None
+
+    x, _ = jax.lax.scan(
+        block, x, (vparams["blocks"], jnp.asarray(full_flags))
+    )
+
+    x = _rms(x, vparams["merger_ln"])
+    x = x.reshape(p // m2, m2 * e)
+    x = jax.nn.gelu(
+        x @ vparams["merger_fc1"] + vparams["merger_b1"], approximate=False
+    )
+    x = x @ vparams["merger_fc2"] + vparams["merger_b2"]
+    return x[np.argsort(perm)]  # back to processor order for the splice
 
 
 def encode_images_qwen2vl(
@@ -127,6 +307,8 @@ def encode_images_qwen2vl(
     grid_thw: Sequence[tuple[int, int, int]],  # static, one (t,h,w) per image
 ) -> jnp.ndarray:
     """-> [P / merge^2, hidden_size] rows for the placeholder positions."""
+    if cfg.vision_arch == "qwen2_5_vl":
+        return _encode_qwen25(vparams, cfg, pixel_values, grid_thw)
     e = cfg.vision_embed_dim
     nh = cfg.vision_num_heads
     hd = vision_head_dim(cfg)
@@ -146,10 +328,11 @@ def encode_images_qwen2vl(
     cos = jnp.asarray(np.cos(freqs), jnp.float32)  # applied to duplicated halves
     sin = jnp.asarray(np.sin(freqs), jnp.float32)
 
-    # block-diagonal full-attention mask per image (static)
-    seg = np.repeat(
-        np.arange(len(grid_thw)), [t * h * w for t, h, w in grid_thw]
-    )
+    # block-diagonal full-attention mask per TEMPORAL FRAME (HF builds
+    # cu_seqlens via repeat_interleave(h*w, t): patches attend within
+    # their frame, not across a video's frames; identical for t=1 images)
+    frame_sizes = [h * w for t, h, w in grid_thw for _ in range(t)]
+    seg = np.repeat(np.arange(len(frame_sizes)), frame_sizes)
     mask = jnp.asarray(seg[:, None] == seg[None, :])
 
     def rot(v):  # [P, NH, hd] rotate_half with per-patch 2D angles
