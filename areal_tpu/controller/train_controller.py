@@ -55,6 +55,29 @@ def _meta_kwargs(meta) -> dict:
     return {"meta": d}
 
 
+def _merge_stats(
+    per_worker: list[dict[str, float]], weights: list[int]
+) -> dict[str, float]:
+    """Weighted mean over worker stat dicts, keyed by the UNION of keys —
+    a stat emitted by one worker only (e.g. a nonfinite-skip counter) must
+    not be dropped because worker 0 didn't emit it."""
+    keys: list[str] = []
+    for p in per_worker:
+        for k in p:
+            if k not in keys:
+                keys.append(k)
+    out: dict[str, float] = {}
+    for k in keys:
+        pairs = [
+            (p[k], max(w, 1))
+            for p, w in zip(per_worker, weights)
+            if k in p
+        ]
+        tot = sum(w for _, w in pairs)
+        out[k] = float(sum(v * w for v, w in pairs) / tot)
+    return out
+
+
 class TrainController:
     """Drives N RPC engine workers through training steps.
 
@@ -129,12 +152,13 @@ class TrainController:
     # -- training steps -------------------------------------------------
 
     def train_lm(self, batch: DistributedBatchMemory) -> dict:
-        """SFT step: even scatter -> concurrent train_lm -> mean stats."""
+        """SFT step: even scatter -> concurrent train_lm -> weighted-mean
+        stats (ffd shards are uneven, so means weight by shard rows; keys
+        are unioned — a stat one worker alone emits is kept)."""
         shards = batch.chunk(len(self.clients))
+        sizes = [len(s) for s in shards]
         stats = self._all("train_lm", tensors_list=[s.to_dict() for s in shards])
-        return {
-            k: float(np.mean([s[k] for s in stats])) for k in stats[0]
-        }
+        return _merge_stats(stats, sizes)
 
     def train_ppo_step(
         self, batch: DistributedBatchMemory
@@ -171,15 +195,17 @@ class TrainController:
             "ppo_update", tensors_list=[s.to_dict() for s in update_shards]
         )
         self.step_lr_scheduler()
-        # merge the per-worker stats lists pointwise (mean over workers)
+        # merge the per-worker stats lists pointwise: union of keys (a stat
+        # only some workers emit is kept), means weighted by shard rows
         merged: list[dict[str, float]] = []
         for i in range(max(len(s) for s in all_stats)):
-            per = [s[i] for s in all_stats if i < len(s)]
+            per = [
+                (s[i], sizes[w])
+                for w, s in enumerate(all_stats)
+                if i < len(s)
+            ]
             merged.append(
-                {
-                    k: float(np.mean([p[k] for p in per if k in p]))
-                    for k in per[0]
-                }
+                _merge_stats([p for p, _ in per], [n for _, n in per])
             )
         return merged
 

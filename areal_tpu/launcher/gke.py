@@ -1,0 +1,234 @@
+"""GKE JobSet launcher: Kubernetes manifest synthesis + submission.
+
+The reference orchestrates multi-host jobs with Ray placement groups
+(areal/launcher/ray.py:68-360 — workers scheduled onto bundles, coordinator
+discovery through the Ray object store). TPU fleets schedule through GKE,
+so the TPU-native translation is a **JobSet manifest**: one replicated job
+of generation-server pods plus one indexed trainer job whose pods wire into
+a single ``jax.distributed`` mesh, glued by the same NFS/etcd name-resolve
+flow as the local and slurm launchers (servers register their addresses;
+trainers discover them).
+
+Manifest synthesis is pure (unit-testable anywhere); submission shells out
+to ``kubectl`` when present.
+
+    python -m areal_tpu.launcher.gke examples/gsm8k_grpo.py \
+        --config cfg.yaml [k=v ...] [--apply]
+
+Mapping (Ray concept -> here):
+  placement group bundles   -> JobSet replicatedJobs + TPU nodeSelectors
+  ray.remote worker fan-out -> indexed Job completions (JOB_COMPLETION_INDEX)
+  coordinator via object store -> trainer-0 headless-service DNS name
+  restart-on-failure        -> JobSet failurePolicy maxRestarts
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+
+from areal_tpu.api.alloc_mode import AllocationMode
+from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("launcher.gke")
+
+_COORD_PORT = 47801
+
+
+def _pod_env(base: dict[str, str]) -> list[dict]:
+    return [{"name": k, "value": str(v)} for k, v in base.items()]
+
+
+def _container(
+    name: str,
+    command: str,
+    cfg,
+    cpus: int,
+    mem_mb: int,
+    env: dict[str, str],
+    tpu_chips: int,
+) -> dict:
+    limits = {
+        "cpu": str(cpus),
+        "memory": f"{mem_mb}Mi",
+    }
+    if tpu_chips:
+        limits["google.com/tpu"] = str(tpu_chips)
+    return {
+        "name": name,
+        "image": os.environ.get("AREAL_TPU_IMAGE", "areal-tpu:latest"),
+        "command": ["/bin/bash", "-c", command],
+        "env": _pod_env(env),
+        "resources": {"limits": limits},
+        "volumeMounts": [
+            {"name": "fileroot", "mountPath": cfg.cluster.fileroot}
+        ],
+    }
+
+
+def _pod_spec(cfg, container: dict, tpu_topology: str | None) -> dict:
+    spec = {
+        "subdomain": "areal",  # headless service for stable DNS names
+        "restartPolicy": "Never",
+        "containers": [container],
+        "volumes": [
+            {
+                "name": "fileroot",
+                "persistentVolumeClaim": {
+                    "claimName": os.environ.get(
+                        "AREAL_TPU_PVC", "areal-fileroot"
+                    )
+                },
+            }
+        ],
+    }
+    if tpu_topology:
+        spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": os.environ.get(
+                "AREAL_TPU_ACCEL", "tpu-v5-lite-podslice"
+            ),
+            "cloud.google.com/gke-tpu-topology": tpu_topology,
+        }
+    return spec
+
+
+def render_jobset(
+    cfg, entry: str, config_path: str, overrides: list[str]
+) -> dict:
+    """Pure manifest synthesis: the JobSet dict for one experiment."""
+    alloc = AllocationMode.from_str(cfg.allocation_mode)
+    n_servers = alloc.gen.dp if alloc.gen else 1
+    n_trainers = max(cfg.launcher.trainer_processes, 1)
+    args = " ".join(shlex.quote(o) for o in overrides)
+    name = f"{cfg.experiment_name}-{cfg.trial_name}".replace("_", "-")
+    chips = cfg.cluster.n_chips_per_host
+    topology = os.environ.get("AREAL_TPU_TOPOLOGY")
+
+    server_cmd = (
+        f"exec python -m areal_tpu.launcher.tpu_server "
+        f"--config {shlex.quote(config_path)} {args}"
+    )
+    # trainer 0's pod has a stable DNS name through the headless service:
+    # <jobset>-trainer-0-0.<subdomain> — every process dials it
+    coord = f"{name}-trainer-0-0.areal:{_COORD_PORT}"
+    trainer_cmd = (
+        "export AREAL_PROCESS_ID=$JOB_COMPLETION_INDEX && "
+        f"export AREAL_COORDINATOR_ADDR={coord} && "
+        f"export AREAL_NUM_PROCESSES={n_trainers} && "
+        f"exec python {shlex.quote(entry)} "
+        f"--config {shlex.quote(config_path)} {args}"
+    )
+
+    def job(job_name, cmd, replicas, cpus, mem, env, tpu):
+        return {
+            "name": job_name,
+            "replicas": 1,
+            "template": {
+                "spec": {
+                    "completions": replicas,
+                    "parallelism": replicas,
+                    "completionMode": "Indexed",
+                    "backoffLimit": 0,
+                    "template": {
+                        "metadata": {
+                            "labels": {"app": name, "role": job_name}
+                        },
+                        "spec": _pod_spec(
+                            cfg,
+                            _container(
+                                job_name, cmd, cfg, cpus, mem, env, tpu
+                            ),
+                            topology,
+                        ),
+                    },
+                }
+            },
+        }
+
+    lcfg = cfg.launcher
+    return {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {"name": name},
+        "spec": {
+            "failurePolicy": {"maxRestarts": 3},
+            "replicatedJobs": [
+                job(
+                    "gen",
+                    server_cmd,
+                    n_servers,
+                    lcfg.inference_server_cpus_per_chip * chips,
+                    lcfg.inference_server_mem_per_chip * chips,
+                    dict(lcfg.inference_server_env_vars),
+                    chips,
+                ),
+                job(
+                    "trainer",
+                    trainer_cmd,
+                    n_trainers,
+                    lcfg.trainer_cpus_per_chip * chips,
+                    lcfg.trainer_mem_per_chip * chips,
+                    dict(lcfg.trainer_env_vars),
+                    chips,
+                ),
+            ],
+        },
+    }
+
+
+def write_manifest(
+    cfg, entry: str, config_path: str, overrides: list[str]
+) -> str:
+    import yaml
+
+    out_dir = os.path.join(
+        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "gke"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "jobset.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(
+            render_jobset(cfg, entry, config_path, overrides),
+            f,
+            sort_keys=False,
+        )
+    return path
+
+
+def kubectl_apply(path: str) -> str:
+    out = subprocess.run(
+        ["kubectl", "apply", "-f", path],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    return out.stdout.strip()
+
+
+def main(argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        raise SystemExit(
+            "usage: python -m areal_tpu.launcher.gke ENTRY --config cfg.yaml "
+            "[k=v ...] [--apply]"
+        )
+    entry = argv.pop(0)
+    apply = "--apply" in argv
+    if apply:
+        argv.remove("--apply")
+    cfg, config_path = load_expr_config(argv, GRPOConfig)
+    overrides = [a for a in argv if "=" in a and not a.startswith("--")]
+    path = write_manifest(cfg, entry, config_path, overrides)
+    logger.info("JobSet manifest written to %s", path)
+    if apply:
+        logger.info("kubectl: %s", kubectl_apply(path))
+    else:
+        print(path)
+    return path
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,20 @@
 """Math answer extraction + equivalence checking.
 
 Capability parity with the reference's sympy/latex verifier
-(areal/reward/math_parser.py:867 — ``process_results`` and friends), built
-fresh and compact: extract the model's final answer from \\boxed{..},
-``####``-style markers, or the last number/expression, then decide
-equivalence by (1) string normalization, (2) numeric evaluation, (3) sympy
-symbolic simplification. Designed to run inside the AsyncRewardWrapper
-process pool with a timeout, so sympy hangs can't stall rollout.
+(areal/reward/math_parser.py:867 — ``process_results``, ``extract_answer``,
+``math_equal`` and the ``strip_string`` normalization pipeline), built
+fresh and compact. The decision ladder:
+
+1. normalized-string equality (LaTeX cleanup, units, percents, word
+   numbers, frac/sqrt canonicalization),
+2. numeric comparison at rel-tol 1e-4 with the reference's
+   percentage-triple rule (gold/100, gold, gold*100 all accepted),
+3. structure-aware compare: tuples/intervals elementwise, pmatrix cells,
+   equations by side-difference,
+4. sympy symbolic simplification of the difference.
+
+Designed to run inside the AsyncRewardWrapper process pool with a
+timeout, so sympy hangs can't stall rollout.
 """
 
 from __future__ import annotations
@@ -18,16 +26,20 @@ from typing import Any
 # Extraction
 # ---------------------------------------------------------------------------
 
-_BOXED_RE = re.compile(r"\\boxed\s*\{")
+_BOXED_RE = re.compile(r"\\boxed\s*\{|\\fbox\s*\{")
 _HASH_RE = re.compile(r"####\s*(.+?)\s*(?:$|\n)")
 _ANSWER_IS_RE = re.compile(
-    r"(?:final answer|answer)\s*(?:is|:|=)\s*\$?([^\n\.\$]+)", re.IGNORECASE
+    r"(?:final answer|answer)\s*(?:is|:|=)\s*\$?([^\n\$]+)", re.IGNORECASE
 )
-_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:/\d+)?")
+_MINERVA_RE = re.compile(
+    r"final answer is \$(.+?)\$\.\s*I hope", re.IGNORECASE | re.DOTALL
+)
+_NUMBER_RE = re.compile(r"-?\d+(?:\.\d+)?(?:/\d+)?|-?\.\d+")
+_CHOICE_RE = re.compile(r"\b([A-E])\b")
 
 
 def _extract_boxed(text: str) -> str | None:
-    """Last \\boxed{...} with balanced-brace scanning (nested braces legal)."""
+    """Last \\boxed{...}/\\fbox{...} with balanced-brace scanning."""
     out = None
     for m in _BOXED_RE.finditer(text):
         depth = 1
@@ -47,6 +59,9 @@ def extract_answer(text: str) -> str | None:
     """Model-output answer extraction, most-specific marker first."""
     if not text:
         return None
+    m = _MINERVA_RE.findall(text)
+    if m:
+        return m[-1].strip()
     boxed = _extract_boxed(text)
     if boxed is not None:
         return boxed.strip()
@@ -55,67 +70,236 @@ def extract_answer(text: str) -> str | None:
         return m[-1].strip()
     m = _ANSWER_IS_RE.findall(text)
     if m:
-        return m[-1].strip()
-    nums = _NUMBER_RE.findall(text)
+        # cut trailing prose after the math ("is 5. I checked it twice"):
+        # a period followed by whitespace ends the answer (decimals like
+        # 3.5 carry no space after the dot and survive)
+        ans = re.split(r"\.\s", m[-1].strip(), maxsplit=1)[0]
+        return ans.strip().rstrip(".").strip()
+    nums = _NUMBER_RE.findall(text.replace(",", ""))
     if nums:
         return nums[-1]
     return None
 
 
+def choice_answer_clean(pred: str) -> str:
+    """Multiple-choice letter cleanup (reference choice_answer_clean)."""
+    pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
+    found = _CHOICE_RE.findall(pred.upper())
+    return (found[-1] if found else pred.strip().strip(".")).rstrip("./")
+
+
 # ---------------------------------------------------------------------------
-# Normalization + equivalence
+# Normalization (the strip_string role)
 # ---------------------------------------------------------------------------
 
-_LATEX_SUBS = [
-    (re.compile(r"\\left|\\right|\\!|\\,|\\;|\\:"), ""),
-    (re.compile(r"\\text\s*\{[^}]*\}"), ""),
-    (re.compile(r"\\mathrm\s*\{[^}]*\}"), ""),
-    (re.compile(r"\\(?:d)?frac\s*\{([^{}]+)\}\s*\{([^{}]+)\}"), r"(\1)/(\2)"),
+_WORD_NUMS = {
+    "zero": "0", "one": "1", "two": "2", "three": "3", "four": "4",
+    "five": "5", "six": "6", "seven": "7", "eight": "8", "nine": "9",
+    "ten": "10", "eleven": "11", "twelve": "12", "twenty": "20",
+    "thirty": "30", "forty": "40", "fifty": "50", "hundred": "100",
+    "thousand": "1000",
+}
+
+# units dropped anywhere they appear as standalone words (reference
+# unit_texts list role — the common physical/word units in benchmark golds)
+_UNIT_WORDS = (
+    "degrees?|deg|cm|mm|km|meters?|metres?|m|inches|inch|in\\.?|feet|foot|ft"
+    "|yards?|miles?|hours?|hrs?|minutes?|mins?|seconds?|secs?|days?|weeks?"
+    "|months?|years?|dollars?|cents?|bucks?|percent|units?|square|sq"
+    "|cubic|cu|grams?|kg|pounds?|lbs?|ounces?|oz|liters?|litres?|ml|mph"
+    "|kmh|amperes?|volts?|watts?|joules?|apples?|oranges?|students?"
+    "|people|cups?|pieces?|points?|cm\\^2|m\\^2|cm\\^3|m\\^3"
+)
+_UNIT_TAIL = re.compile(
+    r"(?<=[\d\}])\s*\\?(?:" + _UNIT_WORDS + r")\s*$", re.IGNORECASE
+)
+_TEXT_UNIT_TAIL = re.compile(r"\\(?:text|mbox|mathrm)\s*\{[^{}]*\}\s*$")
+
+_SUBS_PRE = [
+    # spacing / markup that never changes meaning; the backslash-space rule
+    # must not eat the second backslash of a pmatrix row separator "\\ "
+    (re.compile(r"\\left|\\right|\\!|\\,|\\;|\\:|(?<!\\)\\ "), ""),
+    (re.compile(r"\\\{"), "{"),
+    (re.compile(r"\\\}"), "}"),
+    (re.compile(r"\\mathbf|\\mathrm(?!\s*\{)|\\displaystyle|\\limits"), ""),
+    (re.compile(r"\^\s*\{?\\circ\}?"), ""),  # degrees
+    (re.compile(r"\\\(|\\\)"), ""),
+    (re.compile(r"\\(?:d|t)frac"), r"\\frac"),
+    (re.compile(r"\\neq"), r"\\ne"),
+    (re.compile(r"\\leq"), r"\\le"),
+    (re.compile(r"\\geq"), r"\\ge"),
+    (re.compile(r"\\begin\{array\}\{[^}]*\}"), r"\\begin{pmatrix}"),
+    (re.compile(r"\\end\{array\}"), r"\\end{pmatrix}"),
+    (re.compile(r"bmatrix"), "pmatrix"),
+]
+
+_SUBS_MAIN = [
+    # \text{x} / \mbox{x} / \mathrm{x} -> x (after unit-tail handling)
+    (re.compile(r"\\(?:text|mbox|mathrm)\s*\{([^{}]*)\}"), r"\1"),
+    # \frac{a}{b} -> (a)/(b), innermost-first via repeated application
+    (re.compile(r"\\frac\s*\{([^{}]+)\}\s*\{([^{}]+)\}"), r"((\1)/(\2))"),
+    # \frac12, \frac1{72}, \frac{1}2
+    (re.compile(r"\\frac\s*\{([^{}]+)\}\s*(\w)"), r"((\1)/(\2))"),
+    (re.compile(r"\\frac\s*(\w)\s*\{([^{}]+)\}"), r"((\1)/(\2))"),
+    (re.compile(r"\\frac\s*(\w)\s*(\w)"), r"((\1)/(\2))"),
+    (re.compile(r"\\sqrt\s*\[(\d+)\]\s*\{([^{}]+)\}"), r"(\2)^(1/\1)"),
     (re.compile(r"\\sqrt\s*\{([^{}]+)\}"), r"sqrt(\1)"),
     (re.compile(r"\\sqrt\s*(\w)"), r"sqrt(\1)"),
     (re.compile(r"\\cdot|\\times"), "*"),
+    (re.compile(r"\\div"), "/"),
     (re.compile(r"\\pi"), "pi"),
-    (re.compile(r"\\infty"), "oo"),
+    (re.compile(r"\\infty|infinity"), "oo"),
     (re.compile(r"\\pm"), "+-"),
     (re.compile(r"\\%|%"), ""),
     (re.compile(r"\\\$|\$"), ""),
-    (re.compile(r"\\ "), " "),
     (re.compile(r"\^\s*\{([^{}]+)\}"), r"^(\1)"),
-    (re.compile(r"\{|\}"), ""),
-    (re.compile(r"\s+"), ""),
 ]
 
-# only strip a unit suffix when it follows a digit (optionally with a space):
-# "2m" -> "2", "3 cm" -> "3", but symbolic answers like "x+m" or bare "min"
-# keep their letters
-_UNIT_TAIL = re.compile(
-    r"(?<=\d)\s*(?:degrees?|deg|cm|mm|km|m|inches|inch|in|feet|ft|hours?|hrs?"
-    r"|minutes?|mins?|seconds?|secs?|dollars?|cents?|percent|units?|square"
-    r"|cubic)$",
-    re.IGNORECASE,
-)
+
+def _strip_outer_group(s: str) -> str:
+    """{x} / (x) / [x] around a purely alphanumeric body drops the wrapper
+    (reference strip_string's isalnum-bracket rule)."""
+    if len(s) >= 2 and s[0] + s[-1] in ("{}", "()", "[]") and s[1:-1].isalnum():
+        return s[1:-1]
+    return s
 
 
 def normalize_answer(ans: str) -> str:
-    ans = ans.strip().strip(".").strip()
-    for pat, repl in _LATEX_SUBS:
+    ans = str(ans).replace("\n", " ").strip()
+    ans = ans.rstrip(".").strip()
+    ans = _strip_outer_group(ans)
+    if ans.lower() in _WORD_NUMS:
+        return _WORD_NUMS[ans.lower()]
+    for pat, repl in _SUBS_PRE:
         ans = pat.sub(repl, ans)
-    ans = ans.replace(",", "")  # thousands separators AND tuple commas differ; numeric path handles tuples poorly anyway
+    # trailing \text{...} unit annotations drop — but only when something
+    # remains (reference strip_string: "\\text{yes}" must unwrap, not die)
+    prev = None
+    while prev != ans:
+        prev = ans
+        stripped = _TEXT_UNIT_TAIL.sub("", ans).strip()
+        if stripped:
+            ans = stripped
+    # fixpoint over the whole rule list: nested constructs unlock outer
+    # ones (\frac{1+\sqrt{5}}{2} needs sqrt rewritten before frac matches)
+    prev_all = None
+    while prev_all != ans:
+        prev_all = ans
+        for pat, repl in _SUBS_MAIN:
+            prev = None
+            while prev != ans:  # innermost-first for nested frac/sqrt
+                prev = ans
+                ans = pat.sub(repl, ans)
+    # variable-assignment prefixes: "x=5" -> "5", "k = 1/2" -> "1/2"
+    parts = ans.split("=")
+    if len(parts) == 2 and len(parts[0].strip()) <= 2:
+        ans = parts[1]
+    ans = ans.replace("\\emptyset", "{}")
+    ans = re.sub(r"(\d),(\d\d\d)(?!\d)", r"\1\2", ans)  # thousands commas
     ans = _UNIT_TAIL.sub("", ans)
+    ans = re.sub(r"\s+", "", ans)
+    # ".5" -> "0.5", "{.5" -> "{0.5"
+    ans = re.sub(r"(^|[{(,])\.(\d)", r"\g<1>0.\2", ans)
+    # trailing ".0" / ".000" on integers
+    ans = re.sub(r"(\d+)\.0+($|[^\d])", r"\1\2", ans)
+    # imaginary j for i when no i present
+    if "j" in ans and "i" not in ans:
+        ans = ans.replace("j", "i")
     return ans.strip().lower()
 
 
+# ---------------------------------------------------------------------------
+# Equivalence
+# ---------------------------------------------------------------------------
+
+
 def _to_number(s: str) -> float | None:
+    s = s.strip()
+    had_pct = s.endswith("%") or s.endswith("\\%")
+    s = s.rstrip("%").rstrip("\\")
+    s = s.replace(",", "")
     try:
-        if "/" in s:
-            num, den = s.split("/", 1)
-            return float(num.strip("() ")) / float(den.strip("() "))
-        return float(s)
-    except (ValueError, ZeroDivisionError):
+        return float(s) / 100 if had_pct else float(s)
+    except ValueError:
+        pass
+    # simple rational (a)/(b) or a/b with numeric sides
+    m = re.fullmatch(r"\(?(-?\d+\.?\d*)\)?/\(?(-?\d+\.?\d*)\)?", s)
+    if m:
+        try:
+            return float(m.group(1)) / float(m.group(2))
+        except (ValueError, ZeroDivisionError):
+            return None
+    return None
+
+
+def _numeric_equal(a: float, b: float) -> bool:
+    from math import isclose
+
+    return isclose(a, b, rel_tol=1e-4)
+
+
+def _split_top_level(s: str, sep: str = ",") -> list[str]:
+    """Split on commas not nested inside (), [], {}."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+_PMAT_RE = re.compile(
+    r"^\\begin\{pmatrix\}(.*)\\end\{pmatrix\}$", re.DOTALL
+)
+
+_BRACKETS = {"(": ")", "[": "]", "{": "}"}
+
+
+def _is_wrapped(s: str) -> bool:
+    """True when the FIRST bracket matches the LAST character — i.e. the
+    whole string is one bracketed group. "(a)/(b)" is not wrapped: its
+    opening paren closes mid-string."""
+    if len(s) < 2 or s[0] not in _BRACKETS:
+        return False
+    depth = 0
+    for i, ch in enumerate(s):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                return i == len(s) - 1
+    return False
+
+
+def _sympy_evalf(s: str) -> float | None:
+    """Numeric value of a constant expression ("2*pi", "sqrt(2)+1")."""
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import (
+            implicit_multiplication_application,
+            parse_expr,
+            standard_transformations,
+        )
+
+        tf = standard_transformations + (implicit_multiplication_application,)
+        e = parse_expr(s.replace("^", "**"), transformations=tf)
+        if e.free_symbols:
+            return None
+        v = float(sympy.N(e))
+        return v
+    except Exception:
         return None
 
 
-def _sympy_equal(a: str, b: str, timeout_ok: bool = True) -> bool:
+def _sympy_equal(a: str, b: str) -> bool:
     try:
         import sympy
         from sympy.parsing.sympy_parser import (
@@ -127,25 +311,116 @@ def _sympy_equal(a: str, b: str, timeout_ok: bool = True) -> bool:
         tf = standard_transformations + (implicit_multiplication_application,)
         ea = parse_expr(a.replace("^", "**"), transformations=tf)
         eb = parse_expr(b.replace("^", "**"), transformations=tf)
-        return bool(sympy.simplify(ea - eb) == 0)
+        if ea == eb:
+            return True
+        diff = sympy.simplify(ea - eb)
+        return bool(diff == 0)
     except Exception:
         return False
 
 
-def math_equal(pred: str | None, gold: str | None) -> bool:
+def _equation_sides(s: str) -> tuple[str, str] | None:
+    if s.count("=") == 1 and not any(op in s for op in ("<", ">", "\\le", "\\ge")):
+        l, r = s.split("=")
+        if l.strip() and r.strip():
+            return l, r
+    return None
+
+
+def math_equal(
+    pred: str | None, gold: str | None, include_percentage: bool = True
+) -> bool:
     if pred is None or gold is None:
         return False
-    p, g = normalize_answer(pred), normalize_answer(gold)
+    raw_p, raw_g = str(pred).strip(), str(gold).strip()
+    if raw_p.lower() == raw_g.lower():
+        return True
+    # multiple-choice gold: only a bona-fide letter answer counts ("C",
+    # "(C)", "C."), not a sentence that merely mentions the letter
+    if (
+        raw_g in ("A", "B", "C", "D", "E")
+        and re.fullmatch(r"\(?([A-Ea-e])\)?\.?", raw_p)
+        and choice_answer_clean(raw_p) == raw_g
+    ):
+        return True
+    p, g = normalize_answer(raw_p), normalize_answer(raw_g)
     if not p or not g:
         return False
     if p == g:
         return True
+
+    # numeric ladder with the reference percentage-triple rule
     pn, gn = _to_number(p), _to_number(g)
     if pn is not None and gn is not None:
-        return abs(pn - gn) <= 1e-6 * max(1.0, abs(gn))
-    if pn is not None or gn is not None:
-        # one side numeric, other symbolic: try sympy numeric evaluation
-        pass
+        golds = [gn / 100, gn, gn * 100] if include_percentage else [gn]
+        return any(_numeric_equal(pn, gv) for gv in golds)
+    if (pn is None) != (gn is None):
+        # one side is a plain number, the other symbolic (2\pi vs 6.2832):
+        # numeric-evaluate the symbolic side
+        sym = g if pn is not None else p
+        num = pn if pn is not None else gn
+        ev = _sympy_evalf(sym)
+        if ev is not None and num is not None:
+            return _numeric_equal(ev, num)
+
+    # pmatrix elementwise
+    mp, mg = _PMAT_RE.match(p), _PMAT_RE.match(g)
+    if mp and mg:
+        rows_p = [r for r in mp.group(1).split("\\\\") if r.strip()]
+        rows_g = [r for r in mg.group(1).split("\\\\") if r.strip()]
+        if len(rows_p) != len(rows_g):
+            return False
+        for rp, rg in zip(rows_p, rows_g):
+            cp, cg = rp.split("&"), rg.split("&")
+            if len(cp) != len(cg):
+                return False
+            if not all(math_equal(a, b) for a, b in zip(cp, cg)):
+                return False
+        return True
+
+    # tuples / intervals / sets: elementwise when both are bracketed
+    if _is_wrapped(p) and _is_wrapped(g):
+        parts_p = _split_top_level(p[1:-1])
+        parts_g = _split_top_level(g[1:-1])
+        if len(parts_p) == len(parts_g) and len(parts_p) > 1:
+            # intervals care about bracket kinds; tuples/sets don't — the
+            # reference compares elementwise regardless, accepting (a,b)
+            # vs [a,b] only when element values match
+            return all(
+                math_equal(a, b) for a, b in zip(parts_p, parts_g)
+            )
+        if len(parts_p) == len(parts_g) == 1 and math_equal(
+            parts_p[0], parts_g[0]
+        ):
+            return True
+
+    # bare-vs-bracketed single value: (5) vs 5
+    if (
+        _is_wrapped(p)
+        and len(_split_top_level(p[1:-1])) == 1
+        and math_equal(p[1:-1], g)
+    ):
+        return True
+    if (
+        _is_wrapped(g)
+        and len(_split_top_level(g[1:-1])) == 1
+        and math_equal(p, g[1:-1])
+    ):
+        return True
+
+    # equations: compare side differences (x=2y+1 vs 2y+1=x etc.)
+    ep, eg = _equation_sides(p), _equation_sides(g)
+    if ep and eg:
+        return _sympy_equal(
+            f"({ep[0]})-({ep[1]})", f"({eg[0]})-({eg[1]})"
+        ) or _sympy_equal(
+            f"({ep[0]})-({ep[1]})", f"-(({eg[0]})-({eg[1]}))"
+        )
+    if ep and not eg:
+        return math_equal(ep[1], g) or math_equal(ep[0], g)
+    if eg and not ep:
+        return math_equal(p, eg[1]) or math_equal(p, eg[0])
+
     return _sympy_equal(p, g)
 
 

@@ -1,0 +1,218 @@
+"""Remote sandboxed-verification client: batch async HTTP at high concurrency.
+
+The reference offloads code-verification to a FaaS sandbox service and fans
+out HTTP calls at up to 1500-way concurrency with retries/backoff and
+latency accounting (functioncall/base/call.py:160, functioncall/code/
+verify.py). TPU pods often run zero-egress, so this client is GATED: with
+no service URL configured the local rlimit sandbox (reward/sandbox.py) is
+the production path, and ``code_verify_batch`` transparently falls back to
+it. When a sandbox service IS reachable, reward throughput stops being
+capped by local cores.
+
+Payload/result schema (reference-compatible):
+  request:  {uid, language, code, entryFunction, testcases: [{input,
+             expectedOutput}], timeout, memory, isFastFail, query_index}
+  response: {uid, success: bool, results: [...]}
+
+Per-query verdicts AND together across that query's testcase batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import random
+import time
+from statistics import median
+from typing import Any, Sequence
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("RemoteSandbox")
+
+
+@dataclasses.dataclass
+class RemoteSandboxConfig:
+    """Knobs for the remote verification service (reference cli envs
+    FUNCTIONCALL_SERVICE_DOMAIN etc.)."""
+
+    url: str = ""  # empty = no remote service; use the local sandbox
+    timeout: float = 100.0
+    concurrency: int = 1500
+    max_retries: int = 3
+    initial_retry_interval: float = 0.5
+    max_retry_interval: float = 10.0
+    test_case_batch_size: int = 20
+
+
+def _failure(uid: str, reason: str) -> dict:
+    return {
+        "uid": uid,
+        "success": False,
+        "results": [{"success": False, "reason": reason}],
+    }
+
+
+async def _invoke_one(
+    session, cfg: RemoteSandboxConfig, payload: dict
+) -> dict:
+    uid = payload.get("uid", "")
+    for attempt in range(cfg.max_retries):
+        try:
+            async with session.post(
+                cfg.url,
+                json=payload,
+                timeout=__import__("aiohttp").ClientTimeout(
+                    total=cfg.timeout
+                ),
+            ) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"HTTP {resp.status}: {(await resp.text())[:300]}"
+                    )
+                return await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except asyncio.TimeoutError:
+            logger.warning(
+                "sandbox call timed out (uid=%s attempt %d)", uid, attempt + 1
+            )
+        except Exception as e:
+            logger.warning(
+                "sandbox call failed (uid=%s attempt %d): %s",
+                uid, attempt + 1, e,
+            )
+        await asyncio.sleep(
+            min(
+                cfg.initial_retry_interval * (2**attempt)
+                + random.uniform(0, 0.5),
+                cfg.max_retry_interval,
+            )
+        )
+    return _failure(uid, "max retries exceeded")
+
+
+async def batch_call_async(
+    payloads: Sequence[dict], cfg: RemoteSandboxConfig
+) -> list[dict]:
+    """Fan out every payload with bounded concurrency; returns results in
+    payload order (failures become failure records, never exceptions)."""
+    import aiohttp
+
+    connector = aiohttp.TCPConnector(
+        limit=cfg.concurrency, ttl_dns_cache=300, keepalive_timeout=75
+    )
+    sem = asyncio.Semaphore(cfg.concurrency)
+    t_each: list[float] = []
+
+    async with aiohttp.ClientSession(connector=connector) as session:
+
+        async def limited(p):
+            async with sem:
+                t0 = time.monotonic()
+                r = await _invoke_one(session, cfg, p)
+                t_each.append(time.monotonic() - t0)
+                return r
+
+        out = await asyncio.gather(*[limited(p) for p in payloads])
+    if t_each:
+        s = sorted(t_each)
+        logger.info(
+            "sandbox batch: n=%d p50=%.3fs p90=%.3fs max=%.3fs",
+            len(s), median(s), s[int(0.9 * (len(s) - 1))], s[-1],
+        )
+    return list(out)
+
+
+def batch_call(
+    payloads: Sequence[dict], cfg: RemoteSandboxConfig
+) -> list[dict]:
+    return asyncio.run(batch_call_async(payloads, cfg))
+
+
+# ---------------------------------------------------------------------------
+# Code verification over the remote service (reference code/verify.py)
+# ---------------------------------------------------------------------------
+
+
+def _build_payloads(
+    id2info: dict, query_ids: Sequence[str], generateds: Sequence[str],
+    cfg: RemoteSandboxConfig,
+) -> list[dict]:
+    payloads = []
+    for idx, qid in enumerate(query_ids):
+        info = id2info[qid]
+        io_spec = info.get("input_output", "{}")
+        if isinstance(io_spec, str):
+            io_spec = json.loads(io_spec)
+        inputs = io_spec.get("inputs", [])
+        outputs = io_spec.get("outputs", [])
+        assert len(inputs) == len(outputs), (qid, len(inputs), len(outputs))
+        fn_name = io_spec.get("fn_name", "")
+        n = max(len(inputs), 1)
+        bs = min(max(1, cfg.test_case_batch_size), n)
+        for lo in range(0, n, bs):
+            hi = min(n, lo + bs)
+            payloads.append(
+                {
+                    "uid": f"{qid}:{lo}-{hi}",
+                    "language": info.get("language", "PYTHON").upper(),
+                    "code": generateds[idx],
+                    "entryFunction": fn_name,
+                    "isFastFail": True,
+                    "testcases": [
+                        {
+                            "input": inputs[i] if i < len(inputs) else "",
+                            "expectedOutput": (
+                                outputs[i] if i < len(outputs) else ""
+                            ),
+                        }
+                        for i in range(lo, hi)
+                    ],
+                    "timeout": min(
+                        100.0, max(0.1, float(info.get("timeout", 10.0)))
+                    ),
+                    "query_index": idx,
+                }
+            )
+    return payloads
+
+
+def code_verify_batch(
+    id2info: dict,
+    generateds: Sequence[str],
+    query_ids: Sequence[str],
+    cfg: RemoteSandboxConfig | None = None,
+) -> list[int]:
+    """Per-query 0/1 verdicts; a query passes only if EVERY testcase batch
+    of it passes (reference code_verify AND-combining). Falls back to the
+    local rlimit sandbox when no remote URL is configured."""
+    assert len(generateds) == len(query_ids)
+    cfg = cfg or RemoteSandboxConfig()
+    if not cfg.url:
+        from areal_tpu.reward.sandbox import code_verify_reward
+
+        out = []
+        for qid, gen in zip(query_ids, generateds):
+            info = id2info[qid]
+            io_spec = info.get("input_output", "{}")
+            if isinstance(io_spec, str):
+                io_spec = json.loads(io_spec)
+            cases = [
+                {"stdin": i, "expected_stdout": o}
+                for i, o in zip(
+                    io_spec.get("inputs", []), io_spec.get("outputs", [])
+                )
+            ]
+            r = code_verify_reward(None, gen, testcases=cases)
+            out.append(int(r >= 1.0))
+        return out
+    payloads = _build_payloads(id2info, query_ids, generateds, cfg)
+    responses = batch_call(payloads, cfg)
+    verdicts = [1] * len(query_ids)
+    for payload, resp in zip(payloads, responses):
+        qi = payload["query_index"]
+        ok = bool(resp and resp.get("success", False))
+        verdicts[qi] = verdicts[qi] and int(ok)
+    return verdicts

@@ -312,55 +312,75 @@ class Normalization:
         if mask is None:
             mask = np.ones_like(x, dtype=bool)
         mask = np.asarray(mask, dtype=bool)
+        if not mask.any():
+            return x.astype(np.float32)
 
-        def masked_moments(values, m, axis=None, keepdims=False):
-            cnt = m.sum(axis=axis, keepdims=keepdims)
-            cnt = np.maximum(cnt, 1)
-            mean = (values * m).sum(axis=axis, keepdims=keepdims) / cnt
-            denom = np.maximum(cnt - 1, 1) if self.std_unbiased else cnt
-            var = (
-                (((values - mean) * m) ** 2).sum(axis=axis, keepdims=keepdims)
-                / denom
+        def scope_mean(values, m, axes):
+            """Per-element mean over ``axes`` (plain or leave-one-out),
+            broadcast to values.shape. Reference semantics
+            (areal/utils/data.py:1206-1262): masked-out elements see the
+            plain mean; a scope with <=1 active elements gets mean 0 under
+            leave-one-out."""
+            cnt = m.sum(axis=axes, keepdims=True).astype(np.float64)
+            tot = (values * m).sum(axis=axes, keepdims=True)
+            reg = np.where(cnt > 0, tot / np.maximum(cnt, 1.0), 0.0)
+            if not self.mean_leave1out:
+                return np.broadcast_to(reg, values.shape)
+            loo = (tot - values * m) / np.maximum(cnt - m, 1.0)
+            mean = np.where(m, loo, np.broadcast_to(reg, values.shape))
+            return np.where(cnt > 1, mean, 0.0)
+
+        def scope_std(values, m, mean, axes):
+            """Std over ``axes`` around the (possibly per-element) ``mean``
+            actually subtracted in step 1 — the reference computes squared
+            deviations from that mean, not from the plain scope mean."""
+            cnt = m.sum(axis=axes, keepdims=True).astype(np.float64)
+            centered = (values - mean) * m
+            denom = (
+                np.maximum(cnt - 1, 1.0)
+                if self.std_unbiased
+                else np.maximum(cnt, 1.0)
             )
-            return mean, var
+            var = (centered**2).sum(axis=axes, keepdims=True) / denom
+            return np.broadcast_to(np.sqrt(var), values.shape)
 
-        def loo_mean(values, m, axis, keepdims):
-            """Per-element leave-one-out mean over ``axis``: the scope mean
-            with the element's own contribution removed."""
-            cnt = m.sum(axis=axis, keepdims=True)
-            tot = (values * m).sum(axis=axis, keepdims=True)
-            loo_cnt = np.maximum(cnt - m, 1)
-            return (tot - values * m) / loo_cnt
-
-        if self.mean_level == "group" or self.std_level == "group":
+        need_group = self.mean_level == "group" or self.std_level == "group"
+        if need_group:
             bs = x.shape[0]
             assert bs % self.group_size == 0, (bs, self.group_size)
-            g = x.reshape((bs // self.group_size, self.group_size) + x.shape[1:])
-            gm = mask.reshape(g.shape)
-            axes = tuple(range(1, g.ndim))
-            gmean, gvar = masked_moments(g, gm, axis=axes, keepdims=True)
-            if self.mean_leave1out:
-                gmean = loo_mean(g, gm, axes, True).reshape(x.shape)
-            else:
-                gmean = np.broadcast_to(gmean, g.shape).reshape(x.shape)
-            gstd = np.sqrt(np.broadcast_to(gvar, g.shape).reshape(x.shape))
-        if self.mean_level == "batch" or self.std_level == "batch":
-            bmean, bvar = masked_moments(x, mask)
-            if self.mean_leave1out:
-                bmean = loo_mean(
-                    x, mask, tuple(range(x.ndim)), True
-                ).reshape(x.shape)
-            bstd = np.sqrt(bvar)
+            gshape = (bs // self.group_size, self.group_size) + x.shape[1:]
+            g = x.reshape(gshape)
+            gm = mask.reshape(gshape)
+            gaxes = tuple(range(1, g.ndim))
 
+        # step 1: the mean that gets subtracted (zeros when mean_level=none)
         if self.mean_level == "group":
-            x = x - gmean
+            if self.group_size == 1 and self.mean_leave1out:
+                mean = np.zeros_like(x)  # reference special case
+            else:
+                mean = scope_mean(g, gm, gaxes).reshape(x.shape)
         elif self.mean_level == "batch":
-            x = x - bmean
+            mean = scope_mean(x, mask, tuple(range(x.ndim)))
+        else:
+            mean = np.zeros_like(x)
+
+        x_centered = (x - mean) * mask
+
+        # step 2: std around the step-1 mean (whatever its level was)
+        eps = self.eps
         if self.std_level == "group":
-            x = x / (gstd + self.eps)
+            if self.group_size == 1 and self.std_unbiased:
+                std = np.ones_like(x)  # reference special case (n-1 == 0)
+            else:
+                std = scope_std(
+                    g, gm, mean.reshape(gshape), gaxes
+                ).reshape(x.shape)
         elif self.std_level == "batch":
-            x = x / (bstd + self.eps)
-        return (x * mask).astype(np.float32)
+            std = scope_std(x, mask, mean, tuple(range(x.ndim)))
+        else:
+            std = np.ones_like(x)
+            eps = 0.0
+        return (x_centered / (std + eps)).astype(np.float32)
 
 
 @dataclasses.dataclass

@@ -400,6 +400,100 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
         eng.stop()
 
 
+def weight_update_bench(layers: int = 28, chunk_mb: int = 512):
+    """Trainer->server weight-resync latency for the bench model (VERDICT
+    r3 item 8): the /dev/shm same-host fast path vs HTTP safetensors
+    streaming, both through the real server endpoints. The 'trainer' side
+    is host numpy arrays shaped like the param tree (no second HBM copy —
+    a 16GB chip cannot hold two 1.5B models plus staging)."""
+    import asyncio
+    import threading
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import InferenceEngineConfig, JaxGenConfig
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+
+    model_cfg = qwen2_1p5b_cfg(layers)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=4, max_seq_len=512, prefill_chunk=128,
+            dtype="bfloat16",
+        ),
+        model_config=model_cfg,
+    )
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=120)
+    try:
+        client = RemoteInfEngine(InferenceEngineConfig())
+        client.addresses = [f"127.0.0.1:{port}"]
+
+        # host-side trainer weights: same tree shapes, random bf16 bytes
+        import jax as _jax
+
+        shapes = _jax.tree.map(
+            lambda x: (x.shape, str(x.dtype)), eng.params
+        )
+        rng = np.random.default_rng(0)
+
+        def chunks():
+            budget = chunk_mb * 1_000_000
+            cur, size = {}, 0
+            flat = []
+
+            def walk(node, prefix):
+                for k in sorted(node):
+                    v = node[k]
+                    path = f"{prefix}.{k}" if prefix else k
+                    if isinstance(v, dict):
+                        walk(v, path)
+                    else:
+                        flat.append((path, v))
+
+            walk(shapes, "")
+            for path, (shape, _dt) in flat:
+                arr = rng.standard_normal(size=shape).astype(np.float32)
+                if cur and size + arr.nbytes > budget:
+                    yield cur
+                    cur, size = {}, 0
+                cur[path] = arr
+                size += arr.nbytes
+            if cur:
+                yield cur
+
+        def _total_bytes(node):
+            out = 0
+            for v in node.values():
+                if isinstance(v, dict):
+                    out += _total_bytes(v)
+                else:
+                    out += int(np.prod(v[0])) * 4
+            return out
+
+        total_mb = _total_bytes(shapes) / 1e6
+        shm_lat = client.update_weights_from_shm(chunks(), next_version=1)
+        http_lat = client.update_weights_from_tensors(chunks(), next_version=2)
+        return {
+            "shm_sec": round(shm_lat, 3),
+            "http_sec": round(http_lat, 3),
+            "payload_mb_fp32": round(total_mb, 1),
+            "layers": layers,
+        }
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
 # ---------------------------------------------------------------------------
 # Main ladder
 # ---------------------------------------------------------------------------
@@ -535,6 +629,26 @@ def main():
         except Exception as e:  # noqa: BLE001
             log(f"decode bench failed at {datt}: {e}")
 
+    # ---- rung 3.5: weight-resync latency (shm vs http, VERDICT r3 #8) ----
+    if remaining(deadline) > 420:
+        try:
+            log("weight-update rung")
+            wu = _run_child(
+                "wu",
+                dict(layers=(used or {"layers": 28})["layers"]),
+                timeout=min(1200.0, remaining(deadline) - 60),
+            )
+            emit({
+                "metric": "weight_update_latency",
+                "value": wu["shm_sec"],
+                "unit": "s_shm",
+                "vs_baseline": None,
+                "chip": chip,
+                **wu,
+            })
+        except Exception as e:  # noqa: BLE001
+            log(f"weight-update rung failed: {e}")
+
     # ---- rung 4: full GRPO step (async-RL headline metric) ----
     if remaining(deadline) > 420:
         try:
@@ -582,6 +696,8 @@ def _child_main():
         print(json.dumps({"tps": tps, "mfu": mfu_v}))
     elif kind == "--decode-child":
         print(json.dumps({"tps": decode_bench(**att)}))
+    elif kind == "--wu-child":
+        print(json.dumps(weight_update_bench(**att)))
     elif kind == "--grpo-child":
         from bench_grpo import grpo_step_bench
 

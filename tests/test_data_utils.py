@@ -153,3 +153,40 @@ def test_normalization_leave_one_out_and_unbiased():
     out2 = n2(x)
     want = (x - x.mean()) / x.std(ddof=1)
     np.testing.assert_allclose(out2, want, rtol=1e-6)
+
+
+def test_normalization_loo_std_centers_on_loo_mean():
+    """With mean_leave1out the std must be computed around the per-element
+    LOO mean actually subtracted (reference _compute_std receives the step-1
+    mean tensor), not the plain scope mean."""
+    x = np.asarray([1.0, 3.0, 2.0, 6.0], np.float64)
+    n = Normalization(mean_level="group", std_level="group", group_size=2,
+                      mean_leave1out=True, eps=0.0)
+    out = n(x)
+    # group 1: LOO means [3, 1] -> centered [-2, 2] -> var (4+4)/2 = 4
+    # group 2: LOO means [6, 2] -> centered [-4, 4] -> var 16
+    np.testing.assert_allclose(out, [-1.0, 1.0, -1.0, 1.0], rtol=1e-6)
+
+
+def test_normalization_group_size1_special_cases():
+    """Reference special cases: group_size==1 with leave-one-out -> mean 0;
+    group_size==1 with unbiased std -> std forced to 1 (n-1 == 0)."""
+    x = np.asarray([1.5, -5.0], np.float64)
+    n = Normalization(mean_level="group", std_level="group", group_size=1,
+                      mean_leave1out=True, std_unbiased=True, eps=0.0)
+    np.testing.assert_allclose(n(x), x, rtol=1e-7)
+
+
+def test_normalization_mixed_levels_std_around_batch_mean():
+    """mean_level=batch + std_level=group: the group std is computed around
+    the BATCH mean slice (the mean that was subtracted), reference
+    group_mean_slice = mean[s]."""
+    x = np.asarray([0.0, 2.0, 10.0, 12.0], np.float64)
+    n = Normalization(mean_level="batch", std_level="group", group_size=2,
+                      eps=0.0)
+    out = n(x)
+    bm = 6.0
+    g1 = np.sqrt(((0 - bm) ** 2 + (2 - bm) ** 2) / 2)
+    g2 = np.sqrt(((10 - bm) ** 2 + (12 - bm) ** 2) / 2)
+    want = [(0 - bm) / g1, (2 - bm) / g1, (10 - bm) / g2, (12 - bm) / g2]
+    np.testing.assert_allclose(out, want, rtol=1e-6)

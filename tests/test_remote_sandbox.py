@@ -1,0 +1,133 @@
+"""Remote sandboxed-reward client (VERDICT r3 missing #7): batch async HTTP
+verification with bounded concurrency, retries, and local-sandbox fallback
+(reference: functioncall/base/call.py:160, functioncall/code/verify.py)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from areal_tpu.reward.remote import (
+    RemoteSandboxConfig,
+    batch_call,
+    code_verify_batch,
+)
+
+
+@pytest.fixture()
+def stub_service():
+    """In-process aiohttp sandbox stub: verdict = 'BAD' not in code; tracks
+    peak concurrency and can fail the first attempt per uid (retry test)."""
+    from aiohttp import web
+
+    state = {"active": 0, "peak": 0, "first_seen": set(), "flaky": False}
+    loop_holder = {}
+
+    async def verify(request):
+        payload = await request.json()
+        state["active"] += 1
+        state["peak"] = max(state["peak"], state["active"])
+        try:
+            await asyncio.sleep(0.02)
+            uid = payload["uid"]
+            if state["flaky"] and uid not in state["first_seen"]:
+                state["first_seen"].add(uid)
+                return web.Response(status=500, text="transient")
+            ok = all(
+                "BAD" not in payload["code"] for _ in payload["testcases"]
+            ) and "BAD" not in payload["code"]
+            return web.json_response({"uid": uid, "success": ok})
+        finally:
+            state["active"] -= 1
+
+    app = web.Application()
+    app.router.add_post("/verify", verify)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        loop_holder["loop"] = loop
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        loop_holder["port"] = runner.addresses[0][1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield f"http://127.0.0.1:{loop_holder['port']}/verify", state
+    loop_holder["loop"].call_soon_threadsafe(loop_holder["loop"].stop)
+
+
+def test_batch_call_concurrency_and_order(stub_service):
+    url, state = stub_service
+    cfg = RemoteSandboxConfig(url=url, concurrency=8, timeout=10.0)
+    payloads = [
+        {"uid": f"u{i}", "code": "ok" if i % 3 else "BAD", "testcases": []}
+        for i in range(32)
+    ]
+    out = batch_call(payloads, cfg)
+    assert len(out) == 32
+    # results stay in payload order
+    for i, r in enumerate(out):
+        assert r["uid"] == f"u{i}"
+        assert r["success"] == (i % 3 != 0)
+    # the semaphore bounds in-flight requests
+    assert state["peak"] <= 8
+
+
+def test_batch_call_retries_transient_failures(stub_service):
+    url, state = stub_service
+    state["flaky"] = True
+    cfg = RemoteSandboxConfig(
+        url=url, concurrency=4, max_retries=3, initial_retry_interval=0.01
+    )
+    out = batch_call([{"uid": "r1", "code": "fine", "testcases": []}], cfg)
+    assert out[0]["success"] is True  # second attempt served it
+
+
+def test_code_verify_batch_ands_testcase_batches(stub_service):
+    url, _ = stub_service
+    cfg = RemoteSandboxConfig(url=url, test_case_batch_size=2)
+    id2info = {
+        "q0": {
+            "input_output": json.dumps(
+                {"inputs": ["1", "2", "3", "4"], "outputs": ["1", "2", "3", "4"]}
+            )
+        },
+        "q1": {
+            "input_output": json.dumps({"inputs": ["1"], "outputs": ["1"]})
+        },
+    }
+    got = code_verify_batch(
+        id2info, ["print(input())", "BAD code"], ["q0", "q1"], cfg
+    )
+    assert got == [1, 0]
+
+
+def test_local_fallback_without_url():
+    """Zero-egress pods: no URL configured -> the rlimit sandbox verifies
+    locally with identical call semantics."""
+    id2info = {
+        "a": {
+            "input_output": json.dumps(
+                {"inputs": ["5\n"], "outputs": ["5"]}
+            )
+        },
+        "b": {
+            "input_output": json.dumps(
+                {"inputs": ["5\n"], "outputs": ["999"]}
+            )
+        },
+    }
+    gens = [
+        "```python\nprint(input().strip())\n```",
+        "```python\nprint(input().strip())\n```",
+    ]
+    got = code_verify_batch(id2info, gens, ["a", "b"])
+    assert got == [1, 0]
