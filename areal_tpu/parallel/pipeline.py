@@ -476,6 +476,222 @@ def pipeline_train_step_1f1b(
     return loss_vec, grads
 
 
+def _stage_ticks(s: int, stage, work, operands, collect_last: bool):
+    """Run the sequential stage conveyor: S ticks; at tick t stage t
+    applies ``work`` to its operands (idle stages pass through via
+    lax.cond, skipping their weight reads), then the activation ppermutes
+    forward. Returns (final operands, last stage's computed activation
+    psum-broadcast to every stage if ``collect_last``)."""
+
+    def tick(carry, t):
+        x, rest = carry[0], carry[1:]
+
+        def run(ops):
+            return work(*ops)
+
+        def idle(ops):
+            return ops
+
+        x, *rest = jax.lax.cond(stage == t, run, idle, (x, *rest))
+        y_keep = None
+        if collect_last:
+            y_keep = jnp.where((stage == s - 1) & (t == s - 1), x, 0.0)
+        x = jax.lax.ppermute(
+            x, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
+        )
+        return (x, *rest), y_keep
+
+    carry, ys = jax.lax.scan(tick, operands, jnp.arange(s))
+    y = None
+    if collect_last:
+        y = jax.lax.psum(jnp.sum(ys, 0), AXIS_PP)  # one tick contributed
+    return carry, y
+
+
+def prefill_stream_pp(
+    params: dict,
+    cfg: TransformerConfig,
+    cache: dict,  # paged pool {k, v: [L, NB, BS, KH, D]}, L sharded over pp
+    input_ids: jnp.ndarray,  # [T] packed ragged stream
+    positions: jnp.ndarray,  # [T]
+    segment_ids: jnp.ndarray,  # [T], pad = -1
+    last_idx: jnp.ndarray,  # [N]
+    token_blocks: jnp.ndarray,  # [T] physical block per token (trash = 0)
+    token_offsets: jnp.ndarray,  # [T]
+    mesh: Mesh,
+    attn_spec: AttnSpec | None = None,
+    positions3: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Serving prefill with the layer stack sharded over pipeline stages
+    (the pipelined-generation role of realhf pipe_runner.py:375-648): the
+    packed stream passes through the S stages sequentially; each stage
+    scatters its local layers' K/V into its slice of the paged pool.
+
+    Returns (last-token logits [N, V] fp32, updated pool).
+    """
+    from areal_tpu.models.lm import _embed, _mlp, _norm, _qkv, _rope
+    from areal_tpu.ops.attention import packed_attention
+
+    s = pp_size(mesh)
+    t = input_ids.shape[0]
+    rope_pos = positions3 if positions3 is not None else positions
+    x0 = _embed(params, cfg, input_ids, positions)
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+
+    def stage_fn(layers_local, k_pool, v_pool, x_in):
+        stage = jax.lax.axis_index(AXIS_PP)
+
+        def work(x, kp, vp):
+            def body(carry, layer_in):
+                lp, kl, vl = layer_in
+                h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
+                q, k, v = _qkv(cfg, lp, h)
+                if cfg.pos_embed_type == "rope":
+                    q = _rope(cfg, q, rope_pos)
+                    k = _rope(cfg, k, rope_pos)
+                kl = kl.at[token_blocks, token_offsets].set(
+                    k.astype(kl.dtype), mode="drop"
+                )
+                vl = vl.at[token_blocks, token_offsets].set(
+                    v.astype(vl.dtype), mode="drop"
+                )
+                attn = packed_attention(
+                    q, k, v, segment_ids, spec=inner_spec,
+                    window=cfg.sliding_window,
+                )
+                attn_out = attn.reshape(t, cfg.q_dim) @ lp["wo"]
+                if cfg.proj_bias:
+                    attn_out = attn_out + lp["bo"]
+                out = carry + attn_out
+                h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
+                out = out + _mlp(cfg, lp, h2, inner_spec)
+                return out, (kl, vl)
+
+            y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
+            return y, k2, v2
+
+        (_, kp, vp), y = _stage_ticks(
+            s, stage, work, (x_in, k_pool, v_pool), collect_last=True
+        )
+        return y, kp, vp
+
+    y, k2, v2 = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(params["layers"], cache["k"], cache["v"], x0)
+    y = _norm(cfg, y, params["final_norm"], params.get("final_norm_b"))
+    h_last = y[last_idx]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (h_last @ head).astype(jnp.float32), {"k": k2, "v": v2}
+
+
+def decode_step_paged_pp(
+    params: dict,
+    cfg: TransformerConfig,
+    cache: dict,  # paged pool, L sharded over pp
+    input_ids: jnp.ndarray,  # [B, Tq]
+    cache_len: jnp.ndarray,  # [B]
+    block_table: jnp.ndarray,  # [B, NBT]
+    active: jnp.ndarray,  # [B] bool
+    mesh: Mesh,
+    attn_spec: AttnSpec | None = None,
+    compute_logits: bool = True,
+    pos_offset: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray | None, dict]:
+    """Paged decode with layers sharded over pipeline stages: the [B, Tq]
+    activation rides the sequential stage conveyor (per-token latency is S
+    stage passes — the price of serving a model S× larger than one chip's
+    reach); idle stages cond-skip, so HBM traffic per token stays one full
+    model read spread across stages. models/lm.decode_step_paged is the
+    single-stage twin.
+    """
+    from areal_tpu.models.lm import _embed, _mlp, _norm, _qkv, _rope
+    from areal_tpu.ops.attention import decode_attention_xla
+
+    s = pp_size(mesh)
+    b, tq = input_ids.shape
+    nbt = block_table.shape[1]
+    bs = cache["k"].shape[2]
+    write_pos = cache_len[:, None] + jnp.arange(tq)[None, :]
+    rope_pos = write_pos
+    if pos_offset is not None:
+        rope_pos = rope_pos + pos_offset[:, None]
+    x0 = _embed(params, cfg, input_ids, rope_pos)
+    li = jnp.clip(write_pos // bs, 0, nbt - 1)
+    phys = jnp.take_along_axis(block_table, li, axis=1)
+    phys = jnp.where(active[:, None], jnp.maximum(phys, 0), 0)
+    flat_phys = phys.reshape(-1)
+    flat_off = (write_pos % bs).reshape(-1)
+    gather_ids = jnp.maximum(block_table, 0)
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+
+    def stage_fn(layers_local, k_pool, v_pool, x_in):
+        stage = jax.lax.axis_index(AXIS_PP)
+
+        def work(x, kp, vp):
+            def body(carry, layer_in):
+                lp, kl, vl = layer_in
+                h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
+                q, k, v = _qkv(cfg, lp, h)
+                if cfg.pos_embed_type == "rope":
+                    q = _rope(cfg, q, rope_pos)
+                    k = _rope(cfg, k, rope_pos)
+                rows_k = k.reshape(b * tq, *k.shape[2:])
+                rows_v = v.reshape(b * tq, *v.shape[2:])
+                kl = kl.at[flat_phys, flat_off].set(
+                    rows_k.astype(kl.dtype), mode="drop"
+                )
+                vl = vl.at[flat_phys, flat_off].set(
+                    rows_v.astype(vl.dtype), mode="drop"
+                )
+                k_view = kl[gather_ids].reshape(b, nbt * bs, *kl.shape[2:])
+                v_view = vl[gather_ids].reshape(b, nbt * bs, *vl.shape[2:])
+                attn = decode_attention_xla(
+                    q, k_view, v_view, cache_len + tq,
+                    window=cfg.sliding_window,
+                )
+                attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
+                if cfg.proj_bias:
+                    attn_out = attn_out + lp["bo"]
+                out = carry + attn_out
+                h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
+                mlp_out = _mlp(
+                    cfg, lp, h2.reshape(-1, cfg.hidden_size), inner_spec
+                ).reshape(h2.shape)
+                return out + mlp_out, (kl, vl)
+
+            y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
+            return y, k2, v2
+
+        (_, kp, vp), y = _stage_ticks(
+            s, stage, work, (x_in, k_pool, v_pool), collect_last=True
+        )
+        return y, kp, vp
+
+    y, k2, v2 = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(params["layers"], cache["k"], cache["v"], x0)
+    cache = {"k": k2, "v": v2}
+    if not compute_logits:
+        return None, cache
+    y = _norm(cfg, y, params["final_norm"], params.get("final_norm_b"))
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (y @ head).astype(jnp.float32), cache
+
+
 def forward_packed_pipelined(
     params: dict,
     cfg: TransformerConfig,
