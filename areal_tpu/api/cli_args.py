@@ -102,6 +102,7 @@ def _coerce(value: Any, tp: Any) -> Any:
 # TrainEngineConfig entries (subclass tables add to — and override — them)
 _KEY_ALIASES: dict[str, dict[str, str]] = {
     "TrainEngineConfig": {
+        "virtual_pipeline_parallel_size": "backend.vpp",
         "dtype": "backend.param_dtype",
         "grad_reduce_dtype": "backend.grad_acc_dtype",
         "gradient_checkpointing": "backend.remat",
@@ -427,6 +428,13 @@ class EngineBackendConfig:
     # O(pp) live activations — feed more microbatches per step for the same
     # memory, shrinking the bubble. LoRA engines fall back to gpipe.
     pp_schedule: str = "gpipe"
+    # virtual pipeline (interleaved) stages per pp device — the Megatron
+    # virtual_pipeline_parallel_size capability (reference
+    # alloc_mode.py:216-241): each device owns vpp non-contiguous layer
+    # chunks, cutting the pipeline bubble by vpp x
+    # (parallel/pipeline.pipeline_hidden_interleaved). gpipe schedule only;
+    # needs num_hidden_layers % (pp * vpp) == 0.
+    vpp: int = 1
 
 
 @dataclass

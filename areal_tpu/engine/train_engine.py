@@ -291,7 +291,9 @@ class TPUTrainEngine(TrainEngine):
             self.model_config = model_config
         else:
             self.model_config = from_hf_config(cfg.path)
-        check_pp_compatible(self.model_config, self.mesh)
+        check_pp_compatible(
+            self.model_config, self.mesh, vpp=cfg.backend.vpp
+        )
         self._pp_replicated_data = False
         if pp_size(self.mesh) > 1 and distributed.process_count() > 1:
             # Two supported multi-host pp data placements, decided by the
@@ -815,7 +817,12 @@ class TPUTrainEngine(TrainEngine):
             acc_dtype = _DTYPES[backend.grad_acc_dtype]
             lora_cfg = self.config.lora
 
-            if (
+            if backend.pp_schedule == "1f1b" and backend.vpp > 1:
+                logger.warning(
+                    "pp_schedule=1f1b ignores vpp (interleaved chunks ride "
+                    "the gpipe schedule only); falling back to gpipe"
+                )
+            elif (
                 backend.pp_schedule == "1f1b"
                 and lora_cfg is None
                 and token_loss_fn is not None
@@ -836,13 +843,13 @@ class TPUTrainEngine(TrainEngine):
 
                 self._jit_cache[key] = jax.jit(step_1f1b)
                 return self._jit_cache[key]
-            if backend.pp_schedule == "1f1b":
+            if backend.pp_schedule == "1f1b" and backend.vpp == 1:
                 logger.warning(
                     "pp_schedule=1f1b needs the fused-loss contract "
                     "(TokenLossFn) and supports neither LoRA nor critics; "
                     "falling back to gpipe"
                 )
-            elif backend.pp_schedule != "gpipe":
+            elif backend.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(
                     f"unknown pp_schedule {backend.pp_schedule!r}; "
                     "use gpipe | 1f1b"
@@ -859,6 +866,7 @@ class TPUTrainEngine(TrainEngine):
                     attn_spec=attn_spec,
                     remat=backend.remat,
                     remat_policy=backend.remat_policy,
+                    vpp=backend.vpp,
                 )
                 losses = jax.vmap(loss_fn)(logits, mbs)  # [M]
                 return jnp.sum(losses), losses
@@ -1177,7 +1185,7 @@ class TPUTrainEngine(TrainEngine):
                     logits = forward_packed_pipelined(
                         params, cfg, mbs["input_ids"], mbs["positions"],
                         mbs["segment_ids"], mesh, attn_spec=attn_spec,
-                        remat=False,
+                        remat=False, vpp=self.config.backend.vpp,
                     )
                     return jnp.sum(jax.vmap(loss_fn)(logits, mbs))
 
@@ -1235,7 +1243,7 @@ class TPUTrainEngine(TrainEngine):
                     logits = forward_packed_pipelined(
                         params, cfg, mbs["input_ids"], mbs["positions"],
                         mbs["segment_ids"], mesh, attn_spec=attn_spec,
-                        remat=False,
+                        remat=False, vpp=self.config.backend.vpp,
                     )
                     if post_hook is not None:
                         return jax.vmap(post_hook)(logits, mbs)

@@ -43,14 +43,16 @@ def pp_size(mesh: Mesh | None) -> int:
     return int(mesh.shape.get(AXIS_PP, 1)) if mesh is not None else 1
 
 
-def check_pp_compatible(cfg: TransformerConfig, mesh: Mesh) -> None:
+def check_pp_compatible(
+    cfg: TransformerConfig, mesh: Mesh, vpp: int = 1
+) -> None:
     s = pp_size(mesh)
     if s <= 1:
         return
-    if cfg.num_hidden_layers % s != 0:
+    if cfg.num_hidden_layers % (s * vpp) != 0:
         raise ValueError(
             f"pipeline parallelism needs num_hidden_layers "
-            f"({cfg.num_hidden_layers}) divisible by pp ({s})"
+            f"({cfg.num_hidden_layers}) divisible by pp*vpp ({s}*{vpp})"
         )
     if cfg.is_vlm:
         raise NotImplementedError(
@@ -692,6 +694,160 @@ def decode_step_paged_pp(
     return (y @ head).astype(jnp.float32), cache
 
 
+def pipeline_hidden_interleaved(
+    params: dict,
+    cfg: TransformerConfig,
+    embeds: jnp.ndarray,  # [M, T, H] post-embedding microbatch stack
+    positions: jnp.ndarray,  # [M, T]
+    segment_ids: jnp.ndarray,  # [M, T]
+    mesh: Mesh,
+    vpp: int,
+    attn_spec: AttnSpec | None = None,
+    remat: bool = True,
+    remat_policy: str = "nothing_saveable",
+) -> jnp.ndarray:
+    """Interleaved (virtual-stage) pipeline schedule: the Megatron
+    ``virtual_pipeline_parallel_size`` capability
+    (reference: areal/api/alloc_mode.py:216-241 vpp plumbing, Megatron
+    interleaved 1F1B), re-derived for the GSPMD conveyor.
+
+    Each of the S pp devices owns V=``vpp`` NON-contiguous layer chunks:
+    virtual stage ``j`` (layers ``[j*Lc, (j+1)*Lc)``, ``Lc = L/(S*V)``)
+    lives on device ``j % S``. A microbatch circulates the pp ring V times,
+    one chunk per tick, over a single ring ``ppermute`` that includes the
+    wrap edge ``(S-1, 0)``. Microbatches inject in groups of S (group g,
+    slot r enters stage 0 at tick ``g*V*S + r``), which makes the conveyor
+    collision-free: at every tick each device runs exactly one chunk.
+
+    Total ticks = ``M*V + S - 1`` of one-chunk work vs GPipe's
+    ``M + S - 1`` ticks of V-chunk work — same compute, but the fill/drain
+    bubble shrinks from ``(S-1)`` stage-times to ``(S-1)`` CHUNK-times:
+    bubble fraction (S-1)/(M*V + S - 1), the V-fold interleaved-schedule
+    reduction. With vpp=1 the index algebra degenerates exactly to
+    ``pipeline_hidden``'s GPipe schedule.
+
+    Cost note: params["layers"] is stored contiguously pp-sharded; the
+    strided virtual-stage assignment is produced by a reshape+transpose
+    under a sharding constraint, i.e. one weight collective-permute per
+    call (and its transpose in backward). Storing the interleaved layout
+    natively would delete that traffic; measured first.
+
+    M is padded up to a multiple of S internally (pad lanes compute
+    garbage that is never collected). Backward is AD through the scan,
+    like the GPipe path.
+    """
+    from areal_tpu.models.lm import _REMAT_POLICIES, _block
+
+    s = pp_size(mesh)
+    v = int(vpp)
+    m0 = embeds.shape[0]
+    t_len = embeds.shape[1]
+    h = embeds.shape[2]
+    if cfg.num_hidden_layers % (s * v) != 0:
+        raise ValueError(
+            f"interleaved pp needs num_hidden_layers "
+            f"({cfg.num_hidden_layers}) divisible by pp*vpp ({s}*{v})"
+        )
+    lc = cfg.num_hidden_layers // (s * v)
+    m = -(-m0 // s) * s  # group injection needs M % S == 0
+    if m != m0:
+        pad = m - m0
+        embeds = jnp.concatenate(
+            [embeds, jnp.zeros((pad, t_len, h), embeds.dtype)]
+        )
+        positions = jnp.concatenate(
+            [positions, jnp.zeros((pad, t_len), positions.dtype)]
+        )
+        segment_ids = jnp.concatenate(
+            [segment_ids, jnp.zeros((pad, t_len), segment_ids.dtype)]
+        )
+    vs = v * s
+    steps = m * v + s - 1
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+
+    # [L, ...] -> [S, V, Lc, ...]: element [i, vk] = virtual stage vk*S + i.
+    # reshape [V, S, Lc] is free; the axis swap under the pp in_spec is the
+    # one weight collective-permute named in the docstring.
+    def arrange(a):
+        a2 = a.reshape(v, s, lc, *a.shape[1:])
+        return jnp.swapaxes(a2, 0, 1)
+
+    layers_arr = jax.tree.map(arrange, params["layers"])
+
+    def run_chunk(chunk_layers, x, pos, seg):
+        def body(carry, lp):
+            return _block(cfg, lp, carry, pos, seg, inner_spec), None
+
+        if remat:
+            body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
+        y, _ = jax.lax.scan(body, x, chunk_layers)
+        return y
+
+    def stage_fn(layers_local, emb, pos_all, seg_all):
+        # layers_local: [1, V, Lc, ...]
+        stage = jax.lax.axis_index(AXIS_PP)
+
+        def tick(carry, tt):
+            x_carry, out = carry
+            u = tt - stage
+            uc = jnp.clip(u, 0, m * v - 1)
+            g = uc // vs
+            w = uc % vs
+            vchunk = w // s
+            r = w % s
+            mb = g * s + r
+            # stage 0 / chunk 0 injects a fresh microbatch; every other
+            # (stage, chunk) consumes the ring carry (garbage during
+            # fill/drain rides through and is never collected)
+            fresh = (stage == 0) & (vchunk == 0)
+            x0 = jax.lax.dynamic_index_in_dim(emb, mb, 0, False)
+            x_in = jnp.where(fresh, x0, x_carry)
+            pos = jax.lax.dynamic_index_in_dim(pos_all, mb, 0, False)
+            seg = jax.lax.dynamic_index_in_dim(seg_all, mb, 0, False)
+            chunk_layers = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a[0], vchunk, 0, False),
+                layers_local,
+            )
+            y = run_chunk(chunk_layers, x_in, pos, seg)
+            # microbatch mb exits its last virtual stage on device S-1 at
+            # chunk V-1; park every other tick's write in scratch row M
+            is_out = (stage == s - 1) & (vchunk == v - 1) & (u >= 0) & (
+                u < m * v
+            )
+            slot = jnp.where(is_out, mb, m)
+            out = jax.lax.dynamic_update_index_in_dim(out, y, slot, 0)
+            nxt = jax.lax.ppermute(
+                y, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
+            )
+            return (nxt, out), None
+
+        carry0 = (
+            jnp.zeros((t_len, h), emb.dtype),
+            jnp.zeros((m + 1, t_len, h), emb.dtype),
+        )
+        (_, out), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
+        out = jnp.where(stage == s - 1, out[:m], 0.0)
+        if shard_out:
+            # same reduce-scatter trade as pipeline_hidden: each stage keeps
+            # its own token slice, halving wire traffic and handing the head
+            # boundary an already-pp-sharded tensor
+            return jax.lax.psum_scatter(
+                out, AXIS_PP, scatter_dimension=1, tiled=True
+            )
+        return jax.lax.psum(out, AXIS_PP)
+
+    shard_out = t_len % s == 0
+    out = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(), P(), P()),
+        out_specs=P(None, AXIS_PP) if shard_out else P(),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(layers_arr, embeds, positions, segment_ids)
+    return out[:m0]
+
+
 def forward_packed_pipelined(
     params: dict,
     cfg: TransformerConfig,
@@ -702,6 +858,7 @@ def forward_packed_pipelined(
     attn_spec: AttnSpec | None = None,
     remat: bool = False,
     remat_policy: str = "nothing_saveable",
+    vpp: int = 1,
 ) -> jnp.ndarray:
     """Pipelined counterpart of models/lm.forward_packed over M stacked
     microbatches: logits [M, T, V] fp32 (values [M, T] for critics).
@@ -713,17 +870,31 @@ def forward_packed_pipelined(
     from areal_tpu.models.lm import _embed, _norm
 
     x = _embed(params, cfg, input_ids, positions)  # [M, T, H]
-    x = pipeline_hidden(
-        params,
-        cfg,
-        x,
-        positions,
-        segment_ids,
-        mesh,
-        attn_spec=attn_spec,
-        remat=remat,
-        remat_policy=remat_policy,
-    )
+    if vpp > 1:
+        x = pipeline_hidden_interleaved(
+            params,
+            cfg,
+            x,
+            positions,
+            segment_ids,
+            mesh,
+            vpp,
+            attn_spec=attn_spec,
+            remat=remat,
+            remat_policy=remat_policy,
+        )
+    else:
+        x = pipeline_hidden(
+            params,
+            cfg,
+            x,
+            positions,
+            segment_ids,
+            mesh,
+            attn_spec=attn_spec,
+            remat=remat,
+            remat_policy=remat_policy,
+        )
     # spread head/loss work across ALL devices: pp joins dp/cp as token
     # parallelism for the out-of-pipeline ops
     x = jax.lax.with_sharding_constraint(
