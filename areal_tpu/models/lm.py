@@ -600,6 +600,84 @@ def write_prefill_blocks(
     return {"k": scatter(cache["k"], ks), "v": scatter(cache["v"], vs)}
 
 
+def _decode_paged_layer(
+    cfg: TransformerConfig,
+    lp: Params,
+    k_pool: jnp.ndarray,  # [NB, BS, KH, D] one layer's pool slice
+    v_pool: jnp.ndarray,
+    h_in: jnp.ndarray,  # [B, Tq, H]
+    rope_pos: jnp.ndarray,  # [B, Tq]
+    flat_phys: jnp.ndarray,  # [B*Tq] physical block per new token
+    flat_off: jnp.ndarray,  # [B*Tq] offset within block
+    gather_ids: jnp.ndarray,  # [B, NBT] table view (trash clamped to 0)
+    total_len: jnp.ndarray,  # [B] cache_len + Tq
+    attn_spec,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder layer of paged decode: scatter new K/V into the pool,
+    attend over the gathered block-table view, MLP. Shared by the
+    single-stage path (``decode_step_paged``) and the pipeline-stage
+    conveyor (``parallel/pipeline.decode_step_paged_pp``) so the two can
+    never diverge. Returns (h_out, k_pool, v_pool)."""
+    b, tq = h_in.shape[:2]
+    nbt = gather_ids.shape[1]
+    bs = k_pool.shape[1]
+    h = _norm(cfg, h_in, lp["ln1"], lp.get("ln1_b"))
+    q, k, v = _qkv(cfg, lp, h)
+    if cfg.pos_embed_type == "rope":
+        q = _rope(cfg, q, rope_pos)
+        k = _rope(cfg, k, rope_pos)
+
+    def write(pool, new):
+        rows = new.reshape(b * tq, *new.shape[2:]).astype(pool.dtype)
+        return pool.at[flat_phys, flat_off].set(rows, mode="drop")
+
+    k_pool = write(k_pool, k)
+    v_pool = write(v_pool, v)
+    k_view = k_pool[gather_ids].reshape(b, nbt * bs, *k_pool.shape[2:])
+    v_view = v_pool[gather_ids].reshape(b, nbt * bs, *v_pool.shape[2:])
+    attn = decode_attention_xla(
+        q, k_view, v_view, total_len, window=cfg.sliding_window
+    )
+    attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
+    if cfg.proj_bias:
+        attn_out = attn_out + lp["bo"]
+    h_out = h_in + attn_out
+    h2 = _norm(cfg, h_out, lp["ln2"], lp.get("ln2_b"))
+    mlp_out = _mlp(
+        cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
+    ).reshape(h2.shape)
+    return h_out + mlp_out, k_pool, v_pool
+
+
+def _prefill_stream_layer(
+    cfg: TransformerConfig,
+    lp: Params,
+    carry: jnp.ndarray,  # [T, H]
+    rope_pos: jnp.ndarray,  # [T] or [3, T] (M-RoPE)
+    segment_ids: jnp.ndarray,  # [T]
+    attn_spec,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder layer of the packed ragged prompt pass. Shared by
+    ``prefill_stream`` and ``parallel/pipeline.prefill_stream_pp``.
+    Returns (out [T, H], k [T, KH, D], v [T, KH, D])."""
+    t = carry.shape[0]
+    h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
+    q, k, v = _qkv(cfg, lp, h)
+    if cfg.pos_embed_type == "rope":
+        q = _rope(cfg, q, rope_pos)
+        k = _rope(cfg, k, rope_pos)
+    attn = packed_attention(
+        q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
+    )
+    attn_out = attn.reshape(t, cfg.q_dim) @ lp["wo"]
+    if cfg.proj_bias:
+        attn_out = attn_out + lp["bo"]
+    out = carry + attn_out
+    h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
+    out = out + _mlp(cfg, lp, h2, attn_spec)
+    return out, k, v
+
+
 def decode_step_paged(
     params: Params,
     cfg: TransformerConfig,
@@ -643,32 +721,10 @@ def decode_step_paged(
     def body(carry, layer_in):
         (h_in,) = carry
         lp, k_pool, v_pool = layer_in
-        h = _norm(cfg, h_in, lp["ln1"], lp.get("ln1_b"))
-        q, k, v = _qkv(cfg, lp, h)
-        if cfg.pos_embed_type == "rope":
-            q = _rope(cfg, q, rope_pos)
-            k = _rope(cfg, k, rope_pos)
-
-        def write(pool, new):
-            rows = new.reshape(b * tq, *new.shape[2:]).astype(pool.dtype)
-            return pool.at[flat_phys, flat_off].set(rows, mode="drop")
-
-        k_pool = write(k_pool, k)
-        v_pool = write(v_pool, v)
-        k_view = k_pool[gather_ids].reshape(b, nbt * bs, *k_pool.shape[2:])
-        v_view = v_pool[gather_ids].reshape(b, nbt * bs, *v_pool.shape[2:])
-        attn = decode_attention_xla(
-            q, k_view, v_view, cache_len + tq, window=cfg.sliding_window
+        h_out, k_pool, v_pool = _decode_paged_layer(
+            cfg, lp, k_pool, v_pool, h_in, rope_pos, flat_phys, flat_off,
+            gather_ids, cache_len + tq, attn_spec,
         )
-        attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
-        if cfg.proj_bias:
-            attn_out = attn_out + lp["bo"]
-        h_out = h_in + attn_out
-        h2 = _norm(cfg, h_out, lp["ln2"], lp.get("ln2_b"))
-        mlp_out = _mlp(
-            cfg, lp, h2.reshape(-1, cfg.hidden_size), attn_spec
-        ).reshape(h2.shape)
-        h_out = h_out + mlp_out
         return (h_out,), (k_pool, v_pool)
 
     (x,), (new_k, new_v) = jax.lax.scan(
@@ -734,7 +790,6 @@ def prefill_stream(
     ``positions3`` carries per-token (t, h, w) M-RoPE streams for qwen2_vl
     prompts (vlm_qwen2.mrope_positions per prompt, offset-free).
     """
-    t = input_ids.shape[0]
     rope_pos = positions3 if positions3 is not None else positions
     x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
@@ -755,20 +810,9 @@ def prefill_stream(
         x = splice_image_embeds(cfg, x, input_ids, embeds)
 
     def body(carry, lp):
-        h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
-        q, k, v = _qkv(cfg, lp, h)
-        if cfg.pos_embed_type == "rope":
-            q = _rope(cfg, q, rope_pos)
-            k = _rope(cfg, k, rope_pos)
-        attn = packed_attention(
-            q, k, v, segment_ids, spec=attn_spec, window=cfg.sliding_window
+        out, k, v = _prefill_stream_layer(
+            cfg, lp, carry, rope_pos, segment_ids, attn_spec
         )
-        attn_out = attn.reshape(t, cfg.q_dim) @ lp["wo"]
-        if cfg.proj_bias:
-            attn_out = attn_out + lp["bo"]
-        out = carry + attn_out
-        h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
-        out = out + _mlp(cfg, lp, h2, attn_spec)
         return out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])

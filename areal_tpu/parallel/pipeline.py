@@ -46,8 +46,17 @@ def pp_size(mesh: Mesh | None) -> int:
 def check_pp_compatible(
     cfg: TransformerConfig, mesh: Mesh, vpp: int = 1
 ) -> None:
+    if vpp < 1:
+        raise ValueError(f"backend.vpp must be >= 1, got {vpp}")
     s = pp_size(mesh)
     if s <= 1:
+        if vpp > 1:
+            from areal_tpu.utils import logging
+
+            logging.getLogger("pipeline").warning(
+                "backend.vpp=%d has no effect without pipeline parallelism "
+                "(pp=1); interleaving is a pp schedule", vpp
+            )
         return
     if cfg.num_hidden_layers % (s * vpp) != 0:
         raise ValueError(
@@ -531,11 +540,9 @@ def prefill_stream_pp(
 
     Returns (last-token logits [N, V] fp32, updated pool).
     """
-    from areal_tpu.models.lm import _embed, _mlp, _norm, _qkv, _rope
-    from areal_tpu.ops.attention import packed_attention
+    from areal_tpu.models.lm import _embed, _norm, _prefill_stream_layer
 
     s = pp_size(mesh)
-    t = input_ids.shape[0]
     rope_pos = positions3 if positions3 is not None else positions
     x0 = _embed(params, cfg, input_ids, positions)
     inner_spec = stage_attn_spec(attn_spec, mesh)
@@ -546,27 +553,15 @@ def prefill_stream_pp(
         def work(x, kp, vp):
             def body(carry, layer_in):
                 lp, kl, vl = layer_in
-                h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
-                q, k, v = _qkv(cfg, lp, h)
-                if cfg.pos_embed_type == "rope":
-                    q = _rope(cfg, q, rope_pos)
-                    k = _rope(cfg, k, rope_pos)
+                out, k, v = _prefill_stream_layer(
+                    cfg, lp, carry, rope_pos, segment_ids, inner_spec
+                )
                 kl = kl.at[token_blocks, token_offsets].set(
                     k.astype(kl.dtype), mode="drop"
                 )
                 vl = vl.at[token_blocks, token_offsets].set(
                     v.astype(vl.dtype), mode="drop"
                 )
-                attn = packed_attention(
-                    q, k, v, segment_ids, spec=inner_spec,
-                    window=cfg.sliding_window,
-                )
-                attn_out = attn.reshape(t, cfg.q_dim) @ lp["wo"]
-                if cfg.proj_bias:
-                    attn_out = attn_out + lp["bo"]
-                out = carry + attn_out
-                h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
-                out = out + _mlp(cfg, lp, h2, inner_spec)
                 return out, (kl, vl)
 
             y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
@@ -613,8 +608,7 @@ def decode_step_paged_pp(
     model read spread across stages. models/lm.decode_step_paged is the
     single-stage twin.
     """
-    from areal_tpu.models.lm import _embed, _mlp, _norm, _qkv, _rope
-    from areal_tpu.ops.attention import decode_attention_xla
+    from areal_tpu.models.lm import _decode_paged_layer, _embed, _norm
 
     s = pp_size(mesh)
     b, tq = input_ids.shape
@@ -639,34 +633,11 @@ def decode_step_paged_pp(
         def work(x, kp, vp):
             def body(carry, layer_in):
                 lp, kl, vl = layer_in
-                h = _norm(cfg, carry, lp["ln1"], lp.get("ln1_b"))
-                q, k, v = _qkv(cfg, lp, h)
-                if cfg.pos_embed_type == "rope":
-                    q = _rope(cfg, q, rope_pos)
-                    k = _rope(cfg, k, rope_pos)
-                rows_k = k.reshape(b * tq, *k.shape[2:])
-                rows_v = v.reshape(b * tq, *v.shape[2:])
-                kl = kl.at[flat_phys, flat_off].set(
-                    rows_k.astype(kl.dtype), mode="drop"
+                out, kl, vl = _decode_paged_layer(
+                    cfg, lp, kl, vl, carry, rope_pos, flat_phys, flat_off,
+                    gather_ids, cache_len + tq, inner_spec,
                 )
-                vl = vl.at[flat_phys, flat_off].set(
-                    rows_v.astype(vl.dtype), mode="drop"
-                )
-                k_view = kl[gather_ids].reshape(b, nbt * bs, *kl.shape[2:])
-                v_view = vl[gather_ids].reshape(b, nbt * bs, *vl.shape[2:])
-                attn = decode_attention_xla(
-                    q, k_view, v_view, cache_len + tq,
-                    window=cfg.sliding_window,
-                )
-                attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
-                if cfg.proj_bias:
-                    attn_out = attn_out + lp["bo"]
-                out = carry + attn_out
-                h2 = _norm(cfg, out, lp["ln2"], lp.get("ln2_b"))
-                mlp_out = _mlp(
-                    cfg, lp, h2.reshape(-1, cfg.hidden_size), inner_spec
-                ).reshape(h2.shape)
-                return out + mlp_out, (kl, vl)
+                return out, (kl, vl)
 
             y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
             return y, k2, v2
@@ -870,31 +841,22 @@ def forward_packed_pipelined(
     from areal_tpu.models.lm import _embed, _norm
 
     x = _embed(params, cfg, input_ids, positions)  # [M, T, H]
-    if vpp > 1:
-        x = pipeline_hidden_interleaved(
-            params,
-            cfg,
-            x,
-            positions,
-            segment_ids,
-            mesh,
-            vpp,
-            attn_spec=attn_spec,
-            remat=remat,
-            remat_policy=remat_policy,
-        )
-    else:
-        x = pipeline_hidden(
-            params,
-            cfg,
-            x,
-            positions,
-            segment_ids,
-            mesh,
-            attn_spec=attn_spec,
-            remat=remat,
-            remat_policy=remat_policy,
-        )
+    hidden_fn = (
+        partial(pipeline_hidden_interleaved, vpp=vpp)
+        if vpp > 1
+        else pipeline_hidden
+    )
+    x = hidden_fn(
+        params,
+        cfg,
+        x,
+        positions,
+        segment_ids,
+        mesh,
+        attn_spec=attn_spec,
+        remat=remat,
+        remat_policy=remat_policy,
+    )
     # spread head/loss work across ALL devices: pp joins dp/cp as token
     # parallelism for the out-of-pipeline ops
     x = jax.lax.with_sharding_constraint(

@@ -79,6 +79,16 @@ def _is_oom(msg: str) -> bool:
     return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
 
 
+def _is_outage(msg: str) -> bool:
+    """Backend/tunnel outage signatures — conditions of the CHIP, not of
+    the measurement config that happened to hit them."""
+    return (
+        "UNAVAILABLE" in msg
+        or "Unable to initialize backend" in msg
+        or "DEADLINE_EXCEEDED" in msg
+    )
+
+
 def _run_child(kind: str, att: dict, timeout: float):
     """Run one measurement in a fresh process: prior OOM must not poison
     HBM, and a wedged tunnel must be killable (an in-process hang would
@@ -160,6 +170,18 @@ def probe_child():
     }
 
 
+KERNEL_CONFIGS = [
+    dict(name="fwd_bwd_b128_t8k", block=128, t=8192, bwd=True),
+    dict(name="fwd_bwd_b256_t8k", block=256, t=8192, bwd=True),
+    dict(name="fwd_bwd_b128_t32k", block=128, t=32768, bwd=True),
+    dict(name="fwd_b128_t32k_window4k", block=128, t=32768, bwd=False,
+         window=4096),
+    dict(name="ring_cp_b128_t8k", block=128, t=8192, bwd=True, ring=True),
+    dict(name="ulysses_b128_t8k", block=128, t=8192, bwd=True,
+         ulysses=True),
+]
+
+
 def kernels_child(configs: list[dict] | None = None):
     """Compile (non-interpret) + execute the Pallas flash kernel fwd+bwd and
     the ring/ulysses wrappers on the real backend; per-config pass/fail."""
@@ -169,16 +191,7 @@ def kernels_child(configs: list[dict] | None = None):
 
     from areal_tpu.ops.pallas.flash_attention import flash_attention_packed
 
-    configs = configs or [
-        dict(name="fwd_bwd_b128_t8k", block=128, t=8192, bwd=True),
-        dict(name="fwd_bwd_b256_t8k", block=256, t=8192, bwd=True),
-        dict(name="fwd_bwd_b128_t32k", block=128, t=32768, bwd=True),
-        dict(name="fwd_b128_t32k_window4k", block=128, t=32768, bwd=False,
-             window=4096),
-        dict(name="ring_cp_b128_t8k", block=128, t=8192, bwd=True, ring=True),
-        dict(name="ulysses_b128_t8k", block=128, t=8192, bwd=True,
-             ulysses=True),
-    ]
+    configs = configs or KERNEL_CONFIGS
     nh, kh, d = 12, 2, 128
     results = {}
     for c in configs:
@@ -512,31 +525,42 @@ def main():
     peak = info.get("peak_flops")
 
     # ---- rung 1: kernel compile validation (cheap, de-risks everything) ----
-    kernels = None
-    if remaining(deadline) > 240:
+    # one child PER config: a single wedged/slow compile costs its own
+    # timeout, not the whole rung (round-4 lesson: the monolithic child hit
+    # the 900s cap with zero results recorded)
+    kernels = {}
+    # per-config timeouts AND a rung-level deadline: one wedged compile
+    # costs its own child, and a fully wedged tunnel still can't starve
+    # the PRIMARY sft rung of wall budget
+    kernel_deadline = min(deadline, time.time() + 900.0)
+    for kc in KERNEL_CONFIGS:
+        if remaining(deadline) < 300 or remaining(kernel_deadline) < 60:
+            log("kernel rung budget spent; moving on")
+            break
         try:
-            log("kernel validation rung")
-            kernels = _run_child(
-                "kernels", {}, timeout=min(900.0, remaining(deadline) - 120)
+            log(f"kernel config {kc['name']}")
+            res = _run_child(
+                "kernels", {"configs": [kc]},
+                timeout=min(
+                    480.0,
+                    remaining(kernel_deadline),
+                    remaining(deadline) - 120,
+                ),
             )
-            n_ok = sum(1 for v in kernels.values() if v.get("ok"))
-            emit({
-                "metric": "pallas_kernel_validation",
-                "value": n_ok,
-                "unit": f"of_{len(kernels)}_configs_compiled",
-                "vs_baseline": None,
-                "chip": chip,
-                "detail": kernels,
-            })
+            kernels.update(res)
         except Exception as e:  # noqa: BLE001
-            log(f"kernel validation rung failed: {e}")
-            emit({
-                "metric": "pallas_kernel_validation",
-                "value": None,
-                "unit": "configs",
-                "vs_baseline": None,
-                "error": str(e)[-400:],
-            })
+            log(f"kernel config {kc['name']} failed: {e}")
+            kernels[kc["name"]] = {"ok": False, "error": str(e)[-400:]}
+    if kernels:
+        n_ok = sum(1 for v in kernels.values() if v.get("ok"))
+        emit({
+            "metric": "pallas_kernel_validation",
+            "value": n_ok,
+            "unit": f"of_{len(kernels)}_configs_compiled",
+            "vs_baseline": None,
+            "chip": chip,
+            "detail": kernels,
+        })
 
     # ---- rung 2 (PRIMARY): SFT train throughput ladder ----
     # full model first (adam OOMs a 16GB chip at 1.5B even with bf16
@@ -563,7 +587,10 @@ def main():
     ]
     tps = mfu_v = None
     used = None
-    for att in attempts:
+    i = 0
+    outage_retries = 0
+    while i < len(attempts):
+        att = attempts[i]
         if remaining(deadline) < 300:
             log("wall budget nearly spent; stopping sft ladder")
             break
@@ -577,10 +604,31 @@ def main():
             break
         except MemoryError:
             log(f"OOM at {att}; falling back")
+            i += 1
         except subprocess.TimeoutExpired:
             log(f"sft attempt timed out at {att}; falling back")
+            i += 1
         except RuntimeError as e:
-            log(f"sft attempt failed at {att}: {e}")
+            msg = str(e)
+            if _is_outage(msg) and outage_retries < 4 and (
+                remaining(deadline) > 600
+            ):
+                # a tunnel/backend outage says nothing about THIS ladder
+                # step — wait for the chip to come back (probe_backend
+                # backs off internally), then retry the same attempt
+                outage_retries += 1
+                log(
+                    f"backend outage (retry {outage_retries}); re-probing "
+                    "before resuming the ladder"
+                )
+                try:
+                    probe_backend(deadline)
+                except Exception as pe:  # noqa: BLE001
+                    log(f"re-probe failed: {pe}")
+                    i += 1
+            else:
+                log(f"sft attempt failed at {att}: {e}")
+                i += 1
 
     primary = None
     if tps is not None:
@@ -690,7 +738,7 @@ def _child_main():
     if kind == "--probe-child":
         print(json.dumps(probe_child()))
     elif kind == "--kernels-child":
-        print(json.dumps(kernels_child()))
+        print(json.dumps(kernels_child(att.get("configs"))))
     elif kind == "--sft-child":
         tps, mfu_v = sft_bench(**att)
         print(json.dumps({"tps": tps, "mfu": mfu_v}))
