@@ -22,44 +22,30 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compile cache: test time is dominated by CPU compiles of
-# the same tiny-model jits; caching them across runs cuts repeat-suite wall
-# time several-fold (first run pays once).
-#
-# The cache key does NOT cover host CPU features: XLA:CPU AOT-compiles
-# executables for the build host's ISA extensions, and loading an entry
-# produced on a machine with different features aborts the interpreter
-# (SIGABRT after "could lead to execution errors such as SIGILL"). Guard by
-# keying the cache *directory* with a fingerprint of this host's CPU feature
-# flags — a different host simply gets a fresh directory.
+# Persistent XLA compile cache: DISABLED by default, opt-in via
+# AREAL_TPU_TEST_CACHE=/path. It would cut warm-suite wall time several-
+# fold, but reloading serialized XLA:CPU executables in this suite ABORTS
+# the interpreter (SIGABRT) in two reproduced modes, and correctness wins:
+# 1. Cross-host: the cache key does not cover host CPU features; an entry
+#    AOT-compiled on a host with different ISA extensions aborts on load
+#    ("could lead to execution errors such as SIGILL", then abort) —
+#    round-3 failure.
+# 2. Same-host, NON-DETERMINISTIC: with a single-host cache, warm runs of
+#    test_engine_train_batch_pp_matches_pp1 abort intermittently (observed
+#    pass/pass/ABORT/pass across four identical invocations) — a race in
+#    entry write/read under this suite's multi-threaded jit dispatch
+#    (inference-engine executor threads compile concurrently with the
+#    main thread). jax_persistent_cache_enable_xla_caches="none" does not
+#    help: on CPU the executable IS the jax-level entry.
+# Opting in accepts that risk (useful for quick local iteration on one
+# test file; never for CI or artifact runs).
 
-
-def _host_cpu_fingerprint() -> str:
-    import hashlib
-    import platform
-
-    feats = ""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith(("flags", "Features")):
-                    feats = line
-                    break
-    except OSError:
-        pass
-    raw = f"{platform.machine()}|{jax.__version__}|{feats}"
-    return hashlib.sha1(raw.encode()).hexdigest()[:10]
-
-
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "AREAL_TPU_TEST_CACHE",
-        f"/tmp/areal_tpu_test_jax_cache-{_host_cpu_fingerprint()}",
-    ),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+if os.environ.get("AREAL_TPU_TEST_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["AREAL_TPU_TEST_CACHE"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 

@@ -256,3 +256,25 @@ recover:
     rewards_path = os.path.join(fileroot, "e2e-grpo-mh", "t0", "logs", "rewards.json")
     assert os.path.isfile(rewards_path), r.stderr[-3000:]
     assert len(json.load(open(rewards_path))) == 2
+
+
+@pytest.mark.slow
+def test_real_scale_e2e_script_smoke():
+    """scripts/real_e2e_grpo.py (VERDICT r3 #6): the real-scale e2e GRPO
+    harness must run its full loop (MATH-500 data, math verifier, async
+    colocated engine, device weight push) on CPU smoke shapes and write
+    the artifact with a rising part-B reward trend."""
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(), "e2e_smoke.json")
+    r = _run(
+        [sys.executable, "scripts/real_e2e_grpo.py", "--smoke",
+         "--steps", "3", "--out", out],
+        env_extra={},
+        timeout=1800,
+    )
+    assert r.returncode == 0, f"STDOUT:\n{r.stdout[-3000:]}\nSTDERR:\n{r.stderr[-5000:]}"
+    art = json.load(open(out))
+    assert len(art["part_a_real_scale"]["steps"]) == 3
+    b = art["part_b_learning"]
+    assert b["reward_last3_mean"] > b["reward_first3_mean"]
