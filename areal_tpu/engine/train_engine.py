@@ -554,12 +554,38 @@ class TPUTrainEngine(TrainEngine):
         n = int(packed_mbs[0]["cu_seqlens"][-1])
         if any(int(p["cu_seqlens"][-1]) != n for p in packed_mbs):
             raise ValueError("stacked microbatches must share one bucket")
-        if any("pixel_values" in p for p in packed_mbs):
-            raise NotImplementedError("pp>1 with pixel_values is unsupported")
         rep = NamedSharding(self.mesh, P())
         out = {}
+        if any("pixel_values" in p for p in packed_mbs):
+            if not all("pixel_values" in p for p in packed_mbs):
+                raise NotImplementedError(
+                    "pp>1 VLM needs every microbatch to carry pixel_values "
+                    "(mixed text/image microbatch splits are unsupported)"
+                )
+            # pad per-mb image tables with ghost rows to a common Pmax and
+            # stack [M, Pmax, ...]; ghost rows encode garbage the
+            # placeholder-rank splice never reads (lm.embed_with_images)
+            tables = [
+                np.asarray(_flat_pixels(p), np.float32) for p in packed_mbs
+            ]
+            pmax = max(t.shape[0] for t in tables)
+            if self.model_config.is_qwen_vl and self._vlm_grids:
+                # ghost rows must form WHOLE ghost images: the qwen2_vl
+                # image count derives as P // prod(grid) inside the trace
+                gt, gh, gw = self._vlm_grids
+                ppi = gt * gh * gw
+                pmax = -(-pmax // ppi) * ppi
+            tables = [
+                np.concatenate(
+                    [t, np.zeros((pmax - t.shape[0],) + t.shape[1:],
+                                 np.float32)]
+                ) if t.shape[0] < pmax else t
+                for t in tables
+            ]
+            out["pixel_values"] = jax.device_put(np.stack(tables), rep)
         for k in packed_mbs[0]:
-            if k in ("cu_seqlens", "max_seqlen", "image_grid_thw"):
+            if k in ("cu_seqlens", "max_seqlen", "image_grid_thw",
+                     "pixel_values"):
                 continue
             arrs = [np.asarray(p[k]) for p in packed_mbs]
             if any(a.shape != arrs[0].shape for a in arrs[1:]):
@@ -669,6 +695,12 @@ class TPUTrainEngine(TrainEngine):
             if distributed.process_count() > 1:
                 t = int(distributed.sync_max(t))
             packed_mbs = [self._repad_packed(p, t) for p in packed_mbs]
+            if self.model_config.is_qwen_vl:
+                # _repad_packed rebuilt PLAIN positions; qwen2_vl mbs need
+                # their [3, T] M-RoPE streams recomputed over the new bucket
+                for p in packed_mbs:
+                    if "pixel_values" in p:
+                        p["positions"] = self._mrope_positions_packed(p)
         if self._pp_replicated_data:
             # synchronized-batch multi-host pp: every host MUST be feeding
             # the identical batch — a silent divergence would build
@@ -810,17 +842,19 @@ class TPUTrainEngine(TrainEngine):
         forward_packed_pipelined overlaps their stage compute, and grad
         accumulation over M falls out of summing the vmapped per-mb losses
         (no explicit accumulator buffer)."""
-        key = ("grad_pp", loss_fn, token_loss_fn)
+        key = ("grad_pp", loss_fn, token_loss_fn, self._vlm_grids)
         if key not in self._jit_cache:
             cfg, backend = self.model_config, self.config.backend
             mesh, attn_spec = self.mesh, self.attn_spec
             acc_dtype = _DTYPES[backend.grad_acc_dtype]
             lora_cfg = self.config.lora
 
-            if backend.pp_schedule == "1f1b" and backend.vpp > 1:
+            if backend.pp_schedule == "1f1b" and (
+                backend.vpp > 1 or cfg.is_vlm
+            ):
                 logger.warning(
-                    "pp_schedule=1f1b ignores vpp (interleaved chunks ride "
-                    "the gpipe schedule only); falling back to gpipe"
+                    "pp_schedule=1f1b supports neither vpp>1 nor vision "
+                    "towers; falling back to gpipe"
                 )
             elif (
                 backend.pp_schedule == "1f1b"
@@ -843,7 +877,11 @@ class TPUTrainEngine(TrainEngine):
 
                 self._jit_cache[key] = jax.jit(step_1f1b)
                 return self._jit_cache[key]
-            if backend.pp_schedule == "1f1b" and backend.vpp == 1:
+            if (
+                backend.pp_schedule == "1f1b"
+                and backend.vpp == 1
+                and not cfg.is_vlm
+            ):
                 logger.warning(
                     "pp_schedule=1f1b needs the fused-loss contract "
                     "(TokenLossFn) and supports neither LoRA nor critics; "
@@ -854,6 +892,8 @@ class TPUTrainEngine(TrainEngine):
                     f"unknown pp_schedule {backend.pp_schedule!r}; "
                     "use gpipe | 1f1b"
                 )
+
+            vlm_grids = self._vlm_grids
 
             def compute(params, mbs):
                 logits = forward_packed_pipelined(
@@ -867,6 +907,8 @@ class TPUTrainEngine(TrainEngine):
                     remat=backend.remat,
                     remat_policy=backend.remat_policy,
                     vpp=backend.vpp,
+                    pixel_values=mbs.get("pixel_values"),
+                    image_grid_thw=vlm_grids,
                 )
                 losses = jax.vmap(loss_fn)(logits, mbs)  # [M]
                 return jnp.sum(losses), losses
@@ -1176,16 +1218,19 @@ class TPUTrainEngine(TrainEngine):
                 total += float(evf(self.effective_params(), self._mb_to_device(packed)))
             return total / max(denom, 1.0)
         if pp_size(self.mesh) > 1:
-            pkey = ("eval_pp", loss_fn)
+            pkey = ("eval_pp", loss_fn, self._vlm_grids)
             if pkey not in self._jit_cache:
                 cfg = self.model_config
                 mesh, attn_spec = self.mesh, self.attn_spec
+                vlm_grids = self._vlm_grids
 
                 def ev_pp(params, mbs):
                     logits = forward_packed_pipelined(
                         params, cfg, mbs["input_ids"], mbs["positions"],
                         mbs["segment_ids"], mesh, attn_spec=attn_spec,
                         remat=False, vpp=self.config.backend.vpp,
+                        pixel_values=mbs.get("pixel_values"),
+                        image_grid_thw=vlm_grids,
                     )
                     return jnp.sum(jax.vmap(loss_fn)(logits, mbs))
 
@@ -1234,16 +1279,19 @@ class TPUTrainEngine(TrainEngine):
         assert self.initialized
         mb_list, packed_mbs, real_ns = self._prepare_mbs(input_)
         if pp_size(self.mesh) > 1:
-            key = ("fwd_pp", post_hook)
+            key = ("fwd_pp", post_hook, self._vlm_grids)
             if key not in self._jit_cache:
                 cfg = self.model_config
                 mesh, attn_spec = self.mesh, self.attn_spec
+                vlm_grids = self._vlm_grids
 
                 def fwd_pp(params, mbs):
                     logits = forward_packed_pipelined(
                         params, cfg, mbs["input_ids"], mbs["positions"],
                         mbs["segment_ids"], mesh, attn_spec=attn_spec,
                         remat=False, vpp=self.config.backend.vpp,
+                        pixel_values=mbs.get("pixel_values"),
+                        image_grid_thw=vlm_grids,
                     )
                     if post_hook is not None:
                         return jax.vmap(post_hook)(logits, mbs)

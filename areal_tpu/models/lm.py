@@ -401,19 +401,20 @@ _REMAT_POLICIES = {
 }
 
 
-def _trunk(
+def embed_with_images(
     params: Params,
     cfg: TransformerConfig,
     input_ids: jnp.ndarray,  # [T] int32
-    positions: jnp.ndarray,  # [T] int32
-    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
-    remat: bool = False,
-    attn_spec: AttnSpec | None = None,
-    pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
-    remat_policy: str = "nothing_saveable",
-    image_grid_thw: tuple | None = None,  # qwen2_vl: static (t,h,w) per image
+    positions: jnp.ndarray | None,  # [T] / [3, T] (rope models ignore it)
+    pixel_values: jnp.ndarray | None,  # [N, S, S, 3] or [P, pd] stream order
+    image_grid_thw: tuple | None,  # qwen2_vl: static (t,h,w) per image
 ) -> jnp.ndarray:
-    """Embed -> layer scan -> final norm: hidden states [T, H]."""
+    """Token embeddings with image embeddings spliced at placeholder rows
+    — the shared pre-decoder step of every VLM forward (packed, prefill,
+    and the pipelined paths). Ghost pixel rows appended by stacked-
+    microbatch padding are safe: splice_image_embeds gathers by
+    placeholder rank, so rows beyond the real placeholder count are never
+    read."""
     x = _embed(params, cfg, input_ids, positions)
     if pixel_values is not None:
         from areal_tpu.models.vlm import splice_image_embeds
@@ -435,6 +436,25 @@ def _trunk(
 
             embeds = encode_images(params["vision"], cfg, pixel_values)
         x = splice_image_embeds(cfg, x, input_ids, embeds)
+    return x
+
+
+def _trunk(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jnp.ndarray,  # [T] int32
+    positions: jnp.ndarray,  # [T] int32
+    segment_ids: jnp.ndarray,  # [T] int32, pad = -1
+    remat: bool = False,
+    attn_spec: AttnSpec | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [N, S, S, 3] stream order
+    remat_policy: str = "nothing_saveable",
+    image_grid_thw: tuple | None = None,  # qwen2_vl: static (t,h,w) per image
+) -> jnp.ndarray:
+    """Embed -> layer scan -> final norm: hidden states [T, H]."""
+    x = embed_with_images(
+        params, cfg, input_ids, positions, pixel_values, image_grid_thw
+    )
 
     def body(carry, lp):
         return _block(cfg, lp, carry, positions, segment_ids, attn_spec), None
@@ -791,23 +811,9 @@ def prefill_stream(
     prompts (vlm_qwen2.mrope_positions per prompt, offset-free).
     """
     rope_pos = positions3 if positions3 is not None else positions
-    x = _embed(params, cfg, input_ids, positions)
-    if pixel_values is not None:
-        from areal_tpu.models.vlm import splice_image_embeds
-
-        if cfg.is_qwen_vl:
-            from areal_tpu.models.vlm_qwen2 import encode_images_qwen2vl
-
-            assert image_grid_thw is not None
-            embeds = encode_images_qwen2vl(
-                params["vision"], cfg, pixel_values,
-                _expand_grids(image_grid_thw, pixel_values),
-            )[None]
-        else:
-            from areal_tpu.models.vlm import encode_images
-
-            embeds = encode_images(params["vision"], cfg, pixel_values)
-        x = splice_image_embeds(cfg, x, input_ids, embeds)
+    x = embed_with_images(
+        params, cfg, input_ids, positions, pixel_values, image_grid_thw
+    )
 
     def body(carry, lp):
         out, k, v = _prefill_stream_layer(

@@ -63,12 +63,10 @@ def check_pp_compatible(
             f"pipeline parallelism needs num_hidden_layers "
             f"({cfg.num_hidden_layers}) divisible by pp*vpp ({s}*{vpp})"
         )
-    if cfg.is_vlm:
-        raise NotImplementedError(
-            "pp>1 with a vision tower is not supported yet (the image "
-            "splice runs outside the pipeline; wiring pixel batches through "
-            "the stacked-microbatch path is future work)"
-        )
+    # VLM rides the gpipe path: the vision tower + image splice run
+    # outside the stage conveyor (forward_packed_pipelined), so no layer
+    # of the tower needs a stage assignment. 1F1B still excludes VLM
+    # (engine falls back to gpipe).
 
 
 def stage_attn_spec(spec: AttnSpec | None, mesh: Mesh | None = None) -> AttnSpec | None:
@@ -257,6 +255,8 @@ def pipeline_train_step_1f1b(
 
     if cfg.is_critic:
         raise NotImplementedError("1f1b critics: use pp_schedule=gpipe")
+    if cfg.is_vlm:
+        raise NotImplementedError("1f1b with a vision tower: use gpipe")
     tied = "lm_head" not in params
     head_w = params["embed"].T if tied else params["lm_head"]
     norm_b = params.get("final_norm_b")
@@ -726,8 +726,10 @@ def pipeline_hidden_interleaved(
         embeds = jnp.concatenate(
             [embeds, jnp.zeros((pad, t_len, h), embeds.dtype)]
         )
+        # positions may be [M, T] or [M, 3, T] (qwen2_vl M-RoPE streams)
         positions = jnp.concatenate(
-            [positions, jnp.zeros((pad, t_len), positions.dtype)]
+            [positions,
+             jnp.zeros((pad,) + positions.shape[1:], positions.dtype)]
         )
         segment_ids = jnp.concatenate(
             [segment_ids, jnp.zeros((pad, t_len), segment_ids.dtype)]
@@ -823,13 +825,15 @@ def forward_packed_pipelined(
     params: dict,
     cfg: TransformerConfig,
     input_ids: jnp.ndarray,  # [M, T] int32 microbatch stack
-    positions: jnp.ndarray,  # [M, T]
+    positions: jnp.ndarray,  # [M, T] ([M, 3, T] for qwen2_vl M-RoPE)
     segment_ids: jnp.ndarray,  # [M, T]
     mesh: Mesh,
     attn_spec: AttnSpec | None = None,
     remat: bool = False,
     remat_policy: str = "nothing_saveable",
     vpp: int = 1,
+    pixel_values: jnp.ndarray | None = None,  # [M, Pmax, pd] / [M, N, S, S, 3]
+    image_grid_thw: tuple | None = None,  # static batch grid
 ) -> jnp.ndarray:
     """Pipelined counterpart of models/lm.forward_packed over M stacked
     microbatches: logits [M, T, V] fp32 (values [M, T] for critics).
@@ -838,9 +842,22 @@ def forward_packed_pipelined(
     sharded over (pp, dp, cp) — every device works on head FLOPs, none
     duplicates them.
     """
-    from areal_tpu.models.lm import _embed, _norm
+    from areal_tpu.models.lm import _embed, _norm, embed_with_images
 
-    x = _embed(params, cfg, input_ids, positions)  # [M, T, H]
+    if pixel_values is not None:
+        # vision tower + placeholder splice run OUTSIDE the pipeline, per
+        # microbatch (vmapped over M): every pp device computes the (small)
+        # tower, then only [M, T, H] embeddings enter the stage conveyor.
+        # Stacked pixel tables are padded with ghost rows to a common Pmax;
+        # ghost rows encode garbage that placeholder-rank gathering never
+        # reads (models/lm.embed_with_images).
+        x = jax.vmap(
+            lambda ids, pos, px: embed_with_images(
+                params, cfg, ids, pos, px, image_grid_thw
+            )
+        )(input_ids, positions, pixel_values)
+    else:
+        x = _embed(params, cfg, input_ids, positions)  # [M, T, H]
     hidden_fn = (
         partial(pipeline_hidden_interleaved, vpp=vpp)
         if vpp > 1

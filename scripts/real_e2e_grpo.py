@@ -142,9 +142,12 @@ def run_grpo_loop(
     actor = TPUPPOActor(acfg)
     actor.initialize(None, ft_spec, model_config=model_cfg, seed=0)
 
+    # budget over EVERY row the loop can consume — an under-sized
+    # max_seq_len would make later prompts silently return zero-token
+    # rollouts (inference/engine length guard), poisoning the evidence
     prompt_budget = max(len(t) for t in (
         tokenizer.apply_chat_template(r["messages"], add_generation_prompt=True)
-        for r in rows[: n_prompts * 2]
+        for r in rows
     ))
     inf = LocalInfEngine(
         InferenceEngineConfig(
@@ -231,6 +234,10 @@ def main():
                     "runs never overwrite the real-hardware artifact)")
     args = ap.parse_args()
     out_path = args.out
+    if args.smoke and out_path == OUT:
+        # never clobber the committed real-hardware artifact with CPU
+        # smoke numbers
+        out_path = OUT.replace(".json", ".smoke.json")
 
     from areal_tpu.utils.device import apply_platform_env
 
