@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.api.cli_args import PPOCriticConfig
-from areal_tpu.engine.train_engine import TPUTrainEngine
+from areal_tpu.engine.train_engine import TokenLossFn, TPUTrainEngine
 from areal_tpu.utils.data import TensorDict, split_padded_tensor_dict_into_mb_list
 from areal_tpu.utils.functional import ppo_critic_loss_fn
 
@@ -28,6 +28,12 @@ class PPOCritic:
             value_eps_clip=config.value_eps_clip,
             loss_fn_type=config.value_loss_type,
             huber_delta=config.huber_delta,
+        )
+        # value-head twin of the fused-loss contract: lets the 1F1B
+        # pipeline schedule drive critics (values [T] in place of logp)
+        self._token_loss_fn = TokenLossFn(
+            fn=functools.partial(_value_token_loss, loss_fn=self._loss_fn),
+            is_value=True,
         )
 
     def compute_values(self, data: TensorDict) -> np.ndarray:
@@ -60,6 +66,7 @@ class PPOCritic:
                 mb,
                 loss_fn=self._loss_fn,
                 loss_weight_fn=lambda x: np.asarray(x["loss_mask"]).sum(),
+                token_loss_fn=self._token_loss_fn,
             )
             all_stats.append(stat)
         return all_stats
@@ -81,6 +88,11 @@ class TPUPPOCritic(TPUTrainEngine):
 
 def _take_values(values, input_data):
     return values
+
+
+def _value_token_loss(values, _entropy, input_data, loss_fn):
+    """TokenLossFn.is_value adapter: (values [T], zeros, mb) -> sum loss."""
+    return loss_fn(values, input_data)
 
 
 def critic_loss_fn(

@@ -107,6 +107,11 @@ class TokenLossFn:
     fn: Callable
     temperature: float = 1.0
     needs_entropy: bool = False
+    # critic twin: ``fn(values [T], zeros [T], mb) -> scalar`` over the
+    # value head instead of (logp, entropy) over the LM head. Consumed by
+    # the 1F1B pipeline schedule (the chunked-LM-head fusion itself never
+    # applies to critics — values are [T, 1], nothing to chunk)
+    is_value: bool = False
 
 
 def make_lr_schedule(cfg: OptimizerConfig, total_steps: int):
@@ -694,12 +699,16 @@ class TPUTrainEngine(TrainEngine):
             t = max(int(p["cu_seqlens"][-1]) for p in packed_mbs)
             if distributed.process_count() > 1:
                 t = int(distributed.sync_max(t))
+            old_mbs = packed_mbs
             packed_mbs = [self._repad_packed(p, t) for p in packed_mbs]
             if self.model_config.is_qwen_vl:
                 # _repad_packed rebuilt PLAIN positions; qwen2_vl mbs need
-                # their [3, T] M-RoPE streams recomputed over the new bucket
-                for p in packed_mbs:
-                    if "pixel_values" in p:
+                # their [3, T] M-RoPE streams recomputed over the new
+                # bucket (only where repadding actually happened —
+                # _repad_packed returns the SAME object when the mb was
+                # already at the target bucket)
+                for old, p in zip(old_mbs, packed_mbs):
+                    if p is not old and "pixel_values" in p:
                         p["positions"] = self._mrope_positions_packed(p)
         if self._pp_replicated_data:
             # synchronized-batch multi-host pp: every host MUST be feeding
@@ -860,7 +869,7 @@ class TPUTrainEngine(TrainEngine):
                 backend.pp_schedule == "1f1b"
                 and lora_cfg is None
                 and token_loss_fn is not None
-                and not cfg.is_critic
+                and (not cfg.is_critic or token_loss_fn.is_value)
             ):
                 from areal_tpu.parallel.pipeline import (
                     pipeline_train_step_1f1b,
@@ -884,8 +893,8 @@ class TPUTrainEngine(TrainEngine):
             ):
                 logger.warning(
                     "pp_schedule=1f1b needs the fused-loss contract "
-                    "(TokenLossFn) and supports neither LoRA nor critics; "
-                    "falling back to gpipe"
+                    "(TokenLossFn; is_value=True for critics) and does not "
+                    "support LoRA; falling back to gpipe"
                 )
             elif backend.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(

@@ -133,8 +133,10 @@ class GenerationEngine:
                 )
             if model_config.is_vlm:
                 raise NotImplementedError(
-                    "pp serving with a vision tower is not supported "
-                    "(matches the training-side pp/VLM exclusion)"
+                    "pp serving with a vision tower is not supported: the "
+                    "prefill/decode stage conveyors have no image-splice "
+                    "step (training-side pp DOES support VLM — the tower "
+                    "runs outside the conveyor there)"
                 )
         if (
             model_config.pos_embed_type == "learned"

@@ -230,8 +230,10 @@ def pipeline_train_step_1f1b(
     stage 0 (its weight gradient accumulates via scatter-add on the carry),
     so no O(M) cotangent stack exists anywhere.
 
-    Requires the fused-loss contract (``TokenLossFn``); critics and LoRA
-    engines use the GPipe path. T must divide S.
+    Requires the fused-loss contract (``TokenLossFn`` — with
+    ``is_value=True`` the head/loss section swaps the LM head's
+    (logp, entropy) for per-token values, which is how critics ride this
+    schedule). LoRA and VLM engines use the GPipe path. T must divide S.
     """
     from areal_tpu.models.lm import (
         _REMAT_POLICIES,
@@ -253,12 +255,20 @@ def pipeline_train_step_1f1b(
     steps = m + 2 * s - 1
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
-    if cfg.is_critic:
-        raise NotImplementedError("1f1b critics: use pp_schedule=gpipe")
+    is_value = bool(getattr(token_loss_fn, "is_value", False))
+    if cfg.is_critic and not is_value:
+        raise NotImplementedError(
+            "1f1b critics need a value-head TokenLossFn (is_value=True); "
+            "use pp_schedule=gpipe otherwise"
+        )
     if cfg.is_vlm:
         raise NotImplementedError("1f1b with a vision tower: use gpipe")
-    tied = "lm_head" not in params
-    head_w = params["embed"].T if tied else params["lm_head"]
+    if is_value:
+        tied = False
+        head_w = params["value_head"]  # [H, 1]
+    else:
+        tied = "lm_head" not in params
+        head_w = params["embed"].T if tied else params["lm_head"]
     norm_b = params.get("final_norm_b")
     if cfg.pos_embed_type == "learned":
         raise NotImplementedError("1f1b with learned position embeddings")
@@ -324,13 +334,17 @@ def pipeline_train_step_1f1b(
             labels_sl = jax.lax.dynamic_slice_in_dim(labels_full, lo, tl, 0)
 
             # head for THIS stage's token slice -> per-token (logp, entropy)
-            # only (no [T, V] logits ever cross stages); the token loss then
-            # runs over the psum-assembled FULL [T] vectors with the FULL
-            # microbatch row, so losses that roll labels/masks internally
-            # stay exact (the chunked fused-LM-head-loss pattern,
-            # models/lm.forward_fused_logp, with chunk == stage slice)
+            # (or [value, 0] for critics) only — no [T, V] logits ever
+            # cross stages; the token loss then runs over the
+            # psum-assembled FULL [T] vectors with the FULL microbatch row,
+            # so losses that roll labels/masks internally stay exact (the
+            # chunked fused-LM-head-loss pattern, models/lm.forward_fused_
+            # logp, with chunk == stage slice)
             def head_q(y_, nw, nb, hw):
                 xn = _norm(cfg, y_, nw, nb)
+                if is_value:
+                    vals = (xn @ hw).astype(jnp.float32)[:, 0]  # [tl]
+                    return jnp.stack([vals, jnp.zeros_like(vals)], -1)
                 logits = (xn @ hw).astype(jnp.float32)
                 if token_loss_fn.needs_entropy:
                     logp, ent = gather_logprobs_entropy(
@@ -480,7 +494,9 @@ def pipeline_train_step_1f1b(
     }
     if norm_b is not None:
         grads["final_norm_b"] = g_nb
-    if tied:
+    if is_value:
+        grads["value_head"] = g_hw
+    elif tied:
         grads["embed"] = grads["embed"] + g_hw.T
     else:
         grads["lm_head"] = g_hw

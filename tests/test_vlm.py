@@ -729,16 +729,24 @@ def _vlm_engine(parallel, seed=7):
     return eng
 
 
-def _vlm_batch(bs=4, s=16, seed=0):
+def _vlm_batch(bs=5, s=16, seed=0):
+    """Rows of UNEVEN real length (16,16,12,10,9): FFD packing under a
+    32-token cap yields stacked microbatches with different row counts
+    (so the pixel tables need ghost-row padding) and different token
+    totals (so _repad_packed actually re-pads)."""
     rng = np.random.default_rng(seed)
+    lens = np.asarray([16, 16, 12, 10, 9][:bs])
     ids = rng.integers(1, 100, size=(bs, s)).astype(np.int32)
     ids[:, :4] = IMG_TOK
+    attn = np.zeros((bs, s), np.int32)
+    loss_mask = np.zeros((bs, s), np.int32)
+    for i, n in enumerate(lens):
+        attn[i, :n] = 1
+        loss_mask[i, 4:n] = 1
     return dict(
         input_ids=ids,
-        attention_mask=np.ones((bs, s), np.int32),
-        loss_mask=np.concatenate(
-            [np.zeros((bs, 4), np.int32), np.ones((bs, s - 4), np.int32)], 1
-        ),
+        attention_mask=attn,
+        loss_mask=loss_mask,
         pixel_values=rng.uniform(0, 1, (bs, 1, 16, 16, 3)).astype(np.float32),
     )
 
@@ -776,21 +784,28 @@ def test_qwen2vl_train_pp_matches_single_mesh(tiny_hf_qwen2vl):
 
     model_dir, _ = tiny_hf_qwen2vl
     rng = np.random.default_rng(3)
-    b, s = 4, 14
+    b, s = 5, 14
+    # UNEVEN real lengths: FFD packing under the 32-token cap gives
+    # microbatches with different row counts (ghost patch-table padding,
+    # whole-ghost-image ppi rounding) and different token totals (repad ->
+    # M-RoPE [3, T] recompute)
+    lens = np.asarray([14, 14, 12, 11, 10])
     ids = np.zeros((b, s), np.int32)
+    attn = np.zeros((b, s), np.int32)
+    loss_mask = np.zeros((b, s), np.int32)
     pix = np.zeros((b, 16, 96), np.float32)
     for i in range(b):
         prompt = [5 + i, 9, 118] + [120] * 4 + [119]
         tail = rng.integers(1, 110, size=s - len(prompt))
         ids[i] = np.concatenate([prompt, tail])
+        attn[i, : lens[i]] = 1
+        loss_mask[i, 8: lens[i]] = 1
         pix[i] = rng.normal(0, 1, size=(16, 96)).astype(np.float32)
     grids = np.tile(np.asarray([[1, 4, 4]], np.int64), (b, 1))
     data = dict(
         input_ids=ids,
-        attention_mask=np.ones((b, s), np.int32),
-        loss_mask=np.concatenate(
-            [np.zeros((b, 8), np.int32), np.ones((b, s - 8), np.int32)], 1
-        ),
+        attention_mask=attn,
+        loss_mask=loss_mask,
         pixel_values=pix,
         image_grid_thw=grids,
     )
