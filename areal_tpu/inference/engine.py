@@ -138,6 +138,17 @@ class GenerationEngine:
                     "step (training-side pp DOES support VLM — the tower "
                     "runs outside the conveyor there)"
                 )
+            if config.max_batch_size % pp:
+                # batch-group rotation (decode_rotated_pp) needs the decode
+                # bucket divisible by pp; round the slot count up so the
+                # S x-faster path is always eligible
+                new_b = -(-config.max_batch_size // pp) * pp
+                logger.info(
+                    "rounding max_batch_size %d up to %d (multiple of "
+                    "pp_size=%d) so rotated pp-decode stays eligible",
+                    config.max_batch_size, new_b, pp,
+                )
+                config.max_batch_size = new_b
         if (
             model_config.pos_embed_type == "learned"
             and config.max_seq_len > model_config.max_position_embeddings
@@ -428,6 +439,18 @@ class GenerationEngine:
         pos_delta,  # [B] qwen2_vl M-RoPE decode offsets (zeros otherwise)
         steps: int,
     ):
+        if self._pp > 1 and last_tokens.shape[0] % self._pp == 0:
+            # batch-group rotation: S stages busy every tick instead of
+            # one (pp serving excludes VLM, so pos_delta is always zero
+            # here and the rotated path can ignore it)
+            from areal_tpu.parallel.pipeline import decode_rotated_pp
+
+            return decode_rotated_pp(
+                params, self.model_config, cache, last_tokens, cache_len,
+                block_table, active, self.mesh, rng, temp, top_k, top_p,
+                greedy, steps, attn_spec=self.attn_spec,
+            )
+
         def step(carry, step_rng):
             tokens, cache, clen = carry
             logits, cache = self._paged_decode(

@@ -837,6 +837,191 @@ def pipeline_hidden_interleaved(
     return out[:m0]
 
 
+def decode_rotated_pp(
+    params: dict,
+    cfg: TransformerConfig,
+    cache: dict,  # paged pool {k, v: [L, NB, BS, KH, D]}, L sharded over pp
+    last_tokens: jnp.ndarray,  # [B] int32
+    cache_len: jnp.ndarray,  # [B]
+    block_table: jnp.ndarray,  # [B, NBT]
+    active: jnp.ndarray,  # [B] bool
+    mesh: Mesh,
+    rng: jax.Array,
+    temp: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    greedy: jnp.ndarray,  # [B]
+    steps: int,
+    attn_spec: AttnSpec | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Batch-group-rotated pipelined decode: S× the conveyor's throughput.
+
+    ``decode_step_paged_pp`` moves ONE batch through the S stages
+    sequentially — S-1 stages idle every tick. Here the batch splits into
+    S contiguous row groups that rotate through the ring: at tick t stage
+    i decodes group ``(t - i) mod S`` (token ``(t - i) // S``), so in
+    steady state EVERY stage is busy with a different group every tick.
+    Group g's token k exits stage S-1 (head + on-device sampling) at tick
+    ``g + k*S + S-1``; its embedded next token rides the wrap edge
+    ``(S-1, 0)`` of the same ring ppermute that carries mid-stack
+    activations, entering stage 0 exactly one tick later — a seamless
+    software pipeline with no draining between tokens. Total ticks
+    ``steps*S + S - 1`` of 1/S-batch work vs the conveyor's ``steps*S``
+    ticks of full-batch work on one stage.
+
+    The serving role of Megatron's pipelined generation
+    (realhf/impl/model/backend/pipe_runner.py:375-648), shaped for one
+    jitted lax.scan. Needs B % S == 0 (the engine rounds max_batch_size up
+    to a multiple of pp at init so this always holds).
+
+    Returns (tokens [steps, B], logprobs [steps, B], cache) — identical
+    semantics to the engine's per-step scan over ``decode_step_paged``.
+    """
+    from areal_tpu.inference.sampling import sample_tokens
+    from areal_tpu.models.lm import _decode_paged_layer, _embed, _norm
+
+    s = pp_size(mesh)
+    b = last_tokens.shape[0]
+    assert b % s == 0, f"rotation needs batch {b} divisible by pp {s}"
+    g_sz = b // s
+    nbt = block_table.shape[1]
+    ticks = steps * s + s - 1
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+    h = cfg.hidden_size
+    head_w = params.get("lm_head")
+    if head_w is None:
+        head_w = params["embed"].T
+    norm_b = params.get("final_norm_b")
+    rngs = jax.random.split(rng, ticks)
+
+    def stage_fn(layers_local, k_pool, v_pool):
+        stage = jax.lax.axis_index(AXIS_PP)
+        is_exit = stage == s - 1
+
+        def tick(carry, xs):
+            msg, toks_all, clen_all, kp, vp = carry
+            tt, rng_t = xs
+            u = tt - stage
+            uc = jnp.clip(u, 0, steps * s - 1)
+            g = uc % s
+            k = uc // s
+            lo = g * g_sz
+
+            tbl_g = jax.lax.dynamic_slice(block_table, (lo, 0), (g_sz, nbt))
+            act_g = jax.lax.dynamic_slice(active, (lo,), (g_sz,))
+            clen_g = jax.lax.dynamic_slice(clen_all, (lo,), (g_sz,))
+            toks_g = jax.lax.dynamic_slice(toks_all, (lo,), (g_sz,))
+
+            write_pos = clen_g[:, None]  # [G, 1]
+            li = jnp.clip(write_pos // bs_, 0, nbt - 1)
+            phys = jnp.take_along_axis(tbl_g, li, axis=1)
+            # fill/drain ticks clip u to REAL (group, token) coordinates —
+            # their garbage compute must land in the trash block (0), not
+            # over the live row a valid tick already wrote. Validity MUST
+            # come from the UNCLIPPED u (the clipped k always reads as
+            # in-range)
+            tick_valid = (u >= 0) & (u < steps * s)
+            phys = jnp.where(
+                tick_valid & act_g[:, None], jnp.maximum(phys, 0), 0
+            )
+            gather_ids = jnp.maximum(tbl_g, 0)
+
+            # stage 0 / token 0 embeds the group's initial token; every
+            # other (stage, token) consumes the ring carry (for stage 0,
+            # k>0 that carry IS the freshly sampled token's embedding,
+            # placed there by the exit stage last tick)
+            emb0 = _embed(params, cfg, toks_g[:, None], write_pos)
+            x_in = jnp.where((stage == 0) & (k == 0), emb0, msg)
+
+            def body(c, layer_in):
+                lp, kl, vl = layer_in
+                out, kl, vl = _decode_paged_layer(
+                    cfg, lp, kl, vl, c, write_pos,
+                    phys.reshape(-1), (write_pos % bs_).reshape(-1),
+                    gather_ids, clen_g + 1, inner_spec,
+                )
+                return out, (kl, vl)
+
+            y, (kp, vp) = jax.lax.scan(body, x_in, (layers_local, kp, vp))
+
+            def exit_fn(y_):
+                xn = _norm(cfg, y_[:, 0], params["final_norm"], norm_b)
+                logits = (xn @ head_w).astype(jnp.float32)
+                nxt, logp = sample_tokens(
+                    logits,
+                    rng_t,
+                    jax.lax.dynamic_slice(temp, (lo,), (g_sz,)),
+                    jax.lax.dynamic_slice(top_k, (lo,), (g_sz,)),
+                    jax.lax.dynamic_slice(top_p, (lo,), (g_sz,)),
+                    jax.lax.dynamic_slice(greedy, (lo,), (g_sz,)),
+                )
+                nxt = jnp.where(act_g, nxt, toks_g)
+                emb_nxt = _embed(params, cfg, nxt[:, None], write_pos + 1)
+                return nxt, logp, emb_nxt.astype(y_.dtype)
+
+            def skip_fn(y_):
+                return (
+                    jnp.zeros((g_sz,), jnp.int32),
+                    jnp.zeros((g_sz,), jnp.float32),
+                    jnp.zeros_like(y_),
+                )
+
+            exit_valid = is_exit & tick_valid
+            nxt, logp, emb_nxt = jax.lax.cond(exit_valid, exit_fn, skip_fn, y)
+
+            # replicated token/len state advances via exit-stage deltas
+            zeros_b_i = jnp.zeros((b,), jnp.int32)
+            tok_delta = jax.lax.dynamic_update_slice(
+                zeros_b_i, jnp.where(exit_valid, nxt - toks_g, 0), (lo,)
+            )
+            len_delta = jax.lax.dynamic_update_slice(
+                zeros_b_i,
+                jnp.where(exit_valid, act_g.astype(jnp.int32), 0),
+                (lo,),
+            )
+            toks_all = toks_all + jax.lax.psum(tok_delta, AXIS_PP)
+            clen_all = clen_all + jax.lax.psum(len_delta, AXIS_PP)
+
+            out_msg = jnp.where(exit_valid, emb_nxt, y)
+            out_msg = jax.lax.ppermute(
+                out_msg, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
+            )
+            ys_tok = jax.lax.psum(jnp.where(exit_valid, nxt, 0), AXIS_PP)
+            ys_logp = jax.lax.psum(
+                jnp.where(exit_valid, logp, 0.0), AXIS_PP
+            )
+            return (out_msg, toks_all, clen_all, kp, vp), (ys_tok, ys_logp)
+
+        bs_ = k_pool.shape[2]
+        carry0 = (
+            # ring messages carry activations — embed dtype, not pool dtype
+            jnp.zeros((g_sz, 1, h), params["embed"].dtype),
+            last_tokens,
+            cache_len,
+            k_pool,
+            v_pool,
+        )
+        (_, _, _, kp, vp), (toks, logps) = jax.lax.scan(
+            tick, carry0, (jnp.arange(ticks), rngs)
+        )
+        return toks, logps, kp, vp
+
+    toks_t, logps_t, k2, v2 = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP)),
+        out_specs=(P(), P(), P(AXIS_PP), P(AXIS_PP)),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(params["layers"], cache["k"], cache["v"])
+
+    # de-interleave ticks: group g's token k surfaced at tick g + k*S + S-1
+    idx = (s - 1) + jnp.arange(s)[None, :] + jnp.arange(steps)[:, None] * s
+    toks = toks_t[idx].reshape(steps, b)
+    logps = logps_t[idx].reshape(steps, b)
+    return toks, logps, {"k": k2, "v": v2}
+
+
 def forward_packed_pipelined(
     params: dict,
     cfg: TransformerConfig,

@@ -387,3 +387,92 @@ def test_inplace_reuse_keeps_kv_version_current(model):
     eng._admit()
     assert eng.prefix_clone_count == 2
     drive_until_done(eng, 1, done3)
+
+
+def test_rotated_pp_decode_matches_sequential():
+    """decode_rotated_pp (batch-group rotation: every stage busy every
+    tick) must reproduce the sequential decode scan exactly — tokens,
+    logprobs, AND the paged pool outside the trash block — at pp=4 with
+    uneven cache lengths and an inactive lane."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.inference.sampling import sample_tokens
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.lm import decode_step_paged, init_params
+    from areal_tpu.parallel.mesh import make_mesh
+    from areal_tpu.parallel.pipeline import decode_rotated_pp
+    from areal_tpu.parallel.sharding import param_shardings
+
+    cfg = tiny_config(num_hidden_layers=4)
+    mesh = make_mesh(ParallelStrategy(pp=4))
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    b, nb, bs, nbt, steps = 8, 32, 8, 3, 5
+    layers = cfg.num_hidden_layers
+    pool = {
+        k: jnp.zeros(
+            (layers, nb, bs, cfg.num_key_value_heads, cfg.head_dim),
+            jnp.float32,
+        )
+        for k in ("k", "v")
+    }
+    table = jnp.asarray(
+        [[3 * i + 1, 3 * i + 2, 3 * i + 3] for i in range(b)], jnp.int32
+    )
+    clen0 = jnp.asarray([5, 3, 4, 1, 2, 6, 7, 0], jnp.int32)
+    active = jnp.asarray([True] * 7 + [False])
+    last = jnp.asarray([7, 11, 3, 9, 2, 5, 8, 0], jnp.int32)
+    # seed prompt KV identically for both paths
+    for t in range(7):
+        toks = jnp.asarray([(t + i) % 90 + 1 for i in range(b)], jnp.int32)
+        cl = jnp.minimum(jnp.full((b,), t, jnp.int32), clen0)
+        act = jnp.asarray([t < int(c) for c in clen0])
+        _, pool = decode_step_paged(
+            params, cfg, pool, toks[:, None], cl, table, act,
+            compute_logits=False,
+        )
+    temp = jnp.ones((b,), jnp.float32)
+    tk = jnp.zeros((b,), jnp.int32)
+    tp = jnp.ones((b,), jnp.float32)
+    gr = jnp.ones((b,), bool)
+    rng = jax.random.PRNGKey(42)
+
+    def seq(pl):
+        def step(carry, srng):
+            tokens, cache, clen = carry
+            logits, cache = decode_step_paged(
+                params, cfg, cache, tokens[:, None], clen, table, active
+            )
+            nxt, logp = sample_tokens(logits[:, 0], srng, temp, tk, tp, gr)
+            nxt = jnp.where(active, nxt, tokens)
+            clen = clen + active.astype(jnp.int32)
+            return (nxt, cache, clen), (nxt, logp)
+
+        rngs = jax.random.split(rng, steps)
+        (_, cache, _), (tt, ll) = jax.lax.scan(
+            step, (last, pl, clen0), rngs
+        )
+        return tt, ll, cache
+
+    t1, l1, c1 = jax.jit(seq)(pool)
+    pp_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("pp"))
+    pool_pp = jax.device_put(pool, {"k": pp_sh, "v": pp_sh})
+    t2, l2, c2 = jax.jit(
+        lambda pl: decode_rotated_pp(
+            params_pp, cfg, pl, last, clen0, table, active, mesh, rng,
+            temp, tk, tp, gr, steps,
+        )
+    )(pool_pp)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-6
+    )
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(c1[key][:, 1:]), np.asarray(c2[key][:, 1:]),
+            rtol=1e-5, atol=1e-6,
+        )
