@@ -119,3 +119,33 @@ def test_pp_mesh_constructs():
 
     mesh = make_mesh(ParallelStrategy(pp=2, dp=2, tp=2))
     assert mesh.shape["pp"] == 2 and mesh.shape["dp"] == 2
+
+
+def test_moe_forward_under_pipeline_matches_plain():
+    """MoE layers inside pipeline stages (pp x MoE matrix cell): the
+    stacked-layer scan in the stage conveyor carries expert weights like
+    any other per-layer param; logits must match the plain forward."""
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.parallel.mesh import make_mesh
+    from areal_tpu.parallel.pipeline import forward_packed_pipelined
+    from areal_tpu.parallel.sharding import param_shardings
+
+    cfg = moe_cfg("ragged")
+    mesh = make_mesh(ParallelStrategy(pp=2, dp=2))
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    rng = np.random.default_rng(0)
+    m, t = 3, 16
+    ids = jnp.asarray(rng.integers(1, 128, size=(m, t)).astype(np.int32))
+    pos = jnp.tile(jnp.arange(t, dtype=jnp.int32), (m, 1))
+    seg = jnp.zeros((m, t), jnp.int32)
+    got = jax.jit(
+        lambda p: forward_packed_pipelined(p, cfg, ids, pos, seg, mesh)
+    )(params_pp)
+    want = np.stack([
+        np.asarray(forward_packed(params, cfg, ids[i], pos[i], seg[i]))
+        for i in range(m)
+    ])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
