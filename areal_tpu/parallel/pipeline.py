@@ -270,8 +270,11 @@ def pipeline_train_step_1f1b(
         tied = "lm_head" not in params
         head_w = params["embed"].T if tied else params["lm_head"]
     norm_b = params.get("final_norm_b")
+    # learned positions (gpt2 wpe): gate on the CONFIG like every other
+    # forward path — a stray pos_embed leaf must not change semantics
+    pos_embed_w = None
     if cfg.pos_embed_type == "learned":
-        raise NotImplementedError("1f1b with learned position embeddings")
+        pos_embed_w = params["pos_embed"]
 
     def run_stage(layers_local, x, pos, seg):
         def body(carry, lp):
@@ -283,23 +286,26 @@ def pipeline_train_step_1f1b(
         return y
 
     def stage_fn(layers_local, ids_all, pos_all, seg_all, mbs_rep, head_w_l,
-                 norm_w, norm_b_l, embed_w):
+                 norm_w, norm_b_l, embed_w, pos_embed_l):
         stage = jax.lax.axis_index(AXIS_PP)
         is_first = stage == 0
         is_last = stage == s - 1
         lo = stage * tl  # this stage's head token slice
         h = cfg.hidden_size
         has_nb = norm_b_l is not None
+        has_pos = pos_embed_l is not None
 
-        def embed_rows(ids):
-            x = embed_w[ids]
-            if cfg.scale_embeddings:
-                x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
-            return x
+        def embed_rows(ids, pos):
+            from areal_tpu.models.lm import _embed
+
+            p_emb = {"embed": embed_w}
+            if has_pos:
+                p_emb["pos_embed"] = pos_embed_l
+            return _embed(p_emb, cfg, ids, pos)
 
         def tick(carry, tt):
             (fwd_msg, bwd_msg, xbuf, dybuf, loss_vec, g_lay, g_emb, g_nw,
-             g_nb, g_hw) = carry
+             g_nb, g_hw, g_pos) = carry
 
             # ---- forward ----
             mf = tt - stage
@@ -308,7 +314,7 @@ def pipeline_train_step_1f1b(
             ids_f = jax.lax.dynamic_index_in_dim(ids_all, mfc, 0, False)
             pos_f = jax.lax.dynamic_index_in_dim(pos_all, mfc, 0, False)
             seg_f = jax.lax.dynamic_index_in_dim(seg_all, mfc, 0, False)
-            x_in = jnp.where(is_first, embed_rows(ids_f), fwd_msg)
+            x_in = jnp.where(is_first, embed_rows(ids_f, pos_f), fwd_msg)
             # invalid ticks park their write in the scratch slot K
             slot = jnp.where(f_valid, mfc % k, k)
             xbuf = jax.lax.dynamic_update_index_in_dim(
@@ -426,12 +432,17 @@ def pipeline_train_step_1f1b(
                 lambda a, d: a + jnp.where(b_valid, d.astype(acc_dtype), 0.0),
                 g_lay, dlay,
             )
-            demb_rows = jnp.where(
+            dx_rows = jnp.where(
                 b_valid & is_first, dx.astype(acc_dtype), 0.0
             )
+            demb_rows = dx_rows
             if cfg.scale_embeddings:
                 demb_rows = demb_rows * (cfg.hidden_size**0.5)
             g_emb = g_emb.at[ids_b].add(demb_rows)
+            if has_pos:
+                # pos embed adds AFTER the embedding scale, so its
+                # cotangent is the unscaled dx
+                g_pos = g_pos.at[pos_b].add(dx_rows)
 
             # ---- messages for the next tick ----
             fwd_nxt = jax.lax.ppermute(
@@ -442,7 +453,7 @@ def pipeline_train_step_1f1b(
             )
             return (
                 fwd_nxt, bwd_nxt, xbuf, dybuf, loss_vec, g_lay, g_emb,
-                g_nw, g_nb, g_hw,
+                g_nw, g_nb, g_hw, g_pos,
             ), None
 
         xdtype = embed_w.dtype
@@ -459,9 +470,12 @@ def pipeline_train_step_1f1b(
             jnp.zeros(norm_w.shape, acc_dtype),
             jnp.zeros(norm_w.shape, acc_dtype),
             jnp.zeros(head_w_l.shape, acc_dtype),
+            jnp.zeros(
+                pos_embed_l.shape if has_pos else (1, 1), acc_dtype
+            ),
         )
         (
-            _, _, _, _, loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw
+            _, _, _, _, loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos
         ) = jax.lax.scan(tick, carry0, jnp.arange(steps))[0]
         # token-sliced / stage-local accumulators -> global sums (g_lay stays
         # per-stage: it matches the pp-sharded layer stack)
@@ -470,21 +484,22 @@ def pipeline_train_step_1f1b(
         g_nw = jax.lax.psum(g_nw, AXIS_PP)
         g_nb = jax.lax.psum(g_nb, AXIS_PP)
         g_hw = jax.lax.psum(g_hw, AXIS_PP)
-        return loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw
+        g_pos = jax.lax.psum(g_pos, AXIS_PP)
+        return loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos
 
-    loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw = jax.shard_map(
+    loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos = jax.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(
-            P(AXIS_PP), P(), P(), P(), P(), P(), P(), P(), P(),
+            P(AXIS_PP), P(), P(), P(), P(), P(), P(), P(), P(), P(),
         ),
-        out_specs=(P(), P(AXIS_PP), P(), P(), P(), P()),
+        out_specs=(P(), P(AXIS_PP), P(), P(), P(), P(), P()),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
     )(
         params["layers"], mbs["input_ids"], mbs["positions"],
         mbs["segment_ids"], mbs, head_w, params["final_norm"], norm_b,
-        params["embed"],
+        params["embed"], pos_embed_w,
     )
 
     grads = {
@@ -494,6 +509,8 @@ def pipeline_train_step_1f1b(
     }
     if norm_b is not None:
         grads["final_norm_b"] = g_nb
+    if pos_embed_w is not None:
+        grads["pos_embed"] = g_pos
     if is_value:
         grads["value_head"] = g_hw
     elif tied:

@@ -539,3 +539,71 @@ def test_1f1b_critic_matches_plain_losses_and_grads():
             np.asarray(leaf), np.asarray(flat[path]),
             rtol=2e-3, atol=2e-4, err_msg=str(path),
         )
+
+
+def test_1f1b_learned_positions_matches_plain():
+    """1F1B with a learned position table (gpt2 wpe — the last 1F1B
+    family exclusion): the wpe lookup folds into stage 0 beside the token
+    embedding, its gradient accumulating by position scatter-add."""
+    from areal_tpu.engine.train_engine import TokenLossFn
+    from areal_tpu.parallel.pipeline import pipeline_train_step_1f1b
+    from areal_tpu.utils.functional import gather_logprobs
+
+    def _tok_ce(logp, ent, mb):
+        lm = jnp.roll(mb["loss_mask"], shift=-1).astype(jnp.float32)
+        return -jnp.sum(logp * lm)
+
+    tok = TokenLossFn(fn=_tok_ce)
+    cfg = tiny_config(
+        num_hidden_layers=4,
+        pos_embed_type="learned",
+        norm_type="layer",
+        mlp_gated=False,
+        proj_bias=True,
+        tie_word_embeddings=True,
+        max_position_embeddings=64,
+    )
+    mesh = make_mesh(ParallelStrategy(pp=4))
+    m = 4
+    params = init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    ids, pos, seg = _mb_stack(m=m, t=16)
+    rng = np.random.default_rng(6)
+    mbs = dict(
+        input_ids=ids, positions=pos, segment_ids=seg,
+        loss_mask=jnp.asarray(
+            (rng.uniform(size=(m, 16)) > 0.25).astype(np.float32)
+        ),
+    )
+    losses, grads = jax.jit(
+        lambda p, mb: pipeline_train_step_1f1b(
+            p, cfg, mb, mesh, tok, remat=True
+        )
+    )(params_pp, mbs)
+
+    def plain_loss(p):
+        tot = 0.0
+        per = []
+        for i in range(m):
+            lg = forward_packed(p, cfg, ids[i], pos[i], seg[i])
+            mb = {k: v[i] for k, v in mbs.items()}
+            logp = gather_logprobs(lg, jnp.roll(ids[i], shift=-1))
+            li = _tok_ce(logp, None, mb)
+            per.append(li)
+            tot = tot + li
+        return tot, jnp.stack(per)
+
+    (_, want_losses), want_grads = jax.jit(
+        jax.value_and_grad(plain_loss, has_aux=True)
+    )(params)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(want_losses), rtol=2e-4, atol=2e-5
+    )
+    flat = dict(jax.tree_util.tree_leaves_with_path(want_grads))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat[path]),
+            rtol=2e-3, atol=2e-4, err_msg=str(path),
+        )
