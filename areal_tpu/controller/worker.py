@@ -21,8 +21,12 @@ import sys
 import threading
 
 
-def serve(engine, host: str = "0.0.0.0", port: int = 0,
-          port_file: str | None = None) -> int:
+def serve(
+    engine, host: str = "0.0.0.0", port: int = 0,
+    port_file: str | None = None, worker_name: str | None = None,
+) -> tuple[int, "threading.Event | None"]:
+    """Start the RPC server (+ optional worker-framework announce).
+    Returns (port, stop_event) — stop_event fires on a panel "exit"."""
     from areal_tpu.scheduler.rpc import EngineRPCServer
 
     server = EngineRPCServer(engine)
@@ -31,7 +35,31 @@ def serve(engine, host: str = "0.0.0.0", port: int = 0,
     if port_file:
         with open(port_file, "w") as f:
             f.write(str(actual))
-    return actual
+    if worker_name:
+        # announce under the generic worker framework: heartbeat + status
+        # + the RPC address, so WorkerControl.pulse() detects a dead
+        # engine worker and group_request("exit") tears it down
+        from areal_tpu.controller.worker_base import Worker
+        from areal_tpu.utils.network import gethostip
+
+        rpc_host = gethostip() if host in ("0.0.0.0", "::", "") else host
+        stop_evt = threading.Event()
+
+        class _EngineWorker(Worker):
+            def _poll(self):
+                return 0  # the RPC server drives the actual work
+
+            def _exit_hook(self):
+                server.stop()
+                stop_evt.set()
+
+        w = _EngineWorker(
+            worker_name, extra_record={"rpc_addr": f"{rpc_host}:{actual}"}
+        )
+        threading.Thread(target=w.run, daemon=True,
+                         name=f"announce-{worker_name}").start()
+        return actual, stop_evt
+    return actual, None
 
 
 def main(argv=None):
@@ -43,6 +71,8 @@ def main(argv=None):
     p.add_argument("--coordinator", default=None)
     p.add_argument("--nprocs", type=int, default=1)
     p.add_argument("--pid", type=int, default=0)
+    p.add_argument("--worker-name", default=None,
+                   help="announce under the worker framework (heartbeat/status)")
     args, overrides = p.parse_known_args(argv)
 
     from areal_tpu.parallel import distributed
@@ -73,8 +103,10 @@ def main(argv=None):
             train_batch_size=cfg.train_dataset.batch_size,
         ),
     )
-    serve(actor, args.host, args.port, args.port_file)
-    threading.Event().wait()  # serve until killed
+    _, stop_evt = serve(actor, args.host, args.port, args.port_file,
+                        worker_name=args.worker_name or f"engine/{args.pid}")
+    # serve until killed, or until the worker panel sends "exit"
+    (stop_evt or threading.Event()).wait()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,325 @@
+"""Generic worker poll-loop framework (controller-mode fleet management).
+
+The capability of the reference's worker runtime
+(realhf/system/worker_base.py: WorkerServer command handlers + status
+registry + WorkerControlPanel group requests + heartbeat ``pulse``),
+re-hosted on this repo's primitives — aiohttp for the control plane (like
+scheduler/rpc.py) and name_resolve for discovery/heartbeats:
+
+- :class:`Worker`: subclass with ``_configure(payload)`` / ``_poll()`` /
+  ``_exit_hook()``. ``run()`` announces a control endpoint under
+  ``<root>/<worker_name>``, then loops: RUNNING -> ``_poll()`` (returns the
+  number of work items done; 0 -> exponential idle backoff), PAUSED/STANDBY
+  -> sleep. A heartbeat timestamp rides the same name-resolve record so a
+  dead process is detectable without any extra channel.
+- :class:`WorkerControl`: controller-side panel — discovery via the
+  name-resolve subtree, ``group_request`` fanned out over HTTP, and
+  ``pulse()`` marking workers LOST when their heartbeat goes stale.
+
+Commands (POST /cmd): configure | start | pause | resume | exit, plus
+GET /status. Unknown commands 404. The control plane is a trusted-cluster
+surface, not a public API (same stance as EngineRPCServer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import json
+import threading
+import time
+from typing import Any
+
+from areal_tpu.utils import logging, name_resolve
+
+logger = logging.getLogger("WorkerBase")
+
+
+class WorkerStatus(str, enum.Enum):
+    STANDBY = "STANDBY"  # configured (or fresh), not polling
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    EXITING = "EXITING"
+    ERROR = "ERROR"
+    LOST = "LOST"  # controller-side verdict: heartbeat went stale
+
+
+class WorkerException(Exception):
+    def __init__(self, worker_name: str, status: WorkerStatus, scenario: str):
+        self.worker_name = worker_name
+        self.status = status
+        super().__init__(
+            f"worker {worker_name} is {status.value} during {scenario}"
+        )
+
+
+def _record_key(root: str, name: str) -> str:
+    # worker names like "trainer/0" flatten to one key segment so the
+    # panel's name <-> key mapping stays bijective
+    return f"{root.rstrip('/')}/{name.replace('/', '.')}"
+
+
+class Worker:
+    """Poll-loop worker with an aiohttp control endpoint.
+
+    Subclasses implement ``_poll() -> int`` (work items completed this
+    round — 0 engages idle backoff) and optionally ``_configure(payload)``
+    / ``_exit_hook()``.
+    """
+
+    IDLE_SLEEP_MIN_S = 0.005
+    IDLE_SLEEP_MAX_S = 0.5
+    HEARTBEAT_S = 2.0
+
+    def __init__(self, name: str, record_root: str = "/areal_tpu/workers",
+                 extra_record: dict | None = None):
+        self.name = name
+        self.record_root = record_root
+        self.extra_record = dict(extra_record or {})
+        self.status = WorkerStatus.STANDBY
+        self._exit_evt = threading.Event()
+        self._idle_s = self.IDLE_SLEEP_MIN_S
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._runner = None
+        self._port: int | None = None
+        self._bind_host = "127.0.0.1"
+        self._last_beat = 0.0
+        self._poll_rounds = 0
+        self._work_done = 0
+
+    # ------------------------------------------------------------ subclass
+    def _configure(self, payload: dict) -> None:  # noqa: B027
+        """Apply controller-sent configuration (optional)."""
+
+    def _poll(self) -> int:
+        raise NotImplementedError
+
+    def _exit_hook(self) -> None:  # noqa: B027
+        """Cleanup before the loop exits (optional)."""
+
+    # ------------------------------------------------------------- control
+    async def _handle_cmd(self, request) -> Any:
+        from aiohttp import web
+
+        cmd = request.match_info["cmd"]
+        try:
+            payload = await request.json()
+        except Exception:  # noqa: BLE001 — empty body is fine
+            payload = {}
+        if cmd == "configure":
+            self._configure(payload)
+            self.status = WorkerStatus.STANDBY
+        elif cmd == "start":
+            self.status = WorkerStatus.RUNNING
+        elif cmd == "pause":
+            if self.status == WorkerStatus.RUNNING:
+                self.status = WorkerStatus.PAUSED
+        elif cmd == "resume":
+            if self.status == WorkerStatus.PAUSED:
+                self.status = WorkerStatus.RUNNING
+        elif cmd == "exit":
+            self.status = WorkerStatus.EXITING
+            self._exit_evt.set()
+        else:
+            return web.json_response({"error": f"unknown cmd {cmd}"},
+                                     status=404)
+        self._announce()
+        return web.json_response({"status": self.status.value})
+
+    async def _handle_status(self, request) -> Any:
+        from aiohttp import web
+
+        return web.json_response({
+            "status": self.status.value,
+            "poll_rounds": self._poll_rounds,
+            "work_done": self._work_done,
+        })
+
+    def _start_server(self, host: str, port: int) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/cmd/{cmd}", self._handle_cmd)
+        app.router.add_get("/status", self._handle_status)
+        started = threading.Event()
+        actual: list[int] = []
+
+        def _thread():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _up():
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, host, port)
+                await site.start()
+                self._runner = runner
+                actual.append(site._server.sockets[0].getsockname()[1])
+                started.set()
+
+            self._loop.run_until_complete(_up())
+            self._loop.run_forever()
+
+        threading.Thread(target=_thread, daemon=True,
+                         name=f"worker-ctl-{self.name}").start()
+        if not started.wait(timeout=30):
+            raise RuntimeError("worker control server failed to start")
+        self._port = actual[0]
+        return self._port
+
+    def _reachable_host(self) -> str:
+        # the record must carry an address OTHER hosts can dial: a
+        # 0.0.0.0 bind resolves to this host's IP, loopback stays as-is
+        # (single-host/test deployments)
+        if self._bind_host in ("0.0.0.0", "::", ""):
+            from areal_tpu.utils.network import gethostip
+
+            return gethostip()
+        return self._bind_host
+
+    def _announce(self):
+        self._last_beat = time.time()
+        name_resolve.add(
+            _record_key(self.record_root, self.name),
+            json.dumps({
+                "addr": f"{self._reachable_host()}:{self._port}",
+                "status": self.status.value,
+                "beat": self._last_beat,
+                **self.extra_record,
+            }),
+            replace=True,
+        )
+
+    # ----------------------------------------------------------------- run
+    def run(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        """Serve the control endpoint and poll until told to exit."""
+        self._bind_host = host
+        self._start_server(host, port)
+        self._announce()
+        # the heartbeat rides the control-server loop, NOT the poll loop:
+        # a long _poll() (a full train step) must not read as a dead worker
+        def _beat():
+            if not self._exit_evt.is_set():
+                self._announce()
+                self._loop.call_later(self.HEARTBEAT_S, _beat)
+
+        self._loop.call_soon_threadsafe(
+            self._loop.call_later, self.HEARTBEAT_S, _beat
+        )
+        logger.info("worker %s control endpoint on :%d", self.name, self._port)
+        try:
+            while not self._exit_evt.is_set():
+                if self.status != WorkerStatus.RUNNING:
+                    self._exit_evt.wait(0.02)
+                    continue
+                try:
+                    done = int(self._poll())
+                except Exception:
+                    logger.exception("worker %s poll failed", self.name)
+                    self.status = WorkerStatus.ERROR
+                    self._announce()
+                    raise
+                self._poll_rounds += 1
+                if done > 0:
+                    self._work_done += done
+                    self._idle_s = self.IDLE_SLEEP_MIN_S
+                else:
+                    # nothing to do: exponential backoff caps the idle spin
+                    self._exit_evt.wait(self._idle_s)
+                    self._idle_s = min(self._idle_s * 2, self.IDLE_SLEEP_MAX_S)
+        finally:
+            try:
+                self._exit_hook()
+            finally:
+                if self.status != WorkerStatus.ERROR:
+                    self.status = WorkerStatus.EXITING
+                self._announce()
+
+    def request_exit(self):
+        self._exit_evt.set()
+
+
+class WorkerControl:
+    """Controller-side panel over the worker fleet (reference
+    WorkerControlPanel.group_request / get_worker_status / pulse)."""
+
+    def __init__(self, record_root: str = "/areal_tpu/workers",
+                 heartbeat_timeout: float = 10.0):
+        self.record_root = record_root
+        self.heartbeat_timeout = heartbeat_timeout
+
+    def worker_records(self) -> dict[str, dict]:
+        recs = {}
+        try:
+            for key in name_resolve.find_subtree(self.record_root):
+                try:
+                    recs[key.rsplit("/", 1)[-1]] = json.loads(
+                        name_resolve.get(key)
+                    )
+                except name_resolve.NameEntryNotFoundError:
+                    continue
+        except name_resolve.NameEntryNotFoundError:
+            pass
+        return recs
+
+    def _request(self, addr: str, path: str, timeout: float) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=b"{}",
+            method="POST" if path.startswith("/cmd") else "GET",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def group_request(self, cmd: str, names: list[str] | None = None,
+                      timeout: float = 30.0) -> dict[str, dict]:
+        """Send ``cmd`` to every (or the named) worker; name -> response."""
+        recs = self.worker_records()
+        targets = names if names is not None else sorted(recs)
+        out = {}
+        for n in targets:
+            if n not in recs:
+                raise WorkerException(n, WorkerStatus.LOST, f"cmd {cmd}")
+            out[n] = self._request(recs[n]["addr"], f"/cmd/{cmd}", timeout)
+        return out
+
+    def get_status(self, name: str, timeout: float = 10.0) -> WorkerStatus:
+        recs = self.worker_records()
+        if name not in recs:
+            return WorkerStatus.LOST
+        try:
+            r = self._request(recs[name]["addr"], "/status", timeout)
+            return WorkerStatus(r["status"])
+        except Exception:  # noqa: BLE001 — unreachable = lost
+            return WorkerStatus.LOST
+
+    def pulse(self) -> dict[str, WorkerStatus]:
+        """Heartbeat check over the whole fleet: stale beat -> LOST
+        (the reference's failure-detection loop)."""
+        now = time.time()
+        out = {}
+        for n, rec in self.worker_records().items():
+            if now - float(rec.get("beat", 0)) > self.heartbeat_timeout:
+                out[n] = WorkerStatus.LOST
+            else:
+                out[n] = WorkerStatus(rec.get("status", "STANDBY"))
+        return out
+
+    def wait_all(self, status: WorkerStatus, names: list[str] | None = None,
+                 timeout: float = 60.0, interval: float = 0.05) -> None:
+        deadline = time.time() + timeout
+        while True:
+            recs = self.worker_records()
+            targets = names if names is not None else sorted(recs)
+            if targets and all(
+                recs.get(n, {}).get("status") == status.value
+                for n in targets
+            ):
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"workers {targets} did not reach {status.value}: "
+                    f"{ {n: recs.get(n, {}).get('status') for n in targets} }"
+                )
+            time.sleep(interval)

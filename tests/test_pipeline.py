@@ -436,6 +436,11 @@ def test_1f1b_matches_plain_losses_and_grads(strategy, m):
         np.asarray(losses), np.asarray(want_losses), rtol=2e-4, atol=2e-5
     )
     flat = dict(jax.tree_util.tree_leaves_with_path(want_grads))
+    got_paths = {p for p, _ in jax.tree_util.tree_leaves_with_path(grads)}
+    assert got_paths == set(flat), (
+        f"grad trees differ: only-pp={got_paths - set(flat)} "
+        f"only-plain={set(flat) - got_paths}"
+    )
     for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat[path]),
@@ -534,6 +539,11 @@ def test_1f1b_critic_matches_plain_losses_and_grads():
         np.asarray(losses), np.asarray(want_losses), rtol=2e-4, atol=2e-4
     )
     flat = dict(jax.tree_util.tree_leaves_with_path(want_grads))
+    got_paths = {p for p, _ in jax.tree_util.tree_leaves_with_path(grads)}
+    assert got_paths == set(flat), (
+        f"grad trees differ: only-pp={got_paths - set(flat)} "
+        f"only-plain={set(flat) - got_paths}"
+    )
     for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat[path]),
@@ -602,6 +612,11 @@ def test_1f1b_learned_positions_matches_plain():
         np.asarray(losses), np.asarray(want_losses), rtol=2e-4, atol=2e-5
     )
     flat = dict(jax.tree_util.tree_leaves_with_path(want_grads))
+    got_paths = {p for p, _ in jax.tree_util.tree_leaves_with_path(grads)}
+    assert got_paths == set(flat), (
+        f"grad trees differ: only-pp={got_paths - set(flat)} "
+        f"only-plain={set(flat) - got_paths}"
+    )
     for path, leaf in jax.tree_util.tree_leaves_with_path(grads):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat[path]),

@@ -1,0 +1,140 @@
+"""Generic worker poll-loop framework (controller/worker_base.py) — the
+reference's worker runtime capabilities (realhf/system/worker_base.py:
+command server, status registry, group requests, heartbeat pulse) on
+aiohttp + name_resolve."""
+
+import json
+import threading
+import time
+
+import numpy as np  # noqa: F401  (conftest platform setup)
+
+from areal_tpu.controller.worker_base import (
+    Worker,
+    WorkerControl,
+    WorkerStatus,
+)
+from areal_tpu.utils import name_resolve
+
+
+class CountingWorker(Worker):
+    def __init__(self, name, **kw):
+        super().__init__(name, **kw)
+        self.configured = None
+        self.exited = False
+        self.batch = 1
+
+    def _configure(self, payload):
+        self.configured = payload
+        self.batch = int(payload.get("batch", 1))
+
+    def _poll(self):
+        time.sleep(0.001)
+        return self.batch
+
+    def _exit_hook(self):
+        self.exited = True
+
+
+class IdleWorker(Worker):
+    def _poll(self):
+        return 0
+
+
+def _spawn(worker):
+    t = threading.Thread(target=worker.run, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while worker._port is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert worker._port is not None
+    return t
+
+
+def test_worker_lifecycle_and_group_requests():
+    w1 = CountingWorker("trainer/0", record_root="/t/workers")
+    w2 = CountingWorker("trainer/1", record_root="/t/workers")
+    t1, t2 = _spawn(w1), _spawn(w2)
+    panel = WorkerControl(record_root="/t/workers")
+
+    recs = panel.worker_records()
+    assert set(recs) == {"trainer.0", "trainer.1"}
+
+    panel.group_request("configure")  # empty payload
+    panel.group_request("start")
+    panel.wait_all(WorkerStatus.RUNNING, timeout=10)
+    time.sleep(0.2)
+    assert w1._work_done > 0 and w2._work_done > 0
+
+    panel.group_request("pause")
+    done = w1._work_done
+    time.sleep(0.1)
+    assert w1._work_done == done  # paused: no progress
+    assert w1.status == WorkerStatus.PAUSED
+
+    panel.group_request("resume")
+    time.sleep(0.1)
+    assert w1._work_done > done
+
+    panel.group_request("exit")
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert w1.exited and w2.exited
+
+
+def test_idle_backoff_and_status_endpoint():
+    w = IdleWorker("idle/0", record_root="/t2/workers")
+    t = _spawn(w)
+    panel = WorkerControl(record_root="/t2/workers")
+    panel.group_request("start")
+    time.sleep(0.3)
+    # idle worker backs off instead of hot-spinning: far fewer rounds than
+    # a 1ms-tight loop would give
+    assert w._poll_rounds < 200
+    st = panel.get_status(next(iter(panel.worker_records())))
+    assert st == WorkerStatus.RUNNING
+    panel.group_request("exit")
+    t.join(timeout=10)
+
+
+def test_pulse_marks_stale_heartbeat_lost():
+    w = CountingWorker("hb/0", record_root="/t3/workers")
+    t = _spawn(w)
+    panel = WorkerControl(record_root="/t3/workers", heartbeat_timeout=0.2)
+    assert panel.pulse()[next(iter(panel.worker_records()))] in (
+        WorkerStatus.STANDBY,
+        WorkerStatus.RUNNING,
+    )
+    # forge a stale beat (a dead process stops re-announcing)
+    key = next(
+        k for k in name_resolve.find_subtree("/t3/workers")
+    )
+    rec = json.loads(name_resolve.get(key))
+    rec["beat"] = time.time() - 60
+    name_resolve.add(key, json.dumps(rec), replace=True)
+    w._last_beat = time.time()  # stop the worker refreshing during check
+    statuses = panel.pulse()
+    assert list(statuses.values())[0] == WorkerStatus.LOST
+    panel.group_request("exit")
+    t.join(timeout=10)
+
+
+def test_configure_payload_reaches_worker():
+    w = CountingWorker("cfg/0", record_root="/t4/workers")
+    t = _spawn(w)
+    panel = WorkerControl(record_root="/t4/workers")
+    recs = panel.worker_records()
+    addr = list(recs.values())[0]["addr"]
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{addr}/cmd/configure",
+        data=json.dumps({"batch": 5}).encode(),
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    assert w.configured == {"batch": 5}
+    assert w.batch == 5
+    panel.group_request("exit")
+    t.join(timeout=10)
