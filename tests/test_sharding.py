@@ -101,3 +101,43 @@ def test_attn_spec_for_mesh_rules():
     mesh = Mesh(devs[:1].reshape(1, 1, 1, 1), ("pp", "dp", "cp", "tp"))
     s = AttnSpec.for_mesh(mesh, cfg)
     assert s.mesh is None
+
+
+def test_live_param_reshard_across_topologies():
+    """Param realloc between topologies (reference: realhf param realloc /
+    VERDICT r3 §2.5 partial): under GSPMD a live topology->topology
+    re-shard IS one device_put with the target NamedShardings — no
+    interval machinery, no host roundtrip. d4t2 training layout ->
+    d1t2p4-style layout and back must preserve every leaf bit-exactly."""
+    import numpy as np
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.lm import init_params
+    from areal_tpu.parallel.mesh import make_mesh
+    from areal_tpu.parallel.sharding import param_shardings
+
+    cfg = tiny_config(num_hidden_layers=4)
+    mesh_a = make_mesh(ParallelStrategy(dp=4, tp=2))
+    mesh_b = make_mesh(ParallelStrategy(tp=2, pp=4))
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    host = jax.tree.map(np.asarray, params)
+
+    p_a = jax.device_put(params, param_shardings(mesh_a, params, fsdp=True))
+    # live reshard A -> B (fsdp layout -> pp-stacked layout)
+    p_b = jax.device_put(p_a, param_shardings(mesh_b, params, fsdp=False))
+    # and back
+    p_a2 = jax.device_put(p_b, param_shardings(mesh_a, params, fsdp=True))
+
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p_b):
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            dict(jax.tree_util.tree_leaves_with_path(host))[path],
+            err_msg=str(path),
+        )
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p_a2):
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            dict(jax.tree_util.tree_leaves_with_path(host))[path],
+            err_msg=str(path),
+        )
