@@ -318,6 +318,9 @@ class GenerationEngine:
             donate_argnums=(1,),
             static_argnames=("steps",),
         )
+        self._jit_prefill_rot = jax.jit(
+            self._prefill_rot_impl, donate_argnums=(1,)
+        )
         self._jit_copy_block = jax.jit(
             self._copy_block_impl, donate_argnums=(0,)
         )
@@ -1247,6 +1250,128 @@ class GenerationEngine:
         self._slot_last_use[dst] = time.monotonic()
         return True
 
+    def _prefill_rot_impl(
+        self, params, cache, ids, positions, segment_ids, last_idx,
+        token_blocks, token_offsets, rng, temp, top_k, top_p, greedy,
+    ):
+        """Jit body for the rotated pp prefill: S stacked streams in, one
+        sampled token per (stream, row) out."""
+        from areal_tpu.parallel.pipeline import prefill_rotated_pp
+
+        logits, cache = prefill_rotated_pp(
+            params, self.model_config, cache, ids, positions, segment_ids,
+            last_idx, token_blocks, token_offsets, self.mesh,
+            attn_spec=self.attn_spec,
+        )
+        s, n, v = logits.shape
+        toks, logps = sample_tokens(
+            logits.reshape(s * n, v), rng,
+            temp.reshape(-1), top_k.reshape(-1), top_p.reshape(-1),
+            greedy.reshape(-1),
+        )
+        return toks.reshape(s, n), logps.reshape(s, n), cache
+
+    def _prefill_seqs_rotated(
+        self, seqs: list[_Seq], slots: list[int], blocks: list[list[int]]
+    ):
+        """Split an admission burst into S packed streams (balanced
+        longest-first) and prefill them through the rotated wavefront."""
+        self.prefill_count += len(seqs)
+        self.prefill_dispatch_count += 1
+        self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
+        s_pp = self._pp
+        bs = self.block_size
+        order = sorted(
+            range(len(seqs)), key=lambda i: -len(seqs[i].prompt)
+        )
+        stream_of = {}
+        loads = [0] * s_pp
+        members: list[list[int]] = [[] for _ in range(s_pp)]
+        for i in order:
+            si = loads.index(min(loads))
+            loads[si] += len(seqs[i].prompt)
+            stream_of[i] = (si, len(members[si]))
+            members[si].append(i)
+        tb = self._stream_bucket(max(loads))
+        # pinned row count = prefill_batch (the admission cap, so any
+        # member skew fits): a varying n_rows would retrace the jit per
+        # distinct burst shape; dummy rows only widen last_idx/sampling
+        n_rows = self.config.prefill_batch
+        ids = np.zeros((s_pp, tb), np.int32)
+        positions = np.zeros((s_pp, tb), np.int32)
+        segment_ids = np.full((s_pp, tb), -1, np.int32)
+        last_idx = np.full((s_pp, n_rows), tb - 1, np.int32)
+        temp = np.ones((s_pp, n_rows), np.float32)
+        top_k = np.zeros((s_pp, n_rows), np.int32)
+        top_p = np.ones((s_pp, n_rows), np.float32)
+        greedy = np.zeros((s_pp, n_rows), bool)
+        token_blocks = np.full((s_pp, tb), TRASH_BLOCK, np.int32)
+        token_offsets = np.zeros((s_pp, tb), np.int32)
+        for si, mem in enumerate(members):
+            cursor = 0
+            for ri, i in enumerate(mem):
+                sq = seqs[i]
+                ln = len(sq.prompt)
+                sl = slice(cursor, cursor + ln)
+                ids[si, sl] = sq.prompt
+                positions[si, sl] = np.arange(ln)
+                segment_ids[si, sl] = ri
+                last_idx[si, ri] = cursor + ln - 1
+                blk_row = np.asarray(blocks[i], np.int32)
+                token_blocks[si, sl] = blk_row[np.arange(ln) // bs]
+                token_offsets[si, sl] = np.arange(ln) % bs
+                g = sq.gconfig
+                temp[si, ri], top_k[si, ri] = g.temperature, g.top_k
+                top_p[si, ri], greedy[si, ri] = g.top_p, g.greedy
+                self.pos_delta[slots[i]] = 0
+                cursor += ln
+        toks, logps, self.cache = self._jit_prefill_rot(
+            self.params, self.cache, jnp.asarray(ids),
+            jnp.asarray(positions), jnp.asarray(segment_ids),
+            jnp.asarray(last_idx), jnp.asarray(token_blocks),
+            jnp.asarray(token_offsets), self._next_rng(),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy),
+        )
+        toks = np.asarray(toks)
+        logps = np.asarray(logps)
+        now = time.monotonic()
+        for i, (seq, slot) in enumerate(zip(seqs, slots)):
+            si, ri = stream_of[i]
+            self._finish_prefill_bookkeeping(
+                seq, slot, blocks[i], int(toks[si, ri]),
+                float(logps[si, ri]), now,
+            )
+
+    def _finish_prefill_bookkeeping(
+        self, seq: "_Seq", slot: int, blk_row: list[int], tok_i: int,
+        logp_i: float, now: float,
+    ):
+        """Post-prefill slot/bookkeeping shared by the single-stream and
+        rotated dispatch paths."""
+        seq.slot = slot
+        seq.t_first_token = now
+        seq.t_last_token = now
+        seq.out_tokens.append(tok_i)
+        seq.out_logprobs.append(logp_i)
+        seq.out_versions.append(self.version)
+        self.generated_tokens_total += 1
+        self.slots[slot] = seq
+        # cache holds exactly the prompt tokens; the sampled token's
+        # K/V is written by the next decode step
+        self.cache_len[slot] = len(seq.prompt)
+        self.last_token[slot] = tok_i
+        self._slot_covered[slot] = list(seq.prompt)
+        self.block_table[slot, : len(blk_row)] = blk_row
+        self.block_table[slot, len(blk_row):] = -1
+        self._slot_nblocks[slot] = len(blk_row)
+        self._slot_last_use[slot] = now
+        # image-conditioned rows encode pixels the token ids don't
+        # show; stamp -1 so they can never be cloned into a text request
+        self._slot_kv_version[slot] = -1 if seq.images else self.version
+        if self._seq_finished(seq, tok_i):
+            self._finish(slot, self._finish_reason(seq, tok_i))
+
     def _prefill_seqs(
         self, seqs: list[_Seq], slots: list[int], blocks: list[list[int]]
     ):
@@ -1256,6 +1381,15 @@ class GenerationEngine:
         quadratics). ``blocks[i]`` are slot i's freshly allocated KV blocks
         (covering its prompt); stream-tail and dummy-row writes are routed
         to the trash block."""
+        if (
+            self._pp > 1
+            and len(seqs) >= 2
+            and not any(s.images for s in seqs)
+        ):
+            # pp serving: split the burst into S streams so the wavefront
+            # keeps every stage busy (prefill_rotated_pp) instead of
+            # dragging one stream through the sequential conveyor
+            return self._prefill_seqs_rotated(seqs, slots, blocks)
         self.prefill_count += len(seqs)
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
@@ -1368,29 +1502,9 @@ class GenerationEngine:
         toks = np.asarray(toks)
         logps = np.asarray(logps)
         for i, (seq, slot) in enumerate(zip(seqs, slots)):
-            seq.slot = slot
-            seq.t_first_token = now
-            seq.t_last_token = now
-            tok_i = int(toks[i])
-            seq.out_tokens.append(tok_i)
-            seq.out_logprobs.append(float(logps[i]))
-            seq.out_versions.append(self.version)
-            self.generated_tokens_total += 1
-            self.slots[slot] = seq
-            # cache holds exactly the prompt tokens; the sampled token's
-            # K/V is written by the next decode step
-            self.cache_len[slot] = len(seq.prompt)
-            self.last_token[slot] = tok_i
-            self._slot_covered[slot] = list(seq.prompt)
-            self.block_table[slot, : len(blocks[i])] = blocks[i]
-            self.block_table[slot, len(blocks[i]):] = -1
-            self._slot_nblocks[slot] = len(blocks[i])
-            self._slot_last_use[slot] = now
-            # image-conditioned rows encode pixels the token ids don't
-            # show; stamp -1 so they can never be cloned into a text request
-            self._slot_kv_version[slot] = -1 if seq.images else self.version
-            if self._seq_finished(seq, tok_i):
-                self._finish(slot, self._finish_reason(seq, tok_i))
+            self._finish_prefill_bookkeeping(
+                seq, slot, blocks[i], int(toks[i]), float(logps[i]), now
+            )
 
     def _seq_finished(self, seq: _Seq, last_tok: int) -> bool:
         n_out = len(seq.out_tokens)

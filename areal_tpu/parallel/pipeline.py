@@ -613,12 +613,117 @@ def prefill_stream_pp(
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
     )(params["layers"], cache["k"], cache["v"], x0)
-    y = _norm(cfg, y, params["final_norm"], params.get("final_norm_b"))
-    h_last = y[last_idx]
+    return _final_norm_head(cfg, params, y[last_idx]), {"k": k2, "v": v2}
+
+
+def prefill_rotated_pp(
+    params: dict,
+    cfg: TransformerConfig,
+    cache: dict,  # paged pool {k, v: [L, NB, BS, KH, D]}, L sharded over pp
+    ids: jnp.ndarray,  # [S, T] S packed ragged streams (one per stage slot)
+    positions: jnp.ndarray,  # [S, T]
+    segment_ids: jnp.ndarray,  # [S, T], pad = -1
+    last_idx: jnp.ndarray,  # [S, N] final-token stream index per prompt
+    token_blocks: jnp.ndarray,  # [S, T] physical block per token (trash = 0)
+    token_offsets: jnp.ndarray,  # [S, T]
+    mesh: Mesh,
+    attn_spec: AttnSpec | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Wavefront-rotated prefill: S independent packed streams ride the
+    stage ring like GPipe microbatches (stream m enters stage 0 at tick m,
+    stage i at tick t prefills stream t-i), so all S stages are busy in
+    steady state — ~S/2 x the sequential conveyor's throughput for an
+    admission burst, at 2S-1 ticks of one-stage work total. Each stage
+    scatters its local layers' K/V into its slice of the paged pool;
+    fill/drain ticks write to the trash block. The admission path splits a
+    multi-prompt burst into S streams to feed this (engine._prefill_seqs).
+
+    Returns (last-token logits [S, N, V] fp32, updated pool).
+    """
+    from areal_tpu.models.lm import _embed, _norm, _prefill_stream_layer
+
+    s = pp_size(mesh)
+    assert ids.shape[0] == s, (ids.shape, s)
+    t = ids.shape[1]
+    n = last_idx.shape[1]
+    h = cfg.hidden_size
+    x0 = _embed(params, cfg, ids, positions)  # [S, T, H]
+    inner_spec = stage_attn_spec(attn_spec, mesh)
+    steps = 2 * s - 1
+
+    def stage_fn(layers_local, k_pool, v_pool, emb):
+        stage = jax.lax.axis_index(AXIS_PP)
+
+        def tick(carry, tt):
+            msg, out, kp, vp = carry
+            m = tt - stage
+            valid = (m >= 0) & (m < s)
+            mc = jnp.clip(m, 0, s - 1)
+            seg = jax.lax.dynamic_index_in_dim(segment_ids, mc, 0, False)
+            blk = jax.lax.dynamic_index_in_dim(token_blocks, mc, 0, False)
+            off = jax.lax.dynamic_index_in_dim(token_offsets, mc, 0, False)
+            blk = jnp.where(valid, blk, 0)  # invalid ticks -> trash block
+            x_in = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(emb, mc, 0, False),
+                msg,
+            )
+
+            rope_pos = jax.lax.dynamic_index_in_dim(positions, mc, 0, False)
+
+            def body(c, layer_in):
+                lp, kl, vl = layer_in
+                out_c, k, v = _prefill_stream_layer(
+                    cfg, lp, c, rope_pos, seg, inner_spec
+                )
+                kl = kl.at[blk, off].set(k.astype(kl.dtype), mode="drop")
+                vl = vl.at[blk, off].set(v.astype(vl.dtype), mode="drop")
+                return out_c, (kl, vl)
+
+            y, (kp, vp) = jax.lax.scan(body, x_in, (layers_local, kp, vp))
+            is_out = (stage == s - 1) & valid
+            li = jax.lax.dynamic_index_in_dim(last_idx, mc, 0, False)
+            rows = y[li]  # [N, H]
+            slot = jnp.where(is_out, mc, s)
+            out = jax.lax.dynamic_update_index_in_dim(out, rows, slot, 0)
+            nxt = jax.lax.ppermute(
+                y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
+            )
+            return (nxt, out, kp, vp), None
+
+        carry0 = (
+            jnp.zeros((t, h), emb.dtype),
+            jnp.zeros((s + 1, n, h), emb.dtype),
+            k_pool,
+            v_pool,
+        )
+        (_, out, kp, vp), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
+        out = jnp.where(stage == s - 1, out[:s], 0.0)
+        return jax.lax.psum(out, AXIS_PP), kp, vp
+
+    hidden, k2, v2 = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        axis_names=frozenset({AXIS_PP}),
+        check_vma=False,
+    )(params["layers"], cache["k"], cache["v"], x0)
+    return _final_norm_head(cfg, params, hidden), {"k": k2, "v": v2}
+
+
+def _final_norm_head(cfg, params, hidden) -> jnp.ndarray:
+    """Final norm + LM head (tied or not) -> fp32 logits; the shared tail
+    of every pp serving forward."""
+    from areal_tpu.models.lm import _norm
+
+    hidden = _norm(
+        cfg, hidden, params["final_norm"], params.get("final_norm_b")
+    )
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    return (h_last @ head).astype(jnp.float32), {"k": k2, "v": v2}
+    return (hidden @ head).astype(jnp.float32)
 
 
 def decode_step_paged_pp(
@@ -691,11 +796,7 @@ def decode_step_paged_pp(
     cache = {"k": k2, "v": v2}
     if not compute_logits:
         return None, cache
-    y = _norm(cfg, y, params["final_norm"], params.get("final_norm_b"))
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (y @ head).astype(jnp.float32), cache
+    return _final_norm_head(cfg, params, y), cache
 
 
 def pipeline_hidden_interleaved(

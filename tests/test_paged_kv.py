@@ -476,3 +476,96 @@ def test_rotated_pp_decode_matches_sequential():
             np.asarray(c1[key][:, 1:]), np.asarray(c2[key][:, 1:]),
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_rotated_pp_prefill_matches_single_device():
+    """prefill_rotated_pp: S packed streams wavefront through the stage
+    ring; per-stream last-token logits and the paged pool must match the
+    single-device prefill_stream + write_prefill_blocks path."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.lm import (
+        init_params,
+        prefill_stream,
+        write_prefill_blocks,
+    )
+    from areal_tpu.parallel.mesh import make_mesh
+    from areal_tpu.parallel.pipeline import prefill_rotated_pp
+    from areal_tpu.parallel.sharding import param_shardings
+
+    cfg = tiny_config(num_hidden_layers=4)
+    mesh = make_mesh(ParallelStrategy(pp=2))
+    s = 2
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    params_pp = jax.device_put(
+        params, param_shardings(mesh, params, fsdp=False)
+    )
+    layers = cfg.num_hidden_layers
+    nb, bs, t, n = 16, 8, 24, 2
+    pool = {
+        k: jnp.zeros(
+            (layers, nb, bs, cfg.num_key_value_heads, cfg.head_dim),
+            jnp.float32,
+        )
+        for k in ("k", "v")
+    }
+    rng = np.random.default_rng(0)
+    # stream 0: prompts of 7 and 11 tokens; stream 1: prompts of 9 and 5
+    lens = [[7, 11], [9, 5]]
+    ids = np.zeros((s, t), np.int32)
+    pos = np.zeros((s, t), np.int32)
+    seg = np.full((s, t), -1, np.int32)
+    last = np.full((s, n), t - 1, np.int32)
+    tb_blocks = np.zeros((s, t), np.int32)
+    tb_off = np.zeros((s, t), np.int32)
+    next_block = 1
+    for si in range(s):
+        cur = 0
+        for pi, ln in enumerate(lens[si]):
+            sl = slice(cur, cur + ln)
+            ids[si, sl] = rng.integers(1, 100, size=ln)
+            pos[si, sl] = np.arange(ln)
+            seg[si, sl] = pi
+            last[si, pi] = cur + ln - 1
+            nblk = -(-ln // bs)
+            row = np.arange(next_block, next_block + nblk)
+            next_block += nblk
+            tb_blocks[si, sl] = row[np.arange(ln) // bs]
+            tb_off[si, sl] = np.arange(ln) % bs
+            cur += ln
+
+    # single-device reference, stream by stream
+    ref_pool = pool
+    ref_logits = []
+    for si in range(s):
+        lg, ks, vs = prefill_stream(
+            params, cfg, jnp.asarray(ids[si]), jnp.asarray(pos[si]),
+            jnp.asarray(seg[si]), jnp.asarray(last[si]),
+        )
+        ref_pool = write_prefill_blocks(
+            ref_pool, ks, vs, jnp.asarray(tb_blocks[si]),
+            jnp.asarray(tb_off[si]),
+        )
+        ref_logits.append(np.asarray(lg))
+
+    pp_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("pp"))
+    pool_pp = jax.device_put(pool, {"k": pp_sh, "v": pp_sh})
+    got_logits, got_pool = jax.jit(
+        lambda pl: prefill_rotated_pp(
+            params_pp, cfg, pl, jnp.asarray(ids), jnp.asarray(pos),
+            jnp.asarray(seg), jnp.asarray(last), jnp.asarray(tb_blocks),
+            jnp.asarray(tb_off), mesh,
+        )
+    )(pool_pp)
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.stack(ref_logits), rtol=2e-5, atol=2e-5
+    )
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(got_pool[key][:, 1:]),
+            np.asarray(ref_pool[key][:, 1:]),
+            rtol=1e-5, atol=1e-6,
+        )
