@@ -149,3 +149,50 @@ def test_moe_forward_under_pipeline_matches_plain():
         for i in range(m)
     ])
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_moe_engine_ep_under_pp_matches_other_layouts():
+    """EP x PP matrix cell: expert-parallel GShard dispatch inside
+    pipeline stages. Per-step engine losses must match both the ep-only
+    and the pp-only layouts on identical data/seed."""
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+
+    def run(strategy):
+        cfg = TrainEngineConfig(
+            path="", init_from_scratch=True,
+            optimizer=OptimizerConfig(lr=1e-2),
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=64),
+        )
+        cfg.backend.param_dtype = "float32"
+        cfg.backend.pad_mb_to_multiple = 16
+        model = moe_cfg("ragged")
+        model = tiny_config(
+            num_hidden_layers=4, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=48,
+        )
+        eng = TPULMEngine(cfg)
+        eng.create_process_group(strategy)
+        eng.initialize(None, None, model_config=model, seed=0)
+        rng = np.random.default_rng(0)
+        b, s = 8, 16
+        data = dict(
+            input_ids=rng.integers(1, 128, size=(b, s)).astype(np.int32),
+            attention_mask=np.ones((b, s), np.int32),
+            loss_mask=np.ones((b, s), np.int32),
+        )
+        out = [eng.train_lm(data)["loss"] for _ in range(3)]
+        eng.destroy()
+        return out
+
+    l_ep_pp = run(ParallelStrategy(dp=2, pp=2, ep=2))
+    l_ep = run(ParallelStrategy(dp=2, ep=2))
+    l_pp = run(ParallelStrategy(dp=2, pp=2))
+    np.testing.assert_allclose(l_ep_pp, l_ep, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(l_ep_pp, l_pp, rtol=2e-4, atol=2e-4)
