@@ -348,6 +348,16 @@ def main():
             }
 
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    if os.path.exists(out_path):
+        # --part a and --part b may run as separate invocations (the TPU
+        # session script does); merge instead of clobbering the other part
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            prev.update(out)
+            out = prev
+        except (json.JSONDecodeError, OSError):
+            pass
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps({k: v for k, v in out.items() if k != "what_is_real"},

@@ -1,0 +1,28 @@
+#!/bin/bash
+# One-shot TPU measurement session: run the moment the tunnel is live.
+# Order follows VERDICT r3 priorities: bench ladder (kernel compile +
+# SFT tokens/s + decode + weight-resync + GRPO step) first, then the
+# real-scale e2e GRPO evidence run. Every stage appends to its own
+# artifact so a mid-session wedge still leaves records.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "[tpu_session] probing backend..."
+if ! timeout 240 python -c "import jax; print(jax.devices())"; then
+    echo "[tpu_session] tunnel not live; aborting" >&2
+    exit 1
+fi
+
+echo "[tpu_session] bench ladder (wall budget ${AREAL_BENCH_WALL_S:-5400}s)"
+AREAL_BENCH_WALL_S="${AREAL_BENCH_WALL_S:-5400}" \
+    timeout "$(( ${AREAL_BENCH_WALL_S:-5400} + 300 ))" \
+    python bench.py | tee /tmp/tpu_session_bench.json
+
+echo "[tpu_session] real-scale e2e GRPO (part B learning proof first — cheap)"
+timeout 2400 python scripts/real_e2e_grpo.py --part b --steps 24 || true
+echo "[tpu_session] real-scale e2e GRPO (part A: 0.5B body on MATH-500)"
+timeout 5400 python scripts/real_e2e_grpo.py --part a --steps 5 || true
+
+echo "[tpu_session] artifacts:"
+ls -la BENCH_PARTIAL.jsonl docs/artifacts/e2e_real_r4.json 2>/dev/null
+echo "[tpu_session] done"
