@@ -85,6 +85,23 @@ _DTYPES = {
     "float16": jnp.float16,
 }
 
+# per-sequence microbatch keys whose ZERO row is a semantic no-op, so
+# stacked-pp dispatch may zero-pad their row counts to a common max
+# (pair_mask: a zero row is a masked pair — engine/rw/rw_engine.py)
+_ZERO_ROW_IS_NOOP_KEYS = frozenset({"pair_mask"})
+
+
+def _pad_rows(arrs, rmax: int | None = None):
+    """Zero-pad each array's axis 0 to ``rmax`` (default: the max)."""
+    if rmax is None:
+        rmax = max(a.shape[0] for a in arrs)
+    return [
+        np.concatenate(
+            [a, np.zeros((rmax - a.shape[0],) + a.shape[1:], a.dtype)]
+        ) if a.shape[0] < rmax else a
+        for a in arrs
+    ]
+
 # the batch keys engine.forward consumes; algorithm wrappers (PPO actor /
 # critic) filter to these so per-host-different extras (rewards, behavior
 # logprobs, ...) never hit the replicated device_put branch under multi-host
@@ -580,26 +597,29 @@ class TPUTrainEngine(TrainEngine):
                 gt, gh, gw = self._vlm_grids
                 ppi = gt * gh * gw
                 pmax = -(-pmax // ppi) * ppi
-            tables = [
-                np.concatenate(
-                    [t, np.zeros((pmax - t.shape[0],) + t.shape[1:],
-                                 np.float32)]
-                ) if t.shape[0] < pmax else t
-                for t in tables
-            ]
-            out["pixel_values"] = jax.device_put(np.stack(tables), rep)
+            out["pixel_values"] = jax.device_put(
+                np.stack(_pad_rows(tables, pmax)), rep
+            )
         for k in packed_mbs[0]:
             if k in ("cu_seqlens", "max_seqlen", "image_grid_thw",
                      "pixel_values"):
                 continue
             arrs = [np.asarray(p[k]) for p in packed_mbs]
             if any(a.shape != arrs[0].shape for a in arrs[1:]):
-                # per-sequence keys (RM pair_mask etc.) differ per mb even
-                # after token-bucket equalization
-                raise NotImplementedError(
-                    f"pp>1 cannot stack microbatch key {k!r}: per-mb shapes "
-                    f"{[a.shape for a in arrs]} differ"
-                )
+                shapes = [a.shape for a in arrs]
+                if k in _ZERO_ROW_IS_NOOP_KEYS and all(
+                    a.shape[1:] == arrs[0].shape[1:] for a in arrs
+                ):
+                    # per-SEQUENCE keys whose zero row is verified a no-op
+                    # (pair_mask: a zero row is a masked pair) zero-pad to
+                    # the max row count; anything else stays fail-loud
+                    arrs = _pad_rows(arrs)
+                else:
+                    raise NotImplementedError(
+                        f"pp>1 cannot stack microbatch key {k!r}: per-mb "
+                        f"shapes {shapes} differ (only keys in "
+                        f"{sorted(_ZERO_ROW_IS_NOOP_KEYS)} may row-pad)"
+                    )
             arr = np.stack(arrs)
             if arr.dtype == np.float64:
                 arr = arr.astype(np.float32)
