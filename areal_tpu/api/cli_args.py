@@ -524,6 +524,12 @@ class JaxGenConfig:
     dtype: str = "bfloat16"
     max_batch_size: int = 64
     prefill_chunk: int = 512  # tokens per prefill chunk (static bucket)
+    # > 0 enables intra-prompt chunked prefill (vLLM/SGLang-style): a text
+    # prompt longer than this warms its KV in chunks of this size across
+    # engine iterations, so one 32k admission cannot stall running decodes
+    # for its whole prompt; the slot joins decode only when warm. 0 = off
+    # (whole-prompt dispatches, still token-budgeted per loop iteration).
+    chunked_prefill_tokens: int = 0
     # max queued prompts packed into ONE prefill dispatch (same segment-id
     # stream; block-skipping keeps cost at sum of per-prompt quadratics)
     prefill_batch: int = 4

@@ -296,6 +296,11 @@ class GenerationEngine:
         # and how many prompt tokens skipped prefill that way
         self.prefix_extend_count = 0
         self.prefix_extend_saved_tokens = 0
+        # intra-prompt chunked prefill (vLLM/SGLang-style): slots whose
+        # long prompt is being written chunk-by-chunk between decode
+        # iterations; invisible to decode until warm
+        self._warming: dict[int, dict] = {}
+        self.chunked_prefill_count = 0
         # served-token counters (the reference gserver_manager's per-server
         # token-usage tracking role, realhf/system/gserver_manager.py):
         # prompt_tokens_total counts every ADMITTED request's prompt
@@ -549,6 +554,7 @@ class GenerationEngine:
             for i, s in enumerate(self.slots)
             if s is None
             and i not in self._retained_slots
+            and i not in self._warming  # mid-warm blocks are LIVE
             and self._slot_nblocks[i] > 0
         ]
         if cands:
@@ -943,6 +949,12 @@ class GenerationEngine:
         for i, seq in enumerate(self.slots):
             if seq is not None:
                 self._finish(i, reason, retain=retain)
+        # mid-warm slots answer too (their partially-written KV is
+        # discarded — it may span a weight update and must not survive)
+        for slot in list(self._warming):
+            seq = self._warming.pop(slot)["seq"]
+            self._free_slot_blocks(slot)
+            seq.on_done(self._response(seq, reason))
         # flush queued-but-not-admitted requests too: client re-issues them
         while True:
             try:
@@ -959,6 +971,13 @@ class GenerationEngine:
         for i, seq in enumerate(self.slots):
             if seq is not None and seq.rid in rids:
                 self._finish(i, "abort")
+                rids.discard(seq.rid)
+        for slot in list(self._warming):
+            seq = self._warming[slot]["seq"]
+            if seq.rid in rids:
+                del self._warming[slot]
+                self._free_slot_blocks(slot)
+                seq.on_done(self._response(seq, "abort"))
                 rids.discard(seq.rid)
         if rids:
             # the rid may still be waiting in the input queue — filter it out
@@ -977,6 +996,62 @@ class GenerationEngine:
             for seq in kept:
                 self._input_queue.put(seq)
 
+    def _extend_chunk(self, slot: int, ids_chunk, start: int):
+        """One bucketed suffix-extension dispatch writing slot's prompt
+        tokens [start, start+len) — shared by prefix extension and
+        intra-prompt chunked prefill. Chunk length buckets and the table
+        width pads to a power of two: arbitrary shapes would recompile the
+        model-sized extend program per distinct length; surplus -1 table
+        entries gather the trash block and are masked by position."""
+        bucket = self._bucket(len(ids_chunk))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : len(ids_chunk)] = ids_chunk
+        nbt = 1
+        while nbt < self._slot_nblocks[slot]:
+            nbt *= 2
+        nbt = min(nbt, self.max_blocks_per_seq)
+        self.cache = self._jit_extend(
+            self.params, self.cache, jnp.asarray(ids), jnp.int32(start),
+            jnp.asarray(self.block_table[slot, :nbt][None]),
+        )
+
+    def _advance_warming(self, token_budget: int) -> int:
+        """Write the next chunk(s) of each warming slot's long prompt
+        (intra-prompt chunked prefill: decode proceeds between chunks, so
+        one 32k admission cannot stall running requests for its whole
+        prompt). Returns the remaining token budget."""
+        chunk_sz = self.config.chunked_prefill_tokens
+        for slot in list(self._warming):
+            st = self._warming[slot]
+            seq = st["seq"]
+            limit = len(seq.prompt) - 1  # last token feeds the first decode
+            while token_budget > 0 and st["off"] < limit:
+                n = min(chunk_sz, limit - st["off"], token_budget)
+                self._extend_chunk(
+                    slot, seq.prompt[st["off"]: st["off"] + n], st["off"]
+                )
+                st["off"] += n
+                token_budget -= n
+            if st["off"] >= limit:
+                del self._warming[slot]
+                self.chunked_prefill_count += 1
+                self.prompt_tokens_total += len(seq.prompt)
+                seq.slot = slot
+                self.slots[slot] = seq
+                self.cache_len[slot] = limit
+                self.last_token[slot] = seq.prompt[-1]
+                self.pos_delta[slot] = 0
+                self._slot_covered[slot] = list(seq.prompt[:-1])
+                # a weight update that landed MID-warm leaves mixed-version
+                # KV: poison it as a clone source (-1, like image slots)
+                self._slot_kv_version[slot] = (
+                    st["version"] if st["version"] == self.version else -1
+                )
+                self._slot_last_use[slot] = time.monotonic()
+            if token_budget <= 0:
+                break
+        return token_budget
+
     def _admit(self):
         """Fill slots from the input queue: resume retained requests with
         zero re-prefill, otherwise prefill into a free slot. Prefill work per
@@ -989,6 +1064,7 @@ class GenerationEngine:
             if self.n_running == 0
             else max(self.config.prefill_chunk * 4, 512)
         )
+        token_budget = self._advance_warming(token_budget)
         pending: list[_Seq] = []  # prompts awaiting one packed prefill
         pending_slots: list[int] = []
         pending_blocks: list[list[int]] = []
@@ -1017,6 +1093,7 @@ class GenerationEngine:
                 if s is None
                 and i not in self._retained_slots
                 and i not in pending_slots
+                and i not in self._warming
             ]
             if not free and self._retained:
                 self._evict_lru_retained()
@@ -1026,6 +1103,7 @@ class GenerationEngine:
                     if s is None
                     and i not in self._retained_slots
                     and i not in pending_slots
+                    and i not in self._warming
                 ]
             if not free:
                 self._input_queue.put(seq)  # no capacity; retry next loop
@@ -1069,6 +1147,27 @@ class GenerationEngine:
                 self._input_queue.put(seq)  # pool full of live sequences
                 flush()
                 return
+            chunk_sz = self.config.chunked_prefill_tokens
+            if (
+                chunk_sz > 0
+                and not seq.images
+                and len(seq.prompt) - 1 > chunk_sz
+            ):
+                # intra-prompt chunked prefill: this prompt warms chunk by
+                # chunk across engine iterations (decode runs in between);
+                # the slot stays invisible to decode until warm, and the
+                # final prompt token feeds the first decode step (the
+                # clone-resume recipe — no sampling inside prefill at all)
+                slot = free[0]
+                self.block_table[slot, : len(blocks)] = blocks
+                self.block_table[slot, len(blocks):] = -1
+                self._slot_nblocks[slot] = len(blocks)
+                self._warming[slot] = {
+                    "seq": seq, "blocks": blocks, "off": 0,
+                    "version": self.version,
+                }
+                token_budget = self._advance_warming(token_budget)
+                continue
             # ragged packed prefill: mixed lengths and image prompts all
             # join the same stream; flush first when this prompt would
             # push the dispatch past the stream cap
@@ -1221,23 +1320,7 @@ class GenerationEngine:
             # suffix extension over prompt[best : n-1] (bucket-padded; pad
             # rows are overwritten before they're ever attended — see
             # _extend_impl)
-            suffix = seq.prompt[best : n - 1]
-            bucket = self._bucket(len(suffix))
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, : len(suffix)] = suffix
-            # pad the table width to a power of two (like _decode_chunk):
-            # arbitrary widths would recompile the model-sized extend
-            # program per distinct prefix length; surplus -1 entries gather
-            # the trash block and are masked by position
-            nbt = 1
-            while nbt < len(new_table):
-                nbt *= 2
-            nbt = min(nbt, self.max_blocks_per_seq)
-            self.cache = self._jit_extend(
-                self.params, self.cache, jnp.asarray(ids),
-                jnp.int32(best),
-                jnp.asarray(self.block_table[dst, :nbt][None]),
-            )
+            self._extend_chunk(dst, seq.prompt[best: n - 1], best)
             self.prefix_extend_count += 1
             self.prefix_extend_saved_tokens += best
             self._slot_kv_version[dst] = self.version

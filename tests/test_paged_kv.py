@@ -569,3 +569,115 @@ def test_rotated_pp_prefill_matches_single_device():
             np.asarray(ref_pool[key][:, 1:]),
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_chunked_prefill_matches_whole_prompt(model):
+    """Intra-prompt chunked prefill (chunked_prefill_tokens): a long
+    prompt warms chunk-by-chunk between engine iterations; greedy outputs
+    and logprobs must match the whole-prompt dispatch exactly."""
+    prompt = list((np.arange(100) * 7) % 120 + 1)
+
+    def run(**kw):
+        eng = make_engine(model, max_batch_size=2, max_seq_len=256, **kw)
+        results: list = []
+        submit_n(eng, [prompt], results, max_new=6)
+        drive_until_done(eng, 1, results)
+        return eng, results[0][1]
+
+    eng0, r0 = run()
+    eng1, r1 = run(chunked_prefill_tokens=16)
+    assert eng1.chunked_prefill_count == 1
+    assert eng0.chunked_prefill_count == 0
+    assert r0.output_tokens == r1.output_tokens
+    np.testing.assert_allclose(
+        r0.output_logprobs, r1.output_logprobs, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_chunked_prefill_decode_proceeds_while_warming(model):
+    """A running request keeps generating while a long prompt warms: the
+    short request must finish BEFORE the long one even joins decode."""
+    eng = make_engine(
+        model, max_batch_size=2, max_seq_len=2048,
+        chunked_prefill_tokens=64, decode_steps_per_call=2,
+    )
+    results: list = []
+    submit_n(eng, [[5, 9, 3]], results, max_new=4)
+    eng._admit()
+    assert eng.n_running == 1
+    long_prompt = list((np.arange(1500) * 11) % 120 + 1)
+    eng.submit(
+        "long", long_prompt,
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        lambda r: results.append(("long", r)),
+    )
+    # drive: the short request should complete while the long prompt is
+    # still warming (warming is budgeted per iteration)
+    saw_short_done_while_warming = False
+    for _ in range(200):
+        eng._handle_aborts()
+        eng._admit()
+        if eng.n_running:
+            eng._decode_chunk()
+        if any(i == 0 for i, *_ in results) and eng._warming:
+            saw_short_done_while_warming = True
+        if len(results) == 2:
+            break
+    assert len(results) == 2
+    assert saw_short_done_while_warming
+    long_r = next(r for tag, r in results if tag == "long")
+    assert len(long_r.output_tokens) == 4
+
+
+def test_chunked_prefill_abort_while_warming_frees_blocks(model):
+    """Aborting a request mid-warm must free its blocks and answer with
+    stop_reason=abort."""
+    eng = make_engine(
+        model, max_batch_size=2, max_seq_len=2048,
+        chunked_prefill_tokens=64, decode_steps_per_call=2,
+    )
+    # a running request keeps the warming budget finite
+    results: list = []
+    submit_n(eng, [[5, 9, 3]], results, max_new=30)
+    eng._admit()
+    free_before = eng.pool.n_free
+    long_prompt = list((np.arange(1500) * 13) % 120 + 1)
+    done: list = []
+    eng.submit(
+        "victim", long_prompt,
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        lambda r: done.append(r),
+    )
+    eng._admit()
+    assert eng._warming, "long prompt should be warming"
+    eng.abort("victim")
+    eng._handle_aborts()
+    assert not eng._warming
+    assert done and done[0].stop_reason == "abort"
+    assert eng.pool.n_free == free_before
+
+
+def test_pause_mid_warm_answers_and_discards(model):
+    """_abort_all (pause/shutdown path) must answer a mid-warm request and
+    discard its partial KV — chunks may span a weight update and the
+    partially-written state must not survive."""
+    eng = make_engine(
+        model, max_batch_size=2, max_seq_len=2048,
+        chunked_prefill_tokens=64, decode_steps_per_call=2,
+    )
+    results: list = []
+    submit_n(eng, [[5, 9, 3]], results, max_new=30)
+    eng._admit()
+    free_before = eng.pool.n_free
+    done: list = []
+    eng.submit(
+        "w", list((np.arange(1500) * 3) % 120 + 1),
+        GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        lambda r: done.append(r),
+    )
+    eng._admit()
+    assert eng._warming
+    eng._abort_all("abort")
+    assert not eng._warming
+    assert done and done[0].stop_reason == "abort"
+    assert eng.pool.n_free >= free_before
