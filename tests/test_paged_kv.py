@@ -681,3 +681,27 @@ def test_pause_mid_warm_answers_and_discards(model):
     assert not eng._warming
     assert done and done[0].stop_reason == "abort"
     assert eng.pool.n_free >= free_before
+
+
+def test_tp_and_pp_x_tp_generation_matches_single_device(model):
+    """Serving under tensor parallelism and the pp x tp mesh (rotated
+    prefill/decode manual over pp with tp auto inside): greedy outputs and
+    logprobs must match the single-device engine."""
+    prompts = [[5, 9, 3, 7, 2, 6], [11, 4, 8, 1], [9, 9, 2, 4, 4]]
+
+    def run(**kw):
+        eng = make_engine(model, max_batch_size=4, **kw)
+        results: list = []
+        submit_n(eng, prompts, results, max_new=6)
+        drive_until_done(eng, 3, results)
+        return {i: r for i, r in results}
+
+    single = run()
+    for kw in (dict(tp_size=2), dict(pp_size=2, tp_size=2)):
+        got = run(**kw)
+        for i in range(3):
+            assert single[i].output_tokens == got[i].output_tokens, kw
+            np.testing.assert_allclose(
+                single[i].output_logprobs, got[i].output_logprobs,
+                rtol=1e-5, atol=1e-6, err_msg=str(kw),
+            )
