@@ -705,3 +705,23 @@ def test_tp_and_pp_x_tp_generation_matches_single_device(model):
                 single[i].output_logprobs, got[i].output_logprobs,
                 rtol=1e-5, atol=1e-6, err_msg=str(kw),
             )
+
+
+def test_chunked_prefill_under_pp_matches_single_device(model):
+    """Chunked warming rides the pp-aware extend dispatch: outputs at
+    pp=2 with chunking must match the single-device whole-prompt run."""
+    prompt = list((np.arange(100) * 7) % 120 + 1)
+
+    def run(**kw):
+        eng = make_engine(model, max_batch_size=2, max_seq_len=256, **kw)
+        results: list = []
+        submit_n(eng, [prompt], results, max_new=6)
+        drive_until_done(eng, 1, results)
+        return results[0][1]
+
+    r0 = run()
+    r1 = run(pp_size=2, chunked_prefill_tokens=16)
+    assert r0.output_tokens == r1.output_tokens
+    np.testing.assert_allclose(
+        r0.output_logprobs, r1.output_logprobs, rtol=1e-5, atol=1e-6
+    )
