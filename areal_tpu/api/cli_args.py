@@ -530,6 +530,12 @@ class JaxGenConfig:
     # for its whole prompt; the slot joins decode only when warm. 0 = off
     # (whole-prompt dispatches, still token-budgeted per loop iteration).
     chunked_prefill_tokens: int = 0
+    # "int8" stores the paged KV pool as int8 + per-(row, head) scales:
+    # ~half the HBM per cached token, ~double the concurrent sequences at
+    # the same kv_pool_tokens byte budget (quality: symmetric per-row
+    # quantization; logits drift is small but nonzero). pp serving keeps
+    # the full-precision pool ("none").
+    kv_quant: str = "none"
     # max queued prompts packed into ONE prefill dispatch (same segment-id
     # stream; block-skipping keeps cost at sum of per-prompt quadratics)
     prefill_batch: int = 4

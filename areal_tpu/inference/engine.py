@@ -219,8 +219,18 @@ class GenerationEngine:
                 f"max_seq_len={s} sequence"
             )
         self.pool = BlockPool(num_blocks, self.block_size)
+        if config.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be none|int8, got {config.kv_quant!r}"
+            )
+        if config.kv_quant != "none" and pp > 1:
+            raise NotImplementedError(
+                "kv_quant with pp serving is unsupported (the stage "
+                "conveyors thread full-precision pools)"
+            )
         cache = init_paged_kv_cache(
-            model_config, num_blocks, self.block_size, self.dtype
+            model_config, num_blocks, self.block_size, self.dtype,
+            quant=config.kv_quant,
         )
         kh_div = model_config.num_key_value_heads % tp == 0
         cache_spec = jax.sharding.PartitionSpec(
@@ -230,8 +240,20 @@ class GenerationEngine:
             None,
         )
         self._cache_sharding = jax.sharding.NamedSharding(self.mesh, cache_spec)
+        # scale planes only exist when kv_quant=int8, which excludes pp —
+        # the leading (L) dim is therefore always unsharded here
+        scale_sharding = jax.sharding.NamedSharding(
+            self.mesh,
+            jax.sharding.PartitionSpec(
+                None, None, None, AXIS_TP if kh_div else None
+            ),
+        )
         self.cache = jax.device_put(
-            cache, {"k": self._cache_sharding, "v": self._cache_sharding}
+            cache,
+            {
+                k: (self._cache_sharding if k in ("k", "v") else scale_sharding)
+                for k in cache
+            },
         )
         # per-slot block tables (-1 = unmapped) + valid-entry counts
         self.block_table = np.full((b, self.max_blocks_per_seq), -1, np.int32)
@@ -343,7 +365,8 @@ class GenerationEngine:
             row = jax.lax.dynamic_index_in_dim(x, src_blk, 1, keepdims=False)
             return jax.lax.dynamic_update_index_in_dim(x, row, dst_blk, 1)
 
-        return {"k": cp(cache["k"]), "v": cp(cache["v"])}
+        # tree-wide: int8 pools carry ks/vs scale planes alongside k/v
+        return jax.tree.map(cp, dict(cache))
 
     # ------------------------------------------------------------------
     # Device steps

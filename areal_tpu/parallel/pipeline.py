@@ -771,11 +771,12 @@ def decode_step_paged_pp(
         def work(x, kp, vp):
             def body(carry, layer_in):
                 lp, kl, vl = layer_in
-                out, kl, vl = _decode_paged_layer(
-                    cfg, lp, kl, vl, carry, rope_pos, flat_phys, flat_off,
-                    gather_ids, cache_len + tq, inner_spec,
+                out, pool_layer = _decode_paged_layer(
+                    cfg, lp, {"k": kl, "v": vl}, carry, rope_pos,
+                    flat_phys, flat_off, gather_ids, cache_len + tq,
+                    inner_spec,
                 )
-                return out, (kl, vl)
+                return out, (pool_layer["k"], pool_layer["v"])
 
             y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
             return y, k2, v2
@@ -1053,12 +1054,12 @@ def decode_rotated_pp(
 
             def body(c, layer_in):
                 lp, kl, vl = layer_in
-                out, kl, vl = _decode_paged_layer(
-                    cfg, lp, kl, vl, c, write_pos,
+                out, pool_layer = _decode_paged_layer(
+                    cfg, lp, {"k": kl, "v": vl}, c, write_pos,
                     phys.reshape(-1), (write_pos % bs_).reshape(-1),
                     gather_ids, clen_g + 1, inner_spec,
                 )
-                return out, (kl, vl)
+                return out, (pool_layer["k"], pool_layer["v"])
 
             y, (kp, vp) = jax.lax.scan(body, x_in, (layers_local, kp, vp))
 

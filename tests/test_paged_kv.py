@@ -725,3 +725,78 @@ def test_chunked_prefill_under_pp_matches_single_device(model):
     np.testing.assert_allclose(
         r0.output_logprobs, r1.output_logprobs, rtol=1e-5, atol=1e-6
     )
+
+
+def test_int8_kv_quantization_roundtrip_and_decode_parity(model):
+    """kv_quant=int8: per-row symmetric quantization error is bounded, and
+    paged decode over an int8 pool tracks the fp pool's logits closely."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models.lm import (
+        decode_step_paged,
+        init_paged_kv_cache,
+        quantize_kv_rows,
+        write_prefill_blocks,
+    )
+
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.normal(0, 2, (16, 2, 8)).astype(np.float32))
+    q, scale = quantize_kv_rows(rows)
+    back = q.astype(jnp.float32) * scale[..., None]
+    # symmetric int8: error <= scale/2 = max|row|/254 per element
+    bound = np.asarray(jnp.max(jnp.abs(rows), -1) / 254.0 + 1e-6)
+    assert (np.abs(np.asarray(back - rows)) <= bound[..., None]).all()
+
+    cfg, params = model
+    nb, bs = 8, 8
+    table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    clen = jnp.asarray([5, 3], jnp.int32)
+    active = jnp.ones((2,), bool)
+    toks = jnp.asarray([[7], [11]], jnp.int32)
+    # seed both pools with the same prefill rows
+    t = 8
+    ks = jnp.asarray(rng.normal(0, 1, (cfg.num_hidden_layers, t,
+                                       cfg.num_key_value_heads,
+                                       cfg.head_dim)).astype(np.float32))
+    vs = jnp.asarray(rng.normal(0, 1, ks.shape).astype(np.float32))
+    blocks = jnp.asarray([1, 1, 1, 1, 1, 3, 3, 3], jnp.int32)
+    offs = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2], jnp.int32)
+    pool_fp = init_paged_kv_cache(cfg, nb, bs, jnp.float32)
+    pool_q = init_paged_kv_cache(cfg, nb, bs, jnp.float32, quant="int8")
+    pool_fp = write_prefill_blocks(pool_fp, ks, vs, blocks, offs)
+    pool_q = write_prefill_blocks(pool_q, ks, vs, blocks, offs)
+
+    lg_fp, _ = jax.jit(decode_step_paged, static_argnums=(1,))(
+        params, cfg, pool_fp, toks, clen, table, active
+    )
+    lg_q, _ = jax.jit(decode_step_paged, static_argnums=(1,))(
+        params, cfg, pool_q, toks, clen, table, active
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_q), np.asarray(lg_fp), rtol=0.15, atol=0.35
+    )
+
+
+def test_int8_kv_engine_generation_and_capacity(model):
+    """End-to-end engine with kv_quant=int8: generation runs (prefix clone
+    copies scale planes too), and the pool's k/v HBM bytes halve vs bf16
+    at the same token budget."""
+    eng_q = make_engine(model, max_batch_size=4, kv_quant="int8")
+    results: list = []
+    submit_n(eng_q, [[5, 9, 3, 7], [5, 9, 3, 7], [11, 4, 8]], results,
+             max_new=6)
+    drive_until_done(eng_q, 3, results)
+    for _, r in results:
+        assert len(r.output_tokens) == 6
+        assert np.isfinite(r.output_logprobs).all()
+    # identical prompts share prefill via clone (block copy incl. scales)
+    assert results[0][1].output_tokens == results[1][1].output_tokens
+
+    eng_bf = make_engine(model, max_batch_size=4, dtype="bfloat16")
+    q_bytes = eng_q.cache["k"].nbytes + eng_q.cache["v"].nbytes
+    bf_bytes = eng_bf.cache["k"].nbytes + eng_bf.cache["v"].nbytes
+    assert q_bytes * 2 == bf_bytes
+    # f32 scale planes cost 2/head_dim of the bf16 pool (1/32 at D=64)
+    scale_bytes = eng_q.cache["ks"].nbytes + eng_q.cache["vs"].nbytes
+    assert scale_bytes == bf_bytes * 2 // eng_q.model_config.head_dim
