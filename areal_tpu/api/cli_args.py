@@ -533,8 +533,8 @@ class JaxGenConfig:
     # "int8" stores the paged KV pool as int8 + per-(row, head) scales:
     # ~half the HBM per cached token, ~double the concurrent sequences at
     # the same kv_pool_tokens byte budget (quality: symmetric per-row
-    # quantization; logits drift is small but nonzero). pp serving keeps
-    # the full-precision pool ("none").
+    # quantization; logits drift is small but nonzero). Works under pp
+    # serving too (the stage conveyors thread the scale planes).
     kv_quant: str = "none"
     # max queued prompts packed into ONE prefill dispatch (same segment-id
     # stream; block-skipping keeps cost at sum of per-prompt quadratics)

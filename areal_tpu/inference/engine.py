@@ -223,11 +223,6 @@ class GenerationEngine:
             raise ValueError(
                 f"kv_quant must be none|int8, got {config.kv_quant!r}"
             )
-        if config.kv_quant != "none" and pp > 1:
-            raise NotImplementedError(
-                "kv_quant with pp serving is unsupported (the stage "
-                "conveyors thread full-precision pools)"
-            )
         cache = init_paged_kv_cache(
             model_config, num_blocks, self.block_size, self.dtype,
             quant=config.kv_quant,
@@ -240,12 +235,13 @@ class GenerationEngine:
             None,
         )
         self._cache_sharding = jax.sharding.NamedSharding(self.mesh, cache_spec)
-        # scale planes only exist when kv_quant=int8, which excludes pp —
-        # the leading (L) dim is therefore always unsharded here
+        # int8 scale planes [L, NB, BS, KH] shard like the pools minus D
         scale_sharding = jax.sharding.NamedSharding(
             self.mesh,
             jax.sharding.PartitionSpec(
-                None, None, None, AXIS_TP if kh_div else None
+                AXIS_PP if pp > 1 else None,
+                None, None,
+                AXIS_TP if kh_div else None,
             ),
         )
         self.cache = jax.device_put(

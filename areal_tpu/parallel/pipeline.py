@@ -573,47 +573,50 @@ def prefill_stream_pp(
 
     Returns (last-token logits [N, V] fp32, updated pool).
     """
-    from areal_tpu.models.lm import _embed, _norm, _prefill_stream_layer
+    from areal_tpu.models.lm import (
+        _embed,
+        _norm,
+        _pool_write,
+        _prefill_stream_layer,
+    )
 
     s = pp_size(mesh)
     rope_pos = positions3 if positions3 is not None else positions
     x0 = _embed(params, cfg, input_ids, positions)
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
-    def stage_fn(layers_local, k_pool, v_pool, x_in):
+    def stage_fn(layers_local, pool, x_in):
         stage = jax.lax.axis_index(AXIS_PP)
 
-        def work(x, kp, vp):
+        def work(x, pl):
             def body(carry, layer_in):
-                lp, kl, vl = layer_in
+                lp, pool_layer = layer_in
                 out, k, v = _prefill_stream_layer(
                     cfg, lp, carry, rope_pos, segment_ids, inner_spec
                 )
-                kl = kl.at[token_blocks, token_offsets].set(
-                    k.astype(kl.dtype), mode="drop"
-                )
-                vl = vl.at[token_blocks, token_offsets].set(
-                    v.astype(vl.dtype), mode="drop"
-                )
-                return out, (kl, vl)
+                idx = (token_blocks, token_offsets)
+                pool_layer = _pool_write(pool_layer, "k", idx, k)
+                pool_layer = _pool_write(pool_layer, "v", idx, v)
+                return out, pool_layer
 
-            y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
-            return y, k2, v2
+            y, pl = jax.lax.scan(body, x, (layers_local, pl))
+            return y, pl
 
-        (_, kp, vp), y = _stage_ticks(
-            s, stage, work, (x_in, k_pool, v_pool), collect_last=True
+        (_, pl), y = _stage_ticks(
+            s, stage, work, (x_in, pool), collect_last=True
         )
-        return y, kp, vp
+        return y, pl
 
-    y, k2, v2 = jax.shard_map(
+    pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
+    y, new_cache = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
-        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        in_specs=(P(AXIS_PP), pool_specs, P()),
+        out_specs=(P(), pool_specs),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
-    )(params["layers"], cache["k"], cache["v"], x0)
-    return _final_norm_head(cfg, params, y[last_idx]), {"k": k2, "v": v2}
+    )(params["layers"], dict(cache), x0)
+    return _final_norm_head(cfg, params, y[last_idx]), new_cache
 
 
 def prefill_rotated_pp(
@@ -640,7 +643,12 @@ def prefill_rotated_pp(
 
     Returns (last-token logits [S, N, V] fp32, updated pool).
     """
-    from areal_tpu.models.lm import _embed, _norm, _prefill_stream_layer
+    from areal_tpu.models.lm import (
+        _embed,
+        _norm,
+        _pool_write,
+        _prefill_stream_layer,
+    )
 
     s = pp_size(mesh)
     assert ids.shape[0] == s, (ids.shape, s)
@@ -651,11 +659,11 @@ def prefill_rotated_pp(
     inner_spec = stage_attn_spec(attn_spec, mesh)
     steps = 2 * s - 1
 
-    def stage_fn(layers_local, k_pool, v_pool, emb):
+    def stage_fn(layers_local, pool, emb):
         stage = jax.lax.axis_index(AXIS_PP)
 
         def tick(carry, tt):
-            msg, out, kp, vp = carry
+            msg, out, pl = carry
             m = tt - stage
             valid = (m >= 0) & (m < s)
             mc = jnp.clip(m, 0, s - 1)
@@ -672,15 +680,15 @@ def prefill_rotated_pp(
             rope_pos = jax.lax.dynamic_index_in_dim(positions, mc, 0, False)
 
             def body(c, layer_in):
-                lp, kl, vl = layer_in
+                lp, pool_layer = layer_in
                 out_c, k, v = _prefill_stream_layer(
                     cfg, lp, c, rope_pos, seg, inner_spec
                 )
-                kl = kl.at[blk, off].set(k.astype(kl.dtype), mode="drop")
-                vl = vl.at[blk, off].set(v.astype(vl.dtype), mode="drop")
-                return out_c, (kl, vl)
+                pool_layer = _pool_write(pool_layer, "k", (blk, off), k)
+                pool_layer = _pool_write(pool_layer, "v", (blk, off), v)
+                return out_c, pool_layer
 
-            y, (kp, vp) = jax.lax.scan(body, x_in, (layers_local, kp, vp))
+            y, pl = jax.lax.scan(body, x_in, (layers_local, pl))
             is_out = (stage == s - 1) & valid
             li = jax.lax.dynamic_index_in_dim(last_idx, mc, 0, False)
             rows = y[li]  # [N, H]
@@ -689,27 +697,27 @@ def prefill_rotated_pp(
             nxt = jax.lax.ppermute(
                 y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
             )
-            return (nxt, out, kp, vp), None
+            return (nxt, out, pl), None
 
         carry0 = (
             jnp.zeros((t, h), emb.dtype),
             jnp.zeros((s + 1, n, h), emb.dtype),
-            k_pool,
-            v_pool,
+            pool,
         )
-        (_, out, kp, vp), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
+        (_, out, pl), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
         out = jnp.where(stage == s - 1, out[:s], 0.0)
-        return jax.lax.psum(out, AXIS_PP), kp, vp
+        return jax.lax.psum(out, AXIS_PP), pl
 
-    hidden, k2, v2 = jax.shard_map(
+    pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
+    hidden, new_cache = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
-        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        in_specs=(P(AXIS_PP), pool_specs, P()),
+        out_specs=(P(), pool_specs),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
-    )(params["layers"], cache["k"], cache["v"], x0)
-    return _final_norm_head(cfg, params, hidden), {"k": k2, "v": v2}
+    )(params["layers"], dict(cache), x0)
+    return _final_norm_head(cfg, params, hidden), new_cache
 
 
 def _final_norm_head(cfg, params, hidden) -> jnp.ndarray:
@@ -765,36 +773,36 @@ def decode_step_paged_pp(
     gather_ids = jnp.maximum(block_table, 0)
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
-    def stage_fn(layers_local, k_pool, v_pool, x_in):
+    def stage_fn(layers_local, pool, x_in):
         stage = jax.lax.axis_index(AXIS_PP)
 
-        def work(x, kp, vp):
+        def work(x, pl):
             def body(carry, layer_in):
-                lp, kl, vl = layer_in
+                lp, pool_layer = layer_in
                 out, pool_layer = _decode_paged_layer(
-                    cfg, lp, {"k": kl, "v": vl}, carry, rope_pos,
+                    cfg, lp, pool_layer, carry, rope_pos,
                     flat_phys, flat_off, gather_ids, cache_len + tq,
                     inner_spec,
                 )
-                return out, (pool_layer["k"], pool_layer["v"])
+                return out, pool_layer
 
-            y, (k2, v2) = jax.lax.scan(body, x, (layers_local, kp, vp))
-            return y, k2, v2
+            y, pl = jax.lax.scan(body, x, (layers_local, pl))
+            return y, pl
 
-        (_, kp, vp), y = _stage_ticks(
-            s, stage, work, (x_in, k_pool, v_pool), collect_last=True
+        (_, pl), y = _stage_ticks(
+            s, stage, work, (x_in, pool), collect_last=True
         )
-        return y, kp, vp
+        return y, pl
 
-    y, k2, v2 = jax.shard_map(
+    pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
+    y, cache = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP), P()),
-        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        in_specs=(P(AXIS_PP), pool_specs, P()),
+        out_specs=(P(), pool_specs),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
-    )(params["layers"], cache["k"], cache["v"], x0)
-    cache = {"k": k2, "v": v2}
+    )(params["layers"], dict(cache), x0)
     if not compute_logits:
         return None, cache
     return _final_norm_head(cfg, params, y), cache
@@ -1013,12 +1021,12 @@ def decode_rotated_pp(
     norm_b = params.get("final_norm_b")
     rngs = jax.random.split(rng, ticks)
 
-    def stage_fn(layers_local, k_pool, v_pool):
+    def stage_fn(layers_local, pool):
         stage = jax.lax.axis_index(AXIS_PP)
         is_exit = stage == s - 1
 
         def tick(carry, xs):
-            msg, toks_all, clen_all, kp, vp = carry
+            msg, toks_all, clen_all, pl = carry
             tt, rng_t = xs
             u = tt - stage
             uc = jnp.clip(u, 0, steps * s - 1)
@@ -1053,15 +1061,15 @@ def decode_rotated_pp(
             x_in = jnp.where((stage == 0) & (k == 0), emb0, msg)
 
             def body(c, layer_in):
-                lp, kl, vl = layer_in
+                lp, pool_layer = layer_in
                 out, pool_layer = _decode_paged_layer(
-                    cfg, lp, {"k": kl, "v": vl}, c, write_pos,
+                    cfg, lp, pool_layer, c, write_pos,
                     phys.reshape(-1), (write_pos % bs_).reshape(-1),
                     gather_ids, clen_g + 1, inner_spec,
                 )
-                return out, (pool_layer["k"], pool_layer["v"])
+                return out, pool_layer
 
-            y, (kp, vp) = jax.lax.scan(body, x_in, (layers_local, kp, vp))
+            y, pl = jax.lax.scan(body, x_in, (layers_local, pl))
 
             def exit_fn(y_):
                 xn = _norm(cfg, y_[:, 0], params["final_norm"], norm_b)
@@ -1109,36 +1117,36 @@ def decode_rotated_pp(
             ys_logp = jax.lax.psum(
                 jnp.where(exit_valid, logp, 0.0), AXIS_PP
             )
-            return (out_msg, toks_all, clen_all, kp, vp), (ys_tok, ys_logp)
+            return (out_msg, toks_all, clen_all, pl), (ys_tok, ys_logp)
 
-        bs_ = k_pool.shape[2]
+        bs_ = pool["k"].shape[2]
         carry0 = (
             # ring messages carry activations — embed dtype, not pool dtype
             jnp.zeros((g_sz, 1, h), params["embed"].dtype),
             last_tokens,
             cache_len,
-            k_pool,
-            v_pool,
+            pool,
         )
-        (_, _, _, kp, vp), (toks, logps) = jax.lax.scan(
+        (_, _, _, pl), (toks, logps) = jax.lax.scan(
             tick, carry0, (jnp.arange(ticks), rngs)
         )
-        return toks, logps, kp, vp
+        return toks, logps, pl
 
-    toks_t, logps_t, k2, v2 = jax.shard_map(
+    pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
+    toks_t, logps_t, new_cache = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(AXIS_PP), P(AXIS_PP), P(AXIS_PP)),
-        out_specs=(P(), P(), P(AXIS_PP), P(AXIS_PP)),
+        in_specs=(P(AXIS_PP), pool_specs),
+        out_specs=(P(), P(), pool_specs),
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
-    )(params["layers"], cache["k"], cache["v"])
+    )(params["layers"], dict(cache))
 
     # de-interleave ticks: group g's token k surfaced at tick g + k*S + S-1
     idx = (s - 1) + jnp.arange(s)[None, :] + jnp.arange(steps)[:, None] * s
     toks = toks_t[idx].reshape(steps, b)
     logps = logps_t[idx].reshape(steps, b)
-    return toks, logps, {"k": k2, "v": v2}
+    return toks, logps, new_cache
 
 
 def forward_packed_pipelined(

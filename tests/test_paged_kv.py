@@ -800,3 +800,26 @@ def test_int8_kv_engine_generation_and_capacity(model):
     # f32 scale planes cost 2/head_dim of the bf16 pool (1/32 at D=64)
     scale_bytes = eng_q.cache["ks"].nbytes + eng_q.cache["vs"].nbytes
     assert scale_bytes == bf_bytes * 2 // eng_q.model_config.head_dim
+
+
+def test_int8_kv_under_pp_matches_single_device_int8(model):
+    """int8 pools under pp serving: the stage conveyors thread the scale
+    planes, so pp2+int8 must reproduce single-device int8 exactly (both
+    quantize identical rows identically)."""
+    prompts = [[5, 9, 3, 7, 2, 6], [11, 4, 8, 1]]
+
+    def run(**kw):
+        eng = make_engine(model, max_batch_size=4, kv_quant="int8", **kw)
+        results: list = []
+        submit_n(eng, prompts, results, max_new=6)
+        drive_until_done(eng, 2, results)
+        return {i: r for i, r in results}
+
+    single = run()
+    pp2 = run(pp_size=2)
+    for i in range(2):
+        assert single[i].output_tokens == pp2[i].output_tokens
+        np.testing.assert_allclose(
+            single[i].output_logprobs, pp2[i].output_logprobs,
+            rtol=1e-5, atol=1e-6,
+        )
