@@ -56,7 +56,11 @@ def serve(
         w = _EngineWorker(
             worker_name, extra_record={"rpc_addr": f"{rpc_host}:{actual}"}
         )
-        threading.Thread(target=w.run, daemon=True,
+        # bind the control endpoint on the same interface as the RPC
+        # server — the default loopback bind would advertise an address
+        # other hosts cannot dial (cross-host group_request/get_status
+        # would target 127.0.0.1 on the CALLER's machine)
+        threading.Thread(target=lambda: w.run(host=host), daemon=True,
                          name=f"announce-{worker_name}").start()
         return actual, stop_evt
     return actual, None

@@ -182,10 +182,16 @@ class Worker:
         name_resolve.add(
             _record_key(self.record_root, self.name),
             json.dumps({
+                **self.extra_record,
+                # core fields AFTER the spread: panel addressing and
+                # liveness must not be hijackable by a caller-supplied
+                # extra_record key. "name" is the name as constructed
+                # ("trainer/0") — the record key flattens '/' to '.', so
+                # the panel needs it to accept lookups by original name.
                 "addr": f"{self._reachable_host()}:{self._port}",
+                "name": self.name,
                 "status": self.status.value,
                 "beat": self._last_beat,
-                **self.extra_record,
             }),
             replace=True,
         )
@@ -253,11 +259,13 @@ class WorkerControl:
         try:
             for key in name_resolve.find_subtree(self.record_root):
                 try:
-                    recs[key.rsplit("/", 1)[-1]] = json.loads(
-                        name_resolve.get(key)
-                    )
+                    rec = json.loads(name_resolve.get(key))
                 except name_resolve.NameEntryNotFoundError:
                     continue
+                # key by the name the Worker was constructed with (the
+                # record key flattens '/' to '.'; records from older
+                # workers without the field fall back to the flat form)
+                recs[rec.get("name") or key.rsplit("/", 1)[-1]] = rec
         except name_resolve.NameEntryNotFoundError:
             pass
         return recs

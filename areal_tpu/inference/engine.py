@@ -107,6 +107,10 @@ class GenerationEngine:
         tokenizer: Any | None = None,
         devices: list | None = None,
     ):
+        # own copy: init may round max_batch_size up to a pp multiple, and
+        # a config object shared with capacity formulas or a second engine
+        # must not change value underneath the caller
+        config = dataclasses.replace(config)
         self.config = config
         self.tokenizer = tokenizer
         devices = devices if devices is not None else jax.devices()
@@ -149,6 +153,33 @@ class GenerationEngine:
                     config.max_batch_size, new_b, pp,
                 )
                 config.max_batch_size = new_b
+        requested_s = config.max_seq_len
+        blk = min(config.page_size, config.max_seq_len)
+        if config.max_seq_len % blk:
+            # page-align on the engine's own copy (same treatment as the
+            # pp batch rounding) — callers must not have to hand-roll KV
+            # page alignment. Before the position-window checks below so
+            # the rounded-up value is what gets validated.
+            new_s = -(-config.max_seq_len // blk) * blk
+            logger.info(
+                "rounding max_seq_len %d up to %d (multiple of the KV "
+                "block size %d; knob: page_size)",
+                config.max_seq_len, new_s, blk,
+            )
+            config.max_seq_len = new_s
+
+        def _rounding_note() -> str:
+            # a window error must blame the right knob: if only the PAGE
+            # ROUNDING pushed past the window, the fix is a page_size that
+            # divides the window, not a smaller request
+            if config.max_seq_len == requested_s:
+                return ""
+            return (
+                f" (requested max_seq_len={requested_s} was page-aligned "
+                f"up to {config.max_seq_len}; a page_size dividing "
+                f"{requested_s} would avoid the round-up)"
+            )
+
         if (
             model_config.pos_embed_type == "learned"
             and config.max_seq_len > model_config.max_position_embeddings
@@ -157,6 +188,7 @@ class GenerationEngine:
             raise ValueError(
                 f"max_seq_len={config.max_seq_len} exceeds the learned "
                 f"position table ({model_config.max_position_embeddings})"
+                + _rounding_note()
             )
         if (
             model_config.rope_scaling_type == "dynamic"
@@ -171,7 +203,7 @@ class GenerationEngine:
                 f"max_position_embeddings "
                 f"({model_config.max_position_embeddings}) on a dynamic-NTK "
                 "rope model; extension beyond the trained window is not "
-                "supported"
+                "supported" + _rounding_note()
             )
 
         # per-engine attention dispatch (no process-global state): under TP,
@@ -205,11 +237,7 @@ class GenerationEngine:
         # fixed-size blocks shared by all slots via per-slot block tables,
         # instead of a dense [B, max_seq] reservation per slot.
         self.block_size = min(config.page_size, s)
-        if s % self.block_size:
-            raise ValueError(
-                f"max_seq_len={s} must be a multiple of the KV block size "
-                f"({self.block_size}; knob: page_size)"
-            )
+        assert s % self.block_size == 0  # rounded at init
         pool_tokens = config.kv_pool_tokens or b * s
         self.max_blocks_per_seq = s // self.block_size
         num_blocks = -(-pool_tokens // self.block_size) + 1  # +1 trash block

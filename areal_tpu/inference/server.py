@@ -69,7 +69,10 @@ def _response_payload(r: ModelResponse) -> dict:
 class GenerationServer:
     def __init__(self, engine: GenerationEngine):
         self.engine = engine
-        self.app = web.Application(client_max_size=256 * 1024**2)
+        # must exceed the largest weight-resync chunk (WeightUpdateMeta
+        # chunked_mem_mb defaults: http 512MB, shm 1024MB) plus safetensors
+        # header overhead — a 256MB cap 413'd the default http push
+        self.app = web.Application(client_max_size=2 * 1024**3)
         self.app.add_routes(
             [
                 web.get("/health", self.health),

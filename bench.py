@@ -45,7 +45,15 @@ import time
 REFERENCE_MFU = 0.40
 METRIC = "sft_train_tokens_per_sec_per_chip_qwen2_1.5b"
 REPO = os.path.dirname(os.path.abspath(__file__))
-PARTIAL_PATH = os.path.join(REPO, "BENCH_PARTIAL.jsonl")
+# Rehearsal mode (AREAL_BENCH_REHEARSAL=1): run the WHOLE ladder on CPU with
+# scaled-down shapes to prove the mechanics — every rung completes, emits its
+# record, and no single child can eat the window (the round-4 failure mode).
+# Records go to a separate file so a rehearsal never pollutes the real
+# hardware artifact.
+REHEARSAL = os.environ.get("AREAL_BENCH_REHEARSAL") == "1"
+PARTIAL_PATH = os.path.join(
+    REPO, "BENCH_REHEARSAL.jsonl" if REHEARSAL else "BENCH_PARTIAL.jsonl"
+)
 
 WALL_S = float(os.environ.get("AREAL_BENCH_WALL_S", "6000"))
 _T0 = time.time()
@@ -61,6 +69,8 @@ def remaining(deadline: float) -> float:
 
 def emit(record: dict):
     """One metric line on stdout + append to the partial file."""
+    if REHEARSAL:
+        record = {**record, "rehearsal": True}
     line = json.dumps(record)
     print(line, flush=True)
     try:
@@ -94,8 +104,11 @@ def _run_child(kind: str, att: dict, timeout: float):
     HBM, and a wedged tunnel must be killable (an in-process hang would
     hold jax's init lock for the rest of the run)."""
     cmd = [sys.executable, __file__, f"--{kind}-child", json.dumps(att)]
+    env = dict(os.environ)
+    if REHEARSAL:
+        env["AREAL_PLATFORM"] = "cpu"
     r = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
+        cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
     )
     sys.stderr.write(r.stderr[-2000:])
     if r.returncode != 0:
@@ -181,6 +194,20 @@ KERNEL_CONFIGS = [
          ulysses=True),
 ]
 
+# same rung structure, CPU-sized (interpret=True — Pallas cannot compile on
+# the CPU backend; the rehearsal proves the ladder, the live run proves the
+# kernel)
+KERNEL_CONFIGS_REHEARSAL = [
+    dict(name="fwd_bwd_b128_t1k", block=128, t=1024, bwd=True,
+         interpret=True),
+    dict(name="fwd_b128_t2k_window512", block=128, t=2048, bwd=False,
+         window=512, interpret=True),
+    dict(name="ring_cp_b128_t1k", block=128, t=1024, bwd=True, ring=True,
+         interpret=True),
+    dict(name="ulysses_b128_t1k", block=128, t=1024, bwd=True, ulysses=True,
+         interpret=True),
+]
+
 
 def kernels_child(configs: list[dict] | None = None):
     """Compile (non-interpret) + execute the Pallas flash kernel fwd+bwd and
@@ -218,10 +245,14 @@ def kernels_child(configs: list[dict] | None = None):
                     else ulysses_attention_sharded
                 )
 
+                impl = (
+                    "pallas_interpret" if c.get("interpret") else "pallas"
+                )
+
                 def loss(q, k, v):
                     o = wrapper(
                         mesh, q, k, v, seg, token_axes=("cp",),
-                        chunk_impl="pallas", block=c["block"],
+                        chunk_impl=impl, block=c["block"],
                     )
                     return jnp.sum(o.astype(jnp.float32) ** 2)
 
@@ -236,6 +267,7 @@ def kernels_child(configs: list[dict] | None = None):
                     o = flash_attention_packed(
                         q, k, v, seg, block=c["block"],
                         window=c.get("window", 0),
+                        interpret=c.get("interpret", False),
                     )
                     return jnp.sum(o.astype(jnp.float32) ** 2)
 
@@ -249,6 +281,7 @@ def kernels_child(configs: list[dict] | None = None):
                     lambda q, k, v: flash_attention_packed(
                         q, k, v, seg, block=c["block"],
                         window=c.get("window", 0),
+                        interpret=c.get("interpret", False),
                     )
                 )(q, k, v)
                 jax.block_until_ready(o)
@@ -261,12 +294,12 @@ def kernels_child(configs: list[dict] | None = None):
     return results
 
 
-def qwen2_1p5b_cfg(layers: int = 28):
+def qwen2_1p5b_cfg(layers: int = 28, vocab: int = 151936):
     from areal_tpu.models.config import TransformerConfig
 
     return TransformerConfig(
         arch="qwen2",
-        vocab_size=151936,
+        vocab_size=vocab,
         hidden_size=1536,
         intermediate_size=8960,
         num_hidden_layers=layers,
@@ -287,6 +320,7 @@ def sft_bench(
     remat_policy: str = "nothing_saveable",
     mb_tokens: int | None = None,
     loss_chunk: int = 1024,
+    vocab: int = 151936,
 ):
     """One SFT throughput measurement; returns (tokens/s, mfu or None)."""
     import numpy as np
@@ -316,13 +350,13 @@ def sft_bench(
     # instead — parallel/sharding.py fsdp)
     cfg.backend.optimizer_dtype = "bfloat16"
     cfg.backend.grad_acc_dtype = "bfloat16"
-    model_cfg = qwen2_1p5b_cfg(layers)
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
     engine = TPULMEngine(cfg)
     engine.initialize(None, None, model_config=model_cfg)
 
     rng = np.random.default_rng(0)
     data = dict(
-        input_ids=rng.integers(1, 150000, size=(n_seqs, seqlen)).astype(np.int32),
+        input_ids=rng.integers(1, vocab - 2, size=(n_seqs, seqlen)).astype(np.int32),
         attention_mask=np.ones((n_seqs, seqlen), np.int32),
         loss_mask=np.ones((n_seqs, seqlen), np.int32),
     )
@@ -345,7 +379,8 @@ def sft_bench(
 
 
 def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
-                 new_tokens: int = 128, batch: int = 48, steps_per_call: int = 32):
+                 new_tokens: int = 128, batch: int = 48, steps_per_call: int = 32,
+                 vocab: int = 151936, max_seq_len: int = 512):
     """Continuous-batching decode throughput on the GenerationEngine.
 
     Decode is HBM-bound (every step re-reads the 3GB bf16 params), so
@@ -358,11 +393,11 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
     from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
     from areal_tpu.inference.engine import GenerationEngine
 
-    model_cfg = qwen2_1p5b_cfg(layers)
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
     eng = GenerationEngine(
         JaxGenConfig(
             max_batch_size=batch,
-            max_seq_len=512,
+            max_seq_len=max_seq_len,
             prefill_chunk=128,
             # long decode chains amortize per-dispatch latency (the bench
             # tunnel adds ~70ms RTT per host sync; real hosts ~none) at the
@@ -393,7 +428,7 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
         warm = threading.Event()
         eng.submit(
             "warm",
-            rng.integers(1, 150000, size=prompt_len).tolist(),
+            rng.integers(1, vocab - 2, size=prompt_len).tolist(),
             GenerationHyperparameters(
                 max_new_tokens=16, min_new_tokens=16, temperature=1.0
             ),
@@ -403,7 +438,7 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
 
         t0 = time.perf_counter()
         for i in range(n_requests):
-            prompt = rng.integers(1, 150000, size=prompt_len).tolist()
+            prompt = rng.integers(1, vocab - 2, size=prompt_len).tolist()
             eng.submit(f"bench-{i}", prompt, gconfig, cb)
         assert done.wait(1200), "decode bench timed out"
         dt = time.perf_counter() - t0
@@ -413,7 +448,8 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
         eng.stop()
 
 
-def weight_update_bench(layers: int = 28, chunk_mb: int = 512):
+def weight_update_bench(layers: int = 28, chunk_mb: int = 512,
+                        vocab: int = 151936):
     """Trainer->server weight-resync latency for the bench model (VERDICT
     r3 item 8): the /dev/shm same-host fast path vs HTTP safetensors
     streaming, both through the real server endpoints. The 'trainer' side
@@ -429,7 +465,7 @@ def weight_update_bench(layers: int = 28, chunk_mb: int = 512):
     from areal_tpu.inference.engine import GenerationEngine
     from areal_tpu.inference.server import GenerationServer
 
-    model_cfg = qwen2_1p5b_cfg(layers)
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
     eng = GenerationEngine(
         JaxGenConfig(
             max_batch_size=4, max_seq_len=512, prefill_chunk=128,
@@ -533,7 +569,7 @@ def main():
     # costs its own child, and a fully wedged tunnel still can't starve
     # the PRIMARY sft rung of wall budget
     kernel_deadline = min(deadline, time.time() + 900.0)
-    for kc in KERNEL_CONFIGS:
+    for kc in (KERNEL_CONFIGS_REHEARSAL if REHEARSAL else KERNEL_CONFIGS):
         cfg_timeout = min(
             480.0, remaining(kernel_deadline), remaining(deadline) - 120
         )
@@ -583,6 +619,15 @@ def main():
         dict(layers=14, opt_type="adamw", seqlen=2048, n_seqs=2),
         dict(layers=8, opt_type="adamw", seqlen=2048, n_seqs=2),
     ]
+    if REHEARSAL:
+        # same ladder shape (policy fallback preserved), CPU-sized
+        attempts = [
+            dict(layers=2, opt_type="adafactor", seqlen=512, n_seqs=2,
+                 mb_tokens=512, vocab=2048,
+                 remat_policy="dots_with_no_batch_dims_saveable"),
+            dict(layers=2, opt_type="adamw", seqlen=256, n_seqs=2,
+                 vocab=2048),
+        ]
     tps = mfu_v = None
     used = None
     i = 0
@@ -671,11 +716,17 @@ def main():
 
     # ---- rung 3: decode throughput ----
     decode_tps = None
-    for datt in [
+    decode_attempts = [
         dict(n_requests=320, batch=160, steps_per_call=64),
         dict(n_requests=192, batch=96, steps_per_call=64),
         dict(n_requests=64, batch=48, steps_per_call=32),
-    ]:
+    ]
+    if REHEARSAL:
+        decode_attempts = [
+            dict(n_requests=8, batch=4, steps_per_call=4, prompt_len=32,
+                 new_tokens=16, vocab=2048, max_seq_len=128),
+        ]
+    for datt in decode_attempts:
         if remaining(deadline) < 300:
             log("wall budget nearly spent; skipping decode")
             break
@@ -683,7 +734,8 @@ def main():
             log(f"decode attempt: {datt}")
             decode_tps = _run_child(
                 "decode",
-                dict(layers=(used or {"layers": 28})["layers"], **datt),
+                dict(layers=(used or {"layers": 2 if REHEARSAL else 28})
+                     ["layers"], **datt),
                 timeout=min(1800.0, remaining(deadline) - 60),
             )["tps"]
             emit({
@@ -704,7 +756,9 @@ def main():
             log("weight-update rung")
             wu = _run_child(
                 "wu",
-                dict(layers=(used or {"layers": 28})["layers"]),
+                dict(layers=(used or {"layers": 2 if REHEARSAL else 28})
+                     ["layers"],
+                     **({"vocab": 2048} if REHEARSAL else {})),
                 timeout=min(1200.0, remaining(deadline) - 60),
             )
             emit({
@@ -723,7 +777,8 @@ def main():
         try:
             log("grpo step rung")
             g = _run_child(
-                "grpo", {}, timeout=min(1800.0, remaining(deadline) - 60)
+                "grpo", {"smoke": True} if REHEARSAL else {},
+                timeout=min(1800.0, remaining(deadline) - 60)
             )
             emit({
                 "metric": "grpo_step_sec",
@@ -741,6 +796,8 @@ def main():
         # parseable line get the headline metric)
         if decode_tps is not None:
             primary["decode_tokens_per_sec"] = round(decode_tps, 1)
+        if REHEARSAL:
+            primary = {**primary, "rehearsal": True}
         print(json.dumps(primary), flush=True)
     else:
         raise RuntimeError("all sft bench configurations failed")

@@ -100,7 +100,7 @@ def grpo_step_bench(
         ),
         JaxGenConfig(
             max_batch_size=max(n_prompts * group_size, 8),
-            max_seq_len=prompt_len + new_tokens + 64,
+            max_seq_len=prompt_len + new_tokens + 64,  # engine page-aligns
             prefill_chunk=64 if smoke else 128,
             decode_steps_per_call=4 if smoke else 32,
             dtype="float32" if smoke else "bfloat16",

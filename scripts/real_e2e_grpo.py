@@ -25,7 +25,7 @@ emitting it; the recorded reward trend rising is the proof that
 reward -> advantage -> PPO -> weight push -> changed behavior works on
 this chip, not just that the plumbing runs.
 
-Writes docs/artifacts/e2e_real_r4.json. CPU smoke: --smoke (tiny shapes,
+Writes docs/artifacts/e2e_real_r5.json. CPU smoke: --smoke (tiny shapes,
 same code paths; used by tests/test_e2e_experiments.py).
 
 Run (live chip): python scripts/real_e2e_grpo.py
@@ -42,7 +42,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 MATH500 = "/root/reference/evaluation/data/math_500/test.jsonl"
-OUT = os.path.join(REPO, "docs", "artifacts", "e2e_real_r4.json")
+OUT = os.path.join(REPO, "docs", "artifacts", "e2e_real_r5.json")
 
 
 def qwen25_0p5b_cfg(vocab_size: int, layers: int | None = None):
@@ -156,7 +156,7 @@ def run_grpo_loop(
         ),
         JaxGenConfig(
             max_batch_size=max(n_prompts * group_size, 8),
-            max_seq_len=-(-(prompt_budget + new_tokens + 64) // 128) * 128,
+            max_seq_len=prompt_budget + new_tokens + 64,  # engine page-aligns
             prefill_chunk=64 if smoke else 256,
             decode_steps_per_call=4 if smoke else 32,
             dtype="float32" if smoke else "bfloat16",

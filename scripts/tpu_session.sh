@@ -24,5 +24,5 @@ echo "[tpu_session] real-scale e2e GRPO (part A: 0.5B body on MATH-500)"
 timeout 5400 python scripts/real_e2e_grpo.py --part a --steps 5 || true
 
 echo "[tpu_session] artifacts:"
-ls -la BENCH_PARTIAL.jsonl docs/artifacts/e2e_real_r4.json 2>/dev/null
+ls -la BENCH_PARTIAL.jsonl docs/artifacts/e2e_real_r5.json 2>/dev/null
 echo "[tpu_session] done"

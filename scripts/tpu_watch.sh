@@ -1,0 +1,41 @@
+#!/bin/bash
+# Continuous TPU-tunnel watcher (round 5). Probes the backend on a cadence
+# all session; at the FIRST live window it runs scripts/tpu_session.sh
+# (bench ladder + real-scale e2e) exactly once, then keeps probing so the
+# log proves tunnel state for the whole session either way.
+#
+# Probe protocol per the tunnel playbook: a killable subprocess with
+# `timeout 240` — backend init BLOCKS (never errors) when the tunnel is
+# wedged, and the claim can stay stuck for hours after a killed child.
+set -u
+cd "$(dirname "$0")/.."
+LOG=docs/artifacts/tpu_probe_r5.log
+# round-keyed and set ONLY on success: a failed session (tunnel wedged
+# between the watcher's probe and the session's own) retries at the next
+# live window instead of being permanently skipped, and a stale marker
+# from a previous round cannot suppress this round's measurement
+MARK=/tmp/areal_tpu_session_done_r5
+INTERVAL="${AREAL_PROBE_INTERVAL_S:-300}"
+
+echo "[watch $(date -u +%H:%M:%S)] watcher start (interval ${INTERVAL}s)" >> "$LOG"
+while true; do
+    T0=$(date +%s)
+    if timeout 240 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+        DT=$(( $(date +%s) - T0 ))
+        echo "[watch $(date -u +%H:%M:%S)] LIVE (probe ${DT}s)" >> "$LOG"
+        if [ ! -e "$MARK" ]; then
+            echo "[watch $(date -u +%H:%M:%S)] launching tpu_session.sh" >> "$LOG"
+            bash scripts/tpu_session.sh >> docs/artifacts/tpu_session_r5.log 2>&1
+            RC=$?
+            echo "[watch $(date -u +%H:%M:%S)] tpu_session.sh rc=$RC" >> "$LOG"
+            # success = the bench ladder left its primary record
+            if [ "$RC" -eq 0 ] && grep -q tokens BENCH_PARTIAL.jsonl 2>/dev/null; then
+                touch "$MARK"
+            fi
+        fi
+    else
+        DT=$(( $(date +%s) - T0 ))
+        echo "[watch $(date -u +%H:%M:%S)] wedged (probe blocked ${DT}s, rc!=0)" >> "$LOG"
+    fi
+    sleep "$INTERVAL"
+done

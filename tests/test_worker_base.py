@@ -58,7 +58,13 @@ def test_worker_lifecycle_and_group_requests():
     panel = WorkerControl(record_root="/t/workers")
 
     recs = panel.worker_records()
-    assert set(recs) == {"trainer.0", "trainer.1"}
+    # panel keys are the names the workers were CONSTRUCTED with (ADVICE r4:
+    # callers must not need to know the record-key '/'->'.' flattening)
+    assert set(recs) == {"trainer/0", "trainer/1"}
+
+    # addressing one worker by its constructed name works
+    one = panel.group_request("configure", names=["trainer/0"])
+    assert set(one) == {"trainer/0"}
 
     panel.group_request("configure")  # empty payload
     panel.group_request("start")
