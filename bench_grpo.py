@@ -183,7 +183,7 @@ def grpo_step_bench(
             "step_sec": round(async_step, 2),
             "sync_step_sec": round(sync_step, 2),
             "overlap_fraction": round(max(0.0, 1.0 - async_step / sync_step), 3),
-            "layers": layers,
+            "layers": model_cfg.num_hidden_layers,  # actual (smoke uses 2)
             "n_prompts": n_prompts,
             "group_size": group_size,
             "new_tokens": new_tokens,

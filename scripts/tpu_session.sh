@@ -4,7 +4,7 @@
 # SFT tokens/s + decode + weight-resync + GRPO step) first, then the
 # real-scale e2e GRPO evidence run. Every stage appends to its own
 # artifact so a mid-session wedge still leaves records.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "[tpu_session] probing backend..."
@@ -17,6 +17,7 @@ echo "[tpu_session] bench ladder (wall budget ${AREAL_BENCH_WALL_S:-5400}s)"
 AREAL_BENCH_WALL_S="${AREAL_BENCH_WALL_S:-5400}" \
     timeout "$(( ${AREAL_BENCH_WALL_S:-5400} + 300 ))" \
     python bench.py | tee /tmp/tpu_session_bench.json
+BENCH_RC=$?
 
 echo "[tpu_session] real-scale e2e GRPO (part B learning proof first — cheap)"
 timeout 2400 python scripts/real_e2e_grpo.py --part b --steps 24 || true
@@ -25,4 +26,7 @@ timeout 5400 python scripts/real_e2e_grpo.py --part a --steps 5 || true
 
 echo "[tpu_session] artifacts:"
 ls -la BENCH_PARTIAL.jsonl docs/artifacts/e2e_real_r5.json 2>/dev/null
-echo "[tpu_session] done"
+echo "[tpu_session] done (bench rc=$BENCH_RC)"
+# the session succeeded only if the bench ladder did — the e2e stages
+# leave their own artifacts and are advisory
+exit "$BENCH_RC"

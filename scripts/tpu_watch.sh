@@ -25,13 +25,20 @@ while true; do
         echo "[watch $(date -u +%H:%M:%S)] LIVE (probe ${DT}s)" >> "$LOG"
         if [ ! -e "$MARK" ]; then
             echo "[watch $(date -u +%H:%M:%S)] launching tpu_session.sh" >> "$LOG"
+            STAMP=$(mktemp)
             bash scripts/tpu_session.sh >> docs/artifacts/tpu_session_r5.log 2>&1
             RC=$?
             echo "[watch $(date -u +%H:%M:%S)] tpu_session.sh rc=$RC" >> "$LOG"
-            # success = the bench ladder left its primary record
-            if [ "$RC" -eq 0 ] && grep -q tokens BENCH_PARTIAL.jsonl 2>/dev/null; then
+            # success = THIS run (freshness vs STAMP, not a stale file from
+            # an earlier round) recorded the PRIMARY metric and the session
+            # script (which now propagates bench.py's rc) exited 0
+            if [ "$RC" -eq 0 ] \
+                && [ BENCH_PARTIAL.jsonl -nt "$STAMP" ] \
+                && grep -q '"metric": "sft_train_tokens_per_sec_per_chip_qwen2_1.5b"' \
+                    BENCH_PARTIAL.jsonl 2>/dev/null; then
                 touch "$MARK"
             fi
+            rm -f "$STAMP"
         fi
     else
         DT=$(( $(date +%s) - T0 ))
