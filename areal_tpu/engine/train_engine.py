@@ -878,16 +878,14 @@ class TPUTrainEngine(TrainEngine):
             acc_dtype = _DTYPES[backend.grad_acc_dtype]
             lora_cfg = self.config.lora
 
-            if backend.pp_schedule == "1f1b" and (
-                backend.vpp > 1 or cfg.is_vlm
-            ):
+            if backend.pp_schedule == "1f1b" and cfg.is_vlm:
                 logger.warning(
-                    "pp_schedule=1f1b supports neither vpp>1 nor vision "
-                    "towers; falling back to gpipe"
+                    "pp_schedule=1f1b does not support vision towers (the "
+                    "tower runs outside the gpipe conveyor); falling back "
+                    "to gpipe"
                 )
             elif (
                 backend.pp_schedule == "1f1b"
-                and lora_cfg is None
                 and token_loss_fn is not None
                 and (not cfg.is_critic or token_loss_fn.is_value)
             ):
@@ -895,26 +893,48 @@ class TPUTrainEngine(TrainEngine):
                     pipeline_train_step_1f1b,
                 )
 
-                def step_1f1b(params, mbs):
+                def run_1f1b(params, mbs):
                     return pipeline_train_step_1f1b(
                         params, cfg, mbs, mesh, token_loss_fn,
                         attn_spec=attn_spec,
                         remat=backend.remat,
                         remat_policy=backend.remat_policy,
                         acc_dtype=acc_dtype,
+                        vpp=backend.vpp,
                     )
 
-                self._jit_cache[key] = jax.jit(step_1f1b)
+                if lora_cfg is None:
+                    self._jit_cache[key] = jax.jit(run_1f1b)
+                else:
+                    from areal_tpu.models.lora import merge_lora
+
+                    def step_lora(lora, base, mbs):
+                        # the merge is LINEAR in the adapters, so pulling
+                        # the hand-rolled schedule's dL/dW_merged back
+                        # through one vjp of the merge gives exact
+                        # dL/dlora — LoRA rides 1F1B without the schedule
+                        # knowing adapters exist
+                        merged, pull = jax.vjp(
+                            lambda lo: merge_lora(base, lo, lora_cfg), lora
+                        )
+                        losses, g_merged = run_1f1b(merged, mbs)
+                        (g_lora,) = pull(jax.tree.map(
+                            lambda g, w: g.astype(w.dtype), g_merged, merged
+                        ))
+                        return losses, jax.tree.map(
+                            lambda g: g.astype(acc_dtype), g_lora
+                        )
+
+                    jitted = jax.jit(step_lora)
+                    self._jit_cache[key] = (
+                        lambda tr, mbs: jitted(tr, self.params, mbs)
+                    )
                 return self._jit_cache[key]
-            if (
-                backend.pp_schedule == "1f1b"
-                and backend.vpp == 1
-                and not cfg.is_vlm
-            ):
+            if backend.pp_schedule == "1f1b" and not cfg.is_vlm:
                 logger.warning(
                     "pp_schedule=1f1b needs the fused-loss contract "
-                    "(TokenLossFn; is_value=True for critics) and does not "
-                    "support LoRA; falling back to gpipe"
+                    "(TokenLossFn; is_value=True for critics); falling "
+                    "back to gpipe"
                 )
             elif backend.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(

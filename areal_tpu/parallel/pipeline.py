@@ -110,6 +110,34 @@ def stage_attn_spec(spec: AttnSpec | None, mesh: Mesh | None = None) -> AttnSpec
     return AttnSpec(impl="xla" if inner > 1 else impl, mesh=None, block=spec.block)
 
 
+def vstage_arrange(a, s: int, v: int, lc: int):
+    """[L, ...] -> [S, V, Lc, ...]: element [i, j] = virtual stage j*S + i
+    (the Megatron interleaved layout; with v == 1 a pure reshape). Stored
+    contiguously pp-sharded, so the strided assignment costs one weight
+    collective-permute per call (and its transpose in backward)."""
+    a2 = a.reshape(v, s, lc, *a.shape[1:])
+    return jnp.swapaxes(a2, 0, 1)
+
+
+def vstage_unarrange(a):
+    """Inverse of :func:`vstage_arrange`: [S, V, Lc, ...] -> [L, ...]."""
+    return jnp.swapaxes(a, 0, 1).reshape(-1, *a.shape[3:])
+
+
+def conveyor_decode(u, m: int, s: int, v: int):
+    """Group-injection conveyor algebra shared by the interleaved
+    schedules: microbatch ``g*S + r`` enters virtual stage 0 at tick
+    ``g*V*S + r``, hops one virtual stage per tick, and every device runs
+    exactly one chunk per tick (collision-free). Decodes unit ``u`` (ticks
+    since a device's first possible work) to
+    ``(microbatch, vchunk, in_range)``."""
+    sv = s * v
+    uc = jnp.clip(u, 0, m * v - 1)
+    g = uc // sv
+    w = uc % sv
+    return g * s + w % s, w // s, (u >= 0) & (u < m * v)
+
+
 def pipeline_hidden(
     params: dict,
     cfg: TransformerConfig,
@@ -203,37 +231,60 @@ def pipeline_train_step_1f1b(
     remat: bool = True,
     remat_policy: str = "nothing_saveable",
     acc_dtype=jnp.float32,
+    vpp: int = 1,
 ) -> tuple[jnp.ndarray, dict]:
     """One-forward-one-backward pipeline schedule: (losses [M], grads).
 
     The TPU-native 1F1B (reference: realhf static_schedule.py:1F1B +
-    pipe_runner.py instruction schedules). Unlike ``forward_packed_pipelined``
-    (GPipe + AD, which stores O(M) stage activations through the reverse
-    scan), this HAND-ROLLS forward and backward into ONE ``lax.scan`` of
-    ``M + 2S - 1`` ticks where every tick runs one stage-forward AND one
-    stage-backward (steady state), so live activation memory is the O(S)
-    ring buffer of stage inputs — the whole point of 1F1B. Backward
-    recomputes the stage forward from its stored input (full remat inside
+    pipe_runner.py instruction schedules), composable with ``vpp`` virtual
+    stages (the Megatron INTERLEAVED 1F1B,
+    reference areal/api/alloc_mode.py:216-241). Unlike
+    ``forward_packed_pipelined`` (GPipe + AD, which stores O(M) stage
+    activations through the reverse scan), this HAND-ROLLS forward and
+    backward into ONE ``lax.scan`` where every tick runs one chunk-forward
+    AND one chunk-backward (steady state), so live activation memory is the
+    O(S*V) ring buffer of chunk inputs — the whole point of 1F1B. Backward
+    recomputes the chunk forward from its stored input (full remat inside
     ``jax.vjp``).
 
-    Schedule (stage s, microbatch m): forward at tick ``m + s``, backward at
-    ``m + 2S - 1 - s``; messages ride one fwd ppermute and one bwd ppermute
-    per tick. The LM head + loss are NOT a serial last-stage epilogue: every
-    tick, the last stage's block output is psum-broadcast and each stage
+    Interleaved schedule: virtual stage ``vs = vchunk*S + stage`` (chunk
+    ``vchunk`` of device ``stage``, layers ``[vs*Lc, (vs+1)*Lc)``).
+    Microbatches inject in groups of S (microbatch ``g*S + r`` enters
+    virtual stage 0 at tick ``g*V*S + r`` — the same collision-free
+    group-injection conveyor as ``pipeline_hidden_interleaved``) and hop
+    one virtual stage per tick over a full-ring ``ppermute`` (the wrap edge
+    carries chunk transitions). The BACKWARD conveyor is the forward
+    conveyor mirrored in both device and chunk index
+    (``stage' = S-1-stage``, ``vchunk' = V-1-vchunk``) and offset by
+    ``V*S`` ticks — so backward of a microbatch starts right after its
+    forward drains, the bubble shrinks to ``(S-1)`` CHUNK-times at each
+    end, and the same algebra guarantees each device runs at most one
+    forward and one backward chunk per tick. Total ticks
+    ``M*V + V*S + S - 1`` (``M + 2S - 1`` at vpp=1, the plain 1F1B count).
+
+    Schedule (plain v=1 view — stage s, microbatch m): forward at tick
+    ``m + s``, backward at ``m + 2S - 1 - s``; messages ride one fwd
+    ppermute and one bwd ppermute per tick. The LM head + loss are NOT a
+    serial last-stage epilogue: the tick a microbatch exits its last
+    virtual stage, that block output is psum-broadcast and each device
     runs the head for its own 1/S token slice down to per-token
-    (logp, entropy) — the [T, V] logits never leave a stage — then the tiny
-    [T, 2] vectors psum together and the token loss runs over the FULL
-    stream (so losses that roll labels/masks internally stay exact; this is
-    the chunked fused-LM-head-loss pattern with chunk == stage slice). Head
-    FLOPs stay distributed over the pp group, like the GPipe path's
-    out-of-pipeline token-parallel head. The embedding lookup folds into
-    stage 0 (its weight gradient accumulates via scatter-add on the carry),
-    so no O(M) cotangent stack exists anywhere.
+    (logp, entropy) — the [T, V] logits never leave a device — then the
+    tiny [T, 2] vectors psum together and the token loss runs over the
+    FULL stream (so losses that roll labels/masks internally stay exact;
+    this is the chunked fused-LM-head-loss pattern with chunk == stage
+    slice). Head FLOPs stay distributed over the pp group, like the GPipe
+    path's out-of-pipeline token-parallel head. The embedding lookup folds
+    into virtual stage 0 (its weight gradient accumulates via scatter-add
+    on the carry), so no O(M) cotangent stack exists anywhere.
 
     Requires the fused-loss contract (``TokenLossFn`` — with
-    ``is_value=True`` the head/loss section swaps the LM head's
+    ``is_value=True`` the head/loss section swaps the LM head\'s
     (logp, entropy) for per-token values, which is how critics ride this
-    schedule). LoRA and VLM engines use the GPipe path. T must divide S.
+    schedule). VLM engines use the GPipe path (the vision tower runs
+    outside the conveyor there); LoRA rides this schedule via the engine\'s
+    vjp-of-merge wrapper. T must divide S. With vpp>1, M is padded up to a
+    multiple of S (padded lanes circulate but every loss/grad contribution
+    is validity-gated, so they change nothing).
     """
     from areal_tpu.models.lm import (
         _REMAT_POLICIES,
@@ -246,13 +297,35 @@ def pipeline_train_step_1f1b(
     )
 
     s = pp_size(mesh)
-    m, t = mbs["input_ids"].shape
+    v = int(vpp)
+    sv = s * v  # virtual stages
+    m_real, t = mbs["input_ids"].shape
     assert t % s == 0, (
         f"1f1b shards the head over pp: tokens {t} must divide pp {s}"
     )
     tl = t // s
-    k = 2 * s  # stage-input ring slots (live range is 2S-1-2s ticks)
-    steps = m + 2 * s - 1
+    if cfg.num_hidden_layers % sv != 0:
+        raise ValueError(
+            f"interleaved 1f1b needs num_hidden_layers "
+            f"({cfg.num_hidden_layers}) divisible by pp*vpp ({s}*{v})"
+        )
+    lc = cfg.num_hidden_layers // sv
+
+    # group injection is collision-free only for M % S == 0 (vpp>1): pad
+    # with lanes whose loss/grad contributions the validity gates drop
+    m = -(-m_real // s) * s if v > 1 else m_real
+    if m != m_real:
+        pad = m - m_real
+        mbs = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+            ),
+            mbs,
+        )
+
+    kk = 2 * s  # per-chunk stage-input ring slots (live range < 2SV ticks,
+    #             <= 2S in-flight microbatches per chunk, distinct mod 2S)
+    steps = m * v + sv + s - 1
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
     is_value = bool(getattr(token_loss_fn, "is_value", False))
@@ -276,13 +349,17 @@ def pipeline_train_step_1f1b(
     if cfg.pos_embed_type == "learned":
         pos_embed_w = params["pos_embed"]
 
-    def run_stage(layers_local, x, pos, seg):
+    layers_arr = jax.tree.map(
+        lambda a: vstage_arrange(a, s, v, lc), params["layers"]
+    )
+
+    def run_stage(chunk_layers, x, pos, seg):  # chunk_layers: [Lc, ...]
         def body(carry, lp):
             return _block(cfg, lp, carry, pos, seg, inner_spec), None
 
         if remat:
             body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
-        y, _ = jax.lax.scan(body, x, layers_local)
+        y, _ = jax.lax.scan(body, x, chunk_layers)
         return y
 
     def stage_fn(layers_local, ids_all, pos_all, seg_all, mbs_rep, head_w_l,
@@ -303,29 +380,44 @@ def pipeline_train_step_1f1b(
                 p_emb["pos_embed"] = pos_embed_l
             return _embed(p_emb, cfg, ids, pos)
 
+        def decode_unit(u):
+            return conveyor_decode(u, m, s, v)
+
+        def chunk_of(vc):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a[0], vc, 0, False),
+                layers_local,
+            )
+
         def tick(carry, tt):
             (fwd_msg, bwd_msg, xbuf, dybuf, loss_vec, g_lay, g_emb, g_nw,
              g_nb, g_hw, g_pos) = carry
 
-            # ---- forward ----
-            mf = tt - stage
-            f_valid = (mf >= 0) & (mf < m)
+            # ---- forward: chunk vf of this device, microbatch mf ----
+            mf, vf, f_in = decode_unit(tt - stage)
+            f_valid = f_in & (mf < m_real)
             mfc = jnp.clip(mf, 0, m - 1)
             ids_f = jax.lax.dynamic_index_in_dim(ids_all, mfc, 0, False)
             pos_f = jax.lax.dynamic_index_in_dim(pos_all, mfc, 0, False)
             seg_f = jax.lax.dynamic_index_in_dim(seg_all, mfc, 0, False)
-            x_in = jnp.where(is_first, embed_rows(ids_f, pos_f), fwd_msg)
-            # invalid ticks park their write in the scratch slot K
-            slot = jnp.where(f_valid, mfc % k, k)
-            xbuf = jax.lax.dynamic_update_index_in_dim(
-                xbuf, x_in, slot, 0
+            # virtual stage 0 injects a fresh microbatch; every other
+            # (device, chunk) consumes the ring carry (garbage during
+            # fill/drain rides through; its writes park in scratch)
+            fresh = is_first & (vf == 0)
+            x_in = jnp.where(fresh, embed_rows(ids_f, pos_f), fwd_msg)
+            # invalid ticks park their write in the scratch slot KK
+            slot = jnp.where(f_valid, mfc % kk, kk)
+            xbuf = jax.lax.dynamic_update_slice(
+                xbuf, x_in[None, None], (vf, slot, 0, 0)
             )
-            y = run_stage(layers_local, x_in, pos_f, seg_f)
+            y = run_stage(chunk_of(vf), x_in, pos_f, seg_f)
 
-            # ---- head + loss for the LAST stage's current microbatch,
-            #      token-sliced across ALL stages ----
-            ml = tt - (s - 1)
-            l_valid = (ml >= 0) & (ml < m)
+            # ---- head + loss the tick microbatch ml exits its LAST
+            #      virtual stage (device S-1, chunk V-1): enter + SV - 1.
+            #      decode(tt - (SV-1)) hits chunk 0 exactly at enters.
+            #      token-sliced across ALL devices ----
+            ml, vl, l_in = decode_unit(tt - (sv - 1))
+            l_valid = l_in & (vl == 0) & (ml < m_real)
             mlc = jnp.clip(ml, 0, m - 1)
             y_last = jax.lax.psum(jnp.where(is_last, y, 0.0), AXIS_PP)
             y_sl = jax.lax.dynamic_slice_in_dim(y_last, lo, tl, 0)
@@ -339,9 +431,9 @@ def pipeline_train_step_1f1b(
             )
             labels_sl = jax.lax.dynamic_slice_in_dim(labels_full, lo, tl, 0)
 
-            # head for THIS stage's token slice -> per-token (logp, entropy)
-            # (or [value, 0] for critics) only — no [T, V] logits ever
-            # cross stages; the token loss then runs over the
+            # head for THIS device's token slice -> per-token (logp,
+            # entropy) (or [value, 0] for critics) only — no [T, V] logits
+            # ever cross devices; the token loss then runs over the
             # psum-assembled FULL [T] vectors with the FULL microbatch row,
             # so losses that roll labels/masks internally stay exact (the
             # chunked fused-LM-head-loss pattern, models/lm.forward_fused_
@@ -397,7 +489,7 @@ def pipeline_train_step_1f1b(
                 ),
                 AXIS_PP,
             )
-            # every stage computed the (cheap) full token loss redundantly;
+            # every device computed the (cheap) full token loss redundantly;
             # count it once — the end-of-scan psum over pp restores the total
             loss_vec = loss_vec.at[mlc].add(
                 jnp.where(l_valid & is_first, loss_part, 0.0)
@@ -411,29 +503,39 @@ def pipeline_train_step_1f1b(
                 dybuf, dy_full.astype(y.dtype), dyslot, 0
             )
 
-            # ---- backward ----
-            mb_ = tt - (2 * s - 1 - stage)
-            b_valid = (mb_ >= 0) & (mb_ < m)
+            # ---- backward: the mirror conveyor (device S-1-stage, chunk
+            #      V-1-vchunk run the forward algebra), offset SV ticks ----
+            mb_, vcm, b_in = decode_unit(tt - sv - (s - 1 - stage))
+            vb = v - 1 - vcm  # this device's chunk being back-propagated
+            b_valid = b_in & (mb_ < m_real)
             mbc = jnp.clip(mb_, 0, m - 1)
             ids_b = jax.lax.dynamic_index_in_dim(ids_all, mbc, 0, False)
             pos_b = jax.lax.dynamic_index_in_dim(pos_all, mbc, 0, False)
             seg_b = jax.lax.dynamic_index_in_dim(seg_all, mbc, 0, False)
+            # the LAST virtual stage (device S-1, chunk V-1 <=> mirror
+            # chunk 0) seeds from the head's dy; everyone else from the ring
+            last_unit = is_last & (vcm == 0)
             dy_in = jnp.where(
-                is_last,
+                last_unit,
                 jax.lax.dynamic_index_in_dim(dybuf, mbc % 2, 0, False),
                 bwd_msg,
             )
-            x_st = jax.lax.dynamic_index_in_dim(xbuf, mbc % k, 0, False)
+            x_st = jax.lax.dynamic_slice(
+                xbuf, (vb, mbc % kk, 0, 0), (1, 1, t, h)
+            )[0, 0]
             _, pull2 = jax.vjp(
-                lambda L, x: run_stage(L, x, pos_b, seg_b), layers_local, x_st
+                lambda L, x: run_stage(L, x, pos_b, seg_b), chunk_of(vb), x_st
             )
             dlay, dx = pull2(dy_in)
             g_lay = jax.tree.map(
-                lambda a, d: a + jnp.where(b_valid, d.astype(acc_dtype), 0.0),
+                lambda a, d: a.at[0, vb].add(
+                    jnp.where(b_valid, d.astype(acc_dtype), 0.0)
+                ),
                 g_lay, dlay,
             )
+            # virtual stage 0's dx is the embedding cotangent
             dx_rows = jnp.where(
-                b_valid & is_first, dx.astype(acc_dtype), 0.0
+                b_valid & is_first & (vb == 0), dx.astype(acc_dtype), 0.0
             )
             demb_rows = dx_rows
             if cfg.scale_embeddings:
@@ -444,12 +546,14 @@ def pipeline_train_step_1f1b(
                 # cotangent is the unscaled dx
                 g_pos = g_pos.at[pos_b].add(dx_rows)
 
-            # ---- messages for the next tick ----
+            # ---- messages for the next tick (full ring: the wrap edges
+            #      carry chunk transitions; with v=1 the wrapped message is
+            #      never consumed, same as the old open-chain permute) ----
             fwd_nxt = jax.lax.ppermute(
-                y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
+                y, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
             )
             bwd_nxt = jax.lax.ppermute(
-                dx, AXIS_PP, [(i + 1, i) for i in range(s - 1)]
+                dx, AXIS_PP, [(i, (i - 1) % s) for i in range(s)]
             )
             return (
                 fwd_nxt, bwd_nxt, xbuf, dybuf, loss_vec, g_lay, g_emb,
@@ -460,7 +564,7 @@ def pipeline_train_step_1f1b(
         carry0 = (
             jnp.zeros((t, h), xdtype),
             jnp.zeros((t, h), xdtype),
-            jnp.zeros((k + 1, t, h), xdtype),
+            jnp.zeros((v, kk + 1, t, h), xdtype),
             jnp.zeros((3, t, h), xdtype),
             jnp.zeros((m,), jnp.float32),
             jax.tree.map(
@@ -477,8 +581,8 @@ def pipeline_train_step_1f1b(
         (
             _, _, _, _, loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos
         ) = jax.lax.scan(tick, carry0, jnp.arange(steps))[0]
-        # token-sliced / stage-local accumulators -> global sums (g_lay stays
-        # per-stage: it matches the pp-sharded layer stack)
+        # token-sliced / device-local accumulators -> global sums (g_lay
+        # stays per-device: it matches the pp-sharded chunk stack)
         loss_vec = jax.lax.psum(loss_vec, AXIS_PP)
         g_emb = jax.lax.psum(g_emb, AXIS_PP)
         g_nw = jax.lax.psum(g_nw, AXIS_PP)
@@ -497,14 +601,14 @@ def pipeline_train_step_1f1b(
         axis_names=frozenset({AXIS_PP}),
         check_vma=False,
     )(
-        params["layers"], mbs["input_ids"], mbs["positions"],
+        layers_arr, mbs["input_ids"], mbs["positions"],
         mbs["segment_ids"], mbs, head_w, params["final_norm"], norm_b,
         params["embed"], pos_embed_w,
     )
 
     grads = {
         "embed": g_emb,
-        "layers": g_lay,
+        "layers": jax.tree.map(vstage_unarrange, g_lay),
         "final_norm": g_nw,
     }
     if norm_b is not None:
@@ -517,7 +621,7 @@ def pipeline_train_step_1f1b(
         grads["embed"] = grads["embed"] + g_hw.T
     else:
         grads["lm_head"] = g_hw
-    return loss_vec, grads
+    return loss_vec[:m_real], grads
 
 
 def _stage_ticks(s: int, stage, work, operands, collect_last: bool):
@@ -877,18 +981,12 @@ def pipeline_hidden_interleaved(
         segment_ids = jnp.concatenate(
             [segment_ids, jnp.zeros((pad, t_len), segment_ids.dtype)]
         )
-    vs = v * s
     steps = m * v + s - 1
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
-    # [L, ...] -> [S, V, Lc, ...]: element [i, vk] = virtual stage vk*S + i.
-    # reshape [V, S, Lc] is free; the axis swap under the pp in_spec is the
-    # one weight collective-permute named in the docstring.
-    def arrange(a):
-        a2 = a.reshape(v, s, lc, *a.shape[1:])
-        return jnp.swapaxes(a2, 0, 1)
-
-    layers_arr = jax.tree.map(arrange, params["layers"])
+    layers_arr = jax.tree.map(
+        lambda a: vstage_arrange(a, s, v, lc), params["layers"]
+    )
 
     def run_chunk(chunk_layers, x, pos, seg):
         def body(carry, lp):
@@ -905,13 +1003,7 @@ def pipeline_hidden_interleaved(
 
         def tick(carry, tt):
             x_carry, out = carry
-            u = tt - stage
-            uc = jnp.clip(u, 0, m * v - 1)
-            g = uc // vs
-            w = uc % vs
-            vchunk = w // s
-            r = w % s
-            mb = g * s + r
+            mb, vchunk, in_range = conveyor_decode(tt - stage, m, s, v)
             # stage 0 / chunk 0 injects a fresh microbatch; every other
             # (stage, chunk) consumes the ring carry (garbage during
             # fill/drain rides through and is never collected)
@@ -927,9 +1019,7 @@ def pipeline_hidden_interleaved(
             y = run_chunk(chunk_layers, x_in, pos, seg)
             # microbatch mb exits its last virtual stage on device S-1 at
             # chunk V-1; park every other tick's write in scratch row M
-            is_out = (stage == s - 1) & (vchunk == v - 1) & (u >= 0) & (
-                u < m * v
-            )
+            is_out = (stage == s - 1) & (vchunk == v - 1) & in_range
             slot = jnp.where(is_out, mb, m)
             out = jax.lax.dynamic_update_index_in_dim(out, y, slot, 0)
             nxt = jax.lax.ppermute(

@@ -385,17 +385,23 @@ def _tok_ce(logp, ent, mb):
     return -jnp.sum(logp * lm)
 
 
-@pytest.mark.parametrize("strategy,m", [
-    (ParallelStrategy(pp=4), 8),   # the verdict's d1t1p4 / M=8 case
-    (ParallelStrategy(pp=2), 3),   # M < 2S exercises fill/drain masking
+@pytest.mark.parametrize("strategy,m,vpp,layers", [
+    (ParallelStrategy(pp=4), 8, 1, 4),   # the verdict's d1t1p4 / M=8 case
+    (ParallelStrategy(pp=2), 3, 1, 4),   # M < 2S exercises fill/drain masking
+    # interleaved (Megatron vpp x 1F1B, VERDICT r4 #5): mirror-conveyor
+    # backward, chunk-indexed grads, full-ring ppermutes
+    (ParallelStrategy(pp=2), 4, 2, 4),
+    (ParallelStrategy(pp=2), 3, 2, 4),   # M % S != 0: padded-lane masking
+    (ParallelStrategy(pp=4), 8, 2, 8),
+    (ParallelStrategy(pp=2), 5, 4, 8),   # deep interleave, padded M
 ])
-def test_1f1b_matches_plain_losses_and_grads(strategy, m):
+def test_1f1b_matches_plain_losses_and_grads(strategy, m, vpp, layers):
     from areal_tpu.engine.train_engine import TokenLossFn
     from areal_tpu.parallel.pipeline import pipeline_train_step_1f1b
     from areal_tpu.utils.functional import gather_logprobs
 
     tok = TokenLossFn(fn=_tok_ce)
-    cfg = tiny_config(num_hidden_layers=4)
+    cfg = tiny_config(num_hidden_layers=layers)
     mesh = make_mesh(strategy)
     params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
     params_pp = jax.device_put(
@@ -411,7 +417,7 @@ def test_1f1b_matches_plain_losses_and_grads(strategy, m):
 
     losses, grads = jax.jit(
         lambda p, mb: pipeline_train_step_1f1b(
-            p, cfg, mb, mesh, tok, remat=True
+            p, cfg, mb, mesh, tok, remat=True, vpp=vpp
         )
     )(params_pp, mbs)
 
@@ -481,6 +487,90 @@ def test_engine_train_batch_1f1b_matches_pp1():
             eng_1.destroy()
         if eng_pp is not None:
             eng_pp.destroy()
+
+
+@pytest.mark.slow
+def test_engine_train_batch_1f1b_vpp2_matches_pp1():
+    """Interleaved 1F1B through the full engine step (VERDICT r4 #5): the
+    vpp=2 mirror-conveyor schedule must track the plain engine."""
+    eng_pp = None
+    eng_1 = None
+    try:
+        eng_1 = _make_engine(ParallelStrategy(dp=1), seed=11)
+        cfgo = _cfg()
+        cfgo.backend.pp_schedule = "1f1b"
+        cfgo.backend.vpp = 2
+        eng_pp = TPULMEngine(cfgo)
+        eng_pp.create_process_group(ParallelStrategy(pp=2))
+        eng_pp.initialize(
+            None,
+            FinetuneSpec(
+                total_train_epochs=1, dataset_size=64, train_batch_size=6
+            ),
+            model_config=tiny_config(num_hidden_layers=4),
+            seed=11,
+        )
+        data = _batch()
+        for _ in range(2):
+            s1 = eng_1.train_lm(data)
+            sp = eng_pp.train_lm(data)
+        np.testing.assert_allclose(sp["loss"], s1["loss"], rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(eng_pp.params["embed"]),
+            np.asarray(eng_1.params["embed"]),
+            rtol=2e-3, atol=1e-5,
+        )
+    finally:
+        if eng_1 is not None:
+            eng_1.destroy()
+        if eng_pp is not None:
+            eng_pp.destroy()
+
+
+def test_engine_1f1b_lora_matches_gpipe_lora():
+    """LoRA under 1F1B (the vjp-of-merge wrapper, VERDICT r4 #5 'lift the
+    LoRA exclusion'): adapter-only training must track the gpipe LoRA
+    path, and the base must stay frozen."""
+    from areal_tpu.api.cli_args import LoRAConfig
+
+    data = _batch(seed=4)
+    eng_g = None
+    eng_f = None
+    try:
+        eng_g = _make_engine(
+            ParallelStrategy(pp=2, dp=2), seed=11,
+            lora=LoRAConfig(rank=4, alpha=8.0),
+        )
+        cfgo = _cfg(lora=LoRAConfig(rank=4, alpha=8.0))
+        cfgo.backend.pp_schedule = "1f1b"
+        eng_f = TPULMEngine(cfgo)
+        eng_f.create_process_group(ParallelStrategy(pp=2, dp=2))
+        eng_f.initialize(
+            None,
+            FinetuneSpec(
+                total_train_epochs=1, dataset_size=64, train_batch_size=6
+            ),
+            model_config=tiny_config(num_hidden_layers=4),
+            seed=11,
+        )
+        base_before = jax.tree.map(lambda x: np.asarray(x), eng_f.params)
+        losses_g = [eng_g.train_lm(data)["loss"] for _ in range(3)]
+        losses_f = [eng_f.train_lm(data)["loss"] for _ in range(3)]
+        np.testing.assert_allclose(losses_f, losses_g, rtol=2e-4, atol=2e-4)
+        assert losses_f[-1] < losses_f[0], losses_f
+        for a, b in zip(
+            jax.tree_util.tree_leaves(base_before),
+            jax.tree_util.tree_leaves(
+                jax.tree.map(lambda x: np.asarray(x), eng_f.params)
+            ),
+        ):
+            np.testing.assert_array_equal(a, b)
+        assert eng_f.lora_params is not None
+    finally:
+        if eng_g is not None:
+            eng_g.destroy()
+        if eng_f is not None:
+            eng_f.destroy()
 
 
 def test_1f1b_critic_matches_plain_losses_and_grads():
