@@ -135,13 +135,6 @@ class GenerationEngine:
                     f"pp_size={pp} must divide num_hidden_layers="
                     f"{model_config.num_hidden_layers}"
                 )
-            if model_config.is_vlm:
-                raise NotImplementedError(
-                    "pp serving with a vision tower is not supported: the "
-                    "prefill/decode stage conveyors have no image-splice "
-                    "step (training-side pp DOES support VLM — the tower "
-                    "runs outside the conveyor there)"
-                )
             if config.max_batch_size % pp:
                 # batch-group rotation (decode_rotated_pp) needs the decode
                 # bucket divisible by pp; round the slot count up so the
@@ -422,6 +415,7 @@ class GenerationEngine:
                 params, self.model_config, cache, ids, positions,
                 segment_ids, last_idx, token_blocks, token_offsets,
                 self.mesh, attn_spec=self.attn_spec, positions3=positions3,
+                pixel_values=pixels, image_grid_thw=image_grid_thw,
             )
         else:
             logits, ks, vs = prefill_stream(
@@ -495,15 +489,14 @@ class GenerationEngine:
         steps: int,
     ):
         if self._pp > 1 and last_tokens.shape[0] % self._pp == 0:
-            # batch-group rotation: S stages busy every tick instead of
-            # one (pp serving excludes VLM, so pos_delta is always zero
-            # here and the rotated path can ignore it)
+            # batch-group rotation: S stages busy every tick instead of one
             from areal_tpu.parallel.pipeline import decode_rotated_pp
 
             return decode_rotated_pp(
                 params, self.model_config, cache, last_tokens, cache_len,
                 block_table, active, self.mesh, rng, temp, top_k, top_p,
                 greedy, steps, attn_spec=self.attn_spec,
+                pos_offset=pos_delta,
             )
 
         def step(carry, step_rng):

@@ -669,24 +669,34 @@ def prefill_stream_pp(
     mesh: Mesh,
     attn_spec: AttnSpec | None = None,
     positions3: jnp.ndarray | None = None,
+    pixel_values: jnp.ndarray | None = None,  # [Nimg, S, S, 3] / [P, pd]
+    image_grid_thw: tuple | None = None,  # qwen2_vl static grids
 ) -> tuple[jnp.ndarray, dict]:
     """Serving prefill with the layer stack sharded over pipeline stages
     (the pipelined-generation role of realhf pipe_runner.py:375-648): the
     packed stream passes through the S stages sequentially; each stage
     scatters its local layers' K/V into its slice of the paged pool.
 
+    VLM prompts ride this path too: the vision tower + placeholder splice
+    run OUTSIDE the stage ring (``embed_with_images``, GSPMD-auto over the
+    whole mesh) — the same tower-outside-the-conveyor design as the
+    training-side ``forward_packed_pipelined(pixel_values=...)`` — so only
+    the already-spliced hidden stream enters the conveyor.
+
     Returns (last-token logits [N, V] fp32, updated pool).
     """
     from areal_tpu.models.lm import (
-        _embed,
         _norm,
         _pool_write,
         _prefill_stream_layer,
+        embed_with_images,
     )
 
     s = pp_size(mesh)
     rope_pos = positions3 if positions3 is not None else positions
-    x0 = _embed(params, cfg, input_ids, positions)
+    x0 = embed_with_images(
+        params, cfg, input_ids, positions, pixel_values, image_grid_thw
+    )
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
     def stage_fn(layers_local, pool, x_in):
@@ -1070,6 +1080,7 @@ def decode_rotated_pp(
     greedy: jnp.ndarray,  # [B]
     steps: int,
     attn_spec: AttnSpec | None = None,
+    pos_offset: jnp.ndarray | None = None,  # [B] qwen2_vl M-RoPE deltas
 ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
     """Batch-group-rotated pipelined decode: S× the conveyor's throughput.
 
@@ -1130,6 +1141,10 @@ def decode_rotated_pp(
             toks_g = jax.lax.dynamic_slice(toks_all, (lo,), (g_sz,))
 
             write_pos = clen_g[:, None]  # [G, 1]
+            rope_pos = write_pos
+            if pos_offset is not None:
+                off_g = jax.lax.dynamic_slice(pos_offset, (lo,), (g_sz,))
+                rope_pos = rope_pos + off_g[:, None]
             li = jnp.clip(write_pos // bs_, 0, nbt - 1)
             phys = jnp.take_along_axis(tbl_g, li, axis=1)
             # fill/drain ticks clip u to REAL (group, token) coordinates —
@@ -1147,13 +1162,13 @@ def decode_rotated_pp(
             # other (stage, token) consumes the ring carry (for stage 0,
             # k>0 that carry IS the freshly sampled token's embedding,
             # placed there by the exit stage last tick)
-            emb0 = _embed(params, cfg, toks_g[:, None], write_pos)
+            emb0 = _embed(params, cfg, toks_g[:, None], rope_pos)
             x_in = jnp.where((stage == 0) & (k == 0), emb0, msg)
 
             def body(c, layer_in):
                 lp, pool_layer = layer_in
                 out, pool_layer = _decode_paged_layer(
-                    cfg, lp, pool_layer, c, write_pos,
+                    cfg, lp, pool_layer, c, rope_pos,
                     phys.reshape(-1), (write_pos % bs_).reshape(-1),
                     gather_ids, clen_g + 1, inner_spec,
                 )
@@ -1173,7 +1188,7 @@ def decode_rotated_pp(
                     jax.lax.dynamic_slice(greedy, (lo,), (g_sz,)),
                 )
                 nxt = jnp.where(act_g, nxt, toks_g)
-                emb_nxt = _embed(params, cfg, nxt[:, None], write_pos + 1)
+                emb_nxt = _embed(params, cfg, nxt[:, None], rope_pos + 1)
                 return nxt, logp, emb_nxt.astype(y_.dtype)
 
             def skip_fn(y_):

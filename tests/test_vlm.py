@@ -830,3 +830,102 @@ def test_qwen2vl_train_pp_matches_single_mesh(tiny_hf_qwen2vl):
     np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4, atol=2e-4)
     eng_pp.destroy()
     eng_1.destroy()
+
+
+# ---------------------------------------------------------------------------
+# VLM serving under pipeline parallelism (VERDICT r4 #6): the vision tower
+# + placeholder splice run OUTSIDE the stage ring (prefill_stream_pp), the
+# same design as training-side pp; M-RoPE decode deltas ride the rotated
+# decode conveyor.
+# ---------------------------------------------------------------------------
+
+
+def _drive_generate(eng, reqs, max_new=6, max_iters=500):
+    """Inline engine loop (no thread): {rid: (tokens, logprobs)}."""
+    results: dict = {}
+    for rid, ids, img in reqs:
+        eng.submit(
+            rid, list(map(int, ids)),
+            GenerationHyperparameters(
+                max_new_tokens=max_new, min_new_tokens=max_new, greedy=True
+            ),
+            lambda r, rid=rid: results.__setitem__(
+                rid, (r.output_tokens, r.output_logprobs)
+            ),
+            image_data=img,
+        )
+    it = 0
+    while len(results) < len(reqs):
+        eng._handle_aborts()
+        eng._admit()
+        if eng.n_running:
+            eng._decode_chunk()
+        it += 1
+        assert it < max_iters, "engine made no progress"
+    return results
+
+
+def test_vlm_serving_pp_matches_single_device():
+    """pp=2 VLM generate (image + text mixed burst) == single-device."""
+    cfg = vlm_cfg(num_hidden_layers=4)
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    rng = np.random.default_rng(3)
+    img = rng.uniform(0, 1, (16, 16, 3)).astype(np.float32)
+    reqs = [
+        ("img", [IMG_TOK] * 4 + [5, 9, 12, 3], [img]),
+        ("txt", [7, 8, 22, 9, 4], None),
+        ("img2", [IMG_TOK] * 4 + [11, 2], [img]),
+    ]
+    outs = {}
+    for tag, pp in (("d1", 1), ("pp2", 2)):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=4, max_seq_len=128, prefill_chunk=32,
+                decode_steps_per_call=2, page_size=16, dtype="float32",
+                pp_size=pp,
+            ),
+            model_config=cfg, params=params,
+        )
+        outs[tag] = _drive_generate(eng, reqs)
+    for rid in ("img", "txt", "img2"):
+        assert outs["d1"][rid][0] == outs["pp2"][rid][0], rid
+        np.testing.assert_allclose(
+            outs["d1"][rid][1], outs["pp2"][rid][1],
+            rtol=1e-5, atol=1e-6, err_msg=rid,
+        )
+
+
+def test_qwen2vl_serving_pp_matches_single_device(tiny_hf_qwen2vl):
+    """qwen2_vl under pp=2 serving: HF-processor image payload, M-RoPE
+    prefill positions AND the per-slot decode delta must survive both the
+    sequential prefill conveyor and the rotated decode."""
+    from areal_tpu.models import hf_io
+
+    model_dir, _ = tiny_hf_qwen2vl
+    cfg, params = hf_io.load_hf_params(model_dir, dtype="float32")
+    ids, pixels, grid = _vlm_inputs(seed=11)
+    reqs = [
+        ("vg", ids, [{"pixel_values": pixels, "grid_thw": list(grid)}]),
+        ("txt", [5, 9, 118, 119, 7, 3], None),
+    ]
+    outs = {}
+    for tag, pp in (("d1", 1), ("pp2", 2)):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=2, max_seq_len=128, prefill_chunk=32,
+                decode_steps_per_call=2, dtype="float32", page_size=16,
+                pp_size=pp,
+            ),
+            model_config=cfg, params=params,
+        )
+        outs[tag] = _drive_generate(eng, reqs)
+        # image prompts produce a NEGATIVE M-RoPE decode delta (4
+        # placeholder rows span 2 rope steps); it must be applied under
+        # pp too, not just recorded
+        assert int(eng.pos_delta.min()) < 0, tag
+    for rid in ("vg", "txt"):
+        assert outs["d1"][rid][0] == outs["pp2"][rid][0], rid
+        np.testing.assert_allclose(
+            outs["d1"][rid][1], outs["pp2"][rid][1],
+            rtol=1e-5, atol=1e-6, err_msg=rid,
+        )
