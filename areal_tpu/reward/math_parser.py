@@ -55,8 +55,13 @@ def _extract_boxed(text: str) -> str | None:
     return out
 
 
-def extract_answer(text: str) -> str | None:
-    """Model-output answer extraction, most-specific marker first."""
+def extract_answer(text: str, number_fallback: bool = True) -> str | None:
+    """Model-output answer extraction, most-specific marker first.
+
+    ``number_fallback=False`` restricts to explicit markers — used for
+    GOLD strings, where the last-number fallback would mangle a bare
+    expression answer like ``\\frac{14}{3}`` into ``3`` (caught by the
+    MATH-500 gold round-trip corpus, tests/test_math_parser.py)."""
     if not text:
         return None
     m = _MINERVA_RE.findall(text)
@@ -75,9 +80,10 @@ def extract_answer(text: str) -> str | None:
         # 3.5 carry no space after the dot and survive)
         ans = re.split(r"\.\s", m[-1].strip(), maxsplit=1)[0]
         return ans.strip().rstrip(".").strip()
-    nums = _NUMBER_RE.findall(text.replace(",", ""))
-    if nums:
-        return nums[-1]
+    if number_fallback:
+        nums = _NUMBER_RE.findall(text.replace(",", ""))
+        if nums:
+            return nums[-1]
     return None
 
 
@@ -353,6 +359,18 @@ def math_equal(
     pn, gn = _to_number(p), _to_number(g)
     if pn is not None and gn is not None:
         golds = [gn / 100, gn, gn * 100] if include_percentage else [gn]
+        if re.fullmatch(r"-?\d+", p) and re.fullmatch(r"-?\d+", g):
+            # two integer strings: arbitrary-precision equality (floats
+            # collapse above 2^53), percentage triple in int space
+            ip, ig = int(p), int(g)
+            if include_percentage:
+                return ip == ig or ip * 100 == ig or ip == ig * 100
+            return ip == ig
+        if float(gn).is_integer() or float(pn).is_integer():
+            # an integer-valued side demands exactness: the reference's
+            # blanket rel-tol 1e-4 accepts 13536 AND 13535.5 for a gold
+            # of 13535 (caught by the perturbed-MATH-500 probe)
+            return any(float(pn) == float(gv) for gv in golds)
         return any(_numeric_equal(pn, gv) for gv in golds)
     if (pn is None) != (gn is None):
         # one side is a plain number, the other symbolic (2\pi vs 6.2832):
@@ -429,11 +447,19 @@ def math_equal(
 # ---------------------------------------------------------------------------
 
 
+def _extract_marked(text: str) -> str | None:
+    """Marker-only extraction for GOLD strings (no last-number fallback)."""
+    return extract_answer(text, number_fallback=False)
+
+
 def process_results(completion: str, gold: str) -> int:
     """1 if the completion's extracted answer matches gold (reference
-    math_parser.process_results semantics)."""
+    math_parser.process_results semantics). Gold may be a bare answer
+    (MATH-style) or a full solution with markers (gsm8k '#### x')."""
     pred = extract_answer(completion)
-    gold_ans = extract_answer(gold) or gold
+    gold_ans = _extract_marked(gold)
+    if gold_ans is None:
+        gold_ans = gold
     return int(math_equal(pred, gold_ans))
 
 

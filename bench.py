@@ -529,9 +529,35 @@ def weight_update_bench(layers: int = 28, chunk_mb: int = 512,
         total_mb = _total_bytes(shapes) / 1e6
         shm_lat = client.update_weights_from_shm(chunks(), next_version=1)
         http_lat = client.update_weights_from_tensors(chunks(), next_version=2)
+
+        # disk path: trainer saves an HF safetensors checkpoint, servers
+        # reload it via /update_weights_from_disk (the reference's slowest
+        # but most portable resync; latency = save + fanned-out load)
+        import shutil
+        import tempfile
+
+        from areal_tpu.api.io_struct import WeightUpdateMeta
+        from areal_tpu.models import hf_io
+
+        ckpt_dir = tempfile.mkdtemp(prefix="wu_disk_")
+        try:
+            t0 = time.perf_counter()
+            hf_io.save_hf_params(eng.params, model_cfg, ckpt_dir)
+            save_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            client.update_weights(
+                WeightUpdateMeta(type="disk", path=ckpt_dir)
+            )
+            load_s = time.perf_counter() - t0
+            disk_lat = save_s + load_s
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
         return {
             "shm_sec": round(shm_lat, 3),
             "http_sec": round(http_lat, 3),
+            "disk_sec": round(disk_lat, 3),
+            "disk_save_sec": round(save_s, 3),
+            "disk_load_sec": round(load_s, 3),
             "payload_mb_fp32": round(total_mb, 1),
             "layers": layers,
         }

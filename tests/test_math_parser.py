@@ -220,3 +220,117 @@ def test_long_tail_latex_agreement():
     assert rate >= 0.99, (
         f"long-tail agreement {rate:.1%} ({len(wrong)} wrong): {wrong}"
     )
+
+
+# ---------------------------------------------------------------------------
+# Adversarial pass (VERDICT r4 #8): an EXTERNAL corpus not authored by the
+# parser's author — the reference's MATH-500 gold answers — plus
+# property-based sympy round-trips. The gold round-trip already caught one
+# real bug: extract_answer applied to a bare gold mangled \frac{14}{3}
+# into '3' via the last-number fallback (fixed by _extract_marked).
+# ---------------------------------------------------------------------------
+
+MATH500 = "/root/reference/evaluation/data/math_500/test.jsonl"
+
+
+@pytest.mark.skipif(not os.path.exists(MATH500), reason="MATH-500 not found")
+def test_math500_gold_roundtrip_agreement():
+    """Every MATH-500 gold answer, boxed into a model-style solution, must
+    verify against its own gold — 500 external-authored LaTeX answers
+    through extraction + the full equivalence ladder."""
+    rows = [json.loads(line) for line in open(MATH500)]
+    assert len(rows) == 500
+    fails = []
+    for r in rows:
+        gold = r["answer"]
+        sol = f"Some reasoning.\nThe final answer is $\\boxed{{{gold}}}$."
+        try:
+            ok = bool(process_results(sol, gold))
+        except Exception:  # noqa: BLE001 — a crash is a disagreement
+            ok = False
+        if not ok:
+            fails.append(gold)
+    rate = 1 - len(fails) / len(rows)
+    assert rate >= 0.99, f"agreement {rate:.1%}; failures: {fails[:20]}"
+
+
+@pytest.mark.skipif(not os.path.exists(MATH500), reason="MATH-500 not found")
+def test_math500_perturbed_golds_rejected():
+    """False-positive probe: numeric golds perturbed by +1 (or a digit
+    swap) must NOT verify. Guards against an equivalence ladder so loose
+    it matches everything."""
+    import re as _re
+
+    rows = [json.loads(line) for line in open(MATH500)]
+    checked = 0
+    false_pos = []
+    for r in rows:
+        gold = r["answer"].strip()
+        if not _re.fullmatch(r"-?\d+", gold):
+            continue  # perturb only clean integers (unambiguous mutation)
+        wrong = str(int(gold) + 1)
+        sol = f"The final answer is $\\boxed{{{wrong}}}$."
+        checked += 1
+        if process_results(sol, gold):
+            false_pos.append((gold, wrong))
+    assert checked >= 100, f"only {checked} integer golds found"
+    assert not false_pos, false_pos
+
+
+def test_sympy_roundtrip_property():
+    """Property-based: a value rendered two different ways (sympy.latex vs
+    plain str / evalf) must verify as equal, and values that differ by a
+    nonzero delta must not. Seeded generator (hypothesis's sympy strategies
+    would be overkill; determinism keeps CI stable)."""
+    import sympy
+    from sympy import Rational, latex, sqrt
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    agree_fail, reject_fail = [], []
+    for _ in range(60):
+        kind = rng.integers(0, 4)
+        if kind == 0:  # rational
+            p, q = int(rng.integers(-40, 40)), int(rng.integers(1, 12))
+            val = Rational(p, q)
+        elif kind == 1:  # integer
+            val = sympy.Integer(int(rng.integers(-1000, 1000)))
+        elif kind == 2:  # k*sqrt(n)
+            k, n = int(rng.integers(1, 9)), int(rng.integers(2, 30))
+            val = k * sqrt(n)
+        else:  # rational multiple of pi
+            p, q = int(rng.integers(1, 12)), int(rng.integers(1, 6))
+            val = Rational(p, q) * sympy.pi
+        a = latex(val)
+        b = sympy.sstr(val)  # e.g. 3*sqrt(2)/2, pi/3
+        if not math_equal(a, b):
+            agree_fail.append((a, b))
+        # a float rendering within tolerance must also agree
+        if val.is_real and not math_equal(a, str(sympy.N(val, 10))):
+            agree_fail.append((a, "N"))
+        # perturbed value must be rejected
+        wrong = latex(val + Rational(1, 3))
+        if math_equal(a, wrong):
+            reject_fail.append((a, wrong))
+    assert not agree_fail, agree_fail[:10]
+    assert not reject_fail, reject_fail[:10]
+
+
+def test_integer_gold_exactness():
+    """Review findings r5: the rel-tol ladder must not apply to
+    integer-valued golds, and integer compares must be arbitrary
+    precision (floats collapse above 2^53)."""
+    assert not math_equal("13536", "13535")
+    assert not math_equal("13535.5", "13535")  # decimal near-integer
+    assert not math_equal("13535.9", "13535")
+    assert math_equal("13535", "13535")
+    assert math_equal("13535.0", "13535")
+    # above 2^53: adjacent ints are distinct doubles no more
+    assert not math_equal("9007199254740993", "9007199254740992")
+    assert math_equal("9007199254740993", "9007199254740993")
+    # percentage triple survives the tightening
+    assert math_equal("0.5", "50")
+    assert math_equal("5000", "50")
+    # non-integer golds keep the reference rel-tol
+    assert math_equal("0.33333", "1/3")
