@@ -558,6 +558,12 @@ class JaxGenConfig:
     # generation role). Decode latency grows by the stage count; combine
     # with tp_size for pp x tp meshes.
     pp_size: int = 1
+    # batch-group rotation for pp decode (every stage busy every tick,
+    # ~S x the sequential conveyor's throughput). False forces the
+    # sequential conveyor — one batch through all stages per token — for
+    # debugging and latency/throughput comparisons
+    # (tests/test_pp_decode_latency.py records the trade).
+    pp_rotate_decode: bool = True
     random_seed: int = 1
     skip_tokenizer_init: bool = False
     # keep aborted requests' KV in their slots, keyed by rid; the client's

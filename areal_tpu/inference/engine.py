@@ -135,7 +135,7 @@ class GenerationEngine:
                     f"pp_size={pp} must divide num_hidden_layers="
                     f"{model_config.num_hidden_layers}"
                 )
-            if config.max_batch_size % pp:
+            if config.max_batch_size % pp and config.pp_rotate_decode:
                 # batch-group rotation (decode_rotated_pp) needs the decode
                 # bucket divisible by pp; round the slot count up so the
                 # S x-faster path is always eligible
@@ -488,7 +488,11 @@ class GenerationEngine:
         pos_delta,  # [B] qwen2_vl M-RoPE decode offsets (zeros otherwise)
         steps: int,
     ):
-        if self._pp > 1 and last_tokens.shape[0] % self._pp == 0:
+        if (
+            self._pp > 1
+            and last_tokens.shape[0] % self._pp == 0
+            and self.config.pp_rotate_decode
+        ):
             # batch-group rotation: S stages busy every tick instead of one
             from areal_tpu.parallel.pipeline import decode_rotated_pp
 
