@@ -101,6 +101,13 @@ class WeightUpdateMeta:
     mmap them straight into device_put; only a tiny JSON notification rides
     HTTP. The closest analogue of the reference's NCCL same-node broadcast
     for separate-process engines sharing a host.
+    type="device_transfer": cross-PROCESS device path — servers pull the
+    trainer's staged buffers through JAX's transfer service straight into
+    their own device memory (utils/device_transfer): no safetensors body,
+    no host-RAM staging; the data plane is the platform's DMA/socket
+    transport. The closest analogue of the reference's dedicated NCCL
+    broadcast group (fsdp_engine.py:359-401) for disaggregated deployments,
+    including cross-host.
     type="lora": adapter-only push — just the rank-r LoRA factors go to
     /update_lora_weights (or the colocated equivalent) and the serving side
     merges against its retained base; a sync ships megabytes, not the full
@@ -108,7 +115,8 @@ class WeightUpdateMeta:
     areal/engine/sglang_remote.py:82-106).
     """
 
-    type: str = "disk"  # "disk" | "device" | "http" | "shm" | "lora"
+    # "disk" | "device" | "http" | "shm" | "device_transfer" | "lora"
+    type: str = "disk"
     path: str | None = None
     chunked_mem_mb: int = 1024
 
@@ -130,6 +138,12 @@ class WeightUpdateMeta:
     @classmethod
     def from_http(cls, chunked_mem_mb: int = 512) -> "WeightUpdateMeta":
         return cls(type="http", chunked_mem_mb=chunked_mem_mb)
+
+    @classmethod
+    def from_device_transfer(
+        cls, chunked_mem_mb: int = 512
+    ) -> "WeightUpdateMeta":
+        return cls(type="device_transfer", chunked_mem_mb=chunked_mem_mb)
 
     @classmethod
     def from_lora(cls) -> "WeightUpdateMeta":

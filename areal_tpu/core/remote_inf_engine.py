@@ -396,6 +396,102 @@ class RemoteInfEngine(InferenceEngine):
         self.set_version(next_version)
         return latency
 
+    def update_weights_from_device_transfer(
+        self, chunks, next_version: int
+    ) -> float:
+        """Cross-process DEVICE-PATH weight transfer (the reference's
+        dedicated NCCL broadcast group, fsdp_engine.py:359-401, re-based on
+        JAX's transfer service): each chunk of live device arrays is
+        gathered to one device, staged on this process's transfer server,
+        and every generation server pulls it straight into ITS device
+        memory — no safetensors serialization, no HTTP payload body, no
+        host-RAM staging of the weights. Works across hosts (the data
+        plane is the transfer service's DMA/socket transport).
+
+        ``chunks``: iterable of dict[param_path -> jax.Array] (any
+        sharding; cast/re-shard happens engine-side). One-chunk lookahead
+        bounds the single-device transient to chunked_mem_mb while still
+        marking the final chunk.
+        """
+        import jax
+
+        from areal_tpu.utils import device_transfer, stats_tracker
+
+        t0 = time.monotonic()
+        addr = device_transfer.transfer_address()
+        dev0 = jax.devices()[0]
+        single = jax.sharding.SingleDeviceSharding(dev0)
+        n_chunks = 0
+        # uuids are process-unique per ATTEMPT (device_transfer counter):
+        # a failed push leaves one-shot staged entries behind, and a
+        # retried version must never let a server pull one of those stale
+        # chunks. Generously over-reserve the block.
+        uuid_base = device_transfer.next_uuid_block(1 << 20)
+
+        async def _push_all():
+            nonlocal n_chunks
+            session = aiohttp.ClientSession()
+            try:
+                it = iter(chunks)
+                try:
+                    cur = next(it)
+                except StopIteration:
+                    raise AssertionError("no weight chunks to send") from None
+                while cur is not None:
+                    nxt = next(it, None)
+                    final = nxt is None
+                    # gather this chunk single-shard (the rank-0-
+                    # materializes shape of an NCCL broadcast); one staged
+                    # copy serves every server's pull
+                    staged = {
+                        k: jax.device_put(v, single) for k, v in cur.items()
+                    }
+                    jax.block_until_ready(list(staged.values()))
+                    leaves = [
+                        [k, list(v.shape), str(v.dtype)]
+                        for k, v in staged.items()
+                    ]
+                    reqs = []
+                    for si, a in enumerate(self.addresses):
+                        uuid = uuid_base + (n_chunks << 8) + si
+                        device_transfer.stage_for_pull(uuid, staged)
+                        reqs.append(
+                            arequest_with_retry(
+                                session,
+                                f"http://{a}/update_weights_from_device",
+                                payload={
+                                    "address": addr,
+                                    "uuid": uuid,
+                                    "leaves": leaves,
+                                    "version": next_version,
+                                    "final": final,
+                                },
+                                max_retries=1,
+                                timeout=self.config.request_timeout,
+                            )
+                        )
+                    n_chunks += 1
+                    await asyncio.gather(*reqs)
+                    cur = nxt
+            finally:
+                await session.close()
+
+        asyncio.run(_push_all())
+        latency = time.monotonic() - t0
+        stats_tracker.DEFAULT_TRACKER.scalar(
+            update_weights_device_latency=latency
+        )
+        logger.info(
+            "device-path weight update v%d (%d chunks) -> %d servers in "
+            "%.2fs",
+            next_version,
+            n_chunks,
+            len(self.addresses),
+            latency,
+        )
+        self.set_version(next_version)
+        return latency
+
     def update_weights_from_shm(self, chunks, next_version: int) -> float:
         """Same-host no-copy weight transfer: each chunk is written once to
         /dev/shm (RAM-backed tmpfs) as a safetensors file and every server

@@ -1625,39 +1625,57 @@ class TPUTrainEngine(TrainEngine):
         else:
             raise ValueError(f"unknown weight update type {meta.type}")
 
-    def _weight_chunks(self, chunk_mb: int):
-        """Yield dotted-path-named host-array chunks of <= chunk_mb MB each
-        (oversized single leaves go alone). The staging buffer holds one
-        chunk at a time, bounding host RAM like the reference's
-        weight_chunked_mem_mb bucketing (fsdp_engine.py:359-401)."""
-        budget = chunk_mb * 1_000_000
-        cur: dict[str, np.ndarray] = {}
-        size = 0
-
-        def walk(node, prefix):
-            for k in sorted(node.keys()):
-                v = node[k]
-                path = f"{prefix}.{k}" if prefix else k
-                if isinstance(v, dict):
-                    yield from walk(v, path)
-                else:
-                    yield path, v
-
-        multi = distributed.process_count() > 1
-        for path, leaf in walk(self.effective_params(), ""):
-            if multi:
-                # cross-host sharded leaf: every host joins the gather (a
-                # collective) even though only host 0 pushes the chunks
-                arr = distributed.gather_host_values(leaf)
+    @staticmethod
+    def _walk_params(node, prefix=""):
+        """Sorted dotted-path iteration over a params tree's leaves."""
+        for k in sorted(node.keys()):
+            v = node[k]
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                yield from TPUTrainEngine._walk_params(v, path)
             else:
-                arr = np.asarray(jax.device_get(leaf))
-            if cur and size + arr.nbytes > budget:
+                yield path, v
+
+    def _chunked(self, chunk_mb: int, materialize):
+        """Group leaves into <= chunk_mb chunks (oversized single leaves
+        go alone); ``materialize(leaf) -> array`` picks host vs device."""
+        budget = chunk_mb * 1_000_000
+        cur: dict = {}
+        size = 0
+        for path, leaf in self._walk_params(self.effective_params()):
+            arr = materialize(leaf)
+            nbytes = getattr(arr, "nbytes", arr.size * arr.dtype.itemsize)
+            if cur and size + nbytes > budget:
                 yield cur
                 cur, size = {}, 0
             cur[path] = arr
-            size += arr.nbytes
+            size += nbytes
         if cur:
             yield cur
+
+    def _weight_chunks(self, chunk_mb: int):
+        """Yield dotted-path-named host-array chunks of <= chunk_mb MB
+        each. The staging buffer holds one chunk at a time, bounding host
+        RAM like the reference's weight_chunked_mem_mb bucketing
+        (fsdp_engine.py:359-401)."""
+        multi = distributed.process_count() > 1
+
+        def materialize(leaf):
+            if multi:
+                # cross-host sharded leaf: every host joins the gather (a
+                # collective) even though only host 0 pushes the chunks
+                return distributed.gather_host_values(leaf)
+            return np.asarray(jax.device_get(leaf))
+
+        yield from self._chunked(chunk_mb, materialize)
+
+    def _weight_chunks_device(self, chunk_mb: int):
+        """Like :meth:`_weight_chunks` but yields LIVE device arrays (no
+        host gather): the device-transfer path ships buffers
+        device-to-device, so pulling them through host numpy would defeat
+        the point. Leaves stay in their training sharding; the client
+        gathers each chunk single-shard on device."""
+        yield from self._chunked(chunk_mb, lambda leaf: leaf)
 
     def update_weights(self, meta: WeightUpdateMeta | None = None):
         """Push current weights to the paired rollout engine and bump
@@ -1693,6 +1711,29 @@ class TPUTrainEngine(TrainEngine):
                     pass
             else:
                 getattr(target, method)(chunks, next_version)
+        elif meta.type == "device_transfer":
+            # cross-process DEVICE-PATH resync: servers pull staged
+            # buffers from this process's transfer server directly into
+            # their device memory (the reference's dedicated NCCL
+            # broadcast group, fsdp_engine.py:359-401) — no host-RAM or
+            # HTTP-body staging of the payload. "Cross-host" here means
+            # trainer host vs SERVER hosts; a multi-PROCESS trainer would
+            # need a pre-gather of its non-addressable leaves (use the
+            # http/shm path there until wired)
+            if distributed.process_count() > 1:
+                raise NotImplementedError(
+                    "device_transfer weight updates from a multi-process "
+                    "trainer are not wired (leaves are not fully "
+                    "addressable per process); use type='http' or 'shm'"
+                )
+            target = self._rollout_engine
+            assert target is not None and hasattr(
+                target, "update_weights_from_device_transfer"
+            ), "device_transfer weight updates need a RemoteInfEngine"
+            target.update_weights_from_device_transfer(
+                self._weight_chunks_device(meta.chunked_mem_mb),
+                next_version,
+            )
         elif meta.type == "lora":
             # adapter-native sync: ship ONLY the rank-r factors (megabytes)
             # and let the serving side merge against its retained base —

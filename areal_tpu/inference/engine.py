@@ -798,6 +798,33 @@ class GenerationEngine:
         if err is not None:
             raise err
 
+    def update_weights_from_device_pull(
+        self,
+        address: str,
+        uuid: int,
+        leaves: list,  # [(dotted_path, shape, dtype_str), ...] one chunk
+        version: int | None = None,
+    ):
+        """Cross-process device-path weight chunk (the reference's NCCL
+        broadcast role, fsdp_engine.py:359-401): pull the staged buffers
+        from the trainer's transfer server straight into this process's
+        device memory — no safetensors body, no host staging — then apply
+        like any named chunk. ``version=None`` = more chunks coming."""
+        import jax.experimental.transfer  # noqa: F401 — fail early if absent
+
+        from areal_tpu.utils import device_transfer
+
+        dev = self.mesh.devices.flat[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev)
+        specs = {
+            path: jax.ShapeDtypeStruct(
+                tuple(shape), jnp.dtype(dtype), sharding=sharding
+            )
+            for path, shape, dtype in leaves
+        }
+        named = device_transfer.pull(address, uuid, specs)
+        self.update_weights_from_named_arrays(named, version)
+
     def update_weights_from_arrays(self, params, version: int | None = None):
         """Colocated device-to-device weight refresh: re-place live jax
         arrays (e.g. the train engine's params) onto this engine's shardings

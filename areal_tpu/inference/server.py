@@ -84,6 +84,10 @@ class GenerationServer:
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
                 web.post("/update_weights_from_tensor", self.update_weights_from_tensor),
                 web.post("/update_weights_from_shm", self.update_weights_from_shm),
+                web.post(
+                    "/update_weights_from_device",
+                    self.update_weights_from_device,
+                ),
                 web.post("/update_lora_weights", self.update_lora_weights),
             ]
         )
@@ -250,6 +254,35 @@ class GenerationServer:
             )
         except Exception as e:
             logger.exception("update_lora_weights failed")
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.engine.get_version()}
+        )
+
+    async def update_weights_from_device(self, request: web.Request) -> web.Response:
+        """Device-path weight update: the body names a chunk of staged
+        buffers on the trainer's transfer server; the engine pulls them
+        device-to-device (utils/device_transfer — the reference's NCCL
+        broadcast role) and applies. final=1 commits the version."""
+        payload = await request.json()
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                self.engine.update_weights_from_device_pull,
+                payload["address"],
+                int(payload["uuid"]),
+                payload["leaves"],
+                (
+                    int(payload["version"])
+                    if payload.get("final", True)
+                    and payload.get("version") is not None
+                    else None
+                ),
+            )
+        except Exception as e:
+            logger.exception("update_weights_from_device failed")
             return web.json_response(
                 {"success": False, "message": str(e)}, status=500
             )

@@ -1,0 +1,117 @@
+"""Per-role driver for the cross-process device-path weight resync test
+(tests/test_device_transfer.py): two INDEPENDENT jax processes — no shared
+jax.distributed world, the disaggregated deployment shape — where the
+trainer pushes weights over the transfer service and the server pulls them
+device-to-device.
+
+Usage:
+  python device_transfer_driver.py server  <outdir>
+  python device_transfer_driver.py trainer <outdir> <server_addr>
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def model_cfg():
+    from areal_tpu.models.config import tiny_config
+
+    return tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+
+
+def run_server(outdir: str):
+    import asyncio
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+    from areal_tpu.models import hf_io
+    from areal_tpu.models.lm import init_params
+
+    cfg = model_cfg()
+    eng = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=2, max_seq_len=64, prefill_chunk=32,
+            page_size=16, dtype="float32",
+        ),
+        model_config=cfg,
+        params=init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+    )
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=60)
+    with open(os.path.join(outdir, "server_addr.tmp"), "w") as f:
+        f.write(f"127.0.0.1:{port}")
+    os.rename(
+        os.path.join(outdir, "server_addr.tmp"),
+        os.path.join(outdir, "server_addr"),
+    )
+    deadline = time.time() + 180
+    while eng.get_version() < 1 and time.time() < deadline:
+        time.sleep(0.1)
+    assert eng.get_version() == 1, "device-path update never arrived"
+    hf_io.save_hf_params(eng.params, cfg, os.path.join(outdir, "server_params"))
+    with open(os.path.join(outdir, "server_done"), "w") as f:
+        f.write("ok")
+    time.sleep(5)  # let the trainer's POST response flush
+
+
+def run_trainer(outdir: str, server_addr: str):
+    from areal_tpu.api.cli_args import InferenceEngineConfig, TrainEngineConfig
+    from areal_tpu.api.cli_args import OptimizerConfig
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+    from areal_tpu.models import hf_io
+
+    tcfg = TrainEngineConfig(
+        path="", init_from_scratch=True, optimizer=OptimizerConfig(lr=1e-3)
+    )
+    tcfg.backend.param_dtype = "float32"
+    eng = TPULMEngine(tcfg)
+    eng.initialize(None, None, model_config=model_cfg(), seed=7)
+
+    client = RemoteInfEngine(InferenceEngineConfig())
+    client.addresses = [server_addr]
+    eng.connect_engine(client, WeightUpdateMeta.from_device_transfer(
+        chunked_mem_mb=1  # force several chunks
+    ))
+    eng.update_weights()
+    hf_io.save_hf_params(
+        eng.effective_params(), eng.model_config,
+        os.path.join(outdir, "trainer_params"),
+    )
+    with open(os.path.join(outdir, "trainer_done"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    role = sys.argv[1]
+    if role == "server":
+        run_server(sys.argv[2])
+    elif role == "trainer":
+        run_trainer(sys.argv[2], sys.argv[3])
+    else:
+        raise SystemExit(f"unknown role {role}")
