@@ -710,8 +710,12 @@ class LauncherConfig:
     trainer_env_vars: dict[str, str] = field(default_factory=dict)
     # multi-host training (the torchrun replacement): spawn this many trainer
     # processes wired together via jax.distributed (parallel/distributed.py);
-    # each process drives its local chips and the GSPMD mesh spans all of them
-    trainer_processes: int = 1
+    # each process drives its local chips and the GSPMD mesh spans all of
+    # them. 0 = derive: the slurm/GKE launchers compute the host count from
+    # the allocation mode (controller/scheduling.plan_worker_sets); the
+    # LOCAL launcher uses 1 (a single process drives every local chip
+    # under GSPMD — multi-process locally is only for multi-host testing)
+    trainer_processes: int = 0
 
 
 @dataclass

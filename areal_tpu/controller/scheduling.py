@@ -1,0 +1,138 @@
+"""Experiment-config -> worker-set synthesis.
+
+The reference derives its worker fleet from the experiment config
+(realhf/api/core/system_api.py:174-220 ``ExperimentScheduling`` /
+``TasksGroup`` and each experiment's ``scheduling_setup``): counts and
+resource specs for model workers, generation servers, the master, flow to
+SLURM/Ray. Here the same derivation is one shared function over the
+allocation grammar, consumed by every launcher (slurm, GKE JobSet, local)
+and by the controller — previously each launcher re-derived counts
+inline.
+
+TPU-native worker model: a "trainer" replica is one HOST of the
+jax.distributed train mesh (GSPMD handles intra-host devices; the
+reference needs one worker per GPU instead), a "gen_server" replica is
+one generation-server process holding tp*pp chips, and the cpu-only
+"controller" replica is the reference's auto-added master worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
+
+
+@dataclasses.dataclass
+class ResourceSpec:
+    """Per-replica resource ask (the reference's ``Scheduling`` role)."""
+
+    chips: int = 0  # accelerator chips
+    cpus: int = 4
+    mem_mb: int = 16384
+
+
+@dataclasses.dataclass
+class WorkerGroup:
+    """A homogeneous worker set (the reference's ``TasksGroup``)."""
+
+    role: str  # "trainer" | "gen_server" | "controller"
+    count: int
+    resource: ResourceSpec
+
+
+@dataclasses.dataclass
+class ExperimentPlan:
+    groups: list[WorkerGroup]
+
+    def group(self, role: str) -> WorkerGroup:
+        for g in self.groups:
+            if g.role == role:
+                return g
+        raise KeyError(role)
+
+    @property
+    def n_servers(self) -> int:
+        """Generation-server replicas; 1 when the allocation has no
+        dedicated server fleet (colocated / train-only: one debug
+        server)."""
+        try:
+            return self.group("gen_server").count
+        except KeyError:
+            return 1
+
+    @property
+    def n_trainer_hosts(self) -> int:
+        """Trainer processes (jax.distributed hosts); 1 when the
+        allocation has no train section (gen-only / eval)."""
+        try:
+            return self.group("trainer").count
+        except KeyError:
+            return 1
+
+    @property
+    def total_chips(self) -> int:
+        return sum(g.count * g.resource.chips for g in self.groups)
+
+
+def plan_worker_sets(
+    allocation_mode: str,
+    chips_per_host: int = 4,
+    controller_cpus: int = 4,
+    controller_mem_mb: int = 16384,
+) -> ExperimentPlan:
+    """Worker sets from an allocation string.
+
+    - generation servers: one process per gen DP replica, each holding
+      ``gen.tp * gen.pp`` chips (a server IS a tp x pp mesh);
+    - trainers: the train submesh's world size split over hosts of
+      ``chips_per_host`` chips — one jax.distributed process per host;
+    - controller: always one cpu-only replica (the reference auto-adds
+      the master worker the same way, system_api.py ExperimentConfig
+      ``__post_init__``).
+
+    Colocated allocations (``jaxgen:...|gspmd:...``) produce gen_server
+    count 0: the trainer processes host the colocated engine themselves.
+    """
+    alloc = AllocationMode.from_str(allocation_mode)
+    groups: list[WorkerGroup] = []
+
+    # any allocation with a DEDICATED server fleet (decoupled, gen-only,
+    # decoupled-eval) gets gen.dp server replicas; colocated serves from
+    # the trainer processes themselves
+    if alloc.gen is not None and alloc.type_ != AllocationType.COLOCATED:
+        groups.append(
+            WorkerGroup(
+                role="gen_server",
+                count=alloc.gen.dp,
+                resource=ResourceSpec(chips=alloc.gen.tp * alloc.gen.pp),
+            )
+        )
+
+    train = alloc.train
+    world = train.world_size if train is not None else 0
+    if world:
+        per_host = min(chips_per_host, world)
+        if world % per_host:
+            raise ValueError(
+                f"train world size {world} does not fill hosts of "
+                f"{per_host} chips evenly"
+            )
+        groups.append(
+            WorkerGroup(
+                role="trainer",
+                count=world // per_host,
+                resource=ResourceSpec(chips=per_host),
+            )
+        )
+
+    groups.append(
+        WorkerGroup(
+            role="controller",
+            count=1,
+            resource=ResourceSpec(
+                chips=0, cpus=controller_cpus, mem_mb=controller_mem_mb
+            ),
+        )
+    )
+    return ExperimentPlan(groups=groups)

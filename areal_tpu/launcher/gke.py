@@ -29,7 +29,7 @@ import shlex
 import subprocess
 import sys
 
-from areal_tpu.api.alloc_mode import AllocationMode
+from areal_tpu.controller.scheduling import plan_worker_sets
 from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
 from areal_tpu.utils import logging
 
@@ -99,9 +99,12 @@ def render_jobset(
     cfg, entry: str, config_path: str, overrides: list[str]
 ) -> dict:
     """Pure manifest synthesis: the JobSet dict for one experiment."""
-    alloc = AllocationMode.from_str(cfg.allocation_mode)
-    n_servers = alloc.gen.dp if alloc.gen else 1
-    n_trainers = max(cfg.launcher.trainer_processes, 1)
+    plan = plan_worker_sets(
+        cfg.allocation_mode, chips_per_host=cfg.cluster.n_chips_per_host
+    )
+    n_servers = plan.n_servers
+    # explicit launcher override wins; else the plan's host count
+    n_trainers = cfg.launcher.trainer_processes or plan.n_trainer_hosts
     args = " ".join(shlex.quote(o) for o in overrides)
     name = f"{cfg.experiment_name}-{cfg.trial_name}".replace("_", "-")
     chips = cfg.cluster.n_chips_per_host

@@ -20,7 +20,7 @@ import shlex
 import subprocess
 import sys
 
-from areal_tpu.api.alloc_mode import AllocationMode
+from areal_tpu.controller.scheduling import plan_worker_sets
 from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
 from areal_tpu.utils import logging
 
@@ -51,8 +51,9 @@ def _sbatch_header(
 def render_server_script(cfg, config_path: str, overrides: list[str]) -> str:
     """One srun task per inference server replica; each registers its
     address in name_resolve (launcher/tpu_server.py does that natively)."""
-    alloc = AllocationMode.from_str(cfg.allocation_mode)
-    n_servers = alloc.gen.dp if alloc.gen else 1
+    n_servers = plan_worker_sets(
+        cfg.allocation_mode, chips_per_host=cfg.cluster.n_chips_per_host
+    ).n_servers
     log_dir = os.path.join(
         cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
     )
@@ -78,7 +79,10 @@ def render_trainer_script(
 ) -> str:
     """N trainer tasks wired into one jax.distributed mesh: task 0's host is
     the coordinator; SLURM_PROCID maps to AREAL_PROCESS_ID."""
-    n = max(cfg.launcher.trainer_processes, 1)
+    # explicit launcher override wins; else the plan's host count
+    n = cfg.launcher.trainer_processes or plan_worker_sets(
+        cfg.allocation_mode, chips_per_host=cfg.cluster.n_chips_per_host
+    ).n_trainer_hosts
     log_dir = os.path.join(
         cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
     )

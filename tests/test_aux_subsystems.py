@@ -153,3 +153,50 @@ def test_gke_jobset_manifest_synthesis(tmp_path):
 
     loaded = yaml.safe_load(open(path))
     assert loaded["kind"] == "JobSet"
+
+
+def test_plan_worker_sets_from_allocation():
+    """Experiment-config -> worker-set synthesis (reference
+    ExperimentScheduling/TasksGroup, system_api.py:174-220): counts and
+    chip asks derive from the allocation grammar; the controller (master)
+    group is always present, like the reference's auto-added master."""
+    from areal_tpu.controller.scheduling import plan_worker_sets
+
+    p = plan_worker_sets("jaxgen:d4t2+gspmd:d2t4", chips_per_host=4)
+    assert p.group("gen_server").count == 4
+    assert p.group("gen_server").resource.chips == 2
+    assert p.group("trainer").count == 2  # 8-chip train world / 4 per host
+    assert p.group("trainer").resource.chips == 4
+    assert p.group("controller").count == 1
+    assert p.group("controller").resource.chips == 0
+    assert p.total_chips == 16
+
+    # colocated: trainers host the engine; no separate server fleet
+    import pytest as _pytest
+
+    colo = plan_worker_sets("jaxgen:d2t2|gspmd:d2t2", chips_per_host=4)
+    with _pytest.raises(KeyError):
+        colo.group("gen_server")
+    assert colo.group("trainer").count == 1
+
+    # pp servers ask for tp*pp chips each
+    pp = plan_worker_sets("jaxgen:d2t2p2+gspmd:d8", chips_per_host=4)
+    assert pp.group("gen_server").resource.chips == 4
+    assert pp.group("trainer").count == 2
+
+    # uneven host fill is a config error, not a silent round
+    with _pytest.raises(ValueError, match="evenly"):
+        plan_worker_sets("gspmd:d6", chips_per_host=4)
+
+
+def test_plan_worker_sets_gen_only_and_eval():
+    """Review r5 regressions: GEN_ONLY and DECOUPLED_EVAL allocations have
+    a dedicated server fleet (gen.dp replicas) and no trainer group; the
+    plan's n_servers/n_trainer_hosts properties fall back sanely."""
+    from areal_tpu.controller.scheduling import plan_worker_sets
+
+    p = plan_worker_sets("jaxgen:d4t2")
+    assert p.n_servers == 4
+    assert p.n_trainer_hosts == 1  # no train section -> one process
+    pe = plan_worker_sets("jaxgen:d4t2+eval")
+    assert pe.n_servers == 4
