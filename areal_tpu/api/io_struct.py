@@ -85,6 +85,14 @@ class ParamSpec:
     dtype: str
 
 
+# Request-body ceiling of the generation server (aiohttp client_max_size).
+# ONE home for the number: the server sizes its app with it and the HTTP
+# weight-push path validates each serialized chunk against it CLIENT-side,
+# so a WeightUpdateMeta.chunked_mem_mb too large for the server fails with
+# a clear error naming the knob instead of an opaque 413.
+SERVER_CLIENT_MAX_SIZE = 2 * 1024**3
+
+
 @dataclass
 class WeightUpdateMeta:
     """How trainer weights reach inference servers (reference io_struct.py:105).
@@ -95,7 +103,10 @@ class WeightUpdateMeta:
     type="http": trainer streams safetensors-serialized chunks straight to
     each server's /update_weights_from_tensor endpoint — the disaggregated
     no-disk path (reference NCCL broadcast, fsdp_engine.py:359-401, without
-    the cross-job process group); ``chunked_mem_mb`` bounds chunk size.
+    the cross-job process group); ``chunked_mem_mb`` bounds chunk size and
+    is validated client-side against ``SERVER_CLIENT_MAX_SIZE`` at push
+    time (an oversized chunk fails with an error naming this knob, not an
+    opaque 413).
     type="shm": same-host disaggregated fast path — trainer writes chunks
     into /dev/shm (RAM-backed tmpfs, no TCP payload, no disk) and servers
     mmap them straight into device_put; only a tiny JSON notification rides

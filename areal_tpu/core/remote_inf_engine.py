@@ -32,7 +32,12 @@ import numpy as np
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
-from areal_tpu.api.io_struct import ModelRequest, ModelResponse, WeightUpdateMeta
+from areal_tpu.api.io_struct import (
+    SERVER_CLIENT_MAX_SIZE,
+    ModelRequest,
+    ModelResponse,
+    WeightUpdateMeta,
+)
 from areal_tpu.core.workflow_executor import WorkflowExecutor
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.http import arequest_with_retry
@@ -365,6 +370,18 @@ class RemoteInfEngine(InferenceEngine):
                     blob = st_save(
                         {k: np.ascontiguousarray(v) for k, v in cur.items()}
                     )
+                    if len(blob) > SERVER_CLIENT_MAX_SIZE:
+                        # validate against the server's request-body cap
+                        # CLIENT-side: the alternative is an opaque 413
+                        # from aiohttp with no hint which knob to turn
+                        raise ValueError(
+                            f"serialized weight chunk is {len(blob)} bytes "
+                            f"(> server client_max_size="
+                            f"{SERVER_CLIENT_MAX_SIZE}); lower "
+                            "WeightUpdateMeta.chunked_mem_mb so each "
+                            "safetensors chunk fits the server's request "
+                            "body limit"
+                        )
                     n_chunks += 1
                     await asyncio.gather(
                         *[
@@ -425,7 +442,19 @@ class RemoteInfEngine(InferenceEngine):
         # uuids are process-unique per ATTEMPT (device_transfer counter):
         # a failed push leaves one-shot staged entries behind, and a
         # retried version must never let a server pull one of those stale
-        # chunks. Generously over-reserve the block.
+        # chunks. Generously over-reserve the block. The per-chunk uuid
+        # packs (n_chunks << 8) + server_index into that block, so both
+        # fields are bounds-checked: a 257th server or a 4097th chunk
+        # would silently alias another chunk's staged buffers otherwise.
+        if len(self.addresses) > 256:
+            # a ValueError, not assert: python -O must not strip the guard
+            # that keeps a 257th server from silently pulling another
+            # chunk's staged buffers
+            raise ValueError(
+                "device-transfer uuid encoding packs the server index into "
+                f"8 bits; {len(self.addresses)} servers would alias staged "
+                "chunks — shard the push across engine groups"
+            )
         uuid_base = device_transfer.next_uuid_block(1 << 20)
 
         async def _push_all():
@@ -451,10 +480,22 @@ class RemoteInfEngine(InferenceEngine):
                         [k, list(v.shape), str(v.dtype)]
                         for k, v in staged.items()
                     ]
+                    if n_chunks >= (1 << 12):
+                        raise ValueError(
+                            "device-transfer uuid encoding reserves 12 "
+                            "bits for the chunk index; raise chunked_mem_mb"
+                        )
                     reqs = []
+                    staged_bytes = 0
                     for si, a in enumerate(self.addresses):
                         uuid = uuid_base + (n_chunks << 8) + si
-                        device_transfer.stage_for_pull(uuid, staged)
+                        # the per-server uuids all alias ONE staged array
+                        # set (shared buffers): account its bytes once
+                        n = device_transfer.stage_for_pull(
+                            uuid, staged, account=si == 0
+                        )
+                        if si == 0:
+                            staged_bytes = n
                         reqs.append(
                             arequest_with_retry(
                                 session,
@@ -472,6 +513,13 @@ class RemoteInfEngine(InferenceEngine):
                         )
                     n_chunks += 1
                     await asyncio.gather(*reqs)
+                    # every server acknowledged its pull: the one-shot
+                    # staged entries are consumed. A failed gather skips
+                    # this — the chunk's shared buffers stay pinned while
+                    # ANY server's entry remains, so whole-chunk
+                    # granularity is the honest unit — and the next push
+                    # attempt logs the leak (device_transfer).
+                    device_transfer.ack_pulled(staged_bytes)
                     cur = nxt
             finally:
                 await session.close()

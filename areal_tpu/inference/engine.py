@@ -46,6 +46,7 @@ from areal_tpu.models.lm import (
     init_paged_kv_cache,
     init_params,
     prefill_stream,
+    spec_verify_step_paged,
     write_prefill_blocks,
 )
 from areal_tpu.inference.block_pool import (
@@ -53,7 +54,8 @@ from areal_tpu.inference.block_pool import (
     BlockPool,
     OutOfBlocks,
 )
-from areal_tpu.inference.sampling import sample_tokens
+from areal_tpu.inference.ngram import MAX_SCAN, ngram_propose
+from areal_tpu.inference.sampling import sample_tokens, spec_verify_tokens
 from areal_tpu.parallel.mesh import MESH_AXES, AXIS_PP, AXIS_TP
 from areal_tpu.parallel.sharding import param_shardings
 from areal_tpu.utils import logging
@@ -244,6 +246,41 @@ class GenerationEngine:
             raise ValueError(
                 f"kv_quant must be none|int8, got {config.kv_quant!r}"
             )
+        if config.spec_decode not in ("none", "ngram"):
+            raise ValueError(
+                f"spec_decode must be none|ngram, got {config.spec_decode!r}"
+            )
+        if config.spec_decode == "ngram":
+            # fail loudly: a silently-empty proposer range would pay the
+            # per-window proposal scan forever while spec_acceptance_rate
+            # reads 0.0 with no hint why
+            if config.spec_draft_len < 1:
+                raise ValueError(
+                    f"spec_draft_len must be >= 1 with spec_decode='ngram',"
+                    f" got {config.spec_draft_len}"
+                )
+            if not 1 <= config.spec_ngram_min <= config.spec_ngram_max:
+                raise ValueError(
+                    "need 1 <= spec_ngram_min <= spec_ngram_max, got "
+                    f"min={config.spec_ngram_min} max={config.spec_ngram_max}"
+                )
+        self._spec_enabled = config.spec_decode == "ngram"
+        if self._spec_enabled and pp > 1:
+            # the pp decode conveyors (sequential + rotated) are single-
+            # token-per-tick machines; verify windows are not threaded
+            # through them yet
+            logger.warning(
+                "spec_decode='ngram' is not wired through pp decode "
+                "(pp_size=%d); falling back to non-speculative decode", pp
+            )
+            self._spec_enabled = False
+        # speculative-decoding counters (surfaced via server /model_info):
+        # acceptance rate = accepted / proposed; each window also emits one
+        # non-drafted token (the correction/bonus), so emitted tokens per
+        # dispatch = mean(n_accepted) + 1
+        self.spec_steps_total = 0
+        self.spec_proposed_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
         cache = init_paged_kv_cache(
             model_config, num_blocks, self.block_size, self.dtype,
             quant=config.kv_quant,
@@ -369,6 +406,9 @@ class GenerationEngine:
             self._copy_block_impl, donate_argnums=(0,)
         )
         self._jit_extend = jax.jit(self._extend_impl, donate_argnums=(1,))
+        self._jit_spec_decode = jax.jit(
+            self._spec_decode_impl, donate_argnums=(1,)
+        )
         # qwen2_vl prefill retraces per (grid signature, bucket) — the image
         # grid is a static shape input like prefill buckets
         self._jit_cache_vlm: dict = {}
@@ -521,6 +561,36 @@ class GenerationEngine:
             step, (last_tokens, cache, cache_len), rngs
         )
         return toks, logps, cache  # [steps, B], [steps, B]
+
+    def _spec_decode_impl(
+        self,
+        params,
+        cache,
+        last_tokens,  # [B] pending feed token per slot
+        draft,  # [B, K] n-gram-proposed continuation tokens
+        draft_len,  # [B] valid draft count (0 = plain decode for that slot)
+        cache_len,  # [B]
+        block_table,  # [B, NBT]
+        active,  # [B] bool
+        rng,
+        temp,
+        top_k,
+        top_p,
+        greedy,
+        pos_delta,  # [B] M-RoPE decode offsets
+    ):
+        """One speculative window: verify K drafts per slot in a single
+        K+1-token paged dispatch, then run the acceptance rule. Returns
+        (tokens [B, K+1], logprobs [B, K+1], n_accepted [B], cache)."""
+        logits, cache = spec_verify_step_paged(
+            params, self.model_config, cache, last_tokens, draft,
+            cache_len, block_table, active,
+            attn_spec=self.attn_spec, pos_offset=pos_delta,
+        )
+        toks, logps, n_acc = spec_verify_tokens(
+            logits, draft, draft_len, rng, temp, top_k, top_p, greedy
+        )
+        return toks, logps, n_acc, cache
 
     # ------------------------------------------------------------------
     # Host-side helpers
@@ -847,6 +917,17 @@ class GenerationEngine:
     @property
     def n_running(self) -> int:
         return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Lifetime accepted/proposed draft-token ratio (0.0 before any
+        speculative window ran) — the ONE home for the headline spec-decode
+        metric; the server and bench both read it from here."""
+        if not self.spec_proposed_tokens_total:
+            return 0.0
+        return (
+            self.spec_accepted_tokens_total / self.spec_proposed_tokens_total
+        )
 
     # ------------------------------------------------------------------
     # Engine loop
@@ -1730,25 +1811,19 @@ class GenerationEngine:
                 have += len(new)
         return nbt
 
-    def _decode_chunk(self):
-        b = self.config.max_batch_size
-        # never decode past any active slot's cache capacity
-        steps = self.config.decode_steps_per_call
-        for i, s in enumerate(self.slots):
-            if s is not None:
-                steps = min(steps, self.config.max_seq_len - int(self.cache_len[i]))
-        steps = max(steps, 1)
-        nbt = self._grow_tables(steps)
-        if self.n_running == 0:
-            return  # everything was preempted while growing tables
-        active = np.array([s is not None for s in self.slots])
-        # bucket the table width to powers of two: the gather view scales
-        # with the LONGEST live sequence, not max_seq_len, and the compile
-        # count stays logarithmic
+    def _bucket_table_width(self, nbt: int) -> int:
+        """Bucket the block-table width to powers of two: the gather view
+        scales with the LONGEST live sequence, not max_seq_len, and the
+        compile count stays logarithmic."""
         w = 1
         while w < nbt:
             w *= 2
-        nbt = min(w, self.max_blocks_per_seq)
+        return min(w, self.max_blocks_per_seq)
+
+    def _sampling_knobs(self):
+        """Per-slot sampling knob arrays for a batched dispatch (inactive
+        lanes get inert defaults)."""
+        b = self.config.max_batch_size
         temp = np.ones(b, np.float32)
         top_k = np.zeros(b, np.int32)
         top_p = np.ones(b, np.float32)
@@ -1762,6 +1837,147 @@ class GenerationEngine:
                     g.top_p,
                     g.greedy,
                 )
+        return temp, top_k, top_p, greedy
+
+    def _emit_token(
+        self, i: int, seq: _Seq, tok: int, logp: float, now: float
+    ) -> bool:
+        """Record ONE decoded token for slot ``i`` (shared by the plain
+        multi-step and speculative paths): request accumulators, per-token
+        version/ITL bookkeeping, covered-rows/cache_len advance. Returns
+        True when the sequence finished (slot already released)."""
+        seq.out_tokens.append(tok)
+        seq.out_logprobs.append(logp)
+        seq.out_versions.append(self.version)
+        if seq.t_first_token is None:  # resumed without prefill
+            seq.t_first_token = now
+        if seq.t_last_token is not None:
+            seq.itl.append(now - seq.t_last_token)
+        seq.t_last_token = now
+        self.generated_tokens_total += 1
+        # the fed token's K/V row was just written at cache_len
+        self._slot_covered[i].append(int(self.last_token[i]))
+        self.cache_len[i] += 1
+        self._slot_last_use[i] = now
+        self.last_token[i] = tok
+        if self._seq_finished(seq, tok):
+            self._finish(i, self._finish_reason(seq, tok))
+            return True
+        return False
+
+    def _propose_drafts(self):
+        """Host n-gram proposals for every active slot: ``[B, K]`` draft
+        tokens + per-slot valid counts. History is the slot's covered rows
+        plus the pending feed token — exactly the tokens known so far.
+        Slots with no match get count 0 and behave like plain one-token
+        decode inside the shared verify dispatch."""
+        cfg = self.config
+        k = cfg.spec_draft_len
+        draft = np.zeros((cfg.max_batch_size, k), np.int32)
+        dlen = np.zeros(cfg.max_batch_size, np.int32)
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            # slice the tail BEFORE concatenating: the proposer only
+            # scans MAX_SCAN tokens, so don't copy a 32k-token list per
+            # slot per window either
+            cov = self._slot_covered[i]
+            hist = cov[-(MAX_SCAN - 1):] + [int(self.last_token[i])]
+            prop = ngram_propose(
+                hist, cfg.spec_ngram_min, cfg.spec_ngram_max, k
+            )
+            if prop:
+                draft[i, : len(prop)] = prop
+                dlen[i] = len(prop)
+        return draft, dlen
+
+    def _try_spec_decode_chunk(self) -> bool:
+        """One speculative window: propose drafts, verify all of them in a
+        single K+1-token dispatch, emit the accepted prefix + one
+        correction/bonus token, and roll back rejected tokens by NOT
+        advancing ``cache_len`` past the accepted rows (free under the
+        paged pool — stale rows beyond cache_len are overwritten before
+        any query can attend them). Returns False to fall back to the
+        plain multi-step path: no slot has an n-gram hit, or some active
+        slot sits too close to max_seq_len for a full static-width window
+        (the window never shrinks — that would retrace the verify program
+        per residual length)."""
+        k = self.config.spec_draft_len
+        for i, s in enumerate(self.slots):
+            if s is not None and (
+                self.config.max_seq_len - int(self.cache_len[i]) < k + 1
+            ):
+                return False
+        draft, dlen = self._propose_drafts()
+        hits = int((dlen > 0).sum())
+        # mixed-batch guard: a verify window emits at most 1 token for a
+        # draft-less slot, so one repetitive sequence in a large diverse
+        # batch must not drag everyone off the steps_per_call-amortized
+        # plain path — take the window only when a meaningful fraction of
+        # the batch can benefit
+        if hits == 0 or hits < max(1, self.n_running // 4):
+            return False
+        nbt = self._bucket_table_width(self._grow_tables(k + 1))
+        if self.n_running == 0:
+            return True  # everything was preempted while growing tables
+        active = np.array([s is not None for s in self.slots])
+        # _grow_tables may have preempted slots AFTER their drafts were
+        # proposed: zero those lanes' draft counts so garbage trash-block
+        # logits can never count as proposals/accepts in the metrics
+        dlen = np.where(active, dlen, 0).astype(np.int32)
+        temp, top_k, top_p, greedy = self._sampling_knobs()
+        toks, logps, n_acc, self.cache = self._jit_spec_decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self.last_token),
+            jnp.asarray(draft),
+            jnp.asarray(dlen),
+            jnp.asarray(self.cache_len),
+            jnp.asarray(self.block_table[:, :nbt]),
+            jnp.asarray(active),
+            self._next_rng(),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(greedy),
+            jnp.asarray(self.pos_delta),
+        )
+        toks = np.asarray(toks)  # [B, K+1]
+        logps = np.asarray(logps)
+        n_acc = np.asarray(n_acc)
+        self.spec_steps_total += 1
+        self.spec_proposed_tokens_total += int(dlen.sum())
+        self.spec_accepted_tokens_total += int(n_acc.sum())
+        now = time.monotonic()
+        for i, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            # accepted drafts then the correction/bonus token; a stop token
+            # mid-window truncates — _emit_token released the slot and the
+            # remaining accepted tokens are dropped (cache_len stays at the
+            # last emitted row, like any other early finish)
+            for t in range(int(n_acc[i]) + 1):
+                if self._emit_token(
+                    i, seq, int(toks[i, t]), float(logps[i, t]), now
+                ):
+                    break
+        return True
+
+    def _decode_chunk(self):
+        if self._spec_enabled and self._try_spec_decode_chunk():
+            return
+        # never decode past any active slot's cache capacity
+        steps = self.config.decode_steps_per_call
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                steps = min(steps, self.config.max_seq_len - int(self.cache_len[i]))
+        steps = max(steps, 1)
+        nbt = self._grow_tables(steps)
+        if self.n_running == 0:
+            return  # everything was preempted while growing tables
+        active = np.array([s is not None for s in self.slots])
+        nbt = self._bucket_table_width(nbt)
+        temp, top_k, top_p, greedy = self._sampling_knobs()
         toks, logps, self.cache = self._jit_decode(
             self.params,
             self.cache,
@@ -1784,23 +2000,9 @@ class GenerationEngine:
             if seq is None:
                 continue
             for t in range(toks.shape[0]):
-                tok = int(toks[t, i])
-                seq.out_tokens.append(tok)
-                seq.out_logprobs.append(float(logps[t, i]))
-                seq.out_versions.append(self.version)
-                if seq.t_first_token is None:  # resumed without prefill
-                    seq.t_first_token = now
-                if seq.t_last_token is not None:
-                    seq.itl.append(now - seq.t_last_token)
-                seq.t_last_token = now
-                self.generated_tokens_total += 1
-                # the fed token's K/V row was just written at cache_len
-                self._slot_covered[i].append(int(self.last_token[i]))
-                self.cache_len[i] += 1
-                self._slot_last_use[i] = now
-                self.last_token[i] = tok
-                if self._seq_finished(seq, tok):
-                    self._finish(i, self._finish_reason(seq, tok))
+                if self._emit_token(
+                    i, seq, int(toks[t, i]), float(logps[t, i]), now
+                ):
                     break
 
     def _finish(self, slot: int, reason: str, retain: bool = False):

@@ -1,0 +1,61 @@
+"""Host-side n-gram draft proposer for draft-free speculative decoding.
+
+The vLLM/SGLang "prompt lookup" idea: RL reasoning/math completions repeat
+themselves (restated problem text, recurring equation fragments, greedy
+attractor cycles), so the cheapest draft model is the sequence's OWN
+history — match the trailing n-gram of prompt+output against earlier
+positions and propose the tokens that followed the most recent match. No
+second model, no extra device memory; proposal cost is a few numpy
+window comparisons per slot per window.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Proposal scans only the trailing MAX_SCAN tokens of a sequence's history:
+# per-window cost stays bounded as sequences grow (a full-history scan per
+# slot per window is O(L^2) over a generation and would creep into the
+# engine loop's host budget at long context). Repetition useful to a
+# lookahead draft is overwhelmingly local, so distant matches are a poor
+# trade for the scan cost.
+MAX_SCAN = 2048
+
+
+def ngram_propose(
+    history: list[int] | np.ndarray,
+    min_n: int,
+    max_n: int,
+    draft_len: int,
+    max_scan: int = MAX_SCAN,
+) -> list[int]:
+    """Propose up to ``draft_len`` continuation tokens for ``history``.
+
+    Tries suffix n-grams longest-first (``n = max_n .. min_n``): if the
+    last ``n`` tokens also occur earlier in ``history`` (with at least one
+    token following the occurrence), return the tokens after the most
+    recent occurrence that still has a FULL ``draft_len`` continuation
+    (recency tracks local repetition structure — loops, restated spans —
+    and a full window maximizes tokens verified per dispatch; matches so
+    late that the continuation would run off the end of history are used
+    only when nothing better exists). Returns ``[]`` when nothing matches;
+    callers fall back to plain decode.
+    """
+    arr = np.asarray(history, dtype=np.int64)
+    if max_scan and arr.size > max_scan:
+        arr = arr[-max_scan:]
+    h = arr.size
+    if draft_len <= 0 or h < min_n + 1 or min_n < 1:
+        return []
+    for n in range(min(max_n, h - 1), min_n - 1, -1):
+        suffix = arr[h - n:]
+        # windows over arr[:h-1]: start j in [0, h-1-n], so every match
+        # has a continuation token at j+n and the suffix itself (j = h-n)
+        # is excluded
+        windows = np.lib.stride_tricks.sliding_window_view(arr[: h - 1], n)
+        matches = np.flatnonzero((windows == suffix).all(axis=1))
+        if matches.size:
+            full = matches[matches + n + draft_len <= h]
+            j = int(full[-1]) if full.size else int(matches[-1])
+            return arr[j + n : j + n + draft_len].tolist()
+    return []

@@ -57,17 +57,123 @@ def sample_tokens(
     the batch's top-k/top-p mixture (the round-1 engine flipped static args
     per batch, recompiling on mixture changes). A runtime ``lax.cond`` skips
     the vocab sort entirely when every row has both filters disabled."""
-    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
-    need = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
-    scaled = jax.lax.cond(
-        need,
-        lambda s: _apply_top_k_top_p(s, top_k, top_p),
-        lambda s: s,
-        scaled,
-    )
+    scaled = _modified_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     argmax = jnp.argmax(scaled, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
     logp_dist = jax.nn.log_softmax(scaled, axis=-1)
     logprobs = jnp.take_along_axis(logp_dist, tokens[:, None], axis=-1)[:, 0]
     return tokens, logprobs
+
+
+def _modified_logits(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Temperature + top-k/top-p filtered logits — the MODIFIED behavior
+    distribution every sampled/recorded token lives under (shared by the
+    plain sampler and the speculative verifier, so the two can never
+    diverge on what 'the policy' is)."""
+    scaled = logits / jnp.maximum(temperature, 1e-5)[:, None]
+    need = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
+    return jax.lax.cond(
+        need,
+        lambda s: _apply_top_k_top_p(s, top_k, top_p),
+        lambda s: s,
+        scaled,
+    )
+
+
+def spec_verify_tokens(
+    logits: jnp.ndarray,  # [B, K+1, V] fp32 per-position verify logits
+    draft: jnp.ndarray,  # [B, K] int32 proposed tokens (pad past draft_len)
+    draft_len: jnp.ndarray,  # [B] int32 valid draft count per row (0..K)
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    greedy: jnp.ndarray,  # [B] bool
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative acceptance over one verify window.
+
+    ``logits[:, t]`` is the target model's next-token distribution after
+    consuming the fed prefix ``[last_token, draft_0..draft_{t-1}]`` (the
+    multi-token verify dispatch). Returns ``(tokens [B, K+1],
+    logprobs [B, K+1], n_accepted [B])``; row ``b`` emits exactly
+    ``tokens[b, : n_accepted[b] + 1]`` — the accepted draft prefix plus one
+    extra token (the rejection-position correction, or the bonus token when
+    every valid draft was accepted). Positions past that are garbage.
+
+    Acceptance preserves the served policy EXACTLY:
+
+    - greedy rows accept draft_t iff it equals the argmax of the modified
+      logits at position t, and emit the argmax at the first mismatch —
+      so spec-on output is token-identical to spec-off greedy decode;
+    - sampled rows run rejection sampling against the deterministic n-gram
+      proposal (q = one-hot at draft_t): accept with probability
+      p(draft_t); on rejection sample from the residual
+      ``norm(max(p - q, 0))`` = p with the draft token removed. The
+      emitted tokens are then distributed exactly as ancestral sampling
+      from the modified distribution p.
+
+    Per-token logprobs are ``log p(token)`` under the modified
+    distribution — the same behavior-policy quantity the plain sampler
+    records, which is what decoupled-PPO importance ratios consume.
+
+    Rows with ``draft_len == 0`` behave exactly like a plain decode step:
+    position 0 is a plain sample/argmax and ``n_accepted == 0``.
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    rng_accept, rng_fallback = jax.random.split(rng)
+    rep = lambda x: jnp.repeat(x, k1, axis=0)  # noqa: E731 — [B] -> [B*K1]
+    scaled = _modified_logits(
+        logits.reshape(b * k1, v), rep(temperature), rep(top_k), rep(top_p)
+    ).reshape(b, k1, v)
+    logp_dist = jax.nn.log_softmax(scaled, axis=-1)
+    argmax_tok = jnp.argmax(scaled, axis=-1).astype(jnp.int32)  # [B, K+1]
+
+    # accept tests on the K draft positions
+    p_draft = jnp.exp(
+        jnp.take_along_axis(
+            logp_dist[:, :k], draft[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+    )  # [B, K]
+    unif = jax.random.uniform(rng_accept, (b, k))
+    accept = jnp.where(
+        greedy[:, None], draft == argmax_tok[:, :k], unif < p_draft
+    )
+    valid = jnp.arange(k)[None, :] < draft_len[:, None]
+    accept = accept & valid
+    # leading run of accepts (a rejection kills everything after it)
+    n_accepted = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+    )  # [B] in 0..draft_len
+
+    # fallback token per position: the residual sample at rejected draft
+    # positions (draft token zeroed out of p, renormalized by categorical),
+    # a PLAIN sample at positions without a valid draft (the bonus token
+    # after a fully-accepted window, and position 0 of draft-less rows)
+    draft_pad = jnp.concatenate(
+        [draft.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)], axis=1
+    )  # [B, K+1]
+    valid_pad = jnp.concatenate([valid, jnp.zeros((b, 1), bool)], axis=1)
+    cur = jnp.take_along_axis(scaled, draft_pad[..., None], axis=-1)[..., 0]
+    masked = scaled.at[
+        jnp.arange(b)[:, None], jnp.arange(k1)[None, :], draft_pad
+    ].set(jnp.where(valid_pad, _NEG_INF, cur))
+    fallback = jnp.where(
+        greedy[:, None],
+        argmax_tok,
+        jax.random.categorical(rng_fallback, masked, axis=-1).astype(
+            jnp.int32
+        ),
+    )
+    pos = jnp.arange(k1)[None, :]
+    tokens = jnp.where(pos < n_accepted[:, None], draft_pad, fallback)
+    logprobs = jnp.take_along_axis(
+        logp_dist, tokens[..., None], axis=-1
+    )[..., 0]
+    return tokens, logprobs, n_accepted

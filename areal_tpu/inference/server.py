@@ -26,7 +26,7 @@ from typing import Any
 from aiohttp import web
 
 from areal_tpu.api.cli_args import GenerationHyperparameters
-from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.api.io_struct import SERVER_CLIENT_MAX_SIZE, ModelResponse
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.utils import logging
 
@@ -71,8 +71,12 @@ class GenerationServer:
         self.engine = engine
         # must exceed the largest weight-resync chunk (WeightUpdateMeta
         # chunked_mem_mb defaults: http 512MB, shm 1024MB) plus safetensors
-        # header overhead — a 256MB cap 413'd the default http push
-        self.app = web.Application(client_max_size=2 * 1024**3)
+        # header overhead — a 256MB cap 413'd the default http push. The
+        # value lives in io_struct.SERVER_CLIENT_MAX_SIZE so the push side
+        # can validate a configured chunked_mem_mb against it client-side
+        # (remote_inf_engine.update_weights_from_tensors) instead of
+        # discovering the mismatch as a 413.
+        self.app = web.Application(client_max_size=SERVER_CLIENT_MAX_SIZE)
         self.app.add_routes(
             [
                 web.get("/health", self.health),
@@ -116,6 +120,13 @@ class GenerationServer:
                 "prefix_clone_count": e.prefix_clone_count,
                 "prefix_extend_count": e.prefix_extend_count,
                 "prefix_extend_saved_tokens": e.prefix_extend_saved_tokens,
+                # speculative decoding (spec_decode="ngram"): acceptance
+                # rate is the headline — it bounds the decode speedup at
+                # (1 + accepted/steps) tokens per dispatch
+                "spec_steps_total": e.spec_steps_total,
+                "spec_proposed_tokens_total": e.spec_proposed_tokens_total,
+                "spec_accepted_tokens_total": e.spec_accepted_tokens_total,
+                "spec_acceptance_rate": e.spec_acceptance_rate,
             }
         )
 

@@ -823,6 +823,44 @@ def decode_step_paged(
     return logits, new_cache
 
 
+def spec_verify_step_paged(
+    params: Params,
+    cfg: TransformerConfig,
+    cache: Params,  # paged pool {k, v: [L, NB, BS, KH, D]}
+    last_tokens: jnp.ndarray,  # [B] pending feed token per slot
+    draft: jnp.ndarray,  # [B, K] proposed continuation tokens (pad = any)
+    cache_len: jnp.ndarray,  # [B] valid tokens per sequence BEFORE this call
+    block_table: jnp.ndarray,  # [B, NBT] physical block ids (-1 = unmapped)
+    active: jnp.ndarray,  # [B] bool
+    attn_spec: AttnSpec | None = None,
+    pos_offset: jnp.ndarray | None = None,  # [B] rope shift (M-RoPE)
+) -> tuple[jnp.ndarray, Params]:
+    """Speculative-decoding verify step: score K drafted candidate tokens
+    for every slot in ONE static-shape paged dispatch.
+
+    Feeds ``[last_token, draft_0..draft_{K-1}]`` (K+1 tokens per slot)
+    through :func:`decode_step_paged`, whose per-query causal mask
+    (``decode_attention_xla``: query at position p attends kpos <= p only)
+    makes ``logits[:, t]`` the target distribution conditioned on exactly
+    the fed prefix through position t — the quantity the acceptance rule
+    (``sampling.spec_verify_tokens``) consumes. K/V rows for ALL fed
+    positions land in the pool (positions cache_len..cache_len+K); the
+    caller rolls back rejected tokens by simply not advancing ``cache_len``
+    past the accepted prefix — stale rows beyond it are overwritten
+    position-by-position before any later query can attend them, the same
+    invariant the padded suffix-extension path relies on.
+
+    Returns (logits [B, K+1, V] fp32, updated pool).
+    """
+    ids = jnp.concatenate(
+        [last_tokens[:, None], draft.astype(last_tokens.dtype)], axis=1
+    )
+    return decode_step_paged(
+        params, cfg, cache, ids, cache_len, block_table, active,
+        attn_spec=attn_spec, compute_logits=True, pos_offset=pos_offset,
+    )
+
+
 def prefill(
     params: Params,
     cfg: TransformerConfig,

@@ -19,6 +19,7 @@ timeout, so sympy hangs can't stall rollout.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Any
 
@@ -367,10 +368,22 @@ def math_equal(
                 return ip == ig or ip * 100 == ig or ip == ig * 100
             return ip == ig
         if float(gn).is_integer() or float(pn).is_integer():
-            # an integer-valued side demands exactness: the reference's
-            # blanket rel-tol 1e-4 accepts 13536 AND 13535.5 for a gold
-            # of 13535 (caught by the perturbed-MATH-500 probe)
-            return any(float(pn) == float(gv) for gv in golds)
+            # an integer-valued side demands near-exactness: the
+            # reference's blanket rel-tol 1e-4 accepts 13536 AND 13535.5
+            # for a gold of 13535 (caught by the perturbed-MATH-500
+            # probe). Formatting noise ("13535.0000001" for gold 13535)
+            # must still match, so require BOTH a tiny absolute bound
+            # (rejects off-by-one on billion-scale golds, where a lone
+            # rel-tol of 1e-9 would accept ±1) and a tiny relative bound
+            # (rejects tiny-magnitude wrongs a lone abs-tol would
+            # swallow: gold 5e-7 vs pred 0, or 0.9999995 vs 1).
+            return any(
+                abs(float(pn) - float(gv)) < 1e-6
+                and math.isclose(
+                    float(pn), float(gv), rel_tol=1e-9, abs_tol=1e-12
+                )
+                for gv in golds
+            )
         return any(_numeric_equal(pn, gv) for gv in golds)
     if (pn is None) != (gn is None):
         # one side is a plain number, the other symbolic (2\pi vs 6.2832):

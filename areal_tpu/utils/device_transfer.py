@@ -32,6 +32,29 @@ _LOCK = threading.Lock()
 _SERVER = None
 _CONNECTIONS: dict[str, object] = {}
 _UUID_COUNTER = 0
+# staged-but-unacknowledged bytes: stage_for_pull adds, ack_pulled (the
+# publisher, after every consumer confirmed its pull) subtracts. Entries
+# from FAILED pushes are never acked — their bytes stay on the books, and
+# each new push attempt logs the leak so mounting HBM pressure is visible
+# BEFORE it turns into opaque allocation failures.
+_STAGED_UNACKED_BYTES = 0
+
+
+def staged_unacked_bytes() -> int:
+    """Cumulative bytes staged via :func:`stage_for_pull` whose pulls were
+    never acknowledged — the device memory one-shot await_pull entries pin
+    until process exit."""
+    with _LOCK:
+        return _STAGED_UNACKED_BYTES
+
+
+def ack_pulled(nbytes: int) -> None:
+    """Publisher-side acknowledgement that consumers pulled ``nbytes``
+    worth of staged entries (e.g. every server's HTTP response arrived) —
+    those entries no longer pin device memory."""
+    global _STAGED_UNACKED_BYTES
+    with _LOCK:
+        _STAGED_UNACKED_BYTES = max(0, _STAGED_UNACKED_BYTES - int(nbytes))
 
 
 def next_uuid_block(count: int) -> int:
@@ -40,12 +63,23 @@ def next_uuid_block(count: int) -> int:
     await_pull entries are one-shot and cannot be withdrawn: a FAILED push
     attempt leaves its staged entries registered (bounded device memory
     held until process exit). Fresh uuids per attempt guarantee a retry
-    can never consume a stale staged chunk from the failed one."""
+    can never consume a stale staged chunk from the failed one. Called
+    once per push attempt, so this is where a leak from earlier attempts
+    gets surfaced."""
     global _UUID_COUNTER
     with _LOCK:
+        leaked = _STAGED_UNACKED_BYTES
         base = _UUID_COUNTER
         _UUID_COUNTER += count
-        return base
+    if leaked:
+        logger.warning(
+            "starting a push attempt with %.1f MB of staged-but-unpulled "
+            "transfer entries from earlier failed attempts still pinning "
+            "device memory (one-shot await_pull entries cannot be "
+            "withdrawn; they free only on process exit)",
+            leaked / 1e6,
+        )
+    return base
 
 
 def transfer_server(bind_host: str | None = None):
@@ -85,9 +119,26 @@ def connect(address: str):
         return conn
 
 
-def stage_for_pull(uuid: int, arrays) -> None:
-    """Publish a pytree for exactly one remote ``pull(uuid, ...)``."""
+def stage_for_pull(uuid: int, arrays, account: bool = True) -> int:
+    """Publish a pytree for exactly one remote ``pull(uuid, ...)``.
+    Returns the byte count of ``arrays`` (pass it to :func:`ack_pulled`
+    once the consumer confirmed the pull). ``account=False`` skips the
+    unacked-bytes ledger: when the SAME array set is staged under several
+    uuids (one per consumer), the underlying buffers are shared and pin
+    device memory once — account only the first staging, or the leak
+    warning overstates by the consumer count."""
+    global _STAGED_UNACKED_BYTES
+    import jax
+
+    nbytes = sum(
+        int(getattr(leaf, "nbytes", leaf.size * leaf.dtype.itemsize))
+        for leaf in jax.tree_util.tree_leaves(arrays)
+    )
+    if account:
+        with _LOCK:
+            _STAGED_UNACKED_BYTES += nbytes
     transfer_server().await_pull(uuid, arrays)
+    return nbytes
 
 
 def pull(address: str, uuid: int, specs):

@@ -380,12 +380,20 @@ def sft_bench(
 
 def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
                  new_tokens: int = 128, batch: int = 48, steps_per_call: int = 32,
-                 vocab: int = 151936, max_seq_len: int = 512):
+                 vocab: int = 151936, max_seq_len: int = 512,
+                 spec_decode: str = "none", spec_draft_len: int = 4,
+                 repetitive: bool = False, greedy: bool = False):
     """Continuous-batching decode throughput on the GenerationEngine.
 
     Decode is HBM-bound (every step re-reads the 3GB bf16 params), so
     aggregate tokens/s scales with concurrent slots until compute-bound;
-    the batch value is picked to fit KV + params + logits in 16GB."""
+    the batch value is picked to fit KV + params + logits in 16GB.
+
+    ``spec_decode="ngram"`` turns on draft-free speculative decoding;
+    ``repetitive=True`` tiles each prompt from a short random base so the
+    n-gram proposer has structure to latch onto (the reasoning/math
+    regime), and ``greedy=True`` makes acceptance deterministic. Returns
+    {"tps", "spec_acceptance_rate", "spec_steps"}."""
     import threading
 
     import numpy as np
@@ -404,6 +412,8 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
             # cost of post-EOS overshoot — fine for fixed-length decode
             decode_steps_per_call=steps_per_call,
             dtype="bfloat16",
+            spec_decode=spec_decode,
+            spec_draft_len=spec_draft_len,
         ),
         model_config=model_cfg,
     )
@@ -420,17 +430,27 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
                 if len(results) >= n_requests:
                     done.set()
 
+        def make_prompt():
+            if repetitive:
+                base = rng.integers(
+                    1, vocab - 2, size=max(4, prompt_len // 8)
+                ).tolist()
+                return (base * (prompt_len // len(base) + 1))[:prompt_len]
+            return rng.integers(1, vocab - 2, size=prompt_len).tolist()
+
         gconfig = GenerationHyperparameters(
-            max_new_tokens=new_tokens, min_new_tokens=new_tokens, temperature=1.0
+            max_new_tokens=new_tokens, min_new_tokens=new_tokens,
+            temperature=1.0, greedy=greedy,
         )
 
         # warmup: compile prefill buckets + decode before the timed window
         warm = threading.Event()
         eng.submit(
             "warm",
-            rng.integers(1, vocab - 2, size=prompt_len).tolist(),
+            make_prompt(),
             GenerationHyperparameters(
-                max_new_tokens=16, min_new_tokens=16, temperature=1.0
+                max_new_tokens=16, min_new_tokens=16, temperature=1.0,
+                greedy=greedy,
             ),
             lambda r: warm.set(),
         )
@@ -438,12 +458,15 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
 
         t0 = time.perf_counter()
         for i in range(n_requests):
-            prompt = rng.integers(1, vocab - 2, size=prompt_len).tolist()
-            eng.submit(f"bench-{i}", prompt, gconfig, cb)
+            eng.submit(f"bench-{i}", make_prompt(), gconfig, cb)
         assert done.wait(1200), "decode bench timed out"
         dt = time.perf_counter() - t0
         total_out = sum(len(r.output_tokens) for r in results)
-        return total_out / dt
+        return {
+            "tps": total_out / dt,
+            "spec_acceptance_rate": eng.spec_acceptance_rate,
+            "spec_steps": eng.spec_steps_total,
+        }
     finally:
         eng.stop()
 
@@ -776,6 +799,53 @@ def main():
         except Exception as e:  # noqa: BLE001
             log(f"decode bench failed at {datt}: {e}")
 
+    # ---- rung 3.2: speculative decode — spec-on vs spec-off on a
+    # repetitive-prompt workload (n-gram prompt-lookup regime), same
+    # engine config, greedy so acceptance is deterministic. vs_baseline
+    # here is the spec-on / spec-off throughput ratio. ----
+    if remaining(deadline) > 420:
+        satt = dict(
+            n_requests=96, batch=48, steps_per_call=32, prompt_len=256,
+            new_tokens=256, repetitive=True, greedy=True,
+        )
+        if REHEARSAL:
+            satt = dict(
+                n_requests=4, batch=2, steps_per_call=4, prompt_len=32,
+                new_tokens=32, vocab=2048, max_seq_len=128,
+                repetitive=True, greedy=True,
+            )
+        satt["layers"] = (used or {"layers": 2 if REHEARSAL else 28})[
+            "layers"
+        ]
+        try:
+            log(f"spec decode rung: {satt}")
+            s_off = _run_child(
+                "decode", {**satt, "spec_decode": "none"},
+                timeout=min(1800.0, remaining(deadline) - 60),
+            )
+            s_on = _run_child(
+                "decode", {**satt, "spec_decode": "ngram"},
+                timeout=min(1800.0, remaining(deadline) - 60),
+            )
+            emit({
+                "metric": "spec_decode_tokens_per_sec",
+                "value": round(s_on["tps"], 1),
+                "unit": "tokens/s",
+                "vs_baseline": (
+                    round(s_on["tps"] / s_off["tps"], 3)
+                    if s_off["tps"] else None
+                ),
+                "spec_off_tokens_per_sec": round(s_off["tps"], 1),
+                "spec_acceptance_rate": round(
+                    s_on["spec_acceptance_rate"], 4
+                ),
+                "spec_steps": s_on["spec_steps"],
+                "chip": chip,
+                **satt,
+            })
+        except Exception as e:  # noqa: BLE001
+            log(f"spec decode rung failed: {e}")
+
     # ---- rung 3.5: weight-resync latency (shm vs http, VERDICT r3 #8) ----
     if remaining(deadline) > 420:
         try:
@@ -847,7 +917,7 @@ def _child_main():
         tps, mfu_v = sft_bench(**att)
         print(json.dumps({"tps": tps, "mfu": mfu_v}))
     elif kind == "--decode-child":
-        print(json.dumps({"tps": decode_bench(**att)}))
+        print(json.dumps(decode_bench(**att)))
     elif kind == "--wu-child":
         print(json.dumps(weight_update_bench(**att)))
     elif kind == "--grpo-child":

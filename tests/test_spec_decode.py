@@ -1,0 +1,405 @@
+"""Draft-free (n-gram) speculative decoding: proposer, acceptance rule,
+KV rollback, stop-mid-window truncation, and server metrics.
+
+The load-bearing guarantees (ISSUE 1): greedy spec-on output is
+token-identical to spec-off, sampled output keeps the exact modified
+distribution (rejection sampling), and a partial rejection leaves the
+paged-KV bookkeeping byte-consistent because rollback is just "don't
+advance cache_len past the accepted prefix".
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.inference import engine as engine_mod
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.ngram import ngram_propose
+from areal_tpu.inference.sampling import spec_verify_tokens
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import forward_packed, init_params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(model, start=True, **kw):
+    cfg, params = model
+    defaults = dict(
+        max_batch_size=2,
+        max_seq_len=512,
+        prefill_chunk=64,
+        decode_steps_per_call=4,
+        dtype="float32",
+        spec_decode="ngram",
+        spec_draft_len=4,
+    )
+    defaults.update(kw)
+    eng = GenerationEngine(
+        JaxGenConfig(**defaults), model_config=cfg, params=params
+    )
+    if start:
+        eng.start()
+    return eng
+
+
+def run_request(eng, rid, prompt, gconfig, timeout=300.0):
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(rid, prompt, gconfig, cb)
+    assert done.wait(timeout), "generation timed out"
+    return out["r"]
+
+
+def greedy_reference(model, prompt, n):
+    """Token-by-token greedy reference via the packed forward."""
+    cfg, params = model
+    ids = list(prompt)
+    ref = []
+    for _ in range(n):
+        t = len(ids)
+        logits = forward_packed(
+            params,
+            cfg,
+            jnp.asarray(ids, jnp.int32),
+            jnp.arange(t, dtype=jnp.int32),
+            jnp.zeros(t, jnp.int32),
+        )
+        tok = int(jnp.argmax(logits[-1]))
+        ref.append(tok)
+        ids.append(tok)
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# Proposer
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_propose_basics():
+    # suffix [1,2,3] recurs at the start; continuation follows it
+    assert ngram_propose([1, 2, 3, 4, 1, 2, 3], 1, 4, 4) == [4, 1, 2, 3]
+    # no repetition at all -> no proposal
+    assert ngram_propose([5, 6, 7], 1, 4, 4) == []
+    # constant run: prefers a match with a FULL continuation window
+    assert ngram_propose([9] * 10, 1, 4, 4) == [9, 9, 9, 9]
+    # draft_len caps the proposal
+    assert ngram_propose([1, 2, 1, 2, 1, 2], 1, 4, 2) == [1, 2]
+    # min_n too large for the history -> nothing
+    assert ngram_propose([1, 2], 3, 4, 4) == []
+
+
+# ---------------------------------------------------------------------------
+# Acceptance rule (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_verify_preserves_sampling_distribution():
+    """Rejection sampling against the deterministic n-gram proposal must
+    leave the emitted token distributed EXACTLY as plain sampling from the
+    modified distribution — the property that makes spec decoding safe for
+    RL rollouts (the behavior policy is unchanged)."""
+    v = 8
+    logits = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 2, v)), jnp.float32
+    )
+    draft = jnp.asarray([[3]], jnp.int32)  # propose token 3 at position 0
+    draft_len = jnp.asarray([1], jnp.int32)
+    temp = jnp.ones(1, jnp.float32)
+    top_k = jnp.zeros(1, jnp.int32)
+    top_p = jnp.ones(1, jnp.float32)
+    greedy = jnp.zeros(1, bool)
+
+    @jax.jit
+    def first_token(key):
+        toks, _, _ = spec_verify_tokens(
+            logits, draft, draft_len, key, temp, top_k, top_p, greedy
+        )
+        return toks[0, 0]
+
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(1), n)
+    toks = np.asarray(jax.vmap(first_token)(keys))
+    emp = np.bincount(toks, minlength=v) / n
+    expect = np.asarray(jax.nn.softmax(logits[0, 0]))
+    np.testing.assert_allclose(emp, expect, atol=0.035)
+
+
+def test_spec_verify_greedy_rule():
+    """Greedy rows accept exactly the argmax-matching prefix and emit the
+    argmax at the first mismatch / as the bonus token."""
+    v = 16
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(2, 4, v)), jnp.float32)
+    am = np.asarray(jnp.argmax(logits, axis=-1))  # [2, 4]
+    # row 0: first two drafts right, third wrong; row 1: all three right
+    draft = np.stack(
+        [
+            [am[0, 0], am[0, 1], (am[0, 2] + 1) % v],
+            [am[1, 0], am[1, 1], am[1, 2]],
+        ]
+    ).astype(np.int32)
+    toks, logps, n_acc = spec_verify_tokens(
+        jnp.asarray(logits),
+        jnp.asarray(draft),
+        jnp.asarray([3, 3], jnp.int32),
+        jax.random.PRNGKey(0),
+        jnp.ones(2, jnp.float32),
+        jnp.zeros(2, jnp.int32),
+        jnp.ones(2, jnp.float32),
+        jnp.ones(2, bool),
+    )
+    toks, n_acc = np.asarray(toks), np.asarray(n_acc)
+    assert n_acc.tolist() == [2, 3]
+    # row 0 emits the accepted prefix + the argmax correction
+    assert toks[0, :3].tolist() == [am[0, 0], am[0, 1], am[0, 2]]
+    # row 1 emits all drafts + the bonus argmax
+    assert toks[1, :4].tolist() == am[1].tolist()
+    assert bool(np.all(np.asarray(logps) <= 0))
+
+
+# ---------------------------------------------------------------------------
+# (a) greedy spec-on == spec-off
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_spec_matches_spec_off(model):
+    prompt = [7, 11, 13, 5] * 6  # repetitive: the n-gram regime
+    n = 24
+    eng_off = make_engine(model, spec_decode="none")
+    try:
+        r_off = run_request(
+            eng_off, "off", prompt,
+            GenerationHyperparameters(max_new_tokens=n, greedy=True),
+        )
+    finally:
+        eng_off.stop()
+    eng_on = make_engine(model)
+    try:
+        r_on = run_request(
+            eng_on, "on", prompt,
+            GenerationHyperparameters(max_new_tokens=n, greedy=True),
+        )
+        assert r_on.output_tokens == r_off.output_tokens
+        assert len(r_on.output_logprobs) == n
+        np.testing.assert_allclose(
+            r_on.output_logprobs, r_off.output_logprobs, rtol=1e-4, atol=1e-5
+        )
+        assert r_on.output_versions == [0] * n
+        # the greedy attractor tail must actually exercise acceptance
+        assert eng_on.spec_steps_total > 0
+        assert eng_on.spec_accepted_tokens_total > 0
+    finally:
+        eng_on.stop()
+
+
+# ---------------------------------------------------------------------------
+# (b) KV rollback after partial rejection
+# ---------------------------------------------------------------------------
+
+
+def test_kv_rollback_consistent_after_partial_rejection(
+    model, monkeypatch
+):
+    """Force a mid-window rejection with a known-wrong draft, then keep
+    decoding: cache_len / covered-rows / block accounting must stay
+    consistent and later tokens must still match the greedy reference —
+    i.e. the stale rows past the accepted prefix are really dead."""
+    cfg, params = model
+    prompt = [5, 9, 3, 7, 2]
+    ref = greedy_reference(model, prompt, 10)
+    eng = make_engine(model, start=False)
+    calls = {"n": 0}
+
+    def scripted_propose(hist, min_n, max_n, k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # first window: accept ref[1], reject the wrong second draft
+            return [ref[1], (ref[2] + 1) % cfg.vocab_size, 0, 0]
+        return []  # later windows: plain decode path
+
+    monkeypatch.setattr(engine_mod, "ngram_propose", scripted_propose)
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(
+        "rb", prompt,
+        GenerationHyperparameters(max_new_tokens=10, greedy=True), cb,
+    )
+    # drive the loop synchronously (no engine thread): prefill then windows
+    eng._admit()
+    assert eng.slots[0] is not None and eng.slots[0].rid == "rb"
+    seq = eng.slots[0]
+    eng._decode_chunk()  # the speculative window with the scripted draft
+    assert calls["n"] == 1
+    assert eng.spec_steps_total == 1
+    assert eng.spec_proposed_tokens_total == 4
+    assert eng.spec_accepted_tokens_total == 1  # ref[1] accepted, rest cut
+    # prefill token + accepted draft + the argmax correction
+    assert seq.out_tokens == ref[:3]
+    # ROLLBACK: cache_len advanced by exactly the emitted tokens, not the
+    # full window width
+    assert int(eng.cache_len[0]) == len(prompt) + 2
+    assert eng._slot_covered[0] == prompt + ref[:2]
+    assert int(eng._slot_nblocks[0]) >= eng.pool.blocks_for_tokens(
+        int(eng.cache_len[0])
+    )
+    blks = eng.block_table[0, : int(eng._slot_nblocks[0])]
+    assert (blks >= 0).all() and (eng.pool.ref[blks] >= 1).all()
+    # continue to completion on the plain path: stale rows must not leak
+    # into attention
+    while eng.slots[0] is not None:
+        eng._decode_chunk()
+    assert done.wait(5)
+    assert out["r"].output_tokens == ref
+    assert int(eng.cache_len[0]) == len(eng._slot_covered[0])
+
+
+# ---------------------------------------------------------------------------
+# (c) stop token inside an accepted window truncates
+# ---------------------------------------------------------------------------
+
+
+def test_stop_token_mid_window_truncates(model, monkeypatch):
+    """A stop token in the MIDDLE of a fully-accepted window must end the
+    request right there: later accepted tokens are dropped and cache_len
+    stays at the last emitted row."""
+    prompt = [109, 50, 98, 114, 54]  # greedy continuation has distinct
+    # early tokens, so the stop token cannot fire before the window
+    ref = greedy_reference(model, prompt, 6)
+    assert ref[2] not in ref[:2], "prompt choice: stop must hit mid-window"
+    eng = make_engine(model, start=False)
+
+    def scripted_propose(hist, min_n, max_n, k):
+        if len(hist) == len(prompt) + 1:  # first window only
+            return ref[1:5]  # the true greedy continuation: all accepted
+        return []
+
+    monkeypatch.setattr(engine_mod, "ngram_propose", scripted_propose)
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(
+        "st", prompt,
+        GenerationHyperparameters(
+            max_new_tokens=10, greedy=True, stop_token_ids=[ref[2]]
+        ),
+        cb,
+    )
+    eng._admit()
+    eng._decode_chunk()
+    assert done.wait(5), "stop token did not finish the request"
+    r = out["r"]
+    # window emitted [ref1 ref2 ref3 ref4 bonus] worth of candidates but
+    # the request truncates at ref[2] (position 2 of the window)
+    assert r.output_tokens == ref[:3]
+    assert r.stop_reason == "stop"
+    assert eng.spec_accepted_tokens_total == 4  # all drafts verified fine
+    # slot released; rows cover exactly prompt + emitted-minus-pending
+    assert eng.slots[0] is None
+    assert int(eng.cache_len[0]) == len(prompt) + 2
+    assert eng._slot_covered[0] == prompt + ref[:2]
+
+
+# ---------------------------------------------------------------------------
+# sampled path: mechanics under temperature > 0
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_spec_decode_mechanics(model):
+    prompt = [7, 11, 13, 5] * 6
+    eng = make_engine(model)
+    try:
+        r = run_request(
+            eng, "s", prompt,
+            GenerationHyperparameters(max_new_tokens=16, temperature=1.0),
+        )
+        assert len(r.output_tokens) == 16
+        assert len(r.output_logprobs) == 16
+        assert all(lp <= 0 for lp in r.output_logprobs)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# (d) acceptance counters in server metrics
+# ---------------------------------------------------------------------------
+
+
+def test_spec_counters_in_server_metrics(model):
+    import asyncio
+
+    from areal_tpu.inference.server import GenerationServer
+
+    eng = make_engine(model, start=False)
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=60)
+        body = json.dumps(
+            {
+                "rid": "m1",
+                "input_ids": [7, 11, 13, 5] * 6,
+                "sampling_params": {"max_new_tokens": 24, "greedy": True},
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert len(resp["output_tokens"]) == 24
+        info = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/model_info", timeout=30
+            ).read()
+        )
+        assert info["spec_steps_total"] > 0
+        assert info["spec_proposed_tokens_total"] > 0
+        assert info["spec_accepted_tokens_total"] > 0
+        assert (
+            0.0
+            < info["spec_acceptance_rate"]
+            == info["spec_accepted_tokens_total"]
+            / info["spec_proposed_tokens_total"]
+        )
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
