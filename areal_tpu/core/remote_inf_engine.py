@@ -64,7 +64,7 @@ class RemoteInfEngine(InferenceEngine):
         self.config = config
         self.addresses: list[str] = []
         self._server_idx = 0
-        self._inflight: dict[str, int] = {}  # addr -> my in-flight requests
+        self._inflight: dict[str, int] = {}  # guarded_by: _inflight_lock
         self._inflight_lock = threading.Lock()  # agenerate runs on the
         # rollout thread's loop while generate() may run on a caller thread
         self._rid_to_address: dict[str, str] = {}

@@ -29,7 +29,7 @@ class StalenessManager:
         self.consumer_batch_size = consumer_batch_size
         self.max_staleness = max_staleness
         self._lock = threading.Lock()
-        self._stat = RolloutStat()
+        self._stat = RolloutStat()  # guarded_by: _lock
 
     def get_capacity(self, current_version: int) -> int:
         """Available rollout slots at ``current_version`` (may be negative)."""

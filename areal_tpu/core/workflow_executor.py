@@ -108,7 +108,7 @@ class WorkflowExecutor:
         self.exiting = threading.Event()
         self.paused = threading.Event()
         self._exc_lock = threading.Lock()
-        self._thread_exc: BaseException | None = None
+        self._thread_exc: BaseException | None = None  # guarded_by: _exc_lock
         self.rollout_thread: threading.Thread | None = None
 
     # ----------------------------------------------------------- lifecycle

@@ -1891,6 +1891,7 @@ class GenerationEngine:
                 dlen[i] = len(prop)
         return draft, dlen
 
+    # arealint: hot-path
     def _try_spec_decode_chunk(self) -> bool:
         """One speculative window: propose drafts, verify all of them in a
         single K+1-token dispatch, emit the accepted prefix + one
@@ -1942,9 +1943,11 @@ class GenerationEngine:
             jnp.asarray(greedy),
             jnp.asarray(self.pos_delta),
         )
-        toks = np.asarray(toks)  # [B, K+1]
-        logps = np.asarray(logps)
-        n_acc = np.asarray(n_acc)
+        # intended sync: the verify window is over; sampled tokens must
+        # reach python to be emitted / checked for stop conditions
+        toks = np.asarray(toks)  # [B, K+1]  # arealint: disable=host-sync-in-hot-path
+        logps = np.asarray(logps)  # arealint: disable=host-sync-in-hot-path
+        n_acc = np.asarray(n_acc)  # arealint: disable=host-sync-in-hot-path
         self.spec_steps_total += 1
         self.spec_proposed_tokens_total += int(dlen.sum())
         self.spec_accepted_tokens_total += int(n_acc.sum())
@@ -1963,6 +1966,7 @@ class GenerationEngine:
                     break
         return True
 
+    # arealint: hot-path
     def _decode_chunk(self):
         if self._spec_enabled and self._try_spec_decode_chunk():
             return
@@ -1993,8 +1997,10 @@ class GenerationEngine:
             jnp.asarray(self.pos_delta),
             steps=steps,
         )
-        toks = np.asarray(toks)  # [steps, B]
-        logps = np.asarray(logps)
+        # intended sync: one pull per steps_per_call-token window (already
+        # amortized); tokens must reach python to be emitted
+        toks = np.asarray(toks)  # [steps, B]  # arealint: disable=host-sync-in-hot-path
+        logps = np.asarray(logps)  # arealint: disable=host-sync-in-hot-path
         now = time.monotonic()
         for i, seq in enumerate(self.slots):
             if seq is None:

@@ -1,0 +1,20 @@
+"""arealint: JAX/async-aware static analysis for areal_tpu.
+
+Usage::
+
+    python -m areal_tpu.lint areal_tpu tests --baseline .arealint-baseline.json
+
+See docs/lint_rules.md for the rule catalog, suppression syntax, and the
+baseline workflow.
+"""
+
+from areal_tpu.lint.framework import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    apply_baseline,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)

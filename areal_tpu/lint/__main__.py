@@ -1,0 +1,150 @@
+"""arealint CLI: ``python -m areal_tpu.lint <paths>`` (also installed as
+``areal-tpu-lint``).
+
+Exit codes: 0 clean (warnings alone don't fail unless ``--strict``),
+1 findings, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from areal_tpu.lint import framework
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="areal-tpu-lint",
+        description=(
+            "JAX/async-aware static analysis for areal_tpu (use-after-"
+            "donate, PRNG reuse, blocking-call-in-async, jax-compat, ...)"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["areal_tpu"],
+        help="files or directories to lint (default: areal_tpu)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON baseline of accepted pre-existing findings",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current ERROR findings to --baseline (or "
+        ".arealint-baseline.json) and exit 0",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    p.add_argument(
+        "--select",
+        metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore",
+        metavar="RULES",
+        help="comma-separated rule ids to skip",
+    )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="warnings also fail the run",
+    )
+    p.add_argument(
+        "--no-config",
+        action="store_true",
+        help="ignore [tool.arealint] per_path_ignores from pyproject.toml",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    p.add_argument(
+        "--show-baselined",
+        action="store_true",
+        help="also print findings matched by the baseline",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = framework.all_rules()
+
+    if args.list_rules:
+        width = max(len(r) for r in rules)
+        for rid in sorted(rules):
+            rule = rules[rid]
+            print(f"{rid:<{width}}  [{rule.severity}]  {rule.doc}")
+        return 0
+
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - set(rules)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in rules.items() if k in wanted}
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",") if r.strip()}
+        unknown = dropped - set(framework.all_rules())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in rules.items() if k not in dropped}
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"no such path: {path}", file=sys.stderr)
+            return 2
+
+    findings = framework.lint_paths(args.paths, rules)
+    if not args.no_config:
+        findings = framework.apply_per_path_ignores(
+            findings, framework.load_per_path_ignores()
+        )
+
+    if args.write_baseline:
+        target = args.baseline or ".arealint-baseline.json"
+        framework.write_baseline(
+            target,
+            [f for f in findings if f.severity == framework.SEVERITY_ERROR],
+        )
+        print(f"wrote baseline to {target}")
+        return 0
+
+    baselined: list[framework.Finding] = []
+    if args.baseline:
+        entries = framework.load_baseline(args.baseline)
+        findings, baselined = framework.apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(framework.render_json(findings, baselined))
+    else:
+        shown = findings + (baselined if args.show_baselined else [])
+        shown.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        print(framework.render_text(shown, baselined))
+
+    failing = [
+        f
+        for f in findings
+        if f.severity == framework.SEVERITY_ERROR or args.strict
+    ]
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,501 @@
+"""arealint core: rule registry, per-file analysis context, suppressions,
+baseline, and reporters.
+
+The async design lives or dies on invariants no general linter checks:
+donated buffers must not be touched after a jitted call, PRNG keys must
+never feed two sampling calls, the rollout event loop must never block, and
+``# guarded_by:``-annotated state must be accessed under its lock. Rules
+here are AST-based, import-alias-aware (``import numpy as np`` resolves
+``np.asarray`` to ``numpy.asarray``), and deliberately repo-specific —
+precision over generality, with fixtures under ``tests/lint_fixtures/``
+pinning every rule's true-positive and true-negative behavior.
+
+Inline controls (comments):
+
+- ``# arealint: disable=<rule>[,<rule>...]`` — suppress on this line.
+- ``# arealint: disable-next-line=<rule>[,...]`` — suppress on the next line.
+- ``# arealint: skip-file`` — skip the whole file.
+- ``# arealint: hot-path`` — on/above a ``def``: mark it a decode/verify hot
+  loop for the host-sync-in-hot-path rule.
+- ``# guarded_by: <lock>`` — trailing an ``__init__`` attribute assignment:
+  every other access to that attribute must sit inside ``with self.<lock>:``.
+
+Baseline: a committed JSON file of pre-existing findings keyed on
+``(rule, path, message)`` — line-number-independent so unrelated edits don't
+churn it. ``--write-baseline`` regenerates it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import tokenize
+from typing import Iterable, Iterator
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+#: directory components skipped when expanding directory arguments
+#: (explicit file arguments always lint — that is how fixture tests run)
+DEFAULT_EXCLUDED_DIRS = {
+    "__pycache__",
+    "build",
+    "lint_fixtures",  # deliberate violations pinning rule behavior
+    ".git",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = SEVERITY_ERROR
+
+    def key(self) -> tuple[str, str, str]:
+        """Line-independent identity used for baseline matching."""
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class. Subclasses set ``id``/``severity``/``doc`` and implement
+    ``check(ctx)`` yielding Findings."""
+
+    id: str = ""
+    severity: str = SEVERITY_ERROR
+    doc: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: "FileContext", node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            severity=self.severity,
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # importing the package registers every rule module
+    from areal_tpu.lint import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+# ---------------------------------------------------------------------------
+
+
+class FileContext:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.skip_file = False
+        #: line -> set of suppressed rule ids ("*" = all)
+        self.disables: dict[int, set[str]] = {}
+        #: lines carrying an ``# arealint: hot-path`` marker
+        self.hot_lines: set[int] = set()
+        #: line -> lock name from ``# guarded_by: <lock>``
+        self.guarded_by: dict[int, str] = {}
+        self._scan_comments()
+        #: local name -> canonical dotted module/object path from imports
+        self.aliases = self._collect_aliases()
+        self._parents: dict[ast.AST, ast.AST] | None = None
+        self._stmt_spans: list[tuple[int, int]] | None = None
+
+    # -- comments -----------------------------------------------------------
+
+    def _scan_comments(self):
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (t.start[0], t.string)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:
+            comments = []
+        for line, text in comments:
+            body = text.lstrip("#").strip()
+            if "guarded_by:" in body:
+                lock = body.split("guarded_by:", 1)[1].strip().split()[0]
+                if lock:
+                    self.guarded_by[line] = lock.removeprefix("self.")
+                continue
+            # directives may trail prose: "# intentional  # arealint: ..."
+            if "arealint:" not in body:
+                continue
+            directive = body.split("arealint:", 1)[1].strip()
+            if directive == "skip-file":
+                self.skip_file = True
+            elif directive == "hot-path":
+                self.hot_lines.add(line)
+            elif directive.startswith("disable-next-line="):
+                ids = directive.split("=", 1)[1]
+                self.disables.setdefault(line + 1, set()).update(
+                    r.strip() for r in ids.split(",") if r.strip()
+                )
+            elif directive.startswith("disable="):
+                ids = directive.split("=", 1)[1]
+                self.disables.setdefault(line, set()).update(
+                    r.strip() for r in ids.split(",") if r.strip()
+                )
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        """A disable applies to every line of the innermost statement
+        containing the finding (pylint semantics) — reformatting a
+        suppressed call across lines must not re-arm it."""
+        for line in self._statement_span(finding.line):
+            ids = self.disables.get(line)
+            if ids and (finding.rule in ids or "*" in ids):
+                return True
+        return False
+
+    def _statement_span(self, line: int) -> range:
+        if self._stmt_spans is None:
+            self._stmt_spans = sorted(
+                {
+                    (n.lineno, n.end_lineno or n.lineno)
+                    for n in ast.walk(self.tree)
+                    if isinstance(n, ast.stmt)
+                }
+            )
+        covering = [
+            (lo, hi) for lo, hi in self._stmt_spans if lo <= line <= hi
+        ]
+        if not covering:
+            return range(line, line + 1)
+        lo, hi = min(covering, key=lambda s: s[1] - s[0])  # innermost
+        return range(lo, hi + 1)
+
+    def is_hot(self, func: ast.AST) -> bool:
+        """A def is hot when ``# arealint: hot-path`` sits on the def line,
+        the line above it, or a decorator line."""
+        lines = {func.lineno, func.lineno - 1}
+        for dec in getattr(func, "decorator_list", []):
+            lines.add(dec.lineno)
+            lines.add(dec.lineno - 1)
+        return bool(lines & self.hot_lines)
+
+    # -- imports / name resolution -----------------------------------------
+
+    def _collect_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        # ``import a.b.c`` binds root name ``a`` to module a
+                        root = a.name.split(".")[0]
+                        aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{mod}.{a.name}"
+        return aliases
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """Raw dotted chain for Name/Attribute nodes (``self.cache``,
+        ``jax.jit``); None for anything else."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolved(self, node: ast.AST) -> str | None:
+        """Dotted chain with the root resolved through import aliases:
+        ``pltpu.CompilerParams`` ->
+        ``jax.experimental.pallas.tpu.CompilerParams``."""
+        raw = self.dotted(node)
+        if raw is None:
+            return None
+        root, _, rest = raw.partition(".")
+        base = self.aliases.get(root)
+        if base is None:
+            return raw
+        return f"{base}.{rest}" if rest else base
+
+    # -- tree helpers -------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        if self._parents is None:
+            self._parents = {}
+            for p in ast.walk(self.tree):
+                for c in ast.iter_child_nodes(p):
+                    self._parents[c] = p
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt:
+        cur: ast.AST = node
+        while not isinstance(cur, ast.stmt):
+            nxt = self.parent(cur)
+            if nxt is None:
+                break
+            cur = nxt
+        return cur  # type: ignore[return-value]
+
+    def functions(self) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+def walk_excluding_nested_functions(
+    func: ast.AST, *, include_async: bool = False
+) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/lambda scopes
+    (their bindings are separate scopes; mixing them in causes false
+    positives). ``include_async`` keeps nested ``async def`` bodies — useful
+    when the outer analysis owns the event loop."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.FunctionDef):
+            continue
+        if isinstance(node, ast.AsyncFunctionDef) and not include_async:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d
+                    for d in dirs
+                    if d not in DEFAULT_EXCLUDED_DIRS and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_file(
+    path: str,
+    rules: dict[str, Rule] | None = None,
+    source: str | None = None,
+) -> list[Finding]:
+    """All unsuppressed findings for one file (baseline not applied here)."""
+    rules = rules if rules is not None else all_rules()
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        ctx = FileContext(norm, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="parse-error",
+                path=norm,
+                line=e.lineno or 0,
+                col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+    if ctx.skip_file:
+        return []
+    findings: list[Finding] = []
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(
+    paths: Iterable[str], rules: dict[str, Rule] | None = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project config (CLI-layer only; lint_file/lint_paths stay config-free so
+# fixture tests see raw rule behavior)
+# ---------------------------------------------------------------------------
+
+
+def load_per_path_ignores(root: str = ".") -> dict[str, set[str]]:
+    """``[tool.arealint] per_path_ignores`` from pyproject.toml: path-prefix
+    -> rule ids to drop there (e.g. the one-shot ``jax.jit(f)(x)`` test
+    idiom under ``tests/``)."""
+    try:
+        import tomllib
+    except ImportError:
+        try:
+            import tomli as tomllib  # py3.10 (tomli ships with the image)
+        except ImportError:
+            return {}  # config is best-effort
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    section = data.get("tool", {}).get("arealint", {})
+    return {
+        prefix: set(rules)
+        for prefix, rules in section.get("per_path_ignores", {}).items()
+    }
+
+
+def apply_per_path_ignores(
+    findings: list[Finding], ignores: dict[str, set[str]]
+) -> list[Finding]:
+    if not ignores:
+        return findings
+    return [
+        f
+        for f in findings
+        if not any(
+            f.path.startswith(prefix) and f.rule in rules
+            for prefix, rules in ignores.items()
+        )
+    ]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return data["entries"]
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        {f.key() for f in findings},
+    )
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "Pre-existing findings accepted by arealint. Keys are "
+            "(rule, path, message) — line-independent. Regenerate with "
+            "`python -m areal_tpu.lint <paths> --write-baseline`."
+        ),
+        "entries": [
+            {"rule": r, "path": p, "message": m} for (r, p, m) in entries
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[Finding]]:
+    """Split into (new, baselined). A baseline entry matches every finding
+    with the same (rule, path, message)."""
+    accepted = {(e["rule"], e["path"], e["message"]) for e in entries}
+    new = [f for f in findings if f.key() not in accepted]
+    old = [f for f in findings if f.key() in accepted]
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+def render_text(
+    findings: list[Finding], baselined: list[Finding] | None = None
+) -> str:
+    out = []
+    for f in findings:
+        out.append(
+            f"{f.path}:{f.line}:{f.col + 1}: [{f.severity}] {f.rule}: "
+            f"{f.message}"
+        )
+    n_err = sum(1 for f in findings if f.severity == SEVERITY_ERROR)
+    n_warn = len(findings) - n_err
+    summary = f"arealint: {n_err} error(s), {n_warn} warning(s)"
+    if baselined:
+        summary += f", {len(baselined)} baselined"
+    out.append(summary)
+    return "\n".join(out)
+
+
+def render_json(
+    findings: list[Finding], baselined: list[Finding] | None = None
+) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in findings],
+            "baselined": [f.to_dict() for f in (baselined or [])],
+            "summary": {
+                "errors": sum(
+                    1 for f in findings if f.severity == SEVERITY_ERROR
+                ),
+                "warnings": sum(
+                    1 for f in findings if f.severity == SEVERITY_WARNING
+                ),
+                "baselined": len(baselined or []),
+            },
+        },
+        indent=2,
+    )

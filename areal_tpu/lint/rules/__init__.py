@@ -1,0 +1,10 @@
+"""Rule modules register themselves on import (framework.register)."""
+
+from areal_tpu.lint.rules import (  # noqa: F401
+    async_discipline,
+    donation,
+    jax_compat,
+    jit_discipline,
+    locks,
+    prng,
+)

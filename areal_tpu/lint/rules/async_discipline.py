@@ -1,0 +1,134 @@
+"""Async event-loop discipline.
+
+blocking-call-in-async: the rollout executor, the generation server, and the
+remote-engine client all multiplex many requests on one event loop; a single
+``time.sleep``/``requests.*``/sync-socket call stalls every in-flight
+rollout. Offload to ``run_in_executor`` or use the async equivalent
+(``await asyncio.sleep``, aiohttp).
+
+untracked-task: the event loop holds only weak references to tasks — a
+fire-and-forget ``asyncio.create_task(...)`` whose result is dropped can be
+garbage-collected mid-flight. Keep a reference
+(``areal_tpu.utils.aio.create_tracked_task``) or await it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    SEVERITY_WARNING,
+    FileContext,
+    Finding,
+    Rule,
+    register,
+    walk_excluding_nested_functions,
+)
+
+# exact dotted names that block the calling thread
+_BLOCKING_EXACT = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "urllib.request.urlopen": "use aiohttp on the session's event loop",
+    "socket.create_connection": "use asyncio.open_connection",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+}
+
+# module prefixes that are sync-only clients
+_BLOCKING_PREFIXES = {
+    "requests.": "use aiohttp on the session's event loop",
+}
+
+
+@register
+class BlockingCallInAsyncRule(Rule):
+    id = "blocking-call-in-async"
+    doc = (
+        "a thread-blocking call inside an async def stalls every coroutine "
+        "sharing the event loop"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for func in ctx.functions():
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            # nested sync defs are excluded: they typically run via
+            # run_in_executor, which is the correct offload
+            for node in walk_excluding_nested_functions(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolved(node.func)
+                if resolved in _BLOCKING_EXACT:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{resolved} blocks the event loop inside "
+                        f"`async def {func.name}`; "
+                        f"{_BLOCKING_EXACT[resolved]}",
+                    )
+                    continue
+                if resolved:
+                    for prefix, fix in _BLOCKING_PREFIXES.items():
+                        if resolved.startswith(prefix):
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"{resolved} blocks the event loop inside "
+                                f"`async def {func.name}`; {fix}",
+                            )
+                            break
+                # Future.result() on the loop thread deadlocks or stalls;
+                # warning-severity because attr matching can't see types
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and resolved not in _BLOCKING_EXACT
+                ):
+                    yield Finding(
+                        rule=self.id,
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f".result() inside `async def {func.name}` "
+                            "blocks the event loop if the receiver is a "
+                            "Future; await it instead"
+                        ),
+                        severity=SEVERITY_WARNING,
+                    )
+
+
+@register
+class UntrackedTaskRule(Rule):
+    id = "untracked-task"
+    severity = SEVERITY_WARNING
+    doc = (
+        "a fire-and-forget asyncio task with no saved reference can be "
+        "garbage-collected mid-flight"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            resolved = ctx.resolved(call.func) or ""
+            dotted = ctx.dotted(call.func) or ""
+            if (
+                resolved in ("asyncio.create_task", "asyncio.ensure_future")
+                or dotted.endswith(".create_task")
+            ):
+                yield self.finding(
+                    ctx,
+                    call,
+                    "task reference is discarded; the event loop keeps only "
+                    "a weak reference, so the task can be garbage-collected "
+                    "mid-flight — keep a reference or use "
+                    "areal_tpu.utils.aio.create_tracked_task",
+                )

@@ -1,0 +1,205 @@
+"""use-after-donate: reusing a buffer after passing it at a donated position
+of a known jitted callable.
+
+XLA invalidates donated input buffers; touching one afterwards raises (at
+best) or reads garbage. The rule builds a module-local registry of jitted
+callables from ``X = jax.jit(fn, donate_argnums=...)`` assignments and
+``@jax.jit``/``@partial(jax.jit, ...)`` decorators, then checks every call
+site: the argument at a donated position must be rebound before its next
+read. The safe idiom the inference engine uses everywhere::
+
+    toks, logps, self.cache = self._jit_decode(self.params, self.cache, ...)
+
+rebinds the donated ``self.cache`` in the same statement. Inside a loop the
+rebinding is mandatory — the next iteration feeds the donated buffer again.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+    walk_excluding_nested_functions,
+)
+
+_JIT_NAMES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+def _collect_registry(ctx: FileContext) -> dict[str, tuple[int, ...]]:
+    """dotted callable name (``self._jit_decode``, ``train_step``) ->
+    donated positional indices."""
+    registry: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            call = node.value
+            if (
+                isinstance(call, ast.Call)
+                and ctx.resolved(call.func) in _JIT_NAMES
+            ):
+                donated = _donate_positions(call)
+                if not donated:
+                    continue
+                for tgt in node.targets:
+                    name = ctx.dotted(tgt)
+                    if name:
+                        registry[name] = donated
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (
+                    isinstance(dec, ast.Call)
+                    and ctx.resolved(dec.func) in _JIT_NAMES
+                ):
+                    donated = _donate_positions(dec)
+                    if donated:
+                        registry[node.name] = donated
+    return registry
+
+
+def _stores_name(target: ast.AST, dotted: str, ctx: FileContext) -> bool:
+    """Does an assignment target (possibly a tuple) bind ``dotted``?"""
+    for node in ast.walk(target):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if ctx.dotted(node) == dotted:
+                return True
+    return False
+
+
+def _stmt_rebinds(stmt: ast.stmt, dotted: str, ctx: FileContext) -> bool:
+    if isinstance(stmt, ast.Assign):
+        return any(_stores_name(t, dotted, ctx) for t in stmt.targets)
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return _stores_name(stmt.target, dotted, ctx)
+    return False
+
+
+@register
+class UseAfterDonateRule(Rule):
+    id = "use-after-donate"
+    doc = (
+        "an argument passed at a donate_argnums position of a jitted "
+        "callable is read again before being rebound"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        registry = _collect_registry(ctx)
+        if not registry:
+            return
+        for func in ctx.functions():
+            yield from self._check_function(ctx, func, registry)
+
+    def _check_function(
+        self,
+        ctx: FileContext,
+        func: ast.AST,
+        registry: dict[str, tuple[int, ...]],
+    ) -> Iterator[Finding]:
+        # events: every load/store of every name in this scope, positioned
+        nodes = [
+            n
+            for n in walk_excluding_nested_functions(func, include_async=True)
+            if isinstance(n, (ast.Name, ast.Attribute))
+        ]
+        calls = [
+            n
+            for n in walk_excluding_nested_functions(func, include_async=True)
+            if isinstance(n, ast.Call) and ctx.dotted(n.func) in registry
+        ]
+        for call in calls:
+            callee = ctx.dotted(call.func)
+            if any(isinstance(a, ast.Starred) for a in call.args):
+                continue  # positions unknowable
+            stmt = ctx.enclosing_statement(call)
+            stmt_end = (stmt.end_lineno or stmt.lineno, stmt.end_col_offset or 0)
+            loop = next(
+                (
+                    a
+                    for a in ctx.ancestors(stmt)
+                    if isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                ),
+                None,
+            )
+            for pos in registry[callee]:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                dotted = ctx.dotted(arg)
+                if dotted is None:
+                    continue  # expression result: nothing to reuse
+                rebound_here = _stmt_rebinds(stmt, dotted, ctx)
+                events = sorted(
+                    (
+                        ((n.lineno, n.col_offset), n)
+                        for n in nodes
+                        if ctx.dotted(n) == dotted
+                        and (n.lineno, n.col_offset) > stmt_end
+                    ),
+                    key=lambda e: e[0],
+                )
+                if not rebound_here:
+                    for _, n in events:
+                        if isinstance(n.ctx, ast.Store):
+                            break
+                        if isinstance(n.ctx, ast.Load):
+                            yield self.finding(
+                                ctx,
+                                n,
+                                f"{dotted} is read after being donated to "
+                                f"{callee} (donate_argnums position {pos}, "
+                                f"line {call.lineno}); rebind it from the "
+                                "call result first",
+                            )
+                            break
+                    else:
+                        if dotted.startswith("self."):
+                            # donated OBJECT STATE outlives this function:
+                            # leaving it unbound hands every later method a
+                            # dead buffer
+                            yield self.finding(
+                                ctx,
+                                call,
+                                f"{dotted} is object state donated to "
+                                f"{callee} but never rebound in this "
+                                "function; any later access reads a dead "
+                                "buffer",
+                            )
+                if loop is not None and not rebound_here:
+                    # the next iteration feeds the donated buffer back in
+                    stored_in_loop = any(
+                        isinstance(n.ctx, ast.Store)
+                        and loop.lineno <= n.lineno <= (loop.end_lineno or 0)
+                        for n in nodes
+                        if ctx.dotted(n) == dotted
+                    )
+                    if not stored_in_loop:
+                        yield self.finding(
+                            ctx,
+                            call,
+                            f"{dotted} is donated to {callee} inside a loop "
+                            "without ever being rebound; the next iteration "
+                            "reuses the donated buffer",
+                        )

@@ -1,0 +1,312 @@
+"""Tracing/compilation discipline for jitted code.
+
+side-effect-in-jit: python side effects inside a traced function run once at
+trace time and never again — ``self.x = ...``, ``print``, and list mutation
+inside a jitted body are silent logic bugs (or retrace-dependent flakiness).
+
+jit-in-loop: ``jax.jit(...)`` constructed inside a loop (or immediately
+invoked) defeats the executable cache and recompiles per iteration — the
+classic silent 100x slowdown.
+
+host-sync-in-hot-path: functions annotated ``# arealint: hot-path`` (the
+decode/verify loops of the generation engine) must not sync the host with
+``block_until_ready``/``device_get``/``np.asarray``/``.item()`` — every sync
+drains the device pipeline. Intentional syncs (pulling sampled tokens) carry
+an inline ``# arealint: disable=host-sync-in-hot-path`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    SEVERITY_WARNING,
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+_MUTATING_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "pop",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "discard",
+}
+
+_SYNC_CALLS = {
+    "jax.block_until_ready",
+    "jax.device_get",
+    "numpy.asarray",
+    "numpy.array",
+}
+
+
+def _is_jit_call(ctx: FileContext, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and ctx.resolved(node.func) in _JIT_NAMES
+
+
+def _jitted_target_name(ctx: FileContext, arg: ast.AST) -> str | None:
+    """The local function name a jax.jit(...) first argument refers to,
+    unwrapping functools.partial."""
+    if isinstance(arg, ast.Call) and ctx.resolved(arg.func) in _PARTIAL_NAMES:
+        return _jitted_target_name(ctx, arg.args[0]) if arg.args else None
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        return arg.attr  # self._decode_impl -> match method _decode_impl
+    return None
+
+
+def _collect_jitted_functions(ctx: FileContext) -> list[ast.AST]:
+    """FunctionDefs that are traced: decorated with jax.jit (directly or via
+    partial), or referenced by name as the first argument of a jax.jit call
+    anywhere in the module."""
+    jitted_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if _is_jit_call(ctx, node) and node.args:
+            name = _jitted_target_name(ctx, node.args[0])
+            if name:
+                jitted_names.add(name)
+
+    out = []
+    for func in ctx.functions():
+        if func.name in jitted_names:
+            out.append(func)
+            continue
+        for dec in func.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            resolved = ctx.resolved(target)
+            if resolved in _JIT_NAMES:
+                out.append(func)
+                break
+            if (
+                isinstance(dec, ast.Call)
+                and resolved in _PARTIAL_NAMES
+                and dec.args
+                and ctx.resolved(dec.args[0]) in _JIT_NAMES
+            ):
+                out.append(func)
+                break
+    return out
+
+
+def _local_names(func: ast.AST) -> set[str]:
+    """Names bound inside the function body (its own scope, incl. params)."""
+    names: set[str] = set()
+    args = func.args
+    for a in (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        names.add(a.arg)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+    return names
+
+
+@register
+class SideEffectInJitRule(Rule):
+    id = "side-effect-in-jit"
+    doc = (
+        "python side effects inside a traced (jitted) function run at trace "
+        "time only — state mutation and print are silent logic bugs"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for func in _collect_jitted_functions(ctx):
+            param_names = {
+                a.arg for a in func.args.posonlyargs + func.args.args
+            }
+            assigned_locals = {
+                n.id
+                for n in ast.walk(func)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            }
+            global_names = {
+                name
+                for node in ast.walk(func)
+                if isinstance(node, ast.Global)
+                for name in node.names
+            }
+            for node in ast.walk(func):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        for sub in ast.walk(tgt):
+                            if (
+                                isinstance(sub, ast.Attribute)
+                                and isinstance(sub.value, ast.Name)
+                                and sub.value.id == "self"
+                                and isinstance(sub.ctx, ast.Store)
+                            ):
+                                yield self.finding(
+                                    ctx,
+                                    sub,
+                                    f"self.{sub.attr} is mutated inside "
+                                    f"jitted `{func.name}`; the write "
+                                    "happens at trace time only",
+                                )
+                            elif (
+                                isinstance(sub, ast.Name)
+                                and isinstance(sub.ctx, ast.Store)
+                                and sub.id in global_names
+                            ):
+                                yield self.finding(
+                                    ctx,
+                                    sub,
+                                    f"global {sub.id} is mutated inside "
+                                    f"jitted `{func.name}`",
+                                )
+                elif isinstance(node, ast.Call):
+                    if (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"print() inside jitted `{func.name}` runs at "
+                            "trace time only; use jax.debug.print",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATING_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        # result discarded => called for its side effect;
+                        # `new = tx.update(...)` is a pure-API false friend
+                        and isinstance(
+                            ctx.enclosing_statement(node), ast.Expr
+                        )
+                    ):
+                        obj = node.func.value.id
+                        if obj in param_names or obj not in assigned_locals:
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"{obj}.{node.func.attr}(...) inside jitted "
+                                f"`{func.name}` mutates non-local state at "
+                                "trace time",
+                            )
+
+
+@register
+class JitInLoopRule(Rule):
+    id = "jit-in-loop"
+    doc = (
+        "jax.jit constructed inside a loop (or construct-and-call) defeats "
+        "the compile cache and recompiles silently"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not _is_jit_call(ctx, node):
+                continue
+            loop = next(
+                (
+                    a
+                    for a in ctx.ancestors(node)
+                    if isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                ),
+                None,
+            )
+            if loop is not None:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "jax.jit(...) constructed inside a loop recompiles per "
+                    "iteration; hoist it (or cache the jitted callable)",
+                )
+
+
+@register
+class JitPerCallRule(Rule):
+    id = "jit-per-call"
+    severity = SEVERITY_WARNING
+    doc = (
+        "jax.jit(...)(...) constructed and invoked in one expression "
+        "recompiles every time the enclosing function runs (harmless in "
+        "one-shot tests — ignored under tests/ via [tool.arealint])"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not _is_jit_call(ctx, node):
+                continue
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "jax.jit(...)(...) constructs and calls per invocation "
+                    "(recompiles if the enclosing function runs more than "
+                    "once); bind the jitted callable once and reuse it",
+                )
+
+
+@register
+class HostSyncInHotPathRule(Rule):
+    id = "host-sync-in-hot-path"
+    doc = (
+        "host synchronization inside an `# arealint: hot-path` function "
+        "drains the device pipeline"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for func in ctx.functions():
+            if not ctx.is_hot(func):
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolved(node.func)
+                if resolved in _SYNC_CALLS:
+                    # np.asarray/np.array on a literal builds host data —
+                    # not a device sync
+                    if resolved in (
+                        "numpy.asarray",
+                        "numpy.array",
+                    ) and (
+                        node.args
+                        and isinstance(
+                            node.args[0],
+                            (ast.List, ast.ListComp, ast.Tuple, ast.Dict),
+                        )
+                    ):
+                        continue
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{resolved} synchronizes the host inside hot-path "
+                        f"`{func.name}`; keep the value on device or batch "
+                        "the pull (suppress intentional syncs inline)",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("item", "block_until_ready")
+                    and not node.args
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f".{node.func.attr}() synchronizes the host inside "
+                        f"hot-path `{func.name}`",
+                    )

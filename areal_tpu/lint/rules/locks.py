@@ -1,0 +1,111 @@
+"""lock-discipline: ``# guarded_by:``-annotated attributes must be accessed
+under their lock.
+
+The staleness manager's counters, the workflow executor's thread-exception
+slot, and the remote engine's in-flight table are all touched from multiple
+threads (rollout thread, caller threads, server handlers). Annotating the
+owning assignment in ``__init__``::
+
+    self._stat = RolloutStat()  # guarded_by: _lock
+
+makes the contract checkable: every access outside ``__init__`` must sit
+lexically inside ``with self._lock:`` (any ``with`` listing the lock among
+its items counts). The check is lexical, not aliasing-aware — that is the
+point: keep the locking obvious enough that a linter can see it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import FileContext, Finding, Rule, register
+
+
+def _guarded_attrs(
+    ctx: FileContext, cls: ast.ClassDef
+) -> dict[str, str]:
+    """attr name -> lock name, from annotated assignments in __init__."""
+    init = next(
+        (
+            n
+            for n in cls.body
+            if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+        ),
+        None,
+    )
+    if init is None:
+        return {}
+    guarded: dict[str, str] = {}
+    for stmt in ast.walk(init):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        lock = ctx.guarded_by.get(stmt.lineno)
+        if lock is None and stmt.end_lineno != stmt.lineno:
+            lock = ctx.guarded_by.get(stmt.end_lineno or stmt.lineno)
+        if lock is None:
+            continue
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                guarded[tgt.attr] = lock
+    return guarded
+
+
+def _holds_lock(ctx: FileContext, node: ast.AST, lock: str) -> bool:
+    want = f"self.{lock}"
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if ctx.dotted(item.context_expr) == want:
+                    return True
+    return False
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    doc = (
+        "an attribute annotated `# guarded_by: <lock>` is accessed outside "
+        "a `with self.<lock>:` block"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.guarded_by:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(ctx, cls)
+            if not guarded:
+                continue
+            for method in cls.body:
+                if not isinstance(
+                    method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if method.name == "__init__":
+                    continue
+                for node in ast.walk(method):
+                    if (
+                        isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in guarded
+                    ):
+                        lock = guarded[node.attr]
+                        if not _holds_lock(ctx, node, lock):
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"self.{node.attr} is guarded_by "
+                                f"self.{lock} but accessed outside "
+                                f"`with self.{lock}:` in "
+                                f"{cls.name}.{method.name}",
+                            )

@@ -1,0 +1,187 @@
+"""prng-key-reuse: the same PRNG key object consumed by more than one
+``jax.random.*`` sampling call without an intervening split/rebind.
+
+Reusing a key makes "independent" samples perfectly correlated — a silent
+statistics bug (identical noise across layers, identical sampling across
+batch elements). ``split``/``fold_in``/key constructors don't consume; any
+other ``jax.random.`` call does. Tracking is per-scope and name-based:
+rebinding the name (``key, sub = jax.random.split(key)``) resets it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+    walk_excluding_nested_functions,
+)
+
+_NON_CONSUMING = {
+    "split",
+    "fold_in",
+    "PRNGKey",
+    "key",
+    "key_data",
+    "wrap_key_data",
+    "clone",
+    "key_impl",
+}
+
+
+def _branch_arms(
+    ctx: FileContext, node: ast.AST
+) -> dict[int, str]:
+    """For every If/Try ancestor: which arm this node sits in. Used to
+    avoid flagging consumes on mutually exclusive control-flow paths."""
+    arms: dict[int, str] = {}
+    child = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.If):
+            if child in anc.body:
+                arms[id(anc)] = "body"
+            elif child in anc.orelse:
+                arms[id(anc)] = "orelse"
+        elif isinstance(anc, ast.Try):
+            if child in anc.body:
+                arms[id(anc)] = "body"
+            elif child in anc.handlers:
+                arms[id(anc)] = "handler"
+        child = anc
+    return arms
+
+
+def _mutually_exclusive(
+    ctx: FileContext, a: ast.AST, b: ast.AST
+) -> bool:
+    """True when two nodes live in different arms of the same If/Try — at
+    runtime only one of them executes."""
+    arms_a = _branch_arms(ctx, a)
+    arms_b = _branch_arms(ctx, b)
+    return any(
+        key in arms_b and arms_b[key] != arm
+        for key, arm in arms_a.items()
+    )
+
+
+@register
+class PrngKeyReuseRule(Rule):
+    id = "prng-key-reuse"
+    doc = (
+        "the same PRNG key is fed to multiple jax.random consumers without "
+        "an intervening split or rebind"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._scan_scope(ctx, ctx.tree, is_module=True)
+        for func in ctx.functions():
+            yield from self._scan_scope(ctx, func, is_module=False)
+
+    def _scan_scope(
+        self, ctx: FileContext, scope: ast.AST, is_module: bool
+    ) -> Iterator[Finding]:
+        if is_module:
+            # module scope: top-level statements only, minus function bodies
+            nodes = []
+            for stmt in ast.iter_child_nodes(scope):
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                nodes.append(stmt)
+                nodes.extend(ast.walk(stmt))
+        else:
+            nodes = list(
+                walk_excluding_nested_functions(scope, include_async=True)
+            )
+
+        # (position, kind, key-name, node); kind in {"consume", "store"}
+        events: list[tuple[tuple[int, int], str, str, ast.AST]] = []
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                resolved = ctx.resolved(n.func) or ""
+                if (
+                    resolved.startswith("jax.random.")
+                    and resolved.rsplit(".", 1)[1] not in _NON_CONSUMING
+                ):
+                    key_arg: ast.AST | None = None
+                    if n.args:
+                        key_arg = n.args[0]
+                    else:
+                        for kw in n.keywords:
+                            if kw.arg == "key":
+                                key_arg = kw.value
+                    name = ctx.dotted(key_arg) if key_arg is not None else None
+                    if name:
+                        events.append(
+                            ((n.lineno, n.col_offset), "consume", name, n)
+                        )
+            elif isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                n.ctx, ast.Store
+            ):
+                name = ctx.dotted(n)
+                if name:
+                    events.append(
+                        ((n.lineno, n.col_offset), "store", name, n)
+                    )
+
+        events.sort(key=lambda e: e[0])
+        consumed_at: dict[str, list[ast.AST]] = {}
+        for _, kind, name, node in events:
+            if kind == "store":
+                consumed_at.pop(name, None)
+                continue
+            prior = consumed_at.setdefault(name, [])
+            clash = next(
+                (
+                    p
+                    for p in prior
+                    if not _mutually_exclusive(ctx, p, node)
+                ),
+                None,
+            )
+            if clash is not None:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"PRNG key {name} was already consumed at line "
+                    f"{clash.lineno}; split it (or fold_in a counter) "
+                    "instead of reusing it",
+                )
+            prior.append(node)
+
+        # loop re-entry: a consume inside a loop with no rebind of the key
+        # anywhere in that loop reuses the key on every iteration
+        stores = [
+            (name, n) for _, kind, name, n in events if kind == "store"
+        ]
+        for _, kind, name, node in events:
+            if kind != "consume":
+                continue
+            loop = next(
+                (
+                    a
+                    for a in ctx.ancestors(node)
+                    if isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                ),
+                None,
+            )
+            if loop is None:
+                continue
+            lo, hi = loop.lineno, loop.end_lineno or loop.lineno
+            rebound_in_loop = any(
+                sname == name and lo <= snode.lineno <= hi
+                for sname, snode in stores
+            )
+            if not rebound_in_loop:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"PRNG key {name} is consumed on every iteration of "
+                    "this loop without being split or rebound; each "
+                    "iteration reuses the same key",
+                )

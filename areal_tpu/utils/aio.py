@@ -1,0 +1,71 @@
+"""Asyncio helpers: strong-reference tracking for fire-and-forget tasks.
+
+The event loop holds only weak references to tasks (CPython bpo-44665 class
+of bugs): ``asyncio.create_task(coro)`` whose result is dropped can be
+garbage-collected mid-flight, silently killing the coroutine. The rollout
+executor keeps its episode tasks in its ``live`` table; anything else that
+spawns background work (telemetry flushes, abort fan-outs) should go
+through :func:`create_tracked_task`, which parks the task in a module-level
+registry until it finishes. The ``untracked-task`` arealint rule flags bare
+``asyncio.create_task(...)`` statements that drop the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Coroutine
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("aio")
+
+# strong refs keeping in-flight fire-and-forget tasks alive; entries remove
+# themselves on completion
+_BACKGROUND_TASKS: set[asyncio.Task] = set()
+
+
+def create_tracked_task(
+    coro: Coroutine[Any, Any, Any],
+    *,
+    name: str | None = None,
+    log_exceptions: bool = True,
+) -> asyncio.Task:
+    """``asyncio.create_task`` that cannot be garbage-collected mid-flight.
+
+    The task is held in a module-level set until done. With
+    ``log_exceptions`` (default), a failed task logs its exception when it
+    completes instead of waiting for the loop's unretrieved-exception
+    warning at GC time (which a collected task never reaches).
+    """
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _BACKGROUND_TASKS.add(task)
+    task.add_done_callback(_on_done if log_exceptions else _BACKGROUND_TASKS.discard)
+    return task
+
+
+def _on_done(task: asyncio.Task) -> None:
+    _BACKGROUND_TASKS.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.error(
+            "background task %r failed: %s", task.get_name(), exc,
+            exc_info=exc,
+        )
+
+
+def tracked_task_count() -> int:
+    """In-flight tracked tasks (tests / leak diagnostics)."""
+    return len(_BACKGROUND_TASKS)
+
+
+async def cancel_tracked_tasks() -> int:
+    """Cancel and await every tracked task (shutdown path); returns how
+    many were still in flight."""
+    tasks = [t for t in _BACKGROUND_TASKS if not t.done()]
+    for t in tasks:
+        t.cancel()
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+    return len(tasks)

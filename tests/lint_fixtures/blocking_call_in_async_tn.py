@@ -1,0 +1,25 @@
+# arealint fixture: blocking-call-in-async TRUE NEGATIVES (no findings).
+import asyncio
+import time
+
+
+async def async_sleep(delay):
+    await asyncio.sleep(delay)
+
+
+async def offloaded_blocking_work(loop):
+    # nested sync def bodies are excluded: run_in_executor is the correct
+    # way to run blocking code from a coroutine
+    def work():
+        time.sleep(0.1)
+        return 1
+
+    return await loop.run_in_executor(None, work)
+
+
+def plain_sync_function():
+    time.sleep(0.1)
+
+
+async def awaited_future(fut):
+    return await fut

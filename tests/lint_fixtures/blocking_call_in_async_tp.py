@@ -1,0 +1,20 @@
+# arealint fixture: blocking-call-in-async TRUE POSITIVES.
+import time
+
+import requests  # noqa: F401 — never imported at runtime; lint-only fixture
+
+
+async def retry_loop_with_sync_sleep(url, session):
+    for attempt in range(3):
+        try:
+            return await session.post(url)
+        except Exception:
+            time.sleep(2**attempt)  # lint-expect: blocking-call-in-async
+
+
+async def sync_http_in_async(url):
+    return requests.get(url, timeout=5)  # lint-expect: blocking-call-in-async
+
+
+async def future_result_on_loop(fut):
+    return fut.result()  # lint-expect: blocking-call-in-async

@@ -1,0 +1,19 @@
+# arealint fixture: host-sync-in-hot-path TRUE NEGATIVES (no findings).
+import numpy as np
+
+
+class Engine:
+    # arealint: hot-path
+    def decode_step(self, slots, toks):
+        # np.array on a literal builds HOST data — not a device sync
+        active = np.array([s is not None for s in slots])
+        return active
+
+    def cold_path_pull(self, toks):
+        # not annotated hot: syncs are allowed
+        return np.asarray(toks)
+
+    # arealint: hot-path
+    def intended_sync(self, toks):
+        # suppressed on purpose with a justification
+        return np.asarray(toks)  # arealint: disable=host-sync-in-hot-path

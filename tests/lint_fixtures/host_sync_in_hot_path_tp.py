@@ -1,0 +1,14 @@
+# arealint fixture: host-sync-in-hot-path TRUE POSITIVES.
+import jax
+import numpy as np
+
+
+class Engine:
+    # arealint: hot-path
+    def decode_step(self, toks, cache):
+        host = np.asarray(toks)  # lint-expect: host-sync-in-hot-path
+        jax.block_until_ready(cache)  # lint-expect: host-sync-in-hot-path
+        first = toks[0].item()  # lint-expect: host-sync-in-hot-path
+        pulled = jax.device_get(toks)  # lint-expect: host-sync-in-hot-path
+        toks.block_until_ready()  # lint-expect: host-sync-in-hot-path
+        return host, first, pulled

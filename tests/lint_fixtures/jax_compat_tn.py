@@ -1,0 +1,16 @@
+# arealint fixture: jax-compat TRUE NEGATIVES (no findings expected).
+import jax
+import jax.experimental.pallas.tpu as pltpu
+from jax.experimental.shard_map import shard_map
+
+
+def current_apis(f, mesh, x, tree):
+    y = shard_map(f, mesh=mesh)(x)
+    params = pltpu.TPUCompilerParams(dimension_semantics=())
+    z = jax.tree.map(lambda a: a + 1, tree)
+    return y, params, z
+
+
+def local_name_is_not_the_module(tree_map, x):
+    # a local called tree_map is not jax.tree_map
+    return tree_map(x)

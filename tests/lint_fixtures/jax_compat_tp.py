@@ -1,0 +1,10 @@
+# arealint fixture: jax-compat TRUE POSITIVES.
+import jax
+import jax.experimental.pallas.tpu as pltpu
+
+
+def removed_apis(f, mesh, x, tree):
+    y = jax.shard_map(f, mesh=mesh)(x)  # lint-expect: jax-compat
+    params = pltpu.CompilerParams(dimension_semantics=())  # lint-expect: jax-compat
+    z = jax.tree_map(lambda a: a + 1, tree)  # lint-expect: jax-compat
+    return y, params, z

@@ -1,0 +1,22 @@
+# arealint fixture: jit-in-loop TRUE NEGATIVES (no findings expected).
+import jax
+
+
+def jit_hoisted(xs):
+    f = jax.jit(lambda a: a + 1)
+    outs = []
+    for x in xs:
+        outs.append(f(x))
+    return outs
+
+
+class CachedJit:
+    def __init__(self):
+        self._jit_cache = {}
+
+    def get(self, key, fn):
+        # the engine's real idiom: per-signature executable cache, the
+        # jax.jit construction is guarded, not per-iteration
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]

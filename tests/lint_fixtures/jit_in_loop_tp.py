@@ -1,0 +1,18 @@
+# arealint fixture: jit-in-loop TRUE POSITIVES.
+import jax
+
+
+def rejit_every_iteration(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda a: a + 1)  # lint-expect: jit-in-loop
+        outs.append(f(x))
+    return outs
+
+
+def rejit_in_while(x):
+    n = 0
+    while n < 4:
+        x = jax.jit(lambda a: a * 2)(x)  # lint-expect: jit-in-loop, jit-per-call
+        n += 1
+    return x

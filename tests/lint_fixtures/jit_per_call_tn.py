@@ -1,0 +1,8 @@
+# arealint fixture: jit-per-call TRUE NEGATIVES (no findings expected).
+import jax
+
+_double = jax.jit(lambda a: a * 2)
+
+
+def bound_once(x):
+    return _double(x)

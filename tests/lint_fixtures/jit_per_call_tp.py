@@ -1,0 +1,6 @@
+# arealint fixture: jit-per-call TRUE POSITIVES.
+import jax
+
+
+def construct_and_call(x):
+    return jax.jit(lambda a: a * 2)(x)  # lint-expect: jit-per-call

@@ -1,0 +1,25 @@
+# arealint fixture: lock-discipline TRUE NEGATIVES (no findings expected).
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded_by: _lock
+        self._unguarded = 0  # plain state: no annotation, no rule
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def read_multi_item_with(self, resource):
+        # the lock may share a with-statement with other context managers
+        with resource, self._lock:
+            return self._count
+
+    def touch_unguarded(self):
+        self._unguarded += 1

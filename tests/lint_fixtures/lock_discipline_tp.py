@@ -1,0 +1,23 @@
+# arealint fixture: lock-discipline TRUE POSITIVES.
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded_by: _lock
+        self._peak = 0  # guarded_by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def racy_read(self):
+        return self._count  # lint-expect: lock-discipline
+
+    def racy_write(self):
+        self._peak = 0  # lint-expect: lock-discipline
+
+    def wrong_lock(self, other_lock):
+        with other_lock:
+            return self._count  # lint-expect: lock-discipline

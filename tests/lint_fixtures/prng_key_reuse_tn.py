@@ -1,0 +1,56 @@
+# arealint fixture: prng-key-reuse TRUE NEGATIVES (no findings expected).
+import jax
+
+
+def split_before_reuse(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def rebind_between_uses(key):
+    a = jax.random.normal(key, (4,))
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.normal(key, (4,))
+    return a + b
+
+
+def exclusive_branches(key, flag):
+    # at runtime exactly one branch consumes the key
+    if flag:
+        return jax.random.normal(key, (4,))
+    else:
+        return jax.random.uniform(key, (4,))
+
+
+def try_except_arms(key):
+    try:
+        return jax.random.normal(key, (4,))
+    except TypeError:
+        return jax.random.uniform(key, (4,))
+
+
+def loop_with_per_iteration_subkey(key):
+    outs = []
+    for i in range(4):
+        key, sub = jax.random.split(key)
+        outs.append(jax.random.normal(sub, (4,)))
+    return outs
+
+
+def loop_over_split_keys(key):
+    outs = []
+    for k in jax.random.split(key, 4):
+        outs.append(jax.random.normal(k, (4,)))
+    return outs
+
+
+def separate_scopes(key):
+    # one consumption per scope: the sibling function below gets a fresh
+    # tracking context even though the parameter name matches
+    return jax.random.normal(key, (4,))
+
+
+def separate_scopes_sibling(key):
+    return jax.random.uniform(key, (4,))

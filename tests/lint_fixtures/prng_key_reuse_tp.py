@@ -1,0 +1,29 @@
+# arealint fixture: prng-key-reuse TRUE POSITIVES.
+import jax
+
+
+def same_key_two_samplers(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # lint-expect: prng-key-reuse
+    return a + b
+
+
+def reuse_via_keyword(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.bernoulli(key=key, p=0.5)  # lint-expect: prng-key-reuse
+    return a, b
+
+
+class Sampler:
+    def reuse_attribute_key(self):
+        a = jax.random.normal(self.key, (4,))
+        b = jax.random.normal(self.key, (4,))  # lint-expect: prng-key-reuse
+        return a + b
+
+
+def reuse_across_loop_iterations(key):
+    outs = []
+    for _ in range(4):
+        # every iteration consumes the SAME key: correlated samples
+        outs.append(jax.random.normal(key, (4,)))  # lint-expect: prng-key-reuse
+    return outs

@@ -1,0 +1,27 @@
+# arealint fixture: side-effect-in-jit TRUE NEGATIVES (no findings).
+import jax
+
+
+class Model:
+    def __init__(self):
+        # writes to self OUTSIDE jitted bodies are ordinary mutation
+        self.calls = 0
+        self._jit_fwd = jax.jit(self._fwd_impl)
+
+    def _fwd_impl(self, x):
+        acc = []
+        acc.append(x * 2)  # local list: trace-time-only and private
+        return acc[0]
+
+    def host_side_bookkeeping(self, x):
+        # not jitted: mutation and print are fine
+        self.calls += 1
+        print("step", self.calls)
+        return self._jit_fwd(x)
+
+
+@jax.jit
+def pure_update(params, grads):
+    # name-based pure APIs keep their results: not flagged
+    new = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    return new

@@ -1,0 +1,27 @@
+# arealint fixture: side-effect-in-jit TRUE POSITIVES.
+import jax
+
+TRACE_LOG = []
+
+
+class Model:
+    def __init__(self):
+        self.calls = 0
+        self._jit_fwd = jax.jit(self._fwd_impl)
+
+    def _fwd_impl(self, x):
+        self.calls = self.calls + 1  # lint-expect: side-effect-in-jit
+        print("tracing", x.shape)  # lint-expect: side-effect-in-jit
+        return x * 2
+
+
+@jax.jit
+def append_to_global(x):
+    TRACE_LOG.append(1)  # lint-expect: side-effect-in-jit
+    return x
+
+
+@jax.jit
+def mutate_argument(x, out_rows):
+    out_rows.append(x)  # lint-expect: side-effect-in-jit
+    return x

@@ -1,0 +1,18 @@
+# arealint fixture: untracked-task TRUE NEGATIVES (no findings expected).
+import asyncio
+
+from areal_tpu.utils.aio import create_tracked_task
+
+
+async def awaited(coro_fn):
+    task = asyncio.create_task(coro_fn())
+    return await task
+
+
+async def stored(live, coro_fn):
+    live["rollout"] = asyncio.create_task(coro_fn())
+
+
+async def tracked(coro_fn):
+    # the helper keeps a strong reference until completion
+    create_tracked_task(coro_fn())

@@ -1,0 +1,14 @@
+# arealint fixture: untracked-task TRUE POSITIVES.
+import asyncio
+
+
+async def fire_and_forget(coro_fn):
+    asyncio.create_task(coro_fn())  # lint-expect: untracked-task
+
+
+async def loop_spawn(loop, coro_fn):
+    loop.create_task(coro_fn())  # lint-expect: untracked-task
+
+
+async def ensure_future_dropped(coro_fn):
+    asyncio.ensure_future(coro_fn())  # lint-expect: untracked-task

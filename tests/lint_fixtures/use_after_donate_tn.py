@@ -1,0 +1,31 @@
+# arealint fixture: use-after-donate TRUE NEGATIVES (no findings expected).
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self.cache = object()
+        self._jit_step = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    def _step_impl(self, params, cache):
+        return cache
+
+    def rebind_same_statement(self, params):
+        # the engine's real idiom: the donated buffer is rebound from the
+        # call result in the same statement
+        toks, self.cache = self._jit_step(params, self.cache)
+        return toks
+
+    def rebind_in_loop(self, params, cache):
+        for _ in range(4):
+            cache = self._jit_step(params, cache)
+        return cache
+
+    def rebind_before_next_read(self, params, cache):
+        out = self._jit_step(params, cache)
+        cache = out
+        return cache
+
+    def fresh_expression_arg(self, params, xs):
+        # donating an expression result: nothing to reuse afterwards
+        return self._jit_step(params, list(xs))

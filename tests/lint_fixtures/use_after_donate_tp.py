@@ -1,0 +1,29 @@
+# arealint fixture: use-after-donate TRUE POSITIVES.
+# Lines tagged `# lint-expect: <rule>` must be flagged — tests/test_lint.py
+# asserts the finding set matches the tags exactly.
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self.cache = object()
+        self._jit_step = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    def _step_impl(self, params, cache):
+        return cache
+
+    def read_after_donate(self, params):
+        out = self._jit_step(params, self.cache)
+        return out, self.cache  # lint-expect: use-after-donate
+
+    def donate_in_loop_without_rebind(self, params, cache):
+        out = None
+        for _ in range(4):
+            out = self._jit_step(params, cache)  # lint-expect: use-after-donate
+        return out
+
+    def donate_object_state_without_rebind(self, params):
+        # self.cache outlives this function; the next caller reads a dead
+        # buffer even though THIS function never touches it again
+        out = self._jit_step(params, self.cache)  # lint-expect: use-after-donate
+        return out

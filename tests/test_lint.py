@@ -1,0 +1,266 @@
+"""arealint tier-1 tests: fixture corpus (every rule's true-positive and
+true-negative behavior is pinned by ``# lint-expect:`` tags), the repo-wide
+CI gate (clean against the committed baseline), and framework mechanics
+(suppressions, baseline matching, alias resolution, reporters).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from areal_tpu.lint import framework
+from areal_tpu.lint.framework import all_rules, lint_file
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+BASELINE = os.path.join(REPO_ROOT, ".arealint-baseline.json")
+
+_EXPECT_RE = re.compile(r"#\s*lint-expect:\s*([a-z0-9_,\- ]+)")
+
+
+def _expected_findings(path: str) -> set[tuple[str, int]]:
+    out: set[tuple[str, int]] = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                for rule in m.group(1).split(","):
+                    rule = rule.strip()
+                    if rule:
+                        out.add((rule, lineno))
+    return out
+
+
+def _fixture_files() -> list[str]:
+    return sorted(
+        os.path.join(FIXTURE_DIR, f)
+        for f in os.listdir(FIXTURE_DIR)
+        if f.endswith(".py")
+    )
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "path", _fixture_files(), ids=lambda p: os.path.basename(p)[:-3]
+)
+def test_fixture_matches_expectations(path):
+    """Findings in a fixture == its `# lint-expect:` tags, exactly: every
+    true positive fires, and nothing else does (true negatives)."""
+    expected = _expected_findings(path)
+    if path.endswith("_tp.py"):
+        assert expected, f"TP fixture {path} declares no expectations"
+    if path.endswith("_tn.py"):
+        assert not expected, f"TN fixture {path} should have no lint-expect"
+    actual = {(f.rule, f.line) for f in lint_file(path)}
+    assert actual == expected, (
+        f"{os.path.basename(path)}: findings {sorted(actual)} != "
+        f"expected {sorted(expected)}"
+    )
+
+
+def test_every_rule_has_tp_and_tn_fixture():
+    names = {os.path.basename(p) for p in _fixture_files()}
+    for rule_id in all_rules():
+        snake = rule_id.replace("-", "_")
+        assert f"{snake}_tp.py" in names, f"missing TP fixture for {rule_id}"
+        assert f"{snake}_tn.py" in names, f"missing TN fixture for {rule_id}"
+
+
+def test_rule_registry():
+    rules = all_rules()
+    expected = {
+        "use-after-donate",
+        "prng-key-reuse",
+        "blocking-call-in-async",
+        "jax-compat",
+        "side-effect-in-jit",
+        "jit-in-loop",
+        "jit-per-call",
+        "host-sync-in-hot-path",
+        "lock-discipline",
+        "untracked-task",
+    }
+    assert expected <= set(rules)
+    for rule in rules.values():
+        assert rule.doc, f"rule {rule.id} has no doc line"
+        assert rule.severity in ("error", "warning")
+
+
+# ---------------------------------------------------------------------------
+# repo-wide CI gate
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "areal_tpu.lint", *args],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def test_repo_is_lint_clean_against_baseline():
+    """The CI gate: the whole repo lints clean modulo the committed
+    jax-compat baseline. A new violation anywhere fails tier-1."""
+    proc = _run_cli(
+        "areal_tpu", "tests", "--baseline", ".arealint-baseline.json"
+    )
+    assert proc.returncode == 0, (
+        f"arealint found new violations:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_baseline_contains_only_jax_compat():
+    """Only the documented seed breakage class (removed JAX APIs) may be
+    baselined; every other rule's findings must be fixed or suppressed
+    inline with justification."""
+    entries = framework.load_baseline(BASELINE)
+    assert entries, "baseline unexpectedly empty"
+    assert {e["rule"] for e in entries} == {"jax-compat"}
+    # the two known seed-breakage symbols are what is being accepted
+    msgs = "\n".join(e["message"] for e in entries)
+    assert "jax.shard_map" in msgs
+    assert "CompilerParams" in msgs
+
+
+# ---------------------------------------------------------------------------
+# framework mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression(tmp_path):
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # arealint: disable=blocking-call-in-async\n"
+    )
+    assert lint_file("x.py", source=src) == []
+    # without the comment the finding is back
+    assert lint_file("x.py", source=src.replace("  # arealint: disable=blocking-call-in-async", ""))
+
+
+def test_suppression_survives_multiline_reformat():
+    """A disable comment anywhere on the statement applies — wrapping a
+    suppressed call across lines must not re-arm the finding."""
+    src = (
+        "import numpy as np\n"
+        "class E:\n"
+        "    # arealint: hot-path\n"
+        "    def decode(self, toks):\n"
+        "        out = np.asarray(\n"
+        "            toks\n"
+        "        )  # arealint: disable=host-sync-in-hot-path\n"
+        "        return out\n"
+    )
+    assert lint_file("x.py", source=src) == []
+
+
+def test_disable_next_line_and_skip_file():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    # arealint: disable-next-line=blocking-call-in-async\n"
+        "    time.sleep(1)\n"
+    )
+    assert lint_file("x.py", source=src) == []
+    src_skip = "# arealint: skip-file\nimport time\nasync def f():\n    time.sleep(1)\n"
+    assert lint_file("x.py", source=src_skip) == []
+
+
+def test_import_alias_resolution():
+    # blocking call through an alias still resolves
+    src = "from time import sleep\nasync def f():\n    sleep(1)\n"
+    findings = lint_file("x.py", source=src)
+    assert [f.rule for f in findings] == ["blocking-call-in-async"]
+    # numpy alias in a hot path
+    src2 = (
+        "import numpy as xp\n"
+        "class E:\n"
+        "    # arealint: hot-path\n"
+        "    def decode(self, toks):\n"
+        "        return xp.asarray(toks)\n"
+    )
+    findings2 = lint_file("x.py", source=src2)
+    assert [f.rule for f in findings2] == ["host-sync-in-hot-path"]
+
+
+def test_parse_error_is_a_finding():
+    findings = lint_file("x.py", source="def broken(:\n")
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f1 = framework.Finding("jax-compat", "a.py", 10, 0, "msg one")
+    f2 = framework.Finding("jax-compat", "a.py", 99, 0, "msg one")  # same key
+    f3 = framework.Finding("jax-compat", "b.py", 5, 0, "msg two")
+    path = str(tmp_path / "base.json")
+    framework.write_baseline(path, [f1, f3])
+    entries = framework.load_baseline(path)
+    assert len(entries) == 2
+    new, old = framework.apply_baseline([f1, f2, f3], entries)
+    assert new == [] and len(old) == 3  # line drift still matches
+    new2, _ = framework.apply_baseline(
+        [framework.Finding("jax-compat", "a.py", 1, 0, "msg three")], entries
+    )
+    assert len(new2) == 1
+
+
+def test_cli_json_format():
+    proc = _run_cli(
+        "tests/lint_fixtures/jax_compat_tp.py", "--format", "json"
+    )
+    assert proc.returncode == 1  # fixture has errors, no baseline given
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["errors"] == 3
+    assert {f["rule"] for f in payload["findings"]} == {"jax-compat"}
+
+
+def test_cli_select_and_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    assert "use-after-donate" in proc.stdout
+    proc2 = _run_cli(
+        "tests/lint_fixtures/jit_in_loop_tp.py", "--select", "untracked-task"
+    )
+    assert proc2.returncode == 0  # selected rule has no findings there
+    proc3 = _run_cli("areal_tpu", "--select", "no-such-rule")
+    assert proc3.returncode == 2
+
+
+def test_per_path_ignores_config():
+    ignores = framework.load_per_path_ignores(REPO_ROOT)
+    assert ignores.get("tests/") == {"jit-per-call"}
+    keep = framework.Finding("jit-per-call", "areal_tpu/x.py", 1, 0, "m")
+    drop = framework.Finding("jit-per-call", "tests/t.py", 1, 0, "m")
+    other = framework.Finding("jit-in-loop", "tests/t.py", 1, 0, "m")
+    assert framework.apply_per_path_ignores([keep, drop, other], ignores) == [
+        keep,
+        other,
+    ]
+
+
+def test_guarded_by_annotations_present_in_core():
+    """The concurrency-critical state this PR annotated must stay
+    annotated — the lock-discipline rule is inert without them."""
+    for rel, attr in [
+        ("areal_tpu/core/staleness_manager.py", "_stat"),
+        ("areal_tpu/core/workflow_executor.py", "_thread_exc"),
+        ("areal_tpu/core/remote_inf_engine.py", "_inflight"),
+    ]:
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            src = f.read()
+        assert re.search(
+            rf"self\.{attr}.*#\s*guarded_by:", src
+        ), f"{rel} lost its guarded_by annotation on self.{attr}"

@@ -318,6 +318,8 @@ def test_ring_sliding_window_pallas_chunks_matches_global():
     mesh = make_mesh(1, 4)
     q, k, v, seg = make_inputs(seed=5)
     for w in (64, 37):  # block-aligned AND unaligned (block = 32)
+        # intentional per-window compile: each w closes over a different
+        # static window  # arealint: disable-next-line=jit-in-loop
         out = jax.jit(
             lambda *a, w=w: ring_attention_sharded(
                 mesh, *a, chunk_impl="pallas_interpret", block=32, window=w
@@ -336,6 +338,8 @@ def test_ulysses_sliding_window_matches_global():
     mesh = make_mesh(2, 2)
     q, k, v, seg = make_inputs(t=256, nh=8, kh=4, d=32, seed=6)
     for w, impl, block in ((41, "xla", 128), (64, "pallas_interpret", 32)):
+        # intentional per-config compile (static window/impl/block)
+        # arealint: disable-next-line=jit-in-loop
         out = jax.jit(
             lambda *a, w=w, impl=impl, block=block: ulysses_attention_sharded(
                 mesh, *a, window=w, chunk_impl=impl, block=block
