@@ -613,6 +613,57 @@ class JaxGenConfig:
 
 
 @dataclass
+class CircuitBreakerConfig:
+    """Per-server circuit breaker for the rollout client plane
+    (core/fault_tolerance.py). CLOSED routes normally; enough failures trip
+    the server OPEN (zero traffic); a background ``/health`` probe moves a
+    cooled-down OPEN server to HALF_OPEN, where bounded trial traffic either
+    closes the breaker again or re-opens it."""
+
+    enabled: bool = True
+    # consecutive failures that trip CLOSED -> OPEN
+    failure_threshold: int = 3
+    # sliding window for failure-rate tripping (gray failure: a server that
+    # intermittently fails without ever hitting the consecutive threshold)
+    window_seconds: float = 60.0
+    failure_rate_threshold: float = 0.5
+    min_window_requests: int = 8
+    # OPEN servers are not even probed until this cooldown elapses
+    open_cooldown_seconds: float = 5.0
+    # concurrent trial requests allowed in HALF_OPEN
+    half_open_max_probes: int = 1
+    # background /health probe cadence for OPEN servers
+    probe_interval_seconds: float = 5.0
+    probe_timeout_seconds: float = 10.0
+
+
+@dataclass
+class ChaosRuleConfig:
+    """One deterministic fault-injection rule (utils/chaos.py). ``endpoint``
+    is a substring matched against the request path ("*" = all); ``action``
+    is one of drop | http_error | timeout | slow | disconnect; ``times`` > 0
+    arms the rule for exactly that many matching requests (fail-next-N)."""
+
+    endpoint: str = "*"
+    action: str = "http_error"
+    probability: float = 1.0
+    status: int = 503
+    delay_seconds: float = 0.0
+    times: int = 0  # 0 = unlimited
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault injection for the client request path. Disabled
+    by default; when off the request hot path pays only a None check.
+    Server-side injection is env-gated instead (``AREAL_CHAOS_SERVER``)."""
+
+    enabled: bool = False
+    seed: int = 0
+    rules: list[ChaosRuleConfig] = field(default_factory=list)
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client/rollout control (reference cli_args.py:786)."""
 
@@ -629,6 +680,25 @@ class InferenceEngineConfig:
     request_timeout: float = 3600.0
     request_retries: int = 3
     pause_grace_period: float = 0.0
+    # pause/continue fan-out request timeout (was a hardcoded 60.0)
+    pause_continue_request_timeout: float = 60.0
+    # re-query name_resolve for late-registered servers at most this often;
+    # 0 disables (env/explicit address lists never refresh)
+    server_refresh_interval: float = 30.0
+    # --- fault tolerance (core/fault_tolerance.py) ---
+    breaker: CircuitBreakerConfig = field(default_factory=CircuitBreakerConfig)
+    # per-request re-dispatches to a different server after a failed
+    # generate attempt (accumulated tokens replay as the new prompt)
+    failover_retries: int = 3
+    # overall wall-clock budget for one agenerate call including all
+    # failover re-dispatches; 0 = no overall deadline
+    failover_deadline_seconds: float = 0.0
+    # update_weights tolerates per-server failure (the failed server is
+    # quarantined) as long as at least this fraction of servers took the
+    # update; below it the step raises
+    update_weights_min_healthy_fraction: float = 0.5
+    # client-side deterministic fault injection (tests/rehearsals)
+    chaos: ChaosConfig | None = None
 
 
 @dataclass

@@ -1,0 +1,323 @@
+"""Fault tolerance for the rollout/inference client plane.
+
+The training loop's availability is hostage to a fleet of remote
+generation servers it does not control: before this module, one dead
+address stayed in the round-robin rotation until every request burned its
+full timeout x retries, and one failed server in the weight-update fan-out
+aborted the training step. :class:`ServerHealthTracker` gives the client
+plane a notion of per-server health:
+
+- every request outcome feeds per-address sliding-window success /
+  failure / latency stats;
+- a circuit breaker per address: **CLOSED** (routing normally) trips
+  **OPEN** on ``failure_threshold`` consecutive failures *or* a windowed
+  failure rate (the gray-failure case: a server that is alive enough to
+  never fail N times in a row but sick enough to poison every batch);
+- **OPEN** servers receive zero traffic. A background ``/health`` probe
+  (driven by ``RemoteInfEngine``) moves a cooled-down OPEN server to
+  **HALF_OPEN**, where at most ``half_open_max_probes`` concurrent trial
+  requests are allowed: success closes the breaker, failure re-opens it;
+- **quarantine** (breaker forced OPEN) for servers that missed a weight
+  update: they additionally carry a ``required_version`` and only pass
+  their probe once a version check confirms they caught up — a stale
+  server must never silently rejoin the rotation and generate trajectories
+  under old weights without the client knowing.
+
+``choose_server`` routes around OPEN breakers and falls back to the
+least-bad server when *every* breaker is open (never deadlocking: some
+server always gets the request, and its outcome keeps the stats moving).
+
+The clock is injectable so breaker timing is unit-testable with zero real
+sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from areal_tpu.api.cli_args import CircuitBreakerConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("fault_tolerance")
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class _ServerHealth:
+    """Mutable per-address record; all access under the tracker's lock."""
+
+    __slots__ = (
+        "state",
+        "window",
+        "consecutive_failures",
+        "opened_at",
+        "last_probe_at",
+        "half_open_inflight",
+        "required_version",
+        "successes",
+        "failures",
+        "last_error",
+    )
+
+    def __init__(self):
+        self.state = CLOSED
+        # (timestamp, ok, latency) triples, trimmed to window_seconds
+        self.window: deque = deque()
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self.last_probe_at = 0.0
+        self.half_open_inflight = 0
+        self.required_version: int | None = None
+        self.successes = 0
+        self.failures = 0
+        self.last_error: str = ""
+
+
+class ServerHealthTracker:
+    """Sliding-window health stats + circuit breaker per server address."""
+
+    def __init__(self, config: CircuitBreakerConfig | None = None, clock=None):
+        self.config = config or CircuitBreakerConfig()
+        self.clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._servers: dict[str, _ServerHealth] = {}  # guarded_by: _lock
+
+    # ------------------------------------------------------------ internals
+
+    def _get(self, addr: str) -> _ServerHealth:
+        # callers hold _lock (every call site below is inside `with
+        # self._lock:`; the scope-based lint check can't see across the
+        # call boundary)
+        h = self._servers.get(addr)  # arealint: disable=lock-discipline
+        if h is None:
+            h = self._servers[addr] = _ServerHealth()  # arealint: disable=lock-discipline
+        return h
+
+    def _trim(self, h: _ServerHealth, now: float) -> None:
+        horizon = now - self.config.window_seconds
+        while h.window and h.window[0][0] < horizon:
+            h.window.popleft()
+
+    def _should_trip(self, h: _ServerHealth) -> bool:
+        cfg = self.config
+        if h.consecutive_failures >= cfg.failure_threshold:
+            return True
+        if len(h.window) >= cfg.min_window_requests:
+            fails = sum(1 for (_, ok, _) in h.window if not ok)
+            if fails / len(h.window) >= cfg.failure_rate_threshold:
+                return True
+        return False
+
+    def _open(self, h: _ServerHealth, addr: str, reason: str) -> None:
+        if h.state != OPEN:
+            logger.warning("breaker OPEN for %s: %s", addr, reason)
+        h.state = OPEN
+        h.opened_at = self.clock()
+        h.half_open_inflight = 0
+
+    # ---------------------------------------------------------- request path
+
+    def on_request_start(self, addr: str) -> None:
+        """Call before dispatching; pairs with :meth:`on_request_end`."""
+        if not self.config.enabled:
+            return
+        with self._lock:
+            h = self._get(addr)
+            if h.state == HALF_OPEN:
+                h.half_open_inflight += 1
+
+    def on_request_abandoned(self, addr: str) -> None:
+        """The request ended without a usable outcome (cancellation,
+        client-side deadline): release the half-open probe slot without
+        charging the server a success or failure."""
+        if not self.config.enabled:
+            return
+        with self._lock:
+            h = self._get(addr)
+            if h.state == HALF_OPEN:
+                h.half_open_inflight = max(0, h.half_open_inflight - 1)
+
+    def on_request_end(
+        self, addr: str, ok: bool, latency: float = 0.0, error: str = ""
+    ) -> None:
+        """Record one request outcome and run the breaker state machine."""
+        if not self.config.enabled:
+            return
+        with self._lock:
+            h = self._get(addr)
+            now = self.clock()
+            if h.state == HALF_OPEN:
+                h.half_open_inflight = max(0, h.half_open_inflight - 1)
+            h.window.append((now, ok, latency))
+            self._trim(h, now)
+            if ok:
+                h.successes += 1
+                h.consecutive_failures = 0
+                if h.state == HALF_OPEN:
+                    h.state = CLOSED
+                    logger.info("breaker CLOSED for %s (trial succeeded)", addr)
+            else:
+                h.failures += 1
+                h.consecutive_failures += 1
+                h.last_error = error[:200]
+                if h.state == HALF_OPEN:
+                    self._open(h, addr, f"trial request failed: {error[:120]}")
+                elif h.state == CLOSED and self._should_trip(h):
+                    self._open(
+                        h,
+                        addr,
+                        f"{h.consecutive_failures} consecutive failures / "
+                        f"window rate trip: {error[:120]}",
+                    )
+
+    # -------------------------------------------------------------- routing
+
+    def routable(self, addr: str) -> bool:
+        """May this address receive a (non-probe) request right now?"""
+        if not self.config.enabled:
+            return True
+        with self._lock:
+            h = self._servers.get(addr)
+            if h is None or h.state == CLOSED:
+                return True
+            if h.state == HALF_OPEN:
+                return h.half_open_inflight < self.config.half_open_max_probes
+            return False
+
+    def least_bad(self, addrs: list[str]) -> list[str]:
+        """When every breaker is open: the addresses tied at the lowest
+        recent failure fraction. The caller ROTATES among them (fixed
+        tie-breaks re-pick the same dead server on every failover attempt
+        of a request — observed live against a dead+chaos fleet). Routing
+        somewhere beats deadlock: the outcome feeds the stats either way."""
+        assert addrs, "least_bad needs at least one address"
+        with self._lock:
+
+            def rate(a: str) -> float:
+                h = self._servers.get(a)
+                if h is None:
+                    return 0.0
+                n = len(h.window) or 1
+                return sum(1 for (_, ok, _) in h.window if not ok) / n
+
+            best = min(rate(a) for a in addrs)
+            return [a for a in addrs if rate(a) == best]
+
+    # ------------------------------------------------------------- probing
+
+    def probe_candidates(self) -> list[str]:
+        """OPEN servers due for a background /health probe (cooldown and
+        probe-interval elapsed)."""
+        if not self.config.enabled:
+            return []
+        now = self.clock()
+        cfg = self.config
+        out = []
+        with self._lock:
+            for addr, h in self._servers.items():
+                if h.state != OPEN:
+                    continue
+                if now - h.opened_at < cfg.open_cooldown_seconds:
+                    continue
+                if now - h.last_probe_at < cfg.probe_interval_seconds:
+                    continue
+                h.last_probe_at = now
+                out.append(addr)
+        return out
+
+    def required_version(self, addr: str) -> int | None:
+        with self._lock:
+            h = self._servers.get(addr)
+            return h.required_version if h is not None else None
+
+    def on_probe_result(
+        self, addr: str, ok: bool, version: int | None = None
+    ) -> None:
+        """Outcome of a background /health (+ version) probe. Success moves
+        OPEN -> HALF_OPEN (trial traffic allowed); a quarantined server
+        additionally needs ``version >= required_version``."""
+        with self._lock:
+            h = self._get(addr)
+            if h.state != OPEN:
+                return
+            if not ok:
+                return
+            if h.required_version is not None and (
+                version is None or version < h.required_version
+            ):
+                logger.info(
+                    "probe: %s healthy but at version %s < required %d; "
+                    "staying quarantined",
+                    addr,
+                    version,
+                    h.required_version,
+                )
+                return
+            h.state = HALF_OPEN
+            h.half_open_inflight = 0
+            h.consecutive_failures = 0
+            h.required_version = None
+            logger.info("breaker HALF_OPEN for %s (probe succeeded)", addr)
+
+    # ----------------------------------------------------------- quarantine
+
+    def quarantine(self, addr: str, required_version: int | None = None) -> None:
+        """Force the breaker OPEN (e.g. the server missed a weight update).
+        With ``required_version``, the rejoin probe must also confirm the
+        server's weight version caught up. No-op when the breaker plane is
+        disabled — every recovery path (probing, half-open trials) is off
+        too, so a quarantine would exclude the server forever."""
+        if not self.config.enabled:
+            logger.warning(
+                "breaker disabled: NOT quarantining %s (required_version=%s)",
+                addr,
+                required_version,
+            )
+            return
+        with self._lock:
+            h = self._get(addr)
+            self._open(
+                h,
+                addr,
+                f"quarantined (required_version={required_version})",
+            )
+            if required_version is not None:
+                # a later update supersedes an earlier requirement
+                h.required_version = max(
+                    required_version, h.required_version or 0
+                )
+
+    # ------------------------------------------------------------ inspection
+
+    def state(self, addr: str) -> str:
+        if not self.config.enabled:
+            return CLOSED
+        with self._lock:
+            h = self._servers.get(addr)
+            return h.state if h is not None else CLOSED
+
+    def snapshot(self) -> dict[str, dict]:
+        """Per-address stats for logging/telemetry."""
+        out = {}
+        with self._lock:
+            for addr, h in self._servers.items():
+                n = len(h.window)
+                fails = sum(1 for (_, ok, _) in h.window if not ok)
+                lats = [lat for (_, ok, lat) in h.window if ok]
+                out[addr] = {
+                    "state": h.state,
+                    "successes": h.successes,
+                    "failures": h.failures,
+                    "window_requests": n,
+                    "window_failure_rate": (fails / n) if n else 0.0,
+                    "window_mean_latency": (
+                        sum(lats) / len(lats) if lats else 0.0
+                    ),
+                    "required_version": h.required_version,
+                    "last_error": h.last_error,
+                }
+        return out

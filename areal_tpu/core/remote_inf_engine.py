@@ -38,9 +38,15 @@ from areal_tpu.api.io_struct import (
     ModelResponse,
     WeightUpdateMeta,
 )
+from areal_tpu.core.fault_tolerance import OPEN, ServerHealthTracker
 from areal_tpu.core.workflow_executor import WorkflowExecutor
 from areal_tpu.utils import logging, name_resolve, names
-from areal_tpu.utils.http import arequest_with_retry
+from areal_tpu.utils.chaos import ChaosPolicy
+from areal_tpu.utils.http import (
+    TRANSPORT_ERRORS,
+    HTTPRequestError,
+    arequest_with_retry,
+)
 
 logger = logging.getLogger("RemoteInfEngine")
 
@@ -76,6 +82,18 @@ class RemoteInfEngine(InferenceEngine):
         # one ClientSession per event loop (the rollout thread's loop is the
         # long-lived one; keepalive pooling matters there)
         self._sessions: dict[int, tuple[asyncio.AbstractEventLoop, aiohttp.ClientSession]] = {}
+        # fault-tolerance plane: per-server breaker + routing stats, the
+        # background /health probe task per event loop, and (optionally)
+        # client-side deterministic fault injection
+        self._health = ServerHealthTracker(config.breaker)
+        self._chaos = ChaosPolicy.from_config(config.chaos)
+        self._probe_tasks: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Task]] = {}
+        self._discovered_via_nr = False
+        self._last_server_refresh = 0.0
+        self._refresh_thread: threading.Thread | None = None
+        # last disk weight-update meta, so a quarantined server's rejoin
+        # probe can re-push the update it missed
+        self._last_disk_update: tuple[str, int] | None = None
 
     # ------------------------------------------------------------------
     # lifecycle / discovery
@@ -113,6 +131,8 @@ class RemoteInfEngine(InferenceEngine):
     def _discover_servers(self) -> list[str]:
         key = names.gen_servers(self.config.experiment_name, self.config.trial_name)
         deadline = time.monotonic() + self.config.setup_timeout
+        self._discovered_via_nr = True
+        self._last_server_refresh = time.monotonic()
         while time.monotonic() < deadline:
             addrs = name_resolve.get_subtree(key)
             if addrs:
@@ -123,7 +143,54 @@ class RemoteInfEngine(InferenceEngine):
             f"{self.config.setup_timeout}s"
         )
 
+    def _maybe_refresh_servers(self, force: bool = False):
+        """Re-resolve name_resolve on demand so servers registered after
+        startup join the rotation (capacity scale-up, replacement nodes).
+        Interval-gated; explicit/env address lists never refresh.
+
+        The actual resolve runs on a daemon thread: choose_server is called
+        from the rollout event loop, and an etcd/NFS-backed name_resolve
+        lookup would stall every in-flight rollout for its full I/O
+        latency. New servers therefore join one routing decision late —
+        an acceptable price for never blocking the loop."""
+        interval = self.config.server_refresh_interval
+        if not self._discovered_via_nr or interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_server_refresh < interval:
+            return
+        t = self._refresh_thread
+        if t is not None and t.is_alive():
+            return
+        self._last_server_refresh = now
+        self._refresh_thread = threading.Thread(
+            target=self._refresh_servers_sync,
+            name="server-refresh",
+            daemon=True,
+        )
+        self._refresh_thread.start()
+
+    def _refresh_servers_sync(self):
+        key = names.gen_servers(self.config.experiment_name, self.config.trial_name)
+        try:
+            addrs = name_resolve.get_subtree(key)
+        except Exception as e:
+            logger.debug("server refresh failed: %s", e)
+            return
+        new = sorted(set(addrs) - set(self.addresses))
+        if new:
+            # departed servers stay listed: their breaker opens on the
+            # first failures and the probe loop retires them from routing.
+            # list.extend is atomic under the GIL; choose_server snapshots
+            # via list comprehension
+            self.addresses.extend(new)
+            logger.info("server refresh: %d new server(s) joined: %s", len(new), new)
+
     def destroy(self):
+        for loop, task in list(self._probe_tasks.values()):
+            if loop.is_running():
+                loop.call_soon_threadsafe(task.cancel)
+        self._probe_tasks.clear()
         for loop, session in list(self._sessions.values()):
             if loop.is_running():
                 try:
@@ -137,43 +204,106 @@ class RemoteInfEngine(InferenceEngine):
     # server selection
     # ------------------------------------------------------------------
 
-    def choose_server(self, rid: str | None = None) -> str:
+    def choose_server(
+        self, rid: str | None = None, avoid: set[str] | None = None
+    ) -> str:
+        """Pick a server, routing around OPEN breakers. ``avoid`` holds
+        addresses that already failed THIS request (failover re-dispatch
+        must not hand the request straight back to the server that just
+        dropped it); it is a preference, not a hard ban — when everything
+        else is down, an avoided server beats deadlock."""
         policy = self.config.schedule_policy
         if policy not in ("round_robin", "least_loaded"):
             raise NotImplementedError(policy)
+        self._maybe_refresh_servers()
+        avoid = avoid or set()
         if rid is not None and rid in self._rid_to_address:
-            # KV-prefix affinity beats load balance (reference gserver
-            # routes resumed qids back to their server for cache reuse)
-            return self._rid_to_address[rid]
+            cached = self._rid_to_address[rid]
+            if cached not in avoid and self._health.routable(cached):
+                # KV-prefix affinity beats load balance (reference gserver
+                # routes resumed qids back to their server for cache reuse)
+                return cached
+            # the server holding this rid's KV tripped its breaker (or just
+            # failed this request): the affinity is void — KV is lost,
+            # correctness is not, the accumulated tokens replay as prompt
+            self._drop_rid_affinity(rid)
+        candidates = [
+            a
+            for a in self.addresses
+            if a not in avoid and self._health.routable(a)
+        ]
+        if not candidates:
+            candidates = [a for a in self.addresses if self._health.routable(a)]
+        if not candidates:
+            # every breaker is open: kick off a discovery refresh (threaded
+            # — any newly registered server joins a LATER decision) and
+            # route to a least-bad server now rather than deadlock; its
+            # outcome keeps the health stats moving, and a recovered server
+            # closes its breaker this way. Rotate among equally-bad servers
+            # so repeated failovers of one request spread across the fleet.
+            self._maybe_refresh_servers(force=True)
+            pool = [a for a in self.addresses if a not in avoid] or list(
+                self.addresses
+            )
+            tied = sorted(self._health.least_bad(pool))
+            addr = tied[self._server_idx % len(tied)]
+            logger.warning(
+                "all %d server breakers are open; routing to least-bad %s",
+                len(self.addresses),
+                addr,
+            )
+            self._server_idx += 1
+            return self._remember_rid(rid, addr)
         if policy == "least_loaded":
             # the gserver_manager schedule_request role
             # (realhf/system/gserver_manager.py allocate/schedule): route to
             # the server with the fewest in-flight requests from this
             # client; ties rotate round-robin so equal-load servers
             # interleave instead of pinning to the first
-            n = len(self.addresses)
+            n = len(candidates)
             start = self._server_idx % n
-            order = [self.addresses[(start + i) % n] for i in range(n)]
+            order = [candidates[(start + i) % n] for i in range(n)]
             with self._inflight_lock:
                 addr = min(order, key=lambda a: self._inflight.get(a, 0))
         else:
-            addr = self.addresses[self._server_idx % len(self.addresses)]
+            addr = candidates[self._server_idx % len(candidates)]
         self._server_idx += 1
+        return self._remember_rid(rid, addr)
+
+    def _remember_rid(self, rid: str | None, addr: str) -> str:
         if rid is not None:
-            if len(self._rid_queue) >= RID_CACHE_SIZE:
-                old = self._rid_queue.pop(0)
-                self._rid_to_address.pop(old, None)
+            if rid not in self._rid_to_address:
+                if len(self._rid_queue) >= RID_CACHE_SIZE:
+                    old = self._rid_queue.pop(0)
+                    self._rid_to_address.pop(old, None)
+                self._rid_queue.append(rid)
             self._rid_to_address[rid] = addr
-            self._rid_queue.append(rid)
         return addr
+
+    def _drop_rid_affinity(self, rid: str) -> None:
+        self._rid_to_address.pop(rid, None)
+        try:
+            self._rid_queue.remove(rid)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------------
     # generation (interrupt loop)
     # ------------------------------------------------------------------
 
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
-        """Generate with abort-resume splicing across weight updates."""
-        addr = self.choose_server(req.rid)
+        """Generate with abort-resume splicing across weight updates and
+        failover re-dispatch across server failures.
+
+        When a generate request fails (connection error, timeout, breaker
+        trip mid-stream), the request is re-dispatched to a healthy server
+        with the already-accepted output tokens replayed as prompt — KV
+        affinity is lost, token-level correctness is not (the payload below
+        always sends ``prompt + accumulated``, which is exactly the resume
+        splice the abort loop already uses). Bounded by
+        ``failover_retries`` and an optional overall
+        ``failover_deadline_seconds``."""
+        self._ensure_probe_task()
         gconfig = req.gconfig
         if gconfig.n_samples != 1:
             raise ValueError(
@@ -191,53 +321,121 @@ class RemoteInfEngine(InferenceEngine):
         session = await self._get_session()
         max_new = gconfig.max_new_tokens
         encoded_images = _encode_images_for_transport(req.image_data)
-        with self._inflight_lock:
-            self._inflight[addr] = self._inflight.get(addr, 0) + 1
-        try:
-            while stop_reason == "abort" and len(accumulated) < max_new:
-                while self._paused.is_set():
-                    await asyncio.sleep(0.05)
-                payload = {
-                    "rid": req.rid,
-                    "input_ids": prompt + accumulated,
-                    "image_data": encoded_images,
-                    "sampling_params": {
-                        "max_new_tokens": max_new - len(accumulated),
-                        "min_new_tokens": max(
-                            0, gconfig.min_new_tokens - len(accumulated)
-                        ),
-                        "greedy": gconfig.greedy,
-                        "temperature": gconfig.temperature,
-                        "top_p": gconfig.top_p,
-                        "top_k": gconfig.top_k,
-                        "stop_token_ids": gconfig.stop_token_ids,
-                        "stop": gconfig.stop,
-                    },
-                }
+        failover_left = self.config.failover_retries
+        deadline = (
+            t_start + self.config.failover_deadline_seconds
+            if self.config.failover_deadline_seconds > 0
+            else None
+        )
+        addr: str | None = None
+        failed_addrs: set[str] = set()  # servers that failed THIS request
+        while stop_reason == "abort" and len(accumulated) < max_new:
+            while self._paused.is_set():
+                await asyncio.sleep(0.05)
+            if addr is None:
+                addr = self.choose_server(req.rid, avoid=failed_addrs)
+            payload = {
+                "rid": req.rid,
+                "input_ids": prompt + accumulated,
+                "image_data": encoded_images,
+                "sampling_params": {
+                    "max_new_tokens": max_new - len(accumulated),
+                    "min_new_tokens": max(
+                        0, gconfig.min_new_tokens - len(accumulated)
+                    ),
+                    "greedy": gconfig.greedy,
+                    "temperature": gconfig.temperature,
+                    "top_p": gconfig.top_p,
+                    "top_k": gconfig.top_k,
+                    "stop_token_ids": gconfig.stop_token_ids,
+                    "stop": gconfig.stop,
+                },
+            }
+            cur_addr = addr
+            self._health.on_request_start(cur_addr)
+            with self._inflight_lock:
+                self._inflight[cur_addr] = self._inflight.get(cur_addr, 0) + 1
+            t_req = time.monotonic()
+            outcome_recorded = False
+            try:
                 result = await arequest_with_retry(
                     session,
-                    f"http://{addr}/generate",
+                    f"http://{cur_addr}/generate",
                     payload=payload,
                     max_retries=self.config.request_retries,
                     timeout=self.config.request_timeout,
+                    total_timeout=(
+                        max(0.1, deadline - time.monotonic())
+                        if deadline is not None
+                        else None
+                    ),
+                    chaos=self._chaos,
                 )
-                if not accumulated:
-                    ttft = time.monotonic() - t_start
-                n_new = len(result["output_tokens"])
-                accumulated += result["output_tokens"]
-                logprobs += result["output_logprobs"]
-                versions += result["output_versions"]
-                itl += result.get("itl", [])
-                stop_reason = result["stop_reason"]
-                if stop_reason == "abort" and n_new == 0:
-                    # the server is paused by someone other than this
-                    # client (launcher-driven update, another process):
-                    # back off instead of busy-spinning
-                    # issue->abort->issue HTTP loops
-                    await asyncio.sleep(0.2)
-        finally:
-            with self._inflight_lock:
-                self._inflight[addr] -= 1
+                self._health.on_request_end(
+                    cur_addr, ok=True, latency=time.monotonic() - t_req
+                )
+                outcome_recorded = True
+            except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+                deadline_exhausted = (
+                    deadline is not None and time.monotonic() >= deadline
+                )
+                non_retriable_4xx = (
+                    isinstance(e, HTTPRequestError)
+                    and not e.retriable
+                    and e.status is not None
+                    and 400 <= e.status < 500
+                )
+                if deadline_exhausted or non_retriable_4xx:
+                    # don't charge the server for the CLIENT's expired
+                    # failover deadline or the CLIENT's own bad payload (a
+                    # 4xx answered correctly is the server working fine);
+                    # still release any half-open probe slot
+                    self._health.on_request_abandoned(cur_addr)
+                else:
+                    self._health.on_request_end(
+                        cur_addr, ok=False, error=str(e)
+                    )
+                outcome_recorded = True
+                if non_retriable_4xx or deadline_exhausted or failover_left <= 0:
+                    # a 4xx is the caller's bug — re-dispatching the same
+                    # payload fails identically on every server
+                    raise
+                failover_left -= 1
+                logger.warning(
+                    "generate rid=%s failed on %s (%s); re-dispatching with "
+                    "%d replay tokens (%d failover(s) left)",
+                    req.rid,
+                    cur_addr,
+                    e,
+                    len(accumulated),
+                    failover_left,
+                )
+                self._drop_rid_affinity(req.rid)
+                failed_addrs.add(cur_addr)
+                addr = None
+                continue
+            finally:
+                if not outcome_recorded:
+                    # cancelled mid-request (or a non-transport error):
+                    # release the half-open probe slot without charging the
+                    # server an outcome it didn't produce
+                    self._health.on_request_abandoned(cur_addr)
+                with self._inflight_lock:
+                    self._inflight[cur_addr] -= 1
+            if not accumulated:
+                ttft = time.monotonic() - t_start
+            n_new = len(result["output_tokens"])
+            accumulated += result["output_tokens"]
+            logprobs += result["output_logprobs"]
+            versions += result["output_versions"]
+            itl += result.get("itl", [])
+            stop_reason = result["stop_reason"]
+            if stop_reason == "abort" and n_new == 0:
+                # the server is paused by someone other than this
+                # client (launcher-driven update, another process):
+                # back off instead of busy-spinning
+                # issue->abort->issue HTTP loops
+                await asyncio.sleep(0.2)
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=accumulated,
@@ -269,17 +467,148 @@ class RemoteInfEngine(InferenceEngine):
 
     async def _close_session_for_current_loop(self):
         loop = asyncio.get_running_loop()
+        task_entry = self._probe_tasks.pop(id(loop), None)
+        if task_entry is not None:
+            task_entry[1].cancel()
         entry = self._sessions.pop(id(loop), None)
         if entry is not None:
             await entry[1].close()
+
+    def _new_session(self) -> aiohttp.ClientSession:
+        """One-shot session for the fan-out paths (their ``asyncio.run``
+        loops die with the call). Test seam: chaos tests swap in a scripted
+        in-process session with no sockets."""
+        return aiohttp.ClientSession()
+
+    # ------------------------------------------------------------------
+    # health probing (breaker OPEN -> HALF_OPEN path)
+    # ------------------------------------------------------------------
+
+    def _ensure_probe_task(self):
+        """Lazily start the background /health probe loop on the current
+        event loop (one per loop; cancelled on session close/destroy)."""
+        if not self.config.breaker.enabled:
+            return
+        loop = asyncio.get_running_loop()
+        entry = self._probe_tasks.get(id(loop))
+        if entry is not None and not entry[1].done():
+            return
+        from areal_tpu.utils.aio import create_tracked_task
+
+        task = create_tracked_task(
+            self._probe_loop(), name="server-health-probe",
+            log_exceptions=False,
+        )
+        self._probe_tasks[id(loop)] = (loop, task)
+
+    async def _probe_loop(self):
+        interval = self.config.breaker.probe_interval_seconds
+        while True:  # cancelled via _close_session_for_current_loop/destroy
+            try:
+                await self._probe_open_servers(await self._get_session())
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # probe failures must not kill the loop
+                logger.debug("health probe sweep failed: %s", e)
+            await asyncio.sleep(interval)
+
+    async def _probe_open_servers(self, session) -> None:
+        """One probe sweep: GET /health on every OPEN server past its
+        cooldown; quarantined servers additionally pass a version check
+        (re-pushing the last disk weight update they missed, if any).
+        Success moves the breaker to HALF_OPEN; trial traffic closes it."""
+        probe_timeout = self.config.breaker.probe_timeout_seconds
+        for addr in self._health.probe_candidates():
+            ok = False
+            version: int | None = None
+            try:
+                async with session.get(
+                    f"http://{addr}/health",
+                    timeout=aiohttp.ClientTimeout(total=probe_timeout),
+                ) as resp:
+                    ok = resp.status == 200
+                required = self._health.required_version(addr)
+                if ok and required is not None:
+                    version = await self._probe_version(
+                        session, addr, required, probe_timeout
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug("health probe of %s failed: %s", addr, e)
+                ok = False
+            self._health.on_probe_result(addr, ok, version)
+
+    async def _probe_version(
+        self, session, addr: str, required: int, probe_timeout: float
+    ) -> int | None:
+        """Weight version of a quarantined server, re-pushing the last disk
+        update it missed when stale (so recovery doesn't depend on the next
+        trainer step happening to fan out)."""
+        async def read_version() -> int | None:
+            async with session.get(
+                f"http://{addr}/model_info",
+                timeout=aiohttp.ClientTimeout(total=probe_timeout),
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                info = await resp.json()
+                return info.get("weight_version")
+
+        version = await read_version()
+        if (
+            version is not None
+            and version < required
+            and self._last_disk_update is not None
+            and self._last_disk_update[1] >= required
+        ):
+            path, v = self._last_disk_update
+            logger.info(
+                "re-pushing missed weight update v%d to quarantined %s",
+                v, addr,
+            )
+            # bounded by the probe timeout, NOT request_timeout: a hung
+            # quarantined server must not stall the (sequential) probe
+            # sweep for every other OPEN server. If the load legitimately
+            # takes longer, the server finishes it server-side and a later
+            # sweep reads the caught-up version.
+            await arequest_with_retry(
+                session,
+                f"http://{addr}/update_weights_from_disk",
+                payload={"model_path": path, "version": v},
+                max_retries=1,
+                timeout=probe_timeout,
+            )
+            version = await read_version()
+        return version
 
     # ------------------------------------------------------------------
     # weight updates
     # ------------------------------------------------------------------
 
+    def _update_targets(self, next_version: int) -> list[str]:
+        """Fan-out targets for a weight update: every non-OPEN server.
+        Already-OPEN servers are skipped and re-quarantined at the new
+        version — the rejoin probe re-syncs them instead, so one dead
+        server cannot stall or abort the training step."""
+        targets = []
+        for a in self.addresses:
+            if self._health.state(a) == OPEN:
+                self._health.quarantine(a, required_version=next_version)
+            else:
+                targets.append(a)
+        return targets
+
     def update_weights(self, meta: WeightUpdateMeta):
-        """Fan the update out to every server. Caller (train engine) has
-        already written the checkpoint for the disk path."""
+        """Fan the update out to every reachable server. Caller (train
+        engine) has already written the checkpoint for the disk path.
+
+        Degraded mode: a per-server failure quarantines that server
+        (breaker forced OPEN at the new version; excluded from routing
+        until a version-checked probe passes) instead of aborting the
+        training step — unless fewer than
+        ``update_weights_min_healthy_fraction`` of the servers took the
+        update, in which case the step raises."""
         if self._spectator:
             self._version += 1  # stay in step with the head's version
             return
@@ -290,11 +619,12 @@ class RemoteInfEngine(InferenceEngine):
             )
         next_version = self._version + 1
         save_ts = time.time_ns()
+        targets = self._update_targets(next_version)
 
         async def _update():
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
-                await asyncio.gather(
+                return await asyncio.gather(
                     *[
                         arequest_with_retry(
                             session,
@@ -306,13 +636,48 @@ class RemoteInfEngine(InferenceEngine):
                             max_retries=self.config.request_retries,
                             timeout=self.config.request_timeout,
                         )
-                        for a in self.addresses
-                    ]
+                        for a in targets
+                    ],
+                    return_exceptions=True,
                 )
             finally:
                 await session.close()
 
-        asyncio.run(_update())
+        results = asyncio.run(_update())
+        failed = [
+            (a, r)
+            for a, r in zip(targets, results)
+            if isinstance(r, BaseException)
+        ]
+        healthy = len(targets) - len(failed)
+        if failed and not self.config.breaker.enabled:
+            # without the breaker plane there is no quarantine and no
+            # version-checked rejoin: a stale server would silently stay in
+            # rotation, so strict all-or-nothing semantics are the only
+            # honest ones
+            raise RuntimeError(
+                f"weight update v{next_version} failed on "
+                f"{len(failed)}/{len(targets)} servers (breaker disabled, "
+                "degraded mode unavailable): "
+                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
+            ) from failed[0][1]
+        min_frac = self.config.update_weights_min_healthy_fraction
+        if healthy < max(1, min_frac * len(self.addresses)):
+            raise RuntimeError(
+                f"weight update v{next_version} reached only {healthy}/"
+                f"{len(self.addresses)} servers (min healthy fraction "
+                f"{min_frac}); failures: "
+                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
+            ) from (failed[0][1] if failed else None)
+        for a, r in failed:
+            logger.warning(
+                "quarantining %s after failed weight update v%d: %s",
+                a, next_version, r,
+            )
+            self._health.quarantine(a, required_version=next_version)
+        # remember the update so a quarantined server's rejoin probe can
+        # re-push it (see _probe_version)
+        self._last_disk_update = (meta.path, next_version)
         load_ts = time.time_ns()
         try:
             name_resolve.add(
@@ -327,8 +692,9 @@ class RemoteInfEngine(InferenceEngine):
         except Exception:
             logger.debug("name_resolve unavailable for update latency key")
         logger.info(
-            "weight update v%d fanned out to %d servers in %.2fs",
+            "weight update v%d fanned out to %d/%d servers in %.2fs",
             next_version,
+            healthy,
             len(self.addresses),
             (load_ts - save_ts) / 1e9,
         )
@@ -354,7 +720,7 @@ class RemoteInfEngine(InferenceEngine):
 
         async def _push_all():
             nonlocal n_chunks
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
                 it = iter(chunks)
                 try:
@@ -459,7 +825,7 @@ class RemoteInfEngine(InferenceEngine):
 
         async def _push_all():
             nonlocal n_chunks
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
                 it = iter(chunks)
                 try:
@@ -560,7 +926,7 @@ class RemoteInfEngine(InferenceEngine):
 
         async def _push_all():
             nonlocal n_chunks
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
                 it = iter(chunks)
                 try:
@@ -629,7 +995,7 @@ class RemoteInfEngine(InferenceEngine):
         blob = st_save({k: np.ascontiguousarray(v) for k, v in named.items()})
 
         async def _push_all():
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
                 await asyncio.gather(
                     *[
@@ -673,25 +1039,39 @@ class RemoteInfEngine(InferenceEngine):
         self.executor.resume()
 
     def _fanout(self, endpoint: str):
+        """pause/continue fence fan-out. OPEN servers are skipped (they
+        receive zero traffic and are not generating); a fence failure on a
+        live server quarantines it rather than aborting the step — its
+        in-flight tokens carry per-token versions, so decoupled PPO stays
+        correct even if it kept generating through the update."""
+        targets = [a for a in self.addresses if self._health.state(a) != OPEN]
+
         async def _go():
-            session = aiohttp.ClientSession()
+            session = self._new_session()
             try:
-                await asyncio.gather(
+                return await asyncio.gather(
                     *[
                         arequest_with_retry(
                             session,
                             f"http://{a}/{endpoint}",
                             payload={},
                             max_retries=self.config.request_retries,
-                            timeout=60.0,
+                            timeout=self.config.pause_continue_request_timeout,
                         )
-                        for a in self.addresses
-                    ]
+                        for a in targets
+                    ],
+                    return_exceptions=True,
                 )
             finally:
                 await session.close()
 
-        asyncio.run(_go())
+        results = asyncio.run(_go())
+        for a, r in zip(targets, results):
+            if isinstance(r, BaseException):
+                logger.warning(
+                    "%s fan-out to %s failed (%s); quarantining", endpoint, a, r
+                )
+                self._health.quarantine(a)
 
     # ------------------------------------------------------------------
     # version + rollout-runtime delegation

@@ -55,6 +55,7 @@ class StalenessManager:
 
     def on_rollout_rejected(self) -> None:
         with self._lock:
+            self._stat.rejected += 1
             self._stat.running -= 1
 
     def get_stats(self) -> RolloutStat:

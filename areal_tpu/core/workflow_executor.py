@@ -188,14 +188,24 @@ class WorkflowExecutor:
                 for task in done:
                     rid = int(task.get_name())
                     create_time, _, x = live.pop(rid)
-                    traj = await task  # re-raises workflow exceptions
-                    if traj is not None and self.config.check_trajectory_format:
-                        check_trajectory_format(traj, self._expected_keys)
-                        if self._expected_keys is None and "input_ids" in traj:
-                            self._expected_keys = set(traj.keys())
-                    accept = traj is not None and (
-                        x.should_accept is None or x.should_accept(traj)
-                    )
+                    try:
+                        traj = await task  # re-raises workflow exceptions
+                        if traj is not None and self.config.check_trajectory_format:
+                            check_trajectory_format(traj, self._expected_keys)
+                            if self._expected_keys is None and "input_ids" in traj:
+                                self._expected_keys = set(traj.keys())
+                        accept = traj is not None and (
+                            x.should_accept is None or x.should_accept(traj)
+                        )
+                    except BaseException:
+                        # balance the staleness counters before propagating:
+                        # a dead episode (workflow exception, format check,
+                        # should_accept raising) must not leak `running`
+                        # capacity — submitted == accepted + rejected +
+                        # running must hold even through a crash-and-recover
+                        # cycle
+                        self.staleness_manager.on_rollout_rejected()
+                        raise
                     if accept:
                         self.staleness_manager.on_rollout_accepted()
                         try:
@@ -222,6 +232,11 @@ class WorkflowExecutor:
                 t.cancel()
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
+            # episodes that never completed (shutdown or crash) balance the
+            # counters as rejections, so running returns to zero and
+            # submitted == accepted + rejected holds at quiescence
+            for _ in live:
+                self.staleness_manager.on_rollout_rejected()
 
     # --------------------------------------------------------------- client
 

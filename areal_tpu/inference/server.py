@@ -67,8 +67,26 @@ def _response_payload(r: ModelResponse) -> dict:
 
 
 class GenerationServer:
-    def __init__(self, engine: GenerationEngine):
+    def __init__(self, engine: GenerationEngine, chaos=None):
         self.engine = engine
+        # deterministic fault injection (utils/chaos.py): explicit policy
+        # (tests) or env-gated via AREAL_CHAOS_SERVER. Off by default, and
+        # off means the middleware is simply not installed — the serving
+        # path pays zero overhead.
+        if chaos is None:
+            from areal_tpu.utils.chaos import ChaosPolicy
+
+            chaos = ChaosPolicy.from_env()
+        self.chaos = chaos
+        middlewares = []
+        if chaos is not None:
+            from areal_tpu.utils.chaos import aiohttp_chaos_middleware
+
+            logger.warning(
+                "CHAOS injection enabled on generation server: %s",
+                chaos.describe(),
+            )
+            middlewares.append(aiohttp_chaos_middleware(chaos))
         # must exceed the largest weight-resync chunk (WeightUpdateMeta
         # chunked_mem_mb defaults: http 512MB, shm 1024MB) plus safetensors
         # header overhead — a 256MB cap 413'd the default http push. The
@@ -76,7 +94,9 @@ class GenerationServer:
         # can validate a configured chunked_mem_mb against it client-side
         # (remote_inf_engine.update_weights_from_tensors) instead of
         # discovering the mismatch as a 413.
-        self.app = web.Application(client_max_size=SERVER_CLIENT_MAX_SIZE)
+        self.app = web.Application(
+            client_max_size=SERVER_CLIENT_MAX_SIZE, middlewares=middlewares
+        )
         self.app.add_routes(
             [
                 web.get("/health", self.health),

@@ -7,4 +7,5 @@ from areal_tpu.lint.rules import (  # noqa: F401
     jit_discipline,
     locks,
     prng,
+    retries,
 )

@@ -1,0 +1,131 @@
+"""Retry discipline.
+
+naked-retry-loop: a loop that retries an awaited network request with no
+backoff sleep hammers a struggling server in a tight loop (the retry storm
+that turns one slow server into a dead one), and an unbounded
+``while True`` retry spins past any caller deadline. Bound the attempts,
+back off with jitter, and put a total deadline on the call —
+``areal_tpu.utils.http.arequest_with_retry`` does all three.
+
+A *retry loop* here is a ``while`` loop or a ``for _ in range(...)`` loop
+(attempt counting) containing an awaited request-like call inside a
+``try`` whose handler swallows the error (no ``raise`` anywhere in the
+handler — the classic retry shape). Fan-out loops (``for addr in
+servers``) iterate targets, not attempts, and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+    walk_excluding_nested_functions,
+)
+
+#: last path segments that unambiguously mark a network request
+_REQUEST_SUFFIXES = {"request", "fetch", "urlopen"}
+
+#: HTTP-verb suffixes shared with non-network APIs (asyncio.Queue.get,
+#: dict-likes): they only count when called with an argument (aiohttp's
+#: session.get(url) always has one; queue.get() never does)
+_VERB_SUFFIXES = {"get", "post", "put", "delete", "patch"}
+
+
+def _is_request_call(ctx: FileContext, call: ast.Call) -> bool:
+    dotted = ctx.dotted(call.func) or ""
+    if not dotted:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    if last in _REQUEST_SUFFIXES or "request" in last:
+        return True
+    return last in _VERB_SUFFIXES and bool(call.args)
+
+
+def _is_sleepish(ctx: FileContext, call: ast.Call) -> bool:
+    dotted = ctx.dotted(call.func) or ""
+    last = dotted.rsplit(".", 1)[-1]
+    return last == "sleep" or "backoff" in last
+
+
+def _is_while_true(loop: ast.AST) -> bool:
+    return isinstance(loop, ast.While) and (
+        isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+    )
+
+
+def _is_attempt_loop(loop: ast.AST) -> bool:
+    """while-loops and ``for ... in range(...)`` count attempts; for-loops
+    over anything else iterate targets (fan-out) and are exempt."""
+    if isinstance(loop, ast.While):
+        return True
+    if isinstance(loop, ast.For) and isinstance(loop.iter, ast.Call):
+        f = loop.iter.func
+        return isinstance(f, ast.Name) and f.id == "range"
+    return False
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    return not any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+@register
+class NakedRetryLoopRule(Rule):
+    id = "naked-retry-loop"
+    doc = (
+        "retry loop around an awaited request with no backoff sleep, or an "
+        "unbounded `while True` retry"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            if not _is_attempt_loop(loop):
+                continue
+            body = list(walk_excluding_nested_functions(loop, include_async=True))
+            retry_shape = False
+            for node in body:
+                if not isinstance(node, ast.Try):
+                    continue
+                has_request = any(
+                    isinstance(n, ast.Await)
+                    and isinstance(n.value, ast.Call)
+                    and _is_request_call(ctx, n.value)
+                    for n in ast.walk(node)
+                )
+                if has_request and any(
+                    _handler_swallows(h) for h in node.handlers
+                ):
+                    retry_shape = True
+                    break
+            if not retry_shape:
+                continue
+            if _is_while_true(loop):
+                yield self.finding(
+                    ctx,
+                    loop,
+                    "unbounded `while True` retry around an awaited request "
+                    "can spin past any caller deadline; bound the attempts "
+                    "or add a deadline (see "
+                    "areal_tpu.utils.http.arequest_with_retry)",
+                )
+            has_backoff = any(
+                isinstance(n, ast.Await)
+                and isinstance(n.value, ast.Call)
+                and _is_sleepish(ctx, n.value)
+                for n in body
+            )
+            if not has_backoff:
+                yield self.finding(
+                    ctx,
+                    loop,
+                    "retry loop around an awaited request has no backoff "
+                    "sleep; a tight retry loop turns one slow server into a "
+                    "dead one — back off with jitter (see "
+                    "areal_tpu.utils.http.arequest_with_retry)",
+                )

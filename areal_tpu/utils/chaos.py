@@ -1,0 +1,233 @@
+"""Deterministic fault injection for the rollout client<->server HTTP path.
+
+Every fault-tolerance behavior in the client plane (circuit breakers,
+failover re-dispatch, degraded weight-update fan-out) is exercised by
+*deterministic* chaos rather than hope: a :class:`ChaosPolicy` holds a
+seeded RNG plus per-endpoint rules (drop, http_error/5xx, timeout,
+slow-response, disconnect-mid-stream, fail-next-N) and is hookable into
+
+- the client side: ``arequest_with_retry(..., chaos=policy)``
+  (areal_tpu/utils/http.py) — the injected fault goes through the *same*
+  retry/classification path a real failure would;
+- the server side: :func:`aiohttp_chaos_middleware` installed by
+  ``GenerationServer`` when the ``AREAL_CHAOS_SERVER`` env var carries a
+  JSON policy (or a policy is passed explicitly in tests).
+
+Zero overhead when off: the client hook is a single ``chaos is not None``
+check, and the server middleware is simply not installed.
+
+Determinism: rules default to ``probability=1.0`` and ``times=N``
+(fail-next-N), in which case the RNG is never consulted; probabilistic
+rules draw from ``random.Random(seed)`` so a run replays exactly. The
+``sleep`` used for slow/drop actions is injectable so tests advance a fake
+clock instead of waiting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import random
+from typing import TYPE_CHECKING
+
+from areal_tpu.utils import logging
+
+if TYPE_CHECKING:  # pragma: no cover
+    from areal_tpu.api.cli_args import ChaosConfig
+
+logger = logging.getLogger("chaos")
+
+CHAOS_SERVER_ENV = "AREAL_CHAOS_SERVER"
+
+#: action vocabulary shared by config validation and the two hook sites
+ACTIONS = ("drop", "http_error", "timeout", "slow", "disconnect")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosAction:
+    """A decided fault for one request.
+
+    ``kind`` is the *effect* vocabulary, not the rule vocabulary:
+    "status" (synthesized HTTP error), "slow" (delay then proceed),
+    "disconnect" (sever the connection), "drop" (the request vanishes —
+    the client perceives a timeout, the server never answers).
+    """
+
+    kind: str
+    status: int = 503
+    delay: float = 0.0
+
+
+class _Rule:
+    __slots__ = ("endpoint", "action", "probability", "status", "delay", "remaining")
+
+    def __init__(
+        self,
+        endpoint: str = "*",
+        action: str = "http_error",
+        probability: float = 1.0,
+        status: int = 503,
+        delay: float = 0.0,
+        times: int = 0,
+    ):
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; one of {ACTIONS}")
+        self.endpoint = endpoint
+        self.action = action
+        self.probability = probability
+        self.status = status
+        self.delay = delay
+        self.remaining = times if times > 0 else None  # None = unlimited
+
+    def matches(self, path: str) -> bool:
+        return self.endpoint == "*" or self.endpoint in path
+
+    def describe(self) -> str:
+        n = "inf" if self.remaining is None else str(self.remaining)
+        return f"{self.endpoint}:{self.action}(p={self.probability},n={n})"
+
+
+def _effect(rule: _Rule) -> ChaosAction:
+    if rule.action == "http_error":
+        return ChaosAction(kind="status", status=rule.status, delay=rule.delay)
+    if rule.action == "slow":
+        return ChaosAction(kind="slow", delay=rule.delay)
+    if rule.action == "disconnect":
+        return ChaosAction(kind="disconnect", delay=rule.delay)
+    # drop and timeout share the effect: no answer ever comes back
+    return ChaosAction(kind="drop", delay=rule.delay)
+
+
+class ChaosPolicy:
+    """Seeded, per-endpoint fault decisions. One instance per hook site
+    (client engine or server); not shared across threads."""
+
+    def __init__(self, rules: list[_Rule] | None = None, seed: int = 0, sleep=None):
+        self._rules: list[_Rule] = list(rules or [])
+        self._rng = random.Random(seed)
+        self.sleep = sleep if sleep is not None else asyncio.sleep
+        self.injected = 0  # total faults decided (tests/telemetry)
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg: "ChaosConfig | None", sleep=None) -> "ChaosPolicy | None":
+        """None when chaos is off — callers then pay only a None check."""
+        if cfg is None or not cfg.enabled or not cfg.rules:
+            return None
+        rules = [
+            _Rule(
+                endpoint=r.endpoint,
+                action=r.action,
+                probability=r.probability,
+                status=r.status,
+                delay=r.delay_seconds,
+                times=r.times,
+            )
+            for r in cfg.rules
+        ]
+        return cls(rules, seed=cfg.seed, sleep=sleep)
+
+    @classmethod
+    def from_env(cls, env: str = CHAOS_SERVER_ENV) -> "ChaosPolicy | None":
+        """Server-side gate: a JSON policy in the env enables injection,
+        e.g. ``{"seed": 0, "rules": [{"endpoint": "generate",
+        "action": "http_error", "status": 503, "times": 2}]}``."""
+        raw = os.environ.get(env, "")
+        if not raw:
+            return None
+        spec = json.loads(raw)
+        rules = [
+            _Rule(
+                endpoint=r.get("endpoint", "*"),
+                action=r.get("action", "http_error"),
+                probability=float(r.get("probability", 1.0)),
+                status=int(r.get("status", 503)),
+                delay=float(r.get("delay_seconds", 0.0)),
+                times=int(r.get("times", 0)),
+            )
+            for r in spec.get("rules", [])
+        ]
+        if not rules:
+            return None
+        return cls(rules, seed=int(spec.get("seed", 0)))
+
+    # -- runtime --------------------------------------------------------
+
+    def add_rule(
+        self,
+        endpoint: str = "*",
+        action: str = "http_error",
+        times: int = 0,
+        probability: float = 1.0,
+        status: int = 503,
+        delay: float = 0.0,
+    ) -> None:
+        """Arm a rule programmatically (fail-next-N in tests)."""
+        self._rules.append(
+            _Rule(
+                endpoint=endpoint,
+                action=action,
+                probability=probability,
+                status=status,
+                delay=delay,
+                times=times,
+            )
+        )
+
+    def decide(self, url_or_path: str) -> ChaosAction | None:
+        """The fault (if any) to inject for this request. First matching
+        armed rule wins; a ``times``-limited rule disarms after its budget."""
+        path = url_or_path.split("?", 1)[0]
+        for rule in self._rules:
+            if rule.remaining == 0 or not rule.matches(path):
+                continue
+            if rule.probability < 1.0 and self._rng.random() >= rule.probability:
+                continue
+            if rule.remaining is not None:
+                rule.remaining -= 1
+            self.injected += 1
+            return _effect(rule)
+        return None
+
+    def describe(self) -> str:
+        return ", ".join(r.describe() for r in self._rules) or "(no rules)"
+
+
+def aiohttp_chaos_middleware(policy: ChaosPolicy):
+    """Server-side hook: an aiohttp middleware applying ``policy`` to every
+    request. Only installed when a policy exists, so the production server
+    pays nothing."""
+    from aiohttp import web
+
+    @web.middleware
+    async def chaos_middleware(request, handler):
+        act = policy.decide(request.path)
+        if act is None:
+            return await handler(request)
+        logger.warning("chaos: %s on %s", act.kind, request.path)
+        if act.kind == "slow":
+            await policy.sleep(act.delay)
+            return await handler(request)
+        if act.kind == "status":
+            if act.delay:
+                await policy.sleep(act.delay)
+            return web.json_response(
+                {"error": "chaos-injected failure"}, status=act.status
+            )
+        if act.kind == "disconnect":
+            # sever mid-stream: the client sees the connection die with no
+            # (complete) response on the wire
+            if request.transport is not None:
+                request.transport.close()
+            raise web.HTTPInternalServerError(text="chaos disconnect")
+        # drop: hold the request, then sever — the client's own timeout is
+        # what surfaces the fault
+        await policy.sleep(act.delay or 3600.0)
+        if request.transport is not None:
+            request.transport.close()
+        raise web.HTTPServiceUnavailable(text="chaos drop")
+
+    return chaos_middleware

@@ -1,8 +1,34 @@
-"""Async HTTP helpers (reference: areal/utils/http.py)."""
+"""Async HTTP helpers (reference: areal/utils/http.py).
+
+``arequest_with_retry`` is the single chokepoint every client->server
+request in the rollout plane goes through, so its retry discipline is the
+difference between graceful degradation and a retry storm:
+
+- **status classification** — only 408/425/429/5xx and transport errors
+  (connect/reset/timeout) retry; any other 4xx is the caller's bug (bad
+  payload, wrong endpoint) and fails fast on the first attempt;
+- **full-jitter exponential backoff** — delay ~ U(0, base * 2^attempt), so
+  a fleet of clients recovering from the same outage doesn't re-stampede
+  the server in lockstep;
+- **Retry-After** — a 429/503 that says when to come back is honored (the
+  floor of the next delay), seconds or HTTP-date form;
+- **total deadline** — ``total_timeout`` bounds the whole call including
+  backoff sleeps, so retries can never exceed the caller's budget;
+- **chaos hook** — a :class:`~areal_tpu.utils.chaos.ChaosPolicy` injects
+  deterministic faults through the same classification path a real failure
+  takes. When ``chaos is None`` (production) the hot path pays exactly one
+  None comparison: no awaits, no locks.
+
+``sleep``/``clock``/``rng`` are injectable so chaos tests run with fake
+time — no real sleeps in tier-1.
+"""
 
 from __future__ import annotations
 
 import asyncio
+import email.utils
+import random
+import time
 from typing import Any
 
 import aiohttp
@@ -11,9 +37,67 @@ from areal_tpu.utils import logging
 
 logger = logging.getLogger("http")
 
+#: statuses worth retrying: request-timeout, too-early, rate-limit, and the
+#: 5xx family. Everything else in 4xx-land is deterministic caller error.
+RETRIABLE_STATUSES = frozenset({408, 425, 429, 500, 502, 503, 504})
+
+#: transport-level failures that retry (the connection, not the request,
+#: was the problem). asyncio.TimeoutError != TimeoutError on py3.10.
+TRANSPORT_ERRORS = (
+    aiohttp.ClientError,
+    asyncio.TimeoutError,
+    TimeoutError,
+    ConnectionError,
+    OSError,
+)
+
 
 class HTTPRequestError(RuntimeError):
-    pass
+    def __init__(
+        self,
+        message: str,
+        status: int | None = None,
+        retriable: bool = True,
+    ):
+        super().__init__(message)
+        self.status = status
+        self.retriable = retriable
+
+
+#: ceiling on a server-sent Retry-After: a misconfigured proxy saying
+#: "come back tomorrow" (or "inf") must not stall a rollout slot — the
+#: bounded exponential backoff resumes past this cap
+RETRY_AFTER_CAP = 60.0
+
+
+def _parse_retry_after(value: str | None) -> float | None:
+    """Retry-After header -> seconds (delta-seconds or HTTP-date form),
+    capped at :data:`RETRY_AFTER_CAP`; non-finite values are ignored."""
+    if not value:
+        return None
+    import math
+
+    try:
+        secs = float(value)
+        if not math.isfinite(secs):
+            return None
+        return min(RETRY_AFTER_CAP, max(0.0, secs))
+    except ValueError:
+        pass
+    try:
+        dt = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    import datetime
+
+    if dt.tzinfo is None:
+        # parsedate_to_datetime returns a NAIVE datetime for a -0000 zone;
+        # subtracting it from an aware `now` raises TypeError
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return min(RETRY_AFTER_CAP, max(0.0, (dt - now).total_seconds()))
 
 
 async def arequest_with_retry(
@@ -25,29 +109,89 @@ async def arequest_with_retry(
     max_retries: int = 3,
     timeout: float = 3600.0,
     retry_delay: float = 1.0,
+    total_timeout: float | None = None,
+    chaos=None,
+    rng=None,
+    sleep=None,
+    clock=None,
 ) -> dict[str, Any]:
-    """POST/GET with exponential-backoff retries; raises HTTPRequestError
-    after exhausting retries."""
+    """POST/GET with classified retries, full-jitter backoff, Retry-After,
+    and a total-deadline budget; raises :class:`HTTPRequestError` on a
+    non-retriable status or after exhausting retries/deadline."""
+    rng = rng if rng is not None else random
+    sleep = sleep if sleep is not None else asyncio.sleep
+    clock = clock if clock is not None else time.monotonic
+    deadline = (clock() + total_timeout) if total_timeout is not None else None
     last_exc: Exception | None = None
-    for attempt in range(max_retries):
+    attempt = 0
+    while attempt < max_retries:
+        attempt += 1
+        retry_after: float | None = None
         try:
+            per_try = timeout
+            if deadline is not None:
+                remaining = deadline - clock()
+                if remaining <= 0:
+                    raise HTTPRequestError(
+                        f"{method} {url} exceeded total deadline "
+                        f"{total_timeout}s after {attempt - 1} attempt(s)",
+                        retriable=False,
+                    ) from last_exc
+                per_try = min(per_try, remaining)
+            if chaos is not None:
+                act = chaos.decide(url)
+                if act is not None:
+                    if act.kind == "slow":
+                        await chaos.sleep(act.delay)
+                    elif act.kind == "status":
+                        raise HTTPRequestError(
+                            f"{method} {url} -> {act.status}: chaos-injected",
+                            status=act.status,
+                            retriable=act.status in RETRIABLE_STATUSES,
+                        )
+                    elif act.kind == "disconnect":
+                        raise aiohttp.ServerDisconnectedError(
+                            "chaos-injected disconnect"
+                        )
+                    else:  # drop: the request vanished; client sees timeout
+                        raise asyncio.TimeoutError("chaos-injected drop")
             async with session.request(
                 method,
                 url,
                 json=payload,
                 data=data,
-                timeout=aiohttp.ClientTimeout(total=timeout),
+                timeout=aiohttp.ClientTimeout(total=per_try),
             ) as resp:
                 if resp.status == 200:
                     return await resp.json()
                 body = await resp.text()
-                last_exc = HTTPRequestError(
-                    f"{method} {url} -> {resp.status}: {body[:500]}"
+                retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
+                raise HTTPRequestError(
+                    f"{method} {url} -> {resp.status}: {body[:500]}",
+                    status=resp.status,
+                    retriable=resp.status in RETRIABLE_STATUSES,
                 )
         except asyncio.CancelledError:
             raise
-        except Exception as e:
+        except HTTPRequestError as e:
+            if not e.retriable:
+                raise  # fail fast: retrying a 404/400 only hides the bug
             last_exc = e
-        if attempt + 1 < max_retries:
-            await asyncio.sleep(retry_delay * 2**attempt)
-    raise HTTPRequestError(f"{method} {url} failed after {max_retries} tries") from last_exc
+        except TRANSPORT_ERRORS as e:
+            last_exc = e
+        if attempt >= max_retries:
+            break
+        # full jitter: U(0, base * 2^(attempt-1)); Retry-After floors it
+        delay = rng.uniform(0, retry_delay * 2 ** (attempt - 1))
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        if deadline is not None:
+            remaining = deadline - clock()
+            if remaining <= 0:
+                break
+            delay = min(delay, remaining)
+        await sleep(delay)
+    raise HTTPRequestError(
+        f"{method} {url} failed after {attempt} attempt(s): {last_exc}",
+        status=getattr(last_exc, "status", None),
+    ) from last_exc

@@ -32,6 +32,23 @@ logger = logging.getLogger("recover")
 RECOVER_ENV = "AREAL_RECOVER_RUN"
 
 
+class RecoverStateCorrupted(RuntimeError):
+    """The on-disk recover state is unreadable (truncated json, partial
+    pickle, missing checkpoint). Raised instead of the raw decode error so
+    the launcher refuses to resume with a clear message rather than
+    crashing opaquely — delete the recover dir to start fresh."""
+
+
+def _atomic_write(path: str, write_fn, binary: bool = False) -> None:
+    """Write via tmp-file + rename so readers never see a partial file."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb" if binary else "w") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def config_hash(cfg) -> str:
     try:
         blob = json.dumps(to_dict(cfg), sort_keys=True, default=str)
@@ -123,14 +140,23 @@ class RecoverHandler:
             "saver": saver.state_dict() if saver is not None else None,
             "evaluator": evaluator.state_dict() if evaluator is not None else None,
         }
-        with open(os.path.join(root, "loop_state.pkl"), "wb") as f:
-            pickle.dump(state, f)
+        # write-then-rename: a crash mid-dump must leave either the previous
+        # consistent state or none, never a truncated file that a recovery
+        # run would choke on. recover_info.json goes LAST — its presence is
+        # the commit marker for the whole dump.
+        _atomic_write(
+            os.path.join(root, "loop_state.pkl"),
+            lambda f: pickle.dump(state, f),
+            binary=True,
+        )
         info = RecoverInfo(
             last_step_info=step,
             config_hash=config_hash(config) if config is not None else "",
         )
-        with open(os.path.join(root, "recover_info.json"), "w") as f:
-            json.dump(info.to_json(), f)
+        _atomic_write(
+            os.path.join(root, "recover_info.json"),
+            lambda f: json.dump(info.to_json(), f),
+        )
         self.timer.reset()
         logger.info("recover state dumped at %s (step %d)", root, step.global_step)
         return root
@@ -151,8 +177,14 @@ class RecoverHandler:
         info_path = os.path.join(root, "recover_info.json")
         if not os.path.isfile(info_path):
             return None
-        with open(info_path) as f:
-            info = RecoverInfo.from_json(json.load(f))
+        try:
+            with open(info_path) as f:
+                info = RecoverInfo.from_json(json.load(f))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise RecoverStateCorrupted(
+                f"refusing to resume: {info_path} is corrupted ({e}); "
+                f"delete {root} to start the trial fresh"
+            ) from e
         if config is not None and info.config_hash:
             h = config_hash(config)
             if h != info.config_hash:
@@ -160,15 +192,27 @@ class RecoverHandler:
                     f"refusing to recover: config hash {h} != saved "
                     f"{info.config_hash} (the trial config changed)"
                 )
-        engine.load(
-            SaveLoadMeta(
-                path=os.path.join(root, "engine"),
-                weight_format="orbax",
-                with_optim=True,
+        try:
+            engine.load(
+                SaveLoadMeta(
+                    path=os.path.join(root, "engine"),
+                    weight_format="orbax",
+                    with_optim=True,
+                )
             )
-        )
-        with open(os.path.join(root, "loop_state.pkl"), "rb") as f:
-            state = pickle.load(f)
+        except Exception as e:
+            raise RecoverStateCorrupted(
+                f"refusing to resume: engine checkpoint under {root} is "
+                f"partial or corrupted ({e}); delete {root} to start fresh"
+            ) from e
+        try:
+            with open(os.path.join(root, "loop_state.pkl"), "rb") as f:
+                state = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError, AttributeError) as e:
+            raise RecoverStateCorrupted(
+                f"refusing to resume: {root}/loop_state.pkl is corrupted "
+                f"({e}); delete {root} to start fresh"
+            ) from e
         if dataloader is not None and state.get("dataloader") is not None:
             dataloader.load_state_dict(state["dataloader"])
         if saver is not None and state.get("saver") is not None:

@@ -5,7 +5,7 @@ import requests  # noqa: F401 — never imported at runtime; lint-only fixture
 
 
 async def retry_loop_with_sync_sleep(url, session):
-    for attempt in range(3):
+    for attempt in range(3):  # lint-expect: naked-retry-loop
         try:
             return await session.post(url)
         except Exception:

@@ -1,0 +1,54 @@
+# arealint fixture: naked-retry-loop TRUE NEGATIVES (no findings expected).
+import asyncio
+import random
+
+
+async def bounded_with_jittered_backoff(session, url, retry_delay=1.0):
+    # the blessed shape: bounded attempts + full-jitter exponential backoff
+    last = None
+    for attempt in range(3):
+        try:
+            return await session.post(url)
+        except Exception as e:
+            last = e
+        await asyncio.sleep(random.uniform(0, retry_delay * 2**attempt))
+    raise last
+
+
+async def fanout_not_retry(session, urls):
+    # a for-loop over TARGETS is a fan-out, not a retry loop
+    results = []
+    for url in urls:
+        try:
+            results.append(await session.post(url))
+        except Exception:
+            results.append(None)
+    return results
+
+
+async def reraising_loop(session, url):
+    # the handler re-raises: not a retry, just cleanup
+    for _ in range(3):
+        try:
+            return await session.get(url)
+        except Exception:
+            raise RuntimeError("gave up")
+
+
+async def non_request_loop(queue):
+    # awaited call is not a network request
+    while True:
+        try:
+            return await queue.get_item()
+        except asyncio.CancelledError:
+            continue
+
+
+async def queue_consumer_loop(queue, out):
+    # the canonical asyncio.Queue consumer: `.get` with no argument is not
+    # a network request (aiohttp's session.get(url) always has one)
+    while True:
+        try:
+            out.append(await queue.get())
+        except asyncio.CancelledError:
+            continue

@@ -1,0 +1,36 @@
+# arealint fixture: naked-retry-loop TRUE POSITIVES.
+import asyncio
+
+
+async def unbounded_retry(session, url):
+    while True:  # lint-expect: naked-retry-loop
+        try:
+            return await session.post(url)
+        except Exception:
+            await asyncio.sleep(1.0)  # backoff doesn't excuse unboundedness
+
+
+async def tight_for_retry(session, url):
+    for _ in range(5):  # lint-expect: naked-retry-loop
+        try:
+            return await session.get(url)
+        except Exception:
+            continue  # no backoff: hammers the struggling server
+
+
+async def tight_while_retry(session, url, max_tries):
+    n = 0
+    while n < max_tries:  # lint-expect: naked-retry-loop
+        n += 1
+        try:
+            return await session.request("POST", url)
+        except ConnectionError:
+            pass  # swallowed with no sleep
+
+
+async def unbounded_and_naked(client, url):
+    while True:  # lint-expect: naked-retry-loop
+        try:
+            return await client.fetch(url)
+        except Exception:
+            continue

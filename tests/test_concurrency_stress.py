@@ -66,10 +66,8 @@ def test_staleness_manager_counters_consistent_under_concurrency():
 
     def sampler():
         # the lock makes every get_stats() a consistent snapshot: at any
-        # quiescent point submitted == accepted + rejected* + running
-        # (*rejections here only decrement running; RolloutStat.rejected
-        # stays 0), so running = submitted - accepted - n_rejected is
-        # always >= the in-flight floor of -0 ... just assert bounds
+        # quiescent point submitted == accepted + rejected + running, so
+        # running = submitted - accepted - rejected ... just assert bounds
         while not stop.is_set():
             s = mgr.get_stats()
             if s.running < -0.5:
@@ -98,6 +96,8 @@ def test_staleness_manager_counters_consistent_under_concurrency():
     assert s.submitted == total
     assert s.running == 0
     assert s.accepted == total - n_rejected
+    assert s.rejected == n_rejected
+    assert s.submitted == s.accepted + s.rejected + s.running
 
 
 def test_staleness_capacity_monotone_under_concurrent_accepts():

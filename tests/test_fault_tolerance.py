@@ -1,0 +1,1091 @@
+"""Deterministic chaos tests for the fault-tolerant rollout plane.
+
+Everything here is in-process with injected clocks/sleeps — no real
+servers, no real waits. The scripted :class:`FakeSession` stands in for
+``aiohttp.ClientSession`` so each test controls exactly which address
+fails, how, and when, and the acceptance criteria of the fault-tolerance
+tentpole are pinned:
+
+(a) a server that dies mid-generation has its request complete on another
+    server with token-exact replay-prefix semantics;
+(b) an OPEN breaker receives zero traffic until its half-open probe
+    succeeds;
+(c) ``update_weights`` with 1-of-N servers failing quarantines that server
+    and training proceeds (and raises below the min-healthy fraction);
+(d) staleness/capacity counters balance to zero after a chaos run with
+    failover enabled;
+(e) with chaos disabled, the request hot path adds no new awaits or locks
+    beyond a None check (code-inspection test on utils/http.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import asyncio
+import os
+import random
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    ChaosConfig,
+    ChaosRuleConfig,
+    CircuitBreakerConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest, WeightUpdateMeta
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.fault_tolerance import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    ServerHealthTracker,
+)
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.utils.chaos import ChaosPolicy
+from areal_tpu.utils.http import (
+    HTTPRequestError,
+    arequest_with_retry,
+)
+
+# ---------------------------------------------------------------------------
+# fakes: clock, aiohttp session, per-address server scripts
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    async def sleep(self, delay: float) -> None:
+        """Injectable asyncio.sleep that advances fake time instantly."""
+        self.now += delay
+
+
+class FakeResponse:
+    def __init__(self, status=200, json_data=None, headers=None, body=""):
+        self.status = status
+        self._json = json_data if json_data is not None else {}
+        self.headers = headers or {}
+        self._body = body
+
+    async def json(self):
+        return self._json
+
+    async def text(self):
+        return self._body
+
+
+class _FakeCM:
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    async def __aenter__(self):
+        if isinstance(self._outcome, BaseException):
+            raise self._outcome
+        return self._outcome
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class FakeSession:
+    """Scripted stand-in for aiohttp.ClientSession. ``handler(method, url,
+    payload)`` returns a FakeResponse or an exception to raise. Every call
+    is recorded for traffic assertions."""
+
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls: list[tuple[str, str, dict | None]] = []
+        self.closed = False
+
+    def request(self, method, url, json=None, data=None, timeout=None):
+        self.calls.append((method, url, json))
+        return _FakeCM(self.handler(method, url, json))
+
+    def get(self, url, timeout=None):
+        self.calls.append(("GET", url, None))
+        return _FakeCM(self.handler("GET", url, None))
+
+    async def close(self):
+        self.closed = True
+
+    def calls_to(self, addr: str) -> list[tuple[str, str, dict | None]]:
+        return [c for c in self.calls if f"//{addr}/" in c[1]]
+
+
+def _gen_response(tokens, stop_reason="stop", version=0):
+    return FakeResponse(
+        status=200,
+        json_data={
+            "output_tokens": list(tokens),
+            "output_logprobs": [-0.1] * len(tokens),
+            "output_versions": [version] * len(tokens),
+            "stop_reason": stop_reason,
+            "itl": [],
+        },
+    )
+
+
+def make_engine(addrs, session, **cfg_kwargs) -> RemoteInfEngine:
+    """A RemoteInfEngine wired to a FakeSession, no executor thread."""
+    cfg_kwargs.setdefault("experiment_name", "chaos")
+    cfg_kwargs.setdefault("trial_name", "t")
+    cfg_kwargs.setdefault("request_retries", 1)
+    cfg_kwargs.setdefault(
+        "breaker", CircuitBreakerConfig(failure_threshold=1)
+    )
+    eng = RemoteInfEngine(InferenceEngineConfig(**cfg_kwargs))
+    eng.addresses = list(addrs)
+
+    async def _fake_get_session():
+        return session
+
+    eng._get_session = _fake_get_session
+    eng._new_session = lambda: session
+    eng._ensure_probe_task = lambda: None  # tests drive probes directly
+    return eng
+
+
+def _req(prompt, rid="rid-0", max_new_tokens=8):
+    return ModelRequest(
+        rid=rid,
+        input_ids=list(prompt),
+        gconfig=GenerationHyperparameters(max_new_tokens=max_new_tokens),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ChaosPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_policy_deterministic_and_fail_next_n():
+    cfg = ChaosConfig(
+        enabled=True,
+        seed=7,
+        rules=[
+            ChaosRuleConfig(endpoint="generate", action="drop", probability=0.5),
+        ],
+    )
+    seq1 = [
+        ChaosPolicy.from_config(cfg).decide("http://a/generate") is not None
+        for _ in range(0)
+    ]
+    p1, p2 = ChaosPolicy.from_config(cfg), ChaosPolicy.from_config(cfg)
+    seq1 = [p1.decide("http://a/generate") is not None for _ in range(32)]
+    seq2 = [p2.decide("http://a/generate") is not None for _ in range(32)]
+    assert seq1 == seq2  # seeded RNG: identical replay
+    assert any(seq1) and not all(seq1)
+
+    p = ChaosPolicy()
+    p.add_rule(endpoint="update_weights", action="http_error", status=503, times=2)
+    assert p.decide("http://a/update_weights_from_disk").status == 503
+    assert p.decide("http://a/update_weights_from_disk") is not None
+    assert p.decide("http://a/update_weights_from_disk") is None  # disarmed
+    assert p.decide("http://a/generate") is None  # endpoint-scoped
+
+
+def test_chaos_policy_from_env(monkeypatch):
+    monkeypatch.setenv(
+        "AREAL_CHAOS_SERVER",
+        '{"seed": 3, "rules": [{"endpoint": "generate", "action": '
+        '"disconnect", "times": 1}]}',
+    )
+    p = ChaosPolicy.from_env()
+    assert p is not None
+    assert p.decide("/generate").kind == "disconnect"
+    assert p.decide("/generate") is None
+    monkeypatch.delenv("AREAL_CHAOS_SERVER")
+    assert ChaosPolicy.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# arequest_with_retry: classification, jitter, Retry-After, deadline, chaos
+# ---------------------------------------------------------------------------
+
+
+def test_retry_fails_fast_on_non_retriable_4xx():
+    session = FakeSession(lambda m, u, p: FakeResponse(status=404, body="nope"))
+    with pytest.raises(HTTPRequestError) as ei:
+        asyncio.run(
+            arequest_with_retry(session, "http://a/generate", max_retries=5)
+        )
+    assert ei.value.status == 404 and not ei.value.retriable
+    assert len(session.calls) == 1  # no retry on caller error
+
+
+def test_retry_on_5xx_with_jittered_backoff():
+    outcomes = [FakeResponse(status=503), FakeResponse(status=500),
+                _gen_response([1])]
+    session = FakeSession(lambda m, u, p: outcomes[len(session.calls) - 1])
+    clock = FakeClock()
+    delays: list[float] = []
+
+    async def record_sleep(d):
+        delays.append(d)
+        await clock.sleep(d)
+
+    out = asyncio.run(
+        arequest_with_retry(
+            session,
+            "http://a/generate",
+            max_retries=3,
+            retry_delay=1.0,
+            rng=random.Random(0),
+            sleep=record_sleep,
+            clock=clock,
+        )
+    )
+    assert out["output_tokens"] == [1]
+    assert len(session.calls) == 3
+    # full jitter: U(0, base * 2^(attempt-1))
+    assert len(delays) == 2
+    assert 0.0 <= delays[0] <= 1.0 and 0.0 <= delays[1] <= 2.0
+
+
+def test_retry_honors_retry_after():
+    outcomes = [
+        FakeResponse(status=429, headers={"Retry-After": "7"}),
+        _gen_response([2]),
+    ]
+    session = FakeSession(lambda m, u, p: outcomes[len(session.calls) - 1])
+    clock = FakeClock()
+    delays = []
+
+    async def record_sleep(d):
+        delays.append(d)
+        await clock.sleep(d)
+
+    asyncio.run(
+        arequest_with_retry(
+            session,
+            "http://a/generate",
+            max_retries=2,
+            retry_delay=0.001,
+            rng=random.Random(0),
+            sleep=record_sleep,
+            clock=clock,
+        )
+    )
+    assert delays and delays[0] >= 7.0  # Retry-After floors the backoff
+
+
+def test_retry_total_deadline_bounds_attempts():
+    session = FakeSession(lambda m, u, p: FakeResponse(status=503))
+    clock = FakeClock()
+
+    async def advancing_sleep(d):
+        await clock.sleep(d)
+
+    with pytest.raises(HTTPRequestError):
+        asyncio.run(
+            arequest_with_retry(
+                session,
+                "http://a/generate",
+                max_retries=100,
+                retry_delay=4.0,
+                total_timeout=10.0,
+                rng=random.Random(0),
+                sleep=advancing_sleep,
+                clock=clock,
+            )
+        )
+    # backoff sleeps consumed the 10s budget long before 100 attempts
+    assert len(session.calls) < 100
+    assert clock.now <= 10.0 + 4.0 * 2**6  # sanity: bounded, not 100 tries
+
+
+def test_chaos_injects_through_retry_classification():
+    chaos = ChaosPolicy()
+    chaos.add_rule(endpoint="generate", action="http_error", status=503, times=1)
+    session = FakeSession(lambda m, u, p: _gen_response([3]))
+    out = asyncio.run(
+        arequest_with_retry(
+            session,
+            "http://a/generate",
+            max_retries=2,
+            retry_delay=0.0,
+            chaos=chaos,
+        )
+    )
+    assert out["output_tokens"] == [3]
+    assert chaos.injected == 1
+    # the injected 503 consumed attempt 1 before any real request went out
+    assert len(session.calls) == 1
+
+    # non-retriable injected status fails fast
+    chaos.add_rule(endpoint="generate", action="http_error", status=400, times=1)
+    with pytest.raises(HTTPRequestError) as ei:
+        asyncio.run(
+            arequest_with_retry(
+                session, "http://a/generate", max_retries=3, chaos=chaos
+            )
+        )
+    assert ei.value.status == 400
+
+
+def test_chaos_drop_and_disconnect_retry():
+    chaos = ChaosPolicy()
+    chaos.add_rule(endpoint="*", action="drop", times=1)
+    chaos.add_rule(endpoint="*", action="disconnect", times=1)
+    session = FakeSession(lambda m, u, p: _gen_response([4]))
+    out = asyncio.run(
+        arequest_with_retry(
+            session, "http://a/generate", max_retries=3, retry_delay=0.0,
+            chaos=chaos,
+        )
+    )
+    assert out["output_tokens"] == [4]
+    assert chaos.injected == 2
+
+
+def test_hot_path_code_inspection():
+    """(e) with chaos disabled the request hot path adds no awaits or
+    locks: every reference to ``chaos`` inside arequest_with_retry other
+    than the default-None binding sits under an ``if chaos is not None``
+    guard, and the function takes no locks."""
+    import areal_tpu.utils.http as http_mod
+
+    src = open(http_mod.__file__).read()
+    tree = ast.parse(src)
+    fn = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.AsyncFunctionDef)
+        and n.name == "arequest_with_retry"
+    )
+
+    def guarded_by_chaos_check(node: ast.AST, parents) -> bool:
+        for p in parents:
+            if isinstance(p, ast.If):
+                t = ast.dump(p.test)
+                if "id='chaos'" in t and "IsNot" in t:
+                    return True
+        return False
+
+    # build parent chains
+    parent_of = {}
+    for p in ast.walk(fn):
+        for c in ast.iter_child_nodes(p):
+            parent_of[c] = p
+
+    def parents(n):
+        while n in parent_of:
+            n = parent_of[n]
+            yield n
+
+    offenders = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == "chaos":
+            chain = list(parents(node))
+            # allowed outside the guard: the `if chaos is not None` test
+            # itself and the `chaos=None`-style default normalization
+            in_guard_test = any(
+                isinstance(p, ast.If)
+                and node in ast.walk(p.test)
+                and "IsNot" in ast.dump(p.test)
+                for p in chain
+            )
+            if not in_guard_test and not guarded_by_chaos_check(node, chain):
+                offenders.append(node.lineno)
+    assert not offenders, (
+        f"chaos referenced outside the `if chaos is not None` guard at "
+        f"lines {offenders}: the chaos-off hot path must stay a single "
+        f"None check"
+    )
+    # no locks anywhere in the retry helper
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = ast.dump(node.func)
+            assert "Lock" not in name, "no locks on the request hot path"
+
+
+# ---------------------------------------------------------------------------
+# breaker + routing
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_window_rate_trip_gray_failure():
+    """A gray server (alternating ok/fail, never N consecutive) still trips
+    via the windowed failure rate."""
+    clock = FakeClock()
+    tr = ServerHealthTracker(
+        CircuitBreakerConfig(
+            failure_threshold=10,  # consecutive path disabled
+            min_window_requests=8,
+            failure_rate_threshold=0.5,
+            window_seconds=60.0,
+        ),
+        clock=clock,
+    )
+    for i in range(8):
+        clock.now += 1.0
+        tr.on_request_end("gray:1", ok=(i % 2 == 0), latency=0.5)
+    assert tr.state("gray:1") == OPEN
+
+
+def test_breaker_disabled_is_noop():
+    tr = ServerHealthTracker(CircuitBreakerConfig(enabled=False))
+    for _ in range(10):
+        tr.on_request_end("a", ok=False, error="x")
+    assert tr.routable("a") and tr.state("a") == CLOSED
+    # quarantine is a no-op too: with probing disabled an OPEN state would
+    # be permanent (excluded from updates forever, still routed to)
+    tr.quarantine("a", required_version=3)
+    assert tr.state("a") == CLOSED and tr.routable("a")
+
+
+def test_update_weights_with_breaker_disabled_is_strict(tmp_path):
+    """No breaker plane -> no quarantine/version-checked rejoin, so a
+    failed fan-out must raise (the pre-fault-tolerance semantics) instead
+    of leaving a stale server silently in rotation."""
+    dead, versions = {"b:1"}, {}
+    session = FakeSession(_wu_handler(dead, versions))
+    eng = make_engine(
+        ["a:1", "b:1"], session,
+        breaker=CircuitBreakerConfig(enabled=False),
+        update_weights_min_healthy_fraction=0.0,
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="breaker disabled"):
+        eng.update_weights(meta)
+
+
+def test_choose_server_routes_around_open_and_never_deadlocks():
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(["a:1", "b:1", "c:1"], session)
+    eng._health.quarantine("b:1")
+    picks = {eng.choose_server() for _ in range(12)}
+    assert picks == {"a:1", "c:1"}
+    # all open -> least-bad fallback, not deadlock
+    eng._health.quarantine("a:1")
+    eng._health.quarantine("c:1")
+    assert eng.choose_server() in {"a:1", "b:1", "c:1"}
+
+
+def test_rid_affinity_dropped_when_server_opens():
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(["a:1", "b:1"], session)
+    addr = eng.choose_server("rid-7")
+    assert eng.choose_server("rid-7") == addr  # affinity sticks
+    eng._health.quarantine(addr)
+    other = eng.choose_server("rid-7")
+    assert other != addr  # affinity void once the breaker opened
+    assert eng.choose_server("rid-7") == other
+
+
+def test_late_registered_servers_join_rotation():
+    """Servers that register in name_resolve after startup join the
+    rotation on the next (interval-gated or forced) refresh."""
+    from areal_tpu.utils import name_resolve, names
+
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(["a:1"], session, server_refresh_interval=30.0)
+    eng._discovered_via_nr = True  # as if initialize() used name_resolve
+    key = names.gen_servers("chaos", "t")
+    name_resolve.add_subentry(key, "a:1")
+    name_resolve.add_subentry(key, "b:1")  # late joiner
+    # inside the interval: no refresh yet
+    eng._last_server_refresh = __import__("time").monotonic()
+    eng.choose_server()
+    assert eng.addresses == ["a:1"]
+    # interval elapsed: the next routing decision kicks off the (threaded)
+    # refresh and the rotation grows
+    eng._last_server_refresh = -1e9
+    eng.choose_server()
+    assert eng._refresh_thread is not None
+    eng._refresh_thread.join(timeout=10)
+    assert eng.addresses == ["a:1", "b:1"]
+    picks = {eng.choose_server() for _ in range(8)}
+    assert picks == {"a:1", "b:1"}
+
+
+# ---------------------------------------------------------------------------
+# (a) failover re-dispatch with token-exact replay prefix
+# ---------------------------------------------------------------------------
+
+
+def test_failover_redispatch_replays_accepted_tokens():
+    prompt = [5, 9, 3]
+    state = {"a_calls": 0}
+
+    def handler(method, url, payload):
+        if "//a:1/" in url:
+            state["a_calls"] += 1
+            if state["a_calls"] == 1:
+                # server A accepts the request, returns a partial
+                # generation, then gets interrupted (abort)
+                return _gen_response([10, 11], stop_reason="abort")
+            # ...and dies when the client comes back
+            return ConnectionResetError("server a died mid-generation")
+        if "//b:1/" in url:
+            return _gen_response([12, 13], stop_reason="stop")
+        raise AssertionError(url)
+
+    session = FakeSession(handler)
+    eng = make_engine(
+        ["a:1", "b:1"], session,
+        failover_retries=2,
+        breaker=CircuitBreakerConfig(failure_threshold=1),
+    )
+    resp = asyncio.run(eng.agenerate(_req(prompt, rid="r1")))
+    # token-exact splice: A's accepted prefix + B's continuation
+    assert resp.output_tokens == [10, 11, 12, 13]
+    assert resp.stop_reason == "stop"
+    # B received the accumulated tokens replayed as prompt
+    b_payloads = [p for (m, u, p) in session.calls_to("b:1") if p]
+    assert b_payloads[0]["input_ids"] == prompt + [10, 11]
+    # and A's breaker tripped on the failure
+    assert eng._health.state("a:1") == OPEN
+    # staleness bookkeeping: inflight counters returned to zero
+    assert all(v == 0 for v in eng._inflight.values())
+
+
+def test_no_failover_on_non_retriable_4xx():
+    """A 400 is the caller's bug: re-dispatching the identical payload to
+    another server would fail identically, so failover is not attempted."""
+    session = FakeSession(
+        lambda m, u, p: FakeResponse(status=400, body="bad request")
+    )
+    eng = make_engine(["a:1", "b:1"], session, failover_retries=3)
+    with pytest.raises(HTTPRequestError) as ei:
+        asyncio.run(eng.agenerate(_req([1], rid="r4xx")))
+    assert ei.value.status == 400
+    assert len(session.calls) == 1  # no retry, no failover
+    # and no breaker charge: a correctly-answered 4xx is the server
+    # working fine; the bug is the caller's
+    assert eng._health.state("a:1") == CLOSED
+
+
+def test_failover_budget_exhaustion_raises():
+    session = FakeSession(
+        lambda m, u, p: ConnectionResetError("everything is down")
+    )
+    eng = make_engine(["a:1", "b:1"], session, failover_retries=1)
+    with pytest.raises((HTTPRequestError, ConnectionError)):
+        asyncio.run(eng.agenerate(_req([1, 2], rid="r2")))
+    # 1 original dispatch + 1 failover, each with request_retries=1
+    assert len(session.calls) == 2
+    assert all(v == 0 for v in eng._inflight.values())
+
+
+def test_cancelled_request_releases_half_open_slot():
+    """A trial request cancelled mid-flight must release the HALF_OPEN
+    probe slot (not wedge the server unroutable forever), and must not
+    charge the server an outcome."""
+    started = asyncio.Event()
+
+    class _HangCM:
+        async def __aenter__(self):
+            started.set()
+            await asyncio.sleep(3600)
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class HangingSession(FakeSession):
+        def request(self, method, url, json=None, data=None, timeout=None):
+            self.calls.append((method, url, json))
+            return _HangCM()
+
+    session = HangingSession(None)
+    eng = make_engine(
+        ["a:1"], session,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=1, half_open_max_probes=1
+        ),
+    )
+    eng._health.quarantine("a:1")
+    eng._health.on_probe_result("a:1", ok=True)
+    assert eng._health.state("a:1") == HALF_OPEN
+
+    async def go():
+        task = asyncio.ensure_future(eng.agenerate(_req([1], rid="rc")))
+        await started.wait()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(go())
+    # slot released: the server is routable again and still HALF_OPEN
+    assert eng._health.state("a:1") == HALF_OPEN
+    assert eng._health.routable("a:1")
+    assert all(v == 0 for v in eng._inflight.values())
+
+
+def test_deadline_exhaustion_not_charged_to_server():
+    """A request that dies because the CLIENT's failover deadline expired
+    must not feed the server's breaker: the server did nothing wrong."""
+    class _SlowFailCM:
+        async def __aenter__(self):
+            # the failure lands AFTER the client's deadline expired — the
+            # clamped per-try timeout firing against a healthy-but-slow
+            # server, which must not be charged
+            await asyncio.sleep(0.02)
+            raise asyncio.TimeoutError("client deadline clamped this try")
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class SlowFailSession(FakeSession):
+        def request(self, method, url, json=None, data=None, timeout=None):
+            self.calls.append((method, url, json))
+            return _SlowFailCM()
+
+    session = SlowFailSession(None)
+    eng = make_engine(
+        ["a:1"], session,
+        failover_retries=5,
+        failover_deadline_seconds=0.005,  # expires during the first try
+        breaker=CircuitBreakerConfig(failure_threshold=1),
+    )
+    with pytest.raises((HTTPRequestError, asyncio.TimeoutError, TimeoutError)):
+        asyncio.run(eng.agenerate(_req([1], rid="rd")))
+    assert eng._health.state("a:1") == CLOSED  # no breaker charge
+    assert len(session.calls) == 1  # deadline also ends failover attempts
+
+
+def test_least_bad_ties_rotate_and_failover_avoids_failed_server():
+    clock = FakeClock()
+    tr = ServerHealthTracker(
+        CircuitBreakerConfig(failure_threshold=1), clock=clock
+    )
+    tr.on_request_end("a", ok=False, error="x")
+    tr.on_request_end("b", ok=False, error="x")
+    tr.on_request_end("b", ok=True, latency=0.1)
+    tr.on_request_end("b", ok=False, error="x")
+    assert tr.state("a") == OPEN and tr.state("b") == OPEN
+    # b's window has a success mixed in: lower failure rate wins alone
+    assert tr.least_bad(["a", "b"]) == ["b"]
+    # equal rates tie -> BOTH returned; the engine rotates among them so
+    # repeated failovers of one request spread across the fleet instead of
+    # hammering the same dead address (observed live: a fixed tie-break
+    # re-picked the dead server on every failover attempt)
+    tr2 = ServerHealthTracker(
+        CircuitBreakerConfig(failure_threshold=1), clock=clock
+    )
+    tr2.on_request_end("a", ok=False, error="x")
+    tr2.on_request_end("b", ok=False, error="x")
+    assert sorted(tr2.least_bad(["a", "b"])) == ["a", "b"]
+
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(["a:1", "b:1"], session)
+    eng._health.quarantine("a:1")
+    eng._health.quarantine("b:1")
+    picks = [eng.choose_server() for _ in range(4)]
+    assert set(picks) == {"a:1", "b:1"}  # rotation, not pinning
+    # avoid: a just-failed server is skipped while an alternative exists
+    eng2 = make_engine(["a:1", "b:1"], session)
+    for _ in range(4):
+        assert eng2.choose_server(avoid={"a:1"}) == "b:1"
+    # ...but avoidance never deadlocks when everything has failed
+    assert eng2.choose_server(avoid={"a:1", "b:1"}) in {"a:1", "b:1"}
+
+
+def test_retry_after_capped_and_nonfinite_ignored():
+    from areal_tpu.utils.http import RETRY_AFTER_CAP, _parse_retry_after
+
+    assert _parse_retry_after("86400") == RETRY_AFTER_CAP
+    assert _parse_retry_after("inf") is None
+    assert _parse_retry_after("nan") is None
+    assert _parse_retry_after("7") == 7.0
+    assert _parse_retry_after("-3") == 0.0
+    # HTTP-date forms, including the -0000 zone that parsedate returns as
+    # a NAIVE datetime (subtracting it from aware-now raised TypeError)
+    assert _parse_retry_after("Thu, 01 Jan 2026 00:00:00 -0000") == 0.0
+    assert _parse_retry_after("Thu, 01 Jan 2099 00:00:00 GMT") == RETRY_AFTER_CAP
+    assert _parse_retry_after("not a date") is None
+
+
+def test_format_check_failure_balances_running_counter():
+    """check_trajectory_format raising after a successful episode must
+    still balance `running` (review finding: the leak was outside the
+    original try)."""
+
+    class BadFormat(RolloutWorkflow):
+        async def arun_episode(self, engine, data):
+            return {"input_ids": np.zeros((1, 2), np.int32)}  # no mask
+
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(
+        ["a:1"], session,
+        max_concurrent_rollouts=4,
+        consumer_batch_size=4,
+        check_trajectory_format=True,
+    )
+    eng.executor.initialize(train_data_parallel_size=1)
+    try:
+        eng.executor.submit({"i": 0}, workflow=BadFormat())
+        with pytest.raises(RuntimeError, match="Rollout thread died"):
+            eng.executor.wait(1, timeout=10)
+        stats = eng.executor.staleness_manager.get_stats()
+        assert stats.running == 0
+        assert stats.submitted == stats.accepted + stats.rejected + stats.running
+    finally:
+        eng.executor.destroy()
+
+
+# ---------------------------------------------------------------------------
+# (b) OPEN breaker receives zero traffic until its probe succeeds
+# ---------------------------------------------------------------------------
+
+
+def test_open_breaker_gets_zero_traffic_until_probe_succeeds():
+    clock = FakeClock()
+    healthy = {"a:1": False}
+
+    def handler(method, url, payload):
+        if "//a:1/" in url and not healthy["a:1"]:
+            return ConnectionResetError("a is down")
+        if url.endswith("/health"):
+            return FakeResponse(status=200, json_data={"status": "ok"})
+        return _gen_response([1], stop_reason="stop")
+
+    session = FakeSession(handler)
+    eng = make_engine(
+        ["a:1", "b:1"], session,
+        failover_retries=2,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=1,
+            open_cooldown_seconds=1.0,
+            probe_interval_seconds=0.0,
+        ),
+    )
+    eng._health.clock = clock
+    # trip a:1
+    asyncio.run(eng.agenerate(_req([1], rid="r0")))
+    assert eng._health.state("a:1") == OPEN
+    n_a = len(session.calls_to("a:1"))
+    # zero traffic to the OPEN server across many requests
+    for i in range(6):
+        asyncio.run(eng.agenerate(_req([1], rid=f"r{i + 1}")))
+    assert len(session.calls_to("a:1")) == n_a
+    # probe before cooldown: not even probed
+    assert eng._health.probe_candidates() == []
+    # cooldown elapses, the server recovers, the probe readmits it
+    clock.now += 2.0
+    healthy["a:1"] = True
+    asyncio.run(eng._probe_open_servers(session))
+    assert eng._health.state("a:1") == HALF_OPEN
+    # trial traffic closes the breaker
+    for i in range(4):
+        asyncio.run(eng.agenerate(_req([1], rid=f"t{i}")))
+    assert eng._health.state("a:1") == CLOSED
+    assert len(session.calls_to("a:1")) > n_a
+
+
+# ---------------------------------------------------------------------------
+# (c) degraded update_weights: quarantine, min-healthy fraction, rejoin
+# ---------------------------------------------------------------------------
+
+
+def _wu_handler(dead: set, versions: dict):
+    def handler(method, url, payload):
+        addr = url.split("//")[1].split("/")[0]
+        if addr in dead:
+            return ConnectionResetError(f"{addr} is down")
+        if "update_weights_from_disk" in url:
+            versions[addr] = payload["version"]
+            return FakeResponse(
+                status=200, json_data={"success": True}
+            )
+        if url.endswith("/health"):
+            return FakeResponse(status=200, json_data={"status": "ok"})
+        if url.endswith("/model_info"):
+            return FakeResponse(
+                status=200, json_data={"weight_version": versions.get(addr, 0)}
+            )
+        return _gen_response([1], stop_reason="stop")
+
+    return handler
+
+
+def test_update_weights_quarantines_failed_server_and_proceeds(tmp_path):
+    dead, versions = {"c:1"}, {}
+    session = FakeSession(_wu_handler(dead, versions))
+    eng = make_engine(
+        ["a:1", "b:1", "c:1"], session,
+        update_weights_min_healthy_fraction=0.5,
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    eng.update_weights(meta)
+    # training proceeded: version bumped, healthy servers updated
+    assert eng.get_version() == 1
+    assert versions == {"a:1": 1, "b:1": 1}
+    # the failed server is quarantined at the required version
+    assert eng._health.state("c:1") == OPEN
+    assert eng._health.required_version("c:1") == 1
+    # and excluded from routing
+    picks = {eng.choose_server() for _ in range(8)}
+    assert "c:1" not in picks
+
+
+def test_update_weights_raises_below_min_healthy_fraction(tmp_path):
+    dead, versions = {"b:1", "c:1"}, {}
+    session = FakeSession(_wu_handler(dead, versions))
+    eng = make_engine(
+        ["a:1", "b:1", "c:1"], session,
+        update_weights_min_healthy_fraction=0.9,
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="min healthy fraction"):
+        eng.update_weights(meta)
+
+
+def test_quarantined_server_rejoins_only_after_version_checked_probe(tmp_path):
+    clock = FakeClock()
+    dead, versions = {"c:1"}, {}
+    session = FakeSession(_wu_handler(dead, versions))
+    eng = make_engine(
+        ["a:1", "b:1", "c:1"], session,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=1,
+            open_cooldown_seconds=0.0,
+            probe_interval_seconds=0.0,
+        ),
+    )
+    eng._health.clock = clock
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    eng.update_weights(meta)
+    assert eng._health.state("c:1") == OPEN
+
+    # server comes back (process restarted) but with STALE weights
+    dead.clear()
+    clock.now += 1.0
+    asyncio.run(eng._probe_open_servers(session))
+    # the probe saw health ok + stale version, re-pushed the missed disk
+    # update, and only then readmitted the server
+    assert versions["c:1"] == 1
+    assert eng._health.state("c:1") == HALF_OPEN
+    assert eng._health.required_version("c:1") is None
+
+
+# ---------------------------------------------------------------------------
+# (d) staleness/capacity counters balance after a chaos run with failover
+# ---------------------------------------------------------------------------
+
+
+class _GenWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        resp = await engine.agenerate(_req([1, 2], rid=str(data["i"])))
+        toks = resp.input_tokens + resp.output_tokens
+        return dict(
+            input_ids=np.asarray([toks], dtype=np.int32),
+            attention_mask=np.ones((1, len(toks)), np.int32),
+        )
+
+
+def test_counters_balance_after_chaos_run_with_failover():
+    """Episodes hit chaos-injected failures mid-run; failover completes
+    them all, and the staleness counters balance exactly: submitted ==
+    accepted + rejected, running == 0, no leaked capacity."""
+    n = 12
+    flaky = {"count": 0}
+
+    def handler(method, url, payload):
+        if "//a:1/" in url and "/generate" in url:
+            flaky["count"] += 1
+            if flaky["count"] % 3 == 1:  # every 3rd request to A dies
+                return ConnectionResetError("a hiccup")
+        return _gen_response([7, 8], stop_reason="stop")
+
+    session = FakeSession(handler)
+    eng = make_engine(
+        ["a:1", "b:1"], session,
+        failover_retries=3,
+        max_concurrent_rollouts=4,
+        consumer_batch_size=4,
+        max_head_offpolicyness=100,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=3, min_window_requests=1000
+        ),
+    )
+    eng.executor.initialize(train_data_parallel_size=1)
+    try:
+        wf = _GenWorkflow()
+        # reject half via should_accept to exercise the rejected counter
+        for i in range(n):
+            eng.executor.submit(
+                {"i": i},
+                workflow=wf,
+                should_accept=(lambda t: False) if i % 4 == 3 else None,
+            )
+        out = eng.executor.wait(n - n // 4, timeout=30)
+        assert out["input_ids"].shape[0] == n - n // 4
+        stats = eng.executor.staleness_manager.get_stats()
+        assert stats.submitted == n
+        assert stats.running == 0
+        assert stats.accepted == n - n // 4
+        assert stats.rejected == n // 4
+        assert stats.submitted == stats.accepted + stats.rejected + stats.running
+        # capacity fully restored (no leak): staleness budget minus accepted
+        cap = eng.executor.staleness_manager.get_capacity(0)
+        assert cap == min(4, (100 + 1) * 4 - stats.accepted)
+        # every inflight counter returned to zero
+        assert all(v == 0 for v in eng._inflight.values())
+    finally:
+        eng.executor.destroy()
+
+
+def test_dead_workflow_does_not_leak_running_capacity():
+    """A workflow that raises kills the rollout thread (propagation is
+    unchanged) but must not leave `running` dangling."""
+
+    class Boom(RolloutWorkflow):
+        async def arun_episode(self, engine, data):
+            raise ValueError("boom")
+
+    session = FakeSession(lambda m, u, p: _gen_response([1]))
+    eng = make_engine(
+        ["a:1"], session, max_concurrent_rollouts=4, consumer_batch_size=4
+    )
+    eng.executor.initialize(train_data_parallel_size=1)
+    try:
+        eng.executor.submit({"i": 0}, workflow=Boom())
+        with pytest.raises(RuntimeError, match="Rollout thread died"):
+            eng.executor.wait(1, timeout=10)
+        stats = eng.executor.staleness_manager.get_stats()
+        assert stats.running == 0
+        assert stats.submitted == stats.accepted + stats.rejected + stats.running
+    finally:
+        eng.executor.destroy()
+
+
+# ---------------------------------------------------------------------------
+# server-side chaos middleware (in-process aiohttp server + stub engine)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Minimal GenerationEngine stand-in for GenerationServer."""
+
+    healthy = True
+    n_running = 0
+    prompt_tokens_total = 0
+    generated_tokens_total = 0
+    prefill_count = 0
+    prefill_dispatch_count = 0
+    prefix_clone_count = 0
+    prefix_extend_count = 0
+    prefix_extend_saved_tokens = 0
+    spec_steps_total = 0
+    spec_proposed_tokens_total = 0
+    spec_accepted_tokens_total = 0
+    spec_acceptance_rate = 0.0
+
+    def __init__(self):
+        from types import SimpleNamespace
+
+        self.config = SimpleNamespace(max_batch_size=4, max_seq_len=64)
+        self._version = 0
+
+    def get_version(self):
+        return self._version
+
+    def submit(self, rid, input_ids, gconfig, on_done, image_data=None):
+        from areal_tpu.api.io_struct import ModelResponse
+
+        on_done(
+            ModelResponse(
+                input_tokens=list(input_ids),
+                output_tokens=[42],
+                output_logprobs=[-0.5],
+                output_versions=[self._version],
+                stop_reason="stop",
+            )
+        )
+
+    def abort(self, rid):
+        pass
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+@pytest.fixture()
+def chaos_server():
+    import threading
+
+    from areal_tpu.inference.server import GenerationServer
+
+    policy = ChaosPolicy()
+    server = GenerationServer(_StubEngine(), chaos=policy)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=30)
+    yield f"127.0.0.1:{port}", policy
+    asyncio.run_coroutine_threadsafe(server.stop(), loop).result(timeout=30)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+
+
+def test_server_side_chaos_injection_and_client_recovery(chaos_server):
+    addr, policy = chaos_server
+    import aiohttp
+
+    policy.add_rule(
+        endpoint="generate", action="http_error", status=503, times=1
+    )
+
+    async def go():
+        async with aiohttp.ClientSession() as session:
+            # client-side retry rides out the injected server-side 503
+            out = await arequest_with_retry(
+                session,
+                f"http://{addr}/generate",
+                payload={"rid": "x", "input_ids": [1, 2, 3]},
+                max_retries=3,
+                retry_delay=0.01,
+                timeout=10.0,
+            )
+            assert out["output_tokens"] == [42]
+            # health endpoint untouched by the generate-scoped rule
+            async with session.get(f"http://{addr}/health") as resp:
+                assert resp.status == 200
+        return True
+
+    assert asyncio.run(go())
+    assert policy.injected == 1
+
+
+def test_server_side_chaos_disconnect_is_retriable(chaos_server):
+    addr, policy = chaos_server
+    import aiohttp
+
+    policy.add_rule(endpoint="generate", action="disconnect", times=1)
+
+    async def go():
+        async with aiohttp.ClientSession() as session:
+            return await arequest_with_retry(
+                session,
+                f"http://{addr}/generate",
+                payload={"rid": "y", "input_ids": [4]},
+                max_retries=3,
+                retry_delay=0.01,
+                timeout=10.0,
+            )
+
+    out = asyncio.run(go())
+    assert out["output_tokens"] == [42]
+    assert policy.injected == 1
+
+
+def test_chaos_off_installs_no_middleware(monkeypatch):
+    monkeypatch.delenv("AREAL_CHAOS_SERVER", raising=False)
+    from areal_tpu.inference.server import GenerationServer
+
+    server = GenerationServer(_StubEngine())
+    assert server.chaos is None
+    assert len(server.app.middlewares) == 0

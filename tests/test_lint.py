@@ -89,6 +89,7 @@ def test_rule_registry():
         "host-sync-in-hot-path",
         "lock-discipline",
         "untracked-task",
+        "naked-retry-loop",
     }
     assert expected <= set(rules)
     for rule in rules.values():

@@ -175,3 +175,147 @@ def test_config_hash_mismatch_refuses(tmp_path):
             config=cfg_b,
         )
     eng.destroy()
+
+
+# ---------------------------------------------------------------------------
+# fast recover-cycle tests (no real engine): env protocol round-trip and
+# corrupted/partial recover state must refuse to resume, not crash
+# ---------------------------------------------------------------------------
+
+
+class _DummyEngine:
+    """save/load stand-in: records a marker file as its 'checkpoint'."""
+
+    def __init__(self):
+        self.loaded = None
+
+    def save(self, meta):
+        os.makedirs(meta.path, exist_ok=True)
+        with open(os.path.join(meta.path, "ckpt.marker"), "w") as f:
+            f.write("ok")
+
+    def load(self, meta):
+        path = os.path.join(meta.path, "ckpt.marker")
+        with open(path) as f:
+            if f.read() != "ok":
+                raise ValueError(f"corrupt checkpoint at {path}")
+        self.loaded = meta.path
+
+
+class _DummyLoader:
+    def __init__(self, pos=0):
+        self.pos = pos
+
+    def state_dict(self):
+        return {"pos": self.pos}
+
+    def load_state_dict(self, d):
+        self.pos = d["pos"]
+
+
+def _dump_dummy(tmp_path, config=None):
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    root = handler.dump(
+        _DummyEngine(),
+        step(3),
+        None,
+        None,
+        _DummyLoader(pos=7),
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+        config=config,
+        force=True,
+    )
+    assert root is not None
+    return handler, root
+
+
+def test_recover_env_protocol_roundtrip(tmp_path, monkeypatch):
+    """The launcher-relaunch cycle: dump, relaunch with AREAL_RECOVER_RUN
+    set, check_if_recover says resume, load restores the loop state."""
+    cfg = RecoverConfig(mode="fault", freq_steps=1)
+    handler, root = _dump_dummy(tmp_path)
+    # without the env (and run_id 0) a fault-mode run starts fresh
+    monkeypatch.delenv("AREAL_RECOVER_RUN", raising=False)
+    assert not check_if_recover(cfg, run_id=0)
+    # the launcher relaunches the failed trial with the env set
+    monkeypatch.setenv("AREAL_RECOVER_RUN", "1")
+    assert check_if_recover(cfg)
+    eng, dl = _DummyEngine(), _DummyLoader()
+    info = handler.load(
+        eng,
+        None,
+        None,
+        dl,
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+    )
+    assert info is not None and info.last_step_info.global_step == 3
+    assert dl.pos == 7  # dataloader position fast-forwarded
+    assert eng.loaded is not None
+
+
+def test_recover_refuses_corrupted_info_json(tmp_path):
+    from areal_tpu.utils.recover import RecoverStateCorrupted
+
+    handler, root = _dump_dummy(tmp_path)
+    with open(os.path.join(root, "recover_info.json"), "w") as f:
+        f.write('{"last_step_info": {"epo')  # truncated mid-write
+    with pytest.raises(RecoverStateCorrupted, match="refusing to resume"):
+        handler.load(
+            _DummyEngine(),
+            fileroot=str(tmp_path),
+            experiment_name="e",
+            trial_name="t",
+        )
+
+
+def test_recover_refuses_corrupted_loop_state(tmp_path):
+    from areal_tpu.utils.recover import RecoverStateCorrupted
+
+    handler, root = _dump_dummy(tmp_path)
+    with open(os.path.join(root, "loop_state.pkl"), "wb") as f:
+        f.write(b"\x80\x04not a pickle")
+    with pytest.raises(RecoverStateCorrupted, match="refusing to resume"):
+        handler.load(
+            _DummyEngine(),
+            None,
+            None,
+            _DummyLoader(),
+            fileroot=str(tmp_path),
+            experiment_name="e",
+            trial_name="t",
+        )
+
+
+def test_recover_refuses_partial_checkpoint(tmp_path):
+    from areal_tpu.utils.recover import RecoverStateCorrupted
+
+    handler, root = _dump_dummy(tmp_path)
+    # the engine checkpoint is partial: marker content destroyed
+    with open(os.path.join(root, "engine", "ckpt.marker"), "w") as f:
+        f.write("partial")
+    with pytest.raises(RecoverStateCorrupted, match="partial or corrupted"):
+        handler.load(
+            _DummyEngine(),
+            fileroot=str(tmp_path),
+            experiment_name="e",
+            trial_name="t",
+        )
+
+
+def test_recover_missing_info_is_fresh_start(tmp_path):
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault"), ft)
+    assert (
+        handler.load(
+            _DummyEngine(),
+            fileroot=str(tmp_path),
+            experiment_name="e",
+            trial_name="t",
+        )
+        is None
+    )
