@@ -713,6 +713,12 @@ class SaverConfig(_TimerConfig):
     experiment_name: str = ""
     trial_name: str = ""
     fileroot: str = "/tmp/areal_tpu/experiments"
+    # --- retention GC (long runs must not fill the disk) ---
+    # keep only the newest N checkpoints (None = keep everything)
+    keep_last: int | None = None
+    # additionally keep every checkpoint whose global_step % keep_every == 0
+    # (sparse long-horizon history under a tight keep_last)
+    keep_every: int | None = None
 
 
 @dataclass
@@ -729,6 +735,35 @@ class RecoverConfig:
     freq_steps: int | None = None
     freq_secs: int | None = None
     retries: int = 3
+    # --- preemption semantics (utils/recover.py PreemptionGuard) ---
+    # SIGTERM/preemption-notice -> pause + drain + checkpoint must finish
+    # within this budget (preemptible TPU slices give ~30s notice)
+    grace_period_seconds: float = 30.0
+    # of the grace budget, at most this long is spent draining in-flight
+    # rollouts (the rest is reserved for the checkpoint write itself)
+    drain_timeout_seconds: float = 20.0
+    # --- launcher relaunch backoff (launcher/local.py) ---
+    # capped exponential delay between relaunches of a crashing trial, so a
+    # deterministic startup failure doesn't hot-loop the trial
+    relaunch_backoff_seconds: float = 1.0
+    relaunch_backoff_max_seconds: float = 60.0
+
+
+@dataclass
+class WatchdogConfig:
+    """Hung-trainer detector (utils/watchdog.py): a daemon thread that
+    requires the training loop to ``beat()`` at least every
+    ``timeout_seconds``; on a miss it dumps every thread's stack and exits
+    nonzero, so the launcher relaunches a trainer that is WEDGED (deadlock,
+    lost collective, hung rollout wait) rather than dead."""
+
+    enabled: bool = False
+    # worst-case legitimate gap between beats: compile + slowest train step
+    # or rollout wait; crossing it means wedged, not slow
+    timeout_seconds: float = 1800.0
+    poll_interval_seconds: float = 10.0
+    # distinct from PREEMPTION_EXIT_CODE(42) so logs tell hangs from drains
+    exit_code: int = 43
 
 
 @dataclass
@@ -831,6 +866,7 @@ class BaseExperimentConfig:
     checkpointer: SaverConfig = field(default_factory=SaverConfig)
     evaluator: EvaluatorConfig = field(default_factory=EvaluatorConfig)
     recover: RecoverConfig = field(default_factory=RecoverConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
     launcher: LauncherConfig = field(default_factory=LauncherConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)

@@ -209,6 +209,33 @@ class TrainController:
             )
         return merged
 
+    def reconcile_after_recover(
+        self, run_state, meta: WeightUpdateMeta | None = None, rollout=None
+    ) -> list[str]:
+        """Resume-time reconciliation for controller mode: after a restart
+        the workers loaded the recovered checkpoint but their in-memory
+        version counter starts at 0, and the inference fleet may hold a
+        stale (or newer, if the trainer rolled back) weight version. Pins
+        every worker to the RunState's weight version, re-uploads the
+        recovered weights to the update path, and drives the rollout
+        client's version-checked re-push so no resumed rollout is generated
+        by mismatched weights. Returns the re-pushed server addresses."""
+        version = int(getattr(run_state, "weight_version", run_state or 0))
+        self.set_version(version)
+        if rollout is None:
+            return []
+        if (
+            meta is not None
+            and meta.type == "disk"
+            and hasattr(rollout, "reconcile_after_recover")
+        ):
+            # workers gather + worker 0 writes the recovered weights to the
+            # fan-out path (the checkpoint the servers must converge on)
+            self.upload_weights(meta)
+            return rollout.reconcile_after_recover(meta, version)
+        rollout.set_version(version)
+        return []
+
     def update_weights(self, meta: WeightUpdateMeta, rollout=None):
         """Weight push + version bump fan-out (disk path: workers gather,
         worker 0 writes, rollout servers reload)."""

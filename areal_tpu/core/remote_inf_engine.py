@@ -41,7 +41,7 @@ from areal_tpu.api.io_struct import (
 from areal_tpu.core.fault_tolerance import OPEN, ServerHealthTracker
 from areal_tpu.core.workflow_executor import WorkflowExecutor
 from areal_tpu.utils import logging, name_resolve, names
-from areal_tpu.utils.chaos import ChaosPolicy
+from areal_tpu.utils.chaos import ChaosPolicy, crash_point
 from areal_tpu.utils.http import (
     TRANSPORT_ERRORS,
     HTTPRequestError,
@@ -612,6 +612,7 @@ class RemoteInfEngine(InferenceEngine):
         if self._spectator:
             self._version += 1  # stay in step with the head's version
             return
+        crash_point("pre-weight-update")
         if meta.type != "disk":
             raise NotImplementedError(
                 f"weight update type {meta.type!r}; device path is driven by "
@@ -650,25 +651,9 @@ class RemoteInfEngine(InferenceEngine):
             if isinstance(r, BaseException)
         ]
         healthy = len(targets) - len(failed)
-        if failed and not self.config.breaker.enabled:
-            # without the breaker plane there is no quarantine and no
-            # version-checked rejoin: a stale server would silently stay in
-            # rotation, so strict all-or-nothing semantics are the only
-            # honest ones
-            raise RuntimeError(
-                f"weight update v{next_version} failed on "
-                f"{len(failed)}/{len(targets)} servers (breaker disabled, "
-                "degraded mode unavailable): "
-                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
-            ) from failed[0][1]
-        min_frac = self.config.update_weights_min_healthy_fraction
-        if healthy < max(1, min_frac * len(self.addresses)):
-            raise RuntimeError(
-                f"weight update v{next_version} reached only {healthy}/"
-                f"{len(self.addresses)} servers (min healthy fraction "
-                f"{min_frac}); failures: "
-                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
-            ) from (failed[0][1] if failed else None)
+        self._degraded_mode_or_raise(
+            failed, healthy, next_version, what="weight update"
+        )
         for a, r in failed:
             logger.warning(
                 "quarantining %s after failed weight update v%d: %s",
@@ -1022,6 +1007,124 @@ class RemoteInfEngine(InferenceEngine):
         )
         self.set_version(next_version)
         return latency
+
+    def _degraded_mode_or_raise(
+        self,
+        failed: list[tuple[str, BaseException]],
+        healthy: int,
+        version: int,
+        what: str,
+    ) -> None:
+        """Shared degraded-mode policy for the disk fan-out paths
+        (update_weights and resume reconciliation): without the breaker
+        plane there is no quarantine and no version-checked rejoin — a
+        stale server would silently stay in rotation — so any failure is
+        strict; with it, tolerate failures down to the min-healthy floor
+        (the failed servers get quarantined by the caller)."""
+        if failed and not self.config.breaker.enabled:
+            raise RuntimeError(
+                f"{what} v{version} failed on {len(failed)} server(s) "
+                "(breaker disabled, degraded mode unavailable): "
+                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
+            ) from failed[0][1]
+        min_frac = self.config.update_weights_min_healthy_fraction
+        if healthy < max(1, min_frac * len(self.addresses)):
+            raise RuntimeError(
+                f"{what} v{version} reached only {healthy}/"
+                f"{len(self.addresses)} servers (min healthy fraction "
+                f"{min_frac}); failures: "
+                + "; ".join(f"{a}: {r}" for a, r in failed[:4])
+            ) from (failed[0][1] if failed else None)
+
+    def reconcile_after_recover(
+        self, meta: WeightUpdateMeta, version: int
+    ) -> list[str]:
+        """Resume-time version reconciliation: after a trainer restart, the
+        inference servers may hold ANY weight version — older (the trainer
+        recovered to a checkpoint the servers never saw because the crash
+        landed mid-fan-out) or newer (the trainer rolled back past updates
+        the servers already applied). Reads every server's ``/model_info``
+        and re-pushes the recovered checkpoint (``meta.path``) to each one
+        whose version differs, so no resumed rollout is generated by
+        mismatched weights. Runs SYNCHRONOUSLY and must be called before
+        the first resumed rollout is submitted.
+
+        Unreachable servers are quarantined at ``version`` — PR 3's
+        version-checked rejoin probe re-pushes the update when they return.
+        Returns the addresses that were re-pushed."""
+        if self._spectator:
+            self._version = version
+            return []
+        self.set_version(version)
+        if meta.type != "disk":
+            raise NotImplementedError(
+                "resume reconciliation re-pushes from disk; other transports "
+                "have no persisted artifact to replay after a restart"
+            )
+        # arm the rejoin probe with the recovered checkpoint FIRST: servers
+        # that fail reconciliation below rejoin through _probe_version
+        self._last_disk_update = (meta.path, version)
+        repushed: list[str] = []
+        failed: list[tuple[str, BaseException]] = []
+
+        async def _reconcile_one(session, addr: str):
+            try:
+                async with session.get(
+                    f"http://{addr}/model_info",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.config.breaker.probe_timeout_seconds
+                    ),
+                ) as resp:
+                    info = await resp.json() if resp.status == 200 else {}
+                server_version = info.get("weight_version")
+                if server_version == version:
+                    return
+                logger.info(
+                    "reconcile: %s holds weight version %s, trainer "
+                    "recovered at %d; re-pushing %s",
+                    addr,
+                    server_version,
+                    version,
+                    meta.path,
+                )
+                await arequest_with_retry(
+                    session,
+                    f"http://{addr}/update_weights_from_disk",
+                    payload={"model_path": meta.path, "version": version},
+                    max_retries=self.config.request_retries,
+                    timeout=self.config.request_timeout,
+                )
+                repushed.append(addr)
+            except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+                failed.append((addr, e))
+
+        async def _go():
+            # concurrent fan-out (like update_weights): resume blocks on
+            # this by design, so wall-clock must be one server's worst
+            # case, not the sum over the fleet
+            session = self._new_session()
+            try:
+                await asyncio.gather(
+                    *[_reconcile_one(session, a) for a in list(self.addresses)]
+                )
+            finally:
+                await session.close()
+
+        asyncio.run(_go())
+        healthy = len(self.addresses) - len(failed)
+        self._degraded_mode_or_raise(
+            failed, healthy, version, what="resume reconciliation"
+        )
+        for addr, e in failed:
+            logger.warning(
+                "reconcile: %s unreachable (%s); quarantining at version %d "
+                "— the rejoin probe re-pushes when it returns",
+                addr,
+                e,
+                version,
+            )
+            self._health.quarantine(addr, required_version=version)
+        return repushed
 
     def pause(self):
         """Pause servers + the local rollout runtime (weight-update fence)."""

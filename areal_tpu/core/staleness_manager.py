@@ -58,6 +58,38 @@ class StalenessManager:
             self._stat.rejected += 1
             self._stat.running -= 1
 
+    def on_rollout_discarded(self) -> None:
+        """An already-ACCEPTED trajectory is dropped after the fact (resume
+        discards a drained rollout as too stale). Moves accepted -> rejected
+        so ``submitted == accepted + rejected + running`` keeps holding."""
+        with self._lock:
+            self._stat.accepted -= 1
+            self._stat.rejected += 1
+
+    def state_dict(self) -> dict:
+        """Counters for the crash-consistent RunState."""
+        s = self.get_stats()
+        return {
+            "submitted": s.submitted,
+            "accepted": s.accepted,
+            "running": s.running,
+            "rejected": s.rejected,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore counters after a trainer restart. Episodes that were
+        ``running`` when the state was dumped died with the old process —
+        they are rebalanced into ``rejected`` so the invariant
+        ``submitted == accepted + rejected + running`` holds at resume
+        (running starts at 0 in the new process)."""
+        with self._lock:
+            self._stat.submitted = int(d.get("submitted", 0))
+            self._stat.accepted = int(d.get("accepted", 0))
+            self._stat.rejected = int(d.get("rejected", 0)) + int(
+                d.get("running", 0)
+            )
+            self._stat.running = 0
+
     def get_stats(self) -> RolloutStat:
         with self._lock:
             return RolloutStat(

@@ -32,12 +32,21 @@ from areal_tpu.api.io_struct import TimedResult
 from areal_tpu.api.workflow_api import RolloutWorkflow
 from areal_tpu.core.staleness_manager import StalenessManager
 from areal_tpu.utils import logging
+from areal_tpu.utils.chaos import crash_point
 from areal_tpu.utils.data import concat_padded_tensors, cycle_dataloader
 
 logger = logging.getLogger("WorkflowExecutor")
 
 POLL_WAIT_TIME = 0.05
 POLL_SLEEP_TIME = 0.02
+
+
+class RolloutWaitInterrupted(RuntimeError):
+    """``wait``/``prepare_batch`` was interrupted by the executor's
+    ``interrupt_check`` (the preemption guard): rollout waits dominate
+    wall-clock, so a SIGTERM that only got noticed at the next step
+    boundary would burn the whole grace budget inside ``wait``. The
+    trainer catches this and runs the graceful drain+checkpoint path."""
 
 
 def check_trajectory_format(
@@ -107,9 +116,15 @@ class WorkflowExecutor:
 
         self.exiting = threading.Event()
         self.paused = threading.Event()
+        # polled inside wait/prepare_batch loops; when it returns True the
+        # blocked call raises RolloutWaitInterrupted (preemption guard hook)
+        self.interrupt_check: Callable[[], bool] | None = None
         self._exc_lock = threading.Lock()
         self._thread_exc: BaseException | None = None  # guarded_by: _exc_lock
         self.rollout_thread: threading.Thread | None = None
+        # set when the rollout loop exits: asyncio tasks still pending on its
+        # event loop after shutdown cleanup (must be 0 — pinned by tests)
+        self.tasks_leaked_at_exit: int | None = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -207,15 +222,23 @@ class WorkflowExecutor:
                         self.staleness_manager.on_rollout_rejected()
                         raise
                     if accept:
-                        self.staleness_manager.on_rollout_accepted()
+                        # enqueue BEFORE counting accepted: drain() treats
+                        # running==0 as "every accepted result is in the
+                        # queue", so the counter must never lead the put —
+                        # a GIL switch in between would let a preemption
+                        # drain return without the finished trajectory
                         try:
                             self.output_queue.put_nowait(
                                 TimedResult(t=create_time, data=traj)
                             )
                         except queue.Full:
+                            # the result is lost; balance the counters
+                            # before propagating
+                            self.staleness_manager.on_rollout_rejected()
                             raise RuntimeError(
                                 "output queue full; increase queue_size"
                             ) from None
+                        self.staleness_manager.on_rollout_accepted()
                     else:
                         self.staleness_manager.on_rollout_rejected()
                     if self.config.enable_rollout_tracing:
@@ -237,6 +260,17 @@ class WorkflowExecutor:
             # submitted == accepted + rejected holds at quiescence
             for _ in live:
                 self.staleness_manager.on_rollout_rejected()
+            # tracked background tasks (aio registry, e.g. the health-probe
+            # loop) are owned and cancelled by their creators; anything ELSE
+            # still pending here is an untracked leak
+            from areal_tpu.utils.aio import _BACKGROUND_TASKS
+
+            cur = asyncio.current_task()
+            self.tasks_leaked_at_exit = sum(
+                1
+                for t in asyncio.all_tasks()
+                if t is not cur and not t.done() and t not in _BACKGROUND_TASKS
+            )
 
     # --------------------------------------------------------------- client
 
@@ -256,10 +290,16 @@ class WorkflowExecutor:
             raise RuntimeError("input queue full; increase queue_size") from None
 
     def wait(self, count: int, timeout: float | None = None) -> dict[str, Any]:
+        crash_point("pre-rollout-wait")
         start = time.perf_counter()
         timeout = timeout or float(7 * 24 * 3600)
         while not self.exiting.is_set() and time.perf_counter() - start < timeout:
             self._check_health()
+            if self.interrupt_check is not None and self.interrupt_check():
+                raise RolloutWaitInterrupted(
+                    "rollout wait interrupted (preemption guard); drain and "
+                    "checkpoint now"
+                )
             while True:
                 try:
                     self.result_cache.append(self.output_queue.get_nowait())
@@ -327,3 +367,78 @@ class WorkflowExecutor:
 
     def resume(self):
         self.paused.clear()
+
+    # ----------------------------------------------------- preemption drain
+
+    def drain(self, timeout: float = 30.0) -> list[TimedResult]:
+        """Graceful-shutdown drain: stop launching new episodes (pause),
+        wait up to ``timeout`` for the in-flight ones to finish, then pull
+        every completed trajectory out of the output queue and result cache.
+
+        Returns the drained results oldest-first so the caller (the
+        preemption checkpoint path) can persist them; episodes still running
+        at the deadline are left for ``destroy`` to cancel — its shutdown
+        path rebalances their ``running`` counts into ``rejected``, so
+        ``submitted == accepted + rejected + running`` holds either way."""
+        self.pause()
+        deadline = time.monotonic() + max(0.0, timeout)
+        while time.monotonic() < deadline:
+            self._check_health()
+            if self.staleness_manager.get_stats().running == 0:
+                break
+            time.sleep(POLL_WAIT_TIME)
+        out = list(self.result_cache)
+        self.result_cache = []
+        while True:
+            try:
+                out.append(self.output_queue.get_nowait())
+            except queue.Empty:
+                break
+        out.sort(key=lambda r: r.t)
+        still_running = self.staleness_manager.get_stats().running
+        logger.info(
+            "drained %d completed rollout(s); %d still running "
+            "(will be cancelled and counted rejected on destroy)",
+            len(out),
+            still_running,
+        )
+        return out
+
+    def readmit_drained(
+        self, drained: list[TimedResult], current_version: int
+    ) -> tuple[int, int]:
+        """Resume-time re-admission of rollouts drained before a preemption
+        checkpoint. Each trajectory is re-admitted into the result cache iff
+        it is still within the staleness budget at ``current_version``
+        (judged by its per-token ``versions`` when present, else by the
+        restored weight version, i.e. staleness 0); too-stale ones are
+        discarded, moving their counters accepted -> rejected. Returns
+        ``(readmitted, discarded)``."""
+        max_staleness = self.config.max_head_offpolicyness
+        readmitted = discarded = 0
+        for r in drained:
+            versions = r.data.get("versions") if isinstance(r.data, dict) else None
+            v = None
+            if versions is not None:
+                arr = np.asarray(versions)
+                real = arr[arr >= 0]  # -1 marks prompt/non-generated tokens
+                if real.size:
+                    v = int(real.min())
+            traj_version = v if v is not None else current_version
+            if current_version - traj_version <= max_staleness:
+                self.result_cache.append(r)
+                readmitted += 1
+            else:
+                self.staleness_manager.on_rollout_discarded()
+                discarded += 1
+        self.result_cache.sort(key=lambda r: r.t)
+        if drained:
+            logger.info(
+                "re-admitted %d/%d drained rollout(s) at version %d "
+                "(%d discarded as stale)",
+                readmitted,
+                len(drained),
+                current_version,
+                discarded,
+            )
+        return readmitted, discarded

@@ -9,7 +9,15 @@ Parity with the reference's LocalLauncher (areal/launcher/local.py:258-401):
    ``AREAL_LLM_SERVER_ADDRS`` to the trainer;
 4. spawn the trainer entry script;
 5. monitor both; on any child failure kill the trial and relaunch with
-   ``run_id+1`` (recovery run env set) up to ``recover.retries``.
+   ``run_id+1`` (recovery run env set) up to ``recover.retries``, with a
+   capped exponential backoff between relaunches so a deterministic
+   startup crash can't hot-loop the trial.
+
+Preemption semantics: SIGTERM to the launcher is forwarded to the children
+as SIGTERM and they get ``recover.grace_period_seconds`` to drain + write a
+recover dump before SIGKILL. A trainer exiting after a graceful-preemption
+checkpoint (or killed by its own watchdog) returns nonzero like any crash —
+the relaunch resumes from the dump, step-exactly.
 
 Usage::
 
@@ -28,7 +36,7 @@ from areal_tpu.api.alloc_mode import AllocationMode
 from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.name_resolve import NameResolveConfig
-from areal_tpu.utils.recover import RECOVER_ENV
+from areal_tpu.utils.recover import PREEMPTION_EXIT_CODE, RECOVER_ENV
 
 logger = logging.getLogger("launcher.local")
 
@@ -128,16 +136,30 @@ def _spawn_trainer(cfg, entry: str, config_argv: list[str], addrs: list[str], ru
     return procs
 
 
-def _kill(procs):
+def _kill(procs, grace: float = 10.0):
+    """SIGTERM every child, give the fleet ``grace`` seconds collectively
+    to drain + checkpoint (the trainer's PreemptionGuard path), then
+    SIGKILL stragglers."""
     for p in procs:
         if p.poll() is None:
             p.send_signal(signal.SIGTERM)
     t0 = time.monotonic()
     for p in procs:
-        while p.poll() is None and time.monotonic() - t0 < 10:
+        while p.poll() is None and time.monotonic() - t0 < grace:
             time.sleep(0.2)
         if p.poll() is None:
             p.kill()
+
+
+def relaunch_backoff(
+    failures: int, base: float, cap: float
+) -> float:
+    """Capped exponential delay before relaunch attempt ``failures`` (1 =
+    first relaunch). Deterministic — the launcher is one process, there is
+    no thundering herd to jitter against."""
+    if failures <= 0 or base <= 0:
+        return 0.0
+    return min(base * (2 ** (failures - 1)), max(cap, base))
 
 
 def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
@@ -171,7 +193,12 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
                     return s.poll() or 1
             time.sleep(1.0)
     finally:
-        _kill(procs)
+        _kill(procs, grace=max(cfg.recover.grace_period_seconds, 1.0))
+
+
+#: runs shorter than this count as consecutive failures for backoff; a run
+#: that survived longer made real progress, so the backoff exponent resets
+_BACKOFF_RESET_SECONDS = 300.0
 
 
 def main(argv: list[str] | None = None):
@@ -181,17 +208,61 @@ def main(argv: list[str] | None = None):
     entry, config_argv = argv[0], argv[1:]
     cfg, _ = load_expr_config(config_argv, GRPOConfig)
     retries = max(cfg.recover.retries, 0) if cfg.recover.mode in ("auto", "fault") else 0
+    # SIGTERM (slice preemption, operator stop) -> SystemExit so the
+    # run_trial finally-block SIGTERMs the children with the grace budget
+    # instead of the default handler killing us with the fleet orphaned
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    # run_id counts ALL relaunches (it drives the AREAL_RECOVER_RUN env);
+    # the bounded retry budget counts only CRASHES — graceful preemptions
+    # (rc=42) are routine and unbounded on preemptible slices and must
+    # neither consume the budget nor accrue backoff
     run_id = 0
+    crash_failures = 0
+    consecutive_fast_failures = 0
     while True:
+        t0 = time.monotonic()
         rc = run_trial(entry, config_argv, run_id)
+        duration = time.monotonic() - t0
         if rc == 0:
             logger.info("trial finished successfully")
             return 0
-        if run_id >= retries:
+        if rc == PREEMPTION_EXIT_CODE and cfg.recover.mode != "disabled":
+            # gate on recovery being ENABLED, not on the crash-retry
+            # budget: retries=0 (no crash retries) must still relaunch
+            # after a graceful preemption — there is a valid checkpoint
+            run_id += 1
+            logger.warning(
+                "trial preempted (graceful checkpoint, rc=%d); relaunching "
+                "as run %d immediately",
+                rc,
+                run_id,
+            )
+            continue
+        if crash_failures >= retries:
             logger.error("trial failed with rc=%s; no retries left", rc)
             return rc or 1
+        crash_failures += 1
+        if duration >= _BACKOFF_RESET_SECONDS:
+            consecutive_fast_failures = 0
+        consecutive_fast_failures += 1
+        delay = relaunch_backoff(
+            consecutive_fast_failures,
+            cfg.recover.relaunch_backoff_seconds,
+            cfg.recover.relaunch_backoff_max_seconds,
+        )
         run_id += 1
-        logger.warning("trial failed (rc=%s); relaunching as run %d", rc, run_id)
+        logger.warning(
+            "trial failed (rc=%s after %.0fs, crash %d/%d); relaunching as "
+            "run %d in %.1fs",
+            rc,
+            duration,
+            crash_failures,
+            retries,
+            run_id,
+            delay,
+        )
+        if delay > 0:
+            time.sleep(delay)
 
 
 if __name__ == "__main__":

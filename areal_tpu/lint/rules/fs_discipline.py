@@ -1,0 +1,112 @@
+"""Checkpoint filesystem discipline.
+
+crash-unsafe-write: a direct write-mode ``open`` on a path under the
+checkpoint/recover state tree bypasses the atomic write-then-rename
+helper. A preemption can land between any two syscalls; a reader (the next
+recovery run) that finds a truncated ``recover_info.json`` or half a
+pickle refuses to resume — or worse, resumes wrong. Every such file must
+go through ``areal_tpu.utils.fs.atomic_write`` (tmp + fsync + rename), so
+readers only ever see the previous complete file or the new complete file.
+
+Heuristic: the opened path expression *mentions* recovery state — any
+string constant or identifier in it containing ``recover``,
+``checkpoint``, or ``ckpt``. Exempt when the write IS the atomic pattern:
+the enclosing function's name contains ``atomic``, or the function also
+calls ``os.replace``/``os.rename`` (write-then-rename implemented inline).
+Read-mode opens never flag; append-mode logs (stats.jsonl) are a different
+protocol (scan-and-truncate on reopen) and don't flag either.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import FileContext, Finding, Rule, register
+
+_TOKENS = ("recover", "checkpoint", "ckpt")
+
+#: modes that truncate or create — the crash window this rule is about.
+#: "a" (append) is excluded: append-only logs use scan-and-truncate on
+#: reopen, not write-then-rename.
+_UNSAFE_MODE_CHARS = ("w", "x")
+
+
+def _path_mentions_recovery(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        text = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+        elif isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        if text and any(t in text.lower() for t in _TOKENS):
+            return True
+    return False
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """The constant mode string of an ``open`` call; None when absent or
+    not statically known (no judgement on dynamic modes)."""
+    mode = call.args[1] if len(call.args) > 1 else None
+    if mode is None:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _enclosing_is_atomic(ctx: FileContext, call: ast.Call) -> bool:
+    for anc in ctx.ancestors(call):
+        if not isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "atomic" in anc.name.lower():
+            return True
+        # inline write-then-rename: the function that opens also renames
+        for n in ast.walk(anc):
+            if (
+                isinstance(n, ast.Call)
+                and (ctx.resolved(n.func) or "") in ("os.replace", "os.rename")
+            ):
+                return True
+        return False  # judge only the innermost function
+    return False
+
+
+@register
+class CrashUnsafeWriteRule(Rule):
+    id = "crash-unsafe-write"
+    doc = (
+        "write-mode open on a checkpoint/recover path without "
+        "write-then-rename; a crash mid-write leaves a torn file the next "
+        "resume refuses (or fails) to load"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+                continue
+            if not node.args:
+                continue
+            mode = _open_mode(node)
+            if mode is None or not any(c in mode for c in _UNSAFE_MODE_CHARS):
+                continue
+            if not _path_mentions_recovery(node.args[0]):
+                continue
+            if _enclosing_is_atomic(ctx, node):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                "non-atomic write to recover/checkpoint state "
+                f"(open mode {mode!r}); a preemption mid-write leaves a "
+                "torn file that strands the next resume — use "
+                "areal_tpu.utils.fs.atomic_write (write-then-rename)",
+            )

@@ -41,6 +41,57 @@ logger = logging.getLogger("chaos")
 
 CHAOS_SERVER_ENV = "AREAL_CHAOS_SERVER"
 
+# ---------------------------------------------------------------------------
+# deterministic crash points (the preemption-safety harness)
+# ---------------------------------------------------------------------------
+
+CRASH_ENV = "AREAL_CRASH_AT"
+
+#: barrier names the training plane exposes; harness loops may add their own
+CRASH_POINTS = (
+    "pre-rollout-wait",   # WorkflowExecutor.wait entry
+    "post-train-step",    # after the optimizer step, before weight push
+    "pre-weight-update",  # before the weight fan-out to inference servers
+    "mid-checkpoint",     # inside RecoverHandler.dump, before the commit marker
+)
+
+
+class InjectedCrash(BaseException):
+    """Raised by :func:`crash_point` to simulate a kill -9 at an exact
+    barrier. A ``BaseException`` on purpose: generic ``except Exception``
+    retry/cleanup paths must not swallow it — a real SIGKILL would not be
+    swallowed either. Only the crash-test harness catches it."""
+
+
+#: per-name hit counters for ``name@N`` specs (crash on the Nth arrival)
+_crash_hits: dict[str, int] = {}
+
+
+def reset_crash_points() -> None:
+    """Clear hit counters (tests arm a fresh spec per scenario)."""
+    _crash_hits.clear()
+
+
+def crash_point(name: str) -> None:
+    """Deterministic kill barrier: if ``AREAL_CRASH_AT`` names this point,
+    raise :class:`InjectedCrash` here. Spec grammar, comma-separated:
+    ``point`` (crash on first arrival) or ``point@N`` (crash on the Nth).
+    Off (the common case) costs one env lookup at a once-per-step site —
+    these barriers never sit in token-level hot loops."""
+    spec = os.environ.get(CRASH_ENV, "")
+    if not spec:
+        return
+    for part in spec.split(","):
+        target, _, nth = part.strip().partition("@")
+        if target != name:
+            continue
+        _crash_hits[name] = _crash_hits.get(name, 0) + 1
+        n = int(nth) if nth else 1
+        if _crash_hits[name] == n:
+            raise InjectedCrash(
+                f"AREAL_CRASH_AT barrier {name!r} (arrival {n})"
+            )
+
 #: action vocabulary shared by config validation and the two hook sites
 ACTIONS = ("drop", "http_error", "timeout", "slow", "disconnect")
 

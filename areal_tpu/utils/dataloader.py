@@ -64,9 +64,29 @@ class StatefulDataLoader:
             "epoch": self._epoch,
             "batch_in_epoch": self._batch_in_epoch,
             "seed": self.seed,
+            # resume-safety fingerprint: the cursor is an index into the
+            # (seed, epoch)-shuffled order of THIS dataset — restoring it
+            # over a different dataset/batching silently trains on the
+            # wrong sample stream
+            "dataset_size": len(self.dataset),
+            "batch_size": self.batch_size,
         }
 
     def load_state_dict(self, state: dict):
+        size = state.get("dataset_size")
+        if size is not None and size != len(self.dataset):
+            raise ValueError(
+                f"refusing to restore dataloader cursor: dataset has "
+                f"{len(self.dataset)} rows, saved state was over {size} "
+                "(the dataset changed; the saved shuffle order and cursor "
+                "are meaningless)"
+            )
+        bs = state.get("batch_size")
+        if bs is not None and bs != self.batch_size:
+            raise ValueError(
+                f"refusing to restore dataloader cursor: batch_size "
+                f"{self.batch_size} != saved {bs}"
+            )
         self._epoch = state["epoch"]
         self._batch_in_epoch = state["batch_in_epoch"]
         self.seed = state.get("seed", self.seed)

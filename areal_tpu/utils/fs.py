@@ -1,0 +1,33 @@
+"""Crash-consistent filesystem primitives for checkpoint/recover state.
+
+Every file under a recover or checkpoint directory must be written via
+write-then-rename: a preemption can land between any two syscalls, and a
+reader (the next recovery run) must only ever see either the previous
+complete file or the new complete file — never a truncated one. The
+``crash-unsafe-write`` arealint rule flags direct write-mode ``open`` calls
+on recovery-ish paths that bypass these helpers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write(path: str, write_fn, binary: bool = False) -> None:
+    """Write via tmp-file + fsync + rename so readers never see a partial
+    file. ``write_fn(f)`` receives the open tmp handle."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb" if binary else "w") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write(path, lambda f: f.write(text))
+
+
+def atomic_write_json(path: str, obj) -> None:
+    atomic_write(path, lambda f: json.dump(obj, f))

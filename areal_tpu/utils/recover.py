@@ -1,12 +1,28 @@
 """Step-level recovery: persist everything needed to resume a trial
-(reference: areal/utils/recover.py:385 — RecoverHandler/RecoverInfo).
+step-exactly (reference: areal/utils/recover.py:385 — RecoverHandler),
+plus the preemption plane: a SIGTERM guard that turns a kill notice into
+pause -> rollout drain -> checkpoint within a grace budget.
 
 ``RecoverHandler.dump`` writes, per checkpointed step:
+
 - the engine checkpoint (weights + optimizer, orbax format),
-- the dataloader position (StatefulDataLoader.state_dict),
-- Saver/Evaluator timer states,
-- a ``RecoverInfo`` json: last StepInfo + a config hash (refusing to resume
-  onto a changed config).
+- a ``loop_state.pkl``: dataloader cursor (seeded shuffle position),
+  Saver/Evaluator timer states, python/numpy PRNG states, stats-logger
+  state, and any rollouts drained by a graceful shutdown,
+- a versioned :class:`RunState` json: last StepInfo, weight version,
+  staleness counters, last stats-logger step, last saver checkpoint path,
+  and a config hash (refusing to resume onto a changed config).
+
+Crash consistency: each dump is staged into its own
+``dump_globalstep{N}`` directory (engine checkpoint + loop_state.pkl), and
+only then is the root ``recover_info.json`` marker flipped — atomically,
+via write-then-rename — to reference it; the previous dump directory is
+deleted only after the new marker is committed. A crash at ANY point
+(including the ``mid-checkpoint`` ``AREAL_CRASH_AT`` barrier between the
+staging writes and the marker flip) therefore leaves the previous dump
+fully intact and referenced, or the new one committed — never a torn mix
+of old marker and new state. The price is transiently two engine
+checkpoints on disk during a dump.
 
 ``check_if_recover`` mirrors the reference's AREAL_RECOVER_RUN env protocol:
 launchers relaunch failed trials with the env set, and the entry script calls
@@ -20,16 +36,37 @@ import hashlib
 import json
 import os
 import pickle
-from dataclasses import dataclass
+import random
+import shutil
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
 
 from areal_tpu.api.cli_args import RecoverConfig, to_dict
-from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, TimedResult
 from areal_tpu.utils import logging
+from areal_tpu.utils.chaos import crash_point
+from areal_tpu.utils.fs import atomic_write
 from areal_tpu.utils.saver import FreqTimer
 
 logger = logging.getLogger("recover")
 
 RECOVER_ENV = "AREAL_RECOVER_RUN"
+
+#: RunState schema; bump when the json layout changes incompatibly. A state
+#: written by a NEWER schema refuses to load (older fields are defaulted).
+RUN_STATE_SCHEMA = 1
+
+#: exit code a trainer uses after a successful graceful-preemption
+#: checkpoint; the launcher treats it like any failure (relaunch + resume)
+PREEMPTION_EXIT_CODE = 42
+
+# compat alias: the original helper moved to utils/fs.atomic_write so the
+# saver (retention pointer) and future checkpoint writers share it
+_atomic_write = atomic_write
 
 
 class RecoverStateCorrupted(RuntimeError):
@@ -37,16 +74,6 @@ class RecoverStateCorrupted(RuntimeError):
     pickle, missing checkpoint). Raised instead of the raw decode error so
     the launcher refuses to resume with a clear message rather than
     crashing opaquely — delete the recover dir to start fresh."""
-
-
-def _atomic_write(path: str, write_fn, binary: bool = False) -> None:
-    """Write via tmp-file + rename so readers never see a partial file."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb" if binary else "w") as f:
-        write_fn(f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
 
 
 def config_hash(cfg) -> str:
@@ -58,22 +85,64 @@ def config_hash(cfg) -> str:
 
 
 @dataclass
-class RecoverInfo:
+class RunState:
+    """Versioned, crash-consistent snapshot of the trainer loop's control
+    state — everything a restarted trainer needs (besides the engine
+    checkpoint itself) to continue the run step-exactly."""
+
     last_step_info: StepInfo
     config_hash: str = ""
+    schema_version: int = RUN_STATE_SCHEMA
+    #: inference-plane weight version at dump time; resume reconciliation
+    #: re-pushes weights to any server stuck below it
+    weight_version: int = 0
+    #: StalenessManager counters (running rebalances to rejected on load)
+    rollout_stat: dict = field(default_factory=dict)
+    #: last global step the stats logger committed (resume dedup cross-check)
+    stats_logger_step: int = -1
+    #: last Saver checkpoint path — retention GC must never delete it
+    last_save_path: str | None = None
+    #: dump directory (relative to the recover root) this marker commits;
+    #: None means the pre-RunState flat layout (engine/ + loop_state.pkl
+    #: directly under the root)
+    dump_dir: str | None = None
 
     def to_json(self) -> dict:
         return {
+            "schema_version": self.schema_version,
             "last_step_info": dataclasses.asdict(self.last_step_info),
             "config_hash": self.config_hash,
+            "weight_version": self.weight_version,
+            "rollout_stat": self.rollout_stat,
+            "stats_logger_step": self.stats_logger_step,
+            "last_save_path": self.last_save_path,
+            "dump_dir": self.dump_dir,
         }
 
     @classmethod
-    def from_json(cls, d: dict) -> "RecoverInfo":
+    def from_json(cls, d: dict) -> "RunState":
+        schema = int(d.get("schema_version", 1))
+        if schema > RUN_STATE_SCHEMA:
+            raise RecoverStateCorrupted(
+                f"run state schema {schema} is newer than this build "
+                f"supports ({RUN_STATE_SCHEMA}); upgrade the trainer or "
+                "delete the recover dir to start fresh"
+            )
         return cls(
             last_step_info=StepInfo(**d["last_step_info"]),
             config_hash=d.get("config_hash", ""),
+            schema_version=schema,
+            weight_version=int(d.get("weight_version", 0)),
+            rollout_stat=d.get("rollout_stat", {}) or {},
+            stats_logger_step=int(d.get("stats_logger_step", -1)),
+            last_save_path=d.get("last_save_path"),
+            dump_dir=d.get("dump_dir"),
         )
+
+
+#: historical name — pre-RunState recover_info.json files load through the
+#: same (defaults-tolerant) from_json
+RecoverInfo = RunState
 
 
 def check_if_recover(config: RecoverConfig, run_id: int | None = None) -> bool:
@@ -93,6 +162,121 @@ def check_if_recover(config: RecoverConfig, run_id: int | None = None) -> bool:
     return False
 
 
+def _rollout_snapshot(rollout):
+    """(weight_version, staleness_manager, executor) from whatever rollout
+    object the trainer holds: a RemoteInfEngine (has .executor), a bare
+    WorkflowExecutor, or None."""
+    if rollout is None:
+        return None, None, None
+    version = rollout.get_version() if hasattr(rollout, "get_version") else None
+    executor = getattr(rollout, "executor", rollout)
+    manager = getattr(executor, "staleness_manager", None)
+    if not hasattr(executor, "readmit_drained"):
+        executor = None
+    return version, manager, executor
+
+
+def _counters_as_if_crashed_now(staleness, executor) -> dict:
+    """Staleness counters to persist: the snapshot must describe the world
+    the RESUMED process will actually see. Completed-but-unconsumed
+    trajectories sitting in the output queue / result cache are counted
+    ``accepted`` by the live manager, but unless they ride the dump as
+    ``drained`` they die with the process — restoring them as accepted
+    would permanently shrink the staleness capacity
+    (``(max_staleness+v+1)*bs - (accepted+running)``) by phantoms and can
+    deadlock rollout submission. Move the not-persisted ones
+    accepted -> rejected in the PERSISTED copy only (the live manager is
+    untouched; clamped against racing completions)."""
+    if staleness is None:
+        return {}
+    d = staleness.state_dict()
+    if executor is None:
+        return d
+    # this adjustment applies on the graceful path too: drain() emptied the
+    # queues of everything that IS persisted (the drained list), so any
+    # queue content observed now is a straggler that finished after the
+    # drain deadline — counted accepted by the live manager but absent from
+    # the dump, i.e. lost to the restart like any other unconsumed result
+    unconsumed = executor.output_queue.qsize() + len(executor.result_cache)
+    lost = min(unconsumed, d.get("accepted", 0))
+    d["accepted"] = d.get("accepted", 0) - lost
+    d["rejected"] = d.get("rejected", 0) + lost
+    return d
+
+
+class PreemptionGuard:
+    """Cooperative SIGTERM/preemption-notice handler.
+
+    ``install()`` registers signal handlers (main thread only — Python
+    restriction) that merely set a flag and start the grace clock; the
+    training loop polls :meth:`should_stop` once per step and runs the
+    graceful path (pause -> drain -> checkpoint -> exit
+    ``PREEMPTION_EXIT_CODE``) itself, so the checkpoint is written by
+    ordinary code, not from a signal context. ``trigger()`` is callable
+    directly — tests and cloud preemption-notice pollers (GCE metadata,
+    k8s preStop) use it instead of a real signal.
+    """
+
+    def __init__(
+        self,
+        grace_period_seconds: float = 30.0,
+        signals: tuple = (signal.SIGTERM,),
+        clock=time.monotonic,
+    ):
+        self.grace_period_seconds = grace_period_seconds
+        self._signals = signals
+        self._clock = clock
+        self._flag = threading.Event()
+        self._deadline: float | None = None
+        self._received: int | None = None
+        self._prev_handlers: dict = {}
+
+    def install(self) -> "PreemptionGuard":
+        for s in self._signals:
+            self._prev_handlers[s] = signal.signal(s, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        for s, h in self._prev_handlers.items():
+            signal.signal(s, h)
+        self._prev_handlers.clear()
+
+    def _handle(self, signum, frame):
+        # no logging here: the handler runs between arbitrary bytecodes of
+        # the main thread, and the logging stack's buffered IO is not
+        # reentrant — a SIGTERM landing mid-log-write would raise
+        # RuntimeError('reentrant call') INTO the training loop, crashing
+        # it without the drain+checkpoint this guard exists to run. A raw
+        # os.write is a single syscall and async-signal-safe.
+        self._received = signum
+        self.trigger()
+        try:
+            os.write(
+                2,
+                (
+                    f"PreemptionGuard: signal {signum} received; draining "
+                    f"and checkpointing within {self.grace_period_seconds:.0f}s\n"
+                ).encode(),
+            )
+        except OSError:
+            pass
+
+    def trigger(self) -> None:
+        """Arm the stop flag and start the grace clock (idempotent)."""
+        if not self._flag.is_set():
+            self._deadline = self._clock() + self.grace_period_seconds
+            self._flag.set()
+
+    def should_stop(self) -> bool:
+        return self._flag.is_set()
+
+    def remaining(self) -> float:
+        """Seconds left of the grace budget (inf when not triggered)."""
+        if self._deadline is None:
+            return float("inf")
+        return max(0.0, self._deadline - self._clock())
+
+
 class RecoverHandler:
     def __init__(self, config: RecoverConfig, ft_spec=None):
         self.config = config
@@ -103,6 +287,21 @@ class RecoverHandler:
 
     def recover_root(self, fileroot: str, experiment_name: str, trial_name: str) -> str:
         return os.path.join(fileroot, experiment_name, trial_name, "recover")
+
+    @staticmethod
+    def _read_marker(root: str) -> dict:
+        """Best-effort read of the root commit marker; {} when missing or
+        torn (load() is the strict reader — it refuses torn markers)."""
+        try:
+            with open(os.path.join(root, "recover_info.json")) as f:
+                d = json.load(f)
+            return d if isinstance(d, dict) else {}
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def _committed_dump_name(self, root: str) -> str | None:
+        """Dump dir the current marker references (None when unreadable)."""
+        return self._read_marker(root).get("dump_dir")
 
     def dump(
         self,
@@ -119,6 +318,8 @@ class RecoverHandler:
         tokenizer=None,
         config=None,
         force: bool = False,
+        rollout=None,
+        drained: list[TimedResult] | None = None,
     ) -> str | None:
         if self.config.mode == "disabled":
             return None
@@ -126,40 +327,98 @@ class RecoverHandler:
         if not force and not self.timer.should_fire(step, last):
             return None
         root = self.recover_root(fileroot, experiment_name, trial_name)
-        os.makedirs(root, exist_ok=True)
+        # stage into a per-step directory; the root marker flips to it LAST.
+        # Until then the previous dump stays intact and referenced, so a
+        # crash anywhere in here resumes from the previous consistent state.
+        # A re-dump of the SAME step (graceful shutdown right after a
+        # periodic dump) must not restage into the directory the committed
+        # marker references — that would delete the only consistent state
+        # on disk — so it picks a distinct suffixed name instead.
+        committed = self._committed_dump_name(root)
+        base = f"dump_globalstep{step.global_step}"
+        dump_name, k = base, 0
+        while dump_name == committed:
+            k += 1
+            dump_name = f"{base}.{k}"
+        dump_root = os.path.join(root, dump_name)
+        if os.path.isdir(dump_root):
+            # a torn staging attempt from a crashed dump at this same step;
+            # the marker never committed it (checked above) — restart it
+            shutil.rmtree(dump_root, ignore_errors=True)
+        os.makedirs(dump_root, exist_ok=True)
         engine.save(
             SaveLoadMeta(
-                path=os.path.join(root, "engine"),
+                path=os.path.join(dump_root, "engine"),
                 weight_format="orbax",
                 with_optim=True,
                 tokenizer=tokenizer,
             )
         )
+        weight_version, staleness, rollout_executor = _rollout_snapshot(rollout)
         state = {
             "dataloader": dataloader.state_dict() if dataloader is not None else None,
             "saver": saver.state_dict() if saver is not None else None,
             "evaluator": evaluator.state_dict() if evaluator is not None else None,
+            "stats_logger": (
+                stats_logger.state_dict()
+                if stats_logger is not None and hasattr(stats_logger, "state_dict")
+                else None
+            ),
+            # host PRNG state: the executor's batch shuffle and any
+            # workflow-level sampling draw from these; step-exact resume
+            # needs the same stream
+            "prng": {
+                "python": random.getstate(),
+                "numpy": np.random.get_state(),
+            },
+            # rollouts completed-but-unconsumed at a graceful shutdown;
+            # resume re-admits or discards them by staleness
+            "drained": [(r.t, r.data) for r in (drained or [])],
         }
-        # write-then-rename: a crash mid-dump must leave either the previous
-        # consistent state or none, never a truncated file that a recovery
-        # run would choke on. recover_info.json goes LAST — its presence is
-        # the commit marker for the whole dump.
-        _atomic_write(
-            os.path.join(root, "loop_state.pkl"),
+        atomic_write(
+            os.path.join(dump_root, "loop_state.pkl"),
             lambda f: pickle.dump(state, f),
             binary=True,
         )
-        info = RecoverInfo(
+        # deterministic kill barrier between the staged state and the commit
+        # marker: a crash here must resume from the PREVIOUS dump
+        crash_point("mid-checkpoint")
+        info = RunState(
             last_step_info=step,
             config_hash=config_hash(config) if config is not None else "",
+            weight_version=weight_version if weight_version is not None else 0,
+            rollout_stat=_counters_as_if_crashed_now(staleness, rollout_executor),
+            stats_logger_step=(
+                stats_logger.last_logged_step
+                if stats_logger is not None
+                and hasattr(stats_logger, "last_logged_step")
+                else -1
+            ),
+            last_save_path=getattr(saver, "last_save_path", None),
+            dump_dir=dump_name,
         )
-        _atomic_write(
+        # the commit point for the whole dump: write-then-rename, LAST
+        atomic_write(
             os.path.join(root, "recover_info.json"),
             lambda f: json.dump(info.to_json(), f),
         )
+        # only now is the previous dump unreferenced and safe to GC (and the
+        # legacy flat-layout files, which the new marker supersedes)
+        for name in os.listdir(root):
+            if name.startswith("dump_globalstep") and name != dump_name:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+            elif name == "engine":
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+            elif name == "loop_state.pkl":
+                try:
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass
         self.timer.reset()
-        logger.info("recover state dumped at %s (step %d)", root, step.global_step)
-        return root
+        logger.info(
+            "recover state dumped at %s (step %d)", dump_root, step.global_step
+        )
+        return dump_root
 
     def load(
         self,
@@ -167,19 +426,23 @@ class RecoverHandler:
         saver=None,
         evaluator=None,
         dataloader=None,
+        stats_logger=None,
         *,
         fileroot: str,
         experiment_name: str,
         trial_name: str,
         config=None,
-    ) -> RecoverInfo | None:
+        rollout=None,
+    ) -> RunState | None:
         root = self.recover_root(fileroot, experiment_name, trial_name)
         info_path = os.path.join(root, "recover_info.json")
         if not os.path.isfile(info_path):
             return None
         try:
             with open(info_path) as f:
-                info = RecoverInfo.from_json(json.load(f))
+                info = RunState.from_json(json.load(f))
+        except RecoverStateCorrupted:
+            raise
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             raise RecoverStateCorrupted(
                 f"refusing to resume: {info_path} is corrupted ({e}); "
@@ -192,26 +455,31 @@ class RecoverHandler:
                     f"refusing to recover: config hash {h} != saved "
                     f"{info.config_hash} (the trial config changed)"
                 )
+        # the marker names the committed dump dir; legacy flat-layout
+        # markers (no dump_dir) read straight from the root
+        state_root = (
+            os.path.join(root, info.dump_dir) if info.dump_dir else root
+        )
         try:
             engine.load(
                 SaveLoadMeta(
-                    path=os.path.join(root, "engine"),
+                    path=os.path.join(state_root, "engine"),
                     weight_format="orbax",
                     with_optim=True,
                 )
             )
         except Exception as e:
             raise RecoverStateCorrupted(
-                f"refusing to resume: engine checkpoint under {root} is "
-                f"partial or corrupted ({e}); delete {root} to start fresh"
+                f"refusing to resume: engine checkpoint under {state_root} "
+                f"is partial or corrupted ({e}); delete {root} to start fresh"
             ) from e
         try:
-            with open(os.path.join(root, "loop_state.pkl"), "rb") as f:
+            with open(os.path.join(state_root, "loop_state.pkl"), "rb") as f:
                 state = pickle.load(f)
         except (OSError, pickle.UnpicklingError, EOFError, AttributeError) as e:
             raise RecoverStateCorrupted(
-                f"refusing to resume: {root}/loop_state.pkl is corrupted "
-                f"({e}); delete {root} to start fresh"
+                f"refusing to resume: {state_root}/loop_state.pkl is "
+                f"corrupted ({e}); delete {root} to start fresh"
             ) from e
         if dataloader is not None and state.get("dataloader") is not None:
             dataloader.load_state_dict(state["dataloader"])
@@ -219,9 +487,106 @@ class RecoverHandler:
             saver.load_state_dict(state["saver"])
         if evaluator is not None and state.get("evaluator") is not None:
             evaluator.load_state_dict(state["evaluator"])
+        if stats_logger is not None and hasattr(stats_logger, "load_state_dict"):
+            # the RunState's stats_logger_step cross-checks loop_state's
+            # copy: whichever is further along wins (e.g. loop_state from
+            # an older dump layout, or a jsonl lost with ephemeral disk)
+            sl = dict(state.get("stats_logger") or {})
+            sl["last_logged_step"] = max(
+                int(sl.get("last_logged_step", -1)), info.stats_logger_step
+            )
+            stats_logger.load_state_dict(sl)
+        prng = state.get("prng")
+        if prng is not None:
+            random.setstate(prng["python"])
+            np.random.set_state(prng["numpy"])
+        _, staleness, executor = _rollout_snapshot(rollout)
+        if rollout is not None and hasattr(rollout, "set_version"):
+            rollout.set_version(info.weight_version)
+        if staleness is not None and info.rollout_stat:
+            staleness.load_state_dict(info.rollout_stat)
+        if executor is not None and state.get("drained"):
+            executor.readmit_drained(
+                [TimedResult(t=t, data=d) for t, d in state["drained"]],
+                info.weight_version,
+            )
         logger.info(
-            "recovered from %s at global step %d",
+            "recovered from %s at global step %d (weight version %d)",
             root,
             info.last_step_info.global_step,
+            info.weight_version,
         )
         return info
+
+    def graceful_shutdown(
+        self,
+        engine,
+        step: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        stats_logger=None,
+        *,
+        fileroot: str,
+        experiment_name: str,
+        trial_name: str,
+        tokenizer=None,
+        config=None,
+        rollout=None,
+        guard: PreemptionGuard | None = None,
+        checkpoint_reserve_seconds: float = 10.0,
+    ) -> str | None:
+        """The preemption path: drain in-flight episodes within the
+        remaining grace budget (reserving ``checkpoint_reserve_seconds``
+        for the dump itself), then force a recover dump that includes the
+        drained rollouts. Returns the dump root. The caller exits with
+        :data:`PREEMPTION_EXIT_CODE` after.
+
+        Deliberately does NOT fan out a server-side pause: the drain's
+        whole point is letting in-flight generations FINISH within the
+        grace window, and a paused generation server aborts them (the
+        client would spin on the pause flag until the budget burns with
+        nothing salvaged). New episode launches are gated executor-side by
+        ``drain()`` itself, and this process exits right after the dump —
+        the servers simply go idle."""
+        budget = guard.remaining() if guard is not None else float("inf")
+        if budget == float("inf"):
+            budget = self.config.grace_period_seconds
+        _, _, executor = _rollout_snapshot(rollout)
+        drained: list[TimedResult] = []
+        if executor is not None:
+            drain_budget = max(
+                0.0,
+                min(
+                    self.config.drain_timeout_seconds,
+                    budget - checkpoint_reserve_seconds,
+                ),
+            )
+            drained = executor.drain(timeout=drain_budget)
+        return self.dump(
+            engine,
+            step,
+            saver,
+            evaluator,
+            dataloader,
+            stats_logger,
+            fileroot=fileroot,
+            experiment_name=experiment_name,
+            trial_name=trial_name,
+            tokenizer=tokenizer,
+            config=config,
+            force=True,
+            rollout=rollout,
+            drained=drained,
+        )
+
+    def protected_paths(
+        self, fileroot: str, experiment_name: str, trial_name: str
+    ) -> set[str]:
+        """Checkpoint paths the retention GC must not delete: whatever the
+        committed recover info currently names. Best-effort read — a
+        missing or torn info file protects nothing (the GC separately
+        always keeps the newest checkpoints)."""
+        root = self.recover_root(fileroot, experiment_name, trial_name)
+        p = self._read_marker(root).get("last_save_path")
+        return {p} if p else set()

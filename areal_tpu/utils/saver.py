@@ -8,14 +8,25 @@ timer drives ``Evaluator`` (reference: areal/utils/evaluator.py).
 from __future__ import annotations
 
 import os
+import re
+import shutil
 import time
-from typing import Callable
+from typing import Callable, Iterable
 
 from areal_tpu.api.cli_args import EvaluatorConfig, SaverConfig
 from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
 from areal_tpu.utils import logging
+from areal_tpu.utils.fs import atomic_write_text
 
 logger = logging.getLogger("saver")
+
+#: checkpoint directory naming scheme; the retention GC parses global_step
+#: back out of it to order and select survivors
+_CKPT_DIR_RE = re.compile(r"^epoch(\d+)epochstep(\d+)globalstep(\d+)$")
+
+#: name of the atomically updated pointer file in the save root; always
+#: names the most recent successfully written checkpoint directory
+LATEST_POINTER = "latest"
 
 
 class FreqTimer:
@@ -69,6 +80,9 @@ class Saver:
             config.freq_epochs, config.freq_steps, config.freq_secs
         )
         self.for_recover = for_recover
+        #: last checkpoint this saver wrote (rides the RunState so recover
+        #: info can protect it from retention GC)
+        self.last_save_path: str | None = None
 
     def save_root(self) -> str:
         return os.path.join(
@@ -79,8 +93,18 @@ class Saver:
         )
 
     def save(
-        self, engine, step: StepInfo, force: bool = False, tokenizer=None
+        self,
+        engine,
+        step: StepInfo,
+        force: bool = False,
+        tokenizer=None,
+        protect: Iterable[str] = (),
     ) -> str | None:
+        """Write a checkpoint when the timer fires, update the ``latest``
+        pointer atomically, and run retention GC. ``protect`` names
+        checkpoints the GC must keep regardless of retention policy (the
+        path the recover info references — deleting it would strand the
+        next recovery run)."""
         last = self.ft_spec.is_epoch_last_step(step.epoch_step) if self.ft_spec else False
         if not force and not self.timer.should_fire(step, last):
             return None
@@ -98,14 +122,92 @@ class Saver:
             )
         )
         self.timer.reset()
+        self.last_save_path = path
+        # the pointer flips only AFTER the checkpoint fully landed, via
+        # write-then-rename: readers (resume tooling, eval jobs) either see
+        # the previous complete checkpoint's name or this one's, never a
+        # name for a half-written directory
+        atomic_write_text(
+            os.path.join(self.save_root(), LATEST_POINTER),
+            os.path.basename(path) + "\n",
+        )
+        self.gc(protect=protect)
         logger.info("saved checkpoint at %s", path)
         return path
 
+    def latest_checkpoint(self) -> str | None:
+        """Path named by the ``latest`` pointer, if present and valid."""
+        pointer = os.path.join(self.save_root(), LATEST_POINTER)
+        try:
+            with open(pointer) as f:
+                name = f.read().strip()
+        except OSError:
+            return None
+        path = os.path.join(self.save_root(), name)
+        return path if name and os.path.isdir(path) else None
+
+    def gc(self, protect: Iterable[str] = ()) -> list[str]:
+        """Retention GC: keep the newest ``keep_last`` checkpoints, plus
+        every checkpoint whose global_step is a multiple of ``keep_every``,
+        plus anything in ``protect`` and the ``latest`` pointer target.
+        No-op unless a retention knob is set. Returns the deleted paths."""
+        keep_last = self.config.keep_last
+        keep_every = self.config.keep_every
+        if keep_last is None and keep_every is None:
+            return []
+        root = self.save_root()
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        entries = []
+        for name in names:
+            m = _CKPT_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(root, name)):
+                entries.append((int(m.group(3)), name))
+        entries.sort()
+        protected = {os.path.basename(os.path.normpath(p)) for p in protect if p}
+        latest = self.latest_checkpoint()
+        if latest:
+            protected.add(os.path.basename(latest))
+        if self.last_save_path:
+            protected.add(os.path.basename(self.last_save_path))
+        keep: set[str] = set(protected)
+        # the newest checkpoint always survives, even under keep_every-only
+        n_newest = max(keep_last if keep_last is not None else 1, 1)
+        keep.update(name for _, name in entries[-n_newest:])
+        if keep_every is not None and keep_every > 0:
+            keep.update(
+                name for gs, name in entries if gs % keep_every == 0
+            )
+        deleted = []
+        for _, name in entries:
+            if name in keep:
+                continue
+            path = os.path.join(root, name)
+            shutil.rmtree(path, ignore_errors=True)
+            deleted.append(path)
+        if deleted:
+            logger.info(
+                "retention GC deleted %d checkpoint(s) under %s "
+                "(keep_last=%s keep_every=%s, %d protected)",
+                len(deleted),
+                root,
+                keep_last,
+                keep_every,
+                len(protected),
+            )
+        return deleted
+
     def state_dict(self) -> dict:
-        return {"timer": self.timer.state_dict()}
+        return {
+            "timer": self.timer.state_dict(),
+            "last_save_path": self.last_save_path,
+        }
 
     def load_state_dict(self, s: dict):
         self.timer.load_state_dict(s.get("timer", {}))
+        self.last_save_path = s.get("last_save_path", self.last_save_path)
 
 
 class Evaluator:

@@ -32,6 +32,17 @@ class StatsLogger:
         self._jsonl = None
         self._tb = None
         self._wandb = None
+        # resume dedup floor: commits at or below it are replays of steps
+        # recorded before a crash and are skipped. ARMED ONLY on recovery
+        # (load_state_dict, called by RecoverHandler.load): a fresh run
+        # that happens to reuse an experiment/trial name must keep logging,
+        # not silently suppress every step the old file already has.
+        self.last_logged_step = -1
+        # highest step found in the existing jsonl at reopen (scan also
+        # truncates a torn tail regardless of recovery)
+        self._on_disk_step = -1
+        self._dedup_armed = False  # set by load_state_dict (recovery only)
+        self._warned_stale_logs = False
         if rank == 0:
             self._init_backends()
 
@@ -45,7 +56,9 @@ class StatsLogger:
 
     def _init_backends(self):
         os.makedirs(self.log_dir(), exist_ok=True)
-        self._jsonl = open(os.path.join(self.log_dir(), "stats.jsonl"), "a")
+        path = os.path.join(self.log_dir(), "stats.jsonl")
+        self._on_disk_step = self._repair_and_scan(path)
+        self._jsonl = open(path, "a")
         if self.config.tensorboard.path:
             try:
                 from torch.utils.tensorboard import SummaryWriter
@@ -78,6 +91,39 @@ class StatsLogger:
             except Exception:
                 logger.warning("wandb unavailable; skipping")
 
+    def _repair_and_scan(self, path: str) -> int:
+        """Reopen protocol for crash-consistent append: scan the existing
+        jsonl for the highest recorded global_step, and truncate a torn
+        trailing line (a crash mid-``write``) so the file stays valid
+        jsonl. Returns the last recorded step (-1 for a fresh file)."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return -1
+        last_step = -1
+        valid_end = 0
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break  # torn tail: crash mid-write
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn/garbled line: everything after is suspect
+                if isinstance(rec, dict) and "global_step" in rec:
+                    last_step = max(last_step, int(rec["global_step"]))
+                valid_end += len(line)
+        if valid_end < size:
+            logger.warning(
+                "truncating %d byte(s) of torn tail from %s (crash "
+                "mid-write)",
+                size - valid_end,
+                path,
+            )
+            with open(path, "r+b") as f:
+                f.truncate(valid_end)
+        return last_step
+
     def commit(
         self,
         epoch: int,
@@ -86,6 +132,32 @@ class StatsLogger:
         stats: dict[str, float] | list[dict[str, float]],
     ):
         if self.rank != 0:
+            return
+        if (
+            not self._dedup_armed
+            and self._on_disk_step >= 0
+            and not self._warned_stale_logs
+        ):
+            # a fresh (non-recovery) run appending over another run's
+            # jsonl: logging proceeds, but if THIS run later crashes and
+            # resumes, the dedup scan cannot tell the old run's records
+            # from this one's and will skip steps up to the old maximum —
+            # start fresh trials in a clean trial dir
+            self._warned_stale_logs = True
+            logger.warning(
+                "stats.jsonl already holds records up to global step %d "
+                "from a previous run of this trial name; a future resume "
+                "of THIS run would treat them as already-logged. Prefer a "
+                "clean trial dir for fresh runs.",
+                self._on_disk_step,
+            )
+        if global_step <= self.last_logged_step:
+            logger.info(
+                "skipping stats commit for global step %d: already "
+                "recorded before restart (last logged %d)",
+                global_step,
+                self.last_logged_step,
+            )
             return
         if isinstance(stats, list):
             merged: dict[str, Any] = {}
@@ -103,11 +175,28 @@ class StatsLogger:
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+        self.last_logged_step = max(self.last_logged_step, global_step)
         if self._tb is not None:
             for k, v in stats.items():
                 self._tb.add_scalar(k, v, global_step)
         if self._wandb is not None:
             self._wandb.log(stats, step=global_step)
+
+    def state_dict(self) -> dict:
+        return {
+            "last_logged_step": max(self.last_logged_step, self._on_disk_step)
+        }
+
+    def load_state_dict(self, s: dict):
+        # called on RECOVERY only (RecoverHandler.load): arm the dedup
+        # floor from whichever is further along — the on-disk scan (jsonl
+        # survived) or the RunState value (jsonl on ephemeral disk lost)
+        self._dedup_armed = True
+        self.last_logged_step = max(
+            self.last_logged_step,
+            self._on_disk_step,
+            int(s.get("last_logged_step", -1)),
+        )
 
     def close(self):
         if self._jsonl is not None:

@@ -1,0 +1,122 @@
+"""Hung-trainer watchdog: crash loudly instead of wedging silently.
+
+A preempted trainer dies and the launcher restarts it — but a WEDGED
+trainer (deadlocked collective, rollout wait against a dead fleet, stuck
+host callback) sits at 0% forever and no supervisor notices, which on a
+paid TPU slice is strictly worse than crashing. The watchdog inverts that:
+the training loop calls :meth:`Watchdog.beat` at every phase boundary
+(rollout wait, train step, weight update, checkpoint), and a daemon thread
+verifies the gap between beats never exceeds ``timeout_seconds``. On a
+miss it dumps EVERY thread's stack (the post-mortem for "where was it
+stuck") and exits with ``config.exit_code`` so the launcher's
+relaunch-with-backoff loop restarts the trial from the last recover dump.
+
+``clock``/``exit_fn`` are injectable so tests drive a fake clock and
+capture the exit instead of dying.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+from areal_tpu.api.cli_args import WatchdogConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("watchdog")
+
+
+def dump_all_stacks(file=None) -> str:
+    """Format every live thread's stack (the hang post-mortem). Returns the
+    text; also writes it to ``file`` when given."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in frames.items():
+        parts.append(
+            f"--- thread {names.get(ident, '?')} (ident {ident}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    text = "\n".join(parts)
+    if file is not None:
+        file.write(text)
+        file.flush()
+    return text
+
+
+class Watchdog:
+    """Heartbeat monitor around the training loop's phase boundaries."""
+
+    def __init__(
+        self,
+        config: WatchdogConfig,
+        clock=time.monotonic,
+        exit_fn=None,
+    ):
+        self.config = config
+        self._clock = clock
+        # os._exit, not sys.exit: the whole point is that ordinary control
+        # flow is stuck — atexit handlers or a blocked main thread must not
+        # be able to swallow the exit
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self._lock = threading.Lock()
+        self._last_beat: float = clock()  # guarded_by: _lock
+        self._last_phase: str = "startup"  # guarded_by: _lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.fired = False
+
+    def start(self) -> "Watchdog":
+        if not self.config.enabled:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def beat(self, phase: str) -> None:
+        """Mark liveness at a phase boundary. Cheap (one lock, no I/O) —
+        call it freely; the phase name appears in the hang report."""
+        with self._lock:
+            self._last_beat = self._clock()
+            self._last_phase = phase
+
+    def check(self) -> bool:
+        """One poll: fire if the heartbeat gap exceeded the timeout.
+        Exposed for tests and for loops that poll explicitly."""
+        with self._lock:
+            gap = self._clock() - self._last_beat
+            phase = self._last_phase
+        if gap <= self.config.timeout_seconds:
+            return False
+        self.fired = True
+        report = dump_all_stacks()
+        logger.error(
+            "watchdog: no heartbeat for %.0fs (last phase %r, timeout "
+            "%.0fs); trainer is wedged — dumping stacks and exiting %d "
+            "so the launcher restarts from the last recover dump\n%s",
+            gap,
+            phase,
+            self.config.timeout_seconds,
+            self.config.exit_code,
+            report,
+        )
+        # stderr too: the logger may itself be part of what is stuck
+        print(report, file=sys.stderr, flush=True)
+        self._exit_fn(self.config.exit_code)
+        return True  # only reachable with an injected exit_fn
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.poll_interval_seconds):
+            if self.check():
+                return

@@ -34,16 +34,24 @@ from areal_tpu.api.io_struct import (  # noqa: E402
     WeightUpdateMeta,
 )
 from areal_tpu.core.remote_inf_engine import RemoteInfEngine  # noqa: E402
+from areal_tpu.core.workflow_executor import RolloutWaitInterrupted  # noqa: E402
 from areal_tpu.dataset import get_custom_dataset  # noqa: E402
 from areal_tpu.engine.ppo.actor import PPOActor, TPUPPOActor  # noqa: E402
 from areal_tpu.engine.train_engine import TPUTrainEngine  # noqa: E402
 from areal_tpu.reward import math_verify_reward  # noqa: E402
 from areal_tpu.utils import logging, stats_tracker  # noqa: E402
+from areal_tpu.utils.chaos import crash_point  # noqa: E402
 from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
 from areal_tpu.utils.profiling import StepProfiler  # noqa: E402
-from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
+from areal_tpu.utils.recover import (  # noqa: E402
+    PREEMPTION_EXIT_CODE,
+    PreemptionGuard,
+    RecoverHandler,
+    check_if_recover,
+)
 from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+from areal_tpu.utils.watchdog import Watchdog  # noqa: E402
 from areal_tpu.workflow.rlvr import RLVRWorkflow  # noqa: E402
 
 logger = logging.getLogger("gsm8k_grpo")
@@ -124,6 +132,23 @@ def main(argv=None):
     recover_handler = RecoverHandler(cfg.recover, ft_spec)
     stats_logger = StatsLogger(cfg.stats_logger, ft_spec)
 
+    # preemption plane: SIGTERM arms the guard; the loop below notices at
+    # the next step boundary and drains + checkpoints within the grace
+    # budget. The watchdog is the inverse guard: a trainer that STOPS
+    # beating (wedged collective, hung rollout wait) dumps stacks and exits
+    # nonzero so the launcher restarts it from the last recover dump.
+    guard = PreemptionGuard(cfg.recover.grace_period_seconds).install()
+    watchdog = Watchdog(cfg.watchdog).start()
+    # a SIGTERM mid-rollout-wait must interrupt the wait (it dominates
+    # wall-clock) instead of burning the grace budget until the next step
+    rollout.executor.interrupt_check = guard.should_stop
+
+    recover_kwargs = dict(
+        fileroot=cfg.cluster.fileroot,
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+    )
+
     start_step = 0
     if check_if_recover(cfg.recover):
         info = recover_handler.load(
@@ -131,14 +156,27 @@ def main(argv=None):
             saver,
             evaluator,
             dataloader,
-            fileroot=cfg.cluster.fileroot,
-            experiment_name=cfg.experiment_name,
-            trial_name=cfg.trial_name,
+            stats_logger,
             config=cfg,
+            rollout=rollout,
+            **recover_kwargs,
         )
         if info is not None:
             start_step = info.last_step_info.global_step + 1
-            actor.update_weights(weight_meta)  # re-push recovered weights
+            # re-sync the inference plane BEFORE the first resumed rollout:
+            # servers may be fresh restarts (version 0) or hold updates the
+            # recovered trainer rolled back past. Write the recovered
+            # weights to the fan-out path and re-push to every server whose
+            # version mismatches (reusing the version-checked rejoin probe's
+            # machinery); no resumed rollout is accepted before this.
+            actor.set_version(info.weight_version)
+            if cfg.weight_update == "disk":
+                actor.upload_weights(weight_meta)
+                rollout.reconcile_after_recover(
+                    weight_meta, info.weight_version
+                )
+            else:
+                actor.update_weights(weight_meta)  # full re-push
 
     profiler = StepProfiler(cfg.profiler)
     all_rewards = []
@@ -151,17 +189,56 @@ def main(argv=None):
                 steps_per_epoch=ft_spec.steps_per_epoch,
             )
 
+            def graceful_exit():
+                # SIGTERM/preemption notice: pause -> drain in-flight
+                # rollouts -> forced dump at the last COMPLETED step, then
+                # exit nonzero so the launcher relaunches into a resume.
+                # With no step completed in THIS process there is nothing
+                # new to dump — the previous dump (if any) is still valid.
+                if global_step > start_step:
+                    last = StepInfo(
+                        epoch=(global_step - 1) // ft_spec.steps_per_epoch,
+                        epoch_step=(global_step - 1) % ft_spec.steps_per_epoch,
+                        global_step=global_step - 1,
+                        steps_per_epoch=ft_spec.steps_per_epoch,
+                    )
+                    recover_handler.graceful_shutdown(
+                        actor,
+                        last,
+                        saver,
+                        evaluator,
+                        dataloader,
+                        stats_logger,
+                        tokenizer=tokenizer,
+                        config=cfg,
+                        rollout=rollout,
+                        guard=guard,
+                        **recover_kwargs,
+                    )
+                logger.warning(
+                    "preemption checkpoint written; exiting %d",
+                    PREEMPTION_EXIT_CODE,
+                )
+                sys.exit(PREEMPTION_EXIT_CODE)
+
+            if guard.should_stop():
+                graceful_exit()
+
+            watchdog.beat("rollout")
             profiler_cm = profiler.step(global_step)
             profiler_cm.__enter__()
             # profiler.close() in the finally below finalizes the trace if any
             # step raises mid-window
             with stats_tracker.record_timing("rollout"):
-                if cfg.async_training:
-                    batch = rollout.prepare_batch(dataloader, workflow=workflow)
-                else:
-                    batch = rollout.rollout_batch(
-                        next(iter(dataloader)), workflow=workflow
-                    )
+                try:
+                    if cfg.async_training:
+                        batch = rollout.prepare_batch(dataloader, workflow=workflow)
+                    else:
+                        batch = rollout.rollout_batch(
+                            next(iter(dataloader)), workflow=workflow
+                        )
+                except RolloutWaitInterrupted:
+                    graceful_exit()
 
             if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
                 with stats_tracker.record_timing("recompute_logp"):
@@ -174,17 +251,42 @@ def main(argv=None):
             with stats_tracker.record_timing("compute_advantage"):
                 actor.actor.compute_advantages(batch)
 
+            watchdog.beat("train_step")
             with stats_tracker.record_timing("train_step"):
                 stats = actor.actor.ppo_update(batch)
                 actor.step_lr_scheduler()
+            crash_point("post-train-step")
 
+            watchdog.beat("update_weights")
             with stats_tracker.record_timing("update_weights"):
                 rollout.pause()
                 actor.update_weights(weight_meta)
                 rollout.resume()
 
+            mean_reward = float(np.mean(np.asarray(batch["rewards"])))
+            all_rewards.append(mean_reward)
+            stats[0].update(stats_tracker.export(key="time_perf"))
+            stats[0]["grpo/mean_task_reward"] = mean_reward
+            # commit BEFORE the recover dump: a kill after the dump's
+            # marker flips but before the commit would resume at the next
+            # step and lose this step's stats row forever; committing
+            # first is safe because the resume dedup (the jsonl scan) skips
+            # the replayed commit if the dump never lands. Accepted
+            # tradeoff: the save/dump timing below is exported one step
+            # late (and the last step's is dropped) — crash-exactness of
+            # the row beats perfectly attributed checkpoint timing
+            stats_logger.commit(
+                step_info.epoch, step_info.epoch_step, global_step, stats
+            )
+
+            watchdog.beat("save")
             with stats_tracker.record_timing("save"):
-                saver.save(actor, step_info, tokenizer=tokenizer)
+                saver.save(
+                    actor,
+                    step_info,
+                    tokenizer=tokenizer,
+                    protect=recover_handler.protected_paths(**recover_kwargs),
+                )
                 recover_handler.dump(
                     actor,
                     step_info,
@@ -192,24 +294,18 @@ def main(argv=None):
                     evaluator,
                     dataloader,
                     stats_logger,
-                    fileroot=cfg.cluster.fileroot,
-                    experiment_name=cfg.experiment_name,
-                    trial_name=cfg.trial_name,
                     tokenizer=tokenizer,
                     config=cfg,
+                    rollout=rollout,
+                    **recover_kwargs,
                 )
 
             profiler_cm.__exit__(None, None, None)
-            mean_reward = float(np.mean(np.asarray(batch["rewards"])))
-            all_rewards.append(mean_reward)
-            stats[0].update(stats_tracker.export(key="time_perf"))
-            stats[0]["grpo/mean_task_reward"] = mean_reward
-            stats_logger.commit(
-                step_info.epoch, step_info.epoch_step, global_step, stats
-            )
     finally:
         # finalize any in-flight profiler trace even when a step dies
         profiler.close()
+        watchdog.stop()
+        guard.uninstall()
 
     # artifact the e2e test asserts on (reference tests/grpo pattern)
     out = os.path.join(stats_logger.log_dir(), "rewards.json")

@@ -1,0 +1,42 @@
+"""True negatives for crash-unsafe-write: reads, atomic writers,
+inline write-then-rename, and writes outside the recovery state tree."""
+
+import json
+import os
+
+
+def load_recover_info(root):
+    # read-mode opens on recovery paths are fine
+    with open(os.path.join(root, "recover_info.json")) as f:
+        return json.load(f)
+
+
+def atomic_write_info(recover_path, payload):
+    # the atomic helper itself: tmp + rename, exempt by function name
+    with open(recover_path + ".tmp", "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(recover_path + ".tmp", recover_path)
+
+
+def update_latest_pointer(checkpoint_root, name):
+    # inline write-then-rename: the function also calls os.replace
+    with open(os.path.join(checkpoint_root, "latest.tmp"), "w") as f:
+        f.write(name)
+    os.replace(
+        os.path.join(checkpoint_root, "latest.tmp"),
+        os.path.join(checkpoint_root, "latest"),
+    )
+
+
+def write_scratch(tmpdir):
+    # write mode, but nowhere near recovery state
+    with open(os.path.join(tmpdir, "scratch.txt"), "w") as f:
+        f.write("hello")
+
+
+def append_checkpoint_log(checkpoint_root):
+    # append-only logs use scan-and-truncate on reopen, not rename
+    with open(os.path.join(checkpoint_root, "events.log"), "a") as f:
+        f.write("saved\n")

@@ -1,0 +1,29 @@
+"""True positives for crash-unsafe-write: direct write-mode opens on
+recover/checkpoint state paths, no write-then-rename in sight."""
+
+import json
+import os
+import pickle
+
+
+def dump_recover_info(root, info):
+    # the commit marker written non-atomically: a crash mid-json.dump
+    # leaves a truncated file the next resume chokes on
+    with open(os.path.join(root, "recover_info.json"), "w") as f:  # lint-expect: crash-unsafe-write
+        json.dump(info, f)
+
+
+def dump_loop_state(checkpoint_dir, state):
+    f = open(checkpoint_dir + "/loop_state.pkl", "wb")  # lint-expect: crash-unsafe-write
+    pickle.dump(state, f)
+    f.close()
+
+
+def write_marker(ckpt_path):
+    with open(ckpt_path, mode="w") as f:  # lint-expect: crash-unsafe-write
+        f.write("done")
+
+
+def exclusive_create(recover_root):
+    with open(os.path.join(recover_root, "lock"), "x") as f:  # lint-expect: crash-unsafe-write
+        f.write("pid")

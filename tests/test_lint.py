@@ -242,7 +242,7 @@ def test_cli_select_and_list_rules():
 
 def test_per_path_ignores_config():
     ignores = framework.load_per_path_ignores(REPO_ROOT)
-    assert ignores.get("tests/") == {"jit-per-call"}
+    assert ignores.get("tests/") == {"jit-per-call", "crash-unsafe-write"}
     keep = framework.Finding("jit-per-call", "areal_tpu/x.py", 1, 0, "m")
     drop = framework.Finding("jit-per-call", "tests/t.py", 1, 0, "m")
     other = framework.Finding("jit-in-loop", "tests/t.py", 1, 0, "m")

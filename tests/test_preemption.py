@@ -1,0 +1,893 @@
+"""Preemption-safe training: deterministic crash-point chaos tests.
+
+The contract under test: a trainer killed at ANY point — pre-rollout-wait,
+post-train-step, pre-weight-update, or mid-checkpoint — resumes
+step-exactly. "Step-exactly" is pinned three ways against an uninterrupted
+reference run: the committed stats.jsonl records every global step exactly
+once; every resumed step consumes the SAME batch the uninterrupted run
+consumed at that step; and the final train state is identical. Staleness
+counters must balance (submitted == accepted + rejected + running) through
+the kill/resume cycle.
+
+All in-process: the kill is :class:`InjectedCrash` raised at an
+``AREAL_CRASH_AT`` barrier (the same barriers the real trainer loop runs
+through), and "process death" is executor destroy + fresh objects over the
+same fileroot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    CircuitBreakerConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    RecoverConfig,
+    SaverConfig,
+    StatsLoggerConfig,
+    WatchdogConfig,
+)
+from areal_tpu.api.io_struct import (
+    ModelRequest,
+    SaveLoadMeta,
+    StepInfo,
+    TimedResult,
+    WeightUpdateMeta,
+)
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.core.workflow_executor import WorkflowExecutor
+from areal_tpu.utils import chaos
+from areal_tpu.utils.chaos import InjectedCrash, crash_point
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.recover import (
+    PreemptionGuard,
+    RecoverHandler,
+    RunState,
+)
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+from areal_tpu.utils.watchdog import Watchdog
+
+# ---------------------------------------------------------------------------
+# harness pieces
+# ---------------------------------------------------------------------------
+
+
+class FakeInfEngine:
+    def __init__(self):
+        self.version = 0
+
+    def get_version(self):
+        return self.version
+
+    def set_version(self, v):
+        self.version = v
+
+
+class EchoWorkflow(RolloutWorkflow):
+    """1-row trajectory tagged with the submitted value (and its weight
+    version, so re-admission staleness decisions are exercised)."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+
+    async def arun_episode(self, engine, data):
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        v = int(data["x"])
+        return dict(
+            input_ids=np.full((1, 4), v, dtype=np.int32),
+            attention_mask=np.ones((1, 4), dtype=np.int32),
+            versions=np.full((1, 4), engine.get_version(), dtype=np.int32),
+        )
+
+
+class ToyEngine:
+    """Deterministic 'training': state is one integer folded from every
+    consumed batch. save/load via a json file, so a recover roundtrip can
+    prove bit-identical resume without a real model."""
+
+    def __init__(self):
+        self.weight = 0
+
+    def train(self, values):
+        self.weight = self.weight * 31 + sum(values)
+
+    def save(self, meta: SaveLoadMeta):
+        os.makedirs(meta.path, exist_ok=True)
+        with open(os.path.join(meta.path, "state.json"), "w") as f:
+            json.dump({"weight": self.weight}, f)
+
+    def load(self, meta: SaveLoadMeta):
+        with open(os.path.join(meta.path, "state.json")) as f:
+            self.weight = json.load(f)["weight"]
+
+
+class RolloutShim:
+    """The trainer-side rollout handle: version + executor, like
+    RemoteInfEngine from the recover plumbing's point of view."""
+
+    def __init__(self, inf_engine, executor):
+        self._inf = inf_engine
+        self.executor = executor
+
+    def get_version(self):
+        return self._inf.version
+
+    def set_version(self, v):
+        self._inf.set_version(v)
+
+    def pause(self):
+        self.executor.pause()
+
+
+DATASET = list(range(24))
+BATCH = 4
+STEPS = 5
+STEPS_PER_EPOCH = len(DATASET) // BATCH
+
+
+class MiniTrainer:
+    """In-process trainer mirroring examples/gsm8k_grpo.py's step anatomy:
+    rollout -> train -> weight update -> save + recover dump -> stats
+    commit, with the four AREAL_CRASH_AT barriers at the same places."""
+
+    def __init__(self, fileroot: str):
+        self.fileroot = str(fileroot)
+        self.dataloader = StatefulDataLoader(DATASET, BATCH, shuffle=True, seed=3)
+        self.inf = FakeInfEngine()
+        cfg = InferenceEngineConfig(
+            max_concurrent_rollouts=8,
+            consumer_batch_size=BATCH,
+            max_head_offpolicyness=1000,
+        )
+        self.executor = WorkflowExecutor(cfg, self.inf)
+        self.executor.initialize()
+        self.rollout = RolloutShim(self.inf, self.executor)
+        self.engine = ToyEngine()
+        self.saver = Saver(
+            SaverConfig(
+                freq_steps=1,
+                experiment_name="e",
+                trial_name="t",
+                fileroot=self.fileroot,
+            ),
+            None,
+        )
+        self.recover = RecoverHandler(
+            RecoverConfig(mode="fault", freq_steps=1, drain_timeout_seconds=5.0),
+            None,
+        )
+        self.stats = StatsLogger(
+            StatsLoggerConfig(
+                experiment_name="e", trial_name="t", fileroot=self.fileroot
+            ),
+            rank=0,
+        )
+        self.trace: list[tuple[int, tuple, int]] = []
+        self.start_step = 0
+
+    def _paths(self):
+        return dict(
+            fileroot=self.fileroot, experiment_name="e", trial_name="t"
+        )
+
+    def resume(self) -> RunState | None:
+        info = self.recover.load(
+            self.engine,
+            self.saver,
+            None,
+            self.dataloader,
+            self.stats,
+            rollout=self.rollout,
+            **self._paths(),
+        )
+        if info is not None:
+            self.start_step = info.last_step_info.global_step + 1
+        return info
+
+    def run(self, until: int = STEPS, guard: PreemptionGuard | None = None):
+        it = iter(self.dataloader)
+        for global_step in range(self.start_step, until):
+            if guard is not None and guard.should_stop():
+                self.graceful_exit(global_step, guard)
+                return
+            step_info = StepInfo(
+                epoch=global_step // STEPS_PER_EPOCH,
+                epoch_step=global_step % STEPS_PER_EPOCH,
+                global_step=global_step,
+                steps_per_epoch=STEPS_PER_EPOCH,
+            )
+            try:
+                items = next(it)
+            except StopIteration:
+                it = iter(self.dataloader)
+                items = next(it)
+            # barrier 1 lives inside executor.wait (product code)
+            batch = self.executor.rollout_batch(
+                [{"x": v} for v in items], workflow=EchoWorkflow()
+            )
+            vals = tuple(sorted(batch["input_ids"][:, 0].tolist()))
+            self.engine.train(vals)
+            crash_point("post-train-step")
+            crash_point("pre-weight-update")
+            self.inf.version += 1  # the weight-update fan-out
+            # commit BEFORE the dump (mirrors the example loop): a kill
+            # after the dump marker but before the commit would lose the
+            # step's stats row; the replayed commit after a pre-marker
+            # kill is deduped by the resume scan instead
+            self.stats.commit(
+                step_info.epoch,
+                step_info.epoch_step,
+                global_step,
+                {"weight": float(self.engine.weight)},
+            )
+            self.saver.save(
+                self.engine,
+                step_info,
+                protect=self.recover.protected_paths(**self._paths()),
+            )
+            # barrier 4 (mid-checkpoint) lives inside dump (product code)
+            self.recover.dump(
+                self.engine,
+                step_info,
+                self.saver,
+                None,
+                self.dataloader,
+                self.stats,
+                rollout=self.rollout,
+                **self._paths(),
+            )
+            self.trace.append((global_step, vals, self.engine.weight))
+            self.start_step = global_step + 1
+
+    def graceful_exit(self, global_step: int, guard: PreemptionGuard):
+        """The SIGTERM path: drain + forced dump at the LAST COMPLETED
+        step (this step has not run yet)."""
+        last = max(global_step - 1, 0)
+        step_info = StepInfo(
+            epoch=last // STEPS_PER_EPOCH,
+            epoch_step=last % STEPS_PER_EPOCH,
+            global_step=last,
+            steps_per_epoch=STEPS_PER_EPOCH,
+        )
+        self.recover.graceful_shutdown(
+            self.engine,
+            step_info,
+            self.saver,
+            None,
+            self.dataloader,
+            self.stats,
+            rollout=self.rollout,
+            guard=guard,
+            checkpoint_reserve_seconds=0.0,
+            **self._paths(),
+        )
+
+    def counters(self):
+        return self.executor.staleness_manager.get_stats()
+
+    def destroy(self):
+        self.executor.destroy()
+        self.stats.close()
+
+    def stats_steps(self) -> list[int]:
+        path = os.path.join(self.fileroot, "e", "t", "logs", "stats.jsonl")
+        with open(path) as f:
+            return [json.loads(line)["global_step"] for line in f]
+
+
+def _assert_counters_balanced(trainer: MiniTrainer):
+    s = trainer.counters()
+    assert s.submitted == s.accepted + s.rejected + s.running, vars(s)
+
+
+def _run_reference(tmp_path):
+    t = MiniTrainer(tmp_path / "ref")
+    try:
+        t.run()
+        _assert_counters_balanced(t)
+        return list(t.trace), t.stats_steps()
+    finally:
+        t.destroy()
+
+
+# ---------------------------------------------------------------------------
+# kill-at-step resume tests: the 4 barriers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_env(monkeypatch):
+    monkeypatch.delenv(chaos.CRASH_ENV, raising=False)
+    chaos.reset_crash_points()
+    yield
+    chaos.reset_crash_points()
+
+
+@pytest.mark.parametrize(
+    "point",
+    ["pre-rollout-wait", "post-train-step", "pre-weight-update", "mid-checkpoint"],
+)
+def test_kill_at_barrier_resumes_step_exactly(tmp_path, monkeypatch, point):
+    ref_trace, ref_steps = _run_reference(tmp_path)
+    assert ref_steps == list(range(STEPS))
+
+    # arm the barrier to fire on its 3rd arrival => the kill lands in
+    # global step 2, with steps 0-1 fully committed
+    monkeypatch.setenv(chaos.CRASH_ENV, f"{point}@3")
+    chaos.reset_crash_points()
+    crashed = MiniTrainer(tmp_path / "run")
+    with pytest.raises(InjectedCrash):
+        crashed.run()
+    crashed.destroy()  # the 'process' dies; counters die with it
+
+    monkeypatch.delenv(chaos.CRASH_ENV)
+    chaos.reset_crash_points()
+    resumed = MiniTrainer(tmp_path / "run")
+    try:
+        info = resumed.resume()
+        assert info is not None
+        # mid-checkpoint crashed BEFORE committing step 2's dump, the other
+        # barriers before even reaching it: all resume after step 1
+        start = resumed.start_step
+        assert start == 2
+        resumed.run()
+        # same step sequence and same per-step batches as uninterrupted
+        assert resumed.trace == ref_trace[start:]
+        # identical final train state
+        assert resumed.trace[-1][2] == ref_trace[-1][2]
+        # stats.jsonl: every step exactly once across both processes
+        assert resumed.stats_steps() == list(range(STEPS))
+        _assert_counters_balanced(resumed)
+        s = resumed.counters()
+        assert s.running == 0
+    finally:
+        resumed.destroy()
+
+
+def test_resume_counters_carry_across_restart(tmp_path):
+    """The restored staleness counters are the dumped ones (running
+    rebalanced into rejected), not zeros."""
+    t = MiniTrainer(tmp_path)
+    t.run(until=2)
+    dumped = t.counters()
+    assert dumped.submitted == 2 * BATCH
+    t.destroy()
+
+    t2 = MiniTrainer(tmp_path)
+    try:
+        assert t2.resume() is not None
+        s = t2.counters()
+        assert s.submitted == dumped.submitted
+        assert s.accepted + s.rejected == dumped.accepted + dumped.rejected
+        assert s.running == 0
+        t2.run()
+        _assert_counters_balanced(t2)
+    finally:
+        t2.destroy()
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain path
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_guard_signal_and_grace_clock():
+    clock = [0.0]
+    g = PreemptionGuard(grace_period_seconds=30.0, clock=lambda: clock[0])
+    assert not g.should_stop()
+    assert g.remaining() == float("inf")
+    g.install()
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert g.should_stop()
+    finally:
+        g.uninstall()
+    clock[0] = 12.0
+    assert g.remaining() == pytest.approx(18.0)
+    g.trigger()  # idempotent: deadline does not restart
+    assert g.remaining() == pytest.approx(18.0)
+
+
+def test_sigterm_drain_checkpoints_and_resumes_with_drained_rollouts(tmp_path):
+    t = MiniTrainer(tmp_path)
+    t.run(until=2)
+    # in-flight work at preemption time: a full batch submitted but not
+    # yet consumed by wait()
+    for v in (90, 91, 92, 93):
+        t.executor.submit({"x": v}, workflow=EchoWorkflow())
+    deadline = time.monotonic() + 5
+    while t.counters().accepted < 12 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert t.counters().accepted == 12
+    guard = PreemptionGuard(grace_period_seconds=30.0)
+    guard.trigger()
+    t.run(guard=guard)  # next step notices the flag and drains
+    _assert_counters_balanced(t)
+    t.destroy()
+
+    t2 = MiniTrainer(tmp_path)
+    try:
+        info = t2.resume()
+        assert info is not None
+        # the drained rollouts were persisted and re-admitted: they are
+        # consumable WITHOUT submitting anything new
+        out = t2.executor.wait(4, timeout=2)
+        assert sorted(out["input_ids"][:, 0].tolist()) == [90, 91, 92, 93]
+        _assert_counters_balanced(t2)
+        s = t2.counters()
+        assert s.running == 0
+    finally:
+        t2.destroy()
+
+
+def test_graceful_shutdown_keeps_generation_servers_live(tmp_path):
+    """graceful_shutdown must NOT fan out a server-side pause: paused
+    servers abort in-flight generations, so the drain would salvage
+    nothing and burn its whole budget. Only the executor pauses (inside
+    drain), gating new launches."""
+    t = MiniTrainer(tmp_path)
+    t.run(until=1)
+    pause_calls = []
+    t.rollout.pause = lambda: pause_calls.append("server-pause")
+    guard = PreemptionGuard(grace_period_seconds=30.0)
+    guard.trigger()
+    t.run(guard=guard)
+    assert pause_calls == []  # no rollout.pause() fan-out
+    assert t.executor.paused.is_set()  # drain's executor-side gate
+    t.destroy()
+
+
+def test_pause_drain_destroy_leaves_no_leaks_and_balanced_counters():
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=8,
+        consumer_batch_size=4,
+        max_head_offpolicyness=100,
+    )
+    ex = WorkflowExecutor(cfg, FakeInfEngine())
+    ex.initialize()
+    for i in range(6):
+        ex.submit({"x": i}, workflow=EchoWorkflow(delay=0.05))
+    deadline = time.monotonic() + 5
+    while (
+        ex.staleness_manager.get_stats().submitted < 6
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    drained = ex.drain(timeout=10.0)
+    assert len(drained) == 6
+    assert [int(np.asarray(r.data["input_ids"])[0, 0]) for r in drained] == list(
+        range(6)
+    )  # oldest first
+    s = ex.staleness_manager.get_stats()
+    assert s.running == 0
+    assert s.submitted == s.accepted + s.rejected == 6
+    ex.destroy()
+    assert not ex.rollout_thread.is_alive()
+    assert ex.tasks_leaked_at_exit == 0
+
+
+def test_drain_timeout_hands_stragglers_to_destroy():
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=8,
+        consumer_batch_size=4,
+        max_head_offpolicyness=100,
+    )
+    ex = WorkflowExecutor(cfg, FakeInfEngine())
+    ex.initialize()
+    for i in range(2):
+        ex.submit({"x": i}, workflow=EchoWorkflow(delay=60.0))
+    deadline = time.monotonic() + 5
+    while ex.staleness_manager.get_stats().running < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    drained = ex.drain(timeout=0.2)
+    assert drained == []
+    ex.destroy()  # cancels the stragglers and rebalances them as rejected
+    s = ex.staleness_manager.get_stats()
+    assert s.running == 0
+    assert s.submitted == s.accepted + s.rejected == 2
+    assert ex.tasks_leaked_at_exit == 0
+
+
+def test_sigterm_mid_rollout_wait_interrupts_promptly():
+    """A preemption notice during a long rollout wait must surface within
+    one poll tick, not after the wait finishes — the wait dominates
+    wall-clock and the grace budget is small."""
+    from areal_tpu.core.workflow_executor import RolloutWaitInterrupted
+
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4,
+        consumer_batch_size=4,
+        max_head_offpolicyness=100,
+    )
+    ex = WorkflowExecutor(cfg, FakeInfEngine())
+    ex.initialize()
+    guard = PreemptionGuard(grace_period_seconds=30.0)
+    ex.interrupt_check = guard.should_stop
+    try:
+        ex.submit({"x": 0}, workflow=EchoWorkflow(delay=60.0))  # never finishes
+        threading.Timer(0.2, guard.trigger).start()
+        t0 = time.monotonic()
+        with pytest.raises(RolloutWaitInterrupted):
+            ex.wait(1, timeout=30)
+        assert time.monotonic() - t0 < 5.0  # interrupted, not timed out
+    finally:
+        ex.destroy()
+    s = ex.staleness_manager.get_stats()
+    assert s.submitted == s.accepted + s.rejected + s.running
+
+
+def test_persisted_counters_exclude_unconsumed_straggler_results():
+    """A trajectory that completes after drain() returned (straggler
+    finishing during the engine checkpoint) is counted accepted by the
+    LIVE manager but is not persisted — the dumped counters must count it
+    lost, or resume capacity shrinks by a phantom every preemption."""
+    from areal_tpu.utils.recover import _counters_as_if_crashed_now
+
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4,
+        consumer_batch_size=4,
+        max_head_offpolicyness=0,
+    )
+    ex = WorkflowExecutor(cfg, FakeInfEngine())
+    ex.initialize()
+    try:
+        for _ in range(3):
+            ex.staleness_manager.on_rollout_submitted()
+            ex.staleness_manager.on_rollout_accepted()
+        # one completed result still sitting in the output queue, NOT drained
+        ex.output_queue.put_nowait(
+            TimedResult(t=1, data={"input_ids": np.zeros((1, 2))})
+        )
+        d = _counters_as_if_crashed_now(ex.staleness_manager, ex)
+        assert d == {"submitted": 3, "accepted": 2, "rejected": 1, "running": 0}
+        # live manager untouched
+        assert ex.staleness_manager.get_stats().accepted == 3
+    finally:
+        ex.destroy()
+
+
+def test_readmit_drained_discards_stale_by_version():
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4,
+        consumer_batch_size=4,
+        max_head_offpolicyness=1,
+    )
+    ex = WorkflowExecutor(cfg, FakeInfEngine())
+    ex.initialize()
+    try:
+        # as if restored from a dump where both were accepted
+        ex.staleness_manager.load_state_dict(
+            {"submitted": 2, "accepted": 2, "rejected": 0, "running": 0}
+        )
+        fresh = TimedResult(
+            t=1, data={"input_ids": np.zeros((1, 2)), "versions": np.full((1, 2), 3)}
+        )
+        stale = TimedResult(
+            t=2, data={"input_ids": np.zeros((1, 2)), "versions": np.full((1, 2), 0)}
+        )
+        readmitted, discarded = ex.readmit_drained([fresh, stale], current_version=3)
+        assert (readmitted, discarded) == (1, 1)
+        assert len(ex.result_cache) == 1
+        s = ex.staleness_manager.get_stats()
+        assert (s.submitted, s.accepted, s.rejected, s.running) == (2, 1, 1, 0)
+    finally:
+        ex.destroy()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_on_missed_heartbeat_with_stack_dump(capsys):
+    clock = [0.0]
+    exits: list[int] = []
+    wd = Watchdog(
+        WatchdogConfig(enabled=True, timeout_seconds=100.0, exit_code=43),
+        clock=lambda: clock[0],
+        exit_fn=exits.append,
+    )
+    wd.beat("train_step")
+    clock[0] = 50.0
+    assert not wd.check()
+    wd.beat("rollout_wait")
+    clock[0] = 149.0  # 99s gap: still fine
+    assert not wd.check()
+    clock[0] = 200.0  # 150s gap: wedged
+    assert wd.check()
+    assert wd.fired and exits == [43]
+    # the post-mortem names the thread(s) it dumped
+    assert "--- thread" in capsys.readouterr().err
+
+
+def test_watchdog_disabled_never_starts():
+    wd = Watchdog(WatchdogConfig(enabled=False))
+    wd.start()
+    assert wd._thread is None
+    wd.stop()
+
+
+def test_watchdog_thread_loop_fires(monkeypatch):
+    clock = [0.0]
+    fired = threading.Event()
+    wd = Watchdog(
+        WatchdogConfig(
+            enabled=True, timeout_seconds=0.01, poll_interval_seconds=0.01
+        ),
+        exit_fn=lambda code: fired.set(),
+    )
+    wd.start()
+    try:
+        assert fired.wait(timeout=5)
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# resume reconciliation: stale inference servers get weights re-pushed
+# BEFORE the first resumed rollout
+# ---------------------------------------------------------------------------
+
+
+class _FakeCM:
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    async def __aenter__(self):
+        if isinstance(self._outcome, BaseException):
+            raise self._outcome
+        return self._outcome
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class FakeResponse:
+    def __init__(self, status=200, json_data=None):
+        self.status = status
+        self._json = json_data if json_data is not None else {}
+        self.headers = {}
+
+    async def json(self):
+        return self._json
+
+    async def text(self):
+        return ""
+
+
+class FakeSession:
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls: list[tuple[str, str, dict | None]] = []
+        self.closed = False
+
+    def request(self, method, url, json=None, data=None, timeout=None):
+        self.calls.append((method, url, json))
+        return _FakeCM(self.handler(method, url, json))
+
+    def get(self, url, timeout=None):
+        self.calls.append(("GET", url, None))
+        return _FakeCM(self.handler("GET", url, None))
+
+    async def close(self):
+        self.closed = True
+
+
+def _make_remote_engine(addrs, session, **cfg_kwargs) -> RemoteInfEngine:
+    cfg_kwargs.setdefault("experiment_name", "prem")
+    cfg_kwargs.setdefault("trial_name", "t")
+    cfg_kwargs.setdefault("request_retries", 1)
+    cfg_kwargs.setdefault("breaker", CircuitBreakerConfig(failure_threshold=1))
+    eng = RemoteInfEngine(InferenceEngineConfig(**cfg_kwargs))
+    eng.addresses = list(addrs)
+
+    async def _fake_get_session():
+        return session
+
+    eng._get_session = _fake_get_session
+    eng._new_session = lambda: session
+    eng._ensure_probe_task = lambda: None
+    return eng
+
+
+def _reconcile_handler(server_versions: dict, unreachable=()):
+    def handler(method, url, payload):
+        addr = url.split("//")[1].split("/")[0]
+        if addr in unreachable:
+            return ConnectionError(f"{addr} down")
+        if "/model_info" in url:
+            return FakeResponse(
+                json_data={"weight_version": server_versions[addr]}
+            )
+        if "/update_weights_from_disk" in url:
+            server_versions[addr] = payload["version"]
+            return FakeResponse(json_data={"ok": True})
+        if "/generate" in url:
+            return FakeResponse(
+                json_data={
+                    "output_tokens": [7],
+                    "output_logprobs": [-0.1],
+                    "output_versions": [server_versions[addr]],
+                    "stop_reason": "stop",
+                    "itl": [],
+                }
+            )
+        return FakeResponse(status=404)
+
+    return handler
+
+
+def test_restart_repushes_weights_to_stale_servers_before_first_rollout(tmp_path):
+    versions = {"a:1": 3, "b:1": 5}  # a missed updates while we were down
+    session = FakeSession(_reconcile_handler(versions))
+    eng = _make_remote_engine(["a:1", "b:1"], session)
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+
+    repushed = eng.reconcile_after_recover(meta, version=5)
+    assert repushed == ["a:1"]
+    assert versions == {"a:1": 5, "b:1": 5}
+    assert eng.get_version() == 5
+
+    # first resumed rollout happens strictly AFTER the re-push
+    req = ModelRequest(
+        rid="r0",
+        input_ids=[1, 2],
+        gconfig=GenerationHyperparameters(max_new_tokens=1),
+    )
+    asyncio.run(eng.agenerate(req))
+    kinds = [
+        ("update" if "update_weights_from_disk" in u else
+         "generate" if "/generate" in u else "info")
+        for _, u, _ in session.calls
+    ]
+    assert "generate" in kinds and "update" in kinds
+    assert kinds.index("update") < kinds.index("generate")
+    # and the rejoin probe is armed with the recovered checkpoint
+    assert eng._last_disk_update == (meta.path, 5)
+
+
+def test_reconcile_quarantines_unreachable_server(tmp_path):
+    versions = {"a:1": 5, "b:1": 2}
+    session = FakeSession(_reconcile_handler(versions, unreachable={"b:1"}))
+    eng = _make_remote_engine(
+        ["a:1", "b:1"], session, update_weights_min_healthy_fraction=0.5
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    repushed = eng.reconcile_after_recover(meta, version=5)
+    assert repushed == []
+    assert not eng._health.routable("b:1")
+    assert eng._health.required_version("b:1") == 5
+    # routing avoids the quarantined server entirely
+    assert {eng.choose_server() for _ in range(6)} == {"a:1"}
+
+
+def test_reconcile_with_breaker_disabled_is_strict(tmp_path):
+    """Without the breaker plane there is no quarantine and no rejoin
+    probe: an unreachable server would silently rejoin with stale weights,
+    so reconciliation must raise (mirroring update_weights' semantics)."""
+    versions = {"a:1": 5, "b:1": 2}
+    session = FakeSession(_reconcile_handler(versions, unreachable={"b:1"}))
+    eng = _make_remote_engine(
+        ["a:1", "b:1"],
+        session,
+        breaker=CircuitBreakerConfig(enabled=False),
+        update_weights_min_healthy_fraction=0.5,
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="breaker disabled"):
+        eng.reconcile_after_recover(meta, version=5)
+
+
+def test_reconcile_raises_below_min_healthy_fraction(tmp_path):
+    versions = {"a:1": 2, "b:1": 2}
+    session = FakeSession(
+        _reconcile_handler(versions, unreachable={"a:1", "b:1"})
+    )
+    eng = _make_remote_engine(
+        ["a:1", "b:1"], session, update_weights_min_healthy_fraction=0.5
+    )
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="min healthy fraction"):
+        eng.reconcile_after_recover(meta, version=5)
+
+
+def test_controller_reconcile_sets_worker_versions_and_repushes(tmp_path):
+    from areal_tpu.controller.train_controller import TrainController
+
+    class _FakeClient:
+        def __init__(self):
+            self.version = 0
+            self.uploaded = []
+
+        def call(self, method, tensors=None, **kwargs):
+            if method == "get_version":
+                return self.version
+            if method == "set_version":
+                self.version = kwargs["version"]
+                return None
+            if method == "upload_weights":
+                self.uploaded.append(kwargs["meta"]["path"])
+                return None
+            raise AssertionError(method)
+
+    class _FakeRollout:
+        def __init__(self):
+            self.version = 0
+            self.reconciled = None
+
+        def set_version(self, v):
+            self.version = v
+
+        def reconcile_after_recover(self, meta, version):
+            self.reconciled = (meta.path, version)
+            self.version = version
+            return ["a:1"]
+
+    clients = [_FakeClient(), _FakeClient()]
+    tc = TrainController(clients)
+    rollout = _FakeRollout()
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    run_state = RunState(last_step_info=StepInfo(), weight_version=7)
+    try:
+        repushed = tc.reconcile_after_recover(run_state, meta, rollout)
+        assert repushed == ["a:1"]
+        assert all(c.version == 7 for c in clients)
+        assert all(c.uploaded == [meta.path] for c in clients)
+        assert rollout.reconciled == (meta.path, 7)
+        assert rollout.version == 7
+    finally:
+        tc.destroy()
+
+
+# ---------------------------------------------------------------------------
+# crash points: product-code barrier in update_weights
+# ---------------------------------------------------------------------------
+
+
+def test_update_weights_runs_through_pre_weight_update_barrier(
+    tmp_path, monkeypatch
+):
+    versions = {"a:1": 0}
+    session = FakeSession(_reconcile_handler(versions))
+    eng = _make_remote_engine(["a:1"], session)
+    meta = WeightUpdateMeta(type="disk", path=str(tmp_path / "ckpt"))
+    monkeypatch.setenv(chaos.CRASH_ENV, "pre-weight-update")
+    chaos.reset_crash_points()
+    with pytest.raises(InjectedCrash):
+        eng.update_weights(meta)
+    # the kill landed BEFORE any fan-out traffic
+    assert session.calls == []
+
+
+def test_relaunch_backoff_capped_exponential():
+    from areal_tpu.launcher.local import relaunch_backoff
+
+    assert relaunch_backoff(0, 1.0, 60.0) == 0.0
+    assert relaunch_backoff(1, 1.0, 60.0) == 1.0
+    assert relaunch_backoff(3, 1.0, 60.0) == 4.0
+    assert relaunch_backoff(10, 1.0, 60.0) == 60.0  # capped
+    assert relaunch_backoff(5, 0.0, 60.0) == 0.0  # backoff disabled
+
+
+def test_crash_point_spec_grammar(monkeypatch):
+    monkeypatch.setenv(chaos.CRASH_ENV, "a@2,b")
+    chaos.reset_crash_points()
+    crash_point("a")  # first arrival: armed for the 2nd
+    crash_point("c")  # unrelated point never fires
+    with pytest.raises(InjectedCrash):
+        crash_point("b")
+    with pytest.raises(InjectedCrash):
+        crash_point("a")
+    crash_point("a")  # already fired at its Nth arrival; stays quiet

@@ -262,7 +262,10 @@ def test_recover_refuses_corrupted_info_json(tmp_path):
     from areal_tpu.utils.recover import RecoverStateCorrupted
 
     handler, root = _dump_dummy(tmp_path)
-    with open(os.path.join(root, "recover_info.json"), "w") as f:
+    # the commit marker lives at the recover ROOT (the returned path is the
+    # per-step dump dir it references)
+    marker_root = handler.recover_root(str(tmp_path), "e", "t")
+    with open(os.path.join(marker_root, "recover_info.json"), "w") as f:
         f.write('{"last_step_info": {"epo')  # truncated mid-write
     with pytest.raises(RecoverStateCorrupted, match="refusing to resume"):
         handler.load(
@@ -319,3 +322,241 @@ def test_recover_missing_info_is_fresh_start(tmp_path):
         )
         is None
     )
+
+
+def test_same_step_redump_crash_preserves_committed_dump(tmp_path, monkeypatch):
+    """A graceful shutdown re-dumps the SAME step a periodic dump already
+    committed; a crash mid-restage must not have touched the committed
+    dump — the restage goes to a distinct suffixed directory."""
+    from areal_tpu.utils import chaos
+
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    handler.dump(
+        _DummyEngine(), step(2), None, None, _DummyLoader(pos=5), force=True, **kw
+    )
+    monkeypatch.setenv(chaos.CRASH_ENV, "mid-checkpoint")
+    chaos.reset_crash_points()
+    with pytest.raises(chaos.InjectedCrash):
+        handler.dump(
+            _DummyEngine(), step(2), None, None, _DummyLoader(pos=5),
+            force=True, **kw,
+        )
+    monkeypatch.delenv(chaos.CRASH_ENV)
+    chaos.reset_crash_points()
+    eng, dl = _DummyEngine(), _DummyLoader()
+    info = handler.load(eng, None, None, dl, **kw)
+    assert info is not None and info.last_step_info.global_step == 2
+    assert dl.pos == 5 and eng.loaded is not None
+    # a successful same-step re-dump commits under the suffixed name
+    root2 = handler.dump(
+        _DummyEngine(), step(2), None, None, _DummyLoader(pos=5), force=True, **kw
+    )
+    assert os.path.basename(root2) == "dump_globalstep2.1"
+    assert handler.load(_DummyEngine(), **kw).last_step_info.global_step == 2
+
+
+def test_recover_dump_keeps_previous_until_commit(tmp_path):
+    """Crash consistency of the dump itself: a new dump stages into its own
+    directory and the old one survives until the marker flips; after the
+    flip the old dump is GC'd."""
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    root1 = handler.dump(_DummyEngine(), step(1), None, None, None, force=True, **kw)
+    assert os.path.basename(root1) == "dump_globalstep1"
+    root2 = handler.dump(_DummyEngine(), step(2), None, None, None, force=True, **kw)
+    assert os.path.isdir(root2)
+    assert not os.path.isdir(root1)  # unreferenced after the new commit
+    info = handler.load(_DummyEngine(), **kw)
+    assert info.last_step_info.global_step == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint retention GC + latest pointer
+# ---------------------------------------------------------------------------
+
+
+def _retention_saver(tmp_path, **knobs):
+    ft = FinetuneSpec(total_train_epochs=2, dataset_size=64, train_batch_size=4)
+    return Saver(
+        SaverConfig(
+            freq_steps=1,
+            experiment_name="e",
+            trial_name="t",
+            fileroot=str(tmp_path),
+            **knobs,
+        ),
+        ft,
+    )
+
+
+def _saved_steps(saver):
+    import re
+
+    names = [
+        n for n in os.listdir(saver.save_root()) if n.startswith("epoch")
+    ]
+    return sorted(
+        int(re.search(r"globalstep(\d+)$", n).group(1)) for n in names
+    )
+
+
+def test_retention_gc_keep_last_and_keep_every(tmp_path):
+    saver = _retention_saver(tmp_path, keep_last=2, keep_every=4)
+    eng = _DummyEngine()
+    for i in range(8):
+        assert saver.save(eng, step(i, spe=16), force=True) is not None
+    # newest 2 (6,7) + keep_every multiples (0,4)
+    assert _saved_steps(saver) == [0, 4, 6, 7]
+    # the latest pointer names the newest checkpoint
+    latest = saver.latest_checkpoint()
+    assert latest is not None and latest.endswith("globalstep7")
+
+
+def test_retention_gc_protects_recover_named_checkpoint(tmp_path):
+    """The checkpoint the recover info references must survive GC even when
+    retention would delete it — deleting it strands the next resume."""
+    saver = _retention_saver(tmp_path, keep_last=1)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), None)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    eng = _DummyEngine()
+    saver.save(eng, step(3, spe=16), force=True)
+    # recover info records last_save_path = globalstep3
+    handler.dump(eng, step(3, spe=16), saver, None, None, force=True, **kw)
+    assert handler.protected_paths(**kw) == {saver.last_save_path}
+    for i in (4, 5):
+        saver.save(
+            eng,
+            step(i, spe=16),
+            force=True,
+            protect=handler.protected_paths(**kw),
+        )
+    # keep_last=1 would leave only globalstep5, but 3 is recover-protected
+    assert _saved_steps(saver) == [3, 5]
+
+
+def test_retention_gc_disabled_keeps_everything(tmp_path):
+    saver = _retention_saver(tmp_path)
+    eng = _DummyEngine()
+    for i in range(4):
+        saver.save(eng, step(i, spe=16), force=True)
+    assert _saved_steps(saver) == [0, 1, 2, 3]
+    assert saver.gc() == []
+
+
+# ---------------------------------------------------------------------------
+# stats logger resume dedup
+# ---------------------------------------------------------------------------
+
+
+def _stats_logger(tmp_path):
+    from areal_tpu.api.cli_args import StatsLoggerConfig
+    from areal_tpu.utils.stats_logger import StatsLogger
+
+    return StatsLogger(
+        StatsLoggerConfig(
+            experiment_name="e", trial_name="t", fileroot=str(tmp_path)
+        ),
+        rank=0,
+    )
+
+
+def _stats_lines(tmp_path):
+    import json
+
+    path = os.path.join(str(tmp_path), "e", "t", "logs", "stats.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_stats_logger_never_double_logs_a_step(tmp_path):
+    lg = _stats_logger(tmp_path)
+    for i in range(3):
+        lg.commit(0, i, i, {"x": float(i)})
+    lg.close()
+    # RECOVERY restart (load_state_dict arms the dedup floor) replays
+    # steps 1-2 (recovered trainer re-runs them), then moves on to 3
+    lg2 = _stats_logger(tmp_path)
+    lg2.load_state_dict({})  # RecoverHandler.load does this
+    assert lg2.last_logged_step == 2
+    lg2.commit(0, 1, 1, {"x": 100.0})  # replay: skipped
+    lg2.commit(0, 2, 2, {"x": 200.0})  # replay: skipped
+    lg2.commit(0, 3, 3, {"x": 3.0})
+    lg2.close()
+    recs = _stats_lines(tmp_path)
+    assert [r["global_step"] for r in recs] == [0, 1, 2, 3]
+    assert recs[1]["x"] == 1.0  # the original record, not the replay
+
+
+def test_stats_logger_fresh_run_over_old_logs_is_not_deduped(tmp_path):
+    """A brand-new run reusing an experiment/trial name (no recovery) must
+    keep logging — the dedup floor only arms on load_state_dict."""
+    lg = _stats_logger(tmp_path)
+    lg.commit(0, 0, 0, {"x": 0.0})
+    lg.close()
+    lg2 = _stats_logger(tmp_path)  # fresh run, same names, no recovery
+    lg2.commit(0, 0, 0, {"x": 10.0})
+    lg2.close()
+    assert [r["x"] for r in _stats_lines(tmp_path)] == [0.0, 10.0]
+
+
+def test_stats_logger_truncates_torn_tail_on_reopen(tmp_path):
+    lg = _stats_logger(tmp_path)
+    lg.commit(0, 0, 0, {"x": 0.0})
+    lg.commit(0, 1, 1, {"x": 1.0})
+    lg.close()
+    path = os.path.join(str(tmp_path), "e", "t", "logs", "stats.jsonl")
+    with open(path, "a") as f:
+        f.write('{"epoch": 0, "step": 2, "global_st')  # crash mid-write
+    lg2 = _stats_logger(tmp_path)
+    lg2.load_state_dict({})
+    assert lg2.last_logged_step == 1
+    lg2.commit(0, 2, 2, {"x": 2.0})
+    lg2.close()
+    recs = _stats_lines(tmp_path)  # parses cleanly: torn tail was truncated
+    assert [r["global_step"] for r in recs] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# dataloader deterministic resume
+# ---------------------------------------------------------------------------
+
+
+def _collect(dl, n=None):
+    out = []
+    it = iter(dl)
+    while n is None or len(out) < n:
+        try:
+            out.append(tuple(next(it)))
+        except StopIteration:
+            if n is None:
+                return out
+            it = iter(dl)
+    return out
+
+
+def test_dataloader_resume_stream_identical_to_uninterrupted(tmp_path):
+    data = list(range(50))
+    ref = _collect(StatefulDataLoader(data, 4, seed=7), n=24)  # 2 epochs
+    # interrupted run: consume 7 batches, snapshot, 'crash'
+    dl = StatefulDataLoader(data, 4, seed=7)
+    first = _collect(dl, n=7)
+    snap = dl.state_dict()
+    # resumed process: fresh loader over the same dataset, restore cursor
+    dl2 = StatefulDataLoader(data, 4, seed=7)
+    dl2.load_state_dict(snap)
+    rest = _collect(dl2, n=24 - 7)
+    assert first + rest == ref
+
+
+def test_dataloader_refuses_mismatched_dataset(tmp_path):
+    dl = StatefulDataLoader(list(range(16)), 4, seed=1)
+    snap = dl.state_dict()
+    other = StatefulDataLoader(list(range(20)), 4, seed=1)
+    with pytest.raises(ValueError, match="dataset changed"):
+        other.load_state_dict(snap)
+    rebatched = StatefulDataLoader(list(range(16)), 8, seed=1)
+    with pytest.raises(ValueError, match="batch_size"):
+        rebatched.load_state_dict(snap)
