@@ -450,6 +450,10 @@ class TrainEngineConfig:
     optimizer: OptimizerConfig | None = field(default_factory=OptimizerConfig)
     backend: EngineBackendConfig = field(default_factory=EngineBackendConfig)
     lora: "LoRAConfig | None" = None
+    # persistent JAX compilation cache directory (trainer side): a relaunch
+    # after preemption (PR 4) reloads compiled train-step executables
+    # instead of paying full recompile. None = off.
+    jax_compilation_cache_dir: str | None = None
 
 
 @dataclass
@@ -610,6 +614,16 @@ class JaxGenConfig:
     # sequence's own prompt + output history
     spec_ngram_max: int = 4
     spec_ngram_min: int = 1
+    # max seconds the blocking engine-command API (weight updates, staged
+    # commits) waits for the engine thread to pick a command up before
+    # raising a descriptive TimeoutError naming the pending command (was a
+    # hardcoded 600s deep in the engine); covers worst-case compile of a
+    # fresh decode/prefill program
+    command_timeout_seconds: float = 600.0
+    # persistent JAX compilation cache directory: relaunch-after-preemption
+    # reloads compiled executables from here instead of paying full XLA
+    # recompile (utils/jax_cache.configure_compilation_cache). None = off.
+    jax_compilation_cache_dir: str | None = None
 
 
 @dataclass
@@ -697,6 +711,12 @@ class InferenceEngineConfig:
     # quarantined) as long as at least this fraction of servers took the
     # update; below it the step raises
     update_weights_min_healthy_fraction: float = 0.5
+    # pipelined weight sync: how many encoded/staged chunks the producer may
+    # run AHEAD of the slowest server's stream (chunk i+1 gathers/encodes
+    # while chunk i is in flight). Bounds staging RAM at roughly
+    # depth x chunked_mem_mb beyond the in-flight chunk; 1 = classic
+    # lockstep (encode only after every server took the previous chunk)
+    weight_update_pipeline_depth: int = 2
     # client-side deterministic fault injection (tests/rehearsals)
     chaos: ChaosConfig | None = None
 

@@ -130,6 +130,19 @@ class WeightUpdateMeta:
     type: str = "disk"
     path: str | None = None
     chunked_mem_mb: int = 1024
+    # wire dtype for the streamed paths (http/shm/device_transfer): cast
+    # each leaf to this dtype on device BEFORE shipping (e.g. "bfloat16"
+    # halves the wire bytes of an fp32-trained model; the server casts back
+    # to its serving dtype on apply). None = ship the training dtype.
+    wire_dtype: str | None = None
+    # delta-aware leaf skipping (http/shm): per-leaf content fingerprints
+    # (blake2b over the materialized host bytes) let consecutive pushes ship
+    # ONLY leaves that changed since the last successful push — frozen-base
+    # LoRA-adjacent runs ship megabytes instead of the full tree. The first
+    # push (and any push after the client's server set changes) ships
+    # everything. Not supported on device_transfer (no host bytes to
+    # fingerprint exactly).
+    delta_only: bool = False
 
     @classmethod
     def from_disk(
@@ -143,18 +156,42 @@ class WeightUpdateMeta:
         return cls(type="device", chunked_mem_mb=chunked_mem_mb)
 
     @classmethod
-    def from_shm(cls, chunked_mem_mb: int = 1024) -> "WeightUpdateMeta":
-        return cls(type="shm", chunked_mem_mb=chunked_mem_mb)
+    def from_shm(
+        cls,
+        chunked_mem_mb: int = 1024,
+        wire_dtype: str | None = None,
+        delta_only: bool = False,
+    ) -> "WeightUpdateMeta":
+        return cls(
+            type="shm",
+            chunked_mem_mb=chunked_mem_mb,
+            wire_dtype=wire_dtype,
+            delta_only=delta_only,
+        )
 
     @classmethod
-    def from_http(cls, chunked_mem_mb: int = 512) -> "WeightUpdateMeta":
-        return cls(type="http", chunked_mem_mb=chunked_mem_mb)
+    def from_http(
+        cls,
+        chunked_mem_mb: int = 512,
+        wire_dtype: str | None = None,
+        delta_only: bool = False,
+    ) -> "WeightUpdateMeta":
+        return cls(
+            type="http",
+            chunked_mem_mb=chunked_mem_mb,
+            wire_dtype=wire_dtype,
+            delta_only=delta_only,
+        )
 
     @classmethod
     def from_device_transfer(
-        cls, chunked_mem_mb: int = 512
+        cls, chunked_mem_mb: int = 512, wire_dtype: str | None = None
     ) -> "WeightUpdateMeta":
-        return cls(type="device_transfer", chunked_mem_mb=chunked_mem_mb)
+        return cls(
+            type="device_transfer",
+            chunked_mem_mb=chunked_mem_mb,
+            wire_dtype=wire_dtype,
+        )
 
     @classmethod
     def from_lora(cls) -> "WeightUpdateMeta":

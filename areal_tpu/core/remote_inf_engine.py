@@ -94,6 +94,19 @@ class RemoteInfEngine(InferenceEngine):
         # last disk weight-update meta, so a quarantined server's rejoin
         # probe can re-push the update it missed
         self._last_disk_update: tuple[str, int] | None = None
+        # persistent push loop: ONE long-lived event loop + aiohttp session
+        # for every weight-update/fence fan-out, replacing the old
+        # per-call asyncio.run (which built and tore down a loop, a
+        # session, and its connection pool on EVERY sync — pure stall on
+        # the hot path)
+        self._push_loop: asyncio.AbstractEventLoop | None = None
+        self._push_thread: threading.Thread | None = None
+        self._push_session_obj: aiohttp.ClientSession | None = None
+        self._push_lock = threading.Lock()
+        # in-flight push futures, cancelled by _close_push_loop so a
+        # destroy() racing a push unblocks the caller's .result() instead
+        # of hanging it on a stopped loop
+        self._push_futures: set = set()
 
     # ------------------------------------------------------------------
     # lifecycle / discovery
@@ -198,6 +211,7 @@ class RemoteInfEngine(InferenceEngine):
                 except Exception:
                     pass
         self._sessions.clear()
+        self._close_push_loop()
         self.executor.destroy()
 
     # ------------------------------------------------------------------
@@ -475,10 +489,230 @@ class RemoteInfEngine(InferenceEngine):
             await entry[1].close()
 
     def _new_session(self) -> aiohttp.ClientSession:
-        """One-shot session for the fan-out paths (their ``asyncio.run``
-        loops die with the call). Test seam: chaos tests swap in a scripted
+        """Session factory for the push loop (created once, reused across
+        every fan-out). Test seam: chaos tests swap in a scripted
         in-process session with no sockets."""
         return aiohttp.ClientSession()
+
+    # ------------------------------------------------------------------
+    # persistent push loop (weight updates + pause/continue fences)
+    # ------------------------------------------------------------------
+
+    def _ensure_push_loop(self) -> asyncio.AbstractEventLoop:
+        """The long-lived event loop for sync fan-outs, started lazily on
+        its own daemon thread. One loop + one keepalive session for the
+        engine's lifetime — a per-call ``asyncio.run`` would rebuild both
+        (and re-handshake every server connection) on every weight sync."""
+        with self._push_lock:
+            if (
+                self._push_loop is None
+                or self._push_thread is None
+                or not self._push_thread.is_alive()
+            ):
+                # a session created on a previous (dead) loop is unusable —
+                # drop the reference so the first fan-out on the fresh loop
+                # builds a new one instead of failing with wrong-event-loop
+                # errors forever
+                self._push_session_obj = None
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(
+                    target=loop.run_forever, name="weight-push-loop",
+                    daemon=True,
+                )
+                t.start()
+                self._push_loop = loop
+                self._push_thread = t
+            return self._push_loop
+
+    def _run_push(self, coro):
+        """Run ``coro`` on the persistent push loop and block for its
+        result (the update paths are synchronous by contract: the trainer
+        must not start the next step before the sync outcome is known).
+        The future is tracked so teardown can cancel it rather than leave
+        this thread blocked on a stopped loop."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self._ensure_push_loop())
+        self._push_futures.add(fut)
+        fut.add_done_callback(self._push_futures.discard)
+        return fut.result()
+
+    async def _push_session(self) -> aiohttp.ClientSession:
+        if self._push_session_obj is None or self._push_session_obj.closed:
+            self._push_session_obj = self._new_session()
+        return self._push_session_obj
+
+    def _close_push_loop(self):
+        with self._push_lock:
+            loop, thread = self._push_loop, self._push_thread
+            self._push_loop = None
+            self._push_thread = None
+            session = self._push_session_obj
+            self._push_session_obj = None
+        if loop is None:
+            return
+        for fut in list(self._push_futures):
+            # unblock any thread waiting in _run_push: a cancelled future
+            # raises CancelledError there instead of hanging forever once
+            # the loop below stops
+            fut.cancel()
+
+        async def _close_session():
+            if session is not None:
+                await session.close()
+
+        if loop.is_running():
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _close_session(), loop
+                ).result(5)
+            except Exception:
+                pass
+            loop.call_soon_threadsafe(loop.stop)
+        if thread is not None:
+            thread.join(timeout=5)
+        try:
+            if not loop.is_running():
+                loop.close()  # release the selector fd
+        except Exception:
+            pass
+
+    async def _stream_chunks_pipelined(
+        self,
+        session,
+        targets: list[str],
+        chunks,
+        prepare: Callable,
+        send: Callable,
+        release: Callable | None = None,
+    ) -> tuple[int, dict[str, BaseException]]:
+        """Pipelined per-server chunk fan-out — the zero-stall core.
+
+        A producer task pulls raw chunks from the trainer's generator and
+        ``prepare``s them (gather/encode/stage) in a worker thread, running
+        up to ``weight_update_pipeline_depth`` chunks AHEAD of the slowest
+        server; each server consumes its own bounded queue and ``send``s
+        sequentially (chunk order per server is the commit protocol), so
+        fast servers never barrier on slow ones and chunk ``i+1`` encodes
+        while chunk ``i`` is on the wire. A server whose stream fails is
+        recorded in the returned failure map and drained without further
+        sends — it never receives the final chunk, so it can never commit a
+        half-received update. ``release(item, ok_all)`` fires once EVERY
+        live server is done with an item (ack/unlink/drop staging).
+
+        Returns ``(n_chunks, failed)``. Producer-side errors (unencodable
+        chunk, oversized blob) re-raise after the streams settle."""
+        depth = max(1, self.config.weight_update_pipeline_depth)
+        loop = asyncio.get_running_loop()
+        queues: dict[str, asyncio.Queue] = {
+            a: asyncio.Queue(maxsize=depth) for a in targets
+        }
+        failed: dict[str, BaseException] = {}
+        # idx -> [servers still holding the item, item, all ok so far]
+        pending: dict[int, list] = {}
+        producer_error: list[BaseException] = []
+        n_chunks = 0
+
+        def _next(it):
+            return next(it, None)
+
+        async def produce():
+            nonlocal n_chunks
+            cancelled = False
+            prefetch = None
+            try:
+                from areal_tpu.utils.device_transfer import PrefetchIterator
+
+                # the trainer's generator does real work per next() (host
+                # or device gather): run it one chunk ahead on its own
+                # thread so gather(i+2) overlaps prepare(i+1) — the
+                # producer below serializes fetch and prepare otherwise
+                prefetch = PrefetchIterator(chunks, depth=1)
+                it = iter(prefetch)
+                cur = await loop.run_in_executor(None, _next, it)
+                if cur is None:
+                    raise AssertionError("no weight chunks to send")
+                idx = 0
+                while cur is not None:
+                    if len(failed) == len(targets):
+                        return  # every stream is dead; stop gathering
+                    nxt = await loop.run_in_executor(None, _next, it)
+                    final = nxt is None
+                    item = await loop.run_in_executor(
+                        None, prepare, idx, cur, final
+                    )
+                    pending[idx] = [len(targets), item, True]
+                    for q in queues.values():
+                        await q.put((idx, item, final))
+                    n_chunks += 1
+                    idx += 1
+                    cur = nxt
+            except asyncio.CancelledError:
+                # external cancellation (destroy mid-push) must propagate
+                # as cancellation, not be re-raised later from a live
+                # coroutine (which would cancel the outer future and skip
+                # the quarantine bookkeeping)
+                cancelled = True
+                raise
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                producer_error.append(e)
+            finally:
+                if prefetch is not None:
+                    # early exit (all streams dead, prepare error, cancel):
+                    # release the prefetch thread and its held chunks
+                    prefetch.close()
+                for q in queues.values():
+                    try:
+                        q.put_nowait(None)
+                    except asyncio.QueueFull:
+                        if not cancelled:
+                            # live consumers will drain the queue; a
+                            # cancelled path must not block here (its
+                            # consumers are being cancelled too)
+                            await q.put(None)
+
+        def _consumed(idx: int, ok: bool):
+            ent = pending[idx]
+            ent[0] -= 1
+            ent[2] = ent[2] and ok
+            if ent[0] == 0:
+                del pending[idx]
+                if release is not None:
+                    release(ent[1], ent[2])
+
+        async def stream_to(addr: str):
+            q = queues[addr]
+            while True:
+                got = await q.get()
+                if got is None:
+                    return
+                idx, item, final = got
+                if addr in failed:
+                    _consumed(idx, False)  # drain: keep release() balanced
+                    continue
+                try:
+                    await send(session, addr, item, final)
+                    _consumed(idx, True)
+                except asyncio.CancelledError:
+                    raise
+                except BaseException as e:  # noqa: BLE001 — any stream
+                    # error is a per-server failure (transport, HTTP, or a
+                    # send-callback bug); the stream drains so the producer
+                    # and the other servers never block on this queue
+                    failed[addr] = e
+                    _consumed(idx, False)
+
+        prod = asyncio.ensure_future(produce())
+        try:
+            await asyncio.gather(*[stream_to(a) for a in targets])
+        finally:
+            if not prod.done():
+                prod.cancel()
+            try:
+                await prod
+            except asyncio.CancelledError:
+                pass
+        if producer_error:
+            raise producer_error[0]
+        return n_chunks, failed
 
     # ------------------------------------------------------------------
     # health probing (breaker OPEN -> HALF_OPEN path)
@@ -599,6 +833,7 @@ class RemoteInfEngine(InferenceEngine):
                 targets.append(a)
         return targets
 
+    # arealint: hot-path
     def update_weights(self, meta: WeightUpdateMeta):
         """Fan the update out to every reachable server. Caller (train
         engine) has already written the checkpoint for the disk path.
@@ -623,28 +858,26 @@ class RemoteInfEngine(InferenceEngine):
         targets = self._update_targets(next_version)
 
         async def _update():
-            session = self._new_session()
-            try:
-                return await asyncio.gather(
-                    *[
-                        arequest_with_retry(
-                            session,
-                            f"http://{a}/update_weights_from_disk",
-                            payload={
-                                "model_path": meta.path,
-                                "version": next_version,
-                            },
-                            max_retries=self.config.request_retries,
-                            timeout=self.config.request_timeout,
-                        )
-                        for a in targets
-                    ],
-                    return_exceptions=True,
-                )
-            finally:
-                await session.close()
+            session = await self._push_session()
+            return await asyncio.gather(
+                *[
+                    arequest_with_retry(
+                        session,
+                        f"http://{a}/update_weights_from_disk",
+                        payload={
+                            "model_path": meta.path,
+                            "version": next_version,
+                        },
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.request_timeout,
+                        chaos=self._chaos,
+                    )
+                    for a in targets
+                ],
+                return_exceptions=True,
+            )
 
-        results = asyncio.run(_update())
+        results = self._run_push(_update())
         failed = [
             (a, r)
             for a, r in zip(targets, results)
@@ -685,85 +918,150 @@ class RemoteInfEngine(InferenceEngine):
         )
         self.set_version(next_version)
 
-    def update_weights_from_tensors(self, chunks, next_version: int) -> float:
+    # arealint: hot-path
+    def update_weights_from_tensors(
+        self,
+        chunks,
+        next_version: int,
+        delta_base_version: int | None = None,
+    ) -> float:
         """Disaggregated no-disk weight transfer: stream safetensors-encoded
         chunks to every server's /update_weights_from_tensor endpoint
         (reference NCCL broadcast path, fsdp_engine.py:359-401, replaced by
         HTTP into host RAM + device_put on the server side).
 
         ``chunks``: iterable of dict[param_path -> np.ndarray] in the
-        engines' native (stacked-layer) pytree naming. Chunks are sent in
-        order; the last one carries final=1 so servers bump their version
-        atomically after the whole set landed. Returns the wall latency and
-        records it under stats_tracker timeperf/update_weights_http."""
+        engines' native (stacked-layer) pytree naming. The push is
+        PIPELINED on the persistent loop: the trainer's gather + the
+        safetensors encode of chunk ``i+1`` run while chunk ``i`` is on the
+        wire, and each server streams at its own pace (no per-chunk
+        all-server barrier). The last chunk carries final=1 so each server
+        bumps its version atomically once ITS whole set landed; a server
+        whose stream fails never receives final, stays at the old version,
+        and is quarantined at ``next_version`` (PR 3 semantics: the
+        version-checked rejoin probe re-syncs it) — unless fewer than
+        ``update_weights_min_healthy_fraction`` of the fleet took the
+        update, in which case the step raises. Returns the wall latency
+        and records it under stats_tracker time_perf/update_weights_http.
+
+        ``delta_base_version`` (delta_only pushes): the chunk stream only
+        contains CHANGED leaves, valid solely on a server currently at
+        exactly that version — each request carries it and the server
+        refuses (HTTP 412, non-retriable) when its version differs, so a
+        server that silently restarted at the same address can never
+        commit a mixed old/new tree."""
         from safetensors.numpy import save as st_save
 
         from areal_tpu.utils import stats_tracker
 
         t0 = time.monotonic()
-        n_chunks = 0
+        targets = self._update_targets(next_version)
+
+        def prepare(idx: int, cur: dict, final: bool) -> bytes:
+            from areal_tpu.utils import wire
+
+            with stats_tracker.DEFAULT_TRACKER.record_timing(
+                "weight_sync_encode"
+            ):
+                # bf16 leaves (default training dtype AND the wire_dtype
+                # knob) ride as uint16 views: safetensors.numpy saves bf16
+                # but cannot load it back on the server side
+                blob = st_save(wire.encode_named(cur))
+            if len(blob) > SERVER_CLIENT_MAX_SIZE:
+                # validate against the server's request-body cap
+                # CLIENT-side: the alternative is an opaque 413
+                # from aiohttp with no hint which knob to turn
+                raise ValueError(
+                    f"serialized weight chunk is {len(blob)} bytes "
+                    f"(> server client_max_size="
+                    f"{SERVER_CLIENT_MAX_SIZE}); lower "
+                    "WeightUpdateMeta.chunked_mem_mb so each "
+                    "safetensors chunk fits the server's request "
+                    "body limit"
+                )
+            return blob
+
+        delta_q = (
+            f"&delta_base={delta_base_version}"
+            if delta_base_version is not None
+            else ""
+        )
+
+        async def send(session, addr: str, blob: bytes, final: bool):
+            await arequest_with_retry(
+                session,
+                f"http://{addr}/update_weights_from_tensor"
+                f"?version={next_version}&final={int(final)}{delta_q}",
+                data=blob,
+                max_retries=self.config.request_retries,
+                timeout=self.config.request_timeout,
+                chaos=self._chaos,
+            )
 
         async def _push_all():
-            nonlocal n_chunks
-            session = self._new_session()
-            try:
-                it = iter(chunks)
-                try:
-                    cur = next(it)
-                except StopIteration:
-                    raise AssertionError("no weight chunks to send") from None
-                # one-chunk lookahead keeps the staging RAM bound the
-                # chunked_mem_mb contract promises while still knowing
-                # which chunk is final
-                while cur is not None:
-                    nxt = next(it, None)
-                    final = nxt is None
-                    blob = st_save(
-                        {k: np.ascontiguousarray(v) for k, v in cur.items()}
-                    )
-                    if len(blob) > SERVER_CLIENT_MAX_SIZE:
-                        # validate against the server's request-body cap
-                        # CLIENT-side: the alternative is an opaque 413
-                        # from aiohttp with no hint which knob to turn
-                        raise ValueError(
-                            f"serialized weight chunk is {len(blob)} bytes "
-                            f"(> server client_max_size="
-                            f"{SERVER_CLIENT_MAX_SIZE}); lower "
-                            "WeightUpdateMeta.chunked_mem_mb so each "
-                            "safetensors chunk fits the server's request "
-                            "body limit"
-                        )
-                    n_chunks += 1
-                    await asyncio.gather(
-                        *[
-                            arequest_with_retry(
-                                session,
-                                f"http://{a}/update_weights_from_tensor"
-                                f"?version={next_version}&final={int(final)}",
-                                data=blob,
-                                max_retries=self.config.request_retries,
-                                timeout=self.config.request_timeout,
-                            )
-                            for a in self.addresses
-                        ]
-                    )
-                    cur = nxt
-            finally:
-                await session.close()
+            session = await self._push_session()
+            return await self._stream_chunks_pipelined(
+                session, targets, chunks, prepare, send
+            )
 
-        asyncio.run(_push_all())
+        n_chunks, failed = self._run_push(_push_all())
+        self._finish_streamed_update(
+            "tensor weight update", next_version, targets, failed
+        )
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(update_weights_http_latency=latency)
         logger.info(
-            "tensor weight update v%d (%d chunks) -> %d servers in %.2fs",
+            "tensor weight update v%d (%d chunks) -> %d/%d servers in %.2fs",
             next_version,
             n_chunks,
+            len(targets) - len(failed),
             len(self.addresses),
             latency,
         )
         self.set_version(next_version)
         return latency
 
+    def _finish_streamed_update(
+        self,
+        what: str,
+        next_version: int,
+        targets: list[str],
+        failed: dict[str, BaseException],
+    ) -> None:
+        """Shared post-stream policy for the chunked paths: min-healthy
+        floor, then quarantine each failed server at the new version (its
+        stream never delivered final, so it still serves the old weights
+        cleanly; the PR 3 version-checked rejoin probe re-syncs it).
+
+        Degraded mode requires a rejoin ARTIFACT: the probe can only
+        re-push from disk, so in a pure-stream run (no disk update ever
+        fanned out) a quarantined server could never rejoin — each later
+        update would re-quarantine it at a newer version and the fleet
+        would silently shrink forever. Without an artifact, any failure is
+        strict (the step raises), same as breaker-disabled mode."""
+        failed_list = sorted(failed.items())
+        if failed_list and self._last_disk_update is None:
+            raise RuntimeError(
+                f"{what} v{next_version} failed on {len(failed_list)} "
+                "server(s) and no disk update has ever been fanned out — "
+                "the version-checked rejoin probe has nothing to re-push, "
+                "so quarantining would exclude the server(s) permanently. "
+                "Interleave periodic disk updates (weight_update='disk') "
+                "to enable degraded mode; failures: "
+                + "; ".join(f"{a}: {r}" for a, r in failed_list[:4])
+            ) from failed_list[0][1]
+        healthy = len(targets) - len(failed_list)
+        self._degraded_mode_or_raise(
+            failed_list, healthy, next_version, what=what
+        )
+        for a, r in failed_list:
+            logger.warning(
+                "quarantining %s after failed %s v%d: %s",
+                a, what, next_version, r,
+            )
+            self._health.quarantine(a, required_version=next_version)
+
+    # arealint: hot-path
     def update_weights_from_device_transfer(
         self, chunks, next_version: int
     ) -> float:
@@ -777,9 +1075,16 @@ class RemoteInfEngine(InferenceEngine):
         plane is the transfer service's DMA/socket transport).
 
         ``chunks``: iterable of dict[param_path -> jax.Array] (any
-        sharding; cast/re-shard happens engine-side). One-chunk lookahead
-        bounds the single-device transient to chunked_mem_mb while still
-        marking the final chunk.
+        sharding; cast/re-shard happens engine-side). The push is
+        PIPELINED: chunk ``i+1``'s single-shard gather + staging run while
+        the servers pull chunk ``i`` (producer run-ahead bounded by
+        ``weight_update_pipeline_depth``, so the single-device transient
+        stays a small multiple of chunked_mem_mb), and each server streams
+        at its own pace. A server whose stream fails never receives final
+        — it stays at the old version and is quarantined for the
+        version-checked rejoin probe; its staged entries stay on the
+        unacked-bytes ledger (one-shot await_pull entries cannot be
+        withdrawn) and the next push attempt logs the leak.
         """
         import jax
 
@@ -789,12 +1094,12 @@ class RemoteInfEngine(InferenceEngine):
         addr = device_transfer.transfer_address()
         dev0 = jax.devices()[0]
         single = jax.sharding.SingleDeviceSharding(dev0)
-        n_chunks = 0
+        targets = self._update_targets(next_version)
         # uuids are process-unique per ATTEMPT (device_transfer counter):
         # a failed push leaves one-shot staged entries behind, and a
         # retried version must never let a server pull one of those stale
         # chunks. Generously over-reserve the block. The per-chunk uuid
-        # packs (n_chunks << 8) + server_index into that block, so both
+        # packs (chunk_index << 8) + server_index into that block, so both
         # fields are bounds-checked: a 257th server or a 4097th chunk
         # would silently alias another chunk's staged buffers otherwise.
         if len(self.addresses) > 256:
@@ -808,90 +1113,92 @@ class RemoteInfEngine(InferenceEngine):
             )
         uuid_base = device_transfer.next_uuid_block(1 << 20)
 
-        async def _push_all():
-            nonlocal n_chunks
-            session = self._new_session()
-            try:
-                it = iter(chunks)
-                try:
-                    cur = next(it)
-                except StopIteration:
-                    raise AssertionError("no weight chunks to send") from None
-                while cur is not None:
-                    nxt = next(it, None)
-                    final = nxt is None
-                    # gather this chunk single-shard (the rank-0-
-                    # materializes shape of an NCCL broadcast); one staged
-                    # copy serves every server's pull
-                    staged = {
-                        k: jax.device_put(v, single) for k, v in cur.items()
-                    }
-                    jax.block_until_ready(list(staged.values()))
-                    leaves = [
-                        [k, list(v.shape), str(v.dtype)]
-                        for k, v in staged.items()
-                    ]
-                    if n_chunks >= (1 << 12):
-                        raise ValueError(
-                            "device-transfer uuid encoding reserves 12 "
-                            "bits for the chunk index; raise chunked_mem_mb"
-                        )
-                    reqs = []
-                    staged_bytes = 0
-                    for si, a in enumerate(self.addresses):
-                        uuid = uuid_base + (n_chunks << 8) + si
-                        # the per-server uuids all alias ONE staged array
-                        # set (shared buffers): account its bytes once
-                        n = device_transfer.stage_for_pull(
-                            uuid, staged, account=si == 0
-                        )
-                        if si == 0:
-                            staged_bytes = n
-                        reqs.append(
-                            arequest_with_retry(
-                                session,
-                                f"http://{a}/update_weights_from_device",
-                                payload={
-                                    "address": addr,
-                                    "uuid": uuid,
-                                    "leaves": leaves,
-                                    "version": next_version,
-                                    "final": final,
-                                },
-                                max_retries=1,
-                                timeout=self.config.request_timeout,
-                            )
-                        )
-                    n_chunks += 1
-                    await asyncio.gather(*reqs)
-                    # every server acknowledged its pull: the one-shot
-                    # staged entries are consumed. A failed gather skips
-                    # this — the chunk's shared buffers stay pinned while
-                    # ANY server's entry remains, so whole-chunk
-                    # granularity is the honest unit — and the next push
-                    # attempt logs the leak (device_transfer).
-                    device_transfer.ack_pulled(staged_bytes)
-                    cur = nxt
-            finally:
-                await session.close()
+        def prepare(idx: int, cur: dict, final: bool) -> dict:
+            if idx >= (1 << 12):
+                raise ValueError(
+                    "device-transfer uuid encoding reserves 12 "
+                    "bits for the chunk index; raise chunked_mem_mb"
+                )
+            # gather this chunk single-shard (the rank-0-materializes
+            # shape of an NCCL broadcast); one staged copy serves every
+            # server's pull. Runs on the producer's worker thread, so the
+            # gather of chunk i+1 overlaps the wire time of chunk i.
+            staged = {k: jax.device_put(v, single) for k, v in cur.items()}
+            # intended sync: staged buffers must be materialized before a
+            # server can pull them
+            jax.block_until_ready(list(staged.values()))  # arealint: disable=host-sync-in-hot-path
+            leaves = [
+                [k, list(v.shape), str(v.dtype)] for k, v in staged.items()
+            ]
+            staged_bytes = 0
+            for si in range(len(targets)):
+                # the per-server uuids all alias ONE staged array set
+                # (shared buffers): account its bytes once
+                n = device_transfer.stage_for_pull(
+                    uuid_base + (idx << 8) + si, staged, account=si == 0
+                )
+                if si == 0:
+                    staged_bytes = n
+            return {"idx": idx, "leaves": leaves, "bytes": staged_bytes}
 
-        asyncio.run(_push_all())
+        async def send(session, a: str, item: dict, final: bool):
+            await arequest_with_retry(
+                session,
+                f"http://{a}/update_weights_from_device",
+                payload={
+                    "address": addr,
+                    "uuid": uuid_base + (item["idx"] << 8) + targets.index(a),
+                    "leaves": item["leaves"],
+                    "version": next_version,
+                    "final": final,
+                },
+                max_retries=1,
+                timeout=self.config.request_timeout,
+                chaos=self._chaos,
+            )
+
+        def release(item: dict, ok_all: bool):
+            if ok_all:
+                # every server acknowledged its pull: the one-shot staged
+                # entries are consumed. A failed stream skips this — the
+                # chunk's shared buffers stay pinned while ANY server's
+                # entry remains (whole-chunk granularity is the honest
+                # unit) — and the next push attempt logs the leak.
+                device_transfer.ack_pulled(item["bytes"])
+
+        async def _push_all():
+            session = await self._push_session()
+            return await self._stream_chunks_pipelined(
+                session, targets, chunks, prepare, send, release=release
+            )
+
+        n_chunks, failed = self._run_push(_push_all())
+        self._finish_streamed_update(
+            "device-path weight update", next_version, targets, failed
+        )
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(
             update_weights_device_latency=latency
         )
         logger.info(
-            "device-path weight update v%d (%d chunks) -> %d servers in "
+            "device-path weight update v%d (%d chunks) -> %d/%d servers in "
             "%.2fs",
             next_version,
             n_chunks,
+            len(targets) - len(failed),
             len(self.addresses),
             latency,
         )
         self.set_version(next_version)
         return latency
 
-    def update_weights_from_shm(self, chunks, next_version: int) -> float:
+    # arealint: hot-path
+    def update_weights_from_shm(
+        self,
+        chunks,
+        next_version: int,
+        delta_base_version: int | None = None,
+    ) -> float:
         """Same-host no-copy weight transfer: each chunk is written once to
         /dev/shm (RAM-backed tmpfs) as a safetensors file and every server
         mmaps it directly — the HTTP requests carry only a JSON pointer, so
@@ -899,6 +1206,12 @@ class RemoteInfEngine(InferenceEngine):
         staging copy. The nearest analogue of the reference's same-node
         NCCL broadcast (fsdp_engine.py:359-401) for separate processes.
         Falls on its face across hosts by design — use type="http" there.
+
+        Pipelined like the http path: chunk ``i+1``'s gather + shm write
+        overlap the servers' mmap+apply of chunk ``i`` (run-ahead bounded
+        by ``weight_update_pipeline_depth`` — at most that many chunk files
+        live in /dev/shm beyond the in-flight one); each chunk file is
+        unlinked once every live server acknowledged it.
         """
         import uuid
 
@@ -907,63 +1220,78 @@ class RemoteInfEngine(InferenceEngine):
         from areal_tpu.utils import stats_tracker
 
         t0 = time.monotonic()
-        n_chunks = 0
+        targets = self._update_targets(next_version)
+        run_id = uuid.uuid4().hex[:12]
+
+        def prepare(idx: int, cur: dict, final: bool) -> str:
+            from areal_tpu.utils import wire
+
+            path = f"/dev/shm/areal_wu_{run_id}_{idx}.st"
+            with stats_tracker.DEFAULT_TRACKER.record_timing(
+                "weight_sync_encode"
+            ):
+                # bf16 -> uint16 views (safetensors load-side limitation)
+                st_save_file(wire.encode_named(cur), path)
+            return path
+
+        async def send(session, a: str, path: str, final: bool):
+            await arequest_with_retry(
+                session,
+                f"http://{a}/update_weights_from_shm",
+                payload={
+                    "path": path,
+                    "version": next_version,
+                    "final": final,
+                    # delta streams carry only changed leaves: the server
+                    # refuses (412) unless it sits exactly at this version
+                    "delta_base": delta_base_version,
+                },
+                max_retries=self.config.request_retries,
+                timeout=self.config.request_timeout,
+                chaos=self._chaos,
+            )
+
+        def release(path: str, ok_all: bool):
+            # the sender owns the file's lifetime; once every live server
+            # answered (ok or not), the staging copy goes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
         async def _push_all():
-            nonlocal n_chunks
-            session = self._new_session()
-            try:
-                it = iter(chunks)
-                try:
-                    cur = next(it)
-                except StopIteration:
-                    raise AssertionError("no weight chunks to send") from None
-                run_id = uuid.uuid4().hex[:12]
-                while cur is not None:
-                    nxt = next(it, None)
-                    final = nxt is None
-                    path = f"/dev/shm/areal_wu_{run_id}_{n_chunks}.st"
-                    st_save_file(
-                        {k: np.ascontiguousarray(v) for k, v in cur.items()},
-                        path,
-                    )
-                    n_chunks += 1
-                    try:
-                        await asyncio.gather(
-                            *[
-                                arequest_with_retry(
-                                    session,
-                                    f"http://{a}/update_weights_from_shm",
-                                    payload={
-                                        "path": path,
-                                        "version": next_version,
-                                        "final": final,
-                                    },
-                                    max_retries=self.config.request_retries,
-                                    timeout=self.config.request_timeout,
-                                )
-                                for a in self.addresses
-                            ]
-                        )
-                    finally:
-                        try:
-                            os.unlink(path)
-                        except OSError:
-                            pass
-                    cur = nxt
-            finally:
-                await session.close()
+            session = await self._push_session()
+            return await self._stream_chunks_pipelined(
+                session, targets, chunks, prepare, send, release=release
+            )
 
-        asyncio.run(_push_all())
+        try:
+            n_chunks, failed = self._run_push(_push_all())
+        finally:
+            # release() unlinks each consumed chunk; sweep the stragglers a
+            # cancelled/failed push left behind — leaked files here are
+            # RAM-backed tmpfs, not disk
+            import glob
+
+            for p in glob.glob(f"/dev/shm/areal_wu_{run_id}_*"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        self._finish_streamed_update(
+            "shm weight update", next_version, targets, failed
+        )
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(update_weights_shm_latency=latency)
         logger.info(
-            "shm weight update v%d (%d chunks) -> %d servers in %.2fs",
-            next_version, n_chunks, len(self.addresses), latency,
+            "shm weight update v%d (%d chunks) -> %d/%d servers in %.2fs",
+            next_version, n_chunks, len(targets) - len(failed),
+            len(self.addresses), latency,
         )
         self.set_version(next_version)
         return latency
 
+    # arealint: hot-path
     def update_lora_weights(
         self, named: dict, scale: float, next_version: int
     ) -> float:
@@ -971,34 +1299,35 @@ class RemoteInfEngine(InferenceEngine):
         to every server's /update_lora_weights (reference adapter hot-swap,
         areal/engine/sglang_remote.py:82-106). Ships rank-r factors —
         megabytes — instead of the gigabyte full-parameter stream, which is
-        the operational point of LoRA in async RL."""
+        the operational point of LoRA in async RL. Runs on the persistent
+        push loop; single-payload, so there is nothing to pipeline."""
         from safetensors.numpy import save as st_save
 
         from areal_tpu.utils import stats_tracker
 
+        from areal_tpu.utils import wire
+
         t0 = time.monotonic()
-        blob = st_save({k: np.ascontiguousarray(v) for k, v in named.items()})
+        blob = st_save(wire.encode_named(named))
 
         async def _push_all():
-            session = self._new_session()
-            try:
-                await asyncio.gather(
-                    *[
-                        arequest_with_retry(
-                            session,
-                            f"http://{a}/update_lora_weights"
-                            f"?version={next_version}&scale={scale}",
-                            data=blob,
-                            max_retries=self.config.request_retries,
-                            timeout=self.config.request_timeout,
-                        )
-                        for a in self.addresses
-                    ]
-                )
-            finally:
-                await session.close()
+            session = await self._push_session()
+            await asyncio.gather(
+                *[
+                    arequest_with_retry(
+                        session,
+                        f"http://{a}/update_lora_weights"
+                        f"?version={next_version}&scale={scale}",
+                        data=blob,
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.request_timeout,
+                        chaos=self._chaos,
+                    )
+                    for a in self.addresses
+                ]
+            )
 
-        asyncio.run(_push_all())
+        self._run_push(_push_all())
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(update_lora_http_latency=latency)
         logger.info(
@@ -1102,15 +1431,12 @@ class RemoteInfEngine(InferenceEngine):
             # concurrent fan-out (like update_weights): resume blocks on
             # this by design, so wall-clock must be one server's worst
             # case, not the sum over the fleet
-            session = self._new_session()
-            try:
-                await asyncio.gather(
-                    *[_reconcile_one(session, a) for a in list(self.addresses)]
-                )
-            finally:
-                await session.close()
+            session = await self._push_session()
+            await asyncio.gather(
+                *[_reconcile_one(session, a) for a in list(self.addresses)]
+            )
 
-        asyncio.run(_go())
+        self._run_push(_go())
         healthy = len(self.addresses) - len(failed)
         self._degraded_mode_or_raise(
             failed, healthy, version, what="resume reconciliation"
@@ -1141,8 +1467,11 @@ class RemoteInfEngine(InferenceEngine):
         self._paused.clear()
         self.executor.resume()
 
+    # arealint: hot-path
     def _fanout(self, endpoint: str):
-        """pause/continue fence fan-out. OPEN servers are skipped (they
+        """pause/continue fence fan-out (runs on the persistent push loop —
+        the fence brackets EVERY weight update, so a per-call event loop
+        here was pure per-sync stall). OPEN servers are skipped (they
         receive zero traffic and are not generating); a fence failure on a
         live server quarantines it rather than aborting the step — its
         in-flight tokens carry per-token versions, so decoupled PPO stays
@@ -1150,25 +1479,23 @@ class RemoteInfEngine(InferenceEngine):
         targets = [a for a in self.addresses if self._health.state(a) != OPEN]
 
         async def _go():
-            session = self._new_session()
-            try:
-                return await asyncio.gather(
-                    *[
-                        arequest_with_retry(
-                            session,
-                            f"http://{a}/{endpoint}",
-                            payload={},
-                            max_retries=self.config.request_retries,
-                            timeout=self.config.pause_continue_request_timeout,
-                        )
-                        for a in targets
-                    ],
-                    return_exceptions=True,
-                )
-            finally:
-                await session.close()
+            session = await self._push_session()
+            return await asyncio.gather(
+                *[
+                    arequest_with_retry(
+                        session,
+                        f"http://{a}/{endpoint}",
+                        payload={},
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.pause_continue_request_timeout,
+                        chaos=self._chaos,
+                    )
+                    for a in targets
+                ],
+                return_exceptions=True,
+            )
 
-        results = asyncio.run(_go())
+        results = self._run_push(_go())
         for a, r in zip(targets, results):
             if isinstance(r, BaseException):
                 logger.warning(

@@ -271,6 +271,13 @@ class TPUTrainEngine(TrainEngine):
         self.attn_spec = None
         self._rollout_engine = None
         self._weight_update_meta: WeightUpdateMeta | None = None
+        # delta-aware weight sync (WeightUpdateMeta.delta_only): per-leaf
+        # content digests from the last SUCCESSFUL push, and the server
+        # address set it reached — a changed set (new server joined the
+        # rotation) forces a full re-ship, since a fresh server holds none
+        # of the previously-shipped leaves
+        self._wire_fingerprints: dict[str, bytes] = {}
+        self._wire_fp_addrs: tuple | None = None
         self.initialized = False
 
     # ---------------------------------------------------------------- setup
@@ -309,6 +316,13 @@ class TPUTrainEngine(TrainEngine):
         if self.mesh is None:
             self.create_process_group(None)
         cfg = self.config
+        if cfg.jax_compilation_cache_dir:
+            # before any jit: a relaunch after preemption (PR 4) reloads
+            # compiled train-step executables from the persistent cache
+            # instead of paying full recompile
+            from areal_tpu.utils.jax_cache import configure_compilation_cache
+
+            configure_compilation_cache(cfg.jax_compilation_cache_dir)
         if model_config is not None:
             self.model_config = model_config
         else:
@@ -1636,46 +1650,127 @@ class TPUTrainEngine(TrainEngine):
             else:
                 yield path, v
 
-    def _chunked(self, chunk_mb: int, materialize):
+    @staticmethod
+    def _leaf_digest(arr) -> bytes:
+        """Exact content fingerprint of a materialized host leaf (shape and
+        dtype are part of the identity — a reshaped same-bytes leaf must
+        not pass as unchanged)."""
+        import hashlib  # local: only the delta path pays the import
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(str(arr.dtype).encode())
+        h.update(str(tuple(arr.shape)).encode())
+        # uint8 view, not tobytes(): hashing in place avoids a transient
+        # full-leaf byte copy per leaf per delta push
+        h.update(np.ascontiguousarray(arr).view(np.uint8))
+        return h.digest()
+
+    def _chunked(self, chunk_mb: int, materialize, skip=None):
         """Group leaves into <= chunk_mb chunks (oversized single leaves
-        go alone); ``materialize(leaf) -> array`` picks host vs device."""
+        go alone); ``materialize(leaf) -> array`` picks host vs device;
+        ``skip(path, arr) -> bool`` drops a leaf from the wire (delta
+        sync). If EVERY leaf is skipped, the smallest one ships anyway —
+        the version-bump protocol needs at least one chunk to commit."""
         budget = chunk_mb * 1_000_000
         cur: dict = {}
         size = 0
+        skipped = 0
+        smallest = None  # (nbytes, path, arr) fallback for all-skipped
+        shipped_any = False
+        t_chunk = time.perf_counter()
         for path, leaf in self._walk_params(self.effective_params()):
             arr = materialize(leaf)
-            nbytes = getattr(arr, "nbytes", arr.size * arr.dtype.itemsize)
+            nbytes = int(getattr(arr, "nbytes", arr.size * arr.dtype.itemsize))
+            if skip is not None and skip(path, arr):
+                skipped += 1
+                if smallest is None or nbytes < smallest[0]:
+                    smallest = (nbytes, path, arr)
+                continue
+            shipped_any = True
             if cur and size + nbytes > budget:
+                stats_tracker.DEFAULT_TRACKER.scalar(
+                    **{"time_perf/weight_sync_gather": (
+                        time.perf_counter() - t_chunk
+                    )}
+                )
                 yield cur
                 cur, size = {}, 0
+                t_chunk = time.perf_counter()
             cur[path] = arr
             size += nbytes
+        if not shipped_any and smallest is not None:
+            # nothing changed since the last push: ship the smallest leaf so
+            # the final-chunk commit still bumps every server's version
+            cur[smallest[1]] = smallest[2]
+        if skipped:
+            logger.info(
+                "delta weight sync: skipped %d unchanged leaves", skipped
+            )
         if cur:
+            stats_tracker.DEFAULT_TRACKER.scalar(
+                **{"time_perf/weight_sync_gather": (
+                    time.perf_counter() - t_chunk
+                )}
+            )
             yield cur
 
-    def _weight_chunks(self, chunk_mb: int):
+    def _weight_chunks(
+        self,
+        chunk_mb: int,
+        wire_dtype: str | None = None,
+        delta_only: bool = False,
+        new_fingerprints: dict | None = None,
+    ):
         """Yield dotted-path-named host-array chunks of <= chunk_mb MB
         each. The staging buffer holds one chunk at a time, bounding host
         RAM like the reference's weight_chunked_mem_mb bucketing
-        (fsdp_engine.py:359-401)."""
+        (fsdp_engine.py:359-401). ``wire_dtype`` casts each leaf ON DEVICE
+        before the host gather (bf16 halves the wire bytes of an
+        fp32-trained model); ``delta_only`` skips leaves whose content
+        digest matches the last successful push (``new_fingerprints``
+        collects this push's digests — the caller commits them into
+        ``self._wire_fingerprints`` only after the push succeeds)."""
         multi = distributed.process_count() > 1
+        wire = _DTYPES[wire_dtype] if wire_dtype else None
 
         def materialize(leaf):
+            if wire is not None and leaf.dtype != wire:
+                leaf = leaf.astype(wire)  # device-side cast, XLA-fused
             if multi:
                 # cross-host sharded leaf: every host joins the gather (a
                 # collective) even though only host 0 pushes the chunks
                 return distributed.gather_host_values(leaf)
             return np.asarray(jax.device_get(leaf))
 
-        yield from self._chunked(chunk_mb, materialize)
+        skip = None
+        if delta_only:
+            fingerprints = self._wire_fingerprints
 
-    def _weight_chunks_device(self, chunk_mb: int):
+            def skip(path, arr):
+                digest = self._leaf_digest(arr)
+                if new_fingerprints is not None:
+                    new_fingerprints[path] = digest
+                return fingerprints.get(path) == digest
+
+        yield from self._chunked(chunk_mb, materialize, skip=skip)
+
+    def _weight_chunks_device(
+        self, chunk_mb: int, wire_dtype: str | None = None
+    ):
         """Like :meth:`_weight_chunks` but yields LIVE device arrays (no
         host gather): the device-transfer path ships buffers
         device-to-device, so pulling them through host numpy would defeat
         the point. Leaves stay in their training sharding; the client
-        gathers each chunk single-shard on device."""
-        yield from self._chunked(chunk_mb, lambda leaf: leaf)
+        gathers each chunk single-shard on device. No delta support here —
+        exact fingerprints need host bytes this path exists to avoid."""
+        wire = _DTYPES[wire_dtype] if wire_dtype else None
+
+        def materialize(leaf):
+            if wire is not None and leaf.dtype != wire:
+                return leaf.astype(wire)
+            return leaf
+
+        yield from self._chunked(chunk_mb, materialize)
 
     def update_weights(self, meta: WeightUpdateMeta | None = None):
         """Push current weights to the paired rollout engine and bump
@@ -1686,6 +1781,16 @@ class TPUTrainEngine(TrainEngine):
         process-group machinery); type="disk" => safetensors + fan-out."""
         meta = meta or self._weight_update_meta
         assert meta is not None, "call connect_engine first or pass meta"
+        if (meta.delta_only or meta.wire_dtype) and meta.type not in (
+            "http", "shm", "device_transfer"
+        ):
+            # loud, not silent: the knobs only exist on the streamed
+            # paths — a disk/device/lora push would ship full-size,
+            # full-dtype with no signal otherwise
+            raise NotImplementedError(
+                "wire_dtype/delta_only apply to the streamed weight-update "
+                f"paths (http/shm/device_transfer), not type={meta.type!r}"
+            )
         next_version = self.get_version() + 1
         if meta.type == "device":
             target = self._rollout_engine
@@ -1705,12 +1810,54 @@ class TPUTrainEngine(TrainEngine):
             assert target is not None and hasattr(target, method), (
                 f"{meta.type} weight updates need a RemoteInfEngine"
             )
-            chunks = self._weight_chunks(meta.chunked_mem_mb)
+            if meta.delta_only and distributed.process_count() > 1:
+                # the full-re-ship reset below keys off the CLIENT's server
+                # list, which only the rollout head sees — spectator hosts
+                # would keep skipping leaves the head re-ships, and the
+                # per-leaf gather collectives would diverge (deadlock)
+                raise NotImplementedError(
+                    "delta_only weight sync is single-process-trainer only; "
+                    "multi-host needs the reset decision broadcast"
+                )
+            if meta.delta_only:
+                # a changed server set (scale-up, replacement node) voids
+                # the delta baseline: a fresh server holds none of the
+                # previously-shipped leaves, so ship everything once
+                addrs = tuple(sorted(getattr(target, "addresses", ()) or ()))
+                if addrs != self._wire_fp_addrs:
+                    if self._wire_fp_addrs is not None:
+                        logger.info(
+                            "delta weight sync: server set changed; "
+                            "forcing a full re-ship"
+                        )
+                    self._wire_fingerprints.clear()
+                    self._wire_fp_addrs = addrs
+            new_fp: dict[str, bytes] = {}
+            chunks = self._weight_chunks(
+                meta.chunked_mem_mb,
+                wire_dtype=meta.wire_dtype,
+                delta_only=meta.delta_only,
+                new_fingerprints=new_fp,
+            )
             if distributed.process_count() > 1 and not distributed.is_main():
                 for _ in chunks:  # join the per-leaf gather collectives
                     pass
+            elif meta.delta_only and self._wire_fingerprints:
+                # the stream only carries changed leaves: stamp the base
+                # version so a server not exactly there (silent restart at
+                # the same address) refuses instead of committing a mixed
+                # tree (it then rejoins via the disk re-push)
+                getattr(target, method)(
+                    chunks, next_version,
+                    delta_base_version=next_version - 1,
+                )
             else:
                 getattr(target, method)(chunks, next_version)
+            if meta.delta_only:
+                # only after the push SUCCEEDED: a failed push must re-ship
+                # these leaves next time (quarantined servers rejoin via
+                # the version-checked disk re-push, not via deltas)
+                self._wire_fingerprints.update(new_fp)
         elif meta.type == "device_transfer":
             # cross-process DEVICE-PATH resync: servers pull staged
             # buffers from this process's transfer server directly into
@@ -1726,12 +1873,23 @@ class TPUTrainEngine(TrainEngine):
                     "trainer are not wired (leaves are not fully "
                     "addressable per process); use type='http' or 'shm'"
                 )
+            if meta.delta_only:
+                # loud, not silent: exact fingerprints need the host bytes
+                # this path exists to avoid — a user who set the knob must
+                # not believe they are getting delta sync
+                raise NotImplementedError(
+                    "delta_only is not supported on the device_transfer "
+                    "path (no host bytes to fingerprint exactly); use "
+                    "type='http' or 'shm'"
+                )
             target = self._rollout_engine
             assert target is not None and hasattr(
                 target, "update_weights_from_device_transfer"
             ), "device_transfer weight updates need a RemoteInfEngine"
             target.update_weights_from_device_transfer(
-                self._weight_chunks_device(meta.chunked_mem_mb),
+                self._weight_chunks_device(
+                    meta.chunked_mem_mb, wire_dtype=meta.wire_dtype
+                ),
                 next_version,
             )
         elif meta.type == "lora":

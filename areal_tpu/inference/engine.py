@@ -114,6 +114,13 @@ class GenerationEngine:
         # must not change value underneath the caller
         config = dataclasses.replace(config)
         self.config = config
+        if config.jax_compilation_cache_dir:
+            # before ANY jit below: a relaunched server (PR 4 preemption
+            # plane) reloads its decode/prefill executables instead of
+            # paying full recompile
+            from areal_tpu.utils.jax_cache import configure_compilation_cache
+
+            configure_compilation_cache(config.jax_compilation_cache_dir)
         self.tokenizer = tokenizer
         devices = devices if devices is not None else jax.devices()
         tp, pp = config.tp_size, config.pp_size
@@ -335,7 +342,17 @@ class GenerationEngine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._abort_rids: set[str] = set()
-        self._staging_params = None  # in-flight chunked tensor update
+        # Pipelined weight sync: chunks are STAGED off the engine thread
+        # (device_put onto the live leaves' shardings, no touch of
+        # self.params) while decode dispatches continue; the engine thread
+        # only runs the final pointer-flip commit. _staged_leaves maps
+        # dotted path -> placed jax.Array; _staging_version tags which
+        # update the staged set belongs to, so a torn stream's leftovers
+        # are superseded (abandoned) by the next update instead of
+        # corrupting it.
+        self._staged_leaves: dict[str, Any] = {}  # guarded_by: _staging_lock
+        self._staging_version: int | None = None  # guarded_by: _staging_lock
+        self._staging_lock = threading.Lock()
         # adapter-native serving: pristine base params retained across
         # adapter-only updates (None until the first /update_lora_weights)
         self._lora_base = None
@@ -385,6 +402,20 @@ class GenerationEngine:
         # tokens including each sequence's prefill-sampled first token
         self.prompt_tokens_total = 0
         self.generated_tokens_total = 0
+        # decode dispatches issued (plain multi-step + speculative windows):
+        # the overlap tests assert this keeps advancing while weight chunks
+        # stream in, proving staging never fences the decode loop
+        self.decode_dispatch_count = 0
+        # weight-sync observability (surfaced via server /model_info): the
+        # headline is weight_sync_stall_seconds — the fenced window on the
+        # engine thread (commit dequeue -> version bump), which the
+        # pipelined design shrinks to the final pointer flip
+        self.weight_sync_stall_seconds_last = 0.0
+        self.weight_sync_stall_seconds_total = 0.0
+        self.weight_sync_commits_total = 0
+        self.weight_sync_staged_chunks_total = 0
+        self.weight_sync_staged_bytes_total = 0
+        self.weight_sync_aborted_updates_total = 0
         self._lock = threading.Lock()
         self._dead: Exception | None = None
 
@@ -827,28 +858,137 @@ class GenerationEngine:
         self._paused.clear()
         self._wake.set()
 
+    def _run_command(self, name: str, *args):
+        """Submit one command to the engine thread and block until it is
+        handled; raise a descriptive error if the engine thread does not
+        complete it within ``config.command_timeout_seconds`` (a hung or
+        compile-bound engine loop must name the command it is sitting on,
+        not surface an anonymous queue.Empty after an arbitrary wait)."""
+        done: queue.Queue = queue.Queue()
+        self._cmd_queue.put((name, *args, done))
+        self._wake.set()
+        timeout = self.config.command_timeout_seconds
+        try:
+            err = done.get(timeout=timeout)
+        except queue.Empty:
+            if self._dead is not None:
+                raise RuntimeError(
+                    f"engine loop died while command {name!r} was pending"
+                ) from self._dead
+            raise TimeoutError(
+                f"engine thread did not complete command {name!r} within "
+                f"{timeout}s (knob: JaxGenConfig.command_timeout_seconds; "
+                f"{self._cmd_queue.qsize()} command(s) still queued — long "
+                "compile in progress, or the engine thread was never "
+                "started?)"
+            ) from None
+        if err is not None:
+            raise err
+
     def update_weights_from_disk(self, path: str, version: int | None = None):
         """Swap params in place; must run on the engine thread between
         dispatches. Blocks until done."""
-        done: queue.Queue = queue.Queue()
-        self._cmd_queue.put(("update_weights", path, version, done))
-        self._wake.set()
-        err = done.get(timeout=600.0)
-        if err is not None:
-            raise err
+        self._run_command("update_weights", path, version)
+
+    def stage_weight_chunk(self, named: dict, version: int | None = None):
+        """Stage one chunk of dotted-path-named host arrays for a pending
+        weight update WITHOUT touching the live params: each array is
+        device_put onto its target leaf's sharding from the CALLER's thread,
+        so decode dispatches on the engine thread proceed untouched while
+        the transfer streams in. ``version`` tags the update this chunk
+        belongs to; a chunk tagged differently than the staged set
+        supersedes it (torn/abandoned stream — the old staging is dropped,
+        the server keeps serving its current version). ``None`` joins the
+        current staging regardless of tag."""
+        with self._staging_lock:
+            if (
+                version is not None
+                and self._staging_version is not None
+                and version != self._staging_version
+            ):
+                logger.warning(
+                    "abandoning %d staged weight leaves tagged v%s: a chunk "
+                    "for v%d superseded them (torn stream?)",
+                    len(self._staged_leaves), self._staging_version, version,
+                )
+                self._staged_leaves = {}
+                self.weight_sync_aborted_updates_total += 1
+            if version is not None:
+                self._staging_version = version
+        params = self.params  # one consistent tree snapshot
+        placed: dict[str, Any] = {}
+        nbytes = 0
+        for name, arr in named.items():
+            node = params
+            parts = name.split(".")
+            try:
+                for p in parts[:-1]:
+                    node = node[p]
+                leaf = node[parts[-1]]
+            except (KeyError, TypeError):
+                self.abandon_staged_weights()
+                raise ValueError(f"unknown param leaf {name!r}") from None
+            if tuple(arr.shape) != tuple(leaf.shape):
+                self.abandon_staged_weights()
+                raise ValueError(
+                    f"shape mismatch for {name}: {arr.shape} vs {leaf.shape}"
+                )
+            placed[name] = jax.device_put(
+                arr.astype(leaf.dtype)
+                if getattr(arr, "dtype", None) != leaf.dtype
+                else arr,
+                leaf.sharding,
+            )
+            nbytes += int(
+                getattr(arr, "nbytes", arr.size * arr.dtype.itemsize)
+            )
+        with self._staging_lock:
+            if version is not None and self._staging_version != version:
+                # superseded while we were placing (a racing chunk from a
+                # NEWER update re-tagged the staging set): drop this
+                # chunk's arrays rather than splice stale-version leaves
+                # into the newer update's commit
+                logger.warning(
+                    "dropping %d staged leaves tagged v%d: staging was "
+                    "re-tagged v%s while they were being placed",
+                    len(placed), version, self._staging_version,
+                )
+                return
+            self._staged_leaves.update(placed)
+            self.weight_sync_staged_chunks_total += 1
+            self.weight_sync_staged_bytes_total += nbytes
+
+    def commit_staged_weights(self, version: int):
+        """Atomically flip the live params to include every staged leaf and
+        bump the served version — the ONLY fenced step of a pipelined
+        weight update (runs on the engine thread between dispatches).
+        Raises if nothing is staged or the staged set is tagged for a
+        different version."""
+        self._run_command("commit_staged", version)
+
+    def abandon_staged_weights(self):
+        """Drop any staged-but-uncommitted weight chunks (failed stream).
+        The live params and version are untouched; the server keeps serving
+        the old weights and the client's rejoin probe re-syncs it later."""
+        with self._staging_lock:
+            if self._staged_leaves or self._staging_version is not None:
+                self._staged_leaves = {}
+                self._staging_version = None
+                self.weight_sync_aborted_updates_total += 1
 
     def update_weights_from_named_arrays(
         self, named: dict, version: int | None = None
     ):
         """Apply one chunk of dotted-path-named host arrays (the
         /update_weights_from_tensor payload) into the live sharded params.
-        ``version=None`` = partial chunk (more coming, don't bump)."""
-        done: queue.Queue = queue.Queue()
-        self._cmd_queue.put(("update_named", named, version, done))
-        self._wake.set()
-        err = done.get(timeout=600.0)
-        if err is not None:
-            raise err
+        ``version=None`` = partial chunk (more coming, don't bump).
+
+        Staging (device placement) runs on the CALLER's thread so decode
+        continues between chunks; only the final commit (``version`` set)
+        fences the engine thread for the pointer flip."""
+        self.stage_weight_chunk(named, version)
+        if version is not None:
+            self.commit_staged_weights(version)
 
     def update_lora_from_named_arrays(
         self, named: dict, scale: float, version: int | None = None
@@ -861,12 +1001,7 @@ class GenerationEngine:
         every adapted leaf. A LoRA sync therefore ships megabytes (rank-r
         factors) instead of the full parameter set, which is the main
         operational reason to train LoRA in async RL."""
-        done: queue.Queue = queue.Queue()
-        self._cmd_queue.put(("update_lora", named, scale, version, done))
-        self._wake.set()
-        err = done.get(timeout=600.0)
-        if err is not None:
-            raise err
+        self._run_command("update_lora", named, scale, version)
 
     def update_weights_from_device_pull(
         self,
@@ -874,12 +1009,16 @@ class GenerationEngine:
         uuid: int,
         leaves: list,  # [(dotted_path, shape, dtype_str), ...] one chunk
         version: int | None = None,
+        final: bool = True,
     ):
         """Cross-process device-path weight chunk (the reference's NCCL
         broadcast role, fsdp_engine.py:359-401): pull the staged buffers
         from the trainer's transfer server straight into this process's
-        device memory — no safetensors body, no host staging — then apply
-        like any named chunk. ``version=None`` = more chunks coming."""
+        device memory — no safetensors body, no host staging — then stage
+        like any named chunk (decode keeps dispatching; only the final
+        chunk's commit fences the engine). ``version`` tags every chunk so
+        a torn stream is superseded by the next update; the commit happens
+        only when ``final`` and a version are both set."""
         import jax.experimental.transfer  # noqa: F401 — fail early if absent
 
         from areal_tpu.utils import device_transfer
@@ -893,7 +1032,9 @@ class GenerationEngine:
             for path, shape, dtype in leaves
         }
         named = device_transfer.pull(address, uuid, specs)
-        self.update_weights_from_named_arrays(named, version)
+        self.stage_weight_chunk(named, version)
+        if final and version is not None:
+            self.commit_staged_weights(version)
 
     def update_weights_from_arrays(self, params, version: int | None = None):
         """Colocated device-to-device weight refresh: re-place live jax
@@ -901,12 +1042,7 @@ class GenerationEngine:
         — on a shared chip/slice this is an HBM-local copy, no disk or host
         roundtrip (the fast path the reference needs NCCL broadcast for,
         SURVEY §3.3)."""
-        done: queue.Queue = queue.Queue()
-        self._cmd_queue.put(("update_weights_arrays", params, version, done))
-        self._wake.set()
-        err = done.get(timeout=600.0)
-        if err is not None:
-            raise err
+        self._run_command("update_weights_arrays", params, version)
 
     def get_version(self) -> int:
         return self.version
@@ -964,50 +1100,79 @@ class GenerationEngine:
             if cmd[0] == "pause_ack":
                 self._abort_all("abort")
                 cmd[1].set()
-            elif cmd[0] == "update_named":
-                _, named, version, done = cmd
+            elif cmd[0] == "commit_staged":
+                _, version, done = cmd
+                t0 = time.monotonic()
                 try:
-                    t0 = time.monotonic()
-                    # stage into a deep-copied TREE (leaves are shared jax
-                    # arrays until replaced) and swap atomically on the final
-                    # chunk — decode between chunks must never see layer i at
-                    # v(n+1) while layer j is still v(n), and a mid-chunk
-                    # error must leave the live params untouched
-                    if self._staging_params is None:
-                        self._staging_params = jax.tree.map(
-                            lambda x: x, self.params
-                        )
-                    for name, arr in named.items():
-                        node = self._staging_params
+                    # the ONLY fenced step of a pipelined update: splice the
+                    # staged leaves into a fresh tree (structure copy; leaves
+                    # shared until replaced) and flip the pointer — decode
+                    # between chunks never sees layer i at v(n+1) while
+                    # layer j is still v(n), and a failed stream leaves the
+                    # live params untouched
+                    with self._staging_lock:
+                        # validate WITHOUT consuming: a stale commit
+                        # command (e.g. left queued after a _run_command
+                        # timeout) must not destroy a NEWER update's
+                        # staged set, and a commit that fails below (a
+                        # deferred device error surfacing in the readiness
+                        # check) must leave the full set in place — the
+                        # client's retry of the final chunk then commits
+                        # the WHOLE update, never just that chunk
+                        staged_version = self._staging_version
+                        if not self._staged_leaves:
+                            raise RuntimeError(
+                                f"commit of weight version {version} found "
+                                "no staged chunks (stream torn or already "
+                                "superseded); serving stays at "
+                                f"v{self.version}"
+                            )
+                        if (
+                            staged_version is not None
+                            and staged_version != version
+                        ):
+                            raise RuntimeError(
+                                "staged weight chunks are tagged "
+                                f"v{staged_version} but commit asked for "
+                                f"v{version}; leaving them for their own "
+                                f"commit — serving stays at v{self.version}"
+                            )
+                        staged = dict(self._staged_leaves)
+                    new_params = jax.tree.map(lambda x: x, self.params)
+                    for name, arr in staged.items():
+                        node = new_params
                         parts = name.split(".")
                         for p in parts[:-1]:
                             node = node[p]
-                        leaf = node[parts[-1]]
-                        if arr.shape != leaf.shape:
-                            raise ValueError(
-                                f"shape mismatch for {name}: "
-                                f"{arr.shape} vs {leaf.shape}"
-                            )
-                        node[parts[-1]] = jax.device_put(
-                            arr.astype(leaf.dtype), leaf.sharding
-                        )
-                    if version is not None:
-                        jax.block_until_ready(
-                            jax.tree_util.tree_leaves(self._staging_params)[0]
-                        )
-                        self.params = self._staging_params
-                        self._staging_params = None
-                        self._lora_base = None  # base changed; re-snapshot
-                        self.version = version
-                        logger.info(
-                            "weights updated (tensor) -> v%d (+%.2fs final chunk)",
-                            self.version,
-                            time.monotonic() - t0,
-                        )
+                        node[parts[-1]] = arr
+                    # staged leaves were device_put as they streamed in, so
+                    # this readiness check is usually a no-op — the fence
+                    # really is just the pointer flip
+                    jax.block_until_ready(list(staged.values()))
+                    # success: consume exactly what was committed (a chunk
+                    # from a superseding update that raced in keeps its
+                    # own staging)
+                    with self._staging_lock:
+                        if self._staging_version == staged_version:
+                            for name in staged:
+                                self._staged_leaves.pop(name, None)
+                            if not self._staged_leaves:
+                                self._staging_version = None
+                    self.params = new_params
+                    self._lora_base = None  # base changed; re-snapshot
+                    self.version = version
+                    stall = time.monotonic() - t0
+                    self.weight_sync_stall_seconds_last = stall
+                    self.weight_sync_stall_seconds_total += stall
+                    self.weight_sync_commits_total += 1
+                    logger.info(
+                        "weights updated (staged commit of %d leaves) -> "
+                        "v%d (fenced %.4fs)",
+                        len(staged), self.version, stall,
+                    )
                     done.put(None)
                 except Exception as e:
-                    logger.exception("named weight update failed")
-                    self._staging_params = None  # abandon the partial set
+                    logger.exception("staged weight commit failed")
                     done.put(e)
             elif cmd[0] == "update_lora":
                 _, named, scale, version, done = cmd
@@ -1063,6 +1228,11 @@ class GenerationEngine:
                 _, src, version, done = cmd
                 try:
                     t0 = time.monotonic()
+                    # a full refresh supersedes any staged-but-uncommitted
+                    # stream: drop it so a torn update's device-placed
+                    # leaves stop pinning memory the moment the server is
+                    # re-synced (e.g. the quarantine-rejoin disk re-push)
+                    self.abandon_staged_weights()
                     # a full-weight refresh changes the base: a later
                     # adapter-only update must re-snapshot
                     self._lora_base = None
@@ -1927,6 +2097,7 @@ class GenerationEngine:
         # logits can never count as proposals/accepts in the metrics
         dlen = np.where(active, dlen, 0).astype(np.int32)
         temp, top_k, top_p, greedy = self._sampling_knobs()
+        self.decode_dispatch_count += 1
         toks, logps, n_acc, self.cache = self._jit_spec_decode(
             self.params,
             self.cache,
@@ -1982,6 +2153,7 @@ class GenerationEngine:
         active = np.array([s is not None for s in self.slots])
         nbt = self._bucket_table_width(nbt)
         temp, top_k, top_p, greedy = self._sampling_knobs()
+        self.decode_dispatch_count += 1
         toks, logps, self.cache = self._jit_decode(
             self.params,
             self.cache,

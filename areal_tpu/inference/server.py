@@ -147,6 +147,25 @@ class GenerationServer:
                 "spec_proposed_tokens_total": e.spec_proposed_tokens_total,
                 "spec_accepted_tokens_total": e.spec_accepted_tokens_total,
                 "spec_acceptance_rate": e.spec_acceptance_rate,
+                # pipelined weight sync: the headline stall is the FENCED
+                # window (commit dequeue -> version bump) — with staging
+                # overlapping decode it covers only the final pointer flip,
+                # not the transfer
+                "weight_sync_stall_seconds": e.weight_sync_stall_seconds_last,
+                "weight_sync_stall_seconds_total": (
+                    e.weight_sync_stall_seconds_total
+                ),
+                "weight_sync_commits_total": e.weight_sync_commits_total,
+                "weight_sync_staged_chunks_total": (
+                    e.weight_sync_staged_chunks_total
+                ),
+                "weight_sync_staged_bytes_total": (
+                    e.weight_sync_staged_bytes_total
+                ),
+                "weight_sync_aborted_updates_total": (
+                    e.weight_sync_aborted_updates_total
+                ),
+                "decode_dispatch_count": e.decode_dispatch_count,
             }
         )
 
@@ -196,19 +215,54 @@ class GenerationServer:
 
     async def update_weights_from_tensor(self, request: web.Request) -> web.Response:
         """No-disk weight update: body is one safetensors-encoded chunk of
-        native-pytree-named arrays; final=1 commits the new version."""
+        native-pytree-named arrays; final=1 commits the new version.
+
+        Chunks are STAGED (device-placed off the engine thread) while decode
+        keeps dispatching; only the final chunk's commit fences the engine
+        for the pointer flip. Every chunk carries its version tag, so a
+        torn stream's staged leftovers are superseded by the next update
+        instead of leaking into it."""
         from safetensors.numpy import load as st_load
+
+        from areal_tpu.utils import wire
 
         body = await request.read()
         version = request.query.get("version")
         final = request.query.get("final", "1") == "1"
+        delta_base = request.query.get("delta_base")
+        if delta_base is not None and self.engine.get_version() not in (
+            int(delta_base),
+            # base+1: we already committed this update but the client lost
+            # the response and is retrying the final chunk — re-applying
+            # the same leaves is an idempotent no-op, not a mixed tree
+            int(delta_base) + 1,
+        ):
+            # a delta stream only contains CHANGED leaves relative to
+            # delta_base; applying it on any other version (e.g. a server
+            # restarted at the same address with reloaded base weights)
+            # would commit a silently mixed tree. 412 is non-retriable —
+            # the client quarantines us and the disk rejoin re-syncs.
+            return web.json_response(
+                {
+                    "success": False,
+                    "message": (
+                        f"delta update requires weight version {delta_base}"
+                        f" but this server is at {self.engine.get_version()}"
+                    ),
+                },
+                status=412,
+            )
         try:
-            arrs = st_load(body)
+            arrs = wire.decode_named(st_load(body))
+
+            def stage_and_maybe_commit():
+                tag = int(version) if version is not None else None
+                self.engine.stage_weight_chunk(arrs, tag)
+                if final and tag is not None:
+                    self.engine.commit_staged_weights(tag)
+
             await asyncio.get_running_loop().run_in_executor(
-                None,
-                self.engine.update_weights_from_named_arrays,
-                arrs,
-                int(version) if (final and version is not None) else None,
+                None, stage_and_maybe_commit
             )
         except Exception as e:
             logger.exception("update_weights_from_tensor failed")
@@ -229,6 +283,23 @@ class GenerationServer:
         path = payload.get("path", "")
         version = payload.get("version")
         final = bool(payload.get("final", True))
+        delta_base = payload.get("delta_base")
+        if delta_base is not None and self.engine.get_version() not in (
+            int(delta_base),
+            int(delta_base) + 1,  # lost-response retry of a committed update
+        ):
+            # see update_weights_from_tensor: never apply a changed-leaves-
+            # only stream on a server at the wrong base version
+            return web.json_response(
+                {
+                    "success": False,
+                    "message": (
+                        f"delta update requires weight version {delta_base}"
+                        f" but this server is at {self.engine.get_version()}"
+                    ),
+                },
+                status=412,
+            )
         # resolve symlinks/..-segments BEFORE the containment check — a
         # startswith test alone is traversable ("/dev/shm/../etc/...")
         real = os.path.realpath(path)
@@ -242,14 +313,17 @@ class GenerationServer:
             from safetensors import safe_open
 
             def load_and_apply():
+                from areal_tpu.utils import wire
+
                 arrs = {}
                 with safe_open(path, framework="numpy") as f:
                     for name in f.keys():
                         arrs[name] = f.get_tensor(name)
-                self.engine.update_weights_from_named_arrays(
-                    arrs,
-                    int(version) if (final and version is not None) else None,
-                )
+                arrs = wire.decode_named(arrs)
+                tag = int(version) if version is not None else None
+                self.engine.stage_weight_chunk(arrs, tag)
+                if final and tag is not None:
+                    self.engine.commit_staged_weights(tag)
 
             await asyncio.get_running_loop().run_in_executor(
                 None, load_and_apply
@@ -271,11 +345,13 @@ class GenerationServer:
         megabytes instead of the full parameter set."""
         from safetensors.numpy import load as st_load
 
+        from areal_tpu.utils import wire
+
         body = await request.read()
         scale = float(request.query.get("scale", "1.0"))
         version = request.query.get("version")
         try:
-            arrs = st_load(body)
+            arrs = wire.decode_named(st_load(body))
             await asyncio.get_running_loop().run_in_executor(
                 None,
                 self.engine.update_lora_from_named_arrays,
@@ -307,10 +383,10 @@ class GenerationServer:
                 payload["leaves"],
                 (
                     int(payload["version"])
-                    if payload.get("final", True)
-                    and payload.get("version") is not None
+                    if payload.get("version") is not None
                     else None
                 ),
+                bool(payload.get("final", True)),
             )
         except Exception as e:
             logger.exception("update_weights_from_device failed")

@@ -10,6 +10,13 @@ untracked-task: the event loop holds only weak references to tasks — a
 fire-and-forget ``asyncio.create_task(...)`` whose result is dropped can be
 garbage-collected mid-flight. Keep a reference
 (``areal_tpu.utils.aio.create_tracked_task``) or await it.
+
+per-call-event-loop: ``asyncio.run(...)`` inside an ``# arealint:
+hot-path``-annotated function builds a fresh event loop — and, for HTTP
+work, a fresh session/connection pool — then tears both down, on EVERY
+call. On the weight-sync fan-out paths that cost recurs once per trainer
+step. Submit to a persistent loop instead
+(``RemoteInfEngine._run_push`` is the in-repo pattern).
 """
 
 from __future__ import annotations
@@ -99,6 +106,37 @@ class BlockingCallInAsyncRule(Rule):
                             "Future; await it instead"
                         ),
                         severity=SEVERITY_WARNING,
+                    )
+
+
+@register
+class PerCallEventLoopRule(Rule):
+    id = "per-call-event-loop"
+    severity = SEVERITY_WARNING
+    doc = (
+        "asyncio.run inside a hot-path function pays event-loop (and "
+        "connection-pool) setup/teardown on every call"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for func in ctx.functions():
+            if isinstance(func, ast.AsyncFunctionDef):
+                continue  # asyncio.run inside async def raises at runtime
+            if not ctx.is_hot(func):
+                continue
+            # nested defs excluded: a nested sync helper handed to a
+            # worker thread owns its own loop legitimately
+            for node in walk_excluding_nested_functions(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if ctx.resolved(node.func) == "asyncio.run":
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"asyncio.run(...) inside hot-path `{func.name}` "
+                        "builds and tears down an event loop per call; "
+                        "submit the coroutine to a persistent loop "
+                        "(run_coroutine_threadsafe) instead",
                     )
 
 

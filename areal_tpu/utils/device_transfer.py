@@ -144,3 +144,86 @@ def stage_for_pull(uuid: int, arrays, account: bool = True) -> int:
 def pull(address: str, uuid: int, specs):
     """Fetch a pytree of ShapeDtypeStructs (with shardings) from a peer."""
     return connect(address).pull(uuid, specs)
+
+
+class PrefetchIterator:
+    """Bounded background-thread producer over a chunk iterator.
+
+    The weight-sync chunk generators do real work per ``next()`` — a host
+    gather (``_weight_chunks``) or a single-shard device gather
+    (``_weight_chunks_device``) — which used to run INSIDE the transfer
+    loop, serializing gather/encode with the wire. Wrapping the generator
+    here runs that work on a daemon thread up to ``depth`` chunks ahead, so
+    chunk ``i+1`` gathers while chunk ``i`` is in flight, with host/device
+    staging RAM bounded at ``depth`` chunks beyond the consumer's.
+
+    Exceptions from the source iterator are re-raised at the consuming
+    ``next()`` call (wrapped exactly once, original traceback preserved).
+    A consumer that abandons the iterator mid-stream should call
+    :meth:`close` so the producer thread exits and drops its held chunks
+    (a host-gathered chunk can be chunked_mem_mb large; parking it on the
+    queue for the process lifetime is real RAM).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, source, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        import queue as _queue
+
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=depth)
+        self._source = source
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="weight-chunk-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that aborts when the consumer closed the iterator
+        (a plain put would park this thread — and the chunk it holds —
+        forever once the consumer is gone)."""
+        import queue as _queue
+
+        while not self._closed:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for item in self._source:
+                if not self._put(item):
+                    return  # closed: drop held chunks, exit the thread
+        except BaseException as e:  # noqa: BLE001 — re-raised at next()
+            self._put((self._SENTINEL, e))
+        else:
+            self._put((self._SENTINEL, None))
+
+    def close(self):
+        """Release the producer thread and drop buffered chunks. Idempotent;
+        safe to call with the producer blocked mid-put."""
+        self._closed = True
+        import queue as _queue
+
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is self._SENTINEL:
+            self._queue.put(item)  # keep the stream terminal for re-calls
+            if item[1] is None:
+                raise StopIteration
+            raise item[1]
+        return item

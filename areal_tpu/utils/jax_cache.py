@@ -1,0 +1,79 @@
+"""Persistent JAX compilation cache wiring.
+
+A preempted-and-relaunched trainer or generation server (PR 4's recovery
+plane) pays full XLA recompile on every restart unless the compilation
+cache is pointed at a persistent directory. One knob
+(``jax_compilation_cache_dir`` on TrainEngineConfig / JaxGenConfig) routes
+here; both the train engine and the generation engine call
+:func:`configure_compilation_cache` during startup.
+
+Idempotent and conflict-checked: configuring the same directory twice is a
+no-op, configuring two DIFFERENT directories in one process raises (the
+cache is process-global — silently switching it mid-run would split the
+cache and hide the misconfiguration).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("JaxCache")
+
+_LOCK = threading.Lock()
+_CONFIGURED_DIR: str | None = None
+
+
+def configured_dir() -> str | None:
+    """The directory the process-global compilation cache was pointed at by
+    :func:`configure_compilation_cache` (None = never configured)."""
+    with _LOCK:
+        return _CONFIGURED_DIR
+
+
+def configure_compilation_cache(cache_dir: str | None) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True when the cache was (already) configured to ``cache_dir``,
+    False when ``cache_dir`` is falsy (knob unset — nothing happens).
+    Creating the directory is part of configuring: a relaunch must not
+    fail because the first launch never got far enough to create it.
+    """
+    global _CONFIGURED_DIR
+    if not cache_dir:
+        return False
+    cache_dir = os.path.abspath(cache_dir)
+    with _LOCK:
+        if _CONFIGURED_DIR is not None:
+            if _CONFIGURED_DIR != cache_dir:
+                raise RuntimeError(
+                    "jax compilation cache already configured at "
+                    f"{_CONFIGURED_DIR!r}; refusing to re-point it at "
+                    f"{cache_dir!r} (the cache is process-global — set ONE "
+                    "jax_compilation_cache_dir per process)"
+                )
+            return True
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: the relaunch-after-preemption win is the
+        # SUM over every jitted program, most of which compile in <1s on CPU
+        # test shapes but minutes on real models
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except AttributeError:  # older jax without the knob
+            pass
+        _CONFIGURED_DIR = cache_dir
+        logger.info("persistent jax compilation cache at %s", cache_dir)
+        return True
+
+
+def _reset_for_tests() -> None:
+    """Drop the process-global configured-dir latch (tests only — the jax
+    config itself is NOT reverted)."""
+    global _CONFIGURED_DIR
+    with _LOCK:
+        _CONFIGURED_DIR = None

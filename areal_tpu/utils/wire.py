@@ -1,0 +1,46 @@
+"""Wire encoding for safetensors weight chunks.
+
+The safetensors numpy interface in this image can SAVE ml_dtypes.bfloat16
+arrays but cannot LOAD them back (``_TYPES`` has no 'BF16' entry —
+``KeyError: 'BF16'`` on the receiving side). Since bf16 is both the
+default training dtype and the natural ``WeightUpdateMeta.wire_dtype``,
+bf16 leaves ride the wire bit-exactly as uint16 views under a name
+marker and are re-viewed on the receiving side. Every other dtype passes
+through untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: appended to a leaf's dotted path when its payload is a uint16 view of
+#: bfloat16 data ("::" can never appear in a real pytree path)
+BF16_MARKER = "::bf16"
+
+
+def encode_named(named: dict) -> dict:
+    """Prepare a dotted-path -> array chunk for safetensors: contiguous,
+    with bfloat16 leaves re-viewed as uint16 under ``path + BF16_MARKER``."""
+    out = {}
+    for k, v in named.items():
+        v = np.ascontiguousarray(v)
+        if str(v.dtype) == "bfloat16":
+            out[k + BF16_MARKER] = v.view(np.uint16)
+        else:
+            out[k] = v
+    return out
+
+
+def decode_named(named: dict) -> dict:
+    """Invert :func:`encode_named` after safetensors load (bit-exact)."""
+    import ml_dtypes
+
+    out = {}
+    for k, v in named.items():
+        if k.endswith(BF16_MARKER):
+            out[k[: -len(BF16_MARKER)]] = np.asarray(v).view(
+                ml_dtypes.bfloat16
+            )
+        else:
+            out[k] = v
+    return out

@@ -598,6 +598,194 @@ def weight_update_bench(layers: int = 28, chunk_mb: int = 512,
         eng.stop()
 
 
+def weight_sync_bench(layers: int = 2, vocab: int = 2048, chunk_mb: int = 64,
+                      batch: int = 8, steps_per_call: int = 4,
+                      max_seq_len: int = 512):
+    """Zero-stall weight sync: tokens/s dip + fenced-window size while a
+    tensor weight update streams into a LIVE decoding server, overlapped
+    (pipelined staging, PR 5) vs fenced (pause -> update -> continue).
+
+    The headline is ``weight_sync_stall_seconds`` — the engine-thread
+    fence (commit dequeue -> version bump) the server reports in
+    /model_info. Under the pipelined design it covers only the final
+    pointer flip; the fenced comparison pays the whole transfer inside
+    the pause window."""
+    import asyncio
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=batch, max_seq_len=max_seq_len, prefill_chunk=128,
+            decode_steps_per_call=steps_per_call, dtype="bfloat16",
+            page_size=max_seq_len,  # no mid-run table retrace
+        ),
+        model_config=model_cfg,
+    )
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=120)
+    addr = f"127.0.0.1:{port}"
+
+    client = RemoteInfEngine(InferenceEngineConfig())
+    client.addresses = [addr]
+
+    rng = np.random.default_rng(0)
+    shapes = []
+
+    def walk(node, prefix):
+        for k in sorted(node):
+            v = node[k]
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, path)
+            else:
+                shapes.append((path, tuple(v.shape)))
+
+    import jax as _jax
+
+    walk(_jax.tree.map(lambda x: x, eng.params), "")
+    payload_mb = sum(
+        int(np.prod(s)) * 4 for _, s in shapes
+    ) / 1e6
+
+    def chunks():
+        # own generator: this runs on the push loop's worker thread while
+        # load_loop uses `rng` concurrently, and numpy Generators are not
+        # thread-safe
+        crng = np.random.default_rng(1)
+        budget = chunk_mb * 1_000_000
+        cur, size = {}, 0
+        for path, shape in shapes:
+            arr = crng.standard_normal(size=shape).astype(np.float32)
+            if cur and size + arr.nbytes > budget:
+                yield cur
+                cur, size = {}, 0
+            cur[path] = arr
+            size += arr.nbytes
+        if cur:
+            yield cur
+
+    def post(endpoint):
+        req = urllib.request.Request(
+            f"http://{addr}/{endpoint}", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=60).read()
+
+    def model_info():
+        with urllib.request.urlopen(
+            f"http://{addr}/model_info", timeout=10
+        ) as resp:
+            return _json.loads(resp.read())
+
+    stop = threading.Event()
+
+    def load_loop():
+        """Keep ~batch requests in flight; finished/aborted requests are
+        replaced so generated_tokens_total keeps moving."""
+        sem = threading.Semaphore(batch)
+        i = 0
+        gcfg = GenerationHyperparameters(
+            max_new_tokens=96, min_new_tokens=96, temperature=1.0
+        )
+        while not stop.is_set():
+            sem.acquire()
+
+            def cb(r, _s=sem):
+                _s.release()
+
+            try:
+                eng.submit(
+                    f"load-{i}",
+                    rng.integers(1, vocab - 2, size=32).tolist(),
+                    gcfg, cb,
+                )
+            except RuntimeError:
+                return
+            i += 1
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+
+    def tps_window(seconds: float) -> float:
+        a = eng.generated_tokens_total
+        t0 = time.perf_counter()
+        time.sleep(seconds)
+        return (eng.generated_tokens_total - a) / (time.perf_counter() - t0)
+
+    try:
+        # warmup: compile prefill/decode before any timed window
+        deadline = time.time() + 300
+        while eng.generated_tokens_total < 64 and time.time() < deadline:
+            time.sleep(0.1)
+        assert eng.generated_tokens_total >= 64, "decode load never warmed"
+
+        steady_tps = tps_window(2.0)
+
+        # --- overlapped: chunks stream + stage while decode dispatches ---
+        a_tokens = eng.generated_tokens_total
+        t0 = time.perf_counter()
+        client.update_weights_from_tensors(chunks(), next_version=1)
+        overlapped_update_s = time.perf_counter() - t0
+        overlapped_window_tps = (
+            (eng.generated_tokens_total - a_tokens) / overlapped_update_s
+        )
+        info = model_info()
+        overlapped_stall_s = info["weight_sync_stall_seconds"]
+
+        time.sleep(1.0)  # settle
+
+        # --- fenced: classic pause -> full transfer -> continue ---
+        a_tokens = eng.generated_tokens_total
+        t0 = time.perf_counter()
+        post("pause_generation")
+        client.update_weights_from_tensors(chunks(), next_version=2)
+        post("continue_generation")
+        fenced_update_s = time.perf_counter() - t0
+        fenced_window_tps = (
+            (eng.generated_tokens_total - a_tokens) / fenced_update_s
+        )
+        return {
+            "weight_sync_stall_seconds": round(overlapped_stall_s, 4),
+            "fenced_stall_seconds": round(fenced_update_s, 3),
+            "overlapped_update_s": round(overlapped_update_s, 3),
+            "steady_tokens_per_sec": round(steady_tps, 1),
+            "overlapped_window_tokens_per_sec": round(
+                overlapped_window_tps, 1
+            ),
+            "fenced_window_tokens_per_sec": round(fenced_window_tps, 1),
+            "payload_mb_fp32": round(payload_mb, 1),
+            "layers": layers,
+        }
+    finally:
+        stop.set()
+        client._close_push_loop()
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
 # ---------------------------------------------------------------------------
 # Main ladder
 # ---------------------------------------------------------------------------
@@ -874,6 +1062,38 @@ def main():
         except Exception as e:  # noqa: BLE001
             log(f"weight-update rung failed: {e}")
 
+    # ---- rung 3.6: zero-stall weight sync (overlapped vs fenced) ----
+    if remaining(deadline) > 420:
+        try:
+            log("weight-sync (zero-stall) rung")
+            ws = _run_child(
+                "wsync",
+                (dict(layers=2, vocab=2048, chunk_mb=8, batch=4)
+                 if REHEARSAL
+                 else dict(
+                     layers=(used or {"layers": 28})["layers"],
+                     chunk_mb=256,
+                 )),
+                timeout=min(1200.0, remaining(deadline) - 60),
+            )
+            emit({
+                "metric": "weight_sync_stall_seconds",
+                "value": ws["weight_sync_stall_seconds"],
+                "unit": "s",
+                # how much of the fenced stall the pipelined path eliminates
+                "vs_baseline": (
+                    round(
+                        ws["fenced_stall_seconds"]
+                        / max(ws["weight_sync_stall_seconds"], 1e-4),
+                        1,
+                    )
+                ),
+                "chip": chip,
+                **ws,
+            })
+        except Exception as e:  # noqa: BLE001
+            log(f"weight-sync rung failed: {e}")
+
     # ---- rung 4: full GRPO step (async-RL headline metric) ----
     if remaining(deadline) > 420:
         try:
@@ -926,6 +1146,8 @@ def _child_main():
         print(json.dumps(decode_bench(**att)))
     elif kind == "--wu-child":
         print(json.dumps(weight_update_bench(**att)))
+    elif kind == "--wsync-child":
+        print(json.dumps(weight_sync_bench(**att)))
     elif kind == "--grpo-child":
         from bench_grpo import grpo_step_bench
 

@@ -1,0 +1,31 @@
+"""TN fixture: per-call-event-loop stays quiet off the hot path, on
+persistent-loop submission, and inside nested helpers that own their loop."""
+
+import asyncio
+
+
+async def _work():
+    await asyncio.sleep(0)
+
+
+class Engine:
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+
+    def one_shot_cli_entry(self):
+        # not hot-path annotated: a per-call loop is fine for one-shot
+        # convenience wrappers
+        return asyncio.run(_work())
+
+    # arealint: hot-path
+    def update_weights(self):
+        # the fix: submit to the persistent loop instead of building one
+        return asyncio.run_coroutine_threadsafe(_work(), self._loop).result()
+
+    # arealint: hot-path
+    def dispatch_to_worker(self):
+        def in_worker_thread():
+            # nested sync helper handed to a worker thread owns its loop
+            return asyncio.run(_work())
+
+        return in_worker_thread

@@ -1,0 +1,23 @@
+"""TP fixture: asyncio.run inside hot-path-annotated scopes builds and
+tears down an event loop (and any connection pool) per call."""
+
+import asyncio
+
+
+async def _work():
+    await asyncio.sleep(0)
+
+
+class Engine:
+    # arealint: hot-path
+    def update_weights(self):
+        return asyncio.run(_work())  # lint-expect: per-call-event-loop
+
+    def fanout(self):  # arealint: hot-path
+        results = asyncio.run(_work())  # lint-expect: per-call-event-loop
+        return results
+
+
+# arealint: hot-path
+def module_level_hot():
+    asyncio.run(_work())  # lint-expect: per-call-event-loop
