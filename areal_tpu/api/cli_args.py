@@ -534,6 +534,25 @@ class JaxGenConfig:
     # for its whole prompt; the slot joins decode only when warm. 0 = off
     # (whole-prompt dispatches, still token-budgeted per loop iteration).
     chunked_prefill_tokens: int = 0
+    # preferred name for the chunked-prefill chunk size (serving-plane
+    # naming parity with vLLM/SGLang); > 0 overrides
+    # ``chunked_prefill_tokens``. Both knobs drive the same machinery.
+    prefill_chunk_size: int = 0
+    # radix prefix cache (inference/prefix_cache.py): finished sequences
+    # register their FULL KV blocks under their token prefix; a later
+    # request whose prompt shares that prefix sets cache_len to the covered
+    # blocks and prefills only the uncovered suffix. Survives slot churn
+    # (unlike enable_prefix_reuse's slot-level clone paths, which remain
+    # the zero-dispatch fast path while the source slot is intact).
+    # Weight commits version-fence the cache: stale-version blocks are
+    # never spliced into a new-version prefill.
+    enable_prefix_cache: bool = True
+    # token-budget admission control (inference/scheduler.py): total KV
+    # tokens committed to running + warming sequences may not exceed this;
+    # requests beyond it stay QUEUED instead of thrashing cache eviction,
+    # and a request that could never fit is refused outright. 0 = derive
+    # from pool capacity (kv_pool_tokens).
+    admission_token_budget: int = 0
     # "int8" stores the paged KV pool as int8 + per-(row, head) scales:
     # ~half the HBM per cached token, ~double the concurrent sequences at
     # the same kv_pool_tokens byte budget (quality: symmetric per-row
@@ -711,6 +730,26 @@ class InferenceEngineConfig:
     # quarantined) as long as at least this fraction of servers took the
     # update; below it the step raises
     update_weights_min_healthy_fraction: float = 0.5
+    # cache-aware routing: route requests by a hash of their leading prompt
+    # tokens (rendezvous/highest-random-weight over the ROUTABLE servers),
+    # so a GRPO group's group_size identical prompts — and a multi-turn
+    # conversation's growing prefix — land on the server that already holds
+    # their KV prefix in its radix cache. Layered UNDER the breaker plane:
+    # rid affinity (a resumed request's server holds its exact KV) still
+    # wins, and a tripped breaker overrides affinity entirely.
+    cache_aware_routing: bool = True
+    # how many leading prompt tokens feed the affinity hash; conversations
+    # that share at least this prefix co-locate. 0 disables the signal
+    # (equivalent to cache_aware_routing=False).
+    route_affinity_prefix_tokens: int = 512
+    # hotspot guard: when the affinity-preferred server already carries
+    # this many MORE in-flight requests (from this client) than the
+    # least-loaded routable candidate, the request falls back to the
+    # configured load policy instead — a fleet whose prompts all share one
+    # long template prefix must not collapse onto a single server. Sized
+    # so a GRPO group (typically <= 16 clones) still co-locates. 0
+    # disables the guard (affinity always wins).
+    route_affinity_max_inflight_skew: int = 32
     # pipelined weight sync: how many encoded/staged chunks the producer may
     # run AHEAD of the slowest server's stream (chunk i+1 gathers/encodes
     # while chunk i is in flight). Bounds staging RAM at roughly

@@ -218,14 +218,69 @@ class RemoteInfEngine(InferenceEngine):
     # server selection
     # ------------------------------------------------------------------
 
+    def prefix_affinity_key(self, input_ids) -> bytes | None:
+        """Cache-affinity signal for :meth:`choose_server`: a stable hash
+        of the request's leading ``route_affinity_prefix_tokens`` prompt
+        tokens. A GRPO group's ``group_size`` identical prompts — and a
+        multi-turn conversation's growing prefix — produce the SAME key,
+        so they co-locate on the server whose radix cache already holds
+        their prefix KV. None disables the signal for this request."""
+        if not self.config.cache_aware_routing:
+            return None
+        k = self.config.route_affinity_prefix_tokens
+        if k <= 0 or not input_ids:
+            return None
+        # quantize the hashed length to a power of two (capped at k): a
+        # conversation's turns grow — hashing the raw length would give
+        # every turn a different key and scatter the very prefixes the
+        # cache holds. With the pow2 ladder, turn N and turn N+1 share a
+        # key until the length crosses the next power of two (one remap
+        # per doubling), and identical prompts always collide exactly.
+        q = 1
+        while q * 2 <= min(len(input_ids), k):
+            q *= 2
+        import hashlib
+
+        return hashlib.blake2b(
+            np.asarray(input_ids[:q], np.int64).tobytes(), digest_size=8
+        ).digest()
+
+    @staticmethod
+    def _rendezvous_pick(key: bytes, candidates: list[str]) -> str:
+        """Highest-random-weight (rendezvous) hashing: the same key always
+        prefers the same server, and removing a server (breaker trip,
+        drain) only remaps THAT server's keys — the rest of the fleet
+        keeps its cache affinity. When the server rejoins (version-checked
+        probe), its keys return to it and the affinity rebuilds with no
+        coordination."""
+        import hashlib
+
+        return max(
+            candidates,
+            key=lambda a: hashlib.blake2b(
+                key + a.encode(), digest_size=8
+            ).digest(),
+        )
+
     def choose_server(
-        self, rid: str | None = None, avoid: set[str] | None = None
+        self,
+        rid: str | None = None,
+        avoid: set[str] | None = None,
+        affinity_key: bytes | None = None,
     ) -> str:
         """Pick a server, routing around OPEN breakers. ``avoid`` holds
         addresses that already failed THIS request (failover re-dispatch
         must not hand the request straight back to the server that just
         dropped it); it is a preference, not a hard ban — when everything
-        else is down, an avoided server beats deadlock."""
+        else is down, an avoided server beats deadlock.
+
+        ``affinity_key`` (see :meth:`prefix_affinity_key`) layers
+        cache-aware routing on top: among the ROUTABLE candidates the
+        rendezvous-preferred server wins, so requests sharing a prompt
+        prefix land where that prefix's KV is already cached. Priority
+        order: rid affinity (the server holds this request's exact
+        in-flight KV) > breaker state (an OPEN server gets no traffic,
+        affinity or not) > prefix affinity > load policy."""
         policy = self.config.schedule_policy
         if policy not in ("round_robin", "least_loaded"):
             raise NotImplementedError(policy)
@@ -268,6 +323,31 @@ class RemoteInfEngine(InferenceEngine):
             )
             self._server_idx += 1
             return self._remember_rid(rid, addr)
+        if affinity_key is not None:
+            # cache-aware routing: the rendezvous winner among ROUTABLE
+            # candidates already holds (or will accumulate) this prefix's
+            # KV — prefix reuse beats load spreading for GRPO groups and
+            # multi-turn conversations. Breaker trips shrink `candidates`,
+            # so a quarantined server loses its keys automatically and
+            # reclaims them on rejoin.
+            addr = self._rendezvous_pick(affinity_key, candidates)
+            skew_cap = self.config.route_affinity_max_inflight_skew
+            overloaded = False
+            if skew_cap > 0 and len(candidates) > 1:
+                # hotspot guard: if every prompt in the workload shares one
+                # long template prefix, pure affinity would funnel the
+                # whole fleet's traffic to one server — once the preferred
+                # server runs `skew_cap` requests ahead of the
+                # least-loaded candidate, spill to the load policy (the
+                # spilled requests lose prefix locality, not correctness)
+                with self._inflight_lock:
+                    skew = self._inflight.get(addr, 0) - min(
+                        self._inflight.get(a, 0) for a in candidates
+                    )
+                overloaded = skew > skew_cap
+            if not overloaded:
+                self._server_idx += 1
+                return self._remember_rid(rid, addr)
         if policy == "least_loaded":
             # the gserver_manager schedule_request role
             # (realhf/system/gserver_manager.py allocate/schedule): route to
@@ -343,15 +423,24 @@ class RemoteInfEngine(InferenceEngine):
         )
         addr: str | None = None
         failed_addrs: set[str] = set()  # servers that failed THIS request
+        # computed from the ORIGINAL prompt (not prompt+accumulated): every
+        # re-issue of this request — and every sibling of its GRPO group —
+        # hashes identically, so they all prefer the same server's cache
+        affinity_key = self.prefix_affinity_key(prompt)
         while stop_reason == "abort" and len(accumulated) < max_new:
             while self._paused.is_set():
                 await asyncio.sleep(0.05)
             if addr is None:
-                addr = self.choose_server(req.rid, avoid=failed_addrs)
+                addr = self.choose_server(
+                    req.rid, avoid=failed_addrs, affinity_key=affinity_key
+                )
             payload = {
                 "rid": req.rid,
                 "input_ids": prompt + accumulated,
                 "image_data": encoded_images,
+                # admission priority (engine scheduler): workflows set
+                # req.metadata["priority"]; higher admits first
+                "priority": int((req.metadata or {}).get("priority", 0) or 0),
                 "sampling_params": {
                     "max_new_tokens": max_new - len(accumulated),
                     "min_new_tokens": max(

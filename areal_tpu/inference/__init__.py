@@ -8,3 +8,5 @@ weight refresh from disk.
 """
 
 from areal_tpu.inference.engine import GenerationEngine  # noqa: F401
+from areal_tpu.inference.prefix_cache import RadixPrefixCache  # noqa: F401
+from areal_tpu.inference.scheduler import AdmissionScheduler  # noqa: F401

@@ -28,6 +28,14 @@ class OutOfBlocks(Exception):
     """Raised when an allocation cannot be satisfied even after eviction."""
 
 
+class BlockPoolCorruption(RuntimeError):
+    """A refcount operation touched a block in an impossible state (incref
+    or decref of an already-free block). These are REAL exceptions, not
+    asserts: a double-free under ``python -O`` would otherwise silently
+    push the same block onto the free list twice, and two sequences would
+    later scribble over each other's KV rows."""
+
+
 class BlockPool:
     """Refcounted allocator over `num_blocks` fixed-size KV blocks.
 
@@ -75,7 +83,13 @@ class BlockPool:
             b = int(b)
             if b == TRASH_BLOCK:
                 continue
-            assert self.ref[b] > 0, f"incref on free block {b}"
+            if not 0 < b < self.num_blocks:
+                raise BlockPoolCorruption(f"incref on invalid block id {b}")
+            if self.ref[b] <= 0:
+                raise BlockPoolCorruption(
+                    f"incref on free block {b} (use-after-free: the block "
+                    "returned to the free list while a table still named it)"
+                )
             self.ref[b] += 1
 
     def decref(self, ids) -> None:
@@ -85,7 +99,13 @@ class BlockPool:
             b = int(b)
             if b == TRASH_BLOCK or b < 0:
                 continue
-            assert self.ref[b] > 0, f"decref on free block {b}"
+            if b >= self.num_blocks:
+                raise BlockPoolCorruption(f"decref on invalid block id {b}")
+            if self.ref[b] <= 0:
+                raise BlockPoolCorruption(
+                    f"decref on free block {b} (double-free: the same "
+                    "reference was released twice)"
+                )
             self.ref[b] -= 1
             if self.ref[b] == 0:
                 self._free.append(b)
@@ -94,3 +114,38 @@ class BlockPool:
         """A block may be appended to only while exactly one table points
         at it (copy-on-write discipline)."""
         return int(self.ref[block_id]) == 1 and block_id != TRASH_BLOCK
+
+    def check_invariants(self) -> None:
+        """Raise :class:`BlockPoolCorruption` unless the pool is globally
+        consistent. Cheap enough for tests to call after every interleaved
+        alloc/share/free sequence; production code calls it from debug
+        paths only."""
+        if int(self.ref[TRASH_BLOCK]) != 1:
+            raise BlockPoolCorruption(
+                f"trash block refcount is {int(self.ref[TRASH_BLOCK])}, "
+                "expected exactly 1 (permanently allocated)"
+            )
+        if np.any(self.ref < 0):
+            bad = np.flatnonzero(self.ref < 0).tolist()
+            raise BlockPoolCorruption(f"negative refcounts on blocks {bad}")
+        free = set(self._free)
+        if TRASH_BLOCK in free:
+            raise BlockPoolCorruption("trash block leaked onto the free list")
+        if len(free) != len(self._free):
+            dup = len(self._free) - len(free)
+            raise BlockPoolCorruption(
+                f"free list holds {dup} duplicate entr"
+                f"{'y' if dup == 1 else 'ies'} (double-free)"
+            )
+        for b in self._free:
+            if self.ref[b] != 0:
+                raise BlockPoolCorruption(
+                    f"block {b} is on the free list with refcount "
+                    f"{int(self.ref[b])}"
+                )
+        n_live = int(np.count_nonzero(self.ref > 0))
+        if n_live + len(self._free) != self.num_blocks:
+            raise BlockPoolCorruption(
+                f"{n_live} referenced + {len(self._free)} free != "
+                f"{self.num_blocks} total blocks (leaked or lost blocks)"
+            )

@@ -55,7 +55,9 @@ from areal_tpu.inference.block_pool import (
     OutOfBlocks,
 )
 from areal_tpu.inference.ngram import MAX_SCAN, ngram_propose
+from areal_tpu.inference.prefix_cache import RadixPrefixCache
 from areal_tpu.inference.sampling import sample_tokens, spec_verify_tokens
+from areal_tpu.inference.scheduler import AdmissionScheduler
 from areal_tpu.parallel.mesh import MESH_AXES, AXIS_PP, AXIS_TP
 from areal_tpu.parallel.sharding import param_shardings
 from areal_tpu.utils import logging
@@ -82,6 +84,7 @@ class _Seq:
     t_last_token: float | None = None
     itl: list[float] = dataclasses.field(default_factory=list)
     aborted: bool = False
+    priority: int = 0  # admission priority (higher admits first)
     images: list | None = None  # decoded [S, S, 3] float arrays, or for
     # qwen2_vl: HF-processor patch arrays [P_i, C*tps*ps*ps]
     grids: list | None = None  # qwen2_vl (t, h, w) per image
@@ -155,6 +158,10 @@ class GenerationEngine:
                     config.max_batch_size, new_b, pp,
                 )
                 config.max_batch_size = new_b
+        if config.prefill_chunk_size > 0:
+            # preferred serving-plane name; both knobs drive the same
+            # intra-prompt chunked-prefill machinery (engine's own copy)
+            config.chunked_prefill_tokens = config.prefill_chunk_size
         requested_s = config.max_seq_len
         blk = min(config.page_size, config.max_seq_len)
         if config.max_seq_len % blk:
@@ -249,6 +256,16 @@ class GenerationEngine:
                 f"max_seq_len={s} sequence"
             )
         self.pool = BlockPool(num_blocks, self.block_size)
+        # Radix prefix cache (inference/prefix_cache.py): full KV blocks of
+        # finished sequences stay matchable under their token prefix even
+        # after the slot is re-prefilled — the cross-slot generalization of
+        # the slot-level clone/extension reuse below. Version-fenced on
+        # every weight commit.
+        self.prefix_cache: RadixPrefixCache | None = (
+            RadixPrefixCache(self.pool)
+            if config.enable_prefix_cache
+            else None
+        )
         if config.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"kv_quant must be none|int8, got {config.kv_quant!r}"
@@ -334,8 +351,13 @@ class GenerationEngine:
         self.pos_delta = np.zeros(b, np.int32)
         self.version = 0
 
-        # control plane
-        self._input_queue: queue.Queue[_Seq] = queue.Queue()
+        # control plane: prioritized admission queue + token-budget
+        # admission control (inference/scheduler.py). Budget 0 derives
+        # from pool capacity — the pool's token count IS what it can hold.
+        budget = config.admission_token_budget
+        if budget <= 0:
+            budget = (num_blocks - 1) * self.block_size
+        self.scheduler = AdmissionScheduler(token_budget=budget)
         self._cmd_queue: queue.Queue = queue.Queue()
         self._paused = threading.Event()
         self._shutdown = threading.Event()
@@ -380,8 +402,20 @@ class GenerationEngine:
         # current-weight prefixes; in-flight/retained sequences keep their
         # accepted staleness but stop being clone sources after an update)
         self._slot_kv_version = np.zeros(b, np.int64)
+        # radix-cache pins held on behalf of each slot's admission match
+        # (released on finish/free so LRU eviction can reclaim the nodes)
+        self._slot_pinned_nodes: list[list] = [[] for _ in range(b)]
         self.prefill_count = 0  # prompts prefilled (zero-re-prefill tests)
         self.prefill_dispatch_count = 0  # device dispatches (batching tests)
+        # tokens actually run through prefill/extension dispatches — the
+        # prefix-cache bench's headline denominator (clone/radix hits skip
+        # these tokens entirely; prompt_tokens_total measures demand)
+        self.prefill_tokens_computed_total = 0
+        # chunked-prefill chunks dispatched (satellite observability;
+        # chunked_prefill_count below counts COMPLETED warmups)
+        self.prefill_chunks_total = 0
+        # radix-cache admissions (cross-slot reuse) and their covered tokens
+        self.radix_hit_count = 0
         self.prefix_clone_count = 0
         # cross-request partial prefix sharing (the general radix-reuse
         # case: different requests with a common system/few-shot prefix):
@@ -683,11 +717,19 @@ class GenerationEngine:
         n = int(self._slot_nblocks[i])
         if n:
             self.pool.decref(self.block_table[i, :n])
+        self._unpin_slot_nodes(i)
         self.block_table[i, :] = -1
         self._slot_nblocks[i] = 0
         self._slot_covered[i] = []
         self.cache_len[i] = 0
         self._slot_kv_version[i] = 0
+
+    def _unpin_slot_nodes(self, i: int):
+        """Release the radix-cache pins taken when slot ``i`` admitted via
+        a cache match (idempotent: the list clears on first release)."""
+        if self.prefix_cache is not None and self._slot_pinned_nodes[i]:
+            self.prefix_cache.unpin(self._slot_pinned_nodes[i])
+        self._slot_pinned_nodes[i] = []
 
     def _reclaim_blocks(self) -> bool:
         """Free one inactive slot's cached blocks (LRU). Plain
@@ -714,15 +756,42 @@ class GenerationEngine:
 
     def _alloc_blocks(self, n: int) -> list[int]:
         """Allocate ``n`` blocks, evicting cached prefixes as needed.
-        Raises OutOfBlocks when live sequences hold everything."""
+
+        Eviction ladder: inactive slot tables first (their full blocks are
+        usually ALSO registered in the radix cache, so freeing the table
+        keeps the prefix matchable while releasing the duplicate
+        reference), then LRU unpinned radix nodes. Raises OutOfBlocks when
+        live sequences hold everything."""
         if n <= 0:
             return []
         while True:
             try:
                 return self.pool.alloc(n)
             except OutOfBlocks:
-                if not self._reclaim_blocks():
-                    raise
+                if self._reclaim_blocks():
+                    continue
+                if self.prefix_cache is not None and self.prefix_cache.evict(
+                    n - self.pool.n_free
+                ):
+                    continue
+                raise
+
+    def _on_weights_changed(self):
+        """Version-fence the radix cache after ANY weight commit (staged
+        pointer flip, disk/device refresh, LoRA merge): cached blocks are
+        tagged with the version that computed them, match() only returns
+        current-version nodes, and unpinned stale nodes are evicted NOW —
+        a stale-version block can never be spliced into a new-version
+        prefill. Runs on the engine thread."""
+        if self.prefix_cache is not None:
+            freed = self.prefix_cache.on_weights_changed(self.version)
+            if freed:
+                logger.info(
+                    "weight commit v%d fenced the prefix cache: %d stale "
+                    "block(s) evicted (%d still pinned by in-flight "
+                    "sequences)",
+                    self.version, freed, self.prefix_cache.n_cached_blocks,
+                )
 
     @property
     def eos_token_id(self) -> int | None:
@@ -756,9 +825,11 @@ class GenerationEngine:
         gconfig: GenerationHyperparameters,
         on_done: Callable[[ModelResponse], None],
         image_data: list | None = None,
+        priority: int = 0,
     ):
         """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
-        thread when it finishes (stop/length/abort)."""
+        thread when it finishes (stop/length/abort). ``priority`` orders
+        admission (higher first; FIFO within a class)."""
         if self._dead is not None:
             raise RuntimeError("generation engine loop died") from self._dead
         if len(input_ids) >= self.config.max_seq_len:
@@ -766,6 +837,20 @@ class GenerationEngine:
                 input_tokens=list(input_ids), stop_reason="length"
             )
             on_done(resp)
+            return
+        if not self.scheduler.would_ever_fit(len(input_ids)):
+            # admission control: a prompt beyond the token budget could
+            # never admit — refuse NOW instead of parking it at the queue
+            # head forever (the response mirrors the over-max_seq_len case)
+            self.scheduler.refused_total += 1
+            logger.warning(
+                "refusing rid=%s: prompt of %d tokens exceeds the admission "
+                "token budget %d (knob: JaxGenConfig.admission_token_budget)",
+                rid, len(input_ids), self.scheduler.token_budget,
+            )
+            on_done(
+                ModelResponse(input_tokens=list(input_ids), stop_reason="length")
+            )
             return
         images = None
         grids = None
@@ -825,9 +910,9 @@ class GenerationEngine:
                 )
         seq = _Seq(
             rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done,
-            images=images, grids=grids,
+            images=images, grids=grids, priority=priority,
         )
-        self._input_queue.put(seq)
+        self.scheduler.submit(seq, priority=priority)
         self._wake.set()
 
     def abort(self, rid: str):
@@ -1054,6 +1139,58 @@ class GenerationEngine:
     def n_running(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    def serving_stats(self) -> dict:
+        """Serving-plane observability in one place: pool occupancy, radix
+        prefix-cache hit/miss/eviction counters, chunked-prefill progress,
+        and admission-queue depth/wait. The server's ``/model_info`` and
+        the StatsLogger surface (:meth:`record_serving_stats`) both read
+        from here."""
+        pc = self.prefix_cache
+        sched = self.scheduler
+        return {
+            "kv_blocks_used": self.pool.n_used,
+            "kv_blocks_free": self.pool.n_free,
+            "kv_block_size": self.pool.block_size,
+            "prefix_cache_enabled": pc is not None,
+            "prefix_cache_blocks": pc.n_cached_blocks if pc else 0,
+            "prefix_cache_hit_tokens_total": pc.hit_tokens_total if pc else 0,
+            "prefix_cache_miss_tokens_total": (
+                pc.miss_tokens_total if pc else 0
+            ),
+            "prefix_cache_evicted_blocks_total": (
+                pc.evicted_blocks_total if pc else 0
+            ),
+            "prefix_cache_hit_rate": (
+                pc.hit_tokens_total
+                / max(1, pc.hit_tokens_total + pc.miss_tokens_total)
+                if pc
+                else 0.0
+            ),
+            "radix_hit_count": self.radix_hit_count,
+            "prefill_tokens_computed_total": (
+                self.prefill_tokens_computed_total
+            ),
+            "prefill_chunks_total": self.prefill_chunks_total,
+            "admission_queue_depth": sched.depth,
+            "admission_token_budget": sched.token_budget,
+            "admission_refused_total": sched.refused_total,
+            "queue_wait_seconds_total": sched.queue_wait_seconds_total,
+            "queue_wait_seconds_last": sched.queue_wait_seconds_last,
+        }
+
+    def record_serving_stats(self) -> None:
+        """Push the serving-plane counters into the process-wide stats
+        tracker, so training loops that commit StatsLogger rows (rehearsal
+        runs included) record cache hit rates alongside throughput."""
+        from areal_tpu.utils import stats_tracker
+
+        stats = {
+            f"serving/{k}": float(v)
+            for k, v in self.serving_stats().items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        stats_tracker.DEFAULT_TRACKER.scalar(**stats)
+
     @property
     def spec_acceptance_rate(self) -> float:
         """Lifetime accepted/proposed draft-token ratio (0.0 before any
@@ -1161,6 +1298,7 @@ class GenerationEngine:
                     self.params = new_params
                     self._lora_base = None  # base changed; re-snapshot
                     self.version = version
+                    self._on_weights_changed()
                     stall = time.monotonic() - t0
                     self.weight_sync_stall_seconds_last = stall
                     self.weight_sync_stall_seconds_total += stall
@@ -1216,6 +1354,7 @@ class GenerationEngine:
                         self.version = version
                     else:
                         self.version += 1
+                    self._on_weights_changed()
                     logger.info(
                         "weights updated (lora adapters %s) -> v%d in %.2fs",
                         ",".join(leaves), self.version, time.monotonic() - t0,
@@ -1255,6 +1394,7 @@ class GenerationEngine:
                         self.params = new
                     jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
                     self.version = version if version is not None else self.version + 1
+                    self._on_weights_changed()
                     logger.info(
                         "weights updated (%s) -> v%d in %.2fs",
                         "disk" if cmd[0] == "update_weights" else "device",
@@ -1278,11 +1418,7 @@ class GenerationEngine:
             self._free_slot_blocks(slot)
             seq.on_done(self._response(seq, reason))
         # flush queued-but-not-admitted requests too: client re-issues them
-        while True:
-            try:
-                seq = self._input_queue.get_nowait()
-            except queue.Empty:
-                break
+        for seq in self.scheduler.drain():
             seq.on_done(self._response(seq, reason))
 
     def _handle_aborts(self):
@@ -1302,21 +1438,11 @@ class GenerationEngine:
                 seq.on_done(self._response(seq, "abort"))
                 rids.discard(seq.rid)
         if rids:
-            # the rid may still be waiting in the input queue — filter it out
-            # there too (otherwise the abort is silently lost and the request
-            # is admitted later)
-            kept: list[_Seq] = []
-            while True:
-                try:
-                    seq = self._input_queue.get_nowait()
-                except queue.Empty:
-                    break
-                if seq.rid in rids:
-                    seq.on_done(self._response(seq, "abort"))
-                else:
-                    kept.append(seq)
-            for seq in kept:
-                self._input_queue.put(seq)
+            # the rid may still be waiting in the admission queue — filter
+            # it out there too (otherwise the abort is silently lost and
+            # the request is admitted later)
+            for seq in self.scheduler.remove_rids(rids):
+                seq.on_done(self._response(seq, "abort"))
 
     def _extend_chunk(self, slot: int, ids_chunk, start: int):
         """One bucketed suffix-extension dispatch writing slot's prompt
@@ -1326,6 +1452,8 @@ class GenerationEngine:
         model-sized extend program per distinct length; surplus -1 table
         entries gather the trash block and are masked by position."""
         bucket = self._bucket(len(ids_chunk))
+        self.prefill_tokens_computed_total += len(ids_chunk)
+        self.prefill_chunks_total += 1
         ids = np.zeros((1, bucket), np.int32)
         ids[0, : len(ids_chunk)] = ids_chunk
         nbt = 1
@@ -1370,6 +1498,7 @@ class GenerationEngine:
                     st["version"] if st["version"] == self.version else -1
                 )
                 self._slot_last_use[slot] = time.monotonic()
+                self._cache_insert_slot(slot)
             if token_budget <= 0:
                 break
         return token_budget
@@ -1381,11 +1510,24 @@ class GenerationEngine:
         prefill): a burst of long-prompt admissions cannot stall in-flight
         decode for more than ~one budget's worth of prefill compute, while
         short prompts still batch-ramp quickly."""
-        token_budget = (
-            1 << 62
-            if self.n_running == 0
-            else max(self.config.prefill_chunk * 4, 512)
-        )
+        if (
+            self.prefix_cache is not None
+            and self.prefix_cache.version != self.version
+        ):
+            # version moved outside the command handlers (set_version from
+            # a reconcile path): fence lazily before any match can run
+            self._on_weights_changed()
+        chunk_sz = self.config.chunked_prefill_tokens
+        if self.n_running == 0:
+            token_budget = 1 << 62
+        elif chunk_sz > 0:
+            # chunked prefill on: the per-iteration budget is ~a couple of
+            # chunks, so decode dispatches between every budget's worth of
+            # warming — a long admission interleaves instead of stalling
+            # the running batch for its whole prompt
+            token_budget = max(chunk_sz * 2, self.config.prefill_chunk)
+        else:
+            token_budget = max(self.config.prefill_chunk * 4, 512)
         token_budget = self._advance_warming(token_budget)
         pending: list[_Seq] = []  # prompts awaiting one packed prefill
         pending_slots: list[int] = []
@@ -1394,6 +1536,7 @@ class GenerationEngine:
 
         def flush():
             if pending:
+                landed = list(pending_slots)
                 self._prefill_seqs(
                     list(pending), list(pending_slots), list(pending_blocks)
                 )
@@ -1401,14 +1544,48 @@ class GenerationEngine:
                 pending_slots.clear()
                 pending_blocks.clear()
                 pending_tokens[0] = 0
+                # the flushed requests left pending_held and became live
+                # slot tables: fold them into the incremental held set
+                for s in landed:
+                    note_admitted(s)
 
-        while token_budget > 0 and not self._input_queue.empty():
-            try:
-                seq = self._input_queue.get_nowait()
-            except queue.Empty:
+        # distinct active/warming blocks, computed at most once per pass
+        # and updated incrementally as admissions land (a per-pop rebuild
+        # is O(batch x blocks_per_seq) of host work on the hot loop)
+        live_blocks: set | None = None
+
+        def note_admitted(slot: int):
+            if live_blocks is not None:
+                nb = int(self._slot_nblocks[slot])
+                live_blocks.update(
+                    int(x) for x in self.block_table[slot, :nb]
+                )
+                live_blocks.discard(-1)
+
+        while token_budget > 0:
+            popped = self.scheduler.pop()
+            if popped is None:
                 break
+            seq, entry = popped
             if self._try_resume(seq):
+                note_admitted(seq.slot)
                 continue  # resume costs no device dispatch
+            if live_blocks is None:
+                live_blocks = self._live_block_set()
+            pending_held = sum(len(b) for b in pending_blocks) * self.block_size
+            radix_m = self._radix_match(seq)
+            if not self._admission_ok(
+                seq, extra_held=pending_held,
+                covered=radix_m.covered if radix_m else 0,
+                held_tokens=len(live_blocks) * self.block_size,
+            ):
+                # token-budget admission control: the pool cannot hold this
+                # request right now — keep it QUEUED (it retains its place)
+                # instead of thrashing the prefix cache with evictions that
+                # cannot add up to enough blocks anyway
+                self.scheduler.push_front(entry)
+                flush()
+                return
             free = [
                 i
                 for i, s in enumerate(self.slots)
@@ -1428,7 +1605,7 @@ class GenerationEngine:
                     and i not in self._warming
                 ]
             if not free:
-                self._input_queue.put(seq)  # no capacity; retry next loop
+                self.scheduler.push_front(entry)  # no capacity; retry later
                 flush()
                 return
             if (
@@ -1457,7 +1634,15 @@ class GenerationEngine:
                 ) and best > 0:
                     flush()
             if self._try_clone(seq, free[0]):
+                note_admitted(free[0])
                 continue  # block sharing + at most one block copy
+            radix_cost = self._try_radix(seq, free[0], match=radix_m)
+            if radix_cost is not None:
+                # radix-cache hit: only the uncovered suffix cost prefill
+                # compute (0 for a full-cover hit)
+                note_admitted(free[0])
+                token_budget -= radix_cost
+                continue
             # a fresh prefill owns its blocks exclusively: release the
             # slot's old cached prefix, then draw blocks for the prompt
             self._free_slot_blocks(free[0])
@@ -1466,9 +1651,14 @@ class GenerationEngine:
                     self.pool.blocks_for_tokens(len(seq.prompt))
                 )
             except OutOfBlocks:
-                self._input_queue.put(seq)  # pool full of live sequences
+                self.scheduler.push_front(entry)  # pool full of live seqs
                 flush()
                 return
+            if self.prefix_cache is not None and not seq.images:
+                # charged only once the admission actually lands — an
+                # OutOfBlocks requeue above must not deflate the hit rate
+                # on every retry of the same request
+                self.prefix_cache.miss_tokens_total += len(seq.prompt)
             chunk_sz = self.config.chunked_prefill_tokens
             if (
                 chunk_sz > 0
@@ -1488,6 +1678,7 @@ class GenerationEngine:
                     "seq": seq, "blocks": blocks, "off": 0,
                     "version": self.version,
                 }
+                note_admitted(slot)
                 token_budget = self._advance_warming(token_budget)
                 continue
             # ragged packed prefill: mixed lengths and image prompts all
@@ -1528,6 +1719,180 @@ class GenerationEngine:
         self._slot_covered[slot] = list(covered)
         # cache_len already holds len(covered); decode feeds feed_tok next
         return True
+
+    def _live_block_set(self) -> set:
+        """Distinct physical blocks committed to ACTIVE work (running +
+        warming slots) — shared prefix blocks count once. Retained
+        abort-resume state and idle prefix caches are excluded: both are
+        evictable on demand, so counting them would wedge admission with
+        nothing running. Computed once per _admit pass and updated
+        incrementally as admissions land (a per-pop rebuild is
+        O(batch x blocks_per_seq) on the engine hot loop)."""
+        live: set = set()
+        for i, s in enumerate(self.slots):
+            if s is not None or i in self._warming:
+                nb = int(self._slot_nblocks[i])
+                live.update(int(x) for x in self.block_table[i, :nb])
+        live.discard(-1)
+        return live
+
+    def _held_tokens(self) -> int:
+        return len(self._live_block_set()) * self.block_size
+
+    def _radix_match(self, seq: _Seq):
+        """The admission pass's ONE trie walk for this request (shared by
+        the budget discount and _try_radix). None when the radix tier
+        cannot apply."""
+        if self.prefix_cache is None or seq.images or len(seq.prompt) < 2:
+            return None
+        return self.prefix_cache.match(seq.prompt[: len(seq.prompt) - 1])
+
+    def _admission_ok(
+        self,
+        seq: _Seq,
+        extra_held: int = 0,
+        covered: int = 0,
+        held_tokens: int | None = None,
+    ) -> bool:
+        """Token-budget + pool-headroom admission control: admit only when
+        (a) the configured budget covers running + warming + this prompt,
+        and (b) free + evictable blocks can actually hold the prompt —
+        otherwise the eviction ladder would wipe every cached prefix and
+        STILL fail, which is exactly the thrash this check exists to
+        avoid. ``extra_held`` covers same-pass admissions still waiting in
+        the pending prefill batch (blocks drawn, slot tables not yet
+        written).
+
+        A radix-covered prefix (``covered``, from the admission pass's one
+        trie walk) is discounted from the request's demand: those blocks
+        already exist in the pool, so a group sibling that will admit by
+        reference must not be held back (head-of-line-blocking the queue)
+        for capacity it cannot consume. The match may be evicted before
+        the actual admission — then the fresh path simply fails
+        allocation and requeues, same as before."""
+        need_tokens = max(1, len(seq.prompt) - covered)
+        held = (
+            self._held_tokens() if held_tokens is None else held_tokens
+        ) + extra_held
+        if not self.scheduler.admit_ok(need_tokens, held):
+            return False
+        # exact headroom, no double counting: every usable block is either
+        # held by an active/warming table (not evictable) or reclaimable —
+        # free, inactive-slot-cached, retained (demotable), or
+        # radix-cached (a block referenced by BOTH an inactive table and a
+        # cache node still frees exactly once, which summing the two
+        # populations would overstate). Pinned cache nodes belong to
+        # active slots, so their blocks are already in the held set.
+        need = self.pool.blocks_for_tokens(need_tokens)
+        avail = (self.pool.num_blocks - 1) - held // self.block_size
+        return need <= avail
+
+    def _cache_insert_slot(self, i: int) -> None:
+        """Register slot ``i``'s covered FULL blocks in the radix cache
+        (no-op when the rows predate the current weights or encode
+        pixels)."""
+        if self.prefix_cache is None:
+            return
+        if self._slot_kv_version[i] != self.version:
+            return
+        cov = self._slot_covered[i]
+        nfull = len(cov) // self.block_size
+        if nfull == 0:
+            return
+        self.prefix_cache.insert(
+            cov[: nfull * self.block_size], self.block_table[i, :nfull]
+        )
+
+    def _try_radix(self, seq: _Seq, dst: int, match=None) -> int | None:
+        """Admission via the radix prefix cache: the longest cached
+        full-block prefix of the prompt is REFERENCED into ``dst``'s block
+        table (refcount sharing, no copy — full blocks are never appended
+        into, so no copy-on-write is needed) and only the uncovered suffix
+        runs prefill compute, through the suffix-extension dispatch or —
+        for a long suffix — the chunked-prefill warming path. Returns the
+        suffix token count charged against the admission budget, or None
+        when the cache offers nothing useful. ``match`` is the admission
+        pass's earlier trie walk; it is re-validated (eviction or a
+        version fence may have struck between the walk and this call —
+        e.g. _try_clone's allocations) and re-run only if dead."""
+        if self.prefix_cache is None or seq.images:
+            return None
+        n = len(seq.prompt)
+        if n < 2:
+            return None
+        m = match
+        if m is None or any(
+            node.parent is None or node.version != self.prefix_cache.version
+            for node in m.nodes
+        ):
+            m = self.prefix_cache.match(seq.prompt[: n - 1])
+        covered = m.covered
+        if covered == 0:
+            return None
+        suffix = n - 1 - covered
+        if suffix > 0 and covered < self.config.prefix_extend_min:
+            return None  # too little sharing to beat a batched prefill
+        chunk_sz = self.config.chunked_prefill_tokens
+        warm = chunk_sz > 0 and suffix > chunk_sz
+        if suffix > 0 and not warm and (
+            covered + self._bucket(suffix) > self.config.max_seq_len
+        ):
+            return None  # padded suffix write would overrun the table
+        # pin the matched path and take the sequence's OWN references
+        # before any allocation below can trigger eviction
+        self.pool.incref(m.blocks)
+        self.prefix_cache.pin(m.nodes)
+        self._free_slot_blocks(dst)
+        if suffix == 0:
+            extra = 0  # decode allocates growth blocks on demand
+        elif warm:
+            extra = self.pool.blocks_for_tokens(n) - len(m.blocks)
+        else:
+            extra = (
+                self.pool.blocks_for_tokens(covered + self._bucket(suffix))
+                - len(m.blocks)
+            )
+        try:
+            fresh = self._alloc_blocks(max(extra, 0))
+        except OutOfBlocks:
+            self.pool.decref(m.blocks)
+            self.prefix_cache.unpin(m.nodes)
+            return None
+        table = list(m.blocks) + fresh
+        self.block_table[dst, : len(table)] = table
+        self.block_table[dst, len(table):] = -1
+        self._slot_nblocks[dst] = len(table)
+        self._slot_pinned_nodes[dst] = list(m.nodes)
+        self.prefix_cache.hit_tokens_total += covered
+        self.prefix_cache.miss_tokens_total += suffix
+        self.radix_hit_count += 1
+        now = time.monotonic()
+        self._slot_last_use[dst] = now
+        if warm:
+            # uncovered suffix is long: warm it chunk-by-chunk between
+            # decode iterations (slot invisible to decode until warm;
+            # _advance_warming charges prompt_tokens_total at completion).
+            # Admission itself dispatched NOTHING — the suffix is charged
+            # against the iteration budget chunk-by-chunk as
+            # _advance_warming actually writes it, so returning it here
+            # too would double-bill and starve this iteration's peers.
+            self._warming[dst] = {
+                "seq": seq, "blocks": table, "off": covered,
+                "version": self.version,
+            }
+            return 0
+        self.prompt_tokens_total += n
+        if suffix > 0:
+            self._extend_chunk(dst, seq.prompt[covered: n - 1], covered)
+        seq.slot = dst
+        self.slots[dst] = seq
+        self.cache_len[dst] = n - 1
+        self.last_token[dst] = seq.prompt[-1]
+        self.pos_delta[dst] = 0  # cached prefixes are text-only
+        self._slot_covered[dst] = list(seq.prompt[: n - 1])
+        self._slot_kv_version[dst] = self.version
+        self._cache_insert_slot(dst)  # register the fresh suffix blocks
+        return suffix
 
     def _try_clone(self, seq: _Seq, dst: int) -> bool:
         """Prompt-prefix KV reuse, full and partial.
@@ -1653,6 +2018,13 @@ class GenerationEngine:
         self.pos_delta[dst] = 0  # clone/extension sources are text-only
         self._slot_covered[dst] = list(prefix)
         self._slot_last_use[dst] = time.monotonic()
+        if self.prefix_cache is not None:
+            # slot-level reuse is still a prefix-cache hit from the
+            # operator's perspective: the hit-rate metrics cover BOTH
+            # reuse tiers
+            self.prefix_cache.hit_tokens_total += best
+            self.prefix_cache.miss_tokens_total += n - 1 - best
+            self._cache_insert_slot(dst)
         return True
 
     def _prefill_rot_impl(
@@ -1684,6 +2056,7 @@ class GenerationEngine:
         self.prefill_count += len(seqs)
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
+        self.prefill_tokens_computed_total += sum(len(s.prompt) for s in seqs)
         s_pp = self._pp
         bs = self.block_size
         order = sorted(
@@ -1774,6 +2147,9 @@ class GenerationEngine:
         # image-conditioned rows encode pixels the token ids don't
         # show; stamp -1 so they can never be cloned into a text request
         self._slot_kv_version[slot] = -1 if seq.images else self.version
+        # register the freshly prefilled prompt in the radix cache NOW, so
+        # a group's queued siblings hit even while this sequence decodes
+        self._cache_insert_slot(slot)
         if self._seq_finished(seq, tok_i):
             self._finish(slot, self._finish_reason(seq, tok_i))
 
@@ -1798,6 +2174,7 @@ class GenerationEngine:
         self.prefill_count += len(seqs)
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
+        self.prefill_tokens_computed_total += sum(len(s.prompt) for s in seqs)
         # compiled-shape control: the stream length buckets like prompt
         # lengths did; the segment count pads to prefill_batch (singles
         # keep a lone-row program for the common case)
@@ -2203,7 +2580,12 @@ class GenerationEngine:
         # keep cache_len, covered tokens, and the block table — the rows
         # stay valid as prefix-clone sources until the pool reclaims them
         # (inactive lanes write to the trash block, so a full table poses
-        # no idle-write hazard)
+        # no idle-write hazard). The radix cache additionally registers the
+        # FULL covered blocks (prompt + generated tokens — the multi-turn
+        # reuse case) and the admission pins drop so LRU eviction can
+        # reclaim the nodes once idle.
+        self._cache_insert_slot(slot)
+        self._unpin_slot_nodes(slot)
         self._slot_last_use[slot] = time.monotonic()
         seq.on_done(self._response(seq, reason))
 
@@ -2219,7 +2601,7 @@ class GenerationEngine:
         # prefer evicting entries whose owner is NOT already queued for
         # resume — evicting a pending continuation forces the full re-prefill
         # the retention mechanism exists to avoid
-        pending = {q.rid for q in list(self._input_queue.queue)}
+        pending = self.scheduler.pending_rids()
         candidates = [r for r in self._retained if r not in pending]
         pool = candidates or list(self._retained)
         rid = min(pool, key=lambda r: self._retained[r][3])

@@ -1,0 +1,340 @@
+"""Radix prefix cache: token sequences -> full KV blocks in the paged pool.
+
+The SGLang signature feature (AReaL-lite's default backend, PAPER.md §1) that
+makes GRPO-style rollouts cheap: the same prompt is sent ``group_size`` times
+and multi-turn/agentic workloads re-send a growing conversation prefix every
+turn. This cache maps token prefixes to KV blocks that some earlier request
+already computed, so a hit sets the new sequence's ``cache_len`` to the
+covered prefix and prefill runs only on the uncovered suffix.
+
+Design, deliberately narrow:
+
+- **Exact match on FULL blocks only.** Because blocks are fixed-size, the
+  radix tree degenerates to a trie whose edges are ``block_size``-token
+  chunks; children are keyed by the full chunk tuple, so lookup is one dict
+  probe per block. Partially-filled tail blocks are never cached — the slot
+  paths (clone/extension in the engine) handle sub-block sharing with the
+  existing copy-on-write ``writable`` discipline.
+- **One pool reference per node.** Inserting a chunk increfs its block once
+  on behalf of the cache; evicting the node decrefs it. Sequences that match
+  take their OWN references, so an eviction under a running sequence can
+  never free rows it is attending (the pool refcount protects the memory;
+  the pin protects the node).
+- **Refcount-pinned active nodes.** ``pin``/``unpin`` guard the matched path
+  of every admitted sequence; LRU eviction (oldest ``last_use`` first) only
+  ever removes unpinned leaves, walking toward the root as children vanish.
+- **Version fencing.** Every node is tagged with the weight version its rows
+  were computed under. ``match`` only traverses nodes tagged with the
+  cache's current version, and ``on_weights_changed`` (called on every
+  weight commit) bumps the version and immediately evicts every unpinned
+  stale node — stale-version blocks are therefore never spliced into a
+  new-version prefill, and pinned stale nodes (held by in-flight sequences)
+  are reaped the moment their last pin drops.
+
+Pure host bookkeeping; the engine loop is the single owner (not
+thread-safe), same discipline as :class:`BlockPool`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from areal_tpu.inference.block_pool import BlockPool
+
+
+@dataclasses.dataclass
+class RadixNode:
+    """One full KV block's worth of cached tokens."""
+
+    key: tuple  # the block_size tokens this node's block holds
+    block_id: int
+    version: int  # weight version the rows were computed under
+    parent: "RadixNode | None"
+    children: dict = dataclasses.field(default_factory=dict)
+    pins: int = 0
+    last_use: float = 0.0
+
+    @property
+    def depth_tokens(self) -> int:
+        n, d = self, 0
+        while n.parent is not None:
+            d += len(n.key)
+            n = n.parent
+        return d
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of :meth:`RadixPrefixCache.match`: ``blocks[i]`` holds tokens
+    ``tokens[i*block_size : (i+1)*block_size]``; ``covered`` is the total
+    token count (always a multiple of ``block_size``)."""
+
+    covered: int
+    blocks: list
+    nodes: list
+
+    def __bool__(self) -> bool:
+        return self.covered > 0
+
+
+class RadixPrefixCache:
+    """Trie of full KV blocks over the shared :class:`BlockPool`."""
+
+    def __init__(self, pool: BlockPool, clock=None):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self.version = 0
+        self._root = RadixNode(key=(), block_id=-1, version=-1, parent=None)
+        self._n_nodes = 0
+        self._tick = 0  # monotonic logical clock for LRU (injectable-free)
+        self._clock = clock
+        # observability (engine /model_info + StatsLogger surface)
+        self.hit_tokens_total = 0
+        self.miss_tokens_total = 0
+        self.evicted_blocks_total = 0
+        self.inserted_blocks_total = 0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def n_cached_blocks(self) -> int:
+        return self._n_nodes
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        self._tick += 1
+        return float(self._tick)
+
+    def _chunks(self, tokens) -> list[tuple]:
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        return [tuple(tokens[i * bs : (i + 1) * bs]) for i in range(n_full)]
+
+    # ------------------------------------------------------------------
+    # lookup / insert
+    # ------------------------------------------------------------------
+
+    def match(self, tokens) -> PrefixMatch:
+        """Longest cached prefix of ``tokens`` in whole blocks, current
+        weight version only. Does NOT take references or pins — the caller
+        increfs the returned blocks into its own table and pins the nodes
+        for the sequence's lifetime (``pin``), mirroring how slots own
+        their block-table references."""
+        node = self._root
+        blocks: list[int] = []
+        nodes: list[RadixNode] = []
+        now = self._now()
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None or child.version != self.version:
+                break
+            child.last_use = now
+            blocks.append(child.block_id)
+            nodes.append(child)
+            node = child
+        covered = len(blocks) * self.block_size
+        # NOTE: hit/miss token counters are charged by the ENGINE on the
+        # admission decision (a match that later fails block allocation is
+        # not a hit), not here.
+        return PrefixMatch(covered=covered, blocks=blocks, nodes=nodes)
+
+    def insert(self, tokens, block_ids) -> int:
+        """Register ``tokens``' full blocks (``block_ids[i]`` holds chunk
+        ``i``) under the CURRENT version. Existing current-version nodes are
+        kept (first writer wins — both physical blocks hold identical rows,
+        and the inserter's copy stays owned by its slot); stale-version
+        nodes on the path are refreshed in place to the new block. Returns
+        the number of new pool references the cache took."""
+        node = self._root
+        took = 0
+        now = self._now()
+        chunks = self._chunks(tokens)
+        for i, chunk in enumerate(chunks):
+            blk = int(block_ids[i])
+            child = node.children.get(chunk)
+            if child is not None:
+                if child.version != self.version:
+                    # refresh: same tokens, new-weights rows. Swap the
+                    # cache's reference to the new block; pinned holders
+                    # keep their own refs on the OLD block untouched.
+                    self.pool.decref([child.block_id])
+                    self.pool.incref([blk])
+                    child.block_id = blk
+                    child.version = self.version
+                child.last_use = now
+                node = child
+                continue
+            self.pool.incref([blk])
+            child = RadixNode(
+                key=chunk, block_id=blk, version=self.version, parent=node,
+                last_use=now,
+            )
+            node.children[chunk] = child
+            node = child
+            self._n_nodes += 1
+            self.inserted_blocks_total += 1
+            took += 1
+        return took
+
+    # ------------------------------------------------------------------
+    # pinning / eviction / fencing
+    # ------------------------------------------------------------------
+
+    def pin(self, nodes) -> None:
+        for n in nodes:
+            n.pins += 1
+
+    def unpin(self, nodes) -> None:
+        """Release pins; stale nodes whose last pin just dropped are reaped
+        immediately (leaf-first) so fenced-off KV stops occupying the pool
+        as soon as its last in-flight user finishes."""
+        for n in nodes:
+            if n.pins <= 0:
+                raise RuntimeError(
+                    f"unpin of unpinned radix node (depth "
+                    f"{n.depth_tokens} tokens)"
+                )
+            n.pins -= 1
+        for n in sorted(nodes, key=lambda x: -x.depth_tokens):
+            if (
+                n.version != self.version
+                and n.pins == 0
+                and not n.children
+                and n.parent is not None
+            ):
+                self._evict_node(n)
+
+    def _evict_node(self, node: RadixNode) -> None:
+        del node.parent.children[node.key]
+        self.pool.decref([node.block_id])
+        node.parent = None
+        self._n_nodes -= 1
+        self.evicted_blocks_total += 1
+
+    def _evictable_leaves(self) -> list[RadixNode]:
+        out = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.pins == 0:
+                out.append(n)
+        return out
+
+    def evictable_blocks(self) -> int:
+        """Blocks the cache could eventually release: nodes in subtrees
+        with no pinned descendant (introspection/tests; iterative — cached
+        chains are as deep as blocks-per-sequence, far past the recursion
+        limit for long-context configs)."""
+        # post-order via explicit stack: a node is evictable iff it is
+        # unpinned AND every descendant is evictable
+        clean: dict[int, bool] = {}
+        count = 0
+        stack: list[tuple[RadixNode, bool]] = [(self._root, False)]
+        while stack:
+            node, visited = stack.pop()
+            if not visited:
+                stack.append((node, True))
+                for c in node.children.values():
+                    stack.append((c, False))
+                continue
+            ok = node.pins == 0 and all(
+                clean[id(c)] for c in node.children.values()
+            )
+            clean[id(node)] = ok
+            if ok and node is not self._root:
+                count += 1
+        return count
+
+    def evict(self, n_blocks: int) -> int:
+        """Evict up to ``n_blocks`` unpinned blocks, LRU leaves first
+        (walking up as parents become leaves). Returns how many were
+        actually released to the pool."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.last_use)
+            for leaf in leaves:
+                if freed >= n_blocks:
+                    break
+                parent = leaf.parent
+                self._evict_node(leaf)
+                freed += 1
+                # walk upward while the parent just became an evictable
+                # leaf AND is older than other candidates — cheap
+                # approximation: only continue upward inside this pass if
+                # the parent is unpinned and childless
+                while (
+                    freed < n_blocks
+                    and parent is not None
+                    and parent is not self._root
+                    and parent.pins == 0
+                    and not parent.children
+                ):
+                    nxt = parent.parent
+                    self._evict_node(parent)
+                    freed += 1
+                    parent = nxt
+        return freed
+
+    def _evict_matching(self, pred) -> int:
+        """One post-order pass (children before parents, so a parent whose
+        whole subtree evicts becomes childless within the SAME pass):
+        evict every node that satisfies ``pred``, is unpinned, and has no
+        surviving children. O(N) — this runs on the engine thread inside
+        the weight-commit window, where a repeated leaf-scan loop would
+        cost O(N·depth) and inflate weight_sync_stall_seconds."""
+        freed = 0
+        stack: list[tuple[RadixNode, bool]] = [(self._root, False)]
+        while stack:
+            node, visited = stack.pop()
+            if not visited:
+                stack.append((node, True))
+                for c in node.children.values():
+                    stack.append((c, False))
+                continue
+            if (
+                node is not self._root
+                and node.pins == 0
+                and not node.children
+                and pred(node)
+            ):
+                self._evict_node(node)
+                freed += 1
+        return freed
+
+    def on_weights_changed(self, new_version: int) -> int:
+        """Weight-version fence: bump the cache's version and evict every
+        unpinned stale node NOW (pinned ones are reaped by ``unpin``).
+        Called on the engine thread right after a commit so a new-version
+        prefill can never splice stale-version blocks. Returns the number
+        of blocks released."""
+        self.version = int(new_version)
+        return self._evict_matching(lambda n: n.version != self.version)
+
+    def flush(self) -> int:
+        """Drop every unpinned node regardless of version (tests,
+        defensive resets). Returns blocks released."""
+        return self._evict_matching(lambda n: True)
+
+    def check_invariants(self) -> None:
+        """Every cached block must hold at least the cache's own pool
+        reference, and the node count must match the tree."""
+        count = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            count += 1
+            if self.pool.ref[n.block_id] <= 0:
+                raise RuntimeError(
+                    f"radix node holds freed block {n.block_id}"
+                )
+            stack.extend(n.children.values())
+        if count != self._n_nodes:
+            raise RuntimeError(
+                f"radix node count {self._n_nodes} != tree walk {count}"
+            )

@@ -1,0 +1,158 @@
+"""Continuous-batching admission scheduler: prioritized queue + token-budget
+admission control for the generation engine.
+
+The engine loop used to pop a plain FIFO ``queue.Queue`` and retry-requeue
+at the tail, which (a) reordered requests under pool pressure, (b) gave
+bursty multi-tenant traffic no priority lever, and (c) admitted work the
+pool could not hold, thrashing the prefix-cache eviction path. This module
+owns that policy:
+
+- **Prioritized admission**: ``submit(seq, priority=...)`` — higher priority
+  admits first; FIFO within a priority class (stable sequence numbers). A
+  requeued entry (``push_front``) keeps its original position instead of
+  going to the back of the line.
+- **Token-budget admission control**: ``admission_token_budget`` caps the
+  tokens held by running + warming sequences; a request that would push the
+  pool past the budget stays QUEUED (no eviction thrash), and a request
+  that could NEVER fit is refused outright (``would_ever_fit``) so it fails
+  fast instead of deadlocking the queue head.
+- **Observability**: queue depth, admitted/submitted totals, and queue wait
+  times (total/last), surfaced via ``/model_info`` and the engine stats
+  path.
+
+Thread-safe: callers submit from any thread; the engine thread pops.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+
+class AdmissionScheduler:
+    """Priority queue of pending requests with admission accounting."""
+
+    def __init__(self, token_budget: int = 0, clock=time.monotonic):
+        # token_budget <= 0 means "no explicit budget" (the engine derives
+        # one from pool capacity); kept here so admission decisions and
+        # stats live in one place
+        self.token_budget = int(token_budget)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._heap: list = []  # (-priority, seqno, entry)
+        self._counter = itertools.count()
+        self._removed: set[int] = set()  # lazily-deleted seqnos
+        # stats
+        self.submitted_total = 0
+        self.admitted_total = 0
+        self.refused_total = 0  # hard refusals (could never fit)
+        self.queue_wait_seconds_total = 0.0
+        self.queue_wait_seconds_last = 0.0
+
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap) - len(self._removed)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def submit(self, seq, priority: int = 0) -> None:
+        with self._lock:
+            self.submitted_total += 1
+            now = self._clock()
+            heapq.heappush(
+                self._heap,
+                (-int(priority), next(self._counter),
+                 {"seq": seq, "t_enq": now, "t_first": now}),
+            )
+
+    def pop(self):
+        """Highest-priority pending request, or None. Records queue wait:
+        the total telescopes over pop/push_front cycles (t_enq resets on
+        every pop, so a requeued entry only ever adds the SLICE it waited
+        since its last pop — never its full history again), while ``last``
+        reports the true wait since original submission."""
+        with self._lock:
+            while self._heap:
+                negpri, seqno, entry = heapq.heappop(self._heap)
+                if seqno in self._removed:
+                    self._removed.discard(seqno)
+                    continue
+                now = self._clock()
+                self.queue_wait_seconds_total += max(
+                    0.0, now - entry["t_enq"]
+                )
+                entry["t_enq"] = now
+                self.queue_wait_seconds_last = max(
+                    0.0, now - entry["t_first"]
+                )
+                self.admitted_total += 1
+                entry["_key"] = (negpri, seqno)
+                return entry["seq"], entry
+            return None
+
+    def push_front(self, entry) -> None:
+        """Requeue a popped entry at its ORIGINAL position (same priority
+        and sequence number): the engine pops, discovers no slot/blocks are
+        free, and puts the request back without losing its place."""
+        with self._lock:
+            self.admitted_total -= 1
+            negpri, seqno = entry["_key"]
+            heapq.heappush(self._heap, (negpri, seqno, entry))
+
+    def remove_rids(self, rids) -> list:
+        """Remove (and return) every pending request whose rid is in
+        ``rids`` (abort of a queued-but-not-admitted request)."""
+        out = []
+        with self._lock:
+            for negpri, seqno, entry in self._heap:
+                if seqno in self._removed:
+                    continue
+                if entry["seq"].rid in rids:
+                    self._removed.add(seqno)
+                    out.append(entry["seq"])
+        return out
+
+    def drain(self) -> list:
+        """Pop everything (pause/abort-all: the client re-issues)."""
+        out = []
+        with self._lock:
+            for negpri, seqno, entry in sorted(self._heap):
+                if seqno not in self._removed:
+                    out.append(entry["seq"])
+            self._heap.clear()
+            self._removed.clear()
+        return out
+
+    def pending_rids(self) -> set:
+        with self._lock:
+            return {
+                entry["seq"].rid
+                for negpri, seqno, entry in self._heap
+                if seqno not in self._removed
+            }
+
+    # ------------------------------------------------------------------
+    # admission control
+    # ------------------------------------------------------------------
+
+    def admit_ok(self, need_tokens: int, held_tokens: int) -> bool:
+        """May a request needing ``need_tokens`` of KV admit right now,
+        given ``held_tokens`` already committed to running/warming
+        sequences? (No budget configured = always yes; capacity pressure
+        is then handled by the pool's eviction ladder.)"""
+        if self.token_budget <= 0:
+            return True
+        return held_tokens + need_tokens <= self.token_budget
+
+    def would_ever_fit(self, need_tokens: int) -> bool:
+        """False when the request exceeds the budget even on an empty
+        engine — it must be refused, not queued forever."""
+        if self.token_budget <= 0:
+            return True
+        return need_tokens <= self.token_budget

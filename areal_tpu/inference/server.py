@@ -166,6 +166,9 @@ class GenerationServer:
                     e.weight_sync_aborted_updates_total
                 ),
                 "decode_dispatch_count": e.decode_dispatch_count,
+                # serving plane: pool occupancy, radix prefix-cache hit
+                # rates, chunked prefill, admission queue depth/wait
+                **e.serving_stats(),
             }
         )
 
@@ -186,8 +189,12 @@ class GenerationServer:
             self.engine.submit(
                 rid, input_ids, gconfig, on_done,
                 image_data=body.get("image_data"),
+                # `or 0` folds JSON null to the default; a non-numeric
+                # priority falls into the 400 path below (a malformed
+                # request must fail fast, not 500-and-retry)
+                priority=int(body.get("priority") or 0),
             )
-        except ValueError as e:  # invalid request: no point retrying
+        except (ValueError, TypeError) as e:  # invalid request: fail fast
             return web.json_response({"error": str(e)}, status=400)
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=500)

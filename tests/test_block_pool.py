@@ -1,0 +1,266 @@
+"""BlockPool edge cases the radix prefix cache leans on: real exceptions
+instead of asserts (which vanish under ``python -O``), the invariant-check
+helper, copy-on-write of shared tail blocks, eviction-then-retry on
+OutOfBlocks, and the TRASH_BLOCK discipline."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.inference.block_pool import (
+    TRASH_BLOCK,
+    BlockPool,
+    BlockPoolCorruption,
+    OutOfBlocks,
+)
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+
+
+# ---------------------------------------------------------------------------
+# refcount errors are REAL exceptions, not asserts
+# ---------------------------------------------------------------------------
+
+
+def test_decref_of_free_block_raises_not_asserts():
+    p = BlockPool(8, 16)
+    a = p.alloc(2)
+    p.decref(a)
+    with pytest.raises(BlockPoolCorruption, match="double-free"):
+        p.decref(a)  # second release of the same reference
+    # the failed decref must not have corrupted the free list
+    p.check_invariants()
+
+
+def test_incref_of_free_block_raises():
+    p = BlockPool(8, 16)
+    a = p.alloc(1)
+    p.decref(a)
+    with pytest.raises(BlockPoolCorruption, match="use-after-free"):
+        p.incref(a)
+    p.check_invariants()
+
+
+def test_refcount_errors_survive_python_O_semantics():
+    """The guards are raise statements, not assert statements: compile the
+    module source with optimization level 2 (strips asserts) and the
+    double-free must STILL raise."""
+    import inspect
+
+    import areal_tpu.inference.block_pool as bp_mod
+
+    src = inspect.getsource(bp_mod)
+    code = compile(src, bp_mod.__file__, "exec", optimize=2)
+    ns: dict = {}
+    exec(code, ns)  # noqa: S102 — compiling our own module under -OO
+    p = ns["BlockPool"](8, 16)
+    a = p.alloc(1)
+    p.decref(a)
+    with pytest.raises(ns["BlockPoolCorruption"]):
+        p.decref(a)
+
+
+def test_invalid_block_ids_raise():
+    p = BlockPool(8, 16)
+    with pytest.raises(BlockPoolCorruption, match="invalid"):
+        p.incref([99])
+    with pytest.raises(BlockPoolCorruption, match="invalid"):
+        p.decref([99])
+
+
+# ---------------------------------------------------------------------------
+# invariant-check helper
+# ---------------------------------------------------------------------------
+
+
+def test_check_invariants_catches_planted_corruption():
+    p = BlockPool(8, 16)
+    a = p.alloc(3)
+    p.check_invariants()  # healthy
+    # plant: a referenced block also on the free list
+    p._free.append(a[0])
+    with pytest.raises(BlockPoolCorruption, match="free list"):
+        p.check_invariants()
+    p._free.pop()
+    # plant: negative refcount
+    p.ref[a[1]] = -1
+    with pytest.raises(BlockPoolCorruption, match="negative"):
+        p.check_invariants()
+    p.ref[a[1]] = 1
+    # plant: trash block freed
+    p.ref[TRASH_BLOCK] = 0
+    with pytest.raises(BlockPoolCorruption, match="trash"):
+        p.check_invariants()
+
+
+def test_refcount_balance_after_interleaved_alloc_share_free():
+    """Deterministic interleaving of alloc / incref (share) / decref across
+    many rounds: the ref sum vs free-list invariant must hold after every
+    step, and full teardown returns the pool to pristine."""
+    rng = np.random.default_rng(42)
+    p = BlockPool(32, 8)
+    tables: list[list[int]] = []
+    for step in range(300):
+        op = rng.integers(0, 3)
+        if op == 0 and p.n_free >= 3:
+            tables.append(p.alloc(int(rng.integers(1, 4))))
+        elif op == 1 and tables:
+            src = tables[int(rng.integers(0, len(tables)))]
+            p.incref(src)  # share: a second table references the blocks
+            tables.append(list(src))
+        elif tables:
+            t = tables.pop(int(rng.integers(0, len(tables))))
+            p.decref(t)
+        p.check_invariants()
+    for t in tables:
+        p.decref(t)
+    p.check_invariants()
+    assert p.n_used == 0 and p.n_free == p.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# TRASH_BLOCK discipline
+# ---------------------------------------------------------------------------
+
+
+def test_trash_block_never_allocated_and_refcount_ops_skip_it():
+    p = BlockPool(8, 16)
+    got = []
+    while p.n_free:
+        got.extend(p.alloc(1))
+    assert TRASH_BLOCK not in got
+    # incref/decref of the trash id are no-ops, never errors, and can
+    # never free it
+    p.incref([TRASH_BLOCK])
+    p.decref([TRASH_BLOCK])
+    p.decref([TRASH_BLOCK])
+    assert int(p.ref[TRASH_BLOCK]) == 1
+    p.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine-level: COW of a shared tail, eviction-then-retry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def _make_engine(model, start=True, **kw):
+    from areal_tpu.inference.engine import GenerationEngine
+
+    cfg, params = model
+    defaults = dict(
+        max_batch_size=4,
+        max_seq_len=256,
+        prefill_chunk=64,
+        decode_steps_per_call=4,
+        dtype="float32",
+        page_size=16,
+    )
+    defaults.update(kw)
+    eng = GenerationEngine(
+        JaxGenConfig(**defaults), model_config=cfg, params=params
+    )
+    if start:
+        eng.start()
+    return eng
+
+
+def _run(eng, rid, prompt, max_new=4):
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(
+        rid, prompt,
+        GenerationHyperparameters(
+            max_new_tokens=max_new, min_new_tokens=max_new, greedy=True
+        ),
+        cb,
+    )
+    assert done.wait(120), "generation timed out"
+    return out["r"]
+
+
+def test_cow_of_shared_tail_block(model):
+    """A clone admitted while its source is LIVE must copy-on-write the
+    shared partial tail block: full prefix blocks are referenced (refcount
+    sharing), the tail — which both sequences will append into — is
+    copied, and each copy stays ``writable`` (refcount 1)."""
+    eng = _make_engine(model, start=False)  # loop not running: drive _admit
+    try:
+        prompt = list(np.arange(1, 41) % 120)  # 40 tokens: 2 full + 8 tail
+        results = []
+        g = GenerationHyperparameters(max_new_tokens=4, greedy=True)
+        eng.submit("src", prompt, g, results.append)
+        eng.submit("clone", prompt, g, results.append)
+        eng._admit()
+        assert eng.n_running == 2
+        assert eng.prefix_clone_count == 1
+        src_slot, clone_slot = [
+            i for i in range(4) if eng.slots[i] is not None
+        ]
+        # full blocks shared by both tables (+1 radix-cache reference)
+        assert (
+            eng.block_table[clone_slot, :2] == eng.block_table[src_slot, :2]
+        ).all()
+        assert int(eng.pool.ref[eng.block_table[src_slot, 0]]) == 3
+        # the partial tail was COPIED, not shared: distinct ids, each
+        # writable by exactly its own sequence
+        src_tail = int(eng.block_table[src_slot, 2])
+        clone_tail = int(eng.block_table[clone_slot, 2])
+        assert src_tail != clone_tail
+        assert eng.pool.writable(src_tail)
+        assert eng.pool.writable(clone_tail)
+        eng.pool.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_eviction_then_retry_on_out_of_blocks(model):
+    """With the pool sized for ~2 sequences, a 3rd admission must evict a
+    finished sequence's cached blocks (slot table and/or radix nodes) and
+    retry — not raise OutOfBlocks, not wedge."""
+    eng = _make_engine(
+        model,
+        max_batch_size=2,
+        max_seq_len=64,
+        kv_pool_tokens=128,  # 8 blocks of 16
+        retain_kv_on_abort=False,
+    )
+    try:
+        for i in range(4):
+            r = _run(eng, f"r{i}", [1 + i, 2, 3, 4, 5, 6, 7, 8], max_new=4)
+            assert len(r.output_tokens) == 4
+        eng.pool.check_invariants()
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_out_of_blocks_when_pool_truly_full():
+    p = BlockPool(4, 16)
+    p.alloc(3)
+    with pytest.raises(OutOfBlocks):
+        p.alloc(1)
+    p.check_invariants()

@@ -140,6 +140,10 @@ def make_engine(addrs, session, **cfg_kwargs) -> RemoteInfEngine:
     cfg_kwargs.setdefault(
         "breaker", CircuitBreakerConfig(failure_threshold=1)
     )
+    # these tests pin breaker/failover semantics against scripted per-server
+    # handlers, so routing must stay deterministic round-robin; the
+    # prefix-affinity layer has its own tests (test_prefix_cache.py)
+    cfg_kwargs.setdefault("cache_aware_routing", False)
     eng = RemoteInfEngine(InferenceEngineConfig(**cfg_kwargs))
     eng.addresses = list(addrs)
 
@@ -988,7 +992,12 @@ class _StubEngine:
     def get_version(self):
         return self._version
 
-    def submit(self, rid, input_ids, gconfig, on_done, image_data=None):
+    def serving_stats(self):
+        return {}
+
+    def submit(
+        self, rid, input_ids, gconfig, on_done, image_data=None, priority=0
+    ):
         from areal_tpu.api.io_struct import ModelResponse
 
         on_done(

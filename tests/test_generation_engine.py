@@ -516,8 +516,14 @@ def test_prefix_extension_rejected_when_suffix_bucket_overflows_cache(model):
     rng = np.random.default_rng(7)
     shared = rng.integers(1, 128, size=200).tolist()
     g = GenerationHyperparameters(max_new_tokens=2, min_new_tokens=2, greedy=True)
-    # max_seq_len=256: suffix bucket (64) + best (200) > 256 -> no extension
-    eng = make_engine(model, max_seq_len=256, prefix_extend_min=8)
+    # max_seq_len=256: suffix bucket (64) + best (200) > 256 -> no extension.
+    # The radix cache is off: its block-aligned coverage (128 tokens) plus
+    # its own suffix bucket would legitimately fit, which is a different
+    # (valid) admission path than the slot-extension guard under test.
+    eng = make_engine(
+        model, max_seq_len=256, prefix_extend_min=8,
+        enable_prefix_cache=False,
+    )
     try:
         want = run_request(eng, "a", shared + [3, 4, 5], g)
         got = run_request(eng, "b", shared + [6, 7, 8], g)

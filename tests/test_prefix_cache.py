@@ -1,0 +1,652 @@
+"""Prefix-cache + continuous-batching serving plane.
+
+Covers the radix KV cache (inference/prefix_cache.py), the admission
+scheduler (inference/scheduler.py), their engine integration (greedy
+outputs token-identical cache-on vs cache-off, including ACROSS a staged
+weight commit), chunked-prefill dispatch interleaving, and cache-aware
+routing in RemoteInfEngine.choose_server (breaker-trip override + rejoin
+affinity rebuild).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    CircuitBreakerConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxGenConfig,
+)
+from areal_tpu.inference.block_pool import BlockPool
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.prefix_cache import RadixPrefixCache
+from areal_tpu.inference.scheduler import AdmissionScheduler
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+
+# ---------------------------------------------------------------------------
+# RadixPrefixCache unit behavior (host-only, no model)
+# ---------------------------------------------------------------------------
+
+
+def _cache(num_blocks=32, block_size=4):
+    pool = BlockPool(num_blocks, block_size)
+    return pool, RadixPrefixCache(pool)
+
+
+def test_radix_match_full_blocks_only():
+    pool, pc = _cache(block_size=4)
+    blocks = pool.alloc(2)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    pc.insert(toks, blocks)
+    # exact full-block coverage
+    m = pc.match(toks)
+    assert m.covered == 8 and m.blocks == blocks
+    # a partial tail never matches past the last full block
+    m = pc.match(toks + [9, 10])
+    assert m.covered == 8
+    m = pc.match([1, 2, 3, 4, 5, 6])
+    assert m.covered == 4 and m.blocks == blocks[:1]
+    # divergence inside the first block: no match at all
+    assert pc.match([1, 2, 9, 4, 5]).covered == 0
+    pc.check_invariants()
+    pool.check_invariants()
+
+
+def test_radix_insert_takes_one_ref_and_dedups():
+    pool, pc = _cache(block_size=4)
+    blocks = pool.alloc(2)
+    assert pc.insert([1, 2, 3, 4, 5, 6, 7, 8], blocks) == 2
+    assert int(pool.ref[blocks[0]]) == 2  # owner + cache
+    # same tokens from another sequence's (different) blocks: first wins
+    other = pool.alloc(2)
+    assert pc.insert([1, 2, 3, 4, 5, 6, 7, 8], other) == 0
+    assert pc.match([1, 2, 3, 4, 5, 6, 7, 8]).blocks == blocks
+    pool.decref(other)
+    pc.check_invariants()
+
+
+def test_radix_lru_eviction_skips_pinned():
+    pool, pc = _cache(num_blocks=8, block_size=2)
+    a = pool.alloc(1)
+    b = pool.alloc(1)
+    pc.insert([1, 2], a)
+    pc.insert([7, 8], b)
+    ma = pc.match([1, 2])  # refreshes a's last_use AFTER b's insert
+    pc.pin(ma.nodes)
+    pool.decref(a)
+    pool.decref(b)  # cache now holds the only refs
+    # evicting 2: the pinned node survives, only b goes
+    assert pc.evict(2) == 1
+    assert pc.match([1, 2]).covered == 2
+    assert pc.match([7, 8]).covered == 0
+    pc.unpin(ma.nodes)
+    assert pc.evict(1) == 1
+    assert pc.n_cached_blocks == 0
+    pool.check_invariants()
+
+
+def test_radix_lru_order_and_leaf_first():
+    pool, pc = _cache(num_blocks=16, block_size=2)
+    seq = [1, 2, 3, 4, 5, 6]  # 3 chained blocks
+    blocks = pool.alloc(3)
+    pc.insert(seq, blocks)
+    pool.decref(blocks)
+    # leaves evict before their parents (a parent with a child is not
+    # evictable: the child would become unreachable)
+    assert pc.evict(1) == 1
+    assert pc.match(seq).covered == 4
+    assert pc.evict(10) == 2
+    assert pc.n_cached_blocks == 0
+    pool.check_invariants()
+    assert pool.n_used == 0
+
+
+def test_radix_version_fence_evicts_stale_and_reaps_pinned_on_unpin():
+    pool, pc = _cache(num_blocks=8, block_size=2)
+    a = pool.alloc(1)
+    b = pool.alloc(1)
+    pc.insert([1, 2], a)
+    pc.insert([5, 6], b)
+    pool.decref(a)
+    pool.decref(b)
+    m = pc.match([1, 2])
+    pc.pin(m.nodes)
+    # weight commit: unpinned stale nodes evict NOW, pinned survive but
+    # are unmatchable (version gate)
+    freed = pc.on_weights_changed(1)
+    assert freed == 1
+    assert pc.match([5, 6]).covered == 0
+    assert pc.match([1, 2]).covered == 0  # stale even though still cached
+    assert pc.n_cached_blocks == 1
+    # the pinned stale node is reaped the moment its pin drops
+    pc.unpin(m.nodes)
+    assert pc.n_cached_blocks == 0
+    pool.check_invariants()
+    assert pool.n_used == 0
+
+
+def test_radix_insert_refreshes_stale_path():
+    pool, pc = _cache(num_blocks=8, block_size=2)
+    a = pool.alloc(1)
+    pc.insert([1, 2], a)
+    pool.decref(a)
+    pc.on_weights_changed(1)
+    # fence evicted the stale node; a new-version insert re-registers
+    b = pool.alloc(1)
+    pc.insert([1, 2], b)
+    m = pc.match([1, 2])
+    assert m.covered == 2 and m.blocks == b
+    pc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# AdmissionScheduler unit behavior
+# ---------------------------------------------------------------------------
+
+
+class _FakeSeq:
+    def __init__(self, rid):
+        self.rid = rid
+
+
+def test_scheduler_priority_then_fifo():
+    s = AdmissionScheduler()
+    s.submit(_FakeSeq("lo1"), priority=0)
+    s.submit(_FakeSeq("hi"), priority=5)
+    s.submit(_FakeSeq("lo2"), priority=0)
+    order = [s.pop()[0].rid for _ in range(3)]
+    assert order == ["hi", "lo1", "lo2"]
+    assert s.pop() is None
+    assert s.admitted_total == 3 and s.submitted_total == 3
+
+
+def test_scheduler_push_front_keeps_position():
+    s = AdmissionScheduler()
+    s.submit(_FakeSeq("a"))
+    s.submit(_FakeSeq("b"))
+    seq, entry = s.pop()
+    assert seq.rid == "a"
+    s.push_front(entry)  # no capacity: requeued at its ORIGINAL place
+    assert s.pop()[0].rid == "a"
+    assert s.pop()[0].rid == "b"
+
+
+def test_scheduler_remove_and_drain_and_pending():
+    s = AdmissionScheduler()
+    for r in ("a", "b", "c"):
+        s.submit(_FakeSeq(r))
+    assert s.pending_rids() == {"a", "b", "c"}
+    gone = s.remove_rids({"b"})
+    assert [x.rid for x in gone] == ["b"]
+    assert s.depth == 2
+    assert [x.rid for x in s.drain()] == ["a", "c"]
+    assert s.depth == 0
+
+
+def test_scheduler_token_budget():
+    s = AdmissionScheduler(token_budget=100)
+    assert s.admit_ok(need_tokens=40, held_tokens=50)
+    assert not s.admit_ok(need_tokens=60, held_tokens=50)
+    assert s.would_ever_fit(100)
+    assert not s.would_ever_fit(101)
+    # no budget = never refuses
+    s0 = AdmissionScheduler(token_budget=0)
+    assert s0.admit_ok(10**9, 10**9) and s0.would_ever_fit(10**9)
+
+
+def test_scheduler_queue_wait_stats():
+    t = {"now": 0.0}
+    s = AdmissionScheduler(clock=lambda: t["now"])
+    s.submit(_FakeSeq("a"))
+    t["now"] = 2.5
+    s.pop()
+    assert s.queue_wait_seconds_last == 2.5
+    assert s.queue_wait_seconds_total == 2.5
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(model, start=True, **kw):
+    cfg, params = model
+    defaults = dict(
+        max_batch_size=4,
+        max_seq_len=512,
+        prefill_chunk=64,
+        decode_steps_per_call=4,
+        dtype="float32",
+        page_size=16,
+        prefix_extend_min=16,
+    )
+    defaults.update(kw)
+    eng = GenerationEngine(
+        JaxGenConfig(**defaults), model_config=cfg, params=params
+    )
+    if start:
+        eng.start()
+    return eng
+
+
+def run_request(eng, rid, prompt, max_new=6, timeout=120.0, greedy=True):
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(
+        rid, prompt,
+        GenerationHyperparameters(
+            max_new_tokens=max_new, min_new_tokens=max_new, greedy=greedy
+        ),
+        cb,
+    )
+    assert done.wait(timeout), "generation timed out"
+    return out["r"]
+
+
+def _forget_slots(eng):
+    """Disable the slot-level clone/extension tier so only the RADIX tier
+    can serve reuse (simulates slot churn without extra traffic)."""
+    for i in range(eng.config.max_batch_size):
+        if eng.slots[i] is None:
+            eng._slot_covered[i] = []
+            eng._slot_kv_version[i] = 0
+
+
+def test_radix_survives_slot_churn_token_identical(model):
+    """The radix tier's reason to exist: after the source slot's covered
+    state is gone, a same-prefix request still reuses the cached blocks,
+    with greedy outputs identical to a cache-off engine."""
+    prompt = list(np.arange(1, 34) % 120)  # 33 tokens: 2 full 16-blocks
+    eng_off = make_engine(
+        model, enable_prefix_cache=False, enable_prefix_reuse=False
+    )
+    try:
+        want = run_request(eng_off, "w", prompt)
+    finally:
+        eng_off.stop()
+    eng = make_engine(model)
+    try:
+        first = run_request(eng, "a", prompt)
+        assert first.output_tokens == want.output_tokens
+        _forget_slots(eng)
+        computed_before = eng.prefill_tokens_computed_total
+        second = run_request(eng, "b", prompt)
+        assert second.output_tokens == want.output_tokens
+        np.testing.assert_allclose(
+            second.output_logprobs, want.output_logprobs, rtol=1e-5, atol=1e-6
+        )
+        assert eng.radix_hit_count == 1
+        # full-cover hit: ZERO prefill compute for the second request
+        assert eng.prefill_tokens_computed_total == computed_before
+        stats = eng.serving_stats()
+        assert stats["prefix_cache_hit_tokens_total"] >= 32
+        eng.pool.check_invariants()
+        eng.prefix_cache.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_grpo_group_prefill_reduction_and_identical_outputs(model):
+    """Acceptance pin: a GRPO-shaped workload (same prompt x group_size=4)
+    computes >= 3x fewer prefill tokens with the cache on, and greedy
+    outputs are token-identical cache-on vs cache-off."""
+    group_size = 4
+    prompt = list(np.arange(7, 55) % 120)  # 48 tokens = 3 full 16-blocks
+
+    def run_group(**kw):
+        eng = make_engine(model, **kw)
+        try:
+            outs = [
+                run_request(eng, f"g{i}", prompt, max_new=4)
+                for i in range(group_size)
+            ]
+            return outs, eng.prefill_tokens_computed_total
+        finally:
+            eng.stop()
+
+    outs_off, toks_off = run_group(
+        enable_prefix_cache=False, enable_prefix_reuse=False
+    )
+    outs_on, toks_on = run_group()
+    assert toks_off == group_size * len(prompt)
+    assert toks_on > 0
+    assert toks_off / toks_on >= 3.0, (toks_off, toks_on)
+    for a, b in zip(outs_on, outs_off):
+        assert a.output_tokens == b.output_tokens
+
+
+def test_multi_turn_growing_prefix_reuses_cache(model):
+    """Multi-turn shape: each turn re-sends the whole conversation plus a
+    new user suffix; the cache covers the full-block prefix so prefill
+    touches ~only the new turn."""
+    eng = make_engine(model)
+    try:
+        convo = list(np.arange(3, 51) % 120)  # 48 tokens
+        r1 = run_request(eng, "t1", convo, max_new=4)
+        convo = convo + r1.output_tokens + list(np.arange(60, 90) % 120)
+        _forget_slots(eng)  # force the radix tier
+        before = eng.prefill_tokens_computed_total
+        run_request(eng, "t2", convo, max_new=4)
+        suffix_cost = eng.prefill_tokens_computed_total - before
+        # covered prefix: the full blocks of turn 1's prompt+reply
+        assert suffix_cost < len(convo) // 2
+        assert eng.radix_hit_count == 1
+    finally:
+        eng.stop()
+
+
+def test_identical_outputs_across_staged_weight_commit(model):
+    """Acceptance pin (chaos/interaction): a PR 5-style staged weight
+    commit between two same-prompt requests must version-fence the cache —
+    the second request's greedy outputs match a FRESH cache-off engine at
+    the NEW weights (no stale-version KV splice)."""
+    cfg, params = model
+    prompt = list(np.arange(5, 38) % 120)  # 33 tokens
+    new_params = jax.tree.map(lambda x: x * 1.05, params)
+
+    eng = make_engine(model)
+    try:
+        run_request(eng, "warm", prompt)  # populates the radix cache at v0
+        # staged pipelined update (stage on caller thread, fenced commit)
+        named = {}
+
+        def walk(node, prefix):
+            for k, v in node.items():
+                path = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, dict):
+                    walk(v, path)
+                else:
+                    named[path] = np.asarray(v)
+
+        walk(new_params, "")
+        eng.stage_weight_chunk(named, version=1)
+        eng.commit_staged_weights(1)
+        assert eng.prefix_cache.version == 1
+        _forget_slots(eng)
+        got = run_request(eng, "after", prompt)
+        assert got.output_versions == [1] * len(got.output_versions)
+    finally:
+        eng.stop()
+
+    eng_ref = make_engine(
+        (cfg, new_params),
+        enable_prefix_cache=False,
+        enable_prefix_reuse=False,
+    )
+    try:
+        want = run_request(eng_ref, "ref", prompt)
+    finally:
+        eng_ref.stop()
+    assert got.output_tokens == want.output_tokens
+    np.testing.assert_allclose(
+        got.output_logprobs, want.output_logprobs, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_cache_eviction_under_pool_pressure_keeps_outputs(model):
+    """A pool sized for ~2 sequences forces LRU radix eviction; outputs
+    stay correct and the pool balances."""
+    eng = make_engine(
+        model,
+        max_batch_size=2,
+        max_seq_len=64,
+        kv_pool_tokens=160,  # 10 blocks of 16
+        retain_kv_on_abort=False,
+    )
+    try:
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            prompt = rng.integers(1, 120, size=33).tolist()
+            r = run_request(eng, f"p{i}", prompt, max_new=4)
+            assert len(r.output_tokens) == 4
+        assert eng.prefix_cache.evicted_blocks_total > 0
+        eng.pool.check_invariants()
+        eng.prefix_cache.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_admission_budget_refuses_impossible_and_queues_excess(model):
+    eng = make_engine(
+        model, start=False, admission_token_budget=64, max_batch_size=4
+    )
+    # impossible: refused immediately with a terminal response
+    got = []
+    eng.submit(
+        "huge", list(range(1, 81)),
+        GenerationHyperparameters(max_new_tokens=4), got.append,
+    )
+    assert got and got[0].stop_reason == "length" and not got[0].output_tokens
+    assert eng.scheduler.refused_total == 1
+    # two 40-token prompts: the first admits, the second must WAIT (40
+    # held + 40 needed > 64) rather than thrash eviction
+    res = []
+    g = GenerationHyperparameters(max_new_tokens=2, greedy=True)
+    eng.submit("a", list(np.arange(1, 41)), g, res.append)
+    eng.submit("b", list(np.arange(2, 42)), g, res.append)
+    eng._admit()
+    assert eng.n_running == 1
+    assert eng.scheduler.depth == 1
+    stats = eng.serving_stats()
+    assert stats["admission_queue_depth"] == 1
+    assert stats["admission_token_budget"] == 64
+    # started engine drains the queue as capacity frees: both finish
+    eng.start()
+    deadline = threading.Event()
+    for _ in range(600):
+        if len(res) == 2:
+            break
+        deadline.wait(0.1)
+    assert len(res) == 2
+    eng.stop()
+
+
+def test_priority_orders_admission(model):
+    eng = make_engine(model, start=False, max_batch_size=1)
+    res = []
+    g = GenerationHyperparameters(max_new_tokens=2, greedy=True)
+    eng.submit("lo", [1, 2, 3], g, res.append, priority=0)
+    eng.submit("hi", [4, 5, 6], g, res.append, priority=10)
+    eng._admit()
+    assert eng.n_running == 1
+    running = next(s for s in eng.slots if s is not None)
+    assert running.rid == "hi"
+    assert eng.scheduler.pending_rids() == {"lo"}
+    eng.stop()
+
+
+def test_chunked_prefill_interleaves_with_decode(model):
+    """Acceptance pin (the PR 5-style dispatch-interleaving test): while a
+    long prompt warms chunk-by-chunk (prefill_chunk_size knob), running
+    decodes KEEP dispatching — decode_dispatch_count advances between
+    warming chunks instead of stalling for the whole prompt."""
+    eng = make_engine(
+        model,
+        max_batch_size=2,
+        prefill_chunk_size=32,  # the new knob name drives warming
+        max_seq_len=512,
+    )
+    assert eng.config.chunked_prefill_tokens == 32
+    decode_at_chunk = []
+    orig = eng._extend_chunk
+
+    def spy(slot, ids_chunk, start):
+        decode_at_chunk.append(eng.decode_dispatch_count)
+        return orig(slot, ids_chunk, start)
+
+    eng._extend_chunk = spy
+    try:
+        bg_done = threading.Event()
+        eng.submit(
+            "bg", [9, 8, 7],
+            GenerationHyperparameters(
+                max_new_tokens=96, min_new_tokens=96, greedy=True
+            ),
+            lambda r: bg_done.set(),
+        )
+        # let the background decode start before the long admission
+        for _ in range(200):
+            if eng.decode_dispatch_count > 0:
+                break
+            threading.Event().wait(0.02)
+        assert eng.decode_dispatch_count > 0
+        long_prompt = list(np.arange(1, 301) % 120)  # 300 tokens, ~10 chunks
+        r = run_request(eng, "long", long_prompt, max_new=4)
+        assert len(r.output_tokens) == 4
+        assert bg_done.wait(120)
+        assert len(decode_at_chunk) >= 4  # really went through chunks
+        # decode advanced BETWEEN chunks (not all chunks at one stalled
+        # decode count)
+        assert decode_at_chunk[-1] > decode_at_chunk[0], decode_at_chunk
+        assert eng.prefill_chunks_total >= len(decode_at_chunk)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cache-aware routing (RemoteInfEngine.choose_server)
+# ---------------------------------------------------------------------------
+
+
+def _routing_engine(addrs, **cfg_kwargs):
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+
+    cfg_kwargs.setdefault("experiment_name", "pc")
+    cfg_kwargs.setdefault("trial_name", "t")
+    eng = RemoteInfEngine(InferenceEngineConfig(**cfg_kwargs))
+    eng.addresses = list(addrs)
+    return eng
+
+
+def test_affinity_key_stable_and_prefix_scoped():
+    eng = _routing_engine(["a:1"], route_affinity_prefix_tokens=4)
+    k1 = eng.prefix_affinity_key([1, 2, 3, 4, 99])
+    k2 = eng.prefix_affinity_key([1, 2, 3, 4, 100, 101])
+    assert k1 == k2  # same leading 4 tokens
+    assert k1 != eng.prefix_affinity_key([2, 2, 3, 4])
+    off = _routing_engine(["a:1"], cache_aware_routing=False)
+    assert off.prefix_affinity_key([1, 2, 3]) is None
+
+
+def test_affinity_key_quantized_so_growing_conversations_colocate():
+    """Multi-turn prompts GROW every turn; the hashed prefix length is
+    quantized to a power of two so consecutive turns share a key (one
+    remap per length doubling) instead of scattering across the fleet."""
+    eng = _routing_engine(["a:1"], route_affinity_prefix_tokens=512)
+    turn1 = list(range(300))
+    turn2 = turn1 + list(range(1000, 1200))  # 500 tokens, same prefix
+    assert eng.prefix_affinity_key(turn1) == eng.prefix_affinity_key(turn2)
+    # crossing the next power of two remaps ONCE (len >= 512 hashes 512)
+    turn3 = turn2 + list(range(2000, 2300))  # 800 tokens
+    turn4 = turn3 + list(range(3000, 3100))  # 900 tokens
+    assert eng.prefix_affinity_key(turn3) == eng.prefix_affinity_key(turn4)
+
+
+def test_affinity_routes_group_to_one_server_and_spreads_keys():
+    eng = _routing_engine(["a:1", "b:1", "c:1"])
+    key = eng.prefix_affinity_key(list(range(40)))
+    picks = {eng.choose_server(affinity_key=key) for _ in range(8)}
+    assert len(picks) == 1  # the whole group co-locates
+    # different prefixes spread across the fleet
+    spread = {
+        eng.choose_server(affinity_key=eng.prefix_affinity_key([i] * 24))
+        for i in range(16)
+    }
+    assert len(spread) >= 2
+
+
+def test_breaker_trip_overrides_affinity_and_rejoin_rebuilds():
+    """Chaos/interaction pin: quarantining the affinity server reroutes the
+    key (no deadlock); the version-checked probe rejoin restores the SAME
+    affinity with no coordination."""
+    eng = _routing_engine(
+        ["a:1", "b:1", "c:1"],
+        breaker=CircuitBreakerConfig(failure_threshold=1),
+    )
+    key = eng.prefix_affinity_key(list(range(32)))
+    home = eng.choose_server(affinity_key=key)
+    eng._health.quarantine(home, required_version=3)
+    rerouted = eng.choose_server(affinity_key=key)
+    assert rerouted != home  # OPEN breaker overrides affinity
+    assert {eng.choose_server(affinity_key=key) for _ in range(4)} == {rerouted}
+    # probe at stale version: still quarantined, still rerouted
+    eng._health.on_probe_result(home, ok=True, version=2)
+    assert eng.choose_server(affinity_key=key) == rerouted
+    # version-checked rejoin: HALF_OPEN accepts trial traffic and the key
+    # snaps back to its rendezvous home
+    eng._health.on_probe_result(home, ok=True, version=3)
+    back = eng.choose_server(affinity_key=key)
+    assert back == home
+    eng._health.on_request_start(home)
+    eng._health.on_request_end(home, ok=True, latency=0.01)
+    assert {eng.choose_server(affinity_key=key) for _ in range(4)} == {home}
+
+
+def test_rid_affinity_beats_prefix_affinity():
+    """A resumed request's server holds its EXACT in-flight KV — that beats
+    the statistical prefix signal."""
+    eng = _routing_engine(["a:1", "b:1", "c:1"])
+    key = eng.prefix_affinity_key(list(range(16)))
+    home = eng.choose_server(rid="r1", affinity_key=key)
+    other = next(a for a in eng.addresses if a != home)
+    eng._rid_to_address["r1"] = other  # as if failover moved it
+    assert eng.choose_server(rid="r1", affinity_key=key) == other
+
+
+def test_affinity_hotspot_guard_spills_to_load_policy():
+    """A workload whose prompts ALL share one template prefix must not
+    collapse the fleet onto a single server: once the preferred server
+    runs route_affinity_max_inflight_skew requests ahead of the
+    least-loaded candidate, the request spills to the load policy."""
+    eng = _routing_engine(
+        ["a:1", "b:1", "c:1"],
+        route_affinity_max_inflight_skew=4,
+        schedule_policy="least_loaded",
+    )
+    key = eng.prefix_affinity_key(list(range(32)))
+    home = eng.choose_server(affinity_key=key)
+    # below the skew cap: affinity sticks
+    eng._inflight = {home: 4}
+    assert eng.choose_server(affinity_key=key) == home
+    # past the cap: spill to least-loaded (NOT home), correctness intact
+    eng._inflight = {home: 5}
+    spilled = eng.choose_server(affinity_key=key)
+    assert spilled != home
+    # cap disabled: affinity always wins no matter the skew
+    eng.config.route_affinity_max_inflight_skew = 0
+    eng._inflight = {home: 10_000}
+    assert eng.choose_server(affinity_key=key) == home
+
+
+def test_all_breakers_open_still_no_deadlock_with_affinity():
+    eng = _routing_engine(
+        ["a:1", "b:1"], breaker=CircuitBreakerConfig(failure_threshold=1)
+    )
+    for a in ("a:1", "b:1"):
+        eng._health.quarantine(a)
+    key = eng.prefix_affinity_key([1, 2, 3, 4])
+    assert eng.choose_server(affinity_key=key) in {"a:1", "b:1"}
