@@ -9,3 +9,7 @@ and launcher/recovery/observability infrastructure.
 """
 
 __version__ = "0.1.0"
+
+# Resolve version-forked jax symbols and align old-jax global semantics
+# (e.g. partitionable threefry) BEFORE any submodule traces a computation.
+from areal_tpu.utils import jax_compat as _jax_compat  # noqa: E402,F401

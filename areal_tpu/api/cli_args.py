@@ -553,6 +553,18 @@ class JaxGenConfig:
     # and a request that could never fit is refused outright. 0 = derive
     # from pool capacity (kv_pool_tokens).
     admission_token_budget: int = 0
+    # ragged paged-attention Pallas decode kernel
+    # (ops/pallas/paged_attention.py): decode attention walks the block
+    # table in place — block-table-indexed KV gather inside the kernel,
+    # per-query causal masking over ragged cache lengths, fully-masked KV
+    # blocks skipped — instead of materializing the gathered [B, NBT*BS]
+    # view the XLA path einsums over. TPU backends run the compiled
+    # kernel; CPU runs it in interpret mode (parity testing / bench
+    # rehearsal). Requires kv_quant="none" and tp_size=1 (quantized pools
+    # and TP-sharded decode stay on the XLA gather path, loudly). Greedy
+    # outputs are token-identical kernel-on vs kernel-off
+    # (tests/test_paged_kernel.py pins this).
+    use_pallas_decode: bool = False
     # "int8" stores the paged KV pool as int8 + per-(row, head) scales:
     # ~half the HBM per cached token, ~double the concurrent sequences at
     # the same kv_pool_tokens byte budget (quality: symmetric per-row

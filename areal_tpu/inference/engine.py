@@ -223,6 +223,27 @@ class GenerationEngine:
         self.attn_spec = AttnSpec.for_mesh(
             self.mesh, model_config, token_axes=(), head_axis=AXIS_TP
         )
+        if config.use_pallas_decode:
+            # kernel-tier decode (ops/pallas/paged_attention.py). The raw
+            # pallas_call has no GSPMD partitioning rule, so TP-sharded
+            # decode stays on the einsum path; quantized pools need the
+            # gather path's dequant. Fall back loudly rather than silently
+            # serving a different kernel than asked.
+            if config.tp_size > 1 or config.kv_quant != "none":
+                logger.warning(
+                    "use_pallas_decode=True ignored: needs tp_size=1 and "
+                    "kv_quant='none' (got tp_size=%d, kv_quant=%r)",
+                    config.tp_size, config.kv_quant,
+                )
+            else:
+                self.attn_spec = dataclasses.replace(
+                    self.attn_spec,
+                    decode_impl=(
+                        "pallas"
+                        if jax.default_backend() == "tpu"
+                        else "pallas_interpret"
+                    ),
+                )
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
         shape_tree = jax.eval_shape(

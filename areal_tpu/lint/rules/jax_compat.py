@@ -1,9 +1,22 @@
 """jax-compat: APIs removed/renamed across the supported JAX version matrix.
 
-This is the exact class behind the seed's 64 pre-existing tier-1 failures
-(`jax.shard_map` / `pltpu.CompilerParams` absent on jax 0.4.x). Those known
-sites live in the committed baseline rather than being suppressed inline so
-the debt stays visible and enumerable.
+This was the exact class behind the seed's 64 pre-existing tier-1 failures
+(`jax.shard_map` / `pltpu.CompilerParams` absent on jax 0.4.x). The repo now
+routes every version-forked symbol through ``areal_tpu/utils/jax_compat.py``
+— the ONE module allowed to probe jax spellings directly — so the rule
+enforces two things:
+
+1. plainly removed/renamed APIs (``jax.tree_map`` et al.) are flagged with
+   their stable replacement;
+2. BOTH spellings of the version-forked symbols (``jax.shard_map`` AND
+   ``jax.experimental.shard_map.shard_map``; ``pltpu.CompilerParams`` AND
+   ``pltpu.TPUCompilerParams``) are flagged anywhere outside the shim:
+   importing either directly pins the file to one jax generation, which is
+   exactly the skew that turned tier-1 red. The shim module itself is
+   exempt — probing both spellings is its job.
+
+The baseline is empty and the test suite asserts it stays empty
+(tests/test_lint.py): new findings fail CI instead of re-growing debt.
 """
 
 from __future__ import annotations
@@ -13,15 +26,14 @@ from typing import Iterator
 
 from areal_tpu.lint.framework import FileContext, Finding, Rule, register
 
+#: the one module allowed to reference version-forked jax symbols directly
+SHIM_PATH_SUFFIX = "areal_tpu/utils/jax_compat.py"
+
+_SHIM = "areal_tpu.utils.jax_compat"
+
 # canonical dotted name -> what to use instead (keep messages stable: the
-# baseline keys on them)
+# baseline — when non-empty — keys on them)
 REMOVED_APIS: dict[str, str] = {
-    "jax.shard_map": (
-        "absent on jax 0.4.x; use jax.experimental.shard_map.shard_map"
-    ),
-    "jax.experimental.pallas.tpu.CompilerParams": (
-        "absent on jax 0.4.x; use pltpu.TPUCompilerParams"
-    ),
     "jax.tree_map": "removed in jax>=0.6; use jax.tree.map",
     "jax.tree_multimap": "removed; use jax.tree.map",
     "jax.tree_util.tree_multimap": "removed; use jax.tree.map",
@@ -35,26 +47,58 @@ REMOVED_APIS: dict[str, str] = {
     ),
 }
 
+# version-forked symbols: EITHER spelling outside the shim pins the file to
+# one jax generation — route through the shim instead
+VERSION_FORKED: dict[str, str] = {
+    "jax.shard_map": (
+        f"version-forked (absent on jax 0.4.x); use {_SHIM}.shard_map"
+    ),
+    "jax.experimental.shard_map.shard_map": (
+        f"version-forked (removed on new jax); use {_SHIM}.shard_map"
+    ),
+    "jax.experimental.pallas.tpu.CompilerParams": (
+        f"version-forked (absent on jax 0.4.x); use "
+        f"{_SHIM}.pallas_compiler_params"
+    ),
+    "jax.experimental.pallas.tpu.TPUCompilerParams": (
+        f"version-forked (removed on new jax); use "
+        f"{_SHIM}.pallas_compiler_params"
+    ),
+    "jax.set_mesh": (
+        f"version-forked (absent on jax 0.4.x); use {_SHIM}.set_mesh"
+    ),
+    "jax.sharding.get_abstract_mesh": (
+        f"version-forked (absent on jax 0.4.x); use {_SHIM}.shard_map's "
+        "nested_manual= instead of resolving the abstract mesh yourself"
+    ),
+}
+
 
 @register
 class JaxCompatRule(Rule):
     id = "jax-compat"
     doc = (
         "flags JAX APIs removed or renamed across the supported version "
-        "matrix (the class behind the seed tier-1 failures)"
+        "matrix, and EITHER spelling of version-forked symbols outside "
+        "areal_tpu/utils/jax_compat.py (the compat shim is the one place "
+        "allowed to probe jax spellings)"
     )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.path.replace("\\", "/").endswith(SHIM_PATH_SUFFIX):
+            # the shim probes both spellings by design
+            return
+        apis = {**REMOVED_APIS, **VERSION_FORKED}
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ImportFrom) and node.level == 0:
                 mod = node.module or ""
                 for a in node.names:
                     full = f"{mod}.{a.name}"
-                    if full in REMOVED_APIS:
+                    if full in apis:
                         yield self.finding(
                             ctx,
                             node,
-                            f"import of {full}: {REMOVED_APIS[full]}",
+                            f"import of {full}: {apis[full]}",
                         )
                 continue
             if not isinstance(node, (ast.Attribute, ast.Name)):
@@ -64,7 +108,5 @@ class JaxCompatRule(Rule):
             if isinstance(parent, ast.Attribute):
                 continue
             resolved = ctx.resolved(node)
-            if resolved in REMOVED_APIS:
-                yield self.finding(
-                    ctx, node, f"{resolved}: {REMOVED_APIS[resolved]}"
-                )
+            if resolved in apis:
+                yield self.finding(ctx, node, f"{resolved}: {apis[resolved]}")

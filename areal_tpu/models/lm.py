@@ -716,11 +716,30 @@ def _decode_paged_layer(
         pool_layer, "v", (flat_phys, flat_off),
         v.reshape(b * tq, *v.shape[2:]),
     )
-    k_view = _pool_view(pool_layer, "k", gather_ids, b, q.dtype)
-    v_view = _pool_view(pool_layer, "v", gather_ids, b, q.dtype)
-    attn = decode_attention_xla(
-        q, k_view, v_view, total_len, window=cfg.sliding_window
-    )
+    decode_impl = getattr(attn_spec, "decode_impl", "xla")
+    if decode_impl != "xla" and "ks" not in pool_layer:
+        # kernel tier: block-table-indexed Pallas decode straight off the
+        # pool — no gathered [B, NBT*BS] view ever materializes (quantized
+        # pools stay on the gather path: dequant needs the scale planes)
+        from areal_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        attn = paged_decode_attention(
+            q,
+            pool_layer["k"].astype(q.dtype),
+            pool_layer["v"].astype(q.dtype),
+            gather_ids,
+            total_len,
+            window=cfg.sliding_window,
+            interpret=decode_impl == "pallas_interpret",
+        )
+    else:
+        k_view = _pool_view(pool_layer, "k", gather_ids, b, q.dtype)
+        v_view = _pool_view(pool_layer, "v", gather_ids, b, q.dtype)
+        attn = decode_attention_xla(
+            q, k_view, v_view, total_len, window=cfg.sliding_window
+        )
     attn_out = attn.reshape(b, tq, cfg.q_dim) @ lp["wo"]
     if cfg.proj_bias:
         attn_out = attn_out + lp["bo"]

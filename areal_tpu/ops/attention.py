@@ -60,11 +60,21 @@ class AttnSpec:
     # context abstract mesh, keeping the Pallas kernel live under pp x tp
     # instead of degrading to O(T^2) einsum attention.
     nested_manual: frozenset = frozenset()
+    # paged DECODE kernel choice (models/lm._decode_paged_layer):
+    # "xla" = gather the block-table view and einsum (default);
+    # "pallas" / "pallas_interpret" = the ragged paged-attention kernel
+    # (ops/pallas/paged_attention.py) reading the pool in place. Set by the
+    # serving engine from JaxGenConfig.use_pallas_decode; quantized pools
+    # fall back to the gather path automatically.
+    decode_impl: str = "xla"
 
     def __post_init__(self):
         assert self.impl in (
             "auto", "pallas", "xla", "pallas_interpret", "ulysses"
         ), self.impl
+        assert self.decode_impl in (
+            "xla", "pallas", "pallas_interpret"
+        ), self.decode_impl
 
     @property
     def n_token_shards(self) -> int:

@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from areal_tpu.utils import jax_compat
+
 
 def moe_mlp_ragged(
     x: jnp.ndarray,  # [T, H]
@@ -38,7 +40,7 @@ def moe_mlp_ragged(
 
     router_logits = (x @ router_w).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [T, k]
+    topk_probs, topk_idx = jax_compat.top_k(probs, k)  # [T, k]
     if norm_topk_prob:
         topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
@@ -106,7 +108,7 @@ def moe_mlp_gshard(
     xg = x.reshape(g, s, h)
     router_logits = (xg @ router_w).astype(jnp.float32)  # [G, S, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, S, k]
+    topk_probs, topk_idx = jax_compat.top_k(probs, k)  # [G, S, k]
     if norm_topk_prob:
         topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 

@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.utils.jax_compat import pallas_compiler_params
+
 NEG_INF = -1e30
 DEFAULT_BLOCK = 128
 
@@ -191,7 +193,7 @@ def _fwd(q, k, v, segq, segk, starts, scale, block: int, interpret: bool, window
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -336,7 +338,7 @@ def _bwd(block, interpret, scale, res, dout, dlse=None, window: int = 0):
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((nh, tq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -371,7 +373,7 @@ def _bwd(block, interpret, scale, res, dout, dlse=None, window: int = 0):
             jax.ShapeDtypeStruct((nh, tk, d), q.dtype),
             jax.ShapeDtypeStruct((nh, tk, d), q.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

@@ -1,0 +1,192 @@
+"""Ragged paged-attention decode — Pallas TPU kernel.
+
+The kernel tier for the serving engine's decode hot path
+(models/lm._decode_paged_layer). The XLA path gathers the whole block-table
+view ``[B, NBT*BS, KH, D]`` out of the pool and einsums over it; this kernel
+instead walks the block table directly — the pool never materializes a
+gathered copy, and fully-masked KV blocks never run:
+
+- **block-table-indexed KV gather**: the pool ``[NB, BS, KH, D]`` stays in
+  place; the grid's kv-block step picks physical block ``table[b, kb]``
+  through a scalar-prefetch index map (SMEM), the paged-attention analogue
+  of flash_attention.py's segment-range prefetch;
+- **ragged lengths**: per-slot ``total_len`` (cache_len + Tq, the new
+  tokens' K/V are already scattered into the pool) lives in SMEM; blocks
+  past a slot's length are skipped (``pl.when``), so a batch of mixed-depth
+  sequences costs O(sum_b len_b), not O(B * NBT * BS);
+- **per-query causal masking**: with Tq > 1 (chunked-prefill tail /
+  spec-decode verify) query row t sees cache positions <= cache_len + t;
+  the optional sliding window masks and block-skips on the same positions;
+- **GQA folded into the layout**: q is reshaped to ``[B, KH, Tq*G, D]``
+  (rows grouped per kv head), so the kernel reads each KV block once per
+  kv head — no repeat_kv materialization;
+- classic flash accumulation (running max / denominator / accumulator in
+  VMEM scratch) over a ``(batch, kv_head, kv_block)`` grid, kv innermost-
+  sequential.
+
+``interpret=True`` runs the same kernel on CPU (tier-1 parity tests and the
+``pallas_kernel_validation`` / ``paged_decode_attention`` bench rungs);
+the XLA gather path stays as fallback and parity oracle — greedy outputs
+must be token-identical kernel-on vs kernel-off (tests/test_paged_kernel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from areal_tpu.utils.jax_compat import pallas_compiler_params
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    tbl_ref,  # [B, NBT] int32 physical block per logical block (SMEM)
+    len_ref,  # [B] int32 total valid tokens incl. the Tq new ones (SMEM)
+    q_ref,  # [TqG, D] — this (batch, kv head)'s query rows
+    k_ref,  # [BS, D] — physical KV block tbl[b, kb], head kh
+    v_ref,  # [BS, D]
+    o_ref,  # [TqG, D]
+    m_scr,  # [TqG, 1] f32
+    l_scr,  # [TqG, 1] f32
+    acc_scr,  # [TqG, D] f32
+    *,
+    scale: float,
+    bs: int,
+    nbt: int,
+    tq: int,
+    group: int,
+    window: int,
+):
+    b, kb = pl.program_id(0), pl.program_id(2)
+    n = len_ref[b]  # ragged length of this slot
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # ragged skip: block kb holds positions [kb*bs, kb*bs + bs); dead when
+    # past this slot's length, or (windowed) wholly behind every query
+    live = kb * bs < n
+    if window > 0:
+        live = live & (kb * bs + bs - 1 >= n - tq - (window - 1))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TqG, BS]
+        kpos = kb * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bs), 1
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], bs), 0)
+        qpos = n - tq + row // group  # per-query causal position
+        mask = (kpos <= qpos) & (kpos < n)
+        if window > 0:
+            mask = mask & (qpos - kpos < window)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_scr[:, :] = alpha * l_scr[:, :] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[:, :] = m_cur
+
+    @pl.when(kb == nbt - 1)
+    def _finish():
+        l = l_scr[:, :]
+        m = m_scr[:, :]
+        valid = m > NEG_INF / 2
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o = jnp.where(valid, acc_scr[:, :] / safe_l, 0.0)
+        o_ref[:, :] = o.astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, Tq, NH, D]
+    k_pool: jnp.ndarray,  # [NB, BS, KH, D] — one layer's pool slice
+    v_pool: jnp.ndarray,  # [NB, BS, KH, D]
+    gather_ids: jnp.ndarray,  # [B, NBT] int32, unmapped entries clamped >= 0
+    total_len: jnp.ndarray,  # [B] cache_len + Tq
+    softmax_scale: float | None = None,
+    window: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode attention straight off the paged pool. Drop-in replacement
+    for ``_pool_view`` + ``decode_attention_xla`` (same [B, Tq, NH, D]
+    return, same masking semantics); NOT differentiated (decode only)."""
+    b, tq, nh, d = q.shape
+    nb, bs, kh = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    nbt = gather_ids.shape[1]
+    group = nh // kh
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    tqg = tq * group
+
+    # rows grouped per kv head: row t*G + g of head kh is q[:, t, kh*G + g]
+    qg = (
+        q.reshape(b, tq, kh, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, kh, tqg, d)
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=scale, bs=bs, nbt=nbt, tq=tq, group=group, window=window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh, nbt),
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, tqg, d), lambda bi, hi, kb, *_: (bi, hi, 0, 0)
+            ),
+            pl.BlockSpec(
+                (None, bs, None, d),
+                lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
+            ),
+            pl.BlockSpec(
+                (None, bs, None, d),
+                lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, tqg, d), lambda bi, hi, kb, *_: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tqg, 1), jnp.float32),
+            pltpu.VMEM((tqg, 1), jnp.float32),
+            pltpu.VMEM((tqg, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, tqg, d), q.dtype),
+        compiler_params=pallas_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        gather_ids.astype(jnp.int32),
+        total_len.astype(jnp.int32),
+        qg,
+        k_pool,
+        v_pool,
+    )
+    return (
+        out.reshape(b, kh, tq, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, tq, nh, d)
+    )

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from areal_tpu.ops.attention import repeat_kv
+from areal_tpu.utils import jax_compat
 
 _NEG_INF = -1e30
 
@@ -134,10 +135,10 @@ def ring_attention_local(
         o_acc, lse_acc, k_cur, v_cur, segk, k_start = carry
         o_c, lse_c = chunk(q, k_cur, v_cur, segment_ids, segk, q_start, k_start)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_c, lse_c)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        segk_nxt = jax.lax.ppermute(segk, axis_name, perm)
-        kst_nxt = jax.lax.ppermute(k_start, axis_name, perm)
+        k_nxt = jax_compat.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax_compat.ppermute(v_cur, axis_name, perm)
+        segk_nxt = jax_compat.ppermute(segk, axis_name, perm)
+        kst_nxt = jax_compat.ppermute(k_start, axis_name, perm)
         return (o_acc, lse_acc, k_nxt, v_nxt, segk_nxt, kst_nxt), None
 
     o0 = jnp.zeros((tl, nh, d), jnp.float32)
@@ -215,18 +216,20 @@ def ring_attention_sharded(
     spec3 = P(tok, head_axis, None)
     spec1 = P(tok)
     extra = {}
-    use_mesh = mesh
     if nested_manual:
         own = set(token_axes) | set(axes)
         if head_axis is not None:
             own.add(head_axis)
+        # jax_compat.shard_map resolves the context abstract mesh (new jax)
+        # or keeps the concrete mesh with the right auto complement (0.4.x)
         extra["axis_names"] = frozenset(own)
-        use_mesh = jax.sharding.get_abstract_mesh()
-    return jax.shard_map(
+        extra["nested_manual"] = frozenset(nested_manual)
+    return jax_compat.shard_map(
         fn,
-        mesh=use_mesh,
+        mesh=mesh,
         in_specs=(spec3, spec3, spec3, spec1, spec1),
         out_specs=spec3,
         check_vma=False,
+        diff_argnums=(0, 1, 2),
         **extra,
     )(q, k, v, segment_ids, starts)

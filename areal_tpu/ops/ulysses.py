@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from areal_tpu.utils import jax_compat
+
 
 def _local_attention(q, k, v, seg, impl: str, block: int, softmax_scale,
                      window: int = 0):
@@ -85,19 +87,19 @@ def ulysses_attention_sharded(
         # [Tl, H, D] -> heads split across the group, sequence gathered:
         # all_to_all(split heads, concat tokens) -> [Tl*n, H/n, D]
         def scatter_heads(x):
-            return jax.lax.all_to_all(
+            return jax_compat.all_to_all(
                 x, axis, split_axis=1, concat_axis=0, tiled=True
             )
 
         def gather_heads(x):
-            return jax.lax.all_to_all(
+            return jax_compat.all_to_all(
                 x, axis, split_axis=0, concat_axis=1, tiled=True
             )
 
         qf = scatter_heads(q_l)
         kf = scatter_heads(k_l)
         vf = scatter_heads(v_l)
-        seg_f = jax.lax.all_gather(seg_l, axis, tiled=True)  # [T]
+        seg_f = jax_compat.all_gather(seg_l, axis, tiled=True)  # [T]
         of = _local_attention(
             qf, kf, vf, seg_f, chunk_impl, block, softmax_scale, window
         )
@@ -106,15 +108,15 @@ def ulysses_attention_sharded(
     spec3 = P(token_axes, None, None)
     spec1 = P(token_axes)
     extra = {}
-    use_mesh = mesh
     if nested_manual:
         extra["axis_names"] = frozenset(token_axes)
-        use_mesh = jax.sharding.get_abstract_mesh()
-    return jax.shard_map(
+        extra["nested_manual"] = frozenset(nested_manual)
+    return jax_compat.shard_map(
         fn,
-        mesh=use_mesh,
+        mesh=mesh,
         in_specs=(spec3, spec3, spec3, spec1),
         out_specs=spec3,
         check_vma=False,
+        diff_argnums=(0, 1, 2),
         **extra,
     )(q, k, v, segment_ids)

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import AttnSpec
 from areal_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_PP, AXIS_TP
+from areal_tpu.utils import jax_compat
 
 
 def pp_size(mesh: Mesh | None) -> int:
@@ -165,11 +166,11 @@ def pipeline_hidden(
 
         if remat:
             body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
-        y, _ = jax.lax.scan(body, x, layers_local)
+        y, _ = jax_compat.scan(body, x, layers_local, unroll=True)
         return y
 
     def stage_fn(layers_local, emb, pos_all, seg_all):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
         steps = m + s - 1
         buf = jnp.zeros_like(emb[0])
 
@@ -187,12 +188,12 @@ def pipeline_hidden(
                 seg_all, midx, 0, keepdims=False
             )
             y = run_stage(layers_local, x_in, pos, seg)
-            nxt = jax.lax.ppermute(
+            nxt = jax_compat.ppermute(
                 y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
             )
             return nxt, y
 
-        _, ys = jax.lax.scan(body, buf, jnp.arange(steps))
+        _, ys = jax_compat.scan(body, buf, jnp.arange(steps), unroll=True)
         # microbatch mb exits the last stage at step mb + s - 1
         out = ys[s - 1 :]
         out = jnp.where(stage == s - 1, out, 0.0)
@@ -202,14 +203,14 @@ def pipeline_hidden(
             # transient full-size buffer), and the pp-sharded out_specs
             # spare XLA an "involuntary full rematerialization" reshard at
             # the head boundary
-            return jax.lax.psum_scatter(
+            return jax_compat.psum_scatter(
                 out, AXIS_PP, scatter_dimension=1, tiled=True
             )
         return jax.lax.psum(out, AXIS_PP)
 
     t = embeds.shape[1]
     shard_out = t % s == 0
-    return jax.shard_map(
+    return jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), P(), P(), P()),
@@ -359,12 +360,12 @@ def pipeline_train_step_1f1b(
 
         if remat:
             body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
-        y, _ = jax.lax.scan(body, x, chunk_layers)
+        y, _ = jax_compat.scan(body, x, chunk_layers, unroll=True)
         return y
 
     def stage_fn(layers_local, ids_all, pos_all, seg_all, mbs_rep, head_w_l,
                  norm_w, norm_b_l, embed_w, pos_embed_l):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
         is_first = stage == 0
         is_last = stage == s - 1
         lo = stage * tl  # this stage's head token slice
@@ -549,10 +550,10 @@ def pipeline_train_step_1f1b(
             # ---- messages for the next tick (full ring: the wrap edges
             #      carry chunk transitions; with v=1 the wrapped message is
             #      never consumed, same as the old open-chain permute) ----
-            fwd_nxt = jax.lax.ppermute(
+            fwd_nxt = jax_compat.ppermute(
                 y, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
             )
-            bwd_nxt = jax.lax.ppermute(
+            bwd_nxt = jax_compat.ppermute(
                 dx, AXIS_PP, [(i, (i - 1) % s) for i in range(s)]
             )
             return (
@@ -580,7 +581,7 @@ def pipeline_train_step_1f1b(
         )
         (
             _, _, _, _, loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos
-        ) = jax.lax.scan(tick, carry0, jnp.arange(steps))[0]
+        ) = jax_compat.scan(tick, carry0, jnp.arange(steps))[0]
         # token-sliced / device-local accumulators -> global sums (g_lay
         # stays per-device: it matches the pp-sharded chunk stack)
         loss_vec = jax.lax.psum(loss_vec, AXIS_PP)
@@ -591,7 +592,7 @@ def pipeline_train_step_1f1b(
         g_pos = jax.lax.psum(g_pos, AXIS_PP)
         return loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos
 
-    loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos = jax.shard_map(
+    loss_vec, g_lay, g_emb, g_nw, g_nb, g_hw, g_pos = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(
@@ -644,12 +645,12 @@ def _stage_ticks(s: int, stage, work, operands, collect_last: bool):
         y_keep = None
         if collect_last:
             y_keep = jnp.where((stage == s - 1) & (t == s - 1), x, 0.0)
-        x = jax.lax.ppermute(
+        x = jax_compat.ppermute(
             x, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
         )
         return (x, *rest), y_keep
 
-    carry, ys = jax.lax.scan(tick, operands, jnp.arange(s))
+    carry, ys = jax_compat.scan(tick, operands, jnp.arange(s))
     y = None
     if collect_last:
         y = jax.lax.psum(jnp.sum(ys, 0), AXIS_PP)  # one tick contributed
@@ -700,7 +701,7 @@ def prefill_stream_pp(
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
     def stage_fn(layers_local, pool, x_in):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
 
         def work(x, pl):
             def body(carry, layer_in):
@@ -713,7 +714,7 @@ def prefill_stream_pp(
                 pool_layer = _pool_write(pool_layer, "v", idx, v)
                 return out, pool_layer
 
-            y, pl = jax.lax.scan(body, x, (layers_local, pl))
+            y, pl = jax_compat.scan(body, x, (layers_local, pl))
             return y, pl
 
         (_, pl), y = _stage_ticks(
@@ -722,7 +723,7 @@ def prefill_stream_pp(
         return y, pl
 
     pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
-    y, new_cache = jax.shard_map(
+    y, new_cache = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), pool_specs, P()),
@@ -774,7 +775,7 @@ def prefill_rotated_pp(
     steps = 2 * s - 1
 
     def stage_fn(layers_local, pool, emb):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
 
         def tick(carry, tt):
             msg, out, pl = carry
@@ -802,13 +803,13 @@ def prefill_rotated_pp(
                 pool_layer = _pool_write(pool_layer, "v", (blk, off), v)
                 return out_c, pool_layer
 
-            y, pl = jax.lax.scan(body, x_in, (layers_local, pl))
+            y, pl = jax_compat.scan(body, x_in, (layers_local, pl))
             is_out = (stage == s - 1) & valid
             li = jax.lax.dynamic_index_in_dim(last_idx, mc, 0, False)
             rows = y[li]  # [N, H]
             slot = jnp.where(is_out, mc, s)
             out = jax.lax.dynamic_update_index_in_dim(out, rows, slot, 0)
-            nxt = jax.lax.ppermute(
+            nxt = jax_compat.ppermute(
                 y, AXIS_PP, [(i, i + 1) for i in range(s - 1)]
             )
             return (nxt, out, pl), None
@@ -818,12 +819,14 @@ def prefill_rotated_pp(
             jnp.zeros((s + 1, n, h), emb.dtype),
             pool,
         )
-        (_, out, pl), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
+        (_, out, pl), _ = jax_compat.scan(
+            tick, carry0, jnp.arange(steps), unroll=True
+        )
         out = jnp.where(stage == s - 1, out[:s], 0.0)
         return jax.lax.psum(out, AXIS_PP), pl
 
     pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
-    hidden, new_cache = jax.shard_map(
+    hidden, new_cache = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), pool_specs, P()),
@@ -888,7 +891,7 @@ def decode_step_paged_pp(
     inner_spec = stage_attn_spec(attn_spec, mesh)
 
     def stage_fn(layers_local, pool, x_in):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
 
         def work(x, pl):
             def body(carry, layer_in):
@@ -900,7 +903,7 @@ def decode_step_paged_pp(
                 )
                 return out, pool_layer
 
-            y, pl = jax.lax.scan(body, x, (layers_local, pl))
+            y, pl = jax_compat.scan(body, x, (layers_local, pl))
             return y, pl
 
         (_, pl), y = _stage_ticks(
@@ -909,7 +912,7 @@ def decode_step_paged_pp(
         return y, pl
 
     pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
-    y, cache = jax.shard_map(
+    y, cache = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), pool_specs, P()),
@@ -1004,12 +1007,12 @@ def pipeline_hidden_interleaved(
 
         if remat:
             body = jax.checkpoint(body, policy=_REMAT_POLICIES[remat_policy])
-        y, _ = jax.lax.scan(body, x, chunk_layers)
+        y, _ = jax_compat.scan(body, x, chunk_layers, unroll=True)
         return y
 
     def stage_fn(layers_local, emb, pos_all, seg_all):
         # layers_local: [1, V, Lc, ...]
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
 
         def tick(carry, tt):
             x_carry, out = carry
@@ -1032,7 +1035,7 @@ def pipeline_hidden_interleaved(
             is_out = (stage == s - 1) & (vchunk == v - 1) & in_range
             slot = jnp.where(is_out, mb, m)
             out = jax.lax.dynamic_update_index_in_dim(out, y, slot, 0)
-            nxt = jax.lax.ppermute(
+            nxt = jax_compat.ppermute(
                 y, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
             )
             return (nxt, out), None
@@ -1041,19 +1044,19 @@ def pipeline_hidden_interleaved(
             jnp.zeros((t_len, h), emb.dtype),
             jnp.zeros((m + 1, t_len, h), emb.dtype),
         )
-        (_, out), _ = jax.lax.scan(tick, carry0, jnp.arange(steps))
+        (_, out), _ = jax_compat.scan(tick, carry0, jnp.arange(steps), unroll=True)
         out = jnp.where(stage == s - 1, out[:m], 0.0)
         if shard_out:
             # same reduce-scatter trade as pipeline_hidden: each stage keeps
             # its own token slice, halving wire traffic and handing the head
             # boundary an already-pp-sharded tensor
-            return jax.lax.psum_scatter(
+            return jax_compat.psum_scatter(
                 out, AXIS_PP, scatter_dimension=1, tiled=True
             )
         return jax.lax.psum(out, AXIS_PP)
 
     shard_out = t_len % s == 0
-    out = jax.shard_map(
+    out = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), P(), P(), P()),
@@ -1123,7 +1126,7 @@ def decode_rotated_pp(
     rngs = jax.random.split(rng, ticks)
 
     def stage_fn(layers_local, pool):
-        stage = jax.lax.axis_index(AXIS_PP)
+        stage = jax_compat.axis_index(AXIS_PP)
         is_exit = stage == s - 1
 
         def tick(carry, xs):
@@ -1174,7 +1177,7 @@ def decode_rotated_pp(
                 )
                 return out, pool_layer
 
-            y, pl = jax.lax.scan(body, x_in, (layers_local, pl))
+            y, pl = jax_compat.scan(body, x_in, (layers_local, pl))
 
             def exit_fn(y_):
                 xn = _norm(cfg, y_[:, 0], params["final_norm"], norm_b)
@@ -1215,7 +1218,7 @@ def decode_rotated_pp(
             clen_all = clen_all + jax.lax.psum(len_delta, AXIS_PP)
 
             out_msg = jnp.where(exit_valid, emb_nxt, y)
-            out_msg = jax.lax.ppermute(
+            out_msg = jax_compat.ppermute(
                 out_msg, AXIS_PP, [(i, (i + 1) % s) for i in range(s)]
             )
             ys_tok = jax.lax.psum(jnp.where(exit_valid, nxt, 0), AXIS_PP)
@@ -1232,13 +1235,13 @@ def decode_rotated_pp(
             cache_len,
             pool,
         )
-        (_, _, _, pl), (toks, logps) = jax.lax.scan(
+        (_, _, _, pl), (toks, logps) = jax_compat.scan(
             tick, carry0, (jnp.arange(ticks), rngs)
         )
         return toks, logps, pl
 
     pool_specs = jax.tree.map(lambda _: P(AXIS_PP), dict(cache))
-    toks_t, logps_t, new_cache = jax.shard_map(
+    toks_t, logps_t, new_cache = jax_compat.shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(AXIS_PP), pool_specs),

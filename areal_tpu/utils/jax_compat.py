@@ -1,0 +1,565 @@
+"""Version-compat layer for JAX APIs that forked across the supported matrix.
+
+THE one home for every version-forked jax symbol this repo touches — all
+consumers (ops/, parallel/, inference/) import from here instead of probing
+jax themselves, and the ``jax-compat`` arealint rule enforces it
+(areal_tpu/lint/rules/jax_compat.py: direct ``shard_map`` / Pallas
+compiler-params imports outside this module are findings).
+
+Two jax generations are supported:
+
+- **new** (>= 0.5-era): ``jax.shard_map`` with ``axis_names=`` /
+  ``check_vma=`` and abstract-mesh nesting
+  (``jax.sharding.get_abstract_mesh``); Pallas TPU params are
+  ``pltpu.CompilerParams``.
+- **old** (0.4.x, what this image ships): ``shard_map`` lives at
+  ``jax.experimental.shard_map.shard_map`` with the complementary
+  ``auto=`` / ``check_rep=`` spelling; Pallas TPU params are
+  ``pltpu.TPUCompilerParams``.
+
+The rename layer is the easy half. The hard half is that on 0.4.x the
+**partial-auto** shard_map mode (manual over a subset of mesh axes, the
+rest auto so GSPMD keeps sharding the stage interior — how every pipeline
+schedule in parallel/pipeline.py runs) has broken collective lowering on
+CPU: ``ppermute`` / ``all_gather`` / ``all_to_all`` abort inside the XLA
+SPMD partitioner (``Check failed: target.IsManualSubgroup() ==
+sharding().IsManualSubgroup()``) and ``axis_index`` lowers to an
+unpartitionable ``PartitionId`` op — while ``psum`` / ``psum_scatter``
+lower fine. So :func:`shard_map` here detects the degraded regime (old
+jax AND any auto axis with extent > 1) and the collective wrappers below
+(:func:`axis_index`, :func:`ppermute`, :func:`all_gather`,
+:func:`all_to_all`) transparently fall back to psum-based equivalents:
+
+- the wrapper feeds each manual axis's coordinate in as DATA (a sharded
+  iota extra argument) and stashes it in a trace-local context, so
+  :func:`axis_index` never emits ``PartitionId``;
+- ``ppermute``/``all_gather``/``all_to_all`` one-hot-scatter their operand
+  into a per-shard slot of a zeros table and ``psum`` it, then slice the
+  receiver's entry — O(n) extra bandwidth, which only ever runs in CPU
+  rehearsal (new jax on TPU takes the native path), and differentiable by
+  construction (dynamic-update-slice + psum), so AD through pipeline
+  schedules keeps working.
+
+Everything here is trace-time dispatch: ``interpret``/jit/scan/vjp see
+ordinary lax ops either way.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# --------------------------------------------------------------------------
+# Version probes (computed once at import)
+# --------------------------------------------------------------------------
+
+#: True on jax generations that ship ``jax.shard_map`` natively.
+HAS_NATIVE_SHARD_MAP: bool = hasattr(jax, "shard_map")
+
+try:  # pragma: no cover - absent only on exotic builds
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # noqa: BLE001
+    _pltpu = None
+
+#: The Pallas TPU compiler-params class under its version-local name.
+if _pltpu is not None and hasattr(_pltpu, "CompilerParams"):
+    TPUCompilerParams = _pltpu.CompilerParams
+elif _pltpu is not None:
+    TPUCompilerParams = _pltpu.TPUCompilerParams
+else:  # pragma: no cover
+    TPUCompilerParams = None
+
+
+def pallas_compiler_params(**kwargs) -> Any:
+    """Construct Pallas TPU compiler params under either spelling.
+
+    ``pallas_compiler_params(dimension_semantics=("parallel", "arbitrary"))``
+    returns ``pltpu.CompilerParams(...)`` on new jax and
+    ``pltpu.TPUCompilerParams(...)`` on 0.4.x.
+    """
+    if TPUCompilerParams is None:  # pragma: no cover
+        raise RuntimeError("jax.experimental.pallas.tpu is unavailable")
+    return TPUCompilerParams(**kwargs)
+
+
+if HAS_NATIVE_SHARD_MAP:
+    _native_shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _native_shard_map
+
+    # New jax defaults to the partitionable threefry; 0.4.x defaults to the
+    # legacy layout-DEPENDENT one, where `jit(init, out_shardings=...)` over
+    # a tp-sharded leaf generates different values than the unsharded call —
+    # exactly the single-device-vs-mesh init divergence the engine equality
+    # tests pin. Align old jax to the new-jax semantics.
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+# --------------------------------------------------------------------------
+# Trace-local manual-axis context (the degraded-collective side channel)
+# --------------------------------------------------------------------------
+
+# Tracing is single-threaded per trace; a thread-local stack of
+# {axis: (coord_tracer, size)} frames survives nested compat shard_maps.
+_tls = threading.local()
+
+
+def _ctx_stack() -> list[dict[str, tuple[Any, int]]]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def _current_ctx() -> dict[str, tuple[Any, int]]:
+    stack = _ctx_stack()
+    return stack[-1] if stack else {}
+
+
+@contextlib.contextmanager
+def _pushed_ctx(frame: dict[str, tuple[Any, int]]):
+    merged = dict(_current_ctx())
+    merged.update(frame)
+    _ctx_stack().append(merged)
+    try:
+        yield
+    finally:
+        _ctx_stack().pop()
+
+
+def _in_degraded_region() -> bool:
+    return bool(_current_ctx())
+
+
+def _axes_tuple(axis_name) -> tuple:
+    return (axis_name,) if not isinstance(axis_name, (tuple, list)) else tuple(
+        axis_name
+    )
+
+
+def _combined_index_size(axis_name) -> tuple[Any, int]:
+    """(linear index along the flattened axis group, group size), matching
+    jax's left-major flattening of multi-axis collectives."""
+    ctx = _current_ctx()
+    idx = None
+    total = 1
+    for a in _axes_tuple(axis_name):
+        if a not in ctx:
+            raise KeyError(
+                f"axis {a!r} is not a manual axis of the enclosing compat "
+                f"shard_map (have {sorted(ctx)})"
+            )
+        coord, n = ctx[a]
+        idx = coord if idx is None else idx * n + coord
+        total *= n
+    return idx, total
+
+
+# --------------------------------------------------------------------------
+# shard_map
+# --------------------------------------------------------------------------
+
+
+def shard_map(
+    f: Callable,
+    mesh: Any = None,
+    in_specs: Any = None,
+    out_specs: Any = None,
+    *,
+    check_vma: bool = True,
+    axis_names: frozenset | set | None = None,
+    nested_manual: frozenset = frozenset(),
+    diff_argnums: tuple | None = None,
+    _force_degraded: bool = False,
+) -> Callable:
+    """Version-portable ``shard_map``.
+
+    Parameters follow the NEW jax spelling (``axis_names`` = the axes this
+    map manualizes, ``check_vma``); the shim translates for 0.4.x
+    (``auto`` = complement, ``check_rep``). ``nested_manual`` names axes an
+    ENCLOSING shard_map already manualized: on new jax the inner map then
+    runs on the context abstract mesh (``jax.sharding.get_abstract_mesh``);
+    on old jax it keeps the concrete mesh and simply excludes those axes
+    from ``auto``.
+
+    Old-jax degraded regime: when any ``auto`` axis has extent > 1, the
+    native collectives this repo uses inside manual regions crash XLA's
+    SPMD partitioner on CPU, so the wrapper feeds each manual axis's
+    coordinate in as a sharded-iota extra argument and arms the
+    psum-fallback paths of :func:`axis_index` / :func:`ppermute` /
+    :func:`all_gather` / :func:`all_to_all` for the duration of the trace.
+    ``in_specs`` must be a tuple/list matching ``f``'s positional args (all
+    repo call sites comply) so the extra iota specs can be appended.
+    """
+    if axis_names is not None:
+        axis_names = frozenset(axis_names)
+
+    if HAS_NATIVE_SHARD_MAP:
+        use_mesh = mesh
+        extra = {}
+        if axis_names is not None:
+            extra["axis_names"] = axis_names
+        if nested_manual:
+            use_mesh = jax.sharding.get_abstract_mesh()
+        return _native_shard_map(
+            f,
+            mesh=use_mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+            **extra,
+        )
+
+    all_axes = tuple(mesh.axis_names)
+    manual = (
+        frozenset(all_axes) - frozenset(nested_manual)
+        if axis_names is None
+        else axis_names
+    )
+    auto = frozenset(all_axes) - manual - frozenset(nested_manual)
+    degraded = any(int(mesh.shape[a]) > 1 for a in auto)
+    region_degraded = _in_degraded_region()
+
+    if not degraded and not region_degraded and not _force_degraded:
+        return _native_shard_map(
+            f,
+            mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=False,
+            auto=auto,
+        )
+
+    # Degraded: thread per-manual-axis coordinates in as data. (Also taken
+    # when an ENCLOSING compat map is degraded, so nested maps keep the
+    # coordinate frames flowing and their collectives stay on the psum
+    # fallback too.)
+    man_axes = tuple(a for a in all_axes if a in manual)
+    if not isinstance(in_specs, (tuple, list)):
+        raise TypeError(
+            "compat shard_map needs tuple in_specs to append axis-coordinate "
+            f"inputs in the old-jax degraded regime, got {type(in_specs)}"
+        )
+    ext_specs = tuple(in_specs) + tuple(P(a) for a in man_axes)
+
+    def f_ext(*args):
+        coords = args[len(args) - len(man_axes):]
+        inner = args[: len(args) - len(man_axes)]
+        frame = {
+            a: (c[0], int(mesh.shape[a])) for a, c in zip(man_axes, coords)
+        }
+        with _pushed_ctx(frame):
+            return f(*inner)
+
+    mapped = _native_shard_map(
+        f_ext,
+        mesh,
+        in_specs=ext_specs,
+        out_specs=out_specs,
+        check_rep=False,
+        auto=auto,
+    )
+
+    def call(*args):
+        iotas = tuple(
+            jnp.arange(int(mesh.shape[a]), dtype=jnp.int32) for a in man_axes
+        )
+        return mapped(*args, *iotas)
+
+    if region_degraded and diff_argnums is not None:
+        # NESTED map on a differentiated path. jax 0.4.x cannot transpose a
+        # shard_map nested inside another manual region: partial-eval names
+        # the inner map's residual outputs over EVERY mesh axis, and
+        # lowering that spec inside the enclosing manual context trips
+        # "Axis ... is also found in manual_axes". Hide the nesting from
+        # AD entirely: custom_vjp whose backward rebuilds a FRESH
+        # forward-only nested map that recomputes f and pulls the
+        # cotangent through jax.vjp INSIDE the map body (so only safe,
+        # already-degraded collectives appear in the transposed program).
+        if isinstance(out_specs, (tuple, list)) and not isinstance(
+            out_specs, P
+        ):
+            raise NotImplementedError(
+                "diff_argnums recompute-vjp supports single-output maps"
+            )
+        argnums = tuple(diff_argnums)
+
+        @jax.custom_vjp
+        def cv(*args):
+            return call(*args)
+
+        def cv_fwd(*args):
+            return call(*args), args
+
+        def cv_bwd(res, ct):
+            args = res
+
+            def bwd_body(*a):
+                prim, ct_l = a[:-1], a[-1]
+
+                def g(*diff):
+                    full = list(prim)
+                    for i, d in zip(argnums, diff):
+                        full[i] = d
+                    return f(*full)
+
+                _, pull = jax.vjp(g, *[prim[i] for i in argnums])
+                return pull(ct_l)
+
+            bwd_map = shard_map(
+                bwd_body,
+                mesh,
+                in_specs=tuple(in_specs) + (out_specs,),
+                out_specs=tuple(in_specs[i] for i in argnums),
+                check_vma=check_vma,
+                axis_names=axis_names,
+                nested_manual=nested_manual,
+                _force_degraded=True,
+            )
+            gs = bwd_map(*args, ct)
+            out = [None] * len(args)
+            for i, g_ in zip(argnums, gs):
+                out[i] = g_
+            return tuple(out)
+
+        cv.defvjp(cv_fwd, cv_bwd)
+        return cv
+
+    return call
+
+
+# --------------------------------------------------------------------------
+# Collectives (native when safe, psum-based in the degraded regime)
+# --------------------------------------------------------------------------
+
+
+def axis_index(axis_name) -> jnp.ndarray:
+    """``jax.lax.axis_index`` that stays legal in the degraded regime by
+    reading the data-borne coordinate instead of emitting PartitionId."""
+    if _in_degraded_region():
+        idx, _ = _combined_index_size(axis_name)
+        return idx
+    return jax.lax.axis_index(axis_name)
+
+
+def ppermute(x: jnp.ndarray, axis_name, perm: Sequence[tuple]) -> jnp.ndarray:
+    """``jax.lax.ppermute`` with a psum fallback in the degraded regime.
+
+    Fallback: every shard one-hot-scatters its operand into row ``dst`` of
+    an ``[n, ...]`` zeros table (rows of senders with no target stay
+    zero, matching ppermute's zeros-for-unsourced semantics), psums the
+    table over the axis group, and slices its own row.
+    """
+    if not _in_degraded_region():
+        return jax.lax.ppermute(x, axis_name, perm)
+    idx, n = _combined_index_size(axis_name)
+    import numpy as np
+
+    dst_of = np.full((n,), -1, np.int32)
+    for src, dst in perm:
+        dst_of[src] = dst
+    dst = jnp.asarray(dst_of)[idx]
+    table = jnp.zeros((n,) + x.shape, x.dtype)
+    # senders without a target park their row in a scratch slot n
+    table = jnp.concatenate([table, jnp.zeros((1,) + x.shape, x.dtype)])
+    table = jax.lax.dynamic_update_slice(
+        table,
+        x[None].astype(x.dtype),
+        (jnp.where(dst >= 0, dst, n),) + (0,) * x.ndim,
+    )
+    full = jax.lax.psum(table[:n], axis_name)
+    return jax.lax.dynamic_index_in_dim(full, idx, 0, keepdims=False)
+
+
+def all_gather(
+    x: jnp.ndarray, axis_name, *, axis: int = 0, tiled: bool = False
+) -> jnp.ndarray:
+    """``jax.lax.all_gather`` with a psum fallback in the degraded regime."""
+    if not _in_degraded_region():
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    idx, n = _combined_index_size(axis_name)
+    table = jnp.zeros((n,) + x.shape, x.dtype)
+    table = jax.lax.dynamic_update_slice(
+        table, x[None], (idx,) + (0,) * x.ndim
+    )
+    full = jax.lax.psum(table, axis_name)  # [n, ...]
+    if tiled:
+        # concatenate along ``axis``
+        parts = [
+            jax.lax.index_in_dim(full, i, 0, keepdims=False) for i in range(n)
+        ]
+        return jnp.concatenate(parts, axis=axis)
+    return jnp.moveaxis(full, 0, axis)
+
+
+def all_to_all(
+    x: jnp.ndarray,
+    axis_name,
+    split_axis: int,
+    concat_axis: int,
+    *,
+    tiled: bool = False,
+) -> jnp.ndarray:
+    """``jax.lax.all_to_all`` with a psum fallback in the degraded regime."""
+    if not _in_degraded_region():
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis, concat_axis, tiled=tiled
+        )
+    if not tiled:
+        raise NotImplementedError(
+            "degraded all_to_all supports tiled=True only (the repo's "
+            "ulysses path)"
+        )
+    idx, n = _combined_index_size(axis_name)
+    assert x.shape[split_axis] % n == 0, (x.shape, split_axis, n)
+    # pieces[j] = the chunk this shard sends to receiver j
+    pieces = jnp.stack(jnp.split(x, n, axis=split_axis))  # [n, ...chunk]
+    # table[recv, sender] = chunk; each sender fills column ``idx``
+    table = jnp.zeros((n, n) + pieces.shape[1:], x.dtype)
+    table = jax.lax.dynamic_update_slice(
+        table,
+        pieces[:, None],
+        (0, idx) + (0,) * (pieces.ndim - 1),
+    )
+    full = jax.lax.psum(table, axis_name)  # [recv, sender, ...chunk]
+    mine = jax.lax.dynamic_index_in_dim(full, idx, 0, keepdims=False)
+    parts = [
+        jax.lax.index_in_dim(mine, i, 0, keepdims=False) for i in range(n)
+    ]
+    return jnp.concatenate(parts, axis=concat_axis)
+
+
+def scan(
+    body: Callable,
+    init,
+    xs=None,
+    length: int | None = None,
+    *,
+    unroll: bool = False,
+):
+    """``jax.lax.scan`` that stays compilable in the degraded regime.
+
+    On 0.4.x CPU, a scan that STACKS per-step outputs (``ys``) inside a
+    partial-auto manual region trips ``hlo_sharding_util.cc: Check failed:
+    sharding.IsManualSubgroup()`` in the SPMD partitioner (carry-only scans
+    are fine). Worse, DIFFERENTIATING any scan there re-introduces ys
+    stacking internally (partial-eval saves per-iteration residuals as
+    stacked outputs), so grad-carrying scans crash even when carry-only.
+
+    Fallbacks, degraded regime only:
+
+    - ``unroll=False`` (most forward/serving paths): rewrite the scan to
+      accumulate each ``ys`` leaf into a preallocated carry buffer via
+      ``dynamic_update_index_in_dim`` — same memory, same values, still one
+      XLA while loop. Carry-only scans pass through natively.
+    - ``unroll=True``: unroll the loop in Python — no scan primitive exists
+      in the traced program at all. REQUIRED for anything under
+      jax.grad/jax.vjp (AD's residual stacking re-crashes even carry-only
+      scans) and for bodies whose carry scatters trip the partitioner even
+      without ys (the rotated serving conveyors). Compile time grows with
+      the step count, which is bounded in the CPU-rehearsal tier where
+      this regime runs (new jax on TPU takes the native path).
+    """
+    if not _in_degraded_region():
+        return jax.lax.scan(body, init, xs, length)
+
+    if xs is None:
+        n = int(length)
+    else:
+        n = jax.tree_util.tree_leaves(xs)[0].shape[0]
+
+    if unroll:
+        c = init
+        ys = []
+        for i in range(n):
+            x = (
+                jax.tree.map(lambda a: a[i], xs) if xs is not None else None
+            )
+            c, y = body(c, x)
+            ys.append(y)
+        if not ys or not jax.tree_util.tree_leaves(ys[0]):
+            return c, None
+        stacked = jax.tree.map(lambda *vs: jnp.stack(vs), *ys)
+        return c, stacked
+
+    xs_elt = jax.tree.map(lambda a: a[0], xs) if xs is not None else None
+    _, ys_shape = jax.eval_shape(lambda c, x: body(c, x), init, xs_elt)
+    if not jax.tree_util.tree_leaves(ys_shape):
+        return jax.lax.scan(body, init, xs, length)
+
+    bufs0 = jax.tree.map(
+        lambda sd: jnp.zeros((n,) + sd.shape, sd.dtype), ys_shape
+    )
+
+    def body2(carry2, x):
+        i, c, bufs = carry2
+        c2, y = body(c, x)
+        bufs2 = jax.tree.map(
+            lambda b, yv: jax.lax.dynamic_update_index_in_dim(b, yv, i, 0),
+            bufs,
+            y,
+        )
+        return (i + 1, c2, bufs2), None
+
+    (_, c_fin, ys), _ = jax.lax.scan(
+        body2, (jnp.int32(0), init, bufs0), xs, length
+    )
+    return c_fin, ys
+
+
+def top_k(x: jnp.ndarray, k: int):
+    """``jax.lax.top_k`` that stays compilable in the degraded regime.
+
+    The native op's partitioner hits the same manual-subgroup CHECK as the
+    collectives on 0.4.x CPU; the fallback is a stable descending argsort
+    (identical values AND tie-breaking: lowest index first)."""
+    if not _in_degraded_region():
+        return jax.lax.top_k(x, k)
+    idx = jnp.argsort(-x, axis=-1, stable=True)[..., :k]
+    return jnp.take_along_axis(x, idx, axis=-1), idx.astype(jnp.int32)
+
+
+def set_mesh(mesh):
+    """``jax.set_mesh(mesh)`` context manager under either jax generation.
+
+    New jax exposes ``jax.set_mesh`` (sharding-in-types ambient mesh); on
+    0.4.x entering the ``Mesh`` itself provides the ambient-mesh context.
+    """
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def psum(x, axis_name):
+    """``jax.lax.psum`` (safe in every regime; re-exported so manual-region
+    code can import ALL its collectives from one place)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def psum_scatter(x, axis_name, *, scatter_dimension: int = 0, tiled: bool = False):
+    """``jax.lax.psum_scatter`` with a psum+slice fallback in the degraded
+    regime. The native op lowers fine there, but its TRANSPOSE is
+    ``all_gather`` — so any psum_scatter on a differentiated path aborts in
+    backward. ``psum`` transposes to ``psum``, keeping AD inside the safe
+    collective set."""
+    if not _in_degraded_region():
+        return jax.lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+        )
+    if not tiled:
+        raise NotImplementedError(
+            "degraded psum_scatter supports tiled=True only"
+        )
+    idx, n = _combined_index_size(axis_name)
+    full = jax.lax.psum(x, axis_name)
+    blk = x.shape[scatter_dimension] // n
+    start = idx * blk
+    starts = [0] * x.ndim
+    starts[scatter_dimension] = start
+    sizes = list(x.shape)
+    sizes[scatter_dimension] = blk
+    return jax.lax.dynamic_slice(full, tuple(starts), tuple(sizes))

@@ -13,6 +13,9 @@ Rungs, in order:
    interpret mode).
 2. sft_train_tokens_per_sec_per_chip_qwen2_1.5b (PRIMARY) — full 28-layer
    SFT throughput ladder (bf16, remat, packed 1D streams) + analytic MFU.
+1.5. paged_decode_attention — the ragged paged-attention Pallas decode
+   kernel vs the XLA gather path (step latency + e2e tokens/s, greedy
+   output identity asserted in-child).
 3. decode_tokens_per_sec — continuous-batching decode on GenerationEngine.
 4. grpo_step_sec — one full async-RL GRPO step (rollout + train + weight
    push) with the colocated engine; the reference's headline metric is
@@ -477,6 +480,153 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
         eng.stop()
 
 
+def paged_decode_bench(layers: int = 2, vocab: int = 2048, batch: int = 8,
+                       prompt_len: int = 64, new_tokens: int = 32,
+                       n_requests: int = 8, page_size: int = 16,
+                       max_seq_len: int = 256, steps_per_call: int = 8,
+                       kernel_iters: int = 10):
+    """Ragged paged-attention decode: Pallas kernel vs the XLA gather path
+    (ops/pallas/paged_attention.py vs _pool_view + decode_attention_xla).
+
+    Two measurements:
+
+    1. **raw kernel step latency** — one decode-attention step on a
+       pool/table shaped like the serving engine's (qwen2 heads: 12q/2kv,
+       d=128; ragged lengths spanning empty to near-full), pallas vs XLA,
+       jitted, mean over ``kernel_iters``;
+    2. **e2e decode tokens/s** — the same greedy workload through
+       GenerationEngine with ``use_pallas_decode`` on vs off, and the
+       acceptance bar asserted hard in-child: greedy outputs must be
+       TOKEN-IDENTICAL kernel-on vs kernel-off (a speedup measured on
+       diverging outputs would be a KV bug, not a win).
+
+    On CPU the kernel runs in interpret mode — the rehearsal proves
+    mechanics + parity, not speed (interpret unrolls the grid; expect
+    speedup < 1 there; the compiled TPU run is the perf signal)."""
+    import threading
+
+    import jax as _jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.ops.attention import decode_attention_xla
+    from areal_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    interpret = _jax.default_backend() != "tpu"
+
+    # --- raw kernel: one decode step off a churned pool ---
+    nh, kh, d = 12, 2, 128
+    bs = page_size
+    nbt = max_seq_len // page_size
+    nb = batch * nbt + 1
+    rng = np.random.default_rng(0)
+    dt = jnp.float32 if interpret else jnp.bfloat16
+    q = jnp.asarray(rng.normal(size=(batch, 1, nh, d)), dt)
+    kp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+    vp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+    tbl = jnp.asarray(
+        rng.permutation(nb - 1)[: batch * nbt].reshape(batch, nbt) + 1,
+        jnp.int32,
+    )
+    lens = jnp.asarray(
+        rng.integers(1, max_seq_len, size=batch), jnp.int32
+    )
+
+    def xla_step(q, kp, vp, tbl, lens):
+        view_k = kp[tbl].reshape(batch, nbt * bs, kh, d)
+        view_v = vp[tbl].reshape(batch, nbt * bs, kh, d)
+        return decode_attention_xla(q, view_k, view_v, lens)
+
+    def pallas_step(q, kp, vp, tbl, lens):
+        return paged_decode_attention(
+            q, kp, vp, tbl, lens, interpret=interpret
+        )
+
+    def time_step(fn):
+        # compile outside the timed window
+        # arealint: disable-next-line=jit-in-loop,jit-per-call
+        jf = _jax.jit(fn)
+        _jax.block_until_ready(jf(q, kp, vp, tbl, lens))
+        t0 = time.perf_counter()
+        for _ in range(kernel_iters):
+            out = jf(q, kp, vp, tbl, lens)
+        _jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / kernel_iters
+
+    xla_lat = time_step(xla_step)
+    pallas_lat = time_step(pallas_step)
+
+    # --- e2e: the engine knob, greedy identity asserted ---
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
+    prompts = [
+        rng.integers(1, vocab - 2, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=new_tokens, min_new_tokens=new_tokens, greedy=True,
+    )
+
+    def run_mode(use_pallas: bool):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=batch,
+                max_seq_len=max_seq_len,
+                prefill_chunk=64,
+                page_size=page_size,
+                decode_steps_per_call=steps_per_call,
+                # f32 so the identity assert sees no bf16 argmax-tie noise
+                dtype="float32",
+                use_pallas_decode=use_pallas,
+            ),
+            model_config=model_cfg,
+        )
+        eng.start()
+        try:
+            done = threading.Event()
+            results: dict = {}
+            lock = threading.Lock()
+
+            def cb(i, r):
+                with lock:
+                    results[i] = r
+                    if len(results) >= n_requests:
+                        done.set()
+
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                eng.submit(
+                    f"pd{i}", list(p), gconfig,
+                    lambda r, i=i: cb(i, r),
+                )
+            assert done.wait(1200), "paged-decode bench timed out"
+            wall = time.perf_counter() - t0
+            toks = sum(len(r.output_tokens) for r in results.values())
+            outs = [tuple(results[i].output_tokens) for i in range(n_requests)]
+            return toks / wall, outs
+        finally:
+            eng.stop()
+
+    tps_xla, outs_xla = run_mode(False)
+    tps_pallas, outs_pallas = run_mode(True)
+    assert outs_pallas == outs_xla, (
+        "greedy outputs DIVERGED kernel-on vs kernel-off — paged-decode "
+        "kernel is wrong, refusing to report a speedup"
+    )
+    return {
+        "pallas_step_latency_s": round(pallas_lat, 6),
+        "xla_step_latency_s": round(xla_lat, 6),
+        "kernel_step_speedup": round(xla_lat / pallas_lat, 3),
+        "e2e_tokens_per_sec_pallas": round(tps_pallas, 2),
+        "e2e_tokens_per_sec_xla": round(tps_xla, 2),
+        "greedy_outputs_identical": True,
+        "interpret": interpret,
+        "batch": batch,
+        "layers": layers,
+    }
+
+
 def weight_update_bench(layers: int = 28, chunk_mb: int = 512,
                         vocab: int = 151936):
     """Trainer->server weight-resync latency for the bench model (VERDICT
@@ -790,7 +940,8 @@ def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
                        group_size: int = 8, prompt_len: int = 256,
                        new_tokens: int = 32, turns: int = 3,
                        batch: int = 8, steps_per_call: int = 8,
-                       max_seq_len: int = 1024, page_size: int = 64):
+                       max_seq_len: int = 1024, page_size: int = 64,
+                       dtype: str = "bfloat16"):
     """Prefix-cache serving rung: the two workloads the radix cache exists
     for, cache on vs off, same seeds, greedy (so outputs are comparable
     token-for-token).
@@ -818,7 +969,14 @@ def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
     vs ``none`` (the ISSUE acceptance bar), with the vs-prior-default
     reduction reported alongside; greedy output identity is asserted
     across ALL modes. Also reports time-to-first-token and window
-    tokens/s per mode. CPU-runnable (rehearsal ladder)."""
+    tokens/s per mode. CPU-runnable (rehearsal ladder).
+
+    ``dtype`` defaults to bfloat16 for throughput realism, but the rung
+    driver passes float32: the identity gate is a HARD assert, and bf16
+    argmax near-ties (random-init tiny models) can flip between
+    prefill-chunking regimes across cache modes — a false KV-corruption
+    alarm. The headline metric (prefill tokens computed) is an exact count
+    either way."""
     import threading
 
     import numpy as np
@@ -849,7 +1007,7 @@ def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
                 prefill_chunk=128,
                 page_size=page_size,
                 decode_steps_per_call=steps_per_call,
-                dtype="bfloat16",
+                dtype=dtype,
                 enable_prefix_cache=radix,
                 enable_prefix_reuse=slot_reuse,
             ),
@@ -1066,6 +1224,37 @@ def main():
             "detail": kernels,
         })
 
+    # ---- rung 1.5: paged-decode kernel microbench (pallas vs XLA) ----
+    # the serving engine's decode hot path; greedy kernel-on-vs-off output
+    # identity is asserted inside the child (a speedup on diverging tokens
+    # is a KV bug, not a result)
+    if remaining(deadline) > 420:
+        try:
+            log("paged-decode kernel rung")
+            pd_att = (
+                dict(layers=2, vocab=2048, batch=8, prompt_len=64,
+                     new_tokens=32, n_requests=8, page_size=16,
+                     max_seq_len=256, kernel_iters=5)
+                if REHEARSAL
+                else dict(layers=28, vocab=151936, batch=48, prompt_len=128,
+                          new_tokens=128, n_requests=48, page_size=64,
+                          max_seq_len=512, kernel_iters=50)
+            )
+            pd = _run_child(
+                "pgdec", pd_att,
+                timeout=min(900.0, remaining(deadline) - 120),
+            )
+            emit({
+                "metric": "paged_decode_attention",
+                "value": pd["kernel_step_speedup"],
+                "unit": "x_pallas_vs_xla_step_latency",
+                "vs_baseline": None,
+                "chip": chip,
+                **pd,
+            })
+        except Exception as e:  # noqa: BLE001
+            log(f"paged-decode rung failed: {e}")
+
     # ---- rung 2 (PRIMARY): SFT train throughput ladder ----
     # full model first (adam OOMs a 16GB chip at 1.5B even with bf16
     # moments -> adafactor); depth reduction is the last resort
@@ -1272,15 +1461,21 @@ def main():
     # the prefill-token reduction factor on the GRPO workload; greedy
     # output identity is asserted inside the child. ----
     if remaining(deadline) > 420:
+        # f32: the rung's headline is prefill-token COUNTS (dtype-exact) and
+        # its correctness gate is a hard greedy-identity assert — in bf16 a
+        # random-init argmax near-tie can flip between prefill-chunking
+        # regimes and masquerade as KV corruption (observed when PR 7's
+        # threefry alignment reshuffled init values)
         patt = dict(
             layers=(used or {"layers": 2 if REHEARSAL else 28})["layers"],
             group_size=8, prompt_len=512, new_tokens=64, turns=3, batch=8,
+            dtype="float32",
         )
         if REHEARSAL:
             patt = dict(
                 layers=2, vocab=2048, group_size=8, prompt_len=256,
                 new_tokens=16, turns=3, batch=8, steps_per_call=4,
-                max_seq_len=1024, page_size=64,
+                max_seq_len=1024, page_size=64, dtype="float32",
             )
         try:
             log(f"prefix cache rung: {patt}")
@@ -1402,6 +1597,8 @@ def _child_main():
         print(json.dumps({"tps": tps, "mfu": mfu_v}))
     elif kind == "--decode-child":
         print(json.dumps(decode_bench(**att)))
+    elif kind == "--pgdec-child":
+        print(json.dumps(paged_decode_bench(**att)))
     elif kind == "--pcache-child":
         print(json.dumps(prefix_cache_bench(**att)))
     elif kind == "--wu-child":

@@ -1,16 +1,25 @@
 # arealint fixture: jax-compat TRUE NEGATIVES (no findings expected).
 import jax
-import jax.experimental.pallas.tpu as pltpu
-from jax.experimental.shard_map import shard_map
+from areal_tpu.utils import jax_compat
+from areal_tpu.utils.jax_compat import pallas_compiler_params, shard_map
 
 
 def current_apis(f, mesh, x, tree):
-    y = shard_map(f, mesh=mesh)(x)
-    params = pltpu.TPUCompilerParams(dimension_semantics=())
+    y = shard_map(f, mesh=mesh, in_specs=(), out_specs=())(x)
+    y2 = jax_compat.shard_map(f, mesh=mesh, in_specs=(), out_specs=())(x)
+    params = pallas_compiler_params(dimension_semantics=())
     z = jax.tree.map(lambda a: a + 1, tree)
-    return y, params, z
+    with jax_compat.set_mesh(mesh):
+        pass
+    return y, y2, params, z
 
 
 def local_name_is_not_the_module(tree_map, x):
     # a local called tree_map is not jax.tree_map
     return tree_map(x)
+
+
+def collectives_via_shim(x, perm):
+    a = jax_compat.ppermute(x, "pp", perm)
+    b = jax_compat.axis_index("pp")
+    return a, b

@@ -1,6 +1,7 @@
 # arealint fixture: jax-compat TRUE POSITIVES.
 import jax
 import jax.experimental.pallas.tpu as pltpu
+from jax.experimental.shard_map import shard_map  # lint-expect: jax-compat
 
 
 def removed_apis(f, mesh, x, tree):
@@ -8,3 +9,14 @@ def removed_apis(f, mesh, x, tree):
     params = pltpu.CompilerParams(dimension_semantics=())  # lint-expect: jax-compat
     z = jax.tree_map(lambda a: a + 1, tree)  # lint-expect: jax-compat
     return y, params, z
+
+
+def version_forked_old_spellings(f, mesh, x):
+    # the OLD spellings are findings too: either one pins the file to a
+    # single jax generation — the shim is the only legal prober
+    y = shard_map(f, mesh=mesh)(x)  # lint-expect: jax-compat
+    params = pltpu.TPUCompilerParams(dimension_semantics=())  # lint-expect: jax-compat
+    with jax.set_mesh(mesh):  # lint-expect: jax-compat
+        pass
+    am = jax.sharding.get_abstract_mesh()  # lint-expect: jax-compat
+    return y, params, am

@@ -123,17 +123,16 @@ def test_repo_is_lint_clean_against_baseline():
     )
 
 
-def test_baseline_contains_only_jax_compat():
-    """Only the documented seed breakage class (removed JAX APIs) may be
-    baselined; every other rule's findings must be fixed or suppressed
-    inline with justification."""
+def test_baseline_is_empty_and_stays_empty():
+    """The jax-compat seed debt is PAID (everything routes through
+    areal_tpu/utils/jax_compat.py): the baseline holds zero entries, and
+    this test pins it there — re-growing the baseline instead of fixing a
+    finding fails tier-1."""
     entries = framework.load_baseline(BASELINE)
-    assert entries, "baseline unexpectedly empty"
-    assert {e["rule"] for e in entries} == {"jax-compat"}
-    # the two known seed-breakage symbols are what is being accepted
-    msgs = "\n".join(e["message"] for e in entries)
-    assert "jax.shard_map" in msgs
-    assert "CompilerParams" in msgs
+    assert entries == [], (
+        "the arealint baseline must stay EMPTY; fix or suppress findings "
+        f"instead of baselining them: {entries}"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -224,7 +223,7 @@ def test_cli_json_format():
     )
     assert proc.returncode == 1  # fixture has errors, no baseline given
     payload = json.loads(proc.stdout)
-    assert payload["summary"]["errors"] == 3
+    assert payload["summary"]["errors"] == 8
     assert {f["rule"] for f in payload["findings"]} == {"jax-compat"}
 
 

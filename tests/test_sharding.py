@@ -10,6 +10,7 @@ from areal_tpu.api.alloc_mode import ParallelStrategy
 from areal_tpu.models import lm
 from areal_tpu.models.config import tiny_config
 from areal_tpu.parallel.mesh import make_mesh
+from areal_tpu.utils import jax_compat
 from areal_tpu.parallel.sharding import param_shardings
 from areal_tpu.utils.data import (
     positions_from_cu_seqlens,
@@ -69,7 +70,7 @@ def test_sharded_forward_matches_single_device(cpu_devices):
     def fwd(p, ids, pos, seg):
         return lm.forward_packed(p, cfg, ids, pos, seg)
 
-    with jax.set_mesh(mesh):
+    with jax_compat.set_mesh(mesh):
         out = np.asarray(fwd(sharded_params, jnp.asarray(flat), jnp.asarray(pos), jnp.asarray(seg)))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
