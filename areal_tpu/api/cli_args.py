@@ -520,6 +520,45 @@ class PPOCriticConfig(TrainEngineConfig):
 
 
 @dataclass
+class TracingConfig:
+    """Distributed rollout tracing (utils/tracing.py): per-request
+    trace/span ids minted in the WorkflowExecutor, propagated via the
+    ``x-areal-trace`` HTTP header into the inference server and engine,
+    with spans/events for queue wait, prefix-cache hits, chunked-prefill
+    and decode dispatches, spec-decode accept runs, failover re-dispatch,
+    and weight commits landing mid-generation. Disabled by default; when
+    off every hot-path call site pays only an ``is not None`` check
+    (pinned by a code-inspection test, like the chaos hook)."""
+
+    enabled: bool = False
+    # component name stamped on spans (client plane vs each server)
+    service: str = "areal"
+    # bounded buffer of finished spans (ring; oldest evicted)
+    max_spans: int = 4096
+    # per-span event cap (drops counted, never unbounded)
+    max_events_per_span: int = 256
+    # append finished spans as JSON lines here ("" = buffer only; export
+    # on demand via Tracer.export_jsonl / the Perfetto converter)
+    export_path: str = ""
+
+
+@dataclass
+class MetricsConfig:
+    """Unified metrics registry (utils/metrics.py): counters / gauges /
+    histograms with labels, scrapeable as Prometheus text on the
+    inference server's ``/metrics`` and exported periodically through
+    the StatsLogger on the trainer side."""
+
+    enabled: bool = True
+    # merge registry scalars into every StatsLogger commit row under
+    # this key prefix ("" disables the periodic trainer-side export)
+    stats_logger_prefix: str = "metrics/"
+    # distinct label-sets per metric before new series coalesce into
+    # "__overflow__" (the cardinality guard against raw-rid labels)
+    max_label_values: int = 128
+
+
+@dataclass
 class JaxGenConfig:
     """Inference-server engine knobs (replaces SGLangConfig/vLLMConfig,
     reference cli_args.py:533,620 — ours is the in-repo JAX server)."""
@@ -655,6 +694,11 @@ class JaxGenConfig:
     # reloads compiled executables from here instead of paying full XLA
     # recompile (utils/jax_cache.configure_compilation_cache). None = off.
     jax_compilation_cache_dir: str | None = None
+    # server-side rollout tracing: request spans continue the client's
+    # x-areal-trace context with engine-internal events (admission wait,
+    # radix hit length, prefill chunks, decode segments, spec accepts,
+    # weight commits landing mid-generation). Off = zero request-path cost.
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
 
 @dataclass
@@ -770,6 +814,9 @@ class InferenceEngineConfig:
     weight_update_pipeline_depth: int = 2
     # client-side deterministic fault injection (tests/rehearsals)
     chaos: ChaosConfig | None = None
+    # distributed rollout tracing (client plane: rollout + generate spans,
+    # header propagation to the servers); off = zero hot-path cost
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
 
 @dataclass
@@ -866,6 +913,9 @@ class StatsLoggerConfig:
     fileroot: str = "/tmp/areal_tpu/experiments"
     wandb: WandBConfig = field(default_factory=WandBConfig)
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+    # trainer-side periodic export of the unified metrics registry
+    # (utils/metrics.py): registry scalars are merged into every commit row
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
 
 
 @dataclass

@@ -115,9 +115,25 @@ class ServerHealthTracker:
     def _open(self, h: _ServerHealth, addr: str, reason: str) -> None:
         if h.state != OPEN:
             logger.warning("breaker OPEN for %s: %s", addr, reason)
+            self._record_transition(addr, h.state, OPEN, reason)
         h.state = OPEN
         h.opened_at = self.clock()
         h.half_open_inflight = 0
+
+    @staticmethod
+    def _record_transition(
+        addr: str, old: str, new: str, reason: str = ""
+    ) -> None:
+        """Breaker transitions feed the crash flight recorder: when a
+        watchdog/SIGTERM postmortem lands, the recent breaker history is
+        usually the first question ("was the fleet dying before the
+        wedge?")."""
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record(
+            "breaker", "transition", addr=addr, old=old, new=new,
+            reason=reason[:200],
+        )
 
     # ---------------------------------------------------------- request path
 
@@ -160,6 +176,9 @@ class ServerHealthTracker:
                 if h.state == HALF_OPEN:
                     h.state = CLOSED
                     logger.info("breaker CLOSED for %s (trial succeeded)", addr)
+                    self._record_transition(
+                        addr, HALF_OPEN, CLOSED, "trial succeeded"
+                    )
             else:
                 h.failures += 1
                 h.consecutive_failures += 1
@@ -262,6 +281,7 @@ class ServerHealthTracker:
             h.consecutive_failures = 0
             h.required_version = None
             logger.info("breaker HALF_OPEN for %s (probe succeeded)", addr)
+            self._record_transition(addr, OPEN, HALF_OPEN, "probe succeeded")
 
     # ----------------------------------------------------------- quarantine
 
@@ -300,14 +320,33 @@ class ServerHealthTracker:
             h = self._servers.get(addr)
             return h.state if h is not None else CLOSED
 
+    @staticmethod
+    def _percentile(sorted_vals: list[float], q: float) -> float:
+        """Nearest-rank-with-interpolation percentile of an already
+        sorted latency window (small N, exact — no bucket estimate)."""
+        if not sorted_vals:
+            return 0.0
+        if len(sorted_vals) == 1:
+            return sorted_vals[0]
+        pos = q * (len(sorted_vals) - 1)
+        lo = int(pos)
+        frac = pos - lo
+        hi = min(lo + 1, len(sorted_vals) - 1)
+        return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * frac
+
     def snapshot(self) -> dict[str, dict]:
-        """Per-address stats for logging/telemetry."""
+        """Per-address stats for logging/telemetry, including the
+        latency/throughput percentiles that previously fed routing only:
+        p50/p95 over the success latencies in the sliding window, and the
+        window's request rate (requests over ``window_seconds``)."""
         out = {}
         with self._lock:
+            now = self.clock()
             for addr, h in self._servers.items():
+                self._trim(h, now)
                 n = len(h.window)
                 fails = sum(1 for (_, ok, _) in h.window if not ok)
-                lats = [lat for (_, ok, lat) in h.window if ok]
+                lats = sorted(lat for (_, ok, lat) in h.window if ok)
                 out[addr] = {
                     "state": h.state,
                     "successes": h.successes,
@@ -317,7 +356,70 @@ class ServerHealthTracker:
                     "window_mean_latency": (
                         sum(lats) / len(lats) if lats else 0.0
                     ),
+                    "window_latency_p50": self._percentile(lats, 0.50),
+                    "window_latency_p95": self._percentile(lats, 0.95),
+                    "window_requests_per_sec": (
+                        n / self.config.window_seconds
+                        if self.config.window_seconds > 0
+                        else 0.0
+                    ),
                     "required_version": h.required_version,
                     "last_error": h.last_error,
                 }
         return out
+
+    def fleet_summary(self) -> str:
+        """One line of per-server health for the weight-commit log: state,
+        window p50/p95 latency, failure rate, and request rate — the
+        operator's at-a-glance answer to "which server is dragging"."""
+        snap = self.snapshot()
+        if not snap:
+            return "fleet: (no request history)"
+        parts = []
+        for addr in sorted(snap):
+            s = snap[addr]
+            parts.append(
+                f"{addr}[{s['state']} p50={s['window_latency_p50'] * 1e3:.0f}ms "
+                f"p95={s['window_latency_p95'] * 1e3:.0f}ms "
+                f"fail={s['window_failure_rate']:.0%} "
+                f"rps={s['window_requests_per_sec']:.2f}]"
+            )
+        return "fleet: " + " ".join(parts)
+
+    def export_metrics(self, registry=None) -> None:
+        """Copy the per-address window stats onto the unified metrics
+        registry (gauges labelled by server address and quantile). Wired
+        as a registry collector by RemoteInfEngine, so a scrape/export
+        always reads the live window."""
+        from areal_tpu.utils import metrics as _metrics
+
+        registry = registry or _metrics.DEFAULT_REGISTRY
+        lat = registry.gauge(
+            "areal_server_latency_seconds",
+            "per-server request latency over the health window",
+            labels=("addr", "quantile"),
+        )
+        fr = registry.gauge(
+            "areal_server_failure_rate",
+            "per-server windowed failure rate",
+            labels=("addr",),
+        )
+        rps = registry.gauge(
+            "areal_server_requests_per_sec",
+            "per-server windowed request throughput",
+            labels=("addr",),
+        )
+        state_g = registry.gauge(
+            "areal_server_breaker_open",
+            "1 when the server's circuit breaker is OPEN",
+            labels=("addr",),
+        )
+        for addr, s in self.snapshot().items():
+            lat.labels(addr=addr, quantile="p50").set(s["window_latency_p50"])
+            lat.labels(addr=addr, quantile="p95").set(s["window_latency_p95"])
+            lat.labels(addr=addr, quantile="mean").set(
+                s["window_mean_latency"]
+            )
+            fr.labels(addr=addr).set(s["window_failure_rate"])
+            rps.labels(addr=addr).set(s["window_requests_per_sec"])
+            state_g.labels(addr=addr).set(1.0 if s["state"] == OPEN else 0.0)

@@ -40,7 +40,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.core.fault_tolerance import OPEN, ServerHealthTracker
 from areal_tpu.core.workflow_executor import WorkflowExecutor
-from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils import logging, name_resolve, names, tracing
 from areal_tpu.utils.chaos import ChaosPolicy, crash_point
 from areal_tpu.utils.http import (
     TRANSPORT_ERRORS,
@@ -78,7 +78,14 @@ class RemoteInfEngine(InferenceEngine):
         self._version = 0
         self._paused = threading.Event()
         self._spectator = False  # set by initialize() under multi-host
-        self.executor = WorkflowExecutor(config, self)
+        # distributed tracing: ONE tracer for the whole client plane (the
+        # executor mints rollout spans on it; agenerate hangs generate
+        # spans off them and propagates the x-areal-trace header). None
+        # when disabled — hot paths pay only `is not None` checks.
+        self._tracer = tracing.Tracer.from_config(
+            getattr(config, "tracing", None)
+        )
+        self.executor = WorkflowExecutor(config, self, tracer=self._tracer)
         # one ClientSession per event loop (the rollout thread's loop is the
         # long-lived one; keepalive pooling matters there)
         self._sessions: dict[int, tuple[asyncio.AbstractEventLoop, aiohttp.ClientSession]] = {}
@@ -140,6 +147,14 @@ class RemoteInfEngine(InferenceEngine):
             # executor per rank) must not shrink its staleness capacity
             train_data_parallel_size = 1
         self.executor.initialize(train_data_parallel_size)
+        # unified metrics: the per-server health windows (latency p50/p95,
+        # failure rate, breaker state) become scrapeable gauges via a
+        # collector — they previously fed routing only
+        from areal_tpu.utils import metrics as _metrics
+
+        self._health_collector = _metrics.DEFAULT_REGISTRY.register_collector(
+            lambda reg: self._health.export_metrics(reg)
+        )
 
     def _discover_servers(self) -> list[str]:
         key = names.gen_servers(self.config.experiment_name, self.config.trial_name)
@@ -200,6 +215,13 @@ class RemoteInfEngine(InferenceEngine):
             logger.info("server refresh: %d new server(s) joined: %s", len(new), new)
 
     def destroy(self):
+        if getattr(self, "_health_collector", None) is not None:
+            from areal_tpu.utils import metrics as _metrics
+
+            _metrics.DEFAULT_REGISTRY.unregister_collector(
+                self._health_collector
+            )
+            self._health_collector = None
         for loop, task in list(self._probe_tasks.values()):
             if loop.is_running():
                 loop.call_soon_threadsafe(task.cancel)
@@ -213,6 +235,8 @@ class RemoteInfEngine(InferenceEngine):
         self._sessions.clear()
         self._close_push_loop()
         self.executor.destroy()
+        if self._tracer is not None:
+            self._tracer.close()
 
     # ------------------------------------------------------------------
     # server selection
@@ -396,7 +420,35 @@ class RemoteInfEngine(InferenceEngine):
         always sends ``prompt + accumulated``, which is exactly the resume
         splice the abort loop already uses). Bounded by
         ``failover_retries`` and an optional overall
-        ``failover_deadline_seconds``."""
+        ``failover_deadline_seconds``.
+
+        With tracing on, the call runs under a ``generate`` span (child of
+        the executor's ``rollout`` span when one is current); each HTTP
+        dispatch — including failover re-dispatches — carries the
+        ``x-areal-trace`` header, so the server spans on BOTH the failed
+        and the failover server link into the same trace."""
+        if self._tracer is None:
+            return await self._agenerate_impl(req, None)
+        span = self._tracer.span(
+            "generate", parent=tracing.current_span(), rid=req.rid
+        )
+        try:
+            resp = await self._agenerate_impl(req, span)
+            span.set(
+                stop_reason=resp.stop_reason,
+                output_tokens=len(resp.output_tokens),
+                ttft=resp.ttft,
+            )
+            return resp
+        except BaseException as e:
+            span.set(error=repr(e)[:200])
+            raise
+        finally:
+            span.end()
+
+    async def _agenerate_impl(
+        self, req: ModelRequest, span
+    ) -> ModelResponse:
         self._ensure_probe_task()
         gconfig = req.gconfig
         if gconfig.n_samples != 1:
@@ -455,6 +507,16 @@ class RemoteInfEngine(InferenceEngine):
                 },
             }
             cur_addr = addr
+            headers = None
+            if span is not None:
+                # one dispatch event per HTTP request of this generate
+                # call (the abort-resume loop and failover re-dispatches
+                # each get their own), carrying the server address so the
+                # trace shows which server served which segment
+                span.event(
+                    "dispatch", addr=cur_addr, replay=len(accumulated)
+                )
+                headers = {tracing.TRACE_HEADER: span.header()}
             self._health.on_request_start(cur_addr)
             with self._inflight_lock:
                 self._inflight[cur_addr] = self._inflight.get(cur_addr, 0) + 1
@@ -473,6 +535,7 @@ class RemoteInfEngine(InferenceEngine):
                         else None
                     ),
                     chaos=self._chaos,
+                    headers=headers,
                 )
                 self._health.on_request_end(
                     cur_addr, ok=True, latency=time.monotonic() - t_req
@@ -512,6 +575,23 @@ class RemoteInfEngine(InferenceEngine):
                     e,
                     len(accumulated),
                     failover_left,
+                )
+                if span is not None:
+                    span.event(
+                        "failover",
+                        failed_addr=cur_addr,
+                        error=str(e)[:200],
+                        replay=len(accumulated),
+                    )
+                from areal_tpu.utils import flight_recorder
+
+                flight_recorder.record(
+                    "requests",
+                    "failover",
+                    rid=req.rid,
+                    failed_addr=cur_addr,
+                    error=str(e)[:200],
+                    replay=len(accumulated),
                 )
                 self._drop_rid_affinity(req.rid)
                 failed_addrs.add(cur_addr)
@@ -1005,6 +1085,7 @@ class RemoteInfEngine(InferenceEngine):
             len(self.addresses),
             (load_ts - save_ts) / 1e9,
         )
+        self._note_weight_commit("disk", next_version)
         self.set_version(next_version)
 
     # arealint: hot-path
@@ -1107,6 +1188,7 @@ class RemoteInfEngine(InferenceEngine):
             len(self.addresses),
             latency,
         )
+        self._note_weight_commit("tensor", next_version)
         self.set_version(next_version)
         return latency
 
@@ -1278,6 +1360,7 @@ class RemoteInfEngine(InferenceEngine):
             len(self.addresses),
             latency,
         )
+        self._note_weight_commit("device", next_version)
         self.set_version(next_version)
         return latency
 
@@ -1377,6 +1460,7 @@ class RemoteInfEngine(InferenceEngine):
             next_version, n_chunks, len(targets) - len(failed),
             len(self.addresses), latency,
         )
+        self._note_weight_commit("shm", next_version)
         self.set_version(next_version)
         return latency
 
@@ -1423,8 +1507,21 @@ class RemoteInfEngine(InferenceEngine):
             "lora adapter update v%d (%.1f MB) -> %d servers in %.2fs",
             next_version, len(blob) / 1e6, len(self.addresses), latency,
         )
+        self._note_weight_commit("lora", next_version)
         self.set_version(next_version)
         return latency
+
+    def _note_weight_commit(self, kind: str, version: int) -> None:
+        """Per-commit observability shared by every update path: a
+        one-line fleet health summary (the per-server latency windows
+        previously fed routing only) and a flight-recorder commit event
+        so a later postmortem can line crashes up against syncs."""
+        logger.info("weight commit v%d: %s", version, self._health.fleet_summary())
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record(
+            "commits", kind, version=version, n_servers=len(self.addresses)
+        )
 
     def _degraded_mode_or_raise(
         self,

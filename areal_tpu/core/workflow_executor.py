@@ -19,6 +19,7 @@ Behavior parity with the reference's ``areal/core/workflow_executor.py:225``:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import queue
 import random
 import threading
@@ -31,11 +32,14 @@ from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.api.io_struct import TimedResult
 from areal_tpu.api.workflow_api import RolloutWorkflow
 from areal_tpu.core.staleness_manager import StalenessManager
-from areal_tpu.utils import logging
+from areal_tpu.utils import logging, tracing
 from areal_tpu.utils.chaos import crash_point
 from areal_tpu.utils.data import concat_padded_tensors, cycle_dataloader
 
 logger = logging.getLogger("WorkflowExecutor")
+
+# distinguishes co-resident executors' areal_rollouts series (per-process ids)
+_EXECUTOR_METRICS_IDS = itertools.count()
 
 POLL_WAIT_TIME = 0.05
 POLL_SLEEP_TIME = 0.02
@@ -99,9 +103,22 @@ class WorkflowExecutor:
         config: InferenceEngineConfig,
         inference_engine,
         staleness_manager: StalenessManager | None = None,
+        tracer: tracing.Tracer | None = None,
     ):
         self.config = config
         self.inference_engine = inference_engine
+        # distributed rollout tracing: mint one trace per episode here (the
+        # rollout's birthplace) so the workflow's generate calls — and the
+        # server spans they fan into — all connect. None when disabled: the
+        # submit/collect hot path pays only `is not None` checks.
+        self._tracer = (
+            tracer
+            if tracer is not None
+            else tracing.Tracer.from_config(getattr(config, "tracing", None))
+        )
+        # a passed-in tracer is closed by its owner (RemoteInfEngine); one
+        # we created here is ours to close in destroy()
+        self._owns_tracer = tracer is None
         self.max_concurrent_rollouts = (
             config.max_concurrent_rollouts or config.consumer_batch_size
         )
@@ -138,11 +155,44 @@ class WorkflowExecutor:
             )
         self.rollout_thread = threading.Thread(target=self._thread_main, daemon=True)
         self.rollout_thread.start()
+        # unified metrics: the staleness counters become scrapeable gauges
+        # via a collector (invoked at export time only — zero steady cost)
+        from areal_tpu.utils import metrics as _metrics
+
+        sm = self.staleness_manager
+        g = _metrics.DEFAULT_REGISTRY.gauge(
+            "areal_rollouts",
+            "rollout episode counters by state (StalenessManager)",
+            labels=("state", "instance"),
+        )
+        # co-resident executors (e.g. rollout + eval in one trainer process)
+        # each get their own series instead of overwriting one child set
+        inst = str(next(_EXECUTOR_METRICS_IDS))
+
+        def _collect(_reg, _sm=sm, _g=g, _inst=inst):
+            s = _sm.get_stats()
+            _g.labels(state="submitted", instance=_inst).set(s.submitted)
+            _g.labels(state="accepted", instance=_inst).set(s.accepted)
+            _g.labels(state="rejected", instance=_inst).set(s.rejected)
+            _g.labels(state="running", instance=_inst).set(s.running)
+
+        self._metrics_collector = _metrics.DEFAULT_REGISTRY.register_collector(
+            _collect
+        )
 
     def destroy(self):
         self.exiting.set()
+        if getattr(self, "_metrics_collector", None) is not None:
+            from areal_tpu.utils import metrics as _metrics
+
+            _metrics.DEFAULT_REGISTRY.unregister_collector(
+                self._metrics_collector
+            )
+            self._metrics_collector = None
         if self.rollout_thread is not None:
             self.rollout_thread.join(timeout=10)
+        if self._owns_tracer and self._tracer is not None:
+            self._tracer.close()
 
     def _check_health(self):
         with self._exc_lock:
@@ -178,10 +228,13 @@ class WorkflowExecutor:
                     and self.input_queue.qsize() > 0
                 ):
                     x: _TaskInput = self.input_queue.get_nowait()
-                    task = asyncio.create_task(
-                        x.workflow.arun_episode(self.inference_engine, x.data),
-                        name=str(next_rid),
-                    )
+                    if self._tracer is not None:
+                        coro = self._traced_episode(next_rid, x)
+                    else:
+                        coro = x.workflow.arun_episode(
+                            self.inference_engine, x.data
+                        )
+                    task = asyncio.create_task(coro, name=str(next_rid))
                     live[next_rid] = (time.monotonic_ns(), task, x)
                     self.staleness_manager.on_rollout_submitted()
                     if self.config.enable_rollout_tracing:
@@ -271,6 +324,23 @@ class WorkflowExecutor:
                 for t in asyncio.all_tasks()
                 if t is not cur and not t.done() and t not in _BACKGROUND_TASKS
             )
+
+    async def _traced_episode(self, rid: int, x: _TaskInput):
+        """Run one episode under a fresh ``rollout`` trace. The span is
+        installed as the task-local current span, so every ``agenerate``
+        the workflow makes (directly or through nested tool calls)
+        becomes a child — the cross-process trace's root."""
+        span = self._tracer.span(
+            "rollout", rid=str(rid), version=self.inference_engine.get_version()
+        )
+        token = tracing.set_current_span(span)
+        try:
+            with span:
+                return await x.workflow.arun_episode(
+                    self.inference_engine, x.data
+                )
+        finally:
+            tracing.reset_current_span(token)
 
     # --------------------------------------------------------------- client
 

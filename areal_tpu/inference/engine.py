@@ -85,6 +85,10 @@ class _Seq:
     itl: list[float] = dataclasses.field(default_factory=list)
     aborted: bool = False
     priority: int = 0  # admission priority (higher admits first)
+    # distributed-tracing span (utils/tracing.Span) owned by the server
+    # handler; None when tracing is off — every engine-side use guards
+    # with `is not None` (zero allocation off, code-inspection-pinned)
+    span: object | None = None
     images: list | None = None  # decoded [S, S, 3] float arrays, or for
     # qwen2_vl: HF-processor patch arrays [P_i, C*tps*ps*ps]
     grids: list | None = None  # qwen2_vl (t, h, w) per image
@@ -473,6 +477,30 @@ class GenerationEngine:
         self.weight_sync_aborted_updates_total = 0
         self._lock = threading.Lock()
         self._dead: Exception | None = None
+        # distributed tracing (utils/tracing.py): request spans arrive
+        # from the server as _Seq.span; engine internals stamp events
+        # (admission wait, radix hit, prefill chunks, decode segments,
+        # spec accepts, weight commits) onto them. None when disabled —
+        # every hot-path site guards with `is not None` (pinned by a
+        # code-inspection test), so tracing off allocates nothing.
+        from areal_tpu.utils.tracing import Tracer
+
+        self._tracer = Tracer.from_config(
+            getattr(self.config, "tracing", None)
+        )
+        # unified metrics: TTFT + inter-token latency histograms (observed
+        # once per request at finish — off the per-token path) and a
+        # collector mirroring /model_info counters into gauges at scrape
+        # time (so /metrics and /model_info agree by construction)
+        from areal_tpu.utils import metrics as _metrics
+
+        self._ttft_hist = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_ttft_seconds", "time to first token per request"
+        )
+        self._itl_hist = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_inter_token_seconds", "inter-token latency"
+        )
+        self._metrics_collector = None
 
         # one body; pixels=None (text) vs array (VLM) retraces by pytree
         # structure, so both paths share the cache-write/sampling code
@@ -814,6 +842,15 @@ class GenerationEngine:
                     self.version, freed, self.prefix_cache.n_cached_blocks,
                 )
 
+    def _stamp_active_spans(self, event: str, **attrs) -> None:
+        """Append a trace event to every in-flight request's span (engine
+        thread only). A weight commit that lands mid-generation is the
+        canonical case: per-token versions already record the crossing,
+        this makes it visible on the rollout's timeline too."""
+        for s in self.slots:
+            if s is not None and s.span is not None:
+                s.span.event(event, **attrs)
+
     @property
     def eos_token_id(self) -> int | None:
         if self.tokenizer is not None:
@@ -831,13 +868,30 @@ class GenerationEngine:
             target=self._loop, name="generation-engine", daemon=True
         )
         self._thread.start()
+        if self._metrics_collector is None:
+            from areal_tpu.utils import metrics as _metrics
+
+            self._metrics_collector = (
+                _metrics.DEFAULT_REGISTRY.register_collector(
+                    self._collect_metrics
+                )
+            )
 
     def stop(self):
         self._shutdown.set()
         self._wake.set()
+        if self._metrics_collector is not None:
+            from areal_tpu.utils import metrics as _metrics
+
+            _metrics.DEFAULT_REGISTRY.unregister_collector(
+                self._metrics_collector
+            )
+            self._metrics_collector = None
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
+        if self._tracer is not None:
+            self._tracer.close()
 
     def submit(
         self,
@@ -847,10 +901,12 @@ class GenerationEngine:
         on_done: Callable[[ModelResponse], None],
         image_data: list | None = None,
         priority: int = 0,
+        span=None,
     ):
         """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
         thread when it finishes (stop/length/abort). ``priority`` orders
-        admission (higher first; FIFO within a class)."""
+        admission (higher first; FIFO within a class). ``span`` (tracing
+        on only) receives engine-internal events for this request."""
         if self._dead is not None:
             raise RuntimeError("generation engine loop died") from self._dead
         if len(input_ids) >= self.config.max_seq_len:
@@ -868,6 +924,18 @@ class GenerationEngine:
                 "refusing rid=%s: prompt of %d tokens exceeds the admission "
                 "token budget %d (knob: JaxGenConfig.admission_token_budget)",
                 rid, len(input_ids), self.scheduler.token_budget,
+            )
+            # admission decisions feed the flight recorder: a refusal
+            # storm right before a wedge/crash is exactly the kind of
+            # context the postmortem dump exists to capture
+            from areal_tpu.utils import flight_recorder
+
+            flight_recorder.record(
+                "admission",
+                "refused",
+                rid=rid,
+                prompt_tokens=len(input_ids),
+                budget=self.scheduler.token_budget,
             )
             on_done(
                 ModelResponse(input_tokens=list(input_ids), stop_reason="length")
@@ -931,7 +999,7 @@ class GenerationEngine:
                 )
         seq = _Seq(
             rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done,
-            images=images, grids=grids, priority=priority,
+            images=images, grids=grids, priority=priority, span=span,
         )
         self.scheduler.submit(seq, priority=priority)
         self._wake.set()
@@ -1212,6 +1280,64 @@ class GenerationEngine:
         }
         stats_tracker.DEFAULT_TRACKER.scalar(**stats)
 
+    def metrics_snapshot(self, serving_stats: dict | None = None) -> dict:
+        """Every numeric counter ``/model_info`` serves, flat — the ONE
+        source both the JSON endpoint and the Prometheus collector read,
+        so a ``/metrics`` scrape always agrees with ``/model_info``.
+
+        ``serving_stats`` lets a caller that also needs the native-typed
+        dict (``/model_info``) supply one read instead of taking the
+        scheduler lock twice at two different instants."""
+        out = {
+            "weight_version": self.get_version(),
+            "n_running": self.n_running,
+            "prompt_tokens_total": self.prompt_tokens_total,
+            "generated_tokens_total": self.generated_tokens_total,
+            "prefill_count": self.prefill_count,
+            "prefill_dispatch_count": self.prefill_dispatch_count,
+            "prefix_clone_count": self.prefix_clone_count,
+            "prefix_extend_count": self.prefix_extend_count,
+            "prefix_extend_saved_tokens": self.prefix_extend_saved_tokens,
+            "spec_steps_total": self.spec_steps_total,
+            "spec_proposed_tokens_total": self.spec_proposed_tokens_total,
+            "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
+            "spec_acceptance_rate": self.spec_acceptance_rate,
+            "weight_sync_stall_seconds": self.weight_sync_stall_seconds_last,
+            "weight_sync_stall_seconds_total": (
+                self.weight_sync_stall_seconds_total
+            ),
+            "weight_sync_commits_total": self.weight_sync_commits_total,
+            "weight_sync_staged_chunks_total": (
+                self.weight_sync_staged_chunks_total
+            ),
+            "weight_sync_staged_bytes_total": (
+                self.weight_sync_staged_bytes_total
+            ),
+            "weight_sync_aborted_updates_total": (
+                self.weight_sync_aborted_updates_total
+            ),
+            "decode_dispatch_count": self.decode_dispatch_count,
+        }
+        if serving_stats is None:
+            serving_stats = self.serving_stats()
+        for k, v in serving_stats.items():
+            if isinstance(v, bool):
+                out[k] = int(v)
+            elif isinstance(v, (int, float)):
+                out[k] = v
+        return out
+
+    def _collect_metrics(self, registry) -> None:
+        """Registry collector (runs at scrape/export time only): mirror
+        the live engine counters into ``areal_serving_*`` gauges."""
+        g = registry.gauge(
+            "areal_serving",
+            "generation-engine serving counters (mirrors /model_info)",
+            labels=("key",),
+        )
+        for k, v in self.metrics_snapshot().items():
+            g.labels(key=k).set(float(v))
+
     @property
     def spec_acceptance_rate(self) -> float:
         """Lifetime accepted/proposed draft-token ratio (0.0 before any
@@ -1324,6 +1450,17 @@ class GenerationEngine:
                     self.weight_sync_stall_seconds_last = stall
                     self.weight_sync_stall_seconds_total += stall
                     self.weight_sync_commits_total += 1
+                    self._stamp_active_spans("weight_commit", version=version)
+                    from areal_tpu.utils import flight_recorder
+
+                    flight_recorder.record(
+                        "commits",
+                        "staged_commit",
+                        version=version,
+                        leaves=len(staged),
+                        stall_seconds=stall,
+                        n_running=self.n_running,
+                    )
                     logger.info(
                         "weights updated (staged commit of %d leaves) -> "
                         "v%d (fenced %.4fs)",
@@ -1376,6 +1513,9 @@ class GenerationEngine:
                     else:
                         self.version += 1
                     self._on_weights_changed()
+                    self._stamp_active_spans(
+                        "weight_commit", version=self.version
+                    )
                     logger.info(
                         "weights updated (lora adapters %s) -> v%d in %.2fs",
                         ",".join(leaves), self.version, time.monotonic() - t0,
@@ -1416,6 +1556,19 @@ class GenerationEngine:
                     jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
                     self.version = version if version is not None else self.version + 1
                     self._on_weights_changed()
+                    self._stamp_active_spans(
+                        "weight_commit", version=self.version
+                    )
+                    from areal_tpu.utils import flight_recorder
+
+                    flight_recorder.record(
+                        "commits",
+                        "full_refresh",
+                        version=self.version,
+                        source="disk" if cmd[0] == "update_weights"
+                        else "device",
+                        n_running=self.n_running,
+                    )
                     logger.info(
                         "weights updated (%s) -> v%d in %.2fs",
                         "disk" if cmd[0] == "update_weights" else "device",
@@ -1503,6 +1656,10 @@ class GenerationEngine:
                 )
                 st["off"] += n
                 token_budget -= n
+                if seq.span is not None:
+                    seq.span.event(
+                        "prefill_chunk", tokens=n, offset=st["off"]
+                    )
             if st["off"] >= limit:
                 del self._warming[slot]
                 self.chunked_prefill_count += 1
@@ -1588,6 +1745,16 @@ class GenerationEngine:
             if popped is None:
                 break
             seq, entry = popped
+            if seq.span is not None:
+                # queue wait measured from ORIGINAL submission (a
+                # requeued entry keeps t_first, like the scheduler stats)
+                seq.span.event(
+                    "admission",
+                    queue_wait=round(
+                        max(0.0, time.monotonic() - entry["t_first"]), 6
+                    ),
+                    queue_depth=self.scheduler.depth,
+                )
             if self._try_resume(seq):
                 note_admitted(seq.slot)
                 continue  # resume costs no device dispatch
@@ -1887,6 +2054,10 @@ class GenerationEngine:
         self.prefix_cache.hit_tokens_total += covered
         self.prefix_cache.miss_tokens_total += suffix
         self.radix_hit_count += 1
+        if seq.span is not None:
+            seq.span.event(
+                "radix_hit", covered_tokens=covered, suffix_tokens=suffix
+            )
         now = time.monotonic()
         self._slot_last_use[dst] = now
         if warm:
@@ -2078,6 +2249,13 @@ class GenerationEngine:
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
         self.prefill_tokens_computed_total += sum(len(s.prompt) for s in seqs)
+        for s in seqs:
+            if s.span is not None:
+                s.span.event(
+                    "prefill_dispatch",
+                    prompt_tokens=len(s.prompt),
+                    packed=len(seqs),
+                )
         s_pp = self._pp
         bs = self.block_size
         order = sorted(
@@ -2196,6 +2374,13 @@ class GenerationEngine:
         self.prefill_dispatch_count += 1
         self.prompt_tokens_total += sum(len(s.prompt) for s in seqs)
         self.prefill_tokens_computed_total += sum(len(s.prompt) for s in seqs)
+        for s in seqs:
+            if s.span is not None:
+                s.span.event(
+                    "prefill_dispatch",
+                    prompt_tokens=len(s.prompt),
+                    packed=len(seqs),
+                )
         # compiled-shape control: the stream length buckets like prompt
         # lengths did; the segment count pads to prefill_batch (singles
         # keep a lone-row program for the common case)
@@ -2524,6 +2709,12 @@ class GenerationEngine:
         for i, seq in enumerate(self.slots):
             if seq is None:
                 continue
+            if seq.span is not None:
+                seq.span.event(
+                    "spec_accept",
+                    proposed=int(dlen[i]),
+                    accepted=int(n_acc[i]),
+                )
             # accepted drafts then the correction/bonus token; a stop token
             # mid-window truncates — _emit_token released the slot and the
             # remaining accepted tokens are dropped (cache_len stays at the
@@ -2575,6 +2766,8 @@ class GenerationEngine:
         for i, seq in enumerate(self.slots):
             if seq is None:
                 continue
+            if seq.span is not None:
+                seq.span.event("decode_segment", steps=int(toks.shape[0]))
             for t in range(toks.shape[0]):
                 if self._emit_token(
                     i, seq, int(toks[t, i]), float(logps[t, i]), now
@@ -2630,6 +2823,12 @@ class GenerationEngine:
 
     def _response(self, seq: _Seq, reason: str) -> ModelResponse:
         now = time.monotonic()
+        # latency histograms (p50/p95/p99 via the unified registry):
+        # observed once per request at finish — off the per-token path
+        if seq.t_first_token is not None:
+            self._ttft_hist.observe(seq.t_first_token - seq.t_submit)
+            for d in seq.itl:
+                self._itl_hist.observe(d)
         return ModelResponse(
             input_tokens=list(seq.prompt),
             output_tokens=list(seq.out_tokens),

@@ -50,6 +50,18 @@ class AdmissionScheduler:
         self.refused_total = 0  # hard refusals (could never fit)
         self.queue_wait_seconds_total = 0.0
         self.queue_wait_seconds_last = 0.0
+        # unified metrics: admission-queue wait distribution (p50/p95/p99
+        # through the registry; observed once per pop — off any token
+        # loop). Observations are the TELESCOPED slices (same discipline
+        # as queue_wait_seconds_total): a requeued entry contributes its
+        # waits piecewise, so the histogram's sum is exact and the
+        # common no-requeue case observes the full wait in one piece.
+        from areal_tpu.utils import metrics as _metrics
+
+        self._wait_hist = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_queue_wait_seconds",
+            "admission-queue wait (telescoped slices over requeues)",
+        )
 
     # ------------------------------------------------------------------
 
@@ -84,13 +96,13 @@ class AdmissionScheduler:
                     self._removed.discard(seqno)
                     continue
                 now = self._clock()
-                self.queue_wait_seconds_total += max(
-                    0.0, now - entry["t_enq"]
-                )
+                slice_wait = max(0.0, now - entry["t_enq"])
+                self.queue_wait_seconds_total += slice_wait
                 entry["t_enq"] = now
                 self.queue_wait_seconds_last = max(
                     0.0, now - entry["t_first"]
                 )
+                self._wait_hist.observe(slice_wait)
                 self.admitted_total += 1
                 entry["_key"] = (negpri, seqno)
                 return entry["seq"], entry

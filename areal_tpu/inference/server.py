@@ -101,6 +101,7 @@ class GenerationServer:
             [
                 web.get("/health", self.health),
                 web.get("/model_info", self.model_info),
+                web.get("/metrics", self.metrics),
                 web.post("/generate", self.generate),
                 web.post("/abort_request", self.abort_request),
                 web.post("/pause_generation", self.pause),
@@ -126,50 +127,33 @@ class GenerationServer:
 
     async def model_info(self, request: web.Request) -> web.Response:
         e = self.engine
+        ss = e.serving_stats()
         return web.json_response(
             {
-                "weight_version": e.get_version(),
-                "n_running": e.n_running,
+                # metrics_snapshot is the ONE counter source this endpoint
+                # shares with the /metrics Prometheus collector — a counter
+                # added there shows up on both surfaces, so they cannot
+                # drift. serving_stats is read ONCE and re-spread after it
+                # to restore native JSON types (e.g. prefix_cache_enabled
+                # as a bool, which the snapshot folds to 0/1 for
+                # Prometheus).
+                **e.metrics_snapshot(serving_stats=ss),
+                **ss,
                 "max_batch_size": e.config.max_batch_size,
                 "max_seq_len": e.config.max_seq_len,
-                # serving counters (gserver token-usage tracking role)
-                "prompt_tokens_total": e.prompt_tokens_total,
-                "generated_tokens_total": e.generated_tokens_total,
-                "prefill_count": e.prefill_count,
-                "prefill_dispatch_count": e.prefill_dispatch_count,
-                "prefix_clone_count": e.prefix_clone_count,
-                "prefix_extend_count": e.prefix_extend_count,
-                "prefix_extend_saved_tokens": e.prefix_extend_saved_tokens,
-                # speculative decoding (spec_decode="ngram"): acceptance
-                # rate is the headline — it bounds the decode speedup at
-                # (1 + accepted/steps) tokens per dispatch
-                "spec_steps_total": e.spec_steps_total,
-                "spec_proposed_tokens_total": e.spec_proposed_tokens_total,
-                "spec_accepted_tokens_total": e.spec_accepted_tokens_total,
-                "spec_acceptance_rate": e.spec_acceptance_rate,
-                # pipelined weight sync: the headline stall is the FENCED
-                # window (commit dequeue -> version bump) — with staging
-                # overlapping decode it covers only the final pointer flip,
-                # not the transfer
-                "weight_sync_stall_seconds": e.weight_sync_stall_seconds_last,
-                "weight_sync_stall_seconds_total": (
-                    e.weight_sync_stall_seconds_total
-                ),
-                "weight_sync_commits_total": e.weight_sync_commits_total,
-                "weight_sync_staged_chunks_total": (
-                    e.weight_sync_staged_chunks_total
-                ),
-                "weight_sync_staged_bytes_total": (
-                    e.weight_sync_staged_bytes_total
-                ),
-                "weight_sync_aborted_updates_total": (
-                    e.weight_sync_aborted_updates_total
-                ),
-                "decode_dispatch_count": e.decode_dispatch_count,
-                # serving plane: pool occupancy, radix prefix-cache hit
-                # rates, chunked prefill, admission queue depth/wait
-                **e.serving_stats(),
             }
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the unified metrics registry
+        (utils/metrics.py). The engine registers a collector that mirrors
+        its live counters at scrape time, so the numbers here agree with
+        ``/model_info``'s."""
+        from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+        return web.Response(
+            text=DEFAULT_REGISTRY.render_prometheus(),
+            content_type="text/plain",
         )
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -186,26 +170,62 @@ class GenerationServer:
             )
 
         try:
-            self.engine.submit(
-                rid, input_ids, gconfig, on_done,
-                image_data=body.get("image_data"),
-                # `or 0` folds JSON null to the default; a non-numeric
-                # priority falls into the 400 path below (a malformed
-                # request must fail fast, not 500-and-retry)
-                priority=int(body.get("priority") or 0),
+            n_prompt = len(input_ids)
+        except TypeError:  # invalid request: fail fast, never 500-and-retry
+            return web.json_response(
+                {
+                    "error": "input_ids must be a sequence, got "
+                    f"{type(input_ids).__name__}"
+                },
+                status=400,
             )
-        except (ValueError, TypeError) as e:  # invalid request: fail fast
-            return web.json_response({"error": str(e)}, status=400)
-        except RuntimeError as e:
-            return web.json_response({"error": str(e)}, status=500)
+        # distributed tracing: continue the client's x-areal-trace context
+        # (or root a fresh trace for headerless callers) and hand the span
+        # to the engine, which stamps admission/prefill/decode/commit
+        # events onto it. Tracer None (the default) = nothing allocated.
+        span = None
+        tracer = getattr(self.engine, "_tracer", None)
+        if tracer is not None:
+            from areal_tpu.utils.tracing import TRACE_HEADER
+
+            span = tracer.span_from_header(
+                request.headers.get(TRACE_HEADER),
+                "server.generate",
+                rid=rid,
+                prompt_tokens=n_prompt,
+            )
+        submit_kwargs = {} if span is None else {"span": span}
         try:
-            resp = await fut
-        except asyncio.CancelledError:
-            # client disconnected / timed out: free the slot so a retry of
-            # the same rid doesn't run two copies concurrently
-            self.engine.abort(rid)
-            raise
-        return web.json_response(_response_payload(resp))
+            try:
+                self.engine.submit(
+                    rid, input_ids, gconfig, on_done,
+                    image_data=body.get("image_data"),
+                    # `or 0` folds JSON null to the default; a non-numeric
+                    # priority falls into the 400 path below (a malformed
+                    # request must fail fast, not 500-and-retry)
+                    priority=int(body.get("priority") or 0),
+                    **submit_kwargs,
+                )
+            except (ValueError, TypeError) as e:  # invalid request: fail fast
+                return web.json_response({"error": str(e)}, status=400)
+            except RuntimeError as e:
+                return web.json_response({"error": str(e)}, status=500)
+            try:
+                resp = await fut
+            except asyncio.CancelledError:
+                # client disconnected / timed out: free the slot so a retry
+                # of the same rid doesn't run two copies concurrently
+                self.engine.abort(rid)
+                raise
+            if span is not None:
+                span.set(
+                    stop_reason=resp.stop_reason,
+                    output_tokens=len(resp.output_tokens),
+                )
+            return web.json_response(_response_payload(resp))
+        finally:
+            if span is not None:
+                span.end()
 
     async def abort_request(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import signal
 import socket
 import uuid
 from dataclasses import dataclass, field
@@ -64,15 +65,34 @@ async def amain(cfg: GenServerConfig):
     logger.info("registered %s -> %s", key, addr)
 
     stop_key = f"{names.trial_root(cfg.experiment_name, cfg.trial_name)}/shutdown"
+    # SIGTERM = preemption: the server process holds the flight-recorder
+    # channels a postmortem wants (requests, commits, admission), so dump
+    # them before the clean stop instead of dying with default disposition
+    stop_event = asyncio.Event()
+
+    def _on_sigterm():
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.dump("sigterm")
+        stop_event.set()
+
+    loop = asyncio.get_running_loop()
     try:
-        while True:
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, RuntimeError):  # non-unix / nested loops
+        pass
+    try:
+        while not stop_event.is_set():
             try:
                 name_resolve.get(stop_key)
                 logger.info("shutdown key found; exiting")
                 break
             except Exception:
                 pass
-            await asyncio.sleep(2.0)
+            try:
+                await asyncio.wait_for(stop_event.wait(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
     finally:
         await server.stop()
 

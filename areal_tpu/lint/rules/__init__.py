@@ -7,6 +7,7 @@ from areal_tpu.lint.rules import (  # noqa: F401
     jax_compat,
     jit_discipline,
     locks,
+    metrics_labels,
     prng,
     retries,
 )

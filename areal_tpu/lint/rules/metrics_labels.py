@@ -1,0 +1,93 @@
+"""Metric label-cardinality discipline.
+
+unbounded-metric-label: every distinct label VALUE on a metric creates a
+new time series. A label fed from a per-request identifier — a raw rid,
+a uuid, an f-string interpolating one — grows the registry without bound
+(the classic Prometheus cardinality explosion; the runtime registry caps
+and coalesces into ``__overflow__``, degrading the metric). Label values
+must come from a small closed set: states, endpoint names, quantile
+labels, fleet addresses.
+
+Flagged at ``.labels(...)`` call sites (the runtime API of
+``areal_tpu.utils.metrics``): an f-string value, a ``.format()``/
+``str()`` call, or a variable whose name looks like a per-request id
+(``rid``, ``uuid``, ``request_id``, ``trace_id``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+#: identifier fragments that mark a per-request/unbounded value; matched
+#: against the terminal name of a Name/Attribute label value
+_ID_LIKE = re.compile(
+    r"(^|_)(rid|qid|uuid|guid|request_id|trace_id|span_id|session_id|"
+    r"run_id|task_id)($|_)"
+)
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _why_unbounded(node: ast.AST) -> str | None:
+    """Reason this label-value expression is unbounded, or None."""
+    if isinstance(node, ast.JoinedStr):
+        # only an f-string that actually interpolates something; f"lit"
+        # is just a literal
+        if any(isinstance(v, ast.FormattedValue) for v in node.values):
+            return "an f-string interpolating a runtime value"
+        return None
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "format":
+            return "a .format() call"
+        if isinstance(f, ast.Name) and f.id in ("str", "repr", "hex"):
+            return f"a {f.id}() conversion of a runtime value"
+        return None
+    name = _terminal_name(node)
+    if name is not None and _ID_LIKE.search(name.lower()):
+        return f"an id-like variable ({name!r})"
+    return None
+
+
+@register
+class UnboundedMetricLabelRule(Rule):
+    id = "unbounded-metric-label"
+    doc = (
+        "per-request identifier (rid/uuid/f-string) passed as a metric "
+        "label value — every distinct value is a new time series "
+        "(cardinality explosion)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "labels"):
+                continue
+            for val in list(call.args) + [kw.value for kw in call.keywords]:
+                why = _why_unbounded(val)
+                if why is not None:
+                    yield self.finding(
+                        ctx,
+                        val,
+                        f"metric label value is {why}; label values must "
+                        "come from a small closed set (states, endpoints, "
+                        "quantiles) — put per-request ids in trace spans "
+                        "or the flight recorder, not metric labels",
+                    )

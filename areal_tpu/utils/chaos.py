@@ -88,6 +88,16 @@ def crash_point(name: str) -> None:
         _crash_hits[name] = _crash_hits.get(name, 0) + 1
         n = int(nth) if nth else 1
         if _crash_hits[name] == n:
+            # an injected kill leaves the same postmortem artifact a real
+            # one would: the flight recorder's recent-event rings (the
+            # whole point of the chaos harness is rehearsing production
+            # failures end to end, evidence included)
+            try:
+                from areal_tpu.utils import flight_recorder
+
+                flight_recorder.dump(f"injected_crash_{name}")
+            except Exception:
+                pass
             raise InjectedCrash(
                 f"AREAL_CRASH_AT barrier {name!r} (arrival {n})"
             )

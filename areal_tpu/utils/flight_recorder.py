@@ -1,0 +1,150 @@
+"""Crash flight recorder: per-subsystem ring buffers of recent structured
+events, dumped atomically at the moment of death.
+
+PR 3/4 built the chaos harness (injected crashes, watchdog, SIGTERM
+drains) — but a postmortem still started from logs alone: the watchdog's
+stack dump says WHERE the trainer wedged, not what the last 200 requests,
+breaker transitions, weight commits, and admission decisions looked like
+on the way in. Each subsystem records its recent history into a bounded
+ring here (``record("breaker", "open", addr=...)`` — a deque append, no
+I/O, safe on warm paths), and the three death paths dump every ring as
+one JSON file via the PR 4 atomic write helpers:
+
+- **watchdog timeout** (exit 43): ``Watchdog.check`` dumps before
+  ``os._exit`` — evidence survives the hard exit;
+- **InjectedCrash**: ``utils/chaos.crash_point`` dumps before raising,
+  so every chaos-harness kill leaves the same artifact a real one would;
+- **SIGTERM / graceful drain**: ``RecoverHandler.graceful_shutdown``
+  dumps next to the recover checkpoint.
+
+Dumps are best-effort by design: a recorder failure must never turn a
+clean drain into a crash (every dump path swallows and logs).
+
+The default recorder is process-global (subsystems should not need
+plumbing to leave evidence); ``clock`` is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("flight_recorder")
+
+#: override the dump directory without config plumbing (launcher sets it
+#: next to the trial dir); default keeps dumps out of the way but findable
+DUMP_DIR_ENV = "AREAL_FLIGHT_RECORDER_DIR"
+
+DEFAULT_CAPACITY = 256
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=time.time):
+        self._lock = threading.Lock()
+        self._channels: dict[str, deque] = {}  # guarded_by: _lock
+        self._capacity = capacity
+        self._clock = clock
+        self._dump_dir: str | None = None
+        self.events_recorded = 0
+        self.dumps_written = 0
+
+    # -- recording ------------------------------------------------------
+
+    def channel(self, name: str, capacity: int | None = None) -> deque:
+        """Get-or-create a ring. Idempotent; explicit ``capacity`` only
+        applies on first creation."""
+        with self._lock:
+            ch = self._channels.get(name)
+            if ch is None:
+                ch = self._channels[name] = deque(
+                    maxlen=capacity or self._capacity
+                )
+            return ch
+
+    def record(self, channel: str, kind: str, **fields) -> None:
+        """Append one structured event. Cheap enough for warm paths (one
+        lock, one dict, one deque append); keep it off token-level hot
+        loops. The append holds the lock: snapshot() iterates the rings
+        under it, and CPython raises RuntimeError on a deque mutated
+        mid-iteration — an unlocked append racing a crash-time dump
+        would lose the postmortem exactly when traffic is busiest."""
+        ev = {"t": self._clock(), "kind": kind, **fields}
+        with self._lock:
+            ch = self._channels.get(channel)
+            if ch is None:
+                ch = self._channels[channel] = deque(maxlen=self._capacity)
+            ch.append(ev)
+            self.events_recorded += 1
+
+    # -- dumping --------------------------------------------------------
+
+    def set_dump_dir(self, path: str) -> None:
+        self._dump_dir = path
+
+    def dump_dir(self) -> str:
+        return (
+            self._dump_dir
+            or os.environ.get(DUMP_DIR_ENV)
+            or "/tmp/areal_tpu/flight_recorder"
+        )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dumped_at": self._clock(),
+                "pid": os.getpid(),
+                "events_recorded": self.events_recorded,
+                "channels": {
+                    name: list(ring)
+                    for name, ring in self._channels.items()
+                },
+            }
+
+    def dump(self, reason: str, path: str | None = None) -> str | None:
+        """Atomically write every ring to one JSON file; returns the
+        path, or None when the dump failed (best-effort: the recorder
+        must never turn a clean exit into a crash)."""
+        try:
+            from areal_tpu.utils.fs import atomic_write_json
+
+            if path is None:
+                d = self.dump_dir()
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d,
+                    f"flight_{reason}_{os.getpid()}_"
+                    f"{self.dumps_written}.json",
+                )
+            snap = self.snapshot()
+            snap["reason"] = reason
+            atomic_write_json(path, snap)
+            self.dumps_written += 1
+            logger.warning(
+                "flight recorder dumped %d event(s) across %d channel(s) "
+                "-> %s (reason: %s)",
+                snap["events_recorded"],
+                len(snap["channels"]),
+                path,
+                reason,
+            )
+            return path
+        except Exception:
+            logger.exception("flight recorder dump failed (reason=%s)", reason)
+            return None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._channels.clear()
+            self.events_recorded = 0
+            self.dumps_written = 0
+
+
+DEFAULT_RECORDER = FlightRecorder()
+
+record = DEFAULT_RECORDER.record
+dump = DEFAULT_RECORDER.dump
+set_dump_dir = DEFAULT_RECORDER.set_dump_dir

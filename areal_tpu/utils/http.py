@@ -111,6 +111,7 @@ async def arequest_with_retry(
     retry_delay: float = 1.0,
     total_timeout: float | None = None,
     chaos=None,
+    headers: dict[str, str] | None = None,
     rng=None,
     sleep=None,
     clock=None,
@@ -155,12 +156,17 @@ async def arequest_with_retry(
                         )
                     else:  # drop: the request vanished; client sees timeout
                         raise asyncio.TimeoutError("chaos-injected drop")
+            # headers ride as an OPTIONAL kwarg: test doubles (scripted
+            # sessions) keep their narrow request() signatures, and the
+            # header-less common case stays byte-identical on the wire
+            hdr_kw = {"headers": headers} if headers is not None else {}
             async with session.request(
                 method,
                 url,
                 json=payload,
                 data=data,
                 timeout=aiohttp.ClientTimeout(total=per_try),
+                **hdr_kw,
             ) as resp:
                 if resp.status == 200:
                     return await resp.json()

@@ -1,0 +1,468 @@
+"""Unified metrics plane: one registry for every counter the system
+already keeps in ad-hoc dicts.
+
+Before this module, the same fact lived in several places with several
+shapes — ``serving_stats()`` in ``/model_info``, ServerHealthTracker's
+sliding windows, StalenessManager counters, ``weight_sync_*`` attributes,
+spec-decode acceptance — and nothing could *scrape* them. This registry
+gives them one home with three instrument types:
+
+- :class:`Counter` — monotonically increasing totals;
+- :class:`Gauge` — point-in-time values (queue depth, blocks free);
+- :class:`Histogram` — bucketed distributions with ``quantile()``
+  estimation (p50/p95/p99 TTFT and inter-token latency).
+
+plus **collector callbacks**: a component registers a function that is
+invoked at scrape/export time to copy its live counters into gauges, so
+``/metrics`` always agrees with ``/model_info`` by construction (they
+read the same source at the same moment) and steady-state cost is zero.
+
+Exposition: :meth:`MetricsRegistry.render_prometheus` emits the
+Prometheus text format (``/metrics`` on the inference server);
+:meth:`MetricsRegistry.export_scalars` flattens to a ``dict[str, float]``
+for the trainer-side StatsLogger periodic export.
+
+**Label-cardinality guard**: metric labels multiply time series, and an
+unbounded label value (a raw rid, a uuid) grows the registry without
+limit — the classic Prometheus cardinality explosion. Each metric caps
+its distinct label-sets at ``max_label_values``; past the cap, new label
+values coalesce into ``"__overflow__"`` (logged once). The static side
+is enforced by the ``unbounded-metric-label`` arealint rule.
+
+Thread-safe throughout; the per-child fast path after the first
+``labels()`` call is one dict probe.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+import time
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("metrics")
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+OVERFLOW_LABEL = "__overflow__"
+
+#: default latency buckets (seconds): sub-ms to minutes, log-ish spacing
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self.value += amount
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value -= amount
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: tuple):
+        self._lock = threading.Lock()
+        self.buckets = buckets  # sorted upper bounds, +Inf implicit
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self.counts[idx] += 1
+            self.sum += value
+            self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (the scrape-side
+        ``histogram_quantile`` computation, available in-process so the
+        fleet summary and tests don't need a Prometheus server).
+
+        Estimates are capped at the largest finite bucket bound
+        (Prometheus convention) — check :attr:`overflow_count` to tell a
+        true 120s tail from ">120s, capped"."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else self.buckets[-1]
+                )
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.buckets[-1]
+
+    @property
+    def overflow_count(self) -> int:
+        """Observations beyond the largest finite bucket. Nonzero means
+        ``quantile()`` estimates touching the last bucket understate the
+        real tail."""
+        with self._lock:
+            return self.counts[-1]
+
+
+_CHILD_TYPES = {
+    "counter": _CounterChild,
+    "gauge": _GaugeChild,
+    "histogram": _HistogramChild,
+}
+
+
+class _Metric:
+    """One named metric family; children keyed by label-value tuples."""
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        kind: str,
+        labelnames: tuple,
+        max_label_values: int,
+        buckets: tuple = DEFAULT_BUCKETS,
+    ):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets))
+        self._max_label_values = max_label_values
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+        self._overflowed = False
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self.buckets)
+        return _CHILD_TYPES[self.kind]()
+
+    def labels(self, **labelvalues):
+        """Child for one label-set. Distinct label-sets are capped at
+        ``max_label_values``: past the cap, new values coalesce into the
+        ``__overflow__`` series — a raw rid/uuid label can degrade the
+        metric, never the process."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}"
+            )
+        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        child = self._children.get(key)
+        if child is not None:
+            return child
+        with self._lock:
+            child = self._children.get(key)
+            if child is not None:
+                return child
+            if len(self._children) >= self._max_label_values:
+                if not self._overflowed:
+                    self._overflowed = True
+                    logger.warning(
+                        "metric %s exceeded %d distinct label sets "
+                        "(unbounded label value? e.g. a raw rid); new "
+                        "series coalesce into %s",
+                        self.name,
+                        self._max_label_values,
+                        OVERFLOW_LABEL,
+                    )
+                key = (OVERFLOW_LABEL,) * len(self.labelnames)
+                child = self._children.get(key)
+                if child is not None:
+                    return child
+            child = self._new_child()
+            self._children[key] = child
+            return child
+
+    # unlabelled conveniences ------------------------------------------
+
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name} is labelled {self.labelnames}; "
+                "call .labels(...) first"
+            )
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._solo().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self._solo().quantile(q)
+
+    @property
+    def value(self) -> float:
+        return self._solo().value
+
+    def children(self) -> dict[tuple, object]:
+        with self._lock:
+            return dict(self._children)
+
+
+class MetricsRegistry:
+    def __init__(self, max_label_values: int = 128, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list = []
+        self._initial_max_label_values = max_label_values
+        self.max_label_values = max_label_values
+        self.clock = clock
+
+    def set_max_label_values(self, n: int) -> None:
+        """Re-cap label cardinality (MetricsConfig.max_label_values): the
+        process-global registry is built at import time, so config lands
+        after metrics already exist — retune them too, not just future
+        ones. Shrinking below a metric's live child count keeps existing
+        children and only coalesces NEW values into ``__overflow__``."""
+        with self._lock:
+            self.max_label_values = int(n)
+            for m in self._metrics.values():
+                m._max_label_values = int(n)
+
+    # -- instrument factories (get-or-create, type-checked) -------------
+
+    def _get_or_create(
+        self, name: str, help: str, kind: str, labels: tuple, buckets: tuple
+    ) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.labelnames != tuple(labels):
+                    raise ValueError(
+                        f"metric {name} already registered as {m.kind}"
+                        f"{m.labelnames}, requested {kind}{tuple(labels)}"
+                    )
+                return m
+            m = _Metric(
+                name, help, kind, tuple(labels), self.max_label_values,
+                buckets,
+            )
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "", labels: tuple = ()) -> _Metric:
+        return self._get_or_create(name, help, "counter", labels, ())
+
+    def gauge(self, name: str, help: str = "", labels: tuple = ()) -> _Metric:
+        return self._get_or_create(name, help, "gauge", labels, ())
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: tuple = (),
+        buckets: tuple = DEFAULT_BUCKETS,
+    ) -> _Metric:
+        return self._get_or_create(name, help, "histogram", labels, buckets)
+
+    # -- collectors -----------------------------------------------------
+
+    def register_collector(self, fn) -> object:
+        """``fn(registry)`` runs right before every render/export,
+        copying a component's live counters into gauges — the scrape and
+        the component's own API read the same values at the same moment.
+        Returns a handle for :meth:`unregister_collector`."""
+        with self._lock:
+            self._collectors.append(fn)
+        return fn
+
+    def unregister_collector(self, handle) -> None:
+        with self._lock:
+            try:
+                self._collectors.remove(handle)
+            except ValueError:
+                pass
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:  # a sick collector must not kill the scrape
+                logger.exception("metrics collector failed")
+
+    # -- export ---------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        self._run_collectors()
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help or m.name}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in sorted(m.children().items()):
+                base_lbl = ",".join(
+                    f'{ln}="{_escape_label_value(lv)}"'
+                    for ln, lv in zip(m.labelnames, key)
+                )
+                if m.kind == "histogram":
+                    cum = 0
+                    for i, ub in enumerate(child.buckets):
+                        cum += child.counts[i]
+                        le = f'le="{_fmt(ub)}"'
+                        lbl = f"{base_lbl},{le}" if base_lbl else le
+                        lines.append(
+                            f"{m.name}_bucket{{{lbl}}} {cum}"
+                        )
+                    cum += child.counts[-1]
+                    le = 'le="+Inf"'
+                    lbl = f"{base_lbl},{le}" if base_lbl else le
+                    lines.append(f"{m.name}_bucket{{{lbl}}} {cum}")
+                    suffix = f"{{{base_lbl}}}" if base_lbl else ""
+                    lines.append(f"{m.name}_sum{suffix} {_fmt(child.sum)}")
+                    lines.append(f"{m.name}_count{suffix} {cum}")
+                else:
+                    suffix = f"{{{base_lbl}}}" if base_lbl else ""
+                    lines.append(f"{m.name}{suffix} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def export_scalars(self, prefix: str = "") -> dict[str, float]:
+        """Flatten to ``{name{labels}: value}`` floats for the
+        StatsLogger periodic export; histograms export count/sum and
+        p50/p95/p99."""
+        self._run_collectors()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict[str, float] = {}
+        for m in metrics:
+            for key, child in m.children().items():
+                lbl = (
+                    "{" + ",".join(
+                        f"{ln}={lv}" for ln, lv in zip(m.labelnames, key)
+                    ) + "}"
+                    if key
+                    else ""
+                )
+                base = f"{prefix}{m.name}{lbl}"
+                if m.kind == "histogram":
+                    out[f"{base}/count"] = float(child.count)
+                    out[f"{base}/sum"] = float(child.sum)
+                    out[f"{base}/p50"] = child.quantile(0.50)
+                    out[f"{base}/p95"] = child.quantile(0.95)
+                    out[f"{base}/p99"] = child.quantile(0.99)
+                    ovf = child.overflow_count
+                    if ovf:
+                        # quantiles above are capped at the largest
+                        # finite bucket; this says how many observations
+                        # landed past it
+                        out[f"{base}/overflow_count"] = float(ovf)
+                else:
+                    out[base] = float(child.value)
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric and collector and restore the construction-time
+        label cap (test isolation — a retuned cap must not leak)."""
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+            self.max_label_values = self._initial_max_label_values
+
+
+DEFAULT_REGISTRY = MetricsRegistry()
+
+counter = DEFAULT_REGISTRY.counter
+gauge = DEFAULT_REGISTRY.gauge
+histogram = DEFAULT_REGISTRY.histogram
+register_collector = DEFAULT_REGISTRY.register_collector
+unregister_collector = DEFAULT_REGISTRY.unregister_collector
+render_prometheus = DEFAULT_REGISTRY.render_prometheus
+export_scalars = DEFAULT_REGISTRY.export_scalars
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Minimal parser of the text exposition format (tests + the
+    ``/metrics``-agrees-with-``/model_info`` gate): returns
+    ``{"name{labels}": value}``; raises ValueError on malformed lines so
+    a garbled exposition fails loudly."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+        except ValueError:
+            raise ValueError(f"malformed metrics line: {line!r}") from None
+        if not series or (
+            "{" in series and not series.endswith("}")
+        ):
+            raise ValueError(f"malformed metrics line: {line!r}")
+        v = float(value) if value != "+Inf" else math.inf
+        out[series] = v
+    return out

@@ -24,10 +24,17 @@ class StepProfiler:
     """Capture a jax.profiler trace for steps [start_step, start_step+num_steps).
 
     Usage (train loop):
-        profiler = StepProfiler(cfg.profiler)
-        for step in ...:
-            with profiler.step(step):
-                ...train...
+        with StepProfiler(cfg.profiler) as profiler:
+            for step in ...:
+                with profiler.step(step):
+                    ...train...
+
+    The context-manager form (or an explicit ``close()`` in the loop's
+    ``finally`` and in the graceful-shutdown path) matters: if the loop
+    exits — normal end of data, a crash, or a SIGTERM drain — before
+    ``start_step + num_steps``, an in-flight ``jax.profiler`` capture
+    would otherwise never see ``stop_trace()`` and the whole trace is
+    lost. ``close()`` finalizes any active capture and is idempotent.
     """
 
     def __init__(self, config):
@@ -62,8 +69,28 @@ class StepProfiler:
                 logger.info("profiler trace stopped (step %d)", global_step)
 
     def close(self):
+        """Finalize an in-flight capture (idempotent). Called from the
+        trainer's ``finally`` and the graceful-shutdown path so an early
+        exit (drain, crash, short run) flushes the trace instead of
+        losing it."""
         if self._active:
+            self._active = False
             import jax
 
-            jax.profiler.stop_trace()
-            self._active = False
+            try:
+                jax.profiler.stop_trace()
+                logger.info(
+                    "profiler trace finalized early (close) -> %s",
+                    self.config.dir,
+                )
+            except Exception:
+                # a torn profiler session must not mask the original
+                # exception unwinding through the trainer's finally
+                logger.exception("profiler stop_trace failed in close()")
+
+    def __enter__(self) -> "StepProfiler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

@@ -535,6 +535,7 @@ class RecoverHandler:
         rollout=None,
         guard: PreemptionGuard | None = None,
         checkpoint_reserve_seconds: float = 10.0,
+        profiler=None,
     ) -> str | None:
         """The preemption path: drain in-flight episodes within the
         remaining grace budget (reserving ``checkpoint_reserve_seconds``
@@ -549,6 +550,12 @@ class RecoverHandler:
         nothing salvaged). New episode launches are gated executor-side by
         ``drain()`` itself, and this process exits right after the dump —
         the servers simply go idle."""
+        if profiler is not None:
+            # finalize an in-flight jax.profiler capture FIRST: the
+            # window may span the step we are abandoning, and an
+            # unclosed capture is lost entirely (StepProfiler.close is
+            # idempotent and swallows its own errors)
+            profiler.close()
         budget = guard.remaining() if guard is not None else float("inf")
         if budget == float("inf"):
             budget = self.config.grace_period_seconds
@@ -563,6 +570,15 @@ class RecoverHandler:
                 ),
             )
             drained = executor.drain(timeout=drain_budget)
+        # SIGTERM postmortem: dump the flight recorder's recent-event
+        # rings next to the recover dump (best-effort; the checkpoint
+        # below must proceed regardless)
+        try:
+            from areal_tpu.utils import flight_recorder
+
+            flight_recorder.dump("sigterm")
+        except Exception:
+            pass
         return self.dump(
             engine,
             step,

@@ -43,6 +43,13 @@ class StatsLogger:
         self._on_disk_step = -1
         self._dedup_armed = False  # set by load_state_dict (recovery only)
         self._warned_stale_logs = False
+        mcfg = getattr(config, "metrics", None)
+        if mcfg is not None and mcfg.enabled:
+            from areal_tpu.utils import metrics as _metrics
+
+            _metrics.DEFAULT_REGISTRY.set_max_label_values(
+                mcfg.max_label_values
+            )
         if rank == 0:
             self._init_backends()
 
@@ -164,6 +171,26 @@ class StatsLogger:
             for s in stats:
                 merged.update(s)
             stats = merged
+        mcfg = getattr(self.config, "metrics", None)
+        if (
+            mcfg is not None
+            and mcfg.enabled
+            and mcfg.stats_logger_prefix
+        ):
+            # trainer-side periodic export of the unified metrics
+            # registry: every commit row carries the registry's current
+            # scalars (counters/gauges cumulative, histograms as
+            # count/sum/p50/p95/p99), so stats.jsonl is the one place
+            # metrics land even without a Prometheus scraper. Explicit
+            # per-step stats win on key collision.
+            from areal_tpu.utils import metrics as _metrics
+
+            stats = {
+                **_metrics.DEFAULT_REGISTRY.export_scalars(
+                    prefix=mcfg.stats_logger_prefix
+                ),
+                **stats,
+            }
         logger.info(
             "Epoch %d step %d (global %d): %s",
             epoch,

@@ -1,0 +1,471 @@
+"""Distributed rollout tracing: follow ONE rollout across the process
+boundary.
+
+The counterpart of the reference's monitor layer (realhf/base/monitor.py
+kernel-time attribution) for the *serving* plane: the trainer's counters
+say how much time a step spent, but nothing in the repo could answer
+"where did THIS rollout's 4 seconds go — queue, prefill, decode, a
+failover, or a weight commit that landed mid-generation?". This module
+gives every rollout a trace id minted in :class:`WorkflowExecutor`,
+propagated as an ``x-areal-trace`` HTTP header through
+:class:`RemoteInfEngine` into ``inference/server.py`` and the engine, so
+client and server spans connect into one timeline:
+
+- ``rollout`` (client, per episode) > ``generate`` (client, per
+  agenerate call) > ``server.generate`` (server, per HTTP dispatch —
+  one per failover/abort-resume splice, each tagged with its server
+  address), with events for admission-queue wait, radix prefix-cache hit
+  length, chunked-prefill dispatches, decode segments, spec-decode
+  accept runs, and weight commits landing mid-generation.
+
+Design constraints, in priority order:
+
+1. **Near-zero cost off.** ``Tracer.from_config`` returns ``None`` when
+   tracing is disabled, and every hot-path call site guards with ``is
+   not None`` (the same discipline as the PR 3 chaos hook, pinned by a
+   code-inspection test): the request path allocates NOTHING — no span
+   objects, no kwargs dicts, no header strings.
+2. **Bounded memory.** Finished spans land in a ring (``max_spans``);
+   per-span events are capped (``max_events_per_span``). A tracer can
+   run forever without growing.
+3. **Exportable.** ``export_jsonl`` appends finished spans as JSON
+   lines; :func:`chrome_trace` converts span dicts to the Chrome /
+   Perfetto ``trace_event`` format so one rollout's life renders on a
+   timeline next to a jax.profiler capture, and
+   :func:`spans_from_chrome_trace` round-trips it back.
+
+Clocks are injectable (``clock`` = monotonic for durations, ``wall`` =
+epoch seconds for cross-process alignment) so tests drive fake time.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+#: HTTP header carrying ``<trace_id>:<span_id>`` across the process
+#: boundary (client generate span -> server request span).
+TRACE_HEADER = "x-areal-trace"
+
+#: contextvar linking an executor's rollout span to the agenerate calls
+#: the workflow makes (workflow code in between needs no tracing API).
+_CURRENT: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "areal_current_span", default=None
+)
+
+
+def current_span() -> "Span | None":
+    return _CURRENT.get()
+
+
+def set_current_span(span: "Span | None"):
+    """Returns a token for :func:`reset_current_span`."""
+    return _CURRENT.set(span)
+
+
+def reset_current_span(token) -> None:
+    _CURRENT.reset(token)
+
+
+def parse_trace_header(value: str | None) -> tuple[str, str] | None:
+    """``"<trace_id>:<span_id>"`` -> tuple, or None when absent/garbled
+    (a malformed header from an old client must not fail the request)."""
+    if not value:
+        return None
+    parts = value.split(":")
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        return None
+    return parts[0], parts[1]
+
+
+class Span:
+    """One timed operation. Mutated by its owner; ``event`` may be called
+    from another thread (the engine thread stamps events onto a span the
+    server loop owns) — ``list.append`` is atomic under the GIL and the
+    event cap check is advisory, so no lock is needed."""
+
+    __slots__ = (
+        "tracer",
+        "name",
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "t_start",
+        "t_wall",
+        "t_end",
+        "attrs",
+        "events",
+        "_ended",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: str | None,
+        attrs: dict | None,
+    ):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t_start = tracer.clock()
+        self.t_wall = tracer.wall()
+        self.t_end: float | None = None
+        self.attrs = dict(attrs) if attrs else {}
+        self.events: list[dict] = []
+        self._ended = False
+
+    # -- recording ------------------------------------------------------
+
+    def event(self, name: str, **attrs) -> None:
+        """Append a point-in-time event; silently dropped past the cap
+        (a bounded trace beats an unbounded one; the drop is counted)."""
+        if len(self.events) >= self.tracer.max_events_per_span:
+            self.tracer.events_dropped += 1
+            return
+        self.events.append(
+            {"t": self.tracer.clock(), "name": name, **attrs}
+        )
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def header(self) -> str:
+        """Value for the :data:`TRACE_HEADER` of child requests."""
+        return f"{self.trace_id}:{self.span_id}"
+
+    def end(self) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        self.t_end = self.tracer.clock()
+        self.tracer._finish(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.attrs.setdefault("error", repr(exc)[:200])
+        self.end()
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "t_start": self.t_start,
+            "t_wall": self.t_wall,
+            "t_end": self.t_end,
+            "attrs": self.attrs,
+            "events": list(self.events),
+        }
+
+
+class Tracer:
+    """Span factory + bounded buffer of finished spans.
+
+    One tracer per component (client plane, each server process); spans
+    from different tracers sharing a ``trace_id`` merge at export time —
+    there is no cross-process buffer to synchronize.
+    """
+
+    def __init__(
+        self,
+        service: str = "areal",
+        max_spans: int = 4096,
+        max_events_per_span: int = 256,
+        clock=time.monotonic,
+        wall=time.time,
+        export_path: str | None = None,
+    ):
+        self.service = service
+        self.max_events_per_span = max_events_per_span
+        self.clock = clock
+        self.wall = wall
+        self.export_path = export_path or None
+        self._lock = threading.Lock()
+        self._finished: deque[dict] = deque(maxlen=max_spans)  # guarded_by: _lock
+        # lazily-opened persistent append handle for export_path: the
+        # per-span cost with export on is one buffered write+flush, not
+        # makedirs+open+close syscalls on the caller's (event-loop) thread
+        self._export_lock = threading.Lock()
+        self._export_fh = None  # guarded_by: _export_lock
+        self._counter = itertools.count(1)
+        # one random process prefix so span ids never collide across
+        # processes sharing a trace id
+        self._prefix = os.urandom(4).hex()
+        self.spans_started = 0
+        self.spans_finished = 0
+        self.events_dropped = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "Tracer | None":
+        """None when tracing is off — call sites then pay only an ``is
+        not None`` check (the chaos-hook discipline)."""
+        if cfg is None or not getattr(cfg, "enabled", False):
+            return None
+        return cls(
+            service=getattr(cfg, "service", "areal") or "areal",
+            max_spans=getattr(cfg, "max_spans", 4096),
+            max_events_per_span=getattr(cfg, "max_events_per_span", 256),
+            export_path=getattr(cfg, "export_path", None) or None,
+        )
+
+    # -- span creation --------------------------------------------------
+
+    def _new_id(self) -> str:
+        return f"{self._prefix}{next(self._counter):x}"
+
+    def new_trace_id(self) -> str:
+        return os.urandom(8).hex()
+
+    def span(
+        self,
+        name: str,
+        parent: "Span | None" = None,
+        trace_id: str | None = None,
+        parent_id: str | None = None,
+        **attrs,
+    ) -> Span:
+        """Start a span. Parentage: explicit ``parent`` span wins, else
+        (``trace_id``, ``parent_id``) from a propagated header, else a
+        fresh root trace."""
+        if parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        elif trace_id is None:
+            trace_id = self.new_trace_id()
+        self.spans_started += 1
+        attrs.setdefault("service", self.service)
+        return Span(self, name, trace_id, self._new_id(), parent_id, attrs)
+
+    def span_from_header(self, header: str | None, name: str, **attrs) -> Span:
+        parsed = parse_trace_header(header)
+        if parsed is None:
+            return self.span(name, **attrs)
+        trace_id, parent_id = parsed
+        return self.span(
+            name, trace_id=trace_id, parent_id=parent_id, **attrs
+        )
+
+    # -- buffer / export ------------------------------------------------
+
+    def _finish(self, span: Span) -> None:
+        d = span.to_dict()
+        with self._lock:
+            self._finished.append(d)
+            self.spans_finished += 1
+        if self.export_path:
+            self._export_span(d)
+
+    def finished_spans(self) -> list[dict]:
+        with self._lock:
+            return list(self._finished)
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out = list(self._finished)
+            self._finished.clear()
+            return out
+
+    def _export_span(self, d: dict) -> None:
+        """Stream one finished span to ``export_path`` through a handle
+        opened once and kept open — span end must not pay makedirs+open
+        per span on the caller's thread (the server ends spans on its
+        event loop). Flushed per span so readers (tests, the live verify
+        recipe) see a span as soon as its request finishes."""
+        try:
+            with self._export_lock:
+                fh = self._export_fh
+                if fh is None:
+                    dirn = os.path.dirname(self.export_path)
+                    if dirn:
+                        os.makedirs(dirn, exist_ok=True)
+                    fh = self._export_fh = open(self.export_path, "a")
+                fh.write(json.dumps(d) + "\n")
+                fh.flush()
+        except (OSError, ValueError):  # never fail the traced operation
+            pass
+
+    def close(self) -> None:
+        """Release the export handle (idempotent; spans ended after a
+        close() reopen it lazily)."""
+        with self._export_lock:
+            fh, self._export_fh = self._export_fh, None
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _append_jsonl(path: str, spans: list[dict]) -> None:
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(path, "a") as f:
+                for s in spans:
+                    f.write(json.dumps(s) + "\n")
+        except OSError:  # tracing must never fail the traced operation
+            pass
+
+    def export_jsonl(self, path: str | None = None) -> int:
+        """Append every buffered finished span to ``path`` (or the
+        configured ``export_path``); returns the count written."""
+        path = path or self.export_path
+        if not path:
+            raise ValueError("no export path configured")
+        spans = self.finished_spans()
+        self._append_jsonl(path, spans)
+        return len(spans)
+
+
+# ---------------------------------------------------------------------------
+# Chrome / Perfetto trace_event export
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(spans: list[dict], time_base: str = "wall") -> dict:
+    """Convert finished span dicts (possibly merged from several tracers
+    — client + every server) to the Chrome ``trace_event`` JSON format
+    Perfetto renders. Each distinct (service, trace component) becomes a
+    process row; spans become complete ("X") events carrying their ids
+    in ``args`` so :func:`spans_from_chrome_trace` can reconstruct them;
+    span events become instant ("i") events on the same row.
+
+    ``time_base="wall"`` anchors timestamps at each span's wall-clock
+    start (cross-process alignment — monotonic clocks don't compare
+    across hosts); events inside a span keep their monotonic offsets.
+    """
+    services = []
+    events = []
+    for s in spans:
+        svc = str(s.get("attrs", {}).get("service", "areal"))
+        if svc not in services:
+            services.append(svc)
+        pid = services.index(svc) + 1
+        t_end = s["t_end"] if s["t_end"] is not None else s["t_start"]
+        dur_us = max(0.0, (t_end - s["t_start"]) * 1e6)
+        base_us = (
+            s["t_wall"] * 1e6 if time_base == "wall" else s["t_start"] * 1e6
+        )
+        events.append(
+            {
+                "ph": "X",
+                "name": s["name"],
+                "cat": svc,
+                "pid": pid,
+                "tid": 1,
+                "ts": base_us,
+                "dur": dur_us,
+                "args": {
+                    "trace_id": s["trace_id"],
+                    "span_id": s["span_id"],
+                    "parent_id": s["parent_id"],
+                    "t_wall": s["t_wall"],
+                    **{
+                        k: v
+                        for k, v in s.get("attrs", {}).items()
+                        if k != "service"
+                    },
+                },
+            }
+        )
+        for ev in s.get("events", []):
+            events.append(
+                {
+                    "ph": "i",
+                    "name": ev["name"],
+                    "cat": svc,
+                    "pid": pid,
+                    "tid": 1,
+                    "s": "t",
+                    "ts": base_us + (ev["t"] - s["t_start"]) * 1e6,
+                    "args": {
+                        "span_id": s["span_id"],
+                        **{
+                            k: v
+                            for k, v in ev.items()
+                            if k not in ("t", "name")
+                        },
+                    },
+                }
+            )
+    for i, svc in enumerate(services):
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": i + 1,
+                "tid": 0,
+                "ts": 0,
+                "args": {"name": svc},
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def spans_from_chrome_trace(trace: dict) -> list[dict]:
+    """Inverse of :func:`chrome_trace` (lossless for ids, names, timing,
+    attrs, and events) — pins that the Perfetto export round-trips."""
+    spans: dict[str, dict] = {}
+    pid_to_service = {}
+    # the emitted base timestamp per span — event offsets are relative to
+    # it whatever time_base produced the trace (wall OR monotonic start)
+    base_us: dict[str, float] = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_to_service[ev["pid"]] = ev["args"]["name"]
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        trace_id = args.pop("trace_id")
+        span_id = args.pop("span_id")
+        parent_id = args.pop("parent_id", None)
+        t_wall = args.pop("t_wall", ev["ts"] / 1e6)
+        t_start = t_wall
+        base_us[span_id] = ev["ts"]
+        spans[span_id] = {
+            "name": ev["name"],
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent_id,
+            "t_start": t_start,
+            "t_wall": t_wall,
+            "t_end": t_start + ev.get("dur", 0.0) / 1e6,
+            "attrs": {
+                "service": pid_to_service.get(ev["pid"], "areal"),
+                **args,
+            },
+            "events": [],
+        }
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "i":
+            continue
+        args = dict(ev.get("args", {}))
+        sid = args.pop("span_id", None)
+        s = spans.get(sid)
+        if s is None:
+            continue
+        s["events"].append(
+            {
+                "t": s["t_start"] + (ev["ts"] - base_us[sid]) / 1e6,
+                "name": ev["name"],
+                **args,
+            }
+        )
+    return list(spans.values())

@@ -100,6 +100,16 @@ class Watchdog:
         if gap <= self.config.timeout_seconds:
             return False
         self.fired = True
+        # evidence first: the flight recorder's ring buffers (recent
+        # requests, breaker transitions, weight commits) are the context
+        # the stack dump below lacks; best-effort — a recorder failure
+        # must not block the exit that is the watchdog's whole job
+        try:
+            from areal_tpu.utils import flight_recorder
+
+            flight_recorder.dump("watchdog")
+        except Exception:
+            pass
         report = dump_all_stacks()
         logger.error(
             "watchdog: no heartbeat for %.0fs (last phase %r, timeout "

@@ -391,7 +391,8 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
                  new_tokens: int = 128, batch: int = 48, steps_per_call: int = 32,
                  vocab: int = 151936, max_seq_len: int = 512,
                  spec_decode: str = "none", spec_draft_len: int = 4,
-                 repetitive: bool = False, greedy: bool = False):
+                 repetitive: bool = False, greedy: bool = False,
+                 tracing: bool = False):
     """Continuous-batching decode throughput on the GenerationEngine.
 
     Decode is HBM-bound (every step re-reads the 3GB bf16 params), so
@@ -401,13 +402,20 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
     ``spec_decode="ngram"`` turns on draft-free speculative decoding;
     ``repetitive=True`` tiles each prompt from a short random base so the
     n-gram proposer has structure to latch onto (the reasoning/math
-    regime), and ``greedy=True`` makes acceptance deterministic. Returns
-    {"tps", "spec_acceptance_rate", "spec_steps"}."""
+    regime), and ``greedy=True`` makes acceptance deterministic.
+    ``tracing=True`` enables the PR 8 tracing plane end to end (a span
+    per request with engine-internal events), for the tracing_overhead
+    rung. Returns {"tps", "spec_acceptance_rate", "spec_steps",
+    "ttft_mean_s", "output_digest"}."""
     import threading
 
     import numpy as np
 
-    from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        JaxGenConfig,
+        TracingConfig,
+    )
     from areal_tpu.inference.engine import GenerationEngine
 
     model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
@@ -423,6 +431,7 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
             dtype="bfloat16",
             spec_decode=spec_decode,
             spec_draft_len=spec_draft_len,
+            tracing=TracingConfig(enabled=tracing, service="bench"),
         ),
         model_config=model_cfg,
     )
@@ -467,14 +476,39 @@ def decode_bench(layers: int = 28, n_requests: int = 64, prompt_len: int = 128,
 
         t0 = time.perf_counter()
         for i in range(n_requests):
-            eng.submit(f"bench-{i}", make_prompt(), gconfig, cb)
+            kw = {}
+            if tracing:
+                # mirror the server path: one request span, ended on done
+                span = eng._tracer.span("bench.generate", rid=f"bench-{i}")
+                kw["span"] = span
+
+                def cb_traced(r, _s=span):
+                    _s.end()
+                    cb(r)
+
+                eng.submit(f"bench-{i}", make_prompt(), gconfig, cb_traced, **kw)
+            else:
+                eng.submit(f"bench-{i}", make_prompt(), gconfig, cb)
         assert done.wait(1200), "decode bench timed out"
         dt = time.perf_counter() - t0
         total_out = sum(len(r.output_tokens) for r in results)
+        # greedy identity across tracing on/off is the rung's correctness
+        # gate: digest is order-independent (keyed by the prompt)
+        import hashlib
+
+        dig = hashlib.blake2b(digest_size=16)
+        for r in sorted(
+            results, key=lambda r: tuple(r.input_tokens)
+        ):
+            dig.update(np.asarray(r.input_tokens, np.int64).tobytes())
+            dig.update(np.asarray(r.output_tokens, np.int64).tobytes())
+        ttfts = [r.ttft for r in results if r.ttft]
         return {
             "tps": total_out / dt,
             "spec_acceptance_rate": eng.spec_acceptance_rate,
             "spec_steps": eng.spec_steps_total,
+            "ttft_mean_s": float(sum(ttfts) / max(1, len(ttfts))),
+            "output_digest": dig.hexdigest(),
         }
     finally:
         eng.stop()
@@ -1455,6 +1489,72 @@ def main():
             })
         except Exception as e:  # noqa: BLE001
             log(f"spec decode rung failed: {e}")
+
+    # ---- rung 3.25: tracing overhead — the PR 8 observability plane's
+    # cost contract: full per-request tracing (spans + engine events) on
+    # vs off on the same greedy decode workload; greedy output identity
+    # is HARD-asserted across modes (a tokens/s delta measured on
+    # diverging outputs would be meaningless), and the acceptance bar is
+    # <= 3% tokens/s regression with tracing ON. ----
+    if remaining(deadline) > 420:
+        tatt = dict(
+            n_requests=64, batch=32, steps_per_call=16, prompt_len=128,
+            new_tokens=128, greedy=True,
+        )
+        if REHEARSAL:
+            tatt = dict(
+                n_requests=8, batch=4, steps_per_call=4, prompt_len=32,
+                new_tokens=48, vocab=2048, max_seq_len=256, greedy=True,
+            )
+        tatt["layers"] = (used or {"layers": 2 if REHEARSAL else 28})[
+            "layers"
+        ]
+        try:
+            log(f"tracing overhead rung: {tatt}")
+            tr_off = _run_child(
+                "decode", {**tatt, "tracing": False},
+                timeout=min(1200.0, remaining(deadline) - 60),
+            )
+            tr_on = _run_child(
+                "decode", {**tatt, "tracing": True},
+                timeout=min(1200.0, remaining(deadline) - 60),
+            )
+            # hard gate: greedy outputs must be token-identical with
+            # tracing on — tracing must observe the system, never
+            # perturb it
+            assert tr_on["output_digest"] == tr_off["output_digest"], (
+                "tracing changed greedy outputs: "
+                f"{tr_on['output_digest']} != {tr_off['output_digest']}"
+            )
+            ratio = (
+                tr_on["tps"] / tr_off["tps"] if tr_off["tps"] else None
+            )
+            # <=3% tokens/s acceptance bar, hard-gated on real hardware
+            # runs only: CPU-rehearsal decode throughput jitters past 3%
+            # both directions (the recorded rehearsal ratio is 1.031 —
+            # tracing-on measured FASTER), so rehearsal reports the
+            # ratio without gating on noise
+            if not REHEARSAL and ratio is not None:
+                assert ratio >= 0.97, (
+                    "tracing on-cost exceeds the 3% tokens/s bar: "
+                    f"on/off ratio {ratio:.4f}"
+                )
+            emit({
+                "metric": "tracing_overhead",
+                "value": round(tr_on["tps"], 1),
+                "unit": "tokens/s_tracing_on",
+                # >= 0.97 passes the <=3% overhead acceptance bar
+                # (hard-asserted above on non-rehearsal runs)
+                "vs_baseline": round(ratio, 4) if ratio else None,
+                "tracing_off_tokens_per_sec": round(tr_off["tps"], 1),
+                "ttft_on_s": round(tr_on["ttft_mean_s"], 4),
+                "ttft_off_s": round(tr_off["ttft_mean_s"], 4),
+                "greedy_identity": True,
+                "chip": chip,
+                **tatt,
+            })
+        except Exception as e:  # noqa: BLE001
+            log(f"tracing overhead rung failed: {e}")
 
     # ---- rung 3.3: prefix cache — GRPO-shaped (same prompt x group) and
     # multi-turn growing-prefix workloads, cache on vs off. vs_baseline is

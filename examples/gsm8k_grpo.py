@@ -213,6 +213,7 @@ def main(argv=None):
                         config=cfg,
                         rollout=rollout,
                         guard=guard,
+                        profiler=profiler,
                         **recover_kwargs,
                     )
                 logger.warning(

@@ -110,52 +110,57 @@ def main(argv=None):
     data_iter = iter(dataloader)
     losses = []
     profiler = StepProfiler(cfg.profiler)
-    for global_step in range(start_step, total_steps):
-        step_info = StepInfo(
-            epoch=global_step // ft_spec.steps_per_epoch,
-            epoch_step=global_step % ft_spec.steps_per_epoch,
-            global_step=global_step,
-            steps_per_epoch=ft_spec.steps_per_epoch,
-        )
-        try:
-            batch = next(data_iter)
-        except StopIteration:
-            data_iter = iter(dataloader)
-            batch = next(data_iter)
+    try:
+        for global_step in range(start_step, total_steps):
+            step_info = StepInfo(
+                epoch=global_step // ft_spec.steps_per_epoch,
+                epoch_step=global_step % ft_spec.steps_per_epoch,
+                global_step=global_step,
+                steps_per_epoch=ft_spec.steps_per_epoch,
+            )
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                data_iter = iter(dataloader)
+                batch = next(data_iter)
 
-        with profiler.step(global_step), stats_tracker.record_timing(
-            "train_step"
-        ):
-            stats = engine.train_lm(batch)
-            engine.step_lr_scheduler()
-        losses.append(stats["loss"])
+            with profiler.step(global_step), stats_tracker.record_timing(
+                "train_step"
+            ):
+                stats = engine.train_lm(batch)
+                engine.step_lr_scheduler()
+            losses.append(stats["loss"])
 
-        def eval_fn():
-            if valid_loader is None:
-                return
-            vl = [engine.evaluate_lm(vb) for vb in valid_loader]
-            vl = [x for x in vl if x is not None]
-            if vl:
-                stats_tracker.scalar(eval_loss=float(np.mean(vl)))
+            def eval_fn():
+                if valid_loader is None:
+                    return
+                vl = [engine.evaluate_lm(vb) for vb in valid_loader]
+                vl = [x for x in vl if x is not None]
+                if vl:
+                    stats_tracker.scalar(eval_loss=float(np.mean(vl)))
 
-        saver.save(engine, step_info, tokenizer=tokenizer)
-        evaluator.evaluate(eval_fn, step_info)
-        recover_handler.dump(
-            engine,
-            step_info,
-            saver,
-            evaluator,
-            dataloader,
-            slogger,
-            fileroot=cfg.cluster.fileroot,
-            experiment_name=cfg.experiment_name,
-            trial_name=cfg.trial_name,
-            tokenizer=tokenizer,
-            config=cfg,
-        )
-        stats.update(stats_tracker.export())
-        slogger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
+            saver.save(engine, step_info, tokenizer=tokenizer)
+            evaluator.evaluate(eval_fn, step_info)
+            recover_handler.dump(
+                engine,
+                step_info,
+                saver,
+                evaluator,
+                dataloader,
+                slogger,
+                fileroot=cfg.cluster.fileroot,
+                experiment_name=cfg.experiment_name,
+                trial_name=cfg.trial_name,
+                tokenizer=tokenizer,
+                config=cfg,
+            )
+            stats.update(stats_tracker.export())
+            slogger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
 
+    finally:
+        # a capture window that spans the exit (short run, crash,
+        # StopIteration mid-window) must still flush its trace
+        profiler.close()
     logger.info("final loss %.4f (start %.4f)", losses[-1], losses[0])
     slogger.close()
     engine.destroy()

@@ -1,0 +1,19 @@
+"""TN fixture: bounded label values (closed sets) don't flag."""
+
+from areal_tpu.utils import metrics
+
+
+def good(addr, state, outcome_ok):
+    lat = metrics.gauge(
+        "areal_server_latency_seconds", labels=("addr", "quantile")
+    )
+    # fleet addresses are bounded by fleet size; quantiles are literals
+    lat.labels(addr=addr, quantile="p50").set(0.1)
+    lat.labels(addr=addr, quantile="p95").set(0.5)
+    c = metrics.counter("areal_rollouts", labels=("state",))
+    c.labels(state=state).inc()
+    c.labels(state="accepted" if outcome_ok else "rejected").inc()
+    # f-string with no interpolation is just a literal
+    c.labels(state=f"running").inc()  # noqa: F541
+    # label NAMES in the factory are declarations, not values
+    metrics.counter("areal_other_total", labels=("rid",))

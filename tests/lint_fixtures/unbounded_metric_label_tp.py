@@ -1,0 +1,13 @@
+"""TP fixture: per-request identifiers as metric label values."""
+
+from areal_tpu.utils import metrics
+
+
+def bad(rid, user_uuid, req):
+    c = metrics.counter("areal_requests_total", labels=("rid",))
+    c.labels(rid=rid)  # lint-expect: unbounded-metric-label
+    c.labels(rid=f"req-{rid}")  # lint-expect: unbounded-metric-label
+    c.labels(rid="{}".format(rid))  # lint-expect: unbounded-metric-label
+    c.labels(rid=str(req))  # lint-expect: unbounded-metric-label
+    c.labels(rid=user_uuid)  # lint-expect: unbounded-metric-label
+    c.labels(rid=req.trace_id)  # lint-expect: unbounded-metric-label

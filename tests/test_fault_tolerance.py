@@ -1098,3 +1098,56 @@ def test_chaos_off_installs_no_middleware(monkeypatch):
     server = GenerationServer(_StubEngine())
     assert server.chaos is None
     assert len(server.app.middlewares) == 0
+
+
+# ---------------------------------------------------------------------------
+# health-window observability (PR 8 satellite): the per-address latency /
+# throughput windows surface beyond routing — percentiles in snapshot(),
+# a one-line fleet summary, and a metrics-registry collector
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_latency_percentiles_and_fleet_summary():
+    clk = FakeClock()
+    tracker = ServerHealthTracker(
+        CircuitBreakerConfig(enabled=True, window_seconds=60.0), clock=clk
+    )
+    for i in range(1, 20):  # latencies 10ms..190ms
+        tracker.on_request_end("s:1", ok=True, latency=i * 0.01)
+    tracker.on_request_end("s:1", ok=False, error="x")
+    tracker.on_request_end("s:2", ok=True, latency=1.0)
+    snap = tracker.snapshot()
+    s1 = snap["s:1"]
+    assert s1["window_latency_p50"] == pytest.approx(0.10, abs=0.02)
+    assert s1["window_latency_p95"] == pytest.approx(0.18, abs=0.02)
+    assert s1["window_requests"] == 20
+    assert s1["window_failure_rate"] == pytest.approx(1 / 20)
+    assert s1["window_requests_per_sec"] == pytest.approx(20 / 60.0)
+    # single-sample and empty windows don't divide by zero
+    assert snap["s:2"]["window_latency_p50"] == 1.0
+    line = tracker.fleet_summary()
+    assert "s:1[" in line and "p95=" in line and "rps=" in line
+    # expired entries leave the window before the percentile math
+    clk.now += 120.0
+    assert tracker.snapshot()["s:1"]["window_requests"] == 0
+
+
+def test_health_export_metrics_collector():
+    from areal_tpu.utils.metrics import MetricsRegistry
+
+    tracker = ServerHealthTracker(
+        CircuitBreakerConfig(enabled=True), clock=FakeClock()
+    )
+    tracker.on_request_end("s:1", ok=True, latency=0.25)
+    reg = MetricsRegistry()
+    tracker.export_metrics(reg)
+    out = reg.export_scalars()
+    assert out["areal_server_latency_seconds{addr=s:1,quantile=p50}"] == (
+        pytest.approx(0.25)
+    )
+    assert out["areal_server_breaker_open{addr=s:1}"] == 0.0
+    # trip the breaker; the gauge follows on the next collection
+    for _ in range(5):
+        tracker.on_request_end("s:1", ok=False, error="down")
+    tracker.export_metrics(reg)
+    assert reg.export_scalars()["areal_server_breaker_open{addr=s:1}"] == 1.0

@@ -1,0 +1,158 @@
+"""Crash flight recorder (PR 8): bounded per-subsystem rings, atomic
+dumps on the three death paths (watchdog timeout, InjectedCrash,
+SIGTERM/graceful drain), and the breaker/commit event feeds."""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.api.cli_args import CircuitBreakerConfig, WatchdogConfig
+from areal_tpu.core.fault_tolerance import OPEN, ServerHealthTracker
+from areal_tpu.utils import chaos, flight_recorder
+from areal_tpu.utils.flight_recorder import DEFAULT_RECORDER, FlightRecorder
+from areal_tpu.utils.watchdog import Watchdog
+
+
+def test_rings_are_bounded_and_snapshot_structured():
+    clk = [100.0]
+    fr = FlightRecorder(capacity=4, clock=lambda: clk[0])
+    for i in range(10):
+        clk[0] += 1
+        fr.record("requests", "dispatch", rid=f"r{i}")
+    fr.record("commits", "staged_commit", version=3)
+    snap = fr.snapshot()
+    assert len(snap["channels"]["requests"]) == 4  # ring evicted oldest
+    assert snap["channels"]["requests"][0]["rid"] == "r6"
+    assert snap["channels"]["commits"][0]["kind"] == "staged_commit"
+    assert snap["events_recorded"] == 11
+    # explicit capacity applies on first creation only
+    fr.channel("big", capacity=100)
+    assert fr.channel("big", capacity=5).maxlen == 100
+
+
+def test_dump_atomic_json(tmp_path):
+    fr = FlightRecorder()
+    fr.record("breaker", "transition", addr="a:1", old="closed", new="open")
+    path = str(tmp_path / "dump.json")
+    out = fr.dump("test", path=path)
+    assert out == path
+    data = json.loads(open(path).read())
+    assert data["reason"] == "test"
+    assert data["channels"]["breaker"][0]["addr"] == "a:1"
+    assert not os.path.exists(path + ".tmp")
+    # dump failure is swallowed (best-effort by contract)
+    assert fr.dump("bad", path="/nonexistent-dir/x/y.json") is None
+
+
+def test_watchdog_fire_dumps_flight_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        flight_recorder.DUMP_DIR_ENV, str(tmp_path / "wd")
+    )
+    DEFAULT_RECORDER.reset()
+    DEFAULT_RECORDER._dump_dir = None
+    flight_recorder.record("requests", "dispatch", n=1)
+    clk = [0.0]
+    exits = []
+    wd = Watchdog(
+        WatchdogConfig(enabled=True, timeout_seconds=10.0),
+        clock=lambda: clk[0],
+        exit_fn=exits.append,
+    )
+    wd.beat("train")
+    clk[0] = 5.0
+    assert not wd.check()
+    clk[0] = 20.0
+    assert wd.check()
+    assert exits == [43]
+    dumps = os.listdir(tmp_path / "wd")
+    assert len(dumps) == 1 and dumps[0].startswith("flight_watchdog")
+    data = json.loads(open(tmp_path / "wd" / dumps[0]).read())
+    assert data["channels"]["requests"][0]["n"] == 1
+
+
+def test_injected_crash_dumps_flight_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        flight_recorder.DUMP_DIR_ENV, str(tmp_path / "ic")
+    )
+    monkeypatch.setenv(chaos.CRASH_ENV, "post-train-step")
+    DEFAULT_RECORDER.reset()
+    DEFAULT_RECORDER._dump_dir = None
+    chaos.reset_crash_points()
+    flight_recorder.record("commits", "staged_commit", version=9)
+    chaos.crash_point("pre-weight-update")  # not armed: no crash, no dump
+    assert not os.path.exists(tmp_path / "ic")
+    with pytest.raises(chaos.InjectedCrash):
+        chaos.crash_point("post-train-step")
+    chaos.reset_crash_points()
+    dumps = os.listdir(tmp_path / "ic")
+    assert len(dumps) == 1
+    data = json.loads(open(tmp_path / "ic" / dumps[0]).read())
+    assert data["reason"].startswith("injected_crash")
+    assert data["channels"]["commits"][0]["version"] == 9
+
+
+def test_breaker_transitions_feed_recorder():
+    DEFAULT_RECORDER.reset()
+    tracker = ServerHealthTracker(
+        CircuitBreakerConfig(enabled=True, failure_threshold=2),
+        clock=lambda: 0.0,
+    )
+    tracker.on_request_end("s:1", ok=False, error="boom")
+    tracker.on_request_end("s:1", ok=False, error="boom")
+    assert tracker.state("s:1") == OPEN
+    events = list(DEFAULT_RECORDER.channel("breaker"))
+    assert any(
+        e["addr"] == "s:1" and e["new"] == "open" for e in events
+    )
+    # rejoin path records too
+    tracker.on_probe_result("s:1", ok=True)
+    events = list(DEFAULT_RECORDER.channel("breaker"))
+    assert any(e["new"] == "half_open" for e in events)
+    tracker.on_request_end("s:1", ok=True, latency=0.1)
+    events = list(DEFAULT_RECORDER.channel("breaker"))
+    assert any(e["new"] == "closed" for e in events)
+
+
+def test_graceful_shutdown_dumps_recorder(tmp_path, monkeypatch):
+    """The SIGTERM path: RecoverHandler.graceful_shutdown leaves a flight
+    dump even when there is no rollout plane attached."""
+    from areal_tpu.api.cli_args import RecoverConfig
+    from areal_tpu.api.io_struct import StepInfo
+    from areal_tpu.utils.recover import RecoverHandler
+
+    monkeypatch.setenv(
+        flight_recorder.DUMP_DIR_ENV, str(tmp_path / "st")
+    )
+    DEFAULT_RECORDER.reset()
+    DEFAULT_RECORDER._dump_dir = None
+    flight_recorder.record("requests", "dispatch", rid="last")
+
+    class _Eng:
+        def state_dict(self):
+            return {}
+
+        def save(self, *a, **k):
+            pass
+
+        def get_version(self):
+            return 0
+
+    handler = RecoverHandler(RecoverConfig(mode="auto"))
+    closed = []
+
+    class _Prof:
+        def close(self):
+            closed.append(1)
+
+    handler.graceful_shutdown(
+        _Eng(),
+        StepInfo(epoch=0, epoch_step=0, global_step=0, steps_per_epoch=1),
+        fileroot=str(tmp_path),
+        experiment_name="e",
+        trial_name="t",
+        profiler=_Prof(),
+    )
+    assert closed == [1], "graceful shutdown must close the profiler"
+    dumps = os.listdir(tmp_path / "st")
+    assert len(dumps) == 1 and dumps[0].startswith("flight_sigterm")

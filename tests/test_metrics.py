@@ -1,0 +1,394 @@
+"""Unified metrics registry (PR 8): instrument semantics, Prometheus text
+exposition, the label-cardinality guard, /metrics <-> /model_info
+agreement on a live server, and the StatsLogger periodic export."""
+
+import asyncio
+import json
+import math
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    JaxGenConfig,
+    MetricsConfig,
+    StatsLoggerConfig,
+)
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils import metrics
+from areal_tpu.utils.metrics import (
+    DEFAULT_REGISTRY,
+    OVERFLOW_LABEL,
+    MetricsRegistry,
+    parse_prometheus_text,
+)
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_semantics():
+    r = MetricsRegistry()
+    c = r.counter("areal_t_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("areal_g", labels=("k",))
+    g.labels(k="a").set(5)
+    g.labels(k="a").inc(2)
+    g.labels(k="b").dec(1)
+    assert g.labels(k="a").value == 7
+    assert g.labels(k="b").value == -1
+    # get-or-create is idempotent; type/label conflicts raise
+    assert r.counter("areal_t_total") is c
+    with pytest.raises(ValueError):
+        r.gauge("areal_t_total")
+    with pytest.raises(ValueError):
+        r.counter("areal_t_total", labels=("x",))
+    with pytest.raises(ValueError):
+        r.counter("bad name!")
+    with pytest.raises(ValueError):
+        r.counter("areal_x", labels=("bad-label",))
+
+
+def test_histogram_buckets_and_quantiles():
+    r = MetricsRegistry()
+    h = r.histogram("areal_lat_seconds", buckets=(0.01, 0.1, 1.0, 10.0))
+    for v in [0.005] * 50 + [0.05] * 40 + [5.0] * 10:
+        h.observe(v)
+    # p50 lands in the first bucket, p90 in the second, p95+ in the last
+    assert h.quantile(0.50) <= 0.01
+    assert 0.01 <= h.quantile(0.90) <= 0.1
+    assert 1.0 <= h.quantile(0.95) <= 10.0
+    assert 1.0 <= h.quantile(0.99) <= 10.0
+    assert h._solo().count == 100
+    text = r.render_prometheus()
+    parsed = parse_prometheus_text(text)
+    assert parsed['areal_lat_seconds_bucket{le="0.01"}'] == 50
+    assert parsed['areal_lat_seconds_bucket{le="0.1"}'] == 90
+    assert parsed['areal_lat_seconds_bucket{le="+Inf"}'] == 100
+    assert parsed["areal_lat_seconds_count"] == 100
+
+
+def test_histogram_quantile_overflow_surfaced():
+    """quantile() caps estimates at the largest finite bucket (the
+    Prometheus histogram_quantile convention); the scalar export says
+    how many observations lie past it, so a capped p99 of 1.0s is
+    distinguishable from a true 1.0s tail."""
+    r = MetricsRegistry()
+    h = r.histogram("areal_slow_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    out = r.export_scalars()
+    assert "areal_slow_seconds/overflow_count" not in out  # no overflow yet
+    for _ in range(10):
+        h.observe(600.0)  # far beyond the largest finite bucket
+    assert h.quantile(0.99) == 1.0  # capped, NOT 600
+    assert h.quantile(0.50) == 1.0  # >half the mass is past the cap
+    out = r.export_scalars()
+    assert out["areal_slow_seconds/overflow_count"] == 10.0
+    assert out["areal_slow_seconds/p99"] == 1.0
+
+
+def test_label_cardinality_guard_coalesces_rid_like_values():
+    """The runtime half of the unbounded-metric-label defense: past the
+    cap, new label values collapse into one __overflow__ series instead
+    of growing the registry per rid."""
+    r = MetricsRegistry(max_label_values=8)
+    c = r.counter("areal_reqs_total", labels=("rid",))
+    for i in range(1000):
+        c.labels(rid=f"rid-{i}").inc()  # arealint: disable=unbounded-metric-label
+    children = c.children()
+    assert len(children) <= 9  # 8 + the overflow series
+    assert (OVERFLOW_LABEL,) in children
+    # nothing was lost: total across series == total increments
+    assert sum(ch.value for ch in children.values()) == 1000
+    # bounded values keep their own series
+    g = r.gauge("areal_state", labels=("state",))
+    g.labels(state="open").set(1)
+    g.labels(state="closed").set(0)
+    assert len(g.children()) == 2
+
+
+def test_render_prometheus_escapes_and_parses():
+    r = MetricsRegistry()
+    g = r.gauge("areal_esc", labels=("k",))
+    g.labels(k='we"ird\\va\nlue').set(1)
+    text = r.render_prometheus()
+    parsed = parse_prometheus_text(text)
+    assert any(v == 1.0 for v in parsed.values())
+    with pytest.raises(ValueError):
+        parse_prometheus_text("garbled{\n")
+
+
+def test_collectors_run_at_export_and_unregister():
+    r = MetricsRegistry()
+    calls = []
+
+    def collect(reg):
+        calls.append(1)
+        reg.gauge("areal_live").set(42)
+
+    h = r.register_collector(collect)
+    assert r.export_scalars()["areal_live"] == 42
+    r.render_prometheus()
+    assert len(calls) == 2
+    r.unregister_collector(h)
+    r.render_prometheus()
+    assert len(calls) == 2
+    # a sick collector must not kill the scrape
+    r.register_collector(lambda reg: 1 / 0)
+    assert "areal_live" in r.export_scalars()
+
+
+def test_export_scalars_histogram_quantiles():
+    r = MetricsRegistry()
+    h = r.histogram("areal_q_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    out = r.export_scalars(prefix="metrics/")
+    assert out["metrics/areal_q_seconds/count"] == 2
+    assert out["metrics/areal_q_seconds/p50"] > 0
+    assert "metrics/areal_q_seconds/p99" in out
+
+
+# ---------------------------------------------------------------------------
+# /metrics on the live server agrees with /model_info
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=4,
+            max_seq_len=512,
+            prefill_chunk=64,
+            decode_steps_per_call=2,
+            dtype="float32",
+        ),
+        model_config=cfg,
+        params=params,
+    )
+
+
+def test_metrics_endpoint_agrees_with_model_info():
+    # a dedicated registry epoch: drop collectors left by earlier tests'
+    # components so this engine's collector is the only writer
+    DEFAULT_REGISTRY.reset()
+    engine = _tiny_engine()
+    server = GenerationServer(engine)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=60)
+        addr = f"127.0.0.1:{port}"
+
+        def post_generate():
+            req = urllib.request.Request(
+                f"http://{addr}/generate",
+                data=json.dumps(
+                    {
+                        "rid": "m1",
+                        "input_ids": [1, 2, 3, 4],
+                        "sampling_params": {
+                            "max_new_tokens": 8,
+                            "min_new_tokens": 8,
+                            "temperature": 1.0,
+                        },
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        out = post_generate()
+        assert len(out["output_tokens"]) == 8
+        # engine idle now: both endpoints read stable counters
+        info = json.loads(
+            urllib.request.urlopen(
+                f"http://{addr}/model_info", timeout=30
+            ).read()
+        )
+        text = urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=30
+        ).read().decode()
+        parsed = parse_prometheus_text(text)  # parses as Prometheus text
+        checked = 0
+        for k, v in info.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            series = f'areal_serving{{key="{k}"}}'
+            if series not in parsed:
+                continue
+            assert parsed[series] == pytest.approx(float(v)), k
+            checked += 1
+        assert checked >= 15, "scrape barely overlapped /model_info"
+        # the TTFT/ITL histograms observed this request
+        assert parsed["areal_ttft_seconds_count"] >= 1
+        assert parsed["areal_inter_token_seconds_count"] >= 7
+        # generated tokens agree exactly
+        assert (
+            parsed['areal_serving{key="generated_tokens_total"}']
+            == info["generated_tokens_total"]
+        )
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+
+def test_engine_stop_unregisters_collector():
+    DEFAULT_REGISTRY.reset()
+    engine = _tiny_engine()
+    engine.start()
+    try:
+        assert len(DEFAULT_REGISTRY._collectors) == 1
+    finally:
+        engine.stop()
+    assert len(DEFAULT_REGISTRY._collectors) == 0
+
+
+# ---------------------------------------------------------------------------
+# StatsLogger periodic export
+# ---------------------------------------------------------------------------
+
+
+def test_stats_logger_merges_registry_export(tmp_path):
+    DEFAULT_REGISTRY.reset()
+    from areal_tpu.utils.stats_logger import StatsLogger
+
+    DEFAULT_REGISTRY.counter("areal_demo_total").inc(7)
+    cfg = StatsLoggerConfig(
+        experiment_name="exp",
+        trial_name="t0",
+        fileroot=str(tmp_path),
+        metrics=MetricsConfig(enabled=True, stats_logger_prefix="metrics/"),
+    )
+    logger = StatsLogger(cfg, rank=0)
+    logger.commit(0, 0, 0, {"loss": 1.0})
+    logger.close()
+    rows = [
+        json.loads(x)
+        for x in open(
+            f"{tmp_path}/exp/t0/logs/stats.jsonl"
+        ).read().splitlines()
+    ]
+    assert rows[0]["loss"] == 1.0
+    assert rows[0]["metrics/areal_demo_total"] == 7.0
+    # export disabled: no registry keys in the row
+    cfg2 = StatsLoggerConfig(
+        experiment_name="exp",
+        trial_name="t1",
+        fileroot=str(tmp_path),
+        metrics=MetricsConfig(enabled=False),
+    )
+    logger2 = StatsLogger(cfg2, rank=0)
+    logger2.commit(0, 0, 0, {"loss": 2.0})
+    logger2.close()
+    rows2 = [
+        json.loads(x)
+        for x in open(
+            f"{tmp_path}/exp/t1/logs/stats.jsonl"
+        ).read().splitlines()
+    ]
+    assert "metrics/areal_demo_total" not in rows2[0]
+
+
+def test_max_label_values_knob_retunes_existing_metrics(tmp_path):
+    """MetricsConfig.max_label_values must reach the process-global
+    registry — including metrics created at import time, BEFORE config
+    lands (the knob was once silently dead)."""
+    DEFAULT_REGISTRY.reset()
+    from areal_tpu.utils.stats_logger import StatsLogger
+
+    pre = DEFAULT_REGISTRY.counter("areal_precfg_total", labels=("k",))
+    cfg = StatsLoggerConfig(
+        experiment_name="exp",
+        trial_name="t2",
+        fileroot=str(tmp_path),
+        metrics=MetricsConfig(enabled=True, max_label_values=2),
+    )
+    logger = StatsLogger(cfg, rank=1)  # rank != 0: no backends needed
+    assert DEFAULT_REGISTRY.max_label_values == 2
+    for v in ("a", "b", "c", "d"):
+        pre.labels(k=v).inc()
+    children = set(pre.children().keys())
+    assert (OVERFLOW_LABEL,) in children  # capped at 2, not the default 128
+    assert len(children) == 3  # a, b, __overflow__
+    logger.close()
+
+
+def test_gauge_inc_dec_thread_safe():
+    """The docstring promises thread safety; gauge inc/dec is the natural
+    in-flight up/down pattern, so the read-modify-write must be locked
+    (counters already were)."""
+    DEFAULT_REGISTRY.reset()
+    g = DEFAULT_REGISTRY.gauge("areal_inflight_demo")
+
+    def spin(n):
+        for _ in range(n):
+            g.inc()
+            g.dec()
+        g.inc(n)
+
+    threads = [threading.Thread(target=spin, args=(2000,)) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.labels().value == 4 * 2000
+
+
+def test_coresident_executors_keep_distinct_rollout_series():
+    """Two live WorkflowExecutors in one process (rollout + eval) must not
+    overwrite each other's areal_rollouts gauges: each collector writes
+    its own instance-labelled series."""
+    from areal_tpu.api.cli_args import InferenceEngineConfig
+    from areal_tpu.core.workflow_executor import WorkflowExecutor
+
+    DEFAULT_REGISTRY.reset()
+
+    class _NullEngine:
+        pass
+
+    cfg = InferenceEngineConfig(max_concurrent_rollouts=4, consumer_batch_size=2)
+    ex1 = WorkflowExecutor(cfg, _NullEngine())
+    ex2 = WorkflowExecutor(cfg, _NullEngine())
+    ex1.initialize()
+    ex2.initialize()
+    try:
+        ex1.staleness_manager.on_rollout_submitted()
+        out = DEFAULT_REGISTRY.export_scalars()
+        submitted = {
+            k: v
+            for k, v in out.items()
+            if k.startswith("areal_rollouts") and "state=submitted" in k
+        }
+        # two distinct series, one per executor — values don't mask each other
+        assert len(submitted) == 2, submitted
+        assert sorted(submitted.values()) == [0.0, 1.0], submitted
+    finally:
+        ex1.destroy()
+        ex2.destroy()

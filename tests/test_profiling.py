@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from areal_tpu.api.cli_args import ProfilerConfig
 from areal_tpu.utils.profiling import StepProfiler
 
@@ -30,3 +32,53 @@ def test_capture_window(tmp_path):
     for root, _dirs, files in os.walk(cfg.dir):
         found.extend(files)
     assert found, "no profiler artifacts written"
+
+
+def test_close_finalizes_midwindow_capture(tmp_path):
+    """The leak fix: a loop that exits INSIDE the capture window (crash,
+    drain, short run) must still flush the trace via close() — before
+    this, stop_trace was only reachable at start_step + num_steps."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = ProfilerConfig(
+        enabled=True, dir=str(tmp_path / "prof"), start_step=0, num_steps=100
+    )
+    p = StepProfiler(cfg)
+    with p.step(0):
+        jnp.sum(jnp.ones(16)).block_until_ready()
+    assert p._active, "capture window should still be open"
+    p.close()
+    assert not p._active
+    p.close()  # idempotent
+    found = []
+    for root, _dirs, files in os.walk(cfg.dir):
+        found.extend(files)
+    assert found, "close() lost the in-flight capture"
+    # and capture can start again afterwards (no wedged profiler state)
+    p2 = StepProfiler(
+        ProfilerConfig(
+            enabled=True, dir=str(tmp_path / "p2"), start_step=0, num_steps=1
+        )
+    )
+    with p2.step(0):
+        jnp.sum(jnp.ones(16)).block_until_ready()
+    p2.close()
+
+
+def test_context_manager_closes_on_exception(tmp_path):
+    import jax.numpy as jnp
+
+    cfg = ProfilerConfig(
+        enabled=True, dir=str(tmp_path / "prof"), start_step=0, num_steps=100
+    )
+    with pytest.raises(RuntimeError):
+        with StepProfiler(cfg) as p:
+            with p.step(0):
+                jnp.sum(jnp.ones(16)).block_until_ready()
+            raise RuntimeError("train step died")
+    assert not p._active
+    found = []
+    for root, _dirs, files in os.walk(cfg.dir):
+        found.extend(files)
+    assert found, "exception path lost the capture"

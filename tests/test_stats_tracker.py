@@ -75,3 +75,117 @@ def test_missing_denominator_raises():
     t = StatsTracker()
     with pytest.raises(ValueError):
         t.stat("nope", v=np.ones(2))
+
+
+# ---------------------------------------------------------------------------
+# concurrency (PR 8 satellite): threaded scope/denominator correctness and
+# the StatsLogger reopen-dedup x periodic-metrics-export interaction
+# ---------------------------------------------------------------------------
+
+
+def test_threaded_scopes_do_not_bleed():
+    """Scopes are thread-local: N threads each recording under their own
+    scope must produce exactly their own keys, with denominators and
+    masked stats paired correctly per thread."""
+    import threading
+
+    tracker = StatsTracker()
+    n_threads, n_iters = 8, 50
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(n_iters):
+                with tracker.scope(f"w{tid}"):
+                    mask = np.ones(4, dtype=bool)
+                    tracker.denominator(tokens=mask)
+                    tracker.stat(
+                        "tokens", values=np.full(4, float(tid))
+                    )
+                    tracker.scalar(steps=1.0)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    out = tracker.export()
+    for tid in range(n_threads):
+        # each thread's masked mean is its own id — a cross-thread scope
+        # bleed would mix values or pair a stat with another denominator
+        assert out[f"w{tid}/values/avg"] == pytest.approx(float(tid))
+        assert out[f"w{tid}/tokens"] == 4 * n_iters
+        assert out[f"w{tid}/steps"] == pytest.approx(1.0)
+    # no keys beyond the scoped ones leaked
+    assert all(k.split("/")[0].startswith("w") for k in out)
+
+
+def test_threaded_scalar_and_timing_accumulation():
+    import threading
+
+    tracker = StatsTracker()
+
+    def worker():
+        for _ in range(100):
+            tracker.scalar(hits=1.0)
+            with tracker.record_timing("noop"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = tracker.export()
+    # scalars average; the denominatorless count is len-correct via mean
+    assert out["hits"] == pytest.approx(1.0)
+    assert out["time_perf/noop"] >= 0.0
+
+
+def test_stats_logger_reopen_dedup_with_metrics_export(tmp_path):
+    """Resume dedup and the periodic registry export interact correctly:
+    a replayed step is skipped WITHOUT writing its metrics row again, and
+    the post-resume step carries the registry's cumulative values."""
+    import json
+
+    from areal_tpu.api.cli_args import MetricsConfig, StatsLoggerConfig
+    from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+    from areal_tpu.utils.stats_logger import StatsLogger
+
+    DEFAULT_REGISTRY.reset()
+    c = DEFAULT_REGISTRY.counter("areal_steps_total")
+    cfg = StatsLoggerConfig(
+        experiment_name="exp",
+        trial_name="dedup",
+        fileroot=str(tmp_path),
+        metrics=MetricsConfig(enabled=True, stats_logger_prefix="metrics/"),
+    )
+    logger = StatsLogger(cfg, rank=0)
+    c.inc()
+    logger.commit(0, 0, 0, {"loss": 1.0})
+    c.inc()
+    logger.commit(0, 1, 1, {"loss": 0.9})
+    state = logger.state_dict()
+    logger.close()
+
+    # "crash", reopen, recover: replay of step 1 is skipped entirely
+    logger2 = StatsLogger(cfg, rank=0)
+    logger2.load_state_dict(state)
+    c.inc()
+    logger2.commit(0, 1, 1, {"loss": 0.9})  # replay: must dedup
+    logger2.commit(0, 2, 2, {"loss": 0.8})
+    logger2.close()
+
+    path = f"{tmp_path}/exp/dedup/logs/stats.jsonl"
+    rows = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [r["global_step"] for r in rows] == [0, 1, 2]
+    # counters are cumulative: the skipped replay lost nothing; step 2
+    # reads the registry's CURRENT value
+    assert rows[0]["metrics/areal_steps_total"] == 1.0
+    assert rows[1]["metrics/areal_steps_total"] == 2.0
+    assert rows[2]["metrics/areal_steps_total"] == 3.0

@@ -1,0 +1,645 @@
+"""Distributed rollout tracing (PR 8 tentpole).
+
+The contract under test:
+
+- **Span model**: parentage, header propagation (``x-areal-trace``),
+  bounded buffers, injectable clocks, event caps.
+- **Perfetto export**: :func:`chrome_trace` round-trips through JSON and
+  :func:`spans_from_chrome_trace` losslessly for ids / names / events.
+- **Zero cost off**: ``Tracer.from_config`` returns None when disabled,
+  and a code-inspection test (the PR 3 chaos-hook discipline) pins that
+  every span use on the request hot path sits under an ``is not None``
+  guard — tracing off allocates nothing; the token-level ``_emit_token``
+  loop contains no tracing references at all.
+- **End to end** (the acceptance scenario): one chaos-injected rollout —
+  failover re-dispatch mid-generation across a staged weight commit —
+  produces a SINGLE connected trace: the client's generate span links to
+  server spans on both the failed and the failover server, the
+  ``weight_commit`` event lands inside the failover server's generation
+  span, and the merged trace survives the Perfetto round-trip.
+"""
+
+import ast
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxGenConfig,
+    TracingConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils import tracing
+from areal_tpu.utils.chaos import ChaosPolicy
+from areal_tpu.utils.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    chrome_trace,
+    parse_trace_header,
+    spans_from_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# unit: span model
+# ---------------------------------------------------------------------------
+
+
+def test_span_parentage_and_header():
+    t = Tracer()
+    root = t.span("rollout", rid="7")
+    child = t.span("generate", parent=root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    parsed = parse_trace_header(child.header())
+    assert parsed == (child.trace_id, child.span_id)
+    # header continuation on another tracer (the server side)
+    server = Tracer(service="srv")
+    srv_span = server.span_from_header(child.header(), "server.generate")
+    assert srv_span.trace_id == root.trace_id
+    assert srv_span.parent_id == child.span_id
+    # garbled/missing headers root a fresh trace instead of failing
+    assert parse_trace_header(None) is None
+    assert parse_trace_header("nonsense") is None
+    fresh = server.span_from_header("bad::header", "server.generate")
+    assert fresh.parent_id is None
+
+
+def test_finished_buffer_is_bounded_and_events_capped():
+    clk = [0.0]
+    t = Tracer(max_spans=4, max_events_per_span=3, clock=lambda: clk[0])
+    for i in range(10):
+        sp = t.span(f"s{i}")
+        clk[0] += 1.0
+        sp.end()
+    spans = t.finished_spans()
+    assert len(spans) == 4  # ring evicted the oldest
+    assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+    sp = t.span("evts")
+    for i in range(10):
+        sp.event("e", i=i)
+    sp.end()
+    assert len(t.finished_spans()[-1]["events"]) == 3
+    assert t.events_dropped == 7
+
+
+def test_span_context_manager_records_error_and_ends_once():
+    t = Tracer()
+    with pytest.raises(ValueError):
+        with t.span("boom") as sp:
+            raise ValueError("x")
+    d = t.finished_spans()[0]
+    assert "error" in d["attrs"]
+    sp.end()  # idempotent: no double-finish
+    assert len(t.finished_spans()) == 1
+
+
+def test_export_jsonl_and_drain(tmp_path):
+    p = str(tmp_path / "trace.jsonl")
+    t = Tracer(export_path=p)
+    t.span("a").end()
+    t.span("b").end()
+    lines = [json.loads(x) for x in open(p).read().splitlines()]
+    assert [x["name"] for x in lines] == ["a", "b"]
+    assert len(t.drain()) == 2
+    assert t.finished_spans() == []
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace_event round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_round_trips():
+    t = Tracer(service="client")
+    root = t.span("rollout", rid="1")
+    gen = t.span("generate", parent=root, rid="1")
+    gen.event("dispatch", addr="a:1", replay=0)
+    gen.event("failover", failed_addr="a:1", replay=3)
+    gen.end()
+    root.end()
+    srv = Tracer(service="server-b")
+    s = srv.span_from_header(gen.header(), "server.generate", rid="1")
+    s.event("weight_commit", version=2)
+    s.end()
+    merged = t.finished_spans() + srv.finished_spans()
+    trace = chrome_trace(merged)
+    # the export is genuine JSON (what Perfetto loads)
+    back = spans_from_chrome_trace(json.loads(json.dumps(trace)))
+    by_id = {x["span_id"]: x for x in back}
+    assert set(by_id) == {x["span_id"] for x in merged}
+    for orig in merged:
+        got = by_id[orig["span_id"]]
+        assert got["name"] == orig["name"]
+        assert got["trace_id"] == orig["trace_id"]
+        assert got["parent_id"] == orig["parent_id"]
+        assert got["attrs"]["service"] == orig["attrs"]["service"]
+        assert [e["name"] for e in got["events"]] == [
+            e["name"] for e in orig["events"]
+        ]
+        # durations survive to microsecond precision
+        dur_o = (orig["t_end"] - orig["t_start"])
+        dur_g = (got["t_end"] - got["t_start"])
+        assert abs(dur_o - dur_g) < 1e-5
+    # a second export of the reconstruction is stable (no drift)
+    again = chrome_trace(back)
+    x_orig = sorted(
+        (e["name"], e["args"].get("span_id"))
+        for e in trace["traceEvents"]
+        if e["ph"] == "X"
+    )
+    x_back = sorted(
+        (e["name"], e["args"].get("span_id"))
+        for e in again["traceEvents"]
+        if e["ph"] == "X"
+    )
+    assert x_orig == x_back
+
+
+def test_chrome_trace_round_trips_start_time_base():
+    """time_base='start' anchors spans at the monotonic clock instead of
+    wall time; event offsets must reconstruct against the emitted base —
+    whichever it was — so events land inside their own span in both
+    modes (monotonic and epoch-wall bases differ by decades)."""
+    t = Tracer(service="client")
+    s = t.span("generate", rid="1")
+    s.event("dispatch", addr="a:1")
+    s.end()
+    for time_base in ("wall", "start"):
+        back = spans_from_chrome_trace(
+            chrome_trace(t.finished_spans(), time_base=time_base)
+        )
+        (got,) = back
+        (ev,) = got["events"]
+        assert got["t_start"] - 1e-6 <= ev["t"] <= got["t_end"] + 1e-6, (
+            f"time_base={time_base}: event at {ev['t']} outside span "
+            f"[{got['t_start']}, {got['t_end']}]"
+        )
+
+
+def test_executor_closes_only_self_created_tracer(tmp_path):
+    """destroy() releases the export handle of a tracer the executor
+    built itself (the tracer=None path) but leaves a caller-supplied
+    tracer to its owner."""
+    from areal_tpu.core.workflow_executor import WorkflowExecutor
+
+    cfg = InferenceEngineConfig(
+        consumer_batch_size=2,
+        max_head_offpolicyness=100,
+        tracing=TracingConfig(
+            enabled=True, export_path=str(tmp_path / "self.jsonl")
+        ),
+    )
+    ex = WorkflowExecutor(cfg, inference_engine=None)
+    assert ex._owns_tracer and ex._tracer is not None
+    ex._tracer.span("rollout").end()  # opens the persistent handle
+    assert ex._tracer._export_fh is not None
+    ex.destroy()
+    assert ex._tracer._export_fh is None
+
+    own = Tracer(service="client", export_path=str(tmp_path / "own.jsonl"))
+    ex2 = WorkflowExecutor(cfg, inference_engine=None, tracer=own)
+    assert not ex2._owns_tracer
+    own.span("rollout").end()
+    ex2.destroy()
+    assert own._export_fh is not None  # caller-owned: untouched
+    own.close()
+
+
+# ---------------------------------------------------------------------------
+# zero cost when off
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_off_constructs_nothing():
+    assert Tracer.from_config(None) is None
+    assert Tracer.from_config(TracingConfig(enabled=False)) is None
+    assert Tracer.from_config(TracingConfig(enabled=True)) is not None
+    eng = RemoteInfEngine(InferenceEngineConfig())
+    assert eng._tracer is None
+    assert eng.executor._tracer is None
+
+
+def _parent_chains(fn):
+    parent_of = {}
+    for p in ast.walk(fn):
+        for c in ast.iter_child_nodes(p):
+            parent_of[c] = p
+
+    def parents(n):
+        while n in parent_of:
+            n = parent_of[n]
+            yield n
+
+    return parents
+
+
+def _span_guarded(node, parents) -> bool:
+    """Is ``node`` inside an ``if <span> is not None`` arm (or the guard
+    test itself)?"""
+    for p in parents(node):
+        if isinstance(p, ast.If):
+            t = ast.dump(p.test)
+            if "IsNot" in t and "span" in t:
+                return True
+    return False
+
+
+def _find_fn(tree, name):
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if n.name == name:
+                return n
+    raise AssertionError(f"function {name} not found")
+
+
+def test_hot_path_span_uses_are_guarded_code_inspection():
+    """Chaos-hook discipline for tracing: on the request hot path, every
+    span method call (event/set/end/header) on a span-valued expression
+    must sit under an ``is not None`` guard, so tracing off performs no
+    allocation; and the token-level ``_emit_token`` loop must contain no
+    tracing reference at all."""
+    import areal_tpu.core.remote_inf_engine as rie
+    import areal_tpu.inference.engine as eng_mod
+    import areal_tpu.inference.server as srv_mod
+
+    targets = [
+        (eng_mod, "_admit"),
+        (eng_mod, "_advance_warming"),
+        (eng_mod, "_try_radix"),
+        (eng_mod, "_prefill_seqs"),
+        (eng_mod, "_decode_chunk"),
+        (eng_mod, "_try_spec_decode_chunk"),
+        (eng_mod, "_drain_commands"),
+        (rie, "_agenerate_impl"),
+        (srv_mod, "generate"),
+    ]
+    span_methods = {"event", "set", "end", "header"}
+    for mod, fname in targets:
+        tree = ast.parse(open(mod.__file__).read())
+        fn = _find_fn(tree, fname)
+        parents = _parent_chains(fn)
+        offenders = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in span_methods:
+                continue
+            if "span" not in ast.dump(node.func.value):
+                continue
+            if not _span_guarded(node, parents):
+                offenders.append(node.lineno)
+        assert not offenders, (
+            f"{mod.__name__}.{fname}: unguarded span calls at lines "
+            f"{offenders} — tracing off must cost only an `is not None` "
+            "check on the hot path"
+        )
+    # the per-token loop: no tracing reference whatsoever
+    tree = ast.parse(open(eng_mod.__file__).read())
+    emit = _find_fn(tree, "_emit_token")
+    assert "span" not in ast.dump(emit), (
+        "_emit_token is the token-level hot loop; tracing belongs at "
+        "dispatch boundaries, not per token"
+    )
+
+
+def test_engine_submit_without_tracing_leaves_span_none():
+    eng = _make_engine()
+    assert eng._tracer is None
+    eng.start()
+    try:
+        r = _generate_blocking(eng, [1, 2, 3], max_new=4)
+        assert len(r.output_tokens) == 4
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e: failover mid-generation across a staged commit => one trace
+# ---------------------------------------------------------------------------
+
+
+def _walk_params(node, prefix=""):
+    for k in sorted(node.keys()):
+        v = node[k]
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk_params(v, path)
+        else:
+            yield path, v
+
+
+def _flat_host(params) -> dict:
+    return {p: np.asarray(jax.device_get(v)) for p, v in _walk_params(params)}
+
+
+def _make_engine(service: str | None = None, **over) -> GenerationEngine:
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    gen_cfg = dict(
+        max_batch_size=4,
+        max_seq_len=2048,
+        prefill_chunk=64,
+        decode_steps_per_call=2,
+        dtype="float32",
+    )
+    if service is not None:
+        gen_cfg["tracing"] = TracingConfig(enabled=True, service=service)
+    gen_cfg.update(over)
+    return GenerationEngine(
+        JaxGenConfig(**gen_cfg), model_config=cfg, params=params
+    )
+
+
+def _generate_blocking(eng, prompt, max_new=32, greedy=True):
+    done = threading.Event()
+    out = []
+
+    def cb(r):
+        out.append(r)
+        done.set()
+
+    eng.submit(
+        "rid-%d" % time.monotonic_ns(),
+        list(prompt),
+        GenerationHyperparameters(
+            max_new_tokens=max_new, min_new_tokens=max_new, greedy=greedy
+        ),
+        cb,
+    )
+    assert done.wait(300)
+    return out[0]
+
+
+class _Server:
+    """A live traced server on a private loop (PR 3 fixture pattern)."""
+
+    def __init__(self, service: str):
+        self.engine = _make_engine(service=service)
+        self.server = GenerationServer(self.engine)
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.thread.start()
+        port = asyncio.run_coroutine_threadsafe(
+            self.server.start("127.0.0.1", 0), self.loop
+        ).result(timeout=60)
+        self.addr = f"127.0.0.1:{port}"
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(
+            self.server.stop(), self.loop
+        ).result(timeout=30)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+class _EpisodeWorkflow:
+    """Minimal rollout workflow: one agenerate call, padded trajectory."""
+
+    def __init__(self, prompt, max_new):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.responses = []
+
+    async def arun_episode(self, engine, data):
+        req = ModelRequest(
+            rid="e2e-rollout",
+            input_ids=list(self.prompt),
+            gconfig=GenerationHyperparameters(
+                n_samples=1,
+                max_new_tokens=self.max_new,
+                min_new_tokens=self.max_new,
+                temperature=1.0,
+            ),
+        )
+        resp = await engine.agenerate(req)
+        self.responses.append(resp)
+        ids = list(self.prompt) + list(resp.output_tokens)
+        return {
+            "input_ids": np.asarray([ids]),
+            "attention_mask": np.ones((1, len(ids)), np.int64),
+        }
+
+
+def test_e2e_failover_across_commit_single_connected_trace():
+    """THE acceptance scenario: a rollout whose generation starts on
+    server A, gets aborted mid-generation (A pauses), whose re-dispatch
+    to A is chaos-killed (failover to B), and whose remaining tokens
+    decode on B across a staged weight commit — all of it one connected
+    trace across three tracers (client, server A, server B)."""
+    a = _Server("server-a")
+    b = _Server("server-b")
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            consumer_batch_size=1,
+            max_concurrent_rollouts=1,
+            schedule_policy="round_robin",
+            cache_aware_routing=False,
+            request_retries=1,
+            request_timeout=60.0,
+            failover_retries=3,
+            tracing=TracingConfig(enabled=True, service="client"),
+        )
+    )
+    try:
+        client.initialize(addr=[a.addr, b.addr])
+        # deterministic client-side chaos armed later (times=1 on A)
+        chaos = ChaosPolicy()
+        client._chaos = chaos
+        prompt = [3, 5, 7, 11, 13, 17, 19, 23]
+        wf = _EpisodeWorkflow(prompt, max_new=160)
+        client.submit({"prompt": prompt}, workflow=wf)
+
+        # phase 1: the request lands on A (round-robin first); wait for
+        # real decoded tokens so the later abort is MID-generation
+        deadline = time.monotonic() + 120
+        while a.engine.generated_tokens_total < 8:
+            assert time.monotonic() < deadline, "no tokens on server A"
+            time.sleep(0.01)
+
+        # phase 2: kill the next dispatch to A (chaos 503), then abort
+        # the in-flight generation (pause). The client re-issues to A
+        # (rid affinity), eats the 503, and fails over to B replaying
+        # the accumulated tokens.
+        chaos.add_rule(
+            endpoint=f"{a.addr}/generate", action="http_error",
+            status=503, times=1,
+        )
+        a.engine.pause()
+
+        # phase 3: wait until B is decoding the resumed generation, then
+        # land a staged weight commit mid-generation
+        while b.engine.generated_tokens_total < 4:
+            assert time.monotonic() < deadline, "failover never reached B"
+            time.sleep(0.005)
+        new_params = init_params(
+            b.engine.model_config, jax.random.PRNGKey(9), jnp.float32
+        )
+        b.engine.stage_weight_chunk(_flat_host(new_params), version=1)
+        assert b.engine.n_running == 1, "generation finished before commit"
+        b.engine.commit_staged_weights(1)
+
+        batch = client.wait(count=1, timeout=180)
+        assert batch["input_ids"].shape[0] == 1
+        resp = wf.responses[0]
+        assert len(resp.output_tokens) == 160
+        assert chaos.injected == 1
+        # per-token versions record the commit crossing (old then new)
+        assert set(resp.output_versions) == {0, 1}
+
+        # ---- the trace ------------------------------------------------
+        client_spans = client._tracer.finished_spans()
+        a_spans = a.engine._tracer.finished_spans()
+        b_spans = b.engine._tracer.finished_spans()
+        rollout = next(s for s in client_spans if s["name"] == "rollout")
+        gen = next(s for s in client_spans if s["name"] == "generate")
+        tid = rollout["trace_id"]
+        assert gen["trace_id"] == tid
+        assert gen["parent_id"] == rollout["span_id"]
+        # every server span of this trace links to the client generate span
+        a_mine = [s for s in a_spans if s["trace_id"] == tid]
+        b_mine = [s for s in b_spans if s["trace_id"] == tid]
+        assert a_mine, "no server-A span joined the trace"
+        assert b_mine, "no server-B span joined the trace"
+        for s in a_mine + b_mine:
+            assert s["parent_id"] == gen["span_id"]
+        # client saw >= 2 dispatches (A then B) and exactly one failover
+        dispatch_addrs = [
+            e["addr"] for e in gen["events"] if e["name"] == "dispatch"
+        ]
+        assert a.addr in dispatch_addrs and b.addr in dispatch_addrs
+        failovers = [e for e in gen["events"] if e["name"] == "failover"]
+        assert len(failovers) == 1
+        assert failovers[0]["failed_addr"] == a.addr
+        assert failovers[0]["replay"] >= 8  # mid-generation, tokens replayed
+        # the commit event landed INSIDE a generation span on B
+        b_commit = [
+            s
+            for s in b_mine
+            if any(e["name"] == "weight_commit" for e in s["events"])
+        ]
+        assert b_commit, "weight commit did not land inside the B span"
+        ev = next(
+            e for e in b_commit[0]["events"] if e["name"] == "weight_commit"
+        )
+        assert ev["version"] == 1
+        # engine-internal events made it onto the server spans
+        all_server_events = [
+            e["name"] for s in a_mine + b_mine for e in s["events"]
+        ]
+        assert "admission" in all_server_events
+        assert "decode_segment" in all_server_events
+        assert "prefill_dispatch" in all_server_events
+        # ---- Perfetto export round-trips over the MERGED trace --------
+        merged = client_spans + a_spans + b_spans
+        back = spans_from_chrome_trace(
+            json.loads(json.dumps(chrome_trace(merged)))
+        )
+        assert {s["span_id"] for s in back} == {s["span_id"] for s in merged}
+        back_commit = next(
+            s for s in back if s["span_id"] == b_commit[0]["span_id"]
+        )
+        assert any(
+            e["name"] == "weight_commit" for e in back_commit["events"]
+        )
+    finally:
+        client.destroy()
+        a.stop()
+        b.stop()
+
+
+def test_trace_header_reaches_server_and_engine_events(tmp_path):
+    """Single-server smoke: a traced client request produces one server
+    span carrying the engine's admission/prefill/decode events, exported
+    to jsonl."""
+    srv = _Server("server-x")
+    export = str(tmp_path / "spans.jsonl")
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            consumer_batch_size=1,
+            max_concurrent_rollouts=1,
+            tracing=TracingConfig(
+                enabled=True, service="client", export_path=export
+            ),
+        )
+    )
+    try:
+        client.initialize(addr=[srv.addr])
+        req = ModelRequest(
+            rid="one",
+            input_ids=[2, 4, 6, 8],
+            gconfig=GenerationHyperparameters(
+                n_samples=1, max_new_tokens=8, min_new_tokens=8,
+                temperature=1.0,
+            ),
+        )
+        resp = client.generate(req)
+        assert len(resp.output_tokens) == 8
+        gen = next(
+            s
+            for s in client._tracer.finished_spans()
+            if s["name"] == "generate"
+        )
+        srv_spans = [
+            s
+            for s in srv.engine._tracer.finished_spans()
+            if s["trace_id"] == gen["trace_id"]
+        ]
+        assert len(srv_spans) == 1
+        assert srv_spans[0]["parent_id"] == gen["span_id"]
+        assert srv_spans[0]["attrs"]["stop_reason"] == "length"
+        names = [e["name"] for e in srv_spans[0]["events"]]
+        assert "admission" in names
+        assert "prefill_dispatch" in names
+        assert "decode_segment" in names
+        # jsonl export wrote the client spans
+        lines = [json.loads(x) for x in open(export).read().splitlines()]
+        assert any(s["name"] == "generate" for s in lines)
+    finally:
+        client.destroy()
+        srv.stop()
+
+
+def test_malformed_input_ids_is_400_with_tracing_on():
+    """Regression: with tracing enabled, span creation reads
+    len(input_ids) BEFORE engine.submit's validation — a non-sequence
+    body must still fail fast with 400, never a retriable 500."""
+    import urllib.error
+    import urllib.request
+
+    srv = _Server("server-400")
+    try:
+        for bad in (123, None):
+            req = urllib.request.Request(
+                f"http://{srv.addr}/generate",
+                data=json.dumps(
+                    {"rid": "bad", "input_ids": bad,
+                     "sampling_params": {"max_new_tokens": 4}}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400, bad
+    finally:
+        srv.stop()
