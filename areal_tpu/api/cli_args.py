@@ -23,6 +23,10 @@ from typing import Any
 
 import yaml
 
+# re-exported so the auto-generated cli_reference documents the perf-
+# regression sentinel's knobs next to every other config (the module is
+# stdlib-only by contract — bench.py's parent process must not pull jax)
+from areal_tpu.bench.regression import BenchSentinelConfig  # noqa: F401
 from areal_tpu.utils.name_resolve import NameResolveConfig
 
 # --------------------------------------------------------------------------
@@ -951,6 +955,36 @@ class ProfilerConfig:
 
 
 @dataclass
+class StepTimelineConfig:
+    """Training-plane step-time attribution (utils/step_timeline.py):
+    per-step phase breakdown (rollout wait / logp recompute / advantage /
+    train / weight sync / checkpoint) with a phases-sum-to-wall-clock
+    assertion, goodput (compute fraction) and per-step MFU/TFLOPs-per-chip
+    from the analytic FLOPs math, jax memory + recompile telemetry, a
+    ``trainer`` flight-recorder channel, and one ``train.step`` tracing
+    span per step stamped with the weight version the step produces (the
+    cross-plane Perfetto join key). Runs once per STEP — never per token;
+    with tracing off the span plumbing costs only ``is not None``."""
+
+    enabled: bool = True
+    # warn (once) + count when |wall - sum(phases)| / wall exceeds this
+    tolerance: float = 0.05
+    # steps before the recompile detector freezes: traces during warmup
+    # (first compiles, shape-bucket discovery) are expected; any tracing
+    # of a jitted function AFTER the freeze is flagged as a recompile
+    warmup_steps: int = 2
+    # sample jax device memory_stats + live-array bytes every step
+    # (gauges absent — not zero — on backends without memory_stats)
+    memory_telemetry: bool = True
+    # count tracings per jitted function; one-shot warning + counter
+    # metric on a re-trace after warmup (the silent shape-bucket-miss)
+    recompile_detector: bool = True
+    # ring size of the flight recorder's ``trainer`` channel (last N
+    # step breakdowns, dumped on watchdog/InjectedCrash/SIGTERM)
+    trainer_channel_steps: int = 64
+
+
+@dataclass
 class LauncherConfig:
     inference_server_cpus_per_chip: int = 4
     inference_server_mem_per_chip: int = 32768
@@ -991,6 +1025,9 @@ class BaseExperimentConfig:
     stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
     launcher: LauncherConfig = field(default_factory=LauncherConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    step_timeline: StepTimelineConfig = field(
+        default_factory=StepTimelineConfig
+    )
 
     def __post_init__(self):
         # propagate experiment/trial names into sub-configs left at defaults

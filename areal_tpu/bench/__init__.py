@@ -1,0 +1,6 @@
+"""Bench-side tooling that must stay importable without jax.
+
+``bench.py``'s parent process never imports jax by contract (a wedged TPU
+tunnel holds jax's init lock forever; only freshly exec'd children touch
+the backend), so everything in this package is stdlib-only.
+"""

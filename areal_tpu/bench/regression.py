@@ -1,0 +1,431 @@
+"""Perf-regression sentinel over the bench rehearsal trajectory.
+
+The ROADMAP's standing constraint — "CPU rehearsal is the live perf
+signal" — had no teeth: ``BENCH_REHEARSAL.jsonl`` was rewritten per run
+and nothing ever compared a run against its predecessors, so a perf
+regression from any PR would land unnoticed. This module closes the loop:
+
+- :func:`load_records` parses the rehearsal jsonl (one record per rung
+  per run, appended across runs; the sentinel's own verdict lines and
+  garbled lines are skipped);
+- :func:`analyze` builds a **robust per-rung baseline** — median + MAD
+  noise band over the trailing ``window`` per-run samples — and
+  classifies the newest run's sample of every metric as ``regression``
+  / ``improvement`` / ``ok`` (inside the band) / ``no_baseline`` (first
+  runs) / ``no_data`` (the newest rung **wedged** — a child timeout
+  recorded ``{"wedged": true, ...}`` — or emitted nothing at all in the
+  newest run: never a regression, never a baseline sample). Records
+  collapse to one sample per (metric, ``run_id``), last line wins, so a
+  run's own duplicate emissions can't pollute its baseline and a rung
+  that silently died is judged absent rather than on a stale
+  previous-run value; pre-``run_id`` trajectory lines each stand alone;
+- :func:`append_verdict` writes one ``bench_sentinel`` line back into
+  the jsonl after every rehearsal run (``bench.py`` calls it), so the
+  trajectory carries its own judgments;
+- the CLI (``python areal_tpu/bench/regression.py`` — run BY PATH, see
+  ``scripts/bench_check.sh``: importing the areal_tpu package pulls
+  jax, which blocks forever on a wedged TPU tunnel) gates: exit 1 on
+  any regression, exit 0 otherwise — including when there is no
+  trajectory yet.
+
+Direction is inferred per metric (``*_per_sec`` rates, reduction/speedup
+ratios, and config-counts are higher-better; latencies, stalls and
+``*_sec`` step times are lower-better) with an explicit override table
+for anything ambiguous.
+
+Stdlib-only by contract: ``bench.py``'s parent process must never import
+jax (see ``areal_tpu/bench/__init__``), and it loads this file by path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import statistics
+import sys
+
+#: metric name of the verdict lines the sentinel appends to the jsonl
+SENTINEL_METRIC = "bench_sentinel"
+
+#: scale factor turning a median-absolute-deviation into a robust sigma
+_MAD_SIGMA = 1.4826
+
+
+@dataclasses.dataclass
+class BenchSentinelConfig:
+    """Perf-regression sentinel knobs (areal_tpu/bench/regression.py;
+    CLI: ``python areal_tpu/bench/regression.py`` — by path, jax-free —
+    gated by ``scripts/bench_check.sh``). The baseline is a median +
+    MAD noise band over the trailing runs of each bench rung; the
+    newest run is classified regression / noise / improvement per
+    metric, and wedged or absent rungs (child-timeout forensics /
+    crashed rungs) are never data."""
+
+    # trailing baseline samples per metric (newest excluded)
+    window: int = 8
+    # fewer usable baseline samples than this -> no_baseline (pass);
+    # 2 keeps the very first rehearsal append from gating itself
+    min_samples: int = 2
+    # noise band half-width = mad_k * 1.4826 * MAD (robust sigmas)
+    mad_k: float = 3.0
+    # band floor as a fraction of |median|: with a short, quiet history
+    # MAD collapses to ~0 and every wiggle would gate — below this
+    # relative move nothing is ever called a regression
+    rel_floor: float = 0.10
+
+
+#: metrics whose direction the name heuristic would get wrong, or that
+#: reviewers should not have to reason about
+DIRECTION_OVERRIDES: dict[str, bool] = {
+    # metric -> lower_is_better
+    "weight_update_latency": True,
+    "weight_sync_stall_seconds": True,
+    "grpo_step_sec": True,
+}
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    m = (metric or "").lower()
+    if m in DIRECTION_OVERRIDES:
+        return DIRECTION_OVERRIDES[m]
+    if "per_sec" in m:  # rates: tokens_per_sec etc.
+        return False
+    if "latency" in m or "stall" in m:
+        return True
+    if m.endswith("_sec") or m.endswith("_seconds"):
+        return True
+    u = (unit or "").lower()
+    if u == "s" or u.startswith("s_"):
+        return True
+    return False
+
+
+def _usable(rec: dict) -> bool:
+    """A record that may serve as a data point (baseline or newest)."""
+    if rec.get("wedged"):
+        return False
+    v = rec.get("value")
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse the jsonl trajectory. Sentinel verdict lines and garbled
+    lines are skipped (a torn tail from a killed bench must not void the
+    history)."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) or "metric" not in rec:
+                    continue
+                if rec.get("metric") == SENTINEL_METRIC:
+                    continue
+                out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def _collapse_runs(recs: list[dict]) -> list[dict]:
+    """One sample per run, last record wins (a run may emit a metric
+    more than once — e.g. a retried attempt). Records without a
+    ``run_id`` (pre-sentinel trajectory lines) each stand alone."""
+    order: list = []
+    by_run: dict = {}
+    for i, rec in enumerate(recs):
+        key = rec.get("run_id") or ("_line_", i)
+        if key not in by_run:
+            order.append(key)
+        by_run[key] = rec
+    return [by_run[k] for k in order]
+
+
+def analyze(
+    records: list[dict], cfg: BenchSentinelConfig | None = None
+) -> dict:
+    """Classify the newest run's sample of every metric against its
+    trailing per-run baseline. Returns a report dict with per-metric
+    verdicts and the overall ``ok`` flag (False iff any metric
+    regressed)."""
+    cfg = cfg or BenchSentinelConfig()
+    by_metric: dict[str, list[dict]] = {}
+    for rec in records:
+        by_metric.setdefault(str(rec["metric"]), []).append(rec)
+    # the run under judgment is the one that wrote the last data line;
+    # a metric with no sample in it produced NO data this run (crashed
+    # rung, skipped rung) — judged absent, never on a stale older value
+    newest_run = records[-1].get("run_id") if records else None
+    verdicts: dict[str, dict] = {}
+    regressions: list[str] = []
+    for metric, recs in by_metric.items():
+        samples = _collapse_runs(recs)
+        newest = samples[-1]
+        if newest_run is not None and newest.get("run_id") != newest_run:
+            verdicts[metric] = {
+                "status": "no_data",
+                "absent_from_run": newest_run,
+                "last_seen_run": newest.get("run_id"),
+            }
+            continue
+        lower = lower_is_better(metric, str(newest.get("unit") or ""))
+        if not _usable(newest):
+            verdicts[metric] = {
+                "status": "no_data",
+                "wedged": bool(newest.get("wedged")),
+                "phase": newest.get("phase"),
+            }
+            continue
+        value = float(newest["value"])
+        baseline = [
+            float(r["value"]) for r in samples[:-1] if _usable(r)
+        ][-cfg.window:]
+        if len(baseline) < cfg.min_samples:
+            verdicts[metric] = {
+                "status": "no_baseline",
+                "value": value,
+                "n_baseline": len(baseline),
+            }
+            continue
+        med = statistics.median(baseline)
+        mad = statistics.median(abs(b - med) for b in baseline)
+        band = max(cfg.mad_k * _MAD_SIGMA * mad, cfg.rel_floor * abs(med))
+        delta = value - med
+        if lower:
+            status = (
+                "regression"
+                if delta > band
+                else "improvement" if delta < -band else "ok"
+            )
+        else:
+            status = (
+                "regression"
+                if delta < -band
+                else "improvement" if delta > band else "ok"
+            )
+        verdicts[metric] = {
+            "status": status,
+            "value": value,
+            "baseline_median": med,
+            "band": band,
+            "delta": delta,
+            "n_baseline": len(baseline),
+            "lower_is_better": lower,
+        }
+        if status == "regression":
+            regressions.append(metric)
+    return {
+        "metrics": verdicts,
+        "regressions": sorted(regressions),
+        "ok": not regressions,
+        "n_records": len(records),
+        "config": dataclasses.asdict(cfg),
+    }
+
+
+def analyze_file(
+    path: str, cfg: BenchSentinelConfig | None = None
+) -> dict:
+    return analyze(load_records(path), cfg)
+
+
+def render_text(report: dict) -> str:
+    lines = [
+        f"bench sentinel: {report['n_records']} record(s), "
+        f"{len(report['metrics'])} metric(s), "
+        f"{'OK' if report['ok'] else 'REGRESSION'}"
+    ]
+    for metric in sorted(report["metrics"]):
+        v = report["metrics"][metric]
+        status = v["status"]
+        if status in ("no_data", "no_baseline"):
+            if v.get("wedged"):
+                detail = f"wedged at phase={v.get('phase')!r}"
+            elif "absent_from_run" in v:
+                detail = "no_data (rung absent from the newest run)"
+            else:
+                detail = status
+            lines.append(f"  {metric}: {detail}")
+            continue
+        arrow = "v" if v["lower_is_better"] else "^"
+        lines.append(
+            f"  {metric}: {status} value={v['value']:.6g} "
+            f"median={v['baseline_median']:.6g} "
+            f"band=+/-{v['band']:.6g} (better {arrow}, "
+            f"n={v['n_baseline']})"
+        )
+    if report["regressions"]:
+        lines.append(
+            "  REGRESSED: " + ", ".join(report["regressions"])
+        )
+    return "\n".join(lines)
+
+
+def append_verdict(
+    path: str, report: dict, run_id: str | None = None
+) -> dict:
+    """Append one sentinel verdict line to the trajectory jsonl (ignored
+    as data by :func:`load_records`). Returns the record written."""
+    rec = {
+        "metric": SENTINEL_METRIC,
+        "ok": report["ok"],
+        "regressions": report["regressions"],
+        "verdicts": {
+            m: v["status"] for m, v in report["metrics"].items()
+        },
+        "n_records": report["n_records"],
+    }
+    if run_id is not None:
+        rec["run_id"] = run_id
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Fixture self-test (runs in CI without a live bench: scripts/lint.sh)
+# ---------------------------------------------------------------------------
+
+
+def _fixture(metric: str, values, unit: str = "tokens/s") -> list[dict]:
+    return [
+        {"metric": metric, "value": v, "unit": unit} for v in values
+    ]
+
+
+def self_test() -> int:
+    """Pin the sentinel's contract on synthetic trajectories; returns 0
+    when every case behaves, 1 (with a message) otherwise. This is the
+    fixture-jsonl mode ``scripts/bench_check.sh --self-test`` runs from
+    ``scripts/lint.sh`` so the gate exercises without a live bench."""
+    failures: list[str] = []
+
+    def check(name: str, cond: bool):
+        if not cond:
+            failures.append(name)
+
+    # 1. a 20% tokens/s drop against a quiet baseline is a regression
+    r = analyze(_fixture("decode_tokens_per_sec", [100, 101, 99, 100, 80]))
+    check(
+        "20pct-regression-detected",
+        not r["ok"]
+        and r["metrics"]["decode_tokens_per_sec"]["status"] == "regression",
+    )
+    # 2. noise-band jitter passes
+    r = analyze(_fixture("decode_tokens_per_sec", [100, 101, 99, 100, 98]))
+    check(
+        "noise-band-pass",
+        r["ok"] and r["metrics"]["decode_tokens_per_sec"]["status"] == "ok",
+    )
+    # 3. first run / no baseline passes
+    r = analyze(_fixture("decode_tokens_per_sec", [100]))
+    check(
+        "no-baseline-pass",
+        r["ok"]
+        and r["metrics"]["decode_tokens_per_sec"]["status"] == "no_baseline",
+    )
+    # 4. a wedged newest rung is no_data, never a regression; wedged
+    #    history lines are not baseline samples either
+    recs = _fixture("decode_tokens_per_sec", [100, 101, 99])
+    recs.insert(1, {"metric": "decode_tokens_per_sec", "wedged": True,
+                    "value": None, "phase": "backend_probe"})
+    recs.append({"metric": "decode_tokens_per_sec", "wedged": True,
+                 "value": None, "phase": "decode", "timeout_s": 900})
+    r = analyze(recs)
+    check(
+        "wedged-skip",
+        r["ok"]
+        and r["metrics"]["decode_tokens_per_sec"]["status"] == "no_data",
+    )
+    # 5. lower-is-better metrics gate in the other direction
+    r = analyze(
+        _fixture(
+            "weight_sync_stall_seconds",
+            [0.02, 0.021, 0.019, 0.02, 0.03],
+            unit="s",
+        )
+    )
+    check(
+        "lower-better-regression",
+        not r["ok"]
+        and r["metrics"]["weight_sync_stall_seconds"]["status"]
+        == "regression",
+    )
+    # 6. improvements are improvements, not regressions
+    r = analyze(_fixture("decode_tokens_per_sec", [100, 101, 99, 100, 140]))
+    check(
+        "improvement-pass",
+        r["ok"]
+        and r["metrics"]["decode_tokens_per_sec"]["status"] == "improvement",
+    )
+    if failures:
+        print(
+            f"bench sentinel self-test FAILED: {failures}", file=sys.stderr
+        )
+        return 1
+    print("bench sentinel self-test: 6/6 cases ok")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="areal_tpu.bench.regression",
+        description="perf-regression sentinel over a bench jsonl "
+        "trajectory (exit 1 on any regression)",
+    )
+    p.add_argument(
+        "--jsonl",
+        default="BENCH_REHEARSAL.jsonl",
+        help="trajectory file (default: BENCH_REHEARSAL.jsonl)",
+    )
+    p.add_argument("--json", action="store_true", help="emit the JSON report")
+    p.add_argument("--window", type=int, default=None)
+    p.add_argument("--min-samples", type=int, default=None)
+    p.add_argument("--mad-k", type=float, default=None)
+    p.add_argument("--rel-floor", type=float, default=None)
+    p.add_argument(
+        "--append-verdict",
+        action="store_true",
+        help="append a bench_sentinel line to the jsonl",
+    )
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="run the fixture self-test instead of reading a trajectory",
+    )
+    args = p.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    cfg = BenchSentinelConfig()
+    for name in ("window", "min_samples", "mad_k", "rel_floor"):
+        v = getattr(args, name)
+        if v is not None:
+            setattr(cfg, name, v)
+    if not os.path.exists(args.jsonl):
+        print(
+            f"bench sentinel: no trajectory at {args.jsonl} "
+            "(nothing to gate)",
+        )
+        return 0
+    report = analyze_file(args.jsonl, cfg)
+    if args.append_verdict:
+        append_verdict(args.jsonl, report)
+    print(json.dumps(report) if args.json else render_text(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

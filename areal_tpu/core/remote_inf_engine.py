@@ -1180,6 +1180,12 @@ class RemoteInfEngine(InferenceEngine):
         )
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(update_weights_http_latency=latency)
+        # canonical weight-sync phase name for the step timeline (joins
+        # time_perf/weight_sync_gather + weight_sync_encode from the
+        # trainer/encode sides): total push wall for the streamed fan-out
+        stats_tracker.DEFAULT_TRACKER.scalar(
+            **{"time_perf/weight_sync_push": latency}
+        )
         logger.info(
             "tensor weight update v%d (%d chunks) -> %d/%d servers in %.2fs",
             next_version,
@@ -1455,6 +1461,9 @@ class RemoteInfEngine(InferenceEngine):
         )
         latency = time.monotonic() - t0
         stats_tracker.DEFAULT_TRACKER.scalar(update_weights_shm_latency=latency)
+        stats_tracker.DEFAULT_TRACKER.scalar(
+            **{"time_perf/weight_sync_push": latency}
+        )
         logger.info(
             "shm weight update v%d (%d chunks) -> %d/%d servers in %.2fs",
             next_version, n_chunks, len(targets) - len(failed),

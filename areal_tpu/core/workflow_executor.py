@@ -142,6 +142,20 @@ class WorkflowExecutor:
         # set when the rollout loop exits: asyncio tasks still pending on its
         # event loop after shutdown cleanup (must be 0 — pinned by tests)
         self.tasks_leaked_at_exit: int | None = None
+        # training-plane attribution: total seconds the consumer spent
+        # blocked in wait() (counters telescope across prepare_batch's
+        # 1s-timeout retry loop — each slice adds its own elapsed, so the
+        # sum is the true rollout-wait wall regardless of call pattern)
+        from areal_tpu.utils import metrics as _metrics
+
+        self._wait_seconds_total = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_rollout_wait_seconds_total",
+            "seconds the trainer spent blocked waiting for rollouts",
+        )
+        self._waits_total = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_rollout_wait_calls_total",
+            "wait() slices (including prepare_batch retry slices)",
+        )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -362,6 +376,15 @@ class WorkflowExecutor:
     def wait(self, count: int, timeout: float | None = None) -> dict[str, Any]:
         crash_point("pre-rollout-wait")
         start = time.perf_counter()
+        try:
+            return self._wait_impl(count, timeout, start)
+        finally:
+            self._waits_total.inc()
+            self._wait_seconds_total.inc(time.perf_counter() - start)
+
+    def _wait_impl(
+        self, count: int, timeout: float | None, start: float
+    ) -> dict[str, Any]:
         timeout = timeout or float(7 * 24 * 3600)
         while not self.exiting.is_set() and time.perf_counter() - start < timeout:
             self._check_health()

@@ -53,6 +53,7 @@ from areal_tpu.parallel.pipeline import (
 )
 from areal_tpu.parallel.sharding import FSDP_AXES, param_shardings
 from areal_tpu.utils import logging, stats_tracker
+from areal_tpu.utils.jax_cache import DEFAULT_DETECTOR as _retrace
 from areal_tpu.utils.data import (
     TensorDict,
     pack_tensor_dict,
@@ -278,6 +279,13 @@ class TPUTrainEngine(TrainEngine):
         # of the previously-shipped leaves
         self._wire_fingerprints: dict[str, bytes] = {}
         self._wire_fp_addrs: tuple | None = None
+        # last _perf_stats dict, mirrored into the metrics registry by a
+        # scrape-time collector (PR 8 idiom: zero steady-state cost, and
+        # /metrics agrees with the stats row by construction). MFU is in
+        # the dict only when the chip peak is known, so CPU rehearsal
+        # exports it as ABSENT, never zero.
+        self._last_perf_stats: dict[str, float] = {}
+        self._metrics_collector = None
         self.initialized = False
 
     # ---------------------------------------------------------------- setup
@@ -418,8 +426,50 @@ class TPUTrainEngine(TrainEngine):
             self._lr_schedule = make_lr_schedule(cfg.optimizer, total)
             init_opt = jax.jit(self._tx.init)
             self.opt_state = init_opt(self._trainable())
+        self._register_perf_collector()
         self.initialized = True
         return self
+
+    def _register_perf_collector(self):
+        """Expose the analytic throughput/MFU of the last train_batch on
+        the unified metrics registry (satellite of the goodput
+        observatory): a collector copies ``self._last_perf_stats`` into
+        device-kind-labelled gauges AT SCRAPE TIME only — the train step
+        itself pays nothing beyond storing the dict it already builds.
+        These are the COMPUTE-window numbers (train_batch wall); the
+        StepTimeline exports the whole-step goodput twins."""
+        from areal_tpu.utils import metrics as _metrics
+        from areal_tpu.utils import perf as _perf
+
+        reg = _metrics.DEFAULT_REGISTRY
+        gauges = {
+            "tokens_per_sec": reg.gauge(
+                "areal_train_compute_tokens_per_sec",
+                "trained tokens/s over the last train_batch wall",
+                labels=("device_kind",),
+            ),
+            "tflops_per_chip": reg.gauge(
+                "areal_train_compute_tflops_per_chip",
+                "analytic TFLOP/s per chip over the last train_batch",
+                labels=("device_kind",),
+            ),
+            "mfu": reg.gauge(
+                "areal_train_compute_mfu",
+                "model FLOPs utilization of the last train_batch "
+                "(absent when the chip peak is unknown — CPU rehearsal)",
+                labels=("device_kind",),
+            ),
+        }
+        kind = _perf.device_kind()
+
+        def _collect(_reg, _self=self, _gauges=gauges, _kind=kind):
+            stats = _self._last_perf_stats
+            for key, gauge in _gauges.items():
+                v = stats.get(key)
+                if v is not None:
+                    gauge.labels(device_kind=_kind).set(v)
+
+        self._metrics_collector = reg.register_collector(_collect)
 
     def _trainable(self):
         """The pytree the optimizer updates: LoRA adapters when configured,
@@ -469,6 +519,13 @@ class TPUTrainEngine(TrainEngine):
         self.params = None
         self.opt_state = None
         self._jit_cache.clear()
+        if self._metrics_collector is not None:
+            from areal_tpu.utils import metrics as _metrics
+
+            _metrics.DEFAULT_REGISTRY.unregister_collector(
+                self._metrics_collector
+            )
+            self._metrics_collector = None
         self.initialized = False
 
     # ------------------------------------------------------------- plumbing
@@ -526,6 +583,8 @@ class TPUTrainEngine(TrainEngine):
         m = perf.mfu(tps, fpt, n_chips=n_chips)
         if m is not None:
             out["mfu"] = m
+        # the registry collector reads this at scrape time (no push here)
+        self._last_perf_stats = out
         return out
 
     def current_lr(self) -> float:
@@ -918,7 +977,9 @@ class TPUTrainEngine(TrainEngine):
                     )
 
                 if lora_cfg is None:
-                    self._jit_cache[key] = jax.jit(run_1f1b)
+                    self._jit_cache[key] = jax.jit(
+                        _retrace.wrap("train_engine.grad_step_1f1b", run_1f1b)
+                    )
                 else:
                     from areal_tpu.models.lora import merge_lora
 
@@ -985,7 +1046,9 @@ class TPUTrainEngine(TrainEngine):
                     grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
                     return losses, grads
 
-                self._jit_cache[key] = jax.jit(step)
+                self._jit_cache[key] = jax.jit(
+                    _retrace.wrap("train_engine.grad_step_pp", step)
+                )
             else:
                 from areal_tpu.models.lora import merge_lora
 
@@ -1079,7 +1142,13 @@ class TPUTrainEngine(TrainEngine):
                 )
                 return loss, acc
 
-            return jax.jit(step, donate_argnums=(1,))
+            # _retrace.wrap: trace-count telemetry only (the wrapper body
+            # runs solely when jax traces — a re-trace after the timeline's
+            # warmup freeze is the silent shape-bucket-miss signal)
+            return jax.jit(
+                _retrace.wrap("train_engine.grad_step", step),
+                donate_argnums=(1,),
+            )
         from areal_tpu.models.lora import merge_lora
 
         def step(lora, base, acc, mb):
@@ -1092,7 +1161,10 @@ class TPUTrainEngine(TrainEngine):
             )
             return loss, acc
 
-        jitted = jax.jit(step, donate_argnums=(2,))
+        jitted = jax.jit(
+            _retrace.wrap("train_engine.grad_step_lora", step),
+            donate_argnums=(2,),
+        )
         return lambda tr, acc, mb: jitted(tr, self.params, acc, mb)
 
     def _apply_fn(self) -> Callable:
@@ -1117,7 +1189,10 @@ class TPUTrainEngine(TrainEngine):
                 )
                 return new_params, new_state, gnorm, ok
 
-            self._jit_cache[key] = jax.jit(apply, donate_argnums=(0, 1, 2))
+            self._jit_cache[key] = jax.jit(
+                _retrace.wrap("train_engine.apply", apply),
+                donate_argnums=(0, 1, 2),
+            )
         return self._jit_cache[key]
 
     def _finalize_fn(self) -> Callable:
@@ -1394,7 +1469,9 @@ class TPUTrainEngine(TrainEngine):
                     )
                     return logp
 
-                self._jit_cache[key] = jax.jit(fwd)
+                self._jit_cache[key] = jax.jit(
+                    _retrace.wrap("train_engine.forward_fused", fwd)
+                )
             fwd = self._jit_cache[key]
             mb_outs = None
         else:
@@ -1414,7 +1491,9 @@ class TPUTrainEngine(TrainEngine):
                         post_hook(logits, mb) if post_hook is not None else logits
                     )
 
-                self._jit_cache[key] = jax.jit(fwd)
+                self._jit_cache[key] = jax.jit(
+                    _retrace.wrap("train_engine.forward", fwd)
+                )
             fwd = self._jit_cache[key]
             mb_outs = None
 

@@ -1,4 +1,4 @@
-"""Persistent JAX compilation cache wiring.
+"""Persistent JAX compilation cache wiring + compile telemetry.
 
 A preempted-and-relaunched trainer or generation server (PR 4's recovery
 plane) pays full XLA recompile on every restart unless the compilation
@@ -11,6 +11,25 @@ Idempotent and conflict-checked: configuring the same directory twice is a
 no-op, configuring two DIFFERENT directories in one process raises (the
 cache is process-global — silently switching it mid-run would split the
 cache and hide the misconfiguration).
+
+Two telemetry layers ride along (the training-plane observatory):
+
+- :func:`install_cache_event_counters` mirrors jax's internal
+  ``/jax/compilation_cache/cache_hits``/``cache_misses`` monitoring
+  events into the PR 8 metrics registry
+  (``areal_jax_compilation_cache_events_total{event=hit|miss}``), so a
+  relaunch that silently misses the persistent cache is visible on
+  ``/metrics`` and in the StatsLogger registry export.
+- :class:`RecompileDetector` counts TRACINGS per jitted function (wrap
+  the python callable before handing it to ``jax.jit`` — the wrapper
+  body only runs when jax actually traces, i.e. on a jit-cache miss).
+  After :meth:`~RecompileDetector.freeze` (the StepTimeline calls it
+  once warmup/bucket discovery is over), any further trace is a flagged
+  re-trace — except a function's first-ever compile, so late-starting
+  paths (evaluation) don't false-positive: one-shot warning per
+  function + a counter metric. This is the classic silent
+  shape-bucket-miss throughput killer, caught at the moment it happens
+  instead of three dashboards later.
 """
 
 from __future__ import annotations
@@ -77,3 +96,186 @@ def _reset_for_tests() -> None:
     global _CONFIGURED_DIR
     with _LOCK:
         _CONFIGURED_DIR = None
+
+
+# ---------------------------------------------------------------------------
+# Compilation-cache hit/miss counters (jax.monitoring bridge)
+# ---------------------------------------------------------------------------
+
+#: jax-internal monitoring event names -> our metric label values
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hit",
+    "/jax/compilation_cache/cache_misses": "miss",
+}
+
+_COUNTERS_INSTALLED = False
+# the live counter the (install-once) jax.monitoring listener increments;
+# re-installs re-point it so a registry reset (tests) doesn't leave the
+# listener feeding a detached orphan
+_CACHE_COUNTER_REF: dict = {"counter": None}
+
+
+def install_cache_event_counters(registry=None) -> bool:
+    """Bridge jax's persistent-compilation-cache monitoring events into
+    the metrics registry (idempotent — the listener registers once; the
+    target counter re-binds on every call). Best-effort: an older/newer
+    jax without the monitoring API leaves the counters at zero rather
+    than failing startup."""
+    global _COUNTERS_INSTALLED
+    with _LOCK:
+        if registry is None:
+            from areal_tpu.utils import metrics
+
+            registry = metrics.DEFAULT_REGISTRY
+        _CACHE_COUNTER_REF["counter"] = registry.counter(
+            "areal_jax_compilation_cache_events_total",
+            "persistent jax compilation cache hits/misses",
+            labels=("event",),
+        )
+        if _COUNTERS_INSTALLED:
+            return True
+        try:
+            import jax.monitoring as _mon
+
+            def _on_event(event: str, **kwargs) -> None:
+                label = _CACHE_EVENTS.get(event)
+                if label is None:
+                    return
+                counter = _CACHE_COUNTER_REF["counter"]
+                if counter is not None:
+                    try:
+                        counter.labels(event=label).inc()
+                    except Exception:  # never fail a compile on telemetry
+                        pass
+
+            _mon.register_event_listener(_on_event)
+        except Exception:
+            logger.info(
+                "jax.monitoring unavailable; compilation-cache hit/miss "
+                "counters stay at zero"
+            )
+            return False
+        _COUNTERS_INSTALLED = True
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Recompile detector
+# ---------------------------------------------------------------------------
+
+
+class RecompileDetector:
+    """Count tracings per jitted function; flag re-traces after freeze.
+
+    Wrap the python callable BEFORE ``jax.jit``::
+
+        step = jax.jit(DEFAULT_DETECTOR.wrap("train_engine.grad_step", fn),
+                       donate_argnums=(1,))
+
+    The wrapper body executes only when jax traces (a jit-cache miss), so
+    steady-state cost is literally zero — no per-call overhead, no
+    version-sensitive cache introspection. :meth:`freeze` marks the end
+    of warmup (expected compiles: first shapes, bucket discovery); every
+    trace after it — except a function's first-ever compile, so paths
+    that legitimately start late (evaluation) don't false-positive —
+    increments ``areal_jit_retraces_total{fn=...}`` and warns ONCE per
+    function name.
+    """
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}  # guarded_by: _lock
+        self._retraces: dict[str, int] = {}  # guarded_by: _lock
+        self._frozen = False
+        self._warned: set[str] = set()
+        self._registry = registry
+        self._counter = None  # lazily created on first retrace
+
+    def wrap(self, name: str, fn):
+        def _traced(*args, **kwargs):
+            self.note_trace(name)
+            return fn(*args, **kwargs)
+
+        return _traced
+
+    def note_trace(self, name: str) -> None:
+        warn = False
+        with self._lock:
+            self._counts[name] = n_traces = self._counts.get(name, 0) + 1
+            if not self._frozen:
+                return
+            if n_traces == 1:
+                # first-EVER trace of this function after the freeze: a
+                # late first compile (an eval/ref path jitted past
+                # warmup), not a bucket miss — its SECOND post-freeze
+                # trace is the signal
+                return
+            self._retraces[name] = self._retraces.get(name, 0) + 1
+            if name not in self._warned:
+                self._warned.add(name)
+                warn = True
+            counter = self._retrace_counter()
+        try:
+            counter.labels(fn=name).inc()
+        except Exception:
+            pass
+        if warn:
+            logger.warning(
+                "jitted function %r re-traced AFTER warmup (trace #%d): a "
+                "shape/dtype/static-arg outside the warmed buckets is "
+                "forcing recompiles — the classic silent throughput "
+                "killer. Warned once; every further re-trace counts on "
+                "areal_jit_retraces_total{fn=%s}.",
+                name,
+                n_traces,
+                name,
+            )
+
+    def _retrace_counter(self):
+        # called under _lock
+        if self._counter is None:
+            registry = self._registry
+            if registry is None:
+                from areal_tpu.utils import metrics
+
+                registry = metrics.DEFAULT_REGISTRY
+            self._counter = registry.counter(
+                "areal_jit_retraces_total",
+                "tracings of a jitted function after the warmup freeze",
+                labels=("fn",),
+            )
+        return self._counter
+
+    def freeze(self) -> None:
+        """End of warmup: traces from now on are flagged re-traces."""
+        with self._lock:
+            self._frozen = True
+
+    @property
+    def frozen(self) -> bool:
+        with self._lock:
+            return self._frozen
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def retraces(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._retraces)
+
+    def total_retraces(self) -> int:
+        with self._lock:
+            return sum(self._retraces.values())
+
+    def reset(self) -> None:
+        """Test isolation: drop counts and un-freeze."""
+        with self._lock:
+            self._counts.clear()
+            self._retraces.clear()
+            self._warned.clear()
+            self._frozen = False
+            self._counter = None
+
+
+DEFAULT_DETECTOR = RecompileDetector()

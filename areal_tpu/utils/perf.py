@@ -35,6 +35,18 @@ _PEAK_BF16 = [
 ]
 
 
+def device_kind(device=None) -> str:
+    """The backend's device kind string ("TPU v5e", "cpu", ...) — the
+    label value that keeps CPU-rehearsal MFU series distinct from (and
+    absent next to) real-chip ones."""
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return "unknown"
+        device = devices[0]
+    return getattr(device, "device_kind", "") or "unknown"
+
+
 def chip_peak_flops(device=None) -> float | None:
     """Peak dense bf16 FLOP/s of one chip, or None when unknown (CPU)."""
     if device is None:

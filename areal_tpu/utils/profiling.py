@@ -45,6 +45,13 @@ class StepProfiler:
     def enabled(self) -> bool:
         return self.config is not None and getattr(self.config, "enabled", False)
 
+    @property
+    def active(self) -> bool:
+        """A jax.profiler capture is currently in flight — the signal the
+        step timeline stamps onto its records so the profiled window is
+        findable in the Perfetto join."""
+        return self._active
+
     @contextlib.contextmanager
     def step(self, global_step: int):
         if not self.enabled:

@@ -1,0 +1,409 @@
+"""Training-plane step-time attribution: where did the step's wall-clock go?
+
+The serving plane became explainable in PR 8 (traces, ``/metrics``, flight
+recorder); this module is its trainer-side counterpart, the TPU heir of the
+reference's analytic step accounting (realhf/base/monitor.py FLOPs counters
++ ``time_perf/*`` phase timers). Every train step decomposes into named
+phases — rollout wait, logprob recompute, advantage, forward/backward +
+optimizer, weight sync, checkpoint — and the timeline:
+
+- **asserts attribution**: the recorded phases must sum to the step's
+  wall-clock within ``tolerance`` (unattributed residual is exported as its
+  own fraction and a breach warns once + bumps a counter — a growing
+  residual means a new unnamed cost appeared in the loop);
+- **derives goodput**: the compute fraction of the step (phases in
+  ``COMPUTE_PHASES`` over wall), the number an async-RL throughput
+  question actually asks for ("was the step rollout-bound or
+  compute-bound?");
+- **derives per-step MFU / TFLOPs-per-chip** from the existing analytic
+  FLOPs math in :mod:`areal_tpu.utils.perf` (MFU is **absent, never
+  zero**, when the chip peak is unknown — CPU rehearsal);
+- **samples memory + recompile telemetry**: jax device ``memory_stats``
+  gauges, live-array bytes, persistent-compilation-cache hit/miss
+  counters, and the :class:`~areal_tpu.utils.jax_cache.RecompileDetector`
+  (frozen after ``warmup_steps`` — a re-trace after that is the classic
+  silent shape-bucket-miss throughput killer and warns exactly once);
+- **exports everywhere the repo already looks**: scalars for the
+  StatsLogger row (returned from :meth:`end_step` so the caller merges
+  them like ``time_perf/*``), the PR 8 metrics registry (phase-seconds
+  histograms, goodput/MFU gauges → ``/metrics`` and the periodic
+  StatsLogger registry export), the flight recorder (``trainer`` channel:
+  ring of recent breakdowns, dumped on watchdog/InjectedCrash/SIGTERM),
+  and the PR 8 tracing plane — one ``train.step`` span per step stamped
+  with the weight version the step PRODUCES, so a Perfetto export shows
+  the train step next to the rollout episodes that consumed its weights
+  (joined via the rollout spans' ``version`` attrs / ``weight_commit``
+  events).
+
+Step window protocol (matches the trainers' crash-exactness ordering,
+where the stats row commits BEFORE the recover dump):
+
+    timeline.begin_step(step)
+    with timeline.phase("rollout"): ...
+    with timeline.phase("train_step"): ...
+    row = timeline.end_step(...)        # attribution window closes HERE
+    stats_logger.commit(..., {**stats, **row})
+    with timeline.phase("checkpoint"):  # LATE phase: after end_step
+        saver.save(); recover.dump()
+    # next begin_step (or close()) finalizes: span ends, flight-recorder
+    # entry written — late phases ride the span/record but are excluded
+    # from the attribution sum, whose contract is the end_step window.
+
+Cost contract: tracing off ⇒ the only tracing cost is ``is not None``
+checks (the PR 8 chaos-hook discipline, pinned by the code-inspection
+test); the timeline itself runs once per STEP, never per token.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("StepTimeline")
+
+#: phases counted as "useful training compute" for the goodput fraction;
+#: everything else (rollout wait, weight sync, checkpoint, unattributed)
+#: is coordination the async design tries to overlap away.
+COMPUTE_PHASES = frozenset(
+    {"train_step", "recompute_logp", "ref_logp", "compute_advantage"}
+)
+
+#: flight-recorder channel holding the ring of recent step breakdowns
+TRAINER_CHANNEL = "trainer"
+
+
+class _NullContext:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullContext()
+
+
+class StepTimeline:
+    """Per-step phase attribution + goodput/MFU accounting.
+
+    All clocks are injectable (tests drive fake time); ``peak_flops``
+    overrides the chip peak for MFU (None = resolve from the device,
+    which yields no MFU off-TPU — absent, never zero).
+    """
+
+    def __init__(
+        self,
+        config=None,
+        tracer=None,
+        model_config=None,
+        n_chips: int = 1,
+        recorder=None,
+        registry=None,
+        clock=time.perf_counter,
+        peak_flops: float | None = None,
+    ):
+        self.config = config
+        self.enabled = config is None or getattr(config, "enabled", True)
+        self._tracer = tracer
+        self.model_config = model_config
+        self.n_chips = max(1, int(n_chips))
+        self._clock = clock
+        self._peak_flops = peak_flops
+        tol = getattr(config, "tolerance", 0.05)
+        self.tolerance = 0.05 if tol is None else float(tol)
+        self.warmup_steps = int(getattr(config, "warmup_steps", 2))
+        self.memory_telemetry = bool(
+            getattr(config, "memory_telemetry", True)
+        )
+        self.recompile_detector = bool(
+            getattr(config, "recompile_detector", True)
+        )
+        if recorder is None:
+            from areal_tpu.utils import flight_recorder
+
+            recorder = flight_recorder.DEFAULT_RECORDER
+        self._recorder = recorder
+        recorder.channel(
+            TRAINER_CHANNEL,
+            capacity=int(getattr(config, "trainer_channel_steps", 64)),
+        )
+        if registry is None:
+            from areal_tpu.utils import metrics
+
+            registry = metrics.DEFAULT_REGISTRY
+        self._registry = registry
+        self._phase_hist = registry.histogram(
+            "areal_train_phase_seconds",
+            "per-phase train-step wall time",
+            labels=("phase",),
+        )
+        self._step_hist = registry.histogram(
+            "areal_train_step_seconds", "train-step wall time"
+        )
+        self._goodput_g = registry.gauge(
+            "areal_train_goodput", "compute fraction of the last train step"
+        )
+        self._unattr_g = registry.gauge(
+            "areal_train_unattributed_fraction",
+            "step wall-clock not covered by any recorded phase",
+        )
+        self._breach_c = registry.counter(
+            "areal_train_attribution_breaches_total",
+            "steps whose phase sum missed wall-clock beyond tolerance",
+        )
+        self._mem_g = registry.gauge(
+            "areal_jax_memory_bytes",
+            "jax device memory_stats sampled per step (absent off-TPU)",
+            labels=("stat",),
+        )
+        self._live_g = registry.gauge(
+            "areal_jax_live_array_bytes",
+            "total bytes of live jax arrays sampled per step",
+        )
+        self._mfu_g = registry.gauge(
+            "areal_train_mfu",
+            "per-step model FLOPs utilization (absent when peak unknown)",
+            labels=("device_kind",),
+        )
+        self._tflops_g = registry.gauge(
+            "areal_train_tflops_per_chip",
+            "per-step achieved TFLOP/s per chip (analytic FLOPs)",
+            labels=("device_kind",),
+        )
+        # telemetry hooks shared with the rest of the process
+        from areal_tpu.utils import jax_cache
+
+        self._detector = (
+            jax_cache.DEFAULT_DETECTOR if self.recompile_detector else None
+        )
+        if self.enabled:
+            jax_cache.install_cache_event_counters(registry)
+        self._span = None
+        self._record: dict | None = None
+        self._phases: dict[str, float] = {}
+        self._late_phases: dict[str, float] = {}
+        self._t_begin = 0.0
+        self._closed_step = True  # no step open yet
+        self._steps_seen = 0
+        self._warned_tolerance = False
+        self._device_kind: str | None = None
+
+    @classmethod
+    def from_config(cls, config, **kwargs) -> "StepTimeline":
+        """Always returns a timeline; a disabled config yields one whose
+        begin/phase/end are no-ops (per-STEP cost only, nothing per
+        token), so trainer loops need no conditional plumbing."""
+        return cls(config=config, **kwargs)
+
+    # ----------------------------------------------------------- recording
+
+    def begin_step(self, global_step: int) -> None:
+        """Open the attribution window for one step; finalizes the
+        previous step's record (span end + flight-recorder entry) so late
+        phases (checkpoint) land on the step that ran them."""
+        if not self.enabled:
+            return
+        self._finalize()
+        self._phases = {}
+        self._late_phases = {}
+        self._record = {"step": int(global_step)}
+        self._t_begin = self._clock()
+        self._closed_step = False
+        if self._tracer is not None:
+            self._span = self._tracer.span("train.step", step=int(global_step))
+
+    def phase(self, name: str):
+        """Context manager timing one named phase. Inside the step window
+        it counts toward the attribution sum; after :meth:`end_step` it is
+        recorded as a LATE phase (rides the span/flight record, excluded
+        from the sum — the checkpoint-after-commit ordering)."""
+        if not self.enabled or self._record is None:
+            return _NULL
+        return self._phase_cm(name)
+
+    @contextlib.contextmanager
+    def _phase_cm(self, name: str):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dur = self._clock() - t0
+            target = self._late_phases if self._closed_step else self._phases
+            target[name] = target.get(name, 0.0) + dur
+            self._phase_hist.labels(phase=name).observe(dur)
+            if self._span is not None:
+                self._span.event("phase", phase=name, dur=dur)
+
+    def end_step(
+        self,
+        tokens: int | None = None,
+        n_seqs: int | None = None,
+        weight_version: int | None = None,
+        extra: dict | None = None,
+    ) -> dict[str, float]:
+        """Close the attribution window; returns the ``step_timeline/*``
+        scalar row for the StatsLogger commit. ``tokens``/``n_seqs``
+        (trained tokens and sequences this step) unlock TFLOPs/MFU via
+        the analytic FLOPs math; ``weight_version`` stamps the version
+        this step PRODUCED onto the span and record (the cross-plane
+        Perfetto join key)."""
+        if not self.enabled or self._record is None or self._closed_step:
+            return {}
+        wall = max(self._clock() - self._t_begin, 0.0)
+        self._closed_step = True
+        self._steps_seen += 1
+        accounted = sum(self._phases.values())
+        unattr = wall - accounted
+        unattr_frac = (unattr / wall) if wall > 0 else 0.0
+        compute = sum(
+            v for k, v in self._phases.items() if k in COMPUTE_PHASES
+        )
+        goodput = (compute / wall) if wall > 0 else 0.0
+        if wall > 0 and abs(unattr_frac) > self.tolerance:
+            self._breach_c.inc()
+            if not self._warned_tolerance:
+                self._warned_tolerance = True
+                logger.warning(
+                    "step attribution breach: phases sum to %.4fs but the "
+                    "step took %.4fs (%.1f%% unattributed > %.0f%% "
+                    "tolerance) — a cost in the loop has no phase around "
+                    "it (warned once; counted on "
+                    "areal_train_attribution_breaches_total)",
+                    accounted,
+                    wall,
+                    unattr_frac * 100.0,
+                    self.tolerance * 100.0,
+                )
+        row: dict[str, float] = {
+            f"step_timeline/{k}": v for k, v in self._phases.items()
+        }
+        row["step_timeline/wall"] = wall
+        row["step_timeline/unattributed"] = unattr
+        row["step_timeline/unattributed_frac"] = unattr_frac
+        row["step_timeline/goodput"] = goodput
+        self._step_hist.observe(wall)
+        self._goodput_g.set(goodput)
+        self._unattr_g.set(unattr_frac)
+        row.update(self._perf_row(wall, tokens, n_seqs))
+        row.update(self._telemetry_row())
+        if extra:
+            row.update({f"step_timeline/{k}": v for k, v in extra.items()})
+        rec = self._record
+        rec.update(
+            wall=wall,
+            phases=dict(self._phases),
+            goodput=goodput,
+            unattributed_frac=unattr_frac,
+        )
+        if weight_version is not None:
+            rec["version"] = int(weight_version)
+        if tokens is not None:
+            rec["tokens"] = int(tokens)
+        if self._span is not None:
+            self._span.set(
+                wall=wall,
+                goodput=round(goodput, 4),
+                unattributed_frac=round(unattr_frac, 4),
+            )
+            if weight_version is not None:
+                self._span.set(version=int(weight_version))
+        # freeze the recompile detector once warmup (compile/bucket
+        # discovery) is over: any trace after this is a flagged re-trace
+        # (>=, not ==: warmup_steps=0 / a resumed counter must still
+        # freeze at the first completed step)
+        if (
+            self._detector is not None
+            and not self._detector.frozen
+            and self._steps_seen >= self.warmup_steps
+        ):
+            self._detector.freeze()
+        return row
+
+    def close(self) -> None:
+        """Finalize the open step (loop exit / graceful drain): ends the
+        span and writes the last flight-recorder entry."""
+        if not self.enabled:
+            return
+        self._finalize()
+
+    # ------------------------------------------------------------ internals
+
+    def _finalize(self) -> None:
+        rec, self._record = self._record, None
+        if rec is None:
+            return
+        if self._late_phases:
+            rec["late_phases"] = dict(self._late_phases)
+        self._recorder.record(TRAINER_CHANNEL, "step", **rec)
+        if self._span is not None:
+            self._span.end()
+            self._span = None
+
+    def _perf_row(
+        self, wall: float, tokens: int | None, n_seqs: int | None
+    ) -> dict[str, float]:
+        """TFLOPs-per-chip + MFU over the FULL step wall (the goodput-
+        style utilization number: rollout waits count against it). MFU is
+        omitted — not zeroed — when the chip peak is unknown (CPU)."""
+        if (
+            tokens is None
+            or tokens <= 0
+            or wall <= 0
+            or self.model_config is None
+        ):
+            return {}
+        from areal_tpu.utils import perf
+
+        avg_seqlen = tokens / max(int(n_seqs or 1), 1)
+        fpt = perf.train_flops_per_token(self.model_config, avg_seqlen)
+        tps = tokens / wall
+        kind = self._resolve_device_kind()
+        tflops = tps * fpt / self.n_chips / 1e12
+        self._tflops_g.labels(device_kind=kind).set(tflops)
+        out = {
+            "step_timeline/tokens_per_sec": tps,
+            "step_timeline/tflops_per_chip": tflops,
+        }
+        m = perf.mfu(tps, fpt, n_chips=self.n_chips, peak=self._peak_flops)
+        if m is not None:
+            out["step_timeline/mfu"] = m
+            self._mfu_g.labels(device_kind=kind).set(m)
+        return out
+
+    def _resolve_device_kind(self) -> str:
+        if self._device_kind is None:
+            from areal_tpu.utils import perf
+
+            self._device_kind = perf.device_kind()
+        return self._device_kind
+
+    def _telemetry_row(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if self.memory_telemetry:
+            try:
+                import jax
+
+                dev = jax.local_devices()[0]
+                stats = dev.memory_stats()
+                if stats:
+                    for key in ("bytes_in_use", "peak_bytes_in_use"):
+                        v = stats.get(key)
+                        if v is not None:
+                            self._mem_g.labels(stat=key).set(float(v))
+                            out[f"step_timeline/memory_{key}"] = float(v)
+                live = sum(int(a.nbytes) for a in jax.live_arrays())
+                self._live_g.set(float(live))
+                out["step_timeline/live_array_bytes"] = float(live)
+            except Exception:  # telemetry must never fail the step
+                logger.exception("memory telemetry sample failed")
+        if self._detector is not None:
+            retraces = self._detector.total_retraces()
+            if retraces:
+                out["step_timeline/jit_retraces_after_warmup"] = float(
+                    retraces
+                )
+        return out

@@ -333,6 +333,30 @@ class Tracer:
         return len(spans)
 
 
+def read_spans_jsonl(*paths: str) -> list[dict]:
+    """Load span dicts from one or more jsonl exports (client + each
+    server) for a merged :func:`chrome_trace` — the cross-plane Perfetto
+    join recipe. Garbled lines are skipped (a torn tail from a killed
+    process must not void the rest of the trace)."""
+    spans: list[dict] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(d, dict) and "span_id" in d:
+                        spans.append(d)
+        except OSError:
+            continue
+    return spans
+
+
 # ---------------------------------------------------------------------------
 # Chrome / Perfetto trace_event export
 # ---------------------------------------------------------------------------

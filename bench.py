@@ -60,6 +60,10 @@ PARTIAL_PATH = os.path.join(
 
 WALL_S = float(os.environ.get("AREAL_BENCH_WALL_S", "6000"))
 _T0 = time.time()
+# one id per bench invocation, stamped on every emitted record: the
+# rehearsal file is an APPENDED trajectory (the perf-regression sentinel
+# groups and compares runs), not a per-run scratch file
+RUN_ID = f"{int(_T0)}-{os.getpid()}"
 
 
 def log(msg: str):
@@ -74,6 +78,7 @@ def emit(record: dict):
     """One metric line on stdout + append to the partial file."""
     if REHEARSAL:
         record = {**record, "rehearsal": True}
+    record = {**record, "run_id": RUN_ID, "ts": round(time.time(), 3)}
     line = json.dumps(record)
     print(line, flush=True)
     try:
@@ -81,6 +86,70 @@ def emit(record: dict):
             f.write(line + "\n")
     except OSError:
         pass
+
+
+def emit_wedged(metric: str, phase: str, timeout_s: float | None):
+    """Wedge forensics: a rung child that TIMED OUT (the rc=124 tunnel
+    failure mode — backend init blocks instead of erroring) records a
+    structured artifact instead of leaving nothing. The sentinel treats
+    wedged records as "no data": never a regression, never a baseline
+    sample."""
+    emit(
+        {
+            "metric": metric,
+            "value": None,
+            "unit": "wedged",
+            "vs_baseline": None,
+            "wedged": True,
+            "phase": phase,
+            "timeout_s": round(float(timeout_s), 1) if timeout_s else None,
+        }
+    )
+
+
+def note_rung_failure(metric: str, phase: str, e: Exception):
+    """Shared rung-failure bookkeeping: log always; emit the wedge
+    artifact when the failure was a child timeout."""
+    log(f"{phase} rung failed: {e}")
+    if isinstance(e, subprocess.TimeoutExpired):
+        emit_wedged(metric, phase, getattr(e, "timeout", None))
+
+
+class BackendWedged(RuntimeError):
+    """The backend probe never resolved within the wall budget (the
+    BENCH_r0*.json rc=124 signature)."""
+
+
+def _load_regression_module():
+    """Load areal_tpu/bench/regression.py BY PATH: the parent process
+    must never import the areal_tpu package (its __init__ pulls jax, and
+    a wedged tunnel holds jax's init lock forever)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "areal_tpu", "bench", "regression.py")
+    spec = importlib.util.spec_from_file_location(
+        "areal_tpu_bench_regression", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def append_rehearsal_verdict(path: str = None):
+    """Self-compare this rehearsal run against its predecessors and
+    append one sentinel verdict line to the trajectory. Best-effort: a
+    sentinel bug must not fail the bench."""
+    try:
+        reg = _load_regression_module()
+        target = path or PARTIAL_PATH
+        report = reg.analyze_file(target)
+        reg.append_verdict(target, report, run_id=RUN_ID)
+        log(reg.render_text(report))
+        return report
+    except Exception as e:  # noqa: BLE001
+        log(f"sentinel self-compare failed: {e}")
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -156,7 +225,7 @@ def probe_backend(deadline: float) -> dict:
         if pause > 0:
             time.sleep(pause)
         backoff = min(backoff * 1.6, 240.0)
-    raise RuntimeError(
+    raise BackendWedged(
         f"TPU backend unavailable after {attempt} probe attempt(s) over "
         f"{WALL_S:.0f}s wall budget: {last_err}"
     )
@@ -1212,11 +1281,14 @@ def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
 
 def main():
     deadline = _T0 + WALL_S
-    # wipe the partial file from any previous run
-    try:
-        os.unlink(PARTIAL_PATH)
-    except OSError:
-        pass
+    if not REHEARSAL:
+        # wipe the partial file from any previous run; the REHEARSAL file
+        # is deliberately append-only — it is the trajectory the
+        # perf-regression sentinel baselines against
+        try:
+            os.unlink(PARTIAL_PATH)
+        except OSError:
+            pass
 
     info = probe_backend(deadline)
     chip = info["device_kind"]
@@ -1246,7 +1318,11 @@ def main():
             kernels.update(res)
         except Exception as e:  # noqa: BLE001
             log(f"kernel config {kc['name']} failed: {e}")
-            kernels[kc["name"]] = {"ok": False, "error": str(e)[-400:]}
+            kernels[kc["name"]] = {
+                "ok": False,
+                "error": str(e)[-400:],
+                "wedged": isinstance(e, subprocess.TimeoutExpired),
+            }
     if kernels:
         n_ok = sum(1 for v in kernels.values() if v.get("ok"))
         emit({
@@ -1287,7 +1363,7 @@ def main():
                 **pd,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"paged-decode rung failed: {e}")
+            note_rung_failure("paged_decode_attention", "paged-decode", e)
 
     # ---- rung 2 (PRIMARY): SFT train throughput ladder ----
     # full model first (adam OOMs a 16GB chip at 1.5B even with bf16
@@ -1357,6 +1433,7 @@ def main():
                         # CONFIRMED wedge consumes the retry budget)
                         outage_retries += 1
                         log("tunnel was wedged; retrying same attempt")
+                        emit_wedged(METRIC, f"sft:{att}", None)
                     else:
                         log("backend live after timeout -> attempt was "
                             "slow; falling back")
@@ -1442,6 +1519,11 @@ def main():
             break
         except Exception as e:  # noqa: BLE001
             log(f"decode bench failed at {datt}: {e}")
+            if isinstance(e, subprocess.TimeoutExpired):
+                emit_wedged(
+                    "decode_tokens_per_sec", "decode",
+                    getattr(e, "timeout", None),
+                )
 
     # ---- rung 3.2: speculative decode — spec-on vs spec-off on a
     # repetitive-prompt workload (n-gram prompt-lookup regime), same
@@ -1488,7 +1570,7 @@ def main():
                 **satt,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"spec decode rung failed: {e}")
+            note_rung_failure("spec_decode_tokens_per_sec", "spec-decode", e)
 
     # ---- rung 3.25: tracing overhead — the PR 8 observability plane's
     # cost contract: full per-request tracing (spans + engine events) on
@@ -1554,7 +1636,7 @@ def main():
                 **tatt,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"tracing overhead rung failed: {e}")
+            note_rung_failure("tracing_overhead", "tracing-overhead", e)
 
     # ---- rung 3.3: prefix cache — GRPO-shaped (same prompt x group) and
     # multi-turn growing-prefix workloads, cache on vs off. vs_baseline is
@@ -1591,7 +1673,9 @@ def main():
                 **pc,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"prefix cache rung failed: {e}")
+            note_rung_failure(
+                "prefix_cache_prefill_reduction", "prefix-cache", e
+            )
 
     # ---- rung 3.5: weight-resync latency (shm vs http, VERDICT r3 #8) ----
     if remaining(deadline) > 420:
@@ -1613,7 +1697,7 @@ def main():
                 **wu,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"weight-update rung failed: {e}")
+            note_rung_failure("weight_update_latency", "weight-update", e)
 
     # ---- rung 3.6: zero-stall weight sync (overlapped vs fenced) ----
     if remaining(deadline) > 420:
@@ -1645,7 +1729,7 @@ def main():
                 **ws,
             })
         except Exception as e:  # noqa: BLE001
-            log(f"weight-sync rung failed: {e}")
+            note_rung_failure("weight_sync_stall_seconds", "weight-sync", e)
 
     # ---- rung 4: full GRPO step (async-RL headline metric) ----
     if remaining(deadline) > 420:
@@ -1664,7 +1748,7 @@ def main():
                 **{k: v for k, v in g.items() if k != "step_sec"},
             })
         except Exception as e:  # noqa: BLE001
-            log(f"grpo rung failed: {e}")
+            note_rung_failure("grpo_step_sec", "grpo", e)
 
     if primary is not None:
         # repeat the primary as the FINAL line (drivers that take the last
@@ -1676,6 +1760,24 @@ def main():
         print(json.dumps(primary), flush=True)
     else:
         raise RuntimeError("all sft bench configurations failed")
+
+
+def _fail_record(e: Exception):
+    """Parseable terminal record (round-1/2 lesson: a wedged tunnel must
+    not leave only a stack trace). A probe that never resolved records
+    the wedge-forensics shape the sentinel knows to skip."""
+    if isinstance(e, BackendWedged):
+        emit_wedged(METRIC, "backend_probe", WALL_S)
+        return
+    emit(
+        {
+            "metric": METRIC,
+            "value": None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "error": str(e)[:500],
+        }
+    )
 
 
 def _child_main():
@@ -1722,13 +1824,12 @@ if __name__ == "__main__":
         except Exception as e:  # backend outage etc. — emit a parseable
             # record instead of only a stack trace (round-1/2 failure mode:
             # the tunnel wedged and the driver recorded value:null)
-            emit(
-                {
-                    "metric": METRIC,
-                    "value": None,
-                    "unit": "tokens/s",
-                    "vs_baseline": None,
-                    "error": str(e)[:500],
-                }
-            )
+            _fail_record(e)
             raise
+        finally:
+            if REHEARSAL:
+                # every rehearsal run self-compares against the appended
+                # trajectory and leaves a sentinel verdict line behind —
+                # the "CPU rehearsal is the live perf signal" constraint,
+                # with teeth
+                append_rehearsal_verdict()

@@ -51,6 +51,7 @@ from areal_tpu.utils.recover import (  # noqa: E402
 )
 from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+from areal_tpu.utils.step_timeline import StepTimeline  # noqa: E402
 from areal_tpu.utils.watchdog import Watchdog  # noqa: E402
 from areal_tpu.workflow.rlvr import RLVRWorkflow  # noqa: E402
 
@@ -179,6 +180,17 @@ def main(argv=None):
                 actor.update_weights(weight_meta)  # full re-push
 
     profiler = StepProfiler(cfg.profiler)
+    # training-plane goodput observatory: per-step phase attribution,
+    # goodput/MFU, memory+recompile telemetry, a `trainer` flight-recorder
+    # channel, and one train.step tracing span per step (sharing the
+    # rollout client's tracer so trainer + rollout spans land in ONE
+    # Perfetto export, joined by weight version)
+    timeline = StepTimeline.from_config(
+        cfg.step_timeline,
+        tracer=rollout._tracer,
+        model_config=actor.model_config,
+        n_chips=actor.mesh.size if actor.mesh is not None else 1,
+    )
     all_rewards = []
     try:
         for global_step in range(start_step, total_steps):
@@ -230,7 +242,10 @@ def main(argv=None):
             profiler_cm.__enter__()
             # profiler.close() in the finally below finalizes the trace if any
             # step raises mid-window
-            with stats_tracker.record_timing("rollout"):
+            timeline.begin_step(global_step)
+            with timeline.phase("rollout"), stats_tracker.record_timing(
+                "rollout"
+            ):
                 try:
                     if cfg.async_training:
                         batch = rollout.prepare_batch(dataloader, workflow=workflow)
@@ -242,31 +257,54 @@ def main(argv=None):
                     graceful_exit()
 
             if cfg.actor.recompute_logprob or cfg.actor.use_decoupled_loss:
-                with stats_tracker.record_timing("recompute_logp"):
+                with timeline.phase("recompute_logp"), stats_tracker.record_timing(
+                    "recompute_logp"
+                ):
                     batch["prox_logp"] = actor.actor.compute_logp(batch)
 
             if ref is not None:
-                with stats_tracker.record_timing("ref_logp"):
+                with timeline.phase("ref_logp"), stats_tracker.record_timing(
+                    "ref_logp"
+                ):
                     batch["ref_logp"] = ref.compute_logp(batch)
 
-            with stats_tracker.record_timing("compute_advantage"):
+            with timeline.phase("compute_advantage"), stats_tracker.record_timing(
+                "compute_advantage"
+            ):
                 actor.actor.compute_advantages(batch)
 
             watchdog.beat("train_step")
-            with stats_tracker.record_timing("train_step"):
+            with timeline.phase("train_step"), stats_tracker.record_timing(
+                "train_step"
+            ):
                 stats = actor.actor.ppo_update(batch)
                 actor.step_lr_scheduler()
             crash_point("post-train-step")
 
             watchdog.beat("update_weights")
-            with stats_tracker.record_timing("update_weights"):
+            with timeline.phase("update_weights"), stats_tracker.record_timing(
+                "update_weights"
+            ):
                 rollout.pause()
                 actor.update_weights(weight_meta)
                 rollout.resume()
 
             mean_reward = float(np.mean(np.asarray(batch["rewards"])))
             all_rewards.append(mean_reward)
+            # close the attribution window BEFORE the commit so this
+            # step's phase breakdown/goodput/MFU ride ITS OWN stats row;
+            # the checkpoint below is recorded as a late phase (it rides
+            # the train.step span and the flight-recorder entry, and its
+            # time_perf/save scalar still exports one step late as before)
+            attn = np.asarray(batch["attention_mask"])
+            tl_row = timeline.end_step(
+                tokens=int(attn.sum()),
+                n_seqs=int(attn.shape[0]),
+                weight_version=actor.get_version(),
+                extra={"profiled": float(profiler.active)},
+            )
             stats[0].update(stats_tracker.export(key="time_perf"))
+            stats[0].update(tl_row)
             stats[0]["grpo/mean_task_reward"] = mean_reward
             # commit BEFORE the recover dump: a kill after the dump's
             # marker flips but before the commit would resume at the next
@@ -281,7 +319,9 @@ def main(argv=None):
             )
 
             watchdog.beat("save")
-            with stats_tracker.record_timing("save"):
+            with timeline.phase("checkpoint"), stats_tracker.record_timing(
+                "save"
+            ):
                 saver.save(
                     actor,
                     step_info,
@@ -305,6 +345,7 @@ def main(argv=None):
     finally:
         # finalize any in-flight profiler trace even when a step dies
         profiler.close()
+        timeline.close()  # end the last train.step span + recorder entry
         watchdog.stop()
         guard.uninstall()
 

@@ -31,6 +31,7 @@ from areal_tpu.utils.profiling import StepProfiler  # noqa: E402
 from areal_tpu.utils.recover import RecoverHandler, check_if_recover  # noqa: E402
 from areal_tpu.utils.saver import Evaluator, Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
+from areal_tpu.utils.step_timeline import StepTimeline  # noqa: E402
 
 logger = logging.getLogger("gsm8k_sft")
 
@@ -110,6 +111,13 @@ def main(argv=None):
     data_iter = iter(dataloader)
     losses = []
     profiler = StepProfiler(cfg.profiler)
+    # training-plane goodput observatory (no rollout plane here: the SFT
+    # breakdown is data / train_step / checkpoint — the minimal shape)
+    timeline = StepTimeline.from_config(
+        cfg.step_timeline,
+        model_config=engine.model_config,
+        n_chips=engine.mesh.size if engine.mesh is not None else 1,
+    )
     try:
         for global_step in range(start_step, total_steps):
             step_info = StepInfo(
@@ -118,15 +126,17 @@ def main(argv=None):
                 global_step=global_step,
                 steps_per_epoch=ft_spec.steps_per_epoch,
             )
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                data_iter = iter(dataloader)
-                batch = next(data_iter)
+            timeline.begin_step(global_step)
+            with timeline.phase("data"):
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    data_iter = iter(dataloader)
+                    batch = next(data_iter)
 
-            with profiler.step(global_step), stats_tracker.record_timing(
+            with profiler.step(global_step), timeline.phase(
                 "train_step"
-            ):
+            ), stats_tracker.record_timing("train_step"):
                 stats = engine.train_lm(batch)
                 engine.step_lr_scheduler()
             losses.append(stats["loss"])
@@ -139,20 +149,27 @@ def main(argv=None):
                 if vl:
                     stats_tracker.scalar(eval_loss=float(np.mean(vl)))
 
-            saver.save(engine, step_info, tokenizer=tokenizer)
-            evaluator.evaluate(eval_fn, step_info)
-            recover_handler.dump(
-                engine,
-                step_info,
-                saver,
-                evaluator,
-                dataloader,
-                slogger,
-                fileroot=cfg.cluster.fileroot,
-                experiment_name=cfg.experiment_name,
-                trial_name=cfg.trial_name,
-                tokenizer=tokenizer,
-                config=cfg,
+            with timeline.phase("checkpoint"):
+                saver.save(engine, step_info, tokenizer=tokenizer)
+                evaluator.evaluate(eval_fn, step_info)
+                recover_handler.dump(
+                    engine,
+                    step_info,
+                    saver,
+                    evaluator,
+                    dataloader,
+                    slogger,
+                    fileroot=cfg.cluster.fileroot,
+                    experiment_name=cfg.experiment_name,
+                    trial_name=cfg.trial_name,
+                    tokenizer=tokenizer,
+                    config=cfg,
+                )
+            attn = np.asarray(batch["attention_mask"])
+            stats.update(
+                timeline.end_step(
+                    tokens=int(attn.sum()), n_seqs=int(attn.shape[0])
+                )
             )
             stats.update(stats_tracker.export())
             slogger.commit(step_info.epoch, step_info.epoch_step, global_step, stats)
@@ -161,6 +178,7 @@ def main(argv=None):
         # a capture window that spans the exit (short run, crash,
         # StopIteration mid-window) must still flush its trace
         profiler.close()
+        timeline.close()
     logger.info("final loss %.4f (start %.4f)", losses[-1], losses[0])
     slogger.close()
     engine.destroy()

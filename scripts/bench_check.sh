@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Perf-regression gate over the bench rehearsal trajectory.
+#
+#   scripts/bench_check.sh               # gate BENCH_REHEARSAL.jsonl
+#                                        # (exit 1 on any regression;
+#                                        # exit 0 when no trajectory yet)
+#   scripts/bench_check.sh --self-test   # fixture-jsonl self-test — runs
+#                                        # without a live bench (wired
+#                                        # into scripts/lint.sh)
+#   scripts/bench_check.sh --json ...    # extra args pass through to
+#                                        # areal_tpu/bench/regression.py
+#
+# The sentinel builds a median + MAD noise band per rung over trailing
+# runs and classifies the newest run per metric; wedged rungs (child
+# timeouts recorded by bench.py's wedge forensics) are never data.
+#
+# The sentinel runs BY PATH, never as `python -m areal_tpu...`: importing
+# the package pulls jax (areal_tpu/__init__ resolves jax_compat), and on
+# a host with a wedged TPU tunnel — the exact rc=124 failure mode this
+# gate exists to catch — a jax import blocks forever on the init lock.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec python areal_tpu/bench/regression.py "$@"

@@ -24,5 +24,9 @@ if entries:
     sys.exit(1)
 PY
 
+# perf-regression sentinel self-test (fixture jsonl mode — no live bench
+# needed): the bench gate's own contract must hold before it gates anyone
+bash "$(dirname "$0")/bench_check.sh" --self-test
+
 exec python -m areal_tpu.lint areal_tpu tests \
   --baseline .arealint-baseline.json "$@"

@@ -1,0 +1,240 @@
+"""Perf-regression sentinel fixture suite (areal_tpu/bench/regression.py):
+synthetic regression detected, noise-band pass, first-run/no-baseline
+pass, wedged-rung skip, direction inference, verdict append, CLI gate."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+from areal_tpu.bench import regression as reg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _recs(metric, values, unit="tokens/s"):
+    return [{"metric": metric, "value": v, "unit": unit} for v in values]
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_synthetic_20pct_regression_detected():
+    r = reg.analyze(_recs("decode_tokens_per_sec", [100, 102, 98, 101, 80]))
+    v = r["metrics"]["decode_tokens_per_sec"]
+    assert v["status"] == "regression"
+    assert not r["ok"]
+    assert r["regressions"] == ["decode_tokens_per_sec"]
+
+
+def test_noise_band_jitter_passes():
+    r = reg.analyze(_recs("decode_tokens_per_sec", [100, 102, 98, 101, 97]))
+    assert r["ok"]
+    assert r["metrics"]["decode_tokens_per_sec"]["status"] == "ok"
+
+
+def test_first_run_no_baseline_passes():
+    r = reg.analyze(_recs("decode_tokens_per_sec", [100]))
+    assert r["ok"]
+    assert (
+        r["metrics"]["decode_tokens_per_sec"]["status"] == "no_baseline"
+    )
+    # two samples: still below min_samples=2 baseline (1 trailing)
+    r = reg.analyze(_recs("decode_tokens_per_sec", [100, 50]))
+    assert r["ok"]
+
+
+def test_wedged_rung_is_no_data_never_regression_or_baseline():
+    recs = _recs("decode_tokens_per_sec", [100, 101, 99])
+    # wedged record inside the history: excluded from the baseline
+    recs.insert(
+        1,
+        {"metric": "decode_tokens_per_sec", "value": None, "wedged": True,
+         "phase": "backend_probe", "timeout_s": 6000},
+    )
+    # wedged NEWEST: no data, not a regression (rc=124 forensics)
+    recs.append(
+        {"metric": "decode_tokens_per_sec", "value": None, "wedged": True,
+         "phase": "decode", "timeout_s": 900},
+    )
+    r = reg.analyze(recs)
+    v = r["metrics"]["decode_tokens_per_sec"]
+    assert r["ok"] and v["status"] == "no_data"
+    assert v["wedged"] and v["phase"] == "decode"
+
+
+def test_lower_is_better_direction():
+    # a stall that GREW 50% is a regression
+    r = reg.analyze(
+        _recs("weight_sync_stall_seconds", [0.02, 0.021, 0.019, 0.03],
+              unit="s")
+    )
+    assert not r["ok"]
+    # a stall that SHRANK is an improvement, not a regression
+    r = reg.analyze(
+        _recs("weight_sync_stall_seconds", [0.02, 0.021, 0.019, 0.002],
+              unit="s")
+    )
+    assert r["ok"]
+    assert (
+        r["metrics"]["weight_sync_stall_seconds"]["status"] == "improvement"
+    )
+
+
+def test_direction_inference_table():
+    assert not reg.lower_is_better("decode_tokens_per_sec")
+    assert not reg.lower_is_better("sft_train_tokens_per_sec_per_chip_x")
+    assert not reg.lower_is_better("prefix_cache_prefill_reduction")
+    assert not reg.lower_is_better("pallas_kernel_validation")
+    assert reg.lower_is_better("grpo_step_sec")
+    assert reg.lower_is_better("weight_update_latency", "s_shm")
+    assert reg.lower_is_better("weight_sync_stall_seconds", "s")
+    assert reg.lower_is_better("anything", "s")
+
+
+def test_improvement_and_mad_band():
+    # tight history: MAD ~ 1, band = max(3*1.4826*1, 0.1*100) = 10
+    r = reg.analyze(_recs("m_per_sec", [100, 101, 99, 100, 112]))
+    assert r["metrics"]["m_per_sec"]["status"] == "improvement"
+    r = reg.analyze(_recs("m_per_sec", [100, 101, 99, 100, 109]))
+    assert r["metrics"]["m_per_sec"]["status"] == "ok"
+
+
+def test_run_grouping_duplicates_collapse_and_absent_rung_is_no_data():
+    """Run-aware analysis: duplicate emissions within one run collapse
+    (last wins, never polluting that run's own baseline), and a metric
+    with NO sample in the newest run — a rung that crashed without even
+    a timeout — is no_data, not silently judged on the previous run's
+    stale value."""
+    recs = [
+        {"metric": "a_per_sec", "value": 100, "run_id": "r1"},
+        {"metric": "b_per_sec", "value": 50, "run_id": "r1"},
+        {"metric": "a_per_sec", "value": 101, "run_id": "r2"},
+        # duplicate within r2: collapses to the later 99
+        {"metric": "a_per_sec", "value": 42, "run_id": "r2"},
+        {"metric": "a_per_sec", "value": 99, "run_id": "r2"},
+        {"metric": "a_per_sec", "value": 100, "run_id": "r3"},
+        # b_per_sec emitted NOTHING in r2/r3
+    ]
+    r = reg.analyze(recs)
+    assert r["ok"]
+    a = r["metrics"]["a_per_sec"]
+    # baseline = one sample per prior run ([100, 99]) — the 42/101
+    # duplicates collapsed; 2 samples reach min_samples
+    assert a["status"] == "ok" and a["n_baseline"] == 2
+    b = r["metrics"]["b_per_sec"]
+    assert b["status"] == "no_data"
+    assert b["absent_from_run"] == "r3"
+    assert b["last_seen_run"] == "r1"
+
+
+def test_legacy_lines_without_run_id_each_stand_alone():
+    """Pre-run_id trajectory lines (PR 7/8 appends) each count as their
+    own run sample, so the existing history still baselines."""
+    recs = _recs("m_per_sec", [100, 101, 99, 100])  # no run_id anywhere
+    recs.append({"metric": "m_per_sec", "value": 70, "run_id": "r9"})
+    r = reg.analyze(recs)
+    assert r["metrics"]["m_per_sec"]["status"] == "regression"
+    assert r["metrics"]["m_per_sec"]["n_baseline"] == 4
+
+
+def test_sentinel_verdict_lines_are_not_data(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_jsonl(path, _recs("m_per_sec", [100, 101, 99, 100]))
+    report = reg.analyze_file(path)
+    reg.append_verdict(path, report, run_id="r1")
+    # re-analysis sees the same 4 data records, not 5
+    again = reg.analyze_file(path)
+    assert again["n_records"] == 4
+    last = json.loads(open(path).read().strip().splitlines()[-1])
+    assert last["metric"] == reg.SENTINEL_METRIC
+    assert last["run_id"] == "r1"
+    assert last["verdicts"]["m_per_sec"] == "ok"
+
+
+def test_garbled_lines_skipped(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"metric": "m", "value": 1.0}) + "\n")
+        f.write("{torn tail\n")
+        f.write("not json at all\n")
+    assert len(reg.load_records(path)) == 1
+
+
+def test_self_test_passes():
+    assert reg.self_test() == 0
+
+
+def test_cli_gates_regression(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_jsonl(path, _recs("m_per_sec", [100, 101, 99, 100, 70]))
+    assert reg.main(["--jsonl", path]) == 1
+    _write_jsonl(path, _recs("m_per_sec", [100, 101, 99, 100, 99]))
+    assert reg.main(["--jsonl", path]) == 0
+    # missing trajectory: nothing to gate, pass
+    assert reg.main(["--jsonl", str(tmp_path / "missing.jsonl")]) == 0
+
+
+def test_bench_check_script_self_test():
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "bench_check.sh"),
+         "--self-test"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_bench_parent_loads_sentinel_without_jax(tmp_path):
+    """bench.py's by-path loader keeps the no-jax-in-parent invariant and
+    appends a verdict line after a rehearsal run (pinned without running
+    the full ladder: drive the append helper in a fresh interpreter)."""
+    traj = str(tmp_path / "traj.jsonl")
+    _write_jsonl(traj, _recs("m_per_sec", [100, 99, 101, 100]))
+    code = f"""
+import importlib.util, json, sys
+sys.argv = ["bench.py"]
+spec = importlib.util.spec_from_file_location("benchmod", {json.dumps(os.path.join(REPO, "bench.py"))})
+m = importlib.util.module_from_spec(spec); sys.modules["benchmod"] = m
+spec.loader.exec_module(m)
+assert "jax" not in sys.modules, "bench parent imported jax"
+report = m.append_rehearsal_verdict({json.dumps(traj)})
+assert report is not None and report["ok"], report
+assert "jax" not in sys.modules, "sentinel pulled jax into the parent"
+print("OK")
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    last = json.loads(open(traj).read().strip().splitlines()[-1])
+    assert last["metric"] == reg.SENTINEL_METRIC
+
+
+def test_bench_emit_wedged_shape(tmp_path, monkeypatch):
+    """The wedge-forensics record bench.py writes on a child timeout has
+    the exact shape the sentinel skips."""
+    spec = importlib.util.spec_from_file_location(
+        "benchmod2", os.path.join(REPO, "bench.py")
+    )
+    m = importlib.util.module_from_spec(spec)
+    sys.modules["benchmod2"] = m
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    spec.loader.exec_module(m)
+    monkeypatch.setattr(m, "PARTIAL_PATH", str(tmp_path / "p.jsonl"))
+    m.emit_wedged("decode_tokens_per_sec", "decode", 900.0)
+    rec = json.loads(open(tmp_path / "p.jsonl").read())
+    assert rec["wedged"] is True
+    assert rec["phase"] == "decode"
+    assert rec["timeout_s"] == 900.0
+    assert rec["value"] is None
+    assert "run_id" in rec
+    r = reg.analyze([rec])
+    assert r["metrics"]["decode_tokens_per_sec"]["status"] == "no_data"
