@@ -731,6 +731,65 @@ class CircuitBreakerConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Elastic rollout-fleet controller (areal_tpu/fleet/): closes the loop
+    from observed serving load (admission queue depth/wait, TTFT p95,
+    in-flight skew, rollout-wait fraction) to fleet size. The controller
+    spawns servers through a provider (local subprocess now; slurm/gke share
+    the signature), gates newcomers on ``GET /ready`` + a version-checked
+    warmup before they enter rotation, and drains scale-in victims AFTER
+    removing them from routing so in-flight requests finish or fail over."""
+
+    enabled: bool = False
+    # hard fleet-size bounds the policy may never cross
+    min_servers: int = 1
+    max_servers: int = 4
+    # servers the controller bootstraps at start (None = min_servers);
+    # ignored when the fleet was already booted by a launcher
+    initial_servers: int | None = None
+    # how often the background controller thread evaluates the policy
+    decide_interval_seconds: float = 5.0
+    # policy: "target_tracking" (scale on load signals) | "manual"
+    # (set_size() only)
+    policy: str = "target_tracking"
+    # consecutive breached evaluations required before acting (hysteresis —
+    # one spiky sample must not flap the fleet)
+    breach_evaluations: int = 2
+    # post-action cooldowns: no further scale-out/in until these elapse
+    # (scale-in is slower by default; killing warm KV is expensive)
+    scale_out_cooldown_seconds: float = 15.0
+    scale_in_cooldown_seconds: float = 60.0
+    # servers added/removed per decision
+    scale_step: int = 1
+    # --- target-tracking thresholds (0 disables that signal) ---
+    # scale OUT when admission queue depth per server exceeds this ...
+    queue_depth_high_per_server: float = 4.0
+    # ... and IN when it drops below this on every server
+    queue_depth_low_per_server: float = 0.5
+    # scale OUT when the fleet-max TTFT p95 exceeds this (seconds)
+    ttft_p95_high_seconds: float = 0.0
+    # scale OUT when the trainer's rollout-wait fraction (PR 9 StepTimeline
+    # counters: blocked-in-wait() wall over elapsed wall) exceeds this
+    rollout_wait_fraction_high: float = 0.0
+    # --- lifecycle ---
+    # newcomer must pass GET /ready (model loaded AND weights at the
+    # required version) within this budget or it is terminated and never
+    # enters rotation
+    ready_timeout_seconds: float = 300.0
+    # SIGTERM -> SIGKILL grace for scale-in victims (the PR 4 drain path:
+    # in-flight requests finish or fail over within it)
+    drain_grace_seconds: float = 30.0
+    # per-server /model_info signal-poll timeout
+    signal_timeout_seconds: float = 2.0
+    # provider: "local" (subprocess on this host) | "slurm" | "gke" (stubs)
+    provider: str = "local"
+    # argv template for provider-spawned servers ("{port}"/"{server_id}"
+    # substituted); empty = the launcher exports one via
+    # AREAL_FLEET_SERVER_ARGV (launcher/local.py)
+    server_argv: list[str] = field(default_factory=list)
+
+
+@dataclass
 class ChaosRuleConfig:
     """One deterministic fault-injection rule (utils/chaos.py). ``endpoint``
     is a substring matched against the request path ("*" = all); ``action``
@@ -816,6 +875,14 @@ class InferenceEngineConfig:
     # depth x chunked_mem_mb beyond the in-flight chunk; 1 = classic
     # lockstep (encode only after every server took the previous chunk)
     weight_update_pipeline_depth: int = 2
+    # per-server rollout concurrency: when set, the staleness manager's
+    # max-concurrent-rollout capacity is rollouts_per_server x the LIVE
+    # fleet size, recomputed on every membership change (scale-out raises
+    # the ceiling, scale-in lowers it) instead of being frozen at the
+    # boot-time server count. None keeps the static max_concurrent_rollouts
+    rollouts_per_server: int | None = None
+    # elastic rollout-fleet controller (areal_tpu/fleet/)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     # client-side deterministic fault injection (tests/rehearsals)
     chaos: ChaosConfig | None = None
     # distributed rollout tracing (client plane: rollout + generate spans,

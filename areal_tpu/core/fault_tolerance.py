@@ -311,6 +311,14 @@ class ServerHealthTracker:
                     required_version, h.required_version or 0
                 )
 
+    def forget(self, addr: str) -> None:
+        """Drop every record for an address that LEFT the fleet (scale-in,
+        deregistration). Without this, a departed server's window gauges
+        export forever and — worse — a later server reusing the address
+        would inherit its breaker state and required_version."""
+        with self._lock:
+            self._servers.pop(addr, None)
+
     # ------------------------------------------------------------ inspection
 
     def state(self, addr: str) -> str:

@@ -98,6 +98,9 @@ class RemoteInfEngine(InferenceEngine):
         self._discovered_via_nr = False
         self._last_server_refresh = 0.0
         self._refresh_thread: threading.Thread | None = None
+        # addresses missing from the LAST resolve; a second consecutive
+        # miss confirms departure (partial-listing protection)
+        self._refresh_missing: set[str] = set()
         # last disk weight-update meta, so a quarantined server's rejoin
         # probe can re-push the update it missed
         self._last_disk_update: tuple[str, int] | None = None
@@ -114,6 +117,13 @@ class RemoteInfEngine(InferenceEngine):
         # destroy() racing a push unblocks the caller's .result() instead
         # of hanging it on a stopped loop
         self._push_futures: set = set()
+        # membership fence: every weight-update/fence fan-out holds this
+        # across its whole stream, and add_server/remove_server acquire it
+        # — so a server can never JOIN mid-stream (and miss chunks it would
+        # need to commit) or LEAVE mid-stream (tearing the fan-out's target
+        # set). A membership change racing an update simply defers until
+        # the stream settles; an RLock so nested fenced paths compose.
+        self._membership_lock = threading.RLock()
 
     # ------------------------------------------------------------------
     # lifecycle / discovery
@@ -147,6 +157,10 @@ class RemoteInfEngine(InferenceEngine):
             # executor per rank) must not shrink its staleness capacity
             train_data_parallel_size = 1
         self.executor.initialize(train_data_parallel_size)
+        # with rollouts_per_server set, the staleness capacity tracks the
+        # live fleet size from the very first step — not only after the
+        # first membership change
+        self.executor.on_fleet_resize(len(self.addresses))
         # unified metrics: the per-server health windows (latency p50/p95,
         # failure rate, breaker state) become scrapeable gauges via a
         # collector — they previously fed routing only
@@ -205,14 +219,159 @@ class RemoteInfEngine(InferenceEngine):
         except Exception as e:
             logger.debug("server refresh failed: %s", e)
             return
-        new = sorted(set(addrs) - set(self.addresses))
-        if new:
-            # departed servers stay listed: their breaker opens on the
-            # first failures and the probe loop retires them from routing.
-            # list.extend is atomic under the GIL; choose_server snapshots
-            # via list comprehension
-            self.addresses.extend(new)
-            logger.info("server refresh: %d new server(s) joined: %s", len(new), new)
+        resolved = set(addrs)
+        if not resolved:
+            # an empty resolve is indistinguishable from a flaky/cleared
+            # name_resolve backend — it must never dismantle the rotation
+            logger.warning(
+                "server refresh resolved ZERO servers; keeping the current "
+                "rotation of %d",
+                len(self.addresses),
+            )
+            self._refresh_missing = set()
+            return
+        new = sorted(resolved - set(self.addresses))
+        gone = set(self.addresses) - resolved
+        for a in new:
+            self.add_server(a, source="discovery")
+        # a deregistered entry IS a departed server (crash cleanup or fleet
+        # drain): drop it from rotation promptly instead of letting it burn
+        # timeout x retries per request until its breaker trips. But a
+        # PARTIAL listing from a flaky backend must not mass-remove healthy
+        # servers, so removal requires the address missing from TWO
+        # consecutive resolves (an address that reappears clears itself).
+        confirmed = gone & getattr(self, "_refresh_missing", set())
+        self._refresh_missing = gone - confirmed
+        for a in sorted(confirmed):
+            self.remove_server(a, reason="deregistered")
+
+    # ------------------------------------------------------------------
+    # push-aware membership (elastic fleet)
+    # ------------------------------------------------------------------
+
+    def add_server(self, addr: str, source: str = "fleet") -> bool:
+        """Admit ``addr`` to the rotation. Fenced against in-flight weight
+        fan-outs: a server may never join mid-stream and miss chunks — the
+        call blocks until the stream settles (the fleet controller warms a
+        newcomer to the current version BEFORE admitting it, and re-checks
+        the version after a deferred join). Returns False if already
+        present."""
+        with self._membership_lock:
+            if addr in self.addresses:
+                return False
+            self.addresses.append(addr)
+            if (
+                source == "discovery"
+                and self._version > 0
+                and self.config.breaker.enabled
+            ):
+                # a server that appeared via name_resolve while weight
+                # updates have already happened holds an UNKNOWN version:
+                # quarantine it at the current one, so the version-checked
+                # rejoin probe (re-pushing the last disk update if stale)
+                # admits it — a fleet-controller join skips this because
+                # its warmup already proved the version
+                self._health.quarantine(addr, required_version=self._version)
+            self.executor.on_fleet_resize(len(self.addresses))
+            logger.info(
+                "membership: %s joined the rotation (%s; fleet=%d)",
+                addr, source, len(self.addresses),
+            )
+            return True
+
+    def remove_server(self, addr: str, reason: str = "fleet") -> bool:
+        """Retire ``addr`` from the rotation (scale-in, deregistration).
+        Routing stops immediately: the address leaves the candidate list,
+        its rid affinities drop (in-flight requests to it finish or fail
+        over with their accumulated tokens replayed — the token-exact
+        splice), and rendezvous hashing remaps ONLY this server's prefix-
+        affinity keys. Fenced like :meth:`add_server`: a removal racing a
+        weight fan-out defers until the stream settles (no torn target
+        set). Returns False if the address was not in rotation."""
+        with self._membership_lock:
+            if addr not in self.addresses:
+                return False
+            if len(self.addresses) == 1:
+                logger.warning(
+                    "membership: refusing to remove %s — it is the LAST "
+                    "server in rotation (%s)",
+                    addr, reason,
+                )
+                return False
+            self.addresses.remove(addr)
+            # snapshot first: this runs on the controller/refresh thread
+            # while the rollout loop inserts into the dict — list(items())
+            # is a single C-level copy under the GIL, a bytecode-level
+            # comprehension over the live dict can raise mid-iteration
+            for rid in [
+                r
+                for r, a in list(self._rid_to_address.items())
+                if a == addr
+            ]:
+                self._drop_rid_affinity(rid)
+            self._health.forget(addr)
+            self.executor.on_fleet_resize(len(self.addresses))
+            logger.info(
+                "membership: %s left the rotation (%s; fleet=%d)",
+                addr, reason, len(self.addresses),
+            )
+            return True
+
+    def inflight_snapshot(self) -> dict[str, int]:
+        """Per-address in-flight request counts (fleet-controller load
+        signal: inflight skew, scale-in victim selection)."""
+        with self._inflight_lock:
+            return dict(self._inflight)
+
+    def affinity_load(self, addr: str) -> int:
+        """How many rid affinities currently map to ``addr`` (scale-in
+        victim selection: the fewest affinities = the cheapest KV loss).
+        Snapshots the dict — callers run off the rollout loop thread."""
+        return sum(1 for a in list(self._rid_to_address.values()) if a == addr)
+
+    def warmup_server(self, addr: str, timeout: float | None = None) -> bool:
+        """Warm a newcomer before admitting it to rotation: wait for its
+        ``GET /ready`` gate (model loaded), then run the same version
+        check/re-push path the breaker rejoin probe uses — if the server
+        sits below the client's current version and a disk update artifact
+        exists, it is re-pushed and re-checked. Returns True when the
+        server is ready AT the current version (or no version has ever
+        been committed). Synchronous; runs on the persistent push loop."""
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.config.setup_timeout
+        )
+        required = self._version
+
+        async def _warm():
+            session = await self._push_session()
+            probe_timeout = self.config.breaker.probe_timeout_seconds
+            while time.monotonic() < deadline:
+                try:
+                    async with session.get(
+                        f"http://{addr}/ready",
+                        timeout=aiohttp.ClientTimeout(total=probe_timeout),
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    logger.debug("warmup: %s not ready yet: %s", addr, e)
+                await asyncio.sleep(0.2)
+            else:
+                return False
+            if required <= 0:
+                return True
+            version = await self._probe_version(
+                session, addr, required, probe_timeout
+            )
+            return version is not None and version >= required
+
+        try:
+            return bool(self._run_push(_warm()))
+        except Exception as e:
+            logger.warning("warmup of %s failed: %s", addr, e)
+            return False
 
     def destroy(self):
         if getattr(self, "_health_collector", None) is not None:
@@ -916,17 +1075,21 @@ class RemoteInfEngine(InferenceEngine):
             await asyncio.sleep(interval)
 
     async def _probe_open_servers(self, session) -> None:
-        """One probe sweep: GET /health on every OPEN server past its
-        cooldown; quarantined servers additionally pass a version check
-        (re-pushing the last disk weight update they missed, if any).
-        Success moves the breaker to HALF_OPEN; trial traffic closes it."""
+        """One probe sweep: GET /ready on every OPEN server past its
+        cooldown — the READINESS gate, not bare liveness: a restarted
+        server that is alive but still loading its model answers /health
+        200 long before it can serve, and trial traffic would re-open the
+        breaker for nothing. Quarantined servers additionally pass a
+        version check (re-pushing the last disk weight update they missed,
+        if any). Success moves the breaker to HALF_OPEN; trial traffic
+        closes it."""
         probe_timeout = self.config.breaker.probe_timeout_seconds
         for addr in self._health.probe_candidates():
             ok = False
             version: int | None = None
             try:
                 async with session.get(
-                    f"http://{addr}/health",
+                    f"http://{addr}/ready",
                     timeout=aiohttp.ClientTimeout(total=probe_timeout),
                 ) as resp:
                     ok = resp.status == 200
@@ -1022,6 +1185,10 @@ class RemoteInfEngine(InferenceEngine):
                 f"weight update type {meta.type!r}; device path is driven by "
                 "the train engine (colocated) — see TPUTrainEngine.update_weights"
             )
+        with self._membership_lock:  # no join/leave mid-fan-out
+            return self._update_weights_locked(meta)
+
+    def _update_weights_locked(self, meta: WeightUpdateMeta):
         next_version = self._version + 1
         save_ts = time.time_ns()
         targets = self._update_targets(next_version)
@@ -1120,6 +1287,17 @@ class RemoteInfEngine(InferenceEngine):
         refuses (HTTP 412, non-retriable) when its version differs, so a
         server that silently restarted at the same address can never
         commit a mixed old/new tree."""
+        with self._membership_lock:  # no join/leave mid-stream
+            return self._update_weights_from_tensors_locked(
+                chunks, next_version, delta_base_version
+            )
+
+    def _update_weights_from_tensors_locked(
+        self,
+        chunks,
+        next_version: int,
+        delta_base_version: int | None = None,
+    ) -> float:
         from safetensors.numpy import save as st_save
 
         from areal_tpu.utils import stats_tracker
@@ -1263,6 +1441,14 @@ class RemoteInfEngine(InferenceEngine):
         unacked-bytes ledger (one-shot await_pull entries cannot be
         withdrawn) and the next push attempt logs the leak.
         """
+        with self._membership_lock:  # no join/leave mid-stream
+            return self._update_weights_from_device_transfer_locked(
+                chunks, next_version
+            )
+
+    def _update_weights_from_device_transfer_locked(
+        self, chunks, next_version: int
+    ) -> float:
         import jax
 
         from areal_tpu.utils import device_transfer, stats_tracker
@@ -1391,6 +1577,17 @@ class RemoteInfEngine(InferenceEngine):
         live in /dev/shm beyond the in-flight one); each chunk file is
         unlinked once every live server acknowledged it.
         """
+        with self._membership_lock:  # no join/leave mid-stream
+            return self._update_weights_from_shm_locked(
+                chunks, next_version, delta_base_version
+            )
+
+    def _update_weights_from_shm_locked(
+        self,
+        chunks,
+        next_version: int,
+        delta_base_version: int | None = None,
+    ) -> float:
         import uuid
 
         from safetensors.numpy import save_file as st_save_file
@@ -1483,6 +1680,12 @@ class RemoteInfEngine(InferenceEngine):
         megabytes — instead of the gigabyte full-parameter stream, which is
         the operational point of LoRA in async RL. Runs on the persistent
         push loop; single-payload, so there is nothing to pipeline."""
+        with self._membership_lock:  # no join/leave mid-fan-out
+            return self._update_lora_weights_locked(named, scale, next_version)
+
+    def _update_lora_weights_locked(
+        self, named: dict, scale: float, next_version: int
+    ) -> float:
         from safetensors.numpy import save as st_save
 
         from areal_tpu.utils import stats_tracker
@@ -1579,6 +1782,12 @@ class RemoteInfEngine(InferenceEngine):
         if self._spectator:
             self._version = version
             return []
+        with self._membership_lock:  # no join/leave mid-reconcile
+            return self._reconcile_after_recover_locked(meta, version)
+
+    def _reconcile_after_recover_locked(
+        self, meta: WeightUpdateMeta, version: int
+    ) -> list[str]:
         self.set_version(version)
         if meta.type != "disk":
             raise NotImplementedError(
@@ -1671,26 +1880,29 @@ class RemoteInfEngine(InferenceEngine):
         live server quarantines it rather than aborting the step — its
         in-flight tokens carry per-token versions, so decoupled PPO stays
         correct even if it kept generating through the update."""
-        targets = [a for a in self.addresses if self._health.state(a) != OPEN]
+        with self._membership_lock:  # consistent fence target set
+            targets = [
+                a for a in self.addresses if self._health.state(a) != OPEN
+            ]
 
-        async def _go():
-            session = await self._push_session()
-            return await asyncio.gather(
-                *[
-                    arequest_with_retry(
-                        session,
-                        f"http://{a}/{endpoint}",
-                        payload={},
-                        max_retries=self.config.request_retries,
-                        timeout=self.config.pause_continue_request_timeout,
-                        chaos=self._chaos,
-                    )
-                    for a in targets
-                ],
-                return_exceptions=True,
-            )
+            async def _go():
+                session = await self._push_session()
+                return await asyncio.gather(
+                    *[
+                        arequest_with_retry(
+                            session,
+                            f"http://{a}/{endpoint}",
+                            payload={},
+                            max_retries=self.config.request_retries,
+                            timeout=self.config.pause_continue_request_timeout,
+                            chaos=self._chaos,
+                        )
+                        for a in targets
+                    ],
+                    return_exceptions=True,
+                )
 
-        results = self._run_push(_go())
+            results = self._run_push(_go())
         for a, r in zip(targets, results):
             if isinstance(r, BaseException):
                 logger.warning(

@@ -31,6 +31,16 @@ class StalenessManager:
         self._lock = threading.Lock()
         self._stat = RolloutStat()  # guarded_by: _lock
 
+    def set_max_concurrent_rollouts(self, n: int) -> None:
+        """Retune the concurrency budget at runtime (elastic fleet: capacity
+        follows the live server count instead of the boot-time one). Only
+        the ceiling moves — the submitted/accepted/rejected/running counters
+        are untouched, so ``submitted == accepted + rejected + running``
+        holds across a resize; in-flight rollouts above a lowered ceiling
+        simply finish while ``get_capacity`` reports negative slack."""
+        with self._lock:
+            self.max_concurrent_rollouts = max(1, int(n))
+
     def get_capacity(self, current_version: int) -> int:
         """Available rollout slots at ``current_version`` (may be negative)."""
         with self._lock:

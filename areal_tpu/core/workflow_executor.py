@@ -160,8 +160,9 @@ class WorkflowExecutor:
     # ----------------------------------------------------------- lifecycle
 
     def initialize(self, train_data_parallel_size: int | None = None):
+        dp = train_data_parallel_size or 1
+        self._capacity_dp = dp
         if self.staleness_manager is None:
-            dp = train_data_parallel_size or 1
             self.staleness_manager = StalenessManager(
                 max_concurrent_rollouts=max(1, self.max_concurrent_rollouts // dp),
                 consumer_batch_size=max(1, self.consumer_batch_size // dp),
@@ -218,6 +219,24 @@ class WorkflowExecutor:
     def get_capacity(self) -> int:
         version = self.inference_engine.get_version()
         return self.staleness_manager.get_capacity(version)
+
+    def on_fleet_resize(self, n_servers: int) -> None:
+        """Membership change (elastic fleet scale-out/in, discovery drop):
+        with ``rollouts_per_server`` configured, the staleness manager's
+        concurrency ceiling tracks the LIVE server count — the boot-time
+        derivation would otherwise under-feed a grown fleet and overrun a
+        shrunk one. No-op when the knob is unset (static capacity)."""
+        per = getattr(self.config, "rollouts_per_server", None)
+        if not per or self.staleness_manager is None:
+            return
+        dp = getattr(self, "_capacity_dp", 1)
+        cap = max(1, (per * max(1, n_servers)) // max(1, dp))
+        self.staleness_manager.set_max_concurrent_rollouts(cap)
+        logger.info(
+            "fleet resize to %d server(s): max_concurrent_rollouts -> %d",
+            n_servers,
+            cap,
+        )
 
     # -------------------------------------------------------- rollout thread
 

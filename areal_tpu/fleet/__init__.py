@@ -1,0 +1,40 @@
+"""Elastic rollout-fleet tier: policy (desired size from live load),
+provider (server process lifecycle), controller (the loop that ties them
+to the client's membership).
+
+AReaL's architecture decouples the trainer from the inference fleet
+precisely so the rollout side can be resized independently; this package
+closes that loop: the PR 8 health/latency telemetry and the admission
+queue are the load signal, the ``/ready`` gate plus the version-checked
+warmup make scale-OUT safe, and remove-from-routing-then-drain (PR 4
+SIGTERM grace + PR 3 failover re-dispatch) makes scale-IN safe.
+"""
+
+from areal_tpu.fleet.controller import FleetController, build_controller
+from areal_tpu.fleet.policy import (
+    FleetSignals,
+    ManualPolicy,
+    ScaleDecision,
+    TargetTrackingPolicy,
+    build_policy,
+)
+from areal_tpu.fleet.provider import (
+    FleetProvider,
+    LocalSubprocessProvider,
+    ServerHandle,
+    build_provider,
+)
+
+__all__ = [
+    "FleetController",
+    "FleetProvider",
+    "FleetSignals",
+    "LocalSubprocessProvider",
+    "ManualPolicy",
+    "ScaleDecision",
+    "ServerHandle",
+    "TargetTrackingPolicy",
+    "build_controller",
+    "build_policy",
+    "build_provider",
+]

@@ -1,0 +1,593 @@
+"""The elastic-fleet control loop: observed load -> membership changes.
+
+One :class:`FleetController` pairs with one :class:`RemoteInfEngine`
+client. Each ``step()``:
+
+1. gathers :class:`FleetSignals` — per-server ``/model_info`` polls
+   (admission queue depth/wait, TTFT p95), the client's in-flight map
+   (skew), and the PR 9 ``areal_rollout_wait_seconds_total`` counter
+   (trainer rollout-wait fraction);
+2. asks the policy for a desired size (hysteresis/cooldowns/bounds live
+   there);
+3. executes the delta through the provider with the membership-safety
+   protocol:
+
+   - **scale-out**: spawn -> poll ``GET /ready`` while also polling the
+     PROCESS (a newcomer that crashes mid-warmup is reaped and never
+     enters rotation, never counts toward any healthy floor) -> warm via
+     the client's version-checked probe/re-push path -> register in
+     name_resolve -> ``client.add_server`` (fenced: never joins an
+     in-flight weight stream) -> re-check the version in case an update
+     landed while the join was deferred;
+   - **scale-in**: pick the unhealthiest / least-loaded victim ->
+     ``client.remove_server`` FIRST (routing stops; rid affinities drop;
+     rendezvous remaps only the departed server's prefix keys; fenced
+     against weight streams) -> deregister from name_resolve -> SIGTERM
+     drain through the provider (in-flight requests finish, or the client
+     re-dispatches them token-exactly via the PR 3 failover splice).
+
+Every decision and action lands on the flight-recorder ``fleet`` channel,
+the metrics registry (``areal_fleet_*``), and — when tracing is on — a
+``fleet.scale`` span, so a resize is explainable in the same Perfetto
+timeline the rollout and training planes already share.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.fleet.policy import (
+    FleetPolicy,
+    FleetSignals,
+    ScaleDecision,
+    build_policy,
+)
+from areal_tpu.fleet.provider import (
+    FleetProvider,
+    ServerHandle,
+    build_provider,
+)
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.network import find_free_ports
+
+logger = logging.getLogger("fleet.controller")
+
+#: name of the PR 9 counter the rollout-wait-fraction signal derives from
+_WAIT_COUNTER = "areal_rollout_wait_seconds_total"
+
+
+class FleetController:
+    def __init__(
+        self,
+        client,
+        config: FleetConfig,
+        provider: FleetProvider | None = None,
+        policy: FleetPolicy | None = None,
+        clock=time.monotonic,
+        fetch_info=None,
+    ):
+        self.client = client
+        self.config = config
+        self.clock = clock
+        self.provider = provider if provider is not None else build_provider(config)
+        self.policy = policy if policy is not None else build_policy(config, clock)
+        # provider-owned members by address (a launcher-booted server has
+        # no handle here; scale-in drains it via its name_resolve drain key)
+        self._members: dict[str, ServerHandle] = {}
+        self._seq = itertools.count()
+        self._run_tag = uuid.uuid4().hex[:6]
+        # serializes step()/set_size()/close() across threads
+        self._op_lock = threading.Lock()
+        self._fetch_info = (
+            fetch_info if fetch_info is not None else self._default_fetch_info
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # rollout-wait-fraction sampling anchor: (clock_ts, counter_value)
+        self._wait_anchor: tuple[float, float] | None = None
+
+        from areal_tpu.utils import metrics as _metrics
+
+        reg = _metrics.DEFAULT_REGISTRY
+        self._g_size = reg.gauge(
+            "areal_fleet_size", "live rollout servers in rotation"
+        )
+        self._g_desired = reg.gauge(
+            "areal_fleet_desired_size", "policy-desired rollout server count"
+        )
+        self._c_events = reg.counter(
+            "areal_fleet_scale_events_total",
+            "executed fleet scale actions",
+            labels=("direction",),
+        )
+        self._c_warmup_failures = reg.counter(
+            "areal_fleet_warmup_failures_total",
+            "newcomers that failed readiness/warmup and never joined",
+        )
+
+    # ------------------------------------------------------------ signals
+
+    def _default_fetch_info(self, addr: str) -> dict | None:
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/model_info",
+                timeout=self.config.signal_timeout_seconds,
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as e:
+            logger.debug("signal poll of %s failed: %s", addr, e)
+            return None
+
+    def _fetch_ready_status(self, addr: str) -> int | None:
+        try:
+            req = urllib.request.urlopen(
+                f"http://{addr}/ready",
+                timeout=self.config.signal_timeout_seconds,
+            )
+            with req:
+                return req.status
+        except urllib.error.HTTPError as e:  # 503 = not ready yet
+            return e.code
+        except Exception:
+            return None
+
+    def _rollout_wait_fraction(self, now: float) -> float:
+        """Δ(trainer seconds blocked in rollout wait) / Δ(wall) since the
+        previous look — the PR 9 counter turned into a dimensionless load
+        signal. 0.0 until two samples exist (or off the trainer process)."""
+        from areal_tpu.utils import metrics as _metrics
+
+        try:
+            total = float(_metrics.DEFAULT_REGISTRY.counter(_WAIT_COUNTER).value)
+        except Exception:
+            return 0.0
+        anchor = self._wait_anchor
+        self._wait_anchor = (now, total)
+        if anchor is None:
+            return 0.0
+        dt = now - anchor[0]
+        if dt <= 0:
+            return 0.0
+        return max(0.0, min(1.0, (total - anchor[1]) / dt))
+
+    def collect_signals(self, now: float | None = None) -> FleetSignals:
+        now = self.clock() if now is None else now
+        addrs = list(self.client.addresses)
+        depth = 0.0
+        wait_last = 0.0
+        ttft = 0.0
+        reporting = 0
+        if len(addrs) > 1:
+            # poll concurrently: a wedged fleet (the very moment scaling
+            # matters) must cost ONE signal timeout per step, not N — a
+            # serial sweep under _op_lock would outlast decide_interval
+            # and block set_size()/close()
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(addrs)), thread_name_prefix="fleet-sig"
+            ) as pool:
+                infos = list(pool.map(self._fetch_info, addrs))
+        else:
+            infos = [self._fetch_info(a) for a in addrs]
+        for info in infos:
+            if not info:
+                continue
+            reporting += 1
+            depth += float(info.get("admission_queue_depth", 0) or 0)
+            wait_last = max(
+                wait_last, float(info.get("queue_wait_seconds_last", 0) or 0)
+            )
+            ttft = max(ttft, float(info.get("ttft_p95_seconds", 0) or 0))
+        inflight = self.client.inflight_snapshot()
+        per_addr = [inflight.get(a, 0) for a in addrs]
+        skew = (max(per_addr) - min(per_addr)) if per_addr else 0
+        return FleetSignals(
+            queue_depth=depth,
+            queue_wait_last=wait_last,
+            ttft_p95=ttft,
+            inflight_skew=skew,
+            inflight_total=sum(per_addr),
+            rollout_wait_fraction=self._rollout_wait_fraction(now),
+            n_reporting=reporting,
+            n_servers=len(addrs),
+        )
+
+    # ------------------------------------------------------------ observe
+
+    def _note(self, kind: str, **fields) -> None:
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record("fleet", kind, **fields)
+
+    def _trace_scale(self, direction: str, addr: str, reason: str) -> None:
+        tracer = getattr(self.client, "_tracer", None)
+        if tracer is None:
+            return
+        span = tracer.span(
+            "fleet.scale", direction=direction, addr=addr, reason=reason[:200]
+        )
+        span.end()
+
+    # ------------------------------------------------------------- control
+
+    def bootstrap(self) -> list[str]:
+        """Spawn the initial fleet (``initial_servers`` or ``min_servers``)
+        and wait for every member's readiness gate. Returns the addresses;
+        the caller hands them to ``client.initialize`` (or lets discovery
+        find the name_resolve registrations). Servers that fail readiness
+        are reaped and NOT returned."""
+        # clamped: the min/max bounds are hard — a misconfigured
+        # initial_servers must not boot a fleet the policy may never hold
+        target = self.policy.clamp(
+            self.config.initial_servers or self.config.min_servers
+        )
+        addrs: list[str] = []
+        for _ in range(max(1, target)):
+            handle = self._spawn_one()
+            if handle is not None:
+                # bootstrap runs before any weight update exists, so the
+                # readiness gate IS the whole warmup — register right away
+                # for the client's discovery
+                self._register(handle)
+                self._members[handle.addr] = handle
+                addrs.append(handle.addr)
+        return addrs
+
+    def step(self, now: float | None = None) -> ScaleDecision:
+        """One evaluate-and-act cycle (the background thread calls this
+        every ``decide_interval_seconds``; tests drive it directly)."""
+        with self._op_lock:
+            now = self.clock() if now is None else now
+            signals = self.collect_signals(now)
+            current = len(self.client.addresses)
+            decision = self.policy.desired_size(signals, current, now)
+            self._g_size.set(current)
+            self._g_desired.set(decision.desired)
+            if decision.direction != "hold":
+                self._note(
+                    "decision",
+                    desired=decision.desired,
+                    current=decision.current,
+                    reason=decision.reason[:300],
+                    queue_depth=round(signals.queue_depth, 2),
+                    ttft_p95=round(signals.ttft_p95, 4),
+                    rollout_wait_fraction=round(
+                        signals.rollout_wait_fraction, 3
+                    ),
+                )
+                self._execute(decision)
+            return decision
+
+    def set_size(self, n: int) -> ScaleDecision:
+        """Manual resize (clamped to the configured bounds); goes through
+        the exact same lifecycle protocol as a policy decision."""
+        with self._op_lock:
+            current = len(self.client.addresses)
+            desired = self.policy.clamp(int(n))
+            decision = ScaleDecision(
+                desired, current, f"manual set_size({n})"
+            )
+            if decision.direction != "hold":
+                self._note(
+                    "decision",
+                    desired=desired,
+                    current=current,
+                    reason=decision.reason,
+                )
+                self._execute(decision)
+            return decision
+
+    def _execute(self, decision: ScaleDecision) -> None:
+        if decision.desired > decision.current:
+            for _ in range(decision.desired - decision.current):
+                self._scale_out_one(decision.reason)
+        elif decision.desired < decision.current:
+            for _ in range(decision.current - decision.desired):
+                self._scale_in_one(decision.reason)
+
+    # ------------------------------------------------------- scale OUT
+
+    def _spawn_one(self) -> ServerHandle | None:
+        """Spawn + readiness-gate one server. Reaps and returns None on
+        warmup failure — the newcomer never becomes a member."""
+        server_id = f"fleet-{self._run_tag}-{next(self._seq)}"
+        port = find_free_ports(1)[0]
+        handle = self.provider.spawn(server_id, port)
+        deadline = self.clock() + self.config.ready_timeout_seconds
+        ready = False
+        while self.clock() < deadline:
+            if self._stop.is_set():
+                # controller shutdown mid-warmup: reap the newcomer now —
+                # close() must not wait out a 300s readiness deadline
+                self.provider.terminate(handle, grace=0.0)
+                return None
+            if not self.provider.alive(handle):
+                logger.warning(
+                    "newcomer %s (%s) crashed during warmup; it never "
+                    "enters rotation",
+                    server_id,
+                    handle.addr,
+                )
+                self._c_warmup_failures.inc()
+                self._note(
+                    "warmup_failed", addr=handle.addr, server_id=server_id,
+                    why="process died",
+                )
+                self.provider.terminate(handle, grace=0.0)
+                return None
+            if self._fetch_ready_status(handle.addr) == 200:
+                ready = True
+                break
+            time.sleep(0.05)
+        if not ready:
+            logger.warning(
+                "newcomer %s (%s) missed the %.0fs readiness deadline; "
+                "terminating",
+                server_id,
+                handle.addr,
+                self.config.ready_timeout_seconds,
+            )
+            self._c_warmup_failures.inc()
+            self._note(
+                "warmup_failed", addr=handle.addr, server_id=server_id,
+                why="ready timeout",
+            )
+            self.provider.terminate(handle, grace=0.0)
+            return None
+        return handle
+
+    def _scale_out_one(self, reason: str) -> bool:
+        handle = self._spawn_one()
+        if handle is None:
+            return False
+        version_at_warm = self.client.get_version()
+        if version_at_warm > 0 and not self.client.warmup_server(handle.addr):
+            # ready but could not reach the current weight version (no
+            # rejoin artifact, or the re-push failed): never admit a
+            # stale server to rotation — it was never registered either,
+            # so a discovery refresh cannot have seen it
+            logger.warning(
+                "newcomer %s is ready but stale (required v%d); terminating",
+                handle.addr,
+                version_at_warm,
+            )
+            self._c_warmup_failures.inc()
+            self._note(
+                "warmup_failed", addr=handle.addr,
+                server_id=handle.server_id, why="stale weights",
+            )
+            self.provider.terminate(handle, grace=0.0)
+            return False
+        # register only now — after BOTH the readiness gate and the
+        # version-checked warmup — so a discovery refresh can never admit
+        # a loading or stale newcomer (the managed server does not
+        # self-register; this is the only registration it gets)
+        self._register(handle)
+        self._members[handle.addr] = handle
+        # fenced join: blocks while a weight stream is in flight, so the
+        # newcomer can never receive a partial chunk set
+        self.client.add_server(handle.addr, source="fleet-scale-out")
+        if self.client.get_version() > version_at_warm:
+            # an update committed while our join was deferred behind the
+            # membership fence — the newcomer missed it. Re-warm through
+            # the re-push path; failing that, quarantine at the current
+            # version so the rejoin probe (not rotation traffic) fixes it.
+            if not self.client.warmup_server(handle.addr):
+                self.client._health.quarantine(
+                    handle.addr,
+                    required_version=self.client.get_version(),
+                )
+        self._c_events.labels(direction="out").inc()
+        self._note(
+            "scale_out", addr=handle.addr, server_id=handle.server_id,
+            reason=reason[:300], fleet=len(self.client.addresses),
+        )
+        self._trace_scale("out", handle.addr, reason)
+        logger.info("scaled OUT: %s joined (%s)", handle.addr, reason)
+        return True
+
+    # -------------------------------------------------------- scale IN
+
+    def _pick_victim(self) -> str | None:
+        """Unhealthiest first (an OPEN breaker / high failure rate means
+        the server is already dragging the fleet), then least loaded and
+        least affine (fewest in-flight requests + rid affinities — the
+        cheapest KV to throw away); provider-owned members break ties
+        ahead of launcher-booted ones (we can actually reap them)."""
+        candidates = list(self.client.addresses)
+        if len(candidates) <= self.config.min_servers:
+            return None
+        snap = self.client._health.snapshot()
+        inflight = self.client.inflight_snapshot()
+
+        def score(addr: str):
+            s = snap.get(addr, {})
+            return (
+                0 if s.get("state") == "open" else 1,
+                -s.get("window_failure_rate", 0.0),
+                inflight.get(addr, 0) + self.client.affinity_load(addr),
+                0 if addr in self._members else 1,
+                addr,
+            )
+
+        return min(candidates, key=score)
+
+    def _scale_in_one(self, reason: str) -> bool:
+        victim = self._pick_victim()
+        if victim is None:
+            return False
+        # resolve the victim's registration BEFORE touching it: the drain
+        # key for an unmanaged member can only be derived while the
+        # name_resolve entry still exists
+        handle = self._members.get(victim)
+        server_id = (
+            handle.server_id if handle is not None
+            else self._server_id_for(victim)
+        )
+        if handle is None and server_id is None:
+            # no process handle AND no name_resolve registration: there is
+            # no way to actually stop this server — removing it from
+            # routing would orphan a live process holding its chips
+            logger.warning(
+                "scale-in of %s aborted: not provider-owned and no "
+                "registration maps to it (explicit address list?)",
+                victim,
+            )
+            return False
+        # ORDER MATTERS: routing first (fenced against weight streams), so
+        # from this point no new request can land on the victim; in-flight
+        # ones finish inside the drain grace or fail over token-exactly
+        if not self.client.remove_server(victim, reason="scale-in"):
+            return False
+        if handle is not None:
+            self._members.pop(victim, None)
+            self._deregister(victim, server_id=server_id)
+            rc = self.provider.terminate(
+                handle, grace=self.config.drain_grace_seconds
+            )
+            logger.info("scaled IN: %s drained (rc=%s; %s)", victim, rc, reason)
+        else:
+            # launcher-booted member: no process handle — request a drain
+            # through its name_resolve key FIRST (the server deregisters
+            # itself and exits; the launcher reads that as benign), then
+            # drop the registration so other clients' refresh sees it gone
+            self._request_drain(victim, server_id)
+            self._deregister(victim, server_id=server_id)
+            logger.info(
+                "scaled IN: drain requested for unmanaged %s (%s)",
+                victim,
+                reason,
+            )
+        self._c_events.labels(direction="in").inc()
+        self._note(
+            "scale_in", addr=victim, reason=reason[:300],
+            fleet=len(self.client.addresses),
+            managed=handle is not None,
+        )
+        self._trace_scale("in", victim, reason)
+        return True
+
+    # ----------------------------------------------------- name_resolve
+
+    def _exp_trial(self) -> tuple[str, str]:
+        cfg = self.client.config
+        return cfg.experiment_name, cfg.trial_name
+
+    def _register(self, handle: ServerHandle) -> None:
+        exp, trial = self._exp_trial()
+        try:
+            name_resolve.add(
+                names.gen_server(exp, trial, handle.server_id),
+                handle.addr,
+                replace=True,
+            )
+        except Exception as e:
+            logger.debug("name_resolve registration failed: %s", e)
+
+    def _server_id_for(self, addr: str) -> str | None:
+        exp, trial = self._exp_trial()
+        root = names.gen_servers(exp, trial)
+        try:
+            for key in name_resolve.find_subtree(root):
+                if name_resolve.get(key) == addr:
+                    return key.rsplit("/", 1)[-1]
+        except Exception:
+            pass
+        return None
+
+    def _deregister(self, addr: str, server_id: str | None = None) -> None:
+        exp, trial = self._exp_trial()
+        if server_id is None:
+            handle = self._members.get(addr)  # _members is keyed by addr
+            server_id = (
+                handle.server_id if handle is not None
+                else self._server_id_for(addr)
+            )
+        if server_id is None:
+            return
+        try:
+            name_resolve.delete(names.gen_server(exp, trial, server_id))
+        except Exception:
+            pass
+
+    def _request_drain(self, addr: str, server_id: str | None) -> None:
+        exp, trial = self._exp_trial()
+        if server_id is None:
+            logger.warning(
+                "cannot drain unmanaged %s: no name_resolve registration "
+                "maps to it",
+                addr,
+            )
+            return
+        try:
+            name_resolve.add(
+                names.gen_server_drain(exp, trial, server_id),
+                addr,
+                replace=True,
+            )
+            self._note("drain_requested", addr=addr, server_id=server_id)
+        except Exception as e:
+            logger.warning("drain request for %s failed: %s", addr, e)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Run ``step()`` every ``decide_interval_seconds`` on a daemon
+        thread until :meth:`stop`/:meth:`close`."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(self.config.decide_interval_seconds):
+                try:
+                    self.step()
+                except Exception:
+                    logger.exception("fleet controller step failed")
+
+        self._thread = threading.Thread(
+            target=_loop, name="fleet-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the decision loop; the fleet keeps its current size."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+        self._thread = None
+
+    def close(self) -> None:
+        """Stop the loop AND reap every provider-owned member (drain
+        grace applies). Launcher-booted members are left running — the
+        launcher owns their lifecycle."""
+        self.stop()
+        with self._op_lock:
+            for addr, handle in sorted(self._members.items()):
+                self._deregister(addr)
+                self.provider.terminate(
+                    handle, grace=self.config.drain_grace_seconds
+                )
+            self._members.clear()
+            self.provider.close()
+
+
+def build_controller(
+    client,
+    config: FleetConfig | None = None,
+    **kwargs,
+) -> FleetController:
+    """Convenience wiring for the trainer entry points: config defaults to
+    ``client.config.fleet``; provider/policy resolve from it (the local
+    provider reads the launcher's AREAL_FLEET_SERVER_ARGV template)."""
+    config = config if config is not None else client.config.fleet
+    return FleetController(client, config, **kwargs)

@@ -1,0 +1,255 @@
+"""Deterministic simulation server for fleet e2e tests and the
+``elastic_fleet`` bench rung.
+
+Speaks the generation-server HTTP protocol (``/generate``, ``/ready``,
+``/health``, ``/model_info``, pause/continue, disk weight updates) with a
+fake model: the next token is a pure function of the full sequence so far,
+so outputs are token-identical across fleet sizes, across failover
+re-dispatch (the replayed ``prompt + accumulated`` continues the exact
+stream), and across runs — exactly the property the elasticity acceptance
+tests pin. Per-token latency and a bounded concurrency slot simulate real
+serving load, so autoscaling measurably changes queue wait and TTFT.
+
+Deliberately imports ONLY stdlib + aiohttp: the local subprocess provider
+execs this file BY PATH (``python .../fleet/harness.py``), so a fleet of
+sim servers spawns in well under a second — no jax, no package import.
+
+Lifecycle knobs mirror the failure modes the chaos tests need:
+``--ready-delay`` (readiness gate lag), ``--crash-before-ready`` (newcomer
+dies mid-warmup), SIGTERM = graceful drain (in-flight requests finish,
+then exit 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import time
+
+from aiohttp import web
+
+
+def next_token(seq: list[int], vocab: int) -> int:
+    """Pure function of the whole sequence: the determinism contract."""
+    h = 0
+    for t in seq[-8:]:
+        h = (h * 1103515245 + int(t) + 12345) & 0x7FFFFFFF
+    h = (h + len(seq) * 2654435761) & 0x7FFFFFFF
+    return h % max(2, vocab)
+
+
+class SimServer:
+    def __init__(self, args):
+        self.args = args
+        self.version = args.version
+        self.started_at = time.monotonic()
+        self.ready_at = self.started_at + args.ready_delay
+        self.paused = False
+        self.inflight = 0
+        self.served_total = 0
+        self.queue_waiters = 0
+        self.queue_wait_last = 0.0
+        self.last_prompt_len = 0
+        self.ttfts: list[float] = []
+        self.sem = asyncio.Semaphore(args.max_concurrency)
+        self.draining = asyncio.Event()
+
+    # -- probes ----------------------------------------------------------
+
+    def _ready(self) -> bool:
+        return time.monotonic() >= self.ready_at
+
+    async def health(self, request):
+        return web.json_response({"status": "ok"})
+
+    async def ready(self, request):
+        if self.args.crash_before_ready and self._ready():
+            # the chaos fixture: die exactly when warmup would pass
+            os._exit(7)
+        if not self._ready():
+            return web.json_response({"status": "initializing"}, status=503)
+        mv = request.query.get("min_version")
+        if mv is not None and self.version < int(mv):
+            return web.json_response(
+                {"status": "stale", "weight_version": self.version},
+                status=503,
+            )
+        return web.json_response(
+            {"status": "ready", "weight_version": self.version}
+        )
+
+    async def model_info(self, request):
+        ttfts = sorted(self.ttfts[-256:])
+        p95 = ttfts[int(0.95 * (len(ttfts) - 1))] if ttfts else 0.0
+        return web.json_response(
+            {
+                "weight_version": self.version,
+                "admission_queue_depth": self.queue_waiters,
+                "queue_wait_seconds_last": self.queue_wait_last,
+                "ttft_p95_seconds": p95,
+                "inflight": self.inflight,
+                "served_total": self.served_total,
+                "last_prompt_len": self.last_prompt_len,
+                "pid": os.getpid(),
+            }
+        )
+
+    # -- serving ---------------------------------------------------------
+
+    async def generate(self, request):
+        body = await request.json()
+        seq = [int(t) for t in body["input_ids"]]
+        self.last_prompt_len = len(seq)
+        params = body.get("sampling_params", {})
+        max_new = int(params.get("max_new_tokens", 16))
+        if self.paused or self.draining.is_set():
+            # weight-update fence / SIGTERM drain: abort with no progress;
+            # the client resumes (or fails over) with its accumulated
+            # tokens replayed as prompt — the token-exact splice
+            return web.json_response(
+                self._payload(seq, [], "abort")
+            )
+        t_arrive = time.monotonic()
+        self.queue_waiters += 1
+        try:
+            await self.sem.acquire()
+        finally:
+            self.queue_waiters -= 1
+        self.queue_wait_last = time.monotonic() - t_arrive
+        self.inflight += 1
+        try:
+            out: list[int] = []
+            first_at = None
+            for _ in range(max_new):
+                if self.paused or self.draining.is_set():
+                    # in-flight at drain time: return the tokens generated
+                    # so far as an abort — the client splices and resumes
+                    # elsewhere token-exactly
+                    return web.json_response(self._payload(seq, out, "abort"))
+                await asyncio.sleep(self.args.token_time)
+                tok = next_token(seq + out, self.args.vocab)
+                out.append(tok)
+                if first_at is None:
+                    first_at = time.monotonic()
+            self.ttfts.append((first_at or time.monotonic()) - t_arrive)
+            self.served_total += 1
+            return web.json_response(self._payload(seq, out, "length"))
+        finally:
+            self.inflight -= 1
+            self.sem.release()
+
+    def _payload(self, prompt, out, stop_reason):
+        return {
+            "input_tokens": prompt,
+            "output_tokens": out,
+            "output_logprobs": [-0.1] * len(out),
+            "output_versions": [self.version] * len(out),
+            "stop_reason": stop_reason,
+            "latency": 0.0,
+            "ttft": 0.0,
+            "itl": [],
+        }
+
+    # -- control plane ---------------------------------------------------
+
+    async def pause(self, request):
+        self.paused = True
+        return web.json_response({"success": True})
+
+    async def resume(self, request):
+        self.paused = False
+        return web.json_response({"success": True})
+
+    async def update_weights_from_disk(self, request):
+        body = await request.json()
+        v = body.get("version")
+        if v is not None:
+            self.version = int(v)
+        else:
+            self.version += 1
+        return web.json_response(
+            {"success": True, "weight_version": self.version}
+        )
+
+    async def abort_request(self, request):
+        return web.json_response({"success": True})
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/health", self.health),
+                web.get("/ready", self.ready),
+                web.get("/model_info", self.model_info),
+                web.post("/generate", self.generate),
+                web.post("/pause_generation", self.pause),
+                web.post("/continue_generation", self.resume),
+                web.post("/update_weights_from_disk", self.update_weights_from_disk),
+                web.post("/abort_request", self.abort_request),
+            ]
+        )
+        return app
+
+
+async def amain(args) -> None:
+    sim = SimServer(args)
+    runner = web.AppRunner(sim.app())
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port)
+    await site.start()
+    stop = asyncio.Event()
+
+    def _on_sigterm():
+        # graceful drain: stop accepting, let aiohttp finish in-flight
+        # handlers during runner.cleanup(), exit 0
+        sim.draining.set()
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, RuntimeError):
+        pass
+    if args.lifetime > 0:
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=args.lifetime)
+        except asyncio.TimeoutError:
+            pass
+    else:
+        await stop.wait()
+    # wait for in-flight generations to finish (the SIGTERM drain grace is
+    # enforced by the PROVIDER: it SIGKILLs past the grace)
+    deadline = time.monotonic() + args.drain_wait
+    while sim.inflight > 0 and time.monotonic() < deadline:
+        await asyncio.sleep(0.02)
+    await runner.cleanup()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--token-time", type=float, default=0.005,
+                   help="simulated seconds per generated token")
+    p.add_argument("--max-concurrency", type=int, default=1,
+                   help="requests generating concurrently (rest queue)")
+    p.add_argument("--vocab", type=int, default=997)
+    p.add_argument("--version", type=int, default=0,
+                   help="initial weight version")
+    p.add_argument("--ready-delay", type=float, default=0.0,
+                   help="seconds before /ready turns 200")
+    p.add_argument("--crash-before-ready", action="store_true",
+                   help="exit(7) the moment readiness would be reached")
+    p.add_argument("--lifetime", type=float, default=0.0,
+                   help="self-terminate after this many seconds (0 = run "
+                        "until signalled)")
+    p.add_argument("--drain-wait", type=float, default=30.0,
+                   help="max seconds to wait for in-flight requests on "
+                        "SIGTERM")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    asyncio.run(amain(parse_args()))

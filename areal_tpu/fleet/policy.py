@@ -1,0 +1,251 @@
+"""Fleet-sizing policies: observed load -> desired server count.
+
+A policy is a pure-ish decision function over :class:`FleetSignals` (the
+controller gathers those); it owns the *stability* machinery — hysteresis
+(a breach must persist for ``breach_evaluations`` consecutive looks),
+per-direction cooldowns, and hard min/max bounds — so the controller can
+call it every interval without flapping the fleet. Two implementations:
+
+- :class:`TargetTrackingPolicy`: scale OUT while any enabled high-water
+  signal (admission queue depth per server, TTFT p95, trainer rollout-wait
+  fraction) is breached; scale IN only when every signal sits below its
+  low-water mark. Scale-in is deliberately harder to trigger than
+  scale-out (longer cooldown, all-clear requirement): killing a warm
+  server throws away its KV cache and prefix affinity.
+- :class:`ManualPolicy`: an operator/set_size()-driven target, still
+  bounds-clamped — the "fleet as a dial" mode.
+
+The clock is injectable; no wall time is read outside of it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("fleet.policy")
+
+
+@dataclass
+class FleetSignals:
+    """One controller look at the fleet's load, assembled from the
+    per-server ``/model_info`` polls (queue depth/wait, TTFT p95), the
+    client's in-flight map (skew), and the PR 9 rollout-wait counters."""
+
+    # total admission-queue depth summed over the polled servers
+    queue_depth: float = 0.0
+    # worst per-server last-dequeue queue wait (seconds)
+    queue_wait_last: float = 0.0
+    # worst per-server TTFT p95 (seconds)
+    ttft_p95: float = 0.0
+    # max(inflight) - min(inflight) across servers, from the client
+    inflight_skew: int = 0
+    # total in-flight requests across the fleet, from the client
+    inflight_total: int = 0
+    # fraction of trainer wall spent blocked in rollout wait() since the
+    # previous look (0 when unknown)
+    rollout_wait_fraction: float = 0.0
+    # servers that answered the signal poll / total polled
+    n_reporting: int = 0
+    n_servers: int = 0
+
+
+@dataclass
+class ScaleDecision:
+    """What a policy wants done, and why — exported verbatim to the
+    flight-recorder ``fleet`` channel so every resize is explainable."""
+
+    desired: int
+    current: int
+    reason: str
+    signals: FleetSignals = field(default_factory=FleetSignals)
+
+    @property
+    def direction(self) -> str:
+        if self.desired > self.current:
+            return "out"
+        if self.desired < self.current:
+            return "in"
+        return "hold"
+
+
+class FleetPolicy:
+    """Base: subclasses implement :meth:`desired_size`."""
+
+    def desired_size(
+        self, signals: FleetSignals, current: int, now: float | None = None
+    ) -> ScaleDecision:
+        raise NotImplementedError
+
+    def clamp(self, n: int) -> int:
+        return max(self.config.min_servers, min(self.config.max_servers, n))
+
+    def __init__(self, config: FleetConfig, clock=time.monotonic):
+        self.config = config
+        self.clock = clock
+
+
+class TargetTrackingPolicy(FleetPolicy):
+    def __init__(self, config: FleetConfig, clock=time.monotonic):
+        super().__init__(config, clock)
+        self._out_streak = 0
+        self._in_streak = 0
+        # cooldown anchors; -inf so the first decision is never blocked
+        self._last_out = float("-inf")
+        self._last_in = float("-inf")
+
+    # -- signal classification -------------------------------------------
+
+    def _breaches(self, s: FleetSignals, current: int) -> list[str]:
+        cfg = self.config
+        out = []
+        per_server = s.queue_depth / max(1, current)
+        if (
+            cfg.queue_depth_high_per_server > 0
+            and per_server > cfg.queue_depth_high_per_server
+        ):
+            out.append(
+                f"queue_depth/server {per_server:.1f} > "
+                f"{cfg.queue_depth_high_per_server}"
+            )
+        if cfg.ttft_p95_high_seconds > 0 and s.ttft_p95 > cfg.ttft_p95_high_seconds:
+            out.append(
+                f"ttft_p95 {s.ttft_p95:.3f}s > {cfg.ttft_p95_high_seconds}s"
+            )
+        if (
+            cfg.rollout_wait_fraction_high > 0
+            and s.rollout_wait_fraction > cfg.rollout_wait_fraction_high
+        ):
+            out.append(
+                f"rollout_wait_fraction {s.rollout_wait_fraction:.2f} > "
+                f"{cfg.rollout_wait_fraction_high}"
+            )
+        return out
+
+    def _idle(self, s: FleetSignals, current: int) -> bool:
+        """All-clear for scale-in: every enabled signal below its LOW
+        water mark — a fleet that is merely "not overloaded" keeps its
+        size; only a clearly idle one shrinks."""
+        cfg = self.config
+        if s.n_servers > 0 and s.n_reporting == 0:
+            # every signal poll failed: "no data" must read as UNKNOWN,
+            # not idle — shrinking a fleet we cannot observe is how a
+            # transient monitoring blip becomes an outage
+            return False
+        per_server = s.queue_depth / max(1, current)
+        if per_server > cfg.queue_depth_low_per_server:
+            return False
+        if s.inflight_total >= current:
+            # every server still has work in flight: the queue merely
+            # draining is not idleness — shrinking now would re-queue the
+            # tail it just absorbed
+            return False
+        if (
+            cfg.ttft_p95_high_seconds > 0
+            and s.ttft_p95 > cfg.ttft_p95_high_seconds / 2
+        ):
+            return False
+        if (
+            cfg.rollout_wait_fraction_high > 0
+            and s.rollout_wait_fraction > cfg.rollout_wait_fraction_high / 2
+        ):
+            return False
+        return True
+
+    # -- the decision -----------------------------------------------------
+
+    def desired_size(
+        self, signals: FleetSignals, current: int, now: float | None = None
+    ) -> ScaleDecision:
+        now = self.clock() if now is None else now
+        cfg = self.config
+        breaches = self._breaches(signals, current)
+        if breaches:
+            self._out_streak += 1
+            self._in_streak = 0
+        elif self._idle(signals, current):
+            self._in_streak += 1
+            self._out_streak = 0
+        else:
+            self._out_streak = 0
+            self._in_streak = 0
+
+        need = max(1, cfg.breach_evaluations)
+        if self._out_streak >= need:
+            if now - self._last_out < cfg.scale_out_cooldown_seconds:
+                return ScaleDecision(
+                    current, current,
+                    "scale-out suppressed by cooldown", signals,
+                )
+            desired = self.clamp(current + max(1, cfg.scale_step))
+            if desired > current:
+                self._last_out = now
+                self._out_streak = 0
+                return ScaleDecision(
+                    desired, current, "; ".join(breaches), signals
+                )
+            return ScaleDecision(
+                current, current,
+                f"at max_servers={cfg.max_servers}: " + "; ".join(breaches),
+                signals,
+            )
+        if self._in_streak >= need:
+            # anchored on the last scale action in EITHER direction: a
+            # server that just joined on a spike must not be drained the
+            # moment it absorbs the queue — its warm KV is the investment
+            # the scale-in cooldown exists to protect
+            if (
+                now - max(self._last_in, self._last_out)
+                < cfg.scale_in_cooldown_seconds
+            ):
+                return ScaleDecision(
+                    current, current,
+                    "scale-in suppressed by cooldown", signals,
+                )
+            desired = self.clamp(current - max(1, cfg.scale_step))
+            if desired < current:
+                self._last_in = now
+                self._in_streak = 0
+                return ScaleDecision(desired, current, "fleet idle", signals)
+            return ScaleDecision(
+                current, current,
+                f"idle but at min_servers={cfg.min_servers}", signals,
+            )
+        return ScaleDecision(current, current, "steady", signals)
+
+
+class ManualPolicy(FleetPolicy):
+    """Operator-driven size: :meth:`set_size` sets the target, the next
+    evaluation returns it (bounds-clamped). The controller's lifecycle
+    machinery (readiness gate, warmup, drain ordering) applies unchanged —
+    manual mode changes WHO decides, never HOW the fleet changes."""
+
+    def __init__(self, config: FleetConfig, clock=time.monotonic):
+        super().__init__(config, clock)
+        self._target: int | None = None
+
+    def set_size(self, n: int) -> None:
+        self._target = self.clamp(int(n))
+
+    def desired_size(
+        self, signals: FleetSignals, current: int, now: float | None = None
+    ) -> ScaleDecision:
+        if self._target is None or self._target == current:
+            return ScaleDecision(current, current, "steady", signals)
+        return ScaleDecision(
+            self._target, current, f"manual set_size({self._target})", signals
+        )
+
+
+def build_policy(config: FleetConfig, clock=time.monotonic) -> FleetPolicy:
+    if config.policy == "target_tracking":
+        return TargetTrackingPolicy(config, clock)
+    if config.policy == "manual":
+        return ManualPolicy(config, clock)
+    raise ValueError(
+        f"unknown fleet policy {config.policy!r} "
+        "(expected 'target_tracking' or 'manual')"
+    )

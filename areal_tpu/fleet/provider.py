@@ -1,0 +1,216 @@
+"""Server-lifecycle providers for the elastic fleet.
+
+A provider owns the *process* side of membership: spawn a generation
+server, tell whether it is still alive, and terminate it with a drain
+grace (SIGTERM first — the PR 4 graceful path lets in-flight requests
+finish and the flight recorder dump — SIGKILL only past the grace).
+Every spawned process is registered with the provider and supervised
+(polled by ``alive``; reaped by ``terminate``/``close``) — the
+``unsupervised-subprocess`` lint rule pins this discipline.
+
+:class:`LocalSubprocessProvider` is the working implementation (servers as
+subprocesses of this host — the local launcher's world). The slurm/gke
+classes share the exact signature so a scheduler-backed fleet slots in
+without touching the controller; they raise until those backends land.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("fleet.provider")
+
+#: launcher/local.py exports the server argv template here (JSON list with
+#: "{port}"/"{server_id}" placeholders) so a trainer-side provider spawns
+#: servers with exactly the launcher's configuration
+SERVER_ARGV_ENV = "AREAL_FLEET_SERVER_ARGV"
+
+
+@dataclass
+class ServerHandle:
+    """One provider-owned server: identity + address + the process (or
+    scheduler job) backing it."""
+
+    server_id: str
+    addr: str
+    port: int
+    proc: subprocess.Popen | None = None
+    spawned_at: float = field(default_factory=time.monotonic)
+
+
+def default_server_argv() -> list[str]:
+    """Template the launcher exported, or the bare tpu_server invocation."""
+    raw = os.environ.get(SERVER_ARGV_ENV)
+    if raw:
+        argv = json.loads(raw)
+        if not isinstance(argv, list) or not all(
+            isinstance(a, str) for a in argv
+        ):
+            raise ValueError(
+                f"{SERVER_ARGV_ENV} must be a JSON list of strings, got "
+                f"{raw[:200]!r}"
+            )
+        return argv
+    return [
+        sys.executable,
+        "-m",
+        "areal_tpu.launcher.tpu_server",
+        "server.port={port}",
+    ]
+
+
+def _substitute(argv: list[str], server_id: str, port: int) -> list[str]:
+    return [
+        a.replace("{port}", str(port)).replace("{server_id}", server_id)
+        for a in argv
+    ]
+
+
+class FleetProvider:
+    """Interface; see module docstring."""
+
+    def spawn(self, server_id: str, port: int) -> ServerHandle:
+        raise NotImplementedError
+
+    def alive(self, handle: ServerHandle) -> bool:
+        raise NotImplementedError
+
+    def terminate(self, handle: ServerHandle, grace: float) -> int | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class LocalSubprocessProvider(FleetProvider):
+    """Spawn generation servers as supervised subprocesses of this host.
+
+    ``argv_template`` elements may carry ``{port}``/``{server_id}``
+    placeholders; ``env`` overlays the inherited environment, and each
+    child additionally gets ``AREAL_SERVER_ID`` so it registers under a
+    stable name_resolve key."""
+
+    def __init__(
+        self,
+        argv_template: list[str] | None = None,
+        env: dict[str, str] | None = None,
+        host: str = "127.0.0.1",
+        cwd: str | None = None,
+    ):
+        self.argv_template = argv_template or default_server_argv()
+        self.env = env or {}
+        self.host = host
+        self.cwd = cwd
+        # lifecycle registry: every Popen this provider ever created that
+        # has not been reaped; close() drains it
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(self, server_id: str, port: int) -> ServerHandle:
+        argv = _substitute(self.argv_template, server_id, port)
+        env = dict(os.environ)
+        env.update(self.env)
+        env["AREAL_SERVER_ID"] = server_id
+        # fleet-managed servers must NOT self-register in name_resolve: the
+        # controller registers them only AFTER the /ready + version-checked
+        # warmup passes — a boot-time self-registration would let the
+        # clients' discovery refresh admit a still-loading (or stale)
+        # server to rotation, bypassing the very gate scale-out exists for
+        env["AREAL_FLEET_MANAGED"] = "1"
+        logger.info("spawning %s on port %d: %s", server_id, port, " ".join(argv))
+        proc = subprocess.Popen(argv, env=env, cwd=self.cwd)
+        self._procs[server_id] = proc
+        return ServerHandle(
+            server_id=server_id,
+            addr=f"{self.host}:{port}",
+            port=port,
+            proc=proc,
+        )
+
+    def alive(self, handle: ServerHandle) -> bool:
+        return handle.proc is not None and handle.proc.poll() is None
+
+    def terminate(self, handle: ServerHandle, grace: float) -> int | None:
+        """SIGTERM, wait up to ``grace`` for the drain to finish, then
+        SIGKILL. Returns the exit code (None only if the process somehow
+        survives SIGKILL's wait window)."""
+        proc = handle.proc
+        if proc is None:
+            return None
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            deadline = time.monotonic() + max(0.0, grace)
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                logger.warning(
+                    "%s did not drain within %.1fs; killing",
+                    handle.server_id,
+                    grace,
+                )
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        self._procs.pop(handle.server_id, None)
+        return proc.poll()
+
+    def close(self) -> None:
+        for server_id, proc in list(self._procs.items()):
+            self.terminate(
+                ServerHandle(server_id=server_id, addr="", port=0, proc=proc),
+                grace=5.0,
+            )
+
+
+class SlurmFleetProvider(FleetProvider):
+    """Placeholder sharing the provider signature: spawn = ``sbatch`` a
+    server job, terminate = ``scancel --signal=TERM`` then ``scancel``."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "slurm fleet provider: submit/cancel server jobs via "
+            "launcher/slurm.py — not yet wired"
+        )
+
+
+class GkeFleetProvider(FleetProvider):
+    """Placeholder sharing the provider signature: spawn = patch the
+    server Deployment/LeaderWorkerSet replica count, terminate = delete
+    the pod with a grace period."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "gke fleet provider: drive the k8s API via launcher/gke.py — "
+            "not yet wired"
+        )
+
+
+def build_provider(
+    config: FleetConfig,
+    argv_template: list[str] | None = None,
+    env: dict[str, str] | None = None,
+) -> FleetProvider:
+    if config.provider == "local":
+        return LocalSubprocessProvider(
+            argv_template=argv_template
+            or (list(config.server_argv) or None),
+            env=env,
+        )
+    if config.provider == "slurm":
+        return SlurmFleetProvider()
+    if config.provider == "gke":
+        return GkeFleetProvider()
+    raise ValueError(f"unknown fleet provider {config.provider!r}")

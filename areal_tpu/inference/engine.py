@@ -1013,6 +1013,14 @@ class GenerationEngine:
     def healthy(self) -> bool:
         return self._dead is None
 
+    def is_ready(self) -> bool:
+        """Readiness, as distinct from liveness (:attr:`healthy`): the model
+        is loaded (construction materializes params and the KV pool) AND the
+        engine loop thread is running. The server's ``GET /ready`` gate —
+        which fleet scale-out and the health-prober rejoin path wait on —
+        additionally checks the weight version; this is the engine half."""
+        return self._thread is not None and self._dead is None
+
     def pause(self, timeout: float = 60.0):
         """Abort all in-flight requests and stop admitting new ones (weight
         update fence). Raises if the engine thread doesn't acknowledge —
@@ -1265,6 +1273,10 @@ class GenerationEngine:
             "admission_refused_total": sched.refused_total,
             "queue_wait_seconds_total": sched.queue_wait_seconds_total,
             "queue_wait_seconds_last": sched.queue_wait_seconds_last,
+            # fleet-autoscaler load signal: TTFT p95 over the request
+            # histogram, surfaced via /model_info so the controller's
+            # signal poll reads it without parsing Prometheus buckets
+            "ttft_p95_seconds": self._ttft_hist.quantile(0.95),
         }
 
     def record_serving_stats(self) -> None:

@@ -11,6 +11,8 @@ Endpoint parity with the reference's patched SGLang server protocol
   safetensors refresh of the live params.
 - ``POST /abort_request`` — {rid}.
 - ``GET /health`` / ``GET /model_info`` — liveness + version/running counters.
+- ``GET /ready`` — readiness gate (503 until the engine is initialized and,
+  with ``?min_version=N``, its weights reached that version).
 
 The engine loop runs on its own thread; handlers bridge with asyncio futures
 via ``loop.call_soon_threadsafe`` so one aiohttp event loop serves many
@@ -100,6 +102,7 @@ class GenerationServer:
         self.app.add_routes(
             [
                 web.get("/health", self.health),
+                web.get("/ready", self.ready),
                 web.get("/model_info", self.model_info),
                 web.get("/metrics", self.metrics),
                 web.post("/generate", self.generate),
@@ -124,6 +127,35 @@ class GenerationServer:
         if not self.engine.healthy:
             return web.json_response({"status": "dead"}, status=500)
         return web.json_response({"status": "ok"})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        """Readiness gate, distinct from liveness (``/health``): 503 until
+        the engine is initialized (model loaded, loop thread running) and —
+        with ``?min_version=N`` — its weights have reached that version.
+        The fleet controller's scale-out warmup and the client's breaker
+        rejoin probe both wait on this, so a server that is alive but still
+        loading (or still at stale weights) never takes rotation traffic."""
+        e = self.engine
+        is_ready = getattr(e, "is_ready", None)
+        if not e.healthy or (is_ready is not None and not is_ready()):
+            return web.json_response({"status": "initializing"}, status=503)
+        version = e.get_version()
+        min_version = request.query.get("min_version")
+        if min_version is not None:
+            try:
+                required = int(min_version)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad min_version {min_version!r}"}, status=400
+                )
+            if version < required:
+                return web.json_response(
+                    {"status": "stale", "weight_version": version},
+                    status=503,
+                )
+        return web.json_response(
+            {"status": "ready", "weight_version": version}
+        )
 
     async def model_info(self, request: web.Request) -> web.Response:
         e = self.engine

@@ -202,11 +202,13 @@ def write_manifest(
 
 
 def kubectl_apply(path: str) -> str:
+    # a hung API server must not wedge the launcher forever
     out = subprocess.run(
         ["kubectl", "apply", "-f", path],
         capture_output=True,
         text=True,
         check=True,
+        timeout=300,
     )
     return out.stdout.strip()
 

@@ -68,34 +68,87 @@ def _flatten(prefix: str, d: dict) -> list[str]:
     return out
 
 
-def _spawn_servers(cfg, alloc: AllocationMode) -> list:
-    """The server process gets ONLY its own config section (GenServerConfig
-    is strict about unknown keys), flattened to key=value overrides."""
+def _server_argv_template(cfg, alloc: AllocationMode) -> list[str]:
+    """The tpu_server invocation for one replica, with ``{port}`` left as a
+    placeholder. Shared by the static spawn below and — via the
+    AREAL_FLEET_SERVER_ARGV export — by the trainer-side elastic-fleet
+    provider, so controller-spawned servers run the launcher's exact
+    configuration."""
     from areal_tpu.api.cli_args import to_dict
 
-    procs = []
-    n_servers = alloc.gen.dp if alloc.gen else 0
     chips_per_server = (
         alloc.gen.world_size // max(alloc.gen.dp, 1) if alloc.gen else 0
     )
+    return [
+        sys.executable,
+        "-m",
+        "areal_tpu.launcher.tpu_server",
+        *_flatten("server", to_dict(cfg.server)),
+        f"experiment_name={cfg.experiment_name}",
+        f"trial_name={cfg.trial_name}",
+        f"server.tp_size={max(chips_per_server, 1)}",
+        f"name_resolve.type={cfg.cluster.name_resolve.type}",
+        f"name_resolve.nfs_record_root={cfg.cluster.name_resolve.nfs_record_root}",
+        "server.port={port}",
+    ]
+
+
+def _n_boot_servers(cfg, alloc: AllocationMode) -> int:
+    """Static mode boots the full allocation; elastic mode boots the
+    fleet's initial size (the controller grows/shrinks from there)."""
+    n = alloc.gen.dp if alloc.gen else 0
+    fleet = cfg.rollout.fleet
+    if fleet.enabled:
+        n = min(n or fleet.min_servers, fleet.initial_servers or fleet.min_servers)
+        n = max(n, fleet.min_servers)
+        n = min(n, fleet.max_servers)  # hard bound holds at boot too
+    return n
+
+
+def _spawn_servers(cfg, alloc: AllocationMode) -> list:
+    """The server process gets ONLY its own config section (GenServerConfig
+    is strict about unknown keys), flattened to key=value overrides."""
+    procs = []
+    n_servers = _n_boot_servers(cfg, alloc)
+    template = _server_argv_template(cfg, alloc)
     for i in range(n_servers):
         env = dict(os.environ)
-        env["AREAL_SERVER_ID"] = f"server{i}"
+        server_id = f"server{i}"
+        env["AREAL_SERVER_ID"] = server_id
         env.update(cfg.launcher.inference_server_env_vars)
         argv = [
-            sys.executable,
-            "-m",
-            "areal_tpu.launcher.tpu_server",
-            *_flatten("server", to_dict(cfg.server)),
-            f"experiment_name={cfg.experiment_name}",
-            f"trial_name={cfg.trial_name}",
-            f"server.tp_size={max(chips_per_server, 1)}",
-            f"name_resolve.type={cfg.cluster.name_resolve.type}",
-            f"name_resolve.nfs_record_root={cfg.cluster.name_resolve.nfs_record_root}",
+            a.replace("server.port={port}", f"server.port={cfg.server.port}")
+            for a in template
         ]
         logger.info("spawning server %d: %s", i, " ".join(argv[3:]))
-        procs.append(subprocess.Popen(argv, env=env))
+        p = subprocess.Popen(argv, env=env)
+        p.areal_server_id = server_id  # monitor loop maps exits back
+        procs.append(p)
     return procs
+
+
+def _server_drained(cfg, proc) -> bool:
+    """A dead server process whose name_resolve registration is GONE was
+    drained on purpose (elastic scale-in deregisters before exit) — the
+    trial keeps running. A dead server still registered crashed."""
+    server_id = getattr(proc, "areal_server_id", None)
+    if not cfg.rollout.fleet.enabled or server_id is None:
+        return False
+    from areal_tpu.utils.name_resolve import NameEntryNotFoundError
+
+    try:
+        name_resolve.get(
+            names.gen_server(cfg.experiment_name, cfg.trial_name, server_id)
+        )
+        return False
+    except NameEntryNotFoundError:
+        return True
+    except Exception as e:
+        # a backend blip must not misread a CRASH as an intentional drain:
+        # unknown -> treat as crashed (the relaunch path is the safe one)
+        logger.warning("drain check for %s failed (%s); treating as crash",
+                       server_id, e)
+        return False
 
 
 def _wait_server_addrs(cfg, n_servers: int) -> list[str]:
@@ -114,8 +167,23 @@ def _spawn_trainer(cfg, entry: str, config_argv: list[str], addrs: list[str], ru
     launcher.trainer_processes > 1 (the torchrun replacement; each process
     calls parallel/distributed.initialize from these env vars)."""
     base_env = dict(os.environ)
-    base_env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
     base_env[RECOVER_ENV] = "1" if run_id > 0 else "0"
+    if cfg.rollout.fleet.enabled:
+        # elastic mode: the trainer must DISCOVER servers via name_resolve
+        # (a frozen env address list would pin the boot membership and
+        # disable the client's refresh), and its fleet controller spawns
+        # additional servers with exactly this launcher's configuration
+        # (fleet/provider.py reads the template)
+        import json as _json
+
+        from areal_tpu.fleet.provider import SERVER_ARGV_ENV
+
+        base_env.pop("AREAL_LLM_SERVER_ADDRS", None)
+        base_env[SERVER_ARGV_ENV] = _json.dumps(
+            _server_argv_template(cfg, AllocationMode.from_str(cfg.allocation_mode))
+        )
+    else:
+        base_env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
     base_env.update(cfg.launcher.trainer_env_vars)
     argv = [sys.executable, entry, *config_argv]
     n = max(cfg.launcher.trainer_processes, 1)
@@ -187,8 +255,22 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
             if any(rc is not None and rc != 0 for rc in rcs):
                 logger.error("a trainer died with rc=%s; failing trial", rcs)
                 return next(rc for rc in rcs if rc)
-            for s in servers:
+            for s in list(servers):
                 if s.poll() is not None:
+                    # rc==0 required: a crashing interpreter also loses its
+                    # registration (name_resolve atexit cleanup), but only
+                    # a deliberate drain exits CLEANLY
+                    if s.poll() == 0 and _server_drained(cfg, s):
+                        # elastic scale-in: the server deregistered itself
+                        # and exited on purpose — stop monitoring it
+                        logger.info(
+                            "server %s drained by the fleet controller "
+                            "(rc=%s); trial continues",
+                            getattr(s, "areal_server_id", "?"),
+                            s.poll(),
+                        )
+                        servers.remove(s)
+                        continue
                     logger.error("server died with rc=%s; failing trial", s.poll())
                     return s.poll() or 1
             time.sleep(1.0)

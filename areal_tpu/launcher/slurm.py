@@ -134,7 +134,10 @@ def sbatch(script: str, dependency: str | None = None) -> str:
     if dependency:
         cmd.append(f"--dependency={dependency}")
     cmd.append(script)
-    out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    # a hung slurmctld must not wedge the launcher forever
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, check=True, timeout=300
+    )
     return out.stdout.strip().split(";")[0]
 
 

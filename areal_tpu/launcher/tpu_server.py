@@ -61,10 +61,26 @@ async def amain(cfg: GenServerConfig):
     addr = f"{network.gethostip()}:{port}"
     server_id = os.environ.get("AREAL_SERVER_ID") or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
     key = names.gen_server(cfg.experiment_name, cfg.trial_name, server_id)
-    name_resolve.add(key, addr, replace=True)
-    logger.info("registered %s -> %s", key, addr)
+    if os.environ.get("AREAL_FLEET_MANAGED") == "1":
+        # fleet-provider-spawned: the controller registers this server only
+        # AFTER the /ready + version-checked warmup passes — self-
+        # registering here would let discovery admit it unwarmed (and under
+        # a conflicting address spelling). The drain-key watch and the
+        # exit-time deregistration below still apply to the controller's
+        # registration, which shares this server_id key.
+        logger.info("fleet-managed: skipping self-registration of %s", key)
+    else:
+        name_resolve.add(key, addr, replace=True)
+        logger.info("registered %s -> %s", key, addr)
 
     stop_key = f"{names.trial_root(cfg.experiment_name, cfg.trial_name)}/shutdown"
+    # per-server drain key (elastic fleet scale-in): the controller sets it
+    # for servers it did not spawn (no process handle to SIGTERM) — the
+    # server deregisters itself FIRST (so no client routes new work here),
+    # then stops, letting aiohttp finish in-flight handlers
+    drain_key = names.gen_server_drain(
+        cfg.experiment_name, cfg.trial_name, server_id
+    )
     # SIGTERM = preemption: the server process holds the flight-recorder
     # channels a postmortem wants (requests, commits, admission), so dump
     # them before the clean stop instead of dying with default disposition
@@ -81,6 +97,7 @@ async def amain(cfg: GenServerConfig):
         loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
     except (NotImplementedError, RuntimeError):  # non-unix / nested loops
         pass
+    drained = False
     try:
         while not stop_event.is_set():
             try:
@@ -90,10 +107,26 @@ async def amain(cfg: GenServerConfig):
             except Exception:
                 pass
             try:
+                name_resolve.get(drain_key)
+                logger.info("drain key found; deregistering and exiting")
+                drained = True
+                break
+            except Exception:
+                pass
+            try:
                 await asyncio.wait_for(stop_event.wait(), timeout=2.0)
             except asyncio.TimeoutError:
                 pass
     finally:
+        if drained or stop_event.is_set():
+            # deregister BEFORE stopping: clients' membership refresh drops
+            # a deregistered address immediately, so no request races the
+            # listener teardown; the launcher also reads deregistration as
+            # "drained on purpose" rather than a crash
+            try:
+                name_resolve.delete(key)
+            except Exception:
+                pass
         await server.stop()
 
 

@@ -10,4 +10,5 @@ from areal_tpu.lint.rules import (  # noqa: F401
     metrics_labels,
     prng,
     retries,
+    subprocess_discipline,
 )

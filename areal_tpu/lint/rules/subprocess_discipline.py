@@ -1,0 +1,119 @@
+"""Subprocess lifecycle discipline.
+
+unsupervised-subprocess: a child process nobody supervises is how fleets
+rot — a wedged ``subprocess.run`` with no ``timeout`` blocks its caller
+forever (the bench rounds' rc=124 tunnel lesson), and a ``Popen`` that is
+fired and forgotten (or never polled/reaped anywhere) leaks zombies and
+hides crashes: the parent keeps routing work to a corpse. Long-lived
+children must be registered with a lifecycle owner that polls them and
+can terminate them with a grace — ``areal_tpu/fleet/provider.py``'s
+registry + ``terminate(grace)`` is the house pattern.
+
+Two shapes are flagged:
+
+- ``subprocess.run/call/check_call/check_output`` without a ``timeout=``
+  kwarg (a ``**kwargs`` splat is given the benefit of the doubt);
+- ``subprocess.Popen(...)`` whose handle is DISCARDED (bare expression
+  statement), or created in a module with no supervision at all — no
+  ``.poll()``/``.wait()``/``.communicate()``/``.terminate()``/``.kill()``/
+  ``.send_signal()`` call anywhere in the file. The check is module-scoped
+  on purpose: providers/launchers keep the Popen in a registry and
+  supervise it from other methods, which a scope-local check would
+  false-positive on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import FileContext, Finding, Rule, register
+
+#: blocking one-shot helpers that accept timeout=
+_RUN_FUNCS = {
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+_POPEN = "subprocess.Popen"
+
+#: attribute calls that count as supervising a child process
+_SUPERVISION_ATTRS = {
+    "poll",
+    "wait",
+    "communicate",
+    "terminate",
+    "kill",
+    "send_signal",
+}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg is None:  # **kwargs: may carry one — don't flag
+            return True
+    return False
+
+
+def _module_supervises(ctx: FileContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SUPERVISION_ATTRS
+        ):
+            return True
+    return False
+
+
+@register
+class UnsupervisedSubprocessRule(Rule):
+    id = "unsupervised-subprocess"
+    doc = (
+        "subprocess.run without a timeout, or a Popen handle that is "
+        "discarded / never supervised (poll/wait/terminate) in its module"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        supervises: bool | None = None  # computed lazily, once
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolved(node.func)
+            if resolved in _RUN_FUNCS:
+                if not _has_timeout(node):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{resolved} without timeout= can block its caller "
+                        "forever; pass a timeout (and handle "
+                        "TimeoutExpired)",
+                    )
+                continue
+            if resolved != _POPEN:
+                continue
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Expr):
+                yield self.finding(
+                    ctx,
+                    node,
+                    "fire-and-forget Popen: the handle is discarded, so "
+                    "nobody can poll, drain, or reap this child — register "
+                    "it with a lifecycle owner (see fleet/provider.py)",
+                )
+                continue
+            if supervises is None:
+                supervises = _module_supervises(ctx)
+            if not supervises:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "Popen in a module that never supervises its children "
+                    "(no poll/wait/communicate/terminate/kill anywhere): "
+                    "long-lived processes need a lifecycle owner that "
+                    "polls and can terminate them with a grace",
+                )

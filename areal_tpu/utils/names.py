@@ -22,6 +22,14 @@ def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
     return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
 
 
+def gen_server_drain(experiment_name: str, trial_name: str, server_id: str) -> str:
+    """Per-server drain request key (elastic fleet scale-in of a server the
+    controller did not spawn): the server watches its own key and exits
+    gracefully when it appears. Deliberately OUTSIDE the ``gen_servers``
+    subtree so drain markers are never resolved as server addresses."""
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_drain/{server_id}"
+
+
 def update_weights_from_disk(
     experiment_name: str, trial_name: str, model_version: int
 ) -> str:

@@ -1039,6 +1039,153 @@ def weight_sync_bench(layers: int = 2, vocab: int = 2048, chunk_mb: int = 64,
         eng.stop()
 
 
+def elastic_fleet_bench(n_requests: int = 48, new_tokens: int = 16,
+                        token_time: float = 0.02, max_servers: int = 3,
+                        interarrival: float = 0.12, **_):
+    """Elastic-fleet rung: a synthetic load spike (n_requests concurrent
+    generations, one-at-a-time service per server) against a 1-server fleet
+    with autoscaling ON vs OFF. The serving substrate is the deterministic
+    sim server (areal_tpu/fleet/harness.py — real subprocesses, real HTTP,
+    the same pure-function token stream), so the rung measures the
+    CONTROL-plane value cleanly: queueing collapse under scale-out, with
+    greedy outputs token-identical across modes (hard-asserted) and ZERO
+    failed requests in either mode (hard-asserted — an autoscaler that
+    drops requests while resizing has no result to report).
+
+    The load is OPEN-LOOP (requests arrive every ``interarrival`` seconds,
+    at a rate above one server's service capacity but below the scaled
+    fleet's): a closed burst dispatched at t=0 pins every request to the
+    boot server before any newcomer exists, measuring nothing — arrivals
+    over time are what an autoscaler actually absorbs."""
+    import asyncio
+    import threading
+
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.fleet import harness
+    from areal_tpu.fleet.controller import FleetController
+    from areal_tpu.fleet.provider import LocalSubprocessProvider
+
+    fc = FleetConfig(
+        enabled=True, min_servers=1, max_servers=max_servers,
+        breach_evaluations=1, scale_out_cooldown_seconds=0.0,
+        scale_in_cooldown_seconds=0.0, queue_depth_high_per_server=1.0,
+        queue_depth_low_per_server=0.2, ready_timeout_seconds=60.0,
+        drain_grace_seconds=10.0,
+    )
+    argv = [
+        sys.executable, harness.__file__, "--port", "{port}",
+        "--token-time", str(token_time), "--max-concurrency", "1",
+    ]
+    prompts = [[1, 2, 3, i] for i in range(n_requests)]
+
+    def run_mode(autoscale: bool):
+        prov = LocalSubprocessProvider(argv_template=argv)
+        client = None
+        ctl = None
+        try:
+            boot = FleetController(
+                RemoteInfEngine(InferenceEngineConfig(
+                    experiment_name="bench-fleet-boot", trial_name="t",
+                )),
+                fc, provider=prov,
+            )
+            addrs = boot.bootstrap()
+            client = RemoteInfEngine(InferenceEngineConfig(
+                experiment_name="bench-fleet", trial_name="t",
+                max_concurrent_rollouts=n_requests, consumer_batch_size=2,
+                request_retries=2, cache_aware_routing=False,
+                schedule_policy="least_loaded",
+            ))
+            client.initialize(addrs, train_data_parallel_size=1)
+            ctl = FleetController(client, fc, provider=prov)
+            ctl._members.update(boot._members)
+
+            async def one(i, p):
+                req = ModelRequest(
+                    rid=f"r{i}", input_ids=list(p),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=new_tokens, greedy=True
+                    ),
+                )
+                r = await client.agenerate(req)
+                return r.output_tokens, r.latency
+
+            async def load():
+                try:
+                    tasks = []
+                    for i, p in enumerate(prompts):
+                        tasks.append(asyncio.ensure_future(one(i, p)))
+                        await asyncio.sleep(interarrival)
+                    return await asyncio.gather(
+                        *tasks, return_exceptions=True
+                    )
+                finally:
+                    await client._close_session_for_current_loop()
+
+            results = {}
+            lt = threading.Thread(
+                target=lambda: results.update(out=asyncio.run(load()))
+            )
+            t0 = time.monotonic()
+            lt.start()
+            sizes = [len(client.addresses)]
+            while lt.is_alive():
+                if autoscale:
+                    ctl.step()
+                    sizes.append(len(client.addresses))
+                time.sleep(0.25)
+            lt.join()
+            wall = time.monotonic() - t0
+            out = results["out"]
+            failed = [r for r in out if isinstance(r, BaseException)]
+            ok = [r for r in out if not isinstance(r, BaseException)]
+            lats = sorted(lat for _, lat in ok)
+            p95 = lats[int(0.95 * (len(lats) - 1))] if lats else 0.0
+            digest = hash(tuple(tuple(toks) for toks, _ in ok))
+            return {
+                "failed": len(failed),
+                "latency_p95_s": round(p95, 4),
+                "wall_s": round(wall, 3),
+                "max_fleet": max(sizes),
+                "digest": digest,
+            }
+        finally:
+            if ctl is not None:
+                ctl.close()
+            if client is not None:
+                client.destroy()
+            prov.close()
+
+    off = run_mode(autoscale=False)
+    on = run_mode(autoscale=True)
+    # hard gates: an autoscaler may never drop a request, and resizing may
+    # never perturb greedy outputs
+    assert off["failed"] == 0 and on["failed"] == 0, (off, on)
+    assert on["digest"] == off["digest"], "autoscaling changed greedy outputs"
+    return {
+        "latency_p95_speedup": round(
+            off["latency_p95_s"] / max(on["latency_p95_s"], 1e-6), 3
+        ),
+        "latency_p95_on_s": on["latency_p95_s"],
+        "latency_p95_off_s": off["latency_p95_s"],
+        "wall_on_s": on["wall_s"],
+        "wall_off_s": off["wall_s"],
+        "max_fleet_on": on["max_fleet"],
+        "failed_requests": on["failed"] + off["failed"],
+        "greedy_identity": True,
+        "n_requests": n_requests,
+        "new_tokens": new_tokens,
+        "token_time": token_time,
+        "interarrival": interarrival,
+    }
+
+
 def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
                        group_size: int = 8, prompt_len: int = 256,
                        new_tokens: int = 32, turns: int = 3,
@@ -1731,6 +1878,38 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("weight_sync_stall_seconds", "weight-sync", e)
 
+    # ---- rung 3.7: elastic fleet — autoscaling on vs off under a load
+    # spike (control-plane rung: sim serving substrate, real subprocesses +
+    # HTTP; failed-request count and greedy identity are hard gates in the
+    # child) ----
+    if remaining(deadline) > 180:
+        try:
+            log("elastic fleet rung")
+            ef = _run_child(
+                "fleet",
+                dict(
+                    n_requests=36, new_tokens=16, token_time=0.02,
+                    interarrival=0.12,
+                )
+                if REHEARSAL
+                else dict(
+                    n_requests=64, new_tokens=16, token_time=0.02,
+                    interarrival=0.12,
+                ),
+                timeout=min(600.0, remaining(deadline) - 60),
+            )
+            emit({
+                "metric": "elastic_fleet",
+                "value": ef["latency_p95_speedup"],
+                "unit": "x_latency_p95_autoscale_on_vs_off",
+                "vs_baseline": None,
+                "chip": chip,
+                **{k: v for k, v in ef.items()
+                   if k != "latency_p95_speedup"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("elastic_fleet", "elastic-fleet", e)
+
     # ---- rung 4: full GRPO step (async-RL headline metric) ----
     if remaining(deadline) > 420:
         try:
@@ -1807,6 +1986,8 @@ def _child_main():
         print(json.dumps(weight_update_bench(**att)))
     elif kind == "--wsync-child":
         print(json.dumps(weight_sync_bench(**att)))
+    elif kind == "--fleet-child":
+        print(json.dumps(elastic_fleet_bench(**att)))
     elif kind == "--grpo-child":
         from bench_grpo import grpo_step_bench
 

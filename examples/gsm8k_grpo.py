@@ -91,6 +91,16 @@ def main(argv=None):
     alloc = AllocationMode.from_str(cfg.allocation_mode)
     rollout.initialize(None, train_data_parallel_size=alloc.train.dp if alloc.train else 1)
 
+    # elastic fleet (optional): close the load -> fleet-size loop on a
+    # background thread; the provider spawns servers with the launcher's
+    # exported argv template (AREAL_FLEET_SERVER_ARGV)
+    fleet_controller = None
+    if cfg.rollout.fleet.enabled:
+        from areal_tpu.fleet import build_controller
+
+        fleet_controller = build_controller(rollout)
+        fleet_controller.start()
+
     # actor on the train mesh
     actor = TPUPPOActor(cfg.actor)
     actor.create_process_group(alloc.train)
@@ -356,6 +366,8 @@ def main(argv=None):
     logger.info("wrote %s", out)
 
     stats_logger.close()
+    if fleet_controller is not None:
+        fleet_controller.close()  # reap provider-owned servers (drain grace)
     rollout.destroy()
     actor.destroy()
 

@@ -1,0 +1,41 @@
+# arealint fixture: unsupervised-subprocess TRUE NEGATIVES.
+import signal
+import subprocess
+import time
+
+
+def run_with_timeout(cmd):
+    # bounded one-shot: the caller can never block forever
+    return subprocess.run(cmd, capture_output=True, timeout=120)
+
+
+def run_with_splatted_kwargs(cmd, **kw):
+    # a **kwargs splat may carry timeout=; benefit of the doubt
+    return subprocess.run(cmd, **kw)
+
+
+class SupervisedProvider:
+    """The house pattern (fleet/provider.py): every Popen lands in a
+    registry, and the owner polls and terminates with a grace."""
+
+    def __init__(self):
+        self._procs = {}
+
+    def spawn(self, server_id, cmd, env):
+        proc = subprocess.Popen(cmd, env=env)
+        self._procs[server_id] = proc
+        return proc
+
+    def alive(self, server_id):
+        return self._procs[server_id].poll() is None
+
+    def terminate(self, server_id, grace):
+        proc = self._procs.pop(server_id)
+        proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + grace
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        return proc.poll()

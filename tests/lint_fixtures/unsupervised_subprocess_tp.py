@@ -1,0 +1,24 @@
+# arealint fixture: unsupervised-subprocess TRUE POSITIVES.
+# This module deliberately contains NO poll/wait/terminate call, so every
+# Popen here is unsupervised by construction.
+import subprocess
+from subprocess import Popen, check_output
+
+
+def run_without_timeout(cmd):
+    return subprocess.run(cmd, capture_output=True)  # lint-expect: unsupervised-subprocess
+
+
+def check_output_without_timeout(cmd):
+    return check_output(cmd)  # lint-expect: unsupervised-subprocess
+
+
+def fire_and_forget(cmd, env):
+    # the handle is discarded: nobody can ever poll or reap this child
+    subprocess.Popen(cmd, env=env)  # lint-expect: unsupervised-subprocess
+
+
+def spawned_but_never_supervised(cmd):
+    # assigned, but this module never polls/waits/terminates ANY process
+    proc = Popen(cmd)  # lint-expect: unsupervised-subprocess
+    return proc

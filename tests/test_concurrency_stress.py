@@ -121,6 +121,57 @@ def test_staleness_capacity_monotone_under_concurrent_accepts():
     assert not over_capacity
 
 
+def test_staleness_invariant_holds_across_fleet_resize():
+    """Elastic-fleet satellite: worker threads hammer the
+    submit->accept/reject cycle while another thread resizes the
+    max-concurrent ceiling up and down (what the client's membership
+    callbacks do on scale-out/in). The ``submitted == accepted + rejected
+    + running`` invariant must hold at quiescence, and the capacity
+    formula must reflect the final ceiling exactly."""
+    n_threads, per_thread = 6, 400
+    mgr = StalenessManager(
+        max_concurrent_rollouts=4, consumer_batch_size=8, max_staleness=1000
+    )
+    stop = threading.Event()
+
+    def resizer():
+        sizes = [1, 3, 8, 2, 16, 4]
+        i = 0
+        while not stop.is_set():
+            mgr.set_max_concurrent_rollouts(sizes[i % len(sizes)] * 2)
+            i += 1
+            time.sleep(0.001)
+
+    def worker(i):
+        def go():
+            for k in range(per_thread):
+                mgr.on_rollout_submitted()
+                # capacity reads must never crash mid-resize
+                mgr.get_capacity(current_version=k % 7)
+                if (i + k) % 4 == 0:
+                    mgr.on_rollout_rejected()
+                else:
+                    mgr.on_rollout_accepted()
+
+        return go
+
+    rt = threading.Thread(target=resizer)
+    rt.start()
+    try:
+        _run_threads([worker(i) for i in range(n_threads)])
+    finally:
+        stop.set()
+        rt.join(timeout=10)
+    mgr.set_max_concurrent_rollouts(5)
+    s = mgr.get_stats()
+    assert s.submitted == n_threads * per_thread
+    assert s.submitted == s.accepted + s.rejected + s.running
+    assert s.running == 0
+    # with running == 0 the concurrency term is exactly the new ceiling
+    staleness_term = (1000 + 0 + 1) * 8 - (s.accepted + s.running)
+    assert mgr.get_capacity(current_version=0) == min(5, staleness_term)
+
+
 def test_distributed_lock_mutual_exclusion():
     """Classic lost-update stress: a plain int incremented read-modify-write
     under DistributedLock by many threads. Any mutual-exclusion hole shows

@@ -747,7 +747,8 @@ def test_open_breaker_gets_zero_traffic_until_probe_succeeds():
     def handler(method, url, payload):
         if "//a:1/" in url and not healthy["a:1"]:
             return ConnectionResetError("a is down")
-        if url.endswith("/health"):
+        if url.endswith(("/health", "/ready")):
+            # the breaker prober hits the readiness gate (/ready)
             return FakeResponse(status=200, json_data={"status": "ok"})
         return _gen_response([1], stop_reason="stop")
 
@@ -799,7 +800,7 @@ def _wu_handler(dead: set, versions: dict):
             return FakeResponse(
                 status=200, json_data={"success": True}
             )
-        if url.endswith("/health"):
+        if url.endswith(("/health", "/ready")):
             return FakeResponse(status=200, json_data={"status": "ok"})
         if url.endswith("/model_info"):
             return FakeResponse(

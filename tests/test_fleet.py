@@ -1,0 +1,930 @@
+"""Elastic rollout fleet: policy, provider, membership-safe client, and the
+controller's end-to-end scale-out/in protocol.
+
+The e2e tests run the REAL protocol end to end: the local subprocess
+provider spawns real HTTP server processes (areal_tpu/fleet/harness.py —
+the deterministic simulation server, stdlib+aiohttp only, so a fleet
+spawns in well under a second), the RemoteInfEngine client routes real
+requests at them, and the controller resizes the fleet under an injected
+load spike. Determinism contract: the harness's next token is a pure
+function of the full sequence, so outputs must be token-identical across
+fleet sizes AND across failover re-dispatch (the replayed prompt +
+accumulated tokens continue the exact stream).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    CircuitBreakerConfig,
+    FleetConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.core.fault_tolerance import OPEN
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.fleet import harness
+from areal_tpu.fleet.controller import FleetController
+from areal_tpu.fleet.policy import (
+    FleetSignals,
+    ManualPolicy,
+    TargetTrackingPolicy,
+    build_policy,
+)
+from areal_tpu.fleet.provider import LocalSubprocessProvider, ServerHandle
+from areal_tpu.utils import flight_recorder, name_resolve, names
+
+HARNESS = harness.__file__
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def sim_argv(*extra: str) -> list[str]:
+    return [sys.executable, HARNESS, "--port", "{port}", *extra]
+
+
+def make_fleet_config(**kw) -> FleetConfig:
+    base = dict(
+        enabled=True,
+        min_servers=1,
+        max_servers=3,
+        breach_evaluations=1,
+        scale_out_cooldown_seconds=0.0,
+        scale_in_cooldown_seconds=0.0,
+        queue_depth_high_per_server=1.0,
+        queue_depth_low_per_server=0.2,
+        ready_timeout_seconds=30.0,
+        drain_grace_seconds=5.0,
+        signal_timeout_seconds=2.0,
+    )
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def make_client(addrs, **cfg_kw) -> RemoteInfEngine:
+    cfg_kw.setdefault("experiment_name", "fleet-test")
+    cfg_kw.setdefault("trial_name", "t")
+    cfg_kw.setdefault("max_concurrent_rollouts", 8)
+    cfg_kw.setdefault("consumer_batch_size", 2)
+    cfg_kw.setdefault("request_retries", 1)
+    cfg_kw.setdefault("cache_aware_routing", False)
+    cfg_kw.setdefault("schedule_policy", "least_loaded")
+    client = RemoteInfEngine(InferenceEngineConfig(**cfg_kw))
+    client.initialize(list(addrs), train_data_parallel_size=1)
+    return client
+
+
+def expected_tokens(prompt: list[int], n: int, vocab: int = 997) -> list[int]:
+    out: list[int] = []
+    for _ in range(n):
+        out.append(harness.next_token(list(prompt) + out, vocab))
+    return out
+
+
+def run_load(client, prompts, max_new=8):
+    """Issue all prompts concurrently on a private loop; returns results
+    in order (exceptions included, not raised)."""
+
+    async def one(i, p):
+        req = ModelRequest(
+            rid=f"r{i}",
+            input_ids=list(p),
+            gconfig=GenerationHyperparameters(max_new_tokens=max_new, greedy=True),
+        )
+        r = await client.agenerate(req)
+        return r.output_tokens
+
+    async def go():
+        try:
+            return await asyncio.gather(
+                *[one(i, p) for i, p in enumerate(prompts)],
+                return_exceptions=True,
+            )
+        finally:
+            await client._close_session_for_current_loop()
+
+    return asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_target_tracking_hysteresis_cooldown_and_bounds():
+    clock = FakeClock()
+    cfg = make_fleet_config(
+        breach_evaluations=2,
+        scale_out_cooldown_seconds=10.0,
+        scale_in_cooldown_seconds=30.0,
+        max_servers=3,
+    )
+    pol = TargetTrackingPolicy(cfg, clock=clock)
+    hot = FleetSignals(queue_depth=10.0)
+    cold = FleetSignals(queue_depth=0.0)
+    # hysteresis: one breached look is NOT enough
+    assert pol.desired_size(hot, 1).direction == "hold"
+    d = pol.desired_size(hot, 1)
+    assert (d.desired, d.current) == (2, 1) and "queue_depth" in d.reason
+    # cooldown: an immediately following breach streak cannot re-fire
+    clock.now += 1.0
+    pol.desired_size(hot, 2)
+    d = pol.desired_size(hot, 2)
+    assert d.direction == "hold" and "cooldown" in d.reason
+    # past the cooldown the held streak fires immediately
+    clock.now += 10.0
+    assert pol.desired_size(hot, 2).desired == 3
+    clock.now += 20.0
+    pol.desired_size(hot, 3)
+    d = pol.desired_size(hot, 3)
+    assert d.direction == "hold" and "max_servers" in d.reason
+    # scale-in needs its own streak + cooldown, and clamps at min_servers
+    clock.now += 100.0
+    pol.desired_size(cold, 3)
+    d = pol.desired_size(cold, 3)
+    assert (d.desired, d.current) == (2, 3)
+    clock.now += 1.0
+    pol.desired_size(cold, 2)
+    d = pol.desired_size(cold, 2)
+    assert d.direction == "hold" and "cooldown" in d.reason
+    clock.now += 30.0
+    pol.desired_size(cold, 1)
+    d = pol.desired_size(cold, 1)
+    assert d.direction == "hold" and "min_servers" in d.reason
+
+
+def test_target_tracking_mixed_load_neither_scales():
+    # above the low-water mark but below the high-water mark: steady state
+    cfg = make_fleet_config(breach_evaluations=1)
+    pol = TargetTrackingPolicy(cfg, clock=FakeClock())
+    mid = FleetSignals(queue_depth=0.5)
+    for _ in range(5):
+        assert pol.desired_size(mid, 1).direction == "hold"
+
+
+def test_ttft_and_rollout_wait_signals_trigger_scale_out():
+    cfg = make_fleet_config(
+        breach_evaluations=1,
+        queue_depth_high_per_server=0.0,  # disabled
+        ttft_p95_high_seconds=0.5,
+        rollout_wait_fraction_high=0.6,
+    )
+    pol = TargetTrackingPolicy(cfg, clock=FakeClock())
+    d = pol.desired_size(FleetSignals(ttft_p95=0.9), 1)
+    assert d.desired == 2 and "ttft_p95" in d.reason
+    pol2 = TargetTrackingPolicy(cfg, clock=FakeClock())
+    d = pol2.desired_size(FleetSignals(rollout_wait_fraction=0.8), 1)
+    assert d.desired == 2 and "rollout_wait_fraction" in d.reason
+
+
+def test_manual_policy_clamps_to_bounds():
+    cfg = make_fleet_config(min_servers=1, max_servers=3, policy="manual")
+    pol = build_policy(cfg)
+    assert isinstance(pol, ManualPolicy)
+    pol.set_size(10)
+    assert pol.desired_size(FleetSignals(), 1).desired == 3
+    pol.set_size(0)
+    assert pol.desired_size(FleetSignals(), 3).desired == 1
+
+
+# ---------------------------------------------------------------------------
+# membership-safe client
+# ---------------------------------------------------------------------------
+
+
+class _FakeResp:
+    def __init__(self, status=200, json_data=None):
+        self.status = status
+        self._json = json_data if json_data is not None else {"success": True}
+        self.headers = {}
+
+    async def json(self):
+        return self._json
+
+    async def text(self):
+        return ""
+
+
+class _FakeCM:
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    async def __aenter__(self):
+        if isinstance(self._outcome, BaseException):
+            raise self._outcome
+        return self._outcome
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class FakeSession:
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls: list[tuple[str, str]] = []
+        self.closed = False
+
+    def request(self, method, url, json=None, data=None, timeout=None, headers=None):
+        self.calls.append((method, url))
+        return _FakeCM(self.handler(method, url, json))
+
+    def get(self, url, timeout=None):
+        self.calls.append(("GET", url))
+        return _FakeCM(self.handler("GET", url, None))
+
+    async def close(self):
+        self.closed = True
+
+    def calls_to(self, addr):
+        return [c for c in self.calls if f"//{addr}/" in c[1]]
+
+
+def make_fake_client(addrs, handler, **cfg_kw) -> RemoteInfEngine:
+    cfg_kw.setdefault("experiment_name", "fleet-fake")
+    cfg_kw.setdefault("trial_name", "t")
+    cfg_kw.setdefault("request_retries", 1)
+    cfg_kw.setdefault("cache_aware_routing", False)
+    cfg_kw.setdefault("breaker", CircuitBreakerConfig(failure_threshold=1))
+    client = RemoteInfEngine(InferenceEngineConfig(**cfg_kw))
+    client.addresses = list(addrs)
+    session = FakeSession(handler)
+
+    async def _fake_get_session():
+        return session
+
+    client._get_session = _fake_get_session
+    client._new_session = lambda: session
+    client._ensure_probe_task = lambda: None
+    return client, session
+
+
+def test_add_and_remove_server_update_routing_and_affinity():
+    client, _ = make_fake_client(["a:1", "b:1"], lambda m, u, p: _FakeResp())
+    client._remember_rid("r-a", "a:1")
+    client._remember_rid("r-b", "b:1")
+    assert client.add_server("c:1") is True
+    assert client.add_server("c:1") is False  # idempotent
+    assert client.addresses == ["a:1", "b:1", "c:1"]
+    # removal drops ONLY the departed server's rid affinities
+    assert client.remove_server("a:1", reason="test") is True
+    assert "a:1" not in client.addresses
+    assert "r-a" not in client._rid_to_address
+    assert client._rid_to_address.get("r-b") == "b:1"
+    assert client.affinity_load("b:1") == 1
+    # choose_server never yields the departed address again
+    picks = {client.choose_server() for _ in range(8)}
+    assert "a:1" not in picks and picks <= {"b:1", "c:1"}
+
+
+def test_remove_server_refuses_the_last_member():
+    client, _ = make_fake_client(["a:1"], lambda m, u, p: _FakeResp())
+    assert client.remove_server("a:1") is False
+    assert client.addresses == ["a:1"]
+
+
+def test_rendezvous_remap_only_departed_servers_keys():
+    client, _ = make_fake_client(
+        ["a:1", "b:1", "c:1"], lambda m, u, p: _FakeResp()
+    )
+    keys = [bytes([i, i + 1, 7, 9]) for i in range(32)]
+    before = {
+        k: client._rendezvous_pick(k, list(client.addresses)) for k in keys
+    }
+    client.remove_server("b:1", reason="test")
+    after = {
+        k: client._rendezvous_pick(k, list(client.addresses)) for k in keys
+    }
+    for k in keys:
+        if before[k] != "b:1":
+            assert after[k] == before[k]  # survivors keep their keys
+        else:
+            assert after[k] in ("a:1", "c:1")
+
+
+def test_health_tracker_forget_clears_state():
+    client, _ = make_fake_client(["a:1", "b:1"], lambda m, u, p: _FakeResp())
+    client._health.quarantine("a:1", required_version=5)
+    assert client._health.state("a:1") == OPEN
+    client.remove_server("a:1", reason="test")
+    # a later server reusing the address must NOT inherit the breaker
+    assert client._health.state("a:1") != OPEN
+    assert client._health.required_version("a:1") is None
+
+
+def test_refresh_drops_deregistered_servers_immediately():
+    exp, trial = "fleet-refresh", "t0"
+    root = names.gen_servers(exp, trial)
+    try:
+        name_resolve.clear_subtree(names.trial_root(exp, trial))
+    except Exception:
+        pass
+    name_resolve.add(names.gen_server(exp, trial, "s0"), "h0:1", replace=True)
+    name_resolve.add(names.gen_server(exp, trial, "s1"), "h1:1", replace=True)
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name=exp, trial_name=trial, consumer_batch_size=1,
+            server_refresh_interval=0.01,
+        )
+    )
+    try:
+        client.initialize(None, train_data_parallel_size=1)
+        assert sorted(client.addresses) == ["h0:1", "h1:1"]
+        # s1 deregisters (crash cleanup / drain): dropped after TWO
+        # consecutive missing resolves (partial-listing protection) —
+        # still far ahead of breaker trips burning timeout x retries
+        name_resolve.delete(names.gen_server(exp, trial, "s1"))
+        client._refresh_servers_sync()
+        assert sorted(client.addresses) == ["h0:1", "h1:1"]  # 1st miss
+        client._refresh_servers_sync()
+        assert client.addresses == ["h0:1"]  # confirmed gone
+        # a server that REAPPEARS between refreshes is never removed
+        name_resolve.add(names.gen_server(exp, trial, "s1"), "h1:1", replace=True)
+        client._refresh_servers_sync()
+        name_resolve.delete(names.gen_server(exp, trial, "s1"))
+        client._refresh_servers_sync()  # miss #1
+        name_resolve.add(names.gen_server(exp, trial, "s1"), "h1:1", replace=True)
+        client._refresh_servers_sync()  # back — miss counter clears
+        name_resolve.delete(names.gen_server(exp, trial, "s1"))
+        client._refresh_servers_sync()  # miss #1 again: still in rotation
+        assert sorted(client.addresses) == ["h0:1", "h1:1"]
+        client._refresh_servers_sync()
+        assert client.addresses == ["h0:1"]
+        # an empty resolve never dismantles the rotation
+        name_resolve.delete(names.gen_server(exp, trial, "s0"))
+        assert name_resolve.get_subtree(root) == []
+        client._refresh_servers_sync()
+        client._refresh_servers_sync()
+        assert client.addresses == ["h0:1"]
+        # a re-registration joins, and the (deregistered) h0 drops once
+        # two non-empty resolves confirm it
+        name_resolve.add(
+            names.gen_server(exp, trial, "s2"), "h2:1", replace=True
+        )
+        client._refresh_servers_sync()
+        client._refresh_servers_sync()
+        assert client.addresses == ["h2:1"]
+    finally:
+        client.destroy()
+
+
+def test_membership_changes_defer_until_weight_stream_settles():
+    """The torn-membership race the fence exists for: a server may never
+    join (and miss chunks) or leave (tearing the target set) while a
+    streamed weight update is in flight — both block until it settles."""
+    client, session = make_fake_client(
+        ["a:1", "b:1"], lambda m, u, p: _FakeResp()
+    )
+
+    def slow_chunks():
+        for i in range(3):
+            time.sleep(0.15)
+            yield {"w": np.full((4,), float(i), np.float32)}
+
+    t_update_done = []
+    t_add_done = []
+    t_remove_done = []
+
+    def do_update():
+        client.update_weights_from_tensors(slow_chunks(), next_version=1)
+        t_update_done.append(time.monotonic())
+
+    def do_add():
+        client.add_server("c:1")
+        t_add_done.append(time.monotonic())
+
+    def do_remove():
+        client.remove_server("b:1", reason="test")
+        t_remove_done.append(time.monotonic())
+
+    ut = threading.Thread(target=do_update)
+    ut.start()
+    time.sleep(0.12)  # the stream is mid-flight now
+    at = threading.Thread(target=do_add)
+    rt = threading.Thread(target=do_remove)
+    at.start()
+    rt.start()
+    time.sleep(0.1)
+    assert at.is_alive() and rt.is_alive(), (
+        "membership change went through MID-STREAM"
+    )
+    ut.join(timeout=10)
+    at.join(timeout=10)
+    rt.join(timeout=10)
+    assert t_update_done and t_add_done and t_remove_done
+    assert t_add_done[0] >= t_update_done[0]
+    assert t_remove_done[0] >= t_update_done[0]
+    # the late joiner received ZERO chunks of the stream it missed...
+    assert session.calls_to("c:1") == []
+    # ...while both fan-out targets saw the full 3-chunk stream
+    assert len(session.calls_to("a:1")) == 3
+    assert len(session.calls_to("b:1")) == 3
+    assert "c:1" in client.addresses and "b:1" not in client.addresses
+    assert client.get_version() == 1
+
+
+def test_prober_hits_the_ready_gate():
+    urls = []
+
+    def handler(method, url, payload):
+        urls.append(url)
+        return _FakeResp(status=200, json_data={"status": "ready"})
+
+    client, session = make_fake_client(
+        ["a:1"],
+        handler,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=1,
+            open_cooldown_seconds=0.0,
+            probe_interval_seconds=0.0,
+        ),
+    )
+    client._health.quarantine("a:1")
+    asyncio.run(client._probe_open_servers(session))
+    assert any(u.endswith("/ready") for u in urls), urls
+    assert not any(u.endswith("/health") for u in urls), urls
+
+
+def test_executor_resize_tracks_rollouts_per_server():
+    client, _ = make_fake_client(
+        ["a:1"],
+        lambda m, u, p: _FakeResp(),
+        rollouts_per_server=3,
+        consumer_batch_size=2,
+    )
+    client.executor.initialize(train_data_parallel_size=1)
+    try:
+        client.executor.on_fleet_resize(1)
+        assert (
+            client.executor.staleness_manager.max_concurrent_rollouts == 3
+        )
+        client.add_server("b:1")
+        client.add_server("c:1")
+        assert (
+            client.executor.staleness_manager.max_concurrent_rollouts == 9
+        )
+        client.remove_server("b:1", reason="test")
+        assert (
+            client.executor.staleness_manager.max_concurrent_rollouts == 6
+        )
+        s = client.executor.staleness_manager.get_stats()
+        assert s.submitted == s.accepted + s.rejected + s.running
+    finally:
+        client.executor.destroy()
+
+
+# ---------------------------------------------------------------------------
+# /ready endpoint
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self):
+        self.ready_flag = False
+        self.version = 3
+        self.healthy = True
+
+    def is_ready(self):
+        return self.ready_flag
+
+    def get_version(self):
+        return self.version
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+def test_ready_endpoint_gates_on_init_and_version():
+    import urllib.error
+    import urllib.request
+
+    from areal_tpu.inference.server import GenerationServer
+
+    engine = _StubEngine()
+    server = GenerationServer(engine)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=30)
+
+    def status(path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        # initializing: /health says alive, /ready refuses
+        assert status("/health") == 200
+        assert status("/ready") == 503
+        engine.ready_flag = True
+        assert status("/ready") == 200
+        # version gate: stale weights refuse, current pass
+        assert status("/ready?min_version=5") == 503
+        assert status("/ready?min_version=3") == 200
+        assert status("/ready?min_version=bogus") == 400
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+
+
+# ---------------------------------------------------------------------------
+# provider (real subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_local_provider_spawn_ready_and_graceful_terminate():
+    prov = LocalSubprocessProvider(
+        argv_template=sim_argv("--ready-delay", "0.3")
+    )
+    try:
+        from areal_tpu.utils.network import find_free_ports
+
+        h = prov.spawn("t0", find_free_ports(1)[0])
+        assert prov.alive(h)
+        cfg = make_fleet_config()
+        ctl = FleetController(
+            make_client_for_controller(), cfg, provider=prov, policy=None
+        )
+        # readiness gate lags behind process liveness
+        deadline = time.monotonic() + 15
+        saw_not_ready = False
+        st = None
+        while time.monotonic() < deadline:
+            st = ctl._fetch_ready_status(h.addr)
+            if st == 200:
+                break
+            if st == 503:
+                saw_not_ready = True
+            time.sleep(0.05)
+        assert st == 200
+        assert saw_not_ready, "/ready never reported initializing"
+        # SIGTERM drain exits cleanly
+        rc = prov.terminate(h, grace=10.0)
+        assert rc == 0
+        assert not prov.alive(h)
+    finally:
+        prov.close()
+
+
+def make_client_for_controller(addrs=("x:1",)):
+    """A client whose network surface is never exercised (controller unit
+    tests that only need .addresses / config / health)."""
+    client, _ = make_fake_client(list(addrs), lambda m, u, p: _FakeResp())
+    return client
+
+
+# ---------------------------------------------------------------------------
+# controller e2e (real subprocess fleet)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_events():
+    snap = flight_recorder.DEFAULT_RECORDER.snapshot()
+    return snap["channels"].get("fleet", [])
+
+
+def test_elastic_fleet_scales_out_and_in_with_zero_failures():
+    """The acceptance e2e: a 1-server fleet under an injected load spike
+    scales 1 -> 3 and back to 1 with zero failed requests, token-identical
+    greedy outputs, and every scale decision on the flight-recorder
+    ``fleet`` channel."""
+    flight_recorder.DEFAULT_RECORDER.reset()
+    cfg = make_fleet_config(max_servers=3)
+    prov = LocalSubprocessProvider(
+        argv_template=sim_argv("--token-time", "0.015", "--max-concurrency", "1")
+    )
+    ctl = None
+    client = None
+    try:
+        ctl_client_cfg = dict(
+            experiment_name="fleet-e2e", trial_name="t",
+            max_concurrent_rollouts=32, request_retries=2,
+        )
+        prompts = [[1, 2, 3, i] for i in range(32)]
+        expected = [expected_tokens(p, 10) for p in prompts]
+
+        # --- static-fleet reference run (controller off, 1 server) ---
+        static_ctl = FleetController(
+            make_client_for_controller(), cfg, provider=prov
+        )
+        static_addr = static_ctl.bootstrap()
+        assert len(static_addr) == 1
+        static_client = make_client(static_addr, **ctl_client_cfg)
+        static_out = run_load(static_client, prompts, max_new=10)
+        static_errs = [r for r in static_out if isinstance(r, BaseException)]
+        assert not static_errs
+        assert static_out == expected
+        static_client.destroy()
+        static_ctl.close()
+
+        # --- elastic run ---
+        ctl0 = FleetController(make_client_for_controller(), cfg, provider=prov)
+        addrs = ctl0.bootstrap()
+        client = make_client(addrs, **ctl_client_cfg)
+        ctl = FleetController(client, cfg, provider=prov)
+        ctl._members.update(ctl0._members)  # adopt the bootstrap member
+
+        results = {}
+        lt = threading.Thread(
+            target=lambda: results.update(
+                out=run_load(client, prompts, max_new=10)
+            )
+        )
+        lt.start()
+        sizes = [len(client.addresses)]
+        t0 = time.monotonic()
+        while lt.is_alive() and time.monotonic() - t0 < 60:
+            ctl.step()
+            sizes.append(len(client.addresses))
+            time.sleep(0.25)
+        lt.join(timeout=30)
+        assert not lt.is_alive()
+        # scaled out to the max under the spike
+        assert max(sizes) == 3, sizes
+        errs = [r for r in results["out"] if isinstance(r, BaseException)]
+        assert errs == []
+        # token-identical to the static-fleet run (and the pure function)
+        assert results["out"] == static_out == expected
+        # idle fleet shrinks back to min_servers
+        t0 = time.monotonic()
+        while len(client.addresses) > 1 and time.monotonic() - t0 < 30:
+            ctl.step()
+            time.sleep(0.05)
+        assert len(client.addresses) == 1
+        # every scale decision is on the flight-recorder fleet channel
+        events = _fleet_events()
+        kinds = [e["kind"] for e in events]
+        n_out, n_in = kinds.count("scale_out"), kinds.count("scale_in")
+        assert n_out >= 2  # reached 3 from 1
+        assert n_in == n_out  # returned to 1 (started at 1)
+        decisions = [e for e in events if e["kind"] == "decision"]
+        assert len(decisions) == n_out + n_in  # one per executed action
+        for e in decisions:
+            assert e["desired"] != e["current"] and e["reason"]
+        # metrics: executed actions counted by direction
+        from areal_tpu.utils import metrics as _metrics
+
+        ev = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_fleet_scale_events_total", labels=("direction",)
+        )
+        assert ev.labels(direction="out").value >= 2
+        assert ev.labels(direction="in").value >= 2
+    finally:
+        if ctl is not None:
+            ctl.close()
+        if client is not None:
+            client.destroy()
+        prov.close()
+
+
+def test_scale_in_mid_generation_fails_over_token_exactly():
+    """Scale-in while a generation is in flight on the victim: routing is
+    removed FIRST, then the victim is SIGTERM-drained (the PR 4 grace
+    path) — it aborts the in-flight generation with its partial tokens,
+    and the client re-dispatches with those tokens replayed as prompt.
+    The final output must be token-exact, and the survivor must have seen
+    the REPLAYED (longer-than-original) prompt, proving the splice."""
+    prov = LocalSubprocessProvider(
+        argv_template=sim_argv("--token-time", "0.04", "--max-concurrency", "4")
+    )
+    client = None
+    try:
+        from areal_tpu.utils.network import find_free_ports
+
+        h0 = prov.spawn("v0", find_free_ports(1)[0])
+        h1 = prov.spawn("v1", find_free_ports(1)[0])
+        client = make_client(
+            [h0.addr, h1.addr],
+            experiment_name="fleet-failover", trial_name="t",
+            schedule_policy="round_robin", request_retries=1,
+            failover_retries=3,
+        )
+        ctl_probe = FleetController(client, make_fleet_config(), provider=prov)
+        for h in (h0, h1):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 15:
+                if ctl_probe._fetch_ready_status(h.addr) == 200:
+                    break
+                time.sleep(0.05)
+        # round_robin: the first request lands on addresses[0] == h0
+        victim = client.addresses[0]
+        assert victim == h0.addr
+        prompt = [9, 8, 7]
+        want = expected_tokens(prompt, 30)
+        results = {}
+
+        def go():
+            results["out"] = run_load(client, [prompt], max_new=30)
+
+        lt = threading.Thread(target=go)
+        lt.start()
+        # wait until the request is actually in flight on the victim
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            if client.inflight_snapshot().get(victim, 0) > 0:
+                break
+            time.sleep(0.02)
+        assert client.inflight_snapshot().get(victim, 0) > 0
+        time.sleep(0.3)  # some tokens have been generated by now
+        # the scale-in protocol: remove from routing FIRST, then drain
+        assert client.remove_server(victim, reason="scale-in")
+        rc = prov.terminate(h0, grace=10.0)
+        assert rc == 0  # graceful drain, not a kill
+        lt.join(timeout=60)
+        assert not lt.is_alive()
+        (out,) = results["out"]
+        assert not isinstance(out, BaseException), out
+        assert out == want, "failover splice was not token-exact"
+        # the survivor served the RESUME: its prompt carried the victim's
+        # partial output (strictly longer than the original prompt)
+        info = ctl_probe._fetch_info(h1.addr)
+        assert info is not None
+        assert info["last_prompt_len"] > len(prompt)
+        assert info["last_prompt_len"] < len(prompt) + 30
+    finally:
+        if client is not None:
+            client.destroy()
+        prov.close()
+
+
+def test_newcomer_crashing_mid_warmup_never_joins():
+    """Chaos: a spawned server that dies before its readiness gate passes
+    is reaped, never enters rotation, and the failure is observable."""
+    flight_recorder.DEFAULT_RECORDER.reset()
+    cfg = make_fleet_config(max_servers=2, ready_timeout_seconds=30.0)
+    prov = LocalSubprocessProvider(
+        argv_template=sim_argv("--ready-delay", "0.2", "--crash-before-ready")
+    )
+    client = make_client_for_controller(["stable:1"])
+    ctl = FleetController(client, cfg, provider=prov)
+    try:
+        before = list(client.addresses)
+        d = ctl.set_size(2)
+        assert d.desired == 2
+        # the newcomer crashed during warmup: membership is unchanged
+        assert client.addresses == before
+        assert prov._procs == {}  # reaped, no zombie left registered
+        events = _fleet_events()
+        assert any(e["kind"] == "warmup_failed" for e in events)
+        assert not any(e["kind"] == "scale_out" for e in events)
+        from areal_tpu.utils import metrics as _metrics
+
+        wf = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_fleet_warmup_failures_total"
+        )
+        assert wf.value >= 1
+    finally:
+        ctl.close()
+        prov.close()
+
+
+def test_scale_in_of_unmanaged_member_writes_drain_key():
+    """A launcher-booted victim (no process handle) is drained through its
+    name_resolve drain key — which must be derived BEFORE the registration
+    is deleted, or the drain can never be requested."""
+    exp, trial = "fleet-unmanaged", "t"
+    try:
+        name_resolve.clear_subtree(names.trial_root(exp, trial))
+    except Exception:
+        pass
+    name_resolve.add(names.gen_server(exp, trial, "boot0"), "u0:1", replace=True)
+    name_resolve.add(names.gen_server(exp, trial, "boot1"), "u1:1", replace=True)
+    client, _ = make_fake_client(
+        ["u0:1", "u1:1"], lambda m, u, p: _FakeResp(),
+        experiment_name=exp, trial_name=trial,
+    )
+    cfg = make_fleet_config(min_servers=1, max_servers=2)
+    ctl = FleetController(
+        client, cfg, provider=LocalSubprocessProvider(argv_template=sim_argv())
+    )
+    assert ctl._scale_in_one("test")
+    victim_id, survivor_id = "boot0", "boot1"
+    if client.addresses == ["u0:1"]:
+        victim_id, survivor_id = "boot1", "boot0"
+    # the drain key was written (the server watches it and exits)...
+    assert (
+        name_resolve.get(names.gen_server_drain(exp, trial, victim_id))
+        in ("u0:1", "u1:1")
+    )
+    # ...and the registration is gone, the survivor's intact
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        name_resolve.get(names.gen_server(exp, trial, victim_id))
+    assert name_resolve.get(
+        names.gen_server(exp, trial, survivor_id)
+    ) in ("u0:1", "u1:1")
+
+
+def test_discovery_join_at_nonzero_version_is_quarantined():
+    """A server that appears via name_resolve AFTER weight updates have
+    happened holds an unknown version: it joins the list but stays
+    quarantined (zero traffic) until the version-checked probe clears it."""
+    client, _ = make_fake_client(["a:1"], lambda m, u, p: _FakeResp())
+    client.set_version(3)
+    client.add_server("late:1", source="discovery")
+    assert "late:1" in client.addresses
+    assert client._health.state("late:1") == OPEN
+    assert client._health.required_version("late:1") == 3
+    picks = {client.choose_server() for _ in range(8)}
+    assert "late:1" not in picks
+    # a fleet-controller join (already warmed) is NOT quarantined
+    client.add_server("warm:1", source="fleet-scale-out")
+    assert client._health.state("warm:1") != OPEN
+
+
+def test_idle_requires_signal_data():
+    """All-polls-failed must read as UNKNOWN, never as idle."""
+    cfg = make_fleet_config(breach_evaluations=1)
+    pol = TargetTrackingPolicy(cfg, clock=FakeClock())
+    dark = FleetSignals(queue_depth=0.0, n_servers=3, n_reporting=0)
+    for _ in range(4):
+        assert pol.desired_size(dark, 3).direction == "hold"
+
+
+def test_rollouts_per_server_applies_at_initialize():
+    exp, trial = "fleet-cap-init", "t"
+    try:
+        name_resolve.clear_subtree(names.trial_root(exp, trial))
+    except Exception:
+        pass
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name=exp, trial_name=trial,
+            rollouts_per_server=4, consumer_batch_size=2,
+        )
+    )
+    try:
+        client.initialize(["a:1", "b:1"], train_data_parallel_size=1)
+        # capacity reflects the boot fleet from step one, not only after
+        # the first membership change
+        assert client.executor.staleness_manager.max_concurrent_rollouts == 8
+    finally:
+        client.destroy()
+
+
+def test_warmup_repushes_missed_disk_update(tmp_path):
+    """The version-checked warmup: a newcomer that comes up at version 0
+    while the fleet is at version 2 gets the last disk update re-pushed
+    before it may enter rotation."""
+    prov = LocalSubprocessProvider(argv_template=sim_argv())
+    client = None
+    try:
+        from areal_tpu.utils.network import find_free_ports
+
+        h = prov.spawn("w0", find_free_ports(1)[0])
+        client = make_client(
+            [h.addr], experiment_name="fleet-warm", trial_name="t"
+        )
+        # wait for the sim server to come up
+        ctl = FleetController(client, make_fleet_config(), provider=prov)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15:
+            if ctl._fetch_ready_status(h.addr) == 200:
+                break
+            time.sleep(0.05)
+        client.set_version(2)
+        client._last_disk_update = (str(tmp_path / "ckpt"), 2)
+        assert client.warmup_server(h.addr, timeout=15.0) is True
+        info = ctl._fetch_info(h.addr)
+        assert info["weight_version"] == 2
+        # without a rejoin artifact, a stale newcomer must NOT pass
+        h2 = prov.spawn("w1", find_free_ports(1)[0])
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15:
+            if ctl._fetch_ready_status(h2.addr) == 200:
+                break
+            time.sleep(0.05)
+        client._last_disk_update = None
+        assert client.warmup_server(h2.addr, timeout=3.0) is False
+    finally:
+        if client is not None:
+            client.destroy()
+        prov.close()
