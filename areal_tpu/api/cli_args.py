@@ -1052,6 +1052,70 @@ class StepTimelineConfig:
 
 
 @dataclass
+class RLHealthConfig:
+    """RL training-health observatory (utils/rl_health.py): per-step
+    distribution telemetry for the ALGORITHM plane — staleness mix from
+    per-token ``versions``, importance/behavior ratios + clip and cap
+    trigger fractions, reward raw-vs-clipped distributions, entropy/KL
+    estimates, advantage stats, generation length/truncation, and a cheap
+    degenerate-output detector — exported as ``areal_rl_*`` registry
+    instruments, ``rl_health/*`` StatsLogger scalars, and events on the
+    ``train.step`` tracing span; plus an anomaly sentinel: a declarative
+    rule table (non-finite loss/grad, entropy floor, ratio blow-up,
+    staleness spike, reward collapse/flatline, repetition spike) evaluated
+    once per step with hysteresis. A firing rule latches
+    ``areal_rl_anomaly_total{rule}``, writes a flight-recorder ``anomaly``
+    entry with the full offending-batch stats, dumps the recorder
+    atomically, and drives the configured guardrail action. Runs once per
+    STEP on host-side numpy already in the update path; disabled, the hot
+    paths pay only ``is not None`` checks (code-inspection pinned)."""
+
+    enabled: bool = True
+    # default guardrail when a rule fires: "warn" (log + telemetry only),
+    # "pause_rollout" (WorkflowExecutor.pause — stop feeding new episodes
+    # while the operator looks), or "halt" (raise RLHealthHalt BEFORE the
+    # step's checkpoint commits, so a poisoned step never becomes the
+    # resume point)
+    action: str = "warn"
+    # per-rule action overrides, e.g. {"non_finite_loss": "halt"}
+    rule_actions: dict[str, str] = field(default_factory=dict)
+    # consecutive breached evaluations before a rule fires (hysteresis; a
+    # one-step blip never trips a guardrail). non_finite_loss always fires
+    # on the first breach — one NaN step is already one too many
+    consecutive: int = 2
+    # entropy floor (nats): the per-token Monte-Carlo entropy estimate
+    # (mean -logprob of sampled tokens) falling below this means the
+    # policy has collapsed toward deterministic outputs
+    entropy_floor: float = 0.01
+    # importance-ratio p99 cap: exp(prox_logp - behav_logp) tail beyond
+    # this means the data is too off-policy to trust the update
+    ratio_p99_cap: float = 4.0
+    # per-token staleness (current weight version - token version) p95
+    # threshold; meaningful values sit near max_head_offpolicyness
+    staleness_p95_max: float = 8.0
+    # trailing window (steps, incl. current) for reward collapse/flatline
+    reward_window_steps: int = 8
+    # flatline: std of per-step mean rewards over the window below this
+    # (with a FULL window) — the reward signal died
+    reward_std_floor: float = 1e-6
+    # collapse: current mean reward below trailing-window mean by more
+    # than this absolute drop; <= 0 disables the drop check
+    reward_collapse_drop: float = 0.5
+    # repetition spike: mean max-n-gram-loop fraction of generated tokens
+    # above this (degenerate looping output)
+    repetition_max_frac: float = 0.5
+    # ring of recent per-step snapshots kept on the flight recorder's
+    # ``rl_health`` channel (the context dumped next to an anomaly)
+    ring_steps: int = 64
+    # publish a compact status JSON (last step stats + last anomaly) to
+    # name_resolve for the `areal-tpu-top` operator CLI
+    publish_status: bool = True
+    # filled from BaseExperimentConfig (status key namespacing)
+    experiment_name: str = ""
+    trial_name: str = ""
+
+
+@dataclass
 class LauncherConfig:
     inference_server_cpus_per_chip: int = 4
     inference_server_mem_per_chip: int = 32768
@@ -1095,6 +1159,7 @@ class BaseExperimentConfig:
     step_timeline: StepTimelineConfig = field(
         default_factory=StepTimelineConfig
     )
+    rl_health: RLHealthConfig = field(default_factory=RLHealthConfig)
 
     def __post_init__(self):
         # propagate experiment/trial names into sub-configs left at defaults
@@ -1103,6 +1168,7 @@ class BaseExperimentConfig:
             "checkpointer",
             "evaluator",
             "stats_logger",
+            "rl_health",
         ):
             c = getattr(self, sub, None)
             if c is not None and not c.experiment_name:

@@ -83,6 +83,24 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     "weight_update_latency": True,
     "weight_sync_stall_seconds": True,
     "grpo_step_sec": True,
+    # on/off tokens-per-sec ratios: ~1.0 is the contract, higher is
+    # better (the name heuristic would read neither correctly)
+    "rl_health_overhead": False,
+    "tracing_overhead": False,
+}
+
+
+#: per-metric relative band floors wider than the default ``rel_floor``:
+#: for rungs whose headline is legitimately MULTI-MODAL on identical code,
+#: where a tight MAD over a clustered window reads the other mode as a
+#: regression. elastic_fleet: the autoscale-ON p95 depends on exactly when
+#: the 2nd simulated server's warmup completes relative to the open-loop
+#: arrival process — the trajectory shows two stable modes (~6.1x and
+#: ~5.2x speedup, both with max_fleet 3 and zero failed requests) across
+#: runs of the SAME commit; 20% covers the mode gap while a genuine break
+#: (autoscale not engaging) still gates, since that pins the ratio near 1.
+BAND_FLOOR_OVERRIDES: dict[str, float] = {
+    "elastic_fleet": 0.20,
 }
 
 
@@ -197,7 +215,8 @@ def analyze(
             continue
         med = statistics.median(baseline)
         mad = statistics.median(abs(b - med) for b in baseline)
-        band = max(cfg.mad_k * _MAD_SIGMA * mad, cfg.rel_floor * abs(med))
+        floor = BAND_FLOOR_OVERRIDES.get(metric, cfg.rel_floor)
+        band = max(cfg.mad_k * _MAD_SIGMA * mad, floor * abs(med))
         delta = value - med
         if lower:
             status = (

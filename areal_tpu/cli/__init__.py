@@ -1,0 +1,1 @@
+"""Operator CLIs (stdlib-only; see each module's run-by-path note)."""

@@ -1,0 +1,228 @@
+"""areal-tpu-top: one-screen fleet + training-health summary.
+
+The metrics plane (PR 8/9/13) exports everything, but an operator at a
+terminal still had to curl N servers and eyeball JSON. This CLI polls
+``GET /model_info`` (and optionally ``/metrics``) on every inference
+server in the fleet and the trainer's RL-health status key, then prints a
+one-screen summary: fleet size, per-server weight version / in-flight /
+queue depth / KV + prefix-cache occupancy / TTFT p95, plus the trainer's
+last-step health signals (entropy, ratio p99, staleness) and the last
+anomaly the sentinel fired.
+
+Discovery, in precedence order:
+
+1. ``--addrs host:port,host:port`` (or ``AREAL_LLM_SERVER_ADDRS``);
+2. name_resolve file discovery: ``--name-root`` (the NFS repository's
+   ``record_root``) + ``--experiment``/``--trial`` reads
+   ``<root>/areal_tpu/<exp>/<trial>/gen_servers/*/ENTRY`` — the exact
+   layout ``NfsNameRecordRepository`` writes — and the trainer status at
+   ``.../rl_health/ENTRY``.
+
+STDLIB-ONLY and run BY PATH (``python areal_tpu/cli/top.py``) by design,
+like the bench sentinel: importing the ``areal_tpu`` package resolves
+jax_compat and therefore jax, which on a host with a wedged TPU tunnel
+blocks forever — the exact situation an operator reaches for ``top`` in.
+The ``areal-tpu-top`` console entry exists for healthy installed hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+NAME_ROOT_DEFAULT = "/tmp/areal_tpu/name_resolve"
+PKG_ROOT = "areal_tpu"  # mirrors utils/names.py ROOT (stdlib: no import)
+
+
+def _read_entry(path: str) -> str | None:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def discover_servers(
+    name_root: str, experiment: str, trial: str
+) -> list[str]:
+    """Addresses registered under the trial's ``gen_servers`` subtree in
+    the file-backed name_resolve layout (one ``ENTRY`` file per key)."""
+    base = os.path.join(name_root, PKG_ROOT, experiment, trial, "gen_servers")
+    addrs = []
+    if not os.path.isdir(base):
+        return addrs
+    for server_id in sorted(os.listdir(base)):
+        v = _read_entry(os.path.join(base, server_id, "ENTRY"))
+        if v:
+            addrs.append(v)
+    return addrs
+
+
+def read_health_status(
+    name_root: str, experiment: str, trial: str
+) -> dict | None:
+    """The trainer-published RL-health status JSON (utils/rl_health.py
+    ``publish_status``), or None when absent/undecodable."""
+    raw = _read_entry(
+        os.path.join(name_root, PKG_ROOT, experiment, trial, "rl_health", "ENTRY")
+    )
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+def fetch_json(addr: str, path: str, timeout: float) -> dict | None:
+    url = f"http://{addr}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _fmt(v, spec: str = "", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    try:
+        return format(v, spec) if spec else str(v)
+    except (TypeError, ValueError):
+        return dash
+
+
+def render(
+    addrs: list[str],
+    infos: dict[str, dict | None],
+    health: dict | None,
+    now: float,
+) -> str:
+    """The one screen: fleet header, per-server table, trainer health."""
+    up = [a for a in addrs if infos.get(a)]
+    lines = []
+    versions = sorted(
+        {int(infos[a].get("weight_version", 0)) for a in up}
+    ) if up else []
+    spread = (versions[-1] - versions[0]) if versions else 0
+    lines.append(
+        f"areal-tpu-top  {time.strftime('%H:%M:%S', time.localtime(now))}"
+        f"  fleet {len(up)}/{len(addrs)} up"
+        + (f"  weight v{versions[-1]}" if versions else "")
+        + (f"  version spread {spread}" if spread else "")
+    )
+    header = (
+        f"{'ADDR':<22}{'VER':>5}{'INFL':>6}{'QUEUE':>7}{'KV%':>6}"
+        f"{'HIT%':>6}{'TTFT_P95':>10}{'TOK_TOTAL':>12}"
+    )
+    lines.append(header)
+    for a in addrs:
+        info = infos.get(a)
+        if not info:
+            lines.append(f"{a:<22}{'DOWN':>5}")
+            continue
+        used = info.get("kv_blocks_used", 0)
+        free = info.get("kv_blocks_free", 0)
+        kv_pct = 100.0 * used / max(1, used + free)
+        hit = info.get("prefix_cache_hit_rate")
+        lines.append(
+            f"{a:<22}"
+            f"{_fmt(info.get('weight_version')):>5}"
+            f"{_fmt(info.get('n_running')):>6}"
+            f"{_fmt(info.get('admission_queue_depth')):>7}"
+            f"{kv_pct:>5.0f}%"
+            f"{_fmt(hit * 100 if hit is not None else None, '.0f'):>5}%"
+            f"{_fmt(info.get('ttft_p95_seconds'), '.3f'):>10}"
+            f"{_fmt(info.get('generated_tokens_total')):>12}"
+        )
+    if health:
+        age = now - float(health.get("t", now))
+        lines.append(
+            f"train step {health.get('step', '-')} ({age:.0f}s ago)  "
+            f"entropy {_fmt(health.get('entropy'), '.3f')}  "
+            f"ratio_p99 {_fmt(health.get('ratio_p99'), '.2f')}  "
+            f"staleness_p95 {_fmt(health.get('staleness_p95'), '.1f')}  "
+            f"reward {_fmt(health.get('reward_mean'), '.3f')}  "
+            f"rep {_fmt(health.get('repetition_frac'), '.2f')}"
+        )
+        la = health.get("last_anomaly")
+        lines.append(
+            "last anomaly: "
+            + (
+                f"{la['rule']} @ step {la['step']} (action {la['action']})"
+                if la
+                else "none"
+            )
+            + f"  total fired: {health.get('anomalies_fired', 0)}"
+        )
+    else:
+        lines.append("train health: no status published")
+    return "\n".join(lines)
+
+
+def collect(args) -> str:
+    addrs = []
+    if args.addrs:
+        addrs = [a.strip() for a in args.addrs.split(",") if a.strip()]
+    elif os.environ.get("AREAL_LLM_SERVER_ADDRS"):
+        addrs = [
+            a.strip()
+            for a in os.environ["AREAL_LLM_SERVER_ADDRS"].split(",")
+            if a.strip()
+        ]
+    else:
+        addrs = discover_servers(args.name_root, args.experiment, args.trial)
+    infos = {a: fetch_json(a, "/model_info", args.timeout) for a in addrs}
+    health = read_health_status(args.name_root, args.experiment, args.trial)
+    return render(addrs, infos, health, time.time())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="areal-tpu-top", description=__doc__.split("\n\n")[0]
+    )
+    p.add_argument(
+        "--addrs",
+        default="",
+        help="comma-separated host:port list (skips discovery); also "
+        "read from AREAL_LLM_SERVER_ADDRS",
+    )
+    p.add_argument(
+        "--name-root",
+        default=os.environ.get("AREAL_NAME_RESOLVE_ROOT", NAME_ROOT_DEFAULT),
+        help="NfsNameRecordRepository record_root for file discovery",
+    )
+    p.add_argument("--experiment", default="experiment")
+    p.add_argument("--trial", default="trial")
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=0.0,
+        help="refresh every N seconds (0 = print once and exit)",
+    )
+    p.add_argument("--timeout", type=float, default=2.0, help="per-request")
+    args = p.parse_args(argv)
+
+    if args.interval <= 0:
+        print(collect(args))
+        return 0
+    try:
+        while True:
+            screen = collect(args)
+            # clear + home, like top(1); fall back to plain print when not
+            # a tty (piped output stays parseable)
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(screen, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -390,7 +390,9 @@ class RemoteInfEngine(InferenceEngine):
                 try:
                     asyncio.run_coroutine_threadsafe(session.close(), loop).result(5)
                 except Exception:
-                    pass
+                    logger.debug(
+                        "session close failed during destroy", exc_info=True
+                    )
         self._sessions.clear()
         self._close_push_loop()
         self.executor.destroy()
@@ -893,7 +895,9 @@ class RemoteInfEngine(InferenceEngine):
                     _close_session(), loop
                 ).result(5)
             except Exception:
-                pass
+                logger.debug(
+                    "push-loop session close failed", exc_info=True
+                )
             loop.call_soon_threadsafe(loop.stop)
         if thread is not None:
             thread.join(timeout=5)
@@ -901,7 +905,7 @@ class RemoteInfEngine(InferenceEngine):
             if not loop.is_running():
                 loop.close()  # release the selector fd
         except Exception:
-            pass
+            logger.debug("push-loop close failed", exc_info=True)
 
     async def _stream_chunks_pipelined(
         self,

@@ -133,6 +133,11 @@ class WorkflowExecutor:
 
         self.exiting = threading.Event()
         self.paused = threading.Event()
+        # RL training-health observatory (utils/rl_health.py): attached by
+        # the trainer entry point; every collected batch feeds the
+        # degenerate-output detector at the wait() boundary. None costs
+        # only `is not None` checks (code-inspection pinned)
+        self.rl_health = None
         # polled inside wait/prepare_batch loops; when it returns True the
         # blocked call raises RolloutWaitInterrupted (preemption guard hook)
         self.interrupt_check: Callable[[], bool] | None = None
@@ -396,10 +401,15 @@ class WorkflowExecutor:
         crash_point("pre-rollout-wait")
         start = time.perf_counter()
         try:
-            return self._wait_impl(count, timeout, start)
+            batch = self._wait_impl(count, timeout, start)
         finally:
             self._waits_total.inc()
             self._wait_seconds_total.inc(time.perf_counter() - start)
+        if self.rl_health is not None:
+            # once per COLLECTED batch (never per token): degenerate-output
+            # + generation-shape signals for the training-health sentinel
+            self.rl_health.observe_rollout_batch(batch)
+        return batch
 
     def _wait_impl(
         self, count: int, timeout: float | None, start: float

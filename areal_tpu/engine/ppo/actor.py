@@ -55,6 +55,10 @@ class PPOActor:
         self.mask_no_eos_with_zero = config.mask_no_eos_with_zero
         self.dynamic_sampling = config.dynamic_sampling
         self.group_size = config.group_size
+        # RL training-health observatory (utils/rl_health.py): attached by
+        # the trainer entry point when rl_health.enabled; None costs only
+        # `is not None` checks on the update path (code-inspection pinned)
+        self.rl_health = None
 
         if config.reward_norm is not None:
             # full spec (reference PPOActorConfig.reward_norm); a
@@ -152,8 +156,16 @@ class PPOActor:
             )
 
         reward_score = np.asarray(data["rewards"], dtype=np.float32)
+        raw_reward = reward_score
         reward_score = (reward_score + self.reward_bias) * self.reward_scaling
-        reward_score = np.clip(reward_score, -self.reward_clip, self.reward_clip)
+        clipped = np.clip(reward_score, -self.reward_clip, self.reward_clip)
+        if self.rl_health is not None:
+            self.rl_health.note_rewards(
+                raw=raw_reward,
+                clipped=clipped,
+                clipped_frac=float((clipped != reward_score).mean()),
+            )
+        reward_score = clipped
         if self.reward_norm is not None:
             reward_score = self.reward_norm(reward_score)
 
@@ -253,6 +265,16 @@ class PPOActor:
         )
         global_stats = tracker.export()
 
+        if self.rl_health is not None:
+            # the observatory reads versions/logprobs/prox_logp/advantages
+            # in the post-compute_advantages alignment — before the keys
+            # below are dropped for the engine
+            self.rl_health.observe_train_batch(
+                data,
+                current_version=int(self.engine.get_version() or 0),
+                actor_config=cfg,
+            )
+
         data = dict(data)
         for key in ["rewards", "tot_rewards", "kl_rewards", "versions"]:
             data.pop(key, None)
@@ -289,6 +311,12 @@ class PPOActor:
                 loss_weight_fn=loss_weight_fn,
                 token_loss_fn=self._token_loss_fn,
             )
+            if self.rl_health is not None:
+                self.rl_health.note_train_result(
+                    loss=train_stat.get("loss"),
+                    grad_norm=train_stat.get("grad_norm"),
+                    update_successful=train_stat.get("update_successful"),
+                )
             tracker.scalar(**train_stat)
             all_stats.append(tracker.export())
         all_stats[0].update(global_stats)

@@ -500,7 +500,9 @@ class FleetController:
                 if name_resolve.get(key) == addr:
                     return key.rsplit("/", 1)[-1]
         except Exception:
-            pass
+            logger.debug(
+                "server-id lookup for %s failed", addr, exc_info=True
+            )
         return None
 
     def _deregister(self, addr: str, server_id: str | None = None) -> None:
@@ -516,7 +518,10 @@ class FleetController:
         try:
             name_resolve.delete(names.gen_server(exp, trial, server_id))
         except Exception:
-            pass
+            logger.debug(
+                "deregister of %s (%s) failed", server_id, addr,
+                exc_info=True,
+            )
 
     def _request_drain(self, addr: str, server_id: str | None) -> None:
         exp, trial = self._exp_trial()

@@ -238,7 +238,7 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
     try:
         name_resolve.clear_subtree(names.trial_root(cfg.experiment_name, cfg.trial_name))
     except Exception:
-        pass
+        logger.debug("stale trial-subtree clear failed", exc_info=True)
 
     alloc = AllocationMode.from_str(cfg.allocation_mode)
     servers = _spawn_servers(cfg, alloc)

@@ -104,15 +104,19 @@ async def amain(cfg: GenServerConfig):
                 name_resolve.get(stop_key)
                 logger.info("shutdown key found; exiting")
                 break
+            except name_resolve.NameEntryNotFoundError:
+                pass  # expected: no shutdown requested yet
             except Exception:
-                pass
+                logger.debug("stop-key poll failed", exc_info=True)
             try:
                 name_resolve.get(drain_key)
                 logger.info("drain key found; deregistering and exiting")
                 drained = True
                 break
+            except name_resolve.NameEntryNotFoundError:
+                pass  # expected: no drain requested yet
             except Exception:
-                pass
+                logger.debug("drain-key poll failed", exc_info=True)
             try:
                 await asyncio.wait_for(stop_event.wait(), timeout=2.0)
             except asyncio.TimeoutError:
@@ -126,7 +130,7 @@ async def amain(cfg: GenServerConfig):
             try:
                 name_resolve.delete(key)
             except Exception:
-                pass
+                logger.debug("deregister-on-exit failed", exc_info=True)
         await server.stop()
 
 

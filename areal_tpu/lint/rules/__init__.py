@@ -3,6 +3,7 @@
 from areal_tpu.lint.rules import (  # noqa: F401
     async_discipline,
     donation,
+    exceptions,
     fs_discipline,
     jax_compat,
     jit_discipline,

@@ -1,0 +1,87 @@
+"""Exception-handling discipline.
+
+swallowed-exception: a broad handler (``except:``, ``except Exception:``,
+``except BaseException:`` — alone or in a tuple) whose body does literally
+nothing (``pass`` / ``...``) swallows every failure silently. In a system
+whose health depends on anomalies surfacing — the flight recorder, the
+RL-health sentinel, the watchdog — a silently-dead error path is how a
+postmortem ends up empty. Narrow handlers (``except queue.Empty: pass``,
+``except ValueError: pass``) are exempt: naming the exception IS the
+statement that this specific failure is expected and benign. Broad
+handlers must log (any ``logger.*``/``logging.*`` call in the body flips
+them to non-empty anyway), re-raise, or carry an inline suppression with a
+justification. ``tests/`` is exempt via ``per_path_ignores``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = None
+        if isinstance(e, ast.Name):
+            name = e.id
+        elif isinstance(e, ast.Attribute):
+            name = e.attr
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _does_nothing(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and (
+                stmt.value.value is Ellipsis
+                or isinstance(stmt.value.value, str)  # docstring-comment
+            )
+        ):
+            continue
+        return False
+    return True
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "swallowed-exception"
+    doc = (
+        "broad `except (Base)Exception:`/bare `except:` with a pass-only "
+        "body and no logging — failures on this path die silently; "
+        "anomaly/cleanup paths must leave evidence"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if not _does_nothing(node.body):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                "broad exception handler swallows silently (pass-only "
+                "body): log it (logger.debug at minimum), narrow the "
+                "exception type, or suppress inline with a justification",
+            )

@@ -97,10 +97,66 @@ def crash_point(name: str) -> None:
 
                 flight_recorder.dump(f"injected_crash_{name}")
             except Exception:
-                pass
+                logger.debug("pre-crash flight dump failed", exc_info=True)
             raise InjectedCrash(
                 f"AREAL_CRASH_AT barrier {name!r} (arrival {n})"
             )
+
+# ---------------------------------------------------------------------------
+# deterministic RL-signal faults (the training-health sentinel harness)
+# ---------------------------------------------------------------------------
+
+RL_CHAOS_ENV = "AREAL_CHAOS_RL"
+
+#: fault names the RL-health observatory consults; each corrupts ONE
+#: health signal in the observed snapshot (never the training math), so
+#: the sentinel's detection/guardrail path is exercised end to end
+RL_FAULTS = (
+    "nan_loss",          # loss/grad_norm turn non-finite
+    "entropy_collapse",  # entropy estimate pinned to ~0
+    "staleness_spike",   # staleness p95/max jump past any threshold
+    "ratio_blowup",      # importance-ratio p99 jumps past the cap
+    "reward_flatline",   # rewards read as a constant
+    "repetition_spike",  # degenerate-output fraction pinned to 1.0
+)
+
+#: per-name arrival counters for ``name@N[:K]`` specs
+_rl_fault_hits: dict[str, int] = {}
+
+
+def reset_rl_faults() -> None:
+    """Clear arrival counters (tests arm a fresh spec per scenario)."""
+    _rl_fault_hits.clear()
+
+
+def rl_fault(name: str) -> bool:
+    """Deterministic RL-signal fault gate, mirroring :func:`crash_point`'s
+    grammar: ``AREAL_CHAOS_RL`` holds comma-separated specs ``name`` (fault
+    on the first arrival), ``name@N`` (the Nth), or ``name@N:K`` (arrivals
+    N..N+K-1 — K consecutive steps, for exercising sentinel hysteresis).
+    Returns True when THIS arrival is inside the armed window. Only called
+    by the RL-health monitor (already behind its enabled gate), once per
+    step — never in token-level loops."""
+    spec = os.environ.get(RL_CHAOS_ENV, "")
+    if not spec:
+        return False
+    for part in spec.split(","):
+        target, _, window = part.strip().partition("@")
+        if target != name:
+            continue
+        _rl_fault_hits[name] = _rl_fault_hits.get(name, 0) + 1
+        start_s, _, width_s = window.partition(":")
+        start = int(start_s) if start_s else 1
+        width = int(width_s) if width_s else 1
+        if start <= _rl_fault_hits[name] < start + width:
+            logger.warning(
+                "chaos: RL fault %r injected (arrival %d)",
+                name,
+                _rl_fault_hits[name],
+            )
+            return True
+    return False
+
 
 #: action vocabulary shared by config validation and the two hook sites
 ACTIONS = ("drop", "http_error", "timeout", "slow", "disconnect")

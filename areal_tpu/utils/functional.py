@@ -159,6 +159,66 @@ def ppo_actor_loss_fn(
     return loss, stats
 
 
+def ppo_loss_stats_host(
+    logprobs: np.ndarray,
+    proximal_logprobs: np.ndarray,
+    old_logprobs: np.ndarray,
+    advantages: np.ndarray,
+    loss_mask: np.ndarray,
+    eps_clip: float,
+    eps_clip_higher: float | None = None,
+    c_clip: float | None = None,
+    behav_imp_weight_cap: float | None = None,
+) -> dict[str, np.ndarray]:
+    """Host-side (numpy) mirror of :func:`ppo_actor_loss_fn`'s per-token
+    stats dict — the quantities the decoupled objective computes inside
+    jit and discards. The RL-health observatory (utils/rl_health.py) calls
+    this once per update batch; it must stay an exact transcription of the
+    jitted math (pinned against it by tests/test_functional.py), so a
+    reported clip fraction is the clip fraction the loss actually saw.
+
+    Same conventions as the loss: ``ratio = exp(logprobs - proximal)``
+    with masked tokens zeroed, ``clip_mask`` from the pessimistic-branch
+    comparison (advantage sign matters — only binding clips count),
+    ``behav_imp_weight = exp(proximal - old)`` with the cap mask applied.
+    """
+    mask = np.asarray(loss_mask).astype(bool)
+    lp = np.asarray(logprobs, np.float32)
+    prox = np.asarray(proximal_logprobs, np.float32)
+    old = np.asarray(old_logprobs, np.float32)
+    adv = np.asarray(advantages, np.float32)
+    ratio = np.where(mask, np.exp(lp - prox), 0.0)
+    hi = eps_clip if eps_clip_higher is None else eps_clip_higher
+    clipped_ratio = np.clip(ratio, 1.0 - eps_clip, 1.0 + hi)
+    pg1 = -adv * ratio
+    pg2 = -adv * clipped_ratio
+    clip_mask = (pg1 < pg2) & mask
+    pg = np.maximum(pg1, pg2)
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg3 = np.sign(adv) * c_clip * adv
+        dual_clip_mask = (pg3 < pg) & mask
+    else:
+        dual_clip_mask = np.zeros_like(clip_mask)
+    behav_kl = prox - old
+    behav_imp_weight = np.exp(behav_kl)
+    if behav_imp_weight_cap is not None:
+        behav_mask = (behav_imp_weight <= behav_imp_weight_cap) & mask
+    else:
+        behav_mask = mask
+    behav_kl = np.where(behav_mask, behav_kl, 0.0)
+    behav_imp_weight = np.where(behav_mask, behav_imp_weight, 0.0)
+    return dict(
+        importance_weight=ratio,
+        approx_kl=lp - prox,  # unmasked, like the loss's stop_gradient stat
+        clip_mask=clip_mask,
+        dual_clip_mask=dual_clip_mask,
+        behave_imp_weight=behav_imp_weight,
+        behave_approx_kl=behav_kl,
+        behave_mask=behav_mask,
+    )
+
+
 def ppo_critic_loss_fn(
     value: jnp.ndarray,
     old_value: jnp.ndarray,

@@ -145,7 +145,10 @@ def install_cache_event_counters(registry=None) -> bool:
                 if counter is not None:
                     try:
                         counter.labels(event=label).inc()
-                    except Exception:  # never fail a compile on telemetry
+                    # fires inside jax.monitoring's compile callback:
+                    # logging here could re-enter the listener or spam
+                    # once per cache event — silence is deliberate
+                    except Exception:  # arealint: disable=swallowed-exception
                         pass
 
             _mon.register_event_listener(_on_event)
@@ -218,7 +221,7 @@ class RecompileDetector:
         try:
             counter.labels(fn=name).inc()
         except Exception:
-            pass
+            logger.debug("retrace counter bump failed", exc_info=True)
         if warn:
             logger.warning(
                 "jitted function %r re-traced AFTER warmup (trace #%d): a "

@@ -52,7 +52,8 @@ class DistributedLock:
                 logger.warning("breaking expired lock %s", self.key)
                 name_resolve.delete(self.key)
         except Exception:
-            pass  # raced with the owner's release — fine
+            # raced with the owner's release — fine
+            logger.debug("expired-lock break raced", exc_info=True)
 
     def acquire(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -79,7 +80,7 @@ class DistributedLock:
                     "lock %s no longer owned by this holder", self.key
                 )
         except Exception:
-            pass
+            logger.debug("lock release for %s raced", self.key, exc_info=True)
         self._held = False
 
     def __enter__(self):

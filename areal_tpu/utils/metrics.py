@@ -122,6 +122,35 @@ class _HistogramChild:
             self.sum += value
             self.count += 1
 
+    def observe_many(self, values) -> None:
+        """Bulk observation for per-batch array telemetry (the RL-health
+        observatory feeds thousands of per-token values once per step): one
+        bucketize pass + one lock acquisition, instead of a python loop of
+        per-value ``observe`` calls each taking the lock.
+
+        Non-finite values are DROPPED: one NaN would stick in ``sum``
+        forever and poison every later scrape of ``<name>_sum`` — and the
+        diverging-run regime is exactly when these histograms must stay
+        readable (the sentinel reports the non-finite value itself through
+        its own rules)."""
+        import numpy as np
+
+        vals = np.asarray(values, dtype=np.float64).reshape(-1)
+        vals = vals[np.isfinite(vals)]
+        if vals.size == 0:
+            return
+        # side="left" matches bisect_left in observe(): value == bound
+        # lands IN that bucket (prometheus le semantics)
+        idx = np.searchsorted(self.buckets, vals, side="left")
+        binned = np.bincount(idx, minlength=len(self.buckets) + 1)
+        total = float(vals.sum())
+        with self._lock:
+            for i, c in enumerate(binned):
+                if c:
+                    self.counts[i] += int(c)
+            self.sum += total
+            self.count += int(vals.size)
+
     def quantile(self, q: float) -> float:
         """Bucket-interpolated quantile estimate (the scrape-side
         ``histogram_quantile`` computation, available in-process so the
@@ -258,6 +287,9 @@ class _Metric:
 
     def observe(self, value: float) -> None:
         self._solo().observe(value)
+
+    def observe_many(self, values) -> None:
+        self._solo().observe_many(values)
 
     def quantile(self, q: float) -> float:
         return self._solo().quantile(q)

@@ -52,3 +52,9 @@ def distributed_lock(experiment_name: str, trial_name: str, lock_name: str) -> s
 
 def worker_status(experiment_name: str, trial_name: str, worker: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/worker_status/{worker}"
+
+
+def rl_health(experiment_name: str, trial_name: str) -> str:
+    """Trainer-published RL-health status JSON (last step's headline
+    signals + last anomaly), read by the ``areal-tpu-top`` operator CLI."""
+    return f"{trial_root(experiment_name, trial_name)}/rl_health"

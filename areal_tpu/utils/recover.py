@@ -578,7 +578,7 @@ class RecoverHandler:
 
             flight_recorder.dump("sigterm")
         except Exception:
-            pass
+            logger.debug("sigterm flight dump failed", exc_info=True)
         return self.dump(
             engine,
             step,

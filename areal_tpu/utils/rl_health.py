@@ -1,0 +1,764 @@
+"""RL training-health observatory: the algorithm plane's telemetry + sentinel.
+
+PRs 8-9 made the *systems* planes explainable (tracing, ``/metrics``,
+goodput/MFU); this module lights up the *algorithm* plane. Decoupled-PPO's
+importance ratios, clip fractions, per-token staleness mix, and reward /
+entropy / length distributions are computed inside the loss and discarded —
+exactly the signals AReaL's staleness-controlled async design depends on. An
+async run can silently diverge (entropy collapse, ratio blow-up, degenerate
+repetition, NaN loss) and, before this module, nothing noticed until the
+checkpoint was already garbage.
+
+Two halves:
+
+**Distribution telemetry** — once per train step, from host-side numpy the
+update path already holds (never an extra forward, never per-token python in
+a hot loop):
+
+- staleness: per-token ``versions`` vs the current weight version — lag
+  histogram, mean/max/p95, and the version-mix fraction (sequences whose
+  generated tokens span >1 weight version — the in-flight-weight-swap
+  trainability signal, ROADMAP item 3);
+- ratios: ``exp(prox_logp - behav_logp)`` per token via the exact numpy
+  mirror of the jitted loss stats (:func:`areal_tpu.utils.functional.
+  ppo_loss_stats_host`) — histogram, p99/max, PPO clip fraction, dual-clip
+  fraction, and the behav-cap trigger fraction (tokens the decoupled
+  objective drops);
+- rewards: raw vs shaped-and-clipped distributions + clipped fraction;
+- entropy/KL: Monte-Carlo entropy estimates (mean ``-logprob`` of sampled
+  tokens under the behavior and current policies — E_{a~pi}[-log pi(a)] is
+  H(pi), so a collapse toward deterministic outputs drives this to 0) and
+  the configured k1/k2/k3 staleness-KL estimate;
+- generation shape: length distribution, truncation (no-EOS) rate, and a
+  cheap degenerate-output detector (:func:`degenerate_output_stats` — max
+  n-gram loop fraction + EOS-absence rate), wired at the
+  ``WorkflowExecutor.wait`` batch boundary.
+
+Everything exports three ways: ``areal_rl_*`` instruments on the PR 8
+metrics registry (``/metrics`` + periodic StatsLogger registry export),
+``rl_health/*`` scalars returned from :meth:`RLHealthMonitor.end_step` for
+the step's StatsLogger row, and one ``rl_health`` event on the PR 9
+``train.step`` span (the Perfetto cross-plane join).
+
+**Anomaly sentinel** — a declarative rule table evaluated once per step
+with hysteresis (``consecutive`` breached evaluations before firing; a
+fired rule latches until its condition clears, so a persistent breach
+fires once, not every step): non-finite loss/grad, entropy below floor,
+ratio p99 past cap, staleness p95 past threshold, reward collapse /
+flatline, repetition spike. A firing rule
+
+1. bumps ``areal_rl_anomaly_total{rule}``,
+2. writes a flight-recorder ``anomaly`` entry holding the full
+   offending-step stats (the ``rl_health`` channel ring holds the recent
+   steps leading up to it) and dumps the recorder atomically,
+3. drives the configured guardrail: ``warn`` (log only),
+   ``pause_rollout`` (stop feeding new episodes via
+   ``WorkflowExecutor.pause`` while the operator looks), or ``halt``
+   (raise :class:`RLHealthHalt` BEFORE the step's checkpoint commits —
+   a poisoned step must never become the resume point).
+
+Chaos: the sentinel's detection path is rehearsed by deterministic signal
+faults (``AREAL_CHAOS_RL``, :func:`areal_tpu.utils.chaos.rl_fault`) that
+corrupt the observed snapshot — never the training math — at an exact
+step, so tests pin step-exact detection, dump contents, and guardrails.
+
+Cost contract: disabled (``rl_health.enabled=false``) the monitor is
+``None`` and every hot-path site pays only an ``is not None`` check
+(code-inspection pinned, like the chaos/tracing hooks); enabled, all work
+runs once per STEP on arrays the update already materialized.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("rl_health")
+
+#: flight-recorder channels
+HEALTH_CHANNEL = "rl_health"
+ANOMALY_CHANNEL = "anomaly"
+
+GUARDRAIL_ACTIONS = ("warn", "pause_rollout", "halt")
+
+#: importance-ratio histogram buckets (ratio 1.0 = perfectly on-policy)
+RATIO_BUCKETS = (
+    0.125, 0.25, 0.5, 0.8, 0.9, 0.95, 1.0, 1.05, 1.1, 1.25, 1.5, 2.0,
+    4.0, 8.0,
+)
+#: per-token staleness (weight-version lag) buckets
+STALENESS_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+#: task-reward buckets (shaped rewards are clipped into a few units)
+REWARD_BUCKETS = (-10.0, -5.0, -2.0, -1.0, -0.5, 0.0, 0.5, 1.0, 2.0, 5.0, 10.0)
+#: generated-length buckets (tokens)
+GEN_LEN_BUCKETS = (
+    16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
+    8192.0, 16384.0, 32768.0,
+)
+
+
+class RLHealthHalt(RuntimeError):
+    """The ``halt`` guardrail: an anomaly rule fired with action ``halt``.
+    Raised from :meth:`RLHealthMonitor.end_step` — which the trainer loop
+    calls BEFORE the stats commit and checkpoint — so the poisoned step's
+    state never becomes the resume point. The flight-recorder ``anomaly``
+    dump has already been written when this propagates."""
+
+
+# ---------------------------------------------------------------------------
+# degenerate-output detector (host-side, once per rollout batch)
+# ---------------------------------------------------------------------------
+
+
+def _tail_loop_fraction(gen: np.ndarray, max_ngram: int = 8) -> float:
+    """Fraction of a generated-token sequence covered by consecutive
+    trailing repeats of its final n-gram, maximized over n in [1,
+    ``max_ngram``]. A healthy completion scores ~0; a model stuck emitting
+    "the the the" or a looping sentence scores toward 1.
+
+    Fully vectorized per n (shifted-equality + trailing-True run length):
+    O(len * max_ngram) numpy with NO data-dependent python loop, so the
+    cost is the same for healthy and fully-degenerate sequences — the
+    detector must stay cheap precisely when outputs are at their worst.
+    """
+    ln = int(gen.shape[0])
+    best = 0.0
+    for n in range(1, min(max_ngram, ln // 2) + 1):
+        # eq[i] == True  <=>  gen[i] == gen[i+n]; a trailing all-True run
+        # of length k means the last k+n tokens are periodic with period n
+        eq = gen[n:] == gen[:-n]
+        false_idx = np.flatnonzero(~eq)
+        k = (eq.shape[0] - 1 - false_idx[-1]) if false_idx.size else eq.shape[0]
+        repeats = (k + n) // n  # aligned whole copies of the final n-gram
+        if repeats >= 2:
+            best = max(best, (repeats * n) / ln)
+    return best
+
+
+def degenerate_output_stats(
+    input_ids: np.ndarray,
+    loss_mask: np.ndarray,
+    attention_mask: np.ndarray,
+    max_ngram: int = 8,
+) -> dict[str, np.ndarray | float]:
+    """Per-batch degenerate-output signals over the GENERATED tokens
+    (``loss_mask == 1``): per-sequence max n-gram loop fraction, generated
+    lengths, and the no-EOS (row completely full => truncated at max
+    length, the convention the actor's ``no_eos_ratios`` stat uses) flags.
+    """
+    ids = np.asarray(input_ids)
+    lm = np.asarray(loss_mask).astype(bool)
+    attn = np.asarray(attention_mask)
+    bs, width = ids.shape
+    loop_frac = np.zeros(bs, np.float32)
+    gen_lens = np.zeros(bs, np.int64)
+    for i in range(bs):
+        gen = ids[i][lm[i] & (attn[i] > 0)]
+        gen_lens[i] = gen.shape[0]
+        if gen.shape[0] >= 2:
+            loop_frac[i] = _tail_loop_fraction(gen, max_ngram)
+    eos_absent = attn.sum(-1) == width
+    return dict(
+        loop_frac=loop_frac,
+        gen_lens=gen_lens,
+        eos_absent=eos_absent,
+        repetition_frac=float(loop_frac.mean()) if bs else 0.0,
+        repetition_max=float(loop_frac.max()) if bs else 0.0,
+        eos_absence_rate=float(eos_absent.mean()) if bs else 0.0,
+        gen_len_mean=float(gen_lens.mean()) if bs else 0.0,
+        gen_len_p95=float(np.percentile(gen_lens, 95)) if bs else 0.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sentinel rules
+# ---------------------------------------------------------------------------
+
+
+class _Rule:
+    """One declarative anomaly rule: breached(snap) over the step snapshot;
+    ``consecutive`` is the hysteresis requirement (None = config default)."""
+
+    __slots__ = ("name", "breached", "consecutive", "describe")
+
+    def __init__(self, name, breached, consecutive=None, describe=""):
+        self.name = name
+        self.breached = breached
+        self.consecutive = consecutive
+        self.describe = describe
+
+
+def _nonfinite(v) -> bool:
+    return v is not None and not math.isfinite(float(v))
+
+
+def build_rules(cfg) -> list[_Rule]:
+    """The sentinel's rule table, thresholds from :class:`RLHealthConfig`.
+    Every predicate reads the per-step snapshot; a signal absent from the
+    snapshot (e.g. no rollout batch observed this step) never breaches."""
+
+    def _gt(key, thr):
+        def f(s):
+            v = s.get(key)
+            return v is not None and math.isfinite(float(v)) and float(v) > thr
+
+        return f
+
+    def _entropy_floor(s):
+        v = s.get("entropy")
+        return v is not None and math.isfinite(float(v)) and float(v) < cfg.entropy_floor
+
+    def _non_finite(s):
+        return _nonfinite(s.get("loss")) or _nonfinite(s.get("grad_norm"))
+
+    def _reward_collapse(s):
+        if s.get("reward_window_full") and (
+            s.get("reward_window_std", math.inf) <= cfg.reward_std_floor
+        ):
+            return True
+        drop = cfg.reward_collapse_drop
+        if drop > 0 and s.get("reward_mean") is not None:
+            trailing = s.get("reward_trailing_mean")
+            if trailing is not None and float(s["reward_mean"]) < trailing - drop:
+                return True
+        return False
+
+    return [
+        _Rule(
+            "non_finite_loss", _non_finite, consecutive=1,
+            describe="loss or grad_norm is NaN/Inf",
+        ),
+        _Rule(
+            "entropy_floor", _entropy_floor,
+            describe=f"entropy estimate < {cfg.entropy_floor}",
+        ),
+        _Rule(
+            "ratio_blowup", _gt("ratio_p99", cfg.ratio_p99_cap),
+            describe=f"importance-ratio p99 > {cfg.ratio_p99_cap}",
+        ),
+        _Rule(
+            "staleness_spike", _gt("staleness_p95", cfg.staleness_p95_max),
+            describe=f"per-token staleness p95 > {cfg.staleness_p95_max}",
+        ),
+        _Rule(
+            "reward_collapse", _reward_collapse,
+            describe="reward flatlined (window std ~ 0) or dropped sharply",
+        ),
+        _Rule(
+            "repetition_spike",
+            _gt("repetition_frac", cfg.repetition_max_frac),
+            describe=(
+                "mean n-gram loop fraction of generated tokens > "
+                f"{cfg.repetition_max_frac}"
+            ),
+        ),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+#: snapshot keys exported as gauges (``areal_rl_<key>``) and StatsLogger
+#: scalars (``rl_health/<key>``); help strings double as the signal catalog
+SCALAR_SIGNALS = {
+    "ratio_mean": "masked mean importance ratio exp(prox - behav)",
+    "ratio_p99": "importance-ratio p99 over valid tokens",
+    "ratio_max": "importance-ratio max over valid tokens",
+    "clip_frac": "fraction of valid tokens where the PPO clip binds",
+    "dual_clip_frac": "fraction of valid tokens where the dual clip binds",
+    "behav_cap_frac": "fraction of valid tokens past behav_imp_weight_cap",
+    "kl": "masked-mean staleness KL estimate (configured k1/k2/k3)",
+    "entropy": "MC entropy estimate of the current policy (mean -prox_logp)",
+    "entropy_behav": "MC entropy estimate of the behavior policy",
+    "adv_mean": "masked mean advantage",
+    "adv_std": "masked advantage standard deviation",
+    "staleness_mean": "mean per-token weight-version lag",
+    "staleness_max": "max per-token weight-version lag",
+    "staleness_p95": "p95 per-token weight-version lag",
+    "version_mix_frac": "fraction of sequences spanning >1 weight version",
+    "reward_mean": "mean raw task reward",
+    "reward_std": "std of raw task rewards",
+    "reward_clipped_mean": "mean shaped+clipped reward",
+    "reward_clipped_frac": "fraction of rewards hitting the clip bound",
+    "repetition_frac": "mean max n-gram loop fraction of generated tokens",
+    "repetition_max": "max per-sequence n-gram loop fraction",
+    "eos_absence_rate": "fraction of sequences truncated without EOS",
+    "gen_len_mean": "mean generated length (tokens)",
+    "gen_len_p95": "p95 generated length (tokens)",
+    "loss": "train loss (as reported by the engine)",
+    "grad_norm": "global grad norm (as reported by the engine)",
+}
+
+
+class RLHealthMonitor:
+    """Per-step RL-health snapshot assembly + sentinel evaluation.
+
+    Observation methods (``observe_rollout_batch`` from the executor's
+    wait boundary, ``observe_train_batch`` / ``note_rewards`` /
+    ``note_train_result`` from the PPO actor) stage signals into the
+    current step's snapshot; :meth:`end_step` closes the window: applies
+    chaos faults, evaluates the rule table with hysteresis, exports
+    metrics/ring/status, drives guardrails, and returns the
+    ``rl_health/*`` scalar row for the StatsLogger commit.
+    """
+
+    def __init__(
+        self,
+        config,
+        *,
+        registry=None,
+        recorder=None,
+        pause_fn=None,
+        clock=time.time,
+    ):
+        self.config = config
+        self._clock = clock
+        self._pause_fn = pause_fn
+        self._lock = threading.Lock()
+        self._snap: dict = {}
+        self._reward_window: deque = deque(
+            maxlen=max(2, int(config.reward_window_steps))
+        )
+        self._streaks: dict[str, int] = {}
+        self._latched: set[str] = set()
+        self.last_anomaly: dict | None = None
+        self.anomalies_fired = 0
+        # latched by the pause_rollout guardrail. The trainer loops call
+        # pause()/resume() around every weight push — an unconditional
+        # resume there would silently undo the guardrail one step later,
+        # so the examples gate their resume on this flag. Cleared only by
+        # an explicit resume_rollout() (operator decision).
+        self.rollout_paused = False
+
+        for name, act in dict(config.rule_actions).items():
+            if act not in GUARDRAIL_ACTIONS:
+                raise ValueError(
+                    f"rl_health.rule_actions[{name!r}] = {act!r}; must be "
+                    f"one of {GUARDRAIL_ACTIONS}"
+                )
+        if config.action not in GUARDRAIL_ACTIONS:
+            raise ValueError(
+                f"rl_health.action = {config.action!r}; must be one of "
+                f"{GUARDRAIL_ACTIONS}"
+            )
+        self._rules = build_rules(config)
+
+        if recorder is None:
+            from areal_tpu.utils import flight_recorder
+
+            recorder = flight_recorder.DEFAULT_RECORDER
+        self._recorder = recorder
+        recorder.channel(HEALTH_CHANNEL, capacity=int(config.ring_steps))
+        recorder.channel(ANOMALY_CHANNEL)
+
+        if registry is None:
+            from areal_tpu.utils import metrics
+
+            registry = metrics.DEFAULT_REGISTRY
+        self._registry = registry
+        self._ratio_hist = registry.histogram(
+            "areal_rl_importance_ratio",
+            "per-token importance ratio exp(prox_logp - behav_logp)",
+            buckets=RATIO_BUCKETS,
+        )
+        self._behav_hist = registry.histogram(
+            "areal_rl_behav_ratio",
+            "behavior importance weights the decoupled objective actually "
+            "applies (cap-excluded tokens dropped)",
+            buckets=RATIO_BUCKETS,
+        )
+        self._staleness_hist = registry.histogram(
+            "areal_rl_staleness",
+            "per-token weight-version lag (current - token version)",
+            buckets=STALENESS_BUCKETS,
+        )
+        self._reward_hist = registry.histogram(
+            "areal_rl_reward",
+            "task reward distribution, raw vs shaped+clipped",
+            labels=("kind",),
+            buckets=REWARD_BUCKETS,
+        )
+        self._gen_len_hist = registry.histogram(
+            "areal_rl_gen_len",
+            "generated tokens per sequence",
+            buckets=GEN_LEN_BUCKETS,
+        )
+        self._gauges = {
+            key: registry.gauge(f"areal_rl_{key}", help_)
+            for key, help_ in SCALAR_SIGNALS.items()
+        }
+        self._anomaly_c = registry.counter(
+            "areal_rl_anomaly_total",
+            "sentinel rules fired (latched: once per sustained breach)",
+            labels=("rule",),
+        )
+
+    @classmethod
+    def from_config(cls, config, **kwargs) -> "RLHealthMonitor | None":
+        """None when disabled — hot-path call sites then pay only an
+        ``is not None`` check (the chaos-hook discipline)."""
+        if config is None or not getattr(config, "enabled", True):
+            return None
+        return cls(config, **kwargs)
+
+    # ------------------------------------------------------------ observing
+
+    def observe_rollout_batch(self, batch: dict) -> None:
+        """Degenerate-output + generation-shape signals from one collected
+        rollout batch (called at the ``WorkflowExecutor.wait`` boundary)."""
+        try:
+            ids = batch.get("input_ids")
+            lm = batch.get("loss_mask")
+            attn = batch.get("attention_mask")
+            if ids is None or attn is None:
+                return
+            if lm is None:
+                lm = np.asarray(attn)
+            d = degenerate_output_stats(np.asarray(ids), lm, np.asarray(attn))
+            self._gen_len_hist.observe_many(d["gen_lens"])
+            with self._lock:
+                for k in (
+                    "repetition_frac", "repetition_max", "eos_absence_rate",
+                    "gen_len_mean", "gen_len_p95",
+                ):
+                    self._snap[k] = d[k]
+        except Exception:
+            # telemetry must never take down the rollout path
+            logger.exception("observe_rollout_batch failed")
+
+    def observe_train_batch(
+        self, data: dict, current_version: int, actor_config
+    ) -> None:
+        """Ratio/staleness/entropy/KL/advantage signals from the update
+        batch, AFTER ``compute_advantages`` aligned everything to the
+        next-token convention (``logprobs`` = behavior policy,
+        ``prox_logp`` = current policy, both masked by ``loss_mask``)."""
+        try:
+            self._observe_train_batch(data, current_version, actor_config)
+        except Exception:
+            logger.exception("observe_train_batch failed")
+
+    def _observe_train_batch(self, data, current_version, cfg) -> None:
+        from areal_tpu.utils.data import KLEstimator
+        from areal_tpu.utils.functional import ppo_loss_stats_host
+
+        mask = np.asarray(data["loss_mask"]).astype(bool)
+        n = max(int(mask.sum()), 1)
+        old = np.asarray(data["logprobs"], np.float32)
+        prox = np.asarray(data.get("prox_logp", old), np.float32)
+        adv = np.asarray(data.get("advantages", np.zeros_like(old)), np.float32)
+        snap: dict = {"tokens": float(n)}
+
+        # realized importance ratio of the batch about to be trained:
+        # exp(current - behavior). The mirror call treats the BEHAVIOR
+        # logprobs as the proximal baseline so clip/dual-clip masks measure
+        # how much of this batch already sits outside the trust region
+        # before the first minibatch moves the weights (the decoupled
+        # loss's own ratio is 1 by construction at that point).
+        stats = ppo_loss_stats_host(
+            logprobs=prox,
+            proximal_logprobs=old,
+            old_logprobs=old,
+            advantages=adv,
+            loss_mask=mask,
+            eps_clip=cfg.eps_clip,
+            eps_clip_higher=getattr(cfg, "eps_clip_higher", None),
+            c_clip=getattr(cfg, "c_clip", None),
+            behav_imp_weight_cap=None,
+        )
+        ratio = stats["importance_weight"][mask]
+        snap["ratio_mean"] = float(ratio.mean())
+        snap["ratio_p99"] = float(np.percentile(ratio, 99))
+        snap["ratio_max"] = float(ratio.max())
+        snap["clip_frac"] = float(stats["clip_mask"].sum() / n)
+        snap["dual_clip_frac"] = float(stats["dual_clip_mask"].sum() / n)
+        cap = getattr(cfg, "behav_imp_weight_cap", None)
+        snap["behav_cap_frac"] = (
+            float((ratio > cap).sum() / n) if cap is not None else 0.0
+        )
+        self._ratio_hist.observe_many(ratio)
+        # the behav-ratio distribution is the same exp(prox - behav) with
+        # the cap applied — the weights the decoupled objective actually
+        # multiplies into the loss (cap-excluded tokens contribute 0)
+        self._behav_hist.observe_many(
+            ratio[ratio <= cap] if cap is not None else ratio
+        )
+
+        kl_est = KLEstimator(getattr(cfg, "kl_estimator", "k1"))
+        snap["kl"] = float((kl_est(prox, old) * mask).sum() / n)
+        snap["entropy"] = float((-prox * mask).sum() / n)
+        snap["entropy_behav"] = float((-old * mask).sum() / n)
+        mv = adv[mask]
+        if mv.size:
+            snap["adv_mean"] = float(mv.mean())
+            snap["adv_std"] = float(mv.std())
+
+        versions = data.get("versions")
+        if versions is not None:
+            v = np.asarray(versions)
+            gen = v >= 0  # -1 marks prompt/non-generated tokens
+            if gen.any():
+                lags = np.maximum(int(current_version) - v[gen], 0).astype(
+                    np.float64
+                )
+                snap["staleness_mean"] = float(lags.mean())
+                snap["staleness_max"] = float(lags.max())
+                snap["staleness_p95"] = float(np.percentile(lags, 95))
+                per_seq = [
+                    len(np.unique(row[g])) > 1
+                    for row, g in zip(v, gen)
+                    if g.any()
+                ]
+                snap["version_mix_frac"] = (
+                    float(np.mean(per_seq)) if per_seq else 0.0
+                )
+                self._staleness_hist.observe_many(lags)
+        with self._lock:
+            self._snap.update(snap)
+
+    def note_rewards(
+        self, raw: np.ndarray, clipped: np.ndarray, clipped_frac: float
+    ) -> None:
+        """Raw vs shaped-and-clipped reward distributions (from the
+        actor's ``compute_advantages`` reward pipeline)."""
+        try:
+            raw = np.asarray(raw, np.float64).reshape(-1)
+            clipped = np.asarray(clipped, np.float64).reshape(-1)
+            self._reward_hist.labels(kind="raw").observe_many(raw)
+            self._reward_hist.labels(kind="clipped").observe_many(clipped)
+            with self._lock:
+                self._snap["reward_mean"] = float(raw.mean()) if raw.size else 0.0
+                self._snap["reward_std"] = float(raw.std()) if raw.size else 0.0
+                self._snap["reward_clipped_mean"] = (
+                    float(clipped.mean()) if clipped.size else 0.0
+                )
+                self._snap["reward_clipped_frac"] = float(clipped_frac)
+        except Exception:
+            logger.exception("note_rewards failed")
+
+    def note_train_result(
+        self, loss=None, grad_norm=None, update_successful=None
+    ) -> None:
+        """Engine-reported loss/grad scalars, once per minibatch; a
+        non-finite value sticks for the step (one NaN minibatch is the
+        anomaly even if later minibatches look sane)."""
+        with self._lock:
+            for key, v in (("loss", loss), ("grad_norm", grad_norm)):
+                if v is None:
+                    continue
+                prev = self._snap.get(key)
+                if prev is None or math.isfinite(float(prev)):
+                    self._snap[key] = float(v)
+            if update_successful is not None:
+                self._snap["update_successful"] = float(update_successful)
+
+    # ------------------------------------------------------------- stepping
+
+    def end_step(self, global_step: int, span=None) -> dict[str, float]:
+        """Close the step's observation window: chaos faults, reward-window
+        bookkeeping, rule evaluation with hysteresis, metric/ring/status
+        export, guardrails. Returns the ``rl_health/*`` StatsLogger row.
+        Raises :class:`RLHealthHalt` when a fired rule's action is
+        ``halt`` (after the anomaly dump has been written)."""
+        with self._lock:
+            snap, self._snap = self._snap, {}
+
+        self._update_reward_window(snap)
+        self._apply_chaos(snap)
+        fired = self._evaluate_rules(snap)
+
+        for key, g in self._gauges.items():
+            v = snap.get(key)
+            if v is not None and math.isfinite(float(v)):
+                g.set(float(v))
+
+        compact = {
+            k: v for k, v in snap.items() if isinstance(v, (int, float))
+        }
+        self._recorder.record(
+            HEALTH_CHANNEL, "step", step=int(global_step), **compact
+        )
+        if span is not None:
+            span.event(
+                "rl_health",
+                step=int(global_step),
+                anomalies=",".join(r.name for r in fired),
+                **{
+                    k: round(float(compact[k]), 6)
+                    for k in (
+                        "entropy", "ratio_p99", "staleness_p95",
+                        "reward_mean", "clip_frac", "repetition_frac",
+                        "loss",
+                    )
+                    if k in compact
+                },
+            )
+
+        row = {f"rl_health/{k}": float(v) for k, v in compact.items()}
+        row["rl_health/anomaly"] = float(bool(fired))
+
+        halt_rules: list[str] = []
+        pause_rules: list[str] = []
+        for rule in fired:
+            self.anomalies_fired += 1
+            action = dict(self.config.rule_actions).get(
+                rule.name, self.config.action
+            )
+            self.last_anomaly = {
+                "rule": rule.name,
+                "step": int(global_step),
+                "t": self._clock(),
+                "action": action,
+            }
+            self._anomaly_c.labels(rule=rule.name).inc()
+            self._recorder.record(
+                ANOMALY_CHANNEL,
+                "rule_fired",
+                rule=rule.name,
+                step=int(global_step),
+                action=action,
+                streak=self._streaks.get(rule.name, 0),
+                describe=rule.describe,
+                stats=compact,
+            )
+            # immediate atomic dump: the offending-step evidence must not
+            # depend on the process surviving to its next death-path dump
+            self._recorder.dump(f"rl_anomaly_{rule.name}")
+            logger.warning(
+                "RL-health anomaly %r at step %d (%s); guardrail action: "
+                "%s; offending stats: %s",
+                rule.name,
+                global_step,
+                rule.describe,
+                action,
+                {k: round(v, 4) for k, v in sorted(compact.items())},
+            )
+            if action == "halt":
+                halt_rules.append(rule.name)
+            elif action == "pause_rollout":
+                pause_rules.append(rule.name)
+
+        self._publish_status(global_step, compact)
+
+        if pause_rules:
+            logger.warning(
+                "pausing rollout submission (rules: %s); resume manually "
+                "or restart once the cause is addressed",
+                ",".join(pause_rules),
+            )
+            self.rollout_paused = True
+            if self._pause_fn is not None:
+                self._pause_fn()
+        if halt_rules:
+            raise RLHealthHalt(
+                f"RL-health guardrail halt at step {global_step} "
+                f"(rules: {','.join(halt_rules)}); the anomaly flight dump "
+                "is on disk and this step's checkpoint was NOT committed"
+            )
+        return row
+
+    def resume_rollout(self) -> None:
+        """Clear the pause_rollout latch (an explicit operator/driver
+        decision — the guardrail never un-pauses on its own). The caller
+        resumes the executor itself."""
+        self.rollout_paused = False
+
+    # ------------------------------------------------------------ internals
+
+    def _update_reward_window(self, snap: dict) -> None:
+        rm = snap.get("reward_mean")
+        if rm is not None and math.isfinite(float(rm)):
+            if len(self._reward_window):
+                snap["reward_trailing_mean"] = float(
+                    np.mean(self._reward_window)
+                )
+            self._reward_window.append(float(rm))
+            snap["reward_window_full"] = (
+                len(self._reward_window) == self._reward_window.maxlen
+            )
+            snap["reward_window_std"] = float(np.std(self._reward_window))
+
+    def _apply_chaos(self, snap: dict) -> None:
+        """Deterministic signal faults (AREAL_CHAOS_RL): corrupt the
+        OBSERVED snapshot so the sentinel's detection/guardrail path is
+        exercised end to end without touching the training math."""
+        from areal_tpu.utils.chaos import rl_fault
+
+        if rl_fault("nan_loss"):
+            snap["loss"] = float("nan")
+        if rl_fault("entropy_collapse"):
+            snap["entropy"] = 0.0
+        if rl_fault("staleness_spike"):
+            spike = float(self.config.staleness_p95_max) * 10.0 + 100.0
+            snap["staleness_p95"] = spike
+            snap["staleness_max"] = max(snap.get("staleness_max", 0.0), spike)
+        if rl_fault("ratio_blowup"):
+            snap["ratio_p99"] = float(self.config.ratio_p99_cap) * 10.0
+        if rl_fault("reward_flatline"):
+            snap["reward_window_full"] = True
+            snap["reward_window_std"] = 0.0
+        if rl_fault("repetition_spike"):
+            snap["repetition_frac"] = 1.0
+
+    def _evaluate_rules(self, snap: dict) -> list[_Rule]:
+        """Hysteresis: a rule fires after ``consecutive`` breached
+        evaluations, then latches — no re-fire while the breach persists;
+        clearing resets both streak and latch."""
+        fired = []
+        default_consec = max(1, int(self.config.consecutive))
+        for rule in self._rules:
+            breached = bool(rule.breached(snap))
+            if not breached:
+                self._streaks[rule.name] = 0
+                self._latched.discard(rule.name)
+                continue
+            self._streaks[rule.name] = self._streaks.get(rule.name, 0) + 1
+            need = rule.consecutive or default_consec
+            if (
+                self._streaks[rule.name] >= need
+                and rule.name not in self._latched
+            ):
+                self._latched.add(rule.name)
+                fired.append(rule)
+        return fired
+
+    def _publish_status(self, global_step: int, compact: dict) -> None:
+        """Compact status JSON for ``areal-tpu-top`` via name_resolve
+        (best-effort: discovery being down must never fail a train step)."""
+        cfg = self.config
+        if not cfg.publish_status or not cfg.experiment_name:
+            return
+        payload = {
+            "step": int(global_step),
+            "t": self._clock(),
+            "last_anomaly": self.last_anomaly,
+            "anomalies_fired": self.anomalies_fired,
+            **{
+                k: round(float(compact[k]), 6)
+                for k in (
+                    "entropy", "ratio_p99", "staleness_p95", "staleness_mean",
+                    "reward_mean", "clip_frac", "repetition_frac",
+                    "eos_absence_rate", "version_mix_frac",
+                )
+                if k in compact
+            },
+        }
+        try:
+            from areal_tpu.utils import name_resolve, names
+
+            name_resolve.add(
+                names.rl_health(cfg.experiment_name, cfg.trial_name),
+                json.dumps(payload),
+                replace=True,
+                delete_on_exit=False,
+            )
+        except Exception:
+            logger.debug("rl_health status publish failed", exc_info=True)

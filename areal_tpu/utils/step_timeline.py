@@ -192,6 +192,14 @@ class StepTimeline:
         self._warned_tolerance = False
         self._device_kind: str | None = None
 
+    @property
+    def span(self):
+        """The step's open ``train.step`` tracing span (None with tracing
+        off or between steps) — co-plane observers (the RL-health monitor)
+        stamp their events onto it so one Perfetto export shows algorithm
+        health next to the phase breakdown."""
+        return self._span
+
     @classmethod
     def from_config(cls, config, **kwargs) -> "StepTimeline":
         """Always returns a timeline; a disabled config yields one whose

@@ -109,7 +109,7 @@ class Watchdog:
 
             flight_recorder.dump("watchdog")
         except Exception:
-            pass
+            logger.debug("watchdog flight dump failed", exc_info=True)
         report = dump_all_stacks()
         logger.error(
             "watchdog: no heartbeat for %.0fs (last phase %r, timeout "

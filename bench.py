@@ -1929,6 +1929,47 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("grpo_step_sec", "grpo", e)
 
+    # ---- rung 4.5: RL-health observatory overhead — the PR 13 cost
+    # contract: the SAME colocated GRPO loop monitor-on vs monitor-off
+    # (train-step wall + tokens/s); greedy output identity is HARD-asserted
+    # in the child (the observatory must observe, never perturb). value is
+    # the on/off tokens/s ratio — ~1.0 means the once-per-step host-side
+    # telemetry is free at step granularity. ----
+    if remaining(deadline) > 240:
+        try:
+            log("rl-health overhead rung")
+            rh = _run_child(
+                "rlh",
+                dict(
+                    layers=2, n_prompts=8, group_size=4, prompt_len=64,
+                    new_tokens=32, steps=2, smoke=True,
+                )
+                if REHEARSAL
+                else dict(
+                    layers=14, n_prompts=8, group_size=4, prompt_len=128,
+                    new_tokens=128, steps=2, smoke=False,
+                ),
+                timeout=min(1200.0, remaining(deadline) - 60),
+            )
+            # hard gate on real hardware; CPU-rehearsal step time jitters
+            # past 5% both directions, so rehearsal reports without gating
+            if not REHEARSAL:
+                assert rh["tps_ratio_on_vs_off"] >= 0.95, (
+                    "rl_health on-cost exceeds the 5% tokens/s bar: "
+                    f"ratio {rh['tps_ratio_on_vs_off']}"
+                )
+            emit({
+                "metric": "rl_health_overhead",
+                "value": rh["tps_ratio_on_vs_off"],
+                "unit": "x_tokens_per_sec_on_vs_off",
+                "vs_baseline": rh["tps_ratio_on_vs_off"],
+                "chip": chip,
+                **{k: v for k, v in rh.items()
+                   if k != "tps_ratio_on_vs_off"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("rl_health_overhead", "rl-health", e)
+
     if primary is not None:
         # repeat the primary as the FINAL line (drivers that take the last
         # parseable line get the headline metric)
@@ -1992,6 +2033,10 @@ def _child_main():
         from bench_grpo import grpo_step_bench
 
         print(json.dumps(grpo_step_bench(**att)))
+    elif kind == "--rlh-child":
+        from bench_grpo import rl_health_overhead_bench
+
+        print(json.dumps(rl_health_overhead_bench(**att)))
     else:
         raise SystemExit(f"unknown child kind {kind}")
 

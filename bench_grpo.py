@@ -193,3 +193,189 @@ def grpo_step_bench(
     finally:
         inf.destroy()
         actor.destroy()
+
+
+def rl_health_overhead_bench(
+    layers: int = 2,
+    n_prompts: int = 8,
+    group_size: int = 4,
+    prompt_len: int = 64,
+    new_tokens: int = 32,
+    steps: int = 2,
+    smoke: bool = True,
+):
+    """RL-health observatory cost contract (bench.py --rlh-child): the SAME
+    colocated GRPO loop run monitor-off then monitor-on — identical seeds,
+    greedy decoding — comparing train-step wall and end-to-end tokens/s.
+    Greedy output identity across modes is HARD-asserted in here: the
+    observatory reads arrays the update already materialized and must
+    never perturb the math (an overhead ratio measured on diverging
+    outputs would be a correctness bug wearing a perf costume).
+
+    Mode order is off-first: any process-level jit cache reuse then favors
+    the ON mode, and each mode pays its own warmup step before timing, so
+    compiles stay out of both timed windows either way.
+    """
+    import hashlib
+    import random
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+        RLHealthConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec, WeightUpdateMeta
+    from areal_tpu.engine.local_inf import LocalInfEngine
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+    from areal_tpu.utils.dataloader import StatefulDataLoader
+    from areal_tpu.utils.rl_health import RLHealthMonitor
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    if smoke:
+        from areal_tpu.models.config import tiny_config
+
+        model_cfg = tiny_config(
+            vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=layers, num_attention_heads=4,
+            num_key_value_heads=2,
+        )
+    else:
+        from bench import qwen2_1p5b_cfg
+
+        model_cfg = qwen2_1p5b_cfg(layers)
+
+    rng = np.random.default_rng(0)
+    hi = model_cfg.vocab_size - 1
+    rows = [
+        {"input_ids": rng.integers(1, hi, size=prompt_len).tolist()}
+        for _ in range(n_prompts * (steps + 2))
+    ]
+
+    def run_mode(health_on: bool) -> dict:
+        random.seed(0)  # wait() shuffles via the global RNG
+        acfg = PPOActorConfig(
+            path="",
+            init_from_scratch=True,
+            optimizer=OptimizerConfig(lr=1e-5, type="adafactor"),
+            group_size=group_size,
+            ppo_n_minibatches=1,
+            recompute_logprob=True,
+            use_decoupled_loss=True,
+        )
+        acfg.backend.param_dtype = "float32"
+        acfg.backend.pad_mb_to_multiple = 32
+        ft_spec = FinetuneSpec(
+            total_train_epochs=1,
+            dataset_size=len(rows),
+            train_batch_size=n_prompts,
+        )
+        actor = TPUPPOActor(acfg)
+        actor.initialize(None, ft_spec, model_config=model_cfg, seed=0)
+        inf = LocalInfEngine(
+            InferenceEngineConfig(
+                max_concurrent_rollouts=n_prompts * 2,
+                consumer_batch_size=n_prompts,
+            ),
+            JaxGenConfig(
+                max_batch_size=max(n_prompts * group_size, 8),
+                max_seq_len=prompt_len + new_tokens + 64,
+                prefill_chunk=64,
+                decode_steps_per_call=4,
+                dtype="float32",
+            ),
+            model_config=model_cfg,
+        )
+        inf.initialize(None, train_data_parallel_size=1)
+        actor.connect_engine(inf, WeightUpdateMeta.from_device())
+        if health_on:
+            health = RLHealthMonitor.from_config(
+                RLHealthConfig(publish_status=False),
+                pause_fn=inf.pause,
+            )
+            inf.executor.rl_health = health
+            actor.actor.rl_health = health
+        else:
+            health = None
+        gconfig = GenerationHyperparameters(
+            n_samples=group_size,
+            max_new_tokens=new_tokens,
+            min_new_tokens=new_tokens,
+            greedy=True,
+        )
+        workflow = RLVRWorkflow(
+            _reward, gconfig, tokenizer=None, in_process_reward=True
+        )
+        dataloader = StatefulDataLoader(rows, n_prompts, shuffle=False)
+        digest = hashlib.sha256()
+        train_walls = []
+        step_walls = []
+        try:
+            inf.pause()
+            actor.update_weights()
+            inf.resume()
+
+            def one_step(timed: bool):
+                t0 = time.perf_counter()
+                batch = inf.rollout_batch(
+                    next(iter(dataloader)), workflow=workflow
+                )
+                # order-independent output digest: wait() shuffles, so
+                # hash the SORTED padded rows
+                ids = np.asarray(batch["input_ids"])
+                order = np.lexsort(ids.T[::-1])
+                digest.update(ids[order].tobytes())
+                t_train = time.perf_counter()
+                batch["prox_logp"] = actor.compute_logp(batch)
+                actor.compute_advantages(batch)
+                actor.ppo_update(batch)
+                train_wall = time.perf_counter() - t_train
+                inf.pause()
+                actor.update_weights()
+                inf.resume()
+                if health is not None:
+                    health.end_step(len(step_walls))
+                if timed:
+                    train_walls.append(train_wall)
+                    step_walls.append(time.perf_counter() - t0)
+
+            one_step(timed=False)  # warmup: compiles land here, both modes
+            for _ in range(steps):
+                one_step(timed=True)
+        finally:
+            inf.destroy()
+            actor.destroy()
+        tokens_per_step = n_prompts * group_size * (prompt_len + new_tokens)
+        step_sec = float(np.mean(step_walls))
+        return {
+            "train_step_sec": round(float(np.mean(train_walls)), 4),
+            "step_sec": round(step_sec, 4),
+            "tps": round(tokens_per_step / step_sec, 2),
+            "digest": digest.hexdigest(),
+        }
+
+    off = run_mode(health_on=False)
+    on = run_mode(health_on=True)
+    assert on["digest"] == off["digest"], (
+        "RL-health monitoring changed greedy outputs: "
+        f"{on['digest']} != {off['digest']}"
+    )
+    return {
+        "tps_ratio_on_vs_off": round(on["tps"] / off["tps"], 4),
+        "train_step_ratio_on_vs_off": round(
+            on["train_step_sec"] / off["train_step_sec"], 4
+        ),
+        "tps_on": on["tps"],
+        "tps_off": off["tps"],
+        "train_step_sec_on": on["train_step_sec"],
+        "train_step_sec_off": off["train_step_sec"],
+        "greedy_identity": True,
+        "layers": model_cfg.num_hidden_layers,
+        "n_prompts": n_prompts,
+        "group_size": group_size,
+        "new_tokens": new_tokens,
+    }

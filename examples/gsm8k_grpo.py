@@ -43,6 +43,7 @@ from areal_tpu.utils import logging, stats_tracker  # noqa: E402
 from areal_tpu.utils.chaos import crash_point  # noqa: E402
 from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
 from areal_tpu.utils.profiling import StepProfiler  # noqa: E402
+from areal_tpu.utils.rl_health import RLHealthMonitor  # noqa: E402
 from areal_tpu.utils.recover import (  # noqa: E402
     PREEMPTION_EXIT_CODE,
     PreemptionGuard,
@@ -201,6 +202,19 @@ def main(argv=None):
         model_config=actor.model_config,
         n_chips=actor.mesh.size if actor.mesh is not None else 1,
     )
+    # RL training-health observatory: per-step staleness/ratio/reward/
+    # entropy distribution telemetry + the anomaly sentinel. The monitor
+    # reads the update path's own arrays (actor hooks) and collected
+    # rollout batches (executor hook); a firing rule records an `anomaly`
+    # flight entry + dump and drives the configured guardrail —
+    # pause_rollout stops feeding episodes, halt raises BEFORE this step's
+    # checkpoint commits so a poisoned step never becomes the resume point.
+    health = RLHealthMonitor.from_config(
+        cfg.rl_health, pause_fn=rollout.pause
+    )
+    if health is not None:
+        rollout.executor.rl_health = health
+        actor.actor.rl_health = health
     all_rewards = []
     try:
         for global_step in range(start_step, total_steps):
@@ -297,7 +311,20 @@ def main(argv=None):
             ):
                 rollout.pause()
                 actor.update_weights(weight_meta)
-                rollout.resume()
+                # an unconditional resume would silently undo the
+                # sentinel's pause_rollout guardrail one step later
+                if health is None or not health.rollout_paused:
+                    rollout.resume()
+
+            # sentinel evaluation BEFORE the stats commit and checkpoint:
+            # the halt guardrail must preempt both (a poisoned step's dump
+            # must never become the resume point); the returned scalars
+            # ride this step's stats row
+            health_row = (
+                health.end_step(global_step, span=timeline.span)
+                if health is not None
+                else {}
+            )
 
             mean_reward = float(np.mean(np.asarray(batch["rewards"])))
             all_rewards.append(mean_reward)
@@ -315,6 +342,7 @@ def main(argv=None):
             )
             stats[0].update(stats_tracker.export(key="time_perf"))
             stats[0].update(tl_row)
+            stats[0].update(health_row)
             stats[0]["grpo/mean_task_reward"] = mean_reward
             # commit BEFORE the recover dump: a kill after the dump's
             # marker flips but before the commit would resume at the next

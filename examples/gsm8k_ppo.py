@@ -30,6 +30,7 @@ from areal_tpu.models.config import from_hf_config  # noqa: E402
 from areal_tpu.reward import math_verify_reward  # noqa: E402
 from areal_tpu.utils import logging, stats_tracker  # noqa: E402
 from areal_tpu.utils.dataloader import StatefulDataLoader  # noqa: E402
+from areal_tpu.utils.rl_health import RLHealthMonitor  # noqa: E402
 from areal_tpu.utils.saver import Saver  # noqa: E402
 from areal_tpu.utils.stats_logger import StatsLogger  # noqa: E402
 from areal_tpu.workflow.rlvr import RLVRWorkflow  # noqa: E402
@@ -80,6 +81,16 @@ def main(argv=None):
     saver = Saver(cfg.saver, ft_spec)
     stats_logger = StatsLogger(cfg.stats_logger, ft_spec)
 
+    # RL training-health observatory (same wiring as gsm8k_grpo; the PPO
+    # path additionally benefits from the critic-value-driven advantages
+    # flowing through the same telemetry)
+    health = RLHealthMonitor.from_config(
+        cfg.rl_health, pause_fn=rollout.pause
+    )
+    if health is not None:
+        rollout.executor.rl_health = health
+        actor.actor.rl_health = health
+
     all_rewards = []
     for global_step in range(total_steps):
         step_info = StepInfo(
@@ -109,12 +120,23 @@ def main(argv=None):
         with stats_tracker.record_timing("update_weights"):
             rollout.pause()
             actor.update_weights(weight_meta)
-            rollout.resume()
+            # an unconditional resume would silently undo the sentinel's
+            # pause_rollout guardrail one step later
+            if health is None or not health.rollout_paused:
+                rollout.resume()
+
+        # sentinel evaluation BEFORE the save: the halt guardrail must
+        # preempt the checkpoint (a poisoned step must never become the
+        # resume point)
+        health_row = (
+            health.end_step(global_step) if health is not None else {}
+        )
 
         saver.save(actor, step_info, tokenizer=tokenizer)
         mean_reward = float(np.mean(np.asarray(batch["rewards"])))
         all_rewards.append(mean_reward)
         stats[0].update(stats_tracker.export(key="time_perf"))
+        stats[0].update(health_row)
         stats[0]["ppo/mean_task_reward"] = mean_reward
         stats[0]["ppo/critic_loss"] = float(
             np.mean([s.get("loss", 0.0) for s in critic_stats])

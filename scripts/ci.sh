@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# The whole local/CI gate as ONE command, chaining the three existing
+# gates in fail-fast order:
+#
+#   1. scripts/lint.sh        — arealint (empty-baseline enforced) + the
+#                               bench sentinel's fixture self-test
+#   2. tier-1 pytest          — the fast suite (slow-marked tests excluded),
+#                               on CPU so it runs anywhere
+#   3. scripts/bench_check.sh — perf-regression sentinel over the
+#                               BENCH_REHEARSAL.jsonl trajectory
+#
+#   scripts/ci.sh             # run everything
+#   scripts/ci.sh --fast      # lint + tests only (skip the bench gate)
+#
+# Extra args after the optional --fast pass through to pytest
+# (e.g. `scripts/ci.sh -k rl_health`).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+if [[ "${1:-}" == "--fast" ]]; then
+  FAST=1
+  shift
+fi
+
+echo "=== ci: arealint gate ==="
+bash scripts/lint.sh
+
+echo "=== ci: tier-1 pytest (CPU) ==="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider "$@"
+
+if [[ "$FAST" == "0" ]]; then
+  echo "=== ci: bench perf-regression gate ==="
+  bash scripts/bench_check.sh
+fi
+
+echo "=== ci: all gates green ==="

@@ -1,0 +1,57 @@
+# arealint fixture: swallowed-exception TRUE NEGATIVES.
+import logging
+import queue
+
+logger = logging.getLogger(__name__)
+
+
+def narrow_pass_is_fine(q):
+    # naming the exception IS the statement that this failure is expected
+    try:
+        return q.get_nowait()
+    except queue.Empty:
+        pass
+    return None
+
+
+def narrow_tuple_is_fine(fn):
+    try:
+        fn()
+    except (ValueError, KeyError):
+        pass
+
+
+def broad_with_logging(fn):
+    try:
+        fn()
+    except Exception:
+        logger.debug("best-effort cleanup failed", exc_info=True)
+
+
+def broad_with_reraise(fn):
+    try:
+        fn()
+    except Exception:
+        raise RuntimeError("wrapped") from None
+
+
+def broad_with_fallback(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def broad_with_bookkeeping(fn, stats):
+    try:
+        fn()
+    except Exception:
+        stats["failures"] += 1
+
+
+def suppressed_with_justification(fn):
+    try:
+        fn()
+    # atexit cleanup path; logging may already be torn down
+    except Exception:  # arealint: disable=swallowed-exception
+        pass

@@ -1,0 +1,55 @@
+# arealint fixture: swallowed-exception TRUE POSITIVES.
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def bare_pass(fn):
+    try:
+        fn()
+    except:  # lint-expect: swallowed-exception  # noqa: E722
+        pass
+
+
+def broad_pass(fn):
+    try:
+        fn()
+    except Exception:  # lint-expect: swallowed-exception
+        pass
+
+
+def base_exception_pass(fn):
+    try:
+        fn()
+    except BaseException:  # lint-expect: swallowed-exception
+        pass
+
+
+def tuple_with_broad(fn):
+    try:
+        fn()
+    except (ValueError, Exception):  # lint-expect: swallowed-exception
+        pass
+
+
+def named_but_unused(fn):
+    try:
+        fn()
+    except Exception:  # lint-expect: swallowed-exception
+        ...
+
+
+def commented_away(fn):
+    try:
+        fn()
+    except Exception:  # lint-expect: swallowed-exception
+        """a docstring-comment is still doing nothing"""
+
+
+def qualified_broad(fn):
+    import builtins
+
+    try:
+        fn()
+    except builtins.Exception:  # lint-expect: swallowed-exception
+        pass

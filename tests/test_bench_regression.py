@@ -238,3 +238,28 @@ def test_bench_emit_wedged_shape(tmp_path, monkeypatch):
     assert "run_id" in rec
     r = reg.analyze([rec])
     assert r["metrics"]["decode_tokens_per_sec"]["status"] == "no_data"
+
+
+def test_band_floor_override_covers_bimodal_rung(tmp_path):
+    """A metric in BAND_FLOOR_OVERRIDES uses its own relative floor: a
+    swing inside the widened band (the rung's other mode) is ok, while a
+    collapse past it still gates."""
+    from areal_tpu.bench import regression as R
+
+    assert "elastic_fleet" in R.BAND_FLOOR_OVERRIDES
+    lines = [
+        {"metric": "elastic_fleet", "value": v, "unit": "x", "run_id": f"r{i}",
+         "ts": float(i)}
+        for i, v in enumerate([6.1, 6.0, 5.2, 6.2])
+    ]
+    lines.append({"metric": "elastic_fleet", "value": 5.25, "unit": "x",
+                  "run_id": "r9", "ts": 9.0})
+    p = tmp_path / "t.jsonl"
+    p.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+    rep = R.analyze_file(str(p), R.BenchSentinelConfig())
+    assert rep["metrics"]["elastic_fleet"]["status"] == "ok"
+    # a genuine collapse (autoscale not engaging) still gates
+    lines[-1]["value"] = 1.1
+    p.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+    rep = R.analyze_file(str(p), R.BenchSentinelConfig())
+    assert rep["metrics"]["elastic_fleet"]["status"] == "regression"

@@ -173,3 +173,118 @@ def test_reward_overlong_penalty():
     )
     np.testing.assert_allclose(out["rewards"][0], 1.0)
     np.testing.assert_allclose(out["rewards"][1], 1.0 - 4 / 4 * 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ppo_loss_stats_host: the observatory's loss math, pinned two ways —
+# hand-computed values AND exactness against the jitted loss's own stats
+# (the host mirror must never drift from what the loss actually saw)
+# ---------------------------------------------------------------------------
+
+
+def test_ppo_loss_stats_host_clip_fraction_hand_computed():
+    # ratios by construction: exp(lp - prox) = [2.0, 1.0, 0.5, 4.0]
+    prox = np.zeros(4, np.float32)
+    lp = np.log(np.array([2.0, 1.0, 0.5, 4.0], np.float32))
+    adv = np.array([1.0, 1.0, -1.0, -1.0], np.float32)
+    mask = np.ones(4, np.float32)
+    s = F.ppo_loss_stats_host(
+        logprobs=lp, proximal_logprobs=prox, old_logprobs=prox,
+        advantages=adv, loss_mask=mask, eps_clip=0.2,
+    )
+    np.testing.assert_allclose(
+        s["importance_weight"], [2.0, 1.0, 0.5, 4.0], rtol=1e-6
+    )
+    # the clip binds only when it makes the objective MORE pessimistic:
+    # adv>0 & ratio>1.2 (t0: pg 2 -> 1.2 clipped away... pg1=-2 < pg2=-1.2
+    # -> clips); on-policy never clips (t1); adv<0 & ratio<0.8 clips
+    # (t2: pg1=0.5 < pg2=0.8); adv<0 & ratio>1.2 does NOT (t3: pg1=4 is
+    # already the pessimistic branch)
+    assert s["clip_mask"].tolist() == [True, False, True, False]
+    assert float(s["clip_mask"].sum() / 4) == 0.5  # the clip fraction
+
+
+def test_ppo_loss_stats_host_behav_cap_trigger_hand_computed():
+    # behav weights: exp(prox - old) = [1.0, e, e^2]; cap at e -> the
+    # e^2 token is masked out of behav stats (weight and kl zeroed)
+    old = np.zeros(3, np.float32)
+    prox = np.array([0.0, 1.0, 2.0], np.float32)
+    lp = prox.copy()  # on-policy vs proximal
+    cap = float(np.exp(1.0)) + 1e-6
+    s = F.ppo_loss_stats_host(
+        logprobs=lp, proximal_logprobs=prox, old_logprobs=old,
+        advantages=np.ones(3, np.float32), loss_mask=np.ones(3, np.float32),
+        eps_clip=0.2, behav_imp_weight_cap=cap,
+    )
+    np.testing.assert_allclose(
+        s["behave_imp_weight"], [1.0, np.e, 0.0], rtol=1e-6
+    )
+    assert s["behave_mask"].tolist() == [True, True, False]
+    np.testing.assert_allclose(s["behave_approx_kl"], [0.0, 1.0, 0.0])
+    # trigger fraction the observatory reports: 1 of 3 tokens past cap
+    ratio = s["behave_imp_weight"]
+    assert float((~s["behave_mask"]).sum() / 3) == pytest.approx(1 / 3)
+    del ratio
+
+
+def test_ppo_loss_stats_host_dual_clip_hand_computed():
+    # adv=-1, ratio=5: pg after clip = max(-(-1*5), -(-1*1.2)) = 5;
+    # pg3 = sign(-1)*c*(-1) = 2 < 5 -> dual clip binds
+    prox = np.zeros(2, np.float32)
+    lp = np.log(np.array([5.0, 1.0], np.float32))
+    s = F.ppo_loss_stats_host(
+        logprobs=lp, proximal_logprobs=prox, old_logprobs=prox,
+        advantages=np.array([-1.0, 1.0], np.float32),
+        loss_mask=np.ones(2, np.float32), eps_clip=0.2, c_clip=2.0,
+    )
+    assert s["dual_clip_mask"].tolist() == [True, False]
+
+
+def test_ppo_loss_stats_host_matches_jitted_loss_stats():
+    rng = np.random.default_rng(3)
+    T = 64
+    lp = -rng.random(T).astype(np.float32)
+    prox = lp + rng.normal(0, 0.3, T).astype(np.float32)
+    old = prox + rng.normal(0, 0.3, T).astype(np.float32)
+    adv = rng.normal(size=T).astype(np.float32)
+    mask = (rng.random(T) > 0.25).astype(np.float32)
+    kwargs = dict(
+        eps_clip=0.2, eps_clip_higher=0.3, c_clip=2.0,
+        behav_imp_weight_cap=1.5,
+    )
+    _, jax_stats = F.ppo_actor_loss_fn(
+        logprobs=jnp.asarray(lp),
+        proximal_logprobs=jnp.asarray(prox),
+        old_logprobs=jnp.asarray(old),
+        advantages=jnp.asarray(adv),
+        loss_mask=jnp.asarray(mask),
+        **kwargs,
+    )
+    host = F.ppo_loss_stats_host(
+        logprobs=lp, proximal_logprobs=prox, old_logprobs=old,
+        advantages=adv, loss_mask=mask, **kwargs,
+    )
+    for key in (
+        "importance_weight", "approx_kl", "clip_mask", "dual_clip_mask",
+        "behave_imp_weight", "behave_approx_kl", "behave_mask",
+    ):
+        np.testing.assert_allclose(
+            host[key], np.asarray(jax_stats[key]), rtol=1e-5, atol=1e-6,
+            err_msg=f"host mirror drifted from the jitted loss on {key}",
+        )
+
+
+def test_kl_estimators_hand_computed():
+    from areal_tpu.utils.data import KLEstimator
+
+    # KL(pi||ref) estimators over logr = ref_logp - logp; with
+    # logp=-1, ref=-2: logr=-1 -> k1=1, k2=0.5, k3=e^-1 - 1 + 1 = e^-1
+    logp = np.array([-1.0], np.float32)
+    ref = np.array([-2.0], np.float32)
+    np.testing.assert_allclose(KLEstimator("k1")(logp, ref), [1.0])
+    np.testing.assert_allclose(KLEstimator("k2")(logp, ref), [0.5])
+    np.testing.assert_allclose(
+        KLEstimator("k3")(logp, ref), [np.expm1(-1.0) + 1.0], rtol=1e-6
+    )
+    with pytest.raises(ValueError):
+        KLEstimator("k9")(logp, ref)

@@ -241,7 +241,11 @@ def test_cli_select_and_list_rules():
 
 def test_per_path_ignores_config():
     ignores = framework.load_per_path_ignores(REPO_ROOT)
-    assert ignores.get("tests/") == {"jit-per-call", "crash-unsafe-write"}
+    assert ignores.get("tests/") == {
+        "jit-per-call",
+        "crash-unsafe-write",
+        "swallowed-exception",
+    }
     keep = framework.Finding("jit-per-call", "areal_tpu/x.py", 1, 0, "m")
     drop = framework.Finding("jit-per-call", "tests/t.py", 1, 0, "m")
     other = framework.Finding("jit-in-loop", "tests/t.py", 1, 0, "m")

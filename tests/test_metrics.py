@@ -392,3 +392,41 @@ def test_coresident_executors_keep_distinct_rollout_series():
     finally:
         ex1.destroy()
         ex2.destroy()
+
+
+def test_histogram_observe_many_matches_observe_loop():
+    """Bulk observation (the RL-health per-batch path) must be exactly
+    the per-value loop: same bucket counts, sum, count, quantiles —
+    including values landing ON a bucket bound (le semantics)."""
+    import numpy as np
+
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    buckets = (0.5, 1.0, 2.0, 4.0)
+    a = reg_a.histogram("h", buckets=buckets)
+    b = reg_b.histogram("h", buckets=buckets)
+    vals = np.array([0.1, 0.5, 0.500001, 1.0, 3.9, 4.0, 99.0, 2.0])
+    a.observe_many(vals)
+    for v in vals:
+        b.observe(float(v))
+    ca, cb = a.children()[()], b.children()[()]
+    assert ca.counts == cb.counts
+    assert ca.count == cb.count == len(vals)
+    assert ca.sum == pytest.approx(cb.sum)
+    assert a.quantile(0.5) == pytest.approx(b.quantile(0.5))
+    # empty input is a no-op
+    a.observe_many(np.array([]))
+    assert ca.count == len(vals)
+
+
+def test_histogram_observe_many_drops_non_finite():
+    """One NaN must not poison the histogram sum for the rest of the
+    process — the diverging-run regime is exactly when the RL-health
+    histograms must stay scrapeable."""
+    import numpy as np
+
+    reg = MetricsRegistry()
+    h = reg.histogram("h2", buckets=(1.0, 2.0))
+    h.observe_many(np.array([0.5, float("nan"), float("inf"), 1.5]))
+    child = h.children()[()]
+    assert child.count == 2
+    assert math.isfinite(child.sum) and child.sum == pytest.approx(2.0)

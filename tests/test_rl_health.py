@@ -1,0 +1,670 @@
+"""RL training-health observatory (areal_tpu/utils/rl_health.py): signal
+math pins, sentinel hysteresis/latching, chaos-injected step-exact anomaly
+detection, flight-recorder anomaly dumps, guardrail actions (warn /
+pause_rollout / halt), zero-overhead-off code inspection, and the
+end-to-end PPOActor integration."""
+
+import ast
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    InferenceEngineConfig,
+    PPOActorConfig,
+    RLHealthConfig,
+)
+from areal_tpu.utils import chaos
+from areal_tpu.utils.flight_recorder import FlightRecorder
+from areal_tpu.utils.metrics import MetricsRegistry, parse_prometheus_text
+from areal_tpu.utils.rl_health import (
+    RLHealthHalt,
+    RLHealthMonitor,
+    degenerate_output_stats,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset_rl_faults()
+    old = os.environ.pop(chaos.RL_CHAOS_ENV, None)
+    yield
+    chaos.reset_rl_faults()
+    if old is None:
+        os.environ.pop(chaos.RL_CHAOS_ENV, None)
+    else:
+        os.environ[chaos.RL_CHAOS_ENV] = old
+
+
+def _monitor(cfg=None, **kwargs):
+    cfg = cfg or RLHealthConfig(consecutive=1, publish_status=False)
+    reg = kwargs.pop("registry", MetricsRegistry())
+    rec = kwargs.pop("recorder", FlightRecorder())
+    m = RLHealthMonitor.from_config(cfg, registry=reg, recorder=rec, **kwargs)
+    assert m is not None
+    return m, reg, rec
+
+
+def _train_data(bs=4, seqlen=32, prompt=8, seed=0, versions_hi=1):
+    rng = np.random.default_rng(seed)
+    lm = np.zeros((bs, seqlen), np.int64)
+    lm[:, prompt:] = 1
+    old = np.where(lm > 0, -rng.random((bs, seqlen)).astype(np.float32), 0.0)
+    prox = old + np.where(
+        lm > 0, rng.normal(0, 0.2, size=(bs, seqlen)).astype(np.float32), 0.0
+    )
+    versions = np.where(
+        lm > 0, rng.integers(0, versions_hi + 1, size=(bs, seqlen)), -1
+    )
+    return dict(
+        loss_mask=lm,
+        logprobs=old,
+        prox_logp=prox,
+        advantages=rng.normal(size=(bs, seqlen)).astype(np.float32),
+        versions=versions,
+    )
+
+
+# ---------------------------------------------------------------------------
+# distribution telemetry: hand-computed pins
+# ---------------------------------------------------------------------------
+
+
+def test_degenerate_detector_flags_ngram_loop():
+    S = 40
+    ids = np.arange(1, S + 1)[None, :].repeat(3, axis=0).copy()
+    attn = np.ones((3, S), np.int64)
+    lm = np.zeros((3, S), np.int64)
+    lm[:, 8:] = 1
+    # seq 1: pure 2-gram loop over its whole generated range
+    ids[1, 8:] = np.tile([7, 9], (S - 8) // 2)
+    # seq 2: healthy prefix, loop only in the last 8 tokens (4x "5 6")
+    ids[2, S - 8:] = np.tile([5, 6], 4)
+    d = degenerate_output_stats(ids, lm, attn)
+    assert d["loop_frac"][0] == 0.0
+    assert d["loop_frac"][1] == 1.0
+    assert d["loop_frac"][2] == pytest.approx(8 / 32)
+    assert d["repetition_max"] == 1.0
+    assert d["eos_absence_rate"] == 1.0  # all rows full
+    assert d["gen_len_mean"] == 32.0
+
+
+def test_degenerate_detector_single_token_loop_and_partial_rows():
+    ids = np.ones((2, 16), np.int64) * 3
+    attn = np.ones((2, 16), np.int64)
+    attn[0, 12:] = 0  # seq 0 ended before max length => EOS present
+    lm = np.zeros((2, 16), np.int64)
+    lm[0, 4:12] = 1
+    lm[1, 4:] = 1
+    d = degenerate_output_stats(ids, lm, attn)
+    assert d["loop_frac"][0] == 1.0  # "3 3 3..." is a 1-gram loop
+    assert d["eos_absent"][0] == np.False_
+    assert d["eos_absent"][1] == np.True_
+    assert d["gen_lens"].tolist() == [8, 12]
+
+
+def test_staleness_and_ratio_stats_hand_computed():
+    m, reg, _ = _monitor()
+    lm = np.array([[0, 1, 1, 1], [0, 1, 1, 0]], np.int64)
+    old = np.array(
+        [[0.0, -1.0, -1.0, -1.0], [0.0, -2.0, -2.0, 0.0]], np.float32
+    )
+    prox = old + np.array(
+        [[0.0, math.log(2.0), 0.0, 0.0], [0.0, 0.0, math.log(0.5), 0.0]],
+        np.float32,
+    )
+    versions = np.array([[-1, 0, 0, 2], [-1, 2, 2, -1]], np.int64)
+    data = dict(
+        loss_mask=lm,
+        logprobs=old,
+        prox_logp=prox,
+        advantages=np.ones_like(old),
+        versions=versions,
+    )
+    m.observe_train_batch(
+        data, current_version=2, actor_config=PPOActorConfig(path="")
+    )
+    row = m.end_step(0)
+    # ratios over the 5 valid tokens: [2, 1, 1, 1, 0.5]
+    assert row["rl_health/ratio_mean"] == pytest.approx(5.5 / 5)
+    assert row["rl_health/ratio_max"] == pytest.approx(2.0)
+    # lags over valid-version tokens: [2, 2, 0, 0, 0]
+    assert row["rl_health/staleness_mean"] == pytest.approx(4 / 5)
+    assert row["rl_health/staleness_max"] == 2.0
+    # seq 0 spans {0, 2} => mixed; seq 1 all-2 => not
+    assert row["rl_health/version_mix_frac"] == pytest.approx(0.5)
+    # entropy estimate: mean(-prox) over valid tokens
+    prox_valid = prox[lm.astype(bool)]
+    assert row["rl_health/entropy"] == pytest.approx(float(-prox_valid.mean()))
+    # histograms got the per-token arrays in bulk
+    assert reg.histogram("areal_rl_importance_ratio").children()[()].count == 5
+    assert reg.histogram("areal_rl_staleness").children()[()].count == 5
+
+
+def test_reward_stats_and_window():
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=False, reward_window_steps=3,
+        reward_collapse_drop=0.0,
+    )
+    m, _, _ = _monitor(cfg)
+    for step, r in enumerate([0.5, 0.5, 0.5]):
+        m.note_rewards(
+            raw=np.full(4, r), clipped=np.full(4, r), clipped_frac=0.0
+        )
+        if step < 2:
+            row = m.end_step(step)
+            assert row["rl_health/anomaly"] == 0.0
+    # window now full of identical means -> flatline fires
+    row = m.end_step(2)
+    assert row["rl_health/anomaly"] == 1.0
+    assert m.last_anomaly["rule"] == "reward_collapse"
+
+
+def test_reward_collapse_drop():
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=False, reward_window_steps=8,
+        reward_collapse_drop=0.4, reward_std_floor=0.0,
+    )
+    m, _, _ = _monitor(cfg)
+    for step, r in enumerate([1.0, 0.9, 1.0]):
+        m.note_rewards(raw=np.full(4, r), clipped=np.full(4, r), clipped_frac=0.0)
+        assert m.end_step(step)["rl_health/anomaly"] == 0.0
+    m.note_rewards(raw=np.full(4, 0.2), clipped=np.full(4, 0.2), clipped_frac=0.0)
+    row = m.end_step(3)  # 0.2 < mean(1, .9, 1) - 0.4
+    assert row["rl_health/anomaly"] == 1.0
+    assert m.last_anomaly["rule"] == "reward_collapse"
+
+
+# ---------------------------------------------------------------------------
+# sentinel: hysteresis, latching, chaos step-exactness
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_requires_consecutive_breaches():
+    cfg = RLHealthConfig(
+        consecutive=2, publish_status=False, entropy_floor=0.1
+    )
+    m, reg, _ = _monitor(cfg)
+    # one-step blip: breach, then clear -> never fires
+    m._snap["entropy"] = 0.0
+    assert m.end_step(0)["rl_health/anomaly"] == 0.0
+    m._snap["entropy"] = 1.0
+    assert m.end_step(1)["rl_health/anomaly"] == 0.0
+    # two consecutive breaches -> fires on the SECOND
+    m._snap["entropy"] = 0.0
+    assert m.end_step(2)["rl_health/anomaly"] == 0.0
+    m._snap["entropy"] = 0.0
+    assert m.end_step(3)["rl_health/anomaly"] == 1.0
+    assert m.last_anomaly == {
+        "rule": "entropy_floor", "step": 3,
+        "t": m.last_anomaly["t"], "action": "warn",
+    }
+
+
+def test_latch_fires_once_per_sustained_breach_then_rearms():
+    cfg = RLHealthConfig(consecutive=1, publish_status=False, entropy_floor=0.1)
+    m, reg, _ = _monitor(cfg)
+    for step in range(3):  # sustained breach: fires once, stays latched
+        m._snap["entropy"] = 0.0
+        m.end_step(step)
+    assert m.anomalies_fired == 1
+    m._snap["entropy"] = 1.0
+    m.end_step(3)  # clears -> unlatches
+    m._snap["entropy"] = 0.0
+    m.end_step(4)
+    assert m.anomalies_fired == 2
+    # the counter carries the per-rule latched total
+    c = reg.counter("areal_rl_anomaly_total", labels=("rule",))
+    assert c.labels(rule="entropy_floor").value == 2
+
+
+def test_non_finite_loss_ignores_hysteresis():
+    cfg = RLHealthConfig(consecutive=5, publish_status=False)
+    m, _, _ = _monitor(cfg)
+    m.note_train_result(loss=float("nan"))
+    assert m.end_step(0)["rl_health/anomaly"] == 1.0  # first breach fires
+    assert m.last_anomaly["rule"] == "non_finite_loss"
+
+
+def test_nonfinite_sticks_across_minibatches():
+    m, _, _ = _monitor()
+    m.note_train_result(loss=float("inf"), grad_norm=1.0)
+    m.note_train_result(loss=0.3, grad_norm=1.0)  # later sane mb
+    assert m.end_step(0)["rl_health/anomaly"] == 1.0
+
+
+@pytest.mark.parametrize(
+    "fault,rule",
+    [
+        ("nan_loss", "non_finite_loss"),
+        ("entropy_collapse", "entropy_floor"),
+        ("staleness_spike", "staleness_spike"),
+        ("ratio_blowup", "ratio_blowup"),
+        ("reward_flatline", "reward_collapse"),
+        ("repetition_spike", "repetition_spike"),
+    ],
+)
+def test_chaos_fault_detected_at_exact_step(fault, rule, tmp_path):
+    """AREAL_CHAOS_RL=<fault>@3 fires rule <rule> at step 3 — not 2, not
+    4 — and the anomaly flight dump holds the offending-step stats."""
+    os.environ[chaos.RL_CHAOS_ENV] = f"{fault}@3"
+    rec = FlightRecorder()
+    rec.set_dump_dir(str(tmp_path))
+    m, _, _ = _monitor(recorder=rec)
+    # healthy baseline signals present every step
+    healthy = dict(
+        entropy=1.0, staleness_p95=0.0, ratio_p99=1.0, repetition_frac=0.0,
+    )
+    for step in range(1, 6):
+        m._snap.update(healthy)
+        m.note_train_result(loss=0.2, grad_norm=1.0)
+        # alternating means: never flatlines, never drops past the bound
+        m.note_rewards(
+            raw=np.full(4, 0.5 + 0.05 * (step % 2)),
+            clipped=np.zeros(4),
+            clipped_frac=0.0,
+        )
+        row = m.end_step(step)
+        assert row["rl_health/anomaly"] == float(step == 3), (
+            f"rule {rule} fired at step {step}"
+        )
+    assert m.last_anomaly["rule"] == rule
+    assert m.last_anomaly["step"] == 3
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("flight_rl_anomaly")]
+    assert len(dumps) == 1 and rule in dumps[0]
+    snap = json.load(open(tmp_path / dumps[0]))
+    [entry] = snap["channels"]["anomaly"]
+    assert entry["rule"] == rule and entry["step"] == 3
+    assert "stats" in entry and "loss" in entry["stats"]
+    # the recent-step ring rides the same dump (steps 1..3 at dump time,
+    # the offending step recorded last)
+    ring = snap["channels"]["rl_health"]
+    assert len(ring) == 3 and ring[-1]["step"] == 3
+
+
+def test_chaos_window_grammar_drives_hysteresis():
+    """name@N:K holds the fault for K consecutive steps — a consecutive=2
+    rule then fires at step N+1 and not for a 1-step blip."""
+    os.environ[chaos.RL_CHAOS_ENV] = "entropy_collapse@2:2"
+    cfg = RLHealthConfig(consecutive=2, publish_status=False)
+    m, _, _ = _monitor(cfg)
+    fired_at = []
+    for step in range(1, 6):
+        m._snap["entropy"] = 1.0
+        if m.end_step(step)["rl_health/anomaly"]:
+            fired_at.append(step)
+    assert fired_at == [3]
+
+
+# ---------------------------------------------------------------------------
+# guardrails
+# ---------------------------------------------------------------------------
+
+
+def test_pause_rollout_guardrail_pauses_real_executor():
+    class _Eng:
+        def get_version(self):
+            return 0
+
+    from areal_tpu.core.workflow_executor import WorkflowExecutor
+
+    ex = WorkflowExecutor(InferenceEngineConfig(max_concurrent_rollouts=2), _Eng())
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=False,
+        rule_actions={"entropy_floor": "pause_rollout"},
+    )
+    m, _, _ = _monitor(cfg, pause_fn=ex.pause)
+    ex.rl_health = m
+    assert not ex.paused.is_set()
+    m._snap["entropy"] = 0.0
+    row = m.end_step(0)
+    assert row["rl_health/anomaly"] == 1.0
+    assert ex.paused.is_set()
+    # the latch the trainer loops consult before their per-push resume:
+    # without it, the next step's pause()/resume() pair around
+    # update_weights would silently undo the guardrail
+    assert m.rollout_paused
+    m.resume_rollout()
+    assert not m.rollout_paused
+
+
+def test_halt_guardrail_raises_after_dump(tmp_path):
+    rec = FlightRecorder()
+    rec.set_dump_dir(str(tmp_path))
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=False,
+        rule_actions={"staleness_spike": "halt"},
+    )
+    m, _, _ = _monitor(cfg, recorder=rec)
+    m._snap["staleness_p95"] = 100.0
+    with pytest.raises(RLHealthHalt, match="staleness_spike"):
+        m.end_step(7)
+    # evidence written BEFORE the raise
+    dumps = [f for f in os.listdir(tmp_path) if "rl_anomaly" in f]
+    assert len(dumps) == 1
+
+
+def test_invalid_action_rejected():
+    with pytest.raises(ValueError, match="rl_health.action"):
+        RLHealthMonitor(
+            RLHealthConfig(action="explode"),
+            registry=MetricsRegistry(),
+            recorder=FlightRecorder(),
+        )
+    with pytest.raises(ValueError, match="rule_actions"):
+        RLHealthMonitor(
+            RLHealthConfig(rule_actions={"entropy_floor": "explode"}),
+            registry=MetricsRegistry(),
+            recorder=FlightRecorder(),
+        )
+
+
+# ---------------------------------------------------------------------------
+# export surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_and_span_and_status_exports():
+    from areal_tpu.utils import name_resolve, names
+    from areal_tpu.utils.tracing import Tracer
+
+    name_resolve.DEFAULT_REPOSITORY.reset()
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=True,
+        experiment_name="e1", trial_name="t1",
+    )
+    m, reg, _ = _monitor(cfg)
+    tracer = Tracer(service="test")
+    span = tracer.span("train.step", step=0)
+    m.observe_train_batch(
+        _train_data(), current_version=1, actor_config=PPOActorConfig(path="")
+    )
+    m.note_rewards(raw=np.ones(4), clipped=np.ones(4), clipped_frac=0.25)
+    m.end_step(0, span=span)
+    span.end()
+    # span carries the rl_health event
+    [s] = [
+        s for s in tracer.finished_spans() if s["name"] == "train.step"
+    ]
+    assert any(e["name"] == "rl_health" for e in s["events"])
+    # prometheus exposition carries the gauges + histograms
+    text = reg.render_prometheus()
+    series = parse_prometheus_text(text)
+    assert "areal_rl_entropy" in text
+    assert any(k.startswith("areal_rl_importance_ratio_bucket") for k in series)
+    assert any(
+        k.startswith('areal_rl_reward_bucket{kind="raw"') for k in series
+    )
+    # name_resolve status for areal-tpu-top
+    raw = name_resolve.get(names.rl_health("e1", "t1"))
+    status = json.loads(raw)
+    assert status["step"] == 0 and status["last_anomaly"] is None
+    assert "entropy" in status and "ratio_p99" in status
+
+
+def test_status_publish_failure_never_raises(monkeypatch):
+    from areal_tpu.utils import name_resolve
+
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=True,
+        experiment_name="e1", trial_name="t1",
+    )
+    m, _, _ = _monitor(cfg)
+
+    def boom(*a, **k):
+        raise OSError("discovery down")
+
+    monkeypatch.setattr(name_resolve, "add", boom)
+    m._snap["entropy"] = 1.0
+    m.end_step(0)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# zero overhead off
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_config_yields_none():
+    assert RLHealthMonitor.from_config(RLHealthConfig(enabled=False)) is None
+    assert RLHealthMonitor.from_config(None) is None
+
+
+def _find_fn(tree, name):
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if n.name == name:
+                return n
+    raise AssertionError(f"function {name} not found")
+
+
+def test_hot_path_rl_health_uses_are_guarded_code_inspection():
+    """Chaos-hook discipline: on the rollout-collect and PPO-update hot
+    paths, every rl_health attribute USE sits under an ``is not None``
+    guard — disabled, these paths pay only that check."""
+    import areal_tpu.core.workflow_executor as wx_mod
+    import areal_tpu.engine.ppo.actor as actor_mod
+
+    targets = [
+        (wx_mod, "wait"),
+        (wx_mod, "_wait_impl"),
+        (actor_mod, "ppo_update"),
+        (actor_mod, "compute_advantages"),
+    ]
+    for mod, fname in targets:
+        tree = ast.parse(open(mod.__file__).read())
+        fn = _find_fn(tree, fname)
+        parent_of = {}
+        for p in ast.walk(fn):
+            for c in ast.iter_child_nodes(p):
+                parent_of[c] = p
+
+        def _guarded(n):
+            while n in parent_of:
+                n = parent_of[n]
+                if isinstance(n, ast.If):
+                    t = ast.dump(n.test)
+                    if "IsNot" in t and "rl_health" in t:
+                        return True
+            return False
+
+        offenders = [
+            node.lineno
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Attribute)
+            and node.attr == "rl_health"
+            and isinstance(parent_of.get(node), ast.Attribute)
+            and not _guarded(node)
+        ]
+        assert not offenders, (
+            f"{mod.__name__}.{fname}: unguarded rl_health uses at lines "
+            f"{offenders} — disabled must cost only `is not None`"
+        )
+
+
+# ---------------------------------------------------------------------------
+# end to end: a real PPOActor feeding the observatory
+# ---------------------------------------------------------------------------
+
+
+def test_ppo_actor_integration_populates_observatory():
+    """gsm8k-shaped: a real TPUPPOActor update over a synthetic rollout
+    batch with the monitor attached — the reward hook, the train-batch
+    hook, and the per-minibatch loss hook all land in one step row."""
+    from areal_tpu.api.cli_args import OptimizerConfig
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+    from areal_tpu.models.config import tiny_config
+
+    cfg = PPOActorConfig(
+        path="",
+        init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-3),
+        group_size=2,
+        ppo_n_minibatches=1,
+        use_decoupled_loss=True,
+        recompute_logprob=True,
+        adv_norm=None,
+        behav_imp_weight_cap=2.0,
+    )
+    cfg.backend.pad_mb_to_multiple = 8
+    cfg.backend.param_dtype = "float32"
+    actor = TPUPPOActor(cfg)
+    actor.initialize(None, None, model_config=tiny_config(), seed=0)
+    actor.set_version(3)
+    m, reg, rec = _monitor()
+    actor.actor.rl_health = m
+    try:
+        rng = np.random.default_rng(0)
+        bs, seqlen, prompt = 4, 16, 4
+        batch = dict(
+            input_ids=rng.integers(1, 100, size=(bs, seqlen)),
+            attention_mask=np.ones((bs, seqlen), np.int64),
+            loss_mask=np.zeros((bs, seqlen), np.int64),
+            logprobs=-rng.random((bs, seqlen)).astype(np.float32),
+            rewards=np.array([1.0, 0.0, 1.0, 0.0], np.float32),
+            versions=np.where(
+                np.arange(seqlen)[None, :] >= prompt,
+                rng.integers(1, 4, size=(bs, seqlen)),
+                -1,
+            ),
+        )
+        batch["loss_mask"][:, prompt:] = 1
+        batch["prox_logp"] = actor.compute_logp(batch)
+        actor.compute_advantages(batch)
+        stats = actor.ppo_update(batch)
+        assert stats
+        row = m.end_step(0)
+    finally:
+        actor.destroy()
+    for key in (
+        "rl_health/ratio_p99", "rl_health/clip_frac",
+        "rl_health/behav_cap_frac", "rl_health/staleness_p95",
+        "rl_health/version_mix_frac", "rl_health/reward_mean",
+        "rl_health/reward_clipped_frac", "rl_health/entropy",
+        "rl_health/kl", "rl_health/adv_std", "rl_health/loss",
+        "rl_health/grad_norm",
+    ):
+        assert key in row, f"missing {key}"
+    assert row["rl_health/reward_mean"] == pytest.approx(0.5)
+    assert math.isfinite(row["rl_health/loss"])
+    # staleness: versions in {1,2,3} at current 3 -> lags in {0,1,2}
+    assert 0.0 <= row["rl_health/staleness_mean"] <= 2.0
+    assert reg.histogram("areal_rl_importance_ratio").children()[()].count > 0
+    # behav hist drops cap-excluded tokens (cap=2.0 set in the config)
+    assert (
+        reg.histogram("areal_rl_behav_ratio").children()[()].count
+        <= reg.histogram("areal_rl_importance_ratio").children()[()].count
+    )
+    assert (
+        reg.histogram("areal_rl_reward", labels=("kind",))
+        .labels(kind="raw")
+        .count
+        == 4
+    )
+
+
+def test_ppo_actor_loop_chaos_nan_halts_at_exact_step(tmp_path):
+    """Full loop shape: repeated real PPOActor updates with the monitor
+    attached, AREAL_CHAOS_RL=nan_loss@2 and a halt guardrail — the loop
+    dies via RLHealthHalt at step 2 exactly, with the anomaly dump (and
+    NOT a step-3 row) on disk; steps before it commit normally."""
+    from areal_tpu.api.cli_args import OptimizerConfig
+    from areal_tpu.engine.ppo.actor import TPUPPOActor
+    from areal_tpu.models.config import tiny_config
+
+    os.environ[chaos.RL_CHAOS_ENV] = "nan_loss@2"
+    rec = FlightRecorder()
+    rec.set_dump_dir(str(tmp_path))
+    cfg = RLHealthConfig(
+        consecutive=1, publish_status=False,
+        rule_actions={"non_finite_loss": "halt"},
+    )
+    m = RLHealthMonitor.from_config(
+        cfg, registry=MetricsRegistry(), recorder=rec
+    )
+
+    acfg = PPOActorConfig(
+        path="", init_from_scratch=True,
+        optimizer=OptimizerConfig(lr=1e-3), group_size=2,
+        ppo_n_minibatches=1, use_decoupled_loss=True,
+        recompute_logprob=True, adv_norm=None,
+    )
+    acfg.backend.pad_mb_to_multiple = 8
+    acfg.backend.param_dtype = "float32"
+    actor = TPUPPOActor(acfg)
+    actor.initialize(None, None, model_config=tiny_config(), seed=0)
+    actor.actor.rl_health = m
+    committed = []
+    try:
+        with pytest.raises(RLHealthHalt) as ei:
+            for step in range(1, 4):
+                rng = np.random.default_rng(step)
+                bs, seqlen, prompt = 4, 16, 4
+                batch = dict(
+                    input_ids=rng.integers(1, 100, size=(bs, seqlen)),
+                    attention_mask=np.ones((bs, seqlen), np.int64),
+                    loss_mask=np.zeros((bs, seqlen), np.int64),
+                    logprobs=-rng.random((bs, seqlen)).astype(np.float32),
+                    rewards=rng.normal(size=bs).astype(np.float32),
+                    versions=np.zeros((bs, seqlen), np.int64),
+                )
+                batch["loss_mask"][:, prompt:] = 1
+                batch["prox_logp"] = actor.compute_logp(batch)
+                actor.compute_advantages(batch)
+                actor.ppo_update(batch)
+                m.end_step(step)  # halt raises here, BEFORE the commit
+                committed.append(step)
+    finally:
+        actor.destroy()
+    assert "step 2" in str(ei.value)
+    assert committed == [1]  # step 2 never committed; step 3 never ran
+    dumps = [f for f in os.listdir(tmp_path) if "rl_anomaly" in f]
+    assert len(dumps) == 1 and "non_finite_loss" in dumps[0]
+
+
+def test_executor_wait_feeds_degenerate_detector():
+    """The real rollout path: submit -> background thread -> wait(), with
+    the monitor attached — a looping workflow output lands in the step
+    snapshot without any explicit observe call."""
+    import asyncio
+
+    from areal_tpu.api.workflow_api import RolloutWorkflow
+    from areal_tpu.core.workflow_executor import WorkflowExecutor
+
+    class _Eng:
+        def get_version(self):
+            return 0
+
+    class LoopyWorkflow(RolloutWorkflow):
+        async def arun_episode(self, engine, data):
+            await asyncio.sleep(0)
+            ids = np.full((1, 16), 7, np.int32)  # pure 1-gram loop
+            lm = np.zeros((1, 16), np.int32)
+            lm[:, 4:] = 1
+            return dict(
+                input_ids=ids,
+                attention_mask=np.ones((1, 16), np.int32),
+                loss_mask=lm,
+            )
+
+    ex = WorkflowExecutor(
+        InferenceEngineConfig(
+            max_concurrent_rollouts=2, consumer_batch_size=2
+        ),
+        _Eng(),
+    )
+    m, _, _ = _monitor()
+    ex.rl_health = m
+    ex.initialize()
+    try:
+        ex.submit(dict(x=0), workflow=LoopyWorkflow())
+        ex.submit(dict(x=1), workflow=LoopyWorkflow())
+        batch = ex.wait(count=2, timeout=20)
+        assert batch["input_ids"].shape[0] == 2
+        row = m.end_step(0)
+    finally:
+        ex.destroy()
+    assert row["rl_health/repetition_frac"] == 1.0
+    assert row["rl_health/gen_len_mean"] == 12.0

@@ -1,0 +1,143 @@
+"""areal-tpu-top (areal_tpu/cli/top.py): file-based fleet discovery, the
+/model_info poll, RL-health status rendering, and the stdlib-only/run-by-
+path contract (the module must import WITHOUT the areal_tpu package —
+that import pulls jax, which wedges exactly when an operator needs top).
+"""
+
+import http.server
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOP_PATH = os.path.join(REPO, "areal_tpu", "cli", "top.py")
+
+
+@pytest.fixture(scope="module")
+def top():
+    """Load by PATH, not package import — proving the wedged-tunnel
+    contract (no areal_tpu/jax import) as a side effect."""
+    spec = importlib.util.spec_from_file_location("_top_by_path", TOP_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    pre = set(sys.modules)
+    spec.loader.exec_module(mod)
+    pulled = {m.split(".")[0] for m in set(sys.modules) - pre}
+    assert "jax" not in pulled and "areal_tpu" not in pulled, (
+        f"top.py pulled non-stdlib deps at import: {pulled}"
+    )
+    return mod
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    info = {
+        "weight_version": 7,
+        "n_running": 3,
+        "admission_queue_depth": 2,
+        "kv_blocks_used": 30,
+        "kv_blocks_free": 70,
+        "prefix_cache_hit_rate": 0.8,
+        "ttft_p95_seconds": 0.125,
+        "generated_tokens_total": 12345,
+    }
+
+    def do_GET(self):
+        if self.path == "/model_info":
+            body = json.dumps(self.info).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def server():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _write_entry(root, key, value):
+    d = os.path.join(root, key)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "ENTRY"), "w") as f:
+        f.write(value)
+
+
+def test_discovery_reads_nfs_layout(top, tmp_path, server):
+    root = str(tmp_path)
+    _write_entry(root, "areal_tpu/e1/t1/gen_servers/s0", server)
+    _write_entry(root, "areal_tpu/e1/t1/gen_servers/s1", "127.0.0.1:1")
+    addrs = top.discover_servers(root, "e1", "t1")
+    assert addrs == [server, "127.0.0.1:1"]
+    assert top.discover_servers(root, "nope", "t1") == []
+
+
+def test_nfs_discovery_matches_real_repository(top, tmp_path):
+    """The CLI's hand-rolled file layout must track what
+    NfsNameRecordRepository actually writes."""
+    from areal_tpu.utils import name_resolve, names
+
+    repo = name_resolve.NfsNameRecordRepository(str(tmp_path))
+    repo.add(names.gen_server("e2", "t2", "srv0"), "10.0.0.1:9000")
+    repo.add(names.rl_health("e2", "t2"), json.dumps({"step": 4, "t": 0.0}))
+    assert top.discover_servers(str(tmp_path), "e2", "t2") == ["10.0.0.1:9000"]
+    assert top.read_health_status(str(tmp_path), "e2", "t2")["step"] == 4
+    repo._to_delete.clear()  # don't let atexit rmtree the pytest tmp dir
+
+
+def test_one_screen_summary(top, tmp_path, server):
+    root = str(tmp_path)
+    _write_entry(root, "areal_tpu/e1/t1/gen_servers/s0", server)
+    _write_entry(root, "areal_tpu/e1/t1/gen_servers/s1", "127.0.0.1:1")
+    _write_entry(
+        root,
+        "areal_tpu/e1/t1/rl_health",
+        json.dumps({
+            "step": 12, "t": 0.0, "entropy": 0.42, "ratio_p99": 1.3,
+            "staleness_p95": 2.0, "reward_mean": 0.61,
+            "repetition_frac": 0.02, "anomalies_fired": 1,
+            "last_anomaly": {
+                "rule": "entropy_floor", "step": 9, "action": "warn",
+                "t": 0.0,
+            },
+        }),
+    )
+
+    class A:
+        addrs = ""
+        name_root = root
+        experiment = "e1"
+        trial = "t1"
+        timeout = 2.0
+
+    screen = top.collect(A())
+    assert "fleet 1/2 up" in screen
+    assert "weight v7" in screen
+    assert "DOWN" in screen  # the dead server row
+    assert "0.125" in screen  # ttft p95
+    assert "80%" in screen  # cache hit rate
+    assert "train step 12" in screen and "entropy 0.420" in screen
+    assert "entropy_floor @ step 9" in screen
+
+
+def test_main_once_prints(top, tmp_path, capsys):
+    rc = top.main([
+        "--addrs", "127.0.0.1:1", "--timeout", "0.2",
+        "--name-root", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fleet 0/1 up" in out
+    assert "no status published" in out
