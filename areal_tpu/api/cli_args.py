@@ -816,6 +816,61 @@ class ChaosConfig:
 
 
 @dataclass
+class RewardServiceConfig:
+    """Sandboxed reward-execution plane (areal_tpu/reward_service/): a
+    bounded pool of persistent ``python -I`` sandbox workers backing (a)
+    the in-process execution fallback every zero-egress TPU pod uses and
+    (b) N HTTP service replicas the launcher can spawn alongside the
+    inference servers. The client fronts the replicas with circuit
+    breakers + least-inflight routing and falls back to the local pool,
+    so arbitrary code-execution rewards can never wedge the rollout
+    plane."""
+
+    # spawn/use the HTTP service (off = local bounded pool only)
+    enabled: bool = False
+    # service replicas launcher/local.py spawns alongside the servers
+    replicas: int = 1
+    # explicit service addresses (skip name_resolve discovery)
+    addresses: list[str] = field(default_factory=list)
+    # bind address; must stay reachable at the gethostip() the replica
+    # REGISTERS in name_resolve (0.0.0.0 like the generation server — a
+    # loopback bind would register an address nobody can connect to)
+    host: str = "0.0.0.0"
+    port: int = 0  # 0 = pick a free port per replica
+    # sandbox workers per service replica AND in the local fallback pool
+    num_workers: int = 4
+    # tasks a worker executes before it is retired and respawned
+    recycle_after: int = 64
+    # admission bound: tasks in flight or queued; beyond it the service
+    # answers 429 + Retry-After and the pool raises PoolSaturated
+    max_pending: int = 256
+    # per-task wall deadline; breach = process-group kill + respawn
+    task_timeout: float = 10.0
+    memory_mb: int = 512
+    cpu_seconds: int = 0  # 0 = derived from task_timeout
+    # client-side HTTP knobs (arequest_with_retry)
+    request_timeout: float = 60.0
+    request_retries: int = 3
+    # whole-call deadline incl. retries/backoff; 0 disables
+    total_timeout: float = 120.0
+    # fall back to the in-process pool when no replica is reachable
+    fallback_local: bool = True
+    # re-resolve service replicas from name_resolve this often (seconds)
+    discovery_interval: float = 30.0
+    # SIGTERM: seconds in-flight tasks get before the pool group-kills
+    drain_grace_seconds: float = 10.0
+    # route agentic tool-env sandbox calls (examples/tir) through the
+    # same plane (service when reachable, bounded pool otherwise)
+    tool_execution: bool = True
+    # per-tool latency/failure metrics + tool-call spans + turn-level
+    # staleness accounting in the workflow tool loop
+    tool_metrics: bool = True
+    breaker: CircuitBreakerConfig = field(default_factory=CircuitBreakerConfig)
+    chaos: ChaosConfig | None = None
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+
+@dataclass
 class InferenceEngineConfig:
     """Client/rollout control (reference cli_args.py:786)."""
 
@@ -1200,6 +1255,10 @@ class GRPOConfig(BaseExperimentConfig):
     server: JaxGenConfig = field(default_factory=JaxGenConfig)
     actor: PPOActorConfig = field(default_factory=PPOActorConfig)
     ref: TrainEngineConfig | None = None
+    # sandboxed reward-execution plane (service replicas + bounded pool)
+    reward_service: RewardServiceConfig = field(
+        default_factory=RewardServiceConfig
+    )
 
 
 @dataclass

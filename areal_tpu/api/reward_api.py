@@ -4,6 +4,13 @@ Capability parity with the reference's ``areal/api/reward_api.py:37-120``
 (``AsyncRewardWrapper``): run synchronous, potentially slow/crashy reward
 functions in a shared process pool with timeout and broken-pool recovery, so
 reward computation never blocks the rollout event loop.
+
+Reward-service integration: an ASYNC ``reward_fn`` (e.g.
+``RewardServiceClient.code_reward_fn()`` — sandboxed execution routed
+through the service/pool plane) is awaited directly under the same
+timeout discipline, no process pool involved. A timeout or failure is a
+0.0 verdict for THAT episode — per-episode failure, never a wedged
+rollout plane.
 """
 
 from __future__ import annotations
@@ -11,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import functools
+import inspect
 import os
 from typing import Callable
 
@@ -37,8 +45,10 @@ def _reset_executor():
 
 
 class AsyncRewardWrapper:
-    """Wrap a sync ``reward_fn(prompt, completion, prompt_ids, completion_ids,
-    **data) -> float`` for await-able use from workflows."""
+    """Wrap a ``reward_fn(prompt, completion, prompt_ids, completion_ids,
+    **data) -> float`` for await-able use from workflows. Sync functions
+    run in the shared process pool (or in-process); async functions —
+    the reward-service plane's client fns — are awaited directly."""
 
     def __init__(
         self,
@@ -53,6 +63,32 @@ class AsyncRewardWrapper:
         self.in_process = in_process
 
     async def __call__(self, *args, **kwargs) -> float:
+        if inspect.iscoroutinefunction(self.reward_fn):
+            # service/pool-backed async reward: await it under the same
+            # timeout contract; a late or failed reward is this episode's
+            # 0.0 verdict, not the rollout plane's problem
+            try:
+                return float(
+                    await asyncio.wait_for(
+                        self.reward_fn(*args, **kwargs), timeout=self.timeout
+                    )
+                )
+            except asyncio.CancelledError:
+                # unlike the pool path below there is no restart-initiated
+                # inner cancel here: a CancelledError can only mean OUR
+                # task was cancelled, so it must propagate
+                raise
+            except asyncio.TimeoutError:
+                logger.warning(
+                    "Async reward timed out after %.1fs; returning 0.",
+                    self.timeout,
+                )
+                return 0.0
+            except Exception:
+                logger.warning(
+                    "Async reward failed; returning 0.", exc_info=True
+                )
+                return 0.0
         if self.in_process:
             return float(self.reward_fn(*args, **kwargs))
         loop = asyncio.get_running_loop()
@@ -69,7 +105,12 @@ class AsyncRewardWrapper:
             # cancellation on *us* (caller cancel) must propagate, while a
             # cancel that originated from a pool restart degrades to 0.0.
             task = asyncio.current_task()
-            if task is not None and task.cancelling() > 0:
+            # Task.cancelling() is 3.11+; on 3.10 the cases cannot be
+            # distinguished, so default to PROPAGATING (never swallow a
+            # caller's cancellation; a pool-restart cancel propagating is
+            # merely noisier, a swallowed abort is a hang)
+            cancelling = getattr(task, "cancelling", lambda: 1)
+            if task is not None and cancelling() > 0:
                 raise
             logger.warning("Reward future cancelled by pool restart; returning 0.")
             return 0.0

@@ -87,6 +87,10 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # better (the name heuristic would read neither correctly)
     "rl_health_overhead": False,
     "tracing_overhead": False,
+    # pooled/inprocess rollout tokens/s under a wedged-reward flood:
+    # higher is better; a drop toward 1 means the bounded reward plane
+    # stopped protecting the rollout plane
+    "reward_service": False,
 }
 
 

@@ -113,6 +113,11 @@ class RemoteInfEngine(InferenceEngine):
         self._push_thread: threading.Thread | None = None
         self._push_session_obj: aiohttp.ClientSession | None = None
         self._push_lock = threading.Lock()
+        # chunk gather/prepare offload for the pipelined streamer: a
+        # dedicated bounded executor (lazy; closed with the push loop) —
+        # never the loop default, whose starvation would couple weight
+        # pushes to unrelated offloaded work (unbounded-default-executor)
+        self._push_executor = None  # guarded_by: _push_lock
         # in-flight push futures, cancelled by _close_push_loop so a
         # destroy() racing a push unblocks the caller's .result() instead
         # of hanging it on a stopped loop
@@ -870,6 +875,16 @@ class RemoteInfEngine(InferenceEngine):
             self._push_session_obj = self._new_session()
         return self._push_session_obj
 
+    def _get_push_executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._push_lock:
+            if self._push_executor is None:
+                self._push_executor = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="weight-push-prep"
+                )
+            return self._push_executor
+
     def _close_push_loop(self):
         with self._push_lock:
             loop, thread = self._push_loop, self._push_thread
@@ -877,6 +892,10 @@ class RemoteInfEngine(InferenceEngine):
             self._push_thread = None
             session = self._push_session_obj
             self._push_session_obj = None
+            push_executor = self._push_executor
+            self._push_executor = None
+        if push_executor is not None:
+            push_executor.shutdown(wait=False, cancel_futures=True)
         if loop is None:
             return
         for fut in list(self._push_futures):
@@ -946,6 +965,8 @@ class RemoteInfEngine(InferenceEngine):
         def _next(it):
             return next(it, None)
 
+        pool = self._get_push_executor()
+
         async def produce():
             nonlocal n_chunks
             cancelled = False
@@ -959,17 +980,17 @@ class RemoteInfEngine(InferenceEngine):
                 # producer below serializes fetch and prepare otherwise
                 prefetch = PrefetchIterator(chunks, depth=1)
                 it = iter(prefetch)
-                cur = await loop.run_in_executor(None, _next, it)
+                cur = await loop.run_in_executor(pool, _next, it)
                 if cur is None:
                     raise AssertionError("no weight chunks to send")
                 idx = 0
                 while cur is not None:
                     if len(failed) == len(targets):
                         return  # every stream is dead; stop gathering
-                    nxt = await loop.run_in_executor(None, _next, it)
+                    nxt = await loop.run_in_executor(pool, _next, it)
                     final = nxt is None
                     item = await loop.run_in_executor(
-                        None, prepare, idx, cur, final
+                        pool, prepare, idx, cur, final
                     )
                     pending[idx] = [len(targets), item, True]
                     for q in queues.values():

@@ -161,6 +161,21 @@ class WorkflowExecutor:
             "areal_rollout_wait_calls_total",
             "wait() slices (including prepare_batch retry slices)",
         )
+        # turn-level staleness accounting (agentic workflow plane): how
+        # far behind the CURRENT weights each accepted episode already is
+        # at acceptance, and whether it spans a weight commit — the
+        # per-episode view the batch-level rl_health version-mix fraction
+        # aggregates away
+        self._episode_lag = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_episode_version_lag",
+            "current weight version minus an accepted episode's oldest "
+            "generated-token version",
+        )
+        self._episode_mixed = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_episodes_by_version_mix",
+            "accepted episodes by whether their tokens span >1 weight version",
+            labels=("mixed",),
+        )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -330,6 +345,7 @@ class WorkflowExecutor:
                                 "output queue full; increase queue_size"
                             ) from None
                         self.staleness_manager.on_rollout_accepted()
+                        self._note_episode_staleness(traj)
                     else:
                         self.staleness_manager.on_rollout_rejected()
                     if self.config.enable_rollout_tracing:
@@ -362,6 +378,27 @@ class WorkflowExecutor:
                 for t in asyncio.all_tasks()
                 if t is not cur and not t.done() and t not in _BACKGROUND_TASKS
             )
+
+    def _note_episode_staleness(self, traj) -> None:
+        """Per-accepted-episode version accounting: one numpy pass over
+        the row's ``versions`` (already host-resident), never per token."""
+        try:
+            versions = traj.get("versions") if isinstance(traj, dict) else None
+            if versions is None:
+                return
+            arr = np.asarray(versions)
+            real = arr[arr >= 0]  # -1 marks prompt/observation tokens
+            if not real.size:
+                return
+            lo, hi = int(real.min()), int(real.max())
+            self._episode_lag.observe(
+                max(0, self.inference_engine.get_version() - lo)
+            )
+            self._episode_mixed.labels(
+                mixed="yes" if hi > lo else "no"
+            ).inc()
+        except Exception:
+            logger.debug("episode staleness accounting failed", exc_info=True)
 
     async def _traced_episode(self, rid: int, x: _TaskInput):
         """Run one episode under a fresh ``rollout`` trace. The span is

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 from aiohttp import web
@@ -120,6 +121,19 @@ class GenerationServer:
             ]
         )
         self._runner: web.AppRunner | None = None
+        # blocking engine work (pause fences, weight staging/commits) runs
+        # on this server-owned bounded executor, NEVER the event loop's
+        # default pool — a wedged weight stage must not be able to starve
+        # whatever else the process offloads (unbounded-default-executor
+        # lint rule). Two threads: one staging stream + one fence.
+        self._blocking = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="genserver-blocking"
+        )
+
+    async def _offload(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._blocking, fn, *args
+        )
 
     # -- handlers -------------------------------------------------------
 
@@ -265,7 +279,7 @@ class GenerationServer:
         return web.json_response({"success": True})
 
     async def pause(self, request: web.Request) -> web.Response:
-        await asyncio.get_running_loop().run_in_executor(None, self.engine.pause)
+        await self._offload(self.engine.pause)
         return web.json_response({"success": True})
 
     async def resume(self, request: web.Request) -> web.Response:
@@ -320,9 +334,7 @@ class GenerationServer:
                 if final and tag is not None:
                     self.engine.commit_staged_weights(tag)
 
-            await asyncio.get_running_loop().run_in_executor(
-                None, stage_and_maybe_commit
-            )
+            await self._offload(stage_and_maybe_commit)
         except Exception as e:
             logger.exception("update_weights_from_tensor failed")
             return web.json_response(
@@ -384,9 +396,7 @@ class GenerationServer:
                 if final and tag is not None:
                     self.engine.commit_staged_weights(tag)
 
-            await asyncio.get_running_loop().run_in_executor(
-                None, load_and_apply
-            )
+            await self._offload(load_and_apply)
         except Exception as e:
             logger.exception("update_weights_from_shm failed")
             return web.json_response(
@@ -411,8 +421,7 @@ class GenerationServer:
         version = request.query.get("version")
         try:
             arrs = wire.decode_named(st_load(body))
-            await asyncio.get_running_loop().run_in_executor(
-                None,
+            await self._offload(
                 self.engine.update_lora_from_named_arrays,
                 arrs,
                 scale,
@@ -434,8 +443,7 @@ class GenerationServer:
         broadcast role) and applies. final=1 commits the version."""
         payload = await request.json()
         try:
-            await asyncio.get_running_loop().run_in_executor(
-                None,
+            await self._offload(
                 self.engine.update_weights_from_device_pull,
                 payload["address"],
                 int(payload["uuid"]),
@@ -461,8 +469,8 @@ class GenerationServer:
         path = body["model_path"]
         version = body.get("version")
         try:
-            await asyncio.get_running_loop().run_in_executor(
-                None, self.engine.update_weights_from_disk, path, version
+            await self._offload(
+                self.engine.update_weights_from_disk, path, version
             )
         except Exception as e:
             logger.exception("update_weights_from_disk failed")
@@ -489,4 +497,5 @@ class GenerationServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        self._blocking.shutdown(wait=False, cancel_futures=True)
         self.engine.stop()

@@ -127,6 +127,75 @@ def _spawn_servers(cfg, alloc: AllocationMode) -> list:
     return procs
 
 
+def _reward_service_argv(cfg, index: int = 0) -> list[str]:
+    from areal_tpu.api.cli_args import to_dict
+
+    rs = cfg.reward_service
+    # a fixed port with replicas > 1 would make every replica after the
+    # first fail to bind at boot; offset per replica (0 = free port each)
+    port = rs.port + index if rs.port else 0
+    return [
+        sys.executable,
+        "-m",
+        "areal_tpu.reward_service.service",
+        *_flatten("reward_service", to_dict(rs)),
+        f"experiment_name={cfg.experiment_name}",
+        f"trial_name={cfg.trial_name}",
+        f"name_resolve.type={cfg.cluster.name_resolve.type}",
+        f"name_resolve.nfs_record_root={cfg.cluster.name_resolve.nfs_record_root}",
+        f"reward_service.port={port}",
+    ]
+
+
+def _spawn_reward_services(cfg) -> list:
+    """Reward-service replicas ride alongside the inference servers
+    (``reward_service.enabled``): same trial, same name_resolve, one
+    process per replica. The trainer-side RewardServiceClient discovers
+    them under ``names.reward_services``; a replica death does NOT fail
+    the trial (the client falls back to its local pool) — the monitor
+    loop respawns it instead."""
+    rs = getattr(cfg, "reward_service", None)
+    if rs is None or not rs.enabled:
+        return []
+    procs = []
+    for i in range(max(1, rs.replicas)):
+        procs.append(_spawn_one_reward_service(cfg, i))
+    return procs
+
+
+#: a replica surviving this long resets its crash counter
+_REWARD_RESPAWN_RESET_SECONDS = 60.0
+#: consecutive fast crashes before the launcher stops respawning a replica
+_REWARD_RESPAWN_MAX_CRASHES = 5
+
+
+def _spawn_one_reward_service(cfg, index: int):
+    env = dict(os.environ)
+    env["AREAL_REWARD_SERVICE_ID"] = f"reward{index}"
+    argv = _reward_service_argv(cfg, index)
+    logger.info("spawning reward service %d: %s", index, " ".join(argv[3:]))
+    p = subprocess.Popen(argv, env=env)
+    p.areal_reward_index = index
+    p.areal_spawned_at = time.monotonic()
+    return p
+
+
+def _wait_reward_addrs(cfg, n_services: int, timeout: float = 120.0) -> list[str]:
+    if n_services <= 0:
+        return []
+    key = names.reward_services(cfg.experiment_name, cfg.trial_name)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        addrs = name_resolve.get_subtree(key)
+        if len(addrs) >= n_services:
+            return sorted(addrs)
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"only {len(name_resolve.get_subtree(key))}/{n_services} reward "
+        "services registered"
+    )
+
+
 def _server_drained(cfg, proc) -> bool:
     """A dead server process whose name_resolve registration is GONE was
     drained on purpose (elastic scale-in deregisters before exit) — the
@@ -242,10 +311,29 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
 
     alloc = AllocationMode.from_str(cfg.allocation_mode)
     servers = _spawn_servers(cfg, alloc)
-    procs = list(servers)
+    reward_services = _spawn_reward_services(cfg)
+    reward_crashes: dict[int, int] = {}
+    reward_respawn_at: dict[int, float] = {}
+    procs = list(servers) + list(reward_services)
     try:
         addrs = _wait_server_addrs(cfg, len(servers))
         logger.info("servers up: %s", addrs)
+        if reward_services:
+            # NON-fatal: a replica that crashes at boot must not kill the
+            # trial (the contract is that the client falls back to its
+            # local pool) — the monitor loop below respawns with backoff
+            try:
+                logger.info(
+                    "reward services up: %s",
+                    _wait_reward_addrs(cfg, len(reward_services)),
+                )
+            except TimeoutError as e:
+                logger.error(
+                    "reward services incomplete at boot (%s); trial "
+                    "continues on the local-pool fallback while the "
+                    "monitor loop respawns them",
+                    e,
+                )
         trainers = _spawn_trainer(cfg, entry, config_argv, addrs, run_id)
         procs.extend(trainers)
         while True:
@@ -273,6 +361,48 @@ def run_trial(entry: str, config_argv: list[str], run_id: int) -> int:
                         continue
                     logger.error("server died with rc=%s; failing trial", s.poll())
                     return s.poll() or 1
+            for r in list(reward_services):
+                if r.poll() is not None:
+                    # a reward replica is NOT load-bearing for liveness
+                    # (the client falls back to its local pool); respawn
+                    # it in place instead of failing the trial — but with
+                    # backoff, and give up after repeated instant exits
+                    # (a deterministic boot crash would otherwise fork an
+                    # interpreter per monitor tick for the whole trial)
+                    idx = getattr(r, "areal_reward_index", 0)
+                    lived = time.monotonic() - getattr(
+                        r, "areal_spawned_at", 0.0
+                    )
+                    crashes = (
+                        0 if lived >= _REWARD_RESPAWN_RESET_SECONDS
+                        else reward_crashes.get(idx, 0) + 1
+                    )
+                    reward_crashes[idx] = crashes
+                    reward_services.remove(r)
+                    procs.remove(r)
+                    if crashes > _REWARD_RESPAWN_MAX_CRASHES:
+                        logger.error(
+                            "reward service %d crashed %d times in quick "
+                            "succession (rc=%s); giving up on this replica "
+                            "— the trainer continues on the local-pool "
+                            "fallback",
+                            idx, crashes, r.poll(),
+                        )
+                        continue
+                    delay = relaunch_backoff(crashes, 1.0, 30.0)
+                    logger.warning(
+                        "reward service %d died with rc=%s (lived %.0fs); "
+                        "respawning in %.1fs (crash %d/%d)",
+                        idx, r.poll(), lived, delay, crashes,
+                        _REWARD_RESPAWN_MAX_CRASHES,
+                    )
+                    reward_respawn_at[idx] = time.monotonic() + delay
+            for idx, when in list(reward_respawn_at.items()):
+                if time.monotonic() >= when:
+                    del reward_respawn_at[idx]
+                    fresh = _spawn_one_reward_service(cfg, idx)
+                    reward_services.append(fresh)
+                    procs.append(fresh)
             time.sleep(1.0)
     finally:
         _kill(procs, grace=max(cfg.recover.grace_period_seconds, 1.0))

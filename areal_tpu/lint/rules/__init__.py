@@ -4,6 +4,7 @@ from areal_tpu.lint.rules import (  # noqa: F401
     async_discipline,
     donation,
     exceptions,
+    executors,
     fs_discipline,
     jax_compat,
     jit_discipline,

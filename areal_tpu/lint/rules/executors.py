@@ -1,0 +1,56 @@
+"""Executor discipline.
+
+unbounded-default-executor: ``loop.run_in_executor(None, ...)`` offloads
+onto the event loop's DEFAULT thread pool — one shared, anonymous pool
+per loop. Any call that can wedge (sandboxed code execution, network-ish
+filesystem, engine fences) then occupies a default-pool thread with no
+owner and no bound the caller controls: once ``min(32, cpus+4)`` such
+calls hang, EVERY ``run_in_executor(None, ...)`` user in the process
+queues behind them — the exact failure mode where one stuck reward batch
+stalled every concurrent workflow's tool calls. Offload to an executor
+the subsystem OWNS (bounded, named, shut down with its owner):
+``SandboxWorkerPool`` for untrusted code, a module-scoped
+``ThreadPoolExecutor(max_workers=..., thread_name_prefix=...)`` for
+blocking engine work.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import FileContext, Finding, Rule, register
+
+
+@register
+class UnboundedDefaultExecutorRule(Rule):
+    id = "unbounded-default-executor"
+    doc = (
+        "run_in_executor(None, ...) shares the loop's unbounded default "
+        "thread pool; a wedged call starves every other user of it"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr == "run_in_executor"
+            ):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and first.value is None:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "run_in_executor(None, ...) uses the event loop's "
+                    "default thread pool — unbounded sharing means one "
+                    "wedged call starves every other offload in the "
+                    "process; pass an executor this subsystem owns (a "
+                    "bounded ThreadPoolExecutor, or the reward plane's "
+                    "SandboxWorkerPool for untrusted code)",
+                )

@@ -4,10 +4,12 @@ The reference offloads code-verification to a FaaS sandbox service and fans
 out HTTP calls at up to 1500-way concurrency with retries/backoff and
 latency accounting (functioncall/base/call.py:160, functioncall/code/
 verify.py). TPU pods often run zero-egress, so this client is GATED: with
-no service URL configured the local rlimit sandbox (reward/sandbox.py) is
-the production path, and ``code_verify_batch`` transparently falls back to
-it. When a sandbox service IS reachable, reward throughput stops being
-capped by local cores.
+no service URL configured the local sandbox is the production path —
+the bounded worker pool (``reward_service/pool.py``) when one is active,
+the per-call rlimit fork otherwise — and ``code_verify_batch``
+transparently falls back to it. ``url`` can point at an external FaaS OR
+at an in-repo reward-service replica's ``/run_batch`` endpoint
+(``areal_tpu/reward_service/service.py`` speaks exactly this schema).
 
 Payload/result schema (reference-compatible):
   request:  {uid, language, code, entryFunction, testcases: [{input,
@@ -55,8 +57,9 @@ def _failure(uid: str, reason: str) -> dict:
 
 
 async def _invoke_one(
-    session, cfg: RemoteSandboxConfig, payload: dict
+    session, cfg: RemoteSandboxConfig, payload: dict, sleep=None
 ) -> dict:
+    sleep = sleep if sleep is not None else asyncio.sleep
     uid = payload.get("uid", "")
     for attempt in range(cfg.max_retries):
         try:
@@ -83,7 +86,7 @@ async def _invoke_one(
                 "sandbox call failed (uid=%s attempt %d): %s",
                 uid, attempt + 1, e,
             )
-        await asyncio.sleep(
+        await sleep(
             min(
                 cfg.initial_retry_interval * (2**attempt)
                 + random.uniform(0, 0.5),
@@ -94,7 +97,7 @@ async def _invoke_one(
 
 
 async def batch_call_async(
-    payloads: Sequence[dict], cfg: RemoteSandboxConfig
+    payloads: Sequence[dict], cfg: RemoteSandboxConfig, sleep=None
 ) -> list[dict]:
     """Fan out every payload with bounded concurrency; returns results in
     payload order (failures become failure records, never exceptions)."""
@@ -111,7 +114,7 @@ async def batch_call_async(
         async def limited(p):
             async with sem:
                 t0 = time.monotonic()
-                r = await _invoke_one(session, cfg, p)
+                r = await _invoke_one(session, cfg, p, sleep=sleep)
                 t_each.append(time.monotonic() - t0)
                 return r
 
@@ -126,9 +129,9 @@ async def batch_call_async(
 
 
 def batch_call(
-    payloads: Sequence[dict], cfg: RemoteSandboxConfig
+    payloads: Sequence[dict], cfg: RemoteSandboxConfig, sleep=None
 ) -> list[dict]:
-    return asyncio.run(batch_call_async(payloads, cfg))
+    return asyncio.run(batch_call_async(payloads, cfg, sleep=sleep))
 
 
 # ---------------------------------------------------------------------------
@@ -191,8 +194,13 @@ def code_verify_batch(
     assert len(generateds) == len(query_ids)
     cfg = cfg or RemoteSandboxConfig()
     if not cfg.url:
-        from areal_tpu.reward.sandbox import code_verify_reward
+        from areal_tpu.reward.sandbox import code_verify_reward, pooled_exec_fn
+        from areal_tpu.reward_service.pool import default_pool_active
 
+        # zero-egress fallback rides the bounded worker pool when one is
+        # already up (persistent workers beat a fork per snippet); a
+        # process with no pool keeps per-call fork semantics
+        exec_fn = pooled_exec_fn() if default_pool_active() else None
         out = []
         for qid, gen in zip(query_ids, generateds):
             info = id2info[qid]
@@ -205,7 +213,7 @@ def code_verify_batch(
                     io_spec.get("inputs", []), io_spec.get("outputs", [])
                 )
             ]
-            r = code_verify_reward(None, gen, testcases=cases)
+            r = code_verify_reward(None, gen, testcases=cases, exec_fn=exec_fn)
             out.append(int(r >= 1.0))
         return out
     payloads = _build_payloads(id2info, query_ids, generateds, cfg)

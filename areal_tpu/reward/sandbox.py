@@ -46,28 +46,61 @@ def run_sandboxed(
 ) -> tuple[str, bool]:
     """Execute ``code`` in an isolated python subprocess.
 
-    Returns (stdout+stderr tail, succeeded). Wall timeout kills the process;
-    rlimits bound CPU/memory/files inside it.
+    Returns (stdout+stderr tail, succeeded). Wall timeout kills the
+    process's WHOLE process group: the child runs as a session leader
+    (``start_new_session=True``), so snippets that forked (RLIMIT_NPROC
+    permits 16 processes) cannot leave grandchildren running after the
+    deadline — ``subprocess.run(timeout=...)`` alone kills only the
+    direct child, and a looping grandchild would otherwise survive as an
+    orphan burning a core. Rlimits bound CPU/memory/files inside.
     """
     cpu_seconds = cpu_seconds or max(int(timeout), 1)
     with tempfile.TemporaryDirectory() as cwd:
         try:
-            proc = subprocess.run(
+            proc = subprocess.Popen(
                 [sys.executable, "-I", "-c", code],
-                input=(stdin or "").encode(),
+                stdin=subprocess.PIPE,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
-                timeout=timeout,
                 cwd=cwd,
                 env={"PATH": ""},
                 preexec_fn=_limits(memory_mb, cpu_seconds),
+                start_new_session=True,  # pgid == pid: killpg reaps forks
             )
-        except subprocess.TimeoutExpired:
-            return "execution timed out", False
         except Exception as e:  # spawn failure
             return f"sandbox error: {e}", False
-    text = proc.stdout.decode(errors="replace")[-4000:]
+        try:
+            out, _ = proc.communicate(
+                input=(stdin or "").encode(), timeout=timeout
+            )
+        except subprocess.TimeoutExpired:
+            _kill_group(proc)
+            return "execution timed out", False
+        except Exception as e:
+            _kill_group(proc)
+            return f"sandbox error: {e}", False
+    text = out.decode(errors="replace")[-4000:]
     return text, proc.returncode == 0
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the sandbox child's process group (child + any processes it
+    forked), then reap the child."""
+    import os
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    try:
+        proc.communicate(timeout=5)
+    except Exception:  # already killed; reap is best-effort
+        import logging
+
+        logging.getLogger("sandbox").debug(
+            "sandbox child reap failed", exc_info=True
+        )
 
 
 def extract_code(completion: str) -> str | None:
@@ -83,19 +116,48 @@ def code_verify_reward(
     completion_ids=None,
     testcases: list[dict] | None = None,
     timeout: float = 10.0,
+    exec_fn=None,
     **_kw,
 ) -> float:
     """Reward = fraction of (stdin -> expected stdout) testcases passed by
     the completion's final code block (functioncall/code/verify.py role;
-    run it through AsyncRewardWrapper like every reward fn)."""
+    run it through AsyncRewardWrapper like every reward fn).
+
+    ``exec_fn(code, stdin, timeout) -> (output, ok)`` swaps the execution
+    substrate: the default is the per-call fork above; the reward-service
+    pool plugs in its pooled workers here (``pooled_exec_fn``), and the
+    service-first path uses ``RewardServiceClient.code_reward_fn`` (async)
+    instead of this function entirely."""
     code = extract_code(completion or "")
     if code is None or not testcases:
         return 0.0
+    exec_fn = exec_fn or (
+        lambda c, s, t: run_sandboxed(c, stdin=s, timeout=t)
+    )
     passed = 0
     for case in testcases:
-        out, ok = run_sandboxed(
-            code, stdin=case.get("stdin", ""), timeout=timeout
-        )
+        out, ok = exec_fn(code, case.get("stdin", ""), timeout)
         if ok and out.strip() == str(case.get("expected_stdout", "")).strip():
             passed += 1
     return passed / len(testcases)
+
+
+def pooled_exec_fn(pool=None):
+    """An ``exec_fn`` running on the bounded reward-service worker pool
+    (persistent workers, fork-per-task) instead of a fresh interpreter
+    per call — the drop-in for sync reward fns on hot reward paths."""
+
+    def exec_fn(code: str, stdin: str, timeout: float) -> tuple[str, bool]:
+        from areal_tpu.reward_service.pool import (
+            PoolSaturated,
+            get_default_pool,
+        )
+
+        p = pool if pool is not None else get_default_pool()
+        try:
+            r = p.run(code, stdin=stdin, timeout=timeout)
+        except PoolSaturated as e:
+            return f"reward pool saturated: {e}", False
+        return r.output[-4000:], r.ok
+
+    return exec_fn

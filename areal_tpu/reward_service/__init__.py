@@ -1,0 +1,106 @@
+"""Sandboxed reward-execution plane: bounded worker pool, HTTP service,
+and breaker-fronted client (ROADMAP item 5 / reference ``functioncall/``).
+
+Process-global wiring lives here so call sites that cannot thread a
+client through their constructors (the tool env, sync reward fns) share
+one plane: ``configure(cfg, experiment, trial)`` installs it (the
+trainer entry point does this when ``reward_service`` is configured),
+``aexecute_code`` routes through it — service replicas when reachable,
+the local bounded pool otherwise — and an UNconfigured process still
+gets the bounded pool, never the event loop's default executor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from areal_tpu.reward_service.client import NoServiceAvailable, RewardServiceClient
+from areal_tpu.reward_service.pool import (
+    PoolSaturated,
+    SandboxResult,
+    SandboxWorkerPool,
+    get_default_pool,
+    shutdown_default_pool,
+)
+
+__all__ = [
+    "NoServiceAvailable",
+    "PoolSaturated",
+    "RewardServiceClient",
+    "SandboxResult",
+    "SandboxWorkerPool",
+    "aexecute_code",
+    "configure",
+    "get_client",
+    "get_default_pool",
+    "shutdown",
+    "shutdown_default_pool",
+]
+
+_CLIENT: RewardServiceClient | None = None
+_CLIENT_LOCK = threading.Lock()
+
+
+def configure(
+    cfg, experiment_name: str = "", trial_name: str = ""
+) -> RewardServiceClient | None:
+    """Install the process-global reward plane from a
+    :class:`~areal_tpu.api.cli_args.RewardServiceConfig`. With
+    ``enabled=False`` only the bounded local pool is (lazily) used and
+    None is returned; with ``enabled=True`` a client (service discovery +
+    breakers + local fallback) is installed and returned."""
+    global _CLIENT
+    with _CLIENT_LOCK:
+        if _CLIENT is not None:
+            _CLIENT.close_sync()  # release the replaced client's thread
+        if not getattr(cfg, "enabled", False):
+            _CLIENT = None
+            return None
+        _CLIENT = RewardServiceClient(
+            cfg, experiment_name=experiment_name, trial_name=trial_name
+        )
+        return _CLIENT
+
+
+def get_client() -> RewardServiceClient | None:
+    with _CLIENT_LOCK:
+        return _CLIENT
+
+
+async def aexecute_code(
+    code: str,
+    stdin: str = "",
+    timeout: float | None = None,
+    memory_mb: int | None = None,
+    uid: str = "",
+) -> SandboxResult:
+    """Execute one untrusted snippet on the reward plane: the configured
+    client (service-first) when installed and ``tool_execution`` allows
+    it, else the process-global bounded pool. Never touches the event
+    loop's default executor."""
+    client = get_client()
+    if client is not None and getattr(client.cfg, "tool_execution", True):
+        return await client.aexecute_code(
+            code, stdin=stdin, timeout=timeout, memory_mb=memory_mb, uid=uid
+        )
+    pool = get_default_pool()
+    try:
+        return await pool.arun(
+            code, stdin=stdin, timeout=timeout, memory_mb=memory_mb, uid=uid
+        )
+    except PoolSaturated as e:
+        return SandboxResult(
+            output=f"reward pool saturated: {e}", returncode=1, timed_out=True
+        )
+
+
+def shutdown() -> None:
+    """Tear down the global plane (tests; trainer exit). The client's
+    aiohttp sessions need their loop to close and are only dropped, but
+    its discovery thread is released for real; pools shut down fully."""
+    global _CLIENT
+    with _CLIENT_LOCK:
+        if _CLIENT is not None:
+            _CLIENT.close_sync()
+        _CLIENT = None
+    shutdown_default_pool()

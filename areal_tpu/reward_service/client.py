@@ -1,0 +1,433 @@
+"""Breaker-fronted client for the sandboxed reward-execution service.
+
+The reward plane's analog of ``RemoteInfEngine``'s request path, built
+from the same substrate so its failure behavior is uniform with the
+rollout plane's:
+
+- every HTTP call goes through ``arequest_with_retry`` (classified
+  retries, full-jitter backoff, Retry-After honored, total-deadline
+  budget) with the same ``chaos=`` hook, so reward-service faults are
+  rehearsed through the identical path a real outage takes;
+- a :class:`ServerHealthTracker` per replica: request outcomes feed the
+  sliding window, breakers trip OPEN on consecutive failures or windowed
+  failure rate, and OPEN replicas take zero traffic until a ``GET
+  /ready`` probe (rate-limited by the breaker config) moves them back
+  through HALF_OPEN;
+- replicas come from name_resolve discovery (``names.reward_services``)
+  or an explicit address list, refreshed every ``discovery_interval``;
+  routing is **least-inflight** among routable replicas;
+- when NO replica is configured, reachable, or routable, execution
+  **falls back transparently to the local bounded pool**
+  (``reward_service/pool.py``) — the zero-egress TPU pod path. The same
+  pool implementation backs the service's workers, so verdicts are
+  path-identical by construction (pinned by test).
+
+An episode whose reward call exhausts retries AND cannot fall back gets
+a failed verdict, never an exception into the workflow — a wedged reward
+batch costs its own episodes, not the rollout plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from areal_tpu.api.cli_args import RewardServiceConfig
+from areal_tpu.core.fault_tolerance import ServerHealthTracker
+from areal_tpu.reward_service.pool import (
+    SandboxResult,
+    SandboxWorkerPool,
+    get_default_pool,
+)
+from areal_tpu.utils import logging
+from areal_tpu.utils.http import HTTPRequestError, arequest_with_retry
+
+logger = logging.getLogger("reward_client")
+
+
+class NoServiceAvailable(RuntimeError):
+    """No replica is routable and local fallback is disabled."""
+
+
+class RewardServiceClient:
+    """See the module docstring. Thread-compat: one client is used from
+    one event loop (the rollout thread); discovery refresh and breaker
+    state are lock-protected for the odd cross-thread inspection."""
+
+    def __init__(
+        self,
+        cfg: RewardServiceConfig | None = None,
+        experiment_name: str = "",
+        trial_name: str = "",
+        addresses: list[str] | None = None,
+        session_factory=None,
+        pool: SandboxWorkerPool | None = None,
+        chaos=None,
+        clock=time.monotonic,
+    ):
+        self.cfg = cfg or RewardServiceConfig()
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._addresses: list[str] = list(
+            addresses if addresses is not None else self.cfg.addresses
+        )
+        self._explicit = bool(addresses) or bool(self.cfg.addresses)
+        self._last_refresh = 0.0
+        self._inflight: dict[str, int] = {}  # guarded_by: _lock
+        self._health = ServerHealthTracker(self.cfg.breaker, clock=clock)
+        # one session PER EVENT LOOP (the executor's rollout loop dies
+        # and is replaced across engine restarts; an aiohttp session is
+        # bound to the loop it was created on)
+        self._sessions: dict[int, object] = {}
+        self._session_factory = session_factory
+        # discovery I/O (blocking NFS reads) runs here, never inline on
+        # the rollout event loop and never on the loop's default executor
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._discovery_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="reward-discovery"
+        )
+        self._local_pool = pool
+        if chaos is None:
+            from areal_tpu.utils.chaos import ChaosPolicy
+
+            chaos = ChaosPolicy.from_config(self.cfg.chaos)
+        self._chaos = chaos
+
+        from areal_tpu.utils import metrics as _metrics
+
+        reg = _metrics.DEFAULT_REGISTRY
+        self._m_calls = reg.counter(
+            "areal_reward_service_calls_total",
+            "client->reward-service calls by outcome",
+            labels=("outcome",),
+        )
+        self._m_fallbacks = reg.counter(
+            "areal_reward_fallback_total",
+            "reward executions served by the local pool fallback",
+            labels=("reason",),
+        )
+
+    # ----------------------------------------------------------- membership
+
+    async def _refresh_addresses(self) -> None:
+        """name_resolve discovery (skipped for explicit address lists),
+        throttled to ``discovery_interval`` WHETHER OR NOT any replica is
+        currently known — an empty list must not turn every reward call
+        into a resolve — and run off-loop on the client's own
+        single-thread executor: ``get_subtree`` is blocking NFS I/O, and
+        inline it would stall every concurrent episode's await (the
+        event-loop-wedge class this subsystem exists to remove). A
+        transient empty/failed resolve keeps the previous membership."""
+        if self._explicit or not self.experiment_name:
+            return
+        now = self._clock()
+        with self._lock:
+            if now - self._last_refresh < self.cfg.discovery_interval and (
+                self._addresses or self._last_refresh > 0
+            ):
+                return
+            self._last_refresh = now
+        import asyncio
+
+        from areal_tpu.utils import name_resolve, names
+
+        key = names.reward_services(self.experiment_name, self.trial_name)
+        try:
+            addrs = sorted(
+                await asyncio.get_running_loop().run_in_executor(
+                    self._discovery_executor, name_resolve.get_subtree, key
+                )
+            )
+        except Exception as e:
+            logger.debug("reward-service discovery failed: %s", e)
+            return
+        if not addrs:
+            return
+        with self._lock:
+            for gone in set(self._addresses) - set(addrs):
+                self._health.forget(gone)
+                self._inflight.pop(gone, None)
+            self._addresses = addrs
+
+    def addresses(self) -> list[str]:
+        with self._lock:
+            return list(self._addresses)
+
+    # -------------------------------------------------------------- routing
+
+    def _choose(self) -> str | None:
+        """Least-inflight among breaker-routable replicas; None when no
+        replica may take traffic (the caller falls back locally — unlike
+        generation, a reward ALWAYS has a local fallback, so there is no
+        least-bad forced routing here)."""
+        with self._lock:
+            candidates = [
+                a for a in self._addresses if self._health.routable(a)
+            ]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda a: self._inflight.get(a, 0))
+
+    async def _probe_open(self) -> None:
+        """Inline /ready probe of OPEN replicas past their cooldown
+        (candidates are rate-limited by the breaker's probe interval, so
+        this usually does nothing). Inline — not a background task — so
+        the client has no loop-lifecycle to manage; a probe costs one
+        bounded GET on the request path that needed it."""
+        candidates = self._health.probe_candidates()
+        if not candidates:
+            return
+        import aiohttp
+
+        session = await self._get_session()
+        timeout = self.cfg.breaker.probe_timeout_seconds
+        for addr in candidates:
+            ok = False
+            try:
+                async with session.get(
+                    f"http://{addr}/ready",
+                    timeout=aiohttp.ClientTimeout(total=timeout),
+                ) as resp:
+                    ok = resp.status == 200
+            except Exception as e:
+                logger.debug("reward-service probe of %s failed: %s", addr, e)
+            self._health.on_probe_result(addr, ok)
+
+    async def _get_session(self):
+        import asyncio
+
+        key = id(asyncio.get_running_loop())
+        session = self._sessions.get(key)
+        if session is None or getattr(session, "closed", False):
+            if self._session_factory is not None:
+                session = self._session_factory()
+            else:
+                import aiohttp
+
+                session = aiohttp.ClientSession()
+            self._sessions[key] = session
+        return session
+
+    async def close(self) -> None:
+        """Close the CURRENT loop's session; sessions stranded on dead
+        loops cannot be awaited from here and are dropped."""
+        import asyncio
+
+        key = id(asyncio.get_running_loop())
+        session = self._sessions.pop(key, None)
+        if session is not None:
+            try:
+                await session.close()
+            except Exception:
+                logger.debug("reward client session close failed", exc_info=True)
+        self._sessions.clear()
+        self._discovery_executor.shutdown(wait=False, cancel_futures=True)
+
+    def close_sync(self) -> None:
+        """Loop-less teardown for callers with no running event loop (the
+        global plane's reconfigure/shutdown): releases the discovery
+        thread — which needs no loop — and drops session references
+        (loop-bound; they cannot be awaited from here)."""
+        self._discovery_executor.shutdown(wait=False, cancel_futures=True)
+        self._sessions.clear()
+
+    # ------------------------------------------------------------ local pool
+
+    def _pool(self) -> SandboxWorkerPool:
+        if self._local_pool is None:
+            self._local_pool = get_default_pool(self.cfg)
+        return self._local_pool
+
+    async def _fallback_execute(self, reason: str, code, stdin, timeout,
+                                memory_mb, uid) -> SandboxResult:
+        if not self.cfg.fallback_local:
+            raise NoServiceAvailable(
+                f"no reward-service replica available ({reason}) and "
+                "fallback_local is disabled"
+            )
+        self._m_fallbacks.labels(reason=reason).inc()
+        from areal_tpu.reward_service.pool import PoolSaturated
+
+        try:
+            return await self._pool().arun(
+                code, stdin=stdin, timeout=timeout, memory_mb=memory_mb,
+                uid=uid,
+            )
+        except PoolSaturated as e:
+            # bounded by design: saturation is a failed verdict for THIS
+            # task, never an unbounded queue or an exception into the
+            # workflow
+            return SandboxResult(
+                output=f"reward pool saturated: {e}", returncode=1,
+                timed_out=True,
+            )
+
+    # ------------------------------------------------------------- requests
+
+    def _trace_headers(self) -> dict[str, str] | None:
+        from areal_tpu.utils import tracing
+
+        span = tracing.current_span()
+        if span is None:
+            return None
+        return {tracing.TRACE_HEADER: span.header()}
+
+    async def _post(self, addr: str, path: str, payload: dict) -> dict:
+        session = await self._get_session()
+        with self._lock:
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+        self._health.on_request_start(addr)
+        t0 = self._clock()
+        try:
+            out = await arequest_with_retry(
+                session,
+                f"http://{addr}{path}",
+                payload=payload,
+                max_retries=self.cfg.request_retries,
+                timeout=self.cfg.request_timeout,
+                total_timeout=self.cfg.total_timeout or None,
+                chaos=self._chaos,
+                headers=self._trace_headers(),
+            )
+            self._health.on_request_end(addr, True, self._clock() - t0)
+            self._m_calls.labels(outcome="ok").inc()
+            return out
+        except BaseException as e:
+            if isinstance(e, Exception):
+                self._health.on_request_end(
+                    addr, False, self._clock() - t0, error=str(e)
+                )
+                self._m_calls.labels(outcome="error").inc()
+            else:  # cancellation: no usable outcome, release probe slots
+                self._health.on_request_abandoned(addr)
+            raise
+        finally:
+            with self._lock:
+                self._inflight[addr] = max(0, self._inflight.get(addr, 1) - 1)
+
+    async def aexecute_code(
+        self,
+        code: str,
+        stdin: str = "",
+        timeout: float | None = None,
+        memory_mb: int | None = None,
+        uid: str = "",
+    ) -> SandboxResult:
+        """Execute one snippet on the reward plane: service replica when
+        routable, local bounded pool otherwise. Always returns a verdict."""
+        timeout = timeout if timeout is not None else self.cfg.task_timeout
+        await self._refresh_addresses()
+        await self._probe_open()
+        addr = self._choose()
+        if addr is None:
+            reason = "no_replicas" if not self.addresses() else "breaker_open"
+            return await self._fallback_execute(
+                reason, code, stdin, timeout, memory_mb, uid
+            )
+        try:
+            out = await self._post(
+                addr,
+                "/run",
+                {
+                    "code": code,
+                    "stdin": stdin,
+                    "timeout": timeout,
+                    "memory_mb": memory_mb,
+                    "uid": uid,
+                },
+            )
+        except HTTPRequestError as e:
+            logger.warning(
+                "reward-service call to %s failed (%s); falling back", addr, e
+            )
+            return await self._fallback_execute(
+                "request_failed", code, stdin, timeout, memory_mb, uid
+            )
+        return SandboxResult(
+            output=str(out.get("output", "")),
+            returncode=int(out.get("returncode", 1)),
+            timed_out=bool(out.get("timed_out", False)),
+            duration=float(out.get("duration", 0.0)),
+            truncated=bool(out.get("truncated", False)),
+        )
+
+    async def averify(self, payload: dict) -> dict:
+        """One reference functioncall batch verification; response schema
+        ``{uid, success, results}`` whether served remotely or locally."""
+        await self._refresh_addresses()
+        await self._probe_open()
+        addr = self._choose()
+        if addr is not None:
+            try:
+                return await self._post(addr, "/run_batch", payload)
+            except HTTPRequestError as e:
+                if not self.cfg.fallback_local:
+                    # a host with fallback disabled must NEVER execute
+                    # untrusted code locally, failed replica or not
+                    raise NoServiceAvailable(
+                        f"reward-service verify on {addr} failed and "
+                        "fallback_local is disabled"
+                    ) from e
+                logger.warning(
+                    "reward-service verify on %s failed (%s); falling back",
+                    addr, e,
+                )
+        elif not self.cfg.fallback_local:
+            raise NoServiceAvailable(
+                "no reward-service replica available and fallback_local "
+                "is disabled"
+            )
+        self._m_fallbacks.labels(
+            reason="request_failed" if addr is not None else "no_replicas"
+        ).inc()
+        from areal_tpu.reward_service.service import averify_payload
+
+        return await averify_payload(
+            self._pool(), payload, default_timeout=self.cfg.task_timeout
+        )
+
+    # ---------------------------------------------------------- reward fns
+
+    def code_reward_fn(self, fast_fail: bool = True):
+        """An ASYNC reward function (AsyncRewardWrapper awaits it
+        natively): extract the completion's final fenced code block, run
+        it against the item's testcases through the reward plane, reward
+        = fraction of cases passed (the ``code_verify_reward``
+        contract, service-backed)."""
+
+        async def reward(
+            prompt, completion, prompt_ids, completion_ids,
+            testcases: list[dict] | None = None, **kw,
+        ) -> float:
+            from areal_tpu.reward.sandbox import extract_code
+
+            code = extract_code(completion or "")
+            if code is None or not testcases:
+                return 0.0
+            resp = await self.averify(
+                {
+                    "uid": kw.get("uid", ""),
+                    "language": "PYTHON",
+                    "code": code,
+                    "isFastFail": fast_fail,
+                    "testcases": [
+                        {
+                            "input": c.get("stdin", c.get("input", "")),
+                            "expectedOutput": c.get(
+                                "expected_stdout", c.get("expectedOutput", "")
+                            ),
+                        }
+                        for c in testcases
+                    ],
+                    "timeout": self.cfg.task_timeout,
+                }
+            )
+            results = resp.get("results") or []
+            if not results:
+                return 1.0 if resp.get("success") else 0.0
+            return sum(1 for r in results if r.get("success")) / len(results)
+
+        return reward

@@ -1,0 +1,764 @@
+"""Bounded pool of persistent sandboxed worker subprocesses.
+
+The per-call sandbox (``reward/sandbox.py``) pays a full interpreter
+startup per snippet and — worse — was offloaded onto the event loop's
+DEFAULT thread pool by the tool plane, so one wedged reward batch could
+starve every concurrent workflow. This pool is the shared execution
+substrate for the whole reward plane:
+
+- **persistent workers** — each worker is a ``python -I`` subprocess
+  (empty env, isolated mode) started in its OWN session
+  (``start_new_session=True``), running a tiny fork-per-task loop: the
+  task's code executes in a freshly forked child with the rlimits from
+  ``reward/sandbox.py`` (CPU seconds, address space, file size,
+  descriptors, NPROC), a throwaway working directory, and stdin/stdout
+  redirected — fresh-interpreter semantics at fork cost (~1ms) instead
+  of spawn cost (~40ms), and a snippet calling ``exit()`` (models do)
+  never costs a respawn;
+- **process-group kill** — the pool enforces every per-task wall
+  deadline itself: a worker that misses its response deadline gets
+  ``killpg(SIGKILL)`` on its process group, which reaps the task child
+  AND any grandchildren the task forked (they inherit the worker's
+  pgid), then a fresh worker replaces it. ``subprocess.run(timeout=)``
+  kills only the direct child — the exact orphan hazard this replaces;
+- **recycling** — a worker retires after ``recycle_after`` tasks
+  (drain-and-respawn), bounding fd/memory creep and the blast radius of
+  any in-worker state a hostile task managed to touch (the task runs in
+  a forked child, so the worker's own interpreter is never directly
+  exposed to task code — but paranoia is cheap here);
+- **bounded admission** — at most ``max_pending`` tasks in flight or
+  queued; beyond that ``submit`` raises :class:`PoolSaturated` with a
+  load-derived ``retry_after`` hint (the service turns this into
+  429 + Retry-After — never unbounded memory);
+- **own executor** — the async facade (:meth:`SandboxWorkerPool.arun`)
+  runs on the pool's OWN thread pool, never the loop default, so a
+  wedged sandbox call can only ever occupy a pool slot.
+
+Isolation model (same contract as ``reward/sandbox.py``): os-level, not
+a jail. A task can ``os.setsid`` to escape the kill group or write to
+inherited descriptors it guesses; pair with container sandboxing for
+adversarial workloads. ``docs/rewards.md`` spells out the limits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import queue
+import selectors
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("reward_pool")
+
+#: default per-task wall deadline (seconds); mirrors reward/sandbox.py
+DEFAULT_TIMEOUT = 10.0
+
+#: extra wall allowance past the task timeout before the process-group
+#: kill — covers fork + result serialization on a loaded host
+KILL_GRACE = 2.0
+
+#: bytes of task stdout+stderr the worker keeps (tail semantics applied
+#: by the caller; the cap bounds pipe traffic, not the verdict)
+OUTPUT_CAP = 65536
+
+
+#: monotonically increasing uid suffix for anonymous tasks
+_TASK_IDS = itertools.count()
+
+
+class PoolSaturated(RuntimeError):
+    """Admission refused: the pool's pending bound is full. ``retry_after``
+    is a load-derived backoff hint (seconds) for 429 responses."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class WorkerDied(RuntimeError):
+    """The worker process exited mid-protocol (task crashed it, or it was
+    externally killed). The pool replaces it and reports a failure verdict
+    for the in-flight task."""
+
+
+@dataclasses.dataclass
+class SandboxResult:
+    """Verdict for one sandboxed execution. ``ok`` mirrors the per-call
+    sandbox contract: clean exit AND not timed out."""
+
+    output: str = ""
+    returncode: int = 1
+    timed_out: bool = False
+    duration: float = 0.0
+    truncated: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0 and not self.timed_out
+
+
+# ---------------------------------------------------------------------------
+# worker-side program (runs under `python -I -c`, empty env)
+# ---------------------------------------------------------------------------
+
+# Protocol: one JSON line per task on the worker's stdin, one JSON line per
+# result on its stdout. The forked task child gets its OWN fds (stdin from
+# a per-task file, stdout+stderr into a per-task pipe), so untrusted code
+# never holds the protocol descriptors. The worker never enforces wall
+# deadlines — that is the pool's job, by process-group kill, so a worker
+# wedged by a misbehaving task (e.g. a grandchild pinning the output pipe
+# open) is recoverable by construction.
+_WORKER_SOURCE = r"""
+import json, os, resource, shutil, sys, tempfile, time
+
+
+def _run_child(task, task_dir, stdin_path, w_out):
+    # forked task child: fresh namespace, redirected io, rlimits, then exec
+    try:
+        fd0 = os.open(stdin_path, os.O_RDONLY)
+        os.dup2(fd0, 0)
+        os.dup2(w_out, 1)
+        os.dup2(w_out, 2)
+        if fd0 > 2:
+            os.close(fd0)
+        if w_out > 2:
+            os.close(w_out)
+        cpu = max(int(task.get("cpu_seconds") or 1), 1)
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu + 1))
+        mem = int(task.get("memory_mb") or 512) * 1024 * 1024
+        resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
+        resource.setrlimit(resource.RLIMIT_FSIZE, (1 << 20, 1 << 20))
+        resource.setrlimit(resource.RLIMIT_NOFILE, (32, 32))
+        try:
+            resource.setrlimit(resource.RLIMIT_NPROC, (16, 16))
+        except (ValueError, OSError):
+            pass  # unprivileged users with many processes; NPROC is advisory
+        os.chdir(task_dir)
+        code = compile(task.get("code") or "", "<reward-task>", "exec")
+        exec(code, {"__name__": "__main__", "__builtins__": __builtins__})
+        rc = 0
+    except SystemExit as e:
+        c = e.code
+        rc = c if isinstance(c, int) else (0 if c is None else 1)
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+        rc = 1
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os._exit(rc & 0xFF)
+
+
+def main():
+    stdin = sys.stdin
+    out = sys.stdout
+    while True:
+        line = stdin.readline()
+        if not line:
+            return  # pool closed our stdin: graceful retirement
+        task = json.loads(line)
+        t0 = time.monotonic()
+        task_dir = tempfile.mkdtemp(prefix="reward_task_")
+        stdin_path = os.path.join(task_dir, ".stdin")
+        with open(stdin_path, "w") as f:
+            f.write(task.get("stdin") or "")
+        r_out, w_out = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            os.close(r_out)
+            _run_child(task, task_dir, stdin_path, w_out)
+        os.close(w_out)
+        cap = int(task.get("output_cap") or 65536)
+        chunks, got = [], 0
+        while True:
+            b = os.read(r_out, 65536)
+            if not b:
+                break
+            if got < cap:
+                chunks.append(b[: cap - got])
+            got += len(b)
+        os.close(r_out)
+        _, status = os.waitpid(pid, 0)
+        rc = -os.WTERMSIG(status) if os.WIFSIGNALED(status) else os.WEXITSTATUS(status)
+        shutil.rmtree(task_dir, ignore_errors=True)
+        resp = {
+            "output": b"".join(chunks).decode("utf-8", "replace"),
+            "returncode": rc,
+            "truncated": got > cap,
+            "duration": round(time.monotonic() - t0, 6),
+        }
+        out.write(json.dumps(resp) + "\n")
+        out.flush()
+
+
+main()
+"""
+
+
+class _Worker:
+    """One persistent sandbox worker: process handle + buffered,
+    deadline-aware protocol reader. Not thread-safe — a worker is owned by
+    exactly one task at a time (the idle queue serializes ownership)."""
+
+    def __init__(self):
+        self.proc = subprocess.Popen(
+            [sys.executable, "-I", "-c", _WORKER_SOURCE],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env={"PATH": ""},
+            close_fds=True,
+            start_new_session=True,  # pgid == pid: killpg reaps grandchildren
+        )
+        self.tasks_done = 0
+        self._buf = b""
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self.proc.stdout, selectors.EVENT_READ)
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def send(self, task: dict) -> None:
+        line = (json.dumps(task) + "\n").encode()
+        try:
+            self.proc.stdin.write(line)
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise WorkerDied(f"worker {self.pid} stdin closed: {e}") from e
+
+    def recv_line(self, deadline: float) -> bytes | None:
+        """One protocol line, or None when ``deadline`` passes first.
+        Raises :class:`WorkerDied` on EOF (the worker exited)."""
+        fd = self.proc.stdout.fileno()
+        while b"\n" not in self._buf:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            if not self._sel.select(timeout=remaining):
+                continue  # re-check the deadline
+            b = os.read(fd, 65536)
+            if not b:
+                raise WorkerDied(f"worker {self.pid} exited mid-protocol")
+            self._buf += b
+        line, _, self._buf = self._buf.partition(b"\n")
+        return line
+
+    def kill_group(self) -> None:
+        """SIGKILL the worker's whole process group — the worker, its
+        in-flight task child, and any grandchildren the task forked."""
+        try:
+            os.killpg(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        self._reap()
+
+    def retire(self, grace: float = 2.0) -> None:
+        """Graceful retirement: close stdin (the worker loop returns),
+        give it ``grace`` seconds, then ALWAYS sweep the process group —
+        a past task may have daemonized a grandchild that exited the
+        task cleanly but left the fork running; the group persists while
+        any member lives, so the killpg reaps it even after the worker
+        itself exited (the orphan class this subsystem exists to
+        prevent)."""
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            pass
+        self.kill_group()
+
+    def _reap(self) -> None:
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            self._sel.close()
+        except Exception:
+            logger.debug("worker selector close failed", exc_info=True)
+        for f in (self.proc.stdin, self.proc.stdout):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+
+class SandboxWorkerPool:
+    """Thread-safe bounded sandbox pool; see the module docstring.
+
+    ``run`` is the blocking entrypoint (call from any thread); ``arun``
+    is the async facade and runs on the pool's OWN thread pool — never
+    the event loop's default executor.
+    """
+
+    def __init__(
+        self,
+        num_workers: int = 4,
+        recycle_after: int = 64,
+        default_timeout: float = DEFAULT_TIMEOUT,
+        memory_mb: int = 512,
+        cpu_seconds: int = 0,
+        max_pending: int = 256,
+        kill_grace: float = KILL_GRACE,
+        output_cap: int = OUTPUT_CAP,
+        clock=time.monotonic,
+    ):
+        self.num_workers = max(1, int(num_workers))
+        self.recycle_after = max(1, int(recycle_after))
+        self.default_timeout = default_timeout
+        self.memory_mb = memory_mb
+        self.cpu_seconds = cpu_seconds
+        self.max_pending = max(self.num_workers, int(max_pending))
+        self.kill_grace = kill_grace
+        self.output_cap = output_cap
+        self._clock = clock
+
+        self._idle: queue.Queue[_Worker] = queue.Queue()
+        self._lock = threading.Lock()
+        self._pending = 0  # guarded_by: _lock — submitted, not yet finished
+        self._inflight: dict[str, float] = {}  # guarded_by: _lock — uid -> t0
+        self._latency_sum = 0.0  # guarded_by: _lock
+        self._latency_n = 0  # guarded_by: _lock
+        self._closed = False
+        # EVERY live worker, idle or busy — shutdown must be able to
+        # group-kill a worker currently wedged on a task, or it leaks
+        self._workers: set[_Worker] = set()  # guarded_by: _lock
+        self._executor = ThreadPoolExecutor(
+            max_workers=self.num_workers, thread_name_prefix="reward-pool"
+        )
+        for _ in range(self.num_workers):
+            self._idle.put(self._spawn_worker())
+
+        from areal_tpu.utils import metrics as _metrics
+
+        reg = _metrics.DEFAULT_REGISTRY
+        self._m_tasks = reg.counter(
+            "areal_reward_tasks_total",
+            "sandboxed reward tasks by outcome",
+            labels=("outcome",),
+        )
+        self._m_latency = reg.histogram(
+            "areal_reward_task_seconds",
+            "per-task sandbox execution latency",
+        )
+        self._m_queue_wait = reg.histogram(
+            "areal_reward_queue_wait_seconds",
+            "time a task waited for a sandbox worker",
+        )
+        self._m_kills = reg.counter(
+            "areal_reward_worker_kills_total",
+            "process-group kills (wall-deadline breaches / wedged workers)",
+        )
+        self._m_recycles = reg.counter(
+            "areal_reward_worker_recycles_total",
+            "workers retired after recycle_after tasks",
+        )
+        self._m_respawns = reg.counter(
+            "areal_reward_worker_respawns_total",
+            "replacement workers spawned after a death or kill",
+        )
+        self._m_saturated = reg.counter(
+            "areal_reward_admission_refused_total",
+            "tasks refused at admission (pool saturated)",
+        )
+        g_depth = reg.gauge(
+            "areal_reward_pending_tasks",
+            "tasks in flight or queued in the sandbox pool",
+        )
+        g_workers = reg.gauge(
+            "areal_reward_pool_workers", "configured sandbox worker count"
+        )
+
+        def _collect(_reg, _self=self, _gd=g_depth, _gw=g_workers):
+            with _self._lock:
+                _gd.set(float(_self._pending))
+            _gw.set(float(_self.num_workers))
+
+        self._collector = reg.register_collector(_collect)
+
+    # -------------------------------------------------------- worker registry
+
+    def _spawn_worker(self) -> _Worker:
+        w = _Worker()
+        with self._lock:
+            self._workers.add(w)
+        return w
+
+    def _dispose_worker(
+        self, worker: _Worker, kill: bool, grace: float | None = None
+    ) -> None:
+        with self._lock:
+            self._workers.discard(worker)
+        if kill:
+            worker.kill_group()
+        else:
+            worker.retire(grace=grace if grace is not None else self.kill_grace)
+
+    def _replace_worker(self, worker: _Worker, kill: bool) -> None:
+        """Dispose of ``worker`` and return a slot to the idle queue — a
+        fresh worker normally, nothing once the pool is closed (a kill
+        racing shutdown must not respawn past it)."""
+        self._dispose_worker(worker, kill)
+        with self._lock:
+            if self._closed:
+                return
+        self._m_respawns.inc()
+        self._idle.put(self._spawn_worker())
+
+    # ------------------------------------------------------------- admission
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def inflight(self) -> list[str]:
+        """uids of tasks currently holding (or queued for) a worker —
+        recorded into the flight dump at drain/kill time."""
+        with self._lock:
+            return sorted(self._inflight)
+
+    def _retry_after_locked(self) -> float:
+        # callers hold _lock (arealint can't see across the boundary)
+        mean = (  # arealint: disable=lock-discipline
+            self._latency_sum / self._latency_n if self._latency_n else 0.5
+        )
+        backlog = self._pending  # arealint: disable=lock-discipline
+        return min(30.0, max(0.5, backlog * mean / self.num_workers))
+
+    def retry_after_hint(self) -> float:
+        """Load-derived backoff: pending backlog times mean task latency
+        over the worker count, clamped to something a client would obey."""
+        with self._lock:
+            return self._retry_after_locked()
+
+    def _admit(self, uid: str, headroom: int = 0) -> int:
+        """Admit one task; returns how many tasks were already pending
+        (the queue position, which sizes the worker-wait budget)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("sandbox pool is shut down")
+            if self._pending + headroom >= self.max_pending:
+                self._m_saturated.inc()
+                raise PoolSaturated(
+                    f"sandbox pool saturated ({self._pending} pending, "
+                    f"bound {self.max_pending})",
+                    retry_after=self._retry_after_locked(),
+                )
+            ahead = self._pending
+            self._pending += 1
+            self._inflight[uid] = self._clock()
+            return ahead
+
+    def check_admission(self, n_tasks: int) -> None:
+        """Request-granularity admission probe for batch callers (the
+        service): refuse up-front when ``n_tasks`` would overflow the
+        bound, instead of failing verdicts mid-batch."""
+        with self._lock:
+            if self._pending + n_tasks > self.max_pending:
+                self._m_saturated.inc()
+                raise PoolSaturated(
+                    f"batch of {n_tasks} would overflow the pool bound "
+                    f"({self._pending} pending, bound {self.max_pending})",
+                    retry_after=self._retry_after_locked(),
+                )
+
+    def _finish(self, uid: str, duration: float) -> None:
+        with self._lock:
+            self._pending -= 1
+            self._inflight.pop(uid, None)
+            self._latency_sum += duration
+            self._latency_n += 1
+
+    # ------------------------------------------------------------ execution
+
+    def _task_defaults(
+        self, timeout, memory_mb, cpu_seconds, uid
+    ) -> tuple[float, int, int, str]:
+        timeout = timeout if timeout is not None else self.default_timeout
+        memory_mb = memory_mb if memory_mb is not None else self.memory_mb
+        cpu_seconds = cpu_seconds or self.cpu_seconds or max(int(timeout), 1)
+        uid = uid or f"task-{os.getpid()}-{next(_TASK_IDS)}"
+        return timeout, memory_mb, cpu_seconds, uid
+
+    def run(
+        self,
+        code: str,
+        stdin: str = "",
+        timeout: float | None = None,
+        memory_mb: int | None = None,
+        cpu_seconds: int | None = None,
+        uid: str = "",
+    ) -> SandboxResult:
+        """Execute ``code`` in a pooled sandbox worker (blocking). Always
+        returns a verdict — a timeout/kill/worker-death is a failed
+        :class:`SandboxResult`, never an exception — except for admission
+        (:class:`PoolSaturated`) and shutdown, which the caller must
+        handle."""
+        timeout, memory_mb, cpu_seconds, uid = self._task_defaults(
+            timeout, memory_mb, cpu_seconds, uid
+        )
+        ahead = self._admit(uid)
+        t_q0 = self._clock()
+        try:
+            return self._execute_admitted(
+                code, stdin, timeout, memory_mb, cpu_seconds, uid, ahead, t_q0
+            )
+        finally:
+            self._finish(uid, self._clock() - t_q0)
+
+    def _execute_admitted(
+        self, code, stdin, timeout, memory_mb, cpu_seconds, uid, ahead, t_q0
+    ) -> SandboxResult:
+        # the worker-wait budget scales with the backlog AHEAD of this
+        # task at admission: even a fully wedged pool drains at one
+        # process-group kill per (timeout + kill_grace) per worker, so
+        # this bound is reachable by construction — while a fully
+        # wedged pool still surfaces as a timeout verdict, not a hang
+        wait_budget = (timeout + self.kill_grace) * (
+            1.0 + ahead / self.num_workers
+        )
+        try:
+            worker = self._idle.get(timeout=wait_budget)
+        except queue.Empty:
+            self._m_tasks.labels(outcome="queue_timeout").inc()
+            return SandboxResult(
+                output="sandbox pool busy: no worker within deadline",
+                returncode=1,
+                timed_out=True,
+                duration=self._clock() - t_q0,
+            )
+        self._m_queue_wait.observe(self._clock() - t_q0)
+        return self._run_on(
+            worker, code, stdin, timeout, memory_mb, cpu_seconds, uid
+        )
+
+    def _run_on(
+        self, worker, code, stdin, timeout, memory_mb, cpu_seconds, uid
+    ) -> SandboxResult:
+        from areal_tpu.utils import flight_recorder
+
+        t0 = self._clock()
+        task = {
+            "code": code,
+            "stdin": stdin,
+            "cpu_seconds": cpu_seconds,
+            "memory_mb": memory_mb,
+            "output_cap": self.output_cap,
+        }
+        flight_recorder.record(
+            "reward", "task_start", uid=uid, worker=worker.pid,
+            code_preview=(code or "")[:120],
+        )
+        deadline = time.monotonic() + timeout + self.kill_grace
+        try:
+            worker.send(task)
+            line = worker.recv_line(deadline)
+        except WorkerDied:
+            self._m_tasks.labels(outcome="worker_died").inc()
+            flight_recorder.record("reward", "worker_died", uid=uid,
+                                   worker=worker.pid)
+            self._replace_worker(worker, kill=True)  # reap group stragglers
+            return SandboxResult(
+                output="sandbox worker died mid-task",
+                returncode=1,
+                duration=self._clock() - t0,
+            )
+        if line is None:
+            # wall deadline: kill the WHOLE process group (worker + task
+            # child + grandchildren), then stand up a replacement
+            self._m_tasks.labels(outcome="timeout").inc()
+            self._m_kills.inc()
+            flight_recorder.record(
+                "reward", "task_killed", uid=uid, worker=worker.pid,
+                timeout_s=timeout,
+            )
+            self._replace_worker(worker, kill=True)
+            return SandboxResult(
+                output="execution timed out",
+                returncode=1,
+                timed_out=True,
+                duration=self._clock() - t0,
+            )
+        try:
+            resp = json.loads(line)
+        except ValueError:
+            self._m_tasks.labels(outcome="protocol_error").inc()
+            self._m_kills.inc()
+            self._replace_worker(worker, kill=True)
+            return SandboxResult(
+                output="sandbox protocol violation",
+                returncode=1,
+                duration=self._clock() - t0,
+            )
+        worker.tasks_done += 1
+        if worker.tasks_done >= self.recycle_after:
+            self._m_recycles.inc()
+            self._replace_worker(worker, kill=False)
+        else:
+            self._idle.put(worker)
+        result = SandboxResult(
+            output=resp.get("output", ""),
+            returncode=int(resp.get("returncode", 1)),
+            duration=float(resp.get("duration", self._clock() - t0)),
+            truncated=bool(resp.get("truncated", False)),
+        )
+        self._m_tasks.labels(outcome="ok" if result.ok else "failed").inc()
+        self._m_latency.observe(result.duration)
+        flight_recorder.record(
+            "reward", "task_end", uid=uid, ok=result.ok,
+            returncode=result.returncode, duration=round(result.duration, 4),
+        )
+        return result
+
+    async def arun(
+        self,
+        code: str,
+        stdin: str = "",
+        timeout: float | None = None,
+        memory_mb: int | None = None,
+        cpu_seconds: int | None = None,
+        uid: str = "",
+    ) -> SandboxResult:
+        """Async facade over the pool's own thread pool. Admission runs
+        HERE, before the task enters the executor queue — counting it in
+        ``_pending`` while it waits for a thread — so the ``max_pending``
+        bound covers the executor's queue too (admitting only once a
+        thread picked the task up would cap ``_pending`` at the worker
+        count and let the queue grow without bound)."""
+        import asyncio
+
+        timeout, memory_mb, cpu_seconds, uid = self._task_defaults(
+            timeout, memory_mb, cpu_seconds, uid
+        )
+        ahead = self._admit(uid)
+        t_q0 = self._clock()
+        # submit the CONCURRENT future directly: the un-admit must fire
+        # when the THREAD finishes, not when the awaiting coroutine is
+        # cancelled — a caller's wait_for giving up leaves the task
+        # executing, and un-admitting it early would let new admissions
+        # exceed max_pending while every slot is still occupied (and the
+        # drain-time inflight snapshot would omit running tasks). The
+        # done-callback fires exactly once: on completion, error, or a
+        # cancel-before-start.
+        try:
+            cfut = self._executor.submit(
+                self._execute_admitted,
+                code, stdin, timeout, memory_mb, cpu_seconds, uid, ahead, t_q0,
+            )
+        except RuntimeError:  # shutdown raced the admission
+            self._finish(uid, self._clock() - t_q0)
+            raise
+        cfut.add_done_callback(
+            lambda _f: self._finish(uid, self._clock() - t_q0)
+        )
+        return await asyncio.wrap_future(cfut)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def shutdown(self, grace: float = 5.0) -> None:
+        """Retire idle workers gracefully, GROUP-KILL busy ones (a worker
+        wedged mid-task would otherwise outlive the pool with its whole
+        task tree — the orphan class this subsystem exists to prevent),
+        and release the pool's threads. A task in flight during the kill
+        gets a worker-died verdict. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        idle = []
+        while True:
+            try:
+                idle.append(self._idle.get_nowait())
+            except queue.Empty:
+                break
+        for w in idle:
+            self._dispose_worker(w, kill=False, grace=grace)
+        with self._lock:
+            busy = list(self._workers)
+        for w in busy:
+            self._dispose_worker(w, kill=True)
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        from areal_tpu.utils import metrics as _metrics
+
+        if self._collector is not None:
+            _metrics.DEFAULT_REGISTRY.unregister_collector(self._collector)
+            self._collector = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pending": self._pending,
+                "inflight": sorted(self._inflight),
+                "mean_latency": (
+                    self._latency_sum / self._latency_n
+                    if self._latency_n
+                    else 0.0
+                ),
+                "tasks_completed": self._latency_n,
+                "closed": self._closed,
+            }
+
+
+# ---------------------------------------------------------------------------
+# process-global default pool (the zero-config in-process fallback)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_POOL: SandboxWorkerPool | None = None
+_DEFAULT_POOL_LOCK = threading.Lock()
+
+
+def get_default_pool(cfg=None) -> SandboxWorkerPool:
+    """Lazily build (or return) the process-global pool. ``cfg`` (a
+    :class:`~areal_tpu.api.cli_args.RewardServiceConfig`) only applies on
+    first creation; reconfiguring requires :func:`shutdown_default_pool`
+    first."""
+    global _DEFAULT_POOL
+    with _DEFAULT_POOL_LOCK:
+        if _DEFAULT_POOL is None or _DEFAULT_POOL.stats()["closed"]:
+            kw = {}
+            if cfg is not None:
+                kw = dict(
+                    num_workers=cfg.num_workers,
+                    recycle_after=cfg.recycle_after,
+                    default_timeout=cfg.task_timeout,
+                    memory_mb=cfg.memory_mb,
+                    cpu_seconds=cfg.cpu_seconds,
+                    max_pending=cfg.max_pending,
+                )
+            _DEFAULT_POOL = SandboxWorkerPool(**kw)
+        return _DEFAULT_POOL
+
+
+def default_pool_active() -> bool:
+    """True when the process-global pool exists and is open — callers that
+    only want to USE a pool someone else paid for (e.g. the remote
+    verifier's zero-egress fallback) check this instead of instantiating
+    workers as a side effect."""
+    with _DEFAULT_POOL_LOCK:
+        return _DEFAULT_POOL is not None and not _DEFAULT_POOL.stats()["closed"]
+
+
+def shutdown_default_pool() -> None:
+    global _DEFAULT_POOL
+    with _DEFAULT_POOL_LOCK:
+        if _DEFAULT_POOL is not None:
+            _DEFAULT_POOL.shutdown()
+            _DEFAULT_POOL = None

@@ -1,0 +1,498 @@
+"""Sandboxed reward-execution HTTP service.
+
+The in-repo stand-in for the reference's remote FaaS sandbox
+(``functioncall/``): an aiohttp app that owns a :class:`SandboxWorkerPool`
+and speaks the reference-compatible batch schema already defined in
+``reward/remote.py``, so ``RemoteSandboxConfig.url`` can point at a
+replica of THIS service with zero client changes:
+
+- ``POST /run_batch`` — one functioncall payload ``{uid, language, code,
+  testcases: [{input, expectedOutput}], timeout, memory, isFastFail}``
+  -> ``{uid, success, results}`` (per-query verdicts AND across testcase
+  batches exactly like the reference);
+- ``POST /run`` — one raw execution ``{code, stdin, timeout, memory_mb}``
+  -> ``{output, ok, returncode, timed_out, duration}`` (the agentic tool
+  plane's endpoint);
+- ``GET /ready`` — readiness gate (503 while booting or draining), the
+  same contract the inference server exposes for the client's breaker
+  rejoin probe;
+- ``GET /health`` / ``GET /metrics`` — liveness + Prometheus exposition
+  of the unified registry (queue depth/wait, per-task latency
+  histograms, kill/timeout/recycle counters — all fed by the pool).
+
+Admission is bounded end to end: a request whose tasks would overflow the
+pool's ``max_pending`` gets **429 + Retry-After** (load-derived hint),
+never an unbounded queue. ``x-areal-trace`` headers continue the caller's
+trace into per-task span events. SIGTERM drains: readiness drops, the
+in-flight task set is recorded to the flight recorder and dumped, running
+tasks get ``drain_grace_seconds`` to finish, then the pool group-kills
+stragglers — a kill mid-batch leaves no orphaned sandbox processes and a
+postmortem artifact naming exactly what was in flight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import uuid
+from dataclasses import dataclass, field
+
+from aiohttp import web
+
+from areal_tpu.api.cli_args import NameResolveConfig, RewardServiceConfig
+from areal_tpu.reward_service.pool import (
+    PoolSaturated,
+    SandboxResult,
+    SandboxWorkerPool,
+)
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("reward_service")
+
+#: env var carrying a JSON ChaosPolicy for the reward service (kept
+#: separate from AREAL_CHAOS_SERVER so reward-plane chaos tests don't
+#: fault the co-resident generation servers)
+CHAOS_REWARD_ENV = "AREAL_CHAOS_REWARD"
+
+
+def _clamp_timeout(v, default: float) -> float:
+    try:
+        return min(100.0, max(0.1, float(v)))
+    except (TypeError, ValueError):
+        return default
+
+
+async def averify_payload(
+    pool: SandboxWorkerPool,
+    payload: dict,
+    default_timeout: float = 10.0,
+    span=None,
+) -> dict:
+    """Reference functioncall verification semantics over the pool: run
+    the payload's code against every testcase (stdin -> expected stdout),
+    ``success`` iff ALL pass. Shared by the service handler and the
+    client's zero-egress local fallback so both paths are verdict-
+    identical by construction."""
+    uid = str(payload.get("uid", ""))
+    language = str(payload.get("language", "PYTHON")).upper()
+    code = payload.get("code") or ""
+    cases = payload.get("testcases") or []
+    timeout = _clamp_timeout(payload.get("timeout"), default_timeout)
+    memory_mb = payload.get("memory")
+    fast_fail = bool(payload.get("isFastFail", True))
+    if language not in ("PYTHON", "PYTHON3", "PY"):
+        return {
+            "uid": uid,
+            "success": False,
+            "results": [
+                {"success": False, "reason": f"unsupported language {language}"}
+            ],
+        }
+    if not code:
+        return {
+            "uid": uid,
+            "success": False,
+            "results": [{"success": False, "reason": "empty code"}],
+        }
+
+    async def one(i: int, case: dict) -> dict:
+        r: SandboxResult = await pool.arun(
+            code,
+            stdin=str(case.get("input", "")),
+            timeout=timeout,
+            memory_mb=int(memory_mb) if memory_mb else None,
+            uid=f"{uid}:{i}" if uid else "",
+        )
+        want = str(case.get("expectedOutput", "")).strip()
+        ok = r.ok and r.output.strip() == want
+        if span is not None:
+            span.event(
+                "reward_case", uid=uid, case=i, ok=ok,
+                timed_out=r.timed_out, duration=round(r.duration, 4),
+            )
+        out = {"success": ok}
+        if not ok:
+            out["reason"] = (
+                "timeout" if r.timed_out
+                else f"exit={r.returncode} output={r.output.strip()[-200:]!r}"
+            )
+        return out
+
+    results: list[dict] = []
+    if not cases:
+        # no testcases: verdict is "does it run cleanly" (reference
+        # local_verify fallback shape)
+        r = await pool.arun(code, timeout=timeout, uid=uid)
+        results.append(
+            {"success": r.ok}
+            if r.ok
+            else {
+                "success": False,
+                "reason": "timeout" if r.timed_out else f"exit={r.returncode}",
+            }
+        )
+    elif fast_fail:
+        for i, case in enumerate(cases):
+            res = await one(i, case)
+            results.append(res)
+            if not res["success"]:
+                results.extend(
+                    {"success": False, "reason": "skipped (fast-fail)"}
+                    for _ in cases[i + 1 :]
+                )
+                break
+    else:
+        tasks = [
+            asyncio.ensure_future(one(i, c)) for i, c in enumerate(cases)
+        ]
+        try:
+            results = list(await asyncio.gather(*tasks))
+        except BaseException:
+            # one case failing admission (or the handler being cancelled)
+            # must not leave sibling cases running untrusted code against
+            # a request the caller was already told to retry
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+    return {
+        "uid": uid,
+        "success": all(r["success"] for r in results),
+        "results": results,
+    }
+
+
+class RewardService:
+    """The aiohttp app + pool pairing; see the module docstring."""
+
+    def __init__(self, cfg, pool: SandboxWorkerPool | None = None,
+                 tracer=None, chaos=None):
+        self.cfg = cfg
+        self.pool = pool or SandboxWorkerPool(
+            num_workers=cfg.num_workers,
+            recycle_after=cfg.recycle_after,
+            default_timeout=cfg.task_timeout,
+            memory_mb=cfg.memory_mb,
+            cpu_seconds=cfg.cpu_seconds,
+            max_pending=cfg.max_pending,
+        )
+        if tracer is None:
+            from areal_tpu.utils.tracing import Tracer
+
+            tracer = Tracer.from_config(getattr(cfg, "tracing", None))
+        self._tracer = tracer
+        if chaos is None:
+            from areal_tpu.utils.chaos import ChaosPolicy
+
+            chaos = ChaosPolicy.from_env(CHAOS_REWARD_ENV)
+        middlewares = []
+        if chaos is not None:
+            from areal_tpu.utils.chaos import aiohttp_chaos_middleware
+
+            logger.warning(
+                "CHAOS injection enabled on reward service: %s",
+                chaos.describe(),
+            )
+            middlewares.append(aiohttp_chaos_middleware(chaos))
+        self.chaos = chaos
+        self.draining = False
+        self._inflight_requests = 0
+        self.app = web.Application(middlewares=middlewares)
+        self.app.add_routes(
+            [
+                web.get("/health", self.health),
+                web.get("/ready", self.ready),
+                web.get("/metrics", self.metrics),
+                web.post("/run", self.run),
+                web.post("/run_batch", self.run_batch),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+
+        from areal_tpu.utils import metrics as _metrics
+
+        self._m_requests = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_reward_service_requests_total",
+            "reward-service requests by endpoint and status class",
+            labels=("endpoint", "status"),
+        )
+
+    # ----------------------------------------------------------- handlers
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return web.json_response({"status": "draining"}, status=503)
+        if self.pool.stats()["closed"]:
+            return web.json_response({"status": "pool closed"}, status=503)
+        return web.json_response(
+            {"status": "ready", "workers": self.pool.num_workers}
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+        return web.Response(
+            text=DEFAULT_REGISTRY.render_prometheus(),
+            content_type="text/plain",
+        )
+
+    def _span(self, request: web.Request, name: str, **attrs):
+        if self._tracer is None:
+            return None
+        from areal_tpu.utils.tracing import TRACE_HEADER
+
+        return self._tracer.span_from_header(
+            request.headers.get(TRACE_HEADER), name, **attrs
+        )
+
+    def _unavailable(self, endpoint: str) -> web.Response:
+        self._m_requests.labels(endpoint=endpoint, status="503").inc()
+        return web.json_response(
+            {"error": "service is draining"},
+            status=503,
+            headers={"Retry-After": "30"},
+        )
+
+    def _saturated(self, endpoint: str, e: PoolSaturated) -> web.Response:
+        self._m_requests.labels(endpoint=endpoint, status="429").inc()
+        return web.json_response(
+            {"error": str(e)},
+            status=429,
+            headers={"Retry-After": f"{e.retry_after:.1f}"},
+        )
+
+    async def run(self, request: web.Request) -> web.Response:
+        """One raw sandboxed execution (the tool plane's endpoint)."""
+        if self.draining:
+            return self._unavailable("run")
+        body = await request.json()
+        code = body.get("code")
+        if not isinstance(code, str) or not code:
+            self._m_requests.labels(endpoint="run", status="400").inc()
+            return web.json_response(
+                {"error": "code must be a non-empty string"}, status=400
+            )
+        span = self._span(
+            request, "reward.run", uid=str(body.get("uid", ""))
+        )
+        self._inflight_requests += 1
+        try:
+            try:
+                r = await self.pool.arun(
+                    code,
+                    stdin=str(body.get("stdin", "")),
+                    timeout=(
+                        _clamp_timeout(body["timeout"], self.cfg.task_timeout)
+                        if body.get("timeout") is not None
+                        else None
+                    ),
+                    memory_mb=(
+                        int(body["memory_mb"])
+                        if body.get("memory_mb")
+                        else None
+                    ),
+                    uid=str(body.get("uid", "")),
+                )
+            except PoolSaturated as e:
+                return self._saturated("run", e)
+            if span is not None:
+                span.set(
+                    ok=r.ok, timed_out=r.timed_out,
+                    duration=round(r.duration, 4),
+                )
+            self._m_requests.labels(endpoint="run", status="200").inc()
+            return web.json_response(
+                {
+                    "output": r.output,
+                    "ok": r.ok,
+                    "returncode": r.returncode,
+                    "timed_out": r.timed_out,
+                    "duration": r.duration,
+                    "truncated": r.truncated,
+                }
+            )
+        finally:
+            self._inflight_requests -= 1
+            if span is not None:
+                span.end()
+
+    async def run_batch(self, request: web.Request) -> web.Response:
+        """Reference functioncall batch verification."""
+        if self.draining:
+            return self._unavailable("run_batch")
+        payload = await request.json()
+        cases = payload.get("testcases") or []
+        try:
+            # request-granularity admission: refuse the WHOLE batch up
+            # front rather than failing verdicts mid-way through it
+            self.pool.check_admission(max(1, len(cases)))
+        except PoolSaturated as e:
+            return self._saturated("run_batch", e)
+        span = self._span(
+            request, "reward.verify",
+            uid=str(payload.get("uid", "")), cases=len(cases),
+        )
+        self._inflight_requests += 1
+        try:
+            try:
+                out = await averify_payload(
+                    self.pool, payload,
+                    default_timeout=self.cfg.task_timeout, span=span,
+                )
+            except PoolSaturated as e:
+                # raced past the up-front check; still a clean 429
+                return self._saturated("run_batch", e)
+            if span is not None:
+                span.set(success=out["success"])
+            self._m_requests.labels(endpoint="run_batch", status="200").inc()
+            return web.json_response(out)
+        finally:
+            self._inflight_requests -= 1
+            if span is not None:
+                span.end()
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self, host: str, port: int) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        logger.info("reward service listening on %s:%d", host, actual_port)
+        return actual_port
+
+    def begin_drain(self, reason: str = "sigterm") -> None:
+        """Stop admitting work and leave the postmortem artifact: the
+        flight dump carries the reward channel's recent task events PLUS
+        an explicit snapshot of the in-flight task set at drain time."""
+        from areal_tpu.utils import flight_recorder
+
+        self.draining = True
+        flight_recorder.record(
+            "reward", "drain",
+            reason=reason,
+            inflight_tasks=self.pool.inflight(),
+            inflight_requests=self._inflight_requests,
+        )
+        flight_recorder.dump(f"reward_service_{reason}")
+
+    async def drain_and_stop(self, grace: float = 10.0) -> None:
+        """Wait up to ``grace`` for in-flight work, then stop the app and
+        shut the pool down (group-killing stragglers)."""
+        deadline = asyncio.get_running_loop().time() + max(0.0, grace)
+        while (
+            self._inflight_requests > 0 or self.pool.pending() > 0
+        ) and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        await self.stop()
+
+    async def stop(self) -> None:
+        # pool first: shutdown group-kills workers wedged mid-task, which
+        # unblocks any handler awaiting them — aiohttp's cleanup below
+        # WAITS for in-flight handlers, so the reverse order hangs a
+        # SIGTERM for the whole aiohttp shutdown_timeout on one wedged
+        # reward (pinned by the kill-mid-batch e2e test)
+        self.pool.shutdown(grace=1.0)
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self._tracer is not None:
+            self._tracer.close()
+
+
+# ---------------------------------------------------------------------------
+# standalone entry point (spawned by launcher/local.py per replica)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RewardServiceMain:
+    """Standalone reward-service process config (mirrors GenServerConfig:
+    one section for the service itself plus trial identity + discovery)."""
+
+    experiment_name: str = "local"
+    trial_name: str = "trial"
+    reward_service: RewardServiceConfig = field(
+        default_factory=lambda: RewardServiceConfig()
+    )
+    name_resolve: NameResolveConfig = field(
+        default_factory=lambda: NameResolveConfig()
+    )
+
+
+async def amain(cfg: RewardServiceMain):
+    from areal_tpu.utils import name_resolve, names, network
+
+    name_resolve.reconfigure(cfg.name_resolve)
+    svc = RewardService(cfg.reward_service)
+    port = cfg.reward_service.port or network.find_free_ports(1)[0]
+    port = await svc.start(cfg.reward_service.host, port)
+
+    addr = f"{network.gethostip()}:{port}"
+    service_id = (
+        os.environ.get("AREAL_REWARD_SERVICE_ID")
+        or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+    )
+    key = names.reward_service(cfg.experiment_name, cfg.trial_name, service_id)
+    name_resolve.add(key, addr, replace=True)
+    logger.info("registered %s -> %s", key, addr)
+
+    stop_key = f"{names.trial_root(cfg.experiment_name, cfg.trial_name)}/shutdown"
+    stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    # the shutdown-key poll is blocking NFS I/O: run it off-loop (one
+    # dedicated thread) so a slow mount can never stall the /run and
+    # /ready handlers sharing this event loop — the same discipline the
+    # client applies to discovery
+    from concurrent.futures import ThreadPoolExecutor
+
+    poller = ThreadPoolExecutor(max_workers=1, thread_name_prefix="reward-poll")
+
+    def _on_sigterm():
+        svc.begin_drain("sigterm")
+        stop_event.set()
+
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, RuntimeError):  # non-unix / nested loops
+        pass
+    try:
+        while not stop_event.is_set():
+            try:
+                await loop.run_in_executor(poller, name_resolve.get, stop_key)
+                logger.info("shutdown key found; draining")
+                svc.begin_drain("shutdown_key")
+                break
+            except name_resolve.NameEntryNotFoundError:
+                pass  # expected: no shutdown requested yet
+            except Exception:
+                logger.debug("stop-key poll failed", exc_info=True)
+            try:
+                await asyncio.wait_for(stop_event.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+    finally:
+        try:
+            name_resolve.delete(key)
+        except Exception:
+            logger.debug("deregistration failed", exc_info=True)
+        poller.shutdown(wait=False, cancel_futures=True)
+        await svc.drain_and_stop(cfg.reward_service.drain_grace_seconds)
+
+
+def main(argv=None):
+    from areal_tpu.api.cli_args import from_dict, parse_cli_args
+
+    data, _ = parse_cli_args(argv)
+    cfg = from_dict(RewardServiceMain, data)
+    asyncio.run(amain(cfg))
+
+
+if __name__ == "__main__":
+    main()

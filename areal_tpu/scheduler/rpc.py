@@ -94,6 +94,15 @@ class EngineRPCServer:
         )
         self._runner: web.AppRunner | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
+        # engine calls are blocking (jit dispatch, weight loads): run them
+        # on a server-owned single thread — engine methods are not
+        # concurrency-safe against themselves, and the loop's default
+        # executor must stay out of it (unbounded-default-executor)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._blocking = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rpc-engine"
+        )
 
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -120,10 +129,12 @@ class EngineRPCServer:
         try:
             if tensors:
                 result = await loop.run_in_executor(
-                    None, lambda: fn(tensors, **kwargs)
+                    self._blocking, lambda: fn(tensors, **kwargs)
                 )
             else:
-                result = await loop.run_in_executor(None, lambda: fn(**kwargs))
+                result = await loop.run_in_executor(
+                    self._blocking, lambda: fn(**kwargs)
+                )
         except Exception as e:
             logger.exception("rpc %s failed", method)
             return web.json_response({"error": str(e)}, status=500)
@@ -157,6 +168,7 @@ class EngineRPCServer:
                 self._runner.cleanup(), self._loop
             ).result(15)
             self._loop.call_soon_threadsafe(self._loop.stop)
+        self._blocking.shutdown(wait=False, cancel_futures=True)
 
 
 class EngineRPCClient:

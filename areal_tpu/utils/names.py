@@ -30,6 +30,16 @@ def gen_server_drain(experiment_name: str, trial_name: str, server_id: str) -> s
     return f"{trial_root(experiment_name, trial_name)}/gen_server_drain/{server_id}"
 
 
+def reward_services(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which reward-service replicas register their
+    addresses (discovered by RewardServiceClient)."""
+    return f"{trial_root(experiment_name, trial_name)}/reward_services"
+
+
+def reward_service(experiment_name: str, trial_name: str, service_id: str) -> str:
+    return f"{reward_services(experiment_name, trial_name)}/{service_id}"
+
+
 def update_weights_from_disk(
     experiment_name: str, trial_name: str, model_version: int
 ) -> str:

@@ -3,17 +3,88 @@ observation (zero loss mask) -> continue, used by the TIR and search-agent
 workflows (reference shape: examples/tir/tir_workflow.py and
 examples/search-agent/tongyi_deepresearch/react_agent.py). One home for the
 subtle loss_mask/logprobs/versions splice and the padded-tensor packing so
-masking fixes cannot silently miss a copy."""
+masking fixes cannot silently miss a copy.
+
+Observability (the agentic workflow plane's telemetry, default on):
+
+- **per-tool latency/failure metrics** — ``areal_tool_seconds{tool}``
+  histogram + ``areal_tool_calls_total{tool,outcome}`` counter per
+  executed tool call (outcomes: ok / error / exception / timeout);
+- **tool-call span events** — each call stamps a ``tool_call`` event on
+  the episode's current rollout span, so a Perfetto export shows tool
+  wall-time inline with the generate segments it separates;
+- **turn-level staleness accounting** — every generate turn records
+  ``areal_turn_version_lag`` (current weight version minus the turn's
+  oldest generated-token version: how stale this turn's policy already
+  is at the moment it finishes) and episodes record
+  ``areal_episode_version_span`` (newest minus oldest version across
+  all turns — >0 means the episode spans a weight commit) plus an
+  ``areal_episode_turns`` histogram.
+
+A tool call that raises no longer kills the episode: the exception text
+becomes the observation (loss-masked like any tool output), the failure
+is counted, and the model gets to see its tool broke — per-episode
+failure semantics, matching the reward plane's.
+"""
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any, Awaitable, Callable
 
 import numpy as np
 
 from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.utils import logging, tracing
 from areal_tpu.utils.data import concat_padded_tensors
+
+logger = logging.getLogger("tool_loop")
+
+
+def _tool_instruments():
+    from areal_tpu.utils import metrics as _metrics
+
+    reg = _metrics.DEFAULT_REGISTRY
+    return (
+        reg.histogram(
+            "areal_tool_seconds", "per-tool-call execution latency",
+            labels=("tool",),
+        ),
+        reg.counter(
+            "areal_tool_calls_total", "tool calls by tool and outcome",
+            labels=("tool", "outcome"),
+        ),
+        reg.histogram(
+            "areal_turn_version_lag",
+            "weight-version lag of a finished generate turn "
+            "(current version - oldest token version of the turn)",
+        ),
+        reg.histogram(
+            "areal_episode_version_span",
+            "newest minus oldest weight version across an episode's turns",
+        ),
+        reg.histogram(
+            "areal_episode_turns", "generate turns per tool episode"
+        ),
+    )
+
+
+def _default_action_name(action: Any) -> str:
+    # search-agent actions are ("search"|"visit", arg) tuples; a bare
+    # string action labels itself only when identifier-shaped — model-
+    # derived payloads (TIR passes the raw code block) collapse to
+    # "tool" so they cannot mint a metric label series per distinct
+    # output (the registry's cardinality cap is the backstop, not the
+    # plan)
+    name = None
+    if isinstance(action, tuple) and action and isinstance(action[0], str):
+        name = action[0]
+    elif isinstance(action, str):
+        name = action
+    if name and len(name) <= 32 and name.isidentifier():
+        return name
+    return "tool"
 
 
 async def run_tool_episode(
@@ -25,6 +96,8 @@ async def run_tool_episode(
     execute: Callable[[Any], Awaitable[str]],
     format_obs: Callable[[str], str],
     max_tool_calls: int,
+    action_name: Callable[[Any], str] | None = None,
+    tool_metrics: bool = True,
 ) -> tuple[list[int], list[int], list[float], list[int], str]:
     """Returns (seq, loss_mask, logprobs, versions, full_text).
 
@@ -37,6 +110,11 @@ async def run_tool_episode(
     versions = [-1] * len(seq)
     rid = str(uuid.uuid4())
     full_text = ""
+    instruments = _tool_instruments() if tool_metrics else None
+    name_of = action_name or _default_action_name
+    span = tracing.current_span()
+    turns = 0
+    episode_versions: list[int] = []
     for _ in range(max_tool_calls + 1):
         resp = await engine.agenerate(
             ModelRequest(
@@ -48,18 +126,60 @@ async def run_tool_episode(
         loss_mask += [1] * resp.output_len
         logprobs += resp.output_logprobs
         versions += resp.output_versions
+        turns += 1
+        if instruments is not None and resp.output_versions:
+            turn_versions = [v for v in resp.output_versions if v >= 0]
+            if turn_versions:
+                episode_versions += (min(turn_versions), max(turn_versions))
+                cur = None
+                get_version = getattr(engine, "get_version", None)
+                if get_version is not None:
+                    try:
+                        cur = int(get_version())
+                    except Exception:
+                        cur = None
+                if cur is not None:
+                    instruments[2].observe(
+                        max(0, cur - min(turn_versions))
+                    )
         chunk = tokenizer.decode(resp.output_tokens)
         full_text += chunk
         action = parse_action(chunk)
         if action is None or resp.stop_reason != "stop":
             break
-        obs_text = format_obs(await execute(action))
+        tool = name_of(action)
+        t0 = time.monotonic()
+        try:
+            obs = await execute(action)
+            outcome = "ok"
+        except Exception as e:
+            # a broken tool is THIS episode's problem: the model sees the
+            # failure as its observation; the rollout plane keeps moving
+            logger.warning("tool %s failed: %s", tool, e)
+            obs = f"tool execution failed: {e}"
+            outcome = "exception"
+        dur = time.monotonic() - t0
+        if instruments is not None:
+            instruments[0].labels(tool=tool).observe(dur)
+            instruments[1].labels(tool=tool, outcome=outcome).inc()
+        if span is not None:
+            span.event(
+                "tool_call", tool=tool, outcome=outcome,
+                duration=round(dur, 4), turn=turns,
+            )
+        obs_text = format_obs(obs)
         obs_ids = tokenizer.encode(obs_text, add_special_tokens=False)
         seq += obs_ids
         loss_mask += [0] * len(obs_ids)
         logprobs += [0.0] * len(obs_ids)
         versions += [-1] * len(obs_ids)
         full_text += obs_text
+    if instruments is not None:
+        instruments[4].observe(turns)
+        if episode_versions:
+            instruments[3].observe(
+                max(episode_versions) - min(episode_versions)
+            )
     return seq, loss_mask, logprobs, versions, full_text
 
 

@@ -1039,6 +1039,150 @@ def weight_sync_bench(layers: int = 2, vocab: int = 2048, chunk_mb: int = 64,
         eng.stop()
 
 
+def reward_service_bench(n_episodes: int = 12, tokens_per_episode: int = 120,
+                         token_time: float = 0.01, gen_stagger: float = 0.2,
+                         wedged_frac: float = 0.5, wedge_hold: float = 8.0,
+                         task_timeout: float = 1.0, workers: int = 4, **_):
+    """Reward-execution rung: the SAME simulated rollout load — episodes
+    generate tokens (async token steps, staggered lengths like a real
+    batch) and then score an end-of-episode reward through the sandbox,
+    with ``wedged_frac`` of the rewards WEDGED (snippet sleeping
+    ``wedge_hold`` s; the episode's own await gives up per-episode) —
+    executed three ways:
+
+    - ``inprocess``: the pre-ISSUE-14 architecture — sandbox calls
+      offloaded with ``run_in_executor(None, ...)`` onto the loop's
+      default thread pool (shrunk to ``workers`` threads: pods run
+      hundreds of workflows against ~32 default threads, same ratio). A
+      wedged reward keeps its THREAD for the full sandbox wall even
+      after the await times out, so healthy rewards starve behind it;
+    - ``pooled``: the bounded SandboxWorkerPool (per-task wall deadline
+      enforced by process-group kill) — a wedged reward is killed at
+      ``task_timeout`` and its slot comes back;
+    - ``service``: the same pool behind the reward-service HTTP replica,
+      through RewardServiceClient.
+
+    Metric per mode: rollout tokens/s = total generated tokens over the
+    wall until every episode SETTLES (reward verdict included). Headline
+    = pooled/inprocess ratio (higher is better); the flatness contract
+    is pooled ≈ service ≈ the no-wedge baseline (rewards hide behind
+    generation when verdicts arrive on deadline)."""
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
+    from areal_tpu.api.cli_args import RewardServiceConfig
+    from areal_tpu.reward.sandbox import run_sandboxed
+    from areal_tpu.reward_service.client import RewardServiceClient
+    from areal_tpu.reward_service.pool import SandboxWorkerPool
+    from areal_tpu.reward_service.service import RewardService
+
+    FAST = "print(41 + 1)"
+    WEDGED = f"import time\ntime.sleep({wedge_hold})"
+    n_wedged = int(n_episodes * wedged_frac)
+    total_tokens = n_episodes * tokens_per_episode
+
+    async def episode(i, reward_call, wedged: bool):
+        # staggered generation lengths: rewards trickle into the plane
+        # like a real batch instead of arriving as one burst
+        steps = tokens_per_episode
+        extra = i * gen_stagger
+        for t in range(steps):
+            await asyncio.sleep(token_time + extra / steps)
+        try:
+            await asyncio.wait_for(
+                reward_call(WEDGED if wedged else FAST),
+                timeout=task_timeout * 4,
+            )
+        except asyncio.TimeoutError:
+            pass  # per-episode failure verdict; the plane moves on
+        return steps
+
+    async def run_mode(reward_call, wedge: bool):
+        t0 = time.monotonic()
+        made = await asyncio.gather(
+            *(
+                episode(i, reward_call, wedge and i < n_wedged)
+                for i in range(n_episodes)
+            )
+        )
+        wall = time.monotonic() - t0
+        return sum(made) / wall, wall
+
+    def mode_inprocess():
+        async def main():
+            loop = asyncio.get_running_loop()
+            loop.set_default_executor(ThreadPoolExecutor(max_workers=workers))
+
+            def sandbox(code):
+                # pre-fix semantics: the thread runs the sandbox's FULL
+                # wall budget regardless of the caller having moved on
+                return run_sandboxed(code, timeout=wedge_hold + 2)
+
+            async def call(code):
+                await asyncio.get_running_loop().run_in_executor(  # arealint: disable=unbounded-default-executor
+                    None, lambda: sandbox(code)
+                )
+            return await run_mode(call, wedge=True)
+
+        return asyncio.run(main())
+
+    def mode_pooled(wedge: bool):
+        pool = SandboxWorkerPool(
+            num_workers=workers, default_timeout=task_timeout,
+            kill_grace=0.5,
+        )
+
+        async def main():
+            async def call(code):
+                await pool.arun(code)
+
+            return await run_mode(call, wedge=wedge)
+
+        try:
+            return asyncio.run(main())
+        finally:
+            pool.shutdown()
+
+    def mode_service():
+        cfg = RewardServiceConfig(
+            num_workers=workers, task_timeout=task_timeout,
+        )
+
+        async def main():
+            svc = RewardService(cfg)
+            port = await svc.start("127.0.0.1", 0)
+            cli = RewardServiceClient(cfg, addresses=[f"127.0.0.1:{port}"])
+
+            async def call(code):
+                await cli.aexecute_code(code)
+
+            try:
+                return await run_mode(call, wedge=True)
+            finally:
+                await cli.close()
+                await svc.stop()
+
+        return asyncio.run(main())
+
+    base_tps, base_wall = mode_pooled(wedge=False)  # healthy-reward baseline
+    pooled_tps, pooled_wall = mode_pooled(wedge=True)
+    service_tps, service_wall = mode_service()
+    inproc_tps, inproc_wall = mode_inprocess()
+    return {
+        "baseline_tokens_per_sec": round(base_tps, 1),
+        "inprocess_tokens_per_sec": round(inproc_tps, 1),
+        "pooled_tokens_per_sec": round(pooled_tps, 1),
+        "service_tokens_per_sec": round(service_tps, 1),
+        "pooled_vs_inprocess": round(pooled_tps / max(inproc_tps, 1e-9), 3),
+        "service_vs_pooled": round(service_tps / max(pooled_tps, 1e-9), 3),
+        "pooled_vs_baseline": round(pooled_tps / max(base_tps, 1e-9), 3),
+        "inprocess_wall_s": round(inproc_wall, 2),
+        "pooled_wall_s": round(pooled_wall, 2),
+        "service_wall_s": round(service_wall, 2),
+        "total_tokens": total_tokens,
+    }
+
+
 def elastic_fleet_bench(n_requests: int = 48, new_tokens: int = 16,
                         token_time: float = 0.02, max_servers: int = 3,
                         interarrival: float = 0.12, **_):
@@ -1970,6 +2114,43 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("rl_health_overhead", "rl-health", e)
 
+    # ---- rung 4.6: reward-execution plane — in-process default-executor
+    # offload vs the bounded sandbox pool vs the HTTP reward service,
+    # under a concurrent wedged-reward flood (ISSUE 14). value is the
+    # pooled/inprocess tokens/s ratio over the tool-using episodes; the
+    # flatness contract is pooled ≈ service ≈ the unloaded baseline while
+    # the legacy path collapses. Pure-CPU simulation (no model), so the
+    # same numbers are the signal on rehearsal AND hardware. ----
+    if remaining(deadline) > 120:
+        try:
+            log("reward service rung")
+            rs = _run_child(
+                "reward",
+                dict(
+                    n_episodes=6, tokens_per_episode=120, token_time=0.003,
+                    wedged_frac=0.5, wedge_hold=8.0, task_timeout=1.0,
+                    workers=4,
+                ),
+                timeout=min(300.0, remaining(deadline) - 30),
+            )
+            # the bounded plane must keep the rollout output flat: within
+            # 40% of the unloaded baseline even while rewards wedge (the
+            # legacy path typically lands under 20%)
+            assert rs["pooled_vs_baseline"] >= 0.6, (
+                "pooled reward plane dipped rollout tokens/s: "
+                f"{rs['pooled_vs_baseline']} of baseline"
+            )
+            emit({
+                "metric": "reward_service",
+                "value": rs["pooled_vs_inprocess"],
+                "unit": "x_tokens_per_sec_pooled_vs_inprocess",
+                "vs_baseline": rs["pooled_vs_inprocess"],
+                **{k: v for k, v in rs.items()
+                   if k != "pooled_vs_inprocess"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("reward_service", "reward", e)
+
     if primary is not None:
         # repeat the primary as the FINAL line (drivers that take the last
         # parseable line get the headline metric)
@@ -2029,6 +2210,8 @@ def _child_main():
         print(json.dumps(weight_sync_bench(**att)))
     elif kind == "--fleet-child":
         print(json.dumps(elastic_fleet_bench(**att)))
+    elif kind == "--reward-child":
+        print(json.dumps(reward_service_bench(**att)))
     elif kind == "--grpo-child":
         from bench_grpo import grpo_step_bench
 

@@ -128,6 +128,17 @@ def main(argv=None):
         # penalty must compare actor vs ref weights, not actor vs itself.
         ref = PPOActor(cfg.actor, ref_engine)
 
+    # sandboxed reward-execution plane: installs the service client
+    # (discovery + breakers + local-pool fallback) when enabled; the tool
+    # env and any code-verification reward route through it. A no-op for
+    # the default math reward below, which is trivially fast in-process.
+    if getattr(cfg, "reward_service", None) is not None:
+        import areal_tpu.reward_service as reward_service_plane
+
+        reward_service_plane.configure(
+            cfg.reward_service, cfg.experiment_name, cfg.trial_name
+        )
+
     log_dir = os.path.join(
         cfg.stats_logger.fileroot, cfg.experiment_name, cfg.trial_name, "logs"
     )

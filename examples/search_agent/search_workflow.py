@@ -42,6 +42,7 @@ class SearchAgentWorkflow(RolloutWorkflow):
         env,
         max_tool_calls: int = 4,
         in_process_reward: bool = False,
+        tool_metrics: bool = True,
     ):
         self.reward_fn = AsyncRewardWrapper(reward_fn, in_process=in_process_reward)
         # stop after an action tag so the tool can answer before the model
@@ -53,6 +54,7 @@ class SearchAgentWorkflow(RolloutWorkflow):
         self.tokenizer = tokenizer
         self.env = env
         self.max_tool_calls = max_tool_calls
+        self.tool_metrics = tool_metrics
 
     async def arun_episode(self, engine, data: dict[str, Any]):
         messages = [{"role": "system", "content": SYSTEM_PROMPT}] + list(
@@ -83,6 +85,9 @@ class SearchAgentWorkflow(RolloutWorkflow):
             execute,
             lambda obs: f"\n<observation>\n{obs}\n</observation>\n",
             self.max_tool_calls,
+            # actions are ("search"|"visit", arg) tuples: the default
+            # action_name labels the per-tool metrics/spans by action[0]
+            tool_metrics=self.tool_metrics,
         )
         reward = await self.reward_fn(
             None, full_text, None, None,

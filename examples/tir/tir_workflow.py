@@ -27,6 +27,7 @@ class TIRWorkflow(RolloutWorkflow):
         max_tool_calls: int = 3,
         tool_timeout: float = 10.0,
         in_process_reward: bool = False,
+        tool_metrics: bool = True,
     ):
         self.reward_fn = AsyncRewardWrapper(reward_fn, in_process=in_process_reward)
         # stop at the end of a code block so the tool can run before the
@@ -34,7 +35,19 @@ class TIRWorkflow(RolloutWorkflow):
         self.gconfig = gconfig.new(n_samples=1, stop=list(gconfig.stop) + ["```\n"])
         self.tokenizer = tokenizer
         self.max_tool_calls = max_tool_calls
+        self.tool_metrics = tool_metrics
+        # sandbox execution routes through the reward plane (service
+        # client when reward_service.enabled, bounded pool otherwise)
         self.env = PythonToolEnv(timeout=tool_timeout)
+
+    @classmethod
+    def from_config(cls, reward_fn, gconfig, tokenizer, reward_service_cfg,
+                    **kw):
+        """Build with the workflow knobs from a RewardServiceConfig
+        (tool_metrics, task_timeout as the tool deadline)."""
+        kw.setdefault("tool_timeout", reward_service_cfg.task_timeout)
+        kw.setdefault("tool_metrics", reward_service_cfg.tool_metrics)
+        return cls(reward_fn, gconfig, tokenizer, **kw)
 
     async def arun_episode(self, engine, data: dict[str, Any]):
         prompt_ids = list(
@@ -60,6 +73,8 @@ class TIRWorkflow(RolloutWorkflow):
             execute,
             lambda obs: f"\n<output>\n{obs}\n</output>\n",
             self.max_tool_calls,
+            action_name=lambda _a: "python",
+            tool_metrics=self.tool_metrics,
         )
         reward = await self.reward_fn(
             None, full_text, None, None,

@@ -1,20 +1,34 @@
 """Python-executor tool environment (reference: examples/tir/tool_manager.py
 capability): runs model-emitted python snippets through the sandboxed
-executor (areal_tpu/reward/sandbox.py — rlimits on CPU/memory/files, empty
-env, throwaway cwd) and returns stdout as the observation."""
+reward-execution plane and returns stdout as the observation.
+
+Execution routes through ``areal_tpu.reward_service.aexecute_code`` — the
+configured service client when one is installed (``reward_service.enabled``),
+the process-global BOUNDED worker pool otherwise. It must never touch the
+event loop's default thread pool: the old ``run_in_executor(None, ...)``
+offload meant one batch of wedged sandbox calls exhausted the default
+executor and stalled every concurrent workflow's tool calls (pinned by a
+regression test and the ``unbounded-default-executor`` lint rule)."""
 
 from __future__ import annotations
 
-import asyncio
 from typing import Any
 
 from areal_tpu.api.env_api import Environment
-from areal_tpu.reward.sandbox import run_sandboxed
 
 
 class PythonToolEnv(Environment):
-    def __init__(self, timeout: float = 10.0):
+    def __init__(self, timeout: float = 10.0, executor=None):
         self.timeout = timeout
+        # injectable async executor (tests); default = the reward plane
+        if executor is None:
+            from areal_tpu.reward_service import aexecute_code
+
+            async def executor(code: str, timeout: float):
+                r = await aexecute_code(code, timeout=timeout)
+                return r.output, r.ok
+
+        self._executor = executor
 
     async def alist_tools(self) -> list[dict[str, Any]]:
         return [
@@ -38,8 +52,5 @@ class PythonToolEnv(Environment):
         if tool_name != "python":
             return f"unknown tool {tool_name}", False
         code = arguments.get("code", "")
-        loop = asyncio.get_running_loop()
-        out, ok = await loop.run_in_executor(
-            None, lambda: run_sandboxed(code, timeout=timeout or self.timeout)
-        )
+        out, ok = await self._executor(code, timeout or self.timeout)
         return out[-2000:], ok

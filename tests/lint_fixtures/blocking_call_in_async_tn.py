@@ -1,6 +1,9 @@
 # arealint fixture: blocking-call-in-async TRUE NEGATIVES (no findings).
 import asyncio
 import time
+from concurrent.futures import ThreadPoolExecutor
+
+_EXECUTOR = ThreadPoolExecutor(max_workers=1, thread_name_prefix="fixture")
 
 
 async def async_sleep(delay):
@@ -14,7 +17,7 @@ async def offloaded_blocking_work(loop):
         time.sleep(0.1)
         return 1
 
-    return await loop.run_in_executor(None, work)
+    return await loop.run_in_executor(_EXECUTOR, work)
 
 
 def plain_sync_function():

@@ -1,0 +1,34 @@
+"""TN fixture: offloads onto executors the caller OWNS (bounded, named,
+lifecycle-managed) are the correct pattern and must not fire."""
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+
+_POOL = ThreadPoolExecutor(max_workers=2, thread_name_prefix="fixture")
+
+
+def work():
+    return 1
+
+
+async def offload_to_owned_pool():
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(_POOL, work)
+
+
+class Owner:
+    def __init__(self):
+        self._executor = ThreadPoolExecutor(max_workers=1)
+
+    async def offload(self):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, work)
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+
+
+async def not_an_executor_call(mapping):
+    # same attribute name shape but no positional args: not a finding
+    fn = mapping.run_in_executor
+    return fn

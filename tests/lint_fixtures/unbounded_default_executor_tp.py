@@ -1,0 +1,26 @@
+"""TP fixture: offloading onto the event loop's DEFAULT thread pool —
+one wedged call starves every other run_in_executor(None, ...) user in
+the process."""
+
+import asyncio
+
+
+def work():
+    return 1
+
+
+async def offload_sync_work():
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, work)  # lint-expect: unbounded-default-executor
+
+
+async def offload_with_lambda(sandbox_call, code):
+    loop = asyncio.get_running_loop()
+    out = await loop.run_in_executor(  # lint-expect: unbounded-default-executor
+        None, lambda: sandbox_call(code)
+    )
+    return out
+
+
+async def offload_via_expression():
+    return await asyncio.get_event_loop().run_in_executor(None, work)  # lint-expect: unbounded-default-executor

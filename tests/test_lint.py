@@ -90,6 +90,7 @@ def test_rule_registry():
         "lock-discipline",
         "untracked-task",
         "naked-retry-loop",
+        "unbounded-default-executor",
     }
     assert expected <= set(rules)
     for rule in rules.values():
@@ -245,6 +246,7 @@ def test_per_path_ignores_config():
         "jit-per-call",
         "crash-unsafe-write",
         "swallowed-exception",
+        "unbounded-default-executor",
     }
     keep = framework.Finding("jit-per-call", "areal_tpu/x.py", 1, 0, "m")
     drop = framework.Finding("jit-per-call", "tests/t.py", 1, 0, "m")

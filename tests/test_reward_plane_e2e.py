@@ -1,0 +1,401 @@
+"""End-to-end reward-plane acceptance (ISSUE 14):
+
+1. over a REAL generation server (the deterministic sim harness — real
+   HTTP, pure-function token stream) and a REAL reward service, greedy
+   rollout outputs are token-identical with the reward service ON vs the
+   in-process pool, and chaos-injected wedged/crashing rewards leave the
+   rollout plane generating: every episode completes, affected episodes
+   time out per-episode (0.0 verdict), and the breaker opens and
+   recovers through the /ready probe path;
+
+2. a reward-service kill mid-batch (SIGTERM while a task wedges) leaves
+   no orphaned sandbox processes — worker, task child, and a grandchild
+   the task forked are all dead — and the flight-recorder dump names the
+   in-flight task set.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    CircuitBreakerConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    RewardServiceConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.fleet import harness
+from areal_tpu.reward_service.client import RewardServiceClient
+from areal_tpu.reward_service.pool import SandboxWorkerPool
+from areal_tpu.utils import network
+from areal_tpu.workflow.tool_loop import pack_episode
+
+HARNESS = harness.__file__
+
+GOOD_CODE = "answer\n```python\nprint(input().strip())\n```"
+WEDGED_CODE = "hm\n```python\nimport time\ntime.sleep(300)\n```"
+CRASH_CODE = "oops\n```python\nimport sys\nsys.exit(3)\n```"
+CASES = [{"stdin": "7\n", "expected_stdout": "7"}]
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.1)
+    raise TimeoutError(f"{url} never became ready")
+
+
+@pytest.fixture()
+def sim_server():
+    port = network.find_free_ports(1)[0]
+    proc = subprocess.Popen(
+        [sys.executable, HARNESS, "--port", str(port), "--token-time",
+         "0.001", "--max-concurrency", "8"],
+    )
+    try:
+        _wait_http(f"http://127.0.0.1:{port}/ready")
+        yield f"127.0.0.1:{port}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.fixture()
+def reward_service_proc(tmp_path):
+    port = network.find_free_ports(1)[0]
+    env = dict(os.environ)
+    env["AREAL_FLIGHT_RECORDER_DIR"] = str(tmp_path / "flight")
+    env["AREAL_REWARD_SERVICE_ID"] = "reward-e2e"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.reward_service.service",
+            "experiment_name=reward-e2e",
+            "trial_name=t",
+            f"name_resolve.nfs_record_root={tmp_path / 'nr'}",
+            "name_resolve.type=nfs",
+            f"reward_service.port={port}",
+            "reward_service.num_workers=2",
+            "reward_service.task_timeout=2.0",
+            "reward_service.drain_grace_seconds=1.0",
+        ],
+        env=env,
+    )
+    try:
+        _wait_http(f"http://127.0.0.1:{port}/ready")
+        yield proc, f"127.0.0.1:{port}", tmp_path / "flight"
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class CodeRewardWorkflow(RolloutWorkflow):
+    """Generate greedily on the sim server, then score the item's
+    scripted completion through the configured reward path — the reward
+    plane varies across test modes, generation must not."""
+
+    def __init__(self, reward_fn, reward_timeout: float = 8.0):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn,
+            timeout=reward_timeout,
+            in_process=not asyncio.iscoroutinefunction(reward_fn),
+        )
+
+    async def arun_episode(self, engine, data):
+        req = ModelRequest(
+            rid=str(uuid.uuid4()),
+            input_ids=list(data["prompt"]),
+            gconfig=GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        )
+        resp = await engine.agenerate(req)
+        reward = await self.reward_fn(
+            None, data["completion"], None, None, testcases=list(CASES)
+        )
+        seq = list(data["prompt"]) + list(resp.output_tokens)
+        loss_mask = [0] * len(data["prompt"]) + [1] * len(resp.output_tokens)
+        logprobs = [0.0] * len(data["prompt"]) + list(resp.output_logprobs)
+        versions = [-1] * len(data["prompt"]) + list(resp.output_versions)
+        return pack_episode(seq, loss_mask, logprobs, versions, reward)
+
+
+def _make_engine(addr: str, n: int, **breaker_kw) -> RemoteInfEngine:
+    eng = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name="reward-e2e",
+            trial_name="t",
+            max_concurrent_rollouts=n,
+            consumer_batch_size=n,
+            request_retries=2,
+            cache_aware_routing=False,
+        )
+    )
+    eng.initialize([addr], train_data_parallel_size=1)
+    return eng
+
+
+def _run_batch(engine, workflow, items, timeout=120.0):
+    for item in items:
+        engine.submit(item, workflow=workflow)
+    return engine.wait(count=len(items), timeout=timeout)
+
+
+def _rows(batch) -> list[tuple]:
+    """Order-independent row digests: (tokens..., reward)."""
+    ids = np.asarray(batch["input_ids"])
+    attn = np.asarray(batch["attention_mask"])
+    rw = np.asarray(batch["rewards"]).reshape(-1)
+    out = []
+    for i in range(ids.shape[0]):
+        n = int(attn[i].sum())
+        out.append((tuple(int(t) for t in ids[i, :n]), float(rw[i])))
+    return sorted(out)
+
+
+def _items(n):
+    return [
+        {"prompt": [1, 2, 3, i], "completion": GOOD_CODE} for i in range(n)
+    ]
+
+
+def test_e2e_token_identity_and_wedged_rewards_dont_stall_rollout(
+    sim_server, reward_service_proc
+):
+    _, svc_addr, _ = reward_service_proc
+    n = 4
+
+    # mode A: in-process bounded pool (zero-egress path)
+    pool = SandboxWorkerPool(num_workers=2, default_timeout=2.0)
+    local_cli = RewardServiceClient(
+        RewardServiceConfig(task_timeout=2.0), pool=pool
+    )
+    eng_a = _make_engine(sim_server, n)
+    try:
+        wf_a = CodeRewardWorkflow(local_cli.code_reward_fn())
+        batch_a = _run_batch(eng_a, wf_a, _items(n))
+    finally:
+        eng_a.destroy()
+
+    # mode B: reward service ON (HTTP replica)
+    svc_cli = RewardServiceClient(
+        RewardServiceConfig(task_timeout=2.0, request_retries=2),
+        addresses=[svc_addr],
+        pool=pool,
+    )
+    eng_b = _make_engine(sim_server, n)
+    try:
+        wf_b = CodeRewardWorkflow(svc_cli.code_reward_fn())
+        batch_b = _run_batch(eng_b, wf_b, _items(n))
+    finally:
+        eng_b.destroy()
+
+    rows_a, rows_b = _rows(batch_a), _rows(batch_b)
+    # greedy outputs token-identical service-on vs in-process, and equal
+    # to the sim's analytic stream
+    assert [r[0] for r in rows_a] == [r[0] for r in rows_b]
+    for toks, _ in rows_a:
+        prompt = list(toks[:4])
+        expect = list(prompt)
+        for _ in range(8):
+            expect.append(harness.next_token(expect, 997))
+        assert list(toks) == expect
+    # rewards correct on both paths
+    assert [r[1] for r in rows_a] == [1.0] * n
+    assert [r[1] for r in rows_b] == [1.0] * n
+
+    # mode C: wedged + crashing rewards — the rollout plane keeps
+    # generating; affected episodes get their 0.0 verdict within the
+    # per-task deadline instead of wedging anything
+    eng_c = _make_engine(sim_server, n + 2)
+    try:
+        wf_c = CodeRewardWorkflow(svc_cli.code_reward_fn(), reward_timeout=15.0)
+        items = _items(n)
+        items.append({"prompt": [9, 9, 9, 1], "completion": WEDGED_CODE})
+        items.append({"prompt": [9, 9, 9, 2], "completion": CRASH_CODE})
+        t0 = time.monotonic()
+        batch_c = _run_batch(eng_c, wf_c, items, timeout=60.0)
+        wall = time.monotonic() - t0
+    finally:
+        eng_c.destroy()
+        pool.shutdown()
+
+    rows_c = _rows(batch_c)
+    assert len(rows_c) == n + 2
+    good = [r for r in rows_c if r[0][:3] != (9, 9, 9)]
+    bad = [r for r in rows_c if r[0][:3] == (9, 9, 9)]
+    assert [r[1] for r in good] == [1.0] * n
+    assert [r[1] for r in bad] == [0.0, 0.0]
+    # generation for the WEDGED episodes still produced the analytic
+    # stream — the reward fault never touched the token path
+    for toks, _ in bad:
+        expect = list(toks[:4])
+        for _ in range(8):
+            expect.append(harness.next_token(expect, 997))
+        assert list(toks) == expect
+    # a wedged reward costs ~task_timeout, never the 300s sleep
+    assert wall < 45.0
+
+    asyncio.run(local_cli.close())
+    asyncio.run(svc_cli.close())
+
+
+def test_e2e_breaker_opens_and_recovers_through_probe(
+    sim_server, reward_service_proc
+):
+    """Chaos-injected service faults mid-run: calls fail over to the
+    local pool (verdicts intact), the breaker opens after the configured
+    threshold, and once the fault clears the /ready probe path closes it
+    and traffic returns to the service."""
+    from areal_tpu.utils.chaos import ChaosPolicy
+
+    _, svc_addr, _ = reward_service_proc
+    chaos = ChaosPolicy()
+    chaos.add_rule(endpoint="/run_batch", action="drop", times=2)
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=2.0)
+    cli = RewardServiceClient(
+        RewardServiceConfig(
+            task_timeout=2.0,
+            request_retries=1,
+            request_timeout=5.0,
+            breaker=CircuitBreakerConfig(
+                failure_threshold=2,
+                open_cooldown_seconds=0.0,
+                probe_interval_seconds=0.0,
+                min_window_requests=1000,
+            ),
+        ),
+        addresses=[svc_addr],
+        pool=pool,
+        chaos=chaos,
+    )
+
+    async def main():
+        rewards, states = [], []
+        fn = cli.code_reward_fn()
+        for _ in range(4):
+            rewards.append(
+                await fn(None, GOOD_CODE, None, None, testcases=list(CASES))
+            )
+            states.append(cli._health.state(svc_addr))
+        await cli.close()
+        return rewards, states
+
+    try:
+        rewards, states = asyncio.run(main())
+    finally:
+        pool.shutdown()
+    # every call returned the right verdict regardless of the fault
+    assert rewards == [1.0] * 4
+    # step-exact: fail, trip, then recover via the probe and stay closed
+    assert states == ["closed", "open", "closed", "closed"]
+    assert chaos.injected == 2
+
+
+def test_e2e_service_kill_mid_batch_leaves_no_orphans(
+    reward_service_proc, tmp_path
+):
+    proc, addr, flight_dir = reward_service_proc
+    pids_dir = tmp_path / "pids"
+    pids_dir.mkdir()
+    wedge_code = f"""
+import os, time
+with open({str(pids_dir)!r} + "/task", "w") as f:
+    f.write(str(os.getpid()) + " " + str(os.getppid()))
+pid = os.fork()
+if pid == 0:
+    with open({str(pids_dir)!r} + "/grandchild", "w") as f:
+        f.write(str(os.getpid()))
+    time.sleep(300)
+    os._exit(0)
+time.sleep(300)
+"""
+
+    def fire():
+        req = urllib.request.Request(
+            f"http://{addr}/run_batch",
+            data=json.dumps(
+                {
+                    "uid": "killed-mid-batch",
+                    "code": wedge_code,
+                    "timeout": 60.0,
+                    "testcases": [{"input": "", "expectedOutput": "x"}],
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+        except Exception:
+            pass  # the kill races the response; that's the point
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    # wait until the task is actually running inside a sandbox worker
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not (pids_dir / "grandchild").exists():
+        time.sleep(0.05)
+    assert (pids_dir / "grandchild").exists()
+    task_pid, worker_pid = map(int, (pids_dir / "task").read_text().split())
+    grandchild_pid = int((pids_dir / "grandchild").read_text())
+
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=30) == 0
+    t.join(timeout=10)
+
+    def dead(pid):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().split()[2] == "Z"
+        except (FileNotFoundError, ProcessLookupError):
+            return True
+
+    for pid in (worker_pid, task_pid, grandchild_pid):
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not dead(pid):
+            time.sleep(0.1)
+        assert dead(pid), f"pid {pid} survived the reward-service kill"
+
+    # the flight dump names the in-flight task set
+    dumps = sorted(os.listdir(flight_dir))
+    assert dumps, "SIGTERM left no flight dump"
+    for name in dumps:
+        snap = json.loads((flight_dir / name).read_text())
+        drains = [
+            e
+            for e in snap.get("channels", {}).get("reward", [])
+            if e["kind"] == "drain"
+        ]
+        if drains:
+            assert any(
+                uid.startswith("killed-mid-batch")
+                for uid in drains[-1]["inflight_tasks"]
+            )
+            break
+    else:
+        raise AssertionError("no drain event with the in-flight task set")

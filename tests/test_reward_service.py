@@ -1,0 +1,916 @@
+"""Sandboxed reward-execution plane (ISSUE 14): worker pool semantics
+(rlimits, wall-deadline process-group kills, recycling, bounded
+admission), the HTTP service (batch schema, 429+Retry-After, readiness,
+drain + flight dump), the breaker-fronted client (chaos-injected faults,
+step-exact breaker behavior, local-pool fallback, probe recovery), and
+the regression pins for the two satellite bugs (default-executor
+starvation in the tool env, orphaned grandchildren in the per-call
+sandbox)."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from areal_tpu.api.cli_args import (
+    ChaosConfig,
+    CircuitBreakerConfig,
+    RewardServiceConfig,
+)
+from areal_tpu.reward_service.pool import (
+    PoolSaturated,
+    SandboxWorkerPool,
+    get_default_pool,
+    shutdown_default_pool,
+)
+from areal_tpu.utils import flight_recorder
+
+
+def _alive_and_running(pid: int) -> bool:
+    """True only for a pid that exists AND is not a zombie (a zombie is
+    dead — merely unreaped by this container's init)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split()[2] != "Z"
+    except (FileNotFoundError, ProcessLookupError):
+        return False
+
+
+@pytest.fixture()
+def pool():
+    p = SandboxWorkerPool(
+        num_workers=2, recycle_after=50, default_timeout=5.0, kill_grace=0.5
+    )
+    yield p
+    p.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pool semantics
+# ---------------------------------------------------------------------------
+
+
+def test_pool_basic_verdicts(pool):
+    r = pool.run("print(input())", stdin="hello")
+    assert r.ok and r.output == "hello\n"
+    r = pool.run("import sys; sys.exit(3)")
+    assert not r.ok and r.returncode == 3
+    r = pool.run("raise ValueError('boom')")
+    assert not r.ok and "ValueError" in r.output
+    # a snippet calling exit() (models do constantly) must not cost a
+    # worker respawn: the task runs in a forked child
+    before = pool.stats()["tasks_completed"]
+    for _ in range(3):
+        assert pool.run("exit()").ok  # bare exit() is rc 0
+    assert pool.stats()["tasks_completed"] == before + 3
+
+
+def test_pool_rlimit_breaches_are_verdicts_not_hangs(pool):
+    t0 = time.monotonic()
+    # CPU spin past the rlimit -> SIGXCPU kills the task child
+    r = pool.run("x = 0\nwhile True: x += 1", timeout=30.0, cpu_seconds=1)
+    assert not r.ok and not r.timed_out
+    # memory breach -> MemoryError verdict
+    r = pool.run("b = bytearray(800 * 1024 * 1024)", memory_mb=128)
+    assert not r.ok and "MemoryError" in r.output
+    # fsize breach -> failure verdict
+    r = pool.run(
+        "f = open('big', 'wb')\nf.write(b'x' * (10 << 20))\nf.close()"
+    )
+    assert not r.ok
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_pool_wall_timeout_group_kill_reaps_grandchildren(pool, tmp_path):
+    """The orphan acceptance: a task that forks a long-lived grandchild
+    and hangs gets process-group-killed at the wall deadline — the
+    grandchild must not survive as a running process."""
+    pidfile = tmp_path / "gpid"
+    code = f"""
+import os, time
+pid = os.fork()
+if pid == 0:
+    with open({str(pidfile)!r}, "w") as f:
+        f.write(str(os.getpid()))
+    time.sleep(300)
+    os._exit(0)
+time.sleep(300)
+"""
+    r = pool.run(code, timeout=1.0)
+    assert r.timed_out and not r.ok
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not pidfile.exists():
+        time.sleep(0.05)
+    gpid = int(pidfile.read_text())
+    # give the SIGKILL a moment to land
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and _alive_and_running(gpid):
+        time.sleep(0.05)
+    assert not _alive_and_running(gpid)
+    # the pool replaced the killed worker: next task works
+    assert pool.run("print(1)").ok
+
+
+def test_pool_recycles_worker_after_n_tasks():
+    p = SandboxWorkerPool(num_workers=1, recycle_after=3, default_timeout=5.0)
+    try:
+        # the task child's parent IS the worker: os.getppid() tracks it
+        pids = [int(p.run("import os; print(os.getppid())").output) for _ in range(7)]
+        # tasks 1-3 share a worker, 4-6 the next, 7 a third
+        assert pids[0] == pids[1] == pids[2]
+        assert pids[3] == pids[4] == pids[5]
+        assert pids[2] != pids[3] and pids[5] != pids[6]
+    finally:
+        p.shutdown()
+
+
+def test_pool_admission_bound_and_retry_after_hint():
+    p = SandboxWorkerPool(
+        num_workers=1, default_timeout=5.0, max_pending=2, kill_grace=0.5
+    )
+    try:
+        done = threading.Event()
+        results = []
+
+        def slow():
+            results.append(p.run("import time; time.sleep(1.2)", timeout=5.0))
+            done.set()
+
+        threads = [threading.Thread(target=slow) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # both admitted (1 running + 1 queued = bound)
+        with pytest.raises(PoolSaturated) as ei:
+            p.run("print(1)")
+        assert ei.value.retry_after > 0
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        p.shutdown()
+
+
+def test_pool_async_admission_bounds_the_executor_queue():
+    """Review regression: arun admits BEFORE entering the executor queue,
+    so max_pending covers queued tasks too — admitting only when a thread
+    picks the task up would cap pending at num_workers and let the
+    executor queue grow without bound (and without a 429)."""
+    p = SandboxWorkerPool(
+        num_workers=1, default_timeout=5.0, max_pending=3, kill_grace=0.5
+    )
+
+    async def main():
+        backlog = [
+            asyncio.ensure_future(p.arun("import time; time.sleep(0.8)"))
+            for _ in range(3)
+        ]
+        await asyncio.sleep(0.3)
+        # 1 running + 2 still queued in the pool's executor: all counted
+        assert p.pending() == 3
+        with pytest.raises(PoolSaturated):
+            await p.arun("print(1)")
+        results = await asyncio.gather(*backlog)
+        assert all(r.ok for r in results)
+        assert p.pending() == 0
+
+    try:
+        asyncio.run(main())
+    finally:
+        p.shutdown()
+
+
+def test_pool_cancelled_arun_stays_admitted_until_thread_finishes():
+    """Review regression: a caller's wait_for giving up on arun() leaves
+    the executor thread running the task — the un-admit must track the
+    THREAD, not the await, or new admissions pile past max_pending while
+    every slot is still occupied (and the drain-time inflight snapshot
+    would omit tasks still running untrusted code)."""
+    p = SandboxWorkerPool(
+        num_workers=1, default_timeout=3.0, max_pending=4, kill_grace=0.5
+    )
+
+    async def main():
+        t = asyncio.ensure_future(p.arun("import time; time.sleep(1.0)"))
+        await asyncio.sleep(0.3)
+        t.cancel()
+        try:
+            await t
+        except asyncio.CancelledError:
+            pass
+        # the sandbox thread is still executing: still admitted
+        assert p.pending() == 1
+        deadline = time.monotonic() + 10
+        while p.pending() and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert p.pending() == 0  # un-admitted when the thread finished
+
+    try:
+        asyncio.run(main())
+    finally:
+        p.shutdown()
+
+
+def test_pool_retire_sweeps_daemonized_grandchildren(tmp_path):
+    """Review regression: a task that daemonizes a fork and exits CLEANLY
+    leaves the grandchild in the worker's process group; graceful
+    retirement (recycle path) must still sweep the group."""
+    p = SandboxWorkerPool(
+        num_workers=1, recycle_after=1, default_timeout=5.0, kill_grace=0.5
+    )
+    pidfile = tmp_path / "daemon_pid"
+    code = f"""
+import os, time
+pid = os.fork()
+if pid == 0:
+    os.close(0); os.close(1); os.close(2)
+    with open({str(pidfile)!r}, "w") as f:
+        f.write(str(os.getpid()))
+    time.sleep(300)
+    os._exit(0)
+"""
+    try:
+        r = p.run(code)  # task exits cleanly; recycle_after=1 retires now
+        assert r.ok
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not pidfile.exists():
+            time.sleep(0.05)
+        gpid = int(pidfile.read_text())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and _alive_and_running(gpid):
+            time.sleep(0.05)
+        assert not _alive_and_running(gpid)
+    finally:
+        p.shutdown()
+
+
+def test_pool_arun_rides_its_own_executor_not_the_loop_default():
+    """Regression (satellite 1): wedged sandbox calls occupy pool slots
+    only. The event loop's default executor stays free, the loop itself
+    keeps ticking, and a subsequent fast task completes."""
+    p = SandboxWorkerPool(
+        num_workers=2, default_timeout=1.0, kill_grace=0.5
+    )
+    ticks = []
+
+    async def heartbeat():
+        while len(ticks) < 100:
+            ticks.append(time.monotonic())
+            await asyncio.sleep(0.01)
+
+    async def main():
+        hb = asyncio.ensure_future(heartbeat())
+        wedged = [
+            asyncio.ensure_future(p.arun("import time; time.sleep(300)"))
+            for _ in range(2)
+        ]
+        fast = await p.arun("print('fast')")
+        wedged_results = await asyncio.gather(*wedged)
+        hb.cancel()
+        return fast, wedged_results
+
+    fast, wedged_results = asyncio.run(main())
+    assert fast.ok and fast.output.strip() == "fast"
+    assert all(r.timed_out for r in wedged_results)
+    assert len(ticks) >= 20  # the loop never stalled on sandbox work
+    p.shutdown()
+
+
+def test_tool_env_never_touches_the_default_executor(tmp_path):
+    """Pin the satellite fix at the source level AND behaviorally: the
+    tool env executes even when the loop's default executor is fully
+    saturated with hung work."""
+    import ast
+
+    import examples.tir.tool_env as tool_env_mod
+
+    tree = ast.parse(open(tool_env_mod.__file__.rstrip("c")).read())
+    offloads = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "run_in_executor"
+    ]
+    assert not offloads, "tool env must not offload via run_in_executor"
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from examples.tir.tool_env import PythonToolEnv
+
+    shutdown_default_pool()
+    get_default_pool(RewardServiceConfig(num_workers=1, task_timeout=5.0))
+    release = threading.Event()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        tiny = ThreadPoolExecutor(max_workers=1)
+        loop.set_default_executor(tiny)
+        # wedge the default executor completely
+        loop.run_in_executor(None, release.wait)
+        env = PythonToolEnv(timeout=5.0)
+        try:
+            out, ok = await asyncio.wait_for(
+                env.aexecute("python", {"code": "print(2 + 2)"}), timeout=15.0
+            )
+        finally:
+            # unblock BEFORE asyncio.run tears the loop down — its
+            # default-executor shutdown joins the wedged thread
+            release.set()
+        return out, ok
+
+    try:
+        out, ok = asyncio.run(main())
+        assert ok and out.strip() == "4"
+    finally:
+        release.set()
+        shutdown_default_pool()
+
+
+# ---------------------------------------------------------------------------
+# per-call sandbox (reward/sandbox.py) satellite
+# ---------------------------------------------------------------------------
+
+
+def test_run_sandboxed_group_kills_grandchildren_on_timeout(tmp_path):
+    """Regression: subprocess.run(timeout=...) killed only the direct
+    child; a forked grandchild survived the wall deadline as an orphan.
+    start_new_session + killpg must reap it."""
+    from areal_tpu.reward.sandbox import run_sandboxed
+
+    pidfile = tmp_path / "gpid"
+    code = f"""
+import os, time
+pid = os.fork()
+if pid == 0:
+    with open({str(pidfile)!r}, "w") as f:
+        f.write(str(os.getpid()))
+    time.sleep(300)
+    os._exit(0)
+time.sleep(300)
+"""
+    out, ok = run_sandboxed(code, timeout=1.0)
+    assert not ok and "timed out" in out
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not pidfile.exists():
+        time.sleep(0.05)
+    gpid = int(pidfile.read_text())
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and _alive_and_running(gpid):
+        time.sleep(0.05)
+    assert not _alive_and_running(gpid)
+
+
+def test_code_verify_reward_pooled_exec_matches_per_call(pool):
+    from areal_tpu.reward.sandbox import code_verify_reward, pooled_exec_fn
+
+    completion = "answer:\n```python\nprint(int(input()) * 2)\n```"
+    cases = [
+        {"stdin": "2\n", "expected_stdout": "4"},
+        {"stdin": "5\n", "expected_stdout": "10"},
+        {"stdin": "5\n", "expected_stdout": "11"},
+    ]
+    per_call = code_verify_reward(None, completion, testcases=cases)
+    pooled = code_verify_reward(
+        None, completion, testcases=cases, exec_fn=pooled_exec_fn(pool)
+    )
+    assert per_call == pooled == pytest.approx(2 / 3)
+
+
+# ---------------------------------------------------------------------------
+# service
+# ---------------------------------------------------------------------------
+
+
+def _start_service(cfg, **kw):
+    """Run a RewardService on a private loop thread; returns (svc, addr,
+    stop)."""
+    from areal_tpu.reward_service.service import RewardService
+
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        holder["loop"] = loop
+        svc = RewardService(cfg, **kw)
+        holder["svc"] = svc
+        holder["port"] = loop.run_until_complete(svc.start("127.0.0.1", 0))
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    svc, loop = holder["svc"], holder["loop"]
+
+    def stop():
+        fut = asyncio.run_coroutine_threadsafe(svc.stop(), loop)
+        fut.result(15)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+    return svc, f"127.0.0.1:{holder['port']}", stop
+
+
+@pytest.fixture()
+def service():
+    cfg = RewardServiceConfig(
+        num_workers=2, task_timeout=3.0, max_pending=4
+    )
+    svc, addr, stop = _start_service(cfg)
+    yield svc, addr, cfg
+    stop()
+
+
+async def _post(addr, path, payload):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://{addr}{path}", json=payload) as resp:
+            return resp.status, dict(resp.headers), await resp.json()
+
+
+async def _get(addr, path):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{addr}{path}") as resp:
+            return resp.status, await resp.text()
+
+
+def test_service_run_and_batch_schema(service):
+    _, addr, _ = service
+
+    async def main():
+        status, _, out = await _post(
+            addr, "/run", {"code": "print(6 * 7)"}
+        )
+        assert status == 200 and out["ok"] and out["output"].strip() == "42"
+        # reference functioncall schema: AND across testcases
+        status, _, out = await _post(
+            addr,
+            "/run_batch",
+            {
+                "uid": "q0",
+                "language": "PYTHON",
+                "code": "print(input().strip())",
+                "isFastFail": False,
+                "testcases": [
+                    {"input": "5\n", "expectedOutput": "5"},
+                    {"input": "7\n", "expectedOutput": "8"},
+                ],
+            },
+        )
+        assert status == 200 and out["uid"] == "q0"
+        assert out["success"] is False
+        assert [r["success"] for r in out["results"]] == [True, False]
+        # fast-fail marks the tail skipped
+        status, _, out = await _post(
+            addr,
+            "/run_batch",
+            {
+                "uid": "q1",
+                "code": "print('X')",
+                "isFastFail": True,
+                "testcases": [
+                    {"input": "", "expectedOutput": "Y"},
+                    {"input": "", "expectedOutput": "X"},
+                ],
+            },
+        )
+        assert out["success"] is False
+        assert out["results"][1]["reason"] == "skipped (fast-fail)"
+        # unsupported language is a verdict, not a 500
+        status, _, out = await _post(
+            addr, "/run_batch",
+            {"uid": "q2", "language": "CPP", "code": "int main(){}"},
+        )
+        assert status == 200 and out["success"] is False
+
+    asyncio.run(main())
+
+
+def test_service_429_with_retry_after_when_saturated():
+    cfg = RewardServiceConfig(num_workers=1, task_timeout=5.0, max_pending=1)
+    svc, addr, stop = _start_service(cfg)
+    try:
+
+        async def main():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                wedge = asyncio.ensure_future(
+                    s.post(
+                        f"http://{addr}/run",
+                        json={"code": "import time; time.sleep(2)"},
+                    )
+                )
+                await asyncio.sleep(0.4)
+                async with s.post(
+                    f"http://{addr}/run", json={"code": "print(1)"}
+                ) as resp:
+                    assert resp.status == 429
+                    assert float(resp.headers["Retry-After"]) > 0
+                async with s.post(
+                    f"http://{addr}/run_batch",
+                    json={
+                        "uid": "b",
+                        "code": "print(1)",
+                        "testcases": [
+                            {"input": "", "expectedOutput": "1"}
+                        ] * 3,
+                    },
+                ) as resp:
+                    assert resp.status == 429
+                r = await wedge
+                assert (await r.json())["ok"]
+                r.release()
+
+        asyncio.run(main())
+    finally:
+        stop()
+
+
+def test_service_bad_request_is_400_not_500(service):
+    _, addr, _ = service
+
+    async def main():
+        status, _, _ = await _post(addr, "/run", {"code": ""})
+        assert status == 400
+
+    asyncio.run(main())
+
+
+def test_service_trace_header_continues_trace(service):
+    """x-areal-trace propagates into per-task span events."""
+    from areal_tpu.api.cli_args import TracingConfig
+    from areal_tpu.utils.tracing import TRACE_HEADER, Tracer
+
+    tracer = Tracer.from_config(TracingConfig(enabled=True, service="t"))
+    cfg = RewardServiceConfig(num_workers=1, task_timeout=3.0)
+    svc, addr, stop = _start_service(cfg, tracer=tracer)
+    try:
+
+        async def main():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{addr}/run_batch",
+                    json={
+                        "uid": "traced",
+                        "code": "print('ok')",
+                        "testcases": [{"input": "", "expectedOutput": "ok"}],
+                    },
+                    headers={TRACE_HEADER: "11112222333344445555666677778888:aaaabbbbccccdddd"},
+                ) as resp:
+                    assert resp.status == 200
+
+        asyncio.run(main())
+        spans = tracer.finished_spans()
+        verify = [s for s in spans if s["name"] == "reward.verify"]
+        assert verify and verify[0]["trace_id"] == "11112222333344445555666677778888"
+        assert any(
+            e["name"] == "reward_case" for e in verify[0]["events"]
+        )
+    finally:
+        stop()
+
+
+def test_service_drain_dumps_inflight_task_set(tmp_path, monkeypatch):
+    """SIGTERM-path acceptance: readiness drops, new work is refused,
+    and the flight dump names the in-flight task set."""
+    monkeypatch.setenv(flight_recorder.DUMP_DIR_ENV, str(tmp_path))
+    flight_recorder.DEFAULT_RECORDER.reset()
+    cfg = RewardServiceConfig(num_workers=1, task_timeout=8.0)
+    svc, addr, stop = _start_service(cfg)
+    try:
+
+        async def main():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                wedge = asyncio.ensure_future(
+                    s.post(
+                        f"http://{addr}/run",
+                        json={
+                            "code": "import time; time.sleep(4)",
+                            "uid": "wedged-task",
+                        },
+                    )
+                )
+                await asyncio.sleep(0.5)
+                svc.begin_drain("test")
+                status, _ = await _get(addr, "/ready")
+                assert status == 503
+                async with s.post(
+                    f"http://{addr}/run", json={"code": "print(1)"}
+                ) as resp:
+                    assert resp.status == 503
+                r = await wedge  # in-flight work still completes
+                assert (await r.json())["ok"]
+                r.release()
+
+        asyncio.run(main())
+        dumps = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+        assert dumps
+        snap = json.loads((tmp_path / dumps[0]).read_text())
+        drains = [
+            e
+            for e in snap["channels"]["reward"]
+            if e["kind"] == "drain"
+        ]
+        assert drains and "wedged-task" in drains[0]["inflight_tasks"]
+    finally:
+        stop()
+
+
+# ---------------------------------------------------------------------------
+# client: routing, chaos, breakers, fallback
+# ---------------------------------------------------------------------------
+
+
+def _make_client(cfg=None, addrs=None, **kw):
+    from areal_tpu.reward_service.client import RewardServiceClient
+
+    cfg = cfg or RewardServiceConfig(num_workers=1, task_timeout=3.0)
+    return RewardServiceClient(cfg, addresses=addrs or [], **kw)
+
+
+def test_client_least_inflight_routing_unit():
+    cli = _make_client(addrs=["a:1", "b:1", "c:1"])
+    cli._inflight = {"a:1": 3, "b:1": 1, "c:1": 2}
+    assert cli._choose() == "b:1"
+    # OPEN breaker excludes a replica outright
+    cli._health.quarantine("b:1")
+    assert cli._choose() == "c:1"
+
+
+def test_client_no_replicas_falls_back_to_local_pool():
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=3.0)
+    try:
+        cli = _make_client(pool=pool)
+
+        async def main():
+            return await cli.aexecute_code("print('local')")
+
+        r = asyncio.run(main())
+        assert r.ok and r.output.strip() == "local"
+    finally:
+        pool.shutdown()
+
+
+def test_client_fallback_disabled_raises():
+    from areal_tpu.reward_service.client import NoServiceAvailable
+
+    cfg = RewardServiceConfig(fallback_local=False)
+    cli = _make_client(cfg=cfg)
+    with pytest.raises(NoServiceAvailable):
+        asyncio.run(cli.aexecute_code("print(1)"))
+
+
+@pytest.mark.parametrize("action", ["http_error", "drop", "disconnect"])
+def test_client_chaos_fault_opens_breaker_step_exact(action, service):
+    """Chaos-injected service faults (5xx / drop-timeout / disconnect):
+    call 1 fails -> CLOSED, call 2 fails -> OPEN (failure_threshold=2),
+    call 3 never touches the wire (breaker) — and EVERY call still
+    produces a correct verdict via the local-pool fallback."""
+    from areal_tpu.utils.chaos import ChaosPolicy
+
+    _, addr, _ = service
+    chaos = ChaosPolicy()
+    chaos.add_rule(endpoint="/run", action=action, times=2, status=500)
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=3.0)
+    cfg = RewardServiceConfig(
+        num_workers=1,
+        task_timeout=3.0,
+        request_retries=1,
+        request_timeout=5.0,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=2,
+            open_cooldown_seconds=3600.0,  # no recovery inside this test
+            min_window_requests=1000,
+        ),
+    )
+    cli = _make_client(cfg=cfg, addrs=[addr], pool=pool, chaos=chaos)
+
+    async def main():
+        outs = []
+        states = []
+        for _ in range(3):
+            outs.append(await cli.aexecute_code("print('v')"))
+            states.append(cli._health.state(addr))
+        await cli.close()
+        return outs, states
+
+    try:
+        outs, states = asyncio.run(main())
+        assert [r.ok for r in outs] == [True, True, True]
+        assert [r.output.strip() for r in outs] == ["v", "v", "v"]
+        assert states == ["closed", "open", "open"]
+        assert chaos.injected == 2  # call 3 was routed around, not retried
+    finally:
+        pool.shutdown()
+
+
+def test_client_breaker_recovers_via_ready_probe(service):
+    """After the chaos clears, the /ready probe path (cooldown 0) moves
+    the breaker OPEN -> HALF_OPEN and the next call closes it."""
+    from areal_tpu.utils.chaos import ChaosPolicy
+
+    _, addr, _ = service
+    chaos = ChaosPolicy()
+    chaos.add_rule(endpoint="/run", action="http_error", times=2, status=503)
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=3.0)
+    cfg = RewardServiceConfig(
+        num_workers=1,
+        task_timeout=3.0,
+        request_retries=1,
+        breaker=CircuitBreakerConfig(
+            failure_threshold=2,
+            open_cooldown_seconds=0.0,
+            probe_interval_seconds=0.0,
+            min_window_requests=1000,
+        ),
+    )
+    cli = _make_client(cfg=cfg, addrs=[addr], pool=pool, chaos=chaos)
+
+    async def main():
+        for _ in range(2):
+            await cli.aexecute_code("print('x')")
+        assert cli._health.state(addr) == "open"
+        # chaos exhausted: the next call probes /ready, rejoins, and is
+        # served by the SERVICE (fallback counter must not move)
+        before = cli._m_fallbacks.children()
+        before_n = sum(c.value for c in before.values())
+        r = await cli.aexecute_code("print('recovered')")
+        after_n = sum(c.value for c in cli._m_fallbacks.children().values())
+        await cli.close()
+        return r, cli._health.state(addr), before_n, after_n
+
+    try:
+        r, state, before_n, after_n = asyncio.run(main())
+        assert r.ok and r.output.strip() == "recovered"
+        assert state == "closed"
+        assert after_n == before_n  # served remotely, not by fallback
+    finally:
+        pool.shutdown()
+
+
+def test_client_verify_service_and_fallback_verdict_identical(service):
+    """The same payload produces the same verdict served remotely or by
+    the zero-egress local pool — both run averify_payload over the same
+    pool implementation."""
+    _, addr, _ = service
+    payload = {
+        "uid": "same",
+        "code": "print(int(input()) + 1)",
+        "isFastFail": False,
+        "testcases": [
+            {"input": "1\n", "expectedOutput": "2"},
+            {"input": "2\n", "expectedOutput": "99"},
+        ],
+    }
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=3.0)
+    remote_cli = _make_client(addrs=[addr], pool=pool)
+    local_cli = _make_client(pool=pool)
+
+    async def main():
+        remote = await remote_cli.averify(dict(payload))
+        local = await local_cli.averify(dict(payload))
+        await remote_cli.close()
+        return remote, local
+
+    try:
+        remote, local = asyncio.run(main())
+        assert remote["success"] == local["success"] is False
+        assert [r["success"] for r in remote["results"]] == [
+            r["success"] for r in local["results"]
+        ] == [True, False]
+    finally:
+        pool.shutdown()
+
+
+def test_code_reward_fn_through_async_wrapper():
+    """The service-plane reward fn is async; AsyncRewardWrapper awaits
+    it natively and a slow reward degrades to a 0.0 verdict for THAT
+    episode instead of wedging anything."""
+    from areal_tpu.api.reward_api import AsyncRewardWrapper
+
+    pool = SandboxWorkerPool(num_workers=1, default_timeout=3.0)
+    cli = _make_client(pool=pool)
+    reward_fn = cli.code_reward_fn(fast_fail=False)
+    wrapper = AsyncRewardWrapper(reward_fn, timeout=30.0)
+
+    completion = "```python\nprint(int(input()) * 3)\n```"
+    cases = [
+        {"stdin": "2\n", "expected_stdout": "6"},
+        {"stdin": "3\n", "expected_stdout": "9"},
+        {"stdin": "3\n", "expected_stdout": "8"},
+    ]
+
+    async def main():
+        good = await wrapper(None, completion, None, None, testcases=cases)
+        empty = await wrapper(None, "no code here", None, None, testcases=cases)
+        # timeout discipline: a reward slower than the budget is 0.0
+        async def slow_reward(*a, **k):
+            await asyncio.sleep(30)
+
+        slow = AsyncRewardWrapper(slow_reward, timeout=0.2)
+        t0 = time.monotonic()
+        z = await slow(None, "x", None, None)
+        return good, empty, z, time.monotonic() - t0
+
+    try:
+        good, empty, z, dt = asyncio.run(main())
+        assert good == pytest.approx(2 / 3)
+        assert empty == 0.0
+        assert z == 0.0 and dt < 5.0
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# remote.py retry/backoff/fallback coverage (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_remote_invoke_backoff_grows_and_failure_record():
+    """Every attempt failing -> bounded exponential backoff between
+    attempts and a schema-shaped failure record, never an exception."""
+    from areal_tpu.reward.remote import RemoteSandboxConfig, batch_call
+
+    class FailingSession:
+        def __init__(self):
+            self.calls = 0
+
+        def post(self, url, json=None, timeout=None):
+            self.calls += 1
+
+            class Ctx:
+                async def __aenter__(self_inner):
+                    raise asyncio.TimeoutError("down")
+
+                async def __aexit__(self_inner, *a):
+                    return False
+
+            return Ctx()
+
+    delays = []
+
+    async def fake_sleep(d):
+        delays.append(d)
+
+    cfg = RemoteSandboxConfig(
+        url="http://sandbox/verify",
+        max_retries=3,
+        initial_retry_interval=0.5,
+        max_retry_interval=10.0,
+    )
+    session = FailingSession()
+
+    async def main():
+        from areal_tpu.reward.remote import _invoke_one
+
+        return await _invoke_one(
+            session, cfg, {"uid": "u1", "code": "x"}, sleep=fake_sleep
+        )
+
+    out = asyncio.run(main())
+    assert out == {
+        "uid": "u1",
+        "success": False,
+        "results": [{"success": False, "reason": "max retries exceeded"}],
+    }
+    assert session.calls == 3 and len(delays) == 3
+    # full backoff ladder: base*2^attempt + U(0, 0.5), capped
+    assert 0.5 <= delays[0] <= 1.0
+    assert 1.0 <= delays[1] <= 1.5
+    assert 2.0 <= delays[2] <= 2.5
+    assert batch_call  # imported symbol stays exported
+
+
+def test_remote_local_fallback_uses_active_pool():
+    """With the default pool up, the zero-egress fallback executes on it
+    (persistent workers) instead of forking per snippet."""
+    from areal_tpu.reward.remote import code_verify_batch
+
+    shutdown_default_pool()
+    pool = get_default_pool(
+        RewardServiceConfig(num_workers=1, task_timeout=5.0)
+    )
+    try:
+        before = pool.stats()["tasks_completed"]
+        id2info = {
+            "a": {"input_output": json.dumps({"inputs": ["3\n"], "outputs": ["3"]})},
+            "b": {"input_output": json.dumps({"inputs": ["3\n"], "outputs": ["4"]})},
+        }
+        gens = ["```python\nprint(input().strip())\n```"] * 2
+        got = code_verify_batch(id2info, gens, ["a", "b"])
+        assert got == [1, 0]
+        assert pool.stats()["tasks_completed"] > before
+    finally:
+        shutdown_default_pool()

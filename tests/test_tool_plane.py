@@ -1,0 +1,210 @@
+"""Agentic tool-call plane telemetry (ISSUE 14 workflow wiring): per-tool
+latency/failure metrics, tool-call span events, turn/episode staleness
+accounting in run_tool_episode, a broken tool degrading to an observation
+instead of killing the episode, and the WorkflowExecutor's per-accepted-
+episode version-lag accounting."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    TracingConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.workflow_executor import WorkflowExecutor
+from areal_tpu.utils import tracing
+from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+from areal_tpu.utils.testing import make_toy_tokenizer
+from areal_tpu.workflow.tool_loop import pack_episode, run_tool_episode
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    return make_toy_tokenizer(str(tmp_path_factory.mktemp("tok")))
+
+
+class ScriptedEngine:
+    """Scripted completions; the weight version can change between turns
+    (the staleness-accounting scenario)."""
+
+    def __init__(self, tokenizer, completions, version_per_call=None):
+        self.tokenizer = tokenizer
+        self.completions = list(completions)
+        self.version_per_call = list(version_per_call or [])
+        self.calls = 0
+
+    def get_version(self):
+        # "current" version = the version of the latest call
+        if self.version_per_call:
+            return self.version_per_call[
+                min(self.calls, len(self.version_per_call)) - 1
+            ]
+        return 0
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        i = min(self.calls, len(self.completions) - 1)
+        v = (
+            self.version_per_call[min(self.calls, len(self.version_per_call) - 1)]
+            if self.version_per_call
+            else 0
+        )
+        self.calls += 1
+        out = self.tokenizer.encode(
+            self.completions[i], add_special_tokens=False
+        )
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.1] * len(out),
+            output_versions=[v] * len(out),
+            stop_reason="stop",
+        )
+
+
+def _hist_count(name, labelnames=(), **labels):
+    m = DEFAULT_REGISTRY.histogram(name, labels=tuple(labelnames))
+    if labels:
+        return m.labels(**labels).count
+    return m._solo().count
+
+
+def _counter_value(name, labelnames=(), **labels):
+    m = DEFAULT_REGISTRY.counter(name, labels=tuple(labelnames))
+    if labels:
+        return m.labels(**labels).value
+    return m.value()
+
+
+def test_tool_loop_metrics_spans_and_turn_staleness(tokenizer):
+    engine = ScriptedEngine(
+        tokenizer,
+        ["use tool now", "use tool again", "final answer"],
+        version_per_call=[0, 2, 2],
+    )
+    tracer = tracing.Tracer.from_config(
+        TracingConfig(enabled=True, service="test")
+    )
+    gconfig = GenerationHyperparameters(max_new_tokens=16)
+    executed = []
+
+    def parse(chunk):
+        return "python" if "tool" in chunk else None
+
+    async def execute(action):
+        executed.append(action)
+        if len(executed) == 2:
+            raise RuntimeError("tool backend down")
+        return "tool says 42"
+
+    calls_before_ok = _counter_value(
+        "areal_tool_calls_total", labelnames=("tool", "outcome"), tool="python", outcome="ok"
+    )
+    calls_before_exc = _counter_value(
+        "areal_tool_calls_total", labelnames=("tool", "outcome"), tool="python", outcome="exception"
+    )
+    lat_before = _hist_count("areal_tool_seconds", labelnames=("tool",), tool="python")
+    turns_before = _hist_count("areal_episode_turns")
+    span_before = _hist_count("areal_episode_version_span")
+
+    async def main():
+        span = tracer.span("rollout", rid="r0")
+        token = tracing.set_current_span(span)
+        try:
+            with span:
+                return await run_tool_episode(
+                    engine,
+                    tokenizer,
+                    gconfig,
+                    prompt_ids=[1, 2, 3],
+                    parse_action=parse,
+                    execute=execute,
+                    format_obs=lambda o: f"<obs>{o}</obs>",
+                    max_tool_calls=3,
+                    action_name=lambda a: a,
+                )
+        finally:
+            tracing.reset_current_span(token)
+
+    seq, loss_mask, logprobs, versions, text = asyncio.run(main())
+    # 3 turns, 2 tool calls (one of which broke)
+    assert len(executed) == 2
+    # the broken tool became an observation, not an episode failure
+    assert "tool execution failed" in text
+    assert _counter_value(
+        "areal_tool_calls_total", labelnames=("tool", "outcome"), tool="python", outcome="ok"
+    ) == calls_before_ok + 1
+    assert _counter_value(
+        "areal_tool_calls_total", labelnames=("tool", "outcome"), tool="python", outcome="exception"
+    ) == calls_before_exc + 1
+    assert _hist_count("areal_tool_seconds", labelnames=("tool",), tool="python") == lat_before + 2
+    assert _hist_count("areal_episode_turns") == turns_before + 1
+    assert _hist_count("areal_episode_version_span") == span_before + 1
+    # masking invariants hold through the splices
+    assert len(seq) == len(loss_mask) == len(logprobs) == len(versions)
+    assert all(
+        versions[i] == -1 for i in range(len(seq)) if loss_mask[i] == 0
+    )
+    # span events: one tool_call per executed call, with the outcome
+    spans = tracer.finished_spans()
+    rollout = next(s for s in spans if s["name"] == "rollout")
+    events = [e for e in rollout["events"] if e["name"] == "tool_call"]
+    assert [e["outcome"] for e in events] == ["ok", "exception"]
+    tracer.close()
+
+
+class _VersionedWorkflow(RolloutWorkflow):
+    def __init__(self, versions):
+        self.versions = versions
+
+    async def arun_episode(self, engine, data):
+        n = len(self.versions)
+        return pack_episode(
+            list(range(n)), [1] * n, [0.0] * n, list(self.versions), 1.0
+        )
+
+
+class _FakeEngine:
+    def __init__(self, version=0):
+        self.version = version
+
+    def get_version(self):
+        return self.version
+
+
+def test_executor_accept_notes_episode_version_lag():
+    """Accepting an episode observes current_version - oldest token
+    version and counts whether the episode spans a weight commit."""
+    lag_before = _hist_count("areal_episode_version_lag")
+    mixed_before = _counter_value("areal_episodes_by_version_mix", labelnames=("mixed",), mixed="yes")
+    pure_before = _counter_value("areal_episodes_by_version_mix", labelnames=("mixed",), mixed="no")
+
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=2, consumer_batch_size=2,
+        max_head_offpolicyness=10,
+    )
+    ex = WorkflowExecutor(cfg, _FakeEngine(version=3))
+    ex.initialize()
+    try:
+        ex.submit({"i": 0}, workflow=_VersionedWorkflow([1, 1, 2]))  # mixed
+        ex.submit({"i": 1}, workflow=_VersionedWorkflow([3, 3, 3]))  # pure
+        ex.wait(2, timeout=30)
+    finally:
+        ex.destroy()
+    assert _hist_count("areal_episode_version_lag") == lag_before + 2
+    assert (
+        _counter_value("areal_episodes_by_version_mix", labelnames=("mixed",), mixed="yes")
+        == mixed_before + 1
+    )
+    assert (
+        _counter_value("areal_episodes_by_version_mix", labelnames=("mixed",), mixed="no")
+        == pure_before + 1
+    )
+    # the lag histogram saw 3-1=2 and 3-3=0
+    m = DEFAULT_REGISTRY.histogram("areal_episode_version_lag")
+    assert m._solo().sum >= 2.0
