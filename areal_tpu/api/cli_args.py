@@ -930,6 +930,30 @@ class InferenceEngineConfig:
     # depth x chunked_mem_mb beyond the in-flight chunk; 1 = classic
     # lockstep (encode only after every server took the previous chunk)
     weight_update_pipeline_depth: int = 2
+    # --- peer-to-peer weight propagation (utils/propagation.py) ---
+    # relay the http chunk stream through the fleet instead of pushing a
+    # full copy per server: the trainer streams to weight_propagation_fanout
+    # ROOT servers and each server forwards staged chunks to at most that
+    # many children over POST /relay_weights (staging semantics — version
+    # tags, 412 delta guard, torn-stream supersede — apply per hop).
+    # Trainer egress per commit drops from N x to fanout x model bytes and
+    # commit latency goes O(log N). A parent that fails mid-stream falls
+    # back to direct trainer push for its subtree; OPEN-breaker servers
+    # never enter the tree (quarantine semantics unchanged). Off = the
+    # PR 5 per-server direct streams.
+    weight_propagation_enabled: bool = False
+    # trainer-side root count AND per-server relay fan-out (>= 1; 1 = a
+    # chain — minimal egress, maximal depth)
+    weight_propagation_fanout: int = 2
+    # shared secret for /relay_weights and /push_weights_to_peer (sent as
+    # x-areal-relay-token; servers check it against AREAL_RELAY_TOKEN).
+    # Empty = authentication off (single-tenant dev runs).
+    weight_propagation_token: str = ""
+    # warmup_server (fleet scale-out, stale-newcomer admission) first asks
+    # a healthy in-rotation peer to push its current weights to the
+    # newcomer (POST /push_weights_to_peer) and only falls back to the
+    # trainer's disk artifact — scale-out stops billing the trainer
+    peer_warmup: bool = True
     # per-server rollout concurrency: when set, the staleness manager's
     # max-concurrent-rollout capacity is rollouts_per_server x the LIVE
     # fleet size, recomputed on every membership change (scale-out raises

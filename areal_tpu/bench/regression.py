@@ -91,6 +91,10 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # higher is better; a drop toward 1 means the bounded reward plane
     # stopped protecting the rollout plane
     "reward_service": False,
+    # trainer-egress ratio relay/direct per weight commit: lower is
+    # better (the fabric's contract is <= fanout/N + 0.1; a climb back
+    # toward 1.0 means the tree stopped relaying)
+    "weight_propagation": True,
 }
 
 

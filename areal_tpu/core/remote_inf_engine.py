@@ -104,6 +104,23 @@ class RemoteInfEngine(InferenceEngine):
         # last disk weight-update meta, so a quarantined server's rejoin
         # probe can re-push the update it missed
         self._last_disk_update: tuple[str, int] | None = None
+        # how the last warmup_server call reached the required version
+        # ("ready" | "peer" | "disk" | None) — fleet-controller telemetry
+        self._last_warmup_source: str | None = None
+        # peer-to-peer propagation observability: trainer-NIC egress bytes
+        # (the relay fabric's headline — fanout x model bytes per commit
+        # instead of N x) and the hop depth of the last propagation tree
+        from areal_tpu.utils import metrics as _metrics
+
+        self._egress_trainer = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_weight_egress_bytes_total",
+            "weight bytes shipped, by which NIC paid for them",
+            labels=("source",),
+        ).labels(source="trainer")
+        self._g_prop_depth = _metrics.DEFAULT_REGISTRY.gauge(
+            "areal_weight_propagation_depth",
+            "hop depth of the last weight-propagation tree (1 = direct)",
+        )
         # persistent push loop: ONE long-lived event loop + aiohttp session
         # for every weight-update/fence fan-out, replacing the old
         # per-call asyncio.run (which built and tore down a loop, a
@@ -336,16 +353,21 @@ class RemoteInfEngine(InferenceEngine):
 
     def warmup_server(self, addr: str, timeout: float | None = None) -> bool:
         """Warm a newcomer before admitting it to rotation: wait for its
-        ``GET /ready`` gate (model loaded), then run the same version
-        check/re-push path the breaker rejoin probe uses — if the server
-        sits below the client's current version and a disk update artifact
-        exists, it is re-pushed and re-checked. Returns True when the
-        server is ready AT the current version (or no version has ever
-        been committed). Synchronous; runs on the persistent push loop."""
+        ``GET /ready`` gate (model loaded), then bring it to the current
+        weight version — PEER-SOURCED first when ``peer_warmup`` is on (a
+        healthy in-rotation server pushes its weights via
+        ``/push_weights_to_peer``, so scale-out stops billing the
+        trainer), falling back to the same disk re-push path the breaker
+        rejoin probe uses. Returns True when the server is ready AT the
+        current version (or no version has ever been committed); the
+        source that got it there lands in ``_last_warmup_source``
+        ("ready" | "peer" | "disk"). Synchronous; runs on the persistent
+        push loop."""
         deadline = time.monotonic() + (
             timeout if timeout is not None else self.config.setup_timeout
         )
         required = self._version
+        self._last_warmup_source = None
 
         async def _warm():
             session = await self._push_session()
@@ -366,17 +388,107 @@ class RemoteInfEngine(InferenceEngine):
             else:
                 return False
             if required <= 0:
+                self._last_warmup_source = "ready"
                 return True
+            if self.config.peer_warmup:
+                source = await self._warmup_from_peer(
+                    session, addr, required, deadline=deadline
+                )
+                if source is not None:
+                    # "ready" = the newcomer was already current and
+                    # nothing streamed; "peer" = a peer paid the egress
+                    self._last_warmup_source = source
+                    return True
             version = await self._probe_version(
                 session, addr, required, probe_timeout
             )
-            return version is not None and version >= required
+            ok = version is not None and version >= required
+            if ok:
+                self._last_warmup_source = "disk"
+            return ok
 
         try:
             return bool(self._run_push(_warm()))
         except Exception as e:
             logger.warning("warmup of %s failed: %s", addr, e)
             return False
+
+    async def _warmup_from_peer(
+        self, session, addr: str, required: int, deadline: float
+    ) -> str | None:
+        """Ask a healthy in-rotation peer to push its current weights to
+        ``addr`` (``POST /push_weights_to_peer``), then verify the
+        version ON THE NEWCOMER — the peer's success claim is not the
+        authority. Reads the newcomer's version FIRST (a restarted server
+        already at the required version must not trigger a full-model
+        re-stream — that case returns ``"ready"`` so the telemetry never
+        claims egress that didn't happen), tries up to two peers within
+        the caller's ``deadline`` budget, and returns ``"peer"`` on a
+        verified pull or ``None`` to send the caller to the disk-artifact
+        fallback. Works in pure-stream runs too (no disk artifact
+        needed), which is exactly when it matters most."""
+        from areal_tpu.utils import propagation
+
+        probe_timeout = self.config.breaker.probe_timeout_seconds
+
+        async def newcomer_version() -> int | None:
+            try:
+                async with session.get(
+                    f"http://{addr}/model_info",
+                    timeout=aiohttp.ClientTimeout(total=probe_timeout),
+                ) as resp:
+                    if resp.status != 200:
+                        return None
+                    info = await resp.json()
+                return int(info.get("weight_version") or 0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug(
+                    "peer warmup: version check of %s failed: %s", addr, e
+                )
+                return None
+
+        version = await newcomer_version()
+        if version is not None and version >= required:
+            return "ready"  # already current: nothing to stream
+        peers = [
+            a
+            for a in self.addresses
+            if a != addr and self._health.routable(a)
+        ]
+        token = self._relay_token()
+        headers = (
+            {propagation.RELAY_TOKEN_HEADER: token} if token else None
+        )
+        for peer in peers[:2]:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None  # budget spent; don't overshoot the caller
+            try:
+                await arequest_with_retry(
+                    session,
+                    f"http://{peer}/push_weights_to_peer",
+                    payload={"target": addr, "min_version": required},
+                    max_retries=1,
+                    timeout=max(1.0, remaining),
+                    headers=headers,
+                )
+            except asyncio.CancelledError:
+                raise
+            except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+                logger.info(
+                    "peer warmup of %s via %s failed: %s", addr, peer, e
+                )
+                continue
+            version = await newcomer_version()
+            if version is not None and version >= required:
+                logger.info(
+                    "peer warmup: %s reached v%s from peer %s",
+                    addr, version, peer,
+                )
+                return "peer"
+        return None
 
     def destroy(self):
         if getattr(self, "_health_collector", None) is not None:
@@ -1067,6 +1179,147 @@ class RemoteInfEngine(InferenceEngine):
             raise producer_error[0]
         return n_chunks, failed
 
+    def _relay_token(self) -> str:
+        from areal_tpu.utils import propagation
+
+        return self.config.weight_propagation_token or os.environ.get(
+            propagation.RELAY_TOKEN_ENV, ""
+        )
+
+    def _make_relay_sender(
+        self,
+        targets: list[str],
+        next_version: int,
+        delta_q: str,
+        direct_send: Callable,
+        relay_failed: dict[str, BaseException],
+    ) -> tuple[list[str], Callable]:
+        """Build the per-root ``send`` for a relayed tensor update.
+
+        The propagation tree is computed HERE — inside the caller's
+        ``_membership_lock`` fence, over the already-breaker-filtered
+        target list — so every chunk of this update sees the same tree
+        and an OPEN server never becomes a parent (it was quarantined by
+        ``_update_targets``, semantics unchanged). Per chunk, each root's
+        relay response names every subtree address that missed the chunk;
+        those addresses are pruned from the tree, re-sent the CURRENT
+        chunk directly, and served by direct trainer push from then on —
+        so a parent dying mid-stream degrades its subtree to the PR 5
+        direct path with no chunk ever skipped. An address whose direct
+        fallback ALSO fails lands in ``relay_failed`` (torn: it never
+        receives final, cannot commit, and is quarantined by the shared
+        post-stream policy)."""
+        import json as _json
+
+        from areal_tpu.utils import flight_recorder, propagation
+
+        fanout = max(1, self.config.weight_propagation_fanout)
+        tree = propagation.build_tree(targets, fanout)
+        roots = list(tree.keys())
+        target_set = set(targets)
+        tree_depth = propagation.depth(tree)
+        self._g_prop_depth.set(tree_depth)
+        token = self._relay_token()
+        # per-root: subtree members now served by direct trainer push
+        fallback: dict[str, list[str]] = {r: [] for r in roots}
+        flight_recorder.record(
+            "commits",
+            "relay_tree",
+            version=next_version,
+            n_targets=len(targets),
+            fanout=fanout,
+            depth=tree_depth,
+            roots=roots,
+        )
+        logger.info(
+            "weight propagation v%d: %d target(s) behind %d root(s) "
+            "(fanout=%d, depth=%d)",
+            next_version, len(targets), len(roots), fanout, tree_depth,
+        )
+
+        async def send(session, root: str, blob: bytes, final: bool):
+            sub_failed: dict[str, str] = {}
+            if root not in relay_failed:
+                headers = {
+                    propagation.RELAY_SUBTREE_HEADER: _json.dumps(tree[root])
+                }
+                if token:
+                    headers[propagation.RELAY_TOKEN_HEADER] = token
+                try:
+                    result = await arequest_with_retry(
+                        session,
+                        f"http://{root}/relay_weights"
+                        f"?version={next_version}&final={int(final)}"
+                        f"{delta_q}",
+                        data=blob,
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.request_timeout,
+                        chaos=self._chaos,
+                        headers=headers,
+                    )
+                    self._egress_trainer.inc(len(blob))
+                    sub_failed = dict(result.get("subtree_failed") or {})
+                except asyncio.CancelledError:
+                    raise
+                except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+                    # the parent itself is gone: it is torn (never gets
+                    # final, quarantined post-stream) and its whole
+                    # subtree missed this chunk — flatten it onto the
+                    # direct-push fallback
+                    relay_failed[root] = e
+                    sub_failed = {
+                        a: f"parent {root} failed: {str(e)[:120]}"
+                        for a in propagation.flatten(tree[root])
+                    }
+                    tree[root] = []
+                    flight_recorder.record(
+                        "commits",
+                        "relay_parent_failed",
+                        parent=root,
+                        version=next_version,
+                        error=str(e)[:200],
+                        fallback=len(sub_failed),
+                    )
+                for addr, why in sub_failed.items():
+                    if addr not in target_set:
+                        # a relay response must not be able to steer
+                        # direct pushes at addresses outside the fenced
+                        # target list
+                        continue
+                    if addr in relay_failed or addr in fallback[root]:
+                        continue
+                    logger.warning(
+                        "relay: %s missed a chunk of v%d via the tree "
+                        "(%s); falling back to direct push",
+                        addr, next_version, why,
+                    )
+                    propagation.prune(tree[root], addr)
+                    fallback[root].append(addr)
+            # the CURRENT chunk for every fallen-back subtree member —
+            # earlier chunks reached them through the (then-healthy) tree,
+            # later ones arrive here, so no address ever skips a chunk.
+            # Concurrent across addresses (a dead parent's whole subtree
+            # must not serialize into a per-chunk sweep); per-address
+            # order stays sequential because each address gets exactly
+            # one send per chunk and chunks are sequential per root.
+            async def _fallback_one(addr: str):
+                try:
+                    await direct_send(session, addr, blob, final)
+                except asyncio.CancelledError:
+                    raise
+                except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+                    relay_failed[addr] = e
+
+            pending_addrs = [
+                a for a in fallback[root] if a not in relay_failed
+            ]
+            if pending_addrs:
+                await asyncio.gather(
+                    *(_fallback_one(a) for a in pending_addrs)
+                )
+
+        return roots, send
+
     # ------------------------------------------------------------------
     # health probing (breaker OPEN -> HALF_OPEN path)
     # ------------------------------------------------------------------
@@ -1360,7 +1613,7 @@ class RemoteInfEngine(InferenceEngine):
             else ""
         )
 
-        async def send(session, addr: str, blob: bytes, final: bool):
+        async def direct_send(session, addr: str, blob: bytes, final: bool):
             await arequest_with_retry(
                 session,
                 f"http://{addr}/update_weights_from_tensor"
@@ -1370,14 +1623,31 @@ class RemoteInfEngine(InferenceEngine):
                 timeout=self.config.request_timeout,
                 chaos=self._chaos,
             )
+            self._egress_trainer.inc(len(blob))
+
+        fanout = max(1, self.config.weight_propagation_fanout)
+        relay_failed: dict[str, BaseException] = {}
+        if self.config.weight_propagation_enabled and len(targets) > fanout:
+            # peer-to-peer propagation: stream to `fanout` ROOT servers
+            # only; each hop stages and re-forwards (O(1) trainer egress)
+            stream_targets, send = self._make_relay_sender(
+                targets, next_version, delta_q, direct_send, relay_failed
+            )
+        else:
+            stream_targets, send = targets, direct_send
+            self._g_prop_depth.set(1 if targets else 0)
 
         async def _push_all():
             session = await self._push_session()
             return await self._stream_chunks_pipelined(
-                session, targets, chunks, prepare, send
+                session, stream_targets, chunks, prepare, send
             )
 
         n_chunks, failed = self._run_push(_push_all())
+        # a relay child that missed a chunk and then failed its direct
+        # fallback is torn exactly like a failed direct stream: it never
+        # received final, cannot have committed, and is quarantined below
+        failed = {**relay_failed, **failed}
         self._finish_streamed_update(
             "tensor weight update", next_version, targets, failed
         )

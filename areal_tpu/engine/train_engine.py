@@ -279,6 +279,12 @@ class TPUTrainEngine(TrainEngine):
         # of the previously-shipped leaves
         self._wire_fingerprints: dict[str, bytes] = {}
         self._wire_fp_addrs: tuple | None = None
+        # multi-host delta bookkeeping: spectators stash their fingerprint
+        # updates here (only the HEAD observes whether the push actually
+        # completed) and the next plan's outcome broadcast applies or
+        # discards the stash; the head records the outcome it saw
+        self._pending_wire_fp: dict[str, bytes] | None = None
+        self._last_delta_push_ok = False
         # last _perf_stats dict, mirrored into the metrics registry by a
         # scrape-time collector (PR 8 idiom: zero steady-state cost, and
         # /metrics agrees with the stats row by construction). MFU is in
@@ -1720,14 +1726,11 @@ class TPUTrainEngine(TrainEngine):
 
     @staticmethod
     def _walk_params(node, prefix=""):
-        """Sorted dotted-path iteration over a params tree's leaves."""
-        for k in sorted(node.keys()):
-            v = node[k]
-            path = f"{prefix}.{k}" if prefix else k
-            if isinstance(v, dict):
-                yield from TPUTrainEngine._walk_params(v, path)
-            else:
-                yield path, v
+        """Sorted dotted-path iteration over a params tree's leaves (the
+        canonical wire order — see utils/wire.walk_named_leaves)."""
+        from areal_tpu.utils.wire import walk_named_leaves
+
+        yield from walk_named_leaves(node, prefix)
 
     @staticmethod
     def _leaf_digest(arr) -> bytes:
@@ -1743,6 +1746,130 @@ class TPUTrainEngine(TrainEngine):
         # full-leaf byte copy per leaf per delta push
         h.update(np.ascontiguousarray(arr).view(np.uint8))
         return h.digest()
+
+    @staticmethod
+    def _leaf_local_digest(leaf) -> bytes:
+        """Content fingerprint of THIS process's addressable shards of a
+        (possibly cross-host sharded) leaf. Local-only on purpose:
+        hashing needs host bytes, and gathering every leaf just to
+        fingerprint it would cost the full-model gather delta sync
+        exists to avoid. Each host only ever compares its own digests
+        push-over-push; the cross-host ship decision is the allreduced
+        OR of the per-host changed verdicts
+        (:meth:`_multi_host_delta_plan`)."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(str(leaf.dtype).encode())
+        h.update(str(tuple(leaf.shape)).encode())
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:  # plain host/np leaf
+            h.update(np.ascontiguousarray(np.asarray(leaf)).view(np.uint8))
+            return h.digest()
+        # shard.index is a tuple of slices (not orderable); its repr is a
+        # deterministic sort key, and replica_id breaks replication ties
+        for s in sorted(
+            shards, key=lambda s: (str(s.index), s.replica_id)
+        ):
+            arr = np.ascontiguousarray(np.asarray(s.data))
+            h.update(arr.view(np.uint8))
+        return h.digest()
+
+    def _multi_host_delta_plan(self, target) -> tuple[set[str], dict]:
+        """Cross-host agreement on WHICH leaves a multi-host delta push
+        ships. Every trainer process walks the (structurally identical)
+        params tree in the same sorted order, digests its LOCAL shard
+        bytes per leaf, and contributes one changed bit; the head
+        contributes a RESET bit (the client's server set — which only it
+        sees — changed, voiding the delta baseline). ONE
+        ``sync_max_vector`` collective merges the bitmap: a leaf ships
+        if ANY host saw its shard change, and the reset bit forces a
+        full re-ship everywhere. Because the merged vector is identical
+        on every host, the per-leaf gather collectives inside the chunk
+        stream can never diverge — the loud error below fires only on
+        genuine post-broadcast disagreement (a diverged params tree or a
+        broken collective), never on ordinary sharded updates.
+
+        Returns ``(ship_paths, new_local_fingerprints)``; the caller
+        commits the fingerprints only after the push succeeds. (A failed
+        head push with already-updated spectator fingerprints is SAFE
+        here, unlike under per-host decisions: the head's changed bits
+        force the re-ship through the OR.)"""
+        import hashlib
+
+        # reconcile the PREVIOUS push's outcome first: spectators stashed
+        # their fingerprint updates (only the head observed whether that
+        # stream completed); one broadcast applies or discards the stash,
+        # so a head-failed push re-ships a leaf even when it changed only
+        # on a spectator's shard. All hosts are aligned here (entering
+        # update_weights together), so the collective cannot mismatch.
+        last_ok = distributed.broadcast_obj(
+            self._last_delta_push_ok if distributed.is_main() else None
+        )
+        pending, self._pending_wire_fp = self._pending_wire_fp, None
+        if not distributed.is_main() and pending is not None and last_ok:
+            self._wire_fingerprints.update(pending)
+        # armed for THIS push: an exception before the head's post-push
+        # commit leaves it False, and the next plan discards the stashes
+        self._last_delta_push_ok = False
+
+        paths: list[str] = []
+        local_digests: dict[str, bytes] = {}
+        changed: list[int] = []
+        for path, leaf in self._walk_params(self.effective_params()):
+            digest = self._leaf_local_digest(leaf)
+            paths.append(path)
+            local_digests[path] = digest
+            changed.append(
+                0 if self._wire_fingerprints.get(path) == digest else 1
+            )
+        reset = 0
+        if distributed.is_main():
+            addrs = tuple(sorted(getattr(target, "addresses", ()) or ()))
+            if addrs != self._wire_fp_addrs:
+                if self._wire_fp_addrs is not None:
+                    logger.info(
+                        "delta weight sync: server set changed; forcing a "
+                        "full re-ship"
+                    )
+                reset = 1
+                self._wire_fp_addrs = addrs
+        vec = np.asarray(changed + [reset], np.int64)
+        merged = distributed.sync_max_vector(vec, len(vec))
+        reset = bool(merged[-1])
+        ship = (
+            set(paths)
+            if reset
+            else {p for p, m in zip(paths, merged[:-1]) if m}
+        )
+        # post-broadcast verification: every host must now hold the SAME
+        # plan over the SAME leaf order, or the skip decisions would
+        # silently diverge mid-stream. This is the only condition that
+        # still raises on a multi-host delta push.
+        plan_digest = hashlib.blake2b(
+            "\n".join(paths).encode()
+            + b"|"
+            + merged.astype(np.int64).tobytes(),
+            digest_size=16,
+        ).hexdigest()
+        head_digest = distributed.broadcast_obj(
+            plan_digest if distributed.is_main() else None
+        )
+        if head_digest != plan_digest:
+            raise RuntimeError(
+                "multi-host delta weight sync: plan disagreement after "
+                f"broadcast (host {distributed.process_index()} computed "
+                f"{plan_digest}, head broadcast {head_digest}) — the "
+                "params trees or collectives have diverged; aborting "
+                "before a mixed stream can ship"
+            )
+        if reset:
+            self._wire_fingerprints.clear()
+        logger.info(
+            "multi-host delta plan: %d/%d leaves ship%s",
+            len(ship), len(paths), " (reset)" if reset else "",
+        )
+        return ship, local_digests
 
     def _chunked(self, chunk_mb: int, materialize, skip=None):
         """Group leaves into <= chunk_mb chunks (oversized single leaves
@@ -1799,6 +1926,7 @@ class TPUTrainEngine(TrainEngine):
         wire_dtype: str | None = None,
         delta_only: bool = False,
         new_fingerprints: dict | None = None,
+        ship_paths: set | None = None,
     ):
         """Yield dotted-path-named host-array chunks of <= chunk_mb MB
         each. The staging buffer holds one chunk at a time, bounding host
@@ -1822,7 +1950,15 @@ class TPUTrainEngine(TrainEngine):
             return np.asarray(jax.device_get(leaf))
 
         skip = None
-        if delta_only:
+        if delta_only and ship_paths is not None:
+            # multi-host: the ship set was agreed by the allreduced plan
+            # (one bitmap collective) BEFORE the stream, so every host
+            # skips identically — materialize still runs per leaf on
+            # every host, keeping the gather collectives aligned
+            def skip(path, arr):
+                return path not in ship_paths
+
+        elif delta_only:
             fingerprints = self._wire_fingerprints
 
             def skip(path, arr):
@@ -1889,16 +2025,17 @@ class TPUTrainEngine(TrainEngine):
             assert target is not None and hasattr(target, method), (
                 f"{meta.type} weight updates need a RemoteInfEngine"
             )
+            ship_paths: set | None = None
+            new_fp: dict[str, bytes] = {}
             if meta.delta_only and distributed.process_count() > 1:
-                # the full-re-ship reset below keys off the CLIENT's server
-                # list, which only the rollout head sees — spectator hosts
-                # would keep skipping leaves the head re-ships, and the
-                # per-leaf gather collectives would diverge (deadlock)
-                raise NotImplementedError(
-                    "delta_only weight sync is single-process-trainer only; "
-                    "multi-host needs the reset decision broadcast"
-                )
-            if meta.delta_only:
+                # multi-host delta: the full-re-ship reset keys off the
+                # CLIENT's server list, which only the rollout head sees —
+                # so the per-leaf ship decision (one changed-bitmap
+                # allreduce + the head's reset bit) is agreed across
+                # hosts BEFORE the stream; only post-broadcast
+                # disagreement raises (inside the plan)
+                ship_paths, new_fp = self._multi_host_delta_plan(target)
+            elif meta.delta_only:
                 # a changed server set (scale-up, replacement node) voids
                 # the delta baseline: a fresh server holds none of the
                 # previously-shipped leaves, so ship everything once
@@ -1911,12 +2048,12 @@ class TPUTrainEngine(TrainEngine):
                         )
                     self._wire_fingerprints.clear()
                     self._wire_fp_addrs = addrs
-            new_fp: dict[str, bytes] = {}
             chunks = self._weight_chunks(
                 meta.chunked_mem_mb,
                 wire_dtype=meta.wire_dtype,
                 delta_only=meta.delta_only,
                 new_fingerprints=new_fp,
+                ship_paths=ship_paths,
             )
             if distributed.process_count() > 1 and not distributed.is_main():
                 for _ in chunks:  # join the per-leaf gather collectives
@@ -1933,10 +2070,25 @@ class TPUTrainEngine(TrainEngine):
             else:
                 getattr(target, method)(chunks, next_version)
             if meta.delta_only:
-                # only after the push SUCCEEDED: a failed push must re-ship
-                # these leaves next time (quarantined servers rejoin via
-                # the version-checked disk re-push, not via deltas)
-                self._wire_fingerprints.update(new_fp)
+                if (
+                    distributed.process_count() > 1
+                    and not distributed.is_main()
+                ):
+                    # a spectator never learns THIS push's outcome (only
+                    # the head pushes): committing digests here after a
+                    # head-side failure would make a leaf changed only on
+                    # this host's shard read as unchanged on the retry —
+                    # a silently mixed tree on the servers. Stash instead;
+                    # the next plan's outcome broadcast applies or
+                    # discards the stash.
+                    self._pending_wire_fp = new_fp
+                else:
+                    # only after the push SUCCEEDED: a failed push must
+                    # re-ship these leaves next time (quarantined servers
+                    # rejoin via the version-checked disk re-push, not
+                    # via deltas)
+                    self._wire_fingerprints.update(new_fp)
+                    self._last_delta_push_ok = True
         elif meta.type == "device_transfer":
             # cross-process DEVICE-PATH resync: servers pull staged
             # buffers from this process's transfer server directly into

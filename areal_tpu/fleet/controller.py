@@ -77,6 +77,17 @@ class FleetController:
         self.config = config
         self.clock = clock
         self.provider = provider if provider is not None else build_provider(config)
+        # propagate the weight-propagation shared secret to spawned
+        # servers: the client-side knob alone would leave the servers'
+        # relay endpoints silently unauthenticated (they check
+        # AREAL_RELAY_TOKEN), which is exactly the misconfiguration an
+        # operator setting the knob believes they prevented
+        relay_token = getattr(
+            getattr(client, "config", None), "weight_propagation_token", ""
+        )
+        provider_env = getattr(self.provider, "env", None)
+        if relay_token and isinstance(provider_env, dict):
+            provider_env.setdefault("AREAL_RELAY_TOKEN", relay_token)
         self.policy = policy if policy is not None else build_policy(config, clock)
         # provider-owned members by address (a launcher-booted server has
         # no handle here; scale-in drains it via its name_resolve drain key)
@@ -389,6 +400,11 @@ class FleetController:
         self._note(
             "scale_out", addr=handle.addr, server_id=handle.server_id,
             reason=reason[:300], fleet=len(self.client.addresses),
+            # "peer" = the newcomer pulled the current weights from an
+            # in-rotation server (the trainer's NIC paid nothing);
+            # "disk" = the rejoin-artifact fallback; "ready"/None = no
+            # version check was needed
+            warmup_source=getattr(self.client, "_last_warmup_source", None),
         )
         self._trace_scale("out", handle.addr, reason)
         logger.info("scaled OUT: %s joined (%s)", handle.addr, reason)

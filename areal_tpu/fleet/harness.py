@@ -176,6 +176,51 @@ class SimServer:
     async def abort_request(self, request):
         return web.json_response({"success": True})
 
+    async def push_weights_to_peer(self, request):
+        """Peer-sourced warmup, sim edition: 'push our weights' to the
+        target by driving its version to ours through its own disk
+        endpoint (the sim server has no real tensors — version
+        propagation is the control-plane behavior under test). Refuses
+        when below min_version, exactly like the real server."""
+        body = await request.json()
+        target = body.get("target")
+        if not isinstance(target, str) or not target:
+            return web.json_response(
+                {"success": False, "message": "target address required"},
+                status=400,
+            )
+        required = int(body.get("min_version") or 0)
+        if self.version < required:
+            return web.json_response(
+                {
+                    "success": False,
+                    "weight_version": self.version,
+                    "message": f"peer holds v{self.version} < v{required}",
+                },
+                status=409,
+            )
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://{target}/update_weights_from_disk",
+                    json={
+                        "model_path": f"peer://{os.getpid()}",
+                        "version": self.version,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(f"target answered {resp.status}")
+        except Exception as e:
+            return web.json_response(
+                {"success": False, "message": str(e)[:200]}, status=500
+            )
+        return web.json_response(
+            {"success": True, "weight_version": self.version, "chunks": 1}
+        )
+
     def app(self) -> web.Application:
         app = web.Application()
         app.add_routes(
@@ -187,6 +232,7 @@ class SimServer:
                 web.post("/pause_generation", self.pause),
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
+                web.post("/push_weights_to_peer", self.push_weights_to_peer),
                 web.post("/abort_request", self.abort_request),
             ]
         )

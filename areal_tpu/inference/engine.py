@@ -475,6 +475,21 @@ class GenerationEngine:
         self.weight_sync_staged_chunks_total = 0
         self.weight_sync_staged_bytes_total = 0
         self.weight_sync_aborted_updates_total = 0
+        # peer-to-peer propagation (server-side relay/peer-push hops,
+        # incremented by GenerationServer; plain ints under the GIL):
+        # chunks/bytes this server forwarded to relay children, forwards
+        # that failed, last/total per-hop forward latency, and whole-model
+        # pushes served to warming peers
+        self.weight_relay_forwarded_chunks_total = 0
+        self.weight_relay_forwarded_bytes_total = 0
+        self.weight_relay_failed_forwards_total = 0
+        self.weight_relay_hop_seconds_last = 0.0
+        self.weight_relay_hop_seconds_total = 0.0
+        self.weight_peer_pushes_total = 0
+        # brackets every (params, version) co-publish so an exporter on
+        # another thread (peer push) can never read a new tree under the
+        # old version or vice versa; held only for pointer assignments
+        self._publish_lock = threading.Lock()
         self._lock = threading.Lock()
         self._dead: Exception | None = None
         # distributed tracing (utils/tracing.py): request spans arrive
@@ -1158,6 +1173,44 @@ class GenerationEngine:
                 self._staging_version = None
                 self.weight_sync_aborted_updates_total += 1
 
+    def snapshot_params_for_export(self) -> tuple[int, Any]:
+        """A (version, params-tree) pair that is guaranteed CONSISTENT:
+        every commit path publishes both under ``_publish_lock``, so a
+        commit racing this call can never pair the old tree with the new
+        version (or vice versa) — the exported weights are exactly the
+        weights that version served."""
+        with self._publish_lock:
+            return self.version, self.params
+
+    def export_weight_chunks(self, chunk_mb: int = 64):
+        """Yield the live params as dotted-path host-array chunks of
+        <= ``chunk_mb`` MB — the peer-sourcing half of weight
+        propagation: ``POST /push_weights_to_peer`` streams these to a
+        stale peer's /update_weights_from_tensor, so fleet scale-out
+        warms newcomers from an in-rotation server instead of billing
+        the trainer. Returns ``(version, generator)``; the tree
+        reference is captured once (:meth:`snapshot_params_for_export`),
+        so a commit mid-export cannot produce a mixed tree."""
+        from areal_tpu.utils.wire import walk_named_leaves
+
+        version, params = self.snapshot_params_for_export()
+        budget = max(1, int(chunk_mb)) * 1_000_000
+
+        def chunks():
+            cur: dict[str, Any] = {}
+            size = 0
+            for path, leaf in walk_named_leaves(params):
+                arr = np.asarray(jax.device_get(leaf))
+                if cur and size + arr.nbytes > budget:
+                    yield cur
+                    cur, size = {}, 0
+                cur[path] = arr
+                size += arr.nbytes
+            if cur:
+                yield cur
+
+        return version, chunks()
+
     def update_weights_from_named_arrays(
         self, named: dict, version: int | None = None
     ):
@@ -1328,6 +1381,22 @@ class GenerationEngine:
             "weight_sync_aborted_updates_total": (
                 self.weight_sync_aborted_updates_total
             ),
+            "weight_relay_forwarded_chunks_total": (
+                self.weight_relay_forwarded_chunks_total
+            ),
+            "weight_relay_forwarded_bytes_total": (
+                self.weight_relay_forwarded_bytes_total
+            ),
+            "weight_relay_failed_forwards_total": (
+                self.weight_relay_failed_forwards_total
+            ),
+            "weight_relay_hop_seconds_last": (
+                self.weight_relay_hop_seconds_last
+            ),
+            "weight_relay_hop_seconds_total": (
+                self.weight_relay_hop_seconds_total
+            ),
+            "weight_peer_pushes_total": self.weight_peer_pushes_total,
             "decode_dispatch_count": self.decode_dispatch_count,
         }
         if serving_stats is None:
@@ -1454,9 +1523,10 @@ class GenerationEngine:
                                 self._staged_leaves.pop(name, None)
                             if not self._staged_leaves:
                                 self._staging_version = None
-                    self.params = new_params
+                    with self._publish_lock:
+                        self.params = new_params
+                        self.version = version
                     self._lora_base = None  # base changed; re-snapshot
-                    self.version = version
                     self._on_weights_changed()
                     stall = time.monotonic() - t0
                     self.weight_sync_stall_seconds_last = stall
@@ -1519,11 +1589,12 @@ class GenerationEngine:
                     jax.block_until_ready(
                         [new_layers[leaf] for leaf in leaves]
                     )
-                    self.params = new_params
-                    if version is not None:
-                        self.version = version
-                    else:
-                        self.version += 1
+                    with self._publish_lock:
+                        self.params = new_params
+                        if version is not None:
+                            self.version = version
+                        else:
+                            self.version += 1
                     self._on_weights_changed()
                     self._stamp_active_spans(
                         "weight_commit", version=self.version
@@ -1549,7 +1620,7 @@ class GenerationEngine:
                     # adapter-only update must re-snapshot
                     self._lora_base = None
                     if cmd[0] == "update_weights":
-                        self.params = self._load_params_from(src)
+                        new = self._load_params_from(src)
                     else:
                         # force a copy: astype/device_put are no-ops for
                         # matching dtype+sharding, and aliasing the train
@@ -1564,9 +1635,15 @@ class GenerationEngine:
                             ),
                             self._shardings,
                         )
+                    jax.block_until_ready(jax.tree_util.tree_leaves(new)[0])
+                    # slow work (load/copy/readiness) stays OUTSIDE the
+                    # publish lock; only the pointer+version flip is inside
+                    with self._publish_lock:
                         self.params = new
-                    jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
-                    self.version = version if version is not None else self.version + 1
+                        self.version = (
+                            version if version is not None
+                            else self.version + 1
+                        )
                     self._on_weights_changed()
                     self._stamp_active_spans(
                         "weight_commit", version=self.version

@@ -22,18 +22,27 @@ concurrent generation requests.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
+import aiohttp
 from aiohttp import web
 
 from areal_tpu.api.cli_args import GenerationHyperparameters
 from areal_tpu.api.io_struct import SERVER_CLIENT_MAX_SIZE, ModelResponse
 from areal_tpu.inference.engine import GenerationEngine
-from areal_tpu.utils import logging
+from areal_tpu.utils import logging, propagation
 
 logger = logging.getLogger("GenerationServer")
+
+#: per-forward wall bound on one relay hop (the await covers the child's
+#: own staging AND its onward forwards, so deep trees take multiples of a
+#: single-chunk transfer — generous on purpose; the pushing client's own
+#: request_timeout is the real deadline)
+RELAY_FORWARD_TIMEOUT = 600.0
 
 
 def _gconfig_from_dict(d: dict[str, Any]) -> GenerationHyperparameters:
@@ -118,9 +127,30 @@ class GenerationServer:
                     self.update_weights_from_device,
                 ),
                 web.post("/update_lora_weights", self.update_lora_weights),
+                web.post("/relay_weights", self.relay_weights),
+                web.post("/push_weights_to_peer", self.push_weights_to_peer),
             ]
         )
         self._runner: web.AppRunner | None = None
+        # outbound client session for the propagation plane (relay-hop
+        # forwards + peer pushes); lazy so a server that never relays
+        # allocates nothing
+        self._relay_session_obj: aiohttp.ClientSession | None = None
+        from areal_tpu.utils import metrics as _metrics
+
+        self._relay_hop_hist = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_weight_relay_hop_seconds",
+            "wall seconds per relay-hop chunk forward (child stage + its "
+            "onward forwards included)",
+        )
+        self._egress_peer = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_weight_egress_bytes_total",
+            "weight bytes shipped, by which NIC paid for them",
+            labels=("source",),
+        ).labels(source="peer")
+        # one-shot misconfiguration signal: a client PRESENTED a relay
+        # token but this server has no expected one — auth is silently off
+        self._warned_unverified_token = False
         # blocking engine work (pause fences, weight staging/commits) runs
         # on this server-owned bounded executor, NEVER the event loop's
         # default pool — a wedged weight stage must not be able to starve
@@ -133,6 +163,32 @@ class GenerationServer:
     async def _offload(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
             self._blocking, fn, *args
+        )
+
+    def _delta_base_precondition(self, delta_base) -> web.Response | None:
+        """The HTTP 412 guard shared by every delta-capable weight-update
+        endpoint (tensor, shm, relay hop): a delta stream only contains
+        CHANGED leaves relative to ``delta_base``; applying it on any
+        other version (e.g. a server restarted at the same address with
+        reloaded base weights) would commit a silently mixed tree.
+        ``base + 1`` is accepted — the client lost the response of an
+        already-committed update and is retrying; re-applying the same
+        leaves is an idempotent no-op. 412 is non-retriable — the client
+        quarantines this server and the disk rejoin re-syncs it."""
+        if delta_base is None or self.engine.get_version() in (
+            int(delta_base),
+            int(delta_base) + 1,
+        ):
+            return None
+        return web.json_response(
+            {
+                "success": False,
+                "message": (
+                    f"delta update requires weight version {delta_base}"
+                    f" but this server is at {self.engine.get_version()}"
+                ),
+            },
+            status=412,
         )
 
     # -- handlers -------------------------------------------------------
@@ -302,29 +358,11 @@ class GenerationServer:
         body = await request.read()
         version = request.query.get("version")
         final = request.query.get("final", "1") == "1"
-        delta_base = request.query.get("delta_base")
-        if delta_base is not None and self.engine.get_version() not in (
-            int(delta_base),
-            # base+1: we already committed this update but the client lost
-            # the response and is retrying the final chunk — re-applying
-            # the same leaves is an idempotent no-op, not a mixed tree
-            int(delta_base) + 1,
-        ):
-            # a delta stream only contains CHANGED leaves relative to
-            # delta_base; applying it on any other version (e.g. a server
-            # restarted at the same address with reloaded base weights)
-            # would commit a silently mixed tree. 412 is non-retriable —
-            # the client quarantines us and the disk rejoin re-syncs.
-            return web.json_response(
-                {
-                    "success": False,
-                    "message": (
-                        f"delta update requires weight version {delta_base}"
-                        f" but this server is at {self.engine.get_version()}"
-                    ),
-                },
-                status=412,
-            )
+        refused = self._delta_base_precondition(
+            request.query.get("delta_base")
+        )
+        if refused is not None:
+            return refused
         try:
             arrs = wire.decode_named(st_load(body))
 
@@ -354,23 +392,9 @@ class GenerationServer:
         path = payload.get("path", "")
         version = payload.get("version")
         final = bool(payload.get("final", True))
-        delta_base = payload.get("delta_base")
-        if delta_base is not None and self.engine.get_version() not in (
-            int(delta_base),
-            int(delta_base) + 1,  # lost-response retry of a committed update
-        ):
-            # see update_weights_from_tensor: never apply a changed-leaves-
-            # only stream on a server at the wrong base version
-            return web.json_response(
-                {
-                    "success": False,
-                    "message": (
-                        f"delta update requires weight version {delta_base}"
-                        f" but this server is at {self.engine.get_version()}"
-                    ),
-                },
-                status=412,
-            )
+        refused = self._delta_base_precondition(payload.get("delta_base"))
+        if refused is not None:
+            return refused
         # resolve symlinks/..-segments BEFORE the containment check — a
         # startswith test alone is traversable ("/dev/shm/../etc/...")
         real = os.path.realpath(path)
@@ -481,6 +505,297 @@ class GenerationServer:
             {"success": True, "weight_version": self.engine.get_version()}
         )
 
+    # -- peer-to-peer weight propagation --------------------------------
+
+    def _relay_session(self) -> aiohttp.ClientSession:
+        if self._relay_session_obj is None or self._relay_session_obj.closed:
+            self._relay_session_obj = aiohttp.ClientSession()
+        return self._relay_session_obj
+
+    def _note_unverified_token(self, presented: str | None) -> None:
+        """A client sent a relay token but this server has none configured
+        (AREAL_RELAY_TOKEN unset): the operator set the client-side knob
+        and believes the endpoints are authenticated — they are not. Warn
+        once, loudly."""
+        if (
+            presented
+            and not self._warned_unverified_token
+            and not propagation.expected_token()
+        ):
+            self._warned_unverified_token = True
+            logger.warning(
+                "a relay token was presented but AREAL_RELAY_TOKEN is "
+                "unset on this server — /relay_weights and "
+                "/push_weights_to_peer are UNAUTHENTICATED here; export "
+                "the token into the server environment"
+            )
+
+    async def relay_weights(self, request: web.Request) -> web.Response:
+        """One hop of the propagation tree: the body is a verbatim
+        /update_weights_from_tensor chunk; this server STAGES it locally
+        (the exact PR 5 path — version tags, torn-stream supersede, and
+        the delta 412 guard all apply per hop, so a relay can never
+        half-commit) and concurrently forwards the raw bytes to each
+        child named in the ``x-areal-relay-subtree`` header, each child
+        receiving its own subtree. The response reports every subtree
+        address that missed THIS chunk (``subtree_failed``), so the
+        pushing client can re-send the chunk directly and serve that
+        subtree itself from then on — a dead parent degrades to direct
+        trainer push, never to a torn commit."""
+        from areal_tpu.utils.http import (
+            TRANSPORT_ERRORS,
+            HTTPRequestError,
+            arequest_with_retry,
+        )
+
+        token = request.headers.get(propagation.RELAY_TOKEN_HEADER)
+        if not propagation.token_ok(token):
+            return web.json_response(
+                {"success": False, "message": "bad or missing relay token"},
+                status=403,
+            )
+        self._note_unverified_token(token)
+        body = await request.read()
+        version = request.query.get("version")
+        final = request.query.get("final", "1") == "1"
+        # the per-hop 412 guard: a relay hop at the wrong base version
+        # refuses a delta stream for ITSELF — its children check their
+        # own versions on their own hops
+        refused = self._delta_base_precondition(
+            request.query.get("delta_base")
+        )
+        if refused is not None:
+            return refused
+        try:
+            subtree = propagation.validate_subtree(
+                json.loads(
+                    request.headers.get(
+                        propagation.RELAY_SUBTREE_HEADER, "[]"
+                    )
+                )
+            )
+        except (ValueError, json.JSONDecodeError, RecursionError) as e:
+            # RecursionError: a hostile/corrupt deeply-nested header is a
+            # caller error (400, fail fast), not a retriable 500
+            return web.json_response(
+                {"success": False, "message": f"bad relay subtree: {e}"},
+                status=400,
+            )
+        failed: dict[str, str] = {}
+        session = self._relay_session()
+
+        async def forward(node: dict) -> None:
+            addr = node["addr"]
+            t0 = time.monotonic()
+            try:
+                headers = {
+                    propagation.RELAY_SUBTREE_HEADER: json.dumps(
+                        node["children"]
+                    )
+                }
+                if token:
+                    headers[propagation.RELAY_TOKEN_HEADER] = token
+                result = await arequest_with_retry(
+                    session,
+                    f"http://{addr}/relay_weights?{request.query_string}",
+                    data=body,
+                    max_retries=2,
+                    timeout=RELAY_FORWARD_TIMEOUT,
+                    headers=headers,
+                )
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — a child failure is
+                # data for the pushing client, never a hop failure
+                self.engine.weight_relay_failed_forwards_total += 1
+                failed[addr] = str(e)[:200]
+                for a in propagation.flatten(node["children"]):
+                    # the whole subtree missed this chunk: the parent that
+                    # would have forwarded it is the one that failed
+                    failed[a] = f"parent {addr} failed: {str(e)[:120]}"
+                from areal_tpu.utils import flight_recorder
+
+                flight_recorder.record(
+                    "commits",
+                    "relay_hop_failed",
+                    child=addr,
+                    subtree=len(node["children"]),
+                    error=str(e)[:200],
+                )
+                return
+            dt = time.monotonic() - t0
+            eng = self.engine
+            eng.weight_relay_forwarded_chunks_total += 1
+            eng.weight_relay_forwarded_bytes_total += len(body)
+            eng.weight_relay_hop_seconds_last = dt
+            eng.weight_relay_hop_seconds_total += dt
+            self._relay_hop_hist.observe(dt)
+            self._egress_peer.inc(len(body))
+            from areal_tpu.utils import flight_recorder
+
+            flight_recorder.record(
+                "commits",
+                "relay_hop",
+                child=addr,
+                bytes=len(body),
+                final=final,
+                version=version,
+                hop_seconds=round(dt, 4),
+            )
+            for a, why in (result.get("subtree_failed") or {}).items():
+                failed[a] = why
+
+        from safetensors.numpy import load as st_load
+
+        from areal_tpu.utils import wire
+
+        try:
+            arrs = wire.decode_named(st_load(body))
+
+            def stage_and_maybe_commit():
+                tag = int(version) if version is not None else None
+                self.engine.stage_weight_chunk(arrs, tag)
+                if final and tag is not None:
+                    self.engine.commit_staged_weights(tag)
+
+            # local staging and child forwards overlap; a child failure
+            # lands in `failed`, only a LOCAL failure 500s the hop (the
+            # client then direct-pushes this whole subtree — children
+            # that already staged via our forward re-stage idempotently)
+            results = await asyncio.gather(
+                *(forward(n) for n in subtree),
+                self._offload(stage_and_maybe_commit),
+                return_exceptions=True,
+            )
+            if isinstance(results[-1], BaseException):
+                raise results[-1]
+        except Exception as e:
+            logger.exception("relay_weights failed")
+            return web.json_response(
+                {
+                    "success": False,
+                    "message": str(e),
+                    "subtree_failed": failed,
+                },
+                status=500,
+            )
+        return web.json_response(
+            {
+                "success": True,
+                "weight_version": self.engine.get_version(),
+                "subtree_failed": failed,
+            }
+        )
+
+    async def push_weights_to_peer(self, request: web.Request) -> web.Response:
+        """Peer-sourced weight transfer: stream THIS server's current
+        weights to ``target``'s /update_weights_from_tensor. The
+        scale-out warmup path (RemoteInfEngine.warmup_server) asks a
+        healthy in-rotation peer first and falls back to the trainer's
+        disk artifact — so growing the fleet stops billing the trainer's
+        NIC for a full model copy per newcomer."""
+        from areal_tpu.utils.http import arequest_with_retry
+
+        peer_token = request.headers.get(propagation.RELAY_TOKEN_HEADER)
+        if not propagation.token_ok(peer_token):
+            return web.json_response(
+                {"success": False, "message": "bad or missing relay token"},
+                status=403,
+            )
+        self._note_unverified_token(peer_token)
+        body = await request.json()
+        target = body.get("target")
+        if not isinstance(target, str) or not target:
+            return web.json_response(
+                {"success": False, "message": "target address required"},
+                status=400,
+            )
+        min_version = int(body.get("min_version") or 0)
+        chunk_mb = int(body.get("chunk_mb") or 64)
+        if self.engine.get_version() < min_version:
+            # refusing is the correct answer: the warmup client tries
+            # another peer (or the disk artifact) rather than admitting a
+            # server warmed to a stale version
+            return web.json_response(
+                {
+                    "success": False,
+                    "weight_version": self.engine.get_version(),
+                    "message": (
+                        f"peer holds v{self.engine.get_version()} < "
+                        f"required v{min_version}"
+                    ),
+                },
+                status=409,
+            )
+
+        from safetensors.numpy import save as st_save
+
+        from areal_tpu.utils import wire
+
+        version, chunks = self.engine.export_weight_chunks(chunk_mb)
+        it = iter(chunks)
+
+        def next_blob() -> bytes | None:
+            cur = next(it, None)
+            if cur is None:
+                return None
+            blob = st_save(wire.encode_named(cur))
+            if len(blob) > SERVER_CLIENT_MAX_SIZE:
+                raise ValueError(
+                    f"peer-push chunk is {len(blob)} bytes (> "
+                    f"client_max_size={SERVER_CLIENT_MAX_SIZE}); lower "
+                    "chunk_mb"
+                )
+            return blob
+
+        session = self._relay_session()
+        n = 0
+        sent_bytes = 0
+        try:
+            # gather/encode runs off the event loop; the send pipeline is
+            # sequential per chunk (final must arrive last — it commits)
+            cur = await self._offload(next_blob)
+            if cur is None:
+                raise RuntimeError("engine exported no weight chunks")
+            while cur is not None:
+                nxt = await self._offload(next_blob)
+                final = nxt is None
+                await arequest_with_retry(
+                    session,
+                    f"http://{target}/update_weights_from_tensor"
+                    f"?version={version}&final={int(final)}",
+                    data=cur,
+                    max_retries=2,
+                    timeout=RELAY_FORWARD_TIMEOUT,
+                )
+                n += 1
+                sent_bytes += len(cur)
+                cur = nxt
+        except Exception as e:
+            logger.exception("push_weights_to_peer -> %s failed", target)
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        self.engine.weight_peer_pushes_total += 1
+        self._egress_peer.inc(sent_bytes)
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record(
+            "commits",
+            "peer_push",
+            target=target,
+            version=version,
+            chunks=n,
+            bytes=sent_bytes,
+        )
+        logger.info(
+            "peer push: %d chunk(s) (v%d, %.1f MB) -> %s",
+            n, version, sent_bytes / 1e6, target,
+        )
+        return web.json_response(
+            {"success": True, "weight_version": version, "chunks": n}
+        )
+
     # -- lifecycle ------------------------------------------------------
 
     async def start(self, host: str, port: int) -> int:
@@ -497,5 +812,9 @@ class GenerationServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        if self._relay_session_obj is not None:
+            if not self._relay_session_obj.closed:
+                await self._relay_session_obj.close()
+            self._relay_session_obj = None
         self._blocking.shutdown(wait=False, cancel_futures=True)
         self.engine.stop()

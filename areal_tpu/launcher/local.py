@@ -111,10 +111,18 @@ def _spawn_servers(cfg, alloc: AllocationMode) -> list:
     procs = []
     n_servers = _n_boot_servers(cfg, alloc)
     template = _server_argv_template(cfg, alloc)
+    relay_token = getattr(
+        getattr(cfg, "rollout", None), "weight_propagation_token", ""
+    )
     for i in range(n_servers):
         env = dict(os.environ)
         server_id = f"server{i}"
         env["AREAL_SERVER_ID"] = server_id
+        if relay_token:
+            # the client-side knob alone would leave the servers' relay
+            # and peer-push endpoints silently UNAUTHENTICATED (they
+            # check AREAL_RELAY_TOKEN); an explicit env var still wins
+            env.setdefault("AREAL_RELAY_TOKEN", relay_token)
         env.update(cfg.launcher.inference_server_env_vars)
         argv = [
             a.replace("server.port={port}", f"server.port={cfg.server.port}")

@@ -1,0 +1,148 @@
+"""Peer-to-peer weight-propagation topology shared by the trainer client
+and the inference servers.
+
+The PR 5 weight sync streams one full copy of the model from the trainer
+to EVERY server (``_stream_chunks_pipelined`` is per-server), so trainer
+NIC egress scales O(N * model_size) per commit — the scaling ceiling once
+the PR 12 autoscaler grows the fleet under load. This module holds the
+topology half of the fix: the trainer pushes each chunk stream to a small
+set of ROOT servers (``weight_propagation_fanout``), and each server
+relays staged chunks to its children over ``POST /relay_weights``
+(inference/server.py). Trainer egress drops to fanout x model bytes and
+commit latency goes O(log N) in the fleet size.
+
+Wire format of a subtree (the ``x-areal-relay-subtree`` header): a JSON
+list of nodes ``{"addr": "host:port", "children": [...]}`` — each relay
+hop stages the chunk locally (the verbatim PR 5
+``stage_weight_chunk``/``commit_staged_weights`` path, so per-version
+tags, the HTTP 412 delta-base guard, and torn-stream supersede all apply
+PER HOP) and forwards the raw body to each child with the child's own
+``children`` as the next header.
+
+Authentication: the relay hop and the peer-push endpoint trigger
+outbound pushes and weight overwrites, so they carry a shared-secret
+token (``x-areal-relay-token``). The server reads its expected token
+from ``AREAL_RELAY_TOKEN`` (set by the launcher) or accepts everything
+when unset; comparison is constant-time.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+
+#: header carrying the JSON subtree a relay hop is responsible for
+RELAY_SUBTREE_HEADER = "x-areal-relay-subtree"
+#: shared-secret header authenticating /relay_weights and
+#: /push_weights_to_peer
+RELAY_TOKEN_HEADER = "x-areal-relay-token"
+#: server-side source of the expected token (launcher-exported)
+RELAY_TOKEN_ENV = "AREAL_RELAY_TOKEN"
+
+
+def build_tree(targets: list[str], fanout: int) -> dict[str, list[dict]]:
+    """Balanced d-ary propagation forest over ``targets``: the first
+    ``fanout`` addresses are roots (direct trainer push); every later
+    address hangs under the earliest node with spare child capacity
+    (breadth-first), so depth is O(log_fanout N) and every node forwards
+    to at most ``fanout`` children. Deterministic in the input order —
+    the caller passes the fenced target list, so every chunk of one
+    update sees the same tree."""
+    fanout = max(1, int(fanout))
+    roots: dict[str, list[dict]] = {}
+    bfs: list[dict] = []  # nodes in attach order, each with a children list
+    for addr in targets:
+        node = {"addr": addr, "children": []}
+        if len(roots) < fanout:
+            roots[addr] = node["children"]
+            bfs.append(node)
+            continue
+        # earliest node with spare capacity: BFS order keeps the forest
+        # balanced (depth grows only when a whole level is full)
+        for parent in bfs:
+            if len(parent["children"]) < fanout:
+                parent["children"].append(node)
+                break
+        bfs.append(node)
+    return roots
+
+
+def flatten(nodes: list[dict]) -> list[str]:
+    """Every address in a subtree, preorder (iterative: relay trees are
+    shallow, but a hostile header must not recurse past the limit)."""
+    out: list[str] = []
+    stack = list(reversed(nodes))
+    while stack:
+        node = stack.pop()
+        out.append(node["addr"])
+        stack.extend(reversed(node.get("children") or []))
+    return out
+
+
+def prune(nodes: list[dict], addr: str) -> list[dict]:
+    """Remove the node for ``addr`` (and its whole subtree) from a
+    children list, in place at every level. Returns ``nodes`` for
+    chaining. Descendants of a failed node are reported individually by
+    the relay response, so pruning the subtree wholesale never drops an
+    address silently — every member either stays in the tree or was
+    already handed to the direct-push fallback."""
+    stack = [nodes]
+    while stack:
+        children = stack.pop()
+        for i, node in enumerate(children):
+            if node["addr"] == addr:
+                del children[i]
+                break
+            stack.append(node.get("children") or [])
+    return nodes
+
+
+def depth(roots: dict[str, list[dict]]) -> int:
+    """Hop count of the deepest path (1 = trainer -> root only)."""
+    best = 1 if roots else 0
+
+    def walk(nodes: list[dict], d: int) -> None:
+        nonlocal best
+        for node in nodes:
+            best = max(best, d)
+            walk(node.get("children") or [], d + 1)
+
+    for children in roots.values():
+        walk(children, 2)
+    return best
+
+
+def validate_subtree(nodes) -> list[dict]:
+    """Parse-time validation of a relay header: a list of
+    ``{"addr": str, "children": [...]}`` nodes. Raises ``ValueError`` on
+    anything else — a malformed header must 400, not 500-and-retry."""
+    if not isinstance(nodes, list):
+        raise ValueError("relay subtree must be a JSON list")
+    out = []
+    for node in nodes:
+        if not isinstance(node, dict) or not isinstance(
+            node.get("addr"), str
+        ):
+            raise ValueError("relay subtree nodes need a string 'addr'")
+        out.append(
+            {
+                "addr": node["addr"],
+                "children": validate_subtree(node.get("children") or []),
+            }
+        )
+    return out
+
+
+def expected_token() -> str:
+    """The server's expected relay token ('' = authentication off)."""
+    return os.environ.get(RELAY_TOKEN_ENV, "")
+
+
+def token_ok(presented: str | None, expected: str | None = None) -> bool:
+    """Constant-time token check. An empty expected token disables
+    authentication (single-tenant dev runs); a configured one rejects
+    missing or mismatched headers."""
+    expected = expected_token() if expected is None else expected
+    if not expected:
+        return True
+    return hmac.compare_digest(presented or "", expected)

@@ -18,6 +18,23 @@ import numpy as np
 BF16_MARKER = "::bf16"
 
 
+def walk_named_leaves(node, prefix: str = ""):
+    """Sorted dotted-path iteration over a nested-dict params tree's
+    leaves — THE canonical wire order. Every producer of a named chunk
+    stream (trainer delta/full pushes, the serving engine's peer-push
+    export) must walk in this order: the multi-host delta plan's
+    collectives and the per-leaf fingerprints both key on it, so a
+    second, subtly different traversal would silently desynchronize
+    hosts or digests."""
+    for k in sorted(node.keys()):
+        v = node[k]
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from walk_named_leaves(v, path)
+        else:
+            yield path, v
+
+
 def encode_named(named: dict) -> dict:
     """Prepare a dotted-path -> array chunk for safetensors: contiguous,
     with bfloat16 leaves re-viewed as uint16 under ``path + BF16_MARKER``."""

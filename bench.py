@@ -1039,6 +1039,269 @@ def weight_sync_bench(layers: int = 2, vocab: int = 2048, chunk_mb: int = 64,
         eng.stop()
 
 
+def weight_propagation_bench(layers: int = 2, vocab: int = 2048,
+                             hidden: int = 256, inter: int = 512,
+                             chunk_mb: int = 2, batch: int = 4,
+                             steps_per_call: int = 4, max_seq_len: int = 512,
+                             n_servers: int = 4, fanout: int = 2):
+    """Peer-to-peer weight propagation vs direct per-server streams at a
+    simulated ``n_servers`` fleet (REAL GenerationServers, tiny model).
+
+    Headline: the trainer-egress ratio relay/direct per commit — the
+    fabric's contract is <= fanout/N + 0.1 (the trainer pays for the
+    root streams only; every other server is fed by a peer relay hop).
+    Also reported: commit wall latency both modes, the tokens/s window
+    on a live decoding server during each update, and a mid-stream
+    relay-parent kill (children fall back to direct push; zero torn
+    commits). Greedy output identity relay-on vs relay-off is HARD
+    asserted in-child — an egress win on diverging outputs would be a
+    staging bug, not a speedup."""
+    import asyncio
+    import threading
+    import types
+    import urllib.request
+    import json as _json
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    model_cfg = TransformerConfig(
+        arch="qwen2", vocab_size=vocab, hidden_size=hidden,
+        intermediate_size=inter, num_hidden_layers=layers,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=64,
+        rope_theta=1e6, attention_bias=True, tie_word_embeddings=True,
+    )
+    import jax as _jax
+
+    from areal_tpu.models.lm import init_params as _init_params
+
+    engines = []
+    servers = []
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    addrs = []
+    for _ in range(n_servers):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=batch, max_seq_len=max_seq_len,
+                prefill_chunk=128, decode_steps_per_call=steps_per_call,
+                dtype="float32", page_size=max_seq_len,
+            ),
+            model_config=model_cfg,
+            params=_init_params(model_cfg, _jax.random.PRNGKey(0),
+                                _jax.numpy.float32),
+        )
+        server = GenerationServer(eng)
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=120)
+        engines.append(eng)
+        servers.append(server)
+        addrs.append(f"127.0.0.1:{port}")
+
+    client = RemoteInfEngine(InferenceEngineConfig(request_retries=1))
+    client.addresses = list(addrs)
+
+    from areal_tpu.utils.wire import walk_named_leaves
+
+    shapes = [
+        (path, tuple(leaf.shape))
+        for path, leaf in walk_named_leaves(engines[0].params)
+    ]
+    payload_bytes = sum(int(np.prod(s)) * 4 for _, s in shapes)
+
+    def chunks(seed: int):
+        crng = np.random.default_rng(seed)
+        budget = chunk_mb * 1_000_000
+        cur, size = {}, 0
+        for path, shape in shapes:
+            arr = crng.standard_normal(size=shape).astype(np.float32)
+            if cur and size + arr.nbytes > budget:
+                yield cur
+                cur, size = {}, 0
+            cur[path] = arr
+            size += arr.nbytes
+        if cur:
+            yield cur
+
+    def model_info(addr):
+        with urllib.request.urlopen(
+            f"http://{addr}/model_info", timeout=10
+        ) as resp:
+            return _json.loads(resp.read())
+
+    def trainer_egress():
+        return DEFAULT_REGISTRY.counter(
+            "areal_weight_egress_bytes_total", labels=("source",)
+        ).labels(source="trainer").value
+
+    def greedy(eng, prompt, max_new=16):
+        done = threading.Event()
+        out = []
+        eng.submit(
+            f"greedy-{time.monotonic_ns()}", list(prompt),
+            GenerationHyperparameters(
+                max_new_tokens=max_new, min_new_tokens=max_new, greedy=True
+            ),
+            lambda r: (out.append(r), done.set()),
+        )
+        assert done.wait(120), "greedy probe timed out"
+        return list(out[0].output_tokens)
+
+    rng = np.random.default_rng(0)
+    stop = threading.Event()
+
+    def load_loop():
+        sem = threading.Semaphore(batch)
+        i = 0
+        gcfg = GenerationHyperparameters(
+            max_new_tokens=64, min_new_tokens=64, temperature=1.0
+        )
+        while not stop.is_set():
+            sem.acquire()
+
+            def cb(r, _s=sem):
+                _s.release()
+
+            try:
+                engines[0].submit(
+                    f"load-{i}",
+                    rng.integers(1, vocab - 2, size=24).tolist(), gcfg, cb,
+                )
+            except RuntimeError:
+                return
+            i += 1
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+
+    def tps_window(fn):
+        a = engines[0].generated_tokens_total
+        t0 = time.perf_counter()
+        fn()
+        dt = max(time.perf_counter() - t0, 1e-6)
+        return (engines[0].generated_tokens_total - a) / dt, dt
+
+    class TearOn:
+        def __init__(self, needle, n_ok):
+            self.needle, self.n_ok, self.seen = needle, n_ok, 0
+
+        def decide(self, url):
+            if self.needle in url:
+                self.seen += 1
+                if self.seen > self.n_ok:
+                    return types.SimpleNamespace(kind="disconnect")
+            return None
+
+    try:
+        deadline = time.time() + 300
+        while engines[0].generated_tokens_total < 64 and time.time() < deadline:
+            time.sleep(0.1)
+        assert engines[0].generated_tokens_total >= 64, "load never warmed"
+        probe = rng.integers(1, vocab - 2, size=16).tolist()
+
+        # --- DIRECT: per-server streams (the PR 5 baseline) -----------
+        e0 = trainer_egress()
+        direct_tps, direct_latency = tps_window(
+            lambda: client.update_weights_from_tensors(chunks(1), 1)
+        )
+        egress_direct = trainer_egress() - e0
+        assert all(model_info(a)["weight_version"] == 1 for a in addrs)
+        greedy_direct = greedy(engines[0], probe)
+
+        # --- RELAY: same chunk bytes through the propagation tree -----
+        client.config.weight_propagation_enabled = True
+        client.config.weight_propagation_fanout = fanout
+        e0 = trainer_egress()
+        relay_tps, relay_latency = tps_window(
+            lambda: client.update_weights_from_tensors(chunks(1), 2)
+        )
+        egress_relay = trainer_egress() - e0
+        assert all(model_info(a)["weight_version"] == 2 for a in addrs)
+        greedy_relay = greedy(engines[0], probe)
+        # HARD gate: identical chunk bytes -> identical weights -> the
+        # relay hop must be token-invisible to greedy serving
+        assert greedy_relay == greedy_direct, (
+            "greedy outputs diverged relay-on vs relay-off"
+        )
+        # cross-fleet identity: every relay-fed server serves the exact
+        # same function as the root the trainer fed directly
+        fleet_outs = [greedy(e, probe) for e in engines]
+        assert all(o == fleet_outs[0] for o in fleet_outs), (
+            "relay-fed servers diverged from the root"
+        )
+        egress_ratio = egress_relay / max(egress_direct, 1.0)
+        assert egress_ratio <= fanout / n_servers + 0.1, (
+            f"trainer egress ratio {egress_ratio:.3f} exceeds "
+            f"{fanout}/{n_servers} + 0.1"
+        )
+
+        # --- chaos: kill the first relay parent mid-stream ------------
+        client._last_disk_update = ("/ckpt/rejoin", 3)
+        client._chaos = TearOn(f"{addrs[0]}/relay_weights", n_ok=1)
+        client.update_weights_from_tensors(chunks(2), 3)
+        client._chaos = None
+        versions = [model_info(a)["weight_version"] for a in addrs]
+        # the dead parent stays cleanly at the OLD version; everyone
+        # else (its children included, via direct fallback) commits —
+        # nobody holds a half-applied tree
+        torn = sum(1 for v in versions if v not in (2, 3))
+        assert torn == 0, f"torn commits: {versions}"
+        assert versions[0] == 2 and versions.count(3) == n_servers - 1, (
+            versions
+        )
+        committed = [
+            greedy(e, probe)
+            for e, v in zip(engines, versions)
+            if v == 3
+        ]
+        assert all(o == committed[0] for o in committed), (
+            "fallback-fed children diverged after the parent kill"
+        )
+        # the dead parent still serves its old weights token-exactly
+        assert greedy(engines[0], probe) == greedy_relay
+
+        return {
+            "trainer_egress_ratio": round(egress_ratio, 4),
+            "egress_direct_mb": round(egress_direct / 1e6, 2),
+            "egress_relay_mb": round(egress_relay / 1e6, 2),
+            "payload_mb": round(payload_bytes / 1e6, 2),
+            "direct_commit_s": round(direct_latency, 3),
+            "relay_commit_s": round(relay_latency, 3),
+            "direct_window_tokens_per_sec": round(direct_tps, 1),
+            "relay_window_tokens_per_sec": round(relay_tps, 1),
+            "n_servers": n_servers,
+            "fanout": fanout,
+            "propagation_depth": int(
+                DEFAULT_REGISTRY.gauge(
+                    "areal_weight_propagation_depth"
+                ).value
+            ),
+            "parent_kill_torn_commits": torn,
+            "greedy_identical": True,
+        }
+    finally:
+        stop.set()
+        client._close_push_loop()
+        for server in servers:
+            asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+                timeout=30
+            )
+        loop.call_soon_threadsafe(loop.stop)
+
+
 def reward_service_bench(n_episodes: int = 12, tokens_per_episode: int = 120,
                          token_time: float = 0.01, gen_stagger: float = 0.2,
                          wedged_frac: float = 0.5, wedge_hold: float = 8.0,
@@ -2022,6 +2285,41 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("weight_sync_stall_seconds", "weight-sync", e)
 
+    # ---- rung 3.65: peer-to-peer weight propagation — trainer egress
+    # relay vs direct per-server streams at a simulated 4-server fleet
+    # (real servers, tiny model; greedy identity + zero-torn-commit
+    # parent-kill chaos are hard gates in the child). value is the
+    # trainer-egress ratio — the contract is <= fanout/N + 0.1. ----
+    if remaining(deadline) > 300:
+        try:
+            log("weight-propagation rung")
+            wp = _run_child(
+                "wprop",
+                (dict(layers=2, vocab=2048, hidden=256, inter=512,
+                      chunk_mb=2, batch=4, n_servers=4, fanout=2)
+                 if REHEARSAL
+                 else dict(layers=4, vocab=8192, hidden=512, inter=1024,
+                           chunk_mb=32, batch=4, n_servers=4, fanout=2)),
+                timeout=min(900.0, remaining(deadline) - 60),
+            )
+            assert wp["parent_kill_torn_commits"] == 0
+            assert wp["trainer_egress_ratio"] <= (
+                wp["fanout"] / wp["n_servers"] + 0.1
+            )
+            emit({
+                "metric": "weight_propagation",
+                "value": wp["trainer_egress_ratio"],
+                "unit": "x_trainer_egress_relay_vs_direct",
+                "vs_baseline": wp["trainer_egress_ratio"],
+                "chip": chip,
+                **{k: v for k, v in wp.items()
+                   if k != "trainer_egress_ratio"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure(
+                "weight_propagation", "weight-propagation", e
+            )
+
     # ---- rung 3.7: elastic fleet — autoscaling on vs off under a load
     # spike (control-plane rung: sim serving substrate, real subprocesses +
     # HTTP; failed-request count and greedy identity are hard gates in the
@@ -2208,6 +2506,8 @@ def _child_main():
         print(json.dumps(weight_update_bench(**att)))
     elif kind == "--wsync-child":
         print(json.dumps(weight_sync_bench(**att)))
+    elif kind == "--wprop-child":
+        print(json.dumps(weight_propagation_bench(**att)))
     elif kind == "--fleet-child":
         print(json.dumps(elastic_fleet_bench(**att)))
     elif kind == "--reward-child":

@@ -891,17 +891,21 @@ def test_rollouts_per_server_applies_at_initialize():
 
 
 def test_warmup_repushes_missed_disk_update(tmp_path):
-    """The version-checked warmup: a newcomer that comes up at version 0
-    while the fleet is at version 2 gets the last disk update re-pushed
-    before it may enter rotation."""
+    """The version-checked warmup ladder: a newcomer that comes up at
+    version 0 while the fleet is at version 2 is warmed — peer-sourced
+    when a healthy in-rotation peer holds the version (the trainer's NIC
+    pays nothing), disk re-push as the fallback — before it may enter
+    rotation; with NO capable source it never does."""
     prov = LocalSubprocessProvider(argv_template=sim_argv())
     client = None
     try:
         from areal_tpu.utils.network import find_free_ports
 
         h = prov.spawn("w0", find_free_ports(1)[0])
+        # peer_warmup off: this first leg pins the PR 12 disk-re-push path
         client = make_client(
-            [h.addr], experiment_name="fleet-warm", trial_name="t"
+            [h.addr], experiment_name="fleet-warm", trial_name="t",
+            peer_warmup=False,
         )
         # wait for the sim server to come up
         ctl = FleetController(client, make_fleet_config(), provider=prov)
@@ -913,9 +917,11 @@ def test_warmup_repushes_missed_disk_update(tmp_path):
         client.set_version(2)
         client._last_disk_update = (str(tmp_path / "ckpt"), 2)
         assert client.warmup_server(h.addr, timeout=15.0) is True
+        assert client._last_warmup_source == "disk"
         info = ctl._fetch_info(h.addr)
         assert info["weight_version"] == 2
-        # without a rejoin artifact, a stale newcomer must NOT pass
+        # without a rejoin artifact AND without peer warmup, a stale
+        # newcomer must NOT pass — it never enters rotation unwarmed
         h2 = prov.spawn("w1", find_free_ports(1)[0])
         t0 = time.monotonic()
         while time.monotonic() - t0 < 15:
@@ -924,6 +930,24 @@ def test_warmup_repushes_missed_disk_update(tmp_path):
             time.sleep(0.05)
         client._last_disk_update = None
         assert client.warmup_server(h2.addr, timeout=3.0) is False
+        assert client._last_warmup_source is None
+        # peer-sourced warmup: with the fabric on, the same artifact-less
+        # newcomer warms from the in-rotation peer already at v2 —
+        # scale-out stops billing the trainer
+        client.config.peer_warmup = True
+        assert client.warmup_server(h2.addr, timeout=15.0) is True
+        assert client._last_warmup_source == "peer"
+        assert ctl._fetch_info(h2.addr)["weight_version"] == 2
+        # ... and with no peer capable of the required version, it is
+        # still refused rather than admitted stale
+        client.set_version(3)
+        h3 = prov.spawn("w2", find_free_ports(1)[0])
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15:
+            if ctl._fetch_ready_status(h3.addr) == 200:
+                break
+            time.sleep(0.05)
+        assert client.warmup_server(h3.addr, timeout=3.0) is False
     finally:
         if client is not None:
             client.destroy()

@@ -1,0 +1,817 @@
+"""Peer-to-peer weight propagation (PR 15).
+
+The contract under test, end to end against REAL servers:
+
+- **O(1) trainer egress**: with propagation on, the trainer streams each
+  chunk to ``fanout`` ROOT servers only; the fleet relays the rest over
+  ``POST /relay_weights`` (staging reuses the PR 5
+  stage/commit/412/supersede machinery verbatim, per hop). Every server
+  commits the same weights; trainer egress is fanout x payload, not N x.
+- **Fallback**: a relay parent killed mid-stream is torn (never gets
+  final, quarantined) while its CHILDREN fall back to direct trainer
+  push and commit cleanly — no chunk skipped, no torn commit anywhere.
+- **Per-hop 412 guard**: a relay child at the wrong delta base refuses
+  through the hop AND through the direct fallback, and is quarantined
+  like any torn stream.
+- **Peer-sourced warmup**: ``warmup_server`` pulls the current version
+  from a healthy in-rotation peer (``/push_weights_to_peer``) before
+  falling back to the disk artifact — including in pure-stream runs
+  with no artifact at all.
+- **Auth**: with ``AREAL_RELAY_TOKEN`` set, both propagation endpoints
+  refuse missing/wrong tokens.
+- **Multi-host delta plan**: the allreduced changed-leaf bitmap merges
+  per-host verdicts (ship if ANY host changed), the head's reset bit
+  forces a full re-ship, and only post-broadcast disagreement raises.
+"""
+
+import asyncio
+import json
+import threading
+import time
+import types
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxGenConfig,
+)
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils import propagation
+from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _walk(node, prefix=""):
+    for k in sorted(node.keys()):
+        v = node[k]
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+def _flat_host(params) -> dict:
+    return {p: np.asarray(jax.device_get(v)) for p, v in _walk(params)}
+
+
+def _split_chunks(flat: dict, n: int) -> list[dict]:
+    items = list(flat.items())
+    per = max(1, (len(items) + n - 1) // n)
+    return [dict(items[i : i + per]) for i in range(0, len(items), per)]
+
+
+def _make_engine(seed: int = 0) -> GenerationEngine:
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=4,
+            max_seq_len=2048,
+            prefill_chunk=64,
+            decode_steps_per_call=2,
+            dtype="float32",
+        ),
+        model_config=cfg,
+        params=params,
+    )
+
+
+class _Fleet:
+    """N real GenerationServers (identical init weights) on one loop."""
+
+    def __init__(self, n: int):
+        self.engines = [_make_engine(seed=0) for _ in range(n)]
+        self.servers = [GenerationServer(e) for e in self.engines]
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True
+        )
+        self._thread.start()
+        self.addrs: list[str] = []
+        for s in self.servers:
+            port = asyncio.run_coroutine_threadsafe(
+                s.start("127.0.0.1", 0), self.loop
+            ).result(timeout=60)
+            self.addrs.append(f"127.0.0.1:{port}")
+
+    def engine(self, addr: str) -> GenerationEngine:
+        return self.engines[self.addrs.index(addr)]
+
+    def model_info(self, addr: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://{addr}/model_info", timeout=10
+        ) as resp:
+            return json.loads(resp.read())
+
+    def close(self):
+        for s in self.servers:
+            asyncio.run_coroutine_threadsafe(s.stop(), self.loop).result(30)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+def _client(addrs, **cfg) -> RemoteInfEngine:
+    cfg.setdefault("experiment_name", "wp")
+    cfg.setdefault("trial_name", "t")
+    cfg.setdefault("request_retries", 1)
+    eng = RemoteInfEngine(InferenceEngineConfig(**cfg))
+    eng.addresses = list(addrs)
+    return eng
+
+
+def _greedy(eng: GenerationEngine, prompt, max_new=16) -> list[int]:
+    done = threading.Event()
+    out = []
+
+    def cb(r):
+        out.append(r)
+        done.set()
+
+    eng.submit(
+        "g-%d" % time.monotonic_ns(),
+        list(prompt),
+        GenerationHyperparameters(
+            max_new_tokens=max_new, min_new_tokens=max_new, greedy=True
+        ),
+        cb,
+    )
+    assert done.wait(120), "generation timed out"
+    return list(out[0].output_tokens)
+
+
+def _trainer_egress() -> float:
+    return DEFAULT_REGISTRY.counter(
+        "areal_weight_egress_bytes_total",
+        labels=("source",),
+    ).labels(source="trainer").value
+
+
+class TearOn:
+    """Client-side chaos: disconnect every request whose url matches
+    ``needle`` after ``n_ok`` matching requests went through."""
+
+    def __init__(self, needle: str, n_ok: int = 0):
+        self.needle, self.n_ok, self.seen = needle, n_ok, 0
+
+    def decide(self, url):
+        if self.needle in url:
+            self.seen += 1
+            if self.seen > self.n_ok:
+                return types.SimpleNamespace(kind="disconnect")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# topology unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_build_tree_covers_every_target_once():
+    targets = [f"s{i}:1" for i in range(7)]
+    tree = propagation.build_tree(targets, fanout=2)
+    assert list(tree.keys()) == ["s0:1", "s1:1"]
+    flat = list(tree.keys())
+    for children in tree.values():
+        flat += propagation.flatten(children)
+    assert sorted(flat) == sorted(targets)
+    # balanced: 7 nodes at fanout 2 = 3 hops (2 roots, 4 mid, 1 leaf)
+    assert propagation.depth(tree) == 3
+    # fanout 1 = a chain: depth N
+    chain = propagation.build_tree(targets, fanout=1)
+    assert propagation.depth(chain) == 7
+    # every node relays to at most `fanout` children
+    def max_children(nodes):
+        m = len(nodes)
+        for n in nodes:
+            m = max(m, max_children(n["children"]))
+        return m
+
+    for children in tree.values():
+        assert max_children(children) <= 2
+
+
+def test_prune_and_flatten():
+    tree = propagation.build_tree(["a", "b", "c", "d", "e"], fanout=2)
+    children = tree["a"]
+    before = propagation.flatten(children)
+    assert "c" in before
+    propagation.prune(children, "c")
+    after = propagation.flatten(children)
+    assert "c" not in after
+    # pruning an inner node drops its subtree wholesale
+    tree2 = propagation.build_tree(list("abcdefg"), fanout=1)
+    propagation.prune(tree2["a"], "b")  # b heads the whole chain under a
+    assert propagation.flatten(tree2["a"]) == []
+
+
+def test_token_check_constant_time_semantics():
+    assert propagation.token_ok(None, "")  # auth off
+    assert propagation.token_ok("anything", "")
+    assert propagation.token_ok("s3cret", "s3cret")
+    assert not propagation.token_ok(None, "s3cret")
+    assert not propagation.token_ok("wrong", "s3cret")
+
+
+# ---------------------------------------------------------------------------
+# tentpole: relayed fan-out against real servers
+# ---------------------------------------------------------------------------
+
+
+def test_relay_fanout_e2e_egress_and_token_identity():
+    """4 servers, fanout 2: the trainer streams to 2 roots only; every
+    server commits the same weights, greedy outputs are token-identical
+    to a direct push of the same chunks, and trainer egress is half the
+    direct push's."""
+    fleet = _Fleet(4)
+    control = _make_engine(seed=0)  # direct-push reference
+    client = _client(
+        fleet.addrs,
+        weight_propagation_enabled=True,
+        weight_propagation_fanout=2,
+    )
+    try:
+        new_params = init_params(
+            fleet.engines[0].model_config, jax.random.PRNGKey(7), jnp.float32
+        )
+        flat = _flat_host(new_params)
+        chunks = _split_chunks(flat, 3)
+        payload = sum(a.nbytes for a in flat.values())
+
+        e0 = _trainer_egress()
+        client.update_weights_from_tensors(list(chunks), next_version=1)
+        egress_relay = _trainer_egress() - e0
+        for addr in fleet.addrs:
+            info = fleet.model_info(addr)
+            assert info["weight_version"] == 1, addr
+            flat_live = _flat_host(fleet.engine(addr).params)
+            for p in flat:
+                np.testing.assert_array_equal(flat_live[p], flat[p])
+        # trainer paid for the ROOT streams only (fanout=2 of 4 servers);
+        # safetensors overhead keeps it from being exactly 2 x payload
+        assert egress_relay < 2.5 * payload, (egress_relay, payload)
+        # the non-root servers were fed by peers, not the trainer
+        relayed = sum(
+            fleet.model_info(a)["weight_relay_forwarded_chunks_total"]
+            for a in fleet.addrs
+        )
+        assert relayed == 2 * len(chunks)  # 2 non-root servers x chunks
+        # per-hop latency surfaced via /model_info (and therefore the
+        # /metrics collector — same snapshot by construction)
+        assert any(
+            fleet.model_info(a)["weight_relay_hop_seconds_total"] > 0
+            for a in fleet.addrs
+        )
+
+        # greedy identity vs a direct in-process application of the same
+        # chunks: the relay hop must be byte-invisible to serving
+        control.start()
+        for c in chunks[:-1]:
+            control.stage_weight_chunk(dict(c), 1)
+        control.stage_weight_chunk(dict(chunks[-1]), 1)
+        control.commit_staged_weights(1)
+        fleet.engines[0].start()
+        prompt = np.random.default_rng(3).integers(1, 120, size=8).tolist()
+        assert _greedy(fleet.engines[0], prompt) == _greedy(control, prompt)
+    finally:
+        client._close_push_loop()
+        control.stop()
+        fleet.close()
+
+
+def test_relay_direct_egress_is_n_times():
+    """The baseline the fabric beats: direct mode pays N x payload."""
+    fleet = _Fleet(3)
+    client = _client(fleet.addrs)  # propagation off
+    try:
+        flat = _flat_host(
+            init_params(
+                fleet.engines[0].model_config,
+                jax.random.PRNGKey(7),
+                jnp.float32,
+            )
+        )
+        payload = sum(a.nbytes for a in flat.values())
+        e0 = _trainer_egress()
+        client.update_weights_from_tensors(_split_chunks(flat, 3), 1)
+        egress = _trainer_egress() - e0
+        assert egress > 2.9 * payload
+    finally:
+        client._close_push_loop()
+        fleet.close()
+
+
+def test_relay_parent_killed_mid_stream_children_fall_back():
+    """Chaos: the first root's /relay_weights dies after one chunk. Its
+    child must receive every remaining chunk (and final) by direct
+    trainer push and commit cleanly; the dead parent stays at the old
+    version with valid weights (torn-stream semantics, quarantined); no
+    server anywhere half-commits."""
+    fleet = _Fleet(4)
+    client = _client(
+        fleet.addrs,
+        weight_propagation_enabled=True,
+        weight_propagation_fanout=2,
+        update_weights_min_healthy_fraction=0.5,
+    )
+    # degraded mode needs a rejoin artifact for the quarantine probe
+    client._last_disk_update = ("/ckpt/v0", 1)
+    r0 = fleet.addrs[0]
+    client._chaos = TearOn(f"{r0}/relay_weights", n_ok=1)
+    try:
+        flat = _flat_host(
+            init_params(
+                fleet.engines[0].model_config,
+                jax.random.PRNGKey(7),
+                jnp.float32,
+            )
+        )
+        chunks = _split_chunks(flat, 4)
+        assert len(chunks) == 4
+        client.update_weights_from_tensors(list(chunks), next_version=1)
+        # the dead parent: old version, zero commits, quarantined at v1
+        info = fleet.model_info(r0)
+        assert info["weight_version"] == 0
+        assert info["weight_sync_commits_total"] == 0
+        assert client._health.required_version(r0) == 1
+        # everyone else — including the dead parent's CHILD — committed
+        # the full update
+        for addr in fleet.addrs[1:]:
+            info = fleet.model_info(addr)
+            assert info["weight_version"] == 1, addr
+            flat_live = _flat_host(fleet.engine(addr).params)
+            for p in flat:
+                np.testing.assert_array_equal(flat_live[p], flat[p])
+        # the dead parent still serves valid OLD weights
+        fleet.engines[0].start()
+        out = _greedy(
+            fleet.engines[0],
+            np.random.default_rng(3).integers(1, 120, size=8).tolist(),
+            max_new=4,
+        )
+        assert len(out) == 4
+        # the fallback left a postmortem trail
+        from areal_tpu.utils import flight_recorder
+
+        kinds = [
+            e["kind"]
+            for e in flight_recorder.DEFAULT_RECORDER.snapshot()[
+                "channels"
+            ].get("commits", [])
+        ]
+        assert "relay_parent_failed" in kinds
+        assert "relay_tree" in kinds
+    finally:
+        client._close_push_loop()
+        fleet.close()
+
+
+def test_relay_delta_412_guard_pinned_per_hop():
+    """A relay CHILD at the wrong delta base refuses the stream through
+    the hop, refuses the direct fallback identically (HTTP 412), and
+    ends quarantined — never holding a mixed tree."""
+    fleet = _Fleet(3)
+    client = _client(
+        fleet.addrs,
+        weight_propagation_enabled=True,
+        weight_propagation_fanout=1,  # chain: a0 -> a1 -> a2
+        update_weights_min_healthy_fraction=0.3,
+    )
+    client._last_disk_update = ("/ckpt/v1", 1)
+    try:
+        flat = _flat_host(
+            init_params(
+                fleet.engines[0].model_config,
+                jax.random.PRNGKey(7),
+                jnp.float32,
+            )
+        )
+        chunks = _split_chunks(flat, 3)
+        # full relay push lands everywhere
+        client.update_weights_from_tensors(list(chunks), next_version=1)
+        assert [fleet.model_info(a)["weight_version"] for a in fleet.addrs] == [1, 1, 1]
+        # the LAST hop silently restarts at v0
+        tail = fleet.addrs[-1]
+        fleet.engine(tail).set_version(0)
+        client.update_weights_from_tensors(
+            [chunks[0]], next_version=2, delta_base_version=1
+        )
+        # upstream hops committed the delta; the restarted tail refused
+        # (never moved) and is quarantined for the disk rejoin
+        assert fleet.model_info(fleet.addrs[0])["weight_version"] == 2
+        assert fleet.model_info(fleet.addrs[1])["weight_version"] == 2
+        assert fleet.model_info(tail)["weight_version"] == 0
+        assert client._health.required_version(tail) == 2
+    finally:
+        client._close_push_loop()
+        fleet.close()
+
+
+def test_relay_staging_is_token_invisible_until_commit():
+    """Relay-on vs relay-off across a STAGED (uncommitted) stream:
+    serving stays on the old weights token-exactly until the final
+    chunk's commit, on every hop."""
+    fleet = _Fleet(2)
+    client = _client(
+        fleet.addrs,
+        weight_propagation_enabled=True,
+        weight_propagation_fanout=1,
+    )
+    try:
+        prompt = np.random.default_rng(5).integers(1, 120, size=8).tolist()
+        for e in fleet.engines:
+            e.start()
+        before = [_greedy(e, prompt) for e in fleet.engines]
+        assert before[0] == before[1]
+        flat = _flat_host(
+            init_params(
+                fleet.engines[0].model_config,
+                jax.random.PRNGKey(7),
+                jnp.float32,
+            )
+        )
+        chunks = _split_chunks(flat, 3)
+        # stream all but the final chunk through the relay chain: staged
+        # on BOTH hops, committed on neither
+        import aiohttp
+
+        async def _partial():
+            async with aiohttp.ClientSession() as s:
+                from safetensors.numpy import save as st_save
+
+                from areal_tpu.utils import wire
+
+                for c in chunks[:-1]:
+                    blob = st_save(wire.encode_named(c))
+                    sub = json.dumps(
+                        [{"addr": fleet.addrs[1], "children": []}]
+                    )
+                    async with s.post(
+                        f"http://{fleet.addrs[0]}/relay_weights"
+                        "?version=1&final=0",
+                        data=blob,
+                        headers={propagation.RELAY_SUBTREE_HEADER: sub},
+                    ) as resp:
+                        assert resp.status == 200
+                        body = await resp.json()
+                        assert body["subtree_failed"] == {}
+
+        asyncio.run(_partial())
+        for i, e in enumerate(fleet.engines):
+            assert e.get_version() == 0
+            assert e.weight_sync_staged_chunks_total >= 1, i
+        # staged-but-uncommitted is invisible: greedy unchanged
+        assert [_greedy(e, prompt) for e in fleet.engines] == before
+    finally:
+        client._close_push_loop()
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# peer-sourced warmup
+# ---------------------------------------------------------------------------
+
+
+def test_peer_push_endpoint_and_warmup_prefers_peer():
+    """A stale server warms from a healthy peer's /push_weights_to_peer
+    — no disk artifact anywhere (the pure-stream case the disk-only
+    rejoin path cannot serve)."""
+    fleet = _Fleet(2)
+    a, b = fleet.addrs
+    client = _client([a, b], peer_warmup=True)
+    try:
+        flat = _flat_host(
+            init_params(
+                fleet.engines[0].model_config,
+                jax.random.PRNGKey(7),
+                jnp.float32,
+            )
+        )
+        # bring only A to v1 (direct single-target push)
+        client.addresses = [a]
+        client.update_weights_from_tensors(_split_chunks(flat, 2), 1)
+        client.addresses = [a, b]
+        assert fleet.engine(a).get_version() == 1
+        assert fleet.engine(b).get_version() == 0
+        # warmup B: peer-sourced (no _last_disk_update exists)
+        assert client._last_disk_update is None
+        assert client.warmup_server(b, timeout=30.0) is True
+        assert client._last_warmup_source == "peer"
+        assert fleet.engine(b).get_version() == 1
+        flat_b = _flat_host(fleet.engine(b).params)
+        for p in flat:
+            np.testing.assert_array_equal(flat_b[p], flat[p])
+        assert fleet.engine(a).weight_peer_pushes_total == 1
+        # with peer warmup off and no artifact, the same stale server
+        # would have been refused
+        fleet.engine(b).set_version(0)
+        client.config.peer_warmup = False
+        assert client.warmup_server(b, timeout=3.0) is False
+    finally:
+        client._close_push_loop()
+        fleet.close()
+
+
+def test_peer_push_refuses_below_min_version():
+    fleet = _Fleet(2)
+    a, b = fleet.addrs
+    try:
+        import aiohttp
+
+        async def _ask():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{a}/push_weights_to_peer",
+                    json={"target": b, "min_version": 5},
+                ) as resp:
+                    return resp.status, await resp.json()
+
+        status, body = asyncio.run(_ask())
+        assert status == 409
+        assert body["success"] is False
+        assert fleet.engine(b).get_version() == 0
+    finally:
+        fleet.close()
+
+
+def test_relay_endpoints_require_token_when_configured(monkeypatch):
+    monkeypatch.setenv(propagation.RELAY_TOKEN_ENV, "s3cret")
+    fleet = _Fleet(1)
+    addr = fleet.addrs[0]
+    try:
+        import aiohttp
+
+        async def _post(path, headers=None, payload=None, data=None):
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{addr}{path}",
+                    json=payload,
+                    data=data,
+                    headers=headers,
+                ) as resp:
+                    return resp.status
+
+        # missing / wrong token -> 403 on both propagation endpoints
+        assert asyncio.run(_post("/relay_weights?version=1", data=b"")) == 403
+        assert (
+            asyncio.run(
+                _post(
+                    "/relay_weights?version=1",
+                    data=b"",
+                    headers={propagation.RELAY_TOKEN_HEADER: "nope"},
+                )
+            )
+            == 403
+        )
+        assert (
+            asyncio.run(
+                _post(
+                    "/push_weights_to_peer",
+                    payload={"target": "x:1"},
+                )
+            )
+            == 403
+        )
+        # the right token passes the gate (and then fails on the empty
+        # body, which is a 500 — authentication happened first)
+        assert (
+            asyncio.run(
+                _post(
+                    "/relay_weights?version=1",
+                    data=b"",
+                    headers={propagation.RELAY_TOKEN_HEADER: "s3cret"},
+                )
+            )
+            != 403
+        )
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-host delta plan (emulated collectives)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sft_engine():
+    from areal_tpu.api.cli_args import TrainEngineConfig
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.sft.lm_engine import TPULMEngine
+
+    cfg = TrainEngineConfig(path="", init_from_scratch=True, optimizer=None)
+    cfg.backend.param_dtype = "float32"
+    cfg.backend.remat = False
+    eng = TPULMEngine(cfg)
+    eng.initialize(
+        None,
+        FinetuneSpec(total_train_epochs=1, dataset_size=8, train_batch_size=4),
+        model_config=tiny_config(),
+    )
+    return eng
+
+
+def _patch_two_hosts(monkeypatch, other_changed_bits, my_index=0):
+    """Emulate a 2-host run for _multi_host_delta_plan: sync_max_vector
+    merges our vector with a scripted peer's; broadcast_obj echoes the
+    head's object (we ARE the head when my_index == 0)."""
+    from areal_tpu.engine import train_engine as te
+
+    calls = {}
+
+    def fake_sync_max_vector(values, length):
+        mine = np.zeros(length, np.int64)
+        mine[: len(values)] = values
+        other = np.zeros(length, np.int64)
+        bits = other_changed_bits(length)
+        other[: len(bits)] = bits
+        calls["merged"] = np.maximum(mine, other)
+        return calls["merged"]
+
+    monkeypatch.setattr(
+        te.distributed, "process_count", lambda: 2
+    )
+    monkeypatch.setattr(
+        te.distributed, "process_index", lambda: my_index
+    )
+    monkeypatch.setattr(
+        te.distributed, "is_main", lambda: my_index == 0
+    )
+    monkeypatch.setattr(
+        te.distributed, "sync_max_vector", fake_sync_max_vector
+    )
+    monkeypatch.setattr(te.distributed, "broadcast_obj", lambda obj: obj)
+    return calls
+
+
+class _Target:
+    addresses = ["a:1", "b:1"]
+
+
+def test_multi_host_delta_plan_merges_or(sft_engine, monkeypatch):
+    eng = sft_engine
+    # establish a baseline: first plan ships everything (reset: the
+    # server set was never seen)
+    _patch_two_hosts(monkeypatch, lambda n: [0] * n)
+    ship, fp = eng._multi_host_delta_plan(_Target())
+    n_leaves = len(fp)
+    assert len(ship) == n_leaves  # reset -> full ship
+    eng._wire_fingerprints.update(fp)
+    # steady state, nothing changed anywhere: nothing ships
+    ship, fp = eng._multi_host_delta_plan(_Target())
+    assert ship == set()
+    # the OTHER host saw leaf 0 change -> the OR forces it to ship here
+    # even though our local shard is unchanged
+    _patch_two_hosts(
+        monkeypatch, lambda n: [1] + [0] * (n - 1)
+    )
+    ship, fp = eng._multi_host_delta_plan(_Target())
+    assert len(ship) == 1
+    assert next(iter(ship)) == sorted(fp.keys())[0]
+
+
+def test_multi_host_delta_plan_reset_bit_forces_full_reship(
+    sft_engine, monkeypatch
+):
+    eng = sft_engine
+    _patch_two_hosts(monkeypatch, lambda n: [0] * n)
+    ship, fp = eng._multi_host_delta_plan(_Target())
+    eng._wire_fingerprints.update(fp)
+
+    class _Grown:
+        addresses = ["a:1", "b:1", "c:1"]  # scale-out voids the baseline
+
+    ship2, _ = eng._multi_host_delta_plan(_Grown())
+    assert len(ship2) == len(fp)  # full re-ship
+    assert eng._wire_fingerprints == {}  # baseline cleared everywhere
+
+
+def test_multi_host_delta_plan_disagreement_raises(sft_engine, monkeypatch):
+    eng = sft_engine
+    from areal_tpu.engine import train_engine as te
+
+    _patch_two_hosts(monkeypatch, lambda n: [0] * n)
+    # the head broadcasts a DIFFERENT plan digest than we computed —
+    # diverged params trees / broken collective: loud failure, before
+    # any chunk ships
+    monkeypatch.setattr(
+        te.distributed, "broadcast_obj", lambda obj: "not-our-digest"
+    )
+    monkeypatch.setattr(te.distributed, "is_main", lambda: False)
+    monkeypatch.setattr(te.distributed, "process_index", lambda: 1)
+    with pytest.raises(RuntimeError, match="plan disagreement"):
+        eng._multi_host_delta_plan(_Target())
+
+
+def test_multi_host_delta_spectator_stash_follows_head_outcome(
+    sft_engine, monkeypatch
+):
+    """Spectators must not commit fingerprints for a push whose outcome
+    only the HEAD observed: the next plan's outcome broadcast applies the
+    stash after a successful push and discards it after a failed one —
+    so a leaf changed only on a spectator's shard still re-ships on the
+    retry (no silently mixed tree)."""
+    eng = sft_engine
+    from areal_tpu.engine import train_engine as te
+
+    class MatchesAnything:
+        # stands in for the head's plan digest: this test exercises the
+        # outcome broadcast, not the disagreement check
+        def __eq__(self, other):
+            return True
+
+        def __ne__(self, other):
+            return False
+
+    script: list = []
+
+    monkeypatch.setattr(te.distributed, "process_count", lambda: 2)
+    monkeypatch.setattr(te.distributed, "process_index", lambda: 1)
+    monkeypatch.setattr(te.distributed, "is_main", lambda: False)
+    monkeypatch.setattr(
+        te.distributed,
+        "sync_max_vector",
+        lambda values, length: np.asarray(
+            list(values) + [0] * (length - len(values)), np.int64
+        ),
+    )
+    monkeypatch.setattr(
+        te.distributed, "broadcast_obj", lambda obj: script.pop(0)
+    )
+
+    script[:] = [True, MatchesAnything()]  # no pending stash yet
+    ship, fp = eng._multi_host_delta_plan(_Target())
+    assert len(ship) == len(fp) > 0  # empty fingerprints: everything ships
+
+    # the spectator-side push stashes instead of committing; head FAILED
+    eng._pending_wire_fp = dict(fp)
+    script[:] = [False, MatchesAnything()]
+    ship2, _ = eng._multi_host_delta_plan(_Target())
+    assert eng._wire_fingerprints == {}, "failed-push stash must discard"
+    assert len(ship2) == len(fp), "discarded stash must force a re-ship"
+    assert eng._pending_wire_fp is None
+
+    # same stash, but the head reports SUCCESS: stash commits, steady
+    # state ships nothing
+    eng._pending_wire_fp = dict(fp)
+    script[:] = [True, MatchesAnything()]
+    ship3, _ = eng._multi_host_delta_plan(_Target())
+    assert eng._wire_fingerprints == fp
+    assert ship3 == set()
+
+
+def test_multi_host_delta_update_no_longer_raises(sft_engine, monkeypatch):
+    """The PR 5 'single-process-trainer only' raise is gone: a multi-host
+    delta push goes through the agreed plan and ships normally."""
+    eng = sft_engine
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+
+    _patch_two_hosts(monkeypatch, lambda n: [0] * n)
+
+    class _Recording:
+        def __init__(self):
+            self.pushes = []
+            self.delta_bases = []
+            self.addresses = ["a:1", "b:1"]
+            self.version = 0
+
+        def update_weights_from_tensors(
+            self, chunks, next_version, delta_base_version=None
+        ):
+            self.pushes.append(list(chunks))
+            self.delta_bases.append(delta_base_version)
+            return 0.0
+
+        def set_version(self, v):
+            self.version = v
+
+    target = _Recording()
+    eng._rollout_engine = target
+    meta = WeightUpdateMeta.from_http(chunked_mem_mb=64, delta_only=True)
+    eng.update_weights(meta)  # first push: full ship, no raise
+    assert len(target.pushes) == 1
+    n_first = sum(len(c) for c in target.pushes[0])
+    assert n_first == len(eng._wire_fingerprints) > 0
+    eng.update_weights(meta)  # steady state: smallest-leaf keepalive only
+    assert sum(len(c) for c in target.pushes[1]) == 1
+    assert target.delta_bases[1] == eng.get_version() - 1
