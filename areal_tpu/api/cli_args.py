@@ -603,11 +603,23 @@ class JaxGenConfig:
     # blocks skipped — instead of materializing the gathered [B, NBT*BS]
     # view the XLA path einsums over. TPU backends run the compiled
     # kernel; CPU runs it in interpret mode (parity testing / bench
-    # rehearsal). Requires kv_quant="none" and tp_size=1 (quantized pools
-    # and TP-sharded decode stay on the XLA gather path, loudly). Greedy
+    # rehearsal). Composes with kv_quant="int8" (scale planes are
+    # dequantized inside the kernel — halved KV bytes per decode step).
+    # Requires tp_size=1 (a raw pallas_call has no GSPMD partitioning
+    # rule); unsupported combinations fall back to the XLA gather path
+    # with a one-shot warning + pallas_fallback_total counter. Greedy
     # outputs are token-identical kernel-on vs kernel-off
     # (tests/test_paged_kernel.py pins this).
     use_pallas_decode: bool = False
+    # Pallas chunked-prefill flash kernel (ops/pallas/chunked_prefill.py)
+    # for the paged Tq>1 dispatches — chunked-prefill warming, radix
+    # suffix-prefill, spec-verify windows: query tiles x kv blocks walked
+    # straight off the block table with per-query causal masking across
+    # the chunk boundary (arbitrary cache_len starts, mid-block radix
+    # hits, sliding windows), dead tiles skipped flash-style. Same
+    # fallback rules and greedy-identity bar as use_pallas_decode
+    # (tests/test_prefill_kernel.py); composes with kv_quant="int8".
+    use_pallas_prefill: bool = False
     # "int8" stores the paged KV pool as int8 + per-(row, head) scales:
     # ~half the HBM per cached token, ~double the concurrent sequences at
     # the same kv_pool_tokens byte budget (quality: symmetric per-row

@@ -95,6 +95,11 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # better (the fabric's contract is <= fanout/N + 0.1; a climb back
     # toward 1.0 means the tree stopped relaying)
     "weight_propagation": True,
+    # pallas-vs-XLA kernel step-latency ratios: higher is better (the
+    # name heuristic would read neither; on CPU rehearsal the interpret-
+    # mode ratio sits below 1 by design — the TREND still gates)
+    "chunked_prefill_attention": False,
+    "kv_quant_decode": False,
 }
 
 
@@ -107,8 +112,14 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
 #: ~5.2x speedup, both with max_fleet 3 and zero failed requests) across
 #: runs of the SAME commit; 20% covers the mode gap while a genuine break
 #: (autoscale not engaging) still gates, since that pins the ratio near 1.
+#: kernel step-latency ratios measured in INTERPRET mode on CPU rehearsal
+#: are scheduling-noise dominated (the interpret grid unrolls in python);
+#: a wide band keeps rehearsal noise from gating while a genuine break
+#: (kernel wedged/erroring) still fails the rung's in-child asserts.
 BAND_FLOOR_OVERRIDES: dict[str, float] = {
     "elastic_fleet": 0.20,
+    "chunked_prefill_attention": 0.25,
+    "kv_quant_decode": 0.25,
 }
 
 

@@ -227,26 +227,35 @@ class GenerationEngine:
         self.attn_spec = AttnSpec.for_mesh(
             self.mesh, model_config, token_axes=(), head_axis=AXIS_TP
         )
+        # Pallas serving-kernel fallback ledger: (site, reason) -> count.
+        # Every config that *asked* for a kernel but serves XLA instead is
+        # counted here and exported as pallas_fallback_total{site,reason}
+        # via metrics_snapshot() — the fleet being silently off the fast
+        # path is a scrapeable number, not a log line lost at init.
+        self.pallas_fallbacks: dict[tuple[str, str], int] = {}
+        # kernel-tier serving attention (ops/pallas/): the ragged paged
+        # decode kernel and the chunked-prefill flash kernel, both walking
+        # the block table in place; int8 pools dequantize in-kernel, so
+        # kv_quant composes with either knob. A raw pallas_call has no
+        # GSPMD partitioning rule, so TP-sharded serving stays on the
+        # einsum path — falling back LOUDLY (one-shot structured warning +
+        # counter), never silently serving a different kernel than asked.
+        kernel_impl = (
+            "pallas" if jax.default_backend() == "tpu" else "pallas_interpret"
+        )
         if config.use_pallas_decode:
-            # kernel-tier decode (ops/pallas/paged_attention.py). The raw
-            # pallas_call has no GSPMD partitioning rule, so TP-sharded
-            # decode stays on the einsum path; quantized pools need the
-            # gather path's dequant. Fall back loudly rather than silently
-            # serving a different kernel than asked.
-            if config.tp_size > 1 or config.kv_quant != "none":
-                logger.warning(
-                    "use_pallas_decode=True ignored: needs tp_size=1 and "
-                    "kv_quant='none' (got tp_size=%d, kv_quant=%r)",
-                    config.tp_size, config.kv_quant,
-                )
+            if config.tp_size > 1:
+                self._note_pallas_fallback("decode", "tp_size")
             else:
                 self.attn_spec = dataclasses.replace(
-                    self.attn_spec,
-                    decode_impl=(
-                        "pallas"
-                        if jax.default_backend() == "tpu"
-                        else "pallas_interpret"
-                    ),
+                    self.attn_spec, decode_impl=kernel_impl
+                )
+        if config.use_pallas_prefill:
+            if config.tp_size > 1:
+                self._note_pallas_fallback("prefill", "tp_size")
+            else:
+                self.attn_spec = dataclasses.replace(
+                    self.attn_spec, prefill_impl=kernel_impl
                 )
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
@@ -357,6 +366,17 @@ class GenerationEngine:
                 k: (self._cache_sharding if k in ("k", "v") else scale_sharding)
                 for k in cache
             },
+        )
+        # KV-pool memory gauge (serving_stats kv_pool_*): static byte
+        # accounting off the pool's shapes/dtypes, so the int8 memory win
+        # (quantized rows + f32 scale overhead vs fp rows) is a scrapeable
+        # number, not a claim
+        self._kv_pool_dtype = str(cache["k"].dtype)
+        self._kv_pool_kv_bytes = int(cache["k"].nbytes) + int(
+            cache["v"].nbytes
+        )
+        self._kv_pool_scale_bytes = sum(
+            int(cache[k].nbytes) for k in ("ks", "vs") if k in cache
         )
         # per-slot block tables (-1 = unmapped) + valid-entry counts
         self.block_table = np.full((b, self.max_blocks_per_seq), -1, np.int32)
@@ -1289,18 +1309,46 @@ class GenerationEngine:
     def n_running(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    def _note_pallas_fallback(self, site: str, reason: str) -> None:
+        """Structured one-shot note that a requested Pallas serving kernel
+        (``site`` in {"decode", "prefill"}) is serving on the XLA path
+        instead (``reason``, e.g. "tp_size"): warn ONCE per (site, reason),
+        count always. The ledger is exported as
+        ``pallas_fallback_total{site,reason}`` by :meth:`metrics_snapshot`,
+        so /model_info and /metrics both show when the fleet is off the
+        fast path. See docs/kernels.md for the supported-combination
+        matrix."""
+        key = (site, reason)
+        first = key not in self.pallas_fallbacks
+        self.pallas_fallbacks[key] = self.pallas_fallbacks.get(key, 0) + 1
+        if first:
+            logger.warning(
+                "pallas %s kernel requested but unsupported here (%s): "
+                "serving on the XLA path — counted as "
+                "pallas_fallback_total{site=%s,reason=%s}",
+                site, reason, site, reason,
+            )
+
     def serving_stats(self) -> dict:
-        """Serving-plane observability in one place: pool occupancy, radix
-        prefix-cache hit/miss/eviction counters, chunked-prefill progress,
-        and admission-queue depth/wait. The server's ``/model_info`` and
-        the StatsLogger surface (:meth:`record_serving_stats`) both read
-        from here."""
+        """Serving-plane observability in one place: pool occupancy and
+        byte footprint, radix prefix-cache hit/miss/eviction counters,
+        chunked-prefill progress, and admission-queue depth/wait. The
+        server's ``/model_info`` and the StatsLogger surface
+        (:meth:`record_serving_stats`) both read from here."""
         pc = self.prefix_cache
         sched = self.scheduler
         return {
             "kv_blocks_used": self.pool.n_used,
             "kv_blocks_free": self.pool.n_free,
             "kv_block_size": self.pool.block_size,
+            # KV-pool memory gauge: total persistent pool bytes split into
+            # row storage (int8 halves this vs bf16) and the quantized
+            # pools' f32 scale-plane overhead
+            "kv_pool_dtype": self._kv_pool_dtype,
+            "kv_pool_bytes": self._kv_pool_kv_bytes
+            + self._kv_pool_scale_bytes,
+            "kv_pool_kv_bytes": self._kv_pool_kv_bytes,
+            "kv_pool_scale_bytes": self._kv_pool_scale_bytes,
             "prefix_cache_enabled": pc is not None,
             "prefix_cache_blocks": pc.n_cached_blocks if pc else 0,
             "prefix_cache_hit_tokens_total": pc.hit_tokens_total if pc else 0,
@@ -1398,7 +1446,13 @@ class GenerationEngine:
             ),
             "weight_peer_pushes_total": self.weight_peer_pushes_total,
             "decode_dispatch_count": self.decode_dispatch_count,
+            # Pallas serving-kernel fallback ledger (_note_pallas_fallback):
+            # total plus one labeled entry per (site, reason), so a scrape
+            # shows not just THAT the fleet is off the fast path but where
+            "pallas_fallback_total": sum(self.pallas_fallbacks.values()),
         }
+        for (site, reason), n in sorted(self.pallas_fallbacks.items()):
+            out[f"pallas_fallback_total{{site={site},reason={reason}}}"] = n
         if serving_stats is None:
             serving_stats = self.serving_stats()
         for k, v in serving_stats.items():

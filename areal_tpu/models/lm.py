@@ -717,22 +717,45 @@ def _decode_paged_layer(
         v.reshape(b * tq, *v.shape[2:]),
     )
     decode_impl = getattr(attn_spec, "decode_impl", "xla")
-    if decode_impl != "xla" and "ks" not in pool_layer:
-        # kernel tier: block-table-indexed Pallas decode straight off the
-        # pool — no gathered [B, NBT*BS] view ever materializes (quantized
-        # pools stay on the gather path: dequant needs the scale planes)
+    prefill_impl = getattr(attn_spec, "prefill_impl", "xla")
+    # kernel tier: block-table-indexed Pallas attention straight off the
+    # pool — no gathered [B, NBT*BS] view ever materializes. Tq > 1
+    # dispatches (chunked-prefill warming, radix suffix-prefill,
+    # spec-verify windows) prefer the query-tiled chunked-prefill kernel;
+    # Tq == 1 (and Tq > 1 without it) runs the decode kernel. int8 pools
+    # pass their scale planes for in-kernel dequant on either path.
+    quant = "ks" in pool_layer
+    if tq > 1 and prefill_impl != "xla":
+        from areal_tpu.ops.pallas.chunked_prefill import (
+            chunked_prefill_attention,
+        )
+
+        attn = chunked_prefill_attention(
+            q,
+            pool_layer["k"] if quant else pool_layer["k"].astype(q.dtype),
+            pool_layer["v"] if quant else pool_layer["v"].astype(q.dtype),
+            gather_ids,
+            total_len,
+            window=cfg.sliding_window,
+            interpret=prefill_impl == "pallas_interpret",
+            k_scale=pool_layer.get("ks"),
+            v_scale=pool_layer.get("vs"),
+        )
+    elif decode_impl != "xla":
         from areal_tpu.ops.pallas.paged_attention import (
             paged_decode_attention,
         )
 
         attn = paged_decode_attention(
             q,
-            pool_layer["k"].astype(q.dtype),
-            pool_layer["v"].astype(q.dtype),
+            pool_layer["k"] if quant else pool_layer["k"].astype(q.dtype),
+            pool_layer["v"] if quant else pool_layer["v"].astype(q.dtype),
             gather_ids,
             total_len,
             window=cfg.sliding_window,
             interpret=decode_impl == "pallas_interpret",
+            k_scale=pool_layer.get("ks"),
+            v_scale=pool_layer.get("vs"),
         )
     else:
         k_view = _pool_view(pool_layer, "k", gather_ids, b, q.dtype)

@@ -63,10 +63,17 @@ class AttnSpec:
     # paged DECODE kernel choice (models/lm._decode_paged_layer):
     # "xla" = gather the block-table view and einsum (default);
     # "pallas" / "pallas_interpret" = the ragged paged-attention kernel
-    # (ops/pallas/paged_attention.py) reading the pool in place. Set by the
-    # serving engine from JaxGenConfig.use_pallas_decode; quantized pools
-    # fall back to the gather path automatically.
+    # (ops/pallas/paged_attention.py) reading the pool in place —
+    # int8-quantized pools included (scales dequantized in-kernel). Set by
+    # the serving engine from JaxGenConfig.use_pallas_decode.
     decode_impl: str = "xla"
+    # paged CHUNK-PREFILL kernel choice, same dispatch site at Tq > 1
+    # (chunked-prefill warming, radix suffix-prefill, spec-verify windows):
+    # "xla" = gather + einsum; "pallas" / "pallas_interpret" = the
+    # query-tiled chunked-prefill flash kernel
+    # (ops/pallas/chunked_prefill.py). Set from
+    # JaxGenConfig.use_pallas_prefill.
+    prefill_impl: str = "xla"
 
     def __post_init__(self):
         assert self.impl in (
@@ -75,6 +82,9 @@ class AttnSpec:
         assert self.decode_impl in (
             "xla", "pallas", "pallas_interpret"
         ), self.decode_impl
+        assert self.prefill_impl in (
+            "xla", "pallas", "pallas_interpret"
+        ), self.prefill_impl
 
     @property
     def n_token_shards(self) -> int:

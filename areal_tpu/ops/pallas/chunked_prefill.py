@@ -1,0 +1,237 @@
+"""Chunked-prefill flash attention over the paged KV pool — Pallas TPU.
+
+The prefill-FLOPs sibling of ``paged_attention.py``: where the decode
+kernel serves Tq ~ 1 steps, this kernel serves the serving engine's
+**chunk dispatches** — the PR 6 chunked-prefill warming path and the
+radix-cache suffix-prefill (models/lm._decode_paged_layer with Tq > 1) —
+where a chunk of Tq query tokens starts at an *arbitrary* ``cache_len``
+(mid-block after a radix hit, at a chunk boundary mid-warming) and must
+attend over the whole covered prefix plus itself:
+
+- **block-table-indexed KV gather** (identical to the decode kernel): the
+  pool ``[NB, BS, KH, D]`` stays in place; the kv-block grid step reads
+  physical block ``table[b, kb]`` via a scalar-prefetch index map (SMEM);
+- **query blocking**: the chunk's rows are tiled over a third grid
+  dimension (``q_block`` time steps per tile, GQA rows folded), flash
+  style — so a 512-token chunk is a (nq x nbt) trapezoid of tiles, not
+  one giant row block;
+- **trapezoid skipping**: a kv block is skipped when it is entirely past
+  the slot's ragged length (``pl.when``), entirely in the causal future
+  of the query tile, or (sliding window) entirely behind every query of
+  the tile — cost is O(live tiles), the flash trapezoid;
+- **per-query causal masking across the chunk boundary**: query row t of
+  the chunk sits at absolute position ``cache_len + t`` and sees cache
+  positions <= that, regardless of where in a block ``cache_len`` landed
+  (the radix-covered prefix is just more cache);
+- **int8 pools dequantized in-kernel** (``k_scale``/``v_scale``), same
+  contract as the decode kernel.
+
+``interpret=True`` runs the kernel on CPU (tier-1 parity tests,
+``chunked_prefill_attention`` bench rung). The XLA gather path
+(``_pool_view`` + ``decode_attention_xla``) stays as fallback and parity
+oracle — greedy outputs must be token-identical kernel-on vs kernel-off
+(tests/test_prefill_kernel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from areal_tpu.utils.jax_compat import pallas_compiler_params
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(
+    tbl_ref,  # [B, NBT] int32 physical block per logical block (SMEM)
+    len_ref,  # [B] int32 total valid tokens incl. the Tq chunk (SMEM)
+    q_ref,  # [QB*G, D] — this (batch, kv head, q tile)'s query rows
+    k_ref,  # [BS, D] — physical KV block tbl[b, kb], head kh
+    v_ref,  # [BS, D]
+    *rest,  # quant: (ks_ref [BS,1], vs_ref [BS,1], o_ref, scratch...)
+    scale: float,
+    bs: int,
+    nbt: int,
+    tq: int,
+    qb: int,  # time steps per query tile
+    group: int,
+    window: int,
+    quant: bool,
+):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b, qi, kb = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    n = len_ref[b]  # ragged length of this slot (cache_len + tq)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # query tile qi covers chunk times [qi*qb, qi*qb + qb), i.e. absolute
+    # positions cache_len + t = n - tq + t. kv block kb holds positions
+    # [kb*bs, kb*bs + bs). Tile is dead when the block is past the slot's
+    # length, entirely in the tile's causal future, or (windowed) wholly
+    # behind the tile's earliest query.
+    qpos_lo = n - tq + qi * qb
+    qpos_hi = n - tq + (qi + 1) * qb - 1
+    live = (kb * bs < n) & (kb * bs <= qpos_hi)
+    if window > 0:
+        live = live & (kb * bs + bs - 1 >= qpos_lo - (window - 1))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        if quant:
+            # match the XLA gather path's _pool_view dequant exactly:
+            # row = (int8.astype(f32) * scale).astype(q.dtype)
+            k = (k.astype(jnp.float32) * ks_ref[:, :]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[:, :]).astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [QB*G, BS]
+        kpos = kb * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bs), 1
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], bs), 0)
+        # per-query absolute position across the chunk boundary; rows past
+        # tq (q padding to a tile multiple) mask like the final rows and
+        # are sliced off by the wrapper
+        qpos = n - tq + qi * qb + row // group
+        mask = (kpos <= qpos) & (kpos < n)
+        if window > 0:
+            mask = mask & (qpos - kpos < window)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_scr[:, :] = alpha * l_scr[:, :] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[:, :] = m_cur
+
+    @pl.when(kb == nbt - 1)
+    def _finish():
+        l = l_scr[:, :]
+        m = m_scr[:, :]
+        valid = m > NEG_INF / 2
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o = jnp.where(valid, acc_scr[:, :] / safe_l, 0.0)
+        o_ref[:, :] = o.astype(o_ref.dtype)
+
+
+def chunked_prefill_attention(
+    q: jnp.ndarray,  # [B, Tq, NH, D] — one prefill chunk per slot
+    k_pool: jnp.ndarray,  # [NB, BS, KH, D] — one layer's pool slice
+    v_pool: jnp.ndarray,  # [NB, BS, KH, D]
+    gather_ids: jnp.ndarray,  # [B, NBT] int32, unmapped entries clamped >= 0
+    total_len: jnp.ndarray,  # [B] cache_len + Tq
+    softmax_scale: float | None = None,
+    window: int = 0,
+    q_block: int | None = None,  # time steps per query tile (None = auto)
+    interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [NB, BS, KH] f32 (int8 pools)
+    v_scale: jnp.ndarray | None = None,  # [NB, BS, KH] f32
+) -> jnp.ndarray:
+    """Chunked-prefill attention straight off the paged pool. Drop-in
+    replacement for ``_pool_view`` + ``decode_attention_xla`` at Tq > 1
+    (same [B, Tq, NH, D] return, same masking semantics): the chunk's K/V
+    are already scattered into the pool, ``total_len`` counts them, and
+    query row t attends positions <= ``total_len - Tq + t``. NOT
+    differentiated (serving only)."""
+    quant = k_scale is not None
+    assert (k_scale is None) == (v_scale is None)
+    b, tq, nh, d = q.shape
+    bs, kh = k_pool.shape[1], k_pool.shape[2]
+    nbt = gather_ids.shape[1]
+    group = nh // kh
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    # tile height: ~128 folded rows per tile keeps the flash row block in
+    # the MXU sweet spot without blowing VMEM on wide-GQA models
+    if q_block is None:
+        q_block = max(1, min(tq, 128 // group))
+    nq = -(-tq // q_block)
+    tq_pad = nq * q_block
+    if tq_pad != tq:
+        # pad the chunk to a tile multiple; padded rows mask like the last
+        # rows (their garbage output is sliced off below)
+        q = jnp.pad(q, ((0, 0), (0, tq_pad - tq), (0, 0), (0, 0)))
+    rq = q_block * group  # folded rows per tile
+
+    # rows grouped per kv head: row t*G + g of head kh is q[:, t, kh*G + g]
+    qg = (
+        q.reshape(b, tq_pad, kh, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, kh, tq_pad * group, d)
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        scale=scale, bs=bs, nbt=nbt, tq=tq, qb=q_block, group=group,
+        window=window, quant=quant,
+    )
+    kv_spec = pl.BlockSpec(
+        (None, bs, None, d),
+        lambda bi, hi, qi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
+    )
+    sc_spec = pl.BlockSpec(
+        (None, bs, 1),
+        lambda bi, hi, qi, kb, tbl, lens: (tbl[bi, kb], 0, hi),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, rq, d), lambda bi, hi, qi, kb, *_: (bi, hi, qi, 0)
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh, nq, nbt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (None, None, rq, d), lambda bi, hi, qi, kb, *_: (bi, hi, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, tq_pad * group, d), q.dtype),
+        compiler_params=pallas_compiler_params(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        gather_ids.astype(jnp.int32),
+        total_len.astype(jnp.int32),
+        *operands,
+    )
+    out = (
+        out.reshape(b, kh, tq_pad, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, tq_pad, nh, d)
+    )
+    return out[:, :tq] if tq_pad != tq else out

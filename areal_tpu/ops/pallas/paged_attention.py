@@ -20,6 +20,12 @@ gathered copy, and fully-masked KV blocks never run:
 - **GQA folded into the layout**: q is reshaped to ``[B, KH, Tq*G, D]``
   (rows grouped per kv head), so the kernel reads each KV block once per
   kv head — no repeat_kv materialization;
+- **int8 KV pools dequantized in-kernel**: when the pool is quantized
+  (``kv_quant="int8"``, models/lm.init_paged_kv_cache) the per-(row, head)
+  f32 scale planes ride along as two extra block-indexed inputs and rows
+  are dequantized after the HBM->VMEM copy — the memory-bound decode step
+  moves half the KV bytes, and ``kv_quant`` composes with the kernel
+  instead of forcing the XLA gather path;
 - classic flash accumulation (running max / denominator / accumulator in
   VMEM scratch) over a ``(batch, kv_head, kv_block)`` grid, kv innermost-
   sequential.
@@ -50,18 +56,19 @@ def _decode_kernel(
     q_ref,  # [TqG, D] — this (batch, kv head)'s query rows
     k_ref,  # [BS, D] — physical KV block tbl[b, kb], head kh
     v_ref,  # [BS, D]
-    o_ref,  # [TqG, D]
-    m_scr,  # [TqG, 1] f32
-    l_scr,  # [TqG, 1] f32
-    acc_scr,  # [TqG, D] f32
-    *,
+    *rest,  # quant: (ks_ref [BS,1], vs_ref [BS,1], o_ref, scratch...)
     scale: float,
     bs: int,
     nbt: int,
     tq: int,
     group: int,
     window: int,
+    quant: bool,
 ):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b, kb = pl.program_id(0), pl.program_id(2)
     n = len_ref[b]  # ragged length of this slot
 
@@ -82,6 +89,13 @@ def _decode_kernel(
         q = q_ref[:, :]
         k = k_ref[:, :]
         v = v_ref[:, :]
+        if quant:
+            # dequantize AFTER the (halved) HBM->VMEM copy, matching the
+            # XLA gather path's _pool_view semantics exactly so greedy
+            # outputs stay token-identical kernel-on vs kernel-off:
+            # row = (int8.astype(f32) * scale).astype(q.dtype)
+            k = (k.astype(jnp.float32) * ks_ref[:, :]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[:, :]).astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -124,10 +138,18 @@ def paged_decode_attention(
     softmax_scale: float | None = None,
     window: int = 0,
     interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [NB, BS, KH] f32 (int8 pools)
+    v_scale: jnp.ndarray | None = None,  # [NB, BS, KH] f32
 ) -> jnp.ndarray:
     """Decode attention straight off the paged pool. Drop-in replacement
     for ``_pool_view`` + ``decode_attention_xla`` (same [B, Tq, NH, D]
-    return, same masking semantics); NOT differentiated (decode only)."""
+    return, same masking semantics); NOT differentiated (decode only).
+
+    ``k_scale``/``v_scale`` (both or neither): the pool is int8-quantized
+    (models/lm.quantize_kv_rows) and rows are dequantized inside the
+    kernel through the per-(row, head) scale planes."""
+    quant = k_scale is not None
+    assert (k_scale is None) == (v_scale is None)
     b, tq, nh, d = q.shape
     nb, bs, kh = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
     nbt = gather_ids.shape[1]
@@ -144,23 +166,33 @@ def paged_decode_attention(
     kernel = functools.partial(
         _decode_kernel,
         scale=scale, bs=bs, nbt=nbt, tq=tq, group=group, window=window,
+        quant=quant,
     )
+    kv_spec = pl.BlockSpec(
+        (None, bs, None, d),
+        lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
+    )
+    # scale planes ride the same block-table walk; block (bs, 1) keeps the
+    # ref 2-D (sublane bs, lane 1) so the dequant broadcast stays cheap
+    sc_spec = pl.BlockSpec(
+        (None, bs, 1),
+        lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, tqg, d), lambda bi, hi, kb, *_: (bi, hi, 0, 0)
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kh, nbt),
-        in_specs=[
-            pl.BlockSpec(
-                (None, None, tqg, d), lambda bi, hi, kb, *_: (bi, hi, 0, 0)
-            ),
-            pl.BlockSpec(
-                (None, bs, None, d),
-                lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
-            ),
-            pl.BlockSpec(
-                (None, bs, None, d),
-                lambda bi, hi, kb, tbl, lens: (tbl[bi, kb], 0, hi, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, None, tqg, d), lambda bi, hi, kb, *_: (bi, hi, 0, 0)
         ),
@@ -181,9 +213,7 @@ def paged_decode_attention(
     )(
         gather_ids.astype(jnp.int32),
         total_len.astype(jnp.int32),
-        qg,
-        k_pool,
-        v_pool,
+        *operands,
     )
     return (
         out.reshape(b, kh, tq, group, d)

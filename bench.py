@@ -16,6 +16,11 @@ Rungs, in order:
 1.5. paged_decode_attention — the ragged paged-attention Pallas decode
    kernel vs the XLA gather path (step latency + e2e tokens/s, greedy
    output identity asserted in-child).
+1.6. chunked_prefill_attention — the chunked-prefill flash kernel vs the
+   XLA gather path at Tq > 1 (step latency + e2e chunked-warming
+   tokens/s, greedy identity asserted in-child).
+1.7. kv_quant_decode — int8 KV-quantized Pallas decode (in-kernel dequant)
+   vs the XLA dequant-gather path, same bars.
 3. decode_tokens_per_sec — continuous-batching decode on GenerationEngine.
 4. grpo_step_sec — one full async-RL GRPO step (rollout + train + weight
    push) with the colocated engine; the reference's headline metric is
@@ -265,6 +270,13 @@ KERNEL_CONFIGS = [
     dict(name="ring_cp_b128_t8k", block=128, t=8192, bwd=True, ring=True),
     dict(name="ulysses_b128_t8k", block=128, t=8192, bwd=True,
          ulysses=True),
+    # the serving kernels (paged pool + scalar-prefetch block tables):
+    # int8 decode with in-kernel dequant, and the chunked-prefill flash
+    # kernel at a full chunk
+    dict(name="paged_decode_int8", paged="decode", int8=True, tq=1,
+         batch=8, bs=64, nbt=8),
+    dict(name="chunked_prefill_t256", paged="prefill", tq=256,
+         batch=4, bs=64, nbt=8),
 ]
 
 # same rung structure, CPU-sized (interpret=True — Pallas cannot compile on
@@ -279,6 +291,10 @@ KERNEL_CONFIGS_REHEARSAL = [
          interpret=True),
     dict(name="ulysses_b128_t1k", block=128, t=1024, bwd=True, ulysses=True,
          interpret=True),
+    dict(name="paged_decode_int8", paged="decode", int8=True, tq=1,
+         batch=2, bs=16, nbt=4, interpret=True),
+    dict(name="chunked_prefill_t32", paged="prefill", tq=32,
+         batch=2, bs=16, nbt=4, interpret=True),
 ]
 
 
@@ -295,6 +311,9 @@ def kernels_child(configs: list[dict] | None = None):
     nh, kh, d = 12, 2, 128
     results = {}
     for c in configs:
+        if c.get("paged"):
+            results[c["name"]] = _validate_paged_kernel(c, nh, kh, d)
+            continue
         t = c["t"]
         key = jax.random.PRNGKey(0)
         kq, kk, kv = jax.random.split(key, 3)
@@ -370,6 +389,65 @@ def kernels_child(configs: list[dict] | None = None):
         except Exception as e:  # noqa: BLE001 — record per-config failures
             results[c["name"]] = {"ok": False, "error": str(e)[-400:]}
     return results
+
+
+def _validate_paged_kernel(c: dict, nh: int, kh: int, d: int) -> dict:
+    """One pallas_kernel_validation config for the SERVING kernels: compile
+    (non-interpret on TPU) + execute the paged decode kernel (int8
+    in-kernel dequant variant) or the chunked-prefill flash kernel on a
+    churned block table, per-config pass/fail like the flash configs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        t0 = time.time()
+        interpret = c.get("interpret", False)
+        batch, bs, nbt, tq = c["batch"], c["bs"], c["nbt"], c["tq"]
+        nb = batch * nbt + 1
+        rng = np.random.default_rng(0)
+        dt = jnp.float32 if interpret else jnp.bfloat16
+        q = jnp.asarray(rng.normal(size=(batch, tq, nh, d)), dt)
+        tbl = jnp.asarray(
+            rng.permutation(nb - 1)[: batch * nbt].reshape(batch, nbt) + 1,
+            jnp.int32,
+        )
+        lens = jnp.asarray(
+            rng.integers(tq, nbt * bs, size=batch), jnp.int32
+        )
+        kw = {}
+        if c.get("int8"):
+            from areal_tpu.models.lm import quantize_kv_rows
+
+            rows = jnp.asarray(
+                rng.normal(size=(nb, bs, kh, d)), jnp.float32
+            )
+            kp, kw["k_scale"] = quantize_kv_rows(rows)
+            vp, kw["v_scale"] = quantize_kv_rows(rows[::-1])
+        else:
+            kp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+            vp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+        if c["paged"] == "prefill":
+            from areal_tpu.ops.pallas.chunked_prefill import (
+                chunked_prefill_attention as fn,
+            )
+        else:
+            from areal_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention as fn,
+            )
+        # per-config compile IS the validation being benchmarked
+        # arealint: disable-next-line=jit-in-loop,jit-per-call
+        o = jax.jit(
+            lambda q, kp, vp, tbl, lens: fn(
+                q, kp, vp, tbl, lens, interpret=interpret, **kw
+            )
+        )(q, kp, vp, tbl, lens)
+        jax.block_until_ready(o)
+        finite = bool(jnp.isfinite(jnp.sum(o.astype(jnp.float32))))
+        assert finite, c
+        return {"ok": True, "compile_plus_run_s": round(time.time() - t0, 1)}
+    except Exception as e:  # noqa: BLE001 — record per-config failures
+        return {"ok": False, "error": str(e)[-400:]}
 
 
 def qwen2_1p5b_cfg(layers: int = 28, vocab: int = 151936):
@@ -724,6 +802,320 @@ def paged_decode_bench(layers: int = 2, vocab: int = 2048, batch: int = 8,
         "e2e_tokens_per_sec_pallas": round(tps_pallas, 2),
         "e2e_tokens_per_sec_xla": round(tps_xla, 2),
         "greedy_outputs_identical": True,
+        "interpret": interpret,
+        "batch": batch,
+        "layers": layers,
+    }
+
+
+def chunked_prefill_bench(layers: int = 2, vocab: int = 2048, batch: int = 4,
+                          prompt_len: int = 96, chunk: int = 32,
+                          new_tokens: int = 16, n_requests: int = 6,
+                          page_size: int = 16, max_seq_len: int = 256,
+                          kernel_tq: int = 64, kernel_iters: int = 10):
+    """Chunked-prefill flash kernel vs the XLA gather path
+    (ops/pallas/chunked_prefill.py vs _pool_view + decode_attention_xla
+    at Tq > 1) — the prefill-FLOPs sibling of paged_decode_bench.
+
+    Two measurements:
+
+    1. **raw kernel step latency** — one Tq=``kernel_tq`` chunk dispatch
+       against a deep pool (qwen2 heads, ragged cache_len starts incl.
+       mid-block), pallas vs XLA, jitted, mean over ``kernel_iters``;
+    2. **e2e engine tokens/s** — long prompts warmed chunk-by-chunk
+       (``chunked_prefill_tokens=chunk``) with ``use_pallas_prefill`` on
+       vs off; greedy outputs HARD-asserted token-identical in-child.
+
+    On CPU the kernel runs in interpret mode — mechanics + parity, not
+    speed (the compiled TPU run is the perf signal)."""
+    import threading
+
+    import jax as _jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.ops.attention import decode_attention_xla
+    from areal_tpu.ops.pallas.chunked_prefill import chunked_prefill_attention
+
+    interpret = _jax.default_backend() != "tpu"
+
+    # --- raw kernel: one chunk dispatch off a churned pool ---
+    nh, kh, d = 12, 2, 128
+    bs = page_size
+    nbt = max_seq_len // page_size
+    nb = batch * nbt + 1
+    rng = np.random.default_rng(0)
+    dt = jnp.float32 if interpret else jnp.bfloat16
+    tq = kernel_tq
+    q = jnp.asarray(rng.normal(size=(batch, tq, nh, d)), dt)
+    kp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+    vp = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), dt)
+    tbl = jnp.asarray(
+        rng.permutation(nb - 1)[: batch * nbt].reshape(batch, nbt) + 1,
+        jnp.int32,
+    )
+    # total_len = cache_len + tq with arbitrary (mid-block) cache_len
+    lens = jnp.asarray(
+        rng.integers(tq, max_seq_len, size=batch), jnp.int32
+    )
+
+    def xla_step(q, kp, vp, tbl, lens):
+        view_k = kp[tbl].reshape(batch, nbt * bs, kh, d)
+        view_v = vp[tbl].reshape(batch, nbt * bs, kh, d)
+        return decode_attention_xla(q, view_k, view_v, lens)
+
+    def pallas_step(q, kp, vp, tbl, lens):
+        return chunked_prefill_attention(
+            q, kp, vp, tbl, lens, interpret=interpret
+        )
+
+    def time_step(fn):
+        # compile outside the timed window
+        # arealint: disable-next-line=jit-in-loop,jit-per-call
+        jf = _jax.jit(fn)
+        _jax.block_until_ready(jf(q, kp, vp, tbl, lens))
+        t0 = time.perf_counter()
+        for _ in range(kernel_iters):
+            out = jf(q, kp, vp, tbl, lens)
+        _jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / kernel_iters
+
+    xla_lat = time_step(xla_step)
+    pallas_lat = time_step(pallas_step)
+
+    # --- e2e: chunked warming through the engine, greedy identity ---
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
+    prompts = [
+        rng.integers(1, vocab - 2, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=new_tokens, min_new_tokens=new_tokens, greedy=True,
+    )
+
+    def run_mode(use_pallas: bool):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=batch,
+                max_seq_len=max_seq_len,
+                prefill_chunk=chunk,
+                chunked_prefill_tokens=chunk,
+                page_size=page_size,
+                # f32 so the identity assert sees no bf16 argmax-tie noise
+                dtype="float32",
+                use_pallas_prefill=use_pallas,
+            ),
+            model_config=model_cfg,
+        )
+        eng.start()
+        try:
+            done = threading.Event()
+            results: dict = {}
+            lock = threading.Lock()
+
+            def cb(i, r):
+                with lock:
+                    results[i] = r
+                    if len(results) >= n_requests:
+                        done.set()
+
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                eng.submit(
+                    f"cp{i}", list(p), gconfig,
+                    lambda r, i=i: cb(i, r),
+                )
+            assert done.wait(1200), "chunked-prefill bench timed out"
+            wall = time.perf_counter() - t0
+            toks = sum(len(r.output_tokens) for r in results.values())
+            outs = [tuple(results[i].output_tokens) for i in range(n_requests)]
+            warms = eng.chunked_prefill_count
+            return toks / wall, outs, warms
+        finally:
+            eng.stop()
+
+    tps_xla, outs_xla, _ = run_mode(False)
+    tps_pallas, outs_pallas, warms = run_mode(True)
+    assert warms > 0, "no chunked warming ran — the kernel was never hit"
+    assert outs_pallas == outs_xla, (
+        "greedy outputs DIVERGED kernel-on vs kernel-off — chunked-prefill "
+        "kernel is wrong, refusing to report a speedup"
+    )
+    return {
+        "pallas_step_latency_s": round(pallas_lat, 6),
+        "xla_step_latency_s": round(xla_lat, 6),
+        "kernel_step_speedup": round(xla_lat / pallas_lat, 3),
+        "e2e_tokens_per_sec_pallas": round(tps_pallas, 2),
+        "e2e_tokens_per_sec_xla": round(tps_xla, 2),
+        "greedy_outputs_identical": True,
+        "chunked_warmups": warms,
+        "kernel_tq": tq,
+        "interpret": interpret,
+        "batch": batch,
+        "layers": layers,
+    }
+
+
+def kv_quant_decode_bench(layers: int = 2, vocab: int = 2048, batch: int = 8,
+                          prompt_len: int = 64, new_tokens: int = 32,
+                          n_requests: int = 8, page_size: int = 16,
+                          max_seq_len: int = 256, steps_per_call: int = 8,
+                          kernel_iters: int = 10):
+    """int8 KV-quantized Pallas decode vs the XLA dequant-gather path —
+    the kv_quant="int8" x use_pallas_decode composition Rung B unlocked
+    (before it, quantized pools silently degraded to the gather path).
+
+    Two measurements:
+
+    1. **raw kernel step latency** — one decode step on an int8 pool with
+       per-(row, head) scale planes, in-kernel dequant vs XLA
+       dequant-gather, jitted, mean over ``kernel_iters``;
+    2. **e2e decode tokens/s** — kv_quant="int8" engines with
+       ``use_pallas_decode`` on vs off, greedy outputs HARD-asserted
+       token-identical in-child (same quantized pools both modes, so the
+       argmax sees identical dequantized values).
+
+    On CPU the kernel runs in interpret mode — mechanics + parity, not
+    speed (the compiled TPU run is the perf signal; there the headline is
+    halved KV bytes per step)."""
+    import threading
+
+    import jax as _jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.models.lm import quantize_kv_rows
+    from areal_tpu.ops.attention import decode_attention_xla
+    from areal_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    interpret = _jax.default_backend() != "tpu"
+
+    # --- raw kernel: one decode step off an int8 pool ---
+    nh, kh, d = 12, 2, 128
+    bs = page_size
+    nbt = max_seq_len // page_size
+    nb = batch * nbt + 1
+    rng = np.random.default_rng(0)
+    rows_k = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), jnp.float32)
+    rows_v = jnp.asarray(rng.normal(size=(nb, bs, kh, d)), jnp.float32)
+    kq, ks = quantize_kv_rows(rows_k)
+    vq, vs = quantize_kv_rows(rows_v)
+    dt = jnp.float32 if interpret else jnp.bfloat16
+    q = jnp.asarray(rng.normal(size=(batch, 1, nh, d)), dt)
+    tbl = jnp.asarray(
+        rng.permutation(nb - 1)[: batch * nbt].reshape(batch, nbt) + 1,
+        jnp.int32,
+    )
+    lens = jnp.asarray(
+        rng.integers(1, max_seq_len, size=batch), jnp.int32
+    )
+
+    def xla_step(q, kq, vq, ks, vs, tbl, lens):
+        # the gather path's dequant (_pool_view semantics)
+        view_k = (
+            kq[tbl].reshape(batch, nbt * bs, kh, d).astype(jnp.float32)
+            * ks[tbl].reshape(batch, nbt * bs, kh)[..., None]
+        ).astype(q.dtype)
+        view_v = (
+            vq[tbl].reshape(batch, nbt * bs, kh, d).astype(jnp.float32)
+            * vs[tbl].reshape(batch, nbt * bs, kh)[..., None]
+        ).astype(q.dtype)
+        return decode_attention_xla(q, view_k, view_v, lens)
+
+    def pallas_step(q, kq, vq, ks, vs, tbl, lens):
+        return paged_decode_attention(
+            q, kq, vq, tbl, lens, interpret=interpret,
+            k_scale=ks, v_scale=vs,
+        )
+
+    def time_step(fn):
+        # compile outside the timed window
+        # arealint: disable-next-line=jit-in-loop,jit-per-call
+        jf = _jax.jit(fn)
+        _jax.block_until_ready(jf(q, kq, vq, ks, vs, tbl, lens))
+        t0 = time.perf_counter()
+        for _ in range(kernel_iters):
+            out = jf(q, kq, vq, ks, vs, tbl, lens)
+        _jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / kernel_iters
+
+    xla_lat = time_step(xla_step)
+    pallas_lat = time_step(pallas_step)
+
+    # --- e2e: int8 engines, kernel on vs off, greedy identity ---
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
+    prompts = [
+        rng.integers(1, vocab - 2, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=new_tokens, min_new_tokens=new_tokens, greedy=True,
+    )
+
+    def run_mode(use_pallas: bool):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=batch,
+                max_seq_len=max_seq_len,
+                prefill_chunk=64,
+                page_size=page_size,
+                decode_steps_per_call=steps_per_call,
+                kv_quant="int8",
+                # f32 so the identity assert sees no bf16 argmax-tie noise
+                dtype="float32",
+                use_pallas_decode=use_pallas,
+            ),
+            model_config=model_cfg,
+        )
+        assert eng.metrics_snapshot()["pallas_fallback_total"] == 0, (
+            "int8 + use_pallas_decode fell back — Rung B regressed"
+        )
+        eng.start()
+        try:
+            done = threading.Event()
+            results: dict = {}
+            lock = threading.Lock()
+
+            def cb(i, r):
+                with lock:
+                    results[i] = r
+                    if len(results) >= n_requests:
+                        done.set()
+
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                eng.submit(
+                    f"kq{i}", list(p), gconfig,
+                    lambda r, i=i: cb(i, r),
+                )
+            assert done.wait(1200), "kv-quant decode bench timed out"
+            wall = time.perf_counter() - t0
+            toks = sum(len(r.output_tokens) for r in results.values())
+            outs = [tuple(results[i].output_tokens) for i in range(n_requests)]
+            scale_bytes = eng.serving_stats()["kv_pool_scale_bytes"]
+            return toks / wall, outs, scale_bytes
+        finally:
+            eng.stop()
+
+    tps_xla, outs_xla, _ = run_mode(False)
+    tps_pallas, outs_pallas, scale_bytes = run_mode(True)
+    assert outs_pallas == outs_xla, (
+        "greedy outputs DIVERGED kernel-on vs kernel-off over the same "
+        "int8 pools — in-kernel dequant is wrong, refusing to report a "
+        "speedup"
+    )
+    return {
+        "pallas_step_latency_s": round(pallas_lat, 6),
+        "xla_step_latency_s": round(xla_lat, 6),
+        "kernel_step_speedup": round(xla_lat / pallas_lat, 3),
+        "e2e_tokens_per_sec_pallas": round(tps_pallas, 2),
+        "e2e_tokens_per_sec_xla": round(tps_xla, 2),
+        "greedy_outputs_identical": True,
+        "kv_pool_scale_bytes": scale_bytes,
         "interpret": interpret,
         "batch": batch,
         "layers": layers,
@@ -1919,6 +2311,69 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("paged_decode_attention", "paged-decode", e)
 
+    # ---- rung 1.6: chunked-prefill flash kernel (pallas vs XLA) ----
+    # the serving engine's prefill-FLOPs path (chunked warming + radix
+    # suffix-prefill); greedy identity asserted in-child like rung 1.5
+    if remaining(deadline) > 420:
+        try:
+            log("chunked-prefill kernel rung")
+            cp_att = (
+                dict(layers=2, vocab=2048, batch=4, prompt_len=96,
+                     chunk=32, new_tokens=16, n_requests=6, page_size=16,
+                     max_seq_len=256, kernel_tq=64, kernel_iters=5)
+                if REHEARSAL
+                else dict(layers=28, vocab=151936, batch=8, prompt_len=2048,
+                          chunk=512, new_tokens=64, n_requests=16,
+                          page_size=64, max_seq_len=4096, kernel_tq=512,
+                          kernel_iters=20)
+            )
+            cp = _run_child(
+                "cprefill", cp_att,
+                timeout=min(900.0, remaining(deadline) - 120),
+            )
+            emit({
+                "metric": "chunked_prefill_attention",
+                "value": cp["kernel_step_speedup"],
+                "unit": "x_pallas_vs_xla_step_latency",
+                "vs_baseline": None,
+                "chip": chip,
+                **cp,
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure(
+                "chunked_prefill_attention", "chunked-prefill", e
+            )
+
+    # ---- rung 1.7: int8 KV-quantized decode (pallas vs XLA dequant) ----
+    # the kv_quant x use_pallas_decode composition; in-kernel dequant
+    # halves decode's KV bytes on TPU, identity asserted in-child
+    if remaining(deadline) > 420:
+        try:
+            log("kv-quant decode kernel rung")
+            kq_att = (
+                dict(layers=2, vocab=2048, batch=8, prompt_len=64,
+                     new_tokens=32, n_requests=8, page_size=16,
+                     max_seq_len=256, kernel_iters=5)
+                if REHEARSAL
+                else dict(layers=28, vocab=151936, batch=48, prompt_len=128,
+                          new_tokens=128, n_requests=48, page_size=64,
+                          max_seq_len=512, kernel_iters=50)
+            )
+            kq = _run_child(
+                "kvqdec", kq_att,
+                timeout=min(900.0, remaining(deadline) - 120),
+            )
+            emit({
+                "metric": "kv_quant_decode",
+                "value": kq["kernel_step_speedup"],
+                "unit": "x_pallas_vs_xla_step_latency",
+                "vs_baseline": None,
+                "chip": chip,
+                **kq,
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("kv_quant_decode", "kv-quant-decode", e)
+
     # ---- rung 2 (PRIMARY): SFT train throughput ladder ----
     # full model first (adam OOMs a 16GB chip at 1.5B even with bf16
     # moments -> adafactor); depth reduction is the last resort
@@ -2500,6 +2955,10 @@ def _child_main():
         print(json.dumps(decode_bench(**att)))
     elif kind == "--pgdec-child":
         print(json.dumps(paged_decode_bench(**att)))
+    elif kind == "--cprefill-child":
+        print(json.dumps(chunked_prefill_bench(**att)))
+    elif kind == "--kvqdec-child":
+        print(json.dumps(kv_quant_decode_bench(**att)))
     elif kind == "--pcache-child":
         print(json.dumps(prefix_cache_bench(**att)))
     elif kind == "--wu-child":

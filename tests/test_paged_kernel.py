@@ -1,7 +1,10 @@
 """Ragged paged-attention Pallas decode kernel (ops/pallas/paged_attention)
 vs the XLA gather path — interpret mode on CPU, so the kernel tier is
 tier-1-testable, plus the e2e greedy-identity bar `use_pallas_decode` must
-clear (same bar PR 5/6 used for weight-sync / prefix-cache invisibility)."""
+clear (same bar PR 5/6 used for weight-sync / prefix-cache invisibility).
+Includes the int8 composition: `kv_quant="int8"` + `use_pallas_decode` runs
+the kernel with in-kernel dequant (parity vs the XLA dequant-gather path,
+token-identical greedy e2e), and only tp>1 still falls back — loudly."""
 
 import jax
 import jax.numpy as jnp
@@ -11,7 +14,7 @@ import pytest
 from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.models.config import tiny_config
-from areal_tpu.models.lm import init_params
+from areal_tpu.models.lm import init_params, quantize_kv_rows
 from areal_tpu.ops.attention import AttnSpec, decode_attention_xla
 from areal_tpu.ops.pallas.paged_attention import paged_decode_attention
 
@@ -113,6 +116,54 @@ def test_parity_prefix_cache_hit_mid_block():
     _check(q, kp, vp, tbl, lens)
 
 
+def test_parity_int8_quantized_pool():
+    """int8 pools: the kernel dequantizes rows through the per-(row, head)
+    scale planes IN-KERNEL; reference is the XLA dequant-gather path
+    (_pool_view semantics: (int8.f32 * scale).astype(q.dtype))."""
+    rng = np.random.default_rng(6)
+    B, Tq, NH, KH, D, NB, BS, NBT = 3, 2, 4, 2, 32, 32, 8, 6
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    kq, ks = quantize_kv_rows(kp)
+    vq, vs = quantize_kv_rows(vp)
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([2, 13, 48], jnp.int32)
+    out = paged_decode_attention(
+        q, kq, vq, tbl, lens, interpret=True, k_scale=ks, v_scale=vs
+    )
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
+    ref = _ref(q, kd, vd, tbl, lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_parity_int8_sliding_window():
+    rng = np.random.default_rng(7)
+    B, NH, KH, D, NB, BS, NBT = 2, 4, 4, 32, 16, 8, 4
+    q = _rand(rng, (B, 1, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    kq, ks = quantize_kv_rows(kp)
+    vq, vs = quantize_kv_rows(vp)
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([9, 27], jnp.int32)
+    out = paged_decode_attention(
+        q, kq, vq, tbl, lens, window=5, interpret=True,
+        k_scale=ks, v_scale=vs,
+    )
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
+    ref = _ref(q, kd, vd, tbl, lens, window=5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_parity_under_jit_and_bf16():
     rng = np.random.default_rng(5)
     B, NH, KH, D, NB, BS, NBT = 2, 2, 2, 32, 16, 8, 4
@@ -190,18 +241,61 @@ def test_e2e_greedy_identity_pallas_decode_on_vs_off():
         )
 
 
-def test_knob_falls_back_loudly_on_unsupported_configs(caplog):
-    """tp>1 / quantized pools keep the XLA path (with a warning), never a
-    silently different kernel."""
+def test_e2e_greedy_identity_int8_pallas_on_vs_off():
+    """The ISSUE 16 acceptance bar: kv_quant="int8" + use_pallas_decode
+    runs the kernel (no fallback) and greedy outputs are token-identical
+    kernel-on vs kernel-off over the SAME quantized pools."""
+    prompts = [[5, 9, 3, 7, 2, 6], [11, 4, 8, 1], [9, 9, 2, 4, 4]]
+    off = _generate(_engine(False, kv_quant="int8"), prompts)
     eng = _engine(True, kv_quant="int8")
-    assert eng.attn_spec.decode_impl == "xla"
+    assert eng.attn_spec.decode_impl == "pallas_interpret"
+    assert eng.metrics_snapshot()["pallas_fallback_total"] == 0
+    on = _generate(eng, prompts)
+    for i in range(len(prompts)):
+        assert off[i].output_tokens == on[i].output_tokens, i
+        np.testing.assert_allclose(
+            off[i].output_logprobs, on[i].output_logprobs,
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_knob_falls_back_loudly_on_unsupported_configs(caplog):
+    """int8 pools now COMPOSE with the kernel (in-kernel dequant); only
+    tp>1 keeps the XLA path — with a one-shot warning and a counted
+    pallas_fallback_total{site,reason} entry, never a silently different
+    kernel."""
+    eng = _engine(True, kv_quant="int8")
+    assert eng.attn_spec.decode_impl == "pallas_interpret"
+    assert eng.metrics_snapshot()["pallas_fallback_total"] == 0
     eng2 = _engine(True, tp_size=2)
     assert eng2.attn_spec.decode_impl == "xla"
+    snap = eng2.metrics_snapshot()
+    assert snap["pallas_fallback_total"] == 1
+    assert snap["pallas_fallback_total{site=decode,reason=tp_size}"] == 1
 
 
-def test_quantized_pool_layer_stays_on_gather_path():
-    """_decode_paged_layer routes int8 pools to the gather/dequant path
-    even when the spec asks for the kernel."""
+def test_kv_pool_bytes_gauge_reflects_quantization():
+    """serving_stats reports the pool's byte footprint split into row
+    storage and scale overhead: the int8 memory win is a scrapeable
+    number (int8 rows = 1/4 the f32 rows; scales nonzero only there)."""
+    fp = _engine(False).serving_stats()
+    q8 = _engine(False, kv_quant="int8").serving_stats()
+    assert fp["kv_pool_dtype"] == "float32" and fp["kv_pool_scale_bytes"] == 0
+    assert fp["kv_pool_bytes"] == fp["kv_pool_kv_bytes"]
+    assert q8["kv_pool_dtype"] == "int8" and q8["kv_pool_scale_bytes"] > 0
+    assert q8["kv_pool_kv_bytes"] * 4 == fp["kv_pool_kv_bytes"]
+    assert q8["kv_pool_bytes"] == (
+        q8["kv_pool_kv_bytes"] + q8["kv_pool_scale_bytes"]
+    )
+    # the headline: quantized pool + scale overhead still well under fp
+    assert q8["kv_pool_bytes"] < fp["kv_pool_bytes"]
+
+
+def test_quantized_pool_layer_runs_kernel_path():
+    """_decode_paged_layer routes int8 pools THROUGH the kernel when the
+    spec asks for it, and the result matches the XLA dequant-gather path
+    on the same pools (the dispatch-level parity check under real layer
+    weights)."""
     from areal_tpu.models.lm import _decode_paged_layer
 
     cfg = tiny_config(
@@ -210,19 +304,28 @@ def test_quantized_pool_layer_stays_on_gather_path():
     )
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     lp = jax.tree.map(lambda a: a[0], params["layers"])
+    rng = np.random.default_rng(8)
     B, NB, BS, NBT, D = 2, 8, 8, 2, cfg.head_dim
-    pool = {
-        "k": jnp.zeros((NB, BS, 2, D), jnp.int8),
-        "ks": jnp.ones((NB, BS, 2), jnp.float32),
-        "v": jnp.zeros((NB, BS, 2, D), jnp.int8),
-        "vs": jnp.ones((NB, BS, 2), jnp.float32),
-    }
-    spec = AttnSpec(decode_impl="pallas_interpret")
-    h = jnp.ones((B, 1, cfg.hidden_size), jnp.float32)
-    rope = jnp.zeros((B, 1), jnp.int32)
-    out, _ = _decode_paged_layer(
-        cfg, lp, pool, h, rope,
-        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-        jnp.zeros((B, NBT), jnp.int32), jnp.ones((B,), jnp.int32), spec,
+    rows_k = _rand(rng, (NB, BS, 2, D))
+    rows_v = _rand(rng, (NB, BS, 2, D))
+    kq, ks = quantize_kv_rows(rows_k)
+    vq, vs = quantize_kv_rows(rows_v)
+    pool = {"k": kq, "ks": ks, "v": vq, "vs": vs}
+    h = jnp.asarray(
+        rng.normal(size=(B, 1, cfg.hidden_size)), jnp.float32
     )
-    assert np.all(np.isfinite(np.asarray(out)))
+    rope = jnp.zeros((B, 1), jnp.int32)
+    tbl = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lens = jnp.asarray([5, 11], jnp.int32)
+    args = (
+        cfg, lp, dict(pool), h, rope,
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+        tbl, lens,
+    )
+    out_kern, _ = _decode_paged_layer(
+        *args, AttnSpec(decode_impl="pallas_interpret")
+    )
+    out_xla, _ = _decode_paged_layer(*args, AttnSpec(decode_impl="xla"))
+    np.testing.assert_allclose(
+        np.asarray(out_kern), np.asarray(out_xla), rtol=1e-5, atol=1e-5
+    )

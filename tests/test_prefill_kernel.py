@@ -1,0 +1,274 @@
+"""Chunked-prefill flash kernel (ops/pallas/chunked_prefill) vs the XLA
+gather path — interpret mode on CPU, so the prefill kernel tier is
+tier-1-testable like the decode kernel's (tests/test_paged_kernel.py).
+
+The contract under test: a chunk of Tq queries starting at an ARBITRARY
+cache_len (mid-block after a radix hit, at a chunk boundary mid-warming)
+attends the whole covered prefix plus itself with per-query causal
+masking, over recycled block tables with holes, with sliding windows, and
+over int8-quantized pools — matching `_pool_view` + `decode_attention_xla`
+to interpret-mode tolerance, and token-identically e2e under greedy
+decoding with `use_pallas_prefill` on vs off."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params, quantize_kv_rows
+from areal_tpu.ops.attention import decode_attention_xla
+from areal_tpu.ops.pallas.chunked_prefill import chunked_prefill_attention
+
+
+def _rand(rng, shape):
+    return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+
+def _ref(q, k_pool, v_pool, tbl, lens, window=0):
+    b, nbt = tbl.shape
+    bs = k_pool.shape[1]
+    view_k = k_pool[tbl].reshape(b, nbt * bs, *k_pool.shape[2:])
+    view_v = v_pool[tbl].reshape(b, nbt * bs, *v_pool.shape[2:])
+    return decode_attention_xla(q, view_k, view_v, lens, window=window)
+
+
+def _check(q, k_pool, v_pool, tbl, lens, window=0, q_block=None, **tol):
+    out = chunked_prefill_attention(
+        q, k_pool, v_pool, tbl, lens, window=window, q_block=q_block,
+        interpret=True,
+    )
+    ref = _ref(q, k_pool, v_pool, tbl, lens, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref),
+        rtol=tol.get("rtol", 1e-5), atol=tol.get("atol", 1e-5),
+    )
+
+
+def test_parity_ragged_lengths_gqa():
+    """Mixed-depth slots: a chunk that IS the whole sequence (cache_len=0),
+    chunks landing mid-block, and a near-full table; GQA group 2."""
+    rng = np.random.default_rng(0)
+    B, Tq, NH, KH, D, NB, BS, NBT = 4, 8, 4, 2, 32, 32, 8, 6
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([8, 11, 29, 48], jnp.int32)
+    _check(q, kp, vp, tbl, lens)
+
+
+def test_parity_chunk_boundary_and_radix_hit_starts():
+    """cache_len landing mid-block — a radix admit covered part of the
+    prompt, or a prior warming chunk stopped mid-block — and the next
+    chunk crossing multiple block boundaries."""
+    rng = np.random.default_rng(1)
+    B, Tq, NH, KH, D, NB, BS, NBT = 3, 16, 4, 2, 32, 32, 8, 6
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    # cache_len = lens - Tq = 3 (mid-block), 13 (mid-block, chunk spans
+    # blocks 1..3), 32 (exact boundary)
+    lens = jnp.asarray([19, 29, 48], jnp.int32)
+    _check(q, kp, vp, tbl, lens)
+
+
+def test_parity_query_tiling_and_padding():
+    """Tq not divisible by q_block: the wrapper pads the chunk to a tile
+    multiple and slices the garbage rows back off. Multiple tiles per
+    chunk exercises the tile-level trapezoid skip."""
+    rng = np.random.default_rng(2)
+    B, Tq, NH, KH, D, NB, BS, NBT = 2, 11, 4, 2, 32, 32, 8, 6
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([15, 40], jnp.int32)
+    _check(q, kp, vp, tbl, lens, q_block=4)
+
+
+def test_parity_sliding_window():
+    """Sliding window across the chunk boundary: early queries of the
+    chunk see back into the covered prefix, late ones do not."""
+    rng = np.random.default_rng(3)
+    B, Tq, NH, KH, D, NB, BS, NBT = 2, 8, 4, 4, 32, 16, 8, 4
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([17, 27], jnp.int32)
+    _check(q, kp, vp, tbl, lens, window=5, q_block=4)
+
+
+def test_parity_holes_and_recycled_blocks():
+    """Recycled physical blocks (shared across slots, reused at different
+    logical positions) and trash-clamped unmapped tails — the churned
+    BlockPool + radix-cache table shape."""
+    rng = np.random.default_rng(4)
+    B, Tq, NH, KH, D, NB, BS, NBT = 3, 4, 4, 2, 32, 8, 8, 4
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    tbl = np.zeros((B, NBT), np.int32)  # unmapped tail = trash block 0
+    tbl[0, :2] = [3, 5]
+    tbl[1, :3] = [5, 3, 7]  # blocks 3 and 5 shared with slot 0, reordered
+    tbl[2, :1] = [7]
+    lens = jnp.asarray([14, 20, 4], jnp.int32)
+    _check(q, kp, vp, jnp.asarray(tbl), lens)
+
+
+def test_parity_int8_quantized_pool():
+    """int8 pools through the prefill kernel: in-kernel dequant via the
+    scale planes, matching the XLA dequant-gather reference."""
+    rng = np.random.default_rng(5)
+    B, Tq, NH, KH, D, NB, BS, NBT = 2, 8, 4, 2, 32, 16, 8, 4
+    q = _rand(rng, (B, Tq, NH, D))
+    kp, vp = _rand(rng, (NB, BS, KH, D)), _rand(rng, (NB, BS, KH, D))
+    kq, ks = quantize_kv_rows(kp)
+    vq, vs = quantize_kv_rows(vp)
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([12, 26], jnp.int32)
+    out = chunked_prefill_attention(
+        q, kq, vq, tbl, lens, interpret=True, k_scale=ks, v_scale=vs
+    )
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
+    ref = _ref(q, kd, vd, tbl, lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_parity_under_jit_and_bf16():
+    rng = np.random.default_rng(6)
+    B, Tq, NH, KH, D, NB, BS, NBT = 2, 4, 2, 2, 32, 16, 8, 4
+    q = _rand(rng, (B, Tq, NH, D)).astype(jnp.bfloat16)
+    kp = _rand(rng, (NB, BS, KH, D)).astype(jnp.bfloat16)
+    vp = _rand(rng, (NB, BS, KH, D)).astype(jnp.bfloat16)
+    tbl = jnp.asarray(
+        rng.permutation(NB)[: B * NBT].reshape(B, NBT).astype(np.int32)
+    )
+    lens = jnp.asarray([7, 22], jnp.int32)
+    out = jax.jit(
+        lambda *a: chunked_prefill_attention(*a, interpret=True)
+    )(q, kp, vp, tbl, lens)
+    ref = _ref(q, kp, vp, tbl, lens)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# e2e: the engine knob
+# ---------------------------------------------------------------------------
+
+
+def _engine(use_pallas_prefill, **kw):
+    cfg = tiny_config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    defaults = dict(
+        max_batch_size=4, max_seq_len=128, prefill_chunk=64,
+        decode_steps_per_call=4, page_size=16, dtype="float32",
+        use_pallas_prefill=use_pallas_prefill,
+        # small chunk so every multi-chunk prompt routes Tq>1 warming
+        # dispatches through the kernel under test
+        chunked_prefill_tokens=16,
+    )
+    defaults.update(kw)
+    return GenerationEngine(
+        JaxGenConfig(**defaults), model_config=cfg, params=params
+    )
+
+
+def _generate(eng, prompts, max_new=8):
+    results: list = []
+    for i, p in enumerate(prompts):
+        eng.submit(
+            f"r{i}", p,
+            GenerationHyperparameters(max_new_tokens=max_new, greedy=True),
+            lambda r, i=i: results.append((i, r)),
+        )
+    it = 0
+    while len(results) < len(prompts):
+        eng._handle_aborts()
+        eng._admit()
+        if eng.n_running:
+            eng._decode_chunk()
+        it += 1
+        assert it < 800, "engine made no progress"
+    return {i: r for i, r in results}
+
+
+def test_e2e_greedy_identity_pallas_prefill_on_vs_off():
+    """The acceptance bar: greedy outputs token-identical with
+    use_pallas_prefill on vs off, with long prompts actually exercising
+    chunked-prefill warming (Tq>1 dispatches through the kernel)."""
+    prompts = [
+        list(range(3, 40)),  # multi-chunk warming prompt
+        [11, 4, 8, 1],
+        list(range(5, 30)),
+        [9, 9, 2, 4, 4],
+    ]
+    off = _generate(_engine(False), prompts)
+    eng = _engine(True)
+    assert eng.attn_spec.prefill_impl == "pallas_interpret"
+    on = _generate(eng, prompts)
+    assert eng.chunked_prefill_count > 0, "no warming dispatch ran"
+    for i in range(len(prompts)):
+        assert off[i].output_tokens == on[i].output_tokens, i
+        np.testing.assert_allclose(
+            off[i].output_logprobs, on[i].output_logprobs,
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_e2e_greedy_identity_int8_prefill():
+    """Both tentpole rungs composed: kv_quant="int8" + use_pallas_prefill
+    + use_pallas_decode — every serving dispatch on the kernel tier with
+    in-kernel dequant, still token-identical vs the all-XLA path."""
+    prompts = [list(range(3, 40)), [11, 4, 8, 1], list(range(2, 25))]
+    off = _generate(_engine(False, kv_quant="int8"), prompts)
+    eng = _engine(True, kv_quant="int8", use_pallas_decode=True)
+    assert eng.attn_spec.prefill_impl == "pallas_interpret"
+    assert eng.attn_spec.decode_impl == "pallas_interpret"
+    assert eng.metrics_snapshot()["pallas_fallback_total"] == 0
+    on = _generate(eng, prompts)
+    for i in range(len(prompts)):
+        assert off[i].output_tokens == on[i].output_tokens, i
+
+
+def test_knob_falls_back_loudly_on_tp():
+    """tp>1 keeps the XLA prefill path — one-shot warning plus a counted
+    pallas_fallback_total{site=prefill,reason=tp_size} entry."""
+    eng = _engine(True, tp_size=2)
+    assert eng.attn_spec.prefill_impl == "xla"
+    snap = eng.metrics_snapshot()
+    assert snap["pallas_fallback_total"] == 1
+    assert snap["pallas_fallback_total{site=prefill,reason=tp_size}"] == 1
+
+
+def test_radix_suffix_prefill_through_kernel():
+    """The radix-hit path the kernel exists for: a second request sharing
+    a long prefix admits via copy + suffix-extension (cache_len mid-block
+    at the radix boundary) and must produce identical tokens with the
+    kernel on vs off."""
+    base = list(range(3, 35))
+    prompts = [base + [40, 41, 42], base + [50, 51]]
+    off_eng = _engine(False, prefix_extend_min=8)
+    off = _generate(off_eng, prompts)
+    on_eng = _engine(True, prefix_extend_min=8)
+    on = _generate(on_eng, prompts)
+    for i in range(len(prompts)):
+        assert off[i].output_tokens == on[i].output_tokens, i
