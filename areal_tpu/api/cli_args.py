@@ -414,7 +414,10 @@ class EngineBackendConfig:
     # | dots_with_no_batch_dims_saveable
     remat_policy: str = "nothing_saveable"
     param_dtype: str = "bfloat16"
-    compute_dtype: str = "bfloat16"
+    # "" = follow param_dtype; set explicitly (e.g. "bfloat16" with
+    # param_dtype="float32") for mixed-precision forward/backward — params
+    # are cast at the top of each compute (train_engine._cast_for_compute)
+    compute_dtype: str = ""
     optimizer_dtype: str = "float32"  # adam mu AND nu storage dtype
     grad_acc_dtype: str = "float32"  # microbatch gradient accumulator dtype
     fsdp: bool = True  # shard params/optimizer over the dp axis (ZeRO-3-like)

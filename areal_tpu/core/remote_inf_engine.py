@@ -145,6 +145,11 @@ class RemoteInfEngine(InferenceEngine):
         # need to commit) or LEAVE mid-stream (tearing the fan-out's target
         # set). A membership change racing an update simply defers until
         # the stream settles; an RLock so nested fenced paths compose.
+        #
+        # Rollout-plane acquisition order (checked by the lock-order pass):
+        # membership fence outermost, then the weight-push executor lock,
+        # then the per-request accounting leaf. Never acquire upward.
+        # lock_order: _membership_lock -> _push_lock -> _inflight_lock
         self._membership_lock = threading.RLock()
 
     # ------------------------------------------------------------------
@@ -2157,6 +2162,11 @@ class RemoteInfEngine(InferenceEngine):
             return
         self._paused.set()
         self._fanout("pause_generation")
+        grace = self.config.pause_grace_period
+        if grace > 0:
+            # let servers drain in-flight token loops past the fence
+            # before the caller starts mutating weights
+            time.sleep(grace)
         self.executor.pause()
 
     def resume(self):

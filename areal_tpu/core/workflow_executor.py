@@ -141,6 +141,10 @@ class WorkflowExecutor:
         # polled inside wait/prepare_batch loops; when it returns True the
         # blocked call raises RolloutWaitInterrupted (preemption guard hook)
         self.interrupt_check: Callable[[], bool] | None = None
+        # _exc_lock is a LEAF: the staleness manager's lock may be held
+        # around executor callbacks, but no _exc_lock region may call back
+        # into the staleness manager (checked by the lock-order pass).
+        # lock_order: StalenessManager._lock -> _exc_lock
         self._exc_lock = threading.Lock()
         self._thread_exc: BaseException | None = None  # guarded_by: _exc_lock
         self.rollout_thread: threading.Thread | None = None

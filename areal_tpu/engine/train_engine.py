@@ -392,6 +392,13 @@ class TPUTrainEngine(TrainEngine):
                     )
         self.attn_spec = self._build_attn_spec()
 
+        if cfg.optimizer is not None and cfg.optimizer.offload_optimizer_state:
+            # refuse rather than silently ignore: adam state stays on
+            # device until host-offload lands
+            raise NotImplementedError(
+                "optimizer.offload_optimizer_state is not implemented by "
+                "the JAX backend; set it to False"
+            )
         param_dtype = _DTYPES[cfg.backend.param_dtype]
         shardings = self.param_shardings()
         if cfg.init_from_scratch or not cfg.path:
@@ -753,7 +760,11 @@ class TPUTrainEngine(TrainEngine):
             input_,
             max_tokens_per_mb=self.config.mb_spec.max_tokens_per_mb,
             min_n_mbs=self.config.mb_spec.n_mbs,
-            group_size=group_size,
+            # config-declared adjacency (mb_spec.granularity, e.g. GRPO
+            # groups) composes with the caller's structural grouping
+            group_size=max(
+                group_size, int(self.config.mb_spec.granularity or 1)
+            ),
         )
         multiple = self.config.backend.pad_mb_to_multiple
         packed_mbs, real_ns = [], []
@@ -1026,6 +1037,7 @@ class TPUTrainEngine(TrainEngine):
             vlm_grids = self._vlm_grids
 
             def compute(params, mbs):
+                params = self._cast_for_compute(params)
                 logits = forward_packed_pipelined(
                     params,
                     cfg,
@@ -1080,6 +1092,7 @@ class TPUTrainEngine(TrainEngine):
             cfg, backend = self.model_config, self.config.backend
 
             def compute(params, mb):
+                params = self._cast_for_compute(params)
                 logits = forward_packed(
                     params,
                     cfg,
@@ -1105,6 +1118,7 @@ class TPUTrainEngine(TrainEngine):
             cfg, backend = self.model_config, self.config.backend
 
             def compute(params, mb):
+                params = self._cast_for_compute(params)
                 logp, ent = forward_fused_logp(
                     params,
                     cfg,
@@ -1132,6 +1146,22 @@ class TPUTrainEngine(TrainEngine):
             and self.config.backend.loss_chunk_size > 0
             and pp_size(self.mesh) == 1
             and not self.model_config.is_critic
+        )
+
+    def _cast_for_compute(self, params):
+        """An explicit ``backend.compute_dtype`` != ``param_dtype`` casts
+        floating params at the top of each forward; the default (unset, or
+        equal dtypes) returns params untouched, so the jaxpr is unchanged."""
+        backend = self.config.backend
+        target = backend.compute_dtype or backend.param_dtype
+        if target == backend.param_dtype:
+            return params
+        dt = _DTYPES[target]
+        return jax.tree.map(
+            lambda p: p.astype(dt)
+            if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            params,
         )
 
     def _build_grad_step(self, compute: Callable) -> Callable:
@@ -1197,7 +1227,12 @@ class TPUTrainEngine(TrainEngine):
 
             self._jit_cache[key] = jax.jit(
                 _retrace.wrap("train_engine.apply", apply),
-                donate_argnums=(0, 1, 2),
+                # donate_params=False keeps the pre-step params buffer
+                # alive (debug/what-if reads) at the cost of a full extra
+                # params copy; grads/opt_state are always donated
+                donate_argnums=(0, 1, 2)
+                if self.config.backend.donate_params
+                else (1, 2),
             )
         return self._jit_cache[key]
 

@@ -94,7 +94,13 @@ class FleetController:
         self._members: dict[str, ServerHandle] = {}
         self._seq = itertools.count()
         self._run_tag = uuid.uuid4().hex[:6]
-        # serializes step()/set_size()/close() across threads
+        # serializes step()/set_size()/close() across threads.
+        # Cross-plane acquisition order (checked by the lock-order pass):
+        # the scale-operation lock is OUTERMOST — _execute registers and
+        # deregisters members through the client, which takes its
+        # membership fence; the client must never call back into the
+        # controller while fenced.
+        # lock_order: _op_lock -> _membership_lock -> _push_lock
         self._op_lock = threading.Lock()
         self._fetch_info = (
             fetch_info if fetch_info is not None else self._default_fetch_info
@@ -274,7 +280,12 @@ class FleetController:
                         signals.rollout_wait_fraction, 3
                     ),
                 )
-                self._execute(decision)
+                # _op_lock exists to serialize scale operations end-to-end
+                # (spawn + readiness gate included); holding it through the
+                # slow _execute IS the design, and nothing latency-critical
+                # contends on it (step() runs on the controller thread,
+                # set_size() is an operator call).
+                self._execute(decision)  # arealint: disable=await-under-lock
             return decision
 
     def set_size(self, n: int) -> ScaleDecision:
@@ -293,7 +304,8 @@ class FleetController:
                     current=current,
                     reason=decision.reason,
                 )
-                self._execute(decision)
+                # same serialized-operations design as step() above
+                self._execute(decision)  # arealint: disable=await-under-lock
             return decision
 
     def _execute(self, decision: ScaleDecision) -> None:

@@ -233,7 +233,9 @@ class SimServer:
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
                 web.post("/push_weights_to_peer", self.push_weights_to_peer),
-                web.post("/abort_request", self.abort_request),
+                # protocol parity with the real server (see inference/server.py):
+                # no in-repo caller by design
+                web.post("/abort_request", self.abort_request),  # arealint: disable=http-contract
             ]
         )
         return app

@@ -508,7 +508,12 @@ class GenerationEngine:
         self.weight_peer_pushes_total = 0
         # brackets every (params, version) co-publish so an exporter on
         # another thread (peer push) can never read a new tree under the
-        # old version or vice versa; held only for pointer assignments
+        # old version or vice versa; held only for pointer assignments.
+        # Weight-plane acquisition order (checked by the lock-order pass):
+        # chunk staging strictly before the publish pointer-swap — the
+        # commit path drops _staging_lock before publishing, and nothing
+        # may reach back into staging while holding the publish lock.
+        # lock_order: _staging_lock -> _publish_lock
         self._publish_lock = threading.Lock()
         self._lock = threading.Lock()
         self._dead: Exception | None = None
@@ -944,6 +949,13 @@ class GenerationEngine:
         on only) receives engine-internal events for this request."""
         if self._dead is not None:
             raise RuntimeError("generation engine loop died") from self._dead
+        if gconfig.frequency_penalty:
+            # refuse rather than silently sample without it: the JAX
+            # sampler implements temperature/top_k/top_p/greedy only
+            raise ValueError(
+                "frequency_penalty is not implemented by the JAX sampling "
+                "backend; set GenerationHyperparameters.frequency_penalty=0"
+            )
         if len(input_ids) >= self.config.max_seq_len:
             resp = ModelResponse(
                 input_tokens=list(input_ids), stop_reason="length"

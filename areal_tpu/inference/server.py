@@ -116,7 +116,10 @@ class GenerationServer:
                 web.get("/model_info", self.model_info),
                 web.get("/metrics", self.metrics),
                 web.post("/generate", self.generate),
-                web.post("/abort_request", self.abort_request),
+                # operator/protocol-parity surface (SGLang-style API): the
+                # rollout client cancels via asyncio task cancellation, so
+                # nothing in-repo POSTs here by design
+                web.post("/abort_request", self.abort_request),  # arealint: disable=http-contract
                 web.post("/pause_generation", self.pause),
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),

@@ -45,6 +45,14 @@ def _sbatch_header(
         "#SBATCH --open-mode=append",
     ]
     lines.extend(extra or [])
+    if cfg.cluster.n_nodes and n_tasks > cfg.cluster.n_nodes:
+        # one task per node: a plan wider than the declared cluster queues
+        # forever in sbatch — say so at render time
+        logger.warning(
+            "job %s wants %d single-task nodes but cluster.n_nodes=%d; "
+            "sbatch will pend until the cluster grows",
+            job_name, n_tasks, cfg.cluster.n_nodes,
+        )
     return lines
 
 

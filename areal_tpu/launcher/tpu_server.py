@@ -52,7 +52,13 @@ def _load_tokenizer(path: str):
 
 async def amain(cfg: GenServerConfig):
     name_resolve.reconfigure(cfg.name_resolve)
-    tokenizer = _load_tokenizer(cfg.server.model_path) if cfg.server.model_path else None
+    # skip_tokenizer_init: callers speak token ids end-to-end, so skip the
+    # HF load entirely (stop-string matching is disabled either way)
+    tokenizer = (
+        _load_tokenizer(cfg.server.model_path)
+        if cfg.server.model_path and not cfg.server.skip_tokenizer_init
+        else None
+    )
     engine = GenerationEngine(cfg.server, tokenizer=tokenizer)
     server = GenerationServer(engine)
     port = cfg.server.port or network.find_free_ports(1)[0]

@@ -2,16 +2,30 @@
 ``areal-tpu-lint``).
 
 Exit codes: 0 clean (warnings alone don't fail unless ``--strict``),
-1 findings, 2 bad invocation.
+1 findings, 2 bad invocation or a failed ``--self-test``.
+
+The whole-program index (symbol table + call graph for the cross-file
+passes) is built once per run and shared with the per-file rules, so every
+file is parsed exactly once. ``--changed-only`` additionally reuses
+per-file findings from ``.arealint-cache.json`` for files whose
+mtime+size+sha1 are unchanged (the cross-file passes always run on the
+full index — their findings are cross-file by definition).
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import sys
+import time
 
 from areal_tpu.lint import framework
+from areal_tpu.lint import project as project_mod
+
+CACHE_VERSION = 1
+DEFAULT_CACHE_FILE = ".arealint-cache.json"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -19,7 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="areal-tpu-lint",
         description=(
             "JAX/async-aware static analysis for areal_tpu (use-after-"
-            "donate, PRNG reuse, blocking-call-in-async, jax-compat, ...)"
+            "donate, PRNG reuse, blocking-call-in-async, jax-compat, and "
+            "whole-program passes: lock-order deadlocks, dead config "
+            "knobs, HTTP contract drift, metrics-name drift)"
         ),
     )
     p.add_argument(
@@ -75,43 +91,180 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="also print findings matched by the baseline",
     )
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="smoke-test the whole-program index (module/import/call-"
+        "graph resolution) before linting; a wedged index exits 2 "
+        "instead of silently analyzing nothing",
+    )
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="reuse per-file findings for files unchanged since the last "
+        "run (mtime+size+sha1, stored in --cache-file); cross-file "
+        "passes still run on the full index",
+    )
+    p.add_argument(
+        "--cache-file",
+        metavar="FILE",
+        default=DEFAULT_CACHE_FILE,
+        help=f"findings cache for --changed-only "
+        f"(default: {DEFAULT_CACHE_FILE})",
+    )
     return p
+
+
+def _file_sig(path: str) -> str | None:
+    try:
+        st = os.stat(path)
+        with open(path, "rb") as f:
+            digest = hashlib.sha1(f.read()).hexdigest()
+    except OSError:
+        return None
+    return f"{st.st_mtime_ns}:{st.st_size}:{digest}"
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != CACHE_VERSION:
+            return {}
+        return data.get("files", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_cache(path: str, files: dict) -> None:
+    payload = {
+        "version": CACHE_VERSION,
+        "comment": (
+            "arealint --changed-only findings cache; safe to delete. "
+            "Keys are linted paths, sig is mtime_ns:size:sha1."
+        ),
+        "files": files,
+    }
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        print(f"warning: could not write {path}: {e}", file=sys.stderr)
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     rules = framework.all_rules()
+    project_rules = framework.all_project_rules()
+    every_rule = {**rules, **project_rules}
 
     if args.list_rules:
-        width = max(len(r) for r in rules)
-        for rid in sorted(rules):
-            rule = rules[rid]
-            print(f"{rid:<{width}}  [{rule.severity}]  {rule.doc}")
+        width = max(len(r) for r in every_rule)
+        for rid in sorted(every_rule):
+            rule = every_rule[rid]
+            scope = (
+                "project"
+                if isinstance(rule, framework.ProjectRule)
+                else "file"
+            )
+            print(
+                f"{rid:<{width}}  [{rule.severity}]  ({scope})  {rule.doc}"
+            )
         return 0
+
+    if args.changed_only and (args.select or args.ignore):
+        print(
+            "--changed-only caches full-ruleset findings; it cannot be "
+            "combined with --select/--ignore",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
-        unknown = wanted - set(rules)
+        unknown = wanted - set(every_rule)
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
         rules = {k: v for k, v in rules.items() if k in wanted}
+        project_rules = {
+            k: v for k, v in project_rules.items() if k in wanted
+        }
     if args.ignore:
         dropped = {r.strip() for r in args.ignore.split(",") if r.strip()}
-        unknown = dropped - set(framework.all_rules())
+        unknown = dropped - set(every_rule)
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
         rules = {k: v for k, v in rules.items() if k not in dropped}
+        project_rules = {
+            k: v for k, v in project_rules.items() if k not in dropped
+        }
 
     for path in args.paths:
         if not os.path.exists(path):
             print(f"no such path: {path}", file=sys.stderr)
             return 2
 
-    findings = framework.lint_paths(args.paths, rules)
+    t0 = time.monotonic()
+    index = project_mod.ProjectIndex.build(args.paths)
+    t_index = time.monotonic() - t0
+
+    if args.self_test:
+        problems = index.self_test()
+        if problems:
+            print(
+                "arealint --self-test FAILED (whole-program index is "
+                "wedged; cross-file passes would analyze garbage):",
+                file=sys.stderr,
+            )
+            for problem in problems:
+                print(f"  - {problem}", file=sys.stderr)
+            return 2
+        n_edges = sum(len(v) for v in index.call_graph.values())
+        print(
+            f"arealint --self-test ok: {len(index.modules)} modules, "
+            f"{len(index.functions)} functions, {len(index.classes)} "
+            f"classes, {n_edges} call edges "
+            f"({t_index:.2f}s index build)"
+        )
+
+    findings: list[framework.Finding] = []
+    cache_hits = 0
+    if args.changed_only:
+        cached_files = _load_cache(args.cache_file)
+        new_cache: dict = {}
+        for path in index.file_order:
+            sig = _file_sig(path)
+            entry = cached_files.get(path)
+            if sig is not None and entry and entry.get("sig") == sig:
+                cache_hits += 1
+                file_findings = [
+                    framework.Finding(**f) for f in entry["findings"]
+                ]
+            else:
+                file_findings = framework.lint_file(
+                    path, rules, ctx=index.context(path)
+                )
+            findings.extend(file_findings)
+            if sig is not None:
+                new_cache[path] = {
+                    "sig": sig,
+                    "findings": [f.to_dict() for f in file_findings],
+                }
+        _save_cache(args.cache_file, new_cache)
+    else:
+        for path in index.file_order:
+            findings.extend(
+                framework.lint_file(path, rules, ctx=index.context(path))
+            )
+    findings.extend(index.parse_findings)
+    findings.extend(framework.run_project_rules(index, project_rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     if not args.no_config:
         findings = framework.apply_per_path_ignores(
             findings, framework.load_per_path_ignores()
@@ -131,12 +284,25 @@ def main(argv: list[str] | None = None) -> int:
         entries = framework.load_baseline(args.baseline)
         findings, baselined = framework.apply_baseline(findings, entries)
 
+    wall = time.monotonic() - t0
+    timing = (
+        f"wall {wall:.2f}s over {len(index.file_order)} files "
+        f"(index {t_index:.2f}s"
+        + (f", {cache_hits} cached" if args.changed_only else "")
+        + ")"
+    )
     if args.format == "json":
-        print(framework.render_json(findings, baselined))
+        payload = json.loads(framework.render_json(findings, baselined))
+        payload["summary"]["wall_seconds"] = round(wall, 3)
+        payload["summary"]["files"] = len(index.file_order)
+        if args.changed_only:
+            payload["summary"]["cache_hits"] = cache_hits
+        print(json.dumps(payload, indent=2))
     else:
         shown = findings + (baselined if args.show_baselined else [])
         shown.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         print(framework.render_text(shown, baselined))
+        print(f"arealint: {timing}")
 
     failing = [
         f

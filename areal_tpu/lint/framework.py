@@ -19,6 +19,10 @@ Inline controls (comments):
   loop for the host-sync-in-hot-path rule.
 - ``# guarded_by: <lock>`` — trailing an ``__init__`` attribute assignment:
   every other access to that attribute must sit inside ``with self.<lock>:``.
+- ``# lock_order: A -> B [-> C]`` — declares the intended global
+  acquisition order for the named locks (see rules/lock_graph.py for the
+  name grammar). Declared edges seed the whole-program lock-order graph;
+  an observed acquisition that reverses a declared edge is an error.
 
 Baseline: a committed JSON file of pre-existing findings keyed on
 ``(rule, path, message)`` — line-number-independent so unrelated edits don't
@@ -89,16 +93,46 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A whole-program pass: sees the cross-file ProjectIndex (symbol
+    table, call graph, every FileContext) instead of one file at a time.
+    Subclasses implement ``check_project(index)``; findings still anchor
+    to a concrete file/line so inline suppressions keep working."""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        return iter(())  # per-file phase: nothing; runs in project phase
+
+    def check_project(self, index) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding_at(
+        self, path: str, line: int, col: int, message: str,
+        severity: str | None = None,
+    ) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=path,
+            line=line,
+            col=col,
+            message=message,
+            severity=severity or self.severity,
+        )
+
+
 _REGISTRY: dict[str, Rule] = {}
+_PROJECT_REGISTRY: dict[str, ProjectRule] = {}
 
 
 def register(cls: type[Rule]) -> type[Rule]:
     rule = cls()
     if not rule.id:
         raise ValueError(f"rule {cls.__name__} has no id")
-    if rule.id in _REGISTRY:
+    if rule.id in _REGISTRY or rule.id in _PROJECT_REGISTRY:
         raise ValueError(f"duplicate rule id {rule.id!r}")
-    _REGISTRY[rule.id] = rule
+    if isinstance(rule, ProjectRule):
+        _PROJECT_REGISTRY[rule.id] = rule
+    else:
+        _REGISTRY[rule.id] = rule
     return cls
 
 
@@ -107,6 +141,12 @@ def all_rules() -> dict[str, Rule]:
     from areal_tpu.lint import rules  # noqa: F401
 
     return dict(_REGISTRY)
+
+
+def all_project_rules() -> dict[str, ProjectRule]:
+    from areal_tpu.lint import rules  # noqa: F401
+
+    return dict(_PROJECT_REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -126,11 +166,15 @@ class FileContext:
         self.hot_lines: set[int] = set()
         #: line -> lock name from ``# guarded_by: <lock>``
         self.guarded_by: dict[int, str] = {}
+        #: (line, spec) pairs from ``# lock_order: A -> B [-> C]``
+        self.lock_orders: list[tuple[int, str]] = []
         self._scan_comments()
         #: local name -> canonical dotted module/object path from imports
         self.aliases = self._collect_aliases()
         self._parents: dict[ast.AST, ast.AST] | None = None
         self._stmt_spans: list[tuple[int, int]] | None = None
+        self._all_nodes: list[ast.AST] | None = None
+        self._by_type: dict[type, list[ast.AST]] = {}
 
     # -- comments -----------------------------------------------------------
 
@@ -146,6 +190,13 @@ class FileContext:
             comments = []
         for line, text in comments:
             body = text.lstrip("#").strip()
+            # anchored at comment start so prose *mentioning* the grammar
+            # (docs, examples) doesn't declare an order
+            if body.startswith("lock_order:"):
+                spec = body.split("lock_order:", 1)[1].strip()
+                if spec:
+                    self.lock_orders.append((line, spec))
+                continue
             if "guarded_by:" in body:
                 lock = body.split("guarded_by:", 1)[1].strip().split()[0]
                 if lock:
@@ -185,7 +236,7 @@ class FileContext:
             self._stmt_spans = sorted(
                 {
                     (n.lineno, n.end_lineno or n.lineno)
-                    for n in ast.walk(self.tree)
+                    for n in self.walk()
                     if isinstance(n, ast.stmt)
                 }
             )
@@ -257,7 +308,7 @@ class FileContext:
     def parent(self, node: ast.AST) -> ast.AST | None:
         if self._parents is None:
             self._parents = {}
-            for p in ast.walk(self.tree):
+            for p in self.walk():
                 for c in ast.iter_child_nodes(p):
                     self._parents[c] = p
         return self._parents.get(node)
@@ -277,10 +328,31 @@ class FileContext:
             cur = nxt
         return cur  # type: ignore[return-value]
 
+    def walk(self) -> list[ast.AST]:
+        """The full ``ast.walk`` of the tree, computed once and shared by
+        every rule — repo-wide runs used to pay one tree traversal per
+        rule per file."""
+        if self._all_nodes is None:
+            self._all_nodes = list(ast.walk(self.tree))
+        return self._all_nodes
+
+    def by_type(self, *types: type) -> list[ast.AST]:
+        """Nodes of the given types, from the shared walk (cached per
+        type-tuple element so different rules share the filter cost)."""
+        out: list[ast.AST] = []
+        for t in types:
+            if t not in self._by_type:
+                self._by_type[t] = [
+                    n for n in self.walk() if type(n) is t
+                ]
+            out.extend(self._by_type[t])
+        if len(types) > 1:
+            out.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                    getattr(n, "col_offset", 0)))
+        return out
+
     def functions(self) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
-        for node in ast.walk(self.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield node
+        yield from self.by_type(ast.FunctionDef, ast.AsyncFunctionDef)
 
 
 def walk_excluding_nested_functions(
@@ -328,25 +400,29 @@ def lint_file(
     path: str,
     rules: dict[str, Rule] | None = None,
     source: str | None = None,
+    ctx: "FileContext | None" = None,
 ) -> list[Finding]:
-    """All unsuppressed findings for one file (baseline not applied here)."""
+    """All unsuppressed findings for one file (baseline not applied here).
+    Pass ``ctx`` to reuse an already-parsed FileContext (the whole-program
+    index shares its per-file parses with the per-file rules)."""
     rules = rules if rules is not None else all_rules()
     norm = os.path.normpath(path).replace(os.sep, "/")
-    if source is None:
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-    try:
-        ctx = FileContext(norm, source)
-    except SyntaxError as e:
-        return [
-            Finding(
-                rule="parse-error",
-                path=norm,
-                line=e.lineno or 0,
-                col=e.offset or 0,
-                message=f"file does not parse: {e.msg}",
-            )
-        ]
+    if ctx is None:
+        if source is None:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        try:
+            ctx = FileContext(norm, source)
+        except SyntaxError as e:
+            return [
+                Finding(
+                    rule="parse-error",
+                    path=norm,
+                    line=e.lineno or 0,
+                    col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}",
+                )
+            ]
     if ctx.skip_file:
         return []
     findings: list[Finding] = []
@@ -358,12 +434,71 @@ def lint_file(
     return findings
 
 
-def lint_paths(
-    paths: Iterable[str], rules: dict[str, Rule] | None = None
+#: inline suppression form honored in non-Python files (markdown catalogs):
+#: any line containing ``arealint: disable=<rule>`` suppresses findings
+#: the project rules anchor to that line.
+def _text_line_suppressed(path: str, line: int, rule: str) -> bool:
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return False
+    if not (1 <= line <= len(lines)):
+        return False
+    text = lines[line - 1]
+    if "arealint:" not in text:
+        return False
+    directive = text.split("arealint:", 1)[1]
+    if "disable=" not in directive:
+        return False
+    ids = directive.split("disable=", 1)[1]
+    ids = ids.split("-->", 1)[0]
+    return rule in {r.strip() for r in ids.split(",")} or "*" in ids
+
+
+def run_project_rules(
+    index,
+    project_rules: "dict[str, ProjectRule] | None" = None,
 ) -> list[Finding]:
+    """Run whole-program passes over a built ProjectIndex, applying the
+    per-file inline suppressions of whichever file each finding lands in
+    (and the markdown disable form for catalog files)."""
+    project_rules = (
+        project_rules if project_rules is not None else all_project_rules()
+    )
     findings: list[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(path, rules))
+    for rule in project_rules.values():
+        for f in rule.check_project(index):
+            ctx = index.context(f.path)
+            if ctx is not None:
+                if ctx.skip_file or ctx.is_suppressed(f):
+                    continue
+            elif _text_line_suppressed(f.path, f.line, f.rule):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: dict[str, Rule] | None = None,
+    project_rules: "dict[str, ProjectRule] | None" = None,
+) -> list[Finding]:
+    """Per-file rules plus whole-program passes. Every file is parsed
+    exactly once: the ProjectIndex owns the FileContexts and the per-file
+    rules reuse them."""
+    from areal_tpu.lint import project as project_mod
+
+    index = project_mod.ProjectIndex.build(paths)
+    findings: list[Finding] = []
+    for path in index.file_order:
+        findings.extend(
+            lint_file(path, rules, ctx=index.context(path))
+        )
+    findings.extend(index.parse_findings)
+    findings.extend(run_project_rules(index, project_rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 

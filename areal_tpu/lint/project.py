@@ -1,0 +1,442 @@
+"""Whole-program index for arealint's cross-file passes.
+
+The per-file rules (PR 2) see one AST at a time; the bug classes this repo
+actually ships — a config knob read nowhere (PR 8), a lock-order inversion
+between two planes (PR 15 review), a client POSTing a path no server
+registers — are *cross-file*. ``ProjectIndex`` parses every linted file
+once (the same ``FileContext`` objects the per-file rules run on), then
+builds:
+
+- a module table: file path <-> dotted module name (relative to the common
+  root of the linted paths, so fixture mini-projects index identically to
+  the real tree);
+- a symbol table: top-level classes (with methods and resolved base
+  classes), top-level functions, and module-level string constants;
+- import resolution across files: ``from areal_tpu.utils import metrics as
+  m`` followed by ``m.DEFAULT_REGISTRY.counter`` resolves through the alias
+  map into the indexed module;
+- a call graph over the repo's own functions: direct calls, module-attr
+  calls, and ``self.method()`` resolved through the project-local MRO.
+
+The index is deliberately static and conservative: what it cannot resolve
+it leaves out of the graph (rules treat absence as "unknown", never as
+evidence). ``self_test()`` guards the other failure mode — a wedged
+import-resolution bug silently analyzing nothing — by checking that
+internal imports land on indexed modules and that the call graph is
+non-trivial for non-trivial projects.
+
+Built once per run and memoized in-process on (path, mtime, size) of every
+indexed file, so test suites that lint repeatedly share one build.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator
+
+from areal_tpu.lint.framework import (
+    FileContext,
+    Finding,
+    iter_python_files,
+)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str  # module.func or module.Class.method
+    module: str
+    path: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: "ClassInfo | None" = None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qualname: str  # module.Class
+    name: str
+    module: str
+    path: str
+    node: ast.ClassDef
+    #: raw base-class expressions resolved through import aliases
+    #: (dotted strings; resolution to ClassInfo happens via the index)
+    base_names: list[str] = dataclasses.field(default_factory=list)
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str  # dotted, relative to the index root
+    path: str  # normalized path as linted (relative when linted relative)
+    ctx: FileContext
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict
+    )
+    #: module-level NAME = "literal" constants (cross-file constant
+    #: resolution, e.g. metric names shared between planes)
+    str_constants: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _module_name_for(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.replace(os.sep, "/").split("/") if p != ".."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else os.path.basename(root)
+
+
+# in-process memo: identical file sets (path+mtime+size) share one index
+_CACHE: dict[tuple, "ProjectIndex"] = {}
+_CACHE_MAX = 8
+
+
+class ProjectIndex:
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_path: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.file_order: list[str] = []
+        self.parse_findings: list[Finding] = []
+        #: qualname -> set of callee qualnames (project-internal only)
+        self.call_graph: dict[str, set[str]] = {}
+        self._mro_cache: dict[str, list[ClassInfo]] = {}
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(
+        cls, paths: Iterable[str], sources: dict[str, str] | None = None
+    ) -> "ProjectIndex":
+        """Index every python file under ``paths``. ``sources`` overrides
+        file contents by normalized path — used by tests to ask "would
+        this edit introduce a finding?" without touching the tree."""
+        files = list(iter_python_files(paths))
+        sources = sources or {}
+        sig = None
+        if not sources:
+            try:
+                sig = tuple(
+                    (p, os.stat(p).st_mtime_ns, os.stat(p).st_size)
+                    for p in files
+                )
+            except OSError:
+                sig = None
+            if sig is not None and sig in _CACHE:
+                return _CACHE[sig]
+        abs_dirs = [
+            os.path.dirname(os.path.abspath(p))
+            if os.path.isfile(p)
+            else os.path.abspath(p)
+            for p in paths
+        ] or [os.getcwd()]
+        root = (
+            os.path.commonpath(abs_dirs) if abs_dirs else os.getcwd()
+        )
+        index = cls(root)
+        for path in files:
+            norm = os.path.normpath(path).replace(os.sep, "/")
+            try:
+                source = sources.get(norm)
+                if source is None:
+                    with open(path, encoding="utf-8") as f:
+                        source = f.read()
+                ctx = FileContext(norm, source)
+            except (OSError, SyntaxError) as e:
+                lineno = getattr(e, "lineno", 0) or 0
+                offset = getattr(e, "offset", 0) or 0
+                index.parse_findings.append(
+                    Finding(
+                        rule="parse-error",
+                        path=norm,
+                        line=lineno,
+                        col=offset,
+                        message=f"file does not parse: "
+                        f"{getattr(e, 'msg', e)}",
+                    )
+                )
+                continue
+            index._add_module(norm, ctx)
+        index._build_call_graph()
+        if sig is not None:
+            if len(_CACHE) >= _CACHE_MAX:
+                _CACHE.pop(next(iter(_CACHE)))
+            _CACHE[sig] = index
+        return index
+
+    def _add_module(self, path: str, ctx: FileContext) -> None:
+        name = _module_name_for(path, self.root)
+        mod = ModuleInfo(name=name, path=path, ctx=ctx)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                cinfo = ClassInfo(
+                    qualname=f"{name}.{stmt.name}",
+                    name=stmt.name,
+                    module=name,
+                    path=path,
+                    node=stmt,
+                    base_names=[
+                        r
+                        for b in stmt.bases
+                        if (r := ctx.resolved(b)) is not None
+                    ],
+                )
+                for member in stmt.body:
+                    if isinstance(
+                        member, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        finfo = FunctionInfo(
+                            qualname=f"{cinfo.qualname}.{member.name}",
+                            module=name,
+                            path=path,
+                            node=member,
+                            cls=cinfo,
+                        )
+                        cinfo.methods[member.name] = finfo
+                        self.functions[finfo.qualname] = finfo
+                mod.classes[stmt.name] = cinfo
+                self.classes[cinfo.qualname] = cinfo
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                finfo = FunctionInfo(
+                    qualname=f"{name}.{stmt.name}",
+                    module=name,
+                    path=path,
+                    node=stmt,
+                )
+                mod.functions[stmt.name] = finfo
+                self.functions[finfo.qualname] = finfo
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if (
+                    isinstance(tgt, ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    mod.str_constants[tgt.id] = stmt.value.value
+        self.modules[name] = mod
+        self.by_path[path] = mod
+        self.file_order.append(path)
+
+    # ------------------------------------------------------- resolution
+
+    def context(self, path: str) -> FileContext | None:
+        mod = self.by_path.get(path)
+        return mod.ctx if mod else None
+
+    def iter_contexts(self) -> Iterator[FileContext]:
+        for path in self.file_order:
+            yield self.by_path[path].ctx
+
+    def is_test_path(self, path: str) -> bool:
+        """Test-ness judged relative to the index root, so a fixture
+        mini-project under tests/lint_fixtures/ indexed at its own root
+        sees its files as product code."""
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        parts = rel.replace(os.sep, "/").split("/")
+        return any(p in ("tests", "test") for p in parts[:-1]) or parts[
+            -1
+        ].startswith("test_")
+
+    def _split_module_prefix(
+        self, dotted: str
+    ) -> tuple[ModuleInfo | None, str]:
+        """Longest indexed-module prefix of a canonical dotted name, plus
+        the remainder (symbol path inside that module)."""
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = self.modules.get(".".join(parts[:i]))
+            if mod is not None:
+                return mod, ".".join(parts[i:])
+        return None, dotted
+
+    def resolve_symbol(
+        self, module: ModuleInfo, dotted: str
+    ) -> ClassInfo | FunctionInfo | None:
+        """Resolve a dotted name as written in ``module`` (through its
+        import aliases) to an indexed class/function/method."""
+        canon = dotted
+        root, _, rest = dotted.partition(".")
+        target = module.ctx.aliases.get(root)
+        if target is not None:
+            canon = f"{target}.{rest}" if rest else target
+        elif root in module.classes or root in module.functions:
+            canon = f"{module.name}.{dotted}"
+        owner, remainder = self._split_module_prefix(canon)
+        if owner is None:
+            return None
+        if not remainder:
+            return None
+        parts = remainder.split(".")
+        head = parts[0]
+        if head in owner.classes:
+            cinfo = owner.classes[head]
+            if len(parts) == 1:
+                return cinfo
+            if len(parts) == 2:
+                return self.lookup_method(cinfo, parts[1])
+            return None
+        if len(parts) == 1 and head in owner.functions:
+            return owner.functions[head]
+        return None
+
+    def resolve_str_constant(
+        self, module: ModuleInfo, name: str
+    ) -> str | None:
+        """A Name used where a string is expected: local module constant
+        or an imported one (``from x import NAME``)."""
+        if name in module.str_constants:
+            return module.str_constants[name]
+        target = module.ctx.aliases.get(name)
+        if target is None:
+            return None
+        owner, remainder = self._split_module_prefix(target)
+        if owner is not None and remainder and "." not in remainder:
+            return owner.str_constants.get(remainder)
+        return None
+
+    def class_mro(self, cinfo: ClassInfo) -> list[ClassInfo]:
+        """Project-local linearization: the class, then its indexed bases
+        depth-first (external bases are invisible, which is fine — their
+        methods cannot be analyzed anyway)."""
+        cached = self._mro_cache.get(cinfo.qualname)
+        if cached is not None:
+            return cached
+        out: list[ClassInfo] = []
+        seen: set[str] = set()
+
+        def visit(c: ClassInfo) -> None:
+            if c.qualname in seen:
+                return
+            seen.add(c.qualname)
+            out.append(c)
+            mod = self.modules.get(c.module)
+            for base in c.base_names:
+                # base_names are canonical (alias-resolved) dotted strings:
+                # either module-qualified, or a bare name defined in the
+                # same module
+                resolved: ClassInfo | None = None
+                owner, rem = self._split_module_prefix(base)
+                if owner is not None and rem and "." not in rem:
+                    resolved = owner.classes.get(rem)
+                elif mod is not None and "." not in base:
+                    resolved = mod.classes.get(base)
+                if resolved is not None:
+                    visit(resolved)
+
+        visit(cinfo)
+        self._mro_cache[cinfo.qualname] = out
+        return out
+
+    def lookup_method(
+        self, cinfo: ClassInfo, name: str
+    ) -> FunctionInfo | None:
+        for c in self.class_mro(cinfo):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def subclasses_of(self, cinfo: ClassInfo) -> list[ClassInfo]:
+        return [
+            c
+            for c in self.classes.values()
+            if c is not cinfo and cinfo in self.class_mro(c)[1:]
+        ]
+
+    def resolve_call(
+        self, finfo: FunctionInfo, call: ast.Call
+    ) -> FunctionInfo | None:
+        """Best-effort static resolution of a call site inside ``finfo``
+        to a project function. Unresolvable -> None (treated as opaque)."""
+        mod = self.modules.get(finfo.module)
+        if mod is None:
+            return None
+        func = call.func
+        # self.method() / cls.method() through the project MRO
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+            and finfo.cls is not None
+        ):
+            return self.lookup_method(finfo.cls, func.attr)
+        dotted = mod.ctx.dotted(func)
+        if dotted is None:
+            return None
+        target = self.resolve_symbol(mod, dotted)
+        if isinstance(target, FunctionInfo):
+            return target
+        if isinstance(target, ClassInfo):
+            # constructing a project class executes its __init__
+            return self.lookup_method(target, "__init__")
+        return None
+
+    def _build_call_graph(self) -> None:
+        for finfo in self.functions.values():
+            callees: set[str] = set()
+            for node in _walk_own_scope(finfo.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_call(finfo, node)
+                    if target is not None:
+                        callees.add(target.qualname)
+            self.call_graph[finfo.qualname] = callees
+
+    # -------------------------------------------------------- self-test
+
+    def self_test(self) -> list[str]:
+        """Loud-failure smoke for the index builder. Returns problem
+        descriptions (empty == healthy). Catches the silent-wedge modes:
+        nothing indexed, internal imports that stopped resolving to
+        indexed modules, and a call graph that collapsed to nothing."""
+        problems: list[str] = []
+        if not self.modules:
+            problems.append("no modules indexed")
+            return problems
+        top_packages = {m.split(".")[0] for m in self.modules}
+        unresolved: list[str] = []
+        for mod in self.modules.values():
+            for local, target in mod.ctx.aliases.items():
+                if target.split(".")[0] not in top_packages:
+                    continue  # external import (stdlib, site-packages)
+                owner, _ = self._split_module_prefix(target)
+                if owner is None:
+                    unresolved.append(
+                        f"{mod.path}: import of {target!r} resolves to no "
+                        "indexed module"
+                    )
+        # a handful of unresolved internal names can be legitimate
+        # (optional modules behind try/except); a wedge is wholesale
+        if unresolved and len(unresolved) > max(2, len(self.modules) // 10):
+            problems.extend(unresolved[:10])
+            problems.append(
+                f"... {len(unresolved)} internal imports resolve to no "
+                "indexed module (index wedged?)"
+            )
+        n_edges = sum(len(v) for v in self.call_graph.values())
+        if len(self.functions) >= 20 and n_edges == 0:
+            problems.append(
+                f"{len(self.functions)} functions indexed but the call "
+                "graph has zero resolved edges (resolution wedged?)"
+            )
+        return problems
+
+
+def _walk_own_scope(
+    func: ast.AST, *, include_nested: bool = False
+) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/lambda
+    scopes — a nested ``async def`` handed to another event loop does not
+    execute at the parent's call site, so its calls/awaits must not count
+    as the parent's."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if not include_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -2,13 +2,17 @@
 
 from areal_tpu.lint.rules import (  # noqa: F401
     async_discipline,
+    config_knobs,
     donation,
     exceptions,
     executors,
     fs_discipline,
+    http_contract,
     jax_compat,
     jit_discipline,
+    lock_graph,
     locks,
+    metrics_drift,
     metrics_labels,
     prng,
     retries,

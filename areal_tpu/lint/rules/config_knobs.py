@@ -1,0 +1,228 @@
+"""Dead/unread config knob detection (the PR 8 bug class, checked forever).
+
+``MetricsConfig.max_label_values`` shipped as a dataclass field that
+nothing outside ``cli_args.py`` ever read — the registry kept its own
+hardcoded cap. This pass makes that structurally impossible to repeat:
+
+- collect every dataclass reachable from the experiment-config roots
+  (``BaseExperimentConfig``, ``JaxGenConfig``, ``InferenceEngineConfig``)
+  through field annotation types, base classes, and subclasses;
+- every field of every reachable dataclass must have at least one *read*
+  (an ``obj.field`` attribute load, or a ``getattr(obj, "field")`` with a
+  constant name) somewhere in the indexed project outside the defining
+  module and outside any ``cli_args.py``;
+- fields that are consumed off-AST (launcher env synthesis, OmegaConf
+  interpolation) go in the machine-readable allowlist
+  ``.arealint-knobs.json`` at the project root, each entry carrying a
+  justification::
+
+      {"version": 1, "entries": [
+        {"knob": "ClusterSpecConfig.fileroot",
+         "reason": "interpolated by launcher-generated OmegaConf refs"}
+      ]}
+
+Name matching is attribute-name-based (a read of ``cfg.seed`` marks every
+reachable ``seed`` field read). That direction of imprecision only ever
+*hides* dead knobs behind same-named live ones — it cannot produce a false
+positive on a knob that is actually read.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    SEVERITY_WARNING,
+    Finding,
+    ProjectRule,
+    register,
+)
+from areal_tpu.lint.project import ClassInfo, ProjectIndex
+
+ROOT_CONFIG_CLASSES = {
+    "BaseExperimentConfig",
+    "JaxGenConfig",
+    "InferenceEngineConfig",
+}
+
+ALLOWLIST_FILENAME = ".arealint-knobs.json"
+
+
+def _is_dataclass(index: ProjectIndex, cinfo: ClassInfo) -> bool:
+    mod = index.modules.get(cinfo.module)
+    if mod is None:
+        return False
+    for dec in cinfo.node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        resolved = mod.ctx.resolved(target)
+        if resolved in ("dataclasses.dataclass", "dataclass"):
+            return True
+    return False
+
+
+def _annotation_names(node: ast.AST) -> Iterator[str]:
+    """Every identifier mentioned in a field annotation (handles
+    Optional[X], list[X], X | None, "X" string annotations)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _fields(cinfo: ClassInfo) -> Iterator[tuple[str, ast.AnnAssign]]:
+    for stmt in cinfo.node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            ann_names = set(_annotation_names(stmt.annotation))
+            if "ClassVar" in ann_names:
+                continue
+            yield stmt.target.id, stmt
+
+
+def _reachable_configs(index: ProjectIndex) -> list[ClassInfo]:
+    roots = [
+        c
+        for c in index.classes.values()
+        if c.name in ROOT_CONFIG_CLASSES and _is_dataclass(index, c)
+    ]
+    seen: dict[str, ClassInfo] = {}
+    queue = list(roots)
+    while queue:
+        cinfo = queue.pop()
+        if cinfo.qualname in seen:
+            continue
+        seen[cinfo.qualname] = cinfo
+        mod = index.modules.get(cinfo.module)
+        # field types that are themselves project dataclasses
+        if mod is not None:
+            for _, stmt in _fields(cinfo):
+                for name in _annotation_names(stmt.annotation):
+                    target = index.resolve_symbol(mod, name)
+                    if isinstance(target, ClassInfo) and _is_dataclass(
+                        index, target
+                    ):
+                        queue.append(target)
+        # bases carry inherited fields; subclasses are config surface too
+        for base in index.class_mro(cinfo)[1:]:
+            if _is_dataclass(index, base):
+                queue.append(base)
+        for sub in index.subclasses_of(cinfo):
+            if _is_dataclass(index, sub):
+                queue.append(sub)
+    return sorted(seen.values(), key=lambda c: c.qualname)
+
+
+def _collect_reads(index: ProjectIndex) -> dict[str, set[tuple[str, int]]]:
+    """attr/getattr-read name -> set of (module path, line) read sites."""
+    reads: dict[str, set[tuple[str, int]]] = {}
+    for mod in index.modules.values():
+        for node in mod.ctx.walk():
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                reads.setdefault(node.attr, set()).add(
+                    (mod.path, node.lineno)
+                )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("getattr", "hasattr")
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                reads.setdefault(node.args[1].value, set()).add(
+                    (mod.path, node.lineno)
+                )
+    return reads
+
+
+def _load_allowlist(
+    root: str,
+) -> tuple[dict[str, str], str | None]:
+    path = os.path.join(root, ALLOWLIST_FILENAME)
+    if not os.path.isfile(path):
+        return {}, None
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = {
+            e["knob"]: e.get("reason", "") for e in data["entries"]
+        }
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return {}, f"unreadable {ALLOWLIST_FILENAME}: {e}"
+    return entries, None
+
+
+@register
+class DeadConfigKnobRule(ProjectRule):
+    id = "dead-config-knob"
+    doc = (
+        "a config dataclass field reachable from the experiment-config "
+        "roots has no read outside its definition and cli_args.py "
+        "(allowlist: .arealint-knobs.json)"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        configs = _reachable_configs(index)
+        if not configs:
+            return
+        allowlist, problem = _load_allowlist(index.root)
+        if problem is not None:
+            any_cfg = configs[0]
+            yield self.finding_at(
+                any_cfg.path, 1, 0, problem, severity=SEVERITY_WARNING
+            )
+        reads = _collect_reads(index)
+        used_allow: set[str] = set()
+        for cinfo in configs:
+            def_path = cinfo.path
+            cls_span = (cinfo.node.lineno, cinfo.node.end_lineno or 1 << 30)
+            for name, stmt in _fields(cinfo):
+                knob = f"{cinfo.name}.{name}"
+                if knob in allowlist:
+                    used_allow.add(knob)
+                    continue
+                # "outside its definition" = outside the class body (a
+                # consumer in the same module counts) and outside any
+                # cli_args.py (pure config surface)
+                external = {
+                    (p, ln)
+                    for p, ln in reads.get(name, set())
+                    if os.path.basename(p) != "cli_args.py"
+                    and not (
+                        p == def_path
+                        and cls_span[0] <= ln <= cls_span[1]
+                    )
+                }
+                if external:
+                    continue
+                yield self.finding_at(
+                    cinfo.path, stmt.lineno, stmt.col_offset,
+                    f"config knob {knob} has no read outside its "
+                    "definition — it silently does nothing; wire it, "
+                    "delete it, or allowlist it with a justification in "
+                    f"{ALLOWLIST_FILENAME}",
+                )
+        for knob in sorted(set(allowlist) - used_allow):
+            # stale allowlist entries rot into false documentation
+            owner = next(
+                (c for c in configs if knob.startswith(c.name + ".")),
+                configs[0],
+            )
+            yield self.finding_at(
+                owner.path, owner.node.lineno, 0,
+                f"{ALLOWLIST_FILENAME} entry {knob!r} matches no "
+                "reachable config field — remove the stale entry",
+                severity=SEVERITY_WARNING,
+            )

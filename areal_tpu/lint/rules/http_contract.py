@@ -1,0 +1,355 @@
+"""HTTP endpoint contract checking across the client/server split.
+
+The rollout client, fleet controller, reward client, and propagation plane
+all speak literal paths (``/generate``, ``/relay_weights``,
+``/push_weights_to_peer``) to aiohttp apps registered in other files. A
+renamed route, a typo'd client path, or a POST against a GET route is a
+runtime 404/405 under load — and review has to diff two files to see it.
+This pass extracts both sides from the whole-program index and flags:
+
+- a client request path no server registers (error);
+- a client path whose route exists but under a different method (error);
+- a route no client or test ever calls (warning — dead surface or a
+  missing test; externally-scraped endpoints like ``/metrics`` carry an
+  inline suppression with that justification).
+
+Extraction is static and conservative:
+
+- routes: ``web.get/post/...("/path", handler)`` (aiohttp route-table
+  form), ``router.add_get/add_post("/path", ...)``, and
+  ``@routes.get("/path")`` decorators; ``{var}`` segments become
+  wildcards.
+- clients: any string or f-string containing ``http(s)://`` whose path
+  part is at least partly literal (``f"http://{addr}/ready"``); the
+  request method comes from the enclosing call (``session.get``,
+  ``urllib.request.urlopen``, ``arequest_with_retry(method=...)``); plus
+  repo-idiom path helpers (``self._post(addr, "/run", ...)``,
+  ``self._request(addr, "/status", ...)``). Fully-dynamic URLs
+  (``f"http://{addr}{path}"``) are skipped — absence of evidence, not
+  evidence.
+
+If the indexed file set registers no routes at all the pass stays silent:
+linting a client-only subtree proves nothing about the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    SEVERITY_WARNING,
+    Finding,
+    ProjectRule,
+    register,
+)
+from areal_tpu.lint.project import ProjectIndex
+
+_ROUTE_TABLE_FUNCS = {
+    f"aiohttp.web.{m}": m.upper()
+    for m in ("get", "post", "put", "delete", "patch", "head")
+}
+_ADD_ROUTE_ATTRS = {
+    f"add_{m}": m.upper()
+    for m in ("get", "post", "put", "delete", "patch", "head")
+}
+#: repo-idiom client helpers: attr name -> method ("ANY" = unknown)
+_CLIENT_HELPERS = {
+    "_post": "POST",
+    "_get": "GET",
+    "_request": "ANY",
+    "post_json": "POST",
+}
+#: helpers whose string arg is an endpoint *name* (no leading slash):
+#: RemoteInfEngine._fanout("pause_generation") POSTs /pause_generation
+_NAME_HELPERS = {
+    "_fanout": "POST",
+}
+
+_WILDCARD = "{}"
+
+
+@dataclasses.dataclass
+class _Endpoint:
+    method: str
+    segments: tuple[str, ...]
+    raw: str
+    path: str
+    line: int
+    col: int
+    in_test: bool = False
+
+
+def _normalize(path: str) -> tuple[str, ...] | None:
+    path = path.split("?", 1)[0]
+    if not path.startswith("/"):
+        return None
+    segs = []
+    for seg in path.strip("/").split("/"):
+        if seg.startswith("{") or seg == "\0" or "\0" in seg:
+            segs.append(_WILDCARD)
+        else:
+            segs.append(seg)
+    return tuple(segs)
+
+
+def _segments_match(a: tuple[str, ...], b: tuple[str, ...]) -> bool:
+    if len(a) != len(b):
+        return False
+    return all(
+        x == y or x == _WILDCARD or y == _WILDCARD for x, y in zip(a, b)
+    )
+
+
+def _fstring_template(node: ast.AST) -> str | None:
+    """JoinedStr/Constant -> template string with \\0 per placeholder."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("\0")
+        return "".join(parts)
+    return None
+
+
+def _url_path(template: str) -> str | None:
+    for scheme in ("http://", "https://"):
+        if scheme in template:
+            rest = template.split(scheme, 1)[1]
+            slash = rest.find("/")
+            if slash < 0:
+                return None
+            return rest[slash:]
+    return None
+
+
+def _enclosing_call_method(ctx, node: ast.AST) -> str:
+    """Request method implied by the call the URL literal sits in."""
+    for anc in ctx.ancestors(node):
+        if not isinstance(anc, ast.Call):
+            continue
+        in_call = anc.args + [kw.value for kw in anc.keywords]
+        if node not in in_call:
+            continue
+        resolved = ctx.resolved(anc.func) or ""
+        dotted = ctx.dotted(anc.func) or ""
+        last = dotted.rsplit(".", 1)[-1]
+        if resolved == "urllib.request.urlopen":
+            has_data = any(kw.arg == "data" for kw in anc.keywords) or (
+                len(anc.args) >= 2
+            )
+            return "POST" if has_data else "GET"
+        if last in ("get", "post", "put", "delete", "patch", "head"):
+            return last.upper()
+        if last in ("arequest_with_retry", "request_with_retry"):
+            for kw in anc.keywords:
+                if (
+                    kw.arg == "method"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    return kw.value.value.upper()
+            return "POST"  # the helper's default
+        return "ANY"
+    return "ANY"
+
+
+class _Contract:
+    def __init__(self):
+        self.routes: list[_Endpoint] = []
+        self.clients: list[_Endpoint] = []
+        self.test_paths: set[str] = set()
+
+
+def _extract(index: ProjectIndex) -> _Contract:
+    cached = getattr(index, "_http_contract", None)
+    if cached is not None:
+        return cached
+    out = _Contract()
+    for mod in index.modules.values():
+        ctx = mod.ctx
+        is_test = index.is_test_path(mod.path)
+        for node in ctx.walk():
+            # ---- route registrations -------------------------------
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolved(node.func) or ""
+                dotted = ctx.dotted(node.func) or ""
+                attr = dotted.rsplit(".", 1)[-1]
+                method = None
+                if resolved in _ROUTE_TABLE_FUNCS:
+                    method = _ROUTE_TABLE_FUNCS[resolved]
+                elif attr in _ADD_ROUTE_ATTRS and ".router." in f".{dotted}.":
+                    method = _ADD_ROUTE_ATTRS[attr]
+                elif attr in _ADD_ROUTE_ATTRS and dotted.endswith(
+                    f"app.{attr}"
+                ):
+                    method = _ADD_ROUTE_ATTRS[attr]
+                if (
+                    method
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    raw = node.args[0].value
+                    segs = _normalize(raw)
+                    if segs is not None:
+                        out.routes.append(
+                            _Endpoint(
+                                method, segs, raw, mod.path,
+                                node.lineno, node.col_offset,
+                                in_test=is_test,
+                            )
+                        )
+                    continue
+                # ---- helper-form clients ---------------------------
+                helper = _CLIENT_HELPERS.get(attr)
+                if helper and not is_test:
+                    for arg in node.args:
+                        tpl = _fstring_template(arg)
+                        if tpl and tpl.startswith("/"):
+                            segs = _normalize(tpl)
+                            if segs is not None:
+                                out.clients.append(
+                                    _Endpoint(
+                                        helper, segs, tpl, mod.path,
+                                        arg.lineno, arg.col_offset,
+                                    )
+                                )
+                            break
+                name_helper = _NAME_HELPERS.get(attr)
+                if name_helper and not is_test and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ) and arg.value and "/" not in arg.value:
+                        tpl = "/" + arg.value
+                        segs = _normalize(tpl)
+                        if segs is not None:
+                            out.clients.append(
+                                _Endpoint(
+                                    name_helper, segs, tpl, mod.path,
+                                    arg.lineno, arg.col_offset,
+                                )
+                            )
+            # ---- decorator routes ----------------------------------
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    ddot = ctx.dotted(dec.func) or ""
+                    dattr = ddot.rsplit(".", 1)[-1]
+                    if dattr in ("get", "post", "put", "delete") and (
+                        ddot.startswith("routes.")
+                        or ".routes." in f".{ddot}"
+                    ):
+                        if dec.args and isinstance(
+                            dec.args[0], ast.Constant
+                        ) and isinstance(dec.args[0].value, str):
+                            segs = _normalize(dec.args[0].value)
+                            if segs is not None:
+                                out.routes.append(
+                                    _Endpoint(
+                                        dattr.upper(), segs,
+                                        dec.args[0].value, mod.path,
+                                        dec.lineno, dec.col_offset,
+                                        in_test=is_test,
+                                    )
+                                )
+            # ---- URL-literal clients / test references -------------
+            tpl = None
+            if isinstance(node, ast.JoinedStr) or (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                # a statement-level string is a docstring/comment, not a
+                # request — URLs in prose make no contract claim
+                if isinstance(ctx.parent(node), ast.Expr):
+                    continue
+                tpl = _fstring_template(node)
+            if tpl is None:
+                continue
+            if is_test:
+                # any literal path in a test marks the route exercised
+                if tpl.startswith("/") and "\0" not in tpl:
+                    out.test_paths.add(tpl.split("?", 1)[0])
+                url = _url_path(tpl)
+                if url is not None and "\0" not in url:
+                    out.test_paths.add(url.split("?", 1)[0])
+                continue
+            url = _url_path(tpl)
+            if url is None:
+                continue
+            segs = _normalize(url)
+            if segs is None or all(s == _WILDCARD for s in segs):
+                continue  # fully dynamic: no static claim to check
+            method = _enclosing_call_method(ctx, node)
+            out.clients.append(
+                _Endpoint(
+                    method, segs, url.split("?", 1)[0], mod.path,
+                    node.lineno, node.col_offset,
+                )
+            )
+    index._http_contract = out  # type: ignore[attr-defined]
+    return out
+
+
+@register
+class HttpContractRule(ProjectRule):
+    id = "http-contract"
+    doc = (
+        "client request paths must match a registered server route (and "
+        "its method); routes nothing calls are dead surface"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        c = _extract(index)
+        if not c.routes:
+            return
+        for ep in c.clients:
+            matches = [
+                r for r in c.routes if _segments_match(ep.segments, r.segments)
+            ]
+            if not matches:
+                yield self.finding_at(
+                    ep.path, ep.line, ep.col,
+                    f"client requests {ep.raw!r} but no indexed server "
+                    "registers that route — typo'd path or renamed "
+                    "endpoint (runtime 404)",
+                )
+                continue
+            if ep.method != "ANY" and not any(
+                r.method == ep.method for r in matches
+            ):
+                have = ", ".join(
+                    sorted({f"{r.method} {r.raw}" for r in matches})
+                )
+                yield self.finding_at(
+                    ep.path, ep.line, ep.col,
+                    f"client sends {ep.method} {ep.raw!r} but the route "
+                    f"is registered as {have} (runtime 405)",
+                )
+        client_segs = [ep.segments for ep in c.clients]
+        test_segs = [
+            s for p in c.test_paths if (s := _normalize(p)) is not None
+        ]
+        for r in c.routes:
+            if r.in_test:
+                continue  # test-local servers gate themselves
+            called = any(
+                _segments_match(r.segments, s) for s in client_segs
+            ) or any(_segments_match(r.segments, s) for s in test_segs)
+            if not called:
+                yield self.finding_at(
+                    r.path, r.line, r.col,
+                    f"route {r.method} {r.raw!r} has no in-repo client "
+                    "or test caller — dead surface, a missing test, or "
+                    "an externally-scraped endpoint (suppress with "
+                    "justification if external)",
+                    severity=SEVERITY_WARNING,
+                )

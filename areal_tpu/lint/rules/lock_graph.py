@@ -1,0 +1,589 @@
+"""Whole-program lock analysis: acquisition-order deadlock detection and
+await/blocking-work under a ``threading`` lock.
+
+The async split gives this repo three lock planes that call into each
+other: the client's rollout plane (``_inflight_lock``/``_membership_lock``/
+``_push_lock``), the serving engine's weight plane (``_staging_lock``/
+``_publish_lock``), and the fleet controller's membership plane
+(``_op_lock``). A deadlock here needs two functions in two files each
+taking the same pair in opposite order — exactly what per-file linting
+cannot see. This pass:
+
+1. collects every lock object (``threading.Lock``/``RLock``,
+   ``asyncio.Lock``) bound to a module global or a ``self.<attr>``;
+2. walks each indexed function recording which locks are held (lexical
+   ``with``/``async with`` scopes) around which awaits, blocking calls
+   (the PR 2 blocking-call table), and call sites;
+3. propagates acquires/may-block summaries over the project call graph;
+4. flags (a) cycles in the global lock-acquisition-order graph, (b)
+   observed acquisitions that reverse a declared ``# lock_order:`` edge,
+   (c) re-acquisition of a non-reentrant lock reachable from a region
+   already holding it, and (d) ``await`` or blocking work under a
+   ``threading`` lock (direct = error; via a callee = warning).
+
+``# lock_order: A -> B [-> C]`` declares intended order. Lock names
+resolve by dotted suffix against the collected lock ids
+(``module.Class._attr`` / ``module.GLOBAL``): ``_push_lock`` alone is
+enough when unambiguous, ``RemoteInfEngine._push_lock`` when not. Unknown
+or ambiguous names are warnings so annotations cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from areal_tpu.lint.framework import (
+    SEVERITY_WARNING,
+    Finding,
+    ProjectRule,
+    register,
+)
+from areal_tpu.lint.project import FunctionInfo, ProjectIndex
+from areal_tpu.lint.rules.async_discipline import (
+    _BLOCKING_EXACT,
+    _BLOCKING_PREFIXES,
+)
+
+#: constructor -> (plane, reentrant)
+_LOCK_CTORS = {
+    "threading.Lock": ("threading", False),
+    "threading.RLock": ("threading", True),
+    "asyncio.Lock": ("asyncio", False),
+}
+
+
+@dataclasses.dataclass
+class LockDef:
+    lock_id: str  # module.Class._attr or module.GLOBAL
+    plane: str  # "threading" | "asyncio"
+    reentrant: bool
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    col: int
+    via: str  # human-readable provenance ("with nesting", "call to f", ...)
+    declared: bool = False
+
+
+@dataclasses.dataclass
+class _FuncFacts:
+    acquires: set = dataclasses.field(default_factory=set)
+    blocking: list = dataclasses.field(default_factory=list)  # (name, node)
+    #: (held lock ids tuple, callee qualname, call node)
+    callsites_held: list = dataclasses.field(default_factory=list)
+    #: direct findings raw material: (kind, lock_id, node, detail)
+    events: list = dataclasses.field(default_factory=list)
+
+
+class _Analysis:
+    def __init__(self):
+        self.locks: dict[str, LockDef] = {}
+        self.facts: dict[str, _FuncFacts] = {}
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self.acquires_trans: dict[str, set] = {}
+        self.blocks_trans: dict[str, str | None] = {}  # qualname -> why
+        self.annotation_problems: list[tuple[str, int, str]] = []
+        self.declared: list[tuple[list[str], str, int]] = []
+
+
+def _is_blocking(resolved: str | None) -> str | None:
+    if resolved is None:
+        return None
+    if resolved in _BLOCKING_EXACT:
+        return resolved
+    for prefix in _BLOCKING_PREFIXES:
+        if resolved.startswith(prefix):
+            return resolved
+    return None
+
+
+def _collect_locks(index: ProjectIndex, ana: _Analysis) -> None:
+    for mod in index.modules.values():
+        ctx = mod.ctx
+        for stmt in mod.ctx.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+            ):
+                ctor = ctx.resolved(stmt.value.func)
+                if ctor in _LOCK_CTORS:
+                    plane, reentrant = _LOCK_CTORS[ctor]
+                    lid = f"{mod.name}.{stmt.targets[0].id}"
+                    ana.locks[lid] = LockDef(
+                        lid, plane, reentrant, mod.path, stmt.lineno
+                    )
+        for cinfo in mod.classes.values():
+            for finfo in cinfo.methods.values():
+                for node in ast.walk(finfo.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    ctor = ctx.resolved(node.value.func)
+                    if ctor not in _LOCK_CTORS:
+                        continue
+                    plane, reentrant = _LOCK_CTORS[ctor]
+                    for tgt in node.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            lid = f"{cinfo.qualname}.{tgt.attr}"
+                            ana.locks[lid] = LockDef(
+                                lid, plane, reentrant, mod.path, node.lineno
+                            )
+
+
+def _lock_for_expr(
+    index: ProjectIndex, ana: _Analysis, finfo: FunctionInfo, expr: ast.AST
+) -> str | None:
+    mod = index.modules.get(finfo.module)
+    if mod is None:
+        return None
+    ctx = mod.ctx
+    dotted = ctx.dotted(expr)
+    if dotted is None:
+        return None
+    if dotted.startswith("self.") and finfo.cls is not None:
+        attr = dotted[len("self."):]
+        if "." in attr:
+            return None
+        for c in index.class_mro(finfo.cls):
+            lid = f"{c.qualname}.{attr}"
+            if lid in ana.locks:
+                return lid
+        return None
+    resolved = ctx.resolved(expr)
+    if resolved is not None:
+        owner, rem = index._split_module_prefix(resolved)
+        if owner is not None and rem and "." not in rem:
+            lid = f"{owner.name}.{rem}"
+            if lid in ana.locks:
+                return lid
+    if "." not in dotted:
+        lid = f"{finfo.module}.{dotted}"
+        if lid in ana.locks:
+            return lid
+    return None
+
+
+def _scan_function(
+    index: ProjectIndex, ana: _Analysis, finfo: FunctionInfo
+) -> _FuncFacts:
+    mod = index.modules[finfo.module]
+    ctx = mod.ctx
+    facts = _FuncFacts()
+
+    def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scope: runs later / elsewhere, not under held
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                lid = _lock_for_expr(index, ana, finfo, item.context_expr)
+                if lid is None:
+                    continue
+                facts.acquires.add(lid)
+                for h in new_held:
+                    if h == lid:
+                        if not ana.locks[lid].reentrant:
+                            facts.events.append(
+                                ("self-reacquire", lid, node, "")
+                            )
+                    else:
+                        edge = Edge(
+                            h, lid, ctx.path, node.lineno, node.col_offset,
+                            f"nested `with` in {finfo.qualname}",
+                        )
+                        ana.edges.setdefault((h, lid), edge)
+                new_held.append(lid)
+            for item in node.items:
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, tuple(new_held))
+            for child in node.body:
+                visit(child, tuple(new_held))
+            return
+        if isinstance(node, ast.Await):
+            t_held = [
+                h for h in held if ana.locks[h].plane == "threading"
+            ]
+            if t_held:
+                facts.events.append(("await", t_held[-1], node, ""))
+        if isinstance(node, ast.Call):
+            resolved = ctx.resolved(node.func)
+            blocking = _is_blocking(resolved)
+            if blocking is not None:
+                facts.blocking.append((blocking, node))
+                t_held = [
+                    h for h in held if ana.locks[h].plane == "threading"
+                ]
+                if t_held:
+                    facts.events.append(
+                        ("blocking", t_held[-1], node, blocking)
+                    )
+            # lock.acquire() outside a with-statement still orders locks
+            acq_lock = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                acq_lock = _lock_for_expr(
+                    index, ana, finfo, node.func.value
+                )
+            if acq_lock is not None:
+                facts.acquires.add(acq_lock)
+                for h in held:
+                    if h != acq_lock:
+                        ana.edges.setdefault(
+                            (h, acq_lock),
+                            Edge(
+                                h, acq_lock, ctx.path, node.lineno,
+                                node.col_offset,
+                                f"`.acquire()` in {finfo.qualname}",
+                            ),
+                        )
+            callee = index.resolve_call(finfo, node)
+            if callee is not None and held:
+                facts.callsites_held.append(
+                    (held, callee.qualname, node)
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(finfo.node):
+        visit(child, ())
+    return facts
+
+
+def _fixpoint(index: ProjectIndex, ana: _Analysis) -> None:
+    ana.acquires_trans = {
+        q: set(f.acquires) for q, f in ana.facts.items()
+    }
+    ana.blocks_trans = {
+        q: (f.blocking[0][0] if f.blocking else None)
+        for q, f in ana.facts.items()
+    }
+    changed = True
+    iters = 0
+    while changed and iters < 50:
+        changed = False
+        iters += 1
+        for q in sorted(index.call_graph):
+            callees = index.call_graph[q]
+            if q not in ana.acquires_trans:
+                continue
+            acq = ana.acquires_trans[q]
+            blk = ana.blocks_trans[q]
+            for c in sorted(callees):
+                c_acq = ana.acquires_trans.get(c)
+                if c_acq and not c_acq <= acq:
+                    acq |= c_acq
+                    changed = True
+                if blk is None:
+                    c_blk = ana.blocks_trans.get(c)
+                    if c_blk is not None:
+                        ana.blocks_trans[q] = f"{c_blk} via {c}"
+                        blk = ana.blocks_trans[q]
+                        changed = True
+
+
+def _parse_lock_name(
+    ana: _Analysis, name: str
+) -> tuple[str | None, str | None]:
+    """Suffix-resolve an annotation lock name -> (lock_id, problem)."""
+    name = name.strip()
+    if not name:
+        return None, "empty lock name"
+    matches = [
+        lid
+        for lid in ana.locks
+        if lid == name or lid.endswith("." + name)
+    ]
+    if not matches:
+        return None, f"unknown lock {name!r} (no such lock indexed)"
+    if len(matches) > 1:
+        return None, (
+            f"ambiguous lock {name!r}: matches {', '.join(sorted(matches))}"
+        )
+    return matches[0], None
+
+
+def _collect_declared(index: ProjectIndex, ana: _Analysis) -> None:
+    for mod in index.modules.values():
+        for line, spec in mod.ctx.lock_orders:
+            sep = "->" if "->" in spec else "<"
+            names = [n for n in spec.split(sep) if n.strip()]
+            if len(names) < 2:
+                ana.annotation_problems.append(
+                    (
+                        mod.path,
+                        line,
+                        f"lock_order annotation needs >= 2 locks: {spec!r}",
+                    )
+                )
+                continue
+            resolved: list[str] = []
+            ok = True
+            for raw in names:
+                lid, problem = _parse_lock_name(ana, raw)
+                if problem is not None:
+                    ana.annotation_problems.append((mod.path, line, problem))
+                    ok = False
+                    break
+                resolved.append(lid)
+            if ok:
+                ana.declared.append((resolved, mod.path, line))
+                for a, b in zip(resolved, resolved[1:]):
+                    key = (a, b)
+                    if key not in ana.edges:
+                        ana.edges[key] = Edge(
+                            a, b, mod.path, line, 0,
+                            "declared by lock_order annotation",
+                            declared=True,
+                        )
+
+
+def _declared_closure(ana: _Analysis) -> set[tuple[str, str]]:
+    adj: dict[str, set[str]] = {}
+    for chain, _, _ in ana.declared:
+        for a, b in zip(chain, chain[1:]):
+            adj.setdefault(a, set()).add(b)
+    closure: set[tuple[str, str]] = set()
+    for start in adj:
+        stack = list(adj[start])
+        seen: set[str] = set()
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            closure.add((start, n))
+            stack.extend(adj.get(n, ()))
+    return closure
+
+
+def _sccs(edges: dict[tuple[str, str], Edge]) -> list[list[str]]:
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index_counter = [0]
+    stack: list[str] = []
+    low: dict[str, int] = {}
+    idx: dict[str, int] = {}
+    on_stack: set[str] = set()
+    out: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(adj[v]))]
+        idx[v] = low[v] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in idx:
+            strongconnect(v)
+    return out
+
+
+def _get_analysis(index: ProjectIndex) -> _Analysis:
+    cached = getattr(index, "_lock_graph_analysis", None)
+    if cached is not None:
+        return cached
+    ana = _Analysis()
+    _collect_locks(index, ana)
+    if ana.locks:
+        for q, finfo in index.functions.items():
+            ana.facts[q] = _scan_function(index, ana, finfo)
+        _fixpoint(index, ana)
+        _collect_declared(index, ana)
+        # call-graph-propagated edges: a region holding H calling a
+        # function that (transitively) acquires K orders H before K
+        for q, facts in ana.facts.items():
+            mod_path = index.functions[q].path
+            for held, callee, node in facts.callsites_held:
+                for k in ana.acquires_trans.get(callee, ()):
+                    for h in held:
+                        if h == k:
+                            continue
+                        ana.edges.setdefault(
+                            (h, k),
+                            Edge(
+                                h, k, mod_path, node.lineno,
+                                node.col_offset,
+                                f"{q} calls {callee} (acquires "
+                                f"{k.rsplit('.', 1)[-1]}) while holding "
+                                f"{h.rsplit('.', 1)[-1]}",
+                            ),
+                        )
+    index._lock_graph_analysis = ana  # type: ignore[attr-defined]
+    return ana
+
+
+@register
+class LockOrderRule(ProjectRule):
+    id = "lock-order"
+    doc = (
+        "whole-program lock-acquisition-order analysis: cycles in the "
+        "order graph, reversals of a declared `# lock_order:` edge, and "
+        "reachable re-acquisition of a non-reentrant lock"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        ana = _get_analysis(index)
+        for path, line, problem in ana.annotation_problems:
+            yield self.finding_at(
+                path, line, 0,
+                f"lock_order annotation problem: {problem}",
+                severity=SEVERITY_WARNING,
+            )
+        # declared-order reversals
+        closure = _declared_closure(ana)
+        for (a, b), edge in sorted(ana.edges.items()):
+            if edge.declared:
+                continue
+            if (b, a) in closure:
+                yield self.finding_at(
+                    edge.path, edge.line, edge.col,
+                    f"lock acquisition {a.rsplit('.', 1)[-1]} -> "
+                    f"{b.rsplit('.', 1)[-1]} reverses the declared "
+                    f"lock_order ({b} before {a}); via: {edge.via}",
+                )
+        # cycles (over observed + declared edges)
+        for comp in _sccs(ana.edges):
+            comp_set = set(comp)
+            sites = sorted(
+                f"{e.path}:{e.line} ({e.src.rsplit('.', 1)[-1]} -> "
+                f"{e.dst.rsplit('.', 1)[-1]}: {e.via})"
+                for (a, b), e in ana.edges.items()
+                if a in comp_set and b in comp_set
+            )
+            anchor = min(
+                (
+                    e
+                    for (a, b), e in ana.edges.items()
+                    if a in comp_set and b in comp_set
+                ),
+                key=lambda e: (e.path, e.line),
+            )
+            yield self.finding_at(
+                anchor.path, anchor.line, anchor.col,
+                "lock-order cycle (potential deadlock) among "
+                f"{{{', '.join(comp)}}}; acquisition sites: "
+                + "; ".join(sites),
+            )
+        # reachable re-acquisition of a non-reentrant lock
+        for q in sorted(ana.facts):
+            facts = ana.facts[q]
+            for kind, lid, node, detail in facts.events:
+                if kind == "self-reacquire":
+                    yield self.finding_at(
+                        index.functions[q].path, node.lineno,
+                        node.col_offset,
+                        f"re-acquisition of non-reentrant lock {lid} "
+                        f"inside a region already holding it in {q} "
+                        "(guaranteed deadlock)",
+                    )
+            for held, callee, node in facts.callsites_held:
+                for h in held:
+                    ldef = ana.locks[h]
+                    if ldef.reentrant or ldef.plane != "threading":
+                        continue
+                    if h in ana.acquires_trans.get(callee, ()):
+                        yield self.finding_at(
+                            index.functions[q].path, node.lineno,
+                            node.col_offset,
+                            f"{q} holds non-reentrant {h} while calling "
+                            f"{callee}, which (transitively) re-acquires "
+                            "it — guaranteed deadlock on this path",
+                        )
+
+
+@register
+class AwaitUnderLockRule(ProjectRule):
+    id = "await-under-lock"
+    doc = (
+        "an `await` or blocking call (PR 2 table) executes while a "
+        "`threading` lock is held — stalls every thread contending for it"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        ana = _get_analysis(index)
+        for q in sorted(ana.facts):
+            facts = ana.facts[q]
+            path = index.functions[q].path
+            for kind, lid, node, detail in facts.events:
+                if kind == "await":
+                    yield self.finding_at(
+                        path, node.lineno, node.col_offset,
+                        f"`await` while holding threading lock {lid} in "
+                        f"{q}: the lock pins the event-loop thread's "
+                        "peers for the whole suspension — release before "
+                        "awaiting or use asyncio.Lock",
+                    )
+                elif kind == "blocking":
+                    yield self.finding_at(
+                        path, node.lineno, node.col_offset,
+                        f"{detail} blocks while holding threading lock "
+                        f"{lid} in {q}; shrink the critical section",
+                    )
+            for held, callee, node in facts.callsites_held:
+                t_held = [
+                    h for h in held
+                    if ana.locks[h].plane == "threading"
+                ]
+                if not t_held:
+                    continue
+                why = ana.blocks_trans.get(callee)
+                if why is not None:
+                    yield self.finding_at(
+                        path, node.lineno, node.col_offset,
+                        f"{q} calls {callee} while holding "
+                        f"{t_held[-1]}, and that call may block "
+                        f"({why}); shrink the critical section",
+                        severity=SEVERITY_WARNING,
+                    )

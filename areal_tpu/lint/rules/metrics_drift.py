@@ -1,0 +1,253 @@
+"""Metrics-name drift: the code's instrument set and the observability
+catalog must describe the same system.
+
+PR 8's claim is that ``/metrics`` agrees with the documented catalog by
+construction. That held exactly as long as humans remembered to edit
+``docs/observability.md`` — PRs 12-15 each added instruments. This pass
+makes the agreement a repo-wide invariant:
+
+- collect every instrument name passed to the metrics registry
+  (``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` /
+  ``.get_or_create(...)`` on a registry-shaped receiver): string
+  constants directly, module-level string constants through the
+  cross-file index, and f-string names as leading-literal prefixes
+  (``f"areal_rl_{key}"`` -> ``areal_rl_*``);
+- parse the catalogs in ``docs/observability.md`` (every backticked
+  ``areal_*`` token): ``{a,b,c}`` alternation expands, ``{label=...}`` /
+  ``{label}`` blocks strip, and a trailing ``*`` declares a documented
+  dynamic family;
+- an instrument the catalog doesn't cover flags at its creation site; a
+  catalog name no code creates flags at its line in the markdown (both
+  errors — drift is drift in either direction).
+
+Markdown lines support the suppression form
+``<!-- arealint: disable=metrics-drift -->`` for intentionally-historical
+mentions. If the indexed project has no ``docs/observability.md`` the
+pass is silent (single-file lints and foreign trees make no catalog
+claim).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from areal_tpu.lint.framework import Finding, ProjectRule, register
+from areal_tpu.lint.project import ProjectIndex
+
+CATALOG_RELPATH = os.path.join("docs", "observability.md")
+
+_CREATE_ATTRS = {"counter", "gauge", "histogram", "get_or_create"}
+
+#: receiver shapes that denote the metrics registry (precision over
+#: generality: `reg.counter(...)`, `registry.histogram(...)`,
+#: `_metrics.DEFAULT_REGISTRY.gauge(...)`, `self._registry.counter(...)`)
+def _is_registry_receiver(dotted: str | None) -> bool:
+    if not dotted:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    return (
+        last in ("reg", "registry")
+        or last.endswith("_registry")
+        or "REGISTRY" in last
+    )
+
+
+_TOKEN_RE = re.compile(r"`([^`]*\bareal_[A-Za-z0-9_{},=|*./ -]*)`")
+_NAME_RE = re.compile(r"areal_[A-Za-z0-9_{},=|*]*")
+
+
+class _Token:
+    """One cataloged metric mention: a set of candidate readings.
+
+    The docs use ``{a,b}`` both as name alternation
+    (``areal_train_{goodput,mfu}``) and as label lists
+    (``areal_server_latency_seconds{addr,quantile}``) — statically
+    indistinguishable, so a brace block without ``=`` expands BOTH ways
+    and the token is satisfied if *any* reading matches code. That slack
+    only ever accepts; it cannot flag a documented-and-live metric.
+    """
+
+    __slots__ = ("raw", "line", "exact", "prefixes")
+
+    def __init__(self, raw: str, line: int):
+        self.raw = raw
+        self.line = line
+        self.exact: set[str] = set()
+        self.prefixes: set[str] = set()
+
+
+def _candidate_names(token: str) -> set[str]:
+    """areal_* names in one backticked token, skipping module/file paths
+    (``areal_tpu/utils/metrics.py``, ``areal_tpu.lint``)."""
+    names: set[str] = set()
+    for m in _NAME_RE.finditer(token):
+        nxt = token[m.end() : m.end() + 1]
+        # a bare name running into . / - is a module or file path; a name
+        # with a brace block is a metric whatever follows ({k=...} stops
+        # the match at "...")
+        if "{" not in m.group(0) and nxt in (".", "/", "-"):
+            continue
+        if m.group(0) in ("areal_tpu", "areal_"):
+            continue
+        names.add(m.group(0))
+    return names
+
+
+def _expand_into(tok: _Token) -> None:
+    work = list(_candidate_names(tok.raw))
+    while work:
+        name = work.pop()
+        brace = name.find("{")
+        if brace >= 0:
+            close = name.find("}", brace)
+            if close < 0:
+                name = name[:brace]  # dangling block: label reading only
+            else:
+                inner = name[brace + 1 : close]
+                rest = name[close + 1 :]
+                if "," in inner and "=" not in inner:
+                    for alt in inner.split(","):
+                        work.append(name[:brace] + alt.strip() + rest)
+                # label-list reading: strip the block entirely
+                work.append(name[:brace] + rest)
+                continue
+        if not name or name == "areal_":
+            continue
+        if name.endswith("*"):
+            tok.prefixes.add(name[:-1].rstrip("_") + "_")
+        else:
+            tok.exact.add(name)
+
+
+def _parse_catalog(path: str) -> tuple[list[_Token], set[int]]:
+    """-> (tokens in document order, first line per raw token; lines
+    carrying an ``arealint: disable=`` suppression)."""
+    tokens: dict[str, _Token] = {}
+    suppressed: set[int] = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            if "arealint:" in line and "disable=" in line:
+                suppressed.add(lineno)
+            for m in _TOKEN_RE.finditer(line):
+                raw = m.group(1)
+                if raw in tokens:
+                    continue
+                tok = _Token(raw, lineno)
+                _expand_into(tok)
+                if tok.exact or tok.prefixes:
+                    tokens[raw] = tok
+    return list(tokens.values()), suppressed
+
+
+def _code_instruments(
+    index: ProjectIndex,
+) -> tuple[list[tuple[str, str, int, int]], list[tuple[str, str, int, int]]]:
+    """-> (exact [(name, path, line, col)], prefix [(prefix, ...)])."""
+    exact: list[tuple[str, str, int, int]] = []
+    prefix: list[tuple[str, str, int, int]] = []
+    for mod in index.modules.values():
+        if index.is_test_path(mod.path):
+            continue  # test fixtures name throwaway instruments freely
+        ctx = mod.ctx
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _CREATE_ATTRS:
+                continue
+            if not _is_registry_receiver(ctx.dotted(func.value)):
+                continue
+            arg = node.args[0]
+            site = (mod.path, node.lineno, node.col_offset)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                exact.append((arg.value, *site))
+            elif isinstance(arg, ast.Name):
+                value = index.resolve_str_constant(mod, arg.id)
+                if value is not None:
+                    exact.append((value, *site))
+            elif isinstance(arg, ast.JoinedStr):
+                head = arg.values[0] if arg.values else None
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ) and head.value:
+                    prefix.append((head.value, *site))
+    return exact, prefix
+
+
+@register
+class MetricsDriftRule(ProjectRule):
+    id = "metrics-drift"
+    doc = (
+        "every registry instrument must appear in the "
+        "docs/observability.md catalogs, and every cataloged name must "
+        "still exist in code"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        catalog_path = os.path.join(index.root, CATALOG_RELPATH)
+        if not os.path.isfile(catalog_path):
+            return
+        tokens, suppressed = _parse_catalog(catalog_path)
+        code_exact, code_prefix = _code_instruments(index)
+        if not code_exact and not code_prefix:
+            return  # no instruments in the indexed subset: no claim
+
+        doc_exact = {n for t in tokens for n in t.exact}
+        doc_prefix = {p for t in tokens for p in t.prefixes}
+
+        def documented(name: str) -> bool:
+            return name in doc_exact or any(
+                name.startswith(p) for p in doc_prefix
+            )
+
+        rel_catalog = os.path.relpath(
+            catalog_path, os.getcwd()
+        ).replace(os.sep, "/")
+        if rel_catalog.startswith(".."):
+            rel_catalog = catalog_path.replace(os.sep, "/")
+        for name, path, line, col in code_exact:
+            if not documented(name):
+                yield self.finding_at(
+                    path, line, col,
+                    f"instrument {name!r} is not in the "
+                    f"{CATALOG_RELPATH} catalogs — document it (or its "
+                    "family wildcard) so /metrics stays self-describing",
+                )
+        for pfx, path, line, col in code_prefix:
+            covered = any(
+                pfx.startswith(p) or p.startswith(pfx) for p in doc_prefix
+            ) or any(n.startswith(pfx) for n in doc_exact)
+            if not covered:
+                yield self.finding_at(
+                    path, line, col,
+                    f"dynamic instrument family {pfx + '*'!r} is not in "
+                    f"the {CATALOG_RELPATH} catalogs — document the "
+                    "family wildcard",
+                )
+        code_names = {n for n, *_ in code_exact}
+        code_pfx = {p for p, *_ in code_prefix}
+        for tok in tokens:
+            if tok.line in suppressed:
+                continue
+            alive = any(
+                n in code_names
+                or any(n.startswith(p) for p in code_pfx)
+                for n in tok.exact
+            ) or any(
+                any(n.startswith(pfx) for n in code_names)
+                or any(p.startswith(pfx) or pfx.startswith(p)
+                       for p in code_pfx)
+                for pfx in tok.prefixes
+            )
+            if not alive:
+                yield self.finding_at(
+                    rel_catalog, tok.line, 0,
+                    f"catalog documents {tok.raw!r} but no indexed code "
+                    "creates it — stale docs or a silently-dropped "
+                    "instrument",
+                )

@@ -62,7 +62,10 @@ def _load() -> ctypes.CDLL | None:
         if _tried:
             return _lib
         _tried = True
-        so = _build()
+        # once-per-process double-checked init: the lock's whole job is to
+        # make concurrent first callers wait for the single cc invocation
+        # instead of racing their own builds
+        so = _build()  # arealint: disable=await-under-lock
         if so is None:
             return None
         try:

@@ -85,7 +85,15 @@ def main(argv=None):
         dataset_size=len(train_rows),
         train_batch_size=cfg.train_dataset.batch_size,
     )
-    total_steps = cfg.total_train_steps or ft_spec.total_train_steps
+    # budget precedence: explicit steps > sequence budget > epoch-derived
+    if cfg.total_train_steps:
+        total_steps = cfg.total_train_steps
+    elif cfg.total_train_n_seqs:
+        total_steps = max(
+            1, cfg.total_train_n_seqs // cfg.train_dataset.batch_size
+        )
+    else:
+        total_steps = ft_spec.total_train_steps
 
     # rollout client (generation servers were started by the launcher)
     rollout = RemoteInfEngine(cfg.rollout)

@@ -28,5 +28,11 @@ PY
 # needed): the bench gate's own contract must hold before it gates anyone
 bash "$(dirname "$0")/bench_check.sh" --self-test
 
-exec python -m areal_tpu.lint areal_tpu tests \
+# examples/ is part of the indexed program on purpose: the cross-file
+# passes (dead-config-knob in particular) count reads there, and the
+# training entrypoints ARE the consumers of much of the config surface.
+# --self-test smoke-checks the whole-program index first so a wedged
+# import-resolution bug fails loudly instead of silently analyzing nothing.
+exec python -m areal_tpu.lint areal_tpu tests examples \
+  --self-test \
   --baseline .arealint-baseline.json "$@"

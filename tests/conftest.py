@@ -49,6 +49,12 @@ if os.environ.get("AREAL_TPU_TEST_CACHE"):
 
 import pytest  # noqa: E402
 
+# lint fixtures are DATA, not tests: the xproj_* mini-projects contain
+# deliberately-broken modules (lock cycles, circular imports) and files
+# named test_*.py that exist only so the http-contract pass sees a test
+# caller — pytest must never import them
+collect_ignore_glob = ["lint_fixtures/*"]
+
 # Suite budget (reference test strategy, SURVEY §4): the default selection
 # should stay fast enough that people actually run it. Long-running tests
 # (multi-process, e2e launchers, heavy numerics) carry @pytest.mark.slow —

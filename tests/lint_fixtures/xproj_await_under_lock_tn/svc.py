@@ -1,0 +1,33 @@
+"""Clean lock discipline around awaits: the critical section only
+mutates state; awaits and blocking work happen after release, and an
+asyncio.Lock may be held across await by design."""
+
+import asyncio
+import threading
+import time
+
+LOCK = threading.Lock()
+ALOCK = asyncio.Lock()
+
+_state = {"n": 0}
+
+
+async def await_after_release():
+    with LOCK:
+        _state["n"] += 1
+    await asyncio.sleep(0)
+
+
+async def asyncio_lock_is_fine():
+    async with ALOCK:
+        await asyncio.sleep(0)
+
+
+def helper_blocks():
+    time.sleep(0)
+
+
+def blocking_outside_lock():
+    with LOCK:
+        _state["n"] += 1
+    helper_blocks()

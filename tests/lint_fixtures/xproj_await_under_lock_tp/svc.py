@@ -1,0 +1,28 @@
+"""await / blocking work under a held ``threading`` lock: direct await
+(error), direct blocking call (error), and a blocking callee reached
+through the call graph (warning)."""
+
+import asyncio
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+async def bad_await():
+    with LOCK:
+        await asyncio.sleep(0)  # lint-expect: await-under-lock
+
+
+def bad_blocking():
+    with LOCK:
+        time.sleep(1)  # lint-expect: await-under-lock
+
+
+def helper_blocks():
+    time.sleep(1)
+
+
+def bad_transitive():
+    with LOCK:
+        helper_blocks()  # lint-expect: await-under-lock

@@ -1,0 +1,16 @@
+"""Every reachable field is read somewhere: plain attribute loads, a
+``getattr`` with a constant name, and a read through an import alias."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TuningConfig:
+    alpha: float = 0.5
+    beta: float = 0.1
+
+
+@dataclass
+class BaseExperimentConfig:
+    seed: int = 0
+    tuning: TuningConfig = field(default_factory=TuningConfig)

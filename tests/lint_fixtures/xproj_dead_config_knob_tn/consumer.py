@@ -1,0 +1,11 @@
+"""Consumes the whole surface, including through an import alias and a
+constant-name getattr — both count as reads."""
+
+import cfg as config_mod
+
+
+def run(cfg):
+    base = config_mod.BaseExperimentConfig()
+    del base
+    total = cfg.seed + cfg.tuning.alpha
+    return total + getattr(cfg.tuning, "beta")

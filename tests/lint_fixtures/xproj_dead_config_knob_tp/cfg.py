@@ -1,0 +1,19 @@
+"""Config surface with one dead knob and one stale allowlist entry.
+``TuningConfig.off_ast`` is allowlisted (consumed off-AST, by stipulation)
+so it must NOT flag; ``.arealint-knobs.json`` also names a ``ghost`` field
+that no longer exists, which flags as stale at the owning class."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TuningConfig:  # lint-expect: dead-config-knob
+    alpha: float = 0.5
+    dead_knob: int = 3  # lint-expect: dead-config-knob
+    off_ast: int = 0
+
+
+@dataclass
+class BaseExperimentConfig:
+    seed: int = 0
+    tuning: TuningConfig = field(default_factory=TuningConfig)

@@ -1,0 +1,6 @@
+"""Reads every field except ``dead_knob`` (and the allowlisted
+``off_ast``)."""
+
+
+def run(cfg):
+    return cfg.seed + cfg.tuning.alpha

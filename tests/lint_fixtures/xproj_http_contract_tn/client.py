@@ -1,0 +1,10 @@
+"""Well-matched clients: URL-literal POST and a path-helper GET."""
+
+
+class Client:
+    async def _get(self, addr, path, **kw):
+        raise NotImplementedError
+
+    async def call(self, session, addr):
+        await session.post(f"http://{addr}/run", json={})
+        return await self._get(addr, "/status")

@@ -1,0 +1,16 @@
+"""Every route has a caller: /run via a client f-string URL, /status via
+the repo-idiom _get helper, /ping only via a test's literal path."""
+
+from aiohttp import web
+
+
+async def handle(request):
+    return web.json_response({})
+
+
+def build_app():
+    app = web.Application()
+    app.router.add_post("/run", handle)
+    app.router.add_get("/status", handle)
+    app.router.add_get("/ping", handle)
+    return app

@@ -1,0 +1,8 @@
+"""Clients: a good call, a method mismatch (POST against a GET route —
+runtime 405), and a typo'd path no server registers (runtime 404)."""
+
+
+async def call(session, addr):
+    await session.post(f"http://{addr}/run", json={})
+    await session.post(f"http://{addr}/status")  # lint-expect: http-contract
+    await session.post(f"http://{addr}/rnu", json={})  # lint-expect: http-contract

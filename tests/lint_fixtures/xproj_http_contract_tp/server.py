@@ -1,0 +1,24 @@
+"""Routes: one correctly consumed, one consumed with the wrong method,
+one orphaned (no client or test caller anywhere in the project)."""
+
+from aiohttp import web
+
+
+async def handle_run(request):
+    return web.json_response({})
+
+
+async def handle_status(request):
+    return web.json_response({})
+
+
+async def handle_orphan(request):
+    return web.json_response({})
+
+
+def build_app():
+    app = web.Application()
+    app.router.add_post("/run", handle_run)
+    app.router.add_get("/status", handle_status)
+    app.router.add_post("/orphan", handle_orphan)  # lint-expect: http-contract
+    return app

@@ -1,0 +1,16 @@
+"""Consistent cross-module lock ordering: both modules take A strictly
+before B, matching the declared order — no cycle, no reversal."""
+
+import threading
+
+import mod_b
+
+A = threading.Lock()
+
+# lock_order: A -> B
+
+
+def a_then_b():
+    with A:
+        with mod_b.B:
+            pass

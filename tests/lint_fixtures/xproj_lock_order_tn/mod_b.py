@@ -1,0 +1,20 @@
+"""Same order as mod_a (A before B, here via the call graph): holding
+nothing, calls a helper that nests in the declared direction. An RLock
+re-entry through a nested fenced path is fine — reentrant by design."""
+
+import threading
+
+import mod_a
+
+B = threading.Lock()
+R = threading.RLock()
+
+
+def also_a_then_b():
+    mod_a.a_then_b()
+
+
+def reentrant_ok():
+    with R:
+        with R:
+            pass

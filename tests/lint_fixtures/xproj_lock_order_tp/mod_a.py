@@ -1,0 +1,21 @@
+"""Half of a two-module lock-order cycle: this module orders A before B
+(directly, by `with` nesting); mod_b orders B before A (through the call
+graph). Neither file is wrong in isolation — only the whole-program pass
+can see the deadlock."""
+
+import threading
+
+import mod_b
+
+A = threading.Lock()
+
+
+def take_a():
+    with A:
+        pass
+
+
+def a_then_b():
+    with A:
+        with mod_b.B:  # lint-expect: lock-order
+            pass

@@ -1,0 +1,14 @@
+"""The other half of the cycle: holds B and calls into mod_a.take_a(),
+which acquires A — a call-graph-propagated B -> A edge, opposite to
+mod_a's direct A -> B nesting."""
+
+import threading
+
+import mod_a
+
+B = threading.Lock()
+
+
+def b_then_a():
+    with B:
+        mod_a.take_a()

@@ -1,0 +1,19 @@
+"""Declared-order reversal: the annotation at the bottom declares C
+before D; the function acquires D then C. (The reversal also closes a
+declared+observed 2-cycle, so the pass reports both at the observed
+acquisition site.)"""
+
+import threading
+
+C = threading.Lock()
+D = threading.Lock()
+
+
+def d_then_c():
+    with D:
+        with C:  # lint-expect: lock-order
+            pass
+
+
+# declared after the code so the observed edge anchors the findings
+# lock_order: C -> D

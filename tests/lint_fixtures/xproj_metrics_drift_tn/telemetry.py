@@ -1,0 +1,7 @@
+"""Code and catalog agree exactly: every instrument documented, every
+documented name alive."""
+
+
+def setup(registry, key):
+    registry.counter("areal_fix_requests_total")
+    registry.histogram(f"areal_fix_dyn_{key}_seconds")

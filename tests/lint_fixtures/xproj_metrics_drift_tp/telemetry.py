@@ -1,0 +1,14 @@
+"""Instruments vs the fixture catalog: documented exact name, documented
+via a module constant, documented dynamic family — plus one undocumented
+instrument and one undocumented dynamic family (both flag here), while
+the catalog's stale row flags over in docs/observability.md."""
+
+_BYCONST = "areal_fix_byconst_total"
+
+
+def setup(registry, key):
+    registry.counter("areal_fix_requests_total")
+    registry.counter(_BYCONST)
+    registry.histogram(f"areal_fix_dyn_{key}_seconds")
+    registry.gauge("areal_fix_undocumented")  # lint-expect: metrics-drift
+    registry.histogram(f"areal_fix_undoc_{key}")  # lint-expect: metrics-drift

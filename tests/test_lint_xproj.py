@@ -1,0 +1,263 @@
+"""arealint whole-program passes, tier-1: the xproj_* fixture
+mini-projects pin each cross-file rule's true-positive and true-negative
+behavior via ``lint-expect`` tags; plus index mechanics (--self-test,
+--changed-only, the sources-override what-if API), the seeded
+``# lock_order:`` annotations on real modules, and the
+``MetricsConfig.max_label_values`` revert regression the dead-config-knob
+pass exists to prevent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+import areal_tpu.lint.rules  # noqa: F401 — populate the registries
+from areal_tpu.lint import framework, project
+from areal_tpu.lint.framework import all_project_rules, run_project_rules
+from areal_tpu.lint.rules import config_knobs, lock_graph
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+
+# python comment form and the markdown form (`<!-- lint-expect: ... -->`);
+# rule ids only, so the markdown `-->` terminator is never swallowed
+_EXPECT_RE = re.compile(
+    r"(?:#|<!--)\s*lint-expect:\s*([a-z0-9_-]+(?:\s*,\s*[a-z0-9_-]+)*)"
+)
+
+
+def _xproj_dirs() -> list[str]:
+    return sorted(
+        os.path.join(FIXTURE_DIR, d)
+        for d in os.listdir(FIXTURE_DIR)
+        if d.startswith("xproj_")
+        and os.path.isdir(os.path.join(FIXTURE_DIR, d))
+    )
+
+
+def _expected(projdir: str) -> set[tuple[str, str, int]]:
+    out: set[tuple[str, str, int]] = set()
+    for root, _dirs, files in os.walk(projdir):
+        for fname in sorted(files):
+            if not fname.endswith((".py", ".md")):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, projdir).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, start=1):
+                    m = _EXPECT_RE.search(line)
+                    if m:
+                        for rule in m.group(1).split(","):
+                            rule = rule.strip()
+                            if rule:
+                                out.add((rule, rel, lineno))
+    return out
+
+
+def _actual(projdir: str) -> set[tuple[str, str, int]]:
+    index = project.ProjectIndex.build([projdir])
+    assert not index.parse_findings, index.parse_findings
+    out: set[tuple[str, str, int]] = set()
+    for f in run_project_rules(index):
+        rel = os.path.relpath(
+            os.path.abspath(f.path), os.path.abspath(projdir)
+        ).replace(os.sep, "/")
+        out.add((f.rule, rel, f.line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fixture mini-projects
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "projdir", _xproj_dirs(), ids=lambda p: os.path.basename(p)
+)
+def test_xproj_fixture_matches_expectations(projdir):
+    """Cross-file findings in a mini-project == its lint-expect tags,
+    exactly: every true positive fires and nothing else does."""
+    expected = _expected(projdir)
+    if projdir.endswith("_tp"):
+        assert expected, f"TP project {projdir} declares no expectations"
+    if projdir.endswith("_tn"):
+        assert not expected, f"TN project {projdir} should have no tags"
+    actual = _actual(projdir)
+    assert actual == expected, (
+        f"{os.path.basename(projdir)}: findings {sorted(actual)} != "
+        f"expected {sorted(expected)}"
+    )
+
+
+def test_every_project_rule_has_tp_and_tn_project():
+    names = {os.path.basename(p) for p in _xproj_dirs()}
+    for rule_id in all_project_rules():
+        snake = rule_id.replace("-", "_")
+        assert f"xproj_{snake}_tp" in names, f"no TP project for {rule_id}"
+        assert f"xproj_{snake}_tn" in names, f"no TN project for {rule_id}"
+
+
+def test_project_rule_registry_is_disjoint_and_documented():
+    file_rules = framework.all_rules()
+    proj_rules = all_project_rules()
+    assert not set(file_rules) & set(proj_rules)
+    for rule in proj_rules.values():
+        assert rule.doc, f"project rule {rule.id} has no doc line"
+
+
+# ---------------------------------------------------------------------------
+# seeded annotations on real modules
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_annotations_present_and_resolve():
+    """The four seeded ``# lock_order:`` declarations must stay present
+    AND keep resolving against real locks — an unresolvable annotation
+    would demote the deadlock check to a warning about itself."""
+    for rel in [
+        "areal_tpu/core/remote_inf_engine.py",
+        "areal_tpu/inference/engine.py",
+        "areal_tpu/core/workflow_executor.py",
+        "areal_tpu/fleet/controller.py",
+    ]:
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            assert "# lock_order:" in f.read(), (
+                f"{rel} lost its lock_order declaration"
+            )
+    index = project.ProjectIndex.build([os.path.join(REPO_ROOT, "areal_tpu")])
+    ana = lock_graph._get_analysis(index)
+    assert ana.annotation_problems == []
+    declared_paths = {path for _chain, path, _line in ana.declared}
+    assert len(declared_paths) >= 4
+    # the cross-plane chain: fleet op lock strictly outside the client's
+    # membership fence
+    chains = {" -> ".join(c) for c, _p, _l in ana.declared}
+    assert any(
+        "FleetController._op_lock" in c and "_membership_lock" in c
+        for c in chains
+    )
+
+
+# ---------------------------------------------------------------------------
+# the PR 8 regression, replayed through the what-if API
+# ---------------------------------------------------------------------------
+
+
+def _norm(path: str) -> str:
+    return os.path.normpath(path).replace(os.sep, "/")
+
+
+def test_max_label_values_revert_regression():
+    """Deleting the stats_logger wiring for MetricsConfig.max_label_values
+    (the original PR 8 bug: field shipped, registry kept its own cap) must
+    re-flag the knob. The registry's same-named public attribute is
+    renamed in the override too — attribute-name matching would otherwise
+    mask the dead knob behind it, which is exactly how the bug hid."""
+    sl = os.path.join(REPO_ROOT, "areal_tpu", "utils", "stats_logger.py")
+    mt = os.path.join(REPO_ROOT, "areal_tpu", "utils", "metrics.py")
+    with open(sl, encoding="utf-8") as f:
+        sl_src = f.read()
+    with open(mt, encoding="utf-8") as f:
+        mt_src = f.read()
+    assert "mcfg.max_label_values" in sl_src, (
+        "stats_logger no longer wires MetricsConfig.max_label_values — "
+        "if the wiring moved, update this test; if it was deleted, the "
+        "knob is dead again (the PR 8 bug)"
+    )
+    sources = {
+        _norm(sl): sl_src.replace("mcfg.max_label_values", "128"),
+        _norm(mt): mt_src.replace("max_label_values", "label_cap"),
+    }
+    paths = [
+        os.path.join(REPO_ROOT, "areal_tpu"),
+        os.path.join(REPO_ROOT, "examples"),
+    ]
+    index = project.ProjectIndex.build(paths, sources=sources)
+    findings = list(config_knobs.DeadConfigKnobRule().check_project(index))
+    assert any(
+        "MetricsConfig.max_label_values" in f.message for f in findings
+    ), f"revert not caught; got {[f.message for f in findings]}"
+    # and the unmodified tree is clean on that knob (the wiring counts)
+    clean_index = project.ProjectIndex.build(paths)
+    clean = list(
+        config_knobs.DeadConfigKnobRule().check_project(clean_index)
+    )
+    assert not any(
+        "MetricsConfig.max_label_values" in f.message for f in clean
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI mechanics: --self-test, --changed-only
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    # cwd must be the repo root: areal_tpu is imported from the tree
+    return subprocess.run(
+        [sys.executable, "-m", "areal_tpu.lint", *args],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def test_cli_self_test_smoke():
+    proj = os.path.join(FIXTURE_DIR, "xproj_await_under_lock_tn")
+    proc = _run_cli(proj, "--self-test")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "--self-test ok" in proc.stdout
+    assert re.search(r"\d+ modules, \d+ functions", proc.stdout)
+
+
+def test_cli_changed_only_cache(tmp_path):
+    """Second --changed-only run replays cached per-file findings (same
+    exit code and findings) and reports the cache hit in the summary."""
+    src = os.path.join(FIXTURE_DIR, "jit_in_loop_tp.py")
+    with open(src, encoding="utf-8") as f:
+        content = f.read()
+    work = tmp_path / "proj"
+    work.mkdir()
+    (work / "hot.py").write_text(content)
+    cache = str(tmp_path / "cache.json")
+    first = _run_cli(str(work), "--changed-only", "--cache-file", cache)
+    assert first.returncode == 1, first.stdout + first.stderr
+    assert os.path.isfile(cache)
+    second = _run_cli(str(work), "--changed-only", "--cache-file", cache)
+    assert second.returncode == 1
+    assert "1 cached" in second.stdout
+    # identical findings replayed from cache
+    strip = lambda s: [
+        ln for ln in s.splitlines() if not ln.startswith("arealint: wall")
+    ]
+    assert strip(first.stdout) == strip(second.stdout)
+    # an edit invalidates the entry: file is re-linted, not replayed
+    (work / "hot.py").write_text(content + "\n# touched\n")
+    third = _run_cli(str(work), "--changed-only", "--cache-file", cache)
+    assert third.returncode == 1
+    assert "1 cached" not in third.stdout
+
+
+def test_cli_changed_only_rejects_rule_filters(tmp_path):
+    proc = _run_cli(
+        "tests/lint_fixtures/jit_in_loop_tp.py",
+        "--changed-only",
+        "--cache-file", str(tmp_path / "c.json"),
+        "--select", "jit-in-loop",
+    )
+    assert proc.returncode == 2
+    assert "changed-only" in proc.stderr
+
+
+def test_cli_list_rules_shows_scopes():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    assert re.search(r"lock-order.*\(project\)", proc.stdout)
+    assert re.search(r"jax-compat.*\(file\)", proc.stdout)
