@@ -1030,6 +1030,19 @@ class RecoverConfig:
     # deterministic startup failure doesn't hot-loop the trial
     relaunch_backoff_seconds: float = 1.0
     relaunch_backoff_max_seconds: float = 60.0
+    # --- topology-independent checkpoints (utils/checkpoint.py) ---
+    # engine format for recover dumps: "sharded" writes the re-shardable
+    # digest-manifest format (an N-host checkpoint resumes on any mesh
+    # shape, corruption refused by digest); "orbax" keeps the PR 4
+    # same-topology format
+    checkpoint_format: str = "sharded"
+    # verify per-shard digests BEFORE any weight loads on resume; a failing
+    # dump falls back to the newest retained dump that verifies
+    verify_digests: bool = True
+    # retain the newest N committed dump directories (>= 1). N >= 2 gives
+    # the corruption fallback a previous checkpoint to land on; the price
+    # is N engine checkpoints on disk (plus one transiently during a dump)
+    keep_dumps: int = 2
 
 
 @dataclass

@@ -203,7 +203,7 @@ class SaveLoadMeta:
     """Checkpoint save/load request (reference io_struct.py:197)."""
 
     path: str
-    weight_format: str = "hf"  # "hf" (safetensors) | "orbax"
+    weight_format: str = "hf"  # "hf" (safetensors) | "orbax" | "sharded" (manifest)
     with_optim: bool = False
     tokenizer: object | None = None
     base_model_path: str | None = None

@@ -100,6 +100,10 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # mode ratio sits below 1 by design — the TREND still gates)
     "chunked_prefill_attention": False,
     "kv_quant_decode": False,
+    # disaster-drill MTTR in seconds (kill-to-first-post-recovery-step):
+    # lower is better; correctness invariants gate in-child, the sentinel
+    # only watches the recovery latency trend
+    "recovery_drill": True,
 }
 
 

@@ -1,0 +1,20 @@
+"""Full-system disaster-drill harness: correlated-failure scenarios over
+a real (in-process) GRPO loop, with cross-plane recovery invariants and
+MTTR measurement. See ``python -m areal_tpu.drill --list``."""
+
+from .harness import DrillEngine, DrillFleet, DrillTrainer, RewardPool
+from .runner import DrillReport, run_fast, run_scenario
+from .scenarios import SCENARIOS, DrillScenario, fast_scenario
+
+__all__ = [
+    "DrillEngine",
+    "DrillFleet",
+    "DrillReport",
+    "DrillScenario",
+    "DrillTrainer",
+    "RewardPool",
+    "SCENARIOS",
+    "fast_scenario",
+    "run_fast",
+    "run_scenario",
+]

@@ -1,0 +1,56 @@
+"""CLI entry point: ``python -m areal_tpu.drill [--scenario NAME]``.
+
+Runs one disaster-drill scenario (default: the fast CI one), prints the
+report as a JSON line, and exits nonzero if any recovery invariant failed
+— the contract ``scripts/ci.sh --drill`` and the bench rung rely on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+from .runner import run_scenario
+from .scenarios import SCENARIOS, fast_scenario
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m areal_tpu.drill",
+        description="run a full-system disaster-recovery drill scenario",
+    )
+    parser.add_argument(
+        "--scenario",
+        default=None,
+        choices=sorted(SCENARIOS),
+        help="scenario name (default: the fast CI scenario)",
+    )
+    parser.add_argument(
+        "--fileroot",
+        default=None,
+        help="directory for drill state (default: a fresh temp dir)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for s in SCENARIOS.values():
+            print(f"{s.name}: {s.description}")
+        return 0
+
+    sc = SCENARIOS[args.scenario] if args.scenario else fast_scenario()
+    if args.fileroot is not None:
+        report = run_scenario(sc, args.fileroot)
+    else:
+        with tempfile.TemporaryDirectory(prefix="areal_drill_") as d:
+            report = run_scenario(sc, d)
+    print(json.dumps(report.to_json()), flush=True)
+    return 0 if report.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""In-process full-system disaster-drill harness.
+
+The chaos tooling before this (crash barriers, RL-plane faults, FS fault
+injection) exercises one plane at a time. Real incidents are correlated: a
+preemption takes the trainer AND some fleet servers in the same second,
+while a reward replica happens to be wedged. This harness drives a short
+real GRPO-shaped loop — rollout through :class:`WorkflowExecutor`, train,
+weight fan-out to an in-proc fleet, stats commit, Saver save, recover dump
+with manifest-digest checkpoints — so the drill runner can kill several
+planes at once and assert the CROSS-PLANE invariants, not per-subsystem
+ones.
+
+Everything here is product code (the scenario runner ships in the wheel and
+``scripts/ci.sh --drill`` runs it): no test imports, no jax requirement,
+deterministic batches. "Process death" of the trainer is
+:class:`~areal_tpu.utils.chaos.InjectedCrash` at the same ``AREAL_CRASH_AT``
+barriers the real loop runs through; the fleet and reward planes are live
+objects that SURVIVE the trainer's death, exactly like the separate
+processes they model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+
+from areal_tpu.api.cli_args import (
+    InferenceEngineConfig,
+    RecoverConfig,
+    SaverConfig,
+    StatsLoggerConfig,
+)
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.core.workflow_executor import WorkflowExecutor
+from areal_tpu.utils import checkpoint as ckpt_fmt
+from areal_tpu.utils import logging
+from areal_tpu.utils.chaos import crash_point
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.recover import RecoverHandler, RunState
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = logging.getLogger("drill")
+
+EXPERIMENT = "drill"
+TRIAL = "t"
+
+
+# ---------------------------------------------------------------------------
+# reward plane: replicas that can wedge, a pool that fails over
+# ---------------------------------------------------------------------------
+
+
+class RewardReplica:
+    """One reward worker. Wedged = accepted the request and never answers
+    (the classic sandbox hang), until released. The wedge is a polled
+    flag, NOT an asyncio primitive: the drill's trainer dies and a new
+    one (with a new event loop) takes over, and a loop-bound Event from
+    the dead trainer would poison the resumed one."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.wedged = False
+
+    def wedge(self):
+        self.wedged = True
+
+    def release(self):
+        self.wedged = False
+
+    async def score(self, value: int) -> float:
+        while self.wedged:
+            await asyncio.sleep(0.02)
+        return float(value % 3)
+
+
+class RewardPool:
+    """Round-robin over replicas with bounded failover: a replica that
+    does not answer within ``failover_timeout`` is skipped for this
+    request (the bounded reward plane's contract — a wedged replica must
+    not stall the rollout plane)."""
+
+    def __init__(self, n: int = 2, failover_timeout: float = 0.2):
+        self.replicas = [RewardReplica(i) for i in range(n)]
+        self.failover_timeout = failover_timeout
+        self._next = 0
+
+    def wedge(self, n: int):
+        for r in self.replicas[:n]:
+            r.wedge()
+
+    def release_all(self):
+        for r in self.replicas:
+            r.release()
+
+    def wedged_count(self) -> int:
+        return sum(1 for r in self.replicas if r.wedged)
+
+    async def score(self, value: int) -> float:
+        last_exc: Exception | None = None
+        for k in range(len(self.replicas)):
+            replica = self.replicas[(self._next + k) % len(self.replicas)]
+            try:
+                result = await asyncio.wait_for(
+                    replica.score(value), self.failover_timeout
+                )
+                self._next = (self._next + k + 1) % len(self.replicas)
+                return result
+            except asyncio.TimeoutError as e:
+                last_exc = e
+                continue
+        raise RuntimeError(
+            f"every reward replica wedged scoring {value}"
+        ) from last_exc
+
+
+class DrillWorkflow(RolloutWorkflow):
+    """1-row trajectory tagged with the submitted value, its weight
+    version, and a reward scored through the (possibly wedged) pool."""
+
+    def __init__(self, rewards: RewardPool):
+        self.rewards = rewards
+
+    async def arun_episode(self, engine, data):
+        v = int(data["x"])
+        r = await self.rewards.score(v)
+        return dict(
+            input_ids=np.full((1, 4), v, dtype=np.int32),
+            attention_mask=np.ones((1, 4), dtype=np.int32),
+            versions=np.full((1, 4), engine.get_version(), dtype=np.int32),
+            rewards=np.full((1, 4), r, dtype=np.float32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# inference plane: a fleet of version-carrying servers that can be SIGKILLed
+# mid-weight-stream and reconciled after trainer recovery
+# ---------------------------------------------------------------------------
+
+
+class FleetServer:
+    def __init__(self, addr: str, version: int = 0):
+        self.addr = addr
+        self.version = version
+        self.alive = True
+
+
+class DrillFleet:
+    """The trainer-visible inference plane: ``get_version``/``set_version``
+    for the executor and workflows, a sequential per-server weight push
+    (the stream a kill can land in the middle of), and resume-time
+    reconciliation mirroring ``RemoteInfEngine.reconcile_after_recover``:
+    every reachable server whose version differs from the recovered one is
+    re-pushed; dead servers restart at the recovered version (the rejoin
+    probe's job on real fleets)."""
+
+    def __init__(self, n_servers: int = 3):
+        self.servers = [FleetServer(f"drill-{i}:0") for i in range(n_servers)]
+        self._version = 0
+        self.pushes = 0
+        #: armed mid-stream kill: (push number, server indices to SIGKILL
+        #: after the push has reached `after` servers)
+        self._kill_plan: tuple[int, tuple[int, ...], int] | None = None
+        self.repushed_on_reconcile: list[str] = []
+
+    # trainer-side version handle (what RolloutShim forwards)
+    def get_version(self) -> int:
+        return self._version
+
+    def set_version(self, v: int):
+        self._version = int(v)
+
+    def arm_kill(self, at_push: int, servers: tuple[int, ...], after: int = 1):
+        """SIGKILL ``servers`` during push number ``at_push`` (1-based),
+        once the stream has reached ``after`` servers — some servers got
+        the new version, the victims die, the rest keep the old one."""
+        self._kill_plan = (at_push, tuple(servers), after)
+
+    def push_weights(self, version: int):
+        """Sequential weight fan-out. Dead servers are skipped (the real
+        fan-out quarantines them); an armed kill fires mid-stream."""
+        self.pushes += 1
+        self.set_version(version)
+        plan = self._kill_plan
+        reached = 0
+        for i, s in enumerate(self.servers):
+            if plan is not None and plan[0] == self.pushes and reached >= plan[2]:
+                for j in plan[1]:
+                    if self.servers[j].alive:
+                        logger.info(
+                            "drill: SIGKILL %s mid-weight-stream (push %d)",
+                            self.servers[j].addr,
+                            self.pushes,
+                        )
+                        self.servers[j].alive = False
+                plan = self._kill_plan = None
+            if not s.alive:
+                continue
+            s.version = version
+            reached += 1
+
+    def reconcile(self, version: int) -> list[str]:
+        """Resume-time reconciliation to the recovered version. Returns
+        the addresses that were re-pushed or restarted."""
+        self.set_version(version)
+        repushed: list[str] = []
+        for s in self.servers:
+            if not s.alive:
+                s.alive = True  # the scheduler relaunched it; rejoin probe
+                s.version = version
+                repushed.append(s.addr)
+            elif s.version != version:
+                s.version = version
+                repushed.append(s.addr)
+        self.repushed_on_reconcile = repushed
+        return repushed
+
+    def versions(self) -> dict[str, int | None]:
+        return {s.addr: (s.version if s.alive else None) for s in self.servers}
+
+    def reconciled_to(self, version: int) -> bool:
+        return all(s.alive and s.version == version for s in self.servers)
+
+
+class RolloutShim:
+    """Trainer-side rollout handle (version + executor), what the recover
+    plumbing sees as the rollout engine."""
+
+    def __init__(self, fleet: DrillFleet, executor: WorkflowExecutor):
+        self._fleet = fleet
+        self.executor = executor
+
+    def get_version(self):
+        return self._fleet.get_version()
+
+    def set_version(self, v):
+        self._fleet.set_version(v)
+
+    def pause(self):
+        self.executor.pause()
+
+
+# ---------------------------------------------------------------------------
+# train plane: deterministic toy engine with MANIFEST checkpoints
+# ---------------------------------------------------------------------------
+
+
+class DrillEngine:
+    """Deterministic 'training' (one integer folded from every consumed
+    batch) whose checkpoints use the real manifest/digest format — so the
+    drill's torn-commit and corruption invariants exercise the same
+    verify path production restores run."""
+
+    def __init__(self):
+        self.weight = 0
+
+    def train(self, values):
+        self.weight = self.weight * 31 + sum(values)
+
+    def save(self, meta: SaveLoadMeta):
+        ckpt_fmt.save_named(
+            meta.path, {"weight": np.asarray(self.weight, dtype=np.int64)}
+        )
+
+    def load(self, meta: SaveLoadMeta):
+        named, _ = ckpt_fmt.load_named(meta.path)  # digests verify first
+        self.weight = int(named["weight"])
+
+
+# ---------------------------------------------------------------------------
+# the drill trainer: the GRPO step anatomy with all planes wired together
+# ---------------------------------------------------------------------------
+
+
+class DrillTrainer:
+    """Mirror of the example GRPO loop's step anatomy — rollout -> train ->
+    weight fan-out -> stats commit -> save -> recover dump — against a
+    fleet and reward pool owned by the CALLER (they survive this trainer's
+    death, like the separate processes they model)."""
+
+    def __init__(
+        self,
+        fileroot: str,
+        fleet: DrillFleet,
+        rewards: RewardPool,
+        *,
+        dataset_size: int = 24,
+        batch_size: int = 4,
+        steps: int = 5,
+    ):
+        self.fileroot = str(fileroot)
+        self.fleet = fleet
+        self.rewards = rewards
+        self.steps = steps
+        self.steps_per_epoch = dataset_size // batch_size
+        self.dataloader = StatefulDataLoader(
+            list(range(dataset_size)), batch_size, shuffle=True, seed=3
+        )
+        cfg = InferenceEngineConfig(
+            max_concurrent_rollouts=8,
+            consumer_batch_size=batch_size,
+            max_head_offpolicyness=1000,
+        )
+        self.executor = WorkflowExecutor(cfg, fleet)
+        self.executor.initialize()
+        self.rollout = RolloutShim(fleet, self.executor)
+        self.engine = DrillEngine()
+        self.saver = Saver(
+            SaverConfig(
+                freq_steps=1,
+                experiment_name=EXPERIMENT,
+                trial_name=TRIAL,
+                fileroot=self.fileroot,
+            ),
+            None,
+        )
+        self.recover = RecoverHandler(
+            RecoverConfig(mode="fault", freq_steps=1, drain_timeout_seconds=5.0),
+            None,
+        )
+        self.stats = StatsLogger(
+            StatsLoggerConfig(
+                experiment_name=EXPERIMENT,
+                trial_name=TRIAL,
+                fileroot=self.fileroot,
+            ),
+            rank=0,
+        )
+        self.trace: list[tuple[int, tuple, int]] = []
+        self.start_step = 0
+
+    def _paths(self):
+        return dict(
+            fileroot=self.fileroot, experiment_name=EXPERIMENT, trial_name=TRIAL
+        )
+
+    def recover_root(self) -> str:
+        return self.recover.recover_root(**self._paths())
+
+    def resume(self) -> RunState | None:
+        """Recover load + fleet reconciliation — the replacement trainer's
+        first two moves, in that order: no resumed rollout may be
+        generated by weights the trainer rolled back past."""
+        info = self.recover.load(
+            self.engine,
+            self.saver,
+            None,
+            self.dataloader,
+            self.stats,
+            rollout=self.rollout,
+            **self._paths(),
+        )
+        if info is not None:
+            self.start_step = info.last_step_info.global_step + 1
+            self.fleet.reconcile(info.weight_version)
+        return info
+
+    def run_step(self, global_step: int, it):
+        step_info = StepInfo(
+            epoch=global_step // self.steps_per_epoch,
+            epoch_step=global_step % self.steps_per_epoch,
+            global_step=global_step,
+            steps_per_epoch=self.steps_per_epoch,
+        )
+        try:
+            items = next(it)
+        except StopIteration:
+            it = iter(self.dataloader)
+            items = next(it)
+        # barrier 1 (pre-rollout-wait) lives inside executor.wait
+        batch = self.executor.rollout_batch(
+            [{"x": v} for v in items], workflow=DrillWorkflow(self.rewards)
+        )
+        vals = tuple(sorted(batch["input_ids"][:, 0].tolist()))
+        self.engine.train(vals)
+        crash_point("post-train-step")
+        crash_point("pre-weight-update")
+        # the weight-update fan-out: the stream fleet kills land inside
+        self.fleet.push_weights(self.fleet.get_version() + 1)
+        self.stats.commit(
+            step_info.epoch,
+            step_info.epoch_step,
+            global_step,
+            {"weight": float(self.engine.weight)},
+        )
+        self.saver.save(
+            self.engine,
+            step_info,
+            protect=self.recover.protected_paths(**self._paths()),
+        )
+        # barrier 4 (mid-checkpoint) lives inside dump
+        self.recover.dump(
+            self.engine,
+            step_info,
+            self.saver,
+            None,
+            self.dataloader,
+            self.stats,
+            rollout=self.rollout,
+            **self._paths(),
+        )
+        self.trace.append((global_step, vals, self.engine.weight))
+        self.start_step = global_step + 1
+        return it
+
+    def run(self, until: int | None = None):
+        until = self.steps if until is None else until
+        it = iter(self.dataloader)
+        for global_step in range(self.start_step, until):
+            it = self.run_step(global_step, it)
+
+    def counters(self):
+        return self.executor.staleness_manager.get_stats()
+
+    def counters_balanced(self) -> bool:
+        s = self.counters()
+        return s.submitted == s.accepted + s.rejected + s.running
+
+    def stats_steps(self) -> list[int]:
+        import json
+
+        path = os.path.join(
+            self.fileroot, EXPERIMENT, TRIAL, "logs", "stats.jsonl"
+        )
+        with open(path) as f:
+            return [json.loads(line)["global_step"] for line in f]
+
+    def destroy(self):
+        self.executor.destroy()
+        self.stats.close()

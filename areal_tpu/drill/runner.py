@@ -1,0 +1,228 @@
+"""Drill scenario runner: execute one correlated-failure scenario and
+assert the cross-plane recovery invariants.
+
+Per scenario the runner does four things:
+
+1. **Reference run** — the same loop, uninterrupted, in a sibling
+   fileroot. Its trace is the ground truth for "the recovered run did
+   exactly what the unkilled run would have".
+2. **Chaos run** — arm the scenario's crash barrier, mid-stream fleet
+   kills, and reward wedges; run until :class:`InjectedCrash` takes the
+   trainer down. The fleet and reward pool OUTLIVE the trainer object,
+   like the separate processes they model.
+3. **Recovery** — a fresh trainer over the same fileroot resumes
+   (recover load + fleet reconcile) and finishes the run. MTTR is
+   kill-to-first-post-recovery-step.
+4. **Invariants** — step sequence identical to the reference (trace AND
+   committed stats rows), staleness counters balanced, zero torn commits
+   (every retained dump digest-verifies and the marker names one that
+   does), fleet reconciled to the recovered version.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from areal_tpu.utils import chaos, logging
+from areal_tpu.utils.chaos import InjectedCrash
+
+from .harness import DrillFleet, DrillTrainer, RewardPool
+from .scenarios import SCENARIOS, DrillScenario, fast_scenario
+
+logger = logging.getLogger("drill")
+
+
+@dataclass
+class DrillReport:
+    scenario: str
+    passed: bool
+    mttr_seconds: float
+    recovered_at_step: int
+    steps: int
+    torn_commits: int
+    counters_balanced: bool
+    fleet_reconciled: bool
+    repushed_servers: list[str]
+    #: invariant name -> human-readable failure detail ({} = all held)
+    failures: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "passed": self.passed,
+            "mttr_seconds": round(self.mttr_seconds, 4),
+            "recovered_at_step": self.recovered_at_step,
+            "steps": self.steps,
+            "torn_commits": self.torn_commits,
+            "counters_balanced": self.counters_balanced,
+            "fleet_reconciled": self.fleet_reconciled,
+            "repushed_servers": self.repushed_servers,
+            "failures": self.failures,
+        }
+
+
+def _trainer(sc: DrillScenario, fileroot: str, fleet, rewards) -> DrillTrainer:
+    return DrillTrainer(
+        fileroot,
+        fleet,
+        rewards,
+        dataset_size=sc.dataset_size,
+        batch_size=sc.batch_size,
+        steps=sc.steps,
+    )
+
+
+def _reference_run(sc: DrillScenario, fileroot: str):
+    fleet = DrillFleet(sc.fleet_size)
+    rewards = RewardPool(sc.reward_replicas)
+    t = _trainer(sc, fileroot, fleet, rewards)
+    try:
+        t.run()
+        return list(t.trace), t.stats_steps()
+    finally:
+        t.destroy()
+
+
+def _count_torn_commits(trainer: DrillTrainer) -> tuple[int, str]:
+    """Every retained dump must digest-verify, and the committed marker
+    must name one that does. Any failure is a torn commit."""
+    root = trainer.recover_root()
+    handler = trainer.recover
+    torn, details = 0, []
+    committed = handler._committed_dump_name(root)
+    if committed is None:
+        return 1, "no committed recover marker after the drill"
+    try:
+        names = sorted(
+            n for n in os.listdir(root) if n.startswith("dump_globalstep")
+        )
+    except OSError as e:
+        return 1, f"recover root unreadable: {e}"
+    for name in names:
+        reason = handler._verify_dump(os.path.join(root, name))
+        if reason is not None:
+            torn += 1
+            details.append(f"{name}: {reason}")
+    if committed not in names:
+        torn += 1
+        details.append(f"marker names missing dump {committed}")
+    return torn, "; ".join(details)
+
+
+def run_scenario(
+    scenario: DrillScenario | str, fileroot: str
+) -> DrillReport:
+    """Execute one scenario under ``fileroot`` (which must be empty or
+    fresh — the drill owns it) and return the invariant report."""
+    sc = SCENARIOS[scenario] if isinstance(scenario, str) else scenario
+    failures: dict[str, str] = {}
+
+    ref_trace, ref_steps = _reference_run(sc, os.path.join(fileroot, "ref"))
+    run_root = os.path.join(fileroot, "run")
+
+    # the planes that survive the trainer's death
+    fleet = DrillFleet(sc.fleet_size)
+    rewards = RewardPool(sc.reward_replicas)
+    if sc.kill_servers:
+        fleet.arm_kill(sc.kill_at_push, sc.kill_servers, after=sc.kill_after)
+    if sc.wedge_rewards:
+        rewards.wedge(sc.wedge_rewards)
+
+    prev_env = os.environ.get(chaos.CRASH_ENV)
+    os.environ[chaos.CRASH_ENV] = sc.crash_barrier
+    chaos.reset_crash_points()
+    t_kill = None
+    crashed = _trainer(sc, run_root, fleet, rewards)
+    try:
+        crashed.run()
+    except InjectedCrash:
+        t_kill = time.monotonic()
+    finally:
+        if prev_env is None:
+            os.environ.pop(chaos.CRASH_ENV, None)
+        else:
+            os.environ[chaos.CRASH_ENV] = prev_env
+        chaos.reset_crash_points()
+        crashed.destroy()
+    if t_kill is None:
+        failures["crash_fired"] = (
+            f"barrier {sc.crash_barrier} never fired — the scenario did "
+            "not actually kill the trainer"
+        )
+        t_kill = time.monotonic()
+
+    # recovery: fresh trainer, same fileroot, surviving planes
+    resumed = _trainer(sc, run_root, fleet, rewards)
+    mttr = float("inf")
+    recovered_at, counters_ok, fleet_ok, torn = -1, False, False, -1
+    try:
+        info = resumed.resume()
+        if info is None:
+            failures["resumed"] = "recover.load found no committed state"
+        else:
+            recovered_at = resumed.start_step
+            resumed.run(until=min(recovered_at + 1, sc.steps))
+            mttr = time.monotonic() - t_kill
+            resumed.run()
+
+        # ---- invariants ----
+        full_trace = crashed.trace + resumed.trace
+        if full_trace != ref_trace:
+            failures["step_sequence"] = (
+                f"recovered trace diverged: {full_trace} != reference "
+                f"{ref_trace}"
+            )
+        steps_logged = resumed.stats_steps()
+        if steps_logged != ref_steps or steps_logged != list(range(sc.steps)):
+            failures["stats_rows"] = (
+                f"committed stats rows {steps_logged} != reference "
+                f"{ref_steps} (dup or missing step)"
+            )
+        counters_ok = resumed.counters_balanced()
+        if not counters_ok:
+            failures["counters_balanced"] = str(vars(resumed.counters()))
+        torn, torn_detail = _count_torn_commits(resumed)
+        if torn:
+            failures["torn_commits"] = torn_detail
+        fleet_ok = fleet.reconciled_to(fleet.get_version())
+        if not fleet_ok:
+            failures["fleet_reconciled"] = str(fleet.versions())
+        if sc.wedge_rewards and rewards.wedged_count() != sc.wedge_rewards:
+            failures["reward_wedge_held"] = (
+                "a wedged replica released itself mid-drill"
+            )
+        if mttr > sc.mttr_budget_seconds:
+            failures["mttr"] = (
+                f"{mttr:.2f}s kill-to-first-step exceeds the "
+                f"{sc.mttr_budget_seconds}s budget"
+            )
+    finally:
+        rewards.release_all()
+        resumed.destroy()
+
+    report = DrillReport(
+        scenario=sc.name,
+        passed=not failures,
+        mttr_seconds=mttr if mttr != float("inf") else -1.0,
+        recovered_at_step=recovered_at,
+        steps=sc.steps,
+        torn_commits=torn,
+        counters_balanced=counters_ok,
+        fleet_reconciled=fleet_ok,
+        repushed_servers=fleet.repushed_on_reconcile,
+        failures=failures,
+    )
+    logger.info(
+        "drill %s: %s (mttr %.3fs, repushed %s)",
+        sc.name,
+        "PASSED" if report.passed else f"FAILED {sorted(failures)}",
+        report.mttr_seconds,
+        report.repushed_servers,
+    )
+    return report
+
+
+def run_fast(fileroot: str) -> DrillReport:
+    return run_scenario(fast_scenario(), fileroot)

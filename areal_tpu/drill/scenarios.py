@@ -1,0 +1,97 @@
+"""Drill scenario catalogue.
+
+A scenario is a declarative description of ONE correlated failure: where
+the trainer dies (an ``AREAL_CRASH_AT`` barrier + arrival count), which
+fleet servers are SIGKILLed mid-weight-stream, and how many reward
+replicas wedge. The runner executes it against an uninterrupted reference
+run and asserts the cross-plane recovery invariants.
+
+Barrier grammar is the chaos module's: ``name@N`` fires on the Nth arrival
+at that barrier. With ``freq_steps=1`` dumps, every step arrives at every
+barrier once, so ``@3`` lands the kill inside global step 2 with steps 0-1
+fully committed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class DrillScenario:
+    name: str
+    description: str
+    #: AREAL_CRASH_AT spec for the trainer kill, e.g. "mid-checkpoint@3"
+    crash_barrier: str
+    #: fleet server indices SIGKILLed mid-weight-stream (empty = no kill)
+    kill_servers: tuple[int, ...] = ()
+    #: which weight push (1-based) the kill lands inside
+    kill_at_push: int = 0
+    #: servers the stream must have reached before the kill fires (some
+    #: servers hold the new version, the victims die, the rest lag)
+    kill_after: int = 1
+    #: reward replicas wedged for the WHOLE drill, recovery included —
+    #: the pool's bounded failover must keep rollouts flowing regardless
+    wedge_rewards: int = 0
+    steps: int = 5
+    fleet_size: int = 3
+    reward_replicas: int = 2
+    dataset_size: int = 24
+    batch_size: int = 4
+    #: generous in-proc bound; the gate catches a recovery that hangs or
+    #: retries its way to success, not normal scheduling jitter
+    mttr_budget_seconds: float = 20.0
+    tags: tuple[str, ...] = field(default=())
+
+
+SCENARIOS: dict[str, DrillScenario] = {
+    s.name: s
+    for s in [
+        DrillScenario(
+            name="trainer-kill",
+            description=(
+                "trainer dies mid-checkpoint at step 2; fleet and rewards "
+                "healthy — the baseline single-plane drill, fast enough "
+                "for CI (scripts/ci.sh --drill)"
+            ),
+            crash_barrier="mid-checkpoint@3",
+            steps=4,
+            tags=("fast",),
+        ),
+        DrillScenario(
+            name="fleet-kill-mid-stream",
+            description=(
+                "two of three fleet servers SIGKILLed in the middle of "
+                "step 2's weight fan-out, then the trainer dies in the "
+                "same step's checkpoint dump — the fleet is left torn "
+                "across versions and must reconcile to the recovered one"
+            ),
+            crash_barrier="mid-checkpoint@3",
+            kill_servers=(1, 2),
+            kill_at_push=3,
+            kill_after=1,
+        ),
+        DrillScenario(
+            name="correlated-outage",
+            description=(
+                "the full correlated incident: trainer killed before the "
+                "weight update at step 3, fleet servers SIGKILLed "
+                "mid-stream one step earlier, and a reward replica wedged "
+                "for the entire drill including recovery"
+            ),
+            crash_barrier="pre-weight-update@4",
+            kill_servers=(2,),
+            kill_at_push=3,
+            kill_after=2,
+            wedge_rewards=1,
+        ),
+    ]
+}
+
+
+def fast_scenario() -> DrillScenario:
+    """The scenario CI runs on every --drill invocation."""
+    for s in SCENARIOS.values():
+        if "fast" in s.tags:
+            return s
+    return next(iter(SCENARIOS.values()))

@@ -1653,6 +1653,8 @@ class TPUTrainEngine(TrainEngine):
                 )
         elif meta.weight_format == "orbax":
             self._save_orbax(meta.path, with_optim=meta.with_optim)
+        elif meta.weight_format == "sharded":
+            self._save_sharded(meta.path, with_optim=meta.with_optim)
         else:
             raise ValueError(f"unknown weight_format {meta.weight_format}")
 
@@ -1674,6 +1676,8 @@ class TPUTrainEngine(TrainEngine):
                 self._load_optimizer(optim_dir)
         elif meta.weight_format == "orbax":
             self._load_orbax(meta.path, with_optim=meta.with_optim)
+        elif meta.weight_format == "sharded":
+            self._load_sharded(meta.path, with_optim=meta.with_optim)
         else:
             raise ValueError(f"unknown weight_format {meta.weight_format}")
 
@@ -1735,6 +1739,106 @@ class TPUTrainEngine(TrainEngine):
         if with_optim:
             self.opt_state = restored["opt_state"]
             self._opt_steps = int(restored["opt_steps"])
+
+    # ------------------------------------------- topology-independent format
+
+    @staticmethod
+    def _spec_desc(leaf):
+        """json-safe description of a leaf's partition spec (informational
+        manifest metadata — restore derives its target shardings from ITS
+        mesh, never from the saved one)."""
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            return None
+        return [list(p) if isinstance(p, (tuple, list)) else p for p in spec]
+
+    @staticmethod
+    def _nest(flat: dict) -> dict:
+        tree: dict = {}
+        for name, arr in flat.items():
+            node = tree
+            parts = name.split(".")
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = arr
+        return tree
+
+    def _save_sharded(self, path: str, with_optim: bool):
+        """Manifest checkpoint (utils/checkpoint.py): one file per
+        addressable shard plus per-shard digests, re-shardable into any
+        mesh on restore. Leaf namespace: ``params.<dotted>``,
+        ``lora.<dotted>``, ``opt.leaf_{i}``; opt step count rides the
+        manifest extras."""
+        from areal_tpu.utils import checkpoint as ckpt_fmt
+
+        w = ckpt_fmt.CheckpointWriter(path)
+        for name, leaf in self._walk_params(self.params):
+            w.add_leaf(f"params.{name}", leaf, spec=self._spec_desc(leaf))
+        if self.lora_params is not None:
+            for name, leaf in self._walk_params(self.lora_params):
+                w.add_leaf(f"lora.{name}", leaf, spec=self._spec_desc(leaf))
+        extras = {}
+        if with_optim:
+            leaves, _ = self._flat_opt_leaves()
+            for i, leaf in enumerate(leaves):
+                w.add_leaf(f"opt.leaf_{i}", leaf, spec=self._spec_desc(leaf))
+            extras["opt_steps"] = int(self._opt_steps)
+        w.commit(extras=extras)
+
+    def _load_sharded(self, path: str, with_optim: bool):
+        """Restore a manifest checkpoint into THIS engine's mesh, whatever
+        shape the saving mesh had. Digests verify before any weight
+        loads; target shardings come from ``param_shardings()`` (params)
+        and the freshly initialized opt_state (optimizer leaves), so an
+        N-host checkpoint lands correctly on an M-host trainer."""
+        from areal_tpu.utils import checkpoint as ckpt_fmt
+
+        manifest = ckpt_fmt.read_manifest(path)
+        shardings: dict = {}
+        for name, sh in self._walk_params(self.param_shardings()):
+            shardings[f"params.{name}"] = sh
+        rep = NamedSharding(self.mesh, P())
+        opt_leaves, opt_treedef = self._flat_opt_leaves()
+        for i, old in enumerate(opt_leaves):
+            sh = getattr(old, "sharding", None)
+            # freshly initialized opt leaves can sit uncommitted on one
+            # device; loading through that sharding would COMMIT them
+            # there and clash with mesh-placed params inside jit — only
+            # honor shardings that live on this engine's mesh
+            if not (isinstance(sh, NamedSharding) and sh.mesh == self.mesh):
+                sh = rep
+            shardings[f"opt.leaf_{i}"] = sh
+        for name in manifest["leaves"]:
+            if name.startswith("lora."):
+                shardings[name] = rep
+        named, extras = ckpt_fmt.load_named(
+            path, shardings=shardings, manifest=manifest
+        )
+        self.params = self._nest(
+            {
+                n[len("params."):]: a
+                for n, a in named.items()
+                if n.startswith("params.")
+            }
+        )
+        lora = {
+            n[len("lora."):]: a for n, a in named.items() if n.startswith("lora.")
+        }
+        if lora:
+            self.lora_params = self._nest(lora)
+        self._merged_cache = None
+        if with_optim:
+            new_leaves = []
+            for i, old in enumerate(opt_leaves):
+                arr = named.get(f"opt.leaf_{i}")
+                if arr is None:
+                    raise ValueError(
+                        f"checkpoint at {path} has no opt.leaf_{i} — saved "
+                        "without the optimizer, or the optimizer shape changed"
+                    )
+                new_leaves.append(arr)
+            self.opt_state = jax.tree.unflatten(opt_treedef, new_leaves)
+            self._opt_steps = int(extras.get("opt_steps", 0))
 
     # ---------------------------------------------------------- weight update
 

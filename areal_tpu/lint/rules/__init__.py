@@ -2,6 +2,7 @@
 
 from areal_tpu.lint.rules import (  # noqa: F401
     async_discipline,
+    checkpoint_manifest,
     config_knobs,
     donation,
     exceptions,

@@ -1,0 +1,121 @@
+"""Checkpoint manifest discipline.
+
+unmanifested-checkpoint-write: a raw array-serializer call (``np.save``,
+``np.savez``, safetensors ``save_file``) whose target path lives under
+the checkpoint tree bypasses ``areal_tpu.utils.checkpoint`` — the shard
+bytes land on disk with no manifest entry and no blake2b digest. Restore
+then has no commit record to refuse a torn save with, no digest to catch
+a bit-flip with, and no global shape/spec to re-shard into a different
+mesh with. Every weight/optimizer array under a checkpoint path must go
+through ``CheckpointWriter``/``save_named`` (or the engine's ``sharded``
+format, which uses them).
+
+Heuristic: the serializer's path argument *mentions* the checkpoint tree
+— any string constant or identifier in it containing ``checkpoint`` or
+``ckpt``. Exempt when the innermost enclosing function itself calls into
+``areal_tpu.utils.checkpoint`` (the write is part of the manifest
+protocol, e.g. a migration shim that also records digests), and exempt
+the checkpoint module itself — it IS the helper. Writers to
+non-checkpoint paths (wire buffers, debug dumps, HF export dirs) never
+flag; atomicity of the write is crash-unsafe-write's job, not ours.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from areal_tpu.lint.framework import FileContext, Finding, Rule, register
+
+_TOKENS = ("checkpoint", "ckpt")
+
+#: resolved callable -> index of its path/file argument.
+#: np.save(file, arr) and np.savez(file, ...) take the path first;
+#: safetensors' save_file(tensors, filename) takes it second.
+_WRITERS = {
+    "numpy.save": 0,
+    "numpy.savez": 0,
+    "numpy.savez_compressed": 0,
+    "safetensors.numpy.save_file": 1,
+    "safetensors.flax.save_file": 1,
+    "safetensors.torch.save_file": 1,
+}
+
+#: the module whose helpers constitute "going through the manifest"
+_HELPER_MODULE = "areal_tpu.utils.checkpoint"
+
+
+def _path_mentions_checkpoint(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        text = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+        elif isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        if text and any(t in text.lower() for t in _TOKENS):
+            return True
+    return False
+
+
+def _path_arg(call: ast.Call, index: int) -> ast.AST | None:
+    if len(call.args) > index:
+        return call.args[index]
+    for kw in call.keywords:
+        if kw.arg in ("file", "filename"):
+            return kw.value
+    return None
+
+
+def _enclosing_uses_manifest(ctx: FileContext, call: ast.Call) -> bool:
+    """True when the innermost function around ``call`` also calls into
+    the manifest helpers — the raw write is then part of the protocol
+    (digests ARE being recorded), not a bypass of it."""
+    for anc in ctx.ancestors(call):
+        if not isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(anc):
+            if not isinstance(n, (ast.Call, ast.Attribute, ast.Name)):
+                continue
+            target = n.func if isinstance(n, ast.Call) else n
+            resolved = ctx.resolved(target) or ""
+            if resolved.startswith(_HELPER_MODULE):
+                return True
+        return False  # judge only the innermost function
+    return False
+
+
+@register
+class UnmanifestedCheckpointWriteRule(Rule):
+    id = "unmanifested-checkpoint-write"
+    doc = (
+        "raw np.save/savez/safetensors write to a checkpoint path; the "
+        "bytes bypass the manifest + per-shard digests, so restore can "
+        "neither refuse corruption nor re-shard them — use "
+        "areal_tpu.utils.checkpoint (CheckpointWriter/save_named)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # the helper module is the one place raw shard writes belong
+        if ctx.path.replace("\\", "/").endswith("utils/checkpoint.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolved(node.func)
+            if resolved not in _WRITERS:
+                continue
+            path = _path_arg(node, _WRITERS[resolved])
+            if path is None or not _path_mentions_checkpoint(path):
+                continue
+            if _enclosing_uses_manifest(ctx, node):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"{resolved} writes under a checkpoint path without a "
+                "manifest entry or digest; restore cannot verify or "
+                "re-shard these bytes — route the save through "
+                "areal_tpu.utils.checkpoint (CheckpointWriter/save_named)",
+            )

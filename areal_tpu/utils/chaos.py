@@ -103,6 +103,66 @@ def crash_point(name: str) -> None:
             )
 
 # ---------------------------------------------------------------------------
+# deterministic filesystem faults (the checkpoint-durability harness)
+# ---------------------------------------------------------------------------
+
+FS_CHAOS_ENV = "AREAL_CHAOS_FS"
+
+#: fault kinds the atomic-write helpers inject; every kind aborts BEFORE
+#: the commit rename, because that is what the real failures do — a full
+#: disk or dying device tears the tmp file, never the committed one
+FS_FAULT_KINDS = (
+    "enospc",  # OSError(ENOSPC) before any bytes land (disk full)
+    "eio",     # OSError(EIO) at fsync (device error after a full write)
+    "short",   # tmp truncated to half, then OSError (torn write + crash)
+)
+
+#: per-spec arrival counters for ``substr:kind@N`` specs
+_fs_fault_hits: dict[str, int] = {}
+
+
+def reset_fs_faults() -> None:
+    """Clear arrival counters (tests arm a fresh spec per scenario)."""
+    _fs_fault_hits.clear()
+
+
+def fs_fault(path: str) -> str | None:
+    """Deterministic filesystem fault gate for the atomic-write helpers.
+    ``AREAL_CHAOS_FS`` holds comma-separated specs
+    ``<path-substr>:<kind>`` (fault on the first write whose destination
+    contains the substring) or ``<path-substr>:<kind>@N`` (the Nth such
+    write). Returns the fault kind to inject for THIS write, or None.
+    Only consulted when the env var is set — the off path in
+    ``utils/fs.atomic_write`` is a single env lookup."""
+    spec = os.environ.get(FS_CHAOS_ENV, "")
+    if not spec:
+        return None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        substr, _, rest = part.partition(":")
+        kind, _, nth = rest.partition("@")
+        if kind not in FS_FAULT_KINDS:
+            raise ValueError(
+                f"unknown {FS_CHAOS_ENV} fault kind {kind!r} in {part!r}; "
+                f"one of {FS_FAULT_KINDS}"
+            )
+        if substr not in path:
+            continue
+        _fs_fault_hits[part] = _fs_fault_hits.get(part, 0) + 1
+        if _fs_fault_hits[part] == (int(nth) if nth else 1):
+            logger.warning(
+                "chaos: fs fault %r injected on write to %s (arrival %d)",
+                kind,
+                path,
+                _fs_fault_hits[part],
+            )
+            return kind
+    return None
+
+
+# ---------------------------------------------------------------------------
 # deterministic RL-signal faults (the training-health sentinel harness)
 # ---------------------------------------------------------------------------
 

@@ -3,13 +3,29 @@
 The reference uses torchdata's StatefulDataLoader (areal/utils/dataloader.py)
 for exactly-resumable iteration; this is a dependency-free equivalent: epoch-
 seeded shuffling, per-DP-rank batches, and a ``state_dict`` that fast-forwards
-to the same (epoch, batch) position after recovery.
+to the same position after recovery.
+
+Elastic resume: the cursor is a SAMPLE index into the (seed, epoch)-shuffled
+order — which depends only on the dataset and seed, never on how samples are
+grouped into batches. A checkpoint written at batch size B therefore resumes
+correctly at any batch size B' (a replacement trainer with a different host
+count consumes a different global batch): the stream of samples continues
+exactly where it stopped, replaying none and skipping none. The refusal path
+survives only for genuinely incompatible changes — a different dataset makes
+the saved shuffle order and cursor meaningless — and names the exact
+mismatched field. Legacy batch-cursor states (``batch_in_epoch``) remap via
+their saved batch size.
 """
 
 from __future__ import annotations
 
 import random
 from typing import Any, Callable, Iterator, Sequence
+
+
+class IncompatibleResumeState(ValueError):
+    """The saved dataloader state cannot be remapped onto this loader.
+    The message names the exact incompatible field."""
 
 
 class StatefulDataLoader:
@@ -31,7 +47,9 @@ class StatefulDataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or (lambda x: x)
         self._epoch = 0
-        self._batch_in_epoch = 0
+        #: SAMPLE index into the epoch's shuffled order (batch-size
+        #: independent — the whole elastic-resume seam)
+        self._sample_in_epoch = 0
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -48,26 +66,36 @@ class StatefulDataLoader:
     def __iter__(self) -> Iterator[Any]:
         """Yields the REMAINDER of the current epoch (so a freshly restored
         loader resumes mid-epoch), then advances the epoch counter. Callers
-        loop epochs by re-iterating (see utils.data.cycle_dataloader)."""
+        loop epochs by re-iterating (see utils.data.cycle_dataloader).
+
+        With ``drop_last``, a tail of fewer than ``batch_size`` samples is
+        dropped at the epoch boundary — the standard contract. After an
+        elastic resume whose new batch size doesn't divide the remaining
+        sample count, that rule applies to the (possibly nonempty) tail the
+        same way it applies to an uninterrupted epoch."""
         order = self._order(self._epoch)
-        nb = len(self)
-        while self._batch_in_epoch < nb:
-            b = self._batch_in_epoch
-            sel = order[b * self.batch_size : (b + 1) * self.batch_size]
-            self._batch_in_epoch += 1
+        n = len(order)
+        while self._sample_in_epoch < n:
+            s = self._sample_in_epoch
+            take = min(self.batch_size, n - s)
+            if self.drop_last and take < self.batch_size:
+                break
+            sel = order[s : s + take]
+            self._sample_in_epoch = s + take
             yield self.collate_fn([self.dataset[i] for i in sel])
         self._epoch += 1
-        self._batch_in_epoch = 0
+        self._sample_in_epoch = 0
 
     def state_dict(self) -> dict:
         return {
             "epoch": self._epoch,
-            "batch_in_epoch": self._batch_in_epoch,
+            "sample_in_epoch": self._sample_in_epoch,
             "seed": self.seed,
             # resume-safety fingerprint: the cursor is an index into the
             # (seed, epoch)-shuffled order of THIS dataset — restoring it
-            # over a different dataset/batching silently trains on the
-            # wrong sample stream
+            # over a different dataset silently trains on the wrong sample
+            # stream. batch_size rides along for observability and legacy
+            # remap, but is NOT part of the compatibility contract.
             "dataset_size": len(self.dataset),
             "batch_size": self.batch_size,
         }
@@ -75,18 +103,29 @@ class StatefulDataLoader:
     def load_state_dict(self, state: dict):
         size = state.get("dataset_size")
         if size is not None and size != len(self.dataset):
-            raise ValueError(
-                f"refusing to restore dataloader cursor: dataset has "
-                f"{len(self.dataset)} rows, saved state was over {size} "
+            raise IncompatibleResumeState(
+                f"refusing to restore dataloader cursor: dataset_size "
+                f"mismatch — saved {size}, current {len(self.dataset)} "
                 "(the dataset changed; the saved shuffle order and cursor "
                 "are meaningless)"
             )
-        bs = state.get("batch_size")
-        if bs is not None and bs != self.batch_size:
-            raise ValueError(
-                f"refusing to restore dataloader cursor: batch_size "
-                f"{self.batch_size} != saved {bs}"
+        if "sample_in_epoch" in state:
+            sample = int(state["sample_in_epoch"])
+        else:
+            # legacy batch-cursor state: remap batches -> samples via the
+            # batch size the cursor was counted in
+            saved_bs = state.get("batch_size")
+            if saved_bs is None:
+                raise IncompatibleResumeState(
+                    "refusing to restore dataloader cursor: legacy state "
+                    "has batch_in_epoch but no batch_size to remap it with"
+                )
+            sample = int(state["batch_in_epoch"]) * int(saved_bs)
+        if sample > len(self.dataset):
+            raise IncompatibleResumeState(
+                f"refusing to restore dataloader cursor: sample_in_epoch "
+                f"{sample} exceeds dataset_size {len(self.dataset)}"
             )
-        self._epoch = state["epoch"]
-        self._batch_in_epoch = state["batch_in_epoch"]
+        self._epoch = int(state["epoch"])
+        self._sample_in_epoch = sample
         self.seed = state.get("seed", self.seed)

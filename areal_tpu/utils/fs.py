@@ -6,10 +6,19 @@ reader (the next recovery run) must only ever see either the previous
 complete file or the new complete file — never a truncated one. The
 ``crash-unsafe-write`` arealint rule flags direct write-mode ``open`` calls
 on recovery-ish paths that bypass these helpers.
+
+Fault injection: when ``AREAL_CHAOS_FS`` is armed (utils/chaos.fs_fault),
+writes whose destination matches a spec fail deterministically — ENOSPC
+before any bytes land, EIO at fsync, or a torn half-write — always BEFORE
+the commit rename, exactly like the real failures they rehearse. The
+durability tests pin that a dump hit mid-write leaves the previously
+committed state fully intact and resumable. Off (the common case) costs
+one env lookup per write.
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 
@@ -17,10 +26,24 @@ import os
 def atomic_write(path: str, write_fn, binary: bool = False) -> None:
     """Write via tmp-file + fsync + rename so readers never see a partial
     file. ``write_fn(f)`` receives the open tmp handle."""
+    fault = None
+    if os.environ.get("AREAL_CHAOS_FS"):
+        from areal_tpu.utils.chaos import fs_fault
+
+        fault = fs_fault(path)
     tmp = path + ".tmp"
     with open(tmp, "wb" if binary else "w") as f:
+        if fault == "enospc":
+            raise OSError(errno.ENOSPC, "chaos: injected ENOSPC", tmp)
         write_fn(f)
         f.flush()
+        if fault == "short":
+            # a torn write followed by a crash: half the bytes on the tmp
+            # file, no rename — the committed target is untouched
+            f.truncate(max(f.tell() // 2, 0))
+            raise OSError(errno.EIO, "chaos: injected short write", tmp)
+        if fault == "eio":
+            raise OSError(errno.EIO, "chaos: injected EIO at fsync", tmp)
         os.fsync(f.fileno())
     os.replace(tmp, path)
 

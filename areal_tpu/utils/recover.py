@@ -5,7 +5,13 @@ pause -> rollout drain -> checkpoint within a grace budget.
 
 ``RecoverHandler.dump`` writes, per checkpointed step:
 
-- the engine checkpoint (weights + optimizer, orbax format),
+- the engine checkpoint (weights + optimizer; by default the re-shardable
+  digest-manifest format of utils/checkpoint.py, so a replacement trainer
+  with a DIFFERENT host count or mesh shape resumes the same run —
+  ``RecoverConfig.checkpoint_format="orbax"`` keeps the same-topology
+  format),
+- a ``run_state.json`` copy staged INSIDE the dump directory (fallback
+  restores read the loop state of the dump they actually land on),
 - a ``loop_state.pkl``: dataloader cursor (seeded shuffle position),
   Saver/Evaluator timer states, python/numpy PRNG states, stats-logger
   state, and any rollouts drained by a graceful shutdown,
@@ -21,8 +27,12 @@ deleted only after the new marker is committed. A crash at ANY point
 (including the ``mid-checkpoint`` ``AREAL_CRASH_AT`` barrier between the
 staging writes and the marker flip) therefore leaves the previous dump
 fully intact and referenced, or the new one committed — never a torn mix
-of old marker and new state. The price is transiently two engine
-checkpoints on disk during a dump.
+of old marker and new state. ``RecoverConfig.keep_dumps`` of the newest
+committed dumps are retained (default 2): resume verifies the committed
+dump's shard digests BEFORE any weight loads, and a bit-flipped or
+truncated shard falls back to the newest retained dump that verifies
+instead of stranding the trial. The price is up to ``keep_dumps`` engine
+checkpoints on disk, plus one transiently during a dump.
 
 ``check_if_recover`` mirrors the reference's AREAL_RECOVER_RUN env protocol:
 launchers relaunch failed trials with the env set, and the entry script calls
@@ -37,6 +47,7 @@ import json
 import os
 import pickle
 import random
+import re
 import shutil
 import signal
 import threading
@@ -47,6 +58,7 @@ import numpy as np
 
 from areal_tpu.api.cli_args import RecoverConfig, to_dict
 from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, TimedResult
+from areal_tpu.utils import checkpoint as ckpt_fmt
 from areal_tpu.utils import logging
 from areal_tpu.utils.chaos import crash_point
 from areal_tpu.utils.fs import atomic_write
@@ -67,6 +79,17 @@ PREEMPTION_EXIT_CODE = 42
 # compat alias: the original helper moved to utils/fs.atomic_write so the
 # saver (retention pointer) and future checkpoint writers share it
 _atomic_write = atomic_write
+
+#: staged dump directory naming; the retention/fallback scans parse the
+#: global step (and the same-step re-dump suffix) back out of it to order
+#: candidates newest-first
+_DUMP_DIR_RE = re.compile(r"^dump_globalstep(\d+)(?:\.(\d+))?$")
+
+
+def _dump_sort_key(name: str) -> tuple[int, int]:
+    m = _DUMP_DIR_RE.match(name)
+    assert m, name
+    return (int(m.group(1)), int(m.group(2) or 0))
 
 
 class RecoverStateCorrupted(RuntimeError):
@@ -349,7 +372,9 @@ class RecoverHandler:
         engine.save(
             SaveLoadMeta(
                 path=os.path.join(dump_root, "engine"),
-                weight_format="orbax",
+                weight_format=getattr(
+                    self.config, "checkpoint_format", "sharded"
+                ),
                 with_optim=True,
                 tokenizer=tokenizer,
             )
@@ -380,9 +405,6 @@ class RecoverHandler:
             lambda f: pickle.dump(state, f),
             binary=True,
         )
-        # deterministic kill barrier between the staged state and the commit
-        # marker: a crash here must resume from the PREVIOUS dump
-        crash_point("mid-checkpoint")
         info = RunState(
             last_step_info=step,
             config_hash=config_hash(config) if config is not None else "",
@@ -397,15 +419,35 @@ class RecoverHandler:
             last_save_path=getattr(saver, "last_save_path", None),
             dump_dir=dump_name,
         )
+        # every dump carries its own RunState copy: when the corruption
+        # fallback lands on a RETAINED (non-committed) dump, the loop
+        # control state must come from that dump's step, not the newer
+        # marker's — staged before the barrier, like the rest of the dump
+        atomic_write(
+            os.path.join(dump_root, "run_state.json"),
+            lambda f: json.dump(info.to_json(), f),
+        )
+        # deterministic kill barrier between the staged state and the commit
+        # marker: a crash here must resume from the PREVIOUS dump
+        crash_point("mid-checkpoint")
         # the commit point for the whole dump: write-then-rename, LAST
         atomic_write(
             os.path.join(root, "recover_info.json"),
             lambda f: json.dump(info.to_json(), f),
         )
-        # only now is the previous dump unreferenced and safe to GC (and the
-        # legacy flat-layout files, which the new marker supersedes)
+        # only now is the previous dump unreferenced and safe to GC. The
+        # newest keep_dumps dumps survive (the current one is by
+        # construction the newest) so the digest-verifying restore has a
+        # previous consistent state to fall back to; legacy flat-layout
+        # files, superseded by the marker, are always removed.
+        keep_n = max(int(getattr(self.config, "keep_dumps", 1)), 1)
+        dumps = sorted(
+            (n for n in os.listdir(root) if _DUMP_DIR_RE.match(n)),
+            key=_dump_sort_key,
+        )
+        survivors = set(dumps[-keep_n:]) | {dump_name}
         for name in os.listdir(root):
-            if name.startswith("dump_globalstep") and name != dump_name:
+            if _DUMP_DIR_RE.match(name) and name not in survivors:
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
             elif name == "engine":
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
@@ -419,6 +461,85 @@ class RecoverHandler:
             "recover state dumped at %s (step %d)", dump_root, step.global_step
         )
         return dump_root
+
+    @staticmethod
+    def _dump_run_state(state_root: str) -> RunState | None:
+        """The RunState a dump staged for itself (None when missing or
+        torn — pre-fallback-era dumps have no copy)."""
+        try:
+            with open(os.path.join(state_root, "run_state.json")) as f:
+                return RunState.from_json(json.load(f))
+        except (OSError, json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return None
+
+    def _verify_dump(self, state_root: str) -> str | None:
+        """Why this dump cannot be resumed from (None = it verifies).
+        Digest verification only applies to manifest-format engine
+        checkpoints; other formats get a structural existence check."""
+        if not os.path.isfile(os.path.join(state_root, "loop_state.pkl")):
+            return "loop_state.pkl missing"
+        engine_dir = os.path.join(state_root, "engine")
+        if not os.path.isdir(engine_dir):
+            return "engine checkpoint missing"
+        if getattr(self.config, "verify_digests", True) and (
+            ckpt_fmt.is_manifest_checkpoint(engine_dir)
+        ):
+            try:
+                ckpt_fmt.verify_or_raise(engine_dir)
+            except ckpt_fmt.CheckpointCorrupted as e:
+                return str(e)
+        return None
+
+    def _select_dump(self, root: str, info: RunState) -> tuple[str, RunState]:
+        """The dump to resume from: the committed one when it verifies,
+        else the newest retained dump that does (with ITS staged RunState,
+        so the loop rewinds consistently with the older weights). Raises
+        :class:`RecoverStateCorrupted` when nothing on disk verifies."""
+        if not info.dump_dir:
+            return root, info  # legacy flat layout: nothing to scan
+        committed_root = os.path.join(root, info.dump_dir)
+        reason = self._verify_dump(committed_root)
+        if reason is None:
+            return committed_root, info
+        logger.error(
+            "recover: committed dump %s FAILS verification (%s); scanning "
+            "retained dumps for a fallback",
+            committed_root,
+            reason,
+        )
+        failures = [f"{info.dump_dir}: {reason}"]
+        others = sorted(
+            (
+                n
+                for n in os.listdir(root)
+                if _DUMP_DIR_RE.match(n) and n != info.dump_dir
+            ),
+            key=_dump_sort_key,
+            reverse=True,
+        )
+        for name in others:
+            state_root = os.path.join(root, name)
+            reason = self._verify_dump(state_root)
+            if reason is not None:
+                failures.append(f"{name}: {reason}")
+                continue
+            fb_info = self._dump_run_state(state_root)
+            if fb_info is None:
+                failures.append(f"{name}: verifies but has no run_state.json")
+                continue
+            logger.error(
+                "recover: falling back to retained dump %s (step %d, "
+                "rewinding from committed step %d)",
+                state_root,
+                fb_info.last_step_info.global_step,
+                info.last_step_info.global_step,
+            )
+            return state_root, fb_info
+        raise RecoverStateCorrupted(
+            "refusing to resume: no retained recover dump verifies — "
+            + "; ".join(failures)
+            + f"; delete {root} to start the trial fresh"
+        )
 
     def load(
         self,
@@ -456,15 +577,22 @@ class RecoverHandler:
                     f"{info.config_hash} (the trial config changed)"
                 )
         # the marker names the committed dump dir; legacy flat-layout
-        # markers (no dump_dir) read straight from the root
-        state_root = (
-            os.path.join(root, info.dump_dir) if info.dump_dir else root
-        )
+        # markers (no dump_dir) read straight from the root. With digest
+        # verification on, a committed dump whose shards fail verification
+        # does NOT strand the trial: the scan falls back to the newest
+        # retained dump that verifies (reading the loop state from THAT
+        # dump's staged run_state.json), before any weight loads.
+        state_root, info = self._select_dump(root, info)
         try:
+            engine_dir = os.path.join(state_root, "engine")
             engine.load(
                 SaveLoadMeta(
-                    path=os.path.join(state_root, "engine"),
-                    weight_format="orbax",
+                    path=engine_dir,
+                    weight_format=(
+                        "sharded"
+                        if ckpt_fmt.is_manifest_checkpoint(engine_dir)
+                        else "orbax"
+                    ),
                     with_optim=True,
                 )
             )

@@ -146,6 +146,59 @@ class Saver:
         path = os.path.join(self.save_root(), name)
         return path if name and os.path.isdir(path) else None
 
+    def resolve_latest_checkpoint(self, verify=None) -> str | None:
+        """The ``latest`` pointer target, VALIDATED — the resume-time
+        entry point. A pointer naming a GC'd directory or one that fails
+        ``verify`` (default: digest verification for manifest checkpoints,
+        existence+non-emptiness otherwise) does not crash the restore
+        mid-flight: the scan falls back to the newest checkpoint directory
+        that verifies, with a loud warning naming what was wrong with the
+        pointer. Returns None when nothing on disk verifies."""
+        if verify is None:
+            from areal_tpu.utils.checkpoint import verify_checkpoint_dir
+
+            verify = verify_checkpoint_dir
+        root = self.save_root()
+        pointed = self.latest_checkpoint()
+        reason = "pointer missing or names a GC'd directory"
+        if pointed is not None:
+            ok, why = verify(pointed)
+            if ok:
+                return pointed
+            reason = f"pointer names {pointed}: {why}"
+        # newest-first fallback over every checkpoint-shaped directory
+        try:
+            names = os.listdir(root)
+        except OSError:
+            names = []
+        entries = sorted(
+            (
+                (int(m.group(3)), name)
+                for name in names
+                if (m := _CKPT_DIR_RE.match(name))
+                and os.path.isdir(os.path.join(root, name))
+            ),
+            reverse=True,
+        )
+        for _, name in entries:
+            path = os.path.join(root, name)
+            if path == pointed:
+                continue  # already failed above
+            ok, why = verify(path)
+            if ok:
+                logger.warning(
+                    "latest checkpoint pointer is invalid (%s); falling "
+                    "back to newest verifying checkpoint %s",
+                    reason,
+                    path,
+                )
+                return path
+        if pointed is not None or entries:
+            logger.warning(
+                "no verifying checkpoint under %s (%s)", root, reason
+            )
+        return None
+
     def gc(self, protect: Iterable[str] = ()) -> list[str]:
         """Retention GC: keep the newest ``keep_last`` checkpoints, plus
         every checkpoint whose global_step is a multiple of ``keep_every``,

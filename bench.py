@@ -2904,6 +2904,41 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("reward_service", "reward", e)
 
+    # ---- rung 4.7: full-system disaster-recovery drill (ISSUE 18) — a
+    # correlated failure (trainer killed at a crash barrier, fleet servers
+    # SIGKILLed mid-weight-stream, a reward replica wedged) must recover
+    # with an identical step sequence, balanced counters, zero torn
+    # commits, and the fleet reconciled; those invariants hard-gate in the
+    # child. The emitted value is MTTR (kill-to-first-post-recovery-step,
+    # lower is better) — pure-CPU simulation, so rehearsal numbers are the
+    # live signal. Rehearsal runs the fast scenario; hardware runs the
+    # full correlated one. ----
+    if remaining(deadline) > 90:
+        try:
+            log("recovery drill rung")
+            dr = _run_child(
+                "drill",
+                dict(
+                    scenario="trainer-kill" if REHEARSAL
+                    else "correlated-outage"
+                ),
+                timeout=min(300.0, remaining(deadline) - 30),
+            )
+            emit({
+                "metric": "recovery_drill",
+                "value": dr["mttr_seconds"],
+                "unit": "s_mttr",
+                "vs_baseline": None,
+                "scenario": dr["scenario"],
+                "recovered_at_step": dr["recovered_at_step"],
+                "torn_commits": dr["torn_commits"],
+                "counters_balanced": dr["counters_balanced"],
+                "fleet_reconciled": dr["fleet_reconciled"],
+                "repushed_servers": len(dr["repushed_servers"]),
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("recovery_drill", "drill", e)
+
     if primary is not None:
         # repeat the primary as the FINAL line (drivers that take the last
         # parseable line get the headline metric)
@@ -2914,6 +2949,30 @@ def main():
         print(json.dumps(primary), flush=True)
     else:
         raise RuntimeError("all sft bench configurations failed")
+
+
+def recovery_drill_bench(scenario: str = "trainer-kill") -> dict:
+    """Full-system disaster drill (areal_tpu/drill): kill the trainer at a
+    crash barrier (plus, per scenario, SIGKILL fleet servers mid-weight-
+    stream and wedge reward replicas), recover, and measure MTTR
+    (kill-to-first-post-recovery-step). The recovery INVARIANTS are hard
+    gates in-child — a drill that recovers the wrong step sequence, tears
+    a commit, or leaves the fleet unreconciled must fail the rung, not
+    ship a pretty latency number."""
+    import tempfile
+
+    from areal_tpu.drill import run_scenario
+
+    with tempfile.TemporaryDirectory(prefix="areal_drill_bench_") as d:
+        report = run_scenario(scenario, d).to_json()
+    assert report["passed"], f"drill invariants failed: {report['failures']}"
+    assert report["torn_commits"] == 0, report
+    assert report["counters_balanced"], report
+    assert report["fleet_reconciled"], report
+    assert 0 <= report["mttr_seconds"] < 20.0, (
+        f"MTTR {report['mttr_seconds']}s out of budget"
+    )
+    return report
 
 
 def _fail_record(e: Exception):
@@ -2975,6 +3034,8 @@ def _child_main():
         from bench_grpo import grpo_step_bench
 
         print(json.dumps(grpo_step_bench(**att)))
+    elif kind == "--drill-child":
+        print(json.dumps(recovery_drill_bench(**att)))
     elif kind == "--rlh-child":
         from bench_grpo import rl_health_overhead_bench
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# The whole local/CI gate as ONE command, chaining the three existing
-# gates in fail-fast order:
+# The whole local/CI gate as ONE command, chaining the existing gates in
+# fail-fast order:
 #
 #   1. scripts/lint.sh        — arealint (empty-baseline enforced) + the
 #                               bench sentinel's fixture self-test
@@ -11,17 +11,21 @@
 #
 #   scripts/ci.sh             # run everything
 #   scripts/ci.sh --fast      # lint + tests only (skip the bench gate)
+#   scripts/ci.sh --drill     # also run the fast disaster-recovery drill
+#                             # (trainer-kill scenario, cross-plane
+#                             # invariants; exits nonzero on any failure)
 #
-# Extra args after the optional --fast pass through to pytest
+# Extra args after the optional flags pass through to pytest
 # (e.g. `scripts/ci.sh -k rl_health`).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 FAST=0
-if [[ "${1:-}" == "--fast" ]]; then
-  FAST=1
+DRILL=0
+while [[ "${1:-}" == "--fast" || "${1:-}" == "--drill" ]]; do
+  if [[ "$1" == "--fast" ]]; then FAST=1; else DRILL=1; fi
   shift
-fi
+done
 
 echo "=== ci: arealint gate ==="
 bash scripts/lint.sh
@@ -29,6 +33,11 @@ bash scripts/lint.sh
 echo "=== ci: tier-1 pytest (CPU) ==="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider "$@"
+
+if [[ "$DRILL" == "1" ]]; then
+  echo "=== ci: disaster-recovery drill ==="
+  JAX_PLATFORMS=cpu python -m areal_tpu.drill --scenario trainer-kill
+fi
 
 if [[ "$FAST" == "0" ]]; then
   echo "=== ci: bench perf-regression gate ==="

@@ -1,0 +1,48 @@
+"""True negatives for unmanifested-checkpoint-write: manifest-format
+saves, raw writes off the checkpoint tree, and protocol-internal writes
+that also record digests."""
+
+import io
+import os
+
+import numpy as np
+from safetensors.numpy import save_file
+
+from areal_tpu.utils import checkpoint as ckpt_fmt
+from areal_tpu.utils.checkpoint import CheckpointWriter, save_named
+
+
+def save_params(checkpoint_dir, named_arrays):
+    # the sanctioned path: manifest + per-shard digests
+    save_named(checkpoint_dir, named_arrays)
+
+
+def save_sharded(checkpoint_dir, leaves):
+    w = CheckpointWriter(checkpoint_dir)
+    for name, arr in leaves.items():
+        w.add_leaf(name, arr)
+    w.commit()
+
+
+def encode_for_wire(data):
+    # savez into a memory buffer, nowhere near the checkpoint tree
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in data.items()})
+    return buf.getvalue()
+
+
+def export_hf(out_dir, tensors):
+    # HF export dir is interchange format, not the recoverable tree
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+
+
+def migrate_legacy_dump(checkpoint_dir, named_arrays):
+    # raw write AND a manifest: the function participates in the
+    # protocol (digests are recorded), so it is not a bypass
+    np.save(os.path.join(checkpoint_dir, "legacy_copy.npy"), named_arrays)
+    ckpt_fmt.save_named(checkpoint_dir, named_arrays)
+
+
+def load_params(checkpoint_dir):
+    # reads never flag
+    return np.load(os.path.join(checkpoint_dir, "params.npy"))

@@ -1,0 +1,30 @@
+"""True positives for unmanifested-checkpoint-write: raw array
+serializers aimed at the checkpoint tree, no manifest/digest in sight."""
+
+import os
+
+import numpy as np
+from safetensors.numpy import save_file
+from safetensors.numpy import save_file as st_save
+
+
+def save_params_flat(checkpoint_dir, arrs):
+    # shard bytes with no manifest entry: restore can't verify or re-shard
+    np.save(os.path.join(checkpoint_dir, "params.npy"), arrs)  # lint-expect: unmanifested-checkpoint-write
+
+
+def save_opt_state(root, step, arrs):
+    np.savez(root + "/ckpt/opt_state.npz", step=step, **arrs)  # lint-expect: unmanifested-checkpoint-write
+
+
+def save_compressed(ckpt_path, arrs):
+    np.savez_compressed(ckpt_path, **arrs)  # lint-expect: unmanifested-checkpoint-write
+
+
+def export_weights(checkpoint_root, tensors):
+    # safetensors takes the path SECOND — still a bypass
+    save_file(tensors, os.path.join(checkpoint_root, "model.safetensors"))  # lint-expect: unmanifested-checkpoint-write
+
+
+def export_aliased(run_state, tensors):
+    st_save(tensors, run_state.ckpt_dir + "/model.safetensors")  # lint-expect: unmanifested-checkpoint-write

@@ -1,0 +1,111 @@
+"""Full-system disaster drill (areal_tpu/drill): scenario runner,
+cross-plane invariants, and the plane shims' failure semantics."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from areal_tpu.drill import (
+    SCENARIOS,
+    DrillFleet,
+    DrillScenario,
+    RewardPool,
+    fast_scenario,
+    run_scenario,
+)
+
+
+def test_fast_scenario_is_tagged_fast():
+    assert "fast" in fast_scenario().tags
+
+
+def test_fleet_mid_stream_kill_tears_versions():
+    fleet = DrillFleet(3)
+    fleet.push_weights(1)
+    assert [s.version for s in fleet.servers] == [1, 1, 1]
+    # kill servers 1,2 after the stream reached 1 server of push 2
+    fleet.arm_kill(at_push=2, servers=(1, 2), after=1)
+    fleet.push_weights(2)
+    assert fleet.servers[0].version == 2
+    assert not fleet.servers[1].alive and not fleet.servers[2].alive
+    assert not fleet.reconciled_to(2)
+    repushed = fleet.reconcile(2)
+    assert sorted(repushed) == [fleet.servers[1].addr, fleet.servers[2].addr]
+    assert fleet.reconciled_to(2)
+
+
+def test_fleet_reconcile_rolls_back_newer_servers():
+    """A trainer that recovered to an OLDER checkpoint must pull servers
+    back down — mismatched weights generate poisoned rollouts either way."""
+    fleet = DrillFleet(2)
+    fleet.push_weights(5)
+    repushed = fleet.reconcile(3)
+    assert len(repushed) == 2
+    assert all(s.version == 3 for s in fleet.servers)
+
+
+def test_reward_pool_fails_over_around_wedged_replica():
+    pool = RewardPool(2, failover_timeout=0.05)
+    pool.wedge(1)
+
+    async def go():
+        return [await pool.score(v) for v in range(4)]
+
+    scores = asyncio.run(go())
+    assert scores == [float(v % 3) for v in range(4)]
+    assert pool.wedged_count() == 1
+
+
+def test_reward_pool_all_wedged_raises():
+    pool = RewardPool(2, failover_timeout=0.05)
+    pool.wedge(2)
+
+    async def go():
+        with pytest.raises(RuntimeError, match="every reward replica"):
+            await pool.score(1)
+
+    asyncio.run(go())
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_recovers_with_all_invariants(tmp_path, name):
+    """Every catalogued scenario must pass: step sequence identical to the
+    uninterrupted reference, counters balanced, zero torn commits, fleet
+    reconciled, MTTR within budget."""
+    report = run_scenario(name, str(tmp_path))
+    assert report.passed, report.failures
+    assert report.torn_commits == 0
+    assert report.counters_balanced
+    assert report.fleet_reconciled
+    assert 0 <= report.mttr_seconds < SCENARIOS[name].mttr_budget_seconds
+    assert report.recovered_at_step >= 1
+
+
+def test_scenario_whose_barrier_never_fires_is_a_failure(tmp_path):
+    """A drill that never actually killed the trainer must FAIL — a green
+    drill that silently skipped the kill is worse than a red one."""
+    sc = DrillScenario(
+        name="no-kill",
+        description="barrier count beyond the run length",
+        crash_barrier="mid-checkpoint@99",
+        steps=3,
+    )
+    report = run_scenario(sc, str(tmp_path))
+    assert not report.passed
+    assert "crash_fired" in report.failures
+
+
+def test_drill_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "areal_tpu.drill", "--scenario", "trainer-kill",
+         "--fileroot", str(tmp_path / "d")],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["passed"] and report["scenario"] == "trainer-kill"

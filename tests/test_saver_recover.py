@@ -359,8 +359,9 @@ def test_same_step_redump_crash_preserves_committed_dump(tmp_path, monkeypatch):
 
 def test_recover_dump_keeps_previous_until_commit(tmp_path):
     """Crash consistency of the dump itself: a new dump stages into its own
-    directory and the old one survives until the marker flips; after the
-    flip the old dump is GC'd."""
+    directory and the old one survives until the marker flips; retention
+    keeps ``keep_dumps`` committed dumps so a corrupted newest dump has a
+    fallback landing spot, and GC's anything older."""
     ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
     handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
     kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
@@ -368,7 +369,27 @@ def test_recover_dump_keeps_previous_until_commit(tmp_path):
     assert os.path.basename(root1) == "dump_globalstep1"
     root2 = handler.dump(_DummyEngine(), step(2), None, None, None, force=True, **kw)
     assert os.path.isdir(root2)
-    assert not os.path.isdir(root1)  # unreferenced after the new commit
+    # default keep_dumps=2: the previous dump survives as disaster fallback
+    assert os.path.isdir(root1)
+    root3 = handler.dump(_DummyEngine(), step(3), None, None, None, force=True, **kw)
+    assert os.path.isdir(root3) and os.path.isdir(root2)
+    assert not os.path.isdir(root1)  # beyond retention after the new commit
+    info = handler.load(_DummyEngine(), **kw)
+    assert info.last_step_info.global_step == 3
+
+
+def test_recover_dump_keep_dumps_one_gcs_previous(tmp_path):
+    """keep_dumps=1 restores the old disk-frugal behavior: only the newest
+    committed dump survives."""
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(
+        RecoverConfig(mode="fault", freq_steps=1, keep_dumps=1), ft
+    )
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    root1 = handler.dump(_DummyEngine(), step(1), None, None, None, force=True, **kw)
+    root2 = handler.dump(_DummyEngine(), step(2), None, None, None, force=True, **kw)
+    assert os.path.isdir(root2)
+    assert not os.path.isdir(root1)
     info = handler.load(_DummyEngine(), **kw)
     assert info.last_step_info.global_step == 2
 
@@ -555,8 +576,334 @@ def test_dataloader_refuses_mismatched_dataset(tmp_path):
     dl = StatefulDataLoader(list(range(16)), 4, seed=1)
     snap = dl.state_dict()
     other = StatefulDataLoader(list(range(20)), 4, seed=1)
-    with pytest.raises(ValueError, match="dataset changed"):
+    with pytest.raises(ValueError, match="dataset_size"):
         other.load_state_dict(snap)
+    # a batch-size change is NOT a refusal — the sample cursor remaps onto
+    # any batch size (elastic resume; see test_dataset_and_loader.py for
+    # the stream-identity pins)
     rebatched = StatefulDataLoader(list(range(16)), 8, seed=1)
-    with pytest.raises(ValueError, match="batch_size"):
-        rebatched.load_state_dict(snap)
+    rebatched.load_state_dict(snap)
+
+
+# ---------------------------------------------------------------------------
+# elastic resume: batch-size / host-count changes remap the sample cursor
+# ---------------------------------------------------------------------------
+
+
+def _flat(batches):
+    return [s for b in batches for s in b]
+
+
+def test_dataloader_resumes_at_different_batch_size(tmp_path):
+    """The elastic pin: a cursor saved at batch size 4 resumes at batch
+    size 6 with NO sample replayed and NONE skipped — the flattened
+    sample stream is identical to the uninterrupted one."""
+    data = list(range(24))
+    ref = _flat(_collect(StatefulDataLoader(data, 4, seed=9), n=6))  # epoch 0
+    dl = StatefulDataLoader(data, 4, seed=9)
+    first = _collect(dl, n=3)  # 12 samples consumed
+    snap = dl.state_dict()
+    dl2 = StatefulDataLoader(data, 6, seed=9)
+    dl2.load_state_dict(snap)
+    rest = _collect(dl2, n=2)  # 12 remaining samples at the new batch size
+    assert all(len(b) == 6 for b in rest)
+    assert _flat(first) + _flat(rest) == ref
+
+
+def test_dataloader_resumes_at_different_host_count(tmp_path):
+    """A replacement trainer with half the hosts consumes half the global
+    batch (8 -> 4): the sample stream continues exactly where it stopped,
+    across the epoch boundary."""
+    data = list(range(32))
+    ref = _flat(_collect(StatefulDataLoader(data, 8, seed=5), n=8))  # 2 epochs
+    dl = StatefulDataLoader(data, 8, seed=5)
+    first = _collect(dl, n=3)  # 24 samples into epoch 0
+    snap = dl.state_dict()
+    dl2 = StatefulDataLoader(data, 4, seed=5)
+    dl2.load_state_dict(snap)
+    rest = _collect(dl2, n=2 + 8)  # rest of epoch 0 (8 samples) + epoch 1
+    assert _flat(first) + _flat(rest) == ref
+
+
+def test_dataloader_legacy_batch_cursor_remaps(tmp_path):
+    """Pre-elastic states counted BATCHES; they remap through their saved
+    batch size onto the sample cursor."""
+    data = list(range(24))
+    ref = _flat(_collect(StatefulDataLoader(data, 4, seed=2), n=6))
+    legacy = {"epoch": 0, "batch_in_epoch": 3, "seed": 2, "batch_size": 4,
+              "dataset_size": 24}
+    dl = StatefulDataLoader(data, 4, seed=2)
+    dl.load_state_dict(legacy)
+    assert _flat(_collect(dl, n=3)) == ref[12:]
+
+
+def test_dataloader_refusals_name_exact_field(tmp_path):
+    from areal_tpu.utils.dataloader import IncompatibleResumeState
+
+    dl = StatefulDataLoader(list(range(16)), 4, seed=1)
+    with pytest.raises(IncompatibleResumeState, match="dataset_size"):
+        dl.load_state_dict(
+            {"epoch": 0, "sample_in_epoch": 0, "dataset_size": 999}
+        )
+    with pytest.raises(IncompatibleResumeState, match="batch_size"):
+        dl.load_state_dict({"epoch": 0, "batch_in_epoch": 2})
+    with pytest.raises(IncompatibleResumeState, match="sample_in_epoch"):
+        dl.load_state_dict(
+            {"epoch": 0, "sample_in_epoch": 17, "dataset_size": 16}
+        )
+
+
+# ---------------------------------------------------------------------------
+# AREAL_CHAOS_FS: injected filesystem faults through the atomic helpers
+# ---------------------------------------------------------------------------
+
+
+def test_fs_fault_grammar(tmp_path, monkeypatch):
+    import errno
+
+    from areal_tpu.utils import chaos
+    from areal_tpu.utils.fs import atomic_write_text
+
+    target = str(tmp_path / "target.txt")
+    atomic_write_text(target, "committed")
+    monkeypatch.setenv(chaos.FS_CHAOS_ENV, "target.txt:eio@2")
+    chaos.reset_fs_faults()
+    atomic_write_text(target, "first write passes")  # @2: fires on the 2nd
+    with pytest.raises(OSError) as ei:
+        atomic_write_text(target, "never lands")
+    assert ei.value.errno == errno.EIO
+    # the fault fired BEFORE the rename: the previous commit is intact
+    assert open(target).read() == "first write passes"
+    monkeypatch.setenv(chaos.FS_CHAOS_ENV, "target.txt:bogus")
+    chaos.reset_fs_faults()
+    with pytest.raises(ValueError, match="bogus"):
+        atomic_write_text(target, "x")
+    chaos.reset_fs_faults()
+
+
+def test_enospc_mid_dump_preserves_committed_checkpoint(tmp_path, monkeypatch):
+    """The satellite pin: a dump that hits ENOSPC leaves the PREVIOUS
+    committed checkpoint fully intact and resumable; once space returns,
+    dumping and resuming proceed normally."""
+    import errno
+
+    from areal_tpu.utils import chaos
+
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    handler.dump(
+        _DummyEngine(), step(3), None, None, _DummyLoader(pos=7), force=True, **kw
+    )
+    monkeypatch.setenv(chaos.FS_CHAOS_ENV, "dump_globalstep4:enospc")
+    chaos.reset_fs_faults()
+    with pytest.raises(OSError) as ei:
+        handler.dump(
+            _DummyEngine(), step(4), None, None, _DummyLoader(pos=9),
+            force=True, **kw,
+        )
+    assert ei.value.errno == errno.ENOSPC
+    monkeypatch.delenv(chaos.FS_CHAOS_ENV)
+    chaos.reset_fs_faults()
+    eng, dl = _DummyEngine(), _DummyLoader()
+    info = handler.load(eng, None, None, dl, **kw)
+    assert info is not None and info.last_step_info.global_step == 3
+    assert dl.pos == 7 and eng.loaded is not None
+    # space is back: the next dump commits and supersedes
+    handler.dump(
+        _DummyEngine(), step(4), None, None, _DummyLoader(pos=9), force=True, **kw
+    )
+    assert handler.load(_DummyEngine(), **kw).last_step_info.global_step == 4
+
+
+def test_short_write_on_marker_preserves_previous_marker(tmp_path, monkeypatch):
+    """A torn write of the commit marker itself must leave the previous
+    marker (and therefore the previous resume point) in force."""
+    from areal_tpu.utils import chaos
+
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    handler.dump(
+        _DummyEngine(), step(2), None, None, _DummyLoader(pos=5), force=True, **kw
+    )
+    monkeypatch.setenv(chaos.FS_CHAOS_ENV, "recover_info.json:short")
+    chaos.reset_fs_faults()
+    with pytest.raises(OSError):
+        handler.dump(
+            _DummyEngine(), step(3), None, None, _DummyLoader(pos=6),
+            force=True, **kw,
+        )
+    monkeypatch.delenv(chaos.FS_CHAOS_ENV)
+    chaos.reset_fs_faults()
+    info = handler.load(_DummyEngine(), None, None, _DummyLoader(), **kw)
+    assert info is not None and info.last_step_info.global_step == 2
+
+
+# ---------------------------------------------------------------------------
+# corruption-refusing restore: digest fallback to a retained dump
+# ---------------------------------------------------------------------------
+
+
+class _ManifestEngine:
+    """Engine stand-in whose checkpoints ARE manifest-format — exercises
+    the real digest-verify path in recover without a full TrainEngine."""
+
+    def __init__(self, value=1.0):
+        self.value = value
+        self.w = np.full((8,), value, np.float32)
+        self.loaded_from = None
+
+    def save(self, meta):
+        from areal_tpu.utils.checkpoint import save_named
+
+        save_named(meta.path, {"w": self.w})
+
+    def load(self, meta):
+        from areal_tpu.utils.checkpoint import load_named
+
+        named, _ = load_named(meta.path)
+        self.w = named["w"]
+        self.loaded_from = meta.path
+
+
+def test_bit_flip_in_committed_dump_falls_back_to_retained(tmp_path, monkeypatch):
+    """The acceptance pin: a bit-flipped shard in the newest dump is
+    refused BY DIGEST before any weights load; the restore falls back to
+    the previous retained dump, rewinding the loop state to ITS step, and
+    the flight recorder names the failing leaf."""
+    import glob
+
+    from areal_tpu.utils import flight_recorder
+
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    handler.dump(
+        _ManifestEngine(1.0), step(1), None, None, _DummyLoader(pos=1),
+        force=True, **kw,
+    )
+    root2 = handler.dump(
+        _ManifestEngine(2.0), step(2), None, None, _DummyLoader(pos=2),
+        force=True, **kw,
+    )
+    shard = sorted(glob.glob(os.path.join(root2, "engine", "shards", "*.bin")))[0]
+    raw = bytearray(open(shard, "rb").read())
+    raw[0] ^= 0x01
+    with open(shard, "wb") as f:
+        f.write(raw)
+    seen = []
+    monkeypatch.setattr(
+        flight_recorder,
+        "record",
+        lambda channel, kind, **fields: seen.append((channel, kind, fields)),
+    )
+    eng, dl = _ManifestEngine(0.0), _DummyLoader()
+    info = handler.load(eng, None, None, dl, **kw)
+    # fell back to the step-1 dump, with step-1 loop state
+    assert info is not None and info.last_step_info.global_step == 1
+    assert dl.pos == 1
+    np.testing.assert_array_equal(eng.w, np.full((8,), 1.0, np.float32))
+    assert eng.loaded_from.endswith(os.path.join("dump_globalstep1", "engine"))
+    assert any(
+        k == "shard_verify_failed" and f.get("leaf") == "w"
+        for _, k, f in seen
+    )
+
+
+def test_all_dumps_corrupt_refuses_loudly(tmp_path):
+    from areal_tpu.utils.recover import RecoverStateCorrupted
+
+    ft = FinetuneSpec(total_train_epochs=1, dataset_size=16, train_batch_size=4)
+    handler = RecoverHandler(RecoverConfig(mode="fault", freq_steps=1), ft)
+    kw = dict(fileroot=str(tmp_path), experiment_name="e", trial_name="t")
+    for i in (1, 2):
+        root = handler.dump(
+            _ManifestEngine(float(i)), step(i), None, None, _DummyLoader(pos=i),
+            force=True, **kw,
+        )
+        import glob
+
+        for shard in glob.glob(os.path.join(root, "engine", "shards", "*.bin")):
+            raw = bytearray(open(shard, "rb").read())
+            raw[0] ^= 0xFF
+            with open(shard, "wb") as f:
+                f.write(raw)
+    with pytest.raises(RecoverStateCorrupted, match="no retained recover dump"):
+        handler.load(_ManifestEngine(0.0), None, None, _DummyLoader(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Saver latest-pointer validation and fallback
+# ---------------------------------------------------------------------------
+
+
+def _manifest_save_dirs(saver, steps):
+    """Write manifest-format checkpoint dirs + latest pointer the way
+    Saver.save lays them out."""
+    from areal_tpu.utils.checkpoint import save_named
+    from areal_tpu.utils.fs import atomic_write_text
+    from areal_tpu.utils.saver import LATEST_POINTER
+
+    root = saver.save_root()
+    paths = []
+    for i in steps:
+        path = os.path.join(root, f"epoch0epochstep{i}globalstep{i}")
+        save_named(path, {"w": np.full((4,), float(i), np.float32)})
+        paths.append(path)
+    atomic_write_text(
+        os.path.join(root, LATEST_POINTER), os.path.basename(paths[-1]) + "\n"
+    )
+    return paths
+
+
+def test_resolve_latest_returns_valid_pointer_target(tmp_path):
+    saver = _retention_saver(tmp_path)
+    paths = _manifest_save_dirs(saver, [1, 2, 3])
+    assert saver.resolve_latest_checkpoint() == paths[-1]
+
+
+def test_resolve_latest_falls_back_on_dangling_pointer(tmp_path, monkeypatch):
+    from areal_tpu.utils import saver as saver_mod
+    from areal_tpu.utils.fs import atomic_write_text
+    from areal_tpu.utils.saver import LATEST_POINTER
+
+    saver = _retention_saver(tmp_path)
+    paths = _manifest_save_dirs(saver, [1, 2])
+    atomic_write_text(
+        os.path.join(saver.save_root(), LATEST_POINTER), "epoch0epochstep9globalstep9\n"
+    )
+    warned = []
+    monkeypatch.setattr(
+        saver_mod.logger, "warning", lambda msg, *a: warned.append(msg % a)
+    )
+    assert saver.resolve_latest_checkpoint() == paths[-1]
+    # the warning is loud and names what was wrong with the pointer
+    assert warned and "falling back" in warned[0] and "GC'd" in warned[0]
+
+
+def test_resolve_latest_falls_back_on_corrupt_target(tmp_path, monkeypatch):
+    import glob
+
+    from areal_tpu.utils import saver as saver_mod
+
+    saver = _retention_saver(tmp_path)
+    paths = _manifest_save_dirs(saver, [1, 2, 3])
+    for shard in glob.glob(os.path.join(paths[-1], "shards", "*.bin")):
+        raw = bytearray(open(shard, "rb").read())
+        raw[0] ^= 0x10
+        with open(shard, "wb") as f:
+            f.write(raw)
+    warned = []
+    monkeypatch.setattr(
+        saver_mod.logger, "warning", lambda msg, *a: warned.append(msg % a)
+    )
+    # newest VERIFYING checkpoint wins — the corrupted pointee is skipped
+    assert saver.resolve_latest_checkpoint() == paths[-2]
+    assert warned and "digest mismatch" in warned[0]
+
+
+def test_resolve_latest_none_when_nothing_verifies(tmp_path):
+    saver = _retention_saver(tmp_path)
+    os.makedirs(saver.save_root(), exist_ok=True)
+    assert saver.resolve_latest_checkpoint() is None
