@@ -709,6 +709,25 @@ class JaxGenConfig:
     # hardcoded 600s deep in the engine); covers worst-case compile of a
     # fresh decode/prefill program
     command_timeout_seconds: float = 600.0
+    # TTL for retained abort/interrupt KV whose owner never resumes (a
+    # client that disconnects mid-interrupt-loop would otherwise pin its
+    # slot until LRU pressure): the engine-loop reaper frees entries older
+    # than this many seconds and counts them in
+    # serving_stats()["retained_kv_reaped_total"]. <= 0 disables the reaper.
+    retained_kv_ttl_seconds: float = 300.0
+    # priority preemption: when a strictly-higher-priority request cannot
+    # be admitted, interrupt the lowest-priority running victim at the next
+    # token boundary (KV retained pinned, victim auto-requeued at its
+    # original queue position and resumed with zero re-prefill once
+    # capacity returns). All-equal-priority traffic — the default — is
+    # never preempted, so this is safe to leave on.
+    enable_preemption: bool = True
+    # server-side default drain budget (POST /drain without an explicit
+    # grace, and the launcher's SIGTERM path): in-flight sequences get this
+    # many seconds to finish naturally before the engine interrupts the
+    # rest at the next token boundary (clients resume token-exactly on a
+    # peer). Bounds shutdown wall-time by grace, not max generation length.
+    interrupt_grace_seconds: float = 30.0
     # persistent JAX compilation cache directory: relaunch-after-preemption
     # reloads compiled executables from here instead of paying full XLA
     # recompile (utils/jax_cache.configure_compilation_cache). None = off.
@@ -794,6 +813,13 @@ class FleetConfig:
     # SIGTERM -> SIGKILL grace for scale-in victims (the PR 4 drain path:
     # in-flight requests finish or fail over within it)
     drain_grace_seconds: float = 30.0
+    # bounded-time drain: before terminating a scale-in victim the
+    # controller POSTs /drain with this budget — sequences still running at
+    # the deadline are INTERRUPTED at the next token boundary and resume
+    # token-exactly on a healthy peer through the failover splice, so drain
+    # wall-time is bounded by this grace, not by max generation length.
+    # <= 0 skips the interrupt-drain phase (legacy finish-or-fail-over).
+    interrupt_grace_seconds: float = 15.0
     # per-server /model_info signal-poll timeout
     signal_timeout_seconds: float = 2.0
     # provider: "local" (subprocess on this host) | "slurm" | "gke" (stubs)
@@ -912,6 +938,11 @@ class InferenceEngineConfig:
     # per-request re-dispatches to a different server after a failed
     # generate attempt (accumulated tokens replay as the new prompt)
     failover_retries: int = 3
+    # client-side backoff between abort-resume attempts when the server
+    # made NO forward progress (paused engine / drained queue); interrupt
+    # responses that did emit tokens resume immediately (was a hardcoded
+    # 0.2 in the resume loop)
+    abort_resume_backoff_seconds: float = 0.2
     # overall wall-clock budget for one agenerate call including all
     # failover re-dispatches; 0 = no overall deadline
     failover_deadline_seconds: float = 0.0

@@ -104,6 +104,11 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # lower is better; correctness invariants gate in-child, the sentinel
     # only watches the recovery latency trend
     "recovery_drill": True,
+    # effective staleness in stale-tokens-per-episode after an in-flight
+    # weight-swap request: lower is better (the unit defeats the name
+    # heuristic); greedy identity and commit-spanning versions gate
+    # in-child, the sentinel watches the token-boundary latency trend
+    "inflight_weight_swap": True,
 }
 
 
@@ -120,10 +125,16 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
 #: are scheduling-noise dominated (the interpret grid unrolls in python);
 #: a wide band keeps rehearsal noise from gating while a genuine break
 #: (kernel wedged/erroring) still fails the rung's in-child asserts.
+#: inflight_weight_swap's headline is a SMALL integer token count (how
+#: many tokens decode between the swap request and the token-boundary
+#: interrupt) — on CPU rehearsal it is scheduler-timing dominated and a
+#: one-token wiggle is a large relative move; a genuine break (interrupt
+#: path dead) pushes it to the full episode length, far outside any band.
 BAND_FLOOR_OVERRIDES: dict[str, float] = {
     "elastic_fleet": 0.20,
     "chunked_prefill_attention": 0.25,
     "kv_quant_decode": 0.25,
+    "inflight_weight_swap": 0.50,
 }
 
 

@@ -762,7 +762,13 @@ class RemoteInfEngine(InferenceEngine):
         # re-issue of this request — and every sibling of its GRPO group —
         # hashes identically, so they all prefer the same server's cache
         affinity_key = self.prefix_affinity_key(prompt)
-        while stop_reason == "abort" and len(accumulated) < max_new:
+        # "abort" (pause fence) and "interrupt" (token-boundary interrupt:
+        # drain, preemption-eviction, operator) both resume by replaying
+        # prompt+accumulated — the server's retained-KV resume path turns
+        # the replay into zero (or suffix-only) re-prefill; after a drain
+        # the failed server leaves rotation and a healthy peer continues
+        # token-exactly through this same loop
+        while stop_reason in ("abort", "interrupt") and len(accumulated) < max_new:
             while self._paused.is_set():
                 await asyncio.sleep(0.05)
             if addr is None:
@@ -896,12 +902,21 @@ class RemoteInfEngine(InferenceEngine):
             versions += result["output_versions"]
             itl += result.get("itl", [])
             stop_reason = result["stop_reason"]
+            if stop_reason == "interrupt":
+                # re-consult routing instead of pinning the loop to the
+                # last address: a drained/removed server is already out of
+                # rotation (remove_server dropped its rid affinities), so
+                # the resume lands on a healthy peer and re-prefills
+                # prompt+accumulated; an operator/preemption interrupt on a
+                # still-routable server keeps its rid affinity and resumes
+                # there against the retained KV with zero re-prefill
+                addr = None
             if stop_reason == "abort" and n_new == 0:
                 # the server is paused by someone other than this
                 # client (launcher-driven update, another process):
                 # back off instead of busy-spinning
                 # issue->abort->issue HTTP loops
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(self.config.abort_resume_backoff_seconds)
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=accumulated,

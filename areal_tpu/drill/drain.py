@@ -1,0 +1,256 @@
+"""Bounded-drain drill (``kind="drain"`` scenarios): real generation
+servers, no trainer kill.
+
+Two in-process HTTP generation servers share identical weights. Every
+episode of the drill is pinned to server A and provably mid-decode when
+the drill fences routing (``remove_server``, the fleet controller's
+scale-in order) and POSTs ``/drain``. Invariants, mapped onto
+:class:`~areal_tpu.drill.runner.DrillReport`:
+
+- **drain bounded** (``mttr_seconds``): the drain's wall-time is within
+  the scenario's grace budget plus the token-boundary latency — NOT the
+  max generation length the episodes would otherwise run for.
+- **zero episodes lost** (``counters_balanced``): every episode completes
+  with its full token count despite the drain.
+- **token-identical resume** (``torn_commits``): each interrupted
+  episode's spliced output equals an undrained greedy reference — a
+  mismatch counts exactly like a torn commit in the recover drills.
+- **drained server quiesced** (``fleet_reconciled``): server A ends with
+  zero pending work and its pinned retained KV reaped back to zero.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from areal_tpu.utils import logging
+
+from .scenarios import DrillScenario
+
+logger = logging.getLogger("drill")
+
+
+def _post(addr: str, path: str, payload: dict, timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def run_drain_drill(sc: DrillScenario, fileroot: str):
+    """Execute one drain scenario and return the invariant report.
+    ``fileroot`` is accepted for CLI parity but unused — the drill holds
+    no on-disk state."""
+    # heavyweight deps stay lazy so `--list` and the recover drills never
+    # pay the jax import
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.lm import init_params
+
+    from .runner import DrillReport
+
+    failures: dict[str, str] = {}
+    n_ep = sc.batch_size
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+
+    def serve():
+        engine = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=n_ep,
+                max_seq_len=2048,
+                prefill_chunk=64,
+                decode_steps_per_call=4,
+                dtype="float32",
+                # small TTL so the drill can watch the drained server's
+                # pinned retained KV reaped back to zero
+                retained_kv_ttl_seconds=0.5,
+            ),
+            model_config=cfg,
+            params=params,
+        )
+        server = GenerationServer(engine)
+        loop = asyncio.new_event_loop()
+        threading.Thread(target=loop.run_forever, daemon=True).start()
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=120)
+
+        def stop():
+            asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+                timeout=30
+            )
+            loop.call_soon_threadsafe(loop.stop)
+
+        return f"127.0.0.1:{port}", engine, stop
+
+    addr_a, eng_a, stop_a = serve()
+    addr_b, eng_b, stop_b = serve()
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name="drill", trial_name="drain",
+            max_concurrent_rollouts=2 * n_ep, consumer_batch_size=n_ep,
+            request_retries=2,
+        )
+    )
+    client.initialize([addr_a, addr_b], train_data_parallel_size=1)
+
+    wall = float("inf")
+    resumed_on_peer, lost, mismatched = 0, 0, 0
+    quiesced = False
+    try:
+        prompts = [[3 + i, 9, 1 + 2 * i, 6] for i in range(n_ep)]
+        gc = GenerationHyperparameters(
+            max_new_tokens=sc.episode_tokens, greedy=True
+        )
+        # undrained reference, pinned to the survivor (greedy + identical
+        # weights => the drained episodes must reproduce it exactly)
+        refs = []
+        for i, p in enumerate(prompts):
+            client._rid_to_address[f"ref-{i}"] = addr_b
+            refs.append(
+                client.generate(
+                    ModelRequest(rid=f"ref-{i}", input_ids=p, gconfig=gc)
+                )
+            )
+
+        results: list = [None] * n_ep
+
+        def run(i):
+            client._rid_to_address[f"ep-{i}"] = addr_a
+            results[i] = client.generate(
+                ModelRequest(rid=f"ep-{i}", input_ids=prompts[i], gconfig=gc)
+            )
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(n_ep)
+        ]
+        for t in threads:
+            t.start()
+        # every slot of A must be provably mid-decode before the drain
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            live = sum(
+                1
+                for seq in eng_a.slots
+                if seq is not None and len(seq.out_tokens) >= 3
+            )
+            if live >= n_ep:
+                break
+            time.sleep(0.01)
+        else:
+            failures["load_established"] = (
+                f"only {live}/{n_ep} episodes mid-decode on the victim"
+            )
+
+        # the controller's scale-in order: fence routing, then drain
+        client.remove_server(addr_a, reason="drill-scale-in")
+        out = _post(
+            addr_a, "/drain", {"grace_seconds": sc.grace_seconds},
+            timeout=sc.mttr_budget_seconds + 60.0,
+        )
+        wall = float(out["wall_seconds"])
+        if out.get("interrupted", 0) < 1:
+            failures["drain_interrupted"] = (
+                "drain caught zero in-flight episodes — the drill never "
+                "exercised the interrupt path"
+            )
+        if wall > sc.mttr_budget_seconds:
+            failures["drain_bounded"] = (
+                f"drain took {wall:.2f}s against a "
+                f"{sc.mttr_budget_seconds}s budget "
+                f"(grace {sc.grace_seconds}s)"
+            )
+
+        for t in threads:
+            t.join(timeout=180)
+        for i, (resp, ref) in enumerate(zip(results, refs)):
+            if (
+                resp is None
+                or resp.stop_reason not in ("stop", "length")
+                or len(resp.output_tokens) != sc.episode_tokens
+            ):
+                lost += 1
+                failures.setdefault("episodes_lost", "")
+                failures["episodes_lost"] += f" ep-{i}"
+                continue
+            if resp.output_tokens != ref.output_tokens:
+                mismatched += 1
+                failures.setdefault("token_identical", "")
+                failures["token_identical"] += f" ep-{i}"
+            if client._rid_to_address.get(f"ep-{i}") == addr_b:
+                resumed_on_peer += 1
+        if resumed_on_peer < 1:
+            failures["resumed_on_peer"] = (
+                "no episode finished on the surviving server"
+            )
+
+        # drained server quiesces: nothing pending, retained KV reaped
+        reap_deadline = time.monotonic() + 10.0
+        while time.monotonic() < reap_deadline:
+            eng_a._wake.set()  # the idle loop only reaps when awake
+            if (
+                eng_a.n_pending_work == 0
+                and eng_a.serving_stats()["retained_kv_slots"] == 0
+            ):
+                quiesced = True
+                break
+            time.sleep(0.05)
+        if not quiesced:
+            failures["drained_quiesced"] = (
+                f"pending={eng_a.n_pending_work} retained="
+                f"{eng_a.serving_stats()['retained_kv_slots']} after drain"
+            )
+    finally:
+        client.destroy()
+        stop_a()
+        stop_b()
+
+    report = DrillReport(
+        scenario=sc.name,
+        passed=not failures,
+        mttr_seconds=wall if wall != float("inf") else -1.0,
+        recovered_at_step=resumed_on_peer,
+        steps=n_ep,
+        torn_commits=mismatched,
+        counters_balanced=(lost == 0),
+        fleet_reconciled=quiesced,
+        repushed_servers=[],
+        failures=failures,
+    )
+    logger.info(
+        "drill %s: %s (drain wall %.3fs, %d/%d episodes resumed on peer)",
+        sc.name,
+        "PASSED" if report.passed else f"FAILED {sorted(failures)}",
+        report.mttr_seconds,
+        resumed_on_peer,
+        n_ep,
+    )
+    return report

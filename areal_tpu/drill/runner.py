@@ -117,6 +117,12 @@ def run_scenario(
     """Execute one scenario under ``fileroot`` (which must be empty or
     fresh — the drill owns it) and return the invariant report."""
     sc = SCENARIOS[scenario] if isinstance(scenario, str) else scenario
+    if sc.kind == "drain":
+        # bounded-drain drills run real generation servers, not the
+        # kill/recover loop — lazy import keeps jax off this module
+        from .drain import run_drain_drill
+
+        return run_drain_drill(sc, fileroot)
     failures: dict[str, str] = {}
 
     ref_trace, ref_steps = _reference_run(sc, os.path.join(fileroot, "ref"))

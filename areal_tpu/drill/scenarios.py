@@ -23,6 +23,15 @@ class DrillScenario:
     description: str
     #: AREAL_CRASH_AT spec for the trainer kill, e.g. "mid-checkpoint@3"
     crash_barrier: str
+    #: which runner executes the scenario: "recover" = the kill/recover
+    #: loop in runner.py; "drain" = the bounded-drain drill in drain.py
+    #: (real generation servers, no trainer kill — crash_barrier unused)
+    kind: str = "recover"
+    #: drain drills: grace budget handed to POST /drain
+    grace_seconds: float = 0.5
+    #: drain drills: per-episode generation length — long enough that the
+    #: episodes are provably still decoding when the drain lands
+    episode_tokens: int = 400
     #: fleet server indices SIGKILLed mid-weight-stream (empty = no kill)
     kill_servers: tuple[int, ...] = ()
     #: which weight push (1-based) the kill lands inside
@@ -84,6 +93,25 @@ SCENARIOS: dict[str, DrillScenario] = {
             kill_at_push=3,
             kill_after=2,
             wedge_rewards=1,
+        ),
+        DrillScenario(
+            name="drain-under-load",
+            description=(
+                "bounded-time scale-in drain: every slot of one of two "
+                "real generation servers is mid-decode when the fleet "
+                "fences routing and POSTs /drain — the drain must return "
+                "within the grace budget (not after max generation "
+                "length), zero episodes may be lost, and every "
+                "interrupted episode must resume on the surviving peer "
+                "with output token-identical to an undrained reference"
+            ),
+            crash_barrier="",  # no trainer kill: the drain runner ignores it
+            kind="drain",
+            grace_seconds=0.5,
+            episode_tokens=400,
+            batch_size=3,
+            fleet_size=2,
+            mttr_budget_seconds=30.0,
         ),
     ]
 }

@@ -475,6 +475,12 @@ class FleetController:
         # ones finish inside the drain grace or fail over token-exactly
         if not self.client.remove_server(victim, reason="scale-in"):
             return False
+        # bounded-time drain: with routing off, POST /drain gives in-flight
+        # sequences interrupt_grace_seconds to finish, then interrupts the
+        # stragglers at a token boundary (KV-retaining) — their clients
+        # fail over and resume token-exactly on a healthy peer, so the
+        # terminate below never waits out a whole episode
+        self._interrupt_drain(victim)
         if handle is not None:
             self._members.pop(victim, None)
             self._deregister(victim, server_id=server_id)
@@ -550,6 +556,33 @@ class FleetController:
                 "deregister of %s (%s) failed", server_id, addr,
                 exc_info=True,
             )
+
+    def _interrupt_drain(self, addr: str) -> None:
+        """POST /drain to a scale-in victim (routing already fenced off):
+        wall-time is bounded by ``interrupt_grace_seconds``, not max
+        generation length. Best-effort — a victim that cannot answer is
+        simply terminated/drained through the legacy path."""
+        grace = self.config.interrupt_grace_seconds
+        if grace <= 0:
+            return
+        try:
+            req = urllib.request.Request(
+                f"http://{addr}/drain",
+                data=json.dumps({"grace_seconds": grace}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=grace + 10.0) as r:
+                body = json.loads(r.read().decode() or "{}")
+            self._note(
+                "drain_interrupted",
+                addr=addr,
+                interrupted=int(body.get("interrupted", 0)),
+                wall_seconds=round(float(body.get("wall_seconds", 0.0)), 3),
+                grace_seconds=grace,
+            )
+        except Exception as e:
+            logger.warning("interrupt-drain of %s failed: %s", addr, e)
 
     def _request_drain(self, addr: str, server_id: str | None) -> None:
         exp, trial = self._exp_trial()

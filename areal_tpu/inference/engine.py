@@ -92,6 +92,9 @@ class _Seq:
     images: list | None = None  # decoded [S, S, 3] float arrays, or for
     # qwen2_vl: HF-processor patch arrays [P_i, C*tps*ps*ps]
     grids: list | None = None  # qwen2_vl (t, h, w) per image
+    # the scheduler entry this seq was popped with: preemption hands it
+    # back via push_front so the victim requeues at its ORIGINAL position
+    sched_entry: dict | None = None
 
     @property
     def max_total(self) -> int:
@@ -102,6 +105,27 @@ class _Seq:
         if eos_token_id is not None:
             s.add(eos_token_id)
         return s
+
+
+@dataclasses.dataclass
+class _Retained:
+    """Retained KV for one interrupted/aborted/preempted rid: ``slot``'s
+    cache rows [0, len(covered)) hold the K/V of ``covered``; ``feed_tok``
+    is the next token to feed decode (its row is written when fed).
+
+    ``version`` tags the weight version the OWNING sequence last decoded
+    under — a resume that finds ``version != engine.version`` crossed a
+    staged commit and continues on the NEW weights (accepted staleness;
+    per-token ``versions`` record the crossing for decoupled PPO).
+    ``pinned`` entries (explicit interrupts, scheduler preemptions) are
+    evicted only as a last resort; plain abort retention goes first."""
+
+    slot: int
+    covered: tuple
+    feed_tok: int
+    ts: float
+    version: int
+    pinned: bool = False
 
 
 class GenerationEngine:
@@ -408,7 +432,12 @@ class GenerationEngine:
         self._shutdown = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
-        self._abort_rids: set[str] = set()
+        self._abort_rids: set[str] = set()  # guarded_by: _lock
+        # token-boundary interruption (interrupt()/interrupt_all()): rid ->
+        # reason, swapped out by the engine thread between decode chunks —
+        # the interrupted sequence answers with stop_reason="interrupt" and
+        # its KV stays retained (pinned) for the resume path
+        self._interrupt_rids: dict[str, str] = {}  # guarded_by: _lock
         # Pipelined weight sync: chunks are STAGED off the engine thread
         # (device_put onto the live leaves' shardings, no touch of
         # self.params) while decode dispatches continue; the engine thread
@@ -423,16 +452,30 @@ class GenerationEngine:
         # adapter-native serving: pristine base params retained across
         # adapter-only updates (None until the first /update_lora_weights)
         self._lora_base = None
-        # KV retention across abort-resume (VERDICT r1 weak #4): rid ->
-        # (slot, tokens covered by the slot's cache, next feed token, ts).
-        # The client's interrupt loop re-issues prompt+accumulated; a match
-        # resumes decode with ZERO re-prefill. Survives weight updates by
-        # design: per-token versions still record the sampling policy and
-        # the trainer recomputes exact logprobs (decoupled PPO), while the
-        # retained attention state is an accepted staleness (knob:
-        # JaxGenConfig.retain_kv_on_abort).
-        self._retained: dict[str, tuple[int, tuple, int, float]] = {}
-        self._retained_slots: dict[int, str] = {}
+        # KV retention across interrupt/abort-resume (VERDICT r1 weak #4):
+        # rid -> _Retained (slot, covered tokens, next feed token, ts,
+        # weight version at retention, pin). The client's interrupt loop
+        # re-issues prompt+accumulated; an exact match resumes decode with
+        # ZERO re-prefill, and a longer re-issue that still extends the
+        # covered prefix recomputes ONLY the uncovered suffix. Survives
+        # weight updates by design: per-token versions still record the
+        # sampling policy and the trainer recomputes exact logprobs
+        # (decoupled PPO), while the retained attention state is an
+        # accepted staleness (knob: JaxGenConfig.retain_kv_on_abort).
+        # _retained_lock is a LEAF lock: held only around map reads/writes,
+        # never across calls that take _lock, _staging_lock, or the
+        # scheduler's lock (lock-order pass seed for the interrupt paths).
+        # lock_order: GenerationEngine._lock -> GenerationEngine._retained_lock
+        self._retained: dict[str, _Retained] = {}  # guarded_by: _retained_lock
+        self._retained_slots: dict[int, str] = {}  # guarded_by: _retained_lock
+        self._retained_lock = threading.Lock()
+        # rids the PREEMPTION path requeued internally (client never saw a
+        # response): losing their retained KV must convert them to a
+        # client-visible interrupt, not a silent corruption. Engine-thread
+        # only, like _warming.
+        self._preempted_rids: set[str] = set()
+        # next retained-KV TTL sweep (engine thread; 0 knob disables)
+        self._next_reap = 0.0
         # Prompt-prefix KV reuse (the SGLang radix-cache role for the
         # dominant RL pattern): _slot_covered[i] = the token sequence (a
         # list, appended per decoded token) whose K/V rows live in cache
@@ -473,6 +516,19 @@ class GenerationEngine:
         # iterations; invisible to decode until warm
         self._warming: dict[int, dict] = {}
         self.chunked_prefill_count = 0
+        # token-boundary interruption ledger (tentpole observability):
+        # total + by-reason ("manual" | "drain" | "preempt" | "chaos" |
+        # "reaped"), resumes split by exact-match vs suffix-recompute, and
+        # how many resumes crossed a staged weight commit (their per-token
+        # versions span the commit — the version-mix telemetry shows it)
+        self.interrupts_total = 0
+        self.interrupts_by_reason: dict[str, int] = {}
+        self.resumed_total = 0
+        self.resumed_tokens_total = 0  # KV tokens reused (not recomputed)
+        self.resume_suffix_recomputed_tokens_total = 0
+        self.resumed_across_commit_total = 0
+        self.preemptions_total = 0
+        self.retained_kv_reaped_total = 0
         # served-token counters (the reference gserver_manager's per-server
         # token-usage tracking role, realhf/system/gserver_manager.py):
         # prompt_tokens_total counts every ADMITTED request's prompt
@@ -539,6 +595,11 @@ class GenerationEngine:
         )
         self._itl_hist = _metrics.DEFAULT_REGISTRY.histogram(
             "areal_inter_token_seconds", "inter-token latency"
+        )
+        self._c_interrupts = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_interrupts_total",
+            "token-boundary interruptions, by reason",
+            labels=("reason",),
         )
         self._metrics_collector = None
 
@@ -825,11 +886,14 @@ class GenerationEngine:
         finished-slot prefix caches go first; retained abort-resume state
         is evicted only when nothing else is left (its loss forces a full
         re-prefill on resume)."""
+        with self._retained_lock:
+            retained_slots = set(self._retained_slots)
+            has_retained = bool(self._retained)
         cands = [
             i
             for i, s in enumerate(self.slots)
             if s is None
-            and i not in self._retained_slots
+            and i not in retained_slots
             and i not in self._warming  # mid-warm blocks are LIVE
             and self._slot_nblocks[i] > 0
         ]
@@ -838,7 +902,7 @@ class GenerationEngine:
                 min(cands, key=lambda j: self._slot_last_use[j])
             )
             return True
-        if self._retained:
+        if has_retained:
             self._evict_lru_retained()  # demotes its slot to plain-cached
             return self._reclaim_blocks()
         return False
@@ -1055,6 +1119,27 @@ class GenerationEngine:
         with self._lock:
             self._abort_rids.add(rid)
         self._wake.set()
+
+    def interrupt(self, rid: str, reason: str = "manual"):
+        """Stop ``rid`` at the next token boundary (the engine loop checks
+        between decode chunks, so the wait is bounded by one
+        ``decode_steps_per_call`` window, never by max generation length).
+        The sequence answers with ``stop_reason="interrupt"`` carrying its
+        partial output, and its KV stays retained (pinned, tagged with the
+        current weight version) so a re-issue of prompt+accumulated resumes
+        with zero re-prefill — or, after a staged commit, recomputes only
+        the uncovered suffix and continues on the NEW weights."""
+        with self._lock:
+            self._interrupt_rids[rid] = reason
+        self._wake.set()
+
+    def interrupt_all(self, reason: str = "drain") -> None:
+        """Interrupt every in-flight, warming, and queued request at the
+        next token boundary and block until all their responses fired
+        (bounded-time drain: wall time is one decode chunk plus response
+        fan-out, not max generation length). Thread-safe; raises on engine
+        death like any blocking command."""
+        self._run_command("interrupt_all", reason)
 
     @property
     def healthy(self) -> bool:
@@ -1321,6 +1406,13 @@ class GenerationEngine:
     def n_running(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    @property
+    def n_pending_work(self) -> int:
+        """Requests the engine still owes a response: running slots,
+        warming (chunked-prefill) slots, and the admission queue. The
+        drain path polls this to decide when a server is idle."""
+        return self.n_running + len(self._warming) + len(self.scheduler)
+
     def _note_pallas_fallback(self, site: str, reason: str) -> None:
         """Structured one-shot note that a requested Pallas serving kernel
         (``site`` in {"decode", "prefill"}) is serving on the XLA path
@@ -1349,7 +1441,32 @@ class GenerationEngine:
         (:meth:`record_serving_stats`) both read from here."""
         pc = self.prefix_cache
         sched = self.scheduler
+        # retained-KV hygiene gauges: live entries, their byte footprint
+        # (per-block pool bytes x blocks referenced by retained slots), and
+        # the TTL reaper's lifetime count — a client that vanishes
+        # mid-interrupt-loop shows up here instead of leaking silently
+        with self._retained_lock:
+            retained_n = len(self._retained)
+            retained_blocks = sum(
+                int(self._slot_nblocks[e.slot])
+                for e in self._retained.values()
+            )
+        total_blocks = max(1, self.pool.n_used + self.pool.n_free)
+        per_block_bytes = (
+            self._kv_pool_kv_bytes + self._kv_pool_scale_bytes
+        ) / total_blocks
         return {
+            "retained_kv_slots": retained_n,
+            "retained_kv_bytes": int(retained_blocks * per_block_bytes),
+            "retained_kv_reaped_total": self.retained_kv_reaped_total,
+            "interrupts_total": self.interrupts_total,
+            "resumed_total": self.resumed_total,
+            "resumed_tokens_total": self.resumed_tokens_total,
+            "resume_suffix_recomputed_tokens_total": (
+                self.resume_suffix_recomputed_tokens_total
+            ),
+            "resumed_across_commit_total": self.resumed_across_commit_total,
+            "preemptions_total": self.preemptions_total,
             "kv_blocks_used": self.pool.n_used,
             "kv_blocks_free": self.pool.n_free,
             "kv_block_size": self.pool.block_size,
@@ -1465,6 +1582,10 @@ class GenerationEngine:
         }
         for (site, reason), n in sorted(self.pallas_fallbacks.items()):
             out[f"pallas_fallback_total{{site={site},reason={reason}}}"] = n
+        # interruption ledger, labeled by reason (interrupts_total itself
+        # arrives via serving_stats below, alongside the retained-KV gauges)
+        for reason, n in sorted(self.interrupts_by_reason.items()):
+            out[f"interrupts_total{{reason={reason}}}"] = n
         if serving_stats is None:
             serving_stats = self.serving_stats()
         for k, v in serving_stats.items():
@@ -1510,6 +1631,8 @@ class GenerationEngine:
                     self._wake.clear()
                     continue
                 self._handle_aborts()
+                self._handle_interrupts()
+                self._reap_retained()
                 self._admit()
                 if self.n_running == 0:
                     self._wake.wait(timeout=0.05)
@@ -1531,6 +1654,14 @@ class GenerationEngine:
             if cmd[0] == "pause_ack":
                 self._abort_all("abort")
                 cmd[1].set()
+            elif cmd[0] == "interrupt_all":
+                _, reason, done = cmd
+                try:
+                    self._interrupt_everything(reason)
+                    done.put(None)
+                except Exception as e:
+                    logger.exception("interrupt_all failed")
+                    done.put(e)
             elif cmd[0] == "commit_staged":
                 _, version, done = cmd
                 t0 = time.monotonic()
@@ -1599,6 +1730,11 @@ class GenerationEngine:
                     self.weight_sync_stall_seconds_total += stall
                     self.weight_sync_commits_total += 1
                     self._stamp_active_spans("weight_commit", version=version)
+                    # chaos: an interrupt landing exactly between the
+                    # pointer flip and the next decode chunk — the retained
+                    # KV predates the commit while the resume decodes on
+                    # the new version (the adversarial mixed-version case)
+                    self._chaos_interrupt("mid-commit")
                     from areal_tpu.utils import flight_recorder
 
                     flight_recorder.record(
@@ -1748,6 +1884,7 @@ class GenerationEngine:
             seq.on_done(self._response(seq, reason))
         # flush queued-but-not-admitted requests too: client re-issues them
         for seq in self.scheduler.drain():
+            self._preempted_rids.discard(seq.rid)
             seq.on_done(self._response(seq, reason))
 
     def _handle_aborts(self):
@@ -1771,7 +1908,222 @@ class GenerationEngine:
             # it out there too (otherwise the abort is silently lost and
             # the request is admitted later)
             for seq in self.scheduler.remove_rids(rids):
+                if seq.rid in self._preempted_rids:
+                    # an aborted preempted-victim's pinned KV would linger
+                    # until the TTL reaper; its client just cancelled, so
+                    # drop the pin now
+                    self._preempted_rids.discard(seq.rid)
+                    self._evict_retained(seq.rid)
                 seq.on_done(self._response(seq, "abort"))
+
+    # ------------------------------------------------------------------
+    # Token-boundary interruption (engine thread)
+    # ------------------------------------------------------------------
+
+    def _handle_interrupts(self):
+        """Serve pending interrupt() requests between decode chunks (the
+        token boundary): running slots finish with stop_reason="interrupt"
+        and retained+pinned KV; warming slots cancel their chunked prefill
+        (partial KV discarded — it may span a weight update); queued rids
+        answer with zero tokens. All bounded by one loop iteration."""
+        with self._lock:
+            if not self._interrupt_rids:
+                return
+            reasons, self._interrupt_rids = self._interrupt_rids, {}
+        for i, seq in enumerate(self.slots):
+            if seq is not None and seq.rid in reasons:
+                self._interrupt_slot(i, reasons.pop(seq.rid))
+        for slot in list(self._warming):
+            seq = self._warming[slot]["seq"]
+            if seq.rid in reasons:
+                self._interrupt_warming(slot, reasons.pop(seq.rid))
+        if reasons:
+            for seq in self.scheduler.remove_rids(set(reasons)):
+                self._note_interrupt(seq, reasons.get(seq.rid, "manual"))
+                seq.on_done(self._response(seq, "interrupt"))
+
+    def _interrupt_everything(self, reason: str):
+        """The drain primitive behind :meth:`interrupt_all`: every running,
+        warming, and queued request answers "interrupt" NOW. Unlike
+        :meth:`_abort_all` the running slots' responses carry
+        stop_reason="interrupt" and their KV is pinned, so a peer (or this
+        server, pre-restart) resumes them token-exactly."""
+        retain = self.config.retain_kv_on_abort
+        for i, seq in enumerate(self.slots):
+            if seq is not None:
+                self._interrupt_slot(i, reason, retain=retain)
+        for slot in list(self._warming):
+            self._interrupt_warming(slot, reason)
+        for seq in self.scheduler.drain():
+            self._preempted_rids.discard(seq.rid)
+            self._note_interrupt(seq, reason)
+            seq.on_done(self._response(seq, "interrupt"))
+
+    def _interrupt_slot(self, i: int, reason: str, retain: bool | None = None):
+        """Finish running slot ``i`` with stop_reason="interrupt",
+        retaining its KV pinned under the rid (version-tagged for the
+        resume path's commit-crossing accounting)."""
+        seq = self.slots[i]
+        if seq is None:
+            return
+        self._note_interrupt(seq, reason)
+        if retain is None:
+            retain = self.config.retain_kv_on_abort
+        self._finish(i, "interrupt", retain=retain, pin=True)
+
+    def _interrupt_warming(self, slot: int, reason: str):
+        """Cancel a mid-chunked-prefill slot: its partially-written KV is
+        discarded (it may straddle a weight commit and must not survive);
+        the client re-issues the prompt and admits fresh."""
+        seq = self._warming.pop(slot)["seq"]
+        self._free_slot_blocks(slot)
+        self._note_interrupt(seq, reason)
+        seq.on_done(self._response(seq, "interrupt"))
+
+    def _note_interrupt(self, seq: _Seq, reason: str):
+        self.interrupts_total += 1
+        self.interrupts_by_reason[reason] = (
+            self.interrupts_by_reason.get(reason, 0) + 1
+        )
+        self._c_interrupts.labels(reason=reason).inc()
+        if seq.span is not None:
+            seq.span.event(
+                "interrupt", reason=reason, tokens=len(seq.out_tokens)
+            )
+
+    def _retain_seq(self, slot: int, seq: _Seq, pin: bool):
+        """Record slot ``slot``'s cache as resumable KV for ``seq.rid``.
+        Generalizes the k-tokens-emitted math to k=0 (a slot interrupted
+        right after chunked-warm completion, before its first decode):
+        the cache then covers prompt[:-1] and prompt[-1] is the pending
+        feed token."""
+        if seq.out_tokens:
+            # cache covers prompt + all outputs but the last sampled token
+            # (whose K/V is written when it is fed to the next decode step)
+            covered = tuple(seq.prompt) + tuple(seq.out_tokens[:-1])
+            feed = int(seq.out_tokens[-1])
+        elif len(seq.prompt) >= 2:
+            covered = tuple(seq.prompt[:-1])
+            feed = int(seq.prompt[-1])
+        else:
+            return  # single-token prompt, nothing warmed: not resumable
+        with self._retained_lock:
+            stale = self._retained.pop(seq.rid, None)
+            if stale is not None:
+                self._retained_slots.pop(stale.slot, None)
+            self._retained[seq.rid] = _Retained(
+                slot=slot,
+                covered=covered,
+                feed_tok=feed,
+                ts=time.monotonic(),
+                version=self.version,
+                pinned=pin,
+            )
+            self._retained_slots[slot] = seq.rid
+
+    def _reap_retained(self):
+        """TTL reaper for retained-KV entries (hygiene satellite): a client
+        that disconnects mid-interrupt-loop must not pin KV until LRU
+        pressure. Runs on the engine loop at ~1s cadence; a reaped entry
+        that belonged to an internally-requeued preemption victim converts
+        to a client-visible interrupt (partial output; the client's resume
+        loop re-issues token-exactly)."""
+        ttl = self.config.retained_kv_ttl_seconds
+        if ttl <= 0:
+            return
+        now = time.monotonic()
+        if now < self._next_reap:
+            return
+        self._next_reap = now + max(0.05, min(ttl / 4.0, 1.0))
+        cutoff = now - ttl
+        with self._retained_lock:
+            expired = [
+                rid for rid, e in self._retained.items() if e.ts <= cutoff
+            ]
+            for rid in expired:
+                ent = self._retained.pop(rid)
+                self._retained_slots.pop(ent.slot, None)
+        for rid in expired:
+            self.retained_kv_reaped_total += 1
+            logger.info(
+                "retained KV for rid=%s reaped after %.1fs TTL "
+                "(knob: JaxGenConfig.retained_kv_ttl_seconds)", rid, ttl,
+            )
+            if rid in self._preempted_rids:
+                self._preempted_rids.discard(rid)
+                for seq in self.scheduler.remove_rids({rid}):
+                    self._note_interrupt(seq, "reaped")
+                    seq.on_done(self._response(seq, "interrupt"))
+
+    def _chaos_interrupt(self, site: str, slot: int | None = None):
+        """Seeded chaos hook (AREAL_CHAOS_INTERRUPT): fire an interrupt at
+        an adversarial point. ``slot`` targets a specific running/warming
+        slot (mid-chunked-prefill, radix-warm); None interrupts the first
+        running slot (mid-commit). Off = one env lookup."""
+        from areal_tpu.utils import chaos
+
+        if not chaos.interrupt_point(site):
+            return
+        if slot is not None:
+            if self.slots[slot] is not None:
+                self._interrupt_slot(slot, "chaos")
+            elif slot in self._warming:
+                self._interrupt_warming(slot, "chaos")
+            return
+        for i, seq in enumerate(self.slots):
+            if seq is not None:
+                self._interrupt_slot(i, "chaos")
+                return
+
+    # ------------------------------------------------------------------
+    # Priority preemption (engine thread, driven from _admit)
+    # ------------------------------------------------------------------
+
+    def _maybe_preempt_for(self, seq: _Seq) -> bool:
+        """When ``seq`` (already popped by _admit) cannot be admitted, try
+        interrupting the lowest-priority running victim with priority
+        STRICTLY below ``seq.priority``: its KV is retained pinned and it
+        requeues at its original position (no client-visible response).
+        Returns True when a victim was preempted — the caller retries the
+        admission pass."""
+        if not self.config.enable_preemption:
+            return False
+        running = [
+            (i, s) for i, s in enumerate(self.slots) if s is not None
+        ]
+        victim = self.scheduler.preemption_victim(running, seq.priority)
+        if victim is None:
+            return False
+        self._preempt_slot(victim)
+        return True
+
+    def _preempt_slot(self, slot: int):
+        """Interrupt running slot ``slot`` WITHOUT responding to its
+        client: KV retained pinned, the sequence (with its accumulated
+        tokens/logprobs/versions) pushed back at its original queue
+        position; _try_resume re-admits it with zero re-prefill once
+        capacity returns. If pool pressure later evicts the pinned entry,
+        the eviction path converts it to a client-visible interrupt."""
+        seq = self.slots[slot]
+        if seq is None:
+            return
+        self.slots[slot] = None
+        self.preemptions_total += 1
+        self._note_interrupt(seq, "preempt")
+        self._retain_seq(slot, seq, pin=True)
+        self._cache_insert_slot(slot)
+        self._unpin_slot_nodes(slot)
+        self._slot_last_use[slot] = time.monotonic()
+        self._preempted_rids.add(seq.rid)
+        if seq.sched_entry is not None:
+            self.scheduler.push_front(seq.sched_entry)
+        else:  # defensive: never admitted through _admit (colocated use)
+            self.scheduler.submit(seq, priority=seq.priority)
+        logger.info(
+            "preempted rid=%s (slot %d, priority %d) for a higher-priority "
+            "admission; %d token(s) retained",
+            seq.rid, slot, seq.priority, len(seq.out_tokens),
+        )
 
     def _extend_chunk(self, slot: int, ids_chunk, start: int):
         """One bucketed suffix-extension dispatch writing slot's prompt
@@ -1815,6 +2167,11 @@ class GenerationEngine:
                     seq.span.event(
                         "prefill_chunk", tokens=n, offset=st["off"]
                     )
+                self._chaos_interrupt("mid-chunked-prefill", slot=slot)
+                if slot not in self._warming:
+                    break  # chaos cancelled this warm mid-prompt
+            if slot not in self._warming:
+                continue
             if st["off"] >= limit:
                 del self._warming[slot]
                 self.chunked_prefill_count += 1
@@ -1900,6 +2257,9 @@ class GenerationEngine:
             if popped is None:
                 break
             seq, entry = popped
+            # preemption hands the entry back via push_front so a victim
+            # requeues at its ORIGINAL position
+            seq.sched_entry = entry
             if seq.span is not None:
                 # queue wait measured from ORIGINAL submission (a
                 # requeued entry keeps t_first, like the scheduler stats)
@@ -1913,6 +2273,15 @@ class GenerationEngine:
             if self._try_resume(seq):
                 note_admitted(seq.slot)
                 continue  # resume costs no device dispatch
+            if seq.out_tokens:
+                # an internally-requeued preemption victim whose retained
+                # KV was lost: a fresh prefill cannot re-create mid-sequence
+                # state, so convert to a client-visible interrupt (the
+                # client's resume loop replays prompt+accumulated)
+                self._preempted_rids.discard(seq.rid)
+                self._note_interrupt(seq, "evicted")
+                seq.on_done(self._response(seq, "interrupt"))
+                continue
             if live_blocks is None:
                 live_blocks = self._live_block_set()
             pending_held = sum(len(b) for b in pending_blocks) * self.block_size
@@ -1922,6 +2291,13 @@ class GenerationEngine:
                 covered=radix_m.covered if radix_m else 0,
                 held_tokens=len(live_blocks) * self.block_size,
             ):
+                if self._maybe_preempt_for(seq):
+                    # a strictly-lower-priority victim released its blocks:
+                    # requeue the popped request at the FRONT and retry the
+                    # whole pass with a fresh held-set
+                    self.scheduler.push_front(entry)
+                    live_blocks = None
+                    continue
                 # token-budget admission control: the pool cannot hold this
                 # request right now — keep it QUEUED (it retains its place)
                 # instead of thrashing the prefix cache with evictions that
@@ -1929,25 +2305,34 @@ class GenerationEngine:
                 self.scheduler.push_front(entry)
                 flush()
                 return
+            with self._retained_lock:
+                retained_slots = set(self._retained_slots)
+                has_retained = bool(self._retained)
             free = [
                 i
                 for i, s in enumerate(self.slots)
                 if s is None
-                and i not in self._retained_slots
+                and i not in retained_slots
                 and i not in pending_slots
                 and i not in self._warming
             ]
-            if not free and self._retained:
+            if not free and has_retained:
                 self._evict_lru_retained()
+                with self._retained_lock:
+                    retained_slots = set(self._retained_slots)
                 free = [
                     i
                     for i, s in enumerate(self.slots)
                     if s is None
-                    and i not in self._retained_slots
+                    and i not in retained_slots
                     and i not in pending_slots
                     and i not in self._warming
                 ]
             if not free:
+                if self._maybe_preempt_for(seq):
+                    self.scheduler.push_front(entry)
+                    live_blocks = None
+                    continue
                 self.scheduler.push_front(entry)  # no capacity; retry later
                 flush()
                 return
@@ -2043,24 +2428,95 @@ class GenerationEngine:
         flush()
 
     def _try_resume(self, seq: _Seq) -> bool:
-        """Abort-resume fast path: the re-issued prompt must be exactly the
-        retained cache contents plus the pending feed token."""
-        ent = self._retained.get(seq.rid)
+        """Resume a retained continuation by rid.
+
+        Exact match (re-issued tokens == retained cache contents + the
+        pending feed token) re-admits with ZERO device dispatch — the
+        abort-resume fast path. When the retained cache covers only a
+        PREFIX of the re-issue, recompute just the uncovered suffix via
+        one extension dispatch and continue decoding: this is the
+        in-flight weight-swap path — after a staged commit the suffix
+        (and all further decode) runs on the NEW version while the
+        covered prefix keeps the version-tagged KV it was interrupted
+        with, and the response's per-token ``versions`` span the commit
+        (surfaced live by the version-mix telemetry)."""
+        with self._retained_lock:
+            ent = self._retained.get(seq.rid)
         if ent is None:
             return False
-        slot, covered, feed_tok, _ = ent
-        prompt = tuple(seq.prompt)
-        if prompt != covered + (feed_tok,):
-            self._evict_retained(seq.rid)
-            return False
-        self._retained.pop(seq.rid, None)
-        self._retained_slots.pop(slot, None)
-        self.prompt_tokens_total += len(seq.prompt)
+        slot = ent.slot
+        full = tuple(seq.prompt) + tuple(seq.out_tokens)
+        exact = full == ent.covered + (ent.feed_tok,)
+        n_cov = len(ent.covered)
+        middle: list[int] = []
+        if not exact:
+            if (
+                len(full) <= n_cov
+                or full[:n_cov] != ent.covered
+                or seq.images  # M-RoPE positions: text-only extension path
+            ):
+                self._evict_retained(seq.rid)
+                return False
+            middle = list(full[n_cov:-1])
+            # bucket guard BEFORE committing: the extension dispatch pads
+            # to a power-of-two bucket; a resume too close to max_seq_len
+            # falls back to the fresh-prefill path
+            if middle and (
+                n_cov + self._bucket(len(middle)) > self.config.max_seq_len
+            ):
+                self._evict_retained(seq.rid)
+                return False
+        # pop the entry (so the eviction ladder cannot reap it mid-resume)
+        # and mark the slot live BEFORE drawing blocks — _alloc_blocks may
+        # run the reclaim ladder, which must not free this slot's rows
+        with self._retained_lock:
+            self._retained.pop(seq.rid, None)
+            self._retained_slots.pop(slot, None)
         seq.slot = slot
         self.slots[slot] = seq
-        self.last_token[slot] = feed_tok
-        self._slot_covered[slot] = list(covered)
-        # cache_len already holds len(covered); decode feeds feed_tok next
+        if middle:
+            need = self.pool.blocks_for_tokens(len(full) - 1)
+            have = int(self._slot_nblocks[slot])
+            if need > have:
+                try:
+                    extra = self._alloc_blocks(need - have)
+                except OutOfBlocks:
+                    # continuation unservable right now: drop it and let
+                    # the caller's normal admission path requeue/prefill
+                    self.slots[slot] = None
+                    seq.slot = -1
+                    self._free_slot_blocks(slot)
+                    return False
+                self.block_table[slot, have:need] = extra
+                self._slot_nblocks[slot] = need
+            self._extend_chunk(slot, middle, start=n_cov)
+            self.cache_len[slot] = len(full) - 1
+            self._slot_covered[slot] = list(full[:-1])
+            self.resume_suffix_recomputed_tokens_total += len(middle)
+        else:
+            # cache_len already holds len(covered); decode feeds full[-1]
+            self._slot_covered[slot] = list(full[:-1])
+        self.last_token[slot] = int(full[-1])
+        self.prompt_tokens_total += len(seq.prompt)
+        self.resumed_total += 1
+        self.resumed_tokens_total += n_cov
+        if ent.version != self.version:
+            # the continuation crosses a weight commit: its KV rows mix
+            # versions, so poison the slot as a clone/radix source; the
+            # per-token versions the decode loop stamps from here on carry
+            # the NEW version while the pre-interrupt tokens keep the old
+            self.resumed_across_commit_total += 1
+            self._slot_kv_version[slot] = -1
+        if seq.span is not None:
+            seq.span.event(
+                "resume",
+                exact=exact,
+                covered=n_cov,
+                recomputed=len(middle),
+                kv_version=ent.version,
+                version=self.version,
+            )
+        self._preempted_rids.discard(seq.rid)
         return True
 
     def _live_block_set(self) -> set:
@@ -2227,6 +2683,7 @@ class GenerationEngine:
                 "seq": seq, "blocks": table, "off": covered,
                 "version": self.version,
             }
+            self._chaos_interrupt("radix-warm", slot=dst)
             return 0
         self.prompt_tokens_total += n
         if suffix > 0:
@@ -2929,23 +3386,15 @@ class GenerationEngine:
                 ):
                     break
 
-    def _finish(self, slot: int, reason: str, retain: bool = False):
+    def _finish(
+        self, slot: int, reason: str, retain: bool = False, pin: bool = False
+    ):
         seq = self.slots[slot]
         if seq is None:
             return
         self.slots[slot] = None
-        if retain and seq.out_tokens:
-            # cache covers prompt + all outputs but the last sampled token
-            # (whose K/V is written when it is fed to the next decode step)
-            covered = tuple(seq.prompt) + tuple(seq.out_tokens[:-1])
-            self._evict_retained(seq.rid)  # replace any stale entry
-            self._retained[seq.rid] = (
-                slot,
-                covered,
-                seq.out_tokens[-1],
-                time.monotonic(),
-            )
-            self._retained_slots[slot] = seq.rid
+        if retain and (seq.out_tokens or pin):
+            self._retain_seq(slot, seq, pin=pin)
         # keep cache_len, covered tokens, and the block table — the rows
         # stay valid as prefix-clone sources until the pool reclaims them
         # (inactive lanes write to the trash block, so a full table poses
@@ -2959,22 +3408,43 @@ class GenerationEngine:
         seq.on_done(self._response(seq, reason))
 
     def _evict_retained(self, rid: str):
-        ent = self._retained.pop(rid, None)
-        if ent is not None:
-            self._retained_slots.pop(ent[0], None)
-            # rows stay valid (see _finish): still a prefix-clone source
+        with self._retained_lock:
+            ent = self._retained.pop(rid, None)
+            if ent is not None:
+                self._retained_slots.pop(ent.slot, None)
+                # rows stay valid (see _finish): still a prefix-clone source
 
     def _evict_lru_retained(self):
-        if not self._retained:
-            return
-        # prefer evicting entries whose owner is NOT already queued for
-        # resume — evicting a pending continuation forces the full re-prefill
-        # the retention mechanism exists to avoid
+        """Evict ONE retained entry under pool/slot pressure, by preference
+        ladder: unpinned-and-idle first, then unpinned-but-queued (forces
+        the full re-prefill retention exists to avoid), then pinned
+        (interrupt/preempt continuations) as a last resort — the guarantee
+        that one max-length sequence always fits outranks the pin. Within
+        a rank, oldest first. Evicting a preemption victim's pinned entry
+        converts the internal requeue into a client-visible interrupt (the
+        client replays prompt+accumulated; correctness is preserved, only
+        the zero-recompute fast path is lost)."""
+        # scheduler lock is NOT held while _retained_lock is (leaf-lock
+        # discipline): snapshot the pending set first
         pending = self.scheduler.pending_rids()
-        candidates = [r for r in self._retained if r not in pending]
-        pool = candidates or list(self._retained)
-        rid = min(pool, key=lambda r: self._retained[r][3])
-        self._evict_retained(rid)
+        with self._retained_lock:
+            if not self._retained:
+                return
+            rid = min(
+                self._retained,
+                key=lambda r: (
+                    2 * int(self._retained[r].pinned)
+                    + int(r in pending),
+                    self._retained[r].ts,
+                ),
+            )
+            ent = self._retained.pop(rid)
+            self._retained_slots.pop(ent.slot, None)
+        if rid in self._preempted_rids:
+            self._preempted_rids.discard(rid)
+            for seq in self.scheduler.remove_rids({rid}):
+                self._note_interrupt(seq, "evicted")
+                seq.on_done(self._response(seq, "interrupt"))
 
     def _response(self, seq: _Seq, reason: str) -> ModelResponse:
         now = time.monotonic()

@@ -150,6 +150,34 @@ class AdmissionScheduler:
             }
 
     # ------------------------------------------------------------------
+    # preemption policy
+    # ------------------------------------------------------------------
+
+    def preemption_victim(self, running, priority: int):
+        """Pick the slot index to preempt so a request at ``priority`` can
+        admit, or None when preemption is not justified.
+
+        Policy: only a victim with priority STRICTLY below the admitting
+        request qualifies (equal-priority work is never preempted — FIFO
+        fairness within a class); among qualifying victims pick the lowest
+        priority, tie-broken by YOUNGEST submission (it has the least sunk
+        decode work to retain and is the natural LIFO sacrifice).
+
+        ``running`` is a list of ``(slot_index, seq)`` pairs; the policy
+        lives here (with the rest of the admission policy) while the
+        mechanics — KV retention, requeue via push_front — stay in the
+        engine."""
+        best = None
+        best_key = None
+        for slot, seq in running:
+            if seq.priority >= priority:
+                continue
+            key = (seq.priority, -seq.t_submit)
+            if best_key is None or key < best_key:
+                best, best_key = slot, key
+        return best
+
+    # ------------------------------------------------------------------
     # admission control
     # ------------------------------------------------------------------
 

@@ -120,6 +120,8 @@ class GenerationServer:
                 # rollout client cancels via asyncio task cancellation, so
                 # nothing in-repo POSTs here by design
                 web.post("/abort_request", self.abort_request),  # arealint: disable=http-contract
+                web.post("/interrupt_request", self.interrupt_request),
+                web.post("/drain", self.drain),
                 web.post("/pause_generation", self.pause),
                 web.post("/continue_generation", self.resume),
                 web.post("/update_weights_from_disk", self.update_weights_from_disk),
@@ -162,6 +164,10 @@ class GenerationServer:
         self._blocking = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="genserver-blocking"
         )
+        # bounded-time drain in progress (or done): /ready answers 503 so
+        # probes/rejoin logic stop considering this server, while /generate
+        # stays up for stragglers whose routing raced the drain
+        self._draining = False
 
     async def _offload(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
@@ -208,6 +214,8 @@ class GenerationServer:
         The fleet controller's scale-out warmup and the client's breaker
         rejoin probe both wait on this, so a server that is alive but still
         loading (or still at stale weights) never takes rotation traffic."""
+        if self._draining:
+            return web.json_response({"status": "draining"}, status=503)
         e = self.engine
         is_ready = getattr(e, "is_ready", None)
         if not e.healthy or (is_ready is not None and not is_ready()):
@@ -336,6 +344,68 @@ class GenerationServer:
         body = await request.json()
         self.engine.abort(body.get("rid", ""))
         return web.json_response({"success": True})
+
+    async def interrupt_request(self, request: web.Request) -> web.Response:
+        """Token-boundary interrupt of ONE request: it answers its pending
+        /generate with ``stop_reason="interrupt"`` and partial output at
+        the next decode step, KV retained pinned for an exact resume."""
+        body = await request.json()
+        self.engine.interrupt(
+            body.get("rid", ""), reason=str(body.get("reason") or "manual")
+        )
+        return web.json_response({"success": True})
+
+    async def drain_engine(self, grace_seconds: float) -> dict:
+        """Bounded-time drain shared by POST /drain and the launcher's
+        SIGTERM path: wait up to ``grace_seconds`` for in-flight work to
+        finish naturally, then interrupt the rest at the next token
+        boundary (KV-retaining, ``stop_reason="interrupt"``) so clients
+        fail over and resume token-exactly on a healthy peer. Wall-time is
+        bounded by the grace budget, not by max generation length."""
+        self._draining = True
+        e = self.engine
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, grace_seconds)
+        while e.n_pending_work > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        before = e.interrupts_total
+        if e.n_pending_work > 0:
+            # blocking engine-command round-trip: keep it off the event loop
+            await self._offload(e.interrupt_all, "drain")
+        interrupted = e.interrupts_total - before
+        wall = time.monotonic() - t0
+        logger.info(
+            "drain complete in %.2fs (grace %.2fs): %d request(s) "
+            "interrupted for peer resume",
+            wall, grace_seconds, interrupted,
+        )
+        return {
+            "interrupted": int(interrupted),
+            "wall_seconds": wall,
+            "grace_seconds": float(grace_seconds),
+        }
+
+    async def drain(self, request: web.Request) -> web.Response:
+        """POST /drain {grace_seconds?}: the fleet controller's bounded
+        scale-in step (routing is already fenced off via remove_server
+        before this is called)."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            grace = float(
+                body.get("grace_seconds")
+                if body.get("grace_seconds") is not None
+                else self.engine.config.interrupt_grace_seconds
+            )
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": f"bad grace_seconds {body.get('grace_seconds')!r}"},
+                status=400,
+            )
+        result = await self.drain_engine(grace)
+        return web.json_response({"success": True, **result})
 
     async def pause(self, request: web.Request) -> web.Response:
         await self._offload(self.engine.pause)

@@ -137,6 +137,15 @@ async def amain(cfg: GenServerConfig):
                 name_resolve.delete(key)
             except Exception:
                 logger.debug("deregister-on-exit failed", exc_info=True)
+            # bounded-time drain (SIGTERM/scale-in): give in-flight work the
+            # grace budget, then interrupt the rest at a token boundary so
+            # clients resume token-exactly on a healthy peer — shutdown
+            # wall-time is bounded by grace, not max generation length
+            if cfg.server.interrupt_grace_seconds > 0:
+                try:
+                    await server.drain_engine(cfg.server.interrupt_grace_seconds)
+                except Exception:
+                    logger.warning("interrupt-drain failed", exc_info=True)
         await server.stop()
 
 

@@ -218,6 +218,58 @@ def rl_fault(name: str) -> bool:
     return False
 
 
+# ---------------------------------------------------------------------------
+# deterministic generation-interrupt injection (token-boundary interruption)
+# ---------------------------------------------------------------------------
+
+INTERRUPT_CHAOS_ENV = "AREAL_CHAOS_INTERRUPT"
+
+#: site names the generation engine consults; each fires an interrupt at
+#: one adversarial point of the serving lifecycle (see engine._chaos_interrupt)
+INTERRUPT_SITES = (
+    "mid-commit",           # right after a staged weight commit flips
+    "mid-chunked-prefill",  # between chunks of an intra-prompt warm
+    "radix-warm",           # right after a radix hit enters chunked warm
+)
+
+#: per-site arrival counters for ``name@N[:K]`` specs
+_interrupt_hits: dict[str, int] = {}
+
+
+def reset_interrupt_points() -> None:
+    """Clear arrival counters (tests arm a fresh spec per scenario)."""
+    _interrupt_hits.clear()
+
+
+def interrupt_point(name: str) -> bool:
+    """Deterministic interrupt-injection gate, same grammar as
+    :func:`rl_fault`: ``AREAL_CHAOS_INTERRUPT`` holds comma-separated specs
+    ``name`` (fire on the first arrival), ``name@N`` (the Nth), or
+    ``name@N:K`` (arrivals N..N+K-1). Returns True when THIS arrival is
+    inside the armed window — the engine then interrupts a running/warming
+    sequence at that exact point. Called from engine-loop sites only (never
+    per token); off = one env lookup."""
+    spec = os.environ.get(INTERRUPT_CHAOS_ENV, "")
+    if not spec:
+        return False
+    for part in spec.split(","):
+        target, _, window = part.strip().partition("@")
+        if target != name:
+            continue
+        _interrupt_hits[name] = _interrupt_hits.get(name, 0) + 1
+        start_s, _, width_s = window.partition(":")
+        start = int(start_s) if start_s else 1
+        width = int(width_s) if width_s else 1
+        if start <= _interrupt_hits[name] < start + width:
+            logger.warning(
+                "chaos: generation interrupt fired at %r (arrival %d)",
+                name,
+                _interrupt_hits[name],
+            )
+            return True
+    return False
+
+
 #: action vocabulary shared by config validation and the two hook sites
 ACTIONS = ("drop", "http_error", "timeout", "slow", "disconnect")
 

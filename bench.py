@@ -1431,6 +1431,206 @@ def weight_sync_bench(layers: int = 2, vocab: int = 2048, chunk_mb: int = 64,
         eng.stop()
 
 
+def inflight_weight_swap_bench(layers: int = 2, vocab: int = 2048,
+                               batch: int = 4, episode_tokens: int = 96,
+                               steps_per_call: int = 4,
+                               max_seq_len: int = 512):
+    """In-flight weight swap via token-boundary interruption (ISSUE 19):
+    a staged commit lands while every slot is mid-decode, interrupt-ON
+    (interrupt_all at the next token boundary -> commit -> KV-retaining
+    resume on the new version) vs the fenced baseline (wait for every
+    in-flight episode to finish, then commit).
+
+    The headline is **effective staleness**: mean tokens per episode
+    decoded on the OLD weights after the swap was requested. Under
+    interruption it is the token-boundary latency (~decode_steps_per_call
+    tokens); fenced, it is the whole remaining generation length. Also
+    reported: the swap's drain wall-time on vs off.
+
+    HARD gates in-child: the staged weights equal the live ones, so every
+    interrupted-and-resumed episode must be greedy token-identical to an
+    unswapped reference, with versions spanning the commit, and the
+    retained-KV ledger must return to zero."""
+    import asyncio
+    import threading
+    import urllib.request  # noqa: F401  (parity with sibling children)
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+
+    # float32: the identity gate compares token streams across an
+    # interrupt/resume splice, so the compute must be bit-deterministic
+    model_cfg = qwen2_1p5b_cfg(layers, vocab=vocab)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=batch, max_seq_len=max_seq_len, prefill_chunk=128,
+            decode_steps_per_call=steps_per_call, dtype="float32",
+            page_size=max_seq_len,
+            retained_kv_ttl_seconds=60.0,
+        ),
+        model_config=model_cfg,
+    )
+    server = GenerationServer(eng)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=120)
+    addr = f"127.0.0.1:{port}"
+    client = RemoteInfEngine(InferenceEngineConfig())
+    client.initialize(addr, train_data_parallel_size=1)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, vocab - 2, size=16).tolist() for _ in range(batch)
+    ]
+    gcfg = GenerationHyperparameters(
+        max_new_tokens=episode_tokens, greedy=True
+    )
+
+    named = {}
+
+    def walk(node, prefix):
+        for k in sorted(node):
+            v = node[k]
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, path)
+            else:
+                named[path] = np.asarray(v)
+
+    walk(eng.params, "")
+
+    def run_episodes(tag):
+        results = [None] * batch
+
+        def run(i):
+            results[i] = client.generate(
+                ModelRequest(
+                    rid=f"{tag}-{i}", input_ids=prompts[i], gconfig=gcfg
+                )
+            )
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(batch)
+        ]
+        for t in threads:
+            t.start()
+        return threads, results
+
+    def wait_mid_decode(min_tokens=3, timeout=300.0):
+        """Block until every slot is decoding; returns rid -> tokens-out
+        at that instant (the staleness baseline)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            live = {
+                s.rid: len(s.out_tokens)
+                for s in eng.slots
+                if s is not None and len(s.out_tokens) >= min_tokens
+            }
+            if len(live) >= batch:
+                return live
+            time.sleep(0.005)
+        raise AssertionError("episodes never reached mid-decode")
+
+    try:
+        # reference: unswapped greedy episodes (compiles prefill/decode too)
+        threads, refs = run_episodes("ref")
+        for t in threads:
+            t.join(timeout=600)
+        assert all(
+            r is not None and len(r.output_tokens) == episode_tokens
+            for r in refs
+        ), "reference episodes incomplete"
+
+        # --- interrupt ON: token-boundary interrupt -> commit -> resume ---
+        threads, on = run_episodes("on")
+        len0 = wait_mid_decode()
+        t0 = time.perf_counter()
+        eng.stage_weight_chunk(named, version=1)
+        eng.interrupt_all("swap")  # blocking: every slot answered
+        eng.commit_staged_weights(1)
+        swap_wall_on = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=600)
+        stale_on, resumed_span = [], 0
+        for i, r in enumerate(on):
+            assert r is not None and r.stop_reason in ("stop", "length")
+            # greedy identity across the interrupt/commit/resume splice is
+            # the rung's correctness gate
+            assert r.output_tokens == refs[i].output_tokens, (
+                f"episode {i} diverged across the in-flight swap"
+            )
+            vs = set(r.output_versions)
+            assert 0 in vs and 1 in vs, (
+                f"episode {i} versions {vs} do not span the commit"
+            )
+            resumed_span += 1
+            stale_on.append(
+                sum(1 for v in r.output_versions if v == 0)
+                - len0[f"on-{i}"]
+            )
+        # the consumed retained entries must not leak
+        deadline = time.time() + 30
+        while (
+            eng.serving_stats()["retained_kv_slots"] > 0
+            and time.time() < deadline
+        ):
+            eng._wake.set()
+            time.sleep(0.05)
+        assert eng.serving_stats()["retained_kv_slots"] == 0
+
+        # --- fenced OFF: wait for natural completion, then commit ---
+        threads, off = run_episodes("off")
+        len0 = wait_mid_decode()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=600)
+        eng.stage_weight_chunk(named, version=2)
+        eng.commit_staged_weights(2)
+        swap_wall_off = time.perf_counter() - t0
+        stale_off = []
+        for i, r in enumerate(off):
+            assert r is not None
+            assert r.output_tokens == refs[i].output_tokens
+            stale_off.append(len(r.output_tokens) - len0[f"off-{i}"])
+
+        return {
+            "effective_staleness_tokens": round(
+                float(np.mean(stale_on)), 2
+            ),
+            "fenced_staleness_tokens": round(float(np.mean(stale_off)), 2),
+            "staleness_reduction": round(
+                float(np.mean(stale_off)) / max(float(np.mean(stale_on)), 0.5),
+                1,
+            ),
+            "swap_wall_seconds": round(swap_wall_on, 3),
+            "fenced_drain_wall_seconds": round(swap_wall_off, 3),
+            "episodes_resumed_across_commit": resumed_span,
+            "interrupts_total": eng.interrupts_total,
+            "greedy_identity": True,
+            "episodes": batch,
+            "episode_tokens": episode_tokens,
+            "layers": layers,
+        }
+    finally:
+        client.destroy()
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
 def weight_propagation_bench(layers: int = 2, vocab: int = 2048,
                              hidden: int = 256, inter: int = 512,
                              chunk_mb: int = 2, batch: int = 4,
@@ -2740,6 +2940,41 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("weight_sync_stall_seconds", "weight-sync", e)
 
+    # ---- rung 3.62: in-flight weight swap — token-boundary interruption
+    # vs fenced full-drain around a staged commit (ISSUE 19). value is
+    # effective staleness in tokens/episode after the swap request; greedy
+    # identity across the interrupt/commit/resume splice, commit-spanning
+    # versions, and a zeroed retained-KV ledger are hard gates in the
+    # child. ----
+    if remaining(deadline) > 300:
+        try:
+            log("in-flight weight-swap rung")
+            sw = _run_child(
+                "swap",
+                (dict(layers=2, vocab=2048, batch=4, episode_tokens=96)
+                 if REHEARSAL
+                 else dict(layers=4, vocab=8192, batch=8,
+                           episode_tokens=256)),
+                timeout=min(900.0, remaining(deadline) - 60),
+            )
+            assert sw["greedy_identity"]
+            assert sw["episodes_resumed_across_commit"] >= 1
+            emit({
+                "metric": "inflight_weight_swap",
+                "value": sw["effective_staleness_tokens"],
+                "unit": "stale_tokens_per_episode",
+                # how many stale tokens the fenced baseline pays per one
+                # of ours
+                "vs_baseline": sw["staleness_reduction"],
+                "chip": chip,
+                **{k: v for k, v in sw.items()
+                   if k != "effective_staleness_tokens"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure(
+                "inflight_weight_swap", "inflight-weight-swap", e
+            )
+
     # ---- rung 3.65: peer-to-peer weight propagation — trainer egress
     # relay vs direct per-server streams at a simulated 4-server fleet
     # (real servers, tiny model; greedy identity + zero-torn-commit
@@ -3024,6 +3259,8 @@ def _child_main():
         print(json.dumps(weight_update_bench(**att)))
     elif kind == "--wsync-child":
         print(json.dumps(weight_sync_bench(**att)))
+    elif kind == "--swap-child":
+        print(json.dumps(inflight_weight_swap_bench(**att)))
     elif kind == "--wprop-child":
         print(json.dumps(weight_propagation_bench(**att)))
     elif kind == "--fleet-child":

@@ -1,0 +1,210 @@
+"""Bounded-time drain over HTTP (ISSUE 19): POST /drain interrupts
+in-flight generation at a token boundary within the grace budget, /ready
+flips to draining, and the client fails over and resumes token-exactly on
+a healthy peer. POST /interrupt_request stops one request which the client
+then transparently resumes on the same server from retained KV."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxGenConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+
+
+def _model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def _serve(cfg, params, **gen_kw):
+    """Engine + server on a private loop. Returns (addr, engine, stop)."""
+    engine = GenerationEngine(
+        JaxGenConfig(
+            max_batch_size=2,
+            max_seq_len=2048,
+            prefill_chunk=64,
+            decode_steps_per_call=4,
+            dtype="float32",
+            **gen_kw,
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    server = GenerationServer(engine)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=60)
+
+    def stop():
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
+
+    return f"127.0.0.1:{port}", engine, stop
+
+
+def _client(addrs):
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name="t", trial_name="t", max_concurrent_rollouts=4,
+            consumer_batch_size=2, request_retries=2,
+        )
+    )
+    client.initialize(addrs, train_data_parallel_size=1)
+    return client
+
+
+def _post(addr, path, payload, timeout=30.0):
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _wait_running(engine, rid, n_tokens, timeout=30.0):
+    """Block until ``rid`` is decoding on ``engine`` with >= n_tokens out."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for seq in engine.slots:
+            if seq is not None and seq.rid == rid and len(
+                seq.out_tokens
+            ) >= n_tokens:
+                return
+        time.sleep(0.01)
+    raise AssertionError(f"{rid} never reached {n_tokens} tokens")
+
+
+def test_interrupt_request_endpoint_transparent_resume():
+    """Operator interrupt of one rid over HTTP: the pending /generate
+    answers with partial output, the client resumes against the retained
+    KV, and the final splice is token-identical to an uninterrupted run."""
+    cfg, params = _model()
+    addr, engine, stop = _serve(cfg, params)
+    client = _client(addr)
+    try:
+        gc = GenerationHyperparameters(max_new_tokens=200, greedy=True)
+        ref = client.generate(
+            ModelRequest(rid="ref", input_ids=[5, 9, 3, 7, 2], gconfig=gc)
+        )
+        assert len(ref.output_tokens) == 200
+
+        result = {}
+
+        def run():
+            result["resp"] = client.generate(
+                ModelRequest(rid="tgt", input_ids=[5, 9, 3, 7, 2], gconfig=gc)
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        _wait_running(engine, "tgt", 5)
+        out = _post(addr, "/interrupt_request", {"rid": "tgt", "reason": "operator"})
+        assert out["success"]
+        t.join(timeout=120)
+        assert not t.is_alive(), "client never completed after interrupt"
+
+        resp = result["resp"]
+        assert resp.stop_reason in ("stop", "length")
+        assert resp.output_tokens == ref.output_tokens  # token-exact splice
+        assert resp.output_versions == [0] * 200
+        assert engine.interrupts_by_reason.get("operator") == 1
+        assert engine.resumed_total >= 1
+        # the exact resume consumed the retained entry
+        assert engine.serving_stats()["retained_kv_slots"] == 0
+    finally:
+        client.destroy()
+        stop()
+
+
+def test_drain_bounds_wall_time_and_fails_over_to_peer():
+    """Scale-in drain: fence routing (remove_server), POST /drain with a
+    small grace — the sequence still decoding is interrupted within the
+    budget (not after max_new tokens), /ready reports draining, and the
+    client resumes on the surviving peer with a token-identical result."""
+    cfg, params = _model()
+    addr_a, eng_a, stop_a = _serve(cfg, params)
+    addr_b, eng_b, stop_b = _serve(cfg, params)  # same seed: same weights
+    client = _client([addr_a, addr_b])
+    try:
+        gc = GenerationHyperparameters(max_new_tokens=600, greedy=True)
+        # reference, pinned to the survivor
+        client._rid_to_address["ref"] = addr_b
+        ref = client.generate(
+            ModelRequest(rid="ref", input_ids=[4, 8, 1, 6], gconfig=gc)
+        )
+        assert len(ref.output_tokens) == 600
+
+        client._rid_to_address["mv"] = addr_a
+        result = {}
+
+        def run():
+            result["resp"] = client.generate(
+                ModelRequest(rid="mv", input_ids=[4, 8, 1, 6], gconfig=gc)
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        _wait_running(eng_a, "mv", 5)
+
+        # the controller's scale-in order: fence routing first, then drain
+        assert client.remove_server(addr_a, reason="scale-in")
+        t0 = time.monotonic()
+        out = _post(addr_a, "/drain", {"grace_seconds": 0.0})
+        wall = time.monotonic() - t0
+        assert out["success"] and out["interrupted"] >= 1
+        assert out["wall_seconds"] < 10.0  # bounded by grace, not by max_new
+        assert wall < 30.0
+        # KV retained pinned on the drained server (reaped later by TTL)
+        assert eng_a.serving_stats()["retained_kv_slots"] >= 1
+
+        # readiness now refuses: no warmup probe re-admits this server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{addr_a}/ready", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["status"] == "draining"
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "client never completed after drain"
+        resp = result["resp"]
+        assert resp.stop_reason in ("stop", "length")
+        assert resp.output_tokens == ref.output_tokens  # token-exact failover
+        assert resp.output_versions == [0] * 600
+        # the tail ran on the survivor
+        assert client._rid_to_address.get("mv") == addr_b
+        assert eng_a.interrupts_by_reason.get("drain", 0) >= 1
+    finally:
+        client.destroy()
+        stop_a()
+        stop_b()

@@ -1,0 +1,455 @@
+"""Token-boundary interruption: interrupt/resume, preemption, drain, chaos.
+
+The tentpole contract (ISSUE 19): ``interrupt(rid, reason)`` stops a
+sequence at the next decode step with its KV retained PINNED and
+version-tagged; the re-issue of prompt+accumulated resumes with zero
+re-prefill (token-identical when no commit intervened), or — across a
+staged weight commit — recomputes only the uncovered suffix and continues
+on the NEW weights with per-token ``versions`` spanning the commit.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters, JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils import chaos
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(model, **kw):
+    cfg, params = model
+    defaults = dict(
+        max_batch_size=4,
+        max_seq_len=1024,
+        prefill_chunk=64,
+        decode_steps_per_call=4,
+        dtype="float32",
+    )
+    defaults.update(kw)
+    eng = GenerationEngine(
+        JaxGenConfig(**defaults), model_config=cfg, params=params
+    )
+    eng.start()
+    return eng
+
+
+def run_request(eng, rid, prompt, gconfig, timeout=120.0, **submit_kw):
+    done = threading.Event()
+    out = {}
+
+    def cb(r):
+        out["r"] = r
+        done.set()
+
+    eng.submit(rid, prompt, gconfig, cb, **submit_kw)
+    assert done.wait(timeout), "generation timed out"
+    return out["r"]
+
+
+def submit_async(eng, rid, prompt, gconfig, **submit_kw):
+    done = threading.Event()
+    out = {}
+    eng.submit(
+        rid, prompt, gconfig,
+        lambda r: (out.update(r=r), done.set()),
+        **submit_kw,
+    )
+    return done, out
+
+
+def wait_tokens(eng, rid, n=1, timeout=60.0):
+    """Block until ``rid`` is running and has emitted >= n tokens."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for seq in eng.slots:
+            if seq is not None and seq.rid == rid and len(seq.out_tokens) >= n:
+                return
+        time.sleep(0.01)
+    raise AssertionError(f"rid={rid} never reached {n} emitted token(s)")
+
+
+def _staged_commit(eng, params, version):
+    """One PR 5-style staged weight commit (stage off-thread, fenced flip)."""
+    named = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, path)
+            else:
+                named[path] = np.asarray(v)
+
+    walk(params, "")
+    eng.stage_weight_chunk(named, version=version)
+    eng.commit_staged_weights(version)
+
+
+def test_interrupt_mid_decode_exact_resume(model):
+    """interrupt() answers with stop_reason="interrupt" + pinned retained
+    KV; the prompt+accumulated re-issue resumes with ZERO re-prefill and
+    the greedy splice is token-identical to an uninterrupted run."""
+    eng = make_engine(model)
+    try:
+        prompt = [5, 9, 3, 7, 2]
+        g = GenerationHyperparameters(max_new_tokens=200, greedy=True)
+        full = run_request(eng, "ref", prompt, g)
+        assert len(full.output_tokens) == 200
+
+        done, out = submit_async(eng, "irq", prompt, g)
+        wait_tokens(eng, "irq")
+        eng.interrupt("irq", reason="manual")
+        assert done.wait(30)
+        part = out["r"]
+        assert part.stop_reason == "interrupt"
+        assert 0 < len(part.output_tokens) < 200
+        with eng._retained_lock:
+            ent = eng._retained["irq"]
+        assert ent.pinned and ent.version == 0
+        ss = eng.serving_stats()
+        assert ss["retained_kv_slots"] == 1
+        assert ss["retained_kv_bytes"] > 0
+        assert ss["interrupts_total"] == 1
+        assert eng.interrupts_by_reason == {"manual": 1}
+
+        prefills_before = eng.prefill_count
+        cont = run_request(
+            eng,
+            "irq",
+            prompt + list(part.output_tokens),
+            GenerationHyperparameters(
+                max_new_tokens=200 - len(part.output_tokens), greedy=True
+            ),
+        )
+        assert list(part.output_tokens) + list(cont.output_tokens) == list(
+            full.output_tokens
+        )
+        assert eng.prefill_count == prefills_before  # zero re-prefill
+        ss = eng.serving_stats()
+        assert ss["retained_kv_slots"] == 0  # no retained slot leaks
+        assert ss["resumed_total"] == 1
+        assert ss["resumed_tokens_total"] > 0
+        assert ss["resumed_across_commit_total"] == 0
+    finally:
+        eng.stop()
+
+
+def test_interrupt_resume_across_staged_commit_versions_span(model):
+    """The headline: interrupt -> staged commit -> resume. The retained
+    prefix keeps its old-version KV (accepted staleness), decode continues
+    on the NEW weights, and the spliced per-token versions span the
+    commit — exactly the trajectory shape decoupled PPO trains on."""
+    cfg, params = model
+    eng = make_engine(model)
+    try:
+        prompt = [4, 8, 15, 16, 23, 42]
+        g = GenerationHyperparameters(max_new_tokens=300, greedy=True)
+        done, out = submit_async(eng, "span", prompt, g)
+        wait_tokens(eng, "span", n=2)
+        eng.interrupt("span", reason="weight_swap")
+        assert done.wait(30)
+        part = out["r"]
+        assert part.stop_reason == "interrupt"
+        assert part.output_versions == [0] * len(part.output_tokens)
+
+        new_params = jax.tree.map(lambda x: x * 1.03, params)
+        _staged_commit(eng, new_params, version=1)
+        assert eng.get_version() == 1
+
+        prefills_before = eng.prefill_count
+        cont = run_request(
+            eng,
+            "span",
+            prompt + list(part.output_tokens),
+            GenerationHyperparameters(max_new_tokens=20, greedy=True),
+        )
+        assert len(cont.output_tokens) == 20
+        # every resumed token decoded under the committed weights
+        assert cont.output_versions == [1] * 20
+        # client-side splice (what the trainer sees): versions span the commit
+        spliced = list(part.output_versions) + list(cont.output_versions)
+        assert set(spliced) == {0, 1}
+        assert spliced == sorted(spliced)  # monotonic across the commit
+        assert eng.prefill_count == prefills_before  # still zero re-prefill
+        assert eng.resumed_across_commit_total == 1
+        ss = eng.serving_stats()
+        assert ss["resumed_across_commit_total"] == 1
+        assert ss["retained_kv_slots"] == 0
+    finally:
+        eng.stop()
+
+
+def test_resume_recomputes_only_uncovered_suffix(model):
+    """A re-issue LONGER than the retained coverage (the failover splice:
+    tokens decoded on a peer come back as prompt) recomputes only the
+    uncovered suffix — no full re-prefill — and the greedy continuation
+    stays token-identical to the uninterrupted reference."""
+    eng = make_engine(model)
+    try:
+        prompt = [7, 3, 11, 2, 19]
+        g = GenerationHyperparameters(max_new_tokens=400, greedy=True)
+        ref = run_request(eng, "sref", prompt, g)
+        assert len(ref.output_tokens) == 400
+
+        done, out = submit_async(eng, "sfx", prompt, g)
+        wait_tokens(eng, "sfx")
+        eng.interrupt("sfx", reason="drain")
+        assert done.wait(30)
+        part = out["r"]
+        k = len(part.output_tokens)
+        assert part.stop_reason == "interrupt"
+        assert list(part.output_tokens) == list(ref.output_tokens[:k])
+        assert k + 5 < 400, "interrupt landed too late for a suffix resume"
+
+        # simulate 5 tokens decoded elsewhere: the re-issue covers MORE
+        # than the retained KV, so resume must extend by exactly 5 tokens
+        m = 5
+        extra = list(ref.output_tokens[k: k + m])
+        prefills_before = eng.prefill_count
+        cont = run_request(
+            eng,
+            "sfx",
+            prompt + list(part.output_tokens) + extra,
+            GenerationHyperparameters(max_new_tokens=400 - k - m, greedy=True),
+        )
+        assert list(cont.output_tokens) == list(ref.output_tokens[k + m:])
+        assert eng.prefill_count == prefills_before
+        assert eng.resume_suffix_recomputed_tokens_total == m
+        assert eng.serving_stats()["retained_kv_slots"] == 0
+    finally:
+        eng.stop()
+
+
+def test_retained_ttl_reaper(model):
+    """Hygiene satellite: a disconnected client's retained entry is reaped
+    after retained_kv_ttl_seconds instead of pinning KV until LRU
+    pressure, and the reap is visible in serving_stats()."""
+    eng = make_engine(model, retained_kv_ttl_seconds=0.2)
+    try:
+        prompt = [1, 2, 3, 4]
+        done, out = submit_async(
+            eng, "leak", prompt,
+            GenerationHyperparameters(max_new_tokens=300, greedy=True),
+        )
+        wait_tokens(eng, "leak")
+        eng.interrupt("leak", reason="manual")
+        assert done.wait(30)
+        assert out["r"].stop_reason == "interrupt"
+        assert eng.serving_stats()["retained_kv_slots"] == 1
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            # the reaper runs on the engine loop; poke it awake
+            eng._wake.set()
+            if eng.serving_stats()["retained_kv_slots"] == 0:
+                break
+            time.sleep(0.05)
+        ss = eng.serving_stats()
+        assert ss["retained_kv_slots"] == 0
+        assert ss["retained_kv_reaped_total"] == 1
+        with eng._retained_lock:
+            assert "leak" not in eng._retained
+    finally:
+        eng.stop()
+
+
+def test_priority_preemption_requeues_victim(model):
+    """A strictly-higher-priority request that cannot be admitted preempts
+    the lowest-priority victim: the victim's KV is retained pinned, it
+    requeues at its original position WITHOUT a client-visible response,
+    and resumes with zero recompute — its final output is token-identical
+    to an uninterrupted run."""
+    eng = make_engine(
+        model,
+        max_batch_size=2,
+        # one 128-token page is the whole budget: while the victim holds
+        # its block, ANY new admission fails admission control and must
+        # preempt to proceed
+        admission_token_budget=128,
+    )
+    try:
+        v_prompt = [5, 9, 3, 7, 2]
+        h_prompt = [60, 61, 62]
+        g = GenerationHyperparameters(max_new_tokens=100, greedy=True)
+        v_ref = run_request(eng, "vref", v_prompt, g)
+        h_ref = run_request(eng, "href", h_prompt, g)
+
+        v_done, v_out = submit_async(eng, "victim", v_prompt, g, priority=0)
+        wait_tokens(eng, "victim")
+        h_done, h_out = submit_async(eng, "vip", h_prompt, g, priority=5)
+        assert h_done.wait(60)
+        assert v_done.wait(60)
+
+        assert eng.preemptions_total == 1
+        assert eng.interrupts_by_reason.get("preempt") == 1
+        # the victim's client saw ONE response with the FULL output: the
+        # preemption round-trip (retain pinned -> requeue -> exact resume)
+        # was invisible except in the counters
+        v = v_out["r"]
+        assert v.stop_reason == v_ref.stop_reason
+        assert list(v.output_tokens) == list(v_ref.output_tokens)
+        assert list(h_out["r"].output_tokens) == list(h_ref.output_tokens)
+        ss = eng.serving_stats()
+        assert ss["preemptions_total"] == 1
+        assert ss["resumed_total"] >= 1
+        assert ss["retained_kv_slots"] == 0
+    finally:
+        eng.stop()
+
+
+def test_interrupt_queued_request_answers_immediately(model):
+    """A rid still waiting in the admission queue answers its interrupt
+    with zero tokens instead of waiting for a slot."""
+    eng = make_engine(model, max_batch_size=1)
+    try:
+        g = GenerationHyperparameters(max_new_tokens=500, greedy=True)
+        a_done, a_out = submit_async(eng, "hog", [1, 2, 3], g)
+        wait_tokens(eng, "hog")
+        b_done, b_out = submit_async(eng, "queued", [4, 5, 6], g)
+        eng.interrupt("queued", reason="manual")
+        assert b_done.wait(10)
+        assert b_out["r"].stop_reason == "interrupt"
+        assert b_out["r"].output_tokens == []
+        eng.interrupt("hog", reason="manual")
+        assert a_done.wait(10)
+        assert a_out["r"].stop_reason == "interrupt"
+    finally:
+        eng.stop()
+
+
+def test_interrupt_all_drain_is_bounded(model):
+    """interrupt_all("drain") with every slot mid-decode completes in
+    ~one decode chunk, not max-generation-length; every sequence answers
+    "interrupt" with retained KV, and exact resumes drain the retained
+    map back to zero (the acceptance invariant)."""
+    eng = make_engine(model)
+    try:
+        g = GenerationHyperparameters(max_new_tokens=900, greedy=True)
+        waiters = []
+        for i in range(4):
+            d, o = submit_async(eng, f"d{i}", [10 + i, 20 + i, 3], g)
+            waiters.append((d, o))
+        for i in range(4):
+            wait_tokens(eng, f"d{i}")
+        assert eng.n_running == 4
+
+        t0 = time.monotonic()
+        eng.interrupt_all("drain")
+        wall = time.monotonic() - t0
+        for d, _ in waiters:
+            assert d.wait(10)
+        # bounded by one decode chunk + fan-out, nowhere near the ~900
+        # tokens x 4 slots an un-interrupted drain would decode
+        assert wall < 30.0
+        for _, o in waiters:
+            assert o["r"].stop_reason == "interrupt"
+        ss = eng.serving_stats()
+        assert ss["retained_kv_slots"] == 4
+        assert ss["interrupts_total"] == 4
+        assert eng.interrupts_by_reason == {"drain": 4}
+        assert eng.n_pending_work == 0
+
+        # token-exact resume of every drained sequence -> no retained leaks
+        for i, (_, o) in enumerate(waiters):
+            part = o["r"]
+            cont = run_request(
+                eng,
+                f"d{i}",
+                [10 + i, 20 + i, 3] + list(part.output_tokens),
+                GenerationHyperparameters(max_new_tokens=4, greedy=True),
+            )
+            assert len(cont.output_tokens) == 4
+        assert eng.serving_stats()["retained_kv_slots"] == 0
+    finally:
+        eng.stop()
+
+
+def test_chaos_interrupt_fires_mid_commit(model, monkeypatch):
+    """AREAL_CHAOS_INTERRUPT=mid-commit fires a deterministic interrupt
+    right after a staged weight commit flips — the adversarial point where
+    retained KV and the new version first coexist."""
+    monkeypatch.setenv(chaos.INTERRUPT_CHAOS_ENV, "mid-commit")
+    chaos.reset_interrupt_points()
+    cfg, params = model
+    eng = make_engine(model)
+    try:
+        done, out = submit_async(
+            eng, "cc", [9, 8, 7],
+            GenerationHyperparameters(max_new_tokens=400, greedy=True),
+        )
+        wait_tokens(eng, "cc")
+        _staged_commit(
+            eng, jax.tree.map(lambda x: x * 1.01, params), version=1
+        )
+        assert done.wait(30)
+        part = out["r"]
+        assert part.stop_reason == "interrupt"
+        assert eng.interrupts_by_reason.get("chaos") == 1
+        # pre-commit decode is all v0; the retained entry is tagged with
+        # the freshly-committed version the resume will decode under
+        assert part.output_versions == [0] * len(part.output_tokens)
+        cont = run_request(
+            eng,
+            "cc",
+            [9, 8, 7] + list(part.output_tokens),
+            GenerationHyperparameters(max_new_tokens=6, greedy=True),
+        )
+        assert cont.output_versions == [1] * 6
+        assert eng.serving_stats()["retained_kv_slots"] == 0
+    finally:
+        eng.stop()
+        chaos.reset_interrupt_points()
+
+
+def test_chaos_interrupt_mid_chunked_prefill(model, monkeypatch):
+    """AREAL_CHAOS_INTERRUPT=mid-chunked-prefill cancels an intra-prompt
+    warm between chunks: the partial KV is discarded (it must not straddle
+    a commit) and the client gets a clean zero-token interrupt."""
+    monkeypatch.setenv(chaos.INTERRUPT_CHAOS_ENV, "mid-chunked-prefill")
+    chaos.reset_interrupt_points()
+    eng = make_engine(model, chunked_prefill_tokens=32)
+    try:
+        long_prompt = list(np.arange(100) % 120)
+        done, out = submit_async(
+            eng, "warm", long_prompt,
+            GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        )
+        assert done.wait(60)
+        r = out["r"]
+        assert r.stop_reason == "interrupt"
+        assert r.output_tokens == []
+        assert eng.interrupts_by_reason.get("chaos") == 1
+        ss = eng.serving_stats()
+        assert ss["retained_kv_slots"] == 0  # warming KV is never retained
+        # the engine stays healthy: the same prompt admits and finishes
+        chaos.reset_interrupt_points()
+        monkeypatch.delenv(chaos.INTERRUPT_CHAOS_ENV)
+        r2 = run_request(
+            eng, "warm", long_prompt,
+            GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        )
+        assert len(r2.output_tokens) == 8
+    finally:
+        eng.stop()
+        chaos.reset_interrupt_points()

@@ -1,0 +1,184 @@
+"""Mixed-version trajectory -> decoupled-PPO correctness (ISSUE 19
+satellite): a trajectory whose per-token ``versions`` span a weight commit
+(interrupt -> staged commit -> in-flight resume) must flow through the
+decoupled objective with the behavior-policy importance correction applied
+PER TOKEN — each token is reweighted by exp(proximal - behavioral) against
+the logprob of the policy version that actually sampled it, not a
+per-sequence average. Pinned hand-computed vs both the jitted loss and its
+host stats mirror, plus the rl_health version-mix fraction that makes the
+commit-crossing visible.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import PPOActorConfig, RLHealthConfig
+from areal_tpu.utils.flight_recorder import FlightRecorder
+from areal_tpu.utils.functional import ppo_actor_loss_fn, ppo_loss_stats_host
+from areal_tpu.utils.metrics import MetricsRegistry
+from areal_tpu.utils.rl_health import RLHealthMonitor
+
+
+def _commit_spanning_batch():
+    """Two sequences of 2 prompt + 4 generated tokens. Sequence 0 was
+    interrupted after 2 tokens at version 0 and resumed after a staged
+    commit at version 1 (versions [0, 0, 1, 1] — the in-flight weight-swap
+    trajectory); sequence 1 decoded entirely at version 1. ``old`` holds
+    the BEHAVIOR logprobs — the log-likelihoods under the policy version
+    that actually sampled each token, so they jump at the commit boundary —
+    and ``prox`` holds the trainer's recompute under the current policy."""
+    lm = np.array(
+        [[0, 0, 1, 1, 1, 1], [0, 0, 1, 1, 1, 1]], np.int64
+    )
+    old = np.array(
+        [
+            # v0 segment samples at -1.0; the post-commit v1 segment at -0.4
+            [0.0, 0.0, -1.0, -1.0, -0.4, -0.4],
+            [0.0, 0.0, -0.5, -0.5, -0.5, -0.5],
+        ],
+        np.float32,
+    )
+    prox = np.array(
+        [
+            [0.0, 0.0, -0.7, -1.0, -0.4, -0.4 + math.log(2.0)],
+            [0.0, 0.0, -0.5, -0.5 + math.log(0.5), -0.5, -0.5],
+        ],
+        np.float32,
+    )
+    # current policy == proximal policy here (no minibatch lag), so the
+    # PPO ratio is exactly 1 and the loss isolates the behavior correction
+    lp = prox.copy()
+    adv = np.array(
+        [[0.0, 0.0, 1.0, -1.0, 2.0, 1.0], [0.0, 0.0, 1.0, 1.0, -2.0, 1.0]],
+        np.float32,
+    )
+    versions = np.array(
+        [[-1, -1, 0, 0, 1, 1], [-1, -1, 1, 1, 1, 1]], np.int64
+    )
+    return lm, old, prox, lp, adv, versions
+
+
+def test_per_token_behavior_correction_hand_computed():
+    """The decoupled objective's behavior weights across the commit,
+    by hand: behav_imp_weight = exp(prox - old) PER TOKEN."""
+    lm, old, prox, lp, adv, _ = _commit_spanning_batch()
+    mask = lm.astype(bool)
+
+    stats = ppo_loss_stats_host(
+        logprobs=lp,
+        proximal_logprobs=prox,
+        old_logprobs=old,
+        advantages=adv,
+        loss_mask=lm,
+        eps_clip=0.2,
+    )
+    # hand-computed per-token behavior weights; the stale (v0-sampled)
+    # tokens of sequence 0 get exp(prox - old) != 1, its fresh v1 tokens
+    # and the single-version sequence stay at (or near) 1
+    expect = np.where(mask, np.exp(prox - old), 0.0)
+    np.testing.assert_allclose(
+        stats["behave_imp_weight"], expect, rtol=1e-6
+    )
+    # spot pins across the commit boundary of sequence 0:
+    np.testing.assert_allclose(
+        stats["behave_imp_weight"][0, 2], math.exp(0.3), rtol=1e-6
+    )  # v0-sampled token, corrected
+    np.testing.assert_allclose(
+        stats["behave_imp_weight"][0, 3], 1.0, rtol=1e-6
+    )  # v0-sampled token whose recompute agrees
+    np.testing.assert_allclose(
+        stats["behave_imp_weight"][0, 4], 1.0, rtol=1e-6
+    )  # post-commit token: behavior == proximal
+    np.testing.assert_allclose(
+        stats["behave_imp_weight"][0, 5], 2.0, rtol=1e-6
+    )  # post-commit token the new policy likes 2x more
+    # PPO ratio is 1 everywhere (lp == prox): no clipping anywhere
+    assert not stats["clip_mask"].any()
+
+    # the jitted loss applies exactly these weights: with ratio == 1,
+    # loss = mean over masked tokens of (-adv * behav_imp_weight)
+    loss, jstats = ppo_actor_loss_fn(
+        logprobs=jnp.asarray(lp),
+        proximal_logprobs=jnp.asarray(prox),
+        old_logprobs=jnp.asarray(old),
+        advantages=jnp.asarray(adv),
+        eps_clip=0.2,
+        loss_mask=jnp.asarray(lm),
+    )
+    hand_loss = float((-adv * expect)[mask].sum() / mask.sum())
+    np.testing.assert_allclose(float(loss), hand_loss, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jstats["behave_imp_weight"]), expect, rtol=1e-6
+    )
+
+
+def test_behav_cap_excludes_stale_outlier_tokens():
+    """behav_imp_weight_cap masks individual runaway-stale tokens out of
+    the objective without dropping the rest of the (mixed-version)
+    sequence."""
+    lm, old, prox, lp, adv, _ = _commit_spanning_batch()
+    cap = 1.5
+    stats = ppo_loss_stats_host(
+        logprobs=lp,
+        proximal_logprobs=prox,
+        old_logprobs=old,
+        advantages=adv,
+        loss_mask=lm,
+        eps_clip=0.2,
+        behav_imp_weight_cap=cap,
+    )
+    raw = np.where(lm.astype(bool), np.exp(prox - old), 0.0)
+    capped_out = (raw > cap) & lm.astype(bool)
+    assert capped_out.sum() == 1  # exactly the exp(log 2) = 2.0 token
+    assert not stats["behave_mask"][0, 5]
+    assert stats["behave_imp_weight"][0, 5] == 0.0
+    # its neighbors (same sequence, same resume) still train
+    assert stats["behave_mask"][0, 2] and stats["behave_mask"][0, 4]
+
+    loss, _ = ppo_actor_loss_fn(
+        logprobs=jnp.asarray(lp),
+        proximal_logprobs=jnp.asarray(prox),
+        old_logprobs=jnp.asarray(old),
+        advantages=jnp.asarray(adv),
+        eps_clip=0.2,
+        loss_mask=jnp.asarray(lm),
+        behav_imp_weight_cap=cap,
+    )
+    expect = np.where(capped_out, 0.0, raw)
+    hand_loss = float(
+        (-adv * expect)[lm.astype(bool)].sum() / lm.astype(bool).sum()
+    )
+    np.testing.assert_allclose(float(loss), hand_loss, rtol=1e-6)
+
+
+def test_rl_health_reports_version_mix_of_resumed_trajectories():
+    """The observatory's version_mix_frac counts exactly the sequences
+    whose generated tokens span >1 weight version — the live signal that
+    in-flight weight swaps are producing commit-crossing trajectories."""
+    lm, old, prox, lp, adv, versions = _commit_spanning_batch()
+    m = RLHealthMonitor.from_config(
+        RLHealthConfig(consecutive=1, publish_status=False),
+        registry=MetricsRegistry(),
+        recorder=FlightRecorder(),
+    )
+    assert m is not None
+    m.observe_train_batch(
+        dict(
+            loss_mask=lm,
+            logprobs=old,
+            prox_logp=prox,
+            advantages=adv,
+            versions=versions,
+        ),
+        current_version=1,
+        actor_config=PPOActorConfig(path=""),
+    )
+    row = m.end_step(0)
+    # sequence 0 spans {0, 1}; sequence 1 is pure v1
+    assert row["rl_health/version_mix_frac"] == pytest.approx(0.5)
+    # staleness lags vs current_version=1: seq0 gen = [1,1,0,0], seq1 all 0
+    assert row["rl_health/staleness_mean"] == pytest.approx(2 / 8)
+    assert row["rl_health/staleness_max"] == 1.0
