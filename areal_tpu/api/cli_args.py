@@ -659,6 +659,17 @@ class JaxGenConfig:
     pp_rotate_decode: bool = True
     random_seed: int = 1
     skip_tokenizer_init: bool = False
+    # serving role under prefill/decode disaggregation: "" (generalist,
+    # the single-pool default), "prefill" (computes prompt KV, samples the
+    # first token, retains the blocks pinned for export to a decode peer),
+    # or "decode" (imports shipped KV over POST /import_kv and drives
+    # decode; chunked-prefill interleaving is disabled so decode batches
+    # stay dense — a fallback full prefill after a refused import still
+    # works, it just dispatches whole-prompt). The role rides /ready and
+    # /model_info and is registered in name_resolve for role-aware client
+    # routing. Overridable via the AREAL_SERVER_ROLE env var set by the
+    # fleet provider.
+    role: str = ""
     # keep aborted requests' KV in their slots, keyed by rid; the client's
     # abort-resume loop then continues decoding with ZERO re-prefill. The
     # retained attention state may predate a weight update (accepted
@@ -698,6 +709,20 @@ class JaxGenConfig:
     # max draft tokens proposed (and verified) per slot per window; the
     # verify dispatch feeds 1 + spec_draft_len tokens per slot
     spec_draft_len: int = 4
+    # adaptive per-sequence draft length: each slot keeps an EWMA of its
+    # own acceptance rate and its draft length tracks
+    # min + ewma * (max - min) (rounded, clamped), so low-acceptance
+    # prompts stop paying dead verify FLOPs while repetitive ones keep the
+    # full window. Bounds: [spec_draft_len_min, spec_draft_len_max];
+    # spec_draft_len_max = 0 means "= spec_draft_len" (adaptation can
+    # shrink but never grow past the static knob — compiled verify shapes
+    # are unchanged). spec_draft_len_min = 0 disables adaptation entirely
+    # (every slot stays at the static spec_draft_len).
+    spec_draft_len_min: int = 1
+    spec_draft_len_max: int = 0
+    # EWMA smoothing for the per-slot acceptance estimate (higher = adapt
+    # faster, noisier)
+    spec_adapt_alpha: float = 0.25
     # n-gram match lengths tried longest-first when proposing: the last
     # spec_ngram_max..spec_ngram_min tokens are matched against the
     # sequence's own prompt + output history
@@ -765,6 +790,38 @@ class CircuitBreakerConfig:
 
 
 @dataclass
+class DisaggregationConfig:
+    """Prefill/decode disaggregated serving (client plane). When enabled,
+    ``agenerate`` dispatches the prompt to a prefill-role server (one
+    sampled token), asks it to ship the finished KV blocks to a
+    decode-role server over ``POST /import_kv`` (versioned, digest-stamped
+    chunks on the `utils/wire.py` encode path), and drives decode there —
+    the decode server admits the sequence through the retained-KV resume
+    path with ZERO re-prefill. Every failure mode is loud and counted,
+    never silent: a weight commit landing between prefill and import makes
+    the import refuse with 412 and the client falls back to a local full
+    prefill on the decode server; the prefill server dying mid-ship takes
+    the token-exact re-prefill failover path. Off (the default) leaves the
+    single-pool serving plane byte-identical."""
+
+    enabled: bool = False
+    # max tokens sampled on the prefill server before handoff (the first
+    # token rides back with the prefill response and seeds decode)
+    prefill_max_tokens: int = 1
+    # KV-ship chunking: target encoded bytes per POST /import_kv chunk
+    kv_ship_chunk_mb: int = 8
+    # bounded pipelining for the ship stream (chunks in flight ahead of
+    # the receiver; same backpressure discipline as weight streaming)
+    kv_ship_pipeline_depth: int = 2
+    # whole-ship wall budget (export + every chunk + commit); a breach
+    # falls back to a local full prefill on the decode server
+    kv_ship_timeout_seconds: float = 120.0
+    # prompts shorter than this skip disaggregation (shipping KV for a
+    # tiny prompt costs more than re-prefilling it locally)
+    min_prompt_tokens: int = 0
+
+
+@dataclass
 class FleetConfig:
     """Elastic rollout-fleet controller (areal_tpu/fleet/): closes the loop
     from observed serving load (admission queue depth/wait, TTFT p95,
@@ -805,6 +862,21 @@ class FleetConfig:
     # scale OUT when the trainer's rollout-wait fraction (PR 9 StepTimeline
     # counters: blocked-in-wait() wall over elapsed wall) exceeds this
     rollout_wait_fraction_high: float = 0.0
+    # scale OUT when the fleet-max inter-token-latency p95 exceeds this
+    # (seconds). Primarily a DECODE-pool signal under disaggregation, but
+    # honored in single-pool mode too when set.
+    itl_p95_high_seconds: float = 0.0
+    # --- per-role pools (prefill/decode disaggregation) ---
+    # When serving.disaggregation is enabled the controller runs one
+    # policy instance per role: the prefill pool scales on admission-queue
+    # wait/TTFT and the decode pool on ITL p95/in-flight. Each pool gets
+    # its own size bounds below; min_servers/max_servers above then bound
+    # the TOTAL. Roles ride the spawn env (AREAL_SERVER_ROLE), /ready,
+    # warmup, and the name_resolve role tags the client routes on.
+    prefill_min_servers: int = 1
+    prefill_max_servers: int = 2
+    decode_min_servers: int = 1
+    decode_max_servers: int = 4
     # --- lifecycle ---
     # newcomer must pass GET /ready (model loaded AND weights at the
     # required version) within this budget or it is terminated and never
@@ -1006,6 +1078,10 @@ class InferenceEngineConfig:
     # the ceiling, scale-in lowers it) instead of being frozen at the
     # boot-time server count. None keeps the static max_concurrent_rollouts
     rollouts_per_server: int | None = None
+    # prefill/decode disaggregated serving (KV shipping + role routing)
+    disaggregation: DisaggregationConfig = field(
+        default_factory=DisaggregationConfig
+    )
     # elastic rollout-fleet controller (areal_tpu/fleet/)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     # client-side deterministic fault injection (tests/rehearsals)

@@ -109,6 +109,11 @@ DIRECTION_OVERRIDES: dict[str, bool] = {
     # heuristic); greedy identity and commit-spanning versions gate
     # in-child, the sentinel watches the token-boundary latency trend
     "inflight_weight_swap": True,
+    # decode ITL p95 ratio colocated/disaggregated: higher is better (a
+    # ratio, so the name heuristic reads nothing); greedy identity,
+    # all-requests-shipped and the 412 weight fence gate in-child, the
+    # sentinel watches the isolation benefit trend
+    "disaggregated_serving": False,
 }
 
 
@@ -135,6 +140,12 @@ BAND_FLOOR_OVERRIDES: dict[str, float] = {
     "chunked_prefill_attention": 0.25,
     "kv_quant_decode": 0.25,
     "inflight_weight_swap": 0.50,
+    # a ratio of two CPU-rehearsal latency p95s over a tiny model: both
+    # numerator and denominator are host-scheduling dominated, so the
+    # ratio is legitimately noisy run-to-run; a genuine break (the
+    # decode pool prefilling again, or ships silently falling back)
+    # trips the in-child hard gates long before the trend could
+    "disaggregated_serving": 0.35,
 }
 
 

@@ -151,6 +151,19 @@ class RemoteInfEngine(InferenceEngine):
         # then the per-request accounting leaf. Never acquire upward.
         # lock_order: _membership_lock -> _push_lock -> _inflight_lock
         self._membership_lock = threading.RLock()
+        # disaggregated serving: addr -> role ("" generalist | "prefill" |
+        # "decode"), learned from the name_resolve role subtree and lazily
+        # from /ready; None = not yet probed (retry next time)
+        self._server_roles: dict[str, str | None] = {}
+        # one labeled counter tells the whole disagg story per request:
+        # outcome=shipped is the win path, every fallback_* is a LOUD
+        # counted degradation to local prefill (never silent)
+        self._kv_ship_counter = _metrics.DEFAULT_REGISTRY.counter(
+            "areal_client_kv_ship_total",
+            "disaggregated prefill->decode KV ships by outcome "
+            "(fallback_* = local full prefill on the decode pool)",
+            labels=("outcome",),
+        )
 
     # ------------------------------------------------------------------
     # lifecycle / discovery
@@ -205,6 +218,7 @@ class RemoteInfEngine(InferenceEngine):
         while time.monotonic() < deadline:
             addrs = name_resolve.get_subtree(key)
             if addrs:
+                self._refresh_roles_from_name_resolve()
                 return sorted(addrs)
             time.sleep(1.0)
         raise TimeoutError(
@@ -271,6 +285,27 @@ class RemoteInfEngine(InferenceEngine):
         self._refresh_missing = gone - confirmed
         for a in sorted(confirmed):
             self.remove_server(a, reason="deregistered")
+        self._refresh_roles_from_name_resolve()
+
+    def _refresh_roles_from_name_resolve(self):
+        """Fold the role subtree ("addr role" entries registered by
+        role-tagged servers) into the addr -> role map. Cheap no-op when
+        disaggregation is off — generalist fleets register no roles."""
+        if not self.config.disaggregation.enabled:
+            return
+        try:
+            entries = name_resolve.get_subtree(
+                names.gen_server_roles(
+                    self.config.experiment_name, self.config.trial_name
+                )
+            )
+        except Exception as e:
+            logger.debug("role refresh failed: %s", e)
+            return
+        for ent in entries:
+            parts = str(ent).split()
+            if len(parts) == 2 and parts[1] in ("prefill", "decode"):
+                self._server_roles[parts[0]] = parts[1]
 
     # ------------------------------------------------------------------
     # push-aware membership (elastic fleet)
@@ -337,6 +372,7 @@ class RemoteInfEngine(InferenceEngine):
             ]:
                 self._drop_rid_affinity(rid)
             self._health.forget(addr)
+            self._server_roles.pop(addr, None)
             self.executor.on_fleet_resize(len(self.addresses))
             logger.info(
                 "membership: %s left the rotation (%s; fleet=%d)",
@@ -574,6 +610,7 @@ class RemoteInfEngine(InferenceEngine):
         rid: str | None = None,
         avoid: set[str] | None = None,
         affinity_key: bytes | None = None,
+        role: str | None = None,
     ) -> str:
         """Pick a server, routing around OPEN breakers. ``avoid`` holds
         addresses that already failed THIS request (failover re-dispatch
@@ -587,29 +624,54 @@ class RemoteInfEngine(InferenceEngine):
         prefix land where that prefix's KV is already cached. Priority
         order: rid affinity (the server holds this request's exact
         in-flight KV) > breaker state (an OPEN server gets no traffic,
-        affinity or not) > prefix affinity > load policy."""
+        affinity or not) > prefix affinity > load policy.
+
+        ``role`` (disaggregated serving) restricts every candidate set to
+        servers tagged with that role ("prefill" | "decode"); raises
+        :class:`LookupError` when the rotation holds none — the caller
+        falls back to the single-pool path, loudly and counted."""
         policy = self.config.schedule_policy
         if policy not in ("round_robin", "least_loaded"):
             raise NotImplementedError(policy)
         self._maybe_refresh_servers()
         avoid = avoid or set()
+        if role is None:
+            addresses = self.addresses
+        else:
+            addresses = [
+                a
+                for a in self.addresses
+                if self._server_roles.get(a) == role
+            ]
+            if not addresses:
+                raise LookupError(
+                    f"no servers with role={role!r} in rotation "
+                    f"(fleet={len(self.addresses)})"
+                )
         if rid is not None and rid in self._rid_to_address:
             cached = self._rid_to_address[rid]
-            if cached not in avoid and self._health.routable(cached):
+            if (
+                cached in addresses
+                and cached not in avoid
+                and self._health.routable(cached)
+            ):
                 # KV-prefix affinity beats load balance (reference gserver
                 # routes resumed qids back to their server for cache reuse)
                 return cached
             # the server holding this rid's KV tripped its breaker (or just
             # failed this request): the affinity is void — KV is lost,
-            # correctness is not, the accumulated tokens replay as prompt
-            self._drop_rid_affinity(rid)
+            # correctness is not, the accumulated tokens replay as prompt.
+            # (A role-restricted pick keeps the affinity: the cached addr
+            # merely has the wrong role for THIS leg of the request.)
+            if role is None:
+                self._drop_rid_affinity(rid)
         candidates = [
             a
-            for a in self.addresses
+            for a in addresses
             if a not in avoid and self._health.routable(a)
         ]
         if not candidates:
-            candidates = [a for a in self.addresses if self._health.routable(a)]
+            candidates = [a for a in addresses if self._health.routable(a)]
         if not candidates:
             # every breaker is open: kick off a discovery refresh (threaded
             # — any newly registered server joins a LATER decision) and
@@ -618,8 +680,8 @@ class RemoteInfEngine(InferenceEngine):
             # closes its breaker this way. Rotate among equally-bad servers
             # so repeated failovers of one request spread across the fleet.
             self._maybe_refresh_servers(force=True)
-            pool = [a for a in self.addresses if a not in avoid] or list(
-                self.addresses
+            pool = [a for a in addresses if a not in avoid] or list(
+                addresses
             )
             tied = sorted(self._health.least_bad(pool))
             addr = tied[self._server_idx % len(tied)]
@@ -687,6 +749,158 @@ class RemoteInfEngine(InferenceEngine):
             self._rid_queue.remove(rid)
         except ValueError:
             pass
+
+    # ------------------------------------------------------------------
+    # disaggregated serving (prefill pool -> KV ship -> decode pool)
+    # ------------------------------------------------------------------
+
+    async def _ensure_roles(self, session: aiohttp.ClientSession) -> None:
+        """Lazily learn roles for addresses the name_resolve subtree did
+        not cover (env/explicit address lists): one ``GET /ready`` per
+        unknown address — its JSON carries the role. A failed probe stays
+        unknown and retries on the next disaggregated request."""
+        unknown = [a for a in self.addresses if a not in self._server_roles]
+        if not unknown:
+            return
+
+        async def probe(a: str) -> None:
+            try:
+                async with session.get(
+                    f"http://{a}/ready",
+                    timeout=aiohttp.ClientTimeout(total=5.0),
+                ) as resp:
+                    if resp.status == 200:
+                        data = await resp.json()
+                        self._server_roles[a] = str(data.get("role") or "")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug("role probe of %s failed: %s", a, e)
+
+        await asyncio.gather(*(probe(a) for a in unknown))
+
+    async def _disagg_prefill_ship(
+        self, req: ModelRequest, session, prompt: list[int], span
+    ):
+        """The disaggregated first leg: run the prompt's prefill on a
+        prefill-pool server (``prefill_only`` — its KV is retained pinned),
+        have that server ship the KV straight to a decode-pool server via
+        ``/ship_kv`` -> ``/import_kv``, and hand back
+        ``(prefill_result, decode_addr)`` so the caller's resume loop
+        drives decode there with zero re-prefill.
+
+        Every degradation returns None or ships nothing — ALWAYS loudly
+        counted in ``areal_client_kv_ship_total{outcome=...}``:
+
+        - no prefill/decode-role servers in rotation -> single-pool path;
+        - prefill dispatch failed -> single-pool path (full prefill);
+        - ship refused 412 (a weight commit landed between prefill and
+          import) or failed in transport -> the sampled tokens are KEPT
+          (same splice semantics as an interrupt across a commit) and the
+          decode server full-prefills locally — correct, just not fast."""
+        disagg = self.config.disaggregation
+        gconfig = req.gconfig
+        await self._ensure_roles(session)
+        try:
+            prefill_addr = self.choose_server(
+                affinity_key=self.prefix_affinity_key(prompt),
+                role="prefill",
+            )
+            decode_addr = self.choose_server(role="decode")
+        except LookupError as e:
+            self._kv_ship_counter.labels(
+                outcome="fallback_no_role_servers"
+            ).inc()
+            logger.debug("disagg fallback for rid=%s: %s", req.rid, e)
+            return None
+        payload = {
+            "rid": req.rid,
+            "input_ids": prompt,
+            "prefill_only": True,
+            "priority": int((req.metadata or {}).get("priority", 0) or 0),
+            "sampling_params": {
+                "max_new_tokens": max(1, disagg.prefill_max_tokens),
+                "greedy": gconfig.greedy,
+                "temperature": gconfig.temperature,
+                "top_p": gconfig.top_p,
+                "top_k": gconfig.top_k,
+                "stop_token_ids": gconfig.stop_token_ids,
+                "stop": gconfig.stop,
+            },
+        }
+        headers = None
+        if span is not None:
+            span.event("disagg_prefill", addr=prefill_addr)
+            headers = {tracing.TRACE_HEADER: span.header()}
+        try:
+            result = await arequest_with_retry(
+                session,
+                f"http://{prefill_addr}/generate",
+                payload=payload,
+                max_retries=self.config.request_retries,
+                timeout=self.config.request_timeout,
+                chaos=self._chaos,
+                headers=headers,
+            )
+        except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+            self._kv_ship_counter.labels(
+                outcome="fallback_prefill_failed"
+            ).inc()
+            logger.warning(
+                "disagg prefill of rid=%s on %s failed (%s); falling back "
+                "to single-pool generation", req.rid, prefill_addr, e,
+            )
+            return None
+        if not result["output_tokens"]:
+            # paused/aborted before the first token: nothing to ship and
+            # nothing gained — let the single-pool loop handle the wait
+            self._kv_ship_counter.labels(
+                outcome="fallback_prefill_failed"
+            ).inc()
+            return None
+        from areal_tpu.utils import propagation
+
+        token = self._relay_token()
+        ship_headers = (
+            {propagation.RELAY_TOKEN_HEADER: token} if token else None
+        )
+        try:
+            await arequest_with_retry(
+                session,
+                f"http://{prefill_addr}/ship_kv",
+                payload={
+                    "rid": req.rid,
+                    "target": decode_addr,
+                    "chunk_mb": disagg.kv_ship_chunk_mb,
+                    "pipeline_depth": disagg.kv_ship_pipeline_depth,
+                    "timeout": disagg.kv_ship_timeout_seconds,
+                },
+                max_retries=1,
+                timeout=disagg.kv_ship_timeout_seconds,
+                chaos=self._chaos,
+                headers=ship_headers,
+            )
+            self._kv_ship_counter.labels(outcome="shipped").inc()
+            if span is not None:
+                span.event(
+                    "kv_ship", source=prefill_addr, target=decode_addr
+                )
+        except (HTTPRequestError, *TRANSPORT_ERRORS) as e:
+            outcome = (
+                "fallback_version_fence"
+                if isinstance(e, HTTPRequestError) and e.status == 412
+                else "fallback_ship_failed"
+            )
+            self._kv_ship_counter.labels(outcome=outcome).inc()
+            logger.warning(
+                "KV ship of rid=%s %s -> %s did not land (%s): decode "
+                "server will re-prefill locally (tokens kept — same "
+                "splice as an interrupt)",
+                req.rid, prefill_addr, decode_addr, e,
+            )
+            if span is not None:
+                span.event("kv_ship_fallback", reason=outcome)
+        return result, decode_addr
 
     # ------------------------------------------------------------------
     # generation (interrupt loop)
@@ -762,6 +976,30 @@ class RemoteInfEngine(InferenceEngine):
         # re-issue of this request — and every sibling of its GRPO group —
         # hashes identically, so they all prefer the same server's cache
         affinity_key = self.prefix_affinity_key(prompt)
+        disagg = self.config.disaggregation
+        if (
+            disagg.enabled
+            and not encoded_images
+            and max_new > 1
+            and len(prompt) >= max(0, disagg.min_prompt_tokens)
+        ):
+            pre = await self._disagg_prefill_ship(req, session, prompt, span)
+            if pre is not None:
+                result, decode_addr = pre
+                accumulated += result["output_tokens"]
+                logprobs += result["output_logprobs"]
+                versions += result["output_versions"]
+                itl += result.get("itl", [])
+                ttft = time.monotonic() - t_start
+                stop_reason = result["stop_reason"]
+                if stop_reason != "stop" and len(accumulated) < max_new:
+                    # the prefill leg hit ITS token cap, not the request's:
+                    # resume on the decode server — the shipped KV turns
+                    # the replay of prompt+accumulated into zero re-prefill
+                    # (or a loud local re-prefill if the ship fell back)
+                    stop_reason = "abort"
+                    addr = decode_addr
+                    self._remember_rid(req.rid, decode_addr)
         # "abort" (pause fence) and "interrupt" (token-boundary interrupt:
         # drain, preemption-eviction, operator) both resume by replaying
         # prompt+accumulated — the server's retained-KV resume path turns

@@ -72,10 +72,22 @@ class FleetController:
         policy: FleetPolicy | None = None,
         clock=time.monotonic,
         fetch_info=None,
+        role: str = "",
     ):
+        if role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"fleet role must be '', 'prefill' or 'decode', got {role!r}"
+            )
         self.client = client
         self.config = config
         self.clock = clock
+        # "" = the classic single generalist pool; "prefill"/"decode" = one
+        # pool of a disaggregated fleet. A role-scoped controller only sees
+        # (signals, victims, size) its own role's members, spawns newcomers
+        # with AREAL_SERVER_ROLE in their env, verifies the role echoed by
+        # /ready, and registers the role tag in name_resolve so the
+        # client's role-aware router can find the pool.
+        self.role = role
         self.provider = provider if provider is not None else build_provider(config)
         # propagate the weight-propagation shared secret to spawned
         # servers: the client-side knob alone would leave the servers'
@@ -88,7 +100,14 @@ class FleetController:
         provider_env = getattr(self.provider, "env", None)
         if relay_token and isinstance(provider_env, dict):
             provider_env.setdefault("AREAL_RELAY_TOKEN", relay_token)
-        self.policy = policy if policy is not None else build_policy(config, clock)
+        # role rides the spawn env (one launcher argv template serves both
+        # pools); a role-scoped controller therefore needs its OWN provider
+        # instance — sharing one across roles would cross the tags
+        if role and isinstance(provider_env, dict):
+            provider_env.setdefault("AREAL_SERVER_ROLE", role)
+        self.policy = (
+            policy if policy is not None else build_policy(config, clock, role)
+        )
         # provider-owned members by address (a launcher-booted server has
         # no handle here; scale-in drains it via its name_resolve drain key)
         self._members: dict[str, ServerHandle] = {}
@@ -128,6 +147,18 @@ class FleetController:
             "areal_fleet_warmup_failures_total",
             "newcomers that failed readiness/warmup and never joined",
         )
+        # per-role pool gauges (disaggregated serving): label cardinality is
+        # bounded by the role enum {prefill, decode} — never per-server
+        self._g_role_size = reg.gauge(
+            "areal_fleet_role_size",
+            "live rotation size of one serving-role pool",
+            labels=("role",),
+        )
+        self._g_role_desired = reg.gauge(
+            "areal_fleet_role_desired_size",
+            "policy-desired size of one serving-role pool",
+            labels=("role",),
+        )
 
     # ------------------------------------------------------------ signals
 
@@ -155,6 +186,20 @@ class FleetController:
         except Exception:
             return None
 
+    def _fetch_ready_role(self, addr: str) -> str | None:
+        """The serving role the server itself reports on its 200 ``/ready``
+        body (None when unreachable/undecodable — distinct from ``""``,
+        which is a server explicitly reporting the generalist role)."""
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/ready",
+                timeout=self.config.signal_timeout_seconds,
+            ) as resp:
+                body = json.loads(resp.read().decode() or "{}")
+            return str(body.get("role") or "")
+        except Exception:
+            return None
+
     def _rollout_wait_fraction(self, now: float) -> float:
         """Δ(trainer seconds blocked in rollout wait) / Δ(wall) since the
         previous look — the PR 9 counter turned into a dimensionless load
@@ -174,12 +219,24 @@ class FleetController:
             return 0.0
         return max(0.0, min(1.0, (total - anchor[1]) / dt))
 
+    def _pool_addresses(self) -> list[str]:
+        """The rotation addresses this controller is responsible for: all
+        of them for a generalist controller, only the matching-role members
+        for a role-scoped one (unknown-role members belong to no pool)."""
+        addrs = list(self.client.addresses)
+        if not self.role:
+            return addrs
+        roles = getattr(self.client, "_server_roles", {}) or {}
+        return [a for a in addrs if roles.get(a) == self.role]
+
     def collect_signals(self, now: float | None = None) -> FleetSignals:
         now = self.clock() if now is None else now
-        addrs = list(self.client.addresses)
+        addrs = self._pool_addresses()
         depth = 0.0
         wait_last = 0.0
         ttft = 0.0
+        itl = 0.0
+        queue_wait_p95 = 0.0
         reporting = 0
         if len(addrs) > 1:
             # poll concurrently: a wedged fleet (the very moment scaling
@@ -203,6 +260,11 @@ class FleetController:
                 wait_last, float(info.get("queue_wait_seconds_last", 0) or 0)
             )
             ttft = max(ttft, float(info.get("ttft_p95_seconds", 0) or 0))
+            itl = max(itl, float(info.get("itl_p95_seconds", 0) or 0))
+            queue_wait_p95 = max(
+                queue_wait_p95,
+                float(info.get("queue_wait_p95_seconds", 0) or 0),
+            )
         inflight = self.client.inflight_snapshot()
         per_addr = [inflight.get(a, 0) for a in addrs]
         skew = (max(per_addr) - min(per_addr)) if per_addr else 0
@@ -213,6 +275,8 @@ class FleetController:
             inflight_skew=skew,
             inflight_total=sum(per_addr),
             rollout_wait_fraction=self._rollout_wait_fraction(now),
+            itl_p95=itl,
+            queue_wait_p95=queue_wait_p95,
             n_reporting=reporting,
             n_servers=len(addrs),
         )
@@ -243,9 +307,14 @@ class FleetController:
         are reaped and NOT returned."""
         # clamped: the min/max bounds are hard — a misconfigured
         # initial_servers must not boot a fleet the policy may never hold
-        target = self.policy.clamp(
-            self.config.initial_servers or self.config.min_servers
-        )
+        if self.role:
+            # per-role pools boot at their role floor; initial_servers
+            # sizes the single generalist pool only
+            target = self.policy.bounds()[0]
+        else:
+            target = self.policy.clamp(
+                self.config.initial_servers or self.config.min_servers
+            )
         addrs: list[str] = []
         for _ in range(max(1, target)):
             handle = self._spawn_one()
@@ -264,18 +333,26 @@ class FleetController:
         with self._op_lock:
             now = self.clock() if now is None else now
             signals = self.collect_signals(now)
-            current = len(self.client.addresses)
+            current = len(self._pool_addresses())
             decision = self.policy.desired_size(signals, current, now)
-            self._g_size.set(current)
-            self._g_desired.set(decision.desired)
+            if self.role:
+                self._g_role_size.labels(role=self.role).set(current)
+                self._g_role_desired.labels(role=self.role).set(
+                    decision.desired
+                )
+            else:
+                self._g_size.set(current)
+                self._g_desired.set(decision.desired)
             if decision.direction != "hold":
                 self._note(
                     "decision",
                     desired=decision.desired,
                     current=decision.current,
+                    role=self.role,
                     reason=decision.reason[:300],
                     queue_depth=round(signals.queue_depth, 2),
                     ttft_p95=round(signals.ttft_p95, 4),
+                    itl_p95=round(signals.itl_p95, 4),
                     rollout_wait_fraction=round(
                         signals.rollout_wait_fraction, 3
                     ),
@@ -292,7 +369,7 @@ class FleetController:
         """Manual resize (clamped to the configured bounds); goes through
         the exact same lifecycle protocol as a policy decision."""
         with self._op_lock:
-            current = len(self.client.addresses)
+            current = len(self._pool_addresses())
             desired = self.policy.clamp(int(n))
             decision = ScaleDecision(
                 desired, current, f"manual set_size({n})"
@@ -365,6 +442,28 @@ class FleetController:
             )
             self.provider.terminate(handle, grace=0.0)
             return None
+        if self.role:
+            # the role must round-trip through the server's own config
+            # (spawn env -> config.role -> /ready): a newcomer that came up
+            # as the wrong role would admit/refuse the wrong traffic class,
+            # so it never enters this pool
+            got = self._fetch_ready_role(handle.addr)
+            if got != self.role:
+                logger.warning(
+                    "newcomer %s (%s) reports role %r, expected %r; "
+                    "terminating",
+                    server_id,
+                    handle.addr,
+                    got,
+                    self.role,
+                )
+                self._c_warmup_failures.inc()
+                self._note(
+                    "warmup_failed", addr=handle.addr, server_id=server_id,
+                    why=f"role mismatch ({got!r} != {self.role!r})",
+                )
+                self.provider.terminate(handle, grace=0.0)
+                return None
         return handle
 
     def _scale_out_one(self, reason: str) -> bool:
@@ -430,8 +529,8 @@ class FleetController:
         least affine (fewest in-flight requests + rid affinities — the
         cheapest KV to throw away); provider-owned members break ties
         ahead of launcher-booted ones (we can actually reap them)."""
-        candidates = list(self.client.addresses)
-        if len(candidates) <= self.config.min_servers:
+        candidates = self._pool_addresses()
+        if len(candidates) <= self.policy.bounds()[0]:
             return None
         snap = self.client._health.snapshot()
         inflight = self.client.inflight_snapshot()
@@ -525,6 +624,21 @@ class FleetController:
             )
         except Exception as e:
             logger.debug("name_resolve registration failed: %s", e)
+        if self.role:
+            # role tag alongside the address registration ("addr role"
+            # value, separate subtree) so every client's discovery refresh
+            # learns the pool membership, not just this controller's client
+            try:
+                name_resolve.add(
+                    names.gen_server_role(exp, trial, handle.server_id),
+                    f"{handle.addr} {self.role}",
+                    replace=True,
+                )
+            except Exception as e:
+                logger.debug("role-tag registration failed: %s", e)
+            roles = getattr(self.client, "_server_roles", None)
+            if isinstance(roles, dict):
+                roles[handle.addr] = self.role
 
     def _server_id_for(self, addr: str) -> str | None:
         exp, trial = self._exp_trial()
@@ -555,6 +669,14 @@ class FleetController:
             logger.debug(
                 "deregister of %s (%s) failed", server_id, addr,
                 exc_info=True,
+            )
+        try:
+            name_resolve.delete(names.gen_server_role(exp, trial, server_id))
+        except name_resolve.NameEntryNotFoundError:
+            pass  # most servers carry no role tag
+        except Exception:
+            logger.debug(
+                "role-tag deregister of %s failed", server_id, exc_info=True
             )
 
     def _interrupt_drain(self, addr: str) -> None:
@@ -647,6 +769,38 @@ class FleetController:
             self.provider.close()
 
 
+class FleetControllerGroup:
+    """Per-role controllers for disaggregated serving: one prefill pool +
+    one decode pool, each with its own provider instance (the role rides
+    the spawn env) and a role-scoped policy over role-scoped signals.
+    Mirrors :class:`FleetController`'s lifecycle surface (bootstrap /
+    step / start / stop / close) so the trainer wiring is identical in
+    both modes; ``step()`` returns ``{role: ScaleDecision}``."""
+
+    def __init__(self, controllers: dict[str, FleetController]):
+        self.controllers = dict(controllers)
+
+    def bootstrap(self) -> list[str]:
+        return [a for c in self.controllers.values() for a in c.bootstrap()]
+
+    def step(self, now: float | None = None) -> dict[str, ScaleDecision]:
+        return {
+            role: c.step(now) for role, c in self.controllers.items()
+        }
+
+    def start(self) -> None:
+        for c in self.controllers.values():
+            c.start()
+
+    def stop(self) -> None:
+        for c in self.controllers.values():
+            c.stop()
+
+    def close(self) -> None:
+        for c in self.controllers.values():
+            c.close()
+
+
 def build_controller(
     client,
     config: FleetConfig | None = None,
@@ -657,3 +811,23 @@ def build_controller(
     provider reads the launcher's AREAL_FLEET_SERVER_ARGV template)."""
     config = config if config is not None else client.config.fleet
     return FleetController(client, config, **kwargs)
+
+
+def build_role_controllers(
+    client,
+    config: FleetConfig | None = None,
+    **kwargs,
+) -> FleetControllerGroup:
+    """Disaggregated-serving wiring: a prefill-pool controller scaling on
+    admission queue wait / TTFT and a decode-pool controller scaling on
+    decode ITL p95 / in-flight, bounded by ``prefill_min/max_servers`` and
+    ``decode_min/max_servers``. Use with ``serving.disaggregation.enabled``
+    on the client; the generalist :func:`build_controller` stays the
+    single-pool path."""
+    config = config if config is not None else client.config.fleet
+    return FleetControllerGroup(
+        {
+            role: FleetController(client, config, role=role, **kwargs)
+            for role in ("prefill", "decode")
+        }
+    )

@@ -77,7 +77,14 @@ class SimServer:
                 status=503,
             )
         return web.json_response(
-            {"status": "ready", "weight_version": self.version}
+            {
+                "status": "ready",
+                "weight_version": self.version,
+                # AREAL_SERVER_ROLE mirrors the real server's spawn-env
+                # override, so the role-scoped controller's round-trip
+                # check (spawn env -> /ready) is exercised for real
+                "role": os.environ.get("AREAL_SERVER_ROLE", self.args.role),
+            }
         )
 
     async def model_info(self, request):
@@ -89,6 +96,7 @@ class SimServer:
                 "admission_queue_depth": self.queue_waiters,
                 "queue_wait_seconds_last": self.queue_wait_last,
                 "ttft_p95_seconds": p95,
+                "itl_p95_seconds": self.args.itl_p95,
                 "inflight": self.inflight,
                 "served_total": self.served_total,
                 "last_prompt_len": self.last_prompt_len,
@@ -296,6 +304,13 @@ def parse_args(argv=None):
     p.add_argument("--drain-wait", type=float, default=30.0,
                    help="max seconds to wait for in-flight requests on "
                         "SIGTERM")
+    p.add_argument("--role", default="",
+                   help="serving role reported on /ready (overridden by "
+                        "the AREAL_SERVER_ROLE spawn env, like the real "
+                        "server)")
+    p.add_argument("--itl-p95", type=float, default=0.0,
+                   help="static decode inter-token-latency p95 reported "
+                        "on /model_info (decode-pool scaling fixture)")
     return p.parse_args(argv)
 
 

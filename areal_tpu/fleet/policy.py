@@ -48,6 +48,13 @@ class FleetSignals:
     # fraction of trainer wall spent blocked in rollout wait() since the
     # previous look (0 when unknown)
     rollout_wait_fraction: float = 0.0
+    # worst per-server decode inter-token latency p95 (seconds) — the
+    # decode-pool scaling signal under prefill/decode disaggregation
+    itl_p95: float = 0.0
+    # worst per-server admission queue-wait p95 (seconds) — the
+    # prefill-pool scaling signal under disaggregation (queue wait is the
+    # component of TTFT the prefill pool can actually fix by growing)
+    queue_wait_p95: float = 0.0
     # servers that answered the signal poll / total polled
     n_reporting: int = 0
     n_servers: int = 0
@@ -73,24 +80,46 @@ class ScaleDecision:
 
 
 class FleetPolicy:
-    """Base: subclasses implement :meth:`desired_size`."""
+    """Base: subclasses implement :meth:`desired_size`.
+
+    ``role`` scopes the policy to one serving-role pool under
+    prefill/decode disaggregation: it selects the role's size bounds
+    (``prefill_min/max_servers`` or ``decode_min/max_servers``) and lets
+    :class:`TargetTrackingPolicy` watch only the signals that pool can
+    fix by growing. ``role=""`` is the single-pool policy, byte-identical
+    to the pre-disaggregation behavior."""
 
     def desired_size(
         self, signals: FleetSignals, current: int, now: float | None = None
     ) -> ScaleDecision:
         raise NotImplementedError
 
-    def clamp(self, n: int) -> int:
-        return max(self.config.min_servers, min(self.config.max_servers, n))
+    def bounds(self) -> tuple[int, int]:
+        """(min, max) server count for this policy's pool."""
+        cfg = self.config
+        if self.role == "prefill":
+            return cfg.prefill_min_servers, cfg.prefill_max_servers
+        if self.role == "decode":
+            return cfg.decode_min_servers, cfg.decode_max_servers
+        return cfg.min_servers, cfg.max_servers
 
-    def __init__(self, config: FleetConfig, clock=time.monotonic):
+    def clamp(self, n: int) -> int:
+        lo, hi = self.bounds()
+        return max(lo, min(hi, n))
+
+    def __init__(self, config: FleetConfig, clock=time.monotonic, role: str = ""):
+        if role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"fleet policy role must be '', 'prefill' or 'decode', got {role!r}"
+            )
         self.config = config
         self.clock = clock
+        self.role = role
 
 
 class TargetTrackingPolicy(FleetPolicy):
-    def __init__(self, config: FleetConfig, clock=time.monotonic):
-        super().__init__(config, clock)
+    def __init__(self, config: FleetConfig, clock=time.monotonic, role: str = ""):
+        super().__init__(config, clock, role)
         self._out_streak = 0
         self._in_streak = 0
         # cooldown anchors; -inf so the first decision is never blocked
@@ -102,19 +131,39 @@ class TargetTrackingPolicy(FleetPolicy):
     def _breaches(self, s: FleetSignals, current: int) -> list[str]:
         cfg = self.config
         out = []
-        per_server = s.queue_depth / max(1, current)
-        if (
-            cfg.queue_depth_high_per_server > 0
-            and per_server > cfg.queue_depth_high_per_server
-        ):
-            out.append(
-                f"queue_depth/server {per_server:.1f} > "
-                f"{cfg.queue_depth_high_per_server}"
-            )
-        if cfg.ttft_p95_high_seconds > 0 and s.ttft_p95 > cfg.ttft_p95_high_seconds:
-            out.append(
-                f"ttft_p95 {s.ttft_p95:.3f}s > {cfg.ttft_p95_high_seconds}s"
-            )
+        # admission-side signals (queue depth/wait, TTFT): growing the
+        # DECODE pool cannot fix these — under disaggregation only the
+        # prefill pool admits fresh prompts — so a decode-role policy
+        # skips them rather than chasing load another pool owns
+        if self.role != "decode":
+            per_server = s.queue_depth / max(1, current)
+            if (
+                cfg.queue_depth_high_per_server > 0
+                and per_server > cfg.queue_depth_high_per_server
+            ):
+                out.append(
+                    f"queue_depth/server {per_server:.1f} > "
+                    f"{cfg.queue_depth_high_per_server}"
+                )
+            # queue_wait_p95 is the admission component of TTFT, so it
+            # shares TTFT's threshold: either exceeding it means requests
+            # sit too long before their first token
+            worst_ttft = max(s.ttft_p95, s.queue_wait_p95)
+            if (
+                cfg.ttft_p95_high_seconds > 0
+                and worst_ttft > cfg.ttft_p95_high_seconds
+            ):
+                out.append(
+                    f"ttft_p95 {worst_ttft:.3f}s > {cfg.ttft_p95_high_seconds}s"
+                )
+        # decode-side signal: inter-token latency — a prefill-role policy
+        # never decodes past the first token, so only single-pool and
+        # decode policies watch it
+        if self.role != "prefill":
+            if cfg.itl_p95_high_seconds > 0 and s.itl_p95 > cfg.itl_p95_high_seconds:
+                out.append(
+                    f"itl_p95 {s.itl_p95:.4f}s > {cfg.itl_p95_high_seconds}s"
+                )
         if (
             cfg.rollout_wait_fraction_high > 0
             and s.rollout_wait_fraction > cfg.rollout_wait_fraction_high
@@ -145,7 +194,12 @@ class TargetTrackingPolicy(FleetPolicy):
             return False
         if (
             cfg.ttft_p95_high_seconds > 0
-            and s.ttft_p95 > cfg.ttft_p95_high_seconds / 2
+            and max(s.ttft_p95, s.queue_wait_p95) > cfg.ttft_p95_high_seconds / 2
+        ):
+            return False
+        if (
+            cfg.itl_p95_high_seconds > 0
+            and s.itl_p95 > cfg.itl_p95_high_seconds / 2
         ):
             return False
         if (
@@ -189,7 +243,7 @@ class TargetTrackingPolicy(FleetPolicy):
                 )
             return ScaleDecision(
                 current, current,
-                f"at max_servers={cfg.max_servers}: " + "; ".join(breaches),
+                f"at max_servers={self.bounds()[1]}: " + "; ".join(breaches),
                 signals,
             )
         if self._in_streak >= need:
@@ -212,7 +266,7 @@ class TargetTrackingPolicy(FleetPolicy):
                 return ScaleDecision(desired, current, "fleet idle", signals)
             return ScaleDecision(
                 current, current,
-                f"idle but at min_servers={cfg.min_servers}", signals,
+                f"idle but at min_servers={self.bounds()[0]}", signals,
             )
         return ScaleDecision(current, current, "steady", signals)
 
@@ -223,8 +277,8 @@ class ManualPolicy(FleetPolicy):
     machinery (readiness gate, warmup, drain ordering) applies unchanged —
     manual mode changes WHO decides, never HOW the fleet changes."""
 
-    def __init__(self, config: FleetConfig, clock=time.monotonic):
-        super().__init__(config, clock)
+    def __init__(self, config: FleetConfig, clock=time.monotonic, role: str = ""):
+        super().__init__(config, clock, role)
         self._target: int | None = None
 
     def set_size(self, n: int) -> None:
@@ -240,11 +294,13 @@ class ManualPolicy(FleetPolicy):
         )
 
 
-def build_policy(config: FleetConfig, clock=time.monotonic) -> FleetPolicy:
+def build_policy(
+    config: FleetConfig, clock=time.monotonic, role: str = ""
+) -> FleetPolicy:
     if config.policy == "target_tracking":
-        return TargetTrackingPolicy(config, clock)
+        return TargetTrackingPolicy(config, clock, role)
     if config.policy == "manual":
-        return ManualPolicy(config, clock)
+        return ManualPolicy(config, clock, role)
     raise ValueError(
         f"unknown fleet policy {config.policy!r} "
         "(expected 'target_tracking' or 'manual')"

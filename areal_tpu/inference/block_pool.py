@@ -55,6 +55,11 @@ class BlockPool:
         # LIFO free list: recently freed blocks are re-used first (their
         # pool rows are more likely to still be in cache-friendly state)
         self._free: list[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+        # high-water mark: peak simultaneous allocation over the pool's
+        # lifetime — the capacity-planning number for sizing disaggregated
+        # prefill/decode pools (a decode pool's peak tracks retained +
+        # imported KV, a prefill pool's tracks its admission burst width)
+        self.peak_used = 0
 
     # ------------------------------------------------------------------
 
@@ -76,6 +81,7 @@ class BlockPool:
             raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
         out = [self._free.pop() for _ in range(n)]
         self.ref[out] = 1
+        self.peak_used = max(self.peak_used, self.n_used)
         return out
 
     def incref(self, ids) -> None:

@@ -95,6 +95,20 @@ class _Seq:
     # the scheduler entry this seq was popped with: preemption hands it
     # back via push_front so the victim requeues at its ORIGINAL position
     sched_entry: dict | None = None
+    # disaggregated serving: a prefill-role request finishes with its KV
+    # retained PINNED (exportable via export_kv) instead of merely cached
+    prefill_only: bool = False
+    # TTFT phase decomposition: when this request left the admission queue
+    # (engine loop), and whether it admitted via prefill or a retained-KV
+    # resume (an imported-KV sequence's first token is a DECODE step — its
+    # latency is attributed to the first_decode phase, not prefill)
+    t_admitted: float | None = None
+    admitted_via_resume: bool = False
+    # adaptive speculative drafting: per-sequence acceptance EWMA and the
+    # draft length it currently maps to (0 = not yet initialized; the
+    # static config value applies)
+    spec_ewma: float = 1.0
+    spec_k: int = 0
 
     @property
     def max_total(self) -> int:
@@ -126,6 +140,20 @@ class _Retained:
     ts: float
     version: int
     pinned: bool = False
+
+
+class KVVersionMismatch(Exception):
+    """A KV import carried blocks computed under a different weight version
+    than this engine serves — spliced in they would mix attention state
+    across a commit, exactly what the radix admission fence forbids. The
+    server maps this to HTTP 412; the client falls back to a local full
+    prefill (loud, counted, never silent)."""
+
+
+class KVNoCapacity(Exception):
+    """A KV import could not get a free slot or enough pool blocks even
+    after the eviction ladder. Mapped to HTTP 503; the client falls back to
+    a local full prefill on this server (which queues like any admission)."""
 
 
 class GenerationEngine:
@@ -190,6 +218,22 @@ class GenerationEngine:
             # preferred serving-plane name; both knobs drive the same
             # intra-prompt chunked-prefill machinery (engine's own copy)
             config.chunked_prefill_tokens = config.prefill_chunk_size
+        if config.role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"role must be ''|'prefill'|'decode', got {config.role!r}"
+            )
+        if config.role == "decode" and config.chunked_prefill_tokens > 0:
+            # decode-role engines skip chunked-prefill interleaving
+            # entirely: their steady-state work is imported-KV decode, and
+            # the rare fallback full prefill (refused import) should
+            # dispatch whole-prompt rather than trickle chunks between
+            # decode iterations keeping batches ragged
+            logger.info(
+                "role='decode': disabling chunked-prefill interleaving "
+                "(chunked_prefill_tokens %d -> 0; dense decode batches)",
+                config.chunked_prefill_tokens,
+            )
+            config.chunked_prefill_tokens = 0
         requested_s = config.max_seq_len
         blk = min(config.page_size, config.max_seq_len)
         if config.max_seq_len % blk:
@@ -346,7 +390,30 @@ class GenerationEngine:
                     "need 1 <= spec_ngram_min <= spec_ngram_max, got "
                     f"min={config.spec_ngram_min} max={config.spec_ngram_max}"
                 )
+            if config.spec_draft_len_max > config.spec_draft_len:
+                # the verify dispatch's static window is spec_draft_len
+                # wide; growing a slot's draft past it would need a wider
+                # compiled program — make the config contradiction loud
+                raise ValueError(
+                    f"spec_draft_len_max={config.spec_draft_len_max} "
+                    f"exceeds the static verify window spec_draft_len="
+                    f"{config.spec_draft_len}; raise spec_draft_len instead"
+                )
         self._spec_enabled = config.spec_decode == "ngram"
+        # adaptive per-sequence draft length (EWMA of the slot's OWN
+        # acceptance): bounds [spec_draft_len_min, max], where max=0 means
+        # "= spec_draft_len". min=0 disables adaptation (static drafting).
+        self._spec_draft_max = (
+            config.spec_draft_len_max or config.spec_draft_len
+        )
+        self._spec_draft_min = min(
+            config.spec_draft_len_min, self._spec_draft_max
+        )
+        self._spec_adaptive = (
+            self._spec_enabled
+            and self._spec_draft_min >= 1
+            and self._spec_draft_min < self._spec_draft_max
+        )
         if self._spec_enabled and pp > 1:
             # the pp decode conveyors (sequential + rotated) are single-
             # token-per-tick machines; verify windows are not threaded
@@ -476,6 +543,24 @@ class GenerationEngine:
         self._preempted_rids: set[str] = set()
         # next retained-KV TTL sweep (engine thread; 0 knob disables)
         self._next_reap = 0.0
+        # KV shipping (prefill/decode disaggregation): inbound import
+        # chunks stage on the CALLER's thread as host arrays keyed by rid
+        # (like weight staging — decode dispatches never wait on the
+        # transfer); only the final commit runs on the engine thread, where
+        # it allocates a slot + blocks, scatters the rows into the pool,
+        # and registers a pinned _Retained entry so the follow-up
+        # /generate resumes through _try_resume with ZERO re-prefill.
+        # _kv_staging_lock is a leaf lock like _retained_lock.
+        # lock_order: GenerationEngine._lock -> GenerationEngine._kv_staging_lock
+        self._kv_import_staging: dict[str, dict] = {}  # guarded_by: _kv_staging_lock
+        self._kv_staging_lock = threading.Lock()
+        self.kv_export_total = 0
+        self.kv_export_tokens_total = 0
+        self.kv_import_total = 0
+        self.kv_import_tokens_total = 0
+        self.kv_import_refused_version_total = 0
+        self.kv_import_refused_capacity_total = 0
+        self.kv_import_seconds_last = 0.0
         # Prompt-prefix KV reuse (the SGLang radix-cache role for the
         # dominant RL pattern): _slot_covered[i] = the token sequence (a
         # list, appended per decoded token) whose K/V rows live in cache
@@ -596,6 +681,19 @@ class GenerationEngine:
         self._itl_hist = _metrics.DEFAULT_REGISTRY.histogram(
             "areal_inter_token_seconds", "inter-token latency"
         )
+        # TTFT decomposition: the single areal_ttft_seconds number split
+        # into attributable phases — queue_wait (admission queue), prefill
+        # (admission -> first token for freshly-prefilled requests),
+        # kv_ship (import staging start -> commit on the DECODE server),
+        # first_decode (admission -> first token for resumed/imported
+        # sequences). Bounded label set; the disagg win and the KV-ship
+        # cost each get their own series instead of one opaque number.
+        self._ttft_phase_hist = _metrics.DEFAULT_REGISTRY.histogram(
+            "areal_ttft_phase_seconds",
+            "per-phase TTFT decomposition "
+            "(queue_wait | prefill | kv_ship | first_decode)",
+            labels=("phase",),
+        )
         self._c_interrupts = _metrics.DEFAULT_REGISTRY.counter(
             "areal_interrupts_total",
             "token-boundary interruptions, by reason",
@@ -624,6 +722,9 @@ class GenerationEngine:
         self._jit_spec_decode = jax.jit(
             self._spec_decode_impl, donate_argnums=(1,)
         )
+        self._jit_import_blocks = jax.jit(
+            self._import_blocks_impl, donate_argnums=(0,)
+        )
         # qwen2_vl prefill retraces per (grid signature, bucket) — the image
         # grid is a static shape input like prefill buckets
         self._jit_cache_vlm: dict = {}
@@ -639,6 +740,18 @@ class GenerationEngine:
 
         # tree-wide: int8 pools carry ks/vs scale planes alongside k/v
         return jax.tree.map(cp, dict(cache))
+
+    @staticmethod
+    def _import_blocks_impl(cache, rows, ids):
+        """Scatter shipped KV block rows into the pool: ``rows`` holds
+        ``[L, n, BS, ...]`` per pool leaf and ``ids`` the ``n`` destination
+        block ids. ``ids`` is padded to a power-of-two bucket with the
+        trash block (the designated garbage sink — padded lanes write
+        there), so the compile count stays logarithmic in ship size."""
+        out = dict(cache)
+        for k, r in rows.items():
+            out[k] = cache[k].at[:, ids].set(r.astype(cache[k].dtype))
+        return out
 
     # ------------------------------------------------------------------
     # Device steps
@@ -1006,11 +1119,15 @@ class GenerationEngine:
         image_data: list | None = None,
         priority: int = 0,
         span=None,
+        prefill_only: bool = False,
     ):
         """Enqueue a request; ``on_done(ModelResponse)`` fires from the engine
         thread when it finishes (stop/length/abort). ``priority`` orders
         admission (higher first; FIFO within a class). ``span`` (tracing
-        on only) receives engine-internal events for this request."""
+        on only) receives engine-internal events for this request.
+        ``prefill_only`` marks a disaggregated-serving prefill leg: the
+        finished sequence's KV is always retained AND pinned (regardless
+        of ``kv_retain_seconds``) so :meth:`export_kv` can ship it."""
         if self._dead is not None:
             raise RuntimeError("generation engine loop died") from self._dead
         if gconfig.frequency_penalty:
@@ -1111,6 +1228,7 @@ class GenerationEngine:
         seq = _Seq(
             rid=rid, prompt=list(input_ids), gconfig=gconfig, on_done=on_done,
             images=images, grids=grids, priority=priority, span=span,
+            prefill_only=prefill_only,
         )
         self.scheduler.submit(seq, priority=priority)
         self._wake.set()
@@ -1328,6 +1446,137 @@ class GenerationEngine:
 
         return version, chunks()
 
+    # ------------------------------------------------------------------
+    # KV shipping (prefill/decode disaggregation)
+    # ------------------------------------------------------------------
+
+    def export_kv(self, rid: str, chunk_mb: int = 8):
+        """Snapshot a retained sequence's KV blocks (a finished
+        prefill-only request, or any interrupted/pinned rid) as versioned,
+        digest-stamped chunks for ``POST /import_kv`` on a decode peer.
+
+        Returns ``(meta, chunks)``: ``meta`` carries the rid, the weight
+        version the KV was computed under, the full token list
+        (covered + the pending feed token — exactly what the decode
+        server's ``_try_resume`` will be re-issued), and pool geometry the
+        receiver validates against; ``chunks`` yields
+        ``(named_arrays, digest)`` pairs of <= ``chunk_mb`` MB, where
+        ``named_arrays`` holds per-pool-leaf block rows ("k"/"v", plus
+        "ks"/"vs" scale planes for int8 pools) ready for the
+        `utils/wire.py` encode path and ``digest`` is
+        :func:`wire.chunk_digest` over the raw arrays (the receiver
+        recomputes it after decode — a torn or corrupted body refuses
+        loudly instead of decoding garbage attention state).
+
+        The block gather runs ON the engine thread (one bounded command —
+        the pool's buffers are donated every dispatch, so no other thread
+        may touch them); the host pulls and chunking happen on the
+        caller's thread against the gathered copies."""
+        from areal_tpu.utils.wire import chunk_digest
+
+        out: dict = {}
+        self._run_command("export_kv_snapshot", rid, out)
+        tokens = out["tokens"]
+        rows = out["rows"]  # leaf -> device array [L, nb, BS, ...]
+        n_cov = len(tokens) - 1
+        nb = int(next(iter(rows.values())).shape[1])
+        per_block = sum(
+            int(a.nbytes) // max(1, nb) for a in rows.values()
+        )
+        blocks_per_chunk = max(
+            1, (max(1, int(chunk_mb)) * 1_000_000) // max(1, per_block)
+        )
+        self.kv_export_total += 1
+        self.kv_export_tokens_total += n_cov
+        meta = {
+            "rid": rid,
+            "version": out["version"],
+            "tokens": tokens,
+            "block_size": self.block_size,
+            "kv_quant": self.config.kv_quant,
+            "n_blocks": nb,
+        }
+
+        def chunks():
+            for lo in range(0, nb, blocks_per_chunk):
+                hi = min(nb, lo + blocks_per_chunk)
+                named = {
+                    k: np.asarray(jax.device_get(a[:, lo:hi]))
+                    for k, a in rows.items()
+                }
+                yield named, chunk_digest(named)
+
+        return meta, chunks()
+
+    def stage_kv_chunk(
+        self, rid: str, version: int, seq_idx: int, named: dict
+    ) -> None:
+        """Stage one decoded KV-ship chunk (host arrays) for ``rid`` —
+        caller-thread work, like weight-chunk staging: the engine loop
+        never waits on the transfer. Chunks tagged with a different
+        version than the staged set supersede it (torn-stream hygiene).
+        Fails fast with :class:`KVVersionMismatch` when the ship's version
+        already cannot match this engine (the commit re-checks
+        authoritatively on the engine thread)."""
+        if version != self.version:
+            self.kv_import_refused_version_total += 1
+            raise KVVersionMismatch(
+                f"KV for rid={rid} was computed under weight version "
+                f"{version} but this engine serves v{self.version}"
+            )
+        now = time.monotonic()
+        with self._kv_staging_lock:
+            # drop abandoned ships (a sender that died mid-stream must not
+            # pin host RAM until process exit)
+            stale = [
+                r
+                for r, st in self._kv_import_staging.items()
+                if now - st["t0"] > 120.0
+            ]
+            for r in stale:
+                del self._kv_import_staging[r]
+            st = self._kv_import_staging.get(rid)
+            if st is None or st["version"] != version:
+                st = {"version": version, "t0": now, "chunks": {}}
+                self._kv_import_staging[rid] = st
+            st["chunks"][seq_idx] = named
+
+    def commit_kv_import(self, rid: str, version: int, tokens: list[int]):
+        """Assemble the staged chunks for ``rid`` and splice them into the
+        pool (engine-thread command): allocate a free slot + blocks,
+        scatter the rows, and register a pinned retained entry so the
+        follow-up ``/generate`` with ``tokens`` (prompt + first sampled
+        token) admits through ``_try_resume`` with zero re-prefill.
+        Raises :class:`KVVersionMismatch` (HTTP 412) when a weight commit
+        landed since the prefill, :class:`KVNoCapacity` (HTTP 503) when no
+        slot/blocks are available even after eviction."""
+        with self._kv_staging_lock:
+            st = self._kv_import_staging.pop(rid, None)
+        if st is None or st["version"] != version or not st["chunks"]:
+            raise KVNoCapacity(
+                f"no staged KV chunks for rid={rid} at version {version} "
+                "(stream torn or superseded)"
+            )
+        parts = [st["chunks"][i] for i in sorted(st["chunks"])]
+        rows = {
+            k: (
+                parts[0][k]
+                if len(parts) == 1
+                else np.concatenate([p[k] for p in parts], axis=1)
+            )
+            for k in parts[0]
+        }
+        self._run_command(
+            "import_kv", rid, version, list(tokens), rows, st["t0"]
+        )
+
+    def release_kv(self, rid: str) -> None:
+        """Drop the retained entry for ``rid`` (the prefill server calls
+        this once a ship landed on the decode peer — the pinned source
+        copy has served its purpose; the TTL reaper covers senders that
+        die before getting here). Thread-safe; no-op for unknown rids."""
+        self._evict_retained(rid)
+
     def update_weights_from_named_arrays(
         self, named: dict, version: int | None = None
     ):
@@ -1456,6 +1705,9 @@ class GenerationEngine:
             self._kv_pool_kv_bytes + self._kv_pool_scale_bytes
         ) / total_blocks
         return {
+            # serving role ("" generalist): non-numeric on purpose — the
+            # JSON surface carries it, the numeric metrics snapshot skips it
+            "role": self.config.role,
             "retained_kv_slots": retained_n,
             "retained_kv_bytes": int(retained_blocks * per_block_bytes),
             "retained_kv_reaped_total": self.retained_kv_reaped_total,
@@ -1468,6 +1720,7 @@ class GenerationEngine:
             "resumed_across_commit_total": self.resumed_across_commit_total,
             "preemptions_total": self.preemptions_total,
             "kv_blocks_used": self.pool.n_used,
+            "kv_blocks_used_peak": self.pool.peak_used,
             "kv_blocks_free": self.pool.n_free,
             "kv_block_size": self.pool.block_size,
             # KV-pool memory gauge: total persistent pool bytes split into
@@ -1503,10 +1756,42 @@ class GenerationEngine:
             "admission_refused_total": sched.refused_total,
             "queue_wait_seconds_total": sched.queue_wait_seconds_total,
             "queue_wait_seconds_last": sched.queue_wait_seconds_last,
-            # fleet-autoscaler load signal: TTFT p95 over the request
-            # histogram, surfaced via /model_info so the controller's
-            # signal poll reads it without parsing Prometheus buckets
+            # fleet-autoscaler load signals: p95s over the request
+            # histograms, surfaced via /model_info so the controller's
+            # signal poll reads them without parsing Prometheus buckets.
+            # Under disaggregation the prefill pool scales on queue
+            # wait/TTFT, the decode pool on ITL p95.
             "ttft_p95_seconds": self._ttft_hist.quantile(0.95),
+            "itl_p95_seconds": self._itl_hist.quantile(0.95),
+            "queue_wait_p95_seconds": sched.queue_wait_p95(),
+            # TTFT decomposition (per-phase p95s from the labeled
+            # histogram): queue_wait / prefill / kv_ship / first_decode —
+            # attributes the disagg win (and the KV-ship cost) instead of
+            # folding everything into one opaque TTFT number
+            "ttft_queue_wait_p95_seconds": self._ttft_phase_hist.labels(
+                phase="queue_wait"
+            ).quantile(0.95),
+            "ttft_prefill_p95_seconds": self._ttft_phase_hist.labels(
+                phase="prefill"
+            ).quantile(0.95),
+            "ttft_kv_ship_p95_seconds": self._ttft_phase_hist.labels(
+                phase="kv_ship"
+            ).quantile(0.95),
+            "ttft_first_decode_p95_seconds": self._ttft_phase_hist.labels(
+                phase="first_decode"
+            ).quantile(0.95),
+            # KV-shipping ledger (prefill/decode disaggregation)
+            "kv_export_total": self.kv_export_total,
+            "kv_export_tokens_total": self.kv_export_tokens_total,
+            "kv_import_total": self.kv_import_total,
+            "kv_import_tokens_total": self.kv_import_tokens_total,
+            "kv_import_refused_version_total": (
+                self.kv_import_refused_version_total
+            ),
+            "kv_import_refused_capacity_total": (
+                self.kv_import_refused_capacity_total
+            ),
+            "kv_import_seconds_last": self.kv_import_seconds_last,
         }
 
     def record_serving_stats(self) -> None:
@@ -1544,6 +1829,11 @@ class GenerationEngine:
             "spec_proposed_tokens_total": self.spec_proposed_tokens_total,
             "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
             "spec_acceptance_rate": self.spec_acceptance_rate,
+            # adaptive draft length: current mean per-slot draft window and
+            # acceptance EWMA over the running batch (static config value /
+            # 1.0 when idle or adaptation is off)
+            "spec_draft_len_current": self._spec_draft_len_current(),
+            "spec_accept_ewma": self._spec_accept_ewma_mean(),
             "weight_sync_stall_seconds": self.weight_sync_stall_seconds_last,
             "weight_sync_stall_seconds_total": (
                 self.weight_sync_stall_seconds_total
@@ -1661,6 +1951,26 @@ class GenerationEngine:
                     done.put(None)
                 except Exception as e:
                     logger.exception("interrupt_all failed")
+                    done.put(e)
+            elif cmd[0] == "export_kv_snapshot":
+                _, rid, out, done = cmd
+                try:
+                    out.update(self._snapshot_kv_for_export(rid))
+                    done.put(None)
+                except Exception as e:
+                    # expected refusals (unknown rid) surface to the caller
+                    # without a stack trace — the server maps them to HTTP
+                    done.put(e)
+            elif cmd[0] == "import_kv":
+                _, rid, version, tokens, rows, t0, done = cmd
+                try:
+                    self._import_kv_commit(rid, version, tokens, rows, t0)
+                    done.put(None)
+                except Exception as e:
+                    if not isinstance(
+                        e, (KVVersionMismatch, KVNoCapacity)
+                    ):
+                        logger.exception("KV import failed")
                     done.put(e)
             elif cmd[0] == "commit_staged":
                 _, version, done = cmd
@@ -2021,6 +2331,182 @@ class GenerationEngine:
             )
             self._retained_slots[slot] = seq.rid
 
+    # ------------------------------------------------------------------
+    # KV shipping internals (engine thread)
+    # ------------------------------------------------------------------
+
+    def _snapshot_kv_for_export(self, rid: str) -> dict:
+        """Engine-thread half of :meth:`export_kv`: gather the retained
+        slot's block rows into FRESH device arrays (one bounded take per
+        pool leaf — safe to hand to another thread; unlike the live pool
+        they are never donated)."""
+        with self._retained_lock:
+            ent = self._retained.get(rid)
+        if ent is None:
+            raise KeyError(
+                f"no retained KV for rid={rid} (finished without "
+                "prefill_only, already shipped, or TTL-reaped)"
+            )
+        n_cov = len(ent.covered)
+        nb = self.pool.blocks_for_tokens(n_cov)
+        if nb == 0 or int(self._slot_nblocks[ent.slot]) < nb:
+            raise KeyError(
+                f"retained KV for rid={rid} has no exportable blocks"
+            )
+        blocks = jnp.asarray(
+            np.ascontiguousarray(self.block_table[ent.slot, :nb])
+        )
+        rows = {
+            k: jnp.take(a, blocks, axis=1) for k, a in self.cache.items()
+        }
+        return {
+            "version": ent.version,
+            "tokens": list(ent.covered) + [int(ent.feed_tok)],
+            "rows": rows,
+        }
+
+    def _import_kv_commit(
+        self, rid: str, version: int, tokens: list[int], rows: dict,
+        t0: float,
+    ):
+        """Engine-thread half of :meth:`commit_kv_import`. Version fence
+        FIRST (authoritative — the staged-weight commit path bumps
+        ``self.version`` on this same thread, so no TOCTOU), then slot +
+        block allocation with the normal eviction ladder, then one
+        bucketed scatter dispatch, then the pinned retained entry the
+        resume path keys on."""
+        if version != self.version:
+            self.kv_import_refused_version_total += 1
+            raise KVVersionMismatch(
+                f"KV for rid={rid} was computed under weight version "
+                f"{version} but this engine serves v{self.version} (a "
+                "commit landed between prefill and import)"
+            )
+        n_cov = len(tokens) - 1
+        if n_cov < 1:
+            raise ValueError(
+                f"KV import for rid={rid} needs >= 2 tokens "
+                f"(covered + feed), got {len(tokens)}"
+            )
+        if set(rows) != set(self.cache):
+            raise ValueError(
+                f"KV import leaves {sorted(rows)} do not match this "
+                f"pool's {sorted(self.cache)} (kv_quant mismatch between "
+                "prefill and decode pools?)"
+            )
+        nb_need = self.pool.blocks_for_tokens(n_cov)
+        for k, r in rows.items():
+            want = self.cache[k].shape
+            if (
+                r.shape[0] != want[0]
+                or r.shape[1] != nb_need
+                or tuple(r.shape[2:]) != tuple(want[2:])
+            ):
+                raise ValueError(
+                    f"KV import leaf {k!r} shape {tuple(r.shape)} does "
+                    f"not fit pool {tuple(want)} ({nb_need} blocks of "
+                    f"{self.block_size} tokens expected — block_size "
+                    "mismatch between pools?)"
+                )
+        with self._retained_lock:
+            retained_slots = set(self._retained_slots)
+        free = [
+            i
+            for i, s in enumerate(self.slots)
+            if s is None
+            and i not in retained_slots
+            and i not in self._warming
+        ]
+        if not free:
+            self._evict_lru_retained()
+            with self._retained_lock:
+                retained_slots = set(self._retained_slots)
+            free = [
+                i
+                for i, s in enumerate(self.slots)
+                if s is None
+                and i not in retained_slots
+                and i not in self._warming
+            ]
+        if not free:
+            self.kv_import_refused_capacity_total += 1
+            raise KVNoCapacity(
+                f"KV import for rid={rid}: every slot is running, "
+                "warming, or pinned"
+            )
+        slot = free[0]
+        self._free_slot_blocks(slot)
+        try:
+            blocks = self._alloc_blocks(nb_need)
+        except OutOfBlocks:
+            self.kv_import_refused_capacity_total += 1
+            raise KVNoCapacity(
+                f"KV import for rid={rid} needs {nb_need} blocks; live "
+                "sequences hold the pool"
+            ) from None
+        bucket = 1
+        while bucket < nb_need:
+            bucket *= 2
+        ids = np.full(bucket, TRASH_BLOCK, np.int32)
+        ids[:nb_need] = blocks
+        padded = {}
+        for k, r in rows.items():
+            if bucket != nb_need:
+                pad = np.zeros(
+                    (r.shape[0], bucket - nb_need) + tuple(r.shape[2:]),
+                    r.dtype,
+                )
+                r = np.concatenate([r, pad], axis=1)
+            padded[k] = jnp.asarray(r)
+        self.cache = self._jit_import_blocks(
+            self.cache, padded, jnp.asarray(ids)
+        )
+        self.block_table[slot, :nb_need] = blocks
+        self.block_table[slot, nb_need:] = -1
+        self._slot_nblocks[slot] = nb_need
+        self.cache_len[slot] = n_cov
+        self._slot_covered[slot] = [int(t) for t in tokens[:-1]]
+        self._slot_kv_version[slot] = version
+        self.pos_delta[slot] = 0
+        self.last_token[slot] = int(tokens[-1])
+        now = time.monotonic()
+        self._slot_last_use[slot] = now
+        with self._retained_lock:
+            stale = self._retained.pop(rid, None)
+            if stale is not None:
+                self._retained_slots.pop(stale.slot, None)
+            self._retained[rid] = _Retained(
+                slot=slot,
+                covered=tuple(int(t) for t in tokens[:-1]),
+                feed_tok=int(tokens[-1]),
+                ts=now,
+                version=version,
+                pinned=True,
+            )
+            self._retained_slots[slot] = rid
+        dur = now - t0
+        self.kv_import_total += 1
+        self.kv_import_tokens_total += n_cov
+        self.kv_import_seconds_last = dur
+        self._ttft_phase_hist.labels(phase="kv_ship").observe(dur)
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record(
+            "kv_ship",
+            "import",
+            rid=rid,
+            tokens=n_cov,
+            blocks=nb_need,
+            version=version,
+            seconds=round(dur, 6),
+        )
+        logger.info(
+            "imported shipped KV for rid=%s: %d tokens into %d blocks "
+            "(slot %d, v%d, %.3fs since staging began) — next /generate "
+            "resumes with zero re-prefill",
+            rid, n_cov, nb_need, slot, version, dur,
+        )
+
     def _reap_retained(self):
         """TTL reaper for retained-KV entries (hygiene satellite): a client
         that disconnects mid-interrupt-loop must not pin KV until LRU
@@ -2252,6 +2738,19 @@ class GenerationEngine:
                 )
                 live_blocks.discard(-1)
 
+        def stamp_admitted(s: _Seq, ent: dict, resumed: bool = False):
+            # TTFT decomposition, phase 1: time spent queued before this
+            # admission landed (from ORIGINAL submission — a requeued
+            # entry keeps t_first). ``resumed`` steers phase 2's label:
+            # admission->first-token is decode-only for a zero-re-prefill
+            # resume but prefill compute for a fresh placement.
+            now = time.monotonic()
+            s.t_admitted = now
+            s.admitted_via_resume = resumed
+            self._ttft_phase_hist.labels(phase="queue_wait").observe(
+                max(0.0, now - ent["t_first"])
+            )
+
         while token_budget > 0:
             popped = self.scheduler.pop()
             if popped is None:
@@ -2271,6 +2770,7 @@ class GenerationEngine:
                     queue_depth=self.scheduler.depth,
                 )
             if self._try_resume(seq):
+                stamp_admitted(seq, entry, resumed=True)
                 note_admitted(seq.slot)
                 continue  # resume costs no device dispatch
             if seq.out_tokens:
@@ -2362,12 +2862,14 @@ class GenerationEngine:
                 ) and best > 0:
                     flush()
             if self._try_clone(seq, free[0]):
+                stamp_admitted(seq, entry, resumed=True)
                 note_admitted(free[0])
                 continue  # block sharing + at most one block copy
             radix_cost = self._try_radix(seq, free[0], match=radix_m)
             if radix_cost is not None:
                 # radix-cache hit: only the uncovered suffix cost prefill
                 # compute (0 for a full-cover hit)
+                stamp_admitted(seq, entry, resumed=(radix_cost == 0))
                 note_admitted(free[0])
                 token_budget -= radix_cost
                 continue
@@ -2406,6 +2908,7 @@ class GenerationEngine:
                     "seq": seq, "blocks": blocks, "off": 0,
                     "version": self.version,
                 }
+                stamp_admitted(seq, entry)
                 note_admitted(slot)
                 token_budget = self._advance_warming(token_budget)
                 continue
@@ -2418,6 +2921,7 @@ class GenerationEngine:
             )
             if pending and pending_tokens[0] + len(seq.prompt) > cap:
                 flush()
+            stamp_admitted(seq, entry)
             pending.append(seq)
             pending_slots.append(free[0])
             pending_blocks.append(blocks)
@@ -3243,18 +3747,63 @@ class GenerationEngine:
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
+            # adaptive draft length: a low-acceptance sequence proposes a
+            # SHORTER draft (fewer dead verify rows) while the verify
+            # window's compiled width stays the static spec_draft_len —
+            # unused lanes cost zeros, never a retrace
+            ki = (
+                (s.spec_k or self._spec_draft_max)
+                if self._spec_adaptive
+                else k
+            )
             # slice the tail BEFORE concatenating: the proposer only
             # scans MAX_SCAN tokens, so don't copy a 32k-token list per
             # slot per window either
             cov = self._slot_covered[i]
             hist = cov[-(MAX_SCAN - 1):] + [int(self.last_token[i])]
             prop = ngram_propose(
-                hist, cfg.spec_ngram_min, cfg.spec_ngram_max, k
+                hist, cfg.spec_ngram_min, cfg.spec_ngram_max, ki
             )
             if prop:
                 draft[i, : len(prop)] = prop
                 dlen[i] = len(prop)
         return draft, dlen
+
+    def _spec_draft_len_current(self) -> float:
+        """Mean per-slot draft window over the running batch (the static
+        configured length while idle or when adaptation is off)."""
+        if not self._spec_enabled:
+            return 0.0
+        if not self._spec_adaptive:
+            return float(self.config.spec_draft_len)
+        ks = [
+            (s.spec_k or self._spec_draft_max)
+            for s in self.slots
+            if s is not None
+        ]
+        return (
+            float(sum(ks)) / len(ks) if ks else float(self._spec_draft_max)
+        )
+
+    def _spec_accept_ewma_mean(self) -> float:
+        """Mean acceptance-rate EWMA over the running batch (1.0 idle —
+        the optimistic prior every sequence starts from)."""
+        if not self._spec_enabled:
+            return 0.0
+        es = [s.spec_ewma for s in self.slots if s is not None]
+        return float(sum(es)) / len(es) if es else 1.0
+
+    def _spec_adapt(self, seq: _Seq, proposed: int, accepted: int) -> None:
+        """Fold one verify window's outcome into the sequence's acceptance
+        EWMA and re-derive its draft window:
+        ``k = min + round(ewma * (max - min))`` clamped to [min, max]."""
+        alpha = self.config.spec_adapt_alpha
+        rate = accepted / proposed
+        seq.spec_ewma = (1.0 - alpha) * seq.spec_ewma + alpha * rate
+        dmin, dmax = self._spec_draft_min, self._spec_draft_max
+        seq.spec_k = min(
+            dmax, max(dmin, dmin + round(seq.spec_ewma * (dmax - dmin)))
+        )
 
     # arealint: hot-path
     def _try_spec_decode_chunk(self) -> bool:
@@ -3327,6 +3876,8 @@ class GenerationEngine:
                     proposed=int(dlen[i]),
                     accepted=int(n_acc[i]),
                 )
+            if self._spec_adaptive and int(dlen[i]) > 0:
+                self._spec_adapt(seq, int(dlen[i]), int(n_acc[i]))
             # accepted drafts then the correction/bonus token; a stop token
             # mid-window truncates — _emit_token released the slot and the
             # remaining accepted tokens are dropped (cache_len stays at the
@@ -3393,6 +3944,11 @@ class GenerationEngine:
         if seq is None:
             return
         self.slots[slot] = None
+        if seq.prefill_only and seq.out_tokens:
+            # disaggregated prefill leg: the whole point of this request
+            # is the KV it leaves behind — retain AND pin unconditionally
+            # so export_kv finds it (release_kv / ship drops the pin)
+            retain = pin = True
         if retain and (seq.out_tokens or pin):
             self._retain_seq(slot, seq, pin=pin)
         # keep cache_len, covered tokens, and the block table — the rows
@@ -3452,6 +4008,18 @@ class GenerationEngine:
         # observed once per request at finish — off the per-token path
         if seq.t_first_token is not None:
             self._ttft_hist.observe(seq.t_first_token - seq.t_submit)
+            if seq.t_admitted is not None:
+                # TTFT decomposition: admission -> first token is prefill
+                # compute for a fresh admission, but pure decode for a
+                # zero-re-prefill resume (the prefill cost was paid — and
+                # observed — elsewhere, possibly on another server)
+                self._ttft_phase_hist.labels(
+                    phase=(
+                        "first_decode"
+                        if seq.admitted_via_resume
+                        else "prefill"
+                    )
+                ).observe(seq.t_first_token - seq.t_admitted)
             for d in seq.itl:
                 self._itl_hist.observe(d)
         return ModelResponse(

@@ -65,6 +65,13 @@ class AdmissionScheduler:
 
     # ------------------------------------------------------------------
 
+    def queue_wait_p95(self) -> float:
+        """p95 of the admission-wait histogram — the prefill-pool scaling
+        signal under disaggregation (the decode pool scales on ITL p95
+        instead; a prefill flood shows up HERE first, before TTFT p95
+        moves, because queued requests have no TTFT sample yet)."""
+        return self._wait_hist.quantile(0.95)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._heap) - len(self._removed)

@@ -134,6 +134,10 @@ class GenerationServer:
                 web.post("/update_lora_weights", self.update_lora_weights),
                 web.post("/relay_weights", self.relay_weights),
                 web.post("/push_weights_to_peer", self.push_weights_to_peer),
+                # prefill/decode disaggregation: a decode server ingests
+                # shipped KV here; a prefill server pushes it there
+                web.post("/import_kv", self.import_kv),
+                web.post("/ship_kv", self.ship_kv),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -235,7 +239,14 @@ class GenerationServer:
                     status=503,
                 )
         return web.json_response(
-            {"status": "ready", "weight_version": version}
+            {
+                "status": "ready",
+                "weight_version": version,
+                # serving role ("" generalist | "prefill" | "decode"): the
+                # client's role-aware router and the fleet controller's
+                # per-role pools both read it from this gate
+                "role": getattr(getattr(e, "config", None), "role", ""),
+            }
         )
 
     async def model_info(self, request: web.Request) -> web.Response:
@@ -317,6 +328,7 @@ class GenerationServer:
                     # priority falls into the 400 path below (a malformed
                     # request must fail fast, not 500-and-retry)
                     priority=int(body.get("priority") or 0),
+                    prefill_only=bool(body.get("prefill_only")),
                     **submit_kwargs,
                 )
             except (ValueError, TypeError) as e:  # invalid request: fail fast
@@ -867,6 +879,282 @@ class GenerationServer:
         )
         return web.json_response(
             {"success": True, "weight_version": version, "chunks": n}
+        )
+
+    async def import_kv(self, request: web.Request) -> web.Response:
+        """Disaggregated serving, receive side: ingest one KV-ship chunk
+        (safetensors body over the wire encode path, like weight chunks)
+        into this engine's staging area; the ``final`` chunk carries the
+        full token list under the reserved ``__tokens__`` leaf and commits
+        — the sequence lands as a pinned retained entry, so the follow-up
+        ``/generate`` with those exact tokens admits via ``_try_resume``
+        with zero re-prefill. Refusals are LOUD and typed: 400 digest/
+        payload errors, 412 weight-version fence (a commit landed between
+        prefill and import — the client falls back to a local full
+        prefill), 503 no slot/blocks capacity."""
+        import numpy as np
+        from safetensors.numpy import load as st_load
+
+        from areal_tpu.inference.engine import (
+            KVNoCapacity,
+            KVVersionMismatch,
+        )
+        from areal_tpu.utils import wire
+
+        rid = request.query.get("rid") or ""
+        if not rid:
+            return web.json_response(
+                {"success": False, "message": "rid required"}, status=400
+            )
+        try:
+            version = int(request.query["version"])
+            seq_idx = int(request.query.get("seq", "0"))
+        except (KeyError, ValueError) as e:
+            return web.json_response(
+                {"success": False, "message": f"bad query: {e}"}, status=400
+            )
+        final = request.query.get("final", "1") == "1"
+        want_digest = request.query.get("digest") or ""
+        body = await request.read()
+        try:
+            named = wire.decode_named(st_load(body))
+        except Exception as e:
+            return web.json_response(
+                {"success": False, "message": f"undecodable KV chunk: {e}"},
+                status=400,
+            )
+        if want_digest and wire.chunk_digest(named) != want_digest:
+            # torn/corrupted body: refuse BEFORE any of it can reach the
+            # pool — garbage attention state decodes plausible-looking
+            # tokens, which is far worse than a loud 400
+            return web.json_response(
+                {
+                    "success": False,
+                    "message": (
+                        f"KV chunk digest mismatch for rid={rid} seq="
+                        f"{seq_idx} (torn or corrupted ship stream)"
+                    ),
+                },
+                status=400,
+            )
+        tokens = named.pop("__tokens__", None)
+        try:
+            if named:
+                await self._offload(
+                    self.engine.stage_kv_chunk, rid, version, seq_idx, named
+                )
+            if final:
+                if tokens is None:
+                    return web.json_response(
+                        {
+                            "success": False,
+                            "message": "final KV chunk missing __tokens__",
+                        },
+                        status=400,
+                    )
+                await self._offload(
+                    self.engine.commit_kv_import,
+                    rid,
+                    version,
+                    [int(t) for t in np.asarray(tokens).reshape(-1)],
+                )
+        except KVVersionMismatch as e:
+            return web.json_response(
+                {
+                    "success": False,
+                    "message": str(e),
+                    "weight_version": self.engine.get_version(),
+                },
+                status=412,
+            )
+        except KVNoCapacity as e:
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=503
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=400
+            )
+        return web.json_response(
+            {
+                "success": True,
+                "weight_version": self.engine.get_version(),
+                "committed": final,
+            }
+        )
+
+    async def ship_kv(self, request: web.Request) -> web.Response:
+        """Disaggregated serving, send side: stream the retained KV for
+        ``rid`` from THIS (prefill) server straight to ``target``'s
+        ``/import_kv`` — server-to-server like ``push_weights_to_peer``,
+        so the bytes cross the network once instead of bouncing through
+        the client. Up to ``pipeline_depth`` non-final chunks ship
+        concurrently (staging on the target is keyed by ``seq``, so order
+        does not matter); the committing ``final`` chunk (it carries
+        ``__tokens__``) goes last, alone, after every staged part landed.
+        Success releases the pinned source copy. A 412/503 from the
+        target passes through verbatim so the client can count the exact
+        fallback reason."""
+        import numpy as np
+        from urllib.parse import quote
+
+        from safetensors.numpy import save as st_save
+
+        from areal_tpu.utils import wire
+        from areal_tpu.utils.http import (
+            HTTPRequestError,
+            arequest_with_retry,
+        )
+
+        peer_token = request.headers.get(propagation.RELAY_TOKEN_HEADER)
+        if not propagation.token_ok(peer_token):
+            return web.json_response(
+                {"success": False, "message": "bad or missing relay token"},
+                status=403,
+            )
+        self._note_unverified_token(peer_token)
+        body = await request.json()
+        rid = body.get("rid")
+        target = body.get("target")
+        if not isinstance(rid, str) or not rid:
+            return web.json_response(
+                {"success": False, "message": "rid required"}, status=400
+            )
+        if not isinstance(target, str) or not target:
+            return web.json_response(
+                {"success": False, "message": "target address required"},
+                status=400,
+            )
+        chunk_mb = int(body.get("chunk_mb") or 8)
+        depth = max(1, int(body.get("pipeline_depth") or 1))
+        timeout = float(body.get("timeout") or 120.0)
+        try:
+            # export runs an engine-thread command + per-chunk device
+            # pulls: keep every blocking step on the bounded executor
+            meta, chunks = await self._offload(
+                self.engine.export_kv, rid, chunk_mb
+            )
+        except KeyError as e:
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=404
+            )
+        version = meta["version"]
+        tokens = meta["tokens"]
+        it = iter(chunks)
+
+        def next_part():
+            cur = next(it, None)
+            return None if cur is None else cur[0]
+
+        session = self._relay_session()
+        n = 0
+        sent_bytes = 0
+        t0 = time.monotonic()
+        pending: set[asyncio.Task] = set()
+
+        async def post_chunk(seq_idx: int, named: dict, final: bool) -> int:
+            digest = wire.chunk_digest(named)
+            blob = st_save(wire.encode_named(named))
+            if len(blob) > SERVER_CLIENT_MAX_SIZE:
+                raise ValueError(
+                    f"KV-ship chunk is {len(blob)} bytes (> client_"
+                    f"max_size={SERVER_CLIENT_MAX_SIZE}); lower chunk_mb"
+                )
+            await arequest_with_retry(
+                session,
+                f"http://{target}/import_kv?rid={quote(rid, safe='')}"
+                f"&version={version}&seq={seq_idx}&final={int(final)}"
+                f"&digest={digest}",
+                data=blob,
+                max_retries=2,
+                timeout=timeout,
+            )
+            return len(blob)
+
+        async def reap(tasks) -> None:
+            nonlocal n, sent_bytes
+            for t in tasks:
+                sent_bytes += await t  # re-raises the task's failure
+                n += 1
+
+        async def abort_pending() -> None:
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+        try:
+            cur = await self._offload(next_part)
+            if cur is None:
+                raise RuntimeError("engine exported no KV chunks")
+            seq_idx = 0
+            while cur is not None:
+                nxt = await self._offload(next_part)
+                final = nxt is None
+                if final:
+                    # every staged part must land before the commit chunk
+                    await reap(pending)
+                    pending = set()
+                    cur = dict(cur)
+                    cur["__tokens__"] = np.asarray(tokens, np.int32)
+                    await reap([asyncio.ensure_future(
+                        post_chunk(seq_idx, cur, True)
+                    )])
+                else:
+                    # bounded pipeline: the next chunk's device pull +
+                    # serialization overlaps the in-flight sends
+                    pending.add(
+                        asyncio.ensure_future(post_chunk(seq_idx, cur, False))
+                    )
+                    while len(pending) >= depth:
+                        done, pending = await asyncio.wait(
+                            pending, return_when=asyncio.FIRST_COMPLETED
+                        )
+                        await reap(done)
+                seq_idx += 1
+                cur = nxt
+        except HTTPRequestError as e:
+            await abort_pending()
+            # the target's typed refusal (412 version fence / 503 no
+            # capacity) passes through; transport failures become 502
+            status = e.status if e.status in (412, 503) else 502
+            logger.warning(
+                "ship_kv rid=%s -> %s refused/failed: %s", rid, target, e
+            )
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=status
+            )
+        except Exception as e:
+            await abort_pending()
+            logger.exception("ship_kv rid=%s -> %s failed", rid, target)
+            return web.json_response(
+                {"success": False, "message": str(e)}, status=500
+            )
+        self.engine.release_kv(rid)
+        self._egress_peer.inc(sent_bytes)
+        from areal_tpu.utils import flight_recorder
+
+        flight_recorder.record(
+            "kv_ship",
+            "export",
+            rid=rid,
+            target=target,
+            version=version,
+            chunks=n,
+            bytes=sent_bytes,
+            seconds=round(time.monotonic() - t0, 4),
+        )
+        logger.info(
+            "KV ship: rid=%s %d chunk(s) (%d tokens, v%d, %.1f MB) -> %s",
+            rid, n, len(tokens) - 1, version, sent_bytes / 1e6, target,
+        )
+        return web.json_response(
+            {
+                "success": True,
+                "weight_version": version,
+                "chunks": n,
+                "tokens": len(tokens),
+            }
         )
 
     # -- lifecycle ------------------------------------------------------

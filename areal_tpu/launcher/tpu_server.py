@@ -52,6 +52,14 @@ def _load_tokenizer(path: str):
 
 async def amain(cfg: GenServerConfig):
     name_resolve.reconfigure(cfg.name_resolve)
+    # serving-role override from the fleet provider's spawn env: a
+    # role-scoped controller spawns both pools from ONE argv template and
+    # differentiates them here (must land before engine construction —
+    # the engine validates the role and reconfigures the scheduler for
+    # decode-only service)
+    env_role = os.environ.get("AREAL_SERVER_ROLE", "")
+    if env_role:
+        cfg.server.role = env_role
     # skip_tokenizer_init: callers speak token ids end-to-end, so skip the
     # HF load entirely (stop-string matching is disabled either way)
     tokenizer = (
@@ -67,6 +75,13 @@ async def amain(cfg: GenServerConfig):
     addr = f"{network.gethostip()}:{port}"
     server_id = os.environ.get("AREAL_SERVER_ID") or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
     key = names.gen_server(cfg.experiment_name, cfg.trial_name, server_id)
+    # role tag ("addr role" value, own subtree): clients' role-aware
+    # routing discovers pool membership from here
+    role_key = (
+        names.gen_server_role(cfg.experiment_name, cfg.trial_name, server_id)
+        if cfg.server.role
+        else None
+    )
     if os.environ.get("AREAL_FLEET_MANAGED") == "1":
         # fleet-provider-spawned: the controller registers this server only
         # AFTER the /ready + version-checked warmup passes — self-
@@ -77,7 +92,14 @@ async def amain(cfg: GenServerConfig):
         logger.info("fleet-managed: skipping self-registration of %s", key)
     else:
         name_resolve.add(key, addr, replace=True)
-        logger.info("registered %s -> %s", key, addr)
+        if role_key is not None:
+            name_resolve.add(role_key, f"{addr} {cfg.server.role}", replace=True)
+        logger.info(
+            "registered %s -> %s%s",
+            key,
+            addr,
+            f" (role={cfg.server.role})" if cfg.server.role else "",
+        )
 
     stop_key = f"{names.trial_root(cfg.experiment_name, cfg.trial_name)}/shutdown"
     # per-server drain key (elastic fleet scale-in): the controller sets it
@@ -137,6 +159,13 @@ async def amain(cfg: GenServerConfig):
                 name_resolve.delete(key)
             except Exception:
                 logger.debug("deregister-on-exit failed", exc_info=True)
+            if role_key is not None:
+                try:
+                    name_resolve.delete(role_key)
+                except Exception:
+                    logger.debug(
+                        "role-tag deregister-on-exit failed", exc_info=True
+                    )
             # bounded-time drain (SIGTERM/scale-in): give in-flight work the
             # grace budget, then interrupt the rest at a token boundary so
             # clients resume token-exactly on a healthy peer — shutdown

@@ -22,6 +22,20 @@ def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
     return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
 
 
+def gen_server_roles(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which inference servers register their serving ROLE
+    ("prefill" | "decode"; generalists register nothing). Keyed by the
+    same server_id as :func:`gen_server` so the client's role-aware
+    router and the fleet controller's per-role pools can join the two
+    subtrees. Deliberately OUTSIDE ``gen_servers`` so role tags are never
+    resolved as server addresses."""
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_roles"
+
+
+def gen_server_role(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{gen_server_roles(experiment_name, trial_name)}/{server_id}"
+
+
 def gen_server_drain(experiment_name: str, trial_name: str, server_id: str) -> str:
     """Per-server drain request key (elastic fleet scale-in of a server the
     controller did not spawn): the server watches its own key and exits

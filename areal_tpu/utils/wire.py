@@ -48,6 +48,31 @@ def encode_named(named: dict) -> dict:
     return out
 
 
+def chunk_digest(named: dict) -> str:
+    """Content digest of a dotted-path -> array chunk, stable across the
+    encode/decode round trip: hashes (name, canonical dtype, shape, raw
+    bytes) in sorted name order, where a bf16 leaf hashes identically
+    whether it is still bfloat16 or already a uint16 wire view. Receivers
+    recompute this after :func:`decode_named` and compare against the
+    digest the sender stamped on the chunk."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for name in sorted(named.keys()):
+        v = np.ascontiguousarray(named[name])
+        dtype = str(v.dtype)
+        if name.endswith(BF16_MARKER):
+            name = name[: -len(BF16_MARKER)]
+            dtype = "bfloat16"
+        elif dtype == "bfloat16":
+            v = v.view(np.uint16)
+        h.update(name.encode())
+        h.update(dtype.encode())
+        h.update(repr(tuple(v.shape)).encode())
+        h.update(v.tobytes())
+    return h.hexdigest()
+
+
 def decode_named(named: dict) -> dict:
     """Invert :func:`encode_named` after safetensors load (bit-exact)."""
     import ml_dtypes

@@ -2185,6 +2185,271 @@ def elastic_fleet_bench(n_requests: int = 48, new_tokens: int = 16,
     }
 
 
+def disaggregated_serving_bench(n_requests: int = 8, prompt_len: int = 256,
+                                new_tokens: int = 24,
+                                interarrival: float = 0.25,
+                                batch: int = 0, steps_per_call: int = 2,
+                                **_):
+    """Prefill/decode disaggregation rung (ISSUE 20): the same mixed
+    open-loop load (prompt lengths staggered around ``prompt_len``)
+    against two REAL model servers, colocated (both generalist, client
+    single-pool) vs disaggregated (one prefill-role + one decode-role,
+    KV shipped over /ship_kv -> /import_kv, decode driven on the decode
+    server with zero re-prefill).
+
+    The headline is the decode inter-token-latency p95 ratio
+    colocated/disaggregated (higher is better): on a colocated server
+    every arriving prompt's prefill steals engine iterations from
+    running decodes, while a decode-role server never prefills — that
+    isolation is the latency value the split buys, visible even on CPU.
+
+    Hard gates in-child:
+    - zero failed requests in either mode;
+    - greedy outputs token-identical across modes (the split may move
+      work, never change tokens);
+    - every disaggregated request actually SHIPPED (a silent fallback to
+      single-pool would measure nothing);
+    - a staged weight commit landing on the decode pool between prefill
+      and import fences with 412 -> counted fallback -> local re-prefill
+      that is STILL token-identical (same-value weights, new version)."""
+    import asyncio
+    import threading
+
+    import jax as _jax
+    import jax.numpy as _jnp
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        DisaggregationConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import GenerationServer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.lm import init_params
+    from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    model_cfg = tiny_config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    )
+    # every pool needs a slot per in-flight request: the open-loop load
+    # can pile the whole request set onto one pool (prefill holds pinned
+    # retained KV until its ship lands; decode holds every running
+    # sequence), and slot pressure would evict pinned entries — a real
+    # production behavior, but here it would silently turn shipped
+    # requests into fallbacks and poison the all-shipped hard gate
+    batch = batch or n_requests
+
+    def make_params():
+        return init_params(model_cfg, _jax.random.PRNGKey(0), _jnp.float32)
+
+    def serve(role: str):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                max_batch_size=batch, max_seq_len=2048, prefill_chunk=64,
+                decode_steps_per_call=steps_per_call, dtype="float32",
+                role=role,
+            ),
+            model_config=model_cfg,
+            params=make_params(),
+        )
+        server = GenerationServer(eng)
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        port = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1", 0), loop
+        ).result(timeout=120)
+
+        def stop():
+            asyncio.run_coroutine_threadsafe(server.stop(), loop).result(60)
+            loop.call_soon_threadsafe(loop.stop)
+
+        return f"127.0.0.1:{port}", eng, stop
+
+    def ship_count(outcome: str) -> float:
+        return DEFAULT_REGISTRY.counter(
+            "areal_client_kv_ship_total", labels=("outcome",),
+        ).labels(outcome=outcome).value
+
+    # mixed load: deterministic prompts staggered around prompt_len
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, 127, size=prompt_len + (i % 4) * 32).tolist()
+        for i in range(n_requests)
+    ]
+
+    def run_mode(disagg: bool):
+        addr_a, eng_a, stop_a = serve("prefill" if disagg else "")
+        addr_b, eng_b, stop_b = serve("decode" if disagg else "")
+        client = RemoteInfEngine(InferenceEngineConfig(
+            experiment_name="bench-disagg", trial_name="t",
+            max_concurrent_rollouts=n_requests, consumer_batch_size=2,
+            request_retries=2,
+            disaggregation=DisaggregationConfig(enabled=disagg),
+        ))
+        client.initialize([addr_a, addr_b], train_data_parallel_size=1)
+        try:
+            async def one(i, p):
+                req = ModelRequest(
+                    rid=f"r{i}", input_ids=list(p),
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=new_tokens,
+                        min_new_tokens=new_tokens, greedy=True,
+                    ),
+                )
+                r = await client.agenerate(req)
+                return r.output_tokens, r.ttft, r.itl
+
+            async def load():
+                try:
+                    tasks = []
+                    for i, p in enumerate(prompts):
+                        tasks.append(asyncio.ensure_future(one(i, p)))
+                        await asyncio.sleep(interarrival)
+                    return await asyncio.gather(
+                        *tasks, return_exceptions=True
+                    )
+                finally:
+                    await client._close_session_for_current_loop()
+
+            # warm every engine's jit caches OUTSIDE the measured window
+            # (colocated: one pinned request per server compiles prefill
+            # + decode on both; disagg: two shipped requests — sized for
+            # both pow2 import-block buckets the load will hit — compile
+            # prefill on the prefill engine and import-scatter + decode
+            # on the decode engine: exactly the work each pool does under
+            # load, so no mid-measurement compile stalls ITL or pins
+            # retained KV long enough to trigger pressure eviction)
+            warm_sizes = (prompt_len, prompt_len + 96)
+
+            async def warm():
+                try:
+                    if disagg:
+                        for i, n in enumerate(warm_sizes):
+                            await one(
+                                f"warm{i}",
+                                rng.integers(1, 127, size=n).tolist(),
+                            )
+                    else:
+                        for i, a in enumerate((addr_a, addr_b)):
+                            client._rid_to_address[f"rwarm{i}"] = a
+                            await one(
+                                f"warm{i}",
+                                rng.integers(
+                                    1, 127, size=warm_sizes[-1]
+                                ).tolist(),
+                            )
+                finally:
+                    await client._close_session_for_current_loop()
+
+            asyncio.run(warm())
+            shipped0 = ship_count("shipped")
+            import0 = eng_b.kv_import_total
+            t0 = time.monotonic()
+            out = asyncio.run(load())
+            wall = time.monotonic() - t0
+            failed = [r for r in out if isinstance(r, BaseException)]
+            assert not failed, f"failed requests ({'disagg' if disagg else 'colocated'}): {failed[:2]}"
+            ok = [r for r in out if not isinstance(r, BaseException)]
+            itls = sorted(v for _, _, itl in ok for v in itl)
+            ttfts = sorted(t for _, t, _ in ok)
+
+            def p95(xs):
+                return xs[int(0.95 * (len(xs) - 1))] if xs else 0.0
+
+            res = {
+                "itl_p95_s": round(p95(itls), 4),
+                "ttft_p95_s": round(p95(ttfts), 4),
+                "tokens_per_sec": round(
+                    sum(len(toks) for toks, _, _ in ok) / max(wall, 1e-6), 1
+                ),
+                "wall_s": round(wall, 3),
+                "tokens": [toks for toks, _, _ in ok],
+            }
+            if disagg:
+                # every request must have taken the shipped path: a
+                # fallback measures the single-pool plane under a
+                # disaggregated label
+                shipped = ship_count("shipped") - shipped0
+                assert shipped == n_requests, (
+                    f"only {shipped}/{n_requests} requests shipped KV"
+                )
+                assert eng_b.kv_import_total - import0 == n_requests, (
+                    eng_b.kv_import_total
+                )
+                res["shipped"] = int(shipped)
+
+                # staged weight commit between prefill and import: bump
+                # the decode pool to v1 with IDENTICAL weights — the next
+                # ship must fence (412), fall back loudly, and still
+                # produce the same greedy tokens
+                flat = {}
+
+                def walk(node, prefix=""):
+                    for k in sorted(node):
+                        v = node[k]
+                        path = f"{prefix}.{k}" if prefix else k
+                        if isinstance(v, dict):
+                            walk(v, path)
+                        else:
+                            flat[path] = np.asarray(_jax.device_get(v))
+
+                walk(eng_b.params)
+                eng_b.update_weights_from_named_arrays(flat, version=1)
+                fence0 = ship_count("fallback_version_fence")
+
+                async def fenced():
+                    try:
+                        return await one("fence", prompts[0])
+                    finally:
+                        await client._close_session_for_current_loop()
+
+                toks_f, _, _ = asyncio.run(fenced())
+                assert ship_count("fallback_version_fence") == fence0 + 1, (
+                    "weight commit between prefill and import did not "
+                    "fence with 412"
+                )
+                assert toks_f == res["tokens"][0], (
+                    "greedy identity broke across the staged weight commit"
+                )
+                res["fence_identity"] = True
+            return res
+        finally:
+            client.destroy()
+            stop_a()
+            stop_b()
+
+    colocated = run_mode(disagg=False)
+    disagg = run_mode(disagg=True)
+    assert disagg["tokens"] == colocated["tokens"], (
+        "disaggregation changed greedy outputs"
+    )
+    return {
+        "itl_p95_improvement": round(
+            colocated["itl_p95_s"] / max(disagg["itl_p95_s"], 1e-6), 3
+        ),
+        "itl_p95_colocated_s": colocated["itl_p95_s"],
+        "itl_p95_disagg_s": disagg["itl_p95_s"],
+        "ttft_p95_colocated_s": colocated["ttft_p95_s"],
+        "ttft_p95_disagg_s": disagg["ttft_p95_s"],
+        "tokens_per_sec_colocated": colocated["tokens_per_sec"],
+        "tokens_per_sec_disagg": disagg["tokens_per_sec"],
+        "shipped": disagg["shipped"],
+        "fence_identity": disagg["fence_identity"],
+        "greedy_identity": True,
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "interarrival": interarrival,
+    }
+
+
 def prefix_cache_bench(layers: int = 2, vocab: int = 2048,
                        group_size: int = 8, prompt_len: int = 256,
                        new_tokens: int = 32, turns: int = 3,
@@ -3042,6 +3307,41 @@ def main():
         except Exception as e:  # noqa: BLE001
             note_rung_failure("elastic_fleet", "elastic-fleet", e)
 
+    # ---- rung 3.75: prefill/decode disaggregation — mixed open-loop
+    # load, colocated vs disaggregated over real model servers (ISSUE
+    # 20). Greedy identity across modes AND across a staged weight
+    # commit (412 fence -> loud local re-prefill), plus all-requests-
+    # shipped, are hard gates in the child; the emitted value is the
+    # decode ITL p95 ratio colocated/disaggregated (higher is better:
+    # the decode pool's isolation from arriving prefills). ----
+    if remaining(deadline) > 150:
+        try:
+            log("disaggregated serving rung")
+            ds = _run_child(
+                "disagg",
+                dict(
+                    n_requests=6, prompt_len=192, new_tokens=16,
+                    interarrival=0.25,
+                )
+                if REHEARSAL
+                else dict(
+                    n_requests=12, prompt_len=256, new_tokens=24,
+                    interarrival=0.2,
+                ),
+                timeout=min(600.0, remaining(deadline) - 60),
+            )
+            emit({
+                "metric": "disaggregated_serving",
+                "value": ds["itl_p95_improvement"],
+                "unit": "x_decode_itl_p95_colocated_vs_disagg",
+                "vs_baseline": None,
+                "chip": chip,
+                **{k: v for k, v in ds.items()
+                   if k != "itl_p95_improvement"},
+            })
+        except Exception as e:  # noqa: BLE001
+            note_rung_failure("disaggregated_serving", "disagg", e)
+
     # ---- rung 4: full GRPO step (async-RL headline metric) ----
     if remaining(deadline) > 420:
         try:
@@ -3265,6 +3565,8 @@ def _child_main():
         print(json.dumps(weight_propagation_bench(**att)))
     elif kind == "--fleet-child":
         print(json.dumps(elastic_fleet_bench(**att)))
+    elif kind == "--disagg-child":
+        print(json.dumps(disaggregated_serving_bench(**att)))
     elif kind == "--reward-child":
         print(json.dumps(reward_service_bench(**att)))
     elif kind == "--grpo-child":

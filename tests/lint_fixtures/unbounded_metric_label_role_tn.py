@@ -1,0 +1,25 @@
+"""TN fixture: the serving-role label is a closed enum — ``prefill`` /
+``decode`` / ``""`` (generalist), validated at config load — so the
+role-labeled disaggregation metrics are bounded-cardinality and must not
+flag, whether the value arrives as a literal or as a variable holding a
+member of the enum."""
+
+from areal_tpu.utils import metrics
+
+
+def good(role, outcome_ok):
+    g = metrics.gauge("areal_fleet_role_size", labels=("role",))
+    # role values come from the three-member serving-role enum, never
+    # from request ids
+    g.labels(role=role).set(2)
+    g.labels(role="prefill").set(1)
+    g.labels(role="decode").set(1)
+    d = metrics.gauge("areal_fleet_role_desired_size", labels=("role",))
+    d.labels(role=role).set(2)
+    h = metrics.histogram("areal_ttft_phase_seconds", labels=("phase",))
+    h.labels(phase="kv_ship").observe(0.01)
+    h.labels(phase="queue_wait" if outcome_ok else "prefill").observe(0.02)
+    c = metrics.counter("areal_client_kv_ship_total", labels=("outcome",))
+    c.labels(
+        outcome="shipped" if outcome_ok else "fallback_ship_failed"
+    ).inc()

@@ -1,0 +1,612 @@
+"""Prefill/decode disaggregation (ISSUE 20): KV-shipping serving split.
+
+The contract under test, engine-level and end-to-end against REAL servers:
+
+- **Zero re-prefill handoff**: a prefill-only request's retained KV,
+  exported as versioned digest-stamped chunks and imported on a decode
+  peer, admits the follow-up ``prompt + first token`` through the
+  retained-KV resume path — greedy output token-identical to a
+  single-engine run, with ``resumed_total`` (not a fresh prefill)
+  accounting for the admission.
+- **Weight-version fence**: a weight commit landing between prefill and
+  import makes both the stage fast-path and the authoritative commit
+  refuse with :class:`KVVersionMismatch` (HTTP 412 over the wire); the
+  client counts ``fallback_version_fence`` and re-prefills locally on the
+  decode server — loud, counted, still token-exact.
+- **Chaos**: the prefill server dying between prefill and KV ship takes
+  the ``fallback_ship_failed`` path: sampled tokens are KEPT (interrupt
+  splice semantics) and decode full-prefills locally, token-exactly.
+- **int8 pools**: KV shipped from an int8 block pool (k/v rows + ks/vs
+  scale planes) re-exports bit-identical from the importing pool.
+- **Single-pool pin**: with ``serving.disaggregation`` off (the default)
+  nothing disaggregation-shaped runs — no export, no import, no client
+  ship counters — and output is byte-identical to the plain path.
+- **Role-aware fleet policy**: per-role bounds, and signal ownership
+  (decode pools ignore admission signals, prefill pools ignore ITL).
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    DisaggregationConfig,
+    FleetConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxGenConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.fleet.policy import (
+    FleetPolicy,
+    FleetSignals,
+    TargetTrackingPolicy,
+)
+from areal_tpu.inference.engine import (
+    GenerationEngine,
+    KVNoCapacity,
+    KVVersionMismatch,
+)
+from areal_tpu.inference.server import GenerationServer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.lm import init_params
+from areal_tpu.utils.metrics import DEFAULT_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _model():
+    cfg = tiny_config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, **gen_kw) -> GenerationEngine:
+    gen_kw.setdefault("max_batch_size", 4)
+    gen_kw.setdefault("max_seq_len", 2048)
+    gen_kw.setdefault("prefill_chunk", 64)
+    gen_kw.setdefault("decode_steps_per_call", 2)
+    gen_kw.setdefault("dtype", "float32")
+    eng = GenerationEngine(
+        JaxGenConfig(**gen_kw), model_config=cfg, params=params
+    )
+    # A bare engine (no GenerationServer) needs its loop thread started
+    # explicitly — submit() only enqueues.
+    eng.start()
+    return eng
+
+
+def _serve(cfg, params, **gen_kw):
+    """Engine + server on a private loop. Returns (addr, engine, stop)."""
+    engine = _engine(cfg, params, **gen_kw)
+    server = GenerationServer(engine)
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    port = asyncio.run_coroutine_threadsafe(
+        server.start("127.0.0.1", 0), loop
+    ).result(timeout=60)
+
+    def stop():
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(
+            timeout=30
+        )
+        loop.call_soon_threadsafe(loop.stop)
+
+    return f"127.0.0.1:{port}", engine, stop
+
+
+def _client(addrs, disagg: bool = False, **over) -> RemoteInfEngine:
+    cfg = InferenceEngineConfig(
+        experiment_name="disagg",
+        trial_name="t",
+        max_concurrent_rollouts=4,
+        consumer_batch_size=2,
+        request_retries=2,
+        disaggregation=DisaggregationConfig(enabled=disagg),
+        **over,
+    )
+    client = RemoteInfEngine(cfg)
+    client.initialize(addrs, train_data_parallel_size=1)
+    return client
+
+
+def _greedy(eng: GenerationEngine, prompt, max_new=8, rid=None) -> list[int]:
+    done = threading.Event()
+    out = []
+
+    def cb(r):
+        out.append(r)
+        done.set()
+
+    eng.submit(
+        rid or f"g-{time.monotonic_ns()}",
+        list(prompt),
+        GenerationHyperparameters(
+            max_new_tokens=max_new, min_new_tokens=max_new, greedy=True
+        ),
+        cb,
+    )
+    assert done.wait(120), "generation timed out"
+    return list(out[0].output_tokens)
+
+
+def _prefill_only(eng: GenerationEngine, rid: str, prompt) -> list[int]:
+    """One prefill-only leg: returns its (single) sampled token list."""
+    done = threading.Event()
+    out = []
+
+    def cb(r):
+        out.append(r)
+        done.set()
+
+    eng.submit(
+        rid,
+        list(prompt),
+        GenerationHyperparameters(max_new_tokens=1, greedy=True),
+        cb,
+        prefill_only=True,
+    )
+    assert done.wait(120), "prefill-only leg timed out"
+    return list(out[0].output_tokens)
+
+
+def _walk(node, prefix=""):
+    for k in sorted(node.keys()):
+        v = node[k]
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+def _flat_host(params) -> dict:
+    return {p: np.asarray(jax.device_get(v)) for p, v in _walk(params)}
+
+
+def _ship_count(outcome: str) -> float:
+    return DEFAULT_REGISTRY.counter(
+        "areal_client_kv_ship_total",
+        labels=("outcome",),
+    ).labels(outcome=outcome).value
+
+
+PROMPT = [5, 9, 17, 3, 44, 21, 8, 2, 60, 11, 34, 7, 19, 4, 90, 13,
+          6, 28, 1, 77, 12, 40, 9, 3, 55, 20, 14, 31, 2, 66, 18, 25,
+          10, 48, 5, 37, 22, 8, 51, 29]  # > 2 blocks of KV to ship
+
+
+# ---------------------------------------------------------------------------
+# engine-level: export -> stage -> commit -> resume
+# ---------------------------------------------------------------------------
+
+
+def test_kv_export_import_roundtrip_zero_reprefill_greedy_identity():
+    cfg, params = _model()
+    eng_a = _engine(cfg, params)
+    eng_b = _engine(cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32))
+    try:
+        ref = _greedy(eng_a, PROMPT, max_new=8, rid="ref")
+        assert len(ref) == 8
+
+        first = _prefill_only(eng_a, "d1", PROMPT)
+        assert first == ref[:1]
+        meta, chunks = eng_a.export_kv("d1")
+        assert meta["tokens"] == PROMPT + first
+        assert meta["version"] == 0
+        assert eng_a.kv_export_total == 1
+
+        # stage out of order and, when the pool gave us >1 block, split a
+        # chunk in two — exercises the seq-keyed multi-chunk assembly
+        staged = []
+        for named, digest in chunks:
+            assert isinstance(digest, str) and digest
+            nb = next(iter(named.values())).shape[1]
+            if nb > 1 and not staged:
+                half = nb // 2
+                staged.append({k: a[:, :half] for k, a in named.items()})
+                staged.append({k: a[:, half:] for k, a in named.items()})
+            else:
+                staged.append(named)
+        for seq in reversed(range(len(staged))):
+            eng_b.stage_kv_chunk("d1", meta["version"], seq, staged[seq])
+        eng_b.commit_kv_import("d1", meta["version"], meta["tokens"])
+        assert eng_b.kv_import_total == 1
+
+        # the prefill side releases its pinned copy once the ship landed
+        eng_a.release_kv("d1")
+        assert eng_a.serving_stats()["retained_kv_slots"] == 0
+
+        # decode resumes from the imported KV: zero re-prefill, and the
+        # continuation is exactly the single-engine greedy tail
+        tail = _greedy(eng_b, meta["tokens"], max_new=7, rid="d1")
+        assert tail == ref[1:]
+        assert eng_b.resumed_total == 1
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+def test_kv_import_version_fence_stage_and_commit():
+    cfg, params = _model()
+    eng_a = _engine(cfg, params)
+    eng_b = _engine(cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32))
+    try:
+        _prefill_only(eng_a, "d2", PROMPT)
+        meta, chunks = eng_a.export_kv("d2")
+        parts = [named for named, _ in chunks]
+
+        # stage half the stream, then land a weight commit (same values,
+        # new version — greedy identity elsewhere must be preserved)
+        eng_b.stage_kv_chunk("d2", meta["version"], 0, parts[0])
+        eng_b.update_weights_from_named_arrays(_flat_host(params), version=1)
+        assert eng_b.get_version() == 1
+
+        # fast path: staging a chunk for a version this engine no longer
+        # serves refuses immediately
+        before = eng_b.kv_import_refused_version_total
+        with pytest.raises(KVVersionMismatch):
+            eng_b.stage_kv_chunk("d2", meta["version"], 1, parts[-1])
+        assert eng_b.kv_import_refused_version_total == before + 1
+
+        # authoritative path: the commit re-checks on the engine thread
+        with pytest.raises((KVVersionMismatch, KVNoCapacity)):
+            eng_b.commit_kv_import("d2", meta["version"], meta["tokens"])
+        assert eng_b.kv_import_total == 0
+
+        # and a commit with nothing staged refuses as a torn stream
+        with pytest.raises(KVNoCapacity):
+            eng_b.commit_kv_import("never-staged", 1, [1, 2, 3])
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+def test_int8_pool_kv_ship_bit_exact():
+    cfg, params = _model()
+    eng_a = _engine(cfg, params, kv_quant="int8")
+    eng_b = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        kv_quant="int8",
+    )
+    ref_eng = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        kv_quant="int8",
+    )
+    try:
+        ref = _greedy(ref_eng, PROMPT, max_new=8, rid="ref8")
+        first = _prefill_only(eng_a, "q1", PROMPT)
+        assert first == ref[:1]
+
+        meta, chunks = eng_a.export_kv("q1")
+        assert meta["kv_quant"] == "int8"
+        exported = [(named, digest) for named, digest in chunks]
+        # int8 pools ship quantized rows AND their scale planes
+        leaves = set(exported[0][0])
+        assert {"ks", "vs"} <= leaves or any(
+            k.endswith("s") for k in leaves
+        ), f"no scale planes in int8 export: {sorted(leaves)}"
+
+        for seq, (named, _) in enumerate(exported):
+            eng_b.stage_kv_chunk("q1", meta["version"], seq, named)
+        eng_b.commit_kv_import("q1", meta["version"], meta["tokens"])
+
+        # the import registers a pinned retained entry, so the receiving
+        # pool can re-export: every leaf must round-trip bit-exactly
+        meta2, chunks2 = eng_b.export_kv("q1")
+        assert meta2["tokens"] == meta["tokens"]
+        reexported = [named for named, _ in chunks2]
+
+        def cat(parts):
+            return {
+                k: (
+                    parts[0][k]
+                    if len(parts) == 1
+                    else np.concatenate([p[k] for p in parts], axis=1)
+                )
+                for k in parts[0]
+            }
+
+        a_rows = cat([named for named, _ in exported])
+        b_rows = cat(reexported)
+        assert set(a_rows) == set(b_rows)
+        for k in a_rows:
+            assert a_rows[k].dtype == b_rows[k].dtype, k
+            assert np.array_equal(a_rows[k], b_rows[k]), (
+                f"leaf {k} not bit-exact after int8 KV ship"
+            )
+
+        # and the resumed decode is token-identical to the local run
+        tail = _greedy(eng_b, meta["tokens"], max_new=7, rid="q1")
+        assert tail == ref[1:]
+        assert eng_b.resumed_total == 1
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+        ref_eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real prefill/decode servers + role-aware client
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_end_to_end_greedy_identity_and_counters():
+    cfg, params = _model()
+    ref_eng = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    )
+    addr_p, eng_p, stop_p = _serve(cfg, params, role="prefill")
+    addr_d, eng_d, stop_d = _serve(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        role="decode",
+    )
+    client = _client([addr_p, addr_d], disagg=True)
+    try:
+        ref = _greedy(ref_eng, PROMPT, max_new=12)
+        shipped0 = _ship_count("shipped")
+
+        gc = GenerationHyperparameters(max_new_tokens=12, greedy=True)
+        resp = client.generate(
+            ModelRequest(rid="e2e", input_ids=list(PROMPT), gconfig=gc)
+        )
+
+        assert resp.output_tokens == ref  # token-identical to single pool
+        assert _ship_count("shipped") == shipped0 + 1
+        # the roles were learned (name_resolve subtree or /ready probe)
+        assert client._server_roles.get(addr_p) == "prefill"
+        assert client._server_roles.get(addr_d) == "decode"
+        # prefill pool prefilled + exported; decode pool imported + resumed
+        assert eng_p.kv_export_total == 1
+        assert eng_d.kv_import_total == 1
+        assert eng_d.resumed_total >= 1
+        assert eng_d.kv_export_total == 0
+        # the landed ship released the prefill server's pinned copy
+        assert eng_p.serving_stats()["retained_kv_slots"] == 0
+        stats = eng_d.serving_stats()
+        assert stats["kv_import_total"] == 1
+    finally:
+        client.destroy()
+        stop_p()
+        stop_d()
+        ref_eng.stop()
+
+
+class _KillOn:
+    """Client-side chaos hook that REALLY kills a server the moment the
+    client issues a request matching ``needle`` — the request then hits a
+    dead peer (mid-KV-ship prefill-server death, not a simulated error)."""
+
+    def __init__(self, needle: str, stop_fn):
+        self.needle, self._stop = needle, stop_fn
+        self.killed = False
+
+    def decide(self, url):
+        if self.needle in url and not self.killed:
+            self.killed = True
+            self._stop()
+        return None  # never fake a fault: let the request hit the corpse
+
+
+def test_prefill_server_killed_mid_ship_token_exact_failover():
+    cfg, params = _model()
+    ref_eng = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    )
+    addr_p, eng_p, stop_p = _serve(cfg, params, role="prefill")
+    addr_d, eng_d, stop_d = _serve(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        role="decode",
+    )
+    client = _client([addr_p, addr_d], disagg=True)
+    chaos = _KillOn("/ship_kv", stop_p)
+    client._chaos = chaos
+    try:
+        ref = _greedy(ref_eng, PROMPT, max_new=12)
+        failed0 = _ship_count("fallback_ship_failed")
+
+        gc = GenerationHyperparameters(max_new_tokens=12, greedy=True)
+        resp = client.generate(
+            ModelRequest(rid="chaos", input_ids=list(PROMPT), gconfig=gc)
+        )
+
+        assert chaos.killed, "chaos hook never fired — no ship attempted"
+        # the failure was loud (counted), never silent
+        assert _ship_count("fallback_ship_failed") == failed0 + 1
+        # nothing landed on the decode pool's import path: it re-prefilled
+        # locally, keeping the prefill leg's sampled token (splice)
+        assert eng_d.kv_import_total == 0
+        assert resp.output_tokens == ref  # token-exact failover
+        assert resp.stop_reason in ("stop", "length")
+    finally:
+        client.destroy()
+        if not chaos.killed:
+            stop_p()
+        stop_d()
+        ref_eng.stop()
+
+
+def test_weight_commit_between_prefill_and_import_fences_with_412():
+    cfg, params = _model()
+    ref_eng = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    )
+    addr_p, eng_p, stop_p = _serve(cfg, params, role="prefill")
+    addr_d, eng_d, stop_d = _serve(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        role="decode",
+    )
+    # a staged weight commit lands on the decode pool (same values, new
+    # version — exactly what a trainer push between legs looks like)
+    eng_d.update_weights_from_named_arrays(_flat_host(params), version=1)
+    assert eng_d.get_version() == 1
+
+    client = _client([addr_p, addr_d], disagg=True)
+    try:
+        ref = _greedy(ref_eng, PROMPT, max_new=12)
+        fence0 = _ship_count("fallback_version_fence")
+
+        gc = GenerationHyperparameters(max_new_tokens=12, greedy=True)
+        resp = client.generate(
+            ModelRequest(rid="fence", input_ids=list(PROMPT), gconfig=gc)
+        )
+
+        # the import refused with 412 (version fence), passed through the
+        # ship verbatim, and the client counted the loud fallback
+        assert _ship_count("fallback_version_fence") == fence0 + 1
+        assert eng_d.kv_import_refused_version_total >= 1
+        assert eng_d.kv_import_total == 0
+        # greedy identity preserved across the fence: decode re-prefilled
+        # locally under the committed (identical-value) weights
+        assert resp.output_tokens == ref
+        # the splice is visible in version accounting: first token from
+        # the v0 prefill leg, the rest from the v1 decode server
+        assert resp.output_versions[0] == 0
+        assert set(resp.output_versions[1:]) == {1}
+    finally:
+        client.destroy()
+        stop_p()
+        stop_d()
+        ref_eng.stop()
+
+
+def test_single_pool_default_runs_no_disaggregation_machinery():
+    """The no-behavior-change pin: with the default config the serving
+    path must not touch ANY disaggregation machinery, even when the fleet
+    happens to carry role tags."""
+    cfg, params = _model()
+    ref_eng = _engine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    )
+    addr_p, eng_p, stop_p = _serve(cfg, params, role="prefill")
+    addr_d, eng_d, stop_d = _serve(
+        cfg, init_params(cfg, jax.random.PRNGKey(0), jnp.float32),
+        role="decode",
+    )
+    client = _client([addr_p, addr_d], disagg=False)  # the default
+    try:
+        assert client.config.disaggregation.enabled is False
+        ref = _greedy(ref_eng, PROMPT, max_new=12)
+        before = {
+            o: _ship_count(o)
+            for o in (
+                "shipped",
+                "fallback_no_role_servers",
+                "fallback_prefill_failed",
+                "fallback_ship_failed",
+                "fallback_version_fence",
+            )
+        }
+
+        gc = GenerationHyperparameters(max_new_tokens=12, greedy=True)
+        resp = client.generate(
+            ModelRequest(rid="plain", input_ids=list(PROMPT), gconfig=gc)
+        )
+
+        assert resp.output_tokens == ref
+        for eng in (eng_p, eng_d):
+            assert eng.kv_export_total == 0
+            assert eng.kv_import_total == 0
+            assert eng.kv_import_refused_version_total == 0
+        for o, v in before.items():
+            assert _ship_count(o) == v, f"counter {o} moved in single-pool"
+        # no role probing either: the map stays exactly as discovery left
+        # it (the probe only runs on the disaggregated path)
+        assert not client._server_roles
+    finally:
+        client.destroy()
+        stop_p()
+        stop_d()
+        ref_eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# role-aware fleet policy: bounds + signal ownership
+# ---------------------------------------------------------------------------
+
+
+def _policy_cfg(**over) -> FleetConfig:
+    base = dict(
+        min_servers=1,
+        max_servers=8,
+        prefill_min_servers=1,
+        prefill_max_servers=3,
+        decode_min_servers=2,
+        decode_max_servers=5,
+        breach_evaluations=1,
+        scale_out_cooldown_seconds=0.0,
+        scale_in_cooldown_seconds=0.0,
+        queue_depth_high_per_server=4.0,
+        ttft_p95_high_seconds=1.0,
+        itl_p95_high_seconds=0.1,
+    )
+    base.update(over)
+    return FleetConfig(**base)
+
+
+def test_role_policy_bounds_and_validation():
+    cfg = _policy_cfg()
+    clock = lambda: 0.0  # noqa: E731
+    assert TargetTrackingPolicy(cfg, clock).bounds() == (1, 8)
+    assert TargetTrackingPolicy(cfg, clock, role="prefill").bounds() == (1, 3)
+    assert TargetTrackingPolicy(cfg, clock, role="decode").bounds() == (2, 5)
+    with pytest.raises(ValueError):
+        FleetPolicy(cfg, clock, role="draft")
+
+
+def test_decode_policy_ignores_admission_signals_scales_on_itl():
+    t = [0.0]
+    pol = TargetTrackingPolicy(_policy_cfg(), lambda: t[0], role="decode")
+    # an admission storm (queue depth + TTFT + queue wait all breached) is
+    # the PREFILL pool's problem: the decode policy holds
+    admission = FleetSignals(
+        queue_depth=100, ttft_p95=9.0, queue_wait_p95=9.0,
+        n_reporting=2, n_servers=2, inflight_total=4,
+    )
+    d = pol.desired_size(admission, current=2)
+    assert d.direction == "hold"
+    # but a breached inter-token latency is: scale out, decode bounds
+    t[0] += 100.0
+    d = pol.desired_size(
+        FleetSignals(itl_p95=0.5, n_reporting=2, n_servers=2), current=2
+    )
+    assert d.direction == "out" and d.desired == 3
+    assert "itl_p95" in d.reason
+
+
+def test_prefill_policy_ignores_itl_scales_on_queue_wait():
+    t = [0.0]
+    pol = TargetTrackingPolicy(_policy_cfg(), lambda: t[0], role="prefill")
+    # decode-side ITL breach: not this pool's signal
+    d = pol.desired_size(
+        FleetSignals(itl_p95=9.0, n_reporting=2, n_servers=2,
+                     inflight_total=4),
+        current=2,
+    )
+    assert d.direction == "hold"
+    # queue_wait_p95 shares TTFT's threshold (it is TTFT's admission
+    # component): breaching it alone scales the prefill pool out
+    t[0] += 100.0
+    d = pol.desired_size(
+        FleetSignals(queue_wait_p95=2.0, n_reporting=2, n_servers=2),
+        current=2,
+    )
+    assert d.direction == "out" and d.desired == 3
+    assert "ttft_p95" in d.reason

@@ -997,7 +997,14 @@ class _StubEngine:
         return {}
 
     def submit(
-        self, rid, input_ids, gconfig, on_done, image_data=None, priority=0
+        self,
+        rid,
+        input_ids,
+        gconfig,
+        on_done,
+        image_data=None,
+        priority=0,
+        prefill_only=False,
     ):
         from areal_tpu.api.io_struct import ModelResponse
 
